@@ -1,0 +1,125 @@
+"""Arrow ⇄ ColumnarBatch interchange.
+
+Role of the reference's ArrowConverters (sqlx/arrow/ArrowConverters.scala:216
+toBatchIterator / :447 fromBatchIterator) — but Arrow is our *native* ingest
+format rather than a sidecar: scans deliver pyarrow RecordBatches which are
+dictionary-encoded, padded to a capacity bucket, and shipped to device HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from ..types import (
+    DataType,
+    DecimalType,
+    StringType,
+    StructField,
+    StructType,
+    from_arrow_type,
+)
+from .batch import Column, ColumnarBatch, StringDict, bucket_capacity
+
+__all__ = ["schema_from_arrow", "table_to_batches", "batches_to_table",
+           "record_batch_to_columnar"]
+
+
+def schema_from_arrow(aschema: pa.Schema) -> StructType:
+    return StructType([
+        StructField(f.name, from_arrow_type(f.type), f.nullable)
+        for f in aschema
+    ])
+
+
+def _chunked_to_numpy(arr: pa.ChunkedArray | pa.Array, dt: DataType):
+    """→ (data ndarray in device dtype, validity ndarray|None, StringDict|None)."""
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    n = len(arr)
+    validity = None
+    if arr.null_count:
+        validity = np.asarray(arr.is_valid())
+
+    if isinstance(dt, StringType):
+        if pa.types.is_dictionary(arr.type):
+            darr = arr
+        else:
+            darr = pc.dictionary_encode(arr)
+        if isinstance(darr, pa.ChunkedArray):
+            darr = darr.combine_chunks()
+        codes = np.asarray(darr.indices.fill_null(0)).astype(np.int32)
+        values = darr.dictionary.to_pylist()
+        sd = StringDict([v if v is not None else "" for v in values])
+        return codes, validity, sd
+
+    if isinstance(dt, DecimalType):
+        # scaled int64
+        scaled = pc.multiply(pc.cast(arr, pa.float64()), 10.0 ** dt.scale)
+        data = np.rint(np.asarray(pc.cast(scaled, pa.float64()).fill_null(0))).astype(np.int64)
+        return data, validity, None
+
+    at = arr.type
+    if pa.types.is_date32(at):
+        data = np.asarray(arr.fill_null(0)).astype("datetime64[D]").astype(np.int32)
+        return data, validity, None
+    if pa.types.is_timestamp(at):
+        a = pc.cast(arr, pa.timestamp("us"))
+        data = np.asarray(a.fill_null(0)).astype("datetime64[us]").astype(np.int64)
+        return data, validity, None
+    if pa.types.is_boolean(at):
+        data = np.asarray(arr.fill_null(False)).astype(bool)
+        return data, validity, None
+    data = np.asarray(arr.fill_null(0)).astype(dt.device_dtype)
+    return data, validity, None
+
+
+def record_batch_to_columnar(rb: pa.RecordBatch | pa.Table,
+                             schema: StructType | None = None,
+                             capacity: int | None = None) -> ColumnarBatch:
+    import jax.numpy as jnp
+
+    if schema is None:
+        schema = schema_from_arrow(rb.schema)
+    n = rb.num_rows
+    cap = capacity or bucket_capacity(max(n, 1))
+    cols = []
+    for i, f in enumerate(schema.fields):
+        data, validity, sd = _chunked_to_numpy(rb.column(i), f.dataType)
+        pad = np.zeros(cap, dtype=f.dataType.device_dtype)
+        pad[:n] = data[:cap]
+        v = None
+        if validity is not None:
+            vm = np.zeros(cap, dtype=bool)
+            vm[:n] = validity[:cap]
+            v = jnp.asarray(vm)
+        cols.append(Column(f.dataType, jnp.asarray(pad), v, sd))
+    mask = np.zeros(cap, dtype=bool)
+    mask[:n] = True
+    return ColumnarBatch(schema, cols, jnp.asarray(mask), num_rows=n)
+
+
+def table_to_batches(table: pa.Table, rows_per_batch: int,
+                     schema: StructType | None = None) -> Iterator[ColumnarBatch]:
+    """Slice an Arrow table into fixed-capacity ColumnarBatches."""
+    if schema is None:
+        schema = schema_from_arrow(table.schema)
+    n = table.num_rows
+    if n == 0:
+        yield ColumnarBatch.empty(schema)
+        return
+    for start in range(0, n, rows_per_batch):
+        chunk = table.slice(start, rows_per_batch)
+        yield record_batch_to_columnar(chunk, schema,
+                                       capacity=bucket_capacity(rows_per_batch))
+
+
+def batches_to_table(batches: Iterable[ColumnarBatch]) -> pa.Table:
+    tables = [b.to_arrow() for b in batches]
+    tables = [t for t in tables if t.num_rows or len(tables) == 1]
+    if not tables:
+        raise ValueError("no batches")
+    return pa.concat_tables(tables, promote_options="permissive")

@@ -1,0 +1,367 @@
+"""Columnar batch substrate: fixed-capacity device tiles.
+
+Role of the reference's vectorized layer — ColumnVector/ColumnarBatch
+(sqlcatj/vectorized/{ColumnVector,ColumnarBatch}.java) and the writable
+On/OffHeapColumnVector (sqlxj/vectorized/OffHeapColumnVector.java) — re-designed
+for XLA:
+
+  * Every batch has a STATIC power-of-two `capacity`; the number of live rows
+    is carried as a boolean `row_mask` device array, so filters/joins never
+    change array shapes (no XLA recompilation per cardinality; SURVEY.md §7
+    'Hard parts' (1)).
+  * A column is a device array in the type's device representation plus an
+    optional validity (null) mask. Strings/binary are dictionary-encoded:
+    int32 codes on device, UTF-8 values host-side in a StringDict (the
+    reference keeps UTF8String bytes in UnsafeRow; on TPU bytes stay on host
+    and comparisons ride hashes/ranks — SURVEY.md §2.5).
+  * Selection is mask-based (the reference's selection-vector idea); host
+    materialization compacts.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field, replace
+from functools import cached_property
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+
+from ..types import (
+    BooleanType,
+    DataType,
+    DecimalType,
+    StringType,
+    StructField,
+    StructType,
+    from_arrow_type,
+    to_arrow_type,
+)
+
+__all__ = ["StringDict", "Column", "ColumnarBatch", "bucket_capacity", "EMPTY_DICT"]
+
+
+def bucket_capacity(n: int, minimum: int = 1 << 10) -> int:
+    """Round row count up to a power-of-two capacity bucket so jitted kernels
+    are reused across batches (bounded recompile cache; the reference instead
+    re-JITs Janino code per plan — codegen/CodeGenerator.scala:1557)."""
+    cap = minimum
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+def _hash_str(s: str) -> int:
+    """Deterministic 64-bit hash of a UTF-8 string (signed int64).
+
+    Per-dictionary-entry only — row-level hashing happens on device via code
+    lookup. (Native murmur3 path lives in native/; this is the fallback.)
+    """
+    d = hashlib.blake2b(s.encode("utf-8"), digest_size=8).digest()
+    return int.from_bytes(d, "little", signed=True)
+
+
+class StringDict:
+    """Host-side dictionary for a string column: unique UTF-8 values.
+
+    Device-side derivatives (lazily cached):
+      * hashes: int64[n_values] stable hash per value — the cross-dictionary
+        equality domain used by joins/group-bys over string keys.
+      * ranks:  int32[n_values] lexicographic rank — the ORDER BY key domain.
+    """
+
+    __slots__ = ("values", "_index", "_hashes", "_ranks", "_device_hashes",
+                 "_device_ranks")
+
+    def __init__(self, values: Sequence[str]):
+        self.values: list[str] = list(values)
+        self._index: dict[str, int] | None = None
+        self._hashes: np.ndarray | None = None
+        self._ranks: np.ndarray | None = None
+        self._device_hashes = None
+        self._device_ranks = None
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    @property
+    def index(self) -> dict[str, int]:
+        if self._index is None:
+            self._index = {v: i for i, v in enumerate(self.values)}
+        return self._index
+
+    def code_of(self, value: str) -> int | None:
+        return self.index.get(value)
+
+    @property
+    def hashes(self) -> np.ndarray:
+        if self._hashes is None:
+            try:
+                from ..utils.native import hash_strings
+                self._hashes = hash_strings(self.values)
+            except Exception:
+                self._hashes = np.array(
+                    [_hash_str(v) for v in self.values], dtype=np.int64)
+        return self._hashes
+
+    @property
+    def ranks(self) -> np.ndarray:
+        if self._ranks is None:
+            order = np.argsort(np.array(self.values, dtype=object), kind="stable")
+            r = np.empty(len(self.values), dtype=np.int32)
+            r[order] = np.arange(len(self.values), dtype=np.int32)
+            self._ranks = r
+        return self._ranks
+
+    def device_hashes(self):
+        if self._device_hashes is None:
+            import jax.numpy as jnp
+
+            h = self.hashes if len(self.values) else np.zeros(1, np.int64)
+            self._device_hashes = jnp.asarray(h)
+        return self._device_hashes
+
+    def device_ranks(self):
+        if self._device_ranks is None:
+            import jax.numpy as jnp
+
+            r = self.ranks if len(self.values) else np.zeros(1, np.int32)
+            self._device_ranks = jnp.asarray(r)
+        return self._device_ranks
+
+    def map_values(self, fn) -> "StringDict":
+        """Apply a host string→string function to every dictionary entry —
+        how upper/lower/substr/concat-literal execute in O(|dict|) instead of
+        O(rows) (no reference analog; enabled by dictionary encoding)."""
+        return StringDict([fn(v) for v in self.values])
+
+    @staticmethod
+    def merged(a: "StringDict", b: "StringDict"):
+        """Union two dictionaries; returns (merged, recode_a, recode_b) where
+        recode_x maps old codes → merged codes."""
+        merged = list(a.values)
+        idx = {v: i for i, v in enumerate(merged)}
+        recode_b = np.empty(max(len(b.values), 1), dtype=np.int32)
+        for i, v in enumerate(b.values):
+            j = idx.get(v)
+            if j is None:
+                j = len(merged)
+                merged.append(v)
+                idx[v] = j
+            recode_b[i] = j
+        recode_a = np.arange(max(len(a.values), 1), dtype=np.int32)
+        return StringDict(merged), recode_a, recode_b
+
+
+EMPTY_DICT = StringDict([])
+
+
+@dataclass(frozen=True)
+class Column:
+    """One column of a batch: device data + optional validity mask.
+
+    data: device array [capacity] in dtype.device_dtype
+    validity: device bool array [capacity] or None (= no nulls)
+    dictionary: StringDict for string-like columns
+    """
+
+    dtype: DataType
+    data: Any
+    validity: Any = None
+    dictionary: StringDict | None = None
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def is_string(self) -> bool:
+        return isinstance(self.dtype, StringType)
+
+    def with_data(self, data, validity="__keep__") -> "Column":
+        v = self.validity if validity == "__keep__" else validity
+        return replace(self, data=data, validity=v)
+
+    # --- device key domains ----------------------------------------------
+    def eq_keys(self):
+        """Device array usable as an equality-comparison key (joins, group-by,
+        distinct). Strings map codes → stable 64-bit value hashes so columns
+        with different dictionaries compare correctly."""
+        if self.is_string:
+            import jax.numpy as jnp
+
+            codes = jnp.clip(self.data, 0, max(len(self.dictionary) - 1, 0))
+            return jnp.take(self.dictionary.device_hashes(), codes)
+        if isinstance(self.dtype, BooleanType):
+            return self.data.astype(np.int32)
+        return self.data
+
+    def sort_keys(self):
+        """Device array whose numeric order == SQL ORDER BY order."""
+        if self.is_string:
+            import jax.numpy as jnp
+
+            codes = jnp.clip(self.data, 0, max(len(self.dictionary) - 1, 0))
+            return jnp.take(self.dictionary.device_ranks(), codes)
+        if isinstance(self.dtype, BooleanType):
+            return self.data.astype(np.int32)
+        return self.data
+
+    # --- host materialization --------------------------------------------
+    def to_numpy(self, selection: np.ndarray | None = None) -> np.ndarray:
+        """Materialize (optionally selecting rows) into a host array of
+        Python-level values (strings decoded, decimals scaled)."""
+        data = np.asarray(self.data)
+        valid = None if self.validity is None else np.asarray(self.validity)
+        if selection is not None:
+            data = data[selection]
+            valid = valid[selection] if valid is not None else None
+        if self.is_string:
+            vals = np.array(self.dictionary.values + [""], dtype=object)
+            codes = np.clip(data, 0, len(self.dictionary.values))
+            out = vals[codes] if len(self.dictionary) else np.full(len(data), "", object)
+            out = np.asarray(out, dtype=object)
+        elif isinstance(self.dtype, DecimalType):
+            out = data.astype(np.float64) / (10 ** self.dtype.scale)
+        else:
+            out = data
+        if valid is not None:
+            out = np.asarray(out, dtype=object) if out.dtype != object else out
+            out = out.copy()
+            out[~valid] = None
+        return out
+
+
+class ColumnarBatch:
+    """A fixed-capacity tile of rows (SURVEY.md §7 step 1).
+
+    columns are positional; `schema` names them. `row_mask` marks live rows.
+    `num_rows` is the host-known live count when available (None after a
+    device-side filter until counted)."""
+
+    __slots__ = ("schema", "columns", "row_mask", "_num_rows")
+
+    def __init__(self, schema: StructType, columns: Sequence[Column], row_mask,
+                 num_rows: int | None = None):
+        assert len(schema.fields) == len(columns), (len(schema.fields), len(columns))
+        self.schema = schema
+        self.columns = list(columns)
+        self.row_mask = row_mask
+        self._num_rows = num_rows
+
+    @property
+    def capacity(self) -> int:
+        return int(self.row_mask.shape[0])
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    def column(self, i: int) -> Column:
+        return self.columns[i]
+
+    def column_by_name(self, name: str) -> Column:
+        for f, c in zip(self.schema.fields, self.columns):
+            if f.name == name:
+                return c
+        raise KeyError(name)
+
+    def num_rows(self) -> int:
+        """Live row count; syncs with device if unknown."""
+        if self._num_rows is None:
+            self._num_rows = int(np.asarray(self.row_mask).sum())
+        return self._num_rows
+
+    def with_columns(self, schema: StructType, columns: Sequence[Column],
+                     row_mask=None, num_rows: int | None = None) -> "ColumnarBatch":
+        return ColumnarBatch(
+            schema, columns,
+            self.row_mask if row_mask is None else row_mask,
+            num_rows if row_mask is not None else (num_rows or self._num_rows))
+
+    # --- construction ------------------------------------------------------
+    @staticmethod
+    def from_numpy(schema: StructType, arrays: Sequence[np.ndarray],
+                   dictionaries: Sequence[StringDict | None] | None = None,
+                   validities: Sequence[np.ndarray | None] | None = None,
+                   capacity: int | None = None) -> "ColumnarBatch":
+        import jax.numpy as jnp
+
+        n = int(arrays[0].shape[0]) if arrays else 0
+        cap = capacity or bucket_capacity(max(n, 1))
+        cols = []
+        dictionaries = dictionaries or [None] * len(arrays)
+        validities = validities or [None] * len(arrays)
+        for f, arr, d, v in zip(schema.fields, arrays, dictionaries, validities):
+            dd = f.dataType.device_dtype
+            pad = np.zeros(cap, dtype=dd)
+            pad[:n] = np.asarray(arr, dtype=dd)[:cap]
+            vv = None
+            if v is not None:
+                vm = np.zeros(cap, dtype=bool)
+                vm[:n] = v[:cap]
+                vv = jnp.asarray(vm)
+            cols.append(Column(f.dataType, jnp.asarray(pad), vv,
+                               d if isinstance(f.dataType, StringType) else None))
+        mask = np.zeros(cap, dtype=bool)
+        mask[:n] = True
+        return ColumnarBatch(schema, cols, jnp.asarray(mask), num_rows=n)
+
+    @staticmethod
+    def empty(schema: StructType, capacity: int = 1 << 10) -> "ColumnarBatch":
+        return ColumnarBatch.from_numpy(
+            schema,
+            [np.zeros(0, dtype=f.dataType.device_dtype) for f in schema.fields],
+            dictionaries=[EMPTY_DICT if isinstance(f.dataType, StringType) else None
+                          for f in schema.fields],
+            capacity=capacity)
+
+    # --- host materialization ---------------------------------------------
+    def selection_indices(self) -> np.ndarray:
+        mask = np.asarray(self.row_mask)
+        return np.nonzero(mask)[0]
+
+    def to_pydict(self) -> dict[str, np.ndarray]:
+        sel = self.selection_indices()
+        return {f.name: c.to_numpy(sel)
+                for f, c in zip(self.schema.fields, self.columns)}
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        sel = self.selection_indices()
+        arrays = []
+        for f, c in zip(self.schema.fields, self.columns):
+            vals = c.to_numpy(sel)
+            at = to_arrow_type(f.dataType)
+            if isinstance(f.dataType, DecimalType):
+                # vals are floats; rebuild exact decimals from scaled ints
+                raw = np.asarray(c.data)[sel]
+                valid = (np.asarray(c.validity)[sel]
+                         if c.validity is not None else None)
+                import decimal as _d
+
+                scale = f.dataType.scale
+                py = [None if (valid is not None and not valid[i])
+                      else _d.Decimal(int(raw[i])).scaleb(-scale)
+                      for i in range(len(raw))]
+                arrays.append(pa.array(py, type=at))
+            elif isinstance(f.dataType, StringType):
+                arrays.append(pa.array(list(vals), type=at))
+            else:
+                mask = None
+                if c.validity is not None:
+                    mask = ~np.asarray(c.validity)[sel]
+                if f.dataType.device_dtype == np.dtype(np.int32) and str(at) == "date32[day]":
+                    arrays.append(pa.array(np.asarray(vals, np.int32), type=at, mask=mask))
+                elif str(at).startswith("timestamp"):
+                    arrays.append(pa.array(np.asarray(vals, np.int64), type=at, mask=mask))
+                else:
+                    vals2 = np.asarray([v if v is not None else 0 for v in vals]) \
+                        if vals.dtype == object else vals
+                    arrays.append(pa.array(vals2, type=at, mask=mask))
+        return pa.table(arrays, names=self.schema.names)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"ColumnarBatch(cap={self.capacity}, rows={self._num_rows}, "
+                f"schema={self.schema.simple_string()})")

@@ -1,0 +1,167 @@
+"""Typed configuration system.
+
+Role of the reference's SparkConf + SQLConf (core/internal/config/package.scala,
+sqlcat/.../internal/SQLConf.scala — typed ConfigBuilder entries with defaults,
+docs, versioning; see SURVEY.md §5 "Config / flag system"), reduced to a
+registry of typed entries with per-session overrides.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass(frozen=True)
+class ConfigEntry:
+    key: str
+    default: Any
+    doc: str = ""
+    value_type: Callable[[str], Any] = str
+    since: str = "0.1.0"
+
+
+_REGISTRY: dict[str, ConfigEntry] = {}
+
+
+def _register(entry: ConfigEntry) -> ConfigEntry:
+    _REGISTRY[entry.key] = entry
+    return entry
+
+
+def _bool(v):
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("true", "1", "yes")
+
+
+# --- core entries ----------------------------------------------------------
+
+SHUFFLE_PARTITIONS = _register(ConfigEntry(
+    "spark.sql.shuffle.partitions", 8,
+    "Default number of partitions for exchanges (reference default: 200; "
+    "TPU default is sized to a pod-slice's device count).", int))
+
+BATCH_CAPACITY = _register(ConfigEntry(
+    "spark.tpu.batch.capacity", 1 << 16,
+    "Static row capacity of a ColumnarBatch tile. All kernels are compiled "
+    "for power-of-two capacity buckets to bound XLA recompilation.", int))
+
+MAX_BATCH_BUCKETS = _register(ConfigEntry(
+    "spark.tpu.batch.maxCapacity", 1 << 24,
+    "Upper bound for capacity-bucket growth on CapacityOverflowError.", int))
+
+AUTO_BROADCAST_THRESHOLD = _register(ConfigEntry(
+    "spark.sql.autoBroadcastJoinThreshold", 10 * 1024 * 1024,
+    "Max estimated build-side bytes for broadcast hash join "
+    "(reference: SQLConf.AUTO_BROADCASTJOIN_THRESHOLD).", int))
+
+ADAPTIVE_ENABLED = _register(ConfigEntry(
+    "spark.sql.adaptive.enabled", True,
+    "Re-optimize at exchange boundaries from runtime stats "
+    "(reference: sqlx/adaptive/AdaptiveSparkPlanExec.scala).", _bool))
+
+COALESCE_PARTITIONS_ENABLED = _register(ConfigEntry(
+    "spark.sql.adaptive.coalescePartitions.enabled", True,
+    "AQE partition coalescing (reference: CoalesceShufflePartitions.scala).",
+    _bool))
+
+ADVISORY_PARTITION_BYTES = _register(ConfigEntry(
+    "spark.sql.adaptive.advisoryPartitionSizeInBytes", 64 * 1024 * 1024,
+    "Target partition size for AQE coalescing.", int))
+
+SKEW_JOIN_ENABLED = _register(ConfigEntry(
+    "spark.sql.adaptive.skewJoin.enabled", True,
+    "Split skewed shuffle partitions (reference: OptimizeSkewedJoin.scala:57).",
+    _bool))
+
+CASE_SENSITIVE = _register(ConfigEntry(
+    "spark.sql.caseSensitive", False,
+    "Case sensitivity of identifier resolution.", _bool))
+
+ANSI_ENABLED = _register(ConfigEntry(
+    "spark.sql.ansi.enabled", False,
+    "ANSI SQL semantics (errors on overflow/invalid cast instead of null).",
+    _bool))
+
+SESSION_TIMEZONE = _register(ConfigEntry(
+    "spark.sql.session.timeZone", "UTC", "Session timezone.", str))
+
+DEFAULT_PARALLELISM = _register(ConfigEntry(
+    "spark.default.parallelism", 8,
+    "Default partition count for parallelize / scans.", int))
+
+MAX_RESULT_ROWS = _register(ConfigEntry(
+    "spark.tpu.collect.maxRows", 1 << 26,
+    "Safety cap on rows materialized to the host by collect().", int))
+
+DEVICE_MESH_AXIS = _register(ConfigEntry(
+    "spark.tpu.mesh.dataAxis", "data",
+    "Name of the mesh axis partitions are sharded over.", str))
+
+CODEGEN_CACHE_SIZE = _register(ConfigEntry(
+    "spark.tpu.kernel.cacheSize", 1024,
+    "Max entries in the jitted-kernel cache (role of the reference's "
+    "CodeGenerator Janino class cache, codegen/CodeGenerator.scala:1557).",
+    int))
+
+
+class SQLConf:
+    """Session-local config with string overrides over typed defaults.
+
+    Thread-safe; `get` accepts either a ConfigEntry or a string key.
+    """
+
+    def __init__(self, overrides: dict[str, Any] | None = None):
+        self._lock = threading.RLock()
+        self._values: dict[str, Any] = dict(overrides or {})
+
+    def set(self, key: str | ConfigEntry, value: Any) -> "SQLConf":
+        k = key.key if isinstance(key, ConfigEntry) else key
+        with self._lock:
+            self._values[k] = value
+        return self
+
+    def unset(self, key: str | ConfigEntry) -> None:
+        k = key.key if isinstance(key, ConfigEntry) else key
+        with self._lock:
+            self._values.pop(k, None)
+
+    def get(self, key: str | ConfigEntry, default: Any = None) -> Any:
+        entry = key if isinstance(key, ConfigEntry) else _REGISTRY.get(key)
+        k = entry.key if entry else key
+        with self._lock:
+            if k in self._values:
+                raw = self._values[k]
+                if entry is not None and isinstance(raw, str):
+                    return entry.value_type(raw)
+                return raw
+        if entry is not None:
+            return entry.default
+        return default
+
+    def copy(self) -> "SQLConf":
+        with self._lock:
+            return SQLConf(dict(self._values))
+
+    # convenience typed accessors used on hot paths
+    @property
+    def shuffle_partitions(self) -> int:
+        return int(self.get(SHUFFLE_PARTITIONS))
+
+    @property
+    def batch_capacity(self) -> int:
+        return int(self.get(BATCH_CAPACITY))
+
+    @property
+    def case_sensitive(self) -> bool:
+        return bool(self.get(CASE_SENSITIVE))
+
+    @property
+    def ansi_enabled(self) -> bool:
+        return bool(self.get(ANSI_ENABLED))
+
+
+def registry() -> dict[str, ConfigEntry]:
+    return dict(_REGISTRY)

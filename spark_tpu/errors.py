@@ -1,0 +1,76 @@
+"""Error-condition framework.
+
+Modeled on the reference's SparkThrowable/error-class system
+(common/utils/src/main/resources/error/ + SparkThrowable JSON error conditions,
+see SURVEY.md §2.2 "utils / utils-java") but as a small Python exception
+hierarchy with stable error classes.
+"""
+
+from __future__ import annotations
+
+
+class SparkTpuError(Exception):
+    """Base error. `error_class` is a stable machine-readable identifier."""
+
+    error_class: str = "INTERNAL_ERROR"
+
+    def __init__(self, message: str, error_class: str | None = None):
+        super().__init__(message)
+        if error_class is not None:
+            self.error_class = error_class
+
+
+class AnalysisException(SparkTpuError):
+    """Raised during analysis/resolution (reference: AnalysisException)."""
+
+    error_class = "ANALYSIS_ERROR"
+
+
+class ParseException(AnalysisException):
+    """SQL text could not be parsed (reference: ParseException)."""
+
+    error_class = "PARSE_SYNTAX_ERROR"
+
+
+class UnresolvedColumnError(AnalysisException):
+    error_class = "UNRESOLVED_COLUMN"
+
+    def __init__(self, name: str, candidates: list[str] | None = None):
+        hint = f". Did you mean one of: {candidates}?" if candidates else ""
+        super().__init__(
+            f"A column or function parameter with name `{name}` cannot be resolved{hint}"
+        )
+        self.name = name
+
+
+class TypeCheckError(AnalysisException):
+    error_class = "DATATYPE_MISMATCH"
+
+
+class ExecutionError(SparkTpuError):
+    """Raised while executing a physical plan."""
+
+    error_class = "EXECUTION_ERROR"
+
+
+class CapacityOverflowError(ExecutionError):
+    """A static-shape kernel produced more rows than its output capacity.
+
+    The runtime catches this and retries with the next capacity bucket
+    (the TPU analog of the reference's spill-to-disk escape hatches, e.g.
+    TungstenAggregationIterator's sort-based fallback).
+    """
+
+    error_class = "CAPACITY_OVERFLOW"
+
+    def __init__(self, needed: int, capacity: int, site: str = ""):
+        super().__init__(
+            f"Kernel at {site or '<unknown>'} needed {needed} output rows "
+            f"but static capacity is {capacity}"
+        )
+        self.needed = needed
+        self.capacity = capacity
+
+
+class UnsupportedOperationError(SparkTpuError):
+    error_class = "UNSUPPORTED_OPERATION"

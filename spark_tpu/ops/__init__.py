@@ -1,0 +1,18 @@
+"""Device kernel library — the Tungsten replacement (SURVEY.md §7 step 2).
+
+Pure-jnp functions designed to be composed inside a single `jax.jit` per
+physical operator pipeline; XLA fuses them the way the reference's
+WholeStageCodegen fuses Java iterators (sqlx/WholeStageCodegenExec.scala:47).
+"""
+
+from .hashing import hash_columns, mix64, partition_ids  # noqa: F401
+from .grouping import (  # noqa: F401
+    GroupLayout, group_rows, scatter_group_keys, group_output_mask,
+    seg_sum, seg_count, seg_min, seg_max, seg_first,
+    masked_sum, masked_min, masked_max,
+)
+from .sorting import SortKeySpec, sort_permutation, take_rows, limit_mask  # noqa: F401
+from .joining import BuildSide, JoinResult, build_index, probe_join, cross_join  # noqa: F401
+from .partition import (  # noqa: F401
+    PartitionedRows, hash_partition, round_robin_partition, range_partition,
+)

@@ -1,0 +1,183 @@
+"""Sort-based grouped aggregation kernel.
+
+Role of the reference's HashAggregateExec + UnsafeFixedWidthAggregationMap
+(sqlx/aggregate/HashAggregateExec.scala:50, corej/unsafe/map/BytesToBytesMap.java)
+and its sort-based fallback (TungstenAggregationIterator). TPU-native design:
+no hash table at all — `lax.sort` (bitonic/radix, MXU-adjacent, fully
+data-parallel) groups equal keys adjacently, then `segment_sum`-family ops
+reduce each run. Static shapes throughout: output has the same capacity as
+input (worst case all rows distinct) with a row mask for live groups.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class GroupLayout(NamedTuple):
+    """Result of grouping rows by key columns."""
+
+    perm: jnp.ndarray        # int32[cap] permutation sorting rows (inactive last)
+    seg_ids: jnp.ndarray     # int32[cap] segment id per SORTED row (0-based)
+    start_flag: jnp.ndarray  # bool[cap] first-row-of-group flag per sorted row
+    active: jnp.ndarray      # bool[cap] row_mask per sorted row
+    num_groups: jnp.ndarray  # int32 scalar — number of live groups
+
+
+def group_rows(key_cols: Sequence[jnp.ndarray],
+               key_valids: Sequence[jnp.ndarray | None],
+               row_mask: jnp.ndarray) -> GroupLayout:
+    """Sort rows so equal keys (SQL semantics: null == null, inactive rows
+    last) are adjacent; derive segment structure."""
+    cap = row_mask.shape[0]
+    inactive = (~row_mask).astype(jnp.int32)
+    operands = [inactive]
+    for c, v in zip(key_cols, key_valids):
+        if v is not None:
+            operands.append((~v).astype(jnp.int32))  # nulls group together
+            operands.append(jnp.where(v, c, jnp.zeros_like(c)))
+        else:
+            operands.append(c)
+    num_keys = len(operands)
+    operands.append(lax.iota(jnp.int32, cap))
+    sorted_ops = lax.sort(tuple(operands), num_keys=num_keys, is_stable=True)
+    perm = sorted_ops[-1]
+    skeys = sorted_ops[:num_keys]
+    active = jnp.take(row_mask, perm)
+
+    changed = jnp.zeros(cap, dtype=bool).at[0].set(True)
+    for k in skeys:
+        diff = jnp.concatenate([jnp.ones(1, dtype=bool), k[1:] != k[:-1]])
+        changed = changed | diff
+    start_flag = changed & active
+    seg_ids = jnp.cumsum(start_flag.astype(jnp.int32)) - 1
+    seg_ids = jnp.maximum(seg_ids, 0)
+    num_groups = jnp.sum(start_flag.astype(jnp.int32))
+    return GroupLayout(perm, seg_ids, start_flag, active, num_groups)
+
+
+def scatter_group_keys(layout: GroupLayout, key_col: jnp.ndarray,
+                       key_valid: jnp.ndarray | None):
+    """Gather each group's key value into output slot seg_id.
+
+    Returns (data[cap], validity[cap] | None) in group-output order."""
+    cap = layout.perm.shape[0]
+    sorted_vals = jnp.take(key_col, layout.perm)
+    idx = jnp.where(layout.start_flag, layout.seg_ids, cap)  # drop non-starts
+    out = jnp.zeros(cap, dtype=key_col.dtype).at[idx].set(sorted_vals, mode="drop")
+    out_valid = None
+    if key_valid is not None:
+        sv = jnp.take(key_valid, layout.perm)
+        out_valid = jnp.zeros(cap, dtype=bool).at[idx].set(sv, mode="drop")
+    return out, out_valid
+
+
+def group_output_mask(layout: GroupLayout):
+    cap = layout.perm.shape[0]
+    return lax.iota(jnp.int32, cap) < layout.num_groups
+
+
+# --- segment aggregation primitives ---------------------------------------
+
+def _weights(layout: GroupLayout, valid: jnp.ndarray | None):
+    w = layout.active
+    if valid is not None:
+        w = w & jnp.take(valid, layout.perm)
+    return w
+
+
+def seg_sum(layout: GroupLayout, values: jnp.ndarray, valid=None):
+    cap = values.shape[0]
+    v = jnp.take(values, layout.perm)
+    w = _weights(layout, valid)
+    acc_dtype = jnp.float64 if jnp.issubdtype(v.dtype, jnp.floating) else jnp.int64
+    vv = jnp.where(w, v.astype(acc_dtype), jnp.zeros((), acc_dtype))
+    total = jax.ops.segment_sum(vv, layout.seg_ids, num_segments=cap)
+    cnt = jax.ops.segment_sum(w.astype(jnp.int64), layout.seg_ids, num_segments=cap)
+    return total, cnt  # caller derives sum validity: cnt > 0
+
+
+def seg_count(layout: GroupLayout, valid=None):
+    cap = layout.perm.shape[0]
+    w = _weights(layout, valid)
+    return jax.ops.segment_sum(w.astype(jnp.int64), layout.seg_ids, num_segments=cap)
+
+
+def seg_min(layout: GroupLayout, values: jnp.ndarray, valid=None):
+    cap = values.shape[0]
+    v = jnp.take(values, layout.perm)
+    w = _weights(layout, valid)
+    big = _max_ident(v.dtype)
+    vv = jnp.where(w, v, big)
+    m = jax.ops.segment_min(vv, layout.seg_ids, num_segments=cap)
+    cnt = jax.ops.segment_sum(w.astype(jnp.int32), layout.seg_ids, num_segments=cap)
+    return m, cnt > 0
+
+
+def seg_max(layout: GroupLayout, values: jnp.ndarray, valid=None):
+    cap = values.shape[0]
+    v = jnp.take(values, layout.perm)
+    w = _weights(layout, valid)
+    small = _min_ident(v.dtype)
+    vv = jnp.where(w, v, small)
+    m = jax.ops.segment_max(vv, layout.seg_ids, num_segments=cap)
+    cnt = jax.ops.segment_sum(w.astype(jnp.int32), layout.seg_ids, num_segments=cap)
+    return m, cnt > 0
+
+
+def seg_first(layout: GroupLayout, values: jnp.ndarray, valid=None):
+    """First value per group in sorted order (the reference's First agg is
+    also order-dependent)."""
+    cap = values.shape[0]
+    v = jnp.take(values, layout.perm)
+    w = _weights(layout, valid)
+    # first row of each group where weight holds: use segment_min over
+    # (position if w else cap)
+    pos = lax.iota(jnp.int32, cap)
+    p = jnp.where(w, pos, cap)
+    first_pos = jax.ops.segment_min(p, layout.seg_ids, num_segments=cap)
+    has = first_pos < cap
+    fp = jnp.minimum(first_pos, cap - 1)
+    return jnp.take(v, fp), has
+
+
+def _max_ident(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf, dtype)
+    if dtype == jnp.bool_:
+        return jnp.asarray(True)
+    return jnp.asarray(jnp.iinfo(dtype).max, dtype)
+
+
+def _min_ident(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(-jnp.inf, dtype)
+    if dtype == jnp.bool_:
+        return jnp.asarray(False)
+    return jnp.asarray(jnp.iinfo(dtype).min, dtype)
+
+
+# --- ungrouped (global) aggregation ---------------------------------------
+
+def masked_sum(values, row_mask, valid=None):
+    w = row_mask if valid is None else (row_mask & valid)
+    acc_dtype = jnp.float64 if jnp.issubdtype(values.dtype, jnp.floating) else jnp.int64
+    s = jnp.sum(jnp.where(w, values.astype(acc_dtype), jnp.zeros((), acc_dtype)))
+    c = jnp.sum(w.astype(jnp.int64))
+    return s, c
+
+
+def masked_min(values, row_mask, valid=None):
+    w = row_mask if valid is None else (row_mask & valid)
+    m = jnp.min(jnp.where(w, values, _max_ident(values.dtype)))
+    return m, jnp.any(w)
+
+
+def masked_max(values, row_mask, valid=None):
+    w = row_mask if valid is None else (row_mask & valid)
+    m = jnp.max(jnp.where(w, values, _min_ident(values.dtype)))
+    return m, jnp.any(w)

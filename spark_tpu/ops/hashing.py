@@ -1,0 +1,74 @@
+"""Device hashing kernels.
+
+Role of the reference's Murmur3_x86_32 (common/unsafe/.../hash/Murmur3_x86_32.java)
+used for shuffle partition ids and hash-map keys. TPU-native choice: a 64-bit
+splitmix finalizer over int64 lanes — vectorizes to pure VPU element-wise ops,
+no byte-level loops, and 64 bits make hash-equality a safe join/group-by
+comparison domain (collision probability ~n²/2⁶⁵).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_M1 = jnp.uint64(0xBF58476D1CE4E5B9)
+_M2 = jnp.uint64(0x94D049BB133111EB)
+_GOLDEN = jnp.uint64(0x9E3779B97F4A7C15)
+
+
+def mix64(x):
+    """splitmix64 finalizer (public-domain constant set)."""
+    x = jnp.asarray(x).astype(jnp.int64).view(jnp.uint64)
+    x = x ^ (x >> 30)
+    x = x * _M1
+    x = x ^ (x >> 27)
+    x = x * _M2
+    x = x ^ (x >> 31)
+    return x.view(jnp.int64)
+
+
+def _to_i64_lanes(col):
+    """Reinterpret a column's device data as int64 lanes for hashing."""
+    d = jnp.asarray(col)
+    if d.dtype == jnp.bool_:
+        return d.astype(jnp.int64)
+    if d.dtype in (jnp.float32, jnp.float64):
+        # normalize -0.0 == 0.0 so they hash equal
+        d = jnp.where(d == 0, jnp.zeros_like(d), d)
+        if d.dtype == jnp.float32:
+            return d.view(jnp.int32).astype(jnp.int64)
+        return d.view(jnp.int64)
+    return d.astype(jnp.int64)
+
+
+def hash_columns(cols, validities=None, seed: int = 42):
+    """Combined 64-bit hash over one or more key columns.
+
+    cols: list of device arrays (pre-mapped to eq-key domain for strings).
+    validities: optional list of bool arrays; a null key contributes a fixed
+    tag (so null == null for grouping, like the reference's grouping
+    semantics).
+    Returns int64[capacity].
+    """
+    h = None
+    for i, c in enumerate(cols):
+        lane = _to_i64_lanes(c)
+        k = mix64(lane)
+        if validities is not None and validities[i] is not None:
+            null_tag = mix64(jnp.int64(0x6E756C6C + i))
+            k = jnp.where(validities[i], k, null_tag)
+        if h is None:
+            h = k
+        else:
+            hu = h.view(jnp.uint64) * jnp.uint64(31) + k.view(jnp.uint64) + _GOLDEN
+            h = mix64(hu.view(jnp.int64))
+    if h is None:
+        raise ValueError("hash_columns needs at least one column")
+    return h
+
+
+def partition_ids(hashes, num_partitions: int):
+    """Non-negative modulo (reference: Partitioner.scala HashPartitioner pmod)."""
+    p = jnp.int64(num_partitions)
+    m = hashes % p
+    return jnp.where(m < 0, m + p, m).astype(jnp.int32)

@@ -1,0 +1,66 @@
+"""Exchange partitioning kernels.
+
+Role of the reference's ShuffleExchangeExec partition-key extraction
+(sqlx/exchange/ShuffleExchangeExec.scala:344 prepareShuffleDependency, :396
+getPartitionKeyExtractor) and Partitioner.scala (HashPartitioner /
+RangePartitioner). On TPU the partition id is computed for a whole batch in
+one fused kernel; rows are then grouped by pid with `lax.sort` so the host
+(or an ICI all-to-all) can slice contiguous per-partition runs.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax.numpy as jnp
+from jax import lax
+
+from .hashing import hash_columns, partition_ids
+
+
+class PartitionedRows(NamedTuple):
+    perm: jnp.ndarray    # int32[cap]: row order grouped by pid (inactive last)
+    pids: jnp.ndarray    # int32[cap]: pid per sorted slot (invalid where inactive)
+    counts: jnp.ndarray  # int64[num_partitions]: live rows per partition
+
+
+def hash_partition(key_cols: Sequence[jnp.ndarray],
+                   key_valids: Sequence[jnp.ndarray | None],
+                   row_mask: jnp.ndarray,
+                   num_partitions: int) -> PartitionedRows:
+    h = hash_columns(key_cols, list(key_valids))
+    pids = partition_ids(h, num_partitions)
+    return _group_by_pid(pids, row_mask, num_partitions)
+
+
+def round_robin_partition(row_mask: jnp.ndarray, num_partitions: int,
+                          start: int = 0) -> PartitionedRows:
+    """Round-robin over live rows (reference: round-robin partitioning in
+    ShuffleExchangeExec)."""
+    cap = row_mask.shape[0]
+    live_rank = jnp.cumsum(row_mask.astype(jnp.int32)) - 1
+    pids = ((live_rank + start) % num_partitions).astype(jnp.int32)
+    return _group_by_pid(pids, row_mask, num_partitions)
+
+
+def range_partition(sort_keys: jnp.ndarray, bounds: jnp.ndarray,
+                    row_mask: jnp.ndarray, num_partitions: int,
+                    descending: bool = False) -> PartitionedRows:
+    """Range partitioning against sampled bounds (reference:
+    RangePartitioner's sampled bounds, core/Partitioner.scala:388). `bounds`
+    is int64/float64[num_partitions-1] ascending in the sort-key domain."""
+    pids = jnp.searchsorted(bounds, sort_keys, side="right").astype(jnp.int32)
+    if descending:
+        pids = (num_partitions - 1) - pids
+    return _group_by_pid(pids, row_mask, num_partitions)
+
+
+def _group_by_pid(pids: jnp.ndarray, row_mask: jnp.ndarray,
+                  num_partitions: int) -> PartitionedRows:
+    cap = row_mask.shape[0]
+    key = jnp.where(row_mask, pids, num_partitions)  # inactive last
+    skey, perm = lax.sort((key, lax.iota(jnp.int32, cap)), num_keys=1,
+                          is_stable=True)
+    counts = jnp.zeros(num_partitions + 1, dtype=jnp.int64).at[
+        jnp.minimum(skey, num_partitions)].add(1)
+    return PartitionedRows(perm, skey, counts[:num_partitions])

@@ -1,0 +1,410 @@
+"""SQL data types and schemas.
+
+Role of the reference's sql/api types (StructType/StructField/DataType; see
+SURVEY.md §2.3 "Row formats") re-designed for a columnar TPU engine: every
+type carries its *device representation* (a JAX dtype) plus host/Arrow
+mapping. Strings are dictionary-encoded (int32 codes on device); dates are
+int32 days since epoch; timestamps int64 microseconds; decimals are scaled
+int64 (XLA emulates int64 with int32 pairs on TPU).
+"""
+
+from __future__ import annotations
+
+import datetime
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = [
+    "DataType",
+    "NumericType",
+    "IntegralType",
+    "FractionalType",
+    "BooleanType",
+    "ByteType",
+    "ShortType",
+    "IntegerType",
+    "LongType",
+    "FloatType",
+    "DoubleType",
+    "StringType",
+    "DateType",
+    "TimestampType",
+    "DecimalType",
+    "NullType",
+    "BinaryType",
+    "StructField",
+    "StructType",
+    "ArrayType",
+    "boolean",
+    "int8",
+    "int16",
+    "int32",
+    "int64",
+    "float32",
+    "float64",
+    "string",
+    "date",
+    "timestamp",
+    "null_type",
+    "common_type",
+    "from_arrow_type",
+    "to_arrow_type",
+]
+
+
+@dataclass(frozen=True)
+class DataType:
+    """Base SQL type. Subclasses are singletons except DecimalType."""
+
+    def simple_string(self) -> str:
+        return type(self).__name__.replace("Type", "").lower()
+
+    # --- device representation ---------------------------------------
+    @property
+    def device_dtype(self) -> np.dtype:
+        """numpy/JAX dtype of the on-device representation."""
+        raise NotImplementedError
+
+    @property
+    def is_string_like(self) -> bool:
+        return False
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return self.simple_string()
+
+
+class NullType(DataType):
+    @property
+    def device_dtype(self) -> np.dtype:
+        return np.dtype(np.int32)
+
+
+class NumericType(DataType):
+    pass
+
+
+class IntegralType(NumericType):
+    pass
+
+
+class FractionalType(NumericType):
+    pass
+
+
+class BooleanType(DataType):
+    @property
+    def device_dtype(self) -> np.dtype:
+        return np.dtype(np.bool_)
+
+
+class ByteType(IntegralType):
+    @property
+    def device_dtype(self) -> np.dtype:
+        return np.dtype(np.int8)
+
+
+class ShortType(IntegralType):
+    @property
+    def device_dtype(self) -> np.dtype:
+        return np.dtype(np.int16)
+
+
+class IntegerType(IntegralType):
+    @property
+    def device_dtype(self) -> np.dtype:
+        return np.dtype(np.int32)
+
+
+class LongType(IntegralType):
+    @property
+    def device_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)
+
+
+class FloatType(FractionalType):
+    @property
+    def device_dtype(self) -> np.dtype:
+        return np.dtype(np.float32)
+
+
+class DoubleType(FractionalType):
+    @property
+    def device_dtype(self) -> np.dtype:
+        return np.dtype(np.float64)
+
+
+class StringType(DataType):
+    """Dictionary-encoded UTF-8 string: device = int32 codes into a host
+    dictionary (reference stores raw UTF8String bytes in UnsafeRow,
+    common/unsafe/.../UTF8String.java; on TPU we keep bytes host-side and
+    compute on codes/hashes — SURVEY.md §7 'Hard parts' (2))."""
+
+    @property
+    def device_dtype(self) -> np.dtype:
+        return np.dtype(np.int32)
+
+    @property
+    def is_string_like(self) -> bool:
+        return True
+
+
+class BinaryType(StringType):
+    """Binary blobs, dictionary-encoded like strings."""
+
+
+class DateType(DataType):
+    """Days since 1970-01-01 (matches Arrow date32)."""
+
+    @property
+    def device_dtype(self) -> np.dtype:
+        return np.dtype(np.int32)
+
+
+class TimestampType(DataType):
+    """Microseconds since epoch (matches Arrow timestamp[us])."""
+
+    @property
+    def device_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)
+
+
+@dataclass(frozen=True)
+class DecimalType(FractionalType):
+    """Fixed-point decimal stored as scaled int64 on device.
+
+    The reference implements Decimal over JVM BigDecimal/Long
+    (sql/api .../types/DecimalType.scala). TPUs have no int128; we cap
+    precision at 18 (int64-safe) and widen sums via int64 with overflow
+    checks host-side.
+    """
+
+    precision: int = 10
+    scale: int = 0
+
+    MAX_PRECISION = 18
+
+    def simple_string(self) -> str:
+        return f"decimal({self.precision},{self.scale})"
+
+    @property
+    def device_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)
+
+
+@dataclass(frozen=True)
+class ArrayType(DataType):
+    element_type: DataType = field(default_factory=lambda: IntegerType())
+
+    def simple_string(self) -> str:
+        return f"array<{self.element_type.simple_string()}>"
+
+    @property
+    def device_dtype(self) -> np.dtype:
+        return self.element_type.device_dtype
+
+
+# Singleton-ish instances
+boolean = BooleanType()
+int8 = ByteType()
+int16 = ShortType()
+int32 = IntegerType()
+int64 = LongType()
+float32 = FloatType()
+float64 = DoubleType()
+string = StringType()
+binary = BinaryType()
+date = DateType()
+timestamp = TimestampType()
+null_type = NullType()
+
+
+@dataclass(frozen=True)
+class StructField:
+    name: str
+    dataType: DataType
+    nullable: bool = True
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"{self.name}:{self.dataType.simple_string()}"
+
+
+@dataclass(frozen=True)
+class StructType(DataType):
+    fields: tuple[StructField, ...] = ()
+
+    def __init__(self, fields=()):
+        object.__setattr__(self, "fields", tuple(fields))
+
+    @property
+    def names(self) -> list[str]:
+        return [f.name for f in self.fields]
+
+    def add(self, name: str, dataType: DataType, nullable: bool = True) -> "StructType":
+        return StructType(self.fields + (StructField(name, dataType, nullable),))
+
+    def __getitem__(self, name: str) -> StructField:
+        for f in self.fields:
+            if f.name == name:
+                return f
+        raise KeyError(name)
+
+    def __len__(self) -> int:
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+    def simple_string(self) -> str:
+        inner = ",".join(f"{f.name}:{f.dataType.simple_string()}" for f in self.fields)
+        return f"struct<{inner}>"
+
+
+# ---------------------------------------------------------------------------
+# Type coercion lattice (reference: sqlcat/analysis/TypeCoercion.scala)
+# ---------------------------------------------------------------------------
+
+_NUMERIC_ORDER: list[DataType] = [int8, int16, int32, int64, float32, float64]
+
+
+def _numeric_rank(dt: DataType) -> int:
+    if isinstance(dt, DecimalType):
+        return _NUMERIC_ORDER.index(int64)  # decimals widen like long
+    for i, t in enumerate(_NUMERIC_ORDER):
+        if type(dt) is type(t):
+            return i
+    return -1
+
+
+def common_type(a: DataType, b: DataType) -> DataType | None:
+    """Tightest common type both sides can be cast to, or None."""
+    if a == b:
+        return a
+    if isinstance(a, NullType):
+        return b
+    if isinstance(b, NullType):
+        return a
+    if isinstance(a, DecimalType) and isinstance(b, DecimalType):
+        scale = max(a.scale, b.scale)
+        intd = max(a.precision - a.scale, b.precision - b.scale)
+        return DecimalType(min(intd + scale, DecimalType.MAX_PRECISION), scale)
+    if isinstance(a, DecimalType) and isinstance(b, IntegralType):
+        return a
+    if isinstance(b, DecimalType) and isinstance(a, IntegralType):
+        return b
+    if isinstance(a, DecimalType) and isinstance(b, FractionalType):
+        return float64
+    if isinstance(b, DecimalType) and isinstance(a, FractionalType):
+        return float64
+    ra, rb = _numeric_rank(a), _numeric_rank(b)
+    if ra >= 0 and rb >= 0:
+        return _NUMERIC_ORDER[max(ra, rb)]
+    if isinstance(a, StringType) and isinstance(b, StringType):
+        return string
+    # date/timestamp promotion
+    if isinstance(a, DateType) and isinstance(b, TimestampType):
+        return timestamp
+    if isinstance(b, DateType) and isinstance(a, TimestampType):
+        return timestamp
+    # string <-> other: cast string side (Spark coerces string to the other type
+    # in BinaryComparison); we model as the other type
+    if isinstance(a, StringType):
+        return b
+    if isinstance(b, StringType):
+        return a
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Arrow mapping
+# ---------------------------------------------------------------------------
+
+def from_arrow_type(at) -> DataType:
+    import pyarrow as pa
+
+    if pa.types.is_boolean(at):
+        return boolean
+    if pa.types.is_int8(at):
+        return int8
+    if pa.types.is_int16(at):
+        return int16
+    if pa.types.is_int32(at):
+        return int32
+    if pa.types.is_int64(at):
+        return int64
+    if pa.types.is_float32(at):
+        return float32
+    if pa.types.is_float64(at):
+        return float64
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return string
+    if pa.types.is_binary(at) or pa.types.is_large_binary(at):
+        return binary
+    if pa.types.is_date32(at):
+        return date
+    if pa.types.is_timestamp(at):
+        return timestamp
+    if pa.types.is_decimal(at):
+        return DecimalType(min(at.precision, DecimalType.MAX_PRECISION), at.scale)
+    if pa.types.is_dictionary(at):
+        return from_arrow_type(at.value_type)
+    raise NotImplementedError(f"Arrow type not supported: {at}")
+
+
+def to_arrow_type(dt: DataType):
+    import pyarrow as pa
+
+    if isinstance(dt, BooleanType):
+        return pa.bool_()
+    if isinstance(dt, ByteType):
+        return pa.int8()
+    if isinstance(dt, ShortType):
+        return pa.int16()
+    if isinstance(dt, IntegerType):
+        return pa.int32()
+    if isinstance(dt, LongType):
+        return pa.int64()
+    if isinstance(dt, FloatType):
+        return pa.float32()
+    if isinstance(dt, DoubleType):
+        return pa.float64()
+    if isinstance(dt, BinaryType):
+        return pa.binary()
+    if isinstance(dt, StringType):
+        return pa.string()
+    if isinstance(dt, DateType):
+        return pa.date32()
+    if isinstance(dt, TimestampType):
+        return pa.timestamp("us")
+    if isinstance(dt, DecimalType):
+        return pa.decimal128(dt.precision, dt.scale)
+    if isinstance(dt, NullType):
+        return pa.null()
+    raise NotImplementedError(f"no arrow type for {dt}")
+
+
+def infer_type(value) -> DataType:
+    """Infer a DataType from a Python literal value."""
+    if value is None:
+        return null_type
+    if isinstance(value, bool):
+        return boolean
+    if isinstance(value, int):
+        return int32 if -(2**31) <= value < 2**31 else int64
+    if isinstance(value, float):
+        return float64
+    if isinstance(value, str):
+        return string
+    if isinstance(value, bytes):
+        return binary
+    if isinstance(value, datetime.datetime):
+        return timestamp
+    if isinstance(value, datetime.date):
+        return date
+    import decimal as _d
+
+    if isinstance(value, _d.Decimal):
+        sign, digits, exp = value.as_tuple()
+        scale = max(0, -exp)
+        return DecimalType(max(len(digits), scale), scale)
+    raise TypeError(f"cannot infer SQL type for {value!r}")

@@ -1,0 +1,1 @@
+from .logging import get_logger  # noqa: F401

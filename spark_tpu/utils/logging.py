@@ -1,0 +1,19 @@
+"""Structured logging (role of common/utils/.../internal/Logging.scala)."""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_CONFIGURED = False
+
+
+def get_logger(name: str) -> logging.Logger:
+    global _CONFIGURED
+    if not _CONFIGURED:
+        level = os.environ.get("SPARK_TPU_LOG", "WARNING").upper()
+        logging.basicConfig(
+            format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+            level=getattr(logging, level, logging.WARNING))
+        _CONFIGURED = True
+    return logging.getLogger(name)

@@ -1,0 +1,75 @@
+"""ctypes bindings to the native (C++) host runtime in native/.
+
+Role of the reference's [NATIVE-ROLE] Java off-heap layer
+(common/unsafe/.../Platform.java, Murmur3_x86_32.java, RadixSort.java):
+host-side hot loops — string hashing at dictionary build, radix partitioning
+for shuffle — implemented in C++ and loaded via ctypes. Every entry point has
+a pure-Python/numpy fallback; callers catch ImportError/OSError.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from functools import lru_cache
+
+import numpy as np
+
+_LIB_NAMES = ("libsparktpu_native.so",)
+
+
+@lru_cache(maxsize=1)
+def _load():
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    candidates = [os.path.join(here, "..", "native", "build", n) for n in _LIB_NAMES]
+    candidates += [os.path.join(here, "native", n) for n in _LIB_NAMES]
+    for c in candidates:
+        if os.path.exists(c):
+            lib = ctypes.CDLL(c)
+            lib.spark_tpu_hash_strings.restype = None
+            lib.spark_tpu_hash_strings.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+            lib.spark_tpu_radix_partition.restype = None
+            lib.spark_tpu_radix_partition.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+                ctypes.c_void_p, ctypes.c_void_p]
+            return lib
+    raise ImportError("native library not built")
+
+
+def available() -> bool:
+    try:
+        _load()
+        return True
+    except Exception:
+        return False
+
+
+def hash_strings(values: list[str]) -> np.ndarray:
+    """64-bit hashes for a list of strings via the C++ xxhash64 kernel."""
+    lib = _load()
+    blob = b"".join(v.encode("utf-8") for v in values)
+    offsets = np.zeros(len(values) + 1, dtype=np.int64)
+    np.cumsum([len(v.encode("utf-8")) for v in values], out=offsets[1:])
+    out = np.empty(len(values), dtype=np.int64)
+    buf = ctypes.create_string_buffer(blob, len(blob))
+    lib.spark_tpu_hash_strings(
+        buf, offsets.ctypes.data_as(ctypes.c_void_p), len(values),
+        out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def radix_partition(pids: np.ndarray, num_partitions: int):
+    """Counting-sort row indices by partition id.
+
+    Returns (order int64[n] — row indices grouped by pid, counts int64[p]).
+    Python fallback: np.argsort."""
+    lib = _load()
+    pids = np.ascontiguousarray(pids, dtype=np.int32)
+    order = np.empty(len(pids), dtype=np.int64)
+    counts = np.zeros(num_partitions, dtype=np.int64)
+    lib.spark_tpu_radix_partition(
+        pids.ctypes.data_as(ctypes.c_void_p), len(pids), num_partitions,
+        order.ctypes.data_as(ctypes.c_void_p),
+        counts.ctypes.data_as(ctypes.c_void_p))
+    return order, counts
