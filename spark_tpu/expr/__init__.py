@@ -1,0 +1,2 @@
+from .expressions import *  # noqa: F401,F403
+from .eval import Val, HostCtx, TraceCtx, EvalCtx  # noqa: F401

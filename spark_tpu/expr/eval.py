@@ -1,0 +1,162 @@
+"""Dual-mode expression evaluation contexts.
+
+Role of the reference's expression codegen (sqlcat/expressions/codegen/
+CodeGenerator.scala — every Expression has interpreted `eval` + `doGenCode`).
+TPU re-design: every Expression has ONE `eval(ctx)` implementation that runs
+in two modes over the same traversal:
+
+  * HOST mode (per batch, before tracing): no row data. Computes result
+    *metadata* — dtype, string dictionary, validity presence — and registers
+    "aux arrays": per-dictionary lookup tables (value hashes, LIKE bitmaps,
+    parsed casts, transformed ranks) derived from the batch's dictionaries.
+    O(|dictionary|) host work, never O(rows).
+  * TRACE mode (once per kernel-cache key, inside jax.jit): row data flows as
+    traced arrays; aux arrays arrive as function arguments in registration
+    order; XLA fuses the whole operator pipeline (the WholeStageCodegen
+    analog, sqlx/WholeStageCodegenExec.scala:47).
+
+Aux arrays are padded to power-of-two buckets so kernels are reused across
+batches whose dictionaries differ only in content/size bucket.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+from ..columnar.batch import StringDict
+from ..types import DataType
+
+__all__ = ["Val", "EvalCtx", "HostCtx", "TraceCtx", "pad_pow2"]
+
+
+def pad_pow2(arr: np.ndarray, minimum: int = 8, fill=None) -> np.ndarray:
+    """Pad a 1-D lookup array to a power-of-two length (bucketed so kernel
+    signatures are stable across dictionary sizes)."""
+    n = max(len(arr), 1)
+    cap = minimum
+    while cap < n:
+        cap <<= 1
+    if len(arr) == cap:
+        return arr
+    if len(arr) == 0:
+        return np.zeros(cap, dtype=arr.dtype)
+    out = np.empty(cap, dtype=arr.dtype)
+    out[: len(arr)] = arr
+    out[len(arr):] = arr[-1] if fill is None else fill
+    return out
+
+
+@dataclass
+class Val:
+    """An evaluated expression value.
+
+    HOST mode:  data is None; validity is True (present) or None (absent);
+                sdict is the real StringDict when string-typed.
+    TRACE mode: data is a traced array (may be scalar for literals);
+                validity is a traced bool array or None; sdict is None.
+    """
+
+    dtype: DataType
+    data: Any
+    validity: Any
+    sdict: StringDict | None = None
+
+    @property
+    def has_validity(self) -> bool:
+        return self.validity is not None
+
+
+class EvalCtx:
+    """Shared machinery: memoized recursion + positional aux channel."""
+
+    is_trace: bool = False
+
+    def __init__(self) -> None:
+        self._memo: dict[int, Val] = {}
+
+    # --- recursion --------------------------------------------------------
+    def eval(self, expr) -> Val:
+        key = id(expr)
+        v = self._memo.get(key)
+        if v is None:
+            v = expr.eval(self)
+            self._memo[key] = v
+        return v
+
+    # --- aux channel ------------------------------------------------------
+    def aux(self, make: Callable[[], np.ndarray], minimum: int = 8, fill=None):
+        raise NotImplementedError
+
+    # --- validity helpers -------------------------------------------------
+    def and_valid(self, *vals: "Val"):
+        """Combined validity (NULL if any input NULL)."""
+        present = [v.validity for v in vals if v.validity is not None]
+        if not present:
+            return None
+        if not self.is_trace:
+            return True
+        out = present[0]
+        for p in present[1:]:
+            out = out & p
+        return out
+
+    def attribute(self, expr_id: int) -> Val:
+        raise NotImplementedError
+
+
+class HostCtx(EvalCtx):
+    """Per-batch metadata pass. `inputs` maps attribute expr_id → Val
+    (host-mode: dtype + validity presence + dictionary)."""
+
+    is_trace = False
+
+    def __init__(self, inputs: dict[int, Val]):
+        super().__init__()
+        self.inputs = inputs
+        self.aux_arrays: list[np.ndarray] = []
+
+    def aux(self, make, minimum: int = 8, fill=None):
+        arr = pad_pow2(np.asarray(make()), minimum=minimum, fill=fill)
+        self.aux_arrays.append(arr)
+        return _HostAux(arr.shape, arr.dtype)
+
+    def attribute(self, expr_id: int) -> Val:
+        return self.inputs[expr_id]
+
+    def signature(self) -> tuple:
+        """Part of the kernel cache key: aux shapes/dtypes."""
+        return tuple((a.shape, str(a.dtype)) for a in self.aux_arrays)
+
+
+@dataclass(frozen=True)
+class _HostAux:
+    shape: tuple
+    dtype: Any
+
+
+class TraceCtx(EvalCtx):
+    """Tracing pass (inside jax.jit). `inputs` maps attribute expr_id → Val
+    with traced arrays; `aux_args` is the flat list of traced aux arrays in
+    registration order."""
+
+    is_trace = True
+
+    def __init__(self, inputs: dict[int, Val], aux_args: list, capacity: int,
+                 row_mask=None):
+        super().__init__()
+        self.inputs = inputs
+        self._aux_args = aux_args
+        self._aux_pos = 0
+        self.capacity = capacity
+        self.row_mask = row_mask
+
+    def aux(self, make, minimum: int = 8, fill=None):
+        a = self._aux_args[self._aux_pos]
+        self._aux_pos += 1
+        return a
+
+    def attribute(self, expr_id: int) -> Val:
+        return self.inputs[expr_id]

@@ -1,0 +1,1911 @@
+"""Expression tree.
+
+Role of the reference's ~700 expression classes (sqlcat/expressions/
+Expression.scala, Cast.scala, aggregate/interfaces.scala, conditionalExpressions,
+stringExpressions, datetimeExpressions...). Each expression here implements a
+single `eval(ctx)` that serves both the host metadata pass and the jit trace
+pass (see expr/eval.py) — the TPU analog of the reference's dual
+interpreted-eval/doGenCode contract.
+
+SQL three-valued logic is carried by optional validity masks; string
+computations ride dictionary lookup tables registered through the aux channel.
+"""
+
+from __future__ import annotations
+
+import datetime
+import math
+import re
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..errors import AnalysisException, TypeCheckError, UnsupportedOperationError
+from ..plan.tree import TreeNode, next_id
+from ..columnar.batch import StringDict, _hash_str
+from ..types import (
+    ArrayType, BooleanType, ByteType, DataType, DateType, DecimalType,
+    DoubleType, FloatType, FractionalType, IntegerType, IntegralType, LongType,
+    NullType, NumericType, ShortType, StringType, TimestampType,
+    boolean, common_type, date, float32, float64, infer_type, int8, int16,
+    int32, int64, null_type, string, timestamp,
+)
+from .eval import EvalCtx, Val
+
+__all__ = [
+    "Expression", "Literal", "AttributeReference", "UnresolvedAttribute",
+    "UnresolvedStar", "UnresolvedFunction", "Alias", "SortOrder",
+    "Add", "Subtract", "Multiply", "Divide", "Remainder", "UnaryMinus",
+    "Abs", "Pow", "Sqrt", "Exp", "Log", "Log10", "Floor", "Ceil", "Round",
+    "EqualTo", "EqualNullSafe", "NotEqualTo", "LessThan", "LessThanOrEqual",
+    "GreaterThan", "GreaterThanOrEqual", "And", "Or", "Not",
+    "IsNull", "IsNotNull", "IsNaN", "In", "Like", "RLike", "StartsWith",
+    "EndsWith", "Contains", "CaseWhen", "If", "Coalesce", "Cast", "NullIf",
+    "Greatest", "Least",
+    "Upper", "Lower", "Substring", "Length", "Trim", "LTrim", "RTrim",
+    "Concat", "StringReplace", "Lpad", "Rpad",
+    "Year", "Month", "DayOfMonth", "Quarter", "DayOfWeek", "DayOfYear",
+    "WeekOfYear", "DateAdd", "DateSub", "DateDiff", "TruncDate", "MakeDate",
+    "AggregateFunction", "Sum", "Count", "Min", "Max", "Average", "First",
+    "AnyValue", "StddevSamp", "StddevPop", "VarianceSamp", "VariancePop",
+    "CollectSet",
+]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# Base
+# ---------------------------------------------------------------------------
+
+class Expression(TreeNode):
+    @property
+    def dtype(self) -> DataType:
+        raise NotImplementedError(type(self).__name__)
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    @property
+    def resolved(self) -> bool:
+        return all(c.resolved for c in self.children)
+
+    @property
+    def foldable(self) -> bool:
+        return all(getattr(c, "foldable", False) for c in self.children) \
+            and bool(self.children)
+
+    def references(self) -> set[int]:
+        out: set[int] = set()
+        for n in self.iter_nodes():
+            if isinstance(n, AttributeReference):
+                out.add(n.expr_id)
+        return out
+
+    def eval(self, ctx: EvalCtx) -> Val:
+        raise NotImplementedError(type(self).__name__)
+
+    # helpers for DSL composition (api/column wraps these)
+    def sql_name(self) -> str:
+        return type(self).__name__.lower()
+
+
+# ---------------------------------------------------------------------------
+# Leaves & named expressions
+# ---------------------------------------------------------------------------
+
+class Literal(Expression):
+    child_fields = ()
+
+    def __init__(self, value: Any, dtype: DataType | None = None):
+        if isinstance(value, float) and math.isnan(value):
+            pass
+        self.value = value
+        self._dtype = dtype if dtype is not None else infer_type(value)
+        if isinstance(value, datetime.datetime):
+            epoch = datetime.datetime(1970, 1, 1, tzinfo=value.tzinfo)
+            self.value = int((value - epoch).total_seconds() * 1_000_000)
+        elif isinstance(value, datetime.date):
+            self.value = (value - datetime.date(1970, 1, 1)).days
+        else:
+            import decimal as _d
+
+            if isinstance(value, _d.Decimal):
+                dt = self._dtype
+                assert isinstance(dt, DecimalType)
+                self.value = int(value.scaleb(dt.scale).to_integral_value())
+
+    @property
+    def dtype(self) -> DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.value is None
+
+    @property
+    def resolved(self) -> bool:
+        return True
+
+    @property
+    def foldable(self) -> bool:
+        return True
+
+    def eval(self, ctx: EvalCtx) -> Val:
+        jnp = _jnp()
+        dt = self._dtype
+        if self.value is None:
+            if not ctx.is_trace:
+                return Val(dt, None, True,
+                           StringDict([""]) if isinstance(dt, StringType) else None)
+            z = jnp.zeros((), dtype=dt.device_dtype)
+            return Val(dt, z, jnp.zeros((), dtype=bool), None)
+        if isinstance(dt, StringType):
+            if not ctx.is_trace:
+                return Val(dt, None, None, StringDict([self.value]))
+            return Val(dt, jnp.zeros((), dtype=jnp.int32), None, None)
+        if not ctx.is_trace:
+            return Val(dt, None, None, None)
+        v = self.value
+        return Val(dt, jnp.asarray(v, dtype=dt.device_dtype), None, None)
+
+    def simple_string(self) -> str:
+        return f"lit({self.value!r})"
+
+
+class AttributeReference(Expression):
+    """A resolved column (reference: sqlcat/expressions/namedExpressions.scala
+    AttributeReference with exprId for self-join disambiguation)."""
+
+    child_fields = ()
+
+    def __init__(self, name: str, dtype: DataType, nullable: bool = True,
+                 expr_id: int | None = None, qualifier: tuple[str, ...] = ()):
+        self.name = name
+        self._dtype = dtype
+        self._nullable = nullable
+        self.expr_id = next_id() if expr_id is None else expr_id
+        self.qualifier = tuple(qualifier)
+
+    @property
+    def dtype(self) -> DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self._nullable
+
+    @property
+    def resolved(self) -> bool:
+        return True
+
+    @property
+    def foldable(self) -> bool:
+        return False
+
+    def with_nullability(self, nullable: bool) -> "AttributeReference":
+        return AttributeReference(self.name, self._dtype, nullable,
+                                  self.expr_id, self.qualifier)
+
+    def renamed(self, name: str) -> "AttributeReference":
+        return AttributeReference(name, self._dtype, self._nullable,
+                                  self.expr_id, self.qualifier)
+
+    def new_instance(self) -> "AttributeReference":
+        return AttributeReference(self.name, self._dtype, self._nullable,
+                                  None, self.qualifier)
+
+    def eval(self, ctx: EvalCtx) -> Val:
+        return ctx.attribute(self.expr_id)
+
+    def _data_args(self) -> tuple:
+        return (("expr_id", self.expr_id),)
+
+    def simple_string(self) -> str:
+        return f"{self.name}#{self.expr_id}"
+
+
+class UnresolvedAttribute(Expression):
+    child_fields = ()
+
+    def __init__(self, name_parts: Sequence[str]):
+        self.name_parts = tuple(name_parts)
+
+    @property
+    def name(self) -> str:
+        return ".".join(self.name_parts)
+
+    @property
+    def resolved(self) -> bool:
+        return False
+
+    @property
+    def foldable(self) -> bool:
+        return False
+
+    def simple_string(self) -> str:
+        return f"'{self.name}"
+
+
+class UnresolvedStar(Expression):
+    child_fields = ()
+
+    def __init__(self, target: Optional[str] = None):
+        self.target = target
+
+    @property
+    def resolved(self) -> bool:
+        return False
+
+
+class UnresolvedFunction(Expression):
+    child_fields = ("args",)
+
+    def __init__(self, name: str, args: Sequence[Expression],
+                 distinct: bool = False):
+        self.fname = name
+        self.args = list(args)
+        self.distinct = distinct
+
+    @property
+    def resolved(self) -> bool:
+        return False
+
+
+class Alias(Expression):
+    child_fields = ("child",)
+
+    def __init__(self, child: Expression, name: str, expr_id: int | None = None):
+        self.child = child
+        self.name = name
+        self.expr_id = next_id() if expr_id is None else expr_id
+
+    @property
+    def dtype(self) -> DataType:
+        return self.child.dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.child.nullable
+
+    def to_attribute(self) -> AttributeReference:
+        dt = self.child.dtype if self.child.resolved else null_type
+        return AttributeReference(self.name, dt, self.child.nullable,
+                                  self.expr_id)
+
+    def eval(self, ctx: EvalCtx) -> Val:
+        return ctx.eval(self.child)
+
+    def _data_args(self) -> tuple:
+        return (("name", self.name), ("expr_id", self.expr_id))
+
+    def simple_string(self) -> str:
+        return f"{self.child.simple_string()} AS {self.name}#{self.expr_id}"
+
+
+class SortOrder(Expression):
+    """Sort direction wrapper (reference: sqlcat/expressions/SortOrder.scala)."""
+
+    child_fields = ("child",)
+
+    def __init__(self, child: Expression, ascending: bool = True,
+                 nulls_first: bool | None = None):
+        self.child = child
+        self.ascending = ascending
+        self.nulls_first = nulls_first
+
+    @property
+    def dtype(self) -> DataType:
+        return self.child.dtype
+
+    def eval(self, ctx: EvalCtx) -> Val:
+        return ctx.eval(self.child)
+
+
+# ---------------------------------------------------------------------------
+# Cast
+# ---------------------------------------------------------------------------
+
+_TRUE_STRINGS = {"t", "true", "y", "yes", "1"}
+_FALSE_STRINGS = {"f", "false", "n", "no", "0"}
+
+
+def _parse_date(s: str) -> int | None:
+    s = s.strip()
+    try:
+        return (datetime.date.fromisoformat(s[:10]) - datetime.date(1970, 1, 1)).days
+    except ValueError:
+        return None
+
+
+def _parse_ts(s: str) -> int | None:
+    s = s.strip().replace("T", " ")
+    for fmt in ("%Y-%m-%d %H:%M:%S.%f", "%Y-%m-%d %H:%M:%S", "%Y-%m-%d"):
+        try:
+            d = datetime.datetime.strptime(s, fmt)
+            return int((d - datetime.datetime(1970, 1, 1)).total_seconds() * 1e6)
+        except ValueError:
+            continue
+    return None
+
+
+class Cast(Expression):
+    child_fields = ("child",)
+
+    def __init__(self, child: Expression, to: DataType, ansi: bool = False):
+        self.child = child
+        self.to = to
+        self.ansi = ansi
+
+    @property
+    def dtype(self) -> DataType:
+        return self.to
+
+    @property
+    def nullable(self) -> bool:
+        frm = self.child.dtype if self.child.resolved else null_type
+        if isinstance(frm, StringType) and not isinstance(self.to, StringType):
+            return True  # parse failures produce null
+        return self.child.nullable
+
+    def eval(self, ctx: EvalCtx) -> Val:
+        c = ctx.eval(self.child)
+        return cast_val(ctx, c, self.to)
+
+    def simple_string(self) -> str:
+        return f"cast({self.child.simple_string()} as {self.to.simple_string()})"
+
+
+def cast_val(ctx: EvalCtx, c: Val, to: DataType) -> Val:
+    jnp = _jnp()
+    frm = c.dtype
+    if type(frm) is type(to) and frm == to:
+        return c
+    if isinstance(frm, NullType):
+        if not ctx.is_trace:
+            return Val(to, None, True,
+                       StringDict([""]) if isinstance(to, StringType) else None)
+        z = jnp.zeros((), dtype=to.device_dtype)
+        return Val(to, z, jnp.zeros((), dtype=bool), None)
+
+    # ---- string source: parse the dictionary host-side --------------------
+    if isinstance(frm, StringType) and not isinstance(to, StringType):
+        def parse_arrays():
+            vals = c.sdict.values if c.sdict else [""]
+            out = np.zeros(max(len(vals), 1), dtype=to.device_dtype)
+            ok = np.zeros(max(len(vals), 1), dtype=bool)
+            for i, s in enumerate(vals):
+                p = _parse_str(s, to)
+                if p is not None:
+                    out[i] = p
+                    ok[i] = True
+            return out, ok
+
+        if not ctx.is_trace:
+            data_lut = ctx.aux(lambda: parse_arrays()[0])
+            ok_lut = ctx.aux(lambda: parse_arrays()[1])
+            return Val(to, None, True, None)
+        data_lut = ctx.aux(None)
+        ok_lut = ctx.aux(None)
+        codes = jnp.clip(c.data, 0, data_lut.shape[0] - 1)
+        data = jnp.take(data_lut, codes)
+        ok = jnp.take(ok_lut, codes)
+        v = ok if c.validity is None else (ok & c.validity)
+        return Val(to, data, v, None)
+
+    # ---- to string: only foldable/dictionary sources supported ------------
+    if isinstance(to, StringType):
+        raise UnsupportedOperationError(
+            f"cast({frm.simple_string()} as string) requires host "
+            "materialization (not yet supported on device)")
+
+    if not ctx.is_trace:
+        return Val(to, None, c.validity, None)
+
+    data = c.data
+    v = c.validity
+    # decimal handling
+    if isinstance(frm, DecimalType) and isinstance(to, DecimalType):
+        delta = to.scale - frm.scale
+        if delta >= 0:
+            data = data * (10 ** delta)
+        else:
+            f = 10 ** (-delta)
+            half = f // 2
+            data = jnp.where(data >= 0, (data + half) // f, -((-data + half) // f))
+        return Val(to, data, v, None)
+    if isinstance(frm, DecimalType):
+        scaled = data.astype(jnp.float64) / (10.0 ** frm.scale)
+        return cast_val(ctx, Val(float64, scaled, v, None), to)
+    if isinstance(to, DecimalType):
+        if jnp.issubdtype(data.dtype, jnp.integer) or data.dtype == jnp.bool_:
+            d = data.astype(jnp.int64) * (10 ** to.scale)
+        else:
+            d = jnp.rint(data.astype(jnp.float64) * (10.0 ** to.scale)).astype(jnp.int64)
+        return Val(to, d, v, None)
+    # date/timestamp
+    if isinstance(frm, DateType) and isinstance(to, TimestampType):
+        return Val(to, data.astype(jnp.int64) * 86_400_000_000, v, None)
+    if isinstance(frm, TimestampType) and isinstance(to, DateType):
+        return Val(to, jnp.floor_divide(data, 86_400_000_000).astype(jnp.int32), v, None)
+    if isinstance(frm, (DateType, TimestampType)) and isinstance(to, NumericType):
+        return Val(to, data.astype(to.device_dtype), v, None)
+    # bool
+    if isinstance(to, BooleanType):
+        return Val(to, data != 0, v, None)
+    if isinstance(frm, BooleanType):
+        return Val(to, data.astype(to.device_dtype), v, None)
+    # float -> int truncates toward zero
+    if isinstance(frm, FractionalType) and isinstance(to, IntegralType):
+        t = jnp.nan_to_num(jnp.trunc(data), nan=0.0, posinf=0.0, neginf=0.0)
+        return Val(to, t.astype(to.device_dtype), v, None)
+    return Val(to, data.astype(to.device_dtype), v, None)
+
+
+def _parse_str(s: str, to: DataType):
+    s = s.strip()
+    try:
+        if isinstance(to, BooleanType):
+            ls = s.lower()
+            if ls in _TRUE_STRINGS:
+                return True
+            if ls in _FALSE_STRINGS:
+                return False
+            return None
+        if isinstance(to, IntegralType):
+            return int(float(s)) if ("." in s or "e" in s.lower()) else int(s)
+        if isinstance(to, DecimalType):
+            import decimal as _d
+
+            return int(_d.Decimal(s).scaleb(to.scale).to_integral_value(
+                rounding=_d.ROUND_HALF_UP))
+        if isinstance(to, FractionalType):
+            return float(s)
+        if isinstance(to, DateType):
+            return _parse_date(s)
+        if isinstance(to, TimestampType):
+            return _parse_ts(s)
+    except (ValueError, ArithmeticError):
+        return None
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Arithmetic
+# ---------------------------------------------------------------------------
+
+class BinaryExpression(Expression):
+    child_fields = ("left", "right")
+    symbol = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.left = left
+        self.right = right
+
+    def simple_string(self) -> str:
+        return (f"({self.left.simple_string()} {self.symbol} "
+                f"{self.right.simple_string()})")
+
+
+class BinaryArithmetic(BinaryExpression):
+    @property
+    def dtype(self) -> DataType:
+        lt, rt = self.left.dtype, self.right.dtype
+        ct = common_type(lt, rt)
+        if ct is None or not isinstance(ct, (NumericType,)):
+            if isinstance(lt, (DateType,)) or isinstance(rt, (DateType,)):
+                return self._date_result(lt, rt)
+            raise TypeCheckError(
+                f"{type(self).__name__} needs numeric operands, got "
+                f"{lt.simple_string()}, {rt.simple_string()}")
+        return self._result_type(ct)
+
+    def _date_result(self, lt, rt) -> DataType:
+        raise TypeCheckError(f"cannot apply {self.symbol} to dates")
+
+    def _result_type(self, ct: DataType) -> DataType:
+        return ct
+
+    def eval(self, ctx: EvalCtx) -> Val:
+        l = ctx.eval(self.left)
+        r = ctx.eval(self.right)
+        v = ctx.and_valid(l, r)
+        out = self.dtype
+        if not ctx.is_trace:
+            return Val(out, None, v, None)
+        jnp = _jnp()
+        ld, rd = self._align(ctx, l, r, out)
+        data, extra_null = self._op(ld, rd)
+        if extra_null is not None:
+            v = extra_null if v is None else (v & extra_null)
+        return Val(out, data, v, None)
+
+    def _align(self, ctx, l: Val, r: Val, out: DataType):
+        jnp = _jnp()
+        if isinstance(out, DecimalType):
+            lc = cast_val(ctx, l, out) if not isinstance(l.dtype, DecimalType) else l
+            rc = cast_val(ctx, r, out) if not isinstance(r.dtype, DecimalType) else r
+            return lc.data, rc.data
+        dd = out.device_dtype
+        return l.data.astype(dd), r.data.astype(dd)
+
+    def _op(self, l, r):
+        raise NotImplementedError
+
+
+class Add(BinaryArithmetic):
+    symbol = "+"
+
+    def _date_result(self, lt, rt):
+        if isinstance(lt, DateType) and isinstance(rt, IntegralType):
+            return date
+        if isinstance(rt, DateType) and isinstance(lt, IntegralType):
+            return date
+        raise TypeCheckError("date + non-int")
+
+    def _result_type(self, ct):
+        if isinstance(ct, DecimalType):
+            return DecimalType(min(ct.precision + 1, DecimalType.MAX_PRECISION),
+                               ct.scale)
+        return ct
+
+    def eval(self, ctx):
+        lt = self.left.dtype if self.left.resolved else null_type
+        rt = self.right.dtype if self.right.resolved else null_type
+        if isinstance(lt, DateType) or isinstance(rt, DateType):
+            l, r = ctx.eval(self.left), ctx.eval(self.right)
+            v = ctx.and_valid(l, r)
+            if not ctx.is_trace:
+                return Val(date, None, v, None)
+            jnp = _jnp()
+            if isinstance(lt, DateType):
+                return Val(date, l.data + r.data.astype(jnp.int32), v, None)
+            return Val(date, r.data + l.data.astype(jnp.int32), v, None)
+        return super().eval(ctx)
+
+    def _op(self, l, r):
+        return l + r, None
+
+
+class Subtract(BinaryArithmetic):
+    symbol = "-"
+
+    def _date_result(self, lt, rt):
+        if isinstance(lt, DateType) and isinstance(rt, DateType):
+            return int32
+        if isinstance(lt, DateType) and isinstance(rt, IntegralType):
+            return date
+        raise TypeCheckError("unsupported date subtraction")
+
+    def _result_type(self, ct):
+        if isinstance(ct, DecimalType):
+            return DecimalType(min(ct.precision + 1, DecimalType.MAX_PRECISION),
+                               ct.scale)
+        return ct
+
+    def eval(self, ctx):
+        lt = self.left.dtype if self.left.resolved else null_type
+        rt = self.right.dtype if self.right.resolved else null_type
+        if isinstance(lt, DateType):
+            l, r = ctx.eval(self.left), ctx.eval(self.right)
+            v = ctx.and_valid(l, r)
+            out = self._date_result(lt, rt)
+            if not ctx.is_trace:
+                return Val(out, None, v, None)
+            jnp = _jnp()
+            return Val(out, (l.data - r.data).astype(jnp.int32), v, None)
+        return super().eval(ctx)
+
+    def _op(self, l, r):
+        return l - r, None
+
+
+class Multiply(BinaryArithmetic):
+    symbol = "*"
+
+    def _result_type(self, ct):
+        if isinstance(ct, DecimalType):
+            # decimal*decimal exceeds int64 quickly; compute in float64
+            return float64
+        return ct
+
+    def _align(self, ctx, l, r, out):
+        if isinstance(out, FractionalType) and (
+                isinstance(l.dtype, DecimalType) or isinstance(r.dtype, DecimalType)):
+            lc = cast_val(ctx, l, float64)
+            rc = cast_val(ctx, r, float64)
+            return lc.data, rc.data
+        return super()._align(ctx, l, r, out)
+
+    def _op(self, l, r):
+        return l * r, None
+
+
+class Divide(BinaryArithmetic):
+    symbol = "/"
+
+    def _result_type(self, ct):
+        return float64
+
+    def _align(self, ctx, l, r, out):
+        return (cast_val(ctx, l, float64).data, cast_val(ctx, r, float64).data)
+
+    def _op(self, l, r):
+        jnp = _jnp()
+        zero = r == 0
+        safe = jnp.where(zero, _jnp().ones_like(r), r)
+        return l / safe, ~zero  # x/0 => NULL (non-ANSI Spark semantics)
+
+
+class Remainder(BinaryArithmetic):
+    symbol = "%"
+
+    def _op(self, l, r):
+        jnp = _jnp()
+        zero = r == 0
+        safe = jnp.where(zero, jnp.ones_like(r), r)
+        # Spark % keeps the sign of the dividend (like Java), numpy keeps divisor's
+        if jnp.issubdtype(l.dtype, jnp.floating):
+            m = l - jnp.trunc(l / safe) * safe
+        else:
+            m = l - jnp.sign(l) * (jnp.abs(l) // jnp.abs(safe)) * jnp.abs(safe)
+        return m, ~zero
+
+
+class UnaryExpression(Expression):
+    child_fields = ("child",)
+
+    def __init__(self, child: Expression):
+        self.child = child
+
+    def simple_string(self) -> str:
+        return f"{self.sql_name()}({self.child.simple_string()})"
+
+
+class UnaryMinus(UnaryExpression):
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        if not ctx.is_trace:
+            return Val(self.dtype, None, c.validity, None)
+        return Val(self.dtype, -c.data, c.validity, None)
+
+
+class Abs(UnaryExpression):
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        if not ctx.is_trace:
+            return Val(self.dtype, None, c.validity, None)
+        return Val(self.dtype, _jnp().abs(c.data), c.validity, None)
+
+
+class _MathUnary(UnaryExpression):
+    fn = None
+    domain_check = None  # optional lambda returning ok-mask
+
+    @property
+    def dtype(self):
+        return float64
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        if not ctx.is_trace:
+            return Val(float64, None, True if (self.domain_check or c.has_validity) else None, None)
+        jnp = _jnp()
+        x = cast_val(ctx, c, float64).data
+        v = c.validity
+        if self.domain_check is not None:
+            ok = self.domain_check(x)
+            x = jnp.where(ok, x, jnp.ones_like(x))
+            v = ok if v is None else (v & ok)
+        data = self.fn(x)
+        return Val(float64, data, v, None)
+
+
+class Sqrt(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().sqrt(x))
+    domain_check = staticmethod(lambda x: x >= 0)
+
+
+class Exp(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().exp(x))
+
+
+class Log(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().log(x))
+    domain_check = staticmethod(lambda x: x > 0)
+
+
+class Log10(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().log10(x))
+    domain_check = staticmethod(lambda x: x > 0)
+
+
+class Floor(UnaryExpression):
+    @property
+    def dtype(self):
+        ct = self.child.dtype
+        return ct if isinstance(ct, (IntegralType, DecimalType)) else int64
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        if isinstance(c.dtype, (IntegralType,)):
+            return c
+        if not ctx.is_trace:
+            return Val(self.dtype, None, c.validity, None)
+        jnp = _jnp()
+        if isinstance(c.dtype, DecimalType):
+            f = 10 ** c.dtype.scale
+            d = jnp.where(c.data >= 0, c.data // f, -((-c.data + f - 1) // f)) * f
+            return Val(c.dtype, d, c.validity, None)
+        return Val(int64, jnp.floor(c.data).astype(jnp.int64), c.validity, None)
+
+
+class Ceil(UnaryExpression):
+    @property
+    def dtype(self):
+        ct = self.child.dtype
+        return ct if isinstance(ct, (IntegralType, DecimalType)) else int64
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        if isinstance(c.dtype, (IntegralType,)):
+            return c
+        if not ctx.is_trace:
+            return Val(self.dtype, None, c.validity, None)
+        jnp = _jnp()
+        if isinstance(c.dtype, DecimalType):
+            f = 10 ** c.dtype.scale
+            d = jnp.where(c.data >= 0, (c.data + f - 1) // f, -((-c.data) // f)) * f
+            return Val(c.dtype, d, c.validity, None)
+        return Val(int64, jnp.ceil(c.data).astype(jnp.int64), c.validity, None)
+
+
+class Round(Expression):
+    child_fields = ("child", "scale_expr")
+
+    def __init__(self, child: Expression, scale_expr: Expression | None = None):
+        self.child = child
+        self.scale_expr = scale_expr if scale_expr is not None else Literal(0)
+
+    @property
+    def dtype(self):
+        ct = self.child.dtype
+        return ct if isinstance(ct, (IntegralType, DecimalType)) else float64
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        if not isinstance(self.scale_expr, Literal):
+            raise UnsupportedOperationError("round() scale must be a literal")
+        s = int(self.scale_expr.value or 0)
+        if not ctx.is_trace:
+            return Val(self.dtype, None, c.validity, None)
+        jnp = _jnp()
+        if isinstance(c.dtype, DecimalType):
+            delta = c.dtype.scale - s
+            if delta <= 0:
+                return c
+            f = 10 ** delta
+            half = f // 2
+            d = jnp.where(c.data >= 0, (c.data + half) // f, -((-c.data + half) // f)) * f
+            return Val(c.dtype, d, c.validity, None)
+        if isinstance(c.dtype, IntegralType):
+            return c
+        x = cast_val(ctx, c, float64).data
+        f = 10.0 ** s
+        # HALF_UP like Spark (not banker's rounding)
+        d = jnp.trunc(x * f + jnp.where(x >= 0, 0.5, -0.5)) / f
+        return Val(float64, d, c.validity, None)
+
+
+class Pow(BinaryArithmetic):
+    symbol = "^"
+
+    def _result_type(self, ct):
+        return float64
+
+    def _align(self, ctx, l, r, out):
+        return (cast_val(ctx, l, float64).data, cast_val(ctx, r, float64).data)
+
+    def _op(self, l, r):
+        return l ** r, None
+
+
+# ---------------------------------------------------------------------------
+# Comparisons (string-aware)
+# ---------------------------------------------------------------------------
+
+def _string_eq_domain(ctx: EvalCtx, v: Val):
+    """Map a string Val's codes to 64-bit value hashes via an aux lut."""
+    jnp = _jnp()
+    if not ctx.is_trace:
+        lut = ctx.aux(lambda: (v.sdict.hashes if v.sdict and len(v.sdict)
+                               else np.zeros(1, np.int64)))
+        return None
+    lut = ctx.aux(None)
+    codes = jnp.clip(v.data, 0, lut.shape[0] - 1)
+    return jnp.take(lut, codes)
+
+
+def _string_rank_domain(ctx: EvalCtx, l: Val, r: Val):
+    """Map two string Vals into a common ordering domain (merged-dict ranks)."""
+    jnp = _jnp()
+
+    def make_luts():
+        a = l.sdict or StringDict([""])
+        b = r.sdict or StringDict([""])
+        allv = sorted(set(a.values) | set(b.values))
+        pos = {v: i for i, v in enumerate(allv)}
+        la = np.array([pos[v] for v in a.values] or [0], dtype=np.int64)
+        lb = np.array([pos[v] for v in b.values] or [0], dtype=np.int64)
+        return la, lb
+
+    if not ctx.is_trace:
+        ctx.aux(lambda: make_luts()[0])
+        ctx.aux(lambda: make_luts()[1])
+        return None, None
+    la = ctx.aux(None)
+    lb = ctx.aux(None)
+    ld = jnp.take(la, jnp.clip(l.data, 0, la.shape[0] - 1))
+    rd = jnp.take(lb, jnp.clip(r.data, 0, lb.shape[0] - 1))
+    return ld, rd
+
+
+class BinaryComparison(BinaryExpression):
+    @property
+    def dtype(self):
+        return boolean
+
+    def eval(self, ctx):
+        l = ctx.eval(self.left)
+        r = ctx.eval(self.right)
+        v = ctx.and_valid(l, r)
+        lt, rt = l.dtype, r.dtype
+        is_string = isinstance(lt, StringType) and isinstance(rt, StringType)
+        if is_string:
+            if type(self) in (EqualTo, NotEqualTo, EqualNullSafe):
+                ld = _string_eq_domain(ctx, l)
+                rd = _string_eq_domain(ctx, r)
+            else:
+                ld, rd = _string_rank_domain(ctx, l, r)
+            if not ctx.is_trace:
+                return Val(boolean, None, v, None)
+        else:
+            if not ctx.is_trace:
+                return Val(boolean, None, v, None)
+            ct = common_type(lt, rt) or lt
+            ld = cast_val(ctx, l, ct).data
+            rd = cast_val(ctx, r, ct).data
+        return Val(boolean, self._cmp(ld, rd), v, None)
+
+    def _cmp(self, l, r):
+        raise NotImplementedError
+
+
+class EqualTo(BinaryComparison):
+    symbol = "="
+
+    def _cmp(self, l, r):
+        return l == r
+
+
+class NotEqualTo(BinaryComparison):
+    symbol = "!="
+
+    def _cmp(self, l, r):
+        return l != r
+
+
+class EqualNullSafe(BinaryComparison):
+    symbol = "<=>"
+
+    def eval(self, ctx):
+        l = ctx.eval(self.left)
+        r = ctx.eval(self.right)
+        is_string = isinstance(l.dtype, StringType) and isinstance(r.dtype, StringType)
+        if is_string:
+            ld = _string_eq_domain(ctx, l)
+            rd = _string_eq_domain(ctx, r)
+        if not ctx.is_trace:
+            return Val(boolean, None, None, None)
+        jnp = _jnp()
+        if not is_string:
+            ct = common_type(l.dtype, r.dtype) or l.dtype
+            ld = cast_val(ctx, l, ct).data
+            rd = cast_val(ctx, r, ct).data
+        eq = ld == rd
+        lv = l.validity if l.validity is not None else jnp.ones((), bool)
+        rv = r.validity if r.validity is not None else jnp.ones((), bool)
+        both_null = (~lv) & (~rv)
+        data = jnp.where(lv & rv, eq, both_null)
+        return Val(boolean, data, None, None)
+
+    @property
+    def nullable(self):
+        return False
+
+
+class LessThan(BinaryComparison):
+    symbol = "<"
+
+    def _cmp(self, l, r):
+        return l < r
+
+
+class LessThanOrEqual(BinaryComparison):
+    symbol = "<="
+
+    def _cmp(self, l, r):
+        return l <= r
+
+
+class GreaterThan(BinaryComparison):
+    symbol = ">"
+
+    def _cmp(self, l, r):
+        return l > r
+
+
+class GreaterThanOrEqual(BinaryComparison):
+    symbol = ">="
+
+    def _cmp(self, l, r):
+        return l >= r
+
+
+# ---------------------------------------------------------------------------
+# Boolean logic — Kleene three-valued
+# ---------------------------------------------------------------------------
+
+class And(BinaryExpression):
+    symbol = "AND"
+
+    @property
+    def dtype(self):
+        return boolean
+
+    def eval(self, ctx):
+        l = ctx.eval(self.left)
+        r = ctx.eval(self.right)
+        if not ctx.is_trace:
+            v = ctx.and_valid(l, r)
+            return Val(boolean, None, v, None)
+        jnp = _jnp()
+        lv, rv = l.validity, r.validity
+        ld, rd = l.data, r.data
+        if lv is None and rv is None:
+            return Val(boolean, ld & rd, None, None)
+        lvv = lv if lv is not None else jnp.ones((), bool)
+        rvv = rv if rv is not None else jnp.ones((), bool)
+        # Kleene AND: FALSE wins over NULL; result known iff both known or
+        # either side is a known FALSE
+        known = (lvv & rvv) | (lvv & ~ld) | (rvv & ~rd)
+        t_l = jnp.where(lvv, ld, False)
+        t_r = jnp.where(rvv, rd, False)
+        return Val(boolean, t_l & t_r, known, None)
+
+
+class Or(BinaryExpression):
+    symbol = "OR"
+
+    @property
+    def dtype(self):
+        return boolean
+
+    def eval(self, ctx):
+        l = ctx.eval(self.left)
+        r = ctx.eval(self.right)
+        if not ctx.is_trace:
+            v = ctx.and_valid(l, r)
+            return Val(boolean, None, v, None)
+        jnp = _jnp()
+        lv, rv = l.validity, r.validity
+        ld, rd = l.data, r.data
+        if lv is None and rv is None:
+            return Val(boolean, ld | rd, None, None)
+        lvv = lv if lv is not None else jnp.ones((), bool)
+        rvv = rv if rv is not None else jnp.ones((), bool)
+        known = (lvv & rvv) | (lvv & ld) | (rvv & rd)
+        t_l = jnp.where(lvv, ld, False)
+        t_r = jnp.where(rvv, rd, False)
+        return Val(boolean, t_l | t_r, known, None)
+
+
+class Not(UnaryExpression):
+    @property
+    def dtype(self):
+        return boolean
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        if not ctx.is_trace:
+            return Val(boolean, None, c.validity, None)
+        return Val(boolean, ~c.data, c.validity, None)
+
+
+# ---------------------------------------------------------------------------
+# Null predicates / conditionals
+# ---------------------------------------------------------------------------
+
+class IsNull(UnaryExpression):
+    @property
+    def dtype(self):
+        return boolean
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        if not ctx.is_trace:
+            return Val(boolean, None, None, None)
+        jnp = _jnp()
+        if c.validity is None:
+            return Val(boolean, jnp.zeros((), bool), None, None)
+        return Val(boolean, ~c.validity, None, None)
+
+
+class IsNotNull(UnaryExpression):
+    @property
+    def dtype(self):
+        return boolean
+
+    @property
+    def nullable(self):
+        return False
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        if not ctx.is_trace:
+            return Val(boolean, None, None, None)
+        jnp = _jnp()
+        if c.validity is None:
+            return Val(boolean, jnp.ones((), bool), None, None)
+        return Val(boolean, c.validity, None, None)
+
+
+class IsNaN(UnaryExpression):
+    @property
+    def dtype(self):
+        return boolean
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        if not ctx.is_trace:
+            return Val(boolean, None, c.validity, None)
+        jnp = _jnp()
+        if jnp.issubdtype(c.data.dtype, jnp.floating):
+            return Val(boolean, jnp.isnan(c.data), c.validity, None)
+        return Val(boolean, jnp.zeros((), bool), c.validity, None)
+
+
+class If(Expression):
+    child_fields = ("pred", "then", "otherwise")
+
+    def __init__(self, pred, then, otherwise):
+        self.pred = pred
+        self.then = then
+        self.otherwise = otherwise
+
+    @property
+    def dtype(self):
+        return common_type(self.then.dtype, self.otherwise.dtype) or self.then.dtype
+
+    def eval(self, ctx):
+        return CaseWhen([(self.pred, self.then)], self.otherwise).eval(ctx)
+
+
+class CaseWhen(Expression):
+    child_fields = ("branch_exprs", "else_expr")
+
+    def __init__(self, branches: Sequence[tuple[Expression, Expression]],
+                 else_expr: Expression | None = None):
+        self.branches = [(p, v) for p, v in branches]
+        self.branch_exprs = [e for pv in self.branches for e in pv]
+        self.else_expr = else_expr if else_expr is not None else Literal(None)
+
+    def copy(self, **overrides):
+        if "branch_exprs" in overrides:
+            be = overrides.pop("branch_exprs")
+            overrides["branches"] = [(be[i], be[i + 1]) for i in range(0, len(be), 2)]
+            new = object.__new__(type(self))
+            new.__dict__.update(self.__dict__)
+            new.__dict__.update(overrides)
+            new.__dict__["branch_exprs"] = list(be)
+            new.__dict__.pop("_hash", None)
+            return new
+        return super().copy(**overrides)
+
+    @property
+    def dtype(self):
+        dt: DataType = null_type
+        for _, v in self.branches:
+            dt = common_type(dt, v.dtype) or v.dtype
+        dt = common_type(dt, self.else_expr.dtype) or dt
+        return dt
+
+    def eval(self, ctx):
+        out = self.dtype
+        jnp = _jnp()
+        if isinstance(out, StringType):
+            return self._eval_string(ctx)
+        vals = [(ctx.eval(p), ctx.eval(cast_if(v, out))) for p, v in
+                [(p, v) for p, v in self.branches]]
+        ev = ctx.eval(cast_if(self.else_expr, out))
+        if not ctx.is_trace:
+            anynull = any(v.has_validity for _, v in vals) or ev.has_validity or \
+                any(p.has_validity for p, _ in vals)
+            return Val(out, None, True if anynull else None, None)
+        data = jnp.broadcast_to(ev.data, (ctx.capacity,)) if ev.data.ndim == 0 else ev.data
+        valid = ev.validity if ev.validity is not None else jnp.ones((), bool)
+        valid = jnp.broadcast_to(valid, (ctx.capacity,))
+        data = jnp.broadcast_to(data, (ctx.capacity,))
+        decided = jnp.zeros((ctx.capacity,), bool)
+        # evaluate branches first-match-wins
+        for p, v in vals:
+            pd = p.data
+            if p.validity is not None:
+                pd = pd & p.validity
+            hit = jnp.broadcast_to(pd, (ctx.capacity,)) & ~decided
+            vd = jnp.broadcast_to(v.data, (ctx.capacity,))
+            vv = v.validity if v.validity is not None else jnp.ones((), bool)
+            vv = jnp.broadcast_to(vv, (ctx.capacity,))
+            data = jnp.where(hit, vd, data)
+            valid = jnp.where(hit, vv, valid)
+            decided = decided | hit
+        has_any_null = (ev.validity is not None) or \
+            any(v.validity is not None for _, v in vals)
+        return Val(out, data, valid if has_any_null else None, None)
+
+    def _eval_string(self, ctx):
+        """String CASE: merge branch dictionaries into one output dict."""
+        jnp = _jnp()
+        branch_vals = [(ctx.eval(p), ctx.eval(v)) for p, v in self.branches]
+        ev = ctx.eval(self.else_expr)
+        all_strs = branch_vals + [(None, ev)]
+
+        def merged_dict():
+            md: list[str] = []
+            idx: dict[str, int] = {}
+            luts = []
+            for _, v in all_strs:
+                sd = v.sdict or StringDict([""])
+                lut = np.zeros(max(len(sd), 1), np.int32)
+                for i, s in enumerate(sd.values or [""]):
+                    j = idx.get(s)
+                    if j is None:
+                        j = len(md)
+                        md.append(s)
+                        idx[s] = j
+                    lut[i] = j
+                luts.append(lut)
+            return StringDict(md or [""]), luts
+
+        if not ctx.is_trace:
+            sd, luts = merged_dict()
+            for lut in luts:
+                ctx.aux(lambda l=lut: l)
+            anynull = any(v.has_validity for _, v in all_strs) or \
+                any(p.has_validity for p, _ in branch_vals)
+            return Val(string, None, True if anynull else None, sd)
+        luts = [ctx.aux(None) for _ in all_strs]
+        elut = luts[-1]
+        data = jnp.take(elut, jnp.clip(jnp.broadcast_to(ev.data, (ctx.capacity,)),
+                                       0, elut.shape[0] - 1))
+        valid = ev.validity if ev.validity is not None else jnp.ones((), bool)
+        valid = jnp.broadcast_to(valid, (ctx.capacity,))
+        decided = jnp.zeros((ctx.capacity,), bool)
+        for (p, v), lut in zip(branch_vals, luts[:-1]):
+            pd = p.data
+            if p.validity is not None:
+                pd = pd & p.validity
+            hit = jnp.broadcast_to(pd, (ctx.capacity,)) & ~decided
+            vd = jnp.take(lut, jnp.clip(jnp.broadcast_to(v.data, (ctx.capacity,)),
+                                        0, lut.shape[0] - 1))
+            vv = v.validity if v.validity is not None else jnp.ones((), bool)
+            data = jnp.where(hit, vd, data)
+            valid = jnp.where(hit, jnp.broadcast_to(vv, (ctx.capacity,)), valid)
+            decided = decided | hit
+        has_any_null = any(v.validity is not None for _, v in all_strs)
+        return Val(string, data, valid if has_any_null else None, None)
+
+
+def cast_if(e: Expression, to: DataType) -> Expression:
+    if e.resolved and e.dtype == to:
+        return e
+    c = getattr(e, "_cast_cache", None)
+    if c is not None and c.to == to:
+        return c
+    c = Cast(e, to)
+    try:
+        e._cast_cache = c
+    except Exception:
+        pass
+    return c
+
+
+class Coalesce(Expression):
+    child_fields = ("args",)
+
+    def __init__(self, args: Sequence[Expression]):
+        self.args = list(args)
+
+    @property
+    def dtype(self):
+        dt: DataType = null_type
+        for a in self.args:
+            dt = common_type(dt, a.dtype) or a.dtype
+        return dt
+
+    @property
+    def nullable(self):
+        return all(a.nullable for a in self.args)
+
+    def eval(self, ctx):
+        # rewrite as CASE WHEN a IS NOT NULL THEN a ... for uniform handling
+        branches = [(IsNotNull(a), a) for a in self.args[:-1]]
+        return CaseWhen(branches, self.args[-1]).eval(ctx)
+
+
+class NullIf(BinaryExpression):
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+    def eval(self, ctx):
+        return CaseWhen([(EqualTo(self.left, self.right), Literal(None, self.left.dtype))],
+                        self.left).eval(ctx)
+
+
+class Greatest(Expression):
+    child_fields = ("args",)
+    _reduce = "maximum"
+
+    def __init__(self, args: Sequence[Expression]):
+        self.args = list(args)
+
+    @property
+    def dtype(self):
+        dt = self.args[0].dtype
+        for a in self.args[1:]:
+            dt = common_type(dt, a.dtype) or dt
+        return dt
+
+    def eval(self, ctx):
+        out = self.dtype
+        vals = [ctx.eval(cast_if(a, out)) for a in self.args]
+        v = ctx.and_valid(*vals)  # Spark: null only if ALL null; simplify: any-null→null? Spark Greatest skips nulls
+        if not ctx.is_trace:
+            return Val(out, None, True if any(x.has_validity for x in vals) else None, None)
+        jnp = _jnp()
+        fn = getattr(jnp, self._reduce)
+        ident = None
+        data = None
+        valid = None
+        for x in vals:
+            xv = x.validity if x.validity is not None else jnp.ones((), bool)
+            if data is None:
+                data = x.data
+                valid = jnp.broadcast_to(xv, jnp.shape(jnp.broadcast_to(x.data, (ctx.capacity,))))
+                data = jnp.broadcast_to(data, (ctx.capacity,))
+            else:
+                xd = jnp.broadcast_to(x.data, (ctx.capacity,))
+                xvv = jnp.broadcast_to(xv, (ctx.capacity,))
+                both = valid & xvv
+                data = jnp.where(both, fn(data, xd), jnp.where(xvv, xd, data))
+                valid = valid | xvv
+        has_null = any(x.validity is not None for x in vals)
+        return Val(out, data, valid if has_null else None, None)
+
+
+class Least(Greatest):
+    _reduce = "minimum"
+
+
+# ---------------------------------------------------------------------------
+# IN / LIKE / string predicates
+# ---------------------------------------------------------------------------
+
+class In(Expression):
+    child_fields = ("child", "items")
+
+    def __init__(self, child: Expression, items: Sequence[Expression]):
+        self.child = child
+        self.items = list(items)
+
+    @property
+    def dtype(self):
+        return boolean
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        jnp = _jnp()
+        if isinstance(c.dtype, StringType):
+            targets = []
+            for it in self.items:
+                if not isinstance(it, Literal):
+                    raise UnsupportedOperationError("IN over strings needs literals")
+                if it.value is not None:
+                    targets.append(it.value)
+
+            def make_lut():
+                sd = c.sdict or StringDict([""])
+                tset = set(targets)
+                return np.array([v in tset for v in (sd.values or [""])], bool)
+
+            if not ctx.is_trace:
+                ctx.aux(make_lut)
+                return Val(boolean, None, c.validity, None)
+            lut = ctx.aux(None)
+            data = jnp.take(lut, jnp.clip(c.data, 0, lut.shape[0] - 1))
+            return Val(boolean, data, c.validity, None)
+        vals = [ctx.eval(cast_if(i, c.dtype)) for i in self.items]
+        v = c.validity
+        if not ctx.is_trace:
+            return Val(boolean, None, v, None)
+        data = jnp.zeros((), bool)
+        for x in vals:
+            data = data | (c.data == x.data)
+        return Val(boolean, data, v, None)
+
+
+def _like_to_regex(pattern: str, escape: str = "\\") -> str:
+    out = []
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+        i += 1
+    return "^" + "".join(out) + "$"
+
+
+class _StringPredicate(Expression):
+    child_fields = ("child",)
+
+    def __init__(self, child: Expression, pattern: str):
+        self.child = child
+        self.pattern = pattern
+
+    @property
+    def dtype(self):
+        return boolean
+
+    def matcher(self):
+        raise NotImplementedError
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        jnp = _jnp()
+
+        def make_lut():
+            sd = c.sdict or StringDict([""])
+            m = self.matcher()
+            return np.array([bool(m(v)) for v in (sd.values or [""])], bool)
+
+        if not ctx.is_trace:
+            ctx.aux(make_lut)
+            return Val(boolean, None, c.validity, None)
+        lut = ctx.aux(None)
+        data = jnp.take(lut, jnp.clip(c.data, 0, lut.shape[0] - 1))
+        return Val(boolean, data, c.validity, None)
+
+
+class Like(_StringPredicate):
+    def matcher(self):
+        rx = re.compile(_like_to_regex(self.pattern), re.DOTALL)
+        return lambda s: rx.match(s) is not None
+
+
+class RLike(_StringPredicate):
+    def matcher(self):
+        rx = re.compile(self.pattern)
+        return lambda s: rx.search(s) is not None
+
+
+class StartsWith(_StringPredicate):
+    def matcher(self):
+        p = self.pattern
+        return lambda s: s.startswith(p)
+
+
+class EndsWith(_StringPredicate):
+    def matcher(self):
+        p = self.pattern
+        return lambda s: s.endswith(p)
+
+
+class Contains(_StringPredicate):
+    def matcher(self):
+        p = self.pattern
+        return lambda s: p in s
+
+
+# ---------------------------------------------------------------------------
+# String functions — dictionary transforms
+# ---------------------------------------------------------------------------
+
+class _DictTransform(Expression):
+    """String→string function applied to dictionary values host-side;
+    device codes pass through unchanged."""
+
+    child_fields = ("child",)
+
+    def __init__(self, child: Expression):
+        self.child = child
+
+    @property
+    def dtype(self):
+        return string
+
+    def transform(self, s: str) -> str:
+        raise NotImplementedError
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        if not ctx.is_trace:
+            sd = (c.sdict or StringDict([""])).map_values(self.transform)
+            return Val(string, None, c.validity, sd)
+        return Val(string, c.data, c.validity, None)
+
+
+class Upper(_DictTransform):
+    def transform(self, s):
+        return s.upper()
+
+
+class Lower(_DictTransform):
+    def transform(self, s):
+        return s.lower()
+
+
+class Trim(_DictTransform):
+    def transform(self, s):
+        return s.strip()
+
+
+class LTrim(_DictTransform):
+    def transform(self, s):
+        return s.lstrip()
+
+
+class RTrim(_DictTransform):
+    def transform(self, s):
+        return s.rstrip()
+
+
+class Substring(_DictTransform):
+    def __init__(self, child: Expression, pos: Expression, length: Expression | None = None):
+        super().__init__(child)
+        if not isinstance(pos, Literal) or (length is not None and not isinstance(length, Literal)):
+            raise UnsupportedOperationError("substring pos/len must be literals")
+        self.pos = int(pos.value)
+        self.length = None if length is None else int(length.value)
+
+    def transform(self, s):
+        # SQL 1-based; pos 0 treated as 1
+        p = self.pos
+        start = max(p - 1, 0) if p > 0 else max(len(s) + p, 0)
+        if self.length is None:
+            return s[start:]
+        return s[start:start + max(self.length, 0)]
+
+
+class StringReplace(_DictTransform):
+    def __init__(self, child: Expression, search: Expression, replace: Expression):
+        super().__init__(child)
+        if not isinstance(search, Literal) or not isinstance(replace, Literal):
+            raise UnsupportedOperationError("replace args must be literals")
+        self.search = str(search.value)
+        self.replace = str(replace.value)
+
+    def transform(self, s):
+        return s.replace(self.search, self.replace)
+
+
+class Lpad(_DictTransform):
+    def __init__(self, child, length: Expression, pad: Expression):
+        super().__init__(child)
+        self.length = int(length.value)
+        self.pad = str(pad.value)
+
+    def transform(self, s):
+        if len(s) >= self.length:
+            return s[: self.length]
+        need = self.length - len(s)
+        p = (self.pad * need)[:need]
+        return p + s
+
+
+class Rpad(Lpad):
+    def transform(self, s):
+        if len(s) >= self.length:
+            return s[: self.length]
+        need = self.length - len(s)
+        p = (self.pad * need)[:need]
+        return s + p
+
+
+class Concat(Expression):
+    """Concat where at most ONE argument is a non-literal string column (dict
+    transform); general column||column needs dictionary products (later)."""
+
+    child_fields = ("args",)
+
+    def __init__(self, args: Sequence[Expression]):
+        self.args = list(args)
+
+    @property
+    def dtype(self):
+        return string
+
+    def eval(self, ctx):
+        col_idx = [i for i, a in enumerate(self.args) if not isinstance(a, Literal)]
+        if len(col_idx) == 0:
+            s = "".join(str(a.value) for a in self.args)
+            return Literal(s).eval(ctx)
+        if len(col_idx) > 1:
+            raise UnsupportedOperationError(
+                "concat of multiple string columns not yet supported")
+        i = col_idx[0]
+        prefix = "".join(str(a.value) for a in self.args[:i])
+        suffix = "".join(str(a.value) for a in self.args[i + 1:])
+
+        class _C(_DictTransform):
+            def transform(self, s, _p=prefix, _s=suffix):
+                return _p + s + _s
+
+        return _C(self.args[i]).eval(ctx)
+
+
+class Length(UnaryExpression):
+    @property
+    def dtype(self):
+        return int32
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        jnp = _jnp()
+        if not isinstance(c.dtype, StringType):
+            raise TypeCheckError("length() needs a string")
+
+        def make_lut():
+            sd = c.sdict or StringDict([""])
+            return np.array([len(v) for v in (sd.values or [""])], np.int32)
+
+        if not ctx.is_trace:
+            ctx.aux(make_lut)
+            return Val(int32, None, c.validity, None)
+        lut = ctx.aux(None)
+        return Val(int32, jnp.take(lut, jnp.clip(c.data, 0, lut.shape[0] - 1)),
+                   c.validity, None)
+
+
+# ---------------------------------------------------------------------------
+# Date/time — civil-calendar integer math on device
+# ---------------------------------------------------------------------------
+
+def _civil_from_days(days):
+    """days-since-epoch → (year, month, day); Hinnant's algorithm in int32."""
+    jnp = _jnp()
+    z = days.astype(_jnp().int64) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = jnp.floor_divide(
+        doe - jnp.floor_divide(doe, 1460) + jnp.floor_divide(doe, 36524)
+        - jnp.floor_divide(doe, 146096), 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + jnp.floor_divide(yoe, 4) - jnp.floor_divide(yoe, 100))
+    mp = jnp.floor_divide(5 * doy + 2, 153)
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def _days_from_civil(y, m, d):
+    jnp = _jnp()
+    y = y.astype(jnp.int64) - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + jnp.floor_divide(yoe, 4) - jnp.floor_divide(yoe, 100) + doy
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+class _DatePart(UnaryExpression):
+    part = "year"
+
+    @property
+    def dtype(self):
+        return int32
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        if isinstance(c.dtype, TimestampType):
+            c = cast_val(ctx, c, date)
+        if not ctx.is_trace:
+            return Val(int32, None, c.validity, None)
+        jnp = _jnp()
+        y, m, d = _civil_from_days(c.data)
+        data = self._part(jnp, c.data, y, m, d)
+        return Val(int32, data, c.validity, None)
+
+    def _part(self, jnp, days, y, m, d):
+        raise NotImplementedError
+
+
+class Year(_DatePart):
+    def _part(self, jnp, days, y, m, d):
+        return y
+
+
+class Month(_DatePart):
+    def _part(self, jnp, days, y, m, d):
+        return m
+
+
+class DayOfMonth(_DatePart):
+    def _part(self, jnp, days, y, m, d):
+        return d
+
+
+class Quarter(_DatePart):
+    def _part(self, jnp, days, y, m, d):
+        return (m - 1) // 3 + 1
+
+
+class DayOfWeek(_DatePart):
+    """1 = Sunday … 7 = Saturday (Spark semantics)."""
+
+    def _part(self, jnp, days, y, m, d):
+        return ((days.astype(jnp.int64) + 4) % 7 + 1).astype(jnp.int32)
+
+
+class DayOfYear(_DatePart):
+    def _part(self, jnp, days, y, m, d):
+        jan1 = _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+        return (days - jan1 + 1).astype(jnp.int32)
+
+
+class WeekOfYear(_DatePart):
+    """ISO week number."""
+
+    def _part(self, jnp, days, y, m, d):
+        # ISO: week containing the year's first Thursday is week 1
+        dow = ((days.astype(jnp.int64) + 3) % 7)  # 0=Mon
+        thursday = days.astype(jnp.int64) - dow + 3
+        ty, _, _ = _civil_from_days(thursday)
+        jan1 = _days_from_civil(ty, jnp.ones_like(m), jnp.ones_like(d)).astype(jnp.int64)
+        return (jnp.floor_divide(thursday - jan1, 7) + 1).astype(jnp.int32)
+
+
+class TruncDate(UnaryExpression):
+    def __init__(self, child, fmt: str = "month"):
+        super().__init__(child)
+        self.fmt = fmt.lower()
+
+    @property
+    def dtype(self):
+        return date
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        if isinstance(c.dtype, TimestampType):
+            c = cast_val(ctx, c, date)
+        if not ctx.is_trace:
+            return Val(date, None, c.validity, None)
+        jnp = _jnp()
+        y, m, d = _civil_from_days(c.data)
+        one = jnp.ones_like(m)
+        if self.fmt in ("year", "yyyy", "yy"):
+            data = _days_from_civil(y, one, one)
+        elif self.fmt in ("quarter",):
+            qm = ((m - 1) // 3) * 3 + 1
+            data = _days_from_civil(y, qm, one)
+        elif self.fmt in ("month", "mon", "mm"):
+            data = _days_from_civil(y, m, one)
+        elif self.fmt in ("week",):
+            dow = ((c.data.astype(jnp.int64) + 3) % 7).astype(jnp.int32)  # 0=Mon
+            data = (c.data - dow).astype(jnp.int32)
+        else:
+            raise UnsupportedOperationError(f"trunc format {self.fmt}")
+        return Val(date, data, c.validity, None)
+
+
+class MakeDate(Expression):
+    child_fields = ("y", "m", "d")
+
+    def __init__(self, y, m, d):
+        self.y = y
+        self.m = m
+        self.d = d
+
+    @property
+    def dtype(self):
+        return date
+
+    def eval(self, ctx):
+        y = ctx.eval(cast_if(self.y, int32))
+        m = ctx.eval(cast_if(self.m, int32))
+        d = ctx.eval(cast_if(self.d, int32))
+        v = ctx.and_valid(y, m, d)
+        if not ctx.is_trace:
+            return Val(date, None, v, None)
+        return Val(date, _days_from_civil(y.data, m.data, d.data), v, None)
+
+
+class DateAdd(BinaryExpression):
+    @property
+    def dtype(self):
+        return date
+
+    def eval(self, ctx):
+        l = ctx.eval(self.left)
+        r = ctx.eval(cast_if(self.right, int32))
+        v = ctx.and_valid(l, r)
+        if not ctx.is_trace:
+            return Val(date, None, v, None)
+        return Val(date, l.data + r.data, v, None)
+
+
+class DateSub(BinaryExpression):
+    @property
+    def dtype(self):
+        return date
+
+    def eval(self, ctx):
+        l = ctx.eval(self.left)
+        r = ctx.eval(cast_if(self.right, int32))
+        v = ctx.and_valid(l, r)
+        if not ctx.is_trace:
+            return Val(date, None, v, None)
+        return Val(date, l.data - r.data, v, None)
+
+
+class DateDiff(BinaryExpression):
+    @property
+    def dtype(self):
+        return int32
+
+    def eval(self, ctx):
+        l = ctx.eval(cast_if(self.left, date))
+        r = ctx.eval(cast_if(self.right, date))
+        v = ctx.and_valid(l, r)
+        if not ctx.is_trace:
+            return Val(int32, None, v, None)
+        return Val(int32, (l.data - r.data).astype(_jnp().int32), v, None)
+
+
+# ---------------------------------------------------------------------------
+# Aggregate functions (evaluated by the aggregation operator, not eval())
+# ---------------------------------------------------------------------------
+
+class AggregateFunction(Expression):
+    child_fields = ("child",)
+
+    def __init__(self, child: Expression | None):
+        self.child = child
+
+    @property
+    def nullable(self):
+        return True
+
+    def eval(self, ctx):
+        raise AnalysisException(
+            f"aggregate function {type(self).__name__} cannot be evaluated "
+            "outside an aggregation")
+
+
+class Sum(AggregateFunction):
+    @property
+    def dtype(self):
+        ct = self.child.dtype
+        if isinstance(ct, DecimalType):
+            return DecimalType(DecimalType.MAX_PRECISION, ct.scale)
+        if isinstance(ct, IntegralType):
+            return int64
+        return float64
+
+
+class Count(AggregateFunction):
+    def __init__(self, child: Expression | None = None, distinct: bool = False):
+        super().__init__(child)
+        self.distinct = distinct
+
+    @property
+    def dtype(self):
+        return int64
+
+    @property
+    def nullable(self):
+        return False
+
+
+class Min(AggregateFunction):
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+class Max(AggregateFunction):
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+class Average(AggregateFunction):
+    @property
+    def dtype(self):
+        ct = self.child.dtype
+        if isinstance(ct, DecimalType):
+            return DecimalType(
+                min(ct.precision + 4, DecimalType.MAX_PRECISION),
+                min(ct.scale + 4, 10))
+        return float64
+
+
+class First(AggregateFunction):
+    def __init__(self, child: Expression, ignore_nulls: bool = True):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+class AnyValue(First):
+    pass
+
+
+class _CentralMoment(AggregateFunction):
+    ddof = 1
+
+    @property
+    def dtype(self):
+        return float64
+
+
+class StddevSamp(_CentralMoment):
+    ddof = 1
+
+
+class StddevPop(_CentralMoment):
+    ddof = 0
+
+
+class VarianceSamp(_CentralMoment):
+    ddof = 1
+
+
+class VariancePop(_CentralMoment):
+    ddof = 0
+
+
+class CollectSet(AggregateFunction):
+    @property
+    def dtype(self):
+        return ArrayType(self.child.dtype)

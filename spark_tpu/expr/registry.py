@@ -1,0 +1,133 @@
+"""Function registry: SQL/DataFrame function names → expression builders.
+
+Role of the reference's FunctionRegistry (sqlcat/analysis/FunctionRegistry.scala)."""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+from ..errors import AnalysisException
+from . import expressions as E
+
+Builder = Callable[..., E.Expression]
+
+
+def _lit_str(e: E.Expression) -> str:
+    if isinstance(e, E.Literal) and isinstance(e.value, str):
+        return e.value
+    raise AnalysisException("expected a string literal argument")
+
+
+_REGISTRY: dict[str, Builder] = {}
+
+
+def register(name: str, builder: Builder) -> None:
+    _REGISTRY[name.lower()] = builder
+
+
+def lookup(name: str) -> Builder | None:
+    return _REGISTRY.get(name.lower())
+
+
+def build_function(name: str, args: Sequence[E.Expression],
+                   distinct: bool = False) -> E.Expression:
+    n = name.lower()
+    if n == "count":
+        if len(args) == 0 or isinstance(args[0], E.UnresolvedStar):
+            return E.Count(None, distinct=False)
+        return E.Count(args[0], distinct=distinct)
+    if n in ("sum",) and distinct:
+        raise AnalysisException("sum(distinct) not yet supported")
+    b = lookup(n)
+    if b is None:
+        raise AnalysisException(f"Undefined function: {name}",
+                                error_class="UNRESOLVED_ROUTINE")
+    return b(*args)
+
+
+def _reg_all() -> None:
+    r = register
+    # aggregates
+    r("sum", lambda c: E.Sum(c))
+    r("min", lambda c: E.Min(c))
+    r("max", lambda c: E.Max(c))
+    r("avg", lambda c: E.Average(c))
+    r("mean", lambda c: E.Average(c))
+    r("first", lambda c, *a: E.First(c))
+    r("first_value", lambda c, *a: E.First(c))
+    r("any_value", lambda c, *a: E.AnyValue(c))
+    r("stddev", lambda c: E.StddevSamp(c))
+    r("stddev_samp", lambda c: E.StddevSamp(c))
+    r("stddev_pop", lambda c: E.StddevPop(c))
+    r("variance", lambda c: E.VarianceSamp(c))
+    r("var_samp", lambda c: E.VarianceSamp(c))
+    r("var_pop", lambda c: E.VariancePop(c))
+    r("collect_set", lambda c: E.CollectSet(c))
+    # math
+    r("abs", lambda c: E.Abs(c))
+    r("sqrt", lambda c: E.Sqrt(c))
+    r("exp", lambda c: E.Exp(c))
+    r("ln", lambda c: E.Log(c))
+    r("log", lambda c: E.Log(c))
+    r("log10", lambda c: E.Log10(c))
+    r("floor", lambda c: E.Floor(c))
+    r("ceil", lambda c: E.Ceil(c))
+    r("ceiling", lambda c: E.Ceil(c))
+    r("round", lambda c, s=None: E.Round(c, s))
+    r("power", lambda a, b: E.Pow(a, b))
+    r("pow", lambda a, b: E.Pow(a, b))
+    r("mod", lambda a, b: E.Remainder(a, b))
+    r("negative", lambda c: E.UnaryMinus(c))
+    # conditionals
+    r("if", lambda p, a, b: E.If(p, a, b))
+    r("coalesce", lambda *a: E.Coalesce(list(a)))
+    r("nullif", lambda a, b: E.NullIf(a, b))
+    r("nvl", lambda a, b: E.Coalesce([a, b]))
+    r("ifnull", lambda a, b: E.Coalesce([a, b]))
+    r("greatest", lambda *a: E.Greatest(list(a)))
+    r("least", lambda *a: E.Least(list(a)))
+    r("isnull", lambda c: E.IsNull(c))
+    r("isnotnull", lambda c: E.IsNotNull(c))
+    r("isnan", lambda c: E.IsNaN(c))
+    # strings
+    r("upper", lambda c: E.Upper(c))
+    r("ucase", lambda c: E.Upper(c))
+    r("lower", lambda c: E.Lower(c))
+    r("lcase", lambda c: E.Lower(c))
+    r("trim", lambda c: E.Trim(c))
+    r("ltrim", lambda c: E.LTrim(c))
+    r("rtrim", lambda c: E.RTrim(c))
+    r("length", lambda c: E.Length(c))
+    r("char_length", lambda c: E.Length(c))
+    r("substring", lambda c, p, l=None: E.Substring(c, p, l))
+    r("substr", lambda c, p, l=None: E.Substring(c, p, l))
+    r("concat", lambda *a: E.Concat(list(a)))
+    r("replace", lambda c, s, rep: E.StringReplace(c, s, rep))
+    r("lpad", lambda c, l, p=None: E.Lpad(c, l, p if p is not None else E.Literal(" "))),
+    r("rpad", lambda c, l, p=None: E.Rpad(c, l, p if p is not None else E.Literal(" "))),
+    r("startswith", lambda c, p: E.StartsWith(c, _lit_str(p)))
+    r("endswith", lambda c, p: E.EndsWith(c, _lit_str(p)))
+    r("contains", lambda c, p: E.Contains(c, _lit_str(p)))
+    r("like", lambda c, p: E.Like(c, _lit_str(p)))
+    r("rlike", lambda c, p: E.RLike(c, _lit_str(p)))
+    r("regexp", lambda c, p: E.RLike(c, _lit_str(p)))
+    # datetime
+    r("year", lambda c: E.Year(c))
+    r("month", lambda c: E.Month(c))
+    r("day", lambda c: E.DayOfMonth(c))
+    r("dayofmonth", lambda c: E.DayOfMonth(c))
+    r("quarter", lambda c: E.Quarter(c))
+    r("dayofweek", lambda c: E.DayOfWeek(c))
+    r("dayofyear", lambda c: E.DayOfYear(c))
+    r("weekofyear", lambda c: E.WeekOfYear(c))
+    r("date_add", lambda d, n: E.DateAdd(d, n))
+    r("date_sub", lambda d, n: E.DateSub(d, n))
+    r("datediff", lambda a, b: E.DateDiff(a, b))
+    r("trunc", lambda c, f: E.TruncDate(c, _lit_str(f)))
+    r("date_trunc", lambda f, c: E.TruncDate(c, _lit_str(f)))
+    r("make_date", lambda y, m, d: E.MakeDate(y, m, d))
+    r("to_date", lambda c, fmt=None: E.Cast(c, __import__(
+        "spark_tpu.types", fromlist=["date"]).date))
+
+
+_reg_all()

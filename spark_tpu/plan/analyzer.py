@@ -1,0 +1,450 @@
+"""Analyzer: resolves relations, columns, functions; coerces types.
+
+Role of the reference's Analyzer (sqlcat/analysis/Analyzer.scala:364, rule
+batches at :566) — ~100 rules there; here the load-bearing subset:
+ResolveRelations, ResolveReferences (incl. star expansion and qualifier
+handling via expr_ids), ResolveFunctions, alias extraction for aggregates,
+HAVING/ORDER-BY resolution against aggregates, decimal coercion, and
+CheckAnalysis.
+"""
+
+from __future__ import annotations
+
+import difflib
+from typing import Sequence
+
+from ..errors import AnalysisException, UnresolvedColumnError
+from ..types import DecimalType, common_type
+from .catalog import Catalog
+from .logical import (
+    Aggregate, Distinct, Filter, Join, LogicalPlan, Project, Sort,
+    SubqueryAlias, UnresolvedRelation,
+)
+from .tree import Batch, FixedPoint, Once, Rule, RuleExecutor
+from ..expr.expressions import (
+    Alias, AttributeReference, Cast, EqualTo, Expression, Literal, SortOrder,
+    Subtract, Add, UnresolvedAttribute, UnresolvedFunction, UnresolvedStar,
+    AggregateFunction, cast_if,
+)
+from ..expr.registry import build_function
+
+
+def _resolve_name(name_parts: tuple[str, ...],
+                  attrs: Sequence[AttributeReference],
+                  case_sensitive: bool) -> AttributeReference | None:
+    def norm(s: str) -> str:
+        return s if case_sensitive else s.lower()
+
+    # qualified references must suffix-match the attribute's qualifier
+    matches = []
+    for a in attrs:
+        if norm(a.name) == norm(name_parts[-1]):
+            quals = tuple(norm(q) for q in name_parts[:-1])
+            if quals:
+                aq = tuple(norm(q) for q in a.qualifier)
+                if len(aq) < len(quals) or aq[-len(quals):] != quals:
+                    continue
+            matches.append(a)
+    if len(matches) == 1:
+        return matches[0]
+    if len(matches) > 1:
+        # ambiguous unless they are the same attribute id
+        ids = {m.expr_id for m in matches}
+        if len(ids) == 1:
+            return matches[0]
+        raise AnalysisException(
+            f"Reference `{'.'.join(name_parts)}` is ambiguous",
+            error_class="AMBIGUOUS_REFERENCE")
+    return None
+
+
+class ResolveRelations(Rule):
+    def __init__(self, catalog: Catalog):
+        self.catalog = catalog
+
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        def rule(node):
+            if isinstance(node, UnresolvedRelation):
+                resolved = self.catalog.lookup(node.name_parts)
+                # fresh attribute instances per scan? No — reuse; self-joins
+                # get disambiguated by deduplicate rule below.
+                return SubqueryAlias(node.name_parts[-1], resolved)
+            return node
+
+        return plan.transform_up(rule)
+
+
+class DeduplicateRelations(Rule):
+    """Re-instance attribute ids on the right side of a self-join
+    (reference: Analyzer DeduplicateRelations)."""
+
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        def rule(node):
+            if isinstance(node, Join):
+                left_ids = {a.expr_id for a in node.left.output}
+                right_ids = {a.expr_id for a in node.right.output}
+                overlap = left_ids & right_ids
+                if overlap:
+                    mapping: dict[int, AttributeReference] = {}
+                    new_right = _remap_plan(node.right, mapping, overlap)
+                    cond = node.condition
+                    if cond is not None:
+                        # references in the condition that pointed at the old
+                        # right attrs are ambiguous pre-resolution; leave
+                        # unresolved names alone (they resolve later)
+                        pass
+                    return node.copy(right=new_right)
+            return node
+
+        return plan.transform_up(rule)
+
+
+def _remap_plan(plan: LogicalPlan, mapping: dict[int, AttributeReference],
+                overlap: set[int]) -> LogicalPlan:
+    """Deep-copy a subtree giving fresh expr_ids to attributes in `overlap`
+    (and anything they produce)."""
+
+    def remap_expr(e: Expression) -> Expression:
+        if isinstance(e, AttributeReference) and e.expr_id in mapping_ids():
+            return mapping[e.expr_id]
+        return e
+
+    def mapping_ids():
+        return mapping
+
+    def go(node: LogicalPlan) -> LogicalPlan:
+        node = node.map_children(go)
+        # remap produced attrs
+        from .logical import LogicalRelation, LocalRelation, RangeRelation
+
+        if isinstance(node, (LogicalRelation, LocalRelation)):
+            attrs = node.attrs if hasattr(node, "attrs") else node.output
+            new_attrs = []
+            changed = False
+            for a in attrs:
+                if a.expr_id in overlap:
+                    na = a.new_instance()
+                    mapping[a.expr_id] = na
+                    new_attrs.append(na)
+                    changed = True
+                else:
+                    new_attrs.append(a)
+            if changed:
+                node = node.copy(attrs=new_attrs)
+        elif isinstance(node, RangeRelation) and node.attr.expr_id in overlap:
+            na = node.attr.new_instance()
+            mapping[node.attr.expr_id] = na
+            node = node.copy(attr=na)
+        if isinstance(node, (Project, Aggregate)):
+            # aliases produce new ids too; only inputs need remapping
+            pass
+        node = node.transform_expressions(remap_expr)
+        return node
+
+    return go(plan)
+
+
+class ResolveReferences(Rule):
+    def __init__(self, case_sensitive: bool = False):
+        self.case_sensitive = case_sensitive
+
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        cs = self.case_sensitive
+
+        def rule(node: LogicalPlan):
+            if not all(c.resolved for c in node.children):
+                return node
+            inputs = node.input_attrs()
+
+            # star expansion in Project/Aggregate
+            if isinstance(node, (Project, Aggregate)):
+                lst = node.project_list if isinstance(node, Project) else node.aggregate_exprs
+                if any(isinstance(e, UnresolvedStar) for e in lst):
+                    expanded: list[Expression] = []
+                    for e in lst:
+                        if isinstance(e, UnresolvedStar):
+                            if e.target is None:
+                                expanded.extend(inputs)
+                            else:
+                                t = e.target if cs else e.target.lower()
+                                hits = [a for a in inputs
+                                        if t in tuple(q if cs else q.lower()
+                                                      for q in a.qualifier)]
+                                if not hits:
+                                    raise AnalysisException(
+                                        f"cannot resolve {e.target}.*")
+                                expanded.extend(hits)
+                        else:
+                            expanded.append(e)
+                    if isinstance(node, Project):
+                        return node.copy(project_list=expanded)
+                    return node.copy(aggregate_exprs=expanded)
+
+            def resolve_expr(e: Expression) -> Expression:
+                if isinstance(e, UnresolvedAttribute):
+                    a = _resolve_name(e.name_parts, inputs, cs)
+                    if a is not None:
+                        return a
+                    return e
+                if isinstance(e, UnresolvedFunction):
+                    if all(c.resolved for c in e.args):
+                        return build_function(e.fname, e.args, e.distinct)
+                    return e
+                return e
+
+            # Sort/Filter-over-Aggregate may reference aggregate output or
+            # grouping child columns — handled by ResolveAggsInSortHaving.
+            return node.transform_expressions(resolve_expr)
+
+        return plan.transform_up(rule)
+
+
+class ResolveAliases(Rule):
+    """Wrap top-level non-named project/aggregate expressions in Aliases."""
+
+    def apply(self, plan):
+        def rule(node):
+            if isinstance(node, Project):
+                if node.expressions_resolved and any(
+                        not isinstance(e, (Alias, AttributeReference, UnresolvedStar))
+                        for e in node.project_list):
+                    return node.copy(project_list=[_auto_alias(e)
+                                                   for e in node.project_list])
+            if isinstance(node, Aggregate):
+                if node.expressions_resolved and any(
+                        not isinstance(e, (Alias, AttributeReference, UnresolvedStar))
+                        for e in node.aggregate_exprs):
+                    return node.copy(aggregate_exprs=[_auto_alias(e)
+                                                      for e in node.aggregate_exprs])
+            return node
+
+        return plan.transform_up(rule)
+
+
+def _auto_alias(e: Expression) -> Expression:
+    if isinstance(e, (Alias, AttributeReference, UnresolvedStar)):
+        return e
+    name = _pretty_name(e)
+    return Alias(e, name)
+
+
+def _pretty_name(e: Expression) -> str:
+    from ..expr.expressions import (
+        Average, Count, Max, Min, Sum, Cast as _Cast,
+    )
+
+    if isinstance(e, Sum):
+        return f"sum({_pretty_name(e.child)})"
+    if isinstance(e, Count):
+        return f"count({_pretty_name(e.child) if e.child else '1'})"
+    if isinstance(e, Min):
+        return f"min({_pretty_name(e.child)})"
+    if isinstance(e, Max):
+        return f"max({_pretty_name(e.child)})"
+    if isinstance(e, Average):
+        return f"avg({_pretty_name(e.child)})"
+    if isinstance(e, AttributeReference):
+        return e.name
+    if isinstance(e, UnresolvedAttribute):
+        return e.name
+    if isinstance(e, Literal):
+        return str(e.value)
+    if isinstance(e, _Cast):
+        return _pretty_name(e.child)
+    return e.simple_string()
+
+
+class ResolveAggsInSortHaving(Rule):
+    """Resolve HAVING filters and ORDER BY over an Aggregate: references to
+    aggregate results resolve to output attrs; bare aggregate functions get
+    pulled into the aggregate (reference: ResolveAggregateFunctions)."""
+
+    def __init__(self, case_sensitive: bool = False):
+        self.cs = case_sensitive
+
+    def apply(self, plan):
+        def rule(node):
+            if isinstance(node, (Filter, Sort)) and isinstance(
+                    _skip_alias(node.child), Aggregate):
+                agg = _skip_alias(node.child)
+                if not agg.resolved:
+                    return node
+                out_attrs = agg.output
+
+                extra: list[Alias] = []
+
+                def resolve(e: Expression) -> Expression:
+                    if isinstance(e, UnresolvedAttribute):
+                        a = _resolve_name(e.name_parts, out_attrs, self.cs)
+                        if a is not None:
+                            return a
+                        a = _resolve_name(e.name_parts, agg.child.output, self.cs)
+                        if a is not None:
+                            return a
+                        return e
+                    if isinstance(e, UnresolvedFunction):
+                        if all(c.resolved for c in e.args):
+                            f = build_function(e.fname, e.args, e.distinct)
+                            if isinstance(f, AggregateFunction):
+                                # match an existing aggregate output
+                                for ae in agg.aggregate_exprs:
+                                    if isinstance(ae, Alias) and \
+                                            ae.child.semantic_equals(f):
+                                        return ae.to_attribute()
+                                al = Alias(f, _pretty_name(f))
+                                extra.append(al)
+                                return al.to_attribute()
+                            return f
+                        return e
+                    return e
+
+                # resolve against agg child FIRST for agg args
+                def resolve_inner_attrs(e):
+                    if isinstance(e, UnresolvedAttribute):
+                        a = _resolve_name(e.name_parts, agg.child.output, self.cs)
+                        if a is not None:
+                            return a
+                    return e
+
+                if isinstance(node, Filter):
+                    cond = node.condition.transform_up(resolve_inner_attrs)
+                    cond = cond.transform_up(resolve)
+                    if extra:
+                        new_agg = agg.copy(
+                            aggregate_exprs=agg.aggregate_exprs + extra)
+                        child = _replace_agg(node.child, new_agg)
+                        return Project(
+                            list(out_attrs),
+                            Filter(cond, child))
+                    if cond is not node.condition:
+                        return node.copy(condition=cond)
+                    return node
+                else:
+                    orders = []
+                    changed = False
+                    for o in node.orders:
+                        c = o.child.transform_up(resolve_inner_attrs)
+                        c = c.transform_up(resolve)
+                        if c is not o.child:
+                            changed = True
+                            orders.append(SortOrder(c, o.ascending, o.nulls_first))
+                        else:
+                            orders.append(o)
+                    if extra:
+                        new_agg = agg.copy(
+                            aggregate_exprs=agg.aggregate_exprs + extra)
+                        child = _replace_agg(node.child, new_agg)
+                        return Project(
+                            list(out_attrs),
+                            Sort(orders, node.is_global, child))
+                    if changed:
+                        return node.copy(orders=orders)
+                    return node
+            return node
+
+        return plan.transform_up(rule)
+
+
+def _skip_alias(p: LogicalPlan) -> LogicalPlan:
+    while isinstance(p, SubqueryAlias):
+        p = p.child
+    return p
+
+
+def _replace_agg(p: LogicalPlan, new_agg: Aggregate) -> LogicalPlan:
+    if isinstance(p, SubqueryAlias):
+        return p.copy(child=_replace_agg(p.child, new_agg))
+    return new_agg
+
+
+class CoerceDecimalArithmetic(Rule):
+    """Align decimal scales in Add/Subtract (device repr is scaled int64)."""
+
+    def apply(self, plan):
+        def fix(e: Expression) -> Expression:
+            if isinstance(e, (Add, Subtract)) and e.left.resolved and e.right.resolved:
+                lt, rt = e.left.dtype, e.right.dtype
+                if isinstance(lt, DecimalType) and isinstance(rt, DecimalType) \
+                        and lt.scale != rt.scale:
+                    ct = common_type(lt, rt)
+                    return type(e)(cast_if(e.left, ct), cast_if(e.right, ct))
+            return e
+
+        def rule(node):
+            if node.expressions_resolved:
+                return node.transform_expressions(fix)
+            return node
+
+        return plan.transform_up(rule)
+
+
+class CheckAnalysis(Rule):
+    def apply(self, plan):
+        def check(node):
+            for e in node.expressions():
+                for sub in e.iter_nodes():
+                    if isinstance(sub, UnresolvedAttribute):
+                        cands = [a.name for a in node.input_attrs()]
+                        close = difflib.get_close_matches(sub.name, cands, 3)
+                        raise UnresolvedColumnError(sub.name, close or cands[:5])
+                    if isinstance(sub, (UnresolvedFunction,)):
+                        raise AnalysisException(
+                            f"unresolved function {sub.fname}")
+                    if isinstance(sub, UnresolvedStar):
+                        raise AnalysisException("unexpected * in expression")
+            if isinstance(node, UnresolvedRelation):
+                raise AnalysisException(f"unresolved relation {node.name}")
+            # aggregates: non-grouping bare columns
+            if isinstance(node, Aggregate) and node.resolved:
+                grouping_ids = set()
+                for g in node.grouping_exprs:
+                    if isinstance(g, AttributeReference):
+                        grouping_ids.add(g.expr_id)
+                for e in node.aggregate_exprs:
+                    _check_agg_expr(e, grouping_ids, node)
+            return None
+
+        plan.foreach(check)
+        return plan
+
+
+def _check_agg_expr(e: Expression, grouping_ids: set[int], agg: Aggregate):
+    def ok(x: Expression, inside_agg: bool) -> bool:
+        if isinstance(x, AggregateFunction):
+            return all(ok(c, True) for c in x.children)
+        if isinstance(x, AttributeReference) and not inside_agg:
+            if x.expr_id not in grouping_ids:
+                # allow if semantically equal to a grouping expression
+                for g in agg.grouping_exprs:
+                    if g.semantic_equals(x):
+                        return True
+                raise AnalysisException(
+                    f"column {x.name} is neither grouped nor aggregated",
+                    error_class="MISSING_AGGREGATION")
+            return True
+        return all(ok(c, inside_agg) for c in x.children)
+
+    ok(e.child if isinstance(e, Alias) else e, False)
+
+
+class Analyzer(RuleExecutor):
+    def __init__(self, catalog: Catalog, case_sensitive: bool = False):
+        super().__init__()
+        self.catalog = catalog
+        self.case_sensitive = case_sensitive
+
+    def batches(self):
+        cs = self.case_sensitive
+        return [
+            Batch("Resolution", FixedPoint(50), [
+                ResolveRelations(self.catalog),
+                DeduplicateRelations(),
+                ResolveReferences(cs),
+                ResolveAggsInSortHaving(cs),
+                ResolveAliases(),
+            ]),
+            Batch("Coercion", FixedPoint(10), [
+                CoerceDecimalArithmetic(),
+            ]),
+            Batch("Check", Once(), [CheckAnalysis()]),
+        ]

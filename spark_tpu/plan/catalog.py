@@ -1,0 +1,43 @@
+"""Session catalog.
+
+Role of the reference's SessionCatalog/CatalogManager
+(sqlcat/catalog/SessionCatalog.scala) reduced to an in-memory registry of
+temp views and tables; a persistent metastore SPI can plug in behind
+`external`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..errors import AnalysisException
+from .logical import LogicalPlan
+
+
+class Catalog:
+    def __init__(self, case_sensitive: bool = False):
+        self._tables: dict[str, LogicalPlan] = {}
+        self.case_sensitive = case_sensitive
+
+    def _norm(self, name: str) -> str:
+        return name if self.case_sensitive else name.lower()
+
+    def register(self, name: str, plan: LogicalPlan) -> None:
+        self._tables[self._norm(name)] = plan
+
+    def drop(self, name: str) -> bool:
+        return self._tables.pop(self._norm(name), None) is not None
+
+    def lookup(self, name_parts) -> LogicalPlan:
+        name = ".".join(name_parts)
+        p = self._tables.get(self._norm(name))
+        if p is None and len(name_parts) > 1:
+            p = self._tables.get(self._norm(name_parts[-1]))
+        if p is None:
+            raise AnalysisException(
+                f"Table or view not found: {name}",
+                error_class="TABLE_OR_VIEW_NOT_FOUND")
+        return p
+
+    def list_tables(self) -> list[str]:
+        return sorted(self._tables)

@@ -1,0 +1,427 @@
+"""Logical plan nodes.
+
+Role of the reference's sqlcat/plans/logical/basicLogicalOperators.scala
+(Project, Filter, Aggregate, Join, Sort, Limit, Union, SubqueryAlias,
+LocalRelation, Range...). Same lazy-tree architecture — SURVEY.md §7 keeps
+Spark's logical layer because it is backend-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+from ..errors import AnalysisException
+from ..types import StructField, StructType, int64
+from .tree import TreeNode
+from ..expr.expressions import (
+    Alias, AttributeReference, Expression, SortOrder,
+)
+
+__all__ = [
+    "LogicalPlan", "LeafNode", "UnaryNode", "BinaryNode",
+    "UnresolvedRelation", "LogicalRelation", "LocalRelation", "RangeRelation",
+    "Project", "Filter", "Aggregate", "Sort", "Limit", "Offset", "Sample",
+    "Join", "Union", "Distinct", "SubqueryAlias", "Repartition",
+    "OneRowRelation", "Window", "Expand",
+]
+
+
+class LogicalPlan(TreeNode):
+    @property
+    def output(self) -> list[AttributeReference]:
+        raise NotImplementedError(type(self).__name__)
+
+    @property
+    def resolved(self) -> bool:
+        return self.expressions_resolved and all(c.resolved for c in self.children)
+
+    @property
+    def expressions_resolved(self) -> bool:
+        return all(e.resolved for e in self.expressions())
+
+    def expressions(self) -> list[Expression]:
+        """All expressions directly held by this node."""
+        out = []
+        for k, v in self.__dict__.items():
+            if k in self.child_fields:
+                continue
+            if isinstance(v, Expression):
+                out.append(v)
+            elif isinstance(v, (list, tuple)):
+                out.extend(x for x in v if isinstance(x, Expression))
+        return out
+
+    def map_expressions(self, f) -> "LogicalPlan":
+        changed = False
+        overrides: dict[str, Any] = {}
+        for k, v in self.__dict__.items():
+            if k in self.child_fields or k.startswith("_"):
+                continue
+            if isinstance(v, Expression):
+                nv = f(v)
+                if nv is not v:
+                    changed = True
+                overrides[k] = nv
+            elif isinstance(v, (list, tuple)) and any(isinstance(x, Expression) for x in v):
+                nl = [f(x) if isinstance(x, Expression) else x for x in v]
+                if any(a is not b for a, b in zip(nl, v)):
+                    changed = True
+                overrides[k] = type(v)(nl) if isinstance(v, tuple) else nl
+        return self.copy(**overrides) if changed else self
+
+    def transform_expressions(self, rule) -> "LogicalPlan":
+        return self.map_expressions(lambda e: e.transform_up(rule))
+
+    def input_attrs(self) -> list[AttributeReference]:
+        out = []
+        for c in self.children:
+            out.extend(c.output)
+        return out
+
+    def schema(self) -> StructType:
+        return StructType([
+            StructField(a.name, a.dtype, a.nullable) for a in self.output])
+
+    def stats_rows(self) -> int | None:
+        """Crude row-count estimate (reference: statsEstimation/)."""
+        ests = [c.stats_rows() for c in self.children]
+        if any(e is None for e in ests):
+            return None
+        return sum(ests) if ests else None
+
+
+class LeafNode(LogicalPlan):
+    child_fields = ()
+
+
+class UnaryNode(LogicalPlan):
+    child_fields = ("child",)
+
+    @property
+    def output(self) -> list[AttributeReference]:
+        return self.child.output
+
+
+class BinaryNode(LogicalPlan):
+    child_fields = ("left", "right")
+
+
+# ---------------------------------------------------------------------------
+# Leaves
+# ---------------------------------------------------------------------------
+
+class UnresolvedRelation(LeafNode):
+    def __init__(self, name_parts: Sequence[str]):
+        self.name_parts = tuple(name_parts)
+
+    @property
+    def name(self) -> str:
+        return ".".join(self.name_parts)
+
+    @property
+    def resolved(self) -> bool:
+        return False
+
+    @property
+    def output(self):
+        raise AnalysisException(f"unresolved relation {self.name}")
+
+
+class LogicalRelation(LeafNode):
+    """A resolved data source (reference: execution/datasources/LogicalRelation)."""
+
+    def __init__(self, source, attrs: list[AttributeReference], name: str = ""):
+        self.source = source  # duck-typed: .schema, .partitions(), .estimated_rows
+        self.attrs = attrs
+        self.name = name
+
+    @property
+    def output(self):
+        return self.attrs
+
+    def _data_args(self):
+        return (("name", self.name), ("ids", tuple(a.expr_id for a in self.attrs)))
+
+    def stats_rows(self):
+        return getattr(self.source, "estimated_rows", None)
+
+    def simple_string(self):
+        return f"Relation[{self.name}]({', '.join(a.name for a in self.attrs)})"
+
+
+class LocalRelation(LeafNode):
+    """In-memory rows (reference: sqlcat/plans/logical/LocalRelation.scala)."""
+
+    def __init__(self, attrs: list[AttributeReference], table):
+        self.attrs = attrs
+        self.table = table  # pyarrow.Table
+
+    @property
+    def output(self):
+        return self.attrs
+
+    def _data_args(self):
+        return (("ids", tuple(a.expr_id for a in self.attrs)),)
+
+    def stats_rows(self):
+        return self.table.num_rows
+
+
+class OneRowRelation(LeafNode):
+    @property
+    def output(self):
+        return []
+
+    def stats_rows(self):
+        return 1
+
+
+class RangeRelation(LeafNode):
+    """spark.range() (reference: sqlcat/plans/logical/Range)."""
+
+    def __init__(self, start: int, end: int, step: int, num_partitions: int,
+                 attr: AttributeReference | None = None):
+        self.start = start
+        self.end = end
+        self.step = step
+        self.num_partitions = num_partitions
+        self.attr = attr or AttributeReference("id", int64, nullable=False)
+
+    @property
+    def output(self):
+        return [self.attr]
+
+    def stats_rows(self):
+        return max(0, (self.end - self.start + self.step - 1) // self.step)
+
+
+# ---------------------------------------------------------------------------
+# Unary operators
+# ---------------------------------------------------------------------------
+
+class Project(UnaryNode):
+    def __init__(self, project_list: Sequence[Expression], child: LogicalPlan):
+        self.project_list = list(project_list)
+        self.child = child
+
+    @property
+    def output(self):
+        out = []
+        for e in self.project_list:
+            if isinstance(e, Alias):
+                out.append(e.to_attribute())
+            elif isinstance(e, AttributeReference):
+                out.append(e)
+            else:
+                raise AnalysisException(
+                    f"project expression needs alias: {e.simple_string()}")
+        return out
+
+    def stats_rows(self):
+        return self.child.stats_rows()
+
+
+class Filter(UnaryNode):
+    def __init__(self, condition: Expression, child: LogicalPlan):
+        self.condition = condition
+        self.child = child
+
+    def stats_rows(self):
+        r = self.child.stats_rows()
+        return None if r is None else max(1, r // 4)
+
+
+class Aggregate(UnaryNode):
+    """grouping_exprs + aggregate_exprs (the output list mixing grouping
+    attrs and Alias(AggregateFunction) — reference:
+    sqlcat/plans/logical/basicLogicalOperators.scala Aggregate)."""
+
+    def __init__(self, grouping_exprs: Sequence[Expression],
+                 aggregate_exprs: Sequence[Expression], child: LogicalPlan):
+        self.grouping_exprs = list(grouping_exprs)
+        self.aggregate_exprs = list(aggregate_exprs)
+        self.child = child
+
+    @property
+    def output(self):
+        out = []
+        for e in self.aggregate_exprs:
+            if isinstance(e, Alias):
+                out.append(e.to_attribute())
+            elif isinstance(e, AttributeReference):
+                out.append(e)
+            else:
+                raise AnalysisException(
+                    f"aggregate expression needs alias: {e.simple_string()}")
+        return out
+
+    def stats_rows(self):
+        r = self.child.stats_rows()
+        if not self.grouping_exprs:
+            return 1
+        return None if r is None else max(1, r // 10)
+
+
+class Sort(UnaryNode):
+    def __init__(self, orders: Sequence[SortOrder], is_global: bool,
+                 child: LogicalPlan):
+        self.orders = list(orders)
+        self.is_global = is_global
+        self.child = child
+
+    def stats_rows(self):
+        return self.child.stats_rows()
+
+
+class Limit(UnaryNode):
+    def __init__(self, n: int, child: LogicalPlan):
+        self.n = n
+        self.child = child
+
+    def stats_rows(self):
+        r = self.child.stats_rows()
+        return self.n if r is None else min(self.n, r)
+
+
+class Offset(UnaryNode):
+    def __init__(self, n: int, child: LogicalPlan):
+        self.n = n
+        self.child = child
+
+
+class Sample(UnaryNode):
+    def __init__(self, fraction: float, seed: int, child: LogicalPlan):
+        self.fraction = fraction
+        self.seed = seed
+        self.child = child
+
+
+class Distinct(UnaryNode):
+    def __init__(self, child: LogicalPlan):
+        self.child = child
+
+
+class SubqueryAlias(UnaryNode):
+    def __init__(self, alias: str, child: LogicalPlan):
+        self.alias = alias
+        self.child = child
+
+    @property
+    def output(self):
+        return [AttributeReference(a.name, a.dtype, a.nullable, a.expr_id,
+                                   qualifier=(self.alias,))
+                for a in self.child.output]
+
+    def stats_rows(self):
+        return self.child.stats_rows()
+
+
+class Repartition(UnaryNode):
+    def __init__(self, num_partitions: int | None, shuffle: bool,
+                 partition_exprs: Sequence[Expression], child: LogicalPlan):
+        self.num_partitions = num_partitions
+        self.shuffle = shuffle
+        self.partition_exprs = list(partition_exprs)
+        self.child = child
+
+
+class Window(UnaryNode):
+    """Window operator: window_exprs are Alias(WindowExpression) appended to
+    child output (reference: sqlcat/plans/logical Window)."""
+
+    def __init__(self, window_exprs: Sequence[Expression],
+                 partition_spec: Sequence[Expression],
+                 order_spec: Sequence[SortOrder], child: LogicalPlan):
+        self.window_exprs = list(window_exprs)
+        self.partition_spec = list(partition_spec)
+        self.order_spec = list(order_spec)
+        self.child = child
+
+    @property
+    def output(self):
+        return self.child.output + [e.to_attribute() for e in self.window_exprs]
+
+
+class Expand(UnaryNode):
+    """Multiplies each row by projection sets (rollup/cube/count-distinct;
+    reference: sqlcat/plans/logical Expand)."""
+
+    def __init__(self, projections: Sequence[Sequence[Expression]],
+                 out_attrs: list[AttributeReference], child: LogicalPlan):
+        self.projections = [list(p) for p in projections]
+        self.out_attrs = out_attrs
+        self.child = child
+
+    @property
+    def output(self):
+        return self.out_attrs
+
+
+# ---------------------------------------------------------------------------
+# Binary / n-ary
+# ---------------------------------------------------------------------------
+
+JOIN_TYPES = ("inner", "left_outer", "right_outer", "full_outer", "left_semi",
+              "left_anti", "cross")
+
+
+def normalize_join_type(jt: str) -> str:
+    s = jt.lower().replace("_", "").replace(" ", "")
+    mapping = {
+        "inner": "inner", "cross": "cross",
+        "left": "left_outer", "leftouter": "left_outer",
+        "right": "right_outer", "rightouter": "right_outer",
+        "full": "full_outer", "fullouter": "full_outer", "outer": "full_outer",
+        "semi": "left_semi", "leftsemi": "left_semi",
+        "anti": "left_anti", "leftanti": "left_anti",
+    }
+    if s not in mapping:
+        raise AnalysisException(f"unsupported join type {jt}")
+    return mapping[s]
+
+
+class Join(BinaryNode):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan, join_type: str,
+                 condition: Expression | None):
+        self.left = left
+        self.right = right
+        self.join_type = normalize_join_type(join_type)
+        self.condition = condition
+
+    @property
+    def output(self):
+        jt = self.join_type
+        if jt in ("left_semi", "left_anti"):
+            return self.left.output
+        lo = self.left.output
+        ro = self.right.output
+        if jt in ("right_outer",):
+            lo = [a.with_nullability(True) for a in lo]
+        if jt in ("left_outer",):
+            ro = [a.with_nullability(True) for a in ro]
+        if jt == "full_outer":
+            lo = [a.with_nullability(True) for a in lo]
+            ro = [a.with_nullability(True) for a in ro]
+        return lo + ro
+
+    def stats_rows(self):
+        l = self.left.stats_rows()
+        r = self.right.stats_rows()
+        if l is None or r is None:
+            return None
+        return max(l, r)
+
+
+class Union(LogicalPlan):
+    child_fields = ("children_plans",)
+
+    def __init__(self, children_plans: Sequence[LogicalPlan]):
+        self.children_plans = list(children_plans)
+
+    @property
+    def output(self):
+        first = self.children_plans[0].output
+        # nullability is the OR across children
+        nullables = [any(c.output[i].nullable for c in self.children_plans)
+                     for i in range(len(first))]
+        return [a.with_nullability(n) for a, n in zip(first, nullables)]

@@ -1,0 +1,564 @@
+"""Logical optimizer.
+
+Role of the reference's Optimizer (sqlcat/optimizer/Optimizer.scala:51,
+defaultBatches :100 — ~120 rules). The subset that matters for TPC-DS-class
+plans (SURVEY.md §7 step 3): predicate pushdown (through projects, aliases,
+joins, unions, aggregates), filter combination/pruning, column pruning,
+constant folding, boolean simplification, cast simplification, distinct→
+aggregate, collapse projects, and empty-relation propagation.
+"""
+
+from __future__ import annotations
+
+import datetime
+import math
+from typing import Sequence
+
+from ..types import BooleanType, NullType, boolean
+from .logical import (
+    Aggregate, Distinct, Filter, Join, Limit, LocalRelation, LogicalPlan,
+    LogicalRelation, Project, RangeRelation, Repartition, Sample, Sort,
+    SubqueryAlias, Union, Window, Expand, Offset,
+)
+from .tree import Batch, FixedPoint, Once, Rule, RuleExecutor
+from ..expr.expressions import (
+    Add, Alias, And, AttributeReference, BinaryComparison, Cast, CaseWhen,
+    Coalesce, Divide, EqualTo, Expression, GreaterThan, GreaterThanOrEqual,
+    In, IsNotNull, IsNull, LessThan, LessThanOrEqual, Literal, Multiply, Not,
+    NotEqualTo, Or, Remainder, SortOrder, Subtract, UnaryMinus,
+    AggregateFunction,
+)
+
+__all__ = ["Optimizer", "split_conjuncts", "substitute_attrs"]
+
+
+def split_conjuncts(e: Expression) -> list[Expression]:
+    if isinstance(e, And):
+        return split_conjuncts(e.left) + split_conjuncts(e.right)
+    return [e]
+
+
+def join_conjuncts(es: Sequence[Expression]) -> Expression | None:
+    out = None
+    for e in es:
+        out = e if out is None else And(out, e)
+    return out
+
+
+def substitute_attrs(e: Expression, mapping: dict[int, Expression]) -> Expression:
+    def rule(x):
+        if isinstance(x, AttributeReference) and x.expr_id in mapping:
+            return mapping[x.expr_id]
+        return x
+
+    return e.transform_up(rule)
+
+
+def alias_map(project_list: Sequence[Expression]) -> dict[int, Expression]:
+    m: dict[int, Expression] = {}
+    for e in project_list:
+        if isinstance(e, Alias):
+            m[e.expr_id] = e.child
+    return m
+
+
+# ---------------------------------------------------------------------------
+# Constant folding
+# ---------------------------------------------------------------------------
+
+def const_value(e: Expression):
+    """Evaluate a literal-only expression host-side. Returns (ok, value)."""
+    if isinstance(e, Literal):
+        return True, e.value
+    if isinstance(e, Cast):
+        ok, v = const_value(e.child)
+        if not ok:
+            return False, None
+        try:
+            return True, _py_cast(v, e.to)
+        except Exception:
+            return False, None
+    if isinstance(e, UnaryMinus):
+        ok, v = const_value(e.child)
+        return (True, -v) if ok and v is not None else (ok, None)
+    if isinstance(e, Not):
+        ok, v = const_value(e.child)
+        return (True, (not v) if v is not None else None) if ok else (False, None)
+    binops = {
+        Add: lambda a, b: a + b, Subtract: lambda a, b: a - b,
+        Multiply: lambda a, b: a * b,
+        Divide: lambda a, b: a / b if b else None,
+        Remainder: lambda a, b: math.fmod(a, b) if b else None,
+        EqualTo: lambda a, b: a == b, NotEqualTo: lambda a, b: a != b,
+        LessThan: lambda a, b: a < b, LessThanOrEqual: lambda a, b: a <= b,
+        GreaterThan: lambda a, b: a > b, GreaterThanOrEqual: lambda a, b: a >= b,
+    }
+    for cls, fn in binops.items():
+        if type(e) is cls:
+            ok1, a = const_value(e.left)
+            ok2, b = const_value(e.right)
+            if not (ok1 and ok2):
+                return False, None
+            if a is None or b is None:
+                return True, None
+            try:
+                return True, fn(a, b)
+            except Exception:
+                return False, None
+    return False, None
+
+
+def _py_cast(v, to):
+    from ..types import (
+        BooleanType, DateType, FractionalType, IntegralType, StringType,
+        TimestampType, DecimalType,
+    )
+
+    if v is None:
+        return None
+    if isinstance(to, IntegralType):
+        return int(v)
+    if isinstance(to, DecimalType):
+        return v
+    if isinstance(to, FractionalType):
+        return float(v)
+    if isinstance(to, BooleanType):
+        return bool(v)
+    if isinstance(to, StringType):
+        return str(v)
+    if isinstance(to, DateType):
+        if isinstance(v, str):
+            return datetime.date.fromisoformat(v.strip()[:10])
+        return v
+    if isinstance(to, TimestampType):
+        if isinstance(v, str):
+            return datetime.datetime.fromisoformat(v.strip())
+        return v
+    raise ValueError
+
+
+class ConstantFolding(Rule):
+    def apply(self, plan):
+        def fold(e: Expression) -> Expression:
+            if isinstance(e, Literal) or not e.resolved:
+                return e
+            if isinstance(e, (AggregateFunction, Alias, AttributeReference,
+                              SortOrder)):
+                return e
+            if any(isinstance(c, AttributeReference) for c in e.iter_nodes()):
+                return e
+            ok, v = const_value(e)
+            if ok:
+                try:
+                    dt = e.dtype
+                    if isinstance(dt, NullType) and v is not None:
+                        return Literal(v)
+                    return Literal(v, dt) if v is not None else Literal(None, dt)
+                except Exception:
+                    return e
+            return e
+
+        def rule(node):
+            if node.expressions_resolved:
+                return node.transform_expressions(fold)
+            return node
+
+        return plan.transform_up(rule)
+
+
+class BooleanSimplification(Rule):
+    def apply(self, plan):
+        t = lambda e: isinstance(e, Literal) and e.value is True
+        f = lambda e: isinstance(e, Literal) and e.value is False
+
+        def simp(e: Expression) -> Expression:
+            if isinstance(e, And):
+                if t(e.left):
+                    return e.right
+                if t(e.right):
+                    return e.left
+                if f(e.left) or f(e.right):
+                    return Literal(False)
+            if isinstance(e, Or):
+                if f(e.left):
+                    return e.right
+                if f(e.right):
+                    return e.left
+                if t(e.left) or t(e.right):
+                    return Literal(True)
+            if isinstance(e, Not):
+                if t(e.child):
+                    return Literal(False)
+                if f(e.child):
+                    return Literal(True)
+                if isinstance(e.child, Not):
+                    return e.child.child
+            return e
+
+        def rule(node):
+            if node.expressions_resolved:
+                return node.transform_expressions(simp)
+            return node
+
+        return plan.transform_up(rule)
+
+
+class SimplifyCasts(Rule):
+    def apply(self, plan):
+        def simp(e):
+            if isinstance(e, Cast) and e.child.resolved and e.child.dtype == e.to:
+                return e.child
+            return e
+
+        def rule(node):
+            if node.expressions_resolved:
+                return node.transform_expressions(simp)
+            return node
+
+        return plan.transform_up(rule)
+
+
+class CombineFilters(Rule):
+    def apply(self, plan):
+        def rule(node):
+            if isinstance(node, Filter) and isinstance(node.child, Filter):
+                return Filter(And(node.child.condition, node.condition),
+                              node.child.child)
+            return node
+
+        return plan.transform_up(rule)
+
+
+class PushDownPredicates(Rule):
+    """Push filters through Project/SubqueryAlias/Union and into Join sides
+    (reference: Optimizer PushDownPredicates + PushPredicateThroughJoin)."""
+
+    def apply(self, plan):
+        def rule(node):
+            if not isinstance(node, Filter):
+                return node
+            child = node.child
+            if isinstance(child, Project):
+                if any(isinstance(e, AggregateFunction)
+                       for pe in child.project_list
+                       for e in pe.iter_nodes()):
+                    return node
+                m = alias_map(child.project_list)
+                new_cond = substitute_attrs(node.condition, m)
+                return Project(child.project_list, Filter(new_cond, child.child))
+            if isinstance(child, SubqueryAlias):
+                return SubqueryAlias(child.alias, Filter(node.condition, child.child))
+            if isinstance(child, Union):
+                return Union([Filter(_remap_union_cond(node.condition, child, i), c)
+                              for i, c in enumerate(child.children_plans)])
+            if isinstance(child, Join):
+                return self._push_into_join(node, child)
+            if isinstance(child, Aggregate):
+                # push predicates that reference only grouping attrs
+                group_ids = {g.expr_id for g in child.grouping_exprs
+                             if isinstance(g, AttributeReference)}
+                # aliases of grouping exprs in output
+                out_to_group: dict[int, Expression] = {}
+                for e in child.aggregate_exprs:
+                    if isinstance(e, Alias):
+                        out_to_group[e.expr_id] = e.child
+                    elif isinstance(e, AttributeReference):
+                        out_to_group[e.expr_id] = e
+                pushable, kept = [], []
+                for c in split_conjuncts(node.condition):
+                    refs = c.references()
+                    mapped = substitute_attrs(c, out_to_group)
+                    if any(isinstance(x, AggregateFunction)
+                           for x in mapped.iter_nodes()):
+                        kept.append(c)
+                        continue
+                    mrefs = mapped.references()
+                    child_ids = {a.expr_id for a in child.child.output}
+                    if mrefs <= child_ids and _only_grouping_refs(mapped, child):
+                        pushable.append(mapped)
+                    else:
+                        kept.append(c)
+                if pushable:
+                    new_agg = child.copy(
+                        child=Filter(join_conjuncts(pushable), child.child))
+                    if kept:
+                        return Filter(join_conjuncts(kept), new_agg)
+                    return new_agg
+                return node
+            return node
+
+        return plan.transform_up(rule)
+
+    def _push_into_join(self, filt: Filter, join: Join):
+        left_ids = {a.expr_id for a in join.left.output}
+        right_ids = {a.expr_id for a in join.right.output}
+        left_push, right_push, kept = [], [], []
+        jt = join.join_type
+        for c in split_conjuncts(filt.condition):
+            refs = c.references()
+            if refs and refs <= left_ids and jt in ("inner", "left_outer",
+                                                    "left_semi", "left_anti", "cross"):
+                left_push.append(c)
+            elif refs and refs <= right_ids and jt in ("inner", "right_outer", "cross"):
+                right_push.append(c)
+            else:
+                kept.append(c)
+        if not left_push and not right_push:
+            return filt
+        new_left = Filter(join_conjuncts(left_push), join.left) if left_push else join.left
+        new_right = Filter(join_conjuncts(right_push), join.right) if right_push else join.right
+        new_join = join.copy(left=new_left, right=new_right)
+        if kept:
+            return Filter(join_conjuncts(kept), new_join)
+        return new_join
+
+
+def _only_grouping_refs(e: Expression, agg: Aggregate) -> bool:
+    group_ids = {g.expr_id for g in agg.grouping_exprs
+                 if isinstance(g, AttributeReference)}
+
+    def ok(x):
+        if isinstance(x, AttributeReference):
+            return x.expr_id in group_ids or any(
+                g.semantic_equals(x) for g in agg.grouping_exprs)
+        return all(ok(c) for c in x.children)
+
+    return ok(e)
+
+
+def _remap_union_cond(cond: Expression, union: Union, i: int) -> Expression:
+    out = union.output
+    branch = union.children_plans[i].output
+    m = {a.expr_id: b for a, b in zip(out, branch)}
+    return substitute_attrs(cond, m)
+
+
+class InferFiltersFromJoinKeys(Rule):
+    """Add IsNotNull on equi-join keys (reference: InferFiltersFromConstraints,
+    simplified) — lets scans drop null keys before the shuffle."""
+
+    def apply(self, plan):
+        def rule(node):
+            if isinstance(node, Join) and node.join_type in ("inner",) and \
+                    node.condition is not None and node.resolved:
+                conds = split_conjuncts(node.condition)
+                left_ids = {a.expr_id for a in node.left.output}
+                right_ids = {a.expr_id for a in node.right.output}
+                lnew, rnew = [], []
+                for c in conds:
+                    if isinstance(c, EqualTo):
+                        for side in (c.left, c.right):
+                            if isinstance(side, AttributeReference) and side.nullable:
+                                if side.expr_id in left_ids:
+                                    lnew.append(IsNotNull(side))
+                                elif side.expr_id in right_ids:
+                                    rnew.append(IsNotNull(side))
+                changed = False
+                nl, nr = node.left, node.right
+                if lnew and not _already_filtered(node.left, lnew):
+                    nl = Filter(join_conjuncts(lnew), node.left)
+                    changed = True
+                if rnew and not _already_filtered(node.right, rnew):
+                    nr = Filter(join_conjuncts(rnew), node.right)
+                    changed = True
+                if changed:
+                    return node.copy(left=nl, right=nr)
+            return node
+
+        return plan.transform_down(rule)
+
+
+def _already_filtered(p: LogicalPlan, conds: list[Expression]) -> bool:
+    existing: list[Expression] = []
+    q = p
+    while isinstance(q, Filter):
+        existing.extend(split_conjuncts(q.condition))
+        q = q.child
+    return all(any(c.semantic_equals(e) for e in existing) for c in conds)
+
+
+class ColumnPruning(Rule):
+    """Insert/narrow Projects so only referenced columns flow up from scans
+    (reference: Optimizer ColumnPruning)."""
+
+    def apply(self, plan):
+        def rule(node):
+            for i, child in enumerate(node.children):
+                needed = self._needed_from_child(node, i)
+                if needed is None:
+                    continue
+                have = [a.expr_id for a in child.output]
+                if set(have) - needed and len(have) > len(set(have) & needed):
+                    keep = [a for a in child.output if a.expr_id in needed]
+                    if not keep:
+                        keep = child.output[:1]
+                    if isinstance(child, Project):
+                        new_child = Project(
+                            [e for e in child.project_list
+                             if _out_id(e) in needed] or child.project_list[:1],
+                            child.child)
+                    elif isinstance(child, (LogicalRelation, LocalRelation,
+                                            Aggregate, SubqueryAlias, Join,
+                                            Filter, Union, Window)):
+                        new_child = Project(keep, child)
+                    else:
+                        continue
+                    kids = list(node.children)
+                    kids[i] = new_child
+                    return node.with_new_children(kids)
+            return node
+
+        # apply top-down so outermost requirements propagate
+        out = plan.transform_down(rule)
+        return _collapse_adjacent_projects(out)
+
+    def _needed_from_child(self, node: LogicalPlan, i: int) -> set[int] | None:
+        if isinstance(node, (Project, Aggregate, Filter, Join, Sort, Window,
+                             Expand, Repartition)):
+            needed: set[int] = set()
+            for e in node.expressions():
+                needed |= e.references()
+            if isinstance(node, (Filter, Sort, Repartition)):
+                # pass-through operators also forward their own output
+                needed |= {a.expr_id for a in node.output}
+            if isinstance(node, Window):
+                needed |= {a.expr_id for a in node.child.output}
+            if isinstance(node, Join):
+                # join forwards both sides' outputs upward; pruning decisions
+                # happen above the join, so require node.output too
+                needed |= {a.expr_id for a in node.output}
+            return needed
+        return None
+
+
+def _out_id(e: Expression) -> int | None:
+    if isinstance(e, Alias):
+        return e.expr_id
+    if isinstance(e, AttributeReference):
+        return e.expr_id
+    return None
+
+
+def _collapse_adjacent_projects(plan: LogicalPlan) -> LogicalPlan:
+    def rule(node):
+        if isinstance(node, Project) and isinstance(node.child, Project):
+            m = alias_map(node.child.project_list)
+            new_list = [substitute_attrs(e, m) if not isinstance(e, Alias)
+                        else Alias(substitute_attrs(e.child, m), e.name, e.expr_id)
+                        for e in node.project_list]
+            return Project(new_list, node.child.child)
+        return node
+
+    return plan.transform_up(rule)
+
+
+class CollapseProjects(Rule):
+    def apply(self, plan):
+        return _collapse_adjacent_projects(plan)
+
+
+class RemoveNoopProject(Rule):
+    def apply(self, plan):
+        def rule(node):
+            if isinstance(node, Project):
+                child_out = node.child.output
+                if len(node.project_list) == len(child_out) and all(
+                        isinstance(e, AttributeReference) and
+                        e.expr_id == a.expr_id and e.name == a.name
+                        for e, a in zip(node.project_list, child_out)):
+                    return node.child
+            return node
+
+        return plan.transform_up(rule)
+
+
+class ReplaceDistinct(Rule):
+    def apply(self, plan):
+        def rule(node):
+            if isinstance(node, Distinct):
+                out = node.child.output
+                return Aggregate(list(out), list(out), node.child)
+            return node
+
+        return plan.transform_up(rule)
+
+
+class EliminateSubqueryAliases(Rule):
+    """Once resolution is done, aliases are noise (reference:
+    EliminateSubqueryAliases runs first in the optimizer)."""
+
+    def apply(self, plan):
+        def rule(node):
+            if isinstance(node, SubqueryAlias):
+                return node.child
+            return node
+
+        return plan.transform_up(rule)
+
+
+class PruneFilters(Rule):
+    def apply(self, plan):
+        def rule(node):
+            if isinstance(node, Filter):
+                c = node.condition
+                if isinstance(c, Literal):
+                    if c.value is True:
+                        return node.child
+                    return LocalRelation(
+                        list(node.output), _empty_table(node.output))
+            return node
+
+        return plan.transform_up(rule)
+
+
+class CombineLimits(Rule):
+    def apply(self, plan):
+        def rule(node):
+            if isinstance(node, Limit) and isinstance(node.child, Limit):
+                return Limit(min(node.n, node.child.n), node.child.child)
+            return node
+
+        return plan.transform_up(rule)
+
+
+def _empty_table(attrs):
+    import pyarrow as pa
+
+    from ..types import to_arrow_type
+
+    return pa.table(
+        {a.name: pa.array([], type=to_arrow_type(a.dtype)) for a in attrs}
+        if attrs else {"__dummy": pa.array([], pa.int32())})
+
+
+class Optimizer(RuleExecutor):
+    def __init__(self):
+        super().__init__()
+
+    def batches(self):
+        return [
+            Batch("Finish analysis", Once(), [
+                EliminateSubqueryAliases(),
+                ReplaceDistinct(),
+            ]),
+            Batch("Operator optimization", FixedPoint(100), [
+                CombineFilters(),
+                PushDownPredicates(),
+                ConstantFolding(),
+                BooleanSimplification(),
+                SimplifyCasts(),
+                PruneFilters(),
+                CombineLimits(),
+                CollapseProjects(),
+                RemoveNoopProject(),
+            ]),
+            Batch("Join hygiene", Once(), [
+                InferFiltersFromJoinKeys(),
+                PushDownPredicates(),
+                CombineFilters(),
+            ]),
+            Batch("Column pruning", FixedPoint(20), [
+                ColumnPruning(),
+                RemoveNoopProject(),
+            ]),
+        ]
