@@ -1,0 +1,11 @@
+"""spark_tpu — a TPU-native large-scale analytics engine with Apache Spark's
+capabilities, built on JAX/XLA (see SURVEY.md for the architecture map against
+the reference)."""
+
+__version__ = "0.1.0"
+
+from .api.session import SparkSession, TpuSession  # noqa: F401
+from .api.dataframe import DataFrame, Row  # noqa: F401
+from .api.column import Column  # noqa: F401
+from .errors import AnalysisException, ParseException, SparkTpuError  # noqa: F401
+from . import types  # noqa: F401

@@ -1,0 +1,360 @@
+"""DataFrame API.
+
+Role of the reference's Dataset (sql/api .../Dataset.scala; classic impl
+sql/core/.../classic/Dataset.scala) / pyspark.sql.DataFrame: a lazy wrapper
+over a logical plan bound to a session.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Sequence
+
+import pyarrow as pa
+
+from ..errors import AnalysisException
+from ..exec.query_execution import QueryExecution
+from ..expr import expressions as E
+from ..plan import logical as L
+from .column import Column, _expr
+
+
+class Row(dict):
+    """Dict-backed row with attribute access (pyspark.sql.Row analog)."""
+
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError as e:
+            raise AttributeError(k) from e
+
+    def __repr__(self):
+        inner = ", ".join(f"{k}={v!r}" for k, v in self.items())
+        return f"Row({inner})"
+
+
+def _to_expr_list(cols, allow_str=True) -> list[E.Expression]:
+    out = []
+    for c in cols:
+        if isinstance(c, Column):
+            out.append(c.expr)
+        elif isinstance(c, E.Expression):
+            out.append(c)
+        elif isinstance(c, str) and allow_str:
+            if c == "*":
+                out.append(E.UnresolvedStar())
+            else:
+                out.append(E.UnresolvedAttribute(c.split(".")))
+        else:
+            out.append(E.Literal(c))
+    return out
+
+
+class DataFrame:
+    def __init__(self, session, plan: L.LogicalPlan):
+        self.session = session
+        self.plan = plan
+        self._qe: QueryExecution | None = None
+
+    # ------------------------------------------------------------------
+    def _with(self, plan: L.LogicalPlan) -> "DataFrame":
+        return DataFrame(self.session, plan)
+
+    @property
+    def query_execution(self) -> QueryExecution:
+        if self._qe is None:
+            self._qe = QueryExecution(self.session, self.plan)
+        return self._qe
+
+    # --- schema -------------------------------------------------------
+    @property
+    def schema(self):
+        return self.query_execution.analyzed.schema()
+
+    @property
+    def columns(self) -> list[str]:
+        return [a.name for a in self.query_execution.analyzed.output]
+
+    @property
+    def dtypes(self) -> list[tuple[str, str]]:
+        return [(f.name, f.dataType.simple_string()) for f in self.schema]
+
+    def printSchema(self) -> None:
+        for f in self.schema:
+            print(f" |-- {f.name}: {f.dataType.simple_string()} "
+                  f"(nullable = {str(f.nullable).lower()})")
+
+    # --- transformations ----------------------------------------------
+    def select(self, *cols) -> "DataFrame":
+        if not cols:
+            cols = ("*",)
+        return self._with(L.Project(_to_expr_list(cols), self.plan))
+
+    def selectExpr(self, *exprs: str) -> "DataFrame":
+        from ..sql.parser import parse_expression
+
+        return self._with(L.Project(
+            [parse_expression(e) for e in exprs], self.plan))
+
+    def filter(self, condition) -> "DataFrame":
+        if isinstance(condition, str):
+            from ..sql.parser import parse_expression
+
+            cond = parse_expression(condition)
+        else:
+            cond = _expr(condition)
+        return self._with(L.Filter(cond, self.plan))
+
+    where = filter
+
+    def withColumn(self, name: str, col: Column) -> "DataFrame":
+        exprs: list[E.Expression] = []
+        replaced = False
+        for a in self.query_execution.analyzed.output:
+            if a.name == name:
+                exprs.append(E.Alias(_expr(col), name))
+                replaced = True
+            else:
+                exprs.append(a)
+        if not replaced:
+            exprs.append(E.Alias(_expr(col), name))
+        return self._with(L.Project(exprs, self.plan))
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        exprs = []
+        for a in self.query_execution.analyzed.output:
+            if a.name == old:
+                exprs.append(E.Alias(a, new))
+            else:
+                exprs.append(a)
+        return self._with(L.Project(exprs, self.plan))
+
+    def drop(self, *names: str) -> "DataFrame":
+        keep = [a for a in self.query_execution.analyzed.output
+                if a.name not in names]
+        return self._with(L.Project(keep, self.plan))
+
+    def alias(self, alias: str) -> "DataFrame":
+        return self._with(L.SubqueryAlias(alias, self.plan))
+
+    def distinct(self) -> "DataFrame":
+        return self._with(L.Distinct(self.plan))
+
+    def dropDuplicates(self, subset: Sequence[str] | None = None) -> "DataFrame":
+        if subset is None:
+            return self.distinct()
+        group = _to_expr_list(subset)
+        out = []
+        names = set(subset)
+        for a in self.query_execution.analyzed.output:
+            if a.name in names:
+                out.append(a)
+            else:
+                out.append(E.Alias(E.First(a), a.name))
+        return self._with(L.Aggregate(group, out, self.plan))
+
+    def limit(self, n: int) -> "DataFrame":
+        return self._with(L.Limit(n, self.plan))
+
+    def offset(self, n: int) -> "DataFrame":
+        return self._with(L.Offset(n, self.plan))
+
+    def sort(self, *cols, ascending=None) -> "DataFrame":
+        orders = []
+        exprs = _to_expr_list(cols)
+        if ascending is None:
+            asc_list = [True] * len(exprs)
+        elif isinstance(ascending, bool):
+            asc_list = [ascending] * len(exprs)
+        else:
+            asc_list = list(ascending)
+        for e, a in zip(exprs, asc_list):
+            if isinstance(e, E.SortOrder):
+                orders.append(e)
+            else:
+                orders.append(E.SortOrder(e, a))
+        return self._with(L.Sort(orders, True, self.plan))
+
+    orderBy = sort
+
+    def sortWithinPartitions(self, *cols) -> "DataFrame":
+        exprs = _to_expr_list(cols)
+        orders = [e if isinstance(e, E.SortOrder) else E.SortOrder(e, True)
+                  for e in exprs]
+        return self._with(L.Sort(orders, False, self.plan))
+
+    def repartition(self, num_or_col, *cols) -> "DataFrame":
+        if isinstance(num_or_col, int):
+            exprs = _to_expr_list(cols)
+            return self._with(L.Repartition(num_or_col, True, exprs, self.plan))
+        exprs = _to_expr_list((num_or_col,) + cols)
+        return self._with(L.Repartition(None, True, exprs, self.plan))
+
+    def coalesce(self, n: int) -> "DataFrame":
+        return self._with(L.Repartition(n, False, [], self.plan))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return self._with(L.Union([self.plan, other.plan]))
+
+    unionAll = union
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner") -> "DataFrame":
+        cond = None
+        if on is not None:
+            if isinstance(on, Column):
+                cond = on.expr
+            elif isinstance(on, str):
+                on = [on]
+            if isinstance(on, (list, tuple)):
+                conds = None
+                for name in on:
+                    c = E.EqualTo(
+                        _resolve_using(self, name),
+                        _resolve_using(other, name))
+                    conds = c if conds is None else E.And(conds, c)
+                cond = conds
+                # USING semantics: output merges the key columns
+                joined = L.Join(self.plan, other.plan, how, cond)
+                df = self._with(joined)
+                drop_ids = {_resolve_using(other, name).expr_id for name in on}
+                keep = [a for a in df.query_execution.analyzed.output
+                        if a.expr_id not in drop_ids]
+                return df._with(L.Project(
+                    keep, df.query_execution.analyzed))
+        return self._with(L.Join(self.plan, other.plan, how, cond))
+
+    def crossJoin(self, other: "DataFrame") -> "DataFrame":
+        return self._with(L.Join(self.plan, other.plan, "cross", None))
+
+    def groupBy(self, *cols) -> "GroupedData":
+        return GroupedData(self, _to_expr_list(cols))
+
+    groupby = groupBy
+
+    def agg(self, *cols) -> "DataFrame":
+        return GroupedData(self, []).agg(*cols)
+
+    def sample(self, fraction: float, seed: int = 42) -> "DataFrame":
+        return self._with(L.Sample(fraction, seed, self.plan))
+
+    # --- actions -------------------------------------------------------
+    def toArrow(self) -> pa.Table:
+        return self.query_execution.to_arrow()
+
+    def toPandas(self):
+        return self.toArrow().to_pandas()
+
+    def collect(self) -> list[Row]:
+        t = self.toArrow()
+        return [Row(zip(t.column_names, vals))
+                for vals in zip(*[c.to_pylist() for c in t.columns])] \
+            if t.num_columns else []
+
+    def count(self) -> int:
+        agg = L.Aggregate([], [E.Alias(E.Count(None), "count")], self.plan)
+        t = QueryExecution(self.session, agg).to_arrow()
+        return int(t.column(0)[0].as_py())
+
+    def first(self) -> Row | None:
+        rows = self.limit(1).collect()
+        return rows[0] if rows else None
+
+    def head(self, n: int = 1):
+        rows = self.limit(n).collect()
+        return rows[0] if n == 1 and rows else rows
+
+    def take(self, n: int) -> list[Row]:
+        return self.limit(n).collect()
+
+    def isEmpty(self) -> bool:
+        return len(self.take(1)) == 0
+
+    def show(self, n: int = 20, truncate: bool = True) -> None:
+        t = self.limit(n).toArrow()
+        names = t.column_names
+        rows = [[_fmt(v, truncate) for v in col.to_pylist()]
+                for col in t.columns]
+        widths = [max([len(nm)] + [len(r[i]) for i in range(len(r))])
+                  for nm, r in zip(names, rows)] if t.num_rows else \
+                 [len(nm) for nm in names]
+        sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+        print(sep)
+        print("|" + "|".join(f" {nm:<{w}} " for nm, w in zip(names, widths)) + "|")
+        print(sep)
+        for ri in range(t.num_rows):
+            print("|" + "|".join(
+                f" {rows[ci][ri]:<{widths[ci]}} " for ci in range(len(names)))
+                + "|")
+        print(sep)
+
+    def explain(self, mode: str = "formatted") -> None:
+        print(self.query_execution.explain_string(mode))
+
+    def createOrReplaceTempView(self, name: str) -> None:
+        self.session.catalog_.register(name, self.plan)
+
+    def cache(self) -> "DataFrame":
+        return self.session._cache_df(self)
+
+    persist = cache
+
+    def unpersist(self) -> "DataFrame":
+        return self.session._uncache_df(self)
+
+    def write_parquet(self, path: str) -> None:
+        import pyarrow.parquet as pq
+
+        pq.write_table(self.toArrow(), path)
+
+    @property
+    def write(self):
+        from .readwriter import DataFrameWriter
+
+        return DataFrameWriter(self)
+
+
+def _fmt(v, truncate: bool) -> str:
+    s = "NULL" if v is None else str(v)
+    if truncate and len(s) > 20:
+        s = s[:17] + "..."
+    return s
+
+
+def _resolve_using(df: DataFrame, name: str) -> E.AttributeReference:
+    for a in df.query_execution.analyzed.output:
+        if a.name.lower() == name.lower():
+            return a
+    raise AnalysisException(f"USING column {name} not found")
+
+
+class GroupedData:
+    """Role of RelationalGroupedDataset."""
+
+    def __init__(self, df: DataFrame, grouping: list[E.Expression]):
+        self.df = df
+        self.grouping = grouping
+
+    def agg(self, *cols) -> DataFrame:
+        aggs = _to_expr_list(cols, allow_str=False)
+        out = list(self.grouping) + aggs
+        return self.df._with(L.Aggregate(self.grouping, out, self.df.plan))
+
+    def count(self) -> DataFrame:
+        return self.agg(Column(E.Alias(E.Count(None), "count")))
+
+    def sum(self, *names: str) -> DataFrame:  # noqa: A003
+        return self.agg(*[Column(E.Sum(E.UnresolvedAttribute([n])))
+                          for n in names])
+
+    def avg(self, *names: str) -> DataFrame:
+        return self.agg(*[Column(E.Average(E.UnresolvedAttribute([n])))
+                          for n in names])
+
+    mean = avg
+
+    def min(self, *names: str) -> DataFrame:  # noqa: A003
+        return self.agg(*[Column(E.Min(E.UnresolvedAttribute([n])))
+                          for n in names])
+
+    def max(self, *names: str) -> DataFrame:  # noqa: A003
+        return self.agg(*[Column(E.Max(E.UnresolvedAttribute([n])))
+                          for n in names])

@@ -1,0 +1,295 @@
+"""pyspark.sql.functions-compatible function namespace.
+
+Role of the reference's sql/api functions.scala / python/pyspark/sql/functions.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..expr import expressions as E
+from .column import Column, _expr
+
+
+def col(name: str) -> Column:
+    if name == "*":
+        return Column(E.UnresolvedStar())
+    return Column(E.UnresolvedAttribute(name.split(".")))
+
+
+column = col
+
+
+def lit(v: Any) -> Column:
+    if isinstance(v, Column):
+        return v
+    return Column(E.Literal(v))
+
+
+def expr(sql_text: str) -> Column:
+    from ..sql.parser import parse_expression
+
+    return Column(parse_expression(sql_text))
+
+
+def _c(v) -> E.Expression:
+    if isinstance(v, str):
+        return E.UnresolvedAttribute(v.split("."))
+    return _expr(v)
+
+
+# --- aggregates -------------------------------------------------------------
+
+def sum(c) -> Column:  # noqa: A001
+    return Column(E.Sum(_c(c)))
+
+
+def count(c) -> Column:
+    e = _c(c)
+    if isinstance(e, E.UnresolvedAttribute) and e.name == "*":
+        e = None
+    if isinstance(e, E.UnresolvedStar):
+        e = None
+    return Column(E.Count(e))
+
+
+def countDistinct(c) -> Column:
+    return Column(E.Count(_c(c), distinct=True))
+
+
+count_distinct = countDistinct
+
+
+def avg(c) -> Column:
+    return Column(E.Average(_c(c)))
+
+
+mean = avg
+
+
+def min(c) -> Column:  # noqa: A001
+    return Column(E.Min(_c(c)))
+
+
+def max(c) -> Column:  # noqa: A001
+    return Column(E.Max(_c(c)))
+
+
+def first(c, ignorenulls: bool = True) -> Column:
+    return Column(E.First(_c(c), ignorenulls))
+
+
+def any_value(c) -> Column:
+    return Column(E.AnyValue(_c(c)))
+
+
+def stddev(c) -> Column:
+    return Column(E.StddevSamp(_c(c)))
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(c) -> Column:
+    return Column(E.StddevPop(_c(c)))
+
+
+def variance(c) -> Column:
+    return Column(E.VarianceSamp(_c(c)))
+
+
+var_samp = variance
+
+
+def var_pop(c) -> Column:
+    return Column(E.VariancePop(_c(c)))
+
+
+# --- conditionals -----------------------------------------------------------
+
+def when(cond: Column, value) -> Column:
+    return Column(E.CaseWhen([(cond.expr, _expr(value))], None))
+
+
+def coalesce(*cols) -> Column:
+    return Column(E.Coalesce([_c(c) for c in cols]))
+
+
+def isnull(c) -> Column:
+    return Column(E.IsNull(_c(c)))
+
+
+def isnan(c) -> Column:
+    return Column(E.IsNaN(_c(c)))
+
+
+def greatest(*cols) -> Column:
+    return Column(E.Greatest([_c(c) for c in cols]))
+
+
+def least(*cols) -> Column:
+    return Column(E.Least([_c(c) for c in cols]))
+
+
+def nanvl(a, b) -> Column:
+    return Column(E.If(E.IsNaN(_c(a)), _c(b), _c(a)))
+
+
+# --- math -------------------------------------------------------------------
+
+def abs(c) -> Column:  # noqa: A001
+    return Column(E.Abs(_c(c)))
+
+
+def sqrt(c) -> Column:
+    return Column(E.Sqrt(_c(c)))
+
+
+def exp(c) -> Column:
+    return Column(E.Exp(_c(c)))
+
+
+def log(c) -> Column:
+    return Column(E.Log(_c(c)))
+
+
+def log10(c) -> Column:
+    return Column(E.Log10(_c(c)))
+
+
+def floor(c) -> Column:
+    return Column(E.Floor(_c(c)))
+
+
+def ceil(c) -> Column:
+    return Column(E.Ceil(_c(c)))
+
+
+def round(c, scale: int = 0) -> Column:  # noqa: A001
+    return Column(E.Round(_c(c), E.Literal(scale)))
+
+
+def pow(a, b) -> Column:  # noqa: A001
+    return Column(E.Pow(_c(a), _c(b)))
+
+
+def negative(c) -> Column:
+    return Column(E.UnaryMinus(_c(c)))
+
+
+# --- strings ----------------------------------------------------------------
+
+def upper(c) -> Column:
+    return Column(E.Upper(_c(c)))
+
+
+def lower(c) -> Column:
+    return Column(E.Lower(_c(c)))
+
+
+def trim(c) -> Column:
+    return Column(E.Trim(_c(c)))
+
+
+def ltrim(c) -> Column:
+    return Column(E.LTrim(_c(c)))
+
+
+def rtrim(c) -> Column:
+    return Column(E.RTrim(_c(c)))
+
+
+def length(c) -> Column:
+    return Column(E.Length(_c(c)))
+
+
+def substring(c, pos: int, length: int) -> Column:
+    return Column(E.Substring(_c(c), E.Literal(pos), E.Literal(length)))
+
+
+def concat(*cols) -> Column:
+    return Column(E.Concat([_c(c) for c in cols]))
+
+
+def lpad(c, length: int, pad: str = " ") -> Column:
+    return Column(E.Lpad(_c(c), E.Literal(length), E.Literal(pad)))
+
+
+def rpad(c, length: int, pad: str = " ") -> Column:
+    return Column(E.Rpad(_c(c), E.Literal(length), E.Literal(pad)))
+
+
+def regexp_replace(c, pattern: str, replacement: str) -> Column:
+    import re as _re
+
+    class _RR(E._DictTransform):
+        def transform(self, s, _p=pattern, _r=replacement):
+            return _re.sub(_p, _r, s)
+
+    return Column(_RR(_c(c)))
+
+
+# --- datetime ---------------------------------------------------------------
+
+def year(c) -> Column:
+    return Column(E.Year(_c(c)))
+
+
+def month(c) -> Column:
+    return Column(E.Month(_c(c)))
+
+
+def dayofmonth(c) -> Column:
+    return Column(E.DayOfMonth(_c(c)))
+
+
+def quarter(c) -> Column:
+    return Column(E.Quarter(_c(c)))
+
+
+def dayofweek(c) -> Column:
+    return Column(E.DayOfWeek(_c(c)))
+
+
+def dayofyear(c) -> Column:
+    return Column(E.DayOfYear(_c(c)))
+
+
+def weekofyear(c) -> Column:
+    return Column(E.WeekOfYear(_c(c)))
+
+
+def date_add(c, days) -> Column:
+    return Column(E.DateAdd(_c(c), _c(days)))
+
+
+def date_sub(c, days) -> Column:
+    return Column(E.DateSub(_c(c), _c(days)))
+
+
+def datediff(end, start) -> Column:
+    return Column(E.DateDiff(_c(end), _c(start)))
+
+
+def trunc(c, fmt: str) -> Column:
+    return Column(E.TruncDate(_c(c), fmt))
+
+
+def make_date(y, m, d) -> Column:
+    return Column(E.MakeDate(_c(y), _c(m), _c(d)))
+
+
+def to_date(c, fmt: str | None = None) -> Column:
+    from ..types import date as _date
+
+    return Column(E.Cast(_c(c), _date))
+
+
+# --- sort helpers -----------------------------------------------------------
+
+def asc(c) -> Column:
+    return Column(E.SortOrder(_c(c), True))
+
+
+def desc(c) -> Column:
+    return Column(E.SortOrder(_c(c), False))

@@ -1,0 +1,141 @@
+"""DataFrameReader / DataFrameWriter.
+
+Role of the reference's DataFrameReader/Writer
+(sql/api .../DataFrameReader.scala, sqlx/datasources/DataSource resolution).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import pyarrow as pa
+
+from ..errors import AnalysisException
+from ..io.sources import CSVSource, DataSource, JSONSource, ParquetSource
+from ..plan.logical import LogicalRelation
+from ..expr.expressions import AttributeReference
+
+
+class DataFrameReader:
+    def __init__(self, session):
+        self.session = session
+        self._options: dict[str, Any] = {}
+        self._format = "parquet"
+        self._schema = None
+
+    def format(self, fmt: str) -> "DataFrameReader":  # noqa: A003
+        self._format = fmt
+        return self
+
+    def option(self, k: str, v) -> "DataFrameReader":
+        self._options[k] = v
+        return self
+
+    def options(self, **kw) -> "DataFrameReader":
+        self._options.update(kw)
+        return self
+
+    def schema(self, s) -> "DataFrameReader":
+        self._schema = s
+        return self
+
+    def _df(self, source: DataSource, name: str):
+        from .dataframe import DataFrame
+
+        attrs = [AttributeReference(f.name, f.dataType, f.nullable)
+                 for f in source.schema.fields]
+        return DataFrame(self.session, LogicalRelation(source, attrs, name))
+
+    def parquet(self, path: str):
+        return self._df(ParquetSource(path), os.path.basename(path))
+
+    def csv(self, path: str, header: bool | None = None, **kw):
+        h = self._options.get("header", True if header is None else header)
+        if isinstance(h, str):
+            h = h.lower() == "true"
+        sep = self._options.get("sep", self._options.get("delimiter", ","))
+        return self._df(CSVSource(path, header=h, schema=self._schema,
+                                  delimiter=sep),
+                        os.path.basename(path))
+
+    def json(self, path: str):
+        return self._df(JSONSource(path), os.path.basename(path))
+
+    def table(self, name: str):
+        return self.session.table(name)
+
+    def load(self, path: str):
+        fmt = self._format.lower()
+        if fmt == "parquet":
+            return self.parquet(path)
+        if fmt == "csv":
+            return self.csv(path)
+        if fmt == "json":
+            return self.json(path)
+        raise AnalysisException(f"unknown format {fmt}")
+
+
+class DataFrameWriter:
+    def __init__(self, df):
+        self.df = df
+        self._mode = "errorifexists"
+        self._format = "parquet"
+        self._options: dict[str, Any] = {}
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        self._mode = m.lower()
+        return self
+
+    def format(self, fmt: str) -> "DataFrameWriter":  # noqa: A003
+        self._format = fmt
+        return self
+
+    def option(self, k, v) -> "DataFrameWriter":
+        self._options[k] = v
+        return self
+
+    def _check(self, path: str):
+        if os.path.exists(path):
+            if self._mode in ("error", "errorifexists"):
+                raise AnalysisException(f"path {path} already exists")
+            if self._mode == "ignore":
+                return False
+            if self._mode == "overwrite":
+                import shutil
+
+                if os.path.isdir(path):
+                    shutil.rmtree(path)
+                else:
+                    os.remove(path)
+        return True
+
+    def parquet(self, path: str) -> None:
+        import pyarrow.parquet as pq
+
+        if not self._check(path):
+            return
+        pq.write_table(self.df.toArrow(), path)
+
+    def csv(self, path: str) -> None:
+        import pyarrow.csv as pacsv
+
+        if not self._check(path):
+            return
+        pacsv.write_csv(self.df.toArrow(), path)
+
+    def json(self, path: str) -> None:
+        if not self._check(path):
+            return
+        import json as _json
+
+        t = self.df.toArrow()
+        with open(path, "w") as f:
+            for row in t.to_pylist():
+                f.write(_json.dumps(row, default=str) + "\n")
+
+    def saveAsTable(self, name: str) -> None:
+        self.df.createOrReplaceTempView(name)
+
+    def save(self, path: str) -> None:
+        getattr(self, self._format)(path)

@@ -1,0 +1,235 @@
+"""TpuSession — the SparkSession equivalent.
+
+Role of the reference's SparkSession (sql/api .../SparkSession.scala; classic
+impl sql/core/.../classic/SparkSession.scala) + the SparkContext/SparkEnv
+bootstrap (core/SparkContext.scala, core/SparkEnv.scala:587): wires conf,
+catalog, analyzer, optimizer, planner, and the JAX device runtime.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from ..config import SQLConf
+from ..exec.context import Metrics
+from ..plan.analyzer import Analyzer
+from ..plan.catalog import Catalog
+from ..plan.logical import LocalRelation, RangeRelation
+from ..plan.optimizer import Optimizer
+from ..expr.expressions import AttributeReference
+from ..types import StructType, from_arrow_type, int64
+
+_jax_initialized = False
+_init_lock = threading.Lock()
+
+
+def _init_jax():
+    """Enable x64 (int64 sums/hashes; XLA emulates on TPU with int32 pairs —
+    SURVEY.md §7 'Hard parts' (6)) exactly once, before any tracing."""
+    global _jax_initialized
+    with _init_lock:
+        if _jax_initialized:
+            return
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+        _jax_initialized = True
+
+
+class SessionBuilder:
+    def __init__(self):
+        self._conf: dict[str, Any] = {}
+        self._name = "spark-tpu"
+
+    def appName(self, name: str) -> "SessionBuilder":
+        self._name = name
+        return self
+
+    def master(self, master: str) -> "SessionBuilder":
+        # accepted for API compatibility; local[n] sets default parallelism
+        if master.startswith("local[") and master.endswith("]"):
+            n = master[6:-1]
+            if n != "*":
+                self._conf["spark.default.parallelism"] = int(n)
+        return self
+
+    def config(self, key=None, value=None, **kw) -> "SessionBuilder":
+        if key is not None:
+            self._conf[key] = value
+        self._conf.update(kw)
+        return self
+
+    def getOrCreate(self) -> "TpuSession":
+        if TpuSession._active is not None:
+            for k, v in self._conf.items():
+                TpuSession._active.conf.set(k, v)
+            return TpuSession._active
+        return TpuSession(self._name, self._conf)
+
+
+class TpuSession:
+    _active: "TpuSession | None" = None
+
+    builder = None  # replaced below by property-like helper
+
+    def __init__(self, name: str = "spark-tpu",
+                 conf: dict[str, Any] | None = None):
+        _init_jax()
+        self.name = name
+        self.conf = SQLConf(conf)
+        self.catalog_ = Catalog(self.conf.case_sensitive)
+        self._analyzer = Analyzer(self.catalog_, self.conf.case_sensitive)
+        self._optimizer = Optimizer()
+        self._metrics = Metrics()
+        self._cached: dict[int, Any] = {}
+        TpuSession._active = self
+
+    # ------------------------------------------------------------------
+    def _planner(self):
+        from ..physical.planner import Planner
+
+        return Planner(self.conf)
+
+    # ------------------------------------------------------------------
+    @property
+    def read(self):
+        from .readwriter import DataFrameReader
+
+        return DataFrameReader(self)
+
+    def table(self, name: str):
+        from .dataframe import DataFrame
+        from ..plan.logical import UnresolvedRelation
+
+        return DataFrame(self, UnresolvedRelation(name.split(".")))
+
+    def sql(self, query: str, **kwargs):
+        from ..sql.parser import parse_sql
+        from .dataframe import DataFrame
+
+        plan = parse_sql(query)
+        return DataFrame(self, plan)
+
+    def range(self, start: int, end: int | None = None, step: int = 1,
+              numPartitions: int | None = None):
+        from .dataframe import DataFrame
+
+        if end is None:
+            start, end = 0, start
+        n = numPartitions or int(self.conf.get("spark.default.parallelism", 8))
+        return DataFrame(self, RangeRelation(start, end, step, n))
+
+    def createDataFrame(self, data, schema=None):
+        from .dataframe import DataFrame
+
+        table = _to_arrow_table(data, schema)
+        attrs = [AttributeReference(f.name, from_arrow_type(f.type),
+                                    f.nullable)
+                 for f in table.schema]
+        return DataFrame(self, LocalRelation(attrs, table))
+
+    # ------------------------------------------------------------------
+    @property
+    def catalog(self):
+        return _CatalogApi(self)
+
+    def stop(self) -> None:
+        if TpuSession._active is self:
+            TpuSession._active = None
+
+    def _cache_df(self, df):
+        # materialize once and swap in a LocalRelation (role of CacheManager,
+        # sqlx/columnar/CacheManager.scala) — columnar batches are the cache
+        table = df.toArrow()
+        attrs = list(df.query_execution.analyzed.output)
+        cached = DataFrameFromCache(self, LocalRelation(attrs, table))
+        self._cached[id(df)] = cached
+        return cached
+
+    def _uncache_df(self, df):
+        self._cached.pop(id(df), None)
+        return df
+
+    def version(self) -> str:
+        from .. import __version__
+
+        return __version__
+
+
+class DataFrameFromCache:
+    def __new__(cls, session, plan):
+        from .dataframe import DataFrame
+
+        return DataFrame(session, plan)
+
+
+class _CatalogApi:
+    def __init__(self, session: TpuSession):
+        self.s = session
+
+    def listTables(self):
+        return self.s.catalog_.list_tables()
+
+    def dropTempView(self, name: str) -> bool:
+        return self.s.catalog_.drop(name)
+
+    def tableExists(self, name: str) -> bool:
+        try:
+            self.s.catalog_.lookup(name.split("."))
+            return True
+        except Exception:
+            return False
+
+
+def _to_arrow_table(data, schema) -> pa.Table:
+    from ..types import StructType as ST, to_arrow_type
+
+    if isinstance(data, pa.Table):
+        return data
+    try:
+        import pandas as pd
+
+        if isinstance(data, pd.DataFrame):
+            return pa.Table.from_pandas(data, preserve_index=False)
+    except ImportError:
+        pass
+    if isinstance(data, dict):
+        return pa.table(data)
+    if isinstance(data, (list, tuple)):
+        if not data:
+            raise ValueError("cannot infer schema from empty data")
+        first = data[0]
+        if isinstance(first, dict):
+            names = list(first.keys())
+            cols = {n: [r.get(n) for r in data] for n in names}
+            return pa.table(cols)
+        if isinstance(first, (list, tuple)):
+            if schema is None:
+                raise ValueError("schema required for list-of-tuples")
+            if isinstance(schema, ST):
+                names = schema.names
+                arrays = []
+                for i, f in enumerate(schema.fields):
+                    arrays.append(pa.array([r[i] for r in data],
+                                           type=to_arrow_type(f.dataType)))
+                return pa.table(arrays, names=names)
+            names = list(schema)
+            cols = {n: [r[i] for r in data] for i, n in enumerate(names)}
+            return pa.table(cols)
+    raise TypeError(f"cannot create DataFrame from {type(data)}")
+
+
+class _Builder:
+    def __get__(self, obj, objtype=None):
+        return SessionBuilder()
+
+
+TpuSession.builder = _Builder()
+
+# Spark-compatible alias
+SparkSession = TpuSession

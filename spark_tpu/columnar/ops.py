@@ -1,0 +1,142 @@
+"""Batch-level structural operations: concat, gather, compact, dict unification.
+
+These are the host-orchestrated (but device-executed) glue between kernels —
+the role the reference's UnsafeRow copy/serialize plumbing plays between
+Tungsten operators.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..types import StringType, StructType
+from .batch import Column, ColumnarBatch, StringDict, bucket_capacity
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def unify_string_columns(cols: Sequence[Column]) -> tuple[StringDict, list]:
+    """Merge the dictionaries of string columns; returns (merged dict,
+    per-column recoded code arrays)."""
+    jnp = _jnp()
+    merged: list[str] = []
+    idx: dict[str, int] = {}
+    recoded = []
+    for c in cols:
+        sd = c.dictionary or StringDict([""])
+        lut = np.zeros(max(len(sd.values), 1), dtype=np.int32)
+        for i, v in enumerate(sd.values or [""]):
+            j = idx.get(v)
+            if j is None:
+                j = len(merged)
+                merged.append(v)
+                idx[v] = j
+            lut[i] = j
+        if len(sd.values) and list(lut) == list(range(len(sd.values))) and not recoded:
+            pass
+        recoded.append(jnp.take(jnp.asarray(lut),
+                                jnp.clip(c.data, 0, lut.shape[0] - 1)))
+    return StringDict(merged or [""]), recoded
+
+
+def concat_batches(batches: Sequence[ColumnarBatch],
+                   schema: StructType | None = None) -> ColumnarBatch:
+    """Concatenate batches (same schema) into one larger-capacity batch.
+    String columns get a unified dictionary."""
+    jnp = _jnp()
+    assert batches
+    if len(batches) == 1:
+        return batches[0]
+    schema = schema or batches[0].schema
+    total_cap = sum(b.capacity for b in batches)
+    cap = bucket_capacity(total_cap)
+    ncols = len(schema.fields)
+
+    cols: list[Column] = []
+    for i, f in enumerate(schema.fields):
+        parts = [b.columns[i] for b in batches]
+        if isinstance(f.dataType, StringType):
+            sd, datas = unify_string_columns(parts)
+        else:
+            sd = None
+            datas = [p.data for p in parts]
+        data = jnp.concatenate(datas)
+        if data.shape[0] < cap:
+            data = jnp.concatenate(
+                [data, jnp.zeros(cap - data.shape[0], dtype=data.dtype)])
+        any_valid = any(p.validity is not None for p in parts)
+        validity = None
+        if any_valid:
+            vs = [p.validity if p.validity is not None
+                  else jnp.ones(p.data.shape[0], dtype=bool) for p in parts]
+            validity = jnp.concatenate(vs)
+            if validity.shape[0] < cap:
+                validity = jnp.concatenate(
+                    [validity, jnp.zeros(cap - validity.shape[0], dtype=bool)])
+        cols.append(Column(f.dataType, data, validity, sd))
+
+    masks = [b.row_mask for b in batches]
+    mask = jnp.concatenate(masks)
+    if mask.shape[0] < cap:
+        mask = jnp.concatenate([mask, jnp.zeros(cap - mask.shape[0], dtype=bool)])
+    nrows = None
+    if all(b._num_rows is not None for b in batches):
+        nrows = sum(b._num_rows for b in batches)
+    return ColumnarBatch(schema, cols, mask, num_rows=nrows)
+
+
+def gather_batch(batch: ColumnarBatch, indices, out_mask,
+                 schema: StructType | None = None,
+                 extra_invalid=None) -> ColumnarBatch:
+    """Row-gather a batch by device `indices` (int32[out_cap]) with live-row
+    `out_mask`. `extra_invalid`: bool[out_cap] marking rows whose gathered
+    values must read as NULL (outer-join null extension)."""
+    jnp = _jnp()
+    schema = schema or batch.schema
+    cols = []
+    for f, c in zip(schema.fields, batch.columns):
+        data = jnp.take(c.data, indices)
+        validity = None if c.validity is None else jnp.take(c.validity, indices)
+        if extra_invalid is not None:
+            base = validity if validity is not None \
+                else jnp.ones(indices.shape[0], dtype=bool)
+            validity = base & ~extra_invalid
+        cols.append(Column(f.dataType, data, validity, c.dictionary))
+    return ColumnarBatch(schema, cols, out_mask, num_rows=None)
+
+
+def compact_batch(batch: ColumnarBatch, target_capacity: int | None = None
+                  ) -> ColumnarBatch:
+    """Drop dead rows: permute live rows to the front and slice to a smaller
+    capacity bucket. Host-syncs the live count."""
+    jnp = _jnp()
+    n = batch.num_rows()
+    cap = target_capacity or bucket_capacity(max(n, 1))
+    if cap >= batch.capacity:
+        return batch
+    perm = jnp.argsort(~batch.row_mask, stable=True)[:cap].astype(jnp.int32)
+    cols = []
+    for c in batch.columns:
+        data = jnp.take(c.data, perm)
+        validity = None if c.validity is None else jnp.take(c.validity, perm)
+        cols.append(Column(c.dtype, data, validity, c.dictionary))
+    mask = jnp.arange(cap) < n
+    return ColumnarBatch(batch.schema, cols, mask, num_rows=n)
+
+
+def slice_to_numpy(batch: ColumnarBatch) -> dict:
+    """Pull a batch to host as raw representation (codes stay codes).
+    Returns {"schema", "columns": [(data, validity, dict)], "mask"}."""
+    cols = []
+    for c in batch.columns:
+        cols.append((np.asarray(c.data),
+                     None if c.validity is None else np.asarray(c.validity),
+                     c.dictionary))
+    return {"schema": batch.schema, "columns": cols,
+            "mask": np.asarray(batch.row_mask)}

@@ -1,0 +1,55 @@
+"""Execution context shared across a query run.
+
+Role of the reference's TaskContext + SQLMetrics plumbing (core/TaskContext,
+sqlx/metric/SQLMetrics.scala:35): carries session conf and accumulates
+per-operator metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+from ..config import SQLConf
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counters: dict[str, int] = defaultdict(int)
+        self.timers: dict[str, float] = defaultdict(float)
+
+    def add(self, name: str, v: int = 1) -> None:
+        with self._lock:
+            self.counters[name] += v
+
+    def time(self, name: str):
+        return _Timer(self, name)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"counters": dict(self.counters),
+                    "timers": dict(self.timers)}
+
+
+class _Timer:
+    def __init__(self, m: Metrics, name: str):
+        self.m = m
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        with self.m._lock:
+            self.m.timers[self.name] += time.perf_counter() - self.t0
+        return False
+
+
+@dataclass
+class ExecContext:
+    conf: SQLConf = field(default_factory=SQLConf)
+    metrics: Metrics = field(default_factory=Metrics)

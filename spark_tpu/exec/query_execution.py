@@ -1,0 +1,80 @@
+"""QueryExecution: the lazy phase pipeline.
+
+Role of the reference's QueryExecution (sqlx/QueryExecution.scala —
+lazyAnalyzed:192 → withCachedData → lazyOptimizedPlan:311 → lazySparkPlan:335
+→ lazyExecutedPlan:353 → toRdd), with a QueryPlanningTracker-style per-phase
+timing record (sqlcat/QueryPlanningTracker.scala).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import cached_property
+
+import pyarrow as pa
+
+from ..columnar.ops import concat_batches
+from ..config import MAX_RESULT_ROWS
+from ..exec.context import ExecContext
+from ..plan.logical import LogicalPlan
+from ..physical.operators import PhysicalPlan, attrs_schema
+
+
+class QueryExecution:
+    def __init__(self, session, logical: LogicalPlan):
+        self.session = session
+        self.logical = logical
+        self.phase_times: dict[str, float] = {}
+
+    def _timed(self, name: str, fn):
+        t0 = time.perf_counter()
+        out = fn()
+        self.phase_times[name] = time.perf_counter() - t0
+        return out
+
+    @cached_property
+    def analyzed(self) -> LogicalPlan:
+        return self._timed("analysis",
+                           lambda: self.session._analyzer.execute(self.logical))
+
+    @cached_property
+    def optimized(self) -> LogicalPlan:
+        analyzed = self.analyzed
+        return self._timed("optimization",
+                           lambda: self.session._optimizer.execute(analyzed))
+
+    @cached_property
+    def physical(self) -> PhysicalPlan:
+        optimized = self.optimized
+        return self._timed("planning",
+                           lambda: self.session._planner().plan(optimized))
+
+    def execute(self) -> list:
+        plan = self.physical
+        ctx = ExecContext(conf=self.session.conf,
+                          metrics=self.session._metrics)
+        return self._timed("execution", lambda: plan.execute(ctx))
+
+    def to_arrow(self) -> pa.Table:
+        parts = self.execute()
+        batches = [b for p in parts for b in p]
+        schema = attrs_schema(self.physical.output)
+        if not batches:
+            from ..columnar.batch import ColumnarBatch
+
+            batches = [ColumnarBatch.empty(schema)]
+        tables = [b.to_arrow() for b in batches]
+        out = pa.concat_tables(tables, promote_options="permissive")
+        limit = int(self.session.conf.get(MAX_RESULT_ROWS))
+        if out.num_rows > limit:
+            raise RuntimeError(
+                f"result has {out.num_rows} rows > spark.tpu.collect.maxRows")
+        return out
+
+    def explain_string(self, mode: str = "formatted") -> str:
+        parts = [
+            "== Analyzed Logical Plan ==", self.analyzed.tree_string(),
+            "== Optimized Logical Plan ==", self.optimized.tree_string(),
+            "== Physical Plan ==", self.physical.tree_string(),
+        ]
+        return "\n".join(parts)

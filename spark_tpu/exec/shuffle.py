@@ -1,0 +1,254 @@
+"""Shuffle: redistribute rows across partitions.
+
+Role of the reference's sort-based shuffle stack — ShuffleExchangeExec
+partition-id computation (sqlx/exchange/ShuffleExchangeExec.scala:344),
+SortShuffleManager write paths (core/shuffle/sort/SortShuffleManager.scala:73),
+and BlockStoreShuffleReader (core/shuffle/BlockStoreShuffleReader.scala:72).
+
+TPU-native design (SURVEY.md §2.5, §7 step 6): partition ids are computed on
+device for a whole batch (hash kernel), rows are grouped by pid with one
+`lax.sort`, and the grouped columns cross to the host in a single contiguous
+transfer — the host then slices per-partition runs (the "shuffle files") and
+rebuilds device batches per reducer. Within a real TPU slice the same kernel
+output feeds an ICI all-to-all instead (parallel/collectives.py); this module
+is the host/DCN path and the local-mode fallback. String columns travel as
+dictionary codes + host dictionaries; reducers merge dictionaries on rebuild.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..columnar.batch import Column, ColumnarBatch, StringDict, bucket_capacity
+from ..exec.context import ExecContext
+from ..types import StringType, StructType
+
+Partition = list
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class _OutBuffer:
+    """Accumulates host-side row slices for one reducer partition."""
+
+    def __init__(self, schema: StructType):
+        self.schema = schema
+        self.chunks: list[list] = []  # per append: [(data, validity, sdict), ...]
+        self.rows = 0
+
+    def append(self, cols: list, n: int):
+        if n:
+            self.chunks.append(cols)
+            self.rows += n
+
+    def build(self, tile_capacity: int) -> Partition:
+        """Rebuild device batches (≤ tile_capacity rows each)."""
+        if not self.chunks:
+            return [ColumnarBatch.empty(self.schema)]
+        ncols = len(self.schema.fields)
+        merged_cols = []
+        for i, f in enumerate(self.schema.fields):
+            datas = [c[i][0] for c in self.chunks]
+            valids = [c[i][1] for c in self.chunks]
+            if isinstance(f.dataType, StringType):
+                sdicts = [c[i][2] for c in self.chunks]
+                merged, recoded = _merge_dict_chunks(sdicts, datas)
+                data = np.concatenate(recoded) if recoded else np.zeros(0, np.int32)
+                sd = merged
+            else:
+                data = np.concatenate(datas) if datas else np.zeros(0)
+                sd = None
+            if any(v is not None for v in valids):
+                vs = [v if v is not None else np.ones(len(d), bool)
+                      for v, d in zip(valids, datas)]
+                validity = np.concatenate(vs)
+            else:
+                validity = None
+            merged_cols.append((data, validity, sd))
+
+        total = self.rows
+        batches = []
+        for start in range(0, max(total, 1), tile_capacity):
+            end = min(start + tile_capacity, total)
+            arrays = [c[0][start:end] for c in merged_cols]
+            validities = [None if c[1] is None else c[1][start:end]
+                          for c in merged_cols]
+            dicts = [c[2] for c in merged_cols]
+            batches.append(ColumnarBatch.from_numpy(
+                self.schema, arrays, dictionaries=dicts, validities=validities))
+            if end >= total:
+                break
+        return batches
+
+
+def _merge_dict_chunks(sdicts: list, datas: list):
+    merged: list[str] = []
+    idx: dict[str, int] = {}
+    recoded = []
+    for sd, codes in zip(sdicts, datas):
+        sd = sd or StringDict([""])
+        lut = np.zeros(max(len(sd.values), 1), dtype=np.int32)
+        for i, v in enumerate(sd.values or [""]):
+            j = idx.get(v)
+            if j is None:
+                j = len(merged)
+                merged.append(v)
+                idx[v] = j
+            lut[i] = j
+        recoded.append(lut[np.clip(codes, 0, len(lut) - 1)])
+    return StringDict(merged or [""]), recoded
+
+
+def _pull_sorted(batch: ColumnarBatch, perm, counts) -> tuple[list, np.ndarray]:
+    """Gather columns by perm on device, transfer to host once."""
+    import jax
+    jnp = _jnp()
+
+    gathered = []
+    for c in batch.columns:
+        data = np.asarray(jnp.take(c.data, perm))
+        validity = None if c.validity is None else \
+            np.asarray(jnp.take(c.validity, perm))
+        gathered.append((data, validity, c.dictionary))
+    return gathered, np.asarray(counts)
+
+
+def shuffle_hash(partitions: list[Partition], key_positions: Sequence[int],
+                 num_out: int, schema: StructType, ctx: ExecContext,
+                 stats: dict | None = None) -> list[Partition]:
+    import jax
+
+    from ..ops.partition import hash_partition
+    from ..physical.compile import GLOBAL_KERNEL_CACHE
+
+    jnp = _jnp()
+    bufs = [_OutBuffer(schema) for _ in range(num_out)]
+    for part in partitions:
+        for batch in part:
+            keys = [batch.columns[i] for i in key_positions]
+            key_eqs = [c.eq_keys() for c in keys]
+            key_valids = [c.validity for c in keys]
+            cap = batch.capacity
+            kkey = ("shuffle_hash", cap, num_out, len(keys),
+                    tuple(str(k.dtype) for k in key_eqs),
+                    tuple(v is not None for v in key_valids))
+            kernel = GLOBAL_KERNEL_CACHE.get_or_build(
+                kkey, lambda: jax.jit(
+                    lambda eqs, valids, mask: hash_partition(
+                        eqs, valids, mask, num_out)))
+            pr = kernel(key_eqs, key_valids, batch.row_mask)
+            gathered, counts = _pull_sorted(batch, pr.perm, pr.counts)
+            _slice_into(bufs, gathered, counts)
+    return _finish(bufs, ctx, stats)
+
+
+def shuffle_round_robin(partitions: list[Partition], num_out: int,
+                        schema: StructType, ctx: ExecContext,
+                        stats: dict | None = None) -> list[Partition]:
+    import jax
+
+    from ..ops.partition import round_robin_partition
+    from ..physical.compile import GLOBAL_KERNEL_CACHE
+
+    bufs = [_OutBuffer(schema) for _ in range(num_out)]
+    start = 0
+    for part in partitions:
+        for batch in part:
+            cap = batch.capacity
+            kkey = ("shuffle_rr", cap, num_out, start % num_out)
+            s = start % num_out
+            kernel = GLOBAL_KERNEL_CACHE.get_or_build(
+                kkey, lambda s=s: jax.jit(
+                    lambda mask: round_robin_partition(mask, num_out, s)))
+            pr = kernel(batch.row_mask)
+            gathered, counts = _pull_sorted(batch, pr.perm, pr.counts)
+            _slice_into(bufs, gathered, counts)
+            start += int(counts.sum())
+    return _finish(bufs, ctx, stats)
+
+
+def shuffle_range(partitions: list[Partition], key_position: int,
+                  bounds, descending: bool, num_out: int, schema: StructType,
+                  ctx: ExecContext, stats: dict | None = None) -> list[Partition]:
+    """Range shuffle for global sort. `bounds` is a host list of boundary
+    values in the sort-key domain (numeric) or raw strings."""
+    import jax
+
+    from ..ops.partition import range_partition, _group_by_pid
+    from ..physical.compile import GLOBAL_KERNEL_CACHE
+
+    jnp = _jnp()
+    bufs = [_OutBuffer(schema) for _ in range(num_out)]
+    f = schema.fields[key_position]
+    string_key = isinstance(f.dataType, StringType)
+    for part in partitions:
+        for batch in part:
+            col = batch.columns[key_position]
+            cap = batch.capacity
+            if string_key:
+                # host: dict value → pid lut; device: take + group
+                sd = col.dictionary or StringDict([""])
+                lut = np.searchsorted(bounds, np.array(sd.values or [""],
+                                                       dtype=object),
+                                      side="right").astype(np.int32)
+                if descending:
+                    lut = (num_out - 1) - lut
+                lut_d = jnp.asarray(lut)
+                pids = jnp.take(lut_d, jnp.clip(col.data, 0, len(lut) - 1))
+                kkey = ("shuffle_range_str", cap, num_out)
+                kernel = GLOBAL_KERNEL_CACHE.get_or_build(
+                    kkey, lambda: jax.jit(
+                        lambda p, m: _group_by_pid(p, m, num_out)))
+                pr = kernel(pids, batch.row_mask)
+            else:
+                barr = jnp.asarray(np.asarray(bounds))
+                kkey = ("shuffle_range", cap, num_out, descending,
+                        str(col.data.dtype), len(bounds))
+                kernel = GLOBAL_KERNEL_CACHE.get_or_build(
+                    kkey, lambda: jax.jit(
+                        lambda keys, b, mask: range_partition(
+                            keys, b, mask, num_out, descending)))
+                pr = kernel(col.sort_keys().astype(barr.dtype), barr,
+                            batch.row_mask)
+            gathered, counts = _pull_sorted(batch, pr.perm, pr.counts)
+            _slice_into(bufs, gathered, counts)
+    return _finish(bufs, ctx, stats)
+
+
+def gather_single(partitions: list[Partition]) -> list[Partition]:
+    """AllTuples: concatenate every partition into one."""
+    merged: Partition = []
+    for p in partitions:
+        merged.extend(p)
+    return [merged]
+
+
+def _slice_into(bufs: list[_OutBuffer], gathered: list, counts: np.ndarray):
+    offsets = np.zeros(len(counts) + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    for p in range(len(bufs)):
+        lo, hi = int(offsets[p]), int(offsets[p + 1])
+        if hi <= lo:
+            continue
+        cols = []
+        for data, validity, sd in gathered:
+            cols.append((data[lo:hi],
+                         None if validity is None else validity[lo:hi], sd))
+        bufs[p].append(cols, hi - lo)
+
+
+def _finish(bufs: list[_OutBuffer], ctx: ExecContext,
+            stats: dict | None) -> list[Partition]:
+    tile = ctx.conf.batch_capacity
+    out = []
+    for i, b in enumerate(bufs):
+        if stats is not None:
+            stats[i] = b.rows
+        out.append(b.build(tile))
+    return out

@@ -1,0 +1,115 @@
+"""Aggregate lowering: logical aggregate functions → buffer ops + final exprs.
+
+Role of the reference's AggUtils/DeclarativeAggregate contract
+(sqlx/aggregate/AggUtils.scala; sqlcat/expressions/aggregate/interfaces.scala:
+initialValues/updateExpressions/mergeExpressions/evaluateExpression). Each
+function lowers to primitive buffer ops the group kernel understands
+(sum/count/min/max/first/sumsq); merge ops are the partial ops' associative
+counterparts, so the same kernel serves map-side partial and reduce-side
+final aggregation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from ..errors import UnsupportedOperationError
+from ..expr.expressions import (
+    AggregateFunction, Alias, AttributeReference, Average, Cast, Count,
+    CollectSet, Divide, Expression, First, GreaterThan, If, Literal, Max, Min,
+    Multiply, Sqrt, StddevPop, StddevSamp, Subtract, Sum, VariancePop,
+    VarianceSamp, cast_if,
+)
+from ..types import (
+    DataType, DecimalType, FractionalType, IntegralType, StringType,
+    float64, int64,
+)
+
+# primitive ops the kernel implements
+PARTIAL_TO_MERGE = {
+    "sum": "sum", "count": "sum", "countstar": "sum",
+    "min": "min", "max": "max", "first": "first", "sumsq": "sum",
+}
+
+
+def _buffer_dtype(op: str, in_dtype: DataType | None) -> DataType:
+    if op in ("count", "countstar"):
+        return int64
+    if op == "sumsq":
+        return float64
+    if op == "sum":
+        assert in_dtype is not None
+        if isinstance(in_dtype, DecimalType):
+            return DecimalType(DecimalType.MAX_PRECISION, in_dtype.scale)
+        if isinstance(in_dtype, IntegralType):
+            return int64
+        return float64
+    return in_dtype  # min/max/first preserve type
+
+
+@dataclass
+class AggSpec:
+    """One aggregate function lowered to buffer columns + a finishing expr."""
+
+    func: AggregateFunction
+    input_expr: Expression | None          # argument (None for count(*))
+    ops: list[str]                         # primitive op per buffer column
+    buffer_attrs: list[AttributeReference]  # schema of partial output
+    result_alias: Alias                    # final output (over buffer attrs)
+
+
+def lower_aggregate_function(func: AggregateFunction, out_name: str,
+                             out_id: int) -> AggSpec:
+    child = func.child
+
+    def battr(i: int, op: str) -> AttributeReference:
+        dt = _buffer_dtype(op, child.dtype if child is not None else None)
+        nullable = op not in ("count", "countstar")
+        return AttributeReference(f"{out_name}#buf{i}", dt, nullable)
+
+    if isinstance(func, Sum):
+        b = battr(0, "sum")
+        return AggSpec(func, child, ["sum"], [b],
+                       Alias(cast_if(b, func.dtype), out_name, out_id))
+    if isinstance(func, Count):
+        if func.distinct:
+            raise UnsupportedOperationError(
+                "count(distinct) must be rewritten before lowering")
+        op = "count" if child is not None else "countstar"
+        b = battr(0, op)
+        return AggSpec(func, child, [op], [b],
+                       Alias(b, out_name, out_id))
+    if isinstance(func, (Min, Max)):
+        op = "min" if isinstance(func, Min) else "max"
+        if isinstance(child.dtype, StringType):
+            raise UnsupportedOperationError(
+                "min/max over strings not yet supported on device")
+        b = battr(0, op)
+        return AggSpec(func, child, [op], [b], Alias(b, out_name, out_id))
+    if isinstance(func, Average):
+        bs = battr(0, "sum")
+        bc = battr(1, "count")
+        result = Divide(bs, bc)
+        return AggSpec(func, child, ["sum", "count"], [bs, bc],
+                       Alias(cast_if(result, func.dtype), out_name, out_id))
+    if isinstance(func, First):
+        b = battr(0, "first")
+        return AggSpec(func, child, ["first"], [b], Alias(b, out_name, out_id))
+    if isinstance(func, (StddevSamp, StddevPop, VarianceSamp, VariancePop)):
+        bs = battr(0, "sum")
+        bq = battr(1, "sumsq")
+        bc = battr(2, "count")
+        n = cast_if(bc, float64)
+        mean_sq = Divide(Multiply(cast_if(bs, float64), cast_if(bs, float64)), n)
+        ddof = func.ddof
+        denom = Subtract(n, Literal(float(ddof))) if ddof else n
+        var = Divide(Subtract(bq, mean_sq), denom)
+        var = If(GreaterThan(bc, Literal(ddof)), var, Literal(None, float64))
+        result: Expression = var
+        if isinstance(func, (StddevSamp, StddevPop)):
+            result = Sqrt(var)
+        return AggSpec(func, child, ["sum", "sumsq", "count"], [bs, bq, bc],
+                       Alias(result, out_name, out_id))
+    raise UnsupportedOperationError(
+        f"aggregate {type(func).__name__} not supported yet")

@@ -1,0 +1,209 @@
+"""Kernel compilation: expression trees → cached jitted batch functions.
+
+Role of the reference's WholeStageCodegen + CodeGenerator
+(sqlx/WholeStageCodegenExec.scala:673 doCodeGen; sqlcat/.../codegen/
+CodeGenerator.scala:1557 Janino compile + cache). Here the "generated code"
+is a traced JAX function per (expression structure, input signature,
+capacity, aux signature); XLA performs the operator fusion the reference
+hand-rolls with produce/consume. The cache is keyed STRUCTURALLY (attribute
+ids normalized to input positions) so repeated queries reuse compiled
+kernels across plan instances.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from ..columnar.batch import Column, ColumnarBatch
+from ..expr.eval import HostCtx, TraceCtx, Val
+from ..expr.expressions import (
+    Alias, AttributeReference, Expression, Literal, SortOrder,
+)
+from ..types import DataType, StringType, StructField, StructType
+
+__all__ = ["canonical_key", "KernelCache", "ExprPipeline", "bind_inputs",
+            "broadcast_to_cap"]
+
+
+# ---------------------------------------------------------------------------
+# Structural canonicalization
+# ---------------------------------------------------------------------------
+
+def canonical_key(e: Expression, id_to_pos: dict[int, int]) -> tuple:
+    """Hashable structural key with attribute ids replaced by input positions
+    (so two queries with identical shapes share kernels)."""
+    if isinstance(e, AttributeReference):
+        return ("attr", id_to_pos.get(e.expr_id, -1), str(e.dtype))
+    if isinstance(e, Alias):
+        return ("alias", canonical_key(e.child, id_to_pos))
+    if isinstance(e, Literal):
+        return ("lit", e.value if not isinstance(e.value, (list, dict)) else str(e.value),
+                str(e.dtype))
+    if isinstance(e, SortOrder):
+        return ("sort", canonical_key(e.child, id_to_pos), e.ascending,
+                e.nulls_first)
+    data = []
+    for k, v in sorted(e.__dict__.items()):
+        if k in e.child_fields or k.startswith("_") or isinstance(v, Expression):
+            continue
+        if isinstance(v, (list, tuple)) and any(isinstance(x, Expression) for x in v):
+            continue
+        if isinstance(v, DataType):
+            v = str(v)
+        try:
+            hash(v)
+        except TypeError:
+            v = str(v)
+        data.append((k, v))
+    return (type(e).__name__, tuple(data),
+            tuple(canonical_key(c, id_to_pos) for c in e.children
+                  if isinstance(c, Expression)))
+
+
+# ---------------------------------------------------------------------------
+# Kernel cache
+# ---------------------------------------------------------------------------
+
+class KernelCache:
+    """Process-global LRU of jitted kernels."""
+
+    def __init__(self, max_size: int = 1024):
+        self._cache: "collections.OrderedDict[tuple, Any]" = collections.OrderedDict()
+        self.max_size = max_size
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_build(self, key: tuple, builder: Callable[[], Any]):
+        f = self._cache.get(key)
+        if f is not None:
+            self.hits += 1
+            self._cache.move_to_end(key)
+            return f
+        self.misses += 1
+        f = builder()
+        self._cache[key] = f
+        while len(self._cache) > self.max_size:
+            self._cache.popitem(last=False)
+        return f
+
+
+GLOBAL_KERNEL_CACHE = KernelCache()
+
+
+# ---------------------------------------------------------------------------
+# Input binding
+# ---------------------------------------------------------------------------
+
+def bind_inputs(input_attrs: Sequence[AttributeReference]) -> dict[int, int]:
+    return {a.expr_id: i for i, a in enumerate(input_attrs)}
+
+
+def _host_inputs(batch: ColumnarBatch,
+                 input_attrs: Sequence[AttributeReference]) -> dict[int, Val]:
+    out = {}
+    for a, col in zip(input_attrs, batch.columns):
+        out[a.expr_id] = Val(a.dtype, None,
+                             True if col.validity is not None else None,
+                             col.dictionary)
+    return out
+
+
+def broadcast_to_cap(x, cap: int):
+    import jax.numpy as jnp
+
+    if x is None:
+        return None
+    x = jnp.asarray(x)
+    if x.ndim == 0:
+        return jnp.broadcast_to(x, (cap,))
+    return x
+
+
+# ---------------------------------------------------------------------------
+# ExprPipeline: N filters + M output expressions in one kernel
+# ---------------------------------------------------------------------------
+
+class ExprPipeline:
+    """Compiles `filters` (conjunctive predicates) and `outputs` (named
+    expressions) over a fixed input attribute list into one jitted kernel.
+
+    Per batch: a host pass harvests dictionaries/aux tables and output
+    metadata, then the cached kernel runs on device."""
+
+    def __init__(self, input_attrs: Sequence[AttributeReference],
+                 filters: Sequence[Expression],
+                 outputs: Sequence[Expression],
+                 out_schema: StructType):
+        self.input_attrs = list(input_attrs)
+        self.filters = list(filters)
+        self.outputs = list(outputs)
+        self.out_schema = out_schema
+        self.id_to_pos = bind_inputs(self.input_attrs)
+        self._struct_key = (
+            tuple(canonical_key(f, self.id_to_pos) for f in self.filters),
+            tuple(canonical_key(o, self.id_to_pos) for o in self.outputs),
+        )
+
+    def run(self, batch: ColumnarBatch) -> ColumnarBatch:
+        import jax
+        import jax.numpy as jnp
+
+        cap = batch.capacity
+        # ---- host pass ----
+        hctx = HostCtx(_host_inputs(batch, self.input_attrs))
+        for f in self.filters:
+            hctx.eval(f)
+        host_outs = [hctx.eval(o) for o in self.outputs]
+        aux_np = hctx.aux_arrays
+
+        in_sig = tuple(
+            (str(c.data.dtype), c.validity is not None) for c in batch.columns)
+        key = ("pipeline", self._struct_key, cap, in_sig, hctx.signature())
+
+        kernel = GLOBAL_KERNEL_CACHE.get_or_build(
+            key, lambda: self._build_kernel(cap))
+
+        datas = [c.data for c in batch.columns]
+        valids = [c.validity for c in batch.columns]
+        aux = [jnp.asarray(a) for a in aux_np]
+        out_datas, out_valids, new_mask = kernel(datas, valids, batch.row_mask, aux)
+
+        cols = []
+        for f, hv, d, v in zip(self.out_schema.fields, host_outs, out_datas,
+                               out_valids):
+            sdict = hv.sdict if isinstance(f.dataType, StringType) else None
+            cols.append(Column(f.dataType, d, v, sdict))
+        return ColumnarBatch(self.out_schema, cols, new_mask, num_rows=None)
+
+    def _build_kernel(self, cap: int):
+        import jax
+        import jax.numpy as jnp
+
+        input_attrs = self.input_attrs
+        filters = self.filters
+        outputs = self.outputs
+
+        def kernel(datas, valids, row_mask, aux):
+            inputs = {}
+            for a, d, v in zip(input_attrs, datas, valids):
+                inputs[a.expr_id] = Val(a.dtype, d, v, None)
+            tctx = TraceCtx(inputs, aux, cap, row_mask)
+            mask = row_mask
+            for f in filters:
+                fv = tctx.eval(f)
+                pd = fv.data
+                if fv.validity is not None:
+                    pd = pd & fv.validity
+                mask = mask & broadcast_to_cap(pd, cap)
+            out_datas = []
+            out_valids = []
+            for o in outputs:
+                ov = tctx.eval(o)
+                out_datas.append(broadcast_to_cap(ov.data, cap))
+                out_valids.append(broadcast_to_cap(ov.validity, cap))
+            return out_datas, out_valids, mask
+
+        return jax.jit(kernel)

@@ -1,0 +1,145 @@
+"""Exchange operators.
+
+Role of the reference's ShuffleExchangeExec (sqlx/exchange/
+ShuffleExchangeExec.scala:190) and BroadcastExchangeExec (:61
+relationFuture + torrent broadcast). Broadcast here is a replicated
+concatenated batch (on a mesh: an ICI all-gather — SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..columnar.batch import ColumnarBatch
+from ..columnar.ops import concat_batches
+from ..errors import UnsupportedOperationError
+from ..exec import shuffle as S
+from ..exec.context import ExecContext
+from ..expr.expressions import AttributeReference, SortOrder
+from ..types import StringType
+from .operators import PhysicalPlan, attrs_schema
+from .partitioning import (
+    BroadcastPartitioning, HashPartitioning, Partitioning, RangePartitioning,
+    SinglePartition, UnknownPartitioning,
+)
+
+
+class ShuffleExchangeExec(PhysicalPlan):
+    child_fields = ("child",)
+
+    def __init__(self, partitioning: Partitioning, child: PhysicalPlan):
+        self.partitioning = partitioning
+        self.child = child
+        self.last_stats: dict[int, int] = {}
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def output_partitioning(self):
+        return self.partitioning
+
+    def execute(self, ctx: ExecContext) -> list:
+        parts = self.child.execute(ctx)
+        schema = attrs_schema(self.output)
+        p = self.partitioning
+        self.last_stats = {}
+        with ctx.metrics.time("shuffle"):
+            if isinstance(p, SinglePartition):
+                return S.gather_single(parts)
+            if isinstance(p, HashPartitioning):
+                pos = {a.expr_id: i for i, a in enumerate(self.output)}
+                key_positions = []
+                for e in p.exprs:
+                    assert isinstance(e, AttributeReference), \
+                        "exchange keys must be attributes (planner contract)"
+                    key_positions.append(pos[e.expr_id])
+                return S.shuffle_hash(parts, key_positions, p.num_partitions,
+                                      schema, ctx, self.last_stats)
+            if isinstance(p, RangePartitioning):
+                return self._range_shuffle(parts, p, schema, ctx)
+            if isinstance(p, UnknownPartitioning):
+                return S.shuffle_round_robin(parts, p.num_partitions, schema,
+                                             ctx, self.last_stats)
+        raise UnsupportedOperationError(f"exchange for {p}")
+
+    def _range_shuffle(self, parts, p: RangePartitioning, schema, ctx):
+        order = p.orders[0]
+        pos = {a.expr_id: i for i, a in enumerate(self.output)}
+        assert isinstance(order.child, AttributeReference)
+        kpos = pos[order.child.expr_id]
+        bounds = _sample_bounds(parts, kpos, schema, p.num_partitions)
+        if bounds is None or len(bounds) == 0:
+            return S.gather_single(parts)
+        return S.shuffle_range(parts, kpos, bounds, not order.ascending,
+                               p.num_partitions, schema, ctx, self.last_stats)
+
+    def simple_string(self):
+        return f"Exchange[{type(self.partitioning).__name__}" \
+               f"({self.partitioning.num_partitions})]"
+
+
+def _sample_bounds(parts, kpos: int, schema, num_out: int,
+                   per_part_sample: int = 4096):
+    """Sample the sort key to derive range bounds (role of the reference's
+    RangePartitioner sampling job, core/Partitioner.scala:388)."""
+    f = schema.fields[kpos]
+    samples = []
+    for part in parts:
+        for batch in part[:2]:
+            col = batch.columns[kpos]
+            mask = np.asarray(batch.row_mask)
+            if isinstance(f.dataType, StringType):
+                vals = col.to_numpy(np.nonzero(mask)[0][:per_part_sample])
+                samples.extend([v for v in vals if v is not None])
+            else:
+                data = np.asarray(col.data)[mask][:per_part_sample]
+                if col.validity is not None:
+                    vmask = np.asarray(col.validity)[mask][:per_part_sample]
+                    data = data[vmask[: len(data)]]
+                samples.extend(data.tolist())
+    if not samples:
+        return None
+    if isinstance(f.dataType, StringType):
+        s = sorted(set(samples))
+    else:
+        s = np.unique(np.asarray(samples))
+    if len(s) <= 1:
+        return None
+    qs = [int(round(i * (len(s) - 1) / num_out)) for i in range(1, num_out)]
+    if isinstance(f.dataType, StringType):
+        bounds = sorted(set(s[q] for q in qs))
+    else:
+        bounds = np.unique(s[qs])
+    return bounds
+
+
+class BroadcastExchangeExec(PhysicalPlan):
+    child_fields = ("child",)
+
+    def __init__(self, child: PhysicalPlan):
+        self.child = child
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def output_partitioning(self):
+        return BroadcastPartitioning()
+
+    def execute(self, ctx: ExecContext) -> list:
+        parts = self.child.execute(ctx)
+        merged = []
+        for p in parts:
+            merged.extend(p)
+        schema = attrs_schema(self.output)
+        if not merged:
+            return [[ColumnarBatch.empty(schema)]]
+        batch = concat_batches(merged, schema)
+        ctx.metrics.add("broadcast.rows", batch.num_rows())
+        return [[batch]]
+
+    def simple_string(self):
+        return "BroadcastExchange"

@@ -1,0 +1,902 @@
+"""Physical operators.
+
+Role of the reference's SparkPlan hierarchy (sqlx/SparkPlan.scala:343
+doExecute / :359 doExecuteColumnar and the exec nodes under sqlx/). Every
+operator here is columnar-only (the reference's ColumnarRule path,
+sqlx/Columnar.scala:47, made the default): execute() returns a list of
+partitions, each a list of device ColumnarBatches. Blocking operators
+(aggregate/sort/join-build) concatenate their partition's batches and run one
+fused kernel; XLA plays the role of WholeStageCodegen.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from ..columnar.batch import Column, ColumnarBatch, bucket_capacity
+from ..columnar.ops import concat_batches, gather_batch
+from ..errors import CapacityOverflowError, ExecutionError, UnsupportedOperationError
+from ..exec.context import ExecContext
+from ..expr.eval import HostCtx, TraceCtx, Val
+from ..expr.expressions import (
+    Alias, AttributeReference, Expression, SortOrder,
+)
+from ..plan.tree import TreeNode
+from ..types import (
+    BooleanType, StringType, StructField, StructType, int64,
+)
+from .aggregates import PARTIAL_TO_MERGE, AggSpec
+from .compile import (
+    GLOBAL_KERNEL_CACHE, ExprPipeline, broadcast_to_cap, canonical_key,
+)
+from .partitioning import (
+    AllTuples, BroadcastDistribution, BroadcastPartitioning,
+    ClusteredDistribution, Distribution, HashPartitioning, OrderedDistribution,
+    Partitioning, RangePartitioning, SinglePartition, UnknownPartitioning,
+    UnspecifiedDistribution,
+)
+
+Partition = list  # list[ColumnarBatch]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+def attrs_schema(attrs: Sequence[AttributeReference]) -> StructType:
+    return StructType([StructField(a.name, a.dtype, a.nullable) for a in attrs])
+
+
+class PhysicalPlan(TreeNode):
+    """Base physical operator."""
+
+    @property
+    def output(self) -> list[AttributeReference]:
+        raise NotImplementedError
+
+    def output_partitioning(self) -> Partitioning:
+        ch = self.children
+        if ch:
+            return ch[0].output_partitioning()
+        return UnknownPartitioning(1)
+
+    def required_child_distribution(self) -> list[Distribution]:
+        return [UnspecifiedDistribution() for _ in self.children]
+
+    def execute(self, ctx: ExecContext) -> list[Partition]:
+        raise NotImplementedError
+
+    def schema(self) -> StructType:
+        return attrs_schema(self.output)
+
+
+# ---------------------------------------------------------------------------
+# Scans
+# ---------------------------------------------------------------------------
+
+class ScanExec(PhysicalPlan):
+    """Columnar scan over a DataSource (role of FileSourceScanExec,
+    sqlx/DataSourceScanExec.scala:719, vectorized path)."""
+
+    child_fields = ()
+
+    def __init__(self, source, attrs: list[AttributeReference], name: str = ""):
+        self.source = source
+        self.attrs = attrs
+        self.name = name
+
+    @property
+    def output(self):
+        return self.attrs
+
+    def output_partitioning(self):
+        return UnknownPartitioning(self.source.num_partitions())
+
+    def execute(self, ctx: ExecContext) -> list[Partition]:
+        from ..columnar.arrow import table_to_batches
+
+        cols = [a.name for a in self.attrs]
+        cap = ctx.conf.batch_capacity
+        out: list[Partition] = []
+        for i in range(self.source.num_partitions()):
+            table = self.source.read_partition(i, cols)
+            batches = list(table_to_batches(table, cap, attrs_schema(self.attrs)))
+            ctx.metrics.add(f"scan.{self.name}.rows", table.num_rows)
+            out.append(batches)
+        return out
+
+    def simple_string(self):
+        return f"Scan[{self.name}]({', '.join(a.name for a in self.attrs)})"
+
+
+class LocalTableScanExec(PhysicalPlan):
+    child_fields = ()
+
+    def __init__(self, attrs: list[AttributeReference], table):
+        self.attrs = attrs
+        self.table = table  # pyarrow.Table
+
+    @property
+    def output(self):
+        return self.attrs
+
+    def output_partitioning(self):
+        return SinglePartition()
+
+    def execute(self, ctx: ExecContext) -> list[Partition]:
+        from ..columnar.arrow import table_to_batches
+
+        names = [a.name for a in self.attrs]
+        tbl = self.table.select(names) if self.table.num_columns else self.table
+        batches = list(table_to_batches(tbl, ctx.conf.batch_capacity,
+                                        attrs_schema(self.attrs)))
+        return [batches]
+
+
+class RangeExec(PhysicalPlan):
+    child_fields = ()
+
+    def __init__(self, start: int, end: int, step: int, num_partitions: int,
+                 attr: AttributeReference):
+        self.start = start
+        self.end = end
+        self.step = step
+        self.num_partitions = max(1, num_partitions)
+        self.attr = attr
+
+    @property
+    def output(self):
+        return [self.attr]
+
+    def output_partitioning(self):
+        return UnknownPartitioning(self.num_partitions)
+
+    def execute(self, ctx: ExecContext) -> list[Partition]:
+        jnp = _jnp()
+        total = max(0, -(-(self.end - self.start) // self.step)) if self.step > 0 \
+            else max(0, -(-(self.start - self.end) // -self.step))
+        per = -(-total // self.num_partitions)
+        parts: list[Partition] = []
+        schema = attrs_schema([self.attr])
+        tile = ctx.conf.batch_capacity
+        for p in range(self.num_partitions):
+            lo = min(p * per, total)
+            hi = min(lo + per, total)
+            batches = []
+            for s in range(lo, hi, tile):
+                e = min(s + tile, hi)
+                n = e - s
+                cap = bucket_capacity(n)
+                idx = jnp.arange(cap, dtype=jnp.int64)
+                data = self.start + (s + idx) * self.step
+                mask = idx < n
+                batches.append(ColumnarBatch(
+                    schema, [Column(self.attr.dtype, data, None, None)],
+                    mask, num_rows=n))
+            if not batches:
+                batches = [ColumnarBatch.empty(schema)]
+            parts.append(batches)
+        return parts
+
+
+# ---------------------------------------------------------------------------
+# Compute (fused filter+project)
+# ---------------------------------------------------------------------------
+
+class ComputeExec(PhysicalPlan):
+    """Fused conjunctive filters + projections — one XLA kernel per batch
+    (the WholeStageCodegen pipeline analog for narrow operators)."""
+
+    child_fields = ("child",)
+
+    def __init__(self, filters: Sequence[Expression],
+                 outputs: Sequence[Expression], child: PhysicalPlan):
+        self.filters = list(filters)
+        self.outputs = list(outputs)  # Alias | AttributeReference
+        self.child = child
+        self._pipeline: ExprPipeline | None = None
+
+    @property
+    def output(self):
+        out = []
+        for e in self.outputs:
+            if isinstance(e, Alias):
+                out.append(e.to_attribute())
+            else:
+                out.append(e)
+        return out
+
+    def output_partitioning(self):
+        p = self.child.output_partitioning()
+        if isinstance(p, (HashPartitioning, RangePartitioning)):
+            out_ids = {a.expr_id for a in self.output}
+            exprs = p.exprs if isinstance(p, HashPartitioning) else \
+                [o.child for o in p.orders]
+            for e in exprs:
+                if not (e.references() <= out_ids):
+                    return UnknownPartitioning(p.num_partitions)
+        return p
+
+    def _get_pipeline(self) -> ExprPipeline:
+        if self._pipeline is None:
+            self._pipeline = ExprPipeline(
+                self.child.output, self.filters, self.outputs,
+                attrs_schema(self.output))
+        return self._pipeline
+
+    def execute(self, ctx: ExecContext) -> list[Partition]:
+        pipe = self._get_pipeline()
+        parts = self.child.execute(ctx)
+        return [[pipe.run(b) for b in part] for part in parts]
+
+    def simple_string(self):
+        f = " AND ".join(x.simple_string() for x in self.filters)
+        o = ", ".join(x.simple_string() for x in self.outputs)
+        s = f"Compute[{o}]"
+        if f:
+            s += f" WHERE {f}"
+        return s
+
+
+# ---------------------------------------------------------------------------
+# Aggregation
+# ---------------------------------------------------------------------------
+
+def _group_kernel(num_keys: int, ops: tuple[str, ...], cap: int,
+                  key_valid_sig: tuple[bool, ...],
+                  val_valid_sig: tuple[bool, ...]):
+    """Build the jitted grouped-aggregation kernel (SURVEY.md §7 step 2)."""
+    import jax
+
+    from ..ops import grouping as G
+
+    def kernel(key_eqs, key_outs, key_valids, val_datas, val_valids, row_mask):
+        jnp = _jnp()
+        layout = G.group_rows(key_eqs, key_valids, row_mask)
+        out_keys = []
+        for ko, kv in zip(key_outs, key_valids):
+            out_keys.append(G.scatter_group_keys(layout, ko, kv))
+        bufs = []
+        for op, vd, vv in zip(ops, val_datas, val_valids):
+            if op in ("count", "countstar"):
+                cnt = G.seg_count(layout, vv if op == "count" else None)
+                bufs.append((cnt, None))
+            elif op == "sum":
+                total, cnt = G.seg_sum(layout, vd, vv)
+                bufs.append((total, cnt > 0))
+            elif op == "sumsq":
+                x = vd.astype(jnp.float64)
+                total, cnt = G.seg_sum(layout, x * x, vv)
+                bufs.append((total, cnt > 0))
+            elif op == "min":
+                m, has = G.seg_min(layout, vd, vv)
+                bufs.append((m, has))
+            elif op == "max":
+                m, has = G.seg_max(layout, vd, vv)
+                bufs.append((m, has))
+            elif op == "first":
+                f, has = G.seg_first(layout, vd, vv)
+                bufs.append((f, has))
+            else:
+                raise ValueError(op)
+        out_mask = G.group_output_mask(layout)
+        return out_keys, bufs, out_mask, layout.num_groups
+
+    return jax.jit(kernel)
+
+
+def _ungrouped_kernel(ops: tuple[str, ...], cap: int,
+                      val_valid_sig: tuple[bool, ...], out_cap: int = 8):
+    import jax
+
+    from ..ops import grouping as G
+
+    def kernel(val_datas, val_valids, row_mask):
+        jnp = _jnp()
+        outs = []
+        for op, vd, vv in zip(ops, val_datas, val_valids):
+            if op in ("count", "countstar"):
+                w = row_mask if (vv is None or op == "countstar") else (row_mask & vv)
+                outs.append((jnp.sum(w.astype(jnp.int64)), None))
+            elif op == "sum":
+                s, c = G.masked_sum(vd, row_mask, vv)
+                outs.append((s, c > 0))
+            elif op == "sumsq":
+                x = vd.astype(jnp.float64)
+                s, c = G.masked_sum(x * x, row_mask, vv)
+                outs.append((s, c > 0))
+            elif op == "min":
+                m, has = G.masked_min(vd, row_mask, vv)
+                outs.append((m, has))
+            elif op == "max":
+                m, has = G.masked_max(vd, row_mask, vv)
+                outs.append((m, has))
+            elif op == "first":
+                w = row_mask if vv is None else (row_mask & vv)
+                pos = jnp.argmax(w)  # first True (0 if none)
+                has = jnp.any(w)
+                outs.append((vd[pos], has))
+            else:
+                raise ValueError(op)
+        # materialize as 1-row arrays of capacity out_cap
+        datas = []
+        valids = []
+        for d, v in outs:
+            arr = jnp.zeros((out_cap,), dtype=d.dtype).at[0].set(d)
+            datas.append(arr)
+            if v is None:
+                valids.append(None)
+            else:
+                varr = jnp.zeros((out_cap,), dtype=bool).at[0].set(v)
+                valids.append(varr)
+        mask = jnp.zeros((out_cap,), dtype=bool).at[0].set(True)
+        return datas, valids, mask
+
+    return jax.jit(kernel)
+
+
+class HashAggregateExec(PhysicalPlan):
+    """Grouped aggregation via the sort/segment kernel (role of
+    HashAggregateExec, sqlx/aggregate/HashAggregateExec.scala:50; the
+    lax.sort design replaces UnsafeFixedWidthAggregationMap).
+
+    mode 'partial': values come from spec.input_expr attributes.
+    mode 'final':   values are the buffer attrs; ops are merge ops.
+    Output (both modes): grouping attrs ++ flattened buffer attrs."""
+
+    child_fields = ("child",)
+
+    def __init__(self, grouping: Sequence[AttributeReference],
+                 specs: Sequence[AggSpec], mode: str, child: PhysicalPlan):
+        assert mode in ("partial", "final")
+        self.grouping = list(grouping)
+        self.specs = list(specs)
+        self.mode = mode
+        self.child = child
+
+    @property
+    def output(self):
+        out = list(self.grouping)
+        for s in self.specs:
+            out.extend(s.buffer_attrs)
+        return out
+
+    def required_child_distribution(self):
+        if self.mode == "partial":
+            return [UnspecifiedDistribution()]
+        if not self.grouping:
+            return [AllTuples()]
+        return [ClusteredDistribution(list(self.grouping))]
+
+    def output_partitioning(self):
+        return self.child.output_partitioning()
+
+    def _plan_values(self) -> list[tuple[str, AttributeReference | None]]:
+        """(op, input attr) per buffer column."""
+        out = []
+        for s in self.specs:
+            for i, op in enumerate(s.ops):
+                if self.mode == "partial":
+                    attr = s.input_expr if op != "countstar" else None
+                    out.append((op, attr))
+                else:
+                    out.append((PARTIAL_TO_MERGE[op], s.buffer_attrs[i]))
+        return out
+
+    def execute(self, ctx: ExecContext) -> list[Partition]:
+        parts = self.child.execute(ctx)
+        return [[self._aggregate_partition(part, ctx)] for part in parts]
+
+    def _aggregate_partition(self, part: Partition, ctx) -> ColumnarBatch:
+        jnp = _jnp()
+        batch = concat_batches(part, attrs_schema(self.child.output))
+        cap = batch.capacity
+        pos = {a.expr_id: i for i, a in enumerate(self.child.output)}
+
+        vals = self._plan_values()
+        ops = tuple(op for op, _ in vals)
+        val_datas = []
+        val_valids = []
+        for op, attr in vals:
+            if attr is None:
+                val_datas.append(batch.row_mask)  # dummy
+                val_valids.append(None)
+            else:
+                c = batch.columns[pos[attr.expr_id]]
+                val_datas.append(c.data)
+                val_valids.append(c.validity)
+
+        out_schema = attrs_schema(self.output)
+
+        if not self.grouping:
+            key = ("uagg", ops, cap,
+                   tuple(v is not None for v in val_valids),
+                   tuple(str(d.dtype) for d in val_datas))
+            kernel = GLOBAL_KERNEL_CACHE.get_or_build(
+                key, lambda: _ungrouped_kernel(
+                    ops, cap, tuple(v is not None for v in val_valids)))
+            datas, valids, mask = kernel(val_datas, val_valids, batch.row_mask)
+            cols = [Column(f.dataType, d, v, None)
+                    for f, d, v in zip(out_schema.fields, datas, valids)]
+            return ColumnarBatch(out_schema, cols, mask, num_rows=1)
+
+        key_cols = [batch.columns[pos[g.expr_id]] for g in self.grouping]
+        key_eqs = [c.eq_keys() for c in key_cols]
+        key_outs = [c.data for c in key_cols]
+        key_valids = [c.validity for c in key_cols]
+
+        kkey = ("gagg", len(key_cols), ops, cap,
+                tuple(v is not None for v in key_valids),
+                tuple(v is not None for v in val_valids),
+                tuple(str(d.dtype) for d in key_eqs),
+                tuple(str(d.dtype) for d in val_datas))
+        kernel = GLOBAL_KERNEL_CACHE.get_or_build(
+            kkey, lambda: _group_kernel(
+                len(key_cols), ops, cap,
+                tuple(v is not None for v in key_valids),
+                tuple(v is not None for v in val_valids)))
+        out_keys, bufs, out_mask, _ng = kernel(
+            key_eqs, key_outs, key_valids, val_datas, val_valids, batch.row_mask)
+
+        cols = []
+        for (kd, kv), kc, f in zip(out_keys, key_cols,
+                                   out_schema.fields[: len(key_cols)]):
+            cols.append(Column(f.dataType, kd, kv, kc.dictionary))
+        for (bd, bv), f in zip(bufs, out_schema.fields[len(key_cols):]):
+            # cast buffer to declared device dtype if needed (e.g. acc int64)
+            want = f.dataType.device_dtype
+            if str(bd.dtype) != str(want):
+                bd = bd.astype(want)
+            cols.append(Column(f.dataType, bd, bv, None))
+        return ColumnarBatch(out_schema, cols, out_mask, num_rows=None)
+
+    def simple_string(self):
+        g = ", ".join(a.name for a in self.grouping)
+        fns = ", ".join(type(s.func).__name__ for s in self.specs)
+        return f"HashAggregate[{self.mode}](keys=[{g}], fns=[{fns}])"
+
+
+# ---------------------------------------------------------------------------
+# Sort / Limit
+# ---------------------------------------------------------------------------
+
+class SortExec(PhysicalPlan):
+    """In-partition sort (role of sqlx/SortExec.scala:39). Orders must be
+    over child output attributes (planner pre-projects complex keys)."""
+
+    child_fields = ("child",)
+
+    def __init__(self, orders: Sequence[SortOrder], child: PhysicalPlan):
+        self.orders = list(orders)
+        self.child = child
+        for o in self.orders:
+            assert isinstance(o.child, AttributeReference), \
+                "planner must bind sort keys to attributes"
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def required_child_distribution(self):
+        return [UnspecifiedDistribution()]
+
+    def execute(self, ctx: ExecContext) -> list[Partition]:
+        return [[self._sort_partition(p)] if p else [] for p in
+                self.child.execute(ctx)]
+
+    def _sort_partition(self, part: Partition) -> ColumnarBatch:
+        import jax
+
+        from ..ops.sorting import SortKeySpec, sort_permutation
+
+        jnp = _jnp()
+        batch = concat_batches(part, attrs_schema(self.child.output))
+        pos = {a.expr_id: i for i, a in enumerate(self.child.output)}
+        keys = []
+        valids = []
+        specs = []
+        for o in self.orders:
+            c = batch.columns[pos[o.child.expr_id]]
+            keys.append(c.sort_keys())
+            valids.append(c.validity)
+            specs.append(SortKeySpec(o.ascending, o.nulls_first))
+
+        cap = batch.capacity
+        skey = ("sort", cap, tuple((s.ascending, s.nulls_first) for s in specs),
+                tuple(str(k.dtype) for k in keys),
+                tuple(v is not None for v in valids),
+                tuple((str(c.data.dtype), c.validity is not None)
+                      for c in batch.columns))
+
+        def build():
+            def kernel(keys, valids, datas, dvalids, row_mask):
+                perm = sort_permutation(keys, valids, specs, row_mask)
+                out_d = [jnp.take(d, perm) for d in datas]
+                out_v = [None if v is None else jnp.take(v, perm)
+                         for v in dvalids]
+                return out_d, out_v, jnp.take(row_mask, perm)
+
+            return jax.jit(kernel)
+
+        kernel = GLOBAL_KERNEL_CACHE.get_or_build(skey, build)
+        datas = [c.data for c in batch.columns]
+        dvalids = [c.validity for c in batch.columns]
+        out_d, out_v, out_mask = kernel(keys, valids, datas, dvalids,
+                                        batch.row_mask)
+        cols = [Column(c.dtype, d, v, c.dictionary)
+                for c, d, v in zip(batch.columns, out_d, out_v)]
+        return ColumnarBatch(batch.schema, cols, out_mask, batch._num_rows)
+
+    def simple_string(self):
+        o = ", ".join(
+            f"{x.child.simple_string()} {'ASC' if x.ascending else 'DESC'}"
+            for x in self.orders)
+        return f"Sort[{o}]"
+
+
+class LimitExec(PhysicalPlan):
+    """Keep first n live rows per partition (LocalLimit); with a single
+    child partition this is GlobalLimit (reference: sqlx/limit.scala)."""
+
+    child_fields = ("child",)
+
+    def __init__(self, n: int, child: PhysicalPlan, offset: int = 0,
+                 is_global: bool = False):
+        self.n = n
+        self.offset = offset
+        self.is_global = is_global
+        self.child = child
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def required_child_distribution(self):
+        return [AllTuples()] if self.is_global else [UnspecifiedDistribution()]
+
+    def execute(self, ctx: ExecContext) -> list[Partition]:
+        import jax
+
+        jnp = _jnp()
+        out = []
+        for part in self.child.execute(ctx):
+            if not part:
+                out.append([])
+                continue
+            batch = concat_batches(part, attrs_schema(self.output))
+            cap = batch.capacity
+            key = ("limit", cap, self.n, self.offset)
+
+            def build():
+                def kernel(mask):
+                    rank = jnp.cumsum(mask.astype(jnp.int64))
+                    keep = mask & (rank > self.offset) & \
+                        (rank <= self.offset + self.n)
+                    return keep
+
+                return jax.jit(kernel)
+
+            kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build)
+            new_mask = kernel(batch.row_mask)
+            out.append([ColumnarBatch(batch.schema, batch.columns, new_mask,
+                                      num_rows=None)])
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Joins
+# ---------------------------------------------------------------------------
+
+class HashJoinExec(PhysicalPlan):
+    """Equi-join via the sorted-probe kernel (role of ShuffledHashJoinExec /
+    BroadcastHashJoinExec, sqlx/joins/). The right side is the build side;
+    the planner flips right-joins into left joins over swapped children."""
+
+    child_fields = ("left", "right")
+
+    def __init__(self, left_keys: Sequence[AttributeReference],
+                 right_keys: Sequence[AttributeReference], join_type: str,
+                 left: PhysicalPlan, right: PhysicalPlan,
+                 is_broadcast: bool = False):
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.join_type = join_type  # inner/left_outer/left_semi/left_anti/full_outer
+        self.left = left
+        self.right = right
+        self.is_broadcast = is_broadcast
+
+    @property
+    def output(self):
+        if self.join_type in ("left_semi", "left_anti"):
+            return self.left.output
+        ro = self.right.output
+        lo = self.left.output
+        if self.join_type in ("left_outer", "full_outer"):
+            ro = [a.with_nullability(True) for a in ro]
+        if self.join_type == "full_outer":
+            lo = [a.with_nullability(True) for a in lo]
+        return lo + ro
+
+    def required_child_distribution(self):
+        if self.is_broadcast:
+            return [UnspecifiedDistribution(), BroadcastDistribution()]
+        return [ClusteredDistribution(list(self.left_keys)),
+                ClusteredDistribution(list(self.right_keys))]
+
+    def output_partitioning(self):
+        return self.left.output_partitioning()
+
+    def execute(self, ctx: ExecContext) -> list[Partition]:
+        from ..ops.joining import build_index
+
+        left_parts = self.left.execute(ctx)
+        right_parts = self.right.execute(ctx)
+        if self.is_broadcast:
+            # broadcast exchange produced one partition; replicate
+            bp = right_parts[0]
+            right_parts = [bp for _ in left_parts]
+        if len(left_parts) != len(right_parts):
+            raise ExecutionError(
+                f"join children partition counts differ: "
+                f"{len(left_parts)} vs {len(right_parts)}")
+        out = []
+        rschema = attrs_schema(self.right.output)
+        lschema = attrs_schema(self.left.output)
+        for lp, rp in zip(left_parts, right_parts):
+            out.append(self._join_partition(lp, rp, lschema, rschema, ctx))
+        return out
+
+    def _join_partition(self, lp: Partition, rp: Partition, lschema, rschema,
+                        ctx) -> Partition:
+        import jax
+
+        from ..ops import joining as J
+
+        jnp = _jnp()
+        build = concat_batches(rp, rschema) if rp else ColumnarBatch.empty(rschema)
+        rpos = {a.expr_id: i for i, a in enumerate(self.right.output)}
+        lpos = {a.expr_id: i for i, a in enumerate(self.left.output)}
+        bkeys = [build.columns[rpos[k.expr_id]] for k in self.right_keys]
+        bkey_eqs = [c.eq_keys() for c in bkeys]
+        bkey_valids = [c.validity for c in bkeys]
+
+        bi_key = ("join_build", build.capacity, len(bkeys),
+                  tuple(str(k.dtype) for k in bkey_eqs),
+                  tuple(v is not None for v in bkey_valids))
+
+        def build_bi():
+            return jax.jit(lambda eqs, valids, mask: J.build_index(eqs, valids, mask))
+
+        bi_kernel = GLOBAL_KERNEL_CACHE.get_or_build(bi_key, build_bi)
+        bindex = bi_kernel(bkey_eqs, bkey_valids, build.row_mask)
+
+        out_batches = []
+        for pb in (lp or [ColumnarBatch.empty(lschema)]):
+            out_batches.append(
+                self._probe_batch(pb, build, bindex, bkey_eqs, bkey_valids,
+                                  lpos, ctx))
+        if self.join_type == "full_outer":
+            out_batches.append(
+                self._unmatched_build_rows(lp, build, lschema, ctx))
+        return out_batches
+
+    def _probe_batch(self, pb: ColumnarBatch, build: ColumnarBatch, bindex,
+                     bkey_eqs, bkey_valids, lpos, ctx) -> ColumnarBatch:
+        import jax
+
+        from ..ops import joining as J
+
+        jnp = _jnp()
+        pkeys = [pb.columns[lpos[k.expr_id]] for k in self.left_keys]
+        pkey_eqs = [c.eq_keys() for c in pkeys]
+        pkey_valids = [c.validity for c in pkeys]
+
+        jt = self.join_type if self.join_type != "full_outer" else "left_outer"
+        out_cap = max(pb.capacity, 1 << 10)
+        while True:
+            key = ("join_probe", jt, pb.capacity, build.capacity, out_cap,
+                   len(pkeys), tuple(str(k.dtype) for k in pkey_eqs),
+                   tuple(v is not None for v in pkey_valids),
+                   tuple(v is not None for v in bkey_valids))
+
+            def build_kernel(oc=out_cap):
+                def kernel(bidx_sorted, bidx_perm, beqs, bvalids, peqs,
+                           pvalids, pmask):
+                    bi = J.BuildSide(bidx_sorted, bidx_perm)
+                    return J.probe_join(bi, beqs, bvalids, peqs, pvalids,
+                                        pmask, oc, jt)
+
+                return jax.jit(kernel)
+
+            kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build_kernel)
+            r = kernel(bindex.sorted_hash, bindex.perm, bkey_eqs, bkey_valids,
+                       pkey_eqs, pkey_valids, pb.row_mask)
+            needed = int(r.needed)
+            if needed <= out_cap:
+                break
+            out_cap = bucket_capacity(needed)
+            ctx.metrics.add("join.capacity_retry")
+
+        probe_out = gather_batch(pb, r.probe_idx, r.out_mask)
+        if self.join_type in ("left_semi", "left_anti"):
+            return probe_out
+        null_build = ~r.matched
+        build_out = gather_batch(build, r.build_idx, r.out_mask,
+                                 extra_invalid=null_build)
+        schema = attrs_schema(self.output)
+        cols = probe_out.columns + build_out.columns
+        return ColumnarBatch(schema, cols, r.out_mask, num_rows=None)
+
+    def _unmatched_build_rows(self, lp: Partition, build: ColumnarBatch,
+                              lschema, ctx) -> ColumnarBatch:
+        """full_outer extension: anti-join build side against probe keys."""
+        import jax
+
+        from ..ops import joining as J
+
+        jnp = _jnp()
+        probe_all = concat_batches(lp, lschema) if lp \
+            else ColumnarBatch.empty(lschema)
+        lpos = {a.expr_id: i for i, a in enumerate(self.left.output)}
+        pkeys = [probe_all.columns[lpos[k.expr_id]] for k in self.left_keys]
+        pkey_eqs = [c.eq_keys() for c in pkeys]
+        pkey_valids = [c.validity for c in pkeys]
+        rpos = {a.expr_id: i for i, a in enumerate(self.right.output)}
+        bkeys = [build.columns[rpos[k.expr_id]] for k in self.right_keys]
+        bkey_eqs = [c.eq_keys() for c in bkeys]
+        bkey_valids = [c.validity for c in bkeys]
+
+        # swap: probe = build side, build = probe side; left_anti
+        pi = J.build_index(pkey_eqs, pkey_valids, probe_all.row_mask)
+        out_cap = build.capacity
+        r = J.probe_join(pi, pkey_eqs, pkey_valids, bkey_eqs, bkey_valids,
+                         build.row_mask, out_cap, "left_anti")
+        build_rows = gather_batch(build, r.probe_idx, r.out_mask)
+        schema = attrs_schema(self.output)
+        nl = len(self.left.output)
+        from ..columnar.batch import EMPTY_DICT
+
+        jnpmod = _jnp()
+        cap = r.out_mask.shape[0]
+        left_cols = [
+            Column(f.dataType,
+                   jnpmod.zeros(cap, dtype=f.dataType.device_dtype),
+                   jnpmod.zeros(cap, dtype=bool),
+                   EMPTY_DICT if isinstance(f.dataType, StringType) else None)
+            for f in schema.fields[:nl]]
+        cols = left_cols + build_rows.columns
+        return ColumnarBatch(schema, cols, r.out_mask, num_rows=None)
+
+    def simple_string(self):
+        k = ", ".join(f"{l.name}={r.name}"
+                      for l, r in zip(self.left_keys, self.right_keys))
+        b = "Broadcast" if self.is_broadcast else "Shuffled"
+        return f"{b}HashJoin[{self.join_type}]({k})"
+
+
+class NestedLoopJoinExec(PhysicalPlan):
+    """Cartesian product + optional condition (role of
+    BroadcastNestedLoopJoinExec / CartesianProductExec). Build side (right)
+    is broadcast."""
+
+    child_fields = ("left", "right")
+
+    def __init__(self, condition: Expression | None, join_type: str,
+                 left: PhysicalPlan, right: PhysicalPlan):
+        if join_type not in ("inner", "cross"):
+            raise UnsupportedOperationError(
+                f"nested-loop {join_type} join not supported yet")
+        self.condition = condition
+        self.join_type = join_type
+        self.left = left
+        self.right = right
+        self._cond_pipeline: ExprPipeline | None = None
+
+    @property
+    def output(self):
+        return self.left.output + self.right.output
+
+    def required_child_distribution(self):
+        return [UnspecifiedDistribution(), BroadcastDistribution()]
+
+    def execute(self, ctx: ExecContext) -> list[Partition]:
+        import jax
+
+        from ..ops.joining import cross_join
+
+        jnp = _jnp()
+        left_parts = self.left.execute(ctx)
+        build = self.right.execute(ctx)[0]
+        rschema = attrs_schema(self.right.output)
+        lschema = attrs_schema(self.left.output)
+        bbatch = concat_batches(build, rschema) if build \
+            else ColumnarBatch.empty(rschema)
+        nb = bbatch.num_rows()
+        schema = attrs_schema(self.output)
+
+        cond_pipe = None
+        if self.condition is not None:
+            cond_pipe = ExprPipeline(self.output, [self.condition],
+                                     list(self.output), schema)
+
+        out = []
+        for part in left_parts:
+            obatches = []
+            for pb in (part or [ColumnarBatch.empty(lschema)]):
+                np_rows = pb.num_rows()
+                out_cap = bucket_capacity(max(np_rows * max(nb, 1), 1))
+                r = cross_join(pb.row_mask, bbatch.row_mask, out_cap)
+                if int(r.needed) > out_cap:
+                    r = cross_join(pb.row_mask, bbatch.row_mask,
+                                   bucket_capacity(int(r.needed)))
+                probe_out = gather_batch(pb, r.probe_idx, r.out_mask)
+                build_out = gather_batch(bbatch, r.build_idx, r.out_mask)
+                joined = ColumnarBatch(schema,
+                                       probe_out.columns + build_out.columns,
+                                       r.out_mask, num_rows=None)
+                if cond_pipe is not None:
+                    joined = cond_pipe.run(joined)
+                obatches.append(joined)
+            out.append(obatches)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Union / Coalesce
+# ---------------------------------------------------------------------------
+
+class UnionExec(PhysicalPlan):
+    child_fields = ("children_plans",)
+
+    def __init__(self, children_plans: Sequence[PhysicalPlan],
+                 attrs: list[AttributeReference]):
+        self.children_plans = list(children_plans)
+        self.attrs = attrs
+
+    @property
+    def output(self):
+        return self.attrs
+
+    def output_partitioning(self):
+        n = sum(c.output_partitioning().num_partitions
+                for c in self.children_plans)
+        return UnknownPartitioning(n)
+
+    def execute(self, ctx: ExecContext) -> list[Partition]:
+        out: list[Partition] = []
+        schema = attrs_schema(self.attrs)
+        for c in self.children_plans:
+            for part in c.execute(ctx):
+                # rewrap batches under union output schema (names may differ)
+                out.append([ColumnarBatch(schema, b.columns, b.row_mask,
+                                          b._num_rows) for b in part])
+        return out
+
+
+class CoalescePartitionsExec(PhysicalPlan):
+    child_fields = ("child",)
+
+    def __init__(self, num_partitions: int, child: PhysicalPlan):
+        self.num_partitions = max(1, num_partitions)
+        self.child = child
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def output_partitioning(self):
+        if self.num_partitions == 1:
+            return SinglePartition()
+        return UnknownPartitioning(self.num_partitions)
+
+    def execute(self, ctx: ExecContext) -> list[Partition]:
+        parts = self.child.execute(ctx)
+        n = self.num_partitions
+        out: list[Partition] = [[] for _ in range(min(n, max(len(parts), 1)))]
+        for i, p in enumerate(parts):
+            out[i % len(out)].extend(p)
+        return out

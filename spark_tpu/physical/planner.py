@@ -1,0 +1,416 @@
+"""Physical planner: LogicalPlan → PhysicalPlan.
+
+Role of the reference's SparkPlanner/SparkStrategies (sqlx/
+SparkStrategies.scala — join selection, aggregate planning via
+sqlx/aggregate/AggUtils.scala) plus EnsureRequirements
+(sqlx/exchange/EnsureRequirements.scala:51 — inserts exchanges where a
+child's partitioning doesn't satisfy the parent's required distribution).
+
+Planner contracts established here (and relied on by operators):
+  * exchange/join/sort/grouping keys are always bound to attributes —
+    complex keys get pre-projected via ComputeExec;
+  * aggregates are always planned as partial→(exchange)→final with a
+    finishing ComputeExec evaluating result expressions over buffers;
+  * right outer joins are flipped to left joins over swapped children.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..config import AUTO_BROADCAST_THRESHOLD, SHUFFLE_PARTITIONS, SQLConf
+from ..errors import UnsupportedOperationError
+from ..plan import logical as L
+from ..plan.optimizer import join_conjuncts, split_conjuncts, substitute_attrs
+from ..expr.expressions import (
+    AggregateFunction, Alias, AttributeReference, EqualTo, Expression,
+    Literal, SortOrder,
+)
+from ..types import DataType, StringType, DecimalType
+from .aggregates import AggSpec, lower_aggregate_function
+from .exchange import BroadcastExchangeExec, ShuffleExchangeExec
+from .operators import (
+    CoalescePartitionsExec, ComputeExec, HashAggregateExec, HashJoinExec,
+    LimitExec, LocalTableScanExec, NestedLoopJoinExec, PhysicalPlan, RangeExec,
+    ScanExec, SortExec, UnionExec,
+)
+from .partitioning import (
+    AllTuples, BroadcastDistribution, ClusteredDistribution, Distribution,
+    HashPartitioning, OrderedDistribution, RangePartitioning, SinglePartition,
+    UnspecifiedDistribution,
+)
+
+
+def _row_width(attrs: Sequence[AttributeReference]) -> int:
+    w = 0
+    for a in attrs:
+        w += max(int(a.dtype.device_dtype.itemsize), 4)
+    return max(w, 8)
+
+
+class Planner:
+    def __init__(self, conf: SQLConf):
+        self.conf = conf
+
+    # ------------------------------------------------------------------
+    def plan(self, plan: L.LogicalPlan) -> PhysicalPlan:
+        p = self._convert(plan)
+        p = self._ensure_requirements(p)
+        return p
+
+    # ------------------------------------------------------------------
+    def _convert(self, node: L.LogicalPlan) -> PhysicalPlan:
+        if isinstance(node, L.LogicalRelation):
+            return ScanExec(node.source, list(node.attrs), node.name)
+        if isinstance(node, L.LocalRelation):
+            return LocalTableScanExec(list(node.attrs), node.table)
+        if isinstance(node, L.OneRowRelation):
+            import pyarrow as pa
+
+            return LocalTableScanExec(
+                [], pa.table({"__one": pa.array([1], pa.int32())}).select([]))
+        if isinstance(node, L.RangeRelation):
+            return RangeExec(node.start, node.end, node.step,
+                             node.num_partitions, node.attr)
+        if isinstance(node, L.Project):
+            child = self._convert(node.child)
+            return self._fuse_compute([], node.project_list, child)
+        if isinstance(node, L.Filter):
+            child = self._convert(node.child)
+            return self._fuse_compute(split_conjuncts(node.condition),
+                                      [a for a in node.child.output], child)
+        if isinstance(node, L.Aggregate):
+            return self._plan_aggregate(node)
+        if isinstance(node, L.Sort):
+            return self._plan_sort(node)
+        if isinstance(node, (L.Limit, L.Offset)):
+            return self._plan_limit(node)
+        if isinstance(node, L.Join):
+            return self._plan_join(node)
+        if isinstance(node, L.Union):
+            children = [self._convert(c) for c in node.children_plans]
+            return UnionExec(children, list(node.output))
+        if isinstance(node, L.SubqueryAlias):
+            return self._convert(node.child)
+        if isinstance(node, L.Repartition):
+            child = self._convert(node.child)
+            n = node.num_partitions or self.conf.shuffle_partitions
+            if not node.shuffle:
+                return CoalescePartitionsExec(n, child)
+            if node.partition_exprs:
+                keys, child = self._bind_keys(
+                    [e for e in node.partition_exprs], child, "__repart")
+                return ShuffleExchangeExec(HashPartitioning(keys, n), child)
+            from .partitioning import UnknownPartitioning
+
+            return ShuffleExchangeExec(UnknownPartitioning(n), child)
+        if isinstance(node, L.Distinct):
+            # optimizer normally rewrites; safety net
+            out = node.child.output
+            return self._plan_aggregate(
+                L.Aggregate(list(out), list(out), node.child))
+        raise UnsupportedOperationError(
+            f"no physical plan for {type(node).__name__}")
+
+    # ------------------------------------------------------------------
+    def _fuse_compute(self, filters: list[Expression],
+                      outputs: list[Expression],
+                      child: PhysicalPlan) -> PhysicalPlan:
+        """Fuse into an existing ComputeExec child when safe (the
+        CollapseCodegenStages analog)."""
+        if isinstance(child, ComputeExec):
+            # child outputs: mapping from its output ids to its exprs
+            m: dict[int, Expression] = {}
+            for e in child.outputs:
+                if isinstance(e, Alias):
+                    m[e.expr_id] = e.child
+                elif isinstance(e, AttributeReference):
+                    m[e.expr_id] = e
+            new_filters = [substitute_attrs(f, m) for f in filters]
+            new_outputs: list[Expression] = []
+            for o in outputs:
+                if isinstance(o, Alias):
+                    new_outputs.append(
+                        Alias(substitute_attrs(o.child, m), o.name, o.expr_id))
+                    continue
+                sub = m.get(o.expr_id)
+                if sub is None or (isinstance(sub, AttributeReference)
+                                   and sub.expr_id == o.expr_id):
+                    new_outputs.append(o)
+                else:
+                    new_outputs.append(Alias(sub, o.name, o.expr_id))
+            return ComputeExec(child.filters + new_filters, new_outputs,
+                               child.child)
+        return ComputeExec(filters, outputs, child)
+
+    # ------------------------------------------------------------------
+    def _bind_keys(self, exprs: list[Expression], child: PhysicalPlan,
+                   prefix: str) -> tuple[list[AttributeReference], PhysicalPlan]:
+        """Ensure exprs are attributes of child output; project complex ones."""
+        child_ids = {a.expr_id for a in child.output}
+        keys: list[AttributeReference] = []
+        extra: list[Alias] = []
+        for i, e in enumerate(exprs):
+            if isinstance(e, AttributeReference) and e.expr_id in child_ids:
+                keys.append(e)
+            else:
+                al = Alias(e, f"{prefix}_{i}")
+                extra.append(al)
+                keys.append(al.to_attribute())
+        if extra:
+            outputs = list(child.output) + list(extra)
+            child = self._fuse_compute([], outputs, child)
+        return keys, child
+
+    # ------------------------------------------------------------------
+    def _plan_aggregate(self, node: L.Aggregate) -> PhysicalPlan:
+        child = self._convert(node.child)
+
+        # 1. bind grouping keys to attributes
+        group_keys, child = self._bind_keys(list(node.grouping_exprs), child,
+                                            "__group")
+        group_map: list[tuple[Expression, AttributeReference]] = list(
+            zip(node.grouping_exprs, group_keys))
+
+        # 2. collect distinct aggregate functions across output exprs
+        funcs: list[AggregateFunction] = []
+
+        def collect(e: Expression):
+            for n in e.iter_nodes():
+                if isinstance(n, AggregateFunction):
+                    if not any(n.semantic_equals(f) for f in funcs):
+                        funcs.append(n)
+
+        for e in node.aggregate_exprs:
+            collect(e)
+
+        # 3. bind aggregate inputs to attributes
+        arg_exprs = []
+        for f in funcs:
+            if f.child is not None:
+                arg_exprs.append(f.child)
+        arg_attrs, child = self._bind_keys(arg_exprs, child, "__aggarg")
+        arg_map = dict(zip((id(e) for e in arg_exprs), arg_attrs))
+
+        specs: list[AggSpec] = []
+        func_to_spec: list[tuple[AggregateFunction, AggSpec]] = []
+        for i, f in enumerate(funcs):
+            bound_child = arg_map[id(f.child)] if f.child is not None else None
+            bound = f.copy(child=bound_child) if f.child is not None else f
+            spec = lower_aggregate_function(bound, f"__agg{i}", None or
+                                            _fresh_id())
+            specs.append(spec)
+            func_to_spec.append((f, spec))
+
+        partial = HashAggregateExec(group_keys, specs, "partial", child)
+        final = HashAggregateExec(group_keys, specs, "final", partial)
+
+        # 4. finishing projection: replace agg funcs with spec result exprs,
+        #    grouping exprs with grouping attrs
+        outputs: list[Expression] = []
+        for e in node.aggregate_exprs:
+            outputs.append(self._finish_expr(e, func_to_spec, group_map))
+        return ComputeExec([], outputs, final)
+
+    def _finish_expr(self, e: Expression, func_to_spec, group_map):
+        def replace(x: Expression) -> Expression:
+            for g, attr in group_map:
+                if x.semantic_equals(g):
+                    return attr
+            for f, spec in func_to_spec:
+                if x.semantic_equals(f):
+                    return spec.result_alias.child
+            return x
+
+        if isinstance(e, Alias):
+            return Alias(e.child.transform_down(replace), e.name, e.expr_id)
+        if isinstance(e, AttributeReference):
+            # grouping attr passthrough
+            for g, attr in group_map:
+                if e.semantic_equals(g):
+                    return e if e.expr_id == attr.expr_id else Alias(
+                        attr, e.name, e.expr_id)
+            return e
+        return Alias(e.transform_down(replace), _auto_name(e))
+
+    # ------------------------------------------------------------------
+    def _plan_sort(self, node: L.Sort) -> PhysicalPlan:
+        child = self._convert(node.child)
+        key_exprs = [o.child for o in node.orders]
+        keys, child = self._bind_keys(key_exprs, child, "__sort")
+        orders = [SortOrder(k, o.ascending, o.nulls_first)
+                  for k, o in zip(keys, node.orders)]
+        sort = SortExec(orders, child)
+        sort.is_global = node.is_global
+        # drop helper columns if we added any
+        if len(child.output) != len(node.output):
+            return ComputeExec([], list(node.output), sort)
+        return sort
+
+    # ------------------------------------------------------------------
+    def _plan_limit(self, node) -> PhysicalPlan:
+        if isinstance(node, L.Offset):
+            child = self._convert(node.child)
+            return LimitExec(1 << 62, child, offset=node.n, is_global=True)
+        inner = node.child
+        offset = 0
+        if isinstance(inner, L.Offset):
+            offset = inner.n
+            inner = inner.child
+        child = self._convert(inner)
+        local = LimitExec(node.n + offset, child, is_global=False)
+        return LimitExec(node.n, local, offset=offset, is_global=True)
+
+    # ------------------------------------------------------------------
+    def _plan_join(self, node: L.Join) -> PhysicalPlan:
+        jt = node.join_type
+        left_l, right_l = node.left, node.right
+
+        # flip right joins: build side is always right, probe left
+        flipped = False
+        if jt == "right_outer":
+            left_l, right_l = right_l, left_l
+            jt = "left_outer"
+            flipped = True
+
+        left = self._convert(left_l)
+        right = self._convert(right_l)
+
+        # split condition into equi keys and residual
+        equi: list[tuple[Expression, Expression]] = []
+        residual: list[Expression] = []
+        if node.condition is not None:
+            lids = {a.expr_id for a in left_l.output}
+            rids = {a.expr_id for a in right_l.output}
+            for c in split_conjuncts(node.condition):
+                if isinstance(c, EqualTo):
+                    lr, rr = c.left.references(), c.right.references()
+                    if lr and rr and lr <= lids and rr <= rids:
+                        equi.append((c.left, c.right))
+                        continue
+                    if lr and rr and lr <= rids and rr <= lids:
+                        equi.append((c.right, c.left))
+                        continue
+                residual.append(c)
+
+        if not equi:
+            if jt in ("inner", "cross"):
+                nl = NestedLoopJoinExec(
+                    join_conjuncts(residual) if residual else None,
+                    "cross" if jt == "cross" and not residual else "inner",
+                    left, right)
+                return self._maybe_reorder(nl, node, flipped)
+            raise UnsupportedOperationError(
+                f"non-equi {jt} join not supported yet")
+
+        if residual and jt not in ("inner",):
+            raise UnsupportedOperationError(
+                f"{jt} join with non-equi residual not supported yet")
+
+        lkeys, left = self._bind_keys([lk for lk, _ in equi], left, "__jkl")
+        rkeys, right = self._bind_keys([rk for _, rk in equi], right, "__jkr")
+
+        broadcast = self._can_broadcast(right_l, jt)
+        join = HashJoinExec(lkeys, rkeys, jt, left, right,
+                            is_broadcast=broadcast)
+
+        out: PhysicalPlan = join
+        if residual:
+            out = self._fuse_compute(residual, list(join.output), join)
+        # drop helper key columns
+        want = self._expected_join_output(node, flipped)
+        if [a.expr_id for a in out.output] != [a.expr_id for a in want]:
+            out = self._fuse_compute([], want, out) if not isinstance(out, ComputeExec) \
+                else ComputeExec(out.filters, want, out.child)
+        return out
+
+    def _expected_join_output(self, node: L.Join, flipped: bool):
+        return list(node.output)
+
+    def _maybe_reorder(self, plan: PhysicalPlan, node: L.Join, flipped: bool):
+        want = list(node.output)
+        if [a.expr_id for a in plan.output] != [a.expr_id for a in want]:
+            return ComputeExec([], want, plan)
+        return plan
+
+    def _can_broadcast(self, right_logical: L.LogicalPlan, jt: str) -> bool:
+        rows = right_logical.stats_rows()
+        if rows is None:
+            return False
+        width = _row_width(right_logical.output)
+        return rows * width <= int(self.conf.get(AUTO_BROADCAST_THRESHOLD))
+
+    # ------------------------------------------------------------------
+    # EnsureRequirements
+    # ------------------------------------------------------------------
+    def _ensure_requirements(self, plan: PhysicalPlan) -> PhysicalPlan:
+        plan = plan.map_children(
+            lambda c: self._ensure_requirements(c))
+
+        reqs = plan.required_child_distribution()
+        children = plan.children
+        if not children:
+            return plan
+        n_shuffle = self.conf.shuffle_partitions
+
+        new_children = list(children)
+        changed = False
+
+        if isinstance(plan, HashJoinExec) and not plan.is_broadcast:
+            l, r = children
+            lp, rp = l.output_partitioning(), r.output_partitioning()
+            lreq, rreq = reqs
+            ok = (lp.satisfies(lreq) and rp.satisfies(rreq)
+                  and lp.num_partitions == rp.num_partitions)
+            if not ok:
+                new_children[0] = ShuffleExchangeExec(
+                    HashPartitioning(list(plan.left_keys), n_shuffle), l)
+                new_children[1] = ShuffleExchangeExec(
+                    HashPartitioning(list(plan.right_keys), n_shuffle), r)
+                changed = True
+        else:
+            for i, (child, req) in enumerate(zip(children, reqs)):
+                p = child.output_partitioning()
+                if p.satisfies(req):
+                    continue
+                changed = True
+                if isinstance(req, BroadcastDistribution):
+                    new_children[i] = BroadcastExchangeExec(child)
+                elif isinstance(req, AllTuples):
+                    new_children[i] = ShuffleExchangeExec(SinglePartition(),
+                                                          child)
+                elif isinstance(req, ClusteredDistribution):
+                    keys = [e for e in req.exprs
+                            if isinstance(e, AttributeReference)]
+                    new_children[i] = ShuffleExchangeExec(
+                        HashPartitioning(keys, n_shuffle), child)
+                elif isinstance(req, OrderedDistribution):
+                    new_children[i] = ShuffleExchangeExec(
+                        RangePartitioning(req.orders, n_shuffle), child)
+                else:
+                    continue
+        # global sort needs range partitioning
+        if isinstance(plan, SortExec) and getattr(plan, "is_global", False):
+            child = new_children[0]
+            p = child.output_partitioning()
+            od = OrderedDistribution(plan.orders)
+            if not p.satisfies(od) and p.num_partitions > 1:
+                new_children[0] = ShuffleExchangeExec(
+                    RangePartitioning(plan.orders, n_shuffle), child)
+                changed = True
+        if changed:
+            return plan.with_new_children(new_children)
+        return plan
+
+
+_id_box = [None]
+
+
+def _fresh_id() -> int:
+    from ..plan.tree import next_id
+
+    return next_id()
+
+
+def _auto_name(e: Expression) -> str:
+    return e.simple_string()[:40]

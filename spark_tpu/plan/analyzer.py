@@ -187,7 +187,8 @@ class ResolveReferences(Rule):
                         return a
                     return e
                 if isinstance(e, UnresolvedFunction):
-                    if all(c.resolved for c in e.args):
+                    if all(c.resolved or isinstance(c, UnresolvedStar)
+                           for c in e.args):
                         return build_function(e.fname, e.args, e.distinct)
                     return e
                 return e
@@ -283,7 +284,8 @@ class ResolveAggsInSortHaving(Rule):
                             return a
                         return e
                     if isinstance(e, UnresolvedFunction):
-                        if all(c.resolved for c in e.args):
+                        if all(c.resolved or isinstance(c, UnresolvedStar)
+                               for c in e.args):
                             f = build_function(e.fname, e.args, e.distinct)
                             if isinstance(f, AggregateFunction):
                                 # match an existing aggregate output

@@ -378,57 +378,83 @@ def _already_filtered(p: LogicalPlan, conds: list[Expression]) -> bool:
 
 
 class ColumnPruning(Rule):
-    """Insert/narrow Projects so only referenced columns flow up from scans
-    (reference: Optimizer ColumnPruning)."""
+    """Single top-down pass narrowing projects, aggregates, and scans to the
+    columns actually required above them (reference: Optimizer ColumnPruning;
+    the scan narrowing is what drives parquet column pushdown)."""
 
     def apply(self, plan):
-        def rule(node):
-            for i, child in enumerate(node.children):
-                needed = self._needed_from_child(node, i)
-                if needed is None:
-                    continue
-                have = [a.expr_id for a in child.output]
-                if set(have) - needed and len(have) > len(set(have) & needed):
-                    keep = [a for a in child.output if a.expr_id in needed]
-                    if not keep:
-                        keep = child.output[:1]
-                    if isinstance(child, Project):
-                        new_child = Project(
-                            [e for e in child.project_list
-                             if _out_id(e) in needed] or child.project_list[:1],
-                            child.child)
-                    elif isinstance(child, (LogicalRelation, LocalRelation,
-                                            Aggregate, SubqueryAlias, Join,
-                                            Filter, Union, Window)):
-                        new_child = Project(keep, child)
-                    else:
-                        continue
-                    kids = list(node.children)
-                    kids[i] = new_child
-                    return node.with_new_children(kids)
-            return node
-
-        # apply top-down so outermost requirements propagate
-        out = plan.transform_down(rule)
+        required = {a.expr_id for a in plan.output}
+        out = self._prune(plan, required)
         return _collapse_adjacent_projects(out)
 
-    def _needed_from_child(self, node: LogicalPlan, i: int) -> set[int] | None:
-        if isinstance(node, (Project, Aggregate, Filter, Join, Sort, Window,
-                             Expand, Repartition)):
-            needed: set[int] = set()
+    def _prune(self, node: LogicalPlan, required: set[int]) -> LogicalPlan:
+        if isinstance(node, Project):
+            new_list = [e for e in node.project_list
+                        if _out_id(e) in required]
+            if not new_list:
+                new_list = node.project_list[:1]
+            child_req: set[int] = set()
+            for e in new_list:
+                child_req |= e.references()
+            return Project(new_list, self._prune(node.child, child_req))
+        if isinstance(node, Aggregate):
+            new_aggs = [e for e in node.aggregate_exprs
+                        if _out_id(e) in required]
+            if not new_aggs:
+                new_aggs = node.aggregate_exprs[:1]
+            child_req = set()
+            for e in list(node.grouping_exprs) + new_aggs:
+                child_req |= e.references()
+            return Aggregate(node.grouping_exprs, new_aggs,
+                             self._prune(node.child, child_req))
+        if isinstance(node, (Filter, Sort, Limit, Offset, Sample, Repartition,
+                             Distinct, SubqueryAlias)):
+            child_req = set(required)
             for e in node.expressions():
-                needed |= e.references()
-            if isinstance(node, (Filter, Sort, Repartition)):
-                # pass-through operators also forward their own output
-                needed |= {a.expr_id for a in node.output}
-            if isinstance(node, Window):
-                needed |= {a.expr_id for a in node.child.output}
-            if isinstance(node, Join):
-                # join forwards both sides' outputs upward; pruning decisions
-                # happen above the join, so require node.output too
-                needed |= {a.expr_id for a in node.output}
-            return needed
-        return None
+                child_req |= e.references()
+            if isinstance(node, Distinct):
+                child_req |= {a.expr_id for a in node.child.output}
+            new_child = self._prune(node.child, child_req)
+            if new_child is not node.child:
+                return node.copy(child=new_child)
+            return node
+        if isinstance(node, Join):
+            cond_refs: set[int] = set()
+            if node.condition is not None:
+                cond_refs = node.condition.references()
+            lids = {a.expr_id for a in node.left.output}
+            rids = {a.expr_id for a in node.right.output}
+            lreq = (required | cond_refs) & lids
+            rreq = (required | cond_refs) & rids
+            nl = self._prune_side(node.left, lreq)
+            nr = self._prune_side(node.right, rreq)
+            if nl is not node.left or nr is not node.right:
+                return node.copy(left=nl, right=nr)
+            return node
+        if isinstance(node, LogicalRelation):
+            keep = [a for a in node.attrs if a.expr_id in required]
+            if not keep:
+                keep = node.attrs[:1]
+            if len(keep) != len(node.attrs):
+                return node.copy(attrs=keep)
+            return node
+        if isinstance(node, Window):
+            child_req = {a.expr_id for a in node.child.output}
+            for e in node.expressions():
+                child_req |= e.references()
+            return node.copy(child=self._prune(node.child, child_req))
+        # Union (positional semantics), LocalRelation, leaves: conservative
+        return node.map_children(
+            lambda c: self._prune(c, {a.expr_id for a in c.output}))
+
+    def _prune_side(self, side: LogicalPlan, req: set[int]) -> LogicalPlan:
+        have = [a.expr_id for a in side.output]
+        if set(have) - req:
+            keep = [a for a in side.output if a.expr_id in req]
+            if not keep:
+                keep = side.output[:1]
+            return Project(keep, self._prune(side, set(req)))
+        return self._prune(side, req)
 
 
 def _out_id(e: Expression) -> int | None:

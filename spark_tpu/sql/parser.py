@@ -1,0 +1,702 @@
+"""SQL parser: text → unresolved LogicalPlan.
+
+Role of the reference's AstBuilder over the ANTLR grammar
+(sqlcat/parser/AstBuilder.scala, 8077 LoC; grammar sql/api/src/main/antlr4/
+SqlBaseParser.g4). Hand-rolled recursive descent + Pratt expression parsing
+covering the analytic-SQL core: SELECT/FROM/JOIN (all types, ON/USING)/
+WHERE/GROUP BY (incl. ordinals)/HAVING/ORDER BY/LIMIT/OFFSET, UNION [ALL],
+WITH CTEs, subqueries in FROM, CASE/CAST/IN/LIKE/BETWEEN/IS NULL, date
+literals, and a data-type grammar.
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal as _decimal
+
+from ..errors import ParseException
+from ..plan import logical as L
+from ..expr import expressions as E
+from ..types import (
+    BooleanType, DataType, DateType, DecimalType, DoubleType, FloatType,
+    IntegerType, LongType, ShortType, StringType, TimestampType, boolean,
+    date, float32, float64, int8, int16, int32, int64, string, timestamp,
+)
+from .lexer import Token, tokenize
+
+
+def parse_sql(text: str) -> L.LogicalPlan:
+    p = Parser(tokenize(text))
+    plan = p.parse_statement()
+    p.expect_eof()
+    return plan
+
+
+def parse_expression(text: str) -> E.Expression:
+    p = Parser(tokenize(text))
+    e = p.parse_named_expression()
+    p.expect_eof()
+    return e
+
+
+def parse_data_type(text: str) -> DataType:
+    p = Parser(tokenize(text))
+    t = p.parse_type()
+    p.expect_eof()
+    return t
+
+
+class Parser:
+    def __init__(self, tokens: list[Token]):
+        self.toks = tokens
+        self.i = 0
+
+    # --- token helpers ----------------------------------------------------
+    def peek(self, k: int = 0) -> Token:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> Token:  # noqa: A003
+        t = self.toks[self.i]
+        if t.kind != "eof":
+            self.i += 1
+        return t
+
+    def at_kw(self, *words: str) -> bool:
+        t = self.peek()
+        return t.kind == "kw" and t.value.lower() in words
+
+    def eat_kw(self, *words: str) -> bool:
+        if self.at_kw(*words):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, word: str) -> None:
+        if not self.eat_kw(word):
+            raise ParseException(
+                f"expected {word.upper()} near {self.peek().value!r}")
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == "op" and t.value in ops
+
+    def eat_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.eat_op(op):
+            raise ParseException(
+                f"expected {op!r} near {self.peek().value!r} "
+                f"(pos {self.peek().pos})")
+
+    def expect_eof(self) -> None:
+        t = self.peek()
+        if t.kind != "eof" and not (t.kind == "op" and t.value == ";"):
+            raise ParseException(f"unexpected trailing input {t.value!r}")
+
+    def ident(self) -> str:
+        t = self.peek()
+        if t.kind in ("ident", "kw"):
+            self.next()
+            return t.value
+        raise ParseException(f"expected identifier near {t.value!r}")
+
+    # --- statements -------------------------------------------------------
+    def parse_statement(self) -> L.LogicalPlan:
+        if self.at_kw("with"):
+            return self.parse_query()
+        if self.at_kw("select", "values"):
+            return self.parse_query()
+        if self.at_op("("):
+            return self.parse_query()
+        raise ParseException(
+            f"unsupported statement near {self.peek().value!r}")
+
+    def parse_query(self) -> L.LogicalPlan:
+        ctes: dict[str, L.LogicalPlan] = {}
+        if self.eat_kw("with"):
+            while True:
+                name = self.ident()
+                self.expect_kw("as") if self.at_kw("as") else None
+                self.expect_op("(")
+                sub = self.parse_query()
+                self.expect_op(")")
+                ctes[name.lower()] = L.SubqueryAlias(name, sub)
+                if not self.eat_op(","):
+                    break
+        plan = self.parse_set_expr()
+        plan = self._order_limit(plan)
+        if ctes:
+            plan = _substitute_ctes(plan, ctes)
+        return plan
+
+    def parse_set_expr(self) -> L.LogicalPlan:
+        left = self.parse_term_query()
+        while self.at_kw("union"):
+            self.next()
+            distinct = True
+            if self.eat_kw("all"):
+                distinct = False
+            else:
+                self.eat_kw("distinct")
+            right = self.parse_term_query()
+            left = L.Union([left, right])
+            if distinct:
+                left = L.Distinct(left)
+        return left
+
+    def parse_term_query(self) -> L.LogicalPlan:
+        if self.eat_op("("):
+            q = self.parse_query()
+            self.expect_op(")")
+            return q
+        if self.at_kw("values"):
+            return self.parse_values()
+        return self.parse_select()
+
+    def parse_values(self) -> L.LogicalPlan:
+        self.expect_kw("values")
+        rows = []
+        while True:
+            self.expect_op("(")
+            row = [self.parse_expr()]
+            while self.eat_op(","):
+                row.append(self.parse_expr())
+            self.expect_op(")")
+            rows.append(row)
+            if not self.eat_op(","):
+                break
+        import pyarrow as pa
+
+        from ..plan.optimizer import const_value
+
+        ncols = len(rows[0])
+        cols = {}
+        for c in range(ncols):
+            vals = []
+            for r in rows:
+                ok, v = const_value(r[c])
+                if not ok:
+                    raise ParseException("VALUES entries must be literals")
+                vals.append(v)
+            cols[f"col{c + 1}"] = vals
+        table = pa.table(cols)
+        from ..types import from_arrow_type
+
+        attrs = [E.AttributeReference(f.name, from_arrow_type(f.type), True)
+                 for f in table.schema]
+        return L.LocalRelation(attrs, table)
+
+    def parse_select(self) -> L.LogicalPlan:
+        self.expect_kw("select")
+        distinct = False
+        if self.eat_kw("distinct"):
+            distinct = True
+        else:
+            self.eat_kw("all")
+        select_list = [self.parse_named_expression()]
+        while self.eat_op(","):
+            select_list.append(self.parse_named_expression())
+
+        plan: L.LogicalPlan
+        if self.eat_kw("from"):
+            plan = self.parse_relation()
+            while self.eat_op(","):
+                right = self.parse_relation()
+                plan = L.Join(plan, right, "cross", None)
+        else:
+            plan = L.OneRowRelation()
+
+        if self.eat_kw("where"):
+            plan = L.Filter(self.parse_expr(), plan)
+
+        group_exprs = None
+        if self.at_kw("group"):
+            self.next()
+            self.expect_kw("by")
+            group_exprs = [self.parse_expr()]
+            while self.eat_op(","):
+                group_exprs.append(self.parse_expr())
+
+        having = None
+        if self.eat_kw("having"):
+            having = self.parse_expr()
+
+        has_agg = any(_contains_agg(e) for e in select_list)
+        if group_exprs is not None or has_agg or having is not None:
+            groups = group_exprs or []
+            # GROUP BY ordinals
+            resolved_groups = []
+            for g in groups:
+                if isinstance(g, E.Literal) and isinstance(g.value, int):
+                    idx = g.value - 1
+                    if not (0 <= idx < len(select_list)):
+                        raise ParseException(f"GROUP BY position {g.value}")
+                    tgt = select_list[idx]
+                    resolved_groups.append(
+                        tgt.child if isinstance(tgt, E.Alias) else tgt)
+                else:
+                    resolved_groups.append(g)
+            plan = L.Aggregate(resolved_groups, list(select_list), plan)
+            if having is not None:
+                plan = L.Filter(having, plan)
+        else:
+            plan = L.Project(list(select_list), plan)
+
+        if distinct:
+            plan = L.Distinct(plan)
+        return plan
+
+    def _order_limit(self, plan: L.LogicalPlan) -> L.LogicalPlan:
+        if self.at_kw("order"):
+            self.next()
+            self.expect_kw("by")
+            orders = [self.parse_sort_item(plan)]
+            while self.eat_op(","):
+                orders.append(self.parse_sort_item(plan))
+            plan = L.Sort(orders, True, plan)
+        if self.eat_kw("limit"):
+            t = self.next()
+            if t.kind != "num":
+                raise ParseException("LIMIT expects a number")
+            plan = L.Limit(int(t.value.rstrip("LlDdSs")), plan)
+        if self.eat_kw("offset"):
+            t = self.next()
+            plan = L.Offset(int(t.value.rstrip("LlDdSs")), plan)
+        return plan
+
+    def parse_sort_item(self, plan) -> E.SortOrder:
+        e = self.parse_expr()
+        # ORDER BY ordinal
+        if isinstance(e, E.Literal) and isinstance(e.value, int) and \
+                isinstance(plan, (L.Project, L.Aggregate)):
+            lst = plan.project_list if isinstance(plan, L.Project) \
+                else plan.aggregate_exprs
+            idx = e.value - 1
+            if 0 <= idx < len(lst):
+                tgt = lst[idx]
+                if isinstance(tgt, E.Alias):
+                    e = E.UnresolvedAttribute([tgt.name])
+                elif isinstance(tgt, E.AttributeReference):
+                    e = tgt
+                elif isinstance(tgt, E.UnresolvedAttribute):
+                    e = tgt
+        asc = True
+        if self.eat_kw("desc"):
+            asc = False
+        else:
+            self.eat_kw("asc")
+        nulls_first = None
+        if self.eat_kw("nulls"):
+            if self.eat_kw("first"):
+                nulls_first = True
+            else:
+                self.expect_kw("last")
+                nulls_first = False
+        return E.SortOrder(e, asc, nulls_first)
+
+    # --- relations --------------------------------------------------------
+    def parse_relation(self) -> L.LogicalPlan:
+        left = self.parse_relation_primary()
+        while True:
+            jt = self._join_type()
+            if jt is None:
+                return left
+            right = self.parse_relation_primary()
+            cond = None
+            using = None
+            if self.eat_kw("on"):
+                cond = self.parse_expr()
+            elif self.eat_kw("using"):
+                self.expect_op("(")
+                using = [self.ident()]
+                while self.eat_op(","):
+                    using.append(self.ident())
+                self.expect_op(")")
+            if using is not None:
+                raise ParseException("JOIN USING not yet supported in SQL; "
+                                     "use ON")
+            left = L.Join(left, right, jt, cond)
+
+    def _join_type(self) -> str | None:
+        if self.eat_kw("cross"):
+            self.expect_kw("join")
+            return "cross"
+        if self.at_kw("join"):
+            self.next()
+            return "inner"
+        if self.eat_kw("inner"):
+            self.expect_kw("join")
+            return "inner"
+        for side in ("left", "right", "full"):
+            if self.at_kw(side):
+                self.next()
+                if side == "left" and self.eat_kw("semi"):
+                    self.expect_kw("join")
+                    return "left_semi"
+                if side == "left" and self.eat_kw("anti"):
+                    self.expect_kw("join")
+                    return "left_anti"
+                self.eat_kw("outer")
+                self.expect_kw("join")
+                return {"left": "left_outer", "right": "right_outer",
+                        "full": "full_outer"}[side]
+        return None
+
+    def parse_relation_primary(self) -> L.LogicalPlan:
+        if self.eat_op("("):
+            sub = self.parse_query()
+            self.expect_op(")")
+            alias = self._maybe_alias()
+            if alias:
+                return L.SubqueryAlias(alias, sub)
+            return sub
+        parts = [self.ident()]
+        while self.eat_op("."):
+            parts.append(self.ident())
+        plan = L.UnresolvedRelation(parts)
+        alias = self._maybe_alias()
+        if alias:
+            return L.SubqueryAlias(alias, plan)
+        return plan
+
+    def _maybe_alias(self) -> str | None:
+        if self.eat_kw("as"):
+            return self.ident()
+        t = self.peek()
+        if t.kind == "ident":
+            self.next()
+            return t.value
+        return None
+
+    # --- expressions ------------------------------------------------------
+    def parse_named_expression(self) -> E.Expression:
+        if self.at_op("*"):
+            self.next()
+            return E.UnresolvedStar()
+        # qualified star: t.*
+        if self.peek().kind in ("ident",) and self.peek(1).value == "." and \
+                self.peek(2).value == "*":
+            target = self.ident()
+            self.next()  # .
+            self.next()  # *
+            return E.UnresolvedStar(target)
+        e = self.parse_expr()
+        if self.eat_kw("as"):
+            return E.Alias(e, self.ident())
+        t = self.peek()
+        if t.kind == "ident":
+            self.next()
+            return E.Alias(e, t.value)
+        return e
+
+    def parse_expr(self) -> E.Expression:
+        return self.parse_or()
+
+    def parse_or(self) -> E.Expression:
+        left = self.parse_and()
+        while self.eat_kw("or"):
+            left = E.Or(left, self.parse_and())
+        return left
+
+    def parse_and(self) -> E.Expression:
+        left = self.parse_not()
+        while self.eat_kw("and"):
+            left = E.And(left, self.parse_not())
+        return left
+
+    def parse_not(self) -> E.Expression:
+        if self.eat_kw("not"):
+            return E.Not(self.parse_not())
+        return self.parse_predicate()
+
+    def parse_predicate(self) -> E.Expression:
+        left = self.parse_additive()
+        while True:
+            if self.at_op("=", "==", "<>", "!=", "<", "<=", ">", ">=", "<=>"):
+                op = self.next().value
+                right = self.parse_additive()
+                cls = {"=": E.EqualTo, "==": E.EqualTo, "<>": E.NotEqualTo,
+                       "!=": E.NotEqualTo, "<": E.LessThan,
+                       "<=": E.LessThanOrEqual, ">": E.GreaterThan,
+                       ">=": E.GreaterThanOrEqual, "<=>": E.EqualNullSafe}[op]
+                left = cls(left, right)
+                continue
+            if self.at_kw("is"):
+                self.next()
+                neg = self.eat_kw("not")
+                self.expect_kw("null")
+                left = E.IsNotNull(left) if neg else E.IsNull(left)
+                continue
+            neg = False
+            save = self.i
+            if self.eat_kw("not"):
+                neg = True
+            if self.eat_kw("in"):
+                self.expect_op("(")
+                if self.at_kw("select"):
+                    raise ParseException(
+                        "IN (subquery) not yet supported")
+                items = [self.parse_expr()]
+                while self.eat_op(","):
+                    items.append(self.parse_expr())
+                self.expect_op(")")
+                left = E.In(left, items)
+                if neg:
+                    left = E.Not(left)
+                continue
+            if self.eat_kw("like"):
+                pat = self.next()
+                if pat.kind != "str":
+                    raise ParseException("LIKE expects a string literal")
+                left = E.Like(left, pat.value)
+                if neg:
+                    left = E.Not(left)
+                continue
+            if self.eat_kw("rlike"):
+                pat = self.next()
+                left = E.RLike(left, pat.value)
+                if neg:
+                    left = E.Not(left)
+                continue
+            if self.eat_kw("between"):
+                lo = self.parse_additive()
+                self.expect_kw("and")
+                hi = self.parse_additive()
+                left = E.And(E.GreaterThanOrEqual(left, lo),
+                             E.LessThanOrEqual(left, hi))
+                if neg:
+                    left = E.Not(left)
+                continue
+            if neg:
+                self.i = save
+            break
+        return left
+
+    def parse_additive(self) -> E.Expression:
+        left = self.parse_multiplicative()
+        while self.at_op("+", "-") or self.at_op("||"):
+            op = self.next().value
+            right = self.parse_multiplicative()
+            if op == "+":
+                left = E.Add(left, right)
+            elif op == "-":
+                left = E.Subtract(left, right)
+            else:
+                left = E.Concat([left, right])
+        return left
+
+    def parse_multiplicative(self) -> E.Expression:
+        left = self.parse_unary()
+        while self.at_op("*", "/", "%") or self.at_kw("div"):
+            if self.eat_kw("div"):
+                right = self.parse_unary()
+                left = E.Cast(E.Divide(left, right), int64)
+                continue
+            op = self.next().value
+            right = self.parse_unary()
+            cls = {"*": E.Multiply, "/": E.Divide, "%": E.Remainder}[op]
+            left = cls(left, right)
+        return left
+
+    def parse_unary(self) -> E.Expression:
+        if self.eat_op("-"):
+            e = self.parse_unary()
+            if isinstance(e, E.Literal) and isinstance(e.value, (int, float)):
+                return E.Literal(-e.value)
+            return E.UnaryMinus(e)
+        if self.eat_op("+"):
+            return self.parse_unary()
+        return self.parse_primary()
+
+    def parse_primary(self) -> E.Expression:
+        t = self.peek()
+        if t.kind == "num":
+            self.next()
+            return _num_literal(t.value)
+        if t.kind == "str":
+            self.next()
+            return E.Literal(t.value)
+        if self.at_kw("true"):
+            self.next()
+            return E.Literal(True)
+        if self.at_kw("false"):
+            self.next()
+            return E.Literal(False)
+        if self.at_kw("null"):
+            self.next()
+            return E.Literal(None)
+        if self.at_kw("date"):
+            save = self.i
+            self.next()
+            if self.peek().kind == "str":
+                s = self.next().value
+                return E.Literal(datetime.date.fromisoformat(s.strip()[:10]))
+            self.i = save
+        if self.at_kw("timestamp"):
+            save = self.i
+            self.next()
+            if self.peek().kind == "str":
+                s = self.next().value
+                return E.Literal(_parse_ts_literal(s))
+            self.i = save
+        if self.at_kw("interval"):
+            raise ParseException("INTERVAL literals not yet supported")
+        if self.at_kw("case"):
+            return self.parse_case()
+        if self.at_kw("cast"):
+            self.next()
+            self.expect_op("(")
+            e = self.parse_expr()
+            self.expect_kw("as")
+            to = self.parse_type()
+            self.expect_op(")")
+            return E.Cast(e, to)
+        if self.at_kw("exists"):
+            raise ParseException("EXISTS subqueries not yet supported")
+        if self.eat_op("("):
+            if self.at_kw("select"):
+                raise ParseException("scalar subqueries not yet supported")
+            e = self.parse_expr()
+            self.expect_op(")")
+            return e
+        if t.kind in ("ident", "kw"):
+            # function call or column reference
+            name = self.ident()
+            if self.at_op("("):
+                return self.parse_function(name)
+            parts = [name]
+            while self.at_op(".") and self.peek(1).kind in ("ident", "kw"):
+                self.next()
+                parts.append(self.ident())
+            return E.UnresolvedAttribute(parts)
+        raise ParseException(f"unexpected token {t.value!r} at {t.pos}")
+
+    def parse_function(self, name: str) -> E.Expression:
+        self.expect_op("(")
+        distinct = False
+        args: list[E.Expression] = []
+        if self.at_op("*"):
+            self.next()
+            args = [E.UnresolvedStar()]
+        elif not self.at_op(")"):
+            if self.eat_kw("distinct"):
+                distinct = True
+            args.append(self.parse_expr())
+            while self.eat_op(","):
+                args.append(self.parse_expr())
+        self.expect_op(")")
+        if self.at_kw("over"):
+            raise ParseException("window functions not yet supported in SQL")
+        return E.UnresolvedFunction(name, args, distinct)
+
+    def parse_case(self) -> E.Expression:
+        self.expect_kw("case")
+        base = None
+        if not self.at_kw("when"):
+            base = self.parse_expr()
+        branches = []
+        while self.eat_kw("when"):
+            cond = self.parse_expr()
+            self.expect_kw("then")
+            val = self.parse_expr()
+            if base is not None:
+                cond = E.EqualTo(base, cond)
+            branches.append((cond, val))
+        els = None
+        if self.eat_kw("else"):
+            els = self.parse_expr()
+        self.expect_kw("end")
+        return E.CaseWhen(branches, els)
+
+    # --- types ------------------------------------------------------------
+    def parse_type(self) -> DataType:
+        name = self.ident().lower()
+        if name in ("int", "integer"):
+            return int32
+        if name in ("bigint", "long"):
+            return int64
+        if name in ("smallint", "short"):
+            return int16
+        if name in ("tinyint", "byte"):
+            return int8
+        if name in ("float", "real"):
+            return float32
+        if name == "double":
+            return float64
+        if name in ("string", "text"):
+            return string
+        if name in ("varchar", "char"):
+            if self.eat_op("("):
+                self.next()
+                self.expect_op(")")
+            return string
+        if name in ("bool", "boolean"):
+            return boolean
+        if name == "date":
+            return date
+        if name == "timestamp":
+            return timestamp
+        if name in ("decimal", "numeric", "dec"):
+            p, s = 10, 0
+            if self.eat_op("("):
+                p = int(self.next().value)
+                if self.eat_op(","):
+                    s = int(self.next().value)
+                self.expect_op(")")
+            return DecimalType(min(p, DecimalType.MAX_PRECISION), s)
+        raise ParseException(f"unknown type {name}")
+
+
+def _num_literal(text: str) -> E.Literal:
+    suffix = ""
+    if text and text[-1] in "LlDdSs":
+        suffix = text[-1].lower()
+        text = text[:-1]
+    if "." in text or "e" in text.lower() or suffix == "d":
+        return E.Literal(float(text))
+    v = int(text)
+    if suffix == "l" or not (-(2 ** 31) <= v < 2 ** 31):
+        return E.Literal(v, int64)
+    return E.Literal(v)
+
+
+def _parse_ts_literal(s: str) -> datetime.datetime:
+    s = s.strip().replace("T", " ")
+    for fmt in ("%Y-%m-%d %H:%M:%S.%f", "%Y-%m-%d %H:%M:%S", "%Y-%m-%d"):
+        try:
+            return datetime.datetime.strptime(s, fmt)
+        except ValueError:
+            continue
+    raise ParseException(f"bad timestamp literal {s!r}")
+
+
+def _contains_agg(e: E.Expression) -> bool:
+    for n in e.iter_nodes():
+        if isinstance(n, E.AggregateFunction):
+            return True
+        if isinstance(n, E.UnresolvedFunction):
+            from ..expr.registry import lookup
+
+            nl = n.fname.lower()
+            if nl in ("sum", "count", "min", "max", "avg", "mean", "first",
+                      "any_value", "stddev", "stddev_samp", "stddev_pop",
+                      "variance", "var_samp", "var_pop", "collect_set",
+                      "first_value"):
+                return True
+    return False
+
+
+def _substitute_ctes(plan: L.LogicalPlan,
+                     ctes: dict[str, L.LogicalPlan]) -> L.LogicalPlan:
+    def rule(node):
+        if isinstance(node, L.UnresolvedRelation):
+            hit = ctes.get(node.name.lower())
+            if hit is not None:
+                return hit
+        return node
+
+    return plan.transform_up(rule)

@@ -1,0 +1,38 @@
+"""Test harness: CPU backend with 8 virtual devices (SURVEY.md §4 —
+the local-cluster analog for distributed logic on one host)."""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pyarrow as pa  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def spark():
+    from spark_tpu import TpuSession
+
+    s = TpuSession("tests", {"spark.sql.shuffle.partitions": 4,
+                             "spark.tpu.batch.capacity": 1 << 12})
+    yield s
+    s.stop()
+
+
+@pytest.fixture()
+def people(spark):
+    df = spark.createDataFrame(pa.table({
+        "name": ["alice", "bob", "carol", "dave", "eve", None],
+        "age": [25, 32, 25, None, 41, 25],
+        "dept": ["eng", "sales", "eng", "eng", "hr", "sales"],
+        "salary": [100.0, 80.5, 120.0, 95.0, None, 70.0],
+    }))
+    df.createOrReplaceTempView("people")
+    return df
