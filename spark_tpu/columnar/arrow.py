@@ -79,12 +79,14 @@ def _chunked_to_numpy(arr: pa.ChunkedArray | pa.Array, dt: DataType):
 
 def record_batch_to_columnar(rb: pa.RecordBatch | pa.Table,
                              schema: StructType | None = None,
-                             capacity: int | None = None) -> ColumnarBatch:
+                             capacity: int | None = None,
+                             num_rows: int | None = None) -> ColumnarBatch:
     import jax.numpy as jnp
 
     if schema is None:
         schema = schema_from_arrow(rb.schema)
-    n = rb.num_rows
+    # pyarrow reports unreliable num_rows for zero-column slices
+    n = num_rows if num_rows is not None else rb.num_rows
     cap = capacity or bucket_capacity(max(n, 1))
     cols = []
     for i, f in enumerate(schema.fields):
@@ -113,8 +115,9 @@ def table_to_batches(table: pa.Table, rows_per_batch: int,
         return
     for start in range(0, n, rows_per_batch):
         chunk = table.slice(start, rows_per_batch)
-        yield record_batch_to_columnar(chunk, schema,
-                                       capacity=bucket_capacity(rows_per_batch))
+        yield record_batch_to_columnar(
+            chunk, schema, capacity=bucket_capacity(rows_per_batch),
+            num_rows=min(rows_per_batch, n - start))
 
 
 def batches_to_table(batches: Iterable[ColumnarBatch]) -> pa.Table:

@@ -11,18 +11,20 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-_M1 = jnp.uint64(0xBF58476D1CE4E5B9)
-_M2 = jnp.uint64(0x94D049BB133111EB)
-_GOLDEN = jnp.uint64(0x9E3779B97F4A7C15)
+# python ints (converted lazily) — module-level device arrays would touch the
+# backend at import time
+_M1 = 0xBF58476D1CE4E5B9
+_M2 = 0x94D049BB133111EB
+_GOLDEN = 0x9E3779B97F4A7C15
 
 
 def mix64(x):
     """splitmix64 finalizer (public-domain constant set)."""
     x = jnp.asarray(x).astype(jnp.int64).view(jnp.uint64)
     x = x ^ (x >> 30)
-    x = x * _M1
+    x = x * jnp.uint64(_M1)
     x = x ^ (x >> 27)
-    x = x * _M2
+    x = x * jnp.uint64(_M2)
     x = x ^ (x >> 31)
     return x.view(jnp.int64)
 
@@ -60,7 +62,8 @@ def hash_columns(cols, validities=None, seed: int = 42):
         if h is None:
             h = k
         else:
-            hu = h.view(jnp.uint64) * jnp.uint64(31) + k.view(jnp.uint64) + _GOLDEN
+            hu = h.view(jnp.uint64) * jnp.uint64(31) + k.view(jnp.uint64) \
+                + jnp.uint64(_GOLDEN)
             h = mix64(hu.view(jnp.int64))
     if h is None:
         raise ValueError("hash_columns needs at least one column")

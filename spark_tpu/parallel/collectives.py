@@ -1,0 +1,101 @@
+"""Intra-slice shuffle over ICI: shard_map + lax.all_to_all.
+
+Role of the reference's shuffle data plane (Netty block transfer,
+core/storage/ShuffleBlockFetcherIterator.scala:86) WITHIN a TPU slice: rows
+never leave the devices — each shard buckets its rows by destination with the
+same hash/sort kernel the host shuffle uses (ops/partition.py), lays them out
+as [P, quota] blocks, and one `lax.all_to_all` swaps blocks across the mesh
+(SURVEY.md §2.5 'Communication backend': data plane = XLA collectives over
+ICI; the host/DCN path in exec/shuffle.py covers cross-slice).
+
+Static shapes: each (src→dst) pair gets a fixed `quota` of rows; a scalar
+`overflow` flag reports rows that did not fit so the caller can retry at a
+bigger quota (same capacity-bucket discipline as the join kernel).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.hashing import hash_columns, partition_ids
+
+
+def _bucket_local(key_eqs, key_valids, row_mask, num_partitions: int,
+                  quota: int):
+    """Per-shard: group rows by destination pid into a [P, quota] layout.
+
+    Returns (perm int32[P*quota] gather indices into local rows (clipped),
+             valid bool[P, quota], overflow int32)."""
+    cap = row_mask.shape[0]
+    h = hash_columns(key_eqs, list(key_valids))
+    pids = partition_ids(h, num_partitions)
+    key = jnp.where(row_mask, pids, num_partitions)
+    skey, perm = lax.sort((key, lax.iota(jnp.int32, cap)), num_keys=1,
+                          is_stable=True)
+    # position of each sorted row within its pid run
+    pos = lax.iota(jnp.int32, cap)
+    run_start = jnp.searchsorted(skey, jnp.arange(num_partitions,
+                                                  dtype=skey.dtype),
+                                 side="left").astype(jnp.int32)
+    within = pos - jnp.take(run_start, jnp.minimum(skey, num_partitions - 1))
+    live = skey < num_partitions
+    fits = live & (within < quota)
+    overflow = jnp.sum((live & ~fits).astype(jnp.int32))
+    # scatter sorted rows into [P, quota] slots
+    slot = jnp.where(fits, skey * quota + within, num_partitions * quota)
+    gather_idx = jnp.full((num_partitions * quota,), 0, dtype=jnp.int32)
+    gather_idx = gather_idx.at[slot].set(perm, mode="drop")
+    slot_valid = jnp.zeros((num_partitions * quota,), dtype=bool)
+    slot_valid = slot_valid.at[slot].set(fits, mode="drop")
+    return gather_idx, slot_valid.reshape(num_partitions, quota), overflow
+
+
+def make_all_to_all_exchange(mesh, num_key_cols: int, num_payload: int,
+                             quota: int, axis_name: str = "data"):
+    """Build a jitted shard_map exchange.
+
+    Inputs (all row-sharded over `axis_name`, per-shard capacity = cap):
+      key_eqs: list of eq-domain arrays, key_valids (or None), payload arrays,
+      row_mask.
+    Output: payload arrays + row_mask re-sharded so equal keys land on the
+    same device; per-shard capacity becomes P*quota. overflow scalar summed
+    across shards."""
+    from jax.sharding import PartitionSpec as P
+
+    n_part = mesh.shape[axis_name]
+
+    def local_fn(key_eqs, key_valids, payloads, row_mask):
+        gather_idx, slot_valid, overflow = _bucket_local(
+            key_eqs, key_valids, row_mask, n_part, quota)
+        out_payloads = []
+        for p in payloads:
+            blocks = jnp.take(p, gather_idx).reshape(n_part, quota)
+            recv = lax.all_to_all(blocks, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=False)
+            out_payloads.append(recv.reshape(n_part * quota))
+        vrecv = lax.all_to_all(slot_valid, axis_name, split_axis=0,
+                               concat_axis=0, tiled=False)
+        new_mask = vrecv.reshape(n_part * quota)
+        total_overflow = lax.psum(overflow, axis_name)
+        return out_payloads, new_mask, total_overflow
+
+    def sharded(key_eqs, key_valids, payloads, row_mask):
+        from jax.experimental.shard_map import shard_map
+
+        in_specs = (
+            [P(axis_name)] * len(key_eqs),
+            [None if v is None else P(axis_name) for v in key_valids],
+            [P(axis_name)] * len(payloads),
+            P(axis_name),
+        )
+        out_specs = ([P(axis_name)] * len(payloads), P(axis_name), P())
+        f = shard_map(local_fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+        return f(key_eqs, key_valids, payloads, row_mask)
+
+    return jax.jit(sharded)

@@ -1,0 +1,44 @@
+"""Device mesh management.
+
+Role of the reference's cluster topology layer (SchedulerBackend knowing its
+executors, core/scheduler/cluster/CoarseGrainedSchedulerBackend.scala) —
+TPU-native: the "cluster" inside a slice is a jax.sharding.Mesh and the
+workers are devices; partition-parallelism maps to the 'data' mesh axis
+(SURVEY.md §2.5 row 1).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def get_mesh(n_devices: int | None = None, axis_name: str = "data"):
+    """1-D mesh over the first n devices (all by default)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def row_sharding(mesh, axis_name: str = "data"):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(axis_name))
+
+
+def replicated_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_rows(arr, mesh, axis_name: str = "data"):
+    """Place a [n]-row array row-sharded over the mesh (n % P == 0)."""
+    import jax
+
+    return jax.device_put(arr, row_sharding(mesh, axis_name))

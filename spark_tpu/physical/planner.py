@@ -153,6 +153,9 @@ class Planner:
         for i, e in enumerate(exprs):
             if isinstance(e, AttributeReference) and e.expr_id in child_ids:
                 keys.append(e)
+            elif isinstance(e, Alias):
+                extra.append(e)
+                keys.append(e.to_attribute())
             else:
                 al = Alias(e, f"{prefix}_{i}")
                 extra.append(al)
@@ -215,7 +218,8 @@ class Planner:
     def _finish_expr(self, e: Expression, func_to_spec, group_map):
         def replace(x: Expression) -> Expression:
             for g, attr in group_map:
-                if x.semantic_equals(g):
+                gc = g.child if isinstance(g, Alias) else g
+                if x.semantic_equals(g) or x.semantic_equals(gc):
                     return attr
             for f, spec in func_to_spec:
                 if x.semantic_equals(f):

@@ -154,7 +154,10 @@ class ResolveReferences(Rule):
         def rule(node: LogicalPlan):
             if not all(c.resolved for c in node.children):
                 return node
-            inputs = node.input_attrs()
+            try:
+                inputs = node.input_attrs()
+            except AnalysisException:
+                return node  # child awaits ResolveAliases
 
             # star expansion in Project/Aggregate
             if isinstance(node, (Project, Aggregate)):
@@ -270,6 +273,9 @@ class ResolveAggsInSortHaving(Rule):
                 agg = _skip_alias(node.child)
                 if not agg.resolved:
                     return node
+                if any(not isinstance(e, (Alias, AttributeReference))
+                       for e in agg.aggregate_exprs):
+                    return node  # wait for ResolveAliases
                 out_attrs = agg.output
 
                 extra: list[Alias] = []
@@ -359,6 +365,57 @@ def _replace_agg(p: LogicalPlan, new_agg: Aggregate) -> LogicalPlan:
     return new_agg
 
 
+class ResolveSortHiddenRefs(Rule):
+    """ORDER BY may reference columns of the FROM clause that are not in the
+    SELECT list (reference: Analyzer ResolveMissingReferences) — resolve them
+    against the project's child and re-project afterwards."""
+
+    def __init__(self, case_sensitive: bool = False):
+        self.cs = case_sensitive
+
+    def apply(self, plan):
+        def rule(node):
+            if not (isinstance(node, Sort) and isinstance(node.child, Project)
+                    and node.child.resolved):
+                return node
+            proj = node.child
+            try:
+                outputs = proj.output
+                hidden = proj.child.output
+            except AnalysisException:
+                return node
+            missing: list[AttributeReference] = []
+            changed = [False]
+
+            def resolve(e):
+                if isinstance(e, UnresolvedAttribute):
+                    a = _resolve_name(e.name_parts, outputs, self.cs)
+                    if a is not None:
+                        changed[0] = True
+                        return a
+                    a = _resolve_name(e.name_parts, hidden, self.cs)
+                    if a is not None:
+                        changed[0] = True
+                        if all(x.expr_id != a.expr_id for x in missing) and \
+                                all(x.expr_id != a.expr_id for x in outputs):
+                            missing.append(a)
+                        return a
+                return e
+
+            new_orders = [SortOrder(o.child.transform_up(resolve),
+                                    o.ascending, o.nulls_first)
+                          for o in node.orders]
+            if missing:
+                inner = Project(list(proj.project_list) + missing, proj.child)
+                return Project(list(outputs),
+                               Sort(new_orders, node.is_global, inner))
+            if changed[0]:
+                return node.copy(orders=new_orders)
+            return node
+
+        return plan.transform_up(rule)
+
+
 class CoerceDecimalArithmetic(Rule):
     """Align decimal scales in Add/Subtract (device repr is scaled int64)."""
 
@@ -411,15 +468,20 @@ class CheckAnalysis(Rule):
 
 
 def _check_agg_expr(e: Expression, grouping_ids: set[int], agg: Aggregate):
+    def matches_grouping(x: Expression) -> bool:
+        for g in agg.grouping_exprs:
+            gc = g.child if isinstance(g, Alias) else g
+            if x.semantic_equals(g) or x.semantic_equals(gc):
+                return True
+        return False
+
     def ok(x: Expression, inside_agg: bool) -> bool:
+        if not inside_agg and matches_grouping(x):
+            return True
         if isinstance(x, AggregateFunction):
             return all(ok(c, True) for c in x.children)
         if isinstance(x, AttributeReference) and not inside_agg:
             if x.expr_id not in grouping_ids:
-                # allow if semantically equal to a grouping expression
-                for g in agg.grouping_exprs:
-                    if g.semantic_equals(x):
-                        return True
                 raise AnalysisException(
                     f"column {x.name} is neither grouped nor aggregated",
                     error_class="MISSING_AGGREGATION")
@@ -443,6 +505,7 @@ class Analyzer(RuleExecutor):
                 DeduplicateRelations(),
                 ResolveReferences(cs),
                 ResolveAggsInSortHaving(cs),
+                ResolveSortHiddenRefs(cs),
                 ResolveAliases(),
             ]),
             Batch("Coercion", FixedPoint(10), [

@@ -469,9 +469,23 @@ def _collapse_adjacent_projects(plan: LogicalPlan) -> LogicalPlan:
     def rule(node):
         if isinstance(node, Project) and isinstance(node.child, Project):
             m = alias_map(node.child.project_list)
-            new_list = [substitute_attrs(e, m) if not isinstance(e, Alias)
-                        else Alias(substitute_attrs(e.child, m), e.name, e.expr_id)
-                        for e in node.project_list]
+            new_list = []
+            for e in node.project_list:
+                if isinstance(e, Alias):
+                    new_list.append(
+                        Alias(substitute_attrs(e.child, m), e.name, e.expr_id))
+                else:
+                    sub = substitute_attrs(e, m)
+                    if sub is e or isinstance(sub, AttributeReference):
+                        # keep the outer name/id stable
+                        if isinstance(sub, AttributeReference) and \
+                                isinstance(e, AttributeReference) and \
+                                sub.expr_id != e.expr_id:
+                            new_list.append(Alias(sub, e.name, e.expr_id))
+                        else:
+                            new_list.append(e if sub is e else sub)
+                    else:
+                        new_list.append(Alias(sub, e.name, e.expr_id))
             return Project(new_list, node.child.child)
         return node
 

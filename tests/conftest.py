@@ -1,5 +1,10 @@
 """Test harness: CPU backend with 8 virtual devices (SURVEY.md §4 —
-the local-cluster analog for distributed logic on one host)."""
+the local-cluster analog for distributed logic on one host).
+
+NOTE: the container's sitecustomize imports jax at interpreter start with
+JAX_PLATFORMS=axon (the TPU tunnel). Env vars are therefore too late —
+jax.config.update is the reliable override, and it also avoids touching the
+tunnel from test processes entirely."""
 
 import os
 
@@ -9,6 +14,7 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402

@@ -1,0 +1,219 @@
+"""DataFrame API tests (role of the reference's DataFrameSuite /
+sql/core/src/test — pandas/numpy as oracle)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_tpu.api.functions as F
+
+
+def _dict(df):
+    return df.toArrow().to_pydict()
+
+
+def test_select_filter(people):
+    out = _dict(people.filter(F.col("age") > 25).select("name", "age")
+                .orderBy("name"))
+    assert out["name"] == ["bob", "eve"]
+    assert out["age"] == [32, 41]
+
+
+def test_filter_string_condition(people):
+    out = _dict(people.filter("age = 25 AND dept = 'eng'").select("name")
+                .orderBy("name"))
+    assert out["name"] == ["alice", "carol"]
+
+
+def test_with_column_arithmetic(people):
+    out = _dict(people.withColumn("double_sal", F.col("salary") * 2)
+                .filter(F.col("name") == "alice")
+                .select("name", "double_sal"))
+    assert out["double_sal"] == [200.0]
+
+
+def test_nulls_filtered_by_comparison(people):
+    # age NULL rows drop from age>0 filter (3-valued logic)
+    assert people.filter(F.col("age") > 0).count() == 5
+
+
+def test_is_null(people):
+    out = _dict(people.filter(F.col("age").isNull()).select("name"))
+    assert out["name"] == ["dave"]
+
+
+def test_groupby_agg(people):
+    out = _dict(people.groupBy("dept").agg(
+        F.count("*").alias("n"),
+        F.sum("age").alias("sa"),
+        F.avg("salary").alias("avg_sal"),
+        F.min("age").alias("mn"),
+        F.max("age").alias("mx"),
+    ).orderBy("dept"))
+    assert out["dept"] == ["eng", "hr", "sales"]
+    assert out["n"] == [3, 1, 2]
+    assert out["sa"] == [50, 41, 57]  # null age excluded
+    assert out["mn"] == [25, 41, 25]
+    assert out["mx"] == [25, 41, 32]
+    assert abs(out["avg_sal"][0] - 105.0) < 1e-9
+
+
+def test_global_agg(people):
+    out = _dict(people.agg(F.count("*").alias("n"),
+                           F.sum("age").alias("s")))
+    assert out["n"] == [6]
+    assert out["s"] == [148]
+
+
+def test_sum_all_null_group(spark):
+    df = spark.createDataFrame(pa.table({
+        "k": [1, 1, 2], "v": pa.array([None, None, 5], pa.int64())}))
+    out = _dict(df.groupBy("k").agg(F.sum("v").alias("s"),
+                                    F.count("v").alias("c")).orderBy("k"))
+    assert out["s"] == [None, 5]
+    assert out["c"] == [0, 1]
+
+
+def test_distinct(people):
+    assert people.select("dept").distinct().count() == 3
+
+
+def test_order_by_desc_nulls(people):
+    out = _dict(people.orderBy(F.col("age").desc()).select("age"))
+    assert out["age"] == [41, 32, 25, 25, 25, None]
+    out2 = _dict(people.orderBy(F.col("age").asc()).select("age"))
+    assert out2["age"] == [None, 25, 25, 25, 32, 41]
+
+
+def test_limit_offset(people):
+    df = people.filter(F.col("name").isNotNull()).orderBy("name")
+    assert _dict(df.limit(2).select("name"))["name"] == ["alice", "bob"]
+
+
+def test_join_inner(spark):
+    a = spark.createDataFrame(pa.table({"id": [1, 2, 3], "v": [10, 20, 30]}))
+    b = spark.createDataFrame(pa.table({"id": [2, 3, 4], "w": [200, 300, 400]}))
+    out = _dict(a.join(b, on="id").orderBy("id"))
+    assert out["id"] == [2, 3]
+    assert out["v"] == [20, 30]
+    assert out["w"] == [200, 300]
+
+
+def test_join_left(spark):
+    a = spark.createDataFrame(pa.table({"id": [1, 2], "v": [10, 20]}))
+    b = spark.createDataFrame(pa.table({"id": [2], "w": [200]}))
+    out = _dict(a.join(b, on="id", how="left").orderBy("id"))
+    assert out["w"] == [None, 200]
+
+
+def test_self_join(spark):
+    df = spark.createDataFrame(pa.table({"id": [1, 2, 3], "v": [5, 6, 7]}))
+    a = df.alias("a")
+    b = df.alias("b")
+    out = a.join(b, F.col("a.id") == F.col("b.id")).select(
+        F.col("a.id").alias("id"), F.col("b.v").alias("bv")).orderBy("id")
+    assert _dict(out)["id"] == [1, 2, 3]
+
+
+def test_union(spark):
+    a = spark.createDataFrame(pa.table({"x": [1, 2]}))
+    b = spark.createDataFrame(pa.table({"x": [3]}))
+    assert _dict(a.union(b).orderBy("x"))["x"] == [1, 2, 3]
+
+
+def test_cross_join(spark):
+    a = spark.createDataFrame(pa.table({"x": [1, 2]}))
+    b = spark.createDataFrame(pa.table({"y": ["p", "q"]}))
+    assert a.crossJoin(b).count() == 4
+
+
+def test_string_functions(people):
+    out = _dict(people.filter(F.col("name").isNotNull()).select(
+        F.upper("name").alias("u"),
+        F.length("name").alias("l"),
+        F.col("name").substr(1, 2).alias("s2"),
+    ).orderBy("u"))
+    assert out["u"][0] == "ALICE"
+    assert out["l"][0] == 5
+    assert out["s2"][0] == "al"
+
+
+def test_string_predicates(people):
+    assert people.filter(F.col("name").like("%a%")).count() == 3
+    assert people.filter(F.col("name").startswith("a")).count() == 1
+    assert people.filter(F.col("dept").isin("eng", "hr")).count() == 4
+
+
+def test_case_when(people):
+    out = _dict(people.select(
+        F.when(F.col("age") > 30, "old").otherwise("young").alias("grp")))
+    # NULL age → condition unknown → ELSE branch (SQL CASE semantics)
+    assert sorted(out["grp"]) == ["old", "old", "young", "young", "young",
+                                  "young"]
+
+
+def test_cast(spark):
+    df = spark.createDataFrame(pa.table({"s": ["1", "2", "x"]}))
+    out = _dict(df.select(F.col("s").cast("int").alias("i")))
+    assert out["i"] == [1, 2, None]
+
+
+def test_range(spark):
+    df = spark.range(10)
+    assert df.count() == 10
+    assert _dict(df.agg(F.sum("id").alias("s")))["s"] == [45]
+
+
+def test_repartition_preserves_data(spark):
+    df = spark.range(100).repartition(5)
+    assert df.count() == 100
+    out = df.groupBy((F.col("id") % 3).alias("m")).count()
+    assert sorted(_dict(out)["count"]) == [33, 33, 34]
+
+
+def test_dropduplicates(spark):
+    df = spark.createDataFrame(pa.table({"a": [1, 1, 2], "b": [9, 9, 8]}))
+    assert df.dropDuplicates().count() == 2
+
+
+def test_with_column_renamed(people):
+    assert "renamed" in people.withColumnRenamed("age", "renamed").columns
+
+
+def test_stddev(spark):
+    df = spark.createDataFrame(pa.table({"v": [2.0, 4.0, 4.0, 4.0, 5.0, 5.0,
+                                               7.0, 9.0]}))
+    out = _dict(df.agg(F.stddev_pop("v").alias("sd")))
+    assert abs(out["sd"][0] - 2.0) < 1e-9
+
+
+def test_date_functions(spark):
+    import datetime
+
+    df = spark.createDataFrame(pa.table({
+        "d": pa.array([datetime.date(2020, 2, 29), datetime.date(1999, 12, 31)],
+                      pa.date32())}))
+    out = _dict(df.select(F.year("d").alias("y"), F.month("d").alias("m"),
+                          F.dayofmonth("d").alias("dd"),
+                          F.quarter("d").alias("q"),
+                          F.dayofweek("d").alias("dw")))
+    assert out["y"] == [2020, 1999]
+    assert out["m"] == [2, 12]
+    assert out["dd"] == [29, 31]
+    assert out["q"] == [1, 4]
+    assert out["dw"] == [7, 6]  # Sat=7, Fri=6
+
+
+def test_show_and_explain(people, capsys):
+    people.show(2)
+    people.explain()
+    out = capsys.readouterr().out
+    assert "Physical Plan" in out
+
+
+def test_count_multi_partition(spark):
+    df = spark.range(0, 10000, 1, 8)
+    assert df.count() == 10000
+    out = _dict(df.groupBy((F.col("id") % 7).alias("m")).agg(
+        F.count("*").alias("c")).orderBy("m"))
+    assert sum(out["c"]) == 10000

@@ -1,0 +1,174 @@
+"""Kernel unit tests (the reference tests expression eval both interpreted
+and codegen'd — here numpy is the oracle for every jitted kernel;
+SURVEY.md §4)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_tpu.ops import (
+    SortKeySpec, build_index, cross_join, group_rows, group_output_mask,
+    hash_columns, hash_partition, limit_mask, mix64, partition_ids,
+    probe_join, scatter_group_keys, seg_count, seg_first, seg_max, seg_min,
+    seg_sum, sort_permutation,
+)
+
+
+def test_mix64_deterministic_and_spread():
+    x = jnp.arange(1000, dtype=jnp.int64)
+    h1 = np.asarray(mix64(x))
+    h2 = np.asarray(mix64(x))
+    assert (h1 == h2).all()
+    assert len(np.unique(h1)) == 1000
+    # partition balance
+    pids = np.asarray(partition_ids(jnp.asarray(h1), 8))
+    counts = np.bincount(pids, minlength=8)
+    assert counts.min() > 60  # roughly uniform
+
+
+def test_group_rows_numpy_oracle():
+    rng = np.random.default_rng(0)
+    n, cap = 900, 1024
+    keys = rng.integers(0, 50, n)
+    vals = rng.integers(-100, 100, n)
+    k = np.zeros(cap, np.int64)
+    v = np.zeros(cap, np.int64)
+    k[:n] = keys
+    v[:n] = vals
+    mask = np.arange(cap) < n
+
+    layout = group_rows([jnp.asarray(k)], [None], jnp.asarray(mask))
+    sums, cnts = seg_sum(layout, jnp.asarray(v))
+    out_k, _ = scatter_group_keys(layout, jnp.asarray(k), None)
+    om = np.asarray(group_output_mask(layout))
+
+    got = {}
+    for kk, s in zip(np.asarray(out_k)[om], np.asarray(sums)[om]):
+        got[int(kk)] = int(s)
+    want = {}
+    for kk, vv in zip(keys, vals):
+        want[int(kk)] = want.get(int(kk), 0) + int(vv)
+    assert got == want
+
+    mins, has = seg_min(layout, jnp.asarray(v))
+    gotm = {int(kk): int(m) for kk, m in
+            zip(np.asarray(out_k)[om], np.asarray(mins)[om])}
+    wantm = {}
+    for kk, vv in zip(keys, vals):
+        wantm[int(kk)] = min(wantm.get(int(kk), 10**9), int(vv))
+    assert gotm == wantm
+
+
+def test_group_rows_null_keys_group_together():
+    k = jnp.asarray([1, 2, 1, 99, 99], dtype=jnp.int64)
+    valid = jnp.asarray([True, True, True, False, False])
+    mask = jnp.ones(5, dtype=bool)
+    layout = group_rows([k], [valid], mask)
+    assert int(layout.num_groups) == 3  # {1}, {2}, {null}
+
+
+def test_sort_permutation_desc_nulls():
+    k = jnp.asarray([3, 1, 2, 0, 0], dtype=jnp.int64)
+    valid = jnp.asarray([True, True, True, False, True])
+    mask = jnp.asarray([True, True, True, True, False])
+    perm = sort_permutation([k], [valid], [SortKeySpec(ascending=False)], mask)
+    out = np.asarray(jnp.take(k, perm))
+    vout = np.asarray(jnp.take(valid, perm))
+    mout = np.asarray(jnp.take(mask, perm))
+    # live rows: 3,2,1 then null last (desc → nulls last by default)
+    assert list(out[mout][:3]) == [3, 2, 1]
+    assert not vout[mout][3]
+
+
+def test_sort_stability():
+    k = jnp.asarray([1, 1, 1, 1], dtype=jnp.int64)
+    mask = jnp.ones(4, dtype=bool)
+    perm = sort_permutation([k], [None], [SortKeySpec()], mask)
+    assert list(np.asarray(perm)) == [0, 1, 2, 3]
+
+
+def test_join_inner_oracle():
+    rng = np.random.default_rng(1)
+    bn, pn = 300, 500
+    bcap, pcap = 512, 512
+    bk = np.zeros(bcap, np.int64)
+    pk = np.zeros(pcap, np.int64)
+    bk[:bn] = rng.integers(0, 100, bn)
+    pk[:pn] = rng.integers(0, 100, pn)
+    bmask = np.arange(bcap) < bn
+    pmask = np.arange(pcap) < pn
+
+    bi = build_index([jnp.asarray(bk)], [None], jnp.asarray(bmask))
+    r = probe_join(bi, [jnp.asarray(bk)], [None], [jnp.asarray(pk)], [None],
+                   jnp.asarray(pmask), out_capacity=1 << 14)
+    om = np.asarray(r.out_mask)
+    pi = np.asarray(r.probe_idx)[om]
+    bi_idx = np.asarray(r.build_idx)[om]
+    got = sorted(zip(pi.tolist(), bi_idx.tolist()))
+    want = sorted((i, j) for i in range(pn) for j in range(bn)
+                  if pk[i] == bk[j])
+    assert got == want
+
+
+def test_join_left_outer_and_anti():
+    bk = jnp.asarray([1, 2, 0, 0], dtype=jnp.int64)
+    bmask = jnp.asarray([True, True, False, False])
+    pk = jnp.asarray([1, 5, 2, 2], dtype=jnp.int64)
+    pmask = jnp.ones(4, dtype=bool)
+    bi = build_index([bk], [None], bmask)
+    r = probe_join(bi, [bk], [None], [pk], [None], pmask, 16, "left_outer")
+    om = np.asarray(r.out_mask)
+    rows = sorted(zip(np.asarray(r.probe_idx)[om].tolist(),
+                      np.asarray(r.matched)[om].tolist()))
+    assert rows == [(0, True), (1, False), (2, True), (3, True)]
+    r2 = probe_join(bi, [bk], [None], [pk], [None], pmask, 16, "left_anti")
+    om2 = np.asarray(r2.out_mask)
+    assert np.asarray(r2.probe_idx)[om2].tolist() == [1]
+
+
+def test_join_null_keys_never_match():
+    bk = jnp.asarray([1, 1], dtype=jnp.int64)
+    bvalid = jnp.asarray([True, False])
+    bmask = jnp.ones(2, dtype=bool)
+    pk = jnp.asarray([1], dtype=jnp.int64)
+    pvalid = jnp.asarray([False])
+    pmask = jnp.ones(1, dtype=bool)
+    bi = build_index([bk], [bvalid], bmask)
+    r = probe_join(bi, [bk], [bvalid], [pk], [pvalid], pmask, 8, "inner")
+    assert int(np.asarray(r.out_mask).sum()) == 0
+
+
+def test_join_overflow_reports_needed():
+    bk = jnp.zeros(8, dtype=jnp.int64)
+    bmask = jnp.ones(8, dtype=bool)
+    pk = jnp.zeros(8, dtype=jnp.int64)
+    pmask = jnp.ones(8, dtype=bool)
+    bi = build_index([bk], [None], bmask)
+    r = probe_join(bi, [bk], [None], [pk], [None], pmask, out_capacity=16)
+    assert int(r.needed) == 64  # 8x8 matches, capacity 16 → host must retry
+
+
+def test_hash_partition_counts():
+    k = jnp.arange(1000, dtype=jnp.int64)
+    mask = jnp.ones(1000, dtype=bool)
+    pr = hash_partition([k], [None], mask, 7)
+    counts = np.asarray(pr.counts)
+    assert counts.sum() == 1000
+    pids = np.asarray(pr.pids)
+    # grouped ascending
+    live = pids[pids < 7]
+    assert (np.diff(live) >= 0).all()
+
+
+def test_limit_mask():
+    mask = jnp.asarray([True, False, True, True, True])
+    out = np.asarray(limit_mask(mask, 2))
+    assert out.tolist() == [True, False, True, False, False]
+
+
+def test_cross_join():
+    pmask = jnp.asarray([True, True, False])
+    bmask = jnp.asarray([True, False, True])
+    r = cross_join(pmask, bmask, 16)
+    om = np.asarray(r.out_mask)
+    assert int(om.sum()) == 4  # 2 live probe x 2 live build

@@ -1,0 +1,186 @@
+"""SQL layer tests (role of the reference's SQLQueryTestSuite golden files —
+inline expected results here; golden-file harness in test_golden.py)."""
+
+import pyarrow as pa
+import pytest
+
+from spark_tpu.errors import AnalysisException, ParseException
+
+
+@pytest.fixture()
+def store(spark):
+    sales = spark.createDataFrame(pa.table({
+        "item": [1, 2, 3, 1, 2, 1, 4],
+        "qty": [10, 20, 30, 40, 50, 60, 5],
+        "price": [1.5, 2.0, 0.5, 1.5, 2.0, 1.5, 9.9],
+    }))
+    items = spark.createDataFrame(pa.table({
+        "id": [1, 2, 3],
+        "name": ["apple", "banana", "cherry"],
+    }))
+    sales.createOrReplaceTempView("sales")
+    items.createOrReplaceTempView("items")
+    return spark
+
+
+def q(spark, text):
+    return spark.sql(text).toArrow().to_pydict()
+
+
+def test_basic_select(store):
+    out = q(store, "SELECT item, qty FROM sales WHERE qty >= 30 ORDER BY qty")
+    assert out["item"] == [3, 1, 2, 1]
+    assert out["qty"] == [30, 40, 50, 60]
+
+
+def test_join_agg_having(store):
+    out = q(store, """
+        SELECT i.name, SUM(s.qty * s.price) AS revenue, COUNT(*) AS n
+        FROM sales s JOIN items i ON s.item = i.id
+        GROUP BY i.name HAVING SUM(s.qty) > 40
+        ORDER BY revenue DESC""")
+    assert out["name"] == ["apple", "banana"]
+    assert out["revenue"] == [165.0, 140.0]
+    assert out["n"] == [3, 2]
+
+
+def test_left_join_nulls(store):
+    out = q(store, """SELECT s.item, i.name FROM sales s
+                      LEFT JOIN items i ON s.item = i.id
+                      WHERE s.qty = 5""")
+    assert out["name"] == [None]
+
+
+def test_semi_anti(store):
+    out = q(store, """SELECT item FROM sales s LEFT ANTI JOIN items i
+                      ON s.item = i.id""")
+    assert out["item"] == [4]
+    out2 = q(store, """SELECT DISTINCT item FROM sales s LEFT SEMI JOIN items i
+                       ON s.item = i.id ORDER BY item""")
+    assert out2["item"] == [1, 2, 3]
+
+
+def test_union_distinct_and_all(store):
+    out = q(store, "SELECT item FROM sales UNION SELECT id FROM items "
+                   "ORDER BY item")
+    assert out["item"] == [1, 2, 3, 4]
+    out2 = q(store, "SELECT item FROM sales UNION ALL SELECT id FROM items")
+    assert len(out2["item"]) == 10
+
+
+def test_cte(store):
+    out = q(store, """WITH big AS (SELECT * FROM sales WHERE qty >= 30)
+                      SELECT count(*) AS c, min(qty) AS mn FROM big""")
+    assert out["c"] == [4]
+    assert out["mn"] == [30]
+
+
+def test_subquery_in_from(store):
+    out = q(store, """SELECT t.s FROM
+                      (SELECT item, sum(qty) AS s FROM sales GROUP BY item) t
+                      WHERE t.s > 50 ORDER BY t.s""")
+    assert out["s"] == [70, 110]
+
+
+def test_case_expressions(store):
+    out = q(store, """SELECT item,
+                        CASE WHEN qty < 20 THEN 'low'
+                             WHEN qty < 50 THEN 'mid'
+                             ELSE 'high' END AS band
+                      FROM sales ORDER BY item, qty""")
+    assert out["band"] == ["low", "mid", "high", "mid", "high", "mid", "low"]
+
+
+def test_simple_case(store):
+    out = q(store, "SELECT CASE item WHEN 1 THEN 'one' ELSE 'other' END AS c "
+                   "FROM sales WHERE qty = 10")
+    assert out["c"] == ["one"]
+
+
+def test_in_between_like(store):
+    assert q(store, "SELECT count(*) AS c FROM sales WHERE item IN (1, 3)")["c"] == [4]
+    assert q(store, "SELECT count(*) AS c FROM sales WHERE qty BETWEEN 20 AND 50")["c"] == [4]
+    assert q(store, "SELECT count(*) AS c FROM items WHERE name LIKE '%an%'")["c"] == [1]
+
+
+def test_arithmetic_and_functions(store):
+    out = q(store, """SELECT abs(-3) AS a, round(2.567, 2) AS r,
+                             floor(2.7) AS f, ceil(2.1) AS c,
+                             power(2, 10) AS p""")
+    assert out["a"] == [3]
+    assert abs(out["r"][0] - 2.57) < 1e-9
+    assert out["f"] == [2]
+    assert out["c"] == [3]
+    assert out["p"] == [1024.0]
+
+
+def test_division_by_zero_null(store):
+    out = q(store, "SELECT 1 / 0 AS d, 5 % 0 AS m")
+    assert out["d"] == [None]
+    assert out["m"] == [None]
+
+
+def test_values_clause(spark):
+    out = q(spark, "SELECT col1 + col2 AS s FROM (VALUES (1, 2), (3, 4))")
+    assert out["s"] == [3, 7]
+
+
+def test_select_without_from(spark):
+    out = q(spark, "SELECT 1 + 1 AS two, 'x' AS s")
+    assert out["two"] == [2]
+    assert out["s"] == ["x"]
+
+
+def test_order_by_ordinal_and_group_by_ordinal(store):
+    out = q(store, "SELECT item, sum(qty) FROM sales GROUP BY 1 ORDER BY 1")
+    assert out["item"] == [1, 2, 3, 4]
+
+
+def test_date_literal(spark):
+    out = q(spark, "SELECT year(DATE '2021-03-15') AS y, "
+                   "month(DATE '2021-03-15') AS m")
+    assert out["y"] == [2021]
+    assert out["m"] == [3]
+
+
+def test_cast_syntax(spark):
+    out = q(spark, "SELECT CAST('42' AS INT) AS i, CAST(3.9 AS INT) AS t, "
+                   "CAST('2020-01-02' AS DATE) AS d")
+    assert out["i"] == [42]
+    assert out["t"] == [3]
+    assert str(out["d"][0]) == "2020-01-02"
+
+
+def test_parse_error(spark):
+    with pytest.raises(ParseException):
+        spark.sql("SELEC 1")
+
+
+def test_unresolved_column_error(store):
+    with pytest.raises(AnalysisException):
+        store.sql("SELECT nope FROM sales").toArrow()
+
+
+def test_missing_aggregation_error(store):
+    with pytest.raises(AnalysisException):
+        store.sql("SELECT item, qty FROM sales GROUP BY item").toArrow()
+
+
+def test_string_comparison_lt(store):
+    out = q(store, "SELECT name FROM items WHERE name < 'b' ORDER BY name")
+    assert out["name"] == ["apple"]
+
+
+def test_concat_pipe(store):
+    out = q(store, "SELECT 'x' || name AS n FROM items ORDER BY n")
+    assert out["n"] == ["xapple", "xbanana", "xcherry"]
+
+
+def test_nested_subquery_aliasing(store):
+    out = q(store, """
+      SELECT a.name, a.total FROM (
+        SELECT i.name AS name, SUM(s.qty) AS total
+        FROM sales s JOIN items i ON s.item = i.id GROUP BY i.name
+      ) a WHERE a.total >= 70 ORDER BY a.total""")
+    assert out["name"] == ["banana", "apple"]
+    assert out["total"] == [70, 110]
