@@ -101,11 +101,20 @@ class ScanExec(PhysicalPlan):
 
         cols = [a.name for a in self.attrs]
         cap = ctx.conf.batch_capacity
+        cache = getattr(self.source, "_device_cache", None)
+        if cache is None and getattr(self.source, "cache_device_batches", False):
+            cache = self.source._device_cache = {}
         out: list[Partition] = []
         for i in range(self.source.num_partitions()):
+            key = (i, tuple(cols), cap)
+            if cache is not None and key in cache:
+                out.append(cache[key])
+                continue
             table = self.source.read_partition(i, cols)
             batches = list(table_to_batches(table, cap, attrs_schema(self.attrs)))
             ctx.metrics.add(f"scan.{self.name}.rows", table.num_rows)
+            if cache is not None:
+                cache[key] = batches
             out.append(batches)
         return out
 
