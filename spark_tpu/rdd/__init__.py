@@ -1,0 +1,3 @@
+from .rdd import (  # noqa: F401
+    RDD, RDDContext, Broadcast, Accumulator, Partitioner,
+)

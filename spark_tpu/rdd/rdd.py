@@ -1,0 +1,733 @@
+"""RDD: resilient distributed dataset over host partitions.
+
+Role of the reference's core RDD API (core/rdd/RDD.scala, 2290 LoC;
+PairRDDFunctions.scala; Dependency.scala narrow vs ShuffleDependency;
+core/Partitioner.scala). Design stance: arbitrary-Python-closure datasets
+cannot run on the TPU (the reference has the same split — RDD lambdas never
+enter Tungsten codegen either); the RDD layer is the host-side escape hatch,
+executed by a lineage-driven stage runner with hash shuffles at wide
+dependencies, while columnar/SQL work takes the device path. `to_df` /
+`DataFrame.rdd` bridge the two.
+
+Execution: narrow chains fuse into one pass per partition (pipelining, the
+role of Spark's task pipelining); wide ops cut stages and materialize a
+host hash shuffle (MapOutputTracker analog is the in-memory `_shuffle`
+output dict). A thread pool runs partitions concurrently.
+"""
+
+from __future__ import annotations
+
+import bisect
+import builtins
+import hashlib
+import itertools
+import os
+import pickle
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable, Iterator, Sequence, TypeVar
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+
+def _stable_hash(x: Any) -> int:
+    """Deterministic cross-run hash for shuffle partitioning (python's
+    builtin hash is salted for str)."""
+    if isinstance(x, int):
+        return x
+    if isinstance(x, str):
+        return int.from_bytes(
+            hashlib.blake2b(x.encode(), digest_size=8).digest(), "little")
+    try:
+        return int.from_bytes(
+            hashlib.blake2b(pickle.dumps(x), digest_size=8).digest(), "little")
+    except Exception:
+        return hash(x)
+
+
+class Partitioner:
+    """core/Partitioner.scala analog."""
+
+    def __init__(self, num_partitions: int):
+        self.num_partitions = num_partitions
+
+    def partition(self, key: Any) -> int:
+        return _stable_hash(key) % self.num_partitions
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self.num_partitions == other.num_partitions)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.num_partitions))
+
+
+class RangePartitionerHost(Partitioner):
+    def __init__(self, bounds: list):
+        super().__init__(len(bounds) + 1)
+        self.bounds = bounds
+
+    def partition(self, key: Any) -> int:
+        return bisect.bisect_right(self.bounds, key)
+
+
+class RDD:
+    """Lazy lineage node."""
+
+    def __init__(self, context: "RDDContext", num_partitions: int,
+                 parents: Sequence["RDD"] = ()):
+        self.context = context
+        self._num_partitions = num_partitions
+        self.parents = list(parents)
+        self.id = context._next_rdd_id()
+        self._cache: list[list] | None = None
+        self._cached_flag = False
+        self._checkpoint_dir: str | None = None
+
+    # --- to be implemented by subclasses ---------------------------------
+    def compute(self, split: int) -> Iterator:
+        raise NotImplementedError
+
+    def num_partitions(self) -> int:
+        return self._num_partitions
+
+    getNumPartitions = num_partitions
+
+    # --- iteration with cache (BlockManager role) ------------------------
+    def iterator(self, split: int) -> Iterator:
+        if self._cache is not None and self._cache[split] is not None:
+            return iter(self._cache[split])
+        if self._checkpoint_dir is not None:
+            path = os.path.join(self._checkpoint_dir, f"part-{split:05d}.pkl")
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    return iter(pickle.load(f))
+        it = self.compute(split)
+        if self._cached_flag:
+            data = list(it)
+            if self._cache is None:
+                self._cache = [None] * self.num_partitions()
+            self._cache[split] = data
+            return iter(data)
+        return it
+
+    # --- persistence ------------------------------------------------------
+    def cache(self) -> "RDD":
+        self._cached_flag = True
+        if self._cache is None:
+            self._cache = [None] * self.num_partitions()
+        return self
+
+    persist = cache
+
+    def unpersist(self) -> "RDD":
+        self._cached_flag = False
+        self._cache = None
+        return self
+
+    def checkpoint(self, directory: str | None = None) -> "RDD":
+        """Materialize partitions to reliable storage and truncate lineage
+        (reference: core/rdd/RDD.scala:1736, ReliableCheckpointRDD:41)."""
+        d = directory or self.context.checkpoint_dir
+        if d is None:
+            raise ValueError("no checkpoint dir set")
+        cdir = os.path.join(d, f"rdd-{self.id}")
+        os.makedirs(cdir, exist_ok=True)
+        for i in range(self.num_partitions()):
+            with open(os.path.join(cdir, f"part-{i:05d}.pkl"), "wb") as f:
+                pickle.dump(list(self.iterator(i)), f)
+        self._checkpoint_dir = cdir
+        self.parents = []  # lineage truncation
+        return self
+
+    # --- narrow transformations ------------------------------------------
+    def map(self, f: Callable[[T], U]) -> "RDD":
+        return MapPartitionsRDD(self, lambda it, _s: builtins.map(f, it))
+
+    def flatMap(self, f: Callable[[T], Iterable[U]]) -> "RDD":
+        return MapPartitionsRDD(
+            self, lambda it, _s: itertools.chain.from_iterable(
+                builtins.map(f, it)))
+
+    def filter(self, f: Callable[[T], bool]) -> "RDD":
+        return MapPartitionsRDD(self, lambda it, _s: builtins.filter(f, it))
+
+    def mapPartitions(self, f: Callable[[Iterator], Iterable]) -> "RDD":
+        return MapPartitionsRDD(self, lambda it, _s: f(it))
+
+    def mapPartitionsWithIndex(self, f) -> "RDD":
+        return MapPartitionsRDD(self, lambda it, s: f(s, it))
+
+    def glom(self) -> "RDD":
+        return MapPartitionsRDD(self, lambda it, _s: iter([list(it)]))
+
+    def keyBy(self, f) -> "RDD":
+        return self.map(lambda x: (f(x), x))
+
+    def zipWithIndex(self) -> "RDD":
+        counts = self.mapPartitionsWithIndex(
+            lambda s, it: iter([(s, sum(1 for _ in it))])).collect()
+        offsets = {}
+        acc = 0
+        for s, c in sorted(counts):
+            offsets[s] = acc
+            acc += c
+
+        def zipper(s, it):
+            return ((x, offsets[s] + i) for i, x in enumerate(it))
+
+        return self.mapPartitionsWithIndex(zipper)
+
+    def union(self, other: "RDD") -> "RDD":
+        return UnionRDD(self.context, [self, other])
+
+    def zip(self, other: "RDD") -> "RDD":
+        assert self.num_partitions() == other.num_partitions()
+        return ZipRDD(self, other)
+
+    def sample(self, withReplacement: bool, fraction: float,
+               seed: int = 42) -> "RDD":
+        import random
+
+        def sampler(s, it):
+            rnd = random.Random(seed + s)
+            if withReplacement:
+                items = list(it)
+                k = int(len(items) * fraction)
+                return iter([rnd.choice(items) for _ in range(k)] if items else [])
+            return (x for x in it if rnd.random() < fraction)
+
+        return self.mapPartitionsWithIndex(sampler)
+
+    def pipe(self, command: str) -> "RDD":
+        """Pipe partition elements through a shell command
+        (reference: core/rdd/PipedRDD.scala)."""
+        import subprocess
+
+        def run(it, _s):
+            inp = "\n".join(str(x) for x in it)
+            out = subprocess.run(command, shell=True, input=inp, text=True,
+                                 capture_output=True, check=True)
+            return iter(out.stdout.splitlines())
+
+        return MapPartitionsRDD(self, run)
+
+    def coalesce(self, n: int) -> "RDD":
+        return CoalescedRDD(self, max(1, n))
+
+    def repartition(self, n: int) -> "RDD":
+        return self.map(lambda x: (None, x)) \
+                   ._shuffled(Partitioner(n), spread=True) \
+                   .map(lambda kv: kv[1])
+
+    def distinct(self, numPartitions: int | None = None) -> "RDD":
+        n = numPartitions or self.num_partitions()
+        return (self.map(lambda x: (x, None))
+                .reduceByKey(lambda a, b: a, n)
+                .map(lambda kv: kv[0]))
+
+    # --- pair (shuffle) transformations -----------------------------------
+    def _shuffled(self, partitioner: Partitioner, spread=False) -> "ShuffledRDD":
+        return ShuffledRDD(self, partitioner, spread=spread)
+
+    def partitionBy(self, numPartitions: int) -> "RDD":
+        return self._shuffled(Partitioner(numPartitions))
+
+    def groupByKey(self, numPartitions: int | None = None) -> "RDD":
+        n = numPartitions or self.num_partitions()
+
+        def group(it, _s):
+            d: dict = {}
+            for k, v in it:
+                d.setdefault(k, []).append(v)
+            return iter(d.items())
+
+        return MapPartitionsRDD(self._shuffled(Partitioner(n)), group)
+
+    def reduceByKey(self, f, numPartitions: int | None = None) -> "RDD":
+        n = numPartitions or self.num_partitions()
+
+        def combine(it, _s):
+            d: dict = {}
+            for k, v in it:
+                d[k] = f(d[k], v) if k in d else v
+            return iter(d.items())
+
+        # map-side combine, then shuffle, then reduce-side combine
+        pre = MapPartitionsRDD(self, combine)
+        return MapPartitionsRDD(pre._shuffled(Partitioner(n)), combine)
+
+    def combineByKey(self, createCombiner, mergeValue, mergeCombiners,
+                     numPartitions: int | None = None) -> "RDD":
+        n = numPartitions or self.num_partitions()
+
+        def precombine(it, _s):
+            d: dict = {}
+            for k, v in it:
+                d[k] = mergeValue(d[k], v) if k in d else createCombiner(v)
+            return iter(d.items())
+
+        def merge(it, _s):
+            d: dict = {}
+            for k, c in it:
+                d[k] = mergeCombiners(d[k], c) if k in d else c
+            return iter(d.items())
+
+        pre = MapPartitionsRDD(self, precombine)
+        return MapPartitionsRDD(pre._shuffled(Partitioner(n)), merge)
+
+    def aggregateByKey(self, zero, seqFunc, combFunc,
+                       numPartitions: int | None = None) -> "RDD":
+        import copy
+
+        return self.combineByKey(
+            lambda v: seqFunc(copy.deepcopy(zero), v),
+            seqFunc, combFunc, numPartitions)
+
+    def mapValues(self, f) -> "RDD":
+        return self.map(lambda kv: (kv[0], f(kv[1])))
+
+    def flatMapValues(self, f) -> "RDD":
+        return self.flatMap(lambda kv: ((kv[0], v) for v in f(kv[1])))
+
+    def keys(self) -> "RDD":
+        return self.map(lambda kv: kv[0])
+
+    def values(self) -> "RDD":
+        return self.map(lambda kv: kv[1])
+
+    def cogroup(self, other: "RDD", numPartitions: int | None = None) -> "RDD":
+        n = numPartitions or max(self.num_partitions(), other.num_partitions())
+        tagged = self.mapValues(lambda v: (0, v)).union(
+            other.mapValues(lambda v: (1, v)))
+
+        def group(it, _s):
+            d: dict = {}
+            for k, (tag, v) in it:
+                d.setdefault(k, ([], []))[tag].append(v)
+            return iter(d.items())
+
+        return MapPartitionsRDD(tagged._shuffled(Partitioner(n)), group)
+
+    def join(self, other: "RDD", numPartitions: int | None = None) -> "RDD":
+        return self.cogroup(other, numPartitions).flatMap(
+            lambda kv: ((kv[0], (a, b)) for a in kv[1][0] for b in kv[1][1]))
+
+    def leftOuterJoin(self, other: "RDD", numPartitions=None) -> "RDD":
+        return self.cogroup(other, numPartitions).flatMap(
+            lambda kv: ((kv[0], (a, b)) for a in kv[1][0]
+                        for b in (kv[1][1] or [None])))
+
+    def rightOuterJoin(self, other: "RDD", numPartitions=None) -> "RDD":
+        return self.cogroup(other, numPartitions).flatMap(
+            lambda kv: ((kv[0], (a, b)) for b in kv[1][1]
+                        for a in (kv[1][0] or [None])))
+
+    def fullOuterJoin(self, other: "RDD", numPartitions=None) -> "RDD":
+        return self.cogroup(other, numPartitions).flatMap(
+            lambda kv: ((kv[0], (a, b)) for a in (kv[1][0] or [None])
+                        for b in (kv[1][1] or [None])))
+
+    def subtractByKey(self, other: "RDD", numPartitions=None) -> "RDD":
+        return self.cogroup(other, numPartitions).flatMap(
+            lambda kv: ((kv[0], v) for v in kv[1][0] if not kv[1][1]))
+
+    def sortByKey(self, ascending: bool = True,
+                  numPartitions: int | None = None) -> "RDD":
+        n = numPartitions or self.num_partitions()
+        sample = self.map(lambda kv: kv[0]).takeSample(min(n * 20, 1000))
+        sample.sort()
+        if len(sample) > 1 and n > 1:
+            idx = [int(round(i * (len(sample) - 1) / n)) for i in range(1, n)]
+            bounds = sorted(set(sample[i] for i in idx))
+            part = RangePartitionerHost(bounds)
+        else:
+            part = Partitioner(1)
+        shuffled = self._shuffled(part)
+
+        def sort_part(it, _s):
+            data = sorted(it, key=lambda kv: kv[0], reverse=not ascending)
+            return iter(data)
+
+        out = MapPartitionsRDD(shuffled, sort_part)
+        out._ordered_desc = not ascending
+        return out
+
+    def sortBy(self, keyfunc, ascending: bool = True,
+               numPartitions: int | None = None) -> "RDD":
+        return (self.keyBy(keyfunc)
+                .sortByKey(ascending, numPartitions)
+                .map(lambda kv: kv[1]))
+
+    # --- actions -----------------------------------------------------------
+    def collect(self) -> list:
+        parts = self.context._run(self)
+        if getattr(self, "_ordered_desc", False):
+            parts = parts[::-1]
+        return [x for p in parts for x in p]
+
+    def count(self) -> int:
+        return sum(self.context._run_map(
+            self, lambda it: sum(1 for _ in it)))
+
+    def reduce(self, f):
+        parts = [p for p in self.context._run_map(
+            self, lambda it: _reduce_or_none(f, it)) if p is not _EMPTY]
+        if not parts:
+            raise ValueError("reduce on empty RDD")
+        out = parts[0]
+        for p in parts[1:]:
+            out = f(out, p)
+        return out
+
+    def fold(self, zero, f):
+        parts = self.context._run_map(
+            self, lambda it: _fold(zero, f, it))
+        out = zero
+        for p in parts:
+            out = f(out, p)
+        return out
+
+    def aggregate(self, zero, seqOp, combOp):
+        import copy
+
+        parts = self.context._run_map(
+            self, lambda it: _fold(copy.deepcopy(zero), seqOp, it))
+        out = zero
+        for p in parts:
+            out = combOp(out, p)
+        return out
+
+    def take(self, n: int) -> list:
+        out: list = []
+        for i in range(self.num_partitions()):
+            for x in self.iterator(i):
+                out.append(x)
+                if len(out) >= n:
+                    return out
+        return out
+
+    def first(self):
+        got = self.take(1)
+        if not got:
+            raise ValueError("empty RDD")
+        return got[0]
+
+    def takeSample(self, n: int, seed: int = 42) -> list:
+        import random
+
+        data = self.collect()
+        rnd = random.Random(seed)
+        if len(data) <= n:
+            return data
+        return rnd.sample(data, n)
+
+    def foreach(self, f) -> None:
+        self.context._run_map(self, lambda it: [f(x) for x in it] and None)
+
+    def foreachPartition(self, f) -> None:
+        self.context._run_map(self, lambda it: f(it))
+
+    def countByKey(self) -> dict:
+        out: dict = {}
+        for k, _v in self.collect():
+            out[k] = out.get(k, 0) + 1
+        return out
+
+    def countByValue(self) -> dict:
+        out: dict = {}
+        for x in self.collect():
+            out[x] = out.get(x, 0) + 1
+        return out
+
+    def top(self, n: int) -> list:
+        import heapq
+
+        parts = self.context._run_map(
+            self, lambda it: heapq.nlargest(n, it))
+        return heapq.nlargest(n, itertools.chain.from_iterable(parts))
+
+    def max(self):  # noqa: A003
+        return self.reduce(lambda a, b: a if a >= b else b)
+
+    def min(self):  # noqa: A003
+        return self.reduce(lambda a, b: a if a <= b else b)
+
+    def sum(self):  # noqa: A003
+        return self.fold(0, lambda a, b: a + b)
+
+    def mean(self):
+        n, s = self.aggregate((0, 0),
+                              lambda z, x: (z[0] + 1, z[1] + x),
+                              lambda a, b: (a[0] + b[0], a[1] + b[1]))
+        return s / n
+
+    def isEmpty(self) -> bool:
+        return not self.take(1)
+
+    def saveAsTextFile(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+        for i in range(self.num_partitions()):
+            with open(os.path.join(path, f"part-{i:05d}"), "w") as f:
+                for x in self.iterator(i):
+                    f.write(str(x) + "\n")
+
+    # --- DataFrame bridge ---------------------------------------------------
+    def toDF(self, session, schema=None):
+        data = self.collect()
+        return session.createDataFrame(data, schema)
+
+
+_EMPTY = object()
+
+
+def _reduce_or_none(f, it):
+    out = _EMPTY
+    for x in it:
+        out = x if out is _EMPTY else f(out, x)
+    return out
+
+
+def _fold(zero, f, it):
+    out = zero
+    for x in it:
+        out = f(out, x)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Concrete RDDs
+# ---------------------------------------------------------------------------
+
+class ParallelCollectionRDD(RDD):
+    def __init__(self, context, data: Sequence, num_partitions: int):
+        super().__init__(context, num_partitions)
+        self.data = list(data)
+
+    def compute(self, split: int) -> Iterator:
+        n = len(self.data)
+        per = -(-n // self._num_partitions) if n else 0
+        lo = min(split * per, n)
+        hi = min(lo + per, n)
+        return iter(self.data[lo:hi])
+
+
+class TextFileRDD(RDD):
+    def __init__(self, context, paths: list[str]):
+        super().__init__(context, max(1, len(paths)))
+        self.paths = paths
+
+    def compute(self, split: int) -> Iterator:
+        with open(self.paths[split]) as f:
+            for line in f:
+                yield line.rstrip("\n")
+
+
+class MapPartitionsRDD(RDD):
+    def __init__(self, parent: RDD, fn: Callable[[Iterator, int], Iterator]):
+        super().__init__(parent.context, parent.num_partitions(), [parent])
+        self.fn = fn
+
+    def compute(self, split: int) -> Iterator:
+        return self.fn(self.parents[0].iterator(split), split)
+
+
+class UnionRDD(RDD):
+    def __init__(self, context, rdds: list[RDD]):
+        super().__init__(context, sum(r.num_partitions() for r in rdds), rdds)
+
+    def compute(self, split: int) -> Iterator:
+        for r in self.parents:
+            if split < r.num_partitions():
+                return r.iterator(split)
+            split -= r.num_partitions()
+        raise IndexError(split)
+
+
+class ZipRDD(RDD):
+    def __init__(self, a: RDD, b: RDD):
+        super().__init__(a.context, a.num_partitions(), [a, b])
+
+    def compute(self, split: int) -> Iterator:
+        return zip(self.parents[0].iterator(split),
+                   self.parents[1].iterator(split))
+
+
+class CoalescedRDD(RDD):
+    def __init__(self, parent: RDD, n: int):
+        super().__init__(parent.context, min(n, parent.num_partitions()),
+                         [parent])
+
+    def compute(self, split: int) -> Iterator:
+        parent = self.parents[0]
+        pn = parent.num_partitions()
+        mine = range(split, pn, self._num_partitions)
+        return itertools.chain.from_iterable(
+            parent.iterator(i) for i in mine)
+
+
+class ShuffledRDD(RDD):
+    """Wide dependency: materializes the map side grouped by reducer
+    (reference: core/rdd/ShuffledRDD.scala + SortShuffleManager write path).
+    `spread` distributes non-keyed rows round-robin (repartition)."""
+
+    def __init__(self, parent: RDD, partitioner: Partitioner,
+                 spread: bool = False):
+        import threading
+
+        super().__init__(parent.context, partitioner.num_partitions, [parent])
+        self.partitioner = partitioner
+        self.spread = spread
+        self._fetched: list[list] | None = None
+        self._lock = threading.Lock()
+
+    def _materialize(self) -> list[list]:
+        if self._fetched is not None:
+            return self._fetched
+        with self._lock:
+            return self._materialize_locked()
+
+    def _materialize_locked(self) -> list[list]:
+        if self._fetched is not None:
+            return self._fetched
+        parent = self.parents[0]
+        n = self.partitioner.num_partitions
+
+        def map_task(split: int) -> list[list]:
+            buckets: list[list] = [[] for _ in range(n)]
+            if self.spread:
+                for i, kv in enumerate(parent.iterator(split)):
+                    buckets[(split + i) % n].append(kv)
+            else:
+                for kv in parent.iterator(split):
+                    buckets[self.partitioner.partition(kv[0])].append(kv)
+            return buckets
+
+        results = self.context._parallel(
+            map_task, range(parent.num_partitions()))
+        out: list[list] = [[] for _ in range(n)]
+        for buckets in results:
+            for i, b in enumerate(buckets):
+                out[i].extend(b)
+        self._fetched = out
+        return out
+
+    def compute(self, split: int) -> Iterator:
+        return iter(self._materialize()[split])
+
+
+# ---------------------------------------------------------------------------
+# Context
+# ---------------------------------------------------------------------------
+
+class Broadcast:
+    """Read-only shared value (reference: core/broadcast/TorrentBroadcast.scala
+    — in-process, the torrent distribution is a no-op locally)."""
+
+    def __init__(self, value):
+        self._value = value
+
+    @property
+    def value(self):
+        return self._value
+
+    def unpersist(self):
+        self._value = None
+
+
+class Accumulator:
+    """Commutative counter aggregated at the driver (reference:
+    core/util/AccumulatorV2.scala)."""
+
+    def __init__(self, value, op=lambda a, b: a + b):
+        import threading
+
+        self._value = value
+        self._op = op
+        self._lock = threading.Lock()
+
+    def add(self, v):
+        with self._lock:
+            self._value = self._op(self._value, v)
+
+    __iadd__ = None
+
+    @property
+    def value(self):
+        return self._value
+
+
+class RDDContext:
+    """Driver context (role of SparkContext for the RDD layer)."""
+
+    def __init__(self, parallelism: int = 8,
+                 checkpoint_dir: str | None = None):
+        import threading
+
+        self.parallelism = parallelism
+        self.checkpoint_dir = checkpoint_dir
+        self._rdd_counter = itertools.count()
+        self._pool = ThreadPoolExecutor(max_workers=parallelism)
+        self._in_task = threading.local()
+
+    def _next_rdd_id(self) -> int:
+        return next(self._rdd_counter)
+
+    def setCheckpointDir(self, d: str) -> None:
+        self.checkpoint_dir = d
+
+    def parallelize(self, data: Sequence, numSlices: int | None = None) -> RDD:
+        return ParallelCollectionRDD(self, data,
+                                     numSlices or self.parallelism)
+
+    def range(self, start, end=None, step=1, numSlices=None) -> RDD:
+        if end is None:
+            start, end = 0, start
+        return self.parallelize(builtins.range(start, end, step), numSlices)
+
+    def textFile(self, path: str) -> RDD:
+        import glob as g
+
+        paths = sorted(g.glob(path)) if any(c in path for c in "*?[") \
+            else ([os.path.join(path, p) for p in sorted(os.listdir(path))]
+                  if os.path.isdir(path) else [path])
+        return TextFileRDD(self, paths)
+
+    def broadcast(self, value) -> Broadcast:
+        return Broadcast(value)
+
+    def accumulator(self, value, op=lambda a, b: a + b) -> Accumulator:
+        return Accumulator(value, op)
+
+    def union(self, rdds: list[RDD]) -> RDD:
+        return UnionRDD(self, rdds)
+
+    # --- execution ---------------------------------------------------------
+    def _parallel(self, fn, splits) -> list:
+        # nested jobs (a shuffle materializing inside a pool task) run
+        # inline — submitting to the same bounded pool from a worker
+        # deadlocks (the reference's DAGScheduler avoids this by running
+        # shuffle map stages as separate task sets, not nested calls)
+        if getattr(self._in_task, "flag", False):
+            return [fn(s) for s in splits]
+
+        def wrapped(s):
+            self._in_task.flag = True
+            try:
+                return fn(s)
+            finally:
+                self._in_task.flag = False
+
+        futures = [self._pool.submit(wrapped, s) for s in splits]
+        return [f.result() for f in futures]
+
+    def _run(self, rdd: RDD) -> list[list]:
+        return self._parallel(lambda s: list(rdd.iterator(s)),
+                              range(rdd.num_partitions()))
+
+    def _run_map(self, rdd: RDD, agg) -> list:
+        return self._parallel(lambda s: agg(rdd.iterator(s)),
+                              range(rdd.num_partitions()))
+
+    def stop(self):
+        self._pool.shutdown(wait=False)

@@ -1,0 +1,156 @@
+"""RDD layer tests (reference: core/src/test RDD suites)."""
+
+import os
+
+import pytest
+
+from spark_tpu.rdd import RDD, RDDContext
+
+
+@pytest.fixture(scope="module")
+def sc():
+    ctx = RDDContext(parallelism=4)
+    yield ctx
+    ctx.stop()
+
+
+def test_map_filter_collect(sc):
+    r = sc.parallelize(range(100), 4)
+    out = r.map(lambda x: x * 2).filter(lambda x: x % 10 == 0).collect()
+    assert out == [x * 2 for x in range(100) if (x * 2) % 10 == 0]
+
+
+def test_flatmap_count(sc):
+    r = sc.parallelize(["a b", "c d e"], 2)
+    assert r.flatMap(str.split).count() == 5
+
+
+def test_reduce_fold_aggregate(sc):
+    r = sc.parallelize(range(1, 101), 7)
+    assert r.reduce(lambda a, b: a + b) == 5050
+    assert r.fold(0, lambda a, b: a + b) == 5050
+    n, s = r.aggregate((0, 0), lambda z, x: (z[0] + 1, z[1] + x),
+                       lambda a, b: (a[0] + b[0], a[1] + b[1]))
+    assert (n, s) == (100, 5050)
+    assert r.sum() == 5050
+    assert r.max() == 100 and r.min() == 1
+    assert abs(r.mean() - 50.5) < 1e-9
+
+
+def test_reduce_by_key(sc):
+    r = sc.parallelize([("a", 1), ("b", 2), ("a", 3), ("c", 4), ("b", 5)], 3)
+    out = dict(r.reduceByKey(lambda a, b: a + b).collect())
+    assert out == {"a": 4, "b": 7, "c": 4}
+
+
+def test_group_by_key(sc):
+    r = sc.parallelize([("x", i) for i in range(10)], 4)
+    out = r.groupByKey().collect()
+    assert len(out) == 1
+    assert sorted(out[0][1]) == list(range(10))
+
+
+def test_join(sc):
+    a = sc.parallelize([("k1", 1), ("k2", 2)], 2)
+    b = sc.parallelize([("k2", "x"), ("k3", "y")], 2)
+    assert a.join(b).collect() == [("k2", (2, "x"))]
+    left = dict(a.leftOuterJoin(b).collect())
+    assert left == {"k1": (1, None), "k2": (2, "x")}
+    full = dict(a.fullOuterJoin(b).collect())
+    assert full == {"k1": (1, None), "k2": (2, "x"), "k3": (None, "y")}
+
+
+def test_sort_by_key(sc):
+    import random
+
+    data = list(range(200))
+    random.Random(0).shuffle(data)
+    r = sc.parallelize([(x, x) for x in data], 5)
+    out = [k for k, _ in r.sortByKey().collect()]
+    assert out == sorted(data)
+    out_desc = [k for k, _ in r.sortByKey(False).collect()]
+    assert out_desc == sorted(data, reverse=True)
+
+
+def test_distinct_union_zip(sc):
+    r = sc.parallelize([1, 2, 2, 3, 3, 3], 3)
+    assert sorted(r.distinct().collect()) == [1, 2, 3]
+    u = r.union(sc.parallelize([9], 1))
+    assert sorted(u.collect()) == [1, 2, 2, 3, 3, 3, 9]
+    z = sc.parallelize([1, 2], 2).zip(sc.parallelize(["a", "b"], 2))
+    assert z.collect() == [(1, "a"), (2, "b")]
+
+
+def test_repartition_coalesce(sc):
+    r = sc.parallelize(range(100), 8)
+    assert sorted(r.repartition(3).collect()) == list(range(100))
+    assert r.repartition(3).num_partitions() == 3
+    c = r.coalesce(2)
+    assert c.num_partitions() == 2
+    assert sorted(c.collect()) == list(range(100))
+
+
+def test_cache_and_checkpoint(sc, tmp_path):
+    calls = []
+
+    def f(x):
+        calls.append(x)
+        return x
+
+    r = sc.parallelize(range(10), 2).map(f).cache()
+    r.collect()
+    n1 = len(calls)
+    r.collect()
+    assert len(calls) == n1  # cached, no recompute
+
+    sc.setCheckpointDir(str(tmp_path))
+    r2 = sc.parallelize(range(5), 1).map(lambda x: x * 3)
+    r2.checkpoint()
+    assert r2.parents == []
+    assert r2.collect() == [0, 3, 6, 9, 12]
+
+
+def test_broadcast_accumulator(sc):
+    b = sc.broadcast({"m": 10})
+    acc = sc.accumulator(0)
+    r = sc.parallelize(range(10), 4)
+    out = r.map(lambda x: x * b.value["m"]).collect()
+    assert out == [x * 10 for x in range(10)]
+    r.foreach(lambda x: acc.add(x))
+    assert acc.value == 45
+
+
+def test_take_top_countbyvalue(sc):
+    r = sc.parallelize([5, 3, 8, 1, 9, 3], 3)
+    assert r.take(2) == [5, 3]
+    assert r.top(2) == [9, 8]
+    assert r.countByValue()[3] == 2
+
+
+def test_text_file_roundtrip(sc, tmp_path):
+    p = str(tmp_path / "out")
+    sc.parallelize(["alpha", "beta", "gamma"], 2).saveAsTextFile(p)
+    back = sc.textFile(p + "/part-*" if False else p)
+    assert sorted(back.collect()) == ["alpha", "beta", "gamma"]
+
+
+def test_pipe(sc):
+    r = sc.parallelize(["a", "b"], 1)
+    assert r.pipe("cat").collect() == ["a", "b"]
+
+
+def test_combine_by_key(sc):
+    r = sc.parallelize([("a", 1), ("a", 2), ("b", 3)], 2)
+    out = dict(r.combineByKey(lambda v: [v],
+                              lambda c, v: c + [v],
+                              lambda c1, c2: c1 + c2).collect())
+    assert sorted(out["a"]) == [1, 2]
+    assert out["b"] == [3]
+
+
+def test_sample_deterministic(sc):
+    r = sc.parallelize(range(1000), 4)
+    s1 = r.sample(False, 0.1, seed=1).collect()
+    s2 = r.sample(False, 0.1, seed=1).collect()
+    assert s1 == s2
+    assert 50 < len(s1) < 200
