@@ -167,6 +167,13 @@ class Column:
     def desc_nulls_last(self):
         return Column(E.SortOrder(self.expr, False, False))
 
+    # --- window -----------------------------------------------------------
+    def over(self, spec) -> "Column":
+        from ..expr.window import WindowExpression
+
+        return Column(WindowExpression(self.expr, spec._partition,
+                                       spec._order))
+
     # --- conditional ------------------------------------------------------
     def when(self, cond: "Column", value) -> "Column":
         if not isinstance(self.expr, E.CaseWhen):

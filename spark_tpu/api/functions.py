@@ -285,6 +285,58 @@ def to_date(c, fmt: str | None = None) -> Column:
     return Column(E.Cast(_c(c), _date))
 
 
+# --- window functions -------------------------------------------------------
+
+def row_number() -> Column:
+    from ..expr.window import RowNumber
+
+    return Column(RowNumber())
+
+
+def rank() -> Column:
+    from ..expr.window import Rank
+
+    return Column(Rank())
+
+
+def dense_rank() -> Column:
+    from ..expr.window import DenseRank
+
+    return Column(DenseRank())
+
+
+def percent_rank() -> Column:
+    from ..expr.window import PercentRank
+
+    return Column(PercentRank())
+
+
+def cume_dist() -> Column:
+    from ..expr.window import CumeDist
+
+    return Column(CumeDist())
+
+
+def ntile(n: int) -> Column:
+    from ..expr.window import NTile
+
+    return Column(NTile(E.Literal(n)))
+
+
+def lag(c, offset: int = 1, default=None) -> Column:
+    from ..expr.window import Lag
+
+    return Column(Lag(_c(c), offset,
+                      None if default is None else E.Literal(default)))
+
+
+def lead(c, offset: int = 1, default=None) -> Column:
+    from ..expr.window import Lead
+
+    return Column(Lead(_c(c), offset,
+                       None if default is None else E.Literal(default)))
+
+
 # --- sort helpers -----------------------------------------------------------
 
 def asc(c) -> Column:

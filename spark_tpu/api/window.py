@@ -1,0 +1,59 @@
+"""Window specification API (pyspark.sql.Window analog)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..expr import expressions as E
+from ..expr.window import WindowExpression
+from .column import Column, _expr
+
+
+class WindowSpec:
+    def __init__(self, partition_spec=(), order_spec=()):
+        self._partition = list(partition_spec)
+        self._order = list(order_spec)
+
+    def partitionBy(self, *cols) -> "WindowSpec":
+        exprs = [_to_expr(c) for c in cols]
+        return WindowSpec(self._partition + exprs, self._order)
+
+    def orderBy(self, *cols) -> "WindowSpec":
+        orders = []
+        for c in cols:
+            e = _to_expr(c)
+            orders.append(e if isinstance(e, E.SortOrder)
+                          else E.SortOrder(e, True))
+        return WindowSpec(self._partition, self._order + orders)
+
+    def rowsBetween(self, start, end) -> "WindowSpec":
+        # only the default frames are supported (tracked for round 2)
+        return self
+
+    rangeBetween = rowsBetween
+
+
+class Window:
+    unboundedPreceding = -(1 << 62)
+    unboundedFollowing = 1 << 62
+    currentRow = 0
+
+    @staticmethod
+    def partitionBy(*cols) -> WindowSpec:
+        return WindowSpec().partitionBy(*cols)
+
+    @staticmethod
+    def orderBy(*cols) -> WindowSpec:
+        return WindowSpec().orderBy(*cols)
+
+
+def _to_expr(c):
+    if isinstance(c, Column):
+        return c.expr
+    if isinstance(c, str):
+        return E.UnresolvedAttribute(c.split("."))
+    return _expr(c)
+
+
+def over(col: Column, spec: WindowSpec) -> Column:
+    return Column(WindowExpression(col.expr, spec._partition, spec._order))

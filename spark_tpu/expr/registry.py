@@ -128,6 +128,21 @@ def _reg_all() -> None:
     r("make_date", lambda y, m, d: E.MakeDate(y, m, d))
     r("to_date", lambda c, fmt=None: E.Cast(c, __import__(
         "spark_tpu.types", fromlist=["date"]).date))
+    # window / ranking
+    from .window import (
+        CumeDist, DenseRank, Lag, Lead, NTile, PercentRank, Rank, RowNumber,
+    )
+
+    r("row_number", lambda: RowNumber())
+    r("rank", lambda: Rank())
+    r("dense_rank", lambda: DenseRank())
+    r("percent_rank", lambda: PercentRank())
+    r("cume_dist", lambda: CumeDist())
+    r("ntile", lambda n: NTile(n))
+    r("lag", lambda c, off=None, d=None: Lag(
+        c, off if off is not None else E.Literal(1), d))
+    r("lead", lambda c, off=None, d=None: Lead(
+        c, off if off is not None else E.Literal(1), d))
 
 
 _reg_all()

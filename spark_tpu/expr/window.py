@@ -1,0 +1,143 @@
+"""Window expressions.
+
+Role of the reference's windowExpressions.scala (WindowExpression,
+WindowSpecDefinition, ranking functions) — evaluated by WindowExec via the
+sort/segment kernels in ops/window.py.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..errors import UnsupportedOperationError
+from ..types import DataType, float64, int32
+from .expressions import (
+    AggregateFunction, Expression, Literal, SortOrder,
+)
+
+__all__ = ["WindowFunction", "RowNumber", "Rank", "DenseRank", "PercentRank",
+           "CumeDist", "NTile", "Lag", "Lead", "WindowExpression"]
+
+
+class WindowFunction(Expression):
+    child_fields = ()
+
+    @property
+    def nullable(self):
+        return False
+
+
+class RowNumber(WindowFunction):
+    @property
+    def dtype(self):
+        return int32
+
+
+class Rank(WindowFunction):
+    @property
+    def dtype(self):
+        return int32
+
+
+class DenseRank(WindowFunction):
+    @property
+    def dtype(self):
+        return int32
+
+
+class PercentRank(WindowFunction):
+    @property
+    def dtype(self):
+        return float64
+
+
+class CumeDist(WindowFunction):
+    @property
+    def dtype(self):
+        return float64
+
+
+class NTile(WindowFunction):
+    def __init__(self, n: Expression):
+        if not isinstance(n, Literal):
+            raise UnsupportedOperationError("ntile(n) needs a literal")
+        self.n = int(n.value)
+
+    @property
+    def dtype(self):
+        return int32
+
+
+class Lag(WindowFunction):
+    child_fields = ("child", "default")
+
+    def __init__(self, child: Expression, offset: Expression | int = 1,
+                 default: Expression | None = None):
+        self.child = child
+        self.offset = int(offset.value) if isinstance(offset, Literal) \
+            else int(offset)
+        self.default = default
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def nullable(self):
+        return True
+
+
+class Lead(Lag):
+    pass
+
+
+class UnresolvedWindowExpression(Expression):
+    """Parsed `fn(...) OVER (...)` awaiting function resolution."""
+
+    child_fields = ("function", "partition_spec", "order_spec")
+
+    def __init__(self, function: Expression,
+                 partition_spec: Sequence[Expression],
+                 order_spec: Sequence["SortOrder"]):
+        self.function = function
+        self.partition_spec = list(partition_spec)
+        self.order_spec = list(order_spec)
+
+    @property
+    def resolved(self):
+        return False
+
+
+class WindowExpression(Expression):
+    child_fields = ("function", "partition_spec", "order_spec")
+
+    def __init__(self, function: Expression,
+                 partition_spec: Sequence[Expression],
+                 order_spec: Sequence[SortOrder]):
+        if not isinstance(function, (WindowFunction, AggregateFunction)):
+            raise UnsupportedOperationError(
+                f"{type(function).__name__} is not a window function")
+        self.function = function
+        self.partition_spec = list(partition_spec)
+        self.order_spec = list(order_spec)
+
+    @property
+    def dtype(self) -> DataType:
+        return self.function.dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    def spec_signature(self):
+        """Grouping key: window expressions sharing a spec evaluate in one
+        WindowExec pass."""
+        return (tuple(e.simple_string() for e in self.partition_spec),
+                tuple((o.child.simple_string(), o.ascending, o.nulls_first)
+                      for o in self.order_spec))
+
+    def simple_string(self):
+        p = ", ".join(e.simple_string() for e in self.partition_spec)
+        o = ", ".join(x.child.simple_string() for x in self.order_spec)
+        return (f"{self.function.simple_string()} OVER "
+                f"(PARTITION BY {p} ORDER BY {o})")

@@ -1,0 +1,239 @@
+"""Window function kernels.
+
+Role of the reference's WindowExec + window function frames
+(sqlx/window/WindowExec.scala, sqlcat/expressions/windowExpressions.scala).
+TPU-native design: one `lax.sort` by (partition keys, order keys) makes
+partitions and peer groups contiguous; every ranking/frame computation is
+then a cumsum/segment-op over the sorted layout, and results scatter back to
+the original row order. No per-row loops, no frame iterators.
+
+Default frames (Spark semantics):
+  ranking fns — whole partition by definition;
+  aggregates with ORDER BY — RANGE UNBOUNDED PRECEDING..CURRENT ROW
+    (peer rows share the value);
+  aggregates without ORDER BY — whole partition.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .sorting import SortKeySpec, _directional
+
+
+class WindowLayout(NamedTuple):
+    perm: jnp.ndarray        # sorted-row → original-row index
+    active: jnp.ndarray      # bool per sorted row
+    pos: jnp.ndarray         # int32 global position
+    seg_start: jnp.ndarray   # int32 per sorted row: position of partition start
+    seg_id: jnp.ndarray      # int32 partition id per sorted row
+    peer_id: jnp.ndarray     # int32 peer-group id per sorted row
+    peer_first: jnp.ndarray  # position of first row of the peer group
+    peer_last: jnp.ndarray   # position of last row of the peer group
+    seg_size: jnp.ndarray    # int32 rows in the partition
+
+
+def build_layout(part_keys: Sequence[jnp.ndarray],
+                 part_valids: Sequence[jnp.ndarray | None],
+                 order_keys: Sequence[jnp.ndarray],
+                 order_valids: Sequence[jnp.ndarray | None],
+                 order_specs: Sequence[SortKeySpec],
+                 row_mask: jnp.ndarray) -> WindowLayout:
+    cap = row_mask.shape[0]
+    operands: list[jnp.ndarray] = [(~row_mask).astype(jnp.int32)]
+    n_pkeys_ops = 0
+    for k, v in zip(part_keys, part_valids):
+        if v is not None:
+            operands.append((~v).astype(jnp.int32))
+            operands.append(jnp.where(v, k, jnp.zeros_like(k)))
+            n_pkeys_ops += 2
+        else:
+            operands.append(k)
+            n_pkeys_ops += 1
+    n_order_start = len(operands)
+    for k, v, s in zip(order_keys, order_valids, order_specs):
+        if v is not None:
+            nf = s.nulls_first_effective
+            operands.append((v if nf else ~v).astype(jnp.int32))
+            k = jnp.where(v, k, jnp.zeros_like(k))
+        operands.append(_directional(k, s.ascending))
+    nk = len(operands)
+    operands.append(lax.iota(jnp.int32, cap))
+    out = lax.sort(tuple(operands), num_keys=nk, is_stable=True)
+    perm = out[-1]
+    sorted_keys = out[:nk]
+    active = jnp.take(row_mask, perm)
+    pos = lax.iota(jnp.int32, cap)
+
+    def change_flag(keys):
+        flag = jnp.zeros(cap, dtype=bool).at[0].set(True)
+        for k in keys:
+            flag = flag | jnp.concatenate(
+                [jnp.ones(1, dtype=bool), k[1:] != k[:-1]])
+        return flag
+
+    pchange = change_flag(sorted_keys[: 1 + n_pkeys_ops])
+    ochange = pchange | change_flag(sorted_keys)  # any key change
+
+    seg_id = jnp.cumsum(pchange.astype(jnp.int32)) - 1
+    peer_id = jnp.cumsum(ochange.astype(jnp.int32)) - 1
+
+    seg_start_by_id = jnp.full((cap,), 0, jnp.int32).at[
+        jnp.where(pchange, seg_id, cap)].set(pos, mode="drop")
+    seg_start = jnp.take(seg_start_by_id, seg_id)
+    peer_first_by_id = jnp.full((cap,), 0, jnp.int32).at[
+        jnp.where(ochange, peer_id, cap)].set(pos, mode="drop")
+    peer_first = jnp.take(peer_first_by_id, peer_id)
+    peer_last_by_id = jax.ops.segment_max(pos, peer_id, num_segments=cap)
+    peer_last = jnp.take(peer_last_by_id, peer_id)
+    seg_size = jax.ops.segment_sum(active.astype(jnp.int32), seg_id,
+                                   num_segments=cap)
+    seg_size = jnp.take(seg_size, seg_id)
+    return WindowLayout(perm, active, pos, seg_start, seg_id, peer_id,
+                        peer_first, peer_last, seg_size)
+
+
+# --- per-function computations (all return values in SORTED order) ---------
+
+def w_row_number(lo: WindowLayout):
+    return (lo.pos - lo.seg_start + 1).astype(jnp.int32)
+
+
+def w_rank(lo: WindowLayout):
+    return (lo.peer_first - lo.seg_start + 1).astype(jnp.int32)
+
+
+def w_dense_rank(lo: WindowLayout):
+    start_peer = jnp.take(lo.peer_id, lo.seg_start)
+    return (lo.peer_id - start_peer + 1).astype(jnp.int32)
+
+
+def w_percent_rank(lo: WindowLayout):
+    denom = jnp.maximum(lo.seg_size - 1, 1)
+    return (w_rank(lo) - 1).astype(jnp.float64) / denom
+
+
+def w_cume_dist(lo: WindowLayout):
+    return (lo.peer_last - lo.seg_start + 1).astype(jnp.float64) / \
+        jnp.maximum(lo.seg_size, 1)
+
+
+def w_ntile(lo: WindowLayout, n: int):
+    rn0 = (lo.pos - lo.seg_start).astype(jnp.int64)
+    return (rn0 * n // jnp.maximum(lo.seg_size, 1) + 1).astype(jnp.int32)
+
+
+def _sorted_vals(lo: WindowLayout, values, valid):
+    v = jnp.take(values, lo.perm)
+    w = lo.active if valid is None else (lo.active & jnp.take(valid, lo.perm))
+    return v, w
+
+
+def w_agg_unbounded(lo: WindowLayout, values, valid, kind: str):
+    """sum/count/min/max/avg over the whole partition, broadcast to rows."""
+    cap = values.shape[0]
+    v, w = _sorted_vals(lo, values, valid)
+    if kind == "count":
+        tot = jax.ops.segment_sum(w.astype(jnp.int64), lo.seg_id, cap)
+        return jnp.take(tot, lo.seg_id), None
+    acc = jnp.float64 if jnp.issubdtype(v.dtype, jnp.floating) else jnp.int64
+    if kind in ("sum", "avg"):
+        s = jax.ops.segment_sum(jnp.where(w, v.astype(acc), 0), lo.seg_id, cap)
+        c = jax.ops.segment_sum(w.astype(jnp.int64), lo.seg_id, cap)
+        if kind == "sum":
+            return jnp.take(s, lo.seg_id), jnp.take(c, lo.seg_id) > 0
+        c_safe = jnp.maximum(c, 1)
+        a = s.astype(jnp.float64) / c_safe
+        return jnp.take(a, lo.seg_id), jnp.take(c, lo.seg_id) > 0
+    from .grouping import _max_ident, _min_ident
+
+    if kind == "min":
+        m = jax.ops.segment_min(jnp.where(w, v, _max_ident(v.dtype)),
+                                lo.seg_id, cap)
+    else:
+        m = jax.ops.segment_max(jnp.where(w, v, _min_ident(v.dtype)),
+                                lo.seg_id, cap)
+    c = jax.ops.segment_sum(w.astype(jnp.int32), lo.seg_id, cap)
+    return jnp.take(m, lo.seg_id), jnp.take(c, lo.seg_id) > 0
+
+
+def w_agg_running(lo: WindowLayout, values, valid, kind: str):
+    """RANGE UNBOUNDED PRECEDING..CURRENT ROW (peers share the value)."""
+    cap = values.shape[0]
+    v, w = _sorted_vals(lo, values, valid)
+    acc = jnp.float64 if jnp.issubdtype(v.dtype, jnp.floating) else jnp.int64
+    vv = jnp.where(w, v.astype(acc), 0)
+    csum = jnp.cumsum(vv)
+    ccnt = jnp.cumsum(w.astype(jnp.int64))
+    before_seg_sum = jnp.where(lo.seg_start > 0,
+                               jnp.take(csum, jnp.maximum(lo.seg_start - 1, 0)),
+                               0)
+    before_seg_cnt = jnp.where(lo.seg_start > 0,
+                               jnp.take(ccnt, jnp.maximum(lo.seg_start - 1, 0)),
+                               0)
+    run_sum = jnp.take(csum, lo.peer_last) - before_seg_sum
+    run_cnt = jnp.take(ccnt, lo.peer_last) - before_seg_cnt
+    if kind == "count":
+        return run_cnt, None
+    if kind == "sum":
+        return run_sum, run_cnt > 0
+    if kind == "avg":
+        return run_sum.astype(jnp.float64) / jnp.maximum(run_cnt, 1), \
+            run_cnt > 0
+    # running min/max via cummin/cummax reset at segment start: use
+    # associative_scan over (value, seg_id) pairs
+    big = jnp.where(w, v, _ident(kind, v.dtype))
+
+    def combine(a, b):
+        av, aseg = a
+        bv, bseg = b
+        same = aseg == bseg
+        if kind == "min":
+            m = jnp.minimum(av, bv)
+        else:
+            m = jnp.maximum(av, bv)
+        return jnp.where(same, m, bv), bseg
+
+    scanned, _ = lax.associative_scan(combine, (big, lo.seg_id))
+    run = jnp.take(scanned, lo.peer_last)
+    return run, run_cnt > 0
+
+
+def _ident(kind, dtype):
+    from .grouping import _max_ident, _min_ident
+
+    return _max_ident(dtype) if kind == "min" else _min_ident(dtype)
+
+
+def w_shift(lo: WindowLayout, values, valid, offset: int,
+            default_data=None):
+    """lag (offset>0) / lead (offset<0) within the partition."""
+    cap = values.shape[0]
+    v = jnp.take(values, lo.perm)
+    src = lo.pos - offset
+    seg_end = lo.seg_start + lo.seg_size - 1
+    in_seg = (src >= lo.seg_start) & (src <= seg_end)
+    srcc = jnp.clip(src, 0, cap - 1)
+    out = jnp.take(v, srcc)
+    out_valid = in_seg
+    if valid is not None:
+        sv = jnp.take(valid, lo.perm)
+        out_valid = out_valid & jnp.take(sv, srcc)
+    if default_data is not None:
+        out = jnp.where(in_seg, out, default_data)
+        out_valid = None if valid is None else (out_valid | ~in_seg)
+    return out, out_valid
+
+
+def scatter_back(lo: WindowLayout, sorted_vals, sorted_valid=None):
+    """Sorted-order results → original row order."""
+    cap = sorted_vals.shape[0]
+    out = jnp.zeros(cap, dtype=sorted_vals.dtype).at[lo.perm].set(sorted_vals)
+    ov = None
+    if sorted_valid is not None:
+        ov = jnp.zeros(cap, dtype=bool).at[lo.perm].set(sorted_valid)
+    return out, ov

@@ -1,0 +1,194 @@
+"""WindowExec.
+
+Role of the reference's sqlx/window/WindowExec.scala — but frame evaluation
+is the sort/segment kernel in ops/window.py (no row-at-a-time frame
+iterators), and results scatter back to the original row order so the
+operator is order-preserving like the reference's."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..columnar.batch import Column, ColumnarBatch
+from ..columnar.ops import concat_batches
+from ..errors import UnsupportedOperationError
+from ..exec.context import ExecContext
+from ..expr.expressions import (
+    AggregateFunction, Alias, AttributeReference, Average, Count, Literal,
+    Max, Min, SortOrder, Sum,
+)
+from ..expr.window import (
+    CumeDist, DenseRank, Lag, Lead, NTile, PercentRank, Rank, RowNumber,
+    WindowExpression,
+)
+from ..types import StringType, float64, int32, int64
+from .compile import GLOBAL_KERNEL_CACHE
+from .operators import PhysicalPlan, attrs_schema
+from .partitioning import AllTuples, ClusteredDistribution, UnspecifiedDistribution
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class WindowExec(PhysicalPlan):
+    """window_exprs: Alias(WindowExpression) whose function args, partition
+    keys, and order keys are bound to child attributes by the planner."""
+
+    child_fields = ("child",)
+
+    def __init__(self, window_exprs: Sequence[Alias],
+                 partition_keys: Sequence[AttributeReference],
+                 order_keys: Sequence[SortOrder], child: PhysicalPlan):
+        self.window_exprs = list(window_exprs)
+        self.partition_keys = list(partition_keys)
+        self.order_keys = list(order_keys)
+        self.child = child
+
+    @property
+    def output(self):
+        return self.child.output + [a.to_attribute() for a in self.window_exprs]
+
+    def required_child_distribution(self):
+        if not self.partition_keys:
+            return [AllTuples()]
+        return [ClusteredDistribution(list(self.partition_keys))]
+
+    def output_partitioning(self):
+        return self.child.output_partitioning()
+
+    def _plans(self):
+        """(kind, params) per window expr — static kernel config."""
+        out = []
+        has_order = bool(self.order_keys)
+        for al in self.window_exprs:
+            w: WindowExpression = al.child
+            f = w.function
+            if isinstance(f, RowNumber):
+                out.append(("row_number", None, None))
+            elif isinstance(f, Rank):
+                out.append(("rank", None, None))
+            elif isinstance(f, DenseRank):
+                out.append(("dense_rank", None, None))
+            elif isinstance(f, PercentRank):
+                out.append(("percent_rank", None, None))
+            elif isinstance(f, CumeDist):
+                out.append(("cume_dist", None, None))
+            elif isinstance(f, NTile):
+                out.append(("ntile", f.n, None))
+            elif isinstance(f, (Lag, Lead)):
+                off = f.offset if isinstance(f, Lag) else -f.offset
+                out.append(("shift", off, f.child))
+            elif isinstance(f, (Sum, Count, Min, Max, Average)):
+                kind = {Sum: "sum", Count: "count", Min: "min", Max: "max",
+                        Average: "avg"}[type(f)]
+                mode = "running" if has_order else "unbounded"
+                out.append((f"agg_{mode}_{kind}", None, f.child))
+            else:
+                raise UnsupportedOperationError(
+                    f"window function {type(f).__name__}")
+        return out
+
+    def execute(self, ctx: ExecContext):
+        parts = self.child.execute(ctx)
+        return [[self._run_partition(p)] if p else [] for p in parts]
+
+    def _run_partition(self, part) -> ColumnarBatch:
+        import jax
+
+        from ..ops import window as W
+        from ..ops.sorting import SortKeySpec
+
+        jnp = _jnp()
+        batch = concat_batches(part, attrs_schema(self.child.output))
+        pos = {a.expr_id: i for i, a in enumerate(self.child.output)}
+        cap = batch.capacity
+
+        pcols = [batch.columns[pos[k.expr_id]] for k in self.partition_keys]
+        ocols = [batch.columns[pos[o.child.expr_id]] for o in self.order_keys]
+        ospecs = [SortKeySpec(o.ascending, o.nulls_first)
+                  for o in self.order_keys]
+
+        plans = self._plans()
+        vcols = []
+        for kind, param, arg in plans:
+            if arg is not None:
+                vcols.append(batch.columns[pos[arg.expr_id]])
+            else:
+                vcols.append(None)
+
+        key = ("window", cap,
+               tuple((str(c.eq_keys().dtype), c.validity is not None)
+                     for c in pcols),
+               tuple((str(c.sort_keys().dtype), c.validity is not None,
+                      s.ascending, s.nulls_first)
+                     for c, s in zip(ocols, ospecs)),
+               tuple((k, p, None if v is None else
+                      (str(v.data.dtype), v.validity is not None))
+                     for (k, p, _), v in zip(plans, vcols)))
+
+        def build():
+            def kernel(pkeys, pvalids, okeys, ovalids, vdatas, vvalids,
+                       row_mask):
+                lo = W.build_layout(pkeys, pvalids, okeys, ovalids, ospecs,
+                                    row_mask)
+                outs = []
+                for (kind, param, _), vd, vv in zip(plans, vdatas, vvalids):
+                    if kind == "row_number":
+                        sv, svalid = W.w_row_number(lo), None
+                    elif kind == "rank":
+                        sv, svalid = W.w_rank(lo), None
+                    elif kind == "dense_rank":
+                        sv, svalid = W.w_dense_rank(lo), None
+                    elif kind == "percent_rank":
+                        sv, svalid = W.w_percent_rank(lo), None
+                    elif kind == "cume_dist":
+                        sv, svalid = W.w_cume_dist(lo), None
+                    elif kind == "ntile":
+                        sv, svalid = W.w_ntile(lo, param), None
+                    elif kind == "shift":
+                        sv, svalid = W.w_shift(lo, vd, vv, param)
+                    elif kind.startswith("agg_running_"):
+                        sv, svalid = W.w_agg_running(lo, vd, vv,
+                                                     kind.split("_")[-1])
+                    elif kind.startswith("agg_unbounded_"):
+                        sv, svalid = W.w_agg_unbounded(lo, vd, vv,
+                                                       kind.split("_")[-1])
+                    else:
+                        raise ValueError(kind)
+                    outs.append(W.scatter_back(lo, sv, svalid))
+                return outs
+
+            return jax.jit(kernel)
+
+        kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build)
+        outs = kernel([c.eq_keys() for c in pcols],
+                      [c.validity for c in pcols],
+                      [c.sort_keys() for c in ocols],
+                      [c.validity for c in ocols],
+                      [None if v is None else v.data for v in vcols],
+                      [None if v is None else v.validity for v in vcols],
+                      batch.row_mask)
+
+        schema = attrs_schema(self.output)
+        new_cols = list(batch.columns)
+        for (d, v), al in zip(outs, self.window_exprs):
+            dt = al.child.dtype
+            want = dt.device_dtype
+            if str(d.dtype) != str(want):
+                d = d.astype(want)
+            sdict = None
+            if isinstance(dt, StringType):
+                # shift over strings keeps the source dictionary
+                arg = al.child.function.child
+                sdict = batch.columns[pos[arg.expr_id]].dictionary
+            new_cols.append(Column(dt, d, v, sdict))
+        return ColumnarBatch(schema, new_cols, batch.row_mask,
+                             batch._num_rows)
+
+    def simple_string(self):
+        fns = ", ".join(a.child.function.sql_name()
+                        for a in self.window_exprs)
+        return f"Window[{fns}]"

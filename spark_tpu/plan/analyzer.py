@@ -194,6 +194,17 @@ class ResolveReferences(Rule):
                            for c in e.args):
                         return build_function(e.fname, e.args, e.distinct)
                     return e
+                from ..expr.window import (
+                    UnresolvedWindowExpression, WindowExpression,
+                )
+
+                if isinstance(e, UnresolvedWindowExpression):
+                    if e.function.resolved and \
+                            all(p.resolved for p in e.partition_spec) and \
+                            all(o.resolved for o in e.order_spec):
+                        return WindowExpression(e.function, e.partition_spec,
+                                                e.order_spec)
+                    return e
                 return e
 
             # Sort/Filter-over-Aggregate may reference aggregate output or
@@ -365,6 +376,66 @@ def _replace_agg(p: LogicalPlan, new_agg: Aggregate) -> LogicalPlan:
     return new_agg
 
 
+class ExtractWindowExpressions(Rule):
+    """Pull WindowExpressions out of projections into Window operators
+    (reference: Analyzer ExtractWindowExpressions). Expressions sharing a
+    (partition, order) spec evaluate in one Window node; distinct specs
+    chain."""
+
+    def apply(self, plan):
+        from ..expr.window import WindowExpression
+        from .logical import Window
+
+        def rule(node):
+            if not isinstance(node, Project) or not node.expressions_resolved:
+                return node
+            if not any(isinstance(x, WindowExpression)
+                       for e in node.project_list for x in e.iter_nodes()):
+                return node
+
+            collected: list[Alias] = []
+
+            def extract(x: Expression) -> Expression:
+                if isinstance(x, WindowExpression):
+                    al = Alias(x, f"_we{len(collected)}")
+                    collected.append(al)
+                    return al.to_attribute()
+                return x
+
+            new_list: list[Expression] = []
+            for e in node.project_list:
+                if isinstance(e, Alias):
+                    if isinstance(e.child, WindowExpression):
+                        collected.append(e)
+                        new_list.append(e.to_attribute())
+                        continue
+                    new_list.append(
+                        Alias(e.child.transform_up(extract), e.name,
+                              e.expr_id))
+                else:
+                    new_list.append(e.transform_up(extract))
+
+            # group by spec signature
+            groups: dict = {}
+            order: list = []
+            for al in collected:
+                sig = al.child.spec_signature()
+                if sig not in groups:
+                    groups[sig] = []
+                    order.append(sig)
+                groups[sig].append(al)
+
+            child = node.child
+            for sig in order:
+                exprs = groups[sig]
+                w0: "WindowExpression" = exprs[0].child
+                child = Window(exprs, list(w0.partition_spec),
+                               list(w0.order_spec), child)
+            return Project(new_list, child)
+
+        return plan.transform_up(rule)
+
+
 class ResolveSortHiddenRefs(Rule):
     """ORDER BY may reference columns of the FROM clause that are not in the
     SELECT list (reference: Analyzer ResolveMissingReferences) — resolve them
@@ -506,6 +577,7 @@ class Analyzer(RuleExecutor):
                 ResolveReferences(cs),
                 ResolveAggsInSortHaving(cs),
                 ResolveSortHiddenRefs(cs),
+                ExtractWindowExpressions(),
                 ResolveAliases(),
             ]),
             Batch("Coercion", FixedPoint(10), [

@@ -20,7 +20,8 @@ KEYWORDS = {
     "asc", "desc", "nulls", "first", "last", "exists", "interval", "date",
     "timestamp", "values", "create", "table", "view", "temporary", "replace",
     "drop", "insert", "into", "describe", "show", "tables", "explain",
-    "escape", "div",
+    "escape", "div", "over", "partition", "rows", "range", "unbounded",
+    "preceding", "following", "current",
 }
 
 

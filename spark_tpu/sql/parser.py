@@ -590,8 +590,53 @@ class Parser:
                 args.append(self.parse_expr())
         self.expect_op(")")
         if self.at_kw("over"):
-            raise ParseException("window functions not yet supported in SQL")
+            return self.parse_over(E.UnresolvedFunction(name, args, distinct))
         return E.UnresolvedFunction(name, args, distinct)
+
+    def parse_over(self, func: E.Expression) -> E.Expression:
+        from ..expr.window import WindowExpression
+
+        self.expect_kw("over")
+        self.expect_op("(")
+        partition: list[E.Expression] = []
+        orders: list[E.SortOrder] = []
+        if self.eat_kw("partition"):
+            self.expect_kw("by")
+            partition.append(self.parse_expr())
+            while self.eat_op(","):
+                partition.append(self.parse_expr())
+        if self.eat_kw("order"):
+            self.expect_kw("by")
+            orders.append(self.parse_sort_item(None))
+            while self.eat_op(","):
+                orders.append(self.parse_sort_item(None))
+        if self.at_kw("rows", "range"):
+            self.next()
+            # only default-equivalent frames accepted
+            if self.eat_kw("between"):
+                self._parse_frame_bound()
+                self.expect_kw("and")
+                self._parse_frame_bound()
+            else:
+                self._parse_frame_bound()
+        self.expect_op(")")
+        from ..expr.window import UnresolvedWindowExpression
+
+        return UnresolvedWindowExpression(func, partition, orders)
+
+    def _parse_frame_bound(self):
+        if self.eat_kw("unbounded"):
+            if not (self.eat_kw("preceding") or self.eat_kw("following")):
+                raise ParseException("bad frame bound")
+            return
+        if self.eat_kw("current"):
+            self.expect_kw("row")
+            return
+        t = self.next()
+        if t.kind != "num":
+            raise ParseException("bad frame bound")
+        if not (self.eat_kw("preceding") or self.eat_kw("following")):
+            raise ParseException("bad frame bound")
 
     def parse_case(self) -> E.Expression:
         self.expect_kw("case")
@@ -674,20 +719,22 @@ def _parse_ts_literal(s: str) -> datetime.datetime:
     raise ParseException(f"bad timestamp literal {s!r}")
 
 
-def _contains_agg(e: E.Expression) -> bool:
-    for n in e.iter_nodes():
-        if isinstance(n, E.AggregateFunction):
-            return True
-        if isinstance(n, E.UnresolvedFunction):
-            from ..expr.registry import lookup
+_AGG_NAMES = frozenset((
+    "sum", "count", "min", "max", "avg", "mean", "first", "any_value",
+    "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
+    "collect_set", "first_value"))
 
-            nl = n.fname.lower()
-            if nl in ("sum", "count", "min", "max", "avg", "mean", "first",
-                      "any_value", "stddev", "stddev_samp", "stddev_pop",
-                      "variance", "var_samp", "var_pop", "collect_set",
-                      "first_value"):
-                return True
-    return False
+
+def _contains_agg(e: E.Expression) -> bool:
+    from ..expr.window import UnresolvedWindowExpression
+
+    if isinstance(e, UnresolvedWindowExpression):
+        return False  # window aggregates are not grouping aggregates
+    if isinstance(e, E.AggregateFunction):
+        return True
+    if isinstance(e, E.UnresolvedFunction) and e.fname.lower() in _AGG_NAMES:
+        return True
+    return any(_contains_agg(c) for c in e.children)
 
 
 def _substitute_ctes(plan: L.LogicalPlan,

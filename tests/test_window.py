@@ -1,0 +1,104 @@
+"""Window function tests (reference: sql/core window suites /
+DataFrameWindowFunctionsSuite)."""
+
+import pyarrow as pa
+import pytest
+
+import spark_tpu.api.functions as F
+from spark_tpu.api.window import Window
+
+
+@pytest.fixture()
+def sales(spark):
+    df = spark.createDataFrame(pa.table({
+        "dept": ["a", "a", "a", "b", "b", "c"],
+        "emp": ["e1", "e2", "e3", "e4", "e5", "e6"],
+        "sal": [100, 200, 200, 50, 75, 10],
+    }))
+    df.createOrReplaceTempView("emp_sales")
+    return df
+
+
+def _d(df):
+    return df.toArrow().to_pydict()
+
+
+def test_row_number_rank_dense(sales):
+    w = Window.partitionBy("dept").orderBy(F.col("sal").desc())
+    out = _d(sales.select(
+        "dept", "emp", "sal",
+        F.row_number().over(w).alias("rn"),
+        F.rank().over(w).alias("rk"),
+        F.dense_rank().over(w).alias("dr"),
+    ).orderBy("dept", "sal", "emp"))
+    # dept a sorted desc by sal: e2(200), e3(200), e1(100)
+    rows = {(d, e): (rn, rk, dr) for d, e, rn, rk, dr in
+            zip(out["dept"], out["emp"], out["rn"], out["rk"], out["dr"])}
+    assert rows[("a", "e1")] == (3, 3, 2)
+    assert rows[("a", "e2")][1:] == (1, 1)   # rank/dense of a 200 row
+    assert rows[("a", "e3")][1:] == (1, 1)
+    assert sorted([rows[("a", "e2")][0], rows[("a", "e3")][0]]) == [1, 2]
+    assert rows[("b", "e5")] == (1, 1, 1)
+    assert rows[("b", "e4")] == (2, 2, 2)
+    assert rows[("c", "e6")] == (1, 1, 1)
+
+
+def test_running_sum(sales):
+    w = Window.partitionBy("dept").orderBy("sal")
+    out = _d(sales.select(
+        "dept", "sal", F.sum("sal").over(w).alias("rs"),
+    ).orderBy("dept", "sal"))
+    assert out["rs"][:3] == [100, 500, 500]  # peers (200,200) share total
+    assert out["rs"][3:5] == [50, 125]
+    assert out["rs"][5] == [10][0]
+
+
+def test_partition_total(sales):
+    w = Window.partitionBy("dept")
+    out = _d(sales.select("dept",
+                          F.sum("sal").over(w).alias("total"))
+             .distinct().orderBy("dept"))
+    assert out["total"] == [500, 125, 10]
+
+
+def test_lag_lead(sales):
+    w = Window.partitionBy("dept").orderBy("sal")
+    out = _d(sales.select(
+        "dept", "sal",
+        F.lag("sal").over(w).alias("prev"),
+        F.lead("sal").over(w).alias("next"),
+    ).orderBy("dept", "sal", "emp"))
+    assert out["prev"][:3] == [None, 100, 200]
+    assert out["next"][2] is None or out["next"][1] is not None
+
+
+def test_window_sql(sales, spark):
+    out = _d(spark.sql("""
+        SELECT dept, emp, sal,
+               row_number() OVER (PARTITION BY dept ORDER BY sal DESC) AS rn,
+               sum(sal) OVER (PARTITION BY dept) AS total
+        FROM emp_sales ORDER BY dept, rn"""))
+    assert out["rn"][:3] == [1, 2, 3]
+    assert out["total"][:3] == [500, 500, 500]
+    assert out["total"][3:5] == [125, 125]
+
+
+def test_ntile_percent_rank(spark):
+    df = spark.createDataFrame(pa.table({"v": list(range(1, 9))}))
+    w = Window.orderBy("v")
+    out = _d(df.select("v",
+                       F.ntile(4).over(w).alias("q"),
+                       F.percent_rank().over(w).alias("pr"))
+             .orderBy("v"))
+    assert out["q"] == [1, 1, 2, 2, 3, 3, 4, 4]
+    assert out["pr"][0] == 0.0
+    assert abs(out["pr"][-1] - 1.0) < 1e-12
+
+
+def test_window_after_join_shuffle(spark):
+    a = spark.createDataFrame(pa.table({
+        "k": [1, 1, 2, 2, 3], "v": [10, 20, 30, 40, 50]}))
+    w = Window.partitionBy("k").orderBy("v")
+    out = _d(a.repartition(4).select(
+        "k", "v", F.row_number().over(w).alias("rn")).orderBy("k", "v"))
+    assert out["rn"] == [1, 2, 1, 2, 1]
