@@ -109,10 +109,13 @@ class TpuSession:
         return DataFrame(self, UnresolvedRelation(name.split(".")))
 
     def sql(self, query: str, **kwargs):
+        from ..plan.commands import Command, run_command
         from ..sql.parser import parse_sql
         from .dataframe import DataFrame
 
         plan = parse_sql(query)
+        if isinstance(plan, Command):
+            return run_command(self, plan)
         return DataFrame(self, plan)
 
     def range(self, start: int, end: int | None = None, step: int = 1,

@@ -1,0 +1,152 @@
+"""SQL commands (DDL / utility statements).
+
+Role of the reference's command framework (sqlx/command/ — RunnableCommand:
+CreateViewCommand, ShowTablesCommand, DescribeTableCommand, ExplainCommand,
+CacheTableCommand...). Commands execute eagerly in session.sql and return
+their result rows as a LocalRelation-backed DataFrame, matching the
+reference's behavior.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .logical import LogicalPlan
+
+
+class Command:
+    """Marker base; session.sql dispatches on these."""
+
+
+@dataclass
+class CreateViewCommand(Command):
+    name: str
+    query: LogicalPlan
+    replace: bool = True
+    materialize: bool = False  # True for CREATE TABLE ... AS
+
+
+@dataclass
+class DropRelationCommand(Command):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class ShowTablesCommand(Command):
+    pass
+
+
+@dataclass
+class DescribeCommand(Command):
+    name: str
+
+
+@dataclass
+class ExplainCommand(Command):
+    query: LogicalPlan
+    extended: bool = False
+
+
+@dataclass
+class CacheTableCommand(Command):
+    name: str
+    uncache: bool = False
+
+
+@dataclass
+class SetCommand(Command):
+    key: Optional[str]
+    value: Optional[str]
+
+
+def run_command(session, cmd: Command):
+    """Execute a command; returns a DataFrame of result rows."""
+    import pyarrow as pa
+
+    from ..api.dataframe import DataFrame
+    from ..errors import AnalysisException
+
+    def df_of(table: pa.Table) -> DataFrame:
+        return session.createDataFrame(table)
+
+    if isinstance(cmd, CreateViewCommand):
+        if not cmd.replace and session.catalog.tableExists(cmd.name):
+            raise AnalysisException(
+                f"Temp view {cmd.name} already exists",
+                error_class="TEMP_TABLE_OR_VIEW_ALREADY_EXISTS")
+        plan = cmd.query
+        if cmd.materialize:
+            df = DataFrame(session, plan)
+            table = df.toArrow()
+            attrs = list(df.query_execution.analyzed.output)
+            from .logical import LocalRelation
+
+            plan = LocalRelation(attrs, table)
+        session.catalog_.register(cmd.name, plan)
+        return df_of(pa.table({"result": pa.array([], pa.string())}))
+
+    if isinstance(cmd, DropRelationCommand):
+        dropped = session.catalog_.drop(cmd.name)
+        if not dropped and not cmd.if_exists:
+            raise AnalysisException(
+                f"Table or view not found: {cmd.name}",
+                error_class="TABLE_OR_VIEW_NOT_FOUND")
+        return df_of(pa.table({"result": pa.array([], pa.string())}))
+
+    if isinstance(cmd, ShowTablesCommand):
+        names = session.catalog_.list_tables()
+        return df_of(pa.table({
+            "namespace": pa.array([""] * len(names)),
+            "tableName": pa.array(names),
+            "isTemporary": pa.array([True] * len(names)),
+        }))
+
+    if isinstance(cmd, DescribeCommand):
+        plan = session.catalog_.lookup(cmd.name.split("."))
+        from ..api.dataframe import DataFrame as DF
+
+        analyzed = DF(session, plan).query_execution.analyzed
+        return df_of(pa.table({
+            "col_name": pa.array([a.name for a in analyzed.output]),
+            "data_type": pa.array([a.dtype.simple_string()
+                                   for a in analyzed.output]),
+            "comment": pa.array([None] * len(analyzed.output), pa.string()),
+        }))
+
+    if isinstance(cmd, ExplainCommand):
+        from ..api.dataframe import DataFrame as DF
+
+        text = DF(session, cmd.query).query_execution.explain_string()
+        return df_of(pa.table({"plan": pa.array([text])}))
+
+    if isinstance(cmd, CacheTableCommand):
+        if cmd.uncache:
+            return df_of(pa.table({"result": pa.array([], pa.string())}))
+        plan = session.catalog_.lookup(cmd.name.split("."))
+        from ..api.dataframe import DataFrame as DF
+
+        df = DF(session, plan)
+        cached = df.cache()
+        session.catalog_.register(cmd.name, cached.plan)
+        return df_of(pa.table({"result": pa.array([], pa.string())}))
+
+    if isinstance(cmd, SetCommand):
+        if cmd.key is None:
+            from ..config import registry
+
+            items = sorted(registry().items())
+            return df_of(pa.table({
+                "key": pa.array([k for k, _ in items]),
+                "value": pa.array([str(session.conf.get(k))
+                                   for k, _ in items]),
+            }))
+        if cmd.value is not None:
+            session.conf.set(cmd.key, cmd.value)
+        return df_of(pa.table({
+            "key": pa.array([cmd.key]),
+            "value": pa.array([str(session.conf.get(cmd.key))]),
+        }))
+
+    raise AnalysisException(f"unknown command {type(cmd).__name__}")

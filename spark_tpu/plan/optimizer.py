@@ -512,6 +512,87 @@ class RemoveNoopProject(Rule):
         return plan.transform_up(rule)
 
 
+class RewriteDistinctAggregates(Rule):
+    """count(DISTINCT x) [GROUP BY g] → two-level aggregation:
+    inner Aggregate(g, x) dedups, outer counts (reference:
+    sqlcat/optimizer/RewriteDistinctAggregates.scala — the single-distinct
+    fast path; the multi-distinct Expand rewrite is round-2 work)."""
+
+    def apply(self, plan):
+        from ..errors import UnsupportedOperationError
+        from ..expr.expressions import Count
+
+        def rule(node):
+            if not isinstance(node, Aggregate) or not node.resolved:
+                return node
+            distincts = []
+            others = []
+            for e in node.aggregate_exprs:
+                for x in e.iter_nodes():
+                    if isinstance(x, AggregateFunction):
+                        if getattr(x, "distinct", False):
+                            distincts.append(x)
+                        else:
+                            others.append(x)
+            if not distincts:
+                return node
+            if others:
+                raise UnsupportedOperationError(
+                    "mixing DISTINCT and non-DISTINCT aggregates is not "
+                    "supported yet")
+            first_child = distincts[0].child
+            if any(not d.child.semantic_equals(first_child)
+                   for d in distincts[1:]):
+                raise UnsupportedOperationError(
+                    "multiple DISTINCT aggregates on different expressions "
+                    "are not supported yet")
+
+            # inner: dedup (g..., x)
+            inner_group: list[Expression] = []
+            inner_outs: list[Expression] = []
+            group_attr: list[tuple[Expression, AttributeReference]] = []
+            for i, g in enumerate(node.grouping_exprs):
+                if isinstance(g, AttributeReference):
+                    inner_group.append(g)
+                    inner_outs.append(g)
+                    group_attr.append((g, g))
+                else:
+                    al = Alias(g, f"_g{i}")
+                    inner_group.append(g)
+                    inner_outs.append(al)
+                    group_attr.append((g, al.to_attribute()))
+            if isinstance(first_child, AttributeReference):
+                x_attr = first_child
+                inner_outs.append(first_child)
+            else:
+                xal = Alias(first_child, "_dx")
+                x_attr = xal.to_attribute()
+                inner_outs.append(xal)
+            inner = Aggregate(inner_group + [first_child], inner_outs,
+                              node.child)
+
+            # outer: original outputs with count(distinct x) → count(x)
+            def fix(e: Expression) -> Expression:
+                if isinstance(e, Count) and e.distinct:
+                    return Count(x_attr, distinct=False)
+                for g, a in group_attr:
+                    if e.semantic_equals(g):
+                        return a
+                return e
+
+            outer_group = [a for _, a in group_attr]
+            outer_outs = []
+            for e in node.aggregate_exprs:
+                if isinstance(e, Alias):
+                    outer_outs.append(
+                        Alias(e.child.transform_up(fix), e.name, e.expr_id))
+                else:
+                    outer_outs.append(e.transform_up(fix))
+            return Aggregate(outer_group, outer_outs, inner)
+
+        return plan.transform_up(rule)
+
+
 class ReplaceDistinct(Rule):
     def apply(self, plan):
         def rule(node):
@@ -580,6 +661,7 @@ class Optimizer(RuleExecutor):
             Batch("Finish analysis", Once(), [
                 EliminateSubqueryAliases(),
                 ReplaceDistinct(),
+                RewriteDistinctAggregates(),
             ]),
             Batch("Operator optimization", FixedPoint(100), [
                 CombineFilters(),

@@ -105,15 +105,85 @@ class Parser:
         raise ParseException(f"expected identifier near {t.value!r}")
 
     # --- statements -------------------------------------------------------
-    def parse_statement(self) -> L.LogicalPlan:
-        if self.at_kw("with"):
+    def parse_statement(self):
+        from ..plan import commands as C
+
+        if self.at_kw("with", "select", "values") or self.at_op("("):
             return self.parse_query()
-        if self.at_kw("select", "values"):
-            return self.parse_query()
-        if self.at_op("("):
-            return self.parse_query()
+        if self.eat_kw("create"):
+            replace = False
+            if self.eat_kw("or"):
+                self.expect_kw("replace")
+                replace = True
+            while self.peek().value.lower() in ("global", "temporary", "temp"):
+                self.next()
+            materialize = False
+            if self.eat_kw("view"):
+                pass
+            elif self.eat_kw("table"):
+                materialize = True
+            else:
+                raise ParseException("expected VIEW or TABLE")
+            name = self._qualified_name()
+            self.expect_kw("as")
+            q = self.parse_query()
+            return C.CreateViewCommand(name, q, replace=replace or True,
+                                       materialize=materialize)
+        if self.eat_kw("drop"):
+            if not (self.eat_kw("view") or self.eat_kw("table")):
+                raise ParseException("expected VIEW or TABLE")
+            if_exists = False
+            if self.peek().value.lower() == "if":
+                self.next()
+                self.expect_kw("exists")
+                if_exists = True
+            return C.DropRelationCommand(self._qualified_name(), if_exists)
+        if self.eat_kw("show"):
+            self.expect_kw("tables")
+            return C.ShowTablesCommand()
+        if self.eat_kw("describe"):
+            self.eat_kw("table")
+            return C.DescribeCommand(self._qualified_name())
+        if self.eat_kw("explain"):
+            extended = self.peek().value.lower() in ("extended", "formatted")
+            if extended:
+                self.next()
+            return C.ExplainCommand(self.parse_query(), extended)
+        if self.peek().value.lower() == "cache":
+            self.next()
+            self.expect_kw("table")
+            return C.CacheTableCommand(self._qualified_name())
+        if self.peek().value.lower() == "uncache":
+            self.next()
+            self.expect_kw("table")
+            return C.CacheTableCommand(self._qualified_name(), uncache=True)
+        if self.peek().value.lower() == "set":
+            self.next()
+            if self.peek().kind == "eof":
+                return C.SetCommand(None, None)
+            key = self._conf_key()
+            value = None
+            if self.eat_op("="):
+                parts = []
+                while self.peek().kind != "eof" and not self.at_op(";"):
+                    parts.append(self.next().value)
+                value = " ".join(parts)
+            return C.SetCommand(key, value)
         raise ParseException(
             f"unsupported statement near {self.peek().value!r}")
+
+    def _qualified_name(self) -> str:
+        parts = [self.ident()]
+        while self.eat_op("."):
+            parts.append(self.ident())
+        return ".".join(parts)
+
+    def _conf_key(self) -> str:
+        parts = [self.next().value]
+        while self.at_op("."):
+            self.next()
+            parts.append(self.next().value)
+        return ".".join(parts)
 
     def parse_query(self) -> L.LogicalPlan:
         ctes: dict[str, L.LogicalPlan] = {}

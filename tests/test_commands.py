@@ -1,0 +1,75 @@
+"""DDL / utility command tests + distinct-aggregate rewrite."""
+
+import pyarrow as pa
+import pytest
+
+import spark_tpu.api.functions as F
+from spark_tpu.errors import AnalysisException
+
+
+def test_create_and_drop_view(spark):
+    spark.sql("CREATE OR REPLACE TEMPORARY VIEW v1 AS SELECT 1 AS x")
+    assert spark.sql("SELECT x + 1 AS y FROM v1").toArrow().to_pydict() == \
+        {"y": [2]}
+    spark.sql("DROP VIEW v1")
+    with pytest.raises(AnalysisException):
+        spark.sql("SELECT * FROM v1").toArrow()
+    spark.sql("DROP VIEW IF EXISTS v1")  # no error
+
+
+def test_create_table_as_materializes(spark):
+    spark.sql("CREATE OR REPLACE TEMPORARY VIEW src AS "
+              "SELECT col1 AS x FROM (VALUES (1), (2), (3))")
+    spark.sql("CREATE TABLE t_mat AS SELECT x * 10 AS y FROM src")
+    out = spark.sql("SELECT sum(y) AS s FROM t_mat").toArrow().to_pydict()
+    assert out["s"] == [60]
+    spark.sql("DROP TABLE t_mat")
+    spark.sql("DROP VIEW src")
+
+
+def test_show_tables_and_describe(spark):
+    spark.sql("CREATE OR REPLACE TEMP VIEW shown AS SELECT 1 AS a, 'x' AS b")
+    names = spark.sql("SHOW TABLES").toArrow().to_pydict()["tableName"]
+    assert "shown" in names
+    d = spark.sql("DESCRIBE shown").toArrow().to_pydict()
+    assert d["col_name"] == ["a", "b"]
+    assert d["data_type"] == ["integer", "string"]
+    spark.sql("DROP VIEW shown")
+
+
+def test_explain(spark):
+    out = spark.sql("EXPLAIN SELECT 1 AS one").toArrow().to_pydict()
+    assert "Physical Plan" in out["plan"][0]
+
+
+def test_set_command(spark):
+    spark.sql("SET spark.sql.shuffle.partitions = 6")
+    assert spark.conf.shuffle_partitions == 6
+    out = spark.sql("SET spark.sql.shuffle.partitions").toArrow().to_pydict()
+    assert out["value"] == ["6"]
+    spark.sql("SET spark.sql.shuffle.partitions = 4")
+
+
+def test_count_distinct_global(spark):
+    df = spark.createDataFrame(pa.table({"x": [1, 1, 2, 3, 3, 3]}))
+    out = df.agg(F.countDistinct("x").alias("c")).toArrow().to_pydict()
+    assert out["c"] == [3]
+
+
+def test_count_distinct_grouped(spark):
+    df = spark.createDataFrame(pa.table({
+        "g": ["a", "a", "a", "b", "b"],
+        "x": [1, 1, 2, 5, 5]}))
+    out = df.groupBy("g").agg(F.countDistinct("x").alias("c")) \
+        .orderBy("g").toArrow().to_pydict()
+    assert out["c"] == [2, 1]
+
+
+def test_count_distinct_sql(spark):
+    spark.sql("CREATE OR REPLACE TEMP VIEW cd AS "
+              "SELECT col1 AS g, col2 AS x FROM "
+              "(VALUES (1, 10), (1, 10), (1, 20), (2, 30))")
+    out = spark.sql("SELECT g, count(DISTINCT x) AS c FROM cd GROUP BY g "
+                    "ORDER BY g").toArrow().to_pydict()
+    assert out["c"] == [2, 1]
+    spark.sql("DROP VIEW cd")
