@@ -1,0 +1,176 @@
+// Native host runtime kernels.
+//
+// Role of the reference's [NATIVE-ROLE] Java off-heap layer
+// (common/unsafe/src/main/java/org/apache/spark/unsafe/Platform.java,
+// hash/Murmur3_x86_32.java, corej/util/collection/unsafe/sort/RadixSort.java):
+// the host-side hot loops that sit outside the XLA compute path —
+// dictionary hashing at Arrow ingest and counting-sort partitioning for the
+// DCN shuffle plane. Exposed as a plain C ABI for ctypes (no pybind11 in
+// the image).
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// 64-bit string hashing (xxhash64-inspired mixing, public-domain constants).
+// Per dictionary entry — row-level hashing rides jnp.take on device.
+// ---------------------------------------------------------------------------
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+static const uint64_t P1 = 0x9E3779B185EBCA87ULL;
+static const uint64_t P2 = 0xC2B2AE3D27D4EB4FULL;
+static const uint64_t P3 = 0x165667B19E3779F9ULL;
+static const uint64_t P4 = 0x85EBCA77C2B2AE63ULL;
+static const uint64_t P5 = 0x27D4EB2F165667C5ULL;
+
+static uint64_t hash_bytes64(const uint8_t* data, int64_t len) {
+  uint64_t h;
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  if (len >= 32) {
+    uint64_t v1 = P1 + P2, v2 = P2, v3 = 0, v4 = (uint64_t)0 - P1;
+    const uint8_t* limit = end - 32;
+    do {
+      uint64_t k;
+      std::memcpy(&k, p, 8);
+      v1 = rotl64(v1 + k * P2, 31) * P1;
+      std::memcpy(&k, p + 8, 8);
+      v2 = rotl64(v2 + k * P2, 31) * P1;
+      std::memcpy(&k, p + 16, 8);
+      v3 = rotl64(v3 + k * P2, 31) * P1;
+      std::memcpy(&k, p + 24, 8);
+      v4 = rotl64(v4 + k * P2, 31) * P1;
+      p += 32;
+    } while (p <= limit);
+    h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+  } else {
+    h = P5;
+  }
+  h += (uint64_t)len;
+  while (p + 8 <= end) {
+    uint64_t k;
+    std::memcpy(&k, p, 8);
+    h ^= rotl64(k * P2, 31) * P1;
+    h = rotl64(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    uint32_t k;
+    std::memcpy(&k, p, 4);
+    h ^= (uint64_t)k * P1;
+    h = rotl64(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= (*p) * P5;
+    h = rotl64(h, 11) * P1;
+    p++;
+  }
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+// blob: concatenated UTF-8 bytes; offsets: int64[n+1]; out: int64[n]
+void spark_tpu_hash_strings(const void* blob, const void* offsets_v,
+                            int64_t n, void* out_v) {
+  const uint8_t* bytes = (const uint8_t*)blob;
+  const int64_t* offsets = (const int64_t*)offsets_v;
+  int64_t* out = (int64_t*)out_v;
+  for (int64_t i = 0; i < n; i++) {
+    out[i] = (int64_t)hash_bytes64(bytes + offsets[i],
+                                   offsets[i + 1] - offsets[i]);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Counting-sort partitioning: group row indices by partition id.
+// (RadixSort.java role for the host shuffle plane.)
+// pids: int32[n]; order_out: int64[n] — row indices grouped by pid;
+// counts_out: int64[p].
+// ---------------------------------------------------------------------------
+
+void spark_tpu_radix_partition(const void* pids_v, int64_t n, int32_t p,
+                               void* order_v, void* counts_v) {
+  const int32_t* pids = (const int32_t*)pids_v;
+  int64_t* order = (int64_t*)order_v;
+  int64_t* counts = (int64_t*)counts_v;
+  for (int32_t i = 0; i < p; i++) counts[i] = 0;
+  for (int64_t i = 0; i < n; i++) {
+    int32_t pid = pids[i];
+    if (pid >= 0 && pid < p) counts[pid]++;
+  }
+  // prefix offsets
+  int64_t* cursor = new int64_t[p];
+  int64_t acc = 0;
+  for (int32_t i = 0; i < p; i++) {
+    cursor[i] = acc;
+    acc += counts[i];
+  }
+  for (int64_t i = 0; i < n; i++) {
+    int32_t pid = pids[i];
+    if (pid >= 0 && pid < p) order[cursor[pid]++] = i;
+  }
+  delete[] cursor;
+}
+
+// ---------------------------------------------------------------------------
+// Dictionary merge: union string dictionaries with an open-addressing map.
+// (role of UTF8String interning in the shuffle read path.)
+// Returns the merged size; recode[i] = merged code of input value i.
+// The caller passes values for several dictionaries concatenated; `starts`
+// gives per-dictionary value ranges so codes stay per-dictionary.
+// ---------------------------------------------------------------------------
+
+int64_t spark_tpu_merge_dicts(const void* blob, const void* offsets_v,
+                              int64_t n_values, void* recode_v,
+                              void* merged_order_v) {
+  const uint8_t* bytes = (const uint8_t*)blob;
+  const int64_t* offsets = (const int64_t*)offsets_v;
+  int32_t* recode = (int32_t*)recode_v;
+  int64_t* merged_order = (int64_t*)merged_order_v;  // first-occurrence idx
+
+  // open addressing, power-of-two capacity >= 2n
+  int64_t cap = 16;
+  while (cap < n_values * 2) cap <<= 1;
+  int64_t* slots = new int64_t[cap];  // value index or -1
+  for (int64_t i = 0; i < cap; i++) slots[i] = -1;
+
+  int64_t merged_n = 0;
+  for (int64_t i = 0; i < n_values; i++) {
+    const uint8_t* s = bytes + offsets[i];
+    int64_t len = offsets[i + 1] - offsets[i];
+    uint64_t h = hash_bytes64(s, len);
+    int64_t slot = (int64_t)(h & (uint64_t)(cap - 1));
+    for (;;) {
+      int64_t v = slots[slot];
+      if (v < 0) {
+        slots[slot] = i;
+        merged_order[merged_n] = i;
+        recode[i] = (int32_t)merged_n;
+        merged_n++;
+        break;
+      }
+      int64_t vlen = offsets[v + 1] - offsets[v];
+      if (vlen == len && std::memcmp(bytes + offsets[v], s, len) == 0) {
+        recode[i] = recode[v];
+        break;
+      }
+      slot = (slot + 1) & (cap - 1);
+    }
+  }
+  delete[] slots;
+  return merged_n;
+}
+
+}  // extern "C"

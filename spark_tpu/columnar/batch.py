@@ -139,18 +139,36 @@ class StringDict:
     def merged(a: "StringDict", b: "StringDict"):
         """Union two dictionaries; returns (merged, recode_a, recode_b) where
         recode_x maps old codes → merged codes."""
-        merged = list(a.values)
-        idx = {v: i for i, v in enumerate(merged)}
-        recode_b = np.empty(max(len(b.values), 1), dtype=np.int32)
-        for i, v in enumerate(b.values):
+        md, (ra, rb) = merge_string_dicts([a, b])
+        return md, ra, rb
+
+
+def merge_string_dicts(dicts: Sequence["StringDict"]):
+    """Union several dictionaries; returns (merged StringDict,
+    [recode int32 array per dict]). Uses the C++ open-addressing merge
+    (native/sparktpu_native.cpp spark_tpu_merge_dicts) when built."""
+    try:
+        from ..utils.native import merge_dicts
+
+        merged_vals, recodes = merge_dicts([d.values for d in dicts])
+        recodes = [r if len(r) else np.zeros(1, np.int32) for r in recodes]
+        return StringDict(merged_vals or [""]), recodes
+    except Exception:
+        pass
+    merged: list[str] = []
+    idx: dict[str, int] = {}
+    recodes = []
+    for d in dicts:
+        lut = np.zeros(max(len(d.values), 1), dtype=np.int32)
+        for i, v in enumerate(d.values or [""]):
             j = idx.get(v)
             if j is None:
                 j = len(merged)
                 merged.append(v)
                 idx[v] = j
-            recode_b[i] = j
-        recode_a = np.arange(max(len(a.values), 1), dtype=np.int32)
-        return StringDict(merged), recode_a, recode_b
+            lut[i] = j
+        recodes.append(lut)
+    return StringDict(merged or [""]), recodes
 
 
 EMPTY_DICT = StringDict([])

@@ -23,26 +23,22 @@ def _jnp():
 
 def unify_string_columns(cols: Sequence[Column]) -> tuple[StringDict, list]:
     """Merge the dictionaries of string columns; returns (merged dict,
-    per-column recoded code arrays)."""
+    per-column recoded code arrays). The dictionary union runs in the native
+    C++ merge when built (utils/native.py)."""
+    from .batch import merge_string_dicts
+
     jnp = _jnp()
-    merged: list[str] = []
-    idx: dict[str, int] = {}
+    dicts = [c.dictionary or StringDict([""]) for c in cols]
+    # fast path: all columns share one dictionary object (common after a
+    # scan of one partition) — no recode needed
+    if all(d is dicts[0] for d in dicts):
+        return dicts[0], [c.data for c in cols]
+    merged, luts = merge_string_dicts(dicts)
     recoded = []
-    for c in cols:
-        sd = c.dictionary or StringDict([""])
-        lut = np.zeros(max(len(sd.values), 1), dtype=np.int32)
-        for i, v in enumerate(sd.values or [""]):
-            j = idx.get(v)
-            if j is None:
-                j = len(merged)
-                merged.append(v)
-                idx[v] = j
-            lut[i] = j
-        if len(sd.values) and list(lut) == list(range(len(sd.values))) and not recoded:
-            pass
-        recoded.append(jnp.take(jnp.asarray(lut),
-                                jnp.clip(c.data, 0, lut.shape[0] - 1)))
-    return StringDict(merged or [""]), recoded
+    for c, lut in zip(cols, luts):
+        lut_d = jnp.asarray(lut)
+        recoded.append(jnp.take(lut_d, jnp.clip(c.data, 0, len(lut) - 1)))
+    return merged, recoded
 
 
 def concat_batches(batches: Sequence[ColumnarBatch],

@@ -88,21 +88,15 @@ class _OutBuffer:
 
 
 def _merge_dict_chunks(sdicts: list, datas: list):
-    merged: list[str] = []
-    idx: dict[str, int] = {}
-    recoded = []
-    for sd, codes in zip(sdicts, datas):
-        sd = sd or StringDict([""])
-        lut = np.zeros(max(len(sd.values), 1), dtype=np.int32)
-        for i, v in enumerate(sd.values or [""]):
-            j = idx.get(v)
-            if j is None:
-                j = len(merged)
-                merged.append(v)
-                idx[v] = j
-            lut[i] = j
-        recoded.append(lut[np.clip(codes, 0, len(lut) - 1)])
-    return StringDict(merged or [""]), recoded
+    from ..columnar.batch import merge_string_dicts
+
+    dicts = [sd or StringDict([""]) for sd in sdicts]
+    if all(d is dicts[0] for d in dicts):
+        return dicts[0], [np.asarray(c) for c in datas]
+    merged, luts = merge_string_dicts(dicts)
+    recoded = [lut[np.clip(codes, 0, len(lut) - 1)]
+               for lut, codes in zip(luts, datas)]
+    return merged, recoded
 
 
 def _pull_sorted(batch: ColumnarBatch, perm, counts) -> tuple[list, np.ndarray]:

@@ -2,39 +2,54 @@
 
 Role of the reference's [NATIVE-ROLE] Java off-heap layer
 (common/unsafe/.../Platform.java, Murmur3_x86_32.java, RadixSort.java):
-host-side hot loops — string hashing at dictionary build, radix partitioning
-for shuffle — implemented in C++ and loaded via ctypes. Every entry point has
-a pure-Python/numpy fallback; callers catch ImportError/OSError.
+host-side hot loops — string hashing at dictionary build, counting-sort
+partitioning, dictionary merge — implemented in C++ and loaded via ctypes
+(no pybind11 in the image). Auto-builds with g++ on first use; every entry
+point has a numpy fallback so callers catch ImportError/OSError.
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
+import subprocess
 from functools import lru_cache
 
 import numpy as np
 
-_LIB_NAMES = ("libsparktpu_native.so",)
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libsparktpu_native.so")
+
+
+def _try_build() -> None:
+    src = os.path.join(_NATIVE_DIR, "sparktpu_native.cpp")
+    if not os.path.exists(src):
+        raise ImportError("native source missing")
+    os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
+    subprocess.run(
+        ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", _SO_PATH, src],
+        check=True, capture_output=True, timeout=120)
 
 
 @lru_cache(maxsize=1)
 def _load():
-    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    candidates = [os.path.join(here, "..", "native", "build", n) for n in _LIB_NAMES]
-    candidates += [os.path.join(here, "native", n) for n in _LIB_NAMES]
-    for c in candidates:
-        if os.path.exists(c):
-            lib = ctypes.CDLL(c)
-            lib.spark_tpu_hash_strings.restype = None
-            lib.spark_tpu_hash_strings.argtypes = [
-                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
-            lib.spark_tpu_radix_partition.restype = None
-            lib.spark_tpu_radix_partition.argtypes = [
-                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
-                ctypes.c_void_p, ctypes.c_void_p]
-            return lib
-    raise ImportError("native library not built")
+    if not os.path.exists(_SO_PATH):
+        _try_build()
+    lib = ctypes.CDLL(_SO_PATH)
+    lib.spark_tpu_hash_strings.restype = None
+    lib.spark_tpu_hash_strings.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+    lib.spark_tpu_radix_partition.restype = None
+    lib.spark_tpu_radix_partition.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_void_p, ctypes.c_void_p]
+    lib.spark_tpu_merge_dicts.restype = ctypes.c_int64
+    lib.spark_tpu_merge_dicts.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p]
+    return lib
 
 
 def available() -> bool:
@@ -45,14 +60,22 @@ def available() -> bool:
         return False
 
 
+def _pack(values: list[str]) -> tuple[bytes, np.ndarray]:
+    encoded = [v.encode("utf-8") for v in values]
+    blob = b"".join(encoded)
+    offsets = np.zeros(len(values) + 1, dtype=np.int64)
+    np.cumsum([len(e) for e in encoded], out=offsets[1:])
+    return blob, offsets
+
+
 def hash_strings(values: list[str]) -> np.ndarray:
     """64-bit hashes for a list of strings via the C++ xxhash64 kernel."""
     lib = _load()
-    blob = b"".join(v.encode("utf-8") for v in values)
-    offsets = np.zeros(len(values) + 1, dtype=np.int64)
-    np.cumsum([len(v.encode("utf-8")) for v in values], out=offsets[1:])
+    if not values:
+        return np.zeros(0, dtype=np.int64)
+    blob, offsets = _pack(values)
     out = np.empty(len(values), dtype=np.int64)
-    buf = ctypes.create_string_buffer(blob, len(blob))
+    buf = ctypes.create_string_buffer(blob, max(len(blob), 1))
     lib.spark_tpu_hash_strings(
         buf, offsets.ctypes.data_as(ctypes.c_void_p), len(values),
         out.ctypes.data_as(ctypes.c_void_p))
@@ -62,8 +85,7 @@ def hash_strings(values: list[str]) -> np.ndarray:
 def radix_partition(pids: np.ndarray, num_partitions: int):
     """Counting-sort row indices by partition id.
 
-    Returns (order int64[n] — row indices grouped by pid, counts int64[p]).
-    Python fallback: np.argsort."""
+    Returns (order int64[n] — row indices grouped by pid, counts int64[p])."""
     lib = _load()
     pids = np.ascontiguousarray(pids, dtype=np.int32)
     order = np.empty(len(pids), dtype=np.int64)
@@ -73,3 +95,28 @@ def radix_partition(pids: np.ndarray, num_partitions: int):
         order.ctypes.data_as(ctypes.c_void_p),
         counts.ctypes.data_as(ctypes.c_void_p))
     return order, counts
+
+
+def merge_dicts(value_lists: list[list[str]]):
+    """Union several string dictionaries.
+
+    Returns (merged values list, [recode int32 array per input dict])."""
+    lib = _load()
+    all_values = [v for vals in value_lists for v in vals]
+    if not all_values:
+        return [], [np.zeros(0, np.int32) for _ in value_lists]
+    blob, offsets = _pack(all_values)
+    recode = np.empty(len(all_values), dtype=np.int32)
+    morder = np.empty(len(all_values), dtype=np.int64)
+    buf = ctypes.create_string_buffer(blob, max(len(blob), 1))
+    n = lib.spark_tpu_merge_dicts(
+        buf, offsets.ctypes.data_as(ctypes.c_void_p), len(all_values),
+        recode.ctypes.data_as(ctypes.c_void_p),
+        morder.ctypes.data_as(ctypes.c_void_p))
+    merged = [all_values[morder[i]] for i in range(n)]
+    out = []
+    pos = 0
+    for vals in value_lists:
+        out.append(recode[pos:pos + len(vals)].copy())
+        pos += len(vals)
+    return merged, out
