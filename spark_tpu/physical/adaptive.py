@@ -1,0 +1,104 @@
+"""Adaptive execution: runtime partition coalescing.
+
+Role of the reference's AQE partition coalescing
+(sqlx/adaptive/CoalesceShufflePartitions.scala + AQEShuffleReadExec:41,
+driven by MapOutputStatistics). Our exchanges execute eagerly and report
+per-reducer row counts, so blocking consumers coalesce undersized adjacent
+reducer outputs before processing — hash clustering and range ordering are
+preserved because only ADJACENT partitions merge. Joins coordinate one
+merge plan across both sides (the reference does the same via shared
+partition specs). Skew splitting (OptimizeSkewedJoin.scala:57) is round-2.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..config import (
+    ADAPTIVE_ENABLED, ADVISORY_PARTITION_BYTES, COALESCE_PARTITIONS_ENABLED,
+)
+from ..exec.context import ExecContext
+
+
+def _partition_rows(part) -> int:
+    return sum(b.num_rows() for b in part)
+
+
+def _row_width(schema_attrs) -> int:
+    w = 0
+    for a in schema_attrs:
+        w += max(int(a.dtype.device_dtype.itemsize), 4)
+    return max(w, 8)
+
+
+def plan_merge_groups(sizes: Sequence[int], advisory_rows: int) -> list[list[int]]:
+    """Group consecutive partition indices so each group reaches the
+    advisory size (last group may be small)."""
+    groups: list[list[int]] = []
+    cur: list[int] = []
+    acc = 0
+    for i, s in enumerate(sizes):
+        cur.append(i)
+        acc += s
+        if acc >= advisory_rows:
+            groups.append(cur)
+            cur = []
+            acc = 0
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def apply_merge_groups(parts: list, groups: list[list[int]]) -> list:
+    return [[b for i in g for b in parts[i]] for g in groups]
+
+
+def coalesce_after_exchange(plan_child, parts: list, ctx: ExecContext,
+                            output_attrs) -> list:
+    """Coalesce a single exchange's output for a blocking consumer."""
+    from .exchange import ShuffleExchangeExec
+
+    if not isinstance(plan_child, ShuffleExchangeExec):
+        return parts
+    if not (ctx.conf.get(ADAPTIVE_ENABLED)
+            and ctx.conf.get(COALESCE_PARTITIONS_ENABLED)):
+        return parts
+    if len(parts) <= 1:
+        return parts
+    advisory = int(ctx.conf.get(ADVISORY_PARTITION_BYTES)) // \
+        _row_width(output_attrs)
+    sizes = [_partition_rows(p) for p in parts]
+    if sum(sizes) == 0:
+        return [[b for p in parts for b in p]]
+    groups = plan_merge_groups(sizes, advisory)
+    if len(groups) == len(parts):
+        return parts
+    ctx.metrics.add("aqe.partitions_coalesced", len(parts) - len(groups))
+    return apply_merge_groups(parts, groups)
+
+
+def coalesce_join_inputs(left_child, right_child, left_parts: list,
+                         right_parts: list, ctx: ExecContext,
+                         left_attrs, right_attrs):
+    """Coordinated coalescing for co-partitioned join inputs."""
+    from .exchange import ShuffleExchangeExec
+
+    if not (isinstance(left_child, ShuffleExchangeExec)
+            and isinstance(right_child, ShuffleExchangeExec)):
+        return left_parts, right_parts
+    if not (ctx.conf.get(ADAPTIVE_ENABLED)
+            and ctx.conf.get(COALESCE_PARTITIONS_ENABLED)):
+        return left_parts, right_parts
+    if len(left_parts) != len(right_parts) or len(left_parts) <= 1:
+        return left_parts, right_parts
+    advisory = int(ctx.conf.get(ADVISORY_PARTITION_BYTES)) // max(
+        _row_width(left_attrs), _row_width(right_attrs))
+    sizes = [max(_partition_rows(l), _partition_rows(r))
+             for l, r in zip(left_parts, right_parts)]
+    groups = plan_merge_groups(sizes, advisory)
+    if len(groups) == len(left_parts):
+        return left_parts, right_parts
+    ctx.metrics.add("aqe.partitions_coalesced",
+                    len(left_parts) - len(groups))
+    return (apply_merge_groups(left_parts, groups),
+            apply_merge_groups(right_parts, groups))

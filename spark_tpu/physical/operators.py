@@ -397,7 +397,12 @@ class HashAggregateExec(PhysicalPlan):
         return out
 
     def execute(self, ctx: ExecContext) -> list[Partition]:
+        from .adaptive import coalesce_after_exchange
+
         parts = self.child.execute(ctx)
+        if self.mode == "final":
+            parts = coalesce_after_exchange(self.child, parts, ctx,
+                                            self.child.output)
         return [[self._aggregate_partition(part, ctx)] for part in parts]
 
     def _aggregate_partition(self, part: Partition, ctx) -> ColumnarBatch:
@@ -494,8 +499,12 @@ class SortExec(PhysicalPlan):
         return [UnspecifiedDistribution()]
 
     def execute(self, ctx: ExecContext) -> list[Partition]:
-        return [[self._sort_partition(p)] if p else [] for p in
-                self.child.execute(ctx)]
+        from .adaptive import coalesce_after_exchange
+
+        parts = self.child.execute(ctx)
+        parts = coalesce_after_exchange(self.child, parts, ctx,
+                                        self.child.output)
+        return [[self._sort_partition(p)] if p else [] for p in parts]
 
     def _sort_partition(self, part: Partition) -> ColumnarBatch:
         import jax
@@ -640,7 +649,7 @@ class HashJoinExec(PhysicalPlan):
         return self.left.output_partitioning()
 
     def execute(self, ctx: ExecContext) -> list[Partition]:
-        from ..ops.joining import build_index
+        from .adaptive import coalesce_join_inputs
 
         left_parts = self.left.execute(ctx)
         right_parts = self.right.execute(ctx)
@@ -648,6 +657,10 @@ class HashJoinExec(PhysicalPlan):
             # broadcast exchange produced one partition; replicate
             bp = right_parts[0]
             right_parts = [bp for _ in left_parts]
+        else:
+            left_parts, right_parts = coalesce_join_inputs(
+                self.left, self.right, left_parts, right_parts, ctx,
+                self.left.output, self.right.output)
         if len(left_parts) != len(right_parts):
             raise ExecutionError(
                 f"join children partition counts differ: "

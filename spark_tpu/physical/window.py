@@ -92,7 +92,11 @@ class WindowExec(PhysicalPlan):
         return out
 
     def execute(self, ctx: ExecContext):
+        from .adaptive import coalesce_after_exchange
+
         parts = self.child.execute(ctx)
+        parts = coalesce_after_exchange(self.child, parts, ctx,
+                                        self.child.output)
         return [[self._run_partition(p)] if p else [] for p in parts]
 
     def _run_partition(self, part) -> ColumnarBatch:

@@ -1,0 +1,46 @@
+"""AQE partition-coalescing tests (reference: CoalesceShufflePartitionsSuite)."""
+
+import pyarrow as pa
+import pytest
+
+import spark_tpu.api.functions as F
+from spark_tpu.physical.adaptive import plan_merge_groups
+
+
+def test_plan_merge_groups():
+    assert plan_merge_groups([1, 1, 1, 10, 1], 3) == [[0, 1, 2], [3], [4]]
+    assert plan_merge_groups([5, 5], 3) == [[0], [1]]
+    assert plan_merge_groups([0, 0, 0], 3) == [[0, 1, 2]]
+
+
+def test_coalesced_agg_correct(spark):
+    # tiny shuffle partitions → coalesced into one, results unchanged
+    spark.conf.set("spark.sql.adaptive.advisoryPartitionSizeInBytes",
+                   1 << 30)
+    try:
+        df = spark.range(0, 1000, 1, 8)
+        out = (df.groupBy((F.col("id") % 5).alias("m"))
+               .agg(F.count("*").alias("c")).orderBy("m")
+               .toArrow().to_pydict())
+        assert out["c"] == [200] * 5
+        snap = spark._metrics.snapshot()
+        assert snap["counters"].get("aqe.partitions_coalesced", 0) > 0
+    finally:
+        spark.conf.unset("spark.sql.adaptive.advisoryPartitionSizeInBytes")
+
+
+def test_coalesced_join_correct(spark):
+    spark.conf.set("spark.sql.adaptive.advisoryPartitionSizeInBytes",
+                   1 << 30)
+    spark.conf.set("spark.sql.autoBroadcastJoinThreshold", -1)  # force shuffle
+    try:
+        a = spark.createDataFrame(pa.table({
+            "k": list(range(50)), "v": list(range(50))}))
+        b = spark.createDataFrame(pa.table({
+            "k": list(range(0, 100, 2)), "w": list(range(50))}))
+        out = a.join(b, on="k").agg(F.count("*").alias("c")) \
+            .toArrow().to_pydict()
+        assert out["c"] == [25]
+    finally:
+        spark.conf.unset("spark.sql.adaptive.advisoryPartitionSizeInBytes")
+        spark.conf.unset("spark.sql.autoBroadcastJoinThreshold")
