@@ -57,7 +57,37 @@ class DataFrame:
 
     # ------------------------------------------------------------------
     def _with(self, plan: L.LogicalPlan) -> "DataFrame":
-        return DataFrame(self.session, plan)
+        df = DataFrame(self.session, plan)
+        df._watermark = getattr(self, "_watermark", None)
+        return df
+
+    # --- streaming -----------------------------------------------------
+    @property
+    def isStreaming(self) -> bool:
+        from ..streaming.query import StreamingRelation
+
+        return any(isinstance(n, StreamingRelation)
+                   for n in self.plan.iter_nodes())
+
+    def withWatermark(self, column: str, delay: str) -> "DataFrame":
+        parts = delay.split()
+        v = float(parts[0])
+        unit = parts[1] if len(parts) > 1 else "seconds"
+        mult = {"millisecond": 1e-3, "second": 1.0, "minute": 60.0,
+                "hour": 3600.0, "day": 86400.0}
+        for k, m in mult.items():
+            if unit.startswith(k) or unit.rstrip("s").startswith(k):
+                v *= m
+                break
+        df = self._with(self.plan)
+        df._watermark = (column, v)
+        return df
+
+    @property
+    def writeStream(self):
+        from ..streaming.api import DataStreamWriter
+
+        return DataStreamWriter(self)
 
     @property
     def query_execution(self) -> QueryExecution:

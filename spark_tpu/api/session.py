@@ -87,6 +87,7 @@ class TpuSession:
         self._optimizer = Optimizer()
         self._metrics = Metrics()
         self._cached: dict[int, Any] = {}
+        self._streams: list = []
         TpuSession._active = self
 
     # ------------------------------------------------------------------
@@ -138,12 +139,54 @@ class TpuSession:
 
     # ------------------------------------------------------------------
     @property
+    def readStream(self):
+        from ..streaming.api import DataStreamReader
+
+        return DataStreamReader(self)
+
+    @property
+    def streams(self):
+        return _StreamsApi(self)
+
+    def memory_stream(self, schema=None):
+        """Create a MemoryStream + its DataFrame (test helper; reference:
+        MemoryStream[T].toDF)."""
+        from ..streaming.query import StreamingRelation
+        from ..streaming.sources import MemoryStream
+        from .dataframe import DataFrame
+
+        src = MemoryStream(schema)
+        if schema is None:
+            raise ValueError("memory_stream requires a pyarrow schema")
+        return src, DataFrame(self, StreamingRelation(src))
+
+    # ------------------------------------------------------------------
+    @property
     def catalog(self):
         return _CatalogApi(self)
 
     def stop(self) -> None:
+        for q in self._streams:
+            try:
+                q.stop()
+            except Exception:
+                pass
+        self._streams.clear()
         if TpuSession._active is self:
             TpuSession._active = None
+
+
+class _StreamsApi:
+    def __init__(self, session):
+        self.s = session
+
+    @property
+    def active(self):
+        return [q for q in self.s._streams if q.isActive]
+
+    def awaitAnyTermination(self, timeout=None):
+        for q in list(self.s._streams):
+            q.awaitTermination(timeout)
 
     def _cache_df(self, df):
         # materialize once and swap in a LocalRelation (role of CacheManager,

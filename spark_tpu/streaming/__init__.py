@@ -1,0 +1,3 @@
+from .sources import MemoryStream, RateSource, FileStreamSource  # noqa: F401
+from .query import StreamingQuery, StreamingRelation  # noqa: F401
+from .api import DataStreamReader, DataStreamWriter  # noqa: F401

@@ -1,0 +1,119 @@
+"""DataStreamReader / DataStreamWriter (reference: sql/core/.../streaming/
+DataStreamReader.scala, DataStreamWriter.scala)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from ..errors import AnalysisException
+from .query import (
+    ConsoleSink, ForeachBatchSink, MemorySink, StreamingQuery,
+    StreamingRelation,
+)
+from .sources import FileStreamSource, MemoryStream, RateSource
+
+
+class DataStreamReader:
+    def __init__(self, session):
+        self.session = session
+        self._format = None
+        self._options: dict[str, Any] = {}
+        self._schema = None
+
+    def format(self, fmt: str) -> "DataStreamReader":  # noqa: A003
+        self._format = fmt
+        return self
+
+    def option(self, k, v) -> "DataStreamReader":
+        self._options[k] = v
+        return self
+
+    def schema(self, s) -> "DataStreamReader":
+        self._schema = s
+        return self
+
+    def load(self, path: str | None = None):
+        from ..api.dataframe import DataFrame
+
+        fmt = (self._format or "").lower()
+        if fmt == "rate":
+            src = RateSource(int(self._options.get("rowsPerSecond", 1)))
+        elif fmt in ("parquet", "csv", "json"):
+            src = FileStreamSource(path or self._options["path"], fmt)
+        else:
+            raise AnalysisException(f"unknown streaming format {fmt}")
+        return DataFrame(self.session, StreamingRelation(src))
+
+    def parquet(self, path: str):
+        return self.format("parquet").load(path)
+
+    def csv(self, path: str):
+        return self.format("csv").load(path)
+
+    def json(self, path: str):
+        return self.format("json").load(path)
+
+
+class DataStreamWriter:
+    def __init__(self, df):
+        self.df = df
+        self._format = "memory"
+        self._output_mode = "append"
+        self._options: dict[str, Any] = {}
+        self._query_name: str | None = None
+        self._trigger_interval: float | None = None
+        self._once = False
+        self._foreach_fn: Callable | None = None
+
+    def format(self, fmt: str) -> "DataStreamWriter":  # noqa: A003
+        self._format = fmt
+        return self
+
+    def outputMode(self, mode: str) -> "DataStreamWriter":
+        self._output_mode = mode.lower()
+        return self
+
+    def option(self, k, v) -> "DataStreamWriter":
+        self._options[k] = v
+        return self
+
+    def queryName(self, name: str) -> "DataStreamWriter":
+        self._query_name = name
+        return self
+
+    def trigger(self, processingTime: str | None = None, once: bool = False,
+                availableNow: bool = False) -> "DataStreamWriter":
+        if processingTime:
+            parts = processingTime.split()
+            v = float(parts[0])
+            unit = parts[1] if len(parts) > 1 else "seconds"
+            if unit.startswith("milli"):
+                v /= 1000.0
+            self._trigger_interval = v
+        self._once = once or availableNow
+        return self
+
+    def foreachBatch(self, fn: Callable) -> "DataStreamWriter":
+        self._format = "foreachBatch"
+        self._foreach_fn = fn
+        return self
+
+    def start(self, path: str | None = None) -> StreamingQuery:
+        session = self.df.session
+        fmt = self._format.lower()
+        if fmt == "memory":
+            name = self._query_name or "stream_output"
+            sink = MemorySink(name, session)
+        elif fmt == "console":
+            sink = ConsoleSink()
+        elif fmt == "foreachbatch":
+            sink = ForeachBatchSink(self._foreach_fn, session)
+        else:
+            raise AnalysisException(f"unknown streaming sink {fmt}")
+        wm = getattr(self.df, "_watermark", None)
+        q = StreamingQuery(
+            session, self.df.plan, sink, self._output_mode,
+            self._trigger_interval, self._once,
+            self._options.get("checkpointLocation"), self._query_name, wm)
+        session._streams.append(q)
+        return q

@@ -1,0 +1,419 @@
+"""Micro-batch streaming execution.
+
+Role of the reference's StreamExecution/MicroBatchExecution
+(sqlx/streaming/runtime/StreamExecution.scala — query thread + trigger loop;
+MicroBatchExecution.scala — per-trigger incremental planning;
+IncrementalExecution.scala:65 — stateful operator rewriting; offset/commit
+WAL under sqlx/streaming/checkpointing/).
+
+TPU-native stateful aggregation: state IS the partial-aggregation buffer
+table. Each trigger computes device partials of the new rows, unions them
+with the state scan, and runs the same associative final-merge kernel the
+batch engine uses; the merged buffers become the next state version. No
+separate state-update kernels exist.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import traceback
+import uuid
+from typing import Any, Callable, Optional
+
+import pyarrow as pa
+
+from ..errors import AnalysisException, UnsupportedOperationError
+from ..exec.context import ExecContext
+from ..plan.logical import (
+    Aggregate, LeafNode, LocalRelation, LogicalPlan,
+)
+from ..expr.expressions import AttributeReference
+from .sources import StreamSource
+from .state import StateStore
+
+
+class StreamingRelation(LeafNode):
+    """Logical leaf for a streaming source; replaced per micro-batch with a
+    LocalRelation of the new rows (same attribute ids, so every compiled
+    kernel is reused across triggers)."""
+
+    def __init__(self, source: StreamSource,
+                 attrs: list[AttributeReference] | None = None):
+        self.source = source
+        self.attrs = attrs or [
+            AttributeReference(f.name, f.dataType, f.nullable)
+            for f in source.schema.fields]
+
+    @property
+    def output(self):
+        return self.attrs
+
+    def _data_args(self):
+        return (("ids", tuple(a.expr_id for a in self.attrs)),)
+
+
+class _PhysicalHolder(LeafNode):
+    """Logical leaf wrapping an already-executed physical result."""
+
+    def __init__(self, exec_plan, attrs):
+        self.exec_plan = exec_plan
+        self.attrs = attrs
+
+    @property
+    def output(self):
+        return self.attrs
+
+
+class PrecomputedExec:
+    """Physical leaf over materialized partitions."""
+
+    def __init__(self, partitions, attrs):
+        self.partitions = partitions
+        self.attrs = attrs
+        self.child_fields = ()
+
+    @property
+    def output(self):
+        return self.attrs
+
+    @property
+    def children(self):
+        return []
+
+    def output_partitioning(self):
+        from ..physical.partitioning import UnknownPartitioning
+
+        return UnknownPartitioning(max(len(self.partitions), 1))
+
+    def required_child_distribution(self):
+        return []
+
+    def map_children(self, f):
+        return self
+
+    def with_new_children(self, c):
+        return self
+
+    def execute(self, ctx):
+        return self.partitions
+
+    def tree_string(self, depth=0):
+        return "  " * depth + "Precomputed"
+
+
+class StreamingQuery:
+    """Handle to a running query (reference: StreamingQuery API)."""
+
+    def __init__(self, session, plan: LogicalPlan, sink, output_mode: str,
+                 trigger_interval: float | None, once: bool,
+                 checkpoint_dir: str | None, name: str | None,
+                 watermark: tuple[str, float] | None):
+        self.id = str(uuid.uuid4())
+        self.name = name
+        self.session = session
+        self.plan = plan
+        self.sink = sink
+        self.output_mode = output_mode
+        self.trigger_interval = trigger_interval or 0.05
+        self.once = once
+        self.exception: Exception | None = None
+        self._active = True
+        self._stop_evt = threading.Event()
+        self.batch_id = -1
+        self.recent_progress: list[dict] = []
+        self.watermark = watermark  # (column, delay_seconds)
+        self.current_watermark_us: int | None = None
+
+        # locate the streaming source (exactly one supported)
+        leaves = [n for n in plan.iter_nodes()
+                  if isinstance(n, StreamingRelation)]
+        if len(leaves) != 1:
+            raise UnsupportedOperationError(
+                "exactly one streaming source per query is supported")
+        self.stream_leaf = leaves[0]
+        self.source: StreamSource = leaves[0].source
+
+        self.checkpoint_dir = checkpoint_dir
+        self.state = StateStore(checkpoint_dir)
+        self.committed_offset = self.source.initial_offset()
+        if checkpoint_dir:
+            os.makedirs(os.path.join(checkpoint_dir, "offsets"), exist_ok=True)
+            os.makedirs(os.path.join(checkpoint_dir, "commits"), exist_ok=True)
+            self._recover()
+
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"stream-{self.id[:8]}")
+        self._thread.start()
+
+    # --- checkpoint recovery ---------------------------------------------
+    def _recover(self) -> None:
+        cdir = os.path.join(self.checkpoint_dir, "commits")
+        committed = sorted(int(f) for f in os.listdir(cdir) if f.isdigit())
+        if not committed:
+            return
+        last = committed[-1]
+        with open(os.path.join(self.checkpoint_dir, "offsets", str(last))) as f:
+            self.committed_offset = json.load(f)["offset"]
+        self.batch_id = last
+        self.state.load(last)
+
+    # --- trigger loop ------------------------------------------------------
+    def _run(self) -> None:
+        try:
+            while not self._stop_evt.is_set():
+                progressed = self._run_one_batch()
+                if self.once:
+                    if not progressed:
+                        break
+                    continue
+                if not progressed:
+                    self._stop_evt.wait(self.trigger_interval)
+        except Exception as e:  # surfaced via .exception / awaitTermination
+            self.exception = e
+            traceback.print_exc()
+        finally:
+            self._active = False
+
+    def _run_one_batch(self) -> bool:
+        latest = self.source.latest_offset()
+        if latest == self.committed_offset:
+            return False
+        t0 = time.perf_counter()
+        batch_id = self.batch_id + 1
+        new_data = self.source.get_batch(self.committed_offset, latest)
+        if self.checkpoint_dir:
+            with open(os.path.join(self.checkpoint_dir, "offsets",
+                                   str(batch_id)), "w") as f:
+                json.dump({"offset": _json_safe(latest)}, f)
+
+        out_table = self._execute_batch(new_data, batch_id)
+        self.sink.add_batch(batch_id, out_table, self.output_mode)
+
+        if self.checkpoint_dir:
+            with open(os.path.join(self.checkpoint_dir, "commits",
+                                   str(batch_id)), "w") as f:
+                json.dump({"batch": batch_id}, f)
+        self.batch_id = batch_id
+        self.committed_offset = latest
+        self.recent_progress.append({
+            "batchId": batch_id,
+            "numInputRows": new_data.num_rows,
+            "durationMs": int((time.perf_counter() - t0) * 1000),
+        })
+        del self.recent_progress[:-32]
+        return True
+
+    # --- incremental execution --------------------------------------------
+    def _execute_batch(self, new_data: pa.Table, batch_id: int) -> pa.Table:
+        from ..api.dataframe import DataFrame
+
+        def substitute(node):
+            if isinstance(node, StreamingRelation) and node is self.stream_leaf:
+                return LocalRelation(node.attrs, new_data)
+            if isinstance(node, StreamingRelation):
+                return LocalRelation(node.attrs, new_data)
+            return node
+
+        batch_plan = self.plan.transform_up(substitute)
+        qe_probe = DataFrame(self.session, batch_plan).query_execution
+        optimized = qe_probe.optimized
+        aggs = [n for n in optimized.iter_nodes() if isinstance(n, Aggregate)]
+
+        if not aggs:
+            if self.output_mode not in ("append", "update"):
+                raise AnalysisException(
+                    "complete mode requires an aggregation")
+            return qe_probe.to_arrow()
+
+        if len(aggs) > 1:
+            raise UnsupportedOperationError(
+                "multiple streaming aggregations not supported")
+        if self.output_mode == "append":
+            raise AnalysisException(
+                "append mode on aggregated streams requires a watermark on "
+                "the grouping keys (not yet supported) — use complete/update")
+        return self._execute_stateful(optimized, aggs[0])
+
+    def _execute_stateful(self, optimized: LogicalPlan,
+                          agg: Aggregate) -> pa.Table:
+        from ..physical.operators import (
+            HashAggregateExec, LocalTableScanExec, UnionExec,
+        )
+        from ..physical.planner import Planner
+        from ..columnar.ops import concat_batches
+        from ..physical.operators import attrs_schema
+
+        session = self.session
+        planner = Planner(session.conf)
+        ctx = ExecContext(conf=session.conf, metrics=session._metrics)
+
+        # partial aggregation of new rows (device)
+        partial_plan = planner._convert(agg)  # ComputeExec(final, Final(Partial))
+        # dig out the pieces the planner built
+        finish = partial_plan                    # ComputeExec
+        final: HashAggregateExec = finish.child  # final agg
+        partial: HashAggregateExec = final.child
+
+        buffer_attrs = list(partial.output)
+        partial_ready = planner._ensure_requirements(partial)
+        new_parts = partial_ready.execute(ctx)
+        new_partial_exec = PrecomputedExec(new_parts, buffer_attrs)
+
+        # union with state scan
+        children = [new_partial_exec]
+        if self.state.table is not None and self.state.table.num_rows:
+            children.append(LocalTableScanExec(buffer_attrs, self.state.table))
+        union = UnionExec(children, buffer_attrs)
+        merged = HashAggregateExec(final.grouping, final.specs, "final", union)
+        merged_ready = planner._ensure_requirements(merged)
+        merged_parts = merged_ready.execute(ctx)
+
+        # persist new state (buffers, pre-finishing)
+        state_batches = [b for p in merged_parts for b in p]
+        state_table = pa.concat_tables(
+            [b.to_arrow() for b in state_batches],
+            promote_options="permissive") if state_batches else None
+        if state_table is not None:
+            state_table = self._evict(state_table, buffer_attrs)
+            self.state.commit(self.batch_id + 1, state_table)
+
+        # finishing projection over merged buffers
+        out_exec = finish.copy(child=PrecomputedExec(merged_parts,
+                                                     buffer_attrs))
+        out_parts = out_exec.execute(ctx)
+        out_batches = [b for p in out_parts for b in p]
+        out = pa.concat_tables([b.to_arrow() for b in out_batches],
+                               promote_options="permissive")
+
+        if self.output_mode == "update":
+            # only groups touched by this batch
+            key_names = [a.name for a in partial.grouping]
+            new_batches = [b for p in new_parts for b in p]
+            if new_batches and key_names:
+                newt = pa.concat_tables([b.to_arrow() for b in new_batches],
+                                        promote_options="permissive")
+                new_keys = set(zip(*[newt.column(k).to_pylist()
+                                     for k in key_names])) \
+                    if newt.num_rows else set()
+                cols = list(zip(*[out.column(k).to_pylist()
+                                  for k in key_names])) if out.num_rows else []
+                mask = [c in new_keys for c in cols]
+                out = out.filter(pa.array(mask)) if cols else out
+        return out
+
+    def _evict(self, state_table: pa.Table, buffer_attrs) -> pa.Table:
+        """Watermark-based state eviction when a grouping key is the
+        watermark (event-time) column."""
+        if self.watermark is None:
+            return state_table
+        col, delay_s = self.watermark
+        if col not in state_table.column_names:
+            return state_table
+        vals = state_table.column(col)
+        try:
+            import pyarrow.compute as pc
+
+            mx = pc.max(vals).as_py()
+        except Exception:
+            return state_table
+        if mx is None:
+            return state_table
+        mx_us = _to_us(mx)
+        wm = mx_us - int(delay_s * 1e6)
+        if self.current_watermark_us is not None:
+            wm = max(wm, self.current_watermark_us)
+        self.current_watermark_us = wm
+        keep = [_to_us(v) >= wm for v in vals.to_pylist()]
+        return state_table.filter(pa.array(keep))
+
+    # --- public API --------------------------------------------------------
+    @property
+    def isActive(self) -> bool:
+        return self._active
+
+    def processAllAvailable(self, timeout: float = 30.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.exception:
+                raise self.exception
+            if self.source.latest_offset() == self.committed_offset:
+                return
+            time.sleep(0.01)
+        raise TimeoutError("processAllAvailable timed out")
+
+    def awaitTermination(self, timeout: float | None = None) -> bool:
+        self._thread.join(timeout)
+        if self.exception:
+            raise self.exception
+        return not self._thread.is_alive()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        self._thread.join(timeout=10)
+        self._active = False
+
+    def lastProgress(self) -> dict | None:
+        return self.recent_progress[-1] if self.recent_progress else None
+
+
+def _json_safe(offset):
+    return offset
+
+
+def _to_us(v) -> int:
+    import datetime
+
+    if isinstance(v, datetime.datetime):
+        return int(v.timestamp() * 1e6)
+    if isinstance(v, datetime.date):
+        return int(time.mktime(v.timetuple()) * 1e6)
+    return int(v)
+
+
+# ---------------------------------------------------------------------------
+# Sinks
+# ---------------------------------------------------------------------------
+
+class MemorySink:
+    """Queryable in-memory sink (reference: memory sink for tests)."""
+
+    def __init__(self, name: str, session):
+        self.name = name
+        self.session = session
+        self.batches: list[pa.Table] = []
+        self._lock = threading.Lock()
+
+    def add_batch(self, batch_id: int, table: pa.Table, mode: str) -> None:
+        with self._lock:
+            if mode == "complete":
+                self.batches = [table]
+            else:
+                self.batches.append(table)
+            if self.batches:
+                merged = pa.concat_tables(self.batches,
+                                          promote_options="permissive")
+                df = self.session.createDataFrame(merged)
+                self.session.catalog_.register(self.name, df.plan)
+
+
+class ConsoleSink:
+    def __init__(self):
+        pass
+
+    def add_batch(self, batch_id, table, mode):
+        print(f"-------------------------------------------\n"
+              f"Batch: {batch_id}\n"
+              f"-------------------------------------------")
+        print(table.to_pandas().to_string())
+
+
+class ForeachBatchSink:
+    def __init__(self, fn: Callable, session):
+        self.fn = fn
+        self.session = session
+
+    def add_batch(self, batch_id, table, mode):
+        self.fn(self.session.createDataFrame(table), batch_id)

@@ -1,0 +1,146 @@
+"""Streaming sources.
+
+Role of the reference's streaming sources (sqlx/streaming/sources/ —
+MemoryStream, RateStreamProvider, FileStreamSource). Offsets are
+monotonically increasing JSON-serializable values; getBatch(start, end)
+returns the rows in (start, end] as an Arrow table (the micro-batch
+contract of MicroBatchExecution, sqlx/streaming/runtime/MicroBatchExecution.scala).
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+import threading
+import time
+from typing import Any, Optional
+
+import pyarrow as pa
+
+from ..columnar.arrow import schema_from_arrow
+from ..types import StructType
+
+
+class StreamSource:
+    schema: StructType
+
+    def latest_offset(self) -> Any:
+        raise NotImplementedError
+
+    def get_batch(self, start: Any, end: Any) -> pa.Table:
+        raise NotImplementedError
+
+    def initial_offset(self) -> Any:
+        return None
+
+
+class MemoryStream(StreamSource):
+    """Test source fed by addData (reference: MemoryStream — the backbone of
+    the StreamTest DSL, SURVEY.md §4)."""
+
+    def __init__(self, schema: pa.Schema | None = None):
+        self._rows: list[pa.Table] = []
+        self._lock = threading.Lock()
+        self._schema_arrow = schema
+        self.schema = schema_from_arrow(schema) if schema else None
+
+    def add_data(self, data) -> None:
+        if isinstance(data, dict):
+            table = pa.table(data)
+        elif isinstance(data, pa.Table):
+            table = data
+        else:
+            raise TypeError("add_data expects dict or pyarrow.Table")
+        with self._lock:
+            if self._schema_arrow is None:
+                self._schema_arrow = table.schema
+                self.schema = schema_from_arrow(table.schema)
+            self._rows.append(table)
+
+    addData = add_data
+
+    def latest_offset(self):
+        with self._lock:
+            return len(self._rows)
+
+    def initial_offset(self):
+        return 0
+
+    def get_batch(self, start, end) -> pa.Table:
+        with self._lock:
+            chunk = self._rows[(start or 0):end]
+        if not chunk:
+            return self._schema_arrow.empty_table()
+        return pa.concat_tables(chunk)
+
+
+class RateSource(StreamSource):
+    """rows_per_second synthetic source (reference: RateStreamProvider).
+    Columns: timestamp (us), value (int64)."""
+
+    def __init__(self, rows_per_second: int = 1):
+        self.rps = rows_per_second
+        self.t0 = time.time()
+        self.schema = schema_from_arrow(pa.schema([
+            ("timestamp", pa.timestamp("us")), ("value", pa.int64())]))
+
+    def initial_offset(self):
+        return 0
+
+    def latest_offset(self):
+        return int((time.time() - self.t0) * self.rps)
+
+    def get_batch(self, start, end) -> pa.Table:
+        start = start or 0
+        values = list(range(start, end))
+        ts = [int((self.t0 + v / self.rps) * 1e6) for v in values]
+        return pa.table({
+            "timestamp": pa.array(ts, pa.timestamp("us")),
+            "value": pa.array(values, pa.int64()),
+        })
+
+
+class FileStreamSource(StreamSource):
+    """Watches a directory; offset = sorted list of seen files
+    (reference: FileStreamSource + its seen-files log)."""
+
+    def __init__(self, path: str, fmt: str = "parquet"):
+        self.path = path
+        self.fmt = fmt
+        first = self._list_files()
+        if not first:
+            raise FileNotFoundError(
+                f"file stream needs at least one file at start: {path}")
+        self.schema = schema_from_arrow(self._read([first[0]]).schema)
+
+    def _list_files(self) -> list[str]:
+        pat = {"parquet": "*.parquet", "csv": "*.csv", "json": "*.json"}[self.fmt]
+        return sorted(_glob.glob(os.path.join(self.path, pat)))
+
+    def initial_offset(self):
+        return []
+
+    def latest_offset(self):
+        return self._list_files()
+
+    def get_batch(self, start, end) -> pa.Table:
+        seen = set(start or [])
+        new = [f for f in end if f not in seen]
+        return self._read(new)
+
+    def _read(self, files: list[str]) -> pa.Table:
+        if not files:
+            import pyarrow as pa2
+
+            return pa2.schema([]).empty_table()
+        if self.fmt == "parquet":
+            import pyarrow.parquet as pq
+
+            return pa.concat_tables([pq.read_table(f) for f in files])
+        if self.fmt == "csv":
+            import pyarrow.csv as pacsv
+
+            return pa.concat_tables([pacsv.read_csv(f) for f in files])
+        import pyarrow.json as pajson
+
+        return pa.concat_tables([pajson.read_json(f) for f in files])

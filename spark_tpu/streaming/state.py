@@ -1,0 +1,51 @@
+"""Streaming state store.
+
+Role of the reference's StateStore SPI (sqlx/streaming/state/StateStore.scala:285)
+with the HDFSBackedStateStoreProvider role played by Arrow/Parquet snapshots
+per committed batch. State for streaming aggregation is the PARTIAL
+AGGREGATION BUFFER table (grouping keys + buffer columns) — merging new
+micro-batch partials into it is the same associative final-agg kernel the
+batch engine uses, so streaming adds no new device code.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import pyarrow as pa
+
+
+class StateStore:
+    """Versioned key→buffer state with optional file persistence."""
+
+    def __init__(self, checkpoint_dir: str | None = None):
+        self.table: pa.Table | None = None
+        self.dir = None
+        if checkpoint_dir:
+            self.dir = os.path.join(checkpoint_dir, "state")
+            os.makedirs(self.dir, exist_ok=True)
+
+    def load(self, version: int) -> None:
+        if self.dir is None:
+            return
+        path = os.path.join(self.dir, f"{version}.parquet")
+        if os.path.exists(path):
+            import pyarrow.parquet as pq
+
+            self.table = pq.read_table(path)
+
+    def commit(self, version: int, table: pa.Table) -> None:
+        self.table = table
+        if self.dir is not None:
+            import pyarrow.parquet as pq
+
+            pq.write_table(table, os.path.join(self.dir, f"{version}.parquet"))
+            # retain only the last two snapshots
+            for f in os.listdir(self.dir):
+                try:
+                    v = int(f.split(".")[0])
+                except ValueError:
+                    continue
+                if v < version - 1:
+                    os.remove(os.path.join(self.dir, f))
