@@ -1,0 +1,14 @@
+from .base import Pipeline, PipelineModel, Estimator, Transformer, Model  # noqa: F401
+from .features import (  # noqa: F401
+    VectorAssembler, StandardScaler, MinMaxScaler, StringIndexer, Binarizer,
+)
+from .regression import LinearRegression  # noqa: F401
+from .classification import LogisticRegression, NaiveBayes  # noqa: F401
+from .clustering import KMeans  # noqa: F401
+from .evaluation import (  # noqa: F401
+    RegressionEvaluator, BinaryClassificationEvaluator,
+    MulticlassClassificationEvaluator,
+)
+from .tuning import (  # noqa: F401
+    ParamGridBuilder, CrossValidator, TrainValidationSplit,
+)

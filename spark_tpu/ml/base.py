@@ -1,0 +1,145 @@
+"""ML pipeline abstractions.
+
+Role of the reference's ml API (mllib/.../ml/Pipeline.scala, Estimator.scala,
+Transformer.scala, param/params.scala). The compute design is TPU-first:
+estimators pull feature columns into device matrices and train with jitted
+full-batch gradient steps (the MXU matmul path) instead of the reference's
+breeze/netlib row-iterator optimizers.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+from typing import Any, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+
+class Params:
+    """Typed param map: subclasses declare defaults as class attrs in
+    `_params`."""
+
+    _params: dict[str, Any] = {}
+
+    def __init__(self, **kwargs):
+        self._values = dict(type(self)._params)
+        for k, v in kwargs.items():
+            self._set(k, v)
+
+    def _set(self, k: str, v: Any):
+        if k not in self._values:
+            raise ValueError(
+                f"{type(self).__name__} has no param {k!r}; "
+                f"has {sorted(self._values)}")
+        self._values[k] = v
+        return self
+
+    def getOrDefault(self, k: str):
+        return self._values[k]
+
+    def __getattr__(self, k):
+        values = object.__getattribute__(self, "__dict__").get("_values")
+        if values is not None and k in values:
+            return values[k]
+        raise AttributeError(k)
+
+    def copy(self, extra: dict | None = None):
+        c = copy.deepcopy(self)
+        for k, v in (extra or {}).items():
+            c._set(k, v)
+        return c
+
+    def set(self, **kwargs):
+        for k, v in kwargs.items():
+            self._set(k, v)
+        return self
+
+
+class Transformer(Params):
+    def transform(self, df):
+        raise NotImplementedError
+
+
+class Estimator(Params):
+    def fit(self, df) -> Transformer:
+        raise NotImplementedError
+
+
+class Model(Transformer):
+    pass
+
+
+class Pipeline(Estimator):
+    _params = {"stages": ()}
+
+    def fit(self, df) -> "PipelineModel":
+        fitted = []
+        cur = df
+        stages = list(self.getOrDefault("stages"))
+        for i, stage in enumerate(stages):
+            if isinstance(stage, Estimator):
+                model = stage.fit(cur)
+                fitted.append(model)
+                if i < len(stages) - 1:
+                    cur = model.transform(cur)
+            else:
+                fitted.append(stage)
+                if i < len(stages) - 1:
+                    cur = stage.transform(cur)
+        return PipelineModel(stages=tuple(fitted))
+
+
+class PipelineModel(Model):
+    _params = {"stages": ()}
+
+    def transform(self, df):
+        cur = df
+        for stage in self.getOrDefault("stages"):
+            cur = stage.transform(cur)
+        return cur
+
+
+# ---------------------------------------------------------------------------
+# feature-matrix plumbing
+# ---------------------------------------------------------------------------
+
+def resolve_feature_cols(df, features_col: str) -> list[str]:
+    """A 'features vector' column is represented as recorded assembler
+    metadata (TPU-first: features live as a [n, d] device matrix, not
+    per-row vector objects — see VectorAssembler)."""
+    meta = getattr(df, "_ml_features", None)
+    if meta and features_col in meta:
+        return meta[features_col]
+    if features_col in df.columns:
+        return [features_col]
+    raise ValueError(
+        f"features column {features_col!r} not found; run VectorAssembler "
+        "or name real columns")
+
+
+def extract_matrix(df, cols: Sequence[str]) -> np.ndarray:
+    table = df.select(*cols).toArrow()
+    mats = [np.asarray(table.column(c).to_numpy(zero_copy_only=False),
+                       dtype=np.float64) for c in table.column_names]
+    return np.stack(mats, axis=1)
+
+
+def extract_vector(df, col: str) -> np.ndarray:
+    table = df.select(col).toArrow()
+    return np.asarray(table.column(0).to_numpy(zero_copy_only=False),
+                      dtype=np.float64)
+
+
+def with_host_column(df, name: str, values: np.ndarray):
+    """Append a host-computed column (prediction outputs)."""
+    table = df.toArrow()
+    arr = pa.array(values)
+    if name in table.column_names:
+        table = table.drop_columns([name])
+    table = table.append_column(name, arr)
+    out = df.session.createDataFrame(table)
+    out._ml_features = getattr(df, "_ml_features", None)
+    return out

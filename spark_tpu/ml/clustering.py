@@ -1,0 +1,65 @@
+"""Clustering (reference: ml/clustering/KMeans.scala)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import (
+    Estimator, Model, extract_matrix, resolve_feature_cols, with_host_column,
+)
+
+
+class KMeans(Estimator):
+    """Lloyd's iterations as one jitted lax.scan — assignment is a [n, k]
+    distance matmul (MXU), update is segment_sum."""
+
+    _params = {"featuresCol": "features", "predictionCol": "prediction",
+               "k": 2, "maxIter": 20, "seed": 42}
+
+    def fit(self, df) -> "KMeansModel":
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        cols = resolve_feature_cols(df, self.getOrDefault("featuresCol"))
+        X = extract_matrix(df, cols)
+        k = int(self.getOrDefault("k"))
+        rng = np.random.default_rng(self.getOrDefault("seed"))
+        init = X[rng.choice(len(X), size=k, replace=False)]
+
+        Xd = jnp.asarray(X)
+
+        @jax.jit
+        def run(c0):
+            def step(c, _):
+                d2 = ((Xd[:, None, :] - c[None]) ** 2).sum(-1)
+                assign = jnp.argmin(d2, axis=1)
+                sums = jax.ops.segment_sum(Xd, assign, num_segments=k)
+                cnts = jax.ops.segment_sum(jnp.ones(Xd.shape[0]), assign,
+                                           num_segments=k)
+                newc = jnp.where(cnts[:, None] > 0,
+                                 sums / jnp.maximum(cnts[:, None], 1), c)
+                return newc, None
+
+            c, _ = lax.scan(step, c0, None,
+                            length=int(self.getOrDefault("maxIter")))
+            return c
+
+        centers = np.asarray(run(jnp.asarray(init)))
+        m = KMeansModel(featuresCol=self.getOrDefault("featuresCol"),
+                        predictionCol=self.getOrDefault("predictionCol"),
+                        k=k)
+        m.cols = cols
+        m.clusterCenters = centers
+        return m
+
+
+class KMeansModel(Model):
+    _params = {"featuresCol": "features", "predictionCol": "prediction",
+               "k": 2}
+
+    def transform(self, df):
+        X = extract_matrix(df, self.cols)
+        d2 = ((X[:, None, :] - self.clusterCenters[None]) ** 2).sum(-1)
+        pred = np.argmin(d2, axis=1).astype(np.float64)
+        return with_host_column(df, self.getOrDefault("predictionCol"), pred)

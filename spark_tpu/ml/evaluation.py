@@ -1,0 +1,77 @@
+"""Evaluators (reference: ml/evaluation/*)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import Params, extract_vector
+
+
+class RegressionEvaluator(Params):
+    _params = {"labelCol": "label", "predictionCol": "prediction",
+               "metricName": "rmse"}
+
+    def evaluate(self, df) -> float:
+        y = extract_vector(df, self.getOrDefault("labelCol"))
+        p = extract_vector(df, self.getOrDefault("predictionCol"))
+        m = self.getOrDefault("metricName")
+        if m == "rmse":
+            return float(np.sqrt(np.mean((y - p) ** 2)))
+        if m == "mse":
+            return float(np.mean((y - p) ** 2))
+        if m == "mae":
+            return float(np.mean(np.abs(y - p)))
+        if m == "r2":
+            ss_res = np.sum((y - p) ** 2)
+            ss_tot = np.sum((y - y.mean()) ** 2)
+            return float(1 - ss_res / ss_tot) if ss_tot else 0.0
+        raise ValueError(m)
+
+
+class BinaryClassificationEvaluator(Params):
+    _params = {"labelCol": "label", "rawPredictionCol": "probability",
+               "metricName": "areaUnderROC"}
+
+    def evaluate(self, df) -> float:
+        y = extract_vector(df, self.getOrDefault("labelCol"))
+        s = extract_vector(df, self.getOrDefault("rawPredictionCol"))
+        order = np.argsort(-s, kind="stable")
+        y = y[order]
+        pos = y.sum()
+        neg = len(y) - pos
+        if pos == 0 or neg == 0:
+            return 0.5
+        # AUC via rank statistic
+        ranks = np.empty(len(s))
+        ranks[np.argsort(-s, kind="stable")] = np.arange(1, len(s) + 1)
+        pos_rank_sum = ranks[extract_vector(
+            df, self.getOrDefault("labelCol")) == 1].sum()
+        auc = (len(s) * pos + pos * (pos + 1) / 2 - pos_rank_sum) / (pos * neg)
+        return float(auc)
+
+
+class MulticlassClassificationEvaluator(Params):
+    _params = {"labelCol": "label", "predictionCol": "prediction",
+               "metricName": "accuracy"}
+
+    def evaluate(self, df) -> float:
+        y = extract_vector(df, self.getOrDefault("labelCol"))
+        p = extract_vector(df, self.getOrDefault("predictionCol"))
+        m = self.getOrDefault("metricName")
+        if m == "accuracy":
+            return float(np.mean(y == p))
+        if m == "f1":
+            classes = np.unique(np.concatenate([y, p]))
+            f1s = []
+            weights = []
+            for c in classes:
+                tp = np.sum((p == c) & (y == c))
+                fp = np.sum((p == c) & (y != c))
+                fn = np.sum((p != c) & (y == c))
+                prec = tp / (tp + fp) if tp + fp else 0.0
+                rec = tp / (tp + fn) if tp + fn else 0.0
+                f1s.append(2 * prec * rec / (prec + rec)
+                           if prec + rec else 0.0)
+                weights.append(np.sum(y == c))
+            return float(np.average(f1s, weights=weights))
+        raise ValueError(m)

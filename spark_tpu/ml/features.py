@@ -1,0 +1,152 @@
+"""Feature transformers (reference: mllib ml/feature/*)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import (
+    Estimator, Model, Transformer, extract_matrix, resolve_feature_cols,
+    with_host_column,
+)
+
+
+class VectorAssembler(Transformer):
+    """Records which columns make the [n, d] feature matrix
+    (reference: ml/feature/VectorAssembler.scala; see base.py on the
+    matrix-not-vector-objects design)."""
+
+    _params = {"inputCols": (), "outputCol": "features"}
+
+    def transform(self, df):
+        meta = dict(getattr(df, "_ml_features", None) or {})
+        meta[self.getOrDefault("outputCol")] = list(
+            self.getOrDefault("inputCols"))
+        out = df._with(df.plan)
+        out._ml_features = meta
+        return out
+
+
+class StandardScaler(Estimator):
+    _params = {"inputCol": "features", "outputCol": "scaled",
+               "withMean": True, "withStd": True}
+
+    def fit(self, df) -> "StandardScalerModel":
+        cols = resolve_feature_cols(df, self.getOrDefault("inputCol"))
+        X = extract_matrix(df, cols)
+        mean = X.mean(axis=0)
+        std = X.std(axis=0)
+        std[std == 0] = 1.0
+        return StandardScalerModel(
+            inputCol=self.getOrDefault("inputCol"),
+            outputCol=self.getOrDefault("outputCol"),
+            withMean=self.getOrDefault("withMean"),
+            withStd=self.getOrDefault("withStd"),
+        )._with_stats(cols, mean, std)
+
+
+class StandardScalerModel(Model):
+    _params = {"inputCol": "features", "outputCol": "scaled",
+               "withMean": True, "withStd": True}
+
+    def _with_stats(self, cols, mean, std):
+        self.cols = cols
+        self.mean = mean
+        self.std = std
+        return self
+
+    def transform(self, df):
+        import spark_tpu.api.functions as F
+
+        out = df
+        new_cols = []
+        for i, c in enumerate(self.cols):
+            name = f"{self.getOrDefault('outputCol')}_{c}"
+            expr = F.col(c)
+            if self.getOrDefault("withMean"):
+                expr = expr - float(self.mean[i])
+            if self.getOrDefault("withStd"):
+                expr = expr / float(self.std[i])
+            out = out.withColumn(name, expr)
+            new_cols.append(name)
+        meta = dict(getattr(df, "_ml_features", None) or {})
+        meta[self.getOrDefault("outputCol")] = new_cols
+        out._ml_features = meta
+        return out
+
+
+class MinMaxScaler(Estimator):
+    _params = {"inputCol": "features", "outputCol": "scaled"}
+
+    def fit(self, df):
+        cols = resolve_feature_cols(df, self.getOrDefault("inputCol"))
+        X = extract_matrix(df, cols)
+        mn, mx = X.min(axis=0), X.max(axis=0)
+        rng = mx - mn
+        rng[rng == 0] = 1.0
+        m = MinMaxScalerModel(inputCol=self.getOrDefault("inputCol"),
+                              outputCol=self.getOrDefault("outputCol"))
+        m.cols, m.mn, m.rng = cols, mn, rng
+        return m
+
+
+class MinMaxScalerModel(Model):
+    _params = {"inputCol": "features", "outputCol": "scaled"}
+
+    def transform(self, df):
+        import spark_tpu.api.functions as F
+
+        out = df
+        new_cols = []
+        for i, c in enumerate(self.cols):
+            name = f"{self.getOrDefault('outputCol')}_{c}"
+            out = out.withColumn(
+                name, (F.col(c) - float(self.mn[i])) / float(self.rng[i]))
+            new_cols.append(name)
+        meta = dict(getattr(df, "_ml_features", None) or {})
+        meta[self.getOrDefault("outputCol")] = new_cols
+        out._ml_features = meta
+        return out
+
+
+class StringIndexer(Estimator):
+    """Label encoding by descending frequency
+    (reference: ml/feature/StringIndexer.scala)."""
+
+    _params = {"inputCol": None, "outputCol": None}
+
+    def fit(self, df):
+        import spark_tpu.api.functions as F
+
+        col = self.getOrDefault("inputCol")
+        counts = (df.groupBy(col).agg(F.count("*").alias("c"))
+                  .orderBy(F.col("c").desc(), F.col(col))
+                  .toArrow().to_pydict())
+        labels = [v for v in counts[col]]
+        m = StringIndexerModel(inputCol=col,
+                               outputCol=self.getOrDefault("outputCol"))
+        m.labels = labels
+        return m
+
+
+class StringIndexerModel(Model):
+    _params = {"inputCol": None, "outputCol": None}
+
+    def transform(self, df):
+        mapping = {v: float(i) for i, v in enumerate(self.labels)}
+        vals = df.select(self.getOrDefault("inputCol")).toArrow() \
+            .column(0).to_pylist()
+        idx = np.array([mapping.get(v, -1.0) for v in vals])
+        return with_host_column(df, self.getOrDefault("outputCol"), idx)
+
+
+class Binarizer(Transformer):
+    _params = {"inputCol": None, "outputCol": None, "threshold": 0.0}
+
+    def transform(self, df):
+        import spark_tpu.api.functions as F
+
+        t = self.getOrDefault("threshold")
+        return df.withColumn(
+            self.getOrDefault("outputCol"),
+            F.when(F.col(self.getOrDefault("inputCol")) > t, 1.0)
+            .otherwise(0.0))

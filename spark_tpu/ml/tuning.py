@@ -1,0 +1,102 @@
+"""Hyper-parameter tuning (reference: ml/tuning/CrossValidator.scala,
+ParamGridBuilder)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import Estimator, Model
+
+
+class ParamGridBuilder:
+    def __init__(self):
+        self._grid: dict[str, list] = {}
+
+    def addGrid(self, param: str, values) -> "ParamGridBuilder":
+        self._grid[param] = list(values)
+        return self
+
+    def build(self) -> list[dict]:
+        import itertools
+
+        keys = list(self._grid)
+        combos = itertools.product(*[self._grid[k] for k in keys])
+        return [dict(zip(keys, c)) for c in combos]
+
+
+class CrossValidator(Estimator):
+    _params = {"estimator": None, "estimatorParamMaps": (),
+               "evaluator": None, "numFolds": 3, "seed": 42}
+
+    def fit(self, df) -> "CrossValidatorModel":
+        est = self.getOrDefault("estimator")
+        grid = list(self.getOrDefault("estimatorParamMaps")) or [{}]
+        ev = self.getOrDefault("evaluator")
+        k = int(self.getOrDefault("numFolds"))
+
+        table = df.toArrow()
+        n = table.num_rows
+        rng = np.random.default_rng(self.getOrDefault("seed"))
+        fold = rng.integers(0, k, n)
+
+        session = df.session
+        avg_metrics = []
+        for params in grid:
+            scores = []
+            for f in range(k):
+                train = session.createDataFrame(
+                    table.filter(__import__("pyarrow").array(fold != f)))
+                test = session.createDataFrame(
+                    table.filter(__import__("pyarrow").array(fold == f)))
+                train._ml_features = getattr(df, "_ml_features", None)
+                test._ml_features = getattr(df, "_ml_features", None)
+                model = est.copy(params).fit(train)
+                scores.append(ev.evaluate(model.transform(test)))
+            avg_metrics.append(float(np.mean(scores)))
+
+        higher_better = ev.getOrDefault("metricName") not in (
+            "rmse", "mse", "mae")
+        best_i = int(np.argmax(avg_metrics) if higher_better
+                     else np.argmin(avg_metrics))
+        best_model = est.copy(grid[best_i]).fit(df)
+        out = CrossValidatorModel()
+        out.bestModel = best_model
+        out.avgMetrics = avg_metrics
+        return out
+
+
+class CrossValidatorModel(Model):
+    _params = {}
+
+    def transform(self, df):
+        return self.bestModel.transform(df)
+
+
+class TrainValidationSplit(Estimator):
+    _params = {"estimator": None, "estimatorParamMaps": (),
+               "evaluator": None, "trainRatio": 0.75, "seed": 42}
+
+    def fit(self, df):
+        import pyarrow as pa
+
+        est = self.getOrDefault("estimator")
+        grid = list(self.getOrDefault("estimatorParamMaps")) or [{}]
+        ev = self.getOrDefault("evaluator")
+        table = df.toArrow()
+        rng = np.random.default_rng(self.getOrDefault("seed"))
+        is_train = rng.random(table.num_rows) < self.getOrDefault("trainRatio")
+        session = df.session
+        train = session.createDataFrame(table.filter(pa.array(is_train)))
+        test = session.createDataFrame(table.filter(pa.array(~is_train)))
+        train._ml_features = getattr(df, "_ml_features", None)
+        test._ml_features = getattr(df, "_ml_features", None)
+        scores = [ev.evaluate(est.copy(p).fit(train).transform(test))
+                  for p in grid]
+        higher_better = ev.getOrDefault("metricName") not in (
+            "rmse", "mse", "mae")
+        best_i = int(np.argmax(scores) if higher_better
+                     else np.argmin(scores))
+        out = CrossValidatorModel()
+        out.bestModel = est.copy(grid[best_i]).fit(df)
+        out.avgMetrics = scores
+        return out

@@ -1,0 +1,127 @@
+"""ML pipeline tests (reference: mllib test suites; sklearn-style oracles)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_tpu.ml import (
+    BinaryClassificationEvaluator, CrossValidator, KMeans, LinearRegression,
+    LogisticRegression, MulticlassClassificationEvaluator, NaiveBayes,
+    ParamGridBuilder, Pipeline, RegressionEvaluator, StandardScaler,
+    StringIndexer, VectorAssembler,
+)
+
+
+@pytest.fixture()
+def regression_df(spark):
+    rng = np.random.default_rng(0)
+    n = 500
+    x1 = rng.normal(0, 1, n)
+    x2 = rng.normal(0, 1, n)
+    y = 3.0 * x1 - 2.0 * x2 + 0.5 + rng.normal(0, 0.01, n)
+    df = spark.createDataFrame(pa.table({"x1": x1, "x2": x2, "label": y}))
+    return VectorAssembler(inputCols=["x1", "x2"]).transform(df)
+
+
+def test_linear_regression_normal(regression_df):
+    model = LinearRegression().fit(regression_df)
+    assert abs(model.coefficients[0] - 3.0) < 0.01
+    assert abs(model.coefficients[1] + 2.0) < 0.01
+    assert abs(model.intercept - 0.5) < 0.01
+    pred = model.transform(regression_df)
+    rmse = RegressionEvaluator().evaluate(pred)
+    assert rmse < 0.02
+
+
+def test_linear_regression_gd(regression_df):
+    model = LinearRegression(solver="gd", maxIter=2000).fit(regression_df)
+    assert abs(model.coefficients[0] - 3.0) < 0.1
+
+
+def test_logistic_regression(spark):
+    rng = np.random.default_rng(1)
+    n = 600
+    x1 = rng.normal(0, 1, n)
+    x2 = rng.normal(0, 1, n)
+    label = (2 * x1 - x2 > 0).astype(np.float64)
+    df = VectorAssembler(inputCols=["x1", "x2"]).transform(
+        spark.createDataFrame(pa.table({"x1": x1, "x2": x2, "label": label})))
+    model = LogisticRegression(maxIter=500).fit(df)
+    pred = model.transform(df)
+    acc = MulticlassClassificationEvaluator().evaluate(pred)
+    assert acc > 0.95
+    auc = BinaryClassificationEvaluator().evaluate(pred)
+    assert auc > 0.98
+
+
+def test_kmeans(spark):
+    rng = np.random.default_rng(2)
+    a = rng.normal((0, 0), 0.2, (100, 2))
+    b = rng.normal((5, 5), 0.2, (100, 2))
+    X = np.concatenate([a, b])
+    df = VectorAssembler(inputCols=["x", "y"]).transform(
+        spark.createDataFrame(pa.table({"x": X[:, 0], "y": X[:, 1]})))
+    model = KMeans(k=2).fit(df)
+    centers = sorted(model.clusterCenters.tolist())
+    assert abs(centers[0][0] - 0) < 0.5
+    assert abs(centers[1][0] - 5) < 0.5
+    pred = model.transform(df).toArrow().to_pydict()["prediction"]
+    assert len(set(pred[:100])) == 1 and len(set(pred[100:])) == 1
+
+
+def test_naive_bayes(spark):
+    rng = np.random.default_rng(3)
+    a = rng.normal(0, 1, (200, 2))
+    b = rng.normal(4, 1, (200, 2))
+    X = np.concatenate([a, b])
+    y = np.array([0.0] * 200 + [1.0] * 200)
+    df = VectorAssembler(inputCols=["f1", "f2"]).transform(
+        spark.createDataFrame(pa.table(
+            {"f1": X[:, 0], "f2": X[:, 1], "label": y})))
+    model = NaiveBayes().fit(df)
+    acc = MulticlassClassificationEvaluator().evaluate(model.transform(df))
+    assert acc > 0.95
+
+
+def test_pipeline_with_scaler(spark):
+    rng = np.random.default_rng(4)
+    n = 300
+    x1 = rng.normal(100, 50, n)  # badly scaled
+    y = (x1 > 100).astype(np.float64)
+    df = spark.createDataFrame(pa.table({"x1": x1, "label": y}))
+    pipe = Pipeline(stages=(
+        VectorAssembler(inputCols=["x1"], outputCol="raw"),
+        StandardScaler(inputCol="raw", outputCol="features"),
+        LogisticRegression(maxIter=300),
+    ))
+    model = pipe.fit(df)
+    pred = model.transform(df)
+    acc = MulticlassClassificationEvaluator().evaluate(pred)
+    assert acc > 0.97
+
+
+def test_string_indexer(spark):
+    df = spark.createDataFrame(pa.table(
+        {"cat": ["b", "a", "b", "c", "b", "a"]}))
+    model = StringIndexer(inputCol="cat", outputCol="idx").fit(df)
+    assert model.labels[0] == "b"  # most frequent first
+    out = model.transform(df).toArrow().to_pydict()
+    assert out["idx"][0] == 0.0
+
+
+def test_cross_validator(spark):
+    rng = np.random.default_rng(5)
+    n = 200
+    x = rng.normal(0, 1, n)
+    y = (x > 0).astype(np.float64)
+    df = VectorAssembler(inputCols=["x"]).transform(
+        spark.createDataFrame(pa.table({"x": x, "label": y})))
+    cv = CrossValidator(
+        estimator=LogisticRegression(maxIter=100),
+        estimatorParamMaps=ParamGridBuilder()
+        .addGrid("regParam", [0.0, 10.0]).build(),
+        evaluator=MulticlassClassificationEvaluator(),
+        numFolds=3)
+    model = cv.fit(df)
+    assert len(model.avgMetrics) == 2
+    assert model.avgMetrics[0] > model.avgMetrics[1]  # heavy reg is worse
