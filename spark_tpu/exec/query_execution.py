@@ -20,6 +20,16 @@ from ..plan.logical import LogicalPlan
 from ..physical.operators import PhysicalPlan, attrs_schema
 
 
+def _unconvert(value, dt):
+    """arrow python value → Literal-compatible value."""
+    import datetime
+    import decimal
+
+    if isinstance(value, decimal.Decimal):
+        return value
+    return value
+
+
 class QueryExecution:
     def __init__(self, session, logical: LogicalPlan):
         self.session = session
@@ -40,8 +50,41 @@ class QueryExecution:
     @cached_property
     def optimized(self) -> LogicalPlan:
         analyzed = self.analyzed
-        return self._timed("optimization",
-                           lambda: self.session._optimizer.execute(analyzed))
+        out = self._timed("optimization",
+                          lambda: self.session._optimizer.execute(analyzed))
+        return self._materialize_scalar_subqueries(out)
+
+    def _materialize_scalar_subqueries(self, plan: LogicalPlan) -> LogicalPlan:
+        """Execute remaining (uncorrelated) scalar subqueries once and
+        substitute literals (role of the reference's SubqueryExec
+        materialization before the main query runs)."""
+        from ..plan.subquery import ScalarSubquery
+        from ..expr.expressions import Literal
+
+        has = any(isinstance(x, ScalarSubquery)
+                  for n in plan.iter_nodes()
+                  for e in n.expressions()
+                  for x in e.iter_nodes())
+        if not has:
+            return plan
+
+        def fix_expr(e):
+            if isinstance(e, ScalarSubquery):
+                sub_qe = QueryExecution(self.session, e.plan)
+                table = sub_qe.to_arrow()
+                if table.num_rows > 1:
+                    raise RuntimeError(
+                        "scalar subquery returned more than one row")
+                value = table.column(0)[0].as_py() if table.num_rows else None
+                dt = e.dtype
+                return Literal(_unconvert(value, dt), dt) \
+                    if value is not None else Literal(None, dt)
+            return e
+
+        def rule(node):
+            return node.transform_expressions(fix_expr)
+
+        return plan.transform_up(rule)
 
     @cached_property
     def physical(self) -> PhysicalPlan:
@@ -70,6 +113,10 @@ class QueryExecution:
             raise RuntimeError(
                 f"result has {out.num_rows} rows > spark.tpu.collect.maxRows")
         return out
+
+    @staticmethod
+    def _noop():
+        pass
 
     def explain_string(self, mode: str = "formatted") -> str:
         parts = [

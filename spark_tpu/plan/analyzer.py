@@ -376,6 +376,60 @@ def _replace_agg(p: LogicalPlan, new_agg: Aggregate) -> LogicalPlan:
     return new_agg
 
 
+class _ResolveRelationsDedup(Rule):
+    """ResolveRelations for subquery scopes: re-instances attributes that
+    collide with the outer scope's ids."""
+
+    def __init__(self, catalog: Catalog, outer_ids: set[int]):
+        self.catalog = catalog
+        self.outer_ids = set(outer_ids)
+
+    def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        def rule(node):
+            if isinstance(node, UnresolvedRelation):
+                resolved = self.catalog.lookup(node.name_parts)
+                overlap = {a.expr_id for a in resolved.output} & self.outer_ids
+                if overlap:
+                    mapping: dict[int, AttributeReference] = {}
+                    resolved = _remap_plan(resolved, mapping, overlap)
+                return SubqueryAlias(node.name_parts[-1], resolved)
+            return node
+
+        return plan.transform_up(rule)
+
+
+class ResolveSubqueries(Rule):
+    """Resolve subquery plans, allowing leftover references to resolve
+    against the OUTER scope (correlation; reference: Analyzer
+    ResolveSubquery + outer reference wrapping)."""
+
+    def __init__(self, analyzer: "Analyzer"):
+        self.analyzer = analyzer
+
+    def apply(self, plan):
+        from .subquery import SubqueryExpression
+
+        an = self.analyzer
+
+        def rule(node):
+            if not all(c.resolved for c in node.children):
+                return node
+            try:
+                outer = node.input_attrs()
+            except AnalysisException:
+                return node
+
+            def fix(e):
+                if isinstance(e, SubqueryExpression) and not e.plan.resolved:
+                    sub = an.execute_subquery(e.plan, outer)
+                    return e.copy(plan=sub)
+                return e
+
+            return node.transform_expressions(fix)
+
+        return plan.transform_up(rule)
+
+
 class ExtractWindowExpressions(Rule):
     """Pull WindowExpressions out of projections into Window operators
     (reference: Analyzer ExtractWindowExpressions). Expressions sharing a
@@ -510,9 +564,18 @@ class CoerceDecimalArithmetic(Rule):
 
 class CheckAnalysis(Rule):
     def apply(self, plan):
+        from .subquery import ScalarSubquery, SubqueryExpression
+
         def check(node):
             for e in node.expressions():
                 for sub in e.iter_nodes():
+                    if isinstance(sub, SubqueryExpression):
+                        if isinstance(sub, ScalarSubquery) and \
+                                len(sub.plan.output) != 1:
+                            raise AnalysisException(
+                                "scalar subquery must return one column")
+                        self.apply(sub.plan)
+                        continue
                     if isinstance(sub, UnresolvedAttribute):
                         cands = [a.name for a in node.input_attrs()]
                         close = difflib.get_close_matches(sub.name, cands, 3)
@@ -575,6 +638,7 @@ class Analyzer(RuleExecutor):
                 ResolveRelations(self.catalog),
                 DeduplicateRelations(),
                 ResolveReferences(cs),
+                ResolveSubqueries(self),
                 ResolveAggsInSortHaving(cs),
                 ResolveSortHiddenRefs(cs),
                 ExtractWindowExpressions(),
@@ -585,3 +649,57 @@ class Analyzer(RuleExecutor):
             ]),
             Batch("Check", Once(), [CheckAnalysis()]),
         ]
+
+    def execute_subquery(self, plan: LogicalPlan,
+                         outer: Sequence[AttributeReference]) -> LogicalPlan:
+        """Resolve a subquery plan; unresolved column references fall back to
+        the outer scope (correlated references). Relations resolved inside
+        the subquery get FRESH attribute ids when they collide with the
+        outer scope (same-table self-reference; the reference handles this
+        via DeduplicateRelations over the whole tree)."""
+        cs = self.case_sensitive
+        outer_ids = {a.expr_id for a in outer}
+        resolution = Batch("Resolution", FixedPoint(50), [
+            _ResolveRelationsDedup(self.catalog, outer_ids),
+            DeduplicateRelations(),
+            ResolveReferences(cs),
+            ResolveSubqueries(self),
+            ResolveAggsInSortHaving(cs),
+            ResolveSortHiddenRefs(cs),
+            ExtractWindowExpressions(),
+            ResolveAliases(),
+        ])
+        cur = plan
+        for _ in range(50):
+            before = cur
+            for rule in resolution.rules:
+                cur = rule(cur)
+
+            # resolve leftovers: INNER scope first (SQL shadowing), then the
+            # outer scope (correlation)
+            def node_fix(n):
+                if not all(c.resolved for c in n.children):
+                    return n
+                try:
+                    inputs = n.input_attrs()
+                except AnalysisException:
+                    return n
+
+                def fix(e):
+                    if isinstance(e, UnresolvedAttribute):
+                        a = _resolve_name(e.name_parts, inputs, cs)
+                        if a is not None:
+                            return a
+                        a = _resolve_name(e.name_parts, outer, cs)
+                        if a is not None:
+                            return a
+                    return e
+
+                return n.transform_expressions(
+                    lambda ex: ex.transform_up(fix))
+
+            cur = cur.transform_up(node_fix)
+            if cur.fast_equals(before):
+                break
+        cur = CoerceDecimalArithmetic()(cur)
+        return cur

@@ -652,6 +652,18 @@ def _empty_table(attrs):
         if attrs else {"__dummy": pa.array([], pa.int32())})
 
 
+def _rewrite_predicate_subquery():
+    from .subquery import RewritePredicateSubquery
+
+    return RewritePredicateSubquery()
+
+
+def _rewrite_correlated_scalar():
+    from .subquery import RewriteCorrelatedScalarSubquery
+
+    return RewriteCorrelatedScalarSubquery()
+
+
 class Optimizer(RuleExecutor):
     def __init__(self):
         super().__init__()
@@ -662,6 +674,10 @@ class Optimizer(RuleExecutor):
                 EliminateSubqueryAliases(),
                 ReplaceDistinct(),
                 RewriteDistinctAggregates(),
+            ]),
+            Batch("Subqueries", FixedPoint(10), [
+                _rewrite_predicate_subquery(),
+                _rewrite_correlated_scalar(),
             ]),
             Batch("Operator optimization", FixedPoint(100), [
                 CombineFilters(),

@@ -1,0 +1,316 @@
+"""Subquery expressions and decorrelation.
+
+Role of the reference's subquery machinery — expressions
+(sqlcat/expressions/subquery.scala: ScalarSubquery, ListQuery/InSubquery,
+Exists) and the optimizer rewrites (sqlcat/optimizer/subquery.scala:
+RewritePredicateSubquery → semi/anti joins; decorrelation of equality
+predicates). Uncorrelated scalar subqueries evaluate once at execution and
+substitute as literals (the reference materializes them via
+SubqueryExec/ScalarSubquery reuse).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..errors import AnalysisException, UnsupportedOperationError
+from ..expr.expressions import (
+    Alias, And, AttributeReference, EqualTo, Expression, Literal, Not,
+)
+from .logical import Aggregate, Filter, Join, LogicalPlan, Project
+from .tree import Rule
+
+__all__ = ["ScalarSubquery", "InSubquery", "Exists",
+           "RewritePredicateSubquery", "split_correlation"]
+
+
+class SubqueryExpression(Expression):
+    child_fields = ()
+
+    def __init__(self, plan: LogicalPlan):
+        self.plan = plan
+
+    @property
+    def resolved(self):
+        # plan resolution happens in the analyzer rule ResolveSubqueries
+        return self.plan.resolved
+
+    def _data_args(self):
+        return (("plan_id", id(self.plan)),)
+
+
+class ScalarSubquery(SubqueryExpression):
+    """(SELECT single_value ...) used as an expression."""
+
+    @property
+    def dtype(self):
+        return self.plan.output[0].dtype
+
+    @property
+    def nullable(self):
+        return True
+
+    def simple_string(self):
+        return "scalar-subquery(...)"
+
+
+class InSubquery(SubqueryExpression):
+    """x IN (SELECT col ...)"""
+
+    def __init__(self, value: Expression, plan: LogicalPlan):
+        self.value = value
+        self.plan = plan
+
+    child_fields = ("value",)
+
+    @property
+    def dtype(self):
+        from ..types import boolean
+
+        return boolean
+
+    def simple_string(self):
+        return f"{self.value.simple_string()} IN (subquery)"
+
+
+class Exists(SubqueryExpression):
+    @property
+    def dtype(self):
+        from ..types import boolean
+
+        return boolean
+
+    @property
+    def nullable(self):
+        return False
+
+    def simple_string(self):
+        return "EXISTS(subquery)"
+
+
+# ---------------------------------------------------------------------------
+# Correlation analysis
+# ---------------------------------------------------------------------------
+
+def split_correlation(subplan: LogicalPlan, outer_ids: set[int]):
+    """Pull equality predicates referencing outer attributes out of the
+    subquery (the reference's pullOutCorrelatedPredicates). Returns
+    (decorrelated_plan, [(outer_expr, inner_attr)], ok). Only
+    `outer_attr = inner_expr` conjuncts under Filter nodes are supported."""
+    from .optimizer import join_conjuncts, split_conjuncts
+
+    pairs: list[tuple[Expression, Expression]] = []
+    failed = [False]
+
+    def rule(node):
+        if isinstance(node, Filter):
+            keep = []
+            for c in split_conjuncts(node.condition):
+                refs = c.references()
+                outer_refs = refs & outer_ids
+                if not outer_refs:
+                    keep.append(c)
+                    continue
+                if isinstance(c, EqualTo):
+                    lr = c.left.references()
+                    rr = c.right.references()
+                    if lr <= outer_ids and not (rr & outer_ids):
+                        pairs.append((c.left, c.right))
+                        continue
+                    if rr <= outer_ids and not (lr & outer_ids):
+                        pairs.append((c.right, c.left))
+                        continue
+                failed[0] = True
+                keep.append(c)
+            cond = join_conjuncts(keep)
+            if cond is None:
+                return node.child
+            if len(keep) != len(split_conjuncts(node.condition)):
+                return Filter(cond, node.child)
+        return node
+
+    out = subplan.transform_up(rule)
+    # any remaining outer references → unsupported correlation
+    for n in out.iter_nodes():
+        for e in n.expressions():
+            if e.references() & outer_ids:
+                failed[0] = True
+    return out, pairs, not failed[0]
+
+
+# ---------------------------------------------------------------------------
+# Predicate subquery rewrite (Filter conditions only, like the reference)
+# ---------------------------------------------------------------------------
+
+class RewritePredicateSubquery(Rule):
+    """EXISTS/IN in WHERE → left_semi / left_anti joins
+    (reference: sqlcat/optimizer/subquery.scala RewritePredicateSubquery)."""
+
+    def apply(self, plan):
+        from .optimizer import join_conjuncts, split_conjuncts
+
+        def rule(node):
+            if not isinstance(node, Filter):
+                return node
+            has_sub = any(isinstance(x, (InSubquery, Exists))
+                          for x in node.condition.iter_nodes())
+            if not has_sub:
+                return node
+
+            outer_ids = {a.expr_id for a in node.child.output}
+            base = node.child
+            kept: list[Expression] = []
+            for conj in split_conjuncts(node.condition):
+                base, handled = self._rewrite_one(conj, base, outer_ids)
+                if not handled:
+                    kept.append(conj)
+            if kept:
+                return Filter(join_conjuncts(kept), base)
+            return base
+
+        return plan.transform_up(rule)
+
+    def _rewrite_one(self, conj: Expression, base: LogicalPlan,
+                     outer_ids: set[int]):
+        neg = False
+        e = conj
+        if isinstance(e, Not):
+            inner = e.child
+            if isinstance(inner, (InSubquery, Exists)):
+                neg = True
+                e = inner
+        if isinstance(e, InSubquery):
+            sub, pairs, ok = split_correlation(e.plan, outer_ids)
+            if not ok:
+                raise UnsupportedOperationError(
+                    "unsupported correlated IN subquery")
+            value_attr = sub.output[0]
+            sub = _expose_correlation_keys(sub, pairs)
+            # NOT IN with nullable inner values: null-aware anti join; we
+            # implement the not-exists semantics (documented deviation)
+            cond: Expression = EqualTo(e.value, value_attr)
+            for outer_e, inner_e in pairs:
+                cond = And(cond, EqualTo(outer_e, inner_e))
+            jt = "left_anti" if neg else "left_semi"
+            return Join(base, sub, jt, cond), True
+        if isinstance(e, Exists):
+            sub, pairs, ok = split_correlation(e.plan, outer_ids)
+            if not ok:
+                raise UnsupportedOperationError(
+                    "unsupported correlated EXISTS subquery")
+            if pairs:
+                sub = _expose_correlation_keys(sub, pairs)
+                cond = None
+                for outer_e, inner_e in pairs:
+                    c = EqualTo(outer_e, inner_e)
+                    cond = c if cond is None else And(cond, c)
+            else:
+                # uncorrelated EXISTS: constant-key semi join
+                one = Alias(Literal(1), "__one")
+                sub = Project([one], sub)
+                cond = EqualTo(Literal(1), sub.output[0])
+            jt = "left_anti" if neg else "left_semi"
+            return Join(base, sub, jt, cond), True
+        return base, False
+
+
+def _expose_correlation_keys(
+        sub: LogicalPlan,
+        pairs: Sequence[tuple[Expression, Expression]]) -> LogicalPlan:
+    """Rewrite the decorrelated subplan so the inner key attributes appear
+    in its output. An aggregate regains them as GROUPING keys (turning a
+    per-outer-row aggregate into a grouped one — the decorrelation core);
+    a projection just widens."""
+    keys: list[AttributeReference] = []
+    for _, ie in pairs:
+        if not isinstance(ie, AttributeReference):
+            raise UnsupportedOperationError(
+                "correlated predicate must compare to a plain subquery column")
+        keys.append(ie)
+    out_ids = {a.expr_id for a in sub.output}
+    missing = [k for k in keys if k.expr_id not in out_ids]
+    if not missing:
+        return sub
+    if isinstance(sub, Aggregate):
+        child_ids = {a.expr_id for a in sub.child.output}
+        if all(k.expr_id in child_ids for k in missing):
+            return Aggregate(
+                list(sub.grouping_exprs) + missing,
+                list(missing) + list(sub.aggregate_exprs),
+                sub.child)
+    if isinstance(sub, Project):
+        child_ids = {a.expr_id for a in sub.child.output}
+        if all(k.expr_id in child_ids for k in missing):
+            return Project(list(sub.project_list) + missing, sub.child)
+    raise UnsupportedOperationError(
+        "correlated key is not reachable from the subquery output")
+
+
+class RewriteCorrelatedScalarSubquery(Rule):
+    """Equality-correlated scalar subqueries with a top aggregate →
+    left_outer join against the grouped aggregate (reference:
+    sqlcat/optimizer/subquery.scala RewriteCorrelatedScalarSubquery —
+    the TPC-DS q1/q6 shape: `x > (SELECT avg(y) FROM t WHERE t.k = outer.k)`)."""
+
+    def apply(self, plan):
+        def rule(node):
+            if not isinstance(node, (Filter, Project)):
+                return node
+            subs = [x for e in node.expressions()
+                    for x in e.iter_nodes()
+                    if isinstance(x, ScalarSubquery)]
+            corr = None
+            outer_ids = {a.expr_id for a in node.child.output} \
+                if node.children else set()
+            for s in subs:
+                if any(e2.references() & outer_ids
+                       for n2 in s.plan.iter_nodes()
+                       for e2 in n2.expressions()):
+                    corr = s
+                    break
+            if corr is None:
+                return node
+
+            sub, pairs, ok = split_correlation(corr.plan, outer_ids)
+            if not ok or not pairs:
+                raise UnsupportedOperationError(
+                    "unsupported correlated scalar subquery (only equality "
+                    "correlation is supported)")
+            if not isinstance(sub, Aggregate) or sub.grouping_exprs:
+                raise UnsupportedOperationError(
+                    "correlated scalar subquery must be a simple aggregate")
+            inner_keys: list[AttributeReference] = []
+            for _, ie in pairs:
+                if not isinstance(ie, AttributeReference):
+                    raise UnsupportedOperationError(
+                        "correlated key must be a plain column")
+                inner_keys.append(ie)
+            # regroup the aggregate by the correlation keys
+            regrouped = Aggregate(
+                list(inner_keys),
+                list(inner_keys) + list(sub.aggregate_exprs),
+                sub.child)
+            value_attr = regrouped.output[len(inner_keys)]
+
+            cond = None
+            for (outer_e, _), ik in zip(pairs, inner_keys):
+                c = EqualTo(outer_e, ik)
+                cond = c if cond is None else And(cond, c)
+            joined = Join(node.child, regrouped, "left_outer", cond)
+
+            def replace(x: Expression) -> Expression:
+                if x is corr:
+                    return value_attr
+                return x
+
+            new_node = node.map_expressions(
+                lambda e: e.transform_up(replace))
+            new_node = new_node.copy(child=joined)
+            if isinstance(new_node, Project):
+                return new_node
+            # the join widened a Filter's schema; restore the original output
+            return Project(list(node.output), new_node)
+
+        return plan.transform_up(rule)
+
+

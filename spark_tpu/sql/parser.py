@@ -507,9 +507,15 @@ class Parser:
                 neg = True
             if self.eat_kw("in"):
                 self.expect_op("(")
-                if self.at_kw("select"):
-                    raise ParseException(
-                        "IN (subquery) not yet supported")
+                if self.at_kw("select", "with"):
+                    from ..plan.subquery import InSubquery
+
+                    sub = self.parse_query()
+                    self.expect_op(")")
+                    left = InSubquery(left, sub)
+                    if neg:
+                        left = E.Not(left)
+                    continue
                 items = [self.parse_expr()]
                 while self.eat_op(","):
                     items.append(self.parse_expr())
@@ -626,10 +632,20 @@ class Parser:
             self.expect_op(")")
             return E.Cast(e, to)
         if self.at_kw("exists"):
-            raise ParseException("EXISTS subqueries not yet supported")
+            from ..plan.subquery import Exists
+
+            self.next()
+            self.expect_op("(")
+            sub = self.parse_query()
+            self.expect_op(")")
+            return Exists(sub)
         if self.eat_op("("):
-            if self.at_kw("select"):
-                raise ParseException("scalar subqueries not yet supported")
+            if self.at_kw("select", "with"):
+                from ..plan.subquery import ScalarSubquery
+
+                sub = self.parse_query()
+                self.expect_op(")")
+                return ScalarSubquery(sub)
             e = self.parse_expr()
             self.expect_op(")")
             return e

@@ -1,0 +1,82 @@
+"""Subquery tests (reference: sqlcat/optimizer subquery suites + SQL tests)."""
+
+import pyarrow as pa
+import pytest
+
+
+@pytest.fixture()
+def shop(spark):
+    orders = spark.createDataFrame(pa.table({
+        "oid": [1, 2, 3, 4, 5],
+        "cust": ["a", "a", "b", "c", "b"],
+        "amount": [10.0, 20.0, 5.0, 99.0, 30.0],
+    }))
+    customers = spark.createDataFrame(pa.table({
+        "cid": ["a", "b", "d"],
+        "region": ["west", "east", "west"],
+    }))
+    orders.createOrReplaceTempView("orders")
+    customers.createOrReplaceTempView("customers")
+    return spark
+
+
+def q(spark, text):
+    return spark.sql(text).toArrow().to_pydict()
+
+
+def test_uncorrelated_scalar_subquery(shop):
+    out = q(shop, """SELECT oid FROM orders
+                     WHERE amount > (SELECT avg(amount) FROM orders)
+                     ORDER BY oid""")
+    assert out["oid"] == [4]  # avg = 32.8
+
+
+def test_scalar_subquery_in_select(shop):
+    out = q(shop, "SELECT (SELECT max(amount) FROM orders) AS m")
+    assert out["m"] == [99.0]
+
+
+def test_in_subquery(shop):
+    out = q(shop, """SELECT oid FROM orders
+                     WHERE cust IN (SELECT cid FROM customers)
+                     ORDER BY oid""")
+    assert out["oid"] == [1, 2, 3, 5]
+
+
+def test_not_in_subquery(shop):
+    out = q(shop, """SELECT oid FROM orders
+                     WHERE cust NOT IN (SELECT cid FROM customers)""")
+    assert out["oid"] == [4]
+
+
+def test_correlated_exists(shop):
+    out = q(shop, """SELECT cid FROM customers c
+                     WHERE EXISTS (SELECT 1 FROM orders o
+                                   WHERE o.cust = c.cid)
+                     ORDER BY cid""")
+    assert out["cid"] == ["a", "b"]
+
+
+def test_correlated_not_exists(shop):
+    out = q(shop, """SELECT cid FROM customers c
+                     WHERE NOT EXISTS (SELECT 1 FROM orders o
+                                       WHERE o.cust = c.cid)""")
+    assert out["cid"] == ["d"]
+
+
+def test_correlated_scalar_subquery(shop):
+    # orders above their customer's average
+    out = q(shop, """SELECT oid FROM orders o
+                     WHERE amount > (SELECT avg(amount) FROM orders i
+                                     WHERE i.cust = o.cust)
+                     ORDER BY oid""")
+    # cust a avg 15 → oid2; cust b avg 17.5 → oid5; cust c avg 99 → none
+    assert out["oid"] == [2, 5]
+
+
+def test_in_subquery_with_correlation(shop):
+    out = q(shop, """SELECT oid FROM orders o
+                     WHERE amount IN (SELECT max(amount) FROM orders i
+                                      WHERE i.cust = o.cust)
+                     ORDER BY oid""")
+    assert out["oid"] == [2, 4, 5]
