@@ -333,6 +333,39 @@ def _remap_union_cond(cond: Expression, union: Union, i: int) -> Expression:
     return substitute_attrs(cond, m)
 
 
+class MergeFilterIntoJoin(Rule):
+    """Filter over cross/inner Join → join condition (reference:
+    PushPredicateThroughJoin's join-condition path — turns comma-style
+    FROM a, b WHERE a.k = b.k into an equi join)."""
+
+    def apply(self, plan):
+        def rule(node):
+            if isinstance(node, Filter) and isinstance(node.child, Join) and \
+                    node.child.join_type in ("inner", "cross"):
+                join = node.child
+                lids = {a.expr_id for a in join.left.output}
+                rids = {a.expr_id for a in join.right.output}
+                both, keep = [], []
+                for c in split_conjuncts(node.condition):
+                    refs = c.references()
+                    if refs & lids and refs & rids:
+                        both.append(c)
+                    else:
+                        keep.append(c)
+                if not both:
+                    return node
+                cond = join.condition
+                for c in both:
+                    cond = c if cond is None else And(cond, c)
+                new_join = Join(join.left, join.right, "inner", cond)
+                if keep:
+                    return Filter(join_conjuncts(keep), new_join)
+                return new_join
+            return node
+
+        return plan.transform_up(rule)
+
+
 class InferFiltersFromJoinKeys(Rule):
     """Add IsNotNull on equi-join keys (reference: InferFiltersFromConstraints,
     simplified) — lets scans drop null keys before the shuffle."""
@@ -681,6 +714,7 @@ class Optimizer(RuleExecutor):
             ]),
             Batch("Operator optimization", FixedPoint(100), [
                 CombineFilters(),
+                MergeFilterIntoJoin(),
                 PushDownPredicates(),
                 ConstantFolding(),
                 BooleanSimplification(),

@@ -1,0 +1,103 @@
+"""Mini TPC-DS data generator.
+
+Role of the reference's GenTPCDSData.scala (sql/core/src/test/scala/...):
+a scaled-down star schema — store_sales fact + date_dim/item/customer/store
+dimensions — with TPC-DS column names so real benchmark queries run
+unmodified. Deterministic via seed.
+"""
+
+from __future__ import annotations
+
+import datetime
+
+import numpy as np
+import pyarrow as pa
+
+
+def gen_tpcds(n_sales: int = 20_000, n_items: int = 200,
+              n_customers: int = 500, n_stores: int = 10,
+              seed: int = 42) -> dict[str, pa.Table]:
+    rng = np.random.default_rng(seed)
+
+    # date_dim: 3 years of days
+    base = datetime.date(1998, 1, 1)
+    n_days = 3 * 365
+    dates = [base + datetime.timedelta(days=i) for i in range(n_days)]
+    date_dim = pa.table({
+        "d_date_sk": pa.array(range(2450000, 2450000 + n_days), pa.int32()),
+        "d_date": pa.array(dates, pa.date32()),
+        "d_year": pa.array([d.year for d in dates], pa.int32()),
+        "d_moy": pa.array([d.month for d in dates], pa.int32()),
+        "d_dom": pa.array([d.day for d in dates], pa.int32()),
+        "d_qoy": pa.array([(d.month - 1) // 3 + 1 for d in dates], pa.int32()),
+        "d_day_name": pa.array([d.strftime("%A") for d in dates]),
+    })
+
+    brands = [f"brand#{i % 25 + 1}" for i in range(n_items)]
+    categories = ["Books", "Electronics", "Home", "Music", "Sports"]
+    item = pa.table({
+        "i_item_sk": pa.array(range(1, n_items + 1), pa.int32()),
+        "i_item_id": pa.array([f"ITEM{i:06d}" for i in range(n_items)]),
+        "i_brand_id": pa.array([i % 25 + 1 for i in range(n_items)],
+                               pa.int32()),
+        "i_brand": pa.array(brands),
+        "i_category": pa.array([categories[i % len(categories)]
+                                for i in range(n_items)]),
+        "i_manufact_id": pa.array([i % 50 + 1 for i in range(n_items)],
+                                  pa.int32()),
+        "i_current_price": pa.array(
+            np.round(rng.uniform(0.5, 100.0, n_items), 2), pa.float64()),
+    })
+
+    states = ["CA", "TX", "NY", "WA", "OR"]
+    customer = pa.table({
+        "c_customer_sk": pa.array(range(1, n_customers + 1), pa.int32()),
+        "c_customer_id": pa.array([f"CUST{i:08d}"
+                                   for i in range(n_customers)]),
+        "c_birth_year": pa.array(
+            rng.integers(1930, 2000, n_customers).astype(np.int32)),
+        "c_state": pa.array([states[i % len(states)]
+                             for i in range(n_customers)]),
+    })
+
+    store = pa.table({
+        "s_store_sk": pa.array(range(1, n_stores + 1), pa.int32()),
+        "s_store_id": pa.array([f"STORE{i:04d}" for i in range(n_stores)]),
+        "s_state": pa.array([states[i % len(states)]
+                             for i in range(n_stores)]),
+        "s_number_employees": pa.array(
+            rng.integers(50, 300, n_stores).astype(np.int32)),
+    })
+
+    qty = rng.integers(1, 20, n_sales).astype(np.int32)
+    price = np.round(rng.uniform(0.5, 100.0, n_sales), 2)
+    discount = np.round(rng.uniform(0, 0.4, n_sales), 2)
+    store_sales = pa.table({
+        "ss_sold_date_sk": pa.array(
+            rng.integers(2450000, 2450000 + n_days, n_sales)
+            .astype(np.int32)),
+        "ss_item_sk": pa.array(
+            rng.integers(1, n_items + 1, n_sales).astype(np.int32)),
+        "ss_customer_sk": pa.array(
+            rng.integers(1, n_customers + 1, n_sales).astype(np.int32)),
+        "ss_store_sk": pa.array(
+            rng.integers(1, n_stores + 1, n_sales).astype(np.int32)),
+        "ss_quantity": pa.array(qty),
+        "ss_sales_price": pa.array(price, pa.float64()),
+        "ss_ext_sales_price": pa.array(
+            np.round(qty * price, 2), pa.float64()),
+        "ss_ext_discount_amt": pa.array(
+            np.round(qty * price * discount, 2), pa.float64()),
+        "ss_net_profit": pa.array(
+            np.round(qty * price * (0.3 - discount), 2), pa.float64()),
+    })
+
+    return {"date_dim": date_dim, "item": item, "customer": customer,
+            "store": store, "store_sales": store_sales}
+
+
+def register_tpcds(spark, tables: dict[str, pa.Table] | None = None):
+    tables = tables or gen_tpcds()
+    for name, t in tables.items():
+        spark.createDataFrame(t).createOrReplaceTempView(name)
+    return tables
