@@ -1,0 +1,3 @@
+SELECT count(*) AS n, sum(ss_quantity) AS sq, min(ss_quantity) AS mn, max(ss_quantity) AS mx FROM store_sales;
+SELECT ss_store_sk, count(*) AS n FROM store_sales GROUP BY ss_store_sk ORDER BY ss_store_sk;
+SELECT count(DISTINCT ss_store_sk) AS stores FROM store_sales

@@ -1,0 +1,3 @@
+SELECT abs(-5) AS a, round(3.14159, 2) AS r, upper('hello') AS u, length('spark') AS l, coalesce(NULL, 7) AS c;
+SELECT CASE WHEN 1 < 2 THEN 'yes' ELSE 'no' END AS answer, 10 % 3 AS m, cast('2020-05-17' AS date) AS d;
+SELECT year(DATE '2021-06-15') AS y, quarter(DATE '2021-06-15') AS q, datediff(DATE '2021-01-10', DATE '2021-01-01') AS dd

@@ -1,0 +1,2 @@
+SELECT s_state, count(*) AS n FROM store_sales ss JOIN store s ON ss.ss_store_sk = s.s_store_sk GROUP BY s_state ORDER BY s_state;
+SELECT count(*) AS missing FROM store_sales ss LEFT ANTI JOIN item i ON ss.ss_item_sk = i.i_item_sk

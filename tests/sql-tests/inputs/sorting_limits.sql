@@ -1,0 +1,2 @@
+SELECT d_day_name, count(*) AS n FROM date_dim GROUP BY d_day_name ORDER BY n DESC, d_day_name LIMIT 3;
+SELECT d_year, d_moy FROM date_dim WHERE d_dom = 1 ORDER BY d_year, d_moy LIMIT 5 OFFSET 2

@@ -1,0 +1,76 @@
+"""Golden-file SQL tests.
+
+Role of the reference's SQLQueryTestSuite (sql/core/src/test/.../
+SQLQueryTestSuite.scala): `.sql` inputs under tests/sql-tests/inputs/ run
+against committed results under tests/sql-tests/results/; regenerate with
+SPARK_GENERATE_GOLDEN_FILES=1 (same env-var workflow as the reference).
+"""
+
+import glob
+import os
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+INPUTS = os.path.join(HERE, "sql-tests", "inputs")
+RESULTS = os.path.join(HERE, "sql-tests", "results")
+REGEN = os.environ.get("SPARK_GENERATE_GOLDEN_FILES") == "1"
+
+
+def _setup(spark):
+    from tpcds_mini import register_tpcds
+
+    register_tpcds(spark)
+
+
+def _render(table) -> str:
+    """Stable text rendering of a result table."""
+    cols = table.column_names
+    lines = ["-- " + "\t".join(cols)]
+    pylists = [c.to_pylist() for c in table.columns]
+    for row in zip(*pylists) if cols else []:
+        lines.append("\t".join(_fmt(v) for v in row))
+    return "\n".join(lines) + "\n"
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, float):
+        return f"{v:.6g}"
+    if isinstance(v, bool):
+        return str(v).lower()
+    return str(v)
+
+
+def _cases():
+    return sorted(glob.glob(os.path.join(INPUTS, "*.sql")))
+
+
+@pytest.mark.parametrize("path", _cases(),
+                         ids=[os.path.basename(p) for p in _cases()])
+def test_golden(spark, path):
+    _setup(spark)
+    name = os.path.splitext(os.path.basename(path))[0]
+    out_path = os.path.join(RESULTS, name + ".out")
+    with open(path) as f:
+        text = f.read()
+
+    chunks = [q.strip() for q in text.split(";") if q.strip()
+              and not q.strip().startswith("--")]
+    rendered = []
+    for q in chunks:
+        table = spark.sql(q).toArrow()
+        rendered.append(f"-- !query\n{q}\n-- !result\n{_render(table)}")
+    got = "\n".join(rendered)
+
+    if REGEN:
+        os.makedirs(RESULTS, exist_ok=True)
+        with open(out_path, "w") as f:
+            f.write(got)
+        pytest.skip("regenerated golden file")
+    assert os.path.exists(out_path), \
+        f"golden file missing — regenerate with SPARK_GENERATE_GOLDEN_FILES=1"
+    with open(out_path) as f:
+        want = f.read()
+    assert got == want, f"golden mismatch for {name}"
