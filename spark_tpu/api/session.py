@@ -88,7 +88,18 @@ class TpuSession:
         self._metrics = Metrics()
         self._cached: dict[int, Any] = {}
         self._streams: list = []
+        from ..exec.listener import EventLoggingListener, ListenerBus
+
+        self.listener_bus = ListenerBus()
+        if str(self.conf.get("spark.eventLog.enabled", "false")).lower() \
+                == "true":
+            log_dir = self.conf.get("spark.eventLog.dir", "/tmp/spark-events")
+            self.listener_bus.register(EventLoggingListener(log_dir))
         TpuSession._active = self
+
+    @property
+    def listenerManager(self):
+        return self.listener_bus
 
     # ------------------------------------------------------------------
     def _planner(self):

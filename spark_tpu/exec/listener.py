@@ -1,0 +1,150 @@
+"""Listener bus, query events, and event logging.
+
+Role of the reference's event-sourced observability stack (SURVEY.md §5):
+LiveListenerBus (core/scheduler/LiveListenerBus.scala — async queued
+dispatch), QueryExecutionListener (sql/.../util/QueryExecutionListener.scala),
+EventLoggingListener + JsonProtocol (core/scheduler/EventLoggingListener.scala:48,
+core/util/JsonProtocol.scala:66), and the History Server's replay
+(core/deploy/history/FsHistoryProvider.scala) in miniature.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+import uuid
+from dataclasses import asdict, dataclass, field
+from typing import Any, Callable, Optional
+
+
+@dataclass
+class QueryEvent:
+    event: str                  # queryStarted | querySucceeded | queryFailed
+    query_id: str
+    timestamp: float
+    duration_ms: float | None = None
+    phases: dict = field(default_factory=dict)
+    plan: str = ""
+    error: str | None = None
+    metrics: dict = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), default=str)
+
+
+class ListenerBus:
+    """Async queued listener dispatch (LiveListenerBus role). Listeners are
+    callables or objects with on_event(event)."""
+
+    def __init__(self):
+        self._listeners: list = []
+        self._queue: "queue.Queue" = queue.Queue()
+        self._thread: threading.Thread | None = None
+        self._stopped = threading.Event()
+        self._lock = threading.Lock()
+
+    def register(self, listener) -> None:
+        with self._lock:
+            self._listeners.append(listener)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._drain, daemon=True, name="listener-bus")
+                self._thread.start()
+
+    def unregister(self, listener) -> None:
+        with self._lock:
+            if listener in self._listeners:
+                self._listeners.remove(listener)
+
+    def post(self, event: QueryEvent) -> None:
+        with self._lock:
+            has = bool(self._listeners)
+        if has:
+            self._queue.put(event)
+
+    def _drain(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                ev = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            with self._lock:
+                listeners = list(self._listeners)
+            for l in listeners:
+                try:
+                    if callable(l):
+                        l(ev)
+                    else:
+                        l.on_event(ev)
+                except Exception:
+                    pass
+
+    def wait_empty(self, timeout: float = 5.0) -> None:
+        deadline = time.time() + timeout
+        while not self._queue.empty() and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.02)  # let the in-flight event finish
+
+    def stop(self):
+        self._stopped.set()
+
+
+class QueryExecutionListener:
+    """Subclass with on_success / on_failure (reference API shape)."""
+
+    def on_event(self, ev: QueryEvent) -> None:
+        if ev.event == "querySucceeded":
+            self.on_success(ev)
+        elif ev.event == "queryFailed":
+            self.on_failure(ev)
+
+    def on_success(self, ev: QueryEvent) -> None:  # pragma: no cover
+        pass
+
+    def on_failure(self, ev: QueryEvent) -> None:  # pragma: no cover
+        pass
+
+
+class EventLoggingListener:
+    """JSON-lines event log per session (EventLoggingListener role)."""
+
+    def __init__(self, log_dir: str, app_id: str | None = None):
+        os.makedirs(log_dir, exist_ok=True)
+        self.path = os.path.join(
+            log_dir, f"app-{app_id or uuid.uuid4().hex[:12]}.jsonl")
+        self._lock = threading.Lock()
+
+    def on_event(self, ev: QueryEvent) -> None:
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(ev.to_json() + "\n")
+
+
+class HistoryReader:
+    """Replay event logs into a summary (FsHistoryProvider role)."""
+
+    def __init__(self, log_dir: str):
+        self.log_dir = log_dir
+
+    def applications(self) -> list[str]:
+        return sorted(f for f in os.listdir(self.log_dir)
+                      if f.endswith(".jsonl"))
+
+    def load(self, app_file: str) -> list[dict]:
+        out = []
+        with open(os.path.join(self.log_dir, app_file)) as f:
+            for line in f:
+                if line.strip():
+                    out.append(json.loads(line))
+        return out
+
+    def summary(self, app_file: str) -> dict:
+        events = self.load(app_file)
+        queries = [e for e in events if e["event"] == "querySucceeded"]
+        failed = [e for e in events if e["event"] == "queryFailed"]
+        total_ms = sum(e.get("duration_ms") or 0 for e in queries)
+        return {"queries": len(queries), "failed": len(failed),
+                "total_duration_ms": total_ms}

@@ -99,20 +99,45 @@ class QueryExecution:
         return self._timed("execution", lambda: plan.execute(ctx))
 
     def to_arrow(self) -> pa.Table:
-        parts = self.execute()
-        batches = [b for p in parts for b in p]
-        schema = attrs_schema(self.physical.output)
-        if not batches:
-            from ..columnar.batch import ColumnarBatch
+        import uuid
 
-            batches = [ColumnarBatch.empty(schema)]
-        tables = [b.to_arrow() for b in batches]
-        out = pa.concat_tables(tables, promote_options="permissive")
-        limit = int(self.session.conf.get(MAX_RESULT_ROWS))
-        if out.num_rows > limit:
-            raise RuntimeError(
-                f"result has {out.num_rows} rows > spark.tpu.collect.maxRows")
-        return out
+        from .listener import QueryEvent
+
+        qid = uuid.uuid4().hex[:12]
+        bus = getattr(self.session, "listener_bus", None)
+        t0 = time.perf_counter()
+        if bus is not None:
+            bus.post(QueryEvent("queryStarted", qid, time.time()))
+        try:
+            parts = self.execute()
+            batches = [b for p in parts for b in p]
+            schema = attrs_schema(self.physical.output)
+            if not batches:
+                from ..columnar.batch import ColumnarBatch
+
+                batches = [ColumnarBatch.empty(schema)]
+            tables = [b.to_arrow() for b in batches]
+            out = pa.concat_tables(tables, promote_options="permissive")
+            limit = int(self.session.conf.get(MAX_RESULT_ROWS))
+            if out.num_rows > limit:
+                raise RuntimeError(
+                    f"result has {out.num_rows} rows > "
+                    "spark.tpu.collect.maxRows")
+            if bus is not None:
+                bus.post(QueryEvent(
+                    "querySucceeded", qid, time.time(),
+                    duration_ms=(time.perf_counter() - t0) * 1000,
+                    phases=dict(self.phase_times),
+                    plan=self.physical.tree_string(),
+                    metrics=self.session._metrics.snapshot()["counters"]))
+            return out
+        except Exception as e:
+            if bus is not None:
+                bus.post(QueryEvent(
+                    "queryFailed", qid, time.time(),
+                    duration_ms=(time.perf_counter() - t0) * 1000,
+                    error=f"{type(e).__name__}: {e}"))
+            raise
 
     @staticmethod
     def _noop():

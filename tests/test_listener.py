@@ -1,0 +1,65 @@
+"""Observability tests: listener bus, query events, event log replay."""
+
+import os
+
+import pyarrow as pa
+import pytest
+
+from spark_tpu.exec.listener import (
+    EventLoggingListener, HistoryReader, QueryExecutionListener,
+)
+
+
+def test_query_listener(spark):
+    seen = []
+
+    class L(QueryExecutionListener):
+        def on_success(self, ev):
+            seen.append(ev)
+
+        def on_failure(self, ev):
+            seen.append(ev)
+
+    l = L()
+    spark.listener_bus.register(l)
+    try:
+        df = spark.createDataFrame(pa.table({"x": [1, 2, 3]}))
+        df.toArrow()
+        spark.listener_bus.wait_empty()
+        assert any(e.event == "querySucceeded" for e in seen)
+        ok = [e for e in seen if e.event == "querySucceeded"][0]
+        assert ok.duration_ms is not None
+        assert "execution" in ok.phases
+        assert "LocalTableScan" in ok.plan
+    finally:
+        spark.listener_bus.unregister(l)
+
+
+def test_failure_event(spark):
+    seen = []
+    spark.listener_bus.register(lambda ev: seen.append(ev))
+    try:
+        with pytest.raises(Exception):
+            spark.sql("SELECT missing_col FROM nonexistent_xyz").toArrow()
+        spark.listener_bus.wait_empty()
+        assert any(e.event == "queryFailed" for e in seen)
+    finally:
+        spark.listener_bus._listeners.clear()
+
+
+def test_event_log_and_history(spark, tmp_path):
+    log_dir = str(tmp_path / "events")
+    el = EventLoggingListener(log_dir, app_id="testapp")
+    spark.listener_bus.register(el)
+    try:
+        spark.createDataFrame(pa.table({"x": [1]})).toArrow()
+        spark.createDataFrame(pa.table({"x": [2]})).toArrow()
+        spark.listener_bus.wait_empty()
+        h = HistoryReader(log_dir)
+        apps = h.applications()
+        assert apps == ["app-testapp.jsonl"]
+        summary = h.summary(apps[0])
+        assert summary["queries"] >= 2
+        assert summary["total_duration_ms"] > 0
+    finally:
+        spark.listener_bus.unregister(el)
