@@ -337,6 +337,36 @@ def lead(c, offset: int = 1, default=None) -> Column:
                        None if default is None else E.Literal(default)))
 
 
+# --- python UDFs ------------------------------------------------------------
+
+def udf(f=None, returnType=None):
+    """Vectorized Python UDF (Arrow-UDF analog): the function receives numpy
+    arrays (falls back to row-at-a-time when that fails)."""
+    from ..expr.pyudf import PythonUDF
+    from ..types import DataType, float64
+
+    rt = returnType or float64
+    if isinstance(rt, str):
+        from ..sql.parser import parse_data_type
+
+        rt = parse_data_type(rt)
+
+    def wrap(fn):
+        def call(*cols):
+            return Column(PythonUDF(fn, [_c(c) for c in cols], rt,
+                                    name=getattr(fn, "__name__", "udf")))
+
+        call.__name__ = getattr(fn, "__name__", "udf")
+        return call
+
+    if f is not None:
+        return wrap(f)
+    return wrap
+
+
+pandas_udf = udf
+
+
 # --- sort helpers -----------------------------------------------------------
 
 def asc(c) -> Column:

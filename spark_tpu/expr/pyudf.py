@@ -1,0 +1,52 @@
+"""Python UDF expression.
+
+Role of the reference's PythonUDF + Arrow eval path (sqlx/python/
+ArrowEvalPythonExec.scala, core/api/python/PythonRunner.scala:204,
+python/pyspark/worker.py). In-process design: there is no JVM↔Python
+boundary to cross, so the "worker protocol" collapses to vectorized host
+evaluation over the batch's Arrow-side columns — device pipelines evaluate
+the UDF's argument expressions, live rows cross to the host once, the
+function runs vectorized (numpy in/out, np.vectorize fallback), and the
+result re-enters HBM as a new column.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+from ..types import DataType
+from .expressions import Expression
+
+
+class PythonUDF(Expression):
+    child_fields = ("args",)
+
+    def __init__(self, fn: Callable, args: Sequence[Expression],
+                 return_type: DataType, name: str = "udf",
+                 vectorized: bool = True):
+        self.fn = fn
+        self.args = list(args)
+        self.return_type = return_type
+        self.fname = name
+        self.vectorized = vectorized
+
+    @property
+    def dtype(self) -> DataType:
+        return self.return_type
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def _data_args(self):
+        return (("fn", id(self.fn)), ("name", self.fname))
+
+    def eval(self, ctx):
+        from ..errors import ExecutionError
+
+        raise ExecutionError(
+            "PythonUDF must be extracted by the planner (ExtractPythonUDFs)")
+
+    def simple_string(self):
+        a = ", ".join(x.simple_string() for x in self.args)
+        return f"{self.fname}({a})"

@@ -111,6 +111,11 @@ class Planner:
                 L.Aggregate(list(out), list(out), node.child))
         if isinstance(node, L.Window):
             return self._plan_window(node)
+        if isinstance(node, L.PythonEval):
+            from .python_eval import PythonEvalExec
+
+            return PythonEvalExec(node.udf_aliases,
+                                  self._convert(node.child))
         raise UnsupportedOperationError(
             f"no physical plan for {type(node).__name__}")
 

@@ -1,0 +1,133 @@
+"""PythonEvalExec: vectorized host UDF evaluation.
+
+Role of the reference's ArrowEvalPythonExec + PythonRunner worker protocol
+(sqlx/python/ArrowEvalPythonExec.scala; SURVEY.md §3.4). No process boundary
+here: device pipelines evaluate argument expressions, live rows transfer to
+the host once, the UDF runs vectorized over numpy arrays, and results come
+back as new device columns (strings re-enter via dictionary encoding).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..columnar.batch import Column, ColumnarBatch, StringDict
+from ..exec.context import ExecContext
+from ..expr.expressions import Alias
+from ..types import StringType, StructField, StructType
+from .compile import ExprPipeline
+from .operators import PhysicalPlan, attrs_schema
+
+
+class PythonEvalExec(PhysicalPlan):
+    child_fields = ("child",)
+
+    def __init__(self, udf_aliases: Sequence[Alias], child: PhysicalPlan):
+        self.udf_aliases = list(udf_aliases)
+        self.child = child
+        self._arg_pipelines = None
+
+    @property
+    def output(self):
+        return self.child.output + [a.to_attribute()
+                                    for a in self.udf_aliases]
+
+    def output_partitioning(self):
+        return self.child.output_partitioning()
+
+    def _pipelines(self):
+        if self._arg_pipelines is None:
+            self._arg_pipelines = []
+            for al in self.udf_aliases:
+                udf = al.child
+                arg_aliases = [Alias(a, f"__a{i}")
+                               for i, a in enumerate(udf.args)]
+                schema = StructType([
+                    StructField(x.name, x.child.dtype, True)
+                    for x in arg_aliases])
+                self._arg_pipelines.append(ExprPipeline(
+                    self.child.output, [], arg_aliases, schema))
+        return self._arg_pipelines
+
+    def execute(self, ctx: ExecContext):
+        parts = self.child.execute(ctx)
+        return [[self._eval_batch(b, ctx) for b in p] for p in parts]
+
+    def _eval_batch(self, batch: ColumnarBatch, ctx) -> ColumnarBatch:
+        import jax.numpy as jnp
+
+        cap = batch.capacity
+        mask = np.asarray(batch.row_mask)
+        sel = np.nonzero(mask)[0]
+        new_cols = list(batch.columns)
+        for al, pipe in zip(self.udf_aliases, self._pipelines()):
+            udf = al.child
+            arg_batch = pipe.run(batch)
+            args = [c.to_numpy(sel) for c in arg_batch.columns]
+            with ctx.metrics.time("python_udf"):
+                result = self._call(udf, args, len(sel))
+            col = self._to_column(udf.return_type, result, sel, cap)
+            new_cols.append(col)
+        schema = attrs_schema(self.output)
+        return ColumnarBatch(schema, new_cols, batch.row_mask,
+                             batch._num_rows)
+
+    def _call(self, udf, args: list[np.ndarray], n: int):
+        if n == 0:
+            return np.zeros(0)
+        if udf.vectorized:
+            try:
+                out = udf.fn(*args)
+                out = np.asarray(out)
+                if out.shape[:1] == (n,):
+                    return out
+            except Exception:
+                pass
+        # row-at-a-time fallback (the reference's non-arrow UDF path)
+        return np.array([udf.fn(*[a[i] for a in args]) for i in range(n)],
+                        dtype=object)
+
+    def _to_column(self, dt, result, sel: np.ndarray, cap: int) -> Column:
+        import jax.numpy as jnp
+
+        result = np.asarray(result)
+        nulls = np.array([v is None for v in result]) \
+            if result.dtype == object else np.zeros(len(result), bool)
+        if isinstance(dt, StringType):
+            values: list[str] = []
+            index: dict[str, int] = {}
+            codes = np.zeros(len(result), np.int32)
+            for i, v in enumerate(result):
+                if v is None:
+                    continue
+                s = str(v)
+                j = index.get(s)
+                if j is None:
+                    j = len(values)
+                    values.append(s)
+                    index[s] = j
+                codes[i] = j
+            data = np.zeros(cap, np.int32)
+            data[sel] = codes
+            validity = np.zeros(cap, bool)
+            validity[sel] = ~nulls
+            return Column(dt, jnp.asarray(data), jnp.asarray(validity),
+                          StringDict(values or [""]))
+        dd = dt.device_dtype
+        clean = np.asarray(
+            [0 if v is None else v for v in result]
+            if result.dtype == object else result)
+        data = np.zeros(cap, dd)
+        data[sel] = clean.astype(dd)[: len(sel)]
+        validity = None
+        if nulls.any():
+            vm = np.zeros(cap, bool)
+            vm[sel] = ~nulls
+            validity = jnp.asarray(vm)
+        return Column(dt, jnp.asarray(data), validity, None)
+
+    def simple_string(self):
+        names = ", ".join(a.child.fname for a in self.udf_aliases)
+        return f"PythonEval[{names}]"

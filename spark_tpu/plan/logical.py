@@ -342,6 +342,19 @@ class Window(UnaryNode):
         return self.child.output + [e.to_attribute() for e in self.window_exprs]
 
 
+class PythonEval(UnaryNode):
+    """Append host-evaluated Python UDF columns (reference:
+    ArrowEvalPythonExec's logical shadow)."""
+
+    def __init__(self, udf_aliases: Sequence[Expression], child: LogicalPlan):
+        self.udf_aliases = list(udf_aliases)
+        self.child = child
+
+    @property
+    def output(self):
+        return self.child.output + [a.to_attribute() for a in self.udf_aliases]
+
+
 class Expand(UnaryNode):
     """Multiplies each row by projection sets (rollup/cube/count-distinct;
     reference: sqlcat/plans/logical Expand)."""

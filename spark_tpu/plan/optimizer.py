@@ -333,6 +333,41 @@ def _remap_union_cond(cond: Expression, union: Union, i: int) -> Expression:
     return substitute_attrs(cond, m)
 
 
+class ExtractPythonUDFs(Rule):
+    """Pull PythonUDFs out of projections/filters into PythonEval operators
+    (reference: sqlx/python/ExtractPythonUDFs.scala)."""
+
+    def apply(self, plan):
+        from ..expr.pyudf import PythonUDF
+        from .logical import PythonEval
+
+        def rule(node):
+            if not isinstance(node, (Project, Filter)):
+                return node
+            if not any(isinstance(x, PythonUDF)
+                       for e in node.expressions()
+                       for x in e.iter_nodes()):
+                return node
+            collected: list[Alias] = []
+
+            def extract(x: Expression) -> Expression:
+                if isinstance(x, PythonUDF):
+                    al = Alias(x, f"_pyudf{len(collected)}")
+                    collected.append(al)
+                    return al.to_attribute()
+                return x
+
+            new_node = node.map_expressions(
+                lambda e: e.transform_up(extract))
+            child = PythonEval(collected, node.child)
+            new_node = new_node.copy(child=child)
+            if isinstance(new_node, Filter):
+                return Project(list(node.output), new_node)
+            return new_node
+
+        return plan.transform_up(rule)
+
+
 class MergeFilterIntoJoin(Rule):
     """Filter over cross/inner Join → join condition (reference:
     PushPredicateThroughJoin's join-condition path — turns comma-style
@@ -728,6 +763,9 @@ class Optimizer(RuleExecutor):
                 InferFiltersFromJoinKeys(),
                 PushDownPredicates(),
                 CombineFilters(),
+            ]),
+            Batch("Python UDFs", FixedPoint(10), [
+                ExtractPythonUDFs(),
             ]),
             Batch("Column pruning", FixedPoint(20), [
                 ColumnPruning(),
