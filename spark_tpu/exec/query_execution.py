@@ -93,10 +93,14 @@ class QueryExecution:
                            lambda: self.session._planner().plan(optimized))
 
     def execute(self) -> list:
+        from .scheduler import DAGScheduler
+
         plan = self.physical
         ctx = ExecContext(conf=self.session.conf,
                           metrics=self.session._metrics)
-        return self._timed("execution", lambda: plan.execute(ctx))
+        sched = DAGScheduler(
+            ctx, listener_bus=getattr(self.session, "listener_bus", None))
+        return self._timed("execution", lambda: sched.run(plan))
 
     def to_arrow(self) -> pa.Table:
         import uuid

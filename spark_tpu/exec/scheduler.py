@@ -1,0 +1,256 @@
+"""Stage-DAG scheduler and control plane.
+
+Role of the reference's scheduling stack (SURVEY.md §2.1):
+  * DAGScheduler (core/scheduler/DAGScheduler.scala:648 createShuffleMapStage,
+    :1614 submitStage, :1831 submitMissingTasks): the plan DAG is cut into
+    stages at exchange boundaries; parents run before children; a failed
+    stage retries up to spark.stage.maxAttempts.
+  * TaskScheduler/TaskSetManager (core/scheduler/TaskSchedulerImpl.scala,
+    TaskSetManager.scala): per-stage task sets with per-task retry.
+  * Executor registry + HeartbeatReceiver (core/HeartbeatReceiver.scala) and
+    HealthTracker (core/scheduler/HealthTracker.scala:52): failure detection
+    and excludelists for the multi-host backend.
+  * BarrierCoordinator (core/BarrierCoordinator.scala): gang-sync for SPMD
+    stages — on a TPU mesh every pjit program is already gang-scheduled, so
+    the barrier is only needed for host-side phases.
+
+Local mode runs stages in-process (a stage = the maximal exchange-free
+physical subtree; partitions already execute as device programs inside it).
+The control-plane classes are the contract for the multi-host DCN backend.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from ..physical.operators import PhysicalPlan
+from .context import ExecContext
+
+
+# ---------------------------------------------------------------------------
+# Stage graph
+# ---------------------------------------------------------------------------
+
+@dataclass
+class Stage:
+    stage_id: int
+    root: PhysicalPlan           # subtree with exchanges as leaves
+    parents: list["Stage"] = field(default_factory=list)
+    attempts: int = 0
+    result: list | None = None   # materialized partitions
+
+    def __hash__(self):
+        return self.stage_id
+
+
+def build_stage_graph(plan: PhysicalPlan) -> tuple[Stage, list[Stage]]:
+    """Cut the physical plan at exchange boundaries
+    (DAGScheduler.createShuffleMapStage role). Each stage's root is an
+    exchange (shuffle/broadcast "map stage") or the result subtree; nested
+    exchanges become _StageOutput leaves wired to parent stages."""
+    from ..physical.exchange import BroadcastExchangeExec, ShuffleExchangeExec
+
+    counter = [0]
+    stages: list[Stage] = []
+
+    def convert(node: PhysicalPlan, parent_list: list[Stage]) -> PhysicalPlan:
+        if isinstance(node, (ShuffleExchangeExec, BroadcastExchangeExec)):
+            sub_parents: list[Stage] = []
+            new_child = convert(node.child, sub_parents)
+            counter[0] += 1
+            st = Stage(counter[0], node.with_new_children([new_child]),
+                       sub_parents)
+            stages.append(st)
+            parent_list.append(st)
+            return _StageOutput(st, node.output)
+        return node.map_children(lambda c: convert(c, parent_list))
+
+    root_parents: list[Stage] = []
+    root_plan = convert(plan, root_parents)
+    counter[0] += 1
+    result_stage = Stage(counter[0], root_plan, root_parents)
+    stages.append(result_stage)
+    return result_stage, stages
+
+
+class _StageOutput(PhysicalPlan):
+    """Leaf standing for a parent stage's materialized output."""
+
+    child_fields = ()
+
+    def __init__(self, stage: Stage, attrs):
+        self.stage = stage
+        self.attrs = attrs
+
+    @property
+    def output(self):
+        return self.attrs
+
+    def output_partitioning(self):
+        from ..physical.partitioning import UnknownPartitioning
+
+        n = len(self.stage.result) if self.stage.result is not None else 1
+        return UnknownPartitioning(n)
+
+    def execute(self, ctx):
+        assert self.stage.result is not None, \
+            f"parent stage {self.stage.stage_id} not materialized"
+        return self.stage.result
+
+    def simple_string(self):
+        return f"StageOutput(#{self.stage.stage_id})"
+
+
+class DAGScheduler:
+    """Runs a stage graph with per-stage retry (stage = unit of recovery;
+    deterministic re-execution replays the subtree, the lineage property
+    the reference relies on)."""
+
+    def __init__(self, ctx: ExecContext, max_attempts: int = 2,
+                 listener_bus=None):
+        self.ctx = ctx
+        self.max_attempts = max_attempts
+        self.bus = listener_bus
+
+    def run(self, plan: PhysicalPlan) -> list:
+        result_stage, stages = build_stage_graph(plan)
+        done: set[int] = set()
+
+        def submit(stage: Stage) -> None:
+            if stage.stage_id in done:
+                return
+            for p in stage.parents:
+                submit(p)
+            last_err: Exception | None = None
+            for attempt in range(self.max_attempts):
+                stage.attempts = attempt + 1
+                try:
+                    self._post("stageSubmitted", stage)
+                    t0 = time.perf_counter()
+                    stage.result = stage.root.execute(self.ctx)
+                    self.ctx.metrics.add("scheduler.stages_completed")
+                    self._post("stageCompleted", stage,
+                               dur=(time.perf_counter() - t0) * 1000)
+                    done.add(stage.stage_id)
+                    return
+                except Exception as e:  # deterministic retry (lineage)
+                    last_err = e
+                    self.ctx.metrics.add("scheduler.stage_retries")
+                    self._post("stageFailed", stage, error=str(e))
+            raise last_err  # noqa: B904
+
+        submit(result_stage)
+        return result_stage.result
+
+    def _post(self, kind: str, stage: Stage, dur=None, error=None):
+        if self.bus is None:
+            return
+        from .listener import QueryEvent
+
+        self.bus.post(QueryEvent(
+            kind, f"stage-{stage.stage_id}", time.time(),
+            duration_ms=dur, error=error,
+            metrics={"attempt": stage.attempts}))
+
+
+# ---------------------------------------------------------------------------
+# Control plane (multi-host contract)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ExecutorInfo:
+    executor_id: str
+    host: str
+    slots: int
+    last_heartbeat: float = field(default_factory=time.time)
+    failures: int = 0
+    excluded: bool = False
+
+
+class ExecutorRegistry:
+    """Executor registration + heartbeat expiry
+    (CoarseGrainedSchedulerBackend + HeartbeatReceiver roles)."""
+
+    def __init__(self, heartbeat_timeout_s: float = 120.0):
+        self.timeout = heartbeat_timeout_s
+        self._executors: dict[str, ExecutorInfo] = {}
+        self._lock = threading.Lock()
+
+    def register(self, host: str, slots: int = 1) -> str:
+        eid = f"exec-{uuid.uuid4().hex[:8]}"
+        with self._lock:
+            self._executors[eid] = ExecutorInfo(eid, host, slots)
+        return eid
+
+    def heartbeat(self, executor_id: str) -> bool:
+        with self._lock:
+            e = self._executors.get(executor_id)
+            if e is None:
+                return False  # reference: executor told to re-register
+            e.last_heartbeat = time.time()
+            return True
+
+    def expire_dead(self) -> list[str]:
+        now = time.time()
+        dead = []
+        with self._lock:
+            for eid, e in list(self._executors.items()):
+                if now - e.last_heartbeat > self.timeout:
+                    dead.append(eid)
+                    del self._executors[eid]
+        return dead
+
+    def alive(self) -> list[ExecutorInfo]:
+        with self._lock:
+            return [e for e in self._executors.values() if not e.excluded]
+
+
+class HealthTracker:
+    """Excludelist on repeated failures (HealthTracker.scala:52)."""
+
+    def __init__(self, registry: ExecutorRegistry,
+                 max_failures: int = 2):
+        self.registry = registry
+        self.max_failures = max_failures
+
+    def record_failure(self, executor_id: str) -> bool:
+        """Returns True if the executor is now excluded."""
+        with self.registry._lock:
+            e = self.registry._executors.get(executor_id)
+            if e is None:
+                return True
+            e.failures += 1
+            if e.failures >= self.max_failures:
+                e.excluded = True
+                return True
+        return False
+
+
+class BarrierCoordinator:
+    """allGather/barrier for gang-scheduled host phases
+    (core/BarrierTaskContext.scala barrier()/allGather())."""
+
+    def __init__(self, num_tasks: int):
+        self.num_tasks = num_tasks
+        self._barrier = threading.Barrier(num_tasks)
+        self._messages: dict[int, object] = {}
+        self._lock = threading.Lock()
+
+    def barrier(self, task_id: int, timeout: float = 60.0) -> None:
+        self._barrier.wait(timeout)
+
+    def all_gather(self, task_id: int, message,
+                   timeout: float = 60.0) -> list:
+        with self._lock:
+            self._messages[task_id] = message
+        self._barrier.wait(timeout)
+        with self._lock:
+            out = [self._messages[i] for i in sorted(self._messages)]
+        self._barrier.wait(timeout)
+        with self._lock:
+            self._messages.pop(task_id, None)
+        return out

@@ -53,11 +53,22 @@ def apply_merge_groups(parts: list, groups: list[list[int]]) -> list:
     return [[b for i in g for b in parts[i]] for g in groups]
 
 
+def _effective_child(plan_child):
+    """See through scheduler stage boundaries (exec/scheduler.py
+    _StageOutput) to the exchange that produced the partitions."""
+    from ..exec.scheduler import _StageOutput
+
+    if isinstance(plan_child, _StageOutput):
+        return plan_child.stage.root
+    return plan_child
+
+
 def coalesce_after_exchange(plan_child, parts: list, ctx: ExecContext,
                             output_attrs) -> list:
     """Coalesce a single exchange's output for a blocking consumer."""
     from .exchange import ShuffleExchangeExec
 
+    plan_child = _effective_child(plan_child)
     if not isinstance(plan_child, ShuffleExchangeExec):
         return parts
     if not (ctx.conf.get(ADAPTIVE_ENABLED)
@@ -83,6 +94,8 @@ def coalesce_join_inputs(left_child, right_child, left_parts: list,
     """Coordinated coalescing for co-partitioned join inputs."""
     from .exchange import ShuffleExchangeExec
 
+    left_child = _effective_child(left_child)
+    right_child = _effective_child(right_child)
     if not (isinstance(left_child, ShuffleExchangeExec)
             and isinstance(right_child, ShuffleExchangeExec)):
         return left_parts, right_parts

@@ -1,0 +1,131 @@
+"""Scheduler & control-plane tests (reference: DAGSchedulerSuite drives the
+event loop with a mock TaskScheduler — here the stage graph + retry logic
+are driven directly; SURVEY.md §4)."""
+
+import time
+
+import pyarrow as pa
+import pytest
+
+import spark_tpu.api.functions as F
+from spark_tpu.exec.context import ExecContext
+from spark_tpu.exec.scheduler import (
+    BarrierCoordinator, DAGScheduler, ExecutorRegistry, HealthTracker,
+    build_stage_graph,
+)
+
+
+def test_stage_graph_cuts_at_exchanges(spark):
+    df = (spark.range(0, 1000, 1, 4)
+          .groupBy((F.col("id") % 7).alias("m"))
+          .agg(F.count("*").alias("c")))
+    plan = df.query_execution.physical
+    result_stage, stages = build_stage_graph(plan)
+    # one shuffle (partial→final agg) + result stage
+    assert len(stages) == 2
+    assert result_stage.parents[0] in stages
+
+
+def test_stage_graph_join(spark):
+    spark.conf.set("spark.sql.autoBroadcastJoinThreshold", -1)
+    try:
+        a = spark.range(0, 100, 1, 2).withColumn("k", F.col("id") % 10)
+        b = spark.range(0, 50, 1, 2).withColumn("k", F.col("id") % 10)
+        df = a.join(b, on="k")
+        _, stages = build_stage_graph(df.query_execution.physical)
+        assert len(stages) == 3  # two shuffle stages + result
+    finally:
+        spark.conf.unset("spark.sql.autoBroadcastJoinThreshold")
+
+
+def test_scheduler_results_match_direct(spark):
+    df = (spark.range(0, 5000, 1, 8)
+          .groupBy((F.col("id") % 13).alias("m"))
+          .agg(F.sum("id").alias("s")).orderBy("m"))
+    out = df.toArrow().to_pydict()
+    assert len(out["m"]) == 13
+    assert sum(out["s"]) == sum(range(5000))
+    snap = spark._metrics.snapshot()
+    assert snap["counters"]["scheduler.stages_completed"] > 0
+
+
+def test_stage_retry():
+    from spark_tpu.physical.operators import PhysicalPlan
+
+    calls = [0]
+
+    class Flaky(PhysicalPlan):
+        child_fields = ()
+
+        @property
+        def output(self):
+            return []
+
+        def execute(self, ctx):
+            calls[0] += 1
+            if calls[0] == 1:
+                raise RuntimeError("transient")
+            return [[]]
+
+    sched = DAGScheduler(ExecContext(), max_attempts=2)
+    out = sched.run(Flaky())
+    assert calls[0] == 2
+    assert out == [[]]
+
+
+def test_stage_retry_exhausted():
+    from spark_tpu.physical.operators import PhysicalPlan
+
+    class Broken(PhysicalPlan):
+        child_fields = ()
+
+        @property
+        def output(self):
+            return []
+
+        def execute(self, ctx):
+            raise RuntimeError("permanent")
+
+    sched = DAGScheduler(ExecContext(), max_attempts=2)
+    with pytest.raises(RuntimeError, match="permanent"):
+        sched.run(Broken())
+
+
+def test_executor_registry_heartbeats():
+    reg = ExecutorRegistry(heartbeat_timeout_s=0.05)
+    e1 = reg.register("host1", 4)
+    e2 = reg.register("host2", 4)
+    assert len(reg.alive()) == 2
+    time.sleep(0.08)
+    reg.heartbeat(e1)
+    dead = reg.expire_dead()
+    assert dead == [e2]
+    assert [e.executor_id for e in reg.alive()] == [e1]
+    assert not reg.heartbeat(e2)  # unknown → must re-register
+
+
+def test_health_tracker_excludes():
+    reg = ExecutorRegistry()
+    e1 = reg.register("host1")
+    ht = HealthTracker(reg, max_failures=2)
+    assert not ht.record_failure(e1)
+    assert ht.record_failure(e1)
+    assert reg.alive() == []
+
+
+def test_barrier_all_gather():
+    import threading
+
+    bc = BarrierCoordinator(3)
+    results = {}
+
+    def task(i):
+        results[i] = bc.all_gather(i, f"msg{i}")
+
+    ts = [threading.Thread(target=task, args=(i,)) for i in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(5)
+    assert results[0] == ["msg0", "msg1", "msg2"]
+    assert results[1] == results[0]
