@@ -225,7 +225,9 @@ class ResolveAliases(Rule):
                         for e in node.project_list):
                     return node.copy(project_list=[_auto_alias(e)
                                                    for e in node.project_list])
-            if isinstance(node, Aggregate):
+            from .logical import GroupingSets
+
+            if isinstance(node, (Aggregate, GroupingSets)):
                 if node.expressions_resolved and any(
                         not isinstance(e, (Alias, AttributeReference, UnresolvedStar))
                         for e in node.aggregate_exprs):

@@ -425,6 +425,50 @@ class Join(BinaryNode):
         return max(l, r)
 
 
+class Intersect(BinaryNode):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 is_all: bool = False):
+        self.left = left
+        self.right = right
+        self.is_all = is_all
+
+    @property
+    def output(self):
+        return self.left.output
+
+
+class Except(BinaryNode):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 is_all: bool = False):
+        self.left = left
+        self.right = right
+        self.is_all = is_all
+
+    @property
+    def output(self):
+        return self.left.output
+
+
+class GroupingSets(UnaryNode):
+    """GROUP BY ROLLUP/CUBE/GROUPING SETS — rewritten post-resolution into a
+    Union of Aggregates (the reference lowers via Expand,
+    sqlcat/analysis/ResolveGroupingAnalytics). `sets` holds INDICES into
+    grouping_exprs so resolution machinery sees one expression list."""
+
+    def __init__(self, sets: Sequence[Sequence[int]],
+                 grouping_exprs: Sequence[Expression],
+                 aggregate_exprs: Sequence[Expression], child: LogicalPlan):
+        self.sets = [list(s) for s in sets]
+        self.grouping_exprs = list(grouping_exprs)
+        self.aggregate_exprs = list(aggregate_exprs)
+        self.child = child
+
+    @property
+    def output(self):
+        return Aggregate(self.grouping_exprs, self.aggregate_exprs,
+                         self.child).output
+
+
 class Union(LogicalPlan):
     child_fields = ("children_plans",)
 

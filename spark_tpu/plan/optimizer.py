@@ -661,6 +661,101 @@ class RewriteDistinctAggregates(Rule):
         return plan.transform_up(rule)
 
 
+class ReplaceSetOps(Rule):
+    """INTERSECT → semi join + distinct; EXCEPT → anti join + distinct
+    (reference: ReplaceIntersectWithSemiJoin / ReplaceExceptWithAntiJoin).
+    Null-safe equality per column."""
+
+    def apply(self, plan):
+        from ..expr.expressions import EqualNullSafe
+        from .logical import Except, Intersect
+
+        def rule(node):
+            if isinstance(node, (Intersect, Except)) and node.resolved:
+                # null-safe equality expressed as plain equi keys so the hash
+                # join kernel applies: (isnull(l)=isnull(r)) AND
+                # (coalesce(l,d)=coalesce(r,d))
+                cond = None
+                for l, r in zip(node.left.output, node.right.output):
+                    for c in _null_safe_eq_conjuncts(l, r):
+                        cond = c if cond is None else And(cond, c)
+                jt = "left_semi" if isinstance(node, Intersect) else "left_anti"
+                return Distinct(Join(node.left, node.right, jt, cond))
+            return node
+
+        return plan.transform_up(rule)
+
+
+def _null_safe_eq_conjuncts(l: Expression, r: Expression) -> list[Expression]:
+    from ..expr.expressions import Coalesce, IsNull
+    from ..types import (
+        BooleanType, DateType, NumericType, StringType, TimestampType,
+    )
+
+    if not (l.nullable or r.nullable):
+        return [EqualTo(l, r)]
+    dt = l.dtype
+    if isinstance(dt, StringType):
+        d = Literal("")
+    elif isinstance(dt, BooleanType):
+        d = Literal(False)
+    elif isinstance(dt, (NumericType, DateType, TimestampType)):
+        d = Literal(0)
+    else:
+        d = Literal(0)
+    from ..expr.expressions import cast_if
+
+    d = cast_if(d, dt)
+    return [EqualTo(IsNull(l), IsNull(r)),
+            EqualTo(Coalesce([l, d]), Coalesce([r, d]))]
+
+
+class ExpandGroupingSets(Rule):
+    """GroupingSets → Union of per-set Aggregates with NULL fills for the
+    grouping keys absent from each set."""
+
+    def apply(self, plan):
+        from .logical import GroupingSets
+
+        def rule(node):
+            if not isinstance(node, GroupingSets) or not node.resolved:
+                return node
+            branches = []
+            for si, idxs in enumerate(node.sets):
+                keys = [node.grouping_exprs[i] for i in idxs]
+                out_exprs: list[Expression] = []
+                for e in node.aggregate_exprs:
+                    out_exprs.append(self._fill(e, keys, node.grouping_exprs,
+                                                si))
+                branches.append(Aggregate(list(keys), out_exprs, node.child))
+            return Union(branches) if len(branches) > 1 else branches[0]
+
+        return plan.transform_up(rule)
+
+    def _fill(self, e: Expression, keys, all_keys, set_index: int):
+        from ..expr.expressions import Cast
+
+        def in_set(x):
+            return any(x.semantic_equals(k) for k in keys)
+
+        def rule(x):
+            if any(x.semantic_equals(g) for g in all_keys) and not in_set(x):
+                return Cast(Literal(None), x.dtype)
+            return x
+
+        if isinstance(e, Alias):
+            filled = e.child.transform_down(rule)
+            return Alias(filled, e.name,
+                         e.expr_id if set_index == 0 else None)
+        if isinstance(e, AttributeReference):
+            if any(e.semantic_equals(g) for g in all_keys) and not in_set(e):
+                return Alias(Cast(Literal(None), e.dtype), e.name,
+                             e.expr_id if set_index == 0 else None)
+            return e if set_index == 0 else Alias(
+                e, e.name)
+        return e
+
+
 class ReplaceDistinct(Rule):
     def apply(self, plan):
         def rule(node):
@@ -740,6 +835,8 @@ class Optimizer(RuleExecutor):
         return [
             Batch("Finish analysis", Once(), [
                 EliminateSubqueryAliases(),
+                ReplaceSetOps(),
+                ExpandGroupingSets(),
                 ReplaceDistinct(),
                 RewriteDistinctAggregates(),
             ]),

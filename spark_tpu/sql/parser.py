@@ -205,17 +205,22 @@ class Parser:
 
     def parse_set_expr(self) -> L.LogicalPlan:
         left = self.parse_term_query()
-        while self.at_kw("union"):
-            self.next()
+        while self.at_kw("union", "intersect", "minus", "except"):
+            op = self.next().value.lower()
             distinct = True
             if self.eat_kw("all"):
                 distinct = False
             else:
                 self.eat_kw("distinct")
             right = self.parse_term_query()
-            left = L.Union([left, right])
-            if distinct:
-                left = L.Distinct(left)
+            if op == "union":
+                left = L.Union([left, right])
+                if distinct:
+                    left = L.Distinct(left)
+            elif op == "intersect":
+                left = L.Intersect(left, right)
+            else:  # except / minus
+                left = L.Except(left, right)
         return left
 
     def parse_term_query(self) -> L.LogicalPlan:
@@ -284,12 +289,56 @@ class Parser:
             plan = L.Filter(self.parse_expr(), plan)
 
         group_exprs = None
+        grouping_sets: list[list[int]] | None = None
         if self.at_kw("group"):
             self.next()
             self.expect_kw("by")
-            group_exprs = [self.parse_expr()]
-            while self.eat_op(","):
-                group_exprs.append(self.parse_expr())
+            if self.at_kw("rollup", "cube"):
+                kind = self.next().value.lower()
+                self.expect_op("(")
+                group_exprs = [self.parse_expr()]
+                while self.eat_op(","):
+                    group_exprs.append(self.parse_expr())
+                self.expect_op(")")
+                n = len(group_exprs)
+                if kind == "rollup":
+                    grouping_sets = [list(range(n - i)) for i in range(n + 1)]
+                else:  # cube: all subsets
+                    import itertools as _it
+
+                    grouping_sets = [list(c) for k in range(n, -1, -1)
+                                     for c in _it.combinations(range(n), k)]
+            elif self.at_kw("grouping"):
+                self.next()
+                if self.peek().value.lower() != "sets":
+                    raise ParseException("expected SETS after GROUPING")
+                self.next()
+                self.expect_op("(")
+                group_exprs = []
+                grouping_sets = []
+                index: dict[str, int] = {}
+                while True:
+                    self.expect_op("(")
+                    one: list[int] = []
+                    if not self.at_op(")"):
+                        while True:
+                            e = self.parse_expr()
+                            key = e.simple_string()
+                            if key not in index:
+                                index[key] = len(group_exprs)
+                                group_exprs.append(e)
+                            one.append(index[key])
+                            if not self.eat_op(","):
+                                break
+                    self.expect_op(")")
+                    grouping_sets.append(one)
+                    if not self.eat_op(","):
+                        break
+                self.expect_op(")")
+            else:
+                group_exprs = [self.parse_expr()]
+                while self.eat_op(","):
+                    group_exprs.append(self.parse_expr())
 
         having = None
         if self.eat_kw("having"):
@@ -310,7 +359,11 @@ class Parser:
                         tgt.child if isinstance(tgt, E.Alias) else tgt)
                 else:
                     resolved_groups.append(g)
-            plan = L.Aggregate(resolved_groups, list(select_list), plan)
+            if grouping_sets is not None:
+                plan = L.GroupingSets(grouping_sets, resolved_groups,
+                                      list(select_list), plan)
+            else:
+                plan = L.Aggregate(resolved_groups, list(select_list), plan)
             if having is not None:
                 plan = L.Filter(having, plan)
         else:

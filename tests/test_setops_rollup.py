@@ -1,0 +1,71 @@
+"""Set operations and grouping analytics tests."""
+
+import pyarrow as pa
+import pytest
+
+
+@pytest.fixture()
+def nums(spark):
+    spark.createDataFrame(pa.table({"x": [1, 2, 3, 4]})) \
+        .createOrReplaceTempView("ta")
+    spark.createDataFrame(pa.table({"x": [3, 4, 5]})) \
+        .createOrReplaceTempView("tb")
+    spark.createDataFrame(pa.table({
+        "region": ["w", "w", "e", "e", "e"],
+        "product": ["p1", "p2", "p1", "p1", "p2"],
+        "amount": [10, 20, 30, 40, 50],
+    })).createOrReplaceTempView("sales_r")
+    return spark
+
+
+def q(spark, text):
+    return spark.sql(text).toArrow().to_pydict()
+
+
+def test_intersect(nums):
+    out = q(nums, "SELECT x FROM ta INTERSECT SELECT x FROM tb ORDER BY x")
+    assert out["x"] == [3, 4]
+
+
+def test_except(nums):
+    out = q(nums, "SELECT x FROM ta EXCEPT SELECT x FROM tb ORDER BY x")
+    assert out["x"] == [1, 2]
+
+
+def test_minus_alias(nums):
+    out = q(nums, "SELECT x FROM ta MINUS SELECT x FROM tb ORDER BY x")
+    assert out["x"] == [1, 2]
+
+
+def test_rollup(nums):
+    out = q(nums, """
+        SELECT region, product, sum(amount) AS s
+        FROM sales_r GROUP BY ROLLUP(region, product)
+        ORDER BY region NULLS LAST, product NULLS LAST""")
+    rows = list(zip(out["region"], out["product"], out["s"]))
+    assert (None, None, 150) in rows           # grand total
+    assert ("e", None, 120) in rows            # region subtotal
+    assert ("w", None, 30) in rows
+    assert ("e", "p1", 70) in rows             # leaf
+    assert len(rows) == 4 + 2 + 1              # leaves + regions + total
+
+
+def test_cube(nums):
+    out = q(nums, """
+        SELECT region, product, sum(amount) AS s
+        FROM sales_r GROUP BY CUBE(region, product)""")
+    rows = set(zip(out["region"], out["product"], out["s"]))
+    assert (None, "p1", 80) in rows            # product subtotal (cube only)
+    assert (None, "p2", 70) in rows
+    assert (None, None, 150) in rows
+    assert len(rows) == 4 + 2 + 2 + 1
+
+
+def test_grouping_sets(nums):
+    out = q(nums, """
+        SELECT region, product, sum(amount) AS s
+        FROM sales_r GROUP BY GROUPING SETS ((region), (product))""")
+    rows = set(zip(out["region"], out["product"], out["s"]))
+    assert ("w", None, 30) in rows
+    assert (None, "p1", 80) in rows
+    assert len(rows) == 2 + 2
