@@ -115,3 +115,40 @@ def coalesce_join_inputs(left_child, right_child, left_parts: list,
                     len(left_parts) - len(groups))
     return (apply_merge_groups(left_parts, groups),
             apply_merge_groups(right_parts, groups))
+
+
+def split_skewed_join_inputs(left_parts: list, right_parts: list,
+                             ctx: ExecContext, join_type: str,
+                             skew_factor: float = 4.0):
+    """Split skewed PROBE-side partitions, duplicating the build side
+    (reference: OptimizeSkewedJoin.scala:57 — same idea at batch
+    granularity: probe rows may be split freely for inner/left joins since
+    every probe row still sees the full matching build partition)."""
+    from ..config import SKEW_JOIN_ENABLED
+
+    if not ctx.conf.get(SKEW_JOIN_ENABLED):
+        return left_parts, right_parts
+    if join_type not in ("inner", "left_outer", "left_semi", "left_anti"):
+        return left_parts, right_parts
+    sizes = [_partition_rows(p) for p in left_parts]
+    nonzero = sorted(s for s in sizes if s) or [0]
+    median = nonzero[len(nonzero) // 2]
+    if median == 0:
+        return left_parts, right_parts
+    threshold = max(median * skew_factor, 1)
+    out_l, out_r = [], []
+    split_any = False
+    for lp, rp, s in zip(left_parts, right_parts, sizes):
+        if s > threshold and len(lp) > 1:
+            k = min(len(lp), max(2, int(s // threshold) + 1))
+            per = -(-len(lp) // k)
+            for start in range(0, len(lp), per):
+                out_l.append(lp[start:start + per])
+                out_r.append(rp)
+                split_any = True
+        else:
+            out_l.append(lp)
+            out_r.append(rp)
+    if split_any:
+        ctx.metrics.add("aqe.skew_splits", len(out_l) - len(left_parts))
+    return out_l, out_r

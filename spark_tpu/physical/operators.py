@@ -406,6 +406,32 @@ class HashAggregateExec(PhysicalPlan):
         return [[self._aggregate_partition(part, ctx)] for part in parts]
 
     def _aggregate_partition(self, part: Partition, ctx) -> ColumnarBatch:
+        """Aggregate one partition. Partitions larger than the blockwise
+        threshold fold incrementally — partial-agg each chunk, then agg the
+        accumulated partials — bounding HBM like the reference's
+        sort-based spill fallback (TungstenAggregationIterator), but with
+        associative merges instead of disk (SURVEY.md §7 'Hard parts' (3))."""
+        max_rows = int(ctx.conf.get("spark.tpu.agg.blockRows", 1 << 22))
+        if len(part) > 1 and sum(b.capacity for b in part) > max_rows \
+                and self.grouping:
+            acc: list[ColumnarBatch] = []
+            chunk: list[ColumnarBatch] = []
+            cap_sum = 0
+            for b in part:
+                chunk.append(b)
+                cap_sum += b.capacity
+                if cap_sum >= max_rows:
+                    acc.append(self._aggregate_chunk(chunk, ctx))
+                    chunk, cap_sum = [], 0
+            if chunk:
+                acc.append(self._aggregate_chunk(chunk, ctx))
+            # merge accumulated partials (buffer schema) with final-mode ops
+            merger = HashAggregateExec(self.grouping, self.specs, "final",
+                                       _SchemaOnly(self.output))
+            return merger._aggregate_chunk(acc, ctx)
+        return self._aggregate_chunk(part, ctx)
+
+    def _aggregate_chunk(self, part: Partition, ctx) -> ColumnarBatch:
         jnp = _jnp()
         batch = concat_batches(part, attrs_schema(self.child.output))
         cap = batch.capacity
@@ -477,6 +503,20 @@ class HashAggregateExec(PhysicalPlan):
 # ---------------------------------------------------------------------------
 # Sort / Limit
 # ---------------------------------------------------------------------------
+
+class _SchemaOnly(PhysicalPlan):
+    """Placeholder child carrying only an output schema (blockwise-agg
+    merge step)."""
+
+    child_fields = ()
+
+    def __init__(self, attrs):
+        self.attrs = list(attrs)
+
+    @property
+    def output(self):
+        return self.attrs
+
 
 class SortExec(PhysicalPlan):
     """In-partition sort (role of sqlx/SortExec.scala:39). Orders must be
@@ -658,9 +698,13 @@ class HashJoinExec(PhysicalPlan):
             bp = right_parts[0]
             right_parts = [bp for _ in left_parts]
         else:
+            from .adaptive import split_skewed_join_inputs
+
             left_parts, right_parts = coalesce_join_inputs(
                 self.left, self.right, left_parts, right_parts, ctx,
                 self.left.output, self.right.output)
+            left_parts, right_parts = split_skewed_join_inputs(
+                left_parts, right_parts, ctx, self.join_type)
         if len(left_parts) != len(right_parts):
             raise ExecutionError(
                 f"join children partition counts differ: "

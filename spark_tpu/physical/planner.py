@@ -304,6 +304,22 @@ class Planner:
         if isinstance(inner, L.Offset):
             offset = inner.n
             inner = inner.child
+        # TopK: ORDER BY + LIMIT → per-partition sort+limit, gather, final
+        # sort+limit (reference: TakeOrderedAndProjectExec) — avoids the
+        # full range-partitioned global sort
+        if isinstance(inner, L.Sort) and inner.is_global and all(
+                isinstance(o.child, AttributeReference)
+                for o in inner.orders):
+            child = self._convert(inner.child)
+            child_ids = {a.expr_id for a in child.output}
+            if all(o.child.expr_id in child_ids for o in inner.orders):
+                orders = [SortOrder(o.child, o.ascending, o.nulls_first)
+                          for o in inner.orders]
+                local = LimitExec(node.n + offset,
+                                  SortExec(orders, child))
+                gathered = ShuffleExchangeExec(SinglePartition(), local)
+                return LimitExec(node.n, SortExec(orders, gathered),
+                                 offset=offset, is_global=True)
         child = self._convert(inner)
         local = LimitExec(node.n + offset, child, is_global=False)
         return LimitExec(node.n, local, offset=offset, is_global=True)
