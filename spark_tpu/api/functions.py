@@ -105,6 +105,49 @@ def var_pop(c) -> Column:
     return Column(E.VariancePop(_c(c)))
 
 
+def corr(a, b) -> Column:
+    from ..expr import agg_compound as AC
+
+    return Column(AC.corr(_c(a), _c(b)))
+
+
+def covar_samp(a, b) -> Column:
+    from ..expr import agg_compound as AC
+
+    return Column(AC.covar_samp(_c(a), _c(b)))
+
+
+def covar_pop(a, b) -> Column:
+    from ..expr import agg_compound as AC
+
+    return Column(AC.covar_pop(_c(a), _c(b)))
+
+
+def skewness(c) -> Column:
+    from ..expr import agg_compound as AC
+
+    return Column(AC.skewness(_c(c)))
+
+
+def kurtosis(c) -> Column:
+    from ..expr import agg_compound as AC
+
+    return Column(AC.kurtosis(_c(c)))
+
+
+def approx_count_distinct(c, rsd=None) -> Column:
+    return Column(E.Count(_c(c), distinct=True))
+
+
+def sum_distinct(c) -> Column:
+    e = E.Sum(_c(c))
+    e.distinct = True
+    return Column(e)
+
+
+sumDistinct = sum_distinct
+
+
 # --- conditionals -----------------------------------------------------------
 
 def when(cond: Column, value) -> Column:

@@ -30,8 +30,15 @@ _init_lock = threading.Lock()
 
 def _init_jax():
     """Enable x64 (int64 sums/hashes; XLA emulates on TPU with int32 pairs —
-    SURVEY.md §7 'Hard parts' (6)) exactly once, before any tracing."""
+    SURVEY.md §7 'Hard parts' (6)) exactly once, before any tracing. Also
+    raises the recursion limit — expression-tree recursion uses several
+    frames per node (the reference raises JVM stack size for Catalyst for
+    the same reason)."""
     global _jax_initialized
+    import sys
+
+    if sys.getrecursionlimit() < 20000:
+        sys.setrecursionlimit(20000)
     with _init_lock:
         if _jax_initialized:
             return

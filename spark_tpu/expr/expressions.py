@@ -136,6 +136,9 @@ class Literal(Expression):
     def foldable(self) -> bool:
         return True
 
+    def _data_args(self) -> tuple:
+        return (("value", self.value), ("dtype", str(self._dtype)))
+
     def eval(self, ctx: EvalCtx) -> Val:
         jnp = _jnp()
         dt = self._dtype
@@ -733,6 +736,97 @@ class Log10(_MathUnary):
     domain_check = staticmethod(lambda x: x > 0)
 
 
+class Sin(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().sin(x))
+
+
+class Cos(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().cos(x))
+
+
+class Tan(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().tan(x))
+
+
+class Asin(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().arcsin(x))
+    domain_check = staticmethod(lambda x: _jnp().abs(x) <= 1)
+
+
+class Acos(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().arccos(x))
+    domain_check = staticmethod(lambda x: _jnp().abs(x) <= 1)
+
+
+class Atan(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().arctan(x))
+
+
+class Sinh(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().sinh(x))
+
+
+class Cosh(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().cosh(x))
+
+
+class Tanh(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().tanh(x))
+
+
+class Log2(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().log2(x))
+    domain_check = staticmethod(lambda x: x > 0)
+
+
+class Log1p(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().log1p(x))
+    domain_check = staticmethod(lambda x: x > -1)
+
+
+class Expm1(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().expm1(x))
+
+
+class Degrees(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().degrees(x))
+
+
+class Radians(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().radians(x))
+
+
+class Cbrt(_MathUnary):
+    fn = staticmethod(lambda x: _jnp().cbrt(x))
+
+
+class Atan2(BinaryArithmetic):
+    symbol = "atan2"
+
+    def _result_type(self, ct):
+        return float64
+
+    def _align(self, ctx, l, r, out):
+        return (cast_val(ctx, l, float64).data, cast_val(ctx, r, float64).data)
+
+    def _op(self, l, r):
+        return _jnp().arctan2(l, r), None
+
+
+class Signum(UnaryExpression):
+    @property
+    def dtype(self):
+        return float64
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        if not ctx.is_trace:
+            return Val(float64, None, c.validity, None)
+        jnp = _jnp()
+        return Val(float64, jnp.sign(c.data.astype(jnp.float64)),
+                   c.validity, None)
+
+
 class Floor(UnaryExpression):
     @property
     def dtype(self):
@@ -1110,6 +1204,7 @@ class If(Expression):
 
 class CaseWhen(Expression):
     child_fields = ("branch_exprs", "else_expr")
+    equality_excluded_fields = ("branches",)  # same nodes as branch_exprs
 
     def __init__(self, branches: Sequence[tuple[Expression, Expression]],
                  else_expr: Expression | None = None):
@@ -1602,6 +1697,133 @@ class Length(UnaryExpression):
                    c.validity, None)
 
 
+class Initcap(_DictTransform):
+    def transform(self, s):
+        return " ".join(w[:1].upper() + w[1:].lower() if w else w
+                        for w in s.split(" "))
+
+
+class Reverse(_DictTransform):
+    def transform(self, s):
+        return s[::-1]
+
+
+class Repeat(_DictTransform):
+    def __init__(self, child, n: Expression):
+        super().__init__(child)
+        self.n = int(n.value)
+
+    def transform(self, s):
+        return s * self.n
+
+
+class SubstringIndex(_DictTransform):
+    def __init__(self, child, delim: Expression, count: Expression):
+        super().__init__(child)
+        self.delim = str(delim.value)
+        self.count = int(count.value)
+
+    def transform(self, s):
+        parts = s.split(self.delim)
+        if self.count > 0:
+            return self.delim.join(parts[: self.count])
+        if self.count < 0:
+            return self.delim.join(parts[self.count:])
+        return ""
+
+
+class Translate(_DictTransform):
+    def __init__(self, child, matching: Expression, replace: Expression):
+        super().__init__(child)
+        self.table = str.maketrans(
+            str(matching.value),
+            str(replace.value).ljust(len(str(matching.value)))[
+                : len(str(matching.value))])
+
+    def transform(self, s):
+        return s.translate(self.table)
+
+
+class _StringIntLut(Expression):
+    """String function producing an integer per dictionary entry."""
+
+    child_fields = ("child",)
+
+    def __init__(self, child: Expression):
+        self.child = child
+
+    @property
+    def dtype(self):
+        return int32
+
+    def int_of(self, s: str) -> int:
+        raise NotImplementedError
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        jnp = _jnp()
+
+        def make_lut():
+            sd = c.sdict or StringDict([""])
+            return np.array([self.int_of(v) for v in (sd.values or [""])],
+                            np.int32)
+
+        if not ctx.is_trace:
+            ctx.aux(make_lut)
+            return Val(int32, None, c.validity, None)
+        lut = ctx.aux(None)
+        return Val(int32, jnp.take(lut, jnp.clip(c.data, 0, lut.shape[0] - 1)),
+                   c.validity, None)
+
+
+class Ascii(_StringIntLut):
+    def int_of(self, s):
+        return ord(s[0]) if s else 0
+
+
+class Instr(_StringIntLut):
+    def __init__(self, child, sub: Expression):
+        super().__init__(child)
+        self.sub = str(sub.value)
+
+    def int_of(self, s):
+        return s.find(self.sub) + 1  # 1-based; 0 = not found
+
+
+class ConcatWs(Expression):
+    child_fields = ("args",)
+
+    def __init__(self, sep: Expression, args: Sequence[Expression]):
+        self.sep = str(sep.value)
+        self.args = list(args)
+
+    @property
+    def dtype(self):
+        return string
+
+    def eval(self, ctx):
+        col_idx = [i for i, a in enumerate(self.args)
+                   if not isinstance(a, Literal)]
+        if len(col_idx) > 1:
+            raise UnsupportedOperationError(
+                "concat_ws over multiple string columns not yet supported")
+        if not col_idx:
+            return Literal(self.sep.join(
+                str(a.value) for a in self.args)).eval(ctx)
+        i = col_idx[0]
+        prefix = self.sep.join(str(a.value) for a in self.args[:i])
+        suffix = self.sep.join(str(a.value) for a in self.args[i + 1:])
+        sep = self.sep
+
+        class _C(_DictTransform):
+            def transform(self, s, _p=prefix, _s=suffix, _sep=sep):
+                mid = s
+                out = mid if not _p else _p + _sep + mid
+                return out if not _s else out + _sep + _s
+
+        return _C(self.args[i]).eval(ctx)
+
+
 # ---------------------------------------------------------------------------
 # Date/time — civil-calendar integer math on device
 # ---------------------------------------------------------------------------
@@ -1797,6 +2019,141 @@ class DateDiff(BinaryExpression):
         if not ctx.is_trace:
             return Val(int32, None, v, None)
         return Val(int32, (l.data - r.data).astype(_jnp().int32), v, None)
+
+
+class Hour(UnaryExpression):
+    @property
+    def dtype(self):
+        return int32
+
+    def eval(self, ctx):
+        c = ctx.eval(cast_if(self.child, timestamp))
+        if not ctx.is_trace:
+            return Val(int32, None, c.validity, None)
+        jnp = _jnp()
+        us_in_day = jnp.mod(c.data, 86_400_000_000)
+        return Val(int32, (us_in_day // 3_600_000_000).astype(jnp.int32),
+                   c.validity, None)
+
+
+class Minute(UnaryExpression):
+    @property
+    def dtype(self):
+        return int32
+
+    def eval(self, ctx):
+        c = ctx.eval(cast_if(self.child, timestamp))
+        if not ctx.is_trace:
+            return Val(int32, None, c.validity, None)
+        jnp = _jnp()
+        us = jnp.mod(c.data, 3_600_000_000)
+        return Val(int32, (us // 60_000_000).astype(jnp.int32),
+                   c.validity, None)
+
+
+class Second(UnaryExpression):
+    @property
+    def dtype(self):
+        return int32
+
+    def eval(self, ctx):
+        c = ctx.eval(cast_if(self.child, timestamp))
+        if not ctx.is_trace:
+            return Val(int32, None, c.validity, None)
+        jnp = _jnp()
+        us = jnp.mod(c.data, 60_000_000)
+        return Val(int32, (us // 1_000_000).astype(jnp.int32),
+                   c.validity, None)
+
+
+class UnixTimestamp(UnaryExpression):
+    @property
+    def dtype(self):
+        return int64
+
+    def eval(self, ctx):
+        c = ctx.eval(cast_if(self.child, timestamp))
+        if not ctx.is_trace:
+            return Val(int64, None, c.validity, None)
+        return Val(int64, _jnp().floor_divide(c.data, 1_000_000),
+                   c.validity, None)
+
+
+class FromUnixtime(UnaryExpression):
+    @property
+    def dtype(self):
+        return timestamp
+
+    def eval(self, ctx):
+        c = ctx.eval(cast_if(self.child, int64))
+        if not ctx.is_trace:
+            return Val(timestamp, None, c.validity, None)
+        return Val(timestamp, c.data * 1_000_000, c.validity, None)
+
+
+class AddMonths(BinaryExpression):
+    @property
+    def dtype(self):
+        return date
+
+    def eval(self, ctx):
+        l = ctx.eval(cast_if(self.left, date))
+        r = ctx.eval(cast_if(self.right, int32))
+        v = ctx.and_valid(l, r)
+        if not ctx.is_trace:
+            return Val(date, None, v, None)
+        jnp = _jnp()
+        y, m, d = _civil_from_days(l.data)
+        total = (y.astype(jnp.int64) * 12 + (m - 1)) + r.data
+        ny = jnp.floor_divide(total, 12).astype(jnp.int32)
+        nm = (jnp.mod(total, 12) + 1).astype(jnp.int32)
+        # clamp day to end of month
+        next_month_total = total + 1
+        nmy = jnp.floor_divide(next_month_total, 12).astype(jnp.int32)
+        nmm = (jnp.mod(next_month_total, 12) + 1).astype(jnp.int32)
+        one = jnp.ones_like(nm)
+        days_in_month = (_days_from_civil(nmy, nmm, one)
+                         - _days_from_civil(ny, nm, one)).astype(jnp.int32)
+        nd = jnp.minimum(d, days_in_month)
+        return Val(date, _days_from_civil(ny, nm, nd), v, None)
+
+
+class LastDay(UnaryExpression):
+    @property
+    def dtype(self):
+        return date
+
+    def eval(self, ctx):
+        c = ctx.eval(cast_if(self.child, date))
+        if not ctx.is_trace:
+            return Val(date, None, c.validity, None)
+        jnp = _jnp()
+        y, m, d = _civil_from_days(c.data)
+        ny = jnp.where(m == 12, y + 1, y)
+        nm = jnp.where(m == 12, 1, m + 1)
+        one = jnp.ones_like(m)
+        return Val(date,
+                   (_days_from_civil(ny, nm, one) - 1).astype(jnp.int32),
+                   c.validity, None)
+
+
+class MonthsBetween(BinaryExpression):
+    @property
+    def dtype(self):
+        return float64
+
+    def eval(self, ctx):
+        l = ctx.eval(cast_if(self.left, date))
+        r = ctx.eval(cast_if(self.right, date))
+        v = ctx.and_valid(l, r)
+        if not ctx.is_trace:
+            return Val(float64, None, v, None)
+        jnp = _jnp()
+        ly, lm, ld = _civil_from_days(l.data)
+        ry, rm, rd = _civil_from_days(r.data)
+        months = (ly - ry) * 12 + (lm - rm)
+        frac = (ld - rd).astype(jnp.float64) / 31.0
+        return Val(float64, months.astype(jnp.float64) + frac, v, None)
 
 
 # ---------------------------------------------------------------------------

@@ -36,13 +36,18 @@ def build_function(name: str, args: Sequence[E.Expression],
         if len(args) == 0 or isinstance(args[0], E.UnresolvedStar):
             return E.Count(None, distinct=False)
         return E.Count(args[0], distinct=distinct)
-    if n in ("sum",) and distinct:
-        raise AnalysisException("sum(distinct) not yet supported")
     b = lookup(n)
     if b is None:
         raise AnalysisException(f"Undefined function: {name}",
                                 error_class="UNRESOLVED_ROUTINE")
-    return b(*args)
+    out = b(*args)
+    if distinct:
+        if isinstance(out, (E.Sum, E.Average)):
+            out.distinct = True  # consumed by RewriteDistinctAggregates
+        else:
+            raise AnalysisException(
+                f"DISTINCT is not supported for {name}")
+    return out
 
 
 def _reg_all() -> None:
@@ -63,6 +68,14 @@ def _reg_all() -> None:
     r("var_samp", lambda c: E.VarianceSamp(c))
     r("var_pop", lambda c: E.VariancePop(c))
     r("collect_set", lambda c: E.CollectSet(c))
+    from . import agg_compound as AC
+
+    r("corr", AC.corr)
+    r("covar_samp", AC.covar_samp)
+    r("covar_pop", AC.covar_pop)
+    r("skewness", AC.skewness)
+    r("kurtosis", AC.kurtosis)
+    r("approx_count_distinct", lambda c, *a: E.Count(c, distinct=True))
     # math
     r("abs", lambda c: E.Abs(c))
     r("sqrt", lambda c: E.Sqrt(c))
@@ -78,6 +91,26 @@ def _reg_all() -> None:
     r("pow", lambda a, b: E.Pow(a, b))
     r("mod", lambda a, b: E.Remainder(a, b))
     r("negative", lambda c: E.UnaryMinus(c))
+    r("sin", lambda c: E.Sin(c))
+    r("cos", lambda c: E.Cos(c))
+    r("tan", lambda c: E.Tan(c))
+    r("asin", lambda c: E.Asin(c))
+    r("acos", lambda c: E.Acos(c))
+    r("atan", lambda c: E.Atan(c))
+    r("atan2", lambda a, b: E.Atan2(a, b))
+    r("sinh", lambda c: E.Sinh(c))
+    r("cosh", lambda c: E.Cosh(c))
+    r("tanh", lambda c: E.Tanh(c))
+    r("log2", lambda c: E.Log2(c))
+    r("log1p", lambda c: E.Log1p(c))
+    r("expm1", lambda c: E.Expm1(c))
+    r("degrees", lambda c: E.Degrees(c))
+    r("radians", lambda c: E.Radians(c))
+    r("cbrt", lambda c: E.Cbrt(c))
+    r("sign", lambda c: E.Signum(c))
+    r("signum", lambda c: E.Signum(c))
+    r("pi", lambda: E.Literal(3.141592653589793))
+    r("e", lambda: E.Literal(2.718281828459045))
     # conditionals
     r("if", lambda p, a, b: E.If(p, a, b))
     r("coalesce", lambda *a: E.Coalesce(list(a)))
@@ -111,6 +144,16 @@ def _reg_all() -> None:
     r("like", lambda c, p: E.Like(c, _lit_str(p)))
     r("rlike", lambda c, p: E.RLike(c, _lit_str(p)))
     r("regexp", lambda c, p: E.RLike(c, _lit_str(p)))
+    r("initcap", lambda c: E.Initcap(c))
+    r("reverse", lambda c: E.Reverse(c))
+    r("repeat", lambda c, n: E.Repeat(c, n))
+    r("substring_index", lambda c, d, n: E.SubstringIndex(c, d, n))
+    r("translate", lambda c, m, rep: E.Translate(c, m, rep))
+    r("ascii", lambda c: E.Ascii(c))
+    r("instr", lambda c, s: E.Instr(c, s))
+    r("locate", lambda s, c, pos=None: E.Instr(c, s))
+    r("position", lambda s, c: E.Instr(c, s))
+    r("concat_ws", lambda sep, *a: E.ConcatWs(sep, list(a)))
     # datetime
     r("year", lambda c: E.Year(c))
     r("month", lambda c: E.Month(c))
@@ -126,6 +169,16 @@ def _reg_all() -> None:
     r("trunc", lambda c, f: E.TruncDate(c, _lit_str(f)))
     r("date_trunc", lambda f, c: E.TruncDate(c, _lit_str(f)))
     r("make_date", lambda y, m, d: E.MakeDate(y, m, d))
+    r("hour", lambda c: E.Hour(c))
+    r("minute", lambda c: E.Minute(c))
+    r("second", lambda c: E.Second(c))
+    r("unix_timestamp", lambda c: E.UnixTimestamp(c))
+    r("from_unixtime", lambda c, fmt=None: E.FromUnixtime(c))
+    r("to_timestamp", lambda c, fmt=None: E.Cast(c, __import__(
+        "spark_tpu.types", fromlist=["timestamp"]).timestamp))
+    r("add_months", lambda d, n: E.AddMonths(d, n))
+    r("months_between", lambda a, b, *x: E.MonthsBetween(a, b))
+    r("last_day", lambda c: E.LastDay(c))
     r("to_date", lambda c, fmt=None: E.Cast(c, __import__(
         "spark_tpu.types", fromlist=["date"]).date))
     # window / ranking

@@ -639,10 +639,15 @@ class RewriteDistinctAggregates(Rule):
             inner = Aggregate(inner_group + [first_child], inner_outs,
                               node.child)
 
-            # outer: original outputs with count(distinct x) → count(x)
+            # outer: original outputs with fn(distinct x) → fn(x)
             def fix(e: Expression) -> Expression:
-                if isinstance(e, Count) and e.distinct:
-                    return Count(x_attr, distinct=False)
+                if isinstance(e, AggregateFunction) and \
+                        getattr(e, "distinct", False):
+                    if isinstance(e, Count):
+                        return Count(x_attr, distinct=False)
+                    out = e.copy(child=x_attr)
+                    out.distinct = False
+                    return out
                 for g, a in group_attr:
                     if e.semantic_equals(g):
                         return a

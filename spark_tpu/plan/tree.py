@@ -148,13 +148,21 @@ class TreeNode:
     transform = transform_up
 
     # --- equality ---------------------------------------------------------
+    # attributes that duplicate child_fields content and must stay out of
+    # equality (comparing them both as data and as children makes equality
+    # traverse shared subtrees twice — exponential on expression DAGs)
+    equality_excluded_fields: tuple[str, ...] = ()
+
     def _data_args(self) -> tuple:
         """Non-child attributes participating in equality. Default: all
         __dict__ entries not in child_fields (best-effort)."""
-        skip = set(self.child_fields) | {"_hash"}
+        skip = set(self.child_fields) | set(self.equality_excluded_fields)
         items = []
         for k in sorted(self.__dict__):
-            if k in skip or k.startswith("__"):
+            # private attrs are caches (_hash, _cast_cache, _pipeline…) —
+            # _cast_cache in particular holds a Cast whose child is THIS
+            # node, which would make equality cyclic
+            if k in skip or k.startswith("_"):
                 continue
             v = self.__dict__[k]
             if isinstance(v, list):

@@ -1,0 +1,114 @@
+"""Extended function library tests (numpy as oracle)."""
+
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_tpu.api.functions as F
+
+
+def q(spark, text):
+    return spark.sql(text).toArrow().to_pydict()
+
+
+def test_trig_and_math(spark):
+    out = q(spark, """SELECT sin(0) AS s, cos(0) AS c, atan2(1, 1) AS a,
+                             log2(8) AS l2, sign(-3.5) AS sg,
+                             degrees(pi()) AS dg, cbrt(27) AS cb""")
+    assert out["s"] == [0.0]
+    assert out["c"] == [1.0]
+    assert abs(out["a"][0] - math.pi / 4) < 1e-12
+    assert abs(out["l2"][0] - 3.0) < 1e-9  # XLA log2 is a few ulp off
+    assert out["sg"] == [-1.0]
+    assert abs(out["dg"][0] - 180.0) < 1e-9
+    assert abs(out["cb"][0] - 3.0) < 1e-9
+
+
+def test_string_extended(spark):
+    spark.createDataFrame(pa.table({"s": ["hello world", "aBc"]})) \
+        .createOrReplaceTempView("strs")
+    out = q(spark, """SELECT initcap(s) AS i, reverse(s) AS r,
+                             instr(s, 'o') AS p, ascii(s) AS a,
+                             substring_index(s, ' ', 1) AS si
+                      FROM strs ORDER BY s""")
+    assert out["i"] == ["Abc", "Hello World"]
+    assert out["r"] == ["cBa", "dlrow olleh"]
+    assert out["p"] == [0, 5]
+    assert out["a"] == [ord("a"), ord("h")]
+    assert out["si"] == ["aBc", "hello"]
+
+
+def test_concat_ws_translate_repeat(spark):
+    out = q(spark, """SELECT concat_ws('-', 'a', 'b') AS cw,
+                             translate('abcba', 'ab', 'xy') AS tr,
+                             repeat('ab', 3) AS rp""")
+    assert out["cw"] == ["a-b"]
+    assert out["tr"] == ["xycyx"]
+    assert out["rp"] == ["ababab"]
+
+
+def test_timestamp_parts(spark):
+    out = q(spark, """SELECT hour(TIMESTAMP '2021-03-04 13:45:21') AS h,
+                             minute(TIMESTAMP '2021-03-04 13:45:21') AS m,
+                             second(TIMESTAMP '2021-03-04 13:45:21') AS s,
+                             unix_timestamp(TIMESTAMP '1970-01-01 00:01:00') AS u""")
+    assert out["h"] == [13]
+    assert out["m"] == [45]
+    assert out["s"] == [21]
+    assert out["u"] == [60]
+
+
+def test_month_arithmetic(spark):
+    out = q(spark, """SELECT add_months(DATE '2020-01-31', 1) AS feb,
+                             last_day(DATE '2020-02-10') AS ld,
+                             months_between(DATE '2020-03-15',
+                                            DATE '2020-01-15') AS mb""")
+    assert str(out["feb"][0]) == "2020-02-29"  # clamped, leap year
+    assert str(out["ld"][0]) == "2020-02-29"
+    assert abs(out["mb"][0] - 2.0) < 1e-9
+
+
+def test_corr_covar(spark):
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, 500)
+    y = 2 * x + rng.normal(0, 0.1, 500)
+    df = spark.createDataFrame(pa.table({"x": x, "y": y}))
+    out = df.agg(F.corr("x", "y").alias("c"),
+                 F.covar_samp("x", "y").alias("cv")).toArrow().to_pydict()
+    assert abs(out["c"][0] - np.corrcoef(x, y)[0, 1]) < 1e-6
+    assert abs(out["cv"][0] - np.cov(x, y, ddof=1)[0, 1]) < 1e-6
+
+
+def test_skew_kurtosis(spark):
+    rng = np.random.default_rng(1)
+    x = rng.exponential(1.0, 2000)
+    df = spark.createDataFrame(pa.table({"x": x}))
+    out = df.agg(F.skewness("x").alias("sk"),
+                 F.kurtosis("x").alias("ku")).toArrow().to_pydict()
+    n = len(x)
+    mu = x.mean()
+    m2 = ((x - mu) ** 2).mean()
+    m3 = ((x - mu) ** 3).mean()
+    m4 = ((x - mu) ** 4).mean()
+    assert abs(out["sk"][0] - m3 / m2 ** 1.5) < 1e-6
+    assert abs(out["ku"][0] - (m4 / m2 ** 2 - 3)) < 1e-6
+
+
+def test_sum_distinct(spark):
+    df = spark.createDataFrame(pa.table({"x": [1, 1, 2, 3, 3]}))
+    out = df.agg(F.sum_distinct("x").alias("s")).toArrow().to_pydict()
+    assert out["s"] == [6]
+    out2 = q(spark, "SELECT sum(DISTINCT x) AS s FROM "
+                    "(SELECT col1 AS x FROM (VALUES (1), (1), (5)))")
+    assert out2["s"] == [6]
+
+
+def test_corr_with_nulls(spark):
+    df = spark.createDataFrame(pa.table({
+        "x": pa.array([1.0, 2.0, None, 4.0], pa.float64()),
+        "y": pa.array([2.0, 4.0, 6.0, None], pa.float64())}))
+    out = df.agg(F.corr("x", "y").alias("c")).toArrow().to_pydict()
+    # only rows (1,2),(2,4) count → perfect correlation... but 2 points
+    assert abs(out["c"][0] - 1.0) < 1e-9
