@@ -266,6 +266,37 @@ class DataFrame:
     def sample(self, fraction: float, seed: int = 42) -> "DataFrame":
         return self._with(L.Sample(fraction, seed, self.plan))
 
+    def describe(self, *cols: str) -> "DataFrame":
+        """Summary statistics for numeric columns
+        (reference: Dataset.describe / StatFunctions)."""
+        import pyarrow as pa
+
+        from ..types import NumericType
+
+        targets = [f.name for f in self.schema
+                   if isinstance(f.dataType, NumericType)
+                   and (not cols or f.name in cols)]
+        if not targets:
+            return self.session.createDataFrame(
+                pa.table({"summary": pa.array([], pa.string())}))
+        import spark_tpu.api.functions as FN
+
+        aggs = []
+        for c in targets:
+            aggs += [FN.count(c).alias(f"count_{c}"),
+                     FN.avg(c).alias(f"mean_{c}"),
+                     FN.stddev(c).alias(f"stddev_{c}"),
+                     FN.min(c).alias(f"min_{c}"),
+                     FN.max(c).alias(f"max_{c}")]
+        row = self.agg(*aggs).collect()[0]
+        stats = ["count", "mean", "stddev", "min", "max"]
+        data = {"summary": stats}
+        for c in targets:
+            data[c] = [str(row[f"{s}_{c}"]) for s in stats]
+        return self.session.createDataFrame(pa.table(data))
+
+    summary = describe
+
     # --- actions -------------------------------------------------------
     def toArrow(self) -> pa.Table:
         return self.query_execution.to_arrow()

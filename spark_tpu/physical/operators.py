@@ -915,6 +915,53 @@ class NestedLoopJoinExec(PhysicalPlan):
 # Union / Coalesce
 # ---------------------------------------------------------------------------
 
+class SampleExec(PhysicalPlan):
+    """Bernoulli sampling via a hash of the row's global position —
+    deterministic for a given seed (role of BasicOperators' SampleExec)."""
+
+    child_fields = ("child",)
+
+    def __init__(self, fraction: float, seed: int, child: PhysicalPlan):
+        self.fraction = fraction
+        self.seed = seed
+        self.child = child
+
+    @property
+    def output(self):
+        return self.child.output
+
+    def execute(self, ctx: ExecContext) -> list[Partition]:
+        import jax
+
+        from ..ops.hashing import mix64
+
+        jnp = _jnp()
+        threshold = int(self.fraction * (1 << 30))
+        out = []
+        for pi, part in enumerate(self.child.execute(ctx)):
+            obatches = []
+            for bi, b in enumerate(part):
+                cap = b.capacity
+                key = ("sample", cap, self.seed, threshold, pi, bi)
+
+                def build(pi=pi, bi=bi):
+                    def kernel(mask):
+                        pos = jnp.arange(cap, dtype=jnp.int64) \
+                            + (pi << 40) + (bi << 28)
+                        h = mix64(pos + self.seed)
+                        keep = (h.view(jnp.uint64) >> jnp.uint64(34)) \
+                            .astype(jnp.int64) < threshold
+                        return mask & keep
+
+                    return jax.jit(kernel)
+
+                kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build)
+                obatches.append(ColumnarBatch(
+                    b.schema, b.columns, kernel(b.row_mask), num_rows=None))
+            out.append(obatches)
+        return out
+
+
 class UnionExec(PhysicalPlan):
     child_fields = ("children_plans",)
 

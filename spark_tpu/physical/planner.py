@@ -111,6 +111,11 @@ class Planner:
                 L.Aggregate(list(out), list(out), node.child))
         if isinstance(node, L.Window):
             return self._plan_window(node)
+        if isinstance(node, L.Sample):
+            from .operators import SampleExec
+
+            return SampleExec(node.fraction, node.seed,
+                              self._convert(node.child))
         if isinstance(node, L.PythonEval):
             from .python_eval import PythonEvalExec
 

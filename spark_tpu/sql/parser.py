@@ -705,6 +705,8 @@ class Parser:
         if t.kind in ("ident", "kw"):
             # function call or column reference
             name = self.ident()
+            if name.lower() == "extract" and self.at_op("("):
+                return self.parse_extract()
             if self.at_op("("):
                 return self.parse_function(name)
             parts = [name]
@@ -776,6 +778,23 @@ class Parser:
             raise ParseException("bad frame bound")
         if not (self.eat_kw("preceding") or self.eat_kw("following")):
             raise ParseException("bad frame bound")
+
+    def parse_extract(self) -> E.Expression:
+        self.expect_op("(")
+        field = self.ident().lower()
+        self.expect_kw("from")
+        src = self.parse_expr()
+        self.expect_op(")")
+        mapping = {
+            "year": E.Year, "month": E.Month, "day": E.DayOfMonth,
+            "dayofmonth": E.DayOfMonth, "quarter": E.Quarter,
+            "week": E.WeekOfYear, "doy": E.DayOfYear, "dow": E.DayOfWeek,
+            "hour": E.Hour, "minute": E.Minute, "second": E.Second,
+        }
+        cls = mapping.get(field)
+        if cls is None:
+            raise ParseException(f"EXTRACT field {field} not supported")
+        return cls(src)
 
     def parse_case(self) -> E.Expression:
         self.expect_kw("case")

@@ -1,0 +1,41 @@
+"""Sampling, EXTRACT syntax, and describe() tests."""
+
+import pyarrow as pa
+import pytest
+
+import spark_tpu.api.functions as F
+
+
+def test_sample_deterministic_fraction(spark):
+    df = spark.range(0, 10_000, 1, 4)
+    s1 = df.sample(0.1, seed=7).count()
+    s2 = df.sample(0.1, seed=7).count()
+    assert s1 == s2
+    assert 800 < s1 < 1200
+
+
+def test_sample_composes(spark):
+    df = spark.range(0, 1000, 1, 2).sample(0.5, seed=1)
+    out = df.agg(F.count("*").alias("c")).toArrow().to_pydict()
+    assert 350 < out["c"][0] < 650
+
+
+def test_extract_syntax(spark):
+    out = spark.sql(
+        "SELECT EXTRACT(year FROM DATE '2021-07-04') AS y, "
+        "EXTRACT(month FROM DATE '2021-07-04') AS m, "
+        "EXTRACT(hour FROM TIMESTAMP '2021-07-04 09:30:00') AS h"
+    ).toArrow().to_pydict()
+    assert out["y"] == [2021]
+    assert out["m"] == [7]
+    assert out["h"] == [9]
+
+
+def test_describe(spark):
+    df = spark.createDataFrame(pa.table({
+        "v": [1.0, 2.0, 3.0, 4.0], "name": ["a", "b", "c", "d"]}))
+    out = df.describe().toArrow().to_pydict()
+    assert out["summary"] == ["count", "mean", "stddev", "min", "max"]
+    assert out["v"][0] == "4"
+    assert float(out["v"][1]) == 2.5
+    assert "name" not in out  # non-numeric excluded
