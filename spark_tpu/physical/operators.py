@@ -878,6 +878,17 @@ class HashJoinExec(PhysicalPlan):
         rpos = {a.expr_id: i for i, a in enumerate(self.right.output)}
         lpos = {a.expr_id: i for i, a in enumerate(self.left.output)}
         bkeys = [build.columns[rpos[k.expr_id]] for k in self.right_keys]
+
+        dense = self._try_dense_build(build, bkeys, ctx)
+        if dense is not None:
+            out_batches = [
+                self._dense_probe_batch(pb, build, dense, lpos, ctx)
+                for pb in (lp or [ColumnarBatch.empty(lschema)])]
+            if self.join_type == "full_outer":
+                out_batches.append(
+                    self._unmatched_build_rows(lp, build, lschema, ctx))
+            return out_batches
+
         bkey_eqs = [c.eq_keys() for c in bkeys]
         bkey_valids = [c.validity for c in bkeys]
 
@@ -947,6 +958,119 @@ class HashJoinExec(PhysicalPlan):
         schema = attrs_schema(self.output)
         cols = probe_out.columns + build_out.columns
         return ColumnarBatch(schema, cols, r.out_mask, num_rows=None)
+
+    def _try_dense_build(self, build: ColumnarBatch, bkeys, ctx):
+        """Dense unique-key build fast path (TPC-DS dimension tables: dense
+        integral primary keys): the 'hash table' is a direct-address row
+        index, the probe a single gather — no sort, no searchsorted, no
+        expansion (probe output is 1:1). Falls back when keys are multi,
+        non-integral, sparse, or duplicated."""
+        import jax
+
+        from ..types import DateType, IntegralType
+
+        jnp = _jnp()
+        if len(bkeys) != 1:
+            return None
+        kc = bkeys[0]
+        if not isinstance(kc.dtype, (IntegralType, DateType)):
+            return None
+        cap = build.capacity
+        key64 = kc.data.astype(jnp.int64)
+        mask = build.row_mask if kc.validity is None \
+            else (build.row_mask & kc.validity)
+
+        rkey = ("krange", cap)
+
+        def build_range():
+            def kr(k, m):
+                big = jnp.iinfo(jnp.int64).max
+                small = jnp.iinfo(jnp.int64).min
+                return (jnp.min(jnp.where(m, k, big)),
+                        jnp.max(jnp.where(m, k, small)),
+                        jnp.any(m))
+            return jax.jit(kr)
+
+        kmin_d, kmax_d, any_d = GLOBAL_KERNEL_CACHE.get_or_build(
+            rkey, build_range)(key64, mask)
+        if not bool(any_d):
+            return None
+        kmin, kmax = int(kmin_d), int(kmax_d)
+        span = kmax - kmin + 1
+        if span > min(8 * cap, 1 << 23):
+            return None
+
+        tcap = bucket_capacity(span)
+        tkey = ("djoin_build", cap, tcap)
+
+        def build_table():
+            from jax import lax
+
+            def kt(k, m, kmin_s):
+                slot = jnp.where(m, (k - kmin_s).astype(jnp.int64), tcap)
+                rowidx = jnp.full((tcap,), 0, jnp.int32).at[slot].set(
+                    lax.iota(jnp.int32, cap), mode="drop")
+                cnt = jnp.zeros((tcap,), jnp.int32).at[slot].add(
+                    1, mode="drop")
+                return rowidx, cnt, jnp.max(cnt)
+
+            return jax.jit(kt)
+
+        rowidx, present, maxc = GLOBAL_KERNEL_CACHE.get_or_build(
+            tkey, build_table)(key64, mask, jnp.int64(kmin))
+        if int(maxc) > 1:
+            return None  # duplicate build keys → sorted-probe path
+        ctx.metrics.add("join.dense_fast_path")
+        return {"rowidx": rowidx, "present": present, "kmin": kmin,
+                "tcap": tcap}
+
+    def _dense_probe_batch(self, pb: ColumnarBatch, build: ColumnarBatch,
+                           dense, lpos, ctx) -> ColumnarBatch:
+        import jax
+
+        jnp = _jnp()
+        kc = pb.columns[lpos[self.left_keys[0].expr_id]]
+        cap = pb.capacity
+        tcap = dense["tcap"]
+        jt = self.join_type if self.join_type != "full_outer" else "left_outer"
+
+        key = ("djoin_probe", jt, cap, tcap, kc.validity is not None)
+
+        def build_kernel():
+            def kp(pkey, pvalid, pmask, rowidx, present, kmin_s):
+                k = pkey.astype(jnp.int64) - kmin_s
+                in_range = (k >= 0) & (k < tcap)
+                slot = jnp.clip(k, 0, tcap - 1)
+                usable = pmask & in_range
+                if pvalid is not None:
+                    usable = usable & pvalid
+                matched = usable & (jnp.take(present, slot) > 0)
+                bidx = jnp.take(rowidx, slot)
+                if jt == "inner":
+                    out_mask = matched
+                elif jt == "left_outer":
+                    out_mask = pmask
+                elif jt == "left_semi":
+                    out_mask = matched
+                else:  # left_anti
+                    out_mask = pmask & ~matched
+                return bidx, matched, out_mask
+
+            return jax.jit(kp)
+
+        kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build_kernel)
+        bidx, matched, out_mask = kernel(
+            kc.data, kc.validity, pb.row_mask, dense["rowidx"],
+            dense["present"], jnp.int64(dense["kmin"]))
+
+        if self.join_type in ("left_semi", "left_anti"):
+            return ColumnarBatch(pb.schema, pb.columns, out_mask,
+                                 num_rows=None)
+        build_out = gather_batch(build, bidx, out_mask,
+                                 extra_invalid=~matched)
+        schema = attrs_schema(self.output)
+        cols = pb.columns + build_out.columns
+        return ColumnarBatch(schema, cols, out_mask, num_rows=None)
 
     def _unmatched_build_rows(self, lp: Partition, build: ColumnarBatch,
                               lschema, ctx) -> ColumnarBatch:
