@@ -1,0 +1,34 @@
+"""Graph quickstart: PageRank + connected components via Pregel.
+
+Run: python examples/graph_pagerank.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from spark_tpu import SparkSession
+from spark_tpu.graph import Graph
+
+
+def main():
+    SparkSession.builder.appName("graph").getOrCreate()
+
+    # two communities bridged by one edge
+    src = [1, 2, 3, 1, 10, 11, 12, 3]
+    dst = [2, 3, 1, 3, 11, 12, 10, 10]
+    g = Graph.from_edges(src, dst)
+
+    pr = g.page_rank(num_iter=30)
+    print("pagerank:", {k: round(v, 3) for k, v in sorted(pr.items())})
+
+    cc = g.connected_components()
+    print("components:", cc)
+
+    tc = g.triangle_count()
+    print("triangles:", tc)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,69 @@
+"""SQL analytics quickstart: star-schema joins, aggregation, windows.
+
+Run: python examples/sql_analytics.py   (CPU or TPU)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+
+import numpy as np
+import pyarrow as pa
+
+from spark_tpu import SparkSession
+import spark_tpu.api.functions as F
+
+
+def main():
+    spark = SparkSession.builder.appName("sql-analytics").getOrCreate()
+
+    from tpcds_mini import register_tpcds
+
+    register_tpcds(spark)
+
+    print("== Star-schema join + aggregation ==")
+    spark.sql("""
+        SELECT dt.d_year, item.i_category,
+               SUM(ss_ext_sales_price) AS revenue,
+               COUNT(*) AS n_sales
+        FROM store_sales
+        JOIN date_dim dt ON ss_sold_date_sk = dt.d_date_sk
+        JOIN item ON ss_item_sk = item.i_item_sk
+        WHERE dt.d_moy = 11
+        GROUP BY dt.d_year, item.i_category
+        ORDER BY revenue DESC
+        LIMIT 10""").show()
+
+    print("== Window functions: top items per store ==")
+    spark.sql("""
+        SELECT ss_store_sk, ss_item_sk, rev, rnk FROM (
+            SELECT ss_store_sk, ss_item_sk, rev,
+                   rank() OVER (PARTITION BY ss_store_sk
+                                ORDER BY rev DESC) AS rnk
+            FROM (SELECT ss_store_sk, ss_item_sk,
+                         SUM(ss_ext_sales_price) AS rev
+                  FROM store_sales GROUP BY ss_store_sk, ss_item_sk))
+        WHERE rnk <= 3 ORDER BY ss_store_sk, rnk LIMIT 9""").show()
+
+    print("== Correlated subquery: above-average sales ==")
+    spark.sql("""
+        SELECT ss_store_sk, COUNT(*) AS big_sales
+        FROM store_sales s1
+        WHERE ss_ext_sales_price > (
+            SELECT 2 * AVG(ss_ext_sales_price) FROM store_sales s2
+            WHERE s2.ss_store_sk = s1.ss_store_sk)
+        GROUP BY ss_store_sk ORDER BY ss_store_sk LIMIT 5""").show()
+
+    print("== DataFrame API ==")
+    (spark.table("store_sales")
+     .groupBy("ss_store_sk")
+     .agg(F.sum("ss_net_profit").alias("profit"),
+          F.countDistinct("ss_item_sk").alias("items"))
+     .orderBy(F.col("profit").desc())
+     .limit(5).show())
+
+
+if __name__ == "__main__":
+    main()

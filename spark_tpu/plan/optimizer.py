@@ -605,9 +605,7 @@ class RewriteDistinctAggregates(Rule):
             if not distincts:
                 return node
             if others:
-                raise UnsupportedOperationError(
-                    "mixing DISTINCT and non-DISTINCT aggregates is not "
-                    "supported yet")
+                return self._rewrite_mixed(node, distincts)
             first_child = distincts[0].child
             if any(not d.child.semantic_equals(first_child)
                    for d in distincts[1:]):
@@ -664,6 +662,85 @@ class RewriteDistinctAggregates(Rule):
             return Aggregate(outer_group, outer_outs, inner)
 
         return plan.transform_up(rule)
+
+    def _rewrite_mixed(self, node: Aggregate, distincts):
+        """Mixed DISTINCT + plain aggregates: split into two aggregates over
+        the same child and join them back on the grouping keys (the
+        reference uses a single Expand; the join formulation reuses existing
+        operators). Null-safe key equality keeps null-keyed groups."""
+        from ..errors import UnsupportedOperationError
+        from ..expr.expressions import AggregateFunction as AF
+
+        # grouping attrs for both sides (aliased when complex)
+        def key_aliases(suffix: str):
+            outs, attrs = [], []
+            for i, g in enumerate(node.grouping_exprs):
+                al = Alias(g, f"_k{suffix}{i}")
+                outs.append(al)
+                attrs.append(al.to_attribute())
+            return outs, attrs
+
+        nd_keys, nd_attrs = key_aliases("n")
+        d_keys, d_attrs = key_aliases("d")
+
+        nd_funcs, d_funcs = [], []
+        for e in node.aggregate_exprs:
+            for x in e.iter_nodes():
+                if isinstance(x, AF):
+                    bucket = d_funcs if getattr(x, "distinct", False) \
+                        else nd_funcs
+                    if not any(x.semantic_equals(f) for f in bucket):
+                        bucket.append(x)
+
+        nd_aliases = [Alias(f, f"_nd{i}") for i, f in enumerate(nd_funcs)]
+        d_aliases = [Alias(f, f"_d{i}") for i, f in enumerate(d_funcs)]
+
+        nd_agg = Aggregate(node.grouping_exprs, nd_keys + nd_aliases,
+                           node.child)
+        d_agg = Aggregate(node.grouping_exprs, d_keys + d_aliases,
+                          node.child)
+        # recursively rewrite the distinct side (now distinct-only)
+        d_agg = self.apply(d_agg)
+
+        if node.grouping_exprs:
+            cond = None
+            for l, r in zip(nd_attrs, d_attrs):
+                for c in _null_safe_eq_conjuncts(l, r):
+                    cond = c if cond is None else And(cond, c)
+            joined = Join(nd_agg, d_agg, "inner", cond)
+        else:
+            joined = Join(nd_agg, d_agg, "cross", None)
+
+        nd_map = {id(f): a.to_attribute() for f, a in zip(nd_funcs, nd_aliases)}
+        d_map = list(zip(d_funcs, [a.to_attribute() for a in d_aliases]))
+        g_map = list(zip(node.grouping_exprs, nd_attrs))
+
+        def fix(x: Expression) -> Expression:
+            if isinstance(x, AF):
+                if getattr(x, "distinct", False):
+                    for f, a in d_map:
+                        if x.semantic_equals(f):
+                            return a
+                else:
+                    for f, a in zip(nd_funcs,
+                                    [al.to_attribute() for al in nd_aliases]):
+                        if x.semantic_equals(f):
+                            return a
+            for g, a in g_map:
+                if x.semantic_equals(g):
+                    return a
+            return x
+
+        outs = []
+        for e in node.aggregate_exprs:
+            if isinstance(e, Alias):
+                outs.append(Alias(e.child.transform_up(fix), e.name,
+                                  e.expr_id))
+            elif isinstance(e, AttributeReference):
+                outs.append(Alias(fix(e), e.name, e.expr_id))
+            else:
+                outs.append(e.transform_up(fix))
+        return Project(outs, joined)
 
 
 class ReplaceSetOps(Rule):

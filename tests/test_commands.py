@@ -73,3 +73,29 @@ def test_count_distinct_sql(spark):
                     "ORDER BY g").toArrow().to_pydict()
     assert out["c"] == [2, 1]
     spark.sql("DROP VIEW cd")
+
+
+def test_mixed_distinct_and_plain_aggregates(spark):
+    import pyarrow as pa
+
+    df = spark.createDataFrame(pa.table({
+        "g": ["a", "a", "b", "b", "b"],
+        "x": [1, 1, 2, 3, 3],
+        "v": [10, 20, 30, 40, 50]}))
+    out = (df.groupBy("g")
+           .agg(F.sum("v").alias("s"), F.countDistinct("x").alias("d"),
+                F.count("*").alias("n"))
+           .orderBy("g").toArrow().to_pydict())
+    assert out["s"] == [30, 120]
+    assert out["d"] == [1, 2]
+    assert out["n"] == [2, 3]
+
+
+def test_mixed_distinct_global(spark):
+    import pyarrow as pa
+
+    df = spark.createDataFrame(pa.table({"x": [1, 1, 2], "v": [5, 5, 10]}))
+    out = df.agg(F.sum("v").alias("s"),
+                 F.countDistinct("x").alias("d")).toArrow().to_pydict()
+    assert out["s"] == [20]
+    assert out["d"] == [2]
