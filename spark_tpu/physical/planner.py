@@ -254,7 +254,13 @@ class Planner:
             func_to_spec.append((f, spec))
 
         partial = HashAggregateExec(group_keys, specs, "partial", child)
-        final = HashAggregateExec(group_keys, specs, "final", partial)
+        if child.output_partitioning().num_partitions == 1:
+            # single upstream partition: the partial pass is already
+            # complete — skip the merge stage (reference: AggUtils plans
+            # one-pass aggregation when no shuffle is needed)
+            final: PhysicalPlan = partial
+        else:
+            final = HashAggregateExec(group_keys, specs, "final", partial)
 
         # 4. finishing projection: replace agg funcs with spec result exprs,
         #    grouping exprs with grouping attrs
