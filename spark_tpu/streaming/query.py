@@ -251,11 +251,16 @@ class StreamingQuery:
         ctx = ExecContext(conf=session.conf, metrics=session._metrics)
 
         # partial aggregation of new rows (device)
-        partial_plan = planner._convert(agg)  # ComputeExec(final, Final(Partial))
-        # dig out the pieces the planner built
-        finish = partial_plan                    # ComputeExec
-        final: HashAggregateExec = finish.child  # final agg
-        partial: HashAggregateExec = final.child
+        partial_plan = planner._convert(agg)  # ComputeExec(Final(Partial)) or
+        finish = partial_plan                 # ComputeExec(Partial) when the
+        maybe = finish.child                  # planner skipped the merge
+        if isinstance(maybe, HashAggregateExec) and maybe.mode == "final":
+            final: HashAggregateExec = maybe
+            partial: HashAggregateExec = final.child
+        else:
+            partial = maybe
+            final = HashAggregateExec(partial.grouping, partial.specs,
+                                      "final", partial)
 
         buffer_attrs = list(partial.output)
         partial_ready = planner._ensure_requirements(partial)
