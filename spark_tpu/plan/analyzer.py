@@ -543,6 +543,64 @@ class ResolveSortHiddenRefs(Rule):
         return plan.transform_up(rule)
 
 
+class WidenSetOperationTypes(Rule):
+    """Positionally coerce Union/Intersect/Except branches to common types
+    (reference: TypeCoercion WidenSetOperationTypes)."""
+
+    def apply(self, plan):
+        from .logical import Except, Intersect, Union
+
+        def widen(children: list[LogicalPlan]) -> list[LogicalPlan] | None:
+            outs = [c.output for c in children]
+            n = len(outs[0])
+            if any(len(o) != n for o in outs):
+                raise AnalysisException(
+                    "set operation branches have different column counts",
+                    error_class="NUM_COLUMNS_MISMATCH")
+            targets = []
+            for i in range(n):
+                t = outs[0][i].dtype
+                for o in outs[1:]:
+                    ct = common_type(t, o[i].dtype)
+                    if ct is None:
+                        raise AnalysisException(
+                            f"incompatible set-op column types: "
+                            f"{t.simple_string()} vs "
+                            f"{o[i].dtype.simple_string()}")
+                    t = ct
+                targets.append(t)
+            changed = False
+            new_children = []
+            for c, o in zip(children, outs):
+                if all(a.dtype == t for a, t in zip(o, targets)):
+                    new_children.append(c)
+                    continue
+                projs = []
+                for a, t in zip(o, targets):
+                    if a.dtype == t:
+                        projs.append(a)
+                    else:
+                        projs.append(Alias(cast_if(a, t), a.name))
+                new_children.append(Project(projs, c))
+                changed = True
+            return new_children if changed else None
+
+        def rule(node):
+            if isinstance(node, Union) and node.resolved:
+                nc = widen(node.children_plans)
+                if nc is not None:
+                    return Union(nc)
+            from .logical import Except as Ex, Intersect as Ix
+
+            if isinstance(node, (Ix, Ex)) and node.resolved:
+                nc = widen([node.left, node.right])
+                if nc is not None:
+                    return node.copy(left=nc[0], right=nc[1])
+            return node
+
+        return plan.transform_up(rule)
+
+
 class CoerceDecimalArithmetic(Rule):
     """Align decimal scales in Add/Subtract (device repr is scaled int64)."""
 
@@ -648,6 +706,7 @@ class Analyzer(RuleExecutor):
             ]),
             Batch("Coercion", FixedPoint(10), [
                 CoerceDecimalArithmetic(),
+                WidenSetOperationTypes(),
             ]),
             Batch("Check", Once(), [CheckAnalysis()]),
         ]

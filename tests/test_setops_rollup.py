@@ -69,3 +69,13 @@ def test_grouping_sets(nums):
     assert ("w", None, 30) in rows
     assert (None, "p1", 80) in rows
     assert len(rows) == 2 + 2
+
+
+def test_union_type_widening(nums):
+    out = q(nums, "SELECT 1 AS v UNION ALL SELECT 2.5 UNION ALL SELECT x FROM ta WHERE x = 1")
+    assert sorted(out["v"]) == [1.0, 1.0, 2.5]
+
+
+def test_intersect_type_widening(nums):
+    out = q(nums, "SELECT CAST(3 AS BIGINT) AS v INTERSECT SELECT 3")
+    assert out["v"] == [3]
