@@ -1,0 +1,140 @@
+"""Local cluster: multi-process executor backend.
+
+Role of the reference's `local-cluster[n,cores,mem]` mode
+(core/SparkContext.scala:3464 regex → core/deploy/LocalSparkCluster.scala:38):
+real PROCESS boundaries on one host so distributed logic — task shipping,
+executor failure, retry, excludelists — is exercised without a cluster
+(SURVEY.md §4 'Multi-process distributed without a cluster').
+
+Workers are spawned with the TPU tunnel disabled and connect back over an
+authenticated localhost socket; tasks ship as cloudpickle payloads (the
+ClosureCleaner/serializer role). Executor loss is detected on send/recv
+failure, recorded in the HealthTracker, and the task retries on another
+executor (TaskSetManager.maxFailures role).
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import subprocess
+import sys
+import threading
+import time
+from multiprocessing.connection import Client, Listener
+from typing import Any, Callable
+
+import cloudpickle
+
+from .scheduler import ExecutorRegistry, HealthTracker
+
+
+class _Worker:
+    def __init__(self, proc: subprocess.Popen, conn, executor_id: str):
+        self.proc = proc
+        self.conn = conn
+        self.executor_id = executor_id
+        self.lock = threading.Lock()
+
+    def run(self, payload: bytes) -> Any:
+        with self.lock:
+            self.conn.send_bytes(payload)
+            status, result = self.conn.recv()
+        if status == "err":
+            raise RemoteTaskError(result)
+        return result
+
+    def close(self):
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+class RemoteTaskError(RuntimeError):
+    """The task itself raised on the worker (no retry — deterministic)."""
+
+
+class ExecutorLostError(RuntimeError):
+    pass
+
+
+class LocalCluster:
+    def __init__(self, num_workers: int = 2, max_task_failures: int = 3):
+        self.max_task_failures = max_task_failures
+        self.registry = ExecutorRegistry()
+        self.health = HealthTracker(self.registry, max_failures=2)
+        authkey = secrets.token_bytes(16)
+        self._listener = Listener(("127.0.0.1", 0), authkey=authkey)
+        addr = self._listener.address
+        self._workers: dict[str, _Worker] = {}
+        self._rr = 0
+        self._lock = threading.Lock()
+        env = dict(os.environ)
+        env["PALLAS_AXON_POOL_IPS"] = ""       # no TPU tunnel in workers
+        env["JAX_PLATFORMS"] = "cpu"
+        env["SPARK_TPU_WORKER_KEY"] = authkey.hex()
+        env["SPARK_TPU_WORKER_ADDR"] = f"{addr[0]}:{addr[1]}"
+        env.setdefault("PYTHONPATH", "")
+        root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = root + os.pathsep + env["PYTHONPATH"]
+        for _ in range(num_workers):
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "spark_tpu.exec.worker_main"],
+                env=env)
+            conn = self._listener.accept()
+            eid = self.registry.register(host="localhost", slots=1)
+            self._workers[eid] = _Worker(proc, conn, eid)
+
+    # ------------------------------------------------------------------
+    def _pick(self) -> _Worker:
+        with self._lock:
+            alive = [self._workers[e.executor_id]
+                     for e in self.registry.alive()
+                     if e.executor_id in self._workers]
+            if not alive:
+                raise ExecutorLostError("no alive executors")
+            w = alive[self._rr % len(alive)]
+            self._rr += 1
+            return w
+
+    def run_task(self, fn: Callable, *args) -> Any:
+        payload = cloudpickle.dumps((fn, args))
+        last: Exception | None = None
+        for _ in range(self.max_task_failures):
+            w = self._pick()
+            try:
+                return w.run(payload)
+            except RemoteTaskError:
+                raise  # the function itself failed; retrying won't help
+            except Exception as e:  # connection/process death
+                last = e
+                self.registry.remove(w.executor_id)  # executor lost
+                w.close()
+        raise ExecutorLostError(
+            f"task failed after {self.max_task_failures} executor losses: "
+            f"{last}")
+
+    def map(self, fn: Callable, items) -> list:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=max(len(self._workers), 1)) as p:
+            return list(p.map(lambda x: self.run_task(fn, x), items))
+
+    def num_alive(self) -> int:
+        return len(self.registry.alive())
+
+    def stop(self):
+        for w in self._workers.values():
+            w.close()
+        try:
+            self._listener.close()
+        except Exception:
+            pass

@@ -194,6 +194,13 @@ class ExecutorRegistry:
             e.last_heartbeat = time.time()
             return True
 
+    def remove(self, executor_id: str) -> None:
+        """Executor lost (process death / connection drop) — immediate
+        deregistration (reference: CoarseGrainedSchedulerBackend
+        RemoveExecutor)."""
+        with self._lock:
+            self._executors.pop(executor_id, None)
+
     def expire_dead(self) -> list[str]:
         now = time.time()
         dead = []

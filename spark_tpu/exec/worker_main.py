@@ -1,0 +1,43 @@
+"""Executor worker process entry point.
+
+Role of the reference's CoarseGrainedExecutorBackend.main
+(core/executor/CoarseGrainedExecutorBackend.scala:181 LaunchTask →
+core/executor/Executor.scala TaskRunner): connect back to the driver,
+loop receiving cloudpickled (fn, args) tasks, execute, reply."""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+from multiprocessing.connection import Client
+
+
+def main() -> None:
+    addr_s = os.environ["SPARK_TPU_WORKER_ADDR"]
+    host, port = addr_s.rsplit(":", 1)
+    authkey = bytes.fromhex(os.environ["SPARK_TPU_WORKER_KEY"])
+    conn = Client((host, int(port)), authkey=authkey)
+
+    import cloudpickle
+
+    while True:
+        try:
+            payload = conn.recv_bytes()
+        except (EOFError, OSError):
+            return
+        try:
+            fn, args = cloudpickle.loads(payload)
+            result = fn(*args)
+            conn.send(("ok", result))
+        except SystemExit:
+            raise
+        except BaseException:
+            try:
+                conn.send(("err", traceback.format_exc()))
+            except Exception:
+                return
+
+
+if __name__ == "__main__":
+    main()

@@ -581,6 +581,17 @@ class ShuffledRDD(RDD):
         self._fetched: list[list] | None = None
         self._lock = threading.Lock()
 
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state.pop("_lock", None)
+        return state
+
+    def __setstate__(self, state):
+        import threading
+
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
     def _materialize(self) -> list[list]:
         if self._fetched is not None:
             return self._fetched
@@ -661,13 +672,31 @@ class RDDContext:
     """Driver context (role of SparkContext for the RDD layer)."""
 
     def __init__(self, parallelism: int = 8,
-                 checkpoint_dir: str | None = None):
+                 checkpoint_dir: str | None = None, cluster=None):
         import threading
 
         self.parallelism = parallelism
         self.checkpoint_dir = checkpoint_dir
+        self.cluster = cluster  # exec/cluster.LocalCluster for process mode
         self._rdd_counter = itertools.count()
         self._pool = ThreadPoolExecutor(max_workers=parallelism)
+        self._in_task = threading.local()
+
+    # workers receive the lineage graph; runtime state stays driver-side
+    # (the reference marks SparkContext @transient in closures)
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        for k in ("_pool", "_in_task", "cluster", "_rdd_counter"):
+            state.pop(k, None)
+        return state
+
+    def __setstate__(self, state):
+        import threading
+
+        self.__dict__.update(state)
+        self.cluster = None
+        self._rdd_counter = itertools.count(1 << 20)
+        self._pool = ThreadPoolExecutor(max_workers=self.parallelism)
         self._in_task = threading.local()
 
     def _next_rdd_id(self) -> int:
@@ -710,6 +739,8 @@ class RDDContext:
         # shuffle map stages as separate task sets, not nested calls)
         if getattr(self._in_task, "flag", False):
             return [fn(s) for s in splits]
+        if self.cluster is not None:
+            return self.cluster.map(fn, list(splits))
 
         def wrapped(s):
             self._in_task.flag = True
