@@ -82,6 +82,11 @@ class DataFrameWriter:
         self._mode = "errorifexists"
         self._format = "parquet"
         self._options: dict[str, Any] = {}
+        self._partition_by: list[str] = []
+
+    def partitionBy(self, *cols: str) -> "DataFrameWriter":
+        self._partition_by = list(cols)
+        return self
 
     def mode(self, m: str) -> "DataFrameWriter":
         self._mode = m.lower()
@@ -115,7 +120,29 @@ class DataFrameWriter:
 
         if not self._check(path):
             return
-        pq.write_table(self.df.toArrow(), path)
+        table = self.df.toArrow()
+        if not self._partition_by:
+            pq.write_table(table, path)
+            return
+        # hive-style layout: path/k1=v1/k2=v2/part-00000.parquet
+        # (reference: FileFormatWriter dynamic partitioning)
+        import pyarrow.compute as pc
+
+        keys = self._partition_by
+        combos = table.select(keys).group_by(keys).aggregate([])
+        for i in range(combos.num_rows):
+            vals = [combos.column(k)[i].as_py() for k in keys]
+            mask = None
+            for k, v in zip(keys, vals):
+                cond = pc.is_null(table.column(k)) if v is None \
+                    else pc.equal(table.column(k), v)
+                mask = cond if mask is None else pc.and_(mask, cond)
+            part = table.filter(mask).drop_columns(keys)
+            sub = os.path.join(path, *(
+                f"{k}={'__HIVE_DEFAULT_PARTITION__' if v is None else v}"
+                for k, v in zip(keys, vals)))
+            os.makedirs(sub, exist_ok=True)
+            pq.write_table(part, os.path.join(sub, "part-00000.parquet"))
 
     def csv(self, path: str) -> None:
         import pyarrow.csv as pacsv

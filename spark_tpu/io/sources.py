@@ -85,8 +85,26 @@ class ParquetSource(DataSource):
             raise FileNotFoundError(f"no parquet files under {paths}")
         self.files = files
         self._pq = pq
+        # hive-style partition columns from directory names k=v
+        # (reference: PartitioningAwareFileIndex partition discovery)
+        self._part_values: dict[str, dict[str, str]] = {}
+        part_keys: list[str] = []
+        for fpath in files:
+            vals: dict[str, str] = {}
+            for seg in fpath.split(os.sep)[:-1]:
+                if "=" in seg:
+                    k, _, v = seg.partition("=")
+                    vals[k] = v
+                    if k not in part_keys:
+                        part_keys.append(k)
+            self._part_values[fpath] = vals
+        self._part_keys = [k for k in part_keys
+                           if all(k in self._part_values[f] for f in files)]
         md0 = pq.ParquetFile(files[0])
         self.schema = schema_from_arrow(md0.schema_arrow)
+        for k in self._part_keys:
+            self.schema = self.schema.add(k, _infer_partition_type(
+                [self._part_values[f][k] for f in files]))
         # build splits: (file, rg_start, rg_end)
         self._splits: list[tuple[str, int, int]] = []
         total_rows = 0
@@ -112,17 +130,53 @@ class ParquetSource(DataSource):
         return len(self._splits)
 
     def read_partition(self, i: int, columns=None) -> pa.Table:
+        from ..types import to_arrow_type
+
         fpath, lo, hi = self._splits[i]
         f = self._pq.ParquetFile(fpath)
+        pvals = self._part_values.get(fpath, {})
+        want_part = [k for k in self._part_keys
+                     if columns is None or k in columns]
+        file_cols = None
+        if columns is not None:
+            file_cols = [c for c in columns if c not in self._part_keys]
         if hi <= lo:
             t = f.schema_arrow.empty_table()
+            if file_cols is not None:
+                t = t.select(file_cols)
         else:
-            t = f.read_row_groups(list(range(lo, hi)),
-                                  columns=list(columns) if columns else None)
-            return t
+            t = f.read_row_groups(list(range(lo, hi)), columns=file_cols)
+        for k in want_part:
+            at = to_arrow_type(self.schema[k].dataType)
+            raw = pvals.get(k)
+            v = None if raw == "__HIVE_DEFAULT_PARTITION__" else raw
+            if v is not None and pa.types.is_integer(at):
+                v = int(v)
+            elif v is not None and pa.types.is_floating(at):
+                v = float(v)
+            t = t.append_column(k, pa.array([v] * t.num_rows, type=at))
         if columns is not None:
             t = t.select(list(columns))
         return t
+
+
+def _infer_partition_type(values: list[str]):
+    from ..types import float64, int64, string
+
+    def ok(fn):
+        try:
+            for v in values:
+                if v != "__HIVE_DEFAULT_PARTITION__":
+                    fn(v)
+            return True
+        except ValueError:
+            return False
+
+    if ok(int):
+        return int64
+    if ok(float):
+        return float64
+    return string
 
 
 class CSVSource(DataSource):
