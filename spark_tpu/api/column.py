@@ -172,7 +172,8 @@ class Column:
         from ..expr.window import WindowExpression
 
         return Column(WindowExpression(self.expr, spec._partition,
-                                       spec._order))
+                                       spec._order,
+                                       getattr(spec, "_frame", None)))
 
     # --- conditional ------------------------------------------------------
     def when(self, cond: "Column", value) -> "Column":

@@ -10,13 +10,14 @@ from .column import Column, _expr
 
 
 class WindowSpec:
-    def __init__(self, partition_spec=(), order_spec=()):
+    def __init__(self, partition_spec=(), order_spec=(), frame=None):
         self._partition = list(partition_spec)
         self._order = list(order_spec)
+        self._frame = frame
 
     def partitionBy(self, *cols) -> "WindowSpec":
         exprs = [_to_expr(c) for c in cols]
-        return WindowSpec(self._partition + exprs, self._order)
+        return WindowSpec(self._partition + exprs, self._order, self._frame)
 
     def orderBy(self, *cols) -> "WindowSpec":
         orders = []
@@ -24,13 +25,29 @@ class WindowSpec:
             e = _to_expr(c)
             orders.append(e if isinstance(e, E.SortOrder)
                           else E.SortOrder(e, True))
-        return WindowSpec(self._partition, self._order + orders)
+        return WindowSpec(self._partition, self._order + orders, self._frame)
 
     def rowsBetween(self, start, end) -> "WindowSpec":
-        # only the default frames are supported (tracked for round 2)
-        return self
+        def off(v):
+            if v <= Window.unboundedPreceding:
+                return None
+            if v >= Window.unboundedFollowing:
+                return None
+            return int(v)
 
-    rangeBetween = rowsBetween
+        return WindowSpec(self._partition, self._order,
+                          ("rows", off(start), off(end)))
+
+    def rangeBetween(self, start, end) -> "WindowSpec":
+        if start <= Window.unboundedPreceding and end == 0:
+            return WindowSpec(self._partition, self._order, None)
+        if start <= Window.unboundedPreceding and \
+                end >= Window.unboundedFollowing:
+            return WindowSpec(self._partition, self._order,
+                              ("rows", None, None))
+        raise NotImplementedError(
+            "RANGE frames with numeric bounds are not supported; use "
+            "rowsBetween")
 
 
 class Window:
@@ -56,4 +73,5 @@ def _to_expr(c):
 
 
 def over(col: Column, spec: WindowSpec) -> Column:
-    return Column(WindowExpression(col.expr, spec._partition, spec._order))
+    return Column(WindowExpression(col.expr, spec._partition, spec._order,
+                                   spec._frame))

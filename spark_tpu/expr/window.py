@@ -98,10 +98,12 @@ class UnresolvedWindowExpression(Expression):
 
     def __init__(self, function: Expression,
                  partition_spec: Sequence[Expression],
-                 order_spec: Sequence["SortOrder"]):
+                 order_spec: Sequence["SortOrder"],
+                 frame: tuple | None = None):
         self.function = function
         self.partition_spec = list(partition_spec)
         self.order_spec = list(order_spec)
+        self.frame = frame
 
     @property
     def resolved(self):
@@ -113,13 +115,17 @@ class WindowExpression(Expression):
 
     def __init__(self, function: Expression,
                  partition_spec: Sequence[Expression],
-                 order_spec: Sequence[SortOrder]):
+                 order_spec: Sequence[SortOrder],
+                 frame: tuple | None = None):
         if not isinstance(function, (WindowFunction, AggregateFunction)):
             raise UnsupportedOperationError(
                 f"{type(function).__name__} is not a window function")
         self.function = function
         self.partition_spec = list(partition_spec)
         self.order_spec = list(order_spec)
+        # frame: None = Spark default; ("rows", lo, hi) with offsets where
+        # None = unbounded (lo ≤ 0 ≤ hi row deltas)
+        self.frame = frame
 
     @property
     def dtype(self) -> DataType:

@@ -203,6 +203,45 @@ def w_agg_running(lo: WindowLayout, values, valid, kind: str):
     return run, run_cnt > 0
 
 
+def w_agg_rows(lo: WindowLayout, values, valid, kind: str,
+               lo_off, hi_off):
+    """ROWS BETWEEN <lo_off> AND <hi_off> frame for sum/count/avg, via
+    segment-clipped cumulative sums. Offsets are row deltas relative to the
+    current row; None means unbounded on that side."""
+    import jax
+
+    cap = values.shape[0]
+    v, w = _sorted_vals(lo, values, valid)
+    acc = jnp.float64 if jnp.issubdtype(v.dtype, jnp.floating) else jnp.int64
+    vv = jnp.where(w, v.astype(acc), 0)
+    csum = jnp.cumsum(vv)
+    ccnt = jnp.cumsum(w.astype(jnp.int64))
+    seg_end = lo.seg_start + lo.seg_size - 1
+
+    lo_idx = lo.seg_start if lo_off is None else \
+        jnp.maximum(lo.pos + lo_off, lo.seg_start)
+    hi_idx = seg_end if hi_off is None else \
+        jnp.minimum(lo.pos + hi_off, seg_end)
+    empty = hi_idx < lo_idx
+
+    def rng(c):
+        hi_v = jnp.take(c, jnp.clip(hi_idx, 0, cap - 1))
+        lo_m1 = lo_idx - 1
+        lo_v = jnp.where(lo_m1 >= 0,
+                         jnp.take(c, jnp.clip(lo_m1, 0, cap - 1)), 0)
+        return jnp.where(empty, 0, hi_v - lo_v)
+
+    total = rng(csum)
+    cnt = rng(ccnt)
+    if kind == "count":
+        return cnt, None
+    if kind == "sum":
+        return total, cnt > 0
+    if kind == "avg":
+        return total.astype(jnp.float64) / jnp.maximum(cnt, 1), cnt > 0
+    raise ValueError(kind)
+
+
 def _ident(kind, dtype):
     from .grouping import _max_ident, _min_ident
 

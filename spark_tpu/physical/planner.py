@@ -151,7 +151,7 @@ class Planner:
             f = w.function
             if getattr(f, "child", None) is not None:
                 f = f.copy(child=arg_map[id(f.child)])
-            nw = WindowExpression(f, list(pkeys), list(orders))
+            nw = WindowExpression(f, list(pkeys), list(orders), w.frame)
             new_wexprs.append(Alias(nw, al.name, al.expr_id))
 
         wexec = WindowExec(new_wexprs, pkeys, orders, child)

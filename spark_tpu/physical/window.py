@@ -84,8 +84,20 @@ class WindowExec(PhysicalPlan):
             elif isinstance(f, (Sum, Count, Min, Max, Average)):
                 kind = {Sum: "sum", Count: "count", Min: "min", Max: "max",
                         Average: "avg"}[type(f)]
-                mode = "running" if has_order else "unbounded"
-                out.append((f"agg_{mode}_{kind}", None, f.child))
+                frame = w.frame
+                if frame is not None:
+                    _, lo, hi = frame
+                    if (lo, hi) == (None, None):
+                        out.append((f"agg_unbounded_{kind}", None, f.child))
+                    elif kind in ("sum", "count", "avg"):
+                        out.append((f"agg_rows_{kind}", (lo, hi), f.child))
+                    else:
+                        raise UnsupportedOperationError(
+                            f"{kind} over a bounded ROWS frame is not "
+                            "supported yet")
+                else:
+                    mode = "running" if has_order else "unbounded"
+                    out.append((f"agg_{mode}_{kind}", None, f.child))
             else:
                 raise UnsupportedOperationError(
                     f"window function {type(f).__name__}")
@@ -154,6 +166,10 @@ class WindowExec(PhysicalPlan):
                         sv, svalid = W.w_ntile(lo, param), None
                     elif kind == "shift":
                         sv, svalid = W.w_shift(lo, vd, vv, param)
+                    elif kind.startswith("agg_rows_"):
+                        sv, svalid = W.w_agg_rows(lo, vd, vv,
+                                                  kind.split("_")[-1],
+                                                  param[0], param[1])
                     elif kind.startswith("agg_running_"):
                         sv, svalid = W.w_agg_running(lo, vd, vv,
                                                      kind.split("_")[-1])

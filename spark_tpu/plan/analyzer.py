@@ -203,7 +203,7 @@ class ResolveReferences(Rule):
                             all(p.resolved for p in e.partition_spec) and \
                             all(o.resolved for o in e.order_spec):
                         return WindowExpression(e.function, e.partition_spec,
-                                                e.order_spec)
+                                                e.order_spec, e.frame)
                     return e
                 return e
 

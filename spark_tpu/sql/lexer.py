@@ -21,7 +21,7 @@ KEYWORDS = {
     "timestamp", "values", "create", "table", "view", "temporary", "replace",
     "drop", "insert", "into", "describe", "show", "tables", "explain",
     "escape", "div", "over", "partition", "rows", "range", "unbounded",
-    "preceding", "following", "current", "intersect", "minus",
+    "preceding", "following", "current", "row", "intersect", "minus",
     "rollup", "cube", "grouping", "except",
 }
 

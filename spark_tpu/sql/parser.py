@@ -751,33 +751,49 @@ class Parser:
             orders.append(self.parse_sort_item(None))
             while self.eat_op(","):
                 orders.append(self.parse_sort_item(None))
+        frame = None
         if self.at_kw("rows", "range"):
-            self.next()
-            # only default-equivalent frames accepted
+            ftype = self.next().value.lower()
             if self.eat_kw("between"):
-                self._parse_frame_bound()
+                lo = self._parse_frame_bound(is_lower=True)
                 self.expect_kw("and")
-                self._parse_frame_bound()
+                hi = self._parse_frame_bound(is_lower=False)
             else:
-                self._parse_frame_bound()
+                lo = self._parse_frame_bound(is_lower=True)
+                hi = 0  # CURRENT ROW
+            if ftype == "range":
+                # only default-equivalent RANGE frames are supported
+                if (lo, hi) not in ((None, 0), (None, None)):
+                    raise ParseException(
+                        "RANGE frames with numeric bounds not supported; "
+                        "use ROWS")
+                frame = None if (lo, hi) == (None, 0) else ("rows", None, None)
+            else:
+                frame = ("rows", lo, hi)
         self.expect_op(")")
         from ..expr.window import UnresolvedWindowExpression
 
-        return UnresolvedWindowExpression(func, partition, orders)
+        return UnresolvedWindowExpression(func, partition, orders, frame)
 
-    def _parse_frame_bound(self):
+    def _parse_frame_bound(self, is_lower: bool):
+        """Returns a row offset: None = unbounded, 0 = current row,
+        -n preceding, +n following."""
         if self.eat_kw("unbounded"):
             if not (self.eat_kw("preceding") or self.eat_kw("following")):
                 raise ParseException("bad frame bound")
-            return
+            return None
         if self.eat_kw("current"):
             self.expect_kw("row")
-            return
+            return 0
         t = self.next()
         if t.kind != "num":
             raise ParseException("bad frame bound")
-        if not (self.eat_kw("preceding") or self.eat_kw("following")):
-            raise ParseException("bad frame bound")
+        n = int(t.value.rstrip("LlDdSs"))
+        if self.eat_kw("preceding"):
+            return -n
+        if self.eat_kw("following"):
+            return n
+        raise ParseException("bad frame bound")
 
     def parse_extract(self) -> E.Expression:
         self.expect_op("(")

@@ -102,3 +102,42 @@ def test_window_after_join_shuffle(spark):
     out = _d(a.repartition(4).select(
         "k", "v", F.row_number().over(w).alias("rn")).orderBy("k", "v"))
     assert out["rn"] == [1, 2, 1, 2, 1]
+
+
+def test_rows_frame_moving_average(spark):
+    import pyarrow as pa
+    from spark_tpu.api.window import Window
+
+    df = spark.createDataFrame(pa.table({
+        "g": ["a"] * 5, "t": [1, 2, 3, 4, 5],
+        "v": [10.0, 20.0, 30.0, 40.0, 50.0]}))
+    w = Window.partitionBy("g").orderBy("t").rowsBetween(-1, 1)
+    out = _d(df.select("t", F.sum("v").over(w).alias("ms"),
+                       F.avg("v").over(w).alias("ma")).orderBy("t"))
+    assert out["ms"] == [30.0, 60.0, 90.0, 120.0, 90.0]
+    assert out["ma"] == [15.0, 20.0, 30.0, 40.0, 45.0]
+
+
+def test_rows_frame_sql(spark):
+    import pyarrow as pa
+
+    spark.createDataFrame(pa.table({
+        "t": [1, 2, 3, 4], "v": [1, 2, 3, 4]})) \
+        .createOrReplaceTempView("wf")
+    out = spark.sql("""
+        SELECT t, sum(v) OVER (ORDER BY t
+            ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) AS s
+        FROM wf ORDER BY t""").toArrow().to_pydict()
+    assert out["s"] == [1, 3, 6, 9]
+
+
+def test_rows_frame_unbounded_following(spark):
+    import pyarrow as pa
+
+    spark.createDataFrame(pa.table({"t": [1, 2, 3], "v": [5, 6, 7]})) \
+        .createOrReplaceTempView("wf2")
+    out = spark.sql("""
+        SELECT t, sum(v) OVER (ORDER BY t
+            ROWS BETWEEN CURRENT ROW AND UNBOUNDED FOLLOWING) AS s
+        FROM wf2 ORDER BY t""").toArrow().to_pydict()
+    assert out["s"] == [18, 13, 7]
