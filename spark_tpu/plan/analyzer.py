@@ -432,6 +432,76 @@ class ResolveSubqueries(Rule):
         return plan.transform_up(rule)
 
 
+class ExtractWindowFromAggregate(Rule):
+    """Window functions inside a grouped SELECT evaluate over the grouped
+    rows (reference: Analyzer ExtractWindowExpressions' aggregate path):
+    Aggregate(g, outs-with-windows) → Project(outs', Aggregate(g, aggs)),
+    after which the Project-level window extraction applies."""
+
+    def apply(self, plan):
+        from ..expr.window import WindowExpression
+
+        def rule(node):
+            if not isinstance(node, Aggregate) or not node.expressions_resolved:
+                return node
+            if not any(isinstance(x, WindowExpression)
+                       for e in node.aggregate_exprs
+                       for x in e.iter_nodes()):
+                return node
+
+            from ..expr.expressions import AggregateFunction as AF
+
+            # every aggregate function (including those inside window specs)
+            # computes in the inner aggregate
+            funcs: list[AF] = []
+
+            def collect(e: Expression):
+                for x in e.iter_nodes():
+                    if isinstance(x, AF) and \
+                            not any(x.semantic_equals(f) for f in funcs):
+                        funcs.append(x)
+
+            for e in node.aggregate_exprs:
+                collect(e)
+
+            g_aliases: list[tuple[Expression, AttributeReference]] = []
+            inner_outs: list[Expression] = []
+            for i, g in enumerate(node.grouping_exprs):
+                if isinstance(g, AttributeReference):
+                    inner_outs.append(g)
+                    g_aliases.append((g, g))
+                else:
+                    al = Alias(g, f"_wg{i}")
+                    inner_outs.append(al)
+                    g_aliases.append((g, al.to_attribute()))
+            f_aliases = [Alias(f, f"_wa{i}") for i, f in enumerate(funcs)]
+            inner = Aggregate(node.grouping_exprs, inner_outs + f_aliases,
+                              node.child)
+
+            def fix(x: Expression) -> Expression:
+                if isinstance(x, AF):
+                    for f, al in zip(funcs, f_aliases):
+                        if x.semantic_equals(f):
+                            return al.to_attribute()
+                for g, a in g_aliases:
+                    if x.semantic_equals(g):
+                        return a
+                return x
+
+            outs = []
+            for e in node.aggregate_exprs:
+                if isinstance(e, Alias):
+                    outs.append(Alias(e.child.transform_up(fix), e.name,
+                                      e.expr_id))
+                elif isinstance(e, AttributeReference):
+                    outs.append(fix(e))
+                else:
+                    outs.append(e.transform_up(fix))
+            return Project(outs, inner)
+
+        return plan.transform_up(rule)
+
+
 class ExtractWindowExpressions(Rule):
     """Pull WindowExpressions out of projections into Window operators
     (reference: Analyzer ExtractWindowExpressions). Expressions sharing a
@@ -701,6 +771,7 @@ class Analyzer(RuleExecutor):
                 ResolveSubqueries(self),
                 ResolveAggsInSortHaving(cs),
                 ResolveSortHiddenRefs(cs),
+                ExtractWindowFromAggregate(),
                 ExtractWindowExpressions(),
                 ResolveAliases(),
             ]),
@@ -727,6 +798,7 @@ class Analyzer(RuleExecutor):
             ResolveSubqueries(self),
             ResolveAggsInSortHaving(cs),
             ResolveSortHiddenRefs(cs),
+            ExtractWindowFromAggregate(),
             ExtractWindowExpressions(),
             ResolveAliases(),
         ])

@@ -141,3 +141,22 @@ def test_rows_frame_unbounded_following(spark):
             ROWS BETWEEN CURRENT ROW AND UNBOUNDED FOLLOWING) AS s
         FROM wf2 ORDER BY t""").toArrow().to_pydict()
     assert out["s"] == [18, 13, 7]
+
+
+def test_window_over_aggregate_single_query(spark):
+    import pyarrow as pa
+
+    spark.createDataFrame(pa.table({
+        "store": [1, 1, 1, 2, 2],
+        "item": [10, 11, 12, 10, 11],
+        "rev": [5.0, 9.0, 7.0, 4.0, 8.0]})) \
+        .createOrReplaceTempView("woa")
+    out = spark.sql("""
+        SELECT * FROM (
+          SELECT store, item, SUM(rev) AS r,
+                 rank() OVER (PARTITION BY store ORDER BY SUM(rev) DESC) AS rnk
+          FROM woa GROUP BY store, item) t
+        WHERE rnk <= 2 ORDER BY store, rnk""").toArrow().to_pydict()
+    assert out["store"] == [1, 1, 2, 2]
+    assert out["item"] == [11, 12, 11, 10]
+    assert out["rnk"] == [1, 2, 1, 2]
