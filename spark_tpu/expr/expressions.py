@@ -544,6 +544,14 @@ class BinaryArithmetic(BinaryExpression):
 class Add(BinaryArithmetic):
     symbol = "+"
 
+    @property
+    def dtype(self):
+        if isinstance(self.right, IntervalLiteral):
+            return self.left.dtype
+        if isinstance(self.left, IntervalLiteral):
+            return self.right.dtype
+        return super().dtype
+
     def _date_result(self, lt, rt):
         if isinstance(lt, DateType) and isinstance(rt, IntegralType):
             return date
@@ -558,6 +566,16 @@ class Add(BinaryArithmetic):
         return ct
 
     def eval(self, ctx):
+        if isinstance(self.right, IntervalLiteral) or \
+                isinstance(self.left, IntervalLiteral):
+            iv = self.right if isinstance(self.right, IntervalLiteral) \
+                else self.left
+            other = self.left if iv is self.right else self.right
+            side = ctx.eval(other)
+            if not ctx.is_trace:
+                out_dt = side.dtype
+                return Val(out_dt, None, side.validity, None)
+            return _apply_interval(ctx, side, iv)
         lt = self.left.dtype if self.left.resolved else null_type
         rt = self.right.dtype if self.right.resolved else null_type
         if isinstance(lt, DateType) or isinstance(rt, DateType):
@@ -578,6 +596,12 @@ class Add(BinaryArithmetic):
 class Subtract(BinaryArithmetic):
     symbol = "-"
 
+    @property
+    def dtype(self):
+        if isinstance(self.right, IntervalLiteral):
+            return self.left.dtype
+        return super().dtype
+
     def _date_result(self, lt, rt):
         if isinstance(lt, DateType) and isinstance(rt, DateType):
             return int32
@@ -592,6 +616,11 @@ class Subtract(BinaryArithmetic):
         return ct
 
     def eval(self, ctx):
+        if isinstance(self.right, IntervalLiteral):
+            side = ctx.eval(self.left)
+            if not ctx.is_trace:
+                return Val(side.dtype, None, side.validity, None)
+            return _apply_interval(ctx, side, self.right.negated())
         lt = self.left.dtype if self.left.resolved else null_type
         rt = self.right.dtype if self.right.resolved else null_type
         if isinstance(lt, DateType):
@@ -1855,6 +1884,72 @@ def _days_from_civil(y, m, d):
     doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
     doe = yoe * 365 + jnp.floor_divide(yoe, 4) - jnp.floor_divide(yoe, 100) + doy
     return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+class IntervalLiteral(Expression):
+    """Calendar interval (months, days, microseconds) — only valid as an
+    operand of date/timestamp +/- (reference: CalendarIntervalType)."""
+
+    child_fields = ()
+
+    def __init__(self, months: int = 0, days: int = 0, micros: int = 0):
+        self.months = months
+        self.days = days
+        self.micros = micros
+
+    @property
+    def dtype(self):
+        raise TypeCheckError(
+            "INTERVAL can only be added to/subtracted from dates/timestamps")
+
+    @property
+    def resolved(self):
+        return True
+
+    @property
+    def nullable(self):
+        return False
+
+    def negated(self) -> "IntervalLiteral":
+        return IntervalLiteral(-self.months, -self.days, -self.micros)
+
+    def simple_string(self):
+        return f"interval({self.months}mo {self.days}d {self.micros}us)"
+
+
+def _apply_interval(ctx, side: "Val", iv: IntervalLiteral) -> "Val":
+    jnp = _jnp()
+    if isinstance(side.dtype, DateType):
+        data = side.data
+        if iv.days or iv.micros:
+            extra_days = iv.days + iv.micros // 86_400_000_000
+            data = data + jnp.int32(extra_days)
+        out = Val(date, data, side.validity, None)
+        if iv.months:
+            tmp = AddMonths.__new__(AddMonths)
+            # reuse the month-clamping math directly
+            y, m, d = _civil_from_days(out.data)
+            total = (y.astype(jnp.int64) * 12 + (m - 1)) + iv.months
+            ny = jnp.floor_divide(total, 12).astype(jnp.int32)
+            nm = (jnp.mod(total, 12) + 1).astype(jnp.int32)
+            nmt = total + 1
+            nmy = jnp.floor_divide(nmt, 12).astype(jnp.int32)
+            nmm = (jnp.mod(nmt, 12) + 1).astype(jnp.int32)
+            one = jnp.ones_like(nm)
+            dim = (_days_from_civil(nmy, nmm, one)
+                   - _days_from_civil(ny, nm, one)).astype(jnp.int32)
+            nd = jnp.minimum(d, dim)
+            out = Val(date, _days_from_civil(ny, nm, nd), side.validity, None)
+        return out
+    if isinstance(side.dtype, TimestampType):
+        if iv.months:
+            raise UnsupportedOperationError(
+                "month intervals on timestamps not supported yet")
+        delta = iv.days * 86_400_000_000 + iv.micros
+        return Val(timestamp, side.data + jnp.int64(delta), side.validity,
+                   None)
+    raise TypeCheckError(
+        f"cannot add INTERVAL to {side.dtype.simple_string()}")
 
 
 class _DatePart(UnaryExpression):

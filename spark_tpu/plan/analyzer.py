@@ -676,7 +676,12 @@ class CoerceDecimalArithmetic(Rule):
 
     def apply(self, plan):
         def fix(e: Expression) -> Expression:
-            if isinstance(e, (Add, Subtract)) and e.left.resolved and e.right.resolved:
+            from ..expr.expressions import IntervalLiteral
+
+            if isinstance(e, (Add, Subtract)) and e.left.resolved \
+                    and e.right.resolved \
+                    and not isinstance(e.left, IntervalLiteral) \
+                    and not isinstance(e.right, IntervalLiteral):
                 lt, rt = e.left.dtype, e.right.dtype
                 if isinstance(lt, DecimalType) and isinstance(rt, DecimalType) \
                         and lt.scale != rt.scale:

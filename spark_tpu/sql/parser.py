@@ -673,7 +673,7 @@ class Parser:
                 return E.Literal(_parse_ts_literal(s))
             self.i = save
         if self.at_kw("interval"):
-            raise ParseException("INTERVAL literals not yet supported")
+            return self.parse_interval()
         if self.at_kw("case"):
             return self.parse_case()
         if self.at_kw("cast"):
@@ -794,6 +794,46 @@ class Parser:
         if self.eat_kw("following"):
             return n
         raise ParseException("bad frame bound")
+
+    def parse_interval(self) -> E.Expression:
+        """INTERVAL [-]n unit [n unit ...], with quoted or bare numbers."""
+        self.expect_kw("interval")
+        months = days = micros = 0
+        saw = False
+        while True:
+            sign = 1
+            if self.eat_op("-"):
+                sign = -1
+            t = self.peek()
+            if t.kind == "num":
+                self.next()
+                n = sign * int(float(t.value.rstrip("LlDdSs")))
+            elif t.kind == "str":
+                self.next()
+                n = sign * int(float(t.value))
+            else:
+                break
+            unit = self.ident().lower().rstrip("s")
+            if unit == "year":
+                months += 12 * n
+            elif unit == "month":
+                months += n
+            elif unit == "week":
+                days += 7 * n
+            elif unit == "day":
+                days += n
+            elif unit == "hour":
+                micros += n * 3_600_000_000
+            elif unit == "minute":
+                micros += n * 60_000_000
+            elif unit == "second":
+                micros += n * 1_000_000
+            else:
+                raise ParseException(f"unknown interval unit {unit}")
+            saw = True
+        if not saw:
+            raise ParseException("empty INTERVAL literal")
+        return E.IntervalLiteral(months, days, micros)
 
     def parse_extract(self) -> E.Expression:
         self.expect_op("(")

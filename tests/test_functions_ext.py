@@ -112,3 +112,27 @@ def test_corr_with_nulls(spark):
     out = df.agg(F.corr("x", "y").alias("c")).toArrow().to_pydict()
     # only rows (1,2),(2,4) count → perfect correlation... but 2 points
     assert abs(out["c"][0] - 1.0) < 1e-9
+
+
+def test_interval_date_arithmetic(spark):
+    out = q(spark, """SELECT DATE '2000-01-31' + INTERVAL 1 MONTH AS m,
+                             DATE '2000-01-01' + INTERVAL 30 DAYS AS d,
+                             DATE '2000-03-01' - INTERVAL '1' DAY AS s,
+                             TIMESTAMP '2000-01-01 00:00:00' + INTERVAL 2 HOURS AS h""")
+    assert str(out["m"][0]) == "2000-02-29"
+    assert str(out["d"][0]) == "2000-01-31"
+    assert str(out["s"][0]) == "2000-02-29"
+    assert "02:00" in str(out["h"][0])
+
+
+def test_interval_in_predicate(spark):
+    import pyarrow as pa
+    import datetime
+
+    spark.createDataFrame(pa.table({
+        "d": pa.array([datetime.date(2000, 1, 5), datetime.date(2000, 3, 5)],
+                      pa.date32())})).createOrReplaceTempView("dts")
+    out = q(spark, """SELECT count(*) AS c FROM dts
+                      WHERE d BETWEEN DATE '2000-01-01'
+                                  AND DATE '2000-01-01' + INTERVAL 60 DAYS""")
+    assert out["c"] == [1]
