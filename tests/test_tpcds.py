@@ -159,7 +159,8 @@ def test_q42_shape_date_rollup(spark, tpcds):
 
 
 def test_window_rank_by_store(spark, tpcds):
-    """q44-style: rank items by revenue within store."""
+    """q44-style: rank items by revenue within store — window directly over
+    the grouped SELECT."""
     got = _df(spark, """
         SELECT * FROM (
           SELECT ss_store_sk, ss_item_sk, SUM(ss_ext_sales_price) AS rev,
@@ -167,20 +168,7 @@ def test_window_rank_by_store(spark, tpcds):
                               ORDER BY SUM(ss_ext_sales_price) DESC) AS rnk
           FROM store_sales GROUP BY ss_store_sk, ss_item_sk
         ) t WHERE rnk <= 3
-        ORDER BY ss_store_sk, rnk, ss_item_sk""") if False else None
-    # window-over-aggregate extraction is a known round-2 item; the
-    # two-step formulation works today:
-    agg = spark.sql("""
-        SELECT ss_store_sk, ss_item_sk, SUM(ss_ext_sales_price) AS rev
-        FROM store_sales GROUP BY ss_store_sk, ss_item_sk""")
-    agg.createOrReplaceTempView("store_item_rev")
-    got = _df(spark, """
-        SELECT * FROM (
-          SELECT ss_store_sk, ss_item_sk, rev,
-                 rank() OVER (PARTITION BY ss_store_sk
-                              ORDER BY rev DESC) AS rnk
-          FROM store_item_rev) t
-        WHERE rnk <= 3 ORDER BY ss_store_sk, rnk, ss_item_sk""")
+        ORDER BY ss_store_sk, rnk, ss_item_sk""")
 
     ss = tpcds["store_sales"]
     rev = (ss.groupby(["ss_store_sk", "ss_item_sk"], as_index=False)
@@ -204,3 +192,64 @@ def test_in_subquery_semi(spark, tpcds):
     music = set(it[it.i_category == "Music"].i_item_sk)
     want = int((ss.ss_item_sk.isin(music)).sum())
     assert got["c"].tolist() == [want]
+
+
+def test_q52_q55_brand_by_month(spark, tpcds):
+    got = _df(spark, """
+        SELECT d.d_year, i.i_brand_id AS brand_id, i.i_brand AS brand,
+               SUM(ss_ext_sales_price) AS ext_price
+        FROM date_dim d, store_sales ss, item i
+        WHERE d.d_date_sk = ss.ss_sold_date_sk
+          AND ss.ss_item_sk = i.i_item_sk
+          AND i.i_manufact_id = 13 AND d.d_moy = 11 AND d.d_year = 1999
+        GROUP BY d.d_year, i.i_brand_id, i.i_brand
+        ORDER BY d.d_year, ext_price DESC, brand_id""")
+    ss, dd, it = tpcds["store_sales"], tpcds["date_dim"], tpcds["item"]
+    j = (ss.merge(dd[(dd.d_moy == 11) & (dd.d_year == 1999)],
+                  left_on="ss_sold_date_sk", right_on="d_date_sk")
+         .merge(it[it.i_manufact_id == 13], left_on="ss_item_sk",
+                right_on="i_item_sk"))
+    want = (j.groupby(["d_year", "i_brand_id", "i_brand"], as_index=False)
+            ["ss_ext_sales_price"].sum()
+            .rename(columns={"ss_ext_sales_price": "ext_price",
+                             "i_brand_id": "brand_id", "i_brand": "brand"}))
+    _assert_frames(got, want[got.columns.tolist()],
+                   sort_by=["brand_id", "brand"])
+
+
+def test_q32_shape_interval_window(spark, tpcds):
+    """q32 core: sales within 90 days of a start date, vs 1.3x average."""
+    got = _df(spark, """
+        SELECT SUM(ss_ext_discount_amt) AS excess
+        FROM store_sales ss, date_dim d, item i
+        WHERE d.d_date_sk = ss.ss_sold_date_sk
+          AND ss.ss_item_sk = i.i_item_sk
+          AND i.i_manufact_id = 7
+          AND d.d_date BETWEEN DATE '1999-01-01'
+                           AND DATE '1999-01-01' + INTERVAL 90 DAYS
+          AND ss.ss_ext_discount_amt > (
+              SELECT 1.3 * avg(ss_ext_discount_amt)
+              FROM store_sales s2, date_dim d2
+              WHERE s2.ss_item_sk = ss.ss_item_sk
+                AND d2.d_date_sk = s2.ss_sold_date_sk
+                AND d2.d_date BETWEEN DATE '1999-01-01'
+                                  AND DATE '1999-01-01' + INTERVAL 90 DAYS)""")
+
+    import datetime
+
+    ss, dd, it = tpcds["store_sales"], tpcds["date_dim"], tpcds["item"]
+    lo = datetime.date(1999, 1, 1)
+    hi = datetime.date(1999, 4, 1)  # +90 days
+    dwin = dd[(dd.d_date >= lo) & (dd.d_date <= hi)]
+    j = ss.merge(dwin, left_on="ss_sold_date_sk", right_on="d_date_sk")
+    avg_per_item = j.groupby("ss_item_sk")["ss_ext_discount_amt"] \
+        .transform("mean")
+    jj = j[j.ss_ext_discount_amt > 1.3 * avg_per_item]
+    jj = jj.merge(it[it.i_manufact_id == 7], left_on="ss_item_sk",
+                  right_on="i_item_sk")
+    want = jj.ss_ext_discount_amt.sum()
+    got_v = got["excess"][0]
+    if want == 0:
+        assert got_v is None or abs(got_v) < 1e-9
+    else:
+        assert abs(got_v - want) < 1e-6
