@@ -1,0 +1,3 @@
+from .frame import (  # noqa: F401
+    DataFrame, Series, from_pandas, read_csv, read_parquet,
+)

@@ -1,0 +1,274 @@
+"""pandas API on spark_tpu.
+
+Role of the reference's pandas-on-Spark layer (python/pyspark/pandas/ —
+pandas DataFrame semantics compiled to engine plans). This shim covers the
+working core: column access/assignment, boolean filtering, arithmetic,
+groupby aggregation, sort/merge/head/describe — every operation stays lazy
+in the engine until materialization (`to_pandas`, len, repr).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import spark_tpu.api.functions as F
+from ..api.column import Column as EngineColumn
+from ..api.dataframe import DataFrame as EngineFrame
+
+
+def _session():
+    from ..api.session import TpuSession
+
+    s = TpuSession._active
+    if s is None:
+        s = TpuSession("pandas-api")
+    return s
+
+
+def read_parquet(path: str) -> "DataFrame":
+    return DataFrame(_session().read.parquet(path))
+
+
+def read_csv(path: str, **kw) -> "DataFrame":
+    return DataFrame(_session().read.csv(path, **kw))
+
+
+def from_pandas(pdf) -> "DataFrame":
+    return DataFrame(_session().createDataFrame(pdf))
+
+
+class Series:
+    """A lazy column bound to its frame."""
+
+    def __init__(self, frame: "DataFrame", col: EngineColumn, name: str):
+        self._frame = frame
+        self._col = col
+        self.name = name
+
+    # arithmetic / comparison return new Series
+    def _wrap(self, col: EngineColumn) -> "Series":
+        return Series(self._frame, col, self.name)
+
+    def __add__(self, o):
+        return self._wrap(self._col + _unwrap(o))
+
+    def __sub__(self, o):
+        return self._wrap(self._col - _unwrap(o))
+
+    def __mul__(self, o):
+        return self._wrap(self._col * _unwrap(o))
+
+    def __truediv__(self, o):
+        return self._wrap(self._col / _unwrap(o))
+
+    def __eq__(self, o):  # type: ignore[override]
+        return self._wrap(self._col == _unwrap(o))
+
+    def __ne__(self, o):  # type: ignore[override]
+        return self._wrap(self._col != _unwrap(o))
+
+    def __lt__(self, o):
+        return self._wrap(self._col < _unwrap(o))
+
+    def __le__(self, o):
+        return self._wrap(self._col <= _unwrap(o))
+
+    def __gt__(self, o):
+        return self._wrap(self._col > _unwrap(o))
+
+    def __ge__(self, o):
+        return self._wrap(self._col >= _unwrap(o))
+
+    def __and__(self, o):
+        return self._wrap(self._col & _unwrap(o))
+
+    def __or__(self, o):
+        return self._wrap(self._col | _unwrap(o))
+
+    def __invert__(self):
+        return self._wrap(~self._col)
+
+    def isin(self, values):
+        return self._wrap(self._col.isin(list(values)))
+
+    def isna(self):
+        return self._wrap(self._col.isNull())
+
+    def fillna(self, v):
+        return self._wrap(F.coalesce(self._col, F.lit(v)))
+
+    def str_upper(self):
+        return self._wrap(F.upper(self._col))
+
+    # reductions materialize
+    def _agg(self, fn):
+        out = self._frame._df.agg(fn(self._col).alias("v")).collect()
+        return out[0]["v"]
+
+    def sum(self):  # noqa: A003
+        return self._agg(F.sum)
+
+    def mean(self):
+        return self._agg(F.avg)
+
+    def min(self):  # noqa: A003
+        return self._agg(F.min)
+
+    def max(self):  # noqa: A003
+        return self._agg(F.max)
+
+    def count(self):
+        return self._agg(F.count)
+
+    def nunique(self):
+        return self._agg(F.countDistinct)
+
+    def to_pandas(self):
+        import pandas as pd
+
+        t = self._frame._df.select(self._col.alias(self.name)).toArrow()
+        return t.to_pandas()[self.name]
+
+    def __repr__(self):
+        return repr(self.to_pandas())
+
+
+def _unwrap(o):
+    if isinstance(o, Series):
+        return o._col
+    return o
+
+
+class GroupBy:
+    def __init__(self, frame: "DataFrame", keys: list[str]):
+        self._frame = frame
+        self._keys = keys
+
+    def agg(self, spec: dict) -> "DataFrame":
+        fns = {"sum": F.sum, "mean": F.avg, "avg": F.avg, "min": F.min,
+               "max": F.max, "count": F.count, "nunique": F.countDistinct,
+               "std": F.stddev}
+        aggs = []
+        for col, how in spec.items():
+            hows = how if isinstance(how, (list, tuple)) else [how]
+            for h in hows:
+                name = col if len(hows) == 1 else f"{col}_{h}"
+                aggs.append(fns[h](col).alias(name))
+        return DataFrame(self._frame._df.groupBy(*self._keys).agg(*aggs))
+
+    def sum(self):  # noqa: A003
+        cols = [c for c in self._frame.columns if c not in self._keys
+                and self._frame._numeric(c)]
+        return self.agg({c: "sum" for c in cols})
+
+    def mean(self):
+        cols = [c for c in self._frame.columns if c not in self._keys
+                and self._frame._numeric(c)]
+        return self.agg({c: "mean" for c in cols})
+
+    def count(self):
+        return DataFrame(self._frame._df.groupBy(*self._keys).count())
+
+    def size(self):
+        return self.count()
+
+
+class DataFrame:
+    def __init__(self, df: EngineFrame):
+        self._df = df
+
+    # --- metadata ------------------------------------------------------
+    @property
+    def columns(self) -> list[str]:
+        return self._df.columns
+
+    @property
+    def shape(self):
+        return (len(self), len(self.columns))
+
+    def _numeric(self, name: str) -> bool:
+        from ..types import NumericType
+
+        for f in self._df.schema:
+            if f.name == name:
+                return isinstance(f.dataType, NumericType)
+        return False
+
+    def __len__(self):
+        return self._df.count()
+
+    # --- selection -----------------------------------------------------
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            return Series(self, F.col(key), key)
+        if isinstance(key, list):
+            return DataFrame(self._df.select(*key))
+        if isinstance(key, Series):  # boolean mask
+            return DataFrame(self._df.filter(key._col))
+        raise KeyError(key)
+
+    def __setitem__(self, name: str, value):
+        if isinstance(value, Series):
+            self._df = self._df.withColumn(name, value._col)
+        else:
+            self._df = self._df.withColumn(name, F.lit(value))
+
+    def assign(self, **kw) -> "DataFrame":
+        df = self._df
+        for name, v in kw.items():
+            df = df.withColumn(name, v._col if isinstance(v, Series)
+                               else F.lit(v))
+        return DataFrame(df)
+
+    def drop(self, columns) -> "DataFrame":
+        cols = [columns] if isinstance(columns, str) else list(columns)
+        return DataFrame(self._df.drop(*cols))
+
+    def rename(self, columns: dict) -> "DataFrame":
+        df = self._df
+        for old, new in columns.items():
+            df = df.withColumnRenamed(old, new)
+        return DataFrame(df)
+
+    def dropna(self, subset=None) -> "DataFrame":
+        cols = subset or self.columns
+        df = self._df
+        for c in cols:
+            df = df.filter(F.col(c).isNotNull())
+        return DataFrame(df)
+
+    def drop_duplicates(self, subset=None) -> "DataFrame":
+        return DataFrame(self._df.dropDuplicates(subset))
+
+    # --- compute -------------------------------------------------------
+    def groupby(self, by) -> GroupBy:
+        keys = [by] if isinstance(by, str) else list(by)
+        return GroupBy(self, keys)
+
+    def sort_values(self, by, ascending=True) -> "DataFrame":
+        keys = [by] if isinstance(by, str) else list(by)
+        return DataFrame(self._df.orderBy(*keys, ascending=ascending))
+
+    def merge(self, other: "DataFrame", on=None, how: str = "inner"
+              ) -> "DataFrame":
+        return DataFrame(self._df.join(other._df, on=on, how=how))
+
+    def head(self, n: int = 5):
+        return self._df.limit(n).toPandas()
+
+    def describe(self):
+        return self._df.describe().toPandas()
+
+    def value_counts(self, col: str):
+        return (self._df.groupBy(col).count()
+                .orderBy(F.col("count").desc()).toPandas())
+
+    # --- materialization ----------------------------------------------
+    def to_pandas(self):
+        return self._df.toPandas()
+
+    def to_spark(self) -> EngineFrame:
+        return self._df
+
+    def __repr__(self):
+        return repr(self._df.limit(20).toPandas())

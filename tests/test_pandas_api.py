@@ -1,0 +1,62 @@
+"""pandas-API shim tests (reference: pyspark.pandas suites, reduced)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+
+@pytest.fixture()
+def psdf(spark):
+    import spark_tpu.pandas as ps
+
+    pdf = pd.DataFrame({
+        "city": ["sf", "sf", "nyc", "nyc", "la"],
+        "pop": [10, 20, 30, 40, 50],
+        "area": [1.0, 2.0, 3.0, 4.0, 5.0],
+    })
+    return ps.from_pandas(pdf)
+
+
+def test_select_filter_len(psdf):
+    assert psdf.shape == (5, 3)
+    big = psdf[psdf["pop"] > 25]
+    assert len(big) == 3
+    assert set(big[["city"]].to_pandas()["city"]) == {"nyc", "la"}
+
+
+def test_assign_and_arithmetic(psdf):
+    out = psdf.assign(density=psdf["pop"] / psdf["area"]).to_pandas()
+    assert list(out["density"]) == [10.0] * 5
+
+
+def test_groupby_agg(psdf):
+    out = (psdf.groupby("city").agg({"pop": "sum", "area": "mean"})
+           .sort_values("city").to_pandas())
+    assert list(out["city"]) == ["la", "nyc", "sf"]
+    assert list(out["pop"]) == [50, 70, 30]
+
+
+def test_series_reductions(psdf):
+    assert psdf["pop"].sum() == 150
+    assert psdf["pop"].mean() == 30
+    assert psdf["city"].nunique() == 3
+
+
+def test_merge(psdf, spark):
+    import spark_tpu.pandas as ps
+
+    other = ps.from_pandas(pd.DataFrame({
+        "city": ["sf", "nyc"], "state": ["CA", "NY"]}))
+    out = psdf.merge(other, on="city").sort_values(["city", "pop"]).to_pandas()
+    assert len(out) == 4
+    assert set(out["state"]) == {"CA", "NY"}
+
+
+def test_value_counts_dropna(psdf):
+    vc = psdf.value_counts("city")
+    assert vc.iloc[0]["count"] == 2
+    import spark_tpu.pandas as ps
+
+    pdf = pd.DataFrame({"x": [1.0, None, 3.0]})
+    assert len(ps.from_pandas(pdf).dropna()) == 2
