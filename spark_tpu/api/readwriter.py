@@ -162,7 +162,19 @@ class DataFrameWriter:
                 f.write(_json.dumps(row, default=str) + "\n")
 
     def saveAsTable(self, name: str) -> None:
-        self.df.createOrReplaceTempView(name)
+        wh = self.df.session.catalog_.external
+        if wh is None:
+            self.df.createOrReplaceTempView(name)
+            return
+        mode = {"errorifexists": "error"}.get(self._mode, self._mode)
+        wh.save_table(name, self.df.toArrow(), mode=mode)
+
+    def insertInto(self, name: str) -> None:
+        wh = self.df.session.catalog_.external
+        if wh is not None and name in wh.list_tables():
+            wh.save_table(name, self.df.toArrow(), mode="append")
+            return
+        raise AnalysisException(f"table {name} is not a saved table")
 
     def save(self, path: str) -> None:
         getattr(self, self._format)(path)

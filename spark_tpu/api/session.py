@@ -90,6 +90,11 @@ class TpuSession:
         self.name = name
         self.conf = SQLConf(conf)
         self.catalog_ = Catalog(self.conf.case_sensitive)
+        wh_dir = self.conf.get("spark.sql.warehouse.dir")
+        if wh_dir:
+            from ..plan.warehouse import Warehouse
+
+            self.catalog_.external = Warehouse(str(wh_dir))
         self._analyzer = Analyzer(self.catalog_, self.conf.case_sensitive)
         self._optimizer = Optimizer()
         self._metrics = Metrics()

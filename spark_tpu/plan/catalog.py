@@ -18,6 +18,7 @@ class Catalog:
     def __init__(self, case_sensitive: bool = False):
         self._tables: dict[str, LogicalPlan] = {}
         self.case_sensitive = case_sensitive
+        self.external = None  # Warehouse (plan/warehouse.py) when configured
 
     def _norm(self, name: str) -> str:
         return name if self.case_sensitive else name.lower()
@@ -33,6 +34,8 @@ class Catalog:
         p = self._tables.get(self._norm(name))
         if p is None and len(name_parts) > 1:
             p = self._tables.get(self._norm(name_parts[-1]))
+        if p is None and self.external is not None:
+            p = self.external.lookup(self._norm(name_parts[-1]))
         if p is None:
             raise AnalysisException(
                 f"Table or view not found: {name}",
@@ -40,4 +43,7 @@ class Catalog:
         return p
 
     def list_tables(self) -> list[str]:
-        return sorted(self._tables)
+        out = set(self._tables)
+        if self.external is not None:
+            out |= set(self.external.list_tables())
+        return sorted(out)

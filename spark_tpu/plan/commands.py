@@ -61,6 +61,13 @@ class SetCommand(Command):
     value: Optional[str]
 
 
+@dataclass
+class InsertIntoCommand(Command):
+    name: str
+    query: LogicalPlan
+    overwrite: bool = False
+
+
 def run_command(session, cmd: Command):
     """Execute a command; returns a DataFrame of result rows."""
     import pyarrow as pa
@@ -80,6 +87,12 @@ def run_command(session, cmd: Command):
         if cmd.materialize:
             df = DataFrame(session, plan)
             table = df.toArrow()
+            wh = session.catalog_.external
+            if wh is not None:
+                # managed table in the warehouse
+                wh.save_table(cmd.name, table,
+                              mode="overwrite" if cmd.replace else "error")
+                return df_of(pa.table({"result": pa.array([], pa.string())}))
             attrs = list(df.query_execution.analyzed.output)
             from .logical import LocalRelation
 
@@ -89,10 +102,42 @@ def run_command(session, cmd: Command):
 
     if isinstance(cmd, DropRelationCommand):
         dropped = session.catalog_.drop(cmd.name)
+        if not dropped and session.catalog_.external is not None:
+            dropped = session.catalog_.external.drop_table(cmd.name)
         if not dropped and not cmd.if_exists:
             raise AnalysisException(
                 f"Table or view not found: {cmd.name}",
                 error_class="TABLE_OR_VIEW_NOT_FOUND")
+        return df_of(pa.table({"result": pa.array([], pa.string())}))
+
+    if isinstance(cmd, InsertIntoCommand):
+        df = DataFrame(session, cmd.query)
+        table = df.toArrow()
+        wh = session.catalog_.external
+        if wh is not None and cmd.name in wh.list_tables():
+            target = wh.lookup(cmd.name)
+            names = [a.name for a in target.output]
+            if table.num_columns != len(names):
+                raise AnalysisException(
+                    f"INSERT INTO {cmd.name}: {table.num_columns} columns "
+                    f"provided, table has {len(names)}")
+            table = table.rename_columns(names)  # positional, like the ref
+            wh.save_table(cmd.name, table,
+                          mode="overwrite" if cmd.overwrite else "append")
+            return df_of(pa.table({"result": pa.array([], pa.string())}))
+        # temp view append: concat into the registered relation
+        from .logical import LocalRelation
+
+        existing = session.catalog_.lookup(cmd.name.split("."))
+        if not isinstance(existing, LocalRelation):
+            raise AnalysisException(
+                f"INSERT INTO requires a saved table or materialized view: "
+                f"{cmd.name}")
+        table = table.rename_columns(existing.table.column_names)
+        merged = table if cmd.overwrite else pa.concat_tables(
+            [existing.table, table], promote_options="permissive")
+        session.catalog_.register(
+            cmd.name, LocalRelation(list(existing.attrs), merged))
         return df_of(pa.table({"result": pa.array([], pa.string())}))
 
     if isinstance(cmd, ShowTablesCommand):

@@ -1,0 +1,96 @@
+"""Persistent warehouse catalog.
+
+Role of the reference's external catalog + warehouse layout
+(sql/hive metastore integration, sql/core InMemoryCatalog +
+spark.sql.warehouse.dir): saved tables live as parquet under the warehouse
+directory with a JSON catalog file; sessions reload it on first use.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+
+class Warehouse:
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        os.makedirs(path, exist_ok=True)
+
+    @property
+    def _catalog_file(self) -> str:
+        return os.path.join(self.path, "_catalog.json")
+
+    def _load(self) -> dict:
+        if os.path.exists(self._catalog_file):
+            with open(self._catalog_file) as f:
+                return json.load(f)
+        return {"tables": {}}
+
+    def _save(self, cat: dict) -> None:
+        tmp = self._catalog_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(cat, f, indent=2)
+        os.replace(tmp, self._catalog_file)
+
+    def table_path(self, name: str) -> str:
+        return os.path.join(self.path, name)
+
+    def save_table(self, name: str, table, mode: str = "error") -> str:
+        """Write an Arrow table as a managed parquet table."""
+        import pyarrow.parquet as pq
+
+        from ..errors import AnalysisException
+
+        with self._lock:
+            cat = self._load()
+            exists = name in cat["tables"]
+            p = self.table_path(name)
+            if exists and mode in ("error", "errorifexists"):
+                raise AnalysisException(
+                    f"Table {name} already exists",
+                    error_class="TABLE_OR_VIEW_ALREADY_EXISTS")
+            os.makedirs(p, exist_ok=True)
+            if mode == "append" and exists:
+                i = len([f for f in os.listdir(p) if f.endswith(".parquet")])
+                pq.write_table(table, os.path.join(p, f"part-{i:05d}.parquet"))
+            else:
+                for f in os.listdir(p):
+                    if f.endswith(".parquet"):
+                        os.remove(os.path.join(p, f))
+                pq.write_table(table, os.path.join(p, "part-00000.parquet"))
+            cat["tables"][name] = {"format": "parquet", "path": p}
+            self._save(cat)
+            return p
+
+    def drop_table(self, name: str) -> bool:
+        import shutil
+
+        with self._lock:
+            cat = self._load()
+            if name not in cat["tables"]:
+                return False
+            p = cat["tables"].pop(name)["path"]
+            self._save(cat)
+        shutil.rmtree(p, ignore_errors=True)
+        return True
+
+    def list_tables(self) -> list[str]:
+        return sorted(self._load()["tables"])
+
+    def lookup(self, name: str):
+        """Returns a LogicalRelation for a saved table, or None."""
+        cat = self._load()
+        meta = cat["tables"].get(name)
+        if meta is None:
+            return None
+        from ..io.sources import ParquetSource
+        from ..expr.expressions import AttributeReference
+        from .logical import LogicalRelation
+
+        src = ParquetSource(meta["path"])
+        attrs = [AttributeReference(f.name, f.dataType, f.nullable)
+                 for f in src.schema.fields]
+        return LogicalRelation(src, attrs, name)

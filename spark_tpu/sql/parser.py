@@ -138,6 +138,18 @@ class Parser:
                 self.expect_kw("exists")
                 if_exists = True
             return C.DropRelationCommand(self._qualified_name(), if_exists)
+        if self.eat_kw("insert"):
+            overwrite = False
+            if self.peek().value.lower() == "overwrite":
+                self.next()
+                overwrite = True
+                self.eat_kw("table")
+            else:
+                self.expect_kw("into")
+                self.eat_kw("table")
+            name = self._qualified_name()
+            q = self.parse_query()
+            return C.InsertIntoCommand(name, q, overwrite)
         if self.eat_kw("show"):
             self.expect_kw("tables")
             return C.ShowTablesCommand()

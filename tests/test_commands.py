@@ -99,3 +99,57 @@ def test_mixed_distinct_global(spark):
                  F.countDistinct("x").alias("d")).toArrow().to_pydict()
     assert out["s"] == [20]
     assert out["d"] == [2]
+
+
+def test_warehouse_tables_and_insert(tmp_path):
+    import pyarrow as pa
+
+    from spark_tpu import TpuSession
+
+    s = TpuSession("wh", {"spark.sql.warehouse.dir": str(tmp_path / "wh"),
+                          "spark.tpu.batch.capacity": 1 << 12})
+    try:
+        s.sql("CREATE TABLE managed AS SELECT col1 AS x FROM (VALUES (1), (2))")
+        assert "managed" in s.sql("SHOW TABLES").toArrow().to_pydict()["tableName"]
+        assert s.sql("SELECT sum(x) AS s FROM managed").toArrow() \
+            .to_pydict()["s"] == [3]
+
+        s.sql("INSERT INTO managed VALUES (10)")
+        assert s.sql("SELECT sum(x) AS s FROM managed").toArrow() \
+            .to_pydict()["s"] == [13]
+
+        s.sql("INSERT OVERWRITE managed VALUES (7)")
+        assert s.sql("SELECT sum(x) AS s FROM managed").toArrow() \
+            .to_pydict()["s"] == [7]
+
+        # persists across sessions sharing the warehouse dir
+        s2 = TpuSession("wh2", {"spark.sql.warehouse.dir": str(tmp_path / "wh"),
+                                "spark.tpu.batch.capacity": 1 << 12})
+        assert s2.sql("SELECT x FROM managed").toArrow().to_pydict()["x"] == [7]
+        s2.stop()
+
+        s.sql("DROP TABLE managed")
+        from spark_tpu.errors import AnalysisException
+        import pytest as _pt
+
+        with _pt.raises(AnalysisException):
+            s.sql("SELECT * FROM managed").toArrow()
+    finally:
+        s.stop()
+
+
+def test_save_as_table_api(tmp_path):
+    import pyarrow as pa
+
+    from spark_tpu import TpuSession
+
+    s = TpuSession("wh3", {"spark.sql.warehouse.dir": str(tmp_path / "w3"),
+                           "spark.tpu.batch.capacity": 1 << 12})
+    try:
+        df = s.createDataFrame(pa.table({"a": [1, 2]}))
+        df.write.saveAsTable("t_api")
+        df.write.insertInto("t_api")
+        assert s.sql("SELECT count(*) AS c FROM t_api").toArrow() \
+            .to_pydict()["c"] == [4]
+    finally:
+        s.stop()
