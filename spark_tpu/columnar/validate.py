@@ -1,0 +1,65 @@
+"""Batch invariant validation (debug mode).
+
+Role of the reference's test-side sanitizers (SURVEY.md §5 'Race detection /
+sanitizers': DebugFilesystem, shuffle checksums, ThreadAudit) for the
+columnar layer: with spark.tpu.debug.validateBatches=true every operator
+boundary checks batch invariants — shape agreement, dictionary code bounds,
+validity/mask dtypes — catching kernel bugs at the operator that produced
+them instead of rows downstream.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..errors import ExecutionError
+from ..types import StringType
+from .batch import ColumnarBatch
+
+
+def validate_batch(batch: ColumnarBatch, site: str = "") -> None:
+    cap = batch.capacity
+    if len(batch.columns) != len(batch.schema.fields):
+        raise ExecutionError(
+            f"[{site}] column count {len(batch.columns)} != schema "
+            f"{len(batch.schema.fields)}")
+    mask = np.asarray(batch.row_mask)
+    if mask.dtype != np.bool_ or mask.shape != (cap,):
+        raise ExecutionError(f"[{site}] bad row mask {mask.dtype} {mask.shape}")
+    for f, c in zip(batch.schema.fields, batch.columns):
+        d = np.asarray(c.data)
+        if d.shape != (cap,):
+            raise ExecutionError(
+                f"[{site}] column {f.name}: shape {d.shape} != cap {cap}")
+        if d.dtype != f.dataType.device_dtype:
+            raise ExecutionError(
+                f"[{site}] column {f.name}: dtype {d.dtype} != "
+                f"{f.dataType.device_dtype}")
+        if c.validity is not None:
+            v = np.asarray(c.validity)
+            if v.dtype != np.bool_ or v.shape != (cap,):
+                raise ExecutionError(
+                    f"[{site}] column {f.name}: bad validity "
+                    f"{v.dtype} {v.shape}")
+        if isinstance(f.dataType, StringType):
+            if c.dictionary is None:
+                raise ExecutionError(
+                    f"[{site}] string column {f.name} missing dictionary")
+            live = d[mask]
+            if c.validity is not None:
+                live = d[mask & np.asarray(c.validity)]
+            n = max(len(c.dictionary), 1)
+            if live.size and (live.min() < 0 or live.max() >= n):
+                raise ExecutionError(
+                    f"[{site}] column {f.name}: code out of range "
+                    f"[{live.min()}, {live.max()}] for dict size {n}")
+
+
+def maybe_validate(parts, ctx, site: str):
+    if str(ctx.conf.get("spark.tpu.debug.validateBatches", "false")) \
+            .lower() != "true":
+        return parts
+    for p in parts:
+        for b in p:
+            validate_batch(b, site)
+    return parts

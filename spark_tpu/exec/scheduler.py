@@ -132,6 +132,10 @@ class DAGScheduler:
                     self._post("stageSubmitted", stage)
                     t0 = time.perf_counter()
                     stage.result = stage.root.execute(self.ctx)
+                    from ..columnar.validate import maybe_validate
+
+                    maybe_validate(stage.result, self.ctx,
+                                   f"stage-{stage.stage_id}")
                     self.ctx.metrics.add("scheduler.stages_completed")
                     self._post("stageCompleted", stage,
                                dur=(time.perf_counter() - t0) * 1000)

@@ -172,3 +172,39 @@ def test_cross_join():
     r = cross_join(pmask, bmask, 16)
     om = np.asarray(r.out_mask)
     assert int(om.sum()) == 4  # 2 live probe x 2 live build
+
+
+def test_batch_validation_mode(spark):
+    import pyarrow as pa
+
+    spark.conf.set("spark.tpu.debug.validateBatches", "true")
+    try:
+        df = spark.createDataFrame(pa.table({
+            "k": ["a", "b", "a"], "v": [1, 2, 3]}))
+        import spark_tpu.api.functions as F
+
+        out = (df.repartition(3).groupBy("k")
+               .agg(F.sum("v").alias("s")).orderBy("k")
+               .toArrow().to_pydict())
+        assert out["s"] == [4, 2]
+    finally:
+        spark.conf.unset("spark.tpu.debug.validateBatches")
+
+
+def test_validate_batch_catches_bad_codes():
+    import jax.numpy as jnp
+    import pytest as _pt
+
+    from spark_tpu.columnar.batch import Column, ColumnarBatch, StringDict
+    from spark_tpu.columnar.validate import validate_batch
+    from spark_tpu.errors import ExecutionError
+    from spark_tpu.types import StructField, StructType, string
+
+    schema = StructType([StructField("s", string, False)])
+    bad = ColumnarBatch(
+        schema,
+        [Column(string, jnp.asarray(np.array([5, 0], np.int32)), None,
+                StringDict(["only"]))],
+        jnp.asarray(np.array([True, True])), num_rows=2)
+    with _pt.raises(ExecutionError, match="out of range"):
+        validate_batch(bad, "test")
