@@ -143,10 +143,6 @@ class QueryExecution:
                     error=f"{type(e).__name__}: {e}"))
             raise
 
-    @staticmethod
-    def _noop():
-        pass
-
     def explain_string(self, mode: str = "formatted") -> str:
         parts = [
             "== Analyzed Logical Plan ==", self.analyzed.tree_string(),
