@@ -40,7 +40,8 @@ def main() -> None:
     from spark_tpu.expr.expressions import AttributeReference
 
     session = TpuSession("bench", {
-        "spark.tpu.batch.capacity": 1 << 22,
+        # one 16M-row tile: the whole aggregation is a single fused program
+        "spark.tpu.batch.capacity": 1 << 24,
         "spark.sql.shuffle.partitions": 1,
     })
 

@@ -372,6 +372,12 @@ class DataFrame:
 
         return DataFrameWriter(self)
 
+    @property
+    def stat(self):
+        from .stat import DataFrameStatFunctions
+
+        return DataFrameStatFunctions(self)
+
 
 def _fmt(v, truncate: bool) -> str:
     s = "NULL" if v is None else str(v)

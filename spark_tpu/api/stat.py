@@ -1,0 +1,87 @@
+"""DataFrame statistic functions (df.stat).
+
+Role of the reference's DataFrameStatFunctions (sql/core/.../
+DataFrameStatFunctions.scala backed by StatFunctions.scala): correlation,
+covariance, quantiles, contingency tables, frequent items, stratified
+sampling — all expressed as engine queries.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+import spark_tpu.api.functions as F
+
+
+class DataFrameStatFunctions:
+    def __init__(self, df):
+        self.df = df
+
+    def corr(self, col1: str, col2: str) -> float:
+        out = self.df.agg(F.corr(col1, col2).alias("c")).collect()
+        return float(out[0]["c"])
+
+    def cov(self, col1: str, col2: str) -> float:
+        out = self.df.agg(F.covar_samp(col1, col2).alias("c")).collect()
+        return float(out[0]["c"])
+
+    def approxQuantile(self, col, probabilities: Sequence[float],
+                       relativeError: float = 0.0):
+        """Exact quantiles via the device sort (the reference's
+        Greenwald-Khanna sketch trades accuracy for one pass; our sort is
+        already the aggregation substrate, so exact is the cheap option)."""
+        cols = [col] if isinstance(col, str) else list(col)
+        sorted_df = self.df.select(*cols)
+        table = sorted_df.toArrow()
+        out = []
+        for c in cols:
+            vals = np.sort(np.asarray(
+                table.column(c).drop_null().to_numpy(zero_copy_only=False),
+                dtype=np.float64))
+            if len(vals) == 0:
+                out.append([float("nan")] * len(probabilities))
+                continue
+            qs = []
+            for p in probabilities:
+                idx = min(int(p * len(vals)), len(vals) - 1)
+                qs.append(float(vals[idx]))
+            out.append(qs)
+        return out[0] if isinstance(col, str) else out
+
+    def freqItems(self, cols: Sequence[str], support: float = 0.01):
+        """Frequent items per column (reference: StatFunctions.freqItems)."""
+        n = self.df.count()
+        threshold = max(int(n * support), 1)
+        result = {}
+        for c in cols:
+            counts = (self.df.groupBy(c).agg(F.count("*").alias("cnt"))
+                      .filter(F.col("cnt") >= threshold)
+                      .toArrow().to_pydict())
+            result[c + "_freqItems"] = counts[c]
+        return result
+
+    def crosstab(self, col1: str, col2: str):
+        """Contingency table as a DataFrame."""
+        import pyarrow as pa
+
+        counts = (self.df.groupBy(col1, col2)
+                  .agg(F.count("*").alias("cnt")).toArrow().to_pydict())
+        rows = sorted(set(map(str, counts[col1])))
+        cols = sorted(set(map(str, counts[col2])))
+        grid = {r: {c: 0 for c in cols} for r in rows}
+        for r, c, n in zip(counts[col1], counts[col2], counts["cnt"]):
+            grid[str(r)][str(c)] = n
+        data = {f"{col1}_{col2}": rows}
+        for c in cols:
+            data[c] = [grid[r][c] for r in rows]
+        return self.df.session.createDataFrame(pa.table(data))
+
+    def sampleBy(self, col: str, fractions: dict, seed: int = 42):
+        """Stratified sampling: per-stratum Bernoulli fractions."""
+        out = None
+        for value, frac in fractions.items():
+            stratum = self.df.filter(F.col(col) == value).sample(frac, seed)
+            out = stratum if out is None else out.union(stratum)
+        return out if out is not None else self.df.limit(0)

@@ -39,3 +39,30 @@ def test_describe(spark):
     assert out["v"][0] == "4"
     assert float(out["v"][1]) == 2.5
     assert "name" not in out  # non-numeric excluded
+
+
+def test_stat_functions(spark):
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, 400)
+    y = 3 * x + rng.normal(0, 0.1, 400)
+    cat = ["a" if v > 0 else "b" for v in x]
+    df = spark.createDataFrame(pa.table({"x": x, "y": y, "cat": cat}))
+
+    assert abs(df.stat.corr("x", "y") - np.corrcoef(x, y)[0, 1]) < 1e-6
+    assert abs(df.stat.cov("x", "y") - np.cov(x, y, ddof=1)[0, 1]) < 1e-6
+
+    qs = df.stat.approxQuantile("x", [0.0, 0.5, 1.0])
+    assert qs[0] == x.min() and qs[2] == x.max()
+    assert abs(qs[1] - np.median(x)) < 0.2
+
+    fi = df.stat.freqItems(["cat"], support=0.3)
+    assert set(fi["cat_freqItems"]) == {"a", "b"}
+
+    ct = df.stat.crosstab("cat", "cat").toArrow().to_pydict()
+    assert "a" in ct and "b" in ct
+
+    sb = df.stat.sampleBy("cat", {"a": 1.0, "b": 0.0}, seed=1)
+    got = sb.toArrow().to_pydict()["cat"]
+    assert set(got) == {"a"}
