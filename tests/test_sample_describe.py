@@ -48,7 +48,9 @@ def test_stat_functions(spark):
     x = rng.normal(0, 1, 400)
     y = 3 * x + rng.normal(0, 0.1, 400)
     cat = ["a" if v > 0 else "b" for v in x]
-    df = spark.createDataFrame(pa.table({"x": x, "y": y, "cat": cat}))
+    grp = ["hi" if v > 1 else "lo" for v in y]
+    df = spark.createDataFrame(pa.table({"x": x, "y": y, "cat": cat,
+                                         "grp": grp}))
 
     assert abs(df.stat.corr("x", "y") - np.corrcoef(x, y)[0, 1]) < 1e-6
     assert abs(df.stat.cov("x", "y") - np.cov(x, y, ddof=1)[0, 1]) < 1e-6
@@ -60,8 +62,9 @@ def test_stat_functions(spark):
     fi = df.stat.freqItems(["cat"], support=0.3)
     assert set(fi["cat_freqItems"]) == {"a", "b"}
 
-    ct = df.stat.crosstab("cat", "cat").toArrow().to_pydict()
-    assert "a" in ct and "b" in ct
+    ct = df.stat.crosstab("cat", "grp").toArrow().to_pydict()
+    assert ct["cat_grp"] == ["a", "b"]
+    assert sum(ct["hi"]) + sum(ct["lo"]) == 400
 
     sb = df.stat.sampleBy("cat", {"a": 1.0, "b": 0.0}, seed=1)
     got = sb.toArrow().to_pydict()["cat"]
