@@ -81,8 +81,11 @@ class DeduplicateRelations(Rule):
     def apply(self, plan: LogicalPlan) -> LogicalPlan:
         def rule(node):
             if isinstance(node, Join):
-                left_ids = {a.expr_id for a in node.left.output}
-                right_ids = {a.expr_id for a in node.right.output}
+                try:
+                    left_ids = {a.expr_id for a in node.left.output}
+                    right_ids = {a.expr_id for a in node.right.output}
+                except AnalysisException:
+                    return node  # children await alias resolution
                 overlap = left_ids & right_ids
                 if overlap:
                     mapping: dict[int, AttributeReference] = {}
@@ -158,6 +161,13 @@ class ResolveReferences(Rule):
                 inputs = node.input_attrs()
             except AnalysisException:
                 return node  # child awaits ResolveAliases
+            if isinstance(node, Join):
+                # self-joins: wait for DeduplicateRelations before resolving
+                # the condition, or both sides resolve to the same ids
+                lids = {a.expr_id for a in node.left.output}
+                rids = {a.expr_id for a in node.right.output}
+                if lids & rids:
+                    return node
 
             # star expansion in Project/Aggregate
             if isinstance(node, (Project, Aggregate)):

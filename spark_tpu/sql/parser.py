@@ -206,6 +206,8 @@ class Parser:
                 self.expect_op("(")
                 sub = self.parse_query()
                 self.expect_op(")")
+                # earlier CTEs are visible inside later definitions
+                sub = _substitute_ctes(sub, ctes)
                 ctes[name.lower()] = L.SubqueryAlias(name, sub)
                 if not self.eat_op(","):
                     break
@@ -963,13 +965,32 @@ def _contains_agg(e: E.Expression) -> bool:
     return any(_contains_agg(c) for c in e.children)
 
 
+def _refresh_alias_ids(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Fresh expr_ids for every Alias in a parse-time subtree. CTE bodies
+    splice into multiple call sites; shared alias ids would collide once
+    resolved (references are still by name pre-resolution, so only the ids
+    need refreshing — the analyzer's DeduplicateRelations handles relation
+    ids)."""
+
+    def fresh(e: E.Expression) -> E.Expression:
+        if isinstance(e, E.Alias):
+            return E.Alias(e.child, e.name)  # new expr_id
+        return e
+
+    def go(node: L.LogicalPlan) -> L.LogicalPlan:
+        node = node.map_children(go)
+        return node.map_expressions(lambda ex: ex.transform_up(fresh))
+
+    return go(plan)
+
+
 def _substitute_ctes(plan: L.LogicalPlan,
                      ctes: dict[str, L.LogicalPlan]) -> L.LogicalPlan:
     def rule(node):
         if isinstance(node, L.UnresolvedRelation):
             hit = ctes.get(node.name.lower())
             if hit is not None:
-                return hit
+                return _refresh_alias_ids(hit)
         return node
 
     return plan.transform_up(rule)

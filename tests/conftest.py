@@ -22,6 +22,22 @@ import pyarrow as pa  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session", autouse=True)
+def thread_audit():
+    """Thread-leak check (role of the reference's ThreadAudit,
+    core/src/test/.../ThreadAudit.scala): snapshot threads at session start,
+    warn on leaks at the end (daemon pools excluded)."""
+    import threading
+    import warnings
+
+    before = {t.name for t in threading.enumerate()}
+    yield
+    after = [t for t in threading.enumerate()
+             if t.name not in before and not t.daemon and t.is_alive()]
+    if after:
+        warnings.warn(f"possible thread leak: {[t.name for t in after]}")
+
+
 @pytest.fixture(scope="session")
 def spark():
     from spark_tpu import TpuSession

@@ -1,0 +1,2 @@
+SELECT i_category FROM item WHERE i_brand_id < 5 INTERSECT SELECT i_category FROM item WHERE i_brand_id > 20 ORDER BY i_category;
+SELECT d_year, SUM(d_dom) AS s FROM date_dim WHERE d_date BETWEEN DATE '1998-02-01' AND DATE '1998-02-01' + INTERVAL 1 MONTH GROUP BY ROLLUP(d_year) ORDER BY d_year NULLS LAST

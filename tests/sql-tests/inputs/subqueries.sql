@@ -1,0 +1,3 @@
+SELECT count(*) AS above_avg FROM store_sales WHERE ss_ext_sales_price > (SELECT avg(ss_ext_sales_price) FROM store_sales);
+SELECT count(*) AS music_sales FROM store_sales WHERE ss_item_sk IN (SELECT i_item_sk FROM item WHERE i_category = 'Music');
+SELECT s_store_id FROM store s WHERE EXISTS (SELECT 1 FROM store_sales ss WHERE ss.ss_store_sk = s.s_store_sk AND ss.ss_quantity > 18) ORDER BY s_store_id LIMIT 3

@@ -253,3 +253,33 @@ def test_q32_shape_interval_window(spark, tpcds):
         assert got_v is None or abs(got_v) < 1e-9
     else:
         assert abs(got_v - want) < 1e-6
+
+
+def test_q65_shape_min_avg_revenue(spark, tpcds):
+    """q65 core: items whose store revenue is at most 10% above the store's
+    minimum item revenue."""
+    got = _df(spark, """
+        WITH sa AS (
+            SELECT ss_store_sk, ss_item_sk, SUM(ss_sales_price) AS revenue
+            FROM store_sales GROUP BY ss_store_sk, ss_item_sk),
+        sb AS (
+            SELECT ss_store_sk, MIN(revenue) AS minrev
+            FROM sa GROUP BY ss_store_sk)
+        SELECT sa.ss_store_sk, count(*) AS near_min
+        FROM sa JOIN sb ON sa.ss_store_sk = sb.ss_store_sk
+        WHERE sa.revenue <= 1.1 * sb.minrev
+        GROUP BY sa.ss_store_sk ORDER BY sa.ss_store_sk""")
+
+    ss = tpcds["store_sales"]
+    sa = (ss.groupby(["ss_store_sk", "ss_item_sk"], as_index=False)
+          ["ss_sales_price"].sum()
+          .rename(columns={"ss_sales_price": "revenue"}))
+    sb = sa.groupby("ss_store_sk", as_index=False)["revenue"].min() \
+        .rename(columns={"revenue": "minrev"})
+    j = sa.merge(sb, on="ss_store_sk")
+    want = (j[j.revenue <= 1.1 * j.minrev]
+            .groupby("ss_store_sk", as_index=False).size()
+            .rename(columns={"size": "near_min"})
+            .sort_values("ss_store_sk").reset_index(drop=True))
+    assert got["ss_store_sk"].tolist() == want["ss_store_sk"].tolist()
+    assert got["near_min"].tolist() == want["near_min"].tolist()
