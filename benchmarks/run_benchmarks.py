@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Micro-benchmark harness.
+
+Role of the reference's Benchmark harness + committed results
+(sql/core/benchmarks/*-results.txt, SURVEY.md §4 'Benchmarks as tests'):
+each case reports best/avg wall time and rows/s; results are written to
+benchmarks/results/<name>-results.txt with the environment header so runs
+are comparable across machines/backends.
+
+Run: python benchmarks/run_benchmarks.py [--rows N] [--only case..]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import platform
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def _env_header():
+    import jax
+
+    return (f"backend={jax.default_backend()} devices={len(jax.devices())} "
+            f"python={platform.python_version()} "
+            f"machine={platform.machine()} {platform.system()}")
+
+
+class Bench:
+    def __init__(self, name: str, out_dir: str):
+        self.name = name
+        self.rows: list[str] = []
+        self.out_dir = out_dir
+
+    def case(self, label: str, n_rows: int, fn, iters: int = 5):
+        fn()  # warm-up (compile)
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            fn()
+            times.append(time.perf_counter() - t0)
+        best = min(times)
+        avg = sum(times) / len(times)
+        rate = n_rows / best / 1e6
+        line = (f"{label:<44} best {best * 1000:9.1f} ms   "
+                f"avg {avg * 1000:9.1f} ms   {rate:9.1f} M rows/s")
+        print(line)
+        self.rows.append(line)
+
+    def write(self):
+        os.makedirs(self.out_dir, exist_ok=True)
+        path = os.path.join(self.out_dir, f"{self.name}-results.txt")
+        with open(path, "w") as f:
+            f.write(f"# {self.name}\n# {_env_header()}\n")
+            f.write("\n".join(self.rows) + "\n")
+        print(f"→ {path}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rows", type=int, default=5_000_000)
+    ap.add_argument("--only", nargs="*", default=None)
+    args = ap.parse_args()
+    n = args.rows
+
+    import pyarrow as pa
+
+    from spark_tpu import TpuSession
+    import spark_tpu.api.functions as F
+    from spark_tpu.api.dataframe import DataFrame
+    from spark_tpu.io.sources import InMemorySource
+    from spark_tpu.plan.logical import LogicalRelation
+    from spark_tpu.expr.expressions import AttributeReference
+    from spark_tpu.types import float64, int64
+
+    session = TpuSession("microbench", {
+        "spark.tpu.batch.capacity": 1 << 24,
+        "spark.sql.shuffle.partitions": 1,
+    })
+    rng = np.random.default_rng(7)
+
+    def device_df(table):
+        src = InMemorySource(table, num_partitions=1)
+        src.cache_device_batches = True
+        types = {pa.int64(): int64, pa.float64(): float64}
+        attrs = [AttributeReference(f.name, types[f.type], False)
+                 for f in table.schema]
+        df = DataFrame(session, LogicalRelation(src, attrs, "bench"))
+        df.count()  # populate the device cache
+        return df
+
+    def run(df_query):
+        parts = df_query.query_execution.execute()
+        for p in parts:
+            for b in p:
+                for c in b.columns:
+                    c.data.block_until_ready()
+
+    out_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "results")
+    only = set(args.only or [])
+
+    # ---- aggregation -----------------------------------------------------
+    if not only or "aggregate" in only:
+        b = Bench("aggregate", out_dir)
+        t = pa.table({
+            "k_dense": rng.integers(0, 1 << 20, n).astype(np.int64),
+            "k_sparse": (rng.integers(0, 1 << 20, n).astype(np.int64)
+                         * 1_000_003),
+            "v": rng.integers(0, 1000, n).astype(np.int64),
+            "f": rng.random(n),
+        })
+        df = device_df(t)
+        b.case("ungrouped sum+count", n,
+               lambda: run(df.agg(F.sum("v").alias("s"),
+                                  F.count("*").alias("c"))))
+        b.case("groupBy dense keys (scatter path)", n,
+               lambda: run(df.groupBy("k_dense").agg(F.sum("v").alias("s"))))
+        b.case("groupBy sparse keys (sort path)", n,
+               lambda: run(df.groupBy("k_sparse").agg(F.sum("v").alias("s"))))
+        b.case("groupBy 2 aggs + avg", n,
+               lambda: run(df.groupBy("k_dense").agg(
+                   F.sum("v").alias("s"), F.avg("f").alias("a"))))
+        b.write()
+
+    # ---- filter/project --------------------------------------------------
+    if not only or "compute" in only:
+        b = Bench("compute", out_dir)
+        t = pa.table({"x": rng.integers(0, 1000, n).astype(np.int64),
+                      "y": rng.random(n)})
+        df = device_df(t)
+        b.case("filter x>500 + project x*2+y", n,
+               lambda: run(df.filter(F.col("x") > 500)
+                           .select((F.col("x") * 2).alias("a"),
+                                   (F.col("y") + 1.0).alias("b"))))
+        b.case("5-way fused arithmetic", n,
+               lambda: run(df.select(
+                   ((F.col("x") * 2 + 1) % 97).alias("a"),
+                   (F.col("y") * F.col("y") + F.col("x")).alias("c"))))
+        b.write()
+
+    # ---- join ------------------------------------------------------------
+    if not only or "join" in only:
+        b = Bench("join", out_dir)
+        nb = 1 << 16
+        probe = device_df(pa.table({
+            "k": rng.integers(0, nb, n).astype(np.int64),
+            "v": rng.integers(0, 100, n).astype(np.int64)}))
+        build = device_df(pa.table({
+            "k": np.arange(nb, dtype=np.int64),
+            "w": rng.integers(0, 100, nb).astype(np.int64)}))
+        b.case("broadcast join dense 64k build", n,
+               lambda: run(probe.join(build, on="k")))
+        sparse_build = device_df(pa.table({
+            "k": np.arange(nb, dtype=np.int64) * 1_000_003,
+            "w": rng.integers(0, 100, nb).astype(np.int64)}))
+        sparse_probe = device_df(pa.table({
+            "k": (rng.integers(0, nb, n).astype(np.int64) * 1_000_003),
+            "v": rng.integers(0, 100, n).astype(np.int64)}))
+        b.case("broadcast join sparse keys (sorted probe)", n,
+               lambda: run(sparse_probe.join(sparse_build, on="k")))
+        b.write()
+
+    # ---- sort ------------------------------------------------------------
+    if not only or "sort" in only:
+        b = Bench("sort", out_dir)
+        t = pa.table({"x": rng.integers(0, 1 << 40, n).astype(np.int64),
+                      "y": rng.random(n)})
+        df = device_df(t)
+        b.case("sort by int64", n, lambda: run(df.orderBy("x")))
+        b.case("sort desc + secondary key", n,
+               lambda: run(df.orderBy(F.col("x").desc(), F.col("y"))))
+        b.case("topK 100", n, lambda: run(df.orderBy("x").limit(100)))
+        b.write()
+
+    session.stop()
+
+
+if __name__ == "__main__":
+    main()

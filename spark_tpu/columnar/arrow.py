@@ -115,9 +115,12 @@ def table_to_batches(table: pa.Table, rows_per_batch: int,
         return
     for start in range(0, n, rows_per_batch):
         chunk = table.slice(start, rows_per_batch)
+        chunk_rows = min(rows_per_batch, n - start)
+        # size the tile to the DATA (power-of-two bucket), not the maximum
+        # tile: padding multiplies every downstream kernel's work
         yield record_batch_to_columnar(
-            chunk, schema, capacity=bucket_capacity(rows_per_batch),
-            num_rows=min(rows_per_batch, n - start))
+            chunk, schema, capacity=bucket_capacity(chunk_rows),
+            num_rows=chunk_rows)
 
 
 def batches_to_table(batches: Iterable[ColumnarBatch]) -> pa.Table:

@@ -791,8 +791,16 @@ class LimitExec(PhysicalPlan):
 
             kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build)
             new_mask = kernel(batch.row_mask)
-            out.append([ColumnarBatch(batch.schema, batch.columns, new_mask,
-                                      num_rows=None)])
+            limited = ColumnarBatch(batch.schema, batch.columns, new_mask,
+                                    num_rows=None)
+            # a local limit leaves ≤ n live rows in a full-capacity tile;
+            # compact so the gather exchange and downstream sort touch only
+            # the kept rows (the TakeOrderedAndProject shrink)
+            if not self.is_global and self.n * 4 <= cap:
+                from ..columnar.ops import compact_batch
+
+                limited = compact_batch(limited)
+            out.append([limited])
         return out
 
 
