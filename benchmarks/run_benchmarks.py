@@ -178,6 +178,48 @@ def main():
         b.case("topK 100", n, lambda: run(df.orderBy("x").limit(100)))
         b.write()
 
+
+    # ---- shuffle ---------------------------------------------------------
+    if not only or "shuffle" in only:
+        b = Bench("shuffle", out_dir)
+        session.conf.set("spark.sql.shuffle.partitions", 8)
+        t = pa.table({"k": rng.integers(0, 1 << 20, n).astype(np.int64),
+                      "v": rng.integers(0, 100, n).astype(np.int64)})
+        src8 = InMemorySource(t, num_partitions=8)
+        src8.cache_device_batches = True
+        attrs = [AttributeReference(f.name, int64, False)
+                 for f in t.schema]
+        df8 = DataFrame(session, LogicalRelation(src8, attrs, "sh"))
+        df8.count()
+        b.case("hash shuffle 8->8 + final agg", n,
+               lambda: run(df8.groupBy("k").agg(F.sum("v").alias("s"))))
+        b.case("repartition round-robin 8->8", n,
+               lambda: run(df8.repartition(8)))
+        session.conf.set("spark.sql.shuffle.partitions", 1)
+        b.write()
+
+    # ---- TPC-DS q3 steady state -----------------------------------------
+    if not only or "tpcds" in only:
+        sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                        "tests"))
+        from tpcds_mini import gen_tpcds, register_tpcds
+
+        b = Bench("tpcds", out_dir)
+        n_sales = max(n // 2, 100_000)
+        tables = gen_tpcds(n_sales=n_sales)
+        register_tpcds(session, tables)
+        q3 = """SELECT dt.d_year, item.i_brand_id AS brand_id,
+                       SUM(ss_ext_sales_price) AS sum_agg
+                FROM date_dim dt, store_sales, item
+                WHERE dt.d_date_sk = store_sales.ss_sold_date_sk
+                  AND store_sales.ss_item_sk = item.i_item_sk
+                  AND item.i_manufact_id = 28 AND dt.d_moy = 11
+                GROUP BY dt.d_year, item.i_brand_id
+                ORDER BY dt.d_year, sum_agg DESC LIMIT 100"""
+        b.case(f"q3 shape over {n_sales} sales rows", n_sales,
+               lambda: session.sql(q3).toArrow())
+        b.write()
+
     session.stop()
 
 
