@@ -118,8 +118,15 @@ def shuffle_hash(partitions: list[Partition], key_positions: Sequence[int],
                  stats: dict | None = None) -> list[Partition]:
     import jax
 
+    from ..ops.hashing import hash_columns, partition_ids
     from ..ops.partition import hash_partition
     from ..physical.compile import GLOBAL_KERNEL_CACHE
+
+    try:
+        from ..utils.native import radix_partition as native_radix
+        has_native = True
+    except Exception:
+        has_native = False
 
     jnp = _jnp()
     bufs = [_OutBuffer(schema) for _ in range(num_out)]
@@ -129,16 +136,48 @@ def shuffle_hash(partitions: list[Partition], key_positions: Sequence[int],
             key_eqs = [c.eq_keys() for c in keys]
             key_valids = [c.validity for c in keys]
             cap = batch.capacity
-            kkey = ("shuffle_hash", cap, num_out, len(keys),
-                    tuple(str(k.dtype) for k in key_eqs),
-                    tuple(v is not None for v in key_valids))
-            kernel = GLOBAL_KERNEL_CACHE.get_or_build(
-                kkey, lambda: jax.jit(
-                    lambda eqs, valids, mask: hash_partition(
-                        eqs, valids, mask, num_out)))
-            pr = kernel(key_eqs, key_valids, batch.row_mask)
-            gathered, counts = _pull_sorted(batch, pr.perm, pr.counts)
-            _slice_into(bufs, gathered, counts)
+            if has_native:
+                # fast path: device computes only the pid per row (cheap
+                # hash kernel); the C++ counting sort groups rows host-side
+                # (native/sparktpu_native.cpp, the RadixSort role) — no
+                # device sort, no device gather
+                kkey = ("shuffle_pids", cap, num_out, len(keys),
+                        tuple(str(k.dtype) for k in key_eqs),
+                        tuple(v is not None for v in key_valids))
+                kernel = GLOBAL_KERNEL_CACHE.get_or_build(
+                    kkey, lambda: jax.jit(
+                        lambda eqs, valids, mask: jnp.where(
+                            mask,
+                            partition_ids(hash_columns(eqs, list(valids)),
+                                          num_out),
+                            num_out)))
+                pids = np.asarray(kernel(key_eqs, key_valids,
+                                         batch.row_mask))
+                try:
+                    order, counts = native_radix(pids, num_out)
+                except Exception:
+                    order = np.argsort(pids, kind="stable")
+                    counts = np.bincount(
+                        pids[pids < num_out], minlength=num_out)
+                order = order[: int(counts.sum())]
+                gathered = []
+                for c in batch.columns:
+                    data = np.asarray(c.data)[order]
+                    validity = None if c.validity is None else \
+                        np.asarray(c.validity)[order]
+                    gathered.append((data, validity, c.dictionary))
+                _slice_into(bufs, gathered, counts.astype(np.int64))
+            else:
+                kkey = ("shuffle_hash", cap, num_out, len(keys),
+                        tuple(str(k.dtype) for k in key_eqs),
+                        tuple(v is not None for v in key_valids))
+                kernel = GLOBAL_KERNEL_CACHE.get_or_build(
+                    kkey, lambda: jax.jit(
+                        lambda eqs, valids, mask: hash_partition(
+                            eqs, valids, mask, num_out)))
+                pr = kernel(key_eqs, key_valids, batch.row_mask)
+                gathered, counts = _pull_sorted(batch, pr.perm, pr.counts)
+                _slice_into(bufs, gathered, counts)
     return _finish(bufs, ctx, stats)
 
 
