@@ -122,6 +122,9 @@ class ScanExec(PhysicalPlan):
         return f"Scan[{self.name}]({', '.join(a.name for a in self.attrs)})"
 
 
+_LOCAL_TABLE_CACHE: "weakref.WeakKeyDictionary" = None
+
+
 class LocalTableScanExec(PhysicalPlan):
     child_fields = ()
 
@@ -137,12 +140,38 @@ class LocalTableScanExec(PhysicalPlan):
         return SinglePartition()
 
     def execute(self, ctx: ExecContext) -> list[Partition]:
+        import weakref
+
         from ..columnar.arrow import table_to_batches
 
-        names = [a.name for a in self.attrs]
-        tbl = self.table.select(names) if self.table.num_columns else self.table
+        global _LOCAL_TABLE_CACHE
+        if _LOCAL_TABLE_CACHE is None:
+            _LOCAL_TABLE_CACHE = {}
+
+        # pa.Table is unhashable: key by id with a weakref finalizer so the
+        # device batches die with the table
+        tid = id(self.table)
+        entry = _LOCAL_TABLE_CACHE.get(tid)
+        if entry is None:
+            try:
+                ref = weakref.ref(self.table,
+                                  lambda _r, t=tid:
+                                  _LOCAL_TABLE_CACHE.pop(t, None))
+            except TypeError:
+                ref = None
+            entry = {"ref": ref, "batches": {}}
+            _LOCAL_TABLE_CACHE[tid] = entry
+
+        names = tuple(a.name for a in self.attrs)
+        key = (names, ctx.conf.batch_capacity)
+        hit = entry["batches"].get(key)
+        if hit is not None:
+            return [hit]
+        tbl = self.table.select(list(names)) if self.table.num_columns \
+            else self.table
         batches = list(table_to_batches(tbl, ctx.conf.batch_capacity,
                                         attrs_schema(self.attrs)))
+        entry["batches"][key] = batches
         return [batches]
 
 
