@@ -378,6 +378,38 @@ class DataFrame:
 
         return DataFrameStatFunctions(self)
 
+    @property
+    def na(self):
+        from .na import DataFrameNaFunctions
+
+        return DataFrameNaFunctions(self)
+
+    def fillna(self, value, subset=None) -> "DataFrame":
+        return self.na.fill(value, subset)
+
+    def dropna(self, how: str = "any", subset=None) -> "DataFrame":
+        return self.na.drop(how, subset)
+
+    def replace(self, to_replace, value=None, subset=None) -> "DataFrame":
+        return self.na.replace(to_replace, value, subset)
+
+    def unpivot(self, ids, values, variableColumnName: str = "variable",
+                valueColumnName: str = "value") -> "DataFrame":
+        """Wide→long (reference: Dataset.unpivot / melt): a union of one
+        projection per value column."""
+        ids = [ids] if isinstance(ids, str) else list(ids)
+        values = [values] if isinstance(values, str) else list(values)
+        branches = []
+        for v in values:
+            branches.append(self.select(
+                *ids,
+                Column(E.Alias(E.Literal(v), variableColumnName)),
+                Column(E.Alias(E.UnresolvedAttribute([v]),
+                               valueColumnName))).plan)
+        return self._with(L.Union(branches))
+
+    melt = unpivot
+
 
 def _fmt(v, truncate: bool) -> str:
     s = "NULL" if v is None else str(v)
@@ -396,14 +428,58 @@ def _resolve_using(df: DataFrame, name: str) -> E.AttributeReference:
 class GroupedData:
     """Role of RelationalGroupedDataset."""
 
-    def __init__(self, df: DataFrame, grouping: list[E.Expression]):
+    def __init__(self, df: DataFrame, grouping: list[E.Expression],
+                 pivot_col: str | None = None,
+                 pivot_values: list | None = None):
         self.df = df
         self.grouping = grouping
+        self._pivot_col = pivot_col
+        self._pivot_values = pivot_values
+
+    def pivot(self, pivot_col: str, values: list | None = None
+              ) -> "GroupedData":
+        """Pivot (reference: RelationalGroupedDataset.pivot): each pivot
+        value becomes a conditional aggregate column."""
+        if values is None:
+            import spark_tpu.api.functions as FN
+
+            vals = (self.df.select(pivot_col).distinct()
+                    .orderBy(pivot_col).toArrow().column(0).to_pylist())
+            values = [v for v in vals if v is not None]
+        return GroupedData(self.df, self.grouping, pivot_col, list(values))
 
     def agg(self, *cols) -> DataFrame:
         aggs = _to_expr_list(cols, allow_str=False)
+        if self._pivot_col is not None:
+            aggs = self._pivot_aggs(aggs)
         out = list(self.grouping) + aggs
         return self.df._with(L.Aggregate(self.grouping, out, self.df.plan))
+
+    def _pivot_aggs(self, aggs: list[E.Expression]) -> list[E.Expression]:
+        pivot_attr = E.UnresolvedAttribute([self._pivot_col])
+        out: list[E.Expression] = []
+        for v in self._pivot_values:
+            for a in aggs:
+                inner = a.child if isinstance(a, E.Alias) else a
+                base = a.name if isinstance(a, E.Alias) else None
+
+                def guard(x: E.Expression) -> E.Expression:
+                    if isinstance(x, E.AggregateFunction) and \
+                            x.child is not None:
+                        return x.copy(child=E.If(
+                            E.EqualTo(pivot_attr, E.Literal(v)),
+                            x.child, E.Literal(None)))
+                    if isinstance(x, E.Count) and x.child is None:
+                        return E.Count(E.If(
+                            E.EqualTo(pivot_attr, E.Literal(v)),
+                            E.Literal(1), E.Literal(None)))
+                    return x
+
+                guarded = inner.transform_up(guard)
+                name = str(v) if len(aggs) == 1 and base is None \
+                    else (f"{v}_{base}" if base else f"{v}_{len(out)}")
+                out.append(E.Alias(guarded, name))
+        return out
 
     def count(self) -> DataFrame:
         return self.agg(Column(E.Alias(E.Count(None), "count")))

@@ -1,0 +1,71 @@
+"""df.na, pivot, and unpivot tests."""
+
+import pyarrow as pa
+import pytest
+
+import spark_tpu.api.functions as F
+
+
+@pytest.fixture()
+def nadf(spark):
+    return spark.createDataFrame(pa.table({
+        "a": pa.array([1, None, 3], pa.int64()),
+        "b": pa.array([None, 2.5, 3.5], pa.float64()),
+        "s": pa.array(["x", None, "z"]),
+    }))
+
+
+def test_na_drop(nadf):
+    assert nadf.na.drop().count() == 1
+    assert nadf.na.drop(how="all").count() == 3
+    assert nadf.na.drop(subset=["a"]).count() == 2
+    assert nadf.dropna(subset=["a", "b"]).count() == 1
+
+
+def test_na_fill(nadf):
+    out = nadf.na.fill(0).toArrow().to_pydict()
+    assert out["a"] == [1, 0, 3]
+    assert out["b"] == [0.0, 2.5, 3.5]
+    assert out["s"] == ["x", None, "z"]  # numeric fill skips strings
+    out2 = nadf.na.fill({"s": "missing"}).toArrow().to_pydict()
+    assert out2["s"] == ["x", "missing", "z"]
+
+
+def test_na_replace(nadf):
+    out = nadf.na.replace(1, 100, subset=["a"]).toArrow().to_pydict()
+    assert out["a"] == [100, None, 3]
+    out2 = nadf.na.replace({"x": "X"}).toArrow().to_pydict()
+    assert out2["s"] == ["X", None, "z"]
+
+
+def test_pivot(spark):
+    df = spark.createDataFrame(pa.table({
+        "year": [2020, 2020, 2021, 2021, 2021],
+        "quarter": ["q1", "q2", "q1", "q1", "q2"],
+        "rev": [10, 20, 30, 40, 50],
+    }))
+    out = (df.groupBy("year").pivot("quarter")
+           .agg(F.sum("rev")).orderBy("year").toArrow().to_pydict())
+    assert out["year"] == [2020, 2021]
+    assert out["q1"] == [10, 70]
+    assert out["q2"] == [20, 50]
+
+
+def test_pivot_explicit_values_and_count(spark):
+    df = spark.createDataFrame(pa.table({
+        "g": ["a", "a", "b"],
+        "p": ["x", "y", "x"],
+        "v": [1, 2, 3]}))
+    out = (df.groupBy("g").pivot("p", ["x"])
+           .agg(F.count("*").alias("n")).orderBy("g").toArrow().to_pydict())
+    assert out["x_n"] == [1, 1]
+
+
+def test_unpivot(spark):
+    df = spark.createDataFrame(pa.table({
+        "id": [1, 2], "m1": [10, 20], "m2": [30, 40]}))
+    out = (df.unpivot("id", ["m1", "m2"])
+           .orderBy("id", "variable").toArrow().to_pydict())
+    assert out["id"] == [1, 1, 2, 2]
+    assert out["variable"] == ["m1", "m2", "m1", "m2"]
+    assert out["value"] == [10, 30, 20, 40]
