@@ -113,6 +113,20 @@ class DataFrame:
             print(f" |-- {f.name}: {f.dataType.simple_string()} "
                   f"(nullable = {str(f.nullable).lower()})")
 
+    def __getitem__(self, item):
+        if isinstance(item, str):
+            for a in self.query_execution.analyzed.output:
+                if a.name == item:
+                    return Column(a)
+            from ..errors import UnresolvedColumnError
+
+            raise UnresolvedColumnError(item, self.columns[:5])
+        if isinstance(item, (list, tuple)):
+            return self.select(*item)
+        if isinstance(item, Column):
+            return self.filter(item)
+        raise TypeError(f"cannot index DataFrame with {type(item)}")
+
     # --- transformations ----------------------------------------------
     def select(self, *cols) -> "DataFrame":
         if not cols:

@@ -198,6 +198,46 @@ class TpuSession:
         if TpuSession._active is self:
             TpuSession._active = None
 
+    def _cache_df(self, df):
+        """Materialize once and register the (analyzed plan → LocalRelation)
+        pair: ANY later query containing a semantically equal subtree is
+        rewritten to scan the cache (role of CacheManager.useCachedData,
+        sqlx/columnar/CacheManager.scala + QueryExecution withCachedData)."""
+        analyzed = df.query_execution.analyzed
+        for plan, _ in self._cached.values():
+            if plan.fast_equals(analyzed):
+                return df
+        table = df.toArrow()
+        attrs = list(analyzed.output)
+        self._cached[id(df)] = (analyzed, LocalRelation(attrs, table))
+        return df
+
+    def _uncache_df(self, df):
+        analyzed = df.query_execution.analyzed
+        for k, (plan, _) in list(self._cached.items()):
+            if plan.fast_equals(analyzed):
+                del self._cached[k]
+        return df
+
+    def _use_cached(self, plan):
+        """Substitute cached fragments into an analyzed plan."""
+        if not self._cached:
+            return plan
+        entries = list(self._cached.values())
+
+        def rule(node):
+            for cached_plan, relation in entries:
+                if node is not relation and node.fast_equals(cached_plan):
+                    return relation
+            return node
+
+        return plan.transform_up(rule)
+
+    def version(self) -> str:
+        from .. import __version__
+
+        return __version__
+
 
 class _StreamsApi:
     def __init__(self, session):
@@ -210,31 +250,6 @@ class _StreamsApi:
     def awaitAnyTermination(self, timeout=None):
         for q in list(self.s._streams):
             q.awaitTermination(timeout)
-
-    def _cache_df(self, df):
-        # materialize once and swap in a LocalRelation (role of CacheManager,
-        # sqlx/columnar/CacheManager.scala) — columnar batches are the cache
-        table = df.toArrow()
-        attrs = list(df.query_execution.analyzed.output)
-        cached = DataFrameFromCache(self, LocalRelation(attrs, table))
-        self._cached[id(df)] = cached
-        return cached
-
-    def _uncache_df(self, df):
-        self._cached.pop(id(df), None)
-        return df
-
-    def version(self) -> str:
-        from .. import __version__
-
-        return __version__
-
-
-class DataFrameFromCache:
-    def __new__(cls, session, plan):
-        from .dataframe import DataFrame
-
-        return DataFrame(session, plan)
 
 
 class _CatalogApi:

@@ -48,10 +48,18 @@ class QueryExecution:
                            lambda: self.session._analyzer.execute(self.logical))
 
     @cached_property
-    def optimized(self) -> LogicalPlan:
+    def with_cached_data(self) -> LogicalPlan:
+        """Cached-fragment substitution (reference: QueryExecution
+        withCachedData → CacheManager.useCachedData)."""
         analyzed = self.analyzed
+        use = getattr(self.session, "_use_cached", None)
+        return use(analyzed) if use else analyzed
+
+    @cached_property
+    def optimized(self) -> LogicalPlan:
+        plan = self.with_cached_data
         out = self._timed("optimization",
-                          lambda: self.session._optimizer.execute(analyzed))
+                          lambda: self.session._optimizer.execute(plan))
         return self._materialize_scalar_subqueries(out)
 
     def _materialize_scalar_subqueries(self, plan: LogicalPlan) -> LogicalPlan:

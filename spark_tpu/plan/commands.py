@@ -167,14 +167,14 @@ def run_command(session, cmd: Command):
         return df_of(pa.table({"plan": pa.array([text])}))
 
     if isinstance(cmd, CacheTableCommand):
-        if cmd.uncache:
-            return df_of(pa.table({"result": pa.array([], pa.string())}))
-        plan = session.catalog_.lookup(cmd.name.split("."))
         from ..api.dataframe import DataFrame as DF
 
+        plan = session.catalog_.lookup(cmd.name.split("."))
         df = DF(session, plan)
-        cached = df.cache()
-        session.catalog_.register(cmd.name, cached.plan)
+        if cmd.uncache:
+            session._uncache_df(df)
+        else:
+            df.cache()
         return df_of(pa.table({"result": pa.array([], pa.string())}))
 
     if isinstance(cmd, SetCommand):

@@ -153,3 +153,28 @@ def test_save_as_table_api(tmp_path):
             .to_pydict()["c"] == [4]
     finally:
         s.stop()
+
+
+def test_cache_fragment_substitution(spark):
+    import pyarrow as pa
+
+    from spark_tpu.plan.logical import LocalRelation
+
+    base = spark.createDataFrame(pa.table({
+        "x": list(range(100)), "y": list(range(100))}))
+    filtered = base.filter(F.col("x") > 50)
+    filtered.cache()
+    try:
+        # an INDEPENDENT query with a semantically equal subtree reuses the
+        # materialized cache
+        q = base.filter(F.col("x") > 50).agg(F.count("*").alias("c"))
+        plan = q.query_execution.with_cached_data
+        assert any(isinstance(n, LocalRelation) and n.table.num_rows == 49
+                   for n in plan.iter_nodes())
+        assert q.toArrow().to_pydict()["c"] == [49]
+    finally:
+        filtered.unpersist()
+    q2 = base.filter(F.col("x") > 50).agg(F.count("*").alias("c"))
+    plan2 = q2.query_execution.with_cached_data
+    assert not any(isinstance(n, LocalRelation) and n.table.num_rows == 49
+                   for n in plan2.iter_nodes())

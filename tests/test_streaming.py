@@ -150,3 +150,26 @@ def test_file_stream_source(spark, tmp_path):
         assert sorted(out["x"]) == [1, 2, 3]
     finally:
         q.stop()
+
+
+def test_stream_join_static_dimension(spark):
+    """Streaming fact rows join a static dimension per micro-batch
+    (reference: stream-static joins in MicroBatchExecution)."""
+    dim = spark.createDataFrame(pa.table({
+        "id": [1, 2], "name": ["ann", "bob"]}))
+    dim.createOrReplaceTempView("dim_users")
+
+    src, facts = spark.memory_stream(pa.schema([
+        ("uid", pa.int64()), ("v", pa.int64())]))
+    q = (facts.join(dim, facts["uid"] == dim["id"])
+         .select("name", "v")
+         .writeStream.format("memory").queryName("sj")
+         .outputMode("append").start())
+    try:
+        src.add_data({"uid": [1, 2, 9], "v": [10, 20, 30]})
+        q.processAllAvailable()
+        out = spark.sql("SELECT * FROM sj ORDER BY name").toArrow().to_pydict()
+        assert out["name"] == ["ann", "bob"]
+        assert out["v"] == [10, 20]
+    finally:
+        q.stop()
