@@ -12,3 +12,8 @@ from .evaluation import (  # noqa: F401
 from .tuning import (  # noqa: F401
     ParamGridBuilder, CrossValidator, TrainValidationSplit,
 )
+from .tree import (  # noqa: F401
+    DecisionTreeClassifier, DecisionTreeRegressor,
+    RandomForestClassifier, RandomForestRegressor,
+)
+from .recommendation import ALS, ALSModel  # noqa: F401

@@ -1,0 +1,105 @@
+"""ALS collaborative filtering.
+
+Role of the reference's ml/recommendation/ALS.scala. TPU-native: the
+alternating least-squares updates are BATCHED ridge solves — every user's
+(k×k) normal-equation system is built with `segment_sum` over the rating
+edges and solved with a batched `jnp.linalg.solve` (MXU path) — instead of
+the reference's per-block Cholesky loops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import Estimator, Model, with_host_column
+
+
+class ALS(Estimator):
+    _params = {"userCol": "user", "itemCol": "item", "ratingCol": "rating",
+               "rank": 8, "maxIter": 10, "regParam": 0.1, "seed": 42,
+               "predictionCol": "prediction"}
+
+    def fit(self, df) -> "ALSModel":
+        import jax
+        import jax.numpy as jnp
+
+        users_raw = np.asarray(df.select(self.getOrDefault("userCol"))
+                               .toArrow().column(0).to_numpy(
+                                   zero_copy_only=False))
+        items_raw = np.asarray(df.select(self.getOrDefault("itemCol"))
+                               .toArrow().column(0).to_numpy(
+                                   zero_copy_only=False))
+        ratings = np.asarray(df.select(self.getOrDefault("ratingCol"))
+                             .toArrow().column(0).to_numpy(
+                                 zero_copy_only=False), dtype=np.float64)
+
+        uids, u_idx = np.unique(users_raw, return_inverse=True)
+        iids, i_idx = np.unique(items_raw, return_inverse=True)
+        nu, ni = len(uids), len(iids)
+        k = int(self.getOrDefault("rank"))
+        lam = float(self.getOrDefault("regParam"))
+        rng = np.random.default_rng(self.getOrDefault("seed"))
+
+        U = jnp.asarray(rng.normal(0, 0.1, (nu, k)))
+        V = jnp.asarray(rng.normal(0, 0.1, (ni, k)))
+        ue = jnp.asarray(u_idx)
+        ie = jnp.asarray(i_idx)
+        r = jnp.asarray(ratings)
+
+        def make_solver(n_out: int):
+            """Batched ridge solve: for each output row, A = Σ ff^T + λI,
+            b = Σ rating·f over its edges (n_out is compile-time static)."""
+
+            @jax.jit
+            def solve(fixed, edge_fixed, edge_out):
+                f = fixed[edge_fixed]                  # [m, k]
+                outer = f[:, :, None] * f[:, None, :]  # [m, k, k]
+                A = jax.ops.segment_sum(outer, edge_out, num_segments=n_out)
+                b = jax.ops.segment_sum(f * r[:, None], edge_out,
+                                        num_segments=n_out)
+                A = A + lam * jnp.eye(k)[None]
+                return jnp.linalg.solve(A, b[..., None])[..., 0]
+
+            return solve
+
+        solve_users = make_solver(nu)
+        solve_items = make_solver(ni)
+        for _ in range(int(self.getOrDefault("maxIter"))):
+            U = solve_users(V, ie, ue)
+            V = solve_items(U, ue, ie)
+
+        m = ALSModel(userCol=self.getOrDefault("userCol"),
+                     itemCol=self.getOrDefault("itemCol"),
+                     predictionCol=self.getOrDefault("predictionCol"))
+        m.user_ids = uids
+        m.item_ids = iids
+        m.user_factors = np.asarray(U)
+        m.item_factors = np.asarray(V)
+        return m
+
+
+class ALSModel(Model):
+    _params = {"userCol": "user", "itemCol": "item",
+               "predictionCol": "prediction"}
+
+    def transform(self, df):
+        users = np.asarray(df.select(self.getOrDefault("userCol"))
+                           .toArrow().column(0).to_numpy(
+                               zero_copy_only=False))
+        items = np.asarray(df.select(self.getOrDefault("itemCol"))
+                           .toArrow().column(0).to_numpy(
+                               zero_copy_only=False))
+        u = np.searchsorted(self.user_ids, users)
+        i = np.searchsorted(self.item_ids, items)
+        u = np.clip(u, 0, len(self.user_ids) - 1)
+        i = np.clip(i, 0, len(self.item_ids) - 1)
+        known = (self.user_ids[u] == users) & (self.item_ids[i] == items)
+        pred = (self.user_factors[u] * self.item_factors[i]).sum(axis=1)
+        pred = np.where(known, pred, np.nan)
+        return with_host_column(df, self.getOrDefault("predictionCol"), pred)
+
+    def recommend_for_user(self, user, n: int = 10):
+        idx = np.searchsorted(self.user_ids, user)
+        scores = self.item_factors @ self.user_factors[idx]
+        top = np.argsort(-scores)[:n]
+        return [(self.item_ids[t], float(scores[t])) for t in top]

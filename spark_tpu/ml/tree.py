@@ -1,0 +1,172 @@
+"""Decision trees and random forests.
+
+Role of the reference's tree family (ml/classification/DecisionTreeClassifier,
+ml/regression/DecisionTreeRegressor, RandomForest*). Design: histogram-based
+greedy splitting — per node, candidate thresholds come from feature
+quantiles, impurity sums per bin are vectorized over numpy (the [n, d]
+feature-matrix design of base.py); no per-row recursion.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import (
+    Estimator, Model, extract_matrix, extract_vector, resolve_feature_cols,
+    with_host_column,
+)
+
+
+class _Node:
+    __slots__ = ("feature", "threshold", "left", "right", "value")
+
+    def __init__(self, value):
+        self.feature = -1
+        self.threshold = 0.0
+        self.left = None
+        self.right = None
+        self.value = value
+
+
+def _build_tree(X: np.ndarray, y: np.ndarray, depth: int, max_depth: int,
+                min_instances: int, impurity: str, n_bins: int,
+                rng, feature_subset: float) -> _Node:
+    n, d = X.shape
+    if impurity == "variance":
+        value = float(y.mean())
+        node_imp = float(y.var())
+    else:
+        classes, counts = np.unique(y, return_counts=True)
+        value = float(classes[np.argmax(counts)])
+        p = counts / n
+        node_imp = float(1.0 - (p * p).sum())  # gini
+    node = _Node(value)
+    if depth >= max_depth or n < 2 * min_instances or node_imp <= 1e-12:
+        return node
+
+    feats = np.arange(d)
+    if feature_subset < 1.0:
+        k = max(1, int(d * feature_subset))
+        feats = rng.choice(d, size=k, replace=False)
+
+    best = (0.0, -1, 0.0)  # (gain, feature, threshold)
+    for f in feats:
+        xs = X[:, f]
+        qs = np.unique(np.quantile(xs, np.linspace(0.05, 0.95,
+                                                   min(n_bins, n))))
+        for t in qs:
+            left = xs <= t
+            nl = int(left.sum())
+            if nl < min_instances or n - nl < min_instances:
+                continue
+            if impurity == "variance":
+                imp = (nl * y[left].var() + (n - nl) * y[~left].var()) / n
+            else:
+                def gini(part):
+                    _, c = np.unique(part, return_counts=True)
+                    pp = c / len(part)
+                    return 1.0 - (pp * pp).sum()
+
+                imp = (nl * gini(y[left]) + (n - nl) * gini(y[~left])) / n
+            gain = node_imp - imp
+            if gain > best[0]:
+                best = (gain, int(f), float(t))
+
+    if best[1] < 0:
+        return node
+    node.feature, node.threshold = best[1], best[2]
+    mask = X[:, node.feature] <= node.threshold
+    node.left = _build_tree(X[mask], y[mask], depth + 1, max_depth,
+                            min_instances, impurity, n_bins, rng,
+                            feature_subset)
+    node.right = _build_tree(X[~mask], y[~mask], depth + 1, max_depth,
+                             min_instances, impurity, n_bins, rng,
+                             feature_subset)
+    return node
+
+
+def _predict_tree(node: _Node, X: np.ndarray) -> np.ndarray:
+    out = np.empty(len(X))
+
+    def go(n: _Node, idx: np.ndarray):
+        if n.left is None:
+            out[idx] = n.value
+            return
+        mask = X[idx, n.feature] <= n.threshold
+        go(n.left, idx[mask])
+        go(n.right, idx[~mask])
+
+    go(node, np.arange(len(X)))
+    return out
+
+
+class _TreeEstimator(Estimator):
+    _params = {"featuresCol": "features", "labelCol": "label",
+               "predictionCol": "prediction", "maxDepth": 5,
+               "minInstancesPerNode": 1, "maxBins": 32, "numTrees": 1,
+               "subsamplingRate": 1.0, "featureSubsetStrategy": 1.0,
+               "seed": 42}
+    _impurity = "gini"
+
+    def fit(self, df):
+        cols = resolve_feature_cols(df, self.getOrDefault("featuresCol"))
+        X = extract_matrix(df, cols)
+        y = extract_vector(df, self.getOrDefault("labelCol"))
+        rng = np.random.default_rng(self.getOrDefault("seed"))
+        trees = []
+        n = len(X)
+        for _ in range(int(self.getOrDefault("numTrees"))):
+            if self.getOrDefault("subsamplingRate") < 1.0 or \
+                    int(self.getOrDefault("numTrees")) > 1:
+                idx = rng.choice(
+                    n, size=max(1, int(n * self.getOrDefault(
+                        "subsamplingRate"))), replace=True)
+            else:
+                idx = np.arange(n)
+            trees.append(_build_tree(
+                X[idx], y[idx], 0, int(self.getOrDefault("maxDepth")),
+                int(self.getOrDefault("minInstancesPerNode")),
+                self._impurity, int(self.getOrDefault("maxBins")), rng,
+                float(self.getOrDefault("featureSubsetStrategy"))))
+        m = _TreeModel(featuresCol=self.getOrDefault("featuresCol"),
+                       predictionCol=self.getOrDefault("predictionCol"))
+        m.cols = cols
+        m.trees = trees
+        m.is_regression = self._impurity == "variance"
+        return m
+
+
+class _TreeModel(Model):
+    _params = {"featuresCol": "features", "predictionCol": "prediction"}
+
+    def transform(self, df):
+        X = extract_matrix(df, self.cols)
+        preds = np.stack([_predict_tree(t, X) for t in self.trees])
+        if self.is_regression:
+            pred = preds.mean(axis=0)
+        else:
+            # majority vote
+            pred = np.apply_along_axis(
+                lambda v: np.bincount(v.astype(np.int64)).argmax(), 0,
+                preds).astype(np.float64)
+        return with_host_column(df, self.getOrDefault("predictionCol"), pred)
+
+
+class DecisionTreeClassifier(_TreeEstimator):
+    _impurity = "gini"
+
+
+class DecisionTreeRegressor(_TreeEstimator):
+    _impurity = "variance"
+
+
+class RandomForestClassifier(_TreeEstimator):
+    _impurity = "gini"
+    _params = dict(_TreeEstimator._params, numTrees=20,
+                   subsamplingRate=0.8, featureSubsetStrategy=0.6)
+
+
+class RandomForestRegressor(_TreeEstimator):
+    _impurity = "variance"
+    _params = dict(_TreeEstimator._params, numTrees=20,
+                   subsamplingRate=0.8, featureSubsetStrategy=0.6)

@@ -125,3 +125,56 @@ def test_cross_validator(spark):
     model = cv.fit(df)
     assert len(model.avgMetrics) == 2
     assert model.avgMetrics[0] > model.avgMetrics[1]  # heavy reg is worse
+
+
+def test_decision_tree_classifier(spark):
+    rng = np.random.default_rng(6)
+    n = 400
+    x1 = rng.uniform(-1, 1, n)
+    x2 = rng.uniform(-1, 1, n)
+    label = ((x1 > 0.2) ^ (x2 > -0.3)).astype(np.float64)  # axis-aligned
+    from spark_tpu.ml import DecisionTreeClassifier
+
+    df = VectorAssembler(inputCols=["x1", "x2"]).transform(
+        spark.createDataFrame(pa.table({"x1": x1, "x2": x2, "label": label})))
+    model = DecisionTreeClassifier(maxDepth=4).fit(df)
+    acc = MulticlassClassificationEvaluator().evaluate(model.transform(df))
+    assert acc > 0.95
+
+
+def test_random_forest_regressor(spark):
+    rng = np.random.default_rng(7)
+    n = 500
+    x = rng.uniform(0, 10, n)
+    y = np.where(x < 5, 1.0, 3.0) + rng.normal(0, 0.05, n)
+    from spark_tpu.ml import RandomForestRegressor
+
+    df = VectorAssembler(inputCols=["x"]).transform(
+        spark.createDataFrame(pa.table({"x": x, "label": y})))
+    model = RandomForestRegressor(numTrees=10, maxDepth=3).fit(df)
+    rmse = RegressionEvaluator().evaluate(model.transform(df))
+    assert rmse < 0.3
+
+
+def test_als_recovers_structure(spark):
+    rng = np.random.default_rng(8)
+    nu, ni, k = 30, 20, 3
+    U = rng.normal(0, 1, (nu, k))
+    V = rng.normal(0, 1, (ni, k))
+    R = U @ V.T
+    users, items, ratings = [], [], []
+    for u in range(nu):
+        for i in rng.choice(ni, size=12, replace=False):
+            users.append(u)
+            items.append(int(i))
+            ratings.append(float(R[u, i]))
+    from spark_tpu.ml import ALS
+
+    df = spark.createDataFrame(pa.table({
+        "user": users, "item": items, "rating": ratings}))
+    model = ALS(rank=3, maxIter=15, regParam=0.01).fit(df)
+    pred = model.transform(df).toArrow().to_pydict()["prediction"]
+    err = np.abs(np.array(pred) - np.array(ratings)).mean()
+    assert err < 0.1
+    recs = model.recommend_for_user(0, 5)
+    assert len(recs) == 5
