@@ -1,6 +1,7 @@
 from .base import Pipeline, PipelineModel, Estimator, Transformer, Model  # noqa: F401
 from .features import (  # noqa: F401
     VectorAssembler, StandardScaler, MinMaxScaler, StringIndexer, Binarizer,
+    Bucketizer, QuantileDiscretizer, OneHotEncoder, PCA,
 )
 from .regression import LinearRegression  # noqa: F401
 from .classification import LogisticRegression, NaiveBayes  # noqa: F401

@@ -139,6 +139,125 @@ class StringIndexerModel(Model):
         return with_host_column(df, self.getOrDefault("outputCol"), idx)
 
 
+class Bucketizer(Transformer):
+    """Continuous → bucket index by split points (reference:
+    ml/feature/Bucketizer.scala) — a device searchsorted via SQL CASE."""
+
+    _params = {"inputCol": None, "outputCol": None, "splits": ()}
+
+    def transform(self, df):
+        import spark_tpu.api.functions as F
+
+        splits = list(self.getOrDefault("splits"))
+        c = F.col(self.getOrDefault("inputCol"))
+        expr = None
+        for i in range(len(splits) - 1):
+            cond = (c >= splits[i]) & (c < splits[i + 1]) \
+                if i < len(splits) - 2 else \
+                (c >= splits[i]) & (c <= splits[i + 1])
+            expr = F.when(cond, float(i)) if expr is None \
+                else expr.when(cond, float(i))
+        return df.withColumn(self.getOrDefault("outputCol"),
+                             expr.otherwise(None))
+
+
+class QuantileDiscretizer(Estimator):
+    """Fit quantile split points, then bucketize (reference:
+    ml/feature/QuantileDiscretizer.scala)."""
+
+    _params = {"inputCol": None, "outputCol": None, "numBuckets": 4}
+
+    def fit(self, df) -> Bucketizer:
+        nb = int(self.getOrDefault("numBuckets"))
+        probs = [i / nb for i in range(1, nb)]
+        qs = df.stat.approxQuantile(self.getOrDefault("inputCol"), probs)
+        splits = [float("-inf")] + sorted(set(qs)) + [float("inf")]
+        return Bucketizer(inputCol=self.getOrDefault("inputCol"),
+                          outputCol=self.getOrDefault("outputCol"),
+                          splits=tuple(splits))
+
+
+class OneHotEncoder(Estimator):
+    """Category index → indicator columns (reference:
+    ml/feature/OneHotEncoder.scala; vectors are column groups here)."""
+
+    _params = {"inputCol": None, "outputCol": None, "dropLast": True}
+
+    def fit(self, df):
+        vals = (df.select(self.getOrDefault("inputCol")).distinct()
+                .toArrow().column(0).to_pylist())
+        cats = sorted(v for v in vals if v is not None)
+        if self.getOrDefault("dropLast") and len(cats) > 1:
+            cats = cats[:-1]
+        m = OneHotEncoderModel(inputCol=self.getOrDefault("inputCol"),
+                               outputCol=self.getOrDefault("outputCol"),
+                               dropLast=self.getOrDefault("dropLast"))
+        m.categories = cats
+        return m
+
+
+class OneHotEncoderModel(Model):
+    _params = {"inputCol": None, "outputCol": None, "dropLast": True}
+
+    def transform(self, df):
+        import spark_tpu.api.functions as F
+
+        out = df
+        names = []
+        base = self.getOrDefault("outputCol")
+        for c in self.categories:
+            name = f"{base}_{c}"
+            out = out.withColumn(
+                name, F.when(F.col(self.getOrDefault("inputCol")) == c, 1.0)
+                .otherwise(0.0))
+            names.append(name)
+        meta = dict(getattr(df, "_ml_features", None) or {})
+        meta[base] = names
+        out._ml_features = meta
+        return out
+
+
+class PCA(Estimator):
+    """Principal components via device SVD (reference: ml/feature/PCA.scala —
+    the MXU-friendly path: one gram/SVD instead of row-iterated covariance)."""
+
+    _params = {"inputCol": "features", "outputCol": "pca", "k": 2}
+
+    def fit(self, df):
+        import jax.numpy as jnp
+
+        cols = resolve_feature_cols(df, self.getOrDefault("inputCol"))
+        X = extract_matrix(df, cols)
+        mean = X.mean(axis=0)
+        Xc = jnp.asarray(X - mean)
+        _, _, vt = jnp.linalg.svd(Xc, full_matrices=False)
+        k = int(self.getOrDefault("k"))
+        m = PCAModel(inputCol=self.getOrDefault("inputCol"),
+                     outputCol=self.getOrDefault("outputCol"), k=k)
+        m.cols = cols
+        m.mean = mean
+        m.components = np.asarray(vt)[:k]  # [k, d]
+        return m
+
+
+class PCAModel(Model):
+    _params = {"inputCol": "features", "outputCol": "pca", "k": 2}
+
+    def transform(self, df):
+        X = extract_matrix(df, self.cols)
+        Z = (X - self.mean) @ self.components.T
+        out = df
+        names = []
+        for j in range(Z.shape[1]):
+            name = f"{self.getOrDefault('outputCol')}_{j}"
+            out = with_host_column(out, name, Z[:, j])
+            names.append(name)
+        meta = dict(getattr(df, "_ml_features", None) or {})
+        meta[self.getOrDefault("outputCol")] = names
+        out._ml_features = meta
+        return out
+
+
 class Binarizer(Transformer):
     _params = {"inputCol": None, "outputCol": None, "threshold": 0.0}
 

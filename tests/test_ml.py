@@ -178,3 +178,46 @@ def test_als_recovers_structure(spark):
     assert err < 0.1
     recs = model.recommend_for_user(0, 5)
     assert len(recs) == 5
+
+
+def test_bucketizer_and_discretizer(spark):
+    from spark_tpu.ml import Bucketizer, QuantileDiscretizer
+
+    df = spark.createDataFrame(pa.table({"v": [0.1, 0.4, 0.6, 0.9]}))
+    b = Bucketizer(inputCol="v", outputCol="bkt",
+                   splits=(0.0, 0.5, 1.0))
+    out = b.transform(df).toArrow().to_pydict()
+    assert out["bkt"] == [0.0, 0.0, 1.0, 1.0]
+
+    qd = QuantileDiscretizer(inputCol="v", outputCol="q", numBuckets=2)
+    model = qd.fit(df)
+    out2 = model.transform(df).toArrow().to_pydict()
+    assert len(set(out2["q"])) == 2
+
+
+def test_one_hot_encoder(spark):
+    from spark_tpu.ml import OneHotEncoder
+
+    df = spark.createDataFrame(pa.table({"c": ["a", "b", "c", "a"]}))
+    model = OneHotEncoder(inputCol="c", outputCol="oh", dropLast=True).fit(df)
+    out = model.transform(df).toArrow().to_pydict()
+    assert out["oh_a"] == [1.0, 0.0, 0.0, 1.0]
+    assert out["oh_b"] == [0.0, 1.0, 0.0, 0.0]
+    assert "oh_c" not in out  # dropLast
+
+
+def test_pca(spark):
+    from spark_tpu.ml import PCA
+
+    rng = np.random.default_rng(9)
+    t = rng.normal(0, 3, 300)
+    x = t + rng.normal(0, 0.05, 300)
+    y = 2 * t + rng.normal(0, 0.05, 300)   # rank-1 structure
+    df = VectorAssembler(inputCols=["x", "y"]).transform(
+        spark.createDataFrame(pa.table({"x": x, "y": y})))
+    model = PCA(inputCol="features", outputCol="p", k=1).fit(df)
+    out = model.transform(df).toArrow().to_pydict()
+    z = np.array(out["p_0"])
+    # first component captures nearly all variance
+    total_var = np.var(x) + np.var(y)
+    assert np.var(z) / total_var > 0.99
