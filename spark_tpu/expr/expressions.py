@@ -933,6 +933,53 @@ class Round(Expression):
         return Val(float64, d, c.validity, None)
 
 
+class BitwiseAnd(BinaryArithmetic):
+    symbol = "&"
+
+    def _op(self, l, r):
+        return l & r, None
+
+
+class BitwiseOr(BinaryArithmetic):
+    symbol = "|"
+
+    def _op(self, l, r):
+        return l | r, None
+
+
+class BitwiseXor(BinaryArithmetic):
+    symbol = "^"
+
+    def _op(self, l, r):
+        return l ^ r, None
+
+
+class BitwiseNot(UnaryExpression):
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        if not ctx.is_trace:
+            return Val(self.dtype, None, c.validity, None)
+        return Val(self.dtype, ~c.data, c.validity, None)
+
+
+class ShiftLeft(BinaryArithmetic):
+    symbol = "<<"
+
+    def _op(self, l, r):
+        return l << r, None
+
+
+class ShiftRight(BinaryArithmetic):
+    symbol = ">>"
+
+    def _op(self, l, r):
+        return l >> r, None
+
+
 class Pow(BinaryArithmetic):
     symbol = "^"
 

@@ -111,6 +111,12 @@ def _reg_all() -> None:
     r("signum", lambda c: E.Signum(c))
     r("pi", lambda: E.Literal(3.141592653589793))
     r("e", lambda: E.Literal(2.718281828459045))
+    r("shiftleft", lambda a, b: E.ShiftLeft(a, b))
+    r("shiftright", lambda a, b: E.ShiftRight(a, b))
+    r("bit_and_op", lambda a, b: E.BitwiseAnd(a, b))
+    r("bit_or_op", lambda a, b: E.BitwiseOr(a, b))
+    r("bit_xor_op", lambda a, b: E.BitwiseXor(a, b))
+    r("bit_not", lambda c: E.BitwiseNot(c))
     # conditionals
     r("if", lambda p, a, b: E.If(p, a, b))
     r("coalesce", lambda *a: E.Coalesce(list(a)))
