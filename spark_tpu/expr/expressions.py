@@ -1041,10 +1041,10 @@ class BinaryComparison(BinaryExpression):
     def eval(self, ctx):
         l = ctx.eval(self.left)
         r = ctx.eval(self.right)
-        v = ctx.and_valid(l, r)
         lt, rt = l.dtype, r.dtype
         is_string = isinstance(lt, StringType) and isinstance(rt, StringType)
         if is_string:
+            v = ctx.and_valid(l, r)
             if type(self) in (EqualTo, NotEqualTo, EqualNullSafe):
                 ld = _string_eq_domain(ctx, l)
                 rd = _string_eq_domain(ctx, r)
@@ -1053,11 +1053,15 @@ class BinaryComparison(BinaryExpression):
             if not ctx.is_trace:
                 return Val(boolean, None, v, None)
         else:
+            # casts run in BOTH modes: string→X casts register dictionary
+            # parse tables through the aux channel (host/trace symmetry)
+            ct = common_type(lt, rt) or lt
+            lc = cast_val(ctx, l, ct)
+            rc = cast_val(ctx, r, ct)
+            v = ctx.and_valid(lc, rc)
             if not ctx.is_trace:
                 return Val(boolean, None, v, None)
-            ct = common_type(lt, rt) or lt
-            ld = cast_val(ctx, l, ct).data
-            rd = cast_val(ctx, r, ct).data
+            ld, rd = lc.data, rc.data
         return Val(boolean, self._cmp(ld, rd), v, None)
 
     def _cmp(self, l, r):
@@ -1088,16 +1092,19 @@ class EqualNullSafe(BinaryComparison):
         if is_string:
             ld = _string_eq_domain(ctx, l)
             rd = _string_eq_domain(ctx, r)
+            lc, rc = l, r
+        else:
+            ct = common_type(l.dtype, r.dtype) or l.dtype
+            lc = cast_val(ctx, l, ct)
+            rc = cast_val(ctx, r, ct)
         if not ctx.is_trace:
             return Val(boolean, None, None, None)
         jnp = _jnp()
         if not is_string:
-            ct = common_type(l.dtype, r.dtype) or l.dtype
-            ld = cast_val(ctx, l, ct).data
-            rd = cast_val(ctx, r, ct).data
+            ld, rd = lc.data, rc.data
         eq = ld == rd
-        lv = l.validity if l.validity is not None else jnp.ones((), bool)
-        rv = r.validity if r.validity is not None else jnp.ones((), bool)
+        lv = lc.validity if lc.validity is not None else jnp.ones((), bool)
+        rv = rc.validity if rc.validity is not None else jnp.ones((), bool)
         both_null = (~lv) & (~rv)
         data = jnp.where(lv & rv, eq, both_null)
         return Val(boolean, data, None, None)

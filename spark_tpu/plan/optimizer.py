@@ -333,6 +333,86 @@ def _remap_union_cond(cond: Expression, union: Union, i: int) -> Expression:
     return substitute_attrs(cond, m)
 
 
+class RewriteHostOnlyExpressions(Rule):
+    """Expressions with no device form become vectorized host UDFs
+    (reference analog: expressions lacking codegen fall back to interpreted
+    eval — here the fallback is the Arrow-UDF path):
+      * concat/concat_ws over 2+ string COLUMNS (dictionary products are
+        unbounded);
+      * cast(non-string AS string) (value universe unknown host-side)."""
+
+    def apply(self, plan):
+        import numpy as np
+
+        from ..expr.expressions import Cast, Concat, ConcatWs, Literal
+        from ..expr.pyudf import PythonUDF
+        from ..types import DateType, StringType, TimestampType, string
+
+        def to_str_fn(dt):
+            import datetime
+
+            if isinstance(dt, DateType):
+                return lambda a: np.array(
+                    [(datetime.date(1970, 1, 1)
+                      + datetime.timedelta(days=int(v))).isoformat()
+                     for v in a], dtype=object)
+            if isinstance(dt, TimestampType):
+                return lambda a: np.array(
+                    [(datetime.datetime(1970, 1, 1)
+                      + datetime.timedelta(microseconds=int(v))).isoformat(
+                          sep=" ")
+                     for v in a], dtype=object)
+            return lambda a: np.array([_fmt_num(v) for v in a], dtype=object)
+
+        def fix(e: Expression) -> Expression:
+            if isinstance(e, (Concat, ConcatWs)):
+                cols = [a for a in e.args if not isinstance(a, Literal)]
+                if len(cols) >= 2:
+                    sep = e.sep if isinstance(e, ConcatWs) else ""
+                    parts = [a if not isinstance(a, Literal)
+                             else a for a in e.args]
+
+                    def concat_fn(*arrays, _sep=sep):
+                        out = []
+                        for vals in zip(*arrays):
+                            if any(v is None for v in vals):
+                                out.append(None)
+                            else:
+                                out.append(_sep.join(str(v) for v in vals))
+                        return np.array(out, dtype=object)
+
+                    return PythonUDF(concat_fn, list(e.args), string,
+                                     name="concat")
+            if isinstance(e, Cast) and isinstance(e.to, StringType) and \
+                    e.child.resolved and \
+                    not isinstance(e.child.dtype, StringType):
+                return PythonUDF(to_str_fn(e.child.dtype), [e.child],
+                                 string, name="cast_str")
+            return e
+
+        def rule(node):
+            if node.expressions_resolved:
+                return node.transform_expressions(
+                    lambda ex: ex.transform_up(fix))
+            return node
+
+        return plan.transform_up(rule)
+
+
+def _fmt_num(v):
+    if v is None:
+        return None
+    if isinstance(v, float):
+        return repr(v)
+    import numpy as _np
+
+    if isinstance(v, _np.floating):
+        return repr(float(v))
+    if isinstance(v, (bool, _np.bool_)):
+        return str(bool(v)).lower()
+    return str(v)
+
+
 class ExtractPythonUDFs(Rule):
     """Pull PythonUDFs out of projections/filters into PythonEval operators
     (reference: sqlx/python/ExtractPythonUDFs.scala)."""
@@ -944,6 +1024,7 @@ class Optimizer(RuleExecutor):
                 CombineFilters(),
             ]),
             Batch("Python UDFs", FixedPoint(10), [
+                RewriteHostOnlyExpressions(),
                 ExtractPythonUDFs(),
             ]),
             Batch("Column pruning", FixedPoint(20), [

@@ -136,3 +136,39 @@ def test_interval_in_predicate(spark):
                       WHERE d BETWEEN DATE '2000-01-01'
                                   AND DATE '2000-01-01' + INTERVAL 60 DAYS""")
     assert out["c"] == [1]
+
+
+def test_concat_two_string_columns(spark):
+    df = spark.createDataFrame(pa.table({
+        "a": ["x", "y", None], "b": ["1", "2", "3"]}))
+    out = df.select(F.concat("a", "b").alias("c")).toArrow().to_pydict()
+    assert out["c"] == ["x1", "y2", None]
+    out2 = q(spark, "SELECT first || '-' || last AS full FROM "
+                    "(SELECT col1 AS first, col2 AS last FROM "
+                    "(VALUES ('ada', 'lovelace')))")
+    assert out2["full"] == ["ada-lovelace"]
+
+
+def test_cast_to_string(spark):
+    import datetime
+
+    df = spark.createDataFrame(pa.table({
+        "i": [42, 7],
+        "d": pa.array([datetime.date(2020, 1, 2)] * 2, pa.date32())}))
+    out = df.select(F.col("i").cast("string").alias("s"),
+                    F.col("d").cast("string").alias("ds")) \
+        .toArrow().to_pydict()
+    assert out["s"] == ["42", "7"]
+    assert out["ds"] == ["2020-01-02", "2020-01-02"]
+
+
+def test_date_vs_string_literal_comparison(spark):
+    import datetime
+
+    df = spark.createDataFrame(pa.table({
+        "d": pa.array([datetime.date(1999, 1, 15),
+                       datetime.date(2001, 6, 1)], pa.date32())}))
+    df.createOrReplaceTempView("dcmp")
+    out = q(spark, "SELECT count(*) AS c FROM dcmp "
+                   "WHERE d BETWEEN '1999-01-01' AND '1999-12-31'")
+    assert out["c"] == [1]
