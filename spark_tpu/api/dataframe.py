@@ -398,6 +398,29 @@ class DataFrame:
 
         return DataFrameNaFunctions(self)
 
+    @property
+    def rdd(self):
+        """Materialize into the RDD layer as Row objects (reference:
+        Dataset.rdd). Partition structure is preserved."""
+        from ..rdd import RDDContext
+
+        parts = self.query_execution.execute()
+        names = self.columns
+        rows: list[Row] = []
+        splits: list[int] = []
+        for p in parts:
+            start = len(rows)
+            for b in p:
+                d = b.to_pydict()
+                for vals in zip(*[d[n] for n in names]) if names else []:
+                    rows.append(Row(zip(names, vals)))
+            splits.append(len(rows) - start)
+        sc = getattr(self.session, "_rdd_context", None)
+        if sc is None:
+            sc = RDDContext(parallelism=max(len(parts), 1))
+            self.session._rdd_context = sc
+        return sc.parallelize(rows, max(len(parts), 1))
+
     def fillna(self, value, subset=None) -> "DataFrame":
         return self.na.fill(value, subset)
 

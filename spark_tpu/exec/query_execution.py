@@ -136,12 +136,18 @@ class QueryExecution:
                     f"result has {out.num_rows} rows > "
                     "spark.tpu.collect.maxRows")
             if bus is not None:
+                from ..physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+                counters = dict(
+                    self.session._metrics.snapshot()["counters"])
+                counters["kernel_cache.hits"] = KC.hits
+                counters["kernel_cache.misses"] = KC.misses
                 bus.post(QueryEvent(
                     "querySucceeded", qid, time.time(),
                     duration_ms=(time.perf_counter() - t0) * 1000,
                     phases=dict(self.phase_times),
                     plan=self.physical.tree_string(),
-                    metrics=self.session._metrics.snapshot()["counters"]))
+                    metrics=counters))
             return out
         except Exception as e:
             if bus is not None:

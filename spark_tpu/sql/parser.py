@@ -494,7 +494,22 @@ class Parser:
         parts = [self.ident()]
         while self.eat_op("."):
             parts.append(self.ident())
-        plan = L.UnresolvedRelation(parts)
+        plan: L.LogicalPlan = L.UnresolvedRelation(parts)
+        if self.peek().value.lower() == "tablesample":
+            self.next()
+            self.expect_op("(")
+            t = self.next()
+            if t.kind != "num":
+                raise ParseException("TABLESAMPLE expects a number")
+            amount = float(t.value.rstrip("LlDdSs"))
+            unit = self.ident().lower()
+            self.expect_op(")")
+            if unit == "percent":
+                plan = L.Sample(amount / 100.0, 42, plan)
+            elif unit == "rows":
+                plan = L.Limit(int(amount), plan)
+            else:
+                raise ParseException(f"TABLESAMPLE unit {unit}")
         alias = self._maybe_alias()
         if alias:
             return L.SubqueryAlias(alias, plan)

@@ -69,3 +69,24 @@ def test_stat_functions(spark):
     sb = df.stat.sampleBy("cat", {"a": 1.0, "b": 0.0}, seed=1)
     got = sb.toArrow().to_pydict()["cat"]
     assert set(got) == {"a"}
+
+
+def test_df_rdd_bridge(spark):
+    df = spark.createDataFrame(pa.table({"x": [1, 2, 3], "s": ["a", "b", "c"]}))
+    r = df.rdd
+    rows = r.collect()
+    assert [row.x for row in rows] == [1, 2, 3]
+    assert r.map(lambda row: row.x * 10).sum() == 60
+
+
+def test_tablesample(spark):
+    df = spark.createDataFrame(pa.table({"x": list(range(1000))}))
+    df.createOrReplaceTempView("ts_t")
+    n = spark.sql(
+        "SELECT count(*) AS c FROM ts_t TABLESAMPLE (10 PERCENT)"
+    ).toArrow().to_pydict()["c"][0]
+    assert 40 < n < 200
+    n2 = spark.sql(
+        "SELECT count(*) AS c FROM ts_t TABLESAMPLE (50 ROWS)"
+    ).toArrow().to_pydict()["c"][0]
+    assert n2 == 50
