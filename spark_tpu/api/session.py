@@ -195,6 +195,9 @@ class TpuSession:
             except Exception:
                 pass
         self._streams.clear()
+        rc = getattr(self, "_rdd_context", None)
+        if rc is not None:
+            rc.stop()
         if TpuSession._active is self:
             TpuSession._active = None
 
