@@ -129,6 +129,16 @@ class StringDict:
             self._device_ranks = jnp.asarray(r)
         return self._device_ranks
 
+    def device_rank_to_code(self):
+        """Inverse of ranks: rank → dictionary code (string MIN/MAX
+        aggregation: reduce in rank space, map the winner back to a code)."""
+        import jax.numpy as jnp
+
+        r = self.ranks if len(self.values) else np.zeros(1, np.int32)
+        inv = np.empty(len(r), dtype=np.int32)
+        inv[r] = np.arange(len(r), dtype=np.int32)
+        return jnp.asarray(inv)
+
     def map_values(self, fn) -> "StringDict":
         """Apply a host string→string function to every dictionary entry —
         how upper/lower/substr/concat-literal execute in O(|dict|) instead of

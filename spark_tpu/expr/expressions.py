@@ -1757,6 +1757,57 @@ class Concat(Expression):
         return _C(self.args[i]).eval(ctx)
 
 
+class RegexpExtract(_DictTransform):
+    def __init__(self, child, pattern: Expression, group: Expression):
+        super().__init__(child)
+        self.pattern = str(pattern.value)
+        self.group = int(group.value)
+        self._rx = re.compile(self.pattern)
+
+    def _data_args(self):
+        return (("pattern", self.pattern), ("group", self.group))
+
+    def transform(self, s):
+        m = self._rx.search(s)
+        if m is None:
+            return ""
+        try:
+            return m.group(self.group) or ""
+        except IndexError:
+            return ""
+
+
+class DateFormat(Expression):
+    """date_format(d, fmt): Java-style pattern subset mapped to strftime,
+    evaluated per-row host-side (value universe unknown) via the UDF
+    fallback at planning time — this node only resolves the type."""
+
+    child_fields = ("child",)
+
+    _JAVA_TO_STRF = [("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"),
+                     ("HH", "%H"), ("mm", "%M"), ("ss", "%S"),
+                     ("EEEE", "%A"), ("E", "%a"), ("yy", "%y")]
+
+    def __init__(self, child: Expression, fmt: Expression):
+        self.child = child
+        self.fmt = str(fmt.value)
+
+    @property
+    def dtype(self):
+        return string
+
+    @classmethod
+    def to_strftime(cls, fmt: str) -> str:
+        for a, b in cls._JAVA_TO_STRF:
+            fmt = fmt.replace(a, b)
+        return fmt
+
+    def eval(self, ctx):
+        raise UnsupportedOperationError(
+            "date_format must be rewritten to a host UDF (optimizer rule "
+            "RewriteHostOnlyExpressions)")
+
+
 class Length(UnaryExpression):
     @property
     def dtype(self):

@@ -150,6 +150,9 @@ def _reg_all() -> None:
     r("like", lambda c, p: E.Like(c, _lit_str(p)))
     r("rlike", lambda c, p: E.RLike(c, _lit_str(p)))
     r("regexp", lambda c, p: E.RLike(c, _lit_str(p)))
+    r("regexp_extract", lambda c, p, g=None: E.RegexpExtract(
+        c, p, g if g is not None else E.Literal(1)))
+    r("date_format", lambda c, f: E.DateFormat(c, f))
     r("initcap", lambda c: E.Initcap(c))
     r("reverse", lambda c: E.Reverse(c))
     r("repeat", lambda c, n: E.Repeat(c, n))

@@ -82,9 +82,6 @@ def lower_aggregate_function(func: AggregateFunction, out_name: str,
                        Alias(b, out_name, out_id))
     if isinstance(func, (Min, Max)):
         op = "min" if isinstance(func, Min) else "max"
-        if isinstance(child.dtype, StringType):
-            raise UnsupportedOperationError(
-                "min/max over strings not yet supported on device")
         b = battr(0, op)
         return AggSpec(func, child, [op], [b], Alias(b, out_name, out_id))
     if isinstance(func, Average):

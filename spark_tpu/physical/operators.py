@@ -553,14 +553,21 @@ class HashAggregateExec(PhysicalPlan):
         ops = tuple(op for op, _ in vals)
         val_datas = []
         val_valids = []
-        for op, attr in vals:
+        string_minmax: dict[int, Column] = {}  # buffer idx → source column
+        for bi, (op, attr) in enumerate(vals):
             if attr is None:
                 val_datas.append(batch.row_mask)  # dummy
                 val_valids.append(None)
+                continue
+            c = batch.columns[pos[attr.expr_id]]
+            if op in ("min", "max") and c.is_string:
+                # strings reduce in RANK space (lexicographic); the winning
+                # rank maps back to a dictionary code afterwards
+                val_datas.append(c.sort_keys())
+                string_minmax[bi] = c
             else:
-                c = batch.columns[pos[attr.expr_id]]
                 val_datas.append(c.data)
-                val_valids.append(c.validity)
+            val_valids.append(c.validity)
 
         out_schema = attrs_schema(self.output)
 
@@ -572,8 +579,9 @@ class HashAggregateExec(PhysicalPlan):
                 key, lambda: _ungrouped_kernel(
                     ops, cap, tuple(v is not None for v in val_valids)))
             datas, valids, mask = kernel(val_datas, val_valids, batch.row_mask)
-            cols = [Column(f.dataType, d, v, None)
-                    for f, d, v in zip(out_schema.fields, datas, valids)]
+            cols = [self._finish_buffer(bi, d, v, f, string_minmax)
+                    for bi, (f, d, v) in enumerate(
+                        zip(out_schema.fields, datas, valids))]
             return ColumnarBatch(out_schema, cols, mask, num_rows=1)
 
         key_cols = [batch.columns[pos[g.expr_id]] for g in self.grouping]
@@ -582,7 +590,7 @@ class HashAggregateExec(PhysicalPlan):
         key_valids = [c.validity for c in key_cols]
 
         dense = self._try_dense(batch, key_cols, ops, val_datas, val_valids,
-                                out_schema, ctx)
+                                out_schema, ctx, string_minmax)
         if dense is not None:
             return dense
 
@@ -603,16 +611,29 @@ class HashAggregateExec(PhysicalPlan):
         for (kd, kv), kc, f in zip(out_keys, key_cols,
                                    out_schema.fields[: len(key_cols)]):
             cols.append(Column(f.dataType, kd, kv, kc.dictionary))
-        for (bd, bv), f in zip(bufs, out_schema.fields[len(key_cols):]):
-            # cast buffer to declared device dtype if needed (e.g. acc int64)
-            want = f.dataType.device_dtype
-            if str(bd.dtype) != str(want):
-                bd = bd.astype(want)
-            cols.append(Column(f.dataType, bd, bv, None))
+        for bi, ((bd, bv), f) in enumerate(
+                zip(bufs, out_schema.fields[len(key_cols):])):
+            cols.append(self._finish_buffer(bi, bd, bv, f, string_minmax))
         return ColumnarBatch(out_schema, cols, out_mask, num_rows=None)
 
+    def _finish_buffer(self, bi, bd, bv, f, string_minmax):
+        jnp = _jnp()
+        if bi in string_minmax:
+            from ..columnar.batch import EMPTY_DICT
+
+            c = string_minmax[bi]
+            sd = c.dictionary or EMPTY_DICT
+            inv = sd.device_rank_to_code()
+            codes = jnp.take(inv, jnp.clip(bd.astype(jnp.int32), 0,
+                                           inv.shape[0] - 1))
+            return Column(f.dataType, codes, bv, sd)
+        want = f.dataType.device_dtype
+        if str(bd.dtype) != str(want):
+            bd = bd.astype(want)
+        return Column(f.dataType, bd, bv, None)
+
     def _try_dense(self, batch: ColumnarBatch, key_cols, ops, val_datas,
-                   val_valids, out_schema, ctx):
+                   val_valids, out_schema, ctx, string_minmax):
         """Dense-range fast path dispatch: single integral key whose value
         span fits a capacity bucket (host syncs two scalars to decide)."""
         import jax
@@ -667,11 +688,8 @@ class HashAggregateExec(PhysicalPlan):
         kdata = out_keys.astype(kf.dataType.device_dtype)
         kv = key_validity if kc.validity is not None else None
         cols.append(Column(kf.dataType, kdata, kv, None))
-        for (bd, bv), f in zip(bufs, out_schema.fields[1:]):
-            want = f.dataType.device_dtype
-            if str(bd.dtype) != str(want):
-                bd = bd.astype(want)
-            cols.append(Column(f.dataType, bd, bv, None))
+        for bi, ((bd, bv), f) in enumerate(zip(bufs, out_schema.fields[1:])):
+            cols.append(self._finish_buffer(bi, bd, bv, f, string_minmax))
         return ColumnarBatch(out_schema, cols, out_mask, num_rows=None)
 
     def simple_string(self):

@@ -365,6 +365,34 @@ class RewriteHostOnlyExpressions(Rule):
             return lambda a: np.array([_fmt_num(v) for v in a], dtype=object)
 
         def fix(e: Expression) -> Expression:
+            from ..expr.expressions import DateFormat
+
+            if isinstance(e, DateFormat):
+                import datetime
+
+                strf = DateFormat.to_strftime(e.fmt)
+                src_dt = e.child.dtype
+
+                def fmt_fn(a, _strf=strf, _dt=src_dt):
+                    from ..types import TimestampType as TT
+
+                    out = []
+                    for v in a:
+                        if v is None:
+                            out.append(None)
+                        elif isinstance(_dt, TT):
+                            out.append((datetime.datetime(1970, 1, 1)
+                                        + datetime.timedelta(
+                                            microseconds=int(v)))
+                                       .strftime(_strf))
+                        else:
+                            out.append((datetime.date(1970, 1, 1)
+                                        + datetime.timedelta(days=int(v)))
+                                       .strftime(_strf))
+                    return np.array(out, dtype=object)
+
+                return PythonUDF(fmt_fn, [e.child], string,
+                                 name="date_format", vectorized=True)
             if isinstance(e, (Concat, ConcatWs)):
                 cols = [a for a in e.args if not isinstance(a, Literal)]
                 if len(cols) >= 2:

@@ -217,3 +217,20 @@ def test_count_multi_partition(spark):
     out = _dict(df.groupBy((F.col("id") % 7).alias("m")).agg(
         F.count("*").alias("c")).orderBy("m"))
     assert sum(out["c"]) == 10000
+
+
+def test_string_min_max_aggregate(spark):
+    df = spark.createDataFrame(pa.table({
+        "g": [1, 1, 2, 2, 2],
+        "s": ["banana", "apple", "zebra", None, "mango"]}))
+    out = (df.groupBy("g").agg(F.min("s").alias("mn"),
+                               F.max("s").alias("mx"))
+           .orderBy("g").toArrow().to_pydict())
+    assert out["mn"] == ["apple", "mango"]
+    assert out["mx"] == ["banana", "zebra"]
+    # global + multi-partition merge
+    out2 = (df.repartition(3).agg(F.min("s").alias("mn"),
+                                  F.max("s").alias("mx"))
+            .toArrow().to_pydict())
+    assert out2["mn"] == ["apple"]
+    assert out2["mx"] == ["zebra"]

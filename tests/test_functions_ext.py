@@ -172,3 +172,12 @@ def test_date_vs_string_literal_comparison(spark):
     out = q(spark, "SELECT count(*) AS c FROM dcmp "
                    "WHERE d BETWEEN '1999-01-01' AND '1999-12-31'")
     assert out["c"] == [1]
+
+
+def test_regexp_extract_and_date_format(spark):
+    out = q(spark, """SELECT regexp_extract('abc-123-xyz', '([0-9]+)', 1) AS n,
+                             date_format(DATE '2021-07-04', 'yyyy/MM/dd') AS d,
+                             date_format(DATE '2021-07-04', 'EEEE') AS w""")
+    assert out["n"] == ["123"]
+    assert out["d"] == ["2021/07/04"]
+    assert out["w"] == ["Sunday"]
