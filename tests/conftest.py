@@ -42,8 +42,11 @@ def thread_audit():
 def spark():
     from spark_tpu import TpuSession
 
-    s = TpuSession("tests", {"spark.sql.shuffle.partitions": 4,
-                             "spark.tpu.batch.capacity": 1 << 12})
+    conf = {"spark.sql.shuffle.partitions": 4,
+            "spark.tpu.batch.capacity": 1 << 12}
+    if os.environ.get("SPARK_TPU_VALIDATE") == "1":
+        conf["spark.tpu.debug.validateBatches"] = "true"
+    s = TpuSession("tests", conf)
     yield s
     s.stop()
 

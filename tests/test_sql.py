@@ -184,3 +184,16 @@ def test_nested_subquery_aliasing(store):
       ) a WHERE a.total >= 70 ORDER BY a.total""")
     assert out["name"] == ["banana", "apple"]
     assert out["total"] == [70, 110]
+
+
+def test_non_equi_inner_join(store):
+    out = q(store, """SELECT count(*) AS c FROM items a JOIN items b
+                      ON a.id < b.id""")
+    assert out["c"] == [3]  # (1,2),(1,3),(2,3)
+
+
+def test_mixed_equi_and_residual_join(store):
+    out = q(store, """SELECT s.item, s.qty FROM sales s JOIN items i
+                      ON s.item = i.id AND s.qty > 25
+                      ORDER BY s.item, s.qty""")
+    assert out["qty"] == [40, 60, 50, 30]
