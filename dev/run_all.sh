@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Full verification: native build, tests (with batch validation), examples,
+# micro-benchmarks, headline bench (role of the reference's dev/run-tests.py).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== native build =="
+make -C native
+
+echo "== tests (batch validation on) =="
+SPARK_TPU_VALIDATE=1 python -m pytest tests/ -q
+
+echo "== examples =="
+for ex in examples/*.py; do
+    echo "-- $ex"
+    python "$ex" > /dev/null
+done
+
+echo "== micro-benchmarks =="
+python benchmarks/run_benchmarks.py --rows "${BENCH_ROWS:-2000000}"
+
+echo "== headline bench =="
+python bench.py
