@@ -47,6 +47,7 @@ class DescribeCommand(Command):
 class ExplainCommand(Command):
     query: LogicalPlan
     extended: bool = False
+    analyze: bool = False
 
 
 @dataclass
@@ -163,7 +164,17 @@ def run_command(session, cmd: Command):
     if isinstance(cmd, ExplainCommand):
         from ..api.dataframe import DataFrame as DF
 
-        text = DF(session, cmd.query).query_execution.explain_string()
+        qe = DF(session, cmd.query).query_execution
+        text = qe.explain_string()
+        if cmd.analyze:
+            qe.to_arrow()  # execute for real timings
+            lines = [text, "", "== Analyzed Runtime =="]
+            for phase, t in qe.phase_times.items():
+                lines.append(f"{phase}: {t * 1000:.1f} ms")
+            counters = session._metrics.snapshot()["counters"]
+            for k in sorted(counters):
+                lines.append(f"{k}: {counters[k]}")
+            text = "\n".join(lines)
         return df_of(pa.table({"plan": pa.array([text])}))
 
     if isinstance(cmd, CacheTableCommand):

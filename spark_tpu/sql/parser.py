@@ -157,10 +157,12 @@ class Parser:
             self.eat_kw("table")
             return C.DescribeCommand(self._qualified_name())
         if self.eat_kw("explain"):
-            extended = self.peek().value.lower() in ("extended", "formatted")
-            if extended:
+            mode = self.peek().value.lower()
+            analyze = mode == "analyze"
+            extended = mode in ("extended", "formatted")
+            if analyze or extended:
                 self.next()
-            return C.ExplainCommand(self.parse_query(), extended)
+            return C.ExplainCommand(self.parse_query(), extended, analyze)
         if self.peek().value.lower() == "cache":
             self.next()
             self.expect_kw("table")
