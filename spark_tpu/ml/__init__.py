@@ -15,6 +15,7 @@ from .tuning import (  # noqa: F401
 )
 from .tree import (  # noqa: F401
     DecisionTreeClassifier, DecisionTreeRegressor,
+    GBTClassifier, GBTRegressor,
     RandomForestClassifier, RandomForestRegressor,
 )
 from .recommendation import ALS, ALSModel  # noqa: F401

@@ -160,6 +160,103 @@ class DecisionTreeRegressor(_TreeEstimator):
     _impurity = "variance"
 
 
+class GBTRegressor(Estimator):
+    """Gradient-boosted trees for regression (reference:
+    ml/regression/GBTRegressor.scala): residual-fitting boosting over the
+    histogram tree learner."""
+
+    _params = {"featuresCol": "features", "labelCol": "label",
+               "predictionCol": "prediction", "maxDepth": 3,
+               "maxIter": 20, "stepSize": 0.1, "maxBins": 32, "seed": 42}
+
+    def fit(self, df):
+        cols = resolve_feature_cols(df, self.getOrDefault("featuresCol"))
+        X = extract_matrix(df, cols)
+        y = extract_vector(df, self.getOrDefault("labelCol"))
+        rng = np.random.default_rng(self.getOrDefault("seed"))
+        lr = float(self.getOrDefault("stepSize"))
+        base = float(y.mean())
+        pred = np.full(len(y), base)
+        trees = []
+        for _ in range(int(self.getOrDefault("maxIter"))):
+            residual = y - pred
+            t = _build_tree(X, residual, 0,
+                            int(self.getOrDefault("maxDepth")), 1,
+                            "variance", int(self.getOrDefault("maxBins")),
+                            rng, 1.0)
+            trees.append(t)
+            pred = pred + lr * _predict_tree(t, X)
+        m = GBTRegressorModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"))
+        m.cols = cols
+        m.base = base
+        m.lr = lr
+        m.trees = trees
+        return m
+
+
+class GBTRegressorModel(Model):
+    _params = {"featuresCol": "features", "predictionCol": "prediction"}
+
+    def transform(self, df):
+        X = extract_matrix(df, self.cols)
+        pred = np.full(len(X), self.base)
+        for t in self.trees:
+            pred = pred + self.lr * _predict_tree(t, X)
+        return with_host_column(df, self.getOrDefault("predictionCol"), pred)
+
+
+class GBTClassifier(Estimator):
+    """Binary GBT classifier: logistic boosting on the half-gradient."""
+
+    _params = {"featuresCol": "features", "labelCol": "label",
+               "predictionCol": "prediction",
+               "probabilityCol": "probability", "maxDepth": 3,
+               "maxIter": 20, "stepSize": 0.2, "maxBins": 32, "seed": 42}
+
+    def fit(self, df):
+        cols = resolve_feature_cols(df, self.getOrDefault("featuresCol"))
+        X = extract_matrix(df, cols)
+        y = extract_vector(df, self.getOrDefault("labelCol"))
+        rng = np.random.default_rng(self.getOrDefault("seed"))
+        lr = float(self.getOrDefault("stepSize"))
+        f = np.zeros(len(y))
+        trees = []
+        for _ in range(int(self.getOrDefault("maxIter"))):
+            p = 1.0 / (1.0 + np.exp(-np.clip(f, -50, 50)))
+            grad = y - p  # negative gradient of logloss
+            t = _build_tree(X, grad, 0,
+                            int(self.getOrDefault("maxDepth")), 1,
+                            "variance", int(self.getOrDefault("maxBins")),
+                            rng, 1.0)
+            trees.append(t)
+            f = f + lr * _predict_tree(t, X)
+        m = GBTClassifierModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"),
+            probabilityCol=self.getOrDefault("probabilityCol"))
+        m.cols = cols
+        m.lr = lr
+        m.trees = trees
+        return m
+
+
+class GBTClassifierModel(Model):
+    _params = {"featuresCol": "features", "predictionCol": "prediction",
+               "probabilityCol": "probability"}
+
+    def transform(self, df):
+        X = extract_matrix(df, self.cols)
+        f = np.zeros(len(X))
+        for t in self.trees:
+            f = f + self.lr * _predict_tree(t, X)
+        p = 1.0 / (1.0 + np.exp(-np.clip(f, -50, 50)))
+        out = with_host_column(df, self.getOrDefault("probabilityCol"), p)
+        return with_host_column(out, self.getOrDefault("predictionCol"),
+                                (p >= 0.5).astype(np.float64))
+
+
 class RandomForestClassifier(_TreeEstimator):
     _impurity = "gini"
     _params = dict(_TreeEstimator._params, numTrees=20,

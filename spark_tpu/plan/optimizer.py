@@ -985,6 +985,69 @@ class PruneFilters(Rule):
         return plan.transform_up(rule)
 
 
+class CombineUnions(Rule):
+    """Flatten nested unions (reference: CombineUnions) — fewer positional
+    rewraps, one UnionExec."""
+
+    def apply(self, plan):
+        def rule(node):
+            if isinstance(node, Union) and any(
+                    isinstance(c, Union) for c in node.children_plans):
+                flat: list[LogicalPlan] = []
+                for c in node.children_plans:
+                    if isinstance(c, Union):
+                        flat.extend(c.children_plans)
+                    else:
+                        flat.append(c)
+                return Union(flat)
+            return node
+
+        return plan.transform_up(rule)
+
+
+class PropagateEmptyRelation(Rule):
+    """Empty local relations collapse the operators above them (reference:
+    PropagateEmptyRelation)."""
+
+    def apply(self, plan):
+        def is_empty(p: LogicalPlan) -> bool:
+            return isinstance(p, LocalRelation) and p.table.num_rows == 0
+
+        def empty_of(node: LogicalPlan) -> LogicalPlan:
+            return LocalRelation(list(node.output), _empty_table(node.output))
+
+        def rule(node):
+            if isinstance(node, (Filter, Sort, Limit, Offset, Sample,
+                                 Repartition)) and is_empty(node.child):
+                return empty_of(node)
+            if isinstance(node, Project) and is_empty(node.child) and \
+                    node.resolved:
+                return empty_of(node)
+            if isinstance(node, Join) and node.resolved:
+                if node.join_type in ("inner", "cross", "left_semi") and \
+                        (is_empty(node.left) or is_empty(node.right)):
+                    return empty_of(node)
+                if node.join_type in ("left_outer", "left_anti") and \
+                        is_empty(node.left):
+                    return empty_of(node)
+            if isinstance(node, Union) and node.resolved:
+                alive = [c for c in node.children_plans if not is_empty(c)]
+                if not alive:
+                    return empty_of(node)
+                if len(alive) < len(node.children_plans):
+                    if len(alive) == 1:
+                        keep = alive[0]
+                        # preserve output ids positionally
+                        return Project(
+                            [Alias(b, a.name, a.expr_id)
+                             for a, b in zip(node.output, keep.output)],
+                            keep)
+                    return Union(alive)
+            return node
+
+        return plan.transform_up(rule)
+
+
 class CombineLimits(Rule):
     def apply(self, plan):
         def rule(node):
@@ -1042,6 +1105,8 @@ class Optimizer(RuleExecutor):
                 BooleanSimplification(),
                 SimplifyCasts(),
                 PruneFilters(),
+                PropagateEmptyRelation(),
+                CombineUnions(),
                 CombineLimits(),
                 CollapseProjects(),
                 RemoveNoopProject(),

@@ -221,3 +221,31 @@ def test_pca(spark):
     # first component captures nearly all variance
     total_var = np.var(x) + np.var(y)
     assert np.var(z) / total_var > 0.99
+
+
+def test_gbt_regressor(spark):
+    from spark_tpu.ml import GBTRegressor
+
+    rng = np.random.default_rng(10)
+    x = rng.uniform(0, 10, 600)
+    y = np.sin(x) * 2 + 0.1 * x + rng.normal(0, 0.05, 600)
+    df = VectorAssembler(inputCols=["x"]).transform(
+        spark.createDataFrame(pa.table({"x": x, "label": y})))
+    model = GBTRegressor(maxIter=40, maxDepth=3, stepSize=0.3).fit(df)
+    rmse = RegressionEvaluator().evaluate(model.transform(df))
+    assert rmse < 0.3
+
+
+def test_gbt_classifier(spark):
+    from spark_tpu.ml import GBTClassifier
+
+    rng = np.random.default_rng(11)
+    x1 = rng.uniform(-1, 1, 500)
+    x2 = rng.uniform(-1, 1, 500)
+    label = ((x1 * x1 + x2 * x2) < 0.5).astype(np.float64)  # nonlinear ring
+    df = VectorAssembler(inputCols=["x1", "x2"]).transform(
+        spark.createDataFrame(pa.table({"x1": x1, "x2": x2,
+                                        "label": label})))
+    model = GBTClassifier(maxIter=30, maxDepth=3).fit(df)
+    acc = MulticlassClassificationEvaluator().evaluate(model.transform(df))
+    assert acc > 0.93

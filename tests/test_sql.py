@@ -197,3 +197,20 @@ def test_mixed_equi_and_residual_join(store):
                       ON s.item = i.id AND s.qty > 25
                       ORDER BY s.item, s.qty""")
     assert out["qty"] == [40, 60, 50, 30]
+
+
+def test_empty_relation_propagation(store):
+    # WHERE false collapses to an empty relation; joins/unions fold away
+    out = q(store, """SELECT s.item FROM sales s
+                      JOIN (SELECT id FROM items WHERE false) t
+                      ON s.item = t.id""")
+    assert out["item"] == []
+    out2 = q(store, "SELECT item FROM sales WHERE false "
+                    "UNION ALL SELECT id FROM items ORDER BY item")
+    assert out2["item"] == [1, 2, 3]
+
+
+def test_nested_union_flattening(store):
+    out = q(store, """SELECT 1 AS v UNION ALL SELECT 2
+                      UNION ALL SELECT 3 UNION ALL SELECT 4""")
+    assert sorted(out["v"]) == [1, 2, 3, 4]

@@ -118,7 +118,7 @@ def test_rate_source(spark):
     q = (df.writeStream.format("memory").queryName("s_rate")
          .outputMode("append").start())
     try:
-        deadline = time.time() + 5
+        deadline = time.time() + 10
         while time.time() < deadline:
             try:
                 out = _sink_rows(spark, "s_rate")
