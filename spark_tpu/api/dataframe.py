@@ -280,6 +280,34 @@ class DataFrame:
     def sample(self, fraction: float, seed: int = 42) -> "DataFrame":
         return self._with(L.Sample(fraction, seed, self.plan))
 
+    def mapInPandas(self, fn, schema) -> "DataFrame":
+        """Apply fn(pandas.DataFrame) -> pandas.DataFrame per partition
+        (reference: Dataset.mapInPandas over MapInPandasExec). Host
+        evaluation: partitions cross as Arrow, results re-enter the engine."""
+        import pandas as pd
+        import pyarrow as pa
+
+        if isinstance(schema, str):
+            from ..sql.parser import parse_data_type  # noqa: F401
+
+            raise ValueError("pass a StructType schema")
+        parts = self.query_execution.execute()
+        from ..physical.operators import attrs_schema
+        from ..types import to_arrow_type
+
+        out_tables = []
+        for p in parts:
+            for b in p:
+                pdf = b.to_arrow().to_pandas()
+                res = fn(pdf)
+                out_tables.append(pa.Table.from_pandas(
+                    res, preserve_index=False))
+        merged = pa.concat_tables(out_tables, promote_options="permissive") \
+            if out_tables else pa.table(
+                {f.name: pa.array([], to_arrow_type(f.dataType))
+                 for f in schema.fields})
+        return self.session.createDataFrame(merged)
+
     def describe(self, *cols: str) -> "DataFrame":
         """Summary statistics for numeric columns
         (reference: Dataset.describe / StatFunctions)."""
@@ -520,6 +548,41 @@ class GroupedData:
 
     def count(self) -> DataFrame:
         return self.agg(Column(E.Alias(E.Count(None), "count")))
+
+    def applyInPandas(self, fn, schema=None) -> DataFrame:
+        """Grouped-map pandas UDF (reference: FlatMapGroupsInPandasExec /
+        RelationalGroupedDataset.applyInPandas): the full frame crosses to
+        the host once, pandas groups by the keys, fn runs per group."""
+        import pandas as pd
+        import pyarrow as pa
+
+        key_names = []
+        for g in self.grouping:
+            if isinstance(g, E.UnresolvedAttribute):
+                key_names.append(g.name_parts[-1])
+            elif isinstance(g, E.AttributeReference):
+                key_names.append(g.name)
+            elif isinstance(g, E.Alias):
+                key_names.append(g.name)
+            else:
+                raise ValueError(
+                    "applyInPandas grouping keys must be columns")
+        pdf = self.df.toPandas()
+        outs = []
+        if len(pdf):
+            for _, grp in pdf.groupby(key_names, sort=True, dropna=False):
+                outs.append(fn(grp.reset_index(drop=True)))
+        if outs:
+            merged = pa.concat_tables(
+                [pa.Table.from_pandas(o, preserve_index=False)
+                 for o in outs], promote_options="permissive")
+        else:
+            from ..types import to_arrow_type
+
+            merged = pa.table(
+                {f.name: pa.array([], to_arrow_type(f.dataType))
+                 for f in (schema.fields if schema else [])})
+        return self.df.session.createDataFrame(merged)
 
     def sum(self, *names: str) -> DataFrame:  # noqa: A003
         return self.agg(*[Column(E.Sum(E.UnresolvedAttribute([n])))

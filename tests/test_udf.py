@@ -60,3 +60,49 @@ def test_udf_after_shuffle(spark):
     out = df.select(double("id").alias("d")).agg(
         F.sum("d").alias("s")).toArrow().to_pydict()
     assert out["s"] == [2 * sum(range(100))]
+
+
+def test_map_in_pandas(spark):
+    import pandas as pd
+
+    from spark_tpu.types import StructField, StructType, float64, int64
+
+    df = spark.range(0, 100, 1, 4)
+
+    def double(pdf: "pd.DataFrame") -> "pd.DataFrame":
+        return pd.DataFrame({"twice": pdf["id"] * 2})
+
+    schema = StructType([StructField("twice", int64, False)])
+    out = df.mapInPandas(double, schema)
+    assert out.agg(F.sum("twice").alias("s")).toArrow().to_pydict()["s"] == \
+        [2 * sum(range(100))]
+
+
+def test_apply_in_pandas(spark):
+    import pandas as pd
+    import pyarrow as pa
+
+    df = spark.createDataFrame(pa.table({
+        "g": ["a", "a", "b", "b", "b"],
+        "v": [1.0, 3.0, 2.0, 4.0, 9.0]}))
+
+    def demean(pdf: "pd.DataFrame") -> "pd.DataFrame":
+        pdf = pdf.copy()
+        pdf["v"] = pdf["v"] - pdf["v"].mean()
+        return pdf
+
+    out = (df.groupBy("g").applyInPandas(demean)
+           .orderBy("g", "v").toArrow().to_pydict())
+    assert out["v"] == [-1.0, 1.0, -3.0, -1.0, 4.0]
+
+
+def test_correlated_scalar_in_select(spark):
+    import pyarrow as pa
+
+    spark.createDataFrame(pa.table({
+        "g": ["a", "a", "b"], "v": [1.0, 3.0, 10.0]})) \
+        .createOrReplaceTempView("sel_corr")
+    out = spark.sql("""
+        SELECT g, v, (SELECT avg(v) FROM sel_corr i WHERE i.g = o.g) AS ga
+        FROM sel_corr o ORDER BY g, v""").toArrow().to_pydict()
+    assert out["ga"] == [2.0, 2.0, 10.0]
