@@ -19,3 +19,4 @@ from .tree import (  # noqa: F401
     RandomForestClassifier, RandomForestRegressor,
 )
 from .recommendation import ALS, ALSModel  # noqa: F401
+from .fpm import FPGrowth, FPGrowthModel  # noqa: F401

@@ -1,0 +1,149 @@
+"""Frequent pattern mining.
+
+Role of the reference's ml/fpm/FPGrowth.scala (FP-tree + conditional
+pattern bases) and AssociationRules. Host implementation over transaction
+lists — an FP-tree with recursive conditional mining; association rules
+derive from the frequent itemsets.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Optional
+
+from .base import Estimator, Model
+
+
+class _FPNode:
+    __slots__ = ("item", "count", "parent", "children", "link")
+
+    def __init__(self, item, parent):
+        self.item = item
+        self.count = 0
+        self.parent = parent
+        self.children: dict = {}
+        self.link: Optional["_FPNode"] = None
+
+
+def _build_fp_tree(transactions, min_count):
+    counts = defaultdict(int)
+    for t in transactions:
+        for item in set(t):
+            counts[item] += 1
+    freq = {i: c for i, c in counts.items() if c >= min_count}
+    order = {i: (-c, str(i)) for i, c in freq.items()}
+
+    root = _FPNode(None, None)
+    headers: dict = {}
+    for t in transactions:
+        items = sorted((i for i in set(t) if i in freq),
+                       key=lambda i: order[i])
+        node = root
+        for item in items:
+            child = node.children.get(item)
+            if child is None:
+                child = _FPNode(item, node)
+                node.children[item] = child
+                if item in headers:
+                    child.link = headers[item]
+                headers[item] = child
+            child.count += 1
+            node = child
+    return root, headers, freq
+
+
+def _mine(headers, freq, min_count, suffix, out):
+    for item in sorted(freq, key=lambda i: freq[i]):
+        itemset = suffix + [item]
+        out[frozenset(itemset)] = freq[item]
+        # conditional pattern base
+        cond_transactions = []
+        node = headers.get(item)
+        while node is not None:
+            path = []
+            p = node.parent
+            while p is not None and p.item is not None:
+                path.append(p.item)
+                p = p.parent
+            for _ in range(node.count):
+                cond_transactions.append(path)
+            node = node.link
+        if cond_transactions:
+            _, h2, f2 = _build_fp_tree(cond_transactions, min_count)
+            if f2:
+                _mine(h2, f2, min_count, itemset, out)
+
+
+class FPGrowth(Estimator):
+    _params = {"itemsCol": "items", "minSupport": 0.3, "minConfidence": 0.8}
+
+    def fit(self, df) -> "FPGrowthModel":
+        col = self.getOrDefault("itemsCol")
+        raw = df.select(col).toArrow().column(0).to_pylist()
+        transactions = [t if isinstance(t, (list, tuple))
+                        else str(t).split() for t in raw]
+        n = len(transactions)
+        min_count = max(1, int(self.getOrDefault("minSupport") * n))
+
+        _, headers, freq = _build_fp_tree(transactions, min_count)
+        itemsets: dict = {}
+        _mine(headers, freq, min_count, [], itemsets)
+
+        m = FPGrowthModel(itemsCol=col,
+                          minConfidence=self.getOrDefault("minConfidence"))
+        m.num_transactions = n
+        m.freq_itemsets = itemsets
+        return m
+
+
+class FPGrowthModel(Model):
+    _params = {"itemsCol": "items", "minConfidence": 0.8}
+
+    def freqItemsets(self):
+        """[(items, count)] sorted by count desc."""
+        return sorted(((sorted(k), v) for k, v in self.freq_itemsets.items()),
+                      key=lambda kv: (-kv[1], kv[0]))
+
+    def associationRules(self):
+        """[(antecedent, consequent, confidence, lift)]."""
+        rules = []
+        minc = self.getOrDefault("minConfidence")
+        n = self.num_transactions
+        for itemset, count in self.freq_itemsets.items():
+            if len(itemset) < 2:
+                continue
+            for item in itemset:
+                antecedent = itemset - {item}
+                a_count = self.freq_itemsets.get(antecedent)
+                if not a_count:
+                    continue
+                conf = count / a_count
+                if conf >= minc:
+                    c_support = self.freq_itemsets.get(
+                        frozenset({item}), 0) / n
+                    lift = conf / c_support if c_support else float("inf")
+                    rules.append((sorted(antecedent), [item], conf, lift))
+        return sorted(rules, key=lambda r: (-r[2], r[0]))
+
+    def transform(self, df):
+        """Predict consequents per row from matching rules (reference:
+        FPGrowthModel.transform)."""
+        import numpy as np
+        import pyarrow as pa
+
+        col = self.getOrDefault("itemsCol")
+        raw = df.select(col).toArrow().column(0).to_pylist()
+        rules = self.associationRules()
+        preds = []
+        for t in raw:
+            items = set(t if isinstance(t, (list, tuple))
+                        else str(t).split())
+            out = []
+            for ante, cons, _conf, _lift in rules:
+                if set(ante) <= items and cons[0] not in items and \
+                        cons[0] not in out:
+                    out.append(cons[0])
+            preds.append(" ".join(str(x) for x in out))
+        table = df.toArrow().append_column(
+            "prediction", pa.array(preds, pa.string()))
+        return df.session.createDataFrame(table)

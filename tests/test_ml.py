@@ -249,3 +249,20 @@ def test_gbt_classifier(spark):
     model = GBTClassifier(maxIter=30, maxDepth=3).fit(df)
     acc = MulticlassClassificationEvaluator().evaluate(model.transform(df))
     assert acc > 0.93
+
+
+def test_fpgrowth(spark):
+    from spark_tpu.ml import FPGrowth
+
+    df = spark.createDataFrame(pa.table({
+        "items": ["bread milk", "bread butter", "milk butter bread",
+                  "bread milk", "butter"]}))
+    model = FPGrowth(minSupport=0.4, minConfidence=0.6).fit(df)
+    sets = {tuple(k): v for k, v in model.freqItemsets()}
+    assert sets[("bread",)] == 4
+    assert sets[("bread", "milk")] == 3
+    rules = model.associationRules()
+    assert any(r[0] == ["milk"] and r[1] == ["bread"] and r[2] == 1.0
+               for r in rules)
+    pred = model.transform(df).toArrow().to_pydict()["prediction"]
+    assert "bread" in pred[4]  # butter → bread suggested
