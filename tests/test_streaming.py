@@ -173,3 +173,23 @@ def test_stream_join_static_dimension(spark):
         assert out["v"] == [10, 20]
     finally:
         q.stop()
+
+
+def test_streaming_dedup_via_distinct(spark):
+    """Streaming dropDuplicates rides the stateful-aggregate path
+    (Distinct → Aggregate → buffer-table state)."""
+    src, df = spark.memory_stream(pa.schema([("k", pa.string()),
+                                             ("v", pa.int64())]))
+    q = (df.dropDuplicates()
+         .writeStream.format("memory").queryName("s_dedup")
+         .outputMode("complete").start())
+    try:
+        src.add_data({"k": ["a", "a", "b"], "v": [1, 1, 2]})
+        q.processAllAvailable()
+        src.add_data({"k": ["a", "c"], "v": [1, 3]})  # 'a',1 seen before
+        q.processAllAvailable()
+        out = _sink_rows(spark, "s_dedup")
+        rows = sorted(zip(out["k"], out["v"]))
+        assert rows == [("a", 1), ("b", 2), ("c", 3)]
+    finally:
+        q.stop()
