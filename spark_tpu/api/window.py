@@ -45,9 +45,15 @@ class WindowSpec:
                 end >= Window.unboundedFollowing:
             return WindowSpec(self._partition, self._order,
                               ("rows", None, None))
-        raise NotImplementedError(
-            "RANGE frames with numeric bounds are not supported; use "
-            "rowsBetween")
+
+        def off(v):
+            if v <= Window.unboundedPreceding or \
+                    v >= Window.unboundedFollowing:
+                return None
+            return int(v)
+
+        return WindowSpec(self._partition, self._order,
+                          ("vrange", off(start), off(end)))
 
 
 class Window:

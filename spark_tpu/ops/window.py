@@ -242,6 +242,50 @@ def w_agg_rows(lo: WindowLayout, values, valid, kind: str,
     raise ValueError(kind)
 
 
+def w_agg_value_range(lo: WindowLayout, order_key, values, valid, kind: str,
+                      lo_off, hi_off, kmin: int, band: int):
+    """RANGE BETWEEN <lo_off> AND <hi_off> with VALUE offsets over a single
+    integral order key. Keys are banded per partition —
+    enc = seg_id·band + (key − kmin) — so one global `searchsorted` finds
+    each row's value-window inside its own partition (band exceeds the key
+    span plus the largest offset, so queries never cross partitions)."""
+    import jax
+
+    cap = values.shape[0]
+    k = jnp.take(order_key, lo.perm).astype(jnp.int64)
+    enc = lo.seg_id.astype(jnp.int64) * band + (k - kmin)
+    lo_q = enc + (lo_off if lo_off is not None else -(band - 1))
+    hi_q = enc + (hi_off if hi_off is not None else (band - 1))
+    lo_idx = jnp.searchsorted(enc, lo_q, side="left").astype(jnp.int32)
+    hi_idx = (jnp.searchsorted(enc, hi_q, side="right") - 1).astype(jnp.int32)
+    seg_end = lo.seg_start + lo.seg_size - 1
+    lo_idx = jnp.maximum(lo_idx, lo.seg_start)
+    hi_idx = jnp.minimum(hi_idx, seg_end)
+    empty = hi_idx < lo_idx
+
+    v, w = _sorted_vals(lo, values, valid)
+    acc = jnp.float64 if jnp.issubdtype(v.dtype, jnp.floating) else jnp.int64
+    csum = jnp.cumsum(jnp.where(w, v.astype(acc), 0))
+    ccnt = jnp.cumsum(w.astype(jnp.int64))
+
+    def rng(c):
+        hi_v = jnp.take(c, jnp.clip(hi_idx, 0, cap - 1))
+        lo_m1 = lo_idx - 1
+        lo_v = jnp.where(lo_m1 >= 0,
+                         jnp.take(c, jnp.clip(lo_m1, 0, cap - 1)), 0)
+        return jnp.where(empty, 0, hi_v - lo_v)
+
+    total = rng(csum)
+    cnt = rng(ccnt)
+    if kind == "count":
+        return cnt, None
+    if kind == "sum":
+        return total, cnt > 0
+    if kind == "avg":
+        return total.astype(jnp.float64) / jnp.maximum(cnt, 1), cnt > 0
+    raise ValueError(kind)
+
+
 def _ident(kind, dtype):
     from .grouping import _max_ident, _min_ident
 

@@ -86,15 +86,21 @@ class WindowExec(PhysicalPlan):
                         Average: "avg"}[type(f)]
                 frame = w.frame
                 if frame is not None:
-                    _, lo, hi = frame
+                    ftype, lo, hi = frame
                     if (lo, hi) == (None, None):
                         out.append((f"agg_unbounded_{kind}", None, f.child))
-                    elif kind in ("sum", "count", "avg"):
-                        out.append((f"agg_rows_{kind}", (lo, hi), f.child))
-                    else:
+                    elif kind not in ("sum", "count", "avg"):
                         raise UnsupportedOperationError(
-                            f"{kind} over a bounded ROWS frame is not "
+                            f"{kind} over a bounded frame is not "
                             "supported yet")
+                    elif ftype == "vrange":
+                        if len(self.order_keys) != 1:
+                            raise UnsupportedOperationError(
+                                "RANGE value frames need exactly one "
+                                "ORDER BY key")
+                        out.append((f"agg_vrange_{kind}", (lo, hi), f.child))
+                    else:
+                        out.append((f"agg_rows_{kind}", (lo, hi), f.child))
                 else:
                     mode = "running" if has_order else "unbounded"
                     out.append((f"agg_{mode}_{kind}", None, f.child))
@@ -135,7 +141,40 @@ class WindowExec(PhysicalPlan):
             else:
                 vcols.append(None)
 
-        key = ("window", cap,
+        # value-RANGE frames: band the single integral order key per
+        # partition (host syncs min/max; band is baked into the kernel)
+        kmin = band = 0
+        if any(k.startswith("agg_vrange_") for k, _, _ in plans):
+            import jax
+            from ..types import DateType, IntegralType
+
+            oc = ocols[0]
+            if not isinstance(oc.dtype, (IntegralType, DateType)) or \
+                    oc.validity is not None:
+                raise UnsupportedOperationError(
+                    "RANGE value frames need a non-null integral/date "
+                    "ORDER BY key")
+            if not ospecs[0].ascending:
+                raise UnsupportedOperationError(
+                    "RANGE value frames need an ascending ORDER BY")
+            jnp2 = _jnp()
+            k64 = oc.data.astype(jnp2.int64)
+            big = jnp2.iinfo(jnp2.int64).max
+            small = jnp2.iinfo(jnp2.int64).min
+            kmin = int(jnp2.min(jnp2.where(batch.row_mask, k64, big)))
+            kmax = int(jnp2.max(jnp2.where(batch.row_mask, k64, small)))
+            max_off = max(abs(p[0] or 0) if p else 0 for _, p, _ in plans
+                          if p) + max(abs(p[1] or 0) if p else 0
+                                      for _, p, _ in plans if p) + 1
+            span = max(kmax - kmin + 1 + 2 * max_off, 8)
+            band = 1
+            while band < span:
+                band <<= 1
+            if cap * band >= (1 << 62):
+                raise UnsupportedOperationError(
+                    "RANGE frame key span too large to band")
+
+        key = ("window", cap, kmin, band,
                tuple((str(c.eq_keys().dtype), c.validity is not None)
                      for c in pcols),
                tuple((str(c.sort_keys().dtype), c.validity is not None,
@@ -166,6 +205,10 @@ class WindowExec(PhysicalPlan):
                         sv, svalid = W.w_ntile(lo, param), None
                     elif kind == "shift":
                         sv, svalid = W.w_shift(lo, vd, vv, param)
+                    elif kind.startswith("agg_vrange_"):
+                        sv, svalid = W.w_agg_value_range(
+                            lo, okeys[0], vd, vv, kind.split("_")[-1],
+                            param[0], param[1], kmin, band)
                     elif kind.startswith("agg_rows_"):
                         sv, svalid = W.w_agg_rows(lo, vd, vv,
                                                   kind.split("_")[-1],

@@ -793,12 +793,12 @@ class Parser:
                 lo = self._parse_frame_bound(is_lower=True)
                 hi = 0  # CURRENT ROW
             if ftype == "range":
-                # only default-equivalent RANGE frames are supported
-                if (lo, hi) not in ((None, 0), (None, None)):
-                    raise ParseException(
-                        "RANGE frames with numeric bounds not supported; "
-                        "use ROWS")
-                frame = None if (lo, hi) == (None, 0) else ("rows", None, None)
+                if (lo, hi) == (None, 0):
+                    frame = None  # the default frame
+                elif (lo, hi) == (None, None):
+                    frame = ("rows", None, None)  # whole partition
+                else:
+                    frame = ("vrange", lo, hi)  # value offsets
             else:
                 frame = ("rows", lo, hi)
         self.expect_op(")")

@@ -160,3 +160,31 @@ def test_window_over_aggregate_single_query(spark):
     assert out["store"] == [1, 1, 2, 2]
     assert out["item"] == [11, 12, 11, 10]
     assert out["rnk"] == [1, 2, 1, 2]
+
+
+def test_value_range_frame(spark):
+    import pyarrow as pa
+
+    spark.createDataFrame(pa.table({
+        "g": ["a"] * 5, "t": [1, 2, 4, 7, 8], "v": [10, 20, 30, 40, 50]})) \
+        .createOrReplaceTempView("vr")
+    out = spark.sql("""
+        SELECT t, sum(v) OVER (PARTITION BY g ORDER BY t
+            RANGE BETWEEN 2 PRECEDING AND CURRENT ROW) AS s
+        FROM vr ORDER BY t""").toArrow().to_pydict()
+    # t=1:[1] → 10; t=2:[1,2] → 30; t=4:[2,4] → 50; t=7:[7] → 40; t=8:[7,8]
+    assert out["s"] == [10, 30, 50, 40, 90]
+
+
+def test_value_range_frame_api(spark):
+    import pyarrow as pa
+    from spark_tpu.api.window import Window
+
+    df = spark.createDataFrame(pa.table({
+        "t": [0, 5, 10, 30], "v": [1.0, 2.0, 4.0, 8.0]}))
+    w = Window.orderBy("t").rangeBetween(-10, 10)
+    out = df.select("t", F.avg("v").over(w).alias("a")) \
+        .orderBy("t").toArrow().to_pydict()
+    # t=0: window [−10,10] → {0,5,10} avg 7/3; t=30: only itself
+    assert abs(out["a"][0] - 7 / 3) < 1e-9
+    assert out["a"][3] == 8.0
