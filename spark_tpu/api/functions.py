@@ -83,6 +83,14 @@ def any_value(c) -> Column:
     return Column(E.AnyValue(_c(c)))
 
 
+def median(c) -> Column:
+    return Column(E.Median(_c(c)))
+
+
+def percentile_approx(c, q, accuracy=None) -> Column:
+    return Column(E.Percentile(_c(c), float(q)))
+
+
 def stddev(c) -> Column:
     return Column(E.StddevSamp(_c(c)))
 

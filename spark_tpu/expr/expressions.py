@@ -640,13 +640,44 @@ class Subtract(BinaryArithmetic):
 class Multiply(BinaryArithmetic):
     symbol = "*"
 
+    @staticmethod
+    def _decimal_types(lt, rt):
+        def as_dec(t):
+            if isinstance(t, DecimalType):
+                return t
+            if isinstance(t, IntegralType):
+                p = {1: 3, 2: 5, 4: 10, 8: 19}[t.device_dtype.itemsize]
+                return DecimalType(p, 0)
+            return None
+
+        ld, rd = as_dec(lt), as_dec(rt)
+        if ld is not None and rd is not None and (
+                isinstance(lt, DecimalType) or isinstance(rt, DecimalType)):
+            return ld, rd
+        return None
+
     def _result_type(self, ct):
         if isinstance(ct, DecimalType):
-            # decimal*decimal exceeds int64 quickly; compute in float64
+            lt = self.left.dtype
+            rt = self.right.dtype
+            dd = self._decimal_types(lt, rt)
+            if dd is not None:
+                p = dd[0].precision + dd[1].precision
+                s = dd[0].scale + dd[1].scale
+                if p <= DecimalType.MAX_PRECISION:
+                    return DecimalType(p, s)  # exact scaled-int64 product
+            # precision exceeds int64 → float64 (documented deviation)
             return float64
         return ct
 
     def _align(self, ctx, l, r, out):
+        if isinstance(out, DecimalType):
+            # exact path: raw scaled int64 product, scales add
+            ld = l.data if isinstance(l.dtype, DecimalType) \
+                else l.data.astype(_jnp().int64)
+            rd = r.data if isinstance(r.dtype, DecimalType) \
+                else r.data.astype(_jnp().int64)
+            return ld, rd
         if isinstance(out, FractionalType) and (
                 isinstance(l.dtype, DecimalType) or isinstance(r.dtype, DecimalType)):
             lc = cast_val(ctx, l, float64)
@@ -2460,6 +2491,26 @@ class VarianceSamp(_CentralMoment):
 
 class VariancePop(_CentralMoment):
     ddof = 0
+
+
+class Percentile(AggregateFunction):
+    """Exact percentile (the reference's percentile_approx computed exactly;
+    non-mergeable, so the planner gathers before aggregating)."""
+
+    def __init__(self, child: Expression, q: float):
+        super().__init__(child)
+        self.q = float(q)
+
+    @property
+    def dtype(self):
+        ct = self.child.dtype
+        return ct if isinstance(ct, (IntegralType, DateType, TimestampType,
+                                     DecimalType)) else float64
+
+
+class Median(Percentile):
+    def __init__(self, child: Expression):
+        super().__init__(child, 0.5)
 
 
 class CollectSet(AggregateFunction):

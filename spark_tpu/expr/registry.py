@@ -68,6 +68,9 @@ def _reg_all() -> None:
     r("var_samp", lambda c: E.VarianceSamp(c))
     r("var_pop", lambda c: E.VariancePop(c))
     r("collect_set", lambda c: E.CollectSet(c))
+    r("median", lambda c: E.Median(c))
+    r("percentile", lambda c, q: E.Percentile(c, float(q.value)))
+    r("percentile_approx", lambda c, q, *a: E.Percentile(c, float(q.value)))
     from . import agg_compound as AC
 
     r("corr", AC.corr)

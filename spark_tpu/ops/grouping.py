@@ -161,6 +161,62 @@ def _min_ident(dtype):
     return jnp.asarray(jnp.iinfo(dtype).min, dtype)
 
 
+def group_percentile(key_cols, key_valids, values, value_valid, row_mask,
+                     q: float):
+    """Exact per-group percentile: one sort by (keys, value) makes each
+    group's values contiguous and ordered; the q-th element is a gather at
+    seg_start + floor(q·(n_valid−1)). Non-mergeable across partitions (the
+    planner gathers to one partition first). Returns (vals, has) in the
+    same group order as group_rows over the same keys."""
+    cap = row_mask.shape[0]
+    w = row_mask if value_valid is None else (row_mask & value_valid)
+    operands = [(~row_mask).astype(jnp.int32)]
+    for c, v in zip(key_cols, key_valids):
+        if v is not None:
+            operands.append((~v).astype(jnp.int32))
+            operands.append(jnp.where(v, c, jnp.zeros_like(c)))
+        else:
+            operands.append(c)
+    n_keys = len(operands)
+    operands.append((~w).astype(jnp.int32))  # null/masked values last
+    operands.append(values)
+    operands.append(lax.iota(jnp.int32, cap))
+    out = lax.sort(tuple(operands), num_keys=n_keys + 2, is_stable=True)
+    perm = out[-1]
+    skeys = out[:n_keys]
+    svals = out[-2]
+    active = jnp.take(row_mask, perm)
+    sw = jnp.take(w, perm)
+
+    changed = jnp.zeros(cap, dtype=bool).at[0].set(True)
+    for k in skeys:
+        changed = changed | jnp.concatenate(
+            [jnp.ones(1, dtype=bool), k[1:] != k[:-1]])
+    start_flag = changed & active
+    seg_ids = jnp.maximum(jnp.cumsum(start_flag.astype(jnp.int32)) - 1, 0)
+
+    pos = lax.iota(jnp.int32, cap)
+    seg_start = jnp.full((cap,), 0, jnp.int32).at[
+        jnp.where(start_flag, seg_ids, cap)].set(pos, mode="drop")
+    n_valid = jax.ops.segment_sum(sw.astype(jnp.int32), seg_ids,
+                                  num_segments=cap)
+    idx = seg_start + jnp.floor(
+        q * jnp.maximum(n_valid - 1, 0)).astype(jnp.int32)
+    vals = jnp.take(svals, jnp.clip(idx, 0, cap - 1))
+    return vals, n_valid > 0
+
+
+def masked_percentile(values, row_mask, valid, q: float):
+    """Global exact percentile via one sort."""
+    cap = values.shape[0]
+    w = row_mask if valid is None else (row_mask & valid)
+    big = _max_ident(values.dtype)
+    sv = jnp.sort(jnp.where(w, values, big))
+    n = jnp.sum(w.astype(jnp.int32))
+    idx = jnp.floor(q * jnp.maximum(n - 1, 0)).astype(jnp.int32)
+    return jnp.take(sv, jnp.clip(idx, 0, cap - 1)), n > 0
+
+
 # --- ungrouped (global) aggregation ---------------------------------------
 
 def masked_sum(values, row_mask, valid=None):

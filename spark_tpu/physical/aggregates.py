@@ -57,6 +57,8 @@ class AggSpec:
     ops: list[str]                         # primitive op per buffer column
     buffer_attrs: list[AttributeReference]  # schema of partial output
     result_alias: Alias                    # final output (over buffer attrs)
+    mergeable: bool = True                 # False → gather-then-one-pass
+    param: float | None = None             # e.g. percentile q
 
 
 def lower_aggregate_function(func: AggregateFunction, out_name: str,
@@ -93,6 +95,13 @@ def lower_aggregate_function(func: AggregateFunction, out_name: str,
     if isinstance(func, First):
         b = battr(0, "first")
         return AggSpec(func, child, ["first"], [b], Alias(b, out_name, out_id))
+    from ..expr.expressions import Percentile
+
+    if isinstance(func, Percentile):
+        b = AttributeReference(f"{out_name}#buf0", func.dtype, True)
+        return AggSpec(func, child, ["percentile"], [b],
+                       Alias(b, out_name, out_id), mergeable=False,
+                       param=func.q)
     if isinstance(func, (StddevSamp, StddevPop, VarianceSamp, VariancePop)):
         bs = battr(0, "sum")
         bq = battr(1, "sumsq")

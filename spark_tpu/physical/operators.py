@@ -496,16 +496,17 @@ class HashAggregateExec(PhysicalPlan):
     def output_partitioning(self):
         return self.child.output_partitioning()
 
-    def _plan_values(self) -> list[tuple[str, AttributeReference | None]]:
-        """(op, input attr) per buffer column."""
+    def _plan_values(self):
+        """(op, input attr, param) per buffer column."""
         out = []
         for s in self.specs:
             for i, op in enumerate(s.ops):
                 if self.mode == "partial":
                     attr = s.input_expr if op != "countstar" else None
-                    out.append((op, attr))
+                    out.append((op, attr, s.param))
                 else:
-                    out.append((PARTIAL_TO_MERGE[op], s.buffer_attrs[i]))
+                    out.append((PARTIAL_TO_MERGE[op], s.buffer_attrs[i],
+                                s.param))
         return out
 
     def execute(self, ctx: ExecContext) -> list[Partition]:
@@ -525,7 +526,7 @@ class HashAggregateExec(PhysicalPlan):
         associative merges instead of disk (SURVEY.md §7 'Hard parts' (3))."""
         max_rows = int(ctx.conf.get("spark.tpu.agg.blockRows", 1 << 22))
         if len(part) > 1 and sum(b.capacity for b in part) > max_rows \
-                and self.grouping:
+                and self.grouping and all(s.mergeable for s in self.specs):
             acc: list[ColumnarBatch] = []
             chunk: list[ColumnarBatch] = []
             cap_sum = 0
@@ -550,11 +551,19 @@ class HashAggregateExec(PhysicalPlan):
         pos = {a.expr_id: i for i, a in enumerate(self.child.output)}
 
         vals = self._plan_values()
-        ops = tuple(op for op, _ in vals)
+        percentiles: dict[int, tuple] = {}  # buffer idx → (column, q)
+        main_vals = []
+        for bi, (op, attr, param) in enumerate(vals):
+            if op == "percentile":
+                percentiles[bi] = (batch.columns[pos[attr.expr_id]], param)
+                main_vals.append(("first", attr))  # placeholder, overwritten
+            else:
+                main_vals.append((op, attr))
+        ops = tuple(op for op, _ in main_vals)
         val_datas = []
         val_valids = []
         string_minmax: dict[int, Column] = {}  # buffer idx → source column
-        for bi, (op, attr) in enumerate(vals):
+        for bi, (op, attr) in enumerate(main_vals):
             if attr is None:
                 val_datas.append(batch.row_mask)  # dummy
                 val_valids.append(None)
@@ -579,6 +588,10 @@ class HashAggregateExec(PhysicalPlan):
                 key, lambda: _ungrouped_kernel(
                     ops, cap, tuple(v is not None for v in val_valids)))
             datas, valids, mask = kernel(val_datas, val_valids, batch.row_mask)
+            datas, valids = list(datas), list(valids)
+            for bi, (pc, q) in percentiles.items():
+                datas[bi], valids[bi] = self._ungrouped_percentile(
+                    batch, pc, q, datas[bi].shape[0])
             cols = [self._finish_buffer(bi, d, v, f, string_minmax)
                     for bi, (f, d, v) in enumerate(
                         zip(out_schema.fields, datas, valids))]
@@ -589,10 +602,12 @@ class HashAggregateExec(PhysicalPlan):
         key_outs = [c.data for c in key_cols]
         key_valids = [c.validity for c in key_cols]
 
-        dense = self._try_dense(batch, key_cols, ops, val_datas, val_valids,
-                                out_schema, ctx, string_minmax)
-        if dense is not None:
-            return dense
+        if not percentiles:
+            dense = self._try_dense(batch, key_cols, ops, val_datas,
+                                    val_valids, out_schema, ctx,
+                                    string_minmax)
+            if dense is not None:
+                return dense
 
         kkey = ("gagg", len(key_cols), ops, cap,
                 tuple(v is not None for v in key_valids),
@@ -607,6 +622,25 @@ class HashAggregateExec(PhysicalPlan):
         out_keys, bufs, out_mask, _ng = kernel(
             key_eqs, key_outs, key_valids, val_datas, val_valids, batch.row_mask)
 
+        bufs = list(bufs)
+        for bi, (pc, q) in percentiles.items():
+            from ..ops.grouping import group_percentile
+
+            pkey = ("gperc", batch.capacity, len(key_cols), float(q),
+                    tuple(str(k.dtype) for k in key_eqs),
+                    tuple(v is not None for v in key_valids),
+                    str(pc.data.dtype), pc.validity is not None)
+
+            def build_p(q=q):
+                import jax
+
+                return jax.jit(lambda ke, kv, vd, vv, m:
+                               group_percentile(ke, kv, vd, vv, m, q))
+
+            pk = GLOBAL_KERNEL_CACHE.get_or_build(pkey, build_p)
+            pvals, phas = pk(key_eqs, key_valids, pc.data, pc.validity,
+                             batch.row_mask)
+            bufs[bi] = (pvals, phas)
         cols = []
         for (kd, kv), kc, f in zip(out_keys, key_cols,
                                    out_schema.fields[: len(key_cols)]):
@@ -615,6 +649,28 @@ class HashAggregateExec(PhysicalPlan):
                 zip(bufs, out_schema.fields[len(key_cols):])):
             cols.append(self._finish_buffer(bi, bd, bv, f, string_minmax))
         return ColumnarBatch(out_schema, cols, out_mask, num_rows=None)
+
+    def _ungrouped_percentile(self, batch, pc: Column, q: float,
+                              out_cap: int):
+        import jax
+
+        from ..ops.grouping import masked_percentile
+
+        jnp = _jnp()
+        key = ("uperc", batch.capacity, float(q), str(pc.data.dtype),
+               pc.validity is not None, out_cap)
+
+        def build(q=q):
+            def kernel(vd, vv, m):
+                v, has = masked_percentile(vd, m, vv, q)
+                arr = jnp.zeros((out_cap,), dtype=v.dtype).at[0].set(v)
+                hv = jnp.zeros((out_cap,), dtype=bool).at[0].set(has)
+                return arr, hv
+
+            return jax.jit(kernel)
+
+        k = GLOBAL_KERNEL_CACHE.get_or_build(key, build)
+        return k(pc.data, pc.validity, batch.row_mask)
 
     def _finish_buffer(self, bi, bd, bv, f, string_minmax):
         jnp = _jnp()

@@ -253,6 +253,11 @@ class Planner:
             specs.append(spec)
             func_to_spec.append((f, spec))
 
+        if any(not s.mergeable for s in specs) and \
+                child.output_partitioning().num_partitions != 1:
+            # non-mergeable aggregates (percentile/median): gather first,
+            # aggregate once (no partial/final split)
+            child = ShuffleExchangeExec(SinglePartition(), child)
         partial = HashAggregateExec(group_keys, specs, "partial", child)
         if child.output_partitioning().num_partitions == 1:
             # single upstream partition: the partial pass is already

@@ -967,7 +967,9 @@ def _parse_ts_literal(s: str) -> datetime.datetime:
 _AGG_NAMES = frozenset((
     "sum", "count", "min", "max", "avg", "mean", "first", "any_value",
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
-    "collect_set", "first_value"))
+    "collect_set", "first_value", "median", "percentile",
+    "percentile_approx", "corr", "covar_samp", "covar_pop", "skewness",
+    "kurtosis", "approx_count_distinct"))
 
 
 def _contains_agg(e: E.Expression) -> bool:

@@ -262,6 +262,10 @@ class StreamingQuery:
             final = HashAggregateExec(partial.grouping, partial.specs,
                                       "final", partial)
 
+        if any(not sp.mergeable for sp in partial.specs):
+            raise UnsupportedOperationError(
+                "non-mergeable aggregates (percentile/median) are not "
+                "supported in streaming state")
         buffer_attrs = list(partial.output)
         partial_ready = planner._ensure_requirements(partial)
         new_parts = partial_ready.execute(ctx)

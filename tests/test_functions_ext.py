@@ -181,3 +181,63 @@ def test_regexp_extract_and_date_format(spark):
     assert out["n"] == ["123"]
     assert out["d"] == ["2021/07/04"]
     assert out["w"] == ["Sunday"]
+
+
+def test_decimal_multiply_exact(spark):
+    import decimal
+
+    df = spark.createDataFrame(pa.table({
+        "qty": pa.array([3, 7], pa.int32()),
+        "price": pa.array([decimal.Decimal("19.99"),
+                           decimal.Decimal("0.01")],
+                          pa.decimal128(7, 2))}))
+    out = df.select((F.col("qty") * F.col("price")).alias("amt")) \
+        .agg(F.sum("amt").alias("total")).toArrow().to_pydict()
+    import decimal as _d
+
+    # exact: 3*19.99 + 7*0.01 = 60.04 — arrives as an exact Decimal
+    assert out["total"][0] == _d.Decimal("60.04")
+
+
+def test_nan_sort_order(spark):
+    df = spark.createDataFrame(pa.table({
+        "v": [1.0, float("nan"), -5.0]}))
+    asc = df.orderBy("v").toArrow().to_pydict()["v"]
+    assert asc[0] == -5.0 and asc[1] == 1.0
+    import math
+
+    assert math.isnan(asc[2])  # NaN largest → last asc
+    desc = df.orderBy(F.col("v").desc()).toArrow().to_pydict()["v"]
+    assert math.isnan(desc[0])  # first desc
+
+
+def test_median_percentile(spark):
+    import numpy as np
+
+    rng = np.random.default_rng(12)
+    v = rng.permutation(np.arange(1, 102)).astype(np.float64)  # 1..101
+    df = spark.createDataFrame(pa.table({"v": v}))
+    out = df.agg(F.median("v").alias("m"),
+                 F.percentile_approx("v", 0.25).alias("q1")).toArrow() \
+        .to_pydict()
+    assert out["m"] == [51.0]
+    assert out["q1"] == [26.0]
+
+
+def test_grouped_median_multi_partition(spark):
+    import numpy as np
+
+    df = spark.createDataFrame(pa.table({
+        "g": ["a"] * 5 + ["b"] * 4,
+        "v": [5.0, 1.0, 3.0, 2.0, 4.0, 10.0, 30.0, 20.0, 40.0]}))
+    out = (df.repartition(3).groupBy("g")
+           .agg(F.median("v").alias("m")).orderBy("g")
+           .toArrow().to_pydict())
+    assert out["m"] == [3.0, 20.0]  # even count → lower-middle element
+
+
+def test_percentile_sql(spark):
+    out = spark.sql(
+        "SELECT percentile(col1, 0.5) AS p FROM "
+        "(VALUES (1.0), (2.0), (3.0))").toArrow().to_pydict()
+    assert out["p"] == [2.0]
