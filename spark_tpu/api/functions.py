@@ -262,6 +262,14 @@ def concat(*cols) -> Column:
     return Column(E.Concat([_c(c) for c in cols]))
 
 
+def split(c, pattern: str) -> Column:
+    return Column(E.Split(_c(c), E.Literal(pattern)))
+
+
+def explode(c) -> Column:
+    return Column(E.Explode(_c(c)))
+
+
 def lpad(c, length: int, pad: str = " ") -> Column:
     return Column(E.Lpad(_c(c), E.Literal(length), E.Literal(pad)))
 

@@ -1839,6 +1839,47 @@ class DateFormat(Expression):
             "RewriteHostOnlyExpressions)")
 
 
+class Split(UnaryExpression):
+    """string → array<string> by a regex delimiter. Only valid under a
+    generator (explode) — arrays have no device representation; the
+    Generate operator expands rows host-side over dictionary values."""
+
+    def __init__(self, child: Expression, delim: Expression):
+        super().__init__(child)
+        self.delim = str(delim.value)
+
+    @property
+    def dtype(self):
+        return ArrayType(string)
+
+    def split_lists(self, values: list[str]) -> list[list[str]]:
+        rx = re.compile(self.delim)
+        return [[p for p in rx.split(v)] for v in values]
+
+    def eval(self, ctx):
+        raise UnsupportedOperationError(
+            "split() is only supported under explode()")
+
+
+class Explode(Expression):
+    """Generator marker (reference: sqlcat/expressions/generators.scala
+    Explode) — extracted into a Generate operator by the analyzer."""
+
+    child_fields = ("child",)
+
+    def __init__(self, child: Expression):
+        self.child = child
+
+    @property
+    def dtype(self):
+        ct = self.child.dtype
+        return ct.element_type if isinstance(ct, ArrayType) else ct
+
+    def eval(self, ctx):
+        raise UnsupportedOperationError(
+            "explode() must be planned as a Generate operator")
+
+
 class Length(UnaryExpression):
     @property
     def dtype(self):

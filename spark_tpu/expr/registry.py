@@ -133,6 +133,8 @@ def _reg_all() -> None:
     r("isnan", lambda c: E.IsNaN(c))
     # strings
     r("upper", lambda c: E.Upper(c))
+    r("split", lambda c, d: E.Split(c, d))
+    r("explode", lambda c: E.Explode(c))
     r("ucase", lambda c: E.Upper(c))
     r("lower", lambda c: E.Lower(c))
     r("lcase", lambda c: E.Lower(c))

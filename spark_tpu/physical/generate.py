@@ -1,0 +1,97 @@
+"""GenerateExec: row expansion for explode(split(...)).
+
+Role of the reference's GenerateExec (sqlx/GenerateExec.scala). Arrays have
+no device representation here (ragged); the expansion plan is computed
+host-side, but the expensive part — splitting strings — runs ONCE PER
+DICTIONARY ENTRY, not per row; per-row element counts come from a code
+gather and the source columns are repeated with a device gather.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..columnar.arrow import _chunked_to_numpy
+from ..columnar.batch import Column, ColumnarBatch, bucket_capacity
+from ..columnar.ops import gather_batch
+from ..errors import UnsupportedOperationError
+from ..exec.context import ExecContext
+from ..expr.expressions import AttributeReference, Split
+from ..types import StringType
+from .operators import PhysicalPlan, attrs_schema
+
+
+class GenerateExec(PhysicalPlan):
+    child_fields = ("child",)
+
+    def __init__(self, generator, element_attr: AttributeReference,
+                 child: PhysicalPlan):
+        if not isinstance(generator, Split):
+            raise UnsupportedOperationError(
+                "only explode(split(stringColumn, delim)) is supported")
+        self.generator = generator
+        self.element_attr = element_attr
+        self.child = child
+
+    @property
+    def output(self):
+        return self.child.output + [self.element_attr]
+
+    def execute(self, ctx: ExecContext):
+        src = self.generator.child
+        if not isinstance(src, AttributeReference):
+            raise UnsupportedOperationError(
+                "split() argument must be a column")
+        pos = {a.expr_id: i for i, a in enumerate(self.child.output)}
+        cidx = pos[src.expr_id]
+        out_schema = attrs_schema(self.output)
+        parts = self.child.execute(ctx)
+        return [[self._expand(b, cidx, out_schema)
+                 for b in p] for p in parts]
+
+    def _expand(self, batch: ColumnarBatch, cidx: int,
+                out_schema) -> ColumnarBatch:
+        import jax.numpy as jnp
+        import pyarrow as pa
+
+        col = batch.columns[cidx]
+        if not isinstance(col.dtype, StringType):
+            raise UnsupportedOperationError("split() needs a string column")
+        values = col.dictionary.values if col.dictionary else []
+        lists = self.generator.split_lists(values or [""])
+        counts_per_code = np.array([len(x) for x in lists], np.int64)
+        offsets_per_code = np.zeros(len(lists) + 1, np.int64)
+        np.cumsum(counts_per_code, out=offsets_per_code[1:])
+        flat_elements = np.array(
+            [e for lst in lists for e in lst], dtype=object)
+
+        sel = np.nonzero(np.asarray(batch.row_mask))[0]
+        codes = np.clip(np.asarray(col.data)[sel], 0, len(lists) - 1)
+        row_counts = counts_per_code[codes]
+        if col.validity is not None:
+            row_counts = np.where(np.asarray(col.validity)[sel],
+                                  row_counts, 0)
+        total = int(row_counts.sum())
+        out_cap = bucket_capacity(max(total, 1))
+
+        rep_idx = np.repeat(np.arange(len(sel)), row_counts)
+        src_rows = np.zeros(out_cap, np.int32)
+        src_rows[:total] = sel[rep_idx]
+        out_mask = jnp.arange(out_cap) < total
+        gathered = gather_batch(batch, jnp.asarray(src_rows), out_mask)
+
+        if total:
+            elem_codes = np.concatenate(
+                [np.arange(offsets_per_code[c], offsets_per_code[c] + n)
+                 for c, n in zip(codes, row_counts)])
+            elems = flat_elements[elem_codes]
+        else:
+            elems = np.zeros(0, object)
+        data, validity, sd = _chunked_to_numpy(
+            pa.array(list(elems), pa.string()), StringType())
+        pad = np.zeros(out_cap, StringType().device_dtype)
+        pad[:total] = data
+        elem_col = Column(StringType(), jnp.asarray(pad), None, sd)
+
+        return ColumnarBatch(out_schema, list(gathered.columns) + [elem_col],
+                             out_mask, num_rows=total)

@@ -121,6 +121,11 @@ class Planner:
 
             return PythonEvalExec(node.udf_aliases,
                                   self._convert(node.child))
+        if isinstance(node, L.Generate):
+            from .generate import GenerateExec
+
+            return GenerateExec(node.generator, node.element_attr,
+                                self._convert(node.child))
         raise UnsupportedOperationError(
             f"no physical plan for {type(node).__name__}")
 

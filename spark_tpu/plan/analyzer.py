@@ -442,6 +442,42 @@ class ResolveSubqueries(Rule):
         return plan.transform_up(rule)
 
 
+class ExtractGenerators(Rule):
+    """Project containing explode() → Project over Generate
+    (reference: Analyzer ExtractGenerator)."""
+
+    def apply(self, plan):
+        from ..expr.expressions import Explode
+        from .logical import Generate
+
+        def rule(node):
+            if not isinstance(node, Project) or not node.expressions_resolved:
+                return node
+            gens = [e for pe in node.project_list
+                    for e in pe.iter_nodes() if isinstance(e, Explode)]
+            if not gens:
+                return node
+            if len(gens) > 1:
+                raise AnalysisException(
+                    "only one generator per SELECT is supported")
+            gen = gens[0]
+            elem = AttributeReference("col", gen.dtype, True)
+
+            def replace(e):
+                return elem if e is gen else e
+
+            new_list = []
+            for e in node.project_list:
+                if isinstance(e, Alias):
+                    new_list.append(Alias(e.child.transform_up(replace),
+                                          e.name, e.expr_id))
+                else:
+                    new_list.append(e.transform_up(replace))
+            return Project(new_list, Generate(gen.child, elem, node.child))
+
+        return plan.transform_up(rule)
+
+
 class ExtractWindowFromAggregate(Rule):
     """Window functions inside a grouped SELECT evaluate over the grouped
     rows (reference: Analyzer ExtractWindowExpressions' aggregate path):
@@ -786,6 +822,7 @@ class Analyzer(RuleExecutor):
                 ResolveSubqueries(self),
                 ResolveAggsInSortHaving(cs),
                 ResolveSortHiddenRefs(cs),
+                ExtractGenerators(),
                 ExtractWindowFromAggregate(),
                 ExtractWindowExpressions(),
                 ResolveAliases(),
@@ -813,6 +850,7 @@ class Analyzer(RuleExecutor):
             ResolveSubqueries(self),
             ResolveAggsInSortHaving(cs),
             ResolveSortHiddenRefs(cs),
+            ExtractGenerators(),
             ExtractWindowFromAggregate(),
             ExtractWindowExpressions(),
             ResolveAliases(),

@@ -342,6 +342,21 @@ class Window(UnaryNode):
         return self.child.output + [e.to_attribute() for e in self.window_exprs]
 
 
+class Generate(UnaryNode):
+    """Row generator (reference: sqlcat/plans/logical Generate over
+    Explode): appends the generator's element column, expanding each input
+    row by its element count."""
+
+    def __init__(self, generator: Expression, element_attr, child: LogicalPlan):
+        self.generator = generator  # e.g. Split(col, delim)
+        self.element_attr = element_attr
+        self.child = child
+
+    @property
+    def output(self):
+        return self.child.output + [self.element_attr]
+
+
 class PythonEval(UnaryNode):
     """Append host-evaluated Python UDF columns (reference:
     ArrowEvalPythonExec's logical shadow)."""

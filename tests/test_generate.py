@@ -1,0 +1,56 @@
+"""explode(split(...)) — the wordcount capability (reference:
+sql/core GenerateExec + Explode generator)."""
+
+import pyarrow as pa
+
+
+def _lines_df(spark):
+    t = pa.table({"id": [1, 2, 3],
+                  "line": ["the quick brown fox", "the lazy dog", "the"]})
+    return spark.createDataFrame(t)
+
+
+def test_sql_wordcount(spark):
+    _lines_df(spark).createOrReplaceTempView("lines")
+    out = spark.sql("""
+        SELECT word, count(*) AS n
+        FROM (SELECT explode(split(line, ' ')) AS word FROM lines)
+        GROUP BY word ORDER BY n DESC, word
+    """).collect()
+    assert tuple(out[0].values()) == ("the", 3)
+    counts = {r["word"]: r["n"] for r in out}
+    assert counts == {"the": 3, "quick": 1, "brown": 1, "fox": 1,
+                      "lazy": 1, "dog": 1}
+
+
+def test_explode_keeps_other_columns(spark):
+    from spark_tpu.api import functions as F
+
+    df = _lines_df(spark)
+    out = df.select(df["id"], F.explode(F.split(df["line"], " ")).alias("w")) \
+            .collect()
+    rows = [tuple(r.values()) for r in out]
+    assert rows.count((1, "the")) == 1
+    assert rows.count((3, "the")) == 1
+    assert len(rows) == 4 + 3 + 1
+
+
+def test_explode_with_nulls_and_filter(spark):
+    t = pa.table({"line": ["a b", None, "c"]})
+    df = spark.createDataFrame(t)
+    df.createOrReplaceTempView("nl")
+    out = spark.sql(
+        "SELECT explode(split(line, ' ')) AS w FROM nl").collect()
+    assert sorted(x["w"] for x in out) == ["a", "b", "c"]
+    out2 = spark.sql(
+        "SELECT w FROM (SELECT explode(split(line, ' ')) AS w FROM nl) "
+        "WHERE w <> 'b'").collect()
+    assert sorted(x["w"] for x in out2) == ["a", "c"]
+
+
+def test_split_regex_delimiter(spark):
+    t = pa.table({"s": ["a,b;c", "x"]})
+    spark.createDataFrame(t).createOrReplaceTempView("rx")
+    out = spark.sql(
+        "SELECT explode(split(s, '[,;]')) AS p FROM rx").collect()
+    assert sorted(x["p"] for x in out) == ["a", "b", "c", "x"]
