@@ -274,6 +274,12 @@ class DataFrame:
 
     groupby = groupBy
 
+    def rollup(self, *cols) -> "GroupedData":
+        return GroupedData(self, _to_expr_list(cols), sets_kind="rollup")
+
+    def cube(self, *cols) -> "GroupedData":
+        return GroupedData(self, _to_expr_list(cols), sets_kind="cube")
+
     def agg(self, *cols) -> "DataFrame":
         return GroupedData(self, []).agg(*cols)
 
@@ -495,11 +501,13 @@ class GroupedData:
 
     def __init__(self, df: DataFrame, grouping: list[E.Expression],
                  pivot_col: str | None = None,
-                 pivot_values: list | None = None):
+                 pivot_values: list | None = None,
+                 sets_kind: str | None = None):
         self.df = df
         self.grouping = grouping
         self._pivot_col = pivot_col
         self._pivot_values = pivot_values
+        self._sets_kind = sets_kind
 
     def pivot(self, pivot_col: str, values: list | None = None
               ) -> "GroupedData":
@@ -518,6 +526,17 @@ class GroupedData:
         if self._pivot_col is not None:
             aggs = self._pivot_aggs(aggs)
         out = list(self.grouping) + aggs
+        if self._sets_kind is not None:
+            n = len(self.grouping)
+            if self._sets_kind == "rollup":
+                sets = [list(range(n - i)) for i in range(n + 1)]
+            else:  # cube
+                import itertools as _it
+
+                sets = [list(c) for k in range(n, -1, -1)
+                        for c in _it.combinations(range(n), k)]
+            return self.df._with(
+                L.GroupingSets(sets, self.grouping, out, self.df.plan))
         return self.df._with(L.Aggregate(self.grouping, out, self.df.plan))
 
     def _pivot_aggs(self, aggs: list[E.Expression]) -> list[E.Expression]:

@@ -270,6 +270,14 @@ def explode(c) -> Column:
     return Column(E.Explode(_c(c)))
 
 
+def grouping(c) -> Column:
+    return Column(E.Grouping(_c(c)))
+
+
+def grouping_id(*cols) -> Column:
+    return Column(E.GroupingID([_c(c) for c in cols]))
+
+
 def lpad(c, length: int, pad: str = " ") -> Column:
     return Column(E.Lpad(_c(c), E.Literal(length), E.Literal(pad)))
 

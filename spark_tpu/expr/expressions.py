@@ -1861,6 +1861,43 @@ class Split(UnaryExpression):
             "split() is only supported under explode()")
 
 
+class Grouping(UnaryExpression):
+    """grouping(col) over GROUPING SETS/ROLLUP/CUBE (reference:
+    sqlcat/expressions/grouping.scala Grouping) — folded to a 0/1 literal
+    per branch when ExpandGroupingSets expands the sets."""
+
+    @property
+    def dtype(self):
+        return int32
+
+    def eval(self, ctx):
+        raise AnalysisException(
+            "grouping() is only valid with GROUPING SETS/ROLLUP/CUBE",
+            error_class="UNSUPPORTED_GROUPING_EXPRESSION")
+
+
+class GroupingID(Expression):
+    """grouping_id(...) — bitmask of non-grouped keys, most-significant bit
+    first (reference: grouping.scala GroupingID). Empty args = all keys."""
+
+    child_fields = ("args",)
+
+    def __init__(self, args: list[Expression]):
+        self.args = list(args)
+
+    @property
+    def dtype(self):
+        return int64
+
+    def simple_string(self) -> str:
+        return f"grouping_id({', '.join(a.simple_string() for a in self.args)})"
+
+    def eval(self, ctx):
+        raise AnalysisException(
+            "grouping_id() is only valid with GROUPING SETS/ROLLUP/CUBE",
+            error_class="UNSUPPORTED_GROUPING_EXPRESSION")
+
+
 class Explode(Expression):
     """Generator marker (reference: sqlcat/expressions/generators.scala
     Explode) — extracted into a Generate operator by the analyzer."""

@@ -135,6 +135,8 @@ def _reg_all() -> None:
     r("upper", lambda c: E.Upper(c))
     r("split", lambda c, d: E.Split(c, d))
     r("explode", lambda c: E.Explode(c))
+    r("grouping", lambda c: E.Grouping(c))
+    r("grouping_id", lambda *a: E.GroupingID(list(a)))
     r("ucase", lambda c: E.Upper(c))
     r("lower", lambda c: E.Lower(c))
     r("lcase", lambda c: E.Lower(c))

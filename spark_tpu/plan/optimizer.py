@@ -923,12 +923,24 @@ class ExpandGroupingSets(Rule):
         return plan.transform_up(rule)
 
     def _fill(self, e: Expression, keys, all_keys, set_index: int):
-        from ..expr.expressions import Cast
+        from ..expr.expressions import Cast, Grouping, GroupingID
 
         def in_set(x):
-            return any(x.semantic_equals(k) for k in keys)
+            return any(x.semantic_equals(k)
+                       or (isinstance(k, Alias) and x.semantic_equals(k.child))
+                       for k in keys)
 
         def rule(x):
+            # grouping()/grouping_id() fold to literals per branch — BEFORE
+            # the null-fill below can touch their key argument
+            if isinstance(x, Grouping):
+                return Literal(0 if in_set(x.child) else 1)
+            if isinstance(x, GroupingID):
+                args = x.args or list(all_keys)
+                gid = 0
+                for a in args:
+                    gid = (gid << 1) | (0 if in_set(a) else 1)
+                return Literal(gid)
             if any(x.semantic_equals(g) for g in all_keys) and not in_set(x):
                 return Cast(Literal(None), x.dtype)
             return x

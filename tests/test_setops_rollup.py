@@ -79,3 +79,38 @@ def test_union_type_widening(nums):
 def test_intersect_type_widening(nums):
     out = q(nums, "SELECT CAST(3 AS BIGINT) AS v INTERSECT SELECT 3")
     assert out["v"] == [3]
+
+
+def test_grouping_function(nums):
+    out = q(nums, """
+        SELECT region, grouping(region) AS gr, grouping(product) AS gp,
+               sum(amount) AS s
+        FROM sales_r GROUP BY ROLLUP(region, product)""")
+    rows = set(zip(out["region"], out["gr"], out["gp"], out["s"]))
+    assert (None, 1, 1, 150) in rows        # grand total: both rolled up
+    assert ("e", 0, 1, 120) in rows         # region subtotal
+    assert ("e", 0, 0, 70) in rows          # leaf row (e, p1)
+
+
+def test_grouping_id(nums):
+    out = q(nums, """
+        SELECT region, product, grouping_id() AS gid, sum(amount) AS s
+        FROM sales_r GROUP BY CUBE(region, product)""")
+    rows = set(zip(out["region"], out["product"], out["gid"], out["s"]))
+    assert ("e", "p1", 0, 70) in rows       # fully grouped
+    assert ("e", None, 1, 120) in rows      # product rolled up → bit 0
+    assert (None, "p1", 2, 80) in rows      # region rolled up → bit 1
+    assert (None, None, 3, 150) in rows
+
+
+def test_rollup_dataframe_api(nums):
+    from spark_tpu.api import functions as F
+
+    df = nums.table("sales_r")
+    out = df.rollup(df["region"], df["product"]) \
+            .agg(F.sum(df["amount"]).alias("s"),
+                 F.grouping_id().alias("gid")).toArrow().to_pydict()
+    rows = set(zip(out["region"], out["product"], out["gid"], out["s"]))
+    assert (None, None, 3, 150) in rows
+    assert ("w", None, 1, 30) in rows
+    assert len(rows) == 4 + 2 + 1
