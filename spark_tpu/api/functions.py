@@ -278,6 +278,27 @@ def grouping_id(*cols) -> Column:
     return Column(E.GroupingID([_c(c) for c in cols]))
 
 
+def collect_list(c) -> Column:
+    return Column(E.CollectList(_c(c)))
+
+
+def collect_set(c) -> Column:
+    return Column(E.CollectSet(_c(c)))
+
+
+def array_agg(c) -> Column:
+    return Column(E.CollectList(_c(c)))
+
+
+def regexp_extract(c, pattern: str, idx: int = 1) -> Column:
+    return Column(E.RegexpExtract(_c(c), E.Literal(pattern), E.Literal(idx)))
+
+
+def regexp_replace(c, pattern: str, replacement: str) -> Column:
+    return Column(E.RegexpReplace(_c(c), E.Literal(pattern),
+                                  E.Literal(replacement)))
+
+
 def lpad(c, length: int, pad: str = " ") -> Column:
     return Column(E.Lpad(_c(c), E.Literal(length), E.Literal(pad)))
 

@@ -28,6 +28,7 @@ from typing import Any, Iterable, Sequence
 import numpy as np
 
 from ..types import (
+    ArrayType,
     BooleanType,
     DataType,
     DecimalType,
@@ -244,10 +245,16 @@ class Column:
         if selection is not None:
             data = data[selection]
             valid = valid[selection] if valid is not None else None
-        if self.is_string:
-            vals = np.array(self.dictionary.values + [""], dtype=object)
+        if self.is_string or isinstance(self.dtype, ArrayType):
+            # explicit fill: np.array() would make ragged equal-length
+            # lists into a 2-D array
+            vals = np.empty(len(self.dictionary.values) + 1, dtype=object)
+            for i, v in enumerate(self.dictionary.values):
+                vals[i] = v
+            vals[-1] = [] if isinstance(self.dtype, ArrayType) else ""
             codes = np.clip(data, 0, len(self.dictionary.values))
-            out = vals[codes] if len(self.dictionary) else np.full(len(data), "", object)
+            out = vals[codes] if len(self.dictionary) else \
+                vals[np.full(len(data), -1)]
             out = np.asarray(out, dtype=object)
         elif isinstance(self.dtype, DecimalType):
             out = data.astype(np.float64) / (10 ** self.dtype.scale)
@@ -374,7 +381,7 @@ class ColumnarBatch:
                       else _d.Decimal(int(raw[i])).scaleb(-scale)
                       for i in range(len(raw))]
                 arrays.append(pa.array(py, type=at))
-            elif isinstance(f.dataType, StringType):
+            elif isinstance(f.dataType, (StringType, ArrayType)):
                 arrays.append(pa.array(list(vals), type=at))
             else:
                 mask = None

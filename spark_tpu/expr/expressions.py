@@ -1975,6 +1975,40 @@ class SubstringIndex(_DictTransform):
         return ""
 
 
+class RegexpExtract(_DictTransform):
+    """regexp_extract(col, pattern, idx) (reference:
+    sqlcat/expressions/regexpExpressions.scala RegExpExtract) — one regex
+    match per DICTIONARY value, codes pass through."""
+
+    def __init__(self, child, pattern: Expression, idx: Expression = None):
+        super().__init__(child)
+        self.pattern = str(pattern.value)
+        self.idx = 1 if idx is None else int(idx.value)
+        self._rx = re.compile(self.pattern)
+
+    def transform(self, s):
+        m = self._rx.search(s)
+        if m is None:
+            return ""
+        g = m.group(self.idx)
+        return "" if g is None else g
+
+
+class RegexpReplace(_DictTransform):
+    """regexp_replace(col, pattern, replacement) (reference:
+    regexpExpressions.scala RegExpReplace)."""
+
+    def __init__(self, child, pattern: Expression, repl: Expression):
+        super().__init__(child)
+        self.pattern = str(pattern.value)
+        self.repl = str(repl.value)
+        self._rx = re.compile(self.pattern)
+
+    def transform(self, s):
+        # Spark/Java replacement uses $1 group refs; python wants \1
+        return self._rx.sub(re.sub(r"\$(\d)", r"\\\1", self.repl), s)
+
+
 class Translate(_DictTransform):
     def __init__(self, child, matching: Expression, replace: Expression):
         super().__init__(child)
@@ -2592,6 +2626,18 @@ class Median(Percentile):
 
 
 class CollectSet(AggregateFunction):
+    """collect_set (reference: sqlcat/expressions/aggregate/collect.scala)
+    — non-mergeable here: the planner gathers to one partition; the lists
+    are built host-side and dictionary-encoded (see ArrayType)."""
+
+    @property
+    def dtype(self):
+        return ArrayType(self.child.dtype)
+
+
+class CollectList(AggregateFunction):
+    """collect_list (reference: collect.scala Collect/CollectList)."""
+
     @property
     def dtype(self):
         return ArrayType(self.child.dtype)

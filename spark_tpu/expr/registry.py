@@ -68,6 +68,8 @@ def _reg_all() -> None:
     r("var_samp", lambda c: E.VarianceSamp(c))
     r("var_pop", lambda c: E.VariancePop(c))
     r("collect_set", lambda c: E.CollectSet(c))
+    r("collect_list", lambda c: E.CollectList(c))
+    r("array_agg", lambda c: E.CollectList(c))
     r("median", lambda c: E.Median(c))
     r("percentile", lambda c, q: E.Percentile(c, float(q.value)))
     r("percentile_approx", lambda c, q, *a: E.Percentile(c, float(q.value)))
@@ -164,6 +166,8 @@ def _reg_all() -> None:
     r("reverse", lambda c: E.Reverse(c))
     r("repeat", lambda c, n: E.Repeat(c, n))
     r("substring_index", lambda c, d, n: E.SubstringIndex(c, d, n))
+    r("regexp_extract", lambda c, p, i=None: E.RegexpExtract(c, p, i))
+    r("regexp_replace", lambda c, p, rp: E.RegexpReplace(c, p, rp))
     r("translate", lambda c, m, rep: E.Translate(c, m, rep))
     r("ascii", lambda c: E.Ascii(c))
     r("instr", lambda c, s: E.Instr(c, s))

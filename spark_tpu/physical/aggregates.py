@@ -102,6 +102,13 @@ def lower_aggregate_function(func: AggregateFunction, out_name: str,
         return AggSpec(func, child, ["percentile"], [b],
                        Alias(b, out_name, out_id), mergeable=False,
                        param=func.q)
+    from ..expr.expressions import CollectList
+
+    if isinstance(func, (CollectList, CollectSet)):
+        b = AttributeReference(f"{out_name}#buf0", func.dtype, False)
+        return AggSpec(func, child, ["collect"], [b],
+                       Alias(b, out_name, out_id), mergeable=False,
+                       param=1.0 if isinstance(func, CollectSet) else 0.0)
     if isinstance(func, (StddevSamp, StddevPop, VarianceSamp, VariancePop)):
         bs = battr(0, "sum")
         bq = battr(1, "sumsq")

@@ -22,7 +22,7 @@ from ..expr.eval import HostCtx, TraceCtx, Val
 from ..expr.expressions import (
     Alias, AttributeReference, Expression, Literal, SortOrder,
 )
-from ..types import DataType, StringType, StructField, StructType
+from ..types import ArrayType, DataType, StringType, StructField, StructType
 
 __all__ = ["canonical_key", "KernelCache", "ExprPipeline", "bind_inputs",
             "broadcast_to_cap"]
@@ -174,7 +174,8 @@ class ExprPipeline:
         cols = []
         for f, hv, d, v in zip(self.out_schema.fields, host_outs, out_datas,
                                out_valids):
-            sdict = hv.sdict if isinstance(f.dataType, StringType) else None
+            sdict = hv.sdict if isinstance(f.dataType,
+                                           (StringType, ArrayType)) else None
             cols.append(Column(f.dataType, d, v, sdict))
         return ColumnarBatch(self.out_schema, cols, new_mask, num_rows=None)
 

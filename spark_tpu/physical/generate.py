@@ -26,9 +26,13 @@ class GenerateExec(PhysicalPlan):
 
     def __init__(self, generator, element_attr: AttributeReference,
                  child: PhysicalPlan):
-        if not isinstance(generator, Split):
+        from ..types import ArrayType
+
+        if not (isinstance(generator, Split)
+                or (isinstance(generator, AttributeReference)
+                    and isinstance(generator.dtype, ArrayType))):
             raise UnsupportedOperationError(
-                "only explode(split(stringColumn, delim)) is supported")
+                "explode() supports split(col, delim) or an array column")
         self.generator = generator
         self.element_attr = element_attr
         self.child = child
@@ -38,7 +42,8 @@ class GenerateExec(PhysicalPlan):
         return self.child.output + [self.element_attr]
 
     def execute(self, ctx: ExecContext):
-        src = self.generator.child
+        src = self.generator.child if isinstance(self.generator, Split) \
+            else self.generator
         if not isinstance(src, AttributeReference):
             raise UnsupportedOperationError(
                 "split() argument must be a column")
@@ -55,10 +60,14 @@ class GenerateExec(PhysicalPlan):
         import pyarrow as pa
 
         col = batch.columns[cidx]
-        if not isinstance(col.dtype, StringType):
-            raise UnsupportedOperationError("split() needs a string column")
         values = col.dictionary.values if col.dictionary else []
-        lists = self.generator.split_lists(values or [""])
+        if isinstance(self.generator, Split):
+            if not isinstance(col.dtype, StringType):
+                raise UnsupportedOperationError(
+                    "split() needs a string column")
+            lists = self.generator.split_lists(values or [""])
+        else:  # array column: the dictionary values ARE the lists
+            lists = [list(v) for v in values] or [[]]
         counts_per_code = np.array([len(x) for x in lists], np.int64)
         offsets_per_code = np.zeros(len(lists) + 1, np.int64)
         np.cumsum(counts_per_code, out=offsets_per_code[1:])
@@ -87,11 +96,19 @@ class GenerateExec(PhysicalPlan):
             elems = flat_elements[elem_codes]
         else:
             elems = np.zeros(0, object)
+        from ..types import to_arrow_type
+
+        edt = self.element_attr.dtype
         data, validity, sd = _chunked_to_numpy(
-            pa.array(list(elems), pa.string()), StringType())
-        pad = np.zeros(out_cap, StringType().device_dtype)
+            pa.array(list(elems), to_arrow_type(edt)), edt)
+        pad = np.zeros(out_cap, edt.device_dtype)
         pad[:total] = data
-        elem_col = Column(StringType(), jnp.asarray(pad), None, sd)
+        ev = None
+        if validity is not None:
+            vm = np.zeros(out_cap, bool)
+            vm[:total] = validity
+            ev = jnp.asarray(vm)
+        elem_col = Column(edt, jnp.asarray(pad), ev, sd)
 
         return ColumnarBatch(out_schema, list(gathered.columns) + [elem_col],
                              out_mask, num_rows=total)

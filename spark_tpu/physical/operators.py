@@ -552,10 +552,15 @@ class HashAggregateExec(PhysicalPlan):
 
         vals = self._plan_values()
         percentiles: dict[int, tuple] = {}  # buffer idx → (column, q)
+        collects: dict[int, tuple] = {}     # buffer idx → (column, dedupe)
         main_vals = []
         for bi, (op, attr, param) in enumerate(vals):
             if op == "percentile":
                 percentiles[bi] = (batch.columns[pos[attr.expr_id]], param)
+                main_vals.append(("first", attr))  # placeholder, overwritten
+            elif op == "collect":
+                collects[bi] = (batch.columns[pos[attr.expr_id]],
+                                param >= 0.5)
                 main_vals.append(("first", attr))  # placeholder, overwritten
             else:
                 main_vals.append((op, attr))
@@ -592,7 +597,13 @@ class HashAggregateExec(PhysicalPlan):
             for bi, (pc, q) in percentiles.items():
                 datas[bi], valids[bi] = self._ungrouped_percentile(
                     batch, pc, q, datas[bi].shape[0])
-            cols = [self._finish_buffer(bi, d, v, f, string_minmax)
+            collect_cols = {
+                bi: self._ungrouped_collect(batch, vc, dd,
+                                            datas[bi].shape[0],
+                                            out_schema.fields[bi].dataType)
+                for bi, (vc, dd) in collects.items()}
+            cols = [self._finish_buffer(bi, d, v, f, string_minmax,
+                                        collect_cols)
                     for bi, (f, d, v) in enumerate(
                         zip(out_schema.fields, datas, valids))]
             return ColumnarBatch(out_schema, cols, mask, num_rows=1)
@@ -602,7 +613,7 @@ class HashAggregateExec(PhysicalPlan):
         key_outs = [c.data for c in key_cols]
         key_valids = [c.validity for c in key_cols]
 
-        if not percentiles:
+        if not percentiles and not collects:
             dense = self._try_dense(batch, key_cols, ops, val_datas,
                                     val_valids, out_schema, ctx,
                                     string_minmax)
@@ -641,13 +652,19 @@ class HashAggregateExec(PhysicalPlan):
             pvals, phas = pk(key_eqs, key_valids, pc.data, pc.validity,
                              batch.row_mask)
             bufs[bi] = (pvals, phas)
+        collect_cols = {
+            bi: self._group_collect(
+                batch, key_cols, out_keys, out_mask, vc, dd,
+                out_schema.fields[len(key_cols) + bi].dataType)
+            for bi, (vc, dd) in collects.items()}
         cols = []
         for (kd, kv), kc, f in zip(out_keys, key_cols,
                                    out_schema.fields[: len(key_cols)]):
             cols.append(Column(f.dataType, kd, kv, kc.dictionary))
         for bi, ((bd, bv), f) in enumerate(
                 zip(bufs, out_schema.fields[len(key_cols):])):
-            cols.append(self._finish_buffer(bi, bd, bv, f, string_minmax))
+            cols.append(self._finish_buffer(bi, bd, bv, f, string_minmax,
+                                            collect_cols))
         return ColumnarBatch(out_schema, cols, out_mask, num_rows=None)
 
     def _ungrouped_percentile(self, batch, pc: Column, q: float,
@@ -672,7 +689,65 @@ class HashAggregateExec(PhysicalPlan):
         k = GLOBAL_KERNEL_CACHE.get_or_build(key, build)
         return k(pc.data, pc.validity, batch.row_mask)
 
-    def _finish_buffer(self, bi, bd, bv, f, string_minmax):
+    def _ungrouped_collect(self, batch, vc: Column, dedupe: bool,
+                           out_cap: int, out_dtype):
+        """collect_list/set with no grouping: one list over all valid rows
+        (list order = input row order; reference leaves it unspecified)."""
+        jnp = _jnp()
+        sel = batch.selection_indices()
+        vals = [v for v in vc.to_numpy(sel) if v is not None]
+        if dedupe:
+            vals = list(dict.fromkeys(vals))
+        from ..columnar.batch import StringDict
+
+        return Column(out_dtype, jnp.zeros(out_cap, jnp.int32), None,
+                      StringDict([vals]))
+
+    def _group_collect(self, batch, key_cols, out_keys, out_mask,
+                       vc: Column, dedupe: bool, out_dtype):
+        """Grouped collect: the group structure comes from the device
+        kernel; lists are built host-side and matched to the kernel's
+        group rows by key tuple (same raw key domain on both sides)."""
+        jnp = _jnp()
+
+        def key_tuples(cols, selection):
+            arrs = []
+            for kd, kv in cols:
+                d = np.asarray(kd)[selection]
+                v = None if kv is None else np.asarray(kv)[selection]
+                arrs.append((d, v))
+            return [tuple(None if (v is not None and not v[i])
+                          else d[i].item() for d, v in arrs)
+                    for i in range(len(selection))]
+
+        sel = batch.selection_indices()
+        vals = vc.to_numpy(sel)
+        groups: dict[tuple, list] = {}
+        for kt, v in zip(key_tuples(
+                [(c.data, c.validity) for c in key_cols], sel), vals):
+            if v is not None:
+                groups.setdefault(kt, []).append(v)
+
+        gm = np.asarray(out_mask)
+        gsel = np.nonzero(gm)[0]
+        codes = np.zeros(gm.shape[0], np.int32)
+        values: list[list] = []
+        out_tuples = key_tuples(out_keys, gsel)
+        for g, kt in zip(gsel, out_tuples):
+            lst = groups.get(kt, [])
+            if dedupe:
+                lst = list(dict.fromkeys(lst))
+            codes[g] = len(values)
+            values.append(lst)
+        from ..columnar.batch import StringDict
+
+        return Column(out_dtype, jnp.asarray(codes), None,
+                      StringDict(values or [[]]))
+
+    def _finish_buffer(self, bi, bd, bv, f, string_minmax,
+                       collect_cols=None):
+        if collect_cols and bi in collect_cols:
+            return collect_cols[bi]
         jnp = _jnp()
         if bi in string_minmax:
             from ..columnar.batch import EMPTY_DICT

@@ -967,7 +967,8 @@ def _parse_ts_literal(s: str) -> datetime.datetime:
 _AGG_NAMES = frozenset((
     "sum", "count", "min", "max", "avg", "mean", "first", "any_value",
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
-    "collect_set", "first_value", "median", "percentile",
+    "collect_set", "collect_list", "array_agg", "first_value", "median",
+    "percentile",
     "percentile_approx", "corr", "covar_samp", "covar_pop", "skewness",
     "kurtosis", "approx_count_distinct"))
 

@@ -194,6 +194,10 @@ class DecimalType(FractionalType):
 
 @dataclass(frozen=True)
 class ArrayType(DataType):
+    """Ragged arrays have no dense device layout; array columns are
+    dictionary-encoded like strings — int32 codes on device, the list
+    values host-side in the column's dictionary."""
+
     element_type: DataType = field(default_factory=lambda: IntegerType())
 
     def simple_string(self) -> str:
@@ -201,7 +205,7 @@ class ArrayType(DataType):
 
     @property
     def device_dtype(self) -> np.dtype:
-        return self.element_type.device_dtype
+        return np.dtype(np.int32)
 
 
 # Singleton-ish instances
@@ -380,6 +384,8 @@ def to_arrow_type(dt: DataType):
         return pa.decimal128(dt.precision, dt.scale)
     if isinstance(dt, NullType):
         return pa.null()
+    if isinstance(dt, ArrayType):
+        return pa.list_(to_arrow_type(dt.element_type))
     raise NotImplementedError(f"no arrow type for {dt}")
 
 

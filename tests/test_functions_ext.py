@@ -241,3 +241,56 @@ def test_percentile_sql(spark):
         "SELECT percentile(col1, 0.5) AS p FROM "
         "(VALUES (1.0), (2.0), (3.0))").toArrow().to_pydict()
     assert out["p"] == [2.0]
+
+
+def test_regexp_extract_replace(spark):
+    spark.createDataFrame(pa.table(
+        {"s": ["user-123-end", "no-digits-here", "x9y"]})) \
+        .createOrReplaceTempView("rex")
+    out = q(spark, r"""
+        SELECT regexp_extract(s, '(\d+)', 1) AS d,
+               regexp_extract(s, '([a-z]+)-(\d+)', 2) AS g2,
+               regexp_replace(s, '\d+', '#') AS rp
+        FROM rex ORDER BY s""")
+    assert out["d"] == ["", "123", "9"]
+    assert out["g2"] == ["", "123", ""]
+    assert out["rp"] == ["no-digits-here", "user-#-end", "x#y"]
+
+
+def test_regexp_replace_group_refs(spark):
+    spark.createDataFrame(pa.table({"s": ["ab", "cd"]})) \
+        .createOrReplaceTempView("rex2")
+    out = q(spark, r"""
+        SELECT regexp_replace(s, '(a)(b)', '$2$1') AS sw FROM rex2
+        ORDER BY s""")
+    assert out["sw"] == ["ba", "cd"]
+
+
+def test_collect_list_and_set(spark):
+    spark.createDataFrame(pa.table({
+        "k": ["a", "a", "b", "a", "b"],
+        "v": [1, 2, 1, 2, None],
+        "s": ["x", "y", "x", "y", "z"],
+    })).createOrReplaceTempView("coll")
+    out = q(spark, """
+        SELECT k, collect_list(v) AS l, collect_set(v) AS st,
+               collect_list(s) AS ls
+        FROM coll GROUP BY k ORDER BY k""")
+    assert out["l"] == [[1, 2, 2], [1]]       # nulls skipped
+    assert out["st"] == [[1, 2], [1]]
+    assert out["ls"] == [["x", "y", "y"], ["x", "z"]]
+
+
+def test_collect_ungrouped_and_df_api(spark):
+    df = spark.createDataFrame(pa.table({"v": [3, 1, 3, 2]}))
+    rows = df.agg(F.collect_set(df["v"]).alias("s"),
+                  F.collect_list(df["v"]).alias("l")).collect()
+    assert rows[0]["s"] == [3, 1, 2]
+    assert rows[0]["l"] == [3, 1, 3, 2]
+
+
+def test_array_agg_alias(spark):
+    spark.createDataFrame(pa.table({"v": [1, 2]})) \
+        .createOrReplaceTempView("aa")
+    out = q(spark, "SELECT array_agg(v) AS a FROM aa")
+    assert out["a"] == [[1, 2]]

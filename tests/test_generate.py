@@ -54,3 +54,15 @@ def test_split_regex_delimiter(spark):
     out = spark.sql(
         "SELECT explode(split(s, '[,;]')) AS p FROM rx").collect()
     assert sorted(x["p"] for x in out) == ["a", "b", "c", "x"]
+
+
+def test_explode_array_column(spark):
+    spark.createDataFrame(pa.table({
+        "k": ["a", "a", "b"], "v": [1, 2, 3]})) \
+        .createOrReplaceTempView("cl")
+    out = spark.sql("""
+        SELECT k, explode(l) AS e FROM
+          (SELECT k, collect_list(v) AS l FROM cl GROUP BY k)
+        ORDER BY k, e""").collect()
+    assert [tuple(r.values()) for r in out] == \
+        [("a", 1), ("a", 2), ("b", 3)]
