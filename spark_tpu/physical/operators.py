@@ -1314,7 +1314,7 @@ class NestedLoopJoinExec(PhysicalPlan):
 
     def __init__(self, condition: Expression | None, join_type: str,
                  left: PhysicalPlan, right: PhysicalPlan):
-        if join_type not in ("inner", "cross"):
+        if join_type not in ("inner", "cross", "left_semi", "left_anti"):
             raise UnsupportedOperationError(
                 f"nested-loop {join_type} join not supported yet")
         self.condition = condition
@@ -1325,6 +1325,8 @@ class NestedLoopJoinExec(PhysicalPlan):
 
     @property
     def output(self):
+        if self.join_type in ("left_semi", "left_anti"):
+            return list(self.left.output)
         return self.left.output + self.right.output
 
     def required_child_distribution(self):
@@ -1343,12 +1345,14 @@ class NestedLoopJoinExec(PhysicalPlan):
         bbatch = concat_batches(build, rschema) if build \
             else ColumnarBatch.empty(rschema)
         nb = bbatch.num_rows()
-        schema = attrs_schema(self.output)
+        pair_attrs = list(self.left.output) + list(self.right.output)
+        pair_schema = attrs_schema(pair_attrs)
+        semi_anti = self.join_type in ("left_semi", "left_anti")
 
         cond_pipe = None
         if self.condition is not None:
-            cond_pipe = ExprPipeline(self.output, [self.condition],
-                                     list(self.output), schema)
+            cond_pipe = ExprPipeline(pair_attrs, [self.condition],
+                                     pair_attrs, pair_schema)
 
         out = []
         for part in left_parts:
@@ -1362,12 +1366,23 @@ class NestedLoopJoinExec(PhysicalPlan):
                                    bucket_capacity(int(r.needed)))
                 probe_out = gather_batch(pb, r.probe_idx, r.out_mask)
                 build_out = gather_batch(bbatch, r.build_idx, r.out_mask)
-                joined = ColumnarBatch(schema,
+                joined = ColumnarBatch(pair_schema,
                                        probe_out.columns + build_out.columns,
                                        r.out_mask, num_rows=None)
                 if cond_pipe is not None:
                     joined = cond_pipe.run(joined)
-                obatches.append(joined)
+                if semi_anti:
+                    # fold pair matches back onto probe rows: a probe row
+                    # matches iff ANY surviving pair points at it
+                    matched = jnp.zeros(pb.capacity, bool) \
+                        .at[r.probe_idx].max(joined.row_mask)
+                    keep = pb.row_mask & (
+                        matched if self.join_type == "left_semi"
+                        else ~matched)
+                    obatches.append(ColumnarBatch(
+                        pb.schema, pb.columns, keep, num_rows=None))
+                else:
+                    obatches.append(joined)
             out.append(obatches)
         return out
 

@@ -384,8 +384,20 @@ class Planner:
                     "cross" if jt == "cross" and not residual else "inner",
                     left, right)
                 return self._maybe_reorder(nl, node, flipped)
+            if jt in ("left_semi", "left_anti"):
+                # e.g. null-aware NOT IN: "eq OR eq IS NULL" is not an
+                # equi conjunct; any-match semantics need the pair fold,
+                # not a hash probe
+                return NestedLoopJoinExec(
+                    join_conjuncts(residual) if residual else None,
+                    jt, left, right)
             raise UnsupportedOperationError(
                 f"non-equi {jt} join not supported yet")
+
+        if residual and jt in ("left_semi", "left_anti"):
+            # a residual on top of a semi/anti hash join is NOT a filter —
+            # match-existence must be decided over the full condition
+            return NestedLoopJoinExec(node.condition, jt, left, right)
 
         if residual and jt not in ("inner",):
             raise UnsupportedOperationError(

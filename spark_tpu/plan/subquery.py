@@ -15,7 +15,8 @@ from typing import Optional, Sequence
 
 from ..errors import AnalysisException, UnsupportedOperationError
 from ..expr.expressions import (
-    Alias, And, AttributeReference, EqualTo, Expression, Literal, Not,
+    Alias, And, AttributeReference, EqualTo, Expression, IsNull, Literal,
+    Not, Or,
 )
 from .logical import Aggregate, Filter, Join, LogicalPlan, Project
 from .tree import Rule
@@ -186,9 +187,14 @@ class RewritePredicateSubquery(Rule):
                     "unsupported correlated IN subquery")
             value_attr = sub.output[0]
             sub = _expose_correlation_keys(sub, pairs)
-            # NOT IN with nullable inner values: null-aware anti join; we
-            # implement the not-exists semantics (documented deviation)
-            cond: Expression = EqualTo(e.value, value_attr)
+            eq: Expression = EqualTo(e.value, value_attr)
+            if neg and (e.value.nullable or value_attr.nullable):
+                # null-aware anti join (reference: subquery.scala
+                # RewritePredicateSubquery null-aware path): a NULL on
+                # either side makes NOT IN unknown, so "eq OR eq IS NULL"
+                # counts as a match and the row is anti-filtered
+                eq = Or(eq, IsNull(eq))
+            cond: Expression = eq
             for outer_e, inner_e in pairs:
                 cond = And(cond, EqualTo(outer_e, inner_e))
             jt = "left_anti" if neg else "left_semi"

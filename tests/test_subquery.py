@@ -80,3 +80,26 @@ def test_in_subquery_with_correlation(shop):
                                       WHERE i.cust = o.cust)
                      ORDER BY oid""")
     assert out["oid"] == [2, 4, 5]
+
+
+def test_not_in_null_aware(spark):
+    import pyarrow as pa
+
+    spark.createDataFrame(pa.table({"x": [1, 2, None]})) \
+        .createOrReplaceTempView("na_outer")
+    spark.createDataFrame(pa.table({"y": [2, None]})) \
+        .createOrReplaceTempView("na_inner_null")
+    spark.createDataFrame(pa.table({"y": [2, 3]})) \
+        .createOrReplaceTempView("na_inner")
+    # a NULL in the subquery makes NOT IN never-true → empty result
+    out = q(spark, "SELECT x FROM na_outer "
+                   "WHERE x NOT IN (SELECT y FROM na_inner_null)")
+    assert out["x"] == []
+    # NULL outer values are filtered (NOT IN is unknown, not true)
+    out = q(spark, "SELECT x FROM na_outer "
+                   "WHERE x NOT IN (SELECT y FROM na_inner) ORDER BY x")
+    assert out["x"] == [1]
+    # IN keeps plain semantics
+    out = q(spark, "SELECT x FROM na_outer "
+                   "WHERE x IN (SELECT y FROM na_inner)")
+    assert out["x"] == [2]
