@@ -239,6 +239,8 @@ def w_agg_rows(lo: WindowLayout, values, valid, kind: str,
         return total, cnt > 0
     if kind == "avg":
         return total.astype(jnp.float64) / jnp.maximum(cnt, 1), cnt > 0
+    if kind in ("min", "max"):
+        return _range_minmax(v, w, lo_idx, hi_idx, empty, kind), cnt > 0
     raise ValueError(kind)
 
 
@@ -283,6 +285,8 @@ def w_agg_value_range(lo: WindowLayout, order_key, values, valid, kind: str,
         return total, cnt > 0
     if kind == "avg":
         return total.astype(jnp.float64) / jnp.maximum(cnt, 1), cnt > 0
+    if kind in ("min", "max"):
+        return _range_minmax(v, w, lo_idx, hi_idx, empty, kind), cnt > 0
     raise ValueError(kind)
 
 
@@ -290,6 +294,36 @@ def _ident(kind, dtype):
     from .grouping import _max_ident, _min_ident
 
     return _max_ident(dtype) if kind == "min" else _min_ident(dtype)
+
+
+def _range_minmax(v, w, lo_idx, hi_idx, empty, kind):
+    """min/max over per-row index ranges [lo_idx, hi_idx] of the sorted
+    value array, via a sparse table (doubling): level j holds the reduce
+    of windows of length 2^j — O(n log n) fully vectorized build, O(1)
+    two-window query per row. This is the TPU analog of the reference's
+    per-row frame scan (sqlx/window/WindowFunctionFrame SlidingWindow)."""
+    cap = v.shape[0]
+    ident = _ident(kind, v.dtype)
+    op = jnp.minimum if kind == "min" else jnp.maximum
+    a = jnp.where(w, v, ident)
+    levels = [a]
+    step = 1
+    while step < cap:
+        prev = levels[-1]
+        shifted = jnp.concatenate(
+            [prev[step:], jnp.full((step,), ident, prev.dtype)])
+        levels.append(op(prev, shifted))
+        step <<= 1
+    sp = jnp.stack(levels)  # [L, cap]
+    length = jnp.maximum(hi_idx - lo_idx + 1, 1)
+    k = jnp.floor(
+        jnp.log2(length.astype(jnp.float64))).astype(jnp.int32)
+    # integer-exact guard against float log sloppiness: need 2^k <= length
+    k = jnp.clip(jnp.where((1 << k) > length, k - 1, k),
+                 0, len(levels) - 1)
+    p1 = sp[k, jnp.clip(lo_idx, 0, cap - 1)]
+    p2_at = jnp.clip(hi_idx - (1 << k) + 1, 0, cap - 1)
+    return jnp.where(empty, ident, op(p1, sp[k, p2_at]))
 
 
 def w_shift(lo: WindowLayout, values, valid, offset: int,

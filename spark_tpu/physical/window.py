@@ -89,7 +89,7 @@ class WindowExec(PhysicalPlan):
                     ftype, lo, hi = frame
                     if (lo, hi) == (None, None):
                         out.append((f"agg_unbounded_{kind}", None, f.child))
-                    elif kind not in ("sum", "count", "avg"):
+                    elif kind not in ("sum", "count", "avg", "min", "max"):
                         raise UnsupportedOperationError(
                             f"{kind} over a bounded frame is not "
                             "supported yet")

@@ -188,3 +188,47 @@ def test_value_range_frame_api(spark):
     # t=0: window [−10,10] → {0,5,10} avg 7/3; t=30: only itself
     assert abs(out["a"][0] - 7 / 3) < 1e-9
     assert out["a"][3] == 8.0
+
+
+def test_rows_frame_min_max(spark):
+    import numpy as np
+    import pandas as pd
+    import pyarrow as pa
+    from spark_tpu.api.window import Window
+
+    rng = np.random.default_rng(7)
+    n = 200
+    pdf = pd.DataFrame({
+        "g": rng.integers(0, 5, n),
+        "t": np.arange(n),
+        "v": rng.integers(-50, 50, n).astype("int64"),
+    })
+    df = spark.createDataFrame(pa.table(pdf))
+    w = Window.partitionBy("g").orderBy("t").rowsBetween(-3, 2)
+    out = _d(df.select("g", "t",
+                       F.min("v").over(w).alias("lo"),
+                       F.max("v").over(w).alias("hi")).orderBy("g", "t"))
+    ordered = pdf.sort_values(["g", "t"])
+    exp_lo, exp_hi = [], []  # brute-force oracle
+    for _, grp in ordered.groupby("g"):
+        vs = grp["v"].tolist()
+        for i in range(len(vs)):
+            win = vs[max(0, i - 3): i + 3]
+            exp_lo.append(min(win))
+            exp_hi.append(max(win))
+    assert out["lo"] == exp_lo
+    assert out["hi"] == exp_hi
+
+
+def test_range_value_frame_min(spark):
+    import pyarrow as pa
+
+    spark.createDataFrame(pa.table({
+        "t": [1, 2, 5, 6, 10], "v": [9, 3, 7, 1, 5]})) \
+        .createOrReplaceTempView("wrv")
+    out = spark.sql("""
+        SELECT t, min(v) OVER (ORDER BY t
+            RANGE BETWEEN 2 PRECEDING AND CURRENT ROW) AS m
+        FROM wrv ORDER BY t""").toArrow().to_pydict()
+    # windows by VALUE of t: t=1→{9}; t=2→{9,3}; t=5→{7}; t=6→{7,1}; t=10→{5}
+    assert out["m"] == [9, 3, 7, 1, 5]
