@@ -1314,7 +1314,8 @@ class NestedLoopJoinExec(PhysicalPlan):
 
     def __init__(self, condition: Expression | None, join_type: str,
                  left: PhysicalPlan, right: PhysicalPlan):
-        if join_type not in ("inner", "cross", "left_semi", "left_anti"):
+        if join_type not in ("inner", "cross", "left_semi", "left_anti",
+                             "left_outer"):
             raise UnsupportedOperationError(
                 f"nested-loop {join_type} join not supported yet")
         self.condition = condition
@@ -1381,6 +1382,26 @@ class NestedLoopJoinExec(PhysicalPlan):
                         else ~matched)
                     obatches.append(ColumnarBatch(
                         pb.schema, pb.columns, keep, num_rows=None))
+                elif self.join_type == "left_outer":
+                    obatches.append(joined)
+                    # null-extend unmatched probe rows as a second batch
+                    matched = jnp.zeros(pb.capacity, bool) \
+                        .at[r.probe_idx].max(joined.row_mask)
+                    from ..columnar.batch import EMPTY_DICT
+                    from ..types import ArrayType, StringType
+
+                    null_cols = []
+                    for f in rschema.fields:
+                        null_cols.append(Column(
+                            f.dataType,
+                            jnp.zeros(pb.capacity, f.dataType.device_dtype),
+                            jnp.zeros(pb.capacity, bool),
+                            EMPTY_DICT if isinstance(
+                                f.dataType, (StringType, ArrayType))
+                            else None))
+                    obatches.append(ColumnarBatch(
+                        pair_schema, list(pb.columns) + null_cols,
+                        pb.row_mask & ~matched, num_rows=None))
                 else:
                     obatches.append(joined)
             out.append(obatches)

@@ -384,20 +384,23 @@ class Planner:
                     "cross" if jt == "cross" and not residual else "inner",
                     left, right)
                 return self._maybe_reorder(nl, node, flipped)
-            if jt in ("left_semi", "left_anti"):
+            if jt in ("left_semi", "left_anti", "left_outer"):
                 # e.g. null-aware NOT IN: "eq OR eq IS NULL" is not an
                 # equi conjunct; any-match semantics need the pair fold,
                 # not a hash probe
-                return NestedLoopJoinExec(
+                nl = NestedLoopJoinExec(
                     join_conjuncts(residual) if residual else None,
                     jt, left, right)
+                return self._maybe_reorder(nl, node, flipped)
             raise UnsupportedOperationError(
                 f"non-equi {jt} join not supported yet")
 
-        if residual and jt in ("left_semi", "left_anti"):
-            # a residual on top of a semi/anti hash join is NOT a filter —
-            # match-existence must be decided over the full condition
-            return NestedLoopJoinExec(node.condition, jt, left, right)
+        if residual and jt in ("left_semi", "left_anti", "left_outer"):
+            # a residual on top of a semi/anti/outer hash join is NOT a
+            # post-filter — match-existence must be decided over the full
+            # condition before null extension
+            nl = NestedLoopJoinExec(node.condition, jt, left, right)
+            return self._maybe_reorder(nl, node, flipped)
 
         if residual and jt not in ("inner",):
             raise UnsupportedOperationError(

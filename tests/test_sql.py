@@ -214,3 +214,35 @@ def test_nested_union_flattening(store):
     out = q(store, """SELECT 1 AS v UNION ALL SELECT 2
                       UNION ALL SELECT 3 UNION ALL SELECT 4""")
     assert sorted(out["v"]) == [1, 2, 3, 4]
+
+
+def test_non_equi_left_outer_join(spark):
+    import pyarrow as pa
+
+    spark.createDataFrame(pa.table({"x": [1, 5, 9]})) \
+        .createOrReplaceTempView("neq_a")
+    spark.createDataFrame(pa.table({"y": [3, 6]})) \
+        .createOrReplaceTempView("neq_b")
+    out = spark.sql("""
+        SELECT x, y FROM neq_a LEFT JOIN neq_b ON x < y
+        ORDER BY x, y""").toArrow().to_pydict()
+    assert list(zip(out["x"], out["y"])) == \
+        [(1, 3), (1, 6), (5, 6), (9, None)]
+
+
+def test_left_outer_join_with_residual(spark):
+    import pyarrow as pa
+
+    spark.createDataFrame(pa.table({
+        "k": [1, 1, 2], "v": [10, 20, 30]})) \
+        .createOrReplaceTempView("res_a")
+    spark.createDataFrame(pa.table({
+        "k": [1, 2], "w": [15, 25]})) \
+        .createOrReplaceTempView("res_b")
+    out = spark.sql("""
+        SELECT v, w FROM res_a LEFT JOIN res_b
+        ON res_a.k = res_b.k AND v < w
+        ORDER BY v""").toArrow().to_pydict()
+    # v=10 matches (k=1, w=15); v=20 has no qualifying row; v=30 neither
+    assert list(zip(out["v"], out["w"])) == \
+        [(10, 15), (20, None), (30, None)]
