@@ -232,13 +232,33 @@ class StreamingQuery:
             raise UnsupportedOperationError(
                 "multiple streaming aggregations not supported")
         if self.output_mode == "append":
+            if self._is_dedup(aggs[0]):
+                # dropDuplicates/distinct (reference:
+                # StreamingDeduplicateExec): first-sight emission is
+                # append-safe — a key's buffer never changes after its
+                # first appearance
+                return self._execute_stateful(optimized, aggs[0],
+                                              dedup_append=True)
             raise AnalysisException(
                 "append mode on aggregated streams requires a watermark on "
                 "the grouping keys (not yet supported) — use complete/update")
         return self._execute_stateful(optimized, aggs[0])
 
+    @staticmethod
+    def _is_dedup(agg: Aggregate) -> bool:
+        from ..expr.expressions import AggregateFunction, Alias, First
+
+        for e in agg.aggregate_exprs:
+            inner = e.child if isinstance(e, Alias) else e
+            fns = [n for n in inner.iter_nodes()
+                   if isinstance(n, AggregateFunction)]
+            if fns and not all(isinstance(f, First) for f in fns):
+                return False
+        return True
+
     def _execute_stateful(self, optimized: LogicalPlan,
-                          agg: Aggregate) -> pa.Table:
+                          agg: Aggregate,
+                          dedup_append: bool = False) -> pa.Table:
         from ..physical.operators import (
             HashAggregateExec, LocalTableScanExec, UnionExec,
         )
@@ -267,6 +287,7 @@ class StreamingQuery:
                 "non-mergeable aggregates (percentile/median) are not "
                 "supported in streaming state")
         buffer_attrs = list(partial.output)
+        prev_state = self.state.table  # pre-batch state (dedup emission)
         partial_ready = planner._ensure_requirements(partial)
         new_parts = partial_ready.execute(ctx)
         new_partial_exec = PrecomputedExec(new_parts, buffer_attrs)
@@ -297,8 +318,9 @@ class StreamingQuery:
         out = pa.concat_tables([b.to_arrow() for b in out_batches],
                                promote_options="permissive")
 
-        if self.output_mode == "update":
-            # only groups touched by this batch
+        if self.output_mode == "update" or dedup_append:
+            # update: only groups touched by this batch;
+            # dedup append: touched AND unseen before this batch
             key_names = [a.name for a in partial.grouping]
             new_batches = [b for p in new_parts for b in p]
             if new_batches and key_names:
@@ -307,9 +329,14 @@ class StreamingQuery:
                 new_keys = set(zip(*[newt.column(k).to_pylist()
                                      for k in key_names])) \
                     if newt.num_rows else set()
+                old_keys = set()
+                if dedup_append and prev_state is not None \
+                        and prev_state.num_rows:
+                    old_keys = set(zip(*[prev_state.column(k).to_pylist()
+                                         for k in key_names]))
                 cols = list(zip(*[out.column(k).to_pylist()
                                   for k in key_names])) if out.num_rows else []
-                mask = [c in new_keys for c in cols]
+                mask = [c in new_keys and c not in old_keys for c in cols]
                 out = out.filter(pa.array(mask)) if cols else out
         return out
 

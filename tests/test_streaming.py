@@ -193,3 +193,40 @@ def test_streaming_dedup_via_distinct(spark):
         assert rows == [("a", 1), ("b", 2), ("c", 3)]
     finally:
         q.stop()
+
+
+def test_streaming_dedup_append(spark):
+    src, df = spark.memory_stream(pa.schema([("k", pa.string()),
+                                             ("v", pa.int64())]))
+    q = (df.dropDuplicates(["k"])
+           .writeStream.format("memory").queryName("s_dedup")
+           .outputMode("append").start())
+    try:
+        src.add_data({"k": ["a", "b", "a"], "v": [1, 2, 3]})
+        q.processAllAvailable()
+        out = _sink_rows(spark, "s_dedup")
+        assert sorted(zip(out["k"], out["v"])) == [("a", 1), ("b", 2)]
+        # duplicates across batches are suppressed; new keys emitted
+        src.add_data({"k": ["a", "c"], "v": [9, 4]})
+        q.processAllAvailable()
+        out = _sink_rows(spark, "s_dedup")
+        assert sorted(zip(out["k"], out["v"])) == \
+            [("a", 1), ("b", 2), ("c", 4)]
+    finally:
+        q.stop()
+
+
+def test_streaming_distinct_append(spark):
+    src, df = spark.memory_stream(pa.schema([("x", pa.int64())]))
+    q = (df.distinct()
+           .writeStream.format("memory").queryName("s_dist")
+           .outputMode("append").start())
+    try:
+        src.add_data({"x": [1, 1, 2]})
+        q.processAllAvailable()
+        src.add_data({"x": [2, 3]})
+        q.processAllAvailable()
+        out = _sink_rows(spark, "s_dist")
+        assert sorted(out["x"]) == [1, 2, 3]
+    finally:
+        q.stop()
