@@ -1086,6 +1086,12 @@ def _rewrite_predicate_subquery():
     return RewritePredicateSubquery()
 
 
+def _rewrite_existence_subquery():
+    from .subquery import RewriteExistenceSubquery
+
+    return RewriteExistenceSubquery()
+
+
 def _rewrite_correlated_scalar():
     from .subquery import RewriteCorrelatedScalarSubquery
 
@@ -1107,6 +1113,7 @@ class Optimizer(RuleExecutor):
             ]),
             Batch("Subqueries", FixedPoint(10), [
                 _rewrite_predicate_subquery(),
+                _rewrite_existence_subquery(),
                 _rewrite_correlated_scalar(),
             ]),
             Batch("Operator optimization", FixedPoint(100), [

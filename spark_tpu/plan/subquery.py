@@ -15,10 +15,10 @@ from typing import Optional, Sequence
 
 from ..errors import AnalysisException, UnsupportedOperationError
 from ..expr.expressions import (
-    Alias, And, AttributeReference, EqualTo, Expression, IsNull, Literal,
-    Not, Or,
+    Alias, And, AttributeReference, EqualTo, Expression, IsNotNull, IsNull,
+    Literal, Not, Or,
 )
-from .logical import Aggregate, Filter, Join, LogicalPlan, Project
+from .logical import Aggregate, Filter, Join, Limit, LogicalPlan, Project
 from .tree import Rule
 
 __all__ = ["ScalarSubquery", "InSubquery", "Exists",
@@ -250,6 +250,67 @@ def _expose_correlation_keys(
             return Project(list(sub.project_list) + missing, sub.child)
     raise UnsupportedOperationError(
         "correlated key is not reachable from the subquery output")
+
+
+class RewriteExistenceSubquery(Rule):
+    """IN/EXISTS used as a VALUE (inside a projection) → left_outer
+    "existence join" producing a boolean flag (reference: sqlcat
+    ExistenceJoin planned by RewritePredicateSubquery when the predicate
+    is not a top-level Filter conjunct). Two-valued: a NULL probe value
+    yields false rather than NULL (documented deviation)."""
+
+    def apply(self, plan):
+        def rule(node):
+            if not isinstance(node, Project):
+                return node
+            target = None
+            for e in node.project_list:
+                for x in e.iter_nodes():
+                    if isinstance(x, (InSubquery, Exists)):
+                        target = x
+                        break
+                if target is not None:
+                    break
+            if target is None:
+                return node
+            outer_ids = {a.expr_id for a in node.child.output}
+            sub, pairs, ok = split_correlation(target.plan, outer_ids)
+            if not ok:
+                raise UnsupportedOperationError(
+                    "unsupported correlated subquery in SELECT")
+            flag = Alias(Literal(True), "__exists")
+            cond = None
+            if isinstance(target, InSubquery):
+                value_attr = sub.output[0]
+                sub = _expose_correlation_keys(sub, pairs)
+                keys = [value_attr] + [ie for _, ie in pairs]
+                dsub = Aggregate(list(keys), list(keys) + [flag], sub)
+                cond = EqualTo(target.value, value_attr)
+                for outer_e, ie in pairs:
+                    cond = And(cond, EqualTo(outer_e, ie))
+            elif pairs:
+                sub = _expose_correlation_keys(sub, pairs)
+                keys = [ie for _, ie in pairs]
+                dsub = Aggregate(list(keys), list(keys) + [flag], sub)
+                for outer_e, ie in pairs:
+                    c = EqualTo(outer_e, ie)
+                    cond = c if cond is None else And(cond, c)
+            else:
+                # uncorrelated EXISTS: 0/1-row flag relation, cross-style
+                # left_outer (condition-less nested loop)
+                dsub = Project([flag], Limit(1, sub))
+            flag_attr = dsub.output[-1]
+            joined = Join(node.child, dsub, "left_outer", cond)
+            rep = IsNotNull(flag_attr)
+
+            def replace(x: Expression) -> Expression:
+                return rep if x is target else x
+
+            new_node = node.map_expressions(
+                lambda e: e.transform_up(replace))
+            return new_node.copy(child=joined)
+
+        return plan.transform_up(rule)
 
 
 class RewriteCorrelatedScalarSubquery(Rule):

@@ -103,3 +103,29 @@ def test_not_in_null_aware(spark):
     out = q(spark, "SELECT x FROM na_outer "
                    "WHERE x IN (SELECT y FROM na_inner)")
     assert out["x"] == [2]
+
+
+def test_existence_subquery_in_select(spark):
+    import pyarrow as pa
+
+    spark.createDataFrame(pa.table({"cid": ["a", "b", "c"]})) \
+        .createOrReplaceTempView("ex_cust")
+    spark.createDataFrame(pa.table({
+        "cust": ["a", "a", "b"], "amt": [5, 7, 3]})) \
+        .createOrReplaceTempView("ex_ords")
+    out = q(spark, """SELECT cid, cid IN (SELECT cust FROM ex_ords) AS has
+                      FROM ex_cust ORDER BY cid""")
+    assert out["has"] == [True, True, False]
+    out = q(spark, """SELECT cid,
+                EXISTS(SELECT 1 FROM ex_ords WHERE cust = cid) AS e
+                      FROM ex_cust ORDER BY cid""")
+    assert out["e"] == [True, True, False]
+    out = q(spark, """SELECT cid,
+                cid NOT IN (SELECT cust FROM ex_ords) AS miss
+                      FROM ex_cust ORDER BY cid""")
+    assert out["miss"] == [False, False, True]
+    # uncorrelated EXISTS broadcasts one flag
+    out = q(spark, """SELECT cid,
+                EXISTS(SELECT 1 FROM ex_ords WHERE amt > 6) AS big
+                      FROM ex_cust ORDER BY cid""")
+    assert out["big"] == [True, True, True]
