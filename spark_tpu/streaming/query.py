@@ -239,9 +239,17 @@ class StreamingQuery:
                 # first appearance
                 return self._execute_stateful(optimized, aggs[0],
                                               dedup_append=True)
+            if self.watermark is not None and self.watermark[0] in {
+                    getattr(g, "name", None)
+                    for g in aggs[0].grouping_exprs}:
+                # watermark-gated finalization (reference:
+                # StatefulAggregationStrategy append mode): emit a group
+                # only once the watermark passes its event-time key
+                return self._execute_stateful(optimized, aggs[0],
+                                              append_watermark=True)
             raise AnalysisException(
                 "append mode on aggregated streams requires a watermark on "
-                "the grouping keys (not yet supported) — use complete/update")
+                "the grouping keys — use complete/update")
         return self._execute_stateful(optimized, aggs[0])
 
     @staticmethod
@@ -258,7 +266,8 @@ class StreamingQuery:
 
     def _execute_stateful(self, optimized: LogicalPlan,
                           agg: Aggregate,
-                          dedup_append: bool = False) -> pa.Table:
+                          dedup_append: bool = False,
+                          append_watermark: bool = False) -> pa.Table:
         from ..physical.operators import (
             HashAggregateExec, LocalTableScanExec, UnionExec,
         )
@@ -306,6 +315,16 @@ class StreamingQuery:
         state_table = pa.concat_tables(
             [b.to_arrow() for b in state_batches],
             promote_options="permissive") if state_batches else None
+        if append_watermark and state_table is not None:
+            from ..physical.operators import LocalTableScanExec as _LTS
+
+            finalized, retained = self._split_watermark(state_table)
+            self.state.commit(self.batch_id + 1, retained)
+            out_exec = finish.copy(child=_LTS(list(buffer_attrs), finalized))
+            out_parts = out_exec.execute(ctx)
+            out_batches = [b for p in out_parts for b in p]
+            return pa.concat_tables([b.to_arrow() for b in out_batches],
+                                    promote_options="permissive")
         if state_table is not None:
             state_table = self._evict(state_table, buffer_attrs)
             self.state.commit(self.batch_id + 1, state_table)
@@ -339,6 +358,37 @@ class StreamingQuery:
                 mask = [c in new_keys and c not in old_keys for c in cols]
                 out = out.filter(pa.array(mask)) if cols else out
         return out
+
+    def _split_watermark(self, state_table: pa.Table):
+        """(finalized, retained) split of the merged state by the current
+        watermark: groups whose event-time key fell behind it emit once
+        and leave the state."""
+        col, _delay = self.watermark
+        wm = self._advance_watermark(state_table.column(col))
+        if wm is None:
+            return state_table.slice(0, 0), state_table
+        done = [v is not None and _to_us(v) < wm
+                for v in state_table.column(col).to_pylist()]
+        mask = pa.array(done)
+        import pyarrow.compute as pc
+
+        return state_table.filter(mask), state_table.filter(pc.invert(mask))
+
+    def _advance_watermark(self, vals) -> int | None:
+        _col, delay_s = self.watermark
+        try:
+            import pyarrow.compute as pc
+
+            mx = pc.max(vals).as_py()
+        except Exception:
+            return self.current_watermark_us
+        if mx is None:
+            return self.current_watermark_us
+        wm = _to_us(mx) - int(delay_s * 1e6)
+        if self.current_watermark_us is not None:
+            wm = max(wm, self.current_watermark_us)
+        self.current_watermark_us = wm
+        return wm
 
     def _evict(self, state_table: pa.Table, buffer_attrs) -> pa.Table:
         """Watermark-based state eviction when a grouping key is the
@@ -406,7 +456,9 @@ def _to_us(v) -> int:
         return int(v.timestamp() * 1e6)
     if isinstance(v, datetime.date):
         return int(time.mktime(v.timetuple()) * 1e6)
-    return int(v)
+    # numeric event-time columns are interpreted as SECONDS, matching the
+    # seconds-denominated watermark delay
+    return int(v * 1e6)
 
 
 # ---------------------------------------------------------------------------

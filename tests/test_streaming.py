@@ -230,3 +230,30 @@ def test_streaming_distinct_append(spark):
         assert sorted(out["x"]) == [1, 2, 3]
     finally:
         q.stop()
+
+
+def test_append_mode_watermark_aggregate(spark):
+    src, df = spark.memory_stream(pa.schema([("t", pa.int64()),
+                                             ("v", pa.int64())]))
+    q = (df.withWatermark("t", "2 seconds")
+           .groupBy("t").agg(F.sum("v").alias("s"))
+           .writeStream.format("memory").queryName("s_wm_app")
+           .outputMode("append").start())
+    try:
+        src.add_data({"t": [1, 1, 2], "v": [10, 20, 5]})
+        q.processAllAvailable()
+        # watermark = 2-2 = 0 → nothing finalized yet
+        out = _sink_rows(spark, "s_wm_app")
+        assert out["t"] == []
+        src.add_data({"t": [5, 1], "v": [7, 100]})
+        q.processAllAvailable()
+        # watermark = 5-2 = 3 → groups t=1 (incl. late row), t=2 finalize
+        out = _sink_rows(spark, "s_wm_app")
+        assert dict(zip(out["t"], out["s"])) == {1: 130, 2: 5}
+        src.add_data({"t": [9], "v": [1]})
+        q.processAllAvailable()
+        # watermark = 7 → t=5 finalizes; t=1/2 already emitted, not again
+        out = _sink_rows(spark, "s_wm_app")
+        assert dict(zip(out["t"], out["s"])) == {1: 130, 2: 5, 5: 7}
+    finally:
+        q.stop()
