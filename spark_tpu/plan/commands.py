@@ -69,6 +69,50 @@ class InsertIntoCommand(Command):
     overwrite: bool = False
 
 
+@dataclass
+class UpdateCommand(Command):
+    """UPDATE t SET c = e, ... [WHERE cond] (reference: v2 DML,
+    sqlcat/plans/logical/v2Commands.scala UpdateTable) — executed
+    set-based: one projection `IF(cond, new, old)` per column, then the
+    target table is rewritten."""
+
+    name: str
+    assignments: list  # [(column_name, Expression)]
+    condition: object = None
+
+
+@dataclass
+class DeleteCommand(Command):
+    """DELETE FROM t [WHERE cond] (reference: DeleteFromTable)."""
+
+    name: str
+    condition: object = None
+
+
+@dataclass
+class MergeClause:
+    kind: str                 # "update" | "delete" | "insert"
+    extra: object = None      # additional AND condition
+    assignments: list = field(default_factory=list)
+    insert_cols: list = field(default_factory=list)
+    insert_vals: list = field(default_factory=list)
+    insert_star: bool = False
+
+
+@dataclass
+class MergeCommand(Command):
+    """MERGE INTO target USING source ON cond WHEN ... (reference:
+    MergeIntoTable). Set-based: matched rows rewrite via a left_outer
+    join against the source, unmatched source rows insert via left_anti."""
+
+    name: str
+    target: LogicalPlan
+    source: LogicalPlan
+    condition: object
+    matched: list          # [MergeClause] kind update/delete
+    not_matched: list      # [MergeClause] kind insert
+
+
 def run_command(session, cmd: Command):
     """Execute a command; returns a DataFrame of result rows."""
     import pyarrow as pa
@@ -141,6 +185,9 @@ def run_command(session, cmd: Command):
             cmd.name, LocalRelation(list(existing.attrs), merged))
         return df_of(pa.table({"result": pa.array([], pa.string())}))
 
+    if isinstance(cmd, (UpdateCommand, DeleteCommand, MergeCommand)):
+        return _run_dml(session, cmd, df_of)
+
     if isinstance(cmd, ShowTablesCommand):
         names = session.catalog_.list_tables()
         return df_of(pa.table({
@@ -206,3 +253,147 @@ def run_command(session, cmd: Command):
         }))
 
     raise AnalysisException(f"unknown command {type(cmd).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# DML execution (UPDATE / DELETE / MERGE) — set-based table rewrites
+# ---------------------------------------------------------------------------
+
+def _write_target(session, name: str, new_tbl):
+    """Replace a warehouse table or registered temp relation in place."""
+    from ..errors import AnalysisException
+    from .logical import LocalRelation
+
+    wh = session.catalog_.external
+    if wh is not None and name in wh.list_tables():
+        target = wh.lookup(name)
+        names = [a.name for a in target.output]
+        wh.save_table(name, new_tbl.rename_columns(names), mode="overwrite")
+        return
+    existing = session.catalog_.lookup(name.split("."))
+    if not isinstance(existing, LocalRelation):
+        raise AnalysisException(
+            f"DML requires a saved table or materialized view: {name}")
+    new_tbl = new_tbl.rename_columns(existing.table.column_names)
+    session.catalog_.register(
+        name, LocalRelation(list(existing.attrs), new_tbl))
+
+
+def _run_dml(session, cmd, df_of):
+    import pyarrow as pa
+
+    from ..api.dataframe import DataFrame
+    from ..expr.expressions import (
+        Alias, And, Cast, EqualNullSafe, If, IsNotNull, IsNull, Literal,
+        Not, Or, UnresolvedAttribute, UnresolvedStar,
+    )
+    from .logical import (
+        Filter, Join, Project, SubqueryAlias, UnresolvedRelation,
+    )
+
+    def empty_result():
+        return df_of(pa.table({"result": pa.array([], pa.string())}))
+
+    if isinstance(cmd, DeleteCommand):
+        rel = UnresolvedRelation(cmd.name.split("."))
+        if cmd.condition is None:
+            plan = Filter(Literal(False), rel)
+        else:
+            # keep rows where the predicate is false OR unknown
+            plan = Filter(Or(Not(cmd.condition), IsNull(cmd.condition)), rel)
+        _write_target(session, cmd.name, DataFrame(session, plan).toArrow())
+        return empty_result()
+
+    if isinstance(cmd, UpdateCommand):
+        rel = UnresolvedRelation(cmd.name.split("."))
+        attrs = DataFrame(session, rel).query_execution.analyzed.output
+        amap = {n.lower(): e for n, e in cmd.assignments}
+        proj = []
+        for a in attrs:
+            old = UnresolvedAttribute([a.name])
+            if a.name.lower() in amap:
+                newe = amap[a.name.lower()]
+                e = newe if cmd.condition is None \
+                    else If(cmd.condition, newe, old)
+                proj.append(Alias(Cast(e, a.dtype), a.name))
+            else:
+                proj.append(Alias(old, a.name))
+        new_tbl = DataFrame(session, Project(proj, rel)).toArrow()
+        _write_target(session, cmd.name, new_tbl)
+        return empty_result()
+
+    # ---- MERGE -----------------------------------------------------------
+    talias = cmd.target.alias
+    target_attrs = DataFrame(session,
+                             cmd.target).query_execution.analyzed.output
+
+    matched_ref = IsNotNull(UnresolvedAttribute(["__merge_m"]))
+
+    def base_cond(cl, matched_flag):
+        c = matched_flag
+        if cl.extra is not None:
+            c = And(c, EqualNullSafe(cl.extra, Literal(True)))
+        return c
+
+    def effective(clauses, matched_flag):
+        """First-match-wins: clause i fires iff its condition holds AND no
+        earlier clause's does."""
+        eff, prior = [], None
+        for cl in clauses:
+            c = base_cond(cl, matched_flag)
+            if prior is not None:
+                c = And(c, Not(prior))
+            eff.append(c)
+            prior = c if prior is None else Or(prior, c)
+        return eff
+
+    # matched side: target LEFT OUTER source(+flag)
+    src_flag = Project([UnresolvedStar(None),
+                        Alias(Literal(True), "__merge_m")], cmd.source)
+    joined = Join(cmd.target, src_flag, "left_outer", cmd.condition)
+    eff = effective(cmd.matched, matched_ref)
+    del_cond = None
+    for cl, c in zip(cmd.matched, eff):
+        if cl.kind == "delete":
+            del_cond = c if del_cond is None else Or(del_cond, c)
+    base = joined if del_cond is None else \
+        Filter(Or(Not(del_cond), IsNull(del_cond)), joined)
+    proj = []
+    for a in target_attrs:
+        old = UnresolvedAttribute([talias, a.name])
+        e = old
+        for cl, c in reversed(list(zip(cmd.matched, eff))):
+            if cl.kind != "update":
+                continue
+            am = {n.lower(): x for n, x in cl.assignments}
+            if a.name.lower() in am:
+                e = If(c, am[a.name.lower()], e)
+        proj.append(Alias(Cast(e, a.dtype), a.name))
+    tables = [DataFrame(session, Project(proj, base)).toArrow()]
+
+    # not-matched side: source LEFT ANTI target → inserts
+    if cmd.not_matched:
+        anti = Join(cmd.source, cmd.target, "left_anti", cmd.condition)
+        src_attrs = DataFrame(session,
+                              cmd.source).query_execution.analyzed.output
+        ins_eff = effective(cmd.not_matched, Literal(True))
+        for cl, c in zip(cmd.not_matched, ins_eff):
+            branch = anti if (cl.extra is None and len(cmd.not_matched) == 1) \
+                else Filter(c, anti)
+            if cl.insert_star:
+                proj_i = [Alias(Cast(UnresolvedAttribute([s.name]), a.dtype),
+                                a.name)
+                          for s, a in zip(src_attrs, target_attrs)]
+            else:
+                cmap = {n.lower(): v for n, v in zip(cl.insert_cols,
+                                                     cl.insert_vals)}
+                proj_i = [Alias(Cast(cmap.get(a.name.lower(),
+                                              Literal(None)), a.dtype),
+                                a.name)
+                          for a in target_attrs]
+            tables.append(
+                DataFrame(session, Project(proj_i, branch)).toArrow())
+
+    new_tbl = pa.concat_tables(tables, promote_options="permissive")
+    _write_target(session, cmd.name, new_tbl)
+    return df_of(pa.table({"result": pa.array([], pa.string())}))

@@ -23,6 +23,7 @@ KEYWORDS = {
     "escape", "div", "over", "partition", "rows", "range", "unbounded",
     "preceding", "following", "current", "row", "intersect", "minus",
     "rollup", "cube", "grouping", "except",
+    "update", "delete", "merge", "matched", "set",
 }
 
 

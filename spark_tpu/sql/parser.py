@@ -150,6 +150,21 @@ class Parser:
             name = self._qualified_name()
             q = self.parse_query()
             return C.InsertIntoCommand(name, q, overwrite)
+        if self.eat_kw("update"):
+            name = self._qualified_name()
+            self.expect_kw("set")
+            assigns = [self._parse_assignment()]
+            while self.eat_op(","):
+                assigns.append(self._parse_assignment())
+            cond = self.parse_expr() if self.eat_kw("where") else None
+            return C.UpdateCommand(name, assigns, cond)
+        if self.eat_kw("delete"):
+            self.expect_kw("from")
+            name = self._qualified_name()
+            cond = self.parse_expr() if self.eat_kw("where") else None
+            return C.DeleteCommand(name, cond)
+        if self.eat_kw("merge"):
+            return self._parse_merge()
         if self.eat_kw("show"):
             self.expect_kw("tables")
             return C.ShowTablesCommand()
@@ -185,6 +200,65 @@ class Parser:
             return C.SetCommand(key, value)
         raise ParseException(
             f"unsupported statement near {self.peek().value!r}")
+
+    def _parse_assignment(self):
+        parts = [self.ident()]
+        while self.eat_op("."):
+            parts.append(self.ident())
+        self.expect_op("=")
+        return (parts[-1], self.parse_expr())
+
+    def _parse_merge(self):
+        from ..plan import commands as C
+
+        self.expect_kw("into")
+        name = self._qualified_name()
+        talias = self._maybe_alias() or name.split(".")[-1]
+        target = L.SubqueryAlias(talias,
+                                 L.UnresolvedRelation(name.split(".")))
+        self.expect_kw("using")
+        source = self.parse_relation_primary()
+        self.expect_kw("on")
+        cond = self.parse_expr()
+        matched, not_matched = [], []
+        while self.eat_kw("when"):
+            neg = self.eat_kw("not")
+            self.expect_kw("matched")
+            extra = self.parse_expr() if self.eat_kw("and") else None
+            self.expect_kw("then")
+            if neg:
+                self.expect_kw("insert")
+                if self.at_op("*"):
+                    self.next()
+                    not_matched.append(C.MergeClause(
+                        "insert", extra, insert_star=True))
+                else:
+                    self.expect_op("(")
+                    cols = [self.ident()]
+                    while self.eat_op(","):
+                        cols.append(self.ident())
+                    self.expect_op(")")
+                    self.expect_kw("values")
+                    self.expect_op("(")
+                    vals = [self.parse_expr()]
+                    while self.eat_op(","):
+                        vals.append(self.parse_expr())
+                    self.expect_op(")")
+                    not_matched.append(C.MergeClause(
+                        "insert", extra, insert_cols=cols,
+                        insert_vals=vals))
+            elif self.eat_kw("delete"):
+                matched.append(C.MergeClause("delete", extra))
+            else:
+                self.expect_kw("update")
+                self.expect_kw("set")
+                assigns = [self._parse_assignment()]
+                while self.eat_op(","):
+                    assigns.append(self._parse_assignment())
+                matched.append(C.MergeClause("update", extra,
+                                             assignments=assigns))
+        return C.MergeCommand(name, target, source, cond, matched,
+                              not_matched)
 
     def _qualified_name(self) -> str:
         parts = [self.ident()]

@@ -178,3 +178,67 @@ def test_cache_fragment_substitution(spark):
     plan2 = q2.query_execution.with_cached_data
     assert not any(isinstance(n, LocalRelation) and n.table.num_rows == 49
                    for n in plan2.iter_nodes())
+
+
+def _dml_table(spark):
+    import pyarrow as pa
+
+    spark.createDataFrame(pa.table({
+        "id": [1, 2, 3], "name": ["a", "b", "c"],
+        "amt": [10, 20, 30]})).createOrReplaceTempView("dml_t")
+
+
+def test_update_statement(spark):
+    _dml_table(spark)
+    spark.sql("UPDATE dml_t SET amt = amt + 100 WHERE id >= 2")
+    out = spark.sql("SELECT amt FROM dml_t ORDER BY id").toArrow().to_pydict()
+    assert out["amt"] == [10, 120, 130]
+    spark.sql("UPDATE dml_t SET amt = 0")  # no WHERE = all rows
+    out = spark.sql("SELECT amt FROM dml_t").toArrow().to_pydict()
+    assert out["amt"] == [0, 0, 0]
+
+
+def test_delete_statement(spark):
+    _dml_table(spark)
+    spark.sql("DELETE FROM dml_t WHERE id = 1")
+    out = spark.sql("SELECT id FROM dml_t ORDER BY id").toArrow().to_pydict()
+    assert out["id"] == [2, 3]
+    spark.sql("DELETE FROM dml_t")
+    out = spark.sql("SELECT id FROM dml_t").toArrow().to_pydict()
+    assert out["id"] == []
+
+
+def test_merge_statement(spark):
+    import pyarrow as pa
+
+    _dml_table(spark)
+    spark.createDataFrame(pa.table({
+        "id": [2, 3, 4], "v": [999, -1, 40]})) \
+        .createOrReplaceTempView("dml_src")
+    spark.sql("""
+        MERGE INTO dml_t AS t USING dml_src AS u ON t.id = u.id
+        WHEN MATCHED AND u.v < 0 THEN DELETE
+        WHEN MATCHED THEN UPDATE SET amt = u.v
+        WHEN NOT MATCHED THEN INSERT (id, amt) VALUES (u.id, u.v)""")
+    out = spark.sql("SELECT id, name, amt FROM dml_t ORDER BY id") \
+        .toArrow().to_pydict()
+    # id=1 untouched, id=2 updated, id=3 deleted, id=4 inserted
+    assert out["id"] == [1, 2, 4]
+    assert out["amt"] == [10, 999, 40]
+    assert out["name"] == ["a", "b", None]
+
+
+def test_merge_insert_star(spark):
+    import pyarrow as pa
+
+    spark.createDataFrame(pa.table({"k": [1], "v": [5]})) \
+        .createOrReplaceTempView("ms_t")
+    spark.createDataFrame(pa.table({"k": [1, 2], "v": [50, 20]})) \
+        .createOrReplaceTempView("ms_s")
+    spark.sql("""
+        MERGE INTO ms_t USING ms_s ON ms_t.k = ms_s.k
+        WHEN MATCHED THEN UPDATE SET v = ms_s.v
+        WHEN NOT MATCHED THEN INSERT *""")
+    out = spark.sql("SELECT k, v FROM ms_t ORDER BY k").toArrow().to_pydict()
+    assert out["k"] == [1, 2]
+    assert out["v"] == [50, 20]
