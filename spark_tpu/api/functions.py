@@ -290,6 +290,37 @@ def array_agg(c) -> Column:
     return Column(E.CollectList(_c(c)))
 
 
+def size(c) -> Column:
+    return Column(E.Size(_c(c)))
+
+
+def array_contains(c, value) -> Column:
+    return Column(E.ArrayContains(_c(c), E.Literal(value)))
+
+
+def array_min(c) -> Column:
+    return Column(E.ArrayMin(_c(c)))
+
+
+def array_max(c) -> Column:
+    return Column(E.ArrayMax(_c(c)))
+
+
+def sort_array(c, asc: bool = True) -> Column:
+    return Column(E.SortArray(_c(c), E.Literal(asc)))
+
+
+def array_distinct(c) -> Column:
+    return Column(E.ArrayDistinct(_c(c)))
+
+
+def element_at(c, idx: int) -> Column:
+    # element_at dispatches on the (resolved) element type; defer via
+    # UnresolvedFunction so the analyzer builds it post-resolution
+    return Column(E.UnresolvedFunction(
+        "element_at", [_c(c), E.Literal(idx)]))
+
+
 def regexp_extract(c, pattern: str, idx: int = 1) -> Column:
     return Column(E.RegexpExtract(_c(c), E.Literal(pattern), E.Literal(idx)))
 

@@ -1839,26 +1839,25 @@ class DateFormat(Expression):
             "RewriteHostOnlyExpressions)")
 
 
-class Split(UnaryExpression):
-    """string → array<string> by a regex delimiter. Only valid under a
-    generator (explode) — arrays have no device representation; the
-    Generate operator expands rows host-side over dictionary values."""
+class Split(_DictTransform):
+    """string → array<string> by a regex delimiter: one regex run per
+    DICTIONARY value, producing a list-valued dictionary (see ArrayType).
+    Under explode(), GenerateExec uses split_lists directly."""
 
     def __init__(self, child: Expression, delim: Expression):
         super().__init__(child)
         self.delim = str(delim.value)
+        self._rx = re.compile(self.delim)
 
     @property
     def dtype(self):
         return ArrayType(string)
 
     def split_lists(self, values: list[str]) -> list[list[str]]:
-        rx = re.compile(self.delim)
-        return [[p for p in rx.split(v)] for v in values]
+        return [[p for p in self._rx.split(v)] for v in values]
 
-    def eval(self, ctx):
-        raise UnsupportedOperationError(
-            "split() is only supported under explode()")
+    def transform(self, s):
+        return self._rx.split(s)
 
 
 class Grouping(UnaryExpression):
@@ -2019,6 +2018,156 @@ class Translate(_DictTransform):
 
     def transform(self, s):
         return s.translate(self.table)
+
+
+class _ArrayLut(Expression):
+    """Array function computed ONCE PER DICTIONARY ENTRY into value +
+    validity lookup tables; device codes gather through them (arrays are
+    dictionary-encoded — see ArrayType). Reference:
+    sqlcat/expressions/collectionOperations.scala."""
+
+    child_fields = ("child",)
+
+    def __init__(self, child: Expression):
+        self.child = child
+
+    def value_of(self, lst):
+        """→ (value, is_valid) for one dictionary list."""
+        raise NotImplementedError
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        jnp = _jnp()
+        dd = self.dtype.device_dtype
+
+        def vals_lut():
+            sd = c.sdict or StringDict([[]])
+            vs = sd.values or [[]]
+            out = np.zeros(len(vs), dd)
+            for i, v in enumerate(vs):
+                val, ok = self.value_of(v)
+                out[i] = val if ok else 0
+            return out
+
+        def has_lut():
+            sd = c.sdict or StringDict([[]])
+            return np.array([self.value_of(v)[1]
+                             for v in (sd.values or [[]])], bool)
+
+        if not ctx.is_trace:
+            ctx.aux(vals_lut)
+            ctx.aux(has_lut)
+            return Val(self.dtype, None, True, None)
+        vl = ctx.aux(None)
+        hl = ctx.aux(None)
+        codes = jnp.clip(c.data, 0, vl.shape[0] - 1)
+        data = jnp.take(vl, codes)
+        has = jnp.take(hl, codes)
+        validity = has if c.validity is None else (c.validity & has)
+        return Val(self.dtype, data, validity, None)
+
+
+class Size(_ArrayLut):
+    @property
+    def dtype(self):
+        return int32
+
+    def value_of(self, lst):
+        return len(lst), True
+
+
+class ArrayContains(_ArrayLut):
+    def __init__(self, child: Expression, value: Expression):
+        super().__init__(child)
+        self.value = value.value  # literal
+
+    @property
+    def dtype(self):
+        return boolean
+
+    def value_of(self, lst):
+        return (self.value in lst), True
+
+
+class ArrayMin(_ArrayLut):
+    @property
+    def dtype(self):
+        ct = self.child.dtype
+        return ct.element_type if isinstance(ct, ArrayType) else ct
+
+    def value_of(self, lst):
+        vals = [v for v in lst if v is not None]
+        return (min(vals), True) if vals else (0, False)
+
+
+class ArrayMax(ArrayMin):
+    def value_of(self, lst):
+        vals = [v for v in lst if v is not None]
+        return (max(vals), True) if vals else (0, False)
+
+
+class ElementAt(_ArrayLut):
+    """element_at(arr, i) — 1-based, negative from the end; numeric
+    elements gather through a LUT, string elements go through a
+    dictionary transform (see build_element_at)."""
+
+    def __init__(self, child: Expression, idx: Expression):
+        super().__init__(child)
+        self.idx = int(idx.value)
+
+    @property
+    def dtype(self):
+        ct = self.child.dtype
+        return ct.element_type if isinstance(ct, ArrayType) else ct
+
+    def value_of(self, lst):
+        i = self.idx - 1 if self.idx > 0 else len(lst) + self.idx
+        if 0 <= i < len(lst) and lst[i] is not None:
+            return lst[i], True
+        return 0, False
+
+
+class _ArrayDictTransform(_DictTransform):
+    """list → list function over dictionary values (codes unchanged)."""
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
+class SortArray(_ArrayDictTransform):
+    def __init__(self, child: Expression, asc: Expression | None = None):
+        super().__init__(child)
+        self.asc = True if asc is None else bool(asc.value)
+
+    def transform(self, lst):
+        return sorted(lst, reverse=not self.asc)
+
+
+class ArrayDistinct(_ArrayDictTransform):
+    def transform(self, lst):
+        return list(dict.fromkeys(lst))
+
+
+class ElementAtString(_DictTransform):
+    """element_at over array<string>: the element IS the new dictionary
+    value ('' for out-of-bounds — the reference returns NULL there)."""
+
+    def __init__(self, child: Expression, idx: Expression):
+        super().__init__(child)
+        self.idx = int(idx.value)
+
+    def transform(self, lst):
+        i = self.idx - 1 if self.idx > 0 else len(lst) + self.idx
+        v = lst[i] if 0 <= i < len(lst) else ""
+        return "" if v is None else v
+
+
+def build_element_at(child: Expression, idx: Expression) -> Expression:
+    ct = child.dtype
+    if isinstance(ct, ArrayType) and isinstance(ct.element_type, StringType):
+        return ElementAtString(child, idx)
+    return ElementAt(child, idx)
 
 
 class _StringIntLut(Expression):

@@ -168,6 +168,15 @@ def _reg_all() -> None:
     r("substring_index", lambda c, d, n: E.SubstringIndex(c, d, n))
     r("regexp_extract", lambda c, p, i=None: E.RegexpExtract(c, p, i))
     r("regexp_replace", lambda c, p, rp: E.RegexpReplace(c, p, rp))
+    # arrays (dictionary-encoded; see ArrayType)
+    r("size", lambda c: E.Size(c))
+    r("cardinality", lambda c: E.Size(c))
+    r("array_contains", lambda c, v: E.ArrayContains(c, v))
+    r("array_min", lambda c: E.ArrayMin(c))
+    r("array_max", lambda c: E.ArrayMax(c))
+    r("sort_array", lambda c, asc=None: E.SortArray(c, asc))
+    r("array_distinct", lambda c: E.ArrayDistinct(c))
+    r("element_at", lambda c, i: E.build_element_at(c, i))
     r("translate", lambda c, m, rep: E.Translate(c, m, rep))
     r("ascii", lambda c: E.Ascii(c))
     r("instr", lambda c, s: E.Instr(c, s))

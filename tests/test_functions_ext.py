@@ -294,3 +294,40 @@ def test_array_agg_alias(spark):
         .createOrReplaceTempView("aa")
     out = q(spark, "SELECT array_agg(v) AS a FROM aa")
     assert out["a"] == [[1, 2]]
+
+
+def test_array_functions(spark):
+    spark.createDataFrame(pa.table({
+        "k": ["a", "a", "b", "b", "c"],
+        "v": [3, 1, 2, 2, 7],
+    })).createOrReplaceTempView("arr_src")
+    spark.sql("""CREATE OR REPLACE TEMP VIEW arrs AS
+                 SELECT k, collect_list(v) AS l FROM arr_src GROUP BY k""")
+    out = q(spark, """
+        SELECT k, size(l) AS n, array_contains(l, 2) AS has2,
+               array_min(l) AS lo, array_max(l) AS hi,
+               sort_array(l) AS srt, array_distinct(l) AS dst,
+               element_at(l, 1) AS first_e, element_at(l, -1) AS last_e
+        FROM arrs ORDER BY k""")
+    assert out["n"] == [2, 2, 1]
+    assert out["has2"] == [False, True, False]
+    assert out["lo"] == [1, 2, 7]
+    assert out["hi"] == [3, 2, 7]
+    assert out["srt"] == [[1, 3], [2, 2], [7]]
+    assert out["dst"] == [[3, 1], [2], [7]]
+    assert out["first_e"] == [3, 2, 7]
+    assert out["last_e"] == [1, 2, 7]
+
+
+def test_array_functions_strings(spark):
+    spark.createDataFrame(pa.table({"s": ["b a c", "z"]})) \
+        .createOrReplaceTempView("arrstr_src")
+    spark.sql("""CREATE OR REPLACE TEMP VIEW arrstr AS
+                 SELECT s, split(s, ' ') AS parts FROM arrstr_src""")
+    out = q(spark, """
+        SELECT size(parts) AS n, element_at(parts, 2) AS e2,
+               sort_array(parts) AS srt
+        FROM arrstr ORDER BY s""")
+    assert out["n"] == [3, 1]
+    assert out["e2"] == ["a", ""]   # '' for out-of-bounds (ref: NULL)
+    assert out["srt"] == [["a", "b", "c"], ["z"]]
