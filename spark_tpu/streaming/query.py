@@ -127,18 +127,29 @@ class StreamingQuery:
         self.watermark = watermark  # (column, delay_seconds)
         self.current_watermark_us: int | None = None
 
-        # locate the streaming source (exactly one supported)
-        leaves = [n for n in plan.iter_nodes()
-                  if isinstance(n, StreamingRelation)]
-        if len(leaves) != 1:
+        # locate the streaming sources (1, or 2 for stream-stream joins)
+        leaves = []
+        for n in plan.iter_nodes():
+            if isinstance(n, StreamingRelation) and \
+                    not any(n is x for x in leaves):
+                leaves.append(n)
+        if len(leaves) not in (1, 2):
             raise UnsupportedOperationError(
-                "exactly one streaming source per query is supported")
+                "at most two streaming sources per query are supported")
+        self.stream_leaves = leaves
         self.stream_leaf = leaves[0]
         self.source: StreamSource = leaves[0].source
 
         self.checkpoint_dir = checkpoint_dir
         self.state = StateStore(checkpoint_dir)
-        self.committed_offset = self.source.initial_offset()
+        if len(leaves) == 2:
+            self._validate_stream_join(plan, leaves)
+            self._join_state = [StateStore(checkpoint_dir, "state_left"),
+                                StateStore(checkpoint_dir, "state_right")]
+            self.committed_offset = [l.source.initial_offset()
+                                     for l in leaves]
+        else:
+            self.committed_offset = self.source.initial_offset()
         if checkpoint_dir:
             os.makedirs(os.path.join(checkpoint_dir, "offsets"), exist_ok=True)
             os.makedirs(os.path.join(checkpoint_dir, "commits"), exist_ok=True)
@@ -159,6 +170,9 @@ class StreamingQuery:
             self.committed_offset = json.load(f)["offset"]
         self.batch_id = last
         self.state.load(last)
+        if len(self.stream_leaves) == 2:
+            for st in self._join_state:
+                st.load(last)
 
     # --- trigger loop ------------------------------------------------------
     def _run(self) -> None:
@@ -177,7 +191,97 @@ class StreamingQuery:
         finally:
             self._active = False
 
+    @staticmethod
+    def _validate_stream_join(plan: LogicalPlan, leaves) -> None:
+        """The delta decomposition below is only valid when the two
+        streams meet at a JOIN (the plan is bilinear in the leaves)."""
+        from ..plan.logical import Join as LJoin
+
+        def contains(node, leaf):
+            return any(x is leaf for x in node.iter_nodes())
+
+        for n in plan.iter_nodes():
+            if isinstance(n, LJoin):
+                lhas = [contains(n.left, l) for l in leaves]
+                rhas = [contains(n.right, l) for l in leaves]
+                if (lhas[0] and rhas[1] and not lhas[1] and not rhas[0]) or \
+                        (lhas[1] and rhas[0] and not lhas[0] and not rhas[1]):
+                    if n.join_type not in ("inner", "cross"):
+                        raise UnsupportedOperationError(
+                            "only INNER stream-stream joins are supported")
+                    return
+        raise UnsupportedOperationError(
+            "two streaming sources must meet at a join")
+
+    def _run_one_batch_join(self) -> bool:
+        latest = [l.source.latest_offset() for l in self.stream_leaves]
+        if latest == self.committed_offset:
+            return False
+        t0 = time.perf_counter()
+        batch_id = self.batch_id + 1
+        new_datas = [l.source.get_batch(c, lt)
+                     for l, c, lt in zip(self.stream_leaves,
+                                         self.committed_offset, latest)]
+        if self.checkpoint_dir:
+            with open(os.path.join(self.checkpoint_dir, "offsets",
+                                   str(batch_id)), "w") as f:
+                json.dump({"offset": [_json_safe(x) for x in latest]}, f)
+        out_table = self._execute_join_batch(new_datas, batch_id)
+        self.sink.add_batch(batch_id, out_table, self.output_mode)
+        if self.checkpoint_dir:
+            with open(os.path.join(self.checkpoint_dir, "commits",
+                                   str(batch_id)), "w") as f:
+                json.dump({"batch": batch_id}, f)
+        self.batch_id = batch_id
+        self.committed_offset = latest
+        self.recent_progress.append({
+            "batchId": batch_id,
+            "numInputRows": sum(t.num_rows for t in new_datas),
+            "durationMs": int((time.perf_counter() - t0) * 1000),
+        })
+        del self.recent_progress[:-32]
+        return True
+
+    def _execute_join_batch(self, new_datas, batch_id: int) -> pa.Table:
+        """Incremental inner join (reference: StreamingSymmetricHashJoinExec):
+        joined(old∪new, old∪new) − joined(old, old) computed as two delta
+        runs — newL ⋈ (oldR∪newR), then oldL ⋈ newR — so nothing emits
+        twice. State = the accumulated raw inputs per side."""
+        from ..api.dataframe import DataFrame
+        from ..plan.logical import LocalRelation
+
+        if self.output_mode != "append":
+            raise UnsupportedOperationError(
+                "stream-stream joins support append mode only")
+        lleaf, rleaf = self.stream_leaves
+        old = [st.table if st.table is not None else nd.slice(0, 0)
+               for st, nd in zip(self._join_state, new_datas)]
+        all_r = pa.concat_tables([old[1], new_datas[1]],
+                                 promote_options="permissive")
+
+        def run(ltab, rtab):
+            def sub(node):
+                if node is lleaf:
+                    return LocalRelation(lleaf.attrs, ltab)
+                if node is rleaf:
+                    return LocalRelation(rleaf.attrs, rtab)
+                return node
+
+            return DataFrame(self.session,
+                             self.plan.transform_up(sub)).toArrow()
+
+        parts = [run(new_datas[0], all_r), run(old[0], new_datas[1])]
+        out = pa.concat_tables(parts, promote_options="permissive")
+
+        all_l = pa.concat_tables([old[0], new_datas[0]],
+                                 promote_options="permissive")
+        self._join_state[0].commit(batch_id, all_l)
+        self._join_state[1].commit(batch_id, all_r)
+        return out
+
     def _run_one_batch(self) -> bool:
+        if len(self.stream_leaves) == 2:
+            return self._run_one_batch_join()
         latest = self.source.latest_offset()
         if latest == self.committed_offset:
             return False
@@ -425,7 +529,13 @@ class StreamingQuery:
         while time.time() < deadline:
             if self.exception:
                 raise self.exception
-            if self.source.latest_offset() == self.committed_offset:
+            if len(self.stream_leaves) == 2:
+                caught = [l.source.latest_offset()
+                          for l in self.stream_leaves] == \
+                    self.committed_offset
+            else:
+                caught = self.source.latest_offset() == self.committed_offset
+            if caught:
                 return
             time.sleep(0.01)
         raise TimeoutError("processAllAvailable timed out")

@@ -19,11 +19,12 @@ import pyarrow as pa
 class StateStore:
     """Versioned key→buffer state with optional file persistence."""
 
-    def __init__(self, checkpoint_dir: str | None = None):
+    def __init__(self, checkpoint_dir: str | None = None,
+                 name: str = "state"):
         self.table: pa.Table | None = None
         self.dir = None
         if checkpoint_dir:
-            self.dir = os.path.join(checkpoint_dir, "state")
+            self.dir = os.path.join(checkpoint_dir, name)
             os.makedirs(self.dir, exist_ok=True)
 
     def load(self, version: int) -> None:

@@ -257,3 +257,33 @@ def test_append_mode_watermark_aggregate(spark):
         assert dict(zip(out["t"], out["s"])) == {1: 130, 2: 5, 5: 7}
     finally:
         q.stop()
+
+
+def test_stream_stream_inner_join(spark):
+    src_l, dfl = spark.memory_stream(pa.schema([("k", pa.string()),
+                                                ("lv", pa.int64())]))
+    src_r, dfr = spark.memory_stream(pa.schema([("k2", pa.string()),
+                                                ("rv", pa.int64())]))
+    joined = dfl.join(dfr, dfl["k"] == dfr["k2"], "inner") \
+                .select(dfl["k"], dfl["lv"], dfr["rv"])
+    q = (joined.writeStream.format("memory").queryName("s_ssj")
+         .outputMode("append").start())
+    try:
+        src_l.add_data({"k": ["a", "b"], "lv": [1, 2]})
+        q.processAllAvailable()
+        out = _sink_rows(spark, "s_ssj")
+        assert out["k"] == []        # right side empty so far
+        src_r.add_data({"k2": ["a"], "rv": [10]})
+        q.processAllAvailable()
+        out = _sink_rows(spark, "s_ssj")
+        assert sorted(zip(out["k"], out["lv"], out["rv"])) == \
+            [("a", 1, 10)]
+        # late left row joins BUFFERED right rows; no duplicates
+        src_l.add_data({"k": ["a"], "lv": [3]})
+        src_r.add_data({"k2": ["b"], "rv": [20]})
+        q.processAllAvailable()
+        out = _sink_rows(spark, "s_ssj")
+        assert sorted(zip(out["k"], out["lv"], out["rv"])) == \
+            [("a", 1, 10), ("a", 3, 10), ("b", 2, 20)]
+    finally:
+        q.stop()
