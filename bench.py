@@ -25,9 +25,29 @@ N_ROWS = 10_000_000
 N_KEYS = 1 << 20
 
 
+def _device_init_alive(timeout: float = 120.0) -> bool:
+    """Probe device init in a SUBPROCESS (sequential — never run two jax
+    processes concurrently against the axon tunnel): if the tunnel is
+    wedged, jax.devices() hangs in C and only a kill recovers, so the
+    probe protects the benchmark run itself."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout, capture_output=True)
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main() -> None:
     import jax
 
+    if not _device_init_alive():
+        jax.config.update("jax_platforms", "cpu")
+        print("bench: accelerator init unresponsive; falling back to CPU",
+              file=sys.stderr)
     jax.config.update("jax_enable_x64", True)
 
     import pyarrow as pa
