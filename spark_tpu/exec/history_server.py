@@ -1,0 +1,183 @@
+"""History server: web UI over JSONL event logs.
+
+Role of the reference's HistoryServer + web UI (core/.../history/
+HistoryServer.scala; the SQL tab of ui/). A stdlib http.server renders
+the application list, per-application query table, and per-query detail
+(phases, kernel-cache stats, plan text) from the same JSONL logs
+EventLoggingListener writes — no frameworks, zero dependencies.
+
+Start programmatically:
+    from spark_tpu.exec.history_server import HistoryServer
+    hs = HistoryServer("/tmp/spark-events", port=18080)
+    hs.start()          # background thread
+or from the shell:  python -m spark_tpu.exec.history_server <log_dir>
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from .listener import HistoryReader
+
+_STYLE = """
+body { font-family: sans-serif; margin: 2em; color: #222; }
+table { border-collapse: collapse; margin: 1em 0; }
+th, td { border: 1px solid #ccc; padding: 4px 10px; text-align: left; }
+th { background: #f0f0f0; }
+h1 { font-size: 1.4em; } h2 { font-size: 1.1em; }
+a { color: #1a56a0; text-decoration: none; }
+pre { background: #f8f8f8; padding: 1em; overflow-x: auto; }
+.ok { color: #0a7d20; } .fail { color: #b00020; }
+"""
+
+
+def _page(title: str, body: str) -> bytes:
+    return (f"<!doctype html><html><head><title>{html.escape(title)}"
+            f"</title><style>{_STYLE}</style></head>"
+            f"<body><h1>{html.escape(title)}</h1>{body}</body></html>"
+            ).encode()
+
+
+def _esc(v) -> str:
+    return html.escape(str(v)) if v is not None else ""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    reader: HistoryReader = None  # injected by HistoryServer
+
+    def log_message(self, *a):  # silence per-request stderr noise
+        pass
+
+    def _send(self, body: bytes, ctype="text/html"):
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802  (http.server API)
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        try:
+            if url.path == "/":
+                self._send(self._index())
+            elif url.path == "/app":
+                self._send(self._app(q["id"][0]))
+            elif url.path == "/query":
+                self._send(self._query(q["id"][0], int(q["n"][0])))
+            elif url.path == "/api/applications":
+                apps = [{"id": a, **self.reader.summary(a)}
+                        for a in self.reader.applications()]
+                self._send(json.dumps(apps).encode(), "application/json")
+            else:
+                self.send_error(404)
+        except (KeyError, FileNotFoundError, IndexError, ValueError):
+            self.send_error(404)
+
+    def _index(self) -> bytes:
+        rows = []
+        for a in self.reader.applications():
+            s = self.reader.summary(a)
+            rows.append(
+                f"<tr><td><a href='/app?id={a}'>{_esc(a)}</a></td>"
+                f"<td>{s['queries']}</td><td>{s['failed']}</td>"
+                f"<td>{s['total_duration_ms']:.0f}</td></tr>")
+        body = ("<table><tr><th>Application</th><th>Queries</th>"
+                "<th>Failed</th><th>Total ms</th></tr>"
+                + "".join(rows) + "</table>")
+        return _page("Spark-TPU History Server", body)
+
+    def _app(self, app: str) -> bytes:
+        events = self.reader.load(app)
+        rows = []
+        n = 0
+        for e in events:
+            if e["event"] not in ("querySucceeded", "queryFailed"):
+                continue
+            ok = e["event"] == "querySucceeded"
+            cls = "ok" if ok else "fail"
+            first_plan_line = (e.get("plan") or "").strip().splitlines()
+            desc = first_plan_line[0] if first_plan_line \
+                else e.get("query_id", "")
+            rows.append(
+                f"<tr><td><a href='/query?id={app}&n={n}'>{n}</a></td>"
+                f"<td class='{cls}'>{'OK' if ok else 'FAILED'}</td>"
+                f"<td>{_esc(desc)[:120]}</td>"
+                f"<td>{e.get('duration_ms') or 0:.1f}</td></tr>")
+            n += 1
+        body = (f"<p><a href='/'>&larr; applications</a></p>"
+                "<table><tr><th>#</th><th>Status</th><th>Query</th>"
+                "<th>ms</th></tr>" + "".join(rows) + "</table>")
+        return _page(f"Application {app}", body)
+
+    def _query(self, app: str, n: int) -> bytes:
+        events = self.reader.load(app)
+        finished = [e for e in events
+                    if e["event"] in ("querySucceeded", "queryFailed")]
+        e = finished[n]
+        parts = [f"<p><a href='/app?id={app}'>&larr; queries</a></p>"]
+        dur = e.get("duration_ms")
+        parts.append(f"<p>Status: <b>{_esc(e['event'])}</b>"
+                     + (f" &middot; {dur:.1f} ms" if dur else "") + "</p>")
+        phases = e.get("phases")
+        if phases:
+            parts.append("<h2>Phases</h2><table><tr><th>Phase</th>"
+                         "<th>ms</th></tr>")
+            for k, v in phases.items():  # phase_times are seconds
+                parts.append(f"<tr><td>{_esc(k)}</td>"
+                             f"<td>{float(v) * 1000:.2f}</td></tr>")
+            parts.append("</table>")
+        metrics = e.get("metrics")
+        if metrics:
+            parts.append("<h2>Metrics</h2><table><tr><th>Metric</th>"
+                         "<th>Value</th></tr>")
+            for k, v in metrics.items():
+                parts.append(f"<tr><td>{_esc(k)}</td>"
+                             f"<td>{_esc(v)}</td></tr>")
+            parts.append("</table>")
+        for key in ("plan", "error"):
+            if e.get(key):
+                parts.append(f"<h2>{key.title()}</h2>"
+                             f"<pre>{_esc(e[key])}</pre>")
+        return _page(f"Query {n} — {app}", "".join(parts))
+
+
+class HistoryServer:
+    def __init__(self, log_dir: str, port: int = 18080,
+                 host: str = "127.0.0.1"):
+        self.reader = HistoryReader(log_dir)
+        handler = type("Handler", (_Handler,), {"reader": self.reader})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "HistoryServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="history-server")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def main(argv=None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser(description="Spark-TPU history server")
+    p.add_argument("log_dir")
+    p.add_argument("--port", type=int, default=18080)
+    args = p.parse_args(argv)
+    hs = HistoryServer(args.log_dir, port=args.port)
+    print(f"history server on http://127.0.0.1:{hs.port}/")
+    hs._httpd.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

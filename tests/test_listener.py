@@ -63,3 +63,39 @@ def test_event_log_and_history(spark, tmp_path):
         assert summary["total_duration_ms"] > 0
     finally:
         spark.listener_bus.unregister(el)
+
+
+def test_history_server_ui(tmp_path):
+    import json
+    import urllib.request
+
+    import pyarrow as pa
+
+    from spark_tpu.api.session import TpuSession
+    from spark_tpu.exec.history_server import HistoryServer
+
+    log_dir = str(tmp_path / "events")
+    s = TpuSession("hsui", {"spark.eventLog.enabled": "true",
+                            "spark.eventLog.dir": log_dir})
+    s.createDataFrame(pa.table({"x": [1, 2, 3]})) \
+        .createOrReplaceTempView("hs_t")
+    s.sql("SELECT sum(x) AS s FROM hs_t").collect()
+    s.listener_bus.wait_empty()
+
+    hs = HistoryServer(log_dir, port=0).start()
+    try:
+        base = f"http://127.0.0.1:{hs.port}"
+        apps = json.loads(urllib.request.urlopen(
+            base + "/api/applications", timeout=10).read())
+        assert len(apps) == 1 and apps[0]["queries"] >= 1
+        app_id = apps[0]["id"]
+        index = urllib.request.urlopen(base + "/", timeout=10).read()
+        assert app_id.encode() in index
+        app_page = urllib.request.urlopen(
+            base + f"/app?id={app_id}", timeout=10).read()
+        assert b"OK" in app_page
+        qpage = urllib.request.urlopen(
+            base + f"/query?id={app_id}&n=0", timeout=10).read()
+        assert b"Phases" in qpage and b"HashAggregate" in qpage
+    finally:
+        hs.stop()
