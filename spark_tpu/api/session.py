@@ -188,6 +188,16 @@ class TpuSession:
     def catalog(self):
         return _CatalogApi(self)
 
+    def attachSqlCluster(self, cluster) -> "TpuSession":
+        """Route non-result SQL stages to a process cluster
+        (exec/cluster_sql.py — the multi-host stage execution contract)."""
+        self._sql_cluster = cluster
+        return self
+
+    def detachSqlCluster(self) -> "TpuSession":
+        self._sql_cluster = None
+        return self
+
     def stop(self) -> None:
         for q in self._streams:
             try:
@@ -198,6 +208,13 @@ class TpuSession:
         rc = getattr(self, "_rdd_context", None)
         if rc is not None:
             rc.stop()
+        cl = getattr(self, "_sql_cluster", None)
+        if cl is not None:
+            try:
+                cl.stop()
+            except Exception:
+                pass
+            self._sql_cluster = None
         if TpuSession._active is self:
             TpuSession._active = None
 

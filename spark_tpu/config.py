@@ -123,6 +123,11 @@ class SQLConf:
             self._values[k] = value
         return self
 
+    def overrides(self) -> dict:
+        """Snapshot of explicit overrides (for shipping to executors)."""
+        with self._lock:
+            return dict(self._values)
+
     def unset(self, key: str | ConfigEntry) -> None:
         k = key.key if isinstance(key, ConfigEntry) else key
         with self._lock:

@@ -106,8 +106,16 @@ class QueryExecution:
         plan = self.physical
         ctx = ExecContext(conf=self.session.conf,
                           metrics=self.session._metrics)
-        sched = DAGScheduler(
-            ctx, listener_bus=getattr(self.session, "listener_bus", None))
+        bus = getattr(self.session, "listener_bus", None)
+        cluster = getattr(self.session, "_sql_cluster", None)
+        if cluster is not None:
+            from .cluster_sql import ClusterDAGScheduler
+
+            sched = ClusterDAGScheduler(
+                ctx, cluster, self.session.conf.overrides(),
+                listener_bus=bus)
+        else:
+            sched = DAGScheduler(ctx, listener_bus=bus)
         return self._timed("execution", lambda: sched.run(plan))
 
     def to_arrow(self) -> pa.Table:

@@ -33,6 +33,12 @@ class DataSource:
     def read_partition(self, i: int, columns: Sequence[str] | None) -> pa.Table:
         raise NotImplementedError
 
+    def __getstate__(self):
+        # device-resident batch caches never travel to other processes
+        state = dict(self.__dict__)
+        state.pop("_device_cache", None)
+        return state
+
 
 class InMemorySource(DataSource):
     """An Arrow table split into N partitions (role of LocalTableScan +

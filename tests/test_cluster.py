@@ -70,3 +70,73 @@ def test_rdd_on_cluster():
         assert os.getpid() not in pids
     finally:
         c.stop()
+
+
+def test_distributed_sql_stages():
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu.api.session import TpuSession
+    from spark_tpu.exec.cluster import LocalCluster
+
+    s = TpuSession("csql_t", {"spark.sql.shuffle.partitions": "4"})
+    s.attachSqlCluster(LocalCluster(num_workers=2))
+    try:
+        rng = np.random.default_rng(0)
+        n = 20000
+        keys = rng.integers(0, 40, n)
+        vals = rng.random(n)
+        s.createDataFrame(pa.table({"k": keys, "v": vals})) \
+            .createOrReplaceTempView("cbig")
+        # repartition forces a shuffle exchange → a remote map stage
+        import spark_tpu.api.functions as F
+
+        df = s.table("cbig").repartition(4) \
+            .groupBy("k").agg(F.sum("v").alias("sv"))
+        got = {r["k"]: r["sv"] for r in df.collect()}
+        exp = {}
+        for k, v in zip(keys.tolist(), vals.tolist()):
+            exp[k] = exp.get(k, 0.0) + v
+        assert set(got) == set(exp)
+        for k in exp:
+            assert abs(got[k] - exp[k]) < 1e-6
+        remote = s._metrics.snapshot()["counters"].get(
+            "scheduler.stages_remote", 0)
+        assert remote >= 1
+    finally:
+        s.stop()
+
+
+def test_distributed_sql_join_and_worker_loss():
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu.api.session import TpuSession
+    from spark_tpu.exec.cluster import LocalCluster
+
+    s = TpuSession("csql_j", {"spark.sql.shuffle.partitions": "3"})
+    cluster = LocalCluster(num_workers=2)
+    s.attachSqlCluster(cluster)
+    try:
+        n = 5000
+        rng = np.random.default_rng(1)
+        s.createDataFrame(pa.table({
+            "k": rng.integers(0, 20, n), "v": np.ones(n)})) \
+            .createOrReplaceTempView("cfact")
+        s.createDataFrame(pa.table({
+            "k": np.arange(20), "name": [f"n{i}" for i in range(20)]})) \
+            .createOrReplaceTempView("cdim")
+        q = ("SELECT d.name, sum(f.v) AS s FROM cfact f "
+             "JOIN cdim d ON f.k = d.k GROUP BY d.name")
+        out1 = s.sql(q).toArrow().to_pydict()
+        assert sum(out1["s"]) == n
+
+        # kill one worker; the next query must still succeed (task retry
+        # on the surviving executor)
+        w = next(iter(cluster._workers.values()))
+        w.proc.kill()
+        w.proc.wait(timeout=10)
+        out2 = s.sql(q).toArrow().to_pydict()
+        assert sum(out2["s"]) == n
+    finally:
+        s.stop()
