@@ -958,6 +958,81 @@ class ExpandGroupingSets(Rule):
         return e
 
 
+class ReorderJoins(Rule):
+    """Greedy left-deep reordering of inner-join chains by estimated row
+    counts (reference: Optimizer ReorderJoin + CostBasedJoinReorder,
+    simplified): start from the smallest relation and repeatedly attach
+    the smallest CONNECTED relation (one sharing a join predicate with
+    the rows already joined), so selective dimension tables join before
+    large facts. Fires only when every chain member has a row estimate."""
+
+    def apply(self, plan):
+        def rule(node):
+            if not isinstance(node, Join) or node.join_type != "inner":
+                return node
+            items: list[LogicalPlan] = []
+            conds: list[Expression] = []
+
+            def flatten(n):
+                if isinstance(n, Join) and n.join_type == "inner":
+                    flatten(n.left)
+                    flatten(n.right)
+                    if n.condition is not None:
+                        conds.extend(split_conjuncts(n.condition))
+                else:
+                    items.append(n)
+
+            flatten(node)
+            if len(items) <= 2:
+                return node
+            ests = {}
+            for it in items:
+                r = it.stats_rows()
+                if r is None:
+                    return node  # no stats → keep the written order
+                ests[id(it)] = r
+
+            remaining = list(items)
+            def _key(x):  # deterministic tie-break → stable fixpoint
+                out0 = x.output[0].expr_id if x.output else 0
+                return (ests[id(x)], out0)
+
+            cur = min(remaining, key=_key)
+            remaining.remove(cur)
+            joined_ids = {a.expr_id for a in cur.output}
+            unused = list(conds)
+            result = cur
+            while remaining:
+                def connects(cand):
+                    cids = {a.expr_id for a in cand.output}
+                    for cd in unused:
+                        refs = cd.references()
+                        if refs and refs <= (joined_ids | cids) \
+                                and refs & joined_ids and refs & cids:
+                            return True
+                    return False
+
+                cands = [r for r in remaining if connects(r)]
+                pick = min(cands or remaining, key=_key)
+                remaining.remove(pick)
+                pick_ids = {a.expr_id for a in pick.output}
+                joined_ids |= pick_ids
+                applicable = [cd for cd in unused
+                              if cd.references() <= joined_ids]
+                for cd in applicable:
+                    unused.remove(cd)
+                result = Join(result, pick, "inner",
+                              join_conjuncts(applicable))
+            if unused:  # conds referencing beyond the chain (shouldn't)
+                result = Filter(join_conjuncts(unused), result)
+            if [a.expr_id for a in result.output] != \
+                    [a.expr_id for a in node.output]:
+                result = Project(list(node.output), result)
+            return result
+
+        return plan.transform_up(rule)
+
+
 class ReplaceDistinct(Rule):
     def apply(self, plan):
         def rule(node):
@@ -1120,6 +1195,7 @@ class Optimizer(RuleExecutor):
                 CombineFilters(),
                 MergeFilterIntoJoin(),
                 PushDownPredicates(),
+                ReorderJoins(),
                 ConstantFolding(),
                 BooleanSimplification(),
                 SimplifyCasts(),

