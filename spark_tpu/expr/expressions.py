@@ -2008,6 +2008,135 @@ class RegexpReplace(_DictTransform):
         return self._rx.sub(re.sub(r"\$(\d)", r"\\\1", self.repl), s)
 
 
+class Left(_DictTransform):
+    def __init__(self, child, n: Expression):
+        super().__init__(child)
+        self.n = int(n.value)
+
+    def transform(self, s):
+        return s[: self.n] if self.n >= 0 else ""
+
+
+class Right(_DictTransform):
+    def __init__(self, child, n: Expression):
+        super().__init__(child)
+        self.n = int(n.value)
+
+    def transform(self, s):
+        return s[-self.n:] if self.n > 0 else ""
+
+
+class Overlay(_DictTransform):
+    """overlay(s, replace, pos[, len]) — 1-based."""
+
+    def __init__(self, child, repl: Expression, pos: Expression,
+                 length: Expression | None = None):
+        super().__init__(child)
+        self.repl = str(repl.value)
+        self.pos = int(pos.value)
+        self.length = len(self.repl) if length is None else int(length.value)
+
+    def transform(self, s):
+        p = self.pos - 1
+        return s[:p] + self.repl + s[p + self.length:]
+
+
+class Soundex(_DictTransform):
+    _CODES = {**{c: "1" for c in "bfpv"}, **{c: "2" for c in "cgjkqsxz"},
+              **{c: "3" for c in "dt"}, "l": "4",
+              **{c: "5" for c in "mn"}, "r": "6"}
+
+    def transform(self, s):
+        if not s or not s[0].isalpha():
+            return s
+        out = s[0].upper()
+        prev = self._CODES.get(s[0].lower(), "")
+        for ch in s[1:].lower():
+            code = self._CODES.get(ch, "")
+            if code and code != prev:
+                out += code
+            if ch not in "hw":
+                prev = code
+            if len(out) == 4:
+                break
+        return out.ljust(4, "0")
+
+
+class Md5(_DictTransform):
+    def transform(self, s):
+        import hashlib
+
+        return hashlib.md5(s.encode()).hexdigest()
+
+
+class Sha1(_DictTransform):
+    def transform(self, s):
+        import hashlib
+
+        return hashlib.sha1(s.encode()).hexdigest()
+
+
+class Sha2(_DictTransform):
+    def __init__(self, child, bits: Expression):
+        super().__init__(child)
+        self.bits = int(bits.value) or 256
+
+    def transform(self, s):
+        import hashlib
+
+        h = hashlib.new(f"sha{self.bits}")
+        h.update(s.encode())
+        return h.hexdigest()
+
+
+class Base64(_DictTransform):
+    def transform(self, s):
+        import base64 as b64
+
+        return b64.b64encode(s.encode()).decode()
+
+
+class Unbase64(_DictTransform):
+    def transform(self, s):
+        import base64 as b64
+
+        try:
+            return b64.b64decode(s.encode()).decode()
+        except Exception:
+            return ""
+
+
+class FormatNumber(Expression):
+    """format_number(x, d) — host-only (numeric → string has no bounded
+    dictionary); RewriteHostOnlyExpressions lowers it to a vectorized
+    host UDF."""
+
+    child_fields = ("child",)
+
+    def __init__(self, child: Expression, d: Expression):
+        self.child = child
+        self.d = int(d.value)
+
+    @property
+    def dtype(self):
+        return string
+
+    def format_fn(self):
+        d = self.d
+
+        def fn(a):
+            out = []
+            for v in a:
+                out.append(None if v is None else f"{float(v):,.{d}f}")
+            return np.array(out, dtype=object)
+
+        return fn
+
+    def eval(self, ctx):
+        raise UnsupportedOperationError(
+            "format_number must be lowered to a host UDF")
+
+
 class Translate(_DictTransform):
     def __init__(self, child, matching: Expression, replace: Expression):
         super().__init__(child)
@@ -2201,6 +2330,25 @@ class _StringIntLut(Expression):
         return Val(int32, jnp.take(lut, jnp.clip(c.data, 0, lut.shape[0] - 1)),
                    c.validity, None)
 
+
+
+class Levenshtein(_StringIntLut):
+    def __init__(self, child, other: Expression):
+        super().__init__(child)
+        self.other = str(other.value)
+
+    def int_of(self, s):
+        a, b = s, self.other
+        if len(a) < len(b):
+            a, b = b, a
+        prev = list(range(len(b) + 1))
+        for i, ca in enumerate(a, 1):
+            cur = [i]
+            for j, cb in enumerate(b, 1):
+                cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                               prev[j - 1] + (ca != cb)))
+            prev = cur
+        return prev[-1]
 
 class Ascii(_StringIntLut):
     def int_of(self, s):

@@ -168,6 +168,23 @@ def _reg_all() -> None:
     r("substring_index", lambda c, d, n: E.SubstringIndex(c, d, n))
     r("regexp_extract", lambda c, p, i=None: E.RegexpExtract(c, p, i))
     r("regexp_replace", lambda c, p, rp: E.RegexpReplace(c, p, rp))
+    r("left", lambda c, n: E.Left(c, n))
+    r("right", lambda c, n: E.Right(c, n))
+    r("overlay", lambda c, rp, p, l=None: E.Overlay(c, rp, p, l))
+    r("soundex", lambda c: E.Soundex(c))
+    r("md5", lambda c: E.Md5(c))
+    r("sha1", lambda c: E.Sha1(c))
+    r("sha", lambda c: E.Sha1(c))
+    r("sha2", lambda c, b: E.Sha2(c, b))
+    r("base64", lambda c: E.Base64(c))
+    r("unbase64", lambda c: E.Unbase64(c))
+    r("levenshtein", lambda c, o: E.Levenshtein(c, o))
+    r("format_number", lambda c, d: E.FormatNumber(c, d))
+    r("try_divide", lambda a, b: E.If(
+        E.EqualTo(b, E.Literal(0)), E.Literal(None), E.Divide(a, b)))
+    r("try_add", lambda a, b: E.Add(a, b))
+    r("try_subtract", lambda a, b: E.Subtract(a, b))
+    r("try_multiply", lambda a, b: E.Multiply(a, b))
     # arrays (dictionary-encoded; see ArrayType)
     r("size", lambda c: E.Size(c))
     r("cardinality", lambda c: E.Size(c))

@@ -416,6 +416,11 @@ class RewriteHostOnlyExpressions(Rule):
                     not isinstance(e.child.dtype, StringType):
                 return PythonUDF(to_str_fn(e.child.dtype), [e.child],
                                  string, name="cast_str")
+            from ..expr.expressions import FormatNumber
+
+            if isinstance(e, FormatNumber):
+                return PythonUDF(e.format_fn(), [e.child], string,
+                                 name="format_number")
             return e
 
         def rule(node):

@@ -331,3 +331,36 @@ def test_array_functions_strings(spark):
     assert out["n"] == [3, 1]
     assert out["e2"] == ["a", ""]   # '' for out-of-bounds (ref: NULL)
     assert out["srt"] == [["a", "b", "c"], ["z"]]
+
+
+def test_more_string_functions(spark):
+    spark.createDataFrame(pa.table({"s": ["hello", "spark"]})) \
+        .createOrReplaceTempView("mstr")
+    out = q(spark, """
+        SELECT left(s, 2) AS l, right(s, 2) AS r,
+               overlay(s, 'XX', 2) AS ov, soundex(s) AS sx,
+               levenshtein(s, 'hello') AS lv,
+               md5(s) AS m, base64(s) AS b64,
+               unbase64(base64(s)) AS rt
+        FROM mstr ORDER BY s""")
+    assert out["l"] == ["he", "sp"]
+    assert out["r"] == ["lo", "rk"]
+    assert out["ov"] == ["hXXlo", "sXXrk"]
+    assert out["sx"] == ["H400", "S162"]
+    assert out["lv"] == [0, 5]
+    import hashlib
+
+    assert out["m"][0] == hashlib.md5(b"hello").hexdigest()
+    import base64 as b64mod
+
+    assert out["b64"][0] == b64mod.b64encode(b"hello").decode()
+    assert out["rt"] == ["hello", "spark"]
+
+
+def test_format_number_and_try_divide(spark):
+    out = q(spark, """SELECT format_number(1234567.891, 2) AS f,
+                             try_divide(10, 0) AS t0,
+                             try_divide(10, 4) AS t1""")
+    assert out["f"] == ["1,234,567.89"]
+    assert out["t0"] == [None]
+    assert out["t1"] == [2.5]
