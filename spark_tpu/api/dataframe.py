@@ -568,6 +568,27 @@ class GroupedData:
     def count(self) -> DataFrame:
         return self.agg(Column(E.Alias(E.Count(None), "count")))
 
+    def applyInPandasWithState(self, fn, schema) -> DataFrame:
+        """Arbitrary stateful grouped-map (reference:
+        applyInPandasWithState / flatMapGroupsWithState): lazy — on a
+        streaming frame each micro-batch calls
+        fn(key_tuple, pandas_frame, GroupState); on a static frame one
+        pass runs with empty initial state."""
+        from ..streaming.stateful_map import StatefulMapGroups
+
+        key_names = []
+        for g in self.grouping:
+            if isinstance(g, E.UnresolvedAttribute):
+                key_names.append(g.name_parts[-1])
+            elif isinstance(g, (E.AttributeReference, E.Alias)):
+                key_names.append(g.name)
+            else:
+                raise ValueError("grouping keys must be columns")
+        out_attrs = [E.AttributeReference(f.name, f.dataType, True)
+                     for f in schema.fields]
+        return self.df._with(StatefulMapGroups(
+            key_names, fn, out_attrs, self.df.plan))
+
     def applyInPandas(self, fn, schema=None) -> DataFrame:
         """Grouped-map pandas UDF (reference: FlatMapGroupsInPandasExec /
         RelationalGroupedDataset.applyInPandas): the full frame crosses to

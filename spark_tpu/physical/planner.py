@@ -126,6 +126,12 @@ class Planner:
 
             return GenerateExec(node.generator, node.element_attr,
                                 self._convert(node.child))
+        from ..streaming.stateful_map import StatefulMapGroups
+
+        if isinstance(node, StatefulMapGroups):
+            from .python_eval import StatefulMapExec
+
+            return StatefulMapExec(node, self._convert(node.child))
         raise UnsupportedOperationError(
             f"no physical plan for {type(node).__name__}")
 

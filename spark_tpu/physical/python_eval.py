@@ -131,3 +131,41 @@ class PythonEvalExec(PhysicalPlan):
     def simple_string(self):
         names = ", ".join(a.child.fname for a in self.udf_aliases)
         return f"PythonEval[{names}]"
+
+
+class StatefulMapExec(PhysicalPlan):
+    """Batch-mode applyInPandasWithState: one pass, empty initial state
+    (streaming/query.py drives the incremental version)."""
+
+    child_fields = ("child",)
+
+    def __init__(self, node, child: PhysicalPlan):
+        self.node = node
+        self.child = child
+
+    @property
+    def output(self):
+        return self.node.out_attrs
+
+    def execute(self, ctx: ExecContext):
+        import pyarrow as pa
+
+        from ..columnar.arrow import record_batch_to_columnar
+        from ..streaming.stateful_map import run_stateful_map
+        from ..types import to_arrow_type
+
+        parts = self.child.execute(ctx)
+        tabs = [b.to_arrow() for p in parts for b in p]
+        if tabs:
+            child_table = pa.concat_tables(tabs,
+                                           promote_options="permissive")
+        else:
+            child_table = pa.schema(
+                [(a.name, to_arrow_type(a.dtype))
+                 for a in self.child.output]).empty_table()
+        out_schema = pa.schema([(a.name, to_arrow_type(a.dtype))
+                                for a in self.node.out_attrs])
+        out, _state = run_stateful_map(self.node, child_table, None,
+                                       out_schema)
+        schema = attrs_schema(self.output)
+        return [[record_batch_to_columnar(out, schema)]]

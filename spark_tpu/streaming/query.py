@@ -313,6 +313,10 @@ class StreamingQuery:
     # --- incremental execution --------------------------------------------
     def _execute_batch(self, new_data: pa.Table, batch_id: int) -> pa.Table:
         from ..api.dataframe import DataFrame
+        from .stateful_map import StatefulMapGroups
+
+        if isinstance(self.plan, StatefulMapGroups):
+            return self._execute_stateful_map(new_data)
 
         def substitute(node):
             if isinstance(node, StreamingRelation) and node is self.stream_leaf:
@@ -367,6 +371,30 @@ class StreamingQuery:
             if fns and not all(isinstance(f, First) for f in fns):
                 return False
         return True
+
+    def _execute_stateful_map(self, new_data: pa.Table) -> pa.Table:
+        """applyInPandasWithState micro-batch (reference:
+        FlatMapGroupsWithStateExec): the stateless child plan runs on the
+        engine; the user fn runs per key with its recovered state."""
+        from ..api.dataframe import DataFrame
+        from ..types import to_arrow_type
+        from .stateful_map import run_stateful_map
+
+        node = self.plan
+
+        def sub(n):
+            if isinstance(n, StreamingRelation):
+                return LocalRelation(n.attrs, new_data)
+            return n
+
+        child_table = DataFrame(self.session,
+                                node.child.transform_up(sub)).toArrow()
+        out_schema = pa.schema([(a.name, to_arrow_type(a.dtype))
+                                for a in node.out_attrs])
+        out, new_state = run_stateful_map(node, child_table,
+                                          self.state.table, out_schema)
+        self.state.commit(self.batch_id + 1, new_state)
+        return out
 
     def _execute_stateful(self, optimized: LogicalPlan,
                           agg: Aggregate,

@@ -1,0 +1,109 @@
+"""Arbitrary stateful processing: applyInPandasWithState.
+
+Role of the reference's FlatMapGroupsWithStateExec /
+ApplyInPandasWithStatePythonRunner (sqlx/streaming/
+FlatMapGroupsWithStateExec.scala): the user function sees each key's
+micro-batch rows as a pandas frame plus a GroupState handle; updated
+states persist in the state store as pickled payloads keyed by the
+group's JSON-encoded key tuple. Host-side by construction — arbitrary
+Python state has no device representation; the columnar engine handles
+everything below (the stateless child plan) and above (re-ingestion)."""
+
+from __future__ import annotations
+
+import json
+import pickle
+from typing import Callable
+
+import pyarrow as pa
+
+from ..plan.logical import LogicalPlan, UnaryNode
+from ..expr.expressions import AttributeReference
+
+
+class GroupState:
+    """Per-key state handle (reference: GroupState API)."""
+
+    def __init__(self, raw: bytes | None):
+        self._value = pickle.loads(raw) if raw is not None else None
+        self._exists = raw is not None
+        self._removed = False
+
+    @property
+    def exists(self) -> bool:
+        return self._exists
+
+    def get(self):
+        return self._value
+
+    def update(self, value) -> None:
+        self._value = value
+        self._exists = True
+        self._removed = False
+
+    def remove(self) -> None:
+        self._removed = True
+        self._exists = False
+        self._value = None
+
+
+class StatefulMapGroups(UnaryNode):
+    """Logical node for applyInPandasWithState; must sit at the ROOT of a
+    streaming query (arbitrary state forbids operators above it)."""
+
+    equality_excluded_fields = ("fn",)
+
+    def __init__(self, key_names: list[str], fn: Callable,
+                 out_attrs: list[AttributeReference], child: LogicalPlan):
+        self.key_names = list(key_names)
+        self.fn = fn
+        self.out_attrs = list(out_attrs)
+        self.child = child
+
+    @property
+    def output(self):
+        return self.out_attrs
+
+    @property
+    def resolved(self):
+        return self.child.resolved
+
+
+def run_stateful_map(node: StatefulMapGroups, child_table: pa.Table,
+                     state_table: pa.Table | None,
+                     out_schema: pa.Schema):
+    """One pass: group child rows by key, call fn per key (including keys
+    with state but no new rows — timeout-style wakeups are NOT modeled),
+    return (output table, new state table)."""
+    import pandas as pd
+
+    states: dict[str, bytes] = {}
+    if state_table is not None and state_table.num_rows:
+        for k, v in zip(state_table.column("__key").to_pylist(),
+                        state_table.column("__state").to_pylist()):
+            states[k] = v
+
+    pdf = child_table.to_pandas()
+    outs = []
+    if len(pdf):
+        for key, grp in pdf.groupby(node.key_names, dropna=False,
+                                    sort=False):
+            kt = key if isinstance(key, tuple) else (key,)
+            kjson = json.dumps([None if pd.isna(x) else x for x in kt],
+                               default=str)
+            st = GroupState(states.get(kjson))
+            out = node.fn(kt, grp.reset_index(drop=True), st)
+            if st._removed:
+                states.pop(kjson, None)
+            elif st._exists:
+                states[kjson] = pickle.dumps(st._value)
+            if out is not None and len(out):
+                outs.append(pa.Table.from_pandas(
+                    out, schema=out_schema, preserve_index=False))
+
+    out_table = pa.concat_tables(outs) if outs else out_schema.empty_table()
+    new_state = pa.table({
+        "__key": pa.array(list(states.keys()), pa.string()),
+        "__state": pa.array(list(states.values()), pa.binary()),
+    })
+    return out_table, new_state

@@ -287,3 +287,56 @@ def test_stream_stream_inner_join(spark):
             [("a", 1, 10), ("a", 3, 10), ("b", 2, 20)]
     finally:
         q.stop()
+
+
+def test_apply_in_pandas_with_state(spark):
+    import pandas as pd
+
+    from spark_tpu.types import (
+        IntegerType, LongType, StringType, StructField, StructType,
+    )
+
+    out_schema = StructType([StructField("k", StringType()),
+                             StructField("running", LongType())])
+
+    def running_sum(key, pdf, state):
+        total = (state.get() or 0) + int(pdf["v"].sum())
+        state.update(total)
+        return pd.DataFrame({"k": [key[0]], "running": [total]})
+
+    src, df = spark.memory_stream(pa.schema([("k", pa.string()),
+                                             ("v", pa.int64())]))
+    q = (df.groupBy("k").applyInPandasWithState(running_sum, out_schema)
+           .writeStream.format("memory").queryName("s_state_map")
+           .outputMode("update").start())
+    try:
+        src.add_data({"k": ["a", "a", "b"], "v": [1, 2, 5]})
+        q.processAllAvailable()
+        out = _sink_rows(spark, "s_state_map")
+        assert dict(zip(out["k"], out["running"])) == {"a": 3, "b": 5}
+        src.add_data({"k": ["a"], "v": [10]})
+        q.processAllAvailable()
+        out = _sink_rows(spark, "s_state_map")
+        assert out["running"][-1] == 13  # state carried across batches
+    finally:
+        q.stop()
+
+
+def test_apply_in_pandas_with_state_batch_mode(spark):
+    import pandas as pd
+
+    from spark_tpu.types import (
+        LongType, StringType, StructField, StructType,
+    )
+
+    out_schema = StructType([StructField("k", StringType()),
+                             StructField("n", LongType())])
+
+    def count_rows(key, pdf, state):
+        return pd.DataFrame({"k": [key[0]], "n": [len(pdf)]})
+
+    df = spark.createDataFrame(pa.table({
+        "k": ["x", "x", "y"], "v": [1, 2, 3]}))
+    out = df.groupBy("k").applyInPandasWithState(count_rows, out_schema) \
+        .toArrow().to_pydict()
+    assert dict(zip(out["k"], out["n"])) == {"x": 2, "y": 1}
