@@ -1078,6 +1078,15 @@ class HashJoinExec(PhysicalPlan):
         bkey_eqs = [c.eq_keys() for c in bkeys]
         bkey_valids = [c.validity for c in bkeys]
 
+        from ..types import DateType, DecimalType, IntegralType
+
+        if self.join_type in ("inner", "left_semi") and len(bkeys) == 1 \
+                and isinstance(bkeys[0].dtype,
+                               (IntegralType, DateType, DecimalType)) \
+                and ctx.conf.get("spark.tpu.join.runtimeFilter", False):
+            lp = self._range_filter_probe(lp, build, bkeys, bkey_valids,
+                                          lpos, ctx)
+
         bi_key = ("join_build", build.capacity, len(bkeys),
                   tuple(str(k.dtype) for k in bkey_eqs),
                   tuple(v is not None for v in bkey_valids))
@@ -1097,6 +1106,69 @@ class HashJoinExec(PhysicalPlan):
             out_batches.append(
                 self._unmatched_build_rows(lp, build, lschema, ctx))
         return out_batches
+
+    def _range_filter_probe(self, lp, build, bkeys, bkey_valids, lpos, ctx):
+        """Runtime min-max join filter (reference: InjectRuntimeFilter /
+        bloom pushdown, simplified to a range): probe rows outside the
+        build key range can't match an inner/semi join, so they drop
+        BEFORE the O(cap log cap) sort-probe; batches that shrink enough
+        compact to a smaller capacity bucket. Default OFF: on the 2-core
+        CPU VM the filter+sync overhead beats the smaller sort; benchmark
+        on a live chip (where lax.sort dominates) before enabling."""
+        import jax
+
+        from ..columnar.ops import compact_batch
+
+        jnp = _jnp()
+        bc = bkeys[0]
+        rkey = ("join_rf_range", build.capacity, str(bc.data.dtype),
+                bc.validity is not None)
+
+        def build_range():
+            def kr(k, v, m):
+                k64 = k.astype(jnp.int64)
+                live = m if v is None else (m & v)
+                big = jnp.iinfo(jnp.int64).max
+                small = jnp.iinfo(jnp.int64).min
+                return (jnp.min(jnp.where(live, k64, big)),
+                        jnp.max(jnp.where(live, k64, small)))
+
+            return jax.jit(kr)
+
+        kr = GLOBAL_KERNEL_CACHE.get_or_build(rkey, build_range)
+        bmin, bmax = kr(bc.data, bc.validity, build.row_mask)
+
+        min_cap = int(ctx.conf.get(
+            "spark.tpu.join.runtimeFilter.minCapacity", 1 << 20))
+        out = []
+        for pb in (lp or []):
+            if pb.capacity < min_cap:
+                out.append(pb)  # small batch: the sort-probe is cheap
+                continue
+            pc = pb.columns[lpos[self.left_keys[0].expr_id]]
+            fkey = ("join_rf_mask", pb.capacity, str(pc.data.dtype),
+                    pc.validity is not None)
+
+            def build_mask():
+                def km(k, v, m, lo, hi):
+                    k64 = k.astype(jnp.int64)
+                    keep = (k64 >= lo) & (k64 <= hi)
+                    if v is not None:
+                        keep = keep & v
+                    nm = m & keep
+                    return nm, jnp.sum(nm)
+
+                return jax.jit(km)
+
+            km = GLOBAL_KERNEL_CACHE.get_or_build(fkey, build_mask)
+            nm, live = km(pc.data, pc.validity, pb.row_mask, bmin, bmax)
+            live = int(live)
+            nb = ColumnarBatch(pb.schema, pb.columns, nm, num_rows=live)
+            if bucket_capacity(max(live, 1)) <= pb.capacity // 16:
+                nb = compact_batch(nb)
+                ctx.metrics.add("join.runtime_filter_compactions")
+            out.append(nb)
+        return out
 
     def _probe_batch(self, pb: ColumnarBatch, build: ColumnarBatch, bindex,
                      bkey_eqs, bkey_valids, lpos, ctx) -> ColumnarBatch:
