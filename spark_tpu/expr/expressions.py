@@ -689,6 +689,53 @@ class Multiply(BinaryArithmetic):
         return l * r, None
 
 
+class TryAdd(Add):
+    """try_add: NULL on integral overflow instead of wrapping (reference:
+    Add with EvalMode.TRY, sqlcat/expressions/arithmetic.scala)."""
+
+    def _op(self, l, r):
+        data, _ = super()._op(l, r)
+        jnp = _jnp()
+        if not jnp.issubdtype(data.dtype, jnp.signedinteger):
+            return data, None
+        # signed add overflows iff operands share a sign the result lost
+        ok = ~(((l >= 0) == (r >= 0)) & ((data >= 0) != (l >= 0)))
+        return data, ok
+
+
+class TrySubtract(Subtract):
+    """try_subtract: NULL on integral overflow instead of wrapping."""
+
+    def _op(self, l, r):
+        data, _ = super()._op(l, r)
+        jnp = _jnp()
+        if not jnp.issubdtype(data.dtype, jnp.signedinteger):
+            return data, None
+        ok = ~(((l >= 0) != (r >= 0)) & ((data >= 0) != (l >= 0)))
+        return data, ok
+
+
+class TryMultiply(Multiply):
+    """try_multiply: NULL on integral overflow instead of wrapping."""
+
+    def _op(self, l, r):
+        data, _ = super()._op(l, r)
+        jnp = _jnp()
+        if not jnp.issubdtype(data.dtype, jnp.signedinteger):
+            return data, None
+        info = jnp.iinfo(data.dtype)
+        if info.bits < 64:
+            wide = l.astype(jnp.int64) * r.astype(jnp.int64)
+            return data, (wide >= info.min) & (wide <= info.max)
+        # int64: division check is exact — wrapped result res = l*r - k*2^64
+        # with floor(res/l) == r forces k == 0; only the (-1, INT64_MIN)
+        # pair needs special-casing (its quotient itself wraps)
+        nz = jnp.where(l == 0, jnp.ones_like(l), l)
+        ok = (l == 0) | (jnp.floor_divide(data, nz) == r)
+        ok = ok & ~((l == -1) & (r == info.min))
+        return data, ok
+
+
 class Divide(BinaryArithmetic):
     symbol = "/"
 
@@ -2084,6 +2131,8 @@ class Sha2(_DictTransform):
     def transform(self, s):
         import hashlib
 
+        if self.bits not in (224, 256, 384, 512):
+            return None  # reference returns NULL for unsupported lengths
         h = hashlib.new(f"sha{self.bits}")
         h.update(s.encode())
         return h.hexdigest()
@@ -2103,7 +2152,7 @@ class Unbase64(_DictTransform):
         try:
             return b64.b64decode(s.encode()).decode()
         except Exception:
-            return ""
+            return None  # reference returns NULL for invalid base64
 
 
 class FormatNumber(Expression):

@@ -182,9 +182,9 @@ def _reg_all() -> None:
     r("format_number", lambda c, d: E.FormatNumber(c, d))
     r("try_divide", lambda a, b: E.If(
         E.EqualTo(b, E.Literal(0)), E.Literal(None), E.Divide(a, b)))
-    r("try_add", lambda a, b: E.Add(a, b))
-    r("try_subtract", lambda a, b: E.Subtract(a, b))
-    r("try_multiply", lambda a, b: E.Multiply(a, b))
+    r("try_add", lambda a, b: E.TryAdd(a, b))
+    r("try_subtract", lambda a, b: E.TrySubtract(a, b))
+    r("try_multiply", lambda a, b: E.TryMultiply(a, b))
     # arrays (dictionary-encoded; see ArrayType)
     r("size", lambda c: E.Size(c))
     r("cardinality", lambda c: E.Size(c))

@@ -347,10 +347,38 @@ def _run_dml(session, cmd, df_of):
             prior = c if prior is None else Or(prior, c)
         return eff
 
-    # matched side: target LEFT OUTER source(+flag)
+    # matched side: target LEFT OUTER source(+flag). The target gets a
+    # host-assigned row id so multi-source matches are detectable — the
+    # reference raises MERGE_CARDINALITY_VIOLATION when one target row
+    # matches more than one source row instead of silently duplicating it.
+    from ..errors import ExecutionError
+    from ..expr.expressions import AttributeReference
+    from ..types import int64 as _i64
+    from .logical import LocalRelation
+
+    tgt_tbl = DataFrame(session, cmd.target).toArrow()
+    rid_tbl = tgt_tbl.append_column(
+        "__merge_rid", pa.array(range(tgt_tbl.num_rows), pa.int64()))
+    rid_attrs = [AttributeReference(a.name, a.dtype, True)
+                 for a in target_attrs] + \
+        [AttributeReference("__merge_rid", _i64, False)]
+    target_rel = SubqueryAlias(talias, LocalRelation(rid_attrs, rid_tbl)) \
+        if talias else LocalRelation(rid_attrs, rid_tbl)
+
     src_flag = Project([UnresolvedStar(None),
                         Alias(Literal(True), "__merge_m")], cmd.source)
-    joined = Join(cmd.target, src_flag, "left_outer", cmd.condition)
+    joined = Join(target_rel, src_flag, "left_outer", cmd.condition)
+
+    probe = DataFrame(session, Project(
+        [Alias(UnresolvedAttribute(["__merge_rid"]), "__merge_rid"),
+         Alias(matched_ref, "__m")], joined)).toArrow()
+    matched_rids = [r for r, m in zip(probe.column("__merge_rid").to_pylist(),
+                                      probe.column("__m").to_pylist()) if m]
+    if len(matched_rids) != len(set(matched_rids)):
+        raise ExecutionError(
+            "MERGE_CARDINALITY_VIOLATION: a target row of the MERGE matched "
+            "more than one source row; rewrite the source to have at most "
+            "one match per target row")
     eff = effective(cmd.matched, matched_ref)
     del_cond = None
     for cl, c in zip(cmd.matched, eff):

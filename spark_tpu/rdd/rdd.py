@@ -679,14 +679,14 @@ class RDDContext:
         self.checkpoint_dir = checkpoint_dir
         self.cluster = cluster  # exec/cluster.LocalCluster for process mode
         self._rdd_counter = itertools.count()
-        self._pool = ThreadPoolExecutor(max_workers=parallelism)
+        self._pool_inst = None  # lazy: no threads until the first job
         self._in_task = threading.local()
 
     # workers receive the lineage graph; runtime state stays driver-side
     # (the reference marks SparkContext @transient in closures)
     def __getstate__(self):
         state = dict(self.__dict__)
-        for k in ("_pool", "_in_task", "cluster", "_rdd_counter"):
+        for k in ("_pool_inst", "_in_task", "cluster", "_rdd_counter"):
             state.pop(k, None)
         return state
 
@@ -696,8 +696,15 @@ class RDDContext:
         self.__dict__.update(state)
         self.cluster = None
         self._rdd_counter = itertools.count(1 << 20)
-        self._pool = ThreadPoolExecutor(max_workers=self.parallelism)
+        self._pool_inst = None
         self._in_task = threading.local()
+
+    @property
+    def _pool(self) -> ThreadPoolExecutor:
+        if self._pool_inst is None:
+            self._pool_inst = ThreadPoolExecutor(
+                max_workers=self.parallelism)
+        return self._pool_inst
 
     def _next_rdd_id(self) -> int:
         return next(self._rdd_counter)
@@ -761,4 +768,6 @@ class RDDContext:
                               range(rdd.num_partitions()))
 
     def stop(self):
-        self._pool.shutdown(wait=False)
+        if self._pool_inst is not None:
+            self._pool_inst.shutdown(wait=True)
+            self._pool_inst = None

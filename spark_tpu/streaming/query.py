@@ -293,6 +293,14 @@ class StreamingQuery:
                                    str(batch_id)), "w") as f:
                 json.dump({"offset": _json_safe(latest)}, f)
 
+        # Late-data filter (reference: stateful operators drop rows older
+        # than the watermark so a finalized group is never re-created):
+        # filter BEFORE the stateful aggregation against the watermark as
+        # of the previous batch.
+        wm_before = self.current_watermark_us
+        if self.watermark is not None and self._plan_is_stateful():
+            new_data = self._drop_late_rows(new_data)
+
         out_table = self._execute_batch(new_data, batch_id)
         self.sink.add_batch(batch_id, out_table, self.output_mode)
 
@@ -301,6 +309,23 @@ class StreamingQuery:
                                    str(batch_id)), "w") as f:
                 json.dump({"batch": batch_id}, f)
         self.batch_id = batch_id
+
+        # Advance the watermark at end-of-batch from this batch's max
+        # event time (previous-batch semantics, as the reference does),
+        # then — like MicroBatchExecution, which constructs an extra batch
+        # when the watermark changed — run a no-new-data pass so
+        # append-mode finalization emits without waiting for more input.
+        # Runs before committed_offset flips so processAllAvailable can't
+        # observe the sink mid-finalization.
+        if self.watermark is not None:
+            self._advance_watermark_from_input(new_data)
+            if (self.output_mode == "append"
+                    and self.current_watermark_us is not None
+                    and self.current_watermark_us != wm_before
+                    and self._plan_is_stateful()):
+                self.batch_id = batch_id = batch_id + 1
+                out2 = self._execute_batch(new_data.slice(0, 0), batch_id)
+                self.sink.add_batch(batch_id, out2, self.output_mode)
         self.committed_offset = latest
         self.recent_progress.append({
             "batchId": batch_id,
@@ -493,10 +518,11 @@ class StreamingQuery:
 
     def _split_watermark(self, state_table: pa.Table):
         """(finalized, retained) split of the merged state by the current
-        watermark: groups whose event-time key fell behind it emit once
-        and leave the state."""
+        watermark (as of the previous batch — the reference's semantics):
+        groups whose event-time key fell behind it emit once and leave the
+        state."""
         col, _delay = self.watermark
-        wm = self._advance_watermark(state_table.column(col))
+        wm = self.current_watermark_us
         if wm is None:
             return state_table.slice(0, 0), state_table
         done = [v is not None and _to_us(v) < wm
@@ -506,45 +532,61 @@ class StreamingQuery:
 
         return state_table.filter(mask), state_table.filter(pc.invert(mask))
 
-    def _advance_watermark(self, vals) -> int | None:
-        _col, delay_s = self.watermark
+    def _plan_is_stateful(self) -> bool:
+        """True when the query plan carries state the late-data filter must
+        protect (an aggregation / dedup / stateful map)."""
+        from .stateful_map import StatefulMapGroups
+
+        if isinstance(self.plan, StatefulMapGroups):
+            return True
+        return any(isinstance(n, Aggregate) for n in self.plan.iter_nodes())
+
+    def _drop_late_rows(self, new_data: pa.Table) -> pa.Table:
+        """Drop input rows whose event time is older than the current
+        watermark (null event times pass through)."""
+        wm = self.current_watermark_us
+        col, _delay = self.watermark
+        if wm is None or col not in new_data.column_names \
+                or not new_data.num_rows:
+            return new_data
+        keep = [v is None or _to_us(v) >= wm
+                for v in new_data.column(col).to_pylist()]
+        if all(keep):
+            return new_data
+        return new_data.filter(pa.array(keep))
+
+    def _advance_watermark_from_input(self, new_data: pa.Table) -> None:
+        """End-of-batch watermark advance from this batch's max event time
+        (monotonic)."""
+        col, delay_s = self.watermark
+        if col not in new_data.column_names or not new_data.num_rows:
+            return
         try:
             import pyarrow.compute as pc
 
-            mx = pc.max(vals).as_py()
+            mx = pc.max(new_data.column(col)).as_py()
         except Exception:
-            return self.current_watermark_us
+            return
         if mx is None:
-            return self.current_watermark_us
+            return
         wm = _to_us(mx) - int(delay_s * 1e6)
         if self.current_watermark_us is not None:
             wm = max(wm, self.current_watermark_us)
         self.current_watermark_us = wm
-        return wm
 
     def _evict(self, state_table: pa.Table, buffer_attrs) -> pa.Table:
         """Watermark-based state eviction when a grouping key is the
         watermark (event-time) column."""
         if self.watermark is None:
             return state_table
-        col, delay_s = self.watermark
+        col, _delay_s = self.watermark
         if col not in state_table.column_names:
             return state_table
-        vals = state_table.column(col)
-        try:
-            import pyarrow.compute as pc
-
-            mx = pc.max(vals).as_py()
-        except Exception:
+        wm = self.current_watermark_us
+        if wm is None:
             return state_table
-        if mx is None:
-            return state_table
-        mx_us = _to_us(mx)
-        wm = mx_us - int(delay_s * 1e6)
-        if self.current_watermark_us is not None:
-            wm = max(wm, self.current_watermark_us)
-        self.current_watermark_us = wm
-        keep = [_to_us(v) >= wm for v in vals.to_pylist()]
+        keep = [v is None or _to_us(v) >= wm
+                for v in state_table.column(col).to_pylist()]
         return state_table.filter(pa.array(keep))
 
     # --- public API --------------------------------------------------------

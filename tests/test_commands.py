@@ -242,3 +242,21 @@ def test_merge_insert_star(spark):
     out = spark.sql("SELECT k, v FROM ms_t ORDER BY k").toArrow().to_pydict()
     assert out["k"] == [1, 2]
     assert out["v"] == [50, 20]
+
+
+def test_merge_cardinality_violation(spark):
+    # one target row matching >1 source rows must raise, not duplicate
+    # (reference: MERGE_CARDINALITY_VIOLATION)
+    import pyarrow as pa
+    import pytest
+
+    from spark_tpu.errors import ExecutionError
+
+    spark.createDataFrame(pa.table({"k": [1, 2], "v": [10, 20]})) \
+        .createOrReplaceTempView("mcv_t")
+    spark.createDataFrame(pa.table({"k": [1, 1], "v": [5, 6]})) \
+        .createOrReplaceTempView("mcv_s")
+    with pytest.raises(ExecutionError, match="CARDINALITY"):
+        spark.sql("""
+            MERGE INTO mcv_t AS t USING mcv_s AS s ON t.k = s.k
+            WHEN MATCHED THEN UPDATE SET v = s.v""")

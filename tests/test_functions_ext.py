@@ -364,3 +364,31 @@ def test_format_number_and_try_divide(spark):
     assert out["f"] == ["1,234,567.89"]
     assert out["t0"] == [None]
     assert out["t1"] == [2.5]
+
+
+def test_try_arithmetic_overflow_nulls(spark):
+    # try_* return NULL on int64 overflow instead of wrapping (ADVICE r1)
+    out = q(spark, """SELECT try_add(9223372036854775807, 1) AS a,
+                             try_add(1, 2) AS a2,
+                             try_subtract(-9223372036854775808, 1) AS s,
+                             try_subtract(5, 3) AS s2,
+                             try_multiply(4611686018427387904, 4) AS m,
+                             try_multiply(7, 6) AS m2""")
+    assert out["a"] == [None]
+    assert out["a2"] == [3]
+    assert out["s"] == [None]
+    assert out["s2"] == [2]
+    assert out["m"] == [None]
+    assert out["m2"] == [42]
+
+
+def test_unbase64_sha2_invalid_null(spark):
+    # invalid base64 / unsupported sha2 bit length → NULL (ADVICE r1)
+    out = q(spark, """SELECT unbase64('!!!bad') AS u,
+                             sha2('x', 7) AS s7,
+                             sha2('abc', 224) AS s224""")
+    assert out["u"] == [None]
+    assert out["s7"] == [None]
+    import hashlib
+
+    assert out["s224"] == [hashlib.sha224(b"abc").hexdigest()]

@@ -340,3 +340,29 @@ def test_apply_in_pandas_with_state_batch_mode(spark):
     out = df.groupBy("k").applyInPandasWithState(count_rows, out_schema) \
         .toArrow().to_pydict()
     assert dict(zip(out["k"], out["n"])) == {"x": 2, "y": 1}
+
+
+def test_append_watermark_drops_late_rows(spark):
+    # a row older than the watermark must be dropped, never re-emitting a
+    # finalized group (ADVICE r1: late-data filter + previous-batch
+    # watermark semantics)
+    src, df = spark.memory_stream(pa.schema([("t", pa.int64()),
+                                             ("v", pa.int64())]))
+    q = (df.withWatermark("t", "0 seconds")
+           .groupBy("t").agg(F.sum("v").alias("s"))
+           .writeStream.format("memory").queryName("s_wm_late")
+           .outputMode("append").start())
+    try:
+        src.add_data({"t": [1, 2], "v": [10, 5]})
+        q.processAllAvailable()
+        src.add_data({"t": [5], "v": [7]})
+        q.processAllAvailable()
+        out = _sink_rows(spark, "s_wm_late")
+        assert dict(zip(out["t"], out["s"])) == {1: 10, 2: 5}
+        # t=1 is far below the watermark (5): dropped, NOT re-emitted
+        src.add_data({"t": [1, 9], "v": [100, 1]})
+        q.processAllAvailable()
+        out = _sink_rows(spark, "s_wm_late")
+        assert dict(zip(out["t"], out["s"])) == {1: 10, 2: 5, 5: 7}
+    finally:
+        q.stop()
