@@ -349,55 +349,69 @@ def _run_dml(session, cmd, df_of):
 
     # matched side: target LEFT OUTER source(+flag). The target gets a
     # host-assigned row id so multi-source matches are detectable — the
-    # reference raises MERGE_CARDINALITY_VIOLATION when one target row
-    # matches more than one source row instead of silently duplicating it.
+    # reference raises MERGE_CARDINALITY_VIOLATION when a target row that
+    # an UPDATE/DELETE clause would touch matches more than one source row
+    # instead of silently duplicating it. The join runs ONCE: the update
+    # projection, row id, matched flag, and delete condition are computed
+    # in a single pass, then the cardinality check and delete filter
+    # happen host-side on the materialized result.
     from ..errors import ExecutionError
     from ..expr.expressions import AttributeReference
     from ..types import int64 as _i64
     from .logical import LocalRelation
 
     tgt_tbl = DataFrame(session, cmd.target).toArrow()
-    rid_tbl = tgt_tbl.append_column(
-        "__merge_rid", pa.array(range(tgt_tbl.num_rows), pa.int64()))
-    rid_attrs = [AttributeReference(a.name, a.dtype, True)
-                 for a in target_attrs] + \
-        [AttributeReference("__merge_rid", _i64, False)]
-    target_rel = SubqueryAlias(talias, LocalRelation(rid_attrs, rid_tbl)) \
-        if talias else LocalRelation(rid_attrs, rid_tbl)
+    if not cmd.matched:
+        # insert-only MERGE: the matched side is the target unchanged (no
+        # cardinality constraint applies — reference behavior)
+        tables = [tgt_tbl]
+    else:
+        rid_tbl = tgt_tbl.append_column(
+            "__merge_rid", pa.array(range(tgt_tbl.num_rows), pa.int64()))
+        rid_attrs = [AttributeReference(a.name, a.dtype, True)
+                     for a in target_attrs] + \
+            [AttributeReference("__merge_rid", _i64, False)]
+        target_rel = SubqueryAlias(talias, LocalRelation(rid_attrs, rid_tbl)) \
+            if talias else LocalRelation(rid_attrs, rid_tbl)
 
-    src_flag = Project([UnresolvedStar(None),
-                        Alias(Literal(True), "__merge_m")], cmd.source)
-    joined = Join(target_rel, src_flag, "left_outer", cmd.condition)
+        src_flag = Project([UnresolvedStar(None),
+                            Alias(Literal(True), "__merge_m")], cmd.source)
+        joined = Join(target_rel, src_flag, "left_outer", cmd.condition)
 
-    probe = DataFrame(session, Project(
-        [Alias(UnresolvedAttribute(["__merge_rid"]), "__merge_rid"),
-         Alias(matched_ref, "__m")], joined)).toArrow()
-    matched_rids = [r for r, m in zip(probe.column("__merge_rid").to_pylist(),
-                                      probe.column("__m").to_pylist()) if m]
-    if len(matched_rids) != len(set(matched_rids)):
-        raise ExecutionError(
-            "MERGE_CARDINALITY_VIOLATION: a target row of the MERGE matched "
-            "more than one source row; rewrite the source to have at most "
-            "one match per target row")
-    eff = effective(cmd.matched, matched_ref)
-    del_cond = None
-    for cl, c in zip(cmd.matched, eff):
-        if cl.kind == "delete":
-            del_cond = c if del_cond is None else Or(del_cond, c)
-    base = joined if del_cond is None else \
-        Filter(Or(Not(del_cond), IsNull(del_cond)), joined)
-    proj = []
-    for a in target_attrs:
-        old = UnresolvedAttribute([talias, a.name])
-        e = old
-        for cl, c in reversed(list(zip(cmd.matched, eff))):
-            if cl.kind != "update":
-                continue
-            am = {n.lower(): x for n, x in cl.assignments}
-            if a.name.lower() in am:
-                e = If(c, am[a.name.lower()], e)
-        proj.append(Alias(Cast(e, a.dtype), a.name))
-    tables = [DataFrame(session, Project(proj, base)).toArrow()]
+        eff = effective(cmd.matched, matched_ref)
+        del_cond = None
+        for cl, c in zip(cmd.matched, eff):
+            if cl.kind == "delete":
+                del_cond = c if del_cond is None else Or(del_cond, c)
+        proj = []
+        for a in target_attrs:
+            old = UnresolvedAttribute([talias, a.name])
+            e = old
+            for cl, c in reversed(list(zip(cmd.matched, eff))):
+                if cl.kind != "update":
+                    continue
+                am = {n.lower(): x for n, x in cl.assignments}
+                if a.name.lower() in am:
+                    e = If(c, am[a.name.lower()], e)
+            proj.append(Alias(Cast(e, a.dtype), a.name))
+        aux = [Alias(UnresolvedAttribute(["__merge_rid"]), "__merge_rid"),
+               Alias(matched_ref, "__merge_mf")]
+        if del_cond is not None:
+            aux.append(Alias(del_cond, "__merge_del"))
+        out = DataFrame(session, Project(proj + aux, joined)).toArrow()
+
+        rids = [r for r, m in zip(out.column("__merge_rid").to_pylist(),
+                                  out.column("__merge_mf").to_pylist()) if m]
+        if len(rids) != len(set(rids)):
+            raise ExecutionError(
+                "MERGE_CARDINALITY_VIOLATION: a target row of the MERGE "
+                "matched more than one source row; rewrite the source to "
+                "have at most one match per target row")
+        if del_cond is not None:
+            keep = pa.array([d is not True for d in
+                             out.column("__merge_del").to_pylist()])
+            out = out.filter(keep)
+        tables = [out.select([a.name for a in target_attrs])]
 
     # not-matched side: source LEFT ANTI target → inserts
     if cmd.not_matched:

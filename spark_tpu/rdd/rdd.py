@@ -680,13 +680,15 @@ class RDDContext:
         self.cluster = cluster  # exec/cluster.LocalCluster for process mode
         self._rdd_counter = itertools.count()
         self._pool_inst = None  # lazy: no threads until the first job
+        self._pool_lock = threading.Lock()
         self._in_task = threading.local()
 
     # workers receive the lineage graph; runtime state stays driver-side
     # (the reference marks SparkContext @transient in closures)
     def __getstate__(self):
         state = dict(self.__dict__)
-        for k in ("_pool_inst", "_in_task", "cluster", "_rdd_counter"):
+        for k in ("_pool_inst", "_pool_lock", "_in_task", "cluster",
+                  "_rdd_counter"):
             state.pop(k, None)
         return state
 
@@ -697,14 +699,16 @@ class RDDContext:
         self.cluster = None
         self._rdd_counter = itertools.count(1 << 20)
         self._pool_inst = None
+        self._pool_lock = threading.Lock()
         self._in_task = threading.local()
 
     @property
     def _pool(self) -> ThreadPoolExecutor:
-        if self._pool_inst is None:
-            self._pool_inst = ThreadPoolExecutor(
-                max_workers=self.parallelism)
-        return self._pool_inst
+        with self._pool_lock:
+            if self._pool_inst is None:
+                self._pool_inst = ThreadPoolExecutor(
+                    max_workers=self.parallelism)
+            return self._pool_inst
 
     def _next_rdd_id(self) -> int:
         return next(self._rdd_counter)

@@ -168,6 +168,11 @@ class StreamingQuery:
         last = committed[-1]
         with open(os.path.join(self.checkpoint_dir, "offsets", str(last))) as f:
             self.committed_offset = json.load(f)["offset"]
+        try:
+            with open(os.path.join(cdir, str(last))) as f:
+                self.current_watermark_us = json.load(f).get("watermark_us")
+        except (OSError, ValueError):
+            pass
         self.batch_id = last
         self.state.load(last)
         if len(self.stream_leaves) == 2:
@@ -304,28 +309,45 @@ class StreamingQuery:
         out_table = self._execute_batch(new_data, batch_id)
         self.sink.add_batch(batch_id, out_table, self.output_mode)
 
+        # Advance the watermark at end-of-batch from this batch's max
+        # event time (previous-batch semantics, as the reference does).
+        if self.watermark is not None:
+            self._advance_watermark_from_input(new_data)
         if self.checkpoint_dir:
             with open(os.path.join(self.checkpoint_dir, "commits",
                                    str(batch_id)), "w") as f:
-                json.dump({"batch": batch_id}, f)
+                # end-of-batch watermark rides the commit log so recovery
+                # restores late-data protection (reference keeps it in
+                # offset metadata)
+                json.dump({"batch": batch_id,
+                           "watermark_us": self.current_watermark_us}, f)
         self.batch_id = batch_id
 
-        # Advance the watermark at end-of-batch from this batch's max
-        # event time (previous-batch semantics, as the reference does),
-        # then — like MicroBatchExecution, which constructs an extra batch
-        # when the watermark changed — run a no-new-data pass so
-        # append-mode finalization emits without waiting for more input.
+        # Like MicroBatchExecution — which constructs an extra batch when
+        # the watermark changed — run a no-new-data pass so append-mode
+        # finalization emits without waiting for more input. The pass is a
+        # real batch: its own id, offsets/commits WAL entries, and state
+        # version, so foreachBatch keeps its one-id-one-payload contract.
         # Runs before committed_offset flips so processAllAvailable can't
         # observe the sink mid-finalization.
-        if self.watermark is not None:
-            self._advance_watermark_from_input(new_data)
-            if (self.output_mode == "append"
-                    and self.current_watermark_us is not None
-                    and self.current_watermark_us != wm_before
-                    and self._plan_is_stateful()):
-                self.batch_id = batch_id = batch_id + 1
-                out2 = self._execute_batch(new_data.slice(0, 0), batch_id)
-                self.sink.add_batch(batch_id, out2, self.output_mode)
+        if (self.watermark is not None
+                and self.output_mode == "append"
+                and self.current_watermark_us is not None
+                and self.current_watermark_us != wm_before
+                and self._plan_is_stateful()):
+            fid = batch_id + 1
+            if self.checkpoint_dir:
+                with open(os.path.join(self.checkpoint_dir, "offsets",
+                                       str(fid)), "w") as f:
+                    json.dump({"offset": _json_safe(latest)}, f)
+            out2 = self._execute_batch(new_data.slice(0, 0), fid)
+            self.sink.add_batch(fid, out2, self.output_mode)
+            if self.checkpoint_dir:
+                with open(os.path.join(self.checkpoint_dir, "commits",
+                                       str(fid)), "w") as f:
+                    json.dump({"batch": fid,
+                               "watermark_us": self.current_watermark_us}, f)
+            self.batch_id = fid
         self.committed_offset = latest
         self.recent_progress.append({
             "batchId": batch_id,
@@ -534,12 +556,16 @@ class StreamingQuery:
 
     def _plan_is_stateful(self) -> bool:
         """True when the query plan carries state the late-data filter must
-        protect (an aggregation / dedup / stateful map)."""
+        protect (an aggregation / dedup / stateful map). Distinct counts:
+        the optimizer rewrites it to Aggregate in the plan the batch
+        executor checks."""
+        from ..plan.logical import Distinct
         from .stateful_map import StatefulMapGroups
 
         if isinstance(self.plan, StatefulMapGroups):
             return True
-        return any(isinstance(n, Aggregate) for n in self.plan.iter_nodes())
+        return any(isinstance(n, (Aggregate, Distinct))
+                   for n in self.plan.iter_nodes())
 
     def _drop_late_rows(self, new_data: pa.Table) -> pa.Table:
         """Drop input rows whose event time is older than the current

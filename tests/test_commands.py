@@ -260,3 +260,20 @@ def test_merge_cardinality_violation(spark):
         spark.sql("""
             MERGE INTO mcv_t AS t USING mcv_s AS s ON t.k = s.k
             WHEN MATCHED THEN UPDATE SET v = s.v""")
+
+
+def test_merge_insert_only_multi_match_ok(spark):
+    # insert-only MERGE has no cardinality constraint (reference behavior)
+    import pyarrow as pa
+
+    spark.createDataFrame(pa.table({"k": [1], "v": [10]})) \
+        .createOrReplaceTempView("mio_t")
+    spark.createDataFrame(pa.table({"k": [1, 1, 2], "v": [5, 6, 7]})) \
+        .createOrReplaceTempView("mio_s")
+    spark.sql("""
+        MERGE INTO mio_t AS t USING mio_s AS s ON t.k = s.k
+        WHEN NOT MATCHED THEN INSERT *""")
+    out = spark.sql("SELECT k, v FROM mio_t ORDER BY k, v") \
+        .toArrow().to_pydict()
+    assert out["k"] == [1, 2]
+    assert out["v"] == [10, 7]
