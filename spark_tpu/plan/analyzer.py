@@ -498,14 +498,27 @@ class ExtractWindowFromAggregate(Rule):
             from ..expr.expressions import AggregateFunction as AF
 
             # every aggregate function (including those inside window specs)
-            # computes in the inner aggregate
+            # computes in the inner aggregate — EXCEPT a window function
+            # head itself: `sum(sum(x)) OVER (...)` aggregates sum(x)
+            # inside, then windows over the grouped rows (the TPC-DS
+            # q12/q20/q98 shape)
             funcs: list[AF] = []
 
             def collect(e: Expression):
-                for x in e.iter_nodes():
-                    if isinstance(x, AF) and \
-                            not any(x.semantic_equals(f) for f in funcs):
-                        funcs.append(x)
+                if isinstance(e, WindowExpression):
+                    for c in e.function.children:
+                        collect(c)
+                    for p in e.partition_spec:
+                        collect(p)
+                    for o in e.order_spec:
+                        collect(o)
+                    return
+                if isinstance(e, AF):
+                    if not any(e.semantic_equals(f) for f in funcs):
+                        funcs.append(e)
+                    return
+                for c in e.children:
+                    collect(c)
 
             for e in node.aggregate_exprs:
                 collect(e)

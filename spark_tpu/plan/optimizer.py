@@ -171,6 +171,11 @@ class BooleanSimplification(Rule):
         t = lambda e: isinstance(e, Literal) and e.value is True
         f = lambda e: isinstance(e, Literal) and e.value is False
 
+        def split_disjuncts(e: Expression) -> list[Expression]:
+            if isinstance(e, Or):
+                return split_disjuncts(e.left) + split_disjuncts(e.right)
+            return [e]
+
         def simp(e: Expression) -> Expression:
             if isinstance(e, And):
                 if t(e.left):
@@ -186,6 +191,30 @@ class BooleanSimplification(Rule):
                     return e.left
                 if t(e.left) or t(e.right):
                     return Literal(True)
+                # common-factor extraction (reference: BooleanSimplification
+                # "(a && b) || (a && c) => a && (b || c)") — load-bearing
+                # for TPC-DS q13/q48/q85, where all join keys sit inside OR
+                # branches and factoring them out re-enables equi-joins
+                branches = [split_conjuncts(b) for b in split_disjuncts(e)]
+                if len(branches) > 1:
+                    common = [c for c in branches[0]
+                              if all(any(c.semantic_equals(x) for x in b)
+                                     for b in branches[1:])]
+                    if common:
+                        residuals = []
+                        for b in branches:
+                            rest = [x for x in b
+                                    if not any(x.semantic_equals(c)
+                                               for c in common)]
+                            residuals.append(join_conjuncts(rest) or
+                                             Literal(True))
+                        out = join_conjuncts(common)
+                        if not any(t(r) for r in residuals):
+                            disj = residuals[0]
+                            for r in residuals[1:]:
+                                disj = Or(disj, r)
+                            out = And(out, disj)
+                        return out
             if isinstance(e, Not):
                 if t(e.child):
                     return Literal(False)

@@ -166,7 +166,26 @@ class RewritePredicateSubquery(Rule):
                 if not handled:
                     kept.append(conj)
             if kept:
-                return Filter(join_conjuncts(kept), base)
+                # EXISTS/IN under OR (not a top-level conjunct): lower each
+                # to an existence-join boolean flag (reference plans these
+                # as ExistenceJoin) — the TPC-DS q10/q35 shape
+                # `exists(...) and (exists(...) or exists(...))`
+                new_kept = []
+                for conj in kept:
+                    while True:
+                        target = next(
+                            (x for x in conj.iter_nodes()
+                             if isinstance(x, (InSubquery, Exists))), None)
+                        if target is None:
+                            break
+                        base, rep = _existence_flag(target, base, outer_ids)
+
+                        def replace(x, _t=target, _r=rep):
+                            return _r if x is _t else x
+
+                        conj = conj.transform_up(replace)
+                    new_kept.append(conj)
+                return Filter(join_conjuncts(new_kept), base)
             return base
 
         return plan.transform_up(rule)
@@ -252,12 +271,46 @@ def _expose_correlation_keys(
         "correlated key is not reachable from the subquery output")
 
 
+def _existence_flag(target, child: LogicalPlan, outer_ids: set[int]):
+    """Lower one IN/EXISTS expression to a left_outer "existence join"
+    producing a boolean flag over `child` (reference: sqlcat
+    ExistenceJoin). Returns (joined_plan, replacement_expression).
+    Two-valued: a NULL probe value yields false rather than NULL
+    (documented deviation)."""
+    sub, pairs, ok = split_correlation(target.plan, outer_ids)
+    if not ok:
+        raise UnsupportedOperationError(
+            "unsupported correlated subquery in value position")
+    flag = Alias(Literal(True), "__exists")
+    cond = None
+    if isinstance(target, InSubquery):
+        value_attr = sub.output[0]
+        sub = _expose_correlation_keys(sub, pairs)
+        keys = [value_attr] + [ie for _, ie in pairs]
+        dsub = Aggregate(list(keys), list(keys) + [flag], sub)
+        cond = EqualTo(target.value, value_attr)
+        for outer_e, ie in pairs:
+            cond = And(cond, EqualTo(outer_e, ie))
+    elif pairs:
+        sub = _expose_correlation_keys(sub, pairs)
+        keys = [ie for _, ie in pairs]
+        dsub = Aggregate(list(keys), list(keys) + [flag], sub)
+        for outer_e, ie in pairs:
+            c = EqualTo(outer_e, ie)
+            cond = c if cond is None else And(cond, c)
+    else:
+        # uncorrelated EXISTS: 0/1-row flag relation, cross-style
+        # left_outer (condition-less nested loop)
+        dsub = Project([flag], Limit(1, sub))
+    flag_attr = dsub.output[-1]
+    joined = Join(child, dsub, "left_outer", cond)
+    return joined, IsNotNull(flag_attr)
+
+
 class RewriteExistenceSubquery(Rule):
-    """IN/EXISTS used as a VALUE (inside a projection) → left_outer
-    "existence join" producing a boolean flag (reference: sqlcat
-    ExistenceJoin planned by RewritePredicateSubquery when the predicate
-    is not a top-level Filter conjunct). Two-valued: a NULL probe value
-    yields false rather than NULL (documented deviation)."""
+    """IN/EXISTS used as a VALUE (inside a projection) → existence join
+    (reference: sqlcat ExistenceJoin planned by RewritePredicateSubquery
+    when the predicate is not a top-level Filter conjunct)."""
 
     def apply(self, plan):
         def rule(node):
@@ -274,34 +327,7 @@ class RewriteExistenceSubquery(Rule):
             if target is None:
                 return node
             outer_ids = {a.expr_id for a in node.child.output}
-            sub, pairs, ok = split_correlation(target.plan, outer_ids)
-            if not ok:
-                raise UnsupportedOperationError(
-                    "unsupported correlated subquery in SELECT")
-            flag = Alias(Literal(True), "__exists")
-            cond = None
-            if isinstance(target, InSubquery):
-                value_attr = sub.output[0]
-                sub = _expose_correlation_keys(sub, pairs)
-                keys = [value_attr] + [ie for _, ie in pairs]
-                dsub = Aggregate(list(keys), list(keys) + [flag], sub)
-                cond = EqualTo(target.value, value_attr)
-                for outer_e, ie in pairs:
-                    cond = And(cond, EqualTo(outer_e, ie))
-            elif pairs:
-                sub = _expose_correlation_keys(sub, pairs)
-                keys = [ie for _, ie in pairs]
-                dsub = Aggregate(list(keys), list(keys) + [flag], sub)
-                for outer_e, ie in pairs:
-                    c = EqualTo(outer_e, ie)
-                    cond = c if cond is None else And(cond, c)
-            else:
-                # uncorrelated EXISTS: 0/1-row flag relation, cross-style
-                # left_outer (condition-less nested loop)
-                dsub = Project([flag], Limit(1, sub))
-            flag_attr = dsub.output[-1]
-            joined = Join(node.child, dsub, "left_outer", cond)
-            rep = IsNotNull(flag_attr)
+            joined, rep = _existence_flag(target, node.child, outer_ids)
 
             def replace(x: Expression) -> Expression:
                 return rep if x is target else x

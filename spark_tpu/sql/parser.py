@@ -1080,11 +1080,25 @@ def _refresh_alias_ids(plan: L.LogicalPlan) -> L.LogicalPlan:
 
 def _substitute_ctes(plan: L.LogicalPlan,
                      ctes: dict[str, L.LogicalPlan]) -> L.LogicalPlan:
+    import copy
+
+    from ..plan.subquery import SubqueryExpression
+
+    def fix_expr(ex):
+        # CTEs are visible inside subquery expressions too (reference:
+        # CTESubstitution runs over subquery plans) — q1-style
+        # `WITH ctr AS (...) ... WHERE x > (SELECT avg(..) FROM ctr)`
+        if isinstance(ex, SubqueryExpression):
+            new = copy.copy(ex)
+            new.plan = _substitute_ctes(ex.plan, ctes)
+            return new
+        return ex
+
     def rule(node):
         if isinstance(node, L.UnresolvedRelation):
             hit = ctes.get(node.name.lower())
             if hit is not None:
                 return _refresh_alias_ids(hit)
-        return node
+        return node.map_expressions(lambda e: e.transform_up(fix_expr))
 
     return plan.transform_up(rule)

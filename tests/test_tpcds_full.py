@@ -1,0 +1,146 @@
+"""The real TPC-DS q1–q99 suite as the regression gate.
+
+Role of the reference's TPCDSQueryTestSuite
+(sql/core/src/test/scala/org/apache/spark/sql/TPCDSQueryTestSuite.scala):
+every benchmark query executes over deterministic generated data
+(tests/tpcds/datagen.py, the GenTPCDSData analog) and its full sorted
+result is checked against a committed golden file produced by an
+INDEPENDENT engine (sqlite — tests/tpcds/oracle.py), the analog of the
+committed tpcds-query-results.
+
+Regenerate goldens (after datagen/oracle changes):
+    SPARK_TPU_REGEN_TPCDS=1 python -m pytest tests/test_tpcds_full.py -q
+
+Queries using ROLLUP/GROUPING() (sqlite can't express them) are
+"exec-tier": they must execute and their committed row-shape is pinned,
+but values are engine-produced (cross-checked between configs), not
+independently verified.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import signal
+
+import pytest
+
+HERE = os.path.dirname(__file__)
+QUERY_DIR = os.path.join(HERE, "tpcds", "queries")
+GOLDEN_DIR = os.path.join(HERE, "tpcds", "expected")
+SCALE = 0.1
+REGEN = os.environ.get("SPARK_TPU_REGEN_TPCDS") == "1"
+
+# sqlite cannot run ROLLUP/GROUPING() — exec-tier (see module docstring)
+EXEC_ONLY = {"q5", "q14a", "q18", "q22", "q27", "q36", "q67", "q70",
+             "q77", "q80", "q86"}
+# triaged out entirely (engine gap or pathological runtime at any scale);
+# each entry must carry a reason — shrink this set as gaps close
+SKIP: dict[str, str] = {}
+
+ALL_QUERIES = sorted(
+    os.path.basename(f)[:-4]
+    for f in glob.glob(os.path.join(QUERY_DIR, "q*.sql")))
+
+PER_QUERY_TIMEOUT = int(os.environ.get("SPARK_TPU_TPCDS_TIMEOUT", "240"))
+
+
+def _norm_rows(table):
+    """Engine arrow table → normalized sorted row list (shared shape with
+    the oracle's normalization)."""
+    from tests.tpcds.oracle import _norm_cell, _sort_key
+
+    cols = [table.column(i).to_pylist() for i in range(table.num_columns)]
+    rows = [tuple(_norm_cell(c) for c in r) for r in zip(*cols)] \
+        if cols else []
+    return sorted(rows, key=_sort_key)
+
+
+@pytest.fixture(scope="session")
+def tpcds(spark):
+    from tests.tpcds.datagen import gen_tpcds_full
+
+    tables = gen_tpcds_full(scale=SCALE)
+    for name, tab in tables.items():
+        spark.createDataFrame(tab).createOrReplaceTempView(name)
+    yield {"spark": spark, "tables": tables}
+
+
+class _QueryTimeout(Exception):
+    pass
+
+
+def _alarm(signum, frame):
+    raise _QueryTimeout()
+
+
+@pytest.mark.tpcds
+@pytest.mark.parametrize("qname", ALL_QUERIES)
+def test_tpcds_query(tpcds, qname):
+    if qname in SKIP:
+        pytest.skip(SKIP[qname])
+    from tests.tpcds.oracle import strip_trailing_limit
+
+    spark = tpcds["spark"]
+    sql = strip_trailing_limit(
+        open(os.path.join(QUERY_DIR, f"{qname}.sql")).read())
+
+    old = signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(PER_QUERY_TIMEOUT)
+    try:
+        result = spark.sql(sql).toArrow()
+    except _QueryTimeout:
+        pytest.fail(f"{qname}: exceeded {PER_QUERY_TIMEOUT}s")
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+    rows = _norm_rows(result)
+
+    golden_path = os.path.join(GOLDEN_DIR, f"{qname}.json")
+    if REGEN:
+        if qname in EXEC_ONLY:
+            payload = {"tier": "exec", "num_rows": len(rows),
+                       "num_cols": result.num_columns,
+                       "rows": [list(r) for r in rows]}
+        else:
+            from tests.tpcds.datagen import gen_tpcds_full
+            from tests.tpcds.oracle import (
+                load_sqlite, rewrite_for_sqlite,
+            )
+
+            conn = _oracle_conn(tpcds)
+            osql = rewrite_for_sqlite(sql, qname)
+            orows = conn.execute(osql).fetchall()
+            from tests.tpcds.oracle import _norm_cell, _sort_key
+
+            orows = sorted(
+                [tuple(_norm_cell(c) for c in r) for r in orows],
+                key=_sort_key)
+            payload = {"tier": "oracle", "num_rows": len(orows),
+                       "num_cols": len(orows[0]) if orows else
+                       result.num_columns,
+                       "rows": [list(r) for r in orows]}
+        os.makedirs(GOLDEN_DIR, exist_ok=True)
+        with open(golden_path, "w") as f:
+            json.dump(payload, f)
+
+    if not os.path.exists(golden_path):
+        pytest.skip(f"{qname}: no golden (regen with "
+                    "SPARK_TPU_REGEN_TPCDS=1)")
+    golden = json.load(open(golden_path))
+    expected = [tuple(r) for r in golden["rows"]]
+
+    from tests.tpcds.oracle import compare_rows
+
+    ok, msg = compare_rows(rows, expected)
+    label = "oracle" if golden["tier"] == "oracle" else "exec-tier pin"
+    assert ok, f"{qname} vs {label}: {msg}"
+
+
+def _oracle_conn(tpcds_env):
+    if "_oracle" not in tpcds_env:
+        from tests.tpcds.oracle import load_sqlite
+
+        tpcds_env["_oracle"] = load_sqlite(tpcds_env["tables"])
+    return tpcds_env["_oracle"]

@@ -1,0 +1,225 @@
+"""sqlite-based result oracle for the TPC-DS suite.
+
+Role of the reference's committed `tpcds-query-results` (which are tied
+to dsdgen SF1 data we cannot regenerate): an independent engine executes
+the same query over the same generated tables and the row sets are
+compared. sqlite 3.40 covers the full dialect except GROUPING
+SETS/ROLLUP (those queries are validated by cross-config self-checks in
+the harness instead).
+
+The rewrite layer translates the handful of constructs sqlite spells
+differently (date INTERVAL arithmetic, DECIMAL casts, stddev_samp via a
+registered Python aggregate). Dates live as ISO text so BETWEEN/compare
+work lexically.
+"""
+
+from __future__ import annotations
+
+import datetime
+import math
+import re
+import sqlite3
+from decimal import Decimal
+
+
+class _StddevSamp:
+    def __init__(self):
+        self.vals = []
+
+    def step(self, v):
+        if v is not None:
+            self.vals.append(float(v))
+
+    def finalize(self):
+        n = len(self.vals)
+        if n < 2:
+            return None
+        m = sum(self.vals) / n
+        return math.sqrt(sum((x - m) ** 2 for x in self.vals) / (n - 1))
+
+
+class _VarSamp(_StddevSamp):
+    def finalize(self):
+        n = len(self.vals)
+        if n < 2:
+            return None
+        m = sum(self.vals) / n
+        return sum((x - m) ** 2 for x in self.vals) / (n - 1)
+
+
+def _concat(*args):
+    if any(a is None for a in args):
+        return None
+    return "".join(str(a) for a in args)
+
+
+def load_sqlite(tables) -> sqlite3.Connection:
+    conn = sqlite3.connect(":memory:")
+    conn.create_aggregate("stddev_samp", 1, _StddevSamp)
+    conn.create_aggregate("var_samp", 1, _VarSamp)
+    conn.create_aggregate("stddev", 1, _StddevSamp)
+    conn.create_function("concat", -1, _concat)
+    for name, tab in tables.items():
+        cols = tab.column_names
+        conn.execute(f"CREATE TABLE {name} ({', '.join(cols)})")
+        pyrows = []
+        pycols = []
+        for c in cols:
+            vals = tab.column(c).to_pylist()
+            conv = []
+            for v in vals:
+                if isinstance(v, Decimal):
+                    v = float(v)
+                elif isinstance(v, (datetime.date, datetime.datetime)):
+                    v = v.isoformat()[:10]
+                conv.append(v)
+            pycols.append(conv)
+        pyrows = list(zip(*pycols))
+        conn.executemany(
+            f"INSERT INTO {name} VALUES ({','.join('?' * len(cols))})",
+            pyrows)
+    conn.commit()
+    return conn
+
+
+_INTERVAL = re.compile(
+    r"\(\s*cast\s*\(\s*'(\d{4}-\d{2}-\d{2})'\s+as\s+date\s*\)\s*"
+    r"([+-])\s*interval\s+(\d+)\s+days?\s*\)", re.I)
+_INTERVAL_COL = re.compile(
+    r"\(\s*cast\s*\(\s*([\w.]+)\s+as\s+date\s*\)\s*"
+    r"([+-])\s*interval\s+(\d+)\s+days?\s*\)", re.I)
+_CAST_DATE = re.compile(
+    r"cast\s*\(\s*'(\d{4}-\d{2}-\d{2})'\s+as\s+date\s*\)", re.I)
+_DECIMAL_T = re.compile(r"decimal\s*\(\s*\d+\s*,\s*\d+\s*\)", re.I)
+# sqlite rejects parenthesized members of compound selects:
+# "... UNION ALL (SELECT" / ") UNION ..." — unwrap the parens
+_COMPOUND_OPEN = re.compile(
+    r"\b(UNION\s+ALL|UNION|INTERSECT|EXCEPT)\s*\(\s*(SELECT)\b", re.I)
+
+
+_COMPOUND_CLOSE = re.compile(
+    r"\)\s*(UNION\s+ALL|UNION|INTERSECT|EXCEPT)\b", re.I)
+
+
+def _unwrap_compound(sql: str) -> str:
+    """Remove parentheses around compound-select members (sqlite rejects
+    them): both `UNION (SELECT ...)` and `(SELECT ...) UNION`, matching
+    parens by depth and unwrapping only when the paren directly wraps a
+    SELECT."""
+    while True:
+        m = _COMPOUND_OPEN.search(sql)
+        if not m:
+            break
+        open_idx = sql.index("(", m.end(1))
+        depth, i = 0, open_idx
+        while i < len(sql):
+            if sql[i] == "(":
+                depth += 1
+            elif sql[i] == ")":
+                depth -= 1
+                if depth == 0:
+                    break
+            i += 1
+        sql = (sql[:open_idx] + " " + sql[open_idx + 1:i] + " " +
+               sql[i + 1:])
+    # leading members: `) UNION` whose matching `(` directly wraps SELECT
+    while True:
+        changed = False
+        for m in _COMPOUND_CLOSE.finditer(sql):
+            close_idx = m.start()
+            depth, i = 0, close_idx
+            while i >= 0:
+                if sql[i] == ")":
+                    depth += 1
+                elif sql[i] == "(":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                i -= 1
+            prev = sql[:i].rstrip()[-1:] if i > 0 else ""
+            # only a member-wrapper when directly wrapping SELECT and not
+            # an expression paren (e.g. `IN (SELECT ...)` before UNION)
+            if (i >= 0 and re.match(r"\(\s*SELECT\b", sql[i:], re.I)
+                    and (prev == "(" or prev == "")):
+                sql = (sql[:i] + " " + sql[i + 1:close_idx] + " " +
+                       sql[close_idx + 1:])
+                changed = True
+                break
+        if not changed:
+            return sql
+
+
+# per-query disambiguation patches: sqlite binds unqualified ORDER BY
+# names to input tables before output aliases and reports ambiguity where
+# the reference dialect resolves to the select-list alias
+QUERY_PATCHES = {
+    "q58": [("ORDER BY item_id", "ORDER BY ss_items.item_id")],
+    "q72": [("w_warehouse_name, d_week_seq",
+             "w_warehouse_name, d1.d_week_seq")],
+}
+
+
+def rewrite_for_sqlite(sql: str, qname: str | None = None) -> str:
+    for old, new in QUERY_PATCHES.get(qname or "", []):
+        sql = sql.replace(old, new)
+    sql = _INTERVAL.sub(lambda m: f"date('{m.group(1)}', "
+                        f"'{m.group(2)}{m.group(3)} day')", sql)
+    sql = _INTERVAL_COL.sub(lambda m: f"date({m.group(1)}, "
+                            f"'{m.group(2)}{m.group(3)} day')", sql)
+    sql = _CAST_DATE.sub(lambda m: f"'{m.group(1)}'", sql)
+    sql = _DECIMAL_T.sub("REAL", sql)
+    sql = _unwrap_compound(sql)
+    return sql
+
+
+_TRAILING_LIMIT = re.compile(r"\blimit\s+\d+\s*;?\s*$", re.I)
+
+
+def strip_trailing_limit(sql: str) -> str:
+    """Drop the final LIMIT so tie-broken top-N rows can't produce
+    spurious mismatches between engines (the full sorted sets compare
+    deterministically)."""
+    return _TRAILING_LIMIT.sub("", sql.rstrip())
+
+
+def _norm_cell(v):
+    if v is None:
+        return None
+    if isinstance(v, Decimal):
+        v = float(v)
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "nan"
+        return round(v, 2)
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return v.isoformat()[:10]
+    if isinstance(v, bool):
+        return int(v)
+    return v
+
+
+def _sort_key(row):
+    return tuple((x is None, str(x)) for x in row)
+
+
+def compare_rows(engine_rows, oracle_rows, rel_tol=1e-4, abs_tol=0.02):
+    """Multiset comparison, order-insensitive, with numeric tolerance.
+    Returns (ok, message)."""
+    a = sorted([tuple(_norm_cell(c) for c in r) for r in engine_rows],
+               key=_sort_key)
+    b = sorted([tuple(_norm_cell(c) for c in r) for r in oracle_rows],
+               key=_sort_key)
+    if len(a) != len(b):
+        return False, f"row count {len(a)} != oracle {len(b)}"
+    for i, (ra, rb) in enumerate(zip(a, b)):
+        if len(ra) != len(rb):
+            return False, f"col count {len(ra)} != {len(rb)}"
+        for ca, cb in zip(ra, rb):
+            if ca == cb:
+                continue
+            if isinstance(ca, (int, float)) and isinstance(cb, (int, float)):
+                if math.isclose(float(ca), float(cb), rel_tol=rel_tol,
+                                abs_tol=abs_tol):
+                    continue
+            return False, (f"row {i}: {ra} != oracle {rb}")
+    return True, "ok"
