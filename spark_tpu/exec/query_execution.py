@@ -137,7 +137,13 @@ class QueryExecution:
 
                 batches = [ColumnarBatch.empty(schema)]
             tables = [b.to_arrow() for b in batches]
-            out = pa.concat_tables(tables, promote_options="permissive")
+            try:
+                # identical schemas concat fine even with duplicate output
+                # names (legal, as in the reference); permissive unify
+                # (which rejects duplicates) only for promotions
+                out = pa.concat_tables(tables)
+            except pa.lib.ArrowInvalid:
+                out = pa.concat_tables(tables, promote_options="permissive")
             limit = int(self.session.conf.get(MAX_RESULT_ROWS))
             if out.num_rows > limit:
                 raise RuntimeError(

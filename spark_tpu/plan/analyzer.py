@@ -278,6 +278,13 @@ def _pretty_name(e: Expression) -> str:
         return str(e.value)
     if isinstance(e, _Cast):
         return _pretty_name(e.child)
+    sym = getattr(e, "symbol", None)
+    if sym is not None and hasattr(e, "left") and hasattr(e, "right"):
+        return f"({_pretty_name(e.left)} {sym} {_pretty_name(e.right)})"
+    kids = [c for c in e.children if c is not None]
+    if kids:
+        return (f"{e.sql_name()}"
+                f"({', '.join(_pretty_name(c) for c in kids)})")
     return e.simple_string()
 
 
@@ -317,17 +324,25 @@ class ResolveAggsInSortHaving(Rule):
                                for c in e.args):
                             f = build_function(e.fname, e.args, e.distinct)
                             if isinstance(f, AggregateFunction):
-                                # match an existing aggregate output
-                                for ae in agg.aggregate_exprs:
-                                    if isinstance(ae, Alias) and \
-                                            ae.child.semantic_equals(f):
-                                        return ae.to_attribute()
-                                al = Alias(f, _pretty_name(f))
-                                extra.append(al)
-                                return al.to_attribute()
+                                return match_agg(f)
                             return f
                         return e
+                    # an aggregate already built by general function
+                    # resolution (e.g. count(*), whose args resolve
+                    # immediately) still has to bind to the aggregate's
+                    # output or be pulled into it
+                    if isinstance(e, AggregateFunction) and e.resolved:
+                        return match_agg(e)
                     return e
+
+                def match_agg(f: Expression) -> Expression:
+                    for ae in agg.aggregate_exprs:
+                        if isinstance(ae, Alias) and \
+                                ae.child.semantic_equals(f):
+                            return ae.to_attribute()
+                    al = Alias(f, _pretty_name(f))
+                    extra.append(al)
+                    return al.to_attribute()
 
                 # resolve against agg child FIRST for agg args
                 def resolve_inner_attrs(e):
@@ -356,6 +371,16 @@ class ResolveAggsInSortHaving(Rule):
                     for o in node.orders:
                         c = o.child.transform_up(resolve_inner_attrs)
                         c = c.transform_up(resolve)
+                        # a whole order expression that semantically equals
+                        # a select-list item binds to that output (q62:
+                        # ORDER BY substr(col,1,20) over GROUP BY the same
+                        # expression — col no longer exists post-aggregate)
+                        for ae in agg.aggregate_exprs:
+                            if isinstance(ae, Alias) and not isinstance(
+                                    c, AttributeReference) and \
+                                    ae.child.semantic_equals(c):
+                                c = ae.to_attribute()
+                                break
                         if c is not o.child:
                             changed = True
                             orders.append(SortOrder(c, o.ascending, o.nulls_first))
@@ -486,9 +511,11 @@ class ExtractWindowFromAggregate(Rule):
 
     def apply(self, plan):
         from ..expr.window import WindowExpression
+        from .logical import GroupingSets
 
         def rule(node):
-            if not isinstance(node, Aggregate) or not node.expressions_resolved:
+            if not isinstance(node, (Aggregate, GroupingSets)) or \
+                    not node.expressions_resolved:
                 return node
             if not any(isinstance(x, WindowExpression)
                        for e in node.aggregate_exprs
@@ -496,6 +523,7 @@ class ExtractWindowFromAggregate(Rule):
                 return node
 
             from ..expr.expressions import AggregateFunction as AF
+            from ..expr.expressions import Grouping, GroupingID
 
             # every aggregate function (including those inside window specs)
             # computes in the inner aggregate — EXCEPT a window function
@@ -513,7 +541,7 @@ class ExtractWindowFromAggregate(Rule):
                     for o in e.order_spec:
                         collect(o)
                     return
-                if isinstance(e, AF):
+                if isinstance(e, (AF, Grouping, GroupingID)):
                     if not any(e.semantic_equals(f) for f in funcs):
                         funcs.append(e)
                     return
@@ -534,11 +562,10 @@ class ExtractWindowFromAggregate(Rule):
                     inner_outs.append(al)
                     g_aliases.append((g, al.to_attribute()))
             f_aliases = [Alias(f, f"_wa{i}") for i, f in enumerate(funcs)]
-            inner = Aggregate(node.grouping_exprs, inner_outs + f_aliases,
-                              node.child)
+            inner = node.copy(aggregate_exprs=inner_outs + f_aliases)
 
             def fix(x: Expression) -> Expression:
-                if isinstance(x, AF):
+                if isinstance(x, (AF, Grouping, GroupingID)):
                     for f, al in zip(funcs, f_aliases):
                         if x.semantic_equals(f):
                             return al.to_attribute()

@@ -1207,6 +1207,48 @@ def _rewrite_correlated_scalar():
     return RewriteCorrelatedScalarSubquery()
 
 
+class OptimizeSubqueryPlans(Rule):
+    """Apply structural rules inside subquery expression plans (reference:
+    Optimizer OptimizeSubqueries) — an INTERSECT/ROLLUP/DISTINCT inside an
+    IN/EXISTS/scalar subquery must be rewritten before the subquery
+    itself is unwrapped into a join."""
+
+    def __init__(self, rules):
+        self.rules = rules
+
+    def apply(self, plan):
+        import copy
+
+        from .subquery import SubqueryExpression
+
+        def fix_expr(ex):
+            if isinstance(ex, SubqueryExpression):
+                p = self.apply(ex.plan)  # nested subqueries first
+                for r in self.rules:
+                    p = r.apply(p)
+                if p is not ex.plan:
+                    new = copy.copy(ex)
+                    new.plan = p
+                    return new
+            return ex
+
+        def rule(node):
+            return node.map_expressions(
+                lambda e: e.transform_up(fix_expr))
+
+        return plan.transform_up(rule)
+
+
+def _finish_analysis_rules():
+    return [
+        EliminateSubqueryAliases(),
+        ReplaceSetOps(),
+        ExpandGroupingSets(),
+        ReplaceDistinct(),
+        RewriteDistinctAggregates(),
+    ]
+
+
 class Optimizer(RuleExecutor):
     def __init__(self):
         super().__init__()
@@ -1214,11 +1256,12 @@ class Optimizer(RuleExecutor):
     def batches(self):
         return [
             Batch("Finish analysis", Once(), [
-                EliminateSubqueryAliases(),
-                ReplaceSetOps(),
-                ExpandGroupingSets(),
-                ReplaceDistinct(),
-                RewriteDistinctAggregates(),
+                # subquery plans also get boolean simplification here so
+                # OR-factored correlated equalities (q41) surface as
+                # conjuncts before the Subqueries batch decorrelates
+                OptimizeSubqueryPlans(_finish_analysis_rules() +
+                                      [BooleanSimplification()]),
+                *_finish_analysis_rules(),
             ]),
             Batch("Subqueries", FixedPoint(10), [
                 _rewrite_predicate_subquery(),

@@ -93,14 +93,18 @@ class Exists(SubqueryExpression):
 # Correlation analysis
 # ---------------------------------------------------------------------------
 
-def split_correlation(subplan: LogicalPlan, outer_ids: set[int]):
-    """Pull equality predicates referencing outer attributes out of the
-    subquery (the reference's pullOutCorrelatedPredicates). Returns
-    (decorrelated_plan, [(outer_expr, inner_attr)], ok). Only
-    `outer_attr = inner_expr` conjuncts under Filter nodes are supported."""
+def split_correlation(subplan: LogicalPlan, outer_ids: set[int],
+                      with_residuals: bool = False):
+    """Pull correlated predicates out of the subquery (the reference's
+    pullOutCorrelatedPredicates). Returns
+    (decorrelated_plan, [(outer_expr, inner_attr)], residuals, ok):
+    `outer = inner` conjuncts become join pairs; with_residuals=True also
+    pulls arbitrary correlated conjuncts (e.g. `outer.w <> inner.w`, the
+    TPC-DS q16/q94 shape) to be re-applied as join-condition residuals."""
     from .optimizer import join_conjuncts, split_conjuncts
 
     pairs: list[tuple[Expression, Expression]] = []
+    residuals: list[Expression] = []
     failed = [False]
 
     def rule(node):
@@ -121,6 +125,9 @@ def split_correlation(subplan: LogicalPlan, outer_ids: set[int]):
                     if rr <= outer_ids and not (lr & outer_ids):
                         pairs.append((c.right, c.left))
                         continue
+                if with_residuals:
+                    residuals.append(c)
+                    continue
                 failed[0] = True
                 keep.append(c)
             cond = join_conjuncts(keep)
@@ -136,7 +143,7 @@ def split_correlation(subplan: LogicalPlan, outer_ids: set[int]):
         for e in n.expressions():
             if e.references() & outer_ids:
                 failed[0] = True
-    return out, pairs, not failed[0]
+    return out, pairs, residuals, not failed[0]
 
 
 # ---------------------------------------------------------------------------
@@ -200,12 +207,14 @@ class RewritePredicateSubquery(Rule):
                 neg = True
                 e = inner
         if isinstance(e, InSubquery):
-            sub, pairs, ok = split_correlation(e.plan, outer_ids)
+            sub, pairs, residuals, ok = split_correlation(
+                e.plan, outer_ids, with_residuals=True)
             if not ok:
                 raise UnsupportedOperationError(
                     "unsupported correlated IN subquery")
             value_attr = sub.output[0]
-            sub = _expose_correlation_keys(sub, pairs)
+            sub = _expose_correlation_keys(sub, pairs, residuals,
+                                           outer_ids)
             eq: Expression = EqualTo(e.value, value_attr)
             if neg and (e.value.nullable or value_attr.nullable):
                 # null-aware anti join (reference: subquery.scala
@@ -216,19 +225,25 @@ class RewritePredicateSubquery(Rule):
             cond: Expression = eq
             for outer_e, inner_e in pairs:
                 cond = And(cond, EqualTo(outer_e, inner_e))
+            for r in residuals:
+                cond = And(cond, r)
             jt = "left_anti" if neg else "left_semi"
             return Join(base, sub, jt, cond), True
         if isinstance(e, Exists):
-            sub, pairs, ok = split_correlation(e.plan, outer_ids)
+            sub, pairs, residuals, ok = split_correlation(
+                e.plan, outer_ids, with_residuals=True)
             if not ok:
                 raise UnsupportedOperationError(
                     "unsupported correlated EXISTS subquery")
-            if pairs:
-                sub = _expose_correlation_keys(sub, pairs)
+            if pairs or residuals:
+                sub = _expose_correlation_keys(sub, pairs, residuals,
+                                               outer_ids)
                 cond = None
                 for outer_e, inner_e in pairs:
                     c = EqualTo(outer_e, inner_e)
                     cond = c if cond is None else And(cond, c)
+                for r in residuals:
+                    cond = r if cond is None else And(cond, r)
             else:
                 # uncorrelated EXISTS: constant-key semi join
                 one = Alias(Literal(1), "__one")
@@ -241,17 +256,26 @@ class RewritePredicateSubquery(Rule):
 
 def _expose_correlation_keys(
         sub: LogicalPlan,
-        pairs: Sequence[tuple[Expression, Expression]]) -> LogicalPlan:
+        pairs: Sequence[tuple[Expression, Expression]],
+        residuals: Sequence[Expression] = (),
+        outer_ids: set[int] | None = None) -> LogicalPlan:
     """Rewrite the decorrelated subplan so the inner key attributes appear
     in its output. An aggregate regains them as GROUPING keys (turning a
     per-outer-row aggregate into a grouped one — the decorrelation core);
-    a projection just widens."""
+    a projection just widens. Residual predicates' inner attributes are
+    exposed the same way."""
     keys: list[AttributeReference] = []
     for _, ie in pairs:
         if not isinstance(ie, AttributeReference):
             raise UnsupportedOperationError(
                 "correlated predicate must compare to a plain subquery column")
         keys.append(ie)
+    for r in residuals:
+        for x in r.iter_nodes():
+            if isinstance(x, AttributeReference) and \
+                    (outer_ids is None or x.expr_id not in outer_ids) and \
+                    not any(x.expr_id == k.expr_id for k in keys):
+                keys.append(x)
     out_ids = {a.expr_id for a in sub.output}
     missing = [k for k in keys if k.expr_id not in out_ids]
     if not missing:
@@ -277,7 +301,7 @@ def _existence_flag(target, child: LogicalPlan, outer_ids: set[int]):
     ExistenceJoin). Returns (joined_plan, replacement_expression).
     Two-valued: a NULL probe value yields false rather than NULL
     (documented deviation)."""
-    sub, pairs, ok = split_correlation(target.plan, outer_ids)
+    sub, pairs, _res, ok = split_correlation(target.plan, outer_ids)
     if not ok:
         raise UnsupportedOperationError(
             "unsupported correlated subquery in value position")
@@ -364,7 +388,7 @@ class RewriteCorrelatedScalarSubquery(Rule):
             if corr is None:
                 return node
 
-            sub, pairs, ok = split_correlation(corr.plan, outer_ids)
+            sub, pairs, _res, ok = split_correlation(corr.plan, outer_ids)
             if not ok or not pairs:
                 raise UnsupportedOperationError(
                     "unsupported correlated scalar subquery (only equality "
