@@ -21,7 +21,7 @@ from ..expr.window import (
     CumeDist, DenseRank, Lag, Lead, NTile, PercentRank, Rank, RowNumber,
     WindowExpression,
 )
-from ..types import StringType, float64, int32, int64
+from ..types import DecimalType, StringType, float64, int32, int64
 from .compile import GLOBAL_KERNEL_CACHE
 from .operators import PhysicalPlan, attrs_schema
 from .partitioning import AllTuples, ClusteredDistribution, UnspecifiedDistribution
@@ -239,6 +239,15 @@ class WindowExec(PhysicalPlan):
         new_cols = list(batch.columns)
         for (d, v), al in zip(outs, self.window_exprs):
             dt = al.child.dtype
+            fn = al.child.function
+            if isinstance(dt, DecimalType) and isinstance(fn, Average) \
+                    and isinstance(getattr(fn.child, "dtype", None),
+                                   DecimalType):
+                # the kernel's avg is sum/count in the INPUT scale; the
+                # result decimal carries a wider scale (reference:
+                # Average resultType = DecimalType(p+4, s+4)); round
+                # half-to-even like the cast path, don't truncate
+                d = jnp.rint(d * (10.0 ** (dt.scale - fn.child.dtype.scale)))
             want = dt.device_dtype
             if str(d.dtype) != str(want):
                 d = d.astype(want)

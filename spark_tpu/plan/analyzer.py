@@ -126,8 +126,14 @@ def _remap_plan(plan: LogicalPlan, mapping: dict[int, AttributeReference],
             changed = False
             for a in attrs:
                 if a.expr_id in overlap:
-                    na = a.new_instance()
-                    mapping[a.expr_id] = na
+                    # one new instance PER OLD ID for the whole subtree: a
+                    # relation occurring in several union branches must
+                    # keep one id so references above the union stay bound
+                    # to the union's (first-branch) output — q75 shape
+                    na = mapping.get(a.expr_id)
+                    if na is None:
+                        na = a.new_instance()
+                        mapping[a.expr_id] = na
                     new_attrs.append(na)
                     changed = True
                 else:
@@ -135,8 +141,10 @@ def _remap_plan(plan: LogicalPlan, mapping: dict[int, AttributeReference],
             if changed:
                 node = node.copy(attrs=new_attrs)
         elif isinstance(node, RangeRelation) and node.attr.expr_id in overlap:
-            na = node.attr.new_instance()
-            mapping[node.attr.expr_id] = na
+            na = mapping.get(node.attr.expr_id)
+            if na is None:
+                na = node.attr.new_instance()
+                mapping[node.attr.expr_id] = na
             node = node.copy(attr=na)
         if isinstance(node, (Project, Aggregate)):
             # aliases produce new ids too; only inputs need remapping
@@ -154,6 +162,30 @@ class ResolveReferences(Rule):
     def apply(self, plan: LogicalPlan) -> LogicalPlan:
         cs = self.case_sensitive
 
+        # memo holds (node, verdict): keeping the node referenced pins its
+        # id() for the pass, so a GC'd copy can't alias a stale entry
+        _dedup_memo: dict[int, tuple[LogicalPlan, bool]] = {}
+
+        def _awaits_dedup(n: LogicalPlan) -> bool:
+            """True when a descendant self-join still has overlapping
+            attribute ids: resolving any expression ABOVE it would bind
+            both qualified sides to the same id (e.g. `c.y = p.y + 1` in a
+            WHERE over a comma self-join — the TPC-DS q75 shape).
+            Memoized per apply() so the fixpoint pass stays O(n)."""
+            hit = _dedup_memo.get(id(n))
+            if hit is not None and hit[0] is n:
+                return hit[1]
+            out = any(_awaits_dedup(c) for c in n.children)
+            if not out and isinstance(n, Join):
+                try:
+                    lids = {a.expr_id for a in n.left.output}
+                    rids = {a.expr_id for a in n.right.output}
+                    out = bool(lids & rids)
+                except AnalysisException:
+                    out = False
+            _dedup_memo[id(n)] = (n, out)
+            return out
+
         def rule(node: LogicalPlan):
             if not all(c.resolved for c in node.children):
                 return node
@@ -161,13 +193,8 @@ class ResolveReferences(Rule):
                 inputs = node.input_attrs()
             except AnalysisException:
                 return node  # child awaits ResolveAliases
-            if isinstance(node, Join):
-                # self-joins: wait for DeduplicateRelations before resolving
-                # the condition, or both sides resolve to the same ids
-                lids = {a.expr_id for a in node.left.output}
-                rids = {a.expr_id for a in node.right.output}
-                if lids & rids:
-                    return node
+            if _awaits_dedup(node):
+                return node
 
             # star expansion in Project/Aggregate
             if isinstance(node, (Project, Aggregate)):

@@ -1217,8 +1217,6 @@ class OptimizeSubqueryPlans(Rule):
         self.rules = rules
 
     def apply(self, plan):
-        import copy
-
         from .subquery import SubqueryExpression
 
         def fix_expr(ex):
@@ -1227,9 +1225,7 @@ class OptimizeSubqueryPlans(Rule):
                 for r in self.rules:
                     p = r.apply(p)
                 if p is not ex.plan:
-                    new = copy.copy(ex)
-                    new.plan = p
-                    return new
+                    return ex.copy(plan=p)
             return ex
 
         def rule(node):

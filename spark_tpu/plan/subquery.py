@@ -103,11 +103,25 @@ def split_correlation(subplan: LogicalPlan, outer_ids: set[int],
     TPC-DS q16/q94 shape) to be re-applied as join-condition residuals."""
     from .optimizer import join_conjuncts, split_conjuncts
 
+    from .logical import Limit, Union, Window
+
     pairs: list[tuple[Expression, Expression]] = []
     residuals: list[Expression] = []
     failed = [False]
 
-    def rule(node):
+    def _sensitive(n: LogicalPlan) -> bool:
+        return isinstance(n, (Aggregate, Limit, Union, Window)) or (
+            isinstance(n, Join) and n.join_type not in ("inner", "cross"))
+
+    def go(node: LogicalPlan, crossed: bool) -> LogicalPlan:
+        # `crossed`: a row-count-sensitive operator lies between this node
+        # and the subquery root. A residual stripped from below one would
+        # re-apply at the join AFTER that operator changed what it sees
+        # (an Aggregate aggregating rows the residual should have
+        # excluded, a Limit selecting from unfiltered input, ...) — only
+        # sound when crossed is False.
+        child_crossed = crossed or _sensitive(node)
+        node = node.map_children(lambda c: go(c, child_crossed))
         if isinstance(node, Filter):
             keep = []
             for c in split_conjuncts(node.condition):
@@ -125,7 +139,7 @@ def split_correlation(subplan: LogicalPlan, outer_ids: set[int],
                     if rr <= outer_ids and not (lr & outer_ids):
                         pairs.append((c.right, c.left))
                         continue
-                if with_residuals:
+                if with_residuals and not crossed:
                     residuals.append(c)
                     continue
                 failed[0] = True
@@ -137,7 +151,7 @@ def split_correlation(subplan: LogicalPlan, outer_ids: set[int],
                 return Filter(cond, node.child)
         return node
 
-    out = subplan.transform_up(rule)
+    out = go(subplan, False)
     # any remaining outer references → unsupported correlation
     for n in out.iter_nodes():
         for e in n.expressions():

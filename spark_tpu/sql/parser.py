@@ -1080,8 +1080,6 @@ def _refresh_alias_ids(plan: L.LogicalPlan) -> L.LogicalPlan:
 
 def _substitute_ctes(plan: L.LogicalPlan,
                      ctes: dict[str, L.LogicalPlan]) -> L.LogicalPlan:
-    import copy
-
     from ..plan.subquery import SubqueryExpression
 
     def fix_expr(ex):
@@ -1089,9 +1087,7 @@ def _substitute_ctes(plan: L.LogicalPlan,
         # CTESubstitution runs over subquery plans) — q1-style
         # `WITH ctr AS (...) ... WHERE x > (SELECT avg(..) FROM ctr)`
         if isinstance(ex, SubqueryExpression):
-            new = copy.copy(ex)
-            new.plan = _substitute_ctes(ex.plan, ctes)
-            return new
+            return ex.copy(plan=_substitute_ctes(ex.plan, ctes))
         return ex
 
     def rule(node):

@@ -129,3 +129,34 @@ def test_existence_subquery_in_select(spark):
                 EXISTS(SELECT 1 FROM ex_ords WHERE amt > 6) AS big
                       FROM ex_cust ORDER BY cid""")
     assert out["big"] == [True, True, True]
+
+
+def test_residual_correlation_below_aggregate_rejected(spark):
+    # pulling a correlated non-equality predicate from BELOW an aggregate
+    # would change the aggregate's input — must fail loudly, not silently
+    # mis-execute (code-review r2 finding)
+    import pyarrow as pa
+    import pytest
+
+    from spark_tpu.errors import UnsupportedOperationError
+
+    spark.createDataFrame(pa.table({"x": [5], "w": [3]})) \
+        .createOrReplaceTempView("rcba_o")
+    spark.createDataFrame(pa.table({"a": [5, 9], "w2": [1, 2]})) \
+        .createOrReplaceTempView("rcba_t")
+    with pytest.raises(UnsupportedOperationError):
+        spark.sql("""select x from rcba_o o where x in
+                     (select max(a) from rcba_t t where t.w2 <> o.w)""") \
+            .toArrow()
+
+
+def test_residual_correlated_exists(spark):
+    # the q16 shape: equality + non-equality correlated EXISTS
+    import pyarrow as pa
+
+    spark.createDataFrame(pa.table({"o": [1, 1, 2], "w": [10, 11, 20]})) \
+        .createOrReplaceTempView("rce_s")
+    out = spark.sql("""select distinct o from rce_s s1 where exists
+                       (select * from rce_s s2 where s1.o = s2.o
+                        and s1.w <> s2.w) order by o""").toArrow()
+    assert out.to_pydict()["o"] == [1]

@@ -168,6 +168,10 @@ def rewrite_for_sqlite(sql: str, qname: str | None = None) -> str:
                             f"'{m.group(2)}{m.group(3)} day')", sql)
     sql = _CAST_DATE.sub(lambda m: f"'{m.group(1)}'", sql)
     sql = _DECIMAL_T.sub("REAL", sql)
+    # the reference dialect divides integers as doubles; sqlite truncates —
+    # float-promote the known int/int division sites (q21/q34/q73)
+    sql = re.sub(r"\b(hd_dep_count|inv_after)\s*/",
+                 r"\1 * 1.0 /", sql)
     sql = _unwrap_compound(sql)
     return sql
 
