@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Profile grouped-sum kernel variants on the live chip.
+
+Finds where bench.py's 6.1s/run goes: raw segment_sum (scatter) vs
+sort-based vs the end-to-end query path.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+N = 10_000_000
+G = 1 << 20
+CAP = 1 << 24
+
+
+def timeit(fn, *args, reps=3):
+    out = fn(*args)
+    import jax
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    jax.config.update("jax_enable_x64", True)
+    print("backend:", jax.default_backend(), flush=True)
+
+    rng = np.random.default_rng(42)
+    k = np.zeros(CAP, np.int64)
+    k[:N] = rng.integers(0, G, N)
+    v = np.zeros(CAP, np.int64)
+    v[:N] = rng.integers(0, 1000, N)
+    m = np.zeros(CAP, bool)
+    m[:N] = True
+    kd, vd, md = jnp.asarray(k), jnp.asarray(v), jnp.asarray(m)
+    out_cap = 1 << 21
+
+    @jax.jit
+    def dense_scatter(k, v, m):
+        seg = jnp.where(m, k, out_cap - 1).astype(jnp.int32)
+        tot = jax.ops.segment_sum(jnp.where(m, v, 0), seg,
+                                  num_segments=out_cap)
+        cnt = jax.ops.segment_sum(m.astype(jnp.int64), seg,
+                                  num_segments=out_cap)
+        return tot, cnt
+
+    t = timeit(dense_scatter, kd, vd, md)
+    print(f"dense segment_sum scatter: {t*1e3:.1f} ms = {N/t/1e6:.1f} M rows/s",
+          flush=True)
+
+    @jax.jit
+    def sort_based(k, v, m):
+        key = jnp.where(m, k, jnp.iinfo(jnp.int64).max)
+        sk, sv = lax.sort((key, v), num_keys=1, is_stable=False)
+        # segment starts where key changes
+        prev = jnp.concatenate([sk[:1] - 1, sk[:-1]])
+        starts = sk != prev
+        gid = jnp.cumsum(starts.astype(jnp.int32)) - 1
+        tot = jax.ops.segment_sum(sv, gid, num_segments=out_cap)
+        return sk, tot
+
+    t = timeit(sort_based, kd, vd, md)
+    print(f"sort + seg-sum:            {t*1e3:.1f} ms = {N/t/1e6:.1f} M rows/s",
+          flush=True)
+
+    @jax.jit
+    def just_sort(k, v):
+        return lax.sort((k, v), num_keys=1, is_stable=False)
+
+    t = timeit(just_sort, kd, vd)
+    print(f"lax.sort only:             {t*1e3:.1f} ms", flush=True)
+
+    @jax.jit
+    def sorted_scan_diff(k, v, m):
+        # sort, then segment sums via cumsum-diff at boundaries (no scatter)
+        key = jnp.where(m, k, jnp.iinfo(jnp.int64).max)
+        sk, sv = lax.sort((key, v), num_keys=1, is_stable=False)
+        cs = jnp.cumsum(sv)
+        is_last = jnp.concatenate([sk[1:] != sk[:-1], jnp.ones(1, bool)])
+        # per-row: cumsum at last row of each run; subtract previous run's end
+        run_end_cs = jnp.where(is_last, cs, 0)
+        return sk, run_end_cs
+
+    t = timeit(sorted_scan_diff, kd, vd, md)
+    print(f"sort + cumsum-diff:        {t*1e3:.1f} ms", flush=True)
+
+    @jax.jit
+    def pure_cumsum(v):
+        return jnp.cumsum(v)
+
+    t = timeit(pure_cumsum, vd)
+    print(f"cumsum only 16M:           {t*1e3:.1f} ms", flush=True)
+
+    # scatter with int32 data instead of int64
+    @jax.jit
+    def dense_scatter32(k, v, m):
+        seg = jnp.where(m, k, out_cap - 1).astype(jnp.int32)
+        tot = jax.ops.segment_sum(jnp.where(m, v, 0).astype(jnp.float32), seg,
+                                  num_segments=out_cap)
+        return tot
+
+    t = timeit(dense_scatter32, kd, vd, md)
+    print(f"scatter f32:               {t*1e3:.1f} ms", flush=True)
+
+    # end-to-end query path
+    sys.path.insert(0, ".")
+    import pyarrow as pa
+    from spark_tpu import TpuSession
+    import spark_tpu.api.functions as F
+    from spark_tpu.api.dataframe import DataFrame
+    from spark_tpu.io.sources import InMemorySource
+    from spark_tpu.plan.logical import LogicalRelation
+    from spark_tpu.expr.expressions import AttributeReference
+    from spark_tpu.types import int64 as i64t
+
+    session = TpuSession("bench", {
+        "spark.tpu.batch.capacity": 1 << 24,
+        "spark.sql.shuffle.partitions": 1,
+    })
+    table = pa.table({"k": k[:N], "v": v[:N]})
+    source = InMemorySource(table, num_partitions=1)
+    source.cache_device_batches = True
+    attrs = [AttributeReference(f.name, i64t, False) for f in table.schema]
+    df = DataFrame(session, LogicalRelation(source, attrs, "bench"))
+
+    def run_query():
+        q = df.groupBy("k").agg(F.sum("v").alias("s"))
+        t0 = time.perf_counter()
+        parts = q.query_execution.execute()
+        for part in parts:
+            for b in part:
+                for c in b.columns:
+                    c.data.block_until_ready()
+        return time.perf_counter() - t0
+
+    run_query()
+    ts = [run_query() for _ in range(3)]
+    t = min(ts)
+    print(f"end-to-end query:          {t*1e3:.1f} ms = {N/t/1e6:.1f} M rows/s",
+          flush=True)
+
+    # phase timing inside one run
+    import spark_tpu.exec.query_execution as qe
+    q = df.groupBy("k").agg(F.sum("v").alias("s"))
+    t0 = time.perf_counter()
+    plan = q.query_execution.executed_plan
+    t1 = time.perf_counter()
+    print(f"  planning: {(t1-t0)*1e3:.1f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

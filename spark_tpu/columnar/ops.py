@@ -21,6 +21,51 @@ def _jnp():
     return jnp
 
 
+def _device_of(arr):
+    try:
+        devs = arr.devices()
+        if len(devs) == 1:
+            return next(iter(devs))
+    except Exception:
+        pass
+    return None
+
+
+def align_devices(arrays: list, target=None) -> list:
+    """Move arrays to one shared device when they are committed to different
+    ones (cross-partition combine of mesh-exchange outputs — the gather role
+    of a fetch in the reference's shuffle read). No-op on one device.
+
+    With `target`, every array lands on that device (so a batch's data,
+    validity, and mask planes agree even when each list is single-device)."""
+    devs = {d for a in arrays if a is not None
+            for d in [_device_of(a)] if d is not None}
+    if target is None:
+        if len(devs) <= 1:
+            return arrays
+        target = sorted(devs, key=lambda d: d.id)[0]
+    elif devs <= {target}:
+        return arrays
+    import jax
+
+    return [a if a is None or _device_of(a) == target
+            else jax.device_put(a, target) for a in arrays]
+
+
+def batch_to_device(batch: ColumnarBatch, device) -> ColumnarBatch:
+    """Commit every array of a batch to `device` (broadcast-side alignment
+    for joins against mesh-resident partitions)."""
+    import jax
+
+    cols = [Column(c.dtype, jax.device_put(c.data, device),
+                   None if c.validity is None
+                   else jax.device_put(c.validity, device), c.dictionary)
+            for c in batch.columns]
+    return ColumnarBatch(batch.schema, cols,
+                         jax.device_put(batch.row_mask, device),
+                         num_rows=batch._num_rows)
+
+
 def unify_string_columns(cols: Sequence[Column]) -> tuple[StringDict, list]:
     """Merge the dictionaries of string columns; returns (merged dict,
     per-column recoded code arrays). The dictionary union runs in the native
@@ -54,6 +99,17 @@ def concat_batches(batches: Sequence[ColumnarBatch],
     cap = bucket_capacity(total_cap)
     ncols = len(schema.fields)
 
+    # one coherent device for every plane of the result (mesh partitions
+    # live on different devices; validity fills are created on the default
+    # one) — without a single target, a column's data and validity can end
+    # up committed apart and the next jitted kernel rejects the pair
+    all_devs = {d for b in batches
+                for a in [b.row_mask] + [c.data for c in b.columns]
+                for d in [_device_of(a)] if d is not None}
+    # always pin a target: even a single-device partition needs its
+    # uncommitted validity fills pulled onto that device, not the default one
+    target = sorted(all_devs, key=lambda d: d.id)[0] if all_devs else None
+
     cols: list[Column] = []
     for i, f in enumerate(schema.fields):
         parts = [b.columns[i] for b in batches]
@@ -62,6 +118,7 @@ def concat_batches(batches: Sequence[ColumnarBatch],
         else:
             sd = None
             datas = [p.data for p in parts]
+        datas = align_devices(datas, target)
         data = jnp.concatenate(datas)
         if data.shape[0] < cap:
             data = jnp.concatenate(
@@ -71,13 +128,13 @@ def concat_batches(batches: Sequence[ColumnarBatch],
         if any_valid:
             vs = [p.validity if p.validity is not None
                   else jnp.ones(p.data.shape[0], dtype=bool) for p in parts]
-            validity = jnp.concatenate(vs)
+            validity = jnp.concatenate(align_devices(vs, target))
             if validity.shape[0] < cap:
                 validity = jnp.concatenate(
                     [validity, jnp.zeros(cap - validity.shape[0], dtype=bool)])
         cols.append(Column(f.dataType, data, validity, sd))
 
-    masks = [b.row_mask for b in batches]
+    masks = align_devices([b.row_mask for b in batches], target)
     mask = jnp.concatenate(masks)
     if mask.shape[0] < cap:
         mask = jnp.concatenate([mask, jnp.zeros(cap - mask.shape[0], dtype=bool)])

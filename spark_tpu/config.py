@@ -100,6 +100,13 @@ DEVICE_MESH_AXIS = _register(ConfigEntry(
     "spark.tpu.mesh.dataAxis", "data",
     "Name of the mesh axis partitions are sharded over.", str))
 
+MESH_ENABLED = _register(ConfigEntry(
+    "spark.tpu.mesh.enabled", True,
+    "Lower hash exchanges to lax.all_to_all over the device mesh when the "
+    "partition count fits the mesh (the ICI data plane; reference analog: "
+    "ShuffleExchangeExec lowering to the core shuffle). Falls back to the "
+    "host sort-shuffle otherwise.", _bool))
+
 CODEGEN_CACHE_SIZE = _register(ConfigEntry(
     "spark.tpu.kernel.cacheSize", 1024,
     "Max entries in the jitted-kernel cache (role of the reference's "

@@ -55,8 +55,7 @@ def _bucket_local(key_eqs, key_valids, row_mask, num_partitions: int,
     return gather_idx, slot_valid.reshape(num_partitions, quota), overflow
 
 
-def make_all_to_all_exchange(mesh, num_key_cols: int, num_payload: int,
-                             quota: int, axis_name: str = "data"):
+def make_all_to_all_exchange(mesh, quota: int, axis_name: str = "data"):
     """Build a jitted shard_map exchange.
 
     Inputs (all row-sharded over `axis_name`, per-shard capacity = cap):

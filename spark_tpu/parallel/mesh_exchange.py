@@ -1,0 +1,233 @@
+"""Planner-integrated mesh exchange: ShuffleExchangeExec on the ICI.
+
+Role of the reference's exchange-to-shuffle lowering
+(sqlx/exchange/ShuffleExchangeExec.scala:344 — partition-id computation
+feeding the core shuffle writer) re-designed for a TPU slice: when a hash
+exchange's partition count matches a device mesh, the whole redistribution
+runs as ONE XLA program — per-shard bucket-by-destination (hash + lax.sort)
+followed by `lax.all_to_all` over the mesh axis — so the redistribution
+itself rides the ICI, not a host loop (SURVEY.md §2.5 'Communication
+backend'). Staging still crosses the host once on entry (dictionary merge +
+re-sharding of arbitrary input tiles); keeping resident mesh output sharded
+end-to-end is the planned next step. The host sort-shuffle
+(exec/shuffle.py) remains the fallback for non-mesh shapes and the
+cross-slice/DCN path.
+
+Static-shape discipline: each (src→dst) pair gets a fixed row `quota`; the
+program psums an overflow count and the host retries with a doubled quota —
+the same capacity-bucket contract as the join/aggregate kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..columnar.batch import (
+    Column, ColumnarBatch, StringDict, bucket_capacity, merge_string_dicts,
+)
+from ..types import ArrayType, StringType, StructType
+
+_MESH_CACHE: dict = {}
+
+
+def _get_mesh(n: int, axis: str):
+    from .mesh import get_mesh
+
+    key = (n, axis)
+    m = _MESH_CACHE.get(key)
+    if m is None:
+        m = _MESH_CACHE[key] = get_mesh(n, axis)
+    return m
+
+
+def mesh_for(num_out: int, conf, schema: StructType):
+    """The mesh to run this exchange on, or None → host shuffle path.
+
+    Conditions: mesh enabled, ≥2 devices, power-of-two partition count that
+    fits the device count, no list-typed payload columns (their host-side
+    dictionaries hold unhashable values; they take the host path)."""
+    from ..config import MESH_ENABLED, DEVICE_MESH_AXIS
+
+    if not conf.get(MESH_ENABLED):
+        return None
+    if num_out < 2 or (num_out & (num_out - 1)) != 0:
+        return None
+    if any(isinstance(f.dataType, ArrayType) for f in schema.fields):
+        return None
+    import jax
+
+    if len(jax.devices()) < num_out:
+        return None
+    return _get_mesh(num_out, conf.get(DEVICE_MESH_AXIS))
+
+
+def _stage_inputs(partitions, key_positions, schema: StructType):
+    """Flatten input partitions into host arrays + merged dictionaries.
+
+    Returns (key_eqs, key_valids, payload_datas, payload_valids, row_mask,
+    merged_dicts, total_cap). Strings are recoded to a global dictionary so
+    codes are comparable across shards after the exchange."""
+    batches = [b for part in partitions for b in part]
+    ncols = len(schema.fields)
+
+    merged_dicts: list = [None] * ncols
+    recodes: list = [None] * ncols  # per col: list of per-batch LUTs
+    for i, f in enumerate(schema.fields):
+        if isinstance(f.dataType, StringType):
+            dicts = [b.columns[i].dictionary or StringDict([""])
+                     for b in batches]
+            if batches and all(d is dicts[0] for d in dicts):
+                merged_dicts[i] = dicts[0]
+            else:
+                md, luts = merge_string_dicts(dicts)
+                merged_dicts[i] = md
+                recodes[i] = luts
+            if merged_dicts[i] is None or len(merged_dicts[i]) == 0:
+                merged_dicts[i] = StringDict([""])
+
+    datas = [[] for _ in range(ncols)]
+    valids = [[] for _ in range(ncols)]
+    has_valid = [False] * ncols
+    masks = []
+    key_eq_chunks = [[] for _ in key_positions]
+    for bi, b in enumerate(batches):
+        masks.append(np.asarray(b.row_mask))
+        for i, c in enumerate(b.columns):
+            d = np.asarray(c.data)
+            if recodes[i] is not None:
+                lut = recodes[i][bi]
+                d = lut[np.clip(d, 0, len(lut) - 1)]
+            datas[i].append(d)
+            if c.validity is not None:
+                has_valid[i] = True
+            valids[i].append(None if c.validity is None
+                             else np.asarray(c.validity))
+        for ki, kp in enumerate(key_positions):
+            key_eq_chunks[ki].append(np.asarray(b.columns[kp].eq_keys()))
+
+    if not batches:
+        return None
+    row_mask = np.concatenate(masks)
+    total_cap = int(row_mask.shape[0])
+    payload_datas = [np.concatenate(ds) for ds in datas]
+    payload_valids = []
+    for i in range(ncols):
+        if has_valid[i]:
+            vs = [v if v is not None else np.ones(len(d), bool)
+                  for v, d in zip(valids[i], datas[i])]
+            payload_valids.append(np.concatenate(vs))
+        else:
+            payload_valids.append(None)
+    key_eqs = [np.concatenate(ch) for ch in key_eq_chunks]
+    key_valids = [payload_valids[kp] for kp in key_positions]
+    return (key_eqs, key_valids, payload_datas, payload_valids, row_mask,
+            merged_dicts, total_cap)
+
+
+def _exchange_program(mesh, axis: str, cap: int, quota: int,
+                      n_keys: int, key_valid_sig: tuple,
+                      payload_dtypes: tuple, payload_valid_sig: tuple):
+    """Build (cached) the jitted shard_map exchange for this structure."""
+    from ..physical.compile import GLOBAL_KERNEL_CACHE
+    from .collectives import make_all_to_all_exchange
+
+    kkey = ("mesh_exchange", id(mesh), axis, cap, quota, n_keys,
+            key_valid_sig, payload_dtypes, payload_valid_sig)
+    return GLOBAL_KERNEL_CACHE.get_or_build(
+        kkey,
+        lambda: make_all_to_all_exchange(mesh, quota, axis_name=axis))
+
+
+def mesh_shuffle_hash(partitions, key_positions: Sequence[int], num_out: int,
+                      schema: StructType, ctx, stats, mesh) -> list:
+    """Hash exchange over the mesh; output partition i lives on device i."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..config import DEVICE_MESH_AXIS
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    axis = ctx.conf.get(DEVICE_MESH_AXIS)
+    staged = _stage_inputs(partitions, key_positions, schema)
+    if staged is None:
+        out = [[ColumnarBatch.empty(schema)] for _ in range(num_out)]
+        for i in range(num_out):
+            stats[i] = 0
+        return out
+    (key_eqs, key_valids, payload_datas, payload_valids, row_mask,
+     merged_dicts, total_cap) = staged
+
+    P = num_out
+    shard_cap = bucket_capacity(max((total_cap + P - 1) // P, 64))
+    cap = shard_cap * P
+
+    def pad(arr, fill=0):
+        if arr is None:
+            return None
+        out = np.zeros(cap, dtype=arr.dtype)
+        out[: len(arr)] = arr
+        return out
+
+    sharding = NamedSharding(mesh, PartitionSpec(axis))
+    put = lambda a: jax.device_put(jnp.asarray(a), sharding)
+
+    d_key_eqs = [put(pad(k)) for k in key_eqs]
+    d_key_valids = [None if v is None else put(pad(v)) for v in key_valids]
+    d_mask = put(pad(row_mask))
+    # payloads: every column's data, then the validity planes, then row_mask
+    payloads = [put(pad(d)) for d in payload_datas]
+    vplanes = [put(pad(v)) for v in payload_valids if v is not None]
+    vmap_idx = [i for i, v in enumerate(payload_valids) if v is not None]
+
+    quota = max(16, 2 * shard_cap // P)
+    for _ in range(8):
+        prog = _exchange_program(
+            mesh, axis, shard_cap, quota, len(key_eqs),
+            tuple(v is not None for v in key_valids),
+            tuple(str(d.dtype) for d in payloads),
+            tuple(v is not None for v in payload_valids))
+        out_payloads, new_mask, overflow = prog(
+            d_key_eqs, d_key_valids, payloads + vplanes, d_mask)
+        if int(overflow) == 0:
+            ctx.metrics.add("exchange.mesh")
+            break
+        quota *= 2
+    else:
+        # pathological skew past every retry: the host sort-shuffle has no
+        # quota to overflow — degrade instead of failing the query
+        from ..exec import shuffle as S
+
+        ctx.metrics.add("exchange.mesh_fallback")
+        return S.shuffle_hash(partitions, list(key_positions), num_out,
+                              schema, ctx, stats)
+
+    out_cap = P * quota
+    col_arrays = out_payloads[: len(payload_datas)]
+    valid_arrays = out_payloads[len(payload_datas):]
+
+    def shards_of(arr):
+        """Per-device shard views ordered by partition id."""
+        out = [None] * P
+        for s in arr.addressable_shards:
+            out[s.index[0].start // out_cap] = s.data
+        return out
+
+    mask_shards = shards_of(new_mask)
+    data_shards = [shards_of(a) for a in col_arrays]
+    valid_shards = {}
+    for vi, a in zip(vmap_idx, valid_arrays):
+        valid_shards[vi] = shards_of(a)
+
+    out = []
+    for p in range(P):
+        cols = []
+        for i, f in enumerate(schema.fields):
+            v = valid_shards[i][p] if i in valid_shards else None
+            cols.append(Column(f.dataType, data_shards[i][p], v,
+                               merged_dicts[i]))
+        n = int(np.asarray(mask_shards[p]).sum())
+        stats[p] = n
+        out.append([ColumnarBatch(schema, cols, mask_shards[p], num_rows=n)])
+    return out

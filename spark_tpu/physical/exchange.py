@@ -56,6 +56,13 @@ class ShuffleExchangeExec(PhysicalPlan):
                     assert isinstance(e, AttributeReference), \
                         "exchange keys must be attributes (planner contract)"
                     key_positions.append(pos[e.expr_id])
+                from ..parallel import mesh_exchange as ME
+
+                mesh = ME.mesh_for(p.num_partitions, ctx.conf, schema)
+                if mesh is not None:
+                    return ME.mesh_shuffle_hash(
+                        parts, key_positions, p.num_partitions, schema, ctx,
+                        self.last_stats, mesh)
                 return S.shuffle_hash(parts, key_positions, p.num_partitions,
                                       schema, ctx, self.last_stats)
             if isinstance(p, RangePartitioning):

@@ -1061,6 +1061,15 @@ class HashJoinExec(PhysicalPlan):
 
         jnp = _jnp()
         build = concat_batches(rp, rschema) if rp else ColumnarBatch.empty(rschema)
+        # mesh partitions are committed to their device; the build side and
+        # every probe batch must share one before a kernel can see both
+        # (broadcast batch vs mesh partition, AQE-coalesced neighbours)
+        from ..columnar.ops import _device_of, batch_to_device
+
+        bdev = _device_of(build.row_mask)
+        if bdev is not None and lp:
+            lp = [pb if _device_of(pb.row_mask) in (None, bdev)
+                  else batch_to_device(pb, bdev) for pb in lp]
         rpos = {a.expr_id: i for i, a in enumerate(self.right.output)}
         lpos = {a.expr_id: i for i, a in enumerate(self.left.output)}
         bkeys = [build.columns[rpos[k.expr_id]] for k in self.right_keys]
