@@ -89,6 +89,7 @@ def record_batch_to_columnar(rb: pa.RecordBatch | pa.Table,
     n = num_rows if num_rows is not None else rb.num_rows
     cap = capacity or bucket_capacity(max(n, 1))
     cols = []
+    stats = {}
     for i, f in enumerate(schema.fields):
         data, validity, sd = _chunked_to_numpy(rb.column(i), f.dataType)
         pad = np.zeros(cap, dtype=f.dataType.device_dtype)
@@ -98,10 +99,24 @@ def record_batch_to_columnar(rb: pa.RecordBatch | pa.Table,
             vm = np.zeros(cap, dtype=bool)
             vm[:n] = validity[:cap]
             v = jnp.asarray(vm)
-        cols.append(Column(f.dataType, jnp.asarray(pad), v, sd))
+        col = Column(f.dataType, jnp.asarray(pad), v, sd)
+        # key range from the HOST copy while we still have it: the dense
+        # aggregate/join fast-path decision then never needs a device→host
+        # sync (transfer-bound transports degrade permanently after one)
+        if pad.dtype.kind == "i" and sd is None:
+            live = data[:cap] if validity is None \
+                else data[:cap][validity[:cap]]
+            if len(live):
+                stats[("dense_range", id(col.data))] = (
+                    int(live.min()), int(live.max()), True)
+            else:
+                stats[("dense_range", id(col.data))] = (0, 0, False)
+        cols.append(col)
     mask = np.zeros(cap, dtype=bool)
     mask[:n] = True
-    return ColumnarBatch(schema, cols, jnp.asarray(mask), num_rows=n)
+    out = ColumnarBatch(schema, cols, jnp.asarray(mask), num_rows=n)
+    out._stats = stats
+    return out
 
 
 def table_to_batches(table: pa.Table, rows_per_batch: int,

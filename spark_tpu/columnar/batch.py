@@ -274,7 +274,7 @@ class ColumnarBatch:
     `num_rows` is the host-known live count when available (None after a
     device-side filter until counted)."""
 
-    __slots__ = ("schema", "columns", "row_mask", "_num_rows")
+    __slots__ = ("schema", "columns", "row_mask", "_num_rows", "_stats")
 
     def __init__(self, schema: StructType, columns: Sequence[Column], row_mask,
                  num_rows: int | None = None):
@@ -283,6 +283,9 @@ class ColumnarBatch:
         self.columns = list(columns)
         self.row_mask = row_mask
         self._num_rows = num_rows
+        self._stats = None  # lazy per-batch kernel-result cache (dense agg
+        # range etc.) so repeated executions over a cached batch skip the
+        # host round-trip of re-syncing the same scalars
 
     @property
     def capacity(self) -> int:

@@ -107,6 +107,30 @@ MESH_ENABLED = _register(ConfigEntry(
     "ShuffleExchangeExec lowering to the core shuffle). Falls back to the "
     "host sort-shuffle otherwise.", _bool))
 
+DPP_ENABLED = _register(ConfigEntry(
+    "spark.sql.dynamicPartitionPruning.enabled", True,
+    "Prune probe-side scan splits from the join build side's distinct keys "
+    "(reference: sqlx/dynamicpruning/PartitionPruning.scala).", _bool))
+
+DPP_BUILD_THRESHOLD = _register(ConfigEntry(
+    "spark.sql.dynamicPartitionPruning.buildThreshold", 4 << 20,
+    "Max build-side rows for which distinct join-key values are collected "
+    "for dynamic partition pruning.", int))
+
+PARQUET_FILTER_PUSHDOWN = _register(ConfigEntry(
+    "spark.sql.parquet.filterPushdown", True,
+    "Prune parquet splits by hive partition values and row-group min/max "
+    "statistics (reference: ParquetFileFormat/ParquetFilters).", _bool))
+
+BLOOM_JOIN_FILTER = _register(ConfigEntry(
+    "spark.tpu.join.runtimeFilter.bloom", False,
+    "Device bloom-filter probe-side rows before the join sort-probe "
+    "(reference: InjectRuntimeFilter.scala bloom branch).", _bool))
+
+MINMAX_JOIN_FILTER = _register(ConfigEntry(
+    "spark.tpu.join.runtimeFilter", False,
+    "Min-max runtime join filter on single integral keys.", _bool))
+
 CODEGEN_CACHE_SIZE = _register(ConfigEntry(
     "spark.tpu.kernel.cacheSize", 1024,
     "Max entries in the jitted-kernel cache (role of the reference's "

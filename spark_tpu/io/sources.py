@@ -40,6 +40,11 @@ class DataSource:
         return state
 
 
+UNKNOWN_PARTITION_VALUE = object()
+"""Sentinel: a source cannot tell which partition-column value a split
+holds (DPP must then read the split)."""
+
+
 class InMemorySource(DataSource):
     """An Arrow table split into N partitions (role of LocalTableScan +
     parallelize)."""
@@ -135,6 +140,99 @@ class ParquetSource(DataSource):
     def num_partitions(self) -> int:
         return len(self._splits)
 
+    # --- predicate pruning -------------------------------------------------
+    def pruned(self, predicates) -> "ParquetSource":
+        """A clone reading only splits that can satisfy `predicates`
+        (each: (col, op, value) with op in =,<,<=,>,>=,in).
+
+        Partition columns prune whole files from the hive directory values
+        (reference: PartitioningAwareFileIndex.listFiles pruning); data
+        columns prune by row-group min/max statistics (reference:
+        VectorizedParquetRecordReader / ParquetFileFormat row-group filter).
+        Conservative: a split is kept unless a predicate proves it empty."""
+        part_preds = [p for p in predicates if p[0] in self._part_keys]
+        data_preds = [p for p in predicates if p[0] not in self._part_keys]
+        keep: list[tuple[str, int, int]] = []
+        dropped_files: set[str] = set()
+        # footer metadata survives on the source: repeated plans of filtered
+        # queries must not re-open every file
+        stats_cache = self.__dict__.setdefault("_md_cache", {})
+        for (fpath, lo, hi) in self._splits:
+            if fpath in dropped_files:
+                continue
+            vals = self._part_values.get(fpath, {})
+            if part_preds and not all(
+                    self._part_match(vals.get(c), c, op, v)
+                    for (c, op, v) in part_preds):
+                dropped_files.add(fpath)
+                continue
+            if not data_preds or hi <= lo:
+                keep.append((fpath, lo, hi))
+                continue
+            md = stats_cache.get(fpath)
+            if md is None:
+                md = stats_cache[fpath] = self._pq.ParquetFile(fpath).metadata
+            name_to_idx = {md.schema.column(ci).name: ci
+                           for ci in range(md.num_columns)}
+            run_start = None  # merge contiguous kept row groups so a
+            # non-selective predicate keeps the original split granularity
+            for rg in range(lo, hi):
+                rgm = md.row_group(rg)
+                ok = True
+                for (c, op, v) in data_preds:
+                    ci = name_to_idx.get(c)
+                    if ci is None:
+                        continue
+                    st = rgm.column(ci).statistics
+                    if st is None or not st.has_min_max:
+                        continue
+                    if not _range_overlaps(st.min, st.max, op, v):
+                        ok = False
+                        break
+                if ok and run_start is None:
+                    run_start = rg
+                elif not ok and run_start is not None:
+                    keep.append((fpath, run_start, rg))
+                    run_start = None
+            if run_start is not None:
+                keep.append((fpath, run_start, hi))
+        if keep == self._splits:
+            return self  # nothing pruned — keep the (cached) source
+        import copy
+
+        clone = copy.copy(self)
+        clone._splits = keep or [(self.files[0], 0, 0)]
+        # the shallow copy shares the device cache, but its keys are split
+        # INDICES — different split lists must not alias each other's data
+        clone.__dict__.pop("_device_cache", None)
+        return clone
+
+    def split_partition_value(self, i: int, col: str):
+        """Typed hive-partition value of split i for `col`; None for the
+        null partition; UNKNOWN_PARTITION_VALUE when not derivable."""
+        if col not in self._part_keys:
+            return UNKNOWN_PARTITION_VALUE
+        fpath = self._splits[i][0]
+        raw = self._part_values.get(fpath, {}).get(col)
+        if raw is None:
+            return UNKNOWN_PARTITION_VALUE
+        if raw == "__HIVE_DEFAULT_PARTITION__":
+            return None
+        from ..types import float64, int64
+
+        dt = self.schema[col].dataType
+        return int(raw) if dt is int64 else \
+            float(raw) if dt is float64 else raw
+
+    def _part_match(self, raw: str | None, col: str, op: str, v) -> bool:
+        if raw is None or raw == "__HIVE_DEFAULT_PARTITION__":
+            return False  # null partition never equals a literal
+        from ..types import float64, int64
+
+        dt = self.schema[col].dataType
+        pv = int(raw) if dt is int64 else float(raw) if dt is float64 else raw
+        return _range_overlaps(pv, pv, op, v)
+
     def read_partition(self, i: int, columns=None) -> pa.Table:
         from ..types import to_arrow_type
 
@@ -164,6 +262,49 @@ class ParquetSource(DataSource):
         if columns is not None:
             t = t.select(list(columns))
         return t
+
+
+def _stat_coerce(x):
+    """Normalize parquet-statistics values into the engine's device domain
+    (dates → epoch days, timestamps → epoch micros) so they compare against
+    Literal values."""
+    import datetime as _dt
+
+    if isinstance(x, _dt.datetime):
+        epoch = _dt.datetime(1970, 1, 1, tzinfo=x.tzinfo)
+        return int((x - epoch).total_seconds() * 1_000_000)
+    if isinstance(x, _dt.date):
+        return (x - _dt.date(1970, 1, 1)).days
+    if isinstance(x, bytes):
+        try:
+            return x.decode("utf-8")
+        except UnicodeDecodeError:
+            return x
+    return x
+
+
+def _range_overlaps(lo, hi, op: str, v) -> bool:
+    """Can a value in [lo, hi] satisfy `x op v`? Conservative true on any
+    type mismatch (mirrors the reference's ParquetFilters nullability/type
+    guards)."""
+    lo, hi = _stat_coerce(lo), _stat_coerce(hi)
+    v = [_stat_coerce(x) for x in v] if op == "in" else _stat_coerce(v)
+    try:
+        if op == "=":
+            return lo <= v <= hi
+        if op == "<":
+            return lo < v
+        if op == "<=":
+            return lo <= v
+        if op == ">":
+            return hi > v
+        if op == ">=":
+            return hi >= v
+        if op == "in":
+            return any(lo <= x <= hi for x in v)
+    except TypeError:
+        return True
+    return True
 
 
 def _infer_partition_type(values: list[str]):

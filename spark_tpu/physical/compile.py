@@ -13,6 +13,7 @@ kernels across plan instances.
 from __future__ import annotations
 
 import collections
+import threading
 from typing import Any, Callable, Sequence
 
 import numpy as np
@@ -75,18 +76,24 @@ class KernelCache:
         self.max_size = max_size
         self.hits = 0
         self.misses = 0
+        # scheduler stages run in threads; OrderedDict mutation is not
+        # thread-safe (builder() itself runs unlocked — duplicate builds of
+        # the same key are benign, a torn dict is not)
+        self._lock = threading.Lock()
 
     def get_or_build(self, key: tuple, builder: Callable[[], Any]):
-        f = self._cache.get(key)
-        if f is not None:
-            self.hits += 1
-            self._cache.move_to_end(key)
-            return f
-        self.misses += 1
+        with self._lock:
+            f = self._cache.get(key)
+            if f is not None:
+                self.hits += 1
+                self._cache.move_to_end(key)
+                return f
+            self.misses += 1
         f = builder()
-        self._cache[key] = f
-        while len(self._cache) > self.max_size:
-            self._cache.popitem(last=False)
+        with self._lock:
+            f = self._cache.setdefault(key, f)
+            while len(self._cache) > self.max_size:
+                self._cache.popitem(last=False)
         return f
 
 

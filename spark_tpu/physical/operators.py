@@ -88,6 +88,10 @@ class ScanExec(PhysicalPlan):
         self.source = source
         self.attrs = attrs
         self.name = name
+        # (partition column name, allowed values) installed at runtime by a
+        # joining operator before this scan executes — dynamic partition
+        # pruning (reference: sqlx/dynamicpruning/PartitionPruning.scala)
+        self.runtime_split_filter = None
 
     @property
     def output(self):
@@ -95,6 +99,19 @@ class ScanExec(PhysicalPlan):
 
     def output_partitioning(self):
         return UnknownPartitioning(self.source.num_partitions())
+
+    def _split_pruned(self, i: int) -> bool:
+        """True if split i cannot contain rows passing the runtime filter.
+        Partition count stays stable — pruned splits read as empty."""
+        if self.runtime_split_filter is None:
+            return False
+        from ..io.sources import UNKNOWN_PARTITION_VALUE
+
+        col, allowed = self.runtime_split_filter
+        pv = self.source.split_partition_value(i, col)
+        if pv is UNKNOWN_PARTITION_VALUE:
+            return False  # conservative: value not derivable from layout
+        return pv is None or pv not in allowed  # null never equals a key
 
     def execute(self, ctx: ExecContext) -> list[Partition]:
         from ..columnar.arrow import table_to_batches
@@ -104,14 +121,19 @@ class ScanExec(PhysicalPlan):
         cache = getattr(self.source, "_device_cache", None)
         if cache is None and getattr(self.source, "cache_device_batches", False):
             cache = self.source._device_cache = {}
+        schema = attrs_schema(self.attrs)
         out: list[Partition] = []
         for i in range(self.source.num_partitions()):
+            if self._split_pruned(i):
+                ctx.metrics.add("scan.dpp_pruned_splits")
+                out.append([ColumnarBatch.empty(schema)])
+                continue
             key = (i, tuple(cols), cap)
             if cache is not None and key in cache:
                 out.append(cache[key])
                 continue
             table = self.source.read_partition(i, cols)
-            batches = list(table_to_batches(table, cap, attrs_schema(self.attrs)))
+            batches = list(table_to_batches(table, cap, schema))
             ctx.metrics.add(f"scan.{self.name}.rows", table.num_rows)
             if cache is not None:
                 cache[key] = batches
@@ -267,8 +289,27 @@ class ComputeExec(PhysicalPlan):
         return self._pipeline
 
     def execute(self, ctx: ExecContext) -> list[Partition]:
-        pipe = self._get_pipeline()
         parts = self.child.execute(ctx)
+        if not self.filters:
+            # pure column reorder/prune: share the child's arrays instead of
+            # launching an identity kernel — a computed copy would also be
+            # re-staged per downstream dispatch on transfer-bound transports
+            pos = {a.expr_id: i for i, a in enumerate(self.child.output)}
+            if all(isinstance(e, AttributeReference) and e.expr_id in pos
+                   for e in self.outputs):
+                schema = attrs_schema(self.output)
+                idx = [pos[e.expr_id] for e in self.outputs]
+
+                def reorder(b):
+                    nb = ColumnarBatch(schema, [b.columns[i] for i in idx],
+                                       b.row_mask, num_rows=b._num_rows)
+                    # column objects are shared, so their id-keyed host
+                    # stats (dense_range) stay valid — keep them
+                    nb._stats = b._stats
+                    return nb
+
+                return [[reorder(b) for b in part] for part in parts]
+        pipe = self._get_pipeline()
         return [[pipe.run(b) for b in part] for part in parts]
 
     def simple_string(self):
@@ -283,6 +324,12 @@ class ComputeExec(PhysicalPlan):
 # ---------------------------------------------------------------------------
 # Aggregation
 # ---------------------------------------------------------------------------
+
+def _batch_stats_cache(batch: ColumnarBatch) -> dict:
+    if batch._stats is None:
+        batch._stats = {}
+    return batch._stats
+
 
 def _group_kernel(num_keys: int, ops: tuple[str, ...], cap: int,
                   key_valid_sig: tuple[bool, ...],
@@ -342,6 +389,10 @@ def _dense_group_kernel(ops: tuple[str, ...], cap: int, out_cap: int,
 
     def kernel(key, key_valid, kmin, val_datas, val_valids, row_mask):
         jnp = _jnp()
+        # cast INSIDE the program: an eager host-side astype would make the
+        # key a computed array, which some device transports re-stage on
+        # every downstream dispatch (axon tunnel: ~50 MB/s per boundary)
+        key = key.astype(jnp.int64)
         seg = (key - kmin).astype(jnp.int32)
         if has_key_valid:
             seg = jnp.where(key_valid, seg, out_cap - 1)
@@ -778,39 +829,46 @@ class HashAggregateExec(PhysicalPlan):
         if not isinstance(kc.dtype, (IntegralType, DateType)):
             return None
         cap = batch.capacity
-        key64 = kc.data.astype(jnp.int64)
-        mask = batch.row_mask if kc.validity is None \
-            else (batch.row_mask & kc.validity)
 
-        rkey = ("krange", cap)
+        stats = _batch_stats_cache(batch)
+        skey = ("dense_range", id(kc.data))
+        cached = stats.get(skey)
+        if cached is None:
+            rkey = ("krange", cap, str(kc.data.dtype),
+                    kc.validity is not None)
 
-        def build_range():
-            def kr(k, m):
-                big = jnp.iinfo(jnp.int64).max
-                small = jnp.iinfo(jnp.int64).min
-                return (jnp.min(jnp.where(m, k, big)),
-                        jnp.max(jnp.where(m, k, small)),
-                        jnp.any(m))
-            return jax.jit(kr)
+            def build_range():
+                def kr(k, v, m):
+                    k = k.astype(jnp.int64)  # cast inside (transport cost)
+                    if v is not None:
+                        m = m & v
+                    big = jnp.iinfo(jnp.int64).max
+                    small = jnp.iinfo(jnp.int64).min
+                    return (jnp.min(jnp.where(m, k, big)),
+                            jnp.max(jnp.where(m, k, small)),
+                            jnp.any(m))
+                return jax.jit(kr)
 
-        kmin_d, kmax_d, any_d = GLOBAL_KERNEL_CACHE.get_or_build(
-            rkey, build_range)(key64, mask)
-        if not bool(any_d):
+            kmin_d, kmax_d, any_d = GLOBAL_KERNEL_CACHE.get_or_build(
+                rkey, build_range)(kc.data, kc.validity, batch.row_mask)
+            cached = stats[skey] = (int(kmin_d), int(kmax_d), bool(any_d))
+        kmin, kmax, any_live = cached
+        if not any_live:
             return None
-        kmin, kmax = int(kmin_d), int(kmax_d)
         span = kmax - kmin + 1
         if span + 1 > min(4 * cap, 1 << 23):
             return None  # sparse keys — sort path handles it
 
         out_cap = bucket_capacity(span + 1)
         dkey = ("dagg", ops, cap, out_cap, kc.validity is not None,
+                str(kc.data.dtype),
                 tuple(str(d.dtype) for d in val_datas),
                 tuple(v is not None for v in val_valids))
         kernel = GLOBAL_KERNEL_CACHE.get_or_build(
             dkey, lambda: _dense_group_kernel(
                 ops, cap, out_cap, kc.validity is not None))
         out_keys, key_validity, bufs, out_mask = kernel(
-            key64, kc.validity, jnp.int64(kmin), val_datas, val_valids,
+            kc.data, kc.validity, jnp.int64(kmin), val_datas, val_valids,
             batch.row_mask)
         ctx.metrics.add("agg.dense_fast_path")
 
@@ -1003,6 +1061,10 @@ class HashJoinExec(PhysicalPlan):
         self.left = left
         self.right = right
         self.is_broadcast = is_broadcast
+        # [(ScanExec, key index)] injected by the planner: probe-side scans
+        # whose partition column is a join key — executing the build side
+        # first lets those scans skip whole splits (DPP)
+        self.dpp_targets: list = []
 
     @property
     def output(self):
@@ -1028,8 +1090,14 @@ class HashJoinExec(PhysicalPlan):
     def execute(self, ctx: ExecContext) -> list[Partition]:
         from .adaptive import coalesce_join_inputs
 
-        left_parts = self.left.execute(ctx)
-        right_parts = self.right.execute(ctx)
+        if self.dpp_targets:
+            # build first; its distinct keys prune probe-side splits
+            right_parts = self.right.execute(ctx)
+            self._install_dpp_filters(right_parts, ctx)
+            left_parts = self.left.execute(ctx)
+        else:
+            left_parts = self.left.execute(ctx)
+            right_parts = self.right.execute(ctx)
         if self.is_broadcast:
             # broadcast exchange produced one partition; replicate
             bp = right_parts[0]
@@ -1052,6 +1120,46 @@ class HashJoinExec(PhysicalPlan):
         for lp, rp in zip(left_parts, right_parts):
             out.append(self._join_partition(lp, rp, lschema, rschema, ctx))
         return out
+
+    def _install_dpp_filters(self, right_parts, ctx) -> None:
+        """Distinct build-side key values → runtime split filters on the
+        probe scans (reference: PartitionPruning's duplicated build
+        subquery; here the materialized build side IS the value source, so
+        nothing is executed twice)."""
+        from ..config import DPP_BUILD_THRESHOLD
+
+        max_rows = int(ctx.conf.get(DPP_BUILD_THRESHOLD))
+        total = sum(b.num_rows() for p in right_parts for b in p)
+        rpos = {a.expr_id: i for i, a in enumerate(self.right.output)}
+        values_by_key: dict[int, set] = {}
+        for scan, key_idx in self.dpp_targets:
+            if total > max_rows:
+                scan.runtime_split_filter = None
+                continue
+            values = values_by_key.get(key_idx)
+            if values is None:
+                ci = rpos[self.right_keys[key_idx].expr_id]
+                values = set()
+                for part in right_parts:
+                    for b in part:
+                        arr = b.columns[ci].to_numpy(b.selection_indices())
+                        if arr.dtype == object:
+                            arr = np.array([v for v in arr if v is not None],
+                                           dtype=object)
+                        if len(arr):
+                            values.update(
+                                v.item() if hasattr(v, "item") else v
+                                for v in np.unique(arr))
+                values_by_key[key_idx] = values
+            col_name = scan.attrs[self._dpp_attr_index(scan, key_idx)].name
+            scan.runtime_split_filter = (col_name, values)
+
+    def _dpp_attr_index(self, scan, key_idx: int) -> int:
+        target = self.left_keys[key_idx].expr_id
+        for i, a in enumerate(scan.attrs):
+            if a.expr_id == target:
+                return i
+        raise KeyError(target)
 
     def _join_partition(self, lp: Partition, rp: Partition, lschema, rschema,
                         ctx) -> Partition:
@@ -1094,6 +1202,10 @@ class HashJoinExec(PhysicalPlan):
                                (IntegralType, DateType, DecimalType)) \
                 and ctx.conf.get("spark.tpu.join.runtimeFilter", False):
             lp = self._range_filter_probe(lp, build, bkeys, bkey_valids,
+                                          lpos, ctx)
+        if self.join_type in ("inner", "left_semi") \
+                and ctx.conf.get("spark.tpu.join.runtimeFilter.bloom", False):
+            lp = self._bloom_filter_probe(lp, build, bkeys, bkey_valids,
                                           lpos, ctx)
 
         bi_key = ("join_build", build.capacity, len(bkeys),
@@ -1172,6 +1284,90 @@ class HashJoinExec(PhysicalPlan):
             km = GLOBAL_KERNEL_CACHE.get_or_build(fkey, build_mask)
             nm, live = km(pc.data, pc.validity, pb.row_mask, bmin, bmax)
             live = int(live)
+            nb = ColumnarBatch(pb.schema, pb.columns, nm, num_rows=live)
+            if bucket_capacity(max(live, 1)) <= pb.capacity // 16:
+                nb = compact_batch(nb)
+                ctx.metrics.add("join.runtime_filter_compactions")
+            out.append(nb)
+        return out
+
+    def _bloom_filter_probe(self, lp, build, bkeys, bkey_valids, lpos, ctx):
+        """Runtime bloom join filter (reference: InjectRuntimeFilter.scala
+        bloom branch + BloomFilterImpl): a device bitset of build-key hashes
+        drops probe rows that cannot match an inner/semi join before the
+        sort-probe. Works for any key arity/type (the hash domain is
+        hash_columns), unlike the single-integral-key min-max filter. The
+        bitset is two scatter-sets at build + two gathers at probe — all
+        inside XLA; k=2 with ≥8 bits/row keeps the false-positive rate
+        under ~5%."""
+        import jax
+
+        from ..columnar.ops import compact_batch
+        from ..ops.hashing import hash_columns, mix64
+
+        jnp = _jnp()
+        nbits = min(1 << 24, bucket_capacity(max(build.capacity, 1) * 8))
+        bkey_eqs = [c.eq_keys() for c in bkeys]
+
+        bkey2 = ("join_rf_bloom_build", build.capacity, nbits, len(bkeys),
+                 tuple(str(k.dtype) for k in bkey_eqs),
+                 tuple(v is not None for v in bkey_valids))
+
+        from ..utils.sketch import bloom_position_offsets
+
+        off0, off1 = bloom_position_offsets(2)
+
+        def build_bloom():
+            def kb(eqs, valids, mask):
+                h = hash_columns(eqs, list(valids))
+                p1 = mix64(h + jnp.int64(off0)) & (nbits - 1)
+                p2 = mix64(h + jnp.int64(off1)) & (nbits - 1)
+                p1 = jnp.where(mask, p1, nbits)
+                p2 = jnp.where(mask, p2, nbits)
+                bits = jnp.zeros(nbits, dtype=bool)
+                bits = bits.at[p1].set(True, mode="drop")
+                bits = bits.at[p2].set(True, mode="drop")
+                return bits
+
+            return jax.jit(kb)
+
+        # a broadcast join probes the SAME build batch once per partition —
+        # memoize the bitset on the batch so the scatter-build runs once
+        bstats = _batch_stats_cache(build)
+        mkey = ("bloom_bits", nbits,
+                tuple(k.expr_id for k in self.right_keys))
+        bits = bstats.get(mkey)
+        if bits is None:
+            bits = GLOBAL_KERNEL_CACHE.get_or_build(bkey2, build_bloom)(
+                bkey_eqs, bkey_valids, build.row_mask)
+            bstats[mkey] = bits
+
+        out = []
+        for pb in (lp or []):
+            pkeys = [pb.columns[lpos[k.expr_id]] for k in self.left_keys]
+            pkey_eqs = [c.eq_keys() for c in pkeys]
+            pkey_valids = [c.validity for c in pkeys]
+            fkey = ("join_rf_bloom_probe", pb.capacity, nbits, len(pkeys),
+                    tuple(str(k.dtype) for k in pkey_eqs),
+                    tuple(v is not None for v in pkey_valids))
+
+            def probe_bloom():
+                def kp(bits, eqs, valids, mask):
+                    h = hash_columns(eqs, list(valids))
+                    keep = jnp.take(bits, mix64(h + jnp.int64(off0))
+                                    & (nbits - 1)) \
+                        & jnp.take(bits, mix64(h + jnp.int64(off1))
+                                   & (nbits - 1))
+                    nm = mask & keep
+                    return nm, jnp.sum(nm)
+
+                return jax.jit(kp)
+
+            nm, live = GLOBAL_KERNEL_CACHE.get_or_build(fkey, probe_bloom)(
+                bits, pkey_eqs, pkey_valids, pb.row_mask)
+            before = pb.num_rows()
+            live = int(live)
+            ctx.metrics.add("join.bloom_filtered_rows", before - live)
             nb = ColumnarBatch(pb.schema, pb.columns, nm, num_rows=live)
             if bucket_capacity(max(live, 1)) <= pb.capacity // 16:
                 nb = compact_batch(nb)

@@ -56,7 +56,58 @@ class Planner:
     def plan(self, plan: L.LogicalPlan) -> PhysicalPlan:
         p = self._convert(plan)
         p = self._ensure_requirements(p)
+        self._inject_dpp(p)
         return p
+
+    # ------------------------------------------------------------------
+    def _inject_dpp(self, plan: PhysicalPlan) -> None:
+        """Mark probe-side scans whose hive-partition column is a join key
+        so the join executes its build side first and prunes whole splits
+        (reference: sqlx/dynamicpruning/PartitionPruning.scala; here the
+        materialized build side replaces the duplicated filter subquery)."""
+        if not self.conf.get("spark.sql.dynamicPartitionPruning.enabled",
+                             True):
+            return
+
+        from .exchange import BroadcastExchangeExec as _BX, \
+            ShuffleExchangeExec as _SX
+        from .operators import CoalescePartitionsExec as _CP, \
+            ComputeExec as _CE, UnionExec as _UN
+
+        def scans_under(n, acc):
+            """Pruning-safe descent only: an output row of these operators
+            carries its source row's partition column unchanged, so dropping
+            non-matching scan rows cannot change surviving rows (reference:
+            PartitionPruning's Project/Filter/Join/Union restriction).
+            Limit/Window/Sort/Sample/Aggregate stop the walk — pruning
+            beneath them would change which rows they keep."""
+            if isinstance(n, ScanExec):
+                acc.append(n)
+                return
+            if isinstance(n, (_CE, _UN, _SX, _BX, _CP)):
+                for c in n.children:
+                    scans_under(c, acc)
+            elif isinstance(n, HashJoinExec):
+                scans_under(n.left, acc)
+
+        def walk(n):
+            for c in n.children:
+                walk(c)
+            if isinstance(n, HashJoinExec) \
+                    and n.join_type in ("inner", "left_semi"):
+                acc: list = []
+                scans_under(n.left, acc)
+                for scan in acc:
+                    pk = getattr(scan.source, "_part_keys", None)
+                    if not pk or not hasattr(scan.source,
+                                             "split_partition_value"):
+                        continue
+                    by_id = {a.expr_id: a.name for a in scan.attrs}
+                    for ki, lk in enumerate(n.left_keys):
+                        if by_id.get(lk.expr_id) in pk:
+                            n.dpp_targets.append((scan, ki))
+
+        walk(plan)
 
     # ------------------------------------------------------------------
     def _convert(self, node: L.LogicalPlan) -> PhysicalPlan:
@@ -76,8 +127,25 @@ class Planner:
             child = self._convert(node.child)
             return self._fuse_compute([], node.project_list, child)
         if isinstance(node, L.Filter):
+            conjuncts = split_conjuncts(node.condition)
+            inner = node.child
+            while isinstance(inner, L.SubqueryAlias):
+                inner = inner.child
+            if isinstance(inner, L.LogicalRelation) \
+                    and hasattr(inner.source, "pruned") \
+                    and self.conf.get("spark.sql.parquet.filterPushdown",
+                                      True):
+                preds = _source_predicates(conjuncts, inner.attrs)
+                if preds:
+                    # split/row-group pruning by stats (reference:
+                    # ParquetFileFormat row-group filter + partition
+                    # pruning); the filter stays — pruning is conservative
+                    child = ScanExec(inner.source.pruned(preds),
+                                     list(inner.attrs), inner.name)
+                    return self._fuse_compute(
+                        conjuncts, [a for a in node.child.output], child)
             child = self._convert(node.child)
-            return self._fuse_compute(split_conjuncts(node.condition),
+            return self._fuse_compute(conjuncts,
                                       [a for a in node.child.output], child)
         if isinstance(node, L.Aggregate):
             return self._plan_aggregate(node)
@@ -506,6 +574,38 @@ class Planner:
         if changed:
             return plan.with_new_children(new_children)
         return plan
+
+
+def _source_predicates(conjuncts, attrs) -> list:
+    """Extract (col, op, value) predicates a DataSource can prune with:
+    attr-vs-literal comparisons and IN over literals (reference:
+    DataSourceStrategy.translateFilter)."""
+    from ..expr.expressions import (
+        EqualTo, GreaterThan, GreaterThanOrEqual, In, LessThan,
+        LessThanOrEqual, Literal,
+    )
+
+    names = {a.expr_id: a.name for a in attrs}
+    flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "="}
+    ops = {EqualTo: "=", LessThan: "<", LessThanOrEqual: "<=",
+           GreaterThan: ">", GreaterThanOrEqual: ">="}
+    preds = []
+    for c in conjuncts:
+        op = ops.get(type(c))
+        if op is not None:
+            l, r = c.left, c.right
+            if isinstance(r, AttributeReference) and isinstance(l, Literal):
+                l, r, op = r, l, flip[op]
+            if isinstance(l, AttributeReference) and isinstance(r, Literal) \
+                    and r.value is not None and l.expr_id in names:
+                preds.append((names[l.expr_id], op, r.value))
+        elif isinstance(c, In) and isinstance(c.child, AttributeReference) \
+                and c.child.expr_id in names \
+                and all(isinstance(i, Literal) for i in c.items):
+            vals = [i.value for i in c.items if i.value is not None]
+            if vals:
+                preds.append((names[c.child.expr_id], "in", vals))
+    return preds
 
 
 _id_box = [None]

@@ -1,0 +1,75 @@
+"""BloomFilter / CountMinSketch (role of the reference's common/sketch
+suites: BloomFilterSuite.scala, CountMinSketchSuite.scala)."""
+
+import numpy as np
+import pytest
+
+from spark_tpu.utils.sketch import BloomFilter, CountMinSketch
+
+
+def test_bloom_no_false_negatives():
+    rng = np.random.default_rng(0)
+    items = rng.integers(0, 1 << 40, 5000)
+    bf = BloomFilter(expected_items=5000, fpp=0.03)
+    bf.put_many(items)
+    assert bf.might_contain_many(items).all()
+
+
+def test_bloom_fpp_reasonable():
+    rng = np.random.default_rng(1)
+    items = rng.integers(0, 1 << 40, 5000)
+    other = rng.integers(1 << 41, 1 << 42, 20000)
+    bf = BloomFilter(expected_items=5000, fpp=0.03)
+    bf.put_many(items)
+    fp = bf.might_contain_many(other).mean()
+    assert fp < 0.1, fp
+
+
+def test_bloom_strings_and_merge():
+    a = BloomFilter(expected_items=100, num_bits=1 << 12)
+    b = BloomFilter(expected_items=100, num_bits=1 << 12)
+    b.num_hashes = a.num_hashes
+    a.put_many(["x", "y"])
+    b.put_many(["z"])
+    a.merge(b)
+    assert a.might_contain("x") and a.might_contain("z")
+
+
+def test_bloom_roundtrip():
+    bf = BloomFilter(expected_items=10)
+    bf.put_many([1, 2, 3])
+    bf2 = BloomFilter.from_bytes(bf.to_bytes())
+    assert bf2.might_contain_many([1, 2, 3]).all()
+    assert bf2.num_hashes == bf.num_hashes
+
+
+def test_bloom_incompatible_merge():
+    a = BloomFilter(1, num_bits=1 << 10)
+    b = BloomFilter(1, num_bits=1 << 11)
+    with pytest.raises(AssertionError):
+        a.merge(b)
+
+
+def test_cms_counts():
+    cms = CountMinSketch(eps=0.001, confidence=0.99)
+    rng = np.random.default_rng(2)
+    vals = rng.integers(0, 50, 10000)
+    cms.add_many(vals)
+    true = np.bincount(vals, minlength=50)
+    est = cms.estimate_count_many(np.arange(50))
+    # CMS never undercounts; overcount bounded by eps * total
+    assert (est >= true).all()
+    assert (est - true).max() <= 0.01 * cms.total + 1
+    assert cms.total == 10000
+
+
+def test_cms_merge_roundtrip():
+    a = CountMinSketch(depth=4, width=1 << 10)
+    b = CountMinSketch(depth=4, width=1 << 10)
+    a.add("k", 3)
+    b.add("k", 2)
+    a.merge(b)
+    assert a.estimate_count("k") >= 5
+    c = CountMinSketch.from_bytes(a.to_bytes())
+    assert c.estimate_count("k") >= 5
+    assert c.total == a.total
