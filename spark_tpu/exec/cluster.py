@@ -85,11 +85,18 @@ class LocalCluster:
         root = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
         env["PYTHONPATH"] = root + os.pathsep + env["PYTHONPATH"]
+        self.authkey_hex = authkey.hex()
         for _ in range(num_workers):
             proc = subprocess.Popen(
                 [sys.executable, "-m", "spark_tpu.exec.worker_main"],
                 env=env)
             conn = self._listener.accept()
+            # consume the handshake (the worker announces its block-server
+            # address; the authoritative copy rides in each MapStatus)
+            try:
+                conn.recv()
+            except (EOFError, OSError):
+                pass
             eid = self.registry.register(host="localhost", slots=1)
             self._workers[eid] = _Worker(proc, conn, eid)
 
@@ -106,12 +113,17 @@ class LocalCluster:
             return w
 
     def run_task(self, fn: Callable, *args) -> Any:
+        return self.run_task_traced(fn, *args)[0]
+
+    def run_task_traced(self, fn: Callable, *args) -> tuple:
+        """Run a task; returns (result, worker) so callers can register
+        which executor holds the outputs (MapOutputTracker role)."""
         payload = cloudpickle.dumps((fn, args))
         last: Exception | None = None
         for _ in range(self.max_task_failures):
             w = self._pick()
             try:
-                return w.run(payload)
+                return w.run(payload), w
             except RemoteTaskError:
                 raise  # the function itself failed; retrying won't help
             except Exception as e:  # connection/process death

@@ -2,12 +2,16 @@
 
 Role of the reference's cluster-mode SQL execution (DAGScheduler map
 stages running on executors, shuffle blocks fetched between them —
-core/scheduler/DAGScheduler.scala + ShuffleBlockFetcherIterator): here a
-stage's physical subtree is cloudpickled to a worker process, its parent
-stages' outputs travel as Arrow IPC partition payloads, and results come
-back the same way. Independent parent stages run on different workers
-concurrently. The result (final) stage always runs in the driver so
-device caches and session services stay local.
+core/scheduler/DAGScheduler.scala + ShuffleBlockFetcherIterator): a
+stage's physical subtree is cloudpickled to a worker process, which
+STORES its output partitions in its local block server and returns only
+a MapStatus (address + per-partition rows/bytes). Consumer stages
+receive Fetch leaves and pull the blocks directly from the producing
+worker — shuffle data never rides through the driver. A failed fetch
+(worker died after producing) surfaces as FetchFailedError and the
+scheduler regenerates the lost map stage from lineage, exactly the
+reference's FetchFailed → resubmit path. The result (final) stage always
+runs in the driver so device caches and session services stay local.
 
 The columnar kernels are identical on driver and workers — a worker is
 just another process with its own XLA client (CPU in the local cluster;
@@ -16,11 +20,15 @@ contract rides DCN instead of localhost pipes)."""
 
 from __future__ import annotations
 
+import uuid
 from concurrent.futures import ThreadPoolExecutor
 
 import cloudpickle
 
 from ..physical.operators import PhysicalPlan
+from .map_output import (
+    FetchFailedError, MapOutputTracker, MapStatus, fetch_block, free_shuffle,
+)
 from .scheduler import DAGScheduler, Stage, _StageOutput, build_stage_graph
 
 
@@ -40,32 +48,37 @@ def _partitions_to_ipc(parts):
     return out
 
 
-def _ipc_to_partitions(payload, attrs):
+def _ipc_to_partition(tabs, schema):
     import pyarrow as pa
 
     from ..columnar.arrow import record_batch_to_columnar
+
+    return [record_batch_to_columnar(
+        pa.ipc.open_stream(pa.BufferReader(raw)).read_all(), schema)
+        for raw in tabs]
+
+
+def _ipc_to_partitions(payload, attrs):
     from ..physical.operators import attrs_schema
 
     schema = attrs_schema(attrs)
-    parts = []
-    for tabs in payload:
-        batches = []
-        for raw in tabs:
-            t = pa.ipc.open_stream(pa.BufferReader(raw)).read_all()
-            batches.append(record_batch_to_columnar(t, schema))
-        parts.append(batches)
-    return parts
+    return [_ipc_to_partition(tabs, schema) for tabs in payload]
 
 
-class PrecomputedIPCExec(PhysicalPlan):
-    """Leaf carrying a parent stage's output as Arrow IPC payloads —
-    the shuffle-block-fetch stand-in shipped inside the task."""
+class FetchExec(PhysicalPlan):
+    """Leaf that pulls a parent stage's partitions from the executor that
+    produced them (the BlockStoreShuffleReader role). One block per
+    reduce partition (stage-granular map tasks)."""
 
     child_fields = ()
 
-    def __init__(self, attrs, payload):
+    def __init__(self, attrs, shuffle_id: str, block_addr: str,
+                 authkey_hex: str, num_partitions: int):
         self.attrs = list(attrs)
-        self.payload = payload
+        self.shuffle_id = shuffle_id
+        self.block_addr = block_addr
+        self.authkey_hex = authkey_hex
+        self.num_partitions = num_partitions
 
     @property
     def output(self):
@@ -74,17 +87,37 @@ class PrecomputedIPCExec(PhysicalPlan):
     def output_partitioning(self):
         from ..physical.partitioning import UnknownPartitioning
 
-        return UnknownPartitioning(max(len(self.payload), 1))
+        return UnknownPartitioning(max(self.num_partitions, 1))
 
     def execute(self, ctx):
-        return _ipc_to_partitions(self.payload, self.attrs)
+        import pickle
+
+        from ..physical.operators import attrs_schema
+        from .map_output import BlockClient
+
+        schema = attrs_schema(self.attrs)
+        out = []
+        # one authenticated connection per producer, reused across blocks
+        with BlockClient(self.block_addr, self.authkey_hex,
+                         self.shuffle_id) as client:
+            for rid in range(self.num_partitions):
+                raw = client.get(rid)
+                out.append(_ipc_to_partition(pickle.loads(raw), schema))
+        ctx.metrics.add("shuffle.blocks_fetched", self.num_partitions)
+        return out
 
     def simple_string(self):
-        return f"PrecomputedIPC({len(self.payload)} parts)"
+        return f"Fetch[{self.shuffle_id}@{self.block_addr}]" \
+               f"({self.num_partitions} parts)"
 
 
-def _run_stage_remote(plan_bytes: bytes, conf_overrides: dict):
-    """Task body executed in a worker process (no TPU tunnel there)."""
+def _run_stage_store(plan_bytes: bytes, conf_overrides: dict,
+                     shuffle_id: str):
+    """Map-stage task body: execute the subtree, store each output
+    partition as a block in THIS worker's store, return per-partition
+    (rows, bytes) — the MapStatus payload. Runs in a worker process."""
+    import pickle
+
     import jax
 
     try:
@@ -94,31 +127,68 @@ def _run_stage_remote(plan_bytes: bytes, conf_overrides: dict):
     jax.config.update("jax_enable_x64", True)
 
     from ..config import SQLConf
+    from . import worker_main as WM
     from .context import ExecContext
 
     plan = cloudpickle.loads(plan_bytes)
     ctx = ExecContext(conf=SQLConf(dict(conf_overrides)))
-    return _partitions_to_ipc(plan.execute(ctx))
+    parts = plan.execute(ctx)
+    rows, sizes = [], []
+    for rid, part in enumerate(parts):
+        ipc = _partitions_to_ipc([part])[0]
+        raw = pickle.dumps(ipc)
+        WM.put_block(shuffle_id, rid, raw)
+        rows.append(sum(b.num_rows() for b in part))
+        sizes.append(len(raw))
+    counters = ctx.metrics.snapshot()["counters"]
+    return ("mapstatus", WM.BLOCK_ADDR, rows, sizes, counters)
 
 
 class ClusterDAGScheduler(DAGScheduler):
     """DAGScheduler that ships non-result stages to cluster workers.
 
-    Stage = unit of distribution AND recovery: a worker loss surfaces as
-    a task error and the stage retries (possibly on another worker) via
-    the inherited attempt loop."""
+    Stage = unit of distribution AND recovery: executor loss during a
+    task retries via the cluster's attempt loop; executor loss AFTER a
+    map stage completed surfaces as FetchFailedError in a consumer and
+    regenerates the lost stage from lineage."""
 
     def __init__(self, ctx, cluster, conf_overrides: dict,
                  max_attempts: int = 2, listener_bus=None):
         super().__init__(ctx, max_attempts, listener_bus)
         self.cluster = cluster
         self.conf_overrides = dict(conf_overrides)
+        self.map_outputs = MapOutputTracker()
+        self._run_id = uuid.uuid4().hex[:12]
 
     def run(self, plan):
+        import threading
+        from collections import defaultdict
+
         result_stage, stages = build_stage_graph(plan)
         done: set[int] = set()
+        # per-stage locks serialize materialization/invalidation of a
+        # SHARED parent reached from concurrently-materializing consumers
+        # (diamond DAGs) — lock order is always child→parent, a DAG, so
+        # no cycles
+        locks: dict[int, threading.Lock] = defaultdict(threading.Lock)
+
+        def invalidate_if_stale(stage: Stage, failed_sid: str) -> None:
+            """Under the stage's lock: drop its outputs only if they are
+            still the ones the fetch failed against (another consumer may
+            have regenerated it already)."""
+            with locks[stage.stage_id]:
+                cur = self._shuffle_id(stage)
+                st = self.map_outputs.get(cur)
+                if cur == failed_sid or st is None:
+                    done.discard(stage.stage_id)
+                    stage.result = None
+                    self.map_outputs.unregister(cur)
 
         def materialize(stage: Stage) -> None:
+            with locks[stage.stage_id]:
+                _materialize_locked(stage)
+
+        def _materialize_locked(stage: Stage) -> None:
             if stage.stage_id in done:
                 return
             if len(stage.parents) > 1:
@@ -133,7 +203,8 @@ class ClusterDAGScheduler(DAGScheduler):
                 try:
                     self._post("stageSubmitted", stage)
                     if stage is result_stage:
-                        stage.result = stage.root.execute(self.ctx)
+                        root = _substitute_parents(stage.root, self)
+                        stage.result = root.execute(self.ctx)
                     else:
                         stage.result = self._run_remote(stage)
                     self.ctx.metrics.add("scheduler.stages_completed")
@@ -142,25 +213,77 @@ class ClusterDAGScheduler(DAGScheduler):
                     return
                 except Exception as e:
                     last_err = e
-                    self.ctx.metrics.add("scheduler.stage_retries")
+                    sid = _fetch_failed_shuffle_id(e)
+                    if sid is not None:
+                        # a parent's blocks are gone — regenerate it from
+                        # lineage before retrying this stage
+                        self.ctx.metrics.add("scheduler.fetch_failures")
+                        for p in stage.parents:
+                            invalidate_if_stale(p, sid)
+                        for p in stage.parents:
+                            materialize(p)
+                    else:
+                        self.ctx.metrics.add("scheduler.stage_retries")
                     self._post("stageFailed", stage, error=str(e))
             raise last_err  # noqa: B904
 
-        materialize(result_stage)
-        return result_stage.result
+        try:
+            materialize(result_stage)
+            return result_stage.result
+        finally:
+            self._free_shuffles()
+
+    # ------------------------------------------------------------------
+    def _shuffle_id(self, stage: Stage) -> str:
+        return f"{self._run_id}.{stage.stage_id}.{stage.attempts}"
 
     def _run_remote(self, stage: Stage):
-        shipped = _substitute_parents(stage.root)
+        shipped = _substitute_parents(stage.root, self)
         payload = cloudpickle.dumps(shipped)
-        ipc = self.cluster.run_task(_run_stage_remote, payload,
-                                    self.conf_overrides)
+        sid = self._shuffle_id(stage)
+        result, worker = self.cluster.run_task_traced(
+            _run_stage_store, payload, self.conf_overrides, sid)
+        tag, addr, rows, sizes, counters = result
+        assert tag == "mapstatus", tag
+        status = MapStatus(sid, addr, worker.executor_id, rows, sizes)
+        self.map_outputs.register(status)
+        # fold worker-side operator metrics into the driver's view (the
+        # executor-heartbeat metrics channel, reduced to per-task return)
+        for k, v in counters.items():
+            self.ctx.metrics.add(k, v)
         self.ctx.metrics.add("scheduler.stages_remote")
-        return _ipc_to_partitions(ipc, list(stage.root.output))
+        self.ctx.metrics.add("shuffle.bytes_written", sum(sizes))
+        return status
+
+    def _free_shuffles(self) -> None:
+        key = self.cluster.authkey_hex
+        for sid in self.map_outputs.shuffle_ids():
+            st = self.map_outputs.get(sid)
+            if st is not None:
+                free_shuffle(st.block_addr, key, sid)
+            self.map_outputs.unregister(sid)
 
 
-def _substitute_parents(node):
-    """Replace _StageOutput leaves with IPC payload leaves for shipping."""
+def _fetch_failed_shuffle_id(e: Exception) -> str | None:
+    """Extract the shuffle id from a FetchFailedError, including one that
+    crossed a process boundary as a RemoteTaskError traceback string."""
+    if isinstance(e, FetchFailedError):
+        return e.shuffle_id
+    text = str(e)
+    marker = FetchFailedError.MARKER + ":"
+    if marker in text:
+        return text.split(marker, 1)[1].split(":", 1)[0]
+    return None
+
+
+def _substitute_parents(node, sched: ClusterDAGScheduler):
+    """Replace _StageOutput leaves with Fetch leaves bound to the
+    executor holding the parent's blocks."""
     if isinstance(node, _StageOutput):
-        return PrecomputedIPCExec(
-            node.attrs, _partitions_to_ipc(node.stage.result))
-    return node.map_children(_substitute_parents)
+        st = node.stage
+        status = st.result
+        assert isinstance(status, MapStatus), \
+            f"parent stage {st.stage_id} not materialized"
+        return FetchExec(node.attrs, status.shuffle_id, status.block_addr,
+                         sched.cluster.authkey_hex, status.num_partitions)
+    return node.map_children(lambda c: _substitute_parents(c, sched))

@@ -1,0 +1,136 @@
+"""Map-output tracking and shuffle-block fetch.
+
+Role of the reference's MapOutputTracker (core/MapOutputTracker.scala —
+driver-side registry of MapStatus: which executor holds which shuffle
+partition, and how big it is) and BlockStoreShuffleReader
+(core/shuffle/BlockStoreShuffleReader.scala:72 — reducers pull blocks
+from the executors that wrote them). Stage-granular variant: a map stage
+runs whole on one executor, so each reduce partition is exactly one
+block at one address.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from multiprocessing.connection import Client
+
+
+class FetchFailedError(RuntimeError):
+    """A shuffle block could not be fetched (executor lost its store).
+    Carries the shuffle id so the scheduler can regenerate the parent
+    stage (DAGScheduler FetchFailed → resubmit map stage)."""
+
+    MARKER = "SPARK_TPU_FETCH_FAILED"
+
+    def __init__(self, shuffle_id: str, detail: str = ""):
+        super().__init__(f"{self.MARKER}:{shuffle_id}: {detail}")
+        self.shuffle_id = shuffle_id
+
+
+@dataclass
+class MapStatus:
+    """Where a map stage's output lives + per-reduce-partition sizes
+    (core/scheduler/MapStatus.scala: location + getSizeForBlock)."""
+
+    shuffle_id: str
+    block_addr: str      # host:port of the executor's block server
+    executor_id: str
+    rows: list = field(default_factory=list)    # per reduce partition
+    bytes: list = field(default_factory=list)   # per reduce partition
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.rows)
+
+
+class MapOutputTracker:
+    """Driver-side registry: shuffle_id → MapStatus."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._statuses: dict[str, MapStatus] = {}
+
+    def register(self, status: MapStatus) -> None:
+        with self._lock:
+            self._statuses[status.shuffle_id] = status
+
+    def get(self, shuffle_id: str) -> MapStatus | None:
+        with self._lock:
+            return self._statuses.get(shuffle_id)
+
+    def unregister(self, shuffle_id: str) -> None:
+        with self._lock:
+            self._statuses.pop(shuffle_id, None)
+
+    def shuffle_ids(self) -> list[str]:
+        with self._lock:
+            return list(self._statuses)
+
+
+class BlockClient:
+    """One authenticated connection to an executor's block server, reused
+    across block requests (ShuffleBlockFetcherIterator keeps one channel
+    per (host, port) too — per-block reconnect pays the auth handshake
+    num_partitions times)."""
+
+    def __init__(self, addr: str, authkey_hex: str, shuffle_id: str):
+        self.shuffle_id = shuffle_id
+        if ":" not in addr:
+            raise FetchFailedError(shuffle_id, f"bad block address {addr!r}")
+        host, port = addr.rsplit(":", 1)
+        self.addr = addr
+        try:
+            self._conn = Client((host, int(port)),
+                                authkey=bytes.fromhex(authkey_hex))
+        except (OSError, EOFError) as e:
+            raise FetchFailedError(shuffle_id, f"{addr} unreachable: {e}")
+
+    def get(self, reduce_id: int) -> bytes:
+        try:
+            self._conn.send(("get", self.shuffle_id, reduce_id))
+            status, data = self._conn.recv()
+        except (OSError, EOFError) as e:
+            raise FetchFailedError(self.shuffle_id,
+                                   f"{self.addr} died mid-fetch: {e}")
+        if status != "ok":
+            raise FetchFailedError(
+                self.shuffle_id, f"block {reduce_id} missing at {self.addr}")
+        return data
+
+    def close(self) -> None:
+        try:
+            self._conn.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def fetch_block(addr: str, authkey_hex: str, shuffle_id: str,
+                reduce_id: int) -> bytes:
+    """Pull one block (one-shot convenience over BlockClient)."""
+    with BlockClient(addr, authkey_hex, shuffle_id) as c:
+        return c.get(reduce_id)
+
+
+def free_shuffle(addr: str, authkey_hex: str, shuffle_id: str) -> None:
+    """Best-effort release of a shuffle's blocks on one executor."""
+    if ":" not in addr:
+        return
+    host, port = addr.rsplit(":", 1)
+    try:
+        conn = Client((host, int(port)),
+                      authkey=bytes.fromhex(authkey_hex))
+        try:
+            conn.send(("free", shuffle_id))
+            conn.recv()
+        finally:
+            conn.close()
+    except (OSError, EOFError):
+        pass

@@ -3,21 +3,94 @@
 Role of the reference's CoarseGrainedExecutorBackend.main
 (core/executor/CoarseGrainedExecutorBackend.scala:181 LaunchTask →
 core/executor/Executor.scala TaskRunner): connect back to the driver,
-loop receiving cloudpickled (fn, args) tasks, execute, reply."""
+loop receiving cloudpickled (fn, args) tasks, execute, reply.
+
+Each worker also runs a BLOCK SERVER (role of the executor-side
+shuffle-block transport, common/network-shuffle
+ExternalBlockHandler.java): map-stage outputs persist in this process
+under (shuffle_id, reduce_id) and reducers running on OTHER workers (or
+the driver) fetch them directly over a localhost socket — the driver
+never carries shuffle bytes."""
 
 from __future__ import annotations
 
 import os
 import sys
+import threading
 import traceback
-from multiprocessing.connection import Client
+from multiprocessing.connection import Client, Listener
+
+# (shuffle_id, reduce_id) → Arrow IPC bytes; lives for the worker process
+BLOCK_STORE: dict = {}
+BLOCK_ADDR: str = ""
+_STORE_LOCK = threading.Lock()
+
+
+def put_block(shuffle_id: str, reduce_id: int, data: bytes) -> None:
+    with _STORE_LOCK:
+        BLOCK_STORE[(shuffle_id, reduce_id)] = data
+
+
+def _serve_block_conn(conn):
+    try:
+        while True:
+            try:
+                msg = conn.recv()
+            except (EOFError, OSError):
+                return
+            op = msg[0]
+            if op == "get":
+                _, sid, rid = msg
+                with _STORE_LOCK:
+                    data = BLOCK_STORE.get((sid, rid))
+                if data is None:
+                    conn.send(("missing", None))
+                else:
+                    conn.send(("ok", data))
+            elif op == "free":
+                _, sid = msg
+                with _STORE_LOCK:
+                    for k in [k for k in BLOCK_STORE if k[0] == sid]:
+                        BLOCK_STORE.pop(k, None)
+                conn.send(("ok", None))
+            else:
+                conn.send(("err", f"unknown op {op!r}"))
+    finally:
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+
+def _block_server(authkey: bytes) -> str:
+    listener = Listener(("127.0.0.1", 0), authkey=authkey)
+
+    def loop():
+        while True:
+            try:
+                conn = listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=_serve_block_conn, args=(conn,),
+                             daemon=True).start()
+
+    threading.Thread(target=loop, daemon=True).start()
+    host, port = listener.address
+    return f"{host}:{port}"
 
 
 def main() -> None:
+    # under `python -m`, this file runs as __main__ while tasks import the
+    # canonical spark_tpu.exec.worker_main module — publish the block-store
+    # state THERE so both sides share one dict/address
+    from spark_tpu.exec import worker_main as canonical
+
     addr_s = os.environ["SPARK_TPU_WORKER_ADDR"]
     host, port = addr_s.rsplit(":", 1)
     authkey = bytes.fromhex(os.environ["SPARK_TPU_WORKER_KEY"])
+    canonical.BLOCK_ADDR = canonical._block_server(authkey)
     conn = Client((host, int(port)), authkey=authkey)
+    conn.send(("block_addr", canonical.BLOCK_ADDR))
 
     import cloudpickle
 

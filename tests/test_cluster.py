@@ -140,3 +140,56 @@ def test_distributed_sql_join_and_worker_loss():
         assert sum(out2["s"]) == n
     finally:
         s.stop()
+
+
+def test_fetch_failure_regenerates_lost_map_outputs(monkeypatch):
+    """Worker dies AFTER its map stage completed (blocks lost, task ok):
+    the consumer's fetch fails and the scheduler re-runs only the lost
+    map stage from lineage (reference: DAGScheduler FetchFailed →
+    resubmit missing map stages)."""
+    import numpy as np
+    import pyarrow as pa
+
+    import spark_tpu.exec.cluster_sql as CS
+    from spark_tpu.api.session import TpuSession
+    from spark_tpu.exec.cluster import LocalCluster
+
+    s = TpuSession("csql_ff", {"spark.sql.shuffle.partitions": "3"})
+    cluster = LocalCluster(num_workers=2)
+    s.attachSqlCluster(cluster)
+
+    state = {"killed": False}
+    orig = CS.ClusterDAGScheduler._run_remote
+
+    def kill_after_first_map(self, stage):
+        status = orig(self, stage)
+        if not state["killed"]:
+            state["killed"] = True
+            w = cluster._workers[status.executor_id]
+            w.proc.kill()
+            w.proc.wait(timeout=10)
+        return status
+
+    monkeypatch.setattr(CS.ClusterDAGScheduler, "_run_remote",
+                        kill_after_first_map)
+    try:
+        n = 4000
+        rng = np.random.default_rng(7)
+        s.createDataFrame(pa.table({
+            "k": rng.integers(0, 30, n),
+            "v": rng.integers(1, 5, n)})) \
+            .createOrReplaceTempView("ffact")
+        df = s.table("ffact").repartition(3).groupBy("k").count()
+        got = {r["k"]: r["count"] for r in df.collect()}
+        import collections
+
+        rng2 = np.random.default_rng(7)
+        keys = rng2.integers(0, 30, n)
+        exp = collections.Counter(keys.tolist())
+        assert got == dict(exp)
+        m = s._metrics.snapshot()["counters"]
+        assert m.get("scheduler.fetch_failures", 0) >= 1, m
+        assert m.get("shuffle.blocks_fetched", 0) >= 3, m
+    finally:
+        s.stop()
+        cluster.stop()
