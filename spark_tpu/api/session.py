@@ -229,7 +229,9 @@ class TpuSession:
                 return df
         table = df.toArrow()
         attrs = list(analyzed.output)
-        self._cached[id(df)] = (analyzed, LocalRelation(attrs, table))
+        # unique token key (id(df) recycles after GC and would silently
+        # evict an unrelated entry)
+        self._cached[object()] = (analyzed, LocalRelation(attrs, table))
         return df
 
     def _uncache_df(self, df):

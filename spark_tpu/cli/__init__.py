@@ -1,0 +1,2 @@
+"""CLI tooling (role of the reference's bin/ + launcher/ layer,
+SURVEY.md §1 layer 14: spark-submit, spark-shell, spark-sql)."""

@@ -1,0 +1,49 @@
+"""Interactive Python shell with a prepared session.
+
+Role of the reference's bin/pyspark (python/pyspark/shell.py): drops into
+an interactive interpreter with `spark` (session) and `F` (functions)
+bound, banner included.
+
+Usage: python -m spark_tpu.cli.shell [--conf K=V ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import code
+import sys
+
+
+BANNER = r"""
+   ____              __    ______
+  / __/__  ___ _____/ /__ /_  __/__  __ __
+ _\ \/ _ \/ _ `/ __/  '_/  / / / _ \/ // /
+/___/ .__/\_,_/_/ /_/\_\  /_/ / .__/\_,_/
+   /_/                       /_/
+
+TPU-native analytics engine — `spark` session ready, functions as `F`.
+"""
+
+
+def main(argv: list[str] | None = None) -> int:
+    from .submit import parse_conf
+
+    p = argparse.ArgumentParser(prog="sparktpu-shell")
+    p.add_argument("--conf", action="append", default=[], metavar="K=V")
+    args = p.parse_args(argv)
+
+    from .. import api
+    from ..api.session import TpuSession
+    import spark_tpu.api.functions as F
+
+    spark = TpuSession("shell", parse_conf(args.conf))
+    ns = {"spark": spark, "F": F, "functions": F}
+    try:
+        code.interact(banner=BANNER, local=ns)
+    finally:
+        spark.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,106 @@
+"""Interactive SQL shell.
+
+Role of the reference's bin/spark-sql (SparkSQLCLIDriver,
+sql/hive-thriftserver/.../SparkSQLCLIDriver.scala): a line REPL over a
+session — multi-line statements terminated by ';', EXPLAIN/SET/SHOW pass
+straight through the SQL surface, table output rendered fixed-width.
+
+Usage: python -m spark_tpu.cli.sql_shell [--conf K=V ...] [-e "SQL"]
+       [-f script.sql]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def render_table(table, max_rows: int = 100) -> str:
+    cols = table.column_names
+    data = [c.to_pylist() for c in table.columns]
+    n = min(table.num_rows, max_rows)
+    rows = [[("NULL" if v is None else str(v)) for v in
+             (data[c][i] for c in range(len(cols)))]
+            for i in range(n)]
+    widths = [max(len(cols[c]), *(len(r[c]) for r in rows)) if rows
+              else len(cols[c]) for c in range(len(cols))]
+    sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+    out = [sep,
+           "|" + "|".join(f" {cols[c]:<{widths[c]}} "
+                          for c in range(len(cols))) + "|",
+           sep]
+    for r in rows:
+        out.append("|" + "|".join(f" {r[c]:<{widths[c]}} "
+                                  for c in range(len(cols))) + "|")
+    out.append(sep)
+    if table.num_rows > max_rows:
+        out.append(f"(showing {max_rows} of {table.num_rows} rows)")
+    return "\n".join(out)
+
+
+def run_statement(spark, stmt: str, out=sys.stdout) -> None:
+    t0 = time.perf_counter()
+    df = spark.sql(stmt)
+    if not hasattr(df, "toArrow"):  # command with no result set
+        print("OK", file=out)
+        return
+    table = df.toArrow()
+    dt = time.perf_counter() - t0
+    print(render_table(table), file=out)
+    print(f"{table.num_rows} row(s) in {dt:.3f}s", file=out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    from .submit import parse_conf
+
+    p = argparse.ArgumentParser(prog="sparktpu-sql")
+    p.add_argument("--conf", action="append", default=[], metavar="K=V")
+    p.add_argument("-e", dest="query", default=None,
+                   help="run a single statement and exit")
+    p.add_argument("-f", dest="file", default=None,
+                   help="run statements from a file and exit")
+    args = p.parse_args(argv)
+
+    from ..api.session import TpuSession
+
+    spark = TpuSession("sql-shell", parse_conf(args.conf))
+    try:
+        if args.query is not None:
+            run_statement(spark, args.query)
+            return 0
+        if args.file is not None:
+            with open(args.file) as f:
+                text = f.read()
+            for stmt in [s.strip() for s in text.split(";") if s.strip()]:
+                run_statement(spark, stmt)
+            return 0
+
+        print("sparktpu-sql shell — statements end with ';', "
+              "exit with 'quit;' or Ctrl-D")
+        buf: list[str] = []
+        while True:
+            try:
+                line = input("sql> " if not buf else "   > ")
+            except EOFError:
+                print()
+                break
+            buf.append(line)
+            if line.rstrip().endswith(";"):
+                stmt = "\n".join(buf).rstrip().rstrip(";").strip()
+                buf = []
+                if stmt.lower() in ("quit", "exit"):
+                    break
+                if not stmt:
+                    continue
+                try:
+                    run_statement(spark, stmt)
+                except Exception as e:  # shell survives bad statements
+                    print(f"Error: {e}", file=sys.stderr)
+        return 0
+    finally:
+        spark.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

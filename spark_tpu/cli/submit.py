@@ -1,0 +1,93 @@
+"""Application launcher.
+
+Role of the reference's SparkSubmit (core/deploy/SparkSubmit.scala:1096 main
+→ runMain → user main()) and the launcher process API (launcher/): parses
+--conf/--name/--master style arguments, builds the session configuration,
+exposes it to the app via environment, and runs the user script in-process
+with a prepared `spark` session available through
+`spark_tpu.cli.submit.get_session()` (or the app builds its own — the conf
+is inherited via SPARKTPU_CONF_JSON, the SparkSubmitArguments precedence
+model: CLI > conf file > defaults).
+
+Usage: python -m spark_tpu.cli.submit [options] <app.py> [app args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import runpy
+import sys
+
+_SESSION = None
+
+
+def get_session():
+    """The session prepared by the launcher (lazily created so plain
+    `python app.py` also works)."""
+    global _SESSION
+    if _SESSION is None:
+        from ..api.session import TpuSession
+
+        conf = json.loads(os.environ.get("SPARKTPU_CONF_JSON", "{}"))
+        _SESSION = TpuSession(os.environ.get("SPARKTPU_APP_NAME", "app"),
+                              conf)
+    return _SESSION
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sparktpu-submit",
+        description="Run an application against the TPU engine")
+    p.add_argument("--name", default="app", help="application name")
+    p.add_argument("--conf", action="append", default=[],
+                   metavar="K=V", help="session config entry (repeatable)")
+    p.add_argument("--properties-file", default=None,
+                   help="newline-delimited k=v defaults (lowest precedence)")
+    p.add_argument("--master", default="local",
+                   help="local | local-cluster[N] (process workers)")
+    p.add_argument("app", help="python application file")
+    p.add_argument("app_args", nargs=argparse.REMAINDER,
+                   help="arguments passed to the application")
+    return p
+
+
+def parse_conf(pairs: list[str]) -> dict:
+    out = {}
+    for kv in pairs:
+        if "=" not in kv:
+            raise SystemExit(f"--conf expects K=V, got {kv!r}")
+        k, v = kv.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    conf: dict = {}
+    if args.properties_file:
+        with open(args.properties_file) as f:
+            for line in f:
+                line = line.strip()
+                if line and not line.startswith("#") and "=" in line:
+                    k, v = line.split("=", 1)
+                    conf[k.strip()] = v.strip()
+    conf.update(parse_conf(args.conf))
+    if args.master.startswith("local-cluster"):
+        conf.setdefault("spark.tpu.cluster.enabled", "true")
+        inner = args.master[len("local-cluster"):].strip("[]")
+        if inner:
+            conf.setdefault("spark.tpu.cluster.workers", inner.split(",")[0])
+
+    os.environ["SPARKTPU_CONF_JSON"] = json.dumps(conf)
+    os.environ["SPARKTPU_APP_NAME"] = args.name
+    sys.argv = [args.app] + list(args.app_args)
+    runpy.run_path(args.app, run_name="__main__")
+    if _SESSION is not None:
+        _SESSION.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

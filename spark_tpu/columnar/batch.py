@@ -32,6 +32,7 @@ from ..types import (
     BooleanType,
     DataType,
     DecimalType,
+    NullType,
     StringType,
     StructField,
     StructType,
@@ -372,7 +373,9 @@ class ColumnarBatch:
         for f, c in zip(self.schema.fields, self.columns):
             vals = c.to_numpy(sel)
             at = to_arrow_type(f.dataType)
-            if isinstance(f.dataType, DecimalType):
+            if isinstance(f.dataType, NullType):
+                arrays.append(pa.nulls(len(sel)))
+            elif isinstance(f.dataType, DecimalType):
                 # vals are floats; rebuild exact decimals from scaled ints
                 raw = np.asarray(c.data)[sel]
                 valid = (np.asarray(c.validity)[sel]

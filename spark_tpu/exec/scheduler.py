@@ -105,6 +105,50 @@ class _StageOutput(PhysicalPlan):
         return f"StageOutput(#{self.stage.stage_id})"
 
 
+def _stage_leaves(root: PhysicalPlan) -> list["_StageOutput"]:
+    return [n for n in root.iter_nodes() if isinstance(n, _StageOutput)]
+
+
+def _reachable_stages(result_stage: Stage) -> list[Stage]:
+    """Stages transitively referenced from the result stage via
+    _StageOutput leaves (replanning can orphan stages; orphans never run)."""
+    seen: dict[int, Stage] = {}
+    work = [result_stage]
+    while work:
+        st = work.pop()
+        if st.stage_id in seen:
+            continue
+        seen[st.stage_id] = st
+        for leaf in _stage_leaves(st.root):
+            work.append(leaf.stage)
+    return list(seen.values())
+
+
+def _build_side_stage_ids(stages: list[Stage], done: set[int]) -> set[int]:
+    """Stage ids feeding the build (right) side of a not-yet-broadcast
+    hash join — materializing those first gives AQE demotion its shot."""
+    from ..physical.operators import HashJoinExec
+
+    build: list[Stage] = []
+    for st in stages:
+        if st.stage_id in done:
+            continue
+        for n in st.root.iter_nodes():
+            if isinstance(n, HashJoinExec) and not n.is_broadcast and \
+                    isinstance(n.right, _StageOutput):
+                build.append(n.right.stage)
+    # close over ancestors: the whole build-side chain runs before any
+    # probe-side shuffle
+    out: set[int] = set()
+    while build:
+        st = build.pop()
+        if st.stage_id in out:
+            continue
+        out.add(st.stage_id)
+        build.extend(leaf.stage for leaf in _stage_leaves(st.root))
+    return out
+
+
 class DAGScheduler:
     """Runs a stage graph with per-stage retry (stage = unit of recovery;
     deterministic re-execution replays the subtree, the lineage property
@@ -120,11 +164,7 @@ class DAGScheduler:
         result_stage, stages = build_stage_graph(plan)
         done: set[int] = set()
 
-        def submit(stage: Stage) -> None:
-            if stage.stage_id in done:
-                return
-            for p in stage.parents:
-                submit(p)
+        def run_stage(stage: Stage) -> None:
             last_err: Exception | None = None
             for attempt in range(self.max_attempts):
                 stage.attempts = attempt + 1
@@ -147,7 +187,32 @@ class DAGScheduler:
                     self._post("stageFailed", stage, error=str(e))
             raise last_err  # noqa: B904
 
-        submit(result_stage)
+        from ..physical.adaptive import aqe_replanning_enabled, replan_stages
+
+        adaptive = aqe_replanning_enabled(self.ctx)
+
+        # iterative ready-set loop (AdaptiveSparkPlanExec.scala:301 role):
+        # materialize one ready stage at a time, re-plan the remainder with
+        # observed sizes after each completion; stages the re-plan inlined
+        # or replaced drop out of the reachable set and never run
+        while result_stage.stage_id not in done:
+            needed = _reachable_stages(result_stage)
+            ready = [st for st in needed
+                     if st.stage_id not in done
+                     and all(leaf.stage.stage_id in done
+                             for leaf in _stage_leaves(st.root))]
+            if not ready:
+                raise RuntimeError("stage graph stalled (cycle?)")
+            # potential broadcast build sides first so a small side can
+            # demote the join before the probe shuffle runs
+            if adaptive:
+                build_ids = _build_side_stage_ids(needed, done)
+                ready.sort(key=lambda s: (s.stage_id not in build_ids,
+                                          s.stage_id))
+            st = ready[0]
+            run_stage(st)
+            if adaptive and st is not result_stage:
+                replan_stages(needed, done, self.ctx)
         return result_stage.result
 
     def _post(self, kind: str, stage: Stage, dur=None, error=None):

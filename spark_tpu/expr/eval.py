@@ -75,15 +75,20 @@ class EvalCtx:
     is_trace: bool = False
 
     def __init__(self) -> None:
-        self._memo: dict[int, Val] = {}
+        # entries hold a strong ref to the keyed expression: id() values
+        # recycle after GC, and eval() builds transient nodes (Coalesce →
+        # CaseWhen, cast_if → Cast) whose addresses would otherwise alias a
+        # dead node's memo entry and return its stale Val
+        self._memo: dict[int, tuple[Any, Val]] = {}
 
     # --- recursion --------------------------------------------------------
     def eval(self, expr) -> Val:
         key = id(expr)
-        v = self._memo.get(key)
-        if v is None:
-            v = expr.eval(self)
-            self._memo[key] = v
+        hit = self._memo.get(key)
+        if hit is not None and hit[0] is expr:
+            return hit[1]
+        v = expr.eval(self)
+        self._memo[key] = (expr, v)
         return v
 
     # --- aux channel ------------------------------------------------------

@@ -1817,6 +1817,9 @@ class Concat(Expression):
         return string
 
     def eval(self, ctx):
+        # SQL concat is null-intolerant: any NULL argument nulls the result
+        if any(isinstance(a, Literal) and a.value is None for a in self.args):
+            return Literal(None, string).eval(ctx)
         col_idx = [i for i, a in enumerate(self.args) if not isinstance(a, Literal)]
         if len(col_idx) == 0:
             s = "".join(str(a.value) for a in self.args)
@@ -2216,6 +2219,28 @@ class _ArrayLut(Expression):
     def eval(self, ctx):
         c = ctx.eval(self.child)
         jnp = _jnp()
+
+        def has_lut():
+            sd = c.sdict or StringDict([[]])
+            return np.array([self.value_of(v)[1]
+                             for v in (sd.values or [[]])], bool)
+
+        if isinstance(self.dtype, StringType):
+            # string-valued result (e.g. array_max of a string array):
+            # dictionary transform — per-entry result string, codes pass
+            # through; validity folds in per-entry emptiness
+            if not ctx.is_trace:
+                sd = c.sdict or StringDict([[]])
+                out = StringDict([self.value_of(v)[0] if self.value_of(v)[1]
+                                  else "" for v in (sd.values or [[]])])
+                ctx.aux(has_lut)
+                return Val(string, None, True, out)
+            hl = ctx.aux(None)
+            codes = jnp.clip(c.data, 0, hl.shape[0] - 1)
+            has = jnp.take(hl, codes)
+            validity = has if c.validity is None else (c.validity & has)
+            return Val(string, c.data, validity, None)
+
         dd = self.dtype.device_dtype
 
         def vals_lut():
@@ -2226,11 +2251,6 @@ class _ArrayLut(Expression):
                 val, ok = self.value_of(v)
                 out[i] = val if ok else 0
             return out
-
-        def has_lut():
-            sd = c.sdict or StringDict([[]])
-            return np.array([self.value_of(v)[1]
-                             for v in (sd.values or [[]])], bool)
 
         if not ctx.is_trace:
             ctx.aux(vals_lut)

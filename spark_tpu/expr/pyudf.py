@@ -12,10 +12,17 @@ result re-enters HBM as a new column.
 
 from __future__ import annotations
 
+import itertools
 from typing import Callable, Sequence
 
 from ..types import DataType
 from .expressions import Expression
+
+_udf_uid_counter = itertools.count(1)
+
+
+def _next_udf_uid() -> int:
+    return next(_udf_uid_counter)
 
 
 class PythonUDF(Expression):
@@ -39,7 +46,21 @@ class PythonUDF(Expression):
         return True
 
     def _data_args(self):
-        return (("fn", id(self.fn)), ("name", self.fname))
+        # a process-unique serial, NOT id(fn): the kernel cache outlives the
+        # plan, and a dead function's recycled address must not resurrect a
+        # stale compiled UDF
+        uid = getattr(self.fn, "_sparktpu_uid", None)
+        if uid is None:
+            uid = getattr(self, "_fallback_uid", None)
+        if uid is None:
+            uid = _next_udf_uid()
+            try:
+                self.fn._sparktpu_uid = uid
+            except (AttributeError, TypeError):
+                # unsettable callable (builtin/method): pin the uid on the
+                # EXPRESSION so repeated _data_args() calls stay equal
+                object.__setattr__(self, "_fallback_uid", uid)
+        return (("fn", uid), ("name", self.fname))
 
     def eval(self, ctx):
         from ..errors import ExecutionError

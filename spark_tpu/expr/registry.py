@@ -86,7 +86,10 @@ def _reg_all() -> None:
     r("sqrt", lambda c: E.Sqrt(c))
     r("exp", lambda c: E.Exp(c))
     r("ln", lambda c: E.Log(c))
-    r("log", lambda c: E.Log(c))
+    # log(x) = ln(x); log(base, x) = ln(x) / ln(base)
+    r("log", lambda a, b=None: E.Log(a) if b is None
+      else E.Divide(E.Log(b), E.Log(a)))
+    r("pmod", lambda a, b: E.Remainder(E.Add(E.Remainder(a, b), b), b))
     r("log10", lambda c: E.Log10(c))
     r("floor", lambda c: E.Floor(c))
     r("ceil", lambda c: E.Ceil(c))

@@ -99,11 +99,15 @@ class UnresolvedWindowExpression(Expression):
     def __init__(self, function: Expression,
                  partition_spec: Sequence[Expression],
                  order_spec: Sequence["SortOrder"],
-                 frame: tuple | None = None):
+                 frame: tuple | None = None,
+                 ref_name: str | None = None):
         self.function = function
         self.partition_spec = list(partition_spec)
         self.order_spec = list(order_spec)
         self.frame = frame
+        # `fn() OVER w` — spec filled in from the query's WINDOW clause by
+        # the parser before analysis
+        self.ref_name = ref_name
 
     @property
     def resolved(self):

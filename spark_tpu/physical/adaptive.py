@@ -53,6 +53,98 @@ def apply_merge_groups(parts: list, groups: list[list[int]]) -> list:
     return [[b for i in g for b in parts[i]] for g in groups]
 
 
+def aqe_replanning_enabled(ctx: ExecContext) -> bool:
+    return bool(ctx.conf.get(ADAPTIVE_ENABLED))
+
+
+def replan_stages(stages, done: set, ctx: ExecContext) -> None:
+    """Re-optimize not-yet-run stages with observed parent-stage sizes
+    (role of AdaptiveSparkPlanExec.reOptimize, sqlx/adaptive/
+    AdaptiveSparkPlanExec.scala:301): a shuffled hash join whose
+    materialized build side is under the broadcast threshold demotes to a
+    broadcast join; if the probe-side shuffle hasn't run yet it is elided
+    (its pre-shuffle subtree inlines into the consumer — the reference's
+    local-shuffle-read + SMJ→BHJ demotion rolled into one)."""
+    from ..config import AUTO_BROADCAST_THRESHOLD
+    from ..exec.scheduler import _StageOutput
+    from .exchange import BroadcastExchangeExec, ShuffleExchangeExec
+    from .operators import HashJoinExec
+
+    threshold = int(ctx.conf.get(AUTO_BROADCAST_THRESHOLD))
+    if threshold < 0:
+        return
+
+    from .planner import Planner
+
+    broadcastable = Planner._BROADCAST_RIGHT_TYPES
+
+    def _elide_safe(root, join) -> bool:
+        """The probe shuffle may be skipped only if no operator between
+        the stage root and the join relies on the join's output
+        partitioning (role of the reference's ValidateRequirements after
+        re-optimization): an ancestor whose required distribution the
+        planner satisfied WITHOUT inserting an exchange would silently
+        merge wrong after the elision."""
+        from .partitioning import UnspecifiedDistribution
+
+        def walk(node) -> bool | None:
+            # returns True if join found below and path is safe, None if
+            # join not in this subtree
+            if node is join:
+                return True
+            for i, c in enumerate(node.children):
+                sub = walk(c)
+                if sub is None:
+                    continue
+                if not sub:
+                    return False
+                reqs = node.required_child_distribution()
+                req = reqs[i] if i < len(reqs) else None
+                if req is not None and \
+                        not isinstance(req, UnspecifiedDistribution):
+                    return False
+                return True
+            return None
+
+        return walk(root) is True
+
+    for st in stages:
+        if st.stage_id in done:
+            continue
+
+        def rw(node, _root=st.root):
+            if not (isinstance(node, HashJoinExec)
+                    and not node.is_broadcast):
+                return node
+            if node.join_type not in broadcastable:
+                return node
+            r = node.right
+            if not (isinstance(r, _StageOutput)
+                    and r.stage.stage_id in done
+                    and r.stage.result is not None):
+                return node
+            rows = sum(b.num_rows() for p in r.stage.result for b in p)
+            if rows * _row_width(r.output) > threshold:
+                return node
+            new_right = BroadcastExchangeExec(r)
+            new_left = node.left
+            if isinstance(new_left, _StageOutput) \
+                    and new_left.stage.stage_id not in done \
+                    and isinstance(new_left.stage.root,
+                                   ShuffleExchangeExec) \
+                    and _elide_safe(_root, node):
+                # probe-side shuffle not run and no longer required
+                new_left = new_left.stage.root.child
+                ctx.metrics.add("aqe.probe_shuffles_elided")
+            ctx.metrics.add("aqe.broadcast_demotions")
+            return node.copy(left=new_left, right=new_right,
+                             is_broadcast=True)
+
+        new_root = st.root.transform_up(rw)
+        if new_root is not st.root:
+            st.root = new_root
+
+
 def _effective_child(plan_child):
     """See through scheduler stage boundaries (exec/scheduler.py
     _StageOutput) to the exchange that produced the partitions."""

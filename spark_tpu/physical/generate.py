@@ -42,32 +42,53 @@ class GenerateExec(PhysicalPlan):
         return self.child.output + [self.element_attr]
 
     def execute(self, ctx: ExecContext):
+        from ..expr.expressions import Literal
+
         src = self.generator.child if isinstance(self.generator, Split) \
             else self.generator
-        if not isinstance(src, AttributeReference):
+        if isinstance(src, Literal):
+            # explode over a constant: every input row expands by the same
+            # literal list (code 0 into a one-entry dictionary)
+            cidx = None
+        elif isinstance(src, AttributeReference):
+            pos = {a.expr_id: i for i, a in enumerate(self.child.output)}
+            cidx = pos[src.expr_id]
+        else:
             raise UnsupportedOperationError(
-                "split() argument must be a column")
-        pos = {a.expr_id: i for i, a in enumerate(self.child.output)}
-        cidx = pos[src.expr_id]
+                "split() argument must be a column or literal")
         out_schema = attrs_schema(self.output)
         parts = self.child.execute(ctx)
         return [[self._expand(b, cidx, out_schema)
                  for b in p] for p in parts]
 
-    def _expand(self, batch: ColumnarBatch, cidx: int,
+    def _expand(self, batch: ColumnarBatch, cidx: int | None,
                 out_schema) -> ColumnarBatch:
         import jax.numpy as jnp
         import pyarrow as pa
 
-        col = batch.columns[cidx]
-        values = col.dictionary.values if col.dictionary else []
-        if isinstance(self.generator, Split):
-            if not isinstance(col.dtype, StringType):
-                raise UnsupportedOperationError(
-                    "split() needs a string column")
-            lists = self.generator.split_lists(values or [""])
-        else:  # array column: the dictionary values ARE the lists
-            lists = [list(v) for v in values] or [[]]
+        from ..expr.expressions import Literal
+
+        if cidx is None:
+            src = self.generator.child \
+                if isinstance(self.generator, Split) else self.generator
+            assert isinstance(src, Literal)
+            if src.value is None:
+                lists = [[]]  # split(NULL) is NULL; explode(NULL) emits none
+            elif isinstance(self.generator, Split):
+                lists = self.generator.split_lists([str(src.value)])
+            else:
+                lists = [list(src.value)]
+            col = None
+        else:
+            col = batch.columns[cidx]
+            values = col.dictionary.values if col.dictionary else []
+            if isinstance(self.generator, Split):
+                if not isinstance(col.dtype, StringType):
+                    raise UnsupportedOperationError(
+                        "split() needs a string column")
+                lists = self.generator.split_lists(values or [""])
+            else:  # array column: the dictionary values ARE the lists
+                lists = [list(v) for v in values] or [[]]
         counts_per_code = np.array([len(x) for x in lists], np.int64)
         offsets_per_code = np.zeros(len(lists) + 1, np.int64)
         np.cumsum(counts_per_code, out=offsets_per_code[1:])
@@ -75,9 +96,12 @@ class GenerateExec(PhysicalPlan):
             [e for lst in lists for e in lst], dtype=object)
 
         sel = np.nonzero(np.asarray(batch.row_mask))[0]
-        codes = np.clip(np.asarray(col.data)[sel], 0, len(lists) - 1)
+        if col is None:
+            codes = np.zeros(len(sel), np.int64)
+        else:
+            codes = np.clip(np.asarray(col.data)[sel], 0, len(lists) - 1)
         row_counts = counts_per_code[codes]
-        if col.validity is not None:
+        if col is not None and col.validity is not None:
             row_counts = np.where(np.asarray(col.validity)[sel],
                                   row_counts, 0)
         total = int(row_counts.sum())

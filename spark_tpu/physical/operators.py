@@ -171,9 +171,16 @@ class LocalTableScanExec(PhysicalPlan):
             _LOCAL_TABLE_CACHE = {}
 
         # pa.Table is unhashable: key by id with a weakref finalizer so the
-        # device batches die with the table
+        # device batches die with the table. id() values recycle after GC
+        # (and weakref callbacks can be skipped when the referent dies in a
+        # collected cycle), so a hit must prove the entry still belongs to
+        # THIS table — a stale entry here once served another test's batches.
         tid = id(self.table)
         entry = _LOCAL_TABLE_CACHE.get(tid)
+        if entry is not None:
+            ref = entry.get("ref")
+            if ref is None or ref() is not self.table:
+                entry = None
         if entry is None:
             try:
                 ref = weakref.ref(self.table,

@@ -506,7 +506,17 @@ class Planner:
             return ComputeExec([], want, plan)
         return plan
 
+    # join types where a replicated RIGHT build side is sound: every probe
+    # partition may see the full build relation. full_outer is NOT here —
+    # unmatched build rows would be emitted once per probe partition
+    # (reference: JoinSelection canBroadcastBySize + canBuildBroadcastRight).
+    # AQE demotion (physical/adaptive.py replan_stages) reuses this set.
+    _BROADCAST_RIGHT_TYPES = frozenset(
+        ("inner", "cross", "left_outer", "left_semi", "left_anti"))
+
     def _can_broadcast(self, right_logical: L.LogicalPlan, jt: str) -> bool:
+        if jt not in self._BROADCAST_RIGHT_TYPES:
+            return False
         rows = right_logical.stats_rows()
         if rows is None:
             return False

@@ -138,6 +138,10 @@ class WindowExec(PhysicalPlan):
         for kind, param, arg in plans:
             if arg is not None:
                 vcols.append(batch.columns[pos[arg.expr_id]])
+            elif kind.endswith("_count"):
+                # count(*) over a window: count frame rows — an all-valid
+                # ones column makes the count kernels row-counting
+                vcols.append("ones")
             else:
                 vcols.append(None)
 
@@ -180,7 +184,8 @@ class WindowExec(PhysicalPlan):
                tuple((str(c.sort_keys().dtype), c.validity is not None,
                       s.ascending, s.nulls_first)
                      for c, s in zip(ocols, ospecs)),
-               tuple((k, p, None if v is None else
+               tuple((k, p, "ones" if isinstance(v, str) else
+                      None if v is None else
                       (str(v.data.dtype), v.validity is not None))
                      for (k, p, _), v in zip(plans, vcols)))
 
@@ -227,12 +232,15 @@ class WindowExec(PhysicalPlan):
             return jax.jit(kernel)
 
         kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build)
+        ones = jnp.ones((cap,), jnp.int32)
         outs = kernel([c.eq_keys() for c in pcols],
                       [c.validity for c in pcols],
                       [c.sort_keys() for c in ocols],
                       [c.validity for c in ocols],
-                      [None if v is None else v.data for v in vcols],
-                      [None if v is None else v.validity for v in vcols],
+                      [ones if isinstance(v, str) else
+                       None if v is None else v.data for v in vcols],
+                      [None if v is None or isinstance(v, str)
+                       else v.validity for v in vcols],
                       batch.row_mask)
 
         schema = attrs_schema(self.output)

@@ -315,6 +315,57 @@ def _pretty_name(e: Expression) -> str:
     return e.simple_string()
 
 
+class ResolveGroupByAlias(Rule):
+    """GROUP BY may reference a SELECT-list alias (reference:
+    sqlcat/analysis/Analyzer ResolveReferences' GROUP BY alias fallback,
+    golden file group-by-alias.sql): a grouping expression that stays
+    unresolved against the child's columns resolves to the aliased
+    select expression, provided that expression is not itself an
+    aggregate."""
+
+    def __init__(self, case_sensitive: bool = False):
+        self.cs = case_sensitive
+
+    def apply(self, plan):
+        from .logical import GroupingSets
+
+        def rule(node):
+            if not isinstance(node, (Aggregate, GroupingSets)):
+                return node
+            if all(g.resolved for g in node.grouping_exprs):
+                return node
+            aliases = {}
+            for e in node.aggregate_exprs:
+                if isinstance(e, Alias) and e.child.resolved and \
+                        not _contains_agg(e.child):
+                    key = e.name if self.cs else e.name.lower()
+                    aliases.setdefault(key, e.child)
+
+            def fix(g):
+                if isinstance(g, UnresolvedAttribute) and \
+                        len(g.name_parts) == 1:
+                    key = g.name_parts[0] if self.cs \
+                        else g.name_parts[0].lower()
+                    sub = aliases.get(key)
+                    if sub is not None:
+                        return sub
+                return g
+
+            new_groups = [fix(g) for g in node.grouping_exprs]
+            if all(a is b for a, b in zip(new_groups, node.grouping_exprs)):
+                return node
+            return node.copy(grouping_exprs=new_groups)
+
+        return plan.transform_up(rule)
+
+
+def _contains_agg(e: Expression) -> bool:
+    if isinstance(e, AggregateFunction):
+        return True
+    return any(_contains_agg(c) for c in e.children
+               if isinstance(c, Expression))
+
+
 class ResolveAggsInSortHaving(Rule):
     """Resolve HAVING filters and ORDER BY over an Aggregate: references to
     aggregate results resolve to output attrs; bare aggregate functions get
@@ -754,7 +805,7 @@ class WidenSetOperationTypes(Rule):
                 targets.append(t)
             changed = False
             new_children = []
-            for c, o in zip(children, outs):
+            for ci, (c, o) in enumerate(zip(children, outs)):
                 if all(a.dtype == t for a, t in zip(o, targets)):
                     new_children.append(c)
                     continue
@@ -763,7 +814,12 @@ class WidenSetOperationTypes(Rule):
                     if a.dtype == t:
                         projs.append(a)
                     else:
-                        projs.append(Alias(cast_if(a, t), a.name))
+                        # the FIRST branch defines the set-op's output ids:
+                        # keep them so references above (ORDER BY v) stay
+                        # bound across the widening rewrite
+                        keep = a.expr_id if ci == 0 else None
+                        projs.append(Alias(cast_if(a, t), a.name,
+                                           expr_id=keep))
                 new_children.append(Project(projs, c))
                 changed = True
             return new_children if changed else None
@@ -886,6 +942,7 @@ class Analyzer(RuleExecutor):
                 ResolveRelations(self.catalog),
                 DeduplicateRelations(),
                 ResolveReferences(cs),
+                ResolveGroupByAlias(cs),
                 ResolveSubqueries(self),
                 ResolveAggsInSortHaving(cs),
                 ResolveSortHiddenRefs(cs),
@@ -914,6 +971,7 @@ class Analyzer(RuleExecutor):
             _ResolveRelationsDedup(self.catalog, outer_ids),
             DeduplicateRelations(),
             ResolveReferences(cs),
+            ResolveGroupByAlias(cs),
             ResolveSubqueries(self),
             ResolveAggsInSortHaving(cs),
             ResolveSortHiddenRefs(cs),

@@ -37,7 +37,7 @@ class Token:
         return f"{self.kind}:{self.value}"
 
 
-_TWO_CHAR_OPS = ("<=", ">=", "<>", "!=", "==", "||", "<=>")
+_TWO_CHAR_OPS = ("<=>", "<<", ">>", "<=", ">=", "<>", "!=", "==", "||")
 
 
 def tokenize(text: str) -> list[Token]:
@@ -58,6 +58,14 @@ def tokenize(text: str) -> list[Token]:
             i = n if j < 0 else j + 2
             continue
         start = i
+        if c == "0" and i + 1 < n and text[i + 1] in "xX" \
+                and i + 2 < n and (text[i + 2].isdigit()
+                                   or text[i + 2] in "abcdefABCDEF"):
+            i += 2
+            while i < n and (text[i].isdigit() or text[i] in "abcdefABCDEF"):
+                i += 1
+            toks.append(Token("num", text[start:i], start))
+            continue
         if c.isdigit() or (c == "." and i + 1 < n and text[i + 1].isdigit()):
             i += 1
             isfloat = c == "."
@@ -120,7 +128,7 @@ def tokenize(text: str) -> list[Token]:
                 i += len(op)
                 break
         else:
-            if c in "+-*/%(),.=<>!|&^[]:;":
+            if c in "+-*/%(),.=<>!|&^~[]:;":
                 toks.append(Token("op", c, start))
                 i += 1
             else:

@@ -434,6 +434,33 @@ class Parser:
         if self.eat_kw("having"):
             having = self.parse_expr()
 
+        # WINDOW w AS (spec) [, w2 AS (spec)]* — substitute named specs
+        # into `fn() OVER w` placeholders (reference: namedWindow in
+        # SqlBaseParser.g4 + Analyzer WindowsSubstitution)
+        if self.peek().kind == "ident" and \
+                self.peek().value.lower() == "window":
+            self.next()
+            specs: dict[str, tuple] = {}
+            while True:
+                wname = self.ident().lower()
+                self.expect_kw("as")
+                specs[wname] = self._parse_window_spec()
+                if not self.eat_op(","):
+                    break
+            from ..expr.window import UnresolvedWindowExpression as _UW
+
+            def _sub(e):
+                if isinstance(e, _UW) and e.ref_name is not None:
+                    spec = specs.get(e.ref_name.lower())
+                    if spec is None:
+                        raise ParseException(
+                            f"undefined window: {e.ref_name}")
+                    p, o, fr = spec
+                    return _UW(e.function, p, o, fr)
+                return e
+
+            select_list = [e.transform_up(_sub) for e in select_list]
+
         has_agg = any(_contains_agg(e) for e in select_list)
         if group_exprs is not None or has_agg or having is not None:
             groups = group_exprs or []
@@ -591,11 +618,15 @@ class Parser:
             return L.SubqueryAlias(alias, plan)
         return plan
 
+    # soft keywords that begin a clause and therefore can't be a bare
+    # relation alias (WINDOW w AS ..., LATERAL VIEW, PIVOT ...)
+    _NON_ALIAS_IDENTS = frozenset(("window", "lateral", "pivot", "unpivot"))
+
     def _maybe_alias(self) -> str | None:
         if self.eat_kw("as"):
             return self.ident()
         t = self.peek()
-        if t.kind == "ident":
+        if t.kind == "ident" and t.value.lower() not in self._NON_ALIAS_IDENTS:
             self.next()
             return t.value
         return None
@@ -642,11 +673,11 @@ class Parser:
         return self.parse_predicate()
 
     def parse_predicate(self) -> E.Expression:
-        left = self.parse_additive()
+        left = self.parse_bitwise_or()
         while True:
             if self.at_op("=", "==", "<>", "!=", "<", "<=", ">", ">=", "<=>"):
                 op = self.next().value
-                right = self.parse_additive()
+                right = self.parse_bitwise_or()
                 cls = {"=": E.EqualTo, "==": E.EqualTo, "<>": E.NotEqualTo,
                        "!=": E.NotEqualTo, "<": E.LessThan,
                        "<=": E.LessThanOrEqual, ">": E.GreaterThan,
@@ -710,6 +741,36 @@ class Parser:
             break
         return left
 
+    def parse_bitwise_or(self) -> E.Expression:
+        left = self.parse_bitwise_xor()
+        while self.at_op("|"):
+            self.next()
+            left = E.BitwiseOr(left, self.parse_bitwise_xor())
+        return left
+
+    def parse_bitwise_xor(self) -> E.Expression:
+        left = self.parse_bitwise_and()
+        while self.at_op("^"):
+            self.next()
+            left = E.BitwiseXor(left, self.parse_bitwise_and())
+        return left
+
+    def parse_bitwise_and(self) -> E.Expression:
+        left = self.parse_shift()
+        while self.at_op("&"):
+            self.next()
+            left = E.BitwiseAnd(left, self.parse_shift())
+        return left
+
+    def parse_shift(self) -> E.Expression:
+        left = self.parse_additive()
+        while self.at_op("<<", ">>"):
+            op = self.next().value
+            right = self.parse_additive()
+            left = E.ShiftLeft(left, right) if op == "<<" \
+                else E.ShiftRight(left, right)
+        return left
+
     def parse_additive(self) -> E.Expression:
         left = self.parse_multiplicative()
         while self.at_op("+", "-") or self.at_op("||"):
@@ -744,6 +805,8 @@ class Parser:
             return E.UnaryMinus(e)
         if self.eat_op("+"):
             return self.parse_unary()
+        if self.eat_op("~"):
+            return E.BitwiseNot(self.parse_unary())
         return self.parse_primary()
 
     def parse_primary(self) -> E.Expression:
@@ -831,9 +894,30 @@ class Parser:
         elif not self.at_op(")"):
             if self.eat_kw("distinct"):
                 distinct = True
-            args.append(self.parse_expr())
-            while self.eat_op(","):
+            if name.lower() == "position":
+                # position(substr IN str) — parse below predicate level so
+                # the IN is ours, not an IN-list; order matches position(s, c)
+                args.append(self.parse_bitwise_or())
+                if self.eat_kw("in"):
+                    args.append(self.parse_expr())
+                while self.eat_op(","):
+                    args.append(self.parse_expr())
+            else:
                 args.append(self.parse_expr())
+                if (name.lower() == "overlay"
+                        and self.peek().value.lower() == "placing"):
+                    # overlay(str PLACING repl FROM pos [FOR len]) — argument
+                    # order matches overlay(str, repl, pos[, len])
+                    self.next()
+                    args.append(self.parse_expr())
+                    self.expect_kw("from")
+                    args.append(self.parse_expr())
+                    if self.peek().value.lower() == "for":
+                        self.next()
+                        args.append(self.parse_expr())
+                else:
+                    while self.eat_op(","):
+                        args.append(self.parse_expr())
         self.expect_op(")")
         if self.at_kw("over"):
             return self.parse_over(E.UnresolvedFunction(name, args, distinct))
@@ -843,6 +927,18 @@ class Parser:
         from ..expr.window import WindowExpression
 
         self.expect_kw("over")
+        if not self.at_op("("):
+            from ..expr.window import UnresolvedWindowExpression
+
+            # OVER w — named window, spec substituted from the WINDOW clause
+            return UnresolvedWindowExpression(func, [], [], None,
+                                              ref_name=self.ident())
+        partition, orders, frame = self._parse_window_spec()
+        from ..expr.window import UnresolvedWindowExpression
+
+        return UnresolvedWindowExpression(func, partition, orders, frame)
+
+    def _parse_window_spec(self):
         self.expect_op("(")
         partition: list[E.Expression] = []
         orders: list[E.SortOrder] = []
@@ -876,9 +972,7 @@ class Parser:
             else:
                 frame = ("rows", lo, hi)
         self.expect_op(")")
-        from ..expr.window import UnresolvedWindowExpression
-
-        return UnresolvedWindowExpression(func, partition, orders, frame)
+        return partition, orders, frame
 
     def _parse_frame_bound(self, is_lower: bool):
         """Returns a row offset: None = unbounded, 0 = current row,
@@ -1016,6 +1110,10 @@ class Parser:
 
 
 def _num_literal(text: str) -> E.Literal:
+    if text[:2].lower() == "0x":
+        v = int(text, 16)
+        return E.Literal(v) if -(2 ** 31) <= v < 2 ** 31 \
+            else E.Literal(v, int64)
     suffix = ""
     if text and text[-1] in "LlDdSs":
         suffix = text[-1].lower()

@@ -1,0 +1,2 @@
+SELECT sum(CASE WHEN i_category = 'Books' THEN 1 ELSE 0 END) AS books, sum(CASE WHEN i_category = 'Music' THEN 1 ELSE 0 END) AS music FROM item;
+SELECT CASE WHEN i_brand_id > 20 THEN NULL ELSE i_brand_id END AS k, count(*) AS n FROM item GROUP BY k ORDER BY k NULLS FIRST LIMIT 5;

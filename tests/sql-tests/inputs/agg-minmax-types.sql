@@ -1,0 +1,2 @@
+SELECT min(i_item_id) mn, max(i_item_id) mx FROM item;
+SELECT min(d_date) mn, max(d_date) mx FROM date_dim;

@@ -1,0 +1,4 @@
+SELECT split('a,b,c', ',') AS arr, size(split('a,b', ',')) AS sz, cardinality(split('a', ',')) AS card;
+SELECT array_contains(split('a,b,c', ','), 'b') AS c1, array_contains(split('a,b', ','), 'z') AS c2;
+SELECT sort_array(split('c,a,b', ',')) AS sa, array_distinct(split('a,b,a', ',')) AS ad;
+SELECT array_max(split('3,1,2', ',')) AS mx, array_min(split('3,1,2', ',')) AS mn, element_at(split('a,b,c', ','), 2) AS el;

@@ -1,0 +1,1 @@
+SELECT 3 & 5 a, 3 | 5 o, 3 ^ 5 x, ~3 n, shiftleft(1, 4) sl, shiftright(16, 2) sr;

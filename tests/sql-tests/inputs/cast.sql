@@ -1,0 +1,3 @@
+SELECT cast(3.9 AS int) AS a, cast('42' AS bigint) AS b, cast(1 AS double) AS c, cast('3.14' AS double) AS d;
+SELECT cast('abc' AS int) AS bad_int, cast(NULL AS string) AS ns, cast(true AS int) AS bi, cast(0 AS boolean) AS ib;
+SELECT cast(123.456 AS string) AS s1, cast(DATE '2020-02-29' AS string) AS s2;

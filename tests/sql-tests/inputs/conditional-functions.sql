@@ -1,0 +1,3 @@
+SELECT CASE WHEN 1 > 2 THEN 'a' WHEN 2 > 1 THEN 'b' ELSE 'c' END AS c1;
+SELECT CASE 3 WHEN 1 THEN 'one' WHEN 3 THEN 'three' ELSE 'other' END AS c2;
+SELECT if(1 < 2, 'yes', 'no') i, if(1 > 2, 'yes', 'no') i2;

@@ -1,0 +1,3 @@
+SELECT count(*) AS star, count(c_birth_year) AS nonnull, count(DISTINCT c_state) AS ds FROM customer;
+SELECT count(*) FROM customer WHERE 1 = 0;
+SELECT sum(ss_quantity) FROM store_sales WHERE 1 = 0;

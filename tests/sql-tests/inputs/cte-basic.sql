@@ -1,0 +1,3 @@
+WITH top_items AS (SELECT i_item_sk, i_category FROM item WHERE i_current_price > 50) SELECT i_category, count(*) AS n FROM top_items GROUP BY i_category ORDER BY i_category;
+WITH a AS (SELECT c_state, count(*) AS n FROM customer GROUP BY c_state), b AS (SELECT c_state, n FROM a WHERE n > 90) SELECT * FROM b ORDER BY c_state;
+WITH x AS (SELECT 1 AS v), y AS (SELECT v + 1 AS w FROM x) SELECT * FROM y;

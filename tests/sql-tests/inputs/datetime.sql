@@ -1,0 +1,8 @@
+SELECT year(DATE '2020-06-15') y, quarter(DATE '2020-06-15') q, month(DATE '2020-06-15') m, day(DATE '2020-06-15') d;
+SELECT dayofmonth(DATE '2020-06-15') dm, dayofweek(DATE '2020-06-15') dw, dayofyear(DATE '2020-06-15') dy, weekofyear(DATE '2020-06-15') wy;
+SELECT hour(TIMESTAMP '2020-06-15 13:45:30') h, minute(TIMESTAMP '2020-06-15 13:45:30') m, second(TIMESTAMP '2020-06-15 13:45:30') s;
+SELECT date_add(DATE '2020-01-01', 30) da, date_sub(DATE '2020-01-01', 1) ds, datediff(DATE '2020-02-01', DATE '2020-01-01') dd;
+SELECT add_months(DATE '2020-01-31', 1) am, months_between(DATE '2020-03-01', DATE '2020-01-01') mb, last_day(DATE '2020-02-05') ld;
+SELECT make_date(2020, 2, 29) md, to_date('2020-05-17') td, date_trunc('month', TIMESTAMP '2020-06-15 13:45:30') dt;
+SELECT date_format(DATE '2020-06-15', 'yyyy/MM/dd') df, unix_timestamp(TIMESTAMP '1970-01-02 00:00:00') ut, from_unixtime(86400) fu;
+SELECT trunc(DATE '2020-06-15', 'year') ty, trunc(DATE '2020-06-15', 'mm') tm;

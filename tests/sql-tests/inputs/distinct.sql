@@ -1,0 +1,2 @@
+SELECT DISTINCT i_category FROM item ORDER BY i_category;
+SELECT DISTINCT i_category, i_brand_id % 2 AS parity FROM item ORDER BY i_category, parity LIMIT 8;

@@ -1,0 +1,2 @@
+SELECT explode(split('x,y,z', ',')) AS v;
+SELECT i_item_sk, explode(split('a,b', ',')) AS part FROM item WHERE i_item_sk <= 2 ORDER BY i_item_sk, part;

@@ -1,0 +1,2 @@
+SELECT i_category, i_brand_id % 3 AS b, count(*) AS n FROM item GROUP BY ROLLUP(i_category, i_brand_id % 3) ORDER BY i_category NULLS FIRST, b NULLS FIRST;
+SELECT c_state, count(*) AS n, grouping(c_state) AS g FROM customer GROUP BY CUBE(c_state) ORDER BY c_state NULLS LAST;

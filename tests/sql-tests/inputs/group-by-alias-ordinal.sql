@@ -1,0 +1,2 @@
+SELECT i_category AS cat, count(*) AS n FROM item GROUP BY 1 ORDER BY 1;
+SELECT i_category AS cat, count(*) AS n FROM item GROUP BY cat ORDER BY cat;

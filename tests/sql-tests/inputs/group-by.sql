@@ -1,0 +1,6 @@
+SELECT i_category, count(*) AS cnt, sum(i_current_price) AS total FROM item GROUP BY i_category ORDER BY i_category;
+SELECT c_state, count(DISTINCT c_birth_year) AS dy FROM customer GROUP BY c_state ORDER BY c_state;
+SELECT i_category, avg(i_current_price) AS ap, min(i_current_price) AS mn, max(i_current_price) AS mx FROM item GROUP BY i_category ORDER BY i_category;
+SELECT i_brand_id % 5 AS g, count(*) AS n FROM item GROUP BY i_brand_id % 5 ORDER BY g;
+SELECT count(*) AS n, sum(ss_quantity) AS q, avg(ss_sales_price) AS p FROM store_sales;
+SELECT count(DISTINCT ss_store_sk) AS stores FROM store_sales;

@@ -1,0 +1,2 @@
+SELECT i_category, count(*) AS n FROM item GROUP BY i_category HAVING count(*) > 30 ORDER BY i_category;
+SELECT c_state, count(*) AS n FROM customer GROUP BY c_state HAVING n >= 100 ORDER BY c_state;

@@ -1,0 +1,2 @@
+SELECT col1, col2 FROM (VALUES (1, 'a'), (2, 'b'), (3, 'c')) t WHERE col1 > 1 ORDER BY col1;
+SELECT col1 * 2 AS d FROM (VALUES (1), (2)) v ORDER BY d;

@@ -1,0 +1,7 @@
+SELECT count(*) AS n FROM store_sales ss JOIN item i ON ss.ss_item_sk = i.i_item_sk WHERE i.i_category = 'Books';
+SELECT s_state, count(*) AS n FROM store_sales JOIN store ON ss_store_sk = s_store_sk GROUP BY s_state ORDER BY s_state;
+SELECT count(*) AS n FROM item i LEFT JOIN store_sales ss ON i.i_item_sk = ss.ss_item_sk AND ss.ss_quantity > 18 WHERE ss.ss_item_sk IS NULL;
+SELECT c_state, s_state, count(*) AS n FROM store_sales JOIN customer ON ss_customer_sk = c_customer_sk JOIN store ON ss_store_sk = s_store_sk WHERE c_state = 'CA' AND s_state IN ('CA','TX') GROUP BY c_state, s_state ORDER BY s_state;
+SELECT count(*) AS n FROM store CROSS JOIN date_dim WHERE d_year = 1998;
+SELECT count(*) AS n FROM item i RIGHT JOIN store_sales ss ON i.i_item_sk = ss.ss_item_sk;
+SELECT count(*) AS n FROM store s FULL OUTER JOIN customer c ON s.s_state = c.c_state;

@@ -1,0 +1,4 @@
+SELECT count(*) AS n FROM item WHERE i_item_sk IN (SELECT ss_item_sk FROM store_sales WHERE ss_quantity > 18);
+SELECT count(*) AS n FROM item WHERE i_item_sk NOT IN (SELECT ss_item_sk FROM store_sales);
+SELECT count(*) AS n FROM item i WHERE EXISTS (SELECT 1 FROM store_sales WHERE ss_item_sk = i.i_item_sk AND ss_quantity = 19);
+SELECT count(*) AS n FROM item i WHERE NOT EXISTS (SELECT 1 FROM store_sales WHERE ss_item_sk = i.i_item_sk);

@@ -1,0 +1,2 @@
+SELECT i_item_sk FROM item ORDER BY i_item_sk LIMIT 5 OFFSET 10;
+SELECT count(*) AS n FROM (SELECT i_item_sk FROM item LIMIT 50) t;

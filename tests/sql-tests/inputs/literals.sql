@@ -1,0 +1,3 @@
+SELECT 1 AS i, 1L AS l, 1.5 AS d, 'str' AS s, true AS b, NULL AS n;
+SELECT 0x1F AS hexlit, 1e3 AS sci, -2.5E-2 AS negsci, .5 AS leadingdot;
+SELECT DATE '2019-01-01' AS dt, TIMESTAMP '2019-01-01 12:34:56' AS ts;

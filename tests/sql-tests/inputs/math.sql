@@ -1,0 +1,8 @@
+SELECT abs(-3) a, ceil(1.2) c, floor(-1.2) f, round(2.5) r, round(-2.5) r2, round(3.14159, 3) r3;
+SELECT sqrt(16.0) s, cbrt(27.0) cb, exp(0.0) e, ln(1.0) l, log10(100.0) l10, log2(8.0) l2, log(2.0, 8.0) lg;
+SELECT pow(2, 10) p, power(3.0, 2.0) p2, mod(10, 3) m, pmod(-7, 3) pm, 10 % 3 pct;
+SELECT sin(0.0) s, cos(0.0) c, tan(0.0) t, asin(1.0) asn, acos(1.0) acs, atan(1.0) at, atan2(1.0, 1.0) at2;
+SELECT degrees(pi()) dg, radians(180.0) rd, e() ee, sign(-5) sg, signum(3.2) sg2;
+SELECT sinh(0.0) sh, cosh(0.0) ch, tanh(0.0) th, expm1(0.0) em, log1p(0.0) lp;
+SELECT greatest(1, 5, 3) g, least(1, 5, 3) l, greatest(1.0, NULL, 2.0) gn;
+SELECT pi() p, e() e;

@@ -1,0 +1,2 @@
+SELECT i_item_id, sum(i_current_price) OVER w AS s FROM item WINDOW w AS (PARTITION BY i_category ORDER BY i_item_sk) ORDER BY i_item_id LIMIT 5;
+SELECT DISTINCT i_category, count(*) OVER (PARTITION BY i_category) AS n FROM item ORDER BY i_category;

@@ -1,0 +1,3 @@
+SELECT t.cat, t.n FROM (SELECT i_category AS cat, count(*) AS n FROM item GROUP BY i_category) t WHERE t.n > 30 ORDER BY t.cat;
+SELECT outer_t.mx FROM (SELECT max(n) AS mx FROM (SELECT i_category, count(*) AS n FROM item GROUP BY i_category) inner_t) outer_t;
+select i_category, COUNT(*) as N from item group by i_category order by i_category;

@@ -1,0 +1,3 @@
+SELECT coalesce(NULL, NULL, 3) c, ifnull(NULL, 'x') i, nullif(5, 5) nf, nullif(5, 6) nf2, nvl(NULL, 9) nv;
+SELECT isnull(NULL) a, isnotnull(NULL) b, isnan(cast('nan' AS double)) c, isnan(1.0) d;
+SELECT NULL + 1 a, NULL = NULL b, NULL AND false c, NULL OR true d, concat('x', NULL) e;

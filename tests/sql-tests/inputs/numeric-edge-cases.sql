@@ -1,0 +1,5 @@
+SELECT 1 / 0 AS div0, 0.0 / 0.0 AS nan_div, -0.0 AS negzero;
+SELECT cast('inf' AS double) inf, cast('-inf' AS double) ninf, cast('nan' AS double) nan;
+SELECT 9223372036854775807 AS maxlong, -9223372036854775808 AS minlong;
+SELECT round(2.675, 2) AS banker, round(123456.789, -2) AS negscale;
+SELECT cast('true' AS boolean) t, cast('false' AS boolean) f, cast('yes' AS boolean) y, cast(1 AS boolean) one;

@@ -1,0 +1,3 @@
+SELECT 5 + 3 a, 5 - 3 s, 5 * 3 m, 5 / 3 dv, 5 div 3 idv, -5 neg, +5 pos;
+SELECT 1 < 2 lt, 2 <= 2 le, 3 > 2 gt, 3 >= 4 ge, 1 = 1 eq, 1 != 2 ne, 1 <> 2 ne2, NULL <=> NULL nss, 1 <=> NULL ns2;
+SELECT true AND false a, true OR false o, NOT true n, true AND NULL an, false OR NULL onn;

@@ -1,0 +1,3 @@
+SELECT i_item_id FROM item ORDER BY i_current_price DESC, i_item_id LIMIT 5;
+SELECT i_item_id FROM item ORDER BY i_current_price ASC NULLS FIRST LIMIT 3;
+SELECT i_item_id, i_current_price FROM item ORDER BY 2 DESC, 1 LIMIT 3;

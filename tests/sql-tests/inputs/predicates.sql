@@ -1,0 +1,4 @@
+SELECT 'hello' LIKE 'h%' AND 'hello' LIKE '%o' AS both;
+SELECT 3 IN (1, 2, 3) a, 5 IN (1, 2) b, NULL IN (1, 2) n, 1 NOT IN (2, 3) nn;
+SELECT 5 BETWEEN 1 AND 10 a, 0 BETWEEN 1 AND 10 b, 5 NOT BETWEEN 1 AND 10 c;
+SELECT count(*) FROM store_sales WHERE ss_quantity >= 5 AND ss_quantity <= 10 AND ss_sales_price > 50;

@@ -1,0 +1,3 @@
+SELECT 'hello' LIKE 'he%' a, 'hello' LIKE '%lo' b, 'hello' LIKE 'h_llo' c, 'hello' NOT LIKE 'x%' d;
+SELECT 'hello' RLIKE 'h.*o' a, regexp('foo123', '[a-z]+[0-9]+') r;
+SELECT regexp_extract('100-200', '(\\d+)-(\\d+)', 1) e1, regexp_replace('100-200', '(\\d+)', 'num') rr;

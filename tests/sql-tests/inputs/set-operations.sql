@@ -1,0 +1,5 @@
+SELECT c_state AS st FROM customer UNION SELECT s_state FROM store ORDER BY st;
+SELECT c_state AS st FROM customer UNION ALL SELECT s_state FROM store ORDER BY st LIMIT 5;
+SELECT c_state AS st FROM customer EXCEPT SELECT s_state FROM store ORDER BY st;
+SELECT c_state AS st FROM customer INTERSECT SELECT s_state FROM store ORDER BY st;
+SELECT c_state AS st FROM customer MINUS SELECT s_state FROM store ORDER BY st;

@@ -1,0 +1,3 @@
+SELECT length('') e, trim('') t, upper('') u, substring('abc', 10) oob, substring('abc', 0, 2) zero;
+SELECT repeat('x', 0) r0, lpad('abcdef', 3, '0') truncated, split('', ',') emptysplit;
+SELECT concat_ws(',', 'a', NULL, 'b') skip_null, concat('') empty;

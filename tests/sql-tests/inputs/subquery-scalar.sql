@@ -1,0 +1,3 @@
+SELECT count(*) AS n FROM item WHERE i_current_price > (SELECT avg(i_current_price) FROM item);
+SELECT i_category, (SELECT max(s_number_employees) FROM store) AS me FROM item GROUP BY i_category ORDER BY i_category;
+SELECT s_store_sk, (SELECT count(*) FROM store_sales WHERE ss_store_sk = s_store_sk) AS sales FROM store ORDER BY s_store_sk;

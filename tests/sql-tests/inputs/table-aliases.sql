@@ -1,0 +1,2 @@
+SELECT a.i_item_sk FROM item a JOIN item b ON a.i_item_sk = b.i_item_sk WHERE a.i_item_sk <= 3 ORDER BY a.i_item_sk;
+SELECT t.i_item_id FROM (SELECT * FROM item WHERE i_current_price > 90) AS t ORDER BY t.i_item_id LIMIT 3;

@@ -1,0 +1,2 @@
+SELECT try_add(2147483647, 1) ta, try_subtract(-2147483648, 1) ts, try_multiply(9223372036854775807, 2) tm, try_divide(1, 0) td;
+SELECT try_add(1, 2) a, try_divide(10, 4) d;

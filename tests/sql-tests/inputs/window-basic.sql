@@ -1,0 +1,7 @@
+SELECT i_item_id, row_number() OVER (ORDER BY i_current_price DESC, i_item_id) AS rn FROM item ORDER BY rn LIMIT 5;
+SELECT i_category, i_item_id, rank() OVER (PARTITION BY i_category ORDER BY i_current_price DESC) AS r FROM item ORDER BY i_category, r LIMIT 10;
+SELECT i_item_id, i_current_price, sum(i_current_price) OVER (ORDER BY i_item_sk ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS rs FROM item ORDER BY i_item_sk LIMIT 5;
+SELECT i_item_id, lag(i_current_price) OVER (ORDER BY i_item_sk) AS lg, lead(i_current_price) OVER (ORDER BY i_item_sk) AS ld FROM item ORDER BY i_item_sk LIMIT 5;
+SELECT i_category, avg(i_current_price) OVER (PARTITION BY i_category) AS ca FROM item ORDER BY i_category, ca LIMIT 8;
+SELECT i_item_id, ntile(4) OVER (ORDER BY i_current_price) AS q FROM item ORDER BY i_current_price LIMIT 8;
+SELECT i_item_id, percent_rank() OVER (ORDER BY i_current_price) AS pr, cume_dist() OVER (ORDER BY i_current_price) AS cd FROM item ORDER BY i_current_price LIMIT 5;

@@ -44,3 +44,88 @@ def test_coalesced_join_correct(spark):
     finally:
         spark.conf.unset("spark.sql.adaptive.advisoryPartitionSizeInBytes")
         spark.conf.unset("spark.sql.autoBroadcastJoinThreshold")
+
+
+def test_full_outer_join_never_broadcast(spark):
+    """A replicated build side is unsound for full_outer (unmatched build
+    rows would re-emit per probe partition) — the planner must pick the
+    shuffled path however small the right side is."""
+    l = spark.createDataFrame(pa.table({
+        "k": [1, 2, 3, 4, 5, 6, 7, 8], "a": [1] * 8})).repartition(4)
+    r = spark.createDataFrame(pa.table({"k": [1, 9], "b": [100, 900]}))
+    l.createOrReplaceTempView("fo_l")
+    r.createOrReplaceTempView("fo_r")
+    out = spark.sql(
+        "SELECT b FROM fo_l FULL OUTER JOIN fo_r ON fo_l.k = fo_r.k "
+        "ORDER BY b NULLS LAST").toArrow().to_pydict()
+    assert out["b"] == [100, 900] + [None] * 7
+
+
+def test_aqe_broadcast_demotion(spark):
+    """Initial plan picks a shuffled join (stats over threshold); runtime
+    size of the filtered build side demotes it to broadcast and elides the
+    probe-side shuffle (role of AdaptiveSparkPlanExec re-optimization +
+    local shuffle read)."""
+    spark.conf.set("spark.sql.autoBroadcastJoinThreshold", 200)
+    try:
+        a = spark.createDataFrame(pa.table({
+            "k": list(range(1000)), "v": list(range(1000))})).repartition(4)
+        b = spark.createDataFrame(pa.table({
+            "k": list(range(0, 2000, 2)),
+            "w": list(range(1000))})).repartition(4)
+        a.createOrReplaceTempView("aqe_a")
+        b.createOrReplaceTempView("aqe_b")
+        out = spark.sql(
+            "SELECT count(*) AS c FROM aqe_a JOIN "
+            "(SELECT k, w FROM aqe_b WHERE w < 3) sb "
+            "ON aqe_a.k = sb.k").toArrow().to_pydict()
+        assert out["c"] == [3]
+        snap = spark._metrics.snapshot()["counters"]
+        assert snap.get("aqe.broadcast_demotions", 0) >= 1
+        assert snap.get("aqe.probe_shuffles_elided", 0) >= 1
+    finally:
+        spark.conf.unset("spark.sql.autoBroadcastJoinThreshold")
+
+
+def test_aqe_demotion_disabled_when_adaptive_off(spark):
+    spark.conf.set("spark.sql.adaptive.enabled", "false")
+    spark.conf.set("spark.sql.autoBroadcastJoinThreshold", 200)
+    before = spark._metrics.snapshot()["counters"].get(
+        "aqe.broadcast_demotions", 0)
+    try:
+        a = spark.createDataFrame(pa.table({
+            "k": list(range(100)), "v": list(range(100))})).repartition(4)
+        b = spark.createDataFrame(pa.table({
+            "k": list(range(0, 200, 2)), "w": list(range(100))}))
+        out = a.join(b.filter("w < 3"), on="k") \
+            .agg(F.count("*").alias("c")).toArrow().to_pydict()
+        assert out["c"] == [3]
+        snap = spark._metrics.snapshot()["counters"]
+        assert snap.get("aqe.broadcast_demotions", 0) == before
+    finally:
+        spark.conf.unset("spark.sql.adaptive.enabled")
+        spark.conf.unset("spark.sql.autoBroadcastJoinThreshold")
+
+
+def test_aqe_demotion_preserves_partitioning_dependent_agg(spark):
+    """Probe-shuffle elision must NOT fire when an operator above the join
+    relies on the join's hash partitioning (per-key agg over the join
+    keys) — role of the reference's ValidateRequirements after AQE
+    re-optimization. Results must stay correct either way."""
+    spark.conf.set("spark.sql.autoBroadcastJoinThreshold", 200)
+    try:
+        a = spark.createDataFrame(pa.table({
+            "k": [1, 2, 3, 4] * 250, "v": list(range(1000))})).repartition(4)
+        b = spark.createDataFrame(pa.table({
+            "k": list(range(0, 2000, 2)),
+            "w": list(range(1000))})).repartition(4)
+        a.createOrReplaceTempView("aqe_pk_a")
+        b.createOrReplaceTempView("aqe_pk_b")
+        out = spark.sql(
+            "SELECT aqe_pk_a.k, count(*) c FROM aqe_pk_a JOIN "
+            "(SELECT k FROM aqe_pk_b WHERE w < 3) sb "
+            "ON aqe_pk_a.k = sb.k GROUP BY aqe_pk_a.k "
+            "ORDER BY aqe_pk_a.k").toArrow().to_pydict()
+        assert out["k"] == [2, 4] and out["c"] == [250, 250]
+    finally:
+        spark.conf.unset("spark.sql.autoBroadcastJoinThreshold")

@@ -1,0 +1,62 @@
+"""CLI layer tests (reference: bin/spark-submit, bin/spark-sql,
+launcher/ — SURVEY.md §1 layer 14)."""
+
+import io
+import os
+
+import pyarrow as pa
+
+from spark_tpu.cli.submit import build_parser, parse_conf
+from spark_tpu.cli.sql_shell import render_table, run_statement
+
+
+def test_parse_conf():
+    assert parse_conf(["a.b=1", "c = x=y "]) == {"a.b": "1", "c": "x=y"}
+
+
+def test_submit_parser_app_args():
+    args = build_parser().parse_args(
+        ["--name", "n", "--conf", "k=v", "app.py", "--flag", "7"])
+    assert args.name == "n"
+    assert args.conf == ["k=v"]
+    assert args.app == "app.py"
+    assert args.app_args == ["--flag", "7"]
+
+
+def test_render_table():
+    t = pa.table({"a": [1, None], "name": ["xx", "y"]})
+    out = render_table(t)
+    assert "| a    | name |" in out
+    assert "| NULL | y    |" in out
+
+
+def test_run_statement(spark):
+    buf = io.StringIO()
+    run_statement(spark, "SELECT 1 AS one", out=buf)
+    s = buf.getvalue()
+    assert "| one |" in s and "1 row(s)" in s
+
+
+def test_submit_runs_app(tmp_path, spark):
+    app = tmp_path / "app.py"
+    app.write_text(
+        "import json, os\n"
+        "from spark_tpu.cli.submit import get_session\n"
+        "s = get_session()\n"
+        "out = s.sql('SELECT 40 + 2 AS v').toArrow().to_pydict()\n"
+        "open(os.environ['CLI_TEST_OUT'], 'w').write(json.dumps(out))\n")
+    marker = tmp_path / "out.json"
+    os.environ["CLI_TEST_OUT"] = str(marker)
+    try:
+        import spark_tpu.cli.submit as sub
+
+        old = sub._SESSION
+        sub._SESSION = None
+        try:
+            sub.main(["--name", "t", "--conf",
+                      "spark.sql.shuffle.partitions=2", str(app)])
+        finally:
+            sub._SESSION = old
+        assert marker.read_text() == '{"v": [42]}'
+    finally:
+        del os.environ["CLI_TEST_OUT"]
