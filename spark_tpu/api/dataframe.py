@@ -79,7 +79,8 @@ class DataFrame:
             if unit.startswith(k) or unit.rstrip("s").startswith(k):
                 v *= m
                 break
-        df = self._with(self.plan)
+        df = self._with(L.EventTimeWatermark(column, int(v * 1e6),
+                                             self.plan))
         df._watermark = (column, v)
         return df
 

@@ -160,6 +160,8 @@ class Planner:
             return UnionExec(children, list(node.output))
         if isinstance(node, L.SubqueryAlias):
             return self._convert(node.child)
+        if isinstance(node, L.EventTimeWatermark):
+            return self._convert(node.child)  # batch: transparent marker
         if isinstance(node, L.Repartition):
             child = self._convert(node.child)
             n = node.num_partitions or self.conf.shuffle_partitions

@@ -301,6 +301,19 @@ class Distinct(UnaryNode):
         self.child = child
 
 
+class EventTimeWatermark(UnaryNode):
+    """withWatermark(column, delay) marker (role of the reference's
+    EventTimeWatermark logical node, sqlcat/plans/logical/
+    EventTimeWatermark.scala): batch execution passes through; the
+    streaming runtime reads it to drive late-row filtering, state
+    eviction, and outer-join finalization per input stream."""
+
+    def __init__(self, column: str, delay_us: int, child: LogicalPlan):
+        self.column = column
+        self.delay_us = delay_us
+        self.child = child
+
+
 class SubqueryAlias(UnaryNode):
     def __init__(self, alias: str, child: LogicalPlan):
         self.alias = alias

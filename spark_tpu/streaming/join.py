@@ -1,0 +1,335 @@
+"""Stream-stream joins: inner AND outer, with watermark state management.
+
+Role of the reference's StreamingSymmetricHashJoinExec
+(sqlx/streaming/operators/stateful/ join operators) redesigned for this
+engine's micro-batch model:
+
+  * State per side = the accumulated JOIN-INPUT rows (the side's subplan
+    applied once on ingest), plus three bookkeeping columns — `__id`
+    (process-unique row id), `__ts` (event-time in µs from the side's
+    watermark column, or null), `__matched` (has this row ever joined).
+  * Inner results emit incrementally via the delta decomposition
+    newL ⋈ (oldR ∪ newR)  +  oldL ⋈ newR — nothing emits twice.
+  * The global watermark = min over watermarked sides of
+    (max event time seen − delay), advanced at end of batch
+    (previous-batch semantics, like the reference).
+  * Outer finalization: once a stored outer-side row's event time falls
+    below the watermark and it has never matched, it emits null-extended
+    — exactly once, because the row is evicted with everything else
+    below the watermark.
+  * State trimming: rows below the watermark are evicted on BOTH sides
+    (bounded state — the reference achieves this via the time-interval
+    condition bound; here the watermark itself is the documented bound:
+    a match arriving after the partner fell below the watermark is
+    dropped as late data).
+
+Late input rows (event time < watermark) are dropped on ingest, so a
+finalized row can never re-emit.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from ..errors import UnsupportedOperationError
+from ..expr.expressions import AttributeReference
+from ..plan import logical as L
+from ..types import LongType, int64
+from .state import StateStore
+
+_OUTER_TYPES = ("left_outer", "right_outer", "full_outer")
+_SUPPORTED = ("inner", "cross") + _OUTER_TYPES
+
+
+def _contains(node, leaf) -> bool:
+    return any(x is leaf for x in node.iter_nodes())
+
+
+def _find_stream_join(plan: L.LogicalPlan, leaves) -> L.Join:
+    """The Join node where the two streaming leaves meet."""
+    for n in plan.iter_nodes():
+        if isinstance(n, L.Join):
+            lhas = [_contains(n.left, lf) for lf in leaves]
+            rhas = [_contains(n.right, lf) for lf in leaves]
+            if (lhas[0] and rhas[1] and not lhas[1] and not rhas[0]) or \
+                    (lhas[1] and rhas[0] and not lhas[0] and not rhas[1]):
+                return n
+    raise UnsupportedOperationError(
+        "two streaming sources must meet at a join")
+
+
+class StreamJoinRunner:
+    """Per-query symmetric join state machine (owned by StreamingQuery)."""
+
+    def __init__(self, session, plan: L.LogicalPlan, leaves,
+                 checkpoint_dir: str | None):
+        self.session = session
+        self.plan = plan
+        self.join = _find_stream_join(plan, leaves)
+        if self.join.join_type not in _SUPPORTED:
+            raise UnsupportedOperationError(
+                f"{self.join.join_type} stream-stream joins are not "
+                "supported (inner/left_outer/right_outer/full_outer)")
+
+        # side i holds leaves[i]; sides[0] = join.left's leaf index
+        self.left_leaf_idx = 0 if _contains(self.join.left, leaves[0]) else 1
+        self.leaves = leaves
+        self.below = [self.join.left, self.join.right]  # per JOIN side
+        self.leaf_for_side = [leaves[self.left_leaf_idx],
+                              leaves[1 - self.left_leaf_idx]]
+
+        # per-side watermark: nearest EventTimeWatermark above the leaf,
+        # with the event-time column surviving into the join input
+        self.side_wm: list[tuple[str, int] | None] = [None, None]
+        for n in plan.iter_nodes():
+            if isinstance(n, L.EventTimeWatermark):
+                for s in (0, 1):
+                    if _contains(n, self.leaf_for_side[s]):
+                        names = [a.name for a in self.below[s].output]
+                        if n.column in names:
+                            self.side_wm[s] = (n.column, n.delay_us)
+
+        jt = self.join.join_type
+        if jt in ("left_outer", "full_outer") and self.side_wm[0] is None:
+            raise UnsupportedOperationError(
+                f"{jt} stream-stream join needs withWatermark on the left "
+                "side's event-time column (it must survive into the join)")
+        if jt in ("right_outer", "full_outer") and self.side_wm[1] is None:
+            raise UnsupportedOperationError(
+                f"{jt} stream-stream join needs withWatermark on the right "
+                "side's event-time column")
+
+        self.state = [StateStore(checkpoint_dir, "state_left"),
+                      StateStore(checkpoint_dir, "state_right")]
+        self.next_id = [0, 0]
+        self.max_ts: list[int | None] = [None, None]
+
+    # -- persistence helpers ------------------------------------------------
+    def load(self, version: int) -> None:
+        for s, st in enumerate(self.state):
+            st.load(version)
+            if st.table is not None and st.table.num_rows:
+                self.next_id[s] = int(
+                    pa.compute.max(st.table["__id"]).as_py()) + 1
+                # -1 is the null-event-time sentinel, not a real maximum
+                ts = pa.compute.max(st.table["__ts"]).as_py()
+                self.max_ts[s] = int(ts) if ts is not None and ts >= 0 \
+                    else None
+
+    # -- per-batch ----------------------------------------------------------
+    def _run_plan(self, plan: L.LogicalPlan) -> pa.Table:
+        from ..api.dataframe import DataFrame
+
+        return DataFrame(self.session, plan).toArrow()
+
+    def _ingest(self, side: int, raw: pa.Table,
+                wm_us: int | None) -> pa.Table:
+        """Apply the side's subplan to the new raw rows, attach
+        bookkeeping columns, and drop late rows."""
+        leaf = self.leaf_for_side[side]
+
+        def sub(node):
+            if node is leaf:
+                return L.LocalRelation(leaf.attrs, raw)
+            return node
+
+        t = self._run_plan(self.below[side].transform_up(sub))
+        n = t.num_rows
+        ids = np.arange(self.next_id[side], self.next_id[side] + n,
+                        dtype=np.int64)
+        self.next_id[side] += n
+        wm_col = self.side_wm[side]
+        if wm_col is not None:
+            ts = _event_time_us(t, wm_col[0])
+            mx = int(ts.max()) if len(ts) and not np.all(ts < 0) else None
+            if mx is not None:
+                self.max_ts[side] = mx if self.max_ts[side] is None \
+                    else max(self.max_ts[side], mx)
+        else:
+            ts = np.full(n, -1, np.int64)
+        t = t.append_column("__id", pa.array(ids))
+        t = t.append_column("__ts", pa.array(ts))
+        t = t.append_column("__matched", pa.array(np.zeros(n, bool)))
+        if wm_col is not None:
+            # on a watermarked side a null event time (__ts = -1) cannot
+            # participate in watermark bookkeeping — drop it on ingest so
+            # it can never leak in state unevictable; late rows drop too
+            keep = ts >= (wm_us if wm_us is not None else 0)
+            if not keep.all():
+                t = t.filter(pa.array(keep))
+        return t
+
+    def _side_state(self, side: int) -> pa.Table:
+        st = self.state[side].table
+        if st is not None:
+            return st
+        return self._empty_state(side)
+
+    def _empty_state(self, side: int) -> pa.Table:
+        t = _empty_like(self.below[side].output)
+        t = t.append_column("__id", pa.array([], pa.int64()))
+        t = t.append_column("__ts", pa.array([], pa.int64()))
+        t = t.append_column("__matched", pa.array([], pa.bool_()))
+        return t
+
+    def _delta_join(self, lt: pa.Table, rt: pa.Table):
+        """Inner join of two id-carrying tables through the engine.
+        Returns (result rows conforming to join.output + id columns)."""
+        lid = AttributeReference("__sj_lid", int64, False)
+        rid = AttributeReference("__sj_rid", int64, False)
+        lattrs = list(self.join.left.output) + [lid]
+        rattrs = list(self.join.right.output) + [rid]
+        lrel = L.LocalRelation(
+            lattrs, _rename(lt.drop_columns(["__ts", "__matched"]),
+                            "__id", "__sj_lid"))
+        rrel = L.LocalRelation(
+            rattrs, _rename(rt.drop_columns(["__ts", "__matched"]),
+                            "__id", "__sj_rid"))
+        j = L.Join(lrel, rrel, "inner", self.join.condition)
+        proj = L.Project(
+            list(self.join.left.output) + list(self.join.right.output)
+            + [lid, rid], j)
+        return self._run_plan(proj)
+
+    def run_batch(self, new_raw: list[pa.Table], wm_start: int | None) \
+            -> "tuple[pa.Table, int | None, list[pa.Table]]":
+        """One micro-batch. new_raw is per-LEAF; returns (output rows in
+        the FULL plan's schema, end-of-batch watermark, merged per-side
+        state to pass to commit())."""
+        jt = self.join.join_type
+        new_side = [self._ingest(0, new_raw[self.left_leaf_idx], wm_start),
+                    self._ingest(1, new_raw[1 - self.left_leaf_idx],
+                                 wm_start)]
+        old = [self._side_state(0), self._side_state(1)]
+
+        # delta decomposition (inner rows)
+        all_r = pa.concat_tables([old[1], new_side[1]],
+                                 promote_options="permissive")
+        d1 = self._delta_join(new_side[0], all_r)
+        d2 = self._delta_join(old[0], new_side[1])
+        inner = pa.concat_tables([d1, d2], promote_options="permissive")
+
+        matched_l = set(inner["__sj_lid"].to_pylist())
+        matched_r = set(inner["__sj_rid"].to_pylist())
+        inner = inner.drop_columns(["__sj_lid", "__sj_rid"])
+
+        # merge state: append new rows, fold in matched flags
+        merged = []
+        for s, (o, nw, mset) in enumerate(
+                zip(old, new_side, (matched_l, matched_r))):
+            t = pa.concat_tables([o, nw], promote_options="permissive")
+            if mset:
+                ids = np.asarray(t["__id"].to_pylist() or [], np.int64)
+                m = np.asarray(t["__matched"].to_pylist() or [], bool)
+                hit = np.isin(ids, np.fromiter(mset, np.int64,
+                                               len(mset)))
+                m = m | hit
+                t = t.set_column(t.schema.get_field_index("__matched"),
+                                 "__matched", pa.array(m))
+            merged.append(t)
+
+        # outer finalization + eviction below the batch-start watermark
+        outer_parts = []
+        if wm_start is not None:
+            for s, outer_here in ((0, jt in ("left_outer", "full_outer")),
+                                  (1, jt in ("right_outer", "full_outer"))):
+                t = merged[s]
+                if self.side_wm[s] is None or t.num_rows == 0:
+                    continue
+                ts = np.asarray(t["__ts"].to_pylist(), np.int64)
+                below = (ts >= 0) & (ts < wm_start)
+                if outer_here:
+                    m = np.asarray(t["__matched"].to_pylist(), bool)
+                    un = t.filter(pa.array(below & ~m))
+                    if un.num_rows:
+                        outer_parts.append(self._null_extend(s, un))
+                merged[s] = t.filter(pa.array(~below))
+
+        out_inner = inner
+        out = [out_inner] + outer_parts
+        combined = pa.concat_tables(out, promote_options="permissive") \
+            if len(out) > 1 else out_inner
+        result = self._apply_above(combined)
+
+        # end-of-batch watermark from per-side maxima
+        wms = []
+        for s in (0, 1):
+            if self.side_wm[s] is not None:
+                if self.max_ts[s] is None:
+                    wms.append(None)
+                else:
+                    wms.append(self.max_ts[s] - self.side_wm[s][1])
+        new_wm = None
+        if wms and all(w is not None for w in wms):
+            new_wm = min(wms)
+            if wm_start is not None:
+                new_wm = max(new_wm, wm_start)
+
+        return result, new_wm, merged
+
+    def commit(self, version: int, merged: list[pa.Table]) -> None:
+        self.state[0].commit(version, merged[0])
+        self.state[1].commit(version, merged[1])
+
+    def state_rows(self) -> tuple[int, int]:
+        return tuple(0 if st.table is None else st.table.num_rows
+                     for st in self.state)
+
+    # -- output shaping ----------------------------------------------------
+    def _null_extend(self, side: int, t: pa.Table) -> pa.Table:
+        """Unmatched side-`side` rows padded with nulls for the other side,
+        conforming to join.output + id columns (ids dropped by caller's
+        schema — we just drop them here)."""
+        t = t.drop_columns(["__id", "__ts", "__matched"])
+        n = t.num_rows
+        cols, names = [], []
+        from ..types import to_arrow_type
+
+        for s, attrs in ((0, self.join.left.output),
+                         (1, self.join.right.output)):
+            for i, a in enumerate(attrs):
+                names.append(a.name)
+                if s == side:
+                    cols.append(t.column(i))
+                else:
+                    cols.append(pa.nulls(n, to_arrow_type(a.dtype)))
+        return pa.table(cols, names=names)
+
+    def _apply_above(self, joined: pa.Table) -> pa.Table:
+        out_attrs = [a.with_nullability(True) for a in self.join.output]
+        rel = L.LocalRelation(out_attrs, joined)
+
+        def sub(node):
+            if node is self.join:
+                return rel
+            return node
+
+        return self._run_plan(self.plan.transform_up(sub))
+
+
+def _event_time_us(t: pa.Table, column: str) -> np.ndarray:
+    col = t[column]
+    typ = col.type
+    if pa.types.is_timestamp(typ):
+        us = col.cast(pa.timestamp("us")).cast(pa.int64())
+    elif pa.types.is_integer(typ):
+        us = col.cast(pa.int64())
+    else:
+        raise UnsupportedOperationError(
+            f"watermark column {column} must be timestamp or integer µs")
+    vals = us.to_pylist()
+    return np.asarray([v if v is not None else -1 for v in vals], np.int64)
+
+
+def _empty_like(attrs) -> pa.Table:
+    from ..types import to_arrow_type
+
+    return pa.table(
+        [pa.array([], to_arrow_type(a.dtype)) for a in attrs],
+        names=[a.name for a in attrs])
+
+
+def _rename(t: pa.Table, old: str, new: str) -> pa.Table:
+    names = [new if n == old else n for n in t.column_names]
+    return t.rename_columns(names)

@@ -143,9 +143,10 @@ class StreamingQuery:
         self.checkpoint_dir = checkpoint_dir
         self.state = StateStore(checkpoint_dir)
         if len(leaves) == 2:
-            self._validate_stream_join(plan, leaves)
-            self._join_state = [StateStore(checkpoint_dir, "state_left"),
-                                StateStore(checkpoint_dir, "state_right")]
+            from .join import StreamJoinRunner
+
+            self._join_runner = StreamJoinRunner(session, plan, leaves,
+                                                 checkpoint_dir)
             self.committed_offset = [l.source.initial_offset()
                                      for l in leaves]
         else:
@@ -176,14 +177,17 @@ class StreamingQuery:
         self.batch_id = last
         self.state.load(last)
         if len(self.stream_leaves) == 2:
-            for st in self._join_state:
-                st.load(last)
+            self._join_runner.load(last)
 
     # --- trigger loop ------------------------------------------------------
     def _run(self) -> None:
         try:
             while not self._stop_evt.is_set():
-                progressed = self._run_one_batch()
+                self._in_trigger = True
+                try:
+                    progressed = self._run_one_batch()
+                finally:
+                    self._in_trigger = False
                 if self.once:
                     if not progressed:
                         break
@@ -196,93 +200,55 @@ class StreamingQuery:
         finally:
             self._active = False
 
-    @staticmethod
-    def _validate_stream_join(plan: LogicalPlan, leaves) -> None:
-        """The delta decomposition below is only valid when the two
-        streams meet at a JOIN (the plan is bilinear in the leaves)."""
-        from ..plan.logical import Join as LJoin
-
-        def contains(node, leaf):
-            return any(x is leaf for x in node.iter_nodes())
-
-        for n in plan.iter_nodes():
-            if isinstance(n, LJoin):
-                lhas = [contains(n.left, l) for l in leaves]
-                rhas = [contains(n.right, l) for l in leaves]
-                if (lhas[0] and rhas[1] and not lhas[1] and not rhas[0]) or \
-                        (lhas[1] and rhas[0] and not lhas[0] and not rhas[1]):
-                    if n.join_type not in ("inner", "cross"):
-                        raise UnsupportedOperationError(
-                            "only INNER stream-stream joins are supported")
-                    return
-        raise UnsupportedOperationError(
-            "two streaming sources must meet at a join")
-
     def _run_one_batch_join(self) -> bool:
         latest = [l.source.latest_offset() for l in self.stream_leaves]
         if latest == self.committed_offset:
             return False
+        if self.output_mode != "append":
+            raise UnsupportedOperationError(
+                "stream-stream joins support append mode only")
         t0 = time.perf_counter()
-        batch_id = self.batch_id + 1
         new_datas = [l.source.get_batch(c, lt)
                      for l, c, lt in zip(self.stream_leaves,
                                          self.committed_offset, latest)]
+        wm_before = self.current_watermark_us
+        self._join_batch_pass(new_datas, latest, t0)
+        # watermark advanced → one finalize pass with no new input so
+        # outer rows emit without waiting for more data (mirrors
+        # MicroBatchExecution's extra batch on watermark change)
+        if self.current_watermark_us != wm_before:
+            from .join import _empty_like
+
+            empties = [_empty_like(l.attrs) for l in self.stream_leaves]
+            self._join_batch_pass(empties, latest, time.perf_counter())
+        return True
+
+    def _join_batch_pass(self, new_datas, latest, t0) -> None:
+        batch_id = self.batch_id + 1
         if self.checkpoint_dir:
             with open(os.path.join(self.checkpoint_dir, "offsets",
                                    str(batch_id)), "w") as f:
                 json.dump({"offset": [_json_safe(x) for x in latest]}, f)
-        out_table = self._execute_join_batch(new_datas, batch_id)
+        out_table, new_wm, merged = self._join_runner.run_batch(
+            new_datas, self.current_watermark_us)
         self.sink.add_batch(batch_id, out_table, self.output_mode)
+        self._join_runner.commit(batch_id, merged)
+        if new_wm is not None:
+            self.current_watermark_us = new_wm
         if self.checkpoint_dir:
             with open(os.path.join(self.checkpoint_dir, "commits",
                                    str(batch_id)), "w") as f:
-                json.dump({"batch": batch_id}, f)
+                json.dump({"batch": batch_id,
+                           "watermark_us": self.current_watermark_us}, f)
         self.batch_id = batch_id
         self.committed_offset = latest
         self.recent_progress.append({
             "batchId": batch_id,
             "numInputRows": sum(t.num_rows for t in new_datas),
             "durationMs": int((time.perf_counter() - t0) * 1000),
+            "stateRows": list(self._join_runner.state_rows()),
         })
         del self.recent_progress[:-32]
-        return True
-
-    def _execute_join_batch(self, new_datas, batch_id: int) -> pa.Table:
-        """Incremental inner join (reference: StreamingSymmetricHashJoinExec):
-        joined(old∪new, old∪new) − joined(old, old) computed as two delta
-        runs — newL ⋈ (oldR∪newR), then oldL ⋈ newR — so nothing emits
-        twice. State = the accumulated raw inputs per side."""
-        from ..api.dataframe import DataFrame
-        from ..plan.logical import LocalRelation
-
-        if self.output_mode != "append":
-            raise UnsupportedOperationError(
-                "stream-stream joins support append mode only")
-        lleaf, rleaf = self.stream_leaves
-        old = [st.table if st.table is not None else nd.slice(0, 0)
-               for st, nd in zip(self._join_state, new_datas)]
-        all_r = pa.concat_tables([old[1], new_datas[1]],
-                                 promote_options="permissive")
-
-        def run(ltab, rtab):
-            def sub(node):
-                if node is lleaf:
-                    return LocalRelation(lleaf.attrs, ltab)
-                if node is rleaf:
-                    return LocalRelation(rleaf.attrs, rtab)
-                return node
-
-            return DataFrame(self.session,
-                             self.plan.transform_up(sub)).toArrow()
-
-        parts = [run(new_datas[0], all_r), run(old[0], new_datas[1])]
-        out = pa.concat_tables(parts, promote_options="permissive")
-
-        all_l = pa.concat_tables([old[0], new_datas[0]],
-                                 promote_options="permissive")
-        self._join_state[0].commit(batch_id, all_l)
-        self._join_state[1].commit(batch_id, all_r)
-        return out
 
     def _run_one_batch(self) -> bool:
         if len(self.stream_leaves) == 2:
@@ -631,7 +597,9 @@ class StreamingQuery:
                     self.committed_offset
             else:
                 caught = self.source.latest_offset() == self.committed_offset
-            if caught:
+            # a trigger may still be mid-flight (e.g. the watermark
+            # finalize pass) after offsets catch up — wait it out
+            if caught and not getattr(self, "_in_trigger", False):
                 return
             time.sleep(0.01)
         raise TimeoutError("processAllAvailable timed out")
