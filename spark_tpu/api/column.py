@@ -30,6 +30,29 @@ class Column:
 
     name = alias
 
+    # --- nested access ----------------------------------------------------
+    def getField(self, name: str) -> "Column":
+        """struct field access (reference: Column.getField)."""
+        return Column(E.GetStructField(self.expr, name))
+
+    def getItem(self, key) -> "Column":
+        """map value / array element access (reference: Column.getItem).
+        Dispatch by child type happens at analysis, not construction —
+        the child may still be unresolved here."""
+        return Column(E.UnresolvedFunction(
+            "element_at", [self.expr, E.Literal(key)], False))
+
+    def __getitem__(self, key) -> "Column":
+        if isinstance(key, str):
+            from ..types import StructType
+
+            try:
+                if isinstance(self.expr.dtype, StructType):
+                    return self.getField(key)
+            except Exception:
+                pass
+        return self.getItem(key)
+
     def cast(self, to: DataType | str) -> "Column":
         if isinstance(to, str):
             from ..sql.parser import parse_data_type

@@ -15,14 +15,18 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 from ..types import (
+    ArrayType,
     DataType,
     DecimalType,
+    MapType,
     StringType,
     StructField,
     StructType,
     from_arrow_type,
 )
-from .batch import Column, ColumnarBatch, StringDict, bucket_capacity
+from .batch import (
+    Column, ColumnarBatch, StringDict, bucket_capacity, encode_values,
+)
 
 __all__ = ["schema_from_arrow", "table_to_batches", "batches_to_table",
            "record_batch_to_columnar"]
@@ -61,6 +65,17 @@ def _chunked_to_numpy(arr: pa.ChunkedArray | pa.Array, dt: DataType):
         scaled = pc.multiply(pc.cast(arr, pa.float64()), 10.0 ** dt.scale)
         data = np.rint(np.asarray(pc.cast(scaled, pa.float64()).fill_null(0))).astype(np.int64)
         return data, validity, None
+
+    if isinstance(dt, (ArrayType, MapType, StructType)):
+        # nested values dictionary-encode like strings: int32 codes on
+        # device, python values (lists / dicts) host-side
+        vals = arr.to_pylist()
+        if isinstance(dt, MapType):
+            # pyarrow maps materialize as lists of (k, v) pairs
+            vals = [dict(v) if v is not None else None for v in vals]
+        uniq, codes = encode_values(vals)
+        empty = {} if isinstance(dt, (MapType, StructType)) else []
+        return codes, validity, StringDict(uniq or [empty])
 
     at = arr.type
     if pa.types.is_date32(at):

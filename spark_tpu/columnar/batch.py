@@ -32,10 +32,12 @@ from ..types import (
     BooleanType,
     DataType,
     DecimalType,
+    MapType,
     NullType,
     StringType,
     StructField,
     StructType,
+    dict_encoded,
     from_arrow_type,
     to_arrow_type,
 )
@@ -155,29 +157,68 @@ class StringDict:
         return md, ra, rb
 
 
+def canon_value(v):
+    """Hashable canonical form for a dictionary value. Dict items are
+    SORTED: maps are unordered (two insertion orders are the same map);
+    for structs the field order is schema-fixed so sorting is harmless."""
+    if isinstance(v, dict):
+        return tuple(sorted((k, canon_value(x)) for k, x in v.items()))
+    if isinstance(v, (list, tuple, np.ndarray)):
+        return tuple(canon_value(x) for x in v)
+    return v
+
+
+def encode_values(values, codes: np.ndarray | None = None):
+    """Dictionary-encode a sequence of python values (None → code 0,
+    caller tracks validity separately). Returns (unique values, codes)."""
+    n = len(values)
+    if codes is None:
+        codes = np.zeros(n, np.int32)
+    uniq: list = []
+    index: dict = {}
+    for i, v in enumerate(values):
+        if v is None:
+            continue
+        k = canon_value(v)
+        j = index.get(k)
+        if j is None:
+            j = len(uniq)
+            uniq.append(v)
+            index[k] = j
+        codes[i] = j
+    return uniq, codes
+
+
 def merge_string_dicts(dicts: Sequence["StringDict"]):
     """Union several dictionaries; returns (merged StringDict,
     [recode int32 array per dict]). Uses the C++ open-addressing merge
-    (native/sparktpu_native.cpp spark_tpu_merge_dicts) when built."""
-    try:
-        from ..utils.native import merge_dicts
+    (native/sparktpu_native.cpp spark_tpu_merge_dicts) when built; nested
+    values (lists/dicts) take the canonical-key python path. Dictionaries
+    are type-homogeneous per column, so the first value decides the path."""
+    all_str = all(isinstance(d.values[0], str)
+                  for d in dicts if d.values)
+    if all_str:
+        try:
+            from ..utils.native import merge_dicts
 
-        merged_vals, recodes = merge_dicts([d.values for d in dicts])
-        recodes = [r if len(r) else np.zeros(1, np.int32) for r in recodes]
-        return StringDict(merged_vals or [""]), recodes
-    except Exception:
-        pass
-    merged: list[str] = []
-    idx: dict[str, int] = {}
+            merged_vals, recodes = merge_dicts([d.values for d in dicts])
+            recodes = [r if len(r) else np.zeros(1, np.int32)
+                       for r in recodes]
+            return StringDict(merged_vals or [""]), recodes
+        except Exception:
+            pass
+    merged: list = []
+    idx: dict = {}
     recodes = []
     for d in dicts:
         lut = np.zeros(max(len(d.values), 1), dtype=np.int32)
         for i, v in enumerate(d.values or [""]):
-            j = idx.get(v)
+            k = canon_value(v)
+            j = idx.get(k)
             if j is None:
                 j = len(merged)
                 merged.append(v)
-                idx[v] = j
+                idx[k] = j
             lut[i] = j
         recodes.append(lut)
     return StringDict(merged or [""]), recodes
@@ -246,13 +287,15 @@ class Column:
         if selection is not None:
             data = data[selection]
             valid = valid[selection] if valid is not None else None
-        if self.is_string or isinstance(self.dtype, ArrayType):
+        if self.is_string or isinstance(self.dtype,
+                                        (ArrayType, MapType, StructType)):
             # explicit fill: np.array() would make ragged equal-length
             # lists into a 2-D array
             vals = np.empty(len(self.dictionary.values) + 1, dtype=object)
             for i, v in enumerate(self.dictionary.values):
                 vals[i] = v
-            vals[-1] = [] if isinstance(self.dtype, ArrayType) else ""
+            vals[-1] = [] if isinstance(self.dtype, ArrayType) else \
+                {} if isinstance(self.dtype, (MapType, StructType)) else ""
             codes = np.clip(data, 0, len(self.dictionary.values))
             out = vals[codes] if len(self.dictionary) else \
                 vals[np.full(len(data), -1)]
@@ -341,7 +384,7 @@ class ColumnarBatch:
                 vm[:n] = v[:cap]
                 vv = jnp.asarray(vm)
             cols.append(Column(f.dataType, jnp.asarray(pad), vv,
-                               d if isinstance(f.dataType, StringType) else None))
+                               d if dict_encoded(f.dataType) else None))
         mask = np.zeros(cap, dtype=bool)
         mask[:n] = True
         return ColumnarBatch(schema, cols, jnp.asarray(mask), num_rows=n)
@@ -387,7 +430,11 @@ class ColumnarBatch:
                       else _d.Decimal(int(raw[i])).scaleb(-scale)
                       for i in range(len(raw))]
                 arrays.append(pa.array(py, type=at))
-            elif isinstance(f.dataType, (StringType, ArrayType)):
+            elif isinstance(f.dataType, MapType):
+                arrays.append(pa.array(
+                    [None if v is None else list(v.items())
+                     for v in vals], type=at))
+            elif isinstance(f.dataType, (StringType, ArrayType, StructType)):
                 arrays.append(pa.array(list(vals), type=at))
             else:
                 mask = None

@@ -11,7 +11,7 @@ from typing import Sequence
 
 import numpy as np
 
-from ..types import StringType, StructType
+from ..types import StringType, StructType, dict_encoded
 from .batch import Column, ColumnarBatch, StringDict, bucket_capacity
 
 
@@ -113,7 +113,7 @@ def concat_batches(batches: Sequence[ColumnarBatch],
     cols: list[Column] = []
     for i, f in enumerate(schema.fields):
         parts = [b.columns[i] for b in batches]
-        if isinstance(f.dataType, StringType):
+        if dict_encoded(f.dataType):
             sd, datas = unify_string_columns(parts)
         else:
             sd = None

@@ -23,7 +23,7 @@ import numpy as np
 
 from ..columnar.batch import Column, ColumnarBatch, StringDict, bucket_capacity
 from ..exec.context import ExecContext
-from ..types import StringType, StructType
+from ..types import StringType, StructType, dict_encoded
 
 Partition = list
 
@@ -56,7 +56,7 @@ class _OutBuffer:
         for i, f in enumerate(self.schema.fields):
             datas = [c[i][0] for c in self.chunks]
             valids = [c[i][1] for c in self.chunks]
-            if isinstance(f.dataType, StringType):
+            if dict_encoded(f.dataType):
                 sdicts = [c[i][2] for c in self.chunks]
                 merged, recoded = _merge_dict_chunks(sdicts, datas)
                 data = np.concatenate(recoded) if recoded else np.zeros(0, np.int32)

@@ -26,10 +26,39 @@ from ..columnar.batch import StringDict, _hash_str
 from ..types import (
     ArrayType, BooleanType, ByteType, DataType, DateType, DecimalType,
     DoubleType, FloatType, FractionalType, IntegerType, IntegralType, LongType,
-    NullType, NumericType, ShortType, StringType, TimestampType,
-    boolean, common_type, date, float32, float64, infer_type, int8, int16,
-    int32, int64, null_type, string, timestamp,
+    MapType, NullType, NumericType, ShortType, StringType, StructField,
+    StructType, TimestampType,
+    boolean, common_type, date, dict_encoded, float32, float64, infer_type,
+    int8, int16, int32, int64, null_type, string, timestamp,
 )
+
+
+def _dict_empty(dt):
+    """Placeholder dictionary entry for an absent nested value."""
+    if isinstance(dt, ArrayType):
+        return []
+    if isinstance(dt, (MapType, StructType)):
+        return {}
+    return ""
+
+
+def _to_device_value(dt, v):
+    """Convert a host python value (as arrow to_pylist yields it) to the
+    type's device representation — nested dictionaries hold date/
+    timestamp/Decimal objects that numeric LUTs must re-encode."""
+    if isinstance(v, bool) or v is None:
+        return v
+    if isinstance(dt, DateType) and isinstance(v, datetime.date):
+        return (v - datetime.date(1970, 1, 1)).days
+    if isinstance(dt, TimestampType) and isinstance(v, datetime.datetime):
+        epoch = datetime.datetime(1970, 1, 1, tzinfo=v.tzinfo)
+        return int((v - epoch).total_seconds() * 1_000_000)
+    if isinstance(dt, DecimalType):
+        import decimal as _d
+
+        if isinstance(v, _d.Decimal):
+            return int(v.scaleb(dt.scale).to_integral_value())
+    return v
 from .eval import EvalCtx, Val
 
 __all__ = [
@@ -2225,21 +2254,22 @@ class _ArrayLut(Expression):
             return np.array([self.value_of(v)[1]
                              for v in (sd.values or [[]])], bool)
 
-        if isinstance(self.dtype, StringType):
-            # string-valued result (e.g. array_max of a string array):
-            # dictionary transform — per-entry result string, codes pass
-            # through; validity folds in per-entry emptiness
+        if dict_encoded(self.dtype):
+            # dictionary-encoded result (string element, nested struct /
+            # map / array): per-entry result value, codes pass through;
+            # validity folds in per-entry presence
             if not ctx.is_trace:
                 sd = c.sdict or StringDict([[]])
                 out = StringDict([self.value_of(v)[0] if self.value_of(v)[1]
-                                  else "" for v in (sd.values or [[]])])
+                                  else _dict_empty(self.dtype)
+                                  for v in (sd.values or [[]])])
                 ctx.aux(has_lut)
-                return Val(string, None, True, out)
+                return Val(self.dtype, None, True, out)
             hl = ctx.aux(None)
             codes = jnp.clip(c.data, 0, hl.shape[0] - 1)
             has = jnp.take(hl, codes)
             validity = has if c.validity is None else (c.validity & has)
-            return Val(string, c.data, validity, None)
+            return Val(self.dtype, c.data, validity, None)
 
         dd = self.dtype.device_dtype
 
@@ -2249,7 +2279,7 @@ class _ArrayLut(Expression):
             out = np.zeros(len(vs), dd)
             for i, v in enumerate(vs):
                 val, ok = self.value_of(v)
-                out[i] = val if ok else 0
+                out[i] = _to_device_value(self.dtype, val) if ok else 0
             return out
 
         if not ctx.is_trace:
@@ -2325,12 +2355,101 @@ class ElementAt(_ArrayLut):
         return 0, False
 
 
+class GetStructField(_ArrayLut):
+    """struct.field access (reference: complexTypeExtractors.scala
+    GetStructField) — per-dictionary-entry field extraction into a LUT
+    (numeric fields) or a derived dictionary (string/nested fields)."""
+
+    def __init__(self, child: Expression, name: str):
+        super().__init__(child)
+        self.field_name = name
+
+    @property
+    def dtype(self):
+        ct = self.child.dtype
+        if isinstance(ct, StructType):
+            ft = ct.field_type(self.field_name)
+            if ft is not None:
+                return ft
+        return null_type
+
+    @property
+    def nullable(self):
+        return True
+
+    def value_of(self, d):
+        if isinstance(d, dict) and d.get(self.field_name) is not None:
+            return d[self.field_name], True
+        return 0, False
+
+    def simple_string(self):
+        return f"{self.child.simple_string()}.{self.field_name}"
+
+
+class GetMapValue(_ArrayLut):
+    """map[key] / element_at(map, key) (reference:
+    complexTypeExtractors.scala GetMapValue) — per-entry lookup LUT."""
+
+    def __init__(self, child: Expression, key: Expression):
+        super().__init__(child)
+        self.key = key.value  # literal key
+
+    @property
+    def dtype(self):
+        ct = self.child.dtype
+        return ct.value_type if isinstance(ct, MapType) else null_type
+
+    def value_of(self, m):
+        if isinstance(m, dict) and m.get(self.key) is not None:
+            return m[self.key], True
+        return 0, False
+
+
+class MapContainsKey(_ArrayLut):
+    def __init__(self, child: Expression, key: Expression):
+        super().__init__(child)
+        self.key = key.value
+
+    @property
+    def dtype(self):
+        return boolean
+
+    @property
+    def nullable(self):
+        return self.child.nullable
+
+    def value_of(self, m):
+        return (self.key in m) if isinstance(m, dict) else False, True
+
+
 class _ArrayDictTransform(_DictTransform):
     """list → list function over dictionary values (codes unchanged)."""
 
     @property
     def dtype(self):
         return self.child.dtype
+
+
+class MapKeys(_ArrayDictTransform):
+    @property
+    def dtype(self):
+        ct = self.child.dtype
+        return ArrayType(ct.key_type) if isinstance(ct, MapType) \
+            else ArrayType()
+
+    def transform(self, m):
+        return list(m.keys()) if isinstance(m, dict) else []
+
+
+class MapValues(_ArrayDictTransform):
+    @property
+    def dtype(self):
+        ct = self.child.dtype
+        return ArrayType(ct.value_type) if isinstance(ct, MapType) \
+            else ArrayType()
+
+    def transform(self, m):
+        return list(m.values()) if isinstance(m, dict) else []
 
 
 class SortArray(_ArrayDictTransform):
@@ -2362,10 +2481,84 @@ class ElementAtString(_DictTransform):
 
 
 def build_element_at(child: Expression, idx: Expression) -> Expression:
+    if not isinstance(idx, Literal):
+        from ..errors import AnalysisException
+
+        raise AnalysisException(
+            "element_at / [] requires a literal key; column-valued keys "
+            "are not supported yet")
     ct = child.dtype
+    if isinstance(ct, MapType):
+        return GetMapValue(child, idx)
     if isinstance(ct, ArrayType) and isinstance(ct.element_type, StringType):
         return ElementAtString(child, idx)
     return ElementAt(child, idx)
+
+
+def build_struct_ctor(args, names=None) -> Expression:
+    """struct(...) / named_struct('n1', v1, ...) — a host-vectorized
+    constructor producing a dictionary-encoded struct column (reference:
+    complexTypeCreator.scala CreateNamedStruct)."""
+    from .pyudf import PythonUDF
+
+    if names is None:
+        names, vals = [], []
+        for i, a in enumerate(args):
+            if isinstance(a, Alias):
+                names.append(a.name)
+                vals.append(a.child)
+            elif isinstance(a, AttributeReference):
+                names.append(a.name)
+                vals.append(a)
+            elif isinstance(a, GetStructField):
+                names.append(a.field_name)
+                vals.append(a)
+            else:
+                names.append(f"col{i + 1}")
+                vals.append(a)
+    else:
+        vals = list(args)
+    st = StructType(tuple(StructField(n, v.dtype, True)
+                          for n, v in zip(names, vals)))
+    captured = list(names)
+
+    def make_struct(*cols):
+        return dict(zip(captured, cols))
+
+    return PythonUDF(make_struct, vals, st, name="named_struct",
+                     vectorized=False)
+
+
+def build_named_struct(args) -> Expression:
+    if len(args) % 2 != 0:
+        from ..errors import AnalysisException
+
+        raise AnalysisException("named_struct expects name/value pairs")
+    names = [str(a.value) for a in args[0::2]]
+    return build_struct_ctor(args[1::2], names=names)
+
+
+def build_map_ctor(args) -> Expression:
+    """map(k1, v1, k2, v2, ...) (reference: complexTypeCreator.scala
+    CreateMap) — host-vectorized dictionary-encoded map column."""
+    from ..errors import AnalysisException
+    from .pyudf import PythonUDF
+
+    if len(args) % 2 != 0:
+        raise AnalysisException("map expects key/value pairs")
+    kt: DataType = null_type
+    vt: DataType = null_type
+    for k in args[0::2]:
+        kt = common_type(kt, k.dtype) or k.dtype
+    for v in args[1::2]:
+        vt = common_type(vt, v.dtype) or v.dtype
+    n_pairs = len(args) // 2
+
+    def make_map(*cols):
+        return {cols[2 * i]: cols[2 * i + 1] for i in range(n_pairs)}
+
+    return PythonUDF(make_map, list(args), MapType(kt, vt), name="map",
+                     vectorized=False)
 
 
 class _StringIntLut(Expression):

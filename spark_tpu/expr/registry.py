@@ -197,6 +197,12 @@ def _reg_all() -> None:
     r("sort_array", lambda c, asc=None: E.SortArray(c, asc))
     r("array_distinct", lambda c: E.ArrayDistinct(c))
     r("element_at", lambda c, i: E.build_element_at(c, i))
+    r("struct", lambda *a: E.build_struct_ctor(list(a)))
+    r("named_struct", lambda *a: E.build_named_struct(list(a)))
+    r("map", lambda *a: E.build_map_ctor(list(a)))
+    r("map_keys", lambda c: E.MapKeys(c))
+    r("map_values", lambda c: E.MapValues(c))
+    r("map_contains_key", lambda c, k: E.MapContainsKey(c, k))
     r("translate", lambda c, m, rep: E.Translate(c, m, rep))
     r("ascii", lambda c: E.Ascii(c))
     r("instr", lambda c, s: E.Instr(c, s))

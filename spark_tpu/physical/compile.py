@@ -181,8 +181,9 @@ class ExprPipeline:
         cols = []
         for f, hv, d, v in zip(self.out_schema.fields, host_outs, out_datas,
                                out_valids):
-            sdict = hv.sdict if isinstance(f.dataType,
-                                           (StringType, ArrayType)) else None
+            from ..types import dict_encoded
+
+            sdict = hv.sdict if dict_encoded(f.dataType) else None
             cols.append(Column(f.dataType, d, v, sdict))
         return ColumnarBatch(self.out_schema, cols, new_mask, num_rows=None)
 

@@ -1672,7 +1672,7 @@ class NestedLoopJoinExec(PhysicalPlan):
                     matched = jnp.zeros(pb.capacity, bool) \
                         .at[r.probe_idx].max(joined.row_mask)
                     from ..columnar.batch import EMPTY_DICT
-                    from ..types import ArrayType, StringType
+                    from ..types import dict_encoded
 
                     null_cols = []
                     for f in rschema.fields:
@@ -1680,8 +1680,7 @@ class NestedLoopJoinExec(PhysicalPlan):
                             f.dataType,
                             jnp.zeros(pb.capacity, f.dataType.device_dtype),
                             jnp.zeros(pb.capacity, bool),
-                            EMPTY_DICT if isinstance(
-                                f.dataType, (StringType, ArrayType))
+                            EMPTY_DICT if dict_encoded(f.dataType)
                             else None))
                     obatches.append(ColumnarBatch(
                         pair_schema, list(pb.columns) + null_cols,

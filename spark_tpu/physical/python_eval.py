@@ -95,6 +95,27 @@ class PythonEvalExec(PhysicalPlan):
         result = np.asarray(result)
         nulls = np.array([v is None for v in result]) \
             if result.dtype == object else np.zeros(len(result), bool)
+        from ..types import ArrayType, MapType, StructType
+
+        if isinstance(dt, (ArrayType, MapType, StructType)):
+            # nested result: dictionary-encode by canonical value.
+            # np.asarray may have made equal-length list results 2-D —
+            # iterate element-wise, never rely on the array's own rows
+            from ..columnar.batch import encode_values
+
+            rows = [None if (v is None) else
+                    (list(v) if isinstance(v, np.ndarray) else v)
+                    for v in (result.tolist()
+                              if result.ndim > 1 else result)]
+            values, codes = encode_values(rows)
+            nulls = np.array([v is None for v in rows], bool)
+            data = np.zeros(cap, np.int32)
+            data[sel] = codes
+            validity = np.zeros(cap, bool)
+            validity[sel] = ~nulls
+            empty = [] if isinstance(dt, ArrayType) else {}
+            return Column(dt, jnp.asarray(data), jnp.asarray(validity),
+                          StringDict(values or [empty]))
         if isinstance(dt, StringType):
             values: list[str] = []
             index: dict[str, int] = {}

@@ -58,6 +58,38 @@ def _resolve_name(name_parts: tuple[str, ...],
     return None
 
 
+def _resolve_struct_path(name_parts, attrs, case_sensitive):
+    """a.b.c where a prefix resolves to a struct-typed column: peel the
+    remaining parts as field accesses (reference: complexTypeExtractors
+    ExtractValue resolution in the analyzer)."""
+    from ..types import StructType
+    from ..expr.expressions import GetStructField
+
+    def norm(s):
+        return s if case_sensitive else s.lower()
+
+    for k in range(len(name_parts) - 1, 0, -1):
+        base = _resolve_name(name_parts[:k], attrs, case_sensitive)
+        if base is None or not isinstance(base.dtype, StructType):
+            continue
+        out = base
+        ok = True
+        for p in name_parts[k:]:
+            dt = out.dtype
+            if not isinstance(dt, StructType):
+                ok = False
+                break
+            actual = next((f.name for f in dt.fields
+                           if norm(f.name) == norm(p)), None)
+            if actual is None:
+                ok = False
+                break
+            out = GetStructField(out, actual)
+        if ok:
+            return out
+    return None
+
+
 class ResolveRelations(Rule):
     def __init__(self, catalog: Catalog):
         self.catalog = catalog
@@ -225,6 +257,9 @@ class ResolveReferences(Rule):
                     a = _resolve_name(e.name_parts, inputs, cs)
                     if a is not None:
                         return a
+                    nested = _resolve_struct_path(e.name_parts, inputs, cs)
+                    if nested is not None:
+                        return nested
                     return e
                 if isinstance(e, UnresolvedFunction):
                     if all(c.resolved or isinstance(c, UnresolvedStar)
@@ -284,9 +319,11 @@ def _auto_alias(e: Expression) -> Expression:
 
 def _pretty_name(e: Expression) -> str:
     from ..expr.expressions import (
-        Average, Count, Max, Min, Sum, Cast as _Cast,
+        Average, Count, GetStructField, Max, Min, Sum, Cast as _Cast,
     )
 
+    if isinstance(e, GetStructField):
+        return e.field_name  # `a.b` names its output `b`, like the reference
     if isinstance(e, Sum):
         return f"sum({_pretty_name(e.child)})"
     if isinstance(e, Count):
@@ -376,9 +413,16 @@ class ResolveAggsInSortHaving(Rule):
 
     def apply(self, plan):
         def rule(node):
+            tgt = _skip_alias(node.child) \
+                if isinstance(node, (Filter, Sort)) else None
+            if isinstance(node, Sort) and isinstance(tgt, Filter) and \
+                    isinstance(_skip_alias(tgt.child), Aggregate):
+                # ORDER BY over HAVING over Aggregate: resolve the sort
+                # keys against the aggregate below the filter
+                tgt = _skip_alias(tgt.child)
             if isinstance(node, (Filter, Sort)) and isinstance(
-                    _skip_alias(node.child), Aggregate):
-                agg = _skip_alias(node.child)
+                    tgt, Aggregate):
+                agg = tgt
                 if not agg.resolved:
                     return node
                 if any(not isinstance(e, (Alias, AttributeReference))
@@ -396,6 +440,17 @@ class ResolveAggsInSortHaving(Rule):
                         a = _resolve_name(e.name_parts, agg.child.output, self.cs)
                         if a is not None:
                             return a
+                        # struct path over the agg child (ORDER BY s.a
+                        # where s.a is a grouping expression): bind to the
+                        # matching aggregate output
+                        nested = _resolve_struct_path(
+                            e.name_parts, agg.child.output, self.cs)
+                        if nested is not None:
+                            for ae in agg.aggregate_exprs:
+                                if isinstance(ae, Alias) and \
+                                        ae.child.semantic_equals(nested):
+                                    return ae.to_attribute()
+                            return nested
                         return e
                     if isinstance(e, UnresolvedFunction):
                         if all(c.resolved or isinstance(c, UnresolvedStar)
@@ -486,7 +541,7 @@ def _skip_alias(p: LogicalPlan) -> LogicalPlan:
 
 
 def _replace_agg(p: LogicalPlan, new_agg: Aggregate) -> LogicalPlan:
-    if isinstance(p, SubqueryAlias):
+    if isinstance(p, (SubqueryAlias, Filter)):
         return p.copy(child=_replace_agg(p.child, new_agg))
     return new_agg
 
@@ -576,7 +631,23 @@ class ExtractGenerators(Rule):
                                           e.name, e.expr_id))
                 else:
                     new_list.append(e.transform_up(replace))
-            return Project(new_list, Generate(gen.child, elem, node.child))
+            # a computed generator source (explode(map_keys(m)), ...)
+            # binds to a column first so Generate only sees attributes
+            from ..expr.expressions import (
+                Literal as _Lit, Split as _Split,
+            )
+
+            src = gen.child
+            child_plan = node.child
+            simple = isinstance(src, (AttributeReference, _Lit)) or \
+                (isinstance(src, _Split)
+                 and isinstance(src.child, (AttributeReference, _Lit)))
+            if not simple:
+                bound = Alias(src, "__gen_src")
+                child_plan = Project(
+                    list(node.child.output) + [bound], node.child)
+                src = bound.to_attribute()
+            return Project(new_list, Generate(src, elem, child_plan))
 
         return plan.transform_up(rule)
 
@@ -761,6 +832,21 @@ class ResolveSortHiddenRefs(Rule):
                                 all(x.expr_id != a.expr_id for x in outputs):
                             missing.append(a)
                         return a
+                    nested = _resolve_struct_path(e.name_parts, hidden,
+                                                  self.cs)
+                    if nested is not None:
+                        # sort on a hidden struct field: carry the BASE
+                        # struct column through the inner project
+                        changed[0] = True
+                        base = nested
+                        while not isinstance(base, AttributeReference):
+                            base = base.child
+                        if all(x.expr_id != base.expr_id
+                               for x in missing) and \
+                                all(x.expr_id != base.expr_id
+                                    for x in outputs):
+                            missing.append(base)
+                        return nested
                 return e
 
             new_orders = [SortOrder(o.child.transform_up(resolve),

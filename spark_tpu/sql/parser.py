@@ -807,7 +807,13 @@ class Parser:
             return self.parse_unary()
         if self.eat_op("~"):
             return E.BitwiseNot(self.parse_unary())
-        return self.parse_primary()
+        e = self.parse_primary()
+        # subscript: col[key] → element_at (map value / array element)
+        while self.eat_op("["):
+            key = self.parse_expr()
+            self.expect_op("]")
+            e = E.UnresolvedFunction("element_at", [e, key], False)
+        return e
 
     def parse_primary(self) -> E.Expression:
         t = self.peek()

@@ -36,6 +36,7 @@ __all__ = [
     "StructField",
     "StructType",
     "ArrayType",
+    "MapType",
     "boolean",
     "int8",
     "int16",
@@ -48,6 +49,7 @@ __all__ = [
     "timestamp",
     "null_type",
     "common_type",
+    "dict_encoded",
     "from_arrow_type",
     "to_arrow_type",
 ]
@@ -208,6 +210,24 @@ class ArrayType(DataType):
         return np.dtype(np.int32)
 
 
+@dataclass(frozen=True)
+class MapType(DataType):
+    """Maps are dictionary-encoded like arrays (int32 codes on device,
+    python dicts host-side) — reference: UnsafeMapData.java role, with
+    the TPU analog being host dictionaries + device gather LUTs."""
+
+    key_type: "DataType" = field(default_factory=lambda: StringType())
+    value_type: "DataType" = field(default_factory=lambda: IntegerType())
+
+    def simple_string(self) -> str:
+        return (f"map<{self.key_type.simple_string()},"
+                f"{self.value_type.simple_string()}>")
+
+    @property
+    def device_dtype(self) -> np.dtype:
+        return np.dtype(np.int32)
+
+
 # Singleton-ish instances
 boolean = BooleanType()
 int8 = ByteType()
@@ -243,6 +263,18 @@ class StructType(DataType):
     @property
     def names(self) -> list[str]:
         return [f.name for f in self.fields]
+
+    @property
+    def device_dtype(self) -> np.dtype:
+        # struct COLUMNS are dictionary-encoded (codes on device, python
+        # dicts host-side), like arrays/maps
+        return np.dtype(np.int32)
+
+    def field_type(self, name: str) -> "DataType | None":
+        for f in self.fields:
+            if f.name == name:
+                return f.dataType
+        return None
 
     def add(self, name: str, dataType: DataType, nullable: bool = True) -> "StructType":
         return StructType(self.fields + (StructField(name, dataType, nullable),))
@@ -323,6 +355,12 @@ def common_type(a: DataType, b: DataType) -> DataType | None:
 # Arrow mapping
 # ---------------------------------------------------------------------------
 
+def dict_encoded(dt) -> bool:
+    """True for types whose columns are host-dictionary-encoded (int32
+    codes on device): strings/binary, arrays, maps, structs."""
+    return isinstance(dt, (StringType, ArrayType, MapType, StructType))
+
+
 def from_arrow_type(at) -> DataType:
     import pyarrow as pa
 
@@ -352,6 +390,17 @@ def from_arrow_type(at) -> DataType:
         return DecimalType(min(at.precision, DecimalType.MAX_PRECISION), at.scale)
     if pa.types.is_dictionary(at):
         return from_arrow_type(at.value_type)
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return ArrayType(from_arrow_type(at.value_type))
+    if pa.types.is_map(at):
+        return MapType(from_arrow_type(at.key_type),
+                       from_arrow_type(at.item_type))
+    if pa.types.is_struct(at):
+        return StructType(tuple(
+            StructField(f.name, from_arrow_type(f.type), f.nullable)
+            for f in at))
+    if pa.types.is_null(at):
+        return null_type
     raise NotImplementedError(f"Arrow type not supported: {at}")
 
 
@@ -386,6 +435,12 @@ def to_arrow_type(dt: DataType):
         return pa.null()
     if isinstance(dt, ArrayType):
         return pa.list_(to_arrow_type(dt.element_type))
+    if isinstance(dt, MapType):
+        return pa.map_(to_arrow_type(dt.key_type),
+                       to_arrow_type(dt.value_type))
+    if isinstance(dt, StructType):
+        return pa.struct([(f.name, to_arrow_type(f.dataType))
+                          for f in dt.fields])
     raise NotImplementedError(f"no arrow type for {dt}")
 
 

@@ -1,0 +1,7 @@
+SELECT id, tags['x'] AS x, element_at(tags, 'y') AS y FROM nested ORDER BY id;
+SELECT id, map_keys(tags) AS mk, map_values(tags) AS mv, size(tags) AS sz FROM nested ORDER BY id;
+SELECT id, map_contains_key(tags, 'y') AS has_y FROM nested ORDER BY id;
+SELECT map('a', 1, 'b', 2) AS m;
+SELECT id, explode(map_keys(tags)) AS k FROM nested ORDER BY id, k;
+SELECT id FROM nested WHERE tags['x'] = 9;
+SELECT element_at(nums, 1) AS first_num, sort_array(nums) AS sorted FROM nested ORDER BY id;

@@ -1,0 +1,6 @@
+SELECT id, person.name, person.age FROM nested ORDER BY id;
+SELECT id, person FROM nested WHERE person.age > 28 ORDER BY id;
+SELECT named_struct('a', 1, 'b', 'two') AS ns;
+SELECT struct(id, person.name) AS st FROM nested ORDER BY id;
+SELECT person.name AS nm, count(*) AS n FROM nested GROUP BY person.name ORDER BY nm NULLS FIRST;
+SELECT id FROM nested ORDER BY person.age NULLS LAST, id;

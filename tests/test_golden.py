@@ -18,9 +18,21 @@ REGEN = os.environ.get("SPARK_GENERATE_GOLDEN_FILES") == "1"
 
 
 def _setup(spark):
+    import pyarrow as pa
+
     from tpcds_mini import register_tpcds
 
     register_tpcds(spark)
+    nested = pa.table({
+        "id": [1, 2, 3],
+        "person": pa.array(
+            [{"name": "ann", "age": 31}, {"name": "bob", "age": 25}, None],
+            pa.struct([("name", pa.string()), ("age", pa.int64())])),
+        "tags": pa.array([[("x", 1), ("y", 2)], [("x", 9)], []],
+                         pa.map_(pa.string(), pa.int64())),
+        "nums": pa.array([[3, 1, 2], [5], None], pa.list_(pa.int64())),
+    })
+    spark.createDataFrame(nested).createOrReplaceTempView("nested")
 
 
 def _render(table) -> str:
