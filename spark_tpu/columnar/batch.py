@@ -204,15 +204,19 @@ def merge_string_dicts(dicts: Sequence["StringDict"]):
             merged_vals, recodes = merge_dicts([d.values for d in dicts])
             recodes = [r if len(r) else np.zeros(1, np.int32)
                        for r in recodes]
-            return StringDict(merged_vals or [""]), recodes
+            return StringDict(merged_vals), recodes
         except Exception:
             pass
     merged: list = []
     idx: dict = {}
     recodes = []
     for d in dicts:
+        # empty dictionaries contribute nothing; their (all-masked/invalid)
+        # rows keep code 0, which decoding treats as the type default. Never
+        # pad with "" here — for map/array/struct dictionaries a stray str
+        # corrupts decoding (v.items() on "").
         lut = np.zeros(max(len(d.values), 1), dtype=np.int32)
-        for i, v in enumerate(d.values or [""]):
+        for i, v in enumerate(d.values):
             k = canon_value(v)
             j = idx.get(k)
             if j is None:
@@ -221,7 +225,7 @@ def merge_string_dicts(dicts: Sequence["StringDict"]):
                 idx[k] = j
             lut[i] = j
         recodes.append(lut)
-    return StringDict(merged or [""]), recodes
+    return StringDict(merged), recodes
 
 
 EMPTY_DICT = StringDict([])
@@ -394,7 +398,7 @@ class ColumnarBatch:
         return ColumnarBatch.from_numpy(
             schema,
             [np.zeros(0, dtype=f.dataType.device_dtype) for f in schema.fields],
-            dictionaries=[EMPTY_DICT if isinstance(f.dataType, StringType) else None
+            dictionaries=[EMPTY_DICT if dict_encoded(f.dataType) else None
                           for f in schema.fields],
             capacity=capacity)
 

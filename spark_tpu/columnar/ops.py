@@ -12,7 +12,8 @@ from typing import Sequence
 import numpy as np
 
 from ..types import StringType, StructType, dict_encoded
-from .batch import Column, ColumnarBatch, StringDict, bucket_capacity
+from .batch import (Column, ColumnarBatch, EMPTY_DICT, StringDict,
+                    bucket_capacity)
 
 
 def _jnp():
@@ -73,7 +74,7 @@ def unify_string_columns(cols: Sequence[Column]) -> tuple[StringDict, list]:
     from .batch import merge_string_dicts
 
     jnp = _jnp()
-    dicts = [c.dictionary or StringDict([""]) for c in cols]
+    dicts = [c.dictionary or EMPTY_DICT for c in cols]
     # fast path: all columns share one dictionary object (common after a
     # scan of one partition) — no recode needed
     if all(d is dicts[0] for d in dicts):

@@ -21,7 +21,8 @@ from typing import Sequence
 
 import numpy as np
 
-from ..columnar.batch import Column, ColumnarBatch, StringDict, bucket_capacity
+from ..columnar.batch import (Column, ColumnarBatch, EMPTY_DICT,
+                              StringDict, bucket_capacity)
 from ..exec.context import ExecContext
 from ..types import StringType, StructType, dict_encoded
 
@@ -90,7 +91,7 @@ class _OutBuffer:
 def _merge_dict_chunks(sdicts: list, datas: list):
     from ..columnar.batch import merge_string_dicts
 
-    dicts = [sd or StringDict([""]) for sd in sdicts]
+    dicts = [sd or EMPTY_DICT for sd in sdicts]
     if all(d is dicts[0] for d in dicts):
         return dicts[0], [np.asarray(c) for c in datas]
     merged, luts = merge_string_dicts(dicts)

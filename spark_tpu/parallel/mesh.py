@@ -15,12 +15,24 @@ import numpy as np
 
 
 def get_mesh(n_devices: int | None = None, axis_name: str = "data"):
-    """1-D mesh over the first n devices (all by default)."""
+    """1-D mesh over the first n devices (all by default).
+
+    Raises when fewer than ``n_devices`` exist — silently truncating hides
+    topology bugs (a "mesh of 8" that is secretly 1 device computes wrong
+    ownership and masks broken multi-chip code paths). Callers that can
+    degrade (e.g. mesh_exchange.mesh_for) check device count themselves.
+    """
     import jax
     from jax.sharding import Mesh
 
     devs = jax.devices()
     if n_devices is not None:
+        if len(devs) < n_devices:
+            raise RuntimeError(
+                f"get_mesh({n_devices}): only {len(devs)} jax device(s) "
+                f"visible on backend '{jax.default_backend()}'. For a "
+                f"virtual CPU mesh set JAX_PLATFORMS=cpu and XLA_FLAGS="
+                f"--xla_force_host_platform_device_count={n_devices}.")
         devs = devs[:n_devices]
     return Mesh(np.array(devs), (axis_name,))
 

@@ -25,9 +25,9 @@ from typing import Sequence
 import numpy as np
 
 from ..columnar.batch import (
-    Column, ColumnarBatch, StringDict, bucket_capacity, merge_string_dicts,
+    Column, ColumnarBatch, EMPTY_DICT, bucket_capacity, merge_string_dicts,
 )
-from ..types import ArrayType, StringType, StructType
+from ..types import StructType, dict_encoded
 
 _MESH_CACHE: dict = {}
 
@@ -46,15 +46,14 @@ def mesh_for(num_out: int, conf, schema: StructType):
     """The mesh to run this exchange on, or None → host shuffle path.
 
     Conditions: mesh enabled, ≥2 devices, power-of-two partition count that
-    fits the device count, no list-typed payload columns (their host-side
-    dictionaries hold unhashable values; they take the host path)."""
+    fits the device count. All dict-encoded payloads (strings, arrays,
+    maps, structs) travel as recoded int32 codes against a merged global
+    dictionary (merge_string_dicts canonicalizes nested values)."""
     from ..config import MESH_ENABLED, DEVICE_MESH_AXIS
 
     if not conf.get(MESH_ENABLED):
         return None
     if num_out < 2 or (num_out & (num_out - 1)) != 0:
-        return None
-    if any(isinstance(f.dataType, ArrayType) for f in schema.fields):
         return None
     import jax
 
@@ -75,8 +74,8 @@ def _stage_inputs(partitions, key_positions, schema: StructType):
     merged_dicts: list = [None] * ncols
     recodes: list = [None] * ncols  # per col: list of per-batch LUTs
     for i, f in enumerate(schema.fields):
-        if isinstance(f.dataType, StringType):
-            dicts = [b.columns[i].dictionary or StringDict([""])
+        if dict_encoded(f.dataType):
+            dicts = [b.columns[i].dictionary or EMPTY_DICT
                      for b in batches]
             if batches and all(d is dicts[0] for d in dicts):
                 merged_dicts[i] = dicts[0]
@@ -84,8 +83,6 @@ def _stage_inputs(partitions, key_positions, schema: StructType):
                 md, luts = merge_string_dicts(dicts)
                 merged_dicts[i] = md
                 recodes[i] = luts
-            if merged_dicts[i] is None or len(merged_dicts[i]) == 0:
-                merged_dicts[i] = StringDict([""])
 
     datas = [[] for _ in range(ncols)]
     valids = [[] for _ in range(ncols)]

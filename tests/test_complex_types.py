@@ -141,3 +141,5 @@ def test_map_key_order_insensitive_groupby(spark):
     df = spark.createDataFrame(t1).union(spark.createDataFrame(t2))
     out = df.groupBy("m").agg(F.count("*").alias("n")).toArrow().to_pydict()
     assert out["n"] == [2]
+    # the representative key must survive the exchange with its dictionary
+    assert sorted(out["m"][0]) == [("x", 1), ("y", 2)]
