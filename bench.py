@@ -47,6 +47,30 @@ def _device_init_alive(timeout: float = 120.0) -> bool:
     return accelerator_healthy(timeout)
 
 
+_CONFIG_TIMEOUT_S = int(os.environ.get("SPARK_TPU_BENCH_TIMEOUT", "1500"))
+
+
+class _ConfigTimeout(Exception):
+    pass
+
+
+def _with_timeout(fn, seconds: int):
+    """Run one config under a SIGALRM deadline so a wedged accelerator or
+    pathological compile can't eat the whole suite run."""
+    import signal
+
+    def on_alarm(signum, frame):
+        raise _ConfigTimeout(f"config exceeded {seconds}s")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+    try:
+        return fn()
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
 def _session(extra=None):
     from spark_tpu import TpuSession
 
@@ -74,7 +98,12 @@ def _df_from_table(session, table, name):
 
 
 def _run_blocked(df) -> float:
-    """Execute a DataFrame and block until all device output is ready."""
+    """Execute a DataFrame and block until all device output is ready.
+
+    Blocks via block_until_ready AND an 8-byte host read of each output
+    buffer: a host read cannot complete before the producing computation
+    has, so the timing stays honest even if a remote backend's
+    block_until_ready resolves on dispatch rather than completion."""
     t0 = time.perf_counter()
     parts = df.query_execution.execute()
 
@@ -86,7 +115,8 @@ def _run_blocked(df) -> float:
             for c in x.columns:
                 try:
                     c.data.block_until_ready()
-                except AttributeError:
+                    np.asarray(c.data[:1])
+                except (AttributeError, TypeError):
                     pass
 
     _block(parts)
@@ -170,7 +200,9 @@ def bench_join():
     n_fact = int(20_000_000 * SCALE)
     baseline = 10.1e6  # reference shuffled hash join, codegen on
 
-    session = _session()
+    # 4M-row probe tiles: one moderate-size jitted join program reused
+    # across tiles beats one giant 2^25 compile
+    session = _session({"spark.tpu.batch.capacity": 1 << 22})
     rng = np.random.default_rng(3)
     # date_dim shape: 73049 consecutive date surrogate keys over 1998-2002
     d_date_sk = np.arange(2_450_816, 2_450_816 + 73_049, dtype=np.int64)
@@ -314,7 +346,7 @@ def main() -> int:
     records, failed = [], []
     for name in only:
         try:
-            r = CONFIGS[name]()
+            r = _with_timeout(CONFIGS[name], _CONFIG_TIMEOUT_S)
         except Exception as e:  # keep the suite alive; record the failure
             failed.append(name)
             print(json.dumps({"metric": f"{name} FAILED",

@@ -53,3 +53,13 @@ class _Timer:
 class ExecContext:
     conf: SQLConf = field(default_factory=SQLConf)
     metrics: Metrics = field(default_factory=Metrics)
+    _memory: object = field(default=None, repr=False)
+
+    @property
+    def memory(self):
+        """Per-query MemoryManager (UnifiedMemoryManager role)."""
+        if self._memory is None:
+            from .memory import MemoryManager
+
+            self._memory = MemoryManager(self.conf, self.metrics)
+        return self._memory

@@ -36,31 +36,99 @@ def _jnp():
 
 
 class _OutBuffer:
-    """Accumulates host-side row slices for one reducer partition."""
+    """Accumulates host-side row slices for one reducer partition.
 
-    def __init__(self, schema: StructType):
+    Memory discipline (UnsafeExternalSorter.java role): past
+    ``spill_bytes`` of accumulated host arrays, the live chunks are
+    written to one .npz spill file (dictionaries stay in RAM — they are
+    shared references, not copies) and dropped; build() streams spills
+    back one file at a time, so peak host memory is
+    O(spill_bytes + one tile), not O(partition)."""
+
+    def __init__(self, schema: StructType, spill_bytes: int | None = None,
+                 spill_dir: str | None = None, metrics=None):
         self.schema = schema
         self.chunks: list[list] = []  # per append: [(data, validity, sdict), ...]
         self.rows = 0
+        self.spill_bytes = spill_bytes
+        self.spill_dir = spill_dir
+        self.metrics = metrics
+        self._chunk_rows: list[int] = []
+        self._live_bytes = 0
+        # per spill: (path, [per-chunk [sdict per col]], [per-chunk rows])
+        self._spills: list[tuple] = []
 
     def append(self, cols: list, n: int):
-        if n:
-            self.chunks.append(cols)
-            self.rows += n
+        if not n:
+            return
+        self.chunks.append(cols)
+        self._chunk_rows.append(n)
+        self.rows += n
+        if self.spill_bytes is not None:
+            self._live_bytes += sum(
+                d.nbytes + (v.nbytes if v is not None else 0)
+                for d, v, _ in cols)
+            if self._live_bytes > self.spill_bytes:
+                self._spill()
 
-    def build(self, tile_capacity: int) -> Partition:
-        """Rebuild device batches (≤ tile_capacity rows each)."""
-        if not self.chunks:
-            return [ColumnarBatch.empty(self.schema)]
+    def _spill(self):
+        import os
+        import tempfile
+
+        fd, path = tempfile.mkstemp(suffix=".sparktpu-spill.npz",
+                                    dir=self.spill_dir or None)
+        os.close(fd)
+        arrays = {}
+        dicts = []
+        for ci, chunk in enumerate(self.chunks):
+            dicts.append([sd for _, _, sd in chunk])
+            for i, (d, v, _) in enumerate(chunk):
+                arrays[f"d{ci}_{i}"] = d
+                if v is not None:
+                    arrays[f"v{ci}_{i}"] = v
+        np.savez(path, **arrays)
+        self._spills.append((path, dicts, list(self._chunk_rows)))
+        if self.metrics is not None:
+            self.metrics.add("shuffle.spill.files")
+            self.metrics.add("shuffle.spill.bytes", self._live_bytes)
+        self.chunks, self._chunk_rows, self._live_bytes = [], [], 0
+
+    def _iter_chunks(self):
+        """Yield (chunk_cols, nrows) in append order, loading spill files
+        one at a time."""
+        import os
+
         ncols = len(self.schema.fields)
-        merged_cols = []
+        for path, dicts, chunk_rows in self._spills:
+            with np.load(path, allow_pickle=False) as z:
+                for ci, n in enumerate(chunk_rows):
+                    chunk = []
+                    for i in range(ncols):
+                        d = z[f"d{ci}_{i}"]
+                        v = (z[f"v{ci}_{i}"] if f"v{ci}_{i}" in z.files
+                             else None)
+                        chunk.append((d, v, dicts[ci][i]))
+                    yield chunk, n
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        for chunk, n in zip(self.chunks, self._chunk_rows):
+            yield chunk, n
+
+    def _build_tile(self, chunks: list[list]) -> ColumnarBatch:
+        """Merge a group of chunks into one device batch."""
+        arrays = []
+        validities = []
+        dicts = []
         for i, f in enumerate(self.schema.fields):
-            datas = [c[i][0] for c in self.chunks]
-            valids = [c[i][1] for c in self.chunks]
+            datas = [c[i][0] for c in chunks]
+            valids = [c[i][1] for c in chunks]
             if dict_encoded(f.dataType):
-                sdicts = [c[i][2] for c in self.chunks]
+                sdicts = [c[i][2] for c in chunks]
                 merged, recoded = _merge_dict_chunks(sdicts, datas)
-                data = np.concatenate(recoded) if recoded else np.zeros(0, np.int32)
+                data = (np.concatenate(recoded) if recoded
+                        else np.zeros(0, np.int32))
                 sd = merged
             else:
                 data = np.concatenate(datas) if datas else np.zeros(0)
@@ -71,20 +139,41 @@ class _OutBuffer:
                 validity = np.concatenate(vs)
             else:
                 validity = None
-            merged_cols.append((data, validity, sd))
+            arrays.append(data)
+            validities.append(validity)
+            dicts.append(sd)
+        return ColumnarBatch.from_numpy(
+            self.schema, arrays, dictionaries=dicts, validities=validities)
 
-        total = self.rows
-        batches = []
-        for start in range(0, max(total, 1), tile_capacity):
-            end = min(start + tile_capacity, total)
-            arrays = [c[0][start:end] for c in merged_cols]
-            validities = [None if c[1] is None else c[1][start:end]
-                          for c in merged_cols]
-            dicts = [c[2] for c in merged_cols]
-            batches.append(ColumnarBatch.from_numpy(
-                self.schema, arrays, dictionaries=dicts, validities=validities))
-            if end >= total:
-                break
+    def build(self, tile_capacity: int) -> Partition:
+        """Rebuild device batches (≤ tile_capacity rows each), streaming
+        spilled chunks so peak host memory stays bounded. Chunks are split
+        at exact tile boundaries — an overshooting tile would round up to
+        the next capacity bucket and break the memory bound."""
+        if not self.chunks and not self._spills:
+            return [ColumnarBatch.empty(self.schema)]
+        batches: Partition = []
+        pend: list[list] = []
+        pend_rows = 0
+        for chunk, n in self._iter_chunks():
+            off = 0
+            while n - off > 0:
+                take = min(n - off, tile_capacity - pend_rows)
+                if off == 0 and take == n:
+                    pend.append(chunk)
+                else:
+                    pend.append([
+                        (d[off:off + take],
+                         None if v is None else v[off:off + take], sd)
+                        for d, v, sd in chunk])
+                pend_rows += take
+                off += take
+                if pend_rows >= tile_capacity:
+                    batches.append(self._build_tile(pend))
+                    pend, pend_rows = [], 0
+        if pend or not batches:
+            batches.append(self._build_tile(pend))
+        self._spills = []
         return batches
 
 
@@ -116,7 +205,12 @@ def _pull_sorted(batch: ColumnarBatch, perm, counts) -> tuple[list, np.ndarray]:
 
 def shuffle_hash(partitions: list[Partition], key_positions: Sequence[int],
                  num_out: int, schema: StructType, ctx: ExecContext,
-                 stats: dict | None = None) -> list[Partition]:
+                 stats: dict | None = None,
+                 seed: int = 42) -> list[Partition]:
+    """Hash-repartition. ``seed`` must differ from the upstream exchange's
+    when re-splitting already-hash-partitioned data (grace join): reusing
+    the seed makes h %% nfrag constant within a partition whenever nfrag
+    divides the exchange's partition count — a degenerate split."""
     import jax
 
     from ..ops.hashing import hash_columns, partition_ids
@@ -130,7 +224,9 @@ def shuffle_hash(partitions: list[Partition], key_positions: Sequence[int],
         has_native = False
 
     jnp = _jnp()
-    bufs = [_OutBuffer(schema) for _ in range(num_out)]
+    bufs = [_OutBuffer(schema, spill_bytes=ctx.memory.spill_bytes,
+                       spill_dir=ctx.memory.spill_dir, metrics=ctx.metrics)
+            for _ in range(num_out)]
     for part in partitions:
         for batch in part:
             keys = [batch.columns[i] for i in key_positions]
@@ -142,14 +238,15 @@ def shuffle_hash(partitions: list[Partition], key_positions: Sequence[int],
                 # hash kernel); the C++ counting sort groups rows host-side
                 # (native/sparktpu_native.cpp, the RadixSort role) — no
                 # device sort, no device gather
-                kkey = ("shuffle_pids", cap, num_out, len(keys),
+                kkey = ("shuffle_pids", cap, num_out, len(keys), seed,
                         tuple(str(k.dtype) for k in key_eqs),
                         tuple(v is not None for v in key_valids))
                 kernel = GLOBAL_KERNEL_CACHE.get_or_build(
                     kkey, lambda: jax.jit(
                         lambda eqs, valids, mask: jnp.where(
                             mask,
-                            partition_ids(hash_columns(eqs, list(valids)),
+                            partition_ids(hash_columns(eqs, list(valids),
+                                                       seed=seed),
                                           num_out),
                             num_out)))
                 pids = np.asarray(kernel(key_eqs, key_valids,
@@ -169,13 +266,13 @@ def shuffle_hash(partitions: list[Partition], key_positions: Sequence[int],
                     gathered.append((data, validity, c.dictionary))
                 _slice_into(bufs, gathered, counts.astype(np.int64))
             else:
-                kkey = ("shuffle_hash", cap, num_out, len(keys),
+                kkey = ("shuffle_hash", cap, num_out, len(keys), seed,
                         tuple(str(k.dtype) for k in key_eqs),
                         tuple(v is not None for v in key_valids))
                 kernel = GLOBAL_KERNEL_CACHE.get_or_build(
                     kkey, lambda: jax.jit(
                         lambda eqs, valids, mask: hash_partition(
-                            eqs, valids, mask, num_out)))
+                            eqs, valids, mask, num_out, seed=seed)))
                 pr = kernel(key_eqs, key_valids, batch.row_mask)
                 gathered, counts = _pull_sorted(batch, pr.perm, pr.counts)
                 _slice_into(bufs, gathered, counts)
@@ -190,7 +287,9 @@ def shuffle_round_robin(partitions: list[Partition], num_out: int,
     from ..ops.partition import round_robin_partition
     from ..physical.compile import GLOBAL_KERNEL_CACHE
 
-    bufs = [_OutBuffer(schema) for _ in range(num_out)]
+    bufs = [_OutBuffer(schema, spill_bytes=ctx.memory.spill_bytes,
+                       spill_dir=ctx.memory.spill_dir, metrics=ctx.metrics)
+            for _ in range(num_out)]
     start = 0
     for part in partitions:
         for batch in part:
@@ -218,7 +317,9 @@ def shuffle_range(partitions: list[Partition], key_position: int,
     from ..physical.compile import GLOBAL_KERNEL_CACHE
 
     jnp = _jnp()
-    bufs = [_OutBuffer(schema) for _ in range(num_out)]
+    bufs = [_OutBuffer(schema, spill_bytes=ctx.memory.spill_bytes,
+                       spill_dir=ctx.memory.spill_dir, metrics=ctx.metrics)
+            for _ in range(num_out)]
     f = schema.fields[key_position]
     string_key = isinstance(f.dataType, StringType)
     for part in partitions:

@@ -67,7 +67,10 @@ def hash_columns(cols, validities=None, seed: int = 42):
             h = mix64(hu.view(jnp.int64))
     if h is None:
         raise ValueError("hash_columns needs at least one column")
-    return h
+    # nonlinear seed fold: h' = mix64(h ^ mix64(seed)). A linear fold
+    # (h + seed) would leave h' % p correlated with h % p, defeating the
+    # grace-join re-split of already-hash-partitioned data.
+    return mix64(h ^ mix64(jnp.int64(seed)))
 
 
 def partition_ids(hashes, num_partitions: int):

@@ -27,8 +27,8 @@ class PartitionedRows(NamedTuple):
 def hash_partition(key_cols: Sequence[jnp.ndarray],
                    key_valids: Sequence[jnp.ndarray | None],
                    row_mask: jnp.ndarray,
-                   num_partitions: int) -> PartitionedRows:
-    h = hash_columns(key_cols, list(key_valids))
+                   num_partitions: int, seed: int = 42) -> PartitionedRows:
+    h = hash_columns(key_cols, list(key_valids), seed=seed)
     pids = partition_ids(h, num_partitions)
     return _group_by_pid(pids, row_mask, num_partitions)
 

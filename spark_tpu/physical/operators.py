@@ -938,9 +938,23 @@ class SortExec(PhysicalPlan):
         parts = self.child.execute(ctx)
         parts = coalesce_after_exchange(self.child, parts, ctx,
                                         self.child.output)
-        return [[self._sort_partition(p)] if p else [] for p in parts]
+        return [self._sort_partition(p, ctx) if p else [] for p in parts]
 
-    def _sort_partition(self, part: Partition) -> ColumnarBatch:
+    def _sort_partition(self, part: Partition, ctx) -> Partition:
+        """Budget dispatch: a partition that fits the device budget sorts
+        as one tile; a larger one takes the external range-bucketed
+        multi-pass (physical/external_sort.py, the UnsafeExternalSorter
+        role)."""
+        schema = attrs_schema(self.child.output)
+        budget = ctx.memory.tile_rows(schema, amplification=3)
+        if sum(b.capacity for b in part) <= budget:
+            return [self._sort_single(part)]
+        from .external_sort import external_sort
+
+        return external_sort(part, self.orders, schema, self.child.output,
+                             ctx, budget, self._sort_single)
+
+    def _sort_single(self, part: Partition) -> ColumnarBatch:
         import jax
 
         from ..ops.sorting import SortKeySpec, sort_permutation
@@ -1169,12 +1183,24 @@ class HashJoinExec(PhysicalPlan):
         raise KeyError(target)
 
     def _join_partition(self, lp: Partition, rp: Partition, lschema, rschema,
-                        ctx) -> Partition:
+                        ctx, _depth: int = 0) -> Partition:
         import jax
 
         from ..ops import joining as J
 
         jnp = _jnp()
+        # Grace hash join (memory discipline): a build side over the device
+        # budget is hash-fragmented together with its probe side — same key
+        # hash, same fragment — and each fragment joins independently
+        # (role of the reference's spillable HashedRelation fallback;
+        # exec/memory.py is the budget authority). Depth guard: one level —
+        # re-hashing with the same function cannot split further.
+        if rp and _depth == 0:
+            budget = ctx.memory.tile_rows(rschema, amplification=4)
+            build_cap = sum(b.capacity for b in rp)
+            if build_cap > budget:
+                return self._grace_join(lp, rp, lschema, rschema, ctx,
+                                        budget, build_cap)
         build = concat_batches(rp, rschema) if rp else ColumnarBatch.empty(rschema)
         # mesh partitions are committed to their device; the build side and
         # every probe batch must share one before a kernel can see both
@@ -1428,6 +1454,34 @@ class HashJoinExec(PhysicalPlan):
         schema = attrs_schema(self.output)
         cols = probe_out.columns + build_out.columns
         return ColumnarBatch(schema, cols, r.out_mask, num_rows=None)
+
+    def _grace_join(self, lp: Partition, rp: Partition, lschema, rschema,
+                    ctx, budget_rows: int, build_cap: int) -> Partition:
+        """Fragment both sides by join-key hash and join fragment-wise.
+        Equal keys co-locate, so every join type distributes over the
+        fragments (full_outer's unmatched-build emission runs per
+        fragment against that fragment's probe rows only)."""
+        from ..exec import shuffle as S
+
+        nfrag = -(-build_cap // max(budget_rows, 1))
+        nfrag = min(256, 1 << max(1, (nfrag - 1).bit_length()))
+        rpos = {a.expr_id: i for i, a in enumerate(self.right.output)}
+        lpos = {a.expr_id: i for i, a in enumerate(self.left.output)}
+        rk = [rpos[k.expr_id] for k in self.right_keys]
+        lk = [lpos[k.expr_id] for k in self.left_keys]
+        # distinct seed: the inputs are already hash-partitioned on these
+        # keys with the exchange's default seed — reusing it would send the
+        # whole partition to one fragment (h % nfrag constant)
+        r_frags = S.shuffle_hash([rp], rk, nfrag, rschema, ctx,
+                                 seed=0x9E3779B9)
+        l_frags = S.shuffle_hash([lp], lk, nfrag, lschema, ctx,
+                                 seed=0x9E3779B9)
+        ctx.memory.count("join.grace.fragments", nfrag)
+        out: Partition = []
+        for lf, rf in zip(l_frags, r_frags):
+            out.extend(self._join_partition(lf, rf, lschema, rschema, ctx,
+                                            _depth=1))
+        return out
 
     def _try_dense_build(self, build: ColumnarBatch, bkeys, ctx):
         """Dense unique-key build fast path (TPC-DS dimension tables: dense

@@ -455,24 +455,48 @@ class StreamingQuery:
         merged_ready = planner._ensure_requirements(merged)
         merged_parts = merged_ready.execute(ctx)
 
-        # persist new state (buffers, pre-finishing)
+        # persist new state (buffers, pre-finishing). The touched keys —
+        # exactly the new batch's partial-agg keys — make the commit an
+        # O(delta) changelog write (state.py, RocksDB-changelog role).
         state_batches = [b for p in merged_parts for b in p]
         state_table = pa.concat_tables(
             [b.to_arrow() for b in state_batches],
             promote_options="permissive") if state_batches else None
+        from .state import _key_tuples
+
+        key_names = [a.name for a in partial.grouping]
+        new_batches = [b for p in new_parts for b in p]
+        newt = None
+        new_keys: set = set()
+        need_keys = key_names and (
+            self.state.dir is not None or self.output_mode == "update"
+            or dedup_append)
+        if new_batches and need_keys:
+            newt = pa.concat_tables([b.to_arrow() for b in new_batches],
+                                    promote_options="permissive")
+            new_keys = set(_key_tuples(newt, key_names))
+        delta_kw = ({"upsert_keys": new_keys, "key_names": key_names}
+                    if key_names else {})
+
         if append_watermark and state_table is not None:
             from ..physical.operators import LocalTableScanExec as _LTS
 
             finalized, retained = self._split_watermark(state_table)
-            self.state.commit(self.batch_id + 1, retained)
+            deletes = (_key_tuples(finalized, key_names)
+                       if key_names else [])
+            self.state.commit(self.batch_id + 1, retained,
+                              delete_keys=deletes, **delta_kw)
             out_exec = finish.copy(child=_LTS(list(buffer_attrs), finalized))
             out_parts = out_exec.execute(ctx)
             out_batches = [b for p in out_parts for b in p]
             return pa.concat_tables([b.to_arrow() for b in out_batches],
                                     promote_options="permissive")
         if state_table is not None:
-            state_table = self._evict(state_table, buffer_attrs)
-            self.state.commit(self.batch_id + 1, state_table)
+            state_table, evicted = self._evict(state_table, buffer_attrs)
+            deletes = (_key_tuples(evicted, key_names)
+                       if key_names and evicted is not None else [])
+            self.state.commit(self.batch_id + 1, state_table,
+                              delete_keys=deletes, **delta_kw)
 
         # finishing projection over merged buffers
         out_exec = finish.copy(child=PrecomputedExec(merged_parts,
@@ -485,14 +509,7 @@ class StreamingQuery:
         if self.output_mode == "update" or dedup_append:
             # update: only groups touched by this batch;
             # dedup append: touched AND unseen before this batch
-            key_names = [a.name for a in partial.grouping]
-            new_batches = [b for p in new_parts for b in p]
-            if new_batches and key_names:
-                newt = pa.concat_tables([b.to_arrow() for b in new_batches],
-                                        promote_options="permissive")
-                new_keys = set(zip(*[newt.column(k).to_pylist()
-                                     for k in key_names])) \
-                    if newt.num_rows else set()
+            if newt is not None and key_names:
                 old_keys = set()
                 if dedup_append and prev_state is not None \
                         and prev_state.num_rows:
@@ -566,20 +583,24 @@ class StreamingQuery:
             wm = max(wm, self.current_watermark_us)
         self.current_watermark_us = wm
 
-    def _evict(self, state_table: pa.Table, buffer_attrs) -> pa.Table:
+    def _evict(self, state_table: pa.Table, buffer_attrs):
         """Watermark-based state eviction when a grouping key is the
-        watermark (event-time) column."""
+        watermark (event-time) column. Returns (kept, evicted-or-None);
+        evicted keys become changelog delete tombstones."""
         if self.watermark is None:
-            return state_table
+            return state_table, None
         col, _delay_s = self.watermark
         if col not in state_table.column_names:
-            return state_table
+            return state_table, None
         wm = self.current_watermark_us
         if wm is None:
-            return state_table
+            return state_table, None
         keep = [v is None or _to_us(v) >= wm
                 for v in state_table.column(col).to_pylist()]
-        return state_table.filter(pa.array(keep))
+        mask = pa.array(keep)
+        import pyarrow.compute as pc
+
+        return state_table.filter(mask), state_table.filter(pc.invert(mask))
 
     # --- public API --------------------------------------------------------
     @property

@@ -11,6 +11,11 @@ import os
 os.environ.setdefault("XLA_FLAGS",
                       "--xla_force_host_platform_device_count=8")
 os.environ["JAX_PLATFORMS"] = "cpu"
+# subprocesses spawned by tests (gate probes, dryrun re-exec, workers) must
+# also be pure-CPU: this var triggers the container sitecustomize's
+# accelerator-plugin registration, which overrides JAX_PLATFORMS=cpu and
+# would make child processes dial the (possibly busy) TPU tunnel
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 import jax  # noqa: E402
 
