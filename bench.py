@@ -35,10 +35,10 @@ import numpy as np
 SCALE = float(os.environ.get("SPARK_TPU_BENCH_SCALE", "1.0"))
 
 
-def _device_init_alive(timeout: float = 120.0) -> bool:
+def _device_init_alive(timeout: float = 30.0) -> bool:
     """Single source of truth: __graft_entry__.accelerator_healthy (probes
     compute execution in a subprocess; see its docstring for the tunnel
-    and libtpu-skew rationale)."""
+    and libtpu-skew rationale). Capped at 30 s, cached across processes."""
     _here = os.path.dirname(os.path.abspath(__file__))
     if _here not in sys.path:
         sys.path.insert(0, _here)
@@ -48,6 +48,11 @@ def _device_init_alive(timeout: float = 120.0) -> bool:
 
 
 _CONFIG_TIMEOUT_S = int(os.environ.get("SPARK_TPU_BENCH_TIMEOUT", "1500"))
+# Whole-suite deadline: no matter what the accelerator does, the suite
+# emits its records and summary line inside this budget (r03: a full-scale
+# CPU-fallback run ate the driver budget and rc=124 lost everything after
+# the last flushed line).
+_SUITE_BUDGET_S = int(os.environ.get("SPARK_TPU_BENCH_BUDGET", "5400"))
 
 
 class _ConfigTimeout(Exception):
@@ -333,26 +338,71 @@ CONFIGS = {
 }
 
 
+def _emit(rec):
+    """Flush each record as it's produced: a timed-out suite must still
+    leave a valid evidence trail (r03 lost 3 of 6 metrics to rc=124)."""
+    print(json.dumps(rec), flush=True)
+
+
+def _fallback_to_cpu_child() -> int:
+    """Accelerator is unhealthy: re-exec the suite in a provably-CPU child
+    at smoke scale. The child env is scrubbed of every tunnel trigger
+    (sitecustomize shadow + JAX_PLATFORMS=cpu) so neither the session nor
+    any worker subprocess it spawns can dial the wedged tunnel."""
+    import subprocess
+
+    from __graft_entry__ import cpu_subprocess_env
+
+    _emit({"metric": ("ACCELERATOR UNAVAILABLE — suite re-run on CPU at "
+                      f"{min(SCALE, 0.01):g} scale; vs_baseline values "
+                      "below are NOT TPU numbers"),
+           "value": 0, "unit": "status", "vs_baseline": 0.0})
+    env = cpu_subprocess_env()
+    env["SPARK_TPU_BENCH_CHILD"] = "1"
+    env["SPARK_TPU_BENCH_SCALE"] = str(min(SCALE, 0.01))
+    env["SPARK_TPU_BENCH_TIMEOUT"] = str(min(_CONFIG_TIMEOUT_S, 300))
+    env["SPARK_TPU_BENCH_BUDGET"] = str(min(_SUITE_BUDGET_S, 1500))
+    try:  # stdout inherited: child lines flush straight to the driver
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+            env=env, timeout=min(_SUITE_BUDGET_S, 1800))
+        return r.returncode
+    except subprocess.TimeoutExpired:
+        _emit({"metric": "bench suite CPU-fallback child timed out",
+               "value": 0.001, "unit": "x baseline", "vs_baseline": 0.001})
+        return 0
+
+
 def main() -> int:
+    t_start = time.monotonic()
+    is_child = os.environ.get("SPARK_TPU_BENCH_CHILD") == "1"
+    if not is_child and not _device_init_alive(30):
+        return _fallback_to_cpu_child()
+
     import jax
 
-    if not _device_init_alive():
+    if is_child:
         jax.config.update("jax_platforms", "cpu")
-        print("bench: accelerator unhealthy; falling back to CPU",
-              file=sys.stderr)
     jax.config.update("jax_enable_x64", True)
 
     only = sys.argv[1:] or list(CONFIGS)
     records, failed = [], []
     for name in only:
+        remaining = _SUITE_BUDGET_S - (time.monotonic() - t_start)
+        if remaining < 30:
+            failed.append(name)
+            _emit({"metric": f"{name} SKIPPED (suite budget exhausted)",
+                   "value": 0, "unit": "error", "vs_baseline": 0.0})
+            continue
         try:
-            r = _with_timeout(CONFIGS[name], _CONFIG_TIMEOUT_S)
+            r = _with_timeout(CONFIGS[name],
+                              int(min(_CONFIG_TIMEOUT_S, remaining)))
         except Exception as e:  # keep the suite alive; record the failure
             failed.append(name)
-            print(json.dumps({"metric": f"{name} FAILED",
-                              "value": 0, "unit": "error",
-                              "vs_baseline": 0.0,
-                              "error": f"{type(e).__name__}: {e}"[:400]}))
+            _emit({"metric": f"{name} FAILED",
+                   "value": 0, "unit": "error",
+                   "vs_baseline": 0.0,
+                   "error": f"{type(e).__name__}: {e}"[:400]})
             continue
         for rec in (r if isinstance(r, list) else [r]):
             if SCALE != 1.0:
@@ -361,7 +411,7 @@ def main() -> int:
                 rec["scale"] = SCALE
                 rec["metric"] += f" [SCALED {SCALE:g}x — vs_baseline invalid]"
             records.append(rec)
-            print(json.dumps(rec))
+            _emit(rec)
     # floor at 0.001 so a catastrophically slow config drags the geomean
     # instead of vanishing from it (round() can produce exact 0.0)
     ok = [max(r["vs_baseline"], 0.001) for r in records]
@@ -370,13 +420,15 @@ def main() -> int:
     geo = math.exp(sum(math.log(v) for v in ok) / len(ok)) if ok else 0.0
     label = (f"bench suite geomean vs reference CPU baseline "
              f"({len(records)} metrics over {len(only)} configs")
+    if is_child:
+        label += "; CPU-FALLBACK, scaled, not TPU numbers"
     label += f"; FAILED: {','.join(failed)})" if failed else ")"
-    print(json.dumps({
+    _emit({
         "metric": label,
         "value": round(geo, 2),
         "unit": "x baseline",
         "vs_baseline": round(geo, 3),
-    }))
+    })
     return 0
 
 
