@@ -1,60 +1,41 @@
-"""Local cluster: multi-process executor backend.
+"""Cluster backend: multi-process executors over the gRPC transport.
 
-Role of the reference's `local-cluster[n,cores,mem]` mode
-(core/SparkContext.scala:3464 regex → core/deploy/LocalSparkCluster.scala:38):
-real PROCESS boundaries on one host so distributed logic — task shipping,
-executor failure, retry, excludelists — is exercised without a cluster
-(SURVEY.md §4 'Multi-process distributed without a cluster').
+Role of the reference's cluster scheduling backend + local-cluster test
+mode (core/scheduler/cluster/CoarseGrainedSchedulerBackend.scala:372
+makeOffers/:426 launchTasks; core/SparkContext.scala:3464 local-cluster
+regex → core/deploy/LocalSparkCluster.scala:38): the driver runs a
+control-plane RpcServer (executor registration + heartbeats), workers
+dial in by ADDRESS with the cluster secret and are scheduled tasks over
+their own task/block endpoint. Registration is address-based, so any
+process that can reach the driver endpoint joins the same way the
+reference's standalone workers do — LocalCluster merely spawns its
+initial workers itself. Defaults bind 127.0.0.1 (same-host process
+groups, the local-cluster test mode); a genuine multi-host deployment
+passes bind_host=<reachable IP> here and in worker_env.
 
-Workers are spawned with the TPU tunnel disabled and connect back over an
-authenticated localhost socket; tasks ship as cloudpickle payloads (the
-ClosureCleaner/serializer role). Executor loss is detected on send/recv
-failure, recorded in the HealthTracker, and the task retries on another
-executor (TaskSetManager.maxFailures role).
+Tasks ship as cloudpickle payloads (the ClosureCleaner/serializer role).
+Executor loss is detected on RPC failure (UNAVAILABLE ≙ Netty channel
+inactive), recorded in the HealthTracker, and the task retries on
+another executor (TaskSetManager.maxFailures role).
 """
 
 from __future__ import annotations
 
 import os
+import pickle
 import secrets
 import subprocess
 import sys
 import threading
 import time
-from multiprocessing.connection import Client, Listener
 from typing import Any, Callable
 
 import cloudpickle
 
+from ..net.transport import (
+    RemoteRpcError, RpcClient, RpcServer, RpcUnavailableError,
+)
 from .scheduler import ExecutorRegistry, HealthTracker
-
-
-class _Worker:
-    def __init__(self, proc: subprocess.Popen, conn, executor_id: str):
-        self.proc = proc
-        self.conn = conn
-        self.executor_id = executor_id
-        self.lock = threading.Lock()
-
-    def run(self, payload: bytes) -> Any:
-        with self.lock:
-            self.conn.send_bytes(payload)
-            status, result = self.conn.recv()
-        if status == "err":
-            raise RemoteTaskError(result)
-        return result
-
-    def close(self):
-        try:
-            self.conn.close()
-        except Exception:
-            pass
-        if self.proc.poll() is None:
-            self.proc.terminate()
-            try:
-                self.proc.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                self.proc.kill()
 
 
 class RemoteTaskError(RuntimeError):
@@ -65,40 +46,137 @@ class ExecutorLostError(RuntimeError):
     pass
 
 
+class _Worker:
+    def __init__(self, client: RpcClient, executor_id: str, host: str,
+                 pid: int | None = None,
+                 proc: subprocess.Popen | None = None):
+        self.client = client
+        self.executor_id = executor_id
+        self.host = host
+        self.pid = pid
+        self.proc = proc
+        self.lock = threading.Lock()  # one in-flight task per slot
+
+    def run(self, payload: bytes) -> Any:
+        with self.lock:
+            raw = self.client.call("launch_task", payload)
+        try:
+            status, result = pickle.loads(raw)
+        except Exception as e:
+            raise RemoteTaskError(f"undecodable task reply: {e}")
+        if status == "err":
+            raise RemoteTaskError(result)
+        return result
+
+    def close(self):
+        self.client.close()
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+def worker_env(driver_addr: str, token: str,
+               host_label: str = "localhost",
+               bind_host: str = "127.0.0.1") -> dict:
+    """Environment for a worker process: CPU-pinned jax (workers never
+    dial the TPU tunnel — the chip belongs to the driver) + driver
+    coordinates. `bind_host` is the address the worker's own server
+    binds AND advertises; a worker on another machine sets it to an IP
+    the driver and peer workers can reach."""
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # no TPU tunnel in workers
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SPARK_TPU_WORKER_KEY"] = token
+    env["SPARK_TPU_DRIVER_ADDR"] = driver_addr
+    env["SPARK_TPU_WORKER_HOST"] = host_label
+    env["SPARK_TPU_BIND_HOST"] = bind_host
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
 class LocalCluster:
-    def __init__(self, num_workers: int = 2, max_task_failures: int = 3):
+    """Spawns num_workers executor processes and schedules tasks on them.
+    More executors — including ones labeled as other "hosts" — may join
+    at any time via the driver address + secret."""
+
+    def __init__(self, num_workers: int = 2, max_task_failures: int = 3,
+                 bind_host: str = "127.0.0.1"):
         self.max_task_failures = max_task_failures
         self.registry = ExecutorRegistry()
         self.health = HealthTracker(self.registry, max_failures=2)
-        authkey = secrets.token_bytes(16)
-        self._listener = Listener(("127.0.0.1", 0), authkey=authkey)
-        addr = self._listener.address
+        self.token = secrets.token_hex(16)
+        self.bind_host = bind_host
         self._workers: dict[str, _Worker] = {}
         self._rr = 0
         self._lock = threading.Lock()
-        env = dict(os.environ)
-        env["PALLAS_AXON_POOL_IPS"] = ""       # no TPU tunnel in workers
-        env["JAX_PLATFORMS"] = "cpu"
-        env["SPARK_TPU_WORKER_KEY"] = authkey.hex()
-        env["SPARK_TPU_WORKER_ADDR"] = f"{addr[0]}:{addr[1]}"
-        env.setdefault("PYTHONPATH", "")
-        root = os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__))))
-        env["PYTHONPATH"] = root + os.pathsep + env["PYTHONPATH"]
-        self.authkey_hex = authkey.hex()
-        for _ in range(num_workers):
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "spark_tpu.exec.worker_main"],
-                env=env)
-            conn = self._listener.accept()
-            # consume the handshake (the worker announces its block-server
-            # address; the authoritative copy rides in each MapStatus)
-            try:
-                conn.recv()
-            except (EOFError, OSError):
-                pass
-            eid = self.registry.register(host="localhost", slots=1)
-            self._workers[eid] = _Worker(proc, conn, eid)
+        self._joined = threading.Condition(self._lock)
+
+        self._server = RpcServer(self.token, host=bind_host)
+        self._server.register("register_executor", self._on_register)
+        self._server.register("heartbeat", self._on_heartbeat)
+        self.driver_addr = self._server.start()
+
+        procs = [self._spawn() for _ in range(num_workers)]
+        self._await_workers(num_workers, procs)
+
+    # -- control-plane handlers (run on server threads) -----------------
+    def _on_register(self, payload: bytes) -> bytes:
+        info = pickle.loads(payload)
+        client = RpcClient(info["addr"], self.token)
+        # Connect BEFORE registering: a fresh channel's first call can
+        # fail UNAVAILABLE transiently while TCP/HTTP2 set up, which the
+        # task path would misread as executor loss — and an unreachable
+        # worker must not become a ghost registry entry.
+        try:
+            client.wait_ready(10)
+        except Exception:
+            client.close()
+            raise
+        eid = self.registry.register(host=info["host"], slots=1)
+        with self._lock:
+            self._workers[eid] = _Worker(client, eid, info["host"],
+                                         pid=info.get("pid"))
+            self._joined.notify_all()
+        return eid.encode()
+
+    def _on_heartbeat(self, payload: bytes) -> bytes:
+        ok = self.registry.heartbeat(payload.decode())
+        return b"ok" if ok else b"unknown"
+
+    # ------------------------------------------------------------------
+    def _spawn(self, host_label: str = "localhost") -> subprocess.Popen:
+        return subprocess.Popen(
+            [sys.executable, "-m", "spark_tpu.exec.worker_main"],
+            env=worker_env(self.driver_addr, self.token, host_label))
+
+    def _await_workers(self, expect: int, procs: list, timeout: float = 60.0):
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while len(self._workers) < expect:
+                rest = deadline - time.monotonic()
+                if rest <= 0 or not self._joined.wait(timeout=rest):
+                    raise RuntimeError(
+                        f"only {len(self._workers)}/{expect} workers "
+                        f"registered within {timeout}s")
+        # adopt process handles BY PID (registration order ≠ spawn order;
+        # a swapped handle would make _Worker.close() terminate the wrong
+        # — possibly healthy — process)
+        with self._lock:
+            by_pid = {p.pid: p for p in procs}
+            for w in self._workers.values():
+                if w.proc is None and w.pid in by_pid:
+                    w.proc = by_pid.pop(w.pid)
+
+    def add_worker(self, host_label: str = "localhost") -> None:
+        """Join one more executor process (dynamic allocation growth)."""
+        before = len(self._workers)
+        proc = self._spawn(host_label)
+        self._await_workers(before + 1, [proc])
 
     # ------------------------------------------------------------------
     def _pick(self) -> _Worker:
@@ -124,9 +202,12 @@ class LocalCluster:
             w = self._pick()
             try:
                 return w.run(payload), w
-            except RemoteTaskError:
-                raise  # the function itself failed; retrying won't help
-            except Exception as e:  # connection/process death
+            except (RemoteTaskError, RemoteRpcError):
+                # the task (or its payload) failed deterministically —
+                # retrying on another healthy executor won't help, and
+                # the executor that reported it is NOT dead
+                raise
+            except (RpcUnavailableError, OSError) as e:
                 last = e
                 self.registry.remove(w.executor_id)  # executor lost
                 w.close()
@@ -143,10 +224,15 @@ class LocalCluster:
     def num_alive(self) -> int:
         return len(self.registry.alive())
 
+    @property
+    def authkey_hex(self) -> str:
+        """Cluster secret (name kept from the pipe-transport era; it is
+        the auth token FetchExec ships to consumers)."""
+        return self.token
+
     def stop(self):
-        for w in self._workers.values():
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
             w.close()
-        try:
-            self._listener.close()
-        except Exception:
-            pass
+        self._server.stop()

@@ -11,9 +11,11 @@ block at one address.
 
 from __future__ import annotations
 
+import pickle
 import threading
 from dataclasses import dataclass, field
-from multiprocessing.connection import Client
+
+from ..net.transport import RpcClient, RpcUnavailableError
 
 
 class FetchFailedError(RuntimeError):
@@ -69,40 +71,37 @@ class MapOutputTracker:
 
 
 class BlockClient:
-    """One authenticated connection to an executor's block server, reused
-    across block requests (ShuffleBlockFetcherIterator keeps one channel
-    per (host, port) too — per-block reconnect pays the auth handshake
-    num_partitions times)."""
+    """One authenticated gRPC channel to an executor's block server,
+    reused across block requests (ShuffleBlockFetcherIterator keeps one
+    channel per (host, port) too — per-block reconnect pays TCP+HTTP/2
+    setup num_partitions times). Blocks arrive as chunked streams; any
+    transport failure maps to FetchFailedError so the scheduler can
+    regenerate the producing stage from lineage."""
 
     def __init__(self, addr: str, authkey_hex: str, shuffle_id: str):
         self.shuffle_id = shuffle_id
         if ":" not in addr:
             raise FetchFailedError(shuffle_id, f"bad block address {addr!r}")
-        host, port = addr.rsplit(":", 1)
         self.addr = addr
-        try:
-            self._conn = Client((host, int(port)),
-                                authkey=bytes.fromhex(authkey_hex))
-        except (OSError, EOFError) as e:
-            raise FetchFailedError(shuffle_id, f"{addr} unreachable: {e}")
+        self._client = RpcClient(addr, authkey_hex)
 
     def get(self, reduce_id: int) -> bytes:
         try:
-            self._conn.send(("get", self.shuffle_id, reduce_id))
-            status, data = self._conn.recv()
-        except (OSError, EOFError) as e:
+            frames = self._client.stream(
+                "get_block", pickle.dumps((self.shuffle_id, reduce_id)),
+                timeout=120)
+            head = next(frames, None)
+            if head != b"ok":
+                raise FetchFailedError(
+                    self.shuffle_id,
+                    f"block {reduce_id} missing at {self.addr}")
+            return b"".join(frames)
+        except RpcUnavailableError as e:
             raise FetchFailedError(self.shuffle_id,
                                    f"{self.addr} died mid-fetch: {e}")
-        if status != "ok":
-            raise FetchFailedError(
-                self.shuffle_id, f"block {reduce_id} missing at {self.addr}")
-        return data
 
     def close(self) -> None:
-        try:
-            self._conn.close()
-        except Exception:
-            pass
+        self._client.close()
 
     def __enter__(self):
         return self
@@ -123,14 +122,8 @@ def free_shuffle(addr: str, authkey_hex: str, shuffle_id: str) -> None:
     """Best-effort release of a shuffle's blocks on one executor."""
     if ":" not in addr:
         return
-    host, port = addr.rsplit(":", 1)
     try:
-        conn = Client((host, int(port)),
-                      authkey=bytes.fromhex(authkey_hex))
-        try:
-            conn.send(("free", shuffle_id))
-            conn.recv()
-        finally:
-            conn.close()
-    except (OSError, EOFError):
+        with RpcClient(addr, authkey_hex) as c:
+            c.call("free_shuffle", pickle.dumps(shuffle_id), timeout=10)
+    except Exception:
         pass

@@ -1,24 +1,31 @@
-"""Executor worker process entry point.
+"""Executor worker process entry point (gRPC backend).
 
 Role of the reference's CoarseGrainedExecutorBackend.main
 (core/executor/CoarseGrainedExecutorBackend.scala:181 LaunchTask →
-core/executor/Executor.scala TaskRunner): connect back to the driver,
-loop receiving cloudpickled (fn, args) tasks, execute, reply.
+core/executor/Executor.scala TaskRunner): register with the driver over
+the network, serve task-launch RPCs, heartbeat until the driver goes
+away.
 
-Each worker also runs a BLOCK SERVER (role of the executor-side
-shuffle-block transport, common/network-shuffle
+Each worker's single RpcServer also serves the BLOCK plane (role of the
+executor-side shuffle-block transport, common/network-shuffle
 ExternalBlockHandler.java): map-stage outputs persist in this process
 under (shuffle_id, reduce_id) and reducers running on OTHER workers (or
-the driver) fetch them directly over a localhost socket — the driver
-never carries shuffle bytes."""
+the driver) stream them directly in 4 MiB chunks — the driver never
+carries shuffle bytes. Workers are joinable by address: any process that
+can reach the driver's control endpoint and knows the cluster secret may
+register (the standalone Worker/ExternalShuffleService deployment
+model), which is what the two-"host" cluster test exercises.
+"""
 
 from __future__ import annotations
 
 import os
-import sys
+import pickle
 import threading
+import time
 import traceback
-from multiprocessing.connection import Client, Listener
+
+from ..net.transport import CHUNK_BYTES, RpcClient, RpcServer
 
 # (shuffle_id, reduce_id) → Arrow IPC bytes; lives for the worker process
 BLOCK_STORE: dict = {}
@@ -31,52 +38,91 @@ def put_block(shuffle_id: str, reduce_id: int, data: bytes) -> None:
         BLOCK_STORE[(shuffle_id, reduce_id)] = data
 
 
-def _serve_block_conn(conn):
+def _handle_get_block(payload: bytes):
+    sid, rid = pickle.loads(payload)
+    with _STORE_LOCK:
+        data = BLOCK_STORE.get((sid, rid))
+    if data is None:
+        yield b"missing"
+        return
+    yield b"ok"
+    for off in range(0, len(data), CHUNK_BYTES):
+        yield data[off:off + CHUNK_BYTES]
+
+
+def _handle_free_shuffle(payload: bytes) -> bytes:
+    sid = pickle.loads(payload)
+    with _STORE_LOCK:
+        for k in [k for k in BLOCK_STORE if k[0] == sid]:
+            BLOCK_STORE.pop(k, None)
+    return b"ok"
+
+
+def _handle_launch_task(payload: bytes) -> bytes:
+    """Runs one cloudpickled (fn, args) task. Task failures are data
+    (('err', traceback)), not transport errors — a deterministic task
+    error must not look like an executor loss to the driver."""
+    import cloudpickle
+
     try:
+        fn, args = cloudpickle.loads(payload)
+        result = fn(*args)
+        return pickle.dumps(("ok", result))
+    except SystemExit:
+        raise
+    except BaseException:
+        return pickle.dumps(("err", traceback.format_exc()))
+
+
+def serve_worker(driver_addr: str, token: str, host_label: str = "localhost",
+                 bind_host: str = "127.0.0.1",
+                 block: bool = True) -> RpcServer:
+    """Start the worker server, register with the driver, heartbeat.
+    Returns the running RpcServer (caller blocks or not via `block`).
+    `bind_host` is bound AND advertised — a worker on another machine
+    passes an IP the driver and peer workers can reach."""
+    global BLOCK_ADDR
+
+    server = RpcServer(token, host=bind_host)
+    server.register("launch_task", _handle_launch_task)
+    server.register("free_shuffle", _handle_free_shuffle)
+    server.register("ping", lambda _p: b"pong")
+    server.register_stream("get_block", _handle_get_block)
+    addr = server.start()
+    BLOCK_ADDR = addr
+
+    driver = RpcClient(driver_addr, token)
+    driver.wait_ready()
+
+    def register() -> str:
+        return driver.call("register_executor", pickle.dumps({
+            "addr": addr, "host": host_label, "pid": os.getpid()}),
+            timeout=10).decode()
+
+    eid = register()
+
+    def heartbeat_loop():
+        nonlocal eid
+        misses = 0
         while True:
+            time.sleep(3.0)
             try:
-                msg = conn.recv()
-            except (EOFError, OSError):
-                return
-            op = msg[0]
-            if op == "get":
-                _, sid, rid = msg
-                with _STORE_LOCK:
-                    data = BLOCK_STORE.get((sid, rid))
-                if data is None:
-                    conn.send(("missing", None))
-                else:
-                    conn.send(("ok", data))
-            elif op == "free":
-                _, sid = msg
-                with _STORE_LOCK:
-                    for k in [k for k in BLOCK_STORE if k[0] == sid]:
-                        BLOCK_STORE.pop(k, None)
-                conn.send(("ok", None))
-            else:
-                conn.send(("err", f"unknown op {op!r}"))
-    finally:
-        try:
-            conn.close()
-        except Exception:
-            pass
+                reply = driver.call("heartbeat", eid.encode(), timeout=5)
+                misses = 0
+                if reply == b"unknown":
+                    # driver declared us lost (e.g. one transient task
+                    # RPC failure) — re-register under a fresh id, the
+                    # reference's "executor told to re-register" path
+                    eid = register()
+            except Exception:
+                misses += 1
+                if misses >= 5:  # driver gone — shut down
+                    os._exit(0)
 
-
-def _block_server(authkey: bytes) -> str:
-    listener = Listener(("127.0.0.1", 0), authkey=authkey)
-
-    def loop():
-        while True:
-            try:
-                conn = listener.accept()
-            except OSError:
-                return
-            threading.Thread(target=_serve_block_conn, args=(conn,),
-                             daemon=True).start()
-
-    threading.Thread(target=loop, daemon=True).start()
-    host, port = listener.address
-    return f"{host}:{port}"
+    threading.Thread(target=heartbeat_loop, daemon=True).start()
+    if block:
+        threading.Event().wait()
+    return server
 
 
 def main() -> None:
@@ -85,31 +131,11 @@ def main() -> None:
     # state THERE so both sides share one dict/address
     from spark_tpu.exec import worker_main as canonical
 
-    addr_s = os.environ["SPARK_TPU_WORKER_ADDR"]
-    host, port = addr_s.rsplit(":", 1)
-    authkey = bytes.fromhex(os.environ["SPARK_TPU_WORKER_KEY"])
-    canonical.BLOCK_ADDR = canonical._block_server(authkey)
-    conn = Client((host, int(port)), authkey=authkey)
-    conn.send(("block_addr", canonical.BLOCK_ADDR))
-
-    import cloudpickle
-
-    while True:
-        try:
-            payload = conn.recv_bytes()
-        except (EOFError, OSError):
-            return
-        try:
-            fn, args = cloudpickle.loads(payload)
-            result = fn(*args)
-            conn.send(("ok", result))
-        except SystemExit:
-            raise
-        except BaseException:
-            try:
-                conn.send(("err", traceback.format_exc()))
-            except Exception:
-                return
+    canonical.serve_worker(
+        os.environ["SPARK_TPU_DRIVER_ADDR"],
+        os.environ["SPARK_TPU_WORKER_KEY"],
+        os.environ.get("SPARK_TPU_WORKER_HOST", "localhost"),
+        os.environ.get("SPARK_TPU_BIND_HOST", "127.0.0.1"))
 
 
 if __name__ == "__main__":
