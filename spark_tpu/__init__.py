@@ -1,11 +1,43 @@
 """spark_tpu — a TPU-native large-scale analytics engine with Apache Spark's
 capabilities, built on JAX/XLA (see SURVEY.md for the architecture map against
-the reference)."""
+the reference).
+
+Exports resolve lazily (PEP 562) so engine-free subpackages — the Connect
+thin client (`spark_tpu.connect.client`) and the network transport
+(`spark_tpu.net`) — can be imported without dragging in jax or the SQL
+engine, mirroring the reference's sql/api vs sql/core split where the
+Connect client depends only on the interface layer."""
 
 __version__ = "0.1.0"
 
-from .api.session import SparkSession, TpuSession  # noqa: F401
-from .api.dataframe import DataFrame, Row  # noqa: F401
-from .api.column import Column  # noqa: F401
-from .errors import AnalysisException, ParseException, SparkTpuError  # noqa: F401
-from . import types  # noqa: F401
+_EXPORTS = {
+    "SparkSession": ".api.session",
+    "TpuSession": ".api.session",
+    "DataFrame": ".api.dataframe",
+    "Row": ".api.dataframe",
+    "Column": ".api.column",
+    "AnalysisException": ".errors",
+    "ParseException": ".errors",
+    "SparkTpuError": ".errors",
+}
+
+__all__ = [*_EXPORTS, "types"]
+
+
+def __getattr__(name):
+    import importlib
+
+    if name == "types":
+        mod = importlib.import_module(".types", __name__)
+        globals()[name] = mod
+        return mod
+    home = _EXPORTS.get(name)
+    if home is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    val = getattr(importlib.import_module(home, __name__), name)
+    globals()[name] = val
+    return val
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -1,0 +1,146 @@
+"""Connect thin client: Spark-Connect-style remote DataFrame API.
+
+Role of the reference's pure-Python Connect client
+(python/pyspark/sql/connect/ — a gRPC client mirroring the DataFrame
+API with no JVM/engine dependency): this module imports ONLY stdlib,
+pyarrow, and the engine-free gRPC transport. Plans are declarative JSON
+relation trees with SQL-text expressions; results stream back as Arrow
+IPC batches. A process using this client never imports jax or the
+engine — `tests/test_connect.py` pins that property.
+
+    from spark_tpu.connect.client import ConnectSession
+    spark = ConnectSession("127.0.0.1:15002", token)
+    spark.createDataFrame(arrow_table, "t")
+    rows = spark.sql("SELECT k, sum(v) FROM t GROUP BY k").collect()
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+
+from ..net.transport import RpcClient
+
+_HDR = b"\x00JSON\x00"
+_ERR = b"\x00ERR\x00"
+
+
+class ConnectError(RuntimeError):
+    """Server-side failure executing a remote plan (carries the server
+    traceback so analysis errors read the same as in-process)."""
+
+
+class ConnectSession:
+    """Remote session handle (SparkSession surface, Connect flavor)."""
+
+    def __init__(self, address: str, token: str,
+                 session_id: str | None = None):
+        self._client = RpcClient(address, token)
+        self._client.wait_ready()
+        self.session_id = session_id or uuid.uuid4().hex
+
+    # -- plumbing ------------------------------------------------------
+    def _command(self, op: str, tail: bytes = b"", **kw) -> dict:
+        req = {"op": op, "session_id": self.session_id, **kw}
+        raw = self._client.call(
+            "command", json.dumps(req).encode() + _HDR + tail, timeout=600)
+        head, _, _ = raw.partition(_HDR)
+        return json.loads(head.decode())
+
+    def _execute(self, plan: dict):
+        import pyarrow as pa
+
+        req = {"session_id": self.session_id, "plan": plan}
+        frames = self._client.stream(
+            "execute_plan", json.dumps(req).encode(), timeout=600)
+        head = next(frames, None)
+        if head != b"ok":
+            detail = (head or b"")[len(_ERR):].decode(errors="replace")
+            raise ConnectError(detail or "empty response")
+        raw = b"".join(frames)
+        return pa.ipc.open_stream(pa.BufferReader(raw)).read_all()
+
+    # -- session surface -----------------------------------------------
+    def sql(self, query: str) -> "ConnectDataFrame":
+        return ConnectDataFrame(self, {"op": "sql", "query": query})
+
+    def table(self, name: str) -> "ConnectDataFrame":
+        return ConnectDataFrame(self, {"op": "table", "name": name})
+
+    def createDataFrame(self, arrow_table,
+                        view_name: str | None = None) -> "ConnectDataFrame":
+        """Upload a pyarrow table; registered server-side as a temp view
+        (the LocalRelation/artifact-upload path)."""
+        import pyarrow as pa
+
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, arrow_table.schema) as w:
+            w.write_table(arrow_table)
+        out = self._command("upload", tail=sink.getvalue().to_pybytes(),
+                            name=view_name)
+        return self.table(out["name"])
+
+    def close(self) -> None:
+        try:
+            self._command("close_session")
+        finally:
+            self._client.close()
+
+    stop = close
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class ConnectDataFrame:
+    """Lazy remote plan (Dataset surface, Connect flavor)."""
+
+    def __init__(self, session: ConnectSession, plan: dict):
+        self._session = session
+        self._plan = plan
+
+    # -- transformations (build the plan client-side) -------------------
+    def selectExpr(self, *exprs: str) -> "ConnectDataFrame":
+        return ConnectDataFrame(self._session, {
+            "op": "project", "exprs": list(exprs), "child": self._plan})
+
+    select = selectExpr  # SQL-text expressions are the client's Column
+
+    def filter(self, condition: str) -> "ConnectDataFrame":
+        return ConnectDataFrame(self._session, {
+            "op": "filter", "condition": condition, "child": self._plan})
+
+    where = filter
+
+    def limit(self, n: int) -> "ConnectDataFrame":
+        return ConnectDataFrame(self._session, {
+            "op": "limit", "n": n, "child": self._plan})
+
+    # -- actions --------------------------------------------------------
+    def toArrow(self):
+        return self._session._execute(self._plan)
+
+    def collect(self) -> list[dict]:
+        return self.toArrow().to_pylist()
+
+    def count(self) -> int:
+        out = ConnectDataFrame(self._session, {
+            "op": "project", "exprs": ["count(*) AS count"],
+            "child": self._plan}).toArrow()
+        return out["count"][0].as_py()
+
+    def schema(self) -> list[tuple]:
+        out = self._session._command("schema", plan=self._plan)
+        return [tuple(f) for f in out["fields"]]
+
+    def explain(self, extended: bool = False) -> None:
+        out = self._session._command("explain", plan=self._plan,
+                                     extended=extended)
+        print(out["plan"])
+
+    def createOrReplaceTempView(self, name: str) -> None:
+        self._session._command("create_view", plan=self._plan, name=name)

@@ -1,0 +1,195 @@
+"""Connect server: remote SQL execution over the gRPC transport.
+
+Role of the reference's Spark Connect service
+(sql/connect/server/src/main/scala/org/apache/spark/sql/connect/service/SparkConnectService.scala:59
+executePlan, and SparkConnectPlanner converting proto plans to Catalyst
+trees): a long-lived server process owns the engine; thin clients ship a
+declarative PLAN — never code — and receive Arrow IPC result batches
+streamed back. Departures from the reference, deliberate and TPU-first:
+
+* Plan wire format is JSON (relations.proto role) with SQL-text
+  expressions: the engine's own parser plays the role of the proto
+  expression tree decoder, so the client needs zero engine code and the
+  schema stays readable. An upload carries Arrow IPC bytes after the
+  JSON header (the LocalRelation / artifact-upload path).
+* One engine TpuSession per (user-supplied) remote session id, created
+  on first use and closed on release — SessionHolder semantics. All
+  sessions share the server process's device runtime, which is exactly
+  the TPU deployment shape: the chip belongs to the server.
+
+Wire protocol (over spark_tpu.net.transport, auth token per cluster):
+  execute_plan   stream: req = json(plan);  frames = b"ok", ipc chunks…
+                 or a single b"\\x00ERR\\x00" + traceback frame
+  command        unary:  req = json + optional binary tail; resp = json
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+
+from ..net.transport import CHUNK_BYTES, RpcServer
+
+_HDR = b"\x00JSON\x00"  # separates json header from binary tail
+_ERR = b"\x00ERR\x00"
+
+
+def pack(obj: dict, tail: bytes = b"") -> bytes:
+    return json.dumps(obj).encode() + _HDR + tail
+
+
+def unpack(payload: bytes) -> tuple[dict, bytes]:
+    head, _, tail = payload.partition(_HDR)
+    return json.loads(head.decode()), tail
+
+
+class ConnectServer:
+    """Plans and executes client plans against per-session engines."""
+
+    def __init__(self, conf: dict | None = None, token: str | None = None,
+                 host: str = "127.0.0.1"):
+        self.token = token or uuid.uuid4().hex
+        self.conf = dict(conf or {})
+        self._sessions: dict = {}
+        self._lock = threading.Lock()
+        self._server = RpcServer(self.token, host=host)
+        self._server.register("command", self._on_command)
+        self._server.register_stream("execute_plan", self._on_execute)
+        self.address = ""
+
+    def start(self) -> str:
+        self.address = self._server.start()
+        return self.address
+
+    def stop(self) -> None:
+        with self._lock:
+            sessions = list(self._sessions.values())
+            self._sessions.clear()
+        for s in sessions:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        self._server.stop()
+
+    # ------------------------------------------------------------------
+    def _session(self, session_id: str):
+        from ..api.session import TpuSession
+
+        with self._lock:
+            s = self._sessions.get(session_id)
+            if s is None:
+                s = TpuSession(f"connect-{session_id[:8]}", dict(self.conf))
+                self._sessions[session_id] = s
+        return s
+
+    def _plan_to_df(self, session, plan: dict):
+        """JSON relation tree → engine DataFrame (SparkConnectPlanner
+        role). Expression payloads are SQL text resolved by the engine's
+        own parser."""
+        op = plan["op"]
+        if op == "sql":
+            return session.sql(plan["query"])
+        if op == "table":
+            return session.table(plan["name"])
+        if op == "project":
+            return self._plan_to_df(session, plan["child"]) \
+                .selectExpr(*plan["exprs"])
+        if op == "filter":
+            return self._plan_to_df(session, plan["child"]) \
+                .filter(plan["condition"])
+        if op == "limit":
+            return self._plan_to_df(session, plan["child"]) \
+                .limit(int(plan["n"]))
+        raise ValueError(f"unknown relation op {op!r}")
+
+    # ------------------------------------------------------------------
+    def _on_execute(self, payload: bytes):
+        import traceback
+
+        try:
+            req, _ = unpack(payload)
+            session = self._session(req["session_id"])
+            table = self._plan_to_df(session, req["plan"]).toArrow()
+            import pyarrow as pa
+
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, table.schema) as w:
+                w.write_table(table)
+            raw = sink.getvalue().to_pybytes()
+        except Exception:
+            yield _ERR + traceback.format_exc().encode()
+            return
+        yield b"ok"
+        for off in range(0, len(raw), CHUNK_BYTES):
+            yield raw[off:off + CHUNK_BYTES]
+
+    def _on_command(self, payload: bytes) -> bytes:
+        req, tail = unpack(payload)
+        op = req["op"]
+        if op == "ping":
+            return pack({"status": "ok"})
+        session = self._session(req["session_id"])
+        if op == "upload":
+            import pyarrow as pa
+
+            table = pa.ipc.open_stream(pa.BufferReader(tail)).read_all()
+            name = req.get("name") or f"upload_{uuid.uuid4().hex[:8]}"
+            session.createDataFrame(table).createOrReplaceTempView(name)
+            return pack({"status": "ok", "name": name})
+        if op == "create_view":
+            df = self._plan_to_df(session, req["plan"])
+            df.createOrReplaceTempView(req["name"])
+            return pack({"status": "ok"})
+        if op == "sql_command":
+            # DDL/DML path: execute for effect, return row count only
+            out = session.sql(req["query"])
+            try:
+                n = out.toArrow().num_rows
+            except Exception:
+                n = 0
+            return pack({"status": "ok", "rows": n})
+        if op == "explain":
+            df = self._plan_to_df(session, req["plan"])
+            mode = "extended" if req.get("extended") else "formatted"
+            text = df.query_execution.explain_string(mode)
+            return pack({"status": "ok", "plan": text})
+        if op == "schema":
+            df = self._plan_to_df(session, req["plan"])
+            return pack({"status": "ok",
+                         "fields": [(a.name, str(a.dtype)) for a in
+                                    df.query_execution.analyzed.output]})
+        if op == "close_session":
+            with self._lock:
+                s = self._sessions.pop(req["session_id"], None)
+            if s is not None:
+                s.stop()
+            return pack({"status": "ok"})
+        raise ValueError(f"unknown command {op!r}")
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="spark_tpu Connect server (Spark Connect role)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--token", default=None,
+                   help="cluster secret; generated if omitted")
+    p.add_argument("--conf", action="append", default=[],
+                   metavar="K=V", help="engine conf entries")
+    args = p.parse_args(argv)
+    conf = dict(kv.split("=", 1) for kv in args.conf)
+    server = ConnectServer(conf, token=args.token, host=args.host)
+    addr = server.start()
+    print(json.dumps({"address": addr, "token": server.token}), flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -403,6 +403,34 @@ def _contains_agg(e: Expression) -> bool:
                if isinstance(c, Expression))
 
 
+class GlobalAggregates(Rule):
+    """Project whose list contains an aggregate function (outside any
+    window expression) becomes a global Aggregate with no grouping —
+    df.select(count("*")) / selectExpr("sum(x)") parity (reference:
+    sqlcat/analysis/Analyzer.scala GlobalAggregates)."""
+
+    def apply(self, plan):
+        def has_plain_agg(e) -> bool:
+            from ..expr.window import (
+                UnresolvedWindowExpression, WindowExpression,
+            )
+
+            if isinstance(e, (WindowExpression, UnresolvedWindowExpression)):
+                return False  # window aggregates aggregate per-row
+            if isinstance(e, AggregateFunction):
+                return True
+            return any(has_plain_agg(c) for c in e.children
+                       if isinstance(c, Expression))
+
+        def rule(node):
+            if isinstance(node, Project) and \
+                    any(has_plain_agg(e) for e in node.project_list):
+                return Aggregate([], list(node.project_list), node.child)
+            return node
+
+        return plan.transform_up(rule)
+
+
 class ResolveAggsInSortHaving(Rule):
     """Resolve HAVING filters and ORDER BY over an Aggregate: references to
     aggregate results resolve to output attrs; bare aggregate functions get
@@ -1030,6 +1058,7 @@ class Analyzer(RuleExecutor):
                 ResolveReferences(cs),
                 ResolveGroupByAlias(cs),
                 ResolveSubqueries(self),
+                GlobalAggregates(),
                 ResolveAggsInSortHaving(cs),
                 ResolveSortHiddenRefs(cs),
                 ExtractGenerators(),
@@ -1059,6 +1088,7 @@ class Analyzer(RuleExecutor):
             ResolveReferences(cs),
             ResolveGroupByAlias(cs),
             ResolveSubqueries(self),
+            GlobalAggregates(),
             ResolveAggsInSortHaving(cs),
             ResolveSortHiddenRefs(cs),
             ExtractGenerators(),
