@@ -98,6 +98,7 @@ class TpuSession:
         self._analyzer = Analyzer(self.catalog_, self.conf.case_sensitive)
         self._optimizer = Optimizer()
         self._metrics = Metrics()
+        self._table_stats: dict[str, Any] = {}  # ANALYZE TABLE output
         self._cached: dict[int, Any] = {}
         self._streams: list = []
         from ..exec.listener import EventLoggingListener, ListenerBus
@@ -134,13 +135,60 @@ class TpuSession:
 
     def sql(self, query: str, **kwargs):
         from ..plan.commands import Command, run_command
+        from ..plan.logical import WithCTE
         from ..sql.parser import parse_sql
         from .dataframe import DataFrame
 
         plan = parse_sql(query)
         if isinstance(plan, Command):
             return run_command(self, plan)
+        if isinstance(plan, WithCTE):
+            plan = self._materialize_ctes(plan)
         return DataFrame(self, plan)
+
+    def _materialize_ctes(self, wplan):
+        """Execute each multiply-referenced CTE once and splice the
+        result into every call site as an in-memory relation (WithCTE /
+        CTERelationRef role — see plan/logical.py WithCTE). Every splice
+        site gets FRESH attribute ids over the SHARED source: a
+        correlated subquery referencing the same CTE as its outer query
+        (q1/q30's ctr1/ctr2) must see distinct ids or decorrelation
+        cannot tell inner from outer."""
+        from .dataframe import DataFrame
+
+        mapping = {}
+        for uniq, body in wplan.materializations:
+            body = self._splice_relations(body, mapping)
+            table = DataFrame(self, body).toArrow()
+            rel = self.createDataFrame(table).plan
+            mapping[uniq.lower()] = rel
+        return self._splice_relations(wplan.child, mapping)
+
+    def _splice_relations(self, plan, mapping):
+        from ..expr.expressions import AttributeReference
+        from ..plan import logical as L
+        from ..plan.subquery import SubqueryExpression
+
+        def fresh(rel):
+            attrs = [AttributeReference(a.name, a.dtype, a.nullable)
+                     for a in rel.output]
+            if isinstance(rel, L.LocalRelation):
+                return L.LocalRelation(attrs, rel.table)
+            return L.LogicalRelation(rel.source, attrs, rel.name)
+
+        def fix_expr(ex):
+            if isinstance(ex, SubqueryExpression):
+                return ex.copy(plan=self._splice_relations(ex.plan, mapping))
+            return ex
+
+        def rule(node):
+            if isinstance(node, L.UnresolvedRelation):
+                rel = mapping.get(node.name.lower())
+                if rel is not None:
+                    return fresh(rel)
+            return node.map_expressions(lambda e: e.transform_up(fix_expr))
+
+        return plan.transform_up(rule)
 
     def range(self, start: int, end: int | None = None, step: int = 1,
               numPartitions: int | None = None):
@@ -188,6 +236,14 @@ class TpuSession:
     def catalog(self):
         return _CatalogApi(self)
 
+    def startUI(self, port: int = 0):
+        """Start the live web UI (core/ui/SparkUI.scala role); returns
+        the SparkUI with `.url`."""
+        from ..exec.ui import SparkUI
+
+        self._ui = SparkUI(self, port=port).start()
+        return self._ui
+
     def attachSqlCluster(self, cluster) -> "TpuSession":
         """Route non-result SQL stages to a process cluster
         (exec/cluster_sql.py — the multi-host stage execution contract)."""
@@ -208,6 +264,13 @@ class TpuSession:
         rc = getattr(self, "_rdd_context", None)
         if rc is not None:
             rc.stop()
+        ui = getattr(self, "_ui", None)
+        if ui is not None:
+            try:
+                ui.stop()
+            except Exception:
+                pass
+            self._ui = None
         cl = getattr(self, "_sql_cluster", None)
         if cl is not None:
             try:

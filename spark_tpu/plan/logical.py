@@ -329,6 +329,26 @@ class SubqueryAlias(UnaryNode):
         return self.child.stats_rows()
 
 
+class WithCTE(UnaryNode):
+    """Top-level holder for CTEs the parser chose to MATERIALIZE rather
+    than inline: `materializations` is [(unique_name, plan)] in
+    definition order; `child` references each by its unique name.
+    A CTE instantiated N times would inline its subtree N times — for
+    q64's 18-table cross_sales that doubles an already-huge XLA program.
+    The session executes each plan once and splices the result in as an
+    in-memory relation (role of Spark's WithCTE + CTERelationRef with
+    spark.sql.optimizer.cteInline semantics,
+    sqlcat/optimizer/InlineCTE.scala / plans/logical/ctes.scala)."""
+
+    def __init__(self, materializations, child: LogicalPlan):
+        self.materializations = list(materializations)
+        self.child = child
+
+    @property
+    def output(self):
+        return self.child.output
+
+
 class Repartition(UnaryNode):
     def __init__(self, num_partitions: int | None, shuffle: bool,
                  partition_exprs: Sequence[Expression], child: LogicalPlan):

@@ -998,17 +998,34 @@ class ReorderJoins(Rule):
     simplified): start from the smallest relation and repeatedly attach
     the smallest CONNECTED relation (one sharing a join predicate with
     the rows already joined), so selective dimension tables join before
-    large facts. Fires only when every chain member has a row estimate."""
+    large facts. Members without a row estimate (subquery aggregates)
+    sort last but STILL participate: bailing out kept q64's written
+    order, which crosses two 73k-row date_dim instances before the
+    customer table that connects them — the reference's stats-free
+    ReorderJoin also only needs connectivity (createOrderedJoin)."""
 
     def apply(self, plan):
         def rule(node):
-            if not isinstance(node, Join) or node.join_type != "inner":
+            # Fire on Filter(Join) as well as bare Join: a comma-list
+            # FROM parses as a cross-join chain with EVERY WHERE conjunct
+            # in one Filter above — waiting for pushdown to trickle the
+            # conds onto join nodes leaves the chain looking condition-
+            # less here and q64's 73k×73k date_dim cross in place.
+            # Multi-table conjuncts join the reorder as edges; single-
+            # table ones stay in the Filter for scan pruning/DPP.
+            filter_conds: list[Expression] = []
+            join = node
+            if isinstance(node, Filter) and isinstance(node.child, Join):
+                filter_conds = split_conjuncts(node.condition)
+                join = node.child
+            if not isinstance(join, Join) or \
+                    join.join_type not in ("inner", "cross"):
                 return node
             items: list[LogicalPlan] = []
             conds: list[Expression] = []
 
             def flatten(n):
-                if isinstance(n, Join) and n.join_type == "inner":
+                if isinstance(n, Join) and n.join_type in ("inner", "cross"):
                     flatten(n.left)
                     flatten(n.right)
                     if n.condition is not None:
@@ -1016,39 +1033,128 @@ class ReorderJoins(Rule):
                 else:
                     items.append(n)
 
-            flatten(node)
+            flatten(join)
             if len(items) <= 2:
                 return node
+            single_table: list[Expression] = []
+            if filter_conds:
+                item_ids = [{a.expr_id for a in it.output} for it in items]
+                for c in filter_conds:
+                    refs = c.references()
+                    touched = sum(1 for ids in item_ids if refs & ids)
+                    (conds if touched >= 2 else single_table).append(c)
+            if not conds:
+                # condition-less (pure cross) chain: reordering gains
+                # nothing, and the Project this rule would wrap around a
+                # reordered result fragments the PARENT chain's flatten
+                return node
+            from .stats import Statistics, estimate as _est
+
             ests = {}
+            istats: dict[int, Statistics] = {}
             for it in items:
-                r = it.stats_rows()
-                if r is None:
-                    return node  # no stats → keep the written order
-                ests[id(it)] = r
+                s = _est(it)
+                istats[id(it)] = s
+                ests[id(it)] = float("inf") if s.row_count is None \
+                    else s.row_count
 
             remaining = list(items)
             def _key(x):  # deterministic tie-break → stable fixpoint
                 out0 = x.output[0].expr_id if x.output else 0
                 return (ests[id(x)], out0)
 
-            cur = min(remaining, key=_key)
+            def _pair_cost(a, b) -> float:
+                ra, rb = ests[id(a)], ests[id(b)]
+                if ra == float("inf") or rb == float("inf"):
+                    return float("inf")
+                aids = {x.expr_id for x in a.output}
+                bids = {x.expr_id for x in b.output}
+                denom, connected = 1, False
+                for cd in conds:
+                    refs = cd.references()
+                    if not (refs and refs <= (aids | bids)
+                            and refs & aids and refs & bids):
+                        continue
+                    connected = True
+                    if isinstance(cd, EqualTo):
+                        for side in (cd.left, cd.right):
+                            if isinstance(side, AttributeReference):
+                                for st in (istats[id(a)], istats[id(b)]):
+                                    cs = st.col_stats.get(side.name.lower())
+                                    if cs is not None and cs.distinct_count:
+                                        denom = max(denom,
+                                                    cs.distinct_count)
+                return (ra * rb) / denom if connected else float("inf")
+
+            # seed with the cheapest CONNECTED pair, not the smallest
+            # relation: a small low-ndv table picked first drags its huge
+            # join in as the only connected continuation
+            best, best_cost = None, float("inf")
+            for i, a in enumerate(items):
+                for b in items[i + 1:]:
+                    c = _pair_cost(a, b)
+                    if c < best_cost:
+                        best, best_cost = (a, b), c
+            cur = min(best, key=_key) if best is not None \
+                else min(remaining, key=_key)
             remaining.remove(cur)
             joined_ids = {a.expr_id for a in cur.output}
             unused = list(conds)
             result = cur
+            cur_rows = ests[id(cur)]
+            cur_colstats = dict(istats[id(cur)].col_stats)
+
+            def _joined_rows(cand) -> float:
+                """CBO greedy cost: estimated |result ⋈ cand| using the
+                connecting equi keys' ndv (CostBasedJoinReorder role —
+                without ANALYZE'd ndv this degrades to candidate-size
+                order, the stats-free ReorderJoin behavior)."""
+                crows = ests[id(cand)]
+                if cur_rows == float("inf") or crows == float("inf"):
+                    return crows
+                cstats = istats[id(cand)].col_stats
+                cids = {a.expr_id for a in cand.output}
+                denom = 1
+                for cd in unused:
+                    if not isinstance(cd, EqualTo):
+                        continue
+                    refs = cd.references()
+                    if not (refs and refs <= (joined_ids | cids)
+                            and refs & joined_ids and refs & cids):
+                        continue
+                    for side in (cd.left, cd.right):
+                        if isinstance(side, AttributeReference):
+                            cs = (cstats.get(side.name.lower())
+                                  or cur_colstats.get(side.name.lower()))
+                            if cs is not None and cs.distinct_count:
+                                denom = max(denom, cs.distinct_count)
+                return (cur_rows * crows) / max(denom, 1)
+
             while remaining:
-                def connects(cand):
+                def connects(cand, equi_only: bool):
                     cids = {a.expr_id for a in cand.output}
                     for cd in unused:
+                        if equi_only and not isinstance(cd, EqualTo):
+                            continue
                         refs = cd.references()
                         if refs and refs <= (joined_ids | cids) \
                                 and refs & joined_ids and refs & cids:
                             return True
                     return False
 
-                cands = [r for r in remaining if connects(r)]
-                pick = min(cands or remaining, key=_key)
+                # equi-connected candidates FIRST: a candidate linked only
+                # by a non-equality predicate (q64: cd1.x <> cd2.x) would
+                # otherwise be attached as a near-cartesian nested-loop
+                # join; the equality chain keeps every step hash-joinable
+                # (reference: ReorderJoin createOrderedJoin considers
+                # equi-join conditions)
+                cands = [r for r in remaining if connects(r, True)] or \
+                        [r for r in remaining if connects(r, False)]
+                pool = cands or remaining
+                pick = min(pool, key=lambda x: (_joined_rows(x), _key(x)))
                 remaining.remove(pick)
+                cur_rows = _joined_rows(pick)
+                cur_colstats.update(istats[id(pick)].col_stats)
                 pick_ids = {a.expr_id for a in pick.output}
                 joined_ids |= pick_ids
                 applicable = [cd for cd in unused
@@ -1057,8 +1163,9 @@ class ReorderJoins(Rule):
                     unused.remove(cd)
                 result = Join(result, pick, "inner",
                               join_conjuncts(applicable))
-            if unused:  # conds referencing beyond the chain (shouldn't)
-                result = Filter(join_conjuncts(unused), result)
+            leftover = unused + single_table
+            if leftover:  # single-table conds + any cond beyond the chain
+                result = Filter(join_conjuncts(leftover), result)
             if [a.expr_id for a in result.output] != \
                     [a.expr_id for a in node.output]:
                 result = Project(list(node.output), result)

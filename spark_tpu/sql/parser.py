@@ -76,6 +76,20 @@ class Parser:
             raise ParseException(
                 f"expected {word.upper()} near {self.peek().value!r}")
 
+    def eat_word(self, word: str) -> bool:
+        """Consume a statement word that is not a reserved keyword
+        (ANALYZE/COMPUTE/STATISTICS… lex as plain identifiers)."""
+        t = self.peek()
+        if t.kind in ("kw", "ident") and t.value.lower() == word:
+            self.next()
+            return True
+        return False
+
+    def expect_word(self, word: str) -> None:
+        if not self.eat_word(word):
+            raise ParseException(
+                f"expected {word.upper()} near {self.peek().value!r}")
+
     def at_op(self, *ops: str) -> bool:
         t = self.peek()
         return t.kind == "op" and t.value in ops
@@ -178,6 +192,22 @@ class Parser:
             if analyze or extended:
                 self.next()
             return C.ExplainCommand(self.parse_query(), extended, analyze)
+        if self.peek().value.lower() == "analyze":
+            self.next()
+            self.expect_word("table")
+            name = self._qualified_name()
+            self.expect_word("compute")
+            self.expect_word("statistics")
+            columns = None
+            if self.eat_word("for"):
+                if self.eat_word("all"):
+                    self.expect_word("columns")
+                else:
+                    self.expect_word("columns")
+                    columns = [self.ident()]
+                    while self.eat_op(","):
+                        columns.append(self.ident())
+            return C.AnalyzeTableCommand(name, columns)
         if self.peek().value.lower() == "cache":
             self.next()
             self.expect_kw("table")
@@ -274,24 +304,26 @@ class Parser:
         return ".".join(parts)
 
     def parse_query(self) -> L.LogicalPlan:
-        ctes: dict[str, L.LogicalPlan] = {}
-        if self.eat_kw("with"):
-            while True:
-                name = self.ident()
-                self.expect_kw("as") if self.at_kw("as") else None
-                self.expect_op("(")
-                sub = self.parse_query()
-                self.expect_op(")")
-                # earlier CTEs are visible inside later definitions
-                sub = _substitute_ctes(sub, ctes)
-                ctes[name.lower()] = L.SubqueryAlias(name, sub)
-                if not self.eat_op(","):
-                    break
-        plan = self.parse_set_expr()
-        plan = self._order_limit(plan)
-        if ctes:
-            plan = _substitute_ctes(plan, ctes)
-        return plan
+        depth = getattr(self, "_query_depth", 0)
+        self._query_depth = depth + 1
+        try:
+            defs: list[tuple[str, L.LogicalPlan]] = []
+            if self.eat_kw("with"):
+                while True:
+                    name = self.ident()
+                    self.expect_kw("as") if self.at_kw("as") else None
+                    self.expect_op("(")
+                    defs.append((name, self.parse_query()))
+                    self.expect_op(")")
+                    if not self.eat_op(","):
+                        break
+            plan = self.parse_set_expr()
+            plan = self._order_limit(plan)
+            if defs:
+                plan = _apply_ctes(plan, defs, top_level=(depth == 0))
+            return plan
+        finally:
+            self._query_depth = depth
 
     def parse_set_expr(self) -> L.LogicalPlan:
         left = self.parse_term_query()
@@ -1180,6 +1212,80 @@ def _refresh_alias_ids(plan: L.LogicalPlan) -> L.LogicalPlan:
         return node.map_expressions(lambda ex: ex.transform_up(fresh))
 
     return go(plan)
+
+
+def _count_cte_refs(plan: L.LogicalPlan, name: str) -> int:
+    """Occurrences of UnresolvedRelation(name) in a plan, including
+    inside subquery-expression plans (the same scope _substitute_ctes
+    rewrites)."""
+    from ..plan.subquery import SubqueryExpression
+
+    count = 0
+
+    def visit_plan(p: L.LogicalPlan) -> None:
+        nonlocal count
+        for node in p.iter_nodes():
+            if isinstance(node, L.UnresolvedRelation) and \
+                    node.name.lower() == name:
+                count += 1
+            node.map_expressions(lambda ex: ex.transform_up(visit_expr))
+
+    def visit_expr(ex):
+        if isinstance(ex, SubqueryExpression):
+            visit_plan(ex.plan)
+        return ex
+
+    visit_plan(plan)
+    return count
+
+
+def _cte_expensive(plan: L.LogicalPlan) -> bool:
+    """Worth materializing: joins (each instantiation re-plans and
+    re-compiles the join pipeline) or an aggregate over a join."""
+    joins = sum(1 for n in plan.iter_nodes() if isinstance(n, L.Join))
+    aggs = sum(1 for n in plan.iter_nodes() if isinstance(n, L.Aggregate))
+    return joins >= 2 or (joins >= 1 and aggs >= 1)
+
+
+def _apply_ctes(plan: L.LogicalPlan, defs: list,
+                top_level: bool) -> L.LogicalPlan:
+    """Inline single-use / cheap CTEs; convert multiply-instantiated
+    expensive ones into WithCTE materializations (top-level queries
+    only — a mid-tree WithCTE has no execution point)."""
+    import uuid as _uuid
+
+    # effective instantiation count, later definitions first: a CTE
+    # referenced from an inlined CTE body is instantiated once per
+    # instantiation of THAT body; a materialized body runs once
+    eff: dict[str, int] = {}
+    mat: dict[str, bool] = {}
+    for i in range(len(defs) - 1, -1, -1):
+        name, body = defs[i]
+        key = name.lower()
+        cnt = _count_cte_refs(plan, key)
+        for j in range(i + 1, len(defs)):
+            jname, jbody = defs[j]
+            jkey = jname.lower()
+            mult = 1 if mat.get(jkey) else eff.get(jkey, 0)
+            cnt += _count_cte_refs(jbody, key) * mult
+        eff[key] = cnt
+        mat[key] = bool(top_level and cnt >= 2 and _cte_expensive(body))
+
+    ctes: dict[str, L.LogicalPlan] = {}
+    materializations: list[tuple[str, L.LogicalPlan]] = []
+    for name, body in defs:
+        key = name.lower()
+        body = _substitute_ctes(body, ctes)  # earlier CTEs visible
+        if mat[key]:
+            uniq = f"__cte_mat_{key}_{_uuid.uuid4().hex[:8]}"
+            materializations.append((uniq, body))
+            ctes[key] = L.SubqueryAlias(name, L.UnresolvedRelation([uniq]))
+        else:
+            ctes[key] = L.SubqueryAlias(name, body)
+    plan = _substitute_ctes(plan, ctes)
+    if materializations:
+        plan = L.WithCTE(materializations, plan)
+    return plan
 
 
 def _substitute_ctes(plan: L.LogicalPlan,

@@ -11,10 +11,9 @@ committed tpcds-query-results.
 Regenerate goldens (after datagen/oracle changes):
     SPARK_TPU_REGEN_TPCDS=1 python -m pytest tests/test_tpcds_full.py -q
 
-Queries using ROLLUP/GROUPING() (sqlite can't express them) are
-"exec-tier": they must execute and their committed row-shape is pinned,
-but values are engine-produced (cross-checked between configs), not
-independently verified.
+ROLLUP/GROUPING() queries are oracle-verified too: the rewrite layer
+expands `GROUP BY ROLLUP` into the UNION ALL of grouping-set branches
+sqlite can run (tests/tpcds/oracle.py expand_rollup).
 """
 
 from __future__ import annotations
@@ -32,14 +31,10 @@ GOLDEN_DIR = os.path.join(HERE, "tpcds", "expected")
 SCALE = 0.1
 REGEN = os.environ.get("SPARK_TPU_REGEN_TPCDS") == "1"
 
-# sqlite cannot run ROLLUP/GROUPING() — exec-tier (see module docstring)
-EXEC_ONLY = {"q5", "q14a", "q18", "q22", "q27", "q36", "q67", "q70",
-             "q77", "q80", "q86"}
-# triaged out entirely (engine gap or pathological runtime at any scale);
-# each entry must carry a reason — shrink this set as gaps close
-SKIP: dict[str, str] = {
-    "q64": "kernel-compile blowup on the twice-instantiated 12-table CTE; run separately",
-}
+# empty since r4: ROLLUP queries verify via expand_rollup, q64 runs via
+# CTE materialization (plan/logical.py WithCTE)
+EXEC_ONLY: set[str] = set()
+SKIP: dict[str, str] = {}
 
 ALL_QUERIES = sorted(
     os.path.basename(f)[:-4]

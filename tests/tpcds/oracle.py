@@ -159,9 +159,124 @@ QUERY_PATCHES = {
 }
 
 
+def _matching_paren(sql: str, open_idx: int) -> int:
+    depth = 0
+    for i in range(open_idx, len(sql)):
+        if sql[i] == "(":
+            depth += 1
+        elif sql[i] == ")":
+            depth -= 1
+            if depth == 0:
+                return i
+    raise ValueError("unbalanced parens")
+
+
+def _split_top_commas(text: str) -> list[str]:
+    out, depth, start = [], 0, 0
+    for i, ch in enumerate(text):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            out.append(text[start:i].strip())
+            start = i + 1
+    out.append(text[start:].strip())
+    return out
+
+
+_ROLLUP = re.compile(r"GROUP\s+BY\s+ROLLUP\s*\(", re.I)
+_SELECT_KW = re.compile(r"\bSELECT\b", re.I)
+_FROM_KW = re.compile(r"\bFROM\b", re.I)
+
+
+def _owning_select(sql: str, group_idx: int) -> int:
+    """Index of the SELECT that owns the clause at group_idx: nearest
+    preceding SELECT with zero net paren balance between them."""
+    balance = 0
+    i = group_idx - 1
+    while i >= 0:
+        ch = sql[i]
+        if ch == ")":
+            balance += 1
+        elif ch == "(":
+            balance -= 1
+        elif balance == 0 and sql[i:i + 6].upper() == "SELECT" and \
+                (i == 0 or not (sql[i - 1].isalnum() or sql[i - 1] == "_")):
+            return i
+        i -= 1
+    raise ValueError("no owning SELECT for ROLLUP clause")
+
+
+def expand_rollup(sql: str) -> str:
+    """Rewrite `GROUP BY ROLLUP (c1..cn)` into a UNION ALL of plain
+    GROUP BY prefixes — the GROUPING SETS expansion sqlite cannot do
+    itself — so rollup queries get real oracle verification instead of
+    exec-only pins. Per branch with the first k columns grouped:
+    `grouping(c)` becomes the literal 0/1 and each non-grouped rollup
+    column becomes NULL (aliased when it was a bare select item). The
+    ORDER BY (and anything else after the clause) moves outside a
+    wrapping subselect so output-alias scoping is preserved. Window
+    functions in the select list stay per-branch, which is exact
+    whenever their partition key contains the grouping level (q36/q70/
+    q86 partition on grouping()+grouping()); q67's cross-branch window
+    already lives OUTSIDE the rollup subquery in the committed text.
+    Limitation (unused by q1-q99): a rollup column referenced inside an
+    aggregate argument would be nulled too."""
+    while True:
+        m = _ROLLUP.search(sql)
+        if not m:
+            return sql
+        open_idx = m.end() - 1
+        close_idx = _matching_paren(sql, open_idx)
+        cols = _split_top_commas(sql[open_idx + 1:close_idx])
+        suffix = sql[close_idx + 1:]
+        sel_idx = _owning_select(sql, m.start())
+        prefix = sql[:sel_idx]
+        seg = sql[sel_idx:m.start()]
+        # top-level FROM splits select list from relation/where text
+        depth = 0
+        from_idx = None
+        for fm in _FROM_KW.finditer(seg):
+            depth = seg[:fm.start()].count("(") - seg[:fm.start()].count(")")
+            if depth == 0:
+                from_idx = fm.start()
+                break
+        if from_idx is None:
+            raise ValueError("ROLLUP select without top-level FROM")
+        select_list = seg[len("SELECT"):from_idx]
+        body = seg[from_idx:]
+        items = _split_top_commas(select_list)
+
+        def branch(k: int) -> str:
+            grouped = set(cols[:k])
+            out_items = []
+            for item in items:
+                t = item
+                for c in cols:
+                    t = re.sub(r"grouping\s*\(\s*%s\s*\)" % re.escape(c),
+                               "0" if c in grouped else "1", t, flags=re.I)
+                for c in cols[k:]:
+                    if re.fullmatch(re.escape(c), t.strip(), re.I):
+                        t = f"NULL AS {c}"
+                    else:
+                        t = re.sub(r"\b%s\b" % re.escape(c), "NULL", t,
+                                   flags=re.I)
+                out_items.append(t)
+            b = "SELECT " + ", ".join(out_items) + " " + body
+            if k:
+                b += " GROUP BY " + ", ".join(cols[:k])
+            return b
+
+        union = " UNION ALL ".join(branch(k)
+                                   for k in range(len(cols), -1, -1))
+        sql = prefix + "SELECT * FROM (" + union + ") rollup_u " + suffix
+
+
 def rewrite_for_sqlite(sql: str, qname: str | None = None) -> str:
     for old, new in QUERY_PATCHES.get(qname or "", []):
         sql = sql.replace(old, new)
+    sql = expand_rollup(sql)
     sql = _INTERVAL.sub(lambda m: f"date('{m.group(1)}', "
                         f"'{m.group(2)}{m.group(3)} day')", sql)
     sql = _INTERVAL_COL.sub(lambda m: f"date({m.group(1)}, "
