@@ -131,6 +131,19 @@ MINMAX_JOIN_FILTER = _register(ConfigEntry(
     "spark.tpu.join.runtimeFilter", False,
     "Min-max runtime join filter on single integral keys.", _bool))
 
+SPECULATION = _register(ConfigEntry(
+    "spark.speculation", False,
+    "Re-launch straggler host tasks on another executor; first success "
+    "wins, file commits arbitrated by the OutputCommitCoordinator "
+    "(reference: TaskSetManager.scala:80-88).", _bool))
+
+STATE_STORE_PARTITIONS = _register(ConfigEntry(
+    "spark.sql.streaming.stateStore.numPartitions", 4,
+    "Hash partitions for streaming state: each partition keeps its own "
+    "snapshot+changelog lineage and a batch persists only touched "
+    "partitions (reference: per-partition StateStore instances, "
+    "sqlx/streaming/state/StateStore.scala:285).", int))
+
 CODEGEN_CACHE_SIZE = _register(ConfigEntry(
     "spark.tpu.kernel.cacheSize", 1024,
     "Max entries in the jitted-kernel cache (role of the reference's "

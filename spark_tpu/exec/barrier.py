@@ -1,0 +1,98 @@
+"""Barrier execution: gang-synchronised host-side stages.
+
+Role of the reference's barrier mode (core/rdd/RDDBarrier.scala:33,
+core/BarrierTaskContext.scala barrier():328 / allGather(), coordinated
+by core/BarrierCoordinator.scala on the driver). On a TPU mesh every
+pjit program is already gang-scheduled SPMD — the barrier API exists
+for HOST phases (data loading, shuffle rendezvous, parameter servers)
+that must sync across executor processes. The sync itself is a driver
+RPC: all tasks of a stage post their message and block until the full
+gang arrives, exactly the reference's RequestToSync/allGather protocol.
+"""
+
+from __future__ import annotations
+
+import pickle
+import uuid
+
+from ..net.transport import RpcClient
+
+
+class BarrierTaskContext:
+    """Handle given to each task of a barrier stage."""
+
+    def __init__(self, driver_addr: str, token: str, barrier_id: str,
+                 task_id: int, num_tasks: int, timeout: float = 60.0):
+        self.barrier_id = barrier_id
+        self.task_id = task_id
+        self.num_tasks = num_tasks
+        self.timeout = timeout
+        self._driver_addr = driver_addr
+        self._token = token
+        self._round = 0  # each sync is its own epoch server-side
+
+    def _sync(self, message) -> list:
+        # the round number keys a FRESH server-side rendezvous per sync:
+        # a fast task entering sync N+1 while a slow one is still
+        # returning from sync N must not collide with (or reset) N's
+        # state (reference: BarrierCoordinator's ContextBarrierState
+        # tracks barrierEpoch the same way)
+        key = f"{self.barrier_id}#{self._round}"
+        self._round += 1
+        with RpcClient(self._driver_addr, self._token) as c:
+            raw = c.call("barrier_sync", pickle.dumps(
+                (key, self.task_id, self.num_tasks, message,
+                 self.timeout)), timeout=self.timeout + 10)
+        return pickle.loads(raw)
+
+    def barrier(self) -> None:
+        """Block until every task of the stage reaches this call."""
+        self._sync(None)
+
+    def allGather(self, message) -> list:
+        """Block until all tasks post, then return all messages ordered
+        by task id."""
+        return self._sync(message)
+
+
+def _barrier_task(fn_payload: bytes, driver_addr: str, token: str,
+                  barrier_id: str, task_id: int, num_tasks: int):
+    """Worker-side wrapper: rebuild the context, run the user fn."""
+    import cloudpickle
+
+    fn = cloudpickle.loads(fn_payload)
+    ctx = BarrierTaskContext(driver_addr, token, barrier_id, task_id,
+                             num_tasks)
+    return fn(ctx)
+
+
+def run_barrier_job(cluster, fn, num_tasks: int) -> list:
+    """Launch fn(ctx) as a gang of num_tasks tasks, one per executor,
+    all running simultaneously (RDDBarrier.mapPartitions contract: the
+    whole gang or nothing). Returns results ordered by task id."""
+    import cloudpickle
+    from concurrent.futures import ThreadPoolExecutor
+
+    if cluster.num_alive() < num_tasks:
+        raise RuntimeError(
+            f"barrier stage needs {num_tasks} executors, "
+            f"{cluster.num_alive()} alive")  # reference: barrier stages
+        # require slots ≥ tasks up front (SPARK-24819)
+    if num_tasks > 32:
+        # each waiting gang member parks one driver RPC server thread
+        # (pool of 64, shared with heartbeats) — a larger gang would
+        # starve the pool and never release
+        raise RuntimeError("barrier gangs are limited to 32 tasks")
+    bid = uuid.uuid4().hex[:12]
+    payload = cloudpickle.dumps(fn)
+    gang = cluster.alive_workers()[:num_tasks]
+
+    def one(tid: int):
+        # one DISTINCT executor per gang member: two members sharing a
+        # worker slot would deadlock at the sync point
+        return cluster.run_task_on(
+            gang[tid], _barrier_task, payload, cluster.driver_addr,
+            cluster.token, bid, tid, num_tasks)
+
+    with ThreadPoolExecutor(max_workers=num_tasks) as pool:
+        return list(pool.map(one, range(num_tasks)))

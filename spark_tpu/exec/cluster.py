@@ -56,10 +56,26 @@ class _Worker:
         self.pid = pid
         self.proc = proc
         self.lock = threading.Lock()  # one in-flight task per slot
+        self.busy = False
+        self.idle_since = time.monotonic()
 
-    def run(self, payload: bytes) -> Any:
-        with self.lock:
-            raw = self.client.call("launch_task", payload)
+    def try_acquire(self) -> bool:
+        if self.lock.acquire(blocking=False):
+            self.busy = True
+            return True
+        return False
+
+    def release(self) -> None:
+        self.busy = False
+        self.idle_since = time.monotonic()
+        try:
+            self.lock.release()
+        except RuntimeError:
+            pass
+
+    def run_locked(self, payload: bytes) -> Any:
+        """Execute with the slot already held by the caller."""
+        raw = self.client.call("launch_task", payload)
         try:
             status, result = pickle.loads(raw)
         except Exception as e:
@@ -67,6 +83,15 @@ class _Worker:
         if status == "err":
             raise RemoteTaskError(result)
         return result
+
+    def run(self, payload: bytes) -> Any:
+        """Acquire the slot (blocking), execute, release."""
+        self.lock.acquire()
+        self.busy = True
+        try:
+            return self.run_locked(payload)
+        finally:
+            self.release()
 
     def close(self):
         self.client.close()
@@ -102,27 +127,80 @@ def worker_env(driver_addr: str, token: str,
 class LocalCluster:
     """Spawns num_workers executor processes and schedules tasks on them.
     More executors — including ones labeled as other "hosts" — may join
-    at any time via the driver address + secret."""
+    at any time via the driver address + secret. With
+    dynamic_allocation=True an allocation thread grows the pool when
+    tasks back up behind busy executors and retires idle ones back to
+    num_workers (role of core/ExecutorAllocationManager.scala:102 —
+    backlog-driven scale-out, idle-timeout scale-in)."""
 
     def __init__(self, num_workers: int = 2, max_task_failures: int = 3,
-                 bind_host: str = "127.0.0.1"):
+                 bind_host: str = "127.0.0.1",
+                 speculation: bool = False,
+                 speculation_multiplier: float = 1.5,
+                 speculation_interval: float | None = None,
+                 dynamic_allocation: bool = False,
+                 max_workers: int | None = None,
+                 executor_idle_timeout: float = 10.0,
+                 shuffle_service: bool = False):
         self.max_task_failures = max_task_failures
         self.registry = ExecutorRegistry()
         self.health = HealthTracker(self.registry, max_failures=2)
         self.token = secrets.token_hex(16)
         self.bind_host = bind_host
+        # speculative execution (TaskSetManager.scala:80-88 checkSpeculatableTasks
+        # role): when a task runs longer than multiplier × median of
+        # completed tasks (or the fixed interval), a second copy launches
+        # on another executor; first success wins. Exactly-one-commit for
+        # file outputs is the OutputCommitCoordinator's job (io/commit.py).
+        self.speculation = speculation
+        self.speculation_multiplier = speculation_multiplier
+        self.speculation_interval = speculation_interval
+        self._durations: list[float] = []
+        self.stats: dict[str, int] = {}
         self._workers: dict[str, _Worker] = {}
         self._rr = 0
         self._lock = threading.Lock()
         self._joined = threading.Condition(self._lock)
+        self._slot_free = threading.Condition()
+        self._barriers: dict[str, dict] = {}
+        self._barrier_cv = threading.Condition()
 
-        self._server = RpcServer(self.token, host=bind_host)
+        # 64 handler threads: barrier_sync PARKS a thread per waiting gang
+        # member (see _on_barrier), and heartbeats must still get served
+        # while a gang waits — run_barrier_job caps gangs at half this
+        self._server = RpcServer(self.token, host=bind_host,
+                                 max_workers=64)
         self._server.register("register_executor", self._on_register)
         self._server.register("heartbeat", self._on_heartbeat)
+        self._server.register("barrier_sync", self._on_barrier)
         self.driver_addr = self._server.start()
+
+        # external shuffle service: blocks survive executor loss
+        # (exec/shuffle_service.py; ExternalShuffleService.scala role)
+        self.shuffle_service = None
+        self.shuffle_service_addr: str | None = None
+        self._shuffle_dir: str | None = None
+        if shuffle_service:
+            import tempfile
+
+            from .shuffle_service import ExternalShuffleService
+
+            self._shuffle_dir = tempfile.mkdtemp(prefix="sparktpu-shuffle-")
+            self.shuffle_service = ExternalShuffleService(
+                self._shuffle_dir, self.token, host=bind_host)
+            self.shuffle_service_addr = self.shuffle_service.start()
 
         procs = [self._spawn() for _ in range(num_workers)]
         self._await_workers(num_workers, procs)
+
+        self.min_workers = num_workers
+        self.max_workers = max_workers or num_workers * 4
+        self.idle_timeout = executor_idle_timeout
+        self._active_tasks = 0
+        self._stopping = False
+        if dynamic_allocation:
+            threading.Thread(target=self._allocation_loop,
+                             daemon=True).start()
 
     # -- control-plane handlers (run on server threads) -----------------
     def _on_register(self, payload: bytes) -> bytes:
@@ -150,9 +228,12 @@ class LocalCluster:
 
     # ------------------------------------------------------------------
     def _spawn(self, host_label: str = "localhost") -> subprocess.Popen:
+        env = worker_env(self.driver_addr, self.token, host_label,
+                         bind_host=self.bind_host)
+        if self._shuffle_dir:
+            env["SPARK_TPU_SHUFFLE_DIR"] = self._shuffle_dir
         return subprocess.Popen(
-            [sys.executable, "-m", "spark_tpu.exec.worker_main"],
-            env=worker_env(self.driver_addr, self.token, host_label))
+            [sys.executable, "-m", "spark_tpu.exec.worker_main"], env=env)
 
     def _await_workers(self, expect: int, procs: list, timeout: float = 60.0):
         deadline = time.monotonic() + timeout
@@ -179,16 +260,30 @@ class LocalCluster:
         self._await_workers(before + 1, [proc])
 
     # ------------------------------------------------------------------
-    def _pick(self) -> _Worker:
-        with self._lock:
-            alive = [self._workers[e.executor_id]
-                     for e in self.registry.alive()
-                     if e.executor_id in self._workers]
-            if not alive:
-                raise ExecutorLostError("no alive executors")
-            w = alive[self._rr % len(alive)]
-            self._rr += 1
-            return w
+    def _pick_free(self, timeout: float | None = None) -> _Worker | None:
+        """ACQUIRE a free executor slot (central task queue semantics —
+        TaskSchedulerImpl.resourceOffers: tasks go to whichever executor
+        has a free slot, instead of binding to one at submit and queueing
+        behind it, which would leave executors added by dynamic
+        allocation idle). Caller must release()."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                alive = [self._workers[e.executor_id]
+                         for e in self.registry.alive()
+                         if e.executor_id in self._workers]
+                if not alive:
+                    raise ExecutorLostError("no alive executors")
+                order = alive[self._rr % len(alive):] + \
+                    alive[:self._rr % len(alive)]
+                self._rr += 1
+            for w in order:
+                if w.try_acquire():
+                    return w
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+            with self._slot_free:
+                self._slot_free.wait(timeout=0.05)
 
     def run_task(self, fn: Callable, *args) -> Any:
         return self.run_task_traced(fn, *args)[0]
@@ -197,11 +292,26 @@ class LocalCluster:
         """Run a task; returns (result, worker) so callers can register
         which executor holds the outputs (MapOutputTracker role)."""
         payload = cloudpickle.dumps((fn, args))
+        with self._lock:
+            self._active_tasks += 1
+        try:
+            return self._run_with_retries(payload)
+        finally:
+            with self._lock:
+                self._active_tasks -= 1
+
+    def _run_with_retries(self, payload: bytes) -> tuple:
         last: Exception | None = None
         for _ in range(self.max_task_failures):
-            w = self._pick()
+            w = self._pick_free()
             try:
-                return w.run(payload), w
+                if self.speculation:
+                    return self._run_speculative(payload, w)
+                try:
+                    return w.run_locked(payload), w
+                finally:
+                    w.release()
+                    self._notify_slot_free()
             except (RemoteTaskError, RemoteRpcError):
                 # the task (or its payload) failed deterministically —
                 # retrying on another healthy executor won't help, and
@@ -211,9 +321,163 @@ class LocalCluster:
                 last = e
                 self.registry.remove(w.executor_id)  # executor lost
                 w.close()
+                self._notify_slot_free()
         raise ExecutorLostError(
             f"task failed after {self.max_task_failures} executor losses: "
             f"{last}")
+
+    def _notify_slot_free(self) -> None:
+        with self._slot_free:
+            self._slot_free.notify_all()
+
+    # -- dynamic allocation (ExecutorAllocationManager.scala:102) --------
+    def _allocation_loop(self):
+        backlog_ticks = 0
+        while not self._stopping:
+            time.sleep(0.5)
+            alive = self.registry.alive()
+            n = len(alive)
+            with self._lock:
+                backlog = self._active_tasks - n
+            backlog_ticks = backlog_ticks + 1 if backlog > 0 else 0
+            if backlog_ticks >= 2 and n < self.max_workers:
+                try:
+                    self.add_worker()
+                    self.stats["executors_added"] = \
+                        self.stats.get("executors_added", 0) + 1
+                except Exception:
+                    pass
+                backlog_ticks = 0
+            elif n > self.min_workers:
+                now = time.monotonic()
+                with self._lock:
+                    idle = [w for e in alive
+                            if (w := self._workers.get(e.executor_id))
+                            is not None and not w.busy
+                            and w.proc is not None
+                            and now - w.idle_since > self.idle_timeout]
+                if idle and len(alive) > self.min_workers:
+                    w = max(idle, key=lambda x: now - x.idle_since)
+                    self.registry.remove(w.executor_id)
+                    with self._lock:
+                        self._workers.pop(w.executor_id, None)
+                    w.close()
+                    self.stats["executors_retired"] = \
+                        self.stats.get("executors_retired", 0) + 1
+
+    # -- speculation -----------------------------------------------------
+    def _speculation_threshold(self) -> float | None:
+        if self.speculation_interval is not None:
+            return self.speculation_interval
+        with self._lock:
+            hist = sorted(self._durations)
+        if len(hist) < 3:  # not enough history to call a straggler
+            return None
+        return max(0.1, self.speculation_multiplier
+                   * hist[len(hist) // 2])
+
+    def _run_speculative(self, payload: bytes, primary: _Worker) -> tuple:
+        """First-success-wins across up to two attempts. `primary`
+        arrives with its slot already acquired; each attempt thread
+        releases its own slot. The straggler's reply (it still completes
+        eventually) is discarded; any file commits it tries are
+        arbitrated by the OutputCommitCoordinator."""
+        import queue
+
+        q: queue.Queue = queue.Queue()
+        in_flight = [0]
+
+        def attempt(w: _Worker):
+            t0 = time.monotonic()
+            try:
+                q.put(("ok", w.run_locked(payload), w,
+                       time.monotonic() - t0))
+            except (RemoteTaskError, RemoteRpcError) as e:
+                q.put(("task_err", e, w, 0.0))
+            except Exception as e:
+                q.put(("lost", e, w, 0.0))
+            finally:
+                w.release()
+                self._notify_slot_free()
+
+        def launch(w: _Worker):
+            in_flight[0] += 1
+            threading.Thread(target=attempt, args=(w,), daemon=True).start()
+
+        launch(primary)
+        threshold = self._speculation_threshold()
+        first = None
+        if threshold is not None:
+            try:
+                first = q.get(timeout=threshold)
+            except queue.Empty:
+                try:
+                    backup = self._pick_free(timeout=0)
+                except ExecutorLostError:
+                    backup = None
+                if backup is not None:
+                    self.stats["speculative_launched"] = \
+                        self.stats.get("speculative_launched", 0) + 1
+                    launch(backup)
+        while True:
+            kind, val, w, dur = first if first is not None else q.get()
+            first = None
+            in_flight[0] -= 1
+            if kind == "ok":
+                with self._lock:
+                    self._durations.append(dur)
+                if in_flight[0] > 0:
+                    self.stats["speculative_wins"] = \
+                        self.stats.get("speculative_wins", 0) + 1
+                return val, w
+            if kind == "task_err":
+                raise val
+            # executor lost: drop it; if a copy is still running, let it
+            # decide the task, else surface to the retry loop
+            self.registry.remove(w.executor_id)
+            w.close()
+            if in_flight[0] == 0:
+                raise val
+
+    # -- barrier (BarrierTaskContext.scala barrier()/allGather()) --------
+    def _on_barrier(self, payload: bytes) -> bytes:
+        # bid carries the epoch (barrier_id#round) — see
+        # exec/barrier.py BarrierTaskContext._sync
+        bid, task_id, num_tasks, message, timeout = pickle.loads(payload)
+        deadline = time.monotonic() + timeout
+        with self._barrier_cv:
+            st = self._barriers.setdefault(
+                bid, {"msgs": {}, "done": False})
+            st["msgs"][task_id] = message
+            if len(st["msgs"]) >= num_tasks:
+                st["done"] = True
+                st["out"] = [st["msgs"][t] for t in sorted(st["msgs"])]
+                self._barrier_cv.notify_all()
+            else:
+                while not st["done"]:
+                    rest = deadline - time.monotonic()
+                    if rest <= 0 or not self._barrier_cv.wait(timeout=rest):
+                        st["msgs"].pop(task_id, None)
+                        raise TimeoutError(
+                            f"barrier {bid}: {len(st['msgs'])}/"
+                            f"{num_tasks} tasks after {timeout}s")
+            out = st["out"]
+            st["returned"] = st.get("returned", 0) + 1
+            if st["returned"] >= num_tasks:
+                self._barriers.pop(bid, None)
+            return pickle.dumps(out)
+
+    def alive_workers(self) -> list:
+        with self._lock:
+            return [self._workers[e.executor_id]
+                    for e in self.registry.alive()
+                    if e.executor_id in self._workers]
+
+    def run_task_on(self, worker, fn: Callable, *args) -> Any:
+        """Run on a SPECIFIC executor (barrier gangs need distinct
+        executors — two gang members queued on one worker's slot would
+        deadlock at the sync point)."""
+        return worker.run(cloudpickle.dumps((fn, args)))
 
     def map(self, fn: Callable, items) -> list:
         from concurrent.futures import ThreadPoolExecutor
@@ -231,8 +495,14 @@ class LocalCluster:
         return self.token
 
     def stop(self):
+        self._stopping = True
         with self._lock:
             workers = list(self._workers.values())
         for w in workers:
             w.close()
+        if self.shuffle_service is not None:
+            self.shuffle_service.stop()
+            import shutil
+
+            shutil.rmtree(self._shuffle_dir, ignore_errors=True)
         self._server.stop()

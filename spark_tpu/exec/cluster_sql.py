@@ -73,12 +73,14 @@ class FetchExec(PhysicalPlan):
     child_fields = ()
 
     def __init__(self, attrs, shuffle_id: str, block_addr: str,
-                 authkey_hex: str, num_partitions: int):
+                 authkey_hex: str, num_partitions: int,
+                 fallback_addr: str | None = None):
         self.attrs = list(attrs)
         self.shuffle_id = shuffle_id
         self.block_addr = block_addr
         self.authkey_hex = authkey_hex
         self.num_partitions = num_partitions
+        self.fallback_addr = fallback_addr  # external shuffle service
 
     @property
     def output(self):
@@ -99,7 +101,8 @@ class FetchExec(PhysicalPlan):
         out = []
         # one authenticated connection per producer, reused across blocks
         with BlockClient(self.block_addr, self.authkey_hex,
-                         self.shuffle_id) as client:
+                         self.shuffle_id,
+                         fallback_addr=self.fallback_addr) as client:
             for rid in range(self.num_partitions):
                 raw = client.get(rid)
                 out.append(_ipc_to_partition(pickle.loads(raw), schema))
@@ -159,6 +162,10 @@ class ClusterDAGScheduler(DAGScheduler):
         self.conf_overrides = dict(conf_overrides)
         self.map_outputs = MapOutputTracker()
         self._run_id = uuid.uuid4().hex[:12]
+        from ..config import SPECULATION
+
+        if ctx.conf.get(SPECULATION):
+            cluster.speculation = True
 
     def run(self, plan):
         import threading
@@ -285,5 +292,7 @@ def _substitute_parents(node, sched: ClusterDAGScheduler):
         assert isinstance(status, MapStatus), \
             f"parent stage {st.stage_id} not materialized"
         return FetchExec(node.attrs, status.shuffle_id, status.block_addr,
-                         sched.cluster.authkey_hex, status.num_partitions)
+                         sched.cluster.authkey_hex, status.num_partitions,
+                         fallback_addr=getattr(sched.cluster,
+                                               "shuffle_service_addr", None))
     return node.map_children(lambda c: _substitute_parents(c, sched))

@@ -78,30 +78,54 @@ class BlockClient:
     transport failure maps to FetchFailedError so the scheduler can
     regenerate the producing stage from lineage."""
 
-    def __init__(self, addr: str, authkey_hex: str, shuffle_id: str):
+    def __init__(self, addr: str, authkey_hex: str, shuffle_id: str,
+                 fallback_addr: str | None = None):
         self.shuffle_id = shuffle_id
         if ":" not in addr:
             raise FetchFailedError(shuffle_id, f"bad block address {addr!r}")
         self.addr = addr
+        self._key = authkey_hex
         self._client = RpcClient(addr, authkey_hex)
+        # external shuffle service (exec/shuffle_service.py): blocks that
+        # outlive the producing executor — tried before declaring
+        # FetchFailed, which would recompute the whole map stage
+        self.fallback_addr = fallback_addr
+        self._fallback: RpcClient | None = None
+
+    def _fetch_from(self, client: RpcClient, reduce_id: int) -> bytes:
+        frames = client.stream(
+            "get_block", pickle.dumps((self.shuffle_id, reduce_id)),
+            timeout=120)
+        head = next(frames, None)
+        if head != b"ok":
+            raise FetchFailedError(
+                self.shuffle_id,
+                f"block {reduce_id} missing at {client.addr}")
+        return b"".join(frames)
 
     def get(self, reduce_id: int) -> bytes:
         try:
-            frames = self._client.stream(
-                "get_block", pickle.dumps((self.shuffle_id, reduce_id)),
-                timeout=120)
-            head = next(frames, None)
-            if head != b"ok":
+            return self._fetch_from(self._client, reduce_id)
+        except (RpcUnavailableError, FetchFailedError) as e:
+            if self.fallback_addr is None:
+                if isinstance(e, FetchFailedError):
+                    raise
+                raise FetchFailedError(
+                    self.shuffle_id, f"{self.addr} died mid-fetch: {e}")
+            try:
+                if self._fallback is None:
+                    self._fallback = RpcClient(self.fallback_addr, self._key)
+                return self._fetch_from(self._fallback, reduce_id)
+            except RpcUnavailableError as e2:
                 raise FetchFailedError(
                     self.shuffle_id,
-                    f"block {reduce_id} missing at {self.addr}")
-            return b"".join(frames)
-        except RpcUnavailableError as e:
-            raise FetchFailedError(self.shuffle_id,
-                                   f"{self.addr} died mid-fetch: {e}")
+                    f"{self.addr} and shuffle service both unreachable: "
+                    f"{e2}")
 
     def close(self) -> None:
         self._client.close()
+        if self._fallback is not None:
+            self._fallback.close()
 
     def __enter__(self):
         return self

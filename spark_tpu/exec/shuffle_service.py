@@ -1,0 +1,92 @@
+"""External shuffle service: map outputs that survive executor loss.
+
+Role of the reference's ExternalShuffleService
+(core/deploy/ExternalShuffleService.scala + common/network-shuffle
+ExternalBlockHandler.java): shuffle blocks live OUTSIDE the executor
+that produced them, so losing an executor after its map stage completed
+does not force recomputation — reducers fetch from the service instead.
+
+Design: workers persist each block to a shared spill directory
+(atomic tmp+rename, so a concurrent reader never sees a partial file)
+in addition to their in-memory store; the service is an RpcServer over
+that directory speaking the same get_block/free_shuffle protocol as the
+worker block plane, so BlockClient can fall back to it transparently
+when the producer is gone. On one host the directory is shared
+filesystem; a multi-host deployment runs one service per host over its
+local disks, exactly the reference's YARN aux-service shape.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+
+from ..net.transport import CHUNK_BYTES, RpcServer
+
+_SAFE = set("abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ"
+            "0123456789._-")
+
+
+def _safe_name(s: str) -> str:
+    return "".join(c if c in _SAFE else "_" for c in s)
+
+
+def block_path(root: str, shuffle_id: str, reduce_id: int) -> str:
+    return os.path.join(root, _safe_name(shuffle_id), f"{reduce_id}.block")
+
+
+def persist_block(root: str, shuffle_id: str, reduce_id: int,
+                  data: bytes) -> None:
+    """Atomic write: readers (the service, possibly mid-fetch) must never
+    observe a partial block."""
+    path = block_path(root, shuffle_id, reduce_id)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+class ExternalShuffleService:
+    """Serves persisted shuffle blocks over the block-plane protocol."""
+
+    def __init__(self, root: str, token: str, host: str = "127.0.0.1"):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._server = RpcServer(token, host=host)
+        self._server.register_stream("get_block", self._get_block)
+        self._server.register("free_shuffle", self._free_shuffle)
+        self._server.register("ping", lambda _p: b"pong")
+        self.address = ""
+        self._lock = threading.Lock()
+
+    def start(self) -> str:
+        self.address = self._server.start()
+        return self.address
+
+    def stop(self) -> None:
+        self._server.stop()
+
+    # -- handlers --------------------------------------------------------
+    def _get_block(self, payload: bytes):
+        sid, rid = pickle.loads(payload)
+        path = block_path(self.root, sid, rid)
+        if not os.path.exists(path):
+            yield b"missing"
+            return
+        yield b"ok"
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(CHUNK_BYTES)
+                if not chunk:
+                    break
+                yield chunk
+
+    def _free_shuffle(self, payload: bytes) -> bytes:
+        import shutil
+
+        sid = pickle.loads(payload)
+        shutil.rmtree(os.path.join(self.root, _safe_name(sid)),
+                      ignore_errors=True)
+        return b"ok"

@@ -36,6 +36,13 @@ _STORE_LOCK = threading.Lock()
 def put_block(shuffle_id: str, reduce_id: int, data: bytes) -> None:
     with _STORE_LOCK:
         BLOCK_STORE[(shuffle_id, reduce_id)] = data
+    # external-shuffle durability: persist so the block outlives this
+    # process (exec/shuffle_service.py; reference ExternalShuffleService)
+    root = os.environ.get("SPARK_TPU_SHUFFLE_DIR")
+    if root:
+        from .shuffle_service import persist_block
+
+        persist_block(root, shuffle_id, reduce_id, data)
 
 
 def _handle_get_block(payload: bytes):

@@ -193,3 +193,169 @@ def test_fetch_failure_regenerates_lost_map_outputs(monkeypatch):
     finally:
         s.stop()
         cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# Speculation + barrier (r4; TaskSetManager.scala:80-88,
+# core/BarrierTaskContext.scala)
+# ---------------------------------------------------------------------------
+
+def test_speculative_copy_wins_over_straggler():
+    """One executor is made a straggler; the speculative copy launched on
+    the other executor finishes first and its result wins."""
+    import tempfile
+
+    c = LocalCluster(num_workers=2, speculation=True,
+                     speculation_interval=0.5)
+    try:
+        marker = tempfile.mktemp(prefix="sparktpu-straggle-")
+
+        def straggle_once(path):
+            import os as _os
+            import time as _time
+
+            # the FIRST executor to run the task stalls; the speculative
+            # copy (second executor) sees the marker and returns fast
+            if not _os.path.exists(path):
+                open(path, "w").close()
+                _time.sleep(8.0)
+                return "straggler"
+            return "fast"
+
+        t0 = time.monotonic()
+        out = c.run_task(straggle_once, marker)
+        took = time.monotonic() - t0
+        assert out == "fast"
+        assert took < 6.0, f"straggler was awaited ({took:.1f}s)"
+        assert c.stats.get("speculative_launched", 0) >= 1
+        assert c.stats.get("speculative_wins", 0) >= 1
+    finally:
+        c.stop()
+
+
+def test_speculation_threshold_from_history():
+    c = LocalCluster(num_workers=2, speculation=True)
+    try:
+        assert c._speculation_threshold() is None  # no history yet
+        for _ in range(4):
+            c.run_task(lambda x: x, 1)
+        th = c._speculation_threshold()
+        assert th is not None and th >= 0.1
+    finally:
+        c.stop()
+
+
+def test_barrier_all_gather_across_executors():
+    from spark_tpu.exec.barrier import run_barrier_job
+
+    c = LocalCluster(num_workers=3)
+    try:
+        def task(ctx):
+            import os as _os
+
+            gathered = ctx.allGather((ctx.task_id, _os.getpid()))
+            ctx.barrier()
+            return gathered
+
+        outs = run_barrier_job(c, task, num_tasks=3)
+        assert len(outs) == 3
+        # every task saw all three messages, ordered by task id
+        for got in outs:
+            assert [t for t, _ in got] == [0, 1, 2]
+        pids = {p for _, p in outs[0]}
+        assert len(pids) == 3  # three distinct executor processes
+    finally:
+        c.stop()
+
+
+def test_barrier_times_out_when_gang_incomplete():
+    from spark_tpu.exec.barrier import BarrierTaskContext
+
+    c = LocalCluster(num_workers=1)
+    try:
+        ctx = BarrierTaskContext(c.driver_addr, c.token, "lonely", 0, 2,
+                                 timeout=1.0)
+        with pytest.raises(Exception, match="barrier"):
+            ctx.allGather("only me")
+    finally:
+        c.stop()
+
+
+def test_dynamic_allocation_grows_and_shrinks():
+    """Backlog of slow tasks grows the pool past its floor; idle
+    executors retire back to it (ExecutorAllocationManager.scala:102)."""
+    c = LocalCluster(num_workers=1, dynamic_allocation=True,
+                     max_workers=3, executor_idle_timeout=2.0)
+    try:
+        assert c.num_alive() == 1
+        # 4 concurrent 3s tasks on 1 worker → sustained backlog → growth
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            futs = [pool.submit(c.run_task,
+                                lambda _: __import__("time").sleep(3.0),
+                                i) for i in range(4)]
+            for f in futs:
+                f.result(timeout=60)
+        assert c.stats.get("executors_added", 0) >= 1
+        grown = c.num_alive()
+        assert grown >= 2
+        # idle: retire back to the floor
+        deadline = time.monotonic() + 30
+        while c.num_alive() > 1 and time.monotonic() < deadline:
+            time.sleep(0.5)
+        assert c.num_alive() == 1
+        assert c.stats.get("executors_retired", 0) >= grown - 1
+        # still functional after scale-in
+        assert c.run_task(lambda x: x + 1, 41) == 42
+    finally:
+        c.stop()
+
+
+def test_shuffle_service_survives_executor_loss(monkeypatch):
+    """With the external shuffle service on, killing the producer AFTER
+    its map stage does NOT force recomputation: the consumer fetches the
+    persisted blocks from the service (ExternalShuffleService.scala
+    role) and zero fetch failures are recorded."""
+    import numpy as np
+    import pyarrow as pa
+
+    import spark_tpu.exec.cluster_sql as CS
+    from spark_tpu.api.session import TpuSession
+
+    s = TpuSession("csql_ess", {"spark.sql.shuffle.partitions": "3"})
+    cluster = LocalCluster(num_workers=2, shuffle_service=True)
+    s.attachSqlCluster(cluster)
+
+    state = {"killed": False}
+    orig = CS.ClusterDAGScheduler._run_remote
+
+    def kill_after_first_map(self, stage):
+        status = orig(self, stage)
+        if not state["killed"]:
+            state["killed"] = True
+            w = cluster._workers[status.executor_id]
+            w.proc.kill()
+            w.proc.wait(timeout=10)
+        return status
+
+    monkeypatch.setattr(CS.ClusterDAGScheduler, "_run_remote",
+                        kill_after_first_map)
+    try:
+        n = 4000
+        rng = np.random.default_rng(9)
+        keys = rng.integers(0, 30, n)
+        s.createDataFrame(pa.table({
+            "k": keys, "v": rng.integers(1, 5, n)})) \
+            .createOrReplaceTempView("essfact")
+        df = s.table("essfact").repartition(3).groupBy("k").count()
+        got = {r["k"]: r["count"] for r in df.collect()}
+        import collections
+
+        assert got == dict(collections.Counter(keys.tolist()))
+        assert state["killed"], "the kill hook never fired"
+        m = s._metrics.snapshot()["counters"]
+        # the whole point: no FetchFailed → no map-stage regeneration
+        assert m.get("scheduler.fetch_failures", 0) == 0, m
+    finally:
+        s.stop()
