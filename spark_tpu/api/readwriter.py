@@ -12,7 +12,9 @@ from typing import Any
 import pyarrow as pa
 
 from ..errors import AnalysisException
-from ..io.sources import CSVSource, DataSource, JSONSource, ParquetSource
+from ..io.sources import (
+    CSVSource, DataSource, JDBCSource, JSONSource, ORCSource, ParquetSource,
+)
 from ..plan.logical import LogicalRelation
 from ..expr.expressions import AttributeReference
 
@@ -62,17 +64,52 @@ class DataFrameReader:
     def json(self, path: str):
         return self._df(JSONSource(path), os.path.basename(path))
 
+    def orc(self, path: str):
+        return self._df(ORCSource(path), os.path.basename(path))
+
+    def text(self, path: str):
+        from ..io.sources import TextSource
+
+        return self._df(TextSource(path), os.path.basename(path))
+
+    def jdbc(self, url: str | None = None, table: str | None = None,
+             **kw):
+        url = url or self._options.get("url")
+        table = table or self._options.get("dbtable")
+        if not url or not table:
+            raise AnalysisException("jdbc requires url and dbtable")
+        src = JDBCSource(
+            url, table,
+            partition_column=kw.get("column",
+                                    self._options.get("partitionColumn")),
+            lower_bound=kw.get("lowerBound",
+                               self._options.get("lowerBound")),
+            upper_bound=kw.get("upperBound",
+                               self._options.get("upperBound")),
+            num_partitions=int(kw.get(
+                "numPartitions", self._options.get("numPartitions", 1))),
+            connector=self._options.get("connector"))
+        return self._df(src, table)
+
     def table(self, name: str):
         return self.session.table(name)
 
-    def load(self, path: str):
+    def load(self, path: str | None = None):
         fmt = self._format.lower()
+        if fmt == "jdbc":
+            return self.jdbc()
+        if path is None:
+            raise AnalysisException(f"format {fmt} requires a path")
         if fmt == "parquet":
             return self.parquet(path)
         if fmt == "csv":
             return self.csv(path)
         if fmt == "json":
             return self.json(path)
+        if fmt == "orc":
+            return self.orc(path)
+        if fmt == "text":
+            return self.text(path)
         raise AnalysisException(f"unknown format {fmt}")
 
 
@@ -116,33 +153,64 @@ class DataFrameWriter:
         return True
 
     def parquet(self, path: str) -> None:
-        import pyarrow.parquet as pq
+        self._write_file_format(path, "parquet")
 
+    def orc(self, path: str) -> None:
+        self._write_file_format(path, "orc")
+
+    @staticmethod
+    def _write_one(table: pa.Table, path: str, fmt: str) -> None:
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+
+            pq.write_table(table, path)
+        else:
+            import pyarrow.orc as po
+
+            po.write_table(table, path)
+
+    def _write_file_format(self, path: str, fmt: str) -> None:
         if not self._check(path):
             return
         table = self.df.toArrow()
         if not self._partition_by:
-            pq.write_table(table, path)
+            self._write_one(table, path, fmt)
             return
-        # hive-style layout: path/k1=v1/k2=v2/part-00000.parquet
-        # (reference: FileFormatWriter dynamic partitioning)
+        # hive-style layout path/k1=v1/part-*.{fmt} written through the
+        # two-phase commit protocol: every partition combo is a task,
+        # files land in attempt staging dirs and move into place only at
+        # job commit (reference: FileFormatWriter dynamic partitioning +
+        # HadoopMapReduceCommitProtocol; arbitration =
+        # core/scheduler/OutputCommitCoordinator.scala)
         import pyarrow.compute as pc
 
+        from ..io.commit import FileCommitProtocol
+
+        os.makedirs(path, exist_ok=True)
+        proto = FileCommitProtocol(
+            path, getattr(self.df.session, "_commit_coordinator", None))
+        proto.setup_job()
         keys = self._partition_by
-        combos = table.select(keys).group_by(keys).aggregate([])
-        for i in range(combos.num_rows):
-            vals = [combos.column(k)[i].as_py() for k in keys]
-            mask = None
-            for k, v in zip(keys, vals):
-                cond = pc.is_null(table.column(k)) if v is None \
-                    else pc.equal(table.column(k), v)
-                mask = cond if mask is None else pc.and_(mask, cond)
-            part = table.filter(mask).drop_columns(keys)
-            sub = os.path.join(path, *(
-                f"{k}={'__HIVE_DEFAULT_PARTITION__' if v is None else v}"
-                for k, v in zip(keys, vals)))
-            os.makedirs(sub, exist_ok=True)
-            pq.write_table(part, os.path.join(sub, "part-00000.parquet"))
+        try:
+            combos = table.select(keys).group_by(keys).aggregate([])
+            for i in range(combos.num_rows):
+                vals = [combos.column(k)[i].as_py() for k in keys]
+                mask = None
+                for k, v in zip(keys, vals):
+                    cond = pc.is_null(table.column(k)) if v is None \
+                        else pc.equal(table.column(k), v)
+                    mask = cond if mask is None else pc.and_(mask, cond)
+                part = table.filter(mask).drop_columns(keys)
+                sub = [f"{k}={'__HIVE_DEFAULT_PARTITION__' if v is None else v}"
+                       for k, v in zip(keys, vals)]
+                attempt = proto.new_task_attempt(i)
+                self._write_one(
+                    part, attempt.path_for(*sub, f"part-00000.{fmt}"), fmt)
+                attempt.commit()
+        except BaseException:
+            proto.abort_job()
+            raise
+        proto.commit_job()
 
     def csv(self, path: str) -> None:
         import pyarrow.csv as pacsv

@@ -444,6 +444,14 @@ class ColumnarBatch:
                 mask = None
                 if c.validity is not None:
                     mask = ~np.asarray(c.validity)[sel]
+                if vals.dtype == object and (
+                        str(at) == "date32[day]"
+                        or str(at).startswith("timestamp")):
+                    # host lists can carry None for masked slots (e.g. a
+                    # date column read from ORC) — zero-fill, the mask
+                    # already marks them null
+                    vals = np.asarray([0 if v is None else v
+                                       for v in vals])
                 if f.dataType.device_dtype == np.dtype(np.int32) and str(at) == "date32[day]":
                     arrays.append(pa.array(np.asarray(vals, np.int32), type=at, mask=mask))
                 elif str(at).startswith("timestamp"):

@@ -1,6 +1,7 @@
 """IO tests: parquet round trips, partitioned layout, csv/json."""
 
 import os
+import tempfile
 
 import pyarrow as pa
 import pyarrow.parquet as pq
@@ -76,3 +77,158 @@ def test_write_modes(spark, tmp_path):
     spark.createDataFrame(pa.table({"x": [9]})).write.mode("overwrite") \
         .parquet(p)
     assert spark.read.parquet(p).toArrow().to_pydict()["x"] == [9]
+
+
+# ---------------------------------------------------------------------------
+# Commit protocol + ORC + JDBC (r4)
+# ---------------------------------------------------------------------------
+
+def test_commit_coordinator_exactly_one_winner():
+    """Two attempts of the same task race the coordinator from many
+    threads; exactly one commits, the loser aborts and leaves no files
+    (reference: OutputCommitCoordinator.scala + TaskCommitDenied)."""
+    import threading
+
+    from spark_tpu.io.commit import (
+        CommitDeniedError, FileCommitProtocol,
+    )
+
+    d = tempfile.mkdtemp(prefix="sparktpu-commit-")
+    out = os.path.join(d, "out")
+    os.makedirs(out)
+    proto = FileCommitProtocol(out)
+    proto.setup_job()
+
+    results = []
+
+    def attempt(tag):
+        att = proto.new_task_attempt(task_id=0)
+        with open(att.path_for("part-00000.txt"), "w") as f:
+            f.write(tag)
+        try:
+            att.commit()
+            results.append(("committed", tag))
+        except CommitDeniedError:
+            results.append(("denied", tag))
+
+    threads = [threading.Thread(target=attempt, args=(f"a{i}",))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    proto.commit_job()
+    assert sum(1 for s, _ in results if s == "committed") == 1
+    assert sum(1 for s, _ in results if s == "denied") == 7
+    winner = next(tag for s, tag in results if s == "committed")
+    assert open(os.path.join(out, "part-00000.txt")).read() == winner
+    assert os.path.exists(os.path.join(out, "_SUCCESS"))
+    assert not os.path.exists(os.path.join(out, "_temporary"))
+
+
+def test_partitioned_write_commits_atomically(spark):
+    d = tempfile.mkdtemp(prefix="sparktpu-io-")
+    p = os.path.join(d, "part_out")
+    df = spark.createDataFrame(pa.table({
+        "k": [1, 1, 2, 2, 3], "v": [10.0, 11.0, 20.0, 21.0, 30.0]}))
+    df.write.partitionBy("k").parquet(p)
+    assert os.path.exists(os.path.join(p, "_SUCCESS"))
+    assert not os.path.exists(os.path.join(p, "_temporary"))
+    back = spark.read.parquet(p).toArrow()
+    assert sorted(back.column("v").to_pylist()) == [10.0, 11.0, 20.0,
+                                                    21.0, 30.0]
+
+
+def test_orc_roundtrip(spark):
+    d = tempfile.mkdtemp(prefix="sparktpu-io-")
+    p = os.path.join(d, "t.orc")
+    t = pa.table({"a": [1, 2, 3], "b": ["x", "y", None],
+                  "c": [1.5, None, 3.5]})
+    spark.createDataFrame(t).write.orc(p)
+    back = spark.read.orc(p)
+    assert back.toArrow().to_pydict() == t.to_pydict()
+    # SQL over an ORC scan with projection pushdown
+    back.createOrReplaceTempView("orc_t")
+    out = spark.sql("SELECT a FROM orc_t WHERE c > 1").toArrow()
+    assert sorted(out.column("a").to_pylist()) == [1, 3]
+
+
+def test_orc_partitioned_write_and_format_load(spark):
+    d = tempfile.mkdtemp(prefix="sparktpu-io-")
+    p = os.path.join(d, "orc_parts")
+    spark.createDataFrame(pa.table({
+        "k": ["a", "a", "b"], "v": [1, 2, 3]})) \
+        .write.partitionBy("k").orc(p)
+    assert os.path.exists(os.path.join(p, "_SUCCESS"))
+    back = spark.read.format("orc").load(p).toArrow()
+    assert sorted(back.column("v").to_pylist()) == [1, 2, 3]
+
+
+def test_jdbc_read_partitioned(spark):
+    import sqlite3
+
+    d = tempfile.mkdtemp(prefix="sparktpu-io-")
+    db = os.path.join(d, "db.sqlite")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE emp (id INTEGER, name TEXT, sal REAL)")
+    conn.executemany("INSERT INTO emp VALUES (?,?,?)",
+                     [(i, f"e{i}", 100.0 * i) for i in range(50)])
+    conn.commit()
+    conn.close()
+
+    df = (spark.read.format("jdbc")
+          .option("url", f"jdbc:sqlite:{db}")
+          .option("dbtable", "emp")
+          .option("partitionColumn", "id")
+          .option("numPartitions", "4")
+          .load())
+    assert df.count() == 50
+    out = spark.createDataFrame(pa.table({"id": [1, 2]})) \
+        .join(df, "id").toArrow()
+    assert sorted(out.column("sal").to_pylist()) == [100.0, 200.0]
+
+
+def test_tpcds_q3_from_orc(spark, tmp_path):
+    """TPC-DS runs from ORC files (VERDICT r3 item 6 'the TPC-DS suite
+    loading from ORC')."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from tests.tpcds.datagen import _Gen
+    from tests.tpcds.oracle import strip_trailing_limit
+
+    g = _Gen(0.1, 17)
+    for t in ("date_dim", "time_dim", "item", "customer_address",
+              "customer_demographics", "household_demographics",
+              "income_band", "customer", "store", "warehouse",
+              "ship_mode", "reason", "call_center", "catalog_page",
+              "web_site", "web_page", "promotion", "store_sales"):
+        getattr(g, t)()
+    q3 = strip_trailing_limit(open(os.path.join(
+        os.path.dirname(__file__), "tpcds", "queries", "q3.sql")).read())
+    # in-memory reference result
+    for n in ("date_dim", "store_sales", "item"):
+        spark.createDataFrame(g.tables[n]).createOrReplaceTempView(n)
+    want = spark.sql(q3).toArrow()
+    # same tables through ORC files
+    for n in ("date_dim", "store_sales", "item"):
+        p = str(tmp_path / f"{n}.orc")
+        spark.createDataFrame(g.tables[n]).write.orc(p)
+        spark.read.orc(p).createOrReplaceTempView(n)
+    got = spark.sql(q3).toArrow()
+    assert got.num_rows == want.num_rows > 0
+    assert sorted(map(str, got.to_pylist())) == \
+        sorted(map(str, want.to_pylist()))
+
+
+def test_text_source(spark, tmp_path):
+    p = tmp_path / "lines.txt"
+    p.write_text("hello world\nfoo\nbar baz\n")
+    df = spark.read.text(str(p))
+    assert df.toArrow().column("value").to_pylist() == \
+        ["hello world", "foo", "bar baz"]
+    df.createOrReplaceTempView("lines")
+    out = spark.sql(
+        "SELECT count(*) c FROM lines WHERE value LIKE '%o%'").toArrow()
+    assert out.column("c")[0].as_py() == 2
