@@ -38,6 +38,13 @@ class DataStreamReader:
         fmt = (self._format or "").lower()
         if fmt == "rate":
             src = RateSource(int(self._options.get("rowsPerSecond", 1)))
+        elif fmt == "socket":
+            from .sources import SocketSource
+
+            src = SocketSource(
+                self._options["host"], int(self._options["port"]),
+                include_timestamp=str(self._options.get(
+                    "includeTimestamp", "false")).lower() == "true")
         elif fmt in ("parquet", "csv", "json"):
             src = FileStreamSource(path or self._options["path"], fmt)
         else:

@@ -141,7 +141,12 @@ class StreamingQuery:
         self.source: StreamSource = leaves[0].source
 
         self.checkpoint_dir = checkpoint_dir
-        self.state = StateStore(checkpoint_dir)
+        from ..config import STATE_STORE_PARTITIONS
+        from .state import PartitionedStateStore
+
+        self.state = PartitionedStateStore(
+            checkpoint_dir,
+            num_partitions=int(session.conf.get(STATE_STORE_PARTITIONS)))
         if len(leaves) == 2:
             from .join import StreamJoinRunner
 

@@ -144,3 +144,77 @@ class FileStreamSource(StreamSource):
         import pyarrow.json as pajson
 
         return pa.concat_tables([pajson.read_json(f) for f in files])
+
+
+class SocketSource(StreamSource):
+    """TCP text-line source (reference: TextSocketSourceProvider /
+    TextSocketMicroBatchStream — `format("socket")` with host/port).
+    A reader thread drains lines into a buffer; offset = lines consumed.
+    Column: value (string). As in the reference, this source is NOT
+    fault-tolerant (the socket does not replay), which is why the
+    reference gates it to testing — same stance here."""
+
+    def __init__(self, host: str, port: int,
+                 include_timestamp: bool = False):
+        import socket as _socket
+
+        self.include_timestamp = include_timestamp
+        fields = [("value", pa.string())]
+        if include_timestamp:
+            fields.append(("timestamp", pa.timestamp("us")))
+        self.schema = schema_from_arrow(pa.schema(fields))
+        self._rows: list[tuple[str, int]] = []
+        self._base = 0  # offset of _rows[0]; consumed lines are trimmed
+        self._lock = threading.Lock()
+        self._sock = _socket.create_connection((host, port), timeout=10)
+        self._closed = threading.Event()
+        threading.Thread(target=self._reader, daemon=True,
+                         name="socket-source").start()
+
+    def _reader(self) -> None:
+        buf = b""
+        sock = self._sock
+        while not self._closed.is_set():
+            try:
+                chunk = sock.recv(64 << 10)
+            except OSError:
+                return
+            if not chunk:
+                return
+            buf += chunk
+            while b"\n" in buf:
+                line, _, buf = buf.partition(b"\n")
+                now = int(time.time() * 1e6)
+                with self._lock:
+                    self._rows.append(
+                        (line.decode("utf-8", "replace"), now))
+
+    def initial_offset(self):
+        return 0
+
+    def latest_offset(self):
+        with self._lock:
+            return self._base + len(self._rows)
+
+    def get_batch(self, start, end) -> pa.Table:
+        start = start or 0
+        with self._lock:
+            rows = self._rows[start - self._base:end - self._base]
+            # everything below `start` is committed — trim so an
+            # always-on stream doesn't hold every line ever received
+            # (reference: TextSocketMicroBatchStream.commit pruning)
+            if start > self._base:
+                del self._rows[:start - self._base]
+                self._base = start
+        cols = {"value": pa.array([r[0] for r in rows], pa.string())}
+        if self.include_timestamp:
+            cols["timestamp"] = pa.array([r[1] for r in rows],
+                                         pa.timestamp("us"))
+        return pa.table(cols)
+
+    def stop(self) -> None:
+        self._closed.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass

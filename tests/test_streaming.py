@@ -575,3 +575,54 @@ def test_stream_join_checkpoint_resume(spark, tmp_path):
         assert ("b", 2, -1) not in rows, rows
     finally:
         q2.stop()
+
+
+def test_socket_source_streams_lines(spark):
+    """TCP socket source (TextSocketMicroBatchStream role): lines pushed
+    by a server arrive as streaming rows."""
+    import socket
+    import threading
+    import time as _time
+
+    srv = socket.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+    conns = []
+
+    def accept():
+        c, _ = srv.accept()
+        conns.append(c)
+        c.sendall(b"alpha\nbeta\n")
+
+    t = threading.Thread(target=accept, daemon=True)
+    t.start()
+    df = (spark.readStream.format("socket")
+          .option("host", "127.0.0.1").option("port", port).load())
+    q = (df.writeStream.format("memory").queryName("sock_out")
+         .outputMode("append").start())
+    try:
+        t.join(timeout=10)
+        deadline = _time.monotonic() + 15
+        got = []
+        while _time.monotonic() < deadline:
+            q.processAllAvailable()
+            got = [r["value"] for r in
+                   spark.sql("SELECT * FROM sock_out").collect()]
+            if len(got) >= 2:
+                break
+            _time.sleep(0.1)
+        assert sorted(got) == ["alpha", "beta"]
+        conns[0].sendall(b"gamma\n")
+        deadline = _time.monotonic() + 15
+        while _time.monotonic() < deadline:
+            q.processAllAvailable()
+            got = [r["value"] for r in
+                   spark.sql("SELECT * FROM sock_out").collect()]
+            if len(got) >= 3:
+                break
+            _time.sleep(0.1)
+        assert sorted(got) == ["alpha", "beta", "gamma"]
+    finally:
+        q.stop()
+        for c in conns:
+            c.close()
+        srv.close()
