@@ -63,6 +63,17 @@ class SetCommand(Command):
 
 
 @dataclass
+class AnalyzeTableCommand(Command):
+    """ANALYZE TABLE t COMPUTE STATISTICS [FOR COLUMNS a, b | FOR ALL
+    COLUMNS] (reference: AnalyzeTableCommand / AnalyzeColumnCommand,
+    sqlx/command/AnalyzeColumnCommand.scala — row count + per-column
+    ndv/min/max/nulls persisted for the CBO)."""
+
+    name: str
+    columns: Optional[list] = None  # None → all columns
+
+
+@dataclass
 class InsertIntoCommand(Command):
     name: str
     query: LogicalPlan
@@ -119,6 +130,15 @@ def run_command(session, cmd: Command):
 
     from ..api.dataframe import DataFrame
     from ..errors import AnalysisException
+    from .logical import WithCTE
+
+    # a command's embedded query (CTAS/INSERT/EXPLAIN/MERGE source) can
+    # carry WithCTE materializations — resolve them the same way
+    # session.sql does for plain queries, or analysis would hit the
+    # unresolved __cte_mat_* placeholder relations
+    for fname, val in list(vars(cmd).items()):
+        if isinstance(val, WithCTE):
+            setattr(cmd, fname, session._materialize_ctes(val))
 
     def df_of(table: pa.Table) -> DataFrame:
         return session.createDataFrame(table)
@@ -250,6 +270,29 @@ def run_command(session, cmd: Command):
         return df_of(pa.table({
             "key": pa.array([cmd.key]),
             "value": pa.array([str(session.conf.get(cmd.key))]),
+        }))
+
+    if isinstance(cmd, AnalyzeTableCommand):
+        from ..api.dataframe import DataFrame as _DF
+        from .logical import LocalRelation, LogicalRelation
+        from .stats import compute_table_stats
+
+        plan = session.catalog_.lookup([cmd.name])
+        table = _DF(session, plan).toArrow()
+        stats = compute_table_stats(table, cmd.columns)
+        # attach to the catalog plan's relation leaf so estimate()
+        # (plan/stats.py) sees it wherever the view is spliced — only
+        # when the "table" IS one relation (a multi-relation view's
+        # per-leaf stats would be wrong)
+        leaves = [n for n in plan.iter_nodes()
+                  if isinstance(n, (LocalRelation, LogicalRelation))]
+        if len(leaves) == 1:
+            leaves[0]._cbo_stats = stats
+        session._table_stats[session.catalog_._norm(cmd.name)] = stats
+        return df_of(pa.table({
+            "table": pa.array([cmd.name]),
+            "rows": pa.array([stats.row_count]),
+            "columns_analyzed": pa.array([len(stats.col_stats)]),
         }))
 
     raise AnalysisException(f"unknown command {type(cmd).__name__}")

@@ -307,3 +307,22 @@ def test_join_runtime_filter_correctness(spark):
     finally:
         spark.conf.set("spark.tpu.join.runtimeFilter", False)
         spark.conf.set("spark.tpu.join.runtimeFilter.minCapacity", 1 << 20)
+
+
+def test_ctas_with_materialized_cte(spark):
+    """CREATE TABLE/VIEW AS with a multiply-instantiated expensive CTE:
+    the command path must resolve WithCTE materializations exactly like
+    session.sql does (r4 regression — placeholder relations leaked)."""
+    import pyarrow as pa
+
+    spark.createDataFrame(pa.table({
+        "k": list(range(20)), "v": [1.0] * 20})) \
+        .createOrReplaceTempView("ctas_src")
+    spark.sql("""
+        CREATE OR REPLACE TEMP VIEW ctas_out AS
+        WITH big AS (SELECT a.k, sum(a.v) s FROM ctas_src a
+                     JOIN ctas_src b ON a.k = b.k
+                     JOIN ctas_src c ON a.k = c.k GROUP BY a.k)
+        SELECT count(*) AS c FROM big x JOIN big y ON x.k = y.k""")
+    assert spark.sql("SELECT * FROM ctas_out").toArrow() \
+        .column("c")[0].as_py() == 20
