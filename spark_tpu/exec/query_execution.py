@@ -30,11 +30,32 @@ def _unconvert(value, dt):
     return value
 
 
+class QueryPlanningTracker:
+    """Per-query rule/phase timing (reference:
+    sqlcat/QueryPlanningTracker.scala — phases via measurePhase, rules
+    via RuleExecutor.executeAndTrack; dumpTimeSpent role filled by
+    top_rules)."""
+
+    def __init__(self):
+        self.rules: dict[str, float] = {}
+        self.rule_hits: dict[str, int] = {}
+
+    def record_rule(self, name: str, seconds: float) -> None:
+        self.rules[name] = self.rules.get(name, 0.0) + seconds
+        self.rule_hits[name] = self.rule_hits.get(name, 0) + 1
+
+    def top_rules(self, n: int = 10) -> list[tuple[str, float, int]]:
+        return sorted(((k, v, self.rule_hits[k])
+                       for k, v in self.rules.items()),
+                      key=lambda t: -t[1])[:n]
+
+
 class QueryExecution:
     def __init__(self, session, logical: LogicalPlan):
         self.session = session
         self.logical = logical
         self.phase_times: dict[str, float] = {}
+        self.tracker = QueryPlanningTracker()
 
     def _timed(self, name: str, fn):
         t0 = time.perf_counter()
@@ -45,7 +66,8 @@ class QueryExecution:
     @cached_property
     def analyzed(self) -> LogicalPlan:
         return self._timed("analysis",
-                           lambda: self.session._analyzer.execute(self.logical))
+                           lambda: self.session._analyzer.execute(
+                               self.logical, tracker=self.tracker))
 
     @cached_property
     def with_cached_data(self) -> LogicalPlan:
@@ -59,7 +81,8 @@ class QueryExecution:
     def optimized(self) -> LogicalPlan:
         plan = self.with_cached_data
         out = self._timed("optimization",
-                          lambda: self.session._optimizer.execute(plan))
+                          lambda: self.session._optimizer.execute(
+                              plan, tracker=self.tracker))
         return self._materialize_scalar_subqueries(out)
 
     def _materialize_scalar_subqueries(self, plan: LogicalPlan) -> LogicalPlan:
@@ -156,6 +179,9 @@ class QueryExecution:
                     self.session._metrics.snapshot()["counters"])
                 counters["kernel_cache.hits"] = KC.hits
                 counters["kernel_cache.misses"] = KC.misses
+                counters.update(
+                    {f"rule.{name}_ms": round(sec * 1000, 3)
+                     for name, sec, _ in self.tracker.top_rules(5)})
                 bus.post(QueryEvent(
                     "querySucceeded", qid, time.time(),
                     duration_ms=(time.perf_counter() - t0) * 1000,

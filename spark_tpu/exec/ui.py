@@ -1,0 +1,93 @@
+"""Live web UI: the running session's queries, phases, plans, metrics.
+
+Role of the reference's SparkUI + AppStatusListener
+(core/ui/SparkUI.scala served from the live AppStatusStore,
+core/status/AppStatusListener.scala — every bus event lands in an
+in-memory store the UI renders). The renderer is shared with the
+history server (exec/history_server.py) — the live store simply
+presents the HistoryReader surface over an in-memory deque instead of
+JSONL files, the same live/replay split the reference gets from
+ElementTrackingStore over kvstore.
+
+    spark = TpuSession("app")
+    ui = spark.startUI()        # http://127.0.0.1:<port>/
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import asdict
+from http.server import ThreadingHTTPServer
+
+from .history_server import _Handler
+from .listener import QueryEvent
+
+
+class LiveStatusStore:
+    """In-memory event store fed by the listener bus (AppStatusListener
+    + AppStatusStore roles), shaped like HistoryReader for the shared
+    renderer."""
+
+    def __init__(self, app_name: str, max_events: int = 2000):
+        self.app_name = app_name
+        self._events: deque = deque(maxlen=max_events)
+        self._running: dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def on_event(self, ev: QueryEvent) -> None:
+        d = asdict(ev)
+        with self._lock:
+            if ev.event == "queryStarted":
+                self._running[ev.query_id] = d
+            else:
+                self._running.pop(ev.query_id, None)
+            self._events.append(d)
+
+    # -- HistoryReader surface -------------------------------------------
+    def applications(self) -> list[str]:
+        return [self.app_name]
+
+    def load(self, _app: str) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def summary(self, _app: str) -> dict:
+        events = self.load(_app)
+        done = [e for e in events if e["event"] == "querySucceeded"]
+        failed = [e for e in events if e["event"] == "queryFailed"]
+        with self._lock:
+            running = len(self._running)
+        return {"queries": len(done), "failed": len(failed),
+                "total_duration_ms": sum(e.get("duration_ms") or 0
+                                         for e in done),
+                "running": running}
+
+
+class SparkUI:
+    """Live HTTP UI bound to one session's listener bus."""
+
+    def __init__(self, session, port: int = 0, host: str = "127.0.0.1"):
+        name = getattr(session, "app_name", None) or "session"
+        self.store = LiveStatusStore(name)
+        session.listener_bus.register(self.store)
+        handler = type("Handler", (_Handler,), {"reader": self.store})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}/"
+        self._session = session
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "SparkUI":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True, name="spark-ui")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        try:
+            self._session.listener_bus.unregister(self.store)
+        except Exception:
+            pass
+        self._httpd.shutdown()
+        self._httpd.server_close()

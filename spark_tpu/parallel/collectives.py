@@ -84,7 +84,7 @@ def make_all_to_all_exchange(mesh, quota: int, axis_name: str = "data"):
         return out_payloads, new_mask, total_overflow
 
     def sharded(key_eqs, key_valids, payloads, row_mask):
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
         in_specs = (
             [P(axis_name)] * len(key_eqs),
@@ -94,7 +94,7 @@ def make_all_to_all_exchange(mesh, quota: int, axis_name: str = "data"):
         )
         out_specs = ([P(axis_name)] * len(payloads), P(axis_name), P())
         f = shard_map(local_fn, mesh=mesh, in_specs=in_specs,
-                      out_specs=out_specs, check_rep=False)
+                      out_specs=out_specs, check_vma=False)
         return f(key_eqs, key_valids, payloads, row_mask)
 
     return jax.jit(sharded)

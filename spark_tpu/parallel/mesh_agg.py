@@ -37,10 +37,7 @@ def make_distributed_groupby_sum(mesh, axis_name: str = "data",
     exchange quota defaults to shard_cap // P (retryable upward by caller)."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax.experimental.shard_map import shard_map
-    except ImportError:  # newer jax
-        from jax import shard_map
+    from jax import shard_map
 
     n_part = mesh.shape[axis_name]
 
@@ -83,7 +80,7 @@ def make_distributed_groupby_sum(mesh, axis_name: str = "data",
                       in_specs=(P(axis_name), P(axis_name), P(axis_name)),
                       out_specs=(P(axis_name), P(axis_name), P(axis_name),
                                  P(axis_name)),
-                      check_rep=False)
+                      check_vma=False)
         return f(keys, values, row_mask)
 
     return jax.jit(sharded)

@@ -24,10 +24,7 @@ def make_broadcast_join_sum(mesh, axis_name: str = "data"):
     scan→broadcast-join→project spine of a TPC-DS star query."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax.experimental.shard_map import shard_map
-    except ImportError:  # newer jax
-        from jax import shard_map
+    from jax import shard_map
 
     def local_fn(pk, pv, pm, bk, bv, bm):
         # build side is replicated: dense direct-address table per shard
@@ -54,7 +51,7 @@ def make_broadcast_join_sum(mesh, axis_name: str = "data"):
             in_specs=(P(axis_name), P(axis_name), P(axis_name),
                       P(), P(), P()),
             out_specs=(P(axis_name), P(axis_name)),
-            check_rep=False)
+            check_vma=False)
         return f(pk, pv, pm, bk, bv, bm)
 
     return jax.jit(sharded)

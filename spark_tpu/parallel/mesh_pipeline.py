@@ -32,10 +32,7 @@ def make_mesh_groupby_pipeline(mesh, axis_name: str = "data"):
     from jax import lax
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax.experimental.shard_map import shard_map
-    except ImportError:  # newer jax
-        from jax import shard_map
+    from jax import shard_map
 
     from ..ops import grouping as G
     from .collectives import _bucket_local
@@ -85,7 +82,7 @@ def make_mesh_groupby_pipeline(mesh, axis_name: str = "data"):
                 in_specs=(P(axis_name), P(axis_name), P(axis_name)),
                 out_specs=(P(axis_name), P(axis_name), P(axis_name),
                            P(axis_name), P()),
-                check_rep=False)
+                check_vma=False)
             return f(keys, values, row_mask)
 
         return jax.jit(sharded)

@@ -7,7 +7,8 @@ per-reducer row counts, so blocking consumers coalesce undersized adjacent
 reducer outputs before processing — hash clustering and range ordering are
 preserved because only ADJACENT partitions merge. Joins coordinate one
 merge plan across both sides (the reference does the same via shared
-partition specs). Skew splitting (OptimizeSkewedJoin.scala:57) is round-2.
+partition specs). Skew splitting (OptimizeSkewedJoin.scala:57) lives in
+split_skewed_join_inputs below.
 """
 
 from __future__ import annotations

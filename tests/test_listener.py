@@ -99,3 +99,35 @@ def test_history_server_ui(tmp_path):
         assert b"Phases" in qpage and b"HashAggregate" in qpage
     finally:
         hs.stop()
+
+
+def test_live_ui_serves_session_queries(spark):
+    """Live SparkUI (exec/ui.py): bus events render without event-log
+    files (AppStatusListener/SparkUI roles)."""
+    import json
+    import urllib.request
+
+    import pyarrow as pa
+
+    from spark_tpu.exec.ui import SparkUI
+
+    ui = SparkUI(spark).start()
+    try:
+        spark.createDataFrame(pa.table({"x": [1, 2, 3]})) \
+            .createOrReplaceTempView("ui_t")
+        spark.sql("SELECT sum(x) AS s FROM ui_t").toArrow()
+        spark.listener_bus.wait_empty()
+        api = json.loads(urllib.request.urlopen(
+            ui.url + "api/applications", timeout=10).read())
+        assert api and api[0]["queries"] >= 1
+        index = urllib.request.urlopen(ui.url, timeout=10).read().decode()
+        assert "Application" in index
+        app = urllib.request.urlopen(
+            ui.url + f"app?id={api[0]['id']}", timeout=10).read().decode()
+        assert "OK" in app
+        detail = urllib.request.urlopen(
+            ui.url + f"query?id={api[0]['id']}&n=0", timeout=10) \
+            .read().decode()
+        assert "Phases" in detail and "Plan" in detail
+    finally:
+        ui.stop()
