@@ -325,11 +325,6 @@ def regexp_extract(c, pattern: str, idx: int = 1) -> Column:
     return Column(E.RegexpExtract(_c(c), E.Literal(pattern), E.Literal(idx)))
 
 
-def regexp_replace(c, pattern: str, replacement: str) -> Column:
-    return Column(E.RegexpReplace(_c(c), E.Literal(pattern),
-                                  E.Literal(replacement)))
-
-
 def lpad(c, length: int, pad: str = " ") -> Column:
     return Column(E.Lpad(_c(c), E.Literal(length), E.Literal(pad)))
 

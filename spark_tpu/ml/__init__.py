@@ -20,3 +20,15 @@ from .tree import (  # noqa: F401
 )
 from .recommendation import ALS, ALSModel  # noqa: F401
 from .fpm import FPGrowth, FPGrowthModel  # noqa: F401
+from .features import (  # noqa: F401
+    Imputer, MaxAbsScaler, Normalizer, PolynomialExpansion, RobustScaler,
+)
+from .regression import IsotonicRegression  # noqa: F401
+from .classification import (  # noqa: F401
+    LinearSVC, MultilayerPerceptronClassifier,
+)
+from .clustering import BisectingKMeans, GaussianMixture  # noqa: F401
+from .text import (  # noqa: F401
+    CountVectorizer, HashingTF, IDF, NGram, RegexTokenizer,
+    StopWordsRemover, Tokenizer,
+)

@@ -121,10 +121,21 @@ def resolve_feature_cols(df, features_col: str) -> list[str]:
 
 
 def extract_matrix(df, cols: Sequence[str]) -> np.ndarray:
+    """[n, d] float matrix from scalar columns and/or fixed-width list
+    columns (HashingTF/CountVectorizer vectors are list<double> — each
+    contributes its width in columns)."""
     table = df.select(*cols).toArrow()
-    mats = [np.asarray(table.column(c).to_numpy(zero_copy_only=False),
-                       dtype=np.float64) for c in table.column_names]
-    return np.stack(mats, axis=1)
+    blocks = []
+    for c in table.column_names:
+        col = table.column(c)
+        if pa.types.is_list(col.type) or pa.types.is_large_list(col.type) \
+                or pa.types.is_fixed_size_list(col.type):
+            blocks.append(np.asarray(col.to_pylist(), dtype=np.float64))
+        else:
+            blocks.append(np.asarray(
+                col.to_numpy(zero_copy_only=False),
+                dtype=np.float64)[:, None])
+    return np.concatenate(blocks, axis=1)
 
 
 def extract_vector(df, col: str) -> np.ndarray:

@@ -96,3 +96,153 @@ class NaiveBayesModel(Model):
         scores = ll + self.log_priors[None]
         pred = self.classes[np.argmax(scores, axis=1)].astype(np.float64)
         return with_host_column(df, self.getOrDefault("predictionCol"), pred)
+
+
+class LinearSVC(Estimator):
+    """Linear SVM via jitted full-batch subgradient descent on the
+    squared-hinge objective (reference: ml/classification/LinearSVC.scala
+    — its OWLQN/breeze optimizer replaced by one XLA scan program)."""
+
+    _params = {"featuresCol": "features", "labelCol": "label",
+               "predictionCol": "prediction", "regParam": 0.01,
+               "maxIter": 200, "fitIntercept": True}
+
+    def fit(self, df) -> "LinearSVCModel":
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        cols = resolve_feature_cols(df, self.getOrDefault("featuresCol"))
+        X = extract_matrix(df, cols)
+        y = extract_vector(df, self.getOrDefault("labelCol"))
+        if self.getOrDefault("fitIntercept"):
+            X = np.concatenate([X, np.ones((X.shape[0], 1))], axis=1)
+        n, d = X.shape
+        Xd = jnp.asarray(X)
+        yd = jnp.asarray(np.where(y > 0, 1.0, -1.0))
+        lam = float(self.getOrDefault("regParam"))
+        iters = int(self.getOrDefault("maxIter"))
+        lr = float(n) / (np.linalg.norm(X, ord="fro") ** 2 + 1e-12)
+
+        @jax.jit
+        def run(w0):
+            def step(w, _):
+                margin = yd * (Xd @ w)
+                viol = jnp.maximum(0.0, 1.0 - margin)  # squared hinge
+                g = -(Xd.T @ (yd * viol)) * (2.0 / n) + lam * w
+                return w - lr * g, None
+
+            w, _ = lax.scan(step, w0, None, length=iters)
+            return w
+
+        w = np.asarray(run(jnp.zeros(d)))
+        m = LinearSVCModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"))
+        if self.getOrDefault("fitIntercept"):
+            m.coefficients, m.intercept = w[:-1], float(w[-1])
+        else:
+            m.coefficients, m.intercept = w, 0.0
+        m.cols = cols
+        return m
+
+
+class LinearSVCModel(Model):
+    _params = {"featuresCol": "features", "predictionCol": "prediction"}
+
+    def transform(self, df):
+        X = extract_matrix(df, self.cols)
+        pred = (X @ self.coefficients + self.intercept >= 0) \
+            .astype(np.float64)
+        return with_host_column(df, self.getOrDefault("predictionCol"),
+                                pred)
+
+
+class MultilayerPerceptronClassifier(Estimator):
+    """Feed-forward network trained with jax.grad + full-batch Adam in
+    one lax.scan program — the estimator whose compute maps to the MXU
+    most directly (reference: ml/classification/
+    MultilayerPerceptronClassifier.scala, its LBFGS replaced by Adam)."""
+
+    _params = {"featuresCol": "features", "labelCol": "label",
+               "predictionCol": "prediction", "layers": None,
+               "maxIter": 300, "stepSize": 0.03, "seed": 7}
+
+    def fit(self, df) -> "MultilayerPerceptronModel":
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        cols = resolve_feature_cols(df, self.getOrDefault("featuresCol"))
+        X = extract_matrix(df, cols)
+        y = extract_vector(df, self.getOrDefault("labelCol")) \
+            .astype(np.int64)
+        layers = self.getOrDefault("layers") or \
+            [X.shape[1], 16, int(y.max()) + 1]
+        assert layers[0] == X.shape[1], "layers[0] must equal n_features"
+        rng = np.random.default_rng(self.getOrDefault("seed"))
+        params0 = []
+        for i in range(len(layers) - 1):
+            fan_in, fan_out = layers[i], layers[i + 1]
+            params0.append((
+                jnp.asarray(rng.normal(0, np.sqrt(2.0 / fan_in),
+                                       (fan_in, fan_out))),
+                jnp.zeros(fan_out)))
+        Xd, yd = jnp.asarray(X), jnp.asarray(y)
+        lr = float(self.getOrDefault("stepSize"))
+        iters = int(self.getOrDefault("maxIter"))
+
+        def forward(params, x):
+            h = x
+            for W, b in params[:-1]:
+                h = jax.nn.relu(h @ W + b)
+            W, b = params[-1]
+            return h @ W + b
+
+        def loss(params):
+            logits = forward(params, Xd)
+            return -jnp.mean(
+                jax.nn.log_softmax(logits)[jnp.arange(len(yd)), yd])
+
+        @jax.jit
+        def run(p0):
+            def step(carry, _):
+                params, m, v, t = carry
+                g = jax.grad(loss)(params)
+                t = t + 1
+                m = jax.tree.map(lambda a, b: 0.9 * a + 0.1 * b, m, g)
+                v = jax.tree.map(lambda a, b: 0.999 * a + 0.001 * b ** 2,
+                                 v, g)
+                mh = jax.tree.map(lambda a: a / (1 - 0.9 ** t), m)
+                vh = jax.tree.map(lambda a: a / (1 - 0.999 ** t), v)
+                params = jax.tree.map(
+                    lambda p, a, b: p - lr * a / (jnp.sqrt(b) + 1e-8),
+                    params, mh, vh)
+                return (params, m, v, t), None
+
+            zeros = jax.tree.map(jnp.zeros_like, p0)
+            (params, _, _, _), _ = lax.scan(
+                step, (p0, zeros, zeros, 0.0), None, length=iters)
+            return params
+
+        params = run(params0)
+        m = MultilayerPerceptronModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"))
+        m.params = [(np.asarray(W), np.asarray(b)) for W, b in params]
+        m.cols = cols
+        return m
+
+
+class MultilayerPerceptronModel(Model):
+    _params = {"featuresCol": "features", "predictionCol": "prediction"}
+
+    def transform(self, df):
+        X = extract_matrix(df, self.cols)
+        h = X
+        for W, b in self.params[:-1]:
+            h = np.maximum(h @ W + b, 0.0)
+        W, b = self.params[-1]
+        pred = np.argmax(h @ W + b, axis=1).astype(np.float64)
+        return with_host_column(df, self.getOrDefault("predictionCol"),
+                                pred)

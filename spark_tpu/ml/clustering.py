@@ -63,3 +63,132 @@ class KMeansModel(Model):
         d2 = ((X[:, None, :] - self.clusterCenters[None]) ** 2).sum(-1)
         pred = np.argmin(d2, axis=1).astype(np.float64)
         return with_host_column(df, self.getOrDefault("predictionCol"), pred)
+
+
+class GaussianMixture(Estimator):
+    """Diagonal-covariance GMM by EM, the whole loop one jitted lax.scan
+    program (reference: ml/clustering/GaussianMixture.scala — its
+    aggregation-tree E/M steps become batched device matmuls)."""
+
+    _params = {"featuresCol": "features", "predictionCol": "prediction",
+               "probabilityCol": "probability", "k": 2, "maxIter": 100,
+               "seed": 11, "tol": 1e-6}
+
+    def fit(self, df) -> "GaussianMixtureModel":
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from .base import extract_matrix, resolve_feature_cols
+
+        cols = resolve_feature_cols(df, self.getOrDefault("featuresCol"))
+        X = extract_matrix(df, cols)
+        k = int(self.getOrDefault("k"))
+        n, d = X.shape
+        rng = np.random.default_rng(self.getOrDefault("seed"))
+        mu0 = X[rng.choice(n, size=k, replace=False)]
+        var0 = np.tile(X.var(axis=0) + 1e-6, (k, 1))
+        w0 = np.full(k, 1.0 / k)
+        Xd = jnp.asarray(X)
+
+        @jax.jit
+        def run(mu, var, w):
+            def step(carry, _):
+                mu, var, w = carry
+                # E: log N(x | mu_j, diag var_j) for all pairs [n, k]
+                diff2 = (Xd[:, None, :] - mu[None, :, :]) ** 2
+                logp = (-0.5 * (diff2 / var[None]).sum(-1)
+                        - 0.5 * jnp.log(2 * jnp.pi * var).sum(-1)[None]
+                        + jnp.log(w)[None])
+                r = jax.nn.softmax(logp, axis=1)          # [n, k]
+                nk = r.sum(0) + 1e-12
+                # M: weighted moments — MXU matmuls
+                mu = (r.T @ Xd) / nk[:, None]
+                ex2 = (r.T @ (Xd ** 2)) / nk[:, None]
+                var = jnp.maximum(ex2 - mu ** 2, 1e-6)
+                w = nk / nk.sum()
+                return (mu, var, w), None
+
+            (mu, var, w), _ = lax.scan(
+                step, (mu, var, w), None,
+                length=int(self.getOrDefault("maxIter")))
+            return mu, var, w
+
+        mu, var, w = run(jnp.asarray(mu0), jnp.asarray(var0),
+                         jnp.asarray(w0))
+        m = GaussianMixtureModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"),
+            probabilityCol=self.getOrDefault("probabilityCol"),
+            k=k)
+        m.weights = np.asarray(w)
+        m.means = np.asarray(mu)
+        m.variances = np.asarray(var)
+        m.cols = cols
+        return m
+
+
+class GaussianMixtureModel(Model):
+    _params = {"featuresCol": "features", "predictionCol": "prediction",
+               "probabilityCol": "probability", "k": 2}
+
+    def _resp(self, X):
+        diff2 = (X[:, None, :] - self.means[None]) ** 2
+        logp = (-0.5 * (diff2 / self.variances[None]).sum(-1)
+                - 0.5 * np.log(2 * np.pi * self.variances).sum(-1)[None]
+                + np.log(self.weights)[None])
+        logp -= logp.max(axis=1, keepdims=True)
+        p = np.exp(logp)
+        return p / p.sum(axis=1, keepdims=True)
+
+    def transform(self, df):
+        from .base import extract_matrix, with_host_column
+
+        X = extract_matrix(df, self.cols)
+        r = self._resp(X)
+        out = with_host_column(df, self.getOrDefault("predictionCol"),
+                               np.argmax(r, axis=1).astype(np.float64))
+        return with_host_column(out, self.getOrDefault("probabilityCol"),
+                                r.max(axis=1))
+
+
+class BisectingKMeans(Estimator):
+    """Top-down hierarchical k-means: repeatedly 2-means-split the
+    largest cluster (reference: ml/clustering/BisectingKMeans.scala)."""
+
+    _params = {"featuresCol": "features", "predictionCol": "prediction",
+               "k": 4, "maxIter": 20, "seed": 5}
+
+    def fit(self, df) -> "KMeansModel":
+        from .base import extract_matrix, resolve_feature_cols
+
+        cols = resolve_feature_cols(df, self.getOrDefault("featuresCol"))
+        X = extract_matrix(df, cols)
+        k = int(self.getOrDefault("k"))
+        rng = np.random.default_rng(self.getOrDefault("seed"))
+        assign = np.zeros(len(X), dtype=np.int64)
+        centers = [X.mean(axis=0)]
+        while len(centers) < k:
+            sizes = np.bincount(assign, minlength=len(centers))
+            target = int(np.argmax(sizes))
+            idx = np.nonzero(assign == target)[0]
+            if len(idx) < 2:
+                break
+            sub = X[idx]
+            c = sub[rng.choice(len(sub), 2, replace=False)]
+            for _ in range(int(self.getOrDefault("maxIter"))):
+                d2 = ((sub[:, None] - c[None]) ** 2).sum(-1)
+                lab = d2.argmin(1)
+                for j in (0, 1):
+                    if (lab == j).any():
+                        c[j] = sub[lab == j].mean(axis=0)
+            new_id = len(centers)
+            centers[target] = c[0]
+            centers.append(c[1])
+            assign[idx[lab == 1]] = new_id
+        m = KMeansModel(featuresCol=self.getOrDefault("featuresCol"),
+                        predictionCol=self.getOrDefault("predictionCol"),
+                        k=len(centers))
+        m.clusterCenters = np.stack(centers)
+        m.cols = cols
+        return m

@@ -269,3 +269,173 @@ class Binarizer(Transformer):
             self.getOrDefault("outputCol"),
             F.when(F.col(self.getOrDefault("inputCol")) > t, 1.0)
             .otherwise(0.0))
+
+
+class Imputer(Estimator):
+    """Fill missing values with mean/median per column
+    (ml/feature/Imputer.scala)."""
+
+    _params = {"inputCols": (), "outputCols": (), "strategy": "mean"}
+
+    def fit(self, df) -> "ImputerModel":
+        cols = list(self.getOrDefault("inputCols"))
+        table = df.select(*cols).toArrow()
+        fills = {}
+        for c in cols:
+            v = np.asarray(table.column(c).to_numpy(zero_copy_only=False),
+                           dtype=np.float64)
+            ok = v[~np.isnan(v)]
+            if not len(ok):
+                fills[c] = 0.0  # all-null column: nothing to estimate
+            elif self.getOrDefault("strategy") == "median":
+                fills[c] = float(np.median(ok))
+            else:
+                fills[c] = float(ok.mean())
+        m = ImputerModel(inputCols=tuple(cols),
+                         outputCols=tuple(self.getOrDefault("outputCols"))
+                         or tuple(cols))
+        m.fills = fills
+        return m
+
+
+class ImputerModel(Model):
+    _params = {"inputCols": (), "outputCols": ()}
+
+    def transform(self, df):
+        import spark_tpu.api.functions as F
+
+        out = df
+        for src, dst in zip(self.getOrDefault("inputCols"),
+                            self.getOrDefault("outputCols")):
+            out = out.withColumn(
+                dst, F.coalesce(F.col(src), F.lit(self.fills[src])))
+        return out
+
+
+class Normalizer(Transformer):
+    """Row-wise p-norm scaling of the feature matrix
+    (ml/feature/Normalizer.scala)."""
+
+    _params = {"inputCol": "features", "outputCol": "normalized", "p": 2.0}
+
+    def transform(self, df):
+        cols = resolve_feature_cols(df, self.getOrDefault("inputCol"))
+        X = extract_matrix(df, cols)
+        p = float(self.getOrDefault("p"))
+        norms = np.power(np.power(np.abs(X), p).sum(axis=1), 1.0 / p)
+        norms[norms == 0] = 1.0
+        Xn = X / norms[:, None]
+        out = df
+        names = []
+        for i, c in enumerate(cols):
+            name = f"{self.getOrDefault('outputCol')}_{c}"
+            out = with_host_column(out, name, Xn[:, i])
+            names.append(name)
+        meta = dict(getattr(out, "_ml_features", None) or {})
+        meta[self.getOrDefault("outputCol")] = names
+        out._ml_features = meta
+        return out
+
+
+class MaxAbsScaler(Estimator):
+    _params = {"inputCol": "features", "outputCol": "scaled"}
+
+    def fit(self, df) -> "MaxAbsScalerModel":
+        cols = resolve_feature_cols(df, self.getOrDefault("inputCol"))
+        X = extract_matrix(df, cols)
+        scale = np.abs(X).max(axis=0)
+        scale[scale == 0] = 1.0
+        m = MaxAbsScalerModel(inputCol=self.getOrDefault("inputCol"),
+                              outputCol=self.getOrDefault("outputCol"))
+        m.cols, m.scale = cols, scale
+        return m
+
+
+class MaxAbsScalerModel(Model):
+    _params = {"inputCol": "features", "outputCol": "scaled"}
+
+    def transform(self, df):
+        X = extract_matrix(df, self.cols) / self.scale[None, :]
+        out = df
+        names = []
+        for i, c in enumerate(self.cols):
+            name = f"{self.getOrDefault('outputCol')}_{c}"
+            out = with_host_column(out, name, X[:, i])
+            names.append(name)
+        meta = dict(getattr(out, "_ml_features", None) or {})
+        meta[self.getOrDefault("outputCol")] = names
+        out._ml_features = meta
+        return out
+
+
+class RobustScaler(Estimator):
+    """Median/IQR scaling (ml/feature/RobustScaler.scala)."""
+
+    _params = {"inputCol": "features", "outputCol": "scaled",
+               "withCentering": True, "withScaling": True,
+               "lower": 0.25, "upper": 0.75}
+
+    def fit(self, df) -> "RobustScalerModel":
+        cols = resolve_feature_cols(df, self.getOrDefault("inputCol"))
+        X = extract_matrix(df, cols)
+        med = np.median(X, axis=0)
+        iqr = (np.quantile(X, self.getOrDefault("upper"), axis=0)
+               - np.quantile(X, self.getOrDefault("lower"), axis=0))
+        iqr[iqr == 0] = 1.0
+        m = RobustScalerModel(
+            inputCol=self.getOrDefault("inputCol"),
+            outputCol=self.getOrDefault("outputCol"),
+            withCentering=self.getOrDefault("withCentering"),
+            withScaling=self.getOrDefault("withScaling"))
+        m.cols, m.median, m.iqr = cols, med, iqr
+        return m
+
+
+class RobustScalerModel(Model):
+    _params = {"inputCol": "features", "outputCol": "scaled",
+               "withCentering": True, "withScaling": True}
+
+    def transform(self, df):
+        X = extract_matrix(df, self.cols)
+        if self.getOrDefault("withCentering"):
+            X = X - self.median[None, :]
+        if self.getOrDefault("withScaling"):
+            X = X / self.iqr[None, :]
+        out = df
+        names = []
+        for i, c in enumerate(self.cols):
+            name = f"{self.getOrDefault('outputCol')}_{c}"
+            out = with_host_column(out, name, X[:, i])
+            names.append(name)
+        meta = dict(getattr(out, "_ml_features", None) or {})
+        meta[self.getOrDefault("outputCol")] = names
+        out._ml_features = meta
+        return out
+
+
+class PolynomialExpansion(Transformer):
+    """Degree-2/3 polynomial feature expansion
+    (ml/feature/PolynomialExpansion.scala)."""
+
+    _params = {"inputCol": "features", "outputCol": "poly", "degree": 2}
+
+    def transform(self, df):
+        import itertools
+
+        cols = resolve_feature_cols(df, self.getOrDefault("inputCol"))
+        X = extract_matrix(df, cols)
+        degree = int(self.getOrDefault("degree"))
+        out = df
+        names = []
+        idx = range(X.shape[1])
+        for deg in range(1, degree + 1):
+            for combo in itertools.combinations_with_replacement(idx, deg):
+                name = f"{self.getOrDefault('outputCol')}_" + \
+                    "_".join(str(i) for i in combo)
+                v = np.prod(X[:, list(combo)], axis=1)
+                out = with_host_column(out, name, v)
+                names.append(name)
+        meta = dict(getattr(out, "_ml_features", None) or {})
+        meta[self.getOrDefault("outputCol")] = names
+        out._ml_features = meta
+        return out

@@ -103,3 +103,56 @@ def _gd_fit(X: np.ndarray, y: np.ndarray, lam: float, iters: int,
         return w
 
     return np.asarray(run(jnp.zeros(d)))
+
+
+class IsotonicRegression(Estimator):
+    """Monotone fit via pool-adjacent-violators
+    (ml/regression/IsotonicRegression.scala)."""
+
+    _params = {"featuresCol": "features", "labelCol": "label",
+               "predictionCol": "prediction", "isotonic": True}
+
+    def fit(self, df) -> "IsotonicRegressionModel":
+        cols = resolve_feature_cols(df, self.getOrDefault("featuresCol"))
+        x = extract_matrix(df, cols)[:, 0]
+        y = extract_vector(df, self.getOrDefault("labelCol"))
+        if not self.getOrDefault("isotonic"):
+            y = -y
+        order = np.argsort(x, kind="stable")
+        xs, ys = x[order], y[order].astype(np.float64)
+        # PAVA: merge adjacent violating blocks
+        vals = list(ys)
+        wts = [1.0] * len(ys)
+        i = 0
+        while i < len(vals) - 1:
+            if vals[i] > vals[i + 1] + 1e-15:
+                tot = vals[i] * wts[i] + vals[i + 1] * wts[i + 1]
+                w = wts[i] + wts[i + 1]
+                vals[i:i + 2] = [tot / w]
+                wts[i:i + 2] = [w]
+                if i > 0:
+                    i -= 1
+            else:
+                i += 1
+        fitted = np.repeat(np.asarray(vals),
+                           np.asarray(wts, dtype=np.int64))
+        if not self.getOrDefault("isotonic"):
+            fitted = -fitted
+        m = IsotonicRegressionModel(
+            featuresCol=self.getOrDefault("featuresCol"),
+            predictionCol=self.getOrDefault("predictionCol"))
+        m.boundaries = xs
+        m.predictions = fitted
+        m.cols = cols
+        return m
+
+
+class IsotonicRegressionModel(Model):
+    _params = {"featuresCol": "features", "predictionCol": "prediction"}
+
+    def transform(self, df):
+        x = extract_matrix(df, self.cols)[:, 0]
+        idx = np.clip(np.searchsorted(self.boundaries, x, side="right") - 1,
+                      0, len(self.predictions) - 1)
+        return with_host_column(df, self.getOrDefault("predictionCol"),
+                                self.predictions[idx])

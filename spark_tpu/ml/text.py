@@ -1,0 +1,211 @@
+"""Text feature pipeline: Tokenizer → StopWordsRemover/NGram →
+HashingTF/CountVectorizer → IDF.
+
+Role of the reference's text features (mllib ml/feature/{Tokenizer,
+RegexTokenizer, StopWordsRemover, NGram, HashingTF, CountVectorizer,
+IDF}.scala). TPU-first shape: token lists are host columns
+(list<string> — strings never land on the device), while the produced
+term-frequency vectors are fixed-width list<double> columns that
+`extract_matrix` expands straight into the [n, d] device matrix every
+estimator trains on — so the classic `Tokenizer → HashingTF → IDF →
+LogisticRegression` pipeline runs its training matmuls on the MXU.
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+
+import numpy as np
+import pyarrow as pa
+
+from .base import Estimator, Model, Transformer
+
+# a small default stop-word list (reference ships loadDefaultStopWords)
+_DEFAULT_STOP_WORDS = frozenset("""
+a an and are as at be by for from has he in is it its of on that the to
+was were will with i you your this they but not or so if then than too
+very can could should would do does did done no nor only own same s t
+""".split())
+
+
+def _doc_col(df, col: str) -> list:
+    return df.select(col).toArrow().column(0).to_pylist()
+
+
+def _with_list_column(df, name: str, values, value_type=pa.string()):
+    table = df.toArrow()
+    arr = pa.array(values, type=pa.list_(value_type))
+    if name in table.column_names:
+        table = table.drop_columns([name])
+    out = df.session.createDataFrame(table.append_column(name, arr))
+    out._ml_features = getattr(df, "_ml_features", None)
+    return out
+
+
+class Tokenizer(Transformer):
+    """Lowercase whitespace tokenizer (ml/feature/Tokenizer.scala)."""
+
+    _params = {"inputCol": "text", "outputCol": "tokens"}
+
+    def transform(self, df):
+        docs = _doc_col(df, self.getOrDefault("inputCol"))
+        toks = [(d or "").lower().split() for d in docs]
+        return _with_list_column(df, self.getOrDefault("outputCol"), toks)
+
+
+class RegexTokenizer(Transformer):
+    """Pattern-based tokenizer (ml/feature/RegexTokenizer.scala)."""
+
+    _params = {"inputCol": "text", "outputCol": "tokens",
+               "pattern": r"\s+", "gaps": True, "toLowercase": True,
+               "minTokenLength": 1}
+
+    def transform(self, df):
+        pat = re.compile(self.getOrDefault("pattern"))
+        gaps = self.getOrDefault("gaps")
+        lower = self.getOrDefault("toLowercase")
+        mlen = self.getOrDefault("minTokenLength")
+        out = []
+        for d in _doc_col(df, self.getOrDefault("inputCol")):
+            s = (d or "")
+            if lower:
+                s = s.lower()
+            toks = pat.split(s) if gaps else pat.findall(s)
+            out.append([t for t in toks if len(t) >= mlen])
+        return _with_list_column(df, self.getOrDefault("outputCol"), out)
+
+
+class StopWordsRemover(Transformer):
+    _params = {"inputCol": "tokens", "outputCol": "filtered",
+               "stopWords": None, "caseSensitive": False}
+
+    def transform(self, df):
+        sw = self.getOrDefault("stopWords")
+        cs = self.getOrDefault("caseSensitive")
+        stop = set(sw) if sw is not None else set(_DEFAULT_STOP_WORDS)
+        if not cs:
+            stop = {w.lower() for w in stop}
+        out = []
+        for toks in _doc_col(df, self.getOrDefault("inputCol")):
+            out.append([t for t in (toks or [])
+                        if (t if cs else t.lower()) not in stop])
+        return _with_list_column(df, self.getOrDefault("outputCol"), out)
+
+
+class NGram(Transformer):
+    _params = {"inputCol": "tokens", "outputCol": "ngrams", "n": 2}
+
+    def transform(self, df):
+        n = self.getOrDefault("n")
+        out = []
+        for toks in _doc_col(df, self.getOrDefault("inputCol")):
+            toks = toks or []
+            out.append([" ".join(toks[i:i + n])
+                        for i in range(len(toks) - n + 1)])
+        return _with_list_column(df, self.getOrDefault("outputCol"), out)
+
+
+def _hash_bucket(term: str, num_features: int) -> int:
+    # crc32: deterministic across processes (python hash() is salted)
+    return zlib.crc32(term.encode()) % num_features
+
+
+class HashingTF(Transformer):
+    """Hashing-trick term frequencies → fixed-width list<double> column
+    (ml/feature/HashingTF.scala)."""
+
+    _params = {"inputCol": "tokens", "outputCol": "tf",
+               "numFeatures": 256, "binary": False}
+
+    def transform(self, df):
+        d = self.getOrDefault("numFeatures")
+        binary = self.getOrDefault("binary")
+        vecs = []
+        for toks in _doc_col(df, self.getOrDefault("inputCol")):
+            v = np.zeros(d)
+            for t in (toks or []):
+                i = _hash_bucket(t, d)
+                v[i] = 1.0 if binary else v[i] + 1.0
+            vecs.append(v.tolist())
+        return _with_list_column(df, self.getOrDefault("outputCol"), vecs,
+                                 pa.float64())
+
+
+class CountVectorizer(Estimator):
+    """Vocabulary-based term counts (ml/feature/CountVectorizer.scala):
+    vocab = top vocabSize terms by document frequency, minDF pruning."""
+
+    _params = {"inputCol": "tokens", "outputCol": "tf",
+               "vocabSize": 1 << 10, "minDF": 1.0}
+
+    def fit(self, df) -> "CountVectorizerModel":
+        docs = _doc_col(df, self.getOrDefault("inputCol"))
+        n_docs = max(len(docs), 1)
+        dfreq: dict[str, int] = {}
+        for toks in docs:
+            for t in set(toks or []):
+                dfreq[t] = dfreq.get(t, 0) + 1
+        min_df = self.getOrDefault("minDF")
+        min_count = min_df if min_df >= 1.0 else min_df * n_docs
+        terms = [(c, t) for t, c in dfreq.items() if c >= min_count]
+        terms.sort(key=lambda x: (-x[0], x[1]))
+        vocab = [t for _, t in terms[:self.getOrDefault("vocabSize")]]
+        return CountVectorizerModel(
+            inputCol=self.getOrDefault("inputCol"),
+            outputCol=self.getOrDefault("outputCol"))._with_vocab(vocab)
+
+
+class CountVectorizerModel(Model):
+    _params = {"inputCol": "tokens", "outputCol": "tf"}
+
+    def _with_vocab(self, vocab):
+        self.vocabulary = list(vocab)
+        self._index = {t: i for i, t in enumerate(vocab)}
+        return self
+
+    def transform(self, df):
+        d = len(self.vocabulary)
+        vecs = []
+        for toks in _doc_col(df, self.getOrDefault("inputCol")):
+            v = np.zeros(d)
+            for t in (toks or []):
+                i = self._index.get(t)
+                if i is not None:
+                    v[i] += 1.0
+            vecs.append(v.tolist())
+        return _with_list_column(df, self.getOrDefault("outputCol"), vecs,
+                                 pa.float64())
+
+
+class IDF(Estimator):
+    """Inverse document frequency over TF vectors
+    (ml/feature/IDF.scala): idf = log((n+1)/(df+1))."""
+
+    _params = {"inputCol": "tf", "outputCol": "tfidf", "minDocFreq": 0}
+
+    def fit(self, df) -> "IDFModel":
+        tf = np.asarray(_doc_col(df, self.getOrDefault("inputCol")),
+                        dtype=np.float64)
+        n = tf.shape[0]
+        dfreq = (tf > 0).sum(axis=0)
+        idf = np.log((n + 1.0) / (dfreq + 1.0))
+        idf[dfreq < self.getOrDefault("minDocFreq")] = 0.0
+        return IDFModel(inputCol=self.getOrDefault("inputCol"),
+                        outputCol=self.getOrDefault("outputCol")) \
+            ._with_idf(idf)
+
+
+class IDFModel(Model):
+    _params = {"inputCol": "tf", "outputCol": "tfidf", "minDocFreq": 0}
+
+    def _with_idf(self, idf):
+        self.idf = idf
+        return self
+
+    def transform(self, df):
+        tf = np.asarray(_doc_col(df, self.getOrDefault("inputCol")),
+                        dtype=np.float64)
+        out = (tf * self.idf[None, :]).tolist()
+        return _with_list_column(df, self.getOrDefault("outputCol"), out,
+                                 pa.float64())

@@ -272,3 +272,183 @@ class DataFrame:
 
     def __repr__(self):
         return repr(self._df.limit(20).toPandas())
+
+
+# ---------------------------------------------------------------------------
+# r4 breadth (reference: python/pyspark/pandas — Series.str accessor,
+# apply-as-UDF, query, pivot_table, IO writers)
+# ---------------------------------------------------------------------------
+
+class _StrAccessor:
+    """Series.str namespace (pyspark.pandas strings.py role)."""
+
+    def __init__(self, s: "Series"):
+        self._s = s
+
+    def _wrap(self, col):
+        return self._s._wrap(col)
+
+    def upper(self):
+        return self._wrap(F.upper(self._s._col))
+
+    def lower(self):
+        return self._wrap(F.lower(self._s._col))
+
+    def len(self):  # noqa: A003
+        return self._wrap(F.length(self._s._col))
+
+    def contains(self, pat: str):
+        return self._wrap(self._s._col.contains(pat))
+
+    def startswith(self, pat: str):
+        return self._wrap(self._s._col.startswith(pat))
+
+    def endswith(self, pat: str):
+        return self._wrap(self._s._col.endswith(pat))
+
+    def replace(self, pat: str, repl: str):
+        return self._wrap(F.regexp_replace(self._s._col, pat, repl))
+
+    def strip(self):
+        return self._wrap(F.trim(self._s._col))
+
+
+def _extend_series():
+    """Attach the r4 Series surface (kept out-of-line so the core class
+    above stays readable)."""
+
+    Series.str = property(_StrAccessor)
+
+    def astype(self, t):
+        name = {int: "bigint", float: "double", str: "string",
+                bool: "boolean"}.get(t, str(t))
+        return self._wrap(self._col.cast(name))
+
+    def _abs(self):
+        return self._wrap(F.abs(self._col))
+
+    def _round(self, ndigits: int = 0):
+        return self._wrap(F.round(self._col, ndigits))
+
+    def clip(self, lower=None, upper=None):
+        c = self._col
+        if lower is not None:
+            c = F.greatest(c, F.lit(lower))
+        if upper is not None:
+            c = F.least(c, F.lit(upper))
+        return self._wrap(c)
+
+    def between(self, lo, hi):
+        return self._wrap(self._col.between(lo, hi))
+
+    def std(self):
+        return self._agg(F.stddev)
+
+    def var(self):
+        return self._agg(F.variance)
+
+    def median(self):
+        return self._agg(F.median)
+
+    def unique(self):
+        t = self._frame._df.select(
+            self._col.alias(self.name)).distinct().toArrow()
+        return t.column(0).to_pylist()
+
+    def value_counts(self):
+        return (self._frame._df.groupBy(self._col.alias(self.name))
+                .count().orderBy(F.col("count").desc()).toPandas())
+
+    def apply(self, fn):
+        """Element-wise python function as a vectorized host UDF
+        (pyspark.pandas apply → ArrowEvalPython role)."""
+        u = F.udf(fn)
+        return self._wrap(u(self._col))
+
+    map = apply  # noqa: A003
+
+    Series.astype = astype
+    Series.abs = _abs
+    Series.round = _round
+    Series.clip = clip
+    Series.between = between
+    Series.std = std
+    Series.var = var
+    Series.median = median
+    Series.unique = unique
+    Series.value_counts = value_counts
+    Series.apply = apply
+    Series.map = apply
+
+
+_extend_series()
+
+
+def _extend_frame():
+    def fillna(self, value) -> "DataFrame":
+        return DataFrame(self._df.na.fill(value))
+
+    def query(self, expr: str) -> "DataFrame":
+        return DataFrame(self._df.filter(expr))
+
+    def nlargest(self, n: int, columns) -> "DataFrame":
+        keys = [columns] if isinstance(columns, str) else list(columns)
+        return DataFrame(self._df.orderBy(
+            *[F.col(k).desc() for k in keys]).limit(n))
+
+    def nsmallest(self, n: int, columns) -> "DataFrame":
+        keys = [columns] if isinstance(columns, str) else list(columns)
+        return DataFrame(self._df.orderBy(*keys).limit(n))
+
+    def pivot_table(self, values: str, index: str, columns: str,
+                    aggfunc: str = "mean"):
+        agg = {"mean": F.avg, "sum": F.sum, "count": F.count,
+               "min": F.min, "max": F.max}[aggfunc]
+        return DataFrame(self._df.groupBy(index).pivot(columns)
+                         .agg(agg(values)))
+
+    def nunique(self):
+        import pandas as pd
+
+        # one query per column: the engine rejects several DISTINCT
+        # aggregates over different expressions in one Aggregate
+        out = {}
+        for c in self.columns:
+            row = self._df.agg(F.countDistinct(c).alias("n")).toPandas()
+            out[c] = int(row["n"][0])
+        return pd.Series(out)
+
+    def to_parquet(self, path: str) -> None:
+        self._df.write.mode("overwrite").parquet(path)
+
+    def to_csv(self, path: str) -> None:
+        self._df.write.mode("overwrite").csv(path)
+
+    DataFrame.fillna = fillna
+    DataFrame.query = query
+    DataFrame.nlargest = nlargest
+    DataFrame.nsmallest = nsmallest
+    DataFrame.pivot_table = pivot_table
+    DataFrame.nunique = nunique
+    DataFrame.to_parquet = to_parquet
+    DataFrame.to_csv = to_csv
+
+
+_extend_frame()
+
+
+def concat(frames) -> "DataFrame":
+    """Row-wise union (pd.concat axis=0 over same-schema frames)."""
+    frames = list(frames)
+    df = frames[0]._df
+    for f in frames[1:]:
+        df = df.union(f._df)
+    return DataFrame(df)
+
+
+def read_json(path: str) -> "DataFrame":
+    return DataFrame(_session().read.json(path))
+
+
+def read_orc(path: str) -> "DataFrame":
+    return DataFrame(_session().read.orc(path))

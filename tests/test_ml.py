@@ -266,3 +266,174 @@ def test_fpgrowth(spark):
                for r in rules)
     pred = model.transform(df).toArrow().to_pydict()["prediction"]
     assert "bread" in pred[4]  # butter → bread suggested
+
+
+# ---------------------------------------------------------------------------
+# r4 breadth: text pipeline, SVC, MLP, GMM, isotonic, scalers
+# ---------------------------------------------------------------------------
+
+def test_text_pipeline_tfidf_classification(spark):
+    """Tokenizer → StopWordsRemover → HashingTF → IDF → LogisticRegression
+    end to end (the reference's canonical text pipeline example)."""
+    import pyarrow as pa
+
+    from spark_tpu.ml import (
+        HashingTF, IDF, LogisticRegression, Pipeline, StopWordsRemover,
+        Tokenizer,
+    )
+
+    docs = ["spark is great and fast", "tpu math compiles fast",
+            "slow mail arrived late again", "the mail office was slow"]
+    labels = [1.0, 1.0, 0.0, 0.0]
+    df = spark.createDataFrame(pa.table({"text": docs, "label": labels}))
+    pipe = Pipeline(stages=[
+        Tokenizer(inputCol="text", outputCol="tokens"),
+        StopWordsRemover(inputCol="tokens", outputCol="filtered"),
+        HashingTF(inputCol="filtered", outputCol="tf", numFeatures=64),
+        IDF(inputCol="tf", outputCol="tfidf"),
+        LogisticRegression(featuresCol="tfidf", labelCol="label",
+                           maxIter=300),
+    ])
+    model = pipe.fit(df)
+    out = model.transform(df).toArrow()
+    assert out.column("prediction").to_pylist() == labels
+
+
+def test_count_vectorizer_and_ngram(spark):
+    import pyarrow as pa
+
+    from spark_tpu.ml import CountVectorizer, NGram, Tokenizer
+
+    df = spark.createDataFrame(pa.table({
+        "text": ["a b a c", "b c b", "a a a"]}))
+    toks = Tokenizer(inputCol="text", outputCol="t").transform(df)
+    cv = CountVectorizer(inputCol="t", outputCol="tf", vocabSize=10).fit(toks)
+    assert set(cv.vocabulary) == {"a", "b", "c"}
+    out = cv.transform(toks).toArrow()
+    mat = out.column("tf").to_pylist()
+    ai = cv.vocabulary.index("a")
+    assert [row[ai] for row in mat] == [2.0, 0.0, 3.0]
+    ng = NGram(inputCol="t", outputCol="bi", n=2).transform(toks).toArrow()
+    assert ng.column("bi").to_pylist()[0] == ["a b", "b a", "a c"]
+
+
+def test_linear_svc_separable(spark):
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu.ml import LinearSVC
+
+    rng = np.random.default_rng(0)
+    n = 200
+    x1 = rng.normal(0, 1, n)
+    x2 = rng.normal(0, 1, n)
+    y = (x1 + x2 > 0).astype(np.float64)
+    df = spark.createDataFrame(pa.table({"x1": x1, "x2": x2, "label": y}))
+    from spark_tpu.ml import VectorAssembler
+
+    df = VectorAssembler(inputCols=("x1", "x2"),
+                         outputCol="features").transform(df)
+    m = LinearSVC(maxIter=300).fit(df)
+    pred = m.transform(df).toArrow().column("prediction").to_pylist()
+    acc = np.mean(np.asarray(pred) == y)
+    assert acc >= 0.95, acc
+
+
+def test_mlp_learns_xor(spark):
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu.ml import MultilayerPerceptronClassifier, VectorAssembler
+
+    rng = np.random.default_rng(1)
+    n = 400
+    a = rng.integers(0, 2, n)
+    b = rng.integers(0, 2, n)
+    y = (a ^ b).astype(np.float64)
+    df = spark.createDataFrame(pa.table({
+        "a": a.astype(np.float64) + rng.normal(0, 0.05, n),
+        "b": b.astype(np.float64) + rng.normal(0, 0.05, n),
+        "label": y}))
+    df = VectorAssembler(inputCols=("a", "b"),
+                         outputCol="features").transform(df)
+    m = MultilayerPerceptronClassifier(
+        layers=[2, 8, 2], maxIter=500, stepSize=0.05).fit(df)
+    pred = m.transform(df).toArrow().column("prediction").to_pylist()
+    assert np.mean(np.asarray(pred) == y) >= 0.95
+
+
+def test_gaussian_mixture_separates_blobs(spark):
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu.ml import GaussianMixture, VectorAssembler
+
+    rng = np.random.default_rng(2)
+    n = 150
+    x = np.concatenate([rng.normal(-4, 0.5, n), rng.normal(4, 0.5, n)])
+    z = np.concatenate([rng.normal(-4, 0.5, n), rng.normal(4, 0.5, n)])
+    df = spark.createDataFrame(pa.table({"x": x, "z": z}))
+    df = VectorAssembler(inputCols=("x", "z"),
+                         outputCol="features").transform(df)
+    m = GaussianMixture(k=2, maxIter=50).fit(df)
+    pred = np.asarray(
+        m.transform(df).toArrow().column("prediction").to_pylist())
+    # each half should be (almost) pure one cluster
+    first, second = pred[:n], pred[n:]
+    purity = max((first == 0).mean() + (second == 1).mean(),
+                 (first == 1).mean() + (second == 0).mean()) / 2
+    assert purity >= 0.98
+
+
+def test_bisecting_kmeans(spark):
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu.ml import BisectingKMeans, VectorAssembler
+
+    rng = np.random.default_rng(3)
+    pts = np.concatenate([rng.normal(c, 0.3, 50) for c in (-6, 0, 6)])
+    df = spark.createDataFrame(pa.table({"x": pts}))
+    df = VectorAssembler(inputCols=("x",),
+                         outputCol="features").transform(df)
+    m = BisectingKMeans(k=3).fit(df)
+    pred = np.asarray(
+        m.transform(df).toArrow().column("prediction").to_pylist())
+    assert len(set(pred[:50])) == 1
+    assert len({pred[0], pred[60], pred[120]}) == 3
+
+
+def test_isotonic_regression_monotone(spark):
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu.ml import IsotonicRegression
+
+    x = np.arange(20, dtype=np.float64)
+    y = x + np.sin(x) * 2  # noisy but increasing trend
+    df = spark.createDataFrame(pa.table({"features": x, "label": y}))
+    m = IsotonicRegression().fit(df)
+    pred = np.asarray(
+        m.transform(df).toArrow().column("prediction").to_pylist())
+    assert np.all(np.diff(pred) >= -1e-9)  # monotone
+    assert abs(pred.mean() - y.mean()) < 1.0
+
+
+def test_imputer_and_robust_scaler(spark):
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu.ml import Imputer, RobustScaler, VectorAssembler
+
+    df = spark.createDataFrame(pa.table({
+        "v": [1.0, 2.0, None, 4.0, 100.0]}))
+    imp = Imputer(inputCols=("v",), outputCols=("vf",)).fit(df)
+    got = imp.transform(df).toArrow().column("vf").to_pylist()
+    assert got[2] == pytest.approx((1 + 2 + 4 + 100) / 4)
+    df2 = VectorAssembler(inputCols=("vf",), outputCol="features") \
+        .transform(imp.transform(df))
+    rs = RobustScaler().fit(df2)
+    out = rs.transform(df2)
+    scaled = out.toArrow().column("scaled_vf").to_pylist()
+    assert scaled[1] == pytest.approx(0.0, abs=1e-9) or \
+        abs(np.median(scaled)) < 1e-9  # centered on the median

@@ -60,3 +60,55 @@ def test_value_counts_dropna(psdf):
 
     pdf = pd.DataFrame({"x": [1.0, None, 3.0]})
     assert len(ps.from_pandas(pdf).dropna()) == 2
+
+
+# ---------------------------------------------------------------------------
+# r4 breadth
+# ---------------------------------------------------------------------------
+
+def test_str_accessor_and_astype(spark):
+    import spark_tpu.pandas as ps
+
+    df = ps.from_pandas(pd.DataFrame({
+        "s": ["Alpha", "beta ", "Gamma"], "v": [1.5, 2.5, 3.5]}))
+    up = df["s"].str.upper().to_pandas()
+    assert list(up) == ["ALPHA", "BETA ", "GAMMA"]
+    assert list(df["s"].str.strip().str.len().to_pandas()) == [5, 4, 5]
+    assert list(df["s"].str.contains("et").to_pandas()) == \
+        [False, True, False]
+    assert list(df["v"].astype(int).to_pandas()) == [1, 2, 3]
+    assert list(df["v"].round().to_pandas()) == [2.0, 3.0, 4.0]  # SQL HALF_UP
+
+
+def test_series_apply_and_stats(spark):
+    import spark_tpu.pandas as ps
+
+    df = ps.from_pandas(pd.DataFrame({"x": [1.0, 2.0, 3.0, 4.0]}))
+    assert list(df["x"].apply(lambda v: v * 10).to_pandas()) == \
+        [10.0, 20.0, 30.0, 40.0]
+    assert df["x"].std() == pd.Series([1.0, 2, 3, 4]).std()
+    assert sorted(df["x"].unique()) == [1.0, 2.0, 3.0, 4.0]
+
+
+def test_frame_query_pivot_and_io(spark, tmp_path):
+    import spark_tpu.pandas as ps
+
+    pdf = pd.DataFrame({
+        "k": ["a", "a", "b", "b"], "grp": ["x", "y", "x", "y"],
+        "v": [1.0, 2.0, 3.0, 4.0]})
+    df = ps.from_pandas(pdf)
+    q = df.query("v > 1.5").to_pandas()
+    assert len(q) == 3
+    piv = df.pivot_table(values="v", index="k", columns="grp",
+                         aggfunc="sum").to_pandas()
+    assert set(piv.columns) >= {"k", "x", "y"}
+    big = df.nlargest(1, "v").to_pandas()
+    assert big["v"].iloc[0] == 4.0
+    p = str(tmp_path / "ps.parquet")
+    df.to_parquet(p)
+    back = ps.read_parquet(p).to_pandas()
+    assert len(back) == 4
+    two = ps.concat([df, df]).to_pandas()
+    assert len(two) == 8
+    nn = df.nunique()
+    assert nn["k"] == 2 and nn["grp"] == 2 and nn["v"] == 4

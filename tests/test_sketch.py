@@ -73,3 +73,41 @@ def test_cms_merge_roundtrip():
     c = CountMinSketch.from_bytes(a.to_bytes())
     assert c.estimate_count("k") >= 5
     assert c.total == a.total
+
+
+# ---------------------------------------------------------------------------
+# VARIANT binary type (common/variant Variant.java role)
+# ---------------------------------------------------------------------------
+
+def test_variant_roundtrip():
+    from decimal import Decimal
+
+    from spark_tpu.utils.variant import Variant
+
+    obj = {"name": "spark", "n": 42, "pi": 3.5, "ok": True,
+           "tags": ["a", "b", {"deep": None}],
+           "price": Decimal("12.34")}
+    v = Variant.of(obj)
+    assert v.to_python() == obj
+    assert isinstance(v.metadata, bytes) and isinstance(v.value, bytes)
+
+
+def test_variant_parse_json_and_get():
+    from spark_tpu.utils.variant import Variant
+
+    v = Variant.parse_json(
+        '{"a": {"b": [10, 20, {"c": "x"}]}, "z": false}')
+    assert v.get("$.a.b[1]") == 20
+    assert v.get("$.a.b[2].c") == "x"
+    assert v.get("$.z") is False
+    assert v.get("$.missing") is None
+    assert v.get("$.a.b[9]") is None
+
+
+def test_variant_metadata_dictionary_shares_keys():
+    from spark_tpu.utils.variant import Variant
+
+    v = Variant.of([{"k": 1}, {"k": 2}, {"k": 3}])
+    # one dictionary entry regardless of repetitions
+    assert v.metadata.count(b"k") == 1
+    assert v.to_python() == [{"k": 1}, {"k": 2}, {"k": 3}]
