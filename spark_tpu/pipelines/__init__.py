@@ -1,0 +1,9 @@
+"""Declarative pipelines (reference: sql/pipelines + python/pyspark/pipelines).
+
+See graph.py for the execution model.
+"""
+
+from .graph import (  # noqa: F401
+    Pipeline, PipelineError, append_flow, materialized_view, table,
+    temporary_view,
+)

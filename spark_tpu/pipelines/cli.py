@@ -1,0 +1,39 @@
+"""Pipelines CLI (reference: bin/spark-pipelines →
+python/pyspark/pipelines/cli.py): run a python file that declares a
+Pipeline; every Pipeline instance found in the module is executed."""
+
+from __future__ import annotations
+
+import argparse
+import runpy
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="spark_tpu pipelines runner")
+    p.add_argument("script", help="python file declaring Pipeline(s)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="list datasets without materializing")
+    args = p.parse_args(argv)
+
+    from .graph import Pipeline
+
+    ns = runpy.run_path(args.script)
+    pipelines = [v for v in ns.values() if isinstance(v, Pipeline)]
+    if not pipelines:
+        print("no Pipeline instances found", file=sys.stderr)
+        return 1
+    for pl in pipelines:
+        if args.dry_run:
+            for name, ds in pl._datasets.items():
+                print(f"{ds.kind:18s} {name}"
+                      + (f"  ({len(ds.flows)} flows)" if ds.flows else ""))
+            continue
+        counts = pl.run()
+        for name, n in counts.items():
+            print(f"{name}: {n} rows")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,210 @@
+"""Declarative pipeline graph: datasets as decorated query functions.
+
+Role of the reference's Declarative Pipelines layer (sql/pipelines —
+graph/{DataflowGraph,GraphExecution,FlowExecution}.scala — and the
+python decorator surface python/pyspark/pipelines/api.py:
+materialized_view / table / temporary_view / append_flow). The model:
+
+* a DATASET is declared by decorating a zero-arg query function; its
+  body reads other datasets through `pipeline.read(name)` (or
+  `spark.table(name)` after they materialize);
+* dependencies are discovered DYNAMICALLY: running a flow that reads a
+  not-yet-materialized dataset recursively materializes it first, with
+  cycle detection (the reference resolves its graph topologically from
+  declared inputs; dynamic discovery needs no separate declaration);
+* `materialized_view` persists to the warehouse when one is configured
+  (falling back to a session temp view), `temporary_view` never
+  persists, `table` is a streaming-style target that APPEND FLOWS
+  (`append_flow(target=...)`) feed incrementally — each run executes
+  new flow output and unions it into the target, the reference's
+  streaming-table/flow split.
+
+    from spark_tpu.pipelines import Pipeline
+    p = Pipeline(spark)
+
+    @p.materialized_view()
+    def customers():
+        return spark.read.parquet("/data/customers")
+
+    @p.materialized_view()
+    def big_spenders():
+        return p.read("customers").filter("spend > 100")
+
+    p.run()   # materializes every dataset in dependency order
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+
+class PipelineError(RuntimeError):
+    pass
+
+
+class _Dataset:
+    def __init__(self, name: str, fn: Optional[Callable], kind: str,
+                 comment: str = ""):
+        self.name = name
+        self.fn = fn
+        self.kind = kind          # materialized_view | temporary_view | table
+        self.comment = comment
+        self.flows: list[tuple[str, Callable]] = []  # append flows
+
+
+class Pipeline:
+    """One dataflow graph bound to a session (DataflowGraph role)."""
+
+    def __init__(self, session):
+        self.session = session
+        self._datasets: dict[str, _Dataset] = {}
+        self._state: dict[str, str] = {}  # name → pending|running|done
+        self._lock = threading.RLock()
+        self.events: list[str] = []       # run log (ProgressReporter role)
+
+    # -- declaration decorators -----------------------------------------
+    def materialized_view(self, name: str | None = None, comment: str = ""):
+        return self._decorate("materialized_view", name, comment)
+
+    def temporary_view(self, name: str | None = None, comment: str = ""):
+        return self._decorate("temporary_view", name, comment)
+
+    def table(self, name: str | None = None, comment: str = ""):
+        """A flow-fed target table: its own body (if any) seeds it; append
+        flows add to it on every run (StreamingTable + append_flow)."""
+        return self._decorate("table", name, comment)
+
+    def _decorate(self, kind: str, name, comment):
+        def deco(fn):
+            dname = name or fn.__name__
+            if dname in self._datasets:
+                raise PipelineError(f"dataset {dname!r} defined twice")
+            self._datasets[dname] = _Dataset(dname, fn, kind, comment)
+            return fn
+
+        return deco
+
+    def append_flow(self, target: str, name: str | None = None):
+        def deco(fn):
+            ds = self._datasets.get(target)
+            if ds is None or ds.kind != "table":
+                raise PipelineError(
+                    f"append_flow target {target!r} is not a declared table")
+            ds.flows.append((name or fn.__name__, fn))
+            return fn
+
+        return deco
+
+    # -- reads ----------------------------------------------------------
+    def read(self, name: str):
+        """Read a pipeline dataset from inside a flow body; materializes
+        it first if needed (the dynamic dependency edge)."""
+        if name in self._datasets:
+            self._materialize(name)
+        return self.session.table(name)
+
+    # -- execution -------------------------------------------------------
+    def run(self, full_refresh: bool = True) -> dict:
+        """Materialize every dataset in dependency order; returns
+        name → row count (GraphExecution role). Flow-fed tables are
+        rebuilt from their flows on EVERY run; full_refresh=False keeps
+        already-materialized views and only refreshes the tables (the
+        streaming-table vs materialized-view refresh split)."""
+        if full_refresh:
+            self._state.clear()
+        else:
+            for name, ds in self._datasets.items():
+                if ds.kind == "table":
+                    self._state.pop(name, None)
+        counts = {}
+        for name in self._datasets:
+            self._materialize(name)
+        for name in self._datasets:
+            counts[name] = self.session.table(name).count()
+        return counts
+
+    def _materialize(self, name: str) -> None:
+        with self._lock:
+            st = self._state.get(name)
+            if st == "done":
+                return
+            if st == "running":
+                raise PipelineError(
+                    f"cycle detected through dataset {name!r}")
+            self._state[name] = "running"
+        try:
+            ds = self._datasets[name]
+            df = ds.fn() if ds.fn is not None else None
+            if ds.kind == "table":
+                parts = [] if df is None else [df.toArrow()]
+                for fname, flow in ds.flows:
+                    self.events.append(f"flow {fname} -> {name}")
+                    parts.append(flow().toArrow())
+                if not parts:
+                    raise PipelineError(
+                        f"table {name!r} has no body and no flows")
+                import pyarrow as pa
+
+                table = pa.concat_tables(parts,
+                                         promote_options="permissive")
+                self.session.createDataFrame(table) \
+                    .createOrReplaceTempView(name)
+            elif ds.kind == "materialized_view":
+                table = df.toArrow()
+                wh = self.session.catalog_.external
+                if wh is not None:
+                    wh.save_table(name, table, mode="overwrite")
+                self.session.createDataFrame(table) \
+                    .createOrReplaceTempView(name)
+            else:  # temporary_view
+                df.createOrReplaceTempView(name)
+            self.events.append(f"materialized {ds.kind} {name}")
+        except Exception:
+            with self._lock:
+                self._state[name] = "pending"
+            raise
+        with self._lock:
+            self._state[name] = "done"
+
+
+# -- module-level decorator surface (pyspark.pipelines.api shape) --------
+_ACTIVE: list[Pipeline] = []
+
+
+def _active() -> Pipeline:
+    if not _ACTIVE:
+        raise PipelineError(
+            "no active Pipeline; use `with pipeline:` or the instance "
+            "decorators (p.materialized_view()/p.table())")
+    return _ACTIVE[-1]
+
+
+def materialized_view(name: str | None = None, comment: str = ""):
+    return _active().materialized_view(name, comment)
+
+
+def temporary_view(name: str | None = None, comment: str = ""):
+    return _active().temporary_view(name, comment)
+
+
+def table(name: str | None = None, comment: str = ""):
+    return _active().table(name, comment)
+
+
+def append_flow(target: str, name: str | None = None):
+    return _active().append_flow(target, name)
+
+
+def _enter(self):
+    _ACTIVE.append(self)
+    return self
+
+
+def _exit(self, *exc):
+    _ACTIVE.pop()
+    return False
+
+
+Pipeline.__enter__ = _enter
+Pipeline.__exit__ = _exit

@@ -1,0 +1,96 @@
+"""Declarative pipelines tests (reference: sql/pipelines graph suites +
+python/pyspark/pipelines/tests)."""
+
+import pyarrow as pa
+import pytest
+
+from spark_tpu.pipelines import Pipeline, PipelineError
+
+
+def test_dependency_order_and_counts(spark):
+    spark.createDataFrame(pa.table({
+        "id": [1, 2, 3, 4], "spend": [50.0, 150.0, 300.0, 20.0]})) \
+        .createOrReplaceTempView("pl_src")
+
+    p = Pipeline(spark)
+
+    # declared out of dependency order on purpose
+    @p.materialized_view()
+    def big_spenders():
+        return p.read("pl_customers").filter("spend > 100")
+
+    @p.materialized_view(name="pl_customers")
+    def customers():
+        return spark.table("pl_src")
+
+    counts = p.run()
+    assert counts == {"big_spenders": 2, "pl_customers": 4}
+    out = spark.sql("SELECT id FROM big_spenders ORDER BY id").toArrow()
+    assert out.column("id").to_pylist() == [2, 3]
+    assert any("materialized" in e for e in p.events)
+
+
+def test_cycle_detection(spark):
+    p = Pipeline(spark)
+
+    @p.materialized_view()
+    def a():
+        return p.read("b")
+
+    @p.materialized_view()
+    def b():
+        return p.read("a")
+
+    with pytest.raises(PipelineError, match="cycle"):
+        p.run()
+
+
+def test_append_flows_feed_table(spark):
+    spark.createDataFrame(pa.table({"x": [1, 2]})) \
+        .createOrReplaceTempView("pl_feed1")
+    spark.createDataFrame(pa.table({"x": [3]})) \
+        .createOrReplaceTempView("pl_feed2")
+
+    p = Pipeline(spark)
+
+    @p.table(name="pl_sink")
+    def sink():
+        return None
+
+    @p.append_flow(target="pl_sink")
+    def from_one():
+        return spark.table("pl_feed1")
+
+    @p.append_flow(target="pl_sink")
+    def from_two():
+        return spark.table("pl_feed2")
+
+    counts = p.run()
+    assert counts["pl_sink"] == 3
+    vals = sorted(spark.table("pl_sink").toArrow().column("x").to_pylist())
+    assert vals == [1, 2, 3]
+    assert p.run()["pl_sink"] == 3  # full refresh is idempotent
+
+
+def test_module_level_decorators(spark):
+    import spark_tpu.pipelines as plm
+
+    spark.createDataFrame(pa.table({"n": [10, 20]})) \
+        .createOrReplaceTempView("pl_m_src")
+    p = Pipeline(spark)
+    with p:
+        @plm.materialized_view(name="pl_m_out")
+        def out():
+            return spark.table("pl_m_src").selectExpr("n * 2 AS n2")
+
+    assert p.run()["pl_m_out"] == 2
+    assert sorted(spark.table("pl_m_out").toArrow()
+                  .column("n2").to_pylist()) == [20, 40]
+
+
+def test_append_flow_requires_table_target(spark):
+    p = Pipeline(spark)
+    with pytest.raises(PipelineError, match="not a declared table"):
+        @p.append_flow(target="nope")
+        def f():
+            pass
