@@ -403,6 +403,40 @@ def _contains_agg(e: Expression) -> bool:
                if isinstance(c, Expression))
 
 
+class ResolveSessionVariables(Rule):
+    """Single-part references that columns did NOT resolve fall back to
+    declared session variables and substitute their literal value —
+    column wins over variable, the reference's resolution order
+    (ColumnResolutionHelper resolveColumnsByPlanChildren → variable
+    fallback)."""
+
+    def __init__(self, catalog: Catalog):
+        self.catalog = catalog
+
+    def apply(self, plan):
+        variables = getattr(self.catalog, "variables", None)
+        if not variables:
+            return plan
+
+        def fix(e):
+            if isinstance(e, UnresolvedAttribute) and \
+                    len(e.name_parts) == 1:
+                hit = variables.get(e.name_parts[0].lower())
+                if hit is not None:
+                    return hit
+            return e
+
+        def rule(node):
+            # only where the children are fully resolved: a column with
+            # the same name must win first
+            if all(c.resolved for c in node.children):
+                return node.map_expressions(
+                    lambda ex: ex.transform_up(fix))
+            return node
+
+        return plan.transform_up(rule)
+
+
 class GlobalAggregates(Rule):
     """Project whose list contains an aggregate function (outside any
     window expression) becomes a global Aggregate with no grouping —
@@ -1061,6 +1095,10 @@ class Analyzer(RuleExecutor):
                 GlobalAggregates(),
                 ResolveAggsInSortHaving(cs),
                 ResolveSortHiddenRefs(cs),
+                # AFTER the HAVING/ORDER rules: a real column reachable
+                # through the aggregate child must win over a session
+                # variable of the same name
+                ResolveSessionVariables(self.catalog),
                 ExtractGenerators(),
                 ExtractWindowFromAggregate(),
                 ExtractWindowExpressions(),
@@ -1087,6 +1125,9 @@ class Analyzer(RuleExecutor):
             DeduplicateRelations(),
             ResolveReferences(cs),
             ResolveGroupByAlias(cs),
+            # NO ResolveSessionVariables here: inside a subquery a bare
+            # name must resolve inner column → OUTER column (correlation)
+            # → variable, so the variable fallback lives in node_fix below
             ResolveSubqueries(self),
             GlobalAggregates(),
             ResolveAggsInSortHaving(cs),
@@ -1120,6 +1161,13 @@ class Analyzer(RuleExecutor):
                         a = _resolve_name(e.name_parts, outer, cs)
                         if a is not None:
                             return a
+                        if len(e.name_parts) == 1:
+                            # last resort: session variable (column —
+                            # inner or outer — always wins over it)
+                            hit = getattr(self.catalog, "variables",
+                                          {}).get(e.name_parts[0].lower())
+                            if hit is not None:
+                                return hit
                     return e
 
                 return n.transform_expressions(

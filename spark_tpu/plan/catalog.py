@@ -19,6 +19,9 @@ class Catalog:
         self._tables: dict[str, LogicalPlan] = {}
         self.case_sensitive = case_sensitive
         self.external = None  # Warehouse (plan/warehouse.py) when configured
+        # SQL session variables: name(lower) → Literal (reference: session
+        # variables in SqlScriptingContextManager / VariableManager)
+        self.variables: dict = {}
 
     def _norm(self, name: str) -> str:
         return name if self.case_sensitive else name.lower()

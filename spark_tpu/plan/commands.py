@@ -63,6 +63,30 @@ class SetCommand(Command):
 
 
 @dataclass
+class DeclareVariableCommand(Command):
+    """DECLARE [VARIABLE] name [type] [DEFAULT expr] (reference: SQL
+    session variables, sqlcat CreateVariable + analysis
+    ResolveSetVariable / ColumnResolutionHelper variable fallback)."""
+
+    name: str
+    dtype: Optional[object] = None      # DataType
+    default_expr: Optional[object] = None  # Expression
+    replace: bool = False
+
+
+@dataclass
+class SetVariableCommand(Command):
+    name: str
+    value_expr: object = None  # Expression
+
+
+@dataclass
+class DropVariableCommand(Command):
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
 class AnalyzeTableCommand(Command):
     """ANALYZE TABLE t COMPUTE STATISTICS [FOR COLUMNS a, b | FOR ALL
     COLUMNS] (reference: AnalyzeTableCommand / AnalyzeColumnCommand,
@@ -149,6 +173,33 @@ def run_command(session, cmd: Command):
                 f"Temp view {cmd.name} already exists",
                 error_class="TEMP_TABLE_OR_VIEW_ALREADY_EXISTS")
         plan = cmd.query
+        if not cmd.materialize:
+            # a plan-stored view must not reference itself — resolution
+            # would recurse forever (reference: CheckAnalysis
+            # RECURSIVE_VIEW; Spark prohibits v AS SELECT ... FROM v).
+            # Subquery-expression plans count too (… WHERE x IN
+            # (SELECT … FROM v)).
+            from ..plan.subquery import SubqueryExpression
+            from .logical import UnresolvedRelation as _UR
+
+            full = cmd.name.lower()
+
+            def check_plan(p):
+                for n in p.iter_nodes():
+                    # exact-name match only: salesdb.v inside view v is a
+                    # DIFFERENT relation, not a self-reference
+                    if isinstance(n, _UR) and \
+                            ".".join(n.name_parts).lower() == full:
+                        raise AnalysisException(
+                            f"Recursive view {cmd.name} detected: the "
+                            "view body references the view itself",
+                            error_class="RECURSIVE_VIEW")
+                    for e in n.expressions():
+                        for x in e.iter_nodes():
+                            if isinstance(x, SubqueryExpression):
+                                check_plan(x.plan)
+
+            check_plan(plan)
         if cmd.materialize:
             df = DataFrame(session, plan)
             table = df.toArrow()
@@ -270,6 +321,54 @@ def run_command(session, cmd: Command):
         return df_of(pa.table({
             "key": pa.array([cmd.key]),
             "value": pa.array([str(session.conf.get(cmd.key))]),
+        }))
+
+    if isinstance(cmd, (DeclareVariableCommand, SetVariableCommand,
+                        DropVariableCommand)):
+        from ..expr.expressions import Literal
+
+        varstore = session.catalog_.variables
+        key = cmd.name.lower()
+        if isinstance(cmd, DropVariableCommand):
+            if key not in varstore and not cmd.if_exists:
+                raise AnalysisException(f"variable {cmd.name} not found")
+            varstore.pop(key, None)
+            return df_of(pa.table({"variable": pa.array([cmd.name])}))
+        if isinstance(cmd, SetVariableCommand) and key not in varstore:
+            raise AnalysisException(
+                f"variable {cmd.name} not declared (DECLARE it first)")
+        if isinstance(cmd, DeclareVariableCommand) and key in varstore \
+                and not cmd.replace:
+            raise AnalysisException(
+                f"variable {cmd.name} already exists "
+                "(DECLARE OR REPLACE to overwrite)",
+                error_class="VARIABLE_ALREADY_EXISTS")
+        expr = cmd.default_expr \
+            if isinstance(cmd, DeclareVariableCommand) else cmd.value_expr
+        # the variable's declared type is sticky: assignments cast to it
+        # (reference: SetVariable casts to the variable's type)
+        target_dt = cmd.dtype if isinstance(cmd, DeclareVariableCommand) \
+            else varstore[key].dtype
+        if expr is None:
+            value, dt = None, target_dt
+        else:
+            from ..expr.expressions import Alias, Cast
+            from .logical import OneRowRelation, Project
+
+            if target_dt is not None:
+                expr = Cast(expr, target_dt)
+            table = DataFrame(session, Project(
+                [Alias(expr, "v")], OneRowRelation())).toArrow()
+            value = table.column(0)[0].as_py() if table.num_rows else None
+            from ..columnar.arrow import schema_from_arrow
+
+            dt = target_dt if target_dt is not None else \
+                schema_from_arrow(table.schema).fields[0].dataType
+        varstore[key] = Literal(value, dt) if dt is not None \
+            else Literal(value)
+        return df_of(pa.table({
+            "variable": pa.array([cmd.name]),
+            "value": pa.array([None if value is None else str(value)]),
         }))
 
     if isinstance(cmd, AnalyzeTableCommand):

@@ -144,6 +144,14 @@ class Parser:
             return C.CreateViewCommand(name, q, replace=replace or True,
                                        materialize=materialize)
         if self.eat_kw("drop"):
+            self.eat_word("temporary")
+            if self.peek().value.lower() in ("variable", "var"):
+                self.next()
+                if_exists = False
+                if self.eat_word("if"):
+                    self.expect_word("exists")
+                    if_exists = True
+                return C.DropVariableCommand(self.ident(), if_exists)
             if not (self.eat_kw("view") or self.eat_kw("table")):
                 raise ParseException("expected VIEW or TABLE")
             if_exists = False
@@ -192,6 +200,23 @@ class Parser:
             if analyze or extended:
                 self.next()
             return C.ExplainCommand(self.parse_query(), extended, analyze)
+        if self.peek().value.lower() == "declare":
+            self.next()
+            replace = False
+            if self.eat_word("or"):
+                self.expect_word("replace")
+                replace = True
+            self.eat_word("variable") or self.eat_word("var")
+            name = self.ident()
+            dtype = None
+            if self.peek().kind in ("ident", "kw") and \
+                    self.peek().value.lower() != "default":
+                dtype = self.parse_type()
+            default = None
+            if self.eat_word("default") or self.eat_op("="):
+                default = self.parse_expr()
+            return C.DeclareVariableCommand(name, dtype, default,
+                                            replace=replace)
         if self.peek().value.lower() == "analyze":
             self.next()
             self.expect_word("table")
@@ -220,6 +245,11 @@ class Parser:
             self.next()
             if self.peek().kind == "eof":
                 return C.SetCommand(None, None)
+            if self.peek().value.lower() in ("variable", "var"):
+                self.next()
+                name = self.ident()
+                self.expect_op("=")
+                return C.SetVariableCommand(name, self.parse_expr())
             key = self._conf_key()
             value = None
             if self.eat_op("="):

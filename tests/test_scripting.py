@@ -1,0 +1,236 @@
+"""SQL scripting tests (reference: sql/core scripting
+SqlScriptingInterpreterSuite / SqlScriptingExecutionSuite shapes)."""
+
+import pyarrow as pa
+import pytest
+
+
+def test_script_sequential_statements_and_variables(spark):
+    spark.createDataFrame(pa.table({"x": [1, 2, 3, 4]})) \
+        .createOrReplaceTempView("sc_t")
+    out = spark.sql("""
+    BEGIN
+        DECLARE lim INT DEFAULT 2;
+        SELECT count(*) AS c FROM sc_t WHERE x > lim;
+    END""").toArrow()
+    assert out.column("c")[0].as_py() == 2
+    # block-scoped: lim is gone after the script
+    with pytest.raises(Exception):
+        spark.sql("SELECT lim AS v").toArrow()
+
+
+def test_script_if_else(spark):
+    out = spark.sql("""
+    BEGIN
+        DECLARE mode INT DEFAULT 2;
+        IF mode = 1 THEN
+            SELECT 'one' AS r;
+        ELSEIF mode = 2 THEN
+            SELECT 'two' AS r;
+        ELSE
+            SELECT 'other' AS r;
+        END IF;
+    END""").toArrow()
+    assert out.column("r")[0].as_py() == "two"
+
+
+def test_script_while_loop(spark):
+    out = spark.sql("""
+    BEGIN
+        DECLARE i INT DEFAULT 0;
+        DECLARE total INT DEFAULT 0;
+        WHILE i < 5 DO
+            SET VAR total = total + i;
+            SET VAR i = i + 1;
+        END WHILE;
+        SELECT total AS t;
+    END""").toArrow()
+    assert out.column("t")[0].as_py() == 0 + 1 + 2 + 3 + 4
+
+
+def test_script_repeat_until(spark):
+    out = spark.sql("""
+    BEGIN
+        DECLARE i INT DEFAULT 0;
+        REPEAT
+            SET VAR i = i + 2;
+        UNTIL i >= 7
+        END REPEAT;
+        SELECT i AS v;
+    END""").toArrow()
+    assert out.column("v")[0].as_py() == 8
+
+
+def test_script_nested_if_inside_while(spark):
+    out = spark.sql("""
+    BEGIN
+        DECLARE i INT DEFAULT 0;
+        DECLARE evens INT DEFAULT 0;
+        WHILE i < 6 DO
+            IF i % 2 = 0 THEN
+                SET VAR evens = evens + 1;
+            END IF;
+            SET VAR i = i + 1;
+        END WHILE;
+        SELECT evens AS e;
+    END""").toArrow()
+    assert out.column("e")[0].as_py() == 3
+
+
+def test_script_writes_through_dml(spark):
+    spark.sql("""
+    BEGIN
+        CREATE OR REPLACE TEMP VIEW sc_out AS SELECT 1 AS a;
+    END""")
+    assert spark.sql("SELECT * FROM sc_out").toArrow() \
+        .column("a")[0].as_py() == 1
+
+
+def test_script_leave_exits(spark):
+    out = spark.sql("""
+    BEGIN
+        DECLARE i INT DEFAULT 0;
+        WHILE 1 = 1 DO
+            SET VAR i = i + 1;
+            IF i >= 3 THEN
+                LEAVE;
+            END IF;
+        END WHILE;
+        SELECT i AS v;
+    END""").toArrow()
+    assert out.column("v")[0].as_py() == 3
+
+
+def test_script_nested_same_kind_constructs(spark):
+    """WHILE directly inside WHILE and IF directly inside IF (same-kind
+    nesting as the FIRST body statement — the shape that breaks naive
+    fragment scanners)."""
+    out = spark.sql("""
+    BEGIN
+        DECLARE i INT DEFAULT 0;
+        DECLARE acc INT DEFAULT 0;
+        WHILE i < 2 DO
+            WHILE acc < (i + 1) * 10 DO
+                SET VAR acc = acc + 5;
+            END WHILE;
+            SET VAR i = i + 1;
+        END WHILE;
+        SELECT acc AS a;
+    END""").toArrow()
+    assert out.column("a")[0].as_py() == 20
+    out2 = spark.sql("""
+    BEGIN
+        DECLARE x INT DEFAULT 5;
+        IF x > 0 THEN
+            IF x > 3 THEN
+                SELECT 'big' AS r;
+            ELSE
+                SELECT 'small' AS r;
+            END IF;
+        END IF;
+    END""").toArrow()
+    assert out2.column("r")[0].as_py() == "big"
+
+
+def test_script_case_expression_not_confused_with_control(spark):
+    out = spark.sql("""
+    BEGIN
+        DECLARE v INT DEFAULT 2;
+        SELECT CASE WHEN v = 1 THEN 'one' ELSE 'many' END AS label;
+    END""").toArrow()
+    assert out.column("label")[0].as_py() == "many"
+
+
+def test_script_result_not_reexecuted(spark):
+    """The returned DataFrame is materialized — collecting it twice must
+    not re-run the final statement."""
+    df = spark.sql("""
+    BEGIN
+        DECLARE n INT DEFAULT 3;
+        SELECT n * 2 AS v;
+    END""")
+    assert df.toArrow().column("v")[0].as_py() == 6
+    assert df.toArrow().column("v")[0].as_py() == 6  # n already dropped
+
+
+def test_variable_does_not_shadow_correlated_outer_column(spark):
+    """A session variable must lose to a correlated OUTER column of the
+    same name (reference resolution order)."""
+    import pyarrow as pa
+
+    spark.sql("DECLARE VARIABLE corr_k INT DEFAULT 1")
+    try:
+        spark.createDataFrame(pa.table({
+            "corr_k": [1, 2], "x": [10, 20]})) \
+            .createOrReplaceTempView("corr_t")
+        spark.createDataFrame(pa.table({
+            "ik": [1, 1, 2], "y": [5, 6, 100]})) \
+            .createOrReplaceTempView("corr_s")
+        # correlated: ik = corr_t.corr_k (outer), NOT the variable (=1)
+        out = spark.sql("""
+            SELECT x FROM corr_t
+            WHERE x > (SELECT max(y) FROM corr_s WHERE ik = corr_k)
+            ORDER BY x""").toArrow()
+        # row corr_k=1: max(y)=6 < 10 → keep; row corr_k=2: max=100 > 20 → drop
+        assert out.column("x").to_pylist() == [10]
+    finally:
+        spark.sql("DROP TEMPORARY VARIABLE corr_k")
+
+
+def test_recursive_view_rejected_even_in_subquery(spark):
+    import pyarrow as pa
+    import pytest as _pytest
+
+    spark.createDataFrame(pa.table({"a": [1]})) \
+        .createOrReplaceTempView("rv_base")
+    spark.sql("CREATE OR REPLACE TEMP VIEW rv_v AS SELECT * FROM rv_base")
+    with _pytest.raises(Exception, match="Recursive view"):
+        spark.sql("CREATE OR REPLACE TEMP VIEW rv_v AS "
+                  "SELECT * FROM rv_base WHERE a IN (SELECT a FROM rv_v)")
+
+
+def test_variable_loses_to_column_in_having(spark):
+    import pyarrow as pa
+
+    spark.sql("DECLARE VARIABLE hav_age INT DEFAULT 1000")
+    try:
+        spark.createDataFrame(pa.table({
+            "k": [1, 1, 2], "hav_age": [60, 70, 10]})) \
+            .createOrReplaceTempView("hav_t")
+        out = spark.sql(
+            "SELECT k FROM hav_t GROUP BY k HAVING max(hav_age) > 50"
+        ).toArrow()
+        assert out.column("k").to_pylist() == [1]  # column, not var
+    finally:
+        spark.sql("DROP TEMPORARY VARIABLE hav_age")
+
+
+def test_variable_declared_type_is_sticky(spark):
+    spark.sql("DECLARE VARIABLE typed_n INT DEFAULT 1")
+    try:
+        spark.sql("SET VARIABLE typed_n = '7'")  # cast to INT
+        out = spark.sql("SELECT typed_n + 1 AS v").toArrow()
+        assert out.column("v")[0].as_py() == 8
+        import pytest as _pytest
+
+        with _pytest.raises(Exception, match="already exists"):
+            spark.sql("DECLARE VARIABLE typed_n INT DEFAULT 2")
+        spark.sql("DECLARE OR REPLACE VARIABLE typed_n INT DEFAULT 2")
+        assert spark.sql("SELECT typed_n AS v").toArrow() \
+            .column("v")[0].as_py() == 2
+    finally:
+        spark.sql("DROP TEMPORARY VARIABLE typed_n")
+
+
+def test_script_inner_declare_shadows_and_restores(spark):
+    out = spark.sql("""
+    BEGIN
+        DECLARE sx INT DEFAULT 1;
+        BEGIN
+            DECLARE sx INT DEFAULT 100;
+            SET VAR sx = sx + 1;
+        END;
+        SET VAR sx = sx + 10;
+        SELECT sx AS v;
+    END""").toArrow()
+    assert out.column("v")[0].as_py() == 11  # outer sx restored, then +10

@@ -326,3 +326,31 @@ def test_ctas_with_materialized_cte(spark):
         SELECT count(*) AS c FROM big x JOIN big y ON x.k = y.k""")
     assert spark.sql("SELECT * FROM ctas_out").toArrow() \
         .column("c")[0].as_py() == 20
+
+
+def test_session_variables(spark):
+    """DECLARE/SET/DROP VARIABLE with column-wins resolution
+    (reference: SQL session variables, CreateVariable/ResolveSetVariable)."""
+    import pyarrow as pa
+
+    spark.sql("DECLARE VARIABLE sv_threshold INT DEFAULT 25")
+    spark.createDataFrame(pa.table({"age": [20, 30, 40]})) \
+        .createOrReplaceTempView("sv_people")
+    q = "SELECT count(*) c FROM sv_people WHERE age > sv_threshold"
+    assert spark.sql(q).toArrow().column("c")[0].as_py() == 2
+    spark.sql("SET VARIABLE sv_threshold = 35")
+    assert spark.sql(q).toArrow().column("c")[0].as_py() == 1
+    # subquery assignment
+    spark.sql("SET VAR sv_threshold = (SELECT max(age) FROM sv_people)")
+    assert spark.sql("SELECT sv_threshold AS t").toArrow() \
+        .column("t")[0].as_py() == 40
+    # a real column with the variable's name wins over the variable
+    spark.createDataFrame(pa.table({"sv_threshold": [7]})) \
+        .createOrReplaceTempView("sv_shadow")
+    assert spark.sql("SELECT sv_threshold AS t FROM sv_shadow").toArrow() \
+        .column("t")[0].as_py() == 7
+    spark.sql("DROP TEMPORARY VARIABLE sv_threshold")
+    import pytest as _pytest
+
+    with _pytest.raises(Exception, match="sv_threshold"):
+        spark.sql("SELECT sv_threshold AS t").toArrow()
