@@ -164,6 +164,13 @@ class LocalCluster:
         self._slot_free = threading.Condition()
         self._barriers: dict[str, dict] = {}
         self._barrier_cv = threading.Condition()
+        # FAIR scheduler pools (core/scheduler/Pool.scala +
+        # SchedulableBuilder.scala FAIR mode): when tasks from several
+        # pools contend for slots, the pool with the smallest
+        # running/weight ratio is offered the next free slot
+        self.pool_weights: dict[str, float] = {"default": 1.0}
+        self._pool_running: dict[str, int] = {}
+        self._pool_waiting: dict[str, int] = {}
 
         # 64 handler threads: barrier_sync PARKS a thread per waiting gang
         # member (see _on_barrier), and heartbeats must still get served
@@ -285,43 +292,92 @@ class LocalCluster:
             with self._slot_free:
                 self._slot_free.wait(timeout=0.05)
 
-    def run_task(self, fn: Callable, *args) -> Any:
-        return self.run_task_traced(fn, *args)[0]
+    def set_pool_weight(self, pool: str, weight: float) -> None:
+        self.pool_weights[pool] = float(weight)
 
-    def run_task_traced(self, fn: Callable, *args) -> tuple:
+    def run_task(self, fn: Callable, *args, pool: str = "default") -> Any:
+        return self.run_task_traced(fn, *args, pool=pool)[0]
+
+    def run_task_traced(self, fn: Callable, *args,
+                        pool: str = "default") -> tuple:
         """Run a task; returns (result, worker) so callers can register
         which executor holds the outputs (MapOutputTracker role)."""
         payload = cloudpickle.dumps((fn, args))
         with self._lock:
             self._active_tasks += 1
         try:
-            return self._run_with_retries(payload)
+            return self._run_with_retries(payload, pool)
         finally:
             with self._lock:
                 self._active_tasks -= 1
 
-    def _run_with_retries(self, payload: bytes) -> tuple:
+    def _pool_turn(self, pool: str) -> bool:
+        """FAIR arbitration: this pool may take the next slot iff no
+        contending pool (one with waiters) has a smaller
+        running/weight share."""
+        with self._lock:
+            my = self._pool_running.get(pool, 0) / \
+                self.pool_weights.get(pool, 1.0)
+            for p, waiting in self._pool_waiting.items():
+                if p == pool or waiting <= 0:
+                    continue
+                share = self._pool_running.get(p, 0) / \
+                    self.pool_weights.get(p, 1.0)
+                if share < my:
+                    return False
+            return True
+
+    def _run_with_retries(self, payload: bytes,
+                          pool: str = "default") -> tuple:
         last: Exception | None = None
-        for _ in range(self.max_task_failures):
-            w = self._pick_free()
-            try:
-                if self.speculation:
-                    return self._run_speculative(payload, w)
+        with self._lock:
+            self._pool_waiting[pool] = self._pool_waiting.get(pool, 0) + 1
+        waiting = True  # balances _pool_waiting on EVERY exit path
+        try:
+            for _ in range(self.max_task_failures):
+                # fairness must be re-checked every time a slot frees: a
+                # task already spinning in _pick_free would otherwise race
+                # slots it is not entitled to
+                w = None
+                while w is None:
+                    if not self._pool_turn(pool):
+                        with self._slot_free:
+                            self._slot_free.wait(timeout=0.05)
+                        continue
+                    w = self._pick_free(timeout=0.05)
+                with self._lock:
+                    self._pool_waiting[pool] -= 1
+                    waiting = False
+                    self._pool_running[pool] = \
+                        self._pool_running.get(pool, 0) + 1
                 try:
-                    return w.run_locked(payload), w
-                finally:
-                    w.release()
+                    if self.speculation:
+                        return self._run_speculative(payload, w)
+                    try:
+                        return w.run_locked(payload), w
+                    finally:
+                        w.release()
+                        self._notify_slot_free()
+                except (RemoteTaskError, RemoteRpcError):
+                    # the task (or its payload) failed deterministically —
+                    # retrying on another healthy executor won't help, and
+                    # the executor that reported it is NOT dead
+                    raise
+                except (RpcUnavailableError, OSError) as e:
+                    last = e
+                    self.registry.remove(w.executor_id)  # executor lost
+                    w.close()
                     self._notify_slot_free()
-            except (RemoteTaskError, RemoteRpcError):
-                # the task (or its payload) failed deterministically —
-                # retrying on another healthy executor won't help, and
-                # the executor that reported it is NOT dead
-                raise
-            except (RpcUnavailableError, OSError) as e:
-                last = e
-                self.registry.remove(w.executor_id)  # executor lost
-                w.close()
-                self._notify_slot_free()
+                    with self._lock:  # retry waits for a slot again
+                        self._pool_waiting[pool] += 1
+                        waiting = True
+                finally:
+                    with self._lock:
+                        self._pool_running[pool] -= 1
+        finally:
+            if waiting:
+                with self._lock:
+                    self._pool_waiting[pool] -= 1
         raise ExecutorLostError(
             f"task failed after {self.max_task_failures} executor losses: "
             f"{last}")

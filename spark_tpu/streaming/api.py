@@ -89,14 +89,26 @@ class DataStreamWriter:
         return self
 
     def trigger(self, processingTime: str | None = None, once: bool = False,
-                availableNow: bool = False) -> "DataStreamWriter":
-        if processingTime:
-            parts = processingTime.split()
+                availableNow: bool = False,
+                continuous: str | None = None) -> "DataStreamWriter":
+        def seconds(spec: str) -> float:
+            parts = spec.split()
             v = float(parts[0])
             unit = parts[1] if len(parts) > 1 else "seconds"
-            if unit.startswith("milli"):
-                v /= 1000.0
-            self._trigger_interval = v
+            return v / 1000.0 if unit.startswith("milli") else v
+
+        given = sum(bool(x) for x in
+                    (processingTime, continuous, once or availableNow))
+        if given > 1:
+            raise ValueError(
+                "trigger() accepts exactly one of processingTime, "
+                "continuous, once/availableNow")
+        if processingTime:
+            self._trigger_interval = seconds(processingTime)
+        if continuous:
+            # low-latency mode: the tuple marker carries the epoch
+            # checkpoint interval (ContinuousExecution role)
+            self._trigger_interval = ("continuous", seconds(continuous))
         self._once = once or availableNow
         return self
 

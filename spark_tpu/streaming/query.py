@@ -117,7 +117,17 @@ class StreamingQuery:
         self.plan = plan
         self.sink = sink
         self.output_mode = output_mode
+        # continuous mode (reference: ContinuousExecution.scala — epoch-
+        # based low-latency processing): poll as fast as data arrives and
+        # write checkpoint epochs only every `continuous_epoch` seconds;
+        # recovery replays from the last epoch (sources are replayable)
+        self.continuous_epoch: float | None = None
+        if isinstance(trigger_interval, tuple):
+            self.continuous_epoch = trigger_interval[1]
+            trigger_interval = 0.002
         self.trigger_interval = trigger_interval or 0.05
+        self._last_epoch = 0.0  # first batch always writes an epoch
+        self._wal_due = True
         self.once = once
         self.exception: Exception | None = None
         self._active = True
@@ -183,6 +193,17 @@ class StreamingQuery:
         self.state.load(last)
         if len(self.stream_leaves) == 2:
             self._join_runner.load(last)
+
+    def _epoch_due(self) -> bool:
+        """Micro-batch mode checkpoints every batch; continuous mode only
+        at epoch boundaries (ContinuousExecution's epoch coordinator)."""
+        if self.continuous_epoch is None:
+            return True
+        now = time.monotonic()
+        if now - self._last_epoch >= self.continuous_epoch:
+            self._last_epoch = now
+            return True
+        return False
 
     # --- trigger loop ------------------------------------------------------
     def _run(self) -> None:
@@ -264,7 +285,8 @@ class StreamingQuery:
         t0 = time.perf_counter()
         batch_id = self.batch_id + 1
         new_data = self.source.get_batch(self.committed_offset, latest)
-        if self.checkpoint_dir:
+        self._wal_due = self._epoch_due()
+        if self.checkpoint_dir and self._wal_due:
             with open(os.path.join(self.checkpoint_dir, "offsets",
                                    str(batch_id)), "w") as f:
                 json.dump({"offset": _json_safe(latest)}, f)
@@ -284,7 +306,7 @@ class StreamingQuery:
         # event time (previous-batch semantics, as the reference does).
         if self.watermark is not None:
             self._advance_watermark_from_input(new_data)
-        if self.checkpoint_dir:
+        if self.checkpoint_dir and self._wal_due:
             with open(os.path.join(self.checkpoint_dir, "commits",
                                    str(batch_id)), "w") as f:
                 # end-of-batch watermark rides the commit log so recovery

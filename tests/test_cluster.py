@@ -359,3 +359,42 @@ def test_shuffle_service_survives_executor_loss(monkeypatch):
         assert m.get("scheduler.fetch_failures", 0) == 0, m
     finally:
         s.stop()
+
+
+def test_fair_pools_share_slots():
+    """FAIR pools (core/scheduler/Pool.scala): a task from an empty pool
+    is offered the next slot ahead of a backlog from another pool."""
+    import queue as _q
+
+    c = LocalCluster(num_workers=2)
+    try:
+        done: _q.Queue = _q.Queue()
+        from concurrent.futures import ThreadPoolExecutor
+
+        def slow(tag):
+            import time as _t
+
+            _t.sleep(0.8)
+            return tag
+
+        with ThreadPoolExecutor(max_workers=9) as pool:
+            futs = [pool.submit(
+                lambda i=i: done.put(
+                    c.run_task(slow, f"bulk{i}", pool="bulk")))
+                for i in range(6)]
+            import time as _t
+
+            _t.sleep(1.0)  # bulk occupies both slots, 4 more queued
+            futs.append(pool.submit(
+                lambda: done.put(
+                    c.run_task(slow, "interactive", pool="fast"))))
+            for f in futs:
+                f.result(timeout=60)
+        order = []
+        while not done.empty():
+            order.append(done.get())
+        # the interactive task must NOT be last: FAIR lets the empty
+        # pool jump the bulk backlog (FIFO would finish all bulk first)
+        assert order.index("interactive") < len(order) - 2, order
+    finally:
+        c.stop()
