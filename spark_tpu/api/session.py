@@ -139,6 +139,10 @@ class TpuSession:
         from ..sql.parser import parse_sql
         from .dataframe import DataFrame
 
+        from ..sql.scripting import execute_script, is_script
+
+        if is_script(query):
+            return execute_script(self, query)
         plan = parse_sql(query)
         if isinstance(plan, Command):
             return run_command(self, plan)
