@@ -1411,15 +1411,23 @@ class CaseWhen(Expression):
             new.__dict__.update(overrides)
             new.__dict__["branch_exprs"] = list(be)
             new.__dict__.pop("_hash", None)
+            new.__dict__.pop("_dtype_memo", None)  # branches changed
             return new
         return super().copy(**overrides)
 
     @property
     def dtype(self):
+        # memoized: a chain of nested CASEs (greatest/least expansion)
+        # revisits each level's dtype from every ancestor — uncached
+        # recursion is exponential in chain depth
+        memo = self.__dict__.get("_dtype_memo")
+        if memo is not None:
+            return memo
         dt: DataType = null_type
         for _, v in self.branches:
             dt = common_type(dt, v.dtype) or v.dtype
         dt = common_type(dt, self.else_expr.dtype) or dt
+        self.__dict__["_dtype_memo"] = dt
         return dt
 
     def eval(self, ctx):
@@ -1571,6 +1579,17 @@ class Greatest(Expression):
 
     def eval(self, ctx):
         out = self.dtype
+        if isinstance(out, StringType):
+            # dictionary-encoded strings can't reduce by code arithmetic
+            # (codes from different dictionaries aren't ordered) — expand
+            # into the null-skipping CASE chain, which rides the existing
+            # dictionary comparison machinery
+            cmp_cls = GreaterThan if self._reduce == "maximum" else LessThan
+            acc = self.args[0]
+            for a in self.args[1:]:
+                acc = CaseWhen([(IsNull(a), acc), (IsNull(acc), a),
+                                (cmp_cls(acc, a), acc)], a)
+            return ctx.eval(acc)
         vals = [ctx.eval(cast_if(a, out)) for a in self.args]
         v = ctx.and_valid(*vals)  # Spark: null only if ALL null; simplify: any-null→null? Spark Greatest skips nulls
         if not ctx.is_trace:
@@ -2827,9 +2846,14 @@ class WeekOfYear(_DatePart):
 
 
 class TruncDate(UnaryExpression):
-    def __init__(self, child, fmt: str = "month"):
+    """trunc(date, fmt) / date_trunc(fmt, ts). `allow_day` is True only
+    for date_trunc — Spark's trunc returns NULL for day-level formats
+    (Cast-style graceful null, not an error)."""
+
+    def __init__(self, child, fmt: str = "month", allow_day: bool = False):
         super().__init__(child)
         self.fmt = fmt.lower()
+        self.allow_day = allow_day
 
     @property
     def dtype(self):
@@ -2854,6 +2878,11 @@ class TruncDate(UnaryExpression):
         elif self.fmt in ("week",):
             dow = ((c.data.astype(jnp.int64) + 3) % 7).astype(jnp.int32)  # 0=Mon
             data = (c.data - dow).astype(jnp.int32)
+        elif self.fmt in ("day", "dd"):
+            if not self.allow_day:  # trunc(): day-level → NULL (Spark)
+                return Val(date, jnp.zeros_like(c.data),
+                           jnp.zeros((ctx.capacity,), bool), None)
+            data = c.data  # already truncated to days by the date cast
         else:
             raise UnsupportedOperationError(f"trunc format {self.fmt}")
         return Val(date, data, c.validity, None)

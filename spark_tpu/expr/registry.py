@@ -222,7 +222,8 @@ def _reg_all() -> None:
     r("date_sub", lambda d, n: E.DateSub(d, n))
     r("datediff", lambda a, b: E.DateDiff(a, b))
     r("trunc", lambda c, f: E.TruncDate(c, _lit_str(f)))
-    r("date_trunc", lambda f, c: E.TruncDate(c, _lit_str(f)))
+    r("date_trunc", lambda f, c: E.TruncDate(c, _lit_str(f),
+                                             allow_day=True))
     r("make_date", lambda y, m, d: E.MakeDate(y, m, d))
     r("hour", lambda c: E.Hour(c))
     r("minute", lambda c: E.Minute(c))

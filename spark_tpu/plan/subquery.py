@@ -226,6 +226,7 @@ class RewritePredicateSubquery(Rule):
             if not ok:
                 raise UnsupportedOperationError(
                     "unsupported correlated IN subquery")
+            sub, pairs, residuals = _refresh_lowered(sub, pairs, residuals)
             value_attr = sub.output[0]
             sub = _expose_correlation_keys(sub, pairs, residuals,
                                            outer_ids)
@@ -249,6 +250,7 @@ class RewritePredicateSubquery(Rule):
             if not ok:
                 raise UnsupportedOperationError(
                     "unsupported correlated EXISTS subquery")
+            sub, pairs, residuals = _refresh_lowered(sub, pairs, residuals)
             if pairs or residuals:
                 sub = _expose_correlation_keys(sub, pairs, residuals,
                                                outer_ids)
@@ -266,6 +268,26 @@ class RewritePredicateSubquery(Rule):
             jt = "left_anti" if neg else "left_semi"
             return Join(base, sub, jt, cond), True
         return base, False
+
+
+def _refresh_lowered(sub, pairs, residuals):
+    """Fresh ids for a subquery plan about to be spliced as a join side
+    (the same view lowered twice in one WHERE — or shared with the outer
+    query — must not alias already-spliced ids; see _fresh_plan).
+    Correlation pairs keep their OUTER side; inner sides and residuals
+    remap to the fresh ids. Residuals' outer references are untouched
+    (they are not produced by `sub`, so never in the mapping)."""
+    fm: dict = {}
+    sub = _fresh_plan(sub, fm)
+
+    def remap(e):
+        return e.transform_up(
+            lambda x: fm.get(x.expr_id, x)
+            if isinstance(x, AttributeReference) else x)
+
+    pairs = [(oe, remap(ie)) for oe, ie in pairs]
+    residuals = [remap(r) for r in residuals]
+    return sub, pairs, residuals
 
 
 def _expose_correlation_keys(
@@ -309,20 +331,88 @@ def _expose_correlation_keys(
         "correlated key is not reachable from the subquery output")
 
 
+def _fresh_plan(plan: LogicalPlan, mapping: dict | None = None):
+    """Deep-copy a RESOLVED plan with fresh expression ids everywhere —
+    relations re-instanced, aliases re-minted, references remapped — so
+    the copy can coexist with the original in one tree (or be embedded
+    as an independent subquery) without id collisions."""
+    from ..expr.expressions import Alias as _Alias
+    from .logical import LocalRelation, LogicalRelation, RangeRelation
+
+    mapping = {} if mapping is None else mapping
+
+    def fix_expr(e):
+        if isinstance(e, SubqueryExpression):
+            return e.copy(plan=_fresh_plan(e.plan, mapping))
+        if isinstance(e, _Alias):
+            na = _Alias(e.child, e.name)  # new expr_id
+            mapping[e.expr_id] = na.to_attribute()
+            return na
+        if isinstance(e, AttributeReference) and e.expr_id in mapping:
+            return mapping[e.expr_id]
+        return e
+
+    def go(node):
+        node = node.map_children(go)
+        if isinstance(node, (LogicalRelation, LocalRelation)):
+            new_attrs = []
+            for a in node.attrs:
+                na = mapping.get(a.expr_id)
+                if na is None:
+                    na = a.new_instance()
+                    mapping[a.expr_id] = na
+                new_attrs.append(na)
+            node = node.copy(attrs=new_attrs)
+        elif isinstance(node, RangeRelation):
+            na = mapping.get(node.attr.expr_id)
+            if na is None:  # one fresh id per OLD id (union-branch shape)
+                na = node.attr.new_instance()
+                mapping[node.attr.expr_id] = na
+            node = node.copy(attr=na)
+        return node.map_expressions(lambda ex: ex.transform_up(fix_expr))
+
+    return go(plan)
+
+
 def _existence_flag(target, child: LogicalPlan, outer_ids: set[int]):
     """Lower one IN/EXISTS expression to a left_outer "existence join"
     producing a boolean flag over `child` (reference: sqlcat
     ExistenceJoin). Returns (joined_plan, replacement_expression).
-    Two-valued: a NULL probe value yields false rather than NULL
-    (documented deviation)."""
+    Uncorrelated IN carries full three-valued null semantics (see
+    null_case below); the CORRELATED variants remain two-valued — a NULL
+    probe yields false rather than NULL (documented deviation)."""
     sub, pairs, _res, ok = split_correlation(target.plan, outer_ids)
     if not ok:
         raise UnsupportedOperationError(
             "unsupported correlated subquery in value position")
+    # fresh ids for the spliced subtree: the same view lowered twice in
+    # one SELECT (or appearing in both the outer query and the subquery)
+    # must not alias the ids the previous lowering already spliced in
+    sub, pairs, _ = _refresh_lowered(sub, pairs, [])
     flag = Alias(Literal(True), "__exists")
     cond = None
+    null_case = None  # three-valued IN: unmatched + nulls present → NULL
     if isinstance(target, InSubquery):
         value_attr = sub.output[0]
+        if not pairs:
+            # x IN (sub) with no match is NULL — not false — when x is
+            # NULL or the subquery contains a NULL (reference: In's
+            # null semantics). The has-null probe is an uncorrelated
+            # scalar subquery over the SAME plan; it materializes in its
+            # own QueryExecution so sharing the subtree is safe.
+            from ..expr.expressions import CaseWhen, Max
+
+            hn_map: dict = {}
+            sub_copy = _fresh_plan(sub, hn_map)
+            hn_value = hn_map.get(value_attr.expr_id, value_attr)
+            # one probe, three states: NULL = subquery empty, 1 = has a
+            # NULL value, 0 = non-empty all non-null. IN over an EMPTY
+            # set is false even for a NULL probe (reference In.eval).
+            probe = ScalarSubquery(Aggregate([], [Alias(Max(CaseWhen(
+                [(IsNull(hn_value), Literal(1))], Literal(0))),
+                "__has_null")], sub_copy))
+            null_case = Or(EqualTo(probe, Literal(1)),
+                           And(IsNull(target.value), IsNotNull(probe)))
         sub = _expose_correlation_keys(sub, pairs)
         keys = [value_attr] + [ie for _, ie in pairs]
         dsub = Aggregate(list(keys), list(keys) + [flag], sub)
@@ -342,7 +432,15 @@ def _existence_flag(target, child: LogicalPlan, outer_ids: set[int]):
         dsub = Project([flag], Limit(1, sub))
     flag_attr = dsub.output[-1]
     joined = Join(child, dsub, "left_outer", cond)
-    return joined, IsNotNull(flag_attr)
+    rep = IsNotNull(flag_attr)
+    if null_case is not None:
+        from ..expr.expressions import CaseWhen
+        from ..types import boolean
+
+        rep = CaseWhen([(rep, Literal(True)),
+                        (null_case, Literal(None, boolean))],
+                       Literal(False))
+    return joined, rep
 
 
 class RewriteExistenceSubquery(Rule):

@@ -71,6 +71,7 @@ class TreeNode:
         new = object.__new__(type(self))
         new.__dict__.update(self.__dict__)
         new.__dict__.update(overrides)
+        new.__dict__.pop("_dtype_memo", None)  # children may have changed
         return new
 
     # --- traversal --------------------------------------------------------

@@ -1,0 +1,5 @@
+SELECT stddev(x) AS sd, variance(x) AS v, stddev_pop(x) AS sp, var_pop(x) AS vp FROM (SELECT 2 AS x UNION ALL SELECT 4 UNION ALL SELECT 6);
+SELECT skewness(x) AS sk, kurtosis(x) AS ku FROM (SELECT 1 AS x UNION ALL SELECT 2 UNION ALL SELECT 3 UNION ALL SELECT 10);
+SELECT corr(x, y) AS c, covar_samp(x, y) AS cs, covar_pop(x, y) AS cp FROM (SELECT 1 AS x, 2 AS y UNION ALL SELECT 2, 4 UNION ALL SELECT 3, 6);
+SELECT percentile(x, 0.5) AS p50, median(x) AS med FROM (SELECT 1 AS x UNION ALL SELECT 3 UNION ALL SELECT 5 UNION ALL SELECT 100);
+SELECT any_value(x) AS av, approx_count_distinct(x) AS acd FROM (SELECT 7 AS x UNION ALL SELECT 7 UNION ALL SELECT 8);

@@ -1,0 +1,5 @@
+SELECT date_add(DATE '2024-01-30', 5) AS a, date_sub(DATE '2024-01-05', 10) AS b, datediff(DATE '2024-03-01', DATE '2024-02-01') AS c;
+SELECT add_months(DATE '2024-01-31', 1) AS a, months_between(DATE '2024-03-31', DATE '2024-01-31') AS b, last_day(DATE '2024-02-05') AS c;
+SELECT trunc(DATE '2024-07-17', 'MM') AS m, trunc(DATE '2024-07-17', 'YEAR') AS y, quarter(DATE '2024-07-17') AS q;
+SELECT year(DATE '2021-12-31') AS y, month(DATE '2021-12-31') AS mo, day(DATE '2021-12-31') AS d, dayofweek(DATE '2021-12-31') AS dw, dayofyear(DATE '2021-12-31') AS dy, weekofyear(DATE '2021-12-31') AS wk;
+SELECT make_date(2020, 2, 29) AS leap, to_date('2023-06-15') AS td;

@@ -1,0 +1,3 @@
+SELECT 7 / 2 AS fdiv, 7 DIV 2 AS idiv, 7 % 2 AS rem;
+SELECT 1 / 0 AS div0, 0.0 / 0.0 AS nan0, -1.0 / 0.0 AS ninf;
+SELECT try_divide(10, 0) AS td, try_divide(10, 4) AS td2;

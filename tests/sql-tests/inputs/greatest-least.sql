@@ -1,0 +1,3 @@
+SELECT greatest(3, 9, 1) AS g, least(3, 9, 1) AS l, greatest('b', 'a', 'c') AS gs;
+SELECT greatest(1, NULL, 3) AS gn, least(NULL, NULL) AS ln;
+SELECT pmod(-7, 3) AS pm, mod(-7, 3) AS m, -7 % 3 AS pct;

@@ -1,0 +1,3 @@
+SELECT md5('spark') AS m, sha1('spark') AS s1, sha2('spark', 256) AS s2;
+SELECT base64('hello') AS b64, unbase64(base64('hello')) AS ub;
+SELECT format_number(1234567.891, 2) AS fn, format_number(1000, 0) AS fn0;

@@ -1,0 +1,4 @@
+SELECT 'spark' LIKE 's%' AS a, 'spark' LIKE '%ark' AS b, 'spark' LIKE '_park' AS c, 'spark' LIKE 'S%' AS d;
+SELECT 'a_b' LIKE 'a\\_b' AS esc, '50%' LIKE '50\\%' AS esc2;
+SELECT 'spark' RLIKE '^sp.*k$' AS r1, regexp('123abc', '[0-9]+') AS r2;
+SELECT 'hello' NOT LIKE 'h%' AS nl;

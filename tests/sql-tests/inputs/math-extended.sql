@@ -1,0 +1,4 @@
+SELECT cbrt(27.0) AS cb, expm1(0.0) AS em, log1p(0.0) AS lp, log2(8.0) AS l2, log(100.0) AS ln_, log10(1000.0) AS l10;
+SELECT degrees(pi()) AS deg, radians(180.0) AS rad, e() AS e_, sign(-5) AS sg, signum(3.2) AS sgn;
+SELECT sinh(0.0) AS sh, cosh(0.0) AS ch, tanh(0.0) AS th, atan2(1.0, 1.0) AS at2;
+SELECT shiftleft(1, 4) AS sl, shiftright(256, 4) AS sr;

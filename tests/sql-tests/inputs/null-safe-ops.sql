@@ -1,0 +1,4 @@
+SELECT 1 <=> 1 AS a, NULL <=> NULL AS b, 1 <=> NULL AS c;
+SELECT nullif(3, 3) AS n1, nullif(3, 4) AS n2, nvl(NULL, 'd') AS n3, ifnull(NULL, 9) AS n4, if(1 > 2, 'yes', 'no') AS n5;
+SELECT coalesce(NULL, NULL, 5, 7) AS c1, isnull(NULL) AS i1, isnotnull(0) AS i2, isnan(0.0 / 0.0) AS i3;
+SELECT NULL AND false AS a1, NULL AND true AS a2, NULL OR true AS o1, NULL OR false AS o2, NOT NULL AS n;

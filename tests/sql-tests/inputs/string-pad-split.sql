@@ -1,0 +1,5 @@
+SELECT lpad('7', 3, '0') AS l, rpad('ab', 5, 'xy') AS r, repeat('ab', 3) AS rep, reverse('spark') AS rev;
+SELECT split('a,b,,c', ',') AS parts, substring_index('a.b.c.d', '.', 2) AS si, translate('abcabc', 'abc', 'xyz') AS tr;
+SELECT initcap('hello world') AS ic, ascii('A') AS asc, instr('hello', 'll') AS ins, locate('l', 'hello', 4) AS loc, position('lo' IN 'hello') AS pos;
+SELECT substr('abcdef', 2, 3) AS s1, substr('abcdef', -2) AS s2, left('abcdef', 2) AS lf, right('abcdef', 2) AS rt, overlay('abcdef', 'XX', 3) AS ov;
+SELECT concat_ws('-', 'a', NULL, 'b') AS cw, length('héllo') AS len, char_length('abc') AS cl;

@@ -1,0 +1,4 @@
+SELECT s.a AS fa, s.b AS fb FROM (SELECT named_struct('a', 1, 'b', 'x') AS s);
+SELECT map('k1', 1, 'k2', 2) AS m, map_keys(map('k1', 1)) AS mk, map_values(map('k1', 7)) AS mv;
+SELECT element_at(map('a', 10, 'b', 20), 'b') AS ea, map_contains_key(map('a', 1), 'a') AS mc;
+SELECT size(map('a', 1, 'b', 2)) AS sz, cardinality(map('a', 1)) AS card;

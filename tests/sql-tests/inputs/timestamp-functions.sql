@@ -1,0 +1,4 @@
+SELECT to_timestamp('2024-03-15 12:34:56') AS ts, hour(TIMESTAMP '2024-03-15 12:34:56') AS h, minute(TIMESTAMP '2024-03-15 12:34:56') AS m, second(TIMESTAMP '2024-03-15 12:34:56') AS s;
+SELECT date_format(TIMESTAMP '2024-03-15 12:34:56', 'yyyy/MM/dd') AS f1, date_format(DATE '2024-03-15', 'MM-dd-yyyy') AS f2;
+SELECT unix_timestamp(TIMESTAMP '1970-01-02 00:00:00') AS u, from_unixtime(86400) AS ft;
+SELECT date_trunc('day', TIMESTAMP '2024-03-15 12:34:56') AS td, date_trunc('month', TIMESTAMP '2024-03-15 12:34:56') AS tm;

@@ -1,0 +1,2 @@
+SELECT col1, col2 FROM (VALUES (1, 'a'), (2, 'b')) t ORDER BY col1;
+SELECT col1 * 10 AS ten FROM (VALUES (1), (2), (3)) v WHERE col1 > 1 ORDER BY ten;
