@@ -1003,6 +1003,41 @@ class Ceil(UnaryExpression):
         return Val(int64, jnp.ceil(c.data).astype(jnp.int64), c.validity, None)
 
 
+class NanVl(Expression):
+    """nanvl(a, b): b where a is NaN (mathExpressions.scala NaNvl)."""
+
+    child_fields = ("left", "right")
+
+    def __init__(self, left: Expression, right: Expression):
+        self.left = left
+        self.right = right
+
+    @property
+    def dtype(self):
+        return float64
+
+    def eval(self, ctx):
+        a = ctx.eval(cast_if(self.left, float64))
+        b = ctx.eval(cast_if(self.right, float64))
+        if not ctx.is_trace:
+            return Val(float64, None,
+                       True if a.has_validity or b.has_validity else None,
+                       None)
+        jnp = _jnp()
+        nan = jnp.isnan(a.data)
+        data = jnp.where(nan, jnp.broadcast_to(b.data, jnp.shape(
+            jnp.broadcast_to(a.data, (ctx.capacity,)))), a.data)
+        valid = None
+        if a.validity is not None or b.validity is not None:
+            av = a.validity if a.validity is not None else jnp.ones((), bool)
+            bv = b.validity if b.validity is not None else jnp.ones((), bool)
+            # a NULL left operand stays NULL even if its masked payload
+            # is NaN (Spark: the null check precedes the NaN check)
+            valid = jnp.broadcast_to(jnp.where(nan, av & bv, av),
+                                     (ctx.capacity,))
+        return Val(float64, data, valid, None)
+
+
 class Round(Expression):
     child_fields = ("child", "scale_expr")
 
@@ -1037,6 +1072,40 @@ class Round(Expression):
         f = 10.0 ** s
         # HALF_UP like Spark (not banker's rounding)
         d = jnp.trunc(x * f + jnp.where(x >= 0, 0.5, -0.5)) / f
+        return Val(float64, d, c.validity, None)
+
+
+class BRound(Round):
+    """bround: HALF_EVEN (banker's) rounding — Spark's bround vs round
+    split (mathExpressions.scala BRound)."""
+
+    def eval(self, ctx):
+        c = ctx.eval(self.child)
+        if not isinstance(self.scale_expr, Literal):
+            raise UnsupportedOperationError(
+                "bround() scale must be a literal")
+        s = int(self.scale_expr.value or 0)
+        if not ctx.is_trace:
+            return Val(self.dtype, None, c.validity, None)
+        jnp = _jnp()
+        if isinstance(c.dtype, DecimalType):
+            delta = c.dtype.scale - s
+            if delta <= 0:
+                return c
+            f = 10 ** delta
+            half = f // 2
+            sign = jnp.where(c.data >= 0, 1, -1)
+            a = jnp.abs(c.data)
+            q = a // f
+            r = a - q * f
+            up = (r > half) | ((r == half) & (q % 2 == 1))  # half-to-even
+            d = sign * (q + up.astype(q.dtype)) * f
+            return Val(c.dtype, d, c.validity, None)
+        if isinstance(c.dtype, IntegralType):
+            return c
+        x = cast_val(ctx, c, float64).data
+        f = 10.0 ** s
+        d = jnp.rint(x * f) / f  # rint = round-half-to-even
         return Val(float64, d, c.validity, None)
 
 
@@ -2483,6 +2552,159 @@ class SortArray(_ArrayDictTransform):
 class ArrayDistinct(_ArrayDictTransform):
     def transform(self, lst):
         return list(dict.fromkeys(lst))
+
+
+class Flatten(_ArrayDictTransform):
+    """flatten(array<array<T>>) → array<T> (one level). Deviation from
+    the reference (like ElementAtString's): a NULL sub-array is skipped
+    rather than nulling the whole result — the dictionary channel cannot
+    express a per-value NULL."""
+
+    @property
+    def dtype(self):
+        ct = self.child.dtype
+        return ct.element_type if isinstance(ct, ArrayType) and \
+            isinstance(ct.element_type, ArrayType) else ct
+
+    def transform(self, lst):
+        out = []
+        for sub in lst:
+            if sub is not None:
+                out.extend(sub)
+        return out
+
+
+class Slice(_ArrayDictTransform):
+    """slice(arr, start, length) — 1-based, negative start from the end
+    (collectionOperations.scala Slice)."""
+
+    def __init__(self, child: Expression, start: Expression,
+                 length: Expression):
+        super().__init__(child)
+        self.start = int(start.value)
+        self.length = int(length.value)
+        if self.start == 0:
+            raise AnalysisException(
+                "Unexpected value for start in function slice: "
+                "SQL array indices start at 1")
+
+    def transform(self, lst):
+        s = self.start - 1 if self.start > 0 else len(lst) + self.start
+        if s < 0:
+            return []
+        return lst[s:s + self.length]
+
+
+class ArrayRemove(_ArrayDictTransform):
+    def __init__(self, child: Expression, value: Expression):
+        super().__init__(child)
+        self.value = value.value
+
+    def transform(self, lst):
+        return [v for v in lst if v != self.value]
+
+
+class ArrayJoin(_ArrayLut):
+    """array_join(arr, sep[, null_replacement]) → string."""
+
+    def __init__(self, child: Expression, sep: Expression,
+                 null_replacement: Expression | None = None):
+        super().__init__(child)
+        self.sep = str(sep.value)
+        self.null_rep = None if null_replacement is None \
+            else str(null_replacement.value)
+
+    @property
+    def dtype(self):
+        return string
+
+    def value_of(self, lst):
+        parts = []
+        for v in lst:
+            if v is None:
+                if self.null_rep is not None:
+                    parts.append(self.null_rep)
+            else:
+                parts.append(str(v))
+        return self.sep.join(parts), True
+
+
+class ArrayPosition(_ArrayLut):
+    """array_position(arr, value) → 1-based index of first match, 0 if
+    absent (collectionOperations.scala ArrayPosition)."""
+
+    def __init__(self, child: Expression, value: Expression):
+        super().__init__(child)
+        self.value = value.value
+
+    @property
+    def dtype(self):
+        return int64
+
+    def value_of(self, lst):
+        for i, v in enumerate(lst):
+            if v == self.value:
+                return i + 1, True
+        return 0, True
+
+
+class GetJsonObject(_DictTransform):
+    """get_json_object(json_str, '$.path') — JsonPath subset: dotted
+    fields and [n] indexing (reference: jsonExpressions.scala
+    GetJsonObject). Returns NULL-like '' for misses; non-scalar results
+    re-serialize as JSON, matching the reference."""
+
+    def __init__(self, child: Expression, path: Expression):
+        super().__init__(child)
+        self.path = str(path.value)
+
+    def transform(self, s):
+        import json as _json
+        import re as _re
+
+        try:
+            cur = _json.loads(s)
+        except (ValueError, TypeError):
+            return ""
+        p = self.path
+        if p.startswith("$"):
+            p = p[1:]
+        # the whole path must tokenize — an unsupported segment ($[*],
+        # quoted keys, odd characters) means NULL, not a partial walk
+        tokens = list(_re.finditer(r"\.([A-Za-z_][\w]*)|\[(\d+)\]", p))
+        consumed = "".join(m.group(0) for m in tokens)
+        if consumed != p:
+            return ""
+        for name, idx in ((m.group(1), m.group(2)) for m in tokens):
+            if name:
+                if not isinstance(cur, dict) or name not in cur:
+                    return ""
+                cur = cur[name]
+            else:
+                i = int(idx)
+                if not isinstance(cur, list) or i >= len(cur):
+                    return ""
+                cur = cur[i]
+        if cur is None:
+            return ""
+        if isinstance(cur, (dict, list)):
+            return _json.dumps(cur)
+        if isinstance(cur, bool):
+            return "true" if cur else "false"
+        return str(cur)
+
+
+class Crc32(_ArrayLut):
+    """crc32(string) → bigint over dictionary values (hash.scala Crc32)."""
+
+    @property
+    def dtype(self):
+        return int64
+
+    def value_of(self, s):
+        import zlib
+
+        return zlib.crc32(str(s).encode()), True
 
 
 class ElementAtString(_DictTransform):

@@ -197,6 +197,15 @@ def _reg_all() -> None:
     r("sort_array", lambda c, asc=None: E.SortArray(c, asc))
     r("array_distinct", lambda c: E.ArrayDistinct(c))
     r("element_at", lambda c, i: E.build_element_at(c, i))
+    r("flatten", lambda c: E.Flatten(c))
+    r("slice", lambda c, s, ln: E.Slice(c, s, ln))
+    r("array_remove", lambda c, v: E.ArrayRemove(c, v))
+    r("array_join", lambda c, sep, nr=None: E.ArrayJoin(c, sep, nr))
+    r("array_position", lambda c, v: E.ArrayPosition(c, v))
+    r("get_json_object", lambda c, p: E.GetJsonObject(c, p))
+    r("crc32", lambda c: E.Crc32(c))
+    r("nanvl", lambda a, b: E.NanVl(a, b))
+    r("bround", lambda c, s=None: E.BRound(c, s))
     r("struct", lambda *a: E.build_struct_ctor(list(a)))
     r("named_struct", lambda *a: E.build_named_struct(list(a)))
     r("map", lambda *a: E.build_map_ctor(list(a)))
@@ -239,7 +248,8 @@ def _reg_all() -> None:
         "spark_tpu.types", fromlist=["date"]).date))
     # window / ranking
     from .window import (
-        CumeDist, DenseRank, Lag, Lead, NTile, PercentRank, Rank, RowNumber,
+        CumeDist, DenseRank, FirstValue, Lag, LastValue, Lead, NthValue,
+        NTile, PercentRank, Rank, RowNumber,
     )
 
     r("row_number", lambda: RowNumber())
@@ -252,6 +262,9 @@ def _reg_all() -> None:
         c, off if off is not None else E.Literal(1), d))
     r("lead", lambda c, off=None, d=None: Lead(
         c, off if off is not None else E.Literal(1), d))
+    r("first_value", lambda c: FirstValue(c))
+    r("last_value", lambda c: LastValue(c))
+    r("nth_value", lambda c, n: NthValue(c, n))
 
 
 _reg_all()

@@ -16,7 +16,8 @@ from .expressions import (
 )
 
 __all__ = ["WindowFunction", "RowNumber", "Rank", "DenseRank", "PercentRank",
-           "CumeDist", "NTile", "Lag", "Lead", "WindowExpression"]
+           "CumeDist", "NTile", "Lag", "Lead", "FirstValue", "LastValue",
+           "NthValue", "WindowExpression"]
 
 
 class WindowFunction(Expression):
@@ -89,6 +90,55 @@ class Lag(WindowFunction):
 
 class Lead(Lag):
     pass
+
+
+class FirstValue(WindowFunction):
+    """first_value(x): first row of the frame (default running frame →
+    value at the partition start; reference: windowExpressions.scala
+    First as a window function, RESPECT NULLS)."""
+
+    child_fields = ("child",)
+
+    def __init__(self, child: Expression):
+        self.child = child
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def nullable(self):
+        return True
+
+
+class LastValue(FirstValue):
+    """last_value(x): last row of the frame — with ORDER BY the default
+    frame ends at the CURRENT PEER GROUP (the classic gotcha), without
+    ORDER BY the whole partition."""
+
+
+class NthValue(WindowFunction):
+    """nth_value(x, n): n-th row of the frame, NULL while the frame has
+    fewer than n rows."""
+
+    child_fields = ("child",)
+
+    def __init__(self, child: Expression, n: Expression):
+        if not isinstance(n, Literal):
+            raise UnsupportedOperationError("nth_value(x, n) needs a "
+                                            "literal n")
+        self.child = child
+        self.n = int(n.value)
+        if self.n < 1:
+            raise UnsupportedOperationError("nth_value n must be >= 1")
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def nullable(self):
+        return True
 
 
 class UnresolvedWindowExpression(Expression):

@@ -346,6 +346,49 @@ def w_shift(lo: WindowLayout, values, valid, offset: int,
     return out, out_valid
 
 
+def w_first_value(lo: WindowLayout, values, valid):
+    """first_value: the frame's first row — default running frame starts
+    at the partition start."""
+    v = jnp.take(values, lo.perm)
+    out = jnp.take(v, lo.seg_start)
+    out_valid = None
+    if valid is not None:
+        sv = jnp.take(valid, lo.perm)
+        out_valid = jnp.take(sv, lo.seg_start)
+    return out, out_valid
+
+
+def w_last_value(lo: WindowLayout, values, valid, whole: bool = False):
+    """last_value: the frame's last row — default frame ends at the
+    current PEER GROUP's last row; whole=True (explicit
+    UNBOUNDED..UNBOUNDED) uses the partition's last row."""
+    v = jnp.take(values, lo.perm)
+    end = (lo.seg_start + lo.seg_size - 1) if whole else lo.peer_last
+    out = jnp.take(v, end)
+    out_valid = None
+    if valid is not None:
+        sv = jnp.take(valid, lo.perm)
+        out_valid = jnp.take(sv, end)
+    return out, out_valid
+
+
+def w_nth_value(lo: WindowLayout, values, valid, n: int,
+                whole: bool = False):
+    """nth_value(x, n): NULL until the frame reaches n rows."""
+    cap = values.shape[0]
+    v = jnp.take(values, lo.perm)
+    idx = lo.seg_start + (n - 1)
+    end = (lo.seg_start + lo.seg_size - 1) if whole else lo.peer_last
+    exists = idx <= end
+    idxc = jnp.clip(idx, 0, cap - 1)
+    out = jnp.take(v, idxc)
+    out_valid = exists
+    if valid is not None:
+        sv = jnp.take(valid, lo.perm)
+        out_valid = out_valid & jnp.take(sv, idxc)
+    return out, out_valid
+
+
 def scatter_back(lo: WindowLayout, sorted_vals, sorted_valid=None):
     """Sorted-order results → original row order."""
     cap = sorted_vals.shape[0]

@@ -18,8 +18,8 @@ from ..expr.expressions import (
     Max, Min, SortOrder, Sum,
 )
 from ..expr.window import (
-    CumeDist, DenseRank, Lag, Lead, NTile, PercentRank, Rank, RowNumber,
-    WindowExpression,
+    CumeDist, DenseRank, FirstValue, Lag, LastValue, Lead, NthValue, NTile,
+    PercentRank, Rank, RowNumber, WindowExpression,
 )
 from ..types import DecimalType, StringType, float64, int32, int64
 from .compile import GLOBAL_KERNEL_CACHE
@@ -81,6 +81,25 @@ class WindowExec(PhysicalPlan):
             elif isinstance(f, (Lag, Lead)):
                 off = f.offset if isinstance(f, Lag) else -f.offset
                 out.append(("shift", off, f.child))
+            elif isinstance(f, (NthValue, FirstValue)):
+                # default frame = running-to-current-peers; explicit
+                # UNBOUNDED..UNBOUNDED = whole partition; anything else
+                # is unsupported rather than silently wrong
+                frame = w.frame
+                if frame is None:
+                    scope = "peers"
+                elif (frame[1], frame[2]) == (None, None):
+                    scope = "partition"
+                else:
+                    raise UnsupportedOperationError(
+                        f"{type(f).__name__} over a bounded frame is "
+                        "not supported yet")
+                if isinstance(f, NthValue):
+                    out.append(("nth_value", (f.n, scope), f.child))
+                elif isinstance(f, LastValue):  # FirstValue subclass
+                    out.append(("last_value", scope, f.child))
+                else:
+                    out.append(("first_value", scope, f.child))
             elif isinstance(f, (Sum, Count, Min, Max, Average)):
                 kind = {Sum: "sum", Count: "count", Min: "min", Max: "max",
                         Average: "avg"}[type(f)]
@@ -210,6 +229,16 @@ class WindowExec(PhysicalPlan):
                         sv, svalid = W.w_ntile(lo, param), None
                     elif kind == "shift":
                         sv, svalid = W.w_shift(lo, vd, vv, param)
+                    elif kind == "first_value":
+                        sv, svalid = W.w_first_value(lo, vd, vv)
+                    elif kind == "last_value":
+                        sv, svalid = W.w_last_value(lo, vd, vv,
+                                                    whole=param ==
+                                                    "partition")
+                    elif kind == "nth_value":
+                        sv, svalid = W.w_nth_value(
+                            lo, vd, vv, param[0],
+                            whole=param[1] == "partition")
                     elif kind.startswith("agg_vrange_"):
                         sv, svalid = W.w_agg_value_range(
                             lo, okeys[0], vd, vv, kind.split("_")[-1],

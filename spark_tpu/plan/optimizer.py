@@ -119,7 +119,13 @@ def _py_cast(v, to):
     if isinstance(to, IntegralType):
         return int(v)
     if isinstance(to, DecimalType):
-        return v
+        # fold to an exact Decimal at the TARGET scale — handing the raw
+        # float through made Literal treat 1.25 as scaled-int 1 (0.01)
+        import decimal as _d
+
+        dv = v if isinstance(v, _d.Decimal) else _d.Decimal(str(v))
+        return dv.quantize(_d.Decimal(1).scaleb(-to.scale),
+                           rounding=_d.ROUND_HALF_UP)
     if isinstance(to, FractionalType):
         return float(v)
     if isinstance(to, BooleanType):

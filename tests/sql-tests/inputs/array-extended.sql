@@ -1,0 +1,3 @@
+SELECT flatten(collect_list(nums)) AS f FROM nested WHERE nums IS NOT NULL;
+SELECT slice(nums, 1, 2) AS s1, slice(nums, -2, 2) AS s2, array_remove(nums, 1) AS ar FROM nested WHERE id = 1;
+SELECT array_join(nums, '-') AS aj, array_position(nums, 2) AS ap, array_position(nums, 99) AS missing FROM nested WHERE id = 1;

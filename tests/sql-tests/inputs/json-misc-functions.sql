@@ -1,0 +1,4 @@
+SELECT get_json_object('{"a": {"b": [10, 20]}, "s": "x"}', '$.a.b[1]') AS j1, get_json_object('{"a": 1}', '$.missing') AS j2, get_json_object('{"a": {"c": 3}}', '$.a') AS j3;
+SELECT crc32('spark') AS c1, crc32('') AS c2;
+SELECT nanvl(0.0 / 0.0, 7.5) AS nv, nanvl(3.0, 9.9) AS nv2;
+SELECT bround(2.5, 0) AS b1, bround(3.5, 0) AS b2, round(2.5, 0) AS r1, bround(1.25, 1) AS b3;
