@@ -384,7 +384,13 @@ def test_fair_pools_share_slots():
                 for i in range(6)]
             import time as _t
 
-            _t.sleep(1.0)  # bulk occupies both slots, 4 more queued
+            # wait until bulk PROVABLY occupies both slots with a queue
+            # behind them (a fixed sleep races machine load)
+            deadline = _t.monotonic() + 30
+            while _t.monotonic() < deadline and not (
+                    c._pool_running.get("bulk", 0) >= 2
+                    and c._pool_waiting.get("bulk", 0) >= 2):
+                _t.sleep(0.02)
             futs.append(pool.submit(
                 lambda: done.put(
                     c.run_task(slow, "interactive", pool="fast"))))
