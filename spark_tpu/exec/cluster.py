@@ -141,7 +141,8 @@ class LocalCluster:
                  dynamic_allocation: bool = False,
                  max_workers: int | None = None,
                  executor_idle_timeout: float = 10.0,
-                 shuffle_service: bool = False):
+                 shuffle_service: bool = False,
+                 push_shuffle: bool = False):
         self.max_task_failures = max_task_failures
         self.registry = ExecutorRegistry()
         self.health = HealthTracker(self.registry, max_failures=2)
@@ -187,7 +188,8 @@ class LocalCluster:
         self.shuffle_service = None
         self.shuffle_service_addr: str | None = None
         self._shuffle_dir: str | None = None
-        if shuffle_service:
+        self.push_shuffle = push_shuffle
+        if shuffle_service or push_shuffle:
             import tempfile
 
             from .shuffle_service import ExternalShuffleService
@@ -237,7 +239,11 @@ class LocalCluster:
     def _spawn(self, host_label: str = "localhost") -> subprocess.Popen:
         env = worker_env(self.driver_addr, self.token, host_label,
                          bind_host=self.bind_host)
-        if self._shuffle_dir:
+        if self.push_shuffle:
+            # push mode: blocks travel over the network to the service —
+            # the cross-host deployment (no shared filesystem assumed)
+            env["SPARK_TPU_SHUFFLE_PUSH_ADDR"] = self.shuffle_service_addr
+        elif self._shuffle_dir:
             env["SPARK_TPU_SHUFFLE_DIR"] = self._shuffle_dir
         return subprocess.Popen(
             [sys.executable, "-m", "spark_tpu.exec.worker_main"], env=env)

@@ -40,9 +40,13 @@ def persist_block(root: str, shuffle_id: str, reduce_id: int,
                   data: bytes) -> None:
     """Atomic write: readers (the service, possibly mid-fetch) must never
     observe a partial block."""
+    import uuid
+
     path = block_path(root, shuffle_id, reduce_id)
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}"
+    # per-call unique tmp: concurrent duplicate pushes (speculation) land
+    # in ONE service process, so pid alone is not unique
+    tmp = f"{path}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
     with open(tmp, "wb") as f:
         f.write(data)
     os.replace(tmp, path)
@@ -57,6 +61,7 @@ class ExternalShuffleService:
         self._server = RpcServer(token, host=host)
         self._server.register_stream("get_block", self._get_block)
         self._server.register("free_shuffle", self._free_shuffle)
+        self._server.register("put_block", self._put_block)
         self._server.register("ping", lambda _p: b"pong")
         self.address = ""
         self._lock = threading.Lock()
@@ -82,6 +87,16 @@ class ExternalShuffleService:
                 if not chunk:
                     break
                 yield chunk
+
+    def _put_block(self, payload: bytes) -> bytes:
+        """PUSH path (reference: push-based shuffle, ShuffleBlockPusher →
+        RemoteBlockPushResolver.java:97): a mapper on another host ships
+        its block over the network instead of relying on a shared
+        filesystem. One message per block (the transport's 256 MiB frame
+        cap bounds block size; a real magnet deployment would chunk)."""
+        sid, rid, data = pickle.loads(payload)
+        persist_block(self.root, sid, rid, data)
+        return b"ok"
 
     def _free_shuffle(self, payload: bytes) -> bytes:
         import shutil

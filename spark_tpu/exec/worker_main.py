@@ -33,7 +33,12 @@ BLOCK_ADDR: str = ""
 _STORE_LOCK = threading.Lock()
 
 
+_PUSH_CLIENT = None
+
+
 def put_block(shuffle_id: str, reduce_id: int, data: bytes) -> None:
+    global _PUSH_CLIENT
+
     with _STORE_LOCK:
         BLOCK_STORE[(shuffle_id, reduce_id)] = data
     # external-shuffle durability: persist so the block outlives this
@@ -43,6 +48,18 @@ def put_block(shuffle_id: str, reduce_id: int, data: bytes) -> None:
         from .shuffle_service import persist_block
 
         persist_block(root, shuffle_id, reduce_id, data)
+    # push-based path: no shared filesystem — ship the block to the
+    # shuffle service over the network (ShuffleBlockPusher role)
+    push_addr = os.environ.get("SPARK_TPU_SHUFFLE_PUSH_ADDR")
+    if push_addr:
+        with _STORE_LOCK:  # one client per process (racy init leaks)
+            if _PUSH_CLIENT is None:
+                _PUSH_CLIENT = RpcClient(
+                    push_addr, os.environ["SPARK_TPU_WORKER_KEY"])
+            client = _PUSH_CLIENT
+        client.call(
+            "put_block", pickle.dumps((shuffle_id, reduce_id, data)),
+            timeout=120)
 
 
 def _handle_get_block(payload: bytes):
