@@ -651,8 +651,29 @@ class ResolveSubqueries(Rule):
             except AnalysisException:
                 return node
 
+            def needs_alias(p):
+                # `x IN (SELECT 1)`: the plan is RESOLVED (a literal
+                # resolves trivially) so execute_subquery would be
+                # skipped — but its bare project output still needs the
+                # alias pass or Project.output raises at optimizer time
+                from .logical import GroupingSets
+
+                for n in p.iter_nodes():
+                    if isinstance(n, Project):
+                        exprs = n.project_list
+                    elif isinstance(n, (Aggregate, GroupingSets)):
+                        exprs = n.aggregate_exprs
+                    else:
+                        continue
+                    if any(not isinstance(ex, (Alias, AttributeReference,
+                                               UnresolvedStar))
+                           and ex.resolved for ex in exprs):
+                        return True
+                return False
+
             def fix(e):
-                if isinstance(e, SubqueryExpression) and not e.plan.resolved:
+                if isinstance(e, SubqueryExpression) and \
+                        (not e.plan.resolved or needs_alias(e.plan)):
                     sub = an.execute_subquery(e.plan, outer)
                     return e.copy(plan=sub)
                 return e
