@@ -358,6 +358,39 @@ class _CatalogApi:
         except Exception:
             return False
 
+    def listColumns(self, table: str):
+        """Column name/type/nullable rows for a table (pyspark
+        Catalog.listColumns shape)."""
+        plan = self.s.catalog_.lookup(table.split("."))
+        from ..exec.query_execution import QueryExecution
+
+        analyzed = QueryExecution(self.s, plan).analyzed
+        return [{"name": a.name, "dataType": str(a.dtype),
+                 "nullable": bool(a.nullable)} for a in analyzed.output]
+
+    def listFunctions(self, pattern: str | None = None):
+        """Registered SQL function names (Catalog.listFunctions role)."""
+        from ..expr.registry import filter_names
+
+        return filter_names(pattern)
+
+    def functionExists(self, name: str) -> bool:
+        from ..expr.registry import function_exists
+
+        return function_exists(name)
+
+    def cacheTable(self, name: str) -> None:
+        # command layer directly: an f-string SQL round trip would break
+        # on names that aren't lexable identifiers
+        from ..plan.commands import CacheTableCommand, run_command
+
+        run_command(self.s, CacheTableCommand(name))
+
+    def uncacheTable(self, name: str) -> None:
+        from ..plan.commands import CacheTableCommand, run_command
+
+        run_command(self.s, CacheTableCommand(name, uncache=True))
+
 
 def _to_arrow_table(data, schema) -> pa.Table:
     from ..types import StructType as ST, to_arrow_type

@@ -29,6 +29,31 @@ def lookup(name: str) -> Builder | None:
     return _REGISTRY.get(name.lower())
 
 
+def registered_names() -> list[str]:
+    """All callable SQL function names (FunctionRegistry.listFunction
+    role — backs Catalog.listFunctions and SHOW FUNCTIONS). Includes
+    names special-cased in build_function rather than registered."""
+    return list(_REGISTRY) + ["count"]
+
+
+def filter_names(pattern: str | None) -> list[str]:
+    """Sorted function names matching a SHOW FUNCTIONS pattern:
+    case-insensitive, `*` wildcard, `|` alternation (reference:
+    StringUtils.filterPattern)."""
+    import fnmatch
+
+    names = sorted(registered_names())
+    if not pattern:
+        return names
+    alts = [p.strip().lower() for p in pattern.split("|") if p.strip()]
+    return [n for n in names
+            if any(fnmatch.fnmatch(n.lower(), a) for a in alts)]
+
+
+def function_exists(name: str) -> bool:
+    return name.lower() in {n.lower() for n in registered_names()}
+
+
 def build_function(name: str, args: Sequence[E.Expression],
                    distinct: bool = False) -> E.Expression:
     n = name.lower()

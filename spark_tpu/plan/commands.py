@@ -39,6 +39,13 @@ class ShowTablesCommand(Command):
 
 
 @dataclass
+class ShowFunctionsCommand(Command):
+    """SHOW FUNCTIONS [LIKE 'pattern'] (FunctionRegistry listing)."""
+
+    pattern: Optional[str] = None
+
+
+@dataclass
 class DescribeCommand(Command):
     name: str
 
@@ -266,6 +273,12 @@ def run_command(session, cmd: Command):
             "tableName": pa.array(names),
             "isTemporary": pa.array([True] * len(names)),
         }))
+
+    if isinstance(cmd, ShowFunctionsCommand):
+        from ..expr.registry import filter_names
+
+        return df_of(pa.table(
+            {"function": pa.array(filter_names(cmd.pattern))}))
 
     if isinstance(cmd, DescribeCommand):
         plan = session.catalog_.lookup(cmd.name.split("."))

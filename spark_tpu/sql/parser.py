@@ -188,6 +188,16 @@ class Parser:
         if self.eat_kw("merge"):
             return self._parse_merge()
         if self.eat_kw("show"):
+            if self.eat_word("functions"):
+                pattern = None
+                if self.eat_kw("like"):
+                    t = self.next()
+                    if t.kind != "str":
+                        raise ParseException(
+                            "SHOW FUNCTIONS LIKE expects a string "
+                            f"literal, got {t.value!r}")
+                    pattern = str(t.value)
+                return C.ShowFunctionsCommand(pattern)
             self.expect_kw("tables")
             return C.ShowTablesCommand()
         if self.eat_kw("describe"):

@@ -277,3 +277,24 @@ def test_merge_insert_only_multi_match_ok(spark):
         .toArrow().to_pydict()
     assert out["k"] == [1, 2]
     assert out["v"] == [10, 7]
+
+
+def test_show_functions_and_catalog_api(spark):
+    import pyarrow as pa
+
+    out = spark.sql("SHOW FUNCTIONS").toArrow()
+    fns = out.column("function").to_pylist()
+    assert "sum" in fns and "get_json_object" in fns and len(fns) > 150
+    liked = spark.sql("SHOW FUNCTIONS LIKE 'ARRAY_J*|SUM'").toArrow() \
+        .column("function").to_pylist()
+    assert liked == ["array_join", "sum"]  # case-insensitive + alternation
+    assert "count" in fns  # special-cased fn still listed
+    # catalog API surface (pyspark Catalog shape)
+    assert spark.catalog.functionExists("crc32")
+    assert spark.catalog.functionExists("COUNT")
+    assert not spark.catalog.functionExists("no_such_fn")
+    spark.createDataFrame(pa.table({"a": [1], "s": ["x"]})) \
+        .createOrReplaceTempView("cat_t")
+    cols = spark.catalog.listColumns("cat_t")
+    assert [c["name"] for c in cols] == ["a", "s"]
+    assert all("dataType" in c for c in cols)
