@@ -89,6 +89,35 @@ def test_dryrun_survives_hostile_driver_env(tmp_path):
     assert "GATE_OK" in r.stdout
 
 
+def test_dryrun_survives_cpu_pinned_hostile_env(tmp_path):
+    """The EXACT r04 driver environment that kept the gate red:
+    JAX_PLATFORMS=cpu AND --xla_force_host_platform_device_count=8 are
+    already exported (how a driver builds the virtual mesh), but the
+    container sitecustomize still hangs jax init because
+    PALLAS_AXON_POOL_IPS is set — sitecustomize runs at interpreter start
+    regardless of JAX_PLATFORMS. A fast-path that trusts the CPU-pinning
+    env vars and runs in-process hangs in C. dryrun_multichip must re-exec
+    through its sanitized child env even when the parent looks pinned."""
+    hook = tmp_path / "hostile"
+    hook.mkdir()
+    (hook / "sitecustomize.py").write_text(_HOSTILE_SITECUSTOMIZE)
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = "127.0.0.1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = str(hook)
+    env.pop("SPARK_TPU_ACCEL_HEALTH", None)
+    env.pop("SPARK_TPU_DRYRUN_CHILD", None)
+    code = (
+        "import sys; sys.path.insert(0, %r); "
+        "import __graft_entry__ as g; g.dryrun_multichip(8); "
+        "print('GATE_OK')" % REPO)
+    r = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                       capture_output=True, text=True, timeout=170)
+    assert r.returncode == 0, (r.stderr or "")[-3000:]
+    assert "GATE_OK" in r.stdout
+
+
 def test_bench_cpu_fallback_emits_evidence(tmp_path):
     """bench.py against a dead accelerator must still exit 0 quickly with
     a first-class fallback record, per-config lines, and a summary line —
