@@ -137,6 +137,16 @@ SPECULATION = _register(ConfigEntry(
     "wins, file commits arbitrated by the OutputCommitCoordinator "
     "(reference: TaskSetManager.scala:80-88).", _bool))
 
+SHUFFLE_MAP_PARALLELISM = _register(ConfigEntry(
+    "spark.tpu.shuffle.mapParallelism", 1,
+    "Max map tasks per cluster shuffle map stage. 1 = stage-granular "
+    "(one mapper computes the whole subtree); >1 slices the stage's "
+    "multi-partition Fetch leaves across that many tasks on different "
+    "executors; 0 = auto (min of alive executors and input partitions). "
+    "Only hash/round-robin exchanges slice (range bounds are sampled "
+    "per task, so slicing a range exchange would break global order).",
+    int))
+
 STATE_STORE_PARTITIONS = _register(ConfigEntry(
     "spark.sql.streaming.stateStore.numPartitions", 4,
     "Hash partitions for streaming state: each partition keeps its own "

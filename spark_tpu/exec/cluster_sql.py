@@ -27,7 +27,8 @@ import cloudpickle
 
 from ..physical.operators import PhysicalPlan
 from .map_output import (
-    FetchFailedError, MapOutputTracker, MapStatus, fetch_block, free_shuffle,
+    FetchFailedError, MapOutputTracker, MapStatus, MergeStatus,
+    ShuffleStatus, fetch_block, fetch_merged, free_shuffle, map_block_id,
 )
 from .scheduler import DAGScheduler, Stage, _StageOutput, build_stage_graph
 
@@ -66,21 +67,33 @@ def _ipc_to_partitions(payload, attrs):
 
 
 class FetchExec(PhysicalPlan):
-    """Leaf that pulls a parent stage's partitions from the executor that
-    produced them (the BlockStoreShuffleReader role). One block per
-    reduce partition (stage-granular map tasks)."""
+    """Leaf that pulls a parent shuffle's partitions (the
+    BlockStoreShuffleReader role). Each reduce partition is the ordered
+    concatenation of every map task's block for it; when the parent was
+    push-merged, the service's merged chunk is fetched FIRST and only
+    map ids missing from it (or a corrupt chunk) fall back to the
+    per-map original blocks — the reference's push-merged read path
+    (ShuffleBlockFetcherIterator merged chunks + fallbackFetch).
+
+    `part_indices` restricts the fetch to a subset of reduce partitions:
+    the leaf-slicing handle that turns a consumer stage into multiple
+    map tasks."""
 
     child_fields = ()
 
-    def __init__(self, attrs, shuffle_id: str, block_addr: str,
+    def __init__(self, attrs, shuffle_id: str, maps: list,
                  authkey_hex: str, num_partitions: int,
-                 fallback_addr: str | None = None):
+                 fallback_addr: str | None = None,
+                 merge: tuple | None = None,
+                 part_indices: list | None = None):
         self.attrs = list(attrs)
         self.shuffle_id = shuffle_id
-        self.block_addr = block_addr
+        self.maps = list(maps)              # [(map_id, block_addr), ...]
         self.authkey_hex = authkey_hex
         self.num_partitions = num_partitions
         self.fallback_addr = fallback_addr  # external shuffle service
+        self.merge = merge       # (service_addr, {rid: (map ids merged)})
+        self.part_indices = part_indices
 
     @property
     def output(self):
@@ -89,35 +102,76 @@ class FetchExec(PhysicalPlan):
     def output_partitioning(self):
         from ..physical.partitioning import UnknownPartitioning
 
-        return UnknownPartitioning(max(self.num_partitions, 1))
+        n = (len(self.part_indices) if self.part_indices is not None
+             else self.num_partitions)
+        return UnknownPartitioning(max(n, 1))
 
-    def execute(self, ctx):
+    def _fetch_rid(self, rid: int, clients: dict, schema, ctx) -> list:
+        """One reduce partition: merged chunk first, per-map fallback."""
         import pickle
 
-        from ..physical.operators import attrs_schema
+        from ..net.transport import RpcClient
         from .map_output import BlockClient
 
+        num_maps = len(self.maps)
+        frames: dict[int, bytes] = {}
+        if self.merge is not None and num_maps > 0:
+            service_addr, merged_index = self.merge
+            if merged_index.get(rid):
+                if "merged" not in clients:
+                    clients["merged"] = RpcClient(service_addr,
+                                                  self.authkey_hex)
+                got = fetch_merged(clients["merged"], self.shuffle_id, rid)
+                if got is not None:
+                    frames = dict(got)
+                    ctx.metrics.add("shuffle.merged_chunks_fetched")
+        part: list = []
+        for map_id, addr in sorted(self.maps):
+            raw = frames.get(map_id)
+            if raw is None:
+                bid = map_block_id(self.shuffle_id, map_id, num_maps)
+                key = ("map", map_id)
+                if key not in clients:
+                    clients[key] = BlockClient(
+                        addr, self.authkey_hex, bid,
+                        fallback_addr=self.fallback_addr)
+                try:
+                    raw = clients[key].get(rid)
+                except FetchFailedError as e:
+                    # re-key to the BASE shuffle id: the scheduler
+                    # regenerates the whole map stage, not one map task
+                    raise FetchFailedError(self.shuffle_id,
+                                           str(e)) from None
+                ctx.metrics.add("shuffle.blocks_fetched")
+            part.extend(_ipc_to_partition(pickle.loads(raw), schema))
+        return part
+
+    def execute(self, ctx):
+        from ..physical.operators import attrs_schema
+
         schema = attrs_schema(self.attrs)
-        out = []
-        # one authenticated connection per producer, reused across blocks
-        with BlockClient(self.block_addr, self.authkey_hex,
-                         self.shuffle_id,
-                         fallback_addr=self.fallback_addr) as client:
-            for rid in range(self.num_partitions):
-                raw = client.get(rid)
-                out.append(_ipc_to_partition(pickle.loads(raw), schema))
-        ctx.metrics.add("shuffle.blocks_fetched", self.num_partitions)
-        return out
+        rids = (self.part_indices if self.part_indices is not None
+                else range(self.num_partitions))
+        clients: dict = {}
+        try:
+            return [self._fetch_rid(rid, clients, schema, ctx)
+                    for rid in rids]
+        finally:
+            for c in clients.values():
+                c.close()
 
     def simple_string(self):
-        return f"Fetch[{self.shuffle_id}@{self.block_addr}]" \
-               f"({self.num_partitions} parts)"
+        sl = (f" slice{list(self.part_indices)}"
+              if self.part_indices is not None else "")
+        return f"Fetch[{self.shuffle_id}×{len(self.maps)}maps]" \
+               f"({self.num_partitions} parts{sl})"
 
 
 def _run_stage_store(plan_bytes: bytes, conf_overrides: dict,
-                     shuffle_id: str):
-    """Map-stage task body: execute the subtree, store each output
-    partition as a block in THIS worker's store, return per-partition
+                     shuffle_id: str, map_id: int = 0, num_maps: int = 1):
+    """Map-task body: execute the (possibly leaf-sliced) subtree, store
+    each output partition as a block in THIS worker's store (and push it
+    to the merge service in push mode), return per-partition
     (rows, bytes) — the MapStatus payload. Runs in a worker process."""
     import pickle
 
@@ -140,7 +194,7 @@ def _run_stage_store(plan_bytes: bytes, conf_overrides: dict,
     for rid, part in enumerate(parts):
         ipc = _partitions_to_ipc([part])[0]
         raw = pickle.dumps(ipc)
-        WM.put_block(shuffle_id, rid, raw)
+        WM.store_map_block(shuffle_id, map_id, num_maps, rid, raw)
         rows.append(sum(b.num_rows() for b in part))
         sizes.append(len(raw))
     counters = ctx.metrics.snapshot()["counters"]
@@ -189,6 +243,11 @@ class ClusterDAGScheduler(DAGScheduler):
                 if cur == failed_sid or st is None:
                     done.discard(stage.stage_id)
                     stage.result = None
+                    if st is not None:
+                        # free the stale attempt's blocks + merged chunks
+                        # NOW — once unregistered, _free_shuffles can no
+                        # longer see this sid and the service state leaks
+                        self._free_one(st)
                     self.map_outputs.unregister(cur)
 
         def materialize(stage: Stage) -> None:
@@ -244,30 +303,110 @@ class ClusterDAGScheduler(DAGScheduler):
     def _shuffle_id(self, stage: Stage) -> str:
         return f"{self._run_id}.{stage.stage_id}.{stage.attempts}"
 
+    def _map_task_count(self, shipped) -> int:
+        """How many map tasks to split this stage into. >1 only when the
+        stage root is a hash/round-robin shuffle exchange and every
+        multi-partition Fetch leaf has the same partition count (the
+        co-partitioned zip contract — all such leaves are sliced by the
+        same index set). Range exchanges never slice: each task samples
+        its own bounds, which would break the global order contract."""
+        from ..config import SHUFFLE_MAP_PARALLELISM
+        from ..physical.exchange import ShuffleExchangeExec
+        from ..physical.partitioning import (
+            HashPartitioning, UnknownPartitioning,
+        )
+
+        want = self.ctx.conf.get(SHUFFLE_MAP_PARALLELISM)
+        if want == 1:
+            return 1
+        if not isinstance(shipped, ShuffleExchangeExec):
+            return 1
+        if not isinstance(shipped.partitioning,
+                          (HashPartitioning, UnknownPartitioning)):
+            return 1
+        counts = {f.num_partitions
+                  for f in shipped.iter_nodes()
+                  if isinstance(f, FetchExec) and f.num_partitions > 1}
+        if len(counts) != 1:
+            return 1
+        p = counts.pop()
+        n_workers = max(len(self.cluster.registry.alive()), 1)
+        cap = n_workers if want <= 0 else want
+        return max(1, min(cap, p, n_workers))
+
     def _run_remote(self, stage: Stage):
         shipped = _substitute_parents(stage.root, self)
-        payload = cloudpickle.dumps(shipped)
         sid = self._shuffle_id(stage)
-        result, worker = self.cluster.run_task_traced(
-            _run_stage_store, payload, self.conf_overrides, sid)
-        tag, addr, rows, sizes, counters = result
-        assert tag == "mapstatus", tag
-        status = MapStatus(sid, addr, worker.executor_id, rows, sizes)
+        num_maps = self._map_task_count(shipped)
+
+        def run_map(map_id: int):
+            plan = (_slice_fetch_leaves(shipped, map_id, num_maps)
+                    if num_maps > 1 else shipped)
+            result, worker = self.cluster.run_task_traced(
+                _run_stage_store, cloudpickle.dumps(plan),
+                self.conf_overrides, sid, map_id, num_maps)
+            tag, addr, rows, sizes, counters = result
+            assert tag == "mapstatus", tag
+            return (MapStatus(map_block_id(sid, map_id, num_maps), addr,
+                              worker.executor_id, rows, sizes, map_id),
+                    counters)
+
+        if num_maps == 1:
+            outcomes = [run_map(0)]
+        else:
+            with ThreadPoolExecutor(num_maps) as pool:
+                outcomes = list(pool.map(run_map, range(num_maps)))
+        status = ShuffleStatus(sid, [ms for ms, _ in outcomes])
         self.map_outputs.register(status)
+        if getattr(self.cluster, "push_shuffle", False) and \
+                self.cluster.shuffle_service_addr:
+            status.merge = self._finalize_merge(sid, num_maps)
         # fold worker-side operator metrics into the driver's view (the
         # executor-heartbeat metrics channel, reduced to per-task return)
-        for k, v in counters.items():
-            self.ctx.metrics.add(k, v)
+        for _, counters in outcomes:
+            for k, v in counters.items():
+                self.ctx.metrics.add(k, v)
         self.ctx.metrics.add("scheduler.stages_remote")
-        self.ctx.metrics.add("shuffle.bytes_written", sum(sizes))
+        self.ctx.metrics.add("scheduler.map_tasks", num_maps)
+        self.ctx.metrics.add("shuffle.bytes_written", status.total_bytes)
         return status
 
-    def _free_shuffles(self) -> None:
+    def _finalize_merge(self, sid: str, num_maps: int):
+        """Close the shuffle to late pushes and register which map ids
+        each reduce partition's merged chunk holds (the reference's
+        shuffleMergeFinalized → MergeStatus registration,
+        core/scheduler/MergeStatus.scala)."""
+        import pickle
+
+        from ..net.transport import RpcClient
+
+        addr = self.cluster.shuffle_service_addr
+        try:
+            with RpcClient(addr, self.cluster.authkey_hex) as c:
+                merged = pickle.loads(
+                    c.call("finalize_merge", pickle.dumps(sid),
+                           timeout=30))
+        except Exception:
+            return None    # merge unavailable — per-map fetch still works
+        merge = MergeStatus(sid, addr, num_maps, merged)
+        self.map_outputs.register_merge(merge)
+        return merge
+
+    def _free_one(self, st: ShuffleStatus) -> None:
+        """Best-effort release of one shuffle's blocks on its executors
+        and its originals/merged chunks at the service."""
         key = self.cluster.authkey_hex
+        for ms in st.maps:
+            free_shuffle(ms.block_addr, key, ms.shuffle_id)
+        service = getattr(self.cluster, "shuffle_service_addr", None)
+        if service:
+            free_shuffle(service, key, st.shuffle_id)
+
+    def _free_shuffles(self) -> None:
         for sid in self.map_outputs.shuffle_ids():
             st = self.map_outputs.get(sid)
             if st is not None:
-                free_shuffle(st.block_addr, key, sid)
+                self._free_one(st)
             self.map_outputs.unregister(sid)
 
 
@@ -285,14 +424,36 @@ def _fetch_failed_shuffle_id(e: Exception) -> str | None:
 
 def _substitute_parents(node, sched: ClusterDAGScheduler):
     """Replace _StageOutput leaves with Fetch leaves bound to the
-    executor holding the parent's blocks."""
+    executors holding the parent's map outputs (plus the merge index
+    when the parent shuffle was push-merged)."""
     if isinstance(node, _StageOutput):
         st = node.stage
         status = st.result
-        assert isinstance(status, MapStatus), \
+        assert isinstance(status, ShuffleStatus), \
             f"parent stage {st.stage_id} not materialized"
-        return FetchExec(node.attrs, status.shuffle_id, status.block_addr,
+        merge = None
+        if status.merge is not None:
+            merge = (status.merge.service_addr, status.merge.merged)
+        return FetchExec(node.attrs, status.shuffle_id,
+                         [(m.map_id, m.block_addr) for m in status.maps],
                          sched.cluster.authkey_hex, status.num_partitions,
                          fallback_addr=getattr(sched.cluster,
-                                               "shuffle_service_addr", None))
+                                               "shuffle_service_addr", None),
+                         merge=merge)
     return node.map_children(lambda c: _substitute_parents(c, sched))
+
+
+def _slice_fetch_leaves(node, map_id: int, num_maps: int):
+    """Restrict every multi-partition Fetch leaf to the round-robin
+    slice `map_id::num_maps` of its reduce partitions — the unit of work
+    of one map task. Single-partition leaves (broadcast relations) are
+    left whole so every task sees the full build side."""
+    if isinstance(node, FetchExec) and node.num_partitions > 1:
+        return FetchExec(
+            node.attrs, node.shuffle_id, node.maps, node.authkey_hex,
+            node.num_partitions, fallback_addr=node.fallback_addr,
+            merge=node.merge,
+            part_indices=list(range(map_id, node.num_partitions,
+                                    num_maps)))
+    return node.map_children(
+        lambda c: _slice_fetch_leaves(c, map_id, num_maps))

@@ -30,34 +30,90 @@ class FetchFailedError(RuntimeError):
         self.shuffle_id = shuffle_id
 
 
+def map_block_id(shuffle_id: str, map_id: int, num_maps: int) -> str:
+    """Block-store key for one map task's output. Single-mapper shuffles
+    keep the bare shuffle id (the historical key) so stage-granular
+    stages are wire-compatible with every fetch path."""
+    return shuffle_id if num_maps <= 1 else f"{shuffle_id}#m{map_id}"
+
+
 @dataclass
 class MapStatus:
-    """Where a map stage's output lives + per-reduce-partition sizes
+    """Where ONE map task's output lives + per-reduce-partition sizes
     (core/scheduler/MapStatus.scala: location + getSizeForBlock)."""
 
-    shuffle_id: str
+    shuffle_id: str      # block-store key (map_block_id of this map task)
     block_addr: str      # host:port of the executor's block server
     executor_id: str
     rows: list = field(default_factory=list)    # per reduce partition
     bytes: list = field(default_factory=list)   # per reduce partition
+    map_id: int = 0
 
     @property
     def num_partitions(self) -> int:
         return len(self.rows)
 
 
+@dataclass
+class MergeStatus:
+    """Result of finalizing server-side merge of pushed blocks (role of
+    core/scheduler/MergeStatus.scala + the shuffleMergeFinalized RPC):
+    which map ids made it into the merged chunk of each reduce
+    partition, and where the merged chunks live."""
+
+    shuffle_id: str
+    service_addr: str
+    num_maps: int
+    # reduce_id → map ids present in that partition's merged chunk
+    merged: dict = field(default_factory=dict)
+
+
+@dataclass
+class ShuffleStatus:
+    """All map outputs of one shuffle: per-map-task statuses plus the
+    merge result when push-merge ran (MapOutputTracker's value type)."""
+
+    shuffle_id: str
+    maps: list = field(default_factory=list)    # list[MapStatus]
+    merge: MergeStatus | None = None
+
+    @property
+    def num_partitions(self) -> int:
+        return self.maps[0].num_partitions if self.maps else 0
+
+    @property
+    def executor_id(self) -> str:
+        return self.maps[0].executor_id if self.maps else ""
+
+    @property
+    def block_addr(self) -> str:
+        return self.maps[0].block_addr if self.maps else ""
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(sum(m.bytes) for m in self.maps)
+
+
 class MapOutputTracker:
-    """Driver-side registry: shuffle_id → MapStatus."""
+    """Driver-side registry: shuffle_id → ShuffleStatus."""
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._statuses: dict[str, MapStatus] = {}
+        self._statuses: dict[str, ShuffleStatus] = {}
 
-    def register(self, status: MapStatus) -> None:
+    def register(self, status) -> None:
+        if isinstance(status, MapStatus):
+            status = ShuffleStatus(status.shuffle_id, [status])
         with self._lock:
             self._statuses[status.shuffle_id] = status
 
-    def get(self, shuffle_id: str) -> MapStatus | None:
+    def register_merge(self, merge: MergeStatus) -> None:
+        with self._lock:
+            st = self._statuses.get(merge.shuffle_id)
+            if st is not None:
+                st.merge = merge
+
+    def get(self, shuffle_id: str) -> ShuffleStatus | None:
         with self._lock:
             return self._statuses.get(shuffle_id)
 
@@ -140,6 +196,36 @@ def fetch_block(addr: str, authkey_hex: str, shuffle_id: str,
     """Pull one block (one-shot convenience over BlockClient)."""
     with BlockClient(addr, authkey_hex, shuffle_id) as c:
         return c.get(reduce_id)
+
+
+def fetch_merged(client: RpcClient, shuffle_id: str,
+                 reduce_id: int) -> list | None:
+    """Fetch one MERGED chunk from the shuffle service and split it back
+    into per-map frames [(map_id, raw_block_bytes), ...] (role of the
+    reference's merged-shuffle-chunk fetch, ShuffleBlockFetcherIterator
+    push-merged path). Returns None when the chunk is missing or fails
+    integrity (frame lengths disagree with the index) — callers fall
+    back to per-map original blocks."""
+    try:
+        frames = client.stream(
+            "get_merged", pickle.dumps((shuffle_id, reduce_id)),
+            timeout=120)
+        head = next(frames, None)
+        if head is None or head == b"missing":
+            return None
+        index = pickle.loads(head)          # [(map_id, length), ...]
+        data = b"".join(frames)
+    except Exception:
+        return None
+    out, off = [], 0
+    for map_id, length in index:
+        if off + length > len(data):
+            return None                     # truncated/corrupt chunk
+        out.append((map_id, data[off:off + length]))
+        off += length
+    if off != len(data):
+        return None
+    return out
 
 
 def free_shuffle(addr: str, authkey_hex: str, shuffle_id: str) -> None:

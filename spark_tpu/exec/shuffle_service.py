@@ -52,19 +52,38 @@ def persist_block(root: str, shuffle_id: str, reduce_id: int,
     os.replace(tmp, path)
 
 
+def merged_path(root: str, shuffle_id: str, reduce_id: int) -> str:
+    return os.path.join(root, _safe_name(shuffle_id),
+                        f"merged.{reduce_id}.chunk")
+
+
 class ExternalShuffleService:
-    """Serves persisted shuffle blocks over the block-plane protocol."""
+    """Serves persisted shuffle blocks over the block-plane protocol,
+    and MERGES pushed blocks per reduce partition (role of the
+    reference's RemoteBlockPushResolver.java:97 — magnet push-merge):
+    mappers push (shuffle, map, reduce, data); the service appends each
+    block to one merged chunk file per reduce partition, deduping by
+    map id (speculative duplicates are byte-identical by lineage
+    determinism, so keep-first is safe); finalize closes the shuffle to
+    late pushes and returns the per-partition map-id sets — the
+    MergeStatus payload."""
 
     def __init__(self, root: str, token: str, host: str = "127.0.0.1"):
         self.root = root
         os.makedirs(root, exist_ok=True)
         self._server = RpcServer(token, host=host)
         self._server.register_stream("get_block", self._get_block)
+        self._server.register_stream("get_merged", self._get_merged)
         self._server.register("free_shuffle", self._free_shuffle)
         self._server.register("put_block", self._put_block)
+        self._server.register("push_block", self._push_block)
+        self._server.register("finalize_merge", self._finalize_merge)
         self._server.register("ping", lambda _p: b"pong")
         self.address = ""
         self._lock = threading.Lock()
+        # shuffle_id → {"finalized": bool,
+        #               "index": {rid: [(map_id, length), ...]}}
+        self._merges: dict[str, dict] = {}
 
     def start(self) -> str:
         self.address = self._server.start()
@@ -98,10 +117,69 @@ class ExternalShuffleService:
         persist_block(self.root, sid, rid, data)
         return b"ok"
 
+    # -- push-merge (magnet) handlers ------------------------------------
+    def _push_block(self, payload: bytes) -> bytes:
+        """Append one pushed map block to the reduce partition's merged
+        chunk. Replies: ok | dup (map id already merged) | late (shuffle
+        already finalized — the pusher's data is DROPPED, exactly the
+        reference's stale-push handling)."""
+        sid, map_id, rid, data = pickle.loads(payload)
+        with self._lock:
+            m = self._merges.setdefault(
+                sid, {"finalized": False, "index": {}})
+            if m["finalized"]:
+                return b"late"
+            frames = m["index"].setdefault(rid, [])
+            if any(mid == map_id for mid, _ in frames):
+                return b"dup"
+            path = merged_path(self.root, sid, rid)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "ab") as f:
+                f.write(data)
+            frames.append((map_id, len(data)))
+        return b"ok"
+
+    def _finalize_merge(self, payload: bytes) -> bytes:
+        sid = pickle.loads(payload)
+        with self._lock:
+            m = self._merges.setdefault(
+                sid, {"finalized": False, "index": {}})
+            m["finalized"] = True
+            return pickle.dumps({rid: tuple(mid for mid, _ in frames)
+                                 for rid, frames in m["index"].items()})
+
+    def _get_merged(self, payload: bytes):
+        sid, rid = pickle.loads(payload)
+        with self._lock:
+            m = self._merges.get(sid)
+            frames = list(m["index"].get(rid, ())) if m else None
+        path = merged_path(self.root, sid, rid)
+        if not frames or not os.path.exists(path):
+            yield b"missing"
+            return
+        yield pickle.dumps(frames)          # [(map_id, length), ...]
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(CHUNK_BYTES)
+                if not chunk:
+                    break
+                yield chunk
+
     def _free_shuffle(self, payload: bytes) -> bytes:
+        """Remove a shuffle's originals, merged chunks, and per-map
+        block dirs (map block ids are '<sid>#m<i>', sanitized to
+        '<sid>_m<i>' on disk)."""
         import shutil
 
         sid = pickle.loads(payload)
-        shutil.rmtree(os.path.join(self.root, _safe_name(sid)),
-                      ignore_errors=True)
+        safe = _safe_name(sid)
+        with self._lock:
+            for k in [k for k in self._merges
+                      if k == sid or k.startswith(sid + "#m")]:
+                self._merges.pop(k, None)
+        for name in (os.listdir(self.root)
+                     if os.path.isdir(self.root) else ()):
+            if name == safe or name.startswith(safe + "_m"):
+                shutil.rmtree(os.path.join(self.root, name),
+                              ignore_errors=True)
         return b"ok"

@@ -36,30 +36,47 @@ _STORE_LOCK = threading.Lock()
 _PUSH_CLIENT = None
 
 
-def put_block(shuffle_id: str, reduce_id: int, data: bytes) -> None:
+def _push_client() -> "RpcClient | None":
     global _PUSH_CLIENT
 
+    push_addr = os.environ.get("SPARK_TPU_SHUFFLE_PUSH_ADDR")
+    if not push_addr:
+        return None
+    with _STORE_LOCK:  # one client per process (racy init leaks)
+        if _PUSH_CLIENT is None:
+            _PUSH_CLIENT = RpcClient(
+                push_addr, os.environ["SPARK_TPU_WORKER_KEY"])
+        return _PUSH_CLIENT
+
+
+def store_map_block(shuffle_id: str, map_id: int, num_maps: int,
+                    reduce_id: int, data: bytes) -> None:
+    """Store one map task's block for one reduce partition:
+    in this worker's memory (serves reducer pulls), in the shared spill
+    dir when the external shuffle service runs over one (durability),
+    and — push mode — PUSHED to the service's per-reduce-partition
+    merger over the network (ShuffleBlockPusher →
+    RemoteBlockPushResolver push-merge path; no shared filesystem)."""
+    from .map_output import map_block_id
+
+    bid = map_block_id(shuffle_id, map_id, num_maps)
     with _STORE_LOCK:
-        BLOCK_STORE[(shuffle_id, reduce_id)] = data
-    # external-shuffle durability: persist so the block outlives this
-    # process (exec/shuffle_service.py; reference ExternalShuffleService)
+        BLOCK_STORE[(bid, reduce_id)] = data
     root = os.environ.get("SPARK_TPU_SHUFFLE_DIR")
     if root:
         from .shuffle_service import persist_block
 
-        persist_block(root, shuffle_id, reduce_id, data)
-    # push-based path: no shared filesystem — ship the block to the
-    # shuffle service over the network (ShuffleBlockPusher role)
-    push_addr = os.environ.get("SPARK_TPU_SHUFFLE_PUSH_ADDR")
-    if push_addr:
-        with _STORE_LOCK:  # one client per process (racy init leaks)
-            if _PUSH_CLIENT is None:
-                _PUSH_CLIENT = RpcClient(
-                    push_addr, os.environ["SPARK_TPU_WORKER_KEY"])
-            client = _PUSH_CLIENT
+        persist_block(root, bid, reduce_id, data)
+    client = _push_client()
+    if client is not None:
         client.call(
-            "put_block", pickle.dumps((shuffle_id, reduce_id, data)),
+            "push_block",
+            pickle.dumps((shuffle_id, map_id, reduce_id, data)),
             timeout=120)
+
+
+def put_block(shuffle_id: str, reduce_id: int, data: bytes) -> None:
+    store_map_block(shuffle_id, 0, 1, reduce_id, data)
 
 
 def _handle_get_block(payload: bytes):
@@ -77,7 +94,9 @@ def _handle_get_block(payload: bytes):
 def _handle_free_shuffle(payload: bytes) -> bytes:
     sid = pickle.loads(payload)
     with _STORE_LOCK:
-        for k in [k for k in BLOCK_STORE if k[0] == sid]:
+        # base id and per-map block ids ('<sid>#m<i>') alike
+        for k in [k for k in BLOCK_STORE
+                  if k[0] == sid or k[0].startswith(sid + "#m")]:
             BLOCK_STORE.pop(k, None)
     return b"ok"
 
