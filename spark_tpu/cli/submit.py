@@ -46,7 +46,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--properties-file", default=None,
                    help="newline-delimited k=v defaults (lowest precedence)")
     p.add_argument("--master", default="local",
-                   help="local | local-cluster[N] (process workers)")
+                   help="local | local-cluster[N] (process workers) | "
+                        "grpc://host:port (standalone master daemon)")
+    p.add_argument("--num-executors", type=int, default=None,
+                   help="executors to request from a standalone master")
     p.add_argument("app", help="python application file")
     p.add_argument("app_args", nargs=argparse.REMAINDER,
                    help="arguments passed to the application")
@@ -79,6 +82,11 @@ def main(argv: list[str] | None = None) -> int:
         inner = args.master[len("local-cluster"):].strip("[]")
         if inner:
             conf.setdefault("spark.tpu.cluster.workers", inner.split(",")[0])
+    elif args.master.startswith(("grpc://", "spark://")):
+        conf.setdefault("spark.tpu.master", args.master)
+        if args.num_executors:
+            conf.setdefault("spark.executor.instances",
+                            str(args.num_executors))
 
     os.environ["SPARKTPU_CONF_JSON"] = json.dumps(conf)
     os.environ["SPARKTPU_APP_NAME"] = args.name

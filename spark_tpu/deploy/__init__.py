@@ -1,0 +1,4 @@
+"""Standalone deploy layer: master + worker daemons and the driver-side
+standalone cluster backend (role of the reference's
+core/deploy/master/Master.scala, worker/Worker.scala,
+client/StandaloneAppClient.scala)."""

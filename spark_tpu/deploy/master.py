@@ -1,0 +1,243 @@
+"""Standalone master daemon.
+
+Role of the reference's Master (core/deploy/master/Master.scala): the
+cluster-wide resource arbiter. Worker daemons register and heartbeat;
+applications submit a desired executor count plus their driver's
+address/secret; the master PLACES executor launches on alive workers
+and keeps the fleet reconciled — a worker (or executor) death is
+detected by heartbeat loss and the missing executors are re-placed on
+the survivors, exactly the reference's `schedule()` loop
+(Master.scala:744). Executors themselves register with the APP's
+driver directly (the CoarseGrainedExecutorBackend flow): the master
+never sits on the task or shuffle data paths.
+
+TPU note: a "worker" here is one host of a TPU pod slice. The master
+only arbitrates processes; all device-mesh collectives ride ICI inside
+the app's own jit programs.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+import uuid
+
+from ..net.transport import RpcClient, RpcServer
+
+
+class _WorkerInfo:
+    def __init__(self, wid: str, addr: str, host: str, cores: int,
+                 client: RpcClient):
+        self.wid = wid
+        self.addr = addr
+        self.host = host
+        self.cores = cores
+        self.client = client
+        self.last_heartbeat = time.monotonic()
+        # app_id → executors this worker reports alive (from heartbeats)
+        self.app_executors: dict[str, int] = {}
+
+
+class _AppInfo:
+    def __init__(self, app_id: str, name: str, driver_addr: str,
+                 driver_token: str, executors: int, env_extra: dict):
+        self.app_id = app_id
+        self.name = name
+        self.driver_addr = driver_addr
+        self.driver_token = driver_token
+        self.desired = executors
+        self.env_extra = dict(env_extra)
+        self.last_launch = 0.0
+
+
+class Master:
+    """gRPC control daemon: worker registry + app placement/reconcile."""
+
+    def __init__(self, token: str, host: str = "127.0.0.1",
+                 heartbeat_timeout: float = 10.0,
+                 reconcile_cooldown: float = 3.0):
+        self.token = token
+        self.heartbeat_timeout = heartbeat_timeout
+        self.reconcile_cooldown = reconcile_cooldown
+        self._lock = threading.Lock()
+        self._workers: dict[str, _WorkerInfo] = {}
+        self._apps: dict[str, _AppInfo] = {}
+        self._rr = 0
+        self._stopping = False
+        self._server = RpcServer(token, host=host)
+        self._server.register("register_worker", self._on_register_worker)
+        self._server.register("worker_heartbeat", self._on_heartbeat)
+        self._server.register("submit_app", self._on_submit_app)
+        self._server.register("app_finished", self._on_app_finished)
+        self._server.register("master_state", self._on_state)
+        self._server.register("ping", lambda _p: b"pong")
+        self.address = ""
+
+    def start(self) -> str:
+        self.address = self._server.start()
+        threading.Thread(target=self._monitor_loop, daemon=True).start()
+        return self.address
+
+    def stop(self) -> None:
+        self._stopping = True
+        with self._lock:
+            workers = list(self._workers.values())
+        for w in workers:
+            try:
+                w.client.close()
+            except Exception:
+                pass
+        self._server.stop()
+
+    # -- handlers --------------------------------------------------------
+    def _on_register_worker(self, payload: bytes) -> bytes:
+        info = pickle.loads(payload)
+        client = RpcClient(info["addr"], self.token)
+        try:
+            client.wait_ready(10)
+        except Exception:
+            client.close()
+            raise
+        wid = f"worker-{uuid.uuid4().hex[:8]}"
+        with self._lock:
+            self._workers[wid] = _WorkerInfo(
+                wid, info["addr"], info.get("host", "unknown"),
+                int(info.get("cores", 1)), client)
+        return wid.encode()
+
+    def _on_heartbeat(self, payload: bytes) -> bytes:
+        wid, app_counts = pickle.loads(payload)
+        with self._lock:
+            w = self._workers.get(wid)
+            if w is None:
+                return b"unknown"   # told to re-register (Master.scala
+            w.last_heartbeat = time.monotonic()
+            w.app_executors = dict(app_counts)
+        return b"ok"
+
+    def _on_submit_app(self, payload: bytes) -> bytes:
+        req = pickle.loads(payload)
+        app_id = f"app-{uuid.uuid4().hex[:8]}"
+        app = _AppInfo(app_id, req.get("name", "app"), req["driver_addr"],
+                       req["driver_token"], int(req["executors"]),
+                       req.get("env_extra", {}))
+        with self._lock:
+            self._apps[app_id] = app
+        self._reconcile(app)
+        return app_id.encode()
+
+    def _on_app_finished(self, payload: bytes) -> bytes:
+        app_id = pickle.loads(payload)
+        with self._lock:
+            self._apps.pop(app_id, None)
+            workers = list(self._workers.values())
+        for w in workers:
+            try:
+                w.client.call("kill_app", pickle.dumps(app_id), timeout=10)
+            except Exception:
+                pass
+        return b"ok"
+
+    def _on_state(self, _payload: bytes) -> bytes:
+        with self._lock:
+            return pickle.dumps({
+                "workers": [{"id": w.wid, "addr": w.addr, "host": w.host,
+                             "cores": w.cores,
+                             "apps": dict(w.app_executors)}
+                            for w in self._workers.values()],
+                "apps": [{"id": a.app_id, "name": a.name,
+                          "desired": a.desired,
+                          "driver": a.driver_addr}
+                         for a in self._apps.values()],
+            })
+
+    # -- placement / reconcile ------------------------------------------
+    def _alive_workers(self) -> list[_WorkerInfo]:
+        now = time.monotonic()
+        with self._lock:
+            return [w for w in self._workers.values()
+                    if now - w.last_heartbeat <= self.heartbeat_timeout]
+
+    def _reconcile(self, app: _AppInfo) -> None:
+        """Launch executors until the app's reported-alive total reaches
+        its desired count, spreading round-robin over alive workers
+        (Master.scala:744 schedule / spreadOutApps)."""
+        now = time.monotonic()
+        if now - app.last_launch < self.reconcile_cooldown:
+            return      # let just-launched executors show up in heartbeats
+        alive = self._alive_workers()
+        if not alive:
+            return
+        have = sum(w.app_executors.get(app.app_id, 0) for w in alive)
+        deficit = app.desired - have
+        if deficit <= 0:
+            return
+        app.last_launch = now
+        req = pickle.dumps({
+            "app_id": app.app_id,
+            "driver_addr": app.driver_addr,
+            "driver_token": app.driver_token,
+            "env_extra": app.env_extra,
+        })
+        for i in range(deficit):
+            w = alive[(self._rr + i) % len(alive)]
+            try:
+                w.client.call("launch_executor", req, timeout=30)
+            except Exception:
+                continue    # worker just died — next tick re-places
+        self._rr += deficit
+
+    def _monitor_loop(self) -> None:
+        while not self._stopping:
+            time.sleep(1.0)
+            now = time.monotonic()
+            with self._lock:
+                dead = [wid for wid, w in self._workers.items()
+                        if now - w.last_heartbeat > self.heartbeat_timeout]
+                for wid in dead:
+                    w = self._workers.pop(wid)
+                    try:
+                        w.client.close()
+                    except Exception:
+                        pass
+                apps = list(self._apps.values())
+            for app in apps:
+                try:
+                    self._reconcile(app)
+                except Exception:
+                    pass
+
+
+def main(argv=None) -> int:
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser(prog="sparktpu-master")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--secret",
+                   default=os.environ.get("SPARK_TPU_MASTER_SECRET"))
+    p.add_argument("--announce-file", default=None,
+                   help="write the bound address here once serving "
+                        "(deployment scripts / tests read it back)")
+    args = p.parse_args(argv)
+    if not args.secret:
+        raise SystemExit("--secret or SPARK_TPU_MASTER_SECRET required")
+    m = Master(args.secret, host=args.host)
+    addr = m.start()
+    print(f"sparktpu master listening at {addr}", flush=True)
+    if args.announce_file:
+        tmp = args.announce_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(addr)
+        os.replace(tmp, args.announce_file)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    m.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

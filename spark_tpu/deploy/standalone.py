@@ -1,0 +1,103 @@
+"""Driver-side standalone cluster backend.
+
+Role of the reference's StandaloneAppClient + StandaloneSchedulerBackend
+(core/deploy/client/StandaloneAppClient.scala:60 registerWithMaster,
+core/scheduler/cluster/StandaloneSchedulerBackend.scala): the driver
+keeps its own control plane (executor registration, heartbeats, task
+dispatch — the LocalCluster machinery), but instead of spawning local
+executor processes it asks a MASTER daemon for them; worker daemons
+launch the executor processes, which then dial the driver directly.
+Worker churn is the master's problem (it re-places lost executors); the
+driver's HealthTracker + task retry absorb the loss in-flight.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+
+from ..exec.cluster import LocalCluster
+from ..net.transport import RpcClient
+
+
+def parse_master_url(url: str) -> str:
+    """grpc://host:port → host:port (the reference's spark://host:port)."""
+    for prefix in ("grpc://", "spark://"):
+        if url.startswith(prefix):
+            return url[len(prefix):]
+    return url
+
+
+class StandaloneCluster(LocalCluster):
+    """A cluster whose executors come from a standalone master."""
+
+    def __init__(self, master_url: str, master_secret: str,
+                 num_executors: int = 2, app_name: str = "app",
+                 bind_host: str = "127.0.0.1",
+                 executor_wait_timeout: float = 60.0, **kw):
+        super().__init__(num_workers=0, bind_host=bind_host, **kw)
+        self.master_addr = parse_master_url(master_url)
+        self._master_secret = master_secret
+        self.app_id = ""
+        self._master = None
+        try:
+            self._master = RpcClient(self.master_addr, master_secret)
+            self._master.wait_ready(30)
+            env_extra = {}
+            if self.push_shuffle and self.shuffle_service_addr:
+                env_extra["SPARK_TPU_SHUFFLE_PUSH_ADDR"] = \
+                    self.shuffle_service_addr
+            self.app_id = self._master.call("submit_app", pickle.dumps({
+                "name": app_name,
+                "driver_addr": self.driver_addr,
+                "driver_token": self.token,
+                "executors": num_executors,
+                "env_extra": env_extra,
+            }), timeout=30).decode()
+            self.min_workers = num_executors
+            self.max_workers = num_executors
+            self._await_executors(num_executors, executor_wait_timeout)
+        except BaseException:
+            # a failed join must not leave the driver's RPC/shuffle
+            # services running or the app registered at the master (its
+            # reconcile loop would keep launching executors for a dead
+            # driver)
+            self.stop()
+            raise
+
+    def _await_executors(self, expect: int, timeout: float) -> None:
+        """Executors are launched by REMOTE worker daemons — there are
+        no local Popen handles to adopt, just registrations to await."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while len(self._workers) < expect:
+                rest = deadline - time.monotonic()
+                if rest <= 0 or not self._joined.wait(timeout=rest):
+                    raise RuntimeError(
+                        f"only {len(self._workers)}/{expect} executors "
+                        f"joined from master {self.master_addr} "
+                        f"within {timeout}s")
+
+    def wait_for_executors(self, expect: int, timeout: float = 60.0):
+        """Block until the master has re-placed executors up to
+        `expect` alive (used after worker churn)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.num_alive() >= expect:
+                return
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"{self.num_alive()}/{expect} executors after {timeout}s")
+
+    def stop(self):
+        if self._master is not None:
+            try:
+                if self.app_id:
+                    self._master.call("app_finished",
+                                      pickle.dumps(self.app_id), timeout=10)
+            except Exception:
+                pass
+            finally:
+                self._master.close()
+                self._master = None
+        super().stop()

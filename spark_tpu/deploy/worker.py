@@ -1,0 +1,159 @@
+"""Standalone worker daemon.
+
+Role of the reference's Worker (core/deploy/worker/Worker.scala): a
+per-host daemon that registers with the master, heartbeats its state,
+and LAUNCHES executor processes on demand (Worker.scala LaunchExecutor
+→ ExecutorRunner). Executors are `spark_tpu.exec.worker_main` processes
+wired to the submitting app's driver address + secret; they register
+with the driver themselves, so the master/worker control plane never
+carries task or shuffle traffic. Dead executors are reaped and reported
+via heartbeat so the master can re-place them.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+
+from ..net.transport import RpcClient, RpcServer
+
+
+class WorkerDaemon:
+    def __init__(self, master_addr: str, token: str,
+                 host: str = "127.0.0.1", cores: int = 2,
+                 heartbeat_interval: float = 1.0):
+        self.master_addr = master_addr
+        self.token = token
+        self.host = host
+        self.cores = cores
+        self.heartbeat_interval = heartbeat_interval
+        self._lock = threading.Lock()
+        # app_id → list of executor Popen handles
+        self._executors: dict[str, list[subprocess.Popen]] = {}
+        self._stopping = False
+        self._server = RpcServer(token, host=host)
+        self._server.register("launch_executor", self._on_launch)
+        self._server.register("kill_app", self._on_kill_app)
+        self._server.register("ping", lambda _p: b"pong")
+        self.address = ""
+        self.worker_id = ""
+        self._master: RpcClient | None = None
+
+    def start(self) -> str:
+        self.address = self._server.start()
+        self._master = RpcClient(self.master_addr, self.token)
+        self._master.wait_ready(30)
+        self.worker_id = self._register()
+        threading.Thread(target=self._heartbeat_loop, daemon=True).start()
+        return self.address
+
+    def _register(self) -> str:
+        return self._master.call("register_worker", pickle.dumps({
+            "addr": self.address, "host": self.host, "cores": self.cores,
+        }), timeout=10).decode()
+
+    def stop(self) -> None:
+        self._stopping = True
+        with self._lock:
+            apps = list(self._executors)
+        for app_id in apps:
+            self._kill_app(app_id)
+        if self._master is not None:
+            self._master.close()
+        self._server.stop()
+
+    # -- handlers --------------------------------------------------------
+    def _on_launch(self, payload: bytes) -> bytes:
+        from ..exec.cluster import worker_env
+
+        req = pickle.loads(payload)
+        env = worker_env(req["driver_addr"], req["driver_token"],
+                         host_label=self.host, bind_host=self.host)
+        env.update(req.get("env_extra", {}))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "spark_tpu.exec.worker_main"], env=env)
+        with self._lock:
+            self._executors.setdefault(req["app_id"], []).append(proc)
+        return b"ok"
+
+    def _on_kill_app(self, payload: bytes) -> bytes:
+        self._kill_app(pickle.loads(payload))
+        return b"ok"
+
+    def _kill_app(self, app_id: str) -> None:
+        with self._lock:
+            procs = self._executors.pop(app_id, [])
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    # -- heartbeat / reap ------------------------------------------------
+    def _alive_counts(self) -> dict[str, int]:
+        with self._lock:
+            # reap exited executors while counting (ExecutorRunner's
+            # exit-notification role)
+            out = {}
+            for app_id, procs in list(self._executors.items()):
+                live = [p for p in procs if p.poll() is None]
+                self._executors[app_id] = live
+                out[app_id] = len(live)
+            return out
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stopping:
+            time.sleep(self.heartbeat_interval)
+            try:
+                reply = self._master.call(
+                    "worker_heartbeat",
+                    pickle.dumps((self.worker_id, self._alive_counts())),
+                    timeout=5)
+                if reply == b"unknown":
+                    # master restarted / expired us — rejoin under a new
+                    # id (Worker.scala reregisterWithMaster role)
+                    self.worker_id = self._register()
+            except Exception:
+                pass    # master briefly unreachable — keep trying
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="sparktpu-worker")
+    p.add_argument("master", help="master address host:port")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--cores", type=int, default=2)
+    p.add_argument("--secret",
+                   default=os.environ.get("SPARK_TPU_MASTER_SECRET"))
+    p.add_argument("--announce-file", default=None)
+    args = p.parse_args(argv)
+    if not args.secret:
+        raise SystemExit("--secret or SPARK_TPU_MASTER_SECRET required")
+    w = WorkerDaemon(args.master.replace("grpc://", ""), args.secret,
+                     host=args.host, cores=args.cores)
+    addr = w.start()
+    print(f"sparktpu worker {w.worker_id} at {addr} "
+          f"(master {args.master})", flush=True)
+    if args.announce_file:
+        tmp = args.announce_file + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(addr)
+        os.replace(tmp, args.announce_file)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    w.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
