@@ -108,7 +108,40 @@ class TpuSession:
                 == "true":
             log_dir = self.conf.get("spark.eventLog.dir", "/tmp/spark-events")
             self.listener_bus.register(EventLoggingListener(log_dir))
+        self._maybe_attach_conf_cluster()
         TpuSession._active = self
+
+    def _maybe_attach_conf_cluster(self) -> None:
+        """Conf-driven cluster attach (the spark-submit --master flow):
+        spark.tpu.master=grpc://host:port joins a standalone master
+        (deploy/standalone.py); spark.tpu.cluster.enabled=true spawns a
+        local process cluster (the reference's local-cluster mode)."""
+        import os
+
+        master = str(self.conf.get("spark.tpu.master", "") or "")
+        push = str(self.conf.get("spark.tpu.shuffle.push",
+                                 "false")).lower() == "true"
+        if master.startswith(("grpc://", "spark://")):
+            from ..deploy.standalone import StandaloneCluster
+
+            secret = (self.conf.get("spark.tpu.master.secret")
+                      or os.environ.get("SPARK_TPU_MASTER_SECRET"))
+            if not secret:
+                raise ValueError(
+                    "spark.tpu.master set but no secret: provide "
+                    "spark.tpu.master.secret or SPARK_TPU_MASTER_SECRET")
+            self._sql_cluster = StandaloneCluster(
+                master, str(secret),
+                int(self.conf.get("spark.executor.instances", 2)),
+                app_name=self.name, push_shuffle=push)
+        elif str(self.conf.get("spark.tpu.cluster.enabled",
+                               "false")).lower() == "true":
+            from ..exec.cluster import LocalCluster
+
+            self._sql_cluster = LocalCluster(
+                num_workers=int(self.conf.get("spark.tpu.cluster.workers",
+                                              2)),
+                push_shuffle=push)
 
     @property
     def listenerManager(self):
@@ -282,42 +315,112 @@ class TpuSession:
             except Exception:
                 pass
             self._sql_cluster = None
+        bm = getattr(self, "_block_manager", None)
+        if bm is not None:
+            try:
+                bm.clear()
+            except Exception:
+                pass
+            self._block_manager = None
         if TpuSession._active is self:
             TpuSession._active = None
 
+    @property
+    def block_manager(self):
+        """Session block store: cached tables live here under tiered
+        budgets (device pins / host RAM / disk) with LRU eviction —
+        role of core/storage/BlockManager.scala + MemoryStore/DiskStore."""
+        bm = getattr(self, "_block_manager", None)
+        if bm is None:
+            from ..exec.block_store import BlockManager
+
+            spill = str(self.conf.get("spark.local.dir", "") or "") or None
+            bm = self._block_manager = BlockManager(
+                self.conf, spill_dir=spill, metrics=self._metrics)
+        return bm
+
+    @staticmethod
+    def _table_to_ipc(table) -> bytes:
+        import pyarrow as pa
+
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, table.schema) as w:
+            w.write_table(table)
+        return sink.getvalue().to_pybytes()
+
     def _cache_df(self, df):
-        """Materialize once and register the (analyzed plan → LocalRelation)
-        pair: ANY later query containing a semantically equal subtree is
-        rewritten to scan the cache (role of CacheManager.useCachedData,
-        sqlx/columnar/CacheManager.scala + QueryExecution withCachedData)."""
+        """Materialize once and register (analyzed plan → block id): ANY
+        later query containing a semantically equal subtree is rewritten
+        to scan the cached block (role of CacheManager.useCachedData,
+        sqlx/columnar/CacheManager.scala + QueryExecution
+        withCachedData). The bytes live in the tiered block store, so a
+        cache bigger than the memory budget degrades to disk and then to
+        recompute-from-lineage — it never pins unbounded RAM."""
+        import uuid
+
         analyzed = df.query_execution.analyzed
-        for plan, _ in self._cached.values():
+        for plan, _attrs, _bid in self._cached.values():
             if plan.fast_equals(analyzed):
                 return df
         table = df.toArrow()
-        attrs = list(analyzed.output)
+        block_id = f"cache-{uuid.uuid4().hex[:12]}"
+        self.block_manager.put(block_id, self._table_to_ipc(table))
         # unique token key (id(df) recycles after GC and would silently
         # evict an unrelated entry)
-        self._cached[object()] = (analyzed, LocalRelation(attrs, table))
+        self._cached[object()] = (analyzed, list(analyzed.output), block_id)
         return df
 
     def _uncache_df(self, df):
         analyzed = df.query_execution.analyzed
-        for k, (plan, _) in list(self._cached.items()):
+        for k, (plan, _attrs, bid) in list(self._cached.items()):
             if plan.fast_equals(analyzed):
+                self.block_manager.remove(bid)
                 del self._cached[k]
         return df
 
+    def _cached_relation(self, analyzed, attrs, block_id):
+        """Block bytes → LocalRelation; a dropped block re-materializes
+        from lineage (the RDD recompute-on-miss contract,
+        BlockManager.getOrElseUpdate role) and re-enters the store."""
+        import pyarrow as pa
+
+        from .dataframe import DataFrame
+
+        data = self.block_manager.get(block_id)
+        if data is None:
+            guard = getattr(self, "_recomputing", None)
+            if guard is None:
+                guard = self._recomputing = set()
+            if block_id in guard:
+                return None     # already rebuilding below us — compute raw
+            guard.add(block_id)
+            try:
+                table = DataFrame(self, analyzed).toArrow()
+            finally:
+                guard.discard(block_id)
+            self._metrics.add("cache.recomputed_from_lineage")
+            self.block_manager.put(block_id, self._table_to_ipc(table))
+        else:
+            table = pa.ipc.open_stream(pa.BufferReader(data)).read_all()
+        return LocalRelation(attrs, table)
+
     def _use_cached(self, plan):
-        """Substitute cached fragments into an analyzed plan."""
+        """Substitute cached fragments into an analyzed plan. One
+        relation per block per call (memo): a self-join of a cached
+        frame shares a single deserialized table instead of two."""
         if not self._cached:
             return plan
         entries = list(self._cached.values())
+        memo: dict = {}
 
         def rule(node):
-            for cached_plan, relation in entries:
-                if node is not relation and node.fast_equals(cached_plan):
-                    return relation
+            for cached_plan, attrs, block_id in entries:
+                if node.fast_equals(cached_plan):
+                    if block_id not in memo:
+                        memo[block_id] = self._cached_relation(
+                            cached_plan, attrs, block_id)
+                    if memo[block_id] is not None:
+                        return memo[block_id]
             return node
 
         return plan.transform_up(rule)

@@ -358,6 +358,19 @@ class ColumnarBatch:
             self._num_rows = int(np.asarray(self.row_mask).sum())
         return self._num_rows
 
+    def device_nbytes(self) -> int:
+        """Device bytes this tile holds (column data + validity planes +
+        row mask) — the block store's device-pin accounting unit."""
+        total = self.row_mask.size * 1
+        for c in self.columns:
+            data = getattr(c, "data", None)
+            if data is not None:
+                total += data.size * data.dtype.itemsize
+            valid = getattr(c, "validity", None)
+            if valid is not None:
+                total += valid.size * 1
+        return int(total)
+
     def with_columns(self, schema: StructType, columns: Sequence[Column],
                      row_mask=None, num_rows: int | None = None) -> "ColumnarBatch":
         return ColumnarBatch(

@@ -1,0 +1,246 @@
+"""Unified tiered block store for cached data.
+
+Role of the reference's BlockManager + MemoryStore/DiskStore
+(core/storage/BlockManager.scala, storage/memory/MemoryStore.scala:232
+putIteratorAsValues → evictBlocksToFreeSpace, storage/DiskStore.scala),
+re-shaped for the XLA memory model:
+
+- **device tier**: scan-pinned device batches (the `df.cache()` hot
+  path). XLA owns HBM, so this tier governs *entries*, not allocator
+  bytes: each pinned partition registers its size and LRU entries are
+  dropped (device buffers freed by GC) when the device budget is hit.
+- **host tier**: Arrow IPC bytes in RAM under a byte budget with LRU
+  eviction to disk (MemoryStore → DiskStore flow).
+- **disk tier**: spill files under a byte budget; beyond it, blocks
+  DROP entirely and re-materialize from lineage on the next access —
+  the RDD recompute-on-miss contract, so a cache larger than
+  RAM + disk degrades instead of killing the session.
+
+Access promotes disk blocks back to the host tier. All transitions are
+counted so tests (and the UI storage page) can see evictions happen.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+from ..config import ConfigEntry, _register
+
+CACHE_MEMORY_BUDGET = _register(ConfigEntry(
+    "spark.tpu.cache.memoryBudgetBytes", 1 << 30,
+    "Host-RAM bytes the unified block store may hold before LRU "
+    "eviction to the disk tier (MemoryStore budget role).", int))
+
+CACHE_DISK_BUDGET = _register(ConfigEntry(
+    "spark.tpu.cache.diskBudgetBytes", 4 << 30,
+    "Disk bytes the block store may hold; beyond it blocks drop and "
+    "re-materialize from lineage on miss (DiskStore budget role).", int))
+
+CACHE_DEVICE_ENTRY_BUDGET = _register(ConfigEntry(
+    "spark.tpu.cache.deviceBudgetBytes", 0,
+    "Device bytes of scan-pinned cached batches before LRU entries are "
+    "unpinned (0 = auto: half the blocking-operator device budget).",
+    int))
+
+
+class _HostBlock:
+    __slots__ = ("data", "nbytes")
+
+    def __init__(self, data: bytes):
+        self.data = data
+        self.nbytes = len(data)
+
+
+class BlockManager:
+    """Session-level tiered store; thread-safe (queries may cache
+    concurrently from scheduler threads)."""
+
+    def __init__(self, conf, spill_dir: str | None = None, metrics=None):
+        self.memory_budget = int(conf.get(CACHE_MEMORY_BUDGET))
+        self.disk_budget = int(conf.get(CACHE_DISK_BUDGET))
+        dev = int(conf.get(CACHE_DEVICE_ENTRY_BUDGET))
+        if dev <= 0:
+            from .memory import DEVICE_BUDGET, _auto_budget
+
+            explicit = int(conf.get(DEVICE_BUDGET))
+            dev = (explicit if explicit > 0 else _auto_budget()) // 2
+        self.device_budget = dev
+        self.metrics = metrics
+        self._lock = threading.RLock()
+        self._host: "OrderedDict[str, _HostBlock]" = OrderedDict()
+        self._host_bytes = 0
+        self._disk: "OrderedDict[str, tuple[str, int]]" = OrderedDict()
+        self._disk_bytes = 0
+        self._spill_dir = spill_dir
+        self._spill_created = False
+        # device tier: block_id → (owner dict, key, nbytes); owner is a
+        # scan's _device_cache, entries die when popped from it
+        self._device: "OrderedDict[str, tuple[dict, object, int]]" = \
+            OrderedDict()
+        self._device_bytes = 0
+
+    # -- internals -------------------------------------------------------
+    def _count(self, name: str, v: int = 1) -> None:
+        if self.metrics is not None:
+            self.metrics.add(name, v)
+
+    def _spill_path(self, block_id: str) -> str:
+        if not self._spill_created:
+            import tempfile
+
+            self._spill_dir = tempfile.mkdtemp(
+                prefix="sparktpu-blocks-",
+                dir=self._spill_dir or None)
+            self._spill_created = True
+        safe = "".join(c if c.isalnum() or c in "._-" else "_"
+                       for c in block_id)
+        return os.path.join(self._spill_dir, f"{safe}.block")
+
+    def _evict_host_until(self, incoming: int) -> None:
+        """LRU host→disk until `incoming` bytes fit (evictBlocksToFreeSpace
+        role). A block larger than the whole budget goes straight to
+        disk — never wedge the store."""
+        while self._host and \
+                self._host_bytes + incoming > self.memory_budget:
+            bid, blk = self._host.popitem(last=False)
+            self._host_bytes -= blk.nbytes
+            self._put_disk(bid, blk.data)
+            self._count("cache.evictions_to_disk")
+
+    def _put_disk(self, block_id: str, data: bytes) -> None:
+        if len(data) > self.disk_budget:
+            # an un-storable block must not evict everything else first
+            self._count("cache.blocks_dropped")
+            return
+        while self._disk and \
+                self._disk_bytes + len(data) > self.disk_budget:
+            dropped, (path, nbytes) = self._disk.popitem(last=False)
+            self._disk_bytes -= nbytes
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            self._count("cache.blocks_dropped")
+        path = self._spill_path(block_id)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        self._disk[block_id] = (path, len(data))
+        self._disk_bytes += len(data)
+
+    # -- host/disk API ---------------------------------------------------
+    def put(self, block_id: str, data: bytes) -> None:
+        with self._lock:
+            self.remove(block_id)
+            if len(data) > self.memory_budget:
+                self._put_disk(block_id, data)
+                self._count("cache.direct_to_disk")
+                return
+            self._evict_host_until(len(data))
+            self._host[block_id] = _HostBlock(data)
+            self._host_bytes += len(data)
+
+    def get(self, block_id: str) -> bytes | None:
+        with self._lock:
+            blk = self._host.get(block_id)
+            if blk is not None:
+                self._host.move_to_end(block_id)
+                self._count("cache.host_hits")
+                return blk.data
+            ent = self._disk.get(block_id)
+            if ent is not None:
+                path, nbytes = ent
+                try:
+                    with open(path, "rb") as f:
+                        data = f.read()
+                except OSError:
+                    self._disk.pop(block_id, None)
+                    self._disk_bytes -= nbytes
+                    self._count("cache.misses")
+                    return None
+                self._count("cache.disk_hits")
+                # promote to the host tier (access heat)
+                self._disk.pop(block_id, None)
+                self._disk_bytes -= nbytes
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                if len(data) <= self.memory_budget:
+                    self._evict_host_until(len(data))
+                    self._host[block_id] = _HostBlock(data)
+                    self._host_bytes += len(data)
+                else:
+                    self._put_disk(block_id, data)
+                return data
+            self._count("cache.misses")
+            return None
+
+    def remove(self, block_id: str) -> None:
+        with self._lock:
+            blk = self._host.pop(block_id, None)
+            if blk is not None:
+                self._host_bytes -= blk.nbytes
+            ent = self._disk.pop(block_id, None)
+            if ent is not None:
+                self._disk_bytes -= ent[1]
+                try:
+                    os.unlink(ent[0])
+                except OSError:
+                    pass
+            dev = self._device.pop(block_id, None)
+            if dev is not None:
+                owner, key, nbytes = dev
+                owner.pop(key, None)
+                self._device_bytes -= nbytes
+
+    # -- device tier -----------------------------------------------------
+    def pin_device(self, block_id: str, owner: dict, key,
+                   nbytes: int) -> None:
+        """Register a scan-pinned device entry; LRU-unpin older entries
+        over budget (their device buffers free when the owner dict
+        drops the reference — XLA's allocator reclaims on GC)."""
+        with self._lock:
+            old = self._device.pop(block_id, None)
+            if old is not None:
+                o_owner, o_key, o_bytes = old
+                self._device_bytes -= o_bytes
+                if o_key != key:        # re-pin under a new cache key:
+                    o_owner.pop(o_key, None)  # release the old batches
+            self._device[block_id] = (owner, key, nbytes)
+            self._device_bytes += nbytes
+            while len(self._device) > 1 and \
+                    self._device_bytes > self.device_budget:
+                _, (o, k, nb) = self._device.popitem(last=False)
+                o.pop(k, None)
+                self._device_bytes -= nb
+                self._count("cache.device_unpinned")
+
+    def touch_device(self, block_id: str) -> None:
+        with self._lock:
+            if block_id in self._device:
+                self._device.move_to_end(block_id)
+
+    # -- lifecycle -------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            return {"host_blocks": len(self._host),
+                    "host_bytes": self._host_bytes,
+                    "disk_blocks": len(self._disk),
+                    "disk_bytes": self._disk_bytes,
+                    "device_entries": len(self._device),
+                    "device_bytes": self._device_bytes}
+
+    def clear(self) -> None:
+        with self._lock:
+            for bid in list(self._host) + list(self._disk) + \
+                    list(self._device):
+                self.remove(bid)
+            if self._spill_created and self._spill_dir:
+                import shutil
+
+                shutil.rmtree(self._spill_dir, ignore_errors=True)
+                self._spill_created = False

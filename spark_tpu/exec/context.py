@@ -54,6 +54,9 @@ class ExecContext:
     conf: SQLConf = field(default_factory=SQLConf)
     metrics: Metrics = field(default_factory=Metrics)
     _memory: object = field(default=None, repr=False)
+    # session BlockManager when the query runs under one (device-pin
+    # budget for scan caches; None in bare contexts/workers)
+    block_manager: object = field(default=None, repr=False)
 
     @property
     def memory(self):

@@ -128,7 +128,9 @@ class QueryExecution:
 
         plan = self.physical
         ctx = ExecContext(conf=self.session.conf,
-                          metrics=self.session._metrics)
+                          metrics=self.session._metrics,
+                          block_manager=getattr(
+                              self.session, "block_manager", None))
         bus = getattr(self.session, "listener_bus", None)
         cluster = getattr(self.session, "_sql_cluster", None)
         if cluster is not None:

@@ -129,7 +129,13 @@ class ScanExec(PhysicalPlan):
                 out.append([ColumnarBatch.empty(schema)])
                 continue
             key = (i, tuple(cols), cap)
+            bm = getattr(ctx, "block_manager", None)
+            # block id covers the FULL cache key: the same partition
+            # projected differently is a distinct pinned entry
+            bid = f"scan-{id(self.source)}-{i}-{hash(key) & 0xffffffff:x}"
             if cache is not None and key in cache:
+                if bm is not None:
+                    bm.touch_device(bid)
                 out.append(cache[key])
                 continue
             table = self.source.read_partition(i, cols)
@@ -137,6 +143,10 @@ class ScanExec(PhysicalPlan):
             ctx.metrics.add(f"scan.{self.name}.rows", table.num_rows)
             if cache is not None:
                 cache[key] = batches
+                if bm is not None:
+                    # device-tier governance: LRU-unpin over budget
+                    nbytes = sum(b.device_nbytes() for b in batches)
+                    bm.pin_device(bid, cache, key, nbytes)
             out.append(batches)
         return out
 

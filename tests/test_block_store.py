@@ -1,0 +1,159 @@
+"""Unified tiered block store (reference:
+core/storage/BlockManager.scala, memory/MemoryStore.scala
+evictBlocksToFreeSpace, DiskStore.scala): host-RAM LRU under a budget,
+eviction to disk, drop + recompute-from-lineage beyond disk."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_tpu.config import SQLConf
+from spark_tpu.exec.block_store import BlockManager
+from spark_tpu.exec.context import Metrics
+
+
+def _bm(mem=1000, disk=3000, tmp=None):
+    conf = SQLConf({"spark.tpu.cache.memoryBudgetBytes": mem,
+                    "spark.tpu.cache.diskBudgetBytes": disk})
+    return BlockManager(conf, spill_dir=tmp, metrics=Metrics())
+
+
+def test_put_get_host_tier(tmp_path):
+    bm = _bm(tmp=str(tmp_path))
+    bm.put("a", b"x" * 100)
+    assert bm.get("a") == b"x" * 100
+    assert bm.stats()["host_blocks"] == 1
+    assert bm.metrics.counters["cache.host_hits"] == 1
+
+
+def test_lru_eviction_to_disk(tmp_path):
+    bm = _bm(mem=250, tmp=str(tmp_path))
+    bm.put("a", b"a" * 100)
+    bm.put("b", b"b" * 100)
+    bm.put("c", b"c" * 100)    # evicts a (LRU) to disk
+    st = bm.stats()
+    assert st["host_blocks"] == 2 and st["disk_blocks"] == 1
+    assert bm.metrics.counters["cache.evictions_to_disk"] == 1
+    # a still readable — from disk, promoted back to host (evicting b)
+    assert bm.get("a") == b"a" * 100
+    assert bm.metrics.counters["cache.disk_hits"] == 1
+    assert bm.stats()["disk_blocks"] == 1   # b took a's place on disk
+
+
+def test_access_refreshes_lru_order(tmp_path):
+    bm = _bm(mem=250, tmp=str(tmp_path))
+    bm.put("a", b"a" * 100)
+    bm.put("b", b"b" * 100)
+    assert bm.get("a")          # a is now most-recent
+    bm.put("c", b"c" * 100)     # must evict b, not a
+    assert bm.stats()["host_blocks"] == 2
+    assert bm.get("a") == b"a" * 100
+    assert bm.metrics.counters["cache.evictions_to_disk"] == 1
+    assert bm.metrics.counters["cache.host_hits"] >= 2
+
+
+def test_drop_beyond_disk_budget(tmp_path):
+    bm = _bm(mem=150, disk=250, tmp=str(tmp_path))
+    for name in "abcde":
+        bm.put(name, name.encode() * 100)
+    # 5 × 100B through a 150B host + 250B disk → drops happened
+    assert bm.metrics.counters["cache.blocks_dropped"] >= 1
+    st = bm.stats()
+    assert st["host_bytes"] <= 150 and st["disk_bytes"] <= 250
+    # dropped blocks read as miss (recompute-from-lineage signal)
+    assert bm.get("a") is None
+    assert bm.metrics.counters["cache.misses"] >= 1
+
+
+def test_oversized_block_goes_straight_to_disk(tmp_path):
+    bm = _bm(mem=100, disk=10_000, tmp=str(tmp_path))
+    bm.put("big", b"z" * 5000)
+    assert bm.stats()["host_blocks"] == 0
+    assert bm.get("big") == b"z" * 5000   # still served (from disk)
+
+
+def test_remove_and_clear(tmp_path):
+    bm = _bm(tmp=str(tmp_path))
+    bm.put("a", b"1" * 10)
+    bm.put("b", b"2" * 10)
+    bm.remove("a")
+    assert bm.get("a") is None
+    bm.clear()
+    assert bm.stats()["host_blocks"] == 0
+
+
+def test_device_tier_unpins_lru_over_budget(tmp_path):
+    bm = _bm(tmp=str(tmp_path))
+    bm.device_budget = 250
+    owner = {1: "batch1", 2: "batch2", 3: "batch3"}
+    bm.pin_device("d1", owner, 1, 100)
+    bm.pin_device("d2", owner, 2, 100)
+    bm.pin_device("d3", owner, 3, 100)   # over budget → d1 unpinned
+    assert 1 not in owner                 # device buffers released
+    assert 2 in owner and 3 in owner
+    assert bm.metrics.counters["cache.device_unpinned"] == 1
+    assert bm.stats()["device_bytes"] == 200
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: df.cache() through the tiered store
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def spark():
+    from spark_tpu.api.session import TpuSession
+
+    s = TpuSession("blockstore", {
+        "spark.sql.shuffle.partitions": 2,
+        "spark.tpu.cache.memoryBudgetBytes": 70_000,
+        "spark.tpu.cache.diskBudgetBytes": 130_000,
+    })
+    yield s
+    s.stop()
+
+
+def _table(seed, n=2000):
+    rng = np.random.default_rng(seed)
+    return pa.table({"k": rng.integers(0, 50, n),
+                     "v": rng.integers(0, 1000, n)})
+
+
+def test_cache_twice_the_budget_completes_with_evictions(spark):
+    """The VERDICT bar: caching ~2× the configured budget must complete
+    (evicting/dropping, recomputing from lineage on miss) instead of
+    pinning unbounded memory — and every cached frame stays correct."""
+    dfs, expected = [], []
+    for i in range(8):          # 8 × ~30KB through 20KB RAM + 40KB disk
+        df = spark.createDataFrame(_table(i)).filter("v >= 0")
+        df.cache()
+        expected.append(sorted((r["k"], r["v"]) for r in df.collect()))
+        dfs.append(df)
+    m = spark._metrics.snapshot()["counters"]
+    assert m.get("cache.evictions_to_disk", 0) >= 1, m
+    assert m.get("cache.blocks_dropped", 0) >= 1, m
+    # every frame still answers correctly through a NEW query over the
+    # cached subtree (dropped blocks recompute from lineage)
+    for df, want in zip(dfs, expected):
+        got = sorted((r["k"], r["v"])
+                     for r in df.filter("v >= -1").collect())
+        assert got == want
+    m = spark._metrics.snapshot()["counters"]
+    assert m.get("cache.recomputed_from_lineage", 0) >= 1, m
+
+
+def test_cached_plan_substitution_hits_store(spark):
+    df = spark.createDataFrame(_table(42)).groupBy("k").count()
+    df.cache()
+    base = spark._metrics.snapshot()["counters"].get("cache.host_hits", 0)
+    got = {r["k"]: r["count"]
+           for r in df.filter("count >= 0").collect()}
+    t = _table(42)
+    want: dict = {}
+    for k in t["k"].to_pylist():
+        want[k] = want.get(k, 0) + 1
+    assert got == want
+    after = spark._metrics.snapshot()["counters"].get("cache.host_hits", 0)
+    assert after > base
+
+    df.unpersist()
+    assert spark.block_manager.stats()["host_blocks"] == 0
