@@ -174,10 +174,13 @@ class Literal(Expression):
         if self.value is None:
             if not ctx.is_trace:
                 return Val(dt, None, True,
-                           StringDict([""]) if isinstance(dt, StringType) else None)
+                           StringDict([_dict_empty(dt)])
+                           if dict_encoded(dt) else None)
             z = jnp.zeros((), dtype=dt.device_dtype)
             return Val(dt, z, jnp.zeros((), dtype=bool), None)
-        if isinstance(dt, StringType):
+        if dict_encoded(dt):
+            # string/array/map/struct literal: a one-entry dictionary,
+            # all rows code 0
             if not ctx.is_trace:
                 return Val(dt, None, None, StringDict([self.value]))
             return Val(dt, jnp.zeros((), dtype=jnp.int32), None, None)
@@ -1704,14 +1707,20 @@ class In(Expression):
         return boolean
 
     def eval(self, ctx):
+        # SQL three-valued IN: TRUE on a match; else NULL when the list
+        # holds a NULL (or the probe is NULL); else FALSE (reference:
+        # predicates.scala In.eval null handling)
         c = ctx.eval(self.child)
         jnp = _jnp()
         if isinstance(c.dtype, StringType):
             targets = []
+            has_null_item = False
             for it in self.items:
                 if not isinstance(it, Literal):
                     raise UnsupportedOperationError("IN over strings needs literals")
-                if it.value is not None:
+                if it.value is None:
+                    has_null_item = True
+                else:
                     targets.append(it.value)
 
             def make_lut():
@@ -1721,18 +1730,40 @@ class In(Expression):
 
             if not ctx.is_trace:
                 ctx.aux(make_lut)
-                return Val(boolean, None, c.validity, None)
+                valid = c.validity
+                if has_null_item:
+                    valid = True  # validity becomes data-dependent
+                return Val(boolean, None, valid, None)
             lut = ctx.aux(None)
             data = jnp.take(lut, jnp.clip(c.data, 0, lut.shape[0] - 1))
-            return Val(boolean, data, c.validity, None)
+            valid = c.validity
+            if has_null_item:
+                # unmatched rows are UNKNOWN, not false
+                valid = data if valid is None else (valid & data)
+            return Val(boolean, data, valid, None)
         vals = [ctx.eval(cast_if(i, c.dtype)) for i in self.items]
-        v = c.validity
         if not ctx.is_trace:
-            return Val(boolean, None, v, None)
-        data = jnp.zeros((), bool)
+            may_null_item = any(
+                x.validity is not None or
+                (isinstance(i, Literal) and i.value is None)
+                for x, i in zip(vals, self.items))
+            valid = c.validity
+            if may_null_item:
+                valid = True
+            return Val(boolean, None, valid, None)
+        matched = jnp.zeros((), bool)
+        null_any = jnp.zeros((), bool)
         for x in vals:
-            data = data | (c.data == x.data)
-        return Val(boolean, data, v, None)
+            if x.validity is None:
+                xv = jnp.ones((), bool)
+            else:
+                xv = x.validity
+            matched = matched | ((c.data == x.data) & xv)
+            null_any = null_any | ~xv
+        valid = matched | ~null_any     # unmatched + null item → NULL
+        if c.validity is not None:
+            valid = valid & c.validity
+        return Val(boolean, matched, valid, None)
 
 
 def _like_to_regex(pattern: str, escape: str = "\\") -> str:
@@ -2554,11 +2585,11 @@ class ArrayDistinct(_ArrayDictTransform):
         return list(dict.fromkeys(lst))
 
 
-class Flatten(_ArrayDictTransform):
-    """flatten(array<array<T>>) → array<T> (one level). Deviation from
-    the reference (like ElementAtString's): a NULL sub-array is skipped
-    rather than nulling the whole result — the dictionary channel cannot
-    express a per-value NULL."""
+class Flatten(_ArrayLut):
+    """flatten(array<array<T>>) → array<T> (one level). A NULL
+    sub-array nulls the whole result, per the reference
+    (collectionOperations.scala Flatten) — the per-dictionary-entry
+    validity fold carries the NULL."""
 
     @property
     def dtype(self):
@@ -2566,12 +2597,13 @@ class Flatten(_ArrayDictTransform):
         return ct.element_type if isinstance(ct, ArrayType) and \
             isinstance(ct.element_type, ArrayType) else ct
 
-    def transform(self, lst):
+    def value_of(self, lst):
         out = []
         for sub in lst:
-            if sub is not None:
-                out.extend(sub)
-        return out
+            if sub is None:
+                return [], False
+            out.extend(sub)
+        return out, True
 
 
 class Slice(_ArrayDictTransform):
@@ -2648,24 +2680,32 @@ class ArrayPosition(_ArrayLut):
         return 0, True
 
 
-class GetJsonObject(_DictTransform):
+class GetJsonObject(_ArrayLut):
     """get_json_object(json_str, '$.path') — JsonPath subset: dotted
     fields and [n] indexing (reference: jsonExpressions.scala
-    GetJsonObject). Returns NULL-like '' for misses; non-scalar results
-    re-serialize as JSON, matching the reference."""
+    GetJsonObject). Misses and JSON nulls are real NULLs (per-entry
+    validity fold); non-scalar results re-serialize as JSON, matching
+    the reference."""
 
     def __init__(self, child: Expression, path: Expression):
         super().__init__(child)
         self.path = str(path.value)
 
-    def transform(self, s):
+    @property
+    def dtype(self):
+        return string
+
+    def _data_args(self):
+        return (("path", self.path),)
+
+    def value_of(self, s):
         import json as _json
         import re as _re
 
         try:
             cur = _json.loads(s)
         except (ValueError, TypeError):
-            return ""
+            return "", False
         p = self.path
         if p.startswith("$"):
             p = p[1:]
@@ -2674,24 +2714,24 @@ class GetJsonObject(_DictTransform):
         tokens = list(_re.finditer(r"\.([A-Za-z_][\w]*)|\[(\d+)\]", p))
         consumed = "".join(m.group(0) for m in tokens)
         if consumed != p:
-            return ""
+            return "", False
         for name, idx in ((m.group(1), m.group(2)) for m in tokens):
             if name:
                 if not isinstance(cur, dict) or name not in cur:
-                    return ""
+                    return "", False
                 cur = cur[name]
             else:
                 i = int(idx)
                 if not isinstance(cur, list) or i >= len(cur):
-                    return ""
+                    return "", False
                 cur = cur[i]
         if cur is None:
-            return ""
+            return "", False
         if isinstance(cur, (dict, list)):
-            return _json.dumps(cur)
+            return _json.dumps(cur), True
         if isinstance(cur, bool):
-            return "true" if cur else "false"
-        return str(cur)
+            return ("true" if cur else "false"), True
+        return str(cur), True
 
 
 class Crc32(_ArrayLut):
@@ -2707,18 +2747,27 @@ class Crc32(_ArrayLut):
         return zlib.crc32(str(s).encode()), True
 
 
-class ElementAtString(_DictTransform):
-    """element_at over array<string>: the element IS the new dictionary
-    value ('' for out-of-bounds — the reference returns NULL there)."""
+class ElementAtString(_ArrayLut):
+    """element_at over array<string>: per-entry extraction with a real
+    NULL for out-of-bounds / null elements (complexTypeExtractors.scala
+    ElementAt null semantics, carried by the validity fold)."""
 
     def __init__(self, child: Expression, idx: Expression):
         super().__init__(child)
         self.idx = int(idx.value)
 
-    def transform(self, lst):
+    @property
+    def dtype(self):
+        return string
+
+    def _data_args(self):
+        return (("idx", self.idx),)
+
+    def value_of(self, lst):
         i = self.idx - 1 if self.idx > 0 else len(lst) + self.idx
-        v = lst[i] if 0 <= i < len(lst) else ""
-        return "" if v is None else v
+        if 0 <= i < len(lst) and lst[i] is not None:
+            return lst[i], True
+        return "", False
 
 
 def build_element_at(child: Expression, idx: Expression) -> Expression:
@@ -2779,6 +2828,35 @@ def build_named_struct(args) -> Expression:
     return build_struct_ctor(args[1::2], names=names)
 
 
+def build_array_ctor(args) -> Expression:
+    """array(e1, e2, ...) (reference: complexTypeCreator.scala
+    CreateArray) — host-evaluated dictionary-encoded array column."""
+    from .pyudf import PythonUDF
+
+    et: DataType = null_type
+    for a in args:
+        et = common_type(et, a.dtype) or a.dtype
+    if not args:
+        # array() — a single dummy input keeps the eval pipeline shaped
+        return PythonUDF(lambda _x: [], [Literal(0)], ArrayType(et),
+                         name="array", vectorized=False)
+
+    def make_array(*cols):
+        return list(cols)
+
+    return PythonUDF(make_array, list(args), ArrayType(et), name="array",
+                     vectorized=False)
+
+
+class ArraySortNullsLast(_ArrayDictTransform):
+    """array_sort(arr) — ascending with NULLs LAST, unlike sort_array's
+    nulls-first (collectionOperations.scala ArraySort default)."""
+
+    def transform(self, lst):
+        return sorted([v for v in lst if v is not None]) + \
+            [None] * sum(1 for v in lst if v is None)
+
+
 def build_map_ctor(args) -> Expression:
     """map(k1, v1, k2, v2, ...) (reference: complexTypeCreator.scala
     CreateMap) — host-vectorized dictionary-encoded map column."""
@@ -2833,6 +2911,160 @@ class _StringIntLut(Expression):
         return Val(int32, jnp.take(lut, jnp.clip(c.data, 0, lut.shape[0] - 1)),
                    c.validity, None)
 
+
+
+class RegexpExtractAll(_ArrayLut):
+    """regexp_extract_all(str, regexp[, idx]) → array<string>
+    (reference: regexpExpressions.scala RegExpExtractAll)."""
+
+    def __init__(self, child, pattern: Expression, group: Expression | None = None):
+        super().__init__(child)
+        self.pattern = str(pattern.value)
+        self._rx = re.compile(self.pattern)
+        if group is None:
+            # like the reference: default group 1, but a group-less
+            # pattern extracts the full match
+            self.group = 1 if self._rx.groups >= 1 else 0
+        else:
+            self.group = int(group.value)
+            if self.group > self._rx.groups:
+                raise AnalysisException(
+                    f"regexp_extract_all: regex group count is "
+                    f"{self._rx.groups}, but the specified group index "
+                    f"is {self.group}")
+
+    @property
+    def dtype(self):
+        return ArrayType(string)
+
+    def _data_args(self):
+        return (("pattern", self.pattern), ("group", self.group))
+
+    def value_of(self, s):
+        return [m.group(self.group) or ""
+                for m in self._rx.finditer(s)], True
+
+
+class RegexpSubstr(_ArrayLut):
+    """regexp_substr(str, regexp) → first match or NULL
+    (RegExpSubStr)."""
+
+    def __init__(self, child, pattern: Expression):
+        super().__init__(child)
+        self.pattern = str(pattern.value)
+        self._rx = re.compile(self.pattern)
+
+    @property
+    def dtype(self):
+        return string
+
+    def _data_args(self):
+        return (("pattern", self.pattern),)
+
+    def value_of(self, s):
+        m = self._rx.search(s)
+        return (m.group(0), True) if m is not None else ("", False)
+
+
+class RegexpInstr(_StringIntLut):
+    """regexp_instr(str, regexp) → 1-based position of the first match,
+    0 when none (RegExpInStr)."""
+
+    def __init__(self, child, pattern: Expression):
+        super().__init__(child)
+        self.pattern = str(pattern.value)
+        self._rx = re.compile(self.pattern)
+
+    def _data_args(self):
+        return (("pattern", self.pattern),)
+
+    def int_of(self, s):
+        m = self._rx.search(s)
+        return (m.start() + 1) if m is not None else 0
+
+
+class RegexpCount(_StringIntLut):
+    """regexp_count(str, regexp) (RegExpCount)."""
+
+    def __init__(self, child, pattern: Expression):
+        super().__init__(child)
+        self.pattern = str(pattern.value)
+        self._rx = re.compile(self.pattern)
+
+    def _data_args(self):
+        return (("pattern", self.pattern),)
+
+    def int_of(self, s):
+        return sum(1 for _ in self._rx.finditer(s))
+
+
+class ToNumber(_ArrayLut):
+    """to_number / try_to_number(str, format) → decimal per the format
+    ('9'/'0' digits, D or . decimal point, G or , grouping, S sign,
+    $ currency — numberFormatExpressions.scala ToNumber). Strict mode
+    raises on a non-conforming string; try mode yields NULL."""
+
+    def __init__(self, child, fmt: Expression, strict: bool = False):
+        super().__init__(child)
+        self.fmt = str(fmt.value)
+        self.strict = strict
+        f = self.fmt.upper().replace("D", ".").replace("G", ",")
+        self.scale = len(f.split(".", 1)[1].replace(",", "")) \
+            if "." in f else 0
+        digits = sum(1 for c in f if c in "90")
+        self.precision = max(digits, 1)
+
+    @property
+    def dtype(self):
+        return DecimalType(self.precision, self.scale)
+
+    def _data_args(self):
+        return (("fmt", self.fmt), ("strict", self.strict))
+
+    def _miss(self, s):
+        if self.strict:
+            from ..errors import ExecutionError
+
+            raise ExecutionError(
+                f"to_number: {s!r} does not match format {self.fmt!r}")
+        return 0, False
+
+    def value_of(self, s):
+        import decimal as _d
+        import re as _re
+
+        # validate against the format: the format's shape (digits,
+        # grouping, decimal point, sign, currency) compiled to a regex —
+        # a non-conforming string errors in strict mode (ToNumber) and
+        # NULLs in try mode (TryToNumber)
+        pat = []
+        for ch in self.fmt.upper():
+            if ch in "90":
+                pat.append(r"\d")
+            elif ch in "G,":
+                pat.append(",?")
+            elif ch in "D.":
+                pat.append(r"\.?")
+            elif ch == "S":
+                pat.append("[+-]?")
+            elif ch == "$":
+                pat.append(r"\$?")
+            else:
+                return self._miss(s)
+        rx = "[+-]?" + "".join(pat) if "S" not in self.fmt.upper() \
+            else "".join(pat)
+        t = s.strip()
+        if not _re.fullmatch(rx.replace(r"\d", r"\d?"), t):
+            return self._miss(s)
+        neg = t.startswith("-") or t.endswith("-")
+        t = t.strip("+-").replace(",", "").replace("$", "")
+        try:
+            v = _d.Decimal(t)
+        except _d.InvalidOperation:
+            return self._miss(s)
+        if neg:
+            v = -v
+        return int(v.scaleb(self.scale).to_integral_value()), True
 
 
 class Levenshtein(_StringIntLut):
@@ -2963,6 +3195,27 @@ class IntervalLiteral(Expression):
 
     def simple_string(self):
         return f"interval({self.months}mo {self.days}d {self.micros}us)"
+
+
+def build_make_interval(y, mo, w, d, h, mi, s) -> IntervalLiteral:
+    """make_interval(years, months, weeks, days, hours, mins, secs) —
+    literal arguments only, like interval literals themselves
+    (intervalExpressions.scala MakeInterval)."""
+    def val(e, default=0):
+        if e is None:
+            return default
+        if isinstance(e, Literal) and e.value is not None:
+            return e.value
+        from ..errors import AnalysisException
+
+        raise AnalysisException("make_interval expects literal arguments")
+
+    months = int(val(y)) * 12 + int(val(mo))
+    days = int(val(w)) * 7 + int(val(d))
+    secs = val(s)
+    micros = int(val(h)) * 3_600_000_000 + int(val(mi)) * 60_000_000 + \
+        int(round(float(secs) * 1_000_000))
+    return IntervalLiteral(months, days, micros)
 
 
 def _apply_interval(ctx, side: "Val", iv: IntervalLiteral) -> "Val":

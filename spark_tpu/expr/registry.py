@@ -18,6 +18,54 @@ def _lit_str(e: E.Expression) -> str:
     raise AnalysisException("expected a string literal argument")
 
 
+def _conv_base(s: str, from_base: int, to_base: int) -> str | None:
+    """conv('ff', 16, 10) → '255' (mathExpressions.scala Conv)."""
+    try:
+        v = int(s.strip(), from_base)
+    except ValueError:
+        return None
+    if to_base == 10:
+        return str(v)
+    digits = "0123456789ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+    neg = v < 0
+    v = abs(v)
+    out = ""
+    while True:
+        out = digits[v % to_base] + out
+        v //= to_base
+        if v == 0:
+            break
+    return ("-" + out) if neg else out
+
+
+def _stable_hash(xs, bits: int) -> int:
+    """Deterministic multi-arg hash (role of the reference's Murmur3
+    `hash` / xxhash64 — same shape and stability, different constants,
+    so exact hash VALUES differ from the reference by design)."""
+    import hashlib
+
+    h = hashlib.sha256(repr(tuple(xs)).encode()).digest()
+    v = int.from_bytes(h[: bits // 8], "little", signed=True)
+    return v
+
+
+def _width_bucket(v, lo, hi, n):
+    n = int(n)
+    if n <= 0 or lo == hi:
+        return None
+    if lo < hi:
+        if v < lo:
+            return 0
+        if v >= hi:
+            return n + 1
+        return int((v - lo) / (hi - lo) * n) + 1
+    if v > lo:
+        return 0
+    if v <= hi:
+        return n + 1
+    return int((lo - v) / (lo - hi) * n) + 1
+
+
 _REGISTRY: dict[str, Builder] = {}
 
 
@@ -238,11 +286,255 @@ def _reg_all() -> None:
     r("map_values", lambda c: E.MapValues(c))
     r("map_contains_key", lambda c, k: E.MapContainsKey(c, k))
     r("translate", lambda c, m, rep: E.Translate(c, m, rep))
+    # regexp family (regexpExpressions.scala)
+    r("regexp_extract_all", lambda c, p, g=None: E.RegexpExtractAll(c, p, g))
+    r("regexp_substr", lambda c, p: E.RegexpSubstr(c, p))
+    r("regexp_instr", lambda c, p: E.RegexpInstr(c, p))
+    r("regexp_count", lambda c, p: E.RegexpCount(c, p))
+    r("regexp_like", lambda c, p: E.RLike(c, _lit_str(p)))
+    r("regexp", lambda c, p: E.RLike(c, _lit_str(p)))
+    r("rlike", lambda c, p: E.RLike(c, _lit_str(p)))
+    # number parsing (numberFormatExpressions.scala)
+    r("to_number", lambda c, f: E.ToNumber(c, f, strict=True))
+    r("try_to_number", lambda c, f: E.ToNumber(c, f, strict=False))
+    # interval constructors (intervalExpressions.scala MakeInterval)
+    r("make_interval", lambda y=None, mo=None, w=None, d=None, h=None,
+        mi=None, s=None: E.build_make_interval(y, mo, w, d, h, mi, s))
+    r("make_dt_interval", lambda d=None, h=None, mi=None, s=None:
+        E.build_make_interval(None, None, None, d, h, mi, s))
+    r("make_ym_interval", lambda y=None, mo=None:
+        E.build_make_interval(y, mo, None, None, None, None, None))
     r("ascii", lambda c: E.Ascii(c))
     r("instr", lambda c, s: E.Instr(c, s))
     r("locate", lambda s, c, pos=None: E.Instr(c, s))
     r("position", lambda s, c: E.Instr(c, s))
     r("concat_ws", lambda sep, *a: E.ConcatWs(sep, list(a)))
+    r("nvl2", lambda a, b, c: E.If(E.IsNotNull(a), b, c))
+
+    # ---- breadth batch: host-evaluated scalar/array functions ----------
+    # (complexTypeCreator.scala, collectionOperations.scala,
+    # mathExpressions.scala, stringExpressions.scala). These ride the
+    # in-process Python-eval path like map()/struct(); device pipelines
+    # feed their arguments, results re-enter the columnar batch.
+    from ..types import (
+        ArrayType as _AT, MapType as _MT, StructField as _SF,
+        StructType as _ST, boolean as _bool, float64 as _f64,
+        int32 as _i32, int64 as _i64, string as _str,
+    )
+    from .pyudf import PythonUDF as _U
+
+    def _et(e, default=_i64):
+        dt = e.dtype
+        return dt.element_type if isinstance(dt, _AT) else default
+
+    def _strict(fn):
+        def g(*a):
+            if any(x is None for x in a):
+                return None
+            return fn(*a)
+        return g
+
+    def _seq(x, y, s=None):
+        from ..errors import ExecutionError
+
+        if s is None:
+            s = 1 if y >= x else -1
+        s = int(s)
+        if s == 0 or (s > 0) != (y >= x) and x != y:
+            raise ExecutionError(
+                f"sequence: illegal step {s} for bounds {x}..{y}")
+        return list(range(int(x), int(y) + (1 if s > 0 else -1), s))
+
+    r("sequence", lambda a, b, step=None: _U(
+        _strict(_seq),
+        [a, b] + ([step] if step is not None else []), _AT(_i64),
+        name="sequence", vectorized=False))
+    r("array_repeat", lambda v, n: _U(
+        lambda x, k: [] if k is None else [x] * int(k),
+        [v, n], _AT(v.dtype), name="array_repeat", vectorized=False))
+    r("array_union", lambda a, b: _U(
+        _strict(lambda x, y: list(dict.fromkeys(list(x) + list(y)))),
+        [a, b], a.dtype, name="array_union", vectorized=False))
+    r("array_intersect", lambda a, b: _U(
+        _strict(lambda x, y: [v for v in dict.fromkeys(x) if v in set(
+            v2 for v2 in y if v2 is not None) or (
+            v is None and any(v2 is None for v2 in y))]),
+        [a, b], a.dtype, name="array_intersect", vectorized=False))
+    r("array_except", lambda a, b: _U(
+        _strict(lambda x, y: [v for v in dict.fromkeys(x)
+                              if v not in set(
+                                  v2 for v2 in y if v2 is not None)
+                              and not (v is None and
+                                       any(v2 is None for v2 in y))]),
+        [a, b], a.dtype, name="array_except", vectorized=False))
+    r("arrays_overlap", lambda a, b: _U(
+        _strict(lambda x, y: bool(
+            set(v for v in x if v is not None)
+            & set(v for v in y if v is not None)) or (
+            None if (None in list(x) or None in list(y)) and x and y
+            else False)),
+        [a, b], _bool, name="arrays_overlap", vectorized=False))
+    r("array_append", lambda a, v: _U(
+        lambda x, e: None if x is None else list(x) + [e],
+        [a, v], a.dtype, name="array_append", vectorized=False))
+    r("array_prepend", lambda a, v: _U(
+        lambda x, e: None if x is None else [e] + list(x),
+        [a, v], a.dtype, name="array_prepend", vectorized=False))
+    r("array_insert", lambda a, p, v: _U(
+        _strict(lambda x, i, e: (
+            list(x[:int(i) - 1]) + [e] + list(x[int(i) - 1:]) if i > 0
+            else list(x[:len(x) + int(i) + 1]) + [e]
+            + list(x[len(x) + int(i) + 1:]))),
+        [a, p, v], a.dtype, name="array_insert", vectorized=False))
+    r("array_compact", lambda a: _U(
+        lambda x: None if x is None else [v for v in x if v is not None],
+        [a], a.dtype, name="array_compact", vectorized=False))
+    r("arrays_zip", lambda *args: _U(
+        _strict(lambda *xs: [
+            {str(i): (x[j] if j < len(x) else None)
+             for i, x in enumerate(xs)}
+            for j in range(max(len(x) for x in xs))] if xs else []),
+        list(args),
+        _AT(_ST(tuple(_SF(str(i), _et(a), True)
+                      for i, a in enumerate(args)))),
+        name="arrays_zip", vectorized=False))
+    r("map_from_arrays", lambda k, v: _U(
+        _strict(lambda ks, vs: dict(zip(ks, vs))),
+        [k, v], _MT(_et(k, _str), _et(v)), name="map_from_arrays",
+        vectorized=False))
+    r("map_from_entries", lambda a: _U(
+        _strict(lambda es: {e[list(e)[0]] if isinstance(e, dict) else e[0]:
+                            e[list(e)[1]] if isinstance(e, dict) else e[1]
+                            for e in es}),
+        [a], _MT(_str, _i64), name="map_from_entries", vectorized=False))
+    r("str_to_map", lambda s, pd=None, kvd=None: _U(
+        _strict(lambda x, p=",", kv=":": {
+            (part.split(kv, 1) + [None])[0]:
+            (part.split(kv, 1) + [None])[1]
+            for part in x.split(p)} if x else {}),
+        [s] + [x for x in (pd, kvd) if x is not None],
+        _MT(_str, _str), name="str_to_map", vectorized=False))
+    def _chr(i):
+        return "" if i < 0 else chr(int(i) % 256)
+
+    r("char", lambda c: _U(_strict(_chr), [c], _str, name="char",
+                           vectorized=False))
+    r("chr", lambda c: _U(_strict(_chr), [c], _str, name="chr",
+                          vectorized=False))
+    def _elt(n, *ss):
+        et = ss[0].dtype if ss else _str
+        for x in ss[1:]:
+            from ..types import common_type as _ct
+            et = _ct(et, x.dtype) or et
+        return _U(lambda i, *xs: None if i is None or not (
+            1 <= int(i) <= len(xs)) else xs[int(i) - 1],
+            [n, *ss], et, name="elt", vectorized=False)
+
+    r("elt", _elt)
+    r("find_in_set", lambda s, lst: _U(
+        _strict(lambda x, l: 0 if "," in x else
+                ((l.split(",").index(x) + 1)
+                 if x in l.split(",") else 0)),
+        [s, lst], _i32, name="find_in_set", vectorized=False))
+    r("format_string", lambda f, *a: _U(
+        _strict(lambda fmt, *xs: fmt % xs),
+        [f, *a], _str, name="format_string", vectorized=False))
+    r("printf", lambda f, *a: _U(
+        _strict(lambda fmt, *xs: fmt % xs),
+        [f, *a], _str, name="printf", vectorized=False))
+    r("bin", lambda c: _U(_strict(lambda i: bin(int(i))[2:] if i >= 0
+                                  else bin(int(i) & ((1 << 64) - 1))[2:]),
+                          [c], _str, name="bin", vectorized=False))
+    r("hex", lambda c: _U(
+        _strict(lambda v: format(int(v) & ((1 << 64) - 1), "X")
+                if not isinstance(v, str)
+                else v.encode().hex().upper()),
+        [c], _str, name="hex", vectorized=False))
+    r("unhex", lambda c: _U(
+        _strict(lambda s: bytes.fromhex(s).decode(errors="replace")),
+        [c], _str, name="unhex", vectorized=False))
+    r("conv", lambda c, fb, tb: _U(
+        _strict(lambda s, f, t: _conv_base(str(s), int(f), int(t))),
+        [c, fb, tb], _str, name="conv", vectorized=False))
+    r("bit_count", lambda c: _U(
+        _strict(lambda i: bin(int(i) & ((1 << 64) - 1)).count("1")),
+        [c], _i32, name="bit_count", vectorized=False))
+    r("factorial", lambda c: _U(
+        _strict(lambda i: None if i < 0 or i > 20 else
+                __import__("math").factorial(int(i))),
+        [c], _i64, name="factorial", vectorized=False))
+    r("width_bucket", lambda v, lo, hi, n: _U(
+        _strict(_width_bucket),
+        [v, lo, hi, n], _i64, name="width_bucket", vectorized=False))
+    r("hash", lambda *a: _U(
+        lambda *xs: _stable_hash(xs, bits=32),
+        list(a), _i32, name="hash", vectorized=False))
+    r("xxhash64", lambda *a: _U(
+        lambda *xs: _stable_hash(xs, bits=64),
+        list(a), _i64, name="xxhash64", vectorized=False))
+    r("hypot", lambda a, b: E.Sqrt(E.Add(E.Multiply(a, a),
+                                         E.Multiply(b, b))))
+    r("typeof", lambda a: E.Literal(a.dtype.simple_string()))
+    r("bool_and", lambda c: E.Cast(E.Min(E.Cast(c, _i32)), _bool))
+    r("every", lambda c: E.Cast(E.Min(E.Cast(c, _i32)), _bool))
+    r("bool_or", lambda c: E.Cast(E.Max(E.Cast(c, _i32)), _bool))
+    r("any", lambda c: E.Cast(E.Max(E.Cast(c, _i32)), _bool))
+    r("some", lambda c: E.Cast(E.Max(E.Cast(c, _i32)), _bool))
+    r("count_if", lambda c: E.Coalesce(
+        [E.Sum(E.If(c, E.Literal(1), E.Literal(0))), E.Literal(0)]))
+    r("unix_date", lambda d: E.DateDiff(
+        d, E.Literal(__import__("datetime").date(1970, 1, 1))))
+    def _mk_ts(a, b, c, x, e, f):
+        import calendar
+        import datetime as _dt
+
+        dt = _dt.datetime(int(a), int(b), int(c), int(x), int(e),
+                          int(float(f)))
+        micros = calendar.timegm(dt.timetuple()) * 1_000_000 \
+            + int(round((float(f) % 1) * 1e6))
+        return micros      # engine-native epoch microseconds
+
+    r("make_timestamp", lambda y, mo, d, h, mi, s: _U(
+        _strict(_mk_ts), [y, mo, d, h, mi, s],
+        __import__("spark_tpu.types", fromlist=["timestamp"]).timestamp,
+        name="make_timestamp", vectorized=False))
+
+    def _date_part(field, src):
+        f = _lit_str(field).lower().rstrip("s")
+        m = {"year": E.Year, "yr": E.Year, "month": E.Month,
+             "mon": E.Month, "day": E.DayOfMonth, "d": E.DayOfMonth,
+             "dayofweek": E.DayOfWeek, "dow": E.DayOfWeek,
+             "doy": E.DayOfYear, "quarter": E.Quarter, "qtr": E.Quarter,
+             "week": E.WeekOfYear, "hour": E.Hour, "hr": E.Hour,
+             "minute": E.Minute, "min": E.Minute, "second": E.Second,
+             "sec": E.Second}
+        if f not in m:
+            raise AnalysisException(f"date_part: unknown field {field}")
+        return m[f](src)
+
+    r("date_part", _date_part)
+    r("datepart", _date_part)
+    # higher-order functions (expr/higher_order.py; reference:
+    # sqlcat/expressions/higherOrderFunctions.scala)
+    from . import higher_order as H
+
+    r("array", lambda *a: E.build_array_ctor(list(a)))
+    r("transform", H.build_transform)
+    r("filter", H.build_filter)
+    r("exists", H.build_exists)
+    r("forall", H.build_forall)
+    r("any_match", H.build_exists)
+    r("all_match", H.build_forall)
+    r("aggregate", H.build_aggregate)
+    r("reduce", H.build_aggregate)
+    r("zip_with", H.build_zip_with)
+    r("transform_keys", H.build_transform_keys)
+    r("transform_values", H.build_transform_values)
+    r("map_filter", H.build_map_filter)
+    r("map_zip_with", H.build_map_zip_with)
+    r("array_sort", lambda c, f=None: (
+        H.lower_hof(H.ArraySortLambda([c], f)) if f is not None
+        else E.ArraySortNullsLast(c)))
     # datetime
     r("year", lambda c: E.Year(c))
     r("month", lambda c: E.Month(c))

@@ -40,6 +40,10 @@ class PythonEvalExec(PhysicalPlan):
     def _pipelines(self):
         if self._arg_pipelines is None:
             self._arg_pipelines = []
+            # each UDF's args may reference EARLIER UDF outputs (nested
+            # UDFs extract bottom-up — e.g. transform(array(...), f)):
+            # grow the visible input attrs as aliases accumulate
+            inputs = list(self.child.output)
             for al in self.udf_aliases:
                 udf = al.child
                 arg_aliases = [Alias(a, f"__a{i}")
@@ -48,7 +52,8 @@ class PythonEvalExec(PhysicalPlan):
                     StructField(x.name, x.child.dtype, True)
                     for x in arg_aliases])
                 self._arg_pipelines.append(ExprPipeline(
-                    self.child.output, [], arg_aliases, schema))
+                    list(inputs), [], arg_aliases, schema))
+                inputs.append(al.to_attribute())
         return self._arg_pipelines
 
     def execute(self, ctx: ExecContext):
@@ -62,14 +67,19 @@ class PythonEvalExec(PhysicalPlan):
         mask = np.asarray(batch.row_mask)
         sel = np.nonzero(mask)[0]
         new_cols = list(batch.columns)
+        cur_attrs = list(self.child.output)
+        cur = batch
         for al, pipe in zip(self.udf_aliases, self._pipelines()):
             udf = al.child
-            arg_batch = pipe.run(batch)
+            arg_batch = pipe.run(cur)
             args = [c.to_numpy(sel) for c in arg_batch.columns]
             with ctx.metrics.time("python_udf"):
                 result = self._call(udf, args, len(sel))
             col = self._to_column(udf.return_type, result, sel, cap)
             new_cols.append(col)
+            cur_attrs.append(al.to_attribute())
+            cur = ColumnarBatch(attrs_schema(cur_attrs), new_cols,
+                                batch.row_mask, batch._num_rows)
         schema = attrs_schema(self.output)
         return ColumnarBatch(schema, new_cols, batch.row_mask,
                              batch._num_rows)

@@ -142,6 +142,17 @@ def _remap_plan(plan: LogicalPlan, mapping: dict[int, AttributeReference],
     def remap_expr(e: Expression) -> Expression:
         if isinstance(e, AttributeReference) and e.expr_id in mapping_ids():
             return mapping[e.expr_id]
+        if isinstance(e, Alias) and e.expr_id in overlap:
+            # a view body minting its output via Aliases (e.g.
+            # `SELECT 1 g, 10 v UNION ALL ...`) must re-mint those ids
+            # too, or the subquery's copy stays aliased to the outer's.
+            # One new id per OLD id (same rationale as relations above).
+            na = mapping.get(e.expr_id)
+            if na is None:
+                new = Alias(e.child, e.name)    # fresh expr_id
+                mapping[e.expr_id] = new.to_attribute()
+                return new
+            return Alias(e.child, e.name, expr_id=na.expr_id)
         return e
 
     def mapping_ids():
@@ -620,6 +631,12 @@ class _ResolveRelationsDedup(Rule):
         def rule(node):
             if isinstance(node, UnresolvedRelation):
                 resolved = self.catalog.lookup(node.name_parts)
+                # a view body may carry unaliased union-branch literals
+                # (`... UNION ALL SELECT 1, 20`): alias them before
+                # touching .output (the main path gets this from the
+                # ResolveAliases fixed-point; this early access must
+                # self-serve)
+                resolved = ResolveAliases().apply(resolved)
                 overlap = {a.expr_id for a in resolved.output} & self.outer_ids
                 if overlap:
                     mapping: dict[int, AttributeReference] = {}
@@ -1098,6 +1115,58 @@ def _check_agg_expr(e: Expression, grouping_ids: set[int], agg: Aggregate):
     ok(e.child if isinstance(e, Alias) else e, False)
 
 
+class FoldIntervalArithmetic(Rule):
+    """Interval–interval and interval–numeric arithmetic folds to one
+    IntervalLiteral (reference: intervalExpressions.scala MultiplyInterval
+    / DivideInterval; interval addition in datetimeExpressions). Interval
+    values are literal-born here, so the algebra is closed at analysis
+    time and +/- against dates/timestamps sees a single interval."""
+
+    def apply(self, plan):
+        from ..expr.expressions import (
+            Add as _Add, Divide as _Div, IntervalLiteral as _IL,
+            Literal as _L, Multiply as _Mul, Subtract as _Sub,
+            UnaryMinus as _Neg,
+        )
+
+        def num(e):
+            return e.value if isinstance(e, _L) and \
+                isinstance(e.value, (int, float)) and \
+                not isinstance(e.value, bool) else None
+
+        def fold(e):
+            if isinstance(e, _Neg) and isinstance(e.child, _IL):
+                return e.child.negated()
+            if isinstance(e, (_Add, _Sub)) and \
+                    isinstance(e.left, _IL) and isinstance(e.right, _IL):
+                r = e.right if isinstance(e, _Add) else e.right.negated()
+                return _IL(e.left.months + r.months, e.left.days + r.days,
+                           e.left.micros + r.micros)
+            if isinstance(e, _Mul):
+                iv, n = (e.left, num(e.right)) \
+                    if isinstance(e.left, _IL) else (e.right, num(e.left))
+                if isinstance(iv, _IL) and n is not None:
+                    return _IL(int(iv.months * n), int(iv.days * n),
+                               int(iv.micros * n))
+            if isinstance(e, _Div) and isinstance(e.left, _IL):
+                n = num(e.right)
+                if n:
+                    # day fractions spill into micros (exact day-time
+                    # division); calendar months stay integral
+                    days_f = e.left.days / n
+                    days = int(days_f)
+                    micros = int(e.left.micros / n
+                                 + (days_f - days) * 86_400_000_000)
+                    return _IL(int(e.left.months / n), days, micros)
+            return e
+
+        def rule(node):
+            return node.transform_expressions(
+                lambda x: x.transform_up(fold))
+
+        return plan.transform_up(rule)
+
+
 class Analyzer(RuleExecutor):
     def __init__(self, catalog: Catalog, case_sensitive: bool = False):
         super().__init__()
@@ -1123,6 +1192,7 @@ class Analyzer(RuleExecutor):
                 ExtractGenerators(),
                 ExtractWindowFromAggregate(),
                 ExtractWindowExpressions(),
+                FoldIntervalArithmetic(),
                 ResolveAliases(),
             ]),
             Batch("Coercion", FixedPoint(10), [

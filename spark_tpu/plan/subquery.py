@@ -378,9 +378,10 @@ def _existence_flag(target, child: LogicalPlan, outer_ids: set[int]):
     """Lower one IN/EXISTS expression to a left_outer "existence join"
     producing a boolean flag over `child` (reference: sqlcat
     ExistenceJoin). Returns (joined_plan, replacement_expression).
-    Uncorrelated IN carries full three-valued null semantics (see
-    null_case below); the CORRELATED variants remain two-valued — a NULL
-    probe yields false rather than NULL (documented deviation)."""
+    Both uncorrelated AND equality-correlated IN carry full three-valued
+    null semantics: unmatched + (NULL probe over a non-empty set, or a
+    NULL among the set's values) → NULL, matching the reference's
+    null-aware join (sqlcat/optimizer/subquery.scala)."""
     sub, pairs, _res, ok = split_correlation(target.plan, outer_ids)
     if not ok:
         raise UnsupportedOperationError(
@@ -392,7 +393,10 @@ def _existence_flag(target, child: LogicalPlan, outer_ids: set[int]):
     flag = Alias(Literal(True), "__exists")
     cond = None
     null_case = None  # three-valued IN: unmatched + nulls present → NULL
+    corr_probe = None  # correlated IN: per-key has-null probe join
     if isinstance(target, InSubquery):
+        from ..expr.expressions import CaseWhen, Max
+
         value_attr = sub.output[0]
         if not pairs:
             # x IN (sub) with no match is NULL — not false — when x is
@@ -400,8 +404,6 @@ def _existence_flag(target, child: LogicalPlan, outer_ids: set[int]):
             # null semantics). The has-null probe is an uncorrelated
             # scalar subquery over the SAME plan; it materializes in its
             # own QueryExecution so sharing the subtree is safe.
-            from ..expr.expressions import CaseWhen, Max
-
             hn_map: dict = {}
             sub_copy = _fresh_plan(sub, hn_map)
             hn_value = hn_map.get(value_attr.expr_id, value_attr)
@@ -413,6 +415,33 @@ def _existence_flag(target, child: LogicalPlan, outer_ids: set[int]):
                 "__has_null")], sub_copy))
             null_case = Or(EqualTo(probe, Literal(1)),
                            And(IsNull(target.value), IsNotNull(probe)))
+        else:
+            # CORRELATED x IN (subq): same three states, but per
+            # correlation key — a grouped left_outer probe join whose
+            # has-null column is NULL when this outer row's set is
+            # empty, 1 when it contains a NULL, 0 otherwise (the
+            # reference's null-aware ExistenceJoin semantics,
+            # sqlcat/optimizer/subquery.scala)
+            hn_map = {}
+            sub_copy = _fresh_plan(sub, hn_map)
+            hn_value = hn_map.get(value_attr.expr_id, value_attr)
+            ie_copies = []
+            pairs_copy = []
+            for oe, ie in pairs:
+                ic = hn_map.get(ie.expr_id, ie)
+                ie_copies.append(ic)
+                pairs_copy.append((oe, ic))
+            sub_copy = _expose_correlation_keys(sub_copy, pairs_copy)
+            hn_alias = Alias(Max(CaseWhen(
+                [(IsNull(hn_value), Literal(1))], Literal(0))),
+                "__has_null")
+            probe_plan = Aggregate(list(ie_copies),
+                                   list(ie_copies) + [hn_alias], sub_copy)
+            cond2 = None
+            for oe, ic in pairs_copy:
+                c = EqualTo(oe, ic)
+                cond2 = c if cond2 is None else And(cond2, c)
+            corr_probe = (probe_plan, cond2, probe_plan.output[-1])
         sub = _expose_correlation_keys(sub, pairs)
         keys = [value_attr] + [ie for _, ie in pairs]
         dsub = Aggregate(list(keys), list(keys) + [flag], sub)
@@ -432,6 +461,11 @@ def _existence_flag(target, child: LogicalPlan, outer_ids: set[int]):
         dsub = Project([flag], Limit(1, sub))
     flag_attr = dsub.output[-1]
     joined = Join(child, dsub, "left_outer", cond)
+    if corr_probe is not None:
+        probe_plan, cond2, hn_attr = corr_probe
+        joined = Join(joined, probe_plan, "left_outer", cond2)
+        null_case = Or(EqualTo(hn_attr, Literal(1)),
+                       And(IsNull(target.value), IsNotNull(hn_attr)))
     rep = IsNotNull(flag_attr)
     if null_case is not None:
         from ..expr.expressions import CaseWhen

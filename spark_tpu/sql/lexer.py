@@ -37,7 +37,7 @@ class Token:
         return f"{self.kind}:{self.value}"
 
 
-_TWO_CHAR_OPS = ("<=>", "<<", ">>", "<=", ">=", "<>", "!=", "==", "||")
+_TWO_CHAR_OPS = ("<=>", "<<", ">>", "<=", ">=", "<>", "!=", "==", "||", "->")
 
 
 def tokenize(text: str) -> list[Token]:

@@ -930,7 +930,20 @@ class Parser:
             to = self.parse_type()
             self.expect_op(")")
             return E.Cast(e, to)
-        if self.at_kw("exists"):
+        if t.kind == "ident" and t.value.lower() == "try_cast" and \
+                self.peek(1).value == "(":
+            self.next()
+            self.expect_op("(")
+            e = self.parse_expr()
+            self.expect_kw("as")
+            to = self.parse_type()
+            self.expect_op(")")
+            return E.Cast(e, to, ansi=False)  # try_cast: NULL on failure
+        if self.at_kw("exists") and self.peek(1).value == "(" and \
+                (self.peek(2).value == "(" or
+                 (self.peek(2).kind == "kw" and
+                  self.peek(2).value.lower() in ("select", "with",
+                                                 "values"))):
             from ..plan.subquery import Exists
 
             self.next()
@@ -954,7 +967,14 @@ class Parser:
             if name.lower() == "extract" and self.at_op("("):
                 return self.parse_extract()
             if self.at_op("("):
-                return self.parse_function(name)
+                f = self.parse_function(name)
+                # postfix struct-field access on a function result:
+                # named_struct(...).a.b (complexTypeExtractors.scala)
+                while self.at_op(".") and \
+                        self.peek(1).kind in ("ident", "kw"):
+                    self.next()
+                    f = E.GetStructField(f, self.ident())
+                return f
             parts = [name]
             while self.at_op(".") and self.peek(1).kind in ("ident", "kw"):
                 self.next()
@@ -981,7 +1001,7 @@ class Parser:
                 while self.eat_op(","):
                     args.append(self.parse_expr())
             else:
-                args.append(self.parse_expr())
+                args.append(self.parse_lambda_or_expr())
                 if (name.lower() == "overlay"
                         and self.peek().value.lower() == "placing"):
                     # overlay(str PLACING repl FROM pos [FOR len]) — argument
@@ -995,11 +1015,49 @@ class Parser:
                         args.append(self.parse_expr())
                 else:
                     while self.eat_op(","):
-                        args.append(self.parse_expr())
+                        args.append(self.parse_lambda_or_expr())
         self.expect_op(")")
         if self.at_kw("over"):
             return self.parse_over(E.UnresolvedFunction(name, args, distinct))
         return E.UnresolvedFunction(name, args, distinct)
+
+    def parse_lambda_or_expr(self) -> E.Expression:
+        """A function argument: `x -> body`, `(x, y) -> body`, or a
+        plain expression (higher-order function lambdas,
+        sqlbase grammar lambda rule)."""
+        from ..expr.higher_order import LambdaFunction, mark_lambda_params
+
+        t = self.peek()
+        if t.kind in ("ident", "kw") and self.peek(1).value == "->":
+            name = self.ident()
+            self.next()     # ->
+            body = self.parse_expr()
+            return LambdaFunction([name], mark_lambda_params(body, [name]))
+        if t.value == "(":
+            save = self.i
+            self.next()
+            names: list[str] = []
+            ok = True
+            while True:
+                tt = self.peek()
+                if tt.kind in ("ident", "kw") and \
+                        tt.value.lower() not in ("select", "with"):
+                    names.append(self.ident())
+                else:
+                    ok = False
+                    break
+                if self.eat_op(","):
+                    continue
+                break
+            if ok and names and self.at_op(")") and \
+                    self.peek(1).value == "->":
+                self.next()     # )
+                self.next()     # ->
+                body = self.parse_expr()
+                return LambdaFunction(names,
+                                      mark_lambda_params(body, names))
+            self.i = save
+        return self.parse_expr()
 
     def parse_over(self, func: E.Expression) -> E.Expression:
         from ..expr.window import WindowExpression
@@ -1079,7 +1137,11 @@ class Parser:
         saw = False
         while True:
             sign = 1
-            if self.eat_op("-"):
+            # only claim a '-' that introduces another signed component;
+            # `interval '2' day - interval '1' day` must leave the minus
+            # for the enclosing subtraction
+            if self.at_op("-") and self.peek(1).kind in ("num", "str"):
+                self.next()
                 sign = -1
             t = self.peek()
             if t.kind == "num":

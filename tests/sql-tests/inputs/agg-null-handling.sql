@@ -1,0 +1,4 @@
+CREATE OR REPLACE TEMP VIEW aggn AS SELECT 1 g, 10 v UNION ALL SELECT 1, cast(null as int) UNION ALL SELECT 2, cast(null as int) UNION ALL SELECT 2, cast(null as int);
+SELECT g, count(*) AS cnt_star, count(v) AS cnt_v, sum(v) AS sum_v, avg(v) AS avg_v, min(v) AS min_v, max(v) AS max_v FROM aggn GROUP BY g ORDER BY g;
+SELECT count(distinct v) AS cd FROM aggn;
+SELECT sum(v) AS all_sum FROM aggn WHERE v IS NULL;

@@ -1,0 +1,9 @@
+SELECT array(1, 2, 3) AS a, array('x', 'y') AS s, array() AS e;
+SELECT size(array(1,2,3)) AS n, element_at(array(10,20,30), 2) AS el, element_at(array(10,20,30), -1) AS last_el;
+SELECT array_contains(array(1,2), 2) AS c1, array_contains(array(1,2), 9) AS c2;
+SELECT sort_array(array(3,1,2)) AS srt, array_distinct(array(1,2,1,3,2)) AS dst;
+SELECT array_min(array(5,1,9)) AS mn, array_max(array(5,1,9)) AS mx;
+SELECT flatten(array(array(1,2), array(3))) AS fl;
+SELECT slice(array(1,2,3,4,5), 2, 3) AS sl, slice(array(1,2,3,4,5), -2, 2) AS sl2;
+SELECT array_join(array('a','b','c'), '-') AS j1;
+SELECT array_position(array('a','b'), 'b') AS p1, array_remove(array(1,2,1), 1) AS rm;

@@ -1,0 +1,7 @@
+SELECT array_union(array(1, 2), array(2, 3)) AS un, array_intersect(array(1, 2, 3), array(2, 3, 4)) AS inter;
+SELECT array_except(array(1, 2, 3), array(2)) AS ex;
+SELECT arrays_overlap(array(1, 2), array(2, 3)) AS ov_t, arrays_overlap(array(1), array(9)) AS ov_f;
+SELECT array_union(array(1, 1, 2), array(2, 2)) AS dedup;
+SELECT array_append(array(1, 2), 3) AS app, array_prepend(array(2, 3), 1) AS prep;
+SELECT array_insert(array(1, 3), 2, 2) AS ins;
+SELECT array_compact(array(1, null, 2, null)) AS compacted;

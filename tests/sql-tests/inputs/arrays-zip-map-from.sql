@@ -1,0 +1,3 @@
+SELECT arrays_zip(array(1, 2), array('a', 'b')) AS z;
+SELECT map_from_arrays(array('k1', 'k2'), array(10, 20)) AS mfa;
+SELECT str_to_map('a:1,b:2') AS stm, str_to_map('x=1;y=2', ';', '=') AS stm2;

@@ -1,0 +1,4 @@
+SELECT 5 BETWEEN 1 AND 10 AS b1, 0 BETWEEN 1 AND 10 AS b2, 5 NOT BETWEEN 1 AND 10 AS nb;
+SELECT 'm' BETWEEN 'a' AND 'z' AS str_between;
+SELECT cast(null as int) BETWEEN 1 AND 10 AS null_between;
+SELECT date '2020-06-15' BETWEEN date '2020-01-01' AND date '2020-12-31' AS date_between;

@@ -1,0 +1,4 @@
+CREATE OR REPLACE TEMP VIEW bac AS SELECT 1 g, true b, 5 v UNION ALL SELECT 1, false, 10 UNION ALL SELECT 2, true, 1 UNION ALL SELECT 2, true, 2;
+SELECT g, bool_and(b) AS ba, bool_or(b) AS bo, every(b) AS ev, any(b) AS an FROM bac GROUP BY g ORDER BY g;
+SELECT g, count_if(v > 1) AS ci FROM bac GROUP BY g ORDER BY g;
+SELECT count_if(v > 100) AS ci_zero FROM bac;

@@ -1,0 +1,6 @@
+SELECT true AND true AS tt, true AND false AS tf, false AND false AS ff;
+SELECT true OR false AS t_or_f, false OR false AS f_or_f;
+SELECT (1 = cast(null as int)) AND false AS unknown_and_false;
+SELECT (1 = cast(null as int)) OR true AS unknown_or_true;
+SELECT NOT (1 = cast(null as int)) AS not_unknown;
+SELECT (1 > 0) = (2 > 1) AS bool_eq;

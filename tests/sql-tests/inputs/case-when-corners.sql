@@ -1,0 +1,5 @@
+SELECT CASE WHEN 1 = 1 THEN 'a' WHEN 1 = 1 THEN 'b' ELSE 'c' END AS first_wins;
+SELECT CASE WHEN 1 = 2 THEN 'a' END AS no_else_null;
+SELECT CASE WHEN cast(null as boolean) THEN 'x' ELSE 'y' END AS null_cond;
+SELECT CASE 3 WHEN 1 THEN 'one' WHEN 3 THEN 'three' ELSE 'other' END AS simple_case;
+SELECT CASE WHEN 1 > 0 THEN 1 ELSE 2.5 END AS widened;

@@ -1,0 +1,7 @@
+SELECT cast('42' as int) AS i1, cast('  42  ' as int) AS i_trim, cast('4.9' as int) AS i_trunc;
+SELECT cast('abc' as int) AS i_bad;
+SELECT cast('true' as boolean) AS b1, cast('0' as boolean) AS b2, cast('yes' as boolean) AS b3;
+SELECT cast(1.99 as int) AS trunc1, cast(-1.99 as int) AS trunc2;
+SELECT cast(true as int) AS b2i, cast(0 as boolean) AS i2b;
+SELECT cast('2020-06-01' as date) AS d1, cast('2020-06-01 12:30:00' as timestamp) AS ts1;
+SELECT cast(3.14159 as decimal(5, 2)) AS dec1, cast('12.345' as double) AS dbl1;

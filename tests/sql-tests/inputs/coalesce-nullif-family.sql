@@ -1,0 +1,5 @@
+SELECT coalesce(cast(null as int), 2, 3) AS c1, coalesce(cast(null as int), cast(null as int)) AS c2;
+SELECT nullif(1, 1) AS n1, nullif(1, 2) AS n2, nullif(cast(null as int), 1) AS n3;
+SELECT nvl(cast(null as int), 9) AS nvl_r, nvl2(cast(null as int), 1, 2) AS nvl2_r;
+SELECT ifnull(cast(null as int), 7) AS ifnull_r;
+SELECT isnull(cast(null as int)) AS is_n, isnotnull(3) AS is_nn;

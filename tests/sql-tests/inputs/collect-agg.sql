@@ -1,0 +1,4 @@
+CREATE OR REPLACE TEMP VIEW cla AS SELECT 1 g, 3 v UNION ALL SELECT 1, 1 UNION ALL SELECT 1, 3 UNION ALL SELECT 2, 7;
+SELECT g, sort_array(collect_list(v)) AS lst FROM cla GROUP BY g ORDER BY g;
+SELECT g, sort_array(collect_set(v)) AS st FROM cla GROUP BY g ORDER BY g;
+SELECT g, first(v) AS f, any_value(v) AS av FROM (SELECT * FROM cla ORDER BY v) GROUP BY g ORDER BY g;

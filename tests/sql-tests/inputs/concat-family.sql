@@ -1,0 +1,5 @@
+SELECT concat('a', 'b', 'c') AS c1, concat('x', cast(null as string)) AS c_null;
+SELECT concat_ws('-', 'a', 'b', 'c') AS cw1, concat_ws('-', 'a', cast(null as string), 'c') AS cw_skip_null;
+SELECT 'a' || 'b' || 'c' AS pipe_concat;
+SELECT repeat('ab', 3) AS rep, reverse('spark') AS rev;
+SELECT lpad('7', 3, '0') AS lp, rpad('7', 3, '*') AS rp;

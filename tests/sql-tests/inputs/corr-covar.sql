@@ -1,0 +1,4 @@
+CREATE OR REPLACE TEMP VIEW cvr AS SELECT 1.0 x, 2.0 y UNION ALL SELECT 2.0, 4.0 UNION ALL SELECT 3.0, 6.0;
+SELECT round(corr(x, y), 6) AS c FROM cvr;
+SELECT round(covar_pop(x, y), 6) AS cp, round(covar_samp(x, y), 6) AS cs FROM cvr;
+SELECT round(skewness(x), 6) AS sk, round(kurtosis(x), 6) AS kt FROM cvr;

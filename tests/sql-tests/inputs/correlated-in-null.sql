@@ -1,0 +1,6 @@
+CREATE OR REPLACE TEMP VIEW cin_t AS SELECT 1 a, 1 k UNION ALL SELECT cast(null as int) a, 1 k UNION ALL SELECT 5 a, 1 k UNION ALL SELECT 1 a, 2 k UNION ALL SELECT 2 a, 3 k;
+CREATE OR REPLACE TEMP VIEW cin_u AS SELECT 1 b, 1 ku UNION ALL SELECT cast(null as int) b, 1 ku UNION ALL SELECT 2 b, 2 ku;
+SELECT a, k, a IN (SELECT b FROM cin_u WHERE ku = k) AS in_r FROM cin_t ORDER BY k, a NULLS FIRST;
+SELECT a, k, a NOT IN (SELECT b FROM cin_u WHERE ku = k) AS notin_r FROM cin_t ORDER BY k, a NULLS FIRST;
+SELECT count(*) AS semi_cnt FROM cin_t WHERE a IN (SELECT b FROM cin_u WHERE ku = k);
+SELECT count(*) AS anti_cnt FROM cin_t WHERE a NOT IN (SELECT b FROM cin_u WHERE ku = k);

@@ -1,0 +1,7 @@
+SELECT add_months(date '2020-01-31', 1) AS am1, add_months(date '2020-03-31', -1) AS am2;
+SELECT last_day(date '2020-02-10') AS ld_leap, last_day(date '2021-02-10') AS ld;
+SELECT months_between(date '2020-03-31', date '2020-02-29') AS mb;
+SELECT datediff(date '2020-06-10', date '2020-06-01') AS dd;
+SELECT date_add(date '2019-12-30', 5) AS da, date_sub(date '2020-01-03', 5) AS ds;
+SELECT dayofweek(date '2020-06-01') AS dow, dayofyear(date '2020-12-31') AS doy, weekofyear(date '2020-01-01') AS woy;
+SELECT trunc(date '2020-06-17', 'MM') AS t_month, trunc(date '2020-06-17', 'YEAR') AS t_year;

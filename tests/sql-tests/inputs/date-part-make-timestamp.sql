@@ -1,0 +1,5 @@
+SELECT date_part('year', date '2020-08-15') AS y, date_part('month', date '2020-08-15') AS m, date_part('day', date '2020-08-15') AS d;
+SELECT date_part('hour', timestamp '2020-08-15 13:20:45') AS h, date_part('minute', timestamp '2020-08-15 13:20:45') AS mi;
+SELECT make_timestamp(2021, 3, 14, 15, 9, 26.5) AS mts;
+SELECT unix_date(date '1970-01-10') AS ud, unix_date(date '1969-12-31') AS ud_neg;
+SELECT date_format(date '2020-06-01', 'yyyy/MM/dd') AS df;

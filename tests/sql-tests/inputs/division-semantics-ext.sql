@@ -1,0 +1,4 @@
+SELECT 7 / 2 AS true_div, 7 div 2 AS int_div, -7 div 2 AS int_div_neg;
+SELECT 1 / 0 AS div_zero, 0.0 / 0.0 AS zero_over_zero;
+SELECT 7 % 3 AS mod_pos, -7 % 3 AS mod_neg_dividend, 7 % -3 AS mod_neg_divisor;
+SELECT try_divide(4, 2) AS td_ok, try_divide(1, 0) AS td_zero;

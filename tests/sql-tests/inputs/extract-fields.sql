@@ -1,0 +1,4 @@
+SELECT extract(year FROM date '2020-06-15') AS y, extract(month FROM date '2020-06-15') AS m, extract(day FROM date '2020-06-15') AS d;
+SELECT extract(hour FROM timestamp '2020-06-15 13:45:30') AS h, extract(minute FROM timestamp '2020-06-15 13:45:30') AS mi, extract(second FROM timestamp '2020-06-15 13:45:30') AS s;
+SELECT year(date '2019-02-03') AS yr, quarter(date '2019-08-03') AS q;
+SELECT hour(timestamp '2020-01-01 23:59:59') AS hh, minute(timestamp '2020-01-01 23:59:59') AS mm;

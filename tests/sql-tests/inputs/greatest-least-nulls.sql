@@ -1,0 +1,4 @@
+SELECT greatest(1, 5, 3) AS g1, least(1, 5, 3) AS l1;
+SELECT greatest(1, cast(null as int), 3) AS g_null, least(cast(null as int), 2) AS l_null;
+SELECT greatest('apple', 'pear') AS g_str;
+SELECT greatest(1.5, 2) AS g_mixed;

@@ -1,0 +1,4 @@
+CREATE OR REPLACE TEMP VIEW gbe AS SELECT 1 v UNION ALL SELECT 2 UNION ALL SELECT 3 UNION ALL SELECT 4 UNION ALL SELECT 5;
+SELECT v % 2 AS parity, count(*) c, sum(v) s FROM gbe GROUP BY v % 2 ORDER BY parity;
+SELECT v % 2 AS parity, v % 3 AS m3, count(*) c FROM gbe GROUP BY v % 2, v % 3 ORDER BY parity, m3;
+SELECT CASE WHEN v <= 2 THEN 'low' ELSE 'high' END AS bucket, count(*) c FROM gbe GROUP BY CASE WHEN v <= 2 THEN 'low' ELSE 'high' END ORDER BY bucket;

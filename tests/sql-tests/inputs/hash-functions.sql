@@ -1,0 +1,3 @@
+SELECT md5('spark') AS m, sha2('spark', 256) AS s2;
+SELECT crc32('spark') AS crc;
+SELECT base64('spark') AS b64, unbase64(base64('spark')) AS rt;

@@ -1,0 +1,9 @@
+SELECT transform(array(1,2,3), x -> x * 2) AS t1, transform(array(10,20), (x, i) -> x + i) AS t2;
+SELECT filter(array(1,2,3,4,5), x -> x % 2 = 1) AS f1, filter(array(1,2), x -> x > 10) AS f2;
+SELECT aggregate(array(1,2,3,4), 0, (acc, x) -> acc + x) AS a1, aggregate(array(1,2,3), 1, (acc, x) -> acc * x, acc -> acc + 100) AS a2;
+SELECT reduce(array(5,10), 0, (a, b) -> a + b) AS r1;
+SELECT exists(array(1,2,3), x -> x > 2) AS e1, exists(array(1,2), x -> x > 9) AS e2, exists(array(1,null), x -> x > 9) AS e3;
+SELECT forall(array(2,4,6), x -> x % 2 = 0) AS fa1, forall(array(2,3), x -> x % 2 = 0) AS fa2;
+SELECT zip_with(array(1,2,3), array(10,20,30), (a, b) -> a + b) AS z1, zip_with(array(1), array(1,2), (a, b) -> coalesce(a, 0) + b) AS z2;
+SELECT array_sort(array(3,1,2), (a, b) -> case when a < b then 1 when a > b then -1 else 0 end) AS desc_sorted;
+SELECT transform(array(1,2), x -> transform(array(10), y -> y + x)) AS nested;

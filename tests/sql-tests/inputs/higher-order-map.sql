@@ -1,0 +1,5 @@
+SELECT transform_keys(map('a', 1, 'b', 2), (k, v) -> upper(k)) AS tk;
+SELECT transform_values(map('a', 1, 'b', 2), (k, v) -> v * 10) AS tv;
+SELECT map_filter(map('a', 1, 'b', 2, 'c', 3), (k, v) -> v >= 2) AS mf;
+SELECT map_zip_with(map('a', 1, 'b', 2), map('b', 20, 'c', 30), (k, v1, v2) -> coalesce(v1, 0) + coalesce(v2, 0)) AS mz;
+SELECT map_keys(transform_values(map('x', 1), (k, v) -> v + 1)) AS mk;

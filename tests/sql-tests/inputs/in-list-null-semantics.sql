@@ -1,0 +1,5 @@
+SELECT 1 IN (1, 2, 3) AS in_t, 9 IN (1, 2, 3) AS in_f;
+SELECT 9 IN (1, cast(null as int)) AS in_unknown;
+SELECT 1 IN (1, cast(null as int)) AS in_match_with_null;
+SELECT cast(null as int) IN (1, 2) AS null_probe;
+SELECT 2 NOT IN (1, 3) AS notin_t, 2 NOT IN (1, cast(null as int)) AS notin_unknown;

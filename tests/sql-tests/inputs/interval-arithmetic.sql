@@ -1,0 +1,7 @@
+SELECT timestamp '2020-01-01 00:00:00' + interval '2' day * 3 AS mul;
+SELECT timestamp '2020-01-07 00:00:00' - interval '2' day * 3 AS mul_sub;
+SELECT timestamp '2020-01-02 00:00:00' - interval '1' day / 2 AS div_half;
+SELECT timestamp '2020-01-01 00:00:00' + (interval '1' day + interval '12' hour) AS iv_add;
+SELECT timestamp '2020-01-03 00:00:00' + (interval '2' day - interval '1' day) AS iv_sub;
+SELECT date '2020-01-31' + interval '1' month AS month_clamp;
+SELECT date '2020-02-29' + interval '1' year AS year_clamp;

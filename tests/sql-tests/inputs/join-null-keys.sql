@@ -1,0 +1,8 @@
+CREATE OR REPLACE TEMP VIEW jl AS SELECT 1 k, 'l1' lv UNION ALL SELECT cast(null as int), 'l2' UNION ALL SELECT 3, 'l3';
+CREATE OR REPLACE TEMP VIEW jr AS SELECT 1 k, 'r1' rv UNION ALL SELECT cast(null as int), 'r2' UNION ALL SELECT 4, 'r4';
+SELECT l.lv, r.rv FROM jl l JOIN jr r ON l.k = r.k ORDER BY l.lv;
+SELECT l.lv, r.rv FROM jl l LEFT JOIN jr r ON l.k = r.k ORDER BY l.lv;
+SELECT l.lv, r.rv FROM jl l FULL OUTER JOIN jr r ON l.k = r.k ORDER BY l.lv NULLS LAST, r.rv NULLS LAST;
+SELECT l.lv FROM jl l LEFT SEMI JOIN jr r ON l.k = r.k ORDER BY l.lv;
+SELECT l.lv FROM jl l LEFT ANTI JOIN jr r ON l.k = r.k ORDER BY l.lv;
+SELECT l.lv, r.rv FROM jl l JOIN jr r ON l.k <=> r.k ORDER BY l.lv;

@@ -1,0 +1,6 @@
+SELECT get_json_object('{"a": 1}', '$.a') AS hit, get_json_object('{"a": 1}', '$.b') AS miss;
+SELECT get_json_object('{"a": null}', '$.a') AS json_null;
+SELECT get_json_object('{"a": {"b": 2}}', '$.a.b') AS nested, get_json_object('{"a": {"b": 2}}', '$.a') AS obj;
+SELECT get_json_object('{"arr": [1, 2, 3]}', '$.arr[1]') AS idx, get_json_object('{"arr": [1]}', '$.arr[5]') AS oob;
+SELECT get_json_object('not json', '$.a') AS badjson;
+SELECT get_json_object('{"b": true}', '$.b') AS boolval;

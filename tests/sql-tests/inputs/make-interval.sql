@@ -1,0 +1,4 @@
+SELECT date '2020-01-01' + make_interval(1, 2, 0, 3, 0, 0, 0) AS mi;
+SELECT timestamp '2020-01-01 00:00:00' + make_dt_interval(1, 2, 30, 45.5) AS dt;
+SELECT date '2020-03-31' + make_ym_interval(0, 1) AS ym;
+SELECT timestamp '2020-01-01 00:00:00' + make_interval(0, 0, 1, 0, 0, 0, 0) AS weeks;

@@ -1,0 +1,5 @@
+SELECT map('a', 1, 'b', 2) AS m;
+SELECT element_at(map('a', 1), 'a') AS hit, element_at(map('a', 1), 'z') AS miss;
+SELECT map_keys(map('a', 1, 'b', 2)) AS ks, map_values(map('a', 1, 'b', 2)) AS vs;
+SELECT map_contains_key(map('a', 1), 'a') AS has_a, map_contains_key(map('a', 1), 'z') AS has_z;
+SELECT size(map('a', 1, 'b', 2)) AS n;

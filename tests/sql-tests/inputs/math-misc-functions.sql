@@ -1,0 +1,5 @@
+SELECT hypot(3.0, 4.0) AS hy, factorial(6) AS fact;
+SELECT bit_count(255) AS bc1, bit_count(0) AS bc0;
+SELECT width_bucket(5.3, 0, 10, 5) AS wb1, width_bucket(-1, 0, 10, 5) AS wb_under, width_bucket(11, 0, 10, 5) AS wb_over;
+SELECT log2(8.0) AS l2, log10(1000.0) AS l10, ln(e()) AS lne;
+SELECT round(pi(), 4) AS pi4;

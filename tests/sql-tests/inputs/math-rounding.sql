@@ -1,0 +1,7 @@
+SELECT round(2.5) AS r1, round(3.5) AS r2, round(-2.5) AS r3;
+SELECT round(2.345, 2) AS r4, round(123.456, -1) AS r5;
+SELECT bround(2.5) AS b1, bround(3.5) AS b2;
+SELECT floor(1.9) AS f1, floor(-1.1) AS f2, ceil(1.1) AS c1, ceil(-1.9) AS c2;
+SELECT sign(-5) AS sg1, signum(3.2) AS sg2, sign(0) AS sg0;
+SELECT pmod(10, 3) AS p1, pmod(-7, 3) AS p2, mod(-7, 3) AS m1, -7 % 3 AS m2;
+SELECT power(2, 10) AS pw, sqrt(16.0) AS sq, cbrt(27.0) AS cb;

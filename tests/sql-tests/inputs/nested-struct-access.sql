@@ -1,0 +1,4 @@
+SELECT named_struct('a', 1, 'b', 'x') AS st;
+SELECT named_struct('a', 1, 'b', 'x').a AS field_a;
+SELECT struct(1, 'two').col1 AS c1;
+SELECT named_struct('outer', named_struct('inner', 42)).outer.inner AS deep;

@@ -1,0 +1,7 @@
+SELECT 1 + cast(null as int) AS add_null, cast(null as int) * 2 AS mul_null;
+SELECT abs(cast(null as int)) AS abs_null, upper(cast(null as string)) AS upper_null;
+SELECT length(cast(null as string)) AS len_null, concat('a', cast(null as string)) AS concat_null;
+SELECT cast(null as int) = 1 AS eq_null, cast(null as int) <=> 1 AS nse_false, cast(null as int) <=> cast(null as int) AS nse_true;
+SELECT NOT cast(null as boolean) AS not_null;
+SELECT cast(null as boolean) AND false AS and_false, cast(null as boolean) OR true AS or_true;
+SELECT cast(null as boolean) AND true AS and_null, cast(null as boolean) OR false AS or_null;

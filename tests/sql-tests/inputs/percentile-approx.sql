@@ -1,0 +1,4 @@
+CREATE OR REPLACE TEMP VIEW pca AS SELECT 1.0 v UNION ALL SELECT 2.0 UNION ALL SELECT 3.0 UNION ALL SELECT 4.0 UNION ALL SELECT 100.0;
+SELECT percentile(v, 0.5) AS p50, median(v) AS med FROM pca;
+SELECT approx_count_distinct(v) AS acd FROM pca;
+SELECT percentile_approx(v, 0.5) AS pa50 FROM pca;

@@ -1,0 +1,8 @@
+SELECT regexp_extract_all('a1b2c3', '([a-z])(\\d)', 1) AS groups1, regexp_extract_all('a1b2c3', '([a-z])(\\d)', 2) AS groups2;
+SELECT regexp_extract_all('foo12bar34', '\\d+') AS nums;
+SELECT regexp_substr('hello world', 'o\\w') AS sub1, regexp_substr('abc', 'zz') AS sub_null;
+SELECT regexp_instr('abcabc', 'bc') AS pos1, regexp_instr('abc', 'zz') AS pos0;
+SELECT regexp_count('banana', 'an') AS cnt, regexp_count('aaa', 'b') AS zero;
+SELECT regexp_like('spark', '^sp') AS rl1, regexp_like('spark', '^qq') AS rl2;
+SELECT regexp_replace('a1b2', '\\d', '#') AS rep;
+SELECT regexp_extract('2020-06-01', '(\\d{4})-(\\d{2})', 2) AS month_part;

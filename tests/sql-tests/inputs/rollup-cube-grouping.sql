@@ -1,0 +1,4 @@
+CREATE OR REPLACE TEMP VIEW rcg AS SELECT 'a' x, 'p' y, 1 v UNION ALL SELECT 'a', 'q', 2 UNION ALL SELECT 'b', 'p', 4;
+SELECT x, y, sum(v) s FROM rcg GROUP BY ROLLUP(x, y) ORDER BY x NULLS LAST, y NULLS LAST;
+SELECT x, y, sum(v) s, grouping(x) gx, grouping(y) gy FROM rcg GROUP BY CUBE(x, y) ORDER BY x NULLS LAST, y NULLS LAST;
+SELECT x, sum(v) s, grouping_id(x) gid FROM rcg GROUP BY ROLLUP(x) ORDER BY x NULLS LAST;

@@ -1,0 +1,4 @@
+CREATE OR REPLACE TEMP VIEW sja AS SELECT 1 id, 10 v UNION ALL SELECT 2, 20 UNION ALL SELECT 3, 30;
+SELECT l.id, r.id AS rid FROM sja l JOIN sja r ON l.id = r.id - 1 ORDER BY l.id;
+SELECT a.id FROM sja a JOIN sja b ON a.v = b.v WHERE a.id = b.id ORDER BY a.id;
+SELECT count(*) AS pairs FROM sja x CROSS JOIN sja y;

@@ -1,0 +1,3 @@
+SELECT sequence(1, 5) AS asc_seq, sequence(5, 1) AS desc_seq, sequence(1, 10, 3) AS stepped;
+SELECT array_repeat('ab', 3) AS rep_str, array_repeat(7, 2) AS rep_int;
+SELECT size(sequence(1, 100)) AS n;

@@ -1,0 +1,4 @@
+CREATE OR REPLACE TEMP VIEW sv AS SELECT 2.0 v UNION ALL SELECT 4.0 UNION ALL SELECT 4.0 UNION ALL SELECT 6.0;
+SELECT round(stddev(v), 6) AS sd, round(stddev_pop(v), 6) AS sdp, round(stddev_samp(v), 6) AS sds FROM sv;
+SELECT round(variance(v), 6) AS var, round(var_pop(v), 6) AS varp, round(var_samp(v), 6) AS vars FROM sv;
+SELECT percentile(v, 0.5) AS p50, median(v) AS med FROM sv;

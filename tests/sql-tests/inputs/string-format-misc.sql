@@ -1,0 +1,6 @@
+SELECT format_string('%s has %d items', 'cart', 3) AS fs, printf('%05d', 42) AS pf;
+SELECT chr(72) AS c1, char(101) AS c2;
+SELECT elt(1, 'first', 'second') AS e1, elt(9, 'a', 'b') AS e_oob;
+SELECT find_in_set('b', 'a,b,c') AS fis, find_in_set('z', 'a,b') AS fis_miss;
+SELECT conv('ff', 16, 10) AS c16to10, conv('7', 10, 2) AS c10to2;
+SELECT hex(255) AS hx, unhex('414243') AS uh, bin(10) AS bn;

@@ -1,0 +1,6 @@
+SELECT 'spark' LIKE 'sp%' AS l1, 'spark' LIKE '%ark' AS l2, 'spark' LIKE 's_ark' AS l3;
+SELECT 'spark' LIKE 'SPARK' AS case_sensitive;
+SELECT 'a_b' LIKE 'a\\_b' AS escaped_underscore;
+SELECT 'x' LIKE '%' AS match_all, '' LIKE '%' AS empty_match;
+SELECT startswith('spark', 'sp') AS sw, endswith('spark', 'rk') AS ew, contains('spark', 'par') AS ct;
+SELECT 'spark' RLIKE 'a.k' AS rl;

@@ -1,0 +1,4 @@
+SELECT to_number('123', '999') AS n1, to_number('-12.34', '99.99') AS n2;
+SELECT to_number('1,234', '9,999') AS grouped, to_number('$45.00', '$99.99') AS currency;
+SELECT try_to_number('99', '999') AS ok, try_to_number('bogus', '999') AS bad;
+SELECT try_to_number('12.345', '99.999') AS scaled;

@@ -1,0 +1,5 @@
+SELECT trim('  x  ') AS t1, ltrim('  x  ') AS t2, rtrim('  x  ') AS t3;
+SELECT length(trim('   ')) AS empty_trim;
+SELECT lpad('abcdef', 3, '0') AS lpad_truncates, rpad('ab', 5, 'xy') AS rpad_pattern;
+SELECT initcap('hello spark world') AS ic;
+SELECT substring_index('a.b.c', '.', 2) AS si1, substring_index('a.b.c', '.', -1) AS si2;

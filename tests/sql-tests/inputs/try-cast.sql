@@ -1,0 +1,5 @@
+SELECT try_cast('42' AS int) AS ok_int, try_cast('abc' AS int) AS bad_int;
+SELECT try_cast('3.99' AS double) AS ok_dbl, try_cast('x' AS double) AS bad_dbl;
+SELECT try_cast('2020-01-15' AS date) AS ok_date;
+SELECT try_cast('true' AS boolean) AS ok_bool;
+SELECT typeof(1) AS t_int, typeof('s') AS t_str, typeof(1.5) AS t_dbl, typeof(array(1)) AS t_arr;

@@ -1,0 +1,3 @@
+CREATE OR REPLACE TEMP VIEW wrt AS SELECT 'a' k, 10 v UNION ALL SELECT 'a', 10 UNION ALL SELECT 'a', 20 UNION ALL SELECT 'b', 5;
+SELECT k, v, rank() OVER (PARTITION BY k ORDER BY v) AS rnk, dense_rank() OVER (PARTITION BY k ORDER BY v) AS drnk, row_number() OVER (PARTITION BY k ORDER BY v) AS rn FROM wrt ORDER BY k, v, rn;
+SELECT k, v, percent_rank() OVER (PARTITION BY k ORDER BY v) AS pr, cume_dist() OVER (PARTITION BY k ORDER BY v) AS cd FROM wrt ORDER BY k, v;

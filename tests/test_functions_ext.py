@@ -329,7 +329,7 @@ def test_array_functions_strings(spark):
                sort_array(parts) AS srt
         FROM arrstr ORDER BY s""")
     assert out["n"] == [3, 1]
-    assert out["e2"] == ["a", ""]   # '' for out-of-bounds (ref: NULL)
+    assert out["e2"] == ["a", None]  # NULL for out-of-bounds, like the ref
     assert out["srt"] == [["a", "b", "c"], ["z"]]
 
 

@@ -55,6 +55,42 @@ def _fmt(v) -> str:
     return str(v)
 
 
+def _split_statements(text: str) -> list[str]:
+    """Split on ';' outside single-quoted strings ('' escapes a quote,
+    like the lexer)."""
+    out, buf, i, n = [], [], 0, len(text)
+    in_str = False
+    while i < n:
+        c = text[i]
+        if not in_str and c == "-" and i + 1 < n and text[i + 1] == "-":
+            # '--' comment runs to end of line (apostrophes inside it
+            # must not open a string)
+            while i < n and text[i] != "\n":
+                buf.append(text[i])
+                i += 1
+            continue
+        if in_str:
+            buf.append(c)
+            if c == "'":
+                if i + 1 < n and text[i + 1] == "'":
+                    buf.append("'")
+                    i += 1
+                else:
+                    in_str = False
+        elif c == "'":
+            in_str = True
+            buf.append(c)
+        elif c == ";":
+            out.append("".join(buf))
+            buf = []
+        else:
+            buf.append(c)
+        i += 1
+    if buf:
+        out.append("".join(buf))
+    return out
+
+
 def _cases():
     return sorted(glob.glob(os.path.join(INPUTS, "*.sql")))
 
@@ -68,7 +104,7 @@ def test_golden(spark, path):
     with open(path) as f:
         text = f.read()
 
-    chunks = [q.strip() for q in text.split(";") if q.strip()
+    chunks = [q.strip() for q in _split_statements(text) if q.strip()
               and not q.strip().startswith("--")]
     rendered = []
     for q in chunks:

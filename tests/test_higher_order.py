@@ -1,0 +1,180 @@
+"""Higher-order functions, lambda binding, and the NULL-semantics fixes
+(reference: sqlcat/expressions/higherOrderFunctions.scala,
+collectionOperations.scala, optimizer/subquery.scala null-aware IN)."""
+
+import pyarrow as pa
+import pytest
+
+
+def one(spark, q):
+    return spark.sql(q).toArrow().to_pylist()[0]["r"]
+
+
+class TestHigherOrder:
+    def test_transform(self, spark):
+        assert one(spark, "select transform(array(1,2,3), x -> x + 1) r") \
+            == [2, 3, 4]
+
+    def test_transform_with_index(self, spark):
+        assert one(spark,
+                   "select transform(array(10,20), (x, i) -> x + i) r") \
+            == [10, 21]
+
+    def test_filter(self, spark):
+        assert one(spark,
+                   "select filter(array(1,2,3,4), x -> x % 2 = 0) r") \
+            == [2, 4]
+
+    def test_aggregate_and_finish(self, spark):
+        assert one(spark,
+                   "select aggregate(array(1,2,3), 0, "
+                   "(acc, x) -> acc + x) r") == 6
+        assert one(spark,
+                   "select aggregate(array(1,2,3), 0, "
+                   "(acc, x) -> acc + x, acc -> acc * 10) r") == 60
+
+    def test_zip_with_pads_nulls(self, spark):
+        assert one(spark, "select zip_with(array(1,2), array(3,4,5), "
+                          "(a, b) -> coalesce(a, 0) + b) r") == [4, 6, 5]
+
+    def test_exists_three_valued(self, spark):
+        assert one(spark,
+                   "select exists(array(1,2), x -> x > 1) r") is True
+        # no TRUE + a NULL predicate result → NULL
+        assert one(spark,
+                   "select exists(array(1,null), x -> x > 5) r") is None
+        # a TRUE wins over NULLs
+        assert one(spark,
+                   "select exists(array(1,null,3), x -> x > 2) r") is True
+
+    def test_forall(self, spark):
+        assert one(spark, "select forall(array(1,2), x -> x > 0) r") \
+            is True
+        assert one(spark, "select forall(array(1,-2), x -> x > 0) r") \
+            is False
+
+    def test_map_hofs(self, spark):
+        assert one(spark, "select transform_values(map('a',1,'b',2), "
+                          "(k, v) -> v + 1) r") == [("a", 2), ("b", 3)]
+        assert one(spark, "select map_filter(map('a',1,'b',2), "
+                          "(k, v) -> v > 1) r") == [("b", 2)]
+        assert one(spark, "select map_zip_with(map('a',1), map('a',2), "
+                          "(k, v1, v2) -> v1 + v2) r") == [("a", 3)]
+
+    def test_array_sort_comparator_and_default(self, spark):
+        assert one(spark, "select array_sort(array(3,1,2), (a, b) -> "
+                          "case when a < b then -1 when a > b then 1 "
+                          "else 0 end) r") == [1, 2, 3]
+        assert one(spark, "select array_sort(array(3,null,1)) r") \
+            == [1, 3, None]
+
+    def test_nested_hof(self, spark):
+        assert one(spark, "select transform(array(1,2), x -> "
+                          "aggregate(array(1,2,3), 0, (a,b) -> a+b) + x)"
+                          " r") == [7, 8]
+
+    def test_column_input_and_capture(self, spark):
+        spark.createDataFrame(pa.table({
+            "id": [1, 2],
+            "nums": pa.array([[1, 2, 3], [4, 5]],
+                             pa.list_(pa.int64()))})) \
+            .createOrReplaceTempView("hof_t")
+        got = spark.sql("select transform(nums, x -> x + id) r "
+                        "from hof_t").toArrow().to_pylist()
+        assert [r["r"] for r in got] == [[2, 3, 4], [6, 7]]
+        got = spark.sql("select aggregate(nums, 0, (a, x) -> a + x) r "
+                        "from hof_t").toArrow().to_pylist()
+        assert [r["r"] for r in got] == [6, 9]
+
+
+class TestNullSemanticsFixes:
+    def test_flatten_null_subarray_nulls_result(self, spark):
+        assert one(spark, "select flatten(array(array(1), null)) r") \
+            is None
+        assert one(spark,
+                   "select flatten(array(array(1), array(2,3))) r") \
+            == [1, 2, 3]
+
+    def test_get_json_object_null_vs_missing(self, spark):
+        assert one(spark, "select get_json_object("
+                          "'{\"a\":null}', '$.a') r") is None
+        assert one(spark, "select get_json_object("
+                          "'{\"a\":1}', '$.b') r") is None
+        assert one(spark, "select get_json_object("
+                          "'{\"a\":1}', '$.a') r") == "1"
+
+    def test_element_at_string_out_of_bounds(self, spark):
+        assert one(spark,
+                   "select element_at(split('a,b', ','), 5) r") is None
+
+
+class TestCorrelatedInThreeValued:
+    @pytest.fixture()
+    def views(self, spark):
+        spark.sql(
+            "create or replace temp view tin3 as "
+            "select 1 a, 1 k union all select cast(null as int) a, 1 k "
+            "union all select 5 a, 1 k union all select 1 a, 2 k "
+            "union all select 2 a, 3 k")
+        spark.sql(
+            "create or replace temp view uin3 as "
+            "select 1 b, 1 ku union all "
+            "select cast(null as int) b, 1 ku union all select 2 b, 2 ku")
+
+    def test_correlated_in_value_position(self, spark, views):
+        rows = spark.sql(
+            "select a, k, a in (select b from uin3 where ku = k) r "
+            "from tin3").toArrow().to_pylist()
+        got = {(r["a"], r["k"]): r["r"] for r in rows}
+        assert got == {(1, 1): True,      # matched
+                       (5, 1): None,      # unmatched, set has NULL
+                       (None, 1): None,   # NULL probe, set non-empty
+                       (1, 2): False,     # unmatched, set all non-null
+                       (2, 3): False}     # empty set → false, not NULL
+
+    def test_correlated_not_in_value_position(self, spark, views):
+        rows = spark.sql(
+            "select a, k, a not in (select b from uin3 where ku = k) r "
+            "from tin3").toArrow().to_pylist()
+        got = {(r["a"], r["k"]): r["r"] for r in rows}
+        assert got == {(1, 1): False, (5, 1): None, (None, 1): None,
+                       (1, 2): True, (2, 3): True}
+
+
+class TestIntervalRegexpBreadth:
+    def test_interval_algebra(self, spark):
+        assert str(one(spark, "select timestamp '2020-01-01 00:00:00' "
+                              "+ interval '2' day * 3 r")) \
+            == "2020-01-07 00:00:00"
+        assert str(one(spark, "select timestamp '2020-01-02 00:00:00' "
+                              "- interval '1' day / 2 r")) \
+            == "2020-01-01 12:00:00"
+
+    def test_make_interval_family(self, spark):
+        assert str(one(spark, "select date '2020-01-01' + "
+                              "make_interval(0,1,0,2,0,0,0) r")) \
+            == "2020-02-03"
+        assert str(one(spark, "select timestamp '2020-01-01 00:00:00' + "
+                              "make_dt_interval(0, 1, 30, 15.5) r")) \
+            == "2020-01-01 01:30:15.500000"
+        assert str(one(spark, "select date '2020-03-31' + "
+                              "make_ym_interval(1, 1) r")) == "2021-04-30"
+
+    def test_regexp_family(self, spark):
+        assert one(spark, "select regexp_extract_all('a1b2c3', "
+                          "'([a-z])(\\\\d)', 1) r") == ["a", "b", "c"]
+        assert one(spark, "select regexp_extract_all('a1b2', "
+                          "'[a-z]\\\\d') r") == ["a1", "b2"]
+        assert one(spark, "select regexp_substr('abc', 'z') r") is None
+        assert one(spark, "select regexp_instr('abcdef', 'cd') r") == 3
+        assert one(spark, "select regexp_count('abab', 'ab') r") == 2
+        assert one(spark, "select regexp_like('abc', '^a') r") is True
+
+    def test_to_number(self, spark):
+        assert float(one(spark,
+                         "select to_number('-12.34', '99.99') r")) \
+            == -12.34
+        assert float(one(spark, "select try_to_number('$1,234.5', "
+                                "'$9,999.9') r")) == 1234.5
+        assert one(spark, "select try_to_number('bogus', '999') r") \
+            is None
