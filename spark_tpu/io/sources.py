@@ -40,6 +40,39 @@ class DataSource:
         return state
 
 
+class SupportsPushDownFilters:
+    """DSv2 pushdown mixin (reference: sql/catalyst connector/read/
+    SupportsPushDownFilters.java). Predicates arrive as the engine's
+    source-filter currency — (col, op, value) with op in
+    =,<,<=,>,>=,in — and the source returns (new_source, residual):
+    a clone that applies what it accepted plus the predicates the
+    ENGINE must still evaluate. Functional style (clone, don't mutate)
+    so plan caching and retries stay safe."""
+
+    def push_filters(self, predicates: list) -> tuple["DataSource", list]:
+        raise NotImplementedError
+
+
+class SupportsPushDownLimit:
+    """reference: SupportsPushDownLimit.java. Returns a clone applying
+    the PER-PARTITION limit, or None when it cannot."""
+
+    def push_limit(self, n: int) -> "DataSource | None":
+        raise NotImplementedError
+
+
+class SupportsPushDownAggregation:
+    """reference: SupportsPushDownAggregates.java. `groupings` is a list
+    of column names; `aggs` a list of (fn, col|None, out_name) with fn
+    in count/sum/min/max/avg (col None = count(*)). Returns a clone
+    whose scan yields the FINAL aggregated rows (columns named
+    groupings + out_names), or None to decline."""
+
+    def push_aggregation(self, groupings: list, aggs: list) \
+            -> "DataSource | None":
+        raise NotImplementedError
+
+
 UNKNOWN_PARTITION_VALUE = object()
 """Sentinel: a source cannot tell which partition-column value a split
 holds (DPP must then read the split)."""
@@ -434,12 +467,17 @@ class ORCSource(DataSource):
             else f.read_stripe(stripe)
 
 
-class JDBCSource(DataSource):
+class JDBCSource(DataSource, SupportsPushDownFilters,
+                 SupportsPushDownLimit, SupportsPushDownAggregation):
     """Database scan over a DB-API connection (reference:
     sqlx/datasources/jdbc/JDBCRDD.scala — column pruning and partitioned
     reads via `partitionColumn/lowerBound/upperBound/numPartitions`
-    WHERE-range predicates). URLs: `jdbc:sqlite:<path>` ships in-tree
-    (stdlib driver); other DB-API drivers plug in via `connector`."""
+    WHERE-range predicates; JDBCScanBuilder for the v2 pushdown SPI:
+    WHERE conjuncts, LIMIT, and whole-query aggregation all execute
+    REMOTELY in the database). URLs: `jdbc:sqlite:<path>` ships in-tree
+    (stdlib driver); other DB-API drivers plug in via `connector`.
+    `last_sql` records the most recent generated statement (tests
+    assert remote execution on it)."""
 
     name = "jdbc"
 
@@ -473,6 +511,10 @@ class JDBCSource(DataSource):
             lower_bound = upper_bound = None
         self.lower_bound, self.upper_bound = lower_bound, upper_bound
         self.estimated_rows = None
+        self._where: list[str] = []     # pushed WHERE conjuncts
+        self._limit: int | None = None  # pushed per-partition LIMIT
+        self._agg_sql: str | None = None
+        self.last_sql: str | None = None
 
     def _connect(self):
         if self._connector is not None:
@@ -501,9 +543,130 @@ class JDBCSource(DataSource):
     def num_partitions(self) -> int:
         return self.num_parts
 
-    def read_partition(self, i: int, columns=None) -> pa.Table:
+    # -- DSv2 pushdown SPI ----------------------------------------------
+    @staticmethod
+    def _sql_literal(v) -> str | None:
+        """SQL literal rendering; None = untranslatable (stays an
+        engine-side residual)."""
+        import math
+
+        if isinstance(v, str):
+            return "'" + v.replace("'", "''") + "'"
+        if isinstance(v, bool):
+            return "1" if v else "0"
+        if isinstance(v, int):
+            return str(v)
+        if isinstance(v, float):
+            if math.isnan(v) or math.isinf(v):
+                return None
+            return repr(v)
+        return None    # bytes, dates-as-objects, nested values …
+
+    @staticmethod
+    def _quote_ident(name: str) -> str:
+        return '"' + str(name).replace('"', '""') + '"'
+
+    def _clone(self) -> "JDBCSource":
+        import copy
+
+        c = copy.copy(self)
+        c.__dict__.pop("_device_cache", None)
+        c._where = list(self._where)
+        return c
+
+    def push_filters(self, predicates):
+        """Translatable predicates execute in the database. For the
+        in-tree sqlite driver remote comparison semantics are exact, so
+        consumed predicates leave no residual; a PLUGGABLE connector's
+        collation/comparison rules are unknown, so everything pushed is
+        ALSO returned as residual and the engine re-checks (the
+        conservative contract parquet's row-group stats use)."""
+        c = self._clone()
+        residual = []
+        for pred in predicates:
+            col, op, val = pred
+            if op == "in":
+                lits = [self._sql_literal(v) for v in val]
+                if any(x is None for x in lits):
+                    residual.append(pred)
+                    continue
+                c._where.append(
+                    f"{self._quote_ident(col)} IN ({', '.join(lits)})")
+            else:
+                lit = self._sql_literal(val)
+                if lit is None:
+                    residual.append(pred)
+                    continue
+                c._where.append(f"{self._quote_ident(col)} {op} {lit}")
+            if self._connector is not None:
+                residual.append(pred)   # pushed for IO, re-checked
+        return c, residual
+
+    def push_limit(self, n: int):
+        c = self._clone()
+        c._limit = n if self._limit is None else min(self._limit, n)
+        return c
+
+    def push_aggregation(self, groupings, aggs):
+        """Whole-query aggregation runs in the database; only for
+        single-partition scans (a range-split scan would aggregate each
+        split independently — wrong for non-decomposable finals). The
+        result schema derives statically from the source schema — no
+        probe query against the remote database at planning time."""
+        from ..types import IntegralType, StructField, float64, int64
+
+        if self.num_parts > 1 or self._limit is not None:
+            return None
+        out_names = [out for _, _, out in aggs]
+        if len(set(out_names) | set(groupings)) != \
+                len(out_names) + len(groupings):
+            return None     # name collision would fold columns silently
+        by_name = {f.name: f.dataType for f in self.schema.fields}
+        cols, fields = [], []
+        for g in groupings:
+            if g not in by_name:
+                return None
+            cols.append(self._quote_ident(g))
+            fields.append(StructField(str(g), by_name[g], True))
+        for fn, col, out in aggs:
+            if fn not in ("count", "sum", "min", "max", "avg"):
+                return None
+            if col is not None and col not in by_name:
+                return None
+            arg = "*" if col is None else self._quote_ident(col)
+            cols.append(f"{fn}({arg}) AS {self._quote_ident(out)}")
+            if fn == "count":
+                dt = int64
+            elif fn == "avg":
+                dt = float64
+            elif fn == "sum":
+                dt = int64 if isinstance(by_name[col], IntegralType) \
+                    else float64
+            else:
+                dt = by_name[col]
+            fields.append(StructField(str(out), dt, True))
+        sql = f"SELECT {', '.join(cols)} FROM {self.table}"
+        if self._where:
+            sql += " WHERE " + " AND ".join(self._where)
+        if groupings:
+            sql += " GROUP BY " + ", ".join(self._quote_ident(g)
+                                            for g in groupings)
+        from ..types import StructType
+
+        c = self._clone()
+        c._agg_sql = sql
+        c.num_parts = 1
+        c.schema = StructType(tuple(fields))
+        c.estimated_rows = None
+        return c
+
+    def generated_sql(self, i: int, columns=None) -> str:
+        """The exact statement partition `i` executes remotely."""
+        if self._agg_sql is not None:
+            return self._agg_sql
         proj = ", ".join(columns) if columns else "*"
         sql = f"SELECT {proj} FROM {self.table}"
+        clauses = list(self._where)
         if self.partition_column and self.num_parts > 1:
             lo, hi = self.lower_bound, self.upper_bound
             step = (hi - lo) / self.num_parts
@@ -511,13 +674,23 @@ class JDBCSource(DataSource):
             b = lo + step * (i + 1)
             c = self.partition_column
             if i == 0:
-                sql += f" WHERE {c} < {b} OR {c} IS NULL"
+                clauses.append(f"({c} < {b} OR {c} IS NULL)")
             elif i == self.num_parts - 1:
-                sql += f" WHERE {c} >= {a}"
+                clauses.append(f"{c} >= {a}")
             else:
-                sql += f" WHERE {c} >= {a} AND {c} < {b}"
+                clauses.append(f"({c} >= {a} AND {c} < {b})")
+        if clauses:
+            sql += " WHERE " + " AND ".join(clauses)
+        if self._limit is not None:
+            sql += f" LIMIT {self._limit}"
+        return sql
+
+    def read_partition(self, i: int, columns=None) -> pa.Table:
+        sql = self.generated_sql(i, columns)
+        self.last_sql = sql
         t = self._query(sql)
-        if columns is not None and t.column_names != list(columns):
+        if columns is not None and t.column_names != list(columns) and \
+                set(columns) <= set(t.column_names):
             t = t.select(list(columns))
         return t
 

@@ -131,6 +131,27 @@ class Planner:
             inner = node.child
             while isinstance(inner, L.SubqueryAlias):
                 inner = inner.child
+            from ..io.sources import SupportsPushDownFilters
+
+            if isinstance(inner, L.LogicalRelation) \
+                    and isinstance(inner.source, SupportsPushDownFilters) \
+                    and self.conf.get("spark.tpu.datasource.filterPushdown",
+                                      True):
+                # DSv2 pushdown negotiation: translatable conjuncts go
+                # to the source; it returns the residual it could NOT
+                # apply (V2ScanRelationPushDown role) — the engine keeps
+                # residuals + untranslatable conjuncts
+                mapped = [(c, d) for c, d in
+                          _source_predicates_mapped(conjuncts, inner.attrs)]
+                if mapped:
+                    src2, residual = inner.source.push_filters(
+                        [d for _, d in mapped])
+                    consumed = {id(c) for c, d in mapped
+                                if d not in residual}
+                    kept = [c for c in conjuncts if id(c) not in consumed]
+                    child = ScanExec(src2, list(inner.attrs), inner.name)
+                    return self._fuse_compute(
+                        kept, [a for a in node.child.output], child)
             if isinstance(inner, L.LogicalRelation) \
                     and hasattr(inner.source, "pruned") \
                     and self.conf.get("spark.sql.parquet.filterPushdown",
@@ -296,11 +317,112 @@ class Planner:
 
     # ------------------------------------------------------------------
     def _plan_aggregate(self, node: L.Aggregate) -> PhysicalPlan:
+        pushed = self._try_push_aggregate(node)
+        if pushed is not None:
+            return pushed
         child = self._convert(node.child)
 
         # 1. bind grouping keys to attributes
         group_keys, child = self._bind_keys(list(node.grouping_exprs), child,
                                             "__group")
+        return self._plan_aggregate_bound(node, child, group_keys)
+
+    def _fully_pushed_filter_scan(self, plan):
+        """If `plan` is (aliased) Filter over an (aliased) pushdown-
+        capable relation and EVERY conjunct translates with empty
+        residual, return (relation_node, pushed_source); else None.
+        Shared by the aggregate and limit composition paths."""
+        from ..io.sources import SupportsPushDownFilters
+
+        node = plan
+        while isinstance(node, L.SubqueryAlias):
+            node = node.child
+        if not isinstance(node, L.Filter):
+            return None
+        if not self.conf.get("spark.tpu.datasource.filterPushdown", True):
+            return None
+        inner = node.child
+        while isinstance(inner, L.SubqueryAlias):
+            inner = inner.child
+        if not isinstance(inner, L.LogicalRelation) or \
+                not isinstance(inner.source, SupportsPushDownFilters):
+            return None
+        conjs = split_conjuncts(node.condition)
+        mapped = _source_predicates_mapped(conjs, inner.attrs)
+        if len(mapped) != len(conjs):
+            return None
+        src2, residual = inner.source.push_filters(
+            [d for _, d in mapped])
+        if residual:
+            return None
+        return inner, src2
+
+    def _try_push_aggregate(self, node: L.Aggregate):
+        """DSv2 aggregation pushdown (SupportsPushDownAggregates role):
+        Aggregate over a bare scan whose groupings are plain columns and
+        whose aggregates are count/sum/min/max/avg over plain columns
+        executes ENTIRELY in the source; the node is replaced by a scan
+        of the aggregated result."""
+        from ..expr.expressions import (
+            Alias, Average, Count, Max, Min, Sum,
+        )
+        from ..io.sources import SupportsPushDownAggregation
+
+        inner = node.child
+        while isinstance(inner, L.SubqueryAlias):
+            inner = inner.child
+        filter_src = None
+        if isinstance(inner, L.Filter):
+            # aggregate over a FULLY-pushable filter composes remotely:
+            # WHERE ... GROUP BY ...
+            pushed = self._fully_pushed_filter_scan(inner)
+            if pushed is not None:
+                inner, filter_src = pushed
+        if not isinstance(inner, L.LogicalRelation) or \
+                not isinstance(inner.source, SupportsPushDownAggregation) \
+                or not self.conf.get("spark.tpu.datasource.aggPushdown",
+                                     True):
+            return None
+        names = {a.expr_id: a.name for a in inner.attrs}
+        if not all(isinstance(g, AttributeReference)
+                   and g.expr_id in names
+                   for g in node.grouping_exprs):
+            return None
+        fn_of = {Count: "count", Sum: "sum", Min: "min", Max: "max",
+                 Average: "avg"}
+        groupings = [names[g.expr_id] for g in node.grouping_exprs]
+        aggs, out_attrs = [], []
+        for e in node.aggregate_exprs:
+            if isinstance(e, AttributeReference) and \
+                    any(e.expr_id == g.expr_id
+                        for g in node.grouping_exprs):
+                out_attrs.append(e)
+                continue
+            if not (isinstance(e, Alias) and
+                    type(e.child) in fn_of):
+                return None
+            f = e.child
+            if getattr(f, "distinct", False):
+                return None
+            if f.child is None:
+                col = None
+            elif isinstance(f.child, AttributeReference) and \
+                    f.child.expr_id in names:
+                col = names[f.child.expr_id]
+            else:
+                return None
+            aggs.append((fn_of[type(f)], col, e.name))
+            out_attrs.append(e.to_attribute())
+        if not aggs:
+            return None
+        base = filter_src if filter_src is not None else inner.source
+        src2 = base.push_aggregation(groupings, aggs)
+        if src2 is None:
+            return None
+        return ScanExec(src2, out_attrs, f"{inner.name}:agg")
+
+    def _plan_aggregate_bound(self, node: L.Aggregate, child,
+                              group_keys) -> PhysicalPlan:
         group_map: list[tuple[Expression, AttributeReference]] = list(
             zip(node.grouping_exprs, group_keys))
 
@@ -417,6 +539,33 @@ class Planner:
                 gathered = ShuffleExchangeExec(SinglePartition(), local)
                 return LimitExec(node.n, SortExec(orders, gathered),
                                  offset=offset, is_global=True)
+        # DSv2 limit pushdown (SupportsPushDownLimit role): the source
+        # applies the per-partition limit remotely; the engine's limit
+        # stays above it as the global cut
+        scan_like = inner
+        pushed_filters = None
+        while isinstance(scan_like, L.SubqueryAlias):
+            scan_like = scan_like.child
+        if isinstance(scan_like, L.Filter):
+            # LIMIT over a FULLY-pushable filter composes remotely:
+            # WHERE ... LIMIT n (V2ScanRelationPushDown pushes filters
+            # before limits for exactly this reason)
+            pushed = self._fully_pushed_filter_scan(scan_like)
+            if pushed is not None:
+                scan_like, pushed_filters = pushed
+        if isinstance(scan_like, L.LogicalRelation):
+            from ..io.sources import SupportsPushDownLimit
+
+            base_src = pushed_filters or scan_like.source
+            if isinstance(base_src, SupportsPushDownLimit):
+                pushed = base_src.push_limit(node.n + offset)
+                if pushed is not None:
+                    child = ScanExec(pushed, list(scan_like.attrs),
+                                     scan_like.name)
+                    local = LimitExec(node.n + offset, child,
+                                      is_global=False)
+                    return LimitExec(node.n, local, offset=offset,
+                                     is_global=True)
         child = self._convert(inner)
         local = LimitExec(node.n + offset, child, is_global=False)
         return LimitExec(node.n, local, offset=offset, is_global=True)
@@ -586,6 +735,18 @@ class Planner:
         if changed:
             return plan.with_new_children(new_children)
         return plan
+
+
+def _source_predicates_mapped(conjuncts, attrs) -> list:
+    """Like _source_predicates but keeps the (conjunct, descriptor)
+    pairing so pushdown can tell which engine predicates a source
+    consumed (DataSourceStrategy.translateFilter + selectFilters)."""
+    out = []
+    for c in conjuncts:
+        descs = _source_predicates([c], attrs)
+        if len(descs) == 1:
+            out.append((c, descs[0]))
+    return out
 
 
 def _source_predicates(conjuncts, attrs) -> list:

@@ -47,6 +47,14 @@ class DataStreamReader:
                     "includeTimestamp", "false")).lower() == "true")
         elif fmt in ("parquet", "csv", "json"):
             src = FileStreamSource(path or self._options["path"], fmt)
+        elif fmt in ("segment-log", "segmentlog"):
+            # the Kafka-contract source (streaming/segment_log.py)
+            from .segment_log import SegmentLogSource
+
+            src = SegmentLogSource(
+                path or self._options["path"],
+                starting_offsets=str(self._options.get(
+                    "startingOffsets", "earliest")))
         else:
             raise AnalysisException(f"unknown streaming format {fmt}")
         return DataFrame(self.session, StreamingRelation(src))

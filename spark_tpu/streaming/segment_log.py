@@ -1,0 +1,183 @@
+"""Partitioned segment-log streaming source — the Kafka contract,
+offline.
+
+Role of the reference's Kafka connector (connector/kafka-0-10-sql/ —
+KafkaMicroBatchStream, KafkaOffsetReader, KafkaSourceOffset): a topic is
+a directory of per-partition append-only segment files; records are
+addressed by (partition, offset); consumers replay any offset range;
+partitions appear at any time and are discovered between batches (the
+rebalance-on-discovery shape); offsets serialize to JSON so the
+streaming checkpoint's offset WAL gives exactly-once delivery through
+the commit protocol.
+
+Layout:  <root>/partition=<p>/<base-offset 20 digits>.log
+Record:  one JSON object per line: {"k": key|null, "v": value,
+         "ts": epoch micros}
+Offsets: {"<partition>": next_offset} — string keys so a JSON
+         round-trip through the checkpoint compares equal.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import threading
+import time
+from typing import Any
+
+import pyarrow as pa
+
+from ..columnar.arrow import schema_from_arrow
+from .sources import StreamSource
+
+_SCHEMA = pa.schema([
+    ("key", pa.string()),
+    ("value", pa.string()),
+    ("partition", pa.int32()),
+    ("offset", pa.int64()),
+    ("timestamp", pa.timestamp("us")),
+])
+
+
+def _partition_dir(root: str, p: int) -> str:
+    return os.path.join(root, f"partition={p}")
+
+
+class SegmentLogWriter:
+    """Producer analog (KafkaProducer shape): appends records to a
+    partition's active segment, rolling at segment_max_records."""
+
+    def __init__(self, root: str, segment_max_records: int = 1000):
+        self.root = root
+        self.segment_max = segment_max_records
+        self._lock = threading.Lock()
+        # partition → (active segment path, base offset, records in it)
+        self._active: dict[int, tuple[str, int, int]] = {}
+        os.makedirs(root, exist_ok=True)
+
+    def _open_partition(self, p: int) -> tuple[str, int, int]:
+        pdir = _partition_dir(self.root, p)
+        os.makedirs(pdir, exist_ok=True)
+        segs = sorted(glob.glob(os.path.join(pdir, "*.log")))
+        if not segs:
+            return os.path.join(pdir, f"{0:020d}.log"), 0, 0
+        last = segs[-1]
+        base = int(os.path.basename(last)[:-4])
+        with open(last) as f:
+            n = sum(1 for _ in f)
+        return last, base, n
+
+    def send(self, partition: int, value: str, key: str | None = None,
+             timestamp_us: int | None = None) -> int:
+        """Append one record; returns its offset."""
+        with self._lock:
+            if partition not in self._active:
+                self._active[partition] = self._open_partition(partition)
+            path, base, n = self._active[partition]
+            if n >= self.segment_max:
+                base, n = base + n, 0
+                path = os.path.join(_partition_dir(self.root, partition),
+                                    f"{base:020d}.log")
+            off = base + n
+            rec = json.dumps({
+                "k": key, "v": value,
+                "ts": timestamp_us if timestamp_us is not None
+                else int(time.time() * 1e6)})
+            with open(path, "a") as f:
+                f.write(rec + "\n")
+            self._active[partition] = (path, base, n + 1)
+            return off
+
+
+class SegmentLogSource(StreamSource):
+    """Consumer analog: per-partition offset ranges, arbitrary replay,
+    partition discovery between batches."""
+
+    def __init__(self, root: str, starting_offsets: str = "earliest"):
+        self.root = root
+        self.schema = schema_from_arrow(_SCHEMA)
+        self.starting = starting_offsets
+        # (path, st_size) → record count; re-counted only on growth
+        self._count_cache: dict[tuple[str, int], int] = {}
+
+    # -- log introspection ----------------------------------------------
+    def _partitions(self) -> list[int]:
+        out = []
+        for d in glob.glob(os.path.join(self.root, "partition=*")):
+            try:
+                out.append(int(os.path.basename(d).split("=", 1)[1]))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    def _segments(self, p: int) -> list[tuple[int, str]]:
+        """[(base_offset, path)] sorted."""
+        segs = []
+        for f in glob.glob(os.path.join(_partition_dir(self.root, p),
+                                        "*.log")):
+            segs.append((int(os.path.basename(f)[:-4]), f))
+        return sorted(segs)
+
+    def _seg_count(self, path: str) -> int:
+        size = os.path.getsize(path)
+        key = (path, size)
+        n = self._count_cache.get(key)
+        if n is None:
+            with open(path) as f:
+                n = sum(1 for _ in f)
+            self._count_cache[key] = n
+        return n
+
+    def _end_offset(self, p: int) -> int:
+        segs = self._segments(p)
+        if not segs:
+            return 0
+        base, path = segs[-1]
+        return base + self._seg_count(path)
+
+    # -- StreamSource contract ------------------------------------------
+    def initial_offset(self) -> dict:
+        if self.starting == "latest":
+            return {str(p): self._end_offset(p)
+                    for p in self._partitions()}
+        if self.starting == "earliest":
+            return {}
+        # explicit JSON offsets: replay from arbitrary positions
+        # (KafkaSourceOffset shape)
+        return {str(k): int(v)
+                for k, v in json.loads(self.starting).items()}
+
+    def latest_offset(self) -> dict:
+        return {str(p): self._end_offset(p) for p in self._partitions()}
+
+    def get_batch(self, start: Any, end: dict) -> pa.Table:
+        start = start or {}
+        keys, vals, parts, offs, tss = [], [], [], [], []
+        for pk, hi in sorted(end.items()):
+            p = int(pk)
+            lo = int(start.get(pk, 0))  # new partition → from earliest
+            if hi <= lo:
+                continue
+            for base, path in self._segments(p):
+                n = self._seg_count(path)
+                if base + n <= lo or base >= hi:
+                    continue
+                with open(path) as f:
+                    for i, line in enumerate(f):
+                        off = base + i
+                        if off < lo or off >= hi:
+                            continue
+                        rec = json.loads(line)
+                        keys.append(rec.get("k"))
+                        vals.append(rec.get("v"))
+                        parts.append(p)
+                        offs.append(off)
+                        tss.append(int(rec.get("ts", 0)))
+        return pa.table({
+            "key": pa.array(keys, pa.string()),
+            "value": pa.array(vals, pa.string()),
+            "partition": pa.array(parts, pa.int32()),
+            "offset": pa.array(offs, pa.int64()),
+            "timestamp": pa.array(tss, pa.timestamp("us")),
+        })
