@@ -82,6 +82,8 @@ def _session(extra=None):
     conf = {
         "spark.tpu.batch.capacity": 1 << 24,
         "spark.sql.shuffle.partitions": 1,
+        # no per-operator profiling overhead in measured runs
+        "spark.tpu.ui.operatorMetrics": "false",
     }
     conf.update(extra or {})
     return TpuSession("bench", conf)

@@ -369,6 +369,18 @@ def dayofyear(c) -> Column:
     return Column(E.DayOfYear(_c(c)))
 
 
+def hour(c) -> Column:
+    return Column(E.Hour(_c(c)))
+
+
+def minute(c) -> Column:
+    return Column(E.Minute(_c(c)))
+
+
+def second(c) -> Column:
+    return Column(E.Second(_c(c)))
+
+
 def weekofyear(c) -> Column:
     return Column(E.WeekOfYear(_c(c)))
 

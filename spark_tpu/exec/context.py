@@ -57,6 +57,9 @@ class ExecContext:
     # session BlockManager when the query runs under one (device-pin
     # budget for scan caches; None in bare contexts/workers)
     block_manager: object = field(default=None, repr=False)
+    # id(physical node) → {rows, ms, calls} when per-operator SQLMetrics
+    # collection is on (ui/SparkPlanGraph role); None = no profiling
+    plan_metrics: dict | None = field(default=None, repr=False)
 
     @property
     def memory(self):

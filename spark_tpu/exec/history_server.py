@@ -131,6 +131,26 @@ class _Handler(BaseHTTPRequestHandler):
                 parts.append(f"<tr><td>{_esc(k)}</td>"
                              f"<td>{float(v) * 1000:.2f}</td></tr>")
             parts.append("</table>")
+        graph = e.get("plan_graph")
+        if graph:
+            # SparkPlanGraph role: indented operator tree with
+            # per-operator SQLMetrics (rows / inclusive ms) and the AQE
+            # re-plan annotations row
+            parts.append("<h2>Plan graph</h2><table>"
+                         "<tr><th style='text-align:left'>Operator</th>"
+                         "<th>rows</th><th>ms</th></tr>")
+            for nd in graph:
+                pad = "&nbsp;" * 4 * int(nd.get("depth") or 0)
+                rows = nd.get("rows")
+                ms = nd.get("ms")
+                detail = _esc(str(nd.get("detail") or ""))[:140]
+                parts.append(
+                    f"<tr><td style='text-align:left'>{pad}"
+                    f"<b>{_esc(nd.get('op') or '')}</b> "
+                    f"<span style='color:#888'>{detail}</span></td>"
+                    f"<td>{'' if rows is None else rows}</td>"
+                    f"<td>{'' if ms is None else ms}</td></tr>")
+            parts.append("</table>")
         metrics = e.get("metrics")
         if metrics:
             parts.append("<h2>Metrics</h2><table><tr><th>Metric</th>"

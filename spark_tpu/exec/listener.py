@@ -30,6 +30,9 @@ class QueryEvent:
     plan: str = ""
     error: str | None = None
     metrics: dict = field(default_factory=dict)
+    # executed-plan node list with per-operator rows/ms + AQE notes
+    # (SparkPlanGraph role; rendered by the live UI / history server)
+    plan_graph: list = field(default_factory=list)
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), default=str)

@@ -131,6 +131,21 @@ class QueryExecution:
                           metrics=self.session._metrics,
                           block_manager=getattr(
                               self.session, "block_manager", None))
+        if str(self.session.conf.get("spark.tpu.ui.operatorMetrics",
+                                     "true")).lower() == "true":
+            ctx.plan_metrics = {}
+            # stable metric keys BEFORE execution: the stage builder
+            # copies exchanges and their ancestors (with_new_children),
+            # and copies share __dict__, so a pre-assigned id survives
+            # into the executed objects where id() would not
+            for i, n in enumerate(self.physical.iter_nodes()):
+                n._metric_id = i
+            # AQE annotations are per-QUERY: baseline the session-level
+            # adaptive counters so plan_graph reports the delta
+            self._adaptive_baseline = {
+                k: v for k, v in ctx.metrics.snapshot()["counters"].items()
+                if k.startswith("adaptive.")}
+        self._last_ctx = ctx
         bus = getattr(self.session, "listener_bus", None)
         cluster = getattr(self.session, "_sql_cluster", None)
         if cluster is not None:
@@ -189,7 +204,8 @@ class QueryExecution:
                     duration_ms=(time.perf_counter() - t0) * 1000,
                     phases=dict(self.phase_times),
                     plan=self.physical.tree_string(),
-                    metrics=counters))
+                    metrics=counters,
+                    plan_graph=self.plan_graph()))
             return out
         except Exception as e:
             if bus is not None:
@@ -198,6 +214,50 @@ class QueryExecution:
                     duration_ms=(time.perf_counter() - t0) * 1000,
                     error=f"{type(e).__name__}: {e}"))
             raise
+
+    def plan_graph(self) -> list:
+        """The executed plan as a node list with per-operator SQLMetrics
+        and AQE annotations (role of sqlx/execution/ui/SparkPlanGraph.scala
+        — the UI renders this instead of re-parsing plan text)."""
+        ctx = getattr(self, "_last_ctx", None)
+        rec = getattr(ctx, "plan_metrics", None) or {}
+        nodes = []
+
+        def key_of(node):
+            k = getattr(node, "_metric_id", None)
+            return id(node) if k is None else k
+
+        def walk(node, depth):
+            m = rec.get(key_of(node), {})
+            nodes.append({
+                "id": key_of(node),
+                "depth": depth,
+                "op": type(node).__name__,
+                "detail": node.simple_string()
+                if hasattr(node, "simple_string") else "",
+                "rows": m.get("rows"),
+                "ms": round(m["ms"], 2) if "ms" in m else None,
+                "children": [key_of(c) for c in node.children],
+            })
+            for c in node.children:
+                walk(c, depth + 1)
+
+        walk(self.physical, 0)
+        # AQE re-plan annotations: THIS query's delta over the session
+        # counters (they are cumulative across queries)
+        annotations = []
+        if ctx is not None:
+            base = getattr(self, "_adaptive_baseline", {})
+            for k, v in ctx.metrics.snapshot()["counters"].items():
+                if k.startswith("adaptive."):
+                    d = v - base.get(k, 0)
+                    if d:
+                        annotations.append(f"{k} = {d}")
+        if annotations:
+            nodes.append({"id": 0, "depth": 0, "op": "AQE",
+                          "detail": "; ".join(annotations),
+                          "rows": None, "ms": None, "children": []})
+        return nodes
 
     def explain_string(self, mode: str = "formatted") -> str:
         parts = [

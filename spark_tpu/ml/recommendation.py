@@ -17,7 +17,8 @@ from .base import Estimator, Model, with_host_column
 class ALS(Estimator):
     _params = {"userCol": "user", "itemCol": "item", "ratingCol": "rating",
                "rank": 8, "maxIter": 10, "regParam": 0.1, "seed": 42,
-               "predictionCol": "prediction"}
+               "predictionCol": "prediction",
+               "implicitPrefs": False, "alpha": 1.0}
 
     def fit(self, df) -> "ALSModel":
         import jax
@@ -44,17 +45,38 @@ class ALS(Estimator):
         ie = jnp.asarray(i_idx)
         r = jnp.asarray(ratings)
 
+        implicit = bool(self.getOrDefault("implicitPrefs"))
+        alpha = float(self.getOrDefault("alpha"))
+
         def make_solver(n_out: int):
             """Batched ridge solve: for each output row, A = Σ ff^T + λI,
-            b = Σ rating·f over its edges (n_out is compile-time static)."""
+            b = Σ rating·f over its edges (n_out is compile-time static).
+
+            Implicit mode (reference: ALS.scala implicitPrefs; Hu,
+            Koren & Volinsky 2008): confidence c = 1 + α·r over observed
+            edges, preference p = 1; A = YᵀY + Σ (c−1)·ffᵀ + λI and
+            b = Σ c·f — the global YᵀY term stands in for the full
+            all-pairs sum, so the MXU does one [n,k]ᵀ[n,k] matmul
+            instead of nu×ni pair work."""
 
             @jax.jit
             def solve(fixed, edge_fixed, edge_out):
                 f = fixed[edge_fixed]                  # [m, k]
-                outer = f[:, :, None] * f[:, None, :]  # [m, k, k]
-                A = jax.ops.segment_sum(outer, edge_out, num_segments=n_out)
-                b = jax.ops.segment_sum(f * r[:, None], edge_out,
-                                        num_segments=n_out)
+                if implicit:
+                    cm1 = alpha * jnp.maximum(r, 0.0)  # confidence − 1
+                    outer = cm1[:, None, None] * \
+                        (f[:, :, None] * f[:, None, :])
+                    A = fixed.T @ fixed + \
+                        jax.ops.segment_sum(outer, edge_out,
+                                            num_segments=n_out)
+                    b = jax.ops.segment_sum((1.0 + cm1)[:, None] * f,
+                                            edge_out, num_segments=n_out)
+                else:
+                    outer = f[:, :, None] * f[:, None, :]  # [m, k, k]
+                    A = jax.ops.segment_sum(outer, edge_out,
+                                            num_segments=n_out)
+                    b = jax.ops.segment_sum(f * r[:, None], edge_out,
+                                            num_segments=n_out)
                 A = A + lam * jnp.eye(k)[None]
                 return jnp.linalg.solve(A, b[..., None])[..., 0]
 
@@ -73,7 +95,13 @@ class ALS(Estimator):
             for _ in range(int(self.getOrDefault("maxIter"))):
                 U = solve_users(V, ie, ue)
                 V = solve_items(U, ue, ie)
-            err = float(jnp.mean(jnp.abs((U[ue] * V[ie]).sum(1) - r)))
+            pred = (U[ue] * V[ie]).sum(1)
+            if implicit:
+                # implicit fits preference 1 with confidence weights
+                c = 1.0 + alpha * jnp.maximum(r, 0.0)
+                err = float(jnp.mean(c * (1.0 - pred) ** 2))
+            else:
+                err = float(jnp.mean(jnp.abs(pred - r)))
             if best is None or err < best[0]:
                 best = (err, U, V)
             if err < 1e-3:

@@ -26,13 +26,15 @@ class ParamGridBuilder:
 
 class CrossValidator(Estimator):
     _params = {"estimator": None, "estimatorParamMaps": (),
-               "evaluator": None, "numFolds": 3, "seed": 42}
+               "evaluator": None, "numFolds": 3, "seed": 42,
+               "parallelism": 1}
 
     def fit(self, df) -> "CrossValidatorModel":
         est = self.getOrDefault("estimator")
         grid = list(self.getOrDefault("estimatorParamMaps")) or [{}]
         ev = self.getOrDefault("evaluator")
         k = int(self.getOrDefault("numFolds"))
+        par = max(1, int(self.getOrDefault("parallelism")))
 
         table = df.toArrow()
         n = table.num_rows
@@ -40,19 +42,35 @@ class CrossValidator(Estimator):
         fold = rng.integers(0, k, n)
 
         session = df.session
-        avg_metrics = []
-        for params in grid:
-            scores = []
-            for f in range(k):
-                train = session.createDataFrame(
-                    table.filter(__import__("pyarrow").array(fold != f)))
-                test = session.createDataFrame(
-                    table.filter(__import__("pyarrow").array(fold == f)))
-                train._ml_features = getattr(df, "_ml_features", None)
-                test._ml_features = getattr(df, "_ml_features", None)
-                model = est.copy(params).fit(train)
-                scores.append(ev.evaluate(model.transform(test)))
-            avg_metrics.append(float(np.mean(scores)))
+        import pyarrow as pa
+
+        # pre-split once: every (params, fold) task shares the k splits
+        splits = []
+        for f in range(k):
+            train = session.createDataFrame(table.filter(pa.array(fold != f)))
+            test = session.createDataFrame(table.filter(pa.array(fold == f)))
+            train._ml_features = getattr(df, "_ml_features", None)
+            test._ml_features = getattr(df, "_ml_features", None)
+            splits.append((train, test))
+
+        def one(task):
+            params, (train, test) = task
+            model = est.copy(params).fit(train)
+            return ev.evaluate(model.transform(test))
+
+        tasks = [(params, split) for params in grid for split in splits]
+        if par > 1:
+            # reference: CrossValidator.parallelism fits param maps
+            # concurrently; each fit's device work is jit-compiled, so
+            # host threads overlap the python/solve phases
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(par) as pool:
+                scores = list(pool.map(one, tasks))
+        else:
+            scores = [one(t) for t in tasks]
+        avg_metrics = [float(np.mean(scores[i * k:(i + 1) * k]))
+                       for i in range(len(grid))]
 
         higher_better = ev.getOrDefault("metricName") not in (
             "rmse", "mse", "mae")

@@ -1,4 +1,4 @@
 from .frame import (  # noqa: F401
     DataFrame, Series, from_pandas, read_csv, read_parquet,
 )
-from .frame import concat, read_json, read_orc  # noqa: F401
+from .frame import concat, read_json, read_orc, to_datetime  # noqa: F401

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Any, Sequence
 
+import numpy as np
+
 import spark_tpu.api.functions as F
 from ..api.column import Column as EngineColumn
 from ..api.dataframe import DataFrame as EngineFrame
@@ -435,6 +437,287 @@ def _extend_frame():
 
 
 _extend_frame()
+
+
+# ---------------------------------------------------------------------------
+# r5 breadth (reference: python/pyspark/pandas — rolling/expanding
+# windows, groupby.apply, datetimes.py dt accessor, to_datetime,
+# MultiIndex through set_index/groupby keys)
+# ---------------------------------------------------------------------------
+
+class _Rolling:
+    """Positional rolling window (pyspark.pandas window.py Rolling).
+    Window semantics are row-positional, so the series materializes to
+    the host once and the reductions run as VECTORIZED numpy over a
+    sliding_window_view — no per-row Python loop."""
+
+    def __init__(self, s: "Series", window: int, min_periods=None):
+        self._s = s
+        self.window = int(window)
+        self.min_periods = self.window if min_periods is None \
+            else int(min_periods)
+
+    def _values(self):
+        return self._s.to_pandas().to_numpy(dtype=float, na_value=np.nan)
+
+    def _windows(self):
+        """[n, w] view: row i = the window ending at i (NaN-padded)."""
+        v = self._values()
+        w = min(self.window, max(len(v), 1))
+        padded = np.concatenate([np.full(w - 1, np.nan), v])
+        return v, np.lib.stride_tricks.sliding_window_view(padded, w)
+
+    def _gate(self, res, cnt):
+        return np.where(cnt >= self.min_periods, res, np.nan)
+
+    def _reduce(self, nanfn):
+        import warnings
+
+        v, win = self._windows()
+        cnt = (~np.isnan(win)).sum(axis=1)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)  # all-NaN
+            res = nanfn(win)
+        return self._host_series(self._gate(res, cnt))
+
+    def _host_series(self, values):
+        import pandas as pd
+
+        return pd.Series(values, name=self._s.name)
+
+    def sum(self):  # noqa: A003
+        return self._reduce(lambda w: np.nansum(w, axis=1))
+
+    def mean(self):
+        return self._reduce(lambda w: np.nanmean(w, axis=1))
+
+    def min(self):  # noqa: A003
+        return self._reduce(lambda w: np.nanmin(w, axis=1))
+
+    def max(self):  # noqa: A003
+        return self._reduce(lambda w: np.nanmax(w, axis=1))
+
+    def std(self):
+        return self._reduce(lambda w: np.nanstd(w, axis=1, ddof=1))
+
+    def count(self):
+        # pandas: count gates min_periods on window POSITIONS (NaN rows
+        # included), then counts the non-null ones
+        v, win = self._windows()
+        n = len(v)
+        cnt = (~np.isnan(win)).sum(axis=1).astype(float)
+        positions = np.minimum(np.arange(n) + 1, self.window)
+        gate = positions >= min(self.min_periods, self.window)
+        return self._host_series(np.where(gate, cnt, np.nan))
+
+
+class _Expanding(_Rolling):
+    """Expanding window: cumulative formulations (accumulate/cumsum),
+    never a materialized n×n window."""
+
+    def __init__(self, s: "Series", min_periods: int = 1):
+        super().__init__(s, 1 << 31, min_periods=min_periods)
+
+    def _cum(self):
+        v = self._values()
+        valid = ~np.isnan(v)
+        return v, valid, np.cumsum(valid)
+
+    def sum(self):  # noqa: A003
+        v, valid, cnt = self._cum()
+        return self._host_series(
+            self._gate(np.nancumsum(v), cnt))
+
+    def mean(self):
+        v, valid, cnt = self._cum()
+        with np.errstate(invalid="ignore", divide="ignore"):
+            res = np.nancumsum(v) / cnt
+        return self._host_series(self._gate(res, cnt))
+
+    def min(self):  # noqa: A003
+        v, valid, cnt = self._cum()
+        res = np.minimum.accumulate(np.where(valid, v, np.inf))
+        return self._host_series(self._gate(res, cnt))
+
+    def max(self):  # noqa: A003
+        v, valid, cnt = self._cum()
+        res = np.maximum.accumulate(np.where(valid, v, -np.inf))
+        return self._host_series(self._gate(res, cnt))
+
+    def std(self):
+        v, valid, cnt = self._cum()
+        s1 = np.nancumsum(v)
+        s2 = np.nancumsum(v * v)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            var = (s2 - s1 * s1 / cnt) / (cnt - 1)
+        res = np.sqrt(np.maximum(var, 0))
+        return self._host_series(
+            np.where(cnt >= max(self.min_periods, 2), res, np.nan))
+
+    def count(self):
+        v, valid, cnt = self._cum()
+        positions = np.arange(len(v)) + 1
+        return self._host_series(
+            np.where(positions >= self.min_periods,
+                     cnt.astype(float), np.nan))
+
+
+class _DtAccessor:
+    """Series.dt namespace (pyspark.pandas datetimes.py role)."""
+
+    def __init__(self, s: "Series"):
+        self._s = s
+
+    def _wrap(self, col):
+        return self._s._wrap(col)
+
+    @property
+    def year(self):
+        return self._wrap(F.year(self._s._col))
+
+    @property
+    def month(self):
+        return self._wrap(F.month(self._s._col))
+
+    @property
+    def day(self):
+        return self._wrap(F.dayofmonth(self._s._col))
+
+    @property
+    def hour(self):
+        return self._wrap(F.hour(self._s._col))
+
+    @property
+    def minute(self):
+        return self._wrap(F.minute(self._s._col))
+
+    @property
+    def second(self):
+        return self._wrap(F.second(self._s._col))
+
+    @property
+    def dayofweek(self):
+        # pandas: Monday=0; engine dayofweek: Sunday=1
+        return self._wrap((F.dayofweek(self._s._col) + F.lit(5)) % F.lit(7))
+
+    @property
+    def quarter(self):
+        return self._wrap(F.quarter(self._s._col))
+
+    @property
+    def date(self):
+        return self._wrap(self._s._col.cast("date"))
+
+
+def to_datetime(arg, format=None):  # noqa: A002
+    """ps.to_datetime: Series → timestamp column; anything else defers
+    to real pandas (host values)."""
+    import pandas as pd
+
+    if isinstance(arg, Series):
+        if format is None:
+            return arg._wrap(arg._col.cast("timestamp"))
+        # explicit format: host-parse via pandas, re-enter as a column
+        parsed = pd.to_datetime(arg.to_pandas(), format=format)
+        name = arg.name
+        frame = arg._frame
+        pdf = frame.to_pandas()
+        pdf[name + "__dt"] = parsed.to_numpy()
+        out = DataFrame(_session().createDataFrame(pdf))
+        return out[name + "__dt"]
+    return pd.to_datetime(arg, format=format)
+
+
+def _extend_frame_r5():
+    def set_index(self, keys) -> "DataFrame":
+        keys = [keys] if isinstance(keys, str) else list(keys)
+        out = DataFrame(self._df)
+        out._index_cols = keys
+        return out
+
+    def reset_index(self, drop: bool = False) -> "DataFrame":
+        idx = getattr(self, "_index_cols", None)
+        if drop and idx:
+            # pandas drops the former index entirely
+            keep = [c for c in self.columns if c not in idx]
+            out = DataFrame(self._df.select(*keep))
+        else:
+            out = DataFrame(self._df)
+        out._index_cols = None
+        return out
+
+    _orig_to_pandas = DataFrame.to_pandas
+
+    def to_pandas(self):
+        pdf = _orig_to_pandas(self)
+        idx = getattr(self, "_index_cols", None)
+        if idx:
+            pdf = pdf.set_index(idx if len(idx) > 1 else idx[0])
+        return pdf
+
+    DataFrame.set_index = set_index
+    DataFrame.reset_index = reset_index
+    DataFrame.to_pandas = to_pandas
+
+    def g_apply(self, fn):
+        """groupby(...).apply(fn): fn receives each group as a REAL
+        pandas DataFrame; results concat into a new frame
+        (pyspark.pandas groupby.apply → the grouped-map UDF shape)."""
+        import pandas as pd
+
+        pdf = self._frame._df.toPandas()
+        pieces = []
+        for key, grp in pdf.groupby(
+                self._keys if len(self._keys) > 1 else self._keys[0]):
+            r = fn(grp)
+            if isinstance(r, pd.DataFrame):
+                r = r.copy()
+                # re-attach grouping keys fn's result dropped (pandas
+                # carries them in the result index; columns here)
+                for k, v in zip(self._keys,
+                                key if isinstance(key, tuple) else (key,)):
+                    if k not in r.columns:
+                        r[k] = v
+                pieces.append(r)
+            elif isinstance(r, pd.Series):
+                row = r.to_frame().T
+                for k, v in zip(self._keys,
+                                key if isinstance(key, tuple) else (key,)):
+                    row[k] = v
+                pieces.append(row)
+            else:
+                row = {k: v for k, v in zip(
+                    self._keys,
+                    key if isinstance(key, tuple) else (key,))}
+                row["value"] = r
+                pieces.append(pd.DataFrame([row]))
+        merged = pd.concat(pieces, ignore_index=True)
+        return DataFrame(_session().createDataFrame(merged))
+
+    GroupBy.apply = g_apply
+
+    _orig_g_agg = GroupBy.agg
+
+    def g_agg(self, spec: dict) -> "DataFrame":
+        out = _orig_g_agg(self, spec)
+        # grouping keys become the (Multi)Index, like pandas
+        out._index_cols = list(self._keys)
+        return out
+
+    GroupBy.agg = g_agg
+
+    def rolling(self, window: int, min_periods=None):
+        return _Rolling(self, window, min_periods)
+
+    def expanding(self, min_periods: int = 1):
+        return _Expanding(self, min_periods)
+
+    Series.rolling = rolling
+    Series.expanding = expanding
+    Series.dt = property(_DtAccessor)
+
+
+_extend_frame_r5()
 
 
 def concat(frames) -> "DataFrame":

@@ -52,7 +52,48 @@ def attrs_schema(attrs: Sequence[AttributeReference]) -> StructType:
 
 
 class PhysicalPlan(TreeNode):
-    """Base physical operator."""
+    """Base physical operator.
+
+    Every subclass's `execute` is wrapped ONCE at class-creation time
+    with per-operator instrumentation (role of SQLMetrics,
+    sqlx/metric/SQLMetrics.scala: each SparkPlan carries rows/time
+    metrics the UI's plan graph renders). The wrapper is a no-op unless
+    the ExecContext carries a `plan_metrics` dict, so unprofiled runs
+    pay one attribute lookup."""
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        fn = cls.__dict__.get("execute")
+        if fn is None or getattr(fn, "_sql_metrics_wrapped", False):
+            return
+
+        import functools
+        import time as _time
+
+        @functools.wraps(fn)
+        def traced(self, ctx, *a, _orig=fn, **k):
+            rec = getattr(ctx, "plan_metrics", None)
+            if rec is None:
+                return _orig(self, ctx, *a, **k)
+            t0 = _time.perf_counter()
+            out = _orig(self, ctx, *a, **k)
+            ms = (_time.perf_counter() - t0) * 1000
+            key = getattr(self, "_metric_id", None)
+            if key is None:
+                key = id(self)
+            ent = rec.get(key)
+            if ent is None:
+                ent = rec[key] = {"rows": 0, "ms": 0.0, "calls": 0}
+            ent["ms"] += ms                 # inclusive (children counted)
+            ent["calls"] += 1
+            try:
+                ent["rows"] += sum(b.num_rows() for p in out for b in p)
+            except Exception:
+                pass                        # non-standard result shape
+            return out
+
+        traced._sql_metrics_wrapped = True
+        cls.execute = traced
 
     @property
     def output(self) -> list[AttributeReference]:
