@@ -72,6 +72,18 @@ class DataFrameReader:
 
         return self._df(TextSource(path), os.path.basename(path))
 
+    def avro(self, path: str):
+        from ..io.sources import AvroSource
+
+        return self._df(AvroSource(path), os.path.basename(path))
+
+    def xml(self, path: str, rowTag: str | None = None):
+        from ..io.sources import XMLSource
+
+        return self._df(XMLSource(
+            path, row_tag=rowTag or self._options.get("rowTag", "ROW")),
+            os.path.basename(path))
+
     def jdbc(self, url: str | None = None, table: str | None = None,
              **kw):
         url = url or self._options.get("url")
@@ -110,6 +122,10 @@ class DataFrameReader:
             return self.orc(path)
         if fmt == "text":
             return self.text(path)
+        if fmt == "avro":
+            return self.avro(path)
+        if fmt == "xml":
+            return self.xml(path)
         raise AnalysisException(f"unknown format {fmt}")
 
 
@@ -158,12 +174,19 @@ class DataFrameWriter:
     def orc(self, path: str) -> None:
         self._write_file_format(path, "orc")
 
+    def avro(self, path: str) -> None:
+        self._write_file_format(path, "avro")
+
     @staticmethod
     def _write_one(table: pa.Table, path: str, fmt: str) -> None:
         if fmt == "parquet":
             import pyarrow.parquet as pq
 
             pq.write_table(table, path)
+        elif fmt == "avro":
+            from ..io.avro import write_avro
+
+            write_avro(path, table)
         else:
             import pyarrow.orc as po
 

@@ -1,0 +1,229 @@
+"""SQL endpoint for external tools + DB-API client.
+
+Role of the reference's HiveThriftServer2
+(sql/hive-thriftserver/.../HiveThriftServer2.scala:149 + the
+SparkSQLOperationManager): a long-running server external tools connect
+to with plain SQL and get tabular results back — the JDBC/ODBC
+endpoint role. The wire protocol is newline-delimited JSON over TCP
+(one request object per line, one response object per line) instead of
+Thrift, and `spark_tpu.connect.sql_endpoint.connect()` provides a
+DB-API 2.0 connection/cursor so Python tools (and anything that speaks
+DB-API) can query the engine like any database:
+
+    conn = connect("127.0.0.1", port)
+    cur = conn.cursor()
+    cur.execute("select k, sum(v) from t group by k")
+    cur.fetchall()
+
+All connections share the ONE server session — SET commands and temp
+views are visible across clients, the same shared-SparkContext model
+the reference's thriftserver uses by default (per-connection config
+isolation would need session cloning; not implemented)."""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+from typing import Any
+
+
+def _json_cell(v) -> Any:
+    import datetime
+    import decimal
+
+    if isinstance(v, (datetime.datetime, datetime.date)):
+        return v.isoformat()
+    if isinstance(v, decimal.Decimal):
+        return str(v)
+    if isinstance(v, bytes):
+        return v.decode("utf-8", "replace")
+    return v
+
+
+class SQLEndpoint:
+    """JSON-lines SQL server over one engine session."""
+
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 0):
+        self.session = session
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        req = json.loads(line)
+                        resp = outer._run(req)
+                    except Exception as e:  # protocol-level failure
+                        resp = {"error": f"{type(e).__name__}: {e}"}
+                    self.wfile.write(
+                        (json.dumps(resp) + "\n").encode())
+                    self.wfile.flush()
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: threading.Thread | None = None
+
+    def _run(self, req: dict) -> dict:
+        sql = req.get("sql")
+        if not sql:
+            return {"error": "request must carry a 'sql' field"}
+        try:
+            out = self.session.sql(sql)
+            if out is None or not hasattr(out, "toArrow"):
+                return {"columns": [], "types": [], "rows": []}
+            t = out.toArrow()
+            cols = t.column_names
+            types = [str(c.type) for c in t.columns]
+            pylists = [c.to_pylist() for c in t.columns]
+            rows = [[_json_cell(v) for v in row]
+                    for row in zip(*pylists)] if cols else []
+            return {"columns": cols, "types": types, "rows": rows}
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def start(self) -> "SQLEndpoint":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="sql-endpoint")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# -- DB-API 2.0 client ------------------------------------------------------
+
+apilevel = "2.0"
+threadsafety = 1
+paramstyle = "format"
+
+
+class Error(Exception):
+    pass
+
+
+class Cursor:
+    def __init__(self, conn: "Connection"):
+        self._conn = conn
+        self.description = None
+        self.rowcount = -1
+        self._rows: list = []
+        self._pos = 0
+        self.arraysize = 1
+
+    def execute(self, sql: str, params=None) -> "Cursor":
+        if params:
+            # substitute ONLY %s placeholders — a literal % elsewhere in
+            # the SQL (LIKE 'a%') must not be treated as a format spec
+            parts = sql.split("%s")
+            if len(parts) - 1 != len(params):
+                raise Error(
+                    f"{len(params)} parameters for "
+                    f"{len(parts) - 1} %s placeholders")
+            out = [parts[0]]
+            for p, tail in zip(params, parts[1:]):
+                out.append(_sql_quote(p))
+                out.append(tail)
+            sql = "".join(out)
+        resp = self._conn._request({"sql": sql})
+        if resp.get("error"):
+            raise Error(resp["error"])
+        cols = resp.get("columns", [])
+        types = resp.get("types", [])
+        self.description = [(c, t, None, None, None, None, None)
+                            for c, t in zip(cols, types)] or None
+        self._rows = [tuple(r) for r in resp.get("rows", [])]
+        self.rowcount = len(self._rows)
+        self._pos = 0
+        return self
+
+    def fetchone(self):
+        if self._pos >= len(self._rows):
+            return None
+        row = self._rows[self._pos]
+        self._pos += 1
+        return row
+
+    def fetchmany(self, size=None):
+        size = size or self.arraysize
+        out = self._rows[self._pos:self._pos + size]
+        self._pos += len(out)
+        return out
+
+    def fetchall(self):
+        out = self._rows[self._pos:]
+        self._pos = len(self._rows)
+        return out
+
+    def close(self):
+        self._rows = []
+
+    def __iter__(self):
+        while True:
+            r = self.fetchone()
+            if r is None:
+                return
+            yield r
+
+
+def _sql_quote(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, str):
+        return "'" + v.replace("'", "''") + "'"
+    return str(v)
+
+
+class Connection:
+    def __init__(self, host: str, port: int, timeout: float = 60.0):
+        self._sock = socket.create_connection((host, port),
+                                              timeout=timeout)
+        self._file = self._sock.makefile("rwb")
+        self._lock = threading.Lock()
+
+    def _request(self, req: dict) -> dict:
+        with self._lock:
+            self._file.write((json.dumps(req) + "\n").encode())
+            self._file.flush()
+            line = self._file.readline()
+        if not line:
+            raise Error("server closed the connection")
+        return json.loads(line)
+
+    def cursor(self) -> Cursor:
+        return Cursor(self)
+
+    def commit(self) -> None:
+        pass        # autocommit semantics
+
+    def rollback(self) -> None:
+        raise Error("transactions are not supported")
+
+    def close(self) -> None:
+        try:
+            self._file.close()
+        finally:
+            self._sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def connect(host: str = "127.0.0.1", port: int = 10000,
+            timeout: float = 60.0) -> Connection:
+    return Connection(host, port, timeout)

@@ -1,0 +1,34 @@
+"""sparktpu-sqlserver entry point (HiveThriftServer2.main role)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="sparktpu-sqlserver")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=10000)
+    p.add_argument("--conf", action="append", default=[], metavar="K=V")
+    args = p.parse_args(argv)
+
+    from ..api.session import TpuSession
+    from .sql_endpoint import SQLEndpoint
+
+    conf = dict(kv.split("=", 1) for kv in args.conf if "=" in kv)
+    session = TpuSession("sqlserver", conf)
+    ep = SQLEndpoint(session, host=args.host, port=args.port).start()
+    print(json.dumps({"host": ep.host, "port": ep.port}), flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    ep.stop()
+    session.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

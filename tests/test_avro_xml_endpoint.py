@@ -1,0 +1,200 @@
+"""Avro/XML sources + the thriftserver-role SQL endpoint (reference:
+connector/avro/AvroFileFormat.scala, connector/xml XmlFileFormat,
+sql/hive-thriftserver HiveThriftServer2 + the JDBC/ODBC role)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+
+class TestAvro:
+    def _table(self):
+        return pa.table({
+            "i": pa.array([1, 2, None], pa.int64()),
+            "f": pa.array([1.5, None, -2.25], pa.float64()),
+            "s": pa.array(["a", "b''c", None], pa.string()),
+            "b": pa.array([True, None, False], pa.bool_()),
+        })
+
+    def test_roundtrip_codec(self, tmp_path):
+        from spark_tpu.io.avro import read_avro, write_avro
+
+        t = self._table()
+        for codec in ("null", "deflate"):
+            p = str(tmp_path / f"t_{codec}.avro")
+            write_avro(p, t, codec=codec)
+            back = read_avro(p)
+            assert back.to_pylist() == t.to_pylist()
+
+    def test_multi_block(self, tmp_path):
+        from spark_tpu.io.avro import read_avro, write_avro
+
+        n = 10_000
+        rng = np.random.default_rng(0)
+        t = pa.table({"x": rng.integers(0, 1 << 40, n),
+                      "y": rng.random(n)})
+        p = str(tmp_path / "big.avro")
+        write_avro(p, t, block_rows=512)
+        back = read_avro(p)
+        assert back.num_rows == n
+        assert back.column("x").to_pylist() == t.column("x").to_pylist()
+
+    def test_reader_writer_through_session(self, spark, tmp_path):
+        t = self._table()
+        df = spark.createDataFrame(t)
+        out = str(tmp_path / "sess.avro")
+        df.write.avro(out)
+        back = spark.read.format("avro").load(out)
+        assert sorted(map(str, back.toArrow().to_pylist())) == \
+            sorted(map(str, t.to_pylist()))
+        # SQL over the avro relation
+        back.createOrReplaceTempView("av")
+        n = spark.sql("select count(*) c from av where i is not null") \
+            .toArrow().to_pylist()[0]["c"]
+        assert n == 2
+
+    def test_date_timestamp_logical_types(self, tmp_path):
+        import datetime
+
+        from spark_tpu.io.avro import read_avro, write_avro
+
+        t = pa.table({
+            "d": pa.array([datetime.date(2020, 1, 2), None],
+                          pa.date32()),
+            "ts": pa.array([datetime.datetime(2021, 3, 4, 5, 6, 7,
+                                              500000), None],
+                           pa.timestamp("us")),
+        })
+        p = str(tmp_path / "lt.avro")
+        write_avro(p, t)
+        assert read_avro(p).to_pylist() == t.to_pylist()
+
+    def test_reversed_union_null_branch(self):
+        """A union written as [T, \"null\"] encodes null as branch 1 —
+        the reader must honor the actual index, not assume 0."""
+        import io as _io
+        import json
+
+        from spark_tpu.io import avro as A
+
+        raw = json.dumps({"type": "record", "name": "r", "fields": [
+            {"name": "x", "type": ["long", "null"]}]})
+        fts = A._field_types(raw)
+        assert fts[0].null_branch == 1
+        body = bytearray()
+        body += A._zigzag_encode(0)         # branch 0 = the value
+        A._encode_value(body, "long", 7)
+        body += A._zigzag_encode(1)         # branch 1 = null
+        b = _io.BytesIO(bytes(body))
+        vals = []
+        for _ in range(2):
+            br = A._zigzag_decode(b)
+            vals.append(None if br == fts[0].null_branch
+                        else A._decode_value(b, "long"))
+        assert vals == [7, None]
+
+    def test_corrupt_sync_detected(self, tmp_path):
+        from spark_tpu.io.avro import read_avro, write_avro
+
+        p = str(tmp_path / "c.avro")
+        write_avro(p, pa.table({"x": [1, 2, 3]}))
+        raw = bytearray(open(p, "rb").read())
+        raw[-1] ^= 0xFF     # flip a sync byte
+        open(p, "wb").write(bytes(raw))
+        with pytest.raises(ValueError, match="sync"):
+            read_avro(p)
+
+
+class TestXML:
+    def test_schema_spans_all_files(self, spark, tmp_path):
+        (tmp_path / "a.xml").write_text(
+            "<d><r><x>1</x></r></d>")
+        (tmp_path / "b.xml").write_text(
+            "<d><r><x>2</x><extra>late</extra></r></d>")
+        df = spark.read.format("xml").option("rowTag", "r") \
+            .load(str(tmp_path))
+        rows = df.toArrow().to_pylist()
+        assert {r.get("extra") for r in rows} == {None, "late"}
+
+    def test_like_percent_with_params(self, spark):
+        from spark_tpu.connect.sql_endpoint import SQLEndpoint, connect
+
+        spark.createDataFrame(pa.table({"s": ["abc", "xyz"],
+                                        "k": [1, 2]})) \
+            .createOrReplaceTempView("likep")
+        ep = SQLEndpoint(spark).start()
+        try:
+            with connect("127.0.0.1", ep.port) as c:
+                cur = c.cursor()
+                cur.execute("select s from likep where s like 'a%' "
+                            "and k = %s", (1,))
+                assert cur.fetchall() == [("abc",)]
+        finally:
+            ep.stop()
+
+    def test_read_rows(self, spark, tmp_path):
+        p = tmp_path / "books.xml"
+        p.write_text("""<catalog>
+          <book id="1"><title>Dune</title><price>9.99</price></book>
+          <book id="2"><title>Foundation</title><price>7.50</price></book>
+        </catalog>""")
+        df = spark.read.format("xml").option("rowTag", "book") \
+            .load(str(p))
+        rows = df.toArrow().to_pylist()
+        assert {r["title"] for r in rows} == {"Dune", "Foundation"}
+        assert {r["_id"] for r in rows} == {"1", "2"}
+        # strings cast downstream, like the reference's schema-less mode
+        df.createOrReplaceTempView("books")
+        s = spark.sql("select sum(cast(price as double)) s from books") \
+            .toArrow().to_pylist()[0]["s"]
+        assert abs(s - 17.49) < 1e-9
+
+
+class TestSQLEndpoint:
+    def test_dbapi_roundtrip(self, spark):
+        from spark_tpu.connect.sql_endpoint import SQLEndpoint, connect
+
+        spark.createDataFrame(pa.table({
+            "k": ["a", "a", "b"], "v": [1, 2, 5]})) \
+            .createOrReplaceTempView("ept")
+        ep = SQLEndpoint(spark).start()
+        try:
+            with connect("127.0.0.1", ep.port) as conn:
+                cur = conn.cursor()
+                cur.execute("select k, sum(v) as s from ept "
+                            "group by k order by k")
+                assert [d[0] for d in cur.description] == ["k", "s"]
+                assert cur.fetchall() == [("a", 3), ("b", 5)]
+                # parameters + fetchone/iteration
+                cur.execute("select * from ept where k = %s order by v",
+                            ("a",))
+                assert cur.fetchone() == ("a", 1)
+                assert list(cur) == [("a", 2)]
+                # errors surface as DB-API Error, connection stays alive
+                from spark_tpu.connect.sql_endpoint import Error
+
+                with pytest.raises(Error):
+                    cur.execute("select * from no_such_table")
+                cur.execute("select 1 one")
+                assert cur.fetchall() == [(1,)]
+        finally:
+            ep.stop()
+
+    def test_concurrent_clients(self, spark):
+        from concurrent.futures import ThreadPoolExecutor
+
+        from spark_tpu.connect.sql_endpoint import SQLEndpoint, connect
+
+        ep = SQLEndpoint(spark).start()
+        try:
+            def one(i):
+                with connect("127.0.0.1", ep.port) as c:
+                    cur = c.cursor()
+                    cur.execute(f"select {i} * 2 as r")
+                    return cur.fetchall()[0][0]
+
+            with ThreadPoolExecutor(4) as pool:
+                out = list(pool.map(one, range(8)))
+            assert out == [i * 2 for i in range(8)]
+        finally:
+            ep.stop()
