@@ -3619,6 +3619,31 @@ class Max(AggregateFunction):
         return self.child.dtype
 
 
+class BitAndAgg(AggregateFunction):
+    """bit_and(col) (reference: sqlcat/expressions/aggregate/
+    bitwiseAggregates.scala) — device bit-plane segment reduce.
+    Result keeps the input's integral type, like the reference."""
+
+    kind = "and"
+
+    @property
+    def dtype(self):
+        ct = self.child.dtype
+        if not isinstance(ct, IntegralType):
+            raise TypeCheckError(
+                f"bit_{self.kind} requires an integral column, got "
+                f"{ct.simple_string()}")
+        return ct
+
+
+class BitOrAgg(BitAndAgg):
+    kind = "or"
+
+
+class BitXorAgg(BitAndAgg):
+    kind = "xor"
+
+
 class Average(AggregateFunction):
     @property
     def dtype(self):

@@ -154,6 +154,9 @@ def _reg_all() -> None:
     r("skewness", AC.skewness)
     r("kurtosis", AC.kurtosis)
     r("approx_count_distinct", lambda c, *a: E.Count(c, distinct=True))
+    r("bit_and", lambda c: E.BitAndAgg(c))
+    r("bit_or", lambda c: E.BitOrAgg(c))
+    r("bit_xor", lambda c: E.BitXorAgg(c))
     # math
     r("abs", lambda c: E.Abs(c))
     r("sqrt", lambda c: E.Sqrt(c))

@@ -129,6 +129,42 @@ def seg_max(layout: GroupLayout, values: jnp.ndarray, valid=None):
     return m, cnt > 0
 
 
+def bitplane_reduce(values: jnp.ndarray, weights: jnp.ndarray,
+                    seg_ids: jnp.ndarray, num_segments: int, kind: str):
+    """bit_and / bit_or / bit_xor per segment (reference:
+    sqlcat/expressions/aggregate/bitwiseAggregates.scala). jax has no
+    bitwise segment reduce, so decompose into 64 bit PLANES and ride
+    ONE [cap, 64] segment_sum — then OR = plane sum > 0, AND = plane
+    sum == segment count, XOR = plane sum parity. Arithmetic shift on
+    int64 keeps two's-complement bit patterns exact for negatives.
+    Planes are int32 (counts < 2^31), halving the HBM transient vs a
+    naive int64 matrix. Shared by the sorted-segment, dense-range, and
+    ungrouped kernels."""
+    v = values.astype(jnp.int64)
+    shifts = jnp.arange(64, dtype=jnp.int64)
+    bits = ((v[:, None] >> shifts[None, :]) & 1).astype(jnp.int32)
+    bits = jnp.where(weights[:, None], bits, jnp.int32(0))
+    sums = jax.ops.segment_sum(bits, seg_ids, num_segments=num_segments)
+    cnt = jax.ops.segment_sum(weights.astype(jnp.int32), seg_ids,
+                              num_segments=num_segments)
+    if kind == "and":
+        plane = (sums == cnt[:, None]) & (cnt[:, None] > 0)
+    elif kind == "xor":
+        plane = (sums & 1) == 1
+    else:
+        plane = sums > 0
+    out = (plane.astype(jnp.int64) << shifts[None, :]).sum(axis=1)
+    return out, cnt > 0
+
+
+def seg_bitreduce(layout: GroupLayout, values: jnp.ndarray, valid=None,
+                  kind: str = "or"):
+    cap = values.shape[0]
+    v = jnp.take(values, layout.perm)
+    w = _weights(layout, valid)
+    return bitplane_reduce(v, w, layout.seg_ids, cap, kind)
+
+
 def seg_first(layout: GroupLayout, values: jnp.ndarray, valid=None):
     """First value per group in sorted order (the reference's First agg is
     also order-dependent)."""

@@ -30,11 +30,15 @@ from ..types import (
 PARTIAL_TO_MERGE = {
     "sum": "sum", "count": "sum", "countstar": "sum",
     "min": "min", "max": "max", "first": "first", "sumsq": "sum",
+    # bitwise reduces are associative — partials merge with themselves
+    "bitand": "bitand", "bitor": "bitor", "bitxor": "bitxor",
 }
 
 
 def _buffer_dtype(op: str, in_dtype: DataType | None) -> DataType:
     if op in ("count", "countstar"):
+        return int64
+    if op in ("bitand", "bitor", "bitxor"):
         return int64
     if op == "sumsq":
         return float64
@@ -92,6 +96,13 @@ def lower_aggregate_function(func: AggregateFunction, out_name: str,
         result = Divide(bs, bc)
         return AggSpec(func, child, ["sum", "count"], [bs, bc],
                        Alias(cast_if(result, func.dtype), out_name, out_id))
+    from ..expr.expressions import BitAndAgg
+
+    if isinstance(func, BitAndAgg):
+        op = "bit" + func.kind
+        b = battr(0, op)
+        return AggSpec(func, child, [op], [b],
+                       Alias(cast_if(b, func.dtype), out_name, out_id))
     if isinstance(func, First):
         b = battr(0, "first")
         return AggSpec(func, child, ["first"], [b], Alias(b, out_name, out_id))

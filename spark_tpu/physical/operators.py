@@ -424,6 +424,9 @@ def _group_kernel(num_keys: int, ops: tuple[str, ...], cap: int,
             elif op == "first":
                 f, has = G.seg_first(layout, vd, vv)
                 bufs.append((f, has))
+            elif op in ("bitand", "bitor", "bitxor"):
+                r, has = G.seg_bitreduce(layout, vd, vv, kind=op[3:])
+                bufs.append((r, has))
             else:
                 raise ValueError(op)
         out_mask = G.group_output_mask(layout)
@@ -506,6 +509,9 @@ def _dense_group_kernel(ops: tuple[str, ...], cap: int, out_cap: int,
                 fp = jax.ops.segment_min(p, seg, num_segments=out_cap)
                 has = fp < cap
                 bufs.append((jnp.take(vd, jnp.minimum(fp, cap - 1)), has))
+            elif op in ("bitand", "bitor", "bitxor"):
+                r, has = G.bitplane_reduce(vd, w, seg, out_cap, op[3:])
+                bufs.append((r, has))
             else:
                 raise ValueError(op)
 
@@ -550,6 +556,11 @@ def _ungrouped_kernel(ops: tuple[str, ...], cap: int,
                 pos = jnp.argmax(w)  # first True (0 if none)
                 has = jnp.any(w)
                 outs.append((vd[pos], has))
+            elif op in ("bitand", "bitor", "bitxor"):
+                w = row_mask if vv is None else (row_mask & vv)
+                seg0 = jnp.zeros(vd.shape[0], dtype=jnp.int32)
+                r, has = G.bitplane_reduce(vd, w, seg0, 1, op[3:])
+                outs.append((r[0], has[0]))
             else:
                 raise ValueError(op)
         # materialize as 1-row arrays of capacity out_cap

@@ -1,0 +1,4 @@
+CREATE OR REPLACE TEMP VIEW bitagg AS SELECT 1 g, 12 v UNION ALL SELECT 1, 10 UNION ALL SELECT 2, 5 UNION ALL SELECT 2, cast(null as int) UNION ALL SELECT 3, -1 UNION ALL SELECT 3, 6;
+SELECT g, bit_and(v) AS ba, bit_or(v) AS bo, bit_xor(v) AS bx FROM bitagg GROUP BY g ORDER BY g;
+SELECT bit_and(v) AS ba, bit_or(v) AS bo, bit_xor(v) AS bx FROM bitagg;
+SELECT bit_and(v) AS null_and FROM bitagg WHERE v IS NULL;
