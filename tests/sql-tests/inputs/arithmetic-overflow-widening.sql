@@ -1,0 +1,4 @@
+SELECT 9223372036854775807 AS max_long;
+SELECT 1e308 * 10 AS dbl_inf, -1e308 * 10 AS dbl_ninf;
+SELECT 0.1 + 0.2 AS point_three;
+SELECT cast(2147483647 as bigint) + 1 AS widened;

@@ -1,0 +1,5 @@
+SELECT filter(array(1, null, 3), x -> x > 1) AS f_keeps_matching;
+SELECT transform(array(1, null), x -> coalesce(x, -1)) AS t_null_elem;
+SELECT aggregate(array(1, null, 3), 0, (a, x) -> a + coalesce(x, 0)) AS agg_null_elem;
+SELECT exists(array(cast(null as int)), x -> x = 1) AS exists_only_null;
+SELECT forall(array(cast(null as int)), x -> x = 1) AS forall_only_null;

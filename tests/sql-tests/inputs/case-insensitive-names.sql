@@ -1,0 +1,4 @@
+CREATE OR REPLACE TEMP VIEW cin AS SELECT 1 AS MyCol, 'x' AS OTHER;
+SELECT mycol, other FROM cin;
+SELECT MYCOL + 1 AS bumped FROM cin;
+SELECT t.MyCol FROM cin t WHERE T.mycol = 1;

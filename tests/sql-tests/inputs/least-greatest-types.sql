@@ -1,0 +1,3 @@
+SELECT greatest(1, 2.5, 2) AS g_mixed, least(1, 2.5, 0.5) AS l_mixed;
+SELECT greatest(date '2020-01-01', date '2021-06-01') AS g_date;
+SELECT greatest('b', 'a', 'c') AS g_str, least('b', 'a', 'c') AS l_str;

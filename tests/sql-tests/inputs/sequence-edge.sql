@@ -1,0 +1,4 @@
+SELECT sequence(3, 3) AS single;
+SELECT sequence(-2, 2) AS crossing_zero;
+SELECT sequence(10, 4, -3) AS neg_step;
+SELECT size(sequence(0, 999)) AS thousand;

@@ -1,0 +1,5 @@
+SELECT repeat('ab', 0) AS r0, repeat('x', 5) AS r5;
+SELECT length(concat(repeat(' ', 3), 'x')) AS padded_len;
+SELECT reverse('') AS rev_empty, reverse('ab c') AS rev;
+SELECT substring('hello', 2, 3) AS sub, substring('hello', -3, 2) AS sub_neg;
+SELECT left('spark', 10) AS left_over, right('spark', 2) AS r2;
