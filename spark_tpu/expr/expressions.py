@@ -3619,6 +3619,19 @@ class Max(AggregateFunction):
         return self.child.dtype
 
 
+class Mode(AggregateFunction):
+    """mode(col) — most frequent non-null value (reference:
+    sqlcat/expressions/aggregate/Mode.scala). Never lowered directly:
+    the optimizer rewrites it into count-per-value + max-count join +
+    min-value tie-break (RewriteModeAggregate), so it runs on the same
+    device segment kernels as every other aggregate. Deterministic on
+    ties (smallest value), where the reference is unspecified."""
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+
 class BitAndAgg(AggregateFunction):
     """bit_and(col) (reference: sqlcat/expressions/aggregate/
     bitwiseAggregates.scala) — device bit-plane segment reduce.

@@ -157,6 +157,7 @@ def _reg_all() -> None:
     r("bit_and", lambda c: E.BitAndAgg(c))
     r("bit_or", lambda c: E.BitOrAgg(c))
     r("bit_xor", lambda c: E.BitXorAgg(c))
+    r("mode", lambda c: E.Mode(c))
     # math
     r("abs", lambda c: E.Abs(c))
     r("sqrt", lambda c: E.Sqrt(c))

@@ -2,3 +2,5 @@ CREATE OR REPLACE TEMP VIEW bitagg AS SELECT 1 g, 12 v UNION ALL SELECT 1, 10 UN
 SELECT g, bit_and(v) AS ba, bit_or(v) AS bo, bit_xor(v) AS bx FROM bitagg GROUP BY g ORDER BY g;
 SELECT bit_and(v) AS ba, bit_or(v) AS bo, bit_xor(v) AS bx FROM bitagg;
 SELECT bit_and(v) AS null_and FROM bitagg WHERE v IS NULL;
+SELECT g, mode(v) AS m FROM bitagg GROUP BY g ORDER BY g;
+SELECT mode(v) AS overall_mode FROM bitagg;

@@ -178,3 +178,47 @@ class TestIntervalRegexpBreadth:
                                 "'$9,999.9') r")) == 1234.5
         assert one(spark, "select try_to_number('bogus', '999') r") \
             is None
+
+
+class TestModeAggregate:
+    def test_mode_grouped_tiebreak_nulls(self, spark):
+        spark.sql(
+            "create or replace temp view modet as "
+            "select 1 g, 5 v union all select 1, 5 union all "
+            "select 1, 9 union all select 2, 7 union all select 2, 8 "
+            "union all select 3, cast(null as int) "
+            "union all select 3, cast(null as int)")
+        r = spark.sql("select g, mode(v) m from modet group by g "
+                      "order by g").toArrow().to_pylist()
+        # g=2 ties 7/8 -> deterministic smallest; all-null group -> NULL
+        assert r == [{"g": 1, "m": 5}, {"g": 2, "m": 7},
+                     {"g": 3, "m": None}]
+        assert spark.sql("select mode(v) m from modet")             .toArrow().to_pylist()[0]["m"] == 5
+
+    def test_mode_strings(self, spark):
+        spark.sql(
+            "create or replace temp view modes as "
+            "select 'a' s union all select 'b' union all select 'b'")
+        assert spark.sql("select mode(s) m from modes")             .toArrow().to_pylist()[0]["m"] == "b"
+
+    def test_mode_null_grouping_key(self, spark):
+        spark.sql(
+            "create or replace temp view moden as "
+            "select cast(null as int) g, 4 v union all "
+            "select cast(null as int), 4 union all "
+            "select cast(null as int), 9 union all select 1, 7")
+        r = spark.sql("select g, mode(v) m from moden group by g "
+                      "order by g nulls first").toArrow().to_pylist()
+        assert r == [{"g": None, "m": 4}, {"g": 1, "m": 7}]
+
+    def test_mode_aliased_group_and_nested_expr(self, spark):
+        spark.sql(
+            "create or replace temp view modex as "
+            "select 1 g, 5 v union all select 1, 5 union all "
+            "select 1, 9 union all select 2, 7 union all select 2, 8")
+        r = spark.sql("select g as h, mode(v) m from modex group by g "
+                      "order by h").toArrow().to_pylist()
+        assert r == [{"h": 1, "m": 5}, {"h": 2, "m": 7}]
+        r2 = spark.sql("select g, mode(v) + 1 m from modex group by g "
+                       "order by g").toArrow().to_pylist()
+        assert [x["m"] for x in r2] == [6, 8]
