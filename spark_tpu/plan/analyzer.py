@@ -111,8 +111,10 @@ class DeduplicateRelations(Rule):
     (reference: Analyzer DeduplicateRelations)."""
 
     def apply(self, plan: LogicalPlan) -> LogicalPlan:
+        from .logical import UsingJoin
+
         def rule(node):
-            if isinstance(node, Join):
+            if isinstance(node, (Join, UsingJoin)):
                 try:
                     left_ids = {a.expr_id for a in node.left.output}
                     right_ids = {a.expr_id for a in node.right.output}
@@ -122,12 +124,8 @@ class DeduplicateRelations(Rule):
                 if overlap:
                     mapping: dict[int, AttributeReference] = {}
                     new_right = _remap_plan(node.right, mapping, overlap)
-                    cond = node.condition
-                    if cond is not None:
-                        # references in the condition that pointed at the old
-                        # right attrs are ambiguous pre-resolution; leave
-                        # unresolved names alone (they resolve later)
-                        pass
+                    # any resolved condition references re-resolve later;
+                    # a UsingJoin builds its condition after this remap
                     return node.copy(right=new_right)
             return node
 
@@ -1115,6 +1113,79 @@ def _check_agg_expr(e: Expression, grouping_ids: set[int], agg: Aggregate):
     ok(e.child if isinstance(e, Alias) else e, False)
 
 
+class ResolveUsingJoin(Rule):
+    """JOIN USING (c1, …) → equi Join + a projection emitting each
+    using column once (reference: Analyzer.commonNaturalJoinProcessing):
+    inner/left take the LEFT side's column, right_outer the RIGHT's,
+    full_outer coalesces both; semi/anti keep the bare left output."""
+
+    def __init__(self, case_sensitive: bool = False):
+        self.cs = case_sensitive
+
+    def apply(self, plan):
+        from ..expr.expressions import And, Coalesce
+        from .logical import UsingJoin
+
+        def find(attrs, name):
+            matches = [a for a in attrs
+                       if a.name == name or (
+                           not self.cs
+                           and a.name.lower() == name.lower())]
+            if len({a.expr_id for a in matches}) > 1:
+                raise AnalysisException(
+                    f"USING column `{name}` is ambiguous",
+                    error_class="AMBIGUOUS_REFERENCE")
+            if not matches:
+                raise AnalysisException(
+                    f"USING column {name} not found among "
+                    f"[{', '.join(a.name for a in attrs)}]")
+            return matches[0]
+
+        def rule(node):
+            if not isinstance(node, UsingJoin) or \
+                    not (node.left.resolved and node.right.resolved):
+                return node
+            try:
+                lout = node.left.output
+                rout = node.right.output
+            except AnalysisException:
+                return node     # children await alias resolution
+            lats = [find(lout, c) for c in node.using_cols]
+            rats = [find(rout, c) for c in node.using_cols]
+            cond = None
+            for la, ra in zip(lats, rats):
+                c = EqualTo(la, ra)
+                cond = c if cond is None else And(cond, c)
+            joined = Join(node.left, node.right, node.join_type, cond)
+            jt = joined.join_type
+            if jt in ("left_semi", "left_anti"):
+                return joined
+            # project the JOIN's output attrs (null-padded sides carry
+            # nullable=True there — the raw children's attrs would lie
+            # to nullability-driven rewrites downstream). Deviation from
+            # the reference: the dropped right-side key is NOT kept as a
+            # hidden attribute, so `r.k` after USING (k) is unresolvable
+            # (Spark's hiddenOutput keeps it addressable).
+            by_id = {a.expr_id: a for a in joined.output}
+            jl = [by_id[a.expr_id] for a in lats]
+            jr = [by_id[a.expr_id] for a in rats]
+            if jt == "right_outer":
+                keys: list[Expression] = list(jr)
+            elif jt == "full_outer":
+                keys = [Alias(Coalesce([la, ra]), la.name)
+                        for la, ra in zip(jl, jr)]
+            else:
+                keys = list(jl)
+            drop = {a.expr_id for a in lats} | {a.expr_id for a in rats}
+            rest = [by_id[a.expr_id] for a in node.left.output
+                    if a.expr_id not in drop] + \
+                   [by_id[a.expr_id] for a in node.right.output
+                    if a.expr_id not in drop]
+            return Project(keys + rest, joined)
+
+        return plan.transform_up(rule)
+
+
 class FoldIntervalArithmetic(Rule):
     """Interval–interval and interval–numeric arithmetic folds to one
     IntervalLiteral (reference: intervalExpressions.scala MultiplyInterval
@@ -1179,6 +1250,7 @@ class Analyzer(RuleExecutor):
             Batch("Resolution", FixedPoint(50), [
                 ResolveRelations(self.catalog),
                 DeduplicateRelations(),
+                ResolveUsingJoin(cs),
                 ResolveReferences(cs),
                 ResolveGroupByAlias(cs),
                 ResolveSubqueries(self),

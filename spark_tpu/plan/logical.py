@@ -441,6 +441,31 @@ def normalize_join_type(jt: str) -> str:
     return mapping[s]
 
 
+class UsingJoin(BinaryNode):
+    """JOIN ... USING (c1, ...) before resolution (reference: the
+    UsingJoin hint consumed by Analyzer.commonNaturalJoinProcessing).
+    ResolveUsingJoin rewrites it into an equi Join + a projection that
+    emits each using column ONCE."""
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 join_type: str, using_cols: list):
+        self.left = left
+        self.right = right
+        self.join_type = normalize_join_type(join_type)
+        self.using_cols = list(using_cols)
+
+    @property
+    def resolved(self):
+        return False    # always rewritten by ResolveUsingJoin
+
+    @property
+    def output(self):
+        from ..errors import AnalysisException
+
+        raise AnalysisException(
+            f"unresolved USING join on {self.using_cols}")
+
+
 class Join(BinaryNode):
     def __init__(self, left: LogicalPlan, right: LogicalPlan, join_type: str,
                  condition: Expression | None):

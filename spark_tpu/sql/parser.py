@@ -629,9 +629,9 @@ class Parser:
                     using.append(self.ident())
                 self.expect_op(")")
             if using is not None:
-                raise ParseException("JOIN USING not yet supported in SQL; "
-                                     "use ON")
-            left = L.Join(left, right, jt, cond)
+                left = L.UsingJoin(left, right, jt, using)
+            else:
+                left = L.Join(left, right, jt, cond)
 
     def _join_type(self) -> str | None:
         if self.eat_kw("cross"):

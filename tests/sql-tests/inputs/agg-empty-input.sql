@@ -1,0 +1,5 @@
+CREATE OR REPLACE TEMP VIEW aei AS SELECT 1 v WHERE 1 = 0;
+SELECT count(*) c, count(v) cv FROM aei;
+SELECT sum(v) s, avg(v) a, min(v) mn, max(v) mx FROM aei;
+SELECT count(*) c FROM aei GROUP BY v;
+SELECT sum(v) s FROM aei HAVING count(*) > 0;

@@ -1,0 +1,4 @@
+SELECT 1 = 1.0 AS int_dbl, '1' = 1 AS str_int_coerce;
+SELECT 1 < 1.5 AS lt_mixed, 2 >= 2.0 AS ge_mixed;
+SELECT cast(1 as bigint) = cast(1 as int) AS long_int;
+SELECT date '2020-01-01' < timestamp '2020-01-01 00:00:01' AS date_ts;

@@ -1,0 +1,6 @@
+SELECT array(array(1, 2), array(3)) AS aa;
+SELECT flatten(array(array(1, 2), array(3))) AS flat;
+SELECT size(array(array(1), array(2, 3))) AS outer_size;
+SELECT element_at(array(array(10), array(20, 30)), 2) AS second_inner;
+SELECT map_values(map('a', array(1, 2))) AS map_of_arrays;
+SELECT transform(array(array(1,2), array(3)), x -> size(x)) AS sizes;

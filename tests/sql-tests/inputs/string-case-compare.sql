@@ -1,0 +1,4 @@
+SELECT 'abc' = 'ABC' AS exact, upper('abc') = 'ABC' AS upper_eq;
+SELECT 'a' < 'b' AS lt, 'abc' < 'abd' AS lt2, 'Z' < 'a' AS ascii_order;
+SELECT initcap('wORLD of SQL') AS ic, lower('MiXeD') AS lo;
+SELECT length('héllo') AS unicode_len, upper('héllo') AS unicode_upper;

@@ -222,3 +222,46 @@ class TestModeAggregate:
         r2 = spark.sql("select g, mode(v) + 1 m from modex group by g "
                        "order by g").toArrow().to_pylist()
         assert [x["m"] for x in r2] == [6, 8]
+
+
+class TestUsingJoin:
+    @pytest.fixture()
+    def views(self, spark):
+        spark.sql("create or replace temp view uja as "
+                  "select 1 id, 'a' t union all select 2, 'b' "
+                  "union all select 3, 'c'")
+        spark.sql("create or replace temp view ujb as "
+                  "select 2 id, 'x' u union all select 3, 'y' "
+                  "union all select 4, 'z'")
+
+    def test_all_join_types(self, spark, views):
+        inner = spark.sql("select * from uja join ujb using (id) "
+                          "order by id").toArrow()
+        assert inner.column_names == ["id", "t", "u"]
+        assert [r["id"] for r in inner.to_pylist()] == [2, 3]
+        full = spark.sql("select * from uja full join ujb using (id) "
+                         "order by id").toArrow().to_pylist()
+        assert [r["id"] for r in full] == [1, 2, 3, 4]
+        assert full[0]["u"] is None and full[3]["t"] is None
+        anti = spark.sql("select t from uja left anti join ujb "
+                         "using (id)").toArrow().to_pylist()
+        assert anti == [{"t": "a"}]
+
+    def test_self_join_using_dedups_ids(self, spark):
+        spark.sql("create or replace temp view ujs as "
+                  "select 1 k, 'a' v union all select 2, 'b' "
+                  "union all select cast(null as int), 'c'")
+        n = spark.sql("select count(*) c from ujs a join ujs b "
+                      "using (k)").toArrow().to_pylist()[0]["c"]
+        assert n == 2      # NULL keys never match; no cross-join blowup
+        got = spark.sql("select a.v x, b.v y from ujs a join ujs b "
+                        "using (k) order by x").toArrow().to_pylist()
+        assert got == [{"x": "a", "y": "a"}, {"x": "b", "y": "b"}]
+
+    def test_outer_using_nullability(self, spark):
+        spark.sql("create or replace temp view ujl as select 0 id "
+                  "union all select 1 union all select 2")
+        spark.sql("create or replace temp view ujr as "
+                  "select 2 id, 20 y union all select 4, 40")
+        sch = spark.sql("select * from ujl left join ujr using (id)")             .schema
+        assert [f for f in sch if f.name == "y"][0].nullable is True
