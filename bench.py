@@ -34,6 +34,15 @@ import numpy as np
 # every dataset 100×. The driver runs at 1.0 on the real chip.
 SCALE = float(os.environ.get("SPARK_TPU_BENCH_SCALE", "1.0"))
 
+# --smoke: functional gate, not a perf number. Tiny scales, forced-CPU,
+# single timed run; asserts the whole suite executes (rc=0) and emits
+# kernel-launch counts so dispatch-count regressions surface in CI
+# (tests/test_bench_smoke.py runs this in the tier-1 pass).
+SMOKE = "--smoke" in sys.argv
+if SMOKE:
+    sys.argv = [a for a in sys.argv if a != "--smoke"]
+    SCALE = min(SCALE, 0.002)
+
 
 def _device_init_alive(timeout: float = 30.0) -> bool:
     """Single source of truth: __graft_entry__.accelerator_healthy (probes
@@ -86,6 +95,9 @@ def _session(extra=None):
         "spark.tpu.ui.operatorMetrics": "false",
     }
     conf.update(extra or {})
+    if SMOKE:
+        conf["spark.tpu.batch.capacity"] = min(
+            int(conf["spark.tpu.batch.capacity"]), 1 << 18)
     return TpuSession("bench", conf)
 
 
@@ -132,7 +144,26 @@ def _run_blocked(df) -> float:
 
 def _best_of(fn, n=5):
     fn()  # warm-up: upload + compile
+    if SMOKE:
+        n = 1
     return min(fn() for _ in range(n))
+
+
+def _kernel_counters():
+    from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE
+
+    return GLOBAL_KERNEL_CACHE.counters()
+
+
+def _attach_kernel_delta(rec, before):
+    """Per-config kernel dispatch/compile evidence: a fusion regression
+    shows up as a launch-count jump before it shows up as wall-clock."""
+    after = _kernel_counters()
+    rec["kernel_launches"] = after["kernel_cache.launches"] \
+        - before["kernel_cache.launches"]
+    rec["kernel_compiles"] = after["kernel_cache.misses"] \
+        - before["kernel_cache.misses"]
+    return rec
 
 
 # --------------------------------------------------------------------------
@@ -378,7 +409,9 @@ def _fallback_to_cpu_child() -> int:
 def main() -> int:
     t_start = time.monotonic()
     is_child = os.environ.get("SPARK_TPU_BENCH_CHILD") == "1"
-    if not is_child and not _device_init_alive(30):
+    if SMOKE:
+        is_child = True  # functional gate: forced-CPU, no device probe
+    elif not is_child and not _device_init_alive(30):
         return _fallback_to_cpu_child()
 
     import jax
@@ -387,7 +420,8 @@ def main() -> int:
         jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
 
-    only = sys.argv[1:] or list(CONFIGS)
+    default = [c for c in CONFIGS if not (SMOKE and c == "tpcds")]
+    only = sys.argv[1:] or default
     records, failed = [], []
     for name in only:
         remaining = _SUITE_BUDGET_S - (time.monotonic() - t_start)
@@ -396,6 +430,7 @@ def main() -> int:
             _emit({"metric": f"{name} SKIPPED (suite budget exhausted)",
                    "value": 0, "unit": "error", "vs_baseline": 0.0})
             continue
+        kc_before = _kernel_counters()
         try:
             r = _with_timeout(CONFIGS[name],
                               int(min(_CONFIG_TIMEOUT_S, remaining)))
@@ -406,7 +441,10 @@ def main() -> int:
                    "vs_baseline": 0.0,
                    "error": f"{type(e).__name__}: {e}"[:400]})
             continue
-        for rec in (r if isinstance(r, list) else [r]):
+        recs = r if isinstance(r, list) else [r]
+        if recs:
+            _attach_kernel_delta(recs[0], kc_before)
+        for rec in recs:
             if SCALE != 1.0:
                 # scaled smoke runs compare against full-scale reference
                 # numbers — flag the ratio as not meaningful

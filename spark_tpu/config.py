@@ -154,6 +154,36 @@ STATE_STORE_PARTITIONS = _register(ConfigEntry(
     "partitions (reference: per-partition StateStore instances, "
     "sqlx/streaming/state/StateStore.scala:285).", int))
 
+FUSION_ENABLED = _register(ConfigEntry(
+    "spark.tpu.fusion.enabled", True,
+    "Whole-stage kernel fusion: collapse each exchange-free chain of "
+    "fusable operators (filter/project feeding a partial aggregate, limit, "
+    "or hash-join probe) into ONE jitted program per batch "
+    "(reference: WholeStageCodegenExec produce/consume splicing, "
+    "sqlx/WholeStageCodegenExec.scala:673). Off = operator-at-a-time "
+    "execution, kept as the differential-testing oracle.", _bool))
+
+PARTITION_PARALLELISM = _register(ConfigEntry(
+    "spark.tpu.exec.partitionParallelism", 0,
+    "Concurrent partition-dispatch lanes inside an operator (async XLA "
+    "dispatch pipelines across partitions instead of serial list "
+    "comprehensions). 0 = auto (min(4, cpus)); 1 = serial.", int))
+
+FUSION_MIN_ROWS = _register(ConfigEntry(
+    "spark.tpu.fusion.minRows", 1 << 17,
+    "Partition tile-capacity floor for running the whole-stage FUSED "
+    "kernel. A fused program is compiled per (stage structure, signature, "
+    "capacity) while the operator-at-a-time kernels are shared across "
+    "query structures — below this many rows the XLA compile costs more "
+    "than the dispatches it saves, so small partitions take the unfused "
+    "kernels (same plan, runtime dispatch). 0 = always fuse.", int))
+
+FUSION_DENSE_KEYS = _register(ConfigEntry(
+    "spark.tpu.fusion.denseKeys", True,
+    "Allow the fused partial aggregate to take the dense-range direct "
+    "scatter path when the grouping key is a pass-through integral column "
+    "whose (memoized) range fits a capacity bucket.", _bool))
+
 CODEGEN_CACHE_SIZE = _register(ConfigEntry(
     "spark.tpu.kernel.cacheSize", 1024,
     "Max entries in the jitted-kernel cache (role of the reference's "

@@ -69,3 +69,23 @@ class ExecContext:
 
             self._memory = MemoryManager(self.conf, self.metrics)
         return self._memory
+
+    @property
+    def partition_parallelism(self) -> int:
+        """Concurrent partition-dispatch lanes for operator execution
+        (spark.tpu.exec.partitionParallelism; 0 = auto)."""
+        n = int(self.conf.get("spark.tpu.exec.partitionParallelism", 0))
+        if n <= 0:
+            import os
+
+            n = min(4, os.cpu_count() or 1)
+        return n
+
+    def par_map(self, fn, items: list) -> list:
+        """Dispatch independent partitions concurrently (async pipelining
+        across partitions; see exec/scheduler.par_map). `fn` must be pure
+        per-item device/host work — it must not recurse into plan
+        execution."""
+        from .scheduler import par_map
+
+        return par_map(fn, list(items), self.partition_parallelism)

@@ -194,8 +194,9 @@ class QueryExecution:
 
                 counters = dict(
                     self.session._metrics.snapshot()["counters"])
-                counters["kernel_cache.hits"] = KC.hits
-                counters["kernel_cache.misses"] = KC.misses
+                # process-absolute kernel cache/dispatch counters (the
+                # per-query deltas live under kernel.* via the scheduler)
+                counters.update(KC.counters())
                 counters.update(
                     {f"rule.{name}_ms": round(sec * 1000, 3)
                      for name, sec, _ in self.tracker.top_rules(5)})
@@ -232,7 +233,8 @@ class QueryExecution:
             nodes.append({
                 "id": key_of(node),
                 "depth": depth,
-                "op": type(node).__name__,
+                "op": node.graph_name()
+                if hasattr(node, "graph_name") else type(node).__name__,
                 "detail": node.simple_string()
                 if hasattr(node, "simple_string") else "",
                 "rows": m.get("rows"),

@@ -32,6 +32,49 @@ from .context import ExecContext
 
 
 # ---------------------------------------------------------------------------
+# Concurrent partition dispatch
+# ---------------------------------------------------------------------------
+
+def par_map(fn: Callable, items: list, workers: int) -> list:
+    """Run `fn` over `items` on up to `workers` threads, preserving order.
+
+    The async dispatch plane for partition-granular operator work: XLA
+    dispatch is asynchronous, so a Python thread per partition keeps the
+    device queue fed across partitions instead of round-tripping host →
+    device → host between every launch (role of the reference's task-slot
+    parallelism inside one executor). Threads are ephemeral daemons striding
+    over the item list — no pool to leak, deterministic output order, first
+    exception re-raised like the serial loop would."""
+    n = len(items)
+    if n <= 1 or workers <= 1:
+        return [fn(x) for x in items]
+    w = min(workers, n)
+    out: list = [None] * n
+    errors: list = []
+
+    def run(lane: int) -> None:
+        for i in range(lane, n, w):
+            if errors:
+                return
+            try:
+                out[i] = fn(items[i])
+            except BaseException as e:  # propagate to caller, stop lanes
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=run, args=(k,), daemon=True,
+                                name=f"tpu-dispatch-{k}")
+               for k in range(w)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+    return out
+
+
+# ---------------------------------------------------------------------------
 # Stage graph
 # ---------------------------------------------------------------------------
 
@@ -161,6 +204,21 @@ class DAGScheduler:
         self.bus = listener_bus
 
     def run(self, plan: PhysicalPlan) -> list:
+        from ..physical.compile import GLOBAL_KERNEL_CACHE
+
+        kc_before = GLOBAL_KERNEL_CACHE.counters()
+        try:
+            return self._run(plan)
+        finally:
+            # per-run kernel dispatch/cache deltas into the query metrics
+            # (satellite of SQLMetrics: dispatch-count regressions surface
+            # in listener snapshots and BENCH output)
+            for k, v in GLOBAL_KERNEL_CACHE.counters().items():
+                d = round(v - kc_before.get(k, 0))
+                if d:
+                    self.ctx.metrics.add(f"kernel.{k.split('.', 1)[1]}", d)
+
+    def _run(self, plan: PhysicalPlan) -> list:
         result_stage, stages = build_stage_graph(plan)
         done: set[int] = set()
 

@@ -181,6 +181,134 @@ def seg_first(layout: GroupLayout, values: jnp.ndarray, valid=None):
     return jnp.take(v, fp), has
 
 
+# --- primitive-op dispatch tables ------------------------------------------
+# One traced consume loop per aggregation layout, shared by the standalone
+# HashAggregateExec kernels and the whole-stage fused kernels
+# (physical/fusion.py) so both paths reduce with byte-identical op code.
+
+def apply_group_ops(layout: GroupLayout, ops: Sequence[str], val_datas,
+                    val_valids):
+    """Sorted-segment reduce of each (op, values, validity) triple over a
+    GroupLayout. Returns [(buffer, validity | None)] per op."""
+    bufs = []
+    for op, vd, vv in zip(ops, val_datas, val_valids):
+        if op in ("count", "countstar"):
+            cnt = seg_count(layout, vv if op == "count" else None)
+            bufs.append((cnt, None))
+        elif op == "sum":
+            total, cnt = seg_sum(layout, vd, vv)
+            bufs.append((total, cnt > 0))
+        elif op == "sumsq":
+            x = vd.astype(jnp.float64)
+            total, cnt = seg_sum(layout, x * x, vv)
+            bufs.append((total, cnt > 0))
+        elif op == "min":
+            m, has = seg_min(layout, vd, vv)
+            bufs.append((m, has))
+        elif op == "max":
+            m, has = seg_max(layout, vd, vv)
+            bufs.append((m, has))
+        elif op == "first":
+            f, has = seg_first(layout, vd, vv)
+            bufs.append((f, has))
+        elif op in ("bitand", "bitor", "bitxor"):
+            r, has = seg_bitreduce(layout, vd, vv, kind=op[3:])
+            bufs.append((r, has))
+        else:
+            raise ValueError(op)
+    return bufs
+
+
+def apply_dense_ops(seg, out_cap: int, cap: int, ops: Sequence[str],
+                    val_datas, val_valids, live_mask):
+    """Direct scatter reduce keyed by precomputed segment ids (dense-range
+    fast path; `live_mask` is the row mask after filters). Returns
+    [(buffer, validity | None)] per op."""
+    bufs = []
+    for op, vd, vv in zip(ops, val_datas, val_valids):
+        w = live_mask if vv is None else (live_mask & vv)
+        if op in ("count", "countstar"):
+            ww = live_mask if op == "countstar" else w
+            cnt = jax.ops.segment_sum(
+                ww.astype(jnp.int64), seg, num_segments=out_cap)
+            bufs.append((cnt, None))
+        elif op in ("sum", "sumsq"):
+            acc = jnp.float64 if jnp.issubdtype(vd.dtype, jnp.floating) \
+                else jnp.int64
+            x = vd.astype(acc)
+            if op == "sumsq":
+                x = vd.astype(jnp.float64)
+                x = x * x
+            total = jax.ops.segment_sum(
+                jnp.where(w, x, jnp.zeros((), x.dtype)), seg,
+                num_segments=out_cap)
+            cnt = jax.ops.segment_sum(w.astype(jnp.int64), seg,
+                                      num_segments=out_cap)
+            bufs.append((total, cnt > 0))
+        elif op == "min":
+            big = _max_ident(vd.dtype)
+            m = jax.ops.segment_min(jnp.where(w, vd, big), seg,
+                                    num_segments=out_cap)
+            cnt = jax.ops.segment_sum(w.astype(jnp.int32), seg,
+                                      num_segments=out_cap)
+            bufs.append((m, cnt > 0))
+        elif op == "max":
+            small = _min_ident(vd.dtype)
+            m = jax.ops.segment_max(jnp.where(w, vd, small), seg,
+                                    num_segments=out_cap)
+            cnt = jax.ops.segment_sum(w.astype(jnp.int32), seg,
+                                      num_segments=out_cap)
+            bufs.append((m, cnt > 0))
+        elif op == "first":
+            pos = lax.iota(jnp.int32, cap)
+            p = jnp.where(w, pos, cap)
+            fp = jax.ops.segment_min(p, seg, num_segments=out_cap)
+            has = fp < cap
+            bufs.append((jnp.take(vd, jnp.minimum(fp, cap - 1)), has))
+        elif op in ("bitand", "bitor", "bitxor"):
+            r, has = bitplane_reduce(vd, w, seg, out_cap, op[3:])
+            bufs.append((r, has))
+        else:
+            raise ValueError(op)
+    return bufs
+
+
+def apply_global_ops(ops: Sequence[str], val_datas, val_valids, row_mask):
+    """Whole-tile (ungrouped) reduce. Returns [(scalar, has | None)]."""
+    outs = []
+    for op, vd, vv in zip(ops, val_datas, val_valids):
+        if op in ("count", "countstar"):
+            w = row_mask if (vv is None or op == "countstar") \
+                else (row_mask & vv)
+            outs.append((jnp.sum(w.astype(jnp.int64)), None))
+        elif op == "sum":
+            s, c = masked_sum(vd, row_mask, vv)
+            outs.append((s, c > 0))
+        elif op == "sumsq":
+            x = vd.astype(jnp.float64)
+            s, c = masked_sum(x * x, row_mask, vv)
+            outs.append((s, c > 0))
+        elif op == "min":
+            m, has = masked_min(vd, row_mask, vv)
+            outs.append((m, has))
+        elif op == "max":
+            m, has = masked_max(vd, row_mask, vv)
+            outs.append((m, has))
+        elif op == "first":
+            w = row_mask if vv is None else (row_mask & vv)
+            pos = jnp.argmax(w)  # first True (0 if none)
+            has = jnp.any(w)
+            outs.append((vd[pos], has))
+        elif op in ("bitand", "bitor", "bitxor"):
+            w = row_mask if vv is None else (row_mask & vv)
+            seg0 = jnp.zeros(vd.shape[0], dtype=jnp.int32)
+            r, has = bitplane_reduce(vd, w, seg0, 1, op[3:])
+            outs.append((r[0], has[0]))
+        else:
+            raise ValueError(op)
+    return outs
+
+
 def _max_ident(dtype):
     if jnp.issubdtype(dtype, jnp.floating):
         return jnp.asarray(jnp.inf, dtype)

@@ -1,0 +1,24 @@
+"""shard_map version shim.
+
+jax moved shard_map out of experimental and renamed the replication-check
+kwarg (check_rep -> check_vma) across releases; the mesh kernels target the
+new surface. This shim resolves the import and translates the kwarg so the
+same call sites run on either jax generation.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # jax<0.6 keeps it in experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    if check_vma is not None:
+        try:
+            return _shard_map(f, **kwargs, check_vma=check_vma)
+        except TypeError:
+            return _shard_map(f, **kwargs, check_rep=check_vma)
+    return _shard_map(f, **kwargs)

@@ -84,7 +84,7 @@ def make_all_to_all_exchange(mesh, quota: int, axis_name: str = "data"):
         return out_payloads, new_mask, total_overflow
 
     def sharded(key_eqs, key_valids, payloads, row_mask):
-        from jax import shard_map
+        from ._shard_map_compat import shard_map
 
         in_specs = (
             [P(axis_name)] * len(key_eqs),

@@ -34,6 +34,12 @@ PARTIAL_TO_MERGE = {
     "bitand": "bitand", "bitor": "bitor", "bitxor": "bitxor",
 }
 
+# ops the whole-stage fused aggregation kernels implement
+# (physical/fusion.py): everything associative the segment/scatter reduces
+# handle inside one traced program. percentile/collect stay unfused — they
+# need host-side list building or a gather-first plan.
+FUSABLE_OPS = frozenset(PARTIAL_TO_MERGE)
+
 
 def _buffer_dtype(op: str, in_dtype: DataType | None) -> DataType:
     if op in ("count", "countstar"):

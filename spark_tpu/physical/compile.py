@@ -26,7 +26,8 @@ from ..expr.expressions import (
 from ..types import ArrayType, DataType, StringType, StructField, StructType
 
 __all__ = ["canonical_key", "KernelCache", "ExprPipeline", "bind_inputs",
-            "broadcast_to_cap"]
+            "broadcast_to_cap", "trace_pipeline", "pipeline_host_pass",
+            "pipeline_signature", "pipeline_columns"]
 
 
 # ---------------------------------------------------------------------------
@@ -69,17 +70,55 @@ def canonical_key(e: Expression, id_to_pos: dict[int, int]) -> tuple:
 # ---------------------------------------------------------------------------
 
 class KernelCache:
-    """Process-global LRU of jitted kernels."""
+    """Process-global LRU of jitted kernels.
+
+    Besides hit/miss bookkeeping the cache counts kernel LAUNCHES — every
+    invocation of a cached kernel is one device dispatch, so the counters
+    are the ground truth for "one dispatch per batch per stage" regression
+    tests (the reference's analog is WholeStageCodegen's generated-class
+    instantiation count). `launches_by_kind` buckets by the cache key's
+    leading tag ("pipeline", "fused_agg", "gagg", ...). `compile_ms`
+    accumulates builder time plus each kernel's first invocation (XLA
+    compiles lazily on first call)."""
 
     def __init__(self, max_size: int = 1024):
         self._cache: "collections.OrderedDict[tuple, Any]" = collections.OrderedDict()
         self.max_size = max_size
         self.hits = 0
         self.misses = 0
+        self.launches = 0
+        self.compile_ms = 0.0
+        self.launches_by_kind: "collections.Counter" = collections.Counter()
         # scheduler stages run in threads; OrderedDict mutation is not
         # thread-safe (builder() itself runs unlocked — duplicate builds of
         # the same key are benign, a torn dict is not)
         self._lock = threading.Lock()
+
+    def _wrap(self, key: tuple, f):
+        if not callable(f):
+            return f
+        kind = key[0] if isinstance(key, tuple) and key else "?"
+        state = {"first": True}
+
+        def launch(*args, **kwargs):
+            with self._lock:
+                self.launches += 1
+                self.launches_by_kind[kind] += 1
+                first = state["first"]
+                state["first"] = False
+            if first:
+                import time as _time
+
+                t0 = _time.perf_counter()
+                out = f(*args, **kwargs)
+                dt = (_time.perf_counter() - t0) * 1000
+                with self._lock:
+                    self.compile_ms += dt
+                return out
+            return f(*args, **kwargs)
+
+        launch._kernel = f
+        return launch
 
     def get_or_build(self, key: tuple, builder: Callable[[], Any]):
         with self._lock:
@@ -89,12 +128,27 @@ class KernelCache:
                 self._cache.move_to_end(key)
                 return f
             self.misses += 1
-        f = builder()
+        import time as _time
+
+        t0 = _time.perf_counter()
+        f = self._wrap(key, builder())
+        dt = (_time.perf_counter() - t0) * 1000
         with self._lock:
+            self.compile_ms += dt
             f = self._cache.setdefault(key, f)
             while len(self._cache) > self.max_size:
                 self._cache.popitem(last=False)
         return f
+
+    def counters(self) -> dict:
+        """Snapshot for metrics/listener plumbing."""
+        with self._lock:
+            return {
+                "kernel_cache.hits": self.hits,
+                "kernel_cache.misses": self.misses,
+                "kernel_cache.launches": self.launches,
+                "kernel_cache.compile_ms": round(self.compile_ms, 3),
+            }
 
 
 GLOBAL_KERNEL_CACHE = KernelCache()
@@ -129,6 +183,73 @@ def broadcast_to_cap(x, cap: int):
     return x
 
 
+def pipeline_host_pass(input_attrs: Sequence[AttributeReference],
+                       filters: Sequence[Expression],
+                       outputs: Sequence[Expression],
+                       batch: ColumnarBatch):
+    """Per-batch host shadow pass for a (possibly fused) pipeline kernel:
+    harvests aux lookup tables and output metadata (dtype/validity
+    presence/dictionaries) without touching row data. Returns
+    (hctx, host_outs, aux device arrays)."""
+    import jax.numpy as jnp
+
+    hctx = HostCtx(_host_inputs(batch, input_attrs))
+    for f in filters:
+        hctx.eval(f)
+    host_outs = [hctx.eval(o) for o in outputs]
+    aux = [jnp.asarray(a) for a in hctx.aux_arrays]
+    return hctx, host_outs, aux
+
+
+def pipeline_signature(batch: ColumnarBatch) -> tuple:
+    """Input dtype/validity signature — part of every fused kernel key."""
+    return tuple((str(c.data.dtype), c.validity is not None)
+                 for c in batch.columns)
+
+
+def pipeline_columns(fields, host_outs, out_datas, out_valids) -> list:
+    """Rebuild output Columns from a pipeline kernel's results, attaching
+    each dict-encoded column's host dictionary."""
+    from ..types import dict_encoded
+
+    cols = []
+    for f, hv, d, v in zip(fields, host_outs, out_datas, out_valids):
+        sdict = hv.sdict if dict_encoded(f.dataType) else None
+        cols.append(Column(f.dataType, d, v, sdict))
+    return cols
+
+
+def trace_pipeline(input_attrs: Sequence[AttributeReference],
+                   filters: Sequence[Expression],
+                   outputs: Sequence[Expression],
+                   datas, valids, row_mask, aux, cap: int):
+    """Trace the filter+project pipeline body inside a jitted kernel.
+
+    Shared consume-side prelude: ExprPipeline wraps it alone; fused-stage
+    kernels (physical/fusion.py) run it and feed the projected columns
+    straight into their terminal operator's consume code — the produce/
+    consume splice of the reference's WholeStageCodegen, done by tracing.
+    Returns (out_datas, out_valids, out_mask) broadcast to capacity."""
+    inputs = {}
+    for a, d, v in zip(input_attrs, datas, valids):
+        inputs[a.expr_id] = Val(a.dtype, d, v, None)
+    tctx = TraceCtx(inputs, aux, cap, row_mask)
+    mask = row_mask
+    for f in filters:
+        fv = tctx.eval(f)
+        pd = fv.data
+        if fv.validity is not None:
+            pd = pd & fv.validity
+        mask = mask & broadcast_to_cap(pd, cap)
+    out_datas = []
+    out_valids = []
+    for o in outputs:
+        ov = tctx.eval(o)
+        out_datas.append(broadcast_to_cap(ov.data, cap))
+        out_valids.append(broadcast_to_cap(ov.validity, cap))
+    return out_datas, out_valids, mask
+
+
 # ---------------------------------------------------------------------------
 # ExprPipeline: N filters + M output expressions in one kernel
 # ---------------------------------------------------------------------------
@@ -155,64 +276,32 @@ class ExprPipeline:
         )
 
     def run(self, batch: ColumnarBatch) -> ColumnarBatch:
-        import jax
-        import jax.numpy as jnp
-
         cap = batch.capacity
-        # ---- host pass ----
-        hctx = HostCtx(_host_inputs(batch, self.input_attrs))
-        for f in self.filters:
-            hctx.eval(f)
-        host_outs = [hctx.eval(o) for o in self.outputs]
-        aux_np = hctx.aux_arrays
-
-        in_sig = tuple(
-            (str(c.data.dtype), c.validity is not None) for c in batch.columns)
-        key = ("pipeline", self._struct_key, cap, in_sig, hctx.signature())
+        hctx, host_outs, aux = pipeline_host_pass(
+            self.input_attrs, self.filters, self.outputs, batch)
+        key = ("pipeline", self._struct_key, cap, pipeline_signature(batch),
+               hctx.signature())
 
         kernel = GLOBAL_KERNEL_CACHE.get_or_build(
             key, lambda: self._build_kernel(cap))
 
         datas = [c.data for c in batch.columns]
         valids = [c.validity for c in batch.columns]
-        aux = [jnp.asarray(a) for a in aux_np]
-        out_datas, out_valids, new_mask = kernel(datas, valids, batch.row_mask, aux)
-
-        cols = []
-        for f, hv, d, v in zip(self.out_schema.fields, host_outs, out_datas,
-                               out_valids):
-            from ..types import dict_encoded
-
-            sdict = hv.sdict if dict_encoded(f.dataType) else None
-            cols.append(Column(f.dataType, d, v, sdict))
+        out_datas, out_valids, new_mask = kernel(datas, valids,
+                                                 batch.row_mask, aux)
+        cols = pipeline_columns(self.out_schema.fields, host_outs, out_datas,
+                                out_valids)
         return ColumnarBatch(self.out_schema, cols, new_mask, num_rows=None)
 
     def _build_kernel(self, cap: int):
         import jax
-        import jax.numpy as jnp
 
         input_attrs = self.input_attrs
         filters = self.filters
         outputs = self.outputs
 
         def kernel(datas, valids, row_mask, aux):
-            inputs = {}
-            for a, d, v in zip(input_attrs, datas, valids):
-                inputs[a.expr_id] = Val(a.dtype, d, v, None)
-            tctx = TraceCtx(inputs, aux, cap, row_mask)
-            mask = row_mask
-            for f in filters:
-                fv = tctx.eval(f)
-                pd = fv.data
-                if fv.validity is not None:
-                    pd = pd & fv.validity
-                mask = mask & broadcast_to_cap(pd, cap)
-            out_datas = []
-            out_valids = []
-            for o in outputs:
-                ov = tctx.eval(o)
-                out_datas.append(broadcast_to_cap(ov.data, cap))
-                out_valids.append(broadcast_to_cap(ov.validity, cap))
-            return out_datas, out_valids, mask
+            return trace_pipeline(input_attrs, filters, outputs,
+                                  datas, valids, row_mask, aux, cap)
 
         return jax.jit(kernel)

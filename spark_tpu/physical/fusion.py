@@ -1,0 +1,576 @@
+"""Whole-stage kernel fusion: one XLA dispatch per batch per stage.
+
+Role of the reference's WholeStageCodegen (sqlx/WholeStageCodegenExec.scala:673
+doCodeGen + CollapseCodegenStages): Spark splices produce/consume Java code so
+a stage's operators run as one loop; here the splice is a TRACE — the
+filter/project pipeline body (physical/compile.trace_pipeline) is traced
+inside the terminal operator's kernel (partial hash aggregate, hash-join
+probe, limit mask) and `jax.jit` compiles the whole stage consume as ONE
+program per (structure, input signature, capacity), cached in the
+structurally-keyed GLOBAL_KERNEL_CACHE. XLA then performs the operator
+fusion the reference hand-rolls.
+
+`FuseStages` runs after stage-boundary insertion (exchanges are already
+placed), so each rewrite stays inside one exchange-free chain:
+
+  * ComputeExec(ComputeExec)              -> one ComputeExec (CollapseProject
+    /CollapseCodegenStages analog; the substitution is shared with the
+    planner's construction-time fusion)
+  * HashAggregateExec[partial](ComputeExec) -> FusedAggregateExec
+  * LimitExec(ComputeExec)                -> FusedLimitExec
+  * HashJoinExec(left=ComputeExec)        -> probe pipeline spliced into the
+    probe kernel (operators.HashJoinExec._fused_probe)
+
+The unfused operator-at-a-time path stays intact behind
+spark.tpu.fusion.enabled=false as the differential-testing oracle.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..config import FUSION_DENSE_KEYS, FUSION_MIN_ROWS, SQLConf
+from ..expr.expressions import Alias, AttributeReference, Expression
+from ..types import (
+    BooleanType, DateType, IntegralType, StringType, dict_encoded,
+)
+from ..columnar.batch import Column, ColumnarBatch, bucket_capacity
+from .aggregates import FUSABLE_OPS
+from .compile import (
+    GLOBAL_KERNEL_CACHE, bind_inputs, canonical_key, pipeline_columns,
+    pipeline_host_pass, pipeline_signature, trace_pipeline,
+)
+from .operators import (
+    ComputeExec, HashAggregateExec, HashJoinExec, LimitExec, PhysicalPlan,
+    _SchemaOnly, attrs_schema, dense_range_stats,
+)
+
+__all__ = ["FusedAggregateExec", "FusedLimitExec", "fuse_stages",
+           "collapse_computes", "merge_into_compute"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# ComputeExec collapsing (shared with the planner's construction-time path)
+# ---------------------------------------------------------------------------
+
+def merge_into_compute(filters: Sequence[Expression],
+                       outputs: Sequence[Expression],
+                       child: ComputeExec) -> ComputeExec:
+    """Fuse a filter/project layer into an existing ComputeExec child by
+    substituting the child's output expressions (the CollapseCodegenStages
+    analog; all expressions are deterministic and XLA CSEs duplicated
+    subtrees, so inlining is always sound)."""
+    from ..plan.optimizer import substitute_attrs
+
+    m: dict[int, Expression] = {}
+    for e in child.outputs:
+        if isinstance(e, Alias):
+            m[e.expr_id] = e.child
+        elif isinstance(e, AttributeReference):
+            m[e.expr_id] = e
+    new_filters = [substitute_attrs(f, m) for f in filters]
+    new_outputs: list[Expression] = []
+    for o in outputs:
+        if isinstance(o, Alias):
+            new_outputs.append(
+                Alias(substitute_attrs(o.child, m), o.name, o.expr_id))
+            continue
+        sub = m.get(o.expr_id)
+        if sub is None or (isinstance(sub, AttributeReference)
+                           and sub.expr_id == o.expr_id):
+            new_outputs.append(o)
+        else:
+            new_outputs.append(Alias(sub, o.name, o.expr_id))
+    return ComputeExec(child.filters + new_filters, new_outputs, child.child)
+
+
+def collapse_computes(plan: PhysicalPlan) -> PhysicalPlan:
+    """Collapse adjacent ComputeExec nodes anywhere in the physical tree —
+    a ComputeExec over a ComputeExec would launch two kernels per batch."""
+
+    def rule(node):
+        if isinstance(node, ComputeExec) and isinstance(node.child,
+                                                        ComputeExec):
+            return merge_into_compute(node.filters, node.outputs, node.child)
+        return node
+
+    return plan.transform_up(rule)
+
+
+# ---------------------------------------------------------------------------
+# Shared fused-kernel plumbing
+# ---------------------------------------------------------------------------
+
+def _pipe_attrs(outputs: Sequence[Expression]) -> list[AttributeReference]:
+    return [o.to_attribute() if isinstance(o, Alias) else o for o in outputs]
+
+
+def _compute_nontrivial(c: ComputeExec) -> bool:
+    """A pure column reorder/prune launches no kernel — nothing to fuse."""
+    return bool(c.filters) or any(not isinstance(o, AttributeReference)
+                                  for o in c.outputs)
+
+
+# ---------------------------------------------------------------------------
+# FusedAggregateExec
+# ---------------------------------------------------------------------------
+
+class FusedAggregateExec(HashAggregateExec):
+    """Partial hash aggregate with its feeding filter/project pipeline
+    traced into the aggregation kernel: per input batch, ONE jitted program
+    filters, projects, and partially aggregates (dense-range scatter,
+    sorted-segment, or whole-tile reduce). Per-batch partials then merge
+    with the associative final-mode ops — dispatches across batches and
+    partitions pipeline asynchronously with no host sync in between (the
+    dense-range range decision is memoized per column identity)."""
+
+    child_fields = ("child",)
+
+    def __init__(self, grouping, specs, filters, outputs, child):
+        super().__init__(grouping, specs, "partial", child)
+        self.filters = list(filters)
+        self.pipe_outputs = list(outputs)
+        self.pipe_attrs = _pipe_attrs(self.pipe_outputs)
+        self._unfused_cache = None
+        id_to_pos = bind_inputs(child.output)
+        self._struct_key = (
+            tuple(canonical_key(f, id_to_pos) for f in self.filters),
+            tuple(canonical_key(o, id_to_pos) for o in self.pipe_outputs),
+        )
+
+    def graph_name(self) -> str:
+        # the plan graph groups by operator role (the reference renders the
+        # aggregate node inside its WholeStageCodegen cluster)
+        return "HashAggregateExec"
+
+    def execute(self, ctx) -> list:
+        parts = self.child.execute(ctx)
+        return ctx.par_map(
+            lambda part: [self._fused_partition(part, ctx)], parts)
+
+    # ------------------------------------------------------------------
+    def _unfused(self):
+        """Operator-at-a-time fallback for partitions under
+        spark.tpu.fusion.minRows: the shared (structure-agnostic) agg
+        kernels beat a fresh per-structure fused compile on small inputs."""
+        if self._unfused_cache is None:
+            from .compile import ExprPipeline
+
+            pipe = ExprPipeline(self.child.output, self.filters,
+                                self.pipe_outputs,
+                                attrs_schema(self.pipe_attrs))
+            inner = HashAggregateExec(self.grouping, self.specs, "partial",
+                                      _SchemaOnly(self.pipe_attrs))
+            self._unfused_cache = (pipe, inner)
+        return self._unfused_cache
+
+    def _fused_partition(self, part, ctx) -> ColumnarBatch:
+        if not part:
+            part = [ColumnarBatch.empty(attrs_schema(self.child.output))]
+        if sum(b.capacity for b in part) < int(ctx.conf.get(FUSION_MIN_ROWS)):
+            pipe, inner = self._unfused()
+            return inner._aggregate_partition(
+                [pipe.run(b) for b in part], ctx)
+        partials = [self._fused_batch(b, ctx) for b in part]
+        if len(partials) == 1:
+            return partials[0]
+        merger = HashAggregateExec(self.grouping, self.specs, "final",
+                                   _SchemaOnly(self.output))
+        return merger._aggregate_partition(partials, ctx)
+
+    def _fused_batch(self, batch: ColumnarBatch, ctx) -> ColumnarBatch:
+        import jax
+
+        jnp = _jnp()
+        cap = batch.capacity
+        input_attrs = self.child.output
+        filters, outputs = self.filters, self.pipe_outputs
+        hctx, host_outs, aux = pipeline_host_pass(input_attrs, filters,
+                                                  outputs, batch)
+        opos = {a.expr_id: i for i, a in enumerate(self.pipe_attrs)}
+        vals = self._plan_values()
+        ops = tuple(op for op, _, _ in vals)
+        val_idx = tuple(opos[attr.expr_id] if attr is not None else -1
+                        for _, attr, _ in vals)
+        key_idx = tuple(opos[g.expr_id] for g in self.grouping)
+        out_schema = attrs_schema(self.output)
+        base_key = (self._struct_key, ops, val_idx, key_idx, cap,
+                    pipeline_signature(batch), hctx.signature())
+        datas = [c.data for c in batch.columns]
+        valids = [c.validity for c in batch.columns]
+
+        def pipe_vals(out_datas, out_valids, mask):
+            vd = [out_datas[i] if i >= 0 else mask for i in val_idx]
+            vv = [out_valids[i] if i >= 0 else None for i in val_idx]
+            return vd, vv
+
+        # ---- ungrouped -------------------------------------------------
+        if not self.grouping:
+            out_cap = 8
+
+            def build_ungrouped():
+                from ..ops import grouping as G
+
+                def kernel(datas, valids, row_mask, aux):
+                    out_datas, out_valids, mask = trace_pipeline(
+                        input_attrs, filters, outputs, datas, valids,
+                        row_mask, aux, cap)
+                    vd, vv = pipe_vals(out_datas, out_valids, mask)
+                    outs = G.apply_global_ops(ops, vd, vv, mask)
+                    bufs_d, bufs_v = [], []
+                    for d, v in outs:
+                        bufs_d.append(jnp.zeros((out_cap,), dtype=d.dtype)
+                                      .at[0].set(d))
+                        bufs_v.append(None if v is None else
+                                      jnp.zeros((out_cap,), dtype=bool)
+                                      .at[0].set(v))
+                    m = jnp.zeros((out_cap,), dtype=bool).at[0].set(True)
+                    return bufs_d, bufs_v, m
+
+                return jax.jit(kernel)
+
+            kernel = GLOBAL_KERNEL_CACHE.get_or_build(
+                ("fused_agg", "u") + base_key, build_ungrouped)
+            bufs_d, bufs_v, m = kernel(datas, valids, batch.row_mask, aux)
+            cols = self._fused_cols(
+                list(zip(bufs_d, bufs_v)), out_schema.fields, host_outs,
+                val_idx, 0)
+            return ColumnarBatch(out_schema, cols, m, num_rows=1)
+
+        # ---- grouped: dense-range direct scatter -----------------------
+        dense = self._dense_decision(batch, key_idx, ctx)
+        if dense is not None:
+            kmin, out_cap, has_kv = dense
+            kpos = key_idx[0]
+            kf = out_schema.fields[0]
+            kdt = kf.dataType.device_dtype
+
+            def build_dense():
+                from jax import lax
+
+                from ..ops import grouping as G
+
+                def kernel(datas, valids, row_mask, aux, kmin_s):
+                    out_datas, out_valids, mask = trace_pipeline(
+                        input_attrs, filters, outputs, datas, valids,
+                        row_mask, aux, cap)
+                    key = out_datas[kpos].astype(jnp.int64)
+                    kvalid = out_valids[kpos]
+                    seg = (key - kmin_s).astype(jnp.int32)
+                    if kvalid is not None:
+                        seg = jnp.where(kvalid, seg, out_cap - 1)
+                    seg = jnp.where(mask, seg, out_cap - 1)
+                    present = jax.ops.segment_sum(
+                        jnp.where(mask, 1, 0), seg, num_segments=out_cap)
+                    if kvalid is not None:
+                        null_rows = jnp.sum(
+                            (mask & ~kvalid).astype(jnp.int64))
+                    else:
+                        null_rows = jnp.int64(0)
+                    vd, vv = pipe_vals(out_datas, out_valids, mask)
+                    bufs = G.apply_dense_ops(seg, out_cap, cap, ops, vd, vv,
+                                             mask)
+                    out_keys = (kmin_s +
+                                lax.iota(jnp.int64, out_cap)).astype(kdt)
+                    out_mask = (present > 0).at[out_cap - 1].set(
+                        null_rows > 0)
+                    key_validity = jnp.ones(out_cap, dtype=bool) \
+                        .at[out_cap - 1].set(False)
+                    return out_keys, key_validity, bufs, out_mask
+
+                return jax.jit(kernel)
+
+            kernel = GLOBAL_KERNEL_CACHE.get_or_build(
+                ("fused_agg", "d", out_cap) + base_key, build_dense)
+            out_keys, key_validity, bufs, out_mask = kernel(
+                datas, valids, batch.row_mask, aux, jnp.int64(kmin))
+            ctx.metrics.add("agg.dense_fast_path")
+            cols = [Column(kf.dataType, out_keys,
+                           key_validity if has_kv else None, None)]
+            cols += self._fused_cols(bufs, out_schema.fields[1:], host_outs,
+                                     val_idx, 0)
+            return ColumnarBatch(out_schema, cols, out_mask, num_rows=None)
+
+        # ---- grouped: sorted-segment -----------------------------------
+        key_bool = tuple(isinstance(self.pipe_attrs[i].dtype, BooleanType)
+                         for i in key_idx)
+
+        def build_grouped():
+            from ..ops import grouping as G
+
+            def kernel(datas, valids, row_mask, aux):
+                out_datas, out_valids, mask = trace_pipeline(
+                    input_attrs, filters, outputs, datas, valids, row_mask,
+                    aux, cap)
+                key_eqs = []
+                for i, is_bool in zip(key_idx, key_bool):
+                    kd = out_datas[i]
+                    if is_bool:
+                        kd = kd.astype(jnp.int32)
+                    key_eqs.append(kd)
+                key_valids = [out_valids[i] for i in key_idx]
+                layout = G.group_rows(key_eqs, key_valids, mask)
+                out_keys = [
+                    G.scatter_group_keys(layout, out_datas[i], out_valids[i])
+                    for i in key_idx]
+                vd, vv = pipe_vals(out_datas, out_valids, mask)
+                bufs = G.apply_group_ops(layout, ops, vd, vv)
+                out_mask = G.group_output_mask(layout)
+                return out_keys, bufs, out_mask
+
+            return jax.jit(kernel)
+
+        kernel = GLOBAL_KERNEL_CACHE.get_or_build(
+            ("fused_agg", "g") + base_key, build_grouped)
+        out_keys, bufs, out_mask = kernel(datas, valids, batch.row_mask, aux)
+        cols = []
+        nk = len(key_idx)
+        for (kd, kv), ki, f in zip(out_keys, key_idx,
+                                   out_schema.fields[:nk]):
+            sdict = host_outs[ki].sdict if dict_encoded(f.dataType) else None
+            cols.append(Column(f.dataType, kd, kv, sdict))
+        cols += self._fused_cols(bufs, out_schema.fields[nk:], host_outs,
+                                 val_idx, nk)
+        return ColumnarBatch(out_schema, cols, out_mask, num_rows=None)
+
+    def _fused_cols(self, bufs, fields, host_outs, val_idx, key_count):
+        """Finish buffer columns (dtype casts) and re-attach dictionaries of
+        dict-encoded passthrough buffers (e.g. first(string): codes travel,
+        the batch's dictionary decodes them)."""
+        cols = []
+        for bi, ((bd, bv), f) in enumerate(zip(bufs, fields)):
+            col = self._finish_buffer(bi, bd, bv, f, {})
+            if dict_encoded(f.dataType) and col.dictionary is None:
+                vi = val_idx[bi]
+                if vi >= 0 and host_outs[vi].sdict is not None:
+                    col = Column(f.dataType, col.data, col.validity,
+                                 host_outs[vi].sdict)
+            cols.append(col)
+        return cols
+
+    def _dense_decision(self, batch: ColumnarBatch, key_idx, ctx):
+        """(kmin, out_cap, key_has_validity) when the single grouping key is
+        a pass-through integral input column whose value range (memoized per
+        column identity — the satellite fix for the per-batch two-scalar
+        host sync) fits a capacity bucket. The range is measured under the
+        PRE-filter row mask: a superset of the post-filter range, so the
+        dense table stays sound, merely (rarely) wider."""
+        if len(key_idx) != 1:
+            return None
+        if not ctx.conf.get(FUSION_DENSE_KEYS):
+            return None
+        kexpr = self.pipe_outputs[key_idx[0]]
+        if not isinstance(kexpr, AttributeReference):
+            return None
+        in_pos = None
+        for i, a in enumerate(self.child.output):
+            if a.expr_id == kexpr.expr_id:
+                in_pos = i
+                break
+        if in_pos is None:
+            return None
+        kc = batch.columns[in_pos]
+        if not isinstance(kc.dtype, (IntegralType, DateType)):
+            return None
+        cap = batch.capacity
+        kmin, kmax, any_live = dense_range_stats(kc, batch.row_mask, cap)
+        if not any_live:
+            return None
+        span = kmax - kmin + 1
+        if span + 1 > min(4 * cap, 1 << 23):
+            return None  # sparse keys — sort path handles it
+        return kmin, bucket_capacity(span + 1), kc.validity is not None
+
+    def simple_string(self):
+        g = ", ".join(a.name for a in self.grouping)
+        fns = ", ".join(type(s.func).__name__ for s in self.specs)
+        f = " AND ".join(x.simple_string() for x in self.filters)
+        s = f"FusedHashAggregate[partial](keys=[{g}], fns=[{fns}])"
+        if f:
+            s += f" WHERE {f}"
+        return s
+
+
+# ---------------------------------------------------------------------------
+# FusedLimitExec
+# ---------------------------------------------------------------------------
+
+class FusedLimitExec(LimitExec):
+    """Limit with its feeding filter/project pipeline traced into the limit
+    kernel: one program per partition computes the pipeline, ranks live rows
+    (cumsum), and masks past-limit rows."""
+
+    child_fields = ("child",)
+
+    def __init__(self, n, filters, outputs, child, offset: int = 0,
+                 is_global: bool = False):
+        super().__init__(n, child, offset=offset, is_global=is_global)
+        self.filters = list(filters)
+        self.pipe_outputs = list(outputs)
+        self.pipe_attrs = _pipe_attrs(self.pipe_outputs)
+        self._unfused_cache = None
+        id_to_pos = bind_inputs(child.output)
+        self._struct_key = (
+            tuple(canonical_key(f, id_to_pos) for f in self.filters),
+            tuple(canonical_key(o, id_to_pos) for o in self.pipe_outputs),
+        )
+
+    @property
+    def output(self):
+        return self.pipe_attrs
+
+    def graph_name(self) -> str:
+        return "LimitExec"
+
+    def execute(self, ctx) -> list:
+        parts = self.child.execute(ctx)
+        return ctx.par_map(lambda part: self._fused_partition(part, ctx),
+                           parts)
+
+    def _unfused(self):
+        """Operator-at-a-time fallback under spark.tpu.fusion.minRows."""
+        if self._unfused_cache is None:
+            from .compile import ExprPipeline
+
+            pipe = ExprPipeline(self.child.output, self.filters,
+                                self.pipe_outputs,
+                                attrs_schema(self.pipe_attrs))
+            inner = LimitExec(self.n, _SchemaOnly(self.pipe_attrs),
+                              offset=self.offset, is_global=self.is_global)
+            self._unfused_cache = (pipe, inner)
+        return self._unfused_cache
+
+    def _fused_partition(self, part, ctx) -> list:
+        import jax
+
+        from ..columnar.ops import concat_batches
+
+        jnp = _jnp()
+        if not part:
+            return []
+        if sum(b.capacity for b in part) < int(ctx.conf.get(FUSION_MIN_ROWS)):
+            pipe, inner = self._unfused()
+            return inner._limit_partition([pipe.run(b) for b in part], ctx)
+        batch = concat_batches(part, attrs_schema(self.child.output))
+        cap = batch.capacity
+        input_attrs = self.child.output
+        filters, outputs = self.filters, self.pipe_outputs
+        hctx, host_outs, aux = pipeline_host_pass(input_attrs, filters,
+                                                  outputs, batch)
+        key = ("fused_limit", self._struct_key, cap, self.n, self.offset,
+               pipeline_signature(batch), hctx.signature())
+
+        def build():
+            def kernel(datas, valids, row_mask, aux):
+                out_datas, out_valids, mask = trace_pipeline(
+                    input_attrs, filters, outputs, datas, valids, row_mask,
+                    aux, cap)
+                rank = jnp.cumsum(mask.astype(jnp.int64))
+                keep = mask & (rank > self.offset) & \
+                    (rank <= self.offset + self.n)
+                return out_datas, out_valids, keep
+
+            return jax.jit(kernel)
+
+        kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build)
+        out_datas, out_valids, keep = kernel(
+            [c.data for c in batch.columns],
+            [c.validity for c in batch.columns], batch.row_mask, aux)
+        schema = attrs_schema(self.output)
+        cols = pipeline_columns(schema.fields, host_outs, out_datas,
+                                out_valids)
+        limited = ColumnarBatch(schema, cols, keep, num_rows=None)
+        if not self.is_global and self.n * 4 <= cap:
+            from ..columnar.ops import compact_batch
+
+            limited = compact_batch(limited)
+        return [limited]
+
+    def simple_string(self):
+        o = ", ".join(x.simple_string() for x in self.pipe_outputs)
+        f = " AND ".join(x.simple_string() for x in self.filters)
+        s = f"FusedLimit[n={self.n}]({o})"
+        if f:
+            s += f" WHERE {f}"
+        return s
+
+
+# ---------------------------------------------------------------------------
+# FuseStages planner rule
+# ---------------------------------------------------------------------------
+
+def _aggregate_fusable(agg: HashAggregateExec, compute: ComputeExec) -> bool:
+    if not _compute_nontrivial(compute):
+        return False
+    if not all(s.mergeable for s in agg.specs):
+        return False
+    out_ids = {a.expr_id for a in compute.output}
+    if any(g.expr_id not in out_ids for g in agg.grouping):
+        return False
+    for op, attr, _param in agg._plan_values():
+        if op not in FUSABLE_OPS:
+            return False
+        if attr is not None and attr.expr_id not in out_ids:
+            return False
+        if op in ("min", "max") and attr is not None and \
+                dict_encoded(attr.dtype):
+            # rank-space string min/max needs the host inverse-rank map
+            return False
+    return True
+
+
+def _probe_fusable(join: HashJoinExec, compute: ComputeExec) -> bool:
+    if not _compute_nontrivial(compute):
+        return False
+    out_by_id = {a.expr_id: a for a in compute.output}
+    for k in join.left_keys:
+        a = out_by_id.get(k.expr_id)
+        if a is None:
+            return False
+        if isinstance(a.dtype, StringType) or dict_encoded(a.dtype):
+            # string equality rides dictionary hashes, which live host-side
+            return False
+    return True
+
+
+def fuse_stages(plan: PhysicalPlan, conf: SQLConf) -> PhysicalPlan:
+    """Collapse each maximal exchange-free chain of fusable operators into
+    whole-stage fused operators (run by the planner after EnsureRequirements
+    — the CollapseCodegenStages slot in the reference's preparation rules)."""
+    plan = collapse_computes(plan)
+
+    def rule(node):
+        if isinstance(node, HashAggregateExec) \
+                and not isinstance(node, FusedAggregateExec) \
+                and node.mode == "partial" \
+                and isinstance(node.child, ComputeExec) \
+                and _aggregate_fusable(node, node.child):
+            c = node.child
+            return FusedAggregateExec(node.grouping, node.specs, c.filters,
+                                      c.outputs, c.child)
+        if isinstance(node, LimitExec) \
+                and not isinstance(node, FusedLimitExec) \
+                and isinstance(node.child, ComputeExec) \
+                and _compute_nontrivial(node.child):
+            c = node.child
+            return FusedLimitExec(node.n, c.filters, c.outputs, c.child,
+                                  offset=node.offset,
+                                  is_global=node.is_global)
+        if isinstance(node, HashJoinExec) and node.probe_fusion is None \
+                and isinstance(node.left, ComputeExec) \
+                and _probe_fusable(node, node.left):
+            c = node.left
+            node.probe_fusion = (list(c.filters), list(c.outputs))
+            node.probe_attrs = list(c.output)
+            node.left = c.child
+            node._probe_pipe_cache = None
+            return node
+        return node
+
+    return plan.transform_up(rule)

@@ -99,6 +99,12 @@ class PhysicalPlan(TreeNode):
     def output(self) -> list[AttributeReference]:
         raise NotImplementedError
 
+    def graph_name(self) -> str:
+        """Operator-role name the plan graph/UI groups by. Whole-stage
+        fused operators report the operator they implement (the reference
+        renders the member operators inside a WholeStageCodegen cluster)."""
+        return type(self).__name__
+
     def output_partitioning(self) -> Partitioning:
         ch = self.children
         if ch:
@@ -368,7 +374,7 @@ class ComputeExec(PhysicalPlan):
 
                 return [[reorder(b) for b in part] for part in parts]
         pipe = self._get_pipeline()
-        return [[pipe.run(b) for b in part] for part in parts]
+        return ctx.par_map(lambda part: [pipe.run(b) for b in part], parts)
 
     def simple_string(self):
         f = " AND ".join(x.simple_string() for x in self.filters)
@@ -389,6 +395,83 @@ def _batch_stats_cache(batch: ColumnarBatch) -> dict:
     return batch._stats
 
 
+# Process-global memo of host-synced scalars derived from device arrays,
+# keyed by the arrays' identities. Unlike the per-batch `_stats` dict this
+# survives re-wrapping the same device columns into fresh ColumnarBatches
+# (device-cached scans re-executed per query, reorder projections, repeated
+# broadcast probes), so the dense-range decision syncs its two scalars ONCE
+# per distinct (column, mask) pair instead of once per batch per run —
+# per-batch dispatches then pipeline without a host round-trip in between.
+# Entries hold weakrefs and verify identity: id() values recycle after GC,
+# and serving another array's cached range would silently corrupt results.
+import collections as _collections
+import threading as _threading
+
+_DEVICE_SCALAR_MEMO: "_collections.OrderedDict" = _collections.OrderedDict()
+_DEVICE_SCALAR_LOCK = _threading.Lock()
+_DEVICE_SCALAR_MAX = 4096
+
+
+def _memo_device_scalars(kind: tuple, arrays: tuple, compute):
+    """Memoized `compute()` keyed by `kind` + identity of `arrays` (None
+    entries allowed). Falls back to plain computation when an array does
+    not support weakrefs."""
+    import weakref
+
+    live = tuple(a for a in arrays if a is not None)
+    key = (kind, tuple(id(a) if a is not None else None for a in arrays))
+    with _DEVICE_SCALAR_LOCK:
+        ent = _DEVICE_SCALAR_MEMO.get(key)
+        if ent is not None:
+            refs, value = ent
+            if all(r() is a for r, a in zip(refs, live)):
+                _DEVICE_SCALAR_MEMO.move_to_end(key)
+                return value
+            del _DEVICE_SCALAR_MEMO[key]
+    value = compute()
+    try:
+        refs = tuple(weakref.ref(a) for a in live)
+    except TypeError:
+        return value
+    with _DEVICE_SCALAR_LOCK:
+        _DEVICE_SCALAR_MEMO[key] = (refs, value)
+        while len(_DEVICE_SCALAR_MEMO) > _DEVICE_SCALAR_MAX:
+            _DEVICE_SCALAR_MEMO.popitem(last=False)
+    return value
+
+
+def dense_range_stats(kc: Column, row_mask, cap: int):
+    """(kmin, kmax, any_live) of an integral key column under `row_mask`,
+    memoized across batches sharing the same device arrays (the
+    physical/operators dense fast-path decision; one kernel + one two-scalar
+    host sync per distinct column/mask identity)."""
+    import jax
+
+    jnp = _jnp()
+
+    def compute():
+        rkey = ("krange3", cap, str(kc.data.dtype), kc.validity is not None)
+
+        def build_range():
+            def kr(k, v, m):
+                k = k.astype(jnp.int64)  # cast inside (transport cost)
+                if v is not None:
+                    m = m & v
+                big = jnp.iinfo(jnp.int64).max
+                small = jnp.iinfo(jnp.int64).min
+                return (jnp.min(jnp.where(m, k, big)),
+                        jnp.max(jnp.where(m, k, small)),
+                        jnp.any(m))
+            return jax.jit(kr)
+
+        kmin_d, kmax_d, any_d = GLOBAL_KERNEL_CACHE.get_or_build(
+            rkey, build_range)(kc.data, kc.validity, row_mask)
+        return (int(kmin_d), int(kmax_d), bool(any_d))
+
+    return _memo_device_scalars(("dense_range",),
+                                (kc.data, kc.validity, row_mask), compute)
+
+
 def _group_kernel(num_keys: int, ops: tuple[str, ...], cap: int,
                   key_valid_sig: tuple[bool, ...],
                   val_valid_sig: tuple[bool, ...]):
@@ -398,37 +481,11 @@ def _group_kernel(num_keys: int, ops: tuple[str, ...], cap: int,
     from ..ops import grouping as G
 
     def kernel(key_eqs, key_outs, key_valids, val_datas, val_valids, row_mask):
-        jnp = _jnp()
         layout = G.group_rows(key_eqs, key_valids, row_mask)
         out_keys = []
         for ko, kv in zip(key_outs, key_valids):
             out_keys.append(G.scatter_group_keys(layout, ko, kv))
-        bufs = []
-        for op, vd, vv in zip(ops, val_datas, val_valids):
-            if op in ("count", "countstar"):
-                cnt = G.seg_count(layout, vv if op == "count" else None)
-                bufs.append((cnt, None))
-            elif op == "sum":
-                total, cnt = G.seg_sum(layout, vd, vv)
-                bufs.append((total, cnt > 0))
-            elif op == "sumsq":
-                x = vd.astype(jnp.float64)
-                total, cnt = G.seg_sum(layout, x * x, vv)
-                bufs.append((total, cnt > 0))
-            elif op == "min":
-                m, has = G.seg_min(layout, vd, vv)
-                bufs.append((m, has))
-            elif op == "max":
-                m, has = G.seg_max(layout, vd, vv)
-                bufs.append((m, has))
-            elif op == "first":
-                f, has = G.seg_first(layout, vd, vv)
-                bufs.append((f, has))
-            elif op in ("bitand", "bitor", "bitxor"):
-                r, has = G.seg_bitreduce(layout, vd, vv, kind=op[3:])
-                bufs.append((r, has))
-            else:
-                raise ValueError(op)
+        bufs = G.apply_group_ops(layout, ops, val_datas, val_valids)
         out_mask = G.group_output_mask(layout)
         return out_keys, bufs, out_mask, layout.num_groups
 
@@ -468,52 +525,8 @@ def _dense_group_kernel(ops: tuple[str, ...], cap: int, out_cap: int,
         else:
             null_rows = jnp.int64(0)
 
-        bufs = []
-        for op, vd, vv in zip(ops, val_datas, val_valids):
-            w = w_all if vv is None else (w_all & vv)
-            if op in ("count", "countstar"):
-                ww = w_all if op == "countstar" else w
-                cnt = jax.ops.segment_sum(
-                    ww.astype(jnp.int64), seg, num_segments=out_cap)
-                bufs.append((cnt, None))
-            elif op in ("sum", "sumsq"):
-                acc = jnp.float64 if jnp.issubdtype(vd.dtype, jnp.floating) \
-                    else jnp.int64
-                x = vd.astype(acc)
-                if op == "sumsq":
-                    x = vd.astype(jnp.float64)
-                    x = x * x
-                total = jax.ops.segment_sum(
-                    jnp.where(w, x, jnp.zeros((), x.dtype)), seg,
-                    num_segments=out_cap)
-                cnt = jax.ops.segment_sum(w.astype(jnp.int64), seg,
-                                          num_segments=out_cap)
-                bufs.append((total, cnt > 0))
-            elif op == "min":
-                big = G._max_ident(vd.dtype)
-                m = jax.ops.segment_min(jnp.where(w, vd, big), seg,
-                                        num_segments=out_cap)
-                cnt = jax.ops.segment_sum(w.astype(jnp.int32), seg,
-                                          num_segments=out_cap)
-                bufs.append((m, cnt > 0))
-            elif op == "max":
-                small = G._min_ident(vd.dtype)
-                m = jax.ops.segment_max(jnp.where(w, vd, small), seg,
-                                        num_segments=out_cap)
-                cnt = jax.ops.segment_sum(w.astype(jnp.int32), seg,
-                                          num_segments=out_cap)
-                bufs.append((m, cnt > 0))
-            elif op == "first":
-                pos = lax.iota(jnp.int32, cap)
-                p = jnp.where(w, pos, cap)
-                fp = jax.ops.segment_min(p, seg, num_segments=out_cap)
-                has = fp < cap
-                bufs.append((jnp.take(vd, jnp.minimum(fp, cap - 1)), has))
-            elif op in ("bitand", "bitor", "bitxor"):
-                r, has = G.bitplane_reduce(vd, w, seg, out_cap, op[3:])
-                bufs.append((r, has))
-            else:
-                raise ValueError(op)
+        bufs = G.apply_dense_ops(seg, out_cap, cap, ops, val_datas,
+                                 val_valids, w_all)
 
         out_keys = kmin + lax.iota(jnp.int64, out_cap)
         out_mask = present > 0
@@ -533,36 +546,7 @@ def _ungrouped_kernel(ops: tuple[str, ...], cap: int,
 
     def kernel(val_datas, val_valids, row_mask):
         jnp = _jnp()
-        outs = []
-        for op, vd, vv in zip(ops, val_datas, val_valids):
-            if op in ("count", "countstar"):
-                w = row_mask if (vv is None or op == "countstar") else (row_mask & vv)
-                outs.append((jnp.sum(w.astype(jnp.int64)), None))
-            elif op == "sum":
-                s, c = G.masked_sum(vd, row_mask, vv)
-                outs.append((s, c > 0))
-            elif op == "sumsq":
-                x = vd.astype(jnp.float64)
-                s, c = G.masked_sum(x * x, row_mask, vv)
-                outs.append((s, c > 0))
-            elif op == "min":
-                m, has = G.masked_min(vd, row_mask, vv)
-                outs.append((m, has))
-            elif op == "max":
-                m, has = G.masked_max(vd, row_mask, vv)
-                outs.append((m, has))
-            elif op == "first":
-                w = row_mask if vv is None else (row_mask & vv)
-                pos = jnp.argmax(w)  # first True (0 if none)
-                has = jnp.any(w)
-                outs.append((vd[pos], has))
-            elif op in ("bitand", "bitor", "bitxor"):
-                w = row_mask if vv is None else (row_mask & vv)
-                seg0 = jnp.zeros(vd.shape[0], dtype=jnp.int32)
-                r, has = G.bitplane_reduce(vd, w, seg0, 1, op[3:])
-                outs.append((r[0], has[0]))
-            else:
-                raise ValueError(op)
+        outs = G.apply_global_ops(ops, val_datas, val_valids, row_mask)
         # materialize as 1-row arrays of capacity out_cap
         datas = []
         valids = []
@@ -636,7 +620,8 @@ class HashAggregateExec(PhysicalPlan):
         if self.mode == "final":
             parts = coalesce_after_exchange(self.child, parts, ctx,
                                             self.child.output)
-        return [[self._aggregate_partition(part, ctx)] for part in parts]
+        return ctx.par_map(
+            lambda part: [self._aggregate_partition(part, ctx)], parts)
 
     def _aggregate_partition(self, part: Partition, ctx) -> ColumnarBatch:
         """Aggregate one partition. Partitions larger than the blockwise
@@ -899,29 +884,7 @@ class HashAggregateExec(PhysicalPlan):
             return None
         cap = batch.capacity
 
-        stats = _batch_stats_cache(batch)
-        skey = ("dense_range", id(kc.data))
-        cached = stats.get(skey)
-        if cached is None:
-            rkey = ("krange", cap, str(kc.data.dtype),
-                    kc.validity is not None)
-
-            def build_range():
-                def kr(k, v, m):
-                    k = k.astype(jnp.int64)  # cast inside (transport cost)
-                    if v is not None:
-                        m = m & v
-                    big = jnp.iinfo(jnp.int64).max
-                    small = jnp.iinfo(jnp.int64).min
-                    return (jnp.min(jnp.where(m, k, big)),
-                            jnp.max(jnp.where(m, k, small)),
-                            jnp.any(m))
-                return jax.jit(kr)
-
-            kmin_d, kmax_d, any_d = GLOBAL_KERNEL_CACHE.get_or_build(
-                rkey, build_range)(kc.data, kc.validity, batch.row_mask)
-            cached = stats[skey] = (int(kmin_d), int(kmax_d), bool(any_d))
-        kmin, kmax, any_live = cached
+        kmin, kmax, any_live = dense_range_stats(kc, batch.row_mask, cap)
         if not any_live:
             return None
         span = kmax - kmin + 1
@@ -1087,40 +1050,40 @@ class LimitExec(PhysicalPlan):
         return [AllTuples()] if self.is_global else [UnspecifiedDistribution()]
 
     def execute(self, ctx: ExecContext) -> list[Partition]:
+        return [self._limit_partition(part, ctx)
+                for part in self.child.execute(ctx)]
+
+    def _limit_partition(self, part: Partition, ctx) -> Partition:
         import jax
 
         jnp = _jnp()
-        out = []
-        for part in self.child.execute(ctx):
-            if not part:
-                out.append([])
-                continue
-            batch = concat_batches(part, attrs_schema(self.output))
-            cap = batch.capacity
-            key = ("limit", cap, self.n, self.offset)
+        if not part:
+            return []
+        batch = concat_batches(part, attrs_schema(self.output))
+        cap = batch.capacity
+        key = ("limit", cap, self.n, self.offset)
 
-            def build():
-                def kernel(mask):
-                    rank = jnp.cumsum(mask.astype(jnp.int64))
-                    keep = mask & (rank > self.offset) & \
-                        (rank <= self.offset + self.n)
-                    return keep
+        def build():
+            def kernel(mask):
+                rank = jnp.cumsum(mask.astype(jnp.int64))
+                keep = mask & (rank > self.offset) & \
+                    (rank <= self.offset + self.n)
+                return keep
 
-                return jax.jit(kernel)
+            return jax.jit(kernel)
 
-            kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build)
-            new_mask = kernel(batch.row_mask)
-            limited = ColumnarBatch(batch.schema, batch.columns, new_mask,
-                                    num_rows=None)
-            # a local limit leaves ≤ n live rows in a full-capacity tile;
-            # compact so the gather exchange and downstream sort touch only
-            # the kept rows (the TakeOrderedAndProject shrink)
-            if not self.is_global and self.n * 4 <= cap:
-                from ..columnar.ops import compact_batch
+        kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build)
+        new_mask = kernel(batch.row_mask)
+        limited = ColumnarBatch(batch.schema, batch.columns, new_mask,
+                                num_rows=None)
+        # a local limit leaves ≤ n live rows in a full-capacity tile;
+        # compact so the gather exchange and downstream sort touch only
+        # the kept rows (the TakeOrderedAndProject shrink)
+        if not self.is_global and self.n * 4 <= cap:
+            from ..columnar.ops import compact_batch
 
-                limited = compact_batch(limited)
-            out.append([limited])
-        return out
+            limited = compact_batch(limited)
+        return [limited]
 
 
 # ---------------------------------------------------------------------------
@@ -1148,13 +1111,38 @@ class HashJoinExec(PhysicalPlan):
         # whose partition column is a join key — executing the build side
         # first lets those scans skip whole splits (DPP)
         self.dpp_targets: list = []
+        # whole-stage fusion splice (physical/fusion.py FuseStages): when a
+        # filter/project pipeline fed this join's probe side, its
+        # (filters, outputs) trace inside the probe kernel and `left` is the
+        # pipeline's child. probe_attrs = the pipeline's output attributes —
+        # the join's probe-side schema from the outside.
+        self.probe_fusion: tuple | None = None
+        self.probe_attrs: list | None = None
+        self._probe_pipe_cache: ExprPipeline | None = None
+
+    @property
+    def _left_attrs(self) -> list:
+        """Probe-side output attributes as consumers see them (after the
+        fused pipeline when one is spliced in)."""
+        return self.probe_attrs if self.probe_fusion is not None \
+            else self.left.output
+
+    def _probe_pipeline(self) -> "ExprPipeline | None":
+        if self.probe_fusion is None:
+            return None
+        if self._probe_pipe_cache is None:
+            filters, outputs = self.probe_fusion
+            self._probe_pipe_cache = ExprPipeline(
+                self.left.output, filters, outputs,
+                attrs_schema(self.probe_attrs))
+        return self._probe_pipe_cache
 
     @property
     def output(self):
         if self.join_type in ("left_semi", "left_anti"):
-            return self.left.output
+            return self._left_attrs
         ro = self.right.output
-        lo = self.left.output
+        lo = self._left_attrs
         if self.join_type in ("left_outer", "full_outer"):
             ro = [a.with_nullability(True) for a in ro]
         if self.join_type == "full_outer":
@@ -1197,12 +1185,26 @@ class HashJoinExec(PhysicalPlan):
             raise ExecutionError(
                 f"join children partition counts differ: "
                 f"{len(left_parts)} vs {len(right_parts)}")
-        out = []
+        probe_pipe = self._probe_pipeline()
+        if probe_pipe is not None and (
+                self.join_type == "full_outer"
+                or ctx.conf.get("spark.tpu.join.runtimeFilter", False)
+                or ctx.conf.get("spark.tpu.join.runtimeFilter.bloom",
+                                False)):
+            # paths that read probe key columns outside the probe kernel
+            # (anti-join of build vs probe keys, runtime filters):
+            # materialize the pipeline up front and join as if unfused
+            left_parts = [[probe_pipe.run(b) for b in p]
+                          for p in left_parts]
+            probe_pipe = None
         rschema = attrs_schema(self.right.output)
-        lschema = attrs_schema(self.left.output)
-        for lp, rp in zip(left_parts, right_parts):
-            out.append(self._join_partition(lp, rp, lschema, rschema, ctx))
-        return out
+        lschema = attrs_schema(self.left.output if probe_pipe is not None
+                               else self._left_attrs)
+        return ctx.par_map(
+            lambda pair: self._join_partition(pair[0], pair[1], lschema,
+                                              rschema, ctx,
+                                              probe_pipe=probe_pipe),
+            list(zip(left_parts, right_parts)))
 
     def _install_dpp_filters(self, right_parts, ctx) -> None:
         """Distinct build-side key values → runtime split filters on the
@@ -1245,12 +1247,22 @@ class HashJoinExec(PhysicalPlan):
         raise KeyError(target)
 
     def _join_partition(self, lp: Partition, rp: Partition, lschema, rschema,
-                        ctx, _depth: int = 0) -> Partition:
+                        ctx, _depth: int = 0, probe_pipe=None) -> Partition:
         import jax
 
         from ..ops import joining as J
 
         jnp = _jnp()
+        if probe_pipe is not None:
+            from ..config import FUSION_MIN_ROWS
+
+            if sum(b.capacity for b in lp) < int(ctx.conf.get(
+                    FUSION_MIN_ROWS)):
+                # partition too small to amortize a per-structure fused
+                # probe compile: run the shared pipeline + probe kernels
+                lp = [probe_pipe.run(b) for b in lp]
+                lschema = attrs_schema(self._left_attrs)
+                probe_pipe = None
         # Grace hash join (memory discipline): a build side over the device
         # budget is hash-fragmented together with its probe side — same key
         # hash, same fragment — and each fragment joins independently
@@ -1261,6 +1273,10 @@ class HashJoinExec(PhysicalPlan):
             budget = ctx.memory.tile_rows(rschema, amplification=4)
             build_cap = sum(b.capacity for b in rp)
             if build_cap > budget:
+                if probe_pipe is not None:
+                    # grace fragments by computed key columns: materialize
+                    lp = [probe_pipe.run(b) for b in lp]
+                    lschema = attrs_schema(self._left_attrs)
                 return self._grace_join(lp, rp, lschema, rschema, ctx,
                                         budget, build_cap)
         build = concat_batches(rp, rschema) if rp else ColumnarBatch.empty(rschema)
@@ -1274,13 +1290,14 @@ class HashJoinExec(PhysicalPlan):
             lp = [pb if _device_of(pb.row_mask) in (None, bdev)
                   else batch_to_device(pb, bdev) for pb in lp]
         rpos = {a.expr_id: i for i, a in enumerate(self.right.output)}
-        lpos = {a.expr_id: i for i, a in enumerate(self.left.output)}
+        lpos = {a.expr_id: i for i, a in enumerate(self._left_attrs)}
         bkeys = [build.columns[rpos[k.expr_id]] for k in self.right_keys]
 
         dense = self._try_dense_build(build, bkeys, ctx)
         if dense is not None:
             out_batches = [
-                self._dense_probe_batch(pb, build, dense, lpos, ctx)
+                self._dense_probe_batch(pb, build, dense, lpos, ctx,
+                                        probe_pipe)
                 for pb in (lp or [ColumnarBatch.empty(lschema)])]
             if self.join_type == "full_outer":
                 out_batches.append(
@@ -1317,7 +1334,7 @@ class HashJoinExec(PhysicalPlan):
         for pb in (lp or [ColumnarBatch.empty(lschema)]):
             out_batches.append(
                 self._probe_batch(pb, build, bindex, bkey_eqs, bkey_valids,
-                                  lpos, ctx))
+                                  lpos, ctx, probe_pipe))
         if self.join_type == "full_outer":
             out_batches.append(
                 self._unmatched_build_rows(lp, build, lschema, ctx))
@@ -1471,41 +1488,46 @@ class HashJoinExec(PhysicalPlan):
         return out
 
     def _probe_batch(self, pb: ColumnarBatch, build: ColumnarBatch, bindex,
-                     bkey_eqs, bkey_valids, lpos, ctx) -> ColumnarBatch:
+                     bkey_eqs, bkey_valids, lpos, ctx,
+                     probe_pipe=None) -> ColumnarBatch:
         import jax
 
         from ..ops import joining as J
 
         jnp = _jnp()
-        pkeys = [pb.columns[lpos[k.expr_id]] for k in self.left_keys]
-        pkey_eqs = [c.eq_keys() for c in pkeys]
-        pkey_valids = [c.validity for c in pkeys]
-
         jt = self.join_type if self.join_type != "full_outer" else "left_outer"
-        out_cap = max(pb.capacity, 1 << 10)
-        while True:
-            key = ("join_probe", jt, pb.capacity, build.capacity, out_cap,
-                   len(pkeys), tuple(str(k.dtype) for k in pkey_eqs),
-                   tuple(v is not None for v in pkey_valids),
-                   tuple(v is not None for v in bkey_valids))
+        if probe_pipe is not None:
+            pb, r = self._fused_probe(pb, bindex, bkey_eqs, bkey_valids,
+                                      ctx, jt)
+        else:
+            pkeys = [pb.columns[lpos[k.expr_id]] for k in self.left_keys]
+            pkey_eqs = [c.eq_keys() for c in pkeys]
+            pkey_valids = [c.validity for c in pkeys]
 
-            def build_kernel(oc=out_cap):
-                def kernel(bidx_sorted, bidx_perm, beqs, bvalids, peqs,
-                           pvalids, pmask):
-                    bi = J.BuildSide(bidx_sorted, bidx_perm)
-                    return J.probe_join(bi, beqs, bvalids, peqs, pvalids,
-                                        pmask, oc, jt)
+            out_cap = max(pb.capacity, 1 << 10)
+            while True:
+                key = ("join_probe", jt, pb.capacity, build.capacity, out_cap,
+                       len(pkey_eqs), tuple(str(k.dtype) for k in pkey_eqs),
+                       tuple(v is not None for v in pkey_valids),
+                       tuple(v is not None for v in bkey_valids))
 
-                return jax.jit(kernel)
+                def build_kernel(oc=out_cap):
+                    def kernel(bidx_sorted, bidx_perm, beqs, bvalids, peqs,
+                               pvalids, pmask):
+                        bi = J.BuildSide(bidx_sorted, bidx_perm)
+                        return J.probe_join(bi, beqs, bvalids, peqs, pvalids,
+                                            pmask, oc, jt)
 
-            kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build_kernel)
-            r = kernel(bindex.sorted_hash, bindex.perm, bkey_eqs, bkey_valids,
-                       pkey_eqs, pkey_valids, pb.row_mask)
-            needed = int(r.needed)
-            if needed <= out_cap:
-                break
-            out_cap = bucket_capacity(needed)
-            ctx.metrics.add("join.capacity_retry")
+                    return jax.jit(kernel)
+
+                kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build_kernel)
+                r = kernel(bindex.sorted_hash, bindex.perm, bkey_eqs,
+                           bkey_valids, pkey_eqs, pkey_valids, pb.row_mask)
+                needed = int(r.needed)
+                if needed <= out_cap:
+                    break
+                out_cap = bucket_capacity(needed)
+                ctx.metrics.add("join.capacity_retry")
 
         probe_out = gather_batch(pb, r.probe_idx, r.out_mask)
         if self.join_type in ("left_semi", "left_anti"):
@@ -1516,6 +1538,82 @@ class HashJoinExec(PhysicalPlan):
         schema = attrs_schema(self.output)
         cols = probe_out.columns + build_out.columns
         return ColumnarBatch(schema, cols, r.out_mask, num_rows=None)
+
+    def _fused_probe(self, pb: ColumnarBatch, bindex, bkey_eqs, bkey_valids,
+                     ctx, jt):
+        """Whole-stage fused probe: the probe-side filter/project pipeline
+        traces INSIDE the probe kernel — one dispatch computes the projected
+        columns, derives the join keys, and probes the build index (the
+        consume splice of the reference's codegen'd
+        BroadcastHashJoinExec.doConsume). Returns the COMPUTED probe batch
+        plus the probe result; the caller's gathers read the computed
+        columns."""
+        import jax
+
+        from ..ops import joining as J
+        from .compile import (
+            pipeline_columns, pipeline_host_pass, pipeline_signature,
+            trace_pipeline,
+        )
+        from ..types import BooleanType
+
+        jnp = _jnp()
+        filters, outputs = self.probe_fusion
+        input_attrs = self.left.output
+        pipe = self._probe_pipeline()
+        cap = pb.capacity
+        hctx, host_outs, aux = pipeline_host_pass(input_attrs, filters,
+                                                  outputs, pb)
+        opos = {a.expr_id: i for i, a in enumerate(self.probe_attrs)}
+        kidx = tuple(opos[k.expr_id] for k in self.left_keys)
+        key_bool = tuple(isinstance(self.probe_attrs[i].dtype, BooleanType)
+                         for i in kidx)
+        in_sig = pipeline_signature(pb)
+
+        out_cap = max(cap, 1 << 10)
+        while True:
+            kkey = ("fused_probe", jt, pipe._struct_key, cap,
+                    bindex.perm.shape[0], out_cap, kidx, in_sig,
+                    hctx.signature(), tuple(v is not None
+                                            for v in bkey_valids))
+
+            def build_kernel(oc=out_cap):
+                def kernel(bidx_sorted, bidx_perm, beqs, bvalids, datas,
+                           valids, pmask, aux):
+                    out_datas, out_valids, mask = trace_pipeline(
+                        input_attrs, filters, outputs, datas, valids, pmask,
+                        aux, cap)
+                    peqs = []
+                    pvalids = []
+                    for i, is_bool in zip(kidx, key_bool):
+                        kd = out_datas[i]
+                        if is_bool:
+                            kd = kd.astype(jnp.int32)
+                        peqs.append(kd)
+                        pvalids.append(out_valids[i])
+                    bi = J.BuildSide(bidx_sorted, bidx_perm)
+                    r = J.probe_join(bi, beqs, bvalids, peqs, pvalids,
+                                     mask, oc, jt)
+                    return r, out_datas, out_valids, mask
+
+                return jax.jit(kernel)
+
+            kernel = GLOBAL_KERNEL_CACHE.get_or_build(kkey, build_kernel)
+            r, out_datas, out_valids, mask = kernel(
+                bindex.sorted_hash, bindex.perm, bkey_eqs, bkey_valids,
+                [c.data for c in pb.columns],
+                [c.validity for c in pb.columns], pb.row_mask, aux)
+            needed = int(r.needed)
+            if needed <= out_cap:
+                break
+            out_cap = bucket_capacity(needed)
+            ctx.metrics.add("join.capacity_retry")
+
+        pschema = attrs_schema(self.probe_attrs)
+        cols = pipeline_columns(pschema.fields, host_outs, out_datas,
+                                out_valids)
+        computed = ColumnarBatch(pschema, cols, mask, num_rows=None)
+        return computed, r
 
     def _grace_join(self, lp: Partition, rp: Partition, lschema, rschema,
                     ctx, budget_rows: int, build_cap: int) -> Partition:
@@ -1528,7 +1626,7 @@ class HashJoinExec(PhysicalPlan):
         nfrag = -(-build_cap // max(budget_rows, 1))
         nfrag = min(256, 1 << max(1, (nfrag - 1).bit_length()))
         rpos = {a.expr_id: i for i, a in enumerate(self.right.output)}
-        lpos = {a.expr_id: i for i, a in enumerate(self.left.output)}
+        lpos = {a.expr_id: i for i, a in enumerate(self._left_attrs)}
         rk = [rpos[k.expr_id] for k in self.right_keys]
         lk = [lpos[k.expr_id] for k in self.left_keys]
         # distinct seed: the inputs are already hash-partitioned on these
@@ -1562,38 +1660,25 @@ class HashJoinExec(PhysicalPlan):
         if not isinstance(kc.dtype, (IntegralType, DateType)):
             return None
         cap = build.capacity
-        key64 = kc.data.astype(jnp.int64)
-        mask = build.row_mask if kc.validity is None \
-            else (build.row_mask & kc.validity)
 
-        rkey = ("krange", cap)
-
-        def build_range():
-            def kr(k, m):
-                big = jnp.iinfo(jnp.int64).max
-                small = jnp.iinfo(jnp.int64).min
-                return (jnp.min(jnp.where(m, k, big)),
-                        jnp.max(jnp.where(m, k, small)),
-                        jnp.any(m))
-            return jax.jit(kr)
-
-        kmin_d, kmax_d, any_d = GLOBAL_KERNEL_CACHE.get_or_build(
-            rkey, build_range)(key64, mask)
-        if not bool(any_d):
+        kmin, kmax, any_live = dense_range_stats(kc, build.row_mask, cap)
+        if not any_live:
             return None
-        kmin, kmax = int(kmin_d), int(kmax_d)
         span = kmax - kmin + 1
         if span > min(8 * cap, 1 << 23):
             return None
 
         tcap = bucket_capacity(span)
-        tkey = ("djoin_build", cap, tcap)
+        tkey = ("djoin_build", cap, tcap, str(kc.data.dtype),
+                kc.validity is not None)
 
         def build_table():
             from jax import lax
 
-            def kt(k, m, kmin_s):
-                slot = jnp.where(m, (k - kmin_s).astype(jnp.int64), tcap)
+            def kt(k, v, rm, kmin_s):
+                k = k.astype(jnp.int64)  # cast inside (transport cost)
+                m = rm if v is None else (rm & v)
+                slot = jnp.where(m, k - kmin_s, tcap)
                 rowidx = jnp.full((tcap,), 0, jnp.int32).at[slot].set(
                     lax.iota(jnp.int32, cap), mode="drop")
                 cnt = jnp.zeros((tcap,), jnp.int32).at[slot].add(
@@ -1602,52 +1687,104 @@ class HashJoinExec(PhysicalPlan):
 
             return jax.jit(kt)
 
-        rowidx, present, maxc = GLOBAL_KERNEL_CACHE.get_or_build(
-            tkey, build_table)(key64, mask, jnp.int64(kmin))
-        if int(maxc) > 1:
+        rowidx, present, maxc_d = GLOBAL_KERNEL_CACHE.get_or_build(
+            tkey, build_table)(kc.data, kc.validity, build.row_mask,
+                               jnp.int64(kmin))
+        # the duplicate-key verdict is one scalar: memoize it per build
+        # column identity so a broadcast build probed from many partitions
+        # syncs once, not once per partition
+        maxc = _memo_device_scalars(
+            ("djoin_maxc", tcap), (kc.data, kc.validity, build.row_mask),
+            lambda: int(maxc_d))
+        if maxc > 1:
             return None  # duplicate build keys → sorted-probe path
         ctx.metrics.add("join.dense_fast_path")
         return {"rowidx": rowidx, "present": present, "kmin": kmin,
                 "tcap": tcap}
 
     def _dense_probe_batch(self, pb: ColumnarBatch, build: ColumnarBatch,
-                           dense, lpos, ctx) -> ColumnarBatch:
+                           dense, lpos, ctx, probe_pipe=None) -> ColumnarBatch:
         import jax
 
         jnp = _jnp()
-        kc = pb.columns[lpos[self.left_keys[0].expr_id]]
         cap = pb.capacity
         tcap = dense["tcap"]
         jt = self.join_type if self.join_type != "full_outer" else "left_outer"
 
-        key = ("djoin_probe", jt, cap, tcap, kc.validity is not None)
+        def probe_body(k64, pvalid, pmask, rowidx, present, kmin_s):
+            k = k64 - kmin_s
+            in_range = (k >= 0) & (k < tcap)
+            slot = jnp.clip(k, 0, tcap - 1)
+            usable = pmask & in_range
+            if pvalid is not None:
+                usable = usable & pvalid
+            matched = usable & (jnp.take(present, slot) > 0)
+            bidx = jnp.take(rowidx, slot)
+            if jt == "inner":
+                out_mask = matched
+            elif jt == "left_outer":
+                out_mask = pmask
+            elif jt == "left_semi":
+                out_mask = matched
+            else:  # left_anti
+                out_mask = pmask & ~matched
+            return bidx, matched, out_mask
 
-        def build_kernel():
-            def kp(pkey, pvalid, pmask, rowidx, present, kmin_s):
-                k = pkey.astype(jnp.int64) - kmin_s
-                in_range = (k >= 0) & (k < tcap)
-                slot = jnp.clip(k, 0, tcap - 1)
-                usable = pmask & in_range
-                if pvalid is not None:
-                    usable = usable & pvalid
-                matched = usable & (jnp.take(present, slot) > 0)
-                bidx = jnp.take(rowidx, slot)
-                if jt == "inner":
-                    out_mask = matched
-                elif jt == "left_outer":
-                    out_mask = pmask
-                elif jt == "left_semi":
-                    out_mask = matched
-                else:  # left_anti
-                    out_mask = pmask & ~matched
-                return bidx, matched, out_mask
+        if probe_pipe is not None:
+            # fused: the probe-side pipeline traces inside the dense-probe
+            # kernel; the computed batch comes back with the probe result
+            from .compile import (
+                pipeline_columns, pipeline_host_pass, pipeline_signature,
+                trace_pipeline,
+            )
 
-            return jax.jit(kp)
+            filters, outputs = self.probe_fusion
+            input_attrs = self.left.output
+            hctx, host_outs, aux = pipeline_host_pass(input_attrs, filters,
+                                                      outputs, pb)
+            opos = {a.expr_id: i for i, a in enumerate(self.probe_attrs)}
+            ki = opos[self.left_keys[0].expr_id]
+            pipe = self._probe_pipeline()
+            key = ("fused_djoin_probe", jt, pipe._struct_key, cap, tcap, ki,
+                   pipeline_signature(pb), hctx.signature())
 
-        kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build_kernel)
-        bidx, matched, out_mask = kernel(
-            kc.data, kc.validity, pb.row_mask, dense["rowidx"],
-            dense["present"], jnp.int64(dense["kmin"]))
+            def build_fused():
+                def kp(datas, valids, pmask, aux, rowidx, present, kmin_s):
+                    out_datas, out_valids, mask = trace_pipeline(
+                        input_attrs, filters, outputs, datas, valids, pmask,
+                        aux, cap)
+                    k64 = out_datas[ki].astype(jnp.int64)
+                    bidx, matched, out_mask = probe_body(
+                        k64, out_valids[ki], mask, rowidx, present, kmin_s)
+                    return bidx, matched, out_mask, out_datas, out_valids
+
+                return jax.jit(kp)
+
+            kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build_fused)
+            bidx, matched, out_mask, out_datas, out_valids = kernel(
+                [c.data for c in pb.columns],
+                [c.validity for c in pb.columns], pb.row_mask, aux,
+                dense["rowidx"], dense["present"], jnp.int64(dense["kmin"]))
+            pschema = attrs_schema(self.probe_attrs)
+            cols = pipeline_columns(pschema.fields, host_outs, out_datas,
+                                    out_valids)
+            pb = ColumnarBatch(pschema, cols, out_mask, num_rows=None)
+        else:
+            kc = pb.columns[lpos[self.left_keys[0].expr_id]]
+            key = ("djoin_probe", jt, cap, tcap, str(kc.data.dtype),
+                   kc.validity is not None)
+
+            def build_kernel():
+                def kp(pkey, pvalid, pmask, rowidx, present, kmin_s):
+                    return probe_body(pkey.astype(jnp.int64), pvalid, pmask,
+                                      rowidx, present, kmin_s)
+
+                return jax.jit(kp)
+
+            kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build_kernel)
+            bidx, matched, out_mask = kernel(
+                kc.data, kc.validity, pb.row_mask, dense["rowidx"],
+                dense["present"], jnp.int64(dense["kmin"]))
 
         if self.join_type in ("left_semi", "left_anti"):
             return ColumnarBatch(pb.schema, pb.columns, out_mask,
@@ -1668,7 +1805,7 @@ class HashJoinExec(PhysicalPlan):
         jnp = _jnp()
         probe_all = concat_batches(lp, lschema) if lp \
             else ColumnarBatch.empty(lschema)
-        lpos = {a.expr_id: i for i, a in enumerate(self.left.output)}
+        lpos = {a.expr_id: i for i, a in enumerate(self._left_attrs)}
         pkeys = [probe_all.columns[lpos[k.expr_id]] for k in self.left_keys]
         pkey_eqs = [c.eq_keys() for c in pkeys]
         pkey_valids = [c.validity for c in pkeys]
@@ -1684,7 +1821,7 @@ class HashJoinExec(PhysicalPlan):
                          build.row_mask, out_cap, "left_anti")
         build_rows = gather_batch(build, r.probe_idx, r.out_mask)
         schema = attrs_schema(self.output)
-        nl = len(self.left.output)
+        nl = len(self._left_attrs)
         from ..columnar.batch import EMPTY_DICT
 
         jnpmod = _jnp()
@@ -1702,7 +1839,15 @@ class HashJoinExec(PhysicalPlan):
         k = ", ".join(f"{l.name}={r.name}"
                       for l, r in zip(self.left_keys, self.right_keys))
         b = "Broadcast" if self.is_broadcast else "Shuffled"
-        return f"{b}HashJoin[{self.join_type}]({k})"
+        s = f"{b}HashJoin[{self.join_type}]({k})"
+        if self.probe_fusion is not None:
+            filters, outputs = self.probe_fusion
+            o = ", ".join(x.simple_string() for x in outputs)
+            s += f" FUSED-PROBE[{o}]"
+            if filters:
+                s += " WHERE " + " AND ".join(x.simple_string()
+                                              for x in filters)
+        return s
 
 
 class NestedLoopJoinExec(PhysicalPlan):

@@ -21,7 +21,7 @@ from typing import Sequence
 from ..config import AUTO_BROADCAST_THRESHOLD, SHUFFLE_PARTITIONS, SQLConf
 from ..errors import UnsupportedOperationError
 from ..plan import logical as L
-from ..plan.optimizer import join_conjuncts, split_conjuncts, substitute_attrs
+from ..plan.optimizer import join_conjuncts, split_conjuncts
 from ..expr.expressions import (
     AggregateFunction, Alias, AttributeReference, EqualTo, Expression,
     Literal, SortOrder,
@@ -54,8 +54,17 @@ class Planner:
 
     # ------------------------------------------------------------------
     def plan(self, plan: L.LogicalPlan) -> PhysicalPlan:
+        from ..config import FUSION_ENABLED
+        from .fusion import collapse_computes, fuse_stages
+
         p = self._convert(plan)
         p = self._ensure_requirements(p)
+        # whole-stage fusion after stage boundaries exist (the
+        # CollapseCodegenStages slot); off = operator-at-a-time oracle.
+        # Adjacent-ComputeExec collapsing is an invariant, not a mode.
+        p = collapse_computes(p)
+        if self.conf.get(FUSION_ENABLED):
+            p = fuse_stages(p, self.conf)
         self._inject_dpp(p)
         return p
 
@@ -267,30 +276,12 @@ class Planner:
                       outputs: list[Expression],
                       child: PhysicalPlan) -> PhysicalPlan:
         """Fuse into an existing ComputeExec child when safe (the
-        CollapseCodegenStages analog)."""
+        CollapseCodegenStages analog; substitution shared with the
+        FuseStages collapse pass in physical/fusion.py)."""
         if isinstance(child, ComputeExec):
-            # child outputs: mapping from its output ids to its exprs
-            m: dict[int, Expression] = {}
-            for e in child.outputs:
-                if isinstance(e, Alias):
-                    m[e.expr_id] = e.child
-                elif isinstance(e, AttributeReference):
-                    m[e.expr_id] = e
-            new_filters = [substitute_attrs(f, m) for f in filters]
-            new_outputs: list[Expression] = []
-            for o in outputs:
-                if isinstance(o, Alias):
-                    new_outputs.append(
-                        Alias(substitute_attrs(o.child, m), o.name, o.expr_id))
-                    continue
-                sub = m.get(o.expr_id)
-                if sub is None or (isinstance(sub, AttributeReference)
-                                   and sub.expr_id == o.expr_id):
-                    new_outputs.append(o)
-                else:
-                    new_outputs.append(Alias(sub, o.name, o.expr_id))
-            return ComputeExec(child.filters + new_filters, new_outputs,
-                               child.child)
+            from .fusion import merge_into_compute
+
+            return merge_into_compute(filters, outputs, child)
         return ComputeExec(filters, outputs, child)
 
     # ------------------------------------------------------------------

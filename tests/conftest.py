@@ -49,6 +49,9 @@ def spark():
 
     conf = {"spark.sql.shuffle.partitions": 4,
             "spark.tpu.batch.capacity": 1 << 12}
+    import os as _os
+    if _os.environ.get("SPARK_TPU_TEST_FUSION"):
+        conf["spark.tpu.fusion.enabled"] = _os.environ["SPARK_TPU_TEST_FUSION"]
     if os.environ.get("SPARK_TPU_VALIDATE") == "1":
         conf["spark.tpu.debug.validateBatches"] = "true"
     s = TpuSession("tests", conf)

@@ -1,0 +1,256 @@
+"""Whole-stage kernel fusion (physical/fusion.py): differential tests
+against the unfused operator-at-a-time oracle
+(spark.tpu.fusion.enabled=false), plus dispatch-count regressions over the
+KernelCache launch counters — the reference gates WholeStageCodegen the
+same way (codegen on/off differential suites + codegen-metrics checks)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_tpu.api.functions as F
+from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+
+@pytest.fixture()
+def fusion_spark(spark):
+    """Session fixture forcing the FUSED runtime path (the size gate
+    `spark.tpu.fusion.minRows` would otherwise route test-sized partitions
+    to the shared unfused kernels); restores conf after each test."""
+    spark.conf.set("spark.tpu.fusion.minRows", "0")
+    yield spark
+    spark.conf.unset("spark.tpu.fusion.enabled")
+    spark.conf.unset("spark.tpu.fusion.minRows")
+
+
+def _differential(spark, build_query, sort_cols):
+    """Run the same query fused and unfused; compare row-for-row."""
+    outs = {}
+    for enabled in (True, False):
+        spark.conf.set("spark.tpu.fusion.enabled", str(enabled).lower())
+        outs[enabled] = build_query().toPandas() \
+            .sort_values(sort_cols).reset_index(drop=True)
+    spark.conf.unset("spark.tpu.fusion.enabled")
+    got, want = outs[True], outs[False]
+    assert list(got.columns) == list(want.columns)
+    assert len(got) == len(want), f"{len(got)} vs {len(want)} rows"
+    for c in got.columns:
+        g, w = got[c].to_numpy(), want[c].to_numpy()
+        if np.issubdtype(np.asarray(w).dtype, np.floating):
+            # the fused path merges per-batch partials (associative
+            # reordering of float adds); everything else must be identical
+            np.testing.assert_allclose(g.astype(float), w.astype(float),
+                                       rtol=1e-12, atol=1e-12)
+        else:
+            assert list(g) == list(w), f"column {c} differs"
+
+
+@pytest.fixture()
+def data(spark):
+    rng = np.random.default_rng(7)
+    n = 5000
+    spark.createDataFrame(pa.table({
+        "k": rng.integers(0, 13, n),
+        "v": rng.integers(-50, 100, n),
+        "f": rng.random(n),
+        "s": [f"cat{i % 5}" for i in range(n)],
+    })).createOrReplaceTempView("fu_t")
+    dim = pa.table({
+        "dk": np.arange(13, dtype=np.int64),
+        "label": [f"lab{i % 3}" for i in range(13)],
+    })
+    spark.createDataFrame(dim).createOrReplaceTempView("fu_dim")
+    return spark
+
+
+def test_filter_project_agg_differential(fusion_spark, data):
+    spark = data
+    _differential(
+        spark,
+        lambda: spark.sql(
+            "select k, sum(v * 2) sv, count(*) c, min(v) mn, max(v+1) mx, "
+            "avg(f) af from fu_t where v > 0 group by k"),
+        ["k"])
+
+
+def test_ungrouped_agg_differential(fusion_spark, data):
+    spark = data
+    _differential(
+        spark,
+        lambda: spark.sql(
+            "select count(*) c, sum(v) sv, min(v) mn from fu_t "
+            "where v % 3 = 0"),
+        ["c"])
+
+
+def test_string_group_keys_differential(fusion_spark, data):
+    spark = data
+    _differential(
+        spark,
+        lambda: spark.sql(
+            "select s, k, count(*) c, sum(v) sv from fu_t "
+            "where v != 7 group by s, k"),
+        ["s", "k"])
+
+
+def test_join_plus_agg_differential(fusion_spark, data):
+    spark = data
+    _differential(
+        spark,
+        lambda: spark.sql(
+            "select label, sum(v) sv, count(*) c from fu_t "
+            "join fu_dim on k = dk where v > 10 group by label"),
+        ["label"])
+
+
+def test_limit_differential(fusion_spark, data):
+    spark = data
+    # deterministic limit: values are unique per row position
+    _differential(
+        spark,
+        lambda: spark.sql(
+            "select k + v * 100 as key2 from fu_t where v > 95 "
+            "order by key2 limit 17"),
+        ["key2"])
+
+
+def test_tpcds_mini_q3_q7_differential(fusion_spark, spark):
+    from tpcds_mini import register_tpcds
+
+    register_tpcds(spark)
+    q3 = """
+        SELECT dt.d_year, item.i_brand_id AS brand_id,
+               SUM(ss_ext_sales_price) AS sum_agg
+        FROM date_dim dt, store_sales, item
+        WHERE dt.d_date_sk = store_sales.ss_sold_date_sk
+          AND store_sales.ss_item_sk = item.i_item_sk
+          AND item.i_manufact_id = 28 AND dt.d_moy = 11
+        GROUP BY dt.d_year, item.i_brand_id"""
+    q7 = """
+        SELECT i.i_category, AVG(ss_quantity) AS agg1, COUNT(*) AS cnt
+        FROM store_sales ss
+        JOIN item i ON ss.ss_item_sk = i.i_item_sk
+        JOIN date_dim d ON ss.ss_sold_date_sk = d.d_date_sk
+        WHERE d.d_year = 1999
+        GROUP BY i.i_category"""
+    _differential(spark, lambda: spark.sql(q3), ["d_year", "brand_id"])
+    _differential(spark, lambda: spark.sql(q7), ["i_category"])
+
+
+# ---------------------------------------------------------------------------
+# Dispatch-count regressions
+# ---------------------------------------------------------------------------
+
+def _kind_delta(run):
+    """launches_by_kind delta around `run()`."""
+    before = dict(KC.launches_by_kind)
+    run()
+    after = dict(KC.launches_by_kind)
+    return {k: v - before.get(k, 0) for k, v in after.items()
+            if v != before.get(k, 0)}
+
+
+def test_fused_stage_single_launch_per_batch(fusion_spark, spark):
+    """Acceptance: a scan→filter→project→partial-agg stage executes as ONE
+    cached jitted program per input batch."""
+    cap = 1 << 12  # the session fixture's spark.tpu.batch.capacity
+    n_batches = 4
+    rng = np.random.default_rng(3)
+    t = pa.table({"k": rng.integers(0, 8, cap * n_batches),
+                  "v": rng.integers(0, 100, cap * n_batches)})
+    spark.conf.set("spark.tpu.fusion.enabled", "true")
+    df = spark.createDataFrame(t)
+    q = lambda: (df.filter(F.col("v") > 25)  # noqa: E731
+                 .withColumn("v2", F.col("v") * 3)
+                 .groupBy("k").agg(F.sum("v2").alias("s"))
+                 .toArrow())
+    q()  # warm: compile kernels, device-cache the scan
+    delta = _kind_delta(q)
+    # the fused stage: exactly one launch per input batch, and NO separate
+    # pipeline launches for the stage's filter/project (the only pipeline
+    # kernel left is the buffer→result finishing projection)
+    assert delta.get("fused_agg", 0) == n_batches, delta
+    assert delta.get("pipeline", 0) <= 1, delta
+    # merge of per-batch partials + finish: small constant overhead
+    total = sum(delta.values())
+    assert total <= n_batches + 4, delta
+
+
+def test_fusion_reduces_dispatches_vs_oracle(fusion_spark, spark):
+    rng = np.random.default_rng(4)
+    t = pa.table({"k": rng.integers(0, 8, 3000),
+                  "v": rng.integers(0, 100, 3000)})
+    df = spark.createDataFrame(t)
+
+    def run():
+        (df.filter(F.col("v") > 25).withColumn("v2", F.col("v") * 3)
+         .groupBy("k").agg(F.sum("v2").alias("s")).toArrow())
+
+    counts = {}
+    for enabled in ("true", "false"):
+        spark.conf.set("spark.tpu.fusion.enabled", enabled)
+        run()  # warm this mode's kernels
+        counts[enabled] = sum(_kind_delta(run).values())
+    assert counts["true"] < counts["false"], counts
+
+
+def test_structurally_identical_queries_share_kernels(fusion_spark, spark):
+    """Two plans with the same shape (different attribute ids/tables) hit
+    the same cache entries — zero compile misses on the second query."""
+    rng = np.random.default_rng(5)
+
+    def make(seed):
+        t = pa.table({"a": rng.integers(0, 9, 2000),
+                      "b": rng.integers(0, 50, 2000)})
+        return spark.createDataFrame(t)
+
+    spark.conf.set("spark.tpu.fusion.enabled", "true")
+
+    def q(df):
+        return (df.filter(F.col("b") > 5).groupBy("a")
+                .agg(F.sum("b").alias("s")).toArrow())
+
+    q(make(1))  # compiles
+    misses_before = KC.misses
+    q(make(2))  # structurally identical: every kernel is a cache hit
+    assert KC.misses == misses_before
+
+
+def test_adjacent_computes_collapse(fusion_spark, spark):
+    """A ComputeExec over a ComputeExec must merge into one pipeline."""
+    from spark_tpu.physical.operators import ComputeExec
+
+    rng = np.random.default_rng(6)
+    t = pa.table({"x": rng.integers(0, 100, 500)})
+    df = (spark.createDataFrame(t)
+          .withColumn("y", F.col("x") * 2)
+          .filter(F.col("y") > 10)
+          .select((F.col("y") + 1).alias("z")))
+    plan = df.query_execution.physical
+    for node in plan.iter_nodes():
+        if isinstance(node, ComputeExec):
+            assert not isinstance(node.child, ComputeExec), \
+                plan.tree_string()
+    out = df.toPandas()
+    want = t.to_pandas()
+    want["y"] = want.x * 2
+    want = want[want.y > 10]
+    assert sorted(out["z"]) == sorted((want.y + 1).tolist())
+
+
+def test_dense_range_sync_memoized_across_batches(fusion_spark, spark):
+    """Repeated executions over device-cached scan batches must not re-sync
+    the dense-range scalars: the krange kernel fires once per distinct
+    column identity, not once per run."""
+    rng = np.random.default_rng(8)
+    t = pa.table({"k": rng.integers(0, 16, 4000),
+                  "v": rng.integers(0, 10, 4000)})
+    spark.conf.set("spark.tpu.fusion.enabled", "true")
+    df = spark.createDataFrame(t)
+
+    def run():
+        df.groupBy("k").agg(F.count("*").alias("c")).toArrow()
+
+    run()  # warm: scan batches device-cached, ranges memoized
+    delta = _kind_delta(run)
+    assert delta.get("krange3", 0) == 0, delta

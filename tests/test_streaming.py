@@ -599,14 +599,24 @@ def test_socket_source_streams_lines(spark):
           .option("host", "127.0.0.1").option("port", port).load())
     q = (df.writeStream.format("memory").queryName("sock_out")
          .outputMode("append").start())
+    from spark_tpu.errors import AnalysisException
+
+    def poll():
+        # the memory sink registers its view on the first committed batch;
+        # a poll racing that registration reads "view not found", not rows
+        try:
+            return [r["value"] for r in
+                    spark.sql("SELECT * FROM sock_out").collect()]
+        except AnalysisException:
+            return []
+
     try:
         t.join(timeout=10)
         deadline = _time.monotonic() + 15
         got = []
         while _time.monotonic() < deadline:
             q.processAllAvailable()
-            got = [r["value"] for r in
-                   spark.sql("SELECT * FROM sock_out").collect()]
+            got = poll()
             if len(got) >= 2:
                 break
             _time.sleep(0.1)
@@ -615,8 +625,7 @@ def test_socket_source_streams_lines(spark):
         deadline = _time.monotonic() + 15
         while _time.monotonic() < deadline:
             q.processAllAvailable()
-            got = [r["value"] for r in
-                   spark.sql("SELECT * FROM sock_out").collect()]
+            got = poll()
             if len(got) >= 3:
                 break
             _time.sleep(0.1)
