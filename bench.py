@@ -43,6 +43,35 @@ if SMOKE:
     sys.argv = [a for a in sys.argv if a != "--smoke"]
     SCALE = min(SCALE, 0.002)
 
+# --analyze: before timing each config, run the static plan analyzer
+# (spark_tpu/analysis/plan_lint.py) on its main query and emit one JSON
+# record with the predicted per-kind launch counts — the measured
+# kernel_launches delta on the same record trail is its ground truth.
+ANALYZE = "--analyze" in sys.argv
+if ANALYZE:
+    sys.argv = [a for a in sys.argv if a != "--analyze"]
+
+
+def _maybe_analyze(df, name: str):
+    """`df` may be a DataFrame or a zero-arg callable producing one (so
+    plan construction also stays inside the never-sink-the-bench guard)."""
+    if not ANALYZE:
+        return
+    try:
+        if callable(df):
+            df = df()
+        rep = df.query_execution.analysis_report()
+        _emit({"metric": f"analysis:{name}", "value": rep.total,
+               "unit": "predicted launches/run", "vs_baseline": 1.0,
+               "exact": rep.exact,
+               "predicted_launches": rep.predicted_launches,
+               "fusion_boundaries": rep.fusion_boundaries[:6],
+               "recompile_hazards": rep.recompile_hazards[:6]})
+    except Exception as e:  # analysis must never sink a bench run
+        _emit({"metric": f"analysis:{name} FAILED", "value": 0,
+               "unit": "error", "vs_baseline": 0.0,
+               "error": f"{type(e).__name__}: {e}"[:200]})
+
 
 def _device_init_alive(timeout: float = 30.0) -> bool:
     """Single source of truth: __graft_entry__.accelerator_healthy (probes
@@ -187,6 +216,7 @@ def bench_groupby():
     })
     df = _df_from_table(session, table, "agg_bench")
     q = df.groupBy("k").agg(F.sum("v").alias("s"))
+    _maybe_analyze(q, "groupby")
     best = _best_of(lambda: _run_blocked(q))
     rate = n_rows / best
     return {
@@ -215,6 +245,7 @@ def bench_sort():
                                         n_rows, dtype=np.int64)})
     df = _df_from_table(session, table, "sort_bench")
     q = df.orderBy("k")
+    _maybe_analyze(q, "sort")
     best = _best_of(lambda: _run_blocked(q))
     rate = n_rows / best
     return {
@@ -256,6 +287,7 @@ def bench_join():
     q = (f.join(d, f["ss_sold_date_sk"] == d["d_date_sk"])
           .groupBy("d_year")
           .agg(F.sum("ss_ext_sales_price").alias("rev")))
+    _maybe_analyze(q, "join")
     best = _best_of(lambda: _run_blocked(q))
     rate = n_fact / best
     return {
@@ -344,6 +376,7 @@ def bench_tpcds():
     for qname, ref_ms in TPCDS_REF_MS.items():
         sql = strip_trailing_limit(
             open(os.path.join(qdir, f"{qname}.sql")).read())
+        _maybe_analyze(lambda: session.sql(sql), f"tpcds-{qname}")
 
         def run():
             t0 = time.perf_counter()

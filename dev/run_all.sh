@@ -4,6 +4,9 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "== tpulint (static analysis vs baseline) =="
+python dev/tpulint.py spark_tpu --baseline dev/tpulint_baseline.json
+
 echo "== native build =="
 make -C native
 

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""tpulint: static analyzer CLI for host-sync / recompile / fusion hazards.
+
+Source-level pass over spark_tpu/ (AST only — no jax import, no device
+work; safe inside the tier-1 budget). Rules: host-sync, row-loop, raw-jit,
+config-key — see spark_tpu/analysis/lint.py. The plan/trace-level pass is
+its sibling: df.explain("analysis") / QueryExecution.analysis_report().
+
+Usage:
+  python dev/tpulint.py [paths...] [--baseline dev/tpulint_baseline.json]
+                        [--write-baseline] [--rule RULE] [--format text|json]
+
+Exit codes: 0 clean (or all violations baselined), 1 new violations,
+2 usage error. The baseline counts violations per (file, rule) bucket, so
+existing debt doesn't block CI while NEW violations do.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+# Import the lint module directly off its file path: `import spark_tpu`
+# pulls in the whole engine (and jax); the AST lint must stay light enough
+# for CI's tier-1 budget.
+import importlib.util
+
+_spec = importlib.util.spec_from_file_location(
+    "tpulint_impl", os.path.join(_ROOT, "spark_tpu", "analysis", "lint.py"))
+lint = importlib.util.module_from_spec(_spec)
+sys.modules["tpulint_impl"] = lint
+_spec.loader.exec_module(lint)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpulint", description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(_ROOT, "spark_tpu")])
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON; violations beyond its per-bucket "
+                         "counts fail the run")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="(re)write the baseline from the current state "
+                         "and exit 0")
+    ap.add_argument("--rule", action="append", default=None,
+                    choices=list(lint.RULES),
+                    help="restrict to specific rule(s)")
+    ap.add_argument("--format", default="text", choices=("text", "json"))
+    args = ap.parse_args(argv)
+    if args.write_baseline and args.rule:
+        ap.error("--write-baseline with --rule would drop every other "
+                 "rule's buckets from the baseline; run it unfiltered")
+
+    paths = [p if os.path.isabs(p) else os.path.join(os.getcwd(), p)
+             for p in args.paths]
+    violations = lint.lint_paths(paths, repo_root=_ROOT)
+    if args.rule:
+        violations = [v for v in violations if v.rule in set(args.rule)]
+
+    if args.write_baseline:
+        target = args.baseline or os.path.join(_HERE,
+                                               "tpulint_baseline.json")
+        lint.write_baseline(target, violations)
+        print(f"tpulint: baseline written to {target} "
+              f"({len(violations)} violations over "
+              f"{len(lint.baseline_counts(violations))} buckets)")
+        return 0
+
+    if args.baseline:
+        baseline = lint.load_baseline(args.baseline)
+        offending = lint.new_violations(violations, baseline)
+        label = "new violation(s) beyond baseline"
+    else:
+        baseline = {}
+        offending = violations
+        label = "violation(s)"
+
+    if args.format == "json":
+        print(json.dumps({
+            "total": len(violations),
+            "new": [v.__dict__ for v in offending],
+        }, indent=1))
+    else:
+        for v in offending:
+            print(v)
+        by_rule = {}
+        for v in violations:
+            by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
+        summary = ", ".join(f"{r}:{n}" for r, n in sorted(by_rule.items()))
+        print(f"tpulint: {len(violations)} total ({summary or 'clean'}); "
+              f"{len(offending)} {label}")
+    return 1 if offending else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

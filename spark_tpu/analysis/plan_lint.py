@@ -1,0 +1,1241 @@
+"""Plan/trace-level static analyzer: launches-per-batch, fusion boundaries,
+recompile and overflow hazards.
+
+Role of the reference's EXPLAIN CODEGEN / debugCodegen surface
+(sqlx/execution/debug/package.scala — which operators got whole-stage
+codegen and why the rest fell back) extended with the numbers that matter
+on a TPU: for every stage of the optimized physical plan, how many XLA
+dispatches one warm execution performs, per batch, and why.
+
+The analyzer performs an ABSTRACT interpretation of the physical plan:
+
+  * a layout model — partitions × fixed-capacity batches — propagated from
+    the scans (local relations expose exact row counts; capacity-bucket
+    math mirrors columnar/batch.bucket_capacity);
+  * an identity model — whether a batch's device arrays are the SAME
+    objects across repeated executions (device-cached scans) or fresh per
+    run: the memoized device-scalar reads (utils/
+    device_memo.memo_device_scalars) launch their probe kernel only on fresh arrays;
+  * a value model — for columns that trace to local arrow data through
+    mask-only operators and literal predicates, exact host statistics
+    (span / uniqueness / match cardinality) that decide the value-dependent
+    branches: dense-scatter vs sorted-segment aggregation, dense vs sorted
+    join build, probe-capacity retries.
+
+Where a branch cannot be decided statically the report degrades honestly:
+``exact`` flips to False and the reason is listed. On the fusion
+differential suite (single-partition local relations, broadcast joins)
+predictions are EXACT and tests/test_plan_analysis.py asserts them against
+the measured KernelCache launch counters, fusion on and off.
+
+Kernel-kind legend (KernelCache key tags): pipeline, fused_agg, uagg/dagg/
+gagg, krange3 (dense-range scalar probe), fused_limit, limit, sort,
+join_build/join_probe, fused_probe, djoin_build/djoin_probe,
+fused_djoin_probe, shuffle_pids/shuffle_hash/shuffle_rr/shuffle_range,
+mesh_exchange, sample.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..columnar.batch import bucket_capacity
+from ..config import (
+    ADAPTIVE_ENABLED, AGG_BLOCK_ROWS, BATCH_CAPACITY, BLOOM_JOIN_FILTER,
+    COALESCE_PARTITIONS_ENABLED, FUSION_DENSE_KEYS, FUSION_ENABLED,
+    FUSION_MIN_ROWS, MESH_ENABLED, MINMAX_JOIN_FILTER, SQLConf,
+)
+from ..expr.expressions import (
+    Alias, AttributeReference, EqualTo, GreaterThan, GreaterThanOrEqual, In,
+    IsNotNull, LessThan, LessThanOrEqual, Literal, NotEqualTo,
+)
+from ..types import DateType, IntegralType, StringType, dict_encoded
+
+__all__ = ["AnalysisReport", "analyze_plan"]
+
+_EMPTY_CAP = 1 << 10   # ColumnarBatch.empty capacity
+_DENSE_AGG_LIMIT = 1 << 23
+_DENSE_JOIN_LIMIT = 1 << 23
+_TRACE_MAX_ROWS = 1 << 22  # don't drag huge host columns into the analyzer
+
+
+# ---------------------------------------------------------------------------
+# models
+# ---------------------------------------------------------------------------
+
+@dataclass
+class _Batch:
+    rows: Optional[int]      # live-row upper bound (None = unknown)
+    cap: Optional[int]       # device tile capacity (None = unknown)
+    stable: bool             # same device arrays across executions
+
+
+@dataclass
+class _Trace:
+    """Host value model: per-attribute raw columns traced to local data."""
+    cols: dict            # expr_id -> (np values, np validity | None)
+    live: np.ndarray      # row mask after the traced filter chain
+    consecutive: bool = True   # rows still slice into batches in order
+
+    def stats(self, expr_id):
+        """(values_under_live_and_valid,) or None."""
+        ent = self.cols.get(expr_id)
+        if ent is None:
+            return None
+        vals, valid = ent
+        m = self.live if valid is None else (self.live & valid)
+        return vals[m]
+
+
+@dataclass
+class _Flow:
+    parts: list                       # list[list[_Batch]]
+    trace: Optional[_Trace] = None
+    counted: bool = True              # batch counts are known exactly
+
+    @property
+    def total_batches(self):
+        return sum(len(p) for p in self.parts)
+
+
+@dataclass
+class AnalysisReport:
+    stages: list = field(default_factory=list)
+    predicted_launches: dict = field(default_factory=dict)
+    exact: bool = True
+    inexact_reasons: list = field(default_factory=list)
+    fusion_boundaries: list = field(default_factory=list)
+    recompile_hazards: list = field(default_factory=list)
+    overflow_risks: list = field(default_factory=list)
+    host_sync_notes: list = field(default_factory=list)
+
+    @property
+    def total(self) -> int:
+        return sum(self.predicted_launches.values())
+
+    def to_dict(self) -> dict:
+        return {
+            "stages": list(self.stages),
+            "predicted_launches": dict(self.predicted_launches),
+            "predicted_total": self.total,
+            "exact": self.exact,
+            "inexact_reasons": list(self.inexact_reasons),
+            "fusion_boundaries": list(self.fusion_boundaries),
+            "recompile_hazards": list(self.recompile_hazards),
+            "overflow_risks": list(self.overflow_risks),
+            "host_sync_notes": list(self.host_sync_notes),
+        }
+
+    def render(self) -> str:
+        out = ["== Plan Analysis =="]
+        out.append("-- stages (kernel launches per warm execution) --")
+        for s in self.stages:
+            kinds = ", ".join(f"{k}:{v}" for k, v in sorted(
+                s["kinds"].items())) or "none"
+            lpb = s.get("launches_per_batch")
+            lpb_s = f", {lpb:g}/batch" if lpb is not None else ""
+            out.append(f"  {s['op']}: {{{kinds}}} over "
+                       f"{s['batches']} batch(es){lpb_s}")
+            for n in s.get("notes", ()):
+                out.append(f"      - {n}")
+        pred = ", ".join(f"{k}:{v}" for k, v in sorted(
+            self.predicted_launches.items()))
+        tag = "EXACT" if self.exact else "approximate"
+        out.append(f"-- predicted launches ({tag}): total {self.total} "
+                   f"{{{pred}}} --")
+        for r in self.inexact_reasons:
+            out.append(f"  ? {r}")
+        if self.fusion_boundaries:
+            out.append("-- fusion boundaries --")
+            out.extend(f"  * {b}" for b in self.fusion_boundaries)
+        if self.recompile_hazards:
+            out.append("-- recompile hazards --")
+            out.extend(f"  ! {h}" for h in self.recompile_hazards)
+        if self.overflow_risks:
+            out.append("-- dtype overflow risks --")
+            out.extend(f"  ! {h}" for h in self.overflow_risks)
+        if self.host_sync_notes:
+            out.append("-- host-sync notes --")
+            out.extend(f"  . {h}" for h in self.host_sync_notes)
+        return "\n".join(out)
+
+
+# ---------------------------------------------------------------------------
+# host predicate evaluation over traced columns
+# ---------------------------------------------------------------------------
+
+_CMP = {EqualTo: "==", NotEqualTo: "!=", GreaterThan: ">",
+        GreaterThanOrEqual: ">=", LessThan: "<", LessThanOrEqual: "<="}
+
+
+def _eval_filter(e, trace: _Trace):
+    """Boolean mask where the predicate holds (nulls → False), or None when
+    the predicate is outside the traced language."""
+    if isinstance(e, IsNotNull) and isinstance(e.child, AttributeReference):
+        ent = trace.cols.get(e.child.expr_id)
+        if ent is None:
+            return None
+        vals, valid = ent
+        return np.ones(len(vals), bool) if valid is None else valid.copy()
+    if type(e) in _CMP:
+        l, r = e.left, e.right
+        if isinstance(l, Literal) and isinstance(r, AttributeReference):
+            l, r = r, l
+            op = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+                  "==": "==", "!=": "!="}[_CMP[type(e)]]
+        else:
+            op = _CMP[type(e)]
+        if not (isinstance(l, AttributeReference) and isinstance(r, Literal)
+                and r.value is not None):
+            return None
+        ent = trace.cols.get(l.expr_id)
+        if ent is None:
+            return None
+        vals, valid = ent
+        fns = {"==": np.equal, "!=": np.not_equal, ">": np.greater,
+               ">=": np.greater_equal, "<": np.less, "<=": np.less_equal}
+        try:
+            with np.errstate(all="ignore"):
+                m = fns[op](vals, r.value)
+        except Exception:
+            return None
+        if valid is not None:
+            m = m & valid
+        return np.asarray(m, bool)
+    if isinstance(e, In) and isinstance(e.child, AttributeReference) \
+            and all(isinstance(i, Literal) for i in e.items):
+        ent = trace.cols.get(e.child.expr_id)
+        if ent is None:
+            return None
+        vals, valid = ent
+        m = np.isin(vals, [i.value for i in e.items if i.value is not None])
+        if valid is not None:
+            m = m & valid
+        return m
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the analyzer
+# ---------------------------------------------------------------------------
+
+class _Analyzer:
+    def __init__(self, conf: SQLConf):
+        self.conf = conf
+        self.report = AnalysisReport()
+        self.predicted = Counter()
+        self._fusion_on = bool(conf.get(FUSION_ENABLED))
+        self._min_rows = int(conf.get(FUSION_MIN_ROWS))
+        self._dense_keys = bool(conf.get(FUSION_DENSE_KEYS))
+        self._tile = int(conf.get(BATCH_CAPACITY))
+
+    # -- bookkeeping -------------------------------------------------------
+    def _approx(self, reason: str):
+        self.report.exact = False
+        if reason not in self.report.inexact_reasons:
+            self.report.inexact_reasons.append(reason)
+
+    def _hazard(self, text: str):
+        if text not in self.report.recompile_hazards:
+            self.report.recompile_hazards.append(text)
+
+    def _sync(self, text: str):
+        if text not in self.report.host_sync_notes:
+            self.report.host_sync_notes.append(text)
+
+    def _stage(self, node, kinds: Counter, batches, notes=()):
+        self.predicted.update(kinds)
+        lpb = None
+        if isinstance(batches, int) and batches:
+            per_batch = sum(v for k, v in kinds.items()
+                            if k in ("pipeline", "fused_agg", "fused_limit",
+                                     "join_probe", "fused_probe",
+                                     "djoin_probe", "fused_djoin_probe",
+                                     "shuffle_pids", "shuffle_hash",
+                                     "sample"))
+            if per_batch and batches:
+                lpb = round(per_batch / batches, 2)
+        detail = node.simple_string() if hasattr(node, "simple_string") \
+            else type(node).__name__
+        self.report.stages.append({
+            "op": type(node).__name__,
+            "detail": detail[:120],
+            "kinds": dict(kinds),
+            "batches": batches,
+            "launches_per_batch": lpb,
+            "notes": list(notes),
+        })
+
+    # -- entry -------------------------------------------------------------
+    def run(self, plan) -> AnalysisReport:
+        self.visit(plan)
+        self.report.predicted_launches = dict(self.predicted)
+        self._explain_boundaries(plan)
+        self._overflow_pass(plan)
+        return self.report
+
+    # -- dispatch ----------------------------------------------------------
+    def visit(self, node) -> _Flow:
+        from ..physical import operators as O
+        from ..physical.exchange import (
+            BroadcastExchangeExec, ShuffleExchangeExec,
+        )
+        from ..physical.fusion import FusedAggregateExec, FusedLimitExec
+
+        if isinstance(node, O.LocalTableScanExec):
+            return self._local_scan(node)
+        if isinstance(node, O.ScanExec):
+            return self._scan(node)
+        if isinstance(node, O.RangeExec):
+            return self._range(node)
+        if isinstance(node, O.ComputeExec):
+            return self._compute(node)
+        if isinstance(node, FusedAggregateExec):
+            return self._fused_agg(node)
+        if isinstance(node, O.HashAggregateExec):
+            return self._agg(node)
+        if isinstance(node, FusedLimitExec):
+            return self._fused_limit(node)
+        if isinstance(node, O.LimitExec):
+            return self._limit(node)
+        if isinstance(node, O.SortExec):
+            return self._sort(node)
+        if isinstance(node, O.HashJoinExec):
+            return self._join(node)
+        if isinstance(node, O.NestedLoopJoinExec):
+            return self._nl_join(node)
+        if isinstance(node, BroadcastExchangeExec):
+            return self._broadcast(node)
+        if isinstance(node, ShuffleExchangeExec):
+            return self._exchange(node)
+        if isinstance(node, O.UnionExec):
+            return self._union(node)
+        if isinstance(node, O.CoalescePartitionsExec):
+            return self._coalesce(node)
+        if isinstance(node, O.SampleExec):
+            return self._sample(node)
+        return self._unknown(node)
+
+    # -- scans -------------------------------------------------------------
+    def _batches_for_rows(self, n: int) -> list:
+        if n == 0:
+            return [_Batch(0, _EMPTY_CAP, True)]
+        out = []
+        for start in range(0, n, self._tile):
+            rows = min(self._tile, n - start)
+            out.append(_Batch(rows, bucket_capacity(rows), True))
+        return out
+
+    def _local_scan(self, node) -> _Flow:
+        import pyarrow as pa
+
+        table = node.table
+        n = table.num_rows
+        cols = {}
+        if 0 < n <= _TRACE_MAX_ROWS:
+            names = {a.name: a for a in node.attrs}
+            for fld in table.schema:
+                a = names.get(fld.name)
+                if a is None or not pa.types.is_integer(fld.type):
+                    continue
+                arr = table.column(fld.name)
+                if isinstance(arr, pa.ChunkedArray):
+                    arr = arr.combine_chunks()
+                valid = np.asarray(arr.is_valid()) if arr.null_count else None
+                vals = np.asarray(arr.fill_null(0))
+                cols[a.expr_id] = (vals, valid)
+        trace = _Trace(cols, np.ones(n, bool)) if cols else None
+        flow = _Flow([self._batches_for_rows(n)], trace)
+        self._stage(node, Counter(), flow.total_batches,
+                    [f"{n} rows, device-cached (stable identity)"])
+        return flow
+
+    def _scan(self, node) -> _Flow:
+        nparts = node.source.num_partitions()
+        flow = _Flow([[_Batch(None, None, False)] for _ in range(nparts)],
+                     None, counted=False)
+        self._stage(node, Counter(), None,
+                    ["external source: per-partition batch counts unknown"])
+        return flow
+
+    def _range(self, node) -> _Flow:
+        step = node.step
+        total = max(0, -(-(node.end - node.start) // step)) if step > 0 \
+            else max(0, -(-(node.start - node.end) // -step))
+        per = -(-total // node.num_partitions)
+        parts = []
+        for p in range(node.num_partitions):
+            lo = min(p * per, total)
+            hi = min(lo + per, total)
+            batches = [_Batch(min(self._tile, hi - s),
+                              bucket_capacity(min(self._tile, hi - s)),
+                              False)
+                       for s in range(lo, hi, self._tile)] \
+                or [_Batch(0, _EMPTY_CAP, False)]
+            parts.append(batches)
+        trace = None
+        if node.num_partitions == 1 and 0 < total <= _TRACE_MAX_ROWS:
+            vals = node.start + np.arange(total, dtype=np.int64) * step
+            trace = _Trace({node.attr.expr_id: (vals, None)},
+                           np.ones(total, bool))
+        flow = _Flow(parts, trace)
+        self._stage(node, Counter(), flow.total_batches, [])
+        return flow
+
+    # -- compute -----------------------------------------------------------
+    @staticmethod
+    def _compute_trivial(node) -> bool:
+        return not node.filters and all(
+            isinstance(o, AttributeReference) for o in node.outputs)
+
+    def _project_trace(self, trace, filters, outputs) -> Optional[_Trace]:
+        if trace is None:
+            return None
+        live = trace.live.copy()
+        for f in filters:
+            m = _eval_filter(f, trace)
+            if m is None:
+                return None
+            live &= m
+        cols = {}
+        for o in outputs:
+            if isinstance(o, AttributeReference):
+                if o.expr_id in trace.cols:
+                    cols[o.expr_id] = trace.cols[o.expr_id]
+            elif isinstance(o, Alias) and isinstance(o.child,
+                                                     AttributeReference):
+                if o.child.expr_id in trace.cols:
+                    cols[o.expr_id] = trace.cols[o.child.expr_id]
+        return _Trace(cols, live, trace.consecutive)
+
+    def _compute(self, node) -> _Flow:
+        child = self.visit(node.child)
+        kinds = Counter()
+        if self._compute_trivial(node):
+            trace = self._project_trace(child.trace, [], node.outputs)
+            flow = _Flow(child.parts, trace, counted=child.counted)
+            self._stage(node, kinds, child.total_batches
+                        if child.counted else None,
+                        ["pure column selection: shares child arrays, "
+                         "zero launches"])
+            return flow
+        if child.counted:
+            kinds["pipeline"] = child.total_batches
+        else:
+            self._approx(f"pipeline launches of {node.simple_string()[:60]} "
+                         "depend on an unknown upstream batch count")
+        parts = [[_Batch(b.rows, b.cap, False) for b in p]
+                 for p in child.parts]
+        trace = self._project_trace(child.trace, node.filters, node.outputs)
+        flow = _Flow(parts, trace, counted=child.counted)
+        self._stage(node, kinds, child.total_batches if child.counted
+                    else None, [])
+        return flow
+
+    # -- aggregation -------------------------------------------------------
+    def _agg_chunk_kinds(self, node, batches, trace, kinds: Counter,
+                         notes: list):
+        """Mirror HashAggregateExec._aggregate_chunk over one partition's
+        batch list: concat (no launch) + one aggregation kernel, with the
+        dense-range scalar probe when the decision is not memoized."""
+        vals = node._plan_values()
+        has_pc = any(op in ("percentile", "collect") for op, _, _ in vals)
+        fresh = len(batches) > 1 or any(not b.stable for b in batches)
+        caps = [b.cap for b in batches]
+        cap = bucket_capacity(sum(caps)) if all(
+            c is not None for c in caps) and caps else None
+
+        if not node.grouping:
+            kinds["uagg"] += 1
+            for op, _, _ in vals:
+                if op == "percentile":
+                    kinds["uperc"] += 1
+            return
+
+        single_int_key = len(node.grouping) == 1 and isinstance(
+            node.grouping[0].dtype, (IntegralType, DateType))
+        dense = False
+        if single_int_key and not has_pc:
+            kinds["krange3"] += 1 if fresh else 0
+            if not fresh:
+                notes.append("dense-range scalars memoized on stable scan "
+                             "arrays — no krange3 probe per run")
+            st = trace.stats(node.grouping[0].expr_id) if trace else None
+            if st is not None and cap is not None:
+                if st.size:
+                    span = int(st.max()) - int(st.min()) + 1
+                    dense = span + 1 <= min(4 * cap, _DENSE_AGG_LIMIT)
+            else:
+                self._approx("dense-scatter vs sorted-segment aggregation "
+                             f"over {node.grouping[0].name} is decided by "
+                             "the runtime key span (untraced)")
+            self._hazard(
+                f"aggregate on {node.grouping[0].name}: the dense-scatter "
+                "kernel's output capacity derives from the DATA's key span "
+                "— span drift across batches recompiles (value-dependent "
+                "cache key)")
+        if dense:
+            kinds["dagg"] += 1
+        else:
+            kinds["gagg"] += 1
+        for op, _, _ in vals:
+            if op == "percentile":
+                kinds["gperc"] += 1
+        if has_pc:
+            self._sync("percentile/collect aggregates build results "
+                       "host-side (per-group host loop)")
+
+    def _agg(self, node) -> _Flow:
+        from ..physical.exchange import ShuffleExchangeExec
+
+        child = self.visit(node.child)
+        parts = child.parts
+        notes = []
+        if node.mode == "final" and isinstance(node.child,
+                                               ShuffleExchangeExec) \
+                and len(parts) > 1 \
+                and self.conf.get(ADAPTIVE_ENABLED) \
+                and self.conf.get(COALESCE_PARTITIONS_ENABLED):
+            # AQE coalescing merges undersized reducer outputs; assume one
+            # merged group (row-count dependent)
+            parts = [[b for p in parts for b in p]]
+            notes.append("AQE coalescing assumed to merge all reducer "
+                         "outputs into one partition")
+            self._approx("AQE partition coalescing before the final "
+                         "aggregate depends on runtime row counts")
+        kinds = Counter()
+        max_rows = int(self.conf.get(AGG_BLOCK_ROWS))
+        for p in parts:
+            caps = [b.cap for b in p]
+            known = all(c is not None for c in caps)
+            blockwise = known and len(p) > 1 and sum(caps) > max_rows \
+                and node.grouping and all(s.mergeable for s in node.specs)
+            if not known and not child.counted:
+                self._approx("aggregate chunking depends on unknown "
+                             "upstream batch sizes")
+            if blockwise:
+                # fold in blockRows-bounded chunks, then merge partials
+                chunk, acc, cs = [], 0, 0
+                for b in p:
+                    chunk.append(b)
+                    cs += b.cap
+                    if cs >= max_rows:
+                        self._agg_chunk_kinds(node, chunk, child.trace,
+                                              kinds, notes)
+                        chunk, cs = [], 0
+                        acc += 1
+                if chunk:
+                    self._agg_chunk_kinds(node, chunk, child.trace, kinds,
+                                          notes)
+                    acc += 1
+                merged = [_Batch(None, None, False)] * acc
+                self._agg_chunk_kinds(node, merged, None, kinds, notes)
+                notes.append(f"blockwise fold: {acc} chunks + merge")
+            else:
+                self._agg_chunk_kinds(node, p, child.trace, kinds, notes)
+        out_parts = [[_Batch(None, None, False)] for _ in parts]
+        self._stage(node, kinds, child.total_batches if child.counted
+                    else None, notes)
+        return _Flow(out_parts, None, counted=child.counted)
+
+    def _fused_agg(self, node) -> _Flow:
+        child = self.visit(node.child)
+        kinds = Counter()
+        notes = []
+        single_int_key = len(node.grouping) == 1 and isinstance(
+            node.grouping[0].dtype, (IntegralType, DateType))
+        key_passthrough = single_int_key and any(
+            isinstance(o, AttributeReference)
+            and o.expr_id == node.grouping[0].expr_id
+            for o in node.pipe_outputs)
+        pipe_trace = self._project_trace(child.trace, node.filters,
+                                         node.pipe_outputs)
+        key_span = None
+        if single_int_key and pipe_trace is not None:
+            st = pipe_trace.stats(node.grouping[0].expr_id)
+            if st is not None and st.size:
+                key_span = int(st.max()) - int(st.min()) + 1
+        for p in child.parts:
+            caps = [b.cap for b in p]
+            known = all(c is not None for c in caps)
+            if not known:
+                self._approx("fusion minRows gate undecidable: unknown "
+                             "partition tile capacities")
+                known_sum = None
+            else:
+                known_sum = sum(caps)
+            if known_sum is not None and known_sum < self._min_rows:
+                # runtime size gate: unfused operator-at-a-time kernels
+                kinds["pipeline"] += len(p)
+                self._agg_chunk_kinds(node, [
+                    _Batch(b.rows, b.cap, False) for b in p],
+                    pipe_trace, kinds, notes)
+                notes.append(
+                    f"partition under spark.tpu.fusion.minRows="
+                    f"{self._min_rows}: shared unfused kernels at runtime")
+                continue
+            kinds["fused_agg"] += len(p)
+            if key_passthrough and self._dense_keys:
+                fresh_in = sum(1 for b in p if not b.stable)
+                kinds["krange3"] += fresh_in
+                if fresh_in == 0:
+                    notes.append("dense-range decision memoized per stable "
+                                 "input column (no per-run host sync)")
+            if len(p) > 1:
+                # per-batch partials merge with final-mode ops; the partial
+                # output capacity mirrors the fused kernel variant
+                pcaps = []
+                for b in p:
+                    if not node.grouping:
+                        pcaps.append(8)
+                    elif key_passthrough and self._dense_keys \
+                            and key_span is not None and b.cap is not None \
+                            and key_span + 1 <= min(4 * b.cap,
+                                                    _DENSE_AGG_LIMIT):
+                        pcaps.append(bucket_capacity(key_span + 1))
+                    else:
+                        pcaps.append(b.cap)
+                merge = HashAggMergeProxy(node)
+                self._agg_chunk_kinds(
+                    merge, [_Batch(None, c, False) for c in pcaps],
+                    pipe_trace, kinds, notes)
+                notes.append(f"{len(p)} per-batch partials merge with "
+                             "final-mode ops")
+        self._stage(node, kinds, child.total_batches if child.counted
+                    else None,
+                    ["FUSED stage: filter/project traced into the partial-"
+                     "aggregate kernel — 1 launch/batch"] + notes)
+        out_parts = [[_Batch(None, None, False)] for _ in child.parts]
+        return _Flow(out_parts, None, counted=child.counted)
+
+    # -- limit / sort ------------------------------------------------------
+    def _limit(self, node) -> _Flow:
+        child = self.visit(node.child)
+        kinds = Counter()
+        out_parts = []
+        for p in child.parts:
+            if p:
+                kinds["limit"] += 1
+                out_parts.append([_Batch(min(node.n, self._tile), None,
+                                         False)])
+            else:
+                out_parts.append([])
+        self._sync("LimitExec compaction host-syncs the live-row count "
+                   "per partition")
+        self._stage(node, kinds, child.total_batches if child.counted
+                    else None, [])
+        return _Flow(out_parts, None, counted=child.counted)
+
+    def _fused_limit(self, node) -> _Flow:
+        child = self.visit(node.child)
+        kinds = Counter()
+        notes = []
+        out_parts = []
+        for p in child.parts:
+            if not p:
+                out_parts.append([])
+                continue
+            caps = [b.cap for b in p]
+            known = all(c is not None for c in caps)
+            if known and sum(caps) < self._min_rows:
+                kinds["pipeline"] += len(p)
+                kinds["limit"] += 1
+                notes.append("partition under spark.tpu.fusion.minRows: "
+                             "shared unfused kernels at runtime")
+            else:
+                if not known:
+                    self._approx("fusion minRows gate undecidable for "
+                                 "FusedLimit (unknown capacities)")
+                kinds["fused_limit"] += 1
+            out_parts.append([_Batch(min(node.n, self._tile), None, False)])
+        self._stage(node, kinds, child.total_batches if child.counted
+                    else None,
+                    ["FUSED stage: pipeline traced into the limit kernel — "
+                     "1 launch per partition (batches concatenate)"] + notes)
+        return _Flow(out_parts, None, counted=child.counted)
+
+    def _sort(self, node) -> _Flow:
+        child = self.visit(node.child)
+        kinds = Counter()
+        notes = []
+        budget = self._sort_budget(node)
+        out_parts = []
+        for p in child.parts:
+            if not p:
+                out_parts.append([])
+                continue
+            caps = [b.cap for b in p]
+            known = all(c is not None for c in caps)
+            if known and budget is not None and sum(caps) > budget:
+                self._approx("external range-bucketed sort: bucket count "
+                             "and per-bucket kernels are data-dependent")
+                self._hazard("external sort cache keys embed the bucket "
+                             "count B (data-dependent) — skewed inputs "
+                             "recompile the pid kernel per B")
+                notes.append("over device budget: external multi-pass sort")
+                out_parts.append([_Batch(None, None, False)])
+                continue
+            if not known and not child.counted:
+                self._approx("sort budget check over unknown capacities")
+            kinds["sort"] += 1
+            out_parts.append([_Batch(None, None, False)])
+        self._stage(node, kinds, child.total_batches if child.counted
+                    else None, notes)
+        return _Flow(out_parts, None, counted=child.counted)
+
+    def _sort_budget(self, node):
+        try:
+            from ..exec.memory import MemoryManager
+
+            mm = MemoryManager(self.conf)
+            from ..physical.operators import attrs_schema
+
+            return mm.tile_rows(attrs_schema(node.child.output),
+                                amplification=3)
+        except Exception:
+            return None
+
+    # -- joins -------------------------------------------------------------
+    def _join(self, node) -> _Flow:
+        from ..physical.exchange import ShuffleExchangeExec
+
+        left = self.visit(node.left)
+        right = self.visit(node.right)
+        kinds = Counter()
+        notes = []
+        if node.is_broadcast:
+            pairs = [(lp, right.parts[0] if right.parts else [])
+                     for lp in left.parts]
+        else:
+            if isinstance(node.left, ShuffleExchangeExec) or isinstance(
+                    node.right, ShuffleExchangeExec):
+                self._approx("shuffled join: AQE coalescing/skew splitting "
+                             "reshape partitions at runtime")
+            if len(left.parts) != len(right.parts):
+                self._approx("join partition pairing unknown")
+                pairs = []
+            else:
+                pairs = list(zip(left.parts, right.parts))
+
+        rf_on = bool(self.conf.get(MINMAX_JOIN_FILTER)) \
+            or bool(self.conf.get(BLOOM_JOIN_FILTER))
+        fused = node.probe_fusion is not None and not (
+            node.join_type == "full_outer" or rf_on)
+        if node.probe_fusion is not None and not fused:
+            notes.append("fused probe pipeline materialized up-front "
+                         "(full_outer / runtime-filter path reads probe "
+                         "keys outside the kernel)")
+        if rf_on:
+            self._approx("runtime join filters add data-dependent "
+                         "filter/compaction kernels")
+
+        single_int_bkey = len(node.right_keys) == 1 and isinstance(
+            node.right_keys[0].dtype, (IntegralType, DateType))
+        bstats = right.trace.stats(node.right_keys[0].expr_id) \
+            if (right.trace is not None and single_int_bkey) else None
+
+        probe_trace = left.trace
+        if fused:
+            filters, outputs = node.probe_fusion
+            probe_trace = self._project_trace(left.trace, filters, outputs)
+
+        for lp, rp in pairs:
+            bcaps = [b.cap for b in rp]
+            bknown = all(c is not None for c in bcaps) and rp
+            bcap = bucket_capacity(sum(bcaps)) if bknown else None
+            bfresh = (len(rp) != 1) or any(not b.stable for b in rp)
+            grace = False
+            if bknown:
+                budget = self._join_budget(node)
+                if budget is not None and sum(bcaps) > budget:
+                    grace = True
+            if grace:
+                self._approx("grace hash join fragments both sides by key "
+                             "hash — fragment kernels are data-dependent")
+                notes.append("build side over device budget: grace join")
+                continue
+            pair_fused = fused
+            if pair_fused:
+                pcaps = [b.cap for b in lp]
+                if lp and all(c is not None for c in pcaps) \
+                        and sum(pcaps) < self._min_rows:
+                    # runtime size gate: pipeline materializes up front
+                    kinds["pipeline"] += len(lp)
+                    pair_fused = False
+                    notes.append("probe partition under spark.tpu.fusion."
+                                 f"minRows={self._min_rows}: pipeline + "
+                                 "shared probe kernels at runtime")
+            dense = False
+            if single_int_bkey:
+                kinds["krange3"] += 1 if bfresh else 0
+                if bstats is not None and bcap is not None:
+                    span = (int(bstats.max()) - int(bstats.min()) + 1) \
+                        if bstats.size else None
+                    if span is not None and \
+                            span <= min(8 * bcap, _DENSE_JOIN_LIMIT):
+                        kinds["djoin_build"] += 1
+                        dense = np.unique(bstats).size == bstats.size
+                else:
+                    self._approx(
+                        f"dense vs sorted join build on "
+                        f"{node.right_keys[0].name}: key span/uniqueness "
+                        "untraced")
+                    kinds["djoin_build"] += 1
+                self._hazard(
+                    f"join build on {node.right_keys[0].name}: dense table "
+                    "capacity derives from the key span (value-dependent "
+                    "cache key); duplicate keys fall back to sorted probe "
+                    "at runtime")
+                self._sync("dense join-build verdict is one memoized device "
+                           "scalar per build column identity")
+            if dense:
+                kind = "fused_djoin_probe" if pair_fused else "djoin_probe"
+                kinds[kind] += len(lp) if lp else 1
+            else:
+                kinds["join_build"] += 1
+                kind = "fused_probe" if pair_fused else "join_probe"
+                launches = 0
+                batches = lp if lp else [_Batch(0, _EMPTY_CAP, True)]
+                counts = self._build_key_counts(bstats)
+                row0 = 0
+                for b in batches:
+                    launches += 1
+                    launches += self._probe_retries(
+                        node, b, row0, probe_trace, counts)
+                    row0 += b.rows if b.rows is not None else (b.cap or 0)
+                kinds[kind] += launches
+            if node.join_type == "full_outer":
+                notes.append("full_outer unmatched-build pass runs EAGER "
+                             "device ops (uncached, uncounted dispatches)")
+                self._hazard("full_outer unmatched-build pass bypasses the "
+                             "KernelCache (eager per-run dispatches)")
+        out_parts = []
+        for lp, _ in pairs:
+            nb = max(len(lp), 1) + (1 if node.join_type == "full_outer"
+                                    else 0)
+            out_parts.append([_Batch(None, None, False)
+                              for _ in range(nb)])
+        self._stage(node, kinds, left.total_batches if left.counted
+                    else None, notes)
+        return _Flow(out_parts, None,
+                     counted=left.counted and right.counted)
+
+    def _build_key_counts(self, bstats):
+        if bstats is None or bstats.size == 0:
+            return None
+        vals, counts = np.unique(bstats, return_counts=True)
+        return vals, counts
+
+    def _probe_retries(self, node, batch, row0, probe_trace, counts) -> int:
+        """Capacity-retry launches for one sorted-probe batch: the kernel
+        re-runs with a doubled output bucket when matched pairs overflow
+        max(probe_cap, 1024)."""
+        if node.join_type != "inner":
+            # outer/semi needed-row semantics differ; only retry-predict
+            # the inner case, flag the rest
+            self._approx(f"{node.join_type} sorted-probe output capacity "
+                         "is data-dependent (retry count untraced)")
+            return 0
+        if batch.cap is None:
+            self._approx("sorted-probe retry check needs the probe batch "
+                         "capacity (unknown)")
+            return 0
+        out_cap = max(batch.cap, 1 << 10)
+        if counts is None or probe_trace is None \
+                or not probe_trace.consecutive:
+            self._approx("sorted-probe join expansion untraced: capacity "
+                         "retries unpredictable")
+            self._hazard("sorted-probe kernels re-launch with doubled "
+                         "output capacity on overflow (value-dependent "
+                         "cache key + extra dispatches)")
+            return 0
+        pk = node.left_keys[0] if len(node.left_keys) == 1 else None
+        if pk is None:
+            self._approx("multi-key sorted-probe expansion untraced")
+            return 0
+        ent = probe_trace.cols.get(pk.expr_id)
+        if ent is None:
+            self._approx(f"probe key {pk.name} untraced: sorted-probe "
+                         "retries unpredictable")
+            return 0
+        vals, valid = ent
+        m = probe_trace.live if valid is None else (probe_trace.live & valid)
+        width = batch.rows if batch.rows is not None else (batch.cap or 0)
+        lo, hi = row0, min(row0 + width, len(vals))
+        bvals = vals[lo:hi][m[lo:hi]]
+        cvals, ccounts = counts
+        idx = np.searchsorted(cvals, bvals)
+        idx = np.clip(idx, 0, len(cvals) - 1)
+        matched = cvals[idx] == bvals
+        needed = int(ccounts[idx[matched]].sum())
+        if needed > out_cap:
+            self._hazard("sorted-probe join overflowed its output bucket "
+                         f"(needed {needed} > {out_cap}): one retry launch "
+                         "with a doubled capacity (value-dependent key)")
+            return 1
+        return 0
+
+    def _join_budget(self, node):
+        try:
+            from ..exec.memory import MemoryManager
+            from ..physical.operators import attrs_schema
+
+            mm = MemoryManager(self.conf)
+            return mm.tile_rows(attrs_schema(node.right.output),
+                                amplification=4)
+        except Exception:
+            return None
+
+    def _nl_join(self, node) -> _Flow:
+        left = self.visit(node.left)
+        self.visit(node.right)
+        kinds = Counter()
+        if node.condition is not None and left.counted:
+            kinds["pipeline"] = left.total_batches
+        elif node.condition is not None:
+            self._approx("nested-loop condition pipeline count unknown")
+        self._hazard("NestedLoopJoinExec cross-product runs EAGER device "
+                     "ops (uncached, uncounted dispatches; output capacity "
+                     "is |probe|x|build|)")
+        out_parts = [[_Batch(None, None, False)
+                      for _ in range(max(len(p), 1)
+                                     * (2 if node.join_type == "left_outer"
+                                        else 1))]
+                     for p in left.parts]
+        self._stage(node, kinds, left.total_batches if left.counted
+                    else None, [])
+        return _Flow(out_parts, None, counted=left.counted)
+
+    # -- exchanges ---------------------------------------------------------
+    def _broadcast(self, node) -> _Flow:
+        child = self.visit(node.child)
+        merged = [b for p in child.parts for b in p]
+        if len(merged) == 1:
+            out = [merged[0]]
+        else:
+            caps = [b.cap for b in merged]
+            cap = bucket_capacity(sum(caps)) if merged and all(
+                c is not None for c in caps) else None
+            rows = sum(b.rows for b in merged) if all(
+                b.rows is not None for b in merged) else None
+            out = [_Batch(rows, cap, False)]
+        self._stage(node, Counter(), child.total_batches if child.counted
+                    else None, ["no kernels: host-orchestrated replicate"])
+        return _Flow([out], child.trace, counted=child.counted)
+
+    def _mesh_active(self, num_out: int) -> bool:
+        if not self.conf.get(MESH_ENABLED):
+            return False
+        if num_out < 2 or (num_out & (num_out - 1)) != 0:
+            return False
+        try:
+            import jax
+
+            return len(jax.devices()) >= num_out
+        except Exception:
+            return False
+
+    def _exchange(self, node) -> _Flow:
+        from ..physical.partitioning import (
+            HashPartitioning, RangePartitioning, SinglePartition,
+            UnknownPartitioning,
+        )
+
+        child = self.visit(node.child)
+        p = node.partitioning
+        kinds = Counter()
+        notes = []
+        if isinstance(p, SinglePartition):
+            merged = [b for part in child.parts for b in part]
+            self._stage(node, kinds, child.total_batches if child.counted
+                        else None, ["gather: no kernels"])
+            return _Flow([merged], child.trace, counted=child.counted)
+        if isinstance(p, HashPartitioning):
+            if self._mesh_active(p.num_partitions):
+                kinds["mesh_exchange"] += 1
+                notes.append("mesh all-to-all: ONE program for the whole "
+                             "redistribution")
+                self._approx("mesh exchange quota retries are "
+                             "data-dependent (skew doubles the quota and "
+                             "re-launches)")
+                self._hazard("mesh exchange cache key embeds the per-pair "
+                             "row quota — skewed data recompiles with a "
+                             "doubled quota")
+                out = [[_Batch(None, None, False)]
+                       for _ in range(p.num_partitions)]
+                self._stage(node, kinds, child.total_batches
+                            if child.counted else None, notes)
+                return _Flow(out, None, counted=True)
+            kind = self._host_shuffle_kind()
+            if child.counted:
+                kinds[kind] = child.total_batches
+            else:
+                self._approx("host shuffle launches depend on unknown "
+                             "upstream batch count")
+            self._sync("host sort-shuffle pulls grouped columns to host "
+                       "once per batch (by design: the DCN path)")
+            out = [[_Batch(None, None, False)]
+                   for _ in range(p.num_partitions)]
+            self._stage(node, kinds, child.total_batches if child.counted
+                        else None, notes)
+            return _Flow(out, None, counted=False)
+        if isinstance(p, RangePartitioning):
+            if child.counted:
+                kinds["shuffle_range"] = child.total_batches
+            self._approx("range exchange: sampled bounds may collapse to a "
+                         "single gather (data-dependent)")
+            self._sync("range-bound sampling reads per-batch samples "
+                       "host-side (memoized per column identity)")
+            out = [[_Batch(None, None, False)]
+                   for _ in range(p.num_partitions)]
+            self._stage(node, kinds, child.total_batches if child.counted
+                        else None, notes)
+            return _Flow(out, None, counted=False)
+        if isinstance(p, UnknownPartitioning):
+            if child.counted:
+                kinds["shuffle_rr"] = child.total_batches
+            self._hazard("round-robin shuffle cache key embeds the running "
+                         "row offset — every batch position compiles its "
+                         "own kernel (recompile storm on many batches)")
+            out = [[_Batch(None, None, False)]
+                   for _ in range(p.num_partitions)]
+            self._stage(node, kinds, child.total_batches if child.counted
+                        else None, notes)
+            return _Flow(out, None, counted=False)
+        self._approx(f"exchange over {type(p).__name__} not modeled")
+        return _Flow([[_Batch(None, None, False)]], None, counted=False)
+
+    @staticmethod
+    def _host_shuffle_kind() -> str:
+        try:
+            from ..utils.native import radix_partition  # noqa: F401
+
+            return "shuffle_pids"
+        except Exception:
+            return "shuffle_hash"
+
+    # -- misc --------------------------------------------------------------
+    def _union(self, node) -> _Flow:
+        parts = []
+        counted = True
+        for c in node.children_plans:
+            f = self.visit(c)
+            parts.extend(f.parts)
+            counted = counted and f.counted
+        self._stage(node, Counter(), None, ["no kernels: rewraps batches"])
+        return _Flow(parts, None, counted=counted)
+
+    def _coalesce(self, node) -> _Flow:
+        child = self.visit(node.child)
+        n = max(1, min(node.num_partitions, max(len(child.parts), 1)))
+        out = [[] for _ in range(n)]
+        for i, p in enumerate(child.parts):
+            out[i % n].extend(p)
+        self._stage(node, Counter(), child.total_batches if child.counted
+                    else None, ["no kernels"])
+        return _Flow(out, child.trace if len(child.parts) <= 1 else None,
+                     counted=child.counted)
+
+    def _sample(self, node) -> _Flow:
+        child = self.visit(node.child)
+        kinds = Counter()
+        if child.counted:
+            kinds["sample"] = child.total_batches
+        self._hazard("SampleExec cache key embeds (partition, batch) "
+                     "indices — one compiled kernel PER BATCH (recompile "
+                     "storm; key only needs the global offset)")
+        parts = [[_Batch(b.rows, b.cap, False) for b in p]
+                 for p in child.parts]
+        self._stage(node, kinds, child.total_batches if child.counted
+                    else None, [])
+        return _Flow(parts, None, counted=child.counted)
+
+    def _unknown(self, node) -> _Flow:
+        flows = [self.visit(c) for c in node.children]
+        self._approx(f"{type(node).__name__}: no launch model — counts "
+                     "below this operator are a lower bound")
+        parts = flows[0].parts if flows else [[_Batch(None, None, False)]]
+        self._stage(node, Counter(), None, ["no launch model"])
+        return _Flow([[_Batch(None, None, False)] for _ in parts], None,
+                     counted=False)
+
+    # -- fusion boundary explanations -------------------------------------
+    def _explain_boundaries(self, plan):
+        from ..physical import operators as O
+        from ..physical.fusion import (
+            FusedAggregateExec, FusedLimitExec, _compute_nontrivial,
+        )
+        from ..physical.aggregates import FUSABLE_OPS
+
+        out = self.report.fusion_boundaries
+        gate = (f"runtime gate: partitions under spark.tpu.fusion.minRows="
+                f"{self._min_rows} tile rows take the shared unfused "
+                "kernels (per-structure fused compiles only amortize on "
+                "volume)")
+        if not self._fusion_on:
+            out.append("whole-stage fusion DISABLED "
+                       "(spark.tpu.fusion.enabled=false): operator-at-a-"
+                       "time oracle — every stage boundary is unfused")
+        for node in plan.iter_nodes():
+            if isinstance(node, FusedAggregateExec):
+                out.append(f"FUSED {node.simple_string()[:80]}: pipeline "
+                           f"traced into the partial-agg kernel; {gate}")
+            elif isinstance(node, FusedLimitExec):
+                out.append(f"FUSED {node.simple_string()[:80]}; {gate}")
+            elif isinstance(node, O.HashJoinExec) \
+                    and node.probe_fusion is not None:
+                out.append(f"FUSED probe {node.simple_string()[:80]}; "
+                           f"{gate}")
+            elif isinstance(node, O.HashAggregateExec) \
+                    and node.mode == "partial":
+                reasons = self._agg_boundary_reasons(
+                    node, O, FUSABLE_OPS, _compute_nontrivial)
+                if reasons:
+                    out.append(f"UNFUSED {node.simple_string()[:80]}: "
+                               + "; ".join(reasons))
+            elif isinstance(node, O.HashJoinExec):
+                reasons = self._join_boundary_reasons(
+                    node, O, _compute_nontrivial)
+                if reasons:
+                    out.append(f"UNFUSED probe "
+                               f"{node.simple_string()[:80]}: "
+                               + "; ".join(reasons))
+            elif isinstance(node, O.LimitExec) and not isinstance(
+                    node, FusedLimitExec):
+                if isinstance(node.child, O.SortExec):
+                    msg = ("UNFUSED Limit over Sort: SortExec has no "
+                           "fused consume side yet (needs the sort-key "
+                           "rank domain inside the trace — ROADMAP item)")
+                    if msg not in out:
+                        out.append(msg)
+
+    def _agg_boundary_reasons(self, node, O, FUSABLE_OPS,
+                              _compute_nontrivial):
+        reasons = []
+        c = node.child
+        if not self._fusion_on:
+            return []
+        if not isinstance(c, O.ComputeExec):
+            if isinstance(c, (O.HashJoinExec,)):
+                reasons.append("consume side is a join output (only "
+                               "filter/project pipelines splice into the "
+                               "agg kernel)")
+            elif type(c).__name__.endswith("ExchangeExec"):
+                reasons.append("stage boundary is an exchange — fusion "
+                               "never crosses exchanges")
+            else:
+                reasons.append(f"consume side {type(c).__name__} is not a "
+                               "fusable pipeline")
+            return reasons
+        if not _compute_nontrivial(c):
+            reasons.append("upstream pipeline is a pure column selection — "
+                           "nothing to fuse (zero launches either way)")
+            return reasons
+        if not all(s.mergeable for s in node.specs):
+            reasons.append("non-mergeable aggregate (percentile/collect "
+                           "needs host-side finishing)")
+        out_ids = {a.expr_id for a in c.output}
+        if any(g.expr_id not in out_ids for g in node.grouping):
+            reasons.append("grouping key is not produced by the pipeline")
+        for op, attr, _ in node._plan_values():
+            if op not in FUSABLE_OPS:
+                reasons.append(f"op {op} has no fused kernel")
+            elif op in ("min", "max") and attr is not None and \
+                    dict_encoded(attr.dtype):
+                reasons.append("string min/max reduces in rank space and "
+                               "needs the host inverse-rank map (ROADMAP "
+                               "item)")
+        return reasons or ["not rewritten (unexpected: report this plan)"]
+
+    def _join_boundary_reasons(self, node, O, _compute_nontrivial):
+        if not self._fusion_on:
+            return []
+        c = node.left
+        if not isinstance(c, O.ComputeExec):
+            return []
+        if not _compute_nontrivial(c):
+            return ["probe pipeline is a pure column selection — nothing "
+                    "to fuse"]
+        out_by_id = {a.expr_id: a for a in c.output}
+        for k in node.left_keys:
+            a = out_by_id.get(k.expr_id)
+            if a is None:
+                return ["probe key is not produced by the pipeline"]
+            if isinstance(a.dtype, StringType) or dict_encoded(a.dtype):
+                return [f"probe key {a.name} is a dictionary-encoded "
+                        "string: equality rides host-side dictionary "
+                        "hashes (ROADMAP: padded hash tables as kernel "
+                        "aux inputs)"]
+        return []
+
+    # -- overflow ----------------------------------------------------------
+    def _overflow_pass(self, plan):
+        from ..physical import operators as O
+
+        seen = set()
+        for node in plan.iter_nodes():
+            if not isinstance(node, O.HashAggregateExec):
+                continue
+            for s in node.specs:
+                if id(s) in seen:
+                    continue
+                seen.add(id(s))
+                for op in s.ops:
+                    if op not in ("sum", "count", "countstar"):
+                        continue
+                    name = s.input_expr.name if isinstance(
+                        s.input_expr, AttributeReference) else (
+                        s.result_alias.name)
+                    msg = None
+                    if op == "sum" and s.input_expr is not None and \
+                            isinstance(s.input_expr.dtype, IntegralType):
+                        msg = (f"SUM({name}) accumulates in int64: with "
+                               "ANSI off, |value|*rows beyond 2^63 wraps "
+                               "silently (partial+final merges compound "
+                               "the range)")
+                    elif op in ("count", "countstar"):
+                        # int64 counter: saturation needs ~9.2e18 rows
+                        pass
+                    elif op == "sum" and s.input_expr is not None and \
+                            str(s.input_expr.dtype) == "float":
+                        msg = (f"SUM({name}) over float32 input "
+                               "accumulates in float64 (precision, not "
+                               "overflow)")
+                    if msg and msg not in self.report.overflow_risks:
+                        self.report.overflow_risks.append(msg)
+
+
+class HashAggMergeProxy:
+    """Adapter: the fused aggregate's merge step behaves like a final-mode
+    HashAggregateExec over the partial buffers (same grouping/specs)."""
+
+    def __init__(self, fused):
+        self.grouping = fused.grouping
+        self.specs = fused.specs
+        self._inner = fused
+
+    def _plan_values(self):
+        from ..physical.aggregates import PARTIAL_TO_MERGE
+
+        out = []
+        for s in self.specs:
+            for i, op in enumerate(s.ops):
+                out.append((PARTIAL_TO_MERGE.get(op, op),
+                            s.buffer_attrs[i], s.param))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def analyze_plan(plan, conf: SQLConf) -> AnalysisReport:
+    """Analyze an optimized PHYSICAL plan. Predictions model one WARM
+    execution: kernel caches compiled, device-cached scans resident, and
+    the device-scalar memo primed (first runs add one krange3 probe per
+    distinct stable column plus the compile misses)."""
+    return _Analyzer(conf).run(plan)

@@ -397,6 +397,10 @@ class DataFrame:
         print(sep)
 
     def explain(self, mode: str = "formatted") -> None:
+        """Print the query plans. mode="analysis" additionally runs the
+        static plan analyzer (spark_tpu/analysis/plan_lint.py): predicted
+        kernel launches per batch per stage, fusion-boundary explanations,
+        recompile/overflow hazards — the EXPLAIN CODEGEN analog."""
         print(self.query_execution.explain_string(mode))
 
     def createOrReplaceTempView(self, name: str) -> None:

@@ -104,7 +104,7 @@ def record_batch_to_columnar(rb: pa.RecordBatch | pa.Table,
     n = num_rows if num_rows is not None else rb.num_rows
     cap = capacity or bucket_capacity(max(n, 1))
     cols = []
-    stats = {}
+    ranges = {}
     for i, f in enumerate(schema.fields):
         data, validity, sd = _chunked_to_numpy(rb.column(i), f.dataType)
         pad = np.zeros(cap, dtype=f.dataType.device_dtype)
@@ -122,15 +122,22 @@ def record_batch_to_columnar(rb: pa.RecordBatch | pa.Table,
             live = data[:cap] if validity is None \
                 else data[:cap][validity[:cap]]
             if len(live):
-                stats[("dense_range", id(col.data))] = (
-                    int(live.min()), int(live.max()), True)
+                ranges[i] = (int(live.min()), int(live.max()), True)
             else:
-                stats[("dense_range", id(col.data))] = (0, 0, False)
+                ranges[i] = (0, 0, False)
         cols.append(col)
     mask = np.zeros(cap, dtype=bool)
     mask[:n] = True
-    out = ColumnarBatch(schema, cols, jnp.asarray(mask), num_rows=n)
-    out._stats = stats
+    mask_d = jnp.asarray(mask)
+    out = ColumnarBatch(schema, cols, mask_d, num_rows=n)
+    if ranges:
+        # seed the process-global device-scalar memo keyed by the final
+        # (data, validity, row_mask) identities — dense_range_stats hits it
+        # without ever dispatching its range-probe kernel
+        from ..utils.device_memo import seed_dense_range_memo
+
+        for i, rng in ranges.items():
+            seed_dense_range_memo(cols[i], mask_d, rng)
     return out
 
 

@@ -190,6 +190,67 @@ CODEGEN_CACHE_SIZE = _register(ConfigEntry(
     "CodeGenerator Janino class cache, codegen/CodeGenerator.scala:1557).",
     int))
 
+# --- entries below were historically read by string literal at their use
+# sites; registered here so config has a single typed source of truth
+# (found and enforced by dev/tpulint.py's config-key rule) -----------------
+
+VALIDATE_BATCHES = _register(ConfigEntry(
+    "spark.tpu.debug.validateBatches", False,
+    "Validate every operator's output batches (shape/dtype/mask "
+    "invariants; columnar/validate.py). Debug only — syncs per batch.",
+    _bool))
+
+UI_OPERATOR_METRICS = _register(ConfigEntry(
+    "spark.tpu.ui.operatorMetrics", True,
+    "Record per-operator rows/time SQLMetrics for the plan graph/UI "
+    "(exec/query_execution.py). One dict lookup per execute when off.",
+    _bool))
+
+AGG_BLOCK_ROWS = _register(ConfigEntry(
+    "spark.tpu.agg.blockRows", 1 << 22,
+    "Tile-capacity ceiling for a single aggregation chunk; larger "
+    "partitions fold blockwise and merge partials (the sort-based "
+    "fallback role of TungstenAggregationIterator).", int))
+
+JOIN_RF_MIN_CAPACITY = _register(ConfigEntry(
+    "spark.tpu.join.runtimeFilter.minCapacity", 1 << 20,
+    "Probe batches below this capacity skip the runtime min-max join "
+    "filter (the sort-probe is already cheap).", int))
+
+DSV2_FILTER_PUSHDOWN = _register(ConfigEntry(
+    "spark.tpu.datasource.filterPushdown", True,
+    "Negotiate predicate pushdown with SupportsPushDownFilters sources "
+    "(V2ScanRelationPushDown role).", _bool))
+
+DSV2_AGG_PUSHDOWN = _register(ConfigEntry(
+    "spark.tpu.datasource.aggPushdown", True,
+    "Push whole group-by aggregates into SupportsPushDownAggregation "
+    "sources.", _bool))
+
+CLUSTER_MASTER = _register(ConfigEntry(
+    "spark.tpu.master", "",
+    "grpc://host:port of a standalone master to attach to "
+    "(deploy/standalone.py; the spark-submit --master flow).", str))
+
+CLUSTER_MASTER_SECRET = _register(ConfigEntry(
+    "spark.tpu.master.secret", "",
+    "Shared secret for the standalone master (or env "
+    "SPARK_TPU_MASTER_SECRET).", str))
+
+CLUSTER_ENABLED = _register(ConfigEntry(
+    "spark.tpu.cluster.enabled", False,
+    "Spawn a local process cluster for SQL execution (the reference's "
+    "local-cluster mode).", _bool))
+
+CLUSTER_WORKERS = _register(ConfigEntry(
+    "spark.tpu.cluster.workers", 2,
+    "Worker process count for the local process cluster.", int))
+
+PUSH_SHUFFLE = _register(ConfigEntry(
+    "spark.tpu.shuffle.push", False,
+    "Push-based shuffle: mappers push blocks to reducer-side merged "
+    "files (reference: push-based shuffle, core/shuffle/push).", _bool))
+
 
 class SQLConf:
     """Session-local config with string overrides over typed defaults.

@@ -261,7 +261,22 @@ class QueryExecution:
                           "rows": None, "ms": None, "children": []})
         return nodes
 
+    def analysis_report(self):
+        """Static plan/trace analysis of the optimized physical plan:
+        predicted kernel launches per batch per stage, fusion-boundary
+        explanations, recompile and dtype-overflow hazards (role of the
+        reference's debugCodegen, sqlx/execution/debug/package.scala).
+        Pure host work — nothing executes on device."""
+        from ..analysis.plan_lint import analyze_plan
+
+        return analyze_plan(self.physical, self.session.conf)
+
     def explain_string(self, mode: str = "formatted") -> str:
+        if mode == "analysis":
+            return "\n".join([
+                "== Physical Plan ==", self.physical.tree_string(),
+                self.analysis_report().render(),
+            ])
         parts = [
             "== Analyzed Logical Plan ==", self.analyzed.tree_string(),
             "== Optimized Logical Plan ==", self.optimized.tree_string(),

@@ -88,6 +88,33 @@ class ShuffleExchangeExec(PhysicalPlan):
                f"({self.partitioning.num_partitions})]"
 
 
+def _batch_key_samples(batch: ColumnarBatch, kpos: int, f,
+                       per_part_sample: int) -> tuple:
+    """Up to `per_part_sample` live non-null key values of one batch as an
+    immutable tuple. The device→host pull is memoized per (data, validity,
+    mask) identity (utils/device_memo.memo_device_scalars): repeated
+    range exchanges over device-cached scan batches sync once, not once
+    per batch per query."""
+    from ..utils.device_memo import memo_device_scalars
+
+    col = batch.columns[kpos]
+
+    def compute():
+        mask = np.asarray(batch.row_mask)
+        if isinstance(f.dataType, StringType):
+            vals = col.to_numpy(np.nonzero(mask)[0][:per_part_sample])
+            return tuple(v for v in vals if v is not None)
+        data = np.asarray(col.data)[mask][:per_part_sample]
+        if col.validity is not None:
+            vmask = np.asarray(col.validity)[mask][:per_part_sample]
+            data = data[vmask[: len(data)]]
+        return tuple(data.tolist())
+
+    return memo_device_scalars(
+        ("range_sample", kpos, per_part_sample, str(f.dataType)),
+        (col.data, col.validity, batch.row_mask), compute)
+
+
 def _sample_bounds(parts, kpos: int, schema, num_out: int,
                    per_part_sample: int = 4096):
     """Sample the sort key to derive range bounds (role of the reference's
@@ -96,26 +123,19 @@ def _sample_bounds(parts, kpos: int, schema, num_out: int,
     samples = []
     for part in parts:
         for batch in part[:2]:
-            col = batch.columns[kpos]
-            mask = np.asarray(batch.row_mask)
-            if isinstance(f.dataType, StringType):
-                vals = col.to_numpy(np.nonzero(mask)[0][:per_part_sample])
-                samples.extend([v for v in vals if v is not None])
-            else:
-                data = np.asarray(col.data)[mask][:per_part_sample]
-                if col.validity is not None:
-                    vmask = np.asarray(col.validity)[mask][:per_part_sample]
-                    data = data[vmask[: len(data)]]
-                samples.extend(data.tolist())
+            samples.extend(_batch_key_samples(batch, kpos, f,
+                                              per_part_sample))
     if not samples:
         return None
     if isinstance(f.dataType, StringType):
         s = sorted(set(samples))
     else:
-        s = np.unique(np.asarray(samples))
+        # host math over already-pulled (memoized) sample tuples
+        s = np.unique(np.asarray(samples))  # tpulint: ignore[host-sync]
     if len(s) <= 1:
         return None
-    qs = [int(round(i * (len(s) - 1) / num_out)) for i in range(1, num_out)]
+    qs = [int(round(i * (len(s) - 1) / num_out))  # tpulint: ignore[host-sync]
+          for i in range(1, num_out)]
     if isinstance(f.dataType, StringType):
         bounds = sorted(set(s[q] for q in qs))
     else:

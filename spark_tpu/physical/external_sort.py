@@ -36,18 +36,32 @@ def _jnp():
     return jnp
 
 
-def _sample_numeric_bounds(part, kpos: int, num_buckets: int):
-    """Quantile bounds in the sort-key domain from per-batch samples."""
-    samples = []
-    for b in part:
+def _batch_numeric_samples(b: ColumnarBatch, kpos: int) -> np.ndarray:
+    """Leading-sort-key samples for one batch, memoized per device-array
+    identity (utils/device_memo.memo_device_scalars): repeated external
+    sorts over device-cached batches pull samples to host once, not once
+    per batch per pass. Treat the returned array as immutable."""
+    from ..utils.device_memo import memo_device_scalars
+
+    col = b.columns[kpos]
+
+    def compute():
         mask = np.asarray(b.row_mask)
-        keys = np.asarray(b.columns[kpos].sort_keys())[mask]
-        v = b.columns[kpos].validity
-        if v is not None:
-            keys = keys[np.asarray(v)[mask]]
+        keys = np.asarray(col.sort_keys())[mask]
+        if col.validity is not None:
+            keys = keys[np.asarray(col.validity)[mask]]
         if keys.dtype.kind == "f":
             keys = keys[~np.isnan(keys)]
-        samples.append(keys[:_SAMPLE_PER_BATCH])
+        return keys[:_SAMPLE_PER_BATCH]
+
+    return memo_device_scalars(("extsort_sample", kpos),
+                                (col.data, col.validity, b.row_mask),
+                                compute)
+
+
+def _sample_numeric_bounds(part, kpos: int, num_buckets: int):
+    """Quantile bounds in the sort-key domain from per-batch samples."""
+    samples = [_batch_numeric_samples(b, kpos) for b in part]
     allv = np.concatenate(samples) if samples else np.zeros(0)
     if allv.size == 0:
         return None
@@ -56,12 +70,27 @@ def _sample_numeric_bounds(part, kpos: int, num_buckets: int):
     return np.unique(s[qs])
 
 
+def _batch_string_samples(b: ColumnarBatch, kpos: int) -> tuple:
+    """Live non-null string samples for one batch, memoized like the
+    numeric path (selection_indices syncs the mask otherwise)."""
+    from ..utils.device_memo import memo_device_scalars
+
+    col = b.columns[kpos]
+
+    def compute():
+        sel = b.selection_indices()[:_SAMPLE_PER_BATCH]
+        vals = col.to_numpy(sel)
+        return tuple(v for v in vals if v is not None)
+
+    return memo_device_scalars(("extsort_sample_str", kpos),
+                                (col.data, col.validity, b.row_mask),
+                                compute)
+
+
 def _sample_string_bounds(part, kpos: int, num_buckets: int):
     samples: list = []
     for b in part:
-        sel = b.selection_indices()[:_SAMPLE_PER_BATCH]
-        vals = b.columns[kpos].to_numpy(sel)
-        samples.extend(v for v in vals if v is not None)
+        samples.extend(_batch_string_samples(b, kpos))
     if not samples:
         return None
     s = sorted(samples)

@@ -367,8 +367,9 @@ class ComputeExec(PhysicalPlan):
                 def reorder(b):
                     nb = ColumnarBatch(schema, [b.columns[i] for i in idx],
                                        b.row_mask, num_rows=b._num_rows)
-                    # column objects are shared, so their id-keyed host
-                    # stats (dense_range) stay valid — keep them
+                    # column objects are shared, so id-keyed per-batch
+                    # caches (bloom bitsets) stay valid — keep them; the
+                    # dense-range memo is identity-keyed and global
                     nb._stats = b._stats
                     return nb
 
@@ -402,42 +403,11 @@ def _batch_stats_cache(batch: ColumnarBatch) -> dict:
 # broadcast probes), so the dense-range decision syncs its two scalars ONCE
 # per distinct (column, mask) pair instead of once per batch per run —
 # per-batch dispatches then pipeline without a host round-trip in between.
-# Entries hold weakrefs and verify identity: id() values recycle after GC,
-# and serving another array's cached range would silently corrupt results.
-import collections as _collections
-import threading as _threading
-
-_DEVICE_SCALAR_MEMO: "_collections.OrderedDict" = _collections.OrderedDict()
-_DEVICE_SCALAR_LOCK = _threading.Lock()
-_DEVICE_SCALAR_MAX = 4096
-
-
-def _memo_device_scalars(kind: tuple, arrays: tuple, compute):
-    """Memoized `compute()` keyed by `kind` + identity of `arrays` (None
-    entries allowed). Falls back to plain computation when an array does
-    not support weakrefs."""
-    import weakref
-
-    live = tuple(a for a in arrays if a is not None)
-    key = (kind, tuple(id(a) if a is not None else None for a in arrays))
-    with _DEVICE_SCALAR_LOCK:
-        ent = _DEVICE_SCALAR_MEMO.get(key)
-        if ent is not None:
-            refs, value = ent
-            if all(r() is a for r, a in zip(refs, live)):
-                _DEVICE_SCALAR_MEMO.move_to_end(key)
-                return value
-            del _DEVICE_SCALAR_MEMO[key]
-    value = compute()
-    try:
-        refs = tuple(weakref.ref(a) for a in live)
-    except TypeError:
-        return value
-    with _DEVICE_SCALAR_LOCK:
-        _DEVICE_SCALAR_MEMO[key] = (refs, value)
-        while len(_DEVICE_SCALAR_MEMO) > _DEVICE_SCALAR_MAX:
-            _DEVICE_SCALAR_MEMO.popitem(last=False)
-    return value
+# Implementation lives in utils/device_memo (also used by exchange/sort
+# sampling and columnar ingest seeding).
+from ..utils.device_memo import (
+    DENSE_RANGE_KIND, memo_device_scalars as _memo_device_scalars,
+)
 
 
 def dense_range_stats(kc: Column, row_mask, cap: int):
@@ -468,7 +438,7 @@ def dense_range_stats(kc: Column, row_mask, cap: int):
             rkey, build_range)(kc.data, kc.validity, row_mask)
         return (int(kmin_d), int(kmax_d), bool(any_d))
 
-    return _memo_device_scalars(("dense_range",),
+    return _memo_device_scalars(DENSE_RANGE_KIND,
                                 (kc.data, kc.validity, row_mask), compute)
 
 

@@ -1,0 +1,228 @@
+"""Plan/trace analyzer (spark_tpu/analysis/plan_lint.py).
+
+Acceptance gate: on the fusion differential suite (agg, join+agg, limit,
+TPC-DS mini q3/q7), `explain("analysis")`'s predicted per-kind kernel
+launch counts must equal the measured KernelCache launch counters EXACTLY
+— fusion on and off. The prediction models one warm execution; the test
+warms once (compiles + device-cached scans + memo priming) and measures a
+second run, the same steady-state discipline the fusion dispatch tests
+use (the reference gates EXPLAIN CODEGEN with codegen-metrics checks the
+same way)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+
+@pytest.fixture()
+def fusion_conf(spark):
+    spark.conf.set("spark.tpu.fusion.minRows", "0")
+    yield spark
+    spark.conf.unset("spark.tpu.fusion.enabled")
+    spark.conf.unset("spark.tpu.fusion.minRows")
+
+
+@pytest.fixture()
+def data(spark):
+    rng = np.random.default_rng(7)
+    n = 5000
+    spark.createDataFrame(pa.table({
+        "k": rng.integers(0, 13, n),
+        "v": rng.integers(-50, 100, n),
+        "f": rng.random(n),
+        "s": [f"cat{i % 5}" for i in range(n)],
+    })).createOrReplaceTempView("an_t")
+    dim = pa.table({
+        "dk": np.arange(13, dtype=np.int64),
+        "label": [f"lab{i % 3}" for i in range(13)],
+    })
+    spark.createDataFrame(dim).createOrReplaceTempView("an_dim")
+    return spark
+
+
+Q_AGG = ("select k, sum(v * 2) sv, count(*) c, min(v) mn, max(v+1) mx, "
+         "avg(f) af from an_t where v > 0 group by k")
+Q_JOIN_AGG = ("select label, sum(v) sv, count(*) c from an_t "
+              "join an_dim on k = dk where v > 10 group by label")
+Q_LIMIT = ("select k + v * 100 as key2 from an_t where v > 95 "
+           "order by key2 limit 17")
+Q3 = """
+    SELECT dt.d_year, item.i_brand_id AS brand_id,
+           SUM(ss_ext_sales_price) AS sum_agg
+    FROM date_dim dt, store_sales, item
+    WHERE dt.d_date_sk = store_sales.ss_sold_date_sk
+      AND store_sales.ss_item_sk = item.i_item_sk
+      AND item.i_manufact_id = 28 AND dt.d_moy = 11
+    GROUP BY dt.d_year, item.i_brand_id"""
+Q7 = """
+    SELECT i.i_category, AVG(ss_quantity) AS agg1, COUNT(*) AS cnt
+    FROM store_sales ss
+    JOIN item i ON ss.ss_item_sk = i.i_item_sk
+    JOIN date_dim d ON ss.ss_sold_date_sk = d.d_date_sk
+    WHERE d.d_year = 1999
+    GROUP BY i.i_category"""
+
+
+def _predicted_vs_measured(spark, sql):
+    """(analysis report, measured by-kind launch delta of one warm run)."""
+    df = spark.sql(sql)
+    report = df.query_execution.analysis_report()
+    df.toArrow()  # warm: compile kernels, device-cache scans, prime memos
+    before = dict(KC.launches_by_kind)
+    spark.sql(sql).toArrow()
+    after = dict(KC.launches_by_kind)
+    measured = {k: v - before.get(k, 0) for k, v in after.items()
+                if v != before.get(k, 0)}
+    return report, measured
+
+
+def _assert_exact(spark, sql):
+    report, measured = _predicted_vs_measured(spark, sql)
+    assert report.exact, report.inexact_reasons
+    assert report.predicted_launches == measured, (
+        f"predicted {dict(sorted(report.predicted_launches.items()))} != "
+        f"measured {dict(sorted(measured.items()))}\n{report.render()}")
+
+
+# ---------------------------------------------------------------------------
+# acceptance: predicted == measured, fusion on AND off
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("enabled", ["true", "false"])
+def test_agg_launch_prediction_exact(fusion_conf, data, enabled):
+    data.conf.set("spark.tpu.fusion.enabled", enabled)
+    _assert_exact(data, Q_AGG)
+
+
+@pytest.mark.parametrize("enabled", ["true", "false"])
+def test_join_agg_launch_prediction_exact(fusion_conf, data, enabled):
+    data.conf.set("spark.tpu.fusion.enabled", enabled)
+    _assert_exact(data, Q_JOIN_AGG)
+
+
+@pytest.mark.parametrize("enabled", ["true", "false"])
+def test_limit_launch_prediction_exact(fusion_conf, data, enabled):
+    data.conf.set("spark.tpu.fusion.enabled", enabled)
+    _assert_exact(data, Q_LIMIT)
+
+
+@pytest.mark.parametrize("enabled", ["true", "false"])
+def test_tpcds_q3_q7_launch_prediction_exact(fusion_conf, spark, enabled):
+    from tpcds_mini import register_tpcds
+
+    register_tpcds(spark)
+    spark.conf.set("spark.tpu.fusion.enabled", enabled)
+    _assert_exact(spark, Q3)
+    _assert_exact(spark, Q7)
+
+
+def test_total_matches_kernel_launch_metric(fusion_conf, data):
+    """The report's total equals the per-query kernel.launches SQLMetric
+    delta the scheduler records (same ground truth, metric plumbing)."""
+    data.conf.set("spark.tpu.fusion.enabled", "true")
+    df = data.sql(Q_AGG)
+    report = df.query_execution.analysis_report()
+    df.toArrow()  # warm
+    before = data._metrics.snapshot()["counters"].get("kernel.launches", 0)
+    data.sql(Q_AGG).toArrow()
+    after = data._metrics.snapshot()["counters"].get("kernel.launches", 0)
+    assert report.total == after - before
+
+
+# ---------------------------------------------------------------------------
+# minRows runtime gate: fused PLAN, unfused runtime kernels — still exact
+# ---------------------------------------------------------------------------
+
+def test_min_rows_gate_prediction_exact(spark, data):
+    spark.conf.set("spark.tpu.fusion.enabled", "true")
+    try:
+        # default minRows (128k tile rows) far exceeds the 5k-row table:
+        # the analyzer must predict the UNFUSED runtime kernels under the
+        # fused plan, and say why
+        report, measured = _predicted_vs_measured(spark, Q_AGG)
+        assert report.exact, report.inexact_reasons
+        assert report.predicted_launches == measured, report.render()
+        assert "fused_agg" not in report.predicted_launches
+        assert any("minRows" in n for s in report.stages
+                   for n in s["notes"])
+    finally:
+        spark.conf.unset("spark.tpu.fusion.enabled")
+
+
+# ---------------------------------------------------------------------------
+# explain("analysis") surface + boundary explanations + hazards
+# ---------------------------------------------------------------------------
+
+def test_explain_analysis_renders(fusion_conf, data, capsys):
+    data.conf.set("spark.tpu.fusion.enabled", "true")
+    data.sql(Q_AGG).explain("analysis")
+    out = capsys.readouterr().out
+    assert "== Plan Analysis ==" in out
+    assert "predicted launches" in out
+    assert "FUSED" in out
+    assert "minRows" in out          # the runtime gate is explained
+    assert "fused_agg" in out
+
+
+def test_sort_consume_boundary_explained(fusion_conf, data):
+    data.conf.set("spark.tpu.fusion.enabled", "true")
+    report = data.sql(Q_LIMIT).query_execution.analysis_report()
+    assert any("Sort" in b and "UNFUSED" in b
+               for b in report.fusion_boundaries), report.fusion_boundaries
+
+
+def test_fusion_off_boundary_explained(fusion_conf, data):
+    data.conf.set("spark.tpu.fusion.enabled", "false")
+    report = data.sql(Q_AGG).query_execution.analysis_report()
+    assert any("spark.tpu.fusion.enabled=false" in b
+               for b in report.fusion_boundaries)
+
+
+def test_string_probe_key_boundary_explained(fusion_conf, data):
+    data.conf.set("spark.tpu.fusion.enabled", "true")
+    sdim = pa.table({"sk": [f"cat{i}" for i in range(5)],
+                     "w": np.arange(5, dtype=np.int64)})
+    data.createDataFrame(sdim).createOrReplaceTempView("an_sdim")
+    q = ("select s, w from an_t join an_sdim on s = sk where v > 0")
+    report = data.sql(q).query_execution.analysis_report()
+    assert any("string" in b and "UNFUSED probe" in b
+               for b in report.fusion_boundaries), report.fusion_boundaries
+
+
+def test_overflow_risk_flagged_for_int_sum(fusion_conf, data):
+    report = data.sql(Q_AGG).query_execution.analysis_report()
+    assert any("SUM(" in r and "int64" in r
+               for r in report.overflow_risks), report.overflow_risks
+
+
+def test_dense_recompile_hazard_flagged(fusion_conf, data):
+    data.conf.set("spark.tpu.fusion.enabled", "false")
+    report = data.sql(Q_AGG).query_execution.analysis_report()
+    assert any("value-dependent" in h
+               for h in report.recompile_hazards), report.recompile_hazards
+
+
+def test_report_dict_shape(fusion_conf, data):
+    d = data.sql(Q_AGG).query_execution.analysis_report().to_dict()
+    for key in ("stages", "predicted_launches", "predicted_total", "exact",
+                "fusion_boundaries", "recompile_hazards", "overflow_risks"):
+        assert key in d
+    assert d["predicted_total"] == sum(d["predicted_launches"].values())
+
+
+def test_inexact_degrades_honestly(fusion_conf, data):
+    """A hash-exchange query (multi-partition repartition) has runtime-
+    dependent layout: the analyzer must NOT claim exactness, and must say
+    why."""
+    data.conf.set("spark.tpu.fusion.enabled", "true")
+    df = (data.sql("select * from an_t").repartition(4, "k")
+          .groupBy("k").count())
+    report = df.query_execution.analysis_report()
+    assert not report.exact
+    assert report.inexact_reasons
+    # the exchange kernels themselves are still predicted
+    assert any(k.startswith(("shuffle_", "mesh_"))
+               for k in report.predicted_launches), \
+        report.predicted_launches
