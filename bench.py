@@ -51,6 +51,16 @@ ANALYZE = "--analyze" in sys.argv
 if ANALYZE:
     sys.argv = [a for a in sys.argv if a != "--analyze"]
 
+# --trace: run with span tracing + per-operator metrics ON and write a
+# Perfetto/Chrome-trace JSON (obs/tracing.py) next to the results —
+# SPARK_TPU_TRACE_PATH overrides the destination. dev/run_all.sh's trace
+# gate loads and validates the emitted file (dev/validate_trace.py).
+TRACE = "--trace" in sys.argv
+if TRACE:
+    sys.argv = [a for a in sys.argv if a != "--trace"]
+TRACE_PATH = os.environ.get("SPARK_TPU_TRACE_PATH", "bench_trace.json")
+_TRACE_TRACERS: list = []  # host-only span buffers (never pin sessions)
+
 
 def _maybe_analyze(df, name: str):
     """`df` may be a DataFrame or a zero-arg callable producing one (so
@@ -122,12 +132,23 @@ def _session(extra=None):
         "spark.sql.shuffle.partitions": 1,
         # no per-operator profiling overhead in measured runs
         "spark.tpu.ui.operatorMetrics": "false",
+        "spark.tpu.trace.enabled": "false",
     }
+    if TRACE:
+        # --trace is an observability run: spans + attributed metrics on
+        # (collection is launch-free, so dispatch counts stay honest)
+        conf["spark.tpu.ui.operatorMetrics"] = "true"
+        conf["spark.tpu.trace.enabled"] = "true"
     conf.update(extra or {})
     if SMOKE:
         conf["spark.tpu.batch.capacity"] = min(
             int(conf["spark.tpu.batch.capacity"]), 1 << 18)
-    return TpuSession("bench", conf)
+    session = TpuSession("bench", conf)
+    if TRACE:
+        # keep only the tracer (host span buffer): retaining the session
+        # would pin every config's device-resident scan caches at once
+        _TRACE_TRACERS.append(session.tracer)
+    return session
 
 
 def _df_from_table(session, table, name):
@@ -485,6 +506,22 @@ def main() -> int:
                 rec["metric"] += f" [SCALED {SCALE:g}x — vs_baseline invalid]"
             records.append(rec)
             _emit(rec)
+    if TRACE:
+        try:
+            from spark_tpu.obs.tracing import to_chrome_trace
+
+            spans = []
+            for t in _TRACE_TRACERS:
+                spans.extend(t.spans())
+            with open(TRACE_PATH, "w") as f:
+                json.dump(to_chrome_trace(spans, process_name="bench"), f)
+            _emit({"metric": "trace written", "value": len(spans),
+                   "unit": "spans", "vs_baseline": 1.0,
+                   "path": os.path.abspath(TRACE_PATH)})
+        except Exception as e:  # tracing must never sink a bench run
+            _emit({"metric": "trace FAILED", "value": 0, "unit": "error",
+                   "vs_baseline": 0.0,
+                   "error": f"{type(e).__name__}: {e}"[:200]})
     # floor at 0.001 so a catastrophically slow config drags the geomean
     # instead of vanishing from it (round() can produce exact 0.0)
     ok = [max(r["vs_baseline"], 0.001) for r in records]

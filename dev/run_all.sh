@@ -19,6 +19,11 @@ for ex in examples/*.py; do
     python "$ex" > /dev/null
 done
 
+echo "== trace gate (bench --smoke --trace + validation + drift) =="
+SPARK_TPU_TRACE_PATH=/tmp/sparktpu_smoke_trace.json \
+    python bench.py --smoke --trace
+JAX_PLATFORMS=cpu python dev/validate_trace.py /tmp/sparktpu_smoke_trace.json
+
 echo "== micro-benchmarks =="
 python benchmarks/run_benchmarks.py --rows "${BENCH_ROWS:-2000000}"
 

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""CI gate for the observability layer (dev/run_all.sh).
+
+Two checks, both hard failures:
+
+1. Trace validation — the Chrome-trace JSON emitted by `bench.py --smoke
+   --trace` must be well-formed (a non-empty `traceEvents` list of
+   complete/metadata events with sane fields), spans must nest properly
+   per thread track (stack discipline: no partial overlap), and at least
+   one span must carry non-empty kernel attribution (`args.launches`) —
+   proving the KernelCache→operator attribution path is live end to end.
+
+2. Drift gate — EXPLAIN ANALYZE on a representative fused aggregation
+   runs predicted-vs-measured reconciliation; any finding of severity
+   `error` (unexplained drift between analysis/plan_lint.py's launch
+   model and the execution layer) fails the build.
+
+Usage: python dev/validate_trace.py <trace.json>
+"""
+
+import json
+import os
+import sys
+
+# runs as `python dev/validate_trace.py` — spark_tpu lives one level up
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def fail(msg: str) -> "NoReturn":  # noqa: F821
+    print(f"validate_trace: FAIL — {msg}")
+    sys.exit(1)
+
+
+def validate_trace(path: str) -> None:
+    if not os.path.isfile(path):
+        fail(f"trace file {path} does not exist")
+    with open(path) as f:
+        try:
+            doc = json.load(f)
+        except json.JSONDecodeError as e:
+            fail(f"trace file is not valid JSON: {e}")
+    events = doc.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        fail("traceEvents missing or empty")
+    complete = [e for e in events if e.get("ph") == "X"]
+    if not complete:
+        fail("no complete ('ph': 'X') span events")
+    for e in complete:
+        for k in ("name", "ts", "dur", "pid", "tid"):
+            if k not in e:
+                fail(f"span event missing field {k!r}: {e}")
+        if e["dur"] < 0 or e["ts"] < 0:
+            fail(f"negative ts/dur: {e}")
+
+    # nesting: per tid, spans must obey stack discipline — any two spans
+    # either nest or are disjoint (1 µs fuzz for float rounding)
+    fuzz = 1.0
+    by_tid: dict = {}
+    for e in complete:
+        by_tid.setdefault(e["tid"], []).append(e)
+    for tid, evs in by_tid.items():
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []
+        for e in evs:
+            while stack and e["ts"] >= stack[-1]["ts"] + stack[-1]["dur"] \
+                    - fuzz:
+                stack.pop()
+            if stack:
+                parent = stack[-1]
+                if e["ts"] + e["dur"] > parent["ts"] + parent["dur"] + fuzz:
+                    fail(f"span {e['name']!r} partially overlaps "
+                         f"{parent['name']!r} on tid {tid} "
+                         "(broken nesting)")
+            stack.append(e)
+
+    attributed = [e for e in complete
+                  if (e.get("args") or {}).get("launches", 0) > 0]
+    if not attributed:
+        fail("no span carries kernel attribution (args.launches > 0) — "
+             "the KernelCache→operator attribution scope is dead")
+    cats = {e.get("cat") for e in complete}
+    print(f"validate_trace: trace OK — {len(complete)} spans, "
+          f"{len(by_tid)} thread tracks, {len(attributed)} with kernel "
+          f"attribution, categories={sorted(c for c in cats if c)}")
+
+
+def drift_gate() -> None:
+    """EXPLAIN ANALYZE a fused aggregation; severity-error drift findings
+    (launch-model divergence) fail the gate."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu import TpuSession
+
+    session = TpuSession("trace-gate", {
+        "spark.tpu.batch.capacity": 1 << 12,
+        "spark.sql.shuffle.partitions": 2,
+        "spark.tpu.fusion.minRows": "0",
+    })
+    rng = np.random.default_rng(11)
+    n = 4000
+    session.createDataFrame(pa.table({
+        "k": rng.integers(0, 9, n),
+        "v": rng.integers(-20, 80, n),
+    })).createOrReplaceTempView("gate_t")
+    df = session.sql(
+        "select k, sum(v) s, count(*) c from gate_t where v > 0 group by k")
+    report = df.query_execution.analyzed_report()
+    errors = [f for f in report.findings if f["severity"] == "error"]
+    if errors:
+        print(report.render())
+        fail("EXPLAIN ANALYZE reported unexplained drift: "
+             + "; ".join(f["msg"] for f in errors))
+    print("validate_trace: drift gate OK — predicted "
+          f"{sum(report.predicted.values())} == measured "
+          f"{sum(report.measured.values())} launches, "
+          f"{len(report.findings)} non-error findings")
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print(__doc__)
+        return 2
+    validate_trace(argv[0])
+    drift_gate()
+    print("validate_trace: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1045,13 +1045,16 @@ class _Analyzer:
         kinds = Counter()
         if child.counted:
             kinds["sample"] = child.total_batches
-        self._hazard("SampleExec cache key embeds (partition, batch) "
-                     "indices — one compiled kernel PER BATCH (recompile "
-                     "storm; key only needs the global offset)")
         parts = [[_Batch(b.rows, b.cap, False) for b in p]
                  for p in child.parts]
+        # the per-(partition,batch) position base is a kernel INPUT, so
+        # one compiled kernel per (capacity, seed, fraction) serves every
+        # batch — no recompile hazard (the historical storm keyed by
+        # batch indices; fixed alongside this model)
         self._stage(node, kinds, child.total_batches if child.counted
-                    else None, [])
+                    else None,
+                    ["sample offset rides as a kernel argument: one "
+                     "compile per capacity bucket, 1 launch/batch"])
         return _Flow(parts, None, counted=child.counted)
 
     def _unknown(self, node) -> _Flow:

@@ -400,7 +400,13 @@ class DataFrame:
         """Print the query plans. mode="analysis" additionally runs the
         static plan analyzer (spark_tpu/analysis/plan_lint.py): predicted
         kernel launches per batch per stage, fusion-boundary explanations,
-        recompile/overflow hazards — the EXPLAIN CODEGEN analog."""
+        recompile/overflow hazards — the EXPLAIN CODEGEN analog.
+        mode="analyze" EXECUTES the query (one warm run + one measured
+        run) and renders the physical plan annotated with measured
+        per-operator metrics — rows, wall-ms, attributed kernel launches
+        and compile-ms, including inside whole-stage fused operators —
+        side by side with the static predictions, flagging drift
+        (obs/metrics.AnalyzedReport; the EXPLAIN ANALYZE analog)."""
         print(self.query_execution.explain_string(mode))
 
     def createOrReplaceTempView(self, name: str) -> None:

@@ -102,7 +102,11 @@ class TpuSession:
         self._cached: dict[int, Any] = {}
         self._streams: list = []
         from ..exec.listener import EventLoggingListener, ListenerBus
+        from ..obs.tracing import Tracer
 
+        # always-on span tracing (spark.tpu.trace.enabled flips it live);
+        # pure host bookkeeping — see obs/tracing.py
+        self.tracer = Tracer(conf=self.conf)
         self.listener_bus = ListenerBus()
         if str(self.conf.get("spark.eventLog.enabled", "false")).lower() \
                 == "true":
@@ -176,11 +180,21 @@ class TpuSession:
 
         if is_script(query):
             return execute_script(self, query)
-        plan = parse_sql(query)
+        mark = self.tracer.mark()
+        with self.tracer.span("parse", cat="phase"):  # no-op when disabled
+            plan = parse_sql(query)
         if isinstance(plan, Command):
             return run_command(self, plan)
         if isinstance(plan, WithCTE):
             plan = self._materialize_ctes(plan)
+        # the parse span predates the QueryExecution — ride it on the
+        # parsed plan so to_arrow's event includes the full lifecycle
+        parse_spans = self.tracer.since(mark)
+        if parse_spans:
+            try:
+                plan._parse_spans = parse_spans
+            except Exception:
+                pass
         return DataFrame(self, plan)
 
     def _materialize_ctes(self, wplan):

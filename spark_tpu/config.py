@@ -251,6 +251,28 @@ PUSH_SHUFFLE = _register(ConfigEntry(
     "Push-based shuffle: mappers push blocks to reducer-side merged "
     "files (reference: push-based shuffle, core/shuffle/push).", _bool))
 
+# --- observability (spark_tpu/obs/) ---------------------------------------
+
+TRACE_ENABLED = _register(ConfigEntry(
+    "spark.tpu.trace.enabled", True,
+    "Always-on span tracing of the query lifecycle (parse/analyze/"
+    "optimize/plan/stage/partition/exchange/collect; obs/tracing.py). "
+    "Pure host bookkeeping — zero kernel launches, zero device syncs; "
+    "export with session.tracer.write_chrome_trace() or bench.py "
+    "--trace.", _bool))
+
+TRACE_MAX_SPANS = _register(ConfigEntry(
+    "spark.tpu.trace.maxSpans", 100_000,
+    "Span-buffer cap per session tracer; spans past it are dropped and "
+    "counted so a long-lived session stays bounded.", int))
+
+KERNEL_ATTRIBUTION = _register(ConfigEntry(
+    "spark.tpu.metrics.kernelAttribution", True,
+    "Attribute KernelCache launch/compile-ms counters to the executing "
+    "physical operator (obs/metrics.py contextvar scope, propagated into "
+    "par_map lanes). Requires spark.tpu.ui.operatorMetrics; one "
+    "contextvar read per kernel launch when on.", _bool))
+
 
 class SQLConf:
     """Session-local config with string overrides over typed defaults.

@@ -57,9 +57,15 @@ class ExecContext:
     # session BlockManager when the query runs under one (device-pin
     # budget for scan caches; None in bare contexts/workers)
     block_manager: object = field(default=None, repr=False)
-    # id(physical node) → {rows, ms, calls} when per-operator SQLMetrics
-    # collection is on (ui/SparkPlanGraph role); None = no profiling
+    # id(physical node) → obs.metrics op record (rows/ms/batches/launch
+    # attribution) when per-operator SQLMetrics collection is on
+    # (ui/SparkPlanGraph role); None = no profiling
     plan_metrics: dict | None = field(default=None, repr=False)
+    # session Tracer when span tracing is on (obs/tracing.py); None = off
+    tracer: object = field(default=None, repr=False)
+    # attribute KernelCache launches to the executing operator
+    # (spark.tpu.metrics.kernelAttribution, resolved once per query)
+    kernel_attribution: bool = field(default=True, repr=False)
 
     @property
     def memory(self):
@@ -85,7 +91,23 @@ class ExecContext:
         """Dispatch independent partitions concurrently (async pipelining
         across partitions; see exec/scheduler.par_map). `fn` must be pure
         per-item device/host work — it must not recurse into plan
-        execution."""
+        execution. With tracing on, each partition records its own span
+        from its lane thread (distinct trace tracks), so the async
+        pipeline's overlap is visible in the exported timeline."""
         from .scheduler import par_map
 
-        return par_map(fn, list(items), self.partition_parallelism)
+        items = list(items)
+        tracer = self.tracer
+        if tracer is not None and tracer.enabled and len(items) > 1:
+            from ..obs.metrics import current_op_name
+
+            op = current_op_name() or "partition"
+
+            def traced(pair, _fn=fn, _op=op):
+                i, item = pair
+                with tracer.span(f"{_op}[p{i}]", cat="partition"):
+                    return _fn(item)
+
+            return par_map(traced, list(enumerate(items)),
+                           self.partition_parallelism)
+        return par_map(fn, items, self.partition_parallelism)

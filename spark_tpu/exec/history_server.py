@@ -134,22 +134,57 @@ class _Handler(BaseHTTPRequestHandler):
         graph = e.get("plan_graph")
         if graph:
             # SparkPlanGraph role: indented operator tree with
-            # per-operator SQLMetrics (rows / inclusive ms) and the AQE
-            # re-plan annotations row
+            # per-operator SQLMetrics (rows / inclusive ms / batches /
+            # attributed kernel launches + compile-ms), whole-stage
+            # fused-member re-attribution rows, and the AQE annotations
             parts.append("<h2>Plan graph</h2><table>"
                          "<tr><th style='text-align:left'>Operator</th>"
-                         "<th>rows</th><th>ms</th></tr>")
+                         "<th>rows</th><th>ms</th><th>batches</th>"
+                         "<th>launches</th><th>compile ms</th></tr>")
             for nd in graph:
                 pad = "&nbsp;" * 4 * int(nd.get("depth") or 0)
                 rows = nd.get("rows")
+                if rows is not None and not nd.get("rows_exact", True):
+                    rows = f"&ge;{rows}"  # partial count (mask pull failed)
                 ms = nd.get("ms")
+                launches = nd.get("launches") or {}
+                ls = ", ".join(f"{k}:{v}"
+                               for k, v in sorted(launches.items()))
                 detail = _esc(str(nd.get("detail") or ""))[:140]
                 parts.append(
                     f"<tr><td style='text-align:left'>{pad}"
                     f"<b>{_esc(nd.get('op') or '')}</b> "
                     f"<span style='color:#888'>{detail}</span></td>"
                     f"<td>{'' if rows is None else rows}</td>"
-                    f"<td>{'' if ms is None else ms}</td></tr>")
+                    f"<td>{'' if ms is None else ms}</td>"
+                    f"<td>{nd.get('batches') or ''}</td>"
+                    f"<td>{_esc(ls)}</td>"
+                    f"<td>{nd.get('compile_ms') or ''}</td></tr>")
+                for member in nd.get("fused") or []:
+                    parts.append(
+                        f"<tr><td style='text-align:left'>{pad}"
+                        "&nbsp;&nbsp;&#8627; <span style='color:#888'>"
+                        f"fused: {_esc(member)}</span></td>"
+                        "<td></td><td></td><td></td>"
+                        "<td><span style='color:#888'>shares parent "
+                        "dispatch</span></td><td></td></tr>")
+            parts.append("</table>")
+        spans = e.get("spans")
+        if spans:
+            # span timeline (SQL-tab execution timeline analog): phase /
+            # stage / operator / partition-lane spans with durations
+            parts.append("<h2>Span timeline</h2><table><tr>"
+                         "<th style='text-align:left'>Span</th>"
+                         "<th>category</th><th>thread</th><th>ms</th>"
+                         "</tr>")
+            top = sorted(spans, key=lambda s: -(s.get("dur_ms") or 0))[:60]
+            for sp in top:
+                parts.append(
+                    f"<tr><td style='text-align:left'>"
+                    f"{_esc(sp.get('name'))}</td>"
+                    f"<td>{_esc(sp.get('cat'))}</td>"
+                    f"<td>{_esc(sp.get('thread'))}</td>"
+                    f"<td>{sp.get('dur_ms')}</td></tr>")
             parts.append("</table>")
         metrics = e.get("metrics")
         if metrics:

@@ -30,9 +30,13 @@ class QueryEvent:
     plan: str = ""
     error: str | None = None
     metrics: dict = field(default_factory=dict)
-    # executed-plan node list with per-operator rows/ms + AQE notes
-    # (SparkPlanGraph role; rendered by the live UI / history server)
+    # executed-plan node list with per-operator rows/ms/batches/attributed
+    # kernel launches + AQE notes (SparkPlanGraph role; rendered by the
+    # live UI / history server)
     plan_graph: list = field(default_factory=list)
+    # query-lifecycle spans (obs/tracing.py dicts: name/cat/ts/dur_ms/
+    # thread) — the SQL-tab timeline analog, replayable from the event log
+    spans: list = field(default_factory=list)
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), default=str)
@@ -146,8 +150,40 @@ class HistoryReader:
 
     def summary(self, app_file: str) -> dict:
         events = self.load(app_file)
-        queries = [e for e in events if e["event"] == "querySucceeded"]
-        failed = [e for e in events if e["event"] == "queryFailed"]
-        total_ms = sum(e.get("duration_ms") or 0 for e in queries)
-        return {"queries": len(queries), "failed": len(failed),
-                "total_duration_ms": total_ms}
+        return summarize_events(events)
+
+
+def summarize_events(events: list) -> dict:
+    """Replay a query-event stream into an application summary: query/
+    failure counts plus the observability rollups (kernel.* dispatch
+    counters and per-operator metric totals aggregated over every
+    query's plan graph) — the history-server/live-UI shared shape."""
+    queries = [e for e in events if e["event"] == "querySucceeded"]
+    failed = [e for e in events if e["event"] == "queryFailed"]
+    total_ms = sum(e.get("duration_ms") or 0 for e in queries)
+    # kernel.* session counters are cumulative — the last event carries
+    # the application totals (kernel_cache.* are process-absolute)
+    kernel = {}
+    if queries:
+        kernel = {k: v for k, v in (queries[-1].get("metrics") or {}).items()
+                  if k.startswith(("kernel.", "kernel_cache."))}
+    operators: dict = {}
+    span_ms = 0.0
+    for e in queries:
+        for nd in e.get("plan_graph") or []:
+            op = nd.get("op") or "?"
+            o = operators.setdefault(
+                op, {"rows": 0, "ms": 0.0, "launches": 0, "calls": 0})
+            if nd.get("rows") is not None:
+                o["rows"] += nd["rows"]
+            if nd.get("ms") is not None:
+                o["ms"] = round(o["ms"] + nd["ms"], 2)
+            o["launches"] += sum((nd.get("launches") or {}).values())
+            o["calls"] += 1
+        for sp in e.get("spans") or []:
+            span_ms += sp.get("dur_ms") or 0
+    return {"queries": len(queries), "failed": len(failed),
+            "total_duration_ms": total_ms, "kernel": kernel,
+            "operators": operators,
+            "span_count": sum(len(e.get("spans") or []) for e in queries),
+            "span_total_ms": round(span_ms, 2)}

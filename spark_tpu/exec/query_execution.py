@@ -57,9 +57,19 @@ class QueryExecution:
         self.phase_times: dict[str, float] = {}
         self.tracker = QueryPlanningTracker()
 
+    @property
+    def _tracer(self):
+        t = getattr(self.session, "tracer", None)
+        return t if (t is not None and t.enabled) else None
+
     def _timed(self, name: str, fn):
+        tracer = self._tracer
         t0 = time.perf_counter()
-        out = fn()
+        if tracer is not None:
+            with tracer.span(name, cat="phase"):
+                out = fn()
+        else:
+            out = fn()
         self.phase_times[name] = time.perf_counter() - t0
         return out
 
@@ -124,16 +134,22 @@ class QueryExecution:
                            lambda: self.session._planner().plan(optimized))
 
     def execute(self) -> list:
+        from ..config import KERNEL_ATTRIBUTION, UI_OPERATOR_METRICS
+        from ..obs.metrics import discard_pending, finalize_plan_metrics
         from .scheduler import DAGScheduler
 
         plan = self.physical
         ctx = ExecContext(conf=self.session.conf,
                           metrics=self.session._metrics,
                           block_manager=getattr(
-                              self.session, "block_manager", None))
-        if str(self.session.conf.get("spark.tpu.ui.operatorMetrics",
-                                     "true")).lower() == "true":
+                              self.session, "block_manager", None),
+                          tracer=self._tracer)
+        # conf values are host data — bool() here never touches device
+        if bool(self.session.conf.get(  # tpulint: ignore[host-sync]
+                UI_OPERATOR_METRICS)):
             ctx.plan_metrics = {}
+            ctx.kernel_attribution = bool(  # tpulint: ignore[host-sync]
+                self.session.conf.get(KERNEL_ATTRIBUTION))
             # stable metric keys BEFORE execution: the stage builder
             # copies exchanges and their ancestors (with_new_children),
             # and copies share __dict__, so a pre-assigned id survives
@@ -156,7 +172,16 @@ class QueryExecution:
                 listener_bus=bus)
         else:
             sched = DAGScheduler(ctx, listener_bus=bus)
-        return self._timed("execution", lambda: sched.run(plan))
+        try:
+            out = self._timed("execution", lambda: sched.run(plan))
+        except Exception:
+            discard_pending(ctx.plan_metrics)
+            raise
+        # query end: resolve row counts parked during sync-free collection
+        # (one memoized host read per distinct mask identity — the only
+        # device read the metrics layer performs, after the last dispatch)
+        finalize_plan_metrics(ctx.plan_metrics)
+        return out
 
     def to_arrow(self) -> pa.Table:
         import uuid
@@ -165,30 +190,40 @@ class QueryExecution:
 
         qid = uuid.uuid4().hex[:12]
         bus = getattr(self.session, "listener_bus", None)
+        tracer = self._tracer
+        span_mark = tracer.mark() if tracer is not None else 0
         t0 = time.perf_counter()
         if bus is not None:
             bus.post(QueryEvent("queryStarted", qid, time.time()))
         try:
-            parts = self.execute()
-            batches = [b for p in parts for b in p]
-            schema = attrs_schema(self.physical.output)
-            if not batches:
-                from ..columnar.batch import ColumnarBatch
+            from contextlib import nullcontext
 
-                batches = [ColumnarBatch.empty(schema)]
-            tables = [b.to_arrow() for b in batches]
-            try:
-                # identical schemas concat fine even with duplicate output
-                # names (legal, as in the reference); permissive unify
-                # (which rejects duplicates) only for promotions
-                out = pa.concat_tables(tables)
-            except pa.lib.ArrowInvalid:
-                out = pa.concat_tables(tables, promote_options="permissive")
+            parts = self.execute()
+            with tracer.span("collect", cat="phase") if tracer is not None \
+                    else nullcontext():
+                batches = [b for p in parts for b in p]
+                schema = attrs_schema(self.physical.output)
+                if not batches:
+                    from ..columnar.batch import ColumnarBatch
+
+                    batches = [ColumnarBatch.empty(schema)]
+                tables = [b.to_arrow() for b in batches]
+                try:
+                    # identical schemas concat fine even with duplicate
+                    # output names (legal, as in the reference); permissive
+                    # unify (which rejects duplicates) only for promotions
+                    out = pa.concat_tables(tables)
+                except pa.lib.ArrowInvalid:
+                    out = pa.concat_tables(tables,
+                                           promote_options="permissive")
             limit = int(self.session.conf.get(MAX_RESULT_ROWS))
             if out.num_rows > limit:
                 raise RuntimeError(
                     f"result has {out.num_rows} rows > "
                     "spark.tpu.collect.maxRows")
+            # consume parse spans on first collect even with tracing off
+            # NOW — a later traced collect must not re-report them
+            parse_spans = self._consume_parse_spans()
             if bus is not None:
                 from ..physical.compile import GLOBAL_KERNEL_CACHE as KC
 
@@ -206,7 +241,9 @@ class QueryExecution:
                     phases=dict(self.phase_times),
                     plan=self.physical.tree_string(),
                     metrics=counters,
-                    plan_graph=self.plan_graph()))
+                    plan_graph=self.plan_graph(),
+                    spans=(parse_spans + tracer.since(span_mark))
+                    if tracer is not None else []))
             return out
         except Exception as e:
             if bus is not None:
@@ -216,35 +253,48 @@ class QueryExecution:
                     error=f"{type(e).__name__}: {e}"))
             raise
 
+    def _consume_parse_spans(self) -> list:
+        """Parse spans ride the parsed plan (session.sql records them
+        before this QueryExecution exists); consume ON FIRST COLLECT so a
+        re-collected DataFrame does not re-report a parse that never
+        ran."""
+        spans = getattr(self.logical, "_parse_spans", None)
+        if spans is None:
+            return []
+        try:
+            del self.logical._parse_spans
+        except AttributeError:
+            pass
+        return spans
+
     def plan_graph(self) -> list:
         """The executed plan as a node list with per-operator SQLMetrics
-        and AQE annotations (role of sqlx/execution/ui/SparkPlanGraph.scala
-        — the UI renders this instead of re-parsing plan text)."""
+        (rows / inclusive ms / batches / attributed kernel launches and
+        compile-ms) and AQE annotations (role of sqlx/execution/ui/
+        SparkPlanGraph.scala — the UI renders this instead of re-parsing
+        plan text)."""
+        from ..obs.metrics import (
+            finalize_plan_metrics, fused_members, iter_plan_metrics,
+            metric_key,
+        )
+
         ctx = getattr(self, "_last_ctx", None)
         rec = getattr(ctx, "plan_metrics", None) or {}
+        finalize_plan_metrics(rec)  # resolve any parked row masks
         nodes = []
-
-        def key_of(node):
-            k = getattr(node, "_metric_id", None)
-            return id(node) if k is None else k
-
-        def walk(node, depth):
-            m = rec.get(key_of(node), {})
+        for node, depth, key, fields in iter_plan_metrics(self.physical,
+                                                          rec):
             nodes.append({
-                "id": key_of(node),
+                "id": key,
                 "depth": depth,
                 "op": node.graph_name()
                 if hasattr(node, "graph_name") else type(node).__name__,
                 "detail": node.simple_string()
                 if hasattr(node, "simple_string") else "",
-                "rows": m.get("rows"),
-                "ms": round(m["ms"], 2) if "ms" in m else None,
-                "children": [key_of(c) for c in node.children],
+                **fields,
+                "fused": fused_members(node) or None,
+                "children": [metric_key(c) for c in node.children],
             })
-            for c in node.children:
-                walk(c, depth + 1)
-
-        walk(self.physical, 0)
         # AQE re-plan annotations: THIS query's delta over the session
         # counters (they are cumulative across queries)
         annotations = []
@@ -271,12 +321,73 @@ class QueryExecution:
 
         return analyze_plan(self.physical, self.session.conf)
 
+    def analyzed_report(self, warm: bool = True):
+        """EXPLAIN ANALYZE: execute the query and annotate the physical
+        plan with MEASURED per-operator metrics (rows, inclusive wall-ms,
+        batches, attributed kernel launches + compile-ms — including
+        inside whole-stage fused operators, whose single dispatch is
+        re-attributed to the FuseStages members), side by side with the
+        static analyzer's predictions. Drift between the two (measured
+        launches ≠ predicted, runtime minRows gate decisions, capacity
+        retries) is surfaced as first-class findings.
+
+        The static model predicts one WARM run (kernels compiled,
+        device-cached scans resident, device-scalar memos primed), so by
+        default the query executes once to warm and the SECOND run is
+        measured — the same steady-state discipline as
+        tests/test_plan_analysis.py. Pass warm=False to measure the cold
+        run (compile misses then show up as drift findings)."""
+        from ..config import KERNEL_ATTRIBUTION, UI_OPERATOR_METRICS
+        from ..obs.metrics import build_analyzed_report
+        from ..physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+        prediction = self.analysis_report()
+        # the report's whole point is per-operator annotation: force
+        # metrics collection AND launch attribution for the runs EXPLAIN
+        # ANALYZE itself drives, even in sessions that disable them
+        # (bench-style), then restore the session's settings
+        conf = self.session.conf
+        forced = (UI_OPERATOR_METRICS, KERNEL_ATTRIBUTION)
+        saved = {e.key: conf.overrides().get(e.key)
+                 for e in forced if e.key in conf.overrides()}
+        for e in forced:
+            conf.set(e, True)
+        try:
+            if warm:
+                QueryExecution(self.session, self.logical).to_arrow()
+            before_kinds = dict(KC.launches_by_kind)
+            before_counters = dict(
+                self.session._metrics.snapshot()["counters"])
+            t0 = time.perf_counter()
+            self.to_arrow()
+            wall_ms = (time.perf_counter() - t0) * 1000
+        finally:
+            for e in forced:
+                if e.key in saved:
+                    conf.set(e, saved[e.key])
+                else:
+                    conf.unset(e)
+        after_kinds = dict(KC.launches_by_kind)
+        after_counters = dict(self.session._metrics.snapshot()["counters"])
+        measured = {k: v - before_kinds.get(k, 0)
+                    for k, v in after_kinds.items()
+                    if v != before_kinds.get(k, 0)}
+        counter_deltas = {k: v - before_counters.get(k, 0)
+                          for k, v in after_counters.items()
+                          if v != before_counters.get(k, 0)}
+        ctx = getattr(self, "_last_ctx", None)
+        return build_analyzed_report(
+            self.physical, getattr(ctx, "plan_metrics", None),
+            prediction, measured, counter_deltas, wall_ms)
+
     def explain_string(self, mode: str = "formatted") -> str:
         if mode == "analysis":
             return "\n".join([
                 "== Physical Plan ==", self.physical.tree_string(),
                 self.analysis_report().render(),
             ])
+        if mode == "analyze":
+            return self.analyzed_report().render()
         parts = [
             "== Analyzed Logical Plan ==", self.analyzed.tree_string(),
             "== Optimized Logical Plan ==", self.optimized.tree_string(),

@@ -44,10 +44,15 @@ def par_map(fn: Callable, items: list, workers: int) -> list:
     device → host between every launch (role of the reference's task-slot
     parallelism inside one executor). Threads are ephemeral daemons striding
     over the item list — no pool to leak, deterministic output order, first
-    exception re-raised like the serial loop would."""
+    exception re-raised like the serial loop would. Each lane runs inside
+    a copy of the caller's contextvars context so the obs/ kernel-
+    attribution scope (the operator that called par_map) follows the
+    work onto the lane threads."""
     n = len(items)
     if n <= 1 or workers <= 1:
         return [fn(x) for x in items]
+    import contextvars
+
     w = min(workers, n)
     out: list = [None] * n
     errors: list = []
@@ -62,8 +67,10 @@ def par_map(fn: Callable, items: list, workers: int) -> list:
                 errors.append(e)
                 return
 
-    threads = [threading.Thread(target=run, args=(k,), daemon=True,
-                                name=f"tpu-dispatch-{k}")
+    # one context copy per lane: a Context cannot be entered concurrently
+    contexts = [contextvars.copy_context() for _ in range(w)]
+    threads = [threading.Thread(target=contexts[k].run, args=(run, k),
+                                daemon=True, name=f"tpu-dispatch-{k}")
                for k in range(w)]
     for t in threads:
         t.start()
@@ -222,6 +229,8 @@ class DAGScheduler:
         result_stage, stages = build_stage_graph(plan)
         done: set[int] = set()
 
+        tracer = getattr(self.ctx, "tracer", None)
+
         def run_stage(stage: Stage) -> None:
             last_err: Exception | None = None
             for attempt in range(self.max_attempts):
@@ -229,7 +238,13 @@ class DAGScheduler:
                 try:
                     self._post("stageSubmitted", stage)
                     t0 = time.perf_counter()
-                    stage.result = stage.root.execute(self.ctx)
+                    if tracer is not None:
+                        with tracer.span(f"stage-{stage.stage_id}",
+                                         cat="stage",
+                                         args={"attempt": attempt + 1}):
+                            stage.result = stage.root.execute(self.ctx)
+                    else:
+                        stage.result = stage.root.execute(self.ctx)
                     from ..columnar.validate import maybe_validate
 
                     maybe_validate(stage.result, self.ctx,

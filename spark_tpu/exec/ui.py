@@ -53,15 +53,16 @@ class LiveStatusStore:
             return list(self._events)
 
     def summary(self, _app: str) -> dict:
+        from .listener import summarize_events
+
         events = self.load(_app)
-        done = [e for e in events if e["event"] == "querySucceeded"]
-        failed = [e for e in events if e["event"] == "queryFailed"]
         with self._lock:
             running = len(self._running)
-        return {"queries": len(done), "failed": len(failed),
-                "total_duration_ms": sum(e.get("duration_ms") or 0
-                                         for e in done),
-                "running": running}
+        # same rollup as the history server (kernel.* + per-operator
+        # totals) so both UIs render one shape, plus the live-only count
+        out = summarize_events(events)
+        out["running"] = running
+        return out
 
 
 class SparkUI:

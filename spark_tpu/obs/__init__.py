@@ -1,0 +1,23 @@
+"""Runtime observability: always-on query tracing + per-operator metrics.
+
+Role of the reference's SQLMetrics / SQL-tab plan graph / event-log
+pipeline (sqlx/metric/SQLMetrics.scala, sqlx/execution/ui/SparkPlanGraph
+.scala, core/scheduler/EventLoggingListener.scala), extended with the
+numbers that matter on a TPU: per-operator kernel-launch and compile-ms
+attribution (scoped KernelCache counters, re-attributed through
+whole-stage fusion) and a span timeline exportable as Perfetto/Chrome
+trace JSON.
+
+Design constraint (enforced by tests/test_observability.py): collection
+adds ZERO kernel launches and ZERO mid-query device syncs — row counts
+come from host-side batch metadata, and unresolved live-row masks are
+pulled once per distinct mask identity at query end (parked under a
+per-query byte budget so metrics-on never pins unbounded HBM).
+"""
+
+from .tracing import Tracer, to_chrome_trace  # noqa: F401
+from .metrics import (  # noqa: F401
+    AnalyzedReport, current_op_name, finalize_plan_metrics, fused_members,
+    new_op_record, pop_op, push_op, record_kernel_compile,
+    record_kernel_launch,
+)

@@ -1,0 +1,401 @@
+"""Per-operator SQLMetrics with kernel-launch attribution + EXPLAIN ANALYZE.
+
+Role of the reference's SQLMetrics + the SQL tab's per-node metric
+annotations (sqlx/metric/SQLMetrics.scala, SparkPlanGraph), with the two
+pieces a fusing TPU engine needs that Spark does not:
+
+  * kernel attribution — the process-global KernelCache counts launches
+    and compile-ms; a contextvar scoped to the EXECUTING operator (pushed
+    by the PhysicalPlan execute wrapper, propagated into par_map lanes)
+    re-buckets every launch to the physical node that dispatched it. A
+    whole-stage fused operator owns its single dispatch; `fused_members`
+    re-attributes that dispatch to the constituent operators the
+    `FuseStages` rewrite collapsed (Flare's lesson: once a stage compiles
+    to one program, per-operator attribution must be rebuilt
+    deliberately).
+
+  * sync-free row counts — output rows come from host-side batch
+    metadata (`_num_rows`); batches whose live count is only on device
+    park their row-mask array (bounded by a per-query byte budget) and
+    are resolved ONCE per distinct mask identity at query end. Collection
+    never launches a kernel and never blocks mid-query.
+
+`AnalyzedReport` is the EXPLAIN ANALYZE surface: the executed plan
+annotated with measured metrics side by side with the static analyzer's
+predictions (analysis/plan_lint.py), with drift between them surfaced as
+first-class findings.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["AnalyzedReport", "current_op_name", "finalize_plan_metrics",
+           "fused_members", "new_op_record", "pop_op", "push_op",
+           "record_kernel_launch", "record_kernel_compile"]
+
+
+# ---------------------------------------------------------------------------
+# Attribution scope: which operator is executing on this thread/lane
+# ---------------------------------------------------------------------------
+
+# (record dict | None, operator name). contextvars (not thread-locals) so
+# exec/scheduler.par_map can copy the context into its lane threads and
+# kernels dispatched from a lane still attribute to the dispatching node.
+_SCOPE: "contextvars.ContextVar" = contextvars.ContextVar(
+    "spark_tpu_op_scope", default=None)
+
+# per-record Counter updates are read-modify-write; lanes of one operator
+# share its record, so serialize the tiny increments
+_ATTR_LOCK = threading.Lock()
+
+
+def new_op_record() -> dict:
+    return {"rows": 0, "rows_exact": True, "batches": 0, "ms": 0.0,
+            "calls": 0, "kinds": {}, "launch_total": 0, "compile_ms": 0.0,
+            "pending": []}
+
+
+def push_op(record: dict | None, name: str):
+    """Enter an operator's attribution scope; returns the reset token."""
+    return _SCOPE.set((record, name))
+
+
+def pop_op(token) -> None:
+    _SCOPE.reset(token)
+
+
+def current_op_name() -> str | None:
+    scope = _SCOPE.get()
+    return scope[1] if scope is not None else None
+
+
+def record_kernel_launch(kind) -> None:
+    """Called by KernelCache on every kernel invocation (pure host
+    bookkeeping — never a launch or sync itself)."""
+    scope = _SCOPE.get()
+    if scope is None or scope[0] is None:
+        return
+    rec = scope[0]
+    with _ATTR_LOCK:
+        rec["kinds"][kind] = rec["kinds"].get(kind, 0) + 1
+        rec["launch_total"] += 1
+
+
+def record_kernel_compile(kind, ms: float) -> None:
+    """Called by KernelCache for builder time and first-invocation (XLA
+    lazy compile) time."""
+    scope = _SCOPE.get()
+    if scope is None or scope[0] is None:
+        return
+    rec = scope[0]
+    with _ATTR_LOCK:
+        rec["compile_ms"] += ms
+
+
+# ---------------------------------------------------------------------------
+# Sync-free row accounting
+# ---------------------------------------------------------------------------
+
+# Device-memory ceiling for row masks parked until query end. Parking
+# holds a strong reference (the mask cannot be freed mid-query), so the
+# budget bounds the extra HBM metrics-on can pin on huge queries: beyond
+# it, rows degrade to a lower bound (rows_exact=False) instead of
+# risking an OOM a metrics-off run would not hit. The special "_parked"
+# key in the plan_metrics dict carries the query's remaining budget.
+PARKED_MASK_BUDGET_BYTES = 64 << 20
+_PARKED_KEY = "_parked"
+
+
+def count_batch(rec: dict, record: dict, batch) -> None:
+    """Account one output batch against an operator record using only
+    host-side metadata. Device masks are parked (within the per-query
+    byte budget) for query-end resolution — never pulled here."""
+    record["batches"] += 1
+    n = getattr(batch, "_num_rows", None)
+    if n is not None:
+        record["rows"] += n
+        return
+    mask = getattr(batch, "row_mask", None)
+    if mask is None:
+        record["rows_exact"] = False
+        return
+    if isinstance(mask, np.ndarray):  # already host data — free to count
+        record["rows"] += int(mask.sum())
+        return
+    budget = rec.get(_PARKED_KEY)
+    if budget is None:
+        budget = rec[_PARKED_KEY] = [PARKED_MASK_BUDGET_BYTES, set()]
+    remaining, charged = budget
+    if id(mask) in charged:
+        # already pinned by another operator's park this query: sharing
+        # a mask costs one pull and one ref — charge the budget once
+        record["pending"].append(mask)
+        return
+    nbytes = int(getattr(mask, "nbytes", 0) or 0)
+    if remaining - nbytes < 0:
+        record["rows_exact"] = False  # budget spent: lower bound only
+        return
+    budget[0] = remaining - nbytes
+    charged.add(id(mask))
+    record["pending"].append(mask)
+
+
+def _op_records(rec: dict):
+    return (ent for k, ent in rec.items() if k != _PARKED_KEY)
+
+
+def metric_key(node) -> int:
+    """Stable metric-record key: the pre-assigned `_metric_id` (survives
+    the stage builder's exchange copies) or the object id."""
+    k = getattr(node, "_metric_id", None)
+    return id(node) if k is None else k
+
+
+def iter_plan_metrics(physical, rec: dict):
+    """Depth-first (node, depth, key, metric-fields) over the executed
+    plan — the single walker both plan_graph and EXPLAIN ANALYZE consume,
+    so a new metric field reaches every renderer at once."""
+    out = []
+
+    def walk(node, depth):
+        key = metric_key(node)
+        out.append((node, depth, key, op_metric_fields(rec.get(key))))
+        for c in node.children:
+            walk(c, depth + 1)
+
+    walk(physical, 0)
+    return out
+
+
+def op_metric_fields(ent: dict | None) -> dict:
+    """One operator record → the per-node metric fields every renderer
+    shares (plan_graph, EXPLAIN ANALYZE, history server). Single place to
+    extend when records grow new counters — the walkers only add their
+    own identity/topology fields around this."""
+    if not ent:
+        return {"rows": None, "rows_exact": True, "ms": None,
+                "batches": None, "launches": None, "compile_ms": None}
+    return {"rows": ent["rows"], "rows_exact": ent["rows_exact"],
+            "ms": round(ent["ms"], 3),
+            "batches": ent["batches"] or None,
+            "launches": dict(ent["kinds"]) if ent["kinds"] else None,
+            "compile_ms": round(ent["compile_ms"], 3)
+            if ent["compile_ms"] else None}
+
+
+def finalize_plan_metrics(rec: dict | None) -> None:
+    """Resolve parked row masks at query end: one host pull per DISTINCT
+    mask identity, deduped QUERY-LOCALLY so masks shared across operators
+    (reorder projections, rewrapped union batches) sync once. A local
+    dict — not the bounded utils/device_memo LRU — because parked masks
+    are per-query temporaries: pushing them through the shared memo could
+    evict the dense-range seeds and cause real kernel re-launches. This
+    is the only device read the metrics layer performs, and it happens
+    after the query's last dispatch."""
+    if not rec:
+        return
+    counts: dict[int, int] = {}  # id(mask) -> live rows, this query only
+    for ent in _op_records(rec):
+        pending = ent.get("pending")
+        if not pending:
+            continue
+        ent["pending"] = []
+        for mask in pending:
+            try:
+                n = counts.get(id(mask))
+                if n is None:
+                    n = counts[id(mask)] = int(np.asarray(mask).sum())
+                ent["rows"] += n
+            except Exception:
+                ent["rows_exact"] = False
+    rec.pop(_PARKED_KEY, None)
+
+
+def discard_pending(rec: dict | None) -> None:
+    """Drop parked masks without resolving (failed queries)."""
+    if not rec:
+        return
+    for ent in _op_records(rec):
+        if ent.get("pending"):
+            ent["pending"] = []
+            ent["rows_exact"] = False
+    rec.pop(_PARKED_KEY, None)
+
+
+# ---------------------------------------------------------------------------
+# Fused-stage re-attribution (the FuseStages mapping, inverted)
+# ---------------------------------------------------------------------------
+
+def pipeline_member_names(filters, outputs) -> list[str]:
+    """Filter/Project member descriptions of a fused pipeline (shared by
+    the fused operators' `fused_members` implementations)."""
+    out = []
+    if filters:
+        out.append("Filter[" + " AND ".join(
+            f.simple_string() for f in filters)[:80] + "]")
+    out.append("Project[" + ", ".join(
+        o.simple_string() for o in outputs)[:80] + "]")
+    return out
+
+
+def fused_members(node) -> list[str]:
+    """Constituent operators a whole-stage fused node subsumes, in
+    produce→consume order — the single fused dispatch per batch is
+    re-attributed to these (the reference renders member operators inside
+    their WholeStageCodegen cluster). Fused nodes expose the FuseStages
+    mapping via their `fused_members()` method; anything else has none."""
+    fn = getattr(node, "fused_members", None)
+    return fn() if fn is not None else []
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN ANALYZE report
+# ---------------------------------------------------------------------------
+
+@dataclass
+class AnalyzedReport:
+    """Measured steady-state execution annotated onto the physical plan,
+    reconciled against the static analyzer's predictions."""
+
+    nodes: list = field(default_factory=list)       # rendered rows
+    predicted: dict = field(default_factory=dict)   # kind -> launches
+    measured: dict = field(default_factory=dict)    # kind -> launches
+    prediction_exact: bool = True
+    findings: list = field(default_factory=list)    # {severity, kind?, msg}
+    counter_deltas: dict = field(default_factory=dict)
+    wall_ms: float = 0.0
+
+    @property
+    def drift_kinds(self) -> list[str]:
+        kinds = set(self.predicted) | set(self.measured)
+        return sorted(k for k in kinds
+                      if self.predicted.get(k, 0) != self.measured.get(k, 0))
+
+    @property
+    def has_unexplained_drift(self) -> bool:
+        return any(f["severity"] == "error" for f in self.findings)
+
+    def to_dict(self) -> dict:
+        return {"nodes": list(self.nodes),
+                "predicted": dict(self.predicted),
+                "measured": dict(self.measured),
+                "prediction_exact": self.prediction_exact,
+                "findings": list(self.findings),
+                "counter_deltas": dict(self.counter_deltas),
+                "wall_ms": round(self.wall_ms, 3)}
+
+    def render(self) -> str:
+        out = ["== EXPLAIN ANALYZE (measured steady-state run, "
+               f"{self.wall_ms:.1f} ms) =="]
+        for nd in self.nodes:
+            pad = "  " * nd["depth"]
+            rows = nd["rows"]
+            rows_s = "?" if rows is None else (
+                str(rows) if nd.get("rows_exact", True) else f">={rows}")
+            kinds = nd.get("launches") or {}
+            ks = ",".join(f"{k}:{v}" for k, v in sorted(kinds.items()))
+            line = (f"{pad}{nd['detail']}  "
+                    f"[rows={rows_s}"
+                    + (f", {nd['ms']:.2f} ms" if nd["ms"] is not None else "")
+                    + (f", batches={nd['batches']}" if nd.get("batches")
+                       else "")
+                    + (f", launches={{{ks}}}" if ks else "")
+                    + (f", compile={nd['compile_ms']:.1f} ms"
+                       if nd.get("compile_ms") else "")
+                    + "]")
+            out.append(line)
+            for m in nd.get("fused", ()):
+                out.append(f"{pad}  + fused: {m} (shares the stage's "
+                           "single dispatch per batch)")
+        out.append("-- kernel launches: predicted vs measured "
+                   + ("(prediction EXACT) --" if self.prediction_exact
+                      else "(prediction approximate) --"))
+        kinds = sorted(set(self.predicted) | set(self.measured))
+        for k in kinds:
+            p, m = self.predicted.get(k, 0), self.measured.get(k, 0)
+            mark = "ok" if p == m else "DRIFT"
+            out.append(f"  {k:<18} predicted={p:<5} measured={m:<5} {mark}")
+        out.append(f"  {'total':<18} predicted="
+                   f"{sum(self.predicted.values()):<5} measured="
+                   f"{sum(self.measured.values()):<5}")
+        if self.findings:
+            out.append("-- findings --")
+            for f in self.findings:
+                out.append(f"  [{f['severity']}] {f['msg']}")
+        else:
+            out.append("-- findings: none (zero drift) --")
+        return "\n".join(out)
+
+
+def build_analyzed_report(physical, plan_metrics: dict | None,
+                          prediction, measured: dict,
+                          counter_deltas: dict,
+                          wall_ms: float) -> AnalyzedReport:
+    """Assemble the EXPLAIN ANALYZE report from the executed plan's
+    per-operator records, the measured per-kind launch deltas, and the
+    static analyzer's AnalysisReport."""
+    rec = plan_metrics or {}
+    finalize_plan_metrics(rec)
+    nodes = []
+    for node, depth, _key, fields in iter_plan_metrics(physical, rec):
+        detail = node.simple_string() if hasattr(node, "simple_string") \
+            else type(node).__name__
+        detail = " ".join(detail.split())  # multi-line details flatten
+        nodes.append({"op": type(node).__name__, "detail": detail[:140],
+                      "depth": depth, **fields,
+                      "fused": fused_members(node)})
+
+    predicted = dict(prediction.predicted_launches)
+    findings: list[dict] = []
+    kinds = sorted(set(predicted) | set(measured))
+    for k in kinds:
+        p, m = predicted.get(k, 0), measured.get(k, 0)
+        if p == m:
+            continue
+        if prediction.exact:
+            findings.append({
+                "severity": "error", "kind": k,
+                "msg": f"unexplained drift on kernel kind '{k}': analyzer "
+                       f"predicted {p} launches (and claimed exactness), "
+                       f"measured {m} — the plan_lint launch model and the "
+                       "execution layer have diverged"})
+        else:
+            findings.append({
+                "severity": "info", "kind": k,
+                "msg": f"drift on kernel kind '{k}' (predicted {p}, "
+                       f"measured {m}) — analyzer declared itself "
+                       "approximate: "
+                       + "; ".join(prediction.inexact_reasons[:3])})
+    # runtime minRows gate decisions are first-class findings
+    gate_notes = {n for s in prediction.stages for n in s.get("notes", ())
+                  if "minRows" in n}
+    for n in sorted(gate_notes):
+        findings.append({"severity": "info", "kind": "minRows-gate",
+                         "msg": f"runtime fusion gate: {n}"})
+    retries = counter_deltas.get("join.capacity_retry", 0)
+    if retries:
+        findings.append({
+            "severity": "warning", "kind": "capacity-retry",
+            "msg": f"{retries} join probe capacity retr"
+                   f"{'y' if retries == 1 else 'ies'}: the probe kernel "
+                   "re-launched with a doubled output bucket "
+                   "(value-dependent cache key — extra dispatch + compile)"})
+    stage_retries = counter_deltas.get("scheduler.stage_retries", 0)
+    if stage_retries:
+        findings.append({
+            "severity": "warning", "kind": "stage-retry",
+            "msg": f"{stage_retries} stage retr"
+                   f"{'y' if stage_retries == 1 else 'ies'} during the "
+                   "measured run (lineage re-execution inflates measured "
+                   "launches)"})
+    return AnalyzedReport(nodes=nodes, predicted=predicted,
+                          measured=dict(measured),
+                          prediction_exact=prediction.exact,
+                          findings=findings,
+                          counter_deltas=dict(counter_deltas),
+                          wall_ms=wall_ms)

@@ -1,0 +1,204 @@
+"""Span-based query tracing with a Perfetto/Chrome-trace JSON exporter.
+
+Role of the reference's SQL-tab timeline + task-event timeline (the
+AppStatusListener-fed execution timeline the UI renders): every phase of
+the query lifecycle (parse → analyze → optimize → plan → per-stage
+per-partition execute → shuffle/exchange → collect) records a completed
+span. Spans are plain host bookkeeping — two perf_counter reads and one
+list append each — so tracing stays ON by default; async partition
+pipelining is visible because `ExecContext.par_map` lanes record their
+spans from their own threads (distinct `tid` tracks in the trace).
+
+Export is the Chrome trace-event format ("traceEvents" complete events,
+microsecond timestamps), loadable in Perfetto (ui.perfetto.dev) or
+chrome://tracing.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+__all__ = ["Tracer", "to_chrome_trace"]
+
+
+class _NullSpan:
+    """Disabled-tracer span: context-manager no-op."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_args(self, args) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("tracer", "name", "cat", "args", "t0")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args):
+        self.tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.t0 = 0.0
+
+    def set_args(self, args) -> None:
+        """Attach/merge args before exit (per-span kernel attribution)."""
+        if self.args is None:
+            self.args = args
+        else:
+            self.args.update(args)
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self.t0
+        t = threading.current_thread()
+        self.tracer._record(self.name, self.cat, self.t0, dur,
+                            t.ident, t.name, self.args)
+        return False
+
+
+class Tracer:
+    """Thread-safe accumulator of completed spans.
+
+    `conf`-backed tracers re-read spark.tpu.trace.enabled per span() so a
+    session can flip tracing without rebuilding the tracer (maxSpans is
+    refreshed on the same read — span close never touches conf, so the
+    hot _record path takes no lock but the tracer's own). The buffer is a
+    RING of the latest maxSpans spans: a long-lived session (connect
+    server, streaming, shell) keeps tracing its most recent queries
+    instead of going permanently dark once a cap fills; evicted-oldest
+    spans count in `dropped`, and mark()/since() use monotonic sequence
+    numbers so slices stay correct across eviction.
+
+    Per-QUERY span slices (mark()/since()) assume queries on one session
+    run sequentially; concurrent collects on a shared session interleave
+    in the buffer and cross-attribute event spans (ROADMAP: tag spans
+    with a query-scope contextvar).
+    """
+
+    def __init__(self, conf=None, enabled: bool = True,
+                 max_spans: int = 100_000):
+        import collections
+
+        self._conf = conf
+        self._enabled = enabled
+        self._max_spans = max_spans
+        # ring of (name, cat, t0, dur, tid, tname, args)
+        self._spans: "collections.deque" = collections.deque()
+        self._seq = 0              # total spans ever recorded
+        self._lock = threading.Lock()
+        self.dropped = 0
+
+    @property
+    def enabled(self) -> bool:
+        if self._conf is not None:
+            from ..config import TRACE_ENABLED, TRACE_MAX_SPANS
+
+            on = bool(self._conf.get(TRACE_ENABLED))
+            if on:  # piggyback the cap refresh on the same conf visit
+                self._max_spans = int(self._conf.get(TRACE_MAX_SPANS))
+            return on
+        return self._enabled
+
+    @property
+    def max_spans(self) -> int:
+        return self._max_spans
+
+    def span(self, name: str, cat: str = "exec",
+             args: Optional[dict] = None):
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, cat, args)
+
+    def _record(self, name, cat, t0, dur, tid, tname, args) -> None:
+        with self._lock:
+            self._spans.append((name, cat, t0, dur, tid, tname, args))
+            self._seq += 1
+            while len(self._spans) > self._max_spans:
+                self._spans.popleft()  # ring: evict oldest, keep tracing
+                self.dropped += 1
+
+    # -- reading ----------------------------------------------------------
+    def mark(self) -> int:
+        """Monotonic sequence number — pass to since() to slice one
+        query's spans out of a session-lived tracer (valid across ring
+        eviction)."""
+        with self._lock:
+            return self._seq
+
+    def since(self, mark: int) -> list[dict]:
+        """Spans recorded after mark(), as JSON-friendly dicts (spans the
+        ring already evicted are gone — only the tail can be lost)."""
+        with self._lock:
+            first = self._seq - len(self._spans)  # seq of oldest buffered
+            spans = list(self._spans)[max(0, mark - first):]
+        return [{"name": n, "cat": c, "ts": round(t0, 6),
+                 "dur_ms": round(dur * 1000, 3), "thread": tname,
+                 **({"args": args} if args else {})}
+                for n, c, t0, dur, _tid, tname, args in spans]
+
+    def spans(self) -> list:
+        with self._lock:
+            return list(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self.dropped = 0
+
+    # -- export -----------------------------------------------------------
+    def to_chrome_trace(self, process_name: str = "spark_tpu") -> dict:
+        return to_chrome_trace(self.spans(), process_name=process_name)
+
+    def write_chrome_trace(self, path: str,
+                           process_name: str = "spark_tpu") -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(process_name), f)
+        return path
+
+
+def to_chrome_trace(spans: list, process_name: str = "spark_tpu",
+                    pid: int = 1) -> dict:
+    """Raw tracer spans → Chrome trace-event JSON dict.
+
+    Complete ("ph": "X") events with microsecond timestamps relative to
+    the earliest span; one tid track per recording thread, labeled with
+    the thread name via metadata events (par_map lanes show as their own
+    pipelined tracks).
+    """
+    events = [{"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+               "args": {"name": process_name}}]
+    if not spans:
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+    tmin = min(s[2] for s in spans)
+    # key tracks by (ident, name): lane threads are ephemeral and Python
+    # reuses idents, so ident alone would merge distinct threads into one
+    # mislabeled track
+    tid_map: dict = {}
+    for name, cat, t0, dur, ident, tname, args in spans:
+        tid = tid_map.get((ident, tname))
+        if tid is None:
+            tid = tid_map[(ident, tname)] = len(tid_map) + 1
+            events.append({"ph": "M", "pid": pid, "tid": tid,
+                           "name": "thread_name", "args": {"name": tname}})
+        ev = {"ph": "X", "pid": pid, "tid": tid, "name": name, "cat": cat,
+              "ts": round((t0 - tmin) * 1e6, 3),
+              "dur": round(dur * 1e6, 3)}
+        if args:
+            ev["args"] = args
+        events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -20,6 +20,10 @@ import numpy as np
 
 from ..columnar.batch import Column, ColumnarBatch
 from ..expr.eval import HostCtx, TraceCtx, Val
+from ..obs.metrics import (
+    record_kernel_compile as _obs_compile,
+    record_kernel_launch as _obs_launch,
+)
 from ..expr.expressions import (
     Alias, AttributeReference, Expression, Literal, SortOrder,
 )
@@ -106,6 +110,9 @@ class KernelCache:
                 self.launches_by_kind[kind] += 1
                 first = state["first"]
                 state["first"] = False
+            # per-operator attribution (obs/metrics contextvar scope):
+            # host bookkeeping only — no dispatch, no sync
+            _obs_launch(kind)
             if first:
                 import time as _time
 
@@ -114,6 +121,7 @@ class KernelCache:
                 dt = (_time.perf_counter() - t0) * 1000
                 with self._lock:
                     self.compile_ms += dt
+                _obs_compile(kind, dt)
                 return out
             return f(*args, **kwargs)
 
@@ -138,6 +146,7 @@ class KernelCache:
             f = self._cache.setdefault(key, f)
             while len(self._cache) > self.max_size:
                 self._cache.popitem(last=False)
+        _obs_compile(key[0] if isinstance(key, tuple) and key else "?", dt)
         return f
 
     def counters(self) -> dict:

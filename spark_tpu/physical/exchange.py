@@ -48,7 +48,8 @@ class ShuffleExchangeExec(PhysicalPlan):
         self.last_stats = {}
         with ctx.metrics.time("shuffle"):
             if isinstance(p, SinglePartition):
-                return S.gather_single(parts)
+                with self._span(ctx, "exchange.gather", p):
+                    return S.gather_single(parts)
             if isinstance(p, HashPartitioning):
                 pos = {a.expr_id: i for i, a in enumerate(self.output)}
                 key_positions = []
@@ -60,17 +61,36 @@ class ShuffleExchangeExec(PhysicalPlan):
 
                 mesh = ME.mesh_for(p.num_partitions, ctx.conf, schema)
                 if mesh is not None:
-                    return ME.mesh_shuffle_hash(
-                        parts, key_positions, p.num_partitions, schema, ctx,
-                        self.last_stats, mesh)
-                return S.shuffle_hash(parts, key_positions, p.num_partitions,
-                                      schema, ctx, self.last_stats)
+                    with self._span(ctx, "exchange.mesh_all_to_all", p):
+                        return ME.mesh_shuffle_hash(
+                            parts, key_positions, p.num_partitions, schema,
+                            ctx, self.last_stats, mesh)
+                with self._span(ctx, "exchange.hash", p):
+                    return S.shuffle_hash(parts, key_positions,
+                                          p.num_partitions, schema, ctx,
+                                          self.last_stats)
             if isinstance(p, RangePartitioning):
-                return self._range_shuffle(parts, p, schema, ctx)
+                with self._span(ctx, "exchange.range", p):
+                    return self._range_shuffle(parts, p, schema, ctx)
             if isinstance(p, UnknownPartitioning):
-                return S.shuffle_round_robin(parts, p.num_partitions, schema,
-                                             ctx, self.last_stats)
+                with self._span(ctx, "exchange.round_robin", p):
+                    return S.shuffle_round_robin(parts, p.num_partitions,
+                                                 schema, ctx,
+                                                 self.last_stats)
         raise UnsupportedOperationError(f"exchange for {p}")
+
+    @staticmethod
+    def _span(ctx, name: str, p):
+        """Shuffle-kind span INSIDE the operator span, so the trace
+        timeline separates redistribution work from child execution (the
+        shuffle write/read lane of the reference's stage timeline)."""
+        tracer = getattr(ctx, "tracer", None)
+        if tracer is None:
+            from contextlib import nullcontext
+
+            return nullcontext()
+        return tracer.span(name, cat="exchange",
+                           args={"partitions": p.num_partitions})
 
     def _range_shuffle(self, parts, p: RangePartitioning, schema, ctx):
         order = p.orders[0]

@@ -149,6 +149,16 @@ class FusedAggregateExec(HashAggregateExec):
         # aggregate node inside its WholeStageCodegen cluster)
         return "HashAggregateExec"
 
+    def fused_members(self) -> list:
+        """The FuseStages mapping, inverted: constituent operators whose
+        work rides this node's single dispatch per batch (obs/ EXPLAIN
+        ANALYZE re-attributes the fused launch to these)."""
+        from ..obs.metrics import pipeline_member_names
+
+        return pipeline_member_names(self.filters, self.pipe_outputs) + [
+            "HashAggregate[partial](keys=[%s])"
+            % ", ".join(a.name for a in self.grouping)]
+
     def execute(self, ctx) -> list:
         parts = self.child.execute(ctx)
         return ctx.par_map(
@@ -427,6 +437,13 @@ class FusedLimitExec(LimitExec):
 
     def graph_name(self) -> str:
         return "LimitExec"
+
+    def fused_members(self) -> list:
+        """FuseStages mapping for obs/ dispatch re-attribution."""
+        from ..obs.metrics import pipeline_member_names
+
+        return pipeline_member_names(self.filters, self.pipe_outputs) + [
+            f"Limit[n={self.n}]"]
 
     def execute(self, ctx) -> list:
         parts = self.child.execute(ctx)

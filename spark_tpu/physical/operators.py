@@ -57,9 +57,14 @@ class PhysicalPlan(TreeNode):
     Every subclass's `execute` is wrapped ONCE at class-creation time
     with per-operator instrumentation (role of SQLMetrics,
     sqlx/metric/SQLMetrics.scala: each SparkPlan carries rows/time
-    metrics the UI's plan graph renders). The wrapper is a no-op unless
-    the ExecContext carries a `plan_metrics` dict, so unprofiled runs
-    pay one attribute lookup."""
+    metrics the UI's plan graph renders) plus the observability layer
+    (obs/): a tracer span per operator execute, and a kernel-attribution
+    scope so KernelCache launches/compile-ms bucket to the dispatching
+    node. The wrapper is a no-op unless the ExecContext carries a
+    `plan_metrics` dict or an enabled tracer, so bare runs pay two
+    attribute lookups. Collection is sync-free: row counts come from
+    host-side batch metadata; device masks are parked and resolved once
+    per identity at query end (obs.metrics.finalize_plan_metrics)."""
 
     def __init_subclass__(cls, **kw):
         super().__init_subclass__(**kw)
@@ -70,26 +75,54 @@ class PhysicalPlan(TreeNode):
         import functools
         import time as _time
 
+        from ..obs import metrics as _OM
+
         @functools.wraps(fn)
         def traced(self, ctx, *a, _orig=fn, **k):
             rec = getattr(ctx, "plan_metrics", None)
-            if rec is None:
+            tracer = getattr(ctx, "tracer", None)
+            if rec is None and tracer is None:
                 return _orig(self, ctx, *a, **k)
+            name = self.graph_name()
+            ent = None
+            token = None
+            if rec is not None:
+                key = getattr(self, "_metric_id", None)
+                if key is None:
+                    key = id(self)
+                ent = rec.get(key)
+                if ent is None:
+                    ent = rec[key] = _OM.new_op_record()
+                if getattr(ctx, "kernel_attribution", True):
+                    token = _OM.push_op(ent, name)
+            sp = tracer.span(name, cat="operator") if tracer is not None \
+                else None
+            l0 = ent["launch_total"] if ent is not None else 0
             t0 = _time.perf_counter()
-            out = _orig(self, ctx, *a, **k)
-            ms = (_time.perf_counter() - t0) * 1000
-            key = getattr(self, "_metric_id", None)
-            if key is None:
-                key = id(self)
-            ent = rec.get(key)
-            if ent is None:
-                ent = rec[key] = {"rows": 0, "ms": 0.0, "calls": 0}
-            ent["ms"] += ms                 # inclusive (children counted)
-            ent["calls"] += 1
             try:
-                ent["rows"] += sum(b.num_rows() for p in out for b in p)
-            except Exception:
-                pass                        # non-standard result shape
+                if sp is not None:
+                    sp.__enter__()
+                try:
+                    out = _orig(self, ctx, *a, **k)
+                finally:
+                    if sp is not None:
+                        if ent is not None:
+                            launched = ent["launch_total"] - l0
+                            if launched:
+                                sp.set_args({"launches": launched})
+                        sp.__exit__(None, None, None)
+            finally:
+                if token is not None:
+                    _OM.pop_op(token)
+            if ent is not None:
+                ent["ms"] += (_time.perf_counter() - t0) * 1000  # inclusive
+                ent["calls"] += 1
+                try:
+                    for p in out:
+                        for b in p:
+                            _OM.count_batch(rec, ent, b)
+                except Exception:
+                    pass                    # non-standard result shape
             return out
 
         traced._sql_metrics_wrapped = True
@@ -1805,6 +1838,17 @@ class HashJoinExec(PhysicalPlan):
         cols = left_cols + build_rows.columns
         return ColumnarBatch(schema, cols, r.out_mask, num_rows=None)
 
+    def fused_members(self) -> list:
+        """FuseStages probe-splice mapping for obs/ re-attribution: the
+        probe-side pipeline shares this join's probe dispatch."""
+        if self.probe_fusion is None:
+            return []
+        from ..obs.metrics import pipeline_member_names
+
+        filters, outputs = self.probe_fusion
+        return pipeline_member_names(filters, outputs) + [
+            f"HashJoin[{self.join_type}] probe"]
+
     def simple_string(self):
         k = ", ".join(f"{l.name}={r.name}"
                       for l, r in zip(self.left_keys, self.right_keys))
@@ -1953,12 +1997,16 @@ class SampleExec(PhysicalPlan):
             obatches = []
             for bi, b in enumerate(part):
                 cap = b.capacity
-                key = ("sample", cap, self.seed, threshold, pi, bi)
+                # the per-(partition,batch) global position base is a
+                # KERNEL INPUT, not part of the cache key: one compiled
+                # kernel per capacity bucket serves every batch position
+                # (keying by (pi, bi) compiled a kernel per batch — the
+                # recompile storm plan_lint/ROADMAP flagged)
+                key = ("sample", cap, self.seed, threshold)
 
-                def build(pi=pi, bi=bi):
-                    def kernel(mask):
-                        pos = jnp.arange(cap, dtype=jnp.int64) \
-                            + (pi << 40) + (bi << 28)
+                def build():
+                    def kernel(mask, base):
+                        pos = jnp.arange(cap, dtype=jnp.int64) + base
                         h = mix64(pos + self.seed)
                         keep = (h.view(jnp.uint64) >> jnp.uint64(34)) \
                             .astype(jnp.int64) < threshold
@@ -1967,8 +2015,10 @@ class SampleExec(PhysicalPlan):
                     return jax.jit(kernel)
 
                 kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build)
+                base = jnp.int64((pi << 40) + (bi << 28))
                 obatches.append(ColumnarBatch(
-                    b.schema, b.columns, kernel(b.row_mask), num_rows=None))
+                    b.schema, b.columns, kernel(b.row_mask, base),
+                    num_rows=None))
             out.append(obatches)
         return out
 

@@ -1,0 +1,392 @@
+"""Observability layer (spark_tpu/obs/): always-on tracing + per-operator
+metrics with kernel attribution + EXPLAIN ANALYZE drift detection.
+
+The hard constraint under test: collection adds ZERO kernel launches —
+metrics/tracing on (the default) must measure identical KernelCache
+launch deltas to metrics/tracing off, fusion on and off."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+
+@pytest.fixture()
+def data(spark):
+    rng = np.random.default_rng(23)
+    n = 5000
+    spark.createDataFrame(pa.table({
+        "k": rng.integers(0, 11, n),
+        "v": rng.integers(-40, 90, n),
+    })).createOrReplaceTempView("obs_t")
+    dim = pa.table({"dk": np.arange(11, dtype=np.int64),
+                    "label": [f"l{i % 3}" for i in range(11)]})
+    spark.createDataFrame(dim).createOrReplaceTempView("obs_dim")
+    return spark
+
+
+Q_AGG = "select k, sum(v) sv, count(*) c from obs_t where v > 0 group by k"
+Q_JOIN = ("select label, sum(v) sv from obs_t join obs_dim on k = dk "
+          "where v > 5 group by label")
+
+
+def _launch_delta(spark, sql):
+    spark.sql(sql).toArrow()  # warm: compiles + caches + memos
+    before = dict(KC.launches_by_kind)
+    spark.sql(sql).toArrow()
+    after = dict(KC.launches_by_kind)
+    return {k: v - before.get(k, 0) for k, v in after.items()
+            if v != before.get(k, 0)}
+
+
+# ---------------------------------------------------------------------------
+# overhead guard: metrics + tracing add ZERO kernel launches
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fusion", ["true", "false"])
+@pytest.mark.parametrize("sql", [Q_AGG, Q_JOIN], ids=["agg", "join+agg"])
+def test_metrics_and_tracing_zero_launch_overhead(data, fusion, sql):
+    spark = data
+    spark.conf.set("spark.tpu.fusion.enabled", fusion)
+    spark.conf.set("spark.tpu.fusion.minRows", "0")
+    try:
+        spark.conf.set("spark.tpu.ui.operatorMetrics", "true")
+        spark.conf.set("spark.tpu.trace.enabled", "true")
+        with_obs = _launch_delta(spark, sql)
+        spark.conf.set("spark.tpu.ui.operatorMetrics", "false")
+        spark.conf.set("spark.tpu.trace.enabled", "false")
+        without = _launch_delta(spark, sql)
+        assert with_obs == without, (
+            f"observability changed kernel dispatches: {with_obs} vs "
+            f"{without}")
+    finally:
+        for k in ("spark.tpu.fusion.enabled", "spark.tpu.fusion.minRows",
+                  "spark.tpu.ui.operatorMetrics", "spark.tpu.trace.enabled"):
+            spark.conf.unset(k)
+
+
+# ---------------------------------------------------------------------------
+# per-operator kernel attribution
+# ---------------------------------------------------------------------------
+
+def test_plan_graph_attributes_launches_per_operator(data):
+    spark = data
+    spark.conf.set("spark.tpu.fusion.enabled", "true")
+    spark.conf.set("spark.tpu.fusion.minRows", "0")
+    try:
+        spark.sql(Q_AGG).toArrow()  # warm
+        df = spark.sql(Q_AGG)
+        df.toArrow()
+        graph = df.query_execution.plan_graph()
+        launched = {nd["op"]: nd["launches"] for nd in graph
+                    if nd.get("launches")}
+        assert launched, "no operator carries attributed launches"
+        # the fused partial aggregate owns its fused_agg dispatches
+        agg = [l for op, l in launched.items()
+               if "HashAggregate" in op]
+        assert agg and any("fused_agg" in l or "dagg" in l or "gagg" in l
+                           for l in agg), launched
+        # attributed per-op totals == the global measured delta shape
+        total = sum(v for l in launched.values() for v in l.values())
+        assert total > 0
+        # fused member re-attribution rides the graph
+        fused_nodes = [nd for nd in graph if nd.get("fused")]
+        assert fused_nodes and any(
+            "HashAggregate[partial]" in m
+            for nd in fused_nodes for m in nd["fused"])
+    finally:
+        spark.conf.unset("spark.tpu.fusion.enabled")
+        spark.conf.unset("spark.tpu.fusion.minRows")
+
+
+def test_attribution_total_matches_global_counter(data):
+    """Sum of per-operator attributed launches == global per-query delta
+    (no dispatch escapes the operator scope on the local scheduler)."""
+    spark = data
+    spark.conf.set("spark.tpu.fusion.minRows", "0")
+    try:
+        spark.sql(Q_AGG).toArrow()  # warm
+        before = KC.launches
+        df = spark.sql(Q_AGG)
+        df.toArrow()
+        global_delta = KC.launches - before
+        graph = df.query_execution.plan_graph()
+        attributed = sum(v for nd in graph
+                         for v in (nd.get("launches") or {}).values())
+        assert attributed == global_delta
+    finally:
+        spark.conf.unset("spark.tpu.fusion.minRows")
+
+
+# ---------------------------------------------------------------------------
+# span tracing
+# ---------------------------------------------------------------------------
+
+def test_query_lifecycle_spans_and_chrome_export(data):
+    spark = data
+    mark = spark.tracer.mark()
+    df = spark.sql("select k + 1 kk, v from obs_t where v > 10")
+    df.toArrow()
+    spans = spark.tracer.since(mark)
+    cats = {s["cat"] for s in spans}
+    names = {s["name"] for s in spans}
+    assert "phase" in cats and "operator" in cats and "stage" in cats
+    assert {"parse", "analysis", "planning", "execution",
+            "collect"} <= names, names
+    # multi-partition operator work records per-partition lane spans
+    mark2 = spark.tracer.mark()
+    spark.sql("select v from obs_t").repartition(4) \
+        .filter("v > 0").toArrow()
+    cats2 = {s["cat"] for s in spark.tracer.since(mark2)}
+    assert "partition" in cats2, cats2
+    # chrome export: metadata + complete events, nested, with kernel
+    # attribution args on dispatching operator spans
+    doc = spark.tracer.to_chrome_trace()
+    evs = doc["traceEvents"]
+    complete = [e for e in evs if e.get("ph") == "X"]
+    assert complete and all("ts" in e and "dur" in e for e in complete)
+    assert any((e.get("args") or {}).get("launches", 0) > 0
+               for e in complete), "no span carries kernel attribution"
+
+
+def test_tracer_ring_keeps_latest_spans_and_marks_survive_eviction():
+    """Long-lived sessions must never go permanently dark: the buffer is
+    a ring of the latest maxSpans, and mark()/since() sequence numbers
+    stay correct across eviction."""
+    from spark_tpu.obs.tracing import Tracer
+
+    t = Tracer(enabled=True, max_spans=5)
+    for i in range(8):
+        with t.span(f"s{i}"):
+            pass
+    assert [s[0] for s in t.spans()] == [f"s{i}" for i in range(3, 8)]
+    assert t.dropped == 3
+    m = t.mark()
+    with t.span("tail"):
+        pass
+    assert [d["name"] for d in t.since(m)] == ["tail"]
+
+
+def test_chrome_trace_tracks_keyed_by_ident_and_name():
+    """Python reuses thread idents for ephemeral lane threads — tracks
+    must not merge two differently-named threads onto one label."""
+    from spark_tpu.obs.tracing import to_chrome_trace
+
+    spans = [("a", "c", 0.0, 1.0, 99, "lane-0", None),
+             ("b", "c", 2.0, 1.0, 99, "lane-1", None)]  # reused ident
+    doc = to_chrome_trace(spans)
+    meta = [e for e in doc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "thread_name"]
+    assert {m["args"]["name"] for m in meta} == {"lane-0", "lane-1"}
+    tids = {e["tid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert len(tids) == 2
+
+
+def test_tracing_disable_stops_span_collection(data):
+    spark = data
+    spark.conf.set("spark.tpu.trace.enabled", "false")
+    try:
+        mark = spark.tracer.mark()
+        spark.sql("select v from obs_t where v > 0").toArrow()
+        assert spark.tracer.since(mark) == []
+    finally:
+        spark.conf.unset("spark.tpu.trace.enabled")
+
+
+# ---------------------------------------------------------------------------
+# event-log round-trip: metrics + spans → HistoryReader.summary
+# ---------------------------------------------------------------------------
+
+def test_event_log_roundtrip_surfaces_kernel_and_operator_totals(
+        data, tmp_path):
+    from spark_tpu.exec.listener import EventLoggingListener, HistoryReader
+
+    spark = data
+    log_dir = str(tmp_path / "events")
+    el = EventLoggingListener(log_dir, app_id="obsapp")
+    spark.listener_bus.register(el)
+    try:
+        spark.sql(Q_AGG).toArrow()
+        spark.sql(Q_AGG).toArrow()
+        spark.listener_bus.wait_empty()
+    finally:
+        spark.listener_bus.unregister(el)
+    h = HistoryReader(log_dir)
+    app = h.applications()[0]
+    s = h.summary(app)
+    assert s["queries"] >= 2
+    # kernel.* counters replayed from the log
+    assert s["kernel"].get("kernel.launches", 0) > 0, s["kernel"]
+    assert "kernel_cache.launches" in s["kernel"]
+    # per-operator totals aggregated over plan graphs
+    assert any("HashAggregate" in op for op in s["operators"]), \
+        s["operators"]
+    agg = next(v for op, v in s["operators"].items()
+               if "HashAggregate" in op)
+    assert agg["rows"] > 0 and agg["launches"] > 0
+    # spans rode the event log and replay into the summary
+    assert s["span_count"] > 0 and s["span_total_ms"] > 0
+    events = h.load(app)
+    done = [e for e in events if e["event"] == "querySucceeded"]
+    assert all("spans" in e for e in done)
+    span_names = {sp["name"] for e in done for sp in e["spans"]}
+    # the full lifecycle rides the event: parse (recorded in session.sql
+    # before the QueryExecution exists) through execution and collect
+    assert {"parse", "execution", "collect"} <= span_names, span_names
+
+
+def test_parse_span_emitted_once_per_parse(data, tmp_path):
+    """Re-collecting a DataFrame must not re-report a parse that never
+    ran: the parse span rides the FIRST collect's event only."""
+    from spark_tpu.exec.listener import EventLoggingListener, HistoryReader
+
+    spark = data
+    log_dir = str(tmp_path / "events")
+    el = EventLoggingListener(log_dir, app_id="reparse")
+    spark.listener_bus.register(el)
+    try:
+        df = spark.sql("select count(*) c from obs_t")
+        df.toArrow()
+        df.toArrow()
+        spark.listener_bus.wait_empty()
+    finally:
+        spark.listener_bus.unregister(el)
+    h = HistoryReader(log_dir)
+    done = [e for e in h.load(h.applications()[0])
+            if e["event"] == "querySucceeded"]
+    assert len(done) == 2
+    counts = [sum(1 for sp in e["spans"] if sp["name"] == "parse")
+              for e in done]
+    assert counts == [1, 0], counts
+
+
+def test_parse_span_consumed_even_when_tracing_off_at_collect(
+        data, tmp_path):
+    """Parse spans attach at sql() time; an untraced first collect must
+    still consume them so a later re-traced collect cannot mis-report a
+    stale parse."""
+    from spark_tpu.exec.listener import EventLoggingListener, HistoryReader
+
+    spark = data
+    df = spark.sql("select count(*) c from obs_t")   # tracing on: attach
+    spark.conf.set("spark.tpu.trace.enabled", "false")
+    try:
+        df.toArrow()                                 # untraced collect
+    finally:
+        spark.conf.unset("spark.tpu.trace.enabled")
+    log_dir = str(tmp_path / "events")
+    el = EventLoggingListener(log_dir, app_id="stale")
+    spark.listener_bus.register(el)
+    try:
+        df.toArrow()                                 # re-traced collect
+        spark.listener_bus.wait_empty()
+    finally:
+        spark.listener_bus.unregister(el)
+    h = HistoryReader(log_dir)
+    done = [e for e in h.load(h.applications()[0])
+            if e["event"] == "querySucceeded"]
+    assert not any(sp["name"] == "parse"
+                   for e in done for sp in e["spans"])
+
+
+def test_live_ui_summary_matches_history_shape(data):
+    from spark_tpu.exec.ui import LiveStatusStore
+
+    spark = data
+    store = LiveStatusStore("obs-live")
+    spark.listener_bus.register(store)
+    try:
+        spark.sql(Q_AGG).toArrow()
+        spark.listener_bus.wait_empty()
+    finally:
+        spark.listener_bus.unregister(store)
+    s = store.summary("obs-live")
+    assert s["queries"] >= 1 and "kernel" in s and "operators" in s
+    assert "running" in s
+
+
+# ---------------------------------------------------------------------------
+# EXPLAIN ANALYZE
+# ---------------------------------------------------------------------------
+
+def test_explain_analyze_renders_measured_vs_predicted(data, capsys):
+    spark = data
+    spark.conf.set("spark.tpu.fusion.enabled", "true")
+    spark.conf.set("spark.tpu.fusion.minRows", "0")
+    try:
+        spark.sql(Q_AGG).explain("analyze")
+        out = capsys.readouterr().out
+        assert "EXPLAIN ANALYZE" in out
+        assert "predicted vs measured" in out
+        assert "rows=" in out and "launches=" in out
+        assert "fused:" in out          # member re-attribution rendered
+    finally:
+        spark.conf.unset("spark.tpu.fusion.enabled")
+        spark.conf.unset("spark.tpu.fusion.minRows")
+
+
+@pytest.mark.parametrize("enabled", ["true", "false"])
+def test_explain_analyze_tpcds_mini_zero_unexplained_drift(spark, enabled):
+    """Acceptance: q3/q7 show per-operator rows/wall-ms/attributed
+    launches (including inside fused stages) with zero unexplained
+    drift, fusion on and off."""
+    from tests.test_plan_analysis import Q3, Q7
+    from tpcds_mini import register_tpcds
+
+    register_tpcds(spark)
+    spark.conf.set("spark.tpu.fusion.enabled", enabled)
+    spark.conf.set("spark.tpu.fusion.minRows", "0")
+    try:
+        for sql in (Q3, Q7):
+            report = spark.sql(sql).query_execution.analyzed_report()
+            assert not report.has_unexplained_drift, report.render()
+            assert report.prediction_exact
+            assert report.predicted == report.measured
+            # every executed operator carries rows + wall-ms
+            executed = [nd for nd in report.nodes if nd["ms"] is not None]
+            assert executed
+            assert all(nd["rows"] is not None for nd in executed)
+            # kernel attribution reached inside the plan
+            assert any(nd["launches"] for nd in report.nodes)
+            if enabled == "true":
+                fused = [nd for nd in report.nodes if nd["fused"]]
+                assert fused, "no fused operators on TPC-DS mini plan"
+                assert all(nd["launches"] for nd in fused)
+            d = report.to_dict()
+            assert d["prediction_exact"] and d["measured"] == d["predicted"]
+    finally:
+        spark.conf.unset("spark.tpu.fusion.enabled")
+        spark.conf.unset("spark.tpu.fusion.minRows")
+
+
+def test_explain_analyze_forces_metrics_when_disabled(data):
+    """EXPLAIN ANALYZE drives its own runs — it must annotate operators
+    even in sessions that disable operatorMetrics (bench-style), and
+    restore the setting afterwards."""
+    spark = data
+    spark.conf.set("spark.tpu.ui.operatorMetrics", "false")
+    spark.conf.set("spark.tpu.metrics.kernelAttribution", "false")
+    try:
+        report = spark.sql(Q_AGG).query_execution.analyzed_report()
+        assert any(nd["ms"] is not None for nd in report.nodes)
+        assert any(nd["launches"] for nd in report.nodes)
+        assert spark.conf.get("spark.tpu.ui.operatorMetrics") is False
+        assert spark.conf.get("spark.tpu.metrics.kernelAttribution") is False
+    finally:
+        spark.conf.unset("spark.tpu.ui.operatorMetrics")
+        spark.conf.unset("spark.tpu.metrics.kernelAttribution")
+
+
+def test_explain_analyze_flags_min_rows_gate(spark, data):
+    """Default minRows (≫ 5k rows) routes a fused plan to the unfused
+    kernels at runtime — EXPLAIN ANALYZE must surface the gate decision
+    as a first-class finding, with zero unexplained drift."""
+    spark.conf.set("spark.tpu.fusion.enabled", "true")
+    try:
+        report = spark.sql(Q_AGG).query_execution.analyzed_report()
+        assert not report.has_unexplained_drift, report.render()
+        assert any(f["kind"] == "minRows-gate" for f in report.findings), \
+            report.findings
+    finally:
+        spark.conf.unset("spark.tpu.fusion.enabled")

@@ -212,6 +212,45 @@ def test_report_dict_shape(fusion_conf, data):
     assert d["predicted_total"] == sum(d["predicted_launches"].values())
 
 
+def test_sample_offset_arg_no_recompile_storm(spark):
+    """SampleExec keys its kernel by (capacity, seed, fraction) and feeds
+    the per-(partition,batch) position base as a kernel ARGUMENT: 12
+    batches across 4 partitions compile at most one kernel per capacity
+    bucket (the historical per-batch cache key compiled 12), launches
+    stay 1/batch, the analyzer predicts them exactly, and the recompile
+    hazard is gone from the report."""
+
+    def q():
+        return spark.range(0, 40000, 1, 4).sample(0.5, seed=31)
+
+    report = q().query_execution.analysis_report()
+    assert report.exact, report.inexact_reasons
+    assert report.predicted_launches == {"sample": 12}, \
+        report.predicted_launches
+    assert not any("SampleExec" in h for h in report.recompile_hazards), \
+        report.recompile_hazards
+    assert any("kernel argument" in n for s in report.stages
+               for n in s["notes"])
+
+    before = KC.counters()
+    before_kinds = dict(KC.launches_by_kind)
+    q().toArrow()  # cold: compiles happen here
+    mid = KC.counters()
+    # 10000 rows/partition at 4096-capacity tiles → per partition
+    # [4096, 4096, 2048] caps: two distinct buckets → ≤ 2 compiles
+    assert mid["kernel_cache.misses"] - before["kernel_cache.misses"] <= 2
+    assert KC.launches_by_kind["sample"] \
+        - before_kinds.get("sample", 0) == 12
+
+    warm = dict(KC.launches_by_kind)
+    q().toArrow()  # warm: predicted == measured, zero further compiles
+    after = KC.counters()
+    measured = {k: v - warm.get(k, 0) for k, v in
+                KC.launches_by_kind.items() if v != warm.get(k, 0)}
+    assert measured == report.predicted_launches
+    assert after["kernel_cache.misses"] == mid["kernel_cache.misses"]
+
+
 def test_inexact_degrades_honestly(fusion_conf, data):
     """A hash-exchange query (multi-partition repartition) has runtime-
     dependent layout: the analyzer must NOT claim exactness, and must say
