@@ -61,6 +61,16 @@ if TRACE:
 TRACE_PATH = os.environ.get("SPARK_TPU_TRACE_PATH", "bench_trace.json")
 _TRACE_TRACERS: list = []  # host-only span buffers (never pin sessions)
 
+# --cluster: run every config's session over a local process cluster
+# (ClusterDAGScheduler ships map stages to worker processes) so the
+# trace gate exercises worker-side metric/span shipping end to end —
+# worker spans land in the exported trace as their own tracks and
+# dev/validate_trace.py --cluster requires at least one.
+CLUSTER = "--cluster" in sys.argv
+if CLUSTER:
+    sys.argv = [a for a in sys.argv if a != "--cluster"]
+_CLUSTER_SESSIONS: list = []  # stopped at exit (kills worker processes)
+
 
 def _maybe_analyze(df, name: str):
     """`df` may be a DataFrame or a zero-arg callable producing one (so
@@ -139,6 +149,12 @@ def _session(extra=None):
         # (collection is launch-free, so dispatch counts stay honest)
         conf["spark.tpu.ui.operatorMetrics"] = "true"
         conf["spark.tpu.trace.enabled"] = "true"
+    if CLUSTER:
+        # local process cluster; >1 shuffle partition so plans keep real
+        # exchanges (= remote map stages shipped to workers)
+        conf["spark.tpu.cluster.enabled"] = "true"
+        conf["spark.tpu.cluster.workers"] = "2"
+        conf["spark.sql.shuffle.partitions"] = 2
     conf.update(extra or {})
     if SMOKE:
         conf["spark.tpu.batch.capacity"] = min(
@@ -148,18 +164,25 @@ def _session(extra=None):
         # keep only the tracer (host span buffer): retaining the session
         # would pin every config's device-resident scan caches at once
         _TRACE_TRACERS.append(session.tracer)
+    if CLUSTER:
+        # cluster sessions ARE retained, then stopped at exit — worker
+        # processes must not outlive the bench run
+        _CLUSTER_SESSIONS.append(session)
     return session
 
 
 def _df_from_table(session, table, name):
-    """Device-cached single-partition DataFrame over an arrow table."""
+    """Device-cached single-partition DataFrame over an arrow table.
+    --cluster splits the scan so aggregations keep a real exchange in
+    the plan (a single-partition partial agg completes locally and never
+    ships a map stage to the workers)."""
     from spark_tpu.api.dataframe import DataFrame
     from spark_tpu.expr.expressions import AttributeReference
     from spark_tpu.io.sources import InMemorySource
     from spark_tpu.plan.logical import LogicalRelation
     from spark_tpu.types import from_arrow_type
 
-    source = InMemorySource(table, num_partitions=1)
+    source = InMemorySource(table, num_partitions=2 if CLUSTER else 1)
     source.cache_device_batches = True
     attrs = [AttributeReference(f.name, from_arrow_type(f.type), True)
              for f in table.schema]
@@ -449,9 +472,14 @@ def _fallback_to_cpu_child() -> int:
     env["SPARK_TPU_BENCH_SCALE"] = str(min(SCALE, 0.01))
     env["SPARK_TPU_BENCH_TIMEOUT"] = str(min(_CONFIG_TIMEOUT_S, 300))
     env["SPARK_TPU_BENCH_BUDGET"] = str(min(_SUITE_BUDGET_S, 1500))
+    # mode flags were stripped from sys.argv at import — re-append them
+    # so the child keeps the requested trace/analyze/cluster behavior
+    flags = [f for f, on in (("--analyze", ANALYZE), ("--trace", TRACE),
+                             ("--cluster", CLUSTER)) if on]
     try:  # stdout inherited: child lines flush straight to the driver
         r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+            [sys.executable, os.path.abspath(__file__)]
+            + sys.argv[1:] + flags,
             env=env, timeout=min(_SUITE_BUDGET_S, 1800))
         return r.returncode
     except subprocess.TimeoutExpired:
@@ -522,6 +550,11 @@ def main() -> int:
             _emit({"metric": "trace FAILED", "value": 0, "unit": "error",
                    "vs_baseline": 0.0,
                    "error": f"{type(e).__name__}: {e}"[:200]})
+    for s in _CLUSTER_SESSIONS:
+        try:
+            s.stop()
+        except Exception:
+            pass
     # floor at 0.001 so a catastrophically slow config drags the geomean
     # instead of vanishing from it (round() can produce exact 0.0)
     ok = [max(r["vs_baseline"], 0.001) for r in records]

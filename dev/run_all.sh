@@ -24,6 +24,12 @@ SPARK_TPU_TRACE_PATH=/tmp/sparktpu_smoke_trace.json \
     python bench.py --smoke --trace
 JAX_PLATFORMS=cpu python dev/validate_trace.py /tmp/sparktpu_smoke_trace.json
 
+echo "== cluster trace gate (worker-side metric/span shipping + flows) =="
+SPARK_TPU_TRACE_PATH=/tmp/sparktpu_cluster_trace.json \
+    python bench.py --smoke --trace --cluster groupby
+JAX_PLATFORMS=cpu python dev/validate_trace.py --cluster \
+    /tmp/sparktpu_cluster_trace.json
+
 echo "== micro-benchmarks =="
 python benchmarks/run_benchmarks.py --rows "${BENCH_ROWS:-2000000}"
 

@@ -1,21 +1,31 @@
 #!/usr/bin/env python
 """CI gate for the observability layer (dev/run_all.sh).
 
-Two checks, both hard failures:
+Three checks, all hard failures:
 
 1. Trace validation — the Chrome-trace JSON emitted by `bench.py --smoke
    --trace` must be well-formed (a non-empty `traceEvents` list of
    complete/metadata events with sane fields), spans must nest properly
-   per thread track (stack discipline: no partial overlap), and at least
+   per thread track (stack discipline: no partial overlap), at least
    one span must carry non-empty kernel attribution (`args.launches`) —
-   proving the KernelCache→operator attribution path is live end to end.
+   proving the KernelCache→operator attribution path is live end to end
+   — and every Perfetto flow arrow must have referential integrity:
+   each flow id resolves to exactly one "s" and one "f" event, each
+   anchored inside a complete span on its thread track. With --cluster
+   (the `bench.py --smoke --trace --cluster` leg), the trace must also
+   contain at least one worker-track span (`worker:<id>/...` thread
+   name), proving worker-side span shipping crossed the process
+   boundary.
 
 2. Drift gate — EXPLAIN ANALYZE on a representative fused aggregation
    runs predicted-vs-measured reconciliation; any finding of severity
    `error` (unexplained drift between analysis/plan_lint.py's launch
-   model and the execution layer) fails the build.
+   model and the execution layer) fails the build. With --cluster the
+   gate query runs under ClusterDAGScheduler and additionally requires
+   non-empty per-operator metrics whose attributed-launch total equals
+   the measured (driver + worker) launch total.
 
-Usage: python dev/validate_trace.py <trace.json>
+Usage: python dev/validate_trace.py [--cluster] <trace.json>
 """
 
 import json
@@ -33,7 +43,37 @@ def fail(msg: str) -> "NoReturn":  # noqa: F821
     sys.exit(1)
 
 
-def validate_trace(path: str) -> None:
+def _check_flows(events: list, complete: list) -> int:
+    """Flow-event referential integrity: every flow id has exactly one
+    "s" and one "f" endpoint, and each endpoint lands inside a complete
+    span on its (pid, tid) track (Perfetto binds arrows to the enclosing
+    slice — a dangling endpoint renders as an arrow from/to nowhere)."""
+    fuzz = 1.0
+    flows = [e for e in events if e.get("ph") in ("s", "t", "f")]
+    by_id: dict = {}
+    for e in flows:
+        if "id" not in e:
+            fail(f"flow event missing id: {e}")
+        by_id.setdefault(e["id"], []).append(e)
+    spans_by_track: dict = {}
+    for e in complete:
+        spans_by_track.setdefault((e["pid"], e["tid"]), []).append(e)
+    for fid, evs in by_id.items():
+        phs = sorted(e["ph"] for e in evs)
+        if phs != ["f", "s"]:
+            fail(f"flow id {fid} endpoints are {phs}, want one 's' + "
+                 "one 'f' (broken arrow)")
+        for e in evs:
+            track = spans_by_track.get((e["pid"], e["tid"]), [])
+            if not any(sp["ts"] - fuzz <= e["ts"] <= sp["ts"] + sp["dur"]
+                       + fuzz for sp in track):
+                fail(f"flow endpoint {e} does not land inside any span "
+                     f"on track {(e['pid'], e['tid'])} — the flow id "
+                     "does not resolve to an endpoint span")
+    return len(by_id)
+
+
+def validate_trace(path: str, cluster: bool = False) -> None:
     if not os.path.isfile(path):
         fail(f"trace file {path} does not exist")
     with open(path) as f:
@@ -80,52 +120,108 @@ def validate_trace(path: str) -> None:
     if not attributed:
         fail("no span carries kernel attribution (args.launches > 0) — "
              "the KernelCache→operator attribution scope is dead")
+
+    n_flows = _check_flows(events, complete)
+    if not n_flows:
+        fail("no flow events — the query→stage→lane/worker flow "
+             "linkage is dead (spans carry no resolvable flow ids)")
+
+    worker_tracks = {m["args"]["name"] for m in events
+                     if m.get("ph") == "M"
+                     and m.get("name") == "thread_name"
+                     and str(m.get("args", {}).get("name", ""))
+                     .startswith("worker:")}
+    if cluster and not worker_tracks:
+        fail("--cluster: no worker-track span (thread name 'worker:…') — "
+             "worker-side span shipping never crossed the process "
+             "boundary")
     cats = {e.get("cat") for e in complete}
     print(f"validate_trace: trace OK — {len(complete)} spans, "
-          f"{len(by_tid)} thread tracks, {len(attributed)} with kernel "
-          f"attribution, categories={sorted(c for c in cats if c)}")
+          f"{len(by_tid)} thread tracks ({len(worker_tracks)} worker), "
+          f"{len(attributed)} with kernel attribution, {n_flows} flow "
+          f"arrows, categories={sorted(c for c in cats if c)}")
 
 
-def drift_gate() -> None:
+def drift_gate(cluster: bool = False) -> None:
     """EXPLAIN ANALYZE a fused aggregation; severity-error drift findings
-    (launch-model divergence) fail the gate."""
+    (launch-model divergence) fail the gate. With --cluster the query
+    runs under ClusterDAGScheduler: worker-shipped attribution must be
+    non-empty and reconcile with the driver+worker measured total."""
     import numpy as np
     import pyarrow as pa
 
     from spark_tpu import TpuSession
 
-    session = TpuSession("trace-gate", {
+    conf = {
         "spark.tpu.batch.capacity": 1 << 12,
         "spark.sql.shuffle.partitions": 2,
         "spark.tpu.fusion.minRows": "0",
-    })
-    rng = np.random.default_rng(11)
-    n = 4000
-    session.createDataFrame(pa.table({
-        "k": rng.integers(0, 9, n),
-        "v": rng.integers(-20, 80, n),
-    })).createOrReplaceTempView("gate_t")
-    df = session.sql(
-        "select k, sum(v) s, count(*) c from gate_t where v > 0 group by k")
-    report = df.query_execution.analyzed_report()
-    errors = [f for f in report.findings if f["severity"] == "error"]
-    if errors:
-        print(report.render())
-        fail("EXPLAIN ANALYZE reported unexplained drift: "
-             + "; ".join(f["msg"] for f in errors))
-    print("validate_trace: drift gate OK — predicted "
-          f"{sum(report.predicted.values())} == measured "
-          f"{sum(report.measured.values())} launches, "
-          f"{len(report.findings)} non-error findings")
+    }
+    if cluster:
+        conf["spark.tpu.cluster.enabled"] = "true"
+        conf["spark.tpu.cluster.workers"] = "2"
+    session = TpuSession("trace-gate", conf)
+    try:
+        rng = np.random.default_rng(11)
+        n = 4000
+        session.createDataFrame(pa.table({
+            "k": rng.integers(0, 9, n),
+            "v": rng.integers(-20, 80, n),
+        })).createOrReplaceTempView("gate_t")
+        if cluster:
+            # the explicit repartition keeps shuffle map stages in the
+            # plan (a single-partition partial agg never ships) — the
+            # gate must exercise worker-side attribution, not just the
+            # driver path
+            import spark_tpu.api.functions as F
+
+            df = (session.sql("select k, v from gate_t where v > 0")
+                  .repartition(2).groupBy("k")
+                  .agg(F.sum("v").alias("s"), F.count("k").alias("c")))
+        else:
+            df = session.sql(
+                "select k, sum(v) s, count(*) c from gate_t where v > 0 "
+                "group by k")
+        report = df.query_execution.analyzed_report()
+        errors = [f for f in report.findings if f["severity"] == "error"]
+        if errors:
+            print(report.render())
+            fail("EXPLAIN ANALYZE reported unexplained drift: "
+                 + "; ".join(f["msg"] for f in errors))
+        if cluster:
+            remote = session._metrics.snapshot()["counters"].get(
+                "scheduler.stages_remote", 0)
+            if remote < 1:
+                fail("--cluster: gate query never shipped a map stage "
+                     "to a worker process")
+            attributed = sum(v for nd in report.nodes
+                             for v in (nd.get("launches") or {}).values())
+            measured = sum(report.measured.values())
+            if not attributed:
+                fail("--cluster: EXPLAIN ANALYZE per-operator metrics "
+                     "empty — worker-side attribution never shipped")
+            if attributed != measured:
+                fail(f"--cluster: attributed launches ({attributed}) != "
+                     f"measured driver+worker total ({measured}) — a "
+                     "dispatch escaped cross-process attribution")
+        print("validate_trace: drift gate OK — predicted "
+              f"{sum(report.predicted.values())} == measured "
+              f"{sum(report.measured.values())} launches, "
+              f"{len(report.findings)} non-error findings"
+              + (" [cluster]" if cluster else ""))
+    finally:
+        session.stop()
 
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    cluster = "--cluster" in argv
+    argv = [a for a in argv if a != "--cluster"]
     if len(argv) != 1:
         print(__doc__)
         return 2
-    validate_trace(argv[0])
-    drift_gate()
+    validate_trace(argv[0], cluster=cluster)
+    drift_gate(cluster=cluster)
     print("validate_trace: PASS")
     return 0
 

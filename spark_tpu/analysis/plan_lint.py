@@ -998,9 +998,13 @@ class _Analyzer:
         if isinstance(p, UnknownPartitioning):
             if child.counted:
                 kinds["shuffle_rr"] = child.total_batches
-            self._hazard("round-robin shuffle cache key embeds the running "
-                         "row offset — every batch position compiles its "
-                         "own kernel (recompile storm on many batches)")
+            # the running row offset rides as a kernel argument, so the
+            # cache key is (capacity, num_out)-shaped — no recompile
+            # hazard (the historical storm keyed by start % num_out;
+            # fixed alongside this model)
+            notes.append("round-robin start offset rides as a kernel "
+                         "argument: one compile per capacity bucket, "
+                         "1 launch/batch")
             out = [[_Batch(None, None, False)]
                    for _ in range(p.num_partitions)]
             self._stage(node, kinds, child.total_batches if child.counted

@@ -180,16 +180,28 @@ class TpuSession:
 
         if is_script(query):
             return execute_script(self, query)
-        mark = self.tracer.mark()
-        with self.tracer.span("parse", cat="phase"):  # no-op when disabled
-            plan = parse_sql(query)
+        import uuid as _uuid
+
+        from ..obs.tracing import pop_query, push_query
+
+        # parse predates the collect's query id — tag its spans with a
+        # private scope so concurrent sql() calls on a shared session
+        # can't capture each other's parse work (the old mark()/since()
+        # buffer slice could)
+        pqid = f"parse-{_uuid.uuid4().hex[:8]}"
+        qtoken = push_query(pqid)
+        try:
+            with self.tracer.span("parse", cat="phase"):  # no-op when off
+                plan = parse_sql(query)
+        finally:
+            pop_query(qtoken)
         if isinstance(plan, Command):
             return run_command(self, plan)
         if isinstance(plan, WithCTE):
             plan = self._materialize_ctes(plan)
         # the parse span predates the QueryExecution — ride it on the
         # parsed plan so to_arrow's event includes the full lifecycle
-        parse_spans = self.tracer.since(mark)
+        parse_spans = self.tracer.spans_for(pqid)
         if parse_spans:
             try:
                 plan._parse_spans = parse_spans

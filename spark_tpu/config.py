@@ -273,6 +273,15 @@ KERNEL_ATTRIBUTION = _register(ConfigEntry(
     "par_map lanes). Requires spark.tpu.ui.operatorMetrics; one "
     "contextvar read per kernel launch when on.", _bool))
 
+CLUSTER_OBS_SHIPPING = _register(ConfigEntry(
+    "spark.tpu.cluster.obsShipping", True,
+    "Ship worker-side observability (per-operator metric records, spans, "
+    "kernel-launch deltas) back with each cluster stage-task result and "
+    "merge it into the driver's QueryMetrics/Tracer (the executor "
+    "heartbeat metrics channel, reduced to per-task return). Off = "
+    "cluster queries report driver-side observability only (saves the "
+    "payload bytes on very wide fan-outs).", _bool))
+
 
 class SQLConf:
     """Session-local config with string overrides over typed defaults.

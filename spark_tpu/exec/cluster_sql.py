@@ -106,6 +106,16 @@ class FetchExec(PhysicalPlan):
              else self.num_partitions)
         return UnknownPartitioning(max(n, 1))
 
+    def _flow_parents(self) -> list:
+        """Deterministic flow ids of the map-task spans that produced
+        this shuffle (the same ids `_run_stage_store` stamps on its task
+        root span) — the exporter draws map task → reduce fetch arrows
+        from them, across processes. Capped so args stay small on very
+        wide shuffles."""
+        num_maps = len(self.maps)
+        return [map_block_id(self.shuffle_id, mid, num_maps)
+                for mid, _ in sorted(self.maps)[:16]]
+
     def _fetch_rid(self, rid: int, clients: dict, schema, ctx) -> list:
         """One reduce partition: merged chunk first, per-map fallback."""
         import pickle
@@ -147,15 +157,25 @@ class FetchExec(PhysicalPlan):
         return part
 
     def execute(self, ctx):
+        from contextlib import nullcontext
+
         from ..physical.operators import attrs_schema
 
         schema = attrs_schema(self.attrs)
         rids = (self.part_indices if self.part_indices is not None
                 else range(self.num_partitions))
         clients: dict = {}
+        tracer = getattr(ctx, "tracer", None)
+        # exchange-edge flow: this fetch's span parents to the map-task
+        # spans that stored the blocks (possibly in another process —
+        # the ids are derived from the shuffle id on both sides)
+        sp = tracer.span(f"fetch[{self.shuffle_id}]", cat="exchange",
+                         args={"flow_parent": self._flow_parents()}) \
+            if tracer is not None else nullcontext()
         try:
-            return [self._fetch_rid(rid, clients, schema, ctx)
-                    for rid in rids]
+            with sp:
+                return [self._fetch_rid(rid, clients, schema, ctx)
+                        for rid in rids]
         finally:
             for c in clients.values():
                 c.close()
@@ -168,11 +188,19 @@ class FetchExec(PhysicalPlan):
 
 
 def _run_stage_store(plan_bytes: bytes, conf_overrides: dict,
-                     shuffle_id: str, map_id: int = 0, num_maps: int = 1):
+                     shuffle_id: str, map_id: int = 0, num_maps: int = 1,
+                     query_id: str | None = None,
+                     flow_parent: str | None = None):
     """Map-task body: execute the (possibly leaf-sliced) subtree, store
     each output partition as a block in THIS worker's store (and push it
     to the merge service in push mode), return per-partition
-    (rows, bytes) — the MapStatus payload. Runs in a worker process."""
+    (rows, bytes) — the MapStatus payload — plus the task's shipped
+    observability (per-operator records, spans, kernel deltas; the
+    executor-heartbeat metrics channel reduced to per-task return).
+    Runs in a worker process: the obs recorder is process-local, spans
+    record under the driver's query scope, and the task root span
+    carries a deterministic flow id (`map_block_id`) so reduce-side
+    fetches can draw cross-process arrows to it."""
     import pickle
 
     import jax
@@ -184,21 +212,48 @@ def _run_stage_store(plan_bytes: bytes, conf_overrides: dict,
     jax.config.update("jax_enable_x64", True)
 
     from ..config import SQLConf
+    from ..obs.tracing import pop_query, push_query
     from . import worker_main as WM
     from .context import ExecContext
 
     plan = cloudpickle.loads(plan_bytes)
-    ctx = ExecContext(conf=SQLConf(dict(conf_overrides)))
-    parts = plan.execute(ctx)
-    rows, sizes = [], []
-    for rid, part in enumerate(parts):
-        ipc = _partitions_to_ipc([part])[0]
-        raw = pickle.dumps(ipc)
-        WM.store_map_block(shuffle_id, map_id, num_maps, rid, raw)
-        rows.append(sum(b.num_rows() for b in part))
-        sizes.append(len(raw))
+    conf = SQLConf(dict(conf_overrides))
+    obs = WM.begin_stage_obs(conf)
+    ctx = ExecContext(conf=conf)
+    if obs is not None:
+        if obs["rec"] is not None:
+            ctx.plan_metrics = obs["rec"]
+            ctx.kernel_attribution = obs["attribution"]
+        ctx.tracer = obs["tracer"]
+    qtoken = push_query(query_id) if query_id is not None else None
+    try:
+        task_span = ctx.tracer.span(
+            f"task[{map_block_id(shuffle_id, map_id, num_maps)}]",
+            cat="worker",
+            args={"flow_id": map_block_id(shuffle_id, map_id, num_maps),
+                  **({"flow_parent": flow_parent}
+                     if flow_parent is not None else {})},
+            flow=True) if ctx.tracer is not None else None
+        if task_span is not None:
+            task_span.__enter__()
+        try:
+            parts = plan.execute(ctx)
+            rows, sizes = [], []
+            for rid, part in enumerate(parts):
+                ipc = _partitions_to_ipc([part])[0]
+                raw = pickle.dumps(ipc)
+                WM.store_map_block(shuffle_id, map_id, num_maps, rid, raw)
+                rows.append(sum(b.num_rows() for b in part))
+                sizes.append(len(raw))
+        finally:
+            if task_span is not None:
+                task_span.__exit__(None, None, None)
+    finally:
+        if qtoken is not None:
+            pop_query(qtoken)
     counters = ctx.metrics.snapshot()["counters"]
-    return ("mapstatus", WM.BLOCK_ADDR, rows, sizes, counters)
+    return ("mapstatus", WM.BLOCK_ADDR, rows, sizes, counters,
+            WM.finish_stage_obs(obs))
 
 
 class ClusterDAGScheduler(DAGScheduler):
@@ -216,12 +271,19 @@ class ClusterDAGScheduler(DAGScheduler):
         self.conf_overrides = dict(conf_overrides)
         self.map_outputs = MapOutputTracker()
         self._run_id = uuid.uuid4().hex[:12]
+        import threading
+
+        self._obs_lock = threading.Lock()  # worker obs merges race
         from ..config import SPECULATION
 
         if ctx.conf.get(SPECULATION):
             cluster.speculation = True
 
-    def run(self, plan):
+    def _run(self, plan):
+        # DAGScheduler.run wraps this with the driver-process KernelCache
+        # delta accounting; worker-process deltas merge in via each
+        # task's shipped obs payload (_merge_task_obs), so kernel.*
+        # query metrics are driver+worker totals in cluster mode
         import threading
         from collections import defaultdict
 
@@ -258,21 +320,38 @@ class ClusterDAGScheduler(DAGScheduler):
             if stage.stage_id in done:
                 return
             if len(stage.parents) > 1:
+                from ..obs.metrics import scoped_submit
+
+                # copied contextvars Context per submit: the pool threads
+                # start with an EMPTY context, which would silently drop
+                # the query-scope tag and re-bucket kernel attribution
+                # (matching scheduler.par_map's lane discipline)
                 with ThreadPoolExecutor(len(stage.parents)) as pool:
-                    list(pool.map(materialize, stage.parents))
+                    futures = [scoped_submit(pool, materialize, p)
+                               for p in stage.parents]
+                    for f in futures:
+                        f.result()
             else:
                 for p in stage.parents:
                     materialize(p)
+            tracer = getattr(self.ctx, "tracer", None)
             last_err = None
             for attempt in range(self.max_attempts):
                 stage.attempts = attempt + 1
                 try:
                     self._post("stageSubmitted", stage)
-                    if stage is result_stage:
-                        root = _substitute_parents(stage.root, self)
-                        stage.result = root.execute(self.ctx)
-                    else:
-                        stage.result = self._run_remote(stage)
+                    from contextlib import nullcontext
+
+                    sp = tracer.span(f"stage-{stage.stage_id}", cat="stage",
+                                     args={"attempt": attempt + 1},
+                                     flow=True) \
+                        if tracer is not None else nullcontext()
+                    with sp:
+                        if stage is result_stage:
+                            root = _substitute_parents(stage.root, self)
+                            stage.result = root.execute(self.ctx)
+                        else:
+                            stage.result = self._run_remote(stage)
                     self.ctx.metrics.add("scheduler.stages_completed")
                     self._post("stageCompleted", stage)
                     done.add(stage.stage_id)
@@ -335,41 +414,88 @@ class ClusterDAGScheduler(DAGScheduler):
         return max(1, min(cap, p, n_workers))
 
     def _run_remote(self, stage: Stage):
+        from ..obs.metrics import scoped_submit
+        from ..obs.tracing import current_flow, current_query
+
         shipped = _substitute_parents(stage.root, self)
         sid = self._shuffle_id(stage)
         num_maps = self._map_task_count(shipped)
+        # the driver's query scope + the enclosing stage span's flow id
+        # ride into the task so worker spans tag and link correctly
+        qid = current_query()
+        flow_parent = current_flow()
 
         def run_map(map_id: int):
             plan = (_slice_fetch_leaves(shipped, map_id, num_maps)
                     if num_maps > 1 else shipped)
             result, worker = self.cluster.run_task_traced(
                 _run_stage_store, cloudpickle.dumps(plan),
-                self.conf_overrides, sid, map_id, num_maps)
-            tag, addr, rows, sizes, counters = result
+                self.conf_overrides, sid, map_id, num_maps,
+                qid, flow_parent)
+            tag, addr, rows, sizes, counters, obs = result
             assert tag == "mapstatus", tag
             return (MapStatus(map_block_id(sid, map_id, num_maps), addr,
                               worker.executor_id, rows, sizes, map_id),
-                    counters)
+                    counters, obs, worker.executor_id)
 
         if num_maps == 1:
             outcomes = [run_map(0)]
         else:
             with ThreadPoolExecutor(num_maps) as pool:
-                outcomes = list(pool.map(run_map, range(num_maps)))
-        status = ShuffleStatus(sid, [ms for ms, _ in outcomes])
+                futures = [scoped_submit(pool, run_map, m)
+                           for m in range(num_maps)]
+                outcomes = [f.result() for f in futures]
+        status = ShuffleStatus(sid, [ms for ms, *_ in outcomes])
         self.map_outputs.register(status)
         if getattr(self.cluster, "push_shuffle", False) and \
                 self.cluster.shuffle_service_addr:
             status.merge = self._finalize_merge(sid, num_maps)
         # fold worker-side operator metrics into the driver's view (the
         # executor-heartbeat metrics channel, reduced to per-task return)
-        for _, counters in outcomes:
+        for _, counters, obs, eid in outcomes:
             for k, v in counters.items():
                 self.ctx.metrics.add(k, v)
+            self._merge_task_obs(obs, eid, qid)
         self.ctx.metrics.add("scheduler.stages_remote")
         self.ctx.metrics.add("scheduler.map_tasks", num_maps)
         self.ctx.metrics.add("shuffle.bytes_written", status.total_bytes)
         return status
+
+    def _merge_task_obs(self, obs: dict | None, executor_id: str,
+                        qid: str | None) -> None:
+        """Fold one map task's shipped observability into the driver's
+        query view: per-operator records by `_metric_id` (so EXPLAIN
+        ANALYZE / plan_graph / history server render identical shape
+        local vs cluster), spans into the session tracer under the
+        worker's own track, and the worker process's KernelCache deltas
+        into the query metrics + the per-query worker launch ledger
+        (`ctx.worker_kernel_kinds` — EXPLAIN ANALYZE reconciles measured
+        launches against driver+worker totals with it)."""
+        if obs is None:
+            return
+        if self.ctx.plan_metrics is not None and obs.get("op_records"):
+            from ..obs.metrics import merge_op_records
+
+            merge_op_records(self.ctx.plan_metrics, obs["op_records"])
+        tracer = getattr(self.ctx, "tracer", None)
+        if tracer is not None and obs.get("spans"):
+            tracer.ingest(obs["spans"], anchor=obs.get("anchor"),
+                          track=f"worker:{executor_id}", query_id=qid)
+        if obs.get("kernel_launches"):
+            self.ctx.metrics.add("kernel.launches", obs["kernel_launches"])
+        if obs.get("kernel_compile_ms"):
+            # round, not truncate — matching the driver-side wrapper in
+            # DAGScheduler.run so many small tasks don't bias totals low
+            self.ctx.metrics.add("kernel.compile_ms",
+                                 round(obs["kernel_compile_ms"]))
+        kinds = obs.get("kernel_kinds")
+        if kinds:
+            with self._obs_lock:
+                wk = self.ctx.worker_kernel_kinds
+                if wk is None:
+                    wk = self.ctx.worker_kernel_kinds = {}
+                for k, v in kinds.items():
+                    wk[k] = wk.get(k, 0) + v
 
     def _finalize_merge(self, sid: str, num_maps: int):
         """Close the shuffle to late pushes and register which map ids

@@ -66,6 +66,10 @@ class ExecContext:
     # attribute KernelCache launches to the executing operator
     # (spark.tpu.metrics.kernelAttribution, resolved once per query)
     kernel_attribution: bool = field(default=True, repr=False)
+    # cluster mode: per-kind kernel-launch deltas shipped back from
+    # worker processes this query (ClusterDAGScheduler._merge_task_obs);
+    # EXPLAIN ANALYZE reconciles measured launches as driver + this
+    worker_kernel_kinds: dict | None = field(default=None, repr=False)
 
     @property
     def memory(self):
@@ -105,7 +109,11 @@ class ExecContext:
 
             def traced(pair, _fn=fn, _op=op):
                 i, item = pair
-                with tracer.span(f"{_op}[p{i}]", cat="partition"):
+                # flow=True: the lane span parents to the enclosing flow
+                # span (stage/worker task) — the lane context is a copy
+                # of the dispatching thread's, so the parent id is visible
+                with tracer.span(f"{_op}[p{i}]", cat="partition",
+                                 flow=True):
                     return _fn(item)
 
             return par_map(traced, list(enumerate(items)),

@@ -172,8 +172,16 @@ class _Handler(BaseHTTPRequestHandler):
         spans = e.get("spans")
         if spans:
             # span timeline (SQL-tab execution timeline analog): phase /
-            # stage / operator / partition-lane spans with durations
-            parts.append("<h2>Span timeline</h2><table><tr>"
+            # stage / operator / partition-lane / worker spans with
+            # durations — cluster mode ships worker spans back with the
+            # stage results, so the cross-process timeline renders here
+            # exactly like the local one
+            wtracks = {sp.get("thread") for sp in spans
+                       if str(sp.get("thread") or "").startswith("worker:")}
+            parts.append("<h2>Span timeline</h2>")
+            if wtracks:
+                parts.append(f"<p>worker tracks: {len(wtracks)}</p>")
+            parts.append("<table><tr>"
                          "<th style='text-align:left'>Span</th>"
                          "<th>category</th><th>thread</th><th>ms</th>"
                          "</tr>")

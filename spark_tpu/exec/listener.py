@@ -169,6 +169,7 @@ def summarize_events(events: list) -> dict:
                   if k.startswith(("kernel.", "kernel_cache."))}
     operators: dict = {}
     span_ms = 0.0
+    worker_spans = 0
     for e in queries:
         for nd in e.get("plan_graph") or []:
             op = nd.get("op") or "?"
@@ -182,8 +183,13 @@ def summarize_events(events: list) -> dict:
             o["calls"] += 1
         for sp in e.get("spans") or []:
             span_ms += sp.get("dur_ms") or 0
+            # cluster mode: spans shipped from worker processes land on
+            # "worker:<executor>/<thread>" tracks (Tracer.ingest)
+            if str(sp.get("thread") or "").startswith("worker:"):
+                worker_spans += 1
     return {"queries": len(queries), "failed": len(failed),
             "total_duration_ms": total_ms, "kernel": kernel,
             "operators": operators,
             "span_count": sum(len(e.get("spans") or []) for e in queries),
+            "worker_span_count": worker_spans,
             "span_total_ms": round(span_ms, 2)}

@@ -66,7 +66,9 @@ class QueryExecution:
         tracer = self._tracer
         t0 = time.perf_counter()
         if tracer is not None:
-            with tracer.span(name, cat="phase"):
+            # the execution phase roots the query's flow graph: stage →
+            # partition-lane/worker spans draw arrows back to it
+            with tracer.span(name, cat="phase", flow=(name == "execution")):
                 out = fn()
         else:
             out = fn()
@@ -186,12 +188,18 @@ class QueryExecution:
     def to_arrow(self) -> pa.Table:
         import uuid
 
+        from ..obs.tracing import pop_query, push_query
         from .listener import QueryEvent
 
         qid = uuid.uuid4().hex[:12]
         bus = getattr(self.session, "listener_bus", None)
         tracer = self._tracer
-        span_mark = tracer.mark() if tracer is not None else 0
+        # query-scope tag (NOT a buffer offset): every span this collect
+        # records — on this thread, in par_map lanes (copied contexts),
+        # or in cluster workers (tag ships with the task) — is stamped
+        # with qid, so concurrent collects on one shared session produce
+        # disjoint span sets
+        qtoken = push_query(qid)
         t0 = time.perf_counter()
         if bus is not None:
             bus.post(QueryEvent("queryStarted", qid, time.time()))
@@ -242,7 +250,7 @@ class QueryExecution:
                     plan=self.physical.tree_string(),
                     metrics=counters,
                     plan_graph=self.plan_graph(),
-                    spans=(parse_spans + tracer.since(span_mark))
+                    spans=(parse_spans + tracer.spans_for(qid))
                     if tracer is not None else []))
             return out
         except Exception as e:
@@ -252,6 +260,8 @@ class QueryExecution:
                     duration_ms=(time.perf_counter() - t0) * 1000,
                     error=f"{type(e).__name__}: {e}"))
             raise
+        finally:
+            pop_query(qtoken)
 
     def _consume_parse_spans(self) -> list:
         """Parse spans ride the parsed plan (session.sql records them
@@ -372,6 +382,15 @@ class QueryExecution:
         measured = {k: v - before_kinds.get(k, 0)
                     for k, v in after_kinds.items()
                     if v != before_kinds.get(k, 0)}
+        # cluster mode: the measured run's worker processes shipped their
+        # own KernelCache deltas back with the stage results — measured
+        # launches are DRIVER + WORKER totals, same ground truth the
+        # per-operator attribution merge uses
+        wkinds = getattr(getattr(self, "_last_ctx", None),
+                         "worker_kernel_kinds", None)
+        if wkinds:
+            for k, v in wkinds.items():
+                measured[k] = measured.get(k, 0) + v
         counter_deltas = {k: v - before_counters.get(k, 0)
                           for k, v in after_counters.items()
                           if v != before_counters.get(k, 0)}

@@ -239,9 +239,12 @@ class DAGScheduler:
                     self._post("stageSubmitted", stage)
                     t0 = time.perf_counter()
                     if tracer is not None:
+                        # flow=True links execution phase → stage → lane
+                        # spans as Perfetto flow arrows in the export
                         with tracer.span(f"stage-{stage.stage_id}",
                                          cat="stage",
-                                         args={"attempt": attempt + 1}):
+                                         args={"attempt": attempt + 1},
+                                         flow=True):
                             stage.result = stage.root.execute(self.ctx)
                     else:
                         stage.result = stage.root.execute(self.ctx)

@@ -294,12 +294,17 @@ def shuffle_round_robin(partitions: list[Partition], num_out: int,
     for part in partitions:
         for batch in part:
             cap = batch.capacity
-            kkey = ("shuffle_rr", cap, num_out, start % num_out)
-            s = start % num_out
+            # the running row offset is a kernel ARGUMENT (an int32
+            # device scalar), not part of the cache key: one compiled
+            # kernel per (capacity, num_out) serves every batch position
+            # (the historical key embedded start % num_out and compiled
+            # once per batch — the SampleExec storm shape)
+            kkey = ("shuffle_rr", cap, num_out)
             kernel = GLOBAL_KERNEL_CACHE.get_or_build(
-                kkey, lambda s=s: jax.jit(
-                    lambda mask: round_robin_partition(mask, num_out, s)))
-            pr = kernel(batch.row_mask)
+                kkey, lambda: jax.jit(
+                    lambda mask, s: round_robin_partition(mask, num_out,
+                                                          s)))
+            pr = kernel(batch.row_mask, np.int32(start % num_out))
             gathered, counts = _pull_sorted(batch, pr.perm, pr.counts)
             _slice_into(bufs, gathered, counts)
             start += int(counts.sum())

@@ -79,6 +79,71 @@ def put_block(shuffle_id: str, reduce_id: int, data: bytes) -> None:
     store_map_block(shuffle_id, 0, 1, reduce_id, data)
 
 
+# ---------------------------------------------------------------------------
+# Worker-side observability (cluster-mode SQL stage tasks)
+# ---------------------------------------------------------------------------
+
+def begin_stage_obs(conf) -> dict | None:
+    """Install a process-local observability recorder for one stage task
+    (the executor half of the reference's heartbeat-shipped executor
+    metrics): a task-lived Tracer, a per-operator metric-record dict for
+    the ExecContext, and baselines of THIS process's KernelCache
+    counters, so the driver can reconcile attributed launches against
+    driver+worker totals. Same zero-launch/no-mid-query-sync contract as
+    the driver recorder — everything here is host bookkeeping. Returns
+    None when the session disabled obs shipping."""
+    from ..config import (CLUSTER_OBS_SHIPPING, KERNEL_ATTRIBUTION,
+                          TRACE_ENABLED, TRACE_MAX_SPANS,
+                          UI_OPERATOR_METRICS)
+    from ..obs.tracing import Tracer
+    from ..physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+    # conf values are host data — bool() here never touches device
+    if not bool(conf.get(  # tpulint: ignore[host-sync]
+            CLUSTER_OBS_SHIPPING)):
+        return None
+    trace_on = bool(conf.get(TRACE_ENABLED))  # tpulint: ignore[host-sync]
+    metrics_on = bool(conf.get(  # tpulint: ignore[host-sync]
+        UI_OPERATOR_METRICS))
+    attribution = bool(conf.get(  # tpulint: ignore[host-sync]
+        KERNEL_ATTRIBUTION))
+    tracer = Tracer(enabled=trace_on,
+                    max_spans=int(  # tpulint: ignore[host-sync]
+                        conf.get(TRACE_MAX_SPANS)))
+    return {"tracer": tracer if trace_on else None,
+            "rec": {} if metrics_on else None,
+            "attribution": attribution,
+            "kinds0": dict(KC.launches_by_kind),
+            "launches0": KC.launches,
+            "compile_ms0": KC.compile_ms}
+
+
+def finish_stage_obs(state: dict | None) -> dict | None:
+    """Package the task's observability for the ride back to the driver
+    alongside the MapStatus payload: exported per-operator records
+    (parked masks resolved — the batches are already host-side for block
+    storage), raw spans + the (wall, perf) clock anchor for cross-process
+    rebasing, and this process's KernelCache launch/compile deltas."""
+    if state is None:
+        return None
+    from ..obs.metrics import export_op_records
+    from ..physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+    kinds = {k: v - state["kinds0"].get(k, 0)
+             for k, v in KC.launches_by_kind.items()
+             if v != state["kinds0"].get(k, 0)}
+    tracer = state["tracer"]
+    return {
+        "op_records": export_op_records(state["rec"]),
+        "spans": tracer.spans() if tracer is not None else [],
+        "anchor": tracer.anchor if tracer is not None else None,
+        "kernel_kinds": kinds,
+        "kernel_launches": KC.launches - state["launches0"],
+        "kernel_compile_ms": round(KC.compile_ms - state["compile_ms0"], 3),
+        "pid": os.getpid(),
+    }
+
+
 def _handle_get_block(payload: bytes):
     sid, rid = pickle.loads(payload)
     with _STORE_LOCK:

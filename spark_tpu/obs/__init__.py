@@ -15,9 +15,13 @@ pulled once per distinct mask identity at query end (parked under a
 per-query byte budget so metrics-on never pins unbounded HBM).
 """
 
-from .tracing import Tracer, to_chrome_trace  # noqa: F401
+from .tracing import (  # noqa: F401
+    Tracer, current_flow, current_query, pop_query, push_query,
+    to_chrome_trace,
+)
 from .metrics import (  # noqa: F401
-    AnalyzedReport, current_op_name, finalize_plan_metrics, fused_members,
-    new_op_record, pop_op, push_op, record_kernel_compile,
-    record_kernel_launch,
+    AnalyzedReport, current_op_name, export_op_records,
+    finalize_plan_metrics, fused_members, merge_op_records, new_op_record,
+    pop_op, push_op, record_kernel_compile, record_kernel_launch,
+    scoped_submit,
 )

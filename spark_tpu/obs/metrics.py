@@ -34,9 +34,10 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-__all__ = ["AnalyzedReport", "current_op_name", "finalize_plan_metrics",
-           "fused_members", "new_op_record", "pop_op", "push_op",
-           "record_kernel_launch", "record_kernel_compile"]
+__all__ = ["AnalyzedReport", "current_op_name", "export_op_records",
+           "finalize_plan_metrics", "fused_members", "merge_op_records",
+           "new_op_record", "pop_op", "push_op", "record_kernel_launch",
+           "record_kernel_compile", "scoped_submit"]
 
 
 # ---------------------------------------------------------------------------
@@ -95,6 +96,20 @@ def record_kernel_compile(kind, ms: float) -> None:
     rec = scope[0]
     with _ATTR_LOCK:
         rec["compile_ms"] += ms
+
+
+def scoped_submit(pool, fn, *args):
+    """Submit `fn` to a concurrent.futures pool under a COPY of the
+    caller's contextvars Context, taken at submit time — the same
+    discipline `exec/scheduler.par_map` applies to its lane threads.
+    Pool worker threads start with an empty context, so a bare
+    `pool.submit` silently re-buckets every kernel launch the task
+    dispatches to "unattributed" and drops its spans' query tag; this is
+    the one sanctioned way to hand obs-scoped work to a thread pool.
+    One Context copy per submit (a Context cannot be entered
+    concurrently)."""
+    ctx = contextvars.copy_context()
+    return pool.submit(ctx.run, fn, *args)
 
 
 # ---------------------------------------------------------------------------
@@ -225,6 +240,48 @@ def discard_pending(rec: dict | None) -> None:
             ent["pending"] = []
             ent["rows_exact"] = False
     rec.pop(_PARKED_KEY, None)
+
+
+# ---------------------------------------------------------------------------
+# Cross-process shipping (cluster workers → driver)
+# ---------------------------------------------------------------------------
+
+def export_op_records(rec: dict | None) -> dict:
+    """Worker-side: resolve parked masks and strip device references so
+    the per-operator records can ride the stage-task result back to the
+    driver. Keys are the plan nodes' pre-assigned `_metric_id`s, which
+    survive cloudpickle into the worker — the driver merges by the same
+    key. Resolving here adds no extra sync: the task result path has
+    already pulled every output batch to the host for Arrow-IPC block
+    storage, so the stage's last dispatch is long done."""
+    if not rec:
+        return {}
+    finalize_plan_metrics(rec)
+    return {key: {f: v for f, v in ent.items() if f != "pending"}
+            for key, ent in rec.items() if key != _PARKED_KEY}
+
+
+def merge_op_records(dst: dict, shipped: dict) -> None:
+    """Driver-side: fold a worker's shipped per-operator records into the
+    query's plan_metrics dict (same key space — `_metric_id`). Counters
+    accumulate; rows_exact degrades monotonically. Lanes of one query
+    may merge from several map tasks concurrently, so the increments
+    serialize on the shared attribution lock."""
+    with _ATTR_LOCK:
+        for key, src in shipped.items():
+            ent = dst.get(key)
+            if ent is None:
+                ent = dst[key] = new_op_record()
+            ent["rows"] += src.get("rows", 0)
+            ent["rows_exact"] = ent["rows_exact"] and \
+                src.get("rows_exact", True)
+            ent["batches"] += src.get("batches", 0)
+            ent["ms"] += src.get("ms", 0.0)
+            ent["calls"] += src.get("calls", 0)
+            ent["launch_total"] += src.get("launch_total", 0)
+            ent["compile_ms"] += src.get("compile_ms", 0.0)
+            for kind, n in (src.get("kinds") or {}).items():
+                ent["kinds"][kind] = ent["kinds"].get(kind, 0) + n
 
 
 # ---------------------------------------------------------------------------

@@ -9,6 +9,30 @@ list append each — so tracing stays ON by default; async partition
 pipelining is visible because `ExecContext.par_map` lanes record their
 spans from their own threads (distinct `tid` tracks in the trace).
 
+Three cross-cutting mechanisms ride every span:
+
+  * query scope — a contextvar tag (`push_query`/`pop_query`) stamps
+    each span with the query it belongs to at record time. Because
+    contextvars follow the work into `par_map` lanes (copied Context per
+    lane) and into cluster tasks (the tag ships with the task), two
+    concurrent collects on one shared session get DISJOINT span sets —
+    the buffer-offset mark()/since() slicing that assumed sequential
+    queries is kept only as a compatibility surface.
+
+  * flow graph — spans opened with `flow=True` allocate a process-unique
+    flow id and parent themselves to the enclosing flow span via a
+    second contextvar, which crosses thread (copied Context) and process
+    (shipped span args) boundaries. The exporter turns every resolved
+    parent→child pair into Perfetto flow arrows ("s"/"f" events), so the
+    rendered timeline draws query → stage → partition-lane/worker arrows
+    plus shuffle map-task → reduce-fetch edges.
+
+  * cross-process ingest — `Tracer.ingest` merges spans recorded by a
+    worker-process tracer into this one, rebasing perf_counter
+    timestamps through paired (wall, perf) anchors and prefixing thread
+    tracks with the worker's identity so worker spans render as their
+    own named tracks.
+
 Export is the Chrome trace-event format ("traceEvents" complete events,
 microsecond timestamps), loadable in Perfetto (ui.perfetto.dev) or
 chrome://tracing.
@@ -16,12 +40,50 @@ chrome://tracing.
 
 from __future__ import annotations
 
+import contextvars
 import json
 import threading
 import time
+import uuid
 from typing import Optional
 
-__all__ = ["Tracer", "to_chrome_trace"]
+__all__ = ["Tracer", "current_flow", "current_query", "pop_query",
+           "push_query", "to_chrome_trace"]
+
+
+# ---------------------------------------------------------------------------
+# Query scope: which query's collect is executing on this thread/lane
+# ---------------------------------------------------------------------------
+
+# contextvars (not thread-locals) so scheduler.par_map's copied lane
+# contexts and the cluster task payload both carry the tag — spans from
+# concurrent queries on one session stay disjoint (ROADMAP follow-on)
+_QUERY: "contextvars.ContextVar" = contextvars.ContextVar(
+    "spark_tpu_query_scope", default=None)
+
+# the innermost flow-enabled span: children opened under it (same thread,
+# copied lane context, or shipped worker task) parent their flow arrow here
+_FLOW: "contextvars.ContextVar" = contextvars.ContextVar(
+    "spark_tpu_flow_scope", default=None)
+
+
+def push_query(query_id: str):
+    """Enter a query scope; returns the reset token for pop_query."""
+    return _QUERY.set(query_id)
+
+
+def pop_query(token) -> None:
+    _QUERY.reset(token)
+
+
+def current_query() -> str | None:
+    return _QUERY.get()
+
+
+def current_flow() -> str | None:
+    """Flow id of the innermost flow span (for handing across an
+    explicit boundary, e.g. into a cluster task payload)."""
+    return _FLOW.get()
 
 
 class _NullSpan:
@@ -43,14 +105,17 @@ _NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("tracer", "name", "cat", "args", "t0")
+    __slots__ = ("tracer", "name", "cat", "args", "t0", "flow", "_ftoken")
 
-    def __init__(self, tracer: "Tracer", name: str, cat: str, args):
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args,
+                 flow: bool = False):
         self.tracer = tracer
         self.name = name
         self.cat = cat
         self.args = args
         self.t0 = 0.0
+        self.flow = flow
+        self._ftoken = None
 
     def set_args(self, args) -> None:
         """Attach/merge args before exit (per-span kernel attribution)."""
@@ -60,14 +125,27 @@ class _Span:
             self.args.update(args)
 
     def __enter__(self):
+        if self.flow:
+            # explicit flow_id (deterministic cross-process ids, e.g. a
+            # shuffle's map-task span) wins over a fresh allocation
+            fid = (self.args or {}).get("flow_id") \
+                or self.tracer._next_flow_id()
+            parent = (self.args or {}).get("flow_parent") or _FLOW.get()
+            args = {"flow_id": fid}
+            if parent is not None:
+                args["flow_parent"] = parent
+            self.set_args(args)
+            self._ftoken = _FLOW.set(fid)
         self.t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
         dur = time.perf_counter() - self.t0
+        if self._ftoken is not None:
+            _FLOW.reset(self._ftoken)
         t = threading.current_thread()
         self.tracer._record(self.name, self.cat, self.t0, dur,
-                            t.ident, t.name, self.args)
+                            t.ident, t.name, self.args, _QUERY.get())
         return False
 
 
@@ -84,10 +162,9 @@ class Tracer:
     spans count in `dropped`, and mark()/since() use monotonic sequence
     numbers so slices stay correct across eviction.
 
-    Per-QUERY span slices (mark()/since()) assume queries on one session
-    run sequentially; concurrent collects on a shared session interleave
-    in the buffer and cross-attribute event spans (ROADMAP: tag spans
-    with a query-scope contextvar).
+    Per-QUERY spans come from the query-scope contextvar tag
+    (`spans_for`); mark()/since() buffer slicing is kept for sequential
+    callers but concurrent collects should read their own query tag.
     """
 
     def __init__(self, conf=None, enabled: bool = True,
@@ -97,11 +174,18 @@ class Tracer:
         self._conf = conf
         self._enabled = enabled
         self._max_spans = max_spans
-        # ring of (name, cat, t0, dur, tid, tname, args)
+        # ring of (name, cat, t0, dur, tid, tname, args, query_id)
         self._spans: "collections.deque" = collections.deque()
         self._seq = 0              # total spans ever recorded
         self._lock = threading.Lock()
         self.dropped = 0
+        # flow ids must stay unique across processes (worker spans are
+        # ingested into the driver tracer verbatim)
+        self._uid = uuid.uuid4().hex[:8]
+        self._flow_n = 0
+        # paired clocks for cross-process timestamp rebasing: a worker's
+        # perf_counter domain maps into ours through the wall clock
+        self.anchor = (time.time(), time.perf_counter())
 
     @property
     def enabled(self) -> bool:
@@ -119,26 +203,74 @@ class Tracer:
         return self._max_spans
 
     def span(self, name: str, cat: str = "exec",
-             args: Optional[dict] = None):
+             args: Optional[dict] = None, flow: bool = False):
         if not self.enabled:
             return _NULL_SPAN
-        return _Span(self, name, cat, args)
+        return _Span(self, name, cat, args, flow=flow)
 
-    def _record(self, name, cat, t0, dur, tid, tname, args) -> None:
+    def _next_flow_id(self) -> str:
         with self._lock:
-            self._spans.append((name, cat, t0, dur, tid, tname, args))
+            self._flow_n += 1
+            return f"{self._uid}:{self._flow_n}"
+
+    def _record(self, name, cat, t0, dur, tid, tname, args,
+                qid=None) -> None:
+        with self._lock:
+            self._spans.append((name, cat, t0, dur, tid, tname, args, qid))
             self._seq += 1
             while len(self._spans) > self._max_spans:
                 self._spans.popleft()  # ring: evict oldest, keep tracing
                 self.dropped += 1
 
+    def ingest(self, spans: list, anchor: tuple | None = None,
+               track: str | None = None, query_id: str | None = None) -> int:
+        """Merge spans recorded by ANOTHER process's tracer (a cluster
+        worker) into this buffer: timestamps rebase through the paired
+        (wall, perf) anchors, thread tracks get `track/` prefixed so
+        worker spans render as their own named tracks, and every span is
+        re-tagged to `query_id` (the driver's query scope — the worker's
+        own tag is task-local). Pure host bookkeeping."""
+        if not spans:
+            return 0
+        off = 0.0
+        if anchor is not None:
+            # worker wall time of a span = w_wall + (t0 - w_perf); map it
+            # into our perf domain: t0' = t0 + (w_wall - w_perf) -
+            # (our_wall - our_perf)
+            off = (anchor[0] - anchor[1]) - (self.anchor[0] - self.anchor[1])
+        n = 0
+        with self._lock:
+            for s in spans:
+                name, cat, t0, dur, ident, tname, args = s[:7]
+                qid = s[7] if len(s) > 7 else None
+                self._spans.append((
+                    name, cat, t0 + off, dur, ident,
+                    f"{track}/{tname}" if track else tname, args,
+                    query_id if query_id is not None else qid))
+                self._seq += 1
+                while len(self._spans) > self._max_spans:
+                    self._spans.popleft()
+                    self.dropped += 1
+                n += 1
+        return n
+
     # -- reading ----------------------------------------------------------
     def mark(self) -> int:
         """Monotonic sequence number — pass to since() to slice one
         query's spans out of a session-lived tracer (valid across ring
-        eviction)."""
+        eviction). Assumes sequential queries; concurrent collects should
+        use spans_for(query_id)."""
         with self._lock:
             return self._seq
+
+    @staticmethod
+    def _span_dict(s) -> dict:
+        name, cat, t0, dur, _tid, tname, args = s[:7]
+        qid = s[7] if len(s) > 7 else None
+        return {"name": name, "cat": cat, "ts": round(t0, 6),
+                "dur_ms": round(dur * 1000, 3), "thread": tname,
+                **({"args": args} if args else {}),
+                **({"query": qid} if qid is not None else {})}
 
     def since(self, mark: int) -> list[dict]:
         """Spans recorded after mark(), as JSON-friendly dicts (spans the
@@ -146,10 +278,18 @@ class Tracer:
         with self._lock:
             first = self._seq - len(self._spans)  # seq of oldest buffered
             spans = list(self._spans)[max(0, mark - first):]
-        return [{"name": n, "cat": c, "ts": round(t0, 6),
-                 "dur_ms": round(dur * 1000, 3), "thread": tname,
-                 **({"args": args} if args else {})}
-                for n, c, t0, dur, _tid, tname, args in spans]
+        return [self._span_dict(s) for s in spans]
+
+    def spans_for(self, query_id: str) -> list[dict]:
+        """All buffered spans tagged with one query scope, as
+        JSON-friendly dicts — the concurrency-safe per-query slice.
+        The lock covers only the ring snapshot (same profile as
+        since()); the tag filter runs outside it so a full 100k-span
+        ring never stalls concurrent span recording."""
+        with self._lock:
+            spans = list(self._spans)
+        return [self._span_dict(s) for s in spans
+                if len(s) > 7 and s[7] == query_id]
 
     def spans(self) -> list:
         with self._lock:
@@ -171,6 +311,41 @@ class Tracer:
         return path
 
 
+def _flow_events(complete: list) -> list:
+    """Perfetto flow arrows from span args: every span carrying a
+    `flow_parent` that resolves to another span's `flow_id` emits one
+    "s" (start, anchored inside the parent slice) + "f" (finish, binding
+    to the enclosing child slice) pair with a fresh numeric id. Parents
+    that did not make it into the trace (disabled worker tracer, ring
+    eviction) emit nothing — the exporter never leaves a dangling arrow,
+    which is exactly what dev/validate_trace.py checks."""
+    by_fid = {}
+    for ev in complete:
+        fid = (ev.get("args") or {}).get("flow_id")
+        if fid is not None:
+            by_fid[fid] = ev
+    out = []
+    edge = 0
+    for ev in complete:
+        parents = (ev.get("args") or {}).get("flow_parent")
+        if parents is None:
+            continue
+        if not isinstance(parents, (list, tuple)):
+            parents = [parents]
+        for parent in parents:
+            src = by_fid.get(parent)
+            if src is None or src is ev:
+                continue
+            edge += 1
+            out.append({"ph": "s", "id": edge, "pid": src["pid"],
+                        "tid": src["tid"], "ts": src["ts"],
+                        "name": "flow", "cat": "flow"})
+            out.append({"ph": "f", "bp": "e", "id": edge, "pid": ev["pid"],
+                        "tid": ev["tid"], "ts": ev["ts"],
+                        "name": "flow", "cat": "flow"})
+    return out
+
+
 def to_chrome_trace(spans: list, process_name: str = "spark_tpu",
                     pid: int = 1) -> dict:
     """Raw tracer spans → Chrome trace-event JSON dict.
@@ -178,8 +353,11 @@ def to_chrome_trace(spans: list, process_name: str = "spark_tpu",
     Complete ("ph": "X") events with microsecond timestamps relative to
     the earliest span; one tid track per recording thread, labeled with
     the thread name via metadata events (par_map lanes show as their own
-    pipelined tracks).
-    """
+    pipelined tracks; ingested worker spans as `worker:<id>/...`
+    tracks). Spans carrying flow_id/flow_parent args additionally emit
+    Perfetto flow arrows ("s"/"f" events) linking query → stage →
+    lane/worker spans and shuffle map → reduce-fetch edges across
+    threads and processes."""
     events = [{"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
                "args": {"name": process_name}}]
     if not spans:
@@ -189,7 +367,10 @@ def to_chrome_trace(spans: list, process_name: str = "spark_tpu",
     # reuses idents, so ident alone would merge distinct threads into one
     # mislabeled track
     tid_map: dict = {}
-    for name, cat, t0, dur, ident, tname, args in spans:
+    complete = []
+    for s in spans:
+        name, cat, t0, dur, ident, tname, args = s[:7]
+        qid = s[7] if len(s) > 7 else None
         tid = tid_map.get((ident, tname))
         if tid is None:
             tid = tid_map[(ident, tname)] = len(tid_map) + 1
@@ -198,7 +379,11 @@ def to_chrome_trace(spans: list, process_name: str = "spark_tpu",
         ev = {"ph": "X", "pid": pid, "tid": tid, "name": name, "cat": cat,
               "ts": round((t0 - tmin) * 1e6, 3),
               "dur": round(dur * 1e6, 3)}
-        if args:
-            ev["args"] = args
+        if args or qid is not None:
+            ev["args"] = dict(args or {})
+            if qid is not None:
+                ev["args"]["query"] = qid
         events.append(ev)
+        complete.append(ev)
+    events.extend(_flow_events(complete))
     return {"traceEvents": events, "displayTimeUnit": "ms"}

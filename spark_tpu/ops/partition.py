@@ -34,9 +34,12 @@ def hash_partition(key_cols: Sequence[jnp.ndarray],
 
 
 def round_robin_partition(row_mask: jnp.ndarray, num_partitions: int,
-                          start: int = 0) -> PartitionedRows:
+                          start=0) -> PartitionedRows:
     """Round-robin over live rows (reference: round-robin partitioning in
-    ShuffleExchangeExec)."""
+    ShuffleExchangeExec). `start` — the running row offset across the
+    exchange's batches — may be a TRACED int32 scalar: callers pass it
+    as a kernel argument so one compiled kernel per (capacity,
+    num_partitions) serves every batch position (exec/shuffle.py)."""
     cap = row_mask.shape[0]
     live_rank = jnp.cumsum(row_mask.astype(jnp.int32)) - 1
     pids = ((live_rank + start) % num_partitions).astype(jnp.int32)

@@ -390,3 +390,296 @@ def test_explain_analyze_flags_min_rows_gate(spark, data):
             report.findings
     finally:
         spark.conf.unset("spark.tpu.fusion.enabled")
+
+
+# ---------------------------------------------------------------------------
+# per-query span scoping (concurrency-safe replacement for mark/since)
+# ---------------------------------------------------------------------------
+
+def test_query_scope_tags_spans_disjointly():
+    from spark_tpu.obs.tracing import Tracer, pop_query, push_query
+
+    t = Tracer(enabled=True)
+    tok = push_query("qA")
+    try:
+        with t.span("a1"):
+            with t.span("a2"):
+                pass
+    finally:
+        pop_query(tok)
+    tok = push_query("qB")
+    try:
+        with t.span("b1"):
+            pass
+    finally:
+        pop_query(tok)
+    with t.span("untagged"):
+        pass
+    assert {s["name"] for s in t.spans_for("qA")} == {"a1", "a2"}
+    assert {s["name"] for s in t.spans_for("qB")} == {"b1"}
+    assert all(s["query"] == "qA" for s in t.spans_for("qA"))
+
+
+def test_concurrent_collects_get_disjoint_query_spans(data):
+    """Two collects racing on ONE shared session must not cross-attribute
+    event spans: each querySucceeded event carries exactly its own
+    lifecycle (one collect span) and none of the other query's operator
+    spans — the failure mode of the old buffer-offset mark()/since()
+    slicing."""
+    import threading
+
+    spark = data
+    events = []
+    spark.listener_bus.register(events.append)
+    barrier = threading.Barrier(2)
+    errors = []
+
+    def run(sql):
+        try:
+            barrier.wait(timeout=30)
+            for _ in range(3):
+                spark.sql(sql).toArrow()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    q_plain = "select v from obs_t where v > 10"
+    threads = [threading.Thread(target=run, args=(s,))
+               for s in (Q_AGG, q_plain)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        spark.listener_bus.wait_empty()
+    finally:
+        spark.listener_bus.unregister(events.append)
+    assert not errors, errors
+    done = [e for e in events if e.event == "querySucceeded"]
+    assert len(done) == 6
+    for e in done:
+        names = [sp["name"] for sp in e.spans]
+        assert names.count("collect") == 1, (e.query_id, names)
+        assert names.count("execution") == 1, (e.query_id, names)
+        is_agg = "HashAggregate" in (e.plan or "")
+        agg_spans = [n for n in names if "HashAggregate" in n]
+        if is_agg:
+            assert agg_spans, names
+        else:
+            assert not agg_spans, (e.query_id, names)
+
+
+def test_scoped_submit_preserves_attribution_and_query_scope():
+    """Satellite regression: obs scope must follow work into thread
+    POOLS via a copied contextvars Context per submit — a bare submit
+    silently re-buckets launches to 'unattributed' and drops the query
+    tag (pool threads start with an empty context)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from spark_tpu.obs import metrics as OM
+    from spark_tpu.obs.tracing import current_query, pop_query, push_query
+
+    rec = OM.new_op_record()
+    op_token = OM.push_op(rec, "PoolOp")
+    q_token = push_query("q-pool")
+    try:
+        with ThreadPoolExecutor(2) as pool:
+            futs = [OM.scoped_submit(pool, OM.record_kernel_launch, "probe")
+                    for _ in range(3)]
+            for f in futs:
+                f.result()
+            scoped_op = OM.scoped_submit(pool, OM.current_op_name).result()
+            scoped_q = OM.scoped_submit(pool, current_query).result()
+            bare_op = pool.submit(OM.current_op_name).result()
+    finally:
+        pop_query(q_token)
+        OM.pop_op(op_token)
+    assert rec["kinds"] == {"probe": 3} and rec["launch_total"] == 3
+    assert scoped_op == "PoolOp" and scoped_q == "q-pool"
+    assert bare_op is None  # the hazard scoped_submit exists to prevent
+
+
+# ---------------------------------------------------------------------------
+# Perfetto flow events: phase → stage → partition-lane arrows
+# ---------------------------------------------------------------------------
+
+def _flow_edges(doc):
+    """(source complete event, dest complete event) per exported flow."""
+    evs = doc["traceEvents"]
+    complete = [e for e in evs if e.get("ph") == "X"]
+
+    def enclosing(fe):
+        best = None
+        for sp in complete:
+            if sp["pid"] == fe["pid"] and sp["tid"] == fe["tid"] and \
+                    sp["ts"] - 1 <= fe["ts"] <= sp["ts"] + sp["dur"] + 1:
+                if best is None or sp["dur"] < best["dur"]:
+                    best = sp
+        return best
+
+    starts = {e["id"]: e for e in evs if e.get("ph") == "s"}
+    ends = {e["id"]: e for e in evs if e.get("ph") == "f"}
+    assert set(starts) == set(ends), "unpaired flow events"
+    return [(enclosing(starts[i]), enclosing(ends[i])) for i in starts]
+
+
+def test_flow_events_link_execution_stage_and_lanes(data):
+    spark = data
+    spark.sql("select v from obs_t").repartition(4) \
+        .filter("v > 0").toArrow()
+    doc = spark.tracer.to_chrome_trace()
+    edges = _flow_edges(doc)
+    assert edges, "no flow arrows exported"
+    assert all(src is not None and dst is not None for src, dst in edges), \
+        "flow endpoint does not land inside a span"
+    kinds = {(src["name"].split("[")[0].split("-")[0], dst["cat"])
+             for src, dst in edges}
+    # execution phase → stage arrows and stage → partition-lane arrows
+    assert any(src["name"] == "execution" and
+               dst["name"].startswith("stage-")
+               for src, dst in edges), kinds
+    assert any(dst["cat"] == "partition" for _, dst in edges), kinds
+
+
+# ---------------------------------------------------------------------------
+# cluster mode: worker-side metric/span shipping round trip
+# ---------------------------------------------------------------------------
+
+def _cq(spark):
+    """Shuffle+agg over the cluster: the explicit repartition keeps a
+    round-robin map stage and a hash-exchange map stage in the plan even
+    on single-partition input (a partial-only aggregate would collapse
+    to one local stage and never ship)."""
+    import spark_tpu.api.functions as F
+
+    return (spark.sql("select k, v from cobs_t").repartition(3)
+            .groupBy("k").agg(F.sum("v").alias("sv"),
+                              F.count("k").alias("c")))
+
+
+def _cobs_table():
+    rng = np.random.default_rng(41)
+    n = 6000
+    return pa.table({"k": rng.integers(0, 7, n),
+                     "v": rng.integers(-30, 70, n)})
+
+
+@pytest.fixture(scope="module")
+def cluster_spark():
+    """Session over a 2-worker local process cluster (shuffle+agg plans
+    ship their map stages into worker processes). AQE off so local and
+    cluster runs execute the identical static plan."""
+    from spark_tpu.api.session import TpuSession
+    from spark_tpu.exec.cluster import LocalCluster
+
+    s = TpuSession("obs-cluster", {
+        "spark.sql.shuffle.partitions": "3",
+        "spark.tpu.batch.capacity": 1 << 12,
+        "spark.sql.adaptive.enabled": "false",
+    })
+    cluster = LocalCluster(num_workers=2)
+    s.attachSqlCluster(cluster)
+    s.createDataFrame(_cobs_table()).createOrReplaceTempView("cobs_t")
+    yield s
+    s.stop()
+
+
+def _rollup(graph):
+    """plan_graph → {(metric id, op): (rows, batches)} for executed ops."""
+    return {(nd["id"], nd["op"]): (nd["rows"], nd.get("batches"))
+            for nd in graph if nd.get("rows") is not None}
+
+
+def test_cluster_metrics_merge_matches_local_rollup(cluster_spark):
+    """Worker-shipped per-operator records must merge to the SAME rollup
+    the purely-local scheduler measures: identical plan → identical
+    per-node rows/batches, metric-id for metric-id."""
+    from spark_tpu.api.session import TpuSession
+
+    df = _cq(cluster_spark)
+    df.toArrow()
+    remote = cluster_spark._metrics.snapshot()["counters"].get(
+        "scheduler.stages_remote", 0)
+    assert remote >= 1, "query never shipped a stage to a worker"
+    cluster_rollup = _rollup(df.query_execution.plan_graph())
+    assert cluster_rollup, "cluster plan graph carries no operator rows"
+
+    local = TpuSession("obs-local-ref", {
+        "spark.sql.shuffle.partitions": "3",
+        "spark.tpu.batch.capacity": 1 << 12,
+        "spark.sql.adaptive.enabled": "false",
+    })
+    try:
+        local.createDataFrame(_cobs_table()) \
+            .createOrReplaceTempView("cobs_t")
+        ldf = _cq(local)
+        ldf.toArrow()
+        local_rollup = _rollup(ldf.query_execution.plan_graph())
+    finally:
+        local.stop()
+    assert cluster_rollup == local_rollup, (
+        f"cluster rollup {cluster_rollup} != local {local_rollup}")
+
+
+def test_cluster_spans_include_worker_tracks(cluster_spark):
+    spark = cluster_spark
+    mark = spark.tracer.mark()
+    _cq(spark).toArrow()
+    spans = spark.tracer.since(mark)
+    worker = [s for s in spans
+              if str(s.get("thread", "")).startswith("worker:")]
+    assert worker, f"no worker-track spans in {sorted({s['thread'] for s in spans})}"
+    cats = {s["cat"] for s in worker}
+    # the task root span and the operator spans inside it both shipped
+    assert "worker" in cats and "operator" in cats, cats
+    # worker spans re-tagged to the driver's query scope
+    assert all("query" in s for s in worker), worker[:3]
+
+
+def test_cluster_attribution_total_matches_driver_plus_worker(cluster_spark):
+    """No dispatch escapes attribution across the process boundary: the
+    per-operator attributed-launch total equals the driver KernelCache
+    delta plus the worker-shipped launch deltas."""
+    spark = cluster_spark
+    _cq(spark).toArrow()  # warm both worker processes' caches
+    before = KC.launches
+    df = _cq(spark)
+    df.toArrow()
+    driver_delta = KC.launches - before
+    ctx = df.query_execution._last_ctx
+    worker_kinds = ctx.worker_kernel_kinds or {}
+    assert worker_kinds, "workers shipped no kernel-launch deltas"
+    graph = df.query_execution.plan_graph()
+    attributed = sum(v for nd in graph
+                     for v in (nd.get("launches") or {}).values())
+    assert attributed == driver_delta + sum(worker_kinds.values()), (
+        f"attributed {attributed} != driver {driver_delta} + worker "
+        f"{worker_kinds}")
+
+
+def test_cluster_explain_analyze_no_unexplained_drift(cluster_spark):
+    """Acceptance: cluster-mode EXPLAIN ANALYZE reports non-empty
+    per-operator metrics, zero unexplained drift, and an attributed
+    total equal to the measured driver+worker launch total."""
+    report = _cq(cluster_spark).query_execution.analyzed_report()
+    assert not report.has_unexplained_drift, report.render()
+    executed = [nd for nd in report.nodes if nd["ms"] is not None]
+    assert executed and any(nd["launches"] for nd in report.nodes), \
+        report.render()
+    attributed = sum(v for nd in report.nodes
+                     for v in (nd.get("launches") or {}).values())
+    assert attributed == sum(report.measured.values()), report.render()
+
+
+def test_cluster_trace_exports_cross_process_flow_arrows(cluster_spark):
+    """The exported trace draws arrows across the process boundary:
+    stage → worker task (shipped flow parent) and map task →
+    reduce-side fetch (deterministic shuffle-derived flow ids)."""
+    spark = cluster_spark
+    _cq(spark).toArrow()
+    doc = spark.tracer.to_chrome_trace()
+    edges = [(s, d) for s, d in _flow_edges(doc)
+             if s is not None and d is not None]
+    assert any(d["cat"] == "worker" for _, d in edges), \
+        "no stage → worker-task flow arrow"
+    assert any(d["name"].startswith("fetch[") and s["cat"] == "worker"
+               for s, d in edges), "no map-task → reduce-fetch flow arrow"

@@ -251,6 +251,54 @@ def test_sample_offset_arg_no_recompile_storm(spark):
     assert after["kernel_cache.misses"] == mid["kernel_cache.misses"]
 
 
+def test_rr_offset_arg_no_recompile_storm(spark):
+    """shuffle_rr keys its kernel by (capacity, num_out) and feeds the
+    running row offset as a kernel ARGUMENT: a multi-batch round-robin
+    repartition compiles at most one kernel per capacity bucket (the
+    historical per-offset cache key compiled one per batch position),
+    launches stay 1/batch, and the analyzer's recompile hazard is gone
+    — replaced by the kernel-argument note."""
+
+    def rr_keys():
+        return [k for k in KC._cache if k and k[0] == "shuffle_rr"]
+
+    def q():
+        return spark.range(0, 40000, 1, 4).repartition(3)
+
+    report = q().query_execution.analysis_report()
+    assert not any("round-robin" in h for h in report.recompile_hazards), \
+        report.recompile_hazards
+    assert any("kernel argument" in n for s in report.stages
+               for n in s["notes"] if "round-robin" in n), \
+        [n for s in report.stages for n in s["notes"]]
+
+    before_keys = set(rr_keys())
+    before_kinds = dict(KC.launches_by_kind)
+    q().toArrow()  # cold: compiles happen here
+    new_keys = set(rr_keys()) - before_keys
+    # 10000 rows/partition at 4096-capacity tiles → per partition caps
+    # [4096, 4096, 2048]: two distinct buckets → ≤ 2 compiled kernels,
+    # each keyed WITHOUT the running offset
+    assert len(new_keys) <= 2, new_keys
+    assert all(len(k) == 3 for k in new_keys), new_keys
+    assert KC.launches_by_kind["shuffle_rr"] \
+        - before_kinds.get("shuffle_rr", 0) == 12
+
+    warm_keys = set(rr_keys())
+    q().toArrow()  # warm: zero further shuffle_rr compiles
+    assert set(rr_keys()) == warm_keys
+
+
+def test_rr_shuffle_rows_survive_offset_argument(spark):
+    """Round-robin output stays balanced and complete with the offset as
+    a kernel argument (the offset still advances across batches)."""
+    out = spark.range(0, 9999, 1, 4).repartition(3)
+    parts = out.query_execution.execute()
+    sizes = [sum(b.num_rows() for b in p) for p in parts]
+    assert sum(sizes) == 9999
+    assert max(sizes) - min(sizes) <= 1, sizes  # strict round-robin
+
+
 def test_inexact_degrades_honestly(fusion_conf, data):
     """A hash-exchange query (multi-partition repartition) has runtime-
     dependent layout: the analyzer must NOT claim exactness, and must say
