@@ -344,6 +344,76 @@ def bench_join():
 
 
 # --------------------------------------------------------------------------
+# #3b shuffle-heavy map stage: exchange map-side fusion on/off
+# --------------------------------------------------------------------------
+
+_MAP_SIDE_KINDS = ("fused_shuffle", "pipeline", "shuffle_pids",
+                   "shuffle_hash", "shuffle_rr", "shuffle_range")
+
+
+def bench_shuffle():
+    """Filter→project→hash-repartition→agg: the map side is the product
+    under test. With spark.tpu.fusion.exchange on (default) the stage
+    runs ONE fused dispatch per map batch; off pays pipeline + partition
+    kernels plus an intermediate batch. Reports map-side kernel launches
+    per batch both ways; vs_baseline is the speedup over our own unfused
+    oracle. Partition count 5 (non-power-of-two) keeps the exchange on
+    the host shuffle path rather than a mesh all-to-all."""
+    import pyarrow as pa
+
+    import spark_tpu.api.functions as F
+    from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE
+
+    n_rows = int(20_000_000 * SCALE)
+    session = _session({"spark.tpu.batch.capacity": 1 << 22,
+                        # the bench measures the fused path at every scale
+                        "spark.tpu.fusion.minRows": "0"})
+    cap = int(session.conf.get("spark.tpu.batch.capacity"))
+    n_batches = max(1, -(-n_rows // cap))
+    rng = np.random.default_rng(23)
+    table = pa.table({
+        "k": rng.integers(0, 1 << 16, n_rows).astype(np.int64),
+        "v": rng.integers(0, 1000, n_rows).astype(np.int64),
+    })
+    df = _df_from_table(session, table, "shuffle_bench")
+
+    def q():
+        # repartition terminal: every launch in the query IS map-side
+        # work (a downstream agg would add its own pipeline launches and
+        # muddy the per-batch metric)
+        return (df.filter(F.col("v") > 25)
+                .withColumn("v2", F.col("v") * 3)
+                .repartition(5, "k"))
+
+    _maybe_analyze(q, "shuffle")
+    results = {}
+    for mode, flag in (("fused", "true"), ("unfused", "false")):
+        session.conf.set("spark.tpu.fusion.exchange", flag)
+        best = _best_of(lambda: _run_blocked(q()))
+        before = dict(GLOBAL_KERNEL_CACHE.launches_by_kind)
+        _run_blocked(q())
+        after = GLOBAL_KERNEL_CACHE.launches_by_kind
+        map_launches = sum(after.get(k, 0) - before.get(k, 0)
+                           for k in _MAP_SIDE_KINDS)
+        results[mode] = (best, map_launches)
+    session.conf.unset("spark.tpu.fusion.exchange")
+    best_fused, map_fused = results["fused"]
+    best_unfused, map_unfused = results["unfused"]
+    rate = n_rows / best_fused
+    return {
+        "metric": "shuffle map stage filter+project+repartition(5,k) 2e7 "
+                  "rows (exchange map-side fusion; vs_baseline = speedup "
+                  "over the unfused oracle)",
+        "value": round(rate / 1e6, 2),
+        "unit": "M rows/s",
+        "vs_baseline": round(best_unfused / best_fused, 3),
+        "hbm_gbps": round(n_rows * 16 / best_fused / 1e9, 1),
+        "map_launches_per_batch_fused": round(map_fused / n_batches, 2),
+        "map_launches_per_batch_unfused": round(map_unfused / n_batches, 2),
+    }
+
+
+# --------------------------------------------------------------------------
 # #4/#5 TPC-DS q3 / q7 / q19 wall-clock at SF1-equivalent volume
 # --------------------------------------------------------------------------
 
@@ -444,6 +514,7 @@ CONFIGS = {
     "groupby": bench_groupby,
     "sort": bench_sort,
     "join": bench_join,
+    "shuffle": bench_shuffle,
     "tpcds": bench_tpcds,
 }
 

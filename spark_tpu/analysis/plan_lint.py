@@ -32,7 +32,8 @@ Kernel-kind legend (KernelCache key tags): pipeline, fused_agg, uagg/dagg/
 gagg, krange3 (dense-range scalar probe), fused_limit, limit, sort,
 join_build/join_probe, fused_probe, djoin_build/djoin_probe,
 fused_djoin_probe, shuffle_pids/shuffle_hash/shuffle_rr/shuffle_range,
-mesh_exchange, sample.
+fused_shuffle (exchange map side fused with its pipeline), mesh_exchange,
+sample.
 """
 
 from __future__ import annotations
@@ -45,9 +46,10 @@ import numpy as np
 
 from ..columnar.batch import bucket_capacity
 from ..config import (
-    ADAPTIVE_ENABLED, AGG_BLOCK_ROWS, BATCH_CAPACITY, BLOOM_JOIN_FILTER,
-    COALESCE_PARTITIONS_ENABLED, FUSION_DENSE_KEYS, FUSION_ENABLED,
-    FUSION_MIN_ROWS, MESH_ENABLED, MINMAX_JOIN_FILTER, SQLConf,
+    ADAPTIVE_ENABLED, ADVISORY_PARTITION_BYTES, AGG_BLOCK_ROWS,
+    BATCH_CAPACITY, BLOOM_JOIN_FILTER, COALESCE_PARTITIONS_ENABLED,
+    FUSION_DENSE_KEYS, FUSION_ENABLED, FUSION_EXCHANGE, FUSION_MIN_ROWS,
+    MESH_ENABLED, MINMAX_JOIN_FILTER, SQLConf,
 )
 from ..expr.expressions import (
     Alias, AttributeReference, EqualTo, GreaterThan, GreaterThanOrEqual, In,
@@ -72,6 +74,15 @@ class _Batch:
     rows: Optional[int]      # live-row upper bound (None = unknown)
     cap: Optional[int]       # device tile capacity (None = unknown)
     stable: bool             # same device arrays across executions
+    # shuffle-read tiles carry map-side column stats: the dense-range
+    # memo is seeded at build/ingest time, so the krange3 probe never
+    # fires even though the arrays are fresh every run
+    seeded: bool = False
+
+    @property
+    def probe_free(self) -> bool:
+        """No krange3 dispatch when this batch's range is consulted."""
+        return self.stable or self.seeded
 
 
 @dataclass
@@ -90,16 +101,96 @@ class _Trace:
         m = self.live if valid is None else (self.live & valid)
         return vals[m]
 
+    def compacted(self) -> "_Trace":
+        """Live rows only (the shape of a shuffle/aggregate OUTPUT, where
+        masked rows were dropped on the way through the host buffers)."""
+        m = self.live
+        cols = {k: (v[m], None if val is None else val[m])
+                for k, (v, val) in self.cols.items()}
+        return _Trace(cols, np.ones(int(m.sum()), bool), self.consecutive)
+
+    def select(self, sel: np.ndarray, consecutive: bool) -> "_Trace":
+        """Row subset (over an already-compacted trace)."""
+        cols = {k: (v[sel], None if val is None else val[sel])
+                for k, (v, val) in self.cols.items()}
+        return _Trace(cols, np.ones(len(sel), bool), consecutive)
+
 
 @dataclass
 class _Flow:
     parts: list                       # list[list[_Batch]]
     trace: Optional[_Trace] = None
     counted: bool = True              # batch counts are known exactly
+    # per-partition traces for multi-partition flows (post-exchange /
+    # post-aggregate); when None, `trace` describes the whole flow (the
+    # single-partition case every traced scan starts from)
+    ptraces: Optional[list] = None
 
     @property
     def total_batches(self):
         return sum(len(p) for p in self.parts)
+
+    def part_trace(self, i: int) -> Optional[_Trace]:
+        if self.ptraces is not None:
+            return self.ptraces[i] if i < len(self.ptraces) else None
+        return self.trace
+
+    def all_part_traces(self) -> Optional[list]:
+        """Per-partition traces covering EVERY partition, or None."""
+        if self.ptraces is not None:
+            if len(self.ptraces) == len(self.parts) and \
+                    all(t is not None for t in self.ptraces):
+                return list(self.ptraces)
+            return None
+        if self.trace is not None and len(self.parts) == 1:
+            return [self.trace]
+        return None
+
+
+# ---------------------------------------------------------------------------
+# host mirror of the device hash partitioner (ops/hashing.py)
+# ---------------------------------------------------------------------------
+
+_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_M2 = np.uint64(0x94D049BB133111EB)
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+
+
+def _np_mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer over uint64 lanes — bit-exact numpy mirror of
+    ops/hashing.mix64 (uint64 arithmetic wraps modulo 2^64 on both; the
+    errstate silences the 0-d scalar path's overflow warning — wrapping
+    IS the hash)."""
+    with np.errstate(over="ignore"):
+        x = x ^ (x >> np.uint64(30))
+        x = x * _M1
+        x = x ^ (x >> np.uint64(27))
+        x = x * _M2
+        x = x ^ (x >> np.uint64(31))
+    return x
+
+
+def _np_hash_pids(cols: list, num_out: int, seed: int = 42) -> np.ndarray:
+    """Partition ids of traced (int64-able) key columns — the host-side
+    hash of traced keys that lets multi-stage shuffle plans predict
+    exactly. Mirrors hash_columns + partition_ids: splitmix64 lanes, null
+    tags, 31x + golden combine, nonlinear seed fold, pmod."""
+    h = None
+    for i, (vals, valid) in enumerate(cols):
+        lane = np.asarray(vals).astype(np.int64).view(np.uint64)
+        k = _np_mix64(lane)
+        if valid is not None:
+            null_tag = _np_mix64(
+                np.asarray(0x6E756C6C + i, np.int64).view(np.uint64))
+            k = np.where(valid, k, null_tag)
+        if h is None:
+            h = k
+        else:
+            with np.errstate(over="ignore"):
+                h = _np_mix64(h * np.uint64(31) + k + _GOLDEN)
+    seed_u = _np_mix64(np.asarray(seed, np.int64).view(np.uint64))
+    final = _np_mix64(h ^ seed_u).view(np.int64)
+    return (final % np.int64(num_out)).astype(np.int32)
 
 
 @dataclass
@@ -229,6 +320,7 @@ class _Analyzer:
         self.report = AnalysisReport()
         self.predicted = Counter()
         self._fusion_on = bool(conf.get(FUSION_ENABLED))
+        self._fusion_exchange = bool(conf.get(FUSION_EXCHANGE))
         self._min_rows = int(conf.get(FUSION_MIN_ROWS))
         self._dense_keys = bool(conf.get(FUSION_DENSE_KEYS))
         self._tile = int(conf.get(BATCH_CAPACITY))
@@ -256,7 +348,8 @@ class _Analyzer:
                                      "join_probe", "fused_probe",
                                      "djoin_probe", "fused_djoin_probe",
                                      "shuffle_pids", "shuffle_hash",
-                                     "sample"))
+                                     "shuffle_rr", "shuffle_range",
+                                     "fused_shuffle", "sample"))
             if per_batch and batches:
                 lpb = round(per_batch / batches, 2)
         detail = node.simple_string() if hasattr(node, "simple_string") \
@@ -378,11 +471,21 @@ class _Analyzer:
                 or [_Batch(0, _EMPTY_CAP, False)]
             parts.append(batches)
         trace = None
-        if node.num_partitions == 1 and 0 < total <= _TRACE_MAX_ROWS:
+        ptraces = None
+        if 0 < total <= _TRACE_MAX_ROWS:
             vals = node.start + np.arange(total, dtype=np.int64) * step
-            trace = _Trace({node.attr.expr_id: (vals, None)},
-                           np.ones(total, bool))
-        flow = _Flow(parts, trace)
+            if node.num_partitions == 1:
+                trace = _Trace({node.attr.expr_id: (vals, None)},
+                               np.ones(total, bool))
+            else:
+                ptraces = []
+                for q in range(node.num_partitions):
+                    lo = min(q * per, total)
+                    hi = min(lo + per, total)
+                    ptraces.append(_Trace(
+                        {node.attr.expr_id: (vals[lo:hi], None)},
+                        np.ones(hi - lo, bool)))
+        flow = _Flow(parts, trace, ptraces=ptraces)
         self._stage(node, Counter(), flow.total_batches, [])
         return flow
 
@@ -412,12 +515,21 @@ class _Analyzer:
                     cols[o.expr_id] = trace.cols[o.child.expr_id]
         return _Trace(cols, live, trace.consecutive)
 
+    def _project_ptraces(self, child: _Flow, filters, outputs):
+        if child.ptraces is None:
+            return None
+        return [None if t is None
+                else self._project_trace(t, filters, outputs)
+                for t in child.ptraces]
+
     def _compute(self, node) -> _Flow:
         child = self.visit(node.child)
         kinds = Counter()
         if self._compute_trivial(node):
             trace = self._project_trace(child.trace, [], node.outputs)
-            flow = _Flow(child.parts, trace, counted=child.counted)
+            flow = _Flow(child.parts, trace, counted=child.counted,
+                         ptraces=self._project_ptraces(child, [],
+                                                       node.outputs))
             self._stage(node, kinds, child.total_batches
                         if child.counted else None,
                         ["pure column selection: shares child arrays, "
@@ -431,20 +543,49 @@ class _Analyzer:
         parts = [[_Batch(b.rows, b.cap, False) for b in p]
                  for p in child.parts]
         trace = self._project_trace(child.trace, node.filters, node.outputs)
-        flow = _Flow(parts, trace, counted=child.counted)
+        flow = _Flow(parts, trace, counted=child.counted,
+                     ptraces=self._project_ptraces(child, node.filters,
+                                                   node.outputs))
         self._stage(node, kinds, child.total_batches if child.counted
                     else None, [])
         return flow
 
     # -- aggregation -------------------------------------------------------
+    def _key_group_info(self, trace, key_id):
+        """(sorted unique valid key values, any-null-keys-live) or None."""
+        if trace is None:
+            return None
+        ent = trace.cols.get(key_id)
+        if ent is None:
+            return None
+        vals, valid = ent
+        m = trace.live if valid is None else (trace.live & valid)
+        nulls_live = bool(valid is not None and (trace.live & ~valid).any())
+        return np.unique(vals[m]), nulls_live
+
+    @staticmethod
+    def _agg_out_trace(key_id, uniq, nulls_live) -> _Trace:
+        """Aggregate output key trace: live groups in kernel order —
+        valid keys ascending (dense iota scatter and sorted-segment both
+        emit them sorted), the null-key group last when present."""
+        if nulls_live:
+            vals = np.append(uniq, 0)
+            valid = np.append(np.ones(len(uniq), bool), False)
+        else:
+            vals, valid = uniq, None
+        return _Trace({key_id: (vals, valid)}, np.ones(len(vals), bool))
+
     def _agg_chunk_kinds(self, node, batches, trace, kinds: Counter,
                          notes: list):
         """Mirror HashAggregateExec._aggregate_chunk over one partition's
         batch list: concat (no launch) + one aggregation kernel, with the
-        dense-range scalar probe when the decision is not memoized."""
+        dense-range scalar probe when the decision is neither memoized
+        (stable scan arrays) nor pre-seeded (shuffle-read tiles carry
+        map-side stats). Returns the chunk's (output _Batch, output key
+        _Trace|None) so downstream stages keep predicting exactly."""
         vals = node._plan_values()
         has_pc = any(op in ("percentile", "collect") for op, _, _ in vals)
-        fresh = len(batches) > 1 or any(not b.stable for b in batches)
+        probe = len(batches) > 1 or any(not b.probe_free for b in batches)
         caps = [b.cap for b in batches]
         cap = bucket_capacity(sum(caps)) if all(
             c is not None for c in caps) and caps else None
@@ -454,20 +595,28 @@ class _Analyzer:
             for op, _, _ in vals:
                 if op == "percentile":
                     kinds["uperc"] += 1
-            return
+            return _Batch(1, 8, False), None
 
         single_int_key = len(node.grouping) == 1 and isinstance(
             node.grouping[0].dtype, (IntegralType, DateType))
         dense = False
+        ginfo = None
+        span = None
         if single_int_key and not has_pc:
-            kinds["krange3"] += 1 if fresh else 0
-            if not fresh:
-                notes.append("dense-range scalars memoized on stable scan "
-                             "arrays — no krange3 probe per run")
-            st = trace.stats(node.grouping[0].expr_id) if trace else None
-            if st is not None and cap is not None:
-                if st.size:
-                    span = int(st.max()) - int(st.min()) + 1
+            kinds["krange3"] += 1 if probe else 0
+            if not probe:
+                if any(b.seeded for b in batches):
+                    notes.append("dense-range scalars pre-seeded from "
+                                 "map-side shuffle stats — no krange3 "
+                                 "probe even on fresh arrays")
+                else:
+                    notes.append("dense-range scalars memoized on stable "
+                                 "scan arrays — no krange3 probe per run")
+            ginfo = self._key_group_info(trace, node.grouping[0].expr_id)
+            if ginfo is not None and cap is not None:
+                uniq, _nulls = ginfo
+                if uniq.size:
+                    span = int(uniq.max()) - int(uniq.min()) + 1
                     dense = span + 1 <= min(4 * cap, _DENSE_AGG_LIMIT)
             else:
                 self._approx("dense-scatter vs sorted-segment aggregation "
@@ -488,28 +637,89 @@ class _Analyzer:
         if has_pc:
             self._sync("percentile/collect aggregates build results "
                        "host-side (per-group host loop)")
+        # output layout: exact only for the traced single-int-key case
+        if single_int_key and not has_pc and ginfo is not None \
+                and cap is not None:
+            uniq, nulls_live = ginfo
+            rows = int(uniq.size) + (1 if nulls_live else 0)
+            out_cap = bucket_capacity(span + 1) if dense else cap
+            return (_Batch(rows, out_cap, False),
+                    self._agg_out_trace(node.grouping[0].expr_id, uniq,
+                                        nulls_live))
+        return _Batch(None, None, False), None
+
+    def _merge_group_traces(self, traces: list) -> Optional[_Trace]:
+        """Concatenate compacted per-partition traces (coalesced groups:
+        partition batch lists concatenate in order)."""
+        if any(t is None for t in traces):
+            return None
+        comp = [t.compacted() for t in traces]
+        ids = set(comp[0].cols)
+        for t in comp[1:]:
+            ids &= set(t.cols)
+        if not ids:
+            return None
+        cols = {}
+        for k in ids:
+            vals = np.concatenate([t.cols[k][0] for t in comp])
+            vs = [t.cols[k][1] for t in comp]
+            valid = None
+            if any(v is not None for v in vs):
+                valid = np.concatenate(
+                    [np.ones(len(t.live), bool) if v is None else v
+                     for t, v in zip(comp, vs)])
+            cols[k] = (vals, valid)
+        n = sum(len(t.live) for t in comp)
+        return _Trace(cols, np.ones(n, bool),
+                      all(t.consecutive for t in traces))
 
     def _agg(self, node) -> _Flow:
+        from ..physical.adaptive import plan_merge_groups, _row_width
         from ..physical.exchange import ShuffleExchangeExec
 
         child = self.visit(node.child)
         parts = child.parts
+        ptraces = [child.part_trace(i) for i in range(len(parts))]
         notes = []
         if node.mode == "final" and isinstance(node.child,
                                                ShuffleExchangeExec) \
                 and len(parts) > 1 \
                 and self.conf.get(ADAPTIVE_ENABLED) \
                 and self.conf.get(COALESCE_PARTITIONS_ENABLED):
-            # AQE coalescing merges undersized reducer outputs; assume one
-            # merged group (row-count dependent)
-            parts = [[b for p in parts for b in p]]
-            notes.append("AQE coalescing assumed to merge all reducer "
-                         "outputs into one partition")
-            self._approx("AQE partition coalescing before the final "
-                         "aggregate depends on runtime row counts")
+            sizes = [sum(b.rows for b in p) if all(b.rows is not None
+                                                   for b in p) else None
+                     for p in parts]
+            if all(s is not None for s in sizes):
+                # exact mirror of adaptive.coalesce_after_exchange: the
+                # exchange value model knows per-reducer rows, so the
+                # merge plan is deterministic
+                if sum(sizes) == 0:
+                    groups = [list(range(len(parts)))]
+                else:
+                    advisory = int(self.conf.get(ADVISORY_PARTITION_BYTES)) \
+                        // _row_width(node.child.output)
+                    groups = plan_merge_groups(sizes, advisory)
+                if len(groups) != len(parts):
+                    parts = [[b for i in g for b in parts[i]]
+                             for g in groups]
+                    ptraces = [self._merge_group_traces(
+                        [ptraces[i] for i in g]) for g in groups]
+                    notes.append(f"AQE coalescing merges reducer outputs "
+                                 f"into {len(parts)} partition(s) "
+                                 "(exact: reducer rows traced)")
+            else:
+                # AQE coalescing merges undersized reducer outputs;
+                # assume one merged group (row-count dependent)
+                parts = [[b for p in parts for b in p]]
+                ptraces = [None]
+                notes.append("AQE coalescing assumed to merge all reducer "
+                             "outputs into one partition")
+                self._approx("AQE partition coalescing before the final "
+                             "aggregate depends on runtime row counts")
         kinds = Counter()
         max_rows = int(self.conf.get(AGG_BLOCK_ROWS))
-        for p in parts:
+        out_parts, out_traces = [], []
+        for p, pt in zip(parts, ptraces):
             caps = [b.cap for b in p]
             known = all(c is not None for c in caps)
             blockwise = known and len(p) > 1 and sum(caps) > max_rows \
@@ -524,23 +734,26 @@ class _Analyzer:
                     chunk.append(b)
                     cs += b.cap
                     if cs >= max_rows:
-                        self._agg_chunk_kinds(node, chunk, child.trace,
-                                              kinds, notes)
+                        self._agg_chunk_kinds(node, chunk, pt, kinds,
+                                              notes)
                         chunk, cs = [], 0
                         acc += 1
                 if chunk:
-                    self._agg_chunk_kinds(node, chunk, child.trace, kinds,
-                                          notes)
+                    self._agg_chunk_kinds(node, chunk, pt, kinds, notes)
                     acc += 1
                 merged = [_Batch(None, None, False)] * acc
                 self._agg_chunk_kinds(node, merged, None, kinds, notes)
                 notes.append(f"blockwise fold: {acc} chunks + merge")
+                out_parts.append([_Batch(None, None, False)])
+                out_traces.append(None)
             else:
-                self._agg_chunk_kinds(node, p, child.trace, kinds, notes)
-        out_parts = [[_Batch(None, None, False)] for _ in parts]
+                ob, ot = self._agg_chunk_kinds(node, p, pt, kinds, notes)
+                out_parts.append([ob])
+                out_traces.append(ot)
         self._stage(node, kinds, child.total_batches if child.counted
                     else None, notes)
-        return _Flow(out_parts, None, counted=child.counted)
+        return _Flow(out_parts, None, counted=child.counted,
+                     ptraces=out_traces)
 
     def _fused_agg(self, node) -> _Flow:
         child = self.visit(node.child)
@@ -552,14 +765,22 @@ class _Analyzer:
             isinstance(o, AttributeReference)
             and o.expr_id == node.grouping[0].expr_id
             for o in node.pipe_outputs)
-        pipe_trace = self._project_trace(child.trace, node.filters,
-                                         node.pipe_outputs)
-        key_span = None
-        if single_int_key and pipe_trace is not None:
-            st = pipe_trace.stats(node.grouping[0].expr_id)
-            if st is not None and st.size:
-                key_span = int(st.max()) - int(st.min()) + 1
-        for p in child.parts:
+        out_parts, out_traces = [], []
+        for i, p in enumerate(child.parts):
+            in_trace = child.part_trace(i)
+            pipe_trace = self._project_trace(in_trace, node.filters,
+                                             node.pipe_outputs)
+            # the fused dense decision reads the memoized/seeded range of
+            # the INPUT column — a PRE-filter superset (fusion.py
+            # _dense_decision) — while the unfused gate branch probes the
+            # materialized post-filter pipeline output
+            pre_trace = self._project_trace(in_trace, [],
+                                            node.pipe_outputs)
+            key_span = None
+            if single_int_key and pre_trace is not None:
+                st = pre_trace.stats(node.grouping[0].expr_id)
+                if st is not None and st.size:
+                    key_span = int(st.max()) - int(st.min()) + 1
             caps = [b.cap for b in p]
             known = all(c is not None for c in caps)
             if not known:
@@ -571,20 +792,26 @@ class _Analyzer:
             if known_sum is not None and known_sum < self._min_rows:
                 # runtime size gate: unfused operator-at-a-time kernels
                 kinds["pipeline"] += len(p)
-                self._agg_chunk_kinds(node, [
+                ob, ot = self._agg_chunk_kinds(node, [
                     _Batch(b.rows, b.cap, False) for b in p],
                     pipe_trace, kinds, notes)
                 notes.append(
                     f"partition under spark.tpu.fusion.minRows="
                     f"{self._min_rows}: shared unfused kernels at runtime")
+                out_parts.append([ob])
+                out_traces.append(ot)
                 continue
             kinds["fused_agg"] += len(p)
             if key_passthrough and self._dense_keys:
-                fresh_in = sum(1 for b in p if not b.stable)
+                fresh_in = sum(1 for b in p if not b.probe_free)
                 kinds["krange3"] += fresh_in
                 if fresh_in == 0:
-                    notes.append("dense-range decision memoized per stable "
+                    notes.append("dense-range decision memoized/seeded per "
                                  "input column (no per-run host sync)")
+            dense = key_passthrough and self._dense_keys \
+                and key_span is not None \
+                and all(c is not None for c in caps) and caps \
+                and key_span + 1 <= min(4 * min(caps), _DENSE_AGG_LIMIT)
             if len(p) > 1:
                 # per-batch partials merge with final-mode ops; the partial
                 # output capacity mirrors the fused kernel variant
@@ -600,17 +827,39 @@ class _Analyzer:
                     else:
                         pcaps.append(b.cap)
                 merge = HashAggMergeProxy(node)
-                self._agg_chunk_kinds(
+                ob, ot = self._agg_chunk_kinds(
                     merge, [_Batch(None, c, False) for c in pcaps],
                     pipe_trace, kinds, notes)
                 notes.append(f"{len(p)} per-batch partials merge with "
                              "final-mode ops")
+                out_parts.append([ob])
+                out_traces.append(ot)
+                continue
+            # single fused batch: the kernel output IS the partition output
+            if not node.grouping:
+                out_parts.append([_Batch(1, 8, False)])
+                out_traces.append(None)
+                continue
+            ginfo = self._key_group_info(pipe_trace,
+                                         node.grouping[0].expr_id) \
+                if single_int_key else None
+            if ginfo is not None and caps and caps[0] is not None:
+                uniq, nulls_live = ginfo
+                rows = int(uniq.size) + (1 if nulls_live else 0)
+                out_cap = bucket_capacity(key_span + 1) if dense \
+                    else caps[0]
+                out_parts.append([_Batch(rows, out_cap, False)])
+                out_traces.append(self._agg_out_trace(
+                    node.grouping[0].expr_id, uniq, nulls_live))
+            else:
+                out_parts.append([_Batch(None, None, False)])
+                out_traces.append(None)
         self._stage(node, kinds, child.total_batches if child.counted
                     else None,
                     ["FUSED stage: filter/project traced into the partial-"
                      "aggregate kernel — 1 launch/batch"] + notes)
-        out_parts = [[_Batch(None, None, False)] for _ in child.parts]
-        return _Flow(out_parts, None, counted=child.counted)
+        return _Flow(out_parts, None, counted=child.counted,
+                     ptraces=out_traces)
 
     # -- limit / sort ------------------------------------------------------
     def _limit(self, node) -> _Flow:
@@ -743,11 +992,13 @@ class _Analyzer:
             filters, outputs = node.probe_fusion
             probe_trace = self._project_trace(left.trace, filters, outputs)
 
+        out_parts = []
+        out_traces = []
         for lp, rp in pairs:
             bcaps = [b.cap for b in rp]
             bknown = all(c is not None for c in bcaps) and rp
             bcap = bucket_capacity(sum(bcaps)) if bknown else None
-            bfresh = (len(rp) != 1) or any(not b.stable for b in rp)
+            bfresh = (len(rp) != 1) or any(not b.probe_free for b in rp)
             grace = False
             if bknown:
                 budget = self._join_budget(node)
@@ -757,6 +1008,8 @@ class _Analyzer:
                 self._approx("grace hash join fragments both sides by key "
                              "hash — fragment kernels are data-dependent")
                 notes.append("build side over device budget: grace join")
+                out_parts.append([_Batch(None, None, False)])
+                out_traces.append(None)
                 continue
             pair_fused = fused
             if pair_fused:
@@ -813,16 +1066,55 @@ class _Analyzer:
                              "device ops (uncached, uncounted dispatches)")
                 self._hazard("full_outer unmatched-build pass bypasses the "
                              "KernelCache (eager per-run dispatches)")
-        out_parts = []
-        for lp, _ in pairs:
-            nb = max(len(lp), 1) + (1 if node.join_type == "full_outer"
-                                    else 0)
-            out_parts.append([_Batch(None, None, False)
-                              for _ in range(nb)])
+            ob, ot = self._join_output(node, lp, dense, bstats,
+                                       probe_trace)
+            out_parts.append(ob)
+            out_traces.append(ot)
         self._stage(node, kinds, left.total_batches if left.counted
                     else None, notes)
         return _Flow(out_parts, None,
-                     counted=left.counted and right.counted)
+                     counted=left.counted and right.counted,
+                     ptraces=out_traces)
+
+    def _join_output(self, node, lp, dense, bstats, probe_trace):
+        """Per-pair output layout + value trace through the join. Exact
+        for the dense inner case (unique integral build keys: the probe is
+        a 1:1 gather in probe-row order); everything else keeps the
+        unknown layout the earlier model reported."""
+        nb = max(len(lp), 1) + (1 if node.join_type == "full_outer" else 0)
+        unknown = ([_Batch(None, None, False) for _ in range(nb)], None)
+        if not (dense and node.join_type == "inner" and lp
+                and probe_trace is not None and probe_trace.consecutive
+                and bstats is not None and len(node.left_keys) == 1):
+            return unknown
+        ent = probe_trace.cols.get(node.left_keys[0].expr_id)
+        if ent is None:
+            return unknown
+        vals, valid = ent
+        bvals = np.unique(bstats)
+        live = probe_trace.live if valid is None \
+            else (probe_trace.live & valid)
+        matched_mask = live & np.isin(vals, bvals)
+        out_batches = []
+        row0 = 0
+        ok = True
+        for b in lp:
+            width = b.rows if b.rows is not None else b.cap
+            if width is None or b.cap is None:
+                ok = False
+                break
+            lo, hi = row0, min(row0 + width, len(vals))
+            out_batches.append(
+                _Batch(int(matched_mask[lo:hi].sum()), b.cap, False))
+            row0 += width
+        if not ok:
+            return unknown
+        # probe-side columns pass through row-for-row where matched
+        sel = np.nonzero(matched_mask)[0]
+        cols = {k: (v[sel], None if vv is None else vv[sel])
+                for k, (v, vv) in probe_trace.cols.items()}
+        return (out_batches,
+                _Trace(cols, np.ones(len(sel), bool), True))
 
     def _build_key_counts(self, bstats):
         if bstats is None or bstats.size == 0:
@@ -939,6 +1231,100 @@ class _Analyzer:
         except Exception:
             return False
 
+    # -- exchange layout/value helpers -------------------------------------
+    def _built_partition(self, rows_p: int) -> list:
+        """Output tiles of one reduce partition as exec/shuffle._OutBuffer
+        builds them: tile rows capped at spark.tpu.batch.capacity,
+        power-of-two capacity per tile, every tile pre-seeded with the
+        map-side column stats (fresh arrays, no krange3 probe)."""
+        if rows_p == 0:
+            return [_Batch(0, _EMPTY_CAP, False, seeded=True)]
+        out = []
+        for start in range(0, rows_p, self._tile):
+            n = min(self._tile, rows_p - start)
+            out.append(_Batch(n, bucket_capacity(n), False, seeded=True))
+        return out
+
+    def _exchange_input_traces(self, node, child: _Flow,
+                               fused: bool) -> Optional[list]:
+        """Per-input-partition traces at the exchange's consumption level
+        (the pipeline OUTPUT when the map side is fused)."""
+        traces = child.all_part_traces()
+        if traces is None:
+            return None
+        if fused:
+            filters, outputs = node.pipe_fusion
+            traces = [self._project_trace(t, filters, outputs)
+                      for t in traces]
+            if any(t is None for t in traces):
+                return None
+        if not all(t.consecutive for t in traces):
+            return None
+        return traces
+
+    def _shuffled_flow(self, in_traces: list, pids_per_part: list,
+                       num_out: int) -> _Flow:
+        """Exact post-shuffle layout + per-reduce-partition value traces:
+        reduce partition q = every input partition's live rows with
+        pid == q, input order preserved (the stable pid sort groups rows
+        without reordering within a pid)."""
+        comp = [t.compacted() for t in in_traces]
+        ids = set(comp[0].cols) if comp else set()
+        for t in comp[1:]:
+            ids &= set(t.cols)
+        parts, ptraces = [], []
+        for q in range(num_out):
+            sels = [np.nonzero(pids == q)[0] for pids in pids_per_part]
+            rows_q = int(sum(len(s) for s in sels))
+            parts.append(self._built_partition(rows_q))
+            cols_q = {}
+            for k in ids:
+                vals = np.concatenate(
+                    [t.cols[k][0][s] for t, s in zip(comp, sels)])
+                vs = [t.cols[k][1] for t in comp]
+                valid = None
+                if any(v is not None for v in vs):
+                    valid = np.concatenate(
+                        [np.ones(len(s), bool) if v is None else v[s]
+                         for v, s in zip(vs, sels)])
+                cols_q[k] = (vals, valid)
+            ptraces.append(_Trace(cols_q, np.ones(rows_q, bool), True))
+        return _Flow(parts, None, counted=True, ptraces=ptraces)
+
+    def _map_side_kinds(self, node, child: _Flow, fused: bool,
+                        plain_kind: str, kinds: Counter, notes: list):
+        """Map-side launch model: fused exchanges run ONE fused_shuffle
+        dispatch per batch (partitions under minRows fall back to the
+        shared pipeline + shuffle kernels); unfused exchanges run the
+        plain shuffle kind per batch."""
+        if not fused:
+            if child.counted:
+                kinds[plain_kind] += child.total_batches
+            else:
+                self._approx("host shuffle launches depend on unknown "
+                             "upstream batch count")
+            return
+        gated = False
+        for pp in child.parts:
+            caps = [b.cap for b in pp]
+            if not all(c is not None for c in caps):
+                self._approx("fusion minRows gate undecidable for the "
+                             "fused exchange (unknown tile capacities)")
+                kinds["fused_shuffle"] += len(pp)
+            elif sum(caps) < self._min_rows:
+                kinds["pipeline"] += len(pp)
+                kinds[plain_kind] += len(pp)
+                gated = True
+            else:
+                kinds["fused_shuffle"] += len(pp)
+        notes.append("FUSED map side: pipeline + partition-id kernel "
+                     "traced into ONE program per batch; shuffle writes "
+                     "consume the grouped result directly")
+        if gated:
+            notes.append(f"map partition under spark.tpu.fusion.minRows="
+                         f"{self._min_rows}: pipeline + shared shuffle "
+                         "kernels at runtime")
+
     def _exchange(self, node) -> _Flow:
         from ..physical.partitioning import (
             HashPartitioning, RangePartitioning, SinglePartition,
@@ -949,6 +1335,7 @@ class _Analyzer:
         p = node.partitioning
         kinds = Counter()
         notes = []
+        fused = getattr(node, "pipe_fusion", None) is not None
         if isinstance(p, SinglePartition):
             merged = [b for part in child.parts for b in part]
             self._stage(node, kinds, child.total_batches if child.counted
@@ -956,6 +1343,13 @@ class _Analyzer:
             return _Flow([merged], child.trace, counted=child.counted)
         if isinstance(p, HashPartitioning):
             if self._mesh_active(p.num_partitions):
+                if fused and child.counted:
+                    # mesh all-to-all consumes materialized batches: the
+                    # pipeline runs unfused first
+                    kinds["pipeline"] += child.total_batches
+                    notes.append("mesh fallback: fused map side "
+                                 "materializes the pipeline before the "
+                                 "all-to-all")
                 kinds["mesh_exchange"] += 1
                 notes.append("mesh all-to-all: ONE program for the whole "
                              "redistribution")
@@ -970,34 +1364,51 @@ class _Analyzer:
                 self._stage(node, kinds, child.total_batches
                             if child.counted else None, notes)
                 return _Flow(out, None, counted=True)
-            kind = self._host_shuffle_kind()
-            if child.counted:
-                kinds[kind] = child.total_batches
-            else:
-                self._approx("host shuffle launches depend on unknown "
-                             "upstream batch count")
+            self._map_side_kinds(node, child, fused,
+                                 self._host_shuffle_kind(), kinds, notes)
             self._sync("host sort-shuffle pulls grouped columns to host "
                        "once per batch (by design: the DCN path)")
-            out = [[_Batch(None, None, False)]
-                   for _ in range(p.num_partitions)]
+            flow = None
+            in_traces = self._exchange_input_traces(node, child, fused)
+            key_ids = [e.expr_id for e in p.exprs
+                       if isinstance(e, AttributeReference)]
+            if in_traces is not None and len(key_ids) == len(p.exprs) \
+                    and all(k in t.cols for t in in_traces
+                            for k in key_ids):
+                pids_per_part = []
+                for t in in_traces:
+                    tc = t.compacted()
+                    pids_per_part.append(_np_hash_pids(
+                        [tc.cols[k] for k in key_ids], p.num_partitions))
+                flow = self._shuffled_flow(in_traces, pids_per_part,
+                                           p.num_partitions)
+                notes.append("reduce layout EXACT: host-side splitmix64 "
+                             "of the traced keys decides per-reducer rows")
+            if flow is None:
+                self._approx("hash exchange reduce layout untraced (key "
+                             "values unknown): downstream counts are "
+                             "approximate")
+                flow = _Flow([[_Batch(None, None, False, seeded=True)]
+                              for _ in range(p.num_partitions)], None,
+                             counted=False)
             self._stage(node, kinds, child.total_batches if child.counted
                         else None, notes)
-            return _Flow(out, None, counted=False)
+            return flow
         if isinstance(p, RangePartitioning):
-            if child.counted:
-                kinds["shuffle_range"] = child.total_batches
+            self._map_side_kinds(node, child, fused, "shuffle_range",
+                                 kinds, notes)
             self._approx("range exchange: sampled bounds may collapse to a "
                          "single gather (data-dependent)")
             self._sync("range-bound sampling reads per-batch samples "
                        "host-side (memoized per column identity)")
-            out = [[_Batch(None, None, False)]
+            out = [[_Batch(None, None, False, seeded=True)]
                    for _ in range(p.num_partitions)]
             self._stage(node, kinds, child.total_batches if child.counted
                         else None, notes)
             return _Flow(out, None, counted=False)
         if isinstance(p, UnknownPartitioning):
-            if child.counted:
-                kinds["shuffle_rr"] = child.total_batches
+            self._map_side_kinds(node, child, fused, "shuffle_rr", kinds,
+                                 notes)
             # the running row offset rides as a kernel argument, so the
             # cache key is (capacity, num_out)-shaped — no recompile
             # hazard (the historical storm keyed by start % num_out;
@@ -1005,11 +1416,28 @@ class _Analyzer:
             notes.append("round-robin start offset rides as a kernel "
                          "argument: one compile per capacity bucket, "
                          "1 launch/batch")
-            out = [[_Batch(None, None, False)]
-                   for _ in range(p.num_partitions)]
+            flow = None
+            in_traces = self._exchange_input_traces(node, child, fused)
+            if in_traces is not None:
+                offset = 0
+                pids_per_part = []
+                for t in in_traces:
+                    n = int(t.live.sum())
+                    pids_per_part.append(
+                        ((np.arange(n) + offset) % p.num_partitions)
+                        .astype(np.int32))
+                    offset += n
+                flow = self._shuffled_flow(in_traces, pids_per_part,
+                                           p.num_partitions)
+                notes.append("reduce layout EXACT: round-robin over the "
+                             "traced live-row order")
+            if flow is None:
+                flow = _Flow([[_Batch(None, None, False, seeded=True)]
+                              for _ in range(p.num_partitions)], None,
+                             counted=False)
             self._stage(node, kinds, child.total_batches if child.counted
                         else None, notes)
-            return _Flow(out, None, counted=False)
+            return flow
         self._approx(f"exchange over {type(p).__name__} not modeled")
         return _Flow([[_Batch(None, None, False)]], None, counted=False)
 
@@ -1073,6 +1501,7 @@ class _Analyzer:
     # -- fusion boundary explanations -------------------------------------
     def _explain_boundaries(self, plan):
         from ..physical import operators as O
+        from ..physical.exchange import ShuffleExchangeExec
         from ..physical.fusion import (
             FusedAggregateExec, FusedLimitExec, _compute_nontrivial,
         )
@@ -1093,6 +1522,18 @@ class _Analyzer:
                            f"traced into the partial-agg kernel; {gate}")
             elif isinstance(node, FusedLimitExec):
                 out.append(f"FUSED {node.simple_string()[:80]}; {gate}")
+            elif isinstance(node, ShuffleExchangeExec):
+                if getattr(node, "pipe_fusion", None) is not None:
+                    out.append(f"FUSED map side "
+                               f"{node.simple_string()[:80]}: partition-id "
+                               f"kernel traced into the pipeline; {gate}")
+                else:
+                    reasons = self._exchange_boundary_reasons(node, O)
+                    if reasons:
+                        out.append(
+                            f"UNFUSED exchange "
+                            f"{node.simple_string()[:80]}: "
+                            + "; ".join(reasons))
             elif isinstance(node, O.HashJoinExec) \
                     and node.probe_fusion is not None:
                 out.append(f"FUSED probe {node.simple_string()[:80]}; "
@@ -1151,12 +1592,60 @@ class _Analyzer:
         for op, attr, _ in node._plan_values():
             if op not in FUSABLE_OPS:
                 reasons.append(f"op {op} has no fused kernel")
-            elif op in ("min", "max") and attr is not None and \
-                    dict_encoded(attr.dtype):
-                reasons.append("string min/max reduces in rank space and "
-                               "needs the host inverse-rank map (ROADMAP "
-                               "item)")
+            # string min/max no longer breaks fusion: the fused kernel
+            # reduces in rank space with the inverse-rank lut as an aux
+            # input
         return reasons or ["not rewritten (unexpected: report this plan)"]
+
+    def _exchange_boundary_reasons(self, node, O) -> list:
+        """Why a shuffle exchange over a nontrivial pipeline did NOT fuse
+        its map side (mirrors fusion._exchange_fusable)."""
+        from ..physical.fusion import (
+            _compute_nontrivial, _range_sample_source,
+        )
+        from ..physical.partitioning import (
+            HashPartitioning, RangePartitioning, SinglePartition,
+            UnknownPartitioning,
+        )
+
+        if not self._fusion_on:
+            return []
+        c = node.child
+        if not isinstance(c, O.ComputeExec) or not _compute_nontrivial(c):
+            return []
+        p = node.partitioning
+        if isinstance(p, SinglePartition):
+            return []  # gather launches no partition kernel — nothing lost
+        if not self._fusion_exchange:
+            return ["exchange map-side fusion disabled "
+                    "(spark.tpu.fusion.exchange=false)"]
+        out_by_id = {a.expr_id: a for a in c.output}
+        if isinstance(p, HashPartitioning):
+            for e in p.exprs:
+                a = out_by_id.get(getattr(e, "expr_id", -1))
+                if a is not None and (isinstance(a.dtype, StringType)
+                                      or dict_encoded(a.dtype)):
+                    return [f"partition key {a.name} is a dictionary-"
+                            "encoded string: eq-keys ride host-side "
+                            "dictionary hashes"]
+            return ["not rewritten (unexpected: report this plan)"]
+        if isinstance(p, RangePartitioning):
+            if len(p.orders) != 1:
+                return ["multi-key range partitioning is not fused"]
+            oc = p.orders[0].child
+            a = out_by_id.get(getattr(oc, "expr_id", -1))
+            if a is not None and (isinstance(a.dtype, StringType)
+                                  or dict_encoded(a.dtype)):
+                return [f"range key {a.name} is a dictionary-encoded "
+                        "string: pids ride a host rank→pid lut"]
+            if isinstance(oc, AttributeReference) \
+                    and _range_sample_source(c, oc) is None:
+                return ["range sort key is computed by the pipeline: "
+                        "bound sampling needs a pass-through input column"]
+            return ["not rewritten (unexpected: report this plan)"]
+        if isinstance(p, UnknownPartitioning):
+            return ["not rewritten (unexpected: report this plan)"]
+        return []
 
     def _join_boundary_reasons(self, node, O, _compute_nontrivial):
         if not self._fusion_on:

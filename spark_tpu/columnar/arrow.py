@@ -95,7 +95,8 @@ def _chunked_to_numpy(arr: pa.ChunkedArray | pa.Array, dt: DataType):
 def record_batch_to_columnar(rb: pa.RecordBatch | pa.Table,
                              schema: StructType | None = None,
                              capacity: int | None = None,
-                             num_rows: int | None = None) -> ColumnarBatch:
+                             num_rows: int | None = None,
+                             seed_ranges: dict | None = None) -> ColumnarBatch:
     import jax.numpy as jnp
 
     if schema is None:
@@ -117,14 +118,21 @@ def record_batch_to_columnar(rb: pa.RecordBatch | pa.Table,
         col = Column(f.dataType, jnp.asarray(pad), v, sd)
         # key range from the HOST copy while we still have it: the dense
         # aggregate/join fast-path decision then never needs a device→host
-        # sync (transfer-bound transports degrade permanently after one)
+        # sync (transfer-bound transports degrade permanently after one).
+        # `seed_ranges` are precomputed upstream stats (shuffle reads: the
+        # map side shipped them with the MapStatus) — possibly a SUPERSET
+        # of this batch's range, which the dense decision handles soundly
+        # and which keeps local and cluster decisions identical.
         if pad.dtype.kind == "i" and sd is None:
-            live = data[:cap] if validity is None \
-                else data[:cap][validity[:cap]]
-            if len(live):
-                ranges[i] = (int(live.min()), int(live.max()), True)
+            if seed_ranges is not None and i in seed_ranges:
+                ranges[i] = tuple(seed_ranges[i])
             else:
-                ranges[i] = (0, 0, False)
+                live = data[:cap] if validity is None \
+                    else data[:cap][validity[:cap]]
+                if len(live):
+                    ranges[i] = (int(live.min()), int(live.max()), True)
+                else:
+                    ranges[i] = (0, 0, False)
         cols.append(col)
     mask = np.zeros(cap, dtype=bool)
     mask[:n] = True

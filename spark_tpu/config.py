@@ -184,6 +184,16 @@ FUSION_DENSE_KEYS = _register(ConfigEntry(
     "scatter path when the grouping key is a pass-through integral column "
     "whose (memoized) range fits a capacity bucket.", _bool))
 
+FUSION_EXCHANGE = _register(ConfigEntry(
+    "spark.tpu.fusion.exchange", True,
+    "Exchange map-side fusion: a stage whose terminal is a shuffle "
+    "exchange traces its filter/project pipeline AND the partition-id "
+    "computation (hash/range/round-robin) into ONE jitted kernel per map "
+    "batch that emits the pid-grouped pipeline output; shuffle writes "
+    "consume it directly — no intermediate materialized batch, <=1 "
+    "dispatch per map batch. Requires spark.tpu.fusion.enabled; subject "
+    "to the spark.tpu.fusion.minRows size gate.", _bool))
+
 CODEGEN_CACHE_SIZE = _register(ConfigEntry(
     "spark.tpu.kernel.cacheSize", 1024,
     "Max entries in the jitted-kernel cache (role of the reference's "

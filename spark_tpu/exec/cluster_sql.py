@@ -49,13 +49,14 @@ def _partitions_to_ipc(parts):
     return out
 
 
-def _ipc_to_partition(tabs, schema):
+def _ipc_to_partition(tabs, schema, seed_ranges=None):
     import pyarrow as pa
 
     from ..columnar.arrow import record_batch_to_columnar
 
     return [record_batch_to_columnar(
-        pa.ipc.open_stream(pa.BufferReader(raw)).read_all(), schema)
+        pa.ipc.open_stream(pa.BufferReader(raw)).read_all(), schema,
+        seed_ranges=seed_ranges)
         for raw in tabs]
 
 
@@ -80,12 +81,16 @@ class FetchExec(PhysicalPlan):
     map tasks."""
 
     child_fields = ()
+    # adaptive.coalesce_after_exchange treats this leaf as the shuffle
+    # it stands in for: cluster reduce stages coalesce like local runs
+    is_shuffle_read = True
 
     def __init__(self, attrs, shuffle_id: str, maps: list,
                  authkey_hex: str, num_partitions: int,
                  fallback_addr: str | None = None,
                  merge: tuple | None = None,
-                 part_indices: list | None = None):
+                 part_indices: list | None = None,
+                 col_stats: dict | None = None):
         self.attrs = list(attrs)
         self.shuffle_id = shuffle_id
         self.maps = list(maps)              # [(map_id, block_addr), ...]
@@ -94,6 +99,10 @@ class FetchExec(PhysicalPlan):
         self.fallback_addr = fallback_addr  # external shuffle service
         self.merge = merge       # (service_addr, {rid: (map ids merged)})
         self.part_indices = part_indices
+        # {rid: {col_idx: (kmin, kmax, any)}} merged across map tasks —
+        # seeds the dense-range memo on rebuild (no krange3 probe on
+        # post-shuffle dense decisions; same stats the local write seeds)
+        self.col_stats = col_stats
 
     @property
     def output(self):
@@ -153,7 +162,8 @@ class FetchExec(PhysicalPlan):
                     raise FetchFailedError(self.shuffle_id,
                                            str(e)) from None
                 ctx.metrics.add("shuffle.blocks_fetched")
-            part.extend(_ipc_to_partition(pickle.loads(raw), schema))
+            seed = (self.col_stats or {}).get(rid)
+            part.extend(_ipc_to_partition(pickle.loads(raw), schema, seed))
         return part
 
     def execute(self, ctx):
@@ -252,8 +262,12 @@ def _run_stage_store(plan_bytes: bytes, conf_overrides: dict,
         if qtoken is not None:
             pop_query(qtoken)
     counters = ctx.metrics.snapshot()["counters"]
+    # map-side column stats (shuffle-exchange roots accumulate them while
+    # slicing rows host-side) ride the MapStatus payload: the reduce side
+    # seeds its dense-range memo from them instead of probing on device
+    col_stats = getattr(plan, "last_col_stats", None) or None
     return ("mapstatus", WM.BLOCK_ADDR, rows, sizes, counters,
-            WM.finish_stage_obs(obs))
+            WM.finish_stage_obs(obs), col_stats)
 
 
 class ClusterDAGScheduler(DAGScheduler):
@@ -432,10 +446,11 @@ class ClusterDAGScheduler(DAGScheduler):
                 _run_stage_store, cloudpickle.dumps(plan),
                 self.conf_overrides, sid, map_id, num_maps,
                 qid, flow_parent)
-            tag, addr, rows, sizes, counters, obs = result
+            tag, addr, rows, sizes, counters, obs, col_stats = result
             assert tag == "mapstatus", tag
             return (MapStatus(map_block_id(sid, map_id, num_maps), addr,
-                              worker.executor_id, rows, sizes, map_id),
+                              worker.executor_id, rows, sizes, map_id,
+                              col_stats),
                     counters, obs, worker.executor_id)
 
         if num_maps == 1:
@@ -548,6 +563,26 @@ def _fetch_failed_shuffle_id(e: Exception) -> str | None:
     return None
 
 
+def _merged_col_stats(maps: list) -> dict | None:
+    """Union the per-map-task column stats into per-reduce-partition
+    stats: min of mins, max of maxes, any OR — the reduce partition's
+    rows are exactly the union of every map task's slice for it."""
+    out: dict = {}
+    for ms in maps:
+        for rid, cols in (ms.col_stats or {}).items():
+            cur = out.setdefault(rid, {})
+            for ci, (lo, hi, any_v) in cols.items():
+                if ci in cur:
+                    plo, phi, seen = cur[ci]
+                    if any_v and seen:
+                        cur[ci] = (min(plo, lo), max(phi, hi), True)
+                    elif any_v:
+                        cur[ci] = (lo, hi, True)
+                else:
+                    cur[ci] = (lo, hi, any_v)
+    return out or None
+
+
 def _substitute_parents(node, sched: ClusterDAGScheduler):
     """Replace _StageOutput leaves with Fetch leaves bound to the
     executors holding the parent's map outputs (plus the merge index
@@ -565,7 +600,8 @@ def _substitute_parents(node, sched: ClusterDAGScheduler):
                          sched.cluster.authkey_hex, status.num_partitions,
                          fallback_addr=getattr(sched.cluster,
                                                "shuffle_service_addr", None),
-                         merge=merge)
+                         merge=merge,
+                         col_stats=_merged_col_stats(status.maps))
     return node.map_children(lambda c: _substitute_parents(c, sched))
 
 
@@ -580,6 +616,7 @@ def _slice_fetch_leaves(node, map_id: int, num_maps: int):
             node.num_partitions, fallback_addr=node.fallback_addr,
             merge=node.merge,
             part_indices=list(range(map_id, node.num_partitions,
-                                    num_maps)))
+                                    num_maps)),
+            col_stats=node.col_stats)
     return node.map_children(
         lambda c: _slice_fetch_leaves(c, map_id, num_maps))

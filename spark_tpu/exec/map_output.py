@@ -48,6 +48,13 @@ class MapStatus:
     rows: list = field(default_factory=list)    # per reduce partition
     bytes: list = field(default_factory=list)   # per reduce partition
     map_id: int = 0
+    # map-side integral column stats per reduce partition:
+    # {reduce_id: {col_idx: (kmin, kmax, any_valid)}} — the reduce side
+    # seeds the dense-range device-scalar memo with these after the IPC
+    # rebuild, so post-shuffle dense agg/join decisions never launch the
+    # krange3 probe (exec/shuffle._OutBuffer accumulates them host-side
+    # while slicing rows; zero extra device work)
+    col_stats: dict | None = None
 
     @property
     def num_partitions(self) -> int:

@@ -43,7 +43,14 @@ class _OutBuffer:
     written to one .npz spill file (dictionaries stay in RAM — they are
     shared references, not copies) and dropped; build() streams spills
     back one file at a time, so peak host memory is
-    O(spill_bytes + one tile), not O(partition)."""
+    O(spill_bytes + one tile), not O(partition).
+
+    While the rows are host-side anyway, append() keeps a running
+    (min, max, any_valid) per integral column — the map-side column
+    stats. build() seeds the dense-range device-scalar memo with them,
+    and in cluster mode they ride the MapStatus payload so the reduce
+    side seeds the same values after the IPC rebuild: post-shuffle
+    dense agg/join decisions never launch the krange3 probe."""
 
     def __init__(self, schema: StructType, spill_bytes: int | None = None,
                  spill_dir: str | None = None, metrics=None):
@@ -57,6 +64,14 @@ class _OutBuffer:
         self._live_bytes = 0
         # per spill: (path, [per-chunk [sdict per col]], [per-chunk rows])
         self._spills: list[tuple] = []
+        # integral non-dictionary columns: the ones dense_range_stats reads
+        self._stat_cols = [
+            i for i, f in enumerate(schema.fields)
+            if np.dtype(f.dataType.device_dtype).kind == "i"
+            and not dict_encoded(f.dataType)]
+        # col index -> (kmin, kmax, any_valid) over every appended row
+        self.col_stats: dict[int, tuple] = {
+            i: (0, 0, False) for i in self._stat_cols}
 
     def append(self, cols: list, n: int):
         if not n:
@@ -64,12 +79,34 @@ class _OutBuffer:
         self.chunks.append(cols)
         self._chunk_rows.append(n)
         self.rows += n
+        for i in self._stat_cols:
+            d, v, _ = cols[i]
+            live = d if v is None else d[v]
+            if len(live):
+                lo, hi = int(live.min()), int(live.max())
+                plo, phi, seen = self.col_stats[i]
+                self.col_stats[i] = ((min(plo, lo), max(phi, hi), True)
+                                     if seen else (lo, hi, True))
         if self.spill_bytes is not None:
             self._live_bytes += sum(
                 d.nbytes + (v.nbytes if v is not None else 0)
                 for d, v, _ in cols)
             if self._live_bytes > self.spill_bytes:
                 self._spill()
+
+    def seed_stats(self, batch: ColumnarBatch) -> None:
+        """Seed the dense-range memo of one built tile with this
+        partition's column stats. The seeded range may be a SUPERSET of
+        the tile's own (partition-wide vs per-tile) — sound for the dense
+        fast-path decision: kmin only offsets the scatter base and a wider
+        span merely widens the table. Partition-wide is deliberate: the
+        reduce side of a cluster shuffle seeds the same partition-wide
+        values from the MapStatus payload, so local and cluster runs make
+        identical dense decisions (the plan analyzer mirrors this)."""
+        from ..utils.device_memo import seed_dense_range_memo
+
+        for i, st in self.col_stats.items():
+            seed_dense_range_memo(batch.columns[i], batch.row_mask, st)
 
     def _spill(self):
         import os
@@ -151,7 +188,9 @@ class _OutBuffer:
         at exact tile boundaries — an overshooting tile would round up to
         the next capacity bucket and break the memory bound."""
         if not self.chunks and not self._spills:
-            return [ColumnarBatch.empty(self.schema)]
+            empty = ColumnarBatch.empty(self.schema)
+            self.seed_stats(empty)
+            return [empty]
         batches: Partition = []
         pend: list[list] = []
         pend_rows = 0
@@ -174,6 +213,8 @@ class _OutBuffer:
         if pend or not batches:
             batches.append(self._build_tile(pend))
         self._spills = []
+        for b in batches:
+            self.seed_stats(b)
         return batches
 
 
@@ -203,14 +244,20 @@ def _pull_sorted(batch: ColumnarBatch, perm, counts) -> tuple[list, np.ndarray]:
     return gathered, np.asarray(counts)
 
 
-def shuffle_hash(partitions: list[Partition], key_positions: Sequence[int],
-                 num_out: int, schema: StructType, ctx: ExecContext,
-                 stats: dict | None = None,
-                 seed: int = 42) -> list[Partition]:
-    """Hash-repartition. ``seed`` must differ from the upstream exchange's
-    when re-splitting already-hash-partitioned data (grace join): reusing
-    the seed makes h %% nfrag constant within a partition whenever nfrag
-    divides the exchange's partition count — a degenerate split."""
+def _out_buffers(num_out: int, schema: StructType,
+                 ctx: ExecContext) -> list[_OutBuffer]:
+    return [_OutBuffer(schema, spill_bytes=ctx.memory.spill_bytes,
+                       spill_dir=ctx.memory.spill_dir, metrics=ctx.metrics)
+            for _ in range(num_out)]
+
+
+def hash_partition_batch(batch: ColumnarBatch,
+                         key_positions: Sequence[int], num_out: int,
+                         seed: int) -> tuple[list, np.ndarray]:
+    """Partition ONE materialized batch by key hash; returns the
+    pid-grouped host columns + per-partition counts (the shared
+    operator-at-a-time kernels — the fused exchange write in
+    physical/fusion.py produces the same shape from one fused dispatch)."""
     import jax
 
     from ..ops.hashing import hash_columns, partition_ids
@@ -224,141 +271,190 @@ def shuffle_hash(partitions: list[Partition], key_positions: Sequence[int],
         has_native = False
 
     jnp = _jnp()
-    bufs = [_OutBuffer(schema, spill_bytes=ctx.memory.spill_bytes,
-                       spill_dir=ctx.memory.spill_dir, metrics=ctx.metrics)
-            for _ in range(num_out)]
-    for part in partitions:
-        for batch in part:
-            keys = [batch.columns[i] for i in key_positions]
-            key_eqs = [c.eq_keys() for c in keys]
-            key_valids = [c.validity for c in keys]
-            cap = batch.capacity
-            if has_native:
-                # fast path: device computes only the pid per row (cheap
-                # hash kernel); the C++ counting sort groups rows host-side
-                # (native/sparktpu_native.cpp, the RadixSort role) — no
-                # device sort, no device gather
-                kkey = ("shuffle_pids", cap, num_out, len(keys), seed,
-                        tuple(str(k.dtype) for k in key_eqs),
-                        tuple(v is not None for v in key_valids))
-                kernel = GLOBAL_KERNEL_CACHE.get_or_build(
-                    kkey, lambda: jax.jit(
-                        lambda eqs, valids, mask: jnp.where(
-                            mask,
-                            partition_ids(hash_columns(eqs, list(valids),
-                                                       seed=seed),
-                                          num_out),
-                            num_out)))
-                pids = np.asarray(kernel(key_eqs, key_valids,
-                                         batch.row_mask))
-                try:
-                    order, counts = native_radix(pids, num_out)
-                except Exception:
-                    order = np.argsort(pids, kind="stable")
-                    counts = np.bincount(
-                        pids[pids < num_out], minlength=num_out)
-                order = order[: int(counts.sum())]
-                gathered = []
-                for c in batch.columns:
-                    data = np.asarray(c.data)[order]
-                    validity = None if c.validity is None else \
-                        np.asarray(c.validity)[order]
-                    gathered.append((data, validity, c.dictionary))
-                _slice_into(bufs, gathered, counts.astype(np.int64))
-            else:
-                kkey = ("shuffle_hash", cap, num_out, len(keys), seed,
-                        tuple(str(k.dtype) for k in key_eqs),
-                        tuple(v is not None for v in key_valids))
-                kernel = GLOBAL_KERNEL_CACHE.get_or_build(
-                    kkey, lambda: jax.jit(
-                        lambda eqs, valids, mask: hash_partition(
-                            eqs, valids, mask, num_out, seed=seed)))
-                pr = kernel(key_eqs, key_valids, batch.row_mask)
-                gathered, counts = _pull_sorted(batch, pr.perm, pr.counts)
-                _slice_into(bufs, gathered, counts)
-    return _finish(bufs, ctx, stats)
+    keys = [batch.columns[i] for i in key_positions]
+    key_eqs = [c.eq_keys() for c in keys]
+    key_valids = [c.validity for c in keys]
+    cap = batch.capacity
+    if has_native:
+        # fast path: device computes only the pid per row (cheap
+        # hash kernel); the C++ counting sort groups rows host-side
+        # (native/sparktpu_native.cpp, the RadixSort role) — no
+        # device sort, no device gather
+        kkey = ("shuffle_pids", cap, num_out, len(keys), seed,
+                tuple(str(k.dtype) for k in key_eqs),
+                tuple(v is not None for v in key_valids))
+        kernel = GLOBAL_KERNEL_CACHE.get_or_build(
+            kkey, lambda: jax.jit(
+                lambda eqs, valids, mask: jnp.where(
+                    mask,
+                    partition_ids(hash_columns(eqs, list(valids),
+                                               seed=seed),
+                                  num_out),
+                    num_out)))
+        pids = np.asarray(kernel(key_eqs, key_valids, batch.row_mask))
+        try:
+            order, counts = native_radix(pids, num_out)
+        except Exception:
+            order = np.argsort(pids, kind="stable")
+            counts = np.bincount(
+                pids[pids < num_out], minlength=num_out)
+        order = order[: int(counts.sum())]
+        gathered = []
+        for c in batch.columns:
+            data = np.asarray(c.data)[order]
+            validity = None if c.validity is None else \
+                np.asarray(c.validity)[order]
+            gathered.append((data, validity, c.dictionary))
+        return gathered, counts.astype(np.int64)
+    kkey = ("shuffle_hash", cap, num_out, len(keys), seed,
+            tuple(str(k.dtype) for k in key_eqs),
+            tuple(v is not None for v in key_valids))
+    kernel = GLOBAL_KERNEL_CACHE.get_or_build(
+        kkey, lambda: jax.jit(
+            lambda eqs, valids, mask: hash_partition(
+                eqs, valids, mask, num_out, seed=seed)))
+    pr = kernel(key_eqs, key_valids, batch.row_mask)
+    return _pull_sorted(batch, pr.perm, pr.counts)
 
 
-def shuffle_round_robin(partitions: list[Partition], num_out: int,
-                        schema: StructType, ctx: ExecContext,
-                        stats: dict | None = None) -> list[Partition]:
+def rr_partition_batch(batch: ColumnarBatch, num_out: int,
+                       start: int) -> tuple[list, np.ndarray]:
+    """Round-robin-partition one batch. The running row offset is a
+    kernel ARGUMENT (an int32 device scalar), not part of the cache key:
+    one compiled kernel per (capacity, num_out) serves every batch
+    position (the historical key embedded start % num_out and compiled
+    once per batch — the SampleExec storm shape)."""
     import jax
 
     from ..ops.partition import round_robin_partition
     from ..physical.compile import GLOBAL_KERNEL_CACHE
 
-    bufs = [_OutBuffer(schema, spill_bytes=ctx.memory.spill_bytes,
-                       spill_dir=ctx.memory.spill_dir, metrics=ctx.metrics)
-            for _ in range(num_out)]
-    start = 0
-    for part in partitions:
-        for batch in part:
-            cap = batch.capacity
-            # the running row offset is a kernel ARGUMENT (an int32
-            # device scalar), not part of the cache key: one compiled
-            # kernel per (capacity, num_out) serves every batch position
-            # (the historical key embedded start % num_out and compiled
-            # once per batch — the SampleExec storm shape)
-            kkey = ("shuffle_rr", cap, num_out)
-            kernel = GLOBAL_KERNEL_CACHE.get_or_build(
-                kkey, lambda: jax.jit(
-                    lambda mask, s: round_robin_partition(mask, num_out,
-                                                          s)))
-            pr = kernel(batch.row_mask, np.int32(start % num_out))
-            gathered, counts = _pull_sorted(batch, pr.perm, pr.counts)
-            _slice_into(bufs, gathered, counts)
-            start += int(counts.sum())
-    return _finish(bufs, ctx, stats)
+    kkey = ("shuffle_rr", batch.capacity, num_out)
+    kernel = GLOBAL_KERNEL_CACHE.get_or_build(
+        kkey, lambda: jax.jit(
+            lambda mask, s: round_robin_partition(mask, num_out, s)))
+    pr = kernel(batch.row_mask, np.int32(start % num_out))
+    return _pull_sorted(batch, pr.perm, pr.counts)
 
 
-def shuffle_range(partitions: list[Partition], key_position: int,
-                  bounds, descending: bool, num_out: int, schema: StructType,
-                  ctx: ExecContext, stats: dict | None = None) -> list[Partition]:
-    """Range shuffle for global sort. `bounds` is a host list of boundary
-    values in the sort-key domain (numeric) or raw strings."""
+def range_partition_batch(batch: ColumnarBatch, key_position: int,
+                          bounds, descending: bool, num_out: int,
+                          string_key: bool) -> tuple[list, np.ndarray]:
+    """Range-partition one batch against sampled bounds."""
     import jax
 
     from ..ops.partition import range_partition, _group_by_pid
     from ..physical.compile import GLOBAL_KERNEL_CACHE
 
     jnp = _jnp()
-    bufs = [_OutBuffer(schema, spill_bytes=ctx.memory.spill_bytes,
-                       spill_dir=ctx.memory.spill_dir, metrics=ctx.metrics)
-            for _ in range(num_out)]
+    col = batch.columns[key_position]
+    cap = batch.capacity
+    if string_key:
+        # host: dict value → pid lut; device: take + group
+        sd = col.dictionary or StringDict([""])
+        lut = np.searchsorted(bounds, np.array(sd.values or [""],
+                                               dtype=object),
+                              side="right").astype(np.int32)
+        if descending:
+            lut = (num_out - 1) - lut
+        lut_d = jnp.asarray(lut)
+        pids = jnp.take(lut_d, jnp.clip(col.data, 0, len(lut) - 1))
+        kkey = ("shuffle_range_str", cap, num_out)
+        kernel = GLOBAL_KERNEL_CACHE.get_or_build(
+            kkey, lambda: jax.jit(
+                lambda p, m: _group_by_pid(p, m, num_out)))
+        pr = kernel(pids, batch.row_mask)
+    else:
+        barr = jnp.asarray(np.asarray(bounds))
+        kkey = ("shuffle_range", cap, num_out, descending,
+                str(col.data.dtype), len(bounds))
+        kernel = GLOBAL_KERNEL_CACHE.get_or_build(
+            kkey, lambda: jax.jit(
+                lambda keys, b, mask: range_partition(
+                    keys, b, mask, num_out, descending)))
+        pr = kernel(col.sort_keys().astype(barr.dtype), barr,
+                    batch.row_mask)
+    return _pull_sorted(batch, pr.perm, pr.counts)
+
+
+def shuffle_hash(partitions: list[Partition], key_positions: Sequence[int],
+                 num_out: int, schema: StructType, ctx: ExecContext,
+                 stats: dict | None = None,
+                 seed: int = 42,
+                 col_stats: dict | None = None) -> list[Partition]:
+    """Hash-repartition. ``seed`` must differ from the upstream exchange's
+    when re-splitting already-hash-partitioned data (grace join): reusing
+    the seed makes h %% nfrag constant within a partition whenever nfrag
+    divides the exchange's partition count — a degenerate split."""
+    bufs = _out_buffers(num_out, schema, ctx)
+    for part in partitions:
+        for batch in part:
+            gathered, counts = hash_partition_batch(
+                batch, key_positions, num_out, seed)
+            _slice_into(bufs, gathered, counts)
+    return _finish(bufs, ctx, stats, col_stats)
+
+
+def shuffle_round_robin(partitions: list[Partition], num_out: int,
+                        schema: StructType, ctx: ExecContext,
+                        stats: dict | None = None,
+                        col_stats: dict | None = None) -> list[Partition]:
+    bufs = _out_buffers(num_out, schema, ctx)
+    start = 0
+    for part in partitions:
+        for batch in part:
+            gathered, counts = rr_partition_batch(batch, num_out, start)
+            _slice_into(bufs, gathered, counts)
+            start += int(counts.sum())
+    return _finish(bufs, ctx, stats, col_stats)
+
+
+def shuffle_range(partitions: list[Partition], key_position: int,
+                  bounds, descending: bool, num_out: int, schema: StructType,
+                  ctx: ExecContext, stats: dict | None = None,
+                  col_stats: dict | None = None) -> list[Partition]:
+    """Range shuffle for global sort. `bounds` is a host list of boundary
+    values in the sort-key domain (numeric) or raw strings."""
+    bufs = _out_buffers(num_out, schema, ctx)
     f = schema.fields[key_position]
     string_key = isinstance(f.dataType, StringType)
     for part in partitions:
         for batch in part:
-            col = batch.columns[key_position]
-            cap = batch.capacity
-            if string_key:
-                # host: dict value → pid lut; device: take + group
-                sd = col.dictionary or StringDict([""])
-                lut = np.searchsorted(bounds, np.array(sd.values or [""],
-                                                       dtype=object),
-                                      side="right").astype(np.int32)
-                if descending:
-                    lut = (num_out - 1) - lut
-                lut_d = jnp.asarray(lut)
-                pids = jnp.take(lut_d, jnp.clip(col.data, 0, len(lut) - 1))
-                kkey = ("shuffle_range_str", cap, num_out)
-                kernel = GLOBAL_KERNEL_CACHE.get_or_build(
-                    kkey, lambda: jax.jit(
-                        lambda p, m: _group_by_pid(p, m, num_out)))
-                pr = kernel(pids, batch.row_mask)
-            else:
-                barr = jnp.asarray(np.asarray(bounds))
-                kkey = ("shuffle_range", cap, num_out, descending,
-                        str(col.data.dtype), len(bounds))
-                kernel = GLOBAL_KERNEL_CACHE.get_or_build(
-                    kkey, lambda: jax.jit(
-                        lambda keys, b, mask: range_partition(
-                            keys, b, mask, num_out, descending)))
-                pr = kernel(col.sort_keys().astype(barr.dtype), barr,
-                            batch.row_mask)
-            gathered, counts = _pull_sorted(batch, pr.perm, pr.counts)
+            gathered, counts = range_partition_batch(
+                batch, key_position, bounds, descending, num_out,
+                string_key)
             _slice_into(bufs, gathered, counts)
-    return _finish(bufs, ctx, stats)
+    return _finish(bufs, ctx, stats, col_stats)
+
+
+def shuffle_fused(partitions: list[Partition], writer, num_out: int,
+                  schema: StructType, ctx: ExecContext,
+                  stats: dict | None = None,
+                  col_stats: dict | None = None) -> list[Partition]:
+    """Fused exchange map side: `writer` (physical/fusion.ExchangeFusion
+    bound to a partitioning) runs ONE jitted kernel per input batch —
+    pipeline trace + partition ids + pid-grouped gather — and this loop
+    consumes the grouped host columns directly into the reduce buffers:
+    no intermediate materialized batch between the stage pipeline and the
+    shuffle write. Partitions under spark.tpu.fusion.minRows take the
+    shared unfused kernels instead (pipeline + shuffle kind), matching
+    the other fused operators' size gate."""
+    from ..config import FUSION_MIN_ROWS
+
+    bufs = _out_buffers(num_out, schema, ctx)
+    min_rows = int(ctx.conf.get(FUSION_MIN_ROWS))  # tpulint: ignore[host-sync]
+    start = 0  # running live-row offset (round-robin positioning)
+    for part in partitions:
+        fused = sum(b.capacity for b in part) >= min_rows
+        for batch in part:
+            if fused:
+                gathered, counts = writer.partition_batch(batch, start)
+            else:
+                gathered, counts = writer.partition_unfused(batch, start)
+            _slice_into(bufs, gathered, counts)
+            # counts is host numpy (materialized by the map-side write)
+            start += int(counts.sum())  # tpulint: ignore[host-sync]
+    return _finish(bufs, ctx, stats, col_stats)
 
 
 def gather_single(partitions: list[Partition]) -> list[Partition]:
@@ -373,7 +469,7 @@ def _slice_into(bufs: list[_OutBuffer], gathered: list, counts: np.ndarray):
     offsets = np.zeros(len(counts) + 1, dtype=np.int64)
     np.cumsum(counts, out=offsets[1:])
     for p in range(len(bufs)):
-        lo, hi = int(offsets[p]), int(offsets[p + 1])
+        lo, hi = int(offsets[p]), int(offsets[p + 1])  # tpulint: ignore[host-sync]
         if hi <= lo:
             continue
         cols = []
@@ -384,11 +480,14 @@ def _slice_into(bufs: list[_OutBuffer], gathered: list, counts: np.ndarray):
 
 
 def _finish(bufs: list[_OutBuffer], ctx: ExecContext,
-            stats: dict | None) -> list[Partition]:
+            stats: dict | None,
+            col_stats: dict | None = None) -> list[Partition]:
     tile = ctx.conf.batch_capacity
     out = []
     for i, b in enumerate(bufs):
         if stats is not None:
             stats[i] = b.rows
+        if col_stats is not None:
+            col_stats[i] = dict(b.col_stats)
         out.append(b.build(tile))
     return out

@@ -156,13 +156,23 @@ def _effective_child(plan_child):
     return plan_child
 
 
+def _is_shuffle_output(plan_child) -> bool:
+    """An exchange, or a cluster-mode Fetch leaf standing in for one
+    (exec/cluster_sql.FetchExec, `is_shuffle_read`): the reduce side of a
+    cluster shuffle must coalesce exactly like the local path — adjacent
+    merges preserve hash clustering either way, and the plan analyzer
+    models ONE coalescing behavior for both modes."""
+    from .exchange import ShuffleExchangeExec
+
+    return isinstance(plan_child, ShuffleExchangeExec) or \
+        getattr(plan_child, "is_shuffle_read", False)
+
+
 def coalesce_after_exchange(plan_child, parts: list, ctx: ExecContext,
                             output_attrs) -> list:
     """Coalesce a single exchange's output for a blocking consumer."""
-    from .exchange import ShuffleExchangeExec
-
     plan_child = _effective_child(plan_child)
-    if not isinstance(plan_child, ShuffleExchangeExec):
+    if not _is_shuffle_output(plan_child):
         return parts
     if not (ctx.conf.get(ADAPTIVE_ENABLED)
             and ctx.conf.get(COALESCE_PARTITIONS_ENABLED)):
@@ -185,12 +195,10 @@ def coalesce_join_inputs(left_child, right_child, left_parts: list,
                          right_parts: list, ctx: ExecContext,
                          left_attrs, right_attrs):
     """Coordinated coalescing for co-partitioned join inputs."""
-    from .exchange import ShuffleExchangeExec
-
     left_child = _effective_child(left_child)
     right_child = _effective_child(right_child)
-    if not (isinstance(left_child, ShuffleExchangeExec)
-            and isinstance(right_child, ShuffleExchangeExec)):
+    if not (_is_shuffle_output(left_child)
+            and _is_shuffle_output(right_child)):
         return left_parts, right_parts
     if not (ctx.conf.get(ADAPTIVE_ENABLED)
             and ctx.conf.get(COALESCE_PARTITIONS_ENABLED)):

@@ -33,19 +33,52 @@ class ShuffleExchangeExec(PhysicalPlan):
         self.partitioning = partitioning
         self.child = child
         self.last_stats: dict[int, int] = {}
+        # map-side per-reduce-partition integral column stats (satellite
+        # of the fused write: seeds the dense-range memo locally and
+        # rides the MapStatus payload in cluster mode)
+        self.last_col_stats: dict[int, dict] = {}
+        # set by FuseStages (physical/fusion.py): (filters, outputs) of
+        # the producing pipeline traced into the partition-id kernel
+        self.pipe_fusion: tuple | None = None
+        self.pipe_attrs: list | None = None
 
     @property
     def output(self):
+        if self.pipe_attrs is not None:
+            return self.pipe_attrs
         return self.child.output
 
     def output_partitioning(self):
         return self.partitioning
+
+    def fused_members(self) -> list:
+        """FuseStages mapping for obs/ dispatch re-attribution: the
+        pipeline members share this exchange's single map-side dispatch
+        per batch (the partition-id kernel rides the same program)."""
+        if self.pipe_fusion is None:
+            return []
+        from ..obs.metrics import pipeline_member_names
+
+        filters, outputs = self.pipe_fusion
+        return pipeline_member_names(filters, outputs) + [
+            f"Exchange[{type(self.partitioning).__name__}] partition-ids"]
+
+    def _fusion(self):
+        """Fresh ExchangeFusion per execute (it carries the partitioning
+        binding); the jitted kernels live in the global KernelCache, so
+        rebuilding the binder costs no compile."""
+        from .fusion import ExchangeFusion
+
+        filters, outputs = self.pipe_fusion
+        return ExchangeFusion(filters, outputs, self.child.output)
 
     def execute(self, ctx: ExecContext) -> list:
         parts = self.child.execute(ctx)
         schema = attrs_schema(self.output)
         p = self.partitioning
         self.last_stats = {}
+        self.last_col_stats = {}
+        fusion = self._fusion() if self.pipe_fusion is not None else None
         with ctx.metrics.time("shuffle"):
             if isinstance(p, SinglePartition):
                 with self._span(ctx, "exchange.gather", p):
@@ -61,22 +94,41 @@ class ShuffleExchangeExec(PhysicalPlan):
 
                 mesh = ME.mesh_for(p.num_partitions, ctx.conf, schema)
                 if mesh is not None:
+                    if fusion is not None:
+                        # the mesh all-to-all consumes device-sharded
+                        # batches — materialize the pipeline, then shuffle
+                        parts = [[fusion.run_pipeline(b) for b in part]
+                                 for part in parts]
                     with self._span(ctx, "exchange.mesh_all_to_all", p):
                         return ME.mesh_shuffle_hash(
                             parts, key_positions, p.num_partitions, schema,
                             ctx, self.last_stats, mesh)
                 with self._span(ctx, "exchange.hash", p):
+                    if fusion is not None:
+                        return S.shuffle_fused(
+                            parts,
+                            fusion.bind_hash(key_positions,
+                                             p.num_partitions),
+                            p.num_partitions, schema, ctx, self.last_stats,
+                            self.last_col_stats)
                     return S.shuffle_hash(parts, key_positions,
                                           p.num_partitions, schema, ctx,
-                                          self.last_stats)
+                                          self.last_stats,
+                                          col_stats=self.last_col_stats)
             if isinstance(p, RangePartitioning):
                 with self._span(ctx, "exchange.range", p):
-                    return self._range_shuffle(parts, p, schema, ctx)
+                    return self._range_shuffle(parts, p, schema, ctx,
+                                               fusion)
             if isinstance(p, UnknownPartitioning):
                 with self._span(ctx, "exchange.round_robin", p):
-                    return S.shuffle_round_robin(parts, p.num_partitions,
-                                                 schema, ctx,
-                                                 self.last_stats)
+                    if fusion is not None:
+                        return S.shuffle_fused(
+                            parts, fusion.bind_rr(p.num_partitions),
+                            p.num_partitions, schema, ctx, self.last_stats,
+                            self.last_col_stats)
+                    return S.shuffle_round_robin(
+                        parts, p.num_partitions, schema, ctx,
+                        self.last_stats, col_stats=self.last_col_stats)
         raise UnsupportedOperationError(f"exchange for {p}")
 
     @staticmethod
@@ -92,20 +144,62 @@ class ShuffleExchangeExec(PhysicalPlan):
         return tracer.span(name, cat="exchange",
                            args={"partitions": p.num_partitions})
 
-    def _range_shuffle(self, parts, p: RangePartitioning, schema, ctx):
+    def _range_shuffle(self, parts, p: RangePartitioning, schema, ctx,
+                       fusion=None):
         order = p.orders[0]
         pos = {a.expr_id: i for i, a in enumerate(self.output)}
         assert isinstance(order.child, AttributeReference)
         kpos = pos[order.child.expr_id]
+        if fusion is not None:
+            # bounds sample from the INPUT column the key passes through
+            # (a pre-filter superset of the key domain — any bound set
+            # partitions correctly, the fusable gate guarantees the
+            # pass-through; see fusion._range_sample_source)
+            from .fusion import _range_sample_source
+
+            in_pos = _range_sample_source(
+                _FusionComputeView(self.pipe_fusion, self.child), order.child)
+            in_schema = attrs_schema(self.child.output)
+            bounds = _sample_bounds(parts, in_pos, in_schema,
+                                    p.num_partitions)
+            if bounds is None or len(bounds) == 0:
+                return S.gather_single(
+                    [[fusion.run_pipeline(b) for b in part]
+                     for part in parts])
+            return S.shuffle_fused(
+                parts,
+                fusion.bind_range(kpos, bounds, not order.ascending,
+                                  p.num_partitions),
+                p.num_partitions, schema, ctx, self.last_stats,
+                self.last_col_stats)
         bounds = _sample_bounds(parts, kpos, schema, p.num_partitions)
         if bounds is None or len(bounds) == 0:
             return S.gather_single(parts)
         return S.shuffle_range(parts, kpos, bounds, not order.ascending,
-                               p.num_partitions, schema, ctx, self.last_stats)
+                               p.num_partitions, schema, ctx,
+                               self.last_stats,
+                               col_stats=self.last_col_stats)
 
     def simple_string(self):
-        return f"Exchange[{type(self.partitioning).__name__}" \
-               f"({self.partitioning.num_partitions})]"
+        s = f"Exchange[{type(self.partitioning).__name__}" \
+            f"({self.partitioning.num_partitions})]"
+        if self.pipe_fusion is not None:
+            filters, outputs = self.pipe_fusion
+            o = ", ".join(x.simple_string() for x in outputs)
+            s += f" FUSED-MAP[{o}]"
+            if filters:
+                s += " WHERE " + " AND ".join(x.simple_string()
+                                              for x in filters)
+        return s
+
+
+class _FusionComputeView:
+    """Adapter giving fusion helpers the (filters, outputs, child) shape
+    of the ComputeExec the FuseStages rule absorbed into the exchange."""
+
+    def __init__(self, pipe_fusion: tuple, child):
+        self.filters, self.outputs = pipe_fusion
+        self.child = child
 
 
 def _batch_key_samples(batch: ColumnarBatch, kpos: int, f,

@@ -29,7 +29,11 @@ from __future__ import annotations
 
 from typing import Sequence
 
-from ..config import FUSION_DENSE_KEYS, FUSION_MIN_ROWS, SQLConf
+import numpy as np
+
+from ..config import (
+    FUSION_DENSE_KEYS, FUSION_EXCHANGE, FUSION_MIN_ROWS, SQLConf,
+)
 from ..expr.expressions import Alias, AttributeReference, Expression
 from ..types import (
     BooleanType, DateType, IntegralType, StringType, dict_encoded,
@@ -45,8 +49,8 @@ from .operators import (
     _SchemaOnly, attrs_schema, dense_range_stats,
 )
 
-__all__ = ["FusedAggregateExec", "FusedLimitExec", "fuse_stages",
-           "collapse_computes", "merge_into_compute"]
+__all__ = ["FusedAggregateExec", "FusedLimitExec", "ExchangeFusion",
+           "fuse_stages", "collapse_computes", "merge_into_compute"]
 
 
 def _jnp():
@@ -197,6 +201,8 @@ class FusedAggregateExec(HashAggregateExec):
     def _fused_batch(self, batch: ColumnarBatch, ctx) -> ColumnarBatch:
         import jax
 
+        from ..columnar.batch import EMPTY_DICT
+
         jnp = _jnp()
         cap = batch.capacity
         input_attrs = self.child.output
@@ -210,15 +216,48 @@ class FusedAggregateExec(HashAggregateExec):
                         for _, attr, _ in vals)
         key_idx = tuple(opos[g.expr_id] for g in self.grouping)
         out_schema = attrs_schema(self.output)
+        # string MIN/MAX reduces in RANK space inside the trace: the
+        # rank lut (codes→lexicographic rank) and its inverse (winning
+        # rank→code) ride as kernel aux inputs, so the whole aggregate
+        # stays in the single fused dispatch (no unfused fallback)
+        smm_idx = tuple(bi for bi, (op, attr, _p) in enumerate(vals)
+                        if op in ("min", "max") and attr is not None
+                        and dict_encoded(attr.dtype))
+        smm_dicts = [host_outs[val_idx[bi]].sdict or EMPTY_DICT
+                     for bi in smm_idx]
+        rank_luts = [sd.device_ranks() for sd in smm_dicts]
+        inv_luts = [sd.device_rank_to_code() for sd in smm_dicts]
         base_key = (self._struct_key, ops, val_idx, key_idx, cap,
+                    smm_idx, tuple(int(r.shape[0]) for r in rank_luts),
                     pipeline_signature(batch), hctx.signature())
         datas = [c.data for c in batch.columns]
         valids = [c.validity for c in batch.columns]
+        smm_pos = {bi: j for j, bi in enumerate(smm_idx)}
 
-        def pipe_vals(out_datas, out_valids, mask):
-            vd = [out_datas[i] if i >= 0 else mask for i in val_idx]
+        def pipe_vals(out_datas, out_valids, mask, rluts):
+            vd = []
+            for bi, i in enumerate(val_idx):
+                d = out_datas[i] if i >= 0 else mask
+                if bi in smm_pos:
+                    r = rluts[smm_pos[bi]]
+                    d = jnp.take(r, jnp.clip(d.astype(jnp.int32), 0,
+                                             r.shape[0] - 1))
+                vd.append(d)
             vv = [out_valids[i] if i >= 0 else None for i in val_idx]
             return vd, vv
+
+        def rank_to_code(bufs, iluts):
+            """Map winning ranks of string min/max buffers back to codes
+            (inside the trace; masked/empty groups clip harmlessly — their
+            validity is already False)."""
+            out = []
+            for bi, (bd, bv) in enumerate(bufs):
+                if bi in smm_pos:
+                    inv = iluts[smm_pos[bi]]
+                    bd = jnp.take(inv, jnp.clip(bd.astype(jnp.int32), 0,
+                                                inv.shape[0] - 1))
+                out.append((bd, bv))
+            return out
 
         # ---- ungrouped -------------------------------------------------
         if not self.grouping:
@@ -227,12 +266,13 @@ class FusedAggregateExec(HashAggregateExec):
             def build_ungrouped():
                 from ..ops import grouping as G
 
-                def kernel(datas, valids, row_mask, aux):
+                def kernel(datas, valids, row_mask, aux, rluts, iluts):
                     out_datas, out_valids, mask = trace_pipeline(
                         input_attrs, filters, outputs, datas, valids,
                         row_mask, aux, cap)
-                    vd, vv = pipe_vals(out_datas, out_valids, mask)
+                    vd, vv = pipe_vals(out_datas, out_valids, mask, rluts)
                     outs = G.apply_global_ops(ops, vd, vv, mask)
+                    outs = rank_to_code(outs, iluts)
                     bufs_d, bufs_v = [], []
                     for d, v in outs:
                         bufs_d.append(jnp.zeros((out_cap,), dtype=d.dtype)
@@ -247,7 +287,8 @@ class FusedAggregateExec(HashAggregateExec):
 
             kernel = GLOBAL_KERNEL_CACHE.get_or_build(
                 ("fused_agg", "u") + base_key, build_ungrouped)
-            bufs_d, bufs_v, m = kernel(datas, valids, batch.row_mask, aux)
+            bufs_d, bufs_v, m = kernel(datas, valids, batch.row_mask, aux,
+                                       rank_luts, inv_luts)
             cols = self._fused_cols(
                 list(zip(bufs_d, bufs_v)), out_schema.fields, host_outs,
                 val_idx, 0)
@@ -266,7 +307,8 @@ class FusedAggregateExec(HashAggregateExec):
 
                 from ..ops import grouping as G
 
-                def kernel(datas, valids, row_mask, aux, kmin_s):
+                def kernel(datas, valids, row_mask, aux, kmin_s, rluts,
+                           iluts):
                     out_datas, out_valids, mask = trace_pipeline(
                         input_attrs, filters, outputs, datas, valids,
                         row_mask, aux, cap)
@@ -283,9 +325,10 @@ class FusedAggregateExec(HashAggregateExec):
                             (mask & ~kvalid).astype(jnp.int64))
                     else:
                         null_rows = jnp.int64(0)
-                    vd, vv = pipe_vals(out_datas, out_valids, mask)
+                    vd, vv = pipe_vals(out_datas, out_valids, mask, rluts)
                     bufs = G.apply_dense_ops(seg, out_cap, cap, ops, vd, vv,
                                              mask)
+                    bufs = rank_to_code(bufs, iluts)
                     out_keys = (kmin_s +
                                 lax.iota(jnp.int64, out_cap)).astype(kdt)
                     out_mask = (present > 0).at[out_cap - 1].set(
@@ -299,7 +342,8 @@ class FusedAggregateExec(HashAggregateExec):
             kernel = GLOBAL_KERNEL_CACHE.get_or_build(
                 ("fused_agg", "d", out_cap) + base_key, build_dense)
             out_keys, key_validity, bufs, out_mask = kernel(
-                datas, valids, batch.row_mask, aux, jnp.int64(kmin))
+                datas, valids, batch.row_mask, aux, jnp.int64(kmin),
+                rank_luts, inv_luts)
             ctx.metrics.add("agg.dense_fast_path")
             cols = [Column(kf.dataType, out_keys,
                            key_validity if has_kv else None, None)]
@@ -314,7 +358,7 @@ class FusedAggregateExec(HashAggregateExec):
         def build_grouped():
             from ..ops import grouping as G
 
-            def kernel(datas, valids, row_mask, aux):
+            def kernel(datas, valids, row_mask, aux, rluts, iluts):
                 out_datas, out_valids, mask = trace_pipeline(
                     input_attrs, filters, outputs, datas, valids, row_mask,
                     aux, cap)
@@ -329,8 +373,9 @@ class FusedAggregateExec(HashAggregateExec):
                 out_keys = [
                     G.scatter_group_keys(layout, out_datas[i], out_valids[i])
                     for i in key_idx]
-                vd, vv = pipe_vals(out_datas, out_valids, mask)
+                vd, vv = pipe_vals(out_datas, out_valids, mask, rluts)
                 bufs = G.apply_group_ops(layout, ops, vd, vv)
+                bufs = rank_to_code(bufs, iluts)
                 out_mask = G.group_output_mask(layout)
                 return out_keys, bufs, out_mask
 
@@ -338,7 +383,8 @@ class FusedAggregateExec(HashAggregateExec):
 
         kernel = GLOBAL_KERNEL_CACHE.get_or_build(
             ("fused_agg", "g") + base_key, build_grouped)
-        out_keys, bufs, out_mask = kernel(datas, valids, batch.row_mask, aux)
+        out_keys, bufs, out_mask = kernel(datas, valids, batch.row_mask,
+                                          aux, rank_luts, inv_luts)
         cols = []
         nk = len(key_idx)
         for (kd, kv), ki, f in zip(out_keys, key_idx,
@@ -471,7 +517,8 @@ class FusedLimitExec(LimitExec):
         jnp = _jnp()
         if not part:
             return []
-        if sum(b.capacity for b in part) < int(ctx.conf.get(FUSION_MIN_ROWS)):
+        if sum(b.capacity for b in part) < \
+                int(ctx.conf.get(FUSION_MIN_ROWS)):  # tpulint: ignore[host-sync]
             pipe, inner = self._unfused()
             return inner._limit_partition([pipe.run(b) for b in part], ctx)
         batch = concat_batches(part, attrs_schema(self.child.output))
@@ -519,6 +566,173 @@ class FusedLimitExec(LimitExec):
 
 
 # ---------------------------------------------------------------------------
+# ExchangeFusion: shuffle writes consume straight from the fused stage
+# ---------------------------------------------------------------------------
+
+class ExchangeFusion:
+    """The map side of a shuffle exchange fused with its producing
+    pipeline: per input batch, ONE jitted program filters, projects,
+    computes the partition id of every live row (hash / range /
+    round-robin), groups rows by pid with `lax.sort`, and gathers the
+    pipeline OUTPUT columns into pid order — the shuffle write
+    (exec/shuffle.shuffle_fused) slices the grouped host columns straight
+    into the reduce buffers. No intermediate materialized batch and no
+    separate partition-id dispatch: <=1 XLA dispatch per map batch (the
+    round-robin running offset stays an int32 kernel argument, so the
+    cache key is position-independent)."""
+
+    def __init__(self, filters: Sequence[Expression],
+                 outputs: Sequence[Expression], input_attrs):
+        self.filters = list(filters)
+        self.pipe_outputs = list(outputs)
+        self.pipe_attrs = _pipe_attrs(self.pipe_outputs)
+        self.input_attrs = list(input_attrs)
+        self._pipe_cache = None
+        id_to_pos = bind_inputs(self.input_attrs)
+        self._struct_key = (
+            tuple(canonical_key(f, id_to_pos) for f in self.filters),
+            tuple(canonical_key(o, id_to_pos) for o in self.pipe_outputs),
+        )
+        # partitioning binding (set by bind_*): mode + operands
+        self._mode = None
+        self._num_out = None
+        self._key_idx = ()
+        self._seed = 42
+        self._descending = False
+        self._bounds_host = None
+        self._bounds_dev = None
+        self._range_pos = None
+
+    # -- partitioning binding (one ExchangeFusion serves one execute) ------
+    def bind_hash(self, key_positions, num_out: int, seed: int = 42):
+        self._mode, self._num_out = "h", num_out
+        self._key_idx, self._seed = tuple(key_positions), seed
+        return self
+
+    def bind_rr(self, num_out: int):
+        self._mode, self._num_out = "rr", num_out
+        return self
+
+    def bind_range(self, key_position: int, bounds, descending: bool,
+                   num_out: int):
+        import jax.numpy as jnp
+
+        self._mode, self._num_out = "rg", num_out
+        self._range_pos = key_position
+        self._descending = descending
+        self._bounds_host = bounds
+        # host sample bounds → device, once per exchange execute
+        self._bounds_dev = jnp.asarray(np.asarray(bounds))  # tpulint: ignore[host-sync]
+        return self
+
+    # -- unfused fallback (spark.tpu.fusion.minRows gate) ------------------
+    def _pipeline(self):
+        if self._pipe_cache is None:
+            from .compile import ExprPipeline
+
+            self._pipe_cache = ExprPipeline(
+                self.input_attrs, self.filters, self.pipe_outputs,
+                attrs_schema(self.pipe_attrs))
+        return self._pipe_cache
+
+    def run_pipeline(self, batch: ColumnarBatch) -> ColumnarBatch:
+        """Materialize the pipeline only (mesh fallback + size gate)."""
+        return self._pipeline().run(batch)
+
+    def partition_unfused(self, batch: ColumnarBatch, start: int):
+        """Shared operator-at-a-time kernels for undersized partitions:
+        one pipeline dispatch + one shuffle-kind dispatch per batch."""
+        from ..exec import shuffle as S
+
+        b = self.run_pipeline(batch)
+        if self._mode == "h":
+            return S.hash_partition_batch(b, self._key_idx, self._num_out,
+                                          self._seed)
+        if self._mode == "rr":
+            return S.rr_partition_batch(b, self._num_out, start)
+        return S.range_partition_batch(b, self._range_pos,
+                                       self._bounds_host, self._descending,
+                                       self._num_out, string_key=False)
+
+    # -- the fused kernel --------------------------------------------------
+    def partition_batch(self, batch: ColumnarBatch, start: int):
+        """One dispatch: (grouped host columns, per-partition counts)."""
+        import jax
+
+        jnp = _jnp()
+        cap = batch.capacity
+        num_out = self._num_out
+        input_attrs = self.input_attrs
+        filters, outputs = self.filters, self.pipe_outputs
+        hctx, host_outs, aux = pipeline_host_pass(input_attrs, filters,
+                                                  outputs, batch)
+        key_idx = self._key_idx
+        key_bool = tuple(isinstance(self.pipe_attrs[i].dtype, BooleanType)
+                         for i in key_idx)
+        mode, seed, descending = self._mode, self._seed, self._descending
+        rpos = self._range_pos
+        key = ("fused_shuffle", mode, self._struct_key, cap, num_out,
+               key_idx, seed, descending, rpos,
+               None if self._bounds_dev is None
+               else (str(self._bounds_dev.dtype), len(self._bounds_host)),
+               pipeline_signature(batch), hctx.signature())
+
+        def build():
+            from ..ops.hashing import hash_columns, partition_ids
+            from ..ops.partition import _group_by_pid
+
+            def kernel(datas, valids, row_mask, aux, start_s, bounds):
+                out_datas, out_valids, mask = trace_pipeline(
+                    input_attrs, filters, outputs, datas, valids, row_mask,
+                    aux, cap)
+                if mode == "h":
+                    eqs = []
+                    for i, is_bool in zip(key_idx, key_bool):
+                        kd = out_datas[i]
+                        if is_bool:
+                            kd = kd.astype(jnp.int32)
+                        eqs.append(kd)
+                    kvs = [out_valids[i] for i in key_idx]
+                    pids = partition_ids(
+                        hash_columns(eqs, kvs, seed=seed), num_out)
+                elif mode == "rr":
+                    live_rank = jnp.cumsum(mask.astype(jnp.int32)) - 1
+                    pids = ((live_rank + start_s) % num_out) \
+                        .astype(jnp.int32)
+                else:  # range over sampled bounds (numeric key domain)
+                    keys64 = out_datas[rpos].astype(bounds.dtype)
+                    pids = jnp.searchsorted(bounds, keys64, side="right") \
+                        .astype(jnp.int32)
+                    if descending:
+                        pids = (num_out - 1) - pids
+                pr = _group_by_pid(pids, mask, num_out)
+                g_datas = [jnp.take(d, pr.perm) for d in out_datas]
+                g_valids = [None if v is None else jnp.take(v, pr.perm)
+                            for v in out_valids]
+                return g_datas, g_valids, pr.counts
+
+            return jax.jit(kernel)
+
+        kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build)
+        g_datas, g_valids, counts = kernel(
+            [c.data for c in batch.columns],
+            [c.validity for c in batch.columns], batch.row_mask, aux,
+            np.int32(start % num_out), self._bounds_dev)
+        fields = attrs_schema(self.pipe_attrs).fields
+        gathered = []
+        for i, f in enumerate(fields):
+            sdict = host_outs[i].sdict if dict_encoded(f.dataType) else None
+            # the shuffle write's ONE intended sync point: map output
+            # lands in host buffers for IPC/reduce-buffer slicing
+            gathered.append((
+                np.asarray(g_datas[i]),  # tpulint: ignore[host-sync]
+                None if g_valids[i] is None
+                else np.asarray(g_valids[i]),  # tpulint: ignore[host-sync]
+                sdict))
+        return gathered, np.asarray(counts)  # tpulint: ignore[host-sync]
+
+
+# ---------------------------------------------------------------------------
 # FuseStages planner rule
 # ---------------------------------------------------------------------------
 
@@ -535,11 +749,71 @@ def _aggregate_fusable(agg: HashAggregateExec, compute: ComputeExec) -> bool:
             return False
         if attr is not None and attr.expr_id not in out_ids:
             return False
-        if op in ("min", "max") and attr is not None and \
-                dict_encoded(attr.dtype):
-            # rank-space string min/max needs the host inverse-rank map
-            return False
+        # string min/max fuses too: the reduce runs in rank space with
+        # the rank + inverse-rank luts as kernel aux inputs
     return True
+
+
+def _range_sample_source(compute: ComputeExec, order_child):
+    """Input-column position usable to sample range bounds for a fused
+    range exchange: the sort key must PASS THROUGH the pipeline (bounds
+    are sampled from the pre-pipeline batches — a pre-filter superset of
+    the key domain, sound because any bound set partitions the domain
+    correctly, merely less evenly). Returns the input position or None."""
+    src_id = None
+    for o in compute.outputs:
+        if isinstance(o, AttributeReference) and o.expr_id == order_child.expr_id:
+            src_id = o.expr_id
+            break
+        if isinstance(o, Alias) and o.expr_id == order_child.expr_id \
+                and isinstance(o.child, AttributeReference):
+            src_id = o.child.expr_id
+            break
+    if src_id is None:
+        return None
+    for i, a in enumerate(compute.child.output):
+        if a.expr_id == src_id:
+            return i
+    return None
+
+
+def _exchange_fusable(exch, compute: ComputeExec, conf: SQLConf) -> bool:
+    from .partitioning import (
+        HashPartitioning, RangePartitioning, UnknownPartitioning,
+    )
+
+    if not conf.get(FUSION_EXCHANGE):
+        return False
+    if not _compute_nontrivial(compute):
+        return False
+    p = exch.partitioning
+    out_by_id = {a.expr_id: a for a in compute.output}
+    if isinstance(p, HashPartitioning):
+        for e in p.exprs:
+            if not isinstance(e, AttributeReference):
+                return False
+            a = out_by_id.get(e.expr_id)
+            if a is None:
+                return False
+            if isinstance(a.dtype, StringType) or dict_encoded(a.dtype):
+                # string eq-keys ride host-side dictionary hashes
+                return False
+        return True
+    if isinstance(p, UnknownPartitioning):
+        return True  # round-robin: no keys; offset is a kernel argument
+    if isinstance(p, RangePartitioning):
+        if len(p.orders) != 1:
+            return False
+        oc = p.orders[0].child
+        if not isinstance(oc, AttributeReference):
+            return False
+        a = out_by_id.get(oc.expr_id)
+        if a is None or isinstance(a.dtype, StringType) \
+                or dict_encoded(a.dtype):
+            # string pids ride a host rank→pid lut per dictionary
+            return False
+        return _range_sample_source(compute, oc) is not None
+    return False  # SinglePartition gathers without kernels
 
 
 def _probe_fusable(join: HashJoinExec, compute: ComputeExec) -> bool:
@@ -587,6 +861,21 @@ def fuse_stages(plan: PhysicalPlan, conf: SQLConf) -> PhysicalPlan:
             node.probe_attrs = list(c.output)
             node.left = c.child
             node._probe_pipe_cache = None
+            return node
+        from .exchange import ShuffleExchangeExec
+
+        if isinstance(node, ShuffleExchangeExec) \
+                and node.pipe_fusion is None \
+                and isinstance(node.child, ComputeExec) \
+                and _exchange_fusable(node, node.child, conf):
+            # the exchange terminal consumes straight from the fused
+            # stage: the partition-id kernel traces into the pipeline
+            # program (ExchangeFusion) and shuffle writes read its
+            # pid-grouped output — no materialized intermediate batch
+            c = node.child
+            node.pipe_fusion = (list(c.filters), list(c.outputs))
+            node.pipe_attrs = list(c.output)
+            node.child = c.child
             return node
         return node
 

@@ -238,6 +238,196 @@ def test_adjacent_computes_collapse(fusion_spark, spark):
     assert sorted(out["z"]) == sorted((want.y + 1).tolist())
 
 
+# ---------------------------------------------------------------------------
+# Exchange map-side fusion: shuffle writes consume the fused stage
+# ---------------------------------------------------------------------------
+# Partition counts are deliberately NON-powers-of-two (3/5): the test env
+# runs 8 virtual devices, so a power-of-two hash exchange would take the
+# mesh all-to-all instead of the host shuffle path under test.
+
+@pytest.fixture()
+def xdata(spark):
+    rng = np.random.default_rng(11)
+    n = 6000
+    spark.createDataFrame(pa.table({
+        "k": rng.integers(0, 13, n),
+        "v": rng.integers(-50, 100, n),
+        "s": [f"cat{i % 5}" for i in range(n)],
+    })).createOrReplaceTempView("ex_t")
+    return spark
+
+
+def test_exchange_fusion_hash_differential(fusion_spark, xdata):
+    spark = xdata
+    _differential(
+        spark,
+        lambda: (spark.sql("select k, v * 2 as v2, s from ex_t "
+                           "where v > 0").repartition(5, "k")),
+        ["k", "v2", "s"])
+
+
+def test_exchange_fusion_rr_differential(fusion_spark, xdata):
+    spark = xdata
+    _differential(
+        spark,
+        lambda: (spark.sql("select k + 1 as k2, v from ex_t where v != 7")
+                 .repartition(3)),
+        ["k2", "v"])
+
+
+def test_exchange_fusion_range_differential(fusion_spark, spark):
+    import spark_tpu.api.functions as F
+
+    def q():
+        return (spark.range(0, 30000, 1, 3)
+                .filter(F.col("id") % 7 != 0)
+                .withColumn("y", F.col("id") * 3)
+                .orderBy("id"))
+
+    outs = {}
+    for enabled in (True, False):
+        spark.conf.set("spark.tpu.fusion.enabled", str(enabled).lower())
+        outs[enabled] = q().toPandas().reset_index(drop=True)
+    spark.conf.unset("spark.tpu.fusion.enabled")
+    # global sort: row-for-row ordered equality, not just multiset
+    assert outs[True].equals(outs[False])
+
+
+def test_exchange_fused_single_dispatch_per_map_batch(fusion_spark, spark):
+    """Acceptance: a scan→filter→project→shuffle-write map stage executes
+    as ONE fused dispatch per input batch — no separate pipeline launch,
+    no separate partition-id kernel."""
+    cap = 1 << 12  # the session fixture's spark.tpu.batch.capacity
+    n_batches = 4
+    rng = np.random.default_rng(12)
+    t = pa.table({"k": rng.integers(0, 9, cap * n_batches),
+                  "v": rng.integers(0, 100, cap * n_batches)})
+    df_base = spark.createDataFrame(t)
+    spark.conf.set("spark.tpu.fusion.enabled", "true")
+    q = lambda: (df_base.filter(F.col("v") > 25)  # noqa: E731
+                 .withColumn("v2", F.col("v") * 3)
+                 .repartition(5, "k").toArrow())
+    q()  # warm: compile kernels, device-cache the scan
+    delta = _kind_delta(q)
+    assert delta.get("fused_shuffle", 0) == n_batches, delta
+    assert delta.get("pipeline", 0) == 0, delta
+    assert sum(delta.values()) == n_batches, delta
+
+    # the oracle pays >=2 dispatches per map batch for the same work
+    spark.conf.set("spark.tpu.fusion.exchange", "false")
+    try:
+        q()  # warm the unfused kernels
+        unfused = _kind_delta(q)
+        assert unfused.get("fused_shuffle", 0) == 0, unfused
+        assert unfused.get("pipeline", 0) == n_batches, unfused
+        assert sum(unfused.values()) >= 2 * n_batches, unfused
+    finally:
+        spark.conf.unset("spark.tpu.fusion.exchange")
+
+
+def test_exchange_fusion_minrows_gate(fusion_spark, spark):
+    """Partitions under spark.tpu.fusion.minRows take the shared unfused
+    kernels at runtime even though the PLAN carries the fused exchange."""
+    rng = np.random.default_rng(13)
+    t = pa.table({"k": rng.integers(0, 9, 3000),
+                  "v": rng.integers(0, 100, 3000)})
+    df = spark.createDataFrame(t)
+    spark.conf.set("spark.tpu.fusion.enabled", "true")
+    spark.conf.set("spark.tpu.fusion.minRows", str(1 << 17))
+    q = lambda: (df.filter(F.col("v") > 25)  # noqa: E731
+                 .repartition(5, "k").toArrow())
+    q()
+    delta = _kind_delta(q)
+    assert delta.get("fused_shuffle", 0) == 0, delta
+    assert delta.get("pipeline", 0) == 1, delta
+
+
+def test_shuffle_read_batches_seed_dense_range_memo(fusion_spark, xdata):
+    """Map-side column stats seed the dense-range memo at build time:
+    dense agg/join decisions on shuffle-READ batches never launch the
+    krange3 probe, even though the arrays are fresh every run."""
+    from spark_tpu.physical.operators import dense_range_stats
+
+    spark = xdata
+    spark.conf.set("spark.tpu.fusion.enabled", "true")
+    df = spark.sql("select k, v from ex_t where v > 0").repartition(5, "k")
+    parts = df.query_execution.execute()
+    before = KC.launches_by_kind.get("krange3", 0)
+    for part in parts:
+        for b in part:
+            kmin, kmax, any_live = dense_range_stats(
+                b.columns[0], b.row_mask, b.capacity)
+            live = np.asarray(b.columns[0].data)[np.asarray(b.row_mask)]
+            if len(live):
+                assert any_live
+                assert kmin <= int(live.min()) <= int(live.max()) <= kmax
+    assert KC.launches_by_kind.get("krange3", 0) == before
+
+
+def test_exchange_fusion_cluster_differential(fusion_spark, spark):
+    """The cluster worker runs the SAME fused map program: fused vs
+    unfused cluster runs agree, and the worker ships fused_shuffle
+    launch deltas back to the driver."""
+    import spark_tpu.api.functions as F
+    from spark_tpu.api.session import TpuSession
+    from spark_tpu.exec.cluster import LocalCluster
+
+    rng = np.random.default_rng(14)
+    t = pa.table({"k": rng.integers(0, 11, 6000),
+                  "v": rng.integers(-20, 80, 6000)})
+    outs = {}
+    worker_kinds = {}
+    for enabled in ("true", "false"):
+        s = TpuSession(f"fuse-cluster-{enabled}", {
+            "spark.sql.shuffle.partitions": "3",
+            "spark.tpu.batch.capacity": 1 << 12,
+            "spark.sql.adaptive.enabled": "false",
+            "spark.tpu.fusion.enabled": enabled,
+            "spark.tpu.fusion.minRows": "0",
+        })
+        cluster = LocalCluster(num_workers=2)
+        s.attachSqlCluster(cluster)
+        try:
+            s.createDataFrame(t).createOrReplaceTempView("xc_t")
+            df = (s.sql("select k, v * 2 as v2 from xc_t where v > 0")
+                  .repartition(3, "k")
+                  .groupBy("k").agg(F.sum("v2").alias("sv")))
+            outs[enabled] = (df.toPandas().sort_values("k")
+                             .reset_index(drop=True))
+            remote = s._metrics.snapshot()["counters"].get(
+                "scheduler.stages_remote", 0)
+            assert remote >= 1, "map stage never shipped to a worker"
+            worker_kinds[enabled] = dict(
+                df.query_execution._last_ctx.worker_kernel_kinds or {})
+        finally:
+            s.stop()
+    assert outs["true"].equals(outs["false"])
+    assert worker_kinds["true"].get("fused_shuffle", 0) >= 1, worker_kinds
+    assert worker_kinds["false"].get("fused_shuffle", 0) == 0, worker_kinds
+
+
+def test_string_minmax_fused_differential(fusion_spark, xdata):
+    """String MIN/MAX no longer falls back to the unfused path: the fused
+    kernel reduces in rank space with the inverse-rank lut as an aux
+    input, and results match the oracle exactly."""
+    from spark_tpu.physical.fusion import FusedAggregateExec
+
+    spark = xdata
+    q = ("select k, min(s) mn, max(s) mx, count(*) c from ex_t "
+         "where v > 0 group by k")
+    spark.conf.set("spark.tpu.fusion.enabled", "true")
+    plan = spark.sql(q).query_execution.physical
+    assert any(isinstance(n, FusedAggregateExec) for n in plan.iter_nodes()), \
+        plan.tree_string()
+    _differential(spark, lambda: spark.sql(q), ["k"])
+    # ungrouped variant exercises the whole-tile reduce
+    _differential(
+        spark,
+        lambda: spark.sql("select min(s) mn, max(s) mx from ex_t "
+                          "where v % 3 = 0"),
+        ["mn"])
+
+
 def test_dense_range_sync_memoized_across_batches(fusion_spark, spark):
     """Repeated executions over device-cached scan batches must not re-sync
     the dense-range scalars: the krange kernel fires once per distinct

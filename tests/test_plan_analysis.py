@@ -65,25 +65,34 @@ Q7 = """
     GROUP BY i.i_category"""
 
 
-def _predicted_vs_measured(spark, sql):
-    """(analysis report, measured by-kind launch delta of one warm run)."""
-    df = spark.sql(sql)
+def _predicted_vs_measured_df(build):
+    """(analysis report, measured by-kind launch delta of one warm run)
+    for a DataFrame builder (fresh DataFrame per run)."""
+    df = build()
     report = df.query_execution.analysis_report()
     df.toArrow()  # warm: compile kernels, device-cache scans, prime memos
     before = dict(KC.launches_by_kind)
-    spark.sql(sql).toArrow()
+    build().toArrow()
     after = dict(KC.launches_by_kind)
     measured = {k: v - before.get(k, 0) for k, v in after.items()
                 if v != before.get(k, 0)}
     return report, measured
 
 
-def _assert_exact(spark, sql):
-    report, measured = _predicted_vs_measured(spark, sql)
+def _predicted_vs_measured(spark, sql):
+    return _predicted_vs_measured_df(lambda: spark.sql(sql))
+
+
+def _assert_exact_df(build):
+    report, measured = _predicted_vs_measured_df(build)
     assert report.exact, report.inexact_reasons
     assert report.predicted_launches == measured, (
         f"predicted {dict(sorted(report.predicted_launches.items()))} != "
         f"measured {dict(sorted(measured.items()))}\n{report.render()}")
+
+
+def _assert_exact(spark, sql):
+    _assert_exact_df(lambda: spark.sql(sql))
 
 
 # ---------------------------------------------------------------------------
@@ -300,9 +309,10 @@ def test_rr_shuffle_rows_survive_offset_argument(spark):
 
 
 def test_inexact_degrades_honestly(fusion_conf, data):
-    """A hash-exchange query (multi-partition repartition) has runtime-
-    dependent layout: the analyzer must NOT claim exactness, and must say
-    why."""
+    """A MESH hash exchange (power-of-two partitions on this 8-virtual-
+    device env) has data-dependent quota retries: the analyzer must NOT
+    claim exactness, and must say why. (Host-path shuffles — non-power-
+    of-two counts — now predict exactly; see the tests below.)"""
     data.conf.set("spark.tpu.fusion.enabled", "true")
     df = (data.sql("select * from an_t").repartition(4, "k")
           .groupBy("k").count())
@@ -313,3 +323,73 @@ def test_inexact_degrades_honestly(fusion_conf, data):
     assert any(k.startswith(("shuffle_", "mesh_"))
                for k in report.predicted_launches), \
         report.predicted_launches
+
+
+# ---------------------------------------------------------------------------
+# multi-stage shuffle plans: host-side hash of traced keys → EXACT
+# ---------------------------------------------------------------------------
+# Partition counts are non-powers-of-two so the exchanges stay on the host
+# shuffle path (the 8-virtual-device env would otherwise go mesh).
+
+@pytest.mark.parametrize("enabled", ["true", "false"])
+def test_repartition_agg_prediction_exact(fusion_conf, data, enabled):
+    """Acceptance: the value model flows THROUGH the hash exchange
+    (host-side splitmix64 of the traced keys decides per-reducer rows and
+    values), so repartition+agg predicts exactly — krange3 probes, dense
+    vs sorted decisions, and per-batch launches included — fusion on and
+    off."""
+    data.conf.set("spark.tpu.fusion.enabled", enabled)
+    _assert_exact_df(lambda: (data.sql("select * from an_t")
+                              .repartition(5, "k").groupBy("k").count()))
+
+
+@pytest.mark.parametrize("enabled", ["true", "false"])
+def test_fused_exchange_prediction_exact(fusion_conf, data, enabled):
+    """A shuffle-map stage with a nontrivial pipeline: fused (ONE
+    fused_shuffle dispatch per map batch) and unfused (pipeline + shuffle
+    kind) launch models both predict exactly, through the downstream
+    aggregate."""
+    data.conf.set("spark.tpu.fusion.enabled", enabled)
+    _assert_exact_df(lambda: (
+        data.sql("select k, v * 2 as v2 from an_t where v > 0")
+        .repartition(5, "k")))
+    _assert_exact_df(lambda: (
+        data.sql("select k, v * 2 as v2 from an_t where v > 0")
+        .repartition(5, "k").groupBy("k").count()))
+    # round-robin keeps its offset-as-kernel-argument model when fused
+    _assert_exact_df(lambda: (
+        data.sql("select k, v from an_t where v > 0").repartition(3)))
+
+
+def test_fused_exchange_boundary_and_kind(fusion_conf, data):
+    data.conf.set("spark.tpu.fusion.enabled", "true")
+    df = (data.sql("select k, v * 2 as v2 from an_t where v > 0")
+          .repartition(5, "k"))
+    report = df.query_execution.analysis_report()
+    assert "fused_shuffle" in report.predicted_launches, \
+        report.predicted_launches
+    assert any("FUSED map side" in b for b in report.fusion_boundaries), \
+        report.fusion_boundaries
+
+
+def test_string_exchange_key_boundary_explained(fusion_conf, data):
+    """A dictionary-encoded partition key keeps the exchange unfused —
+    and the report says why."""
+    data.conf.set("spark.tpu.fusion.enabled", "true")
+    df = (data.sql("select s, v * 2 as v2 from an_t where v > 0")
+          .repartition(5, "s"))
+    report = df.query_execution.analysis_report()
+    assert "fused_shuffle" not in report.predicted_launches, \
+        report.predicted_launches
+    assert any("UNFUSED exchange" in b and "string" in b
+               for b in report.fusion_boundaries), report.fusion_boundaries
+
+
+@pytest.mark.parametrize("enabled", ["true", "false"])
+def test_string_minmax_fused_prediction_exact(fusion_conf, data, enabled):
+    """String MIN/MAX now rides the fused aggregate kernel (rank-space
+    reduce, inverse-rank lut as aux input) — and the launch model stays
+    exact fusion on and off."""
+    data.conf.set("spark.tpu.fusion.enabled", enabled)
+    _assert_exact(data, "select k, min(s) mn, max(s) mx, count(*) c "
+                        "from an_t where v > 0 group by k")
