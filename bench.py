@@ -71,6 +71,14 @@ if CLUSTER:
     sys.argv = [a for a in sys.argv if a != "--cluster"]
 _CLUSTER_SESSIONS: list = []  # stopped at exit (kills worker processes)
 
+# --progress: live console stage bars while configs run (obs/live.py
+# ConsoleProgressReporter over heartbeat-streamed worker telemetry; a
+# fast heartbeat so even short stages repaint). The reporter writes to
+# stderr — the JSON record stream on stdout stays machine-clean.
+PROGRESS = "--progress" in sys.argv
+if PROGRESS:
+    sys.argv = [a for a in sys.argv if a != "--progress"]
+
 
 def _maybe_analyze(df, name: str):
     """`df` may be a DataFrame or a zero-arg callable producing one (so
@@ -155,6 +163,10 @@ def _session(extra=None):
         conf["spark.tpu.cluster.enabled"] = "true"
         conf["spark.tpu.cluster.workers"] = "2"
         conf["spark.sql.shuffle.partitions"] = 2
+    if PROGRESS:
+        conf["spark.tpu.progress.console"] = "true"
+        conf["spark.tpu.progress.updateInterval"] = "0.2"
+        conf["spark.tpu.heartbeat.interval"] = "0.25"
     conf.update(extra or {})
     if SMOKE:
         conf["spark.tpu.batch.capacity"] = min(
@@ -546,7 +558,8 @@ def _fallback_to_cpu_child() -> int:
     # mode flags were stripped from sys.argv at import — re-append them
     # so the child keeps the requested trace/analyze/cluster behavior
     flags = [f for f, on in (("--analyze", ANALYZE), ("--trace", TRACE),
-                             ("--cluster", CLUSTER)) if on]
+                             ("--cluster", CLUSTER),
+                             ("--progress", PROGRESS)) if on]
     try:  # stdout inherited: child lines flush straight to the driver
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__)]

@@ -24,10 +24,10 @@ SPARK_TPU_TRACE_PATH=/tmp/sparktpu_smoke_trace.json \
     python bench.py --smoke --trace
 JAX_PLATFORMS=cpu python dev/validate_trace.py /tmp/sparktpu_smoke_trace.json
 
-echo "== cluster trace gate (worker-side metric/span shipping + flows) =="
+echo "== cluster trace gate (worker shipping + flows + live telemetry) =="
 SPARK_TPU_TRACE_PATH=/tmp/sparktpu_cluster_trace.json \
     python bench.py --smoke --trace --cluster groupby
-JAX_PLATFORMS=cpu python dev/validate_trace.py --cluster \
+JAX_PLATFORMS=cpu python dev/validate_trace.py --cluster --live \
     /tmp/sparktpu_cluster_trace.json
 
 echo "== micro-benchmarks =="

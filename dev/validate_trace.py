@@ -25,7 +25,16 @@ Three checks, all hard failures:
    non-empty per-operator metrics whose attributed-launch total equals
    the measured (driver + worker) launch total.
 
-Usage: python dev/validate_trace.py [--cluster] <trace.json>
+3. Live-telemetry gate (--live) — a cluster smoke run with a fast
+   executor heartbeat must surface at least one MID-STAGE obs delta on
+   the driver before any task returns (the reference's periodic
+   Heartbeater streaming accumulator updates), and after completion the
+   merged live records must reconcile with the final task-return
+   records (monotonic merge converged: every task done, partial
+   counters superseded exactly, zero straggler findings on the healthy
+   run).
+
+Usage: python dev/validate_trace.py [--cluster] [--live] <trace.json>
 """
 
 import json
@@ -213,15 +222,98 @@ def drift_gate(cluster: bool = False) -> None:
         session.stop()
 
 
+def live_gate() -> None:
+    """Heartbeat-streamed telemetry must be operational, not post-mortem:
+    run a deliberately slow map stage on a 2-worker cluster heartbeating
+    every 0.1s, require ≥1 mid-stage obs delta on the driver BEFORE the
+    task-return record lands, then require the live store to have
+    converged to the task-return truth (every cluster task done and
+    reconciled) with zero straggler findings on the healthy run."""
+    import time
+
+    import numpy as np
+    import pyarrow as pa
+
+    import spark_tpu.api.functions as F
+    from spark_tpu import TpuSession
+    from spark_tpu.types import int64
+
+    session = TpuSession("live-gate", {
+        "spark.tpu.batch.capacity": 1 << 12,
+        "spark.sql.shuffle.partitions": 2,
+        "spark.sql.adaptive.enabled": "false",
+        "spark.tpu.cluster.enabled": "true",
+        "spark.tpu.cluster.workers": "2",
+        "spark.tpu.heartbeat.interval": "0.1",
+    })
+    try:
+        rng = np.random.default_rng(23)
+        session.createDataFrame(pa.table({
+            "k": rng.integers(0, 8, 4000),
+            "v": rng.integers(-20, 60, 4000),
+        })).createOrReplaceTempView("live_t")
+
+        @F.udf(returnType=int64)
+        def crawl(k):
+            time.sleep(0.4)   # several 0.1s heartbeats per map batch
+            return k * 2
+
+        qids = []
+        session.listener_bus.register(lambda ev: qids.append(ev.query_id))
+        live = session.live_obs
+        base = live.partials_seen
+        (session.table("live_t").withColumn("kk", crawl("k"))
+         .repartition(2).groupBy("k").agg(F.sum("v").alias("s"))).toArrow()
+        session.listener_bus.wait_empty()
+        if live.partials_seen <= base:
+            fail("--live: no mid-stage heartbeat obs delta reached the "
+                 "driver before task return")
+        if not qids:
+            fail("--live: query event never fired (no query id to check)")
+        progress = live.query_progress(qids[-1])
+        if progress is None:
+            fail("--live: live store has no record of the gate query")
+        streamed = 0
+        for stage, st in progress["stages"].items():
+            if stage == "local":
+                continue
+            if st["tasks_done"] != st["tasks_total"]:
+                fail(f"--live: stage {stage} never closed in the live "
+                     f"store ({st['tasks_done']}/{st['tasks_total']})")
+            for task, t in st["tasks"].items():
+                if t["partials"] > 0:
+                    streamed += 1
+                    if t["reconciled"] is not True:
+                        fail(f"--live: task {task} of stage {stage} "
+                             "streamed partials that do NOT reconcile "
+                             "with its final task-return record")
+        if streamed < 1:
+            fail("--live: no cluster task streamed a mid-stage partial "
+                 "for the gate query")
+        stragglers = [f for f in progress["findings"]
+                      if f.get("kind") == "obs.straggler"]
+        if stragglers:
+            fail("--live: healthy run raised straggler findings: "
+                 + "; ".join(f["msg"] for f in stragglers))
+        print(f"validate_trace: live gate OK — {live.partials_seen - base} "
+              f"heartbeat deltas, {streamed} task(s) streamed partials "
+              "and reconciled, 0 stragglers")
+    finally:
+        session.stop()
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     cluster = "--cluster" in argv
-    argv = [a for a in argv if a != "--cluster"]
+    live = "--live" in argv
+    argv = [a for a in argv if a not in ("--cluster", "--live")]
     if len(argv) != 1:
         print(__doc__)
         return 2
     validate_trace(argv[0], cluster=cluster)
     drift_gate(cluster=cluster)
+    if live:
+        live_gate()
     print("validate_trace: PASS")
     return 0
 

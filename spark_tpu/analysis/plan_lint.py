@@ -74,15 +74,21 @@ class _Batch:
     rows: Optional[int]      # live-row upper bound (None = unknown)
     cap: Optional[int]       # device tile capacity (None = unknown)
     stable: bool             # same device arrays across executions
-    # shuffle-read tiles carry map-side column stats: the dense-range
+    # shuffle-built tiles carry map-side column stats: the dense-range
     # memo is seeded at build/ingest time, so the krange3 probe never
-    # fires even though the arrays are fresh every run
-    seeded: bool = False
+    # fires even though the arrays are fresh every run. True = every
+    # integral column is seeded (the pre-annotation legacy model);
+    # a frozenset holds the expr ids the exchange actually accumulates
+    # (ShuffleExchangeExec.stat_cols — plan-reachable dense candidates)
+    seeded: "bool | frozenset" = False
 
-    @property
-    def probe_free(self) -> bool:
-        """No krange3 dispatch when this batch's range is consulted."""
-        return self.stable or self.seeded
+    def probe_free_for(self, expr_id) -> bool:
+        """No krange3 dispatch when THIS column's range is consulted:
+        stable arrays hit the memo from a prior run; seeded tiles were
+        pre-populated for that column at build time."""
+        if self.stable or self.seeded is True:
+            return True
+        return isinstance(self.seeded, frozenset) and expr_id in self.seeded
 
 
 @dataclass
@@ -585,7 +591,6 @@ class _Analyzer:
         _Trace|None) so downstream stages keep predicting exactly."""
         vals = node._plan_values()
         has_pc = any(op in ("percentile", "collect") for op, _, _ in vals)
-        probe = len(batches) > 1 or any(not b.probe_free for b in batches)
         caps = [b.cap for b in batches]
         cap = bucket_capacity(sum(caps)) if all(
             c is not None for c in caps) and caps else None
@@ -599,6 +604,9 @@ class _Analyzer:
 
         single_int_key = len(node.grouping) == 1 and isinstance(
             node.grouping[0].dtype, (IntegralType, DateType))
+        kid = node.grouping[0].expr_id if single_int_key else None
+        probe = len(batches) > 1 or any(not b.probe_free_for(kid)
+                                        for b in batches)
         dense = False
         ginfo = None
         span = None
@@ -803,7 +811,8 @@ class _Analyzer:
                 continue
             kinds["fused_agg"] += len(p)
             if key_passthrough and self._dense_keys:
-                fresh_in = sum(1 for b in p if not b.probe_free)
+                kid = node.grouping[0].expr_id
+                fresh_in = sum(1 for b in p if not b.probe_free_for(kid))
                 kinds["krange3"] += fresh_in
                 if fresh_in == 0:
                     notes.append("dense-range decision memoized/seeded per "
@@ -998,7 +1007,9 @@ class _Analyzer:
             bcaps = [b.cap for b in rp]
             bknown = all(c is not None for c in bcaps) and rp
             bcap = bucket_capacity(sum(bcaps)) if bknown else None
-            bfresh = (len(rp) != 1) or any(not b.probe_free for b in rp)
+            bkid = node.right_keys[0].expr_id if single_int_bkey else None
+            bfresh = (len(rp) != 1) or any(not b.probe_free_for(bkid)
+                                           for b in rp)
             grace = False
             if bknown:
                 budget = self._join_budget(node)
@@ -1232,17 +1243,32 @@ class _Analyzer:
             return False
 
     # -- exchange layout/value helpers -------------------------------------
-    def _built_partition(self, rows_p: int) -> list:
+    @staticmethod
+    def _exchange_seeded(node) -> "bool | frozenset":
+        """Which output columns the exchange's map-side write accumulates
+        stats for — the SAME annotation the execution layer consumes
+        (ShuffleExchangeExec.stat_cols, set by annotate_exchange_stat_
+        cols to the plan-reachable dense candidates). None = the legacy
+        every-integral-column model (bare plans)."""
+        sc = getattr(node, "stat_cols", None)
+        if sc is None:
+            return True
+        out = node.output
+        return frozenset(out[i].expr_id for i in sc if i < len(out))
+
+    def _built_partition(self, rows_p: int,
+                         seeded: "bool | frozenset" = True) -> list:
         """Output tiles of one reduce partition as exec/shuffle._OutBuffer
         builds them: tile rows capped at spark.tpu.batch.capacity,
         power-of-two capacity per tile, every tile pre-seeded with the
-        map-side column stats (fresh arrays, no krange3 probe)."""
+        map-side column stats of the exchange's stat columns (fresh
+        arrays, no krange3 probe for those columns)."""
         if rows_p == 0:
-            return [_Batch(0, _EMPTY_CAP, False, seeded=True)]
+            return [_Batch(0, _EMPTY_CAP, False, seeded=seeded)]
         out = []
         for start in range(0, rows_p, self._tile):
             n = min(self._tile, rows_p - start)
-            out.append(_Batch(n, bucket_capacity(n), False, seeded=True))
+            out.append(_Batch(n, bucket_capacity(n), False, seeded=seeded))
         return out
 
     def _exchange_input_traces(self, node, child: _Flow,
@@ -1263,7 +1289,8 @@ class _Analyzer:
         return traces
 
     def _shuffled_flow(self, in_traces: list, pids_per_part: list,
-                       num_out: int) -> _Flow:
+                       num_out: int,
+                       seeded: "bool | frozenset" = True) -> _Flow:
         """Exact post-shuffle layout + per-reduce-partition value traces:
         reduce partition q = every input partition's live rows with
         pid == q, input order preserved (the stable pid sort groups rows
@@ -1276,7 +1303,7 @@ class _Analyzer:
         for q in range(num_out):
             sels = [np.nonzero(pids == q)[0] for pids in pids_per_part]
             rows_q = int(sum(len(s) for s in sels))
-            parts.append(self._built_partition(rows_q))
+            parts.append(self._built_partition(rows_q, seeded))
             cols_q = {}
             for k in ids:
                 vals = np.concatenate(
@@ -1368,6 +1395,7 @@ class _Analyzer:
                                  self._host_shuffle_kind(), kinds, notes)
             self._sync("host sort-shuffle pulls grouped columns to host "
                        "once per batch (by design: the DCN path)")
+            seeded = self._exchange_seeded(node)
             flow = None
             in_traces = self._exchange_input_traces(node, child, fused)
             key_ids = [e.expr_id for e in p.exprs
@@ -1381,14 +1409,14 @@ class _Analyzer:
                     pids_per_part.append(_np_hash_pids(
                         [tc.cols[k] for k in key_ids], p.num_partitions))
                 flow = self._shuffled_flow(in_traces, pids_per_part,
-                                           p.num_partitions)
+                                           p.num_partitions, seeded)
                 notes.append("reduce layout EXACT: host-side splitmix64 "
                              "of the traced keys decides per-reducer rows")
             if flow is None:
                 self._approx("hash exchange reduce layout untraced (key "
                              "values unknown): downstream counts are "
                              "approximate")
-                flow = _Flow([[_Batch(None, None, False, seeded=True)]
+                flow = _Flow([[_Batch(None, None, False, seeded=seeded)]
                               for _ in range(p.num_partitions)], None,
                              counted=False)
             self._stage(node, kinds, child.total_batches if child.counted
@@ -1401,7 +1429,8 @@ class _Analyzer:
                          "single gather (data-dependent)")
             self._sync("range-bound sampling reads per-batch samples "
                        "host-side (memoized per column identity)")
-            out = [[_Batch(None, None, False, seeded=True)]
+            out = [[_Batch(None, None, False,
+                           seeded=self._exchange_seeded(node))]
                    for _ in range(p.num_partitions)]
             self._stage(node, kinds, child.total_batches if child.counted
                         else None, notes)
@@ -1409,6 +1438,7 @@ class _Analyzer:
         if isinstance(p, UnknownPartitioning):
             self._map_side_kinds(node, child, fused, "shuffle_rr", kinds,
                                  notes)
+            seeded = self._exchange_seeded(node)
             # the running row offset rides as a kernel argument, so the
             # cache key is (capacity, num_out)-shaped — no recompile
             # hazard (the historical storm keyed by start % num_out;
@@ -1428,11 +1458,11 @@ class _Analyzer:
                         .astype(np.int32))
                     offset += n
                 flow = self._shuffled_flow(in_traces, pids_per_part,
-                                           p.num_partitions)
+                                           p.num_partitions, seeded)
                 notes.append("reduce layout EXACT: round-robin over the "
                              "traced live-row order")
             if flow is None:
-                flow = _Flow([[_Batch(None, None, False, seeded=True)]
+                flow = _Flow([[_Batch(None, None, False, seeded=seeded)]
                               for _ in range(p.num_partitions)], None,
                              counted=False)
             self._stage(node, kinds, child.total_batches if child.counted

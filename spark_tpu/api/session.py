@@ -107,6 +107,14 @@ class TpuSession:
         # always-on span tracing (spark.tpu.trace.enabled flips it live);
         # pure host bookkeeping — see obs/tracing.py
         self.tracer = Tracer(conf=self.conf)
+        from ..obs.live import LiveObs
+
+        # live telemetry store: heartbeat-streamed worker obs partials,
+        # in-flight stage progress, straggler findings (obs/live.py) —
+        # created BEFORE the conf-driven cluster attach so the cluster's
+        # heartbeat handler has a sink from its first beat
+        self.live_obs = LiveObs(conf=self.conf)
+        self._progress_reporter = None
         self.listener_bus = ListenerBus()
         if str(self.conf.get("spark.eventLog.enabled", "false")).lower() \
                 == "true":
@@ -134,18 +142,33 @@ class TpuSession:
                 raise ValueError(
                     "spark.tpu.master set but no secret: provide "
                     "spark.tpu.master.secret or SPARK_TPU_MASTER_SECRET")
+            from ..config import HEARTBEAT_INTERVAL
+
             self._sql_cluster = StandaloneCluster(
                 master, str(secret),
                 int(self.conf.get("spark.executor.instances", 2)),
-                app_name=self.name, push_shuffle=push)
+                app_name=self.name, push_shuffle=push,
+                heartbeat_interval=float(self.conf.get(
+                    HEARTBEAT_INTERVAL)))
         elif str(self.conf.get("spark.tpu.cluster.enabled",
                                "false")).lower() == "true":
+            from ..config import HEARTBEAT_INTERVAL
             from ..exec.cluster import LocalCluster
 
             self._sql_cluster = LocalCluster(
                 num_workers=int(self.conf.get("spark.tpu.cluster.workers",
                                               2)),
-                push_shuffle=push)
+                push_shuffle=push,
+                heartbeat_interval=float(self.conf.get(
+                    HEARTBEAT_INTERVAL)))
+        if getattr(self, "_sql_cluster", None) is not None:
+            self._wire_cluster_obs(self._sql_cluster)
+
+    def _wire_cluster_obs(self, cluster) -> None:
+        """Point the cluster's heartbeat telemetry at this session's
+        live store (executor heartbeats carry per-task obs partials)."""
+        if hasattr(cluster, "obs_sink"):
+            cluster.obs_sink = self.live_obs.on_heartbeat
 
     @property
     def listenerManager(self):
@@ -311,13 +334,32 @@ class TpuSession:
         """Route non-result SQL stages to a process cluster
         (exec/cluster_sql.py — the multi-host stage execution contract)."""
         self._sql_cluster = cluster
+        self._wire_cluster_obs(cluster)
         return self
+
+    def _ensure_progress_reporter(self):
+        """Start the console progress reporter on first use
+        (spark.tpu.progress.console — ConsoleProgressBar role); lives
+        until session stop."""
+        if self._progress_reporter is None:
+            from ..obs.live import ConsoleProgressReporter
+
+            self._progress_reporter = ConsoleProgressReporter(
+                self.live_obs, conf=self.conf).start()
+        return self._progress_reporter
 
     def detachSqlCluster(self) -> "TpuSession":
         self._sql_cluster = None
         return self
 
     def stop(self) -> None:
+        pr = getattr(self, "_progress_reporter", None)
+        if pr is not None:
+            try:
+                pr.stop()
+            except Exception:
+                pass
+            self._progress_reporter = None
         for q in self._streams:
             try:
                 q.stop()

@@ -292,6 +292,59 @@ CLUSTER_OBS_SHIPPING = _register(ConfigEntry(
     "cluster queries report driver-side observability only (saves the "
     "payload bytes on very wide fan-outs).", _bool))
 
+# --- live telemetry (spark_tpu/obs/live.py) --------------------------------
+
+HEARTBEAT_INTERVAL = _register(ConfigEntry(
+    "spark.tpu.heartbeat.interval", 3.0,
+    "Executor heartbeat period in seconds (exec/worker_main.py → driver; "
+    "the reference's spark.executor.heartbeatInterval). Live obs deltas "
+    "ride the same call, so this is also the worker-side flush cadence.",
+    float))
+
+HEARTBEAT_OBS = _register(ConfigEntry(
+    "spark.tpu.heartbeat.obs", True,
+    "Stream incremental observability deltas (open/closed spans since "
+    "last flush, per-operator rows/batches/wall-ms, per-kind KernelCache "
+    "launch/compile deltas) of running stage tasks on the executor "
+    "heartbeat, feeding the driver's live store (obs/live.py). Pure host "
+    "bookkeeping — zero kernel launches, no mid-query device syncs "
+    "(parked row-masks stay parked until task end).", _bool))
+
+PROGRESS_CONSOLE = _register(ConfigEntry(
+    "spark.tpu.progress.console", False,
+    "Render live per-stage progress bars (tasks done, rows/launches so "
+    "far, straggler flags) to stderr while queries run, fed by the live "
+    "telemetry store (reference: spark.ui.showConsoleProgress / "
+    "ConsoleProgressBar).", _bool))
+
+PROGRESS_UPDATE_INTERVAL = _register(ConfigEntry(
+    "spark.tpu.progress.updateInterval", 0.5,
+    "Console progress / local-mode flush repaint period in seconds.",
+    float))
+
+STRAGGLER_ENABLED = _register(ConfigEntry(
+    "spark.tpu.straggler.enabled", True,
+    "Flag straggling stage tasks from live heartbeat telemetry "
+    "(obs.straggler findings in live status and EXPLAIN ANALYZE; signal "
+    "hook for speculative execution).", _bool))
+
+STRAGGLER_RATE_FRACTION = _register(ConfigEntry(
+    "spark.tpu.straggler.rateFraction", 0.2,
+    "A running task is a straggler when its progress rate (rows+batches+"
+    "launches per second) falls below this fraction of the stage-wide "
+    "median rate.", float))
+
+STRAGGLER_MIN_SECONDS = _register(ConfigEntry(
+    "spark.tpu.straggler.minSeconds", 1.0,
+    "Minimum task runtime before rate-based straggler detection may "
+    "fire (healthy short tasks must never be flagged).", float))
+
+STRAGGLER_HEARTBEAT_DEADLINE = _register(ConfigEntry(
+    "spark.tpu.straggler.heartbeatDeadline", 30.0,
+    "A running task whose live telemetry goes silent for this many "
+    "seconds is flagged as a straggler regardless of rate (executor "
+    "frozen or partitioned).", float))
+
 
 class SQLConf:
     """Session-local config with string overrides over typed defaults.

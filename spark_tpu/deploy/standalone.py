@@ -47,6 +47,11 @@ class StandaloneCluster(LocalCluster):
             if self.push_shuffle and self.shuffle_service_addr:
                 env_extra["SPARK_TPU_SHUFFLE_PUSH_ADDR"] = \
                     self.shuffle_service_addr
+            if self.heartbeat_interval is not None:
+                # daemon-launched executors heartbeat (and flush live
+                # obs) at the session's configured cadence too
+                env_extra["SPARK_TPU_HEARTBEAT_INTERVAL"] = \
+                    str(self.heartbeat_interval)
             self.app_id = self._master.call("submit_app", pickle.dumps({
                 "name": app_name,
                 "driver_addr": self.driver_addr,

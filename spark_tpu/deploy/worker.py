@@ -25,11 +25,17 @@ from ..net.transport import RpcClient, RpcServer
 class WorkerDaemon:
     def __init__(self, master_addr: str, token: str,
                  host: str = "127.0.0.1", cores: int = 2,
-                 heartbeat_interval: float = 1.0):
+                 heartbeat_interval: float | None = None):
         self.master_addr = master_addr
         self.token = token
         self.host = host
         self.cores = cores
+        if heartbeat_interval is None:
+            # same knob the executor heartbeat honors (worker_env /
+            # spark.tpu.heartbeat.interval), capped so master-side
+            # liveness expiry stays responsive on long settings
+            heartbeat_interval = min(float(os.environ.get(
+                "SPARK_TPU_HEARTBEAT_INTERVAL", "1.0")), 5.0)
         self.heartbeat_interval = heartbeat_interval
         self._lock = threading.Lock()
         # app_id → list of executor Popen handles

@@ -105,12 +105,15 @@ class _Worker:
 
 def worker_env(driver_addr: str, token: str,
                host_label: str = "localhost",
-               bind_host: str = "127.0.0.1") -> dict:
+               bind_host: str = "127.0.0.1",
+               heartbeat_interval: float | None = None) -> dict:
     """Environment for a worker process: CPU-pinned jax (workers never
     dial the TPU tunnel — the chip belongs to the driver) + driver
     coordinates. `bind_host` is the address the worker's own server
     binds AND advertises; a worker on another machine sets it to an IP
-    the driver and peer workers can reach."""
+    the driver and peer workers can reach. `heartbeat_interval` sets the
+    executor heartbeat/live-obs flush cadence in seconds
+    (spark.tpu.heartbeat.interval)."""
     env = dict(os.environ)
     env["PALLAS_AXON_POOL_IPS"] = ""  # no TPU tunnel in workers
     env["JAX_PLATFORMS"] = "cpu"
@@ -118,6 +121,8 @@ def worker_env(driver_addr: str, token: str,
     env["SPARK_TPU_DRIVER_ADDR"] = driver_addr
     env["SPARK_TPU_WORKER_HOST"] = host_label
     env["SPARK_TPU_BIND_HOST"] = bind_host
+    if heartbeat_interval is not None:
+        env["SPARK_TPU_HEARTBEAT_INTERVAL"] = str(heartbeat_interval)
     root = os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
@@ -142,12 +147,23 @@ class LocalCluster:
                  max_workers: int | None = None,
                  executor_idle_timeout: float = 10.0,
                  shuffle_service: bool = False,
-                 push_shuffle: bool = False):
+                 push_shuffle: bool = False,
+                 heartbeat_interval: float | None = None):
         self.max_task_failures = max_task_failures
         self.registry = ExecutorRegistry()
         self.health = HealthTracker(self.registry, max_failures=2)
         self.token = secrets.token_hex(16)
         self.bind_host = bind_host
+        self.heartbeat_interval = heartbeat_interval
+        # live-telemetry sink: executor heartbeats carry obs deltas of
+        # running stage tasks; the owning session points this at its
+        # LiveObs.on_heartbeat (obs/live.py). None = deltas dropped.
+        self.obs_sink = None
+        # straggler signal hook (obs/live.LiveObs.active_stragglers):
+        # when it reports flagged tasks, speculation launches the backup
+        # copy immediately instead of waiting out the duration-history
+        # threshold
+        self.speculation_signal = None
         # speculative execution (TaskSetManager.scala:80-88 checkSpeculatableTasks
         # role): when a task runs longer than multiplier × median of
         # completed tasks (or the fixed interval), a second copy launches
@@ -232,13 +248,31 @@ class LocalCluster:
         return eid.encode()
 
     def _on_heartbeat(self, payload: bytes) -> bytes:
-        ok = self.registry.heartbeat(payload.decode())
+        """Heartbeat = liveness + live telemetry (HeartbeatReceiver +
+        the reference's executor metrics/accumulator-update channel in
+        one call): the payload is a pickled {eid, obs} dict whose obs
+        list carries per-task mid-stage snapshots, routed to the
+        session's LiveObs. Bare-eid payloads (externally-started legacy
+        workers) stay accepted."""
+        try:
+            msg = pickle.loads(payload)
+        except Exception:
+            msg = {"eid": payload.decode()}
+        eid = msg["eid"]
+        ok = self.registry.heartbeat(eid)
+        sink = self.obs_sink
+        if ok and sink is not None and msg.get("obs"):
+            try:
+                sink(eid, msg["obs"])
+            except Exception:
+                pass    # telemetry must never fail a liveness heartbeat
         return b"ok" if ok else b"unknown"
 
     # ------------------------------------------------------------------
     def _spawn(self, host_label: str = "localhost") -> subprocess.Popen:
         env = worker_env(self.driver_addr, self.token, host_label,
-                         bind_host=self.bind_host)
+                         bind_host=self.bind_host,
+                         heartbeat_interval=self.heartbeat_interval)
         if self.push_shuffle:
             # push mode: blocks travel over the network to the service —
             # the cross-host deployment (no shared filesystem assumed)
@@ -305,14 +339,17 @@ class LocalCluster:
         return self.run_task_traced(fn, *args, pool=pool)[0]
 
     def run_task_traced(self, fn: Callable, *args,
-                        pool: str = "default") -> tuple:
+                        pool: str = "default", task_key=None) -> tuple:
         """Run a task; returns (result, worker) so callers can register
-        which executor holds the outputs (MapOutputTracker role)."""
+        which executor holds the outputs (MapOutputTracker role).
+        `task_key` identifies the task to the live straggler signal
+        (cluster_sql passes (shuffle id, map id)) so speculation scopes
+        its decision to THIS task."""
         payload = cloudpickle.dumps((fn, args))
         with self._lock:
             self._active_tasks += 1
         try:
-            return self._run_with_retries(payload, pool)
+            return self._run_with_retries(payload, pool, task_key)
         finally:
             with self._lock:
                 self._active_tasks -= 1
@@ -334,7 +371,7 @@ class LocalCluster:
             return True
 
     def _run_with_retries(self, payload: bytes,
-                          pool: str = "default") -> tuple:
+                          pool: str = "default", task_key=None) -> tuple:
         last: Exception | None = None
         with self._lock:
             self._pool_waiting[pool] = self._pool_waiting.get(pool, 0) + 1
@@ -358,7 +395,7 @@ class LocalCluster:
                         self._pool_running.get(pool, 0) + 1
                 try:
                     if self.speculation:
-                        return self._run_speculative(payload, w)
+                        return self._run_speculative(payload, w, task_key)
                     try:
                         return w.run_locked(payload), w
                     finally:
@@ -438,7 +475,30 @@ class LocalCluster:
         return max(0.1, self.speculation_multiplier
                    * hist[len(hist) // 2])
 
-    def _run_speculative(self, payload: bytes, primary: _Worker) -> tuple:
+    def _signal_flags(self, task_key) -> bool:
+        """Does the live straggler signal (obs/live.py via
+        cluster_sql's keyed lambda) flag THIS task? Scoping the check
+        to the task key keeps one straggler from collapsing the
+        speculation threshold for every in-flight task — which is also
+        why a task WITHOUT a key never consumes the signal: an unkeyed
+        run_task with 'is any task anywhere straggling?' semantics
+        would double-launch every unrelated task the moment one
+        straggler is flagged. Keyless tasks rely on the
+        duration-history threshold alone."""
+        sig = self.speculation_signal
+        if sig is None or task_key is None:
+            return False
+        try:
+            try:
+                # host list truthiness (LiveObs findings), never device
+                return bool(sig(task_key))  # tpulint: ignore[host-sync]
+            except TypeError:
+                return bool(sig())  # tpulint: ignore[host-sync]
+        except Exception:
+            return False
+
+    def _run_speculative(self, payload: bytes, primary: _Worker,
+                         task_key=None) -> tuple:
         """First-success-wins across up to two attempts. `primary`
         arrives with its slot already acquired; each attempt thread
         releases its own slot. The straggler's reply (it still completes
@@ -468,11 +528,22 @@ class LocalCluster:
 
         launch(primary)
         threshold = self._speculation_threshold()
+        sig = self.speculation_signal
         first = None
-        if threshold is not None:
-            try:
-                first = q.get(timeout=threshold)
-            except queue.Empty:
+        backup_launched = False
+        deadline = (time.monotonic() + threshold) \
+            if threshold is not None else None
+        # wait for the primary: the duration-history threshold bounds
+        # the wait, and the live straggler signal — polled, scoped to
+        # THIS task — cuts it short the moment the task is flagged
+        # mid-flight (a straggler is only ever flagged AFTER launch, so
+        # a one-shot check at launch time would never fire)
+        while first is None and not backup_launched:
+            if deadline is None and sig is None:
+                break  # no speculation trigger possible: plain wait below
+            now = time.monotonic()
+            if (deadline is not None and now >= deadline) or \
+                    self._signal_flags(task_key):
                 try:
                     backup = self._pick_free(timeout=0)
                 except ExecutorLostError:
@@ -481,6 +552,15 @@ class LocalCluster:
                     self.stats["speculative_launched"] = \
                         self.stats.get("speculative_launched", 0) + 1
                     launch(backup)
+                backup_launched = True
+                break
+            step = 0.1 if sig is not None else deadline - now
+            if deadline is not None:
+                step = min(step, max(deadline - now, 0.0))
+            try:
+                first = q.get(timeout=max(step, 0.0))
+            except queue.Empty:
+                pass
         while True:
             kind, val, w, dur = first if first is not None else q.get()
             first = None

@@ -29,6 +29,7 @@ from ..physical.operators import PhysicalPlan
 from .map_output import (
     FetchFailedError, MapOutputTracker, MapStatus, MergeStatus,
     ShuffleStatus, fetch_block, fetch_merged, free_shuffle, map_block_id,
+    merge_flow_id,
 )
 from .scheduler import DAGScheduler, Stage, _StageOutput, build_stage_graph
 
@@ -116,14 +117,22 @@ class FetchExec(PhysicalPlan):
         return UnknownPartitioning(max(n, 1))
 
     def _flow_parents(self) -> list:
-        """Deterministic flow ids of the map-task spans that produced
-        this shuffle (the same ids `_run_stage_store` stamps on its task
-        root span) — the exporter draws map task → reduce fetch arrows
-        from them, across processes. Capped so args stay small on very
-        wide shuffles."""
+        """Deterministic flow ids of the spans that produced this
+        shuffle's blocks: the map-task spans (`_run_stage_store` stamps
+        `map_block_id` on its task root span) and — when the shuffle was
+        push-merged — the driver's merge-finalize span
+        (`merge_flow_id`), so exchange edges run map task → merge →
+        reduce fetch instead of stopping at the fetch. The exporter
+        draws the arrows across processes; capped so args stay small on
+        very wide shuffles."""
         num_maps = len(self.maps)
-        return [map_block_id(self.shuffle_id, mid, num_maps)
-                for mid, _ in sorted(self.maps)[:16]]
+        parents = [map_block_id(self.shuffle_id, mid, num_maps)
+                   for mid, _ in sorted(self.maps)[:16]]
+        if self.merge is not None and any(self.merge[1].values()):
+            # merged chunks have a producing span on the driver
+            # (ClusterDAGScheduler._finalize_merge) — parent to it too
+            parents.append(merge_flow_id(self.shuffle_id))
+        return parents
 
     def _fetch_rid(self, rid: int, clients: dict, schema, ctx) -> list:
         """One reduce partition: merged chunk first, per-map fallback."""
@@ -205,8 +214,11 @@ def _run_stage_store(plan_bytes: bytes, conf_overrides: dict,
     each output partition as a block in THIS worker's store (and push it
     to the merge service in push mode), return per-partition
     (rows, bytes) — the MapStatus payload — plus the task's shipped
-    observability (per-operator records, spans, kernel deltas; the
-    executor-heartbeat metrics channel reduced to per-task return).
+    observability (per-operator records, spans, kernel deltas). While
+    the task RUNS, the same recorder streams partial snapshots on the
+    executor heartbeat (worker_main.collect_live_obs — the reference's
+    periodic Heartbeater), keyed by the (query, shuffle, map) identity
+    passed here so the driver's LiveObs merges them per task.
     Runs in a worker process: the obs recorder is process-local, spans
     record under the driver's query scope, and the task root span
     carries a deterministic flow id (`map_block_id`) so reduce-side
@@ -228,7 +240,8 @@ def _run_stage_store(plan_bytes: bytes, conf_overrides: dict,
 
     plan = cloudpickle.loads(plan_bytes)
     conf = SQLConf(dict(conf_overrides))
-    obs = WM.begin_stage_obs(conf)
+    obs = WM.begin_stage_obs(conf, query_id=query_id,
+                             stage_id=shuffle_id, task_id=map_id)
     ctx = ExecContext(conf=conf)
     if obs is not None:
         if obs["rec"] is not None:
@@ -236,7 +249,7 @@ def _run_stage_store(plan_bytes: bytes, conf_overrides: dict,
             ctx.kernel_attribution = obs["attribution"]
         ctx.tracer = obs["tracer"]
     qtoken = push_query(query_id) if query_id is not None else None
-    try:
+    try:  # noqa: SIM105 — failed tasks must deregister from live flushing
         task_span = ctx.tracer.span(
             f"task[{map_block_id(shuffle_id, map_id, num_maps)}]",
             cat="worker",
@@ -258,6 +271,11 @@ def _run_stage_store(plan_bytes: bytes, conf_overrides: dict,
         finally:
             if task_span is not None:
                 task_span.__exit__(None, None, None)
+    except BaseException:
+        # the task failed: stop streaming its partials NOW (the retry
+        # will register a fresh recorder under the same identity)
+        WM.finish_stage_obs(obs)
+        raise
     finally:
         if qtoken is not None:
             pop_query(qtoken)
@@ -292,6 +310,23 @@ class ClusterDAGScheduler(DAGScheduler):
 
         if ctx.conf.get(SPECULATION):
             cluster.speculation = True
+        # live telemetry: heartbeat-streamed partials land in the
+        # session's LiveObs (obs/live.py); the final task-return record
+        # supersedes them (_run_remote → task_finished). The straggler
+        # detector doubles as the speculative-execution signal hook.
+        self.live = getattr(ctx, "live_obs", None)
+        if self.live is not None:
+            if getattr(cluster, "obs_sink", None) is None:
+                cluster.obs_sink = self.live.on_heartbeat
+            if getattr(cluster, "speculation", False):
+                # keyed on (stage sid, map_id): the speculative wait
+                # consults the signal for ITS OWN task, so one flagged
+                # straggler doesn't collapse the threshold for every
+                # in-flight task (key=None keeps the any-straggler view)
+                cluster.speculation_signal = (
+                    lambda key=None, live=self.live: any(
+                        key is None or (f[1], f[2]) == key
+                        for f in live.active_stragglers()))
 
     def _run(self, plan):
         # DAGScheduler.run wraps this with the driver-process KernelCache
@@ -372,6 +407,14 @@ class ClusterDAGScheduler(DAGScheduler):
                     return
                 except Exception as e:
                     last_err = e
+                    if self.live is not None:
+                        # the retry runs under a NEW sid — close the
+                        # failed attempt's live entries or they trip the
+                        # heartbeat-silence straggler deadline forever
+                        from ..obs.tracing import current_query as _cq
+
+                        self.live.stage_abandoned(
+                            _cq(), self._shuffle_id(stage))
                     sid = _fetch_failed_shuffle_id(e)
                     if sid is not None:
                         # a parent's blocks are gone — regenerate it from
@@ -440,14 +483,29 @@ class ClusterDAGScheduler(DAGScheduler):
         flow_parent = current_flow()
 
         def run_map(map_id: int):
+            import time as _time
+
             plan = (_slice_fetch_leaves(shipped, map_id, num_maps)
                     if num_maps > 1 else shipped)
+            t_start = _time.time()
             result, worker = self.cluster.run_task_traced(
                 _run_stage_store, cloudpickle.dumps(plan),
                 self.conf_overrides, sid, map_id, num_maps,
-                qid, flow_parent)
+                qid, flow_parent, task_key=(sid, map_id))
             tag, addr, rows, sizes, counters, obs, col_stats = result
             assert tag == "mapstatus", tag
+            # close the task in the live store the moment ITS result
+            # lands (not at the stage barrier): the final record
+            # supersedes the heartbeat partials, and a completed peer's
+            # rate immediately becomes the straggler bar for siblings
+            # still running (TaskSetManager marks success per task).
+            # started= gives fast no-heartbeat tasks their real duration
+            # (first_seen alone would make their rate explode)
+            if self.live is not None:
+                self.live.task_finished(qid, sid, map_id, obs,
+                                        rows=sum(rows),
+                                        executor=worker.executor_id,
+                                        started=t_start)
             return (MapStatus(map_block_id(sid, map_id, num_maps), addr,
                               worker.executor_id, rows, sizes, map_id,
                               col_stats),
@@ -465,9 +523,10 @@ class ClusterDAGScheduler(DAGScheduler):
         if getattr(self.cluster, "push_shuffle", False) and \
                 self.cluster.shuffle_service_addr:
             status.merge = self._finalize_merge(sid, num_maps)
-        # fold worker-side operator metrics into the driver's view (the
-        # executor-heartbeat metrics channel, reduced to per-task return)
-        for _, counters, obs, eid in outcomes:
+        # fold worker-side operator metrics into the driver's view
+        # (task-return records already closed the live store per task,
+        # inside run_map)
+        for ms, counters, obs, eid in outcomes:
             for k, v in counters.items():
                 self.ctx.metrics.add(k, v)
             self._merge_task_obs(obs, eid, qid)
@@ -516,19 +575,33 @@ class ClusterDAGScheduler(DAGScheduler):
         """Close the shuffle to late pushes and register which map ids
         each reduce partition's merged chunk holds (the reference's
         shuffleMergeFinalized → MergeStatus registration,
-        core/scheduler/MergeStatus.scala)."""
+        core/scheduler/MergeStatus.scala). The finalize records a
+        PRODUCING span for the merged chunks (the service process has no
+        tracer): it claims the deterministic `merge_flow_id` and parents
+        to the map-task spans, so exchange edges run map task → merge →
+        reduce fetch instead of stopping at the fetch."""
         import pickle
+        from contextlib import nullcontext
 
         from ..net.transport import RpcClient
 
         addr = self.cluster.shuffle_service_addr
-        try:
-            with RpcClient(addr, self.cluster.authkey_hex) as c:
-                merged = pickle.loads(
-                    c.call("finalize_merge", pickle.dumps(sid),
-                           timeout=30))
-        except Exception:
-            return None    # merge unavailable — per-map fetch still works
+        tracer = getattr(self.ctx, "tracer", None)
+        sp = tracer.span(
+            f"merge[{sid}]", cat="exchange",
+            args={"flow_id": merge_flow_id(sid),
+                  "flow_parent": [map_block_id(sid, m, num_maps)
+                                  for m in range(min(num_maps, 16))],
+                  "service": addr},
+            flow=True) if tracer is not None else nullcontext()
+        with sp:
+            try:
+                with RpcClient(addr, self.cluster.authkey_hex) as c:
+                    merged = pickle.loads(
+                        c.call("finalize_merge", pickle.dumps(sid),
+                               timeout=30))
+            except Exception:
+                return None    # merge unavailable — per-map fetch works
         merge = MergeStatus(sid, addr, num_maps, merged)
         self.map_outputs.register_merge(merge)
         return merge

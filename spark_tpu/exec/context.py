@@ -70,6 +70,14 @@ class ExecContext:
     # worker processes this query (ClusterDAGScheduler._merge_task_obs);
     # EXPLAIN ANALYZE reconciles measured launches as driver + this
     worker_kernel_kinds: dict | None = field(default=None, repr=False)
+    # session LiveObs (obs/live.py) when live telemetry is wired: the
+    # cluster scheduler closes task records against it and the straggler
+    # detector reads it; None = no live store
+    live_obs: object = field(default=None, repr=False)
+    # query-scope tag of the collect driving this execution (set by
+    # QueryExecution.execute from the tracing contextvar) — keys the
+    # live store and EXPLAIN ANALYZE's straggler-finding lookup
+    query_id: str | None = field(default=None, repr=False)
 
     @property
     def memory(self):

@@ -37,6 +37,16 @@ def map_block_id(shuffle_id: str, map_id: int, num_maps: int) -> str:
     return shuffle_id if num_maps <= 1 else f"{shuffle_id}#m{map_id}"
 
 
+def merge_flow_id(shuffle_id: str) -> str:
+    """Deterministic Perfetto flow id of a shuffle's push-merge step:
+    the driver's merge-finalize span claims it as flow_id, reduce-side
+    fetches that consume merged chunks list it as a flow_parent — both
+    sides derive it from the shuffle id alone, so the arrow resolves
+    across processes (and never dangles: with no merge span in the
+    trace, the exporter drops the unresolved parent)."""
+    return f"{shuffle_id}#merged"
+
+
 @dataclass
 class MapStatus:
     """Where ONE map task's output lives + per-reduce-partition sizes

@@ -136,16 +136,39 @@ class QueryExecution:
                            lambda: self.session._planner().plan(optimized))
 
     def execute(self) -> list:
-        from ..config import KERNEL_ATTRIBUTION, UI_OPERATOR_METRICS
+        from ..config import (KERNEL_ATTRIBUTION, PROGRESS_CONSOLE,
+                              PROGRESS_UPDATE_INTERVAL,
+                              UI_OPERATOR_METRICS)
         from ..obs.metrics import discard_pending, finalize_plan_metrics
+        from ..obs.tracing import current_query, pop_query, push_query
         from .scheduler import DAGScheduler
 
         plan = self.physical
+        from ..physical.exchange import annotate_exchange_stat_cols
+
+        # map-side shuffle stat accumulation is restricted to columns a
+        # downstream dense decision can actually consult (the plan
+        # analyzer mirrors the same reachability rule)
+        annotate_exchange_stat_cols(plan)
+        # execution always runs under a query scope: collects push one in
+        # to_arrow, but direct execute() callers (bench._run_blocked,
+        # tests) would otherwise stream worker heartbeat deltas with no
+        # query key — phantom entries the live store could never close
+        qid = current_query()
+        eph_token = None
+        if qid is None:
+            import uuid
+
+            qid = uuid.uuid4().hex[:12]
+            eph_token = push_query(qid)
         ctx = ExecContext(conf=self.session.conf,
                           metrics=self.session._metrics,
                           block_manager=getattr(
                               self.session, "block_manager", None),
-                          tracer=self._tracer)
+                          tracer=self._tracer,
+                          live_obs=getattr(self.session, "live_obs",
+                                           None),
+                          query_id=qid)
         # conf values are host data — bool() here never touches device
         if bool(self.session.conf.get(  # tpulint: ignore[host-sync]
                 UI_OPERATOR_METRICS)):
@@ -174,11 +197,36 @@ class QueryExecution:
                 listener_bus=bus)
         else:
             sched = DAGScheduler(ctx, listener_bus=bus)
+        # live progress: local stages get the same in-flight feed
+        # cluster tasks stream over heartbeats — a flush thread (spawned
+        # through scoped_submit so the query scope rides along) samples
+        # the driver-side plan_metrics into the live store while the
+        # console reporter renders bars from it
+        stop_flusher = None
+        live = ctx.live_obs
+        console_on = bool(self.session.conf.get(  # tpulint: ignore[host-sync]
+            PROGRESS_CONSOLE))
+        if live is not None and console_on:
+            from ..obs.live import start_query_flusher
+
+            self.session._ensure_progress_reporter()
+            if ctx.plan_metrics is not None:
+                stop_flusher = start_query_flusher(
+                    live, ctx,
+                    interval=float(  # tpulint: ignore[host-sync]
+                        self.session.conf.get(PROGRESS_UPDATE_INTERVAL)))
         try:
             out = self._timed("execution", lambda: sched.run(plan))
         except Exception:
             discard_pending(ctx.plan_metrics)
             raise
+        finally:
+            if stop_flusher is not None:
+                stop_flusher()
+            if live is not None:
+                live.query_finished(ctx.query_id)
+            if eph_token is not None:
+                pop_query(eph_token)
         # query end: resolve row counts parked during sync-free collection
         # (one memoized host read per distinct mask identity — the only
         # device read the metrics layer performs, after the last dispatch)
@@ -395,9 +443,16 @@ class QueryExecution:
                           for k, v in after_counters.items()
                           if v != before_counters.get(k, 0)}
         ctx = getattr(self, "_last_ctx", None)
-        return build_analyzed_report(
+        report = build_analyzed_report(
             self.physical, getattr(ctx, "plan_metrics", None),
             prediction, measured, counter_deltas, wall_ms)
+        # straggler findings the live telemetry raised during the
+        # measured run surface as first-class EXPLAIN ANALYZE findings
+        live = getattr(ctx, "live_obs", None)
+        if live is not None:
+            report.findings.extend(
+                live.findings_for(getattr(ctx, "query_id", None)))
+        return report
 
     def explain_string(self, mode: str = "formatted") -> str:
         if mode == "analysis":

@@ -46,14 +46,23 @@ class _OutBuffer:
     O(spill_bytes + one tile), not O(partition).
 
     While the rows are host-side anyway, append() keeps a running
-    (min, max, any_valid) per integral column — the map-side column
-    stats. build() seeds the dense-range device-scalar memo with them,
-    and in cluster mode they ride the MapStatus payload so the reduce
-    side seeds the same values after the IPC rebuild: post-shuffle
-    dense agg/join decisions never launch the krange3 probe."""
+    (min, max, any_valid) per stat column — the map-side column stats.
+    build() seeds the dense-range device-scalar memo with them, and in
+    cluster mode they ride the MapStatus payload so the reduce side
+    seeds the same values after the IPC rebuild: post-shuffle dense
+    agg/join decisions never launch the krange3 probe.
+
+    ``stat_cols`` restricts accumulation to the PLAN-REACHABLE dense
+    candidates (columns some downstream single-integral-key aggregate or
+    join can actually consult — physical/exchange.
+    annotate_exchange_stat_cols); None keeps the historical behavior of
+    every integral column (bare plans built without the planner). Either
+    way the set intersects with integral non-dictionary columns, the
+    only ones dense_range_stats reads."""
 
     def __init__(self, schema: StructType, spill_bytes: int | None = None,
-                 spill_dir: str | None = None, metrics=None):
+                 spill_dir: str | None = None, metrics=None,
+                 stat_cols: list | None = None):
         self.schema = schema
         self.chunks: list[list] = []  # per append: [(data, validity, sdict), ...]
         self.rows = 0
@@ -64,11 +73,12 @@ class _OutBuffer:
         self._live_bytes = 0
         # per spill: (path, [per-chunk [sdict per col]], [per-chunk rows])
         self._spills: list[tuple] = []
-        # integral non-dictionary columns: the ones dense_range_stats reads
-        self._stat_cols = [
+        integral = [
             i for i, f in enumerate(schema.fields)
             if np.dtype(f.dataType.device_dtype).kind == "i"
             and not dict_encoded(f.dataType)]
+        self._stat_cols = integral if stat_cols is None else \
+            [i for i in integral if i in set(stat_cols)]
         # col index -> (kmin, kmax, any_valid) over every appended row
         self.col_stats: dict[int, tuple] = {
             i: (0, 0, False) for i in self._stat_cols}
@@ -244,10 +254,11 @@ def _pull_sorted(batch: ColumnarBatch, perm, counts) -> tuple[list, np.ndarray]:
     return gathered, np.asarray(counts)
 
 
-def _out_buffers(num_out: int, schema: StructType,
-                 ctx: ExecContext) -> list[_OutBuffer]:
+def _out_buffers(num_out: int, schema: StructType, ctx: ExecContext,
+                 stat_cols: list | None = None) -> list[_OutBuffer]:
     return [_OutBuffer(schema, spill_bytes=ctx.memory.spill_bytes,
-                       spill_dir=ctx.memory.spill_dir, metrics=ctx.metrics)
+                       spill_dir=ctx.memory.spill_dir, metrics=ctx.metrics,
+                       stat_cols=stat_cols)
             for _ in range(num_out)]
 
 
@@ -381,12 +392,13 @@ def shuffle_hash(partitions: list[Partition], key_positions: Sequence[int],
                  num_out: int, schema: StructType, ctx: ExecContext,
                  stats: dict | None = None,
                  seed: int = 42,
-                 col_stats: dict | None = None) -> list[Partition]:
+                 col_stats: dict | None = None,
+                 stat_cols: list | None = None) -> list[Partition]:
     """Hash-repartition. ``seed`` must differ from the upstream exchange's
     when re-splitting already-hash-partitioned data (grace join): reusing
     the seed makes h %% nfrag constant within a partition whenever nfrag
     divides the exchange's partition count — a degenerate split."""
-    bufs = _out_buffers(num_out, schema, ctx)
+    bufs = _out_buffers(num_out, schema, ctx, stat_cols)
     for part in partitions:
         for batch in part:
             gathered, counts = hash_partition_batch(
@@ -398,8 +410,9 @@ def shuffle_hash(partitions: list[Partition], key_positions: Sequence[int],
 def shuffle_round_robin(partitions: list[Partition], num_out: int,
                         schema: StructType, ctx: ExecContext,
                         stats: dict | None = None,
-                        col_stats: dict | None = None) -> list[Partition]:
-    bufs = _out_buffers(num_out, schema, ctx)
+                        col_stats: dict | None = None,
+                        stat_cols: list | None = None) -> list[Partition]:
+    bufs = _out_buffers(num_out, schema, ctx, stat_cols)
     start = 0
     for part in partitions:
         for batch in part:
@@ -412,10 +425,11 @@ def shuffle_round_robin(partitions: list[Partition], num_out: int,
 def shuffle_range(partitions: list[Partition], key_position: int,
                   bounds, descending: bool, num_out: int, schema: StructType,
                   ctx: ExecContext, stats: dict | None = None,
-                  col_stats: dict | None = None) -> list[Partition]:
+                  col_stats: dict | None = None,
+                  stat_cols: list | None = None) -> list[Partition]:
     """Range shuffle for global sort. `bounds` is a host list of boundary
     values in the sort-key domain (numeric) or raw strings."""
-    bufs = _out_buffers(num_out, schema, ctx)
+    bufs = _out_buffers(num_out, schema, ctx, stat_cols)
     f = schema.fields[key_position]
     string_key = isinstance(f.dataType, StringType)
     for part in partitions:
@@ -430,7 +444,8 @@ def shuffle_range(partitions: list[Partition], key_position: int,
 def shuffle_fused(partitions: list[Partition], writer, num_out: int,
                   schema: StructType, ctx: ExecContext,
                   stats: dict | None = None,
-                  col_stats: dict | None = None) -> list[Partition]:
+                  col_stats: dict | None = None,
+                  stat_cols: list | None = None) -> list[Partition]:
     """Fused exchange map side: `writer` (physical/fusion.ExchangeFusion
     bound to a partitioning) runs ONE jitted kernel per input batch —
     pipeline trace + partition ids + pid-grouped gather — and this loop
@@ -441,7 +456,7 @@ def shuffle_fused(partitions: list[Partition], writer, num_out: int,
     the other fused operators' size gate."""
     from ..config import FUSION_MIN_ROWS
 
-    bufs = _out_buffers(num_out, schema, ctx)
+    bufs = _out_buffers(num_out, schema, ctx, stat_cols)
     min_rows = int(ctx.conf.get(FUSION_MIN_ROWS))  # tpulint: ignore[host-sync]
     start = 0  # running live-row offset (round-robin positioning)
     for part in partitions:

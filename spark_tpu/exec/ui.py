@@ -29,11 +29,17 @@ class LiveStatusStore:
     + AppStatusStore roles), shaped like HistoryReader for the shared
     renderer."""
 
-    def __init__(self, app_name: str, max_events: int = 2000):
+    def __init__(self, app_name: str, max_events: int = 2000,
+                 live_obs=None):
         self.app_name = app_name
         self._events: deque = deque(maxlen=max_events)
         self._running: dict[str, dict] = {}
         self._lock = threading.Lock()
+        # obs/live.LiveObs when the session streams heartbeat telemetry:
+        # the summary then carries IN-FLIGHT stage progress (rows so
+        # far, per-task heartbeat age) and straggler findings — the live
+        # UI's view into queries that have not finished yet
+        self.live_obs = live_obs
 
     def on_event(self, ev: QueryEvent) -> None:
         d = asdict(ev)
@@ -62,6 +68,8 @@ class LiveStatusStore:
         # totals) so both UIs render one shape, plus the live-only count
         out = summarize_events(events)
         out["running"] = running
+        if self.live_obs is not None:
+            out["live"] = self.live_obs.snapshot()
         return out
 
 
@@ -70,7 +78,8 @@ class SparkUI:
 
     def __init__(self, session, port: int = 0, host: str = "127.0.0.1"):
         name = getattr(session, "app_name", None) or "session"
-        self.store = LiveStatusStore(name)
+        self.store = LiveStatusStore(
+            name, live_obs=getattr(session, "live_obs", None))
         session.listener_bus.register(self.store)
         handler = type("Handler", (_Handler,), {"reader": self.store})
         self._httpd = ThreadingHTTPServer((host, port), handler)

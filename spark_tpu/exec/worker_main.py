@@ -83,18 +83,31 @@ def put_block(shuffle_id: str, reduce_id: int, data: bytes) -> None:
 # Worker-side observability (cluster-mode SQL stage tasks)
 # ---------------------------------------------------------------------------
 
-def begin_stage_obs(conf) -> dict | None:
+# stage tasks currently running in THIS process, registered for live
+# telemetry: the heartbeat loop snapshots each into the next heartbeat
+# payload (collect_live_obs) — the reference's periodic Heartbeater
+# shipping accumulator updates mid-task
+_LIVE_TASKS: dict[int, dict] = {}
+
+
+def begin_stage_obs(conf, query_id: str | None = None,
+                    stage_id: str | None = None,
+                    task_id: int = 0) -> dict | None:
     """Install a process-local observability recorder for one stage task
     (the executor half of the reference's heartbeat-shipped executor
     metrics): a task-lived Tracer, a per-operator metric-record dict for
     the ExecContext, and baselines of THIS process's KernelCache
     counters, so the driver can reconcile attributed launches against
-    driver+worker totals. Same zero-launch/no-mid-query-sync contract as
-    the driver recorder — everything here is host bookkeeping. Returns
-    None when the session disabled obs shipping."""
-    from ..config import (CLUSTER_OBS_SHIPPING, KERNEL_ATTRIBUTION,
-                          TRACE_ENABLED, TRACE_MAX_SPANS,
-                          UI_OPERATOR_METRICS)
+    driver+worker totals. With spark.tpu.heartbeat.obs on, the state is
+    also registered for LIVE flushing: every heartbeat ships a
+    cumulative snapshot of the task's host counters, closed spans since
+    the last flush, and currently-open spans (collect_live_obs). Same
+    zero-launch/no-mid-query-sync contract as the driver recorder —
+    everything here is host bookkeeping. Returns None when the session
+    disabled obs shipping."""
+    from ..config import (CLUSTER_OBS_SHIPPING, HEARTBEAT_OBS,
+                          KERNEL_ATTRIBUTION, TRACE_ENABLED,
+                          TRACE_MAX_SPANS, UI_OPERATOR_METRICS)
     from ..obs.tracing import Tracer
     from ..physical.compile import GLOBAL_KERNEL_CACHE as KC
 
@@ -110,12 +123,86 @@ def begin_stage_obs(conf) -> dict | None:
     tracer = Tracer(enabled=trace_on,
                     max_spans=int(  # tpulint: ignore[host-sync]
                         conf.get(TRACE_MAX_SPANS)))
-    return {"tracer": tracer if trace_on else None,
-            "rec": {} if metrics_on else None,
-            "attribution": attribution,
-            "kinds0": dict(KC.launches_by_kind),
-            "launches0": KC.launches,
-            "compile_ms0": KC.compile_ms}
+    state = {"tracer": tracer if trace_on else None,
+             "rec": {} if metrics_on else None,
+             "attribution": attribution,
+             "kinds0": dict(KC.launches_by_kind),
+             "launches0": KC.launches,
+             "compile_ms0": KC.compile_ms,
+             "query_id": query_id, "stage_id": stage_id,
+             "task_id": task_id, "flush_seq": 0,
+             "span_mark": tracer.mark() if trace_on else 0,
+             "unsent_spans": []}
+    if bool(conf.get(HEARTBEAT_OBS)):  # tpulint: ignore[host-sync]
+        with _STORE_LOCK:
+            _LIVE_TASKS[id(state)] = state
+    return state
+
+
+def collect_live_obs() -> list:
+    """Snapshot every registered in-flight stage task into live obs
+    deltas for the next heartbeat. Each delta is CUMULATIVE since task
+    start (snapshots replace on the driver, so a dropped heartbeat loses
+    nothing) except closed spans, which ship incrementally via the
+    tracer's monotonic sequence mark — carried in a per-task unsent
+    buffer until `ack_live_obs` confirms the heartbeat RPC succeeded,
+    so a failed beat re-sends them instead of silently dropping them
+    (at-least-once across failures; exactly-once on a healthy channel).
+    Host counters only: parked row-masks stay parked
+    (export_op_records_partial), no kernel is launched, no device array
+    is read."""
+    from ..obs.metrics import export_op_records_partial
+    from ..physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+    with _STORE_LOCK:
+        states = list(_LIVE_TASKS.values())
+    out = []
+    for state in states:
+        state["flush_seq"] += 1
+        recs = export_op_records_partial(state["rec"])
+        tracer = state["tracer"]
+        spans_closed: list = []
+        open_spans: list = []
+        if tracer is not None:
+            mark = state["span_mark"]
+            state["span_mark"] = tracer.mark()
+            carry = state["unsent_spans"]
+            carry.extend(tracer.since(mark))
+            del carry[:-512]  # bound the carry across a long outage
+            spans_closed = list(carry)
+            open_spans = tracer.open_spans()
+        kinds = {k: v - state["kinds0"].get(k, 0)
+                 for k, v in KC.launches_by_kind.items()
+                 if v != state["kinds0"].get(k, 0)}
+        out.append({
+            "query": state["query_id"], "stage": state["stage_id"],
+            "task": state["task_id"], "seq": state["flush_seq"],
+            "executor_pid": os.getpid(),
+            "rows": sum(e.get("rows", 0) for e in recs.values()),
+            "rows_exact": all(e.get("rows_exact", True)
+                              for e in recs.values()),
+            "batches": sum(e.get("batches", 0) for e in recs.values()),
+            "launches": KC.launches - state["launches0"],
+            "compile_ms": round(KC.compile_ms - state["compile_ms0"], 3),
+            "kernel_kinds": kinds,
+            "op_records": recs,
+            "spans_closed": spans_closed,
+            "open_spans": open_spans,
+        })
+    return out
+
+
+def ack_live_obs() -> None:
+    """The heartbeat carrying the last `collect_live_obs` snapshot
+    reached the driver — drop the carried closed spans. Called only
+    from the (single) heartbeat thread, strictly alternating with
+    collect, so nothing is appended to the unsent buffers in between
+    (new spans land in the tracer ring and are picked up by the next
+    collect's mark)."""
+    with _STORE_LOCK:
+        states = list(_LIVE_TASKS.values())
+    for state in states:
+        state["unsent_spans"] = []
 
 
 def finish_stage_obs(state: dict | None) -> dict | None:
@@ -123,12 +210,16 @@ def finish_stage_obs(state: dict | None) -> dict | None:
     alongside the MapStatus payload: exported per-operator records
     (parked masks resolved — the batches are already host-side for block
     storage), raw spans + the (wall, perf) clock anchor for cross-process
-    rebasing, and this process's KernelCache launch/compile deltas."""
+    rebasing, and this process's KernelCache launch/compile deltas.
+    Deregisters the task from live flushing FIRST, so no heartbeat can
+    ship a partial that postdates the final record."""
     if state is None:
         return None
     from ..obs.metrics import export_op_records
     from ..physical.compile import GLOBAL_KERNEL_CACHE as KC
 
+    with _STORE_LOCK:
+        _LIVE_TASKS.pop(id(state), None)
     kinds = {k: v - state["kinds0"].get(k, 0)
              for k, v in KC.launches_by_kind.items()
              if v != state["kinds0"].get(k, 0)}
@@ -209,13 +300,28 @@ def serve_worker(driver_addr: str, token: str, host_label: str = "localhost",
 
     eid = register()
 
+    interval = float(os.environ.get(  # tpulint: ignore[host-sync]
+        "SPARK_TPU_HEARTBEAT_INTERVAL", "3.0"))
+
     def heartbeat_loop():
         nonlocal eid
         misses = 0
         while True:
-            time.sleep(3.0)
+            time.sleep(interval)
             try:
-                reply = driver.call("heartbeat", eid.encode(), timeout=5)
+                # live telemetry rides the liveness heartbeat: snapshots
+                # of every in-flight stage task's obs counters/spans
+                # (empty list when nothing runs or streaming is off).
+                # Span-heavy payloads compress well — gzip them on the
+                # wire instead of raising the frame budget.
+                obs = collect_live_obs()
+                payload = pickle.dumps({"eid": eid, "obs": obs})
+                reply = driver.call("heartbeat", payload, timeout=5,
+                                    compress=bool(obs))
+                if reply != b"unknown":
+                    # the driver ingested the obs payload (it skips the
+                    # sink for unknown executors) — drop the span carry
+                    ack_live_obs()
                 misses = 0
                 if reply == b"unknown":
                     # driver declared us lost (e.g. one transient task

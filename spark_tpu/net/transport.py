@@ -182,12 +182,20 @@ class RpcClient:
         return RemoteRpcError(msg)
 
     def call(self, method: str, payload: bytes = b"",
-             timeout: float | None = None) -> bytes:
+             timeout: float | None = None,
+             compress: bool = False) -> bytes:
+        """One unary call. `compress=True` gzips the request on the wire
+        (per-call grpc compression) — used for span-heavy telemetry
+        payloads riding the heartbeat channel, where text-shaped pickle
+        shrinks well and the frame budget should stay reserved for
+        shuffle blocks."""
         fn = self._channel.unary_unary(
             f"/{SERVICE}/{method}",
             request_serializer=_ident, response_deserializer=_ident)
         try:
-            raw = fn(payload, metadata=self._meta, timeout=timeout)
+            raw = fn(payload, metadata=self._meta, timeout=timeout,
+                     compression=grpc.Compression.Gzip if compress
+                     else None)
         except grpc.RpcError as e:
             raise self._classify(method, e) from None
         if raw.startswith(_ERR_PREFIX):

@@ -1,0 +1,610 @@
+"""Live telemetry: heartbeat-streamed worker obs, stragglers, progress.
+
+Role of the reference's periodic executor Heartbeater + the driver-side
+machinery it feeds (core/executor/Executor.scala startDriverHeartbeater
+→ HeartbeatReceiver → accumulator updates into the live AppStatusStore;
+ConsoleProgressBar; the TaskSetManager's speculatable-task scan): PRs
+3–5 made every query fully observable but only after the fact — worker
+spans/metrics/kernel deltas ship with the task RESULT, so a
+long-running or stuck stage is dark until it finishes. This module
+closes that gap on the driver side:
+
+  * `LiveObs` aggregates the partial obs records that worker tasks
+    flush on the executor heartbeat (exec/worker_main.collect_live_obs
+    → heartbeat payload → exec/cluster.LocalCluster._on_heartbeat →
+    `on_heartbeat`) per (query, stage, task), with monotonic merge
+    semantics: deltas apply in sequence order, the final task-return
+    record supersedes and reconciles the partials
+    (`task_finished`, wired from ClusterDAGScheduler._run_remote), and
+    late heartbeats arriving after task completion are dropped.
+
+  * a straggler detector over the same store: a running task whose
+    progress rate (rows+batches+launches per second) falls below a
+    configurable fraction of the stage median, or whose telemetry goes
+    silent past a deadline, is flagged as an `obs.straggler` finding —
+    surfaced in live status, EXPLAIN ANALYZE
+    (QueryExecution.analyzed_report), and the `active_stragglers`
+    signal hook the speculative-execution path consumes
+    (exec/cluster.LocalCluster.speculation_signal).
+
+  * `ConsoleProgressReporter` (the reference's ConsoleProgressBar
+    analog, spark.tpu.progress.console) renders live stage bars from
+    the same store, and `start_query_flusher` gives LOCAL-mode queries
+    the same live feed by sampling the driver's plan_metrics from a
+    flush thread (spawned through obs.metrics.scoped_submit so the
+    query-scope contextvar follows the work — a bare thread would
+    publish every sample untagged).
+
+Contract (same as the rest of obs/): everything here is host
+bookkeeping — zero kernel launches, zero device syncs. Partial metric
+snapshots ship parked row-masks NOT AT ALL (they stay parked on the
+worker until task end; see obs.metrics.export_op_records_partial).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import OrderedDict
+
+from ..config import (
+    PROGRESS_UPDATE_INTERVAL, STRAGGLER_ENABLED,
+    STRAGGLER_HEARTBEAT_DEADLINE, STRAGGLER_MIN_SECONDS,
+    STRAGGLER_RATE_FRACTION,
+)
+
+__all__ = ["ConsoleProgressReporter", "LiveObs", "start_query_flusher"]
+
+_MAX_QUERIES = 64          # retained finished queries (ring)
+_MAX_TASK_SPANS = 64       # recent closed spans kept per task
+
+
+def _new_task() -> dict:
+    now = time.time()
+    # seq_by/rows_by are PER-EXECUTOR: speculative execution races two
+    # copies of one task on the same (query, stage, task) key, each with
+    # its own monotonic seq counter — comparing them against a single
+    # stored seq would interleave-drop whichever copy is behind
+    return {"executor": None, "seq": -1, "seq_by": {}, "rows_by": {},
+            "first_seen": now,
+            "last_heartbeat": now, "rows": 0, "rows_exact": True,
+            "batches": 0, "launches": 0, "compile_ms": 0.0,
+            "kernel_kinds": {}, "op_records": {}, "open_spans": [],
+            "spans": [], "partials": 0, "done": False, "duration": None,
+            "reconciled": None}
+
+
+class LiveObs:
+    """Driver-side aggregator of streamed observability partials.
+
+    Thread-safe: heartbeats arrive on gRPC server threads, final
+    records on scheduler map-task threads, reads from the UI/console
+    reporter/EXPLAIN ANALYZE. Merge semantics are monotonic per task:
+    out-of-order heartbeats (seq <= last seen) and heartbeats after the
+    final record are dropped, so the store converges to the task-return
+    truth regardless of arrival order.
+    """
+
+    def __init__(self, conf=None):
+        self._conf = conf
+        self._lock = threading.Lock()
+        self._queries: "OrderedDict[str, dict]" = OrderedDict()
+        self.late_dropped = 0     # heartbeats discarded after task end
+        self.partials_seen = 0    # mid-stage deltas accepted
+        # straggler-scan memo: every heartbeat, UI snapshot, and
+        # speculative wait polls check_stragglers — rescanning the whole
+        # store each time is wasted work AND lock contention. A scan is
+        # reused until a write bumps the version or the TTL lapses (the
+        # clock matters even without writes: silence detection)
+        self._version = 0
+        self._scan_cache: tuple = (-1, 0.0, [])  # (version, at, active)
+
+    # -- config -----------------------------------------------------------
+    def _cfg(self, entry, default):
+        if self._conf is None:
+            return default
+        try:
+            return entry.value_type(self._conf.get(entry))
+        except Exception:
+            return default
+
+    # -- writes -----------------------------------------------------------
+    def _query(self, qid: str) -> dict:
+        q = self._queries.get(qid)
+        if q is None:
+            q = self._queries[qid] = {
+                "stages": {}, "findings": [], "flagged": set(),
+                "abandoned": set(), "done": False,
+                "started": time.time()}
+            while len(self._queries) > _MAX_QUERIES:
+                self._queries.popitem(last=False)
+        return q
+
+    def _task(self, qid: str, stage: str, task) -> dict:
+        stages = self._query(qid)["stages"]
+        st = stages.get(stage)
+        if st is None:
+            st = stages[stage] = {"tasks": {}}
+        t = st["tasks"].get(task)
+        if t is None:
+            t = st["tasks"][task] = _new_task()
+        return t
+
+    def on_heartbeat(self, executor_id: str, deltas: list) -> None:
+        """Fold one executor heartbeat's live obs deltas into the store.
+        Each delta is a cumulative snapshot of one running stage task
+        (see exec/worker_main.collect_live_obs): snapshots replace, so
+        a lost heartbeat never loses counts — the next one carries
+        them. Closed spans ride incrementally, carried until the worker
+        acks delivery (at-least-once across failed beats; a beat whose
+        reply was lost may repeat a span in the display ring)."""
+        if not deltas:
+            return
+        now = time.time()
+        with self._lock:
+            self._version += 1
+            for d in deltas:
+                qid = d.get("query") or "?"
+                stage = d.get("stage") or "?"
+                # a heartbeat straggling in after stage_abandoned must
+                # not resurrect the popped entry (it would never be
+                # closed and would trip the silence deadline forever)
+                if stage in self._query(qid)["abandoned"]:
+                    self.late_dropped += 1
+                    continue
+                t = self._task(qid, stage, d.get("task", 0))
+                if t["done"]:
+                    self.late_dropped += 1
+                    continue
+                seq = d.get("seq", 0)
+                if seq <= t["seq_by"].get(executor_id, -1):
+                    continue            # stale/reordered snapshot
+                t["seq_by"][executor_id] = seq
+                t["last_heartbeat"] = now
+                t["partials"] += 1
+                self.partials_seen += 1
+                if "rows" in d:
+                    t["rows_by"][executor_id] = d["rows"]
+                # speculative copies race on one task key: the
+                # further-along copy owns the DISPLAYED counters
+                # (snapshots are cumulative per copy, so replacing from
+                # the laggard would make progress appear to move
+                # backwards); with a single executor this is always true
+                units = (d.get("rows", 0) + d.get("batches", 0)
+                         + d.get("launches", 0))
+                if t["executor"] not in (None, executor_id) \
+                        and units < self._units(t):
+                    continue
+                t["seq"] = max(t["seq"], seq)
+                t["executor"] = executor_id
+                for f in ("rows", "batches", "launches", "compile_ms"):
+                    if f in d:
+                        t[f] = d[f]
+                if "rows_exact" in d:
+                    t["rows_exact"] = d["rows_exact"]
+                if d.get("kernel_kinds") is not None:
+                    t["kernel_kinds"] = dict(d["kernel_kinds"])
+                if d.get("op_records") is not None:
+                    t["op_records"] = d["op_records"]
+                t["open_spans"] = d.get("open_spans") or []
+                for sp in d.get("spans_closed") or ():
+                    t["spans"].append(sp)
+                del t["spans"][:-_MAX_TASK_SPANS]
+        self.check_stragglers(now)
+
+    def local_update(self, qid: str | None, op_records: dict,
+                     open_spans: list | None = None) -> None:
+        """Local-mode feed: the driver-side flush thread samples the
+        running query's plan_metrics (host counters only) into the same
+        store, stage 'local'."""
+        if qid is None:
+            return
+        rows = sum(e.get("rows", 0) for e in op_records.values())
+        batches = sum(e.get("batches", 0) for e in op_records.values())
+        launches = sum(e.get("launch_total", 0)
+                       for e in op_records.values())
+        with self._lock:
+            self._version += 1
+            t = self._task(qid, "local", 0)
+            if t["done"]:
+                self.late_dropped += 1
+                return
+            t["seq"] += 1
+            t["executor"] = "driver"
+            t["last_heartbeat"] = time.time()
+            t["partials"] += 1
+            self.partials_seen += 1
+            t["rows"], t["batches"], t["launches"] = rows, batches, launches
+            t["op_records"] = op_records
+            if open_spans is not None:
+                t["open_spans"] = open_spans
+
+    def task_finished(self, qid: str | None, stage: str, task,
+                      final: dict | None, rows: int | None = None,
+                      executor: str | None = None,
+                      started: float | None = None) -> None:
+        """The task's RETURN record supersedes every partial: counters
+        are replaced with the exact task-end values (parked masks were
+        resolved on the worker after the last dispatch), the task is
+        closed to further heartbeats, and the reconciliation verdict
+        (did the last partial already agree?) is recorded.
+
+        `started` is the scheduler's launch time for the task: a fast
+        task may finish before its first heartbeat ever creates the
+        entry, and without the true start its duration would collapse to
+        ~0 and its completed-peer rate would explode — inflating the
+        straggler bar for every sibling still running. `executor` is the
+        WINNING copy under speculation: reconciliation compares the
+        final rows against that copy's own partials, not whichever
+        copy last touched the display."""
+        if qid is None:
+            return
+        now = time.time()
+        with self._lock:
+            self._version += 1
+            if stage in self._query(qid)["abandoned"]:
+                return  # the attempt failed; its final record is moot
+            t = self._task(qid, stage, task)
+            if started is not None and started < t["first_seen"]:
+                t["first_seen"] = started
+            had_partials = t["partials"]
+            if executor is not None and t["rows_by"]:
+                partial_rows = t["rows_by"].get(executor, 0)
+            else:
+                partial_rows = t["rows"]
+            if executor is not None:
+                t["executor"] = executor
+            t["done"] = True
+            t["duration"] = now - t["first_seen"]
+            t["last_heartbeat"] = now
+            t["open_spans"] = []
+            if final is not None:
+                recs = final.get("op_records") or {}
+                t["op_records"] = recs
+                t["rows"] = sum(e.get("rows", 0) for e in recs.values())
+                t["rows_exact"] = all(e.get("rows_exact", True)
+                                      for e in recs.values())
+                t["batches"] = sum(e.get("batches", 0)
+                                   for e in recs.values())
+                t["launches"] = final.get("kernel_launches",
+                                          t["launches"])
+                t["compile_ms"] = final.get("kernel_compile_ms",
+                                            t["compile_ms"])
+                if final.get("kernel_kinds") is not None:
+                    t["kernel_kinds"] = dict(final["kernel_kinds"])
+            elif rows is not None:
+                t["rows"] = rows
+            # exact reconciliation only claimable when partials arrived
+            # and the final record agrees with (or extends) them
+            # monotonically — partial rows can never exceed the final
+            t["reconciled"] = (had_partials > 0
+                               and partial_rows <= t["rows"])
+
+    def query_finished(self, qid: str | None) -> None:
+        if qid is None:
+            return
+        with self._lock:
+            self._version += 1
+            q = self._queries.get(qid)
+            if q is not None:
+                q["done"] = True
+
+    def stage_abandoned(self, qid: str | None, stage: str) -> None:
+        """A failed stage attempt retries under a NEW shuffle id (the
+        attempt number is part of the sid); the abandoned attempt's task
+        entries would otherwise sit done=False forever and trip the
+        heartbeat-silence deadline for the rest of the query — a
+        permanently-truthy straggler signal. Drop them (the retry
+        supersedes their partials). Findings already raised stay: a
+        straggler flagged on the failed attempt is historical truth
+        EXPLAIN ANALYZE should still report."""
+        if qid is None:
+            return
+        with self._lock:
+            self._version += 1
+            q = self._queries.get(qid)
+            if q is None:
+                return
+            q["stages"].pop(stage, None)
+            q["abandoned"].add(stage)  # late heartbeats must not revive
+            q["flagged"] = {k for k in q["flagged"] if k[0] != stage}
+
+    # -- straggler detection ----------------------------------------------
+    @staticmethod
+    def _units(t: dict) -> float:
+        return t["rows"] + t["batches"] + t["launches"]
+
+    def check_stragglers(self, now: float | None = None) -> list[dict]:
+        """Scan running stages for straggling tasks; newly-flagged
+        tasks append a finding (kept for the life of the query, so
+        EXPLAIN ANALYZE sees flags raised mid-run). Returns the
+        CURRENTLY-active straggler findings."""
+        if not self._cfg(STRAGGLER_ENABLED, True):
+            return []
+        frac = self._cfg(STRAGGLER_RATE_FRACTION, 0.2)
+        min_s = self._cfg(STRAGGLER_MIN_SECONDS, 1.0)
+        deadline = self._cfg(STRAGGLER_HEARTBEAT_DEADLINE, 30.0)
+        now = time.time() if now is None else now
+        # verdicts also flip with the CLOCK (silence, elapsed>minSeconds)
+        # — the reuse window must stay well under those thresholds
+        ttl = min(0.25, deadline / 4.0, max(min_s, 0.01) / 4.0)
+        with self._lock:
+            ver, at, cached = self._scan_cache
+            if ver == self._version and now - at < ttl:
+                return list(cached)
+        active: list[dict] = []
+        with self._lock:
+            for qid, q in self._queries.items():
+                if q["done"]:
+                    continue
+                for stage, st in q["stages"].items():
+                    tasks = st["tasks"]
+
+                    def rate(t):
+                        el = t["duration"] if t["done"] \
+                            else now - t["first_seen"]
+                        return self._units(t) / max(el, 1e-6)
+
+                    # reference discipline (TaskSetManager
+                    # checkSpeculatableTasks): completed peers set the
+                    # bar; before any completes, the stage-wide median
+                    # does (equal-progress peers keep ratio ≈ 1)
+                    done_rates = sorted(rate(t) for t in tasks.values()
+                                        if t["done"])
+                    base = done_rates or sorted(rate(t)
+                                                for t in tasks.values())
+                    median = base[len(base) // 2] if base else 0.0
+                    for task, t in tasks.items():
+                        if t["done"]:
+                            continue
+                        elapsed = now - t["first_seen"]
+                        silent = now - t["last_heartbeat"] > deadline
+                        slow = (len(tasks) >= 2 and elapsed > min_s
+                                and median > 0.0
+                                and self._units(t) / max(elapsed, 1e-6)
+                                < frac * median)
+                        if not (silent or slow):
+                            continue
+                        why = ("telemetry silent "
+                               f"{now - t['last_heartbeat']:.1f}s > "
+                               f"{deadline:.1f}s deadline" if silent else
+                               f"progress rate under {frac:.0%} of the "
+                               f"stage median after {elapsed:.1f}s")
+                        finding = {
+                            "severity": "warning", "kind": "obs.straggler",
+                            "query": qid, "stage": stage, "task": task,
+                            "executor": t["executor"],
+                            "msg": f"straggler: task {task} of stage "
+                                   f"{stage} ({t['executor']}): {why} "
+                                   f"(rows so far {t['rows']})"}
+                        active.append(finding)
+                        key = (stage, task)
+                        if key not in q["flagged"]:
+                            q["flagged"].add(key)
+                            q["findings"].append(finding)
+            self._scan_cache = (self._version, now, list(active))
+        return active
+
+    def active_stragglers(self) -> list[tuple]:
+        """(query, stage, task) keys of currently-straggling tasks —
+        the signal hook the speculative-execution path consumes
+        (LocalCluster.speculation_signal): a flagged straggler launches
+        the backup copy without waiting out the duration-history
+        threshold."""
+        return [(f["query"], f["stage"], f["task"])
+                for f in self.check_stragglers()]
+
+    def findings_for(self, qid: str | None) -> list[dict]:
+        """Straggler findings raised during one query (live OR already
+        finished — EXPLAIN ANALYZE reads this after the measured run)."""
+        self.check_stragglers()
+        if qid is None:
+            return []
+        with self._lock:
+            q = self._queries.get(qid)
+            return list(q["findings"]) if q is not None else []
+
+    # -- reads ------------------------------------------------------------
+    def query_progress(self, qid: str) -> dict | None:
+        """In-flight progress of one query: per stage, tasks done/total,
+        rows/batches/launches so far, per-task last-heartbeat age."""
+        now = time.time()
+        with self._lock:
+            q = self._queries.get(qid)
+            if q is None:
+                return None
+            stages = {}
+            for stage, st in q["stages"].items():
+                tasks = st["tasks"]
+                stages[stage] = {
+                    "tasks_total": len(tasks),
+                    "tasks_done": sum(1 for t in tasks.values()
+                                      if t["done"]),
+                    "rows": sum(t["rows"] for t in tasks.values()),
+                    "rows_exact": all(t["rows_exact"]
+                                      for t in tasks.values()),
+                    "batches": sum(t["batches"] for t in tasks.values()),
+                    "launches": sum(t["launches"]
+                                    for t in tasks.values()),
+                    "kernel_kinds": _sum_kinds(
+                        t["kernel_kinds"] for t in tasks.values()),
+                    "partials": sum(t["partials"]
+                                    for t in tasks.values()),
+                    "tasks": {
+                        task: {"executor": t["executor"],
+                               "rows": t["rows"], "batches": t["batches"],
+                               "launches": t["launches"],
+                               "done": t["done"],
+                               "partials": t["partials"],
+                               "reconciled": t["reconciled"],
+                               "open_spans": list(t["open_spans"]),
+                               "heartbeat_age_s": round(
+                                   now - t["last_heartbeat"], 3)}
+                        for task, t in tasks.items()},
+                }
+            return {"done": q["done"], "stages": stages,
+                    "findings": list(q["findings"])}
+
+    def task_record(self, qid: str, stage: str, task) -> dict | None:
+        with self._lock:
+            q = self._queries.get(qid)
+            if q is None:
+                return None
+            st = q["stages"].get(stage)
+            if st is None:
+                return None
+            t = st["tasks"].get(task)
+            return dict(t) if t is not None else None
+
+    def snapshot(self) -> dict:
+        """Whole-store view for the live UI: running queries with stage
+        progress, straggler findings, merge-discipline counters."""
+        with self._lock:
+            qids = [qid for qid, q in self._queries.items()
+                    if not q["done"]]
+            finished = len(self._queries) - len(qids)
+        out = {"running": {}, "finished_queries": finished,
+               "partials_seen": self.partials_seen,
+               "late_dropped": self.late_dropped,
+               "stragglers": self.check_stragglers()}
+        for qid in qids:
+            p = self.query_progress(qid)
+            if p is not None:
+                out["running"][qid] = p
+        return out
+
+
+def _sum_kinds(dicts) -> dict:
+    out: dict = {}
+    for d in dicts:
+        for k, v in (d or {}).items():
+            out[k] = out.get(k, 0) + v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Local-mode flush thread (driver-side sampler)
+# ---------------------------------------------------------------------------
+
+def start_query_flusher(live: LiveObs, ctx, interval: float = 0.25):
+    """Periodically publish the running query's driver-side plan_metrics
+    into the live store so LOCAL stages get the same in-flight feed
+    cluster tasks stream over heartbeats. The loop is handed to its
+    thread through obs.metrics.scoped_submit: the flush thread runs in a
+    COPY of the caller's contextvars context, so current_query() inside
+    the loop resolves to the query being collected (a bare thread starts
+    with an empty context and would publish untagged samples). Samples
+    read host counters only — parked masks are never resolved here.
+
+    Returns a zero-argument stop() that joins the flusher."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from .metrics import export_op_records_partial, scoped_submit
+    from .tracing import current_query
+
+    stop_event = threading.Event()
+    pool = ThreadPoolExecutor(1, thread_name_prefix="obs-flush")
+
+    def loop():
+        qid = current_query()
+        while not stop_event.wait(interval):
+            live.local_update(qid,
+                              export_op_records_partial(ctx.plan_metrics))
+        # final sample so short queries still register one partial
+        live.local_update(qid,
+                          export_op_records_partial(ctx.plan_metrics))
+
+    fut = scoped_submit(pool, loop)
+
+    def stop():
+        stop_event.set()
+        try:
+            fut.result(timeout=10)
+        except Exception:
+            pass
+        pool.shutdown(wait=False)
+
+    return stop
+
+
+# ---------------------------------------------------------------------------
+# Console progress (ConsoleProgressBar role)
+# ---------------------------------------------------------------------------
+
+class ConsoleProgressReporter:
+    """Renders live stage bars to a terminal from the LiveObs store
+    (reference: core/ui/ConsoleProgressBar.scala — a \\r-rewritten
+    status line while stages run, cleared when they finish)."""
+
+    BAR = 20
+
+    def __init__(self, live: LiveObs, stream=None,
+                 interval: float | None = None, conf=None):
+        self.live = live
+        self.stream = stream if stream is not None else sys.stderr
+        if interval is None:
+            interval = PROGRESS_UPDATE_INTERVAL.default if conf is None \
+                else float(conf.get(PROGRESS_UPDATE_INTERVAL))
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_len = 0
+
+    def start(self) -> "ConsoleProgressReporter":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="spark-tpu-progress")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+        self._clear()
+
+    # ------------------------------------------------------------------
+    def render_line(self) -> str:
+        """One status line over every running query's stages."""
+        snap = self.live.snapshot()
+        parts = []
+        for qid, q in snap["running"].items():
+            for stage, st in q["stages"].items():
+                total = max(st["tasks_total"], 1)
+                done = st["tasks_done"]
+                fill = int(self.BAR * done / total)
+                bar = "=" * fill + ">" * (1 if fill < self.BAR else 0)
+                extra = ""
+                flagged = [f for f in q["findings"]
+                           if f["stage"] == stage]
+                if flagged:
+                    extra = f" STRAGGLERS={len(flagged)}"
+                parts.append(
+                    f"[{qid[:8]} {stage}] {done}/{total} tasks "
+                    f"[{bar:<{self.BAR}}] rows={st['rows']} "
+                    f"launches={st['launches']}{extra}")
+        return "  ".join(parts)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            line = self.render_line()
+            if line:
+                pad = max(self._last_len - len(line), 0)
+                try:
+                    self.stream.write("\r" + line + " " * pad)
+                    self.stream.flush()
+                except Exception:
+                    return
+                self._last_len = len(line)
+            elif self._last_len:
+                self._clear()
+
+    def _clear(self) -> None:
+        if self._last_len:
+            try:
+                self.stream.write("\r" + " " * self._last_len + "\r")
+                self.stream.flush()
+            except Exception:
+                pass
+            self._last_len = 0

@@ -35,7 +35,8 @@ from dataclasses import dataclass, field
 import numpy as np
 
 __all__ = ["AnalyzedReport", "current_op_name", "export_op_records",
-           "finalize_plan_metrics", "fused_members", "merge_op_records",
+           "export_op_records_partial", "finalize_plan_metrics",
+           "fused_members", "get_or_create_op_record", "merge_op_records",
            "new_op_record", "pop_op", "push_op", "record_kernel_launch",
            "record_kernel_compile", "scoped_submit"]
 
@@ -59,6 +60,23 @@ def new_op_record() -> dict:
     return {"rows": 0, "rows_exact": True, "batches": 0, "ms": 0.0,
             "calls": 0, "kinds": {}, "launch_total": 0, "compile_ms": 0.0,
             "pending": []}
+
+
+def get_or_create_op_record(rec: dict, key) -> dict:
+    """Insert-if-absent under the attribution lock. The plan_metrics
+    dict is iterated under `_ATTR_LOCK` by the live-telemetry partial
+    export (heartbeat thread) while operator threads create records for
+    nodes reaching their first batch — an unlocked `rec[key] = ...`
+    there can blow up the iterator with "dict changed size during
+    iteration". Every insertion into a plan_metrics dict goes through
+    here or `merge_op_records`."""
+    ent = rec.get(key)
+    if ent is None:
+        with _ATTR_LOCK:
+            ent = rec.get(key)
+            if ent is None:
+                ent = rec[key] = new_op_record()
+    return ent
 
 
 def push_op(record: dict | None, name: str):
@@ -144,7 +162,13 @@ def count_batch(rec: dict, record: dict, batch) -> None:
         return
     budget = rec.get(_PARKED_KEY)
     if budget is None:
-        budget = rec[_PARKED_KEY] = [PARKED_MASK_BUDGET_BYTES, set()]
+        # locked insert — the live-telemetry flush iterates this dict
+        # under _ATTR_LOCK (export_op_records_partial) concurrently
+        with _ATTR_LOCK:
+            budget = rec.get(_PARKED_KEY)
+            if budget is None:
+                budget = rec[_PARKED_KEY] = \
+                    [PARKED_MASK_BUDGET_BYTES, set()]
     remaining, charged = budget
     if id(mask) in charged:
         # already pinned by another operator's park this query: sharing
@@ -228,7 +252,8 @@ def finalize_plan_metrics(rec: dict | None) -> None:
                 ent["rows"] += n
             except Exception:
                 ent["rows_exact"] = False
-    rec.pop(_PARKED_KEY, None)
+    with _ATTR_LOCK:  # size-changing pop vs the live flush's iteration
+        rec.pop(_PARKED_KEY, None)
 
 
 def discard_pending(rec: dict | None) -> None:
@@ -239,7 +264,8 @@ def discard_pending(rec: dict | None) -> None:
         if ent.get("pending"):
             ent["pending"] = []
             ent["rows_exact"] = False
-    rec.pop(_PARKED_KEY, None)
+    with _ATTR_LOCK:  # size-changing pop vs the live flush's iteration
+        rec.pop(_PARKED_KEY, None)
 
 
 # ---------------------------------------------------------------------------
@@ -259,6 +285,30 @@ def export_op_records(rec: dict | None) -> dict:
     finalize_plan_metrics(rec)
     return {key: {f: v for f, v in ent.items() if f != "pending"}
             for key, ent in rec.items() if key != _PARKED_KEY}
+
+
+def export_op_records_partial(rec: dict | None) -> dict:
+    """Live-telemetry snapshot of in-flight per-operator records: host
+    counters only, parked row-masks STAY PARKED (resolving them is a
+    device sync the mid-query contract forbids — they resolve once at
+    task end). Rows with pending masks degrade to a lower bound
+    (rows_exact=False) in the snapshot; the final task-return record
+    supersedes with exact values. Never touches a device array."""
+    if not rec:
+        return {}
+    out = {}
+    with _ATTR_LOCK:
+        for key, ent in rec.items():
+            if key == _PARKED_KEY:
+                continue
+            out[key] = {
+                "rows": ent["rows"],
+                "rows_exact": ent["rows_exact"] and not ent["pending"],
+                "batches": ent["batches"], "ms": round(ent["ms"], 3),
+                "calls": ent["calls"], "kinds": dict(ent["kinds"]),
+                "launch_total": ent["launch_total"],
+                "compile_ms": round(ent["compile_ms"], 3)}
+    return out
 
 
 def merge_op_records(dst: dict, shipped: dict) -> None:

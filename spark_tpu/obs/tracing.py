@@ -105,7 +105,8 @@ _NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("tracer", "name", "cat", "args", "t0", "flow", "_ftoken")
+    __slots__ = ("tracer", "name", "cat", "args", "t0", "flow", "_ftoken",
+                 "_qid")
 
     def __init__(self, tracer: "Tracer", name: str, cat: str, args,
                  flow: bool = False):
@@ -116,6 +117,7 @@ class _Span:
         self.t0 = 0.0
         self.flow = flow
         self._ftoken = None
+        self._qid = None
 
     def set_args(self, args) -> None:
         """Attach/merge args before exit (per-span kernel attribution)."""
@@ -137,12 +139,17 @@ class _Span:
             self.set_args(args)
             self._ftoken = _FLOW.set(fid)
         self.t0 = time.perf_counter()
+        self._qid = _QUERY.get()
+        # live telemetry reads in-flight spans: register open, drop on
+        # close (two dict ops per span — still pure host bookkeeping)
+        self.tracer._open_add(self)
         return self
 
     def __exit__(self, *exc):
         dur = time.perf_counter() - self.t0
         if self._ftoken is not None:
             _FLOW.reset(self._ftoken)
+        self.tracer._open_remove(self)
         t = threading.current_thread()
         self.tracer._record(self.name, self.cat, self.t0, dur,
                             t.ident, t.name, self.args, _QUERY.get())
@@ -186,6 +193,8 @@ class Tracer:
         # paired clocks for cross-process timestamp rebasing: a worker's
         # perf_counter domain maps into ours through the wall clock
         self.anchor = (time.time(), time.perf_counter())
+        # spans currently inside __enter__/__exit__ (live telemetry view)
+        self._open: dict[int, "_Span"] = {}
 
     @property
     def enabled(self) -> bool:
@@ -212,6 +221,29 @@ class Tracer:
         with self._lock:
             self._flow_n += 1
             return f"{self._uid}:{self._flow_n}"
+
+    def _open_add(self, span: "_Span") -> None:
+        with self._lock:
+            self._open[id(span)] = span
+
+    def _open_remove(self, span: "_Span") -> None:
+        with self._lock:
+            self._open.pop(id(span), None)
+
+    def open_spans(self) -> list[dict]:
+        """Snapshot of spans currently in flight, as JSON-friendly dicts
+        with elapsed-so-far (the live-telemetry 'what is this task doing
+        RIGHT NOW' view). Pure host bookkeeping."""
+        with self._lock:
+            spans = list(self._open.values())
+        now = time.perf_counter()
+        out = []
+        for s in spans:
+            out.append({"name": s.name, "cat": s.cat,
+                        "elapsed_ms": round((now - s.t0) * 1000, 3),
+                        **({"query": s._qid} if s._qid is not None
+                           else {})})
+        return out
 
     def _record(self, name, cat, t0, dur, tid, tname, args,
                 qid=None) -> None:

@@ -41,6 +41,10 @@ class ShuffleExchangeExec(PhysicalPlan):
         # the producing pipeline traced into the partition-id kernel
         self.pipe_fusion: tuple | None = None
         self.pipe_attrs: list | None = None
+        # output column positions whose min/max the map-side write
+        # accumulates (annotate_exchange_stat_cols: only plan-reachable
+        # dense candidates); None = every integral column (bare plans)
+        self.stat_cols: list | None = None
 
     @property
     def output(self):
@@ -76,8 +80,13 @@ class ShuffleExchangeExec(PhysicalPlan):
         parts = self.child.execute(ctx)
         schema = attrs_schema(self.output)
         p = self.partitioning
-        self.last_stats = {}
-        self.last_col_stats = {}
+        # cleared IN PLACE: stage-builder/AQE copies share this node's
+        # __dict__ values (TreeNode.copy), so mutating the same dicts
+        # keeps runtime stats visible on the pre-copy plan the user
+        # inspects (EXPLAIN, tests); rebinding would strand them on the
+        # executing copy
+        self.last_stats.clear()
+        self.last_col_stats.clear()
         fusion = self._fusion() if self.pipe_fusion is not None else None
         with ctx.metrics.time("shuffle"):
             if isinstance(p, SinglePartition):
@@ -110,11 +119,12 @@ class ShuffleExchangeExec(PhysicalPlan):
                             fusion.bind_hash(key_positions,
                                              p.num_partitions),
                             p.num_partitions, schema, ctx, self.last_stats,
-                            self.last_col_stats)
+                            self.last_col_stats, self.stat_cols)
                     return S.shuffle_hash(parts, key_positions,
                                           p.num_partitions, schema, ctx,
                                           self.last_stats,
-                                          col_stats=self.last_col_stats)
+                                          col_stats=self.last_col_stats,
+                                          stat_cols=self.stat_cols)
             if isinstance(p, RangePartitioning):
                 with self._span(ctx, "exchange.range", p):
                     return self._range_shuffle(parts, p, schema, ctx,
@@ -125,10 +135,11 @@ class ShuffleExchangeExec(PhysicalPlan):
                         return S.shuffle_fused(
                             parts, fusion.bind_rr(p.num_partitions),
                             p.num_partitions, schema, ctx, self.last_stats,
-                            self.last_col_stats)
+                            self.last_col_stats, self.stat_cols)
                     return S.shuffle_round_robin(
                         parts, p.num_partitions, schema, ctx,
-                        self.last_stats, col_stats=self.last_col_stats)
+                        self.last_stats, col_stats=self.last_col_stats,
+                        stat_cols=self.stat_cols)
         raise UnsupportedOperationError(f"exchange for {p}")
 
     @staticmethod
@@ -171,14 +182,15 @@ class ShuffleExchangeExec(PhysicalPlan):
                 fusion.bind_range(kpos, bounds, not order.ascending,
                                   p.num_partitions),
                 p.num_partitions, schema, ctx, self.last_stats,
-                self.last_col_stats)
+                self.last_col_stats, self.stat_cols)
         bounds = _sample_bounds(parts, kpos, schema, p.num_partitions)
         if bounds is None or len(bounds) == 0:
             return S.gather_single(parts)
         return S.shuffle_range(parts, kpos, bounds, not order.ascending,
                                p.num_partitions, schema, ctx,
                                self.last_stats,
-                               col_stats=self.last_col_stats)
+                               col_stats=self.last_col_stats,
+                               stat_cols=self.stat_cols)
 
     def simple_string(self):
         s = f"Exchange[{type(self.partitioning).__name__}" \
@@ -255,6 +267,59 @@ def _sample_bounds(parts, kpos: int, schema, num_out: int,
     else:
         bounds = np.unique(s[qs])
     return bounds
+
+
+def dense_stat_candidate_ids(plan: PhysicalPlan) -> set:
+    """Expr ids whose value RANGE some downstream dense decision can
+    consult: the single integral/date grouping key of a hash aggregate
+    (dense-scatter vs sorted-segment, operators._try_dense and
+    fusion._dense_decision) and the single integral/date keys of a hash
+    join (dense direct-address build, operators._try_dense_build; both
+    sides listed — AQE may re-side the build). Pass-through projections
+    preserve expr ids, so membership at an exchange's output is exactly
+    'a consumer above can read this column's range'. Aliased/computed
+    keys produce FRESH device arrays whose identity the memo can never
+    hit, so excluding them loses nothing."""
+    from ..types import DateType, IntegralType
+    from .operators import HashAggregateExec, HashJoinExec
+
+    def single_int(keys) -> bool:
+        return len(keys) == 1 and isinstance(
+            keys[0].dtype, (IntegralType, DateType))
+
+    out: set = set()
+    for node in plan.iter_nodes():
+        if isinstance(node, HashAggregateExec):  # FusedAggregate too
+            if single_int(node.grouping):
+                out.add(node.grouping[0].expr_id)
+        if isinstance(node, HashJoinExec):
+            for keys in (node.left_keys, node.right_keys):
+                if single_int(keys):
+                    out.add(keys[0].expr_id)
+    return out
+
+
+def annotate_exchange_stat_cols(plan: PhysicalPlan) -> None:
+    """Restrict every shuffle exchange's map-side stat accumulation
+    (exec/shuffle._OutBuffer) to plan-reachable dense candidates: the
+    historical behavior ran host min/max over EVERY integral column per
+    appended slice even when no downstream consumer makes a dense
+    decision. Idempotent; runs at plan time (Planner.plan) so the
+    annotation rides stage-builder copies (shared __dict__) and
+    cloudpickle into cluster map tasks, and the plan analyzer reads the
+    SAME annotation for its krange3 launch model."""
+    exchanges = [n for n in plan.iter_nodes()
+                 if isinstance(n, ShuffleExchangeExec)]
+    # planner-annotated plans reach execute() already done (stat_cols
+    # defaults to None until annotated) — skip the candidate recompute;
+    # any exchange an adaptive rewrite introduced un-annotated re-runs it
+    if all(n.stat_cols is not None for n in exchanges):
+        return
+    cands = dense_stat_candidate_ids(plan)
+    for node in exchanges:
+        node.stat_cols = [
+            i for i, a in enumerate(node.output)
+            if a.expr_id in cands]
 
 
 class BroadcastExchangeExec(PhysicalPlan):

@@ -90,9 +90,9 @@ class PhysicalPlan(TreeNode):
                 key = getattr(self, "_metric_id", None)
                 if key is None:
                     key = id(self)
-                ent = rec.get(key)
-                if ent is None:
-                    ent = rec[key] = _OM.new_op_record()
+                # locked insert: the heartbeat flush iterates this dict
+                # under the attribution lock (export_op_records_partial)
+                ent = _OM.get_or_create_op_record(rec, key)
                 if getattr(ctx, "kernel_attribution", True):
                     token = _OM.push_op(ent, name)
             sp = tracer.span(name, cat="operator") if tracer is not None \

@@ -66,6 +66,12 @@ class Planner:
         if self.conf.get(FUSION_ENABLED):
             p = fuse_stages(p, self.conf)
         self._inject_dpp(p)
+        from .exchange import annotate_exchange_stat_cols
+
+        # after fusion (exchanges may have absorbed their pipeline —
+        # stat positions index the FUSED output): restrict map-side
+        # shuffle stat accumulation to plan-reachable dense candidates
+        annotate_exchange_stat_cols(p)
         return p
 
     # ------------------------------------------------------------------

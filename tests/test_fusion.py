@@ -343,21 +343,34 @@ def test_exchange_fusion_minrows_gate(fusion_spark, spark):
 
 
 def test_shuffle_read_batches_seed_dense_range_memo(fusion_spark, xdata):
-    """Map-side column stats seed the dense-range memo at build time:
-    dense agg/join decisions on shuffle-READ batches never launch the
-    krange3 probe, even though the arrays are fresh every run."""
+    """Map-side column stats seed the dense-range memo at build time for
+    the PLAN-REACHABLE dense candidates (annotate_exchange_stat_cols):
+    the downstream aggregate's single-int grouping key never launches
+    the krange3 probe on shuffle-READ batches, even though the arrays
+    are fresh every run — while columns no dense decision can consult
+    stop paying the per-append host min/max entirely."""
+    from spark_tpu.exec.context import ExecContext
+    from spark_tpu.physical.exchange import ShuffleExchangeExec
     from spark_tpu.physical.operators import dense_range_stats
 
     spark = xdata
     spark.conf.set("spark.tpu.fusion.enabled", "true")
-    df = spark.sql("select k, v from ex_t where v > 0").repartition(5, "k")
-    parts = df.query_execution.execute()
+    df = (spark.sql("select k, v from ex_t where v > 0")
+          .repartition(5, "k").groupBy("k").agg(F.sum("v").alias("sv")))
+    plan = df.query_execution.physical
+    ex = next(n for n in plan.iter_nodes()
+              if isinstance(n, ShuffleExchangeExec))
+    kpos = [i for i, a in enumerate(ex.output) if a.name == "k"]
+    assert ex.stat_cols == kpos, ex.stat_cols
+    # execute the exchange subtree: its output IS the shuffle-read side
+    parts = ex.execute(ExecContext(conf=spark.conf))
     before = KC.launches_by_kind.get("krange3", 0)
     for part in parts:
         for b in part:
             kmin, kmax, any_live = dense_range_stats(
-                b.columns[0], b.row_mask, b.capacity)
-            live = np.asarray(b.columns[0].data)[np.asarray(b.row_mask)]
+                b.columns[kpos[0]], b.row_mask, b.capacity)
+            live = np.asarray(
+                b.columns[kpos[0]].data)[np.asarray(b.row_mask)]
             if len(live):
                 assert any_live
                 assert kmin <= int(live.min()) <= int(live.max()) <= kmax
