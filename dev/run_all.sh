@@ -19,7 +19,7 @@ for ex in examples/*.py; do
     python "$ex" > /dev/null
 done
 
-echo "== trace gate (bench --smoke --trace + validation + drift) =="
+echo "== trace gate (bench --smoke --trace + validation + drift + resources) =="
 SPARK_TPU_TRACE_PATH=/tmp/sparktpu_smoke_trace.json \
     python bench.py --smoke --trace
 JAX_PLATFORMS=cpu python dev/validate_trace.py /tmp/sparktpu_smoke_trace.json

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """CI gate for the observability layer (dev/run_all.sh).
 
-Three checks, all hard failures:
+Four checks, all hard failures:
 
 1. Trace validation — the Chrome-trace JSON emitted by `bench.py --smoke
    --trace` must be well-formed (a non-empty `traceEvents` list of
@@ -25,7 +25,16 @@ Three checks, all hard failures:
    non-empty per-operator metrics whose attributed-launch total equals
    the measured (driver + worker) launch total.
 
-3. Live-telemetry gate (--live) — a cluster smoke run with a fast
+3. Resource gate — after the drift-gate query, the device ledger
+   (obs/resources.py) must verify internally: non-negative balances
+   everywhere (global, per-query, per-operator), the identity table
+   reconciling with the byte counter, attribution sums never exceeding
+   the global ledger; the KernelCache's captured cost table must be
+   non-empty with positive cumulative bytes accessed; and the gate
+   query's HBM record must show a positive measured watermark with
+   per-operator attribution.
+
+4. Live-telemetry gate (--live) — a cluster smoke run with a fast
    executor heartbeat must surface at least one MID-STAGE obs delta on
    the driver before any task returns (the reference's periodic
    Heartbeater streaming accumulator updates), and after completion the
@@ -222,6 +231,63 @@ def drift_gate(cluster: bool = False) -> None:
         session.stop()
 
 
+def resource_gate() -> None:
+    """Device-resource accounting must balance at query end: the ledger
+    verifies internally (non-negative balances, identity table ==
+    counter, attribution <= global), the kernel cost table is non-empty
+    with positive bytes accessed, and the gate query's HBM record shows
+    a positive per-operator-attributed watermark that EXPLAIN ANALYZE's
+    memory section reconciles against the plan analyzer's prediction."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu import TpuSession
+    from spark_tpu.obs.resources import GLOBAL_LEDGER
+    from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+    session = TpuSession("resource-gate", {
+        "spark.tpu.batch.capacity": 1 << 12,
+        "spark.tpu.fusion.minRows": "0",
+    })
+    try:
+        rng = np.random.default_rng(5)
+        session.createDataFrame(pa.table({
+            "k": rng.integers(0, 7, 3000),
+            "v": rng.integers(-10, 90, 3000),
+        })).createOrReplaceTempView("res_t")
+        df = session.sql("select k, sum(v) s from res_t where v > 0 "
+                         "group by k")
+        report = df.query_execution.analyzed_report()
+        issues = GLOBAL_LEDGER.verify()
+        if issues:
+            fail("resource gate: ledger failed verification — "
+                 + "; ".join(issues))
+        if not KC.cost_by_kind:
+            fail("resource gate: kernel cost table empty — cost capture "
+                 "never ran (spark.tpu.metrics.kernelCost path broken)")
+        if not KC.bytes_total > 0:
+            fail("resource gate: cumulative kernel bytes accessed is 0 — "
+                 "neither XLA cost_analysis nor the metadata fallback "
+                 "captured anything")
+        mem = report.memory
+        if not mem.get("measured_peak"):
+            fail("resource gate: EXPLAIN ANALYZE memory section has no "
+                 "measured HBM watermark for the gate query")
+        if not mem.get("predicted_peak"):
+            fail("resource gate: plan analyzer produced no predicted "
+                 "peak HBM for the gate query")
+        if not any(st.get("measured") for st in mem.get("per_stage", ())):
+            fail("resource gate: no per-operator HBM attribution reached "
+                 "the memory section (scope propagation broken)")
+        print("validate_trace: resource gate OK — ledger balanced "
+              f"({GLOBAL_LEDGER.bytes} B live), "
+              f"{len(KC.cost_by_kind)} kernel kinds costed "
+              f"({KC.bytes_total:.0f} B accessed), query watermark "
+              f"{mem['measured_peak']} B vs predicted {mem['predicted_peak']} B")
+    finally:
+        session.stop()
+
+
 def live_gate() -> None:
     """Heartbeat-streamed telemetry must be operational, not post-mortem:
     run a deliberately slow map stage on a 2-worker cluster heartbeating
@@ -312,6 +378,7 @@ def main(argv=None) -> int:
         return 2
     validate_trace(argv[0], cluster=cluster)
     drift_gate(cluster=cluster)
+    resource_gate()
     if live:
         live_gate()
     print("validate_trace: PASS")

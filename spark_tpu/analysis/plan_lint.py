@@ -209,6 +209,14 @@ class AnalysisReport:
     recompile_hazards: list = field(default_factory=list)
     overflow_risks: list = field(default_factory=list)
     host_sync_notes: list = field(default_factory=list)
+    # memory model (mirrors the layout model the launch counts ride on):
+    # per-stage predicted HBM in stages[i]["hbm_bytes"]; the query peak
+    # is the SUM of stage outputs — an upper bound on simultaneously
+    # resident engine tiles (operators materialize whole output
+    # partition lists; GC frees consumed children at uncertain points)
+    predicted_peak_hbm: Optional[int] = None
+    memory_exact: bool = True
+    memory_notes: list = field(default_factory=list)
 
     @property
     def total(self) -> int:
@@ -225,6 +233,9 @@ class AnalysisReport:
             "recompile_hazards": list(self.recompile_hazards),
             "overflow_risks": list(self.overflow_risks),
             "host_sync_notes": list(self.host_sync_notes),
+            "predicted_peak_hbm": self.predicted_peak_hbm,
+            "memory_exact": self.memory_exact,
+            "memory_notes": list(self.memory_notes),
         }
 
     def render(self) -> str:
@@ -246,6 +257,20 @@ class AnalysisReport:
                    f"{{{pred}}} --")
         for r in self.inexact_reasons:
             out.append(f"  ? {r}")
+        if self.predicted_peak_hbm is not None:
+            mtag = "model exact" if self.memory_exact \
+                else "model approximate"
+            out.append(f"-- predicted peak HBM ({mtag}): "
+                       f"~{self.predicted_peak_hbm / (1 << 20):.1f} MiB "
+                       "resident engine tiles --")
+            staged = sorted((s for s in self.stages
+                             if s.get("hbm_bytes")),
+                            key=lambda s: -s["hbm_bytes"])[:5]
+            for s in staged:
+                out.append(f"  {s['op']:<22} "
+                           f"~{s['hbm_bytes'] / (1 << 20):.2f} MiB")
+            for n in self.memory_notes:
+                out.append(f"  ? {n}")
         if self.fusion_boundaries:
             out.append("-- fusion boundaries --")
             out.extend(f"  * {b}" for b in self.fusion_boundaries)
@@ -330,6 +355,11 @@ class _Analyzer:
         self._min_rows = int(conf.get(FUSION_MIN_ROWS))
         self._dense_keys = bool(conf.get(FUSION_DENSE_KEYS))
         self._tile = int(conf.get(BATCH_CAPACITY))
+        # memory model state: the stage entry each node produced (so the
+        # OUTPUT flow recorded after the handler returns can annotate it)
+        self._stage_by_node: dict[int, dict] = {}
+        self._hbm_total = 0
+        self._hbm_any = False
 
     # -- bookkeeping -------------------------------------------------------
     def _approx(self, reason: str):
@@ -360,25 +390,74 @@ class _Analyzer:
                 lpb = round(per_batch / batches, 2)
         detail = node.simple_string() if hasattr(node, "simple_string") \
             else type(node).__name__
-        self.report.stages.append({
+        ent = {
             "op": type(node).__name__,
             "detail": detail[:120],
             "kinds": dict(kinds),
             "batches": batches,
             "launches_per_batch": lpb,
             "notes": list(notes),
-        })
+        }
+        self.report.stages.append(ent)
+        self._stage_by_node[id(node)] = ent
 
     # -- entry -------------------------------------------------------------
     def run(self, plan) -> AnalysisReport:
         self.visit(plan)
         self.report.predicted_launches = dict(self.predicted)
+        if self._hbm_any:
+            self.report.predicted_peak_hbm = self._hbm_total
         self._explain_boundaries(plan)
         self._overflow_pass(plan)
         return self.report
 
+    # -- memory model ------------------------------------------------------
+    def _mem_approx(self, reason: str) -> None:
+        self.report.memory_exact = False
+        if reason not in self.report.memory_notes:
+            self.report.memory_notes.append(reason)
+
+    def _record_memory(self, node, flow: _Flow) -> None:
+        """Predicted HBM of one stage's OUTPUT tiles: capacity × device
+        row bytes (column data + validity planes + row mask — the same
+        schema_row_bytes the MemoryManager budgets with and the same
+        planes the runtime ledger registers per batch). Unknown
+        capacities fall back to the session tile and degrade the model
+        to approximate; the query peak sums stages (everything an
+        execution materializes counts once)."""
+        try:
+            from ..exec.memory import schema_row_bytes
+            from ..physical.operators import attrs_schema
+
+            row_bytes = schema_row_bytes(attrs_schema(node.output))
+        except Exception:
+            row_bytes = None
+            self._mem_approx(f"{type(node).__name__}: output schema "
+                             "unavailable — stage bytes estimated at "
+                             "16 B/row")
+        total = 0
+        for p in flow.parts:
+            for b in p:
+                cap = b.cap
+                if cap is None:
+                    cap = self._tile
+                    self._mem_approx(
+                        f"{type(node).__name__}: unknown tile capacity "
+                        "assumed spark.tpu.batch.capacity")
+                total += cap * (row_bytes if row_bytes else 16)
+        ent = self._stage_by_node.get(id(node))
+        if ent is not None and "hbm_bytes" not in ent:
+            ent["hbm_bytes"] = total
+            self._hbm_total += total
+            self._hbm_any = True
+
     # -- dispatch ----------------------------------------------------------
     def visit(self, node) -> _Flow:
+        flow = self._dispatch(node)
+        self._record_memory(node, flow)
+        return flow
+
+    def _dispatch(self, node) -> _Flow:
         from ..physical import operators as O
         from ..physical.exchange import (
             BroadcastExchangeExec, ShuffleExchangeExec,

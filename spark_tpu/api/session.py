@@ -107,6 +107,12 @@ class TpuSession:
         # always-on span tracing (spark.tpu.trace.enabled flips it live);
         # pure host bookkeeping — see obs/tracing.py
         self.tracer = Tracer(conf=self.conf)
+        from ..obs import resources as _resources
+
+        # device-resource ledger + kernel cost capture switches
+        # (spark.tpu.memory.ledger / spark.tpu.metrics.kernelCost) —
+        # process-global like the KernelCache, configured per session
+        _resources.configure(self.conf)
         from ..obs.live import LiveObs
 
         # live telemetry store: heartbeat-streamed worker obs partials,

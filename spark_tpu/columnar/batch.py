@@ -322,7 +322,10 @@ class ColumnarBatch:
     `num_rows` is the host-known live count when available (None after a
     device-side filter until counted)."""
 
-    __slots__ = ("schema", "columns", "row_mask", "_num_rows", "_stats")
+    # __weakref__: the device-resource ledger (obs/resources.py) arms a
+    # weakref finalizer per batch to release its HBM charge on GC
+    __slots__ = ("schema", "columns", "row_mask", "_num_rows", "_stats",
+                 "__weakref__")
 
     def __init__(self, schema: StructType, columns: Sequence[Column], row_mask,
                  num_rows: int | None = None):
@@ -334,6 +337,14 @@ class ColumnarBatch:
         self._stats = None  # lazy per-batch kernel-result cache (dense agg
         # range etc.) so repeated executions over a cached batch skip the
         # host round-trip of re-syncing the same scalars
+        # HBM ledger registration: charge this tile's device planes to
+        # the current query/operator scope (array-identity refcounted, so
+        # rewraps over shared columns charge once; shape/dtype metadata
+        # only — zero launches, no sync)
+        from ..obs.resources import GLOBAL_LEDGER, ledger_enabled
+
+        if ledger_enabled():
+            GLOBAL_LEDGER.register_batch(self)
 
     @property
     def capacity(self) -> int:

@@ -345,6 +345,50 @@ STRAGGLER_HEARTBEAT_DEADLINE = _register(ConfigEntry(
     "seconds is flagged as a straggler regardless of rate (executor "
     "frozen or partitioned).", float))
 
+# --- resource observability (spark_tpu/obs/resources.py) -------------------
+
+MEMORY_LEDGER = _register(ConfigEntry(
+    "spark.tpu.memory.ledger", True,
+    "Attributed HBM shadow ledger: every engine-held device buffer "
+    "(columnar batches — column data, validity planes, row masks) "
+    "registers its metadata-derived byte size to the current "
+    "query/operator scope and deregisters on GC, giving live occupancy "
+    "and per-query/per-stage watermarks (obs/resources.py). Pure host "
+    "bookkeeping — zero kernel launches, no device syncs.", _bool))
+
+MEMORY_BUDGET = _register(ConfigEntry(
+    "spark.tpu.memory.budget", 0,
+    "Per-query HBM admission budget in bytes (0 = unlimited): before "
+    "dispatch, the plan analyzer's memory model predicts peak resident "
+    "HBM and the query fails with MemoryBudgetExceeded naming the "
+    "offending stage instead of an opaque XLA OOM mid-query (role of "
+    "the reference's ExecutionMemoryPool acquireMemory refusal).", int))
+
+KERNEL_COST = _register(ConfigEntry(
+    "spark.tpu.metrics.kernelCost", True,
+    "Capture each compiled kernel's XLA cost_analysis() (flops, bytes "
+    "accessed) at first invocation via the lowering — no second backend "
+    "compile — with an argument/output-metadata fallback; launches then "
+    "attribute flops/bytes to the executing operator for EXPLAIN "
+    "ANALYZE's achieved-GB/s roofline view and bench.py's measured "
+    "hbm_gbps.", _bool))
+
+MEMORY_PEAK_GBPS = _register(ConfigEntry(
+    "spark.tpu.memory.peakGbps", 0.0,
+    "Peak HBM bandwidth (GB/s) for achieved-vs-peak rendering; 0 = auto "
+    "from the device kind (CPU backends report no roofline).", float))
+
+HEARTBEAT_FLUSH_BUDGET = _register(ConfigEntry(
+    "spark.tpu.heartbeat.flushBudget", 1 << 18,
+    "Approximate byte cap on the live-obs payload of ONE executor "
+    "heartbeat. Beyond it, remaining in-flight tasks ship minimal "
+    "counter-only deltas and an overflow counter surfaces in live "
+    "status; their closed spans stay in a bounded carry buffer and the "
+    "trim rotates across tasks, so each task periodically ships in "
+    "full (only a task closing more spans than the carry bound before "
+    "its rotation turn loses its oldest — the task-return record still "
+    "carries the complete set). 0 = uncapped.", int))
+
 
 class SQLConf:
     """Session-local config with string overrides over typed defaults.

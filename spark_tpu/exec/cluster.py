@@ -261,9 +261,15 @@ class LocalCluster:
         eid = msg["eid"]
         ok = self.registry.heartbeat(eid)
         sink = self.obs_sink
-        if ok and sink is not None and msg.get("obs"):
+        if ok and sink is not None and (
+                msg.get("obs") or msg.get("hbm") is not None):
             try:
-                sink(eid, msg["obs"])
+                # the sink is LiveObs.on_heartbeat, which takes the
+                # executor-level resource fields too (per-executor HBM
+                # occupancy + the flush-budget overflow counter)
+                sink(eid, msg.get("obs") or [],
+                     hbm=msg.get("hbm"),
+                     overflows=msg.get("obs_overflows"))
             except Exception:
                 pass    # telemetry must never fail a liveness heartbeat
         return b"ok" if ok else b"unknown"

@@ -570,6 +570,13 @@ class ClusterDAGScheduler(DAGScheduler):
                     wk = self.ctx.worker_kernel_kinds = {}
                 for k, v in kinds.items():
                     wk[k] = wk.get(k, 0) + v
+        if obs.get("hbm"):
+            # worker HBM is a DIFFERENT device's memory: it folds into
+            # the query record as a per-executor remote peak (EXPLAIN
+            # ANALYZE's memory section), never into the driver balance
+            from ..obs.resources import GLOBAL_LEDGER
+
+            GLOBAL_LEDGER.merge_remote(qid, executor_id, obs["hbm"])
 
     def _finalize_merge(self, sid: str, num_maps: int):
         """Close the shuffle to late pushes and register which map ids

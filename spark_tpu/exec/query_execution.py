@@ -150,6 +150,13 @@ class QueryExecution:
         # downstream dense decision can actually consult (the plan
         # analyzer mirrors the same reachability rule)
         annotate_exchange_stat_cols(plan)
+        # HBM admission control: with spark.tpu.memory.budget set, the
+        # analyzer's memory model pre-flights predicted peak HBM and an
+        # over-budget plan fails HERE — named stage, nothing dispatched —
+        # instead of as an opaque XLA OOM mid-query (obs/resources.py)
+        from ..obs.resources import check_memory_budget
+
+        check_memory_budget(plan, self.session.conf)
         # execution always runs under a query scope: collects push one in
         # to_arrow, but direct execute() callers (bench._run_blocked,
         # tests) would otherwise stream worker heartbeat deltas with no
@@ -443,9 +450,19 @@ class QueryExecution:
                           for k, v in after_counters.items()
                           if v != before_counters.get(k, 0)}
         ctx = getattr(self, "_last_ctx", None)
+        # device-resource view of the measured run: the ledger's
+        # per-query record (driver watermarks + worker peaks merged from
+        # the shipped task obs) reconciles against the analyzer's
+        # per-stage memory model inside the report
+        from ..obs.resources import GLOBAL_LEDGER, device_peak_gbps
+
+        resources = GLOBAL_LEDGER.query_record(
+            getattr(ctx, "query_id", None))
         report = build_analyzed_report(
             self.physical, getattr(ctx, "plan_metrics", None),
-            prediction, measured, counter_deltas, wall_ms)
+            prediction, measured, counter_deltas, wall_ms,
+            resources=resources,
+            peak_gbps=device_peak_gbps(self.session.conf))
         # straggler findings the live telemetry raised during the
         # measured run surface as first-class EXPLAIN ANALYZE findings
         live = getattr(ctx, "live_obs", None)

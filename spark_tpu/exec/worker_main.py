@@ -105,11 +105,16 @@ def begin_stage_obs(conf, query_id: str | None = None,
     zero-launch/no-mid-query-sync contract as the driver recorder —
     everything here is host bookkeeping. Returns None when the session
     disabled obs shipping."""
-    from ..config import (CLUSTER_OBS_SHIPPING, HEARTBEAT_OBS,
-                          KERNEL_ATTRIBUTION, TRACE_ENABLED,
+    from ..config import (CLUSTER_OBS_SHIPPING, HEARTBEAT_FLUSH_BUDGET,
+                          HEARTBEAT_OBS, KERNEL_ATTRIBUTION, TRACE_ENABLED,
                           TRACE_MAX_SPANS, UI_OPERATOR_METRICS)
+    from ..obs import resources as _resources
     from ..obs.tracing import Tracer
     from ..physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+    # ledger + kernel-cost switches follow the shipped session conf (the
+    # worker-process analog of TpuSession.__init__'s configure call)
+    _resources.configure(conf)
 
     # conf values are host data — bool() here never touches device
     if not bool(conf.get(  # tpulint: ignore[host-sync]
@@ -132,11 +137,28 @@ def begin_stage_obs(conf, query_id: str | None = None,
              "query_id": query_id, "stage_id": stage_id,
              "task_id": task_id, "flush_seq": 0,
              "span_mark": tracer.mark() if trace_on else 0,
-             "unsent_spans": []}
+             "unsent_spans": [], "sent_spans": 0,
+             "flush_budget": int(conf.get(  # tpulint: ignore[host-sync]
+                 HEARTBEAT_FLUSH_BUDGET))}
     if bool(conf.get(HEARTBEAT_OBS)):  # tpulint: ignore[host-sync]
         with _STORE_LOCK:
             _LIVE_TASKS[id(state)] = state
     return state
+
+
+# heartbeat flush-budget bookkeeping: tasks trimmed to a minimal delta
+# because a beat hit spark.tpu.heartbeat.flushBudget (cumulative — the
+# driver surfaces it in live status), and a rotation cursor so the trim
+# never starves the same tasks every beat
+FLUSH_OVERFLOWS = 0
+_FLUSH_RR = 0
+
+# rough per-element payload estimates (pickled size order-of-magnitude):
+# exact accounting would pickle twice per beat for no benefit
+_DELTA_BASE_COST = 256
+_OP_RECORD_COST = 160
+_SPAN_COST = 240
+_OPEN_SPAN_COST = 96
 
 
 def collect_live_obs() -> list:
@@ -148,18 +170,44 @@ def collect_live_obs() -> list:
     buffer until `ack_live_obs` confirms the heartbeat RPC succeeded,
     so a failed beat re-sends them instead of silently dropping them
     (at-least-once across failures; exactly-once on a healthy channel).
+
+    Very wide executors cap the payload per beat at
+    spark.tpu.heartbeat.flushBudget: once the (estimated) budget is
+    spent, remaining tasks ship minimal counter-only deltas — their
+    closed spans STAY in the (bounded) carry buffer for a later beat,
+    the overflow is counted (FLUSH_OVERFLOWS → live status), and the
+    collection order rotates so no task is trimmed forever; a task
+    closing more spans than the carry bound before its rotation turn
+    loses its oldest from the LIVE stream only (the task-return record
+    ships the tracer's full ring regardless).
+
     Host counters only: parked row-masks stay parked
     (export_op_records_partial), no kernel is launched, no device array
     is read."""
+    global FLUSH_OVERFLOWS, _FLUSH_RR
+
     from ..obs.metrics import export_op_records_partial
     from ..physical.compile import GLOBAL_KERNEL_CACHE as KC
 
     with _STORE_LOCK:
         states = list(_LIVE_TASKS.values())
+    if states:
+        _FLUSH_RR = (_FLUSH_RR + 1) % len(states)
+        states = states[_FLUSH_RR:] + states[:_FLUSH_RR]
+    budget = next((s["flush_budget"] for s in states
+                   if s.get("flush_budget")), 0)
+    spent = 0
     out = []
     for state in states:
         state["flush_seq"] += 1
-        recs = export_op_records_partial(state["rec"])
+        trimmed = budget > 0 and spent >= budget
+        # a trimmed task still ships its rolled-up counters — it just
+        # drops the per-operator breakdown from the payload
+        full = export_op_records_partial(state["rec"])
+        recs = {} if trimmed else full
+        rows = sum(e.get("rows", 0) for e in full.values())
+        rows_exact = all(e.get("rows_exact", True) for e in full.values())
+        batches = sum(e.get("batches", 0) for e in full.values())
         tracer = state["tracer"]
         spans_closed: list = []
         open_spans: list = []
@@ -169,40 +217,48 @@ def collect_live_obs() -> list:
             carry = state["unsent_spans"]
             carry.extend(tracer.since(mark))
             del carry[:-512]  # bound the carry across a long outage
-            spans_closed = list(carry)
-            open_spans = tracer.open_spans()
+            if not trimmed:
+                spans_closed = list(carry)
+                open_spans = tracer.open_spans()
+        state["sent_spans"] = len(spans_closed)
+        if trimmed:
+            FLUSH_OVERFLOWS += 1
         kinds = {k: v - state["kinds0"].get(k, 0)
                  for k, v in KC.launches_by_kind.items()
                  if v != state["kinds0"].get(k, 0)}
+        spent += (_DELTA_BASE_COST + _OP_RECORD_COST * len(recs)
+                  + _SPAN_COST * len(spans_closed)
+                  + _OPEN_SPAN_COST * len(open_spans))
         out.append({
             "query": state["query_id"], "stage": state["stage_id"],
             "task": state["task_id"], "seq": state["flush_seq"],
             "executor_pid": os.getpid(),
-            "rows": sum(e.get("rows", 0) for e in recs.values()),
-            "rows_exact": all(e.get("rows_exact", True)
-                              for e in recs.values()),
-            "batches": sum(e.get("batches", 0) for e in recs.values()),
+            "rows": rows,
+            "rows_exact": rows_exact,
+            "batches": batches,
             "launches": KC.launches - state["launches0"],
             "compile_ms": round(KC.compile_ms - state["compile_ms0"], 3),
             "kernel_kinds": kinds,
-            "op_records": recs,
+            "op_records": recs if not trimmed else None,
             "spans_closed": spans_closed,
-            "open_spans": open_spans,
+            "open_spans": open_spans if not trimmed else None,
         })
     return out
 
 
 def ack_live_obs() -> None:
     """The heartbeat carrying the last `collect_live_obs` snapshot
-    reached the driver — drop the carried closed spans. Called only
-    from the (single) heartbeat thread, strictly alternating with
-    collect, so nothing is appended to the unsent buffers in between
-    (new spans land in the tracer ring and are picked up by the next
-    collect's mark)."""
+    reached the driver — drop the closed spans that beat actually
+    INCLUDED (a flush-budget trim keeps its carry for the next beat).
+    Called only from the (single) heartbeat thread, strictly alternating
+    with collect, so nothing is appended to the unsent buffers in
+    between (new spans land in the tracer ring and are picked up by the
+    next collect's mark)."""
     with _STORE_LOCK:
         states = list(_LIVE_TASKS.values())
     for state in states:
-        state["unsent_spans"] = []
+        del state["unsent_spans"][:state.get("sent_spans", 0)]
+        state["sent_spans"] = 0
 
 
 def finish_stage_obs(state: dict | None) -> dict | None:
@@ -216,6 +272,7 @@ def finish_stage_obs(state: dict | None) -> dict | None:
     if state is None:
         return None
     from ..obs.metrics import export_op_records
+    from ..obs.resources import GLOBAL_LEDGER
     from ..physical.compile import GLOBAL_KERNEL_CACHE as KC
 
     with _STORE_LOCK:
@@ -224,6 +281,9 @@ def finish_stage_obs(state: dict | None) -> dict | None:
              for k, v in KC.launches_by_kind.items()
              if v != state["kinds0"].get(k, 0)}
     tracer = state["tracer"]
+    # this process's HBM accounting for the task's query (the ledger is
+    # per-process; the driver merges it as the executor's remote peak)
+    hbm = GLOBAL_LEDGER.query_record(state["query_id"])
     return {
         "op_records": export_op_records(state["rec"]),
         "spans": tracer.spans() if tracer is not None else [],
@@ -231,6 +291,9 @@ def finish_stage_obs(state: dict | None) -> dict | None:
         "kernel_kinds": kinds,
         "kernel_launches": KC.launches - state["launches0"],
         "kernel_compile_ms": round(KC.compile_ms - state["compile_ms0"], 3),
+        "hbm": {"bytes": hbm["bytes"], "peak": hbm["peak"],
+                "ops": {k: v["peak"] for k, v in hbm["ops"].items()}}
+        if hbm is not None else None,
         "pid": os.getpid(),
     }
 
@@ -315,7 +378,15 @@ def serve_worker(driver_addr: str, token: str, host_label: str = "localhost",
                 # Span-heavy payloads compress well — gzip them on the
                 # wire instead of raising the frame budget.
                 obs = collect_live_obs()
-                payload = pickle.dumps({"eid": eid, "obs": obs})
+                # executor-level HBM occupancy (device ledger snapshot —
+                # metadata counters only) rides EVERY beat, so cluster
+                # live status shows per-executor HBM even between tasks
+                from ..obs.resources import GLOBAL_LEDGER
+
+                payload = pickle.dumps({
+                    "eid": eid, "obs": obs,
+                    "hbm": GLOBAL_LEDGER.snapshot(),
+                    "obs_overflows": FLUSH_OVERFLOWS})
                 reply = driver.call("heartbeat", payload, timeout=5,
                                     compress=bool(obs))
                 if reply != b"unknown":

@@ -58,6 +58,11 @@ __all__ = ["ConsoleProgressReporter", "LiveObs", "start_query_flusher"]
 
 _MAX_QUERIES = 64          # retained finished queries (ring)
 _MAX_TASK_SPANS = 64       # recent closed spans kept per task
+_EXECUTOR_TTL = 60.0       # drop executor resource rows this long silent
+#                            (an executor that re-registered under a new
+#                            eid would otherwise leave a ghost row whose
+#                            cumulative overflow count double-counts its
+#                            process)
 
 
 def _new_task() -> dict:
@@ -92,6 +97,9 @@ class LiveObs:
         self._queries: "OrderedDict[str, dict]" = OrderedDict()
         self.late_dropped = 0     # heartbeats discarded after task end
         self.partials_seen = 0    # mid-stage deltas accepted
+        # executor-level resource telemetry (rides every heartbeat, even
+        # idle ones): eid -> {"hbm_bytes", "hbm_peak", "overflows", "at"}
+        self.executors: dict[str, dict] = {}
         # straggler-scan memo: every heartbeat, UI snapshot, and
         # speculative wait polls check_stragglers — rescanning the whole
         # store each time is wasted work AND lock contention. A scan is
@@ -131,17 +139,36 @@ class LiveObs:
             t = st["tasks"][task] = _new_task()
         return t
 
-    def on_heartbeat(self, executor_id: str, deltas: list) -> None:
+    def on_heartbeat(self, executor_id: str, deltas: list,
+                     hbm: dict | None = None,
+                     overflows: int | None = None) -> None:
         """Fold one executor heartbeat's live obs deltas into the store.
         Each delta is a cumulative snapshot of one running stage task
         (see exec/worker_main.collect_live_obs): snapshots replace, so
         a lost heartbeat never loses counts — the next one carries
         them. Closed spans ride incrementally, carried until the worker
         acks delivery (at-least-once across failed beats; a beat whose
-        reply was lost may repeat a span in the display ring)."""
+        reply was lost may repeat a span in the display ring).
+
+        `hbm` is the executor's device-ledger snapshot (live HBM bytes +
+        process watermark) and `overflows` its cumulative flush-budget
+        trim count — executor-level facts that ride every beat, task
+        deltas or not."""
+        now = time.time()
+        if hbm is not None or overflows is not None:
+            with self._lock:
+                ent = self.executors.setdefault(executor_id, {})
+                if hbm is not None:
+                    ent["hbm_bytes"] = hbm.get("bytes", 0)
+                    ent["hbm_peak"] = hbm.get("peak", 0)
+                if overflows is not None:
+                    ent["overflows"] = overflows
+                ent["at"] = now
+                for eid in [eid for eid, e in self.executors.items()
+                            if now - e.get("at", now) > _EXECUTOR_TTL]:
+                    del self.executors[eid]
         if not deltas:
             return
-        now = time.time()
         with self._lock:
             self._version += 1
             for d in deltas:
@@ -187,7 +214,8 @@ class LiveObs:
                     t["kernel_kinds"] = dict(d["kernel_kinds"])
                 if d.get("op_records") is not None:
                     t["op_records"] = d["op_records"]
-                t["open_spans"] = d.get("open_spans") or []
+                if d.get("open_spans") is not None:
+                    t["open_spans"] = d["open_spans"]
                 for sp in d.get("spans_closed") or ():
                     t["spans"].append(sp)
                 del t["spans"][:-_MAX_TASK_SPANS]
@@ -204,6 +232,17 @@ class LiveObs:
         batches = sum(e.get("batches", 0) for e in op_records.values())
         launches = sum(e.get("launch_total", 0)
                        for e in op_records.values())
+        # the driver is an "executor" too: publish its device-ledger
+        # occupancy so local-mode consoles show the same HBM rows the
+        # cluster heartbeats feed (host counters only)
+        from .resources import GLOBAL_LEDGER
+
+        hbm = GLOBAL_LEDGER.snapshot()
+        with self._lock:
+            ent = self.executors.setdefault("driver", {})
+            ent["hbm_bytes"] = hbm["bytes"]
+            ent["hbm_peak"] = hbm["peak"]
+            ent["at"] = time.time()
         with self._lock:
             self._version += 1
             t = self._task(qid, "local", 0)
@@ -457,9 +496,46 @@ class LiveObs:
             t = st["tasks"].get(task)
             return dict(t) if t is not None else None
 
+    def executor_utilization(self) -> dict:
+        """Per-executor live utilization: progress rate of the RUNNING
+        tasks it owns (rows+batches+launches per second — the straggler
+        detector's unit) plus its latest heartbeat-shipped HBM occupancy
+        and flush-budget overflow count. Feeds the console reporter's
+        per-executor rows and the live UI."""
+        now = time.time()
+        with self._lock:
+            out = {eid: {"rows": 0, "rate": 0.0, "tasks": 0,
+                         "hbm_bytes": e.get("hbm_bytes"),
+                         "hbm_peak": e.get("hbm_peak"),
+                         "overflows": e.get("overflows", 0)}
+                   for eid, e in self.executors.items()}
+            for q in self._queries.values():
+                if q["done"]:
+                    continue
+                for st in q["stages"].values():
+                    for t in st["tasks"].values():
+                        if t["done"] or t["executor"] is None:
+                            continue
+                        e = out.setdefault(
+                            t["executor"],
+                            {"rows": 0, "rate": 0.0, "tasks": 0,
+                             "hbm_bytes": None, "hbm_peak": None,
+                             "overflows": 0})
+                        e["tasks"] += 1
+                        e["rows"] += t["rows"]
+                        e["rate"] += self._units(t) / max(
+                            now - t["first_seen"], 1e-6)
+        return out
+
+    def flush_overflow_total(self) -> int:
+        with self._lock:
+            return sum(e.get("overflows", 0)
+                       for e in self.executors.values())
+
     def snapshot(self) -> dict:
         """Whole-store view for the live UI: running queries with stage
-        progress, straggler findings, merge-discipline counters."""
+        progress, straggler findings, merge-discipline counters, and
+        per-executor utilization/HBM rows."""
         with self._lock:
             qids = [qid for qid, q in self._queries.items()
                     if not q["done"]]
@@ -467,7 +543,9 @@ class LiveObs:
         out = {"running": {}, "finished_queries": finished,
                "partials_seen": self.partials_seen,
                "late_dropped": self.late_dropped,
-               "stragglers": self.check_stragglers()}
+               "stragglers": self.check_stragglers(),
+               "executors": self.executor_utilization(),
+               "flush_overflows": self.flush_overflow_total()}
         for qid in qids:
             p = self.query_progress(qid)
             if p is not None:
@@ -566,7 +644,10 @@ class ConsoleProgressReporter:
 
     # ------------------------------------------------------------------
     def render_line(self) -> str:
-        """One status line over every running query's stages."""
+        """One status line over every running query's stages, followed
+        by per-executor utilization rows (running tasks, progress rate,
+        live HBM occupancy from the device ledger — streamed on the
+        heartbeat for workers, read directly for the driver)."""
         snap = self.live.snapshot()
         parts = []
         for qid, q in snap["running"].items():
@@ -584,6 +665,19 @@ class ConsoleProgressReporter:
                     f"[{qid[:8]} {stage}] {done}/{total} tasks "
                     f"[{bar:<{self.BAR}}] rows={st['rows']} "
                     f"launches={st['launches']}{extra}")
+        if parts:
+            from .metrics import _fmt_bytes
+
+            for eid, e in sorted(snap.get("executors", {}).items()):
+                seg = f"{eid}: {e['tasks']} task" \
+                      f"{'s' if e['tasks'] != 1 else ''}"
+                if e["rate"]:
+                    seg += f" {e['rate']:.0f}/s"
+                if e.get("hbm_bytes") is not None:
+                    seg += f" hbm={_fmt_bytes(e['hbm_bytes'])}"
+                if e.get("overflows"):
+                    seg += f" obs-trims={e['overflows']}"
+                parts.append(f"<{seg}>")
         return "  ".join(parts)
 
     def _loop(self) -> None:

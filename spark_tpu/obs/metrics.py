@@ -59,7 +59,7 @@ _ATTR_LOCK = threading.Lock()
 def new_op_record() -> dict:
     return {"rows": 0, "rows_exact": True, "batches": 0, "ms": 0.0,
             "calls": 0, "kinds": {}, "launch_total": 0, "compile_ms": 0.0,
-            "pending": []}
+            "flops": 0.0, "bytes": 0.0, "pending": []}
 
 
 def get_or_create_op_record(rec: dict, key) -> dict:
@@ -93,9 +93,13 @@ def current_op_name() -> str | None:
     return scope[1] if scope is not None else None
 
 
-def record_kernel_launch(kind) -> None:
+def record_kernel_launch(kind, cost: dict | None = None) -> None:
     """Called by KernelCache on every kernel invocation (pure host
-    bookkeeping — never a launch or sync itself)."""
+    bookkeeping — never a launch or sync itself). `cost` is the kernel's
+    captured per-launch cost (flops / bytes accessed — physical/compile.
+    _capture_kernel_cost), multiplied out onto the executing operator's
+    record so EXPLAIN ANALYZE can render per-operator FLOPs, bytes and
+    achieved GB/s."""
     scope = _SCOPE.get()
     if scope is None or scope[0] is None:
         return
@@ -103,6 +107,9 @@ def record_kernel_launch(kind) -> None:
     with _ATTR_LOCK:
         rec["kinds"][kind] = rec["kinds"].get(kind, 0) + 1
         rec["launch_total"] += 1
+        if cost is not None:
+            rec["flops"] += cost["flops"]
+            rec["bytes"] += cost["bytes"]
 
 
 def record_kernel_compile(kind, ms: float) -> None:
@@ -218,13 +225,23 @@ def op_metric_fields(ent: dict | None) -> dict:
     own identity/topology fields around this."""
     if not ent:
         return {"rows": None, "rows_exact": True, "ms": None,
-                "batches": None, "launches": None, "compile_ms": None}
+                "batches": None, "launches": None, "compile_ms": None,
+                "flops": None, "bytes": None, "gbps": None}
+    ms = ent["ms"]
+    by = ent.get("bytes") or 0.0
     return {"rows": ent["rows"], "rows_exact": ent["rows_exact"],
-            "ms": round(ent["ms"], 3),
+            "ms": round(ms, 3),
             "batches": ent["batches"] or None,
             "launches": dict(ent["kinds"]) if ent["kinds"] else None,
             "compile_ms": round(ent["compile_ms"], 3)
-            if ent["compile_ms"] else None}
+            if ent["compile_ms"] else None,
+            "flops": round(ent.get("flops") or 0.0, 1) or None,
+            "bytes": round(by, 1) or None,
+            # achieved device bandwidth: captured bytes over INCLUSIVE
+            # wall-ms (an understatement for parents that time their
+            # children — still the roofline-facing number per leaf/stage)
+            "gbps": round(by / (ms / 1000.0) / 1e9, 3)
+            if by and ms > 0 else None}
 
 
 def finalize_plan_metrics(rec: dict | None) -> None:
@@ -307,7 +324,9 @@ def export_op_records_partial(rec: dict | None) -> dict:
                 "batches": ent["batches"], "ms": round(ent["ms"], 3),
                 "calls": ent["calls"], "kinds": dict(ent["kinds"]),
                 "launch_total": ent["launch_total"],
-                "compile_ms": round(ent["compile_ms"], 3)}
+                "compile_ms": round(ent["compile_ms"], 3),
+                "flops": round(ent.get("flops", 0.0), 1),
+                "bytes": round(ent.get("bytes", 0.0), 1)}
     return out
 
 
@@ -330,6 +349,8 @@ def merge_op_records(dst: dict, shipped: dict) -> None:
             ent["calls"] += src.get("calls", 0)
             ent["launch_total"] += src.get("launch_total", 0)
             ent["compile_ms"] += src.get("compile_ms", 0.0)
+            ent["flops"] += src.get("flops", 0.0)
+            ent["bytes"] += src.get("bytes", 0.0)
             for kind, n in (src.get("kinds") or {}).items():
                 ent["kinds"][kind] = ent["kinds"].get(kind, 0) + n
 
@@ -376,6 +397,11 @@ class AnalyzedReport:
     findings: list = field(default_factory=list)    # {severity, kind?, msg}
     counter_deltas: dict = field(default_factory=dict)
     wall_ms: float = 0.0
+    # HBM accounting: predicted per-stage peaks (plan_lint memory model)
+    # reconciled against the device ledger's measured watermarks
+    # (obs/resources.py) — {"predicted_peak", "measured_peak",
+    # "per_stage": [...], "remote": {executor: peak}, "peak_gbps"}
+    memory: dict = field(default_factory=dict)
 
     @property
     def drift_kinds(self) -> list[str]:
@@ -394,7 +420,8 @@ class AnalyzedReport:
                 "prediction_exact": self.prediction_exact,
                 "findings": list(self.findings),
                 "counter_deltas": dict(self.counter_deltas),
-                "wall_ms": round(self.wall_ms, 3)}
+                "wall_ms": round(self.wall_ms, 3),
+                "memory": dict(self.memory)}
 
     def render(self) -> str:
         out = ["== EXPLAIN ANALYZE (measured steady-state run, "
@@ -406,12 +433,26 @@ class AnalyzedReport:
                 str(rows) if nd.get("rows_exact", True) else f">={rows}")
             kinds = nd.get("launches") or {}
             ks = ",".join(f"{k}:{v}" for k, v in sorted(kinds.items()))
+            peak_gbps = self.memory.get("peak_gbps")
+            gbps = nd.get("gbps")
+            gbps_s = ""
+            if gbps is not None:
+                gbps_s = f", {gbps:g} GB/s"
+                if peak_gbps:
+                    gbps_s += f" ({100.0 * gbps / peak_gbps:.0f}% of peak)"
             line = (f"{pad}{nd['detail']}  "
                     f"[rows={rows_s}"
                     + (f", {nd['ms']:.2f} ms" if nd["ms"] is not None else "")
                     + (f", batches={nd['batches']}" if nd.get("batches")
                        else "")
                     + (f", launches={{{ks}}}" if ks else "")
+                    + (f", flops={nd['flops']:g}" if nd.get("flops")
+                       else "")
+                    + (f", bytes={_fmt_bytes(nd['bytes'])}"
+                       if nd.get("bytes") else "")
+                    + gbps_s
+                    + (f", hbm_peak={_fmt_bytes(nd['hbm_peak'])}"
+                       if nd.get("hbm_peak") else "")
                     + (f", compile={nd['compile_ms']:.1f} ms"
                        if nd.get("compile_ms") else "")
                     + "]")
@@ -430,6 +471,29 @@ class AnalyzedReport:
         out.append(f"  {'total':<18} predicted="
                    f"{sum(self.predicted.values()):<5} measured="
                    f"{sum(self.measured.values()):<5}")
+        mem = self.memory
+        if mem:
+            pred = mem.get("predicted_peak")
+            meas = mem.get("measured_peak")
+            out.append("-- memory (HBM, per-stage peaks) --")
+            out.append(
+                "  query peak: predicted~"
+                + (_fmt_bytes(pred) if pred is not None else "?")
+                + "  measured watermark="
+                + (_fmt_bytes(meas) if meas is not None else "?")
+                + ("" if not mem.get("remote") else
+                   "  workers={"
+                   + ", ".join(f"{e}:{_fmt_bytes(v.get('peak', 0))}"
+                               for e, v in sorted(mem["remote"].items()))
+                   + "}"))
+            for st in mem.get("per_stage", ()):
+                tag = st["op"] if st.get("instances", 1) == 1 \
+                    else f"{st['op']} ×{st['instances']}"
+                out.append(f"  {tag:<22} predicted~"
+                           f"{_fmt_bytes(st['predicted'])}"
+                           + (f"  measured peak="
+                              f"{_fmt_bytes(st['measured'])}"
+                              if st.get("measured") is not None else ""))
         if self.findings:
             out.append("-- findings --")
             for f in self.findings:
@@ -439,13 +503,90 @@ class AnalyzedReport:
         return "\n".join(out)
 
 
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit, div in (("GiB", 1 << 30), ("MiB", 1 << 20), ("KiB", 1 << 10)):
+        if n >= div:
+            return f"{n / div:.1f}{unit}"
+    return f"{n:.0f}B"
+
+
+def _memory_section(physical, prediction, resources: dict | None,
+                    peak_gbps: float | None, nodes: list,
+                    findings: list) -> dict:
+    """Reconcile the analyzer's per-stage predicted HBM against the
+    device ledger's measured watermarks: annotate nodes with their
+    operator's measured peak, build the report's memory dict, and raise
+    drift findings when a measured watermark exceeds the model (the
+    model is an upper bound on engine-held tiles — overshooting it means
+    the model and the execution layer diverged)."""
+    mem: dict = {}
+    pred_stages = [s for s in getattr(prediction, "stages", ())
+                   if s.get("hbm_bytes") is not None]
+    measured_ops = (resources or {}).get("ops") or {}
+    if pred_stages:
+        # the ledger buckets by creator-operator CLASS, so a measured
+        # watermark covers every instance of that class in the query —
+        # compare it against the class-summed prediction, not a single
+        # instance's (two ComputeExec stages ≠ each one doubling the
+        # model)
+        by_cls: dict = {}
+        per_stage = []
+        for s in pred_stages:
+            ent = by_cls.get(s["op"])
+            if ent is None:
+                ent = by_cls[s["op"]] = {
+                    "op": s["op"], "detail": s["detail"][:80],
+                    "predicted": 0, "instances": 0}
+                per_stage.append(ent)
+            ent["predicted"] += s["hbm_bytes"]
+            ent["instances"] += 1
+        for ent in per_stage:
+            ent["measured"] = measured_ops.get(ent["op"], {}).get("peak")
+        mem["per_stage"] = per_stage
+        mem["predicted_peak"] = getattr(prediction, "predicted_peak_hbm",
+                                        None)
+    if resources is not None:
+        mem["measured_peak"] = resources.get("peak")
+        if resources.get("remote"):
+            mem["remote"] = resources["remote"]
+    if peak_gbps:
+        mem["peak_gbps"] = peak_gbps
+    # per-node annotation: the creator-op's measured HBM watermark
+    by_name: dict[str, int] = {}
+    for nd in nodes:
+        op = nd["op"]
+        m = measured_ops.get(op)
+        if m is not None and op not in by_name:
+            by_name[op] = 1
+            nd["hbm_peak"] = m.get("peak")
+    pred = mem.get("predicted_peak")
+    meas = mem.get("measured_peak")
+    if pred and meas is not None and meas > pred:
+        exact = getattr(prediction, "memory_exact", False)
+        findings.append({
+            "severity": "warning" if exact else "info",
+            "kind": "hbm-drift",
+            "msg": f"measured HBM watermark {_fmt_bytes(meas)} exceeds "
+                   f"the memory model's predicted peak {_fmt_bytes(pred)}"
+                   + ("" if exact else
+                      " (model approximate: "
+                      + "; ".join(getattr(prediction, "memory_notes",
+                                          [])[:2]) + ")")})
+    return mem
+
+
 def build_analyzed_report(physical, plan_metrics: dict | None,
                           prediction, measured: dict,
                           counter_deltas: dict,
-                          wall_ms: float) -> AnalyzedReport:
+                          wall_ms: float,
+                          resources: dict | None = None,
+                          peak_gbps: float | None = None) -> AnalyzedReport:
     """Assemble the EXPLAIN ANALYZE report from the executed plan's
-    per-operator records, the measured per-kind launch deltas, and the
-    static analyzer's AnalysisReport."""
+    per-operator records, the measured per-kind launch deltas, the
+    static analyzer's AnalysisReport, and the device ledger's HBM
+    accounting for the measured query (`resources` — obs/resources.py
+    query_record)."""
     rec = plan_metrics or {}
     finalize_plan_metrics(rec)
     nodes = []
@@ -500,9 +641,11 @@ def build_analyzed_report(physical, plan_metrics: dict | None,
                    f"{'y' if stage_retries == 1 else 'ies'} during the "
                    "measured run (lineage re-execution inflates measured "
                    "launches)"})
+    memory = _memory_section(physical, prediction, resources, peak_gbps,
+                             nodes, findings)
     return AnalyzedReport(nodes=nodes, predicted=predicted,
                           measured=dict(measured),
                           prediction_exact=prediction.exact,
                           findings=findings,
                           counter_deltas=dict(counter_deltas),
-                          wall_ms=wall_ms)
+                          wall_ms=wall_ms, memory=memory)

@@ -73,6 +73,51 @@ def canonical_key(e: Expression, id_to_pos: dict[int, int]) -> tuple:
 # Kernel cache
 # ---------------------------------------------------------------------------
 
+def _tree_nbytes(x, depth: int = 0) -> int:
+    """Sum .nbytes over array leaves of a (nested) argument structure —
+    shape/dtype metadata only, never touches device data."""
+    if depth > 4:
+        return 0
+    nb = getattr(x, "nbytes", None)
+    if nb is not None and not isinstance(x, (bytes, str)):
+        return int(nb)
+    if isinstance(x, (list, tuple)):
+        return sum(_tree_nbytes(i, depth + 1) for i in x)
+    if isinstance(x, dict):
+        return sum(_tree_nbytes(v, depth + 1) for v in x.values())
+    return 0
+
+
+def _capture_kernel_cost(f, args, kwargs) -> dict | None:
+    """Per-launch cost of one compiled kernel, captured once at first
+    invocation: XLA's HLO cost analysis via the LOWERING (tracing only —
+    no second backend compile; jax.stages.Lowered.cost_analysis) with a
+    metadata fallback (argument bytes) when lowering is unavailable.
+    Gated by spark.tpu.metrics.kernelCost."""
+    from ..obs.resources import kernel_cost_enabled
+
+    if not kernel_cost_enabled():
+        return None
+    cost = {"flops": 0.0, "bytes": float(_tree_nbytes(args)
+                                         + _tree_nbytes(kwargs)),
+            "source": "metadata"}
+    lower = getattr(f, "lower", None)
+    if lower is not None:
+        try:
+            ca = lower(*args, **kwargs).cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            flops = float(ca.get("flops", 0.0) or 0.0)
+            ba = float(ca.get("bytes accessed", 0.0) or 0.0)
+            if ba > 0.0:
+                cost = {"flops": flops, "bytes": ba, "source": "xla"}
+            elif flops > 0.0:
+                cost["flops"] = flops
+        except Exception:
+            pass  # cost capture must never fail a dispatch
+    return cost
+
+
 class KernelCache:
     """Process-global LRU of jitted kernels.
 
@@ -83,7 +128,14 @@ class KernelCache:
     instantiation count). `launches_by_kind` buckets by the cache key's
     leading tag ("pipeline", "fused_agg", "gagg", ...). `compile_ms`
     accumulates builder time plus each kernel's first invocation (XLA
-    compiles lazily on first call)."""
+    compiles lazily on first call).
+
+    Resource accounting (obs/resources.py): the first invocation also
+    captures the kernel's per-launch cost (XLA cost_analysis flops /
+    bytes accessed via the lowering), after which every launch adds it
+    to the process counters (`flops_total`, `bytes_total`), the per-kind
+    cost table (`cost_by_kind`), and the executing operator's record —
+    launch attribution multiplied out to FLOPs and bytes."""
 
     def __init__(self, max_size: int = 1024):
         self._cache: "collections.OrderedDict[tuple, Any]" = collections.OrderedDict()
@@ -93,6 +145,11 @@ class KernelCache:
         self.launches = 0
         self.compile_ms = 0.0
         self.launches_by_kind: "collections.Counter" = collections.Counter()
+        self.flops_total = 0.0      # cumulative captured flops dispatched
+        self.bytes_total = 0.0      # cumulative captured bytes accessed
+        # kind -> {"flops","bytes","kernels","launches"} aggregate of the
+        # captured per-launch costs (the resource gate's cost table)
+        self.cost_by_kind: dict = {}
         # scheduler stages run in threads; OrderedDict mutation is not
         # thread-safe (builder() itself runs unlocked — duplicate builds of
         # the same key are benign, a torn dict is not)
@@ -102,7 +159,7 @@ class KernelCache:
         if not callable(f):
             return f
         kind = key[0] if isinstance(key, tuple) and key else "?"
-        state = {"first": True}
+        state = {"first": True, "cost": None, "capturing": False}
 
         def launch(*args, **kwargs):
             with self._lock:
@@ -110,9 +167,45 @@ class KernelCache:
                 self.launches_by_kind[kind] += 1
                 first = state["first"]
                 state["first"] = False
+                # one capturer at a time; retried while unset (a capture
+                # under kernelCost=off yields None, so flipping it on
+                # later still costs this kernel), concurrent launches
+                # during the capture window just skip cost accounting
+                cost = state["cost"]
+                capture = cost is None and not state["capturing"]
+                if capture:
+                    state["capturing"] = True
+                elif cost is not None:
+                    # steady state: cost accounting rides the same
+                    # critical section as the launch counters
+                    self.flops_total += cost["flops"]
+                    self.bytes_total += cost["bytes"]
+                    ent = self.cost_by_kind.get(kind)
+                    if ent is not None:
+                        ent["flops"] += cost["flops"]
+                        ent["bytes"] += cost["bytes"]
+                        ent["launches"] += 1
+            if capture:
+                # BEFORE the dispatch so even the first launch
+                # attributes cost (host-side trace/lower only — no
+                # kernel launch, no device sync)
+                cost = _capture_kernel_cost(f, args, kwargs)
+                with self._lock:
+                    state["cost"] = cost
+                    state["capturing"] = False
+                    if cost is not None:
+                        ent = self.cost_by_kind.setdefault(
+                            kind, {"flops": 0.0, "bytes": 0.0,
+                                   "kernels": 0, "launches": 0})
+                        ent["kernels"] += 1
+                        ent["flops"] += cost["flops"]
+                        ent["bytes"] += cost["bytes"]
+                        ent["launches"] += 1
+                        self.flops_total += cost["flops"]
+                        self.bytes_total += cost["bytes"]
             # per-operator attribution (obs/metrics contextvar scope):
             # host bookkeeping only — no dispatch, no sync
-            _obs_launch(kind)
+            _obs_launch(kind, cost)
             if first:
                 import time as _time
 
@@ -157,6 +250,8 @@ class KernelCache:
                 "kernel_cache.misses": self.misses,
                 "kernel_cache.launches": self.launches,
                 "kernel_cache.compile_ms": round(self.compile_ms, 3),
+                "kernel_cache.flops": round(self.flops_total, 1),
+                "kernel_cache.bytes_accessed": round(self.bytes_total, 1),
             }
 
 

@@ -1,0 +1,435 @@
+"""Resource observability (spark_tpu/obs/resources.py): HBM ledger,
+kernel cost capture, memory budgets, and plan_lint's memory model.
+
+Hard constraints under test: the ledger and cost capture add ZERO kernel
+launches (same guard as the rest of obs/), watermarks reconcile with
+batch shape/dtype metadata exactly, the memory budget pre-flights BEFORE
+any dispatch, and the analyzer's predicted peak HBM bounds the measured
+watermark on a real multi-operator plan."""
+
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_tpu.obs.resources import (GLOBAL_LEDGER, DeviceLedger,
+                                     MemoryBudgetExceeded)
+from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+
+@pytest.fixture()
+def data(spark):
+    rng = np.random.default_rng(31)
+    n = 5000
+    spark.createDataFrame(pa.table({
+        "k": rng.integers(0, 11, n),
+        "v": rng.integers(-40, 90, n),
+    })).createOrReplaceTempView("res_t")
+    return spark
+
+
+Q_AGG = "select k, sum(v) sv, count(*) c from res_t where v > 0 group by k"
+
+
+def _launch_delta(spark, sql):
+    spark.sql(sql).toArrow()  # warm: compiles + caches + memos
+    before = dict(KC.launches_by_kind)
+    spark.sql(sql).toArrow()
+    after = dict(KC.launches_by_kind)
+    return {k: v - before.get(k, 0) for k, v in after.items()
+            if v != before.get(k, 0)}
+
+
+# ---------------------------------------------------------------------------
+# overhead guard: the ledger adds ZERO kernel launches, fusion on and off
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fusion", ["true", "false"])
+def test_ledger_zero_launch_overhead(data, fusion):
+    from spark_tpu.obs import resources
+
+    spark = data
+    spark.conf.set("spark.tpu.fusion.enabled", fusion)
+    spark.conf.set("spark.tpu.fusion.minRows", "0")
+    try:
+        spark.conf.set("spark.tpu.memory.ledger", "true")
+        spark.conf.set("spark.tpu.metrics.kernelCost", "true")
+        resources.configure(spark.conf)
+        with_ledger = _launch_delta(spark, Q_AGG)
+        spark.conf.set("spark.tpu.memory.ledger", "false")
+        spark.conf.set("spark.tpu.metrics.kernelCost", "false")
+        resources.configure(spark.conf)
+        without = _launch_delta(spark, Q_AGG)
+        assert with_ledger == without, (
+            f"resource ledger changed kernel dispatches: {with_ledger} "
+            f"vs {without}")
+    finally:
+        for k in ("spark.tpu.fusion.enabled", "spark.tpu.fusion.minRows",
+                  "spark.tpu.memory.ledger", "spark.tpu.metrics.kernelCost"):
+            spark.conf.unset(k)
+        resources.configure(spark.conf)
+
+
+# ---------------------------------------------------------------------------
+# ledger unit semantics: exact bytes, identity refcount, release on GC
+# ---------------------------------------------------------------------------
+
+class _Col:
+    def __init__(self, data, validity=None):
+        self.data = data
+        self.validity = validity
+
+
+class _Batch:
+    def __init__(self, columns, row_mask):
+        self.columns = columns
+        self.row_mask = row_mask
+
+
+def test_ledger_watermark_exact_vs_known_nbytes():
+    """Charge = column data nbytes + 1 B/row validity planes + 1 B/row
+    row mask, attributed to the active query/operator scope; shared
+    arrays charge once; the charge releases when the LAST owner dies."""
+    from spark_tpu.obs.metrics import pop_op, push_op
+    from spark_tpu.obs.tracing import pop_query, push_query
+
+    led = DeviceLedger()
+    n = 1024
+    dat = np.zeros(n, dtype=np.int64)          # 8192 B
+    val = np.ones(n, dtype=bool)               # 1024 B
+    mask = np.ones(n, dtype=bool)              # 1024 B
+    expected = dat.nbytes + val.nbytes + mask.nbytes
+
+    qtok = push_query("resq-unit")
+    otok = push_op({}, "UnitExec")
+    try:
+        b1 = _Batch([_Col(dat, val)], mask)
+        led.register_batch(b1)
+    finally:
+        pop_op(otok)
+        pop_query(qtok)
+    assert led.bytes == expected
+    assert led.peak == expected
+    rec = led.query_record("resq-unit")
+    assert rec["bytes"] == rec["peak"] == expected
+    assert rec["ops"]["UnitExec"]["peak"] == expected
+
+    # a second wrapper over the SAME planes must not double-charge
+    b2 = _Batch([_Col(dat, val)], mask)
+    led.register_batch(b2)
+    assert led.bytes == expected
+    assert led.verify() == []
+
+    # first owner dies: refcounts hold the charge for the survivor
+    del b1
+    assert led.bytes == expected
+    del b2
+    assert led.bytes == 0
+    assert led.peak == expected               # watermark survives release
+    rec = led.query_record("resq-unit")
+    assert rec["bytes"] == 0 and rec["peak"] == expected
+    assert rec["registered"] == rec["released"] == expected
+    assert led.verify() == []
+
+
+def test_query_watermark_covers_executed_batches(data):
+    """Integration: executing under a query scope charges at least the
+    surviving output tiles' metadata bytes to that query, and the global
+    ledger stays internally consistent."""
+    from spark_tpu.obs.tracing import pop_query, push_query
+
+    spark = data
+    df = spark.sql(Q_AGG)
+    qid = "resq-exec-watermark"
+    tok = push_query(qid)
+    try:
+        parts = df.query_execution.execute()
+    finally:
+        pop_query(tok)
+    seen, live_bytes = set(), 0
+    for batch in [b for p in parts for b in (p if isinstance(p, list)
+                                             else [p])]:
+        planes = [batch.row_mask] + [c.data for c in batch.columns] \
+            + [c.validity for c in batch.columns]
+        for a in planes:
+            if a is None or not hasattr(a, "dtype") or id(a) in seen:
+                continue
+            seen.add(id(a))
+            live_bytes += int(a.size) * a.dtype.itemsize
+    rec = GLOBAL_LEDGER.query_record(qid)
+    assert rec is not None
+    assert rec["peak"] >= rec["bytes"] > 0
+    # first execution of a fresh view: every surviving output plane was
+    # created (and charged) under this query's scope, so the still-held
+    # balance must cover the parts' metadata bytes
+    assert rec["bytes"] >= live_bytes > 0
+    assert GLOBAL_LEDGER.verify() == []
+
+
+# ---------------------------------------------------------------------------
+# kernel cost capture
+# ---------------------------------------------------------------------------
+
+def test_kernel_cost_table_and_operator_attribution(data):
+    """Every launch multiplies its captured per-launch cost onto the
+    process counters, the per-kind cost table, and the executing
+    operator's record (flops/bytes/gbps in EXPLAIN ANALYZE nodes)."""
+    spark = data
+    spark.sql(Q_AGG).toArrow()  # ensure at least one costed kernel ran
+    assert KC.cost_by_kind, "cost table empty after a real query"
+    assert KC.bytes_total > 0
+    counters = KC.counters()
+    assert counters["kernel_cache.bytes_accessed"] > 0
+    for kind, ent in KC.cost_by_kind.items():
+        assert ent["kernels"] >= 1 and ent["launches"] >= 1, kind
+        assert ent["bytes"] >= 0.0 and ent["flops"] >= 0.0
+
+    report = spark.sql(Q_AGG).query_execution.analyzed_report()
+    costed = [nd for nd in report.nodes if nd.get("bytes")]
+    assert costed, "no operator carries captured bytes accessed"
+    assert any(nd.get("gbps") for nd in costed), \
+        "bytes present but achieved-GB/s never derived"
+    text = report.render()
+    assert "bytes=" in text
+
+
+# ---------------------------------------------------------------------------
+# memory budget pre-flight (admission control)
+# ---------------------------------------------------------------------------
+
+def test_budget_preflight_raises_before_dispatch(data):
+    spark = data
+    spark.conf.set("spark.tpu.memory.budget", "1024")
+    try:
+        before = KC.launches
+        with pytest.raises(MemoryBudgetExceeded) as ei:
+            spark.sql(Q_AGG).toArrow()
+        msg = str(ei.value)
+        assert "largest stage" in msg and "Exec" in msg, msg
+        assert "spark.tpu.memory.budget" in msg
+        assert KC.launches == before, \
+            "an over-budget query dispatched kernels before failing"
+    finally:
+        spark.conf.unset("spark.tpu.memory.budget")
+    # same query admits fine once the budget is lifted
+    assert spark.sql(Q_AGG).toArrow().num_rows > 0
+
+
+def test_budget_admits_within_budget_plan(data):
+    spark = data
+    spark.conf.set("spark.tpu.memory.budget", str(1 << 34))
+    try:
+        assert spark.sql(Q_AGG).toArrow().num_rows > 0
+    finally:
+        spark.conf.unset("spark.tpu.memory.budget")
+
+
+# ---------------------------------------------------------------------------
+# plan_lint memory model vs measured watermark (TPC-DS mini q3)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("fusion", ["true", "false"])
+def test_predicted_peak_bounds_measured_watermark_q3(spark, fusion):
+    """EXPLAIN ANALYZE on TPC-DS mini q3: a per-stage predicted peak-HBM
+    line reconciled against the ledger's measured watermark — the model
+    is an upper bound on engine-held tiles, so measured must stay within
+    it (plus slack for rounding), with zero unexplained drift."""
+    from tests.test_plan_analysis import Q3
+    from tpcds_mini import register_tpcds
+
+    register_tpcds(spark)
+    spark.conf.set("spark.tpu.fusion.enabled", fusion)
+    spark.conf.set("spark.tpu.fusion.minRows", "0")
+    try:
+        report = spark.sql(Q3).query_execution.analyzed_report()
+        assert not report.has_unexplained_drift, report.render()
+        mem = report.memory
+        assert mem.get("predicted_peak"), "memory model produced no peak"
+        assert mem.get("measured_peak") is not None
+        assert mem["measured_peak"] > 0
+        assert mem["measured_peak"] <= mem["predicted_peak"] * 1.25, (
+            f"measured watermark {mem['measured_peak']} blew through the "
+            f"model's predicted peak {mem['predicted_peak']}")
+        assert mem.get("per_stage"), "no per-stage predicted-HBM rows"
+        assert any(st.get("measured") for st in mem["per_stage"]), \
+            "no stage carries a measured per-operator watermark"
+        text = report.render()
+        assert "memory (HBM" in text and "query peak" in text
+    finally:
+        spark.conf.unset("spark.tpu.fusion.enabled")
+        spark.conf.unset("spark.tpu.fusion.minRows")
+
+
+def test_analysis_report_renders_predicted_hbm(data):
+    rep = data.sql(Q_AGG).query_execution.analysis_report()
+    assert rep.predicted_peak_hbm and rep.predicted_peak_hbm > 0
+    assert any(s.get("hbm_bytes") for s in rep.stages)
+    assert "predicted peak HBM" in rep.render()
+    assert rep.to_dict()["predicted_peak_hbm"] == rep.predicted_peak_hbm
+
+
+# ---------------------------------------------------------------------------
+# heartbeat flush budget (satellite: wide-executor payload cap)
+# ---------------------------------------------------------------------------
+
+def test_flush_budget_trims_carries_and_counts_overflow():
+    """With the per-beat byte budget exhausted, later tasks ship
+    counter-only deltas: no op-record breakdown, no spans — but their
+    closed spans stay in the carry buffer (never dropped) and ship once
+    the budget allows; every trim increments the overflow counter."""
+    from spark_tpu.config import SQLConf
+    from spark_tpu.exec import worker_main as wm
+    from spark_tpu.obs.metrics import get_or_create_op_record
+
+    conf = SQLConf()
+    conf.set("spark.tpu.heartbeat.flushBudget", "1")   # starve every beat
+    states = [wm.begin_stage_obs(conf, query_id="fbq", stage_id=f"s{i}",
+                                 task_id=i) for i in range(2)]
+    try:
+        assert all(s is not None for s in states)
+        for s in states:
+            ent = get_or_create_op_record(s["rec"], f"op{s['task_id']}")
+            ent["rows"] += 100
+            ent["batches"] += 1
+            with s["tracer"].span(f"work{s['task_id']}"):
+                pass
+        base = wm.FLUSH_OVERFLOWS
+        out = wm.collect_live_obs()
+        mine = [d for d in out if d["query"] == "fbq"]
+        assert len(mine) == 2
+        trimmed = [d for d in mine if d["op_records"] is None]
+        fat = [d for d in mine if d["op_records"] is not None]
+        assert trimmed and fat, "budget=1 B should trim all but the first"
+        # counter totals survive the trim
+        assert all(d["rows"] == 100 and d["batches"] == 1 for d in mine)
+        assert all(not d["spans_closed"] for d in trimmed)
+        assert wm.FLUSH_OVERFLOWS > base
+        wm.ack_live_obs()
+        # the trimmed task's spans were carried, not dropped: lift the
+        # budget and they ship on the next beat
+        for s in states:
+            s["flush_budget"] = 0
+        out2 = wm.collect_live_obs()
+        by_task = {d["task"]: d for d in out2 if d["query"] == "fbq"}
+        carried = [sp for d in by_task.values()
+                   for sp in d["spans_closed"]]
+        assert any(sp.get("name", "").startswith("work")
+                   for sp in carried), \
+            "trimmed spans never shipped after the budget was lifted"
+        wm.ack_live_obs()
+    finally:
+        for s in states:
+            wm.finish_stage_obs(s)
+
+
+def test_overflow_counter_surfaces_in_live_status():
+    from spark_tpu.obs.live import LiveObs
+
+    live = LiveObs()
+    live.on_heartbeat("w-1", [], hbm={"bytes": 4096, "peak": 8192},
+                      overflows=3)
+    live.on_heartbeat("w-2", [], hbm={"bytes": 100, "peak": 200})
+    snap = live.snapshot()
+    assert snap["flush_overflows"] == 3
+    ex = snap["executors"]
+    assert ex["w-1"]["hbm_bytes"] == 4096
+    assert ex["w-1"]["hbm_peak"] == 8192
+    assert ex["w-1"]["overflows"] == 3
+    assert ex["w-2"]["overflows"] == 0
+
+
+# ---------------------------------------------------------------------------
+# console reporter: per-executor utilization rows
+# ---------------------------------------------------------------------------
+
+def test_console_reporter_renders_executor_rows():
+    from spark_tpu.obs.live import ConsoleProgressReporter, LiveObs
+
+    live = LiveObs()
+    live.on_heartbeat("exec-9", [{
+        "query": "cq", "stage": "s0", "task": 0, "seq": 1,
+        "rows": 500, "rows_exact": True, "batches": 2, "launches": 4,
+        "compile_ms": 0.0, "kernel_kinds": {"pipeline": 4},
+        "op_records": {}, "spans_closed": [], "open_spans": [],
+    }], hbm={"bytes": 3 << 20, "peak": 4 << 20}, overflows=2)
+    rep = ConsoleProgressReporter(live, stream=None, interval=99)
+    line = rep.render_line()
+    assert "exec-9" in line
+    assert "hbm=3.0MiB" in line
+    assert "obs-trims=2" in line
+    assert "1 task" in line
+
+
+# ---------------------------------------------------------------------------
+# cluster round-trip: executor watermarks over the heartbeat path
+# ---------------------------------------------------------------------------
+
+def _cluster_table():
+    rng = np.random.default_rng(47)
+    n = 6000
+    return pa.table({"k": rng.integers(0, 7, n),
+                     "v": rng.integers(-30, 70, n)})
+
+
+@pytest.fixture(scope="module")
+def cluster_spark():
+    from spark_tpu.api.session import TpuSession
+    from spark_tpu.exec.cluster import LocalCluster
+
+    s = TpuSession("resource-cluster", {
+        "spark.sql.shuffle.partitions": "2",
+        "spark.tpu.batch.capacity": 1 << 12,
+        "spark.sql.adaptive.enabled": "false",
+        "spark.tpu.heartbeat.interval": "0.1",
+    })
+    cluster = LocalCluster(num_workers=2,
+                           heartbeat_interval=0.1)
+    s.attachSqlCluster(cluster)
+    s.createDataFrame(_cluster_table()).createOrReplaceTempView("cres_t")
+    yield s
+    s.stop()
+
+
+def _cluster_query(s):
+    import spark_tpu.api.functions as F
+
+    return (s.table("cres_t").filter(F.col("v") > 0).repartition(2)
+            .groupBy("k").agg(F.sum("v").alias("sv")))
+
+
+def test_cluster_heartbeat_ships_executor_hbm(cluster_spark):
+    """Worker processes report their device-ledger occupancy on every
+    heartbeat; the driver's LiveObs shows HBM per executor."""
+    s = cluster_spark
+    _cluster_query(s).toArrow()
+    deadline = time.time() + 5.0
+    workers = {}
+    while time.time() < deadline:
+        workers = {eid: e for eid, e in s.live_obs.executors.items()
+                   if eid != "driver" and e.get("hbm_bytes") is not None}
+        if workers:
+            break
+        time.sleep(0.1)
+    assert workers, "no worker heartbeat carried an HBM snapshot"
+    for eid, e in workers.items():
+        assert e["hbm_bytes"] >= 0
+        assert e["hbm_peak"] >= e["hbm_bytes"]
+    util = s.live_obs.executor_utilization()
+    assert any(eid in util for eid in workers)
+
+
+def test_cluster_explain_analyze_merges_remote_hbm(cluster_spark):
+    """Map tasks ship their worker-process HBM record with the task
+    result; EXPLAIN ANALYZE's memory section reports per-executor remote
+    peaks next to the driver watermark — and stays drift-free."""
+    s = cluster_spark
+    report = _cluster_query(s).query_execution.analyzed_report()
+    assert not report.has_unexplained_drift, report.render()
+    mem = report.memory
+    assert mem.get("remote"), \
+        "no worker HBM record reached the memory section"
+    for eid, rec in mem["remote"].items():
+        assert rec.get("peak", 0) > 0, (eid, rec)
+    assert "workers={" in report.render()
+    assert GLOBAL_LEDGER.verify() == []
