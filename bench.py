@@ -79,6 +79,16 @@ PROGRESS = "--progress" in sys.argv
 if PROGRESS:
     sys.argv = [a for a in sys.argv if a != "--progress"]
 
+# --mesh: add the mesh SPMD shuffle-stage config (parallel/mesh_fusion):
+# a power-of-two hash repartition whose whole stage — traced pipeline,
+# partition ids, ICI all-to-all — is ONE shard_map dispatch per step.
+# Reports dispatches_per_stage (mesh_stage launches per warm run) and the
+# donated vs undonated send-buffer HBM watermark (DeviceLedger window).
+# Needs >=2 jax devices; `python bench.py mesh` also selects it directly.
+MESH = "--mesh" in sys.argv
+if MESH:
+    sys.argv = [a for a in sys.argv if a != "--mesh"]
+
 
 # per-config predicted peak HBM (plan_lint memory model) captured by
 # _maybe_analyze so the timed record can print predicted vs measured
@@ -478,6 +488,97 @@ def bench_shuffle():
 
 
 # --------------------------------------------------------------------------
+# #3c mesh SPMD shuffle stage: one sharded dispatch per stage per step
+# --------------------------------------------------------------------------
+
+def bench_mesh():
+    """Filter→project→hash-repartition over the device mesh: the whole
+    map stage (traced pipeline + partition ids + all-to-all) is ONE
+    shard_map dispatch per step with donated send buffers. vs_baseline is
+    the speedup over our own legacy composition (spark.tpu.fusion.mesh=
+    false: per-batch pipeline materialization before the collective);
+    the record also carries dispatches_per_stage measured from the
+    KernelCache and the donated vs undonated staged-buffer HBM peaks
+    from the DeviceLedger window watermark."""
+    import gc
+
+    import jax
+    import pyarrow as pa
+
+    import spark_tpu.api.functions as F
+    from spark_tpu.obs.resources import GLOBAL_LEDGER
+    from spark_tpu.parallel import mesh_fusion as MF
+    from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        return {"metric": "mesh shuffle stage SKIPPED (needs >=2 devices)",
+                "value": 0, "unit": "status", "vs_baseline": 1.0}
+    num_out = 8 if ndev >= 8 else (4 if ndev >= 4 else 2)
+    n_rows = int(20_000_000 * SCALE)
+    session = _session({"spark.tpu.batch.capacity": 1 << 22,
+                        "spark.tpu.fusion.minRows": "0"})
+    rng = np.random.default_rng(29)
+    table = pa.table({
+        "k": rng.integers(0, 1 << 16, n_rows).astype(np.int64),
+        "v": rng.integers(0, 1000, n_rows).astype(np.int64),
+    })
+    df = _df_from_table(session, table, "mesh_bench")
+
+    def q():
+        return (df.filter(F.col("v") > 25)
+                .withColumn("v2", F.col("v") * 3)
+                .repartition(num_out, "k"))
+
+    _maybe_analyze(q, "mesh")
+    results = {}
+    for mode, flag in (("fused", "true"), ("legacy", "false")):
+        session.conf.set("spark.tpu.fusion.mesh", flag)
+        best = _best_of(lambda: _run_blocked(q()))
+        before = dict(GLOBAL_KERNEL_CACHE.launches_by_kind)
+        _run_blocked(q())
+        after = GLOBAL_KERNEL_CACHE.launches_by_kind
+        dispatches = after.get("mesh_stage", 0) - before.get("mesh_stage", 0)
+        results[mode] = (best, dispatches)
+    session.conf.unset("spark.tpu.fusion.mesh")
+
+    def hbm_window():
+        gc.collect()
+        GLOBAL_LEDGER.begin_window()
+        _run_blocked(q())
+        return GLOBAL_LEDGER.window_peak()
+
+    donate_was = MF.DONATE_DEFAULT
+    try:
+        MF.DONATE_DEFAULT = False
+        _run_blocked(q())  # compile the undonated oracle program
+        peak_undonated = hbm_window()
+        MF.DONATE_DEFAULT = True
+        peak_donated = hbm_window()
+    finally:
+        MF.DONATE_DEFAULT = donate_was
+
+    best_fused, disp_fused = results["fused"]
+    best_legacy, _disp_legacy = results["legacy"]
+    rate = n_rows / best_fused
+    return {
+        "metric": f"mesh SPMD shuffle stage filter+project+repartition"
+                  f"({num_out},k) {n_rows:.0e} rows over {num_out} devices "
+                  "(one sharded dispatch per stage per step; vs_baseline "
+                  "= speedup over the materialize-then-collective legacy "
+                  "path)",
+        "value": round(rate / 1e6, 2),
+        "unit": "M rows/s",
+        "vs_baseline": round(best_legacy / best_fused, 3),
+        **_hbm_fields("mesh", best_fused, n_rows * 16),
+        "dispatches_per_stage": disp_fused,
+        "hbm_peak_donated": peak_donated,
+        "hbm_peak_undonated": peak_undonated,
+        "donated_hbm_saving": peak_undonated - peak_donated,
+    }
+
+
+# --------------------------------------------------------------------------
 # #4/#5 TPC-DS q3 / q7 / q19 wall-clock at SF1-equivalent volume
 # --------------------------------------------------------------------------
 
@@ -579,6 +680,7 @@ CONFIGS = {
     "sort": bench_sort,
     "join": bench_join,
     "shuffle": bench_shuffle,
+    "mesh": bench_mesh,
     "tpcds": bench_tpcds,
 }
 
@@ -611,7 +713,8 @@ def _fallback_to_cpu_child() -> int:
     # so the child keeps the requested trace/analyze/cluster behavior
     flags = [f for f, on in (("--analyze", ANALYZE), ("--trace", TRACE),
                              ("--cluster", CLUSTER),
-                             ("--progress", PROGRESS)) if on]
+                             ("--progress", PROGRESS),
+                             ("--mesh", MESH)) if on]
     try:  # stdout inherited: child lines flush straight to the driver
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__)]
@@ -638,7 +741,9 @@ def main() -> int:
         jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
 
-    default = [c for c in CONFIGS if not (SMOKE and c == "tpcds")]
+    default = [c for c in CONFIGS
+               if not (SMOKE and c == "tpcds")
+               and (MESH or c != "mesh")]  # mesh config is opt-in
     only = sys.argv[1:] or default
     records, failed = [], []
     for name in only:

@@ -30,6 +30,10 @@ SPARK_TPU_TRACE_PATH=/tmp/sparktpu_cluster_trace.json \
 JAX_PLATFORMS=cpu python dev/validate_trace.py --cluster --live \
     /tmp/sparktpu_cluster_trace.json
 
+echo "== mesh gate (SPMD stage fusion on the 8-device virtual mesh) =="
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python dev/validate_trace.py --mesh
+
 echo "== micro-benchmarks =="
 python benchmarks/run_benchmarks.py --rows "${BENCH_ROWS:-2000000}"
 

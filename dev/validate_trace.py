@@ -43,7 +43,16 @@ Four checks, all hard failures:
    counters superseded exactly, zero straggler findings on the healthy
    run).
 
-Usage: python dev/validate_trace.py [--cluster] [--live] <trace.json>
+5. Mesh gate (--mesh) — on a virtual 8-device CPU mesh, a fused
+   power-of-two repartition+agg must run its shuffle stage as
+   mesh_stage dispatches that plan_lint predicts EXACTLY, with zero
+   unexplained drift, attribution totals matching the measured
+   launches under shard_map, span nesting holding on the exported
+   trace, a donated (donate_argnums) stage program in the kernel
+   cache, and a balanced device ledger afterwards. Self-contained:
+   `validate_trace.py --mesh` with no trace path runs only this gate.
+
+Usage: python dev/validate_trace.py [--cluster] [--live] [--mesh] [<trace.json>]
 """
 
 import json
@@ -368,11 +377,113 @@ def live_gate() -> None:
         session.stop()
 
 
+def mesh_gate() -> None:
+    """Mesh SPMD stage gate (--mesh, virtual 8-device CPU mesh): a
+    power-of-two fused repartition+agg must execute its shuffle stage as
+    mesh_stage dispatches predicted EXACTLY by plan_lint (one per step
+    plus quota retries), EXPLAIN ANALYZE must show zero unexplained
+    drift, per-operator attribution must equal the measured total (no
+    dispatch escapes the operator scope under shard_map), span nesting
+    must hold on the exported trace, and the device ledger must stay
+    balanced after the donated send buffers release."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        fail("--mesh: needs 8 virtual devices (run with JAX_PLATFORMS="
+             "cpu XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    import json as _json
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+
+    import spark_tpu.api.functions as F
+    from spark_tpu import TpuSession
+    from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+    session = TpuSession("mesh-gate", {
+        "spark.tpu.batch.capacity": 1 << 12,
+        "spark.sql.shuffle.partitions": 8,
+        "spark.tpu.fusion.minRows": "0",
+        "spark.tpu.trace.enabled": "true",
+        "spark.tpu.ui.operatorMetrics": "true",
+    })
+    try:
+        rng = np.random.default_rng(29)
+        n = 6000
+        session.createDataFrame(pa.table({
+            "k": rng.integers(0, 11, n),
+            "v": rng.integers(-20, 80, n),
+        })).createOrReplaceTempView("mesh_t")
+
+        def q():
+            return (session.sql("select k, v * 2 as v2 from mesh_t "
+                                "where v > 0")
+                    .repartition(8, "k").groupBy("k")
+                    .agg(F.sum("v2").alias("s")))
+
+        report = q().query_execution.analyzed_report()
+        errors = [f for f in report.findings if f["severity"] == "error"]
+        if errors:
+            print(report.render())
+            fail("--mesh: EXPLAIN ANALYZE reported unexplained drift "
+                 "under shard_map: " + "; ".join(f["msg"] for f in errors))
+        if report.measured.get("mesh_stage", 0) < 1:
+            fail("--mesh: gate query never dispatched a mesh stage "
+                 f"program (measured {dict(report.measured)})")
+        if report.predicted.get("mesh_stage") != \
+                report.measured.get("mesh_stage"):
+            fail("--mesh: plan_lint mesh_stage prediction "
+                 f"{report.predicted.get('mesh_stage')} != measured "
+                 f"{report.measured.get('mesh_stage')}")
+        attributed = sum(v for nd in report.nodes
+                         for v in (nd.get("launches") or {}).values())
+        measured = sum(report.measured.values())
+        if attributed != measured:
+            fail(f"--mesh: attributed launches ({attributed}) != "
+                 f"measured total ({measured}) — a shard_map dispatch "
+                 "escaped operator attribution")
+        # span nesting + attribution args hold on the exported trace
+        from spark_tpu.obs.tracing import to_chrome_trace
+
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            _json.dump(to_chrome_trace(session.tracer.spans(),
+                                       process_name="mesh-gate"), f)
+            path = f.name
+        validate_trace(path)
+        os.unlink(path)
+        donated = [k for k in KC._cache
+                   if k and k[0] == "mesh_stage" and k[-1] is True]
+        if not donated:
+            fail("--mesh: no mesh stage program compiled with donated "
+                 "send buffers (donate_argnums)")
+        from spark_tpu.obs.resources import GLOBAL_LEDGER
+
+        issues = GLOBAL_LEDGER.verify()
+        if issues:
+            fail("--mesh: device ledger failed verification after the "
+                 "donated stage: " + "; ".join(issues))
+        print("validate_trace: mesh gate OK — "
+              f"{report.measured.get('mesh_stage')} mesh_stage "
+              f"dispatch(es) predicted exactly, {attributed} launches "
+              "attributed, ledger balanced")
+    finally:
+        session.stop()
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     cluster = "--cluster" in argv
     live = "--live" in argv
-    argv = [a for a in argv if a not in ("--cluster", "--live")]
+    mesh = "--mesh" in argv
+    argv = [a for a in argv if a not in ("--cluster", "--live", "--mesh")]
+    if mesh and not argv:
+        # self-contained leg: the gate generates and validates its own
+        # trace (dev/run_all.sh runs it under an 8-device CPU mesh env)
+        mesh_gate()
+        print("validate_trace: PASS")
+        return 0
     if len(argv) != 1:
         print(__doc__)
         return 2
@@ -381,6 +492,8 @@ def main(argv=None) -> int:
     resource_gate()
     if live:
         live_gate()
+    if mesh:
+        mesh_gate()
     print("validate_trace: PASS")
     return 0
 

@@ -32,8 +32,9 @@ Kernel-kind legend (KernelCache key tags): pipeline, fused_agg, uagg/dagg/
 gagg, krange3 (dense-range scalar probe), fused_limit, limit, sort,
 join_build/join_probe, fused_probe, djoin_build/djoin_probe,
 fused_djoin_probe, shuffle_pids/shuffle_hash/shuffle_rr/shuffle_range,
-fused_shuffle (exchange map side fused with its pipeline), mesh_exchange,
-sample.
+fused_shuffle (exchange map side fused with its pipeline), mesh_stage
+(whole shuffle stage as ONE shard_map dispatch — pipeline + partition ids
++ ICI all-to-all; quota retries re-dispatch), sample.
 """
 
 from __future__ import annotations
@@ -48,8 +49,8 @@ from ..columnar.batch import bucket_capacity
 from ..config import (
     ADAPTIVE_ENABLED, ADVISORY_PARTITION_BYTES, AGG_BLOCK_ROWS,
     BATCH_CAPACITY, BLOOM_JOIN_FILTER, COALESCE_PARTITIONS_ENABLED,
-    FUSION_DENSE_KEYS, FUSION_ENABLED, FUSION_EXCHANGE, FUSION_MIN_ROWS,
-    MESH_ENABLED, MINMAX_JOIN_FILTER, SQLConf,
+    FUSION_DENSE_KEYS, FUSION_ENABLED, FUSION_EXCHANGE, FUSION_MESH,
+    FUSION_MIN_ROWS, MESH_ENABLED, MINMAX_JOIN_FILTER, SQLConf,
 )
 from ..expr.expressions import (
     Alias, AttributeReference, EqualTo, GreaterThan, GreaterThanOrEqual, In,
@@ -346,12 +347,14 @@ def _eval_filter(e, trace: _Trace):
 # ---------------------------------------------------------------------------
 
 class _Analyzer:
-    def __init__(self, conf: SQLConf):
+    def __init__(self, conf: SQLConf, cluster: bool = False):
         self.conf = conf
+        self._cluster = cluster
         self.report = AnalysisReport()
         self.predicted = Counter()
         self._fusion_on = bool(conf.get(FUSION_ENABLED))
         self._fusion_exchange = bool(conf.get(FUSION_EXCHANGE))
+        self._fusion_mesh = bool(conf.get(FUSION_MESH))
         self._min_rows = int(conf.get(FUSION_MIN_ROWS))
         self._dense_keys = bool(conf.get(FUSION_DENSE_KEYS))
         self._tile = int(conf.get(BATCH_CAPACITY))
@@ -1072,17 +1075,26 @@ class _Analyzer:
 
         single_int_bkey = len(node.right_keys) == 1 and isinstance(
             node.right_keys[0].dtype, (IntegralType, DateType))
-        bstats = right.trace.stats(node.right_keys[0].expr_id) \
-            if (right.trace is not None and single_int_bkey) else None
 
-        probe_trace = left.trace
+        # per-pair traces: post-exchange flows carry per-partition traces
+        # (mesh/host shuffled layouts), so the probe AND build value
+        # models hold through multi-partition joins too
+        pair_traces = [left.part_trace(i) for i in range(len(pairs))]
         if fused:
             filters, outputs = node.probe_fusion
-            probe_trace = self._project_trace(left.trace, filters, outputs)
+            pair_traces = [None if t is None
+                           else self._project_trace(t, filters, outputs)
+                           for t in pair_traces]
+        build_traces = [right.part_trace(0 if node.is_broadcast else i)
+                        for i in range(len(pairs))]
 
         out_parts = []
         out_traces = []
-        for lp, rp in pairs:
+        for pi, (lp, rp) in enumerate(pairs):
+            probe_trace = pair_traces[pi]
+            bstats = build_traces[pi].stats(node.right_keys[0].expr_id) \
+                if (build_traces[pi] is not None and single_int_bkey) \
+                else None
             bcaps = [b.cap for b in rp]
             bknown = all(c is not None for c in bcaps) and rp
             bcap = bucket_capacity(sum(bcaps)) if bknown else None
@@ -1305,9 +1317,18 @@ class _Analyzer:
             rows = sum(b.rows for b in merged) if all(
                 b.rows is not None for b in merged) else None
             out = [_Batch(rows, cap, False)]
+        trace = child.trace
+        if trace is None:
+            # multi-partition child (e.g. a mesh/host shuffled flow):
+            # the replicate concatenates every partition, so the merged
+            # value trace is the per-partition traces in order — build
+            # sides over broadcast exchange outputs keep their key stats
+            ptr = child.all_part_traces()
+            if ptr is not None:
+                trace = self._merge_group_traces(ptr)
         self._stage(node, Counter(), child.total_batches if child.counted
                     else None, ["no kernels: host-orchestrated replicate"])
-        return _Flow([out], child.trace, counted=child.counted)
+        return _Flow([out], trace, counted=child.counted)
 
     def _mesh_active(self, num_out: int) -> bool:
         if not self.conf.get(MESH_ENABLED):
@@ -1320,6 +1341,162 @@ class _Analyzer:
             return len(jax.devices()) >= num_out
         except Exception:
             return False
+
+    # -- mesh stage model ---------------------------------------------------
+    def _mesh_exchange(self, node, child, p, fused: bool, kinds: Counter,
+                       notes: list) -> _Flow:
+        """Launch model of the mesh SPMD stage (parallel/mesh_fusion.py):
+        ONE sharded dispatch per step for the whole stage, plus one
+        re-dispatch per quota-overflow retry. The staging geometry and
+        the retry loop mirror mesh_exchange exactly, so when the key
+        values trace the prediction is EXACT — retries included."""
+        num_out = p.num_partitions
+        if self._cluster:
+            # a cluster scheduler splits the plan at exchanges: whether
+            # this exchange runs a (worker-)local mesh collective or the
+            # host shuffle write + fetch depends on stage placement, and
+            # reduce tiles rebuilt from MapStatus arrive pre-seeded —
+            # launch counts here are placement-dependent, not exact
+            self._approx("cluster scheduler: mesh-capable exchange "
+                         "placement (local collective vs host shuffle "
+                         "write) is a stage-scheduling decision")
+            kinds["mesh_stage"] += 1
+            notes.append("mesh-capable exchange under a cluster "
+                         "scheduler: placement decided at stage build")
+            self._stage(node, kinds, child.total_batches if child.counted
+                        else None, notes)
+            return _Flow([[_Batch(None, None, False, seeded=True)]
+                          for _ in range(num_out)], None, counted=False)
+        fused_mesh = fused and self._fusion_mesh
+        if fused and not fused_mesh:
+            if child.counted:
+                kinds["pipeline"] += child.total_batches
+            else:
+                self._approx("mesh pipeline materialization count depends "
+                             "on an unknown upstream batch count")
+            notes.append("mesh fallback (spark.tpu.fusion.mesh=false): "
+                         "the fused map side materializes the pipeline "
+                         "per batch before the all-to-all")
+        if fused_mesh:
+            notes.append("FUSED mesh stage: pipeline + partition ids + "
+                         "all-to-all compiled as ONE shard_map program — "
+                         "1 sharded dispatch per step, send buffers "
+                         "donated (spark.tpu.fusion.minRows does not "
+                         "apply: one program per step, not per batch)")
+        else:
+            notes.append("mesh SPMD stage: ONE sharded dispatch "
+                         "redistributes the staged batches")
+        self._hazard("mesh stage cache key embeds the per-pair row quota "
+                     "— skewed data recompiles with a doubled quota")
+        key_ids = [e.expr_id for e in p.exprs
+                   if isinstance(e, AttributeReference)]
+        sim = None
+        if len(key_ids) == len(p.exprs) and child.counted:
+            in_traces = self._exchange_input_traces(node, child, fused)
+            if in_traces is not None:
+                sim = self._mesh_sim(child, in_traces, key_ids, num_out)
+        if sim is None:
+            self._approx("mesh stage quota retries are data-dependent "
+                         "and the key values are untraced — assuming one "
+                         "dispatch, reduce layout unknown")
+            kinds["mesh_stage"] += 1
+            self._stage(node, kinds, child.total_batches if child.counted
+                        else None, notes)
+            return _Flow([[_Batch(None, None, False)]
+                          for _ in range(num_out)], None, counted=True)
+        attempts, flow = sim
+        kinds["mesh_stage"] += attempts
+        if attempts > 1:
+            notes.append(f"{attempts - 1} quota "
+                         f"retr{'y' if attempts == 2 else 'ies'}: a "
+                         "(src,dst) pair overflowed its row quota and "
+                         "the stage re-dispatched doubled")
+        notes.append("reduce layout EXACT: staged-shard hash simulation "
+                     "decides per-reducer rows and the retry count")
+        self._stage(node, kinds, child.total_batches if child.counted
+                    else None, notes)
+        return flow
+
+    def _mesh_sim(self, child: _Flow, traces: list, key_ids: list,
+                  num_out: int):
+        """Host mirror of the mesh staging + quota-retry loop. Returns
+        (attempts, output _Flow) or None when the layout cannot be
+        reconstructed. Mirrors parallel/mesh_exchange: batches flatten
+        partition-major into a [total_cap] plane, shard s owns data rows
+        [s*rows_per_shard, (s+1)*rows_per_shard), pids come from the
+        splitmix64 host mirror, and the quota doubles (one extra
+        dispatch) while any (src,dst) bucket overflows."""
+        # the SAME geometry helper the runtime stages with — the mirror
+        # cannot drift from the execution layer
+        from ..parallel.mesh_fusion import mesh_stage_geometry
+
+        ids = set(traces[0].cols)
+        for t in traces[1:]:
+            ids &= set(t.cols)
+        if any(k not in ids for k in key_ids):
+            return None
+        # global staged plane: per-batch capacity slots, data rows first
+        total_cap = 0
+        spans = []  # (trace, r0, rows_b, off, cap)
+        for part, t in zip(child.parts, traces):
+            r0 = 0
+            for b in part:
+                if b.cap is None or b.rows is None:
+                    return None
+                spans.append((t, r0, b.rows, total_cap, b.cap))
+                r0 += b.rows
+                total_cap += b.cap
+            if r0 != len(t.live):
+                return None
+        if total_cap == 0:
+            return None
+        live = np.zeros(total_cap, bool)
+        gcols = {}
+        for k in ids:
+            dt = traces[0].cols[k][0].dtype
+            has_valid = any(t.cols[k][1] is not None for t in traces)
+            gcols[k] = [np.zeros(total_cap, dtype=dt),
+                        np.zeros(total_cap, bool) if has_valid else None]
+        for t, r0, rows_b, off, _cap in spans:
+            sl = slice(r0, r0 + rows_b)
+            live[off: off + rows_b] = t.live[sl]
+            for k in ids:
+                vals, valid = t.cols[k]
+                gvals, gvalid = gcols[k]
+                gvals[off: off + rows_b] = vals[sl]
+                if gvalid is not None:
+                    gvalid[off: off + rows_b] = (
+                        np.ones(rows_b, bool) if valid is None
+                        else valid[sl])
+        pids = _np_hash_pids([(gcols[k][0], gcols[k][1])
+                              for k in key_ids], num_out)
+        live_idx = np.nonzero(live)[0]
+        rows_per_shard, _shard_cap, quota = mesh_stage_geometry(
+            total_cap, num_out)
+        shard = live_idx // rows_per_shard
+        pid_live = pids[live_idx]
+        attempts = 1
+        while True:
+            counts = np.zeros((num_out, num_out), np.int64)
+            np.add.at(counts, (shard, pid_live), 1)
+            if not len(live_idx) or counts.max() <= quota:
+                break
+            if attempts >= 8:
+                return None  # degrades to the host shuffle at runtime
+            quota *= 2
+            attempts += 1
+        out_cap = num_out * quota
+        parts, ptraces = [], []
+        for q in range(num_out):
+            sel = live_idx[pid_live == q]  # ascending == shard-major,
+            # then original position: the stable per-shard pid sort
+            rows_q = int(len(sel))
+            parts.append([_Batch(rows_q, out_cap, False)])
+            cols_q = {k: (gv[sel],
+                          None if gvalid is None else gvalid[sel])
+                      for k, (gv, gvalid) in gcols.items()}
+            ptraces.append(_Trace(cols_q, np.ones(rows_q, bool), True))
+        return attempts, _Flow(parts, None, counted=True, ptraces=ptraces)
 
     # -- exchange layout/value helpers -------------------------------------
     @staticmethod
@@ -1449,27 +1626,8 @@ class _Analyzer:
             return _Flow([merged], child.trace, counted=child.counted)
         if isinstance(p, HashPartitioning):
             if self._mesh_active(p.num_partitions):
-                if fused and child.counted:
-                    # mesh all-to-all consumes materialized batches: the
-                    # pipeline runs unfused first
-                    kinds["pipeline"] += child.total_batches
-                    notes.append("mesh fallback: fused map side "
-                                 "materializes the pipeline before the "
-                                 "all-to-all")
-                kinds["mesh_exchange"] += 1
-                notes.append("mesh all-to-all: ONE program for the whole "
-                             "redistribution")
-                self._approx("mesh exchange quota retries are "
-                             "data-dependent (skew doubles the quota and "
-                             "re-launches)")
-                self._hazard("mesh exchange cache key embeds the per-pair "
-                             "row quota — skewed data recompiles with a "
-                             "doubled quota")
-                out = [[_Batch(None, None, False)]
-                       for _ in range(p.num_partitions)]
-                self._stage(node, kinds, child.total_batches
-                            if child.counted else None, notes)
-                return _Flow(out, None, counted=True)
+                return self._mesh_exchange(node, child, p, fused, kinds,
+                                           notes)
             self._map_side_kinds(node, child, fused,
                                  self._host_shuffle_kind(), kinds, notes)
             self._sync("host sort-shuffle pulls grouped columns to host "
@@ -1504,10 +1662,19 @@ class _Analyzer:
         if isinstance(p, RangePartitioning):
             self._map_side_kinds(node, child, fused, "shuffle_range",
                                  kinds, notes)
+            if fused and child.counted:
+                # post-pipeline bound sampling materializes the pipeline
+                # for ≤3 spread batches per partition
+                kinds["pipeline"] += sum(min(3, len(pp))
+                                         for pp in child.parts)
+                notes.append("fused range bounds sample the POST-pipeline "
+                             "key column (≤3 materialized batches per "
+                             "partition)")
             self._approx("range exchange: sampled bounds may collapse to a "
                          "single gather (data-dependent)")
             self._sync("range-bound sampling reads per-batch samples "
-                       "host-side (memoized per column identity)")
+                       "host-side (fused: fresh pipeline outputs each "
+                       "run; unfused: memoized per column identity)")
             out = [[_Batch(None, None, False,
                            seeded=self._exchange_seeded(node))]
                    for _ in range(p.num_partitions)]
@@ -1709,9 +1876,7 @@ class _Analyzer:
     def _exchange_boundary_reasons(self, node, O) -> list:
         """Why a shuffle exchange over a nontrivial pipeline did NOT fuse
         its map side (mirrors fusion._exchange_fusable)."""
-        from ..physical.fusion import (
-            _compute_nontrivial, _range_sample_source,
-        )
+        from ..physical.fusion import _compute_nontrivial
         from ..physical.partitioning import (
             HashPartitioning, RangePartitioning, SinglePartition,
             UnknownPartitioning,
@@ -1747,10 +1912,8 @@ class _Analyzer:
                                   or dict_encoded(a.dtype)):
                 return [f"range key {a.name} is a dictionary-encoded "
                         "string: pids ride a host rank→pid lut"]
-            if isinstance(oc, AttributeReference) \
-                    and _range_sample_source(c, oc) is None:
-                return ["range sort key is computed by the pipeline: "
-                        "bound sampling needs a pass-through input column"]
+            # computed sort keys fuse: bounds sample the post-pipeline
+            # key column (the sampled batches materialize the pipeline)
             return ["not rewritten (unexpected: report this plan)"]
         if isinstance(p, UnknownPartitioning):
             return ["not rewritten (unexpected: report this plan)"]
@@ -1838,9 +2001,12 @@ class HashAggMergeProxy:
 # entry point
 # ---------------------------------------------------------------------------
 
-def analyze_plan(plan, conf: SQLConf) -> AnalysisReport:
+def analyze_plan(plan, conf: SQLConf, cluster: bool = False) -> AnalysisReport:
     """Analyze an optimized PHYSICAL plan. Predictions model one WARM
     execution: kernel caches compiled, device-cached scans resident, and
     the device-scalar memo primed (first runs add one krange3 probe per
-    distinct stable column plus the compile misses)."""
-    return _Analyzer(conf).run(plan)
+    distinct stable column plus the compile misses). `cluster` models
+    execution under a cluster scheduler, where exchanges run the host
+    shuffle path in worker map tasks instead of the driver-local mesh
+    collective."""
+    return _Analyzer(conf, cluster=cluster).run(plan)

@@ -184,6 +184,19 @@ FUSION_DENSE_KEYS = _register(ConfigEntry(
     "scatter path when the grouping key is a pass-through integral column "
     "whose (memoized) range fits a capacity bucket.", _bool))
 
+FUSION_MESH = _register(ConfigEntry(
+    "spark.tpu.fusion.mesh", True,
+    "Mesh-native SPMD stage fusion: a fused shuffle exchange whose "
+    "partition count matches the device mesh runs its WHOLE stage — "
+    "traced filter/project pipeline, partition-id computation, per-shard "
+    "bucket-by-destination and the ICI all-to-all — as ONE shard_map "
+    "program per step, with the staged send buffers donated "
+    "(donate_argnums) so the all-to-all reuses their HBM in-place. Off: "
+    "the legacy composition materializes the pipeline per batch before "
+    "the collective. Requires spark.tpu.fusion.enabled and "
+    "spark.tpu.fusion.exchange; the minRows gate does not apply (the "
+    "mesh stage is one program per step, not per batch).", _bool))
+
 FUSION_EXCHANGE = _register(ConfigEntry(
     "spark.tpu.fusion.exchange", True,
     "Exchange map-side fusion: a stage whose terminal is a shuffle "

@@ -156,7 +156,9 @@ class QueryExecution:
         # instead of as an opaque XLA OOM mid-query (obs/resources.py)
         from ..obs.resources import check_memory_budget
 
-        check_memory_budget(plan, self.session.conf)
+        check_memory_budget(
+            plan, self.session.conf,
+            cluster=getattr(self.session, "_sql_cluster", None) is not None)
         # execution always runs under a query scope: collects push one in
         # to_arrow, but direct execute() callers (bench._run_blocked,
         # tests) would otherwise stream worker heartbeat deltas with no
@@ -384,7 +386,9 @@ class QueryExecution:
         Pure host work — nothing executes on device."""
         from ..analysis.plan_lint import analyze_plan
 
-        return analyze_plan(self.physical, self.session.conf)
+        return analyze_plan(
+            self.physical, self.session.conf,
+            cluster=getattr(self.session, "_sql_cluster", None) is not None)
 
     def analyzed_report(self, warm: bool = True):
         """EXPLAIN ANALYZE: execute the query and annotate the physical

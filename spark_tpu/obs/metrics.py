@@ -28,16 +28,18 @@ first-class findings.
 
 from __future__ import annotations
 
+import contextlib
 import contextvars
 import threading
 from dataclasses import dataclass, field
 
 import numpy as np
 
-__all__ = ["AnalyzedReport", "current_op_name", "export_op_records",
-           "export_op_records_partial", "finalize_plan_metrics",
-           "fused_members", "get_or_create_op_record", "merge_op_records",
-           "new_op_record", "pop_op", "push_op", "record_kernel_launch",
+__all__ = ["AnalyzedReport", "batch_cost_scope", "current_op_name",
+           "export_op_records", "export_op_records_partial",
+           "finalize_plan_metrics", "fused_members",
+           "get_or_create_op_record", "merge_op_records", "new_op_record",
+           "pop_op", "push_op", "record_kernel_launch",
            "record_kernel_compile", "scoped_submit"]
 
 
@@ -54,6 +56,35 @@ _SCOPE: "contextvars.ContextVar" = contextvars.ContextVar(
 # per-record Counter updates are read-modify-write; lanes of one operator
 # share its record, so serialize the tiny increments
 _ATTR_LOCK = threading.Lock()
+
+# live-row fraction of the batch currently dispatching. Captured kernel
+# costs are per-kernel-identity CONSTANTS (first-invocation lowering), so
+# shape buckets whose batches carry very different live row counts would
+# overstate per-operator bytes/flops — and EXPLAIN ANALYZE's achieved
+# GB/s — on sparse batches. Dispatch sites that know the live count
+# host-side (ExprPipeline.run, the fused stage kernels) scope this
+# fraction around the kernel call and record_kernel_launch scales the
+# cost multiplied onto the OPERATOR record. The process-wide KernelCache
+# counters stay unscaled: they mirror the cost model's per-launch bytes.
+_BATCH_FRACTION: "contextvars.ContextVar" = contextvars.ContextVar(
+    "spark_tpu_batch_fraction", default=None)
+
+
+@contextlib.contextmanager
+def batch_cost_scope(batch):
+    """Context manager scoping the live-row fraction of `batch` (host
+    metadata only — an unknown live count scales nothing). Runs once per
+    kernel dispatch: module-level contextmanager, no per-call closure."""
+    rows = batch._num_rows
+    cap = batch.capacity
+    frac = None
+    if rows is not None and cap and rows < cap:
+        frac = max(int(rows), 1) / cap
+    token = _BATCH_FRACTION.set(frac)
+    try:
+        yield
+    finally:
+        _BATCH_FRACTION.reset(token)
 
 
 def new_op_record() -> dict:
@@ -104,12 +135,20 @@ def record_kernel_launch(kind, cost: dict | None = None) -> None:
     if scope is None or scope[0] is None:
         return
     rec = scope[0]
+    frac = _BATCH_FRACTION.get() if cost is not None else None
     with _ATTR_LOCK:
         rec["kinds"][kind] = rec["kinds"].get(kind, 0) + 1
         rec["launch_total"] += 1
         if cost is not None:
-            rec["flops"] += cost["flops"]
-            rec["bytes"] += cost["bytes"]
+            if frac is not None:
+                # scale the per-identity constant cost by the dispatching
+                # batch's live-row fraction (PR 7 follow-on: sparse
+                # batches no longer overstate achieved GB/s)
+                rec["flops"] += cost["flops"] * frac
+                rec["bytes"] += cost["bytes"] * frac
+            else:
+                rec["flops"] += cost["flops"]
+                rec["bytes"] += cost["bytes"]
 
 
 def record_kernel_compile(kind, ms: float) -> None:

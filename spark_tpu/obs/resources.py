@@ -201,7 +201,33 @@ class DeviceLedger:
         if pairs:
             self._register(pairs, batch)
 
+    def charge_arrays(self, arrays) -> list:
+        """Explicitly charge raw device arrays (mesh staging send
+        buffers — parallel/mesh_fusion.py) to the current scope. Returns
+        the token for `release_arrays`; the caller owns the lifetime
+        (donated buffers release at dispatch, undonated ones after the
+        outputs register). Metadata only — never reads device data."""
+        if not _LEDGER_ON:
+            return []
+        pairs = []
+        for a in arrays:
+            if a is None or not hasattr(a, "dtype"):
+                continue
+            pairs.append((a, int(a.size) * a.dtype.itemsize))
+        return self._charge(pairs) if pairs else []
+
+    def release_arrays(self, token: list) -> None:
+        """Release a `charge_arrays` token (idempotent per token use)."""
+        if token:
+            self._release(token)
+
     def _register(self, pairs, owner) -> None:
+        keys = self._charge(pairs)
+        # the finalizer closes over ids + the ledger only — it must not
+        # keep the arrays (or the batch) alive
+        weakref.finalize(owner, self._release, keys)
+
+    def _charge(self, pairs) -> list:
         from .metrics import current_op_name
         from .tracing import current_query
 
@@ -236,9 +262,7 @@ class DeviceLedger:
                     o["registered"] += nb
                     if o["bytes"] > o["peak"]:
                         o["peak"] = o["bytes"]
-        # the finalizer closes over ids + the ledger only — it must not
-        # keep the arrays (or the batch) alive
-        weakref.finalize(owner, self._release, keys)
+        return keys
 
     def _release(self, keys) -> None:
         with self._lock:
@@ -355,7 +379,8 @@ class MemoryBudgetExceeded(RuntimeError):
     offending stage, instead of an opaque XLA OOM mid-query."""
 
 
-def check_memory_budget(physical, conf, report=None) -> None:
+def check_memory_budget(physical, conf, report=None,
+                        cluster: bool = False) -> None:
     """Pre-flight the memory model against spark.tpu.memory.budget
     (0 = unlimited). Pure host work — nothing executes on device."""
     from ..config import MEMORY_BUDGET
@@ -366,7 +391,7 @@ def check_memory_budget(physical, conf, report=None) -> None:
     if report is None:
         from ..analysis.plan_lint import analyze_plan
 
-        report = analyze_plan(physical, conf)
+        report = analyze_plan(physical, conf, cluster=cluster)
     peak = report.predicted_peak_hbm
     if peak is None or peak <= budget:
         return

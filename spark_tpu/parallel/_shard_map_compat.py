@@ -2,23 +2,60 @@
 
 jax moved shard_map out of experimental and renamed the replication-check
 kwarg (check_rep -> check_vma) across releases; the mesh kernels target the
-new surface. This shim resolves the import and translates the kwarg so the
-same call sites run on either jax generation.
+new surface. The supported kwarg is FEATURE-DETECTED once per process from
+the resolved function's signature and cached; a jax release that renames
+the kwarg again (or hides the signature) raises immediately with the
+detected surface in the message instead of silently dropping the check —
+version skew must fail loudly (tests/test_shard_map_compat.py).
 """
 
 from __future__ import annotations
+
+import inspect
 
 try:
     from jax import shard_map as _shard_map
 except ImportError:  # jax<0.6 keeps it in experimental
     from jax.experimental.shard_map import shard_map as _shard_map
 
+_check_kwarg: str | None = None  # detected lazily, once per process
+
+
+def _detect_check_kwarg(fn) -> str:
+    """The replication-check kwarg this jax's shard_map accepts
+    (check_vma on current jax, check_rep before the rename). Raises on
+    an unrecognized surface."""
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        raise RuntimeError(
+            "jax shard_map signature is not introspectable — the "
+            "version-skew shim (parallel/_shard_map_compat.py) cannot "
+            "verify which replication-check kwarg this jax accepts; "
+            "update the shim for this jax release")
+    for name in ("check_vma", "check_rep"):
+        if name in params:
+            return name
+    if any(p.kind is inspect.Parameter.VAR_KEYWORD
+           for p in params.values()):
+        # **kwargs hides the real surface: passing a guessed name would
+        # either work or blow up deep inside jax — refuse loudly instead
+        raise RuntimeError(
+            "jax shard_map accepts **kwargs but neither check_vma nor "
+            "check_rep is a named parameter — jax renamed the "
+            "replication-check kwarg again; update "
+            "parallel/_shard_map_compat.py for this jax release")
+    raise RuntimeError(
+        "jax shard_map exposes no replication-check kwarg "
+        f"(parameters: {sorted(params)}) — update "
+        "parallel/_shard_map_compat.py for this jax release")
+
 
 def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
     kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs)
     if check_vma is not None:
-        try:
-            return _shard_map(f, **kwargs, check_vma=check_vma)
-        except TypeError:
-            return _shard_map(f, **kwargs, check_rep=check_vma)
+        global _check_kwarg
+        if _check_kwarg is None:
+            _check_kwarg = _detect_check_kwarg(_shard_map)
+        kwargs[_check_kwarg] = check_vma
     return _shard_map(f, **kwargs)

@@ -1,12 +1,15 @@
-"""Intra-slice shuffle over ICI: shard_map + lax.all_to_all.
+"""Intra-slice shuffle bucketing primitives: the per-shard leg of the
+ICI data plane.
 
 Role of the reference's shuffle data plane (Netty block transfer,
 core/storage/ShuffleBlockFetcherIterator.scala:86) WITHIN a TPU slice: rows
 never leave the devices — each shard buckets its rows by destination with the
-same hash/sort kernel the host shuffle uses (ops/partition.py), lays them out
-as [P, quota] blocks, and one `lax.all_to_all` swaps blocks across the mesh
-(SURVEY.md §2.5 'Communication backend': data plane = XLA collectives over
-ICI; the host/DCN path in exec/shuffle.py covers cross-slice).
+same hash/sort kernel the host shuffle uses (ops/partition.py) and lays them
+out as [P, quota] blocks for `lax.all_to_all` (SURVEY.md §2.5 'Communication
+backend': data plane = XLA collectives over ICI; the host/DCN path in
+exec/shuffle.py covers cross-slice). The stage-level programs that wrap
+these primitives under `shard_map` — exchange tail, traced-pipeline fusion,
+donation — live in parallel/mesh_fusion.py.
 
 Static shapes: each (src→dst) pair gets a fixed `quota` of rows; a scalar
 `overflow` flag reports rows that did not fit so the caller can retry at a
@@ -15,10 +18,6 @@ bigger quota (same capacity-bucket discipline as the join kernel).
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Sequence
-
-import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -31,9 +30,16 @@ def _bucket_local(key_eqs, key_valids, row_mask, num_partitions: int,
 
     Returns (perm int32[P*quota] gather indices into local rows (clipped),
              valid bool[P, quota], overflow int32)."""
-    cap = row_mask.shape[0]
     h = hash_columns(key_eqs, list(key_valids))
     pids = partition_ids(h, num_partitions)
+    return _bucket_by_pid(pids, row_mask, num_partitions, quota)
+
+
+def _bucket_by_pid(pids, row_mask, num_partitions: int, quota: int):
+    """_bucket_local over PRECOMPUTED partition ids — the fused mesh
+    stage program (parallel/mesh_fusion.py) derives pids from its traced
+    pipeline outputs instead of hashing staged key arrays."""
+    cap = row_mask.shape[0]
     key = jnp.where(row_mask, pids, num_partitions)
     skey, perm = lax.sort((key, lax.iota(jnp.int32, cap)), num_keys=1,
                           is_stable=True)
@@ -55,46 +61,3 @@ def _bucket_local(key_eqs, key_valids, row_mask, num_partitions: int,
     return gather_idx, slot_valid.reshape(num_partitions, quota), overflow
 
 
-def make_all_to_all_exchange(mesh, quota: int, axis_name: str = "data"):
-    """Build a jitted shard_map exchange.
-
-    Inputs (all row-sharded over `axis_name`, per-shard capacity = cap):
-      key_eqs: list of eq-domain arrays, key_valids (or None), payload arrays,
-      row_mask.
-    Output: payload arrays + row_mask re-sharded so equal keys land on the
-    same device; per-shard capacity becomes P*quota. overflow scalar summed
-    across shards."""
-    from jax.sharding import PartitionSpec as P
-
-    n_part = mesh.shape[axis_name]
-
-    def local_fn(key_eqs, key_valids, payloads, row_mask):
-        gather_idx, slot_valid, overflow = _bucket_local(
-            key_eqs, key_valids, row_mask, n_part, quota)
-        out_payloads = []
-        for p in payloads:
-            blocks = jnp.take(p, gather_idx).reshape(n_part, quota)
-            recv = lax.all_to_all(blocks, axis_name, split_axis=0,
-                                  concat_axis=0, tiled=False)
-            out_payloads.append(recv.reshape(n_part * quota))
-        vrecv = lax.all_to_all(slot_valid, axis_name, split_axis=0,
-                               concat_axis=0, tiled=False)
-        new_mask = vrecv.reshape(n_part * quota)
-        total_overflow = lax.psum(overflow, axis_name)
-        return out_payloads, new_mask, total_overflow
-
-    def sharded(key_eqs, key_valids, payloads, row_mask):
-        from ._shard_map_compat import shard_map
-
-        in_specs = (
-            [P(axis_name)] * len(key_eqs),
-            [None if v is None else P(axis_name) for v in key_valids],
-            [P(axis_name)] * len(payloads),
-            P(axis_name),
-        )
-        out_specs = ([P(axis_name)] * len(payloads), P(axis_name), P())
-        f = shard_map(local_fn, mesh=mesh, in_specs=in_specs,
-                      out_specs=out_specs, check_vma=False)
-        return f(key_eqs, key_valids, payloads, row_mask)
-
-    return jax.jit(sharded)

@@ -1,0 +1,314 @@
+"""Mesh-native SPMD stage fusion: one sharded dispatch per stage per step.
+
+Role of the reference's whole shuffle stage — map-side pipeline, partition
+writer, block transfer, reduce-side read (sqlx/exchange/
+ShuffleExchangeExec.scala + the SortShuffleManager data plane) — compiled
+as ONE XLA program over a jax.sharding.Mesh: the traced filter/project
+pipeline (physical/compile.trace_pipeline), the partition-id computation,
+the per-shard bucket-by-destination, and the `lax.all_to_all` over the
+ICI all run under a single `shard_map`, so a shuffle stage costs exactly
+one dispatch per step regardless of how many batches staged into it
+(JAMPI in PAPERS.md: barrier-mode ICI collectives beat host-mediated
+shuffle by an order of magnitude; this is ROADMAP direction 1).
+
+Layout discipline (the SpecLayout pattern, SNIPPETS [2]): every operand
+declares its canonical PartitionSpec once in `MeshSpecLayout` — row data
+is sharded over the data axis, pipeline aux tables are replicated — and
+staging `device_put`s against those specs BEFORE the jit call, so no
+input is ever resharded implicitly and outputs stay shard-resident for
+the reduce-side consumer (each reduce partition's batch wraps its
+device's shard directly; the agg partial / join build feed reads it
+without a host hop).
+
+Buffer donation: the staged send buffers are dead the moment the program
+consumes them, so they ride `donate_argnums` and XLA reuses their HBM
+in-place for the all-to-all staging/outputs. Staging is deliberately
+sized so each per-shard send plane equals the receive plane
+(shard_cap == P * quota) — the donated input aliases its output
+one-for-one instead of tripping XLA's "donated buffer not usable" path.
+The HBM ledger (obs/resources.DeviceLedger) charges the staged buffers
+explicitly and releases them at dispatch when donated (the arrays are
+genuinely invalidated by the call) vs. after output registration when
+not — the per-query watermark is the scoreboard for the donation win.
+
+Static-shape discipline: each (src→dst) pair gets a fixed row `quota`;
+the program psums an overflow count and the host retries with a doubled
+quota — the same capacity-bucket contract as the join/aggregate kernels.
+Per-partition live counts come back as a sharded [P] array computed
+in-program, so building the reduce batches needs one host pull, not one
+sync per partition.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import warnings
+from typing import Sequence
+
+import numpy as np
+
+from ..columnar.batch import bucket_capacity
+
+__all__ = ["MeshSpecLayout", "StagedBuffers", "build_fused_stage",
+           "build_plain_stage", "expected_donation_residue",
+           "mesh_stage_geometry"]
+
+# Donation is the default; tests A/B the HBM watermark by flipping this
+# module switch (the undonated program compiles under a distinct cache
+# key). Not a SQLConf: there is no reason to run undonated in production.
+DONATE_DEFAULT = True
+
+@contextlib.contextmanager
+def expected_donation_residue():
+    """Suppress jax's 'donated buffers were not usable' warning for ONE
+    mesh-stage dispatch: a donated plane whose dtype has no matching
+    output (an input column the projection drops) cannot alias, which is
+    expected here — the size-matched staging makes every surviving plane
+    alias cleanly. Scoped per call site, never process-wide: that warning
+    is the only signal a FUTURE donation site regressed its aliasing."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        yield
+
+
+def mesh_stage_geometry(total_cap: int, num_out: int) -> tuple[int, int, int]:
+    """(rows_per_shard, shard_cap, quota) for staging `total_cap` input
+    slots across `num_out` shards.
+
+    rows_per_shard — input slots assigned to each shard (row-block
+    split of the concatenated batches, so every device gets data).
+    quota — per-(src,dst) row budget of the first attempt: 2× the
+    uniform share, the historical overflow headroom.
+    shard_cap — per-shard staged capacity, padded to P*quota so the
+    send planes are the SAME size as the receive planes and donation
+    aliases in-place. The plan analyzer mirrors these formulas exactly
+    (analysis/plan_lint.py mesh model)."""
+    rows_per_shard = max(-(-total_cap // num_out), 1)
+    base = bucket_capacity(max(rows_per_shard, 64))
+    quota = max(16, 2 * base // num_out)
+    return rows_per_shard, num_out * quota, quota
+
+
+# ---------------------------------------------------------------------------
+# canonical operand layouts (the SpecLayout pattern)
+# ---------------------------------------------------------------------------
+
+class MeshSpecLayout:
+    """Canonical PartitionSpecs per operand role for a mesh stage.
+
+    One authority for how every array of the stage program is laid out
+    over the mesh: staging places inputs against these specs and the
+    shard_map in/out_specs are derived from the same methods, so a batch
+    flows shard-resident between stages with no implicit resharding."""
+
+    def __init__(self, axis: str = "data"):
+        from jax.sharding import PartitionSpec as P
+
+        self.axis = axis
+        self._P = P
+
+    def rows(self):
+        """Row-sharded planes: column data, validity, row mask, keys."""
+        return self._P(self.axis)
+
+    def replicated(self):
+        """Pipeline aux tables (dictionaries' luts) and scalar operands:
+        every shard reads the full array."""
+        return self._P()
+
+    def row_sharding(self, mesh):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(mesh, self.rows())
+
+    def replicated_sharding(self, mesh):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(mesh, self.replicated())
+
+
+# ---------------------------------------------------------------------------
+# HBM ledger bookkeeping for staged send buffers
+# ---------------------------------------------------------------------------
+
+class StagedBuffers:
+    """Explicit ledger ownership of one attempt's staged device arrays.
+
+    `release_consumed()` drops the charge of every array the dispatch
+    invalidated (donation) the moment it returns — the buffers are
+    genuinely gone, and the per-query watermark records the in-place
+    reuse. Undonated arrays stay charged until `release_all()` (or GC of
+    this holder), which runs after the reduce-side output batches have
+    registered — the honest input+output overlap."""
+
+    def __init__(self, arrays: Sequence):
+        from ..obs.resources import GLOBAL_LEDGER, ledger_enabled
+
+        self._ledger = GLOBAL_LEDGER if ledger_enabled() else None
+        self._entries = []
+        if self._ledger is not None:
+            for a in arrays:
+                if a is None or not hasattr(a, "dtype"):
+                    continue
+                token = self._ledger.charge_arrays([a])
+                if token:
+                    self._entries.append((a, token))
+
+    def release_consumed(self) -> None:
+        if self._ledger is None:
+            return
+        kept = []
+        for a, token in self._entries:
+            if getattr(a, "is_deleted", lambda: False)():
+                self._ledger.release_arrays(token)
+            else:
+                kept.append((a, token))
+        self._entries = kept
+
+    def release_all(self) -> None:
+        if self._ledger is None:
+            return
+        for _a, token in self._entries:
+            self._ledger.release_arrays(token)
+        self._entries = []
+
+    def __del__(self):  # backstop — release_all is idempotent
+        try:
+            self.release_all()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# the SPMD stage programs
+# ---------------------------------------------------------------------------
+
+def _exchange_tail(arrays, pids, row_mask, num_out: int, quota: int,
+                   axis: str):
+    """Shared post-pid leg of a stage program, per shard: bucket live
+    rows by destination into [P, quota] blocks, all-to-all every plane,
+    and report (received arrays, received mask, per-shard live count,
+    global overflow). `arrays` entries may be None (absent validity
+    planes) and pass through as None."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .collectives import _bucket_by_pid
+
+    gather_idx, slot_valid, overflow = _bucket_by_pid(
+        pids, row_mask, num_out, quota)
+
+    def xchg(blocks):
+        recv = lax.all_to_all(blocks, axis, split_axis=0, concat_axis=0,
+                              tiled=False)
+        return recv.reshape(num_out * quota)
+
+    outs = [None if a is None
+            else xchg(jnp.take(a, gather_idx).reshape(num_out, quota))
+            for a in arrays]
+    new_mask = xchg(slot_valid)
+    count = jnp.sum(new_mask.astype(jnp.int64)).reshape(1)
+    total_overflow = lax.psum(overflow, axis)
+    return outs, new_mask, count, total_overflow
+
+
+def build_plain_stage(mesh, axis: str, quota: int, num_out: int,
+                      n_keys: int, key_valid_sig: tuple,
+                      n_payloads: int, donate: bool):
+    """Jitted mesh stage for PRE-MATERIALIZED batches: pids from staged
+    key arrays + all-to-all, payload/mask send buffers donated. Signature:
+    f(key_eqs, key_valids, payloads, row_mask) ->
+    (out_payloads, new_mask, counts[P], overflow)."""
+    import jax
+
+    from ..ops.hashing import hash_columns, partition_ids
+    from ._shard_map_compat import shard_map
+
+    layout = MeshSpecLayout(axis)
+    rows = layout.rows()
+
+    def local_fn(key_eqs, key_valids, payloads, row_mask):
+        h = hash_columns(key_eqs, list(key_valids))
+        pids = partition_ids(h, num_out)
+        return _exchange_tail(payloads, pids, row_mask, num_out, quota,
+                              axis)
+
+    def sharded(key_eqs, key_valids, payloads, row_mask):
+        in_specs = (
+            [rows] * n_keys,
+            [None if not has else rows for has in key_valid_sig],
+            [rows] * n_payloads,
+            rows,
+        )
+        out_specs = ([rows] * n_payloads, rows, rows,
+                     layout.replicated())
+        f = shard_map(local_fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=False)
+        return f(key_eqs, key_valids, payloads, row_mask)
+
+    # built exclusively through GLOBAL_KERNEL_CACHE.get_or_build
+    # (mesh_exchange) — launches ride the dispatch counters
+    return jax.jit(sharded,  # tpulint: ignore[raw-jit]
+                   donate_argnums=(2, 3) if donate else ())
+
+
+def build_fused_stage(mesh, axis: str, shard_cap: int, quota: int,
+                      num_out: int, seed: int, input_attrs,
+                      filters, outputs, key_idx: tuple, key_bool: tuple,
+                      out_valid_sig: tuple, donate: bool):
+    """Jitted mesh stage for a FUSED shuffle stage: the filter/project
+    pipeline traces per shard, partition ids derive from the traced key
+    outputs, and the all-to-all ships the pipeline OUTPUT columns — the
+    whole stage is one SPMD dispatch. Signature:
+    f(datas, valids, row_mask, aux) ->
+    (out_datas, out_valids, new_mask, counts[P], overflow), where the
+    input planes (datas/valids/row_mask) are the donated send buffers."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..physical.compile import trace_pipeline
+    from ..ops.hashing import hash_columns, partition_ids
+    from ._shard_map_compat import shard_map
+
+    layout = MeshSpecLayout(axis)
+    rows = layout.rows()
+    rep = layout.replicated()
+    n_in = len(input_attrs)
+
+    def local_fn(datas, valids, row_mask, aux):
+        out_datas, out_valids, mask = trace_pipeline(
+            input_attrs, filters, outputs, datas, valids, row_mask, aux,
+            shard_cap)
+        eqs = []
+        for i, is_bool in zip(key_idx, key_bool):
+            kd = out_datas[i]
+            if is_bool:
+                kd = kd.astype(jnp.int32)
+            eqs.append(kd)
+        kvs = [out_valids[i] for i in key_idx]
+        pids = partition_ids(hash_columns(eqs, kvs, seed=seed), num_out)
+        planes = list(out_datas) + list(out_valids)
+        outs, new_mask, count, overflow = _exchange_tail(
+            planes, pids, mask, num_out, quota, axis)
+        n = len(out_datas)
+        return outs[:n], outs[n:], new_mask, count, overflow
+
+    def sharded(datas, valids, row_mask, aux):
+        in_specs = (
+            [rows] * n_in,
+            [None if v is None else rows for v in valids],
+            rows,
+            [rep] * len(aux),
+        )
+        out_specs = ([rows] * len(outputs),
+                     [rows if has else None for has in out_valid_sig],
+                     rows, rows, rep)
+        f = shard_map(local_fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=False)
+        return f(datas, valids, row_mask, aux)
+
+    # built exclusively through GLOBAL_KERNEL_CACHE.get_or_build
+    # (mesh_exchange) — launches ride the dispatch counters
+    return jax.jit(sharded,  # tpulint: ignore[raw-jit]
+                   donate_argnums=(0, 1, 2) if donate else ())

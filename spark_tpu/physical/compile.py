@@ -21,6 +21,7 @@ import numpy as np
 from ..columnar.batch import Column, ColumnarBatch
 from ..expr.eval import HostCtx, TraceCtx, Val
 from ..obs.metrics import (
+    batch_cost_scope,
     record_kernel_compile as _obs_compile,
     record_kernel_launch as _obs_launch,
 )
@@ -391,8 +392,9 @@ class ExprPipeline:
 
         datas = [c.data for c in batch.columns]
         valids = [c.validity for c in batch.columns]
-        out_datas, out_valids, new_mask = kernel(datas, valids,
-                                                 batch.row_mask, aux)
+        with batch_cost_scope(batch):
+            out_datas, out_valids, new_mask = kernel(datas, valids,
+                                                     batch.row_mask, aux)
         cols = pipeline_columns(self.out_schema.fields, host_outs, out_datas,
                                 out_valids)
         return ColumnarBatch(self.out_schema, cols, new_mask, num_rows=None)

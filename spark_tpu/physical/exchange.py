@@ -103,15 +103,20 @@ class ShuffleExchangeExec(PhysicalPlan):
 
                 mesh = ME.mesh_for(p.num_partitions, ctx.conf, schema)
                 if mesh is not None:
-                    if fusion is not None:
-                        # the mesh all-to-all consumes device-sharded
-                        # batches — materialize the pipeline, then shuffle
-                        parts = [[fusion.run_pipeline(b) for b in part]
-                                 for part in parts]
+                    # the whole stage — pipeline, partition ids,
+                    # all-to-all — is ONE SPMD dispatch per step when the
+                    # map side is fused (spark.tpu.fusion.mesh); the
+                    # legacy materialize-then-collective composition sits
+                    # behind that flag
                     with self._span(ctx, "exchange.mesh_all_to_all", p):
                         return ME.mesh_shuffle_hash(
                             parts, key_positions, p.num_partitions, schema,
-                            ctx, self.last_stats, mesh)
+                            ctx, self.last_stats, mesh,
+                            fusion=None if fusion is None else
+                            fusion.bind_hash(key_positions,
+                                             p.num_partitions),
+                            col_stats=self.last_col_stats,
+                            stat_cols=self.stat_cols)
                 with self._span(ctx, "exchange.hash", p):
                     if fusion is not None:
                         return S.shuffle_fused(
@@ -162,17 +167,23 @@ class ShuffleExchangeExec(PhysicalPlan):
         assert isinstance(order.child, AttributeReference)
         kpos = pos[order.child.expr_id]
         if fusion is not None:
-            # bounds sample from the INPUT column the key passes through
-            # (a pre-filter superset of the key domain — any bound set
-            # partitions correctly, the fusable gate guarantees the
-            # pass-through; see fusion._range_sample_source)
-            from .fusion import _range_sample_source
+            # bounds sample the POST-pipeline key column: the pipeline
+            # materializes for ≤3 sampled batches per partition — spread
+            # first/middle/last so ordered domains (range scans) are
+            # covered end to end — and selective filters no longer skew
+            # partition balance; COMPUTED sort keys fuse too (the
+            # pre-pipeline input-column sampling was a pre-filter
+            # superset — sound but uneven, and it required a
+            # pass-through key)
+            def picks(part):
+                if len(part) <= 3:
+                    return list(part)
+                return [part[0], part[len(part) // 2], part[-1]]
 
-            in_pos = _range_sample_source(
-                _FusionComputeView(self.pipe_fusion, self.child), order.child)
-            in_schema = attrs_schema(self.child.output)
-            bounds = _sample_bounds(parts, in_pos, in_schema,
-                                    p.num_partitions)
+            sample_parts = [[fusion.run_pipeline(b) for b in picks(part)]
+                            for part in parts]
+            bounds = _sample_bounds(sample_parts, kpos, schema,
+                                    p.num_partitions, all_batches=True)
             if bounds is None or len(bounds) == 0:
                 return S.gather_single(
                     [[fusion.run_pipeline(b) for b in part]
@@ -205,15 +216,6 @@ class ShuffleExchangeExec(PhysicalPlan):
         return s
 
 
-class _FusionComputeView:
-    """Adapter giving fusion helpers the (filters, outputs, child) shape
-    of the ComputeExec the FuseStages rule absorbed into the exchange."""
-
-    def __init__(self, pipe_fusion: tuple, child):
-        self.filters, self.outputs = pipe_fusion
-        self.child = child
-
-
 def _batch_key_samples(batch: ColumnarBatch, kpos: int, f,
                        per_part_sample: int) -> tuple:
     """Up to `per_part_sample` live non-null key values of one batch as an
@@ -242,13 +244,17 @@ def _batch_key_samples(batch: ColumnarBatch, kpos: int, f,
 
 
 def _sample_bounds(parts, kpos: int, schema, num_out: int,
-                   per_part_sample: int = 4096):
+                   per_part_sample: int = 4096,
+                   all_batches: bool = False):
     """Sample the sort key to derive range bounds (role of the reference's
-    RangePartitioner sampling job, core/Partitioner.scala:388)."""
+    RangePartitioner sampling job, core/Partitioner.scala:388).
+    `all_batches` samples every batch handed in — the fused exchange
+    pre-selects a spread of materialized pipeline outputs instead of
+    relying on the first-2 heuristic."""
     f = schema.fields[kpos]
     samples = []
     for part in parts:
-        for batch in part[:2]:
+        for batch in (part if all_batches else part[:2]):
             samples.extend(_batch_key_samples(batch, kpos, f,
                                               per_part_sample))
     if not samples:

@@ -39,6 +39,7 @@ from ..types import (
     BooleanType, DateType, IntegralType, StringType, dict_encoded,
 )
 from ..columnar.batch import Column, ColumnarBatch, bucket_capacity
+from ..obs.metrics import batch_cost_scope
 from .aggregates import FUSABLE_OPS
 from .compile import (
     GLOBAL_KERNEL_CACHE, bind_inputs, canonical_key, pipeline_columns,
@@ -287,8 +288,9 @@ class FusedAggregateExec(HashAggregateExec):
 
             kernel = GLOBAL_KERNEL_CACHE.get_or_build(
                 ("fused_agg", "u") + base_key, build_ungrouped)
-            bufs_d, bufs_v, m = kernel(datas, valids, batch.row_mask, aux,
-                                       rank_luts, inv_luts)
+            with batch_cost_scope(batch):
+                bufs_d, bufs_v, m = kernel(datas, valids, batch.row_mask,
+                                           aux, rank_luts, inv_luts)
             cols = self._fused_cols(
                 list(zip(bufs_d, bufs_v)), out_schema.fields, host_outs,
                 val_idx, 0)
@@ -341,9 +343,10 @@ class FusedAggregateExec(HashAggregateExec):
 
             kernel = GLOBAL_KERNEL_CACHE.get_or_build(
                 ("fused_agg", "d", out_cap) + base_key, build_dense)
-            out_keys, key_validity, bufs, out_mask = kernel(
-                datas, valids, batch.row_mask, aux, jnp.int64(kmin),
-                rank_luts, inv_luts)
+            with batch_cost_scope(batch):
+                out_keys, key_validity, bufs, out_mask = kernel(
+                    datas, valids, batch.row_mask, aux, jnp.int64(kmin),
+                    rank_luts, inv_luts)
             ctx.metrics.add("agg.dense_fast_path")
             cols = [Column(kf.dataType, out_keys,
                            key_validity if has_kv else None, None)]
@@ -383,8 +386,10 @@ class FusedAggregateExec(HashAggregateExec):
 
         kernel = GLOBAL_KERNEL_CACHE.get_or_build(
             ("fused_agg", "g") + base_key, build_grouped)
-        out_keys, bufs, out_mask = kernel(datas, valids, batch.row_mask,
-                                          aux, rank_luts, inv_luts)
+        with batch_cost_scope(batch):
+            out_keys, bufs, out_mask = kernel(datas, valids,
+                                              batch.row_mask, aux,
+                                              rank_luts, inv_luts)
         cols = []
         nk = len(key_idx)
         for (kd, kv), ki, f in zip(out_keys, key_idx,
@@ -543,9 +548,10 @@ class FusedLimitExec(LimitExec):
             return jax.jit(kernel)
 
         kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build)
-        out_datas, out_valids, keep = kernel(
-            [c.data for c in batch.columns],
-            [c.validity for c in batch.columns], batch.row_mask, aux)
+        with batch_cost_scope(batch):
+            out_datas, out_valids, keep = kernel(
+                [c.data for c in batch.columns],
+                [c.validity for c in batch.columns], batch.row_mask, aux)
         schema = attrs_schema(self.output)
         cols = pipeline_columns(schema.fields, host_outs, out_datas,
                                 out_valids)
@@ -714,10 +720,11 @@ class ExchangeFusion:
             return jax.jit(kernel)
 
         kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build)
-        g_datas, g_valids, counts = kernel(
-            [c.data for c in batch.columns],
-            [c.validity for c in batch.columns], batch.row_mask, aux,
-            np.int32(start % num_out), self._bounds_dev)
+        with batch_cost_scope(batch):
+            g_datas, g_valids, counts = kernel(
+                [c.data for c in batch.columns],
+                [c.validity for c in batch.columns], batch.row_mask, aux,
+                np.int32(start % num_out), self._bounds_dev)
         fields = attrs_schema(self.pipe_attrs).fields
         gathered = []
         for i, f in enumerate(fields):
@@ -752,29 +759,6 @@ def _aggregate_fusable(agg: HashAggregateExec, compute: ComputeExec) -> bool:
         # string min/max fuses too: the reduce runs in rank space with
         # the rank + inverse-rank luts as kernel aux inputs
     return True
-
-
-def _range_sample_source(compute: ComputeExec, order_child):
-    """Input-column position usable to sample range bounds for a fused
-    range exchange: the sort key must PASS THROUGH the pipeline (bounds
-    are sampled from the pre-pipeline batches — a pre-filter superset of
-    the key domain, sound because any bound set partitions the domain
-    correctly, merely less evenly). Returns the input position or None."""
-    src_id = None
-    for o in compute.outputs:
-        if isinstance(o, AttributeReference) and o.expr_id == order_child.expr_id:
-            src_id = o.expr_id
-            break
-        if isinstance(o, Alias) and o.expr_id == order_child.expr_id \
-                and isinstance(o.child, AttributeReference):
-            src_id = o.child.expr_id
-            break
-    if src_id is None:
-        return None
-    for i, a in enumerate(compute.child.output):
-        if a.expr_id == src_id:
-            return i
-    return None
 
 
 def _exchange_fusable(exch, compute: ComputeExec, conf: SQLConf) -> bool:
@@ -812,7 +796,10 @@ def _exchange_fusable(exch, compute: ComputeExec, conf: SQLConf) -> bool:
                 or dict_encoded(a.dtype):
             # string pids ride a host rank→pid lut per dictionary
             return False
-        return _range_sample_source(compute, oc) is not None
+        # computed sort keys fuse too: bounds sample the POST-pipeline
+        # key column (physical/exchange._range_shuffle materializes the
+        # pipeline for the sampled batches only)
+        return True
     return False  # SinglePartition gathers without kernels
 
 

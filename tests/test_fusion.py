@@ -293,6 +293,60 @@ def test_exchange_fusion_range_differential(fusion_spark, spark):
     assert outs[True].equals(outs[False])
 
 
+def test_fused_range_bounds_sample_post_pipeline(fusion_spark, spark):
+    """Fused range-exchange bounds sample the POST-pipeline key column:
+    a selective filter no longer skews partition balance (pre-pipeline
+    sampling saw the full input domain, so every surviving row landed in
+    the top partition). Balance is read from the exchange's per-reducer
+    stats BEFORE AQE coalescing can mask the skew."""
+    from spark_tpu.physical.exchange import ShuffleExchangeExec
+
+    df = (spark.range(0, 30000, 1, 3)
+          .filter(F.col("id") >= 27000)
+          .withColumn("y", F.col("id") * 2)
+          .orderBy("id"))
+    plan = df.query_execution.physical
+    ex = next(n for n in plan.iter_nodes()
+              if isinstance(n, ShuffleExchangeExec))
+    assert ex.pipe_fusion is not None, plan.tree_string()
+    df.query_execution.execute()
+    sizes = [ex.last_stats[i] for i in sorted(ex.last_stats)]
+    assert sum(sizes) == 3000
+    # post-pipeline bounds split the SURVIVING domain: every reducer
+    # gets a share, none hoards the whole filtered range (pre-pipeline
+    # sampling put all 3000 rows in the last reducer)
+    assert all(s > 0 for s in sizes), sizes
+    assert max(sizes) <= 2 * (sum(sizes) / len(sizes)), sizes
+    # and the global sort is still correct
+    out = df.toPandas()
+    assert list(out["id"]) == list(range(27000, 30000))
+
+
+def test_fused_range_computed_key_fuses(fusion_spark, spark):
+    """A COMPUTED sort key no longer blocks exchange fusion: bounds
+    sample the pipeline output, so no pass-through input column is
+    needed — and the fused plan matches the unfused oracle."""
+    from spark_tpu.physical.exchange import ShuffleExchangeExec
+
+    def q():
+        return (spark.range(0, 20000, 1, 3)
+                .filter(F.col("id") % 3 != 0)
+                .select((F.col("id") * 2 + 1).alias("key2"))
+                .orderBy("key2"))
+
+    spark.conf.set("spark.tpu.fusion.enabled", "true")
+    plan = q().query_execution.physical
+    ex = next(n for n in plan.iter_nodes()
+              if isinstance(n, ShuffleExchangeExec))
+    assert ex.pipe_fusion is not None, plan.tree_string()
+    outs = {}
+    for enabled in (True, False):
+        spark.conf.set("spark.tpu.fusion.enabled", str(enabled).lower())
+        outs[enabled] = q().toPandas().reset_index(drop=True)
+    spark.conf.unset("spark.tpu.fusion.enabled")
+    assert outs[True].equals(outs[False])
+
+
 def test_exchange_fused_single_dispatch_per_map_batch(fusion_spark, spark):
     """Acceptance: a scan→filter→project→shuffle-write map stage executes
     as ONE fused dispatch per input batch — no separate pipeline launch,
