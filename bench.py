@@ -89,6 +89,17 @@ MESH = "--mesh" in sys.argv
 if MESH:
     sys.argv = [a for a in sys.argv if a != "--mesh"]
 
+# --encoded: add the compressed-execution config (columnar/encoding.py):
+# a dictionary-heavy filter→repartition(string key)→group-by(string) whose
+# encoded path groups directly on dictionary codes, fuses string pids via
+# dict-hash luts, and ships codes + dictionaries through the shuffle.
+# Reports shuffle bytes moved and hbm_gbps encoded vs decoded
+# (spark.tpu.encoding.enabled=false oracle). `python bench.py encoded`
+# also selects it directly.
+ENCODED = "--encoded" in sys.argv
+if ENCODED:
+    sys.argv = [a for a in sys.argv if a != "--encoded"]
+
 
 # per-config predicted peak HBM (plan_lint memory model) captured by
 # _maybe_analyze so the timed record can print predicted vs measured
@@ -579,6 +590,103 @@ def bench_mesh():
 
 
 # --------------------------------------------------------------------------
+# #3d compressed execution: dictionary/RLE-native kernels + code shuffle
+# --------------------------------------------------------------------------
+
+def bench_encoded():
+    """Dictionary-heavy filter→hash-repartition(string key)→group-by
+    (string key)→sum: the compressed-execution scoreboard. Encoded
+    (spark.tpu.encoding.enabled, default on): the aggregate groups
+    directly on dictionary codes (dense-on-codes, no sort, no range
+    probe), the fused map dispatch computes string pids from the padded
+    dict-hash lut inside the stage kernel, and the shuffle ships int32
+    codes + shared dictionary references. Decoded oracle (off): hashed
+    eq-key staging, sorted-segment grouping. vs_baseline is the speedup
+    over the oracle; the record carries shuffle bytes moved and hbm_gbps
+    both ways. Partition count 5 keeps the exchange on the host path."""
+    import pyarrow as pa
+
+    import spark_tpu.api.functions as F  # noqa: F401
+
+    n_rows = int(20_000_000 * SCALE)
+    session = _session({"spark.tpu.batch.capacity": 1 << 22,
+                        "spark.tpu.fusion.minRows": "0"})
+    rng = np.random.default_rng(31)
+    # long repeated strings: the decoded wire format pays them per row
+    cats = [f"category-{i:04d}-with-a-long-repeated-name" for i in
+            range(4096)]
+    codes = rng.integers(0, len(cats), n_rows)
+    table = pa.table({
+        "s": pa.DictionaryArray.from_arrays(
+            pa.array(codes, type=pa.int32()), pa.array(cats)),
+        "v": rng.integers(0, 1000, n_rows).astype(np.int64),
+    })
+    df = _df_from_table(session, table, "encoded_bench")
+
+    def q():
+        return (df.filter(F.col("v") > 25)
+                .repartition(5, "s")
+                .groupBy("s").agg(F.sum("v").alias("sv")))
+
+    _maybe_analyze(q, "encoded")
+    results = {}
+    for mode, flag in (("encoded", "true"), ("decoded", "false")):
+        session.conf.set("spark.tpu.encoding.enabled", flag)
+        best = _best_of(lambda: _run_blocked(q()))
+        results[mode] = (best,
+                         _hbm_fields(f"encoded[{mode}]", best, n_rows * 12))
+    session.conf.unset("spark.tpu.encoding.enabled")
+
+    # wire bytes: the CLUSTER block format is where codes + one dict per
+    # map task beat decoded row values (the local path shares host
+    # buffers either way) — a 2-worker process cluster at bounded scale
+    # measures the pickled block sizes (MapStatus bytes) both ways
+    wire = {}
+    wn = min(n_rows, 500_000)
+    wtable = table.slice(0, wn)
+    for mode, flag in (("encoded", "true"), ("decoded", "false")):
+        from spark_tpu.api.session import TpuSession
+        from spark_tpu.exec.cluster import LocalCluster
+
+        s2 = TpuSession(f"bench-encoded-wire-{mode}", {
+            "spark.sql.shuffle.partitions": "3",
+            "spark.tpu.batch.capacity": 1 << 18,
+            "spark.sql.adaptive.enabled": "false",
+            "spark.tpu.fusion.minRows": "0",
+            "spark.tpu.encoding.enabled": flag,
+        })
+        s2.attachSqlCluster(LocalCluster(num_workers=2))
+        try:
+            wdf = s2.createDataFrame(wtable)
+            (wdf.filter(F.col("v") > 25).repartition(3, "s")
+             .groupBy("s").agg(F.sum("v").alias("sv")).toArrow())
+            wire[mode] = s2._metrics.snapshot()["counters"].get(
+                "shuffle.bytes_written", 0)
+        finally:
+            s2.stop()
+
+    best_enc, hbm_enc = results["encoded"]
+    best_dec, hbm_dec = results["decoded"]
+    rate = n_rows / best_enc
+    return {
+        "metric": "compressed execution filter+repartition(5,s)+groupBy(s) "
+                  f"{n_rows:.0e} rows, 4096-entry dictionary (dense-on-"
+                  "codes agg + fused dict-hash pids + code-shipping "
+                  "shuffle; vs_baseline = speedup over the decoded oracle)",
+        "value": round(rate / 1e6, 2),
+        "unit": "M rows/s",
+        "vs_baseline": round(best_dec / best_enc, 3),
+        **{k: v for k, v in hbm_enc.items()},
+        "hbm_gbps_decoded": hbm_dec.get("hbm_gbps"),
+        "shuffle_wire_bytes_encoded": int(wire["encoded"]),
+        "shuffle_wire_bytes_decoded": int(wire["decoded"]),
+        "shuffle_wire_bytes_ratio": round(
+            wire["encoded"] / wire["decoded"], 3)
+        if wire["decoded"] else None,
+    }
+
+
+# --------------------------------------------------------------------------
 # #4/#5 TPC-DS q3 / q7 / q19 wall-clock at SF1-equivalent volume
 # --------------------------------------------------------------------------
 
@@ -681,6 +789,7 @@ CONFIGS = {
     "join": bench_join,
     "shuffle": bench_shuffle,
     "mesh": bench_mesh,
+    "encoded": bench_encoded,
     "tpcds": bench_tpcds,
 }
 
@@ -714,7 +823,8 @@ def _fallback_to_cpu_child() -> int:
     flags = [f for f, on in (("--analyze", ANALYZE), ("--trace", TRACE),
                              ("--cluster", CLUSTER),
                              ("--progress", PROGRESS),
-                             ("--mesh", MESH)) if on]
+                             ("--mesh", MESH),
+                             ("--encoded", ENCODED)) if on]
     try:  # stdout inherited: child lines flush straight to the driver
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__)]
@@ -743,7 +853,8 @@ def main() -> int:
 
     default = [c for c in CONFIGS
                if not (SMOKE and c == "tpcds")
-               and (MESH or c != "mesh")]  # mesh config is opt-in
+               and (MESH or c != "mesh")       # mesh config is opt-in
+               and (ENCODED or c != "encoded")]  # encoded too
     only = sys.argv[1:] or default
     records, failed = [], []
     for name in only:

@@ -34,6 +34,10 @@ echo "== mesh gate (SPMD stage fusion on the 8-device virtual mesh) =="
 JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     python dev/validate_trace.py --mesh
 
+echo "== encoded gate (compressed execution: dict-native kernels, code shuffle) =="
+JAX_PLATFORMS=cpu python dev/validate_trace.py --encoded
+python bench.py --smoke --encoded encoded
+
 echo "== micro-benchmarks =="
 python benchmarks/run_benchmarks.py --rows "${BENCH_ROWS:-2000000}"
 

@@ -52,7 +52,17 @@ Four checks, all hard failures:
    cache, and a balanced device ledger afterwards. Self-contained:
    `validate_trace.py --mesh` with no trace path runs only this gate.
 
-Usage: python dev/validate_trace.py [--cluster] [--live] [--mesh] [<trace.json>]
+6. Encoded gate (--encoded) — compressed execution: a dictionary-heavy
+   string-keyed repartition + group-by must produce byte-identical
+   results encoded vs decoded (spark.tpu.encoding.enabled
+   differential), predict launch counts exactly on the encoded path
+   (dense-on-codes, zero krange3 probes) fusion on and off, and show
+   zero unexplained EXPLAIN ANALYZE drift. Self-contained:
+   `validate_trace.py --encoded` with no trace path runs only this
+   gate.
+
+Usage: python dev/validate_trace.py [--cluster] [--live] [--mesh]
+       [--encoded] [<trace.json>]
 """
 
 import json
@@ -472,16 +482,105 @@ def mesh_gate() -> None:
         session.stop()
 
 
+def encoded_gate() -> None:
+    """Compressed-execution drift gate (--encoded): a dictionary-heavy
+    string-keyed repartition + group-by must (1) produce byte-identical
+    results encoded vs decoded (spark.tpu.encoding.enabled differential),
+    (2) predict its launch counts EXACTLY on the encoded path — dense-on-
+    codes aggregation with ZERO krange3 probes, fused string pids —
+    fusion on AND off, and (3) show zero unexplained EXPLAIN ANALYZE
+    drift. Self-contained: no trace path required."""
+    import numpy as np
+    import pyarrow as pa
+
+    import spark_tpu.api.functions as F
+    from spark_tpu import TpuSession
+    from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+    session = TpuSession("encoded-gate", {
+        "spark.tpu.batch.capacity": 1 << 12,
+        "spark.sql.shuffle.partitions": 5,
+        "spark.tpu.fusion.minRows": "0",
+        "spark.tpu.ui.operatorMetrics": "true",
+    })
+    try:
+        rng = np.random.default_rng(31)
+        n = 6000
+        session.createDataFrame(pa.table({
+            "s": [None if i % 29 == 0 else f"cat{i % 23}"
+                  for i in range(n)],
+            "v": rng.integers(-20, 80, n),
+        })).createOrReplaceTempView("enc_gate_t")
+
+        def q():
+            return (session.sql("select s, v from enc_gate_t where v > 0")
+                    .repartition(5, "s").groupBy("s")
+                    .agg(F.sum("v").alias("sv")))
+
+        outs = {}
+        for flag in ("true", "false"):
+            session.conf.set("spark.tpu.encoding.enabled", flag)
+            outs[flag] = (q().toPandas().sort_values("s", na_position="last")
+                          .reset_index(drop=True))
+        session.conf.unset("spark.tpu.encoding.enabled")
+        if not outs["true"].equals(outs["false"]):
+            fail("--encoded: encoded results differ from the decoded "
+                 "oracle (dictionary-native kernels changed answers)")
+
+        for fusion in ("true", "false"):
+            session.conf.set("spark.tpu.fusion.enabled", fusion)
+            report = q().query_execution.analysis_report()
+            if not report.exact:
+                fail(f"--encoded: plan not exactly predicted (fusion="
+                     f"{fusion}): {report.inexact_reasons}")
+            if report.predicted_launches.get("krange3"):
+                fail("--encoded: dictionary grouping key predicted a "
+                     "krange3 probe — the code-domain decision regressed")
+            q().toArrow()  # warm
+            before = dict(KC.launches_by_kind)
+            q().toArrow()
+            measured = {k: v - before.get(k, 0)
+                        for k, v in KC.launches_by_kind.items()
+                        if v != before.get(k, 0)}
+            if report.predicted_launches != measured:
+                fail(f"--encoded: predicted {report.predicted_launches} "
+                     f"!= measured {measured} (fusion={fusion})")
+            if measured.get("gagg"):
+                fail("--encoded: string group-by took the sort path "
+                     f"(fusion={fusion}): {measured} — dense-on-codes "
+                     "regressed")
+        session.conf.unset("spark.tpu.fusion.enabled")
+
+        report = q().query_execution.analyzed_report()
+        errors = [f for f in report.findings if f["severity"] == "error"]
+        if errors:
+            print(report.render())
+            fail("--encoded: EXPLAIN ANALYZE reported unexplained drift "
+                 "on the encoded path: "
+                 + "; ".join(f["msg"] for f in errors))
+        print("validate_trace: encoded gate OK — encoded == decoded, "
+              f"{sum(report.measured.values())} launches predicted "
+              "exactly fusion on/off, 0 krange3 probes on the "
+              "dictionary key")
+    finally:
+        session.stop()
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     cluster = "--cluster" in argv
     live = "--live" in argv
     mesh = "--mesh" in argv
-    argv = [a for a in argv if a not in ("--cluster", "--live", "--mesh")]
-    if mesh and not argv:
-        # self-contained leg: the gate generates and validates its own
-        # trace (dev/run_all.sh runs it under an 8-device CPU mesh env)
-        mesh_gate()
+    encoded = "--encoded" in argv
+    argv = [a for a in argv if a not in ("--cluster", "--live", "--mesh",
+                                         "--encoded")]
+    if (mesh or encoded) and not argv:
+        # self-contained legs: these gates generate and validate their
+        # own state (dev/run_all.sh runs them without a trace file)
+        if mesh:
+            mesh_gate()
+        if encoded:
+            encoded_gate()
         print("validate_trace: PASS")
         return 0
     if len(argv) != 1:
@@ -494,6 +593,8 @@ def main(argv=None) -> int:
         live_gate()
     if mesh:
         mesh_gate()
+    if encoded:
+        encoded_gate()
     print("validate_trace: PASS")
     return 0
 

@@ -29,7 +29,8 @@ predictions are EXACT and tests/test_plan_analysis.py asserts them against
 the measured KernelCache launch counters, fusion on and off.
 
 Kernel-kind legend (KernelCache key tags): pipeline, fused_agg, uagg/dagg/
-gagg, krange3 (dense-range scalar probe), fused_limit, limit, sort,
+gagg, ragg (sorted-run RLE segment reduce — no grouping sort),
+krange3 (dense-range scalar probe), fused_limit, limit, sort,
 join_build/join_probe, fused_probe, djoin_build/djoin_probe,
 fused_djoin_probe, shuffle_pids/shuffle_hash/shuffle_rr/shuffle_range,
 fused_shuffle (exchange map side fused with its pipeline), mesh_stage
@@ -49,8 +50,8 @@ from ..columnar.batch import bucket_capacity
 from ..config import (
     ADAPTIVE_ENABLED, ADVISORY_PARTITION_BYTES, AGG_BLOCK_ROWS,
     BATCH_CAPACITY, BLOOM_JOIN_FILTER, COALESCE_PARTITIONS_ENABLED,
-    FUSION_DENSE_KEYS, FUSION_ENABLED, FUSION_EXCHANGE, FUSION_MESH,
-    FUSION_MIN_ROWS, MESH_ENABLED, MINMAX_JOIN_FILTER, SQLConf,
+    ENCODING_ENABLED, FUSION_DENSE_KEYS, FUSION_ENABLED, FUSION_EXCHANGE,
+    FUSION_MESH, FUSION_MIN_ROWS, MESH_ENABLED, MINMAX_JOIN_FILTER, SQLConf,
 )
 from ..expr.expressions import (
     Alias, AttributeReference, EqualTo, GreaterThan, GreaterThanOrEqual, In,
@@ -82,6 +83,10 @@ class _Batch:
     # a frozenset holds the expr ids the exchange actually accumulates
     # (ShuffleExchangeExec.stat_cols — plan-reachable dense candidates)
     seeded: "bool | frozenset" = False
+    # host-ingested tile (columnar/arrow ingest or shuffle rebuild):
+    # integral columns carry RunInfo metadata, so the sorted-run (ragg)
+    # aggregate variant is reachable — kernel outputs drop it
+    ingest: bool = False
 
     def probe_free_for(self, expr_id) -> bool:
         """No krange3 dispatch when THIS column's range is consulted:
@@ -98,6 +103,16 @@ class _Trace:
     cols: dict            # expr_id -> (np values, np validity | None)
     live: np.ndarray      # row mask after the traced filter chain
     consecutive: bool = True   # rows still slice into batches in order
+    # encoding model: expr_id -> tuple of dictionary values in DICT ORDER
+    # for columns whose runtime dictionary covers more than the traced
+    # rows (join gathers keep the FULL build dictionary; agg/shuffle
+    # outputs keep merged input dictionaries). Absent entries derive the
+    # domain from the value slice itself — the appearance-order distinct
+    # pyarrow's dictionary_encode produces at ingest — but only while
+    # `dict_derivable` holds (row subsets break the derivation: the
+    # runtime dictionary still covers the DROPPED rows' values)
+    dict_domains: dict = field(default_factory=dict)
+    dict_derivable: bool = True
 
     def stats(self, expr_id):
         """(values_under_live_and_valid,) or None."""
@@ -114,13 +129,15 @@ class _Trace:
         m = self.live
         cols = {k: (v[m], None if val is None else val[m])
                 for k, (v, val) in self.cols.items()}
-        return _Trace(cols, np.ones(int(m.sum()), bool), self.consecutive)
+        return _Trace(cols, np.ones(int(m.sum()), bool), self.consecutive,
+                      dict(self.dict_domains), False)
 
     def select(self, sel: np.ndarray, consecutive: bool) -> "_Trace":
         """Row subset (over an already-compacted trace)."""
         cols = {k: (v[sel], None if val is None else val[sel])
                 for k, (v, val) in self.cols.items()}
-        return _Trace(cols, np.ones(len(sel), bool), consecutive)
+        return _Trace(cols, np.ones(len(sel), bool), consecutive,
+                      dict(self.dict_domains), False)
 
 
 @dataclass
@@ -177,15 +194,38 @@ def _np_mix64(x: np.ndarray) -> np.ndarray:
     return x
 
 
+def _np_eq_lane(vals: np.ndarray, valid) -> np.ndarray:
+    """Host mirror of Column.eq_keys as a uint64 hash lane: numeric
+    columns cast to int64; STRING columns map each value to its stable
+    dictionary hash (the same StringDict.hashes the runtime lut holds,
+    native or blake2b — codes → value hashes is exactly what the padded
+    dict-hash aux table computes inside the trace). Null rows get lane 0:
+    hash_columns replaces them with the null tag regardless."""
+    vals = np.asarray(vals)
+    if vals.dtype != object:
+        return vals.astype(np.int64).view(np.uint64)
+    from ..columnar.batch import StringDict
+
+    if not len(vals):
+        return np.zeros(0, np.uint64)
+    # vectorized: hash each distinct value once, scatter by inverse index
+    # (traced string columns hold only str — nulls are "" placeholders).
+    # Invalid rows' lanes are irrelevant: the hash mirror replaces them
+    # with the null tag.
+    uniq, inv = np.unique(vals, return_inverse=True)
+    hashes = StringDict([str(u) for u in uniq]).hashes
+    return hashes[inv].view(np.uint64)
+
+
 def _np_hash_pids(cols: list, num_out: int, seed: int = 42) -> np.ndarray:
-    """Partition ids of traced (int64-able) key columns — the host-side
-    hash of traced keys that lets multi-stage shuffle plans predict
-    exactly. Mirrors hash_columns + partition_ids: splitmix64 lanes, null
-    tags, 31x + golden combine, nonlinear seed fold, pmod."""
+    """Partition ids of traced key columns — the host-side hash of traced
+    keys that lets multi-stage shuffle plans predict exactly. Mirrors
+    hash_columns + partition_ids: eq-key lanes (int64 casts; string
+    values via their dictionary hashes), splitmix64 lanes, null tags,
+    31x + golden combine, nonlinear seed fold, pmod."""
     h = None
     for i, (vals, valid) in enumerate(cols):
-        lane = np.asarray(vals).astype(np.int64).view(np.uint64)
-        k = _np_mix64(lane)
+        k = _np_mix64(_np_eq_lane(vals, valid))
         if valid is not None:
             null_tag = _np_mix64(
                 np.asarray(0x6E756C6C + i, np.int64).view(np.uint64))
@@ -357,6 +397,7 @@ class _Analyzer:
         self._fusion_mesh = bool(conf.get(FUSION_MESH))
         self._min_rows = int(conf.get(FUSION_MIN_ROWS))
         self._dense_keys = bool(conf.get(FUSION_DENSE_KEYS))
+        self._encoding = bool(conf.get(ENCODING_ENABLED))
         self._tile = int(conf.get(BATCH_CAPACITY))
         # memory model state: the stage entry each node produced (so the
         # OUTPUT flow recorded after the handler returns can annotate it)
@@ -407,7 +448,11 @@ class _Analyzer:
     # -- entry -------------------------------------------------------------
     def run(self, plan) -> AnalysisReport:
         self.visit(plan)
-        self.report.predicted_launches = dict(self.predicted)
+        # zero-count kinds (a probe that never fires on this plan) are
+        # bookkeeping, not predictions — the measured delta never lists
+        # them either
+        self.report.predicted_launches = {
+            k: v for k, v in self.predicted.items() if v}
         if self._hbm_any:
             self.report.predicted_peak_hbm = self._hbm_total
         self._explain_boundaries(plan)
@@ -504,12 +549,22 @@ class _Analyzer:
     # -- scans -------------------------------------------------------------
     def _batches_for_rows(self, n: int) -> list:
         if n == 0:
-            return [_Batch(0, _EMPTY_CAP, True)]
+            return [_Batch(0, _EMPTY_CAP, True, ingest=True)]
         out = []
         for start in range(0, n, self._tile):
             rows = min(self._tile, n - start)
-            out.append(_Batch(rows, bucket_capacity(rows), True))
+            out.append(_Batch(rows, bucket_capacity(rows), True,
+                              ingest=True))
         return out
+
+    @staticmethod
+    def _is_traced_string(t) -> bool:
+        import pyarrow as pa
+
+        return (pa.types.is_string(t) or pa.types.is_large_string(t)
+                or (pa.types.is_dictionary(t)
+                    and (pa.types.is_string(t.value_type)
+                         or pa.types.is_large_string(t.value_type))))
 
     def _local_scan(self, node) -> _Flow:
         import pyarrow as pa
@@ -521,19 +576,90 @@ class _Analyzer:
             names = {a.name: a for a in node.attrs}
             for fld in table.schema:
                 a = names.get(fld.name)
-                if a is None or not pa.types.is_integer(fld.type):
+                if a is None:
                     continue
-                arr = table.column(fld.name)
-                if isinstance(arr, pa.ChunkedArray):
-                    arr = arr.combine_chunks()
-                valid = np.asarray(arr.is_valid()) if arr.null_count else None
-                vals = np.asarray(arr.fill_null(0))
-                cols[a.expr_id] = (vals, valid)
+                if pa.types.is_integer(fld.type):
+                    arr = table.column(fld.name)
+                    if isinstance(arr, pa.ChunkedArray):
+                        arr = arr.combine_chunks()
+                    valid = np.asarray(arr.is_valid()) \
+                        if arr.null_count else None
+                    vals = np.asarray(arr.fill_null(0))
+                    cols[a.expr_id] = (vals, valid)
+                elif self._is_traced_string(fld.type):
+                    # encoding model: string values trace as object
+                    # arrays — dictionary domains (dense-on-codes
+                    # cardinality) and eq-key hash lanes derive from them
+                    arr = table.column(fld.name)
+                    if isinstance(arr, pa.ChunkedArray):
+                        arr = arr.combine_chunks()
+                    valid = np.asarray(arr.is_valid()) \
+                        if arr.null_count else None
+                    vals = np.empty(n, dtype=object)
+                    vals[:] = ["" if v is None else v
+                               for v in arr.to_pylist()]
+                    cols[a.expr_id] = (vals, valid)
         trace = _Trace(cols, np.ones(n, bool)) if cols else None
         flow = _Flow([self._batches_for_rows(n)], trace)
         self._stage(node, Counter(), flow.total_batches,
                     [f"{n} rows, device-cached (stable identity)"])
         return flow
+
+    # -- encoding model helpers ---------------------------------------------
+    def _trace_domain(self, trace: Optional[_Trace], expr_id,
+                      lo=None, hi=None):
+        """Ordered dictionary domain of a traced string column over row
+        span [lo, hi) (None = whole trace): the EXPLICIT domain when one
+        is recorded (join/agg/shuffle outputs whose runtime dictionary
+        covers more than the traced rows — slice-independent), else the
+        appearance-order distinct of non-null values over the span,
+        mirroring pyarrow dictionary_encode at ingest."""
+        if trace is None:
+            return None
+        dom = trace.dict_domains.get(expr_id)
+        if dom is not None:
+            return dom
+        if not trace.dict_derivable:
+            return None
+        ent = trace.cols.get(expr_id)
+        if ent is None or ent[0].dtype != object:
+            return None
+        vals, valid = ent
+        sl = slice(lo, hi)
+        v = vals[sl]
+        m = np.ones(len(v), bool) if valid is None else valid[sl]
+        live = v[m]
+        if not len(live):
+            return ()
+        # appearance-order distinct, vectorized: unique + first-index sort
+        uniq, first = np.unique(live, return_index=True)
+        return tuple(uniq[np.argsort(first)])
+
+    def _chunk_dict_domain(self, trace: Optional[_Trace], batches,
+                           expr_id):
+        """Merged dictionary domain of one aggregation chunk (its batches
+        concat and unify dictionaries): the explicit per-partition
+        domain, or the derived domain when the chunk covers the whole
+        traced partition."""
+        if trace is None:
+            return None
+        dom = trace.dict_domains.get(expr_id)
+        if dom is not None:
+            return dom
+        rows = [b.rows for b in batches]
+        if all(r is not None for r in rows) \
+                and sum(rows) == len(trace.live):
+            return self._trace_domain(trace, expr_id)
+        return None
+
+    @staticmethod
+    def _ordered_union(domains) -> tuple:
+        seen: dict = {}
+        for dom in domains:
+            for v in dom:
+                if v not in seen:
+                    seen[v] = None
+        return tuple(seen)
 
     def _scan(self, node) -> _Flow:
         nparts = node.source.num_partitions()
@@ -593,15 +719,21 @@ class _Analyzer:
                 return None
             live &= m
         cols = {}
+        domains = {}
         for o in outputs:
             if isinstance(o, AttributeReference):
                 if o.expr_id in trace.cols:
                     cols[o.expr_id] = trace.cols[o.expr_id]
+                if o.expr_id in trace.dict_domains:
+                    domains[o.expr_id] = trace.dict_domains[o.expr_id]
             elif isinstance(o, Alias) and isinstance(o.child,
                                                      AttributeReference):
                 if o.child.expr_id in trace.cols:
                     cols[o.expr_id] = trace.cols[o.child.expr_id]
-        return _Trace(cols, live, trace.consecutive)
+                if o.child.expr_id in trace.dict_domains:
+                    domains[o.expr_id] = trace.dict_domains[o.child.expr_id]
+        return _Trace(cols, live, trace.consecutive, domains,
+                      trace.dict_derivable)
 
     def _project_ptraces(self, child: _Flow, filters, outputs):
         if child.ptraces is None:
@@ -684,6 +816,11 @@ class _Analyzer:
                     kinds["uperc"] += 1
             return _Batch(1, 8, False), None
 
+        if self._encoding and not has_pc and len(node.grouping) == 1 \
+                and isinstance(node.grouping[0].dtype, StringType):
+            return self._dict_agg_chunk(node, batches, trace, cap, kinds,
+                                        notes)
+
         single_int_key = len(node.grouping) == 1 and isinstance(
             node.grouping[0].dtype, (IntegralType, DateType))
         kid = node.grouping[0].expr_id if single_int_key else None
@@ -719,6 +856,13 @@ class _Analyzer:
                 "cache key)")
         if dense:
             kinds["dagg"] += 1
+        elif self._ragg_applies(batches, trace, single_int_key, has_pc,
+                                node.grouping[0].expr_id
+                                if single_int_key else None):
+            kinds["ragg"] += 1
+            notes.append("sorted-run RLE fast path: ingest RunInfo says "
+                         "the key is already sorted — segment reduce per "
+                         "run boundary, no grouping sort")
         else:
             kinds["gagg"] += 1
         for op, _, _ in vals:
@@ -737,6 +881,102 @@ class _Analyzer:
                     self._agg_out_trace(node.grouping[0].expr_id, uniq,
                                         nulls_live))
         return _Batch(None, None, False), None
+
+    def _ragg_applies(self, batches, trace, single_int_key: bool,
+                      has_pc: bool, kid) -> bool:
+        """Mirror of HashAggregateExec._try_run_sorted: the sorted-run
+        (RLE) aggregate runs when the dense path declined, the chunk is
+        ONE host-ingested tile (concat of several drops RunInfo), the key
+        has no validity plane, and its values are non-decreasing over the
+        tile's rows (ingest sortedness survives mask-only filters)."""
+        if not self._encoding or not single_int_key or has_pc:
+            return False
+        from ..columnar.encoding import runs_harvest_enabled
+
+        if not runs_harvest_enabled():
+            # tiles ingested by this process carry no RunInfo (session
+            # started under the decoded oracle) — ragg is unreachable
+            return False
+        if len(batches) != 1 or not batches[0].ingest:
+            return False
+        b = batches[0]
+        if trace is None or b.rows is None or b.rows != len(trace.live):
+            return False
+        ent = trace.cols.get(kid)
+        if ent is None:
+            return False
+        vals, valid = ent
+        if valid is not None or vals.dtype == object:
+            return False
+        n = b.rows
+        return bool(n > 0 and (np.diff(vals[:n]) >= 0).all())
+
+    def _dict_agg_chunk(self, node, batches, trace, cap, kinds: Counter,
+                        notes: list):
+        """Single dictionary-encoded (string) grouping key: the int32
+        codes ARE a dense group domain [0, len(dict)) and the runtime
+        decides dense-on-codes from len(dictionary) HOST-SIDE — no
+        krange3 probe ever (compressed execution). The model needs the
+        dictionary cardinality (traced domain) only for the dense-fit
+        check and the output layout."""
+        kid = node.grouping[0].expr_id
+        name = node.grouping[0].name
+        dom = self._chunk_dict_domain(trace, batches, kid)
+        if dom is None:
+            self._approx(f"dense-on-codes aggregation over {name}: "
+                         "dictionary cardinality untraced")
+            dense = True  # the overwhelmingly common runtime outcome
+        elif cap is None:
+            # tile capacities always bucket to >= _EMPTY_CAP, so a small
+            # dictionary fits the dense table regardless of the actual
+            # (unknown) capacity — the decision stays EXACT
+            if len(dom) + 1 <= 4 * _EMPTY_CAP:
+                dense = True
+            else:
+                self._approx(f"dense-on-codes fit for {name} needs tile "
+                             "capacities (unknown)")
+                dense = True
+        else:
+            dense = len(dom) + 1 <= min(4 * cap, _DENSE_AGG_LIMIT)
+        kinds["dagg" if dense else "gagg"] += 1
+        note = ("dictionary-encoded grouping key: codes are a dense "
+                "group domain — len(dictionary) decides host-side, no "
+                "krange3 probe")
+        if note not in notes:
+            notes.append(note)
+        self._hazard(
+            f"aggregate on {name}: the dense-on-codes kernel's output "
+            "capacity derives from the dictionary cardinality — "
+            "dictionary growth across batches recompiles "
+            "(value-dependent cache key)")
+        if dom is None or not dense:
+            return _Batch(None, None, False), None
+        out_cap = bucket_capacity(len(dom) + 1)
+        ent = trace.cols.get(kid)
+        if ent is None:
+            # cardinality known (explicit domain) but row values are not
+            # traced: the layout stays unknown while the DOMAIN still
+            # propagates — a downstream final aggregate can keep
+            # deciding dense-on-codes exactly
+            return (_Batch(None, out_cap, False),
+                    _Trace({}, np.zeros(0, bool), True, {kid: dom},
+                           False))
+        vals, valid = ent
+        m = trace.live if valid is None else (trace.live & valid)
+        live_set = set(vals[m])
+        live_vals = [v for v in dom if v in live_set]
+        nulls_live = bool(valid is not None
+                          and (trace.live & ~valid).any())
+        rows = len(live_vals) + (1 if nulls_live else 0)
+        ovals = np.empty(rows, dtype=object)
+        ovals[: len(live_vals)] = live_vals
+        ovalid = None
+        if nulls_live:
+            ovals[-1] = ""
+            ovalid = np.append(np.ones(len(live_vals), bool), False)
+        out_trace = _Trace({kid: (ovals, ovalid)}, np.ones(rows, bool),
+                           True, {kid: dom}, False)
+        return _Batch(rows, out_cap, False), out_trace
 
     def _merge_group_traces(self, traces: list) -> Optional[_Trace]:
         """Concatenate compacted per-partition traces (coalesced groups:
@@ -759,9 +999,21 @@ class _Analyzer:
                     [np.ones(len(t.live), bool) if v is None else v
                      for t, v in zip(comp, vs)])
             cols[k] = (vals, valid)
+        # merged dictionary domains: concat unifies dictionaries in
+        # partition order (first-appearance union)
+        domains = {}
+        dom_ids = set()
+        for t in traces:
+            dom_ids |= set(t.dict_domains)
+        dom_ids |= {k for k in ids if comp[0].cols[k][0].dtype == object}
+        for k in dom_ids:
+            per = [self._trace_domain(t, k) for t in traces]
+            if all(d is not None for d in per):
+                domains[k] = self._ordered_union(per)
         n = sum(len(t.live) for t in comp)
         return _Trace(cols, np.ones(n, bool),
-                      all(t.consecutive for t in traces))
+                      all(t.consecutive for t in traces),
+                      domains, False)
 
     def _agg(self, node) -> _Flow:
         from ..physical.adaptive import plan_merge_groups, _row_width
@@ -851,6 +1103,8 @@ class _Analyzer:
         notes = []
         single_int_key = len(node.grouping) == 1 and isinstance(
             node.grouping[0].dtype, (IntegralType, DateType))
+        single_dict_key = self._encoding and len(node.grouping) == 1 \
+            and isinstance(node.grouping[0].dtype, StringType)
         key_passthrough = single_int_key and any(
             isinstance(o, AttributeReference)
             and o.expr_id == node.grouping[0].expr_id
@@ -899,15 +1153,36 @@ class _Analyzer:
                 if fresh_in == 0:
                     notes.append("dense-range decision memoized/seeded per "
                                  "input column (no per-run host sync)")
+            if single_dict_key and self._dense_keys:
+                note = ("dictionary-encoded grouping key: dense-on-codes "
+                        "decided in-kernel from the host-pass dictionary "
+                        "— no krange3 probe")
+                if note not in notes:
+                    notes.append(note)
             dense = key_passthrough and self._dense_keys \
                 and key_span is not None \
                 and all(c is not None for c in caps) and caps \
                 and key_span + 1 <= min(4 * min(caps), _DENSE_AGG_LIMIT)
+            # per-batch dictionary domains (slice-derived or explicit):
+            # the fused dense-on-codes variant keys its output capacity
+            # on len(batch dictionary)
+            dict_doms = None
+            if single_dict_key and pipe_trace is not None \
+                    and all(b.rows is not None for b in p):
+                kid = node.grouping[0].expr_id
+                dict_doms, r0 = [], 0
+                for b in p:
+                    dict_doms.append(self._trace_domain(
+                        pipe_trace, kid, r0, r0 + b.rows))
+                    r0 += b.rows
+                if r0 != len(pipe_trace.live) \
+                        or any(d is None for d in dict_doms):
+                    dict_doms = None
             if len(p) > 1:
                 # per-batch partials merge with final-mode ops; the partial
                 # output capacity mirrors the fused kernel variant
                 pcaps = []
-                for b in p:
+                for bi, b in enumerate(p):
                     if not node.grouping:
                         pcaps.append(8)
                     elif key_passthrough and self._dense_keys \
@@ -915,12 +1190,31 @@ class _Analyzer:
                             and key_span + 1 <= min(4 * b.cap,
                                                     _DENSE_AGG_LIMIT):
                         pcaps.append(bucket_capacity(key_span + 1))
+                    elif single_dict_key and self._dense_keys \
+                            and dict_doms is not None \
+                            and b.cap is not None \
+                            and len(dict_doms[bi]) + 1 <= min(
+                                4 * b.cap, _DENSE_AGG_LIMIT):
+                        pcaps.append(
+                            bucket_capacity(len(dict_doms[bi]) + 1))
                     else:
                         pcaps.append(b.cap)
                 merge = HashAggMergeProxy(node)
+                merge_trace = pipe_trace
+                if single_dict_key and pipe_trace is not None:
+                    # the merged partials' dictionary is the union of the
+                    # per-batch dictionaries = the partition-wide domain
+                    kid = node.grouping[0].expr_id
+                    dom_p = self._trace_domain(pipe_trace, kid)
+                    if dom_p is not None:
+                        merge_trace = _Trace(
+                            pipe_trace.cols, pipe_trace.live,
+                            pipe_trace.consecutive,
+                            {**pipe_trace.dict_domains, kid: dom_p},
+                            False)
                 ob, ot = self._agg_chunk_kinds(
                     merge, [_Batch(None, c, False) for c in pcaps],
-                    pipe_trace, kinds, notes)
+                    merge_trace, kinds, notes)
                 notes.append(f"{len(p)} per-batch partials merge with "
                              "final-mode ops")
                 out_parts.append([ob])
@@ -930,6 +1224,26 @@ class _Analyzer:
             if not node.grouping:
                 out_parts.append([_Batch(1, 8, False)])
                 out_traces.append(None)
+                continue
+            if single_dict_key:
+                kid = node.grouping[0].expr_id
+                dom = dict_doms[0] if dict_doms else None
+                dense_d = self._dense_keys and dom is not None \
+                    and caps and caps[0] is not None \
+                    and len(dom) + 1 <= min(4 * caps[0], _DENSE_AGG_LIMIT)
+                if dense_d:
+                    fake = Counter()
+                    ob, ot = self._dict_agg_chunk(
+                        node, p, _Trace(
+                            pipe_trace.cols, pipe_trace.live,
+                            pipe_trace.consecutive,
+                            {**pipe_trace.dict_domains, kid: dom}, False),
+                        caps[0], fake, [])
+                    out_parts.append([ob])
+                    out_traces.append(ot)
+                else:
+                    out_parts.append([_Batch(None, None, False)])
+                    out_traces.append(None)
                 continue
             ginfo = self._key_group_info(pipe_trace,
                                          node.grouping[0].expr_id) \
@@ -1075,6 +1389,11 @@ class _Analyzer:
 
         single_int_bkey = len(node.right_keys) == 1 and isinstance(
             node.right_keys[0].dtype, (IntegralType, DateType))
+        # string build keys: the dense-build fast paths stay int-only,
+        # but the MATCH-CARDINALITY trace (probe-capacity retries) works
+        # on raw values regardless of type
+        single_str_bkey = len(node.right_keys) == 1 and isinstance(
+            node.right_keys[0].dtype, StringType)
 
         # per-pair traces: post-exchange flows carry per-partition traces
         # (mesh/host shuffled layouts), so the probe AND build value
@@ -1093,7 +1412,8 @@ class _Analyzer:
         for pi, (lp, rp) in enumerate(pairs):
             probe_trace = pair_traces[pi]
             bstats = build_traces[pi].stats(node.right_keys[0].expr_id) \
-                if (build_traces[pi] is not None and single_int_bkey) \
+                if (build_traces[pi] is not None
+                    and (single_int_bkey or single_str_bkey)) \
                 else None
             bcaps = [b.cap for b in rp]
             bknown = all(c is not None for c in bcaps) and rp
@@ -1169,7 +1489,7 @@ class _Analyzer:
                 self._hazard("full_outer unmatched-build pass bypasses the "
                              "KernelCache (eager per-run dispatches)")
             ob, ot = self._join_output(node, lp, dense, bstats,
-                                       probe_trace)
+                                       probe_trace, build_traces[pi])
             out_parts.append(ob)
             out_traces.append(ot)
         self._stage(node, kinds, left.total_batches if left.counted
@@ -1178,13 +1498,39 @@ class _Analyzer:
                      counted=left.counted and right.counted,
                      ptraces=out_traces)
 
-    def _join_output(self, node, lp, dense, bstats, probe_trace):
+    def _join_output(self, node, lp, dense, bstats, probe_trace,
+                     build_trace=None):
         """Per-pair output layout + value trace through the join. Exact
         for the dense inner case (unique integral build keys: the probe is
         a 1:1 gather in probe-row order); everything else keeps the
-        unknown layout the earlier model reported."""
+        unknown layout the earlier model reported. Dictionary domains of
+        build-side string columns ride the output trace (the gather keeps
+        the FULL build dictionary), so downstream dense-on-codes
+        aggregates keep deciding exactly."""
+        # dictionary domains are LAYOUT-independent: the join gathers
+        # keep the probe batch's dictionary on probe columns and the
+        # FULL build dictionary on build columns, whatever the match
+        # cardinality — so they propagate even when the row layout is
+        # unknown (downstream dense-on-codes aggregates keep deciding)
+        domains = {}
+        if probe_trace is not None:
+            for k in probe_trace.cols:
+                dom = self._trace_domain(probe_trace, k)
+                if dom is not None:
+                    domains[k] = dom
+            for k, dom in probe_trace.dict_domains.items():
+                domains.setdefault(k, dom)
+        if build_trace is not None:
+            for a in node.right.output:
+                if isinstance(a.dtype, StringType):
+                    dom = self._trace_domain(build_trace, a.expr_id)
+                    if dom is not None:
+                        domains[a.expr_id] = dom
+        dom_trace = _Trace({}, np.zeros(0, bool), True, domains, False) \
+            if domains else None
         nb = max(len(lp), 1) + (1 if node.join_type == "full_outer" else 0)
-        unknown = ([_Batch(None, None, False) for _ in range(nb)], None)
+        unknown = ([_Batch(None, None, False) for _ in range(nb)],
+                   dom_trace)
         if not (dense and node.join_type == "inner" and lp
                 and probe_trace is not None and probe_trace.consecutive
                 and bstats is not None and len(node.left_keys) == 1):
@@ -1216,7 +1562,8 @@ class _Analyzer:
         cols = {k: (v[sel], None if vv is None else vv[sel])
                 for k, (v, vv) in probe_trace.cols.items()}
         return (out_batches,
-                _Trace(cols, np.ones(len(sel), bool), True))
+                _Trace(cols, np.ones(len(sel), bool), True, domains,
+                       False))
 
     def _build_key_counts(self, bstats):
         if bstats is None or bstats.size == 0:
@@ -1367,16 +1714,26 @@ class _Analyzer:
                         else None, notes)
             return _Flow([[_Batch(None, None, False, seeded=True)]
                           for _ in range(num_out)], None, counted=False)
-        fused_mesh = fused and self._fusion_mesh
+        dict_keys = any(isinstance(getattr(e, "dtype", None), StringType)
+                        for e in p.exprs)
+        fused_mesh = fused and self._fusion_mesh and not (fused
+                                                          and dict_keys)
         if fused and not fused_mesh:
             if child.counted:
                 kinds["pipeline"] += child.total_batches
             else:
                 self._approx("mesh pipeline materialization count depends "
                              "on an unknown upstream batch count")
-            notes.append("mesh fallback (spark.tpu.fusion.mesh=false): "
-                         "the fused map side materializes the pipeline "
-                         "per batch before the all-to-all")
+            if dict_keys and self._fusion_mesh:
+                notes.append("dictionary-encoded partition keys on the "
+                             "mesh path: pipeline materializes per batch, "
+                             "the plain stage hashes staged eq-key planes "
+                             "(dict-hash lut aux planes in the shard_map "
+                             "program are a recorded follow-on)")
+            else:
+                notes.append("mesh fallback (spark.tpu.fusion.mesh=false): "
+                             "the fused map side materializes the pipeline "
+                             "per batch before the all-to-all")
         if fused_mesh:
             notes.append("FUSED mesh stage: pipeline + partition ids + "
                          "all-to-all compiled as ONE shard_map program — "
@@ -1455,8 +1812,19 @@ class _Analyzer:
         for k in ids:
             dt = traces[0].cols[k][0].dtype
             has_valid = any(t.cols[k][1] is not None for t in traces)
-            gcols[k] = [np.zeros(total_cap, dtype=dt),
+            base = np.full(total_cap, "", dtype=object) \
+                if dt == object else np.zeros(total_cap, dtype=dt)
+            gcols[k] = [base,
                         np.zeros(total_cap, bool) if has_valid else None]
+        # mesh staging merges every batch's dictionary into ONE global
+        # dictionary (parallel/mesh_exchange._stage_payloads) — every
+        # reduce partition shares the merged domain
+        global_doms = {}
+        for k in ids:
+            if traces[0].cols[k][0].dtype == object:
+                per = [self._trace_domain(t, k) for t in traces]
+                if all(d is not None for d in per):
+                    global_doms[k] = self._ordered_union(per)
         for t, r0, rows_b, off, _cap in spans:
             sl = slice(r0, r0 + rows_b)
             live[off: off + rows_b] = t.live[sl]
@@ -1495,7 +1863,8 @@ class _Analyzer:
             cols_q = {k: (gv[sel],
                           None if gvalid is None else gvalid[sel])
                       for k, (gv, gvalid) in gcols.items()}
-            ptraces.append(_Trace(cols_q, np.ones(rows_q, bool), True))
+            ptraces.append(_Trace(cols_q, np.ones(rows_q, bool), True,
+                                  dict(global_doms), False))
         return attempts, _Flow(parts, None, counted=True, ptraces=ptraces)
 
     # -- exchange layout/value helpers -------------------------------------
@@ -1520,11 +1889,16 @@ class _Analyzer:
         map-side column stats of the exchange's stat columns (fresh
         arrays, no krange3 probe for those columns)."""
         if rows_p == 0:
-            return [_Batch(0, _EMPTY_CAP, False, seeded=seeded)]
+            return [_Batch(0, _EMPTY_CAP, False, seeded=seeded,
+                           ingest=True)]
         out = []
         for start in range(0, rows_p, self._tile):
             n = min(self._tile, rows_p - start)
-            out.append(_Batch(n, bucket_capacity(n), False, seeded=seeded))
+            # rebuilt tiles are host-ingested (ColumnarBatch.from_numpy):
+            # integral columns carry RunInfo, so a reducer whose rows
+            # arrive sorted can take the ragg kernel
+            out.append(_Batch(n, bucket_capacity(n), False, seeded=seeded,
+                              ingest=True))
         return out
 
     def _exchange_input_traces(self, node, child: _Flow,
@@ -1546,20 +1920,55 @@ class _Analyzer:
 
     def _shuffled_flow(self, in_traces: list, pids_per_part: list,
                        num_out: int,
-                       seeded: "bool | frozenset" = True) -> _Flow:
+                       seeded: "bool | frozenset" = True,
+                       in_parts: Optional[list] = None) -> _Flow:
         """Exact post-shuffle layout + per-reduce-partition value traces:
         reduce partition q = every input partition's live rows with
         pid == q, input order preserved (the stable pid sort groups rows
-        without reordering within a pid)."""
+        without reordering within a pid). With `in_parts` (the exchange
+        input's batch layout), per-reduce dictionary domains mirror the
+        rebuild: a reduce tile's merged dictionary is the union of the
+        FULL dictionaries of every input batch that contributed rows
+        (exec/shuffle._OutBuffer chunks carry whole-batch dictionaries)."""
         comp = [t.compacted() for t in in_traces]
         ids = set(comp[0].cols) if comp else set()
         for t in comp[1:]:
             ids &= set(t.cols)
+        dict_ids = [k for k in ids
+                    if comp and comp[0].cols[k][0].dtype == object]
+        # per input partition: (live-row -> source batch index, per-batch
+        # domain) for the dictionary-union mirror
+        chunk_info = None
+        if dict_ids and in_parts is not None:
+            chunk_info = []
+            for t, part in zip(in_traces, in_parts):
+                rows = [b.rows for b in part]
+                if any(r is None for r in rows) \
+                        or sum(rows) != len(t.live):
+                    chunk_info = None
+                    break
+                src = np.repeat(np.arange(len(part)), rows)[t.live]
+                doms = {}
+                ok = True
+                for k in dict_ids:
+                    r0, per = 0, []
+                    for r in rows:
+                        d = self._trace_domain(t, k, r0, r0 + r)
+                        per.append(d)
+                        r0 += r
+                        if d is None:
+                            ok = False
+                    doms[k] = per
+                if not ok:
+                    chunk_info = None
+                    break
+                chunk_info.append((src, doms))
         parts, ptraces = [], []
         for q in range(num_out):
             sels = [np.nonzero(pids == q)[0] for pids in pids_per_part]
             rows_q = int(sum(len(s) for s in sels))
-            parts.append(self._built_partition(rows_q, seeded))
+            built = self._built_partition(rows_q, seeded)
+            parts.append(built)
             cols_q = {}
             for k in ids:
                 vals = np.concatenate(
@@ -1571,7 +1980,18 @@ class _Analyzer:
                         [np.ones(len(s), bool) if v is None else v[s]
                          for v, s in zip(vs, sels)])
                 cols_q[k] = (vals, valid)
-            ptraces.append(_Trace(cols_q, np.ones(rows_q, bool), True))
+            domains = {}
+            if chunk_info is not None and len(built) == 1:
+                # single rebuilt tile: its dictionary = ordered union of
+                # contributing chunks' full batch dictionaries
+                for k in dict_ids:
+                    contributing = []
+                    for (src, doms), s in zip(chunk_info, sels):
+                        hit = np.unique(src[s]) if len(s) else []
+                        contributing.extend(doms[k][int(b)] for b in hit)
+                    domains[k] = self._ordered_union(contributing)
+            ptraces.append(_Trace(cols_q, np.ones(rows_q, bool), True,
+                                  domains, False))
         return _Flow(parts, None, counted=True, ptraces=ptraces)
 
     def _map_side_kinds(self, node, child: _Flow, fused: bool,
@@ -1646,7 +2066,8 @@ class _Analyzer:
                     pids_per_part.append(_np_hash_pids(
                         [tc.cols[k] for k in key_ids], p.num_partitions))
                 flow = self._shuffled_flow(in_traces, pids_per_part,
-                                           p.num_partitions, seeded)
+                                           p.num_partitions, seeded,
+                                           child.parts)
                 notes.append("reduce layout EXACT: host-side splitmix64 "
                              "of the traced keys decides per-reducer rows")
             if flow is None:
@@ -1704,7 +2125,8 @@ class _Analyzer:
                         .astype(np.int32))
                     offset += n
                 flow = self._shuffled_flow(in_traces, pids_per_part,
-                                           p.num_partitions, seeded)
+                                           p.num_partitions, seeded,
+                                           child.parts)
                 notes.append("reduce layout EXACT: round-robin over the "
                              "traced live-row order")
             if flow is None:
@@ -1897,11 +2319,19 @@ class _Analyzer:
         if isinstance(p, HashPartitioning):
             for e in p.exprs:
                 a = out_by_id.get(getattr(e, "expr_id", -1))
-                if a is not None and (isinstance(a.dtype, StringType)
-                                      or dict_encoded(a.dtype)):
-                    return [f"partition key {a.name} is a dictionary-"
-                            "encoded string: eq-keys ride host-side "
-                            "dictionary hashes"]
+                if a is None:
+                    continue
+                if isinstance(a.dtype, StringType):
+                    if not self._encoding:
+                        return [f"partition key {a.name} is a dictionary-"
+                                "encoded string and compressed execution "
+                                "is off (spark.tpu.encoding.enabled="
+                                "false): eq-keys ride host-side "
+                                "dictionary hashes"]
+                elif dict_encoded(a.dtype):
+                    return [f"partition key {a.name} is a nested "
+                            "dictionary-encoded type: codes are not a "
+                            "cross-dictionary equality domain"]
             return ["not rewritten (unexpected: report this plan)"]
         if isinstance(p, RangePartitioning):
             if len(p.orders) != 1:
@@ -1933,11 +2363,16 @@ class _Analyzer:
             a = out_by_id.get(k.expr_id)
             if a is None:
                 return ["probe key is not produced by the pipeline"]
-            if isinstance(a.dtype, StringType) or dict_encoded(a.dtype):
-                return [f"probe key {a.name} is a dictionary-encoded "
-                        "string: equality rides host-side dictionary "
-                        "hashes (ROADMAP: padded hash tables as kernel "
-                        "aux inputs)"]
+            if isinstance(a.dtype, StringType):
+                if not self._encoding:
+                    return [f"probe key {a.name} is a dictionary-encoded "
+                            "string and compressed execution is off "
+                            "(spark.tpu.encoding.enabled=false): "
+                            "equality rides host-side dictionary hashes"]
+            elif dict_encoded(a.dtype):
+                return [f"probe key {a.name} is a nested dictionary-"
+                        "encoded type: codes are not a cross-dictionary "
+                        "equality domain"]
         return []
 
     # -- overflow ----------------------------------------------------------

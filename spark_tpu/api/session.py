@@ -113,6 +113,10 @@ class TpuSession:
         # (spark.tpu.memory.ledger / spark.tpu.metrics.kernelCost) —
         # process-global like the KernelCache, configured per session
         _resources.configure(self.conf)
+        from ..columnar import encoding as _encoding
+
+        # compressed-execution ingest harvest (spark.tpu.encoding.enabled)
+        _encoding.configure(self.conf)
         from ..obs.live import LiveObs
 
         # live telemetry store: heartbeat-streamed worker obs partials,

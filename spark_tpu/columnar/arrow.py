@@ -96,7 +96,17 @@ def record_batch_to_columnar(rb: pa.RecordBatch | pa.Table,
                              schema: StructType | None = None,
                              capacity: int | None = None,
                              num_rows: int | None = None,
-                             seed_ranges: dict | None = None) -> ColumnarBatch:
+                             seed_ranges: dict | None = None,
+                             dict_cache: dict | None = None,
+                             dict_tokens: dict | None = None) -> ColumnarBatch:
+    """Ingest one arrow slice into a device tile.
+
+    `dict_cache`/`dict_tokens` (cluster shuffle reads): a {token →
+    StringDict} intern table plus this slice's per-column dictionary
+    tokens (shipped on the MapStatus). A token hit attaches the SAME
+    StringDict object the previous block rebuilt — downstream
+    concat/merge takes the identity fast path instead of re-merging
+    equal dictionaries, with no host sync anywhere."""
     import jax.numpy as jnp
 
     if schema is None:
@@ -108,6 +118,14 @@ def record_batch_to_columnar(rb: pa.RecordBatch | pa.Table,
     ranges = {}
     for i, f in enumerate(schema.fields):
         data, validity, sd = _chunked_to_numpy(rb.column(i), f.dataType)
+        if sd is not None and dict_cache is not None \
+                and dict_tokens is not None and i in dict_tokens:
+            tok = dict_tokens[i]
+            cached = dict_cache.get(tok)
+            if cached is not None and len(cached) == len(sd):
+                sd = cached  # identity remap: equal content, shared object
+            else:
+                dict_cache[tok] = sd
         pad = np.zeros(cap, dtype=f.dataType.device_dtype)
         pad[:n] = data[:cap]
         v = None
@@ -115,7 +133,16 @@ def record_batch_to_columnar(rb: pa.RecordBatch | pa.Table,
             vm = np.zeros(cap, dtype=bool)
             vm[:n] = validity[:cap]
             v = jnp.asarray(vm)
-        col = Column(f.dataType, jnp.asarray(pad), v, sd)
+        runs = None
+        if validity is None and sd is None and pad.dtype.kind == "i":
+            # run/sortedness metadata from the host copy (encoding.py):
+            # licenses the sort-free run-boundary aggregate variant;
+            # skipped entirely under the decoded oracle
+            from .encoding import column_runs, runs_harvest_enabled
+
+            if runs_harvest_enabled():
+                runs = column_runs(pad, min(n, cap))
+        col = Column(f.dataType, jnp.asarray(pad), v, sd, runs=runs)
         # key range from the HOST copy while we still have it: the dense
         # aggregate/join fast-path decision then never needs a device→host
         # sync (transfer-bound transports degrade permanently after one).
@@ -133,6 +160,17 @@ def record_batch_to_columnar(rb: pa.RecordBatch | pa.Table,
                     ranges[i] = (int(live.min()), int(live.max()), True)
                 else:
                     ranges[i] = (0, 0, False)
+        elif sd is not None:
+            # dictionary code span is known host-side — codes live in
+            # [0, len(dict)): seed the dense-range memo so ANY dense
+            # consumer of the code plane decides without a krange3 probe
+            # (the dense-on-codes aggregate reads len(dict) directly and
+            # never consults the memo, but this keeps the invariant for
+            # every other range reader)
+            any_live = n > 0 and (
+                validity is None
+                or bool(validity[:n].any()))  # tpulint: ignore[host-sync]
+            ranges[i] = (0, max(len(sd) - 1, 0), any_live)
         cols.append(col)
     mask = np.zeros(cap, dtype=bool)
     mask[:n] = True

@@ -75,7 +75,7 @@ class StringDict:
     """
 
     __slots__ = ("values", "_index", "_hashes", "_ranks", "_device_hashes",
-                 "_device_ranks")
+                 "_device_ranks", "_hash_luts", "_token")
 
     def __init__(self, values: Sequence[str]):
         self.values: list[str] = list(values)
@@ -84,6 +84,8 @@ class StringDict:
         self._ranks: np.ndarray | None = None
         self._device_hashes = None
         self._device_ranks = None
+        self._hash_luts: dict | None = None  # pow2 bucket -> device lut
+        self._token: str | None = None
 
     def __len__(self) -> int:
         return len(self.values)
@@ -132,6 +134,45 @@ class StringDict:
             r = self.ranks if len(self.values) else np.zeros(1, np.int32)
             self._device_ranks = jnp.asarray(r)
         return self._device_ranks
+
+    def device_hash_lut(self, minimum: int = 8):
+        """Padded codes→value-hash lut as a kernel AUX input: the eq-key
+        domain (joins/exchanges on string keys) computed INSIDE a traced
+        stage kernel via one `take`. Padded to a power-of-two bucket so
+        the kernel cache key depends on the BUCKET, not the exact
+        dictionary size — dictionaries that drift a few entries between
+        batches reuse one compiled kernel. Pad entries are zeros: live
+        valid codes are always < len(values), so padding is never read
+        by a row that matters. Cached per bucket on the dictionary."""
+        import jax.numpy as jnp
+
+        n = max(len(self.values), 1)
+        bucket = bucket_capacity(n, minimum=minimum)
+        if self._hash_luts is None:
+            self._hash_luts = {}
+        lut = self._hash_luts.get(bucket)
+        if lut is None:
+            h = np.zeros(bucket, dtype=np.int64)
+            if len(self.values):
+                h[: len(self.values)] = self.hashes
+            lut = self._hash_luts[bucket] = jnp.asarray(h)
+        return lut
+
+    def token(self) -> str:
+        """Stable content fingerprint — the dictionary IDENTITY shipped on
+        MapStatus/shuffle payloads so reduce sides recognize equal
+        dictionaries across map tasks and remap by reference instead of
+        re-merging (cluster shuffle ships codes + ONE dictionary per map
+        task; equal tokens rebuild to one shared StringDict object)."""
+        if self._token is None:
+            h = hashlib.blake2b(digest_size=16)
+            h.update(str(len(self.values)).encode())
+            for v in self.values:
+                s = v if isinstance(v, str) else repr(canon_value(v))
+                h.update(s.encode("utf-8", "surrogatepass"))
+                h.update(b"\x00")
+            self._token = h.hexdigest()
+        return self._token
 
     def device_rank_to_code(self):
         """Inverse of ranks: rank → dictionary code (string MIN/MAX
@@ -238,12 +279,16 @@ class Column:
     data: device array [capacity] in dtype.device_dtype
     validity: device bool array [capacity] or None (= no nulls)
     dictionary: StringDict for string-like columns
+    runs: host-side RunInfo (columnar/encoding.py) harvested at ingest —
+        run-length/sortedness metadata licensing encoding-native kernel
+        variants; dropped whenever the data plane is replaced
     """
 
     dtype: DataType
     data: Any
     validity: Any = None
     dictionary: StringDict | None = None
+    runs: Any = None
 
     @property
     def capacity(self) -> int:
@@ -255,7 +300,8 @@ class Column:
 
     def with_data(self, data, validity="__keep__") -> "Column":
         v = self.validity if validity == "__keep__" else validity
-        return replace(self, data=data, validity=v)
+        # fresh data plane: ingest-time run metadata no longer describes it
+        return replace(self, data=data, validity=v, runs=None)
 
     # --- device key domains ----------------------------------------------
     def eq_keys(self):
@@ -411,8 +457,20 @@ class ColumnarBatch:
                 vm = np.zeros(cap, dtype=bool)
                 vm[:n] = v[:cap]
                 vv = jnp.asarray(vm)
+            runs = None
+            if v is None and not dict_encoded(f.dataType) \
+                    and pad.dtype.kind == "i":
+                # run/sortedness metadata while the plane is still host
+                # numpy (columnar/encoding.py): licenses the sort-free
+                # run-boundary aggregate downstream, zero device work;
+                # skipped entirely under the decoded oracle
+                from .encoding import column_runs, runs_harvest_enabled
+
+                if runs_harvest_enabled():
+                    runs = column_runs(pad, min(n, cap))
             cols.append(Column(f.dataType, jnp.asarray(pad), vv,
-                               d if dict_encoded(f.dataType) else None))
+                               d if dict_encoded(f.dataType) else None,
+                               runs=runs))
         mask = np.zeros(cap, dtype=bool)
         mask[:n] = True
         return ColumnarBatch(schema, cols, jnp.asarray(mask), num_rows=n)
@@ -436,12 +494,31 @@ class ColumnarBatch:
         return {f.name: c.to_numpy(sel)
                 for f, c in zip(self.schema.fields, self.columns)}
 
-    def to_arrow(self):
+    def to_arrow(self, encoded: bool = False):
+        """Arrow materialization. `encoded=True` keeps StringType columns
+        DICTIONARY-ENCODED (int32 codes + the dictionary values, i.e.
+        pa.DictionaryArray) instead of decoding every row — the cluster
+        shuffle wire format: codes cross the IPC boundary and the reduce
+        side rebuilds code columns without re-encoding (compressed
+        execution; the decoded path remains the user-facing collect
+        format and the encoding-off oracle)."""
         import pyarrow as pa
 
         sel = self.selection_indices()
         arrays = []
         for f, c in zip(self.schema.fields, self.columns):
+            if encoded and isinstance(f.dataType, StringType):
+                sd = c.dictionary or EMPTY_DICT
+                codes = np.asarray(c.data)[sel]  # tpulint: ignore[host-sync]
+                codes = np.clip(codes, 0, max(len(sd) - 1, 0)) \
+                    .astype(np.int32)
+                mask = None
+                if c.validity is not None:
+                    mask = ~np.asarray(c.validity)[sel]  # tpulint: ignore[host-sync]
+                arrays.append(pa.DictionaryArray.from_arrays(
+                    pa.array(codes, mask=mask),
+                    pa.array(list(sd.values) or [""], type=pa.string())))
+                continue
             vals = c.to_numpy(sel)
             at = to_arrow_type(f.dataType)
             if isinstance(f.dataType, NullType):

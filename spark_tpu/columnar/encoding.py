@@ -1,0 +1,96 @@
+"""Encoded-column metadata: run-length segments and dictionary-code domains.
+
+The compressed-execution layer (ROADMAP direction 3, "GPU Acceleration of
+SQL Analytics on Compressed Data" in PAPERS.md): columns carry cheap
+host-side encoding metadata harvested while the data is still a numpy
+array at ingest, and kernels pick encoding-native variants from it
+without ever launching a probe or decoding a value:
+
+  * `RunInfo` — run-length structure of an integral column (run count,
+    sortedness, first/last value). A SORTED single grouping key reduces
+    per run boundary (ops/grouping.group_rows_presorted) instead of
+    paying the O(n log n) grouping sort — the RLE-aware segment reduce.
+  * dictionary codes — a string column's int32 codes are a DENSE group
+    domain [0, len(dict)): the dense-scatter aggregate keys directly on
+    codes with the span known host-side (len(dictionary)), so encoded
+    group-by columns never launch the krange3 range probe.
+  * padded dictionary-hash luts (built on StringDict) — codes → stable
+    value hashes as kernel aux inputs, so `eq_keys` works INSIDE a traced
+    stage kernel and string join/exchange keys fuse.
+
+Everything here is metadata: zero kernel launches, no device syncs.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+__all__ = ["RunInfo", "column_runs", "configure", "encoding_enabled",
+           "runs_harvest_enabled"]
+
+# process-wide switch rather than a per-call conf read: the RunInfo
+# harvest sits on the batch-ingest hot path (every integral column of
+# every tile) — flipped by configure(), the same pattern as
+# obs/resources (TpuSession.__init__ + worker begin_stage_obs)
+_ENCODING_ON = True
+
+
+def configure(conf) -> None:
+    """Apply a session/worker conf to the process-global encoding
+    switch (spark.tpu.encoding.enabled). Dynamic conf flips after
+    session start still govern the DECISION sites (which read the conf
+    directly); this only gates the ingest-time metadata harvest."""
+    global _ENCODING_ON
+
+    from ..config import ENCODING_ENABLED
+
+    # conf values are host data — never a device read
+    _ENCODING_ON = bool(conf.get(ENCODING_ENABLED))  # tpulint: ignore[host-sync]
+
+
+def runs_harvest_enabled() -> bool:
+    return _ENCODING_ON
+
+
+class RunInfo(NamedTuple):
+    """Host-side run-length summary of one ingested column's live prefix.
+
+    Computed on the host array at ingest (O(n) numpy, no device work) for
+    integral/date columns without a validity plane. `is_sorted` licenses
+    the run-boundary (sort-free) grouped aggregation: later mask-only
+    filters never reorder rows, so sortedness survives every mask-based
+    operator — only fresh kernel-output columns drop it."""
+
+    n_runs: int
+    is_sorted: bool
+    first: int
+    last: int
+
+
+def column_runs(data: np.ndarray, n: int) -> RunInfo | None:
+    """RunInfo over the first `n` (live) rows of a host integral array,
+    or None for empty/degenerate inputs."""
+    if n <= 0 or data.dtype.kind not in "iu":
+        return None
+    live = data[:n]
+    if n == 1:
+        # host numpy only — `live` is the ingest-time numpy plane
+        return RunInfo(1, True, int(live[0]), int(live[0]))  # tpulint: ignore[host-sync]
+    diff = np.diff(live)
+    n_runs = int(np.count_nonzero(diff)) + 1  # tpulint: ignore[host-sync]
+    is_sorted = bool((diff >= 0).all())  # tpulint: ignore[host-sync]
+    return RunInfo(n_runs, is_sorted,
+                   int(live[0]), int(live[-1]))  # tpulint: ignore[host-sync]
+
+
+def encoding_enabled(conf) -> bool:
+    """spark.tpu.encoding.enabled — the compressed-execution switch
+    (off = the decode-at-boundary oracle)."""
+    from ..config import ENCODING_ENABLED
+
+    try:
+        return bool(conf.get(ENCODING_ENABLED))  # tpulint: ignore[host-sync]
+    except Exception:
+        return True

@@ -207,6 +207,18 @@ FUSION_EXCHANGE = _register(ConfigEntry(
     "dispatch per map batch. Requires spark.tpu.fusion.enabled; subject "
     "to the spark.tpu.fusion.minRows size gate.", _bool))
 
+ENCODING_ENABLED = _register(ConfigEntry(
+    "spark.tpu.encoding.enabled", True,
+    "Compressed execution: kernels operate directly on encoded columns. "
+    "Single dictionary-encoded (string) grouping keys aggregate by direct "
+    "scatter over the dense code domain (the dictionary IS the group "
+    "table — no sort, no range probe), string join/exchange keys fuse "
+    "into stage kernels via padded dictionary-hash aux tables, sorted "
+    "run-length-encoded keys reduce per run without sorting, and cluster "
+    "shuffle ships dictionary codes + one dictionary per map task instead "
+    "of decoded values. Off = the decode-at-boundary oracle for "
+    "differential testing.", _bool))
+
 CODEGEN_CACHE_SIZE = _register(ConfigEntry(
     "spark.tpu.kernel.cacheSize", 1024,
     "Max entries in the jitted-kernel cache (role of the reference's "

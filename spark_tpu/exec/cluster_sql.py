@@ -50,22 +50,70 @@ def _partitions_to_ipc(parts):
     return out
 
 
-def _ipc_to_partition(tabs, schema, seed_ranges=None):
+def _partition_to_ipc_encoded(part):
+    """Compressed shuffle wire format: each batch serializes with its
+    StringType columns DICTIONARY-ENCODED (arrow dictionary arrays —
+    int32 codes + the dictionary, never decoded row values). The
+    per-column dictionary TOKENS (StringDict.token content fingerprints)
+    are returned SEPARATELY — they ride the MapStatus (`dict_ids`), the
+    control-plane carrier the reduce side consults to recognize equal
+    dictionaries across blocks and remap by reference. Returns
+    (("enc1", ipc_list), {col_idx: (token per batch, ...)})."""
+    import pyarrow as pa
+
+    from ..columnar.batch import EMPTY_DICT
+    from ..types import StringType
+
+    tabs = []
+    dtokens: dict[int, list] = {}
+    for bi, b in enumerate(part):
+        t = b.to_arrow(encoded=True)
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, t.schema) as w:
+            w.write_table(t)
+        tabs.append(sink.getvalue().to_pybytes())
+        for ci, (f, c) in enumerate(zip(b.schema.fields, b.columns)):
+            if isinstance(f.dataType, StringType):
+                dtokens.setdefault(ci, []).append(
+                    (c.dictionary or EMPTY_DICT).token())
+    return ("enc1", tabs), {ci: tuple(ts) for ci, ts in dtokens.items()}
+
+
+def _ipc_to_partition(payload, schema, seed_ranges=None, dict_cache=None,
+                      dict_tokens=None):
+    """Rebuild one block's batches. `dict_tokens` ({col_idx: (token per
+    batch, ...)}, from the producing MapStatus.dict_ids) + `dict_cache`
+    intern equal dictionaries to one shared StringDict object."""
     import pyarrow as pa
 
     from ..columnar.arrow import record_batch_to_columnar
 
+    if isinstance(payload, tuple) and payload and payload[0] == "enc1":
+        _tag, tabs = payload
+        out = []
+        for bi, raw in enumerate(tabs):
+            toks = None
+            if dict_tokens:
+                toks = {ci: ts[bi] for ci, ts in dict_tokens.items()
+                        if bi < len(ts)}
+            out.append(record_batch_to_columnar(
+                pa.ipc.open_stream(pa.BufferReader(raw)).read_all(),
+                schema, seed_ranges=seed_ranges,
+                dict_cache=dict_cache, dict_tokens=toks))
+        return out
     return [record_batch_to_columnar(
         pa.ipc.open_stream(pa.BufferReader(raw)).read_all(), schema,
         seed_ranges=seed_ranges)
-        for raw in tabs]
+        for raw in payload]
 
 
 def _ipc_to_partitions(payload, attrs):
     from ..physical.operators import attrs_schema
 
     schema = attrs_schema(attrs)
-    return [_ipc_to_partition(tabs, schema) for tabs in payload]
+    dict_cache: dict = {}
+    return [_ipc_to_partition(tabs, schema, dict_cache=dict_cache)
+            for tabs in payload]
 
 
 class FetchExec(PhysicalPlan):
@@ -91,7 +139,8 @@ class FetchExec(PhysicalPlan):
                  fallback_addr: str | None = None,
                  merge: tuple | None = None,
                  part_indices: list | None = None,
-                 col_stats: dict | None = None):
+                 col_stats: dict | None = None,
+                 dict_ids: dict | None = None):
         self.attrs = list(attrs)
         self.shuffle_id = shuffle_id
         self.maps = list(maps)              # [(map_id, block_addr), ...]
@@ -104,6 +153,11 @@ class FetchExec(PhysicalPlan):
         # seeds the dense-range memo on rebuild (no krange3 probe on
         # post-shuffle dense decisions; same stats the local write seeds)
         self.col_stats = col_stats
+        # {map_id: {rid: {col_idx: (StringDict.token per batch, ...)}}} —
+        # the dictionary IDENTITY each map task registered on its
+        # MapStatus: rebuilds intern equal dictionaries by token and
+        # remap blocks by reference (no re-encode, no host sync)
+        self.dict_ids = dict_ids
 
     @property
     def output(self):
@@ -134,7 +188,8 @@ class FetchExec(PhysicalPlan):
             parents.append(merge_flow_id(self.shuffle_id))
         return parents
 
-    def _fetch_rid(self, rid: int, clients: dict, schema, ctx) -> list:
+    def _fetch_rid(self, rid: int, clients: dict, schema, ctx,
+                   dict_cache: dict | None = None) -> list:
         """One reduce partition: merged chunk first, per-map fallback."""
         import pickle
 
@@ -172,7 +227,10 @@ class FetchExec(PhysicalPlan):
                                            str(e)) from None
                 ctx.metrics.add("shuffle.blocks_fetched")
             seed = (self.col_stats or {}).get(rid)
-            part.extend(_ipc_to_partition(pickle.loads(raw), schema, seed))
+            toks = ((self.dict_ids or {}).get(map_id) or {}).get(rid)
+            part.extend(_ipc_to_partition(pickle.loads(raw), schema, seed,
+                                          dict_cache=dict_cache,
+                                          dict_tokens=toks))
         return part
 
     def execute(self, ctx):
@@ -184,6 +242,10 @@ class FetchExec(PhysicalPlan):
         rids = (self.part_indices if self.part_indices is not None
                 else range(self.num_partitions))
         clients: dict = {}
+        # one dictionary intern table per fetch: encoded blocks carrying
+        # the same StringDict.token rebuild to ONE shared dictionary
+        # object across map tasks and reduce partitions (identity remap)
+        dict_cache: dict = {}
         tracer = getattr(ctx, "tracer", None)
         # exchange-edge flow: this fetch's span parents to the map-task
         # spans that stored the blocks (possibly in another process —
@@ -193,7 +255,8 @@ class FetchExec(PhysicalPlan):
             if tracer is not None else nullcontext()
         try:
             with sp:
-                return [self._fetch_rid(rid, clients, schema, ctx)
+                return [self._fetch_rid(rid, clients, schema, ctx,
+                                        dict_cache)
                         for rid in rids]
         finally:
             for c in clients.values():
@@ -260,10 +323,22 @@ def _run_stage_store(plan_bytes: bytes, conf_overrides: dict,
         if task_span is not None:
             task_span.__enter__()
         try:
+            from ..columnar.encoding import encoding_enabled
+
+            encoded = encoding_enabled(conf)
             parts = plan.execute(ctx)
             rows, sizes = [], []
+            dict_ids: dict = {}
             for rid, part in enumerate(parts):
-                ipc = _partitions_to_ipc([part])[0]
+                if encoded:
+                    # ship dictionary codes + per-column dictionaries
+                    # (tokens identify them on the MapStatus) instead of
+                    # decoded values — compressed execution's wire format
+                    ipc, toks = _partition_to_ipc_encoded(part)
+                    if toks:
+                        dict_ids[rid] = toks
+                else:
+                    ipc = _partitions_to_ipc([part])[0]
                 raw = pickle.dumps(ipc)
                 WM.store_map_block(shuffle_id, map_id, num_maps, rid, raw)
                 rows.append(sum(b.num_rows() for b in part))
@@ -285,7 +360,7 @@ def _run_stage_store(plan_bytes: bytes, conf_overrides: dict,
     # seeds its dense-range memo from them instead of probing on device
     col_stats = getattr(plan, "last_col_stats", None) or None
     return ("mapstatus", WM.BLOCK_ADDR, rows, sizes, counters,
-            WM.finish_stage_obs(obs), col_stats)
+            WM.finish_stage_obs(obs), col_stats, dict_ids or None)
 
 
 class ClusterDAGScheduler(DAGScheduler):
@@ -492,7 +567,8 @@ class ClusterDAGScheduler(DAGScheduler):
                 _run_stage_store, cloudpickle.dumps(plan),
                 self.conf_overrides, sid, map_id, num_maps,
                 qid, flow_parent, task_key=(sid, map_id))
-            tag, addr, rows, sizes, counters, obs, col_stats = result
+            (tag, addr, rows, sizes, counters, obs, col_stats,
+             dict_ids) = result
             assert tag == "mapstatus", tag
             # close the task in the live store the moment ITS result
             # lands (not at the stage barrier): the final record
@@ -508,7 +584,7 @@ class ClusterDAGScheduler(DAGScheduler):
                                         started=t_start)
             return (MapStatus(map_block_id(sid, map_id, num_maps), addr,
                               worker.executor_id, rows, sizes, map_id,
-                              col_stats),
+                              col_stats, dict_ids),
                     counters, obs, worker.executor_id)
 
         if num_maps == 1:
@@ -681,7 +757,10 @@ def _substitute_parents(node, sched: ClusterDAGScheduler):
                          fallback_addr=getattr(sched.cluster,
                                                "shuffle_service_addr", None),
                          merge=merge,
-                         col_stats=_merged_col_stats(status.maps))
+                         col_stats=_merged_col_stats(status.maps),
+                         dict_ids={m.map_id: m.dict_ids
+                                   for m in status.maps
+                                   if m.dict_ids} or None)
     return node.map_children(lambda c: _substitute_parents(c, sched))
 
 
@@ -697,6 +776,6 @@ def _slice_fetch_leaves(node, map_id: int, num_maps: int):
             merge=node.merge,
             part_indices=list(range(map_id, node.num_partitions,
                                     num_maps)),
-            col_stats=node.col_stats)
+            col_stats=node.col_stats, dict_ids=node.dict_ids)
     return node.map_children(
         lambda c: _slice_fetch_leaves(c, map_id, num_maps))

@@ -65,6 +65,14 @@ class MapStatus:
     # krange3 probe (exec/shuffle._OutBuffer accumulates them host-side
     # while slicing rows; zero extra device work)
     col_stats: dict | None = None
+    # dictionary IDENTITY of every encoded string column this map task
+    # shipped: {reduce_id: {col_idx: (StringDict.token per batch, ...)}}.
+    # Blocks travel as codes + dictionary (compressed execution); equal
+    # tokens let the reduce side rebuild ONE shared StringDict per
+    # distinct dictionary and remap blocks by reference — no re-encode,
+    # no host sync, and downstream concat/merge hits the identity fast
+    # path across map tasks
+    dict_ids: dict | None = None
 
     @property
     def num_partitions(self) -> int:

@@ -89,6 +89,13 @@ class _OutBuffer:
         self.chunks.append(cols)
         self._chunk_rows.append(n)
         self.rows += n
+        if self.metrics is not None:
+            # bytes moved through the shuffle write (codes + validity
+            # planes; dictionaries ride by reference) — the compressed-
+            # execution scoreboard bench.py --encoded reads
+            self.metrics.add("shuffle.bytes_shipped", sum(
+                d.nbytes + (v.nbytes if v is not None else 0)
+                for d, v, _ in cols))
         for i in self._stat_cols:
             d, v, _ = cols[i]
             live = d if v is None else d[v]

@@ -115,6 +115,10 @@ def begin_stage_obs(conf, query_id: str | None = None,
     # ledger + kernel-cost switches follow the shipped session conf (the
     # worker-process analog of TpuSession.__init__'s configure call)
     _resources.configure(conf)
+    from ..columnar import encoding as _encoding
+
+    # compressed-execution ingest harvest follows the shipped conf too
+    _encoding.configure(conf)
 
     # conf values are host data — bool() here never touches device
     if not bool(conf.get(  # tpulint: ignore[host-sync]

@@ -267,10 +267,18 @@ class ParquetSource(DataSource):
         return _range_overlaps(pv, pv, op, v)
 
     def read_partition(self, i: int, columns=None) -> pa.Table:
-        from ..types import to_arrow_type
+        from ..types import StringType, to_arrow_type
 
         fpath, lo, hi = self._splits[i]
-        f = self._pq.ParquetFile(fpath)
+        # keep parquet DICTIONARY PAGES encoded end to end: string
+        # columns decode to pa.DictionaryArray (codes + dictionary)
+        # straight from the file, and columnar ingest ships those codes
+        # to HBM without ever materializing row values (compressed
+        # execution; _chunked_to_numpy's is_dictionary branch)
+        dict_cols = [f.name for f in self.schema.fields
+                     if isinstance(f.dataType, StringType)
+                     and f.name not in self._part_keys]
+        f = self._pq.ParquetFile(fpath, read_dictionary=dict_cols or None)
         pvals = self._part_values.get(fpath, {})
         want_part = [k for k in self._part_keys
                      if columns is None or k in columns]

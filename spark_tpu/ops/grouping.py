@@ -60,6 +60,33 @@ def group_rows(key_cols: Sequence[jnp.ndarray],
     return GroupLayout(perm, seg_ids, start_flag, active, num_groups)
 
 
+def group_rows_presorted(key: jnp.ndarray, row_mask: jnp.ndarray
+                         ) -> GroupLayout:
+    """GroupLayout for a single key column whose values are ALREADY
+    non-decreasing (ingest RunInfo.is_sorted metadata, no validity plane):
+    the RLE-aware segment reduce. Equal keys are contiguous by
+    construction, so the segment structure derives from run BOUNDARIES
+    (one adjacent-difference + a per-run first-live scatter) and the
+    O(cap log cap) grouping sort is skipped entirely — the reduce visits
+    each run once instead of re-discovering it. Mask-only filters never
+    reorder rows, so sortedness established at ingest survives them;
+    masked rows inside a run contribute nothing (weights), and runs with
+    no live rows produce no group."""
+    cap = row_mask.shape[0]
+    pos = lax.iota(jnp.int32, cap)
+    changed = jnp.concatenate([jnp.ones(1, dtype=bool),
+                               key[1:] != key[:-1]])
+    run_id = jnp.cumsum(changed.astype(jnp.int32)) - 1
+    # first LIVE row of each value run opens its group: a masked row
+    # between two live rows of one run must not split the group
+    p = jnp.where(row_mask, pos, cap)
+    first_live = jax.ops.segment_min(p, run_id, num_segments=cap)
+    start_flag = row_mask & (pos == jnp.take(first_live, run_id))
+    seg_ids = jnp.maximum(jnp.cumsum(start_flag.astype(jnp.int32)) - 1, 0)
+    num_groups = jnp.sum(start_flag.astype(jnp.int32))
+    return GroupLayout(pos, seg_ids, start_flag, row_mask, num_groups)
+
+
 def scatter_group_keys(layout: GroupLayout, key_col: jnp.ndarray,
                        key_valid: jnp.ndarray | None):
     """Gather each group's key value into output slot seg_id.

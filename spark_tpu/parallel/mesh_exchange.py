@@ -196,8 +196,21 @@ def mesh_shuffle_hash(partitions, key_positions: Sequence[int],
     otherwise the pipeline (if any) materializes per batch and the
     pre-materialized batches take the plain stage program."""
     from ..config import DEVICE_MESH_AXIS, FUSION_MESH
+    from ..types import StringType
 
     axis = ctx.conf.get(DEVICE_MESH_AXIS)
+    if fusion is not None and any(
+            isinstance(fusion.pipe_attrs[i].dtype, StringType)
+            for i in fusion._key_idx):
+        # dictionary-encoded partition keys on the mesh path take the
+        # materialize-then-collective composition: the plain stage hashes
+        # staged eq-key planes (value hashes), which are dictionary-
+        # independent across shards. Folding the padded dict-hash luts
+        # into the fused shard_map program as replicated aux planes is a
+        # recorded follow-on (ROADMAP direction 3).
+        partitions = [[fusion.run_pipeline(b) for b in part]
+                      for part in partitions]
+        fusion = None
     if fusion is not None and not ctx.conf.get(FUSION_MESH):
         # legacy composition: materialize the pipeline per batch, then
         # redistribute the materialized batches

@@ -32,7 +32,8 @@ from typing import Sequence
 import numpy as np
 
 from ..config import (
-    FUSION_DENSE_KEYS, FUSION_EXCHANGE, FUSION_MIN_ROWS, SQLConf,
+    ENCODING_ENABLED, FUSION_DENSE_KEYS, FUSION_EXCHANGE, FUSION_MIN_ROWS,
+    SQLConf,
 )
 from ..expr.expressions import Alias, AttributeReference, Expression
 from ..types import (
@@ -297,7 +298,28 @@ class FusedAggregateExec(HashAggregateExec):
             return ColumnarBatch(out_schema, cols, m, num_rows=1)
 
         # ---- grouped: dense-range direct scatter -----------------------
-        dense = self._dense_decision(batch, key_idx, ctx)
+        # dictionary-encoded single keys are ALWAYS dense candidates: the
+        # int32 code domain is [0, len(dict)) with the span known from
+        # the host pass's output dictionary — no range probe, no sync
+        # (compressed execution; the dictionary decodes the output keys)
+        dense = None
+        key_dict = None
+        if len(key_idx) == 1 and ctx.conf.get(FUSION_DENSE_KEYS) \
+                and isinstance(self.pipe_attrs[key_idx[0]].dtype,
+                               StringType):
+            from ..columnar.encoding import encoding_enabled
+
+            if encoding_enabled(ctx.conf):
+                from ..columnar.batch import EMPTY_DICT as _ED
+
+                sdk = host_outs[key_idx[0]].sdict or _ED
+                if len(sdk) + 1 <= min(4 * cap, 1 << 23):
+                    key_dict = sdk
+                    dense = (0, bucket_capacity(len(sdk) + 1),
+                             host_outs[key_idx[0]].validity is not None)
+                    ctx.metrics.add("agg.dict_code_fast_path")
+        if dense is None:
+            dense = self._dense_decision(batch, key_idx, ctx)
         if dense is not None:
             kmin, out_cap, has_kv = dense
             kpos = key_idx[0]
@@ -349,7 +371,7 @@ class FusedAggregateExec(HashAggregateExec):
                     rank_luts, inv_luts)
             ctx.metrics.add("agg.dense_fast_path")
             cols = [Column(kf.dataType, out_keys,
-                           key_validity if has_kv else None, None)]
+                           key_validity if has_kv else None, key_dict)]
             cols += self._fused_cols(bufs, out_schema.fields[1:], host_outs,
                                      val_idx, 0)
             return ColumnarBatch(out_schema, cols, out_mask, num_rows=None)
@@ -675,19 +697,33 @@ class ExchangeFusion:
         key_idx = self._key_idx
         key_bool = tuple(isinstance(self.pipe_attrs[i].dtype, BooleanType)
                          for i in key_idx)
+        # string partition keys: eq_keys computes inside the trace via
+        # padded dictionary-hash aux luts (compressed execution — the
+        # fused map dispatch ships codes, never decoded values)
+        from ..columnar.batch import EMPTY_DICT as _ED
+
+        dict_pos = {i: j for j, i in enumerate(
+            i for i in key_idx
+            if isinstance(self.pipe_attrs[i].dtype, StringType))}
+        kluts = [(host_outs[i].sdict or _ED).device_hash_lut()
+                 for i in dict_pos]
         mode, seed, descending = self._mode, self._seed, self._descending
         rpos = self._range_pos
         key = ("fused_shuffle", mode, self._struct_key, cap, num_out,
                key_idx, seed, descending, rpos,
                None if self._bounds_dev is None
                else (str(self._bounds_dev.dtype), len(self._bounds_host)),
-               pipeline_signature(batch), hctx.signature())
+               pipeline_signature(batch), hctx.signature(),
+               tuple(sorted(dict_pos)),
+               tuple(int(l.shape[0])  # tpulint: ignore[host-sync]
+                     for l in kluts))
 
         def build():
             from ..ops.hashing import hash_columns, partition_ids
             from ..ops.partition import _group_by_pid
 
-            def kernel(datas, valids, row_mask, aux, start_s, bounds):
+            def kernel(datas, valids, row_mask, aux, start_s, bounds,
+                       kluts):
                 out_datas, out_valids, mask = trace_pipeline(
                     input_attrs, filters, outputs, datas, valids, row_mask,
                     aux, cap)
@@ -697,6 +733,11 @@ class ExchangeFusion:
                         kd = out_datas[i]
                         if is_bool:
                             kd = kd.astype(jnp.int32)
+                        if i in dict_pos:
+                            lut = kluts[dict_pos[i]]
+                            kd = jnp.take(lut, jnp.clip(
+                                kd.astype(jnp.int32), 0,
+                                lut.shape[0] - 1))
                         eqs.append(kd)
                     kvs = [out_valids[i] for i in key_idx]
                     pids = partition_ids(
@@ -724,7 +765,7 @@ class ExchangeFusion:
             g_datas, g_valids, counts = kernel(
                 [c.data for c in batch.columns],
                 [c.validity for c in batch.columns], batch.row_mask, aux,
-                np.int32(start % num_out), self._bounds_dev)
+                np.int32(start % num_out), self._bounds_dev, kluts)
         fields = attrs_schema(self.pipe_attrs).fields
         gathered = []
         for i, f in enumerate(fields):
@@ -779,8 +820,14 @@ def _exchange_fusable(exch, compute: ComputeExec, conf: SQLConf) -> bool:
             a = out_by_id.get(e.expr_id)
             if a is None:
                 return False
-            if isinstance(a.dtype, StringType) or dict_encoded(a.dtype):
-                # string eq-keys ride host-side dictionary hashes
+            if isinstance(a.dtype, StringType):
+                # string eq-keys compute inside the trace via padded
+                # dictionary-hash aux luts (compressed execution)
+                if not conf.get(ENCODING_ENABLED):
+                    return False
+            elif dict_encoded(a.dtype):
+                # nested types: raw codes are not a cross-dictionary
+                # equality domain — unfused path handles them
                 return False
         return True
     if isinstance(p, UnknownPartitioning):
@@ -803,7 +850,8 @@ def _exchange_fusable(exch, compute: ComputeExec, conf: SQLConf) -> bool:
     return False  # SinglePartition gathers without kernels
 
 
-def _probe_fusable(join: HashJoinExec, compute: ComputeExec) -> bool:
+def _probe_fusable(join: HashJoinExec, compute: ComputeExec,
+                   conf: SQLConf) -> bool:
     if not _compute_nontrivial(compute):
         return False
     out_by_id = {a.expr_id: a for a in compute.output}
@@ -811,8 +859,14 @@ def _probe_fusable(join: HashJoinExec, compute: ComputeExec) -> bool:
         a = out_by_id.get(k.expr_id)
         if a is None:
             return False
-        if isinstance(a.dtype, StringType) or dict_encoded(a.dtype):
-            # string equality rides dictionary hashes, which live host-side
+        if isinstance(a.dtype, StringType):
+            # string probe keys fuse: eq_keys (codes → value hashes)
+            # computes inside the probe kernel via the padded
+            # dictionary-hash lut aux input (compressed execution)
+            if not conf.get(ENCODING_ENABLED):
+                return False
+        elif dict_encoded(a.dtype):
+            # nested types: codes are not a cross-dictionary eq domain
             return False
     return True
 
@@ -842,7 +896,7 @@ def fuse_stages(plan: PhysicalPlan, conf: SQLConf) -> PhysicalPlan:
                                   is_global=node.is_global)
         if isinstance(node, HashJoinExec) and node.probe_fusion is None \
                 and isinstance(node.left, ComputeExec) \
-                and _probe_fusable(node, node.left):
+                and _probe_fusable(node, node.left, conf):
             c = node.left
             node.probe_fusion = (list(c.filters), list(c.outputs))
             node.probe_attrs = list(c.output)

@@ -541,6 +541,26 @@ def _dense_group_kernel(ops: tuple[str, ...], cap: int, out_cap: int,
     return jax.jit(kernel)
 
 
+def _run_group_kernel(ops: tuple[str, ...], cap: int):
+    """RLE-aware grouped aggregation: the key column is already sorted
+    (ingest RunInfo), so segments come from run boundaries
+    (ops/grouping.group_rows_presorted) and `lax.sort` is skipped — the
+    reduce visits each run once. Compiled only when sorted-run metadata
+    is actually present (encoded-operand cache-key discipline)."""
+    import jax
+
+    from ..ops import grouping as G
+
+    def kernel(key, val_datas, val_valids, row_mask):
+        layout = G.group_rows_presorted(key, row_mask)
+        out_key = G.scatter_group_keys(layout, key, None)
+        bufs = G.apply_group_ops(layout, ops, val_datas, val_valids)
+        out_mask = G.group_output_mask(layout)
+        return out_key, bufs, out_mask, layout.num_groups
+
+    return jax.jit(kernel)
+
+
 def _ungrouped_kernel(ops: tuple[str, ...], cap: int,
                       val_valid_sig: tuple[bool, ...], out_cap: int = 8):
     import jax
@@ -727,6 +747,11 @@ class HashAggregateExec(PhysicalPlan):
                                     string_minmax)
             if dense is not None:
                 return dense
+            rle = self._try_run_sorted(batch, key_cols, ops, val_datas,
+                                       val_valids, out_schema, ctx,
+                                       string_minmax)
+            if rle is not None:
+                return rle
 
         kkey = ("gagg", len(key_cols), ops, cap,
                 tuple(v is not None for v in key_valids),
@@ -874,7 +899,12 @@ class HashAggregateExec(PhysicalPlan):
     def _try_dense(self, batch: ColumnarBatch, key_cols, ops, val_datas,
                    val_valids, out_schema, ctx, string_minmax):
         """Dense-range fast path dispatch: single integral key whose value
-        span fits a capacity bucket (host syncs two scalars to decide)."""
+        span fits a capacity bucket (host syncs two scalars to decide),
+        OR a single dictionary-encoded string key — its int32 codes ARE a
+        dense domain [0, len(dict)) with the span known host-side
+        (len(dictionary)), so the decision never launches the range
+        probe and the dictionary decodes the output keys (compressed
+        execution: the aggregate groups directly on codes)."""
         import jax
 
         from ..types import DateType, IntegralType
@@ -883,16 +913,29 @@ class HashAggregateExec(PhysicalPlan):
         if len(key_cols) != 1:
             return None
         kc = key_cols[0]
-        if not isinstance(kc.dtype, (IntegralType, DateType)):
-            return None
         cap = batch.capacity
+        key_dict = None
+        if kc.is_string:
+            from ..columnar.batch import EMPTY_DICT
+            from ..columnar.encoding import encoding_enabled
 
-        kmin, kmax, any_live = dense_range_stats(kc, batch.row_mask, cap)
-        if not any_live:
+            if not encoding_enabled(ctx.conf):
+                return None
+            key_dict = kc.dictionary or EMPTY_DICT
+            kmin, span = 0, len(key_dict)
+            if span + 1 > min(4 * cap, 1 << 23):
+                return None  # mega-dictionary — sort path handles it
+            ctx.metrics.add("agg.dict_code_fast_path")
+        elif isinstance(kc.dtype, (IntegralType, DateType)):
+            kmin, kmax, any_live = dense_range_stats(kc, batch.row_mask,
+                                                     cap)
+            if not any_live:
+                return None
+            span = kmax - kmin + 1
+            if span + 1 > min(4 * cap, 1 << 23):
+                return None  # sparse keys — sort path handles it
+        else:
             return None
-        span = kmax - kmin + 1
-        if span + 1 > min(4 * cap, 1 << 23):
-            return None  # sparse keys — sort path handles it
 
         out_cap = bucket_capacity(span + 1)
         dkey = ("dagg", ops, cap, out_cap, kc.validity is not None,
@@ -911,7 +954,41 @@ class HashAggregateExec(PhysicalPlan):
         kf = out_schema.fields[0]
         kdata = out_keys.astype(kf.dataType.device_dtype)
         kv = key_validity if kc.validity is not None else None
-        cols.append(Column(kf.dataType, kdata, kv, None))
+        cols.append(Column(kf.dataType, kdata, kv, key_dict))
+        for bi, ((bd, bv), f) in enumerate(zip(bufs, out_schema.fields[1:])):
+            cols.append(self._finish_buffer(bi, bd, bv, f, string_minmax))
+        return ColumnarBatch(out_schema, cols, out_mask, num_rows=None)
+
+    def _try_run_sorted(self, batch: ColumnarBatch, key_cols, ops,
+                        val_datas, val_valids, out_schema, ctx,
+                        string_minmax):
+        """RLE fast path: a single integral key whose ingest RunInfo says
+        the live rows are already sorted (no validity plane) reduces per
+        RUN BOUNDARY — no grouping sort, no dense table. Reached only
+        when the dense-range path declined (sparse span), so clustered
+        sparse keys (sorted file reads, post-sort streams) keep a
+        sort-free aggregate. Metadata-only decision: zero launches."""
+        from ..columnar.encoding import encoding_enabled
+
+        if len(key_cols) != 1:
+            return None
+        kc = key_cols[0]
+        runs = getattr(kc, "runs", None)
+        if runs is None or not runs.is_sorted or kc.validity is not None:
+            return None
+        if not encoding_enabled(ctx.conf):
+            return None
+        cap = batch.capacity
+        rkey = ("ragg", ops, cap, str(kc.data.dtype),
+                tuple(str(d.dtype) for d in val_datas),
+                tuple(v is not None for v in val_valids))
+        kernel = GLOBAL_KERNEL_CACHE.get_or_build(
+            rkey, lambda: _run_group_kernel(ops, cap))
+        (out_key, out_kv), bufs, out_mask, _ng = kernel(
+            kc.data, val_datas, val_valids, batch.row_mask)
+        ctx.metrics.add("agg.run_sorted_fast_path")
+        cols = [Column(out_schema.fields[0].dataType, out_key, None,
+                       kc.dictionary)]
         for bi, ((bd, bv), f) in enumerate(zip(bufs, out_schema.fields[1:])):
             cols.append(self._finish_buffer(bi, bd, bv, f, string_minmax))
         return ColumnarBatch(out_schema, cols, out_mask, num_rows=None)
@@ -1561,6 +1638,9 @@ class HashJoinExec(PhysicalPlan):
         from ..types import BooleanType
 
         jnp = _jnp()
+        from ..columnar.batch import EMPTY_DICT
+        from ..types import StringType
+
         filters, outputs = self.probe_fusion
         input_attrs = self.left.output
         pipe = self._probe_pipeline()
@@ -1571,6 +1651,15 @@ class HashJoinExec(PhysicalPlan):
         kidx = tuple(opos[k.expr_id] for k in self.left_keys)
         key_bool = tuple(isinstance(self.probe_attrs[i].dtype, BooleanType)
                          for i in kidx)
+        # string probe keys: padded dictionary-hash luts ride as kernel
+        # aux inputs so eq_keys (codes → stable value hashes) computes
+        # INSIDE the trace — the former unfused string-probe fallback is
+        # retired (compressed execution)
+        dict_pos = {i: j for j, i in enumerate(
+            i for i in kidx
+            if isinstance(self.probe_attrs[i].dtype, StringType))}
+        kluts = [(host_outs[i].sdict or EMPTY_DICT).device_hash_lut()
+                 for i in dict_pos]
         in_sig = pipeline_signature(pb)
 
         out_cap = max(cap, 1 << 10)
@@ -1578,11 +1667,14 @@ class HashJoinExec(PhysicalPlan):
             kkey = ("fused_probe", jt, pipe._struct_key, cap,
                     bindex.perm.shape[0], out_cap, kidx, in_sig,
                     hctx.signature(), tuple(v is not None
-                                            for v in bkey_valids))
+                                            for v in bkey_valids),
+                    tuple(sorted(dict_pos)),
+                    tuple(int(l.shape[0])  # tpulint: ignore[host-sync]
+                          for l in kluts))
 
             def build_kernel(oc=out_cap):
                 def kernel(bidx_sorted, bidx_perm, beqs, bvalids, datas,
-                           valids, pmask, aux):
+                           valids, pmask, aux, kluts):
                     out_datas, out_valids, mask = trace_pipeline(
                         input_attrs, filters, outputs, datas, valids, pmask,
                         aux, cap)
@@ -1592,6 +1684,11 @@ class HashJoinExec(PhysicalPlan):
                         kd = out_datas[i]
                         if is_bool:
                             kd = kd.astype(jnp.int32)
+                        if i in dict_pos:
+                            lut = kluts[dict_pos[i]]
+                            kd = jnp.take(lut, jnp.clip(
+                                kd.astype(jnp.int32), 0,
+                                lut.shape[0] - 1))
                         peqs.append(kd)
                         pvalids.append(out_valids[i])
                     bi = J.BuildSide(bidx_sorted, bidx_perm)
@@ -1605,7 +1702,7 @@ class HashJoinExec(PhysicalPlan):
             r, out_datas, out_valids, mask = kernel(
                 bindex.sorted_hash, bindex.perm, bkey_eqs, bkey_valids,
                 [c.data for c in pb.columns],
-                [c.validity for c in pb.columns], pb.row_mask, aux)
+                [c.validity for c in pb.columns], pb.row_mask, aux, kluts)
             needed = int(r.needed)
             if needed <= out_cap:
                 break

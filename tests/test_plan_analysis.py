@@ -189,15 +189,29 @@ def test_fusion_off_boundary_explained(fusion_conf, data):
                for b in report.fusion_boundaries)
 
 
-def test_string_probe_key_boundary_explained(fusion_conf, data):
+def test_string_probe_key_fuses_with_encoding(fusion_conf, data):
+    """Compressed execution retires the string-key unfused probe
+    fallback: the probe pipeline fuses (padded dictionary-hash lut as a
+    kernel aux input), the prediction stays exact, and turning encoding
+    OFF restores the historical boundary + reason."""
     data.conf.set("spark.tpu.fusion.enabled", "true")
     sdim = pa.table({"sk": [f"cat{i}" for i in range(5)],
                      "w": np.arange(5, dtype=np.int64)})
     data.createDataFrame(sdim).createOrReplaceTempView("an_sdim")
     q = ("select s, w from an_t join an_sdim on s = sk where v > 0")
     report = data.sql(q).query_execution.analysis_report()
-    assert any("string" in b and "UNFUSED probe" in b
-               for b in report.fusion_boundaries), report.fusion_boundaries
+    assert any("FUSED probe" in b for b in report.fusion_boundaries), \
+        report.fusion_boundaries
+    assert not any("UNFUSED probe" in b for b in report.fusion_boundaries)
+    _assert_exact(data, q)
+    data.conf.set("spark.tpu.encoding.enabled", "false")
+    try:
+        report = data.sql(q).query_execution.analysis_report()
+        assert any("UNFUSED probe" in b and "string" in b
+                   for b in report.fusion_boundaries), \
+            report.fusion_boundaries
+    finally:
+        data.conf.unset("spark.tpu.encoding.enabled")
 
 
 def test_overflow_risk_flagged_for_int_sum(fusion_conf, data):
@@ -310,13 +324,14 @@ def test_rr_shuffle_rows_survive_offset_argument(spark):
 
 def test_inexact_degrades_honestly(fusion_conf, data):
     """A MESH hash exchange whose key values the analyzer cannot trace
-    (string keys — only integer columns trace) has data-dependent quota
-    retries: the analyzer must NOT claim exactness, and must say why.
-    (Traced integer keys now simulate the staging + retry loop exactly —
-    see test_mesh_exchange_prediction_exact.)"""
+    (a COMPUTED string key — only pass-through columns trace) has
+    data-dependent quota retries: the analyzer must NOT claim exactness,
+    and must say why. (Traced keys — integers AND plain string columns,
+    whose eq-lanes ride the dictionary hashes — now simulate the staging
+    + retry loop exactly.)"""
     data.conf.set("spark.tpu.fusion.enabled", "true")
-    df = (data.sql("select * from an_t").repartition(4, "s")
-          .groupBy("s").count())
+    df = (data.sql("select upper(s) as u, v from an_t")
+          .repartition(4, "u").groupBy("u").count())
     report = df.query_execution.analysis_report()
     assert not report.exact
     assert report.inexact_reasons
@@ -374,17 +389,32 @@ def test_fused_exchange_boundary_and_kind(fusion_conf, data):
         report.fusion_boundaries
 
 
-def test_string_exchange_key_boundary_explained(fusion_conf, data):
-    """A dictionary-encoded partition key keeps the exchange unfused —
-    and the report says why."""
+def test_string_exchange_key_fuses_with_encoding(fusion_conf, data):
+    """Compressed execution fuses string hash-partition keys into the
+    map-side program (dict-hash lut aux input): fused_shuffle is
+    predicted exactly; encoding off restores the historical boundary."""
     data.conf.set("spark.tpu.fusion.enabled", "true")
-    df = (data.sql("select s, v * 2 as v2 from an_t where v > 0")
-          .repartition(5, "s"))
-    report = df.query_execution.analysis_report()
-    assert "fused_shuffle" not in report.predicted_launches, \
+
+    def q():
+        return (data.sql("select s, v * 2 as v2 from an_t where v > 0")
+                .repartition(5, "s"))
+
+    report = q().query_execution.analysis_report()
+    assert "fused_shuffle" in report.predicted_launches, \
         report.predicted_launches
-    assert any("UNFUSED exchange" in b and "string" in b
-               for b in report.fusion_boundaries), report.fusion_boundaries
+    assert any("FUSED map side" in b for b in report.fusion_boundaries), \
+        report.fusion_boundaries
+    _assert_exact_df(q)
+    data.conf.set("spark.tpu.encoding.enabled", "false")
+    try:
+        report = q().query_execution.analysis_report()
+        assert "fused_shuffle" not in report.predicted_launches, \
+            report.predicted_launches
+        assert any("UNFUSED exchange" in b and "string" in b
+                   for b in report.fusion_boundaries), \
+            report.fusion_boundaries
+    finally:
+        data.conf.unset("spark.tpu.encoding.enabled")
 
 
 # ---------------------------------------------------------------------------
