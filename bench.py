@@ -100,6 +100,15 @@ ENCODED = "--encoded" in sys.argv
 if ENCODED:
     sys.argv = [a for a in sys.argv if a != "--encoded"]
 
+# --whole-query: add the whole-query compilation config
+# (physical/whole_query.py): a TPC-DS-mini-shaped join+agg plan compiled
+# as ONE jitted program per step (spark.tpu.compile.tier=whole) vs the
+# per-stage tier. Reports dispatches-per-query both ways and the tier
+# speedup. `python bench.py whole_query` also selects it directly.
+WHOLE_QUERY = "--whole-query" in sys.argv
+if WHOLE_QUERY:
+    sys.argv = [a for a in sys.argv if a != "--whole-query"]
+
 
 # per-config predicted peak HBM (plan_lint memory model) captured by
 # _maybe_analyze so the timed record can print predicted vs measured
@@ -686,6 +695,86 @@ def bench_encoded():
     }
 
 
+def bench_whole_query():
+    """Whole-query compilation scoreboard: a q3-shaped star join
+    (fact scan -> filter -> two broadcast dim joins -> group-by sum)
+    executed under the whole tier (ONE jitted program per step, exchanges
+    lowered to in-program gathers, zero host shuffle round-trips) vs the
+    per-stage tier (PR 1/5 fusion). vs_baseline is the tier speedup;
+    the record carries measured dispatches-per-query for both tiers."""
+    import pyarrow as pa
+
+    import spark_tpu.api.functions as F  # noqa: F401
+    from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+    n_rows = int(10_000_000 * SCALE)
+    session = _session({"spark.tpu.batch.capacity": 1 << 22,
+                        "spark.tpu.fusion.minRows": "0"})
+    rng = np.random.default_rng(23)
+    n_dim = 2048
+    fact = pa.table({
+        "date_sk": rng.integers(0, n_dim, n_rows).astype(np.int64),
+        "item_sk": rng.integers(0, n_dim, n_rows).astype(np.int64),
+        "price": rng.integers(0, 10_000, n_rows).astype(np.int64),
+    })
+    dates = pa.table({
+        "d_date_sk": np.arange(n_dim, dtype=np.int64),
+        "d_year": (1998 + (np.arange(n_dim) // 366)).astype(np.int64),
+        "d_moy": (1 + np.arange(n_dim) % 12).astype(np.int64),
+    })
+    items = pa.table({
+        "i_item_sk": np.arange(n_dim, dtype=np.int64),
+        "i_brand_id": (np.arange(n_dim) % 37).astype(np.int64),
+        "i_manufact_id": (np.arange(n_dim) % 100).astype(np.int64),
+    })
+    fdf = _df_from_table(session, fact, "wq_fact")
+    ddf = _df_from_table(session, dates, "wq_dates")
+    idf = _df_from_table(session, items, "wq_items")
+    fdf.createOrReplaceTempView("wq_fact")
+    ddf.createOrReplaceTempView("wq_dates")
+    idf.createOrReplaceTempView("wq_items")
+    sql = ("select d_year, i_brand_id, sum(price) s from wq_fact "
+           "join wq_dates on date_sk = d_date_sk "
+           "join wq_items on item_sk = i_item_sk "
+           "where d_moy = 11 and i_manufact_id = 28 "
+           "group by d_year, i_brand_id")
+
+    def q():
+        return session.sql(sql)
+
+    session.conf.set("spark.tpu.compile.tier", "whole")
+    _maybe_analyze(q, "whole_query")  # the whole-tier launch model
+    results = {}
+    dispatches = {}
+    for tier in ("whole", "stage"):
+        session.conf.set("spark.tpu.compile.tier", tier)
+        q().toArrow()  # warm: compile the tier's programs
+        before = KC.launches
+        q().toArrow()
+        dispatches[tier] = KC.launches - before
+        best = _best_of(lambda: _run_blocked(q()))
+        results[tier] = (best, _hbm_fields(f"whole_query[{tier}]", best,
+                                           n_rows * 24))
+    session.conf.unset("spark.tpu.compile.tier")
+    best_w, hbm_w = results["whole"]
+    best_s, _hbm_s = results["stage"]
+    rate = n_rows / best_w
+    return {
+        "metric": "whole-query compilation: q3-shaped star join+agg "
+                  f"{n_rows:.0e} fact rows as ONE jitted dispatch per "
+                  "step (spark.tpu.compile.tier=whole; vs_baseline = "
+                  "speedup over the per-stage tier)",
+        "value": round(rate / 1e6, 2),
+        "unit": "M rows/s",
+        "vs_baseline": round(best_s / best_w, 3),
+        **{k: v for k, v in hbm_w.items()},
+        "dispatches_per_query_whole": int(dispatches["whole"]),
+        "dispatches_per_query_stage": int(dispatches["stage"]),
+        "wall_ms_whole": round(best_w * 1e3, 1),
+        "wall_ms_stage": round(best_s * 1e3, 1),
+    }
+
+
 # --------------------------------------------------------------------------
 # #4/#5 TPC-DS q3 / q7 / q19 wall-clock at SF1-equivalent volume
 # --------------------------------------------------------------------------
@@ -790,6 +879,7 @@ CONFIGS = {
     "shuffle": bench_shuffle,
     "mesh": bench_mesh,
     "encoded": bench_encoded,
+    "whole_query": bench_whole_query,
     "tpcds": bench_tpcds,
 }
 
@@ -824,7 +914,8 @@ def _fallback_to_cpu_child() -> int:
                              ("--cluster", CLUSTER),
                              ("--progress", PROGRESS),
                              ("--mesh", MESH),
-                             ("--encoded", ENCODED)) if on]
+                             ("--encoded", ENCODED),
+                             ("--whole-query", WHOLE_QUERY)) if on]
     try:  # stdout inherited: child lines flush straight to the driver
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__)]
@@ -854,7 +945,8 @@ def main() -> int:
     default = [c for c in CONFIGS
                if not (SMOKE and c == "tpcds")
                and (MESH or c != "mesh")       # mesh config is opt-in
-               and (ENCODED or c != "encoded")]  # encoded too
+               and (ENCODED or c != "encoded")  # encoded too
+               and (WHOLE_QUERY or c != "whole_query")]  # and whole-query
     only = sys.argv[1:] or default
     records, failed = [], []
     for name in only:

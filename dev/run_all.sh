@@ -38,6 +38,10 @@ echo "== encoded gate (compressed execution: dict-native kernels, code shuffle) 
 JAX_PLATFORMS=cpu python dev/validate_trace.py --encoded
 python bench.py --smoke --encoded encoded
 
+echo "== whole-query gate (one jitted program per step, 3-tier differential) =="
+JAX_PLATFORMS=cpu python dev/validate_trace.py --whole-query
+python bench.py --smoke --whole-query whole_query
+
 echo "== micro-benchmarks =="
 python benchmarks/run_benchmarks.py --rows "${BENCH_ROWS:-2000000}"
 

@@ -566,21 +566,129 @@ def encoded_gate() -> None:
         session.stop()
 
 
+def whole_query_gate() -> None:
+    """Whole-query compilation gate (--whole-query): a q3-shaped star
+    join + group-by under spark.tpu.compile.tier=whole must (1) produce
+    results identical to the per-stage and operator tiers, (2) execute
+    as EXACTLY the predicted whole_query dispatch count per step (one
+    plus any predicted join-capacity retries; zero per-stage kernels of
+    any kind), (3) show zero unexplained EXPLAIN ANALYZE drift, and (4)
+    surface the tier decision in the analysis report (tier + reason).
+    Self-contained: no trace path required."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu import TpuSession
+    from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+    session = TpuSession("whole-query-gate", {
+        "spark.tpu.batch.capacity": 1 << 12,
+        "spark.sql.shuffle.partitions": 5,
+        "spark.tpu.fusion.minRows": "0",
+        "spark.tpu.ui.operatorMetrics": "true",
+    })
+    try:
+        rng = np.random.default_rng(41)
+        n, nd = 9000, 700
+        session.createDataFrame(pa.table({
+            "date_sk": rng.integers(0, nd, n),
+            "item_sk": rng.integers(0, nd, n),
+            "price": rng.integers(0, 1000, n),
+        })).createOrReplaceTempView("wqg_fact")
+        session.createDataFrame(pa.table({
+            "d_date_sk": np.arange(nd, dtype=np.int64),
+            "d_year": (1998 + np.arange(nd) // 366),
+            "d_moy": (1 + np.arange(nd) % 12),
+        })).createOrReplaceTempView("wqg_dates")
+        session.createDataFrame(pa.table({
+            "i_item_sk": np.arange(nd, dtype=np.int64),
+            "i_brand_id": (np.arange(nd) % 37),
+            "i_manufact_id": (np.arange(nd) % 50),
+        })).createOrReplaceTempView("wqg_items")
+        sql = ("select d_year, i_brand_id, sum(price) s from wqg_fact "
+               "join wqg_dates on date_sk = d_date_sk "
+               "join wqg_items on item_sk = i_item_sk "
+               "where d_moy = 11 and i_manufact_id = 28 "
+               "group by d_year, i_brand_id")
+
+        def q():
+            return session.sql(sql)
+
+        outs = {}
+        for tier in ("whole", "stage", "operator"):
+            session.conf.set("spark.tpu.compile.tier", tier)
+            outs[tier] = (q().toPandas()
+                          .sort_values(["d_year", "i_brand_id"])
+                          .reset_index(drop=True))
+        for tier in ("stage", "operator"):
+            if not outs["whole"].equals(outs[tier]):
+                fail(f"--whole-query: whole-tier results differ from the "
+                     f"{tier} tier (in-program lowering changed answers)")
+
+        session.conf.set("spark.tpu.compile.tier", "whole")
+        report = q().query_execution.analysis_report()
+        if not report.exact:
+            fail("--whole-query: whole tier not exactly predicted: "
+                 f"{report.inexact_reasons}")
+        if (report.tier or {}).get("tier") != "whole":
+            fail("--whole-query: tier decision missing from the analysis "
+                 f"report: {report.tier}")
+        expected = report.predicted_launches
+        if set(expected) != {"whole_query"}:
+            fail(f"--whole-query: predicted kinds {expected} — per-stage "
+                 "kernels leaked into the whole-query program")
+        q().toArrow()  # warm
+        before = dict(KC.launches_by_kind)
+        q().toArrow()
+        measured = {k: v - before.get(k, 0)
+                    for k, v in KC.launches_by_kind.items()
+                    if v != before.get(k, 0)}
+        if measured != expected:
+            fail(f"--whole-query: measured {measured} != predicted "
+                 f"{expected} — the one-dispatch-per-step guarantee "
+                 "regressed")
+
+        # the tier decision rides the execution span (obs contract)
+        tier_spans = [s for s in session.tracer.spans()
+                      if s and s[0] == "whole_query.program"
+                      and (s[6] or {}).get("tier") == "whole"]
+        if not tier_spans:
+            fail("--whole-query: tier decision not visible in spans "
+                 "(no whole_query.program span with args.tier=whole)")
+
+        report = q().query_execution.analyzed_report()
+        errors = [f for f in report.findings if f["severity"] == "error"]
+        if errors:
+            print(report.render())
+            fail("--whole-query: EXPLAIN ANALYZE reported unexplained "
+                 "drift under the whole tier: "
+                 + "; ".join(f["msg"] for f in errors))
+        session.conf.unset("spark.tpu.compile.tier")
+        print("validate_trace: whole-query gate OK — 3 tiers agree, "
+              f"{sum(expected.values())} dispatch(es) per step predicted "
+              "exactly, tier decision surfaced, zero drift")
+    finally:
+        session.stop()
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     cluster = "--cluster" in argv
     live = "--live" in argv
     mesh = "--mesh" in argv
     encoded = "--encoded" in argv
+    whole = "--whole-query" in argv
     argv = [a for a in argv if a not in ("--cluster", "--live", "--mesh",
-                                         "--encoded")]
-    if (mesh or encoded) and not argv:
+                                         "--encoded", "--whole-query")]
+    if (mesh or encoded or whole) and not argv:
         # self-contained legs: these gates generate and validate their
         # own state (dev/run_all.sh runs them without a trace file)
         if mesh:
             mesh_gate()
         if encoded:
             encoded_gate()
+        if whole:
+            whole_query_gate()
         print("validate_trace: PASS")
         return 0
     if len(argv) != 1:
@@ -595,6 +703,8 @@ def main(argv=None) -> int:
         mesh_gate()
     if encoded:
         encoded_gate()
+    if whole:
+        whole_query_gate()
     print("validate_trace: PASS")
     return 0
 

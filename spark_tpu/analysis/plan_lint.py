@@ -83,10 +83,13 @@ class _Batch:
     # a frozenset holds the expr ids the exchange actually accumulates
     # (ShuffleExchangeExec.stat_cols — plan-reachable dense candidates)
     seeded: "bool | frozenset" = False
-    # host-ingested tile (columnar/arrow ingest or shuffle rebuild):
-    # integral columns carry RunInfo metadata, so the sorted-run (ragg)
-    # aggregate variant is reachable — kernel outputs drop it
-    ingest: bool = False
+    # RunInfo-bearing tile: host-ingested (columnar/arrow ingest or
+    # shuffle rebuild — True: every column carries run metadata) or a
+    # pipeline output whose PASS-THROUGH columns inherited the input
+    # tile's RunInfo (a frozenset of the expr ids that kept it; mask-only
+    # filters never reorder rows, so ingest sortedness survives them).
+    # Any other fresh kernel output drops it (Column.with_data).
+    ingest: "bool | frozenset" = False
 
     def probe_free_for(self, expr_id) -> bool:
         """No krange3 dispatch when THIS column's range is consulted:
@@ -95,6 +98,14 @@ class _Batch:
         if self.stable or self.seeded is True:
             return True
         return isinstance(self.seeded, frozenset) and expr_id in self.seeded
+
+    def runs_for(self, expr_id) -> bool:
+        """Column carries ingest RunInfo on this tile (the sorted-run
+        ragg trigger): whole-tile ingest metadata, or run metadata a
+        pass-through pipeline output inherited."""
+        if self.ingest is True:
+            return True
+        return isinstance(self.ingest, frozenset) and expr_id in self.ingest
 
 
 @dataclass
@@ -245,6 +256,10 @@ class AnalysisReport:
     stages: list = field(default_factory=list)
     predicted_launches: dict = field(default_factory=dict)
     exact: bool = True
+    # compile-tier decision (physical/whole_query.choose_tier): which of
+    # whole / stage / operator ran, and the fallback reason when the
+    # cost model declined a higher tier
+    tier: Optional[dict] = None
     inexact_reasons: list = field(default_factory=list)
     fusion_boundaries: list = field(default_factory=list)
     recompile_hazards: list = field(default_factory=list)
@@ -266,6 +281,7 @@ class AnalysisReport:
     def to_dict(self) -> dict:
         return {
             "stages": list(self.stages),
+            "tier": dict(self.tier) if self.tier else None,
             "predicted_launches": dict(self.predicted_launches),
             "predicted_total": self.total,
             "exact": self.exact,
@@ -281,6 +297,9 @@ class AnalysisReport:
 
     def render(self) -> str:
         out = ["== Plan Analysis =="]
+        if self.tier:
+            out.append(f"-- compilation tier: {self.tier.get('tier')} — "
+                       f"{self.tier.get('reason', '')} --")
         out.append("-- stages (kernel launches per warm execution) --")
         for s in self.stages:
             kinds = ", ".join(f"{k}:{v}" for k, v in sorted(
@@ -447,6 +466,15 @@ class _Analyzer:
 
     # -- entry -------------------------------------------------------------
     def run(self, plan) -> AnalysisReport:
+        # compile-tier decision: the planner stashes the chooser's verdict
+        # (incl. the whole-query fallback reason) on the plan root; the
+        # whole tier's own root node carries it directly
+        dec = getattr(plan, "_tier_decision", None)
+        if dec is not None and self.report.tier is None:
+            try:
+                self.report.tier = dec.to_dict()
+            except Exception:
+                pass
         self.visit(plan)
         # zero-count kinds (a probe that never fires on this plan) are
         # bookkeeping, not predictions — the measured delta never lists
@@ -511,7 +539,13 @@ class _Analyzer:
             BroadcastExchangeExec, ShuffleExchangeExec,
         )
         from ..physical.fusion import FusedAggregateExec, FusedLimitExec
+        from ..physical.python_eval import PythonEvalExec
+        from ..physical.whole_query import WholeQueryExec
 
+        if isinstance(node, WholeQueryExec):
+            return self._whole_query(node)
+        if isinstance(node, PythonEvalExec):
+            return self._python_eval(node)
         if isinstance(node, O.LocalTableScanExec):
             return self._local_scan(node)
         if isinstance(node, O.ScanExec):
@@ -566,14 +600,18 @@ class _Analyzer:
                     and (pa.types.is_string(t.value_type)
                          or pa.types.is_large_string(t.value_type))))
 
-    def _local_scan(self, node) -> _Flow:
+    def _table_trace(self, node) -> tuple:
+        """(row count, value trace | None) of a LocalTableScan — shared
+        by the per-stage layout model and the whole-query mirror."""
+        return self._arrow_trace(node.table, node.attrs)
+
+    def _arrow_trace(self, table, attrs) -> tuple:
         import pyarrow as pa
 
-        table = node.table
         n = table.num_rows
         cols = {}
         if 0 < n <= _TRACE_MAX_ROWS:
-            names = {a.name: a for a in node.attrs}
+            names = {a.name: a for a in attrs}
             for fld in table.schema:
                 a = names.get(fld.name)
                 if a is None:
@@ -599,7 +637,10 @@ class _Analyzer:
                     vals[:] = ["" if v is None else v
                                for v in arr.to_pylist()]
                     cols[a.expr_id] = (vals, valid)
-        trace = _Trace(cols, np.ones(n, bool)) if cols else None
+        return n, (_Trace(cols, np.ones(n, bool)) if cols else None)
+
+    def _local_scan(self, node) -> _Flow:
+        n, trace = self._table_trace(node)
         flow = _Flow([self._batches_for_rows(n)], trace)
         self._stage(node, Counter(), flow.total_batches,
                     [f"{n} rows, device-cached (stable identity)"])
@@ -669,21 +710,33 @@ class _Analyzer:
                     ["external source: per-partition batch counts unknown"])
         return flow
 
+    def _part_tiles(self, total: int, nparts: int) -> list:
+        """Per-partition (rows, capacity) tile layout of `total` rows
+        ceil-div split across `nparts` partitions, each partition tiled
+        at spark.tpu.batch.capacity — the ONE mirror of
+        RangeExec.execute / InMemorySource.read_partition +
+        table_to_batches leaf batching (shared by the per-stage layout
+        model and the whole-query walk so the formulas cannot drift)."""
+        per = -(-total // nparts) if total else 0
+        out = []
+        for q in range(nparts):
+            lo = min(q * per, total)
+            hi = min(lo + per, total)
+            tiles = [(min(self._tile, hi - s),
+                      bucket_capacity(min(self._tile, hi - s)))
+                     for s in range(lo, hi, self._tile)] \
+                or [(0, _EMPTY_CAP)]
+            out.append(tiles)
+        return out
+
     def _range(self, node) -> _Flow:
         step = node.step
         total = max(0, -(-(node.end - node.start) // step)) if step > 0 \
             else max(0, -(-(node.start - node.end) // -step))
         per = -(-total // node.num_partitions)
-        parts = []
-        for p in range(node.num_partitions):
-            lo = min(p * per, total)
-            hi = min(lo + per, total)
-            batches = [_Batch(min(self._tile, hi - s),
-                              bucket_capacity(min(self._tile, hi - s)),
-                              False)
-                       for s in range(lo, hi, self._tile)] \
-                or [_Batch(0, _EMPTY_CAP, False)]
-            parts.append(batches)
+        parts = [[_Batch(r, c, False) for r, c in tiles]
+                 for tiles in self._part_tiles(total,
+                                               node.num_partitions)]
         trace = None
         ptraces = None
         if 0 < total <= _TRACE_MAX_ROWS:
@@ -708,6 +761,29 @@ class _Analyzer:
     def _compute_trivial(node) -> bool:
         return not node.filters and all(
             isinstance(o, AttributeReference) for o in node.outputs)
+
+    @staticmethod
+    def _passthrough_runs(outputs, child_attrs,
+                          b_ingest) -> "bool | frozenset":
+        """RunInfo survival through a pipeline: expr ids of OUTPUTS that
+        are pass-through attribute references (or aliases of one) whose
+        source column carries run metadata on the input tile — the
+        runtime attaches the same RunInfo object to those output columns
+        (physical/compile.ExprPipeline), so sorted-run (ragg) aggregation
+        stays reachable on filter/project→agg chains."""
+        if not b_ingest:
+            return False
+        src = {a.expr_id for a in child_attrs} if b_ingest is True \
+            else set(b_ingest)
+        out = set()
+        for o in outputs:
+            if isinstance(o, AttributeReference) and o.expr_id in src:
+                out.add(o.expr_id)
+            elif isinstance(o, Alias) \
+                    and isinstance(o.child, AttributeReference) \
+                    and o.child.expr_id in src:
+                out.add(o.expr_id)
+        return frozenset(out) if out else False
 
     def _project_trace(self, trace, filters, outputs) -> Optional[_Trace]:
         if trace is None:
@@ -760,7 +836,10 @@ class _Analyzer:
         else:
             self._approx(f"pipeline launches of {node.simple_string()[:60]} "
                          "depend on an unknown upstream batch count")
-        parts = [[_Batch(b.rows, b.cap, False) for b in p]
+        parts = [[_Batch(b.rows, b.cap, False,
+                         ingest=self._passthrough_runs(
+                             node.outputs, node.child.output, b.ingest))
+                  for b in p]
                  for p in child.parts]
         trace = self._project_trace(child.trace, node.filters, node.outputs)
         flow = _Flow(parts, trace, counted=child.counted,
@@ -897,7 +976,7 @@ class _Analyzer:
             # tiles ingested by this process carry no RunInfo (session
             # started under the decoded oracle) — ragg is unreachable
             return False
-        if len(batches) != 1 or not batches[0].ingest:
+        if len(batches) != 1 or not batches[0].runs_for(kid):
             return False
         b = batches[0]
         if trace is None or b.rows is None or b.rows != len(trace.live):
@@ -1135,9 +1214,15 @@ class _Analyzer:
                 known_sum = sum(caps)
             if known_sum is not None and known_sum < self._min_rows:
                 # runtime size gate: unfused operator-at-a-time kernels
+                # (the materialized pipeline outputs keep pass-through
+                # RunInfo, so ragg stays reachable behind the gate)
                 kinds["pipeline"] += len(p)
                 ob, ot = self._agg_chunk_kinds(node, [
-                    _Batch(b.rows, b.cap, False) for b in p],
+                    _Batch(b.rows, b.cap, False,
+                           ingest=self._passthrough_runs(
+                               node.pipe_outputs, node.child.output,
+                               b.ingest))
+                    for b in p],
                     pipe_trace, kinds, notes)
                 notes.append(
                     f"partition under spark.tpu.fusion.minRows="
@@ -2187,6 +2272,359 @@ class _Analyzer:
                      "compile per capacity bucket, 1 launch/batch"])
         return _Flow(parts, None, counted=child.counted)
 
+    # -- python UDF evaluation ---------------------------------------------
+    def _python_eval(self, node) -> _Flow:
+        """PythonEvalExec launch model: one argument-pipeline dispatch per
+        batch per UDF (the UDF itself runs host-side — zero kernel
+        launches); the output batch wraps the SAME input columns plus one
+        fresh host-built column, so identity/seed/RunInfo metadata and the
+        value trace all pass through (the UDF column stays untraced)."""
+        child = self.visit(node.child)
+        kinds = Counter()
+        notes = []
+        nudf = len(node.udf_aliases)
+        if child.counted:
+            kinds["pipeline"] = nudf * child.total_batches
+        else:
+            self._approx("python UDF argument-pipeline launches depend on "
+                         "an unknown upstream batch count")
+        self._sync("python UDFs pull live argument rows to host once per "
+                   "batch (by design: host evaluation)")
+        if self._encoding:
+            for al in node.udf_aliases:
+                udf = al.child
+                args = getattr(udf, "args", [])
+                if len(args) == 1 \
+                        and isinstance(args[0], AttributeReference) \
+                        and isinstance(args[0].dtype, StringType) \
+                        and getattr(udf, "deterministic", True):
+                    notes.append(
+                        f"{getattr(udf, 'fname', 'udf')}: dictionary-"
+                        "domain lane — the UDF evaluates once per "
+                        "DISTINCT value of its dictionary-encoded string "
+                        "argument and maps over codes (per-row only when "
+                        "the domain is not smaller than the live rows)")
+                    break
+        parts = [[_Batch(b.rows, b.cap, b.stable, seeded=b.seeded,
+                         ingest=b.ingest) for b in p]
+                 for p in child.parts]
+        self._stage(node, kinds, child.total_batches if child.counted
+                    else None, notes)
+        return _Flow(parts, child.trace, counted=child.counted,
+                     ptraces=child.ptraces)
+
+    # -- whole-query tier ---------------------------------------------------
+    def _whole_query(self, node) -> _Flow:
+        """Launch model of the whole-query tier (physical/whole_query.py):
+        the ENTIRE plan is ONE jitted program — leaves execute launch-free
+        (device-cached ingest), exchanges lower to in-program gathers, and
+        the only dispatches are the program itself plus one re-dispatch
+        per join output-capacity retry round. The mirror walks the inner
+        plan with the single-flow layout (gathered capacities) and the
+        value model to predict the retry count EXACTLY when the join keys
+        trace; memory is the fully-resident sum of every lowered
+        operator's tile plus the leaf input planes."""
+        from ..exec.memory import schema_row_bytes
+        from ..physical import operators as O
+        from ..physical.exchange import (
+            BroadcastExchangeExec, ShuffleExchangeExec,
+        )
+        from ..physical.fusion import FusedAggregateExec, FusedLimitExec
+        from ..physical.operators import attrs_schema
+
+        kinds = Counter()
+        notes = []
+        dec = getattr(node, "decision", None)
+        if dec is not None:
+            self.report.tier = dec.to_dict()
+            notes.append(f"tier decision: {dec.reason}")
+        hbm = [0]
+        untraced = [False]
+        # retry-loop state shared across simulation rounds: per-join
+        # output capacities in lowering order, exactly as the runtime's
+        # join_caps list evolves
+        caps_state: dict[int, int] = {}
+        round_state = {"seq": 0, "overflow": []}
+
+        def mem(n, cap, extra_planes: int = 0):
+            try:
+                rb = schema_row_bytes(attrs_schema(n.output))
+            except Exception:
+                rb = 16
+                self._mem_approx(f"{type(n).__name__}: output schema "
+                                 "unavailable — 16 B/row assumed")
+            hbm[0] += (cap + extra_planes) * rb
+
+        def walk(n):
+            """(gathered cap, value trace | None) of the lowered flow."""
+            if isinstance(n, O.LocalTableScanExec):
+                rows, trace = self._table_trace(n)
+                caps = [b.cap for b in self._batches_for_rows(rows)]
+                cap = bucket_capacity(max(sum(caps), 1))
+                mem(n, cap, extra_planes=sum(caps))
+                return cap, trace
+            if isinstance(n, O.ScanExec):
+                from ..physical.whole_query import _scan_table
+
+                t = _scan_table(n)
+                if t is None:
+                    self._approx("whole-query leaf layout unknown "
+                                 f"(external scan [{n.name}])")
+                    return self._tile, None
+                caps = [c for tiles in self._part_tiles(
+                    t.num_rows, n.source.num_partitions())
+                    for _r, c in tiles]
+                cap = bucket_capacity(max(sum(caps), 1))
+                _rows2, trace = self._arrow_trace(t, n.attrs)
+                mem(n, cap, extra_planes=sum(caps))
+                return cap, trace
+            if isinstance(n, O.RangeExec):
+                step = n.step
+                total = max(0, -(-(n.end - n.start) // step)) if step > 0 \
+                    else max(0, -(-(n.start - n.end) // -step))
+                caps = [c for tiles in self._part_tiles(
+                    total, n.num_partitions) for _r, c in tiles]
+                cap = bucket_capacity(max(sum(caps), 1))
+                trace = None
+                if 0 < total <= _TRACE_MAX_ROWS:
+                    vals = n.start + np.arange(total, dtype=np.int64) * step
+                    trace = _Trace({n.attr.expr_id: (vals, None)},
+                                   np.ones(total, bool))
+                mem(n, cap, extra_planes=sum(caps))
+                return cap, trace
+            if isinstance(n, O.ComputeExec):
+                cap, tr = walk(n.child)
+                mem(n, cap)
+                return cap, self._project_trace(tr, n.filters, n.outputs)
+            if isinstance(n, ShuffleExchangeExec):
+                cap, tr = walk(n.child)
+                if n.pipe_fusion is not None:
+                    f_, o_ = n.pipe_fusion
+                    tr = self._project_trace(tr, f_, o_)
+                    mem(n, cap)
+                return cap, tr
+            if isinstance(n, (BroadcastExchangeExec,
+                              O.CoalescePartitionsExec)):
+                return walk(n.child)
+            if isinstance(n, FusedAggregateExec):
+                cap, _tr = walk(n.child)
+                out_cap = cap if n.grouping else 8
+                mem(n, out_cap)
+                return out_cap, None
+            if isinstance(n, O.HashAggregateExec):
+                cap, _tr = walk(n.child)
+                out_cap = cap if n.grouping else 8
+                mem(n, out_cap)
+                return out_cap, None
+            if isinstance(n, (FusedLimitExec, O.LimitExec, O.SortExec)):
+                cap, _tr = walk(n.child)
+                mem(n, cap)
+                return cap, None
+            if isinstance(n, O.UnionExec):
+                pairs = [walk(c) for c in n.children_plans]
+                cap = bucket_capacity(max(sum(c for c, _ in pairs), 1))
+                traces = [t for _, t in pairs]
+                tr = self._merge_group_traces(traces) \
+                    if all(t is not None for t in traces) else None
+                mem(n, cap)
+                return cap, tr
+            if isinstance(n, O.HashJoinExec):
+                pcap, ptr = walk(n.left)
+                if n.probe_fusion is not None:
+                    f_, o_ = n.probe_fusion
+                    ptr = self._project_trace(ptr, f_, o_)
+                bcap, btr = walk(n.right)
+                jid = round_state["seq"]
+                round_state["seq"] += 1
+                out_cap = caps_state.setdefault(jid, max(pcap, 1 << 10))
+                needed = self._whole_join_needed(n, ptr, btr)
+                if needed is None:
+                    untraced[0] = True
+                elif needed > out_cap:
+                    round_state["overflow"].append(
+                        (jid, bucket_capacity(needed)))
+                mem(n, out_cap)
+                out_tr = self._whole_join_trace(n, ptr, btr)
+                if out_tr is not None and needed is not None \
+                        and needed > out_cap:
+                    # the failed attempt TRUNCATES at the output bucket:
+                    # downstream joins of this round see the prefix (the
+                    # kernel fills output slots probe-major, within a
+                    # probe row's block in original build-row order —
+                    # exactly this expansion's order)
+                    if n.join_type == "inner" \
+                            and len(out_tr.live) >= out_cap:
+                        sel = np.arange(out_cap)
+                        out_tr = out_tr.select(sel, True)
+                    else:
+                        untraced[0] = True
+                        out_tr = None
+                return out_cap, out_tr
+            # admission should prevent this; degrade honestly
+            self._approx(f"whole-query mirror missing for "
+                         f"{type(n).__name__}")
+            return self._tile, None
+
+        # mirror of WholeQueryExec.execute's retry loop: each round
+        # re-walks with the bumped capacities; truncated upstream traces
+        # make the observed `needed` of cascading joins exact too. The
+        # memory model keeps the LAST round's accumulation — the peak
+        # attempt runs with the bumped join output buckets
+        attempts = 0
+        out_cap, out_tr = self._tile, None
+        while attempts < 8:
+            attempts += 1
+            round_state["seq"] = 0
+            round_state["overflow"] = []
+            hbm[0] = 0
+            out_cap, out_tr = walk(node.plan)
+            if untraced[0] or not round_state["overflow"]:
+                break
+            for jid, newcap in round_state["overflow"]:
+                caps_state[jid] = newcap
+        if untraced[0]:
+            self._approx("whole-query join output capacity untraced (key "
+                         "values outside the traced language): retry "
+                         "dispatches unpredictable")
+        if attempts > 1:
+            notes.append(
+                f"{attempts - 1} capacity "
+                f"retr{'y' if attempts == 2 else 'ies'}: a join "
+                "overflowed its output bucket and the whole program "
+                "re-dispatched with the bumped capacity")
+        kinds["whole_query"] = attempts
+        notes.insert(0, "WHOLE-QUERY program: all stages in ONE jitted "
+                        "dispatch per step — exchanges lowered to "
+                        "in-program gathers, intermediates never leave "
+                        "HBM, zero host shuffle round-trips")
+        self._sync("whole-query join capacity verdicts sync once after "
+                   "the single dispatch (the query's last device "
+                   "interaction before collect)")
+        self._hazard("whole-query join output capacities are "
+                     "value-dependent program-key components — match "
+                     "growth recompiles the whole program")
+        self._stage(node, kinds, 1, notes)
+        ent = self._stage_by_node.get(id(node))
+        if ent is not None and "hbm_bytes" not in ent:
+            ent["hbm_bytes"] = hbm[0]
+            self._hbm_total += hbm[0]
+            self._hbm_any = True
+        return _Flow([[_Batch(None, out_cap, False)]], out_tr,
+                     counted=True)
+
+    def _whole_join_needed(self, node, ptr, btr):
+        """Mirror of ops/joining.probe_join's `needed` scalar over the
+        single gathered flow: per live probe row, the count of verified
+        build matches (semi/anti/outer reserve >= 1 slot per live row).
+        None when the keys/values are outside the traced language."""
+        if len(node.left_keys) != 1 or ptr is None or btr is None:
+            return None
+        pent = ptr.cols.get(node.left_keys[0].expr_id)
+        bstats = btr.stats(node.right_keys[0].expr_id)
+        if pent is None or bstats is None:
+            return None
+        pv, pvalid = pent
+        live = ptr.live
+        usable = live if pvalid is None else (live & pvalid)
+        counts = np.zeros(len(pv), np.int64)
+        if bstats.size:
+            bvals, bcounts = np.unique(bstats, return_counts=True)
+            if pv.dtype == object or bvals.dtype == object:
+                cmap = {v: int(c) for v, c in zip(bvals.tolist(),
+                                                  bcounts.tolist())}
+                counts = np.array([cmap.get(x, 0) for x in pv.tolist()],
+                                  np.int64)
+            else:
+                idx = np.clip(np.searchsorted(bvals, pv), 0,
+                              len(bvals) - 1)
+                counts = np.where(bvals[idx] == pv, bcounts[idx],
+                                  0).astype(np.int64)
+        counts = np.where(usable, counts, 0)
+        if node.join_type != "inner":
+            counts = np.maximum(counts, live.astype(np.int64))
+        return int(counts.sum())
+
+    def _whole_join_trace(self, node, ptr, btr):
+        """Value trace through an in-program join (whole-query mirror):
+        the output MULTISET of probe AND build columns — semi/anti select
+        probe rows; inner joins expand fully (each live usable probe row
+        repeats once per matching build row, duplicate build keys
+        included); left_outer maps 1:1 when the build key is unique.
+        Downstream whole-query consumers only SUM over these traces
+        (further join `needed` counts), so within-group ordering need not
+        mirror the kernel's hash-sorted layout."""
+        jt = node.join_type
+        if ptr is None or btr is None or len(node.left_keys) != 1:
+            return None
+        pent = ptr.cols.get(node.left_keys[0].expr_id)
+        bent = btr.cols.get(node.right_keys[0].expr_id)
+        if pent is None or bent is None:
+            return None
+        pv, pvalid = pent
+        live = ptr.live
+        usable = live if pvalid is None else (live & pvalid)
+        bvals_all, bvalid_all = bent
+        blive = btr.live if bvalid_all is None \
+            else (btr.live & bvalid_all)
+        bsel = np.nonzero(blive)[0]
+        bkeys = bvals_all[bsel]
+
+        def probe_only(sel):
+            cols = {k: (v[sel], None if vv is None else vv[sel])
+                    for k, (v, vv) in ptr.cols.items()}
+            return _Trace(cols, np.ones(len(sel), bool), True,
+                          dict(ptr.dict_domains), False)
+
+        if jt in ("left_semi", "left_anti"):
+            matched = usable & np.isin(pv, bkeys)
+            sel_mask = matched if jt == "left_semi" \
+                else (live & ~matched)
+            return probe_only(np.nonzero(sel_mask)[0])
+        if jt == "left_outer":
+            if np.unique(bkeys).size != bkeys.size:
+                return None  # dup-build outer expansion: layout unclear
+            sel = np.nonzero(live)[0]
+            out = probe_only(sel)
+            # 1:1 build-column mapping: matched rows gather the build
+            # row, unmatched rows read NULL
+            order = np.argsort(bkeys, kind="stable")
+            bs = bkeys[order]
+            pos = np.clip(np.searchsorted(bs, pv[sel]), 0,
+                          max(len(bs) - 1, 0))
+            hit = (len(bs) > 0) & usable[sel]
+            if len(bs):
+                hit = hit & (bs[pos] == pv[sel])
+            pick = bsel[order][pos] if len(bs) else np.zeros(len(sel), int)
+            for k, (bv, bvv) in btr.cols.items():
+                vals = bv[pick] if len(bs) else np.zeros(len(sel),
+                                                         bv.dtype)
+                valid = np.asarray(hit, bool).copy()
+                if bvv is not None and len(bs):
+                    valid &= bvv[pick]
+                out.cols.setdefault(k, (vals, valid))
+            return out
+        if jt != "inner":
+            return None
+        # inner: full expansion over sorted build keys
+        order = np.argsort(bkeys, kind="stable")
+        bs = bkeys[order]
+        lo = np.searchsorted(bs, pv, side="left")
+        hi = np.searchsorted(bs, pv, side="right")
+        counts = np.where(usable, hi - lo, 0).astype(np.int64)
+        total = int(counts.sum())
+        src = np.repeat(np.arange(len(pv)), counts)
+        starts = np.repeat(np.cumsum(counts) - counts, counts)
+        within = np.arange(total) - starts
+        offs = np.repeat(lo, counts) + within
+        pick = bsel[order][offs] if total else np.zeros(0, int)
+        cols = {k: (v[src], None if vv is None else vv[src])
+                for k, (v, vv) in ptr.cols.items()}
+        for k, (bv, bvv) in btr.cols.items():
+            cols.setdefault(k, (bv[pick],
+                                None if bvv is None else bvv[pick]))
+        return _Trace(cols, np.ones(total, bool), True,
+                      dict(ptr.dict_domains), False)
+
     def _unknown(self, node) -> _Flow:
         flows = [self.visit(c) for c in node.children]
         self._approx(f"{type(node).__name__}: no launch model — counts "
@@ -2214,6 +2652,11 @@ class _Analyzer:
             out.append("whole-stage fusion DISABLED "
                        "(spark.tpu.fusion.enabled=false): operator-at-a-"
                        "time oracle — every stage boundary is unfused")
+        if (self.report.tier or {}).get("tier") == "operator":
+            out.append("compilation tier OPERATOR "
+                       "(spark.tpu.compile.tier): shared operator-at-a-"
+                       "time kernels — whole-stage fusion rewrites "
+                       "skipped at plan time")
         for node in plan.iter_nodes():
             if isinstance(node, FusedAggregateExec):
                 out.append(f"FUSED {node.simple_string()[:80]}: pipeline "

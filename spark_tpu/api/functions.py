@@ -465,9 +465,15 @@ def lead(c, offset: int = 1, default=None) -> Column:
 
 # --- python UDFs ------------------------------------------------------------
 
-def udf(f=None, returnType=None):
+def udf(f=None, returnType=None, deterministic: bool = True):
     """Vectorized Python UDF (Arrow-UDF analog): the function receives numpy
-    arrays (falls back to row-at-a-time when that fails)."""
+    arrays (falls back to row-at-a-time when that fails).
+
+    `deterministic=False` (the asNondeterministic analog) opts out of
+    value-level optimizations — in particular the dictionary-domain lane
+    that evaluates a deterministic UDF once per DISTINCT value of a
+    dictionary-encoded string argument (physical/python_eval.py); a
+    non-deterministic UDF must run per row."""
     from ..expr.pyudf import PythonUDF
     from ..types import DataType, float64
 
@@ -480,7 +486,8 @@ def udf(f=None, returnType=None):
     def wrap(fn):
         def call(*cols):
             return Column(PythonUDF(fn, [_c(c) for c in cols], rt,
-                                    name=getattr(fn, "__name__", "udf")))
+                                    name=getattr(fn, "__name__", "udf"),
+                                    deterministic=deterministic))
 
         call.__name__ = getattr(fn, "__name__", "udf")
         return call

@@ -188,7 +188,9 @@ class TpuSession:
     def _planner(self):
         from ..physical.planner import Planner
 
-        return Planner(self.conf)
+        return Planner(
+            self.conf,
+            cluster=getattr(self, "_sql_cluster", None) is not None)
 
     # ------------------------------------------------------------------
     @property

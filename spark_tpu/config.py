@@ -207,6 +207,29 @@ FUSION_EXCHANGE = _register(ConfigEntry(
     "dispatch per map batch. Requires spark.tpu.fusion.enabled; subject "
     "to the spark.tpu.fusion.minRows size gate.", _bool))
 
+COMPILE_TIER = _register(ConfigEntry(
+    "spark.tpu.compile.tier", "auto",
+    "Compilation tier: 'whole' compiles the ENTIRE query — all stages, "
+    "exchanges lowered to in-program gathers — into ONE jitted program "
+    "per step (zero host shuffle round-trips; physical/whole_query.py); "
+    "'stage' compiles one program per stage per batch (PR 1/5/8 fusion, "
+    "with the per-partition minRows runtime gate as the stage->operator "
+    "fallback); 'operator' forces the shared operator-at-a-time kernels "
+    "(the differential oracle). 'auto' (default) chooses from predicted "
+    "compile cost, predicted fully-resident HBM (spark.tpu.memory.budget "
+    "admission), and batch volume (spark.tpu.compile.whole.minRows), "
+    "falling back tier-by-tier when statistics are unknown or budgets "
+    "are exceeded — the generalization of the spark.tpu.fusion.minRows "
+    "gate to whole programs.", str))
+
+WHOLE_MIN_ROWS = _register(ConfigEntry(
+    "spark.tpu.compile.whole.minRows", 1 << 17,
+    "Leaf-row volume floor for the auto tier to choose whole-query "
+    "compilation (scaled up with program depth: deeper programs need "
+    "more volume to amortize the bigger XLA compile). The whole-query "
+    "analog of spark.tpu.fusion.minRows. Forced tier=whole ignores the "
+    "floor (structural and memory admission still apply).", int))
+
 ENCODING_ENABLED = _register(ConfigEntry(
     "spark.tpu.encoding.enabled", True,
     "Compressed execution: kernels operate directly on encoded columns. "

@@ -30,12 +30,18 @@ class PythonUDF(Expression):
 
     def __init__(self, fn: Callable, args: Sequence[Expression],
                  return_type: DataType, name: str = "udf",
-                 vectorized: bool = True):
+                 vectorized: bool = True, deterministic: bool = True):
         self.fn = fn
         self.args = list(args)
         self.return_type = return_type
         self.fname = name
         self.vectorized = vectorized
+        # deterministic element-wise contract (the engine evaluates UDFs
+        # per batch, so batch-shape-dependent functions are already out of
+        # contract): licenses the dictionary-domain evaluation lane
+        # (physical/python_eval.py — evaluate once per DISTINCT value of a
+        # dictionary-encoded argument, map over codes)
+        self.deterministic = deterministic
 
     @property
     def dtype(self) -> DataType:

@@ -213,13 +213,33 @@ def _exchange_tail(arrays, pids, row_mask, num_out: int, quota: int,
     return outs, new_mask, count, total_overflow
 
 
+def _embed_block(x, shard_cap: int):
+    """Per-shard re-layout of a BASE plane block (quota-retry restaging):
+    the shard's geometry-independent [base_rows] data block embeds at
+    offset 0 of a zero-padded [shard_cap] send plane — the device-side
+    equivalent of _pad_shards, so a retry never re-crosses the host."""
+    import jax.numpy as jnp
+
+    if x is None:
+        return None
+    out = jnp.zeros((shard_cap,), dtype=x.dtype)
+    return out.at[: x.shape[0]].set(x)
+
+
 def build_plain_stage(mesh, axis: str, quota: int, num_out: int,
                       n_keys: int, key_valid_sig: tuple,
-                      n_payloads: int, donate: bool):
+                      n_payloads: int, donate: bool,
+                      base_rows: "int | None" = None):
     """Jitted mesh stage for PRE-MATERIALIZED batches: pids from staged
     key arrays + all-to-all, payload/mask send buffers donated. Signature:
     f(key_eqs, key_valids, payloads, row_mask) ->
-    (out_payloads, new_mask, counts[P], overflow)."""
+    (out_payloads, new_mask, counts[P], overflow).
+
+    With `base_rows`, inputs are PERSISTED base planes ([P*base_rows]
+    row-sharded, geometry-independent): each shard embeds its block into
+    the [shard_cap] send layout in-program, nothing is donated (the base
+    planes survive for the next quota retry), and a retry pays only the
+    recompile — not the host->device restage."""
     import jax
 
     from ..ops.hashing import hash_columns, partition_ids
@@ -227,8 +247,14 @@ def build_plain_stage(mesh, axis: str, quota: int, num_out: int,
 
     layout = MeshSpecLayout(axis)
     rows = layout.rows()
+    shard_cap = num_out * quota
 
     def local_fn(key_eqs, key_valids, payloads, row_mask):
+        if base_rows is not None:
+            key_eqs = [_embed_block(k, shard_cap) for k in key_eqs]
+            key_valids = [_embed_block(v, shard_cap) for v in key_valids]
+            payloads = [_embed_block(p, shard_cap) for p in payloads]
+            row_mask = _embed_block(row_mask, shard_cap)
         h = hash_columns(key_eqs, list(key_valids))
         pids = partition_ids(h, num_out)
         return _exchange_tail(payloads, pids, row_mask, num_out, quota,
@@ -250,13 +276,15 @@ def build_plain_stage(mesh, axis: str, quota: int, num_out: int,
     # built exclusively through GLOBAL_KERNEL_CACHE.get_or_build
     # (mesh_exchange) — launches ride the dispatch counters
     return jax.jit(sharded,  # tpulint: ignore[raw-jit]
-                   donate_argnums=(2, 3) if donate else ())
+                   donate_argnums=(2, 3) if donate and base_rows is None
+                   else ())
 
 
 def build_fused_stage(mesh, axis: str, shard_cap: int, quota: int,
                       num_out: int, seed: int, input_attrs,
                       filters, outputs, key_idx: tuple, key_bool: tuple,
-                      out_valid_sig: tuple, donate: bool):
+                      out_valid_sig: tuple, donate: bool,
+                      base_rows: "int | None" = None):
     """Jitted mesh stage for a FUSED shuffle stage: the filter/project
     pipeline traces per shard, partition ids derive from the traced key
     outputs, and the all-to-all ships the pipeline OUTPUT columns — the
@@ -277,6 +305,13 @@ def build_fused_stage(mesh, axis: str, shard_cap: int, quota: int,
     n_in = len(input_attrs)
 
     def local_fn(datas, valids, row_mask, aux):
+        if base_rows is not None:
+            # quota-retry restaging: geometry-independent base planes
+            # re-lay out to the attempt's [shard_cap] send layout
+            # in-program (no host->device restage on retries)
+            datas = [_embed_block(d, shard_cap) for d in datas]
+            valids = [_embed_block(v, shard_cap) for v in valids]
+            row_mask = _embed_block(row_mask, shard_cap)
         out_datas, out_valids, mask = trace_pipeline(
             input_attrs, filters, outputs, datas, valids, row_mask, aux,
             shard_cap)
@@ -311,4 +346,5 @@ def build_fused_stage(mesh, axis: str, shard_cap: int, quota: int,
     # built exclusively through GLOBAL_KERNEL_CACHE.get_or_build
     # (mesh_exchange) — launches ride the dispatch counters
     return jax.jit(sharded,  # tpulint: ignore[raw-jit]
-                   donate_argnums=(0, 1, 2) if donate else ())
+                   donate_argnums=(0, 1, 2) if donate and base_rows is None
+                   else ())

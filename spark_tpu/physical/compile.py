@@ -397,7 +397,35 @@ class ExprPipeline:
                                                      batch.row_mask, aux)
         cols = pipeline_columns(self.out_schema.fields, host_outs, out_datas,
                                 out_valids)
+        cols = self._propagate_runs(batch, cols)
         return ColumnarBatch(self.out_schema, cols, new_mask, num_rows=None)
+
+    def _propagate_runs(self, batch: ColumnarBatch, cols: list) -> list:
+        """Pass-through outputs inherit the input column's ingest RunInfo:
+        the kernel emits a FRESH array, but a pure attribute reference
+        carries the same values row-for-row and mask-only filters never
+        reorder rows, so sortedness metadata harvested at ingest still
+        describes the output plane — the sorted-run (ragg) aggregate
+        stays reachable on filter/project→agg chains, not just direct
+        scan→agg (compressed execution; plan_lint mirrors via
+        _Batch.ingest pass-through sets)."""
+        from dataclasses import replace as _replace
+
+        from ..expr.expressions import Alias as _Alias
+
+        any_runs = any(c.runs is not None for c in batch.columns)
+        if not any_runs:
+            return cols
+        in_pos = {a.expr_id: i for i, a in enumerate(self.input_attrs)}
+        out = []
+        for o, col in zip(self.outputs, cols):
+            target = o.child if isinstance(o, _Alias) else o
+            if isinstance(target, AttributeReference):
+                i = in_pos.get(target.expr_id)
+                if i is not None and batch.columns[i].runs is not None:
+                    col = _replace(col, runs=batch.columns[i].runs)
+            out.append(col)
+        return out
 
     def _build_kernel(self, cap: int):
         import jax

@@ -49,12 +49,13 @@ def _row_width(attrs: Sequence[AttributeReference]) -> int:
 
 
 class Planner:
-    def __init__(self, conf: SQLConf):
+    def __init__(self, conf: SQLConf, cluster: bool = False):
         self.conf = conf
+        self.cluster = cluster
 
     # ------------------------------------------------------------------
     def plan(self, plan: L.LogicalPlan) -> PhysicalPlan:
-        from ..config import FUSION_ENABLED
+        from ..config import COMPILE_TIER, FUSION_ENABLED
         from .fusion import collapse_computes, fuse_stages
 
         p = self._convert(plan)
@@ -63,7 +64,8 @@ class Planner:
         # CollapseCodegenStages slot); off = operator-at-a-time oracle.
         # Adjacent-ComputeExec collapsing is an invariant, not a mode.
         p = collapse_computes(p)
-        if self.conf.get(FUSION_ENABLED):
+        tier_pref = str(self.conf.get(COMPILE_TIER)).lower()
+        if self.conf.get(FUSION_ENABLED) and tier_pref != "operator":
             p = fuse_stages(p, self.conf)
         self._inject_dpp(p)
         from .exchange import annotate_exchange_stat_cols
@@ -72,6 +74,12 @@ class Planner:
         # stat positions index the FUSED output): restrict map-side
         # shuffle stat accumulation to plan-reachable dense candidates
         annotate_exchange_stat_cols(p)
+        # compile-tier cost model (physical/whole_query.py): collapse a
+        # slice-resident plan into ONE jitted program, or stash the
+        # fallback decision for explain("analysis")
+        from .whole_query import apply_compile_tier
+
+        p = apply_compile_tier(p, self.conf, cluster=self.cluster)
         return p
 
     # ------------------------------------------------------------------

@@ -72,9 +72,11 @@ class PythonEvalExec(PhysicalPlan):
         for al, pipe in zip(self.udf_aliases, self._pipelines()):
             udf = al.child
             arg_batch = pipe.run(cur)
-            args = [c.to_numpy(sel) for c in arg_batch.columns]
             with ctx.metrics.time("python_udf"):
-                result = self._call(udf, args, len(sel))
+                result = self._dict_domain_call(udf, arg_batch, sel, ctx)
+                if result is None:
+                    args = [c.to_numpy(sel) for c in arg_batch.columns]
+                    result = self._call(udf, args, len(sel))
             col = self._to_column(udf.return_type, result, sel, cap)
             new_cols.append(col)
             cur_attrs.append(al.to_attribute())
@@ -83,6 +85,67 @@ class PythonEvalExec(PhysicalPlan):
         schema = attrs_schema(self.output)
         return ColumnarBatch(schema, new_cols, batch.row_mask,
                              batch._num_rows)
+
+    def _dict_domain_call(self, udf, arg_batch: ColumnarBatch,
+                          sel: np.ndarray, ctx):
+        """Dictionary-domain evaluation lane (compressed execution): a
+        deterministic UDF over a single dictionary-encoded string column
+        evaluates once per DISTINCT dictionary value and maps over codes
+        — O(|dictionary|) Python calls instead of O(rows), and the string
+        values never materialize per row. This is how non-host-evaluable
+        predicates (a UDF filter the expression layer can't turn into a
+        dictionary lut itself) still pay per-distinct, not per-row.
+        Returns the per-row result array, or None when the lane does not
+        apply (the per-row path runs). Gated by spark.tpu.encoding.enabled
+        so the decoded oracle keeps per-row behavior for differential
+        testing."""
+        from ..columnar.encoding import encoding_enabled
+
+        if not encoding_enabled(ctx.conf):
+            return None
+        if not getattr(udf, "deterministic", True):
+            return None
+        if len(arg_batch.columns) != 1:
+            return None
+        c = arg_batch.columns[0]
+        if not isinstance(c.dtype, StringType) or c.dictionary is None:
+            return None
+        values = c.dictionary.values
+        if not values or len(values) >= max(len(sel), 1):
+            return None  # domain not smaller than the rows: no win
+        # the UDF lane's ONE intended pull: codes cross to host once per
+        # batch (the per-row path pulls the decoded VALUES instead)
+        codes = np.clip(np.asarray(c.data)[sel],  # tpulint: ignore[host-sync]
+                        0, len(values) - 1)
+        vm = None
+        if c.validity is not None:
+            vm = np.asarray(c.validity)[sel]  # tpulint: ignore[host-sync]
+        # evaluate over the LIVE distinct codes only — the runtime
+        # dictionary still covers values that exist solely in rows an
+        # upstream filter dropped, and a partial UDF guarded by that
+        # filter must never see them (per-row semantics)
+        live_codes = np.unique(codes if vm is None else codes[vm])
+        if live_codes.size:
+            dvals = np.empty(live_codes.size, dtype=object)
+            dvals[:] = [str(values[cd]) for cd in live_codes]
+            per_value = np.asarray(  # tpulint: ignore[host-sync]
+                self._call(udf, [dvals], live_codes.size))
+            pos = np.clip(np.searchsorted(live_codes, codes), 0,
+                          live_codes.size - 1)
+            out = per_value[pos]
+        else:
+            out = np.empty(len(sel), dtype=object)
+        if vm is not None and not vm.all():
+            # the null lane evaluates once too (per-row semantics:
+            # invalid rows hand the UDF a None)
+            null_res = self._call(
+                udf, [np.array([None], dtype=object)], 1)
+            out = np.asarray(out, dtype=object).copy()  # tpulint: ignore[host-sync]
+            out[~vm] = null_res[0] if len(null_res) else None
+        ctx.metrics.add("udf.dict_domain_evals")
+        ctx.metrics.add("udf.dict_domain_rows_saved",
+                        len(sel) - live_codes.size)
+        return out
 
     def _call(self, udf, args: list[np.ndarray], n: int):
         if n == 0:

@@ -1,0 +1,1058 @@
+"""Whole-QUERY compilation: collapse a slice-resident plan into ONE jitted
+program (Flare's bet, ROADMAP direction 4).
+
+Whole-stage fusion (PR 1) compiles each exchange-free chain into one
+program per batch; exchange map-side fusion (PR 5) extends the program to
+the shuffle write; mesh stage fusion (PR 8) makes a shuffle stage one
+sharded dispatch. The host shuffle ROUND-TRIPS between stages remain: the
+scheduler materializes every stage output, pulls grouped columns to host,
+and re-ingests them for the next stage. When plan-time statistics show the
+whole query's working set fits device-side, those round-trips are pure
+overhead — the same tracing machinery that builds the per-stage programs
+can trace EVERY stage into one `jax.jit` program per (plan structure,
+input signatures, capacities):
+
+  * exchanges lower to in-program GATHERS — on one device a hash/range/rr
+    redistribution moves no data, it only re-partitions rows the next
+    operator re-groups/re-sorts anyway, so the lowering concatenates the
+    flow and lets the consumer's trace do the grouping;
+  * aggregates always take the sorted-segment layout (static shapes: the
+    output tile has the input capacity) — the value-dependent dense-range
+    scatter stays a per-stage optimization, the whole-query program trades
+    it for zero host hops;
+  * joins run the sorted-probe kernel in-trace; output-capacity overflow
+    comes back as a per-join `needed` scalar checked ONCE after the single
+    dispatch (the same capacity-bucket retry contract as the per-batch
+    kernels — a retry recompiles with the bumped bucket and re-dispatches
+    the whole program);
+  * intermediate stage outputs never materialize as ColumnarBatches —
+    they are XLA values inside one program, resident in HBM only for the
+    program's lifetime.
+
+The `minRows` size gate generalizes into a three-tier cost model
+(`spark.tpu.compile.tier` = auto | whole | stage | operator):
+
+  whole     — one jitted program per query step (this module);
+  stage     — one program per stage per batch (PR 1/5/8 fusion; the
+              per-partition minRows runtime gate keeps routing undersized
+              partitions to the shared operator kernels, i.e. the
+              stage→operator fallback stays a runtime decision);
+  operator  — operator-at-a-time shared kernels (the differential oracle;
+              forced globally by the tier, per-partition by the gate).
+
+`auto` picks whole-query only when the plan is structurally lowerable,
+every leaf row count is known (LocalTableScan/Range statistics), the
+plan actually contains exchange round-trips to eliminate (a single-stage
+plan is already one program per batch under stage fusion — collapsing it
+would trade the value-dependent dense fast paths for nothing), the
+batch volume amortizes the bigger compile (spark.tpu.compile.whole.minRows
+scaled by program depth — the compile-cost proxy; the measured per-kernel
+compile cost from the KernelCache cost table refines the estimate when
+available), and the fully-resident working set passes the
+`spark.tpu.memory.budget` admission check. Any failed check falls back
+tier-by-tier with the reason recorded on the plan
+(`explain("analysis")` surfaces the decision).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, NamedTuple, Optional, Sequence
+
+from ..columnar.batch import (
+    EMPTY_DICT, Column, ColumnarBatch, StringDict, bucket_capacity,
+    merge_string_dicts,
+)
+from ..errors import ExecutionError
+from ..expr.expressions import Alias, AttributeReference
+from ..types import BooleanType, StringType, dict_encoded
+from .aggregates import FUSABLE_OPS
+from .compile import (
+    GLOBAL_KERNEL_CACHE, bind_inputs, canonical_key, pipeline_host_pass,
+    trace_pipeline,
+)
+from .operators import PhysicalPlan, attrs_schema
+
+__all__ = ["WholeQueryExec", "TierDecision", "choose_tier",
+           "apply_compile_tier", "supported_whole_query"]
+
+_MAX_PROGRAM_RETRIES = 8
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+# ---------------------------------------------------------------------------
+# tier decision
+# ---------------------------------------------------------------------------
+
+@dataclass
+class TierDecision:
+    """Outcome of the compile-tier cost model, stashed on the plan so
+    explain("analysis") and the execution span can surface it."""
+
+    tier: str                 # "whole" | "stage" | "operator"
+    reason: str               # human-readable why (incl. fallback cause)
+    details: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {"tier": self.tier, "reason": self.reason,
+                "details": dict(self.details)}
+
+
+def _scan_table(node):
+    """The backing arrow table of an in-memory ScanExec (io/sources
+    InMemorySource), or None for external sources — in-memory scans have
+    exact plan-time statistics like LocalTableScan."""
+    import pyarrow as pa
+
+    t = getattr(getattr(node, "source", None), "table", None)
+    return t if isinstance(t, pa.Table) else None
+
+
+def _leaf_rows(node) -> Optional[int]:
+    from . import operators as O
+
+    if isinstance(node, O.LocalTableScanExec):
+        return int(node.table.num_rows)  # tpulint: ignore[host-sync]
+    if isinstance(node, O.ScanExec):
+        t = _scan_table(node)
+        if t is None:
+            return None
+        return int(t.num_rows)  # tpulint: ignore[host-sync]
+    if isinstance(node, O.RangeExec):
+        step = node.step
+        if step > 0:
+            return max(0, -(-(node.end - node.start) // step))
+        return max(0, -(-(node.start - node.end) // -step))
+    return None
+
+
+def supported_whole_query(plan, conf) -> tuple[bool, str]:
+    """Structural admission: every operator of the plan must have a
+    whole-query lowering. Returns (ok, reason-if-not)."""
+    from . import operators as O
+    from .exchange import BroadcastExchangeExec, ShuffleExchangeExec
+    from .fusion import FusedAggregateExec, FusedLimitExec  # noqa: F401
+
+    for node in _iter_inner(plan):
+        if isinstance(node, (O.LocalTableScanExec, O.RangeExec)):
+            continue
+        if isinstance(node, O.ScanExec):
+            if _scan_table(node) is None:
+                return False, (f"scan [{node.name}] reads an external "
+                               "source (no plan-time statistics)")
+            continue
+        if isinstance(node, (O.ComputeExec, O.LimitExec, O.SortExec,
+                             O.UnionExec, O.CoalescePartitionsExec,
+                             BroadcastExchangeExec, ShuffleExchangeExec)):
+            continue
+        if isinstance(node, O.HashAggregateExec):
+            vals = node._plan_values()
+            bad = [op for op, _, _ in vals if op not in FUSABLE_OPS]
+            if bad:
+                return False, (f"aggregate op {bad[0]} needs host-side "
+                               "finishing (no in-program lowering)")
+            for g in node.grouping:
+                if dict_encoded(g.dtype) and not isinstance(g.dtype,
+                                                            StringType):
+                    return False, (f"grouping key {g.name} is a nested "
+                                   "dictionary type (codes are not a "
+                                   "canonical group domain)")
+            continue
+        if isinstance(node, O.HashJoinExec):
+            if node.join_type == "full_outer":
+                return False, ("full_outer join runs eager host-side "
+                               "passes (no in-program lowering)")
+            for k in list(node.left_keys) + list(node.right_keys):
+                if dict_encoded(k.dtype) and not isinstance(k.dtype,
+                                                            StringType):
+                    return False, (f"join key {k.name} is a nested "
+                                   "dictionary type")
+            continue
+        return False, (f"operator {type(node).__name__} has no "
+                       "whole-query lowering")
+    return True, ""
+
+
+def _iter_inner(plan):
+    """Iterate the plan INCLUDING through fused-exchange absorption (the
+    plan tree itself; WholeQueryExec is opaque to the stage cutter but
+    this walks its inner plan when given one)."""
+    inner = plan.plan if isinstance(plan, WholeQueryExec) else plan
+    return inner.iter_nodes()
+
+
+def _estimate_resident_bytes(plan, conf) -> Optional[int]:
+    """Cheap upper-bound of the fully-resident program's engine bytes:
+    every lowered operator's output tile (capacity x row bytes) plus the
+    leaf input planes — all live inside ONE XLA program. Pure host
+    arithmetic over plan metadata (no value tracing: the tier chooser
+    must stay launch-free and cheap enough to run per query)."""
+    from ..exec.memory import schema_row_bytes
+    from . import operators as O
+    from .exchange import BroadcastExchangeExec, ShuffleExchangeExec
+    from .fusion import FusedAggregateExec
+
+    tile = int(conf.get(  # tpulint: ignore[host-sync]
+        "spark.tpu.batch.capacity", 1 << 20))
+    memo: dict[int, Optional[int]] = {}
+
+    def cap_of(node) -> Optional[int]:
+        hit = memo.get(id(node))
+        if hit is not None or id(node) in memo:
+            return hit
+        memo[id(node)] = out = _cap_of(node)
+        return out
+
+    def _cap_of(node) -> Optional[int]:
+        rows = _leaf_rows(node)
+        if rows is not None:
+            # tiling mirror: per-tile buckets, then the gathered concat
+            total = 0
+            n = rows
+            while n > 0:
+                total += bucket_capacity(min(tile, n))
+                n -= tile
+            return bucket_capacity(max(total, 1))
+        kids = [cap_of(c) for c in node.children]
+        if any(k is None for k in kids):
+            return None
+        if isinstance(node, O.HashAggregateExec) and not node.grouping:
+            return 8
+        if isinstance(node, O.HashJoinExec):
+            return max(kids[0], 1 << 10)
+        if isinstance(node, O.UnionExec):
+            return bucket_capacity(sum(kids))
+        if isinstance(node, (ShuffleExchangeExec, BroadcastExchangeExec,
+                             O.CoalescePartitionsExec)):
+            return kids[0]
+        return kids[0] if kids else None
+
+    total = 0
+    for node in _iter_inner(plan):
+        cap = cap_of(node)
+        if cap is None:
+            return None
+        try:
+            rb = schema_row_bytes(attrs_schema(node.output))
+        except Exception:
+            rb = 16
+        total += cap * rb
+        if isinstance(node, FusedAggregateExec):
+            # the traced pipeline's projected planes are live too
+            total += cap * 16
+    return total
+
+
+def _avg_compile_ms() -> float:
+    """Online per-kernel compile-cost estimate from the KernelCache (PR 7
+    cost table companion): total builder+first-invocation time over
+    compiled kernels. Falls back to a conservative constant cold."""
+    kc = GLOBAL_KERNEL_CACHE
+    misses = max(kc.misses, 1)
+    avg = kc.compile_ms / misses
+    return max(avg, 50.0)
+
+
+def choose_tier(plan, conf, cluster: bool = False) -> TierDecision:
+    """The three-tier cost model. See module docstring for the rules."""
+    from ..config import (
+        COMPILE_TIER, FUSION_ENABLED, MEMORY_BUDGET, WHOLE_MIN_ROWS,
+    )
+
+    pref = str(conf.get(COMPILE_TIER)).lower()
+    if pref == "operator":
+        return TierDecision("operator", "forced by spark.tpu.compile.tier")
+    if pref == "stage":
+        return TierDecision("stage", "forced by spark.tpu.compile.tier")
+    forced = pref == "whole"
+    base = "forced by spark.tpu.compile.tier" if forced \
+        else "cost model (spark.tpu.compile.tier=auto)"
+    if not conf.get(FUSION_ENABLED):
+        # the whole-query program IS fusion taken to its limit: with
+        # fusion disabled the session asked for the operator-at-a-time
+        # differential oracle, and collapsing the plan anyway would make
+        # the fusion-on/off comparison compare whole vs whole
+        return TierDecision(
+            "stage", "whole-query fallback: spark.tpu.fusion.enabled="
+            "false (operator-at-a-time differential oracle)")
+    if cluster:
+        return TierDecision(
+            "stage", "cluster scheduler: stages place on workers — the "
+            "whole-query program needs the data driver-resident")
+    if not forced:
+        # cheap disqualifier FIRST: the common exchange-free query must
+        # not pay the full admission walk at plan time (auto only — the
+        # whole tier's win is ELIMINATING stage round-trips; a plan with
+        # no exchanges is already one program per batch under stage
+        # fusion, and collapsing it would trade the value-dependent
+        # dense fast paths for nothing)
+        from .exchange import BroadcastExchangeExec, ShuffleExchangeExec
+
+        n_exch = sum(1 for x in _iter_inner(plan)
+                     if isinstance(x, (ShuffleExchangeExec,
+                                       BroadcastExchangeExec)))
+        if n_exch == 0:
+            return TierDecision(
+                "stage", "whole-query fallback: no exchange round-trips "
+                "to eliminate (single-stage plan — stage fusion already "
+                "dispatches once per batch)", {"exchanges": 0})
+    ok, why = supported_whole_query(plan, conf)
+    if not ok:
+        return TierDecision("stage", f"whole-query fallback: {why}")
+    rows = []
+    n_ops = 0
+    for node in _iter_inner(plan):
+        n_ops += 1
+        r = _leaf_rows(node)
+        if r is not None:
+            rows.append(r)
+        elif not node.children:
+            return TierDecision(
+                "stage", "whole-query fallback: leaf statistics unknown "
+                f"({type(node).__name__} row count untraced)")
+    volume = sum(rows)
+    details = {"volume_rows": volume, "lowered_ops": n_ops,
+               "est_compile_ms": round(_avg_compile_ms() * n_ops, 1)}
+    est = _estimate_resident_bytes(plan, conf)
+    if est is not None:
+        details["est_resident_bytes"] = est
+    budget = int(conf.get(MEMORY_BUDGET))  # tpulint: ignore[host-sync]
+    if budget > 0 and est is not None and est > budget:
+        return TierDecision(
+            "stage", "whole-query fallback: predicted fully-resident "
+            f"working set ~{est / (1 << 20):.1f} MiB exceeds "
+            f"spark.tpu.memory.budget ({budget / (1 << 20):.1f} MiB)",
+            details)
+    if not forced:
+        floor = int(conf.get(WHOLE_MIN_ROWS))  # tpulint: ignore[host-sync]
+        floor *= max(1, -(-n_ops // 8))
+        details["volume_floor"] = floor
+        if volume < floor:
+            return TierDecision(
+                "stage", "whole-query fallback: batch volume "
+                f"{volume} rows under the compile-amortization floor "
+                f"({floor}; spark.tpu.compile.whole.minRows scaled by "
+                "program depth)", details)
+    return TierDecision("whole", base, details)
+
+
+def apply_compile_tier(plan, conf, cluster: bool = False):
+    """Planner hook: wrap the plan for the whole tier, or stash the
+    decision (with its fallback reason) for explain("analysis")."""
+    decision = choose_tier(plan, conf, cluster=cluster)
+    if decision.tier == "whole":
+        return WholeQueryExec(plan, decision)
+    try:
+        plan._tier_decision = decision
+    except Exception:
+        pass
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# program builder
+# ---------------------------------------------------------------------------
+
+class _MCol(NamedTuple):
+    """Host-side column metadata threaded through the shadow pass: the
+    same (dtype, validity presence, dictionary) triple pipeline_host_pass
+    reads off a real batch — intermediate flows never materialize, their
+    metadata derives from the producing operator's host pass."""
+
+    dtype: object
+    valid: bool
+    sdict: Optional[StringDict]
+
+
+class _MetaColShim:
+    """Column-shaped view over _MCol for pipeline_host_pass (which reads
+    only `.validity is not None` and `.dictionary`)."""
+
+    __slots__ = ("validity", "dictionary")
+
+    def __init__(self, m: _MCol):
+        self.validity = True if m.valid else None
+        self.dictionary = m.sdict
+
+
+class _MetaView:
+    __slots__ = ("columns",)
+
+    def __init__(self, metas: Sequence[_MCol]):
+        self.columns = [_MetaColShim(m) for m in metas]
+
+
+class _Lowered(NamedTuple):
+    metas: list            # list[_MCol] per output column
+    cap: int               # static tile capacity of this flow
+    emit: Callable         # emit(args, needed) -> (datas, valids, mask)
+
+
+class _ProgramBuilder:
+    """Lowers an admitted physical plan into one traced program.
+
+    Host pass (per execute): leaf scans execute (launch-free device-cached
+    ingest), dictionaries merge, aux luts harvest, and every operator
+    contributes a structural key fragment. The traced pass (once per
+    program cache key) composes the SAME kernel bodies the per-stage path
+    uses — trace_pipeline, ops.grouping, ops.joining, ops.sorting — into
+    a single function; XLA fuses across what used to be stage boundaries."""
+
+    def __init__(self, ctx, join_caps: list):
+        self.ctx = ctx
+        self.args: list = []           # program inputs, in arg-index order
+        self.key: list = []            # cache-key fragments
+        self.join_caps = join_caps     # per-join output capacities (shared
+        # across the retry loop: a bumped bucket re-enters here)
+        self._join_seq = 0
+        self.members: list[str] = []   # lowered ops, produce->consume order
+
+    # -- plumbing ----------------------------------------------------------
+    def arg(self, arr) -> int:
+        self.args.append(arr)
+        return len(self.args) - 1
+
+    def _member(self, node) -> None:
+        s = node.simple_string() if hasattr(node, "simple_string") \
+            else type(node).__name__
+        self.members.append(s[:100])
+
+    # -- dispatch ----------------------------------------------------------
+    def lower(self, node) -> _Lowered:
+        from . import operators as O
+        from .exchange import BroadcastExchangeExec, ShuffleExchangeExec
+        from .fusion import FusedAggregateExec, FusedLimitExec
+
+        if isinstance(node, (O.LocalTableScanExec, O.RangeExec,
+                             O.ScanExec)):
+            return self._lower_leaf(node)
+        if isinstance(node, FusedAggregateExec):
+            low = self.lower(node.child)
+            low = self._lower_pipe(node.filters, node.pipe_outputs,
+                                   node.child.output, node.pipe_attrs, low)
+            self._member(node)
+            return self._lower_agg(node, node.pipe_attrs, low)
+        if isinstance(node, O.HashAggregateExec):
+            low = self.lower(node.child)
+            self._member(node)
+            return self._lower_agg(node, node.child.output, low)
+        if isinstance(node, FusedLimitExec):
+            low = self.lower(node.child)
+            low = self._lower_pipe(node.filters, node.pipe_outputs,
+                                   node.child.output, node.pipe_attrs, low)
+            self._member(node)
+            return self._lower_limit(node, low)
+        if isinstance(node, O.LimitExec):
+            low = self.lower(node.child)
+            self._member(node)
+            return self._lower_limit(node, low)
+        if isinstance(node, O.SortExec):
+            low = self.lower(node.child)
+            self._member(node)
+            return self._lower_sort(node, low)
+        if isinstance(node, O.HashJoinExec):
+            self._member(node)
+            return self._lower_join(node)
+        if isinstance(node, O.ComputeExec):
+            low = self.lower(node.child)
+            self._member(node)
+            attrs = [o.to_attribute() if isinstance(o, Alias) else o
+                     for o in node.outputs]
+            return self._lower_pipe(node.filters, node.outputs,
+                                    node.child.output, attrs, low)
+        if isinstance(node, ShuffleExchangeExec):
+            low = self.lower(node.child)
+            if node.pipe_fusion is not None:
+                filters, outputs = node.pipe_fusion
+                low = self._lower_pipe(filters, outputs, node.child.output,
+                                       node.pipe_attrs, low)
+            self.members.append(
+                f"Exchange[{type(node.partitioning).__name__}] -> "
+                "in-program gather")
+            self.key.append(("xgather",))
+            return low
+        if isinstance(node, BroadcastExchangeExec):
+            self.members.append("BroadcastExchange -> in-program identity")
+            return self.lower(node.child)
+        if isinstance(node, O.CoalescePartitionsExec):
+            return self.lower(node.child)
+        if isinstance(node, O.UnionExec):
+            lows = [self.lower(c) for c in node.children_plans]
+            self._member(node)
+            return self._lower_union(node, lows)
+        raise ExecutionError(            # admission guarantees this
+            f"whole-query lowering missing for {type(node).__name__}")
+
+    # -- leaves ------------------------------------------------------------
+    def _lower_leaf(self, node) -> _Lowered:
+        jnp = _jnp()
+        parts = node.execute(self.ctx)
+        batches = [b for p in parts for b in p]
+        fields = attrs_schema(node.output).fields
+        self._member(node)
+        caps = [b.capacity for b in batches]
+        cap = bucket_capacity(max(sum(caps), 1))
+        ncols = len(fields)
+
+        col_args = []      # per col: list[(data_idx, valid_idx|None)]
+        luts = []          # per col: list[lut arg idx]|None
+        metas = []
+        for i, f in enumerate(fields):
+            cols = [b.columns[i] for b in batches]
+            merged = None
+            lut_idx = None
+            if dict_encoded(f.dataType):
+                dicts = [c.dictionary or EMPTY_DICT for c in cols]
+                if all(d is dicts[0] for d in dicts):
+                    merged = dicts[0]
+                else:
+                    merged, lut_list = merge_string_dicts(dicts)
+                    lut_idx = [self.arg(jnp.asarray(lt))
+                               for lt in lut_list]
+            any_valid = any(c.validity is not None for c in cols)
+            entry = []
+            for c in cols:
+                di = self.arg(c.data)
+                vi = self.arg(c.validity) if c.validity is not None \
+                    else None
+                entry.append((di, vi))
+            col_args.append(entry)
+            luts.append(lut_idx)
+            metas.append(_MCol(f.dataType, any_valid, merged))
+        mask_idx = [self.arg(b.row_mask) for b in batches]
+        self.key.append((
+            "leaf", tuple(caps),
+            tuple((str(c.data.dtype), c.validity is not None)
+                  for b in batches for c in b.columns),
+            tuple(None if li is None else len(li) for li in luts)))
+
+        col_args_f = list(col_args)
+        luts_f = list(luts)
+        metas_f = list(metas)
+        bcaps = list(caps)
+
+        def emit(args, needed):
+            def pad(a, fill):
+                n = sum(bcaps)
+                if n < cap:
+                    a = jnp.concatenate(
+                        [a, jnp.full(cap - n, fill, dtype=a.dtype)])
+                return a
+
+            datas, valids = [], []
+            for ci in range(ncols):
+                chunks = []
+                for bi, (di, _vi) in enumerate(col_args_f[ci]):
+                    d = args[di]
+                    if luts_f[ci] is not None:
+                        lt = args[luts_f[ci][bi]]
+                        d = jnp.take(lt, jnp.clip(d, 0, lt.shape[0] - 1))
+                    chunks.append(d)
+                datas.append(pad(jnp.concatenate(chunks), 0))
+                if metas_f[ci].valid:
+                    vchunks = []
+                    for bi, (_di, vi) in enumerate(col_args_f[ci]):
+                        if vi is None:
+                            vchunks.append(jnp.ones(bcaps[bi], dtype=bool))
+                        else:
+                            vchunks.append(args[vi])
+                    valids.append(pad(jnp.concatenate(vchunks), False))
+                else:
+                    valids.append(None)
+            mask = pad(jnp.concatenate([args[i] for i in mask_idx]), False)
+            return datas, valids, mask
+
+        return _Lowered(metas, cap, emit)
+
+    # -- filter/project pipelines ------------------------------------------
+    def _lower_pipe(self, filters, outputs, input_attrs, out_attrs,
+                    low: _Lowered) -> _Lowered:
+        if not filters and all(isinstance(o, AttributeReference)
+                               for o in outputs):
+            # pure column selection: reorder the flow, zero trace work
+            pos = {a.expr_id: i for i, a in enumerate(input_attrs)}
+            sel = [pos[o.expr_id] for o in outputs]
+            metas = [low.metas[i] for i in sel]
+            self.key.append(("reorder", tuple(sel)))
+
+            def emit(args, needed, _low=low, _sel=tuple(sel)):
+                d, v, m = _low.emit(args, needed)
+                return [d[i] for i in _sel], [v[i] for i in _sel], m
+
+            return _Lowered(metas, low.cap, emit)
+        hctx, host_outs, aux = pipeline_host_pass(
+            input_attrs, filters, outputs, _MetaView(low.metas))
+        aux_idx = [self.arg(a) for a in aux]
+        id_to_pos = bind_inputs(input_attrs)
+        self.key.append((
+            "pipe",
+            tuple(canonical_key(f, id_to_pos) for f in filters),
+            tuple(canonical_key(o, id_to_pos) for o in outputs),
+            hctx.signature()))
+        metas = [_MCol(a.dtype, hv.validity is not None,
+                       hv.sdict if dict_encoded(a.dtype) else None)
+                 for a, hv in zip(out_attrs, host_outs)]
+        cap = low.cap
+        in_attrs = list(input_attrs)
+        flt = list(filters)
+        outs = list(outputs)
+
+        def emit(args, needed, _low=low):
+            d, v, m = _low.emit(args, needed)
+            aux_arrs = [args[i] for i in aux_idx]
+            return trace_pipeline(in_attrs, flt, outs, d, v, m, aux_arrs,
+                                  cap)
+
+        return _Lowered(metas, cap, emit)
+
+    # -- aggregation -------------------------------------------------------
+    def _lower_agg(self, node, in_attrs, low: _Lowered) -> _Lowered:
+        jnp = _jnp()
+        pos = {a.expr_id: i for i, a in enumerate(in_attrs)}
+        out_fields = attrs_schema(node.output).fields
+        vals = node._plan_values()
+        ops = tuple(op for op, _, _ in vals)
+        val_idx = tuple(pos[attr.expr_id] if attr is not None else -1
+                        for _, attr, _ in vals)
+        key_idx = tuple(pos[g.expr_id] for g in node.grouping)
+        key_bool = tuple(isinstance(in_attrs[i].dtype, BooleanType)
+                         for i in key_idx)
+        nk = len(key_idx)
+        # string MIN/MAX reduces in rank space (same trick as the fused
+        # aggregate): rank lut in, winning rank -> code out
+        smm = {}
+        for bi, (op, attr, _p) in enumerate(vals):
+            if op in ("min", "max") and attr is not None \
+                    and dict_encoded(attr.dtype):
+                sd = low.metas[val_idx[bi]].sdict or EMPTY_DICT
+                smm[bi] = (self.arg(sd.device_ranks()),
+                           self.arg(sd.device_rank_to_code()),
+                           len(sd))
+        buf_metas = []
+        for bi, (op, attr, _p) in enumerate(vals):
+            f = out_fields[nk + bi]
+            sdict = None
+            if dict_encoded(f.dataType):
+                vi = val_idx[bi]
+                if vi >= 0:
+                    sdict = low.metas[vi].sdict
+            buf_metas.append(_MCol(f.dataType,
+                                   op not in ("count", "countstar"), sdict))
+        self.key.append(("agg", node.mode, ops, key_idx, val_idx,
+                         key_bool, tuple((bi, n) for bi, (_r, _i, n)
+                                         in sorted(smm.items()))))
+
+        def pipe_vals(d, v, m):
+            vd, vv = [], []
+            for bi, i in enumerate(val_idx):
+                dd = d[i] if i >= 0 else m
+                if bi in smm:
+                    rank = args_box[0][smm[bi][0]]
+                    dd = jnp.take(rank, jnp.clip(dd.astype(jnp.int32), 0,
+                                                 rank.shape[0] - 1))
+                vd.append(dd)
+                vv.append(v[i] if i >= 0 else None)
+            return vd, vv
+
+        def rank_back(bufs):
+            out = []
+            for bi, (bd, bv) in enumerate(bufs):
+                if bi in smm:
+                    inv = args_box[0][smm[bi][1]]
+                    bd = jnp.take(inv, jnp.clip(bd.astype(jnp.int32), 0,
+                                                inv.shape[0] - 1))
+                out.append((bd, bv))
+            return out
+
+        def finish(bufs):
+            out = []
+            for bi, (bd, bv) in enumerate(bufs):
+                if bi in smm:
+                    out.append((bd, bv))
+                    continue
+                want = out_fields[nk + bi].dataType.device_dtype
+                if str(bd.dtype) != str(want):
+                    bd = bd.astype(want)
+                out.append((bd, bv))
+            return out
+
+        args_box = [None]  # bound to the live args list inside emit
+
+        if not node.grouping:
+            metas = list(buf_metas)
+
+            def emit(args, needed, _low=low):
+                from ..ops import grouping as G
+
+                args_box[0] = args
+                d, v, m = _low.emit(args, needed)
+                vd, vv = pipe_vals(d, v, m)
+                outs = G.apply_global_ops(ops, vd, vv, m)
+                outs = rank_back(outs)
+                outs = finish(outs)
+                datas, valids = [], []
+                for bd, bv in outs:
+                    datas.append(jnp.zeros((8,), dtype=bd.dtype)
+                                 .at[0].set(bd))
+                    valids.append(None if bv is None else
+                                  jnp.zeros((8,), dtype=bool)
+                                  .at[0].set(bv))
+                mask = jnp.zeros((8,), dtype=bool).at[0].set(True)
+                return datas, valids, mask
+
+            return _Lowered(metas, 8, emit)
+
+        key_metas = [_MCol(out_fields[j].dataType, low.metas[i].valid,
+                           low.metas[i].sdict)
+                     for j, i in enumerate(key_idx)]
+        metas = key_metas + buf_metas
+        cap = low.cap
+
+        def emit(args, needed, _low=low):
+            from ..ops import grouping as G
+
+            args_box[0] = args
+            d, v, m = _low.emit(args, needed)
+            key_eqs = []
+            for i, is_bool in zip(key_idx, key_bool):
+                kd = d[i]
+                if is_bool:
+                    kd = kd.astype(jnp.int32)
+                key_eqs.append(kd)
+            key_valids = [v[i] for i in key_idx]
+            layout = G.group_rows(key_eqs, key_valids, m)
+            out_keys = [G.scatter_group_keys(layout, d[i], v[i])
+                        for i in key_idx]
+            vd, vv = pipe_vals(d, v, m)
+            bufs = G.apply_group_ops(layout, ops, vd, vv)
+            bufs = finish(rank_back(bufs))
+            out_mask = G.group_output_mask(layout)
+            datas = [kd for kd, _kv in out_keys] + [bd for bd, _ in bufs]
+            valids = [kv for _kd, kv in out_keys] + [bv for _, bv in bufs]
+            return datas, valids, out_mask
+
+        return _Lowered(metas, cap, emit)
+
+    # -- limit / sort ------------------------------------------------------
+    def _lower_limit(self, node, low: _Lowered) -> _Lowered:
+        jnp = _jnp()
+        n, offset = node.n, node.offset
+        self.key.append(("limit", n, offset))
+
+        def emit(args, needed, _low=low):
+            d, v, m = _low.emit(args, needed)
+            rank = jnp.cumsum(m.astype(jnp.int64))
+            keep = m & (rank > offset) & (rank <= offset + n)
+            return d, v, keep
+
+        return _Lowered(low.metas, low.cap, emit)
+
+    def _lower_sort(self, node, low: _Lowered) -> _Lowered:
+        jnp = _jnp()
+        from ..ops.sorting import SortKeySpec
+
+        pos = {a.expr_id: i for i, a in enumerate(node.child.output)}
+        kidx, specs, rank_idx = [], [], []
+        for o in node.orders:
+            i = pos[o.child.expr_id]
+            kidx.append(i)
+            specs.append(SortKeySpec(o.ascending, o.nulls_first))
+            mc = low.metas[i]
+            if dict_encoded(mc.dtype):
+                sd = mc.sdict or EMPTY_DICT
+                rank_idx.append((self.arg(sd.device_ranks()), len(sd)))
+            else:
+                rank_idx.append(None)
+        self.key.append(("sort", tuple(kidx),
+                         tuple((s.ascending, s.nulls_first)
+                               for s in specs),
+                         tuple(None if r is None else r[1]
+                               for r in rank_idx)))
+        kidx_t, specs_t, ranks_t = tuple(kidx), list(specs), list(rank_idx)
+        is_bool = tuple(isinstance(low.metas[i].dtype, BooleanType)
+                        for i in kidx)
+
+        def emit(args, needed, _low=low):
+            from ..ops.sorting import sort_permutation
+
+            d, v, m = _low.emit(args, needed)
+            keys, kvalids = [], []
+            for j, i in enumerate(kidx_t):
+                kd = d[i]
+                if ranks_t[j] is not None:
+                    r = args[ranks_t[j][0]]
+                    kd = jnp.take(r, jnp.clip(kd, 0, r.shape[0] - 1))
+                elif is_bool[j]:
+                    kd = kd.astype(jnp.int32)
+                keys.append(kd)
+                kvalids.append(v[i])
+            perm = sort_permutation(keys, kvalids, specs_t, m)
+            out_d = [jnp.take(x, perm) for x in d]
+            out_v = [None if x is None else jnp.take(x, perm) for x in v]
+            return out_d, out_v, jnp.take(m, perm)
+
+        return _Lowered(low.metas, low.cap, emit)
+
+    # -- joins -------------------------------------------------------------
+    def _eq_lut(self, mc: _MCol):
+        if isinstance(mc.dtype, StringType) or dict_encoded(mc.dtype):
+            sd = mc.sdict or EMPTY_DICT
+            lut = sd.device_hash_lut()
+            return self.arg(lut), int(lut.shape[0])  # tpulint: ignore[host-sync]
+        return None, None
+
+    def _lower_join(self, node) -> _Lowered:
+        jnp = _jnp()
+        probe = self.lower(node.left)
+        if node.probe_fusion is not None:
+            filters, outputs = node.probe_fusion
+            probe = self._lower_pipe(filters, outputs, node.left.output,
+                                     node.probe_attrs, probe)
+        build = self.lower(node.right)
+        jt = node.join_type
+        lattrs = node._left_attrs
+        rattrs = node.right.output
+        lpos = {a.expr_id: i for i, a in enumerate(lattrs)}
+        rpos = {a.expr_id: i for i, a in enumerate(rattrs)}
+        lk = tuple(lpos[k.expr_id] for k in node.left_keys)
+        rk = tuple(rpos[k.expr_id] for k in node.right_keys)
+        lk_luts = [self._eq_lut(probe.metas[i]) for i in lk]
+        rk_luts = [self._eq_lut(build.metas[i]) for i in rk]
+        lk_bool = tuple(isinstance(probe.metas[i].dtype, BooleanType)
+                        for i in lk)
+        rk_bool = tuple(isinstance(build.metas[i].dtype, BooleanType)
+                        for i in rk)
+        join_id = self._join_seq
+        self._join_seq += 1
+        if join_id >= len(self.join_caps):
+            self.join_caps.append(max(probe.cap, 1 << 10))
+        out_cap = self.join_caps[join_id]
+        self.key.append(("join", jt, lk, rk, out_cap, lk_bool, rk_bool,
+                         tuple(x[1] for x in lk_luts),
+                         tuple(x[1] for x in rk_luts)))
+        semi_anti = jt in ("left_semi", "left_anti")
+        if semi_anti:
+            metas = list(probe.metas)
+        else:
+            metas = list(probe.metas) + [
+                _MCol(m.dtype, True, m.sdict) for m in build.metas]
+
+        def eqs_of(d, v, idx, luts, bools, args):
+            eqs, valids = [], []
+            for j, i in enumerate(idx):
+                kd = d[i]
+                if luts[j][0] is not None:
+                    lut = args[luts[j][0]]
+                    kd = jnp.take(lut, jnp.clip(kd.astype(jnp.int32), 0,
+                                                lut.shape[0] - 1))
+                elif bools[j]:
+                    kd = kd.astype(jnp.int32)
+                eqs.append(kd)
+                valids.append(v[i])
+            return eqs, valids
+
+        def emit(args, needed, _probe=probe, _build=build, _oc=out_cap):
+            from ..ops import joining as J
+
+            pd, pv, pm = _probe.emit(args, needed)
+            bd, bv, bm = _build.emit(args, needed)
+            beqs, bvalids = eqs_of(bd, bv, rk, rk_luts, rk_bool, args)
+            peqs, pvalids = eqs_of(pd, pv, lk, lk_luts, lk_bool, args)
+            bi_ = J.build_index(beqs, bvalids, bm)
+            r = J.probe_join(bi_, beqs, bvalids, peqs, pvalids, pm, _oc,
+                             jt)
+            needed.append(r.needed)
+            if semi_anti:
+                datas = [jnp.take(x, r.probe_idx) for x in pd]
+                valids = [None if x is None else jnp.take(x, r.probe_idx)
+                          for x in pv]
+                return datas, valids, r.out_mask
+            datas = [jnp.take(x, r.probe_idx) for x in pd]
+            valids = [None if x is None else jnp.take(x, r.probe_idx)
+                      for x in pv]
+            null_build = ~r.matched
+            for x, xv in zip(bd, bv):
+                datas.append(jnp.take(x, r.build_idx))
+                base = jnp.take(xv, r.build_idx) if xv is not None \
+                    else jnp.ones(_oc, dtype=bool)
+                valids.append(base & ~null_build)
+            return datas, valids, r.out_mask
+
+        return _Lowered(metas, out_cap, emit)
+
+    # -- union -------------------------------------------------------------
+    def _lower_union(self, node, lows: list) -> _Lowered:
+        jnp = _jnp()
+        fields = attrs_schema(node.output).fields
+        ncols = len(fields)
+        cap = bucket_capacity(sum(lw.cap for lw in lows))
+        luts = []
+        metas = []
+        for ci, f in enumerate(fields):
+            merged = None
+            lut_idx = None
+            if dict_encoded(f.dataType):
+                dicts = [lw.metas[ci].sdict or EMPTY_DICT for lw in lows]
+                if all(d is dicts[0] for d in dicts):
+                    merged = dicts[0]
+                else:
+                    merged, lut_list = merge_string_dicts(dicts)
+                    lut_idx = [self.arg(jnp.asarray(lt))
+                               for lt in lut_list]
+            luts.append(lut_idx)
+            metas.append(_MCol(f.dataType,
+                               any(lw.metas[ci].valid for lw in lows),
+                               merged))
+        self.key.append(("union", tuple(lw.cap for lw in lows)))
+
+        def emit(args, needed):
+            outs = [lw.emit(args, needed) for lw in lows]
+
+            def pad(a, fill):
+                n = sum(lw.cap for lw in lows)
+                if n < cap:
+                    a = jnp.concatenate(
+                        [a, jnp.full(cap - n, fill, dtype=a.dtype)])
+                return a
+
+            datas, valids = [], []
+            for ci in range(ncols):
+                chunks = []
+                for li, (d, _v, _m) in enumerate(outs):
+                    dd = d[ci]
+                    if luts[ci] is not None:
+                        lt = args[luts[ci][li]]
+                        dd = jnp.take(lt, jnp.clip(dd, 0,
+                                                   lt.shape[0] - 1))
+                    chunks.append(dd)
+                datas.append(pad(jnp.concatenate(chunks), 0))
+                if metas[ci].valid:
+                    vchunks = []
+                    for li, (_d, v, _m) in enumerate(outs):
+                        vchunks.append(
+                            v[ci] if v[ci] is not None
+                            else jnp.ones(lows[li].cap, dtype=bool))
+                    valids.append(pad(jnp.concatenate(vchunks), False))
+                else:
+                    valids.append(None)
+            mask = pad(jnp.concatenate([m for _d, _v, m in outs]), False)
+            return datas, valids, mask
+
+        return _Lowered(metas, cap, emit)
+
+
+# ---------------------------------------------------------------------------
+# the operator
+# ---------------------------------------------------------------------------
+
+class WholeQueryExec(PhysicalPlan):
+    """The whole query as ONE jitted program per step.
+
+    Opaque to the stage cutter (child_fields = ()): the scheduler sees a
+    single stage with no exchanges, so there are zero host shuffle
+    round-trips by construction. Leaf scans execute normally (device-
+    cached, launch-free); everything above them traces into one program
+    whose single dispatch the obs layer re-attributes to the member
+    operators via fused_members(). Join output-capacity overflow retries
+    re-dispatch the whole program with bumped buckets (counted, and
+    mirrored by the plan analyzer's whole-query launch model)."""
+
+    child_fields = ()          # the inner plan is NOT a schedulable child
+
+    def __init__(self, plan, decision: TierDecision):
+        self.plan = plan
+        self.decision = decision
+        self._members_cache: list | None = None
+
+    @property
+    def output(self):
+        return self.plan.output
+
+    def output_partitioning(self):
+        from .partitioning import SinglePartition
+
+        return SinglePartition()
+
+    def graph_name(self) -> str:
+        return "WholeQueryExec"
+
+    def fused_members(self) -> list:
+        """Every lowered operator shares this node's single dispatch."""
+        if self._members_cache is None:
+            self._members_cache = [
+                (n.simple_string() if hasattr(n, "simple_string")
+                 else type(n).__name__)[:100]
+                for n in self.plan.iter_nodes()]
+        return self._members_cache
+
+    def simple_string(self):
+        n = sum(1 for _ in self.plan.iter_nodes())
+        return (f"WholeQuery[ops={n}, tier=whole] "
+                f"({self.decision.reason[:60]})")
+
+    def tree_string(self, depth: int = 0) -> str:
+        pad = "  " * depth
+        head = pad + ("+- " if depth else "") + self.simple_string()
+        return head + "\n" + self.plan.tree_string(depth + 1)
+
+    def execute(self, ctx) -> list:
+        import jax
+
+        tracer = getattr(ctx, "tracer", None)
+        from contextlib import nullcontext
+
+        span = tracer.span("whole_query.program", cat="operator",
+                           args={"tier": "whole",
+                                 "reason": self.decision.reason,
+                                 **{k: v for k, v in
+                                    self.decision.details.items()
+                                    if isinstance(v, (int, float, str))}}) \
+            if tracer is not None else nullcontext()
+        join_caps: list[int] = []
+        with span:
+            for attempt in range(_MAX_PROGRAM_RETRIES):
+                b = _ProgramBuilder(ctx, join_caps)
+                root = b.lower(self.plan)
+                key = ("whole_query", tuple(b.key))
+
+                def build(_root=root, _nargs=len(b.args)):
+                    def program(args):
+                        needed: list = []
+                        datas, valids, mask = _root.emit(args, needed)
+                        return datas, valids, mask, tuple(needed)
+
+                    return jax.jit(program)
+
+                kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build)
+                datas, valids, mask, needed = kernel(b.args)
+                # the program's ONE capacity verdict: join `needed`
+                # scalars sync after the single dispatch (the query's
+                # last device interaction before collect)
+                bumped = False
+                for i, nd in enumerate(needed):
+                    n_i = int(nd)  # tpulint: ignore[host-sync]
+                    if n_i > join_caps[i]:
+                        join_caps[i] = bucket_capacity(n_i)
+                        bumped = True
+                if not bumped:
+                    if attempt:
+                        ctx.metrics.add("whole_query.capacity_retries",
+                                        attempt)
+                    ctx.metrics.add("whole_query.dispatches", attempt + 1)
+                    schema = attrs_schema(self.output)
+                    cols = [Column(f.dataType, d, v,
+                                   m.sdict if dict_encoded(f.dataType)
+                                   else None)
+                            for f, d, v, m in zip(schema.fields, datas,
+                                                  valids, root.metas)]
+                    batch = ColumnarBatch(schema, cols, mask,
+                                          num_rows=None)
+                    return [[batch]]
+            raise ExecutionError(
+                "whole-query program exceeded its capacity-retry budget "
+                f"({_MAX_PROGRAM_RETRIES}) — report this plan")
