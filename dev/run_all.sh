@@ -42,6 +42,9 @@ echo "== whole-query gate (one jitted program per step, 3-tier differential) =="
 JAX_PLATFORMS=cpu python dev/validate_trace.py --whole-query
 python bench.py --smoke --whole-query whole_query
 
+echo "== chaos gate (fault injection: retry/exclusion/degrade, fixed seed) =="
+JAX_PLATFORMS=cpu python dev/validate_trace.py --chaos
+
 echo "== micro-benchmarks =="
 python benchmarks/run_benchmarks.py --rows "${BENCH_ROWS:-2000000}"
 

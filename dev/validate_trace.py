@@ -61,8 +61,21 @@ Four checks, all hard failures:
    `validate_trace.py --encoded` with no trace path runs only this
    gate.
 
+7. Chaos gate (--chaos) — deterministic fault injection under a fixed
+   seed: a transient block-fetch flap must be absorbed by the bounded
+   fetch retry with zero stage regenerations; exhausted fetch retries
+   must regenerate from lineage correctly and an unbounded failure
+   stream must terminate in the classified StageRegenerationLimitError
+   with zero leaked shuffle blocks; a transient worker-task fault must
+   fail over to another executor with per-operator kernel attribution
+   still equal to driver+worker totals; a whole-tier runtime dispatch
+   fault must degrade to the stage tier with identical results. Every
+   scenario runs under a watchdog (a hang fails the gate) and the
+   device ledger must verify balanced afterwards. Self-contained:
+   `validate_trace.py --chaos` with no trace path runs only this gate.
+
 Usage: python dev/validate_trace.py [--cluster] [--live] [--mesh]
-       [--encoded] [<trace.json>]
+       [--encoded] [--whole-query] [--chaos] [<trace.json>]
 """
 
 import json
@@ -671,6 +684,212 @@ def whole_query_gate() -> None:
         session.stop()
 
 
+def chaos_gate() -> None:
+    """Chaos gate (--chaos, self-contained, fixed seed): deterministic
+    fault injection through the regular conf surface must always
+    TERMINATE — every injected fault class ends in a correct query
+    result or a CLASSIFIED error under a watchdog timeout, never a
+    hang. Scenarios: (1) transient block-fetch flap absorbed by the
+    bounded fetch retry with ZERO stage regenerations; (2) fetch-retry
+    budget exhausted → FetchFailed regeneration still correct, and an
+    unbounded failure stream terminates in StageRegenerationLimitError
+    with zero leaked shuffle blocks on any worker; (3) transient
+    worker-task fault retried on another executor with per-operator
+    kernel attribution still equal to driver+worker measured totals
+    AFTER the failover; (4) a whole-tier runtime dispatch fault
+    degrading to the stage tier with identical results. The device
+    ledger must verify balanced at the end."""
+    import pickle
+    import threading
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu import TpuSession
+    from spark_tpu.errors import StageRegenerationLimitError
+    from spark_tpu.net.transport import RpcClient
+    from spark_tpu.obs.resources import GLOBAL_LEDGER
+    from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE as KC
+    from spark_tpu.utils import faults
+
+    def watchdog(name, fn, timeout_s=120.0):
+        """Every injected fault must terminate — run the scenario under
+        a hard wall-clock bound so a hang fails the gate instead of
+        wedging CI."""
+        out: dict = {}
+
+        def run():
+            try:
+                out["result"] = fn()
+            except BaseException as e:   # re-raised on the gate thread
+                out["error"] = e
+
+        t = threading.Thread(target=run, daemon=True, name=f"chaos-{name}")
+        t.start()
+        t.join(timeout_s)
+        if t.is_alive():
+            fail(f"--chaos: scenario {name!r} HUNG past {timeout_s}s "
+                 "(injected faults must terminate in a result or a "
+                 "classified error)")
+        if "error" in out:
+            raise out["error"]
+        return out.get("result")
+
+    session = TpuSession("chaos-gate", {
+        "spark.sql.shuffle.partitions": "2",
+        "spark.tpu.batch.capacity": 1 << 12,
+        "spark.sql.adaptive.enabled": "false",
+        "spark.tpu.cluster.enabled": "true",
+        "spark.tpu.cluster.workers": "2",
+    })
+    try:
+        rng = np.random.default_rng(7)    # fixed seed end to end
+        keys = rng.integers(0, 24, 5000)
+        vals = rng.integers(-40, 90, 5000)
+        session.createDataFrame(pa.table({"k": keys, "v": vals})) \
+            .createOrReplaceTempView("cg_t")
+        rows = sorted(zip(keys.tolist(), vals.tolist()))
+
+        def set_faults(points):
+            session.conf.set("spark.tpu.faults.enabled", "true")
+            session.conf.set("spark.tpu.faults.seed", "7")
+            session.conf.set("spark.tpu.faults.points", points)
+            faults.configure(session.conf)
+
+        def clear_faults():
+            session.conf.set("spark.tpu.faults.enabled", "false")
+            session.conf.unset("spark.tpu.faults.points")
+            faults.configure(session.conf)
+
+        def counters():
+            return dict(session._metrics.snapshot()["counters"])
+
+        def shuffle_q():
+            return session.table("cg_t").repartition(2)
+
+        def check_rows(df):
+            got = sorted((r["k"], r["v"]) for r in df.collect())
+            if got != rows:
+                fail("--chaos: faulted query returned WRONG rows")
+
+        def scenario_flap():
+            set_faults("block.fetch=first:2")
+            before = counters()
+            check_rows(shuffle_q())
+            after = counters()
+            clear_faults()
+            regens = after.get("scheduler.fetch_failures", 0) \
+                - before.get("scheduler.fetch_failures", 0)
+            if regens != 0:
+                fail(f"--chaos: transient fetch flap cost {regens} stage "
+                     "regeneration(s) — the bounded retry did not absorb")
+            retries = after.get("shuffle.fetch_retries", 0) \
+                - before.get("shuffle.fetch_retries", 0)
+            if retries < 1:
+                fail("--chaos: fetch flap injected but no retry recorded")
+
+        def scenario_regen_and_cap():
+            session.conf.set("spark.tpu.shuffle.fetch.maxRetries", "0")
+            set_faults("block.fetch=first:1")
+            check_rows(shuffle_q())          # regen path still correct
+            session.conf.set("spark.tpu.scheduler.maxStageRegens", "1")
+            session.conf.set("spark.tpu.excludeOnFailure.maxFailures",
+                             "100")
+            set_faults("block.fetch=first:1000")
+            try:
+                shuffle_q().toArrow()
+                fail("--chaos: unbounded fetch failures did NOT raise "
+                     "the classified regen-limit error")
+            except StageRegenerationLimitError as e:
+                if e.error_class != "STAGE_REGENERATION_LIMIT":
+                    fail(f"--chaos: wrong error class {e.error_class}")
+            finally:
+                session.conf.unset("spark.tpu.shuffle.fetch.maxRetries")
+                session.conf.unset("spark.tpu.scheduler.maxStageRegens")
+                session.conf.unset(
+                    "spark.tpu.excludeOnFailure.maxFailures")
+                clear_faults()
+                session._sql_cluster.health.reset()
+            cluster = session._sql_cluster
+            for w in cluster.alive_workers():
+                with RpcClient(w.client.addr, cluster.authkey_hex) as c:
+                    stats = pickle.loads(c.call("block_stats", timeout=10))
+                if stats["blocks"]:
+                    fail(f"--chaos: failed query leaked {stats['blocks']} "
+                         f"shuffle block(s) on {w.executor_id}")
+
+        def scenario_failover_attribution():
+            check_rows(shuffle_q())          # warm
+            set_faults("worker.task=once")
+            before = KC.launches
+            df = shuffle_q()
+            check_rows(df)
+            driver_delta = KC.launches - before
+            clear_faults()
+            session._sql_cluster.health.reset()
+            ctx = df.query_execution._last_ctx
+            worker = sum((ctx.worker_kernel_kinds or {}).values())
+            graph = df.query_execution.plan_graph()
+            attributed = sum(v for nd in graph
+                             for v in (nd.get("launches") or {}).values())
+            if attributed != driver_delta + worker:
+                fail("--chaos: attribution total after failover "
+                     f"({attributed}) != driver+worker measured "
+                     f"({driver_delta}+{worker})")
+
+        def scenario_tier_degrade():
+            local = TpuSession("chaos-gate-local", {
+                "spark.sql.shuffle.partitions": "2",
+                "spark.tpu.batch.capacity": 1 << 12,
+                "spark.sql.adaptive.enabled": "false",
+                "spark.tpu.compile.tier": "whole",
+            })
+            try:
+                local.createDataFrame(pa.table({"k": keys, "v": vals})) \
+                    .createOrReplaceTempView("cg_t")
+                import spark_tpu.api.functions as F
+
+                def q():
+                    return (local.table("cg_t").repartition(2)
+                            .groupBy("k").agg(F.sum("v").alias("s")))
+
+                healthy = {r["k"]: r["s"] for r in q().collect()}
+                local.conf.set("spark.tpu.faults.enabled", "true")
+                local.conf.set("spark.tpu.faults.points",
+                               "kernel.dispatch=once@whole_query")
+                faults.configure(local.conf)
+                before = dict(local._metrics.snapshot()["counters"])
+                degraded = {r["k"]: r["s"] for r in q().collect()}
+                after = dict(local._metrics.snapshot()["counters"])
+                if degraded != healthy:
+                    fail("--chaos: tier-degraded run returned different "
+                         "results from the whole-tier run")
+                d = after.get("whole_query.runtime_degraded", 0) \
+                    - before.get("whole_query.runtime_degraded", 0)
+                if d != 1:
+                    fail("--chaos: whole-tier dispatch fault did not "
+                         f"degrade to the stage tier (counter delta {d})")
+            finally:
+                faults.reset()
+                local.stop()
+
+        watchdog("flap", scenario_flap)
+        watchdog("regen+cap", scenario_regen_and_cap)
+        watchdog("failover-attribution", scenario_failover_attribution)
+        watchdog("tier-degrade", scenario_tier_degrade)
+        issues = GLOBAL_LEDGER.verify()
+        if issues:
+            fail("--chaos: device ledger unbalanced after chaos run: "
+                 + "; ".join(issues))
+        print("validate_trace: chaos gate OK — flap absorbed with 0 "
+              "regens, regen limit classified + state freed, failover "
+              "attribution intact, whole→stage degrade identical, "
+              "ledger balanced")
+    finally:
+        faults.reset()
+        session.stop()
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     cluster = "--cluster" in argv
@@ -678,9 +897,11 @@ def main(argv=None) -> int:
     mesh = "--mesh" in argv
     encoded = "--encoded" in argv
     whole = "--whole-query" in argv
+    chaos = "--chaos" in argv
     argv = [a for a in argv if a not in ("--cluster", "--live", "--mesh",
-                                         "--encoded", "--whole-query")]
-    if (mesh or encoded or whole) and not argv:
+                                         "--encoded", "--whole-query",
+                                         "--chaos")]
+    if (mesh or encoded or whole or chaos) and not argv:
         # self-contained legs: these gates generate and validate their
         # own state (dev/run_all.sh runs them without a trace file)
         if mesh:
@@ -689,6 +910,8 @@ def main(argv=None) -> int:
             encoded_gate()
         if whole:
             whole_query_gate()
+        if chaos:
+            chaos_gate()
         print("validate_trace: PASS")
         return 0
     if len(argv) != 1:
@@ -705,6 +928,8 @@ def main(argv=None) -> int:
         encoded_gate()
     if whole:
         whole_query_gate()
+    if chaos:
+        chaos_gate()
     print("validate_trace: PASS")
     return 0
 

@@ -117,6 +117,12 @@ class TpuSession:
 
         # compressed-execution ingest harvest (spark.tpu.encoding.enabled)
         _encoding.configure(self.conf)
+        from ..utils import faults as _faults
+
+        # deterministic fault injection (spark.tpu.faults.*) — off by
+        # default; chaos runs flip it per session and the rules ship to
+        # workers with the rest of the conf
+        _faults.configure(self.conf)
         from ..obs.live import LiveObs
 
         # live telemetry store: heartbeat-streamed worker obs partials,

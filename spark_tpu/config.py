@@ -426,6 +426,97 @@ MEMORY_PEAK_GBPS = _register(ConfigEntry(
     "Peak HBM bandwidth (GB/s) for achieved-vs-peak rendering; 0 = auto "
     "from the device kind (CPU backends report no roofline).", float))
 
+# --- chaos hardening (PR 11): fault injection, retry/backoff, exclusion ---
+
+FAULTS_ENABLED = _register(ConfigEntry(
+    "spark.tpu.faults.enabled", False,
+    "Deterministic fault injection (utils/faults.py): named fault "
+    "points threaded through the stack (rpc.call, block.fetch, "
+    "worker.task, heartbeat.flush, kernel.compile, kernel.dispatch, "
+    "shuffle.write) fire per spark.tpu.faults.points rules. Off "
+    "(default) short-circuits every point to one module-bool read — "
+    "zero overhead on healthy runs. Ships to workers like all conf.",
+    _bool))
+
+FAULTS_SEED = _register(ConfigEntry(
+    "spark.tpu.faults.seed", 0,
+    "Seed for probabilistic fault rules; identical seed + call order "
+    "reproduces the identical fault schedule per process.", int))
+
+FAULTS_POINTS = _register(ConfigEntry(
+    "spark.tpu.faults.points", "",
+    "';'-separated fault rules, each point=trigger[:arg][:action[:arg]]"
+    "[@scope]. Triggers: once | nth:N | first:N | after:N (every call "
+    "past the Nth — the blackout shape) | prob:P | always. "
+    "Actions: raise (default) | kill (os._exit) | sleep:S. @scope "
+    "restricts to processes with that host label or calls whose detail "
+    "contains it (e.g. kernel.dispatch=once@whole_query).", str))
+
+RPC_MAX_RETRIES = _register(ConfigEntry(
+    "spark.tpu.rpc.maxRetries", 3,
+    "Bounded retry count for transient RpcUnavailableError on "
+    "conf-driven idempotent control-plane calls (finalize_merge; any "
+    "caller constructing RetryPolicy.from_conf) — the reference's "
+    "spark.rpc.numRetries role. Fire-and-forget cleanup RPCs "
+    "(free_shuffle, push_block) use a fixed small best-effort policy "
+    "instead, so a flapping peer can never stall shutdown on a "
+    "generous conf.", int))
+
+RPC_RETRY_BACKOFF_MS = _register(ConfigEntry(
+    "spark.tpu.rpc.retryBackoffMs", 50.0,
+    "Base backoff between control-plane RPC retries; grows "
+    "exponentially per attempt with full jitter, capped at 2s.", float))
+
+RPC_RETRY_DEADLINE = _register(ConfigEntry(
+    "spark.tpu.rpc.retryDeadline", 10.0,
+    "Wall-clock budget in seconds for one logical control-plane call "
+    "including all its retries — retries never extend past it.", float))
+
+FETCH_MAX_RETRIES = _register(ConfigEntry(
+    "spark.tpu.shuffle.fetch.maxRetries", 2,
+    "Bounded shuffle-block fetch retries (primary then shuffle-service "
+    "fallback per round) BEFORE raising FetchFailedError — a transient "
+    "block-server flap stops paying a full lineage stage regeneration "
+    "(reference: spark.shuffle.io.maxRetries).", int))
+
+FETCH_RETRY_WAIT_MS = _register(ConfigEntry(
+    "spark.tpu.shuffle.fetch.retryWaitMs", 50.0,
+    "Wait between shuffle fetch retry rounds (scaled linearly by "
+    "attempt; reference: spark.shuffle.io.retryWait).", float))
+
+EXCLUDE_ON_FAILURE = _register(ConfigEntry(
+    "spark.tpu.excludeOnFailure.enabled", True,
+    "Window-based executor exclusion (reference: TaskSetExcludelist / "
+    "HealthTracker, spark.excludeOnFailure.*): executors accumulating "
+    "maxFailures task failures inside windowSecs stop receiving tasks "
+    "for timeoutSecs, then rejoin automatically (timed re-inclusion). "
+    "Surfaced in live status, console executor rows, and EXPLAIN "
+    "ANALYZE findings.", _bool))
+
+EXCLUDE_MAX_FAILURES = _register(ConfigEntry(
+    "spark.tpu.excludeOnFailure.maxFailures", 2,
+    "Task failures inside the window before an executor is excluded "
+    "(reference: spark.excludeOnFailure.task.maxTaskAttemptsPerExecutor "
+    "family).", int))
+
+EXCLUDE_WINDOW_SECS = _register(ConfigEntry(
+    "spark.tpu.excludeOnFailure.windowSecs", 60.0,
+    "Sliding window over which executor failures count toward "
+    "exclusion; older failures expire.", float))
+
+EXCLUDE_TIMEOUT_SECS = _register(ConfigEntry(
+    "spark.tpu.excludeOnFailure.timeoutSecs", 30.0,
+    "How long an excluded executor stays out of scheduling before "
+    "timed re-inclusion (reference: spark.excludeOnFailure.timeout).",
+    float))
+
+STAGE_MAX_REGENS = _register(ConfigEntry(
+    "spark.tpu.scheduler.maxStageRegens", 8,
+    "Per-query cap on FetchFailed-driven stage regenerations; past it "
+    "the query fails with the classified StageRegenerationLimitError "
+    "instead of looping (reference: spark.stage.maxConsecutiveAttempts "
+    "+ the DAGScheduler abort-on-repeated-fetch-failure path).", int))
+
 HEARTBEAT_FLUSH_BUDGET = _register(ConfigEntry(
     "spark.tpu.heartbeat.flushBudget", 1 << 18,
     "Approximate byte cap on the live-obs payload of ONE executor "

@@ -72,5 +72,24 @@ class CapacityOverflowError(ExecutionError):
         self.capacity = capacity
 
 
+class StageRegenerationLimitError(ExecutionError):
+    """A query kept losing shuffle outputs (FetchFailed) and hit the
+    per-query stage-regeneration cap (spark.tpu.scheduler.maxStageRegens)
+    — the classified terminal form of what would otherwise be an
+    unbounded regenerate/fetch/fail loop (reference: DAGScheduler's
+    abort after spark.stage.maxConsecutiveAttempts)."""
+
+    error_class = "STAGE_REGENERATION_LIMIT"
+
+    def __init__(self, regens: int, cap: int, shuffle_id: str = ""):
+        super().__init__(
+            f"query exceeded {cap} shuffle-stage regenerations "
+            f"({regens} FetchFailed recoveries; last lost shuffle "
+            f"{shuffle_id or '<unknown>'}) — executors are losing map "
+            "outputs faster than lineage can regenerate them")
+        self.regens = regens
+        self.cap = cap
+
+
 class UnsupportedOperationError(SparkTpuError):
     error_class = "UNSUPPORTED_OPERATION"

@@ -151,7 +151,10 @@ class LocalCluster:
                  heartbeat_interval: float | None = None):
         self.max_task_failures = max_task_failures
         self.registry = ExecutorRegistry()
-        self.health = HealthTracker(self.registry, max_failures=2)
+        # timed exclusion by default (excludeOnFailure semantics); the
+        # SQL scheduler re-configures from session conf at query time
+        self.health = HealthTracker(self.registry, max_failures=2,
+                                    exclude_s=30.0)
         self.token = secrets.token_hex(16)
         self.bind_host = bind_host
         self.heartbeat_interval = heartbeat_interval
@@ -271,7 +274,19 @@ class LocalCluster:
                      hbm=msg.get("hbm"),
                      overflows=msg.get("obs_overflows"))
             except Exception:
-                pass    # telemetry must never fail a liveness heartbeat
+                # telemetry must never fail a liveness heartbeat — but a
+                # sink bug must not vanish either: count every swallowed
+                # error where live status can see it (a bare `pass` here
+                # once hid every sink regression)
+                with self._lock:
+                    self.stats["heartbeat.telemetry_errors"] = \
+                        self.stats.get("heartbeat.telemetry_errors", 0) + 1
+                owner = getattr(sink, "__self__", None)
+                if owner is not None:
+                    try:
+                        owner.telemetry_errors += 1
+                    except Exception:
+                        pass
         return b"ok" if ok else b"unknown"
 
     # ------------------------------------------------------------------
@@ -313,23 +328,53 @@ class LocalCluster:
         self._await_workers(before + 1, [proc])
 
     # ------------------------------------------------------------------
-    def _pick_free(self, timeout: float | None = None) -> _Worker | None:
+    def _pick_free(self, timeout: float | None = None,
+                   avoid: frozenset | set = frozenset()) -> _Worker | None:
         """ACQUIRE a free executor slot (central task queue semantics —
         TaskSchedulerImpl.resourceOffers: tasks go to whichever executor
         has a free slot, instead of binding to one at submit and queueing
         behind it, which would leave executors added by dynamic
-        allocation idle). Caller must release()."""
+        allocation idle). Caller must release().
+
+        `avoid` de-prioritizes executors that already failed THIS task
+        (TaskSetManager's per-task attempt excludelist role): avoided
+        executors are offered the slot only when no other executor is
+        free — progress beats purity on a shrunken cluster. Executors
+        excluded cluster-wide (HealthTracker window exclusion) never
+        appear at all: registry.alive() filters them."""
         deadline = None if timeout is None else time.monotonic() + timeout
+        override_counted = False
         while True:
             with self._lock:
                 alive = [self._workers[e.executor_id]
                          for e in self.registry.alive()
                          if e.executor_id in self._workers]
                 if not alive:
-                    raise ExecutorLostError("no alive executors")
+                    # distinguish a DEAD cluster from a fully-EXCLUDED
+                    # one: excluded executors are alive processes that
+                    # will rejoin at their re-inclusion horizon —
+                    # failing the query with 'no alive executors' would
+                    # be both misleading and a needless abort. Schedule
+                    # on excluded executors rather than starve (the
+                    # reference aborts the task set here; overriding
+                    # keeps liveness and the override is counted).
+                    registered = [self._workers[e.executor_id]
+                                  for e in self.registry.registered()
+                                  if e.executor_id in self._workers]
+                    if not registered:
+                        raise ExecutorLostError("no alive executors")
+                    alive = registered
+                    if not override_counted:
+                        override_counted = True
+                        self.stats["exclusion_overridden"] = \
+                            self.stats.get("exclusion_overridden", 0) + 1
                 order = alive[self._rr % len(alive):] + \
                     alive[:self._rr % len(alive)]
                 self._rr += 1
+            if avoid:
+                order = [w for w in order
+                         if w.executor_id not in avoid] + \
+                        [w for w in order if w.executor_id in avoid]
             for w in order:
                 if w.try_acquire():
                     return w
@@ -376,9 +421,37 @@ class LocalCluster:
                     return False
             return True
 
+    def _is_transient_task_error(self, e: Exception) -> bool:
+        """Worker-side task failures worth retrying on ANOTHER executor
+        (and counting against the reporting executor's excludeOnFailure
+        window): injected chaos faults and runtime resource exhaustion.
+        FetchFailed is NOT one — it must reach the DAG scheduler intact
+        so lineage regenerates the lost map stage. Everything else stays
+        deterministic (retrying a genuine task bug elsewhere fails the
+        same way and wastes an executor's failure budget)."""
+        from ..utils.faults import is_transient_marker
+        from .map_output import FetchFailedError
+
+        text = str(e)
+        if FetchFailedError.MARKER in text:
+            return False
+        return is_transient_marker(text)
+
+    def _record_failure(self, executor_id: str, lost: bool) -> None:
+        """Count a task failure / executor loss in the HealthTracker
+        (window-based exclusion) and the cluster stats."""
+        with self._lock:
+            k = "executor_losses" if lost else "transient_task_failures"
+            self.stats[k] = self.stats.get(k, 0) + 1
+        try:
+            self.health.record_failure(executor_id)
+        except Exception:
+            pass
+
     def _run_with_retries(self, payload: bytes,
                           pool: str = "default", task_key=None) -> tuple:
         last: Exception | None = None
+        avoid: set = set()   # executors that already failed THIS task
         with self._lock:
             self._pool_waiting[pool] = self._pool_waiting.get(pool, 0) + 1
         waiting = True  # balances _pool_waiting on EVERY exit path
@@ -393,7 +466,7 @@ class LocalCluster:
                         with self._slot_free:
                             self._slot_free.wait(timeout=0.05)
                         continue
-                    w = self._pick_free(timeout=0.05)
+                    w = self._pick_free(timeout=0.05, avoid=avoid)
                 with self._lock:
                     self._pool_waiting[pool] -= 1
                     waiting = False
@@ -407,14 +480,37 @@ class LocalCluster:
                     finally:
                         w.release()
                         self._notify_slot_free()
-                except (RemoteTaskError, RemoteRpcError):
+                except (RemoteTaskError, RemoteRpcError) as e:
+                    # only a TASK-side raise can be transient: a
+                    # RemoteRpcError (oversized payload, bad auth) has
+                    # RESOURCE_EXHAUSTED-shaped text but is the CALL
+                    # failing deterministically, not the executor
+                    if isinstance(e, RemoteTaskError) and \
+                            self._is_transient_task_error(e):
+                        # transient worker-side failure (injected fault /
+                        # resource exhaustion): the executor is alive but
+                        # suspect — count it toward exclusion and retry
+                        # the task elsewhere (TaskSetManager.maxFailures).
+                        # Under speculation the raiser may be the BACKUP
+                        # copy's executor, stamped on the exception.
+                        last = e
+                        failed_eid = getattr(e, "failing_executor",
+                                             w.executor_id)
+                        self._record_failure(failed_eid, lost=False)
+                        avoid.add(failed_eid)
+                        with self._lock:  # retry waits for a slot again
+                            self._pool_waiting[pool] += 1
+                            waiting = True
+                        continue
                     # the task (or its payload) failed deterministically —
                     # retrying on another healthy executor won't help, and
                     # the executor that reported it is NOT dead
                     raise
                 except (RpcUnavailableError, OSError) as e:
                     last = e
+                    self._record_failure(w.executor_id, lost=True)
                     self.registry.remove(w.executor_id)  # executor lost
+                    avoid.add(w.executor_id)
                     w.close()
                     self._notify_slot_free()
                     with self._lock:  # retry waits for a slot again
@@ -427,6 +523,9 @@ class LocalCluster:
             if waiting:
                 with self._lock:
                     self._pool_waiting[pool] -= 1
+        if last is not None and not isinstance(
+                last, (RpcUnavailableError, OSError, ExecutorLostError)):
+            raise last  # transient task failures exhausted the budget
         raise ExecutorLostError(
             f"task failed after {self.max_task_failures} executor losses: "
             f"{last}")
@@ -579,6 +678,14 @@ class LocalCluster:
                         self.stats.get("speculative_wins", 0) + 1
                 return val, w
             if kind == "task_err":
+                # the failure may come from the BACKUP copy — stamp the
+                # actually-failing executor so the retry loop's failure
+                # accounting does not blame the (possibly healthy)
+                # primary
+                try:
+                    val.failing_executor = w.executor_id
+                except Exception:
+                    pass
                 raise val
             # executor lost: drop it; if a copy is still running, let it
             # decide the task, else surface to the retry loop
@@ -619,6 +726,15 @@ class LocalCluster:
         with self._lock:
             return [self._workers[e.executor_id]
                     for e in self.registry.alive()
+                    if e.executor_id in self._workers]
+
+    def registered_workers(self) -> list:
+        """Every connected worker INCLUDING excluded ones — cleanup
+        paths must reach executors that exclusion removed from
+        scheduling (their block stores still hold data)."""
+        with self._lock:
+            return [self._workers[e.executor_id]
+                    for e in self.registry.registered()
                     if e.executor_id in self._workers]
 
     def run_task_on(self, worker, fn: Callable, *args) -> Any:

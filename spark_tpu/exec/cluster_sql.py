@@ -140,7 +140,9 @@ class FetchExec(PhysicalPlan):
                  merge: tuple | None = None,
                  part_indices: list | None = None,
                  col_stats: dict | None = None,
-                 dict_ids: dict | None = None):
+                 dict_ids: dict | None = None,
+                 fetch_retries: int = 2,
+                 fetch_wait_ms: float = 50.0):
         self.attrs = list(attrs)
         self.shuffle_id = shuffle_id
         self.maps = list(maps)              # [(map_id, block_addr), ...]
@@ -149,6 +151,11 @@ class FetchExec(PhysicalPlan):
         self.fallback_addr = fallback_addr  # external shuffle service
         self.merge = merge       # (service_addr, {rid: (map ids merged)})
         self.part_indices = part_indices
+        # bounded-fetch-retry knobs, captured as plain values at plan
+        # substitution time (the leaf ships to worker processes, which
+        # must retry with the DRIVER session's settings)
+        self.fetch_retries = fetch_retries
+        self.fetch_wait_ms = fetch_wait_ms
         # {rid: {col_idx: (kmin, kmax, any)}} merged across map tasks —
         # seeds the dense-range memo on rebuild (no krange3 probe on
         # post-shuffle dense decisions; same stats the local write seeds)
@@ -217,14 +224,22 @@ class FetchExec(PhysicalPlan):
                 if key not in clients:
                     clients[key] = BlockClient(
                         addr, self.authkey_hex, bid,
-                        fallback_addr=self.fallback_addr)
+                        fallback_addr=self.fallback_addr,
+                        max_retries=self.fetch_retries,
+                        retry_wait_ms=self.fetch_wait_ms)
                 try:
                     raw = clients[key].get(rid)
                 except FetchFailedError as e:
-                    # re-key to the BASE shuffle id: the scheduler
-                    # regenerates the whole map stage, not one map task
-                    raise FetchFailedError(self.shuffle_id,
-                                           str(e)) from None
+                    # last alternate source before the expensive lineage
+                    # regen: a push-merged chunk that failed its FIRST
+                    # read (or was skipped) may hold this map's frame
+                    raw = self._merged_rescue(clients, rid, map_id)
+                    if raw is None:
+                        # re-key to the BASE shuffle id: the scheduler
+                        # regenerates the whole map stage, not one task
+                        raise FetchFailedError(self.shuffle_id,
+                                               str(e)) from None
+                    ctx.metrics.add("shuffle.fetch_merged_rescues")
                 ctx.metrics.add("shuffle.blocks_fetched")
             seed = (self.col_stats or {}).get(rid)
             toks = ((self.dict_ids or {}).get(map_id) or {}).get(rid)
@@ -232,6 +247,24 @@ class FetchExec(PhysicalPlan):
                                           dict_cache=dict_cache,
                                           dict_tokens=toks))
         return part
+
+    def _merged_rescue(self, clients: dict, rid: int,
+                       map_id: int) -> bytes | None:
+        """Retry the push-merged chunk as an ALTERNATE SOURCE for one
+        map's frame after its per-map block fetch exhausted retries."""
+        if self.merge is None:
+            return None
+        service_addr, merged_index = self.merge
+        if map_id not in (merged_index.get(rid) or ()):
+            return None
+        from ..net.transport import RpcClient
+
+        if "merged" not in clients:
+            clients["merged"] = RpcClient(service_addr, self.authkey_hex)
+        got = fetch_merged(clients["merged"], self.shuffle_id, rid)
+        if got is None:
+            return None
+        return dict(got).get(map_id)
 
     def execute(self, ctx):
         from contextlib import nullcontext
@@ -259,6 +292,12 @@ class FetchExec(PhysicalPlan):
                                         dict_cache)
                         for rid in rids]
         finally:
+            retries = sum(getattr(c, "retries_used", 0)
+                          for c in clients.values())
+            if retries:
+                # transient flaps this fetch absorbed WITHOUT paying a
+                # lineage regen (the chaos gate's zero-regen assertion)
+                ctx.metrics.add("shuffle.fetch_retries", retries)
             for c in clients.values():
                 c.close()
 
@@ -313,6 +352,18 @@ def _run_stage_store(plan_bytes: bytes, conf_overrides: dict,
         ctx.tracer = obs["tracer"]
     qtoken = push_query(query_id) if query_id is not None else None
     try:  # noqa: SIM105 — failed tasks must deregister from live flushing
+        # chaos seam (rules just installed from the shipped conf by
+        # begin_stage_obs): an injected raise surfaces to the driver as
+        # a TRANSIENT task failure (retried on another executor,
+        # counted toward this executor's exclusion window); kill mode
+        # hard-exits the process mid-task (the worker-death scenario).
+        # Inside the try: a raise must deregister the live recorder or
+        # the task would stream ghost partials forever
+        from ..utils import faults
+
+        if faults.ENABLED:
+            faults.maybe_fail("worker.task",
+                              detail=f"{shuffle_id}#m{map_id}")
         task_span = ctx.tracer.span(
             f"task[{map_block_id(shuffle_id, map_id, num_maps)}]",
             cat="worker",
@@ -390,6 +441,26 @@ class ClusterDAGScheduler(DAGScheduler):
         # supersedes them (_run_remote → task_finished). The straggler
         # detector doubles as the speculative-execution signal hook.
         self.live = getattr(ctx, "live_obs", None)
+        # excludeOnFailure: configure the cluster's HealthTracker from
+        # session conf and hook exclusion events into the live store
+        # (console executor rows, live status, EXPLAIN ANALYZE findings)
+        from ..config import (
+            EXCLUDE_MAX_FAILURES, EXCLUDE_ON_FAILURE, EXCLUDE_TIMEOUT_SECS,
+            EXCLUDE_WINDOW_SECS,
+        )
+
+        health = getattr(cluster, "health", None)
+        if health is not None:
+            health.configure(
+                enabled=bool(ctx.conf.get(  # tpulint: ignore[host-sync]
+                    EXCLUDE_ON_FAILURE)),
+                max_failures=int(ctx.conf.get(  # tpulint: ignore[host-sync]
+                    EXCLUDE_MAX_FAILURES)),
+                window_s=float(ctx.conf.get(  # tpulint: ignore[host-sync]
+                    EXCLUDE_WINDOW_SECS)),
+                exclude_s=float(ctx.conf.get(  # tpulint: ignore[host-sync]
+                    EXCLUDE_TIMEOUT_SECS)))
+            health.on_exclude = self._on_executor_excluded
         if self.live is not None:
             if getattr(cluster, "obs_sink", None) is None:
                 cluster.obs_sink = self.live.on_heartbeat
@@ -403,6 +474,28 @@ class ClusterDAGScheduler(DAGScheduler):
                         key is None or (f[1], f[2]) == key
                         for f in live.active_stragglers()))
 
+    def _on_executor_excluded(self, eid: str, until: float,
+                              failures: int) -> None:
+        """HealthTracker exclusion hook: surface the event in the live
+        store so console executor rows, live status, and EXPLAIN
+        ANALYZE findings all show WHY an executor stopped taking tasks
+        (the reference's TaskSetExcludelist → UI excludelist view)."""
+        if self.live is None:
+            return
+        import math
+
+        from ..obs.tracing import current_query
+
+        horizon = None if math.isinf(until) else until
+        self.live.executor_excluded(eid, horizon, failures)
+        self.live.add_finding(current_query(), {
+            "severity": "warning", "kind": "exec.excluded",
+            "executor": eid,
+            "msg": f"executor {eid} excluded after {failures} task "
+                   "failure(s) in the excludeOnFailure window"
+                   + ("" if horizon is None else
+                      " (timed re-inclusion pending)")})
+
     def _run(self, plan):
         # DAGScheduler.run wraps this with the driver-process KernelCache
         # delta accounting; worker-process deltas merge in via each
@@ -410,6 +503,17 @@ class ClusterDAGScheduler(DAGScheduler):
         # query metrics are driver+worker totals in cluster mode
         import threading
         from collections import defaultdict
+
+        from ..config import STAGE_MAX_REGENS
+        from ..errors import StageRegenerationLimitError
+
+        max_regens = int(self.ctx.conf.get(  # tpulint: ignore[host-sync]
+            STAGE_MAX_REGENS))
+        regens = [0]   # FetchFailed-driven regenerations THIS query
+        # sibling stages materialize on pool threads and can catch
+        # FetchFailed concurrently — the cap counter must not lose
+        # increments to a torn read-modify-write
+        regen_lock = threading.Lock()
 
         result_stage, stages = build_stage_graph(plan)
         done: set[int] = set()
@@ -490,11 +594,24 @@ class ClusterDAGScheduler(DAGScheduler):
 
                         self.live.stage_abandoned(
                             _cq(), self._shuffle_id(stage))
+                    # (partial map outputs of the failed attempt are
+                    # freed by _run_remote's own handler, closest to
+                    # the failure and covering BaseException too)
                     sid = _fetch_failed_shuffle_id(e)
                     if sid is not None:
                         # a parent's blocks are gone — regenerate it from
-                        # lineage before retrying this stage
+                        # lineage before retrying this stage. Bounded per
+                        # query: an executor set losing outputs faster
+                        # than lineage regenerates them must terminate in
+                        # a CLASSIFIED error, not an infinite loop
+                        with regen_lock:
+                            regens[0] += 1
+                            n_regens = regens[0]
+                        if n_regens > max_regens:
+                            raise StageRegenerationLimitError(
+                                n_regens, max_regens, sid) from e
                         self.ctx.metrics.add("scheduler.fetch_failures")
+                        self._record_lost_shuffle_executors(sid, str(e))
                         for p in stage.parents:
                             invalidate_if_stale(p, sid)
                         for p in stage.parents:
@@ -587,13 +704,20 @@ class ClusterDAGScheduler(DAGScheduler):
                               col_stats, dict_ids),
                     counters, obs, worker.executor_id)
 
-        if num_maps == 1:
-            outcomes = [run_map(0)]
-        else:
-            with ThreadPoolExecutor(num_maps) as pool:
-                futures = [scoped_submit(pool, run_map, m)
-                           for m in range(num_maps)]
-                outcomes = [f.result() for f in futures]
+        try:
+            if num_maps == 1:
+                outcomes = [run_map(0)]
+            else:
+                with ThreadPoolExecutor(num_maps) as pool:
+                    futures = [scoped_submit(pool, run_map, m)
+                               for m in range(num_maps)]
+                    outcomes = [f.result() for f in futures]
+        except BaseException:
+            # sibling map tasks that SUCCEEDED stored blocks under this
+            # sid; the status never registers, so free them now or they
+            # leak on the workers (the stage retry uses a fresh sid)
+            self._free_sid_best_effort(sid)
+            raise
         status = ShuffleStatus(sid, [ms for ms, *_ in outcomes])
         self.map_outputs.register(status)
         if getattr(self.cluster, "push_shuffle", False) and \
@@ -666,7 +790,7 @@ class ClusterDAGScheduler(DAGScheduler):
         import pickle
         from contextlib import nullcontext
 
-        from ..net.transport import RpcClient
+        from ..net.transport import RetryPolicy, RpcClient
 
         addr = self.cluster.shuffle_service_addr
         tracer = getattr(self.ctx, "tracer", None)
@@ -680,14 +804,59 @@ class ClusterDAGScheduler(DAGScheduler):
         with sp:
             try:
                 with RpcClient(addr, self.cluster.authkey_hex) as c:
+                    # idempotent (finalize twice returns the same index)
+                    # — absorb a transient service flap with backoff
                     merged = pickle.loads(
                         c.call("finalize_merge", pickle.dumps(sid),
-                               timeout=30))
+                               timeout=30,
+                               retry=RetryPolicy.from_conf(self.ctx.conf)))
             except Exception:
                 return None    # merge unavailable — per-map fetch works
         merge = MergeStatus(sid, addr, num_maps, merged)
         self.map_outputs.register_merge(merge)
         return merge
+
+    def _record_lost_shuffle_executors(self, sid: str,
+                                       error_text: str = "") -> None:
+        """A FetchFailed names a lost shuffle — count the failure
+        against the executor whose block server actually failed (the
+        reference's fetch-failure → HealthTracker attribution): the
+        error text carries the failing block address, so only producers
+        whose address appears in it are blamed (blaming every producer
+        of a wide shuffle would exclude healthy executors). Falls back
+        to all producers only when no address matches (e.g. a
+        re-serialized error lost the detail)."""
+        health = getattr(self.cluster, "health", None)
+        st = self.map_outputs.get(sid)
+        if health is None or st is None:
+            return
+        producers = {ms.executor_id: ms.block_addr
+                     for ms in st.maps if ms.executor_id}
+        blamed = [eid for eid, addr in producers.items()
+                  if addr and addr in error_text]
+        for eid in (blamed or producers):
+            try:
+                health.record_failure(eid)
+            except Exception:
+                pass
+
+    def _free_sid_best_effort(self, sid: str) -> None:
+        """Free one shuffle id's blocks on EVERY registered worker
+        (INCLUDING excluded ones — an executor excluded mid-stage still
+        holds its stored blocks) plus the shuffle service — the cleanup
+        path for sids that never made it into the MapOutputTracker (a
+        stage attempt that stored some map blocks and then failed):
+        _free_shuffles can only free what was registered, so partial
+        outputs would leak worker memory for the life of the process."""
+        key = self.cluster.authkey_hex
+        for w in getattr(self.cluster, "registered_workers", list)():
+            try:
+                free_shuffle(w.client.addr, key, sid)
+            except Exception:
+                pass
+        service = getattr(self.cluster, "shuffle_service_addr", None)
+        if service:
+            free_shuffle(service, key, sid)
 
     def _free_one(self, st: ShuffleStatus) -> None:
         """Best-effort release of one shuffle's blocks on its executors
@@ -744,6 +913,8 @@ def _substitute_parents(node, sched: ClusterDAGScheduler):
     executors holding the parent's map outputs (plus the merge index
     when the parent shuffle was push-merged)."""
     if isinstance(node, _StageOutput):
+        from ..config import FETCH_MAX_RETRIES, FETCH_RETRY_WAIT_MS
+
         st = node.stage
         status = st.result
         assert isinstance(status, ShuffleStatus), \
@@ -760,7 +931,11 @@ def _substitute_parents(node, sched: ClusterDAGScheduler):
                          col_stats=_merged_col_stats(status.maps),
                          dict_ids={m.map_id: m.dict_ids
                                    for m in status.maps
-                                   if m.dict_ids} or None)
+                                   if m.dict_ids} or None,
+                         fetch_retries=int(  # tpulint: ignore[host-sync]
+                             sched.ctx.conf.get(FETCH_MAX_RETRIES)),
+                         fetch_wait_ms=float(  # tpulint: ignore[host-sync]
+                             sched.ctx.conf.get(FETCH_RETRY_WAIT_MS)))
     return node.map_children(lambda c: _substitute_parents(c, sched))
 
 
@@ -776,6 +951,8 @@ def _slice_fetch_leaves(node, map_id: int, num_maps: int):
             merge=node.merge,
             part_indices=list(range(map_id, node.num_partitions,
                                     num_maps)),
-            col_stats=node.col_stats, dict_ids=node.dict_ids)
+            col_stats=node.col_stats, dict_ids=node.dict_ids,
+            fetch_retries=node.fetch_retries,
+            fetch_wait_ms=node.fetch_wait_ms)
     return node.map_children(
         lambda c: _slice_fetch_leaves(c, map_id, num_maps))

@@ -13,9 +13,13 @@ from __future__ import annotations
 
 import pickle
 import threading
+import time
 from dataclasses import dataclass, field
 
-from ..net.transport import RpcClient, RpcUnavailableError
+from ..net.transport import (
+    BEST_EFFORT_RETRY, RpcClient, RpcUnavailableError,
+)
+from ..utils import faults
 
 
 class FetchFailedError(RuntimeError):
@@ -155,12 +159,20 @@ class BlockClient:
     """One authenticated gRPC channel to an executor's block server,
     reused across block requests (ShuffleBlockFetcherIterator keeps one
     channel per (host, port) too — per-block reconnect pays TCP+HTTP/2
-    setup num_partitions times). Blocks arrive as chunked streams; any
-    transport failure maps to FetchFailedError so the scheduler can
-    regenerate the producing stage from lineage."""
+    setup num_partitions times). Blocks arrive as chunked streams.
+
+    A failed fetch RETRIES a bounded number of rounds before it maps to
+    FetchFailedError (primary, then the external shuffle service when
+    present, each round): raising FetchFailed costs a full lineage
+    stage regeneration, so a transient block-server flap must be
+    absorbed here (spark.tpu.shuffle.fetch.maxRetries — the reference's
+    spark.shuffle.io.maxRetries/retryWait role). Only after the retry
+    budget is spent does the scheduler see FetchFailed and regenerate
+    the producing stage."""
 
     def __init__(self, addr: str, authkey_hex: str, shuffle_id: str,
-                 fallback_addr: str | None = None):
+                 fallback_addr: str | None = None,
+                 max_retries: int = 2, retry_wait_ms: float = 50.0):
         self.shuffle_id = shuffle_id
         if ":" not in addr:
             raise FetchFailedError(shuffle_id, f"bad block address {addr!r}")
@@ -172,8 +184,16 @@ class BlockClient:
         # FetchFailed, which would recompute the whole map stage
         self.fallback_addr = fallback_addr
         self._fallback: RpcClient | None = None
+        self.max_retries = max(int(max_retries), 0)
+        self.retry_wait_ms = float(retry_wait_ms)
+        self.retries_used = 0      # rounds past the first (metrics)
 
     def _fetch_from(self, client: RpcClient, reduce_id: int) -> bytes:
+        if faults.ENABLED:
+            faults.maybe_fail(
+                "block.fetch",
+                detail=f"{self.shuffle_id}:{reduce_id}@{client.addr}",
+                exc=RpcUnavailableError)
         frames = client.stream(
             "get_block", pickle.dumps((self.shuffle_id, reduce_id)),
             timeout=120)
@@ -184,24 +204,42 @@ class BlockClient:
                 f"block {reduce_id} missing at {client.addr}")
         return b"".join(frames)
 
+    def _try_fallback(self, reduce_id: int) -> bytes:
+        if self._fallback is None:
+            self._fallback = RpcClient(self.fallback_addr, self._key)
+        return self._fetch_from(self._fallback, reduce_id)
+
     def get(self, reduce_id: int) -> bytes:
-        try:
-            return self._fetch_from(self._client, reduce_id)
-        except (RpcUnavailableError, FetchFailedError) as e:
-            if self.fallback_addr is None:
-                if isinstance(e, FetchFailedError):
-                    raise
-                raise FetchFailedError(
-                    self.shuffle_id, f"{self.addr} died mid-fetch: {e}")
+        last: Exception | None = None
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                self.retries_used += 1
+                time.sleep(self.retry_wait_ms * attempt / 1000.0)
+            missing = False
             try:
-                if self._fallback is None:
-                    self._fallback = RpcClient(self.fallback_addr, self._key)
-                return self._fetch_from(self._fallback, reduce_id)
-            except RpcUnavailableError as e2:
-                raise FetchFailedError(
-                    self.shuffle_id,
-                    f"{self.addr} and shuffle service both unreachable: "
-                    f"{e2}")
+                return self._fetch_from(self._client, reduce_id)
+            except (RpcUnavailableError, FetchFailedError) as e:
+                last = e
+                # a REACHABLE server answering 'missing' is definitive
+                # (the store lost the block — it will not reappear);
+                # only transport failures are worth another round
+                missing = isinstance(e, FetchFailedError)
+                if self.fallback_addr is not None:
+                    try:
+                        return self._try_fallback(reduce_id)
+                    except (RpcUnavailableError, FetchFailedError) as e2:
+                        last = e2
+                        missing = missing and \
+                            isinstance(e2, FetchFailedError)
+            if missing:
+                break   # every source says gone — regen now, not later
+        raise FetchFailedError(
+            self.shuffle_id,
+            f"block {reduce_id} unavailable after "
+            f"{self.retries_used + 1} fetch round(s) at {self.addr}"
+            + (f" (+ service {self.fallback_addr})"
+               if self.fallback_addr else "")
+            + f": {last}")
 
     def close(self) -> None:
         self._client.close()
@@ -254,11 +292,14 @@ def fetch_merged(client: RpcClient, shuffle_id: str,
 
 
 def free_shuffle(addr: str, authkey_hex: str, shuffle_id: str) -> None:
-    """Best-effort release of a shuffle's blocks on one executor."""
+    """Best-effort release of a shuffle's blocks on one executor. A
+    transient flap retries briefly (BEST_EFFORT_RETRY) — leaked blocks
+    outlive the flap, a dead executor's blocks died with it."""
     if ":" not in addr:
         return
     try:
         with RpcClient(addr, authkey_hex) as c:
-            c.call("free_shuffle", pickle.dumps(shuffle_id), timeout=10)
+            c.call("free_shuffle", pickle.dumps(shuffle_id), timeout=10,
+                   retry=BEST_EFFORT_RETRY)
     except Exception:
         pass

@@ -312,8 +312,13 @@ class ExecutorInfo:
     host: str
     slots: int
     last_heartbeat: float = field(default_factory=time.time)
-    failures: int = 0
-    excluded: bool = False
+    failures: int = 0            # lifetime task-failure total (surfaced)
+    excluded: bool = False       # permanent exclusion (legacy/manual)
+    excluded_until: float = 0.0  # timed exclusion (excludeOnFailure)
+
+    def is_excluded(self, now: float | None = None) -> bool:
+        return self.excluded or \
+            self.excluded_until > (time.time() if now is None else now)
 
 
 class ExecutorRegistry:
@@ -357,29 +362,128 @@ class ExecutorRegistry:
         return dead
 
     def alive(self) -> list[ExecutorInfo]:
+        now = time.time()
         with self._lock:
-            return [e for e in self._executors.values() if not e.excluded]
+            return [e for e in self._executors.values()
+                    if not e.is_excluded(now)]
+
+    def registered(self) -> list[ExecutorInfo]:
+        """All registered executors INCLUDING excluded ones — the
+        last-resort scheduling pool when exclusion would otherwise
+        starve the cluster."""
+        with self._lock:
+            return list(self._executors.values())
 
 
 class HealthTracker:
-    """Excludelist on repeated failures (HealthTracker.scala:52)."""
+    """Executor excludelist on repeated failures (the reference's
+    HealthTracker.scala:52 + TaskSetExcludelist): failures are counted
+    per executor inside a sliding window; crossing `max_failures` inside
+    `window_s` excludes the executor from scheduling for `exclude_s`
+    seconds (timed re-inclusion — a transiently-sick executor rejoins,
+    a permanently-sick one re-excludes on its next failures). Failure
+    history lives here (not on ExecutorInfo), so counters survive an
+    executor being removed and re-registered and are reportable after
+    loss."""
 
     def __init__(self, registry: ExecutorRegistry,
-                 max_failures: int = 2):
+                 max_failures: int = 2, window_s: float = 60.0,
+                 exclude_s: float = 0.0, enabled: bool = True):
         self.registry = registry
         self.max_failures = max_failures
+        self.window_s = window_s
+        # 0.0 keeps the legacy permanent-exclusion semantics (tests and
+        # callers that never configure a timeout)
+        self.exclude_s = exclude_s
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._failures: dict[str, list[float]] = {}
+        self._totals: dict[str, int] = {}
+        self._excluded_until: dict[str, float] = {}
+        # on_exclude(eid, until, failures) — the cluster scheduler hooks
+        # this to surface exclusion in live status / EXPLAIN ANALYZE
+        self.on_exclude = None
+
+    def configure(self, enabled: bool | None = None,
+                  max_failures: int | None = None,
+                  window_s: float | None = None,
+                  exclude_s: float | None = None) -> None:
+        if enabled is not None:
+            self.enabled = enabled
+        if max_failures is not None:
+            self.max_failures = max_failures
+        if window_s is not None:
+            self.window_s = window_s
+        if exclude_s is not None:
+            self.exclude_s = exclude_s
 
     def record_failure(self, executor_id: str) -> bool:
-        """Returns True if the executor is now excluded."""
+        """Count one task failure against the executor. Returns True if
+        the executor is now (or already) excluded."""
+        if not self.enabled:
+            return False
+        now = time.time()
+        with self._lock:
+            times = self._failures.setdefault(executor_id, [])
+            times.append(now)
+            times[:] = [t for t in times if now - t <= self.window_s]
+            self._totals[executor_id] = \
+                self._totals.get(executor_id, 0) + 1
+            total = self._totals[executor_id]
+            trip = len(times) >= self.max_failures
+            if trip:
+                until = (now + self.exclude_s) if self.exclude_s > 0 \
+                    else float("inf")
+                self._excluded_until[executor_id] = until
+                # the window restarts after an exclusion: re-inclusion
+                # gives the executor a clean slate to prove itself
+                times.clear()
         with self.registry._lock:
             e = self.registry._executors.get(executor_id)
             if e is None:
-                return True
-            e.failures += 1
-            if e.failures >= self.max_failures:
-                e.excluded = True
-                return True
-        return False
+                # executor already deregistered (process death) — the
+                # failure still counts toward its history
+                excluded = True
+            else:
+                e.failures = total
+                if trip:
+                    if self.exclude_s > 0:
+                        e.excluded_until = until
+                    else:
+                        e.excluded = True
+                excluded = e.is_excluded()
+        if trip and self.on_exclude is not None:
+            try:
+                self.on_exclude(executor_id,
+                                self._excluded_until[executor_id], total)
+            except Exception:
+                pass    # surfacing must never fail the scheduling path
+        return excluded
+
+    def failure_count(self, executor_id: str) -> int:
+        with self._lock:
+            return self._totals.get(executor_id, 0)
+
+    def reset(self) -> None:
+        """Clear all failure history and lift every exclusion (the
+        operator's 'clear the excludelist' action)."""
+        with self._lock:
+            self._failures.clear()
+            self._totals.clear()
+            self._excluded_until.clear()
+        with self.registry._lock:
+            for e in self.registry._executors.values():
+                e.excluded = False
+                e.excluded_until = 0.0
+                e.failures = 0
+
+    def excluded(self) -> dict[str, float]:
+        """Currently-excluded executors → re-inclusion time."""
+        now = time.time()
+        with self._lock:
+            return {eid: until
+                    for eid, until in self._excluded_until.items()
+                    if until > now}
 
 
 class BarrierCoordinator:

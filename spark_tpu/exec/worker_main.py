@@ -25,7 +25,10 @@ import threading
 import time
 import traceback
 
-from ..net.transport import CHUNK_BYTES, RpcClient, RpcServer
+from ..net.transport import (
+    BEST_EFFORT_RETRY, CHUNK_BYTES, RpcClient, RpcServer,
+)
+from ..utils import faults
 
 # (shuffle_id, reduce_id) → Arrow IPC bytes; lives for the worker process
 BLOCK_STORE: dict = {}
@@ -60,6 +63,8 @@ def store_map_block(shuffle_id: str, map_id: int, num_maps: int,
     from .map_output import map_block_id
 
     bid = map_block_id(shuffle_id, map_id, num_maps)
+    if faults.ENABLED:
+        faults.maybe_fail("shuffle.write", detail=f"{bid}:{reduce_id}")
     with _STORE_LOCK:
         BLOCK_STORE[(bid, reduce_id)] = data
     root = os.environ.get("SPARK_TPU_SHUFFLE_DIR")
@@ -69,10 +74,12 @@ def store_map_block(shuffle_id: str, map_id: int, num_maps: int,
         persist_block(root, bid, reduce_id, data)
     client = _push_client()
     if client is not None:
+        # pushes are idempotent (the merger dedups by (map, reduce)) —
+        # absorb a transient service flap instead of failing the task
         client.call(
             "push_block",
             pickle.dumps((shuffle_id, map_id, reduce_id, data)),
-            timeout=120)
+            timeout=120, retry=BEST_EFFORT_RETRY)
 
 
 def put_block(shuffle_id: str, reduce_id: int, data: bytes) -> None:
@@ -119,6 +126,10 @@ def begin_stage_obs(conf, query_id: str | None = None,
 
     # compressed-execution ingest harvest follows the shipped conf too
     _encoding.configure(conf)
+    # fault-injection rules ship with the session conf exactly like the
+    # other process-global switches — chaos runs exercise the worker's
+    # task/heartbeat/shuffle-write seams, healthy conf disables them
+    faults.configure(conf)
 
     # conf values are host data — bool() here never touches device
     if not bool(conf.get(  # tpulint: ignore[host-sync]
@@ -314,6 +325,16 @@ def _handle_get_block(payload: bytes):
         yield data[off:off + CHUNK_BYTES]
 
 
+def _handle_block_stats(payload: bytes) -> bytes:
+    """Block-store introspection for tests/CI gates: the chaos suite
+    asserts failed queries leave ZERO blocks behind on every worker."""
+    with _STORE_LOCK:
+        return pickle.dumps({
+            "blocks": len(BLOCK_STORE),
+            "bytes": sum(len(v) for v in BLOCK_STORE.values()),
+        })
+
+
 def _handle_free_shuffle(payload: bytes) -> bytes:
     sid = pickle.loads(payload)
     with _STORE_LOCK:
@@ -352,6 +373,7 @@ def serve_worker(driver_addr: str, token: str, host_label: str = "localhost",
     server = RpcServer(token, host=bind_host)
     server.register("launch_task", _handle_launch_task)
     server.register("free_shuffle", _handle_free_shuffle)
+    server.register("block_stats", _handle_block_stats)
     server.register("ping", lambda _p: b"pong")
     server.register_stream("get_block", _handle_get_block)
     addr = server.start()
@@ -376,6 +398,20 @@ def serve_worker(driver_addr: str, token: str, host_label: str = "localhost",
         while True:
             time.sleep(interval)
             try:
+                # chaos seam: an injected heartbeat blackout models the
+                # DRIVER never receiving the beat (a receive-path
+                # partition) — from the driver's view the executor went
+                # silent mid-task, which is exactly what the straggler
+                # silence deadline and speculative execution must
+                # absorb. The detail carries busy/idle so rules can
+                # target beats DURING a task (`@busy`) — an idle-phase
+                # blackout would be consumed before the task exists.
+                if faults.ENABLED:
+                    with _STORE_LOCK:
+                        busy = bool(_LIVE_TASKS)
+                    faults.maybe_fail(
+                        "heartbeat.flush",
+                        detail="busy" if busy else "idle")
                 # live telemetry rides the liveness heartbeat: snapshots
                 # of every in-flight stage task's obs counters/spans
                 # (empty list when nothing runs or streaming is off).
@@ -403,6 +439,11 @@ def serve_worker(driver_addr: str, token: str, host_label: str = "localhost",
                     # RPC failure) — re-register under a fresh id, the
                     # reference's "executor told to re-register" path
                     eid = register()
+            except faults.InjectedFault:
+                # injected blackout: the beat was "lost on the wire",
+                # not a send failure — the worker itself is healthy and
+                # must not count it toward the driver-gone suicide
+                continue
             except Exception:
                 misses += 1
                 if misses >= 5:  # driver gone — shut down

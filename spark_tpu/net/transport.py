@@ -19,14 +19,64 @@ chunks (HTTP/2 flow control replaces maxChunksBeingTransferred).
 
 from __future__ import annotations
 
+import random
+import time
 from concurrent import futures
 from typing import Callable, Iterator
 
 import grpc
 
+from ..utils import faults
+
 SERVICE = "sparktpu.Transport"
 CHUNK_BYTES = 4 << 20
 _AUTH_KEY = "sparktpu-auth"
+
+# process-wide retry bookkeeping (tests and the chaos gate read these):
+# absorbed = transient UNAVAILABLE errors a retry recovered from;
+# gave_up = logical calls that exhausted their retry budget
+RETRY_STATS = {"absorbed": 0, "gave_up": 0}
+
+
+class RetryPolicy:
+    """Bounded retry for transient RpcUnavailableError on IDEMPOTENT
+    control-plane calls: exponential backoff with full jitter, capped
+    per-sleep, under a wall-clock deadline (role of the reference's
+    RpcUtils.numRetries/retryWaitMs + shuffle.io.maxRetries discipline).
+    Application errors (RemoteRpcError) never retry — the same call
+    would fail the same way anywhere."""
+
+    __slots__ = ("attempts", "base_ms", "max_ms", "deadline_s")
+
+    def __init__(self, attempts: int = 3, base_ms: float = 50.0,
+                 max_ms: float = 2000.0, deadline_s: float = 10.0):
+        self.attempts = max(int(attempts), 0)
+        self.base_ms = float(base_ms)
+        self.max_ms = float(max_ms)
+        self.deadline_s = float(deadline_s)
+
+    def backoff_s(self, attempt: int) -> float:
+        """Sleep before retry `attempt` (1-based): exp growth with full
+        jitter so a thundering herd of retries decorrelates."""
+        span = min(self.base_ms * (2 ** (attempt - 1)), self.max_ms)
+        return random.uniform(span / 2, span) / 1000.0
+
+    @classmethod
+    def from_conf(cls, conf) -> "RetryPolicy":
+        from ..config import (
+            RPC_MAX_RETRIES, RPC_RETRY_BACKOFF_MS, RPC_RETRY_DEADLINE,
+        )
+
+        return cls(
+            attempts=int(conf.get(RPC_MAX_RETRIES)),
+            base_ms=float(conf.get(RPC_RETRY_BACKOFF_MS)),
+            deadline_s=float(conf.get(RPC_RETRY_DEADLINE)))
+
+
+# small best-effort default for fire-and-forget cleanup RPCs
+#  (free_shuffle and friends): absorb one flap, never stall shutdown
+BEST_EFFORT_RETRY = RetryPolicy(attempts=2, base_ms=25.0, max_ms=200.0,
+                                deadline_s=2.0)
 
 
 class RpcUnavailableError(ConnectionError):
@@ -183,21 +233,53 @@ class RpcClient:
 
     def call(self, method: str, payload: bytes = b"",
              timeout: float | None = None,
-             compress: bool = False) -> bytes:
+             compress: bool = False,
+             retry: RetryPolicy | None = None) -> bytes:
         """One unary call. `compress=True` gzips the request on the wire
         (per-call grpc compression) — used for span-heavy telemetry
         payloads riding the heartbeat channel, where text-shaped pickle
         shrinks well and the frame budget should stay reserved for
-        shuffle blocks."""
+        shuffle blocks.
+
+        `retry` opts an IDEMPOTENT call into bounded retry of transient
+        RpcUnavailableError (exp backoff + jitter, deadline-bounded).
+        RemoteRpcError (the handler raised / payload too big / bad
+        auth) never retries, and callers that treat UNAVAILABLE as
+        executor death (the task launch path) must NOT pass a policy —
+        absorbing the loss signal there would mask dead executors."""
         fn = self._channel.unary_unary(
             f"/{SERVICE}/{method}",
             request_serializer=_ident, response_deserializer=_ident)
-        try:
-            raw = fn(payload, metadata=self._meta, timeout=timeout,
-                     compression=grpc.Compression.Gzip if compress
-                     else None)
-        except grpc.RpcError as e:
-            raise self._classify(method, e) from None
+        deadline = (time.monotonic() + retry.deadline_s
+                    if retry is not None else None)
+        attempt = 0
+        while True:
+            try:
+                if faults.ENABLED:
+                    faults.maybe_fail("rpc.call",
+                                      detail=f"{method}@{self.addr}",
+                                      exc=RpcUnavailableError)
+                try:
+                    raw = fn(payload, metadata=self._meta, timeout=timeout,
+                             compression=grpc.Compression.Gzip if compress
+                             else None)
+                except grpc.RpcError as e:
+                    raise self._classify(method, e) from None
+                if attempt:
+                    RETRY_STATS["absorbed"] += 1
+                break
+            except RpcUnavailableError:
+                attempt += 1
+                if retry is None or attempt > retry.attempts:
+                    if retry is not None:
+                        RETRY_STATS["gave_up"] += 1
+                    raise
+                wait = retry.backoff_s(attempt)
+                if deadline is not None and \
+                        time.monotonic() + wait >= deadline:
+                    RETRY_STATS["gave_up"] += 1
+                    raise
+                time.sleep(wait)
         if raw.startswith(_ERR_PREFIX):
             raise RemoteRpcError(raw[len(_ERR_PREFIX):].decode())
         return raw[len(b"\x00OK\x00"):]

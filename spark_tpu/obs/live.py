@@ -97,6 +97,10 @@ class LiveObs:
         self._queries: "OrderedDict[str, dict]" = OrderedDict()
         self.late_dropped = 0     # heartbeats discarded after task end
         self.partials_seen = 0    # mid-stage deltas accepted
+        # heartbeat-sink exceptions the cluster swallowed to protect
+        # liveness (exec/cluster._on_heartbeat counts them here so a
+        # sink bug is visible in live status instead of silently eaten)
+        self.telemetry_errors = 0
         # executor-level resource telemetry (rides every heartbeat, even
         # idle ones): eid -> {"hbm_bytes", "hbm_peak", "overflows", "at"}
         self.executors: dict[str, dict] = {}
@@ -329,6 +333,29 @@ class LiveObs:
             if q is not None:
                 q["done"] = True
 
+    def executor_excluded(self, eid: str, until: float | None,
+                          failures: int) -> None:
+        """Stamp excludeOnFailure state onto the executor's live row
+        (ClusterDAGScheduler hooks this to the HealthTracker): console
+        executor rows and the live UI render EXCLUDED until the timed
+        re-inclusion horizon passes."""
+        with self._lock:
+            ent = self.executors.setdefault(eid, {})
+            ent["excluded_until"] = until if until is not None \
+                else float("inf")
+            ent["failures"] = failures
+            ent.setdefault("at", time.time())
+
+    def add_finding(self, qid: str | None, finding: dict) -> None:
+        """Append a non-straggler finding (executor exclusion, tier
+        degradation, ...) to the query's finding list — the same list
+        EXPLAIN ANALYZE and live status already surface."""
+        if qid is None:
+            return
+        with self._lock:
+            self._version += 1
+            self._query(qid)["findings"].append(finding)
+
     def stage_abandoned(self, qid: str | None, stage: str) -> None:
         """A failed stage attempt retries under a NEW shuffle id (the
         attempt number is part of the sid); the abandoned attempt's task
@@ -507,7 +534,9 @@ class LiveObs:
             out = {eid: {"rows": 0, "rate": 0.0, "tasks": 0,
                          "hbm_bytes": e.get("hbm_bytes"),
                          "hbm_peak": e.get("hbm_peak"),
-                         "overflows": e.get("overflows", 0)}
+                         "overflows": e.get("overflows", 0),
+                         "excluded": e.get("excluded_until", 0) > now,
+                         "failures": e.get("failures", 0)}
                    for eid, e in self.executors.items()}
             for q in self._queries.values():
                 if q["done"]:
@@ -520,7 +549,8 @@ class LiveObs:
                             t["executor"],
                             {"rows": 0, "rate": 0.0, "tasks": 0,
                              "hbm_bytes": None, "hbm_peak": None,
-                             "overflows": 0})
+                             "overflows": 0, "excluded": False,
+                             "failures": 0})
                         e["tasks"] += 1
                         e["rows"] += t["rows"]
                         e["rate"] += self._units(t) / max(
@@ -543,6 +573,7 @@ class LiveObs:
         out = {"running": {}, "finished_queries": finished,
                "partials_seen": self.partials_seen,
                "late_dropped": self.late_dropped,
+               "telemetry_errors": self.telemetry_errors,
                "stragglers": self.check_stragglers(),
                "executors": self.executor_utilization(),
                "flush_overflows": self.flush_overflow_total()}
@@ -677,6 +708,8 @@ class ConsoleProgressReporter:
                     seg += f" hbm={_fmt_bytes(e['hbm_bytes'])}"
                 if e.get("overflows"):
                     seg += f" obs-trims={e['overflows']}"
+                if e.get("excluded"):
+                    seg += f" EXCLUDED({e.get('failures', 0)} fails)"
                 parts.append(f"<{seg}>")
         return "  ".join(parts)
 

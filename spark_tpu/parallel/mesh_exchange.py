@@ -42,6 +42,11 @@ from .mesh_fusion import (
 
 _MESH_CACHE: dict = {}
 _MAX_QUOTA_RETRIES = 8
+# gang-failure budget (JAMPI barrier-mode semantics: one shard fails ⇒
+# the WHOLE sharded dispatch failed): one full-gang retry with fresh
+# staging, then degrade to the host sort-shuffle — the same terminal
+# fallback the skew path takes
+_MAX_GANG_RETRIES = 1
 
 
 def _get_mesh(n: int, axis: str):
@@ -277,6 +282,7 @@ def _mesh_shuffle_plain(partitions, key_positions, num_out, schema, ctx,
         + ("bool",) * len(vmap_idx)
     base = None        # device-resident base planes (set at 1st overflow)
     base_ledger = None
+    gang_failures = 0
     try:
         for attempt in range(_MAX_QUOTA_RETRIES):
             out_cap = P * quota
@@ -316,12 +322,34 @@ def _mesh_shuffle_plain(partitions, key_positions, num_out, schema, ctx,
                         mesh, axis, quota, P, len(key_eqs), key_sig,
                         len(d_payloads) + len(d_vplanes), donate,
                         base_rows=rows_per_shard))
-            with MF.expected_donation_residue():
-                out_payloads, new_mask, counts, overflow = prog(
-                    d_keys, d_kvalids, d_payloads + d_vplanes, d_mask)
-            # the shuffle's ONE intended sync point per attempt: the
-            # overflow verdict gates the retry loop
-            flow = int(overflow)  # tpulint: ignore[host-sync]
+            try:
+                with MF.expected_donation_residue():
+                    out_payloads, new_mask, counts, overflow = prog(
+                        d_keys, d_kvalids, d_payloads + d_vplanes, d_mask)
+                # the shuffle's ONE intended sync point per attempt: the
+                # overflow verdict gates the retry loop
+                flow = int(overflow)  # tpulint: ignore[host-sync]
+            except Exception as e:
+                from ..utils.faults import is_runtime_fault
+
+                if not is_runtime_fault(e):
+                    raise
+                # GANG failure (barrier semantics): one shard dying at
+                # runtime fails the whole sharded dispatch. Retry the
+                # gang once with fresh staging (donated send buffers may
+                # already be consumed), then degrade to the host shuffle.
+                if ledger is not None:
+                    ledger.release_all()
+                if base_ledger is not None:
+                    base_ledger.release_all()
+                    base_ledger = None
+                base = None
+                gang_failures += 1
+                ctx.metrics.add("exchange.mesh_gang_failures")
+                if gang_failures > _MAX_GANG_RETRIES:
+                    break       # → host-shuffle fallback below
+                ctx.metrics.add("exchange.mesh_gang_retries")
+                continue
             if ledger is not None:
                 ledger.release_consumed()  # donated buffers died at call
             if flow == 0:
@@ -358,11 +386,14 @@ def _mesh_shuffle_plain(partitions, key_positions, num_out, schema, ctx,
     finally:
         if base_ledger is not None:
             base_ledger.release_all()
-    # pathological skew past every retry: the host sort-shuffle has no
-    # quota to overflow — degrade instead of failing the query
+    # pathological skew past every retry — or a mesh gang that kept
+    # dying at runtime: the host sort-shuffle has no quota to overflow
+    # and no gang to fail — degrade instead of failing the query
     from ..exec import shuffle as S
 
     ctx.metrics.add("exchange.mesh_fallback")
+    if gang_failures > _MAX_GANG_RETRIES:
+        ctx.metrics.add("exchange.mesh_runtime_fallback")
     return S.shuffle_hash(partitions, list(key_positions), num_out,
                           schema, ctx, stats, col_stats=col_stats,
                           stat_cols=stat_cols)
@@ -427,6 +458,7 @@ def _mesh_shuffle_fused(partitions, fusion, num_out, schema, ctx, stats,
     donate = MF.DONATE_DEFAULT  # module switch: tests A/B the HBM win
     base = None        # device-resident base planes (set at 1st overflow)
     base_ledger = None
+    gang_failures = 0
     try:
         for attempt in range(_MAX_QUOTA_RETRIES):
             out_cap = P * quota
@@ -466,11 +498,31 @@ def _mesh_shuffle_fused(partitions, fusion, num_out, schema, ctx, stats,
                         mesh, axis, shard_cap, quota, P, seed,
                         input_attrs, filters, outputs, key_idx, key_bool,
                         out_valid_sig, donate, base_rows=rows_per_shard))
-            with MF.expected_donation_residue():
-                g_datas, g_valids, new_mask, counts, overflow = prog(
-                    d_datas, d_valids, d_mask, d_aux)
-            # the shuffle's ONE intended sync point per attempt
-            flow = int(overflow)  # tpulint: ignore[host-sync]
+            try:
+                with MF.expected_donation_residue():
+                    g_datas, g_valids, new_mask, counts, overflow = prog(
+                        d_datas, d_valids, d_mask, d_aux)
+                # the shuffle's ONE intended sync point per attempt
+                flow = int(overflow)  # tpulint: ignore[host-sync]
+            except Exception as e:
+                from ..utils.faults import is_runtime_fault
+
+                if not is_runtime_fault(e):
+                    raise
+                # gang failure: retry the whole sharded dispatch once
+                # with fresh staging, then degrade to the host shuffle
+                if ledger is not None:
+                    ledger.release_all()
+                if base_ledger is not None:
+                    base_ledger.release_all()
+                    base_ledger = None
+                base = None
+                gang_failures += 1
+                ctx.metrics.add("exchange.mesh_gang_failures")
+                if gang_failures > _MAX_GANG_RETRIES:
+                    break       # → host-shuffle fallback below
+                ctx.metrics.add("exchange.mesh_gang_retries")
+                continue
             if ledger is not None:
                 ledger.release_consumed()  # donated buffers died at call
             if flow == 0:
@@ -503,5 +555,7 @@ def _mesh_shuffle_fused(partitions, fusion, num_out, schema, ctx, stats,
     from ..exec import shuffle as S
 
     ctx.metrics.add("exchange.mesh_fallback")
+    if gang_failures > _MAX_GANG_RETRIES:
+        ctx.metrics.add("exchange.mesh_runtime_fallback")
     return S.shuffle_fused(partitions, fusion, num_out, schema, ctx,
                            stats, col_stats, stat_cols)

@@ -29,6 +29,7 @@ from ..expr.expressions import (
     Alias, AttributeReference, Expression, Literal, SortOrder,
 )
 from ..types import ArrayType, DataType, StringType, StructField, StructType
+from ..utils import faults as _faults
 
 __all__ = ["canonical_key", "KernelCache", "ExprPipeline", "bind_inputs",
             "broadcast_to_cap", "trace_pipeline", "pipeline_host_pass",
@@ -163,6 +164,13 @@ class KernelCache:
         state = {"first": True, "cost": None, "capturing": False}
 
         def launch(*args, **kwargs):
+            if _faults.ENABLED:
+                # chaos seam: an injected dispatch fault stands in for
+                # an XLA runtime error the pre-flight could not predict
+                # (RESOURCE_EXHAUSTED at launch). Raised BEFORE counting
+                # — a launch that never dispatched must not count.
+                # Idle cost: one module-bool read per launch.
+                _faults.maybe_fail("kernel.dispatch", detail=str(kind))
             with self._lock:
                 self.launches += 1
                 self.launches_by_kind[kind] += 1
@@ -230,6 +238,14 @@ class KernelCache:
                 self._cache.move_to_end(key)
                 return f
             self.misses += 1
+        if _faults.ENABLED:
+            # chaos seam: a compile-time failure (trace/lower bug, XLA
+            # compiler fault) — fired on the MISS path only, cached
+            # kernels never re-compile
+            _faults.maybe_fail(
+                "kernel.compile",
+                detail=str(key[0]) if isinstance(key, tuple) and key
+                else "?")
         import time as _time
 
         t0 = _time.perf_counter()

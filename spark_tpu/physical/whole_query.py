@@ -74,9 +74,14 @@ from .compile import (
 from .operators import PhysicalPlan, attrs_schema
 
 __all__ = ["WholeQueryExec", "TierDecision", "choose_tier",
-           "apply_compile_tier", "supported_whole_query"]
+           "apply_compile_tier", "supported_whole_query",
+           "is_runtime_fault"]
 
 _MAX_PROGRAM_RETRIES = 8
+
+# re-export: tier degradation shares the runtime-fault classifier with
+# the mesh gang-failure path (utils/faults.py owns it — no deps)
+from ..utils.faults import is_runtime_fault  # noqa: E402
 
 
 def _jnp():
@@ -1001,6 +1006,46 @@ class WholeQueryExec(PhysicalPlan):
         return head + "\n" + self.plan.tree_string(depth + 1)
 
     def execute(self, ctx) -> list:
+        try:
+            return self._execute_whole(ctx)
+        except Exception as e:
+            if not is_runtime_fault(e):
+                raise
+            # the program died AT RUNTIME (XLA fault / RESOURCE_EXHAUSTED
+            # the MemoryBudgetExceeded pre-flight could not predict, or
+            # an injected chaos fault): degrade to the STAGE tier and
+            # re-execute the inner plan stage-at-a-time — smaller
+            # programs, host round-trips, value-dependent fast paths.
+            # The reason lands on the tier decision so explain() and the
+            # degrade span show WHY this query did not run whole.
+            return self._degrade_to_stage(ctx, e)
+
+    def _degrade_to_stage(self, ctx, cause: Exception) -> list:
+        from contextlib import nullcontext
+
+        from ..exec.scheduler import DAGScheduler
+
+        reason = f"{type(cause).__name__}: {str(cause)[:200]}"
+        self.decision.details["runtime_degraded"] = reason
+        ctx.metrics.add("whole_query.runtime_degraded")
+        live = getattr(ctx, "live_obs", None)
+        if live is not None:
+            live.add_finding(getattr(ctx, "query_id", None), {
+                "severity": "warning", "kind": "tier.degraded",
+                "msg": "whole-query program failed at runtime — "
+                       f"degraded to the stage tier and re-executed "
+                       f"({reason})"})
+        tracer = getattr(ctx, "tracer", None)
+        sp = tracer.span("whole_query.degrade", cat="operator",
+                         args={"tier": "stage", "reason": reason}) \
+            if tracer is not None else nullcontext()
+        with sp:
+            # _run (not run): the ENCLOSING scheduler already owns this
+            # query's KernelCache delta accounting — wrapping again would
+            # double-count the stage tier's launches in kernel.* metrics
+            return DAGScheduler(ctx)._run(self.plan)
+
+    def _execute_whole(self, ctx) -> list:
         import jax
 
         tracer = getattr(ctx, "tracer", None)
