@@ -109,6 +109,18 @@ WHOLE_QUERY = "--whole-query" in sys.argv
 if WHOLE_QUERY:
     sys.argv = [a for a in sys.argv if a != "--whole-query"]
 
+# --profile: record a QueryProfile for every query the suite executes
+# (obs/history.py flight recorder) into SPARK_TPU_PROFILE_DIR (default
+# ./bench_profiles): fingerprint-keyed JSONL with per-kind launch/compile
+# deltas, tier decisions, retry counters, and HBM watermarks.
+# dev/perfcheck.py runs `bench.py --smoke --profile` and diffs the
+# profiles' deterministic counters against dev/perf_baseline.json — the
+# flight recorder's counters ARE the CI perf gate.
+PROFILE = "--profile" in sys.argv
+if PROFILE:
+    sys.argv = [a for a in sys.argv if a != "--profile"]
+PROFILE_DIR = os.environ.get("SPARK_TPU_PROFILE_DIR", "bench_profiles")
+
 
 # per-config predicted peak HBM (plan_lint memory model) captured by
 # _maybe_analyze so the timed record can print predicted vs measured
@@ -205,6 +217,11 @@ def _session(extra=None):
         conf["spark.tpu.progress.console"] = "true"
         conf["spark.tpu.progress.updateInterval"] = "0.2"
         conf["spark.tpu.heartbeat.interval"] = "0.25"
+    if PROFILE:
+        # flight recorder on: every executed query appends a
+        # fingerprint-keyed profile (close-time host work only — the
+        # measured dispatch counts stay honest)
+        conf["spark.tpu.obs.profileDir"] = PROFILE_DIR
     conf.update(extra or {})
     if SMOKE:
         conf["spark.tpu.batch.capacity"] = min(

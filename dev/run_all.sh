@@ -45,6 +45,12 @@ python bench.py --smoke --whole-query whole_query
 echo "== chaos gate (fault injection: retry/exclusion/degrade, fixed seed) =="
 JAX_PLATFORMS=cpu python dev/validate_trace.py --chaos
 
+echo "== profile gate (flight recorder: fingerprints, store, regression) =="
+JAX_PLATFORMS=cpu python dev/validate_trace.py --profile
+
+echo "== perfcheck (deterministic counters of bench --smoke vs baseline) =="
+python dev/perfcheck.py
+
 echo "== micro-benchmarks =="
 python benchmarks/run_benchmarks.py --rows "${BENCH_ROWS:-2000000}"
 

@@ -74,8 +74,19 @@ Four checks, all hard failures:
    device ledger must verify balanced afterwards. Self-contained:
    `validate_trace.py --chaos` with no trace path runs only this gate.
 
+8. Profile gate (--profile) — query flight recorder end to end: two
+   identical smoke queries must yield ONE plan fingerprint, two stored
+   profiles, and zero obs.regression findings; a forced
+   spark.tpu.compile.tier=operator flip must land on the SAME
+   structural query key, a DIFFERENT fingerprint, and raise a
+   deterministic-counter regression finding (severity error); and
+   dev/perfcheck.py's comparator must flag the same delta against a
+   baseline built from the healthy runs. Self-contained:
+   `validate_trace.py --profile` with no trace path runs only this
+   gate.
+
 Usage: python dev/validate_trace.py [--cluster] [--live] [--mesh]
-       [--encoded] [--whole-query] [--chaos] [<trace.json>]
+       [--encoded] [--whole-query] [--chaos] [--profile] [<trace.json>]
 """
 
 import json
@@ -890,6 +901,114 @@ def chaos_gate() -> None:
         session.stop()
 
 
+def profile_gate() -> None:
+    """Query flight recorder gate (--profile, self-contained): the
+    fingerprint/store/regression loop must hold end to end. Two
+    identical runs ⇒ one fingerprint, two stored profiles, zero
+    obs.regression findings (warm runs never regress against their own
+    cold baseline); a forced tier flip ⇒ same structural query key,
+    different fingerprint, and a severity-error deterministic-counter
+    regression finding in both the close hook and the live store; and
+    dev/perfcheck.py's comparator flags the same delta against a
+    baseline built from the healthy profiles."""
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu import TpuSession
+    from spark_tpu.obs.history import ProfileStore
+
+    tmp = tempfile.mkdtemp(prefix="profile_gate_")
+    session = TpuSession("profile-gate", {
+        "spark.tpu.batch.capacity": 1 << 12,
+        "spark.sql.shuffle.partitions": 2,
+        "spark.tpu.fusion.minRows": "0",
+        "spark.tpu.obs.profileDir": tmp,
+    })
+    try:
+        rng = np.random.default_rng(13)
+        session.createDataFrame(pa.table({
+            "k": rng.integers(0, 9, 4000),
+            "v": rng.integers(-20, 80, 4000),
+        })).createOrReplaceTempView("pg_t")
+
+        def q():
+            return session.sql("select k, sum(v) s from pg_t "
+                               "where v > 0 group by k")
+
+        first = q()
+        first.toArrow()
+        second = q()
+        second.toArrow()
+        qe = second.query_execution
+        if qe._last_profile is None:
+            fail("--profile: flight recorder never recorded a profile")
+        store = ProfileStore(tmp)
+        qk = qe._last_profile["query_key"]
+        profs = store.profiles(qk)
+        if len(profs) != 2:
+            fail(f"--profile: expected 2 stored profiles for the query "
+                 f"key, found {len(profs)}")
+        fps = {p["fingerprint"] for p in profs}
+        if len(fps) != 1:
+            fail(f"--profile: identical runs produced {len(fps)} distinct "
+                 f"fingerprints ({fps}) — canonicalization is unstable")
+        if qe._last_regressions:
+            fail("--profile: identical re-run raised regression findings: "
+                 + "; ".join(f["msg"] for f in qe._last_regressions))
+        # perfcheck comparator: healthy baseline vs itself must be clean
+        import importlib.util as _ilu
+
+        spec = _ilu.spec_from_file_location(
+            "perfcheck", os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "perfcheck.py"))
+        perfcheck = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(perfcheck)
+        healthy = perfcheck.collect_profiles(tmp)
+        regs, _notes = perfcheck.compare(healthy, {"queries": healthy})
+        if regs:
+            fail("--profile: perfcheck flagged a healthy run against its "
+                 "own baseline: " + "; ".join(regs))
+        # forced tier flip: same query key, new fingerprint, counter
+        # drift detected as a severity-error finding
+        session.conf.set("spark.tpu.compile.tier", "operator")
+        flipped = q()
+        flipped.toArrow()
+        session.conf.unset("spark.tpu.compile.tier")
+        fqe = flipped.query_execution
+        fprof = fqe._last_profile
+        if fprof["query_key"] != qk:
+            fail("--profile: tier flip changed the structural query key — "
+                 "regression detection lost its baseline")
+        if fprof["fingerprint"] in fps:
+            fail("--profile: tier flip did NOT change the full plan "
+                 "fingerprint (compile-cache key is tier-blind)")
+        errors = [f for f in fqe._last_regressions
+                  if f["severity"] == "error"]
+        if not errors:
+            fail("--profile: forced tier flip raised no deterministic-"
+                 f"counter regression (findings: {fqe._last_regressions})")
+        live = session.live_obs.findings_for(
+            fqe._last_ctx.query_id)
+        if not any(f.get("kind") == "obs.regression" for f in live):
+            fail("--profile: regression finding never reached the live "
+                 "store (EXPLAIN ANALYZE/live status would miss it)")
+        # the same delta must trip perfcheck's cross-commit comparator
+        flipped_counters = perfcheck.collect_profiles(tmp)
+        regs, _notes = perfcheck.compare(flipped_counters,
+                                         {"queries": healthy})
+        if not regs:
+            fail("--profile: perfcheck comparator missed the tier-flip "
+                 "counter delta")
+        print("validate_trace: profile gate OK — 1 fingerprint / 2 "
+              "profiles / 0 regressions on identical runs; tier flip "
+              f"kept query key, changed fingerprint, raised {len(errors)} "
+              f"error finding(s) and {len(regs)} perfcheck regression(s)")
+    finally:
+        session.stop()
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     cluster = "--cluster" in argv
@@ -898,10 +1017,11 @@ def main(argv=None) -> int:
     encoded = "--encoded" in argv
     whole = "--whole-query" in argv
     chaos = "--chaos" in argv
+    profile = "--profile" in argv
     argv = [a for a in argv if a not in ("--cluster", "--live", "--mesh",
                                          "--encoded", "--whole-query",
-                                         "--chaos")]
-    if (mesh or encoded or whole or chaos) and not argv:
+                                         "--chaos", "--profile")]
+    if (mesh or encoded or whole or chaos or profile) and not argv:
         # self-contained legs: these gates generate and validate their
         # own state (dev/run_all.sh runs them without a trace file)
         if mesh:
@@ -912,6 +1032,8 @@ def main(argv=None) -> int:
             whole_query_gate()
         if chaos:
             chaos_gate()
+        if profile:
+            profile_gate()
         print("validate_trace: PASS")
         return 0
     if len(argv) != 1:
@@ -930,6 +1052,8 @@ def main(argv=None) -> int:
         whole_query_gate()
     if chaos:
         chaos_gate()
+    if profile:
+        profile_gate()
     print("validate_trace: PASS")
     return 0
 

@@ -426,6 +426,59 @@ MEMORY_PEAK_GBPS = _register(ConfigEntry(
     "Peak HBM bandwidth (GB/s) for achieved-vs-peak rendering; 0 = auto "
     "from the device kind (CPU backends report no roofline).", float))
 
+KERNEL_MEMORY = _register(ConfigEntry(
+    "spark.tpu.metrics.kernelMemory", False,
+    "Capture each compiled kernel's XLA memory_analysis() temp (scratch) "
+    "bytes at first invocation and fold them into EXPLAIN ANALYZE's HBM "
+    "reconciliation and the query profile (the device ledger tracks "
+    "engine-held tiles only — fused-kernel scratch is invisible to it). "
+    "Off by default: the AOT lowering compile this requires is NOT "
+    "shared with the dispatch path on this jax version, so capture "
+    "costs one extra backend compile per distinct kernel.", _bool))
+
+# --- query flight recorder (spark_tpu/obs/history.py) ----------------------
+
+OBS_PROFILE_DIR = _register(ConfigEntry(
+    "spark.tpu.obs.profileDir", "",
+    "Directory for the persistent query flight recorder: at query close "
+    "the driver appends a QueryProfile (plan fingerprint, per-operator "
+    "metrics, launches/compile-ms by kind, tier decision, retry/fault "
+    "counters, HBM watermarks, per-stage runtime stats) as one JSONL "
+    "line keyed by the query's structural fingerprint, then compares "
+    "the fresh profile against the fingerprint's stored baseline and "
+    "raises obs.regression findings on deterministic-counter drift. "
+    "Empty (default) = recorder off. Driver-owned: worker processes "
+    "never write profiles regardless of this setting. Pure host "
+    "bookkeeping — zero kernel launches, no mid-query device syncs "
+    "(assembly runs after the query's last device interaction).", str))
+
+OBS_PROFILE_RING = _register(ConfigEntry(
+    "spark.tpu.obs.profileRing", 32,
+    "Profiles retained per query fingerprint in the on-disk store (the "
+    "JSONL file compacts to the newest N once it doubles the bound).",
+    int))
+
+OBS_PROFILE_BASELINE_N = _register(ConfigEntry(
+    "spark.tpu.obs.profileBaselineN", 5,
+    "Regression baseline window: the fresh profile compares against the "
+    "MEDIAN of the last N stored profiles for the same structural query "
+    "key.", int))
+
+OBS_PROFILE_REGRESSION = _register(ConfigEntry(
+    "spark.tpu.obs.profileRegression", True,
+    "Raise obs.regression findings at query close when the fresh "
+    "profile's deterministic counters (kernel launches by kind, compile "
+    "count, retry/fault attempts) EXCEED the stored baseline (severity "
+    "error), or wall/HBM drift past the advisory tolerance (severity "
+    "info). Requires spark.tpu.obs.profileDir.", _bool))
+
+OBS_PROFILE_WALL_TOLERANCE = _register(ConfigEntry(
+    "spark.tpu.obs.profileWallTolerance", 1.5,
+    "Advisory wall-clock drift factor: a fresh profile slower than "
+    "tolerance x the baseline median wall-ms raises an info-severity "
+    "obs.regression finding (wall time is noisy — never an error).",
+    float))
+
 # --- chaos hardening (PR 11): fault injection, retry/backoff, exclusion ---
 
 FAULTS_ENABLED = _register(ConfigEntry(

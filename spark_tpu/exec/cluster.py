@@ -77,11 +77,18 @@ class _Worker:
         """Execute with the slot already held by the caller."""
         raw = self.client.call("launch_task", payload)
         try:
-            status, result = pickle.loads(raw)
+            decoded = pickle.loads(raw)
+            status, result = decoded[0], decoded[1]
         except Exception as e:
             raise RemoteTaskError(f"undecodable task reply: {e}")
         if status == "err":
-            raise RemoteTaskError(result)
+            err = RemoteTaskError(result)
+            # a failed stage task ships its packaged obs alongside the
+            # traceback (chaos salvage) — ride it on the exception so
+            # the retry loop can hand the wasted-work record upward
+            if len(decoded) > 2 and decoded[2] is not None:
+                err.salvaged_obs = decoded[2]
+            raise err
         return result
 
     def run(self, payload: bytes) -> Any:
@@ -390,17 +397,23 @@ class LocalCluster:
         return self.run_task_traced(fn, *args, pool=pool)[0]
 
     def run_task_traced(self, fn: Callable, *args,
-                        pool: str = "default", task_key=None) -> tuple:
+                        pool: str = "default", task_key=None,
+                        on_failed_attempt: Callable | None = None) -> tuple:
         """Run a task; returns (result, worker) so callers can register
         which executor holds the outputs (MapOutputTracker role).
         `task_key` identifies the task to the live straggler signal
         (cluster_sql passes (shuffle id, map id)) so speculation scopes
-        its decision to THIS task."""
+        its decision to THIS task. `on_failed_attempt(executor_id, err,
+        salvaged_obs)` is invoked (best-effort) for every attempt the
+        retry loop absorbs — transient task failures and executor
+        losses — so the caller can record the wasted work the failed
+        attempt's salvaged obs describes."""
         payload = cloudpickle.dumps((fn, args))
         with self._lock:
             self._active_tasks += 1
         try:
-            return self._run_with_retries(payload, pool, task_key)
+            return self._run_with_retries(payload, pool, task_key,
+                                          on_failed_attempt)
         finally:
             with self._lock:
                 self._active_tasks -= 1
@@ -448,8 +461,20 @@ class LocalCluster:
         except Exception:
             pass
 
+    @staticmethod
+    def _notify_failed_attempt(cb, eid: str, e: Exception) -> None:
+        """Best-effort wasted-work notification — the retry path must
+        never fail because the obs side-channel did."""
+        if cb is None:
+            return
+        try:
+            cb(eid, e, getattr(e, "salvaged_obs", None))
+        except Exception:
+            pass
+
     def _run_with_retries(self, payload: bytes,
-                          pool: str = "default", task_key=None) -> tuple:
+                          pool: str = "default", task_key=None,
+                          on_failed_attempt: Callable | None = None) -> tuple:
         last: Exception | None = None
         avoid: set = set()   # executors that already failed THIS task
         with self._lock:
@@ -497,6 +522,8 @@ class LocalCluster:
                         failed_eid = getattr(e, "failing_executor",
                                              w.executor_id)
                         self._record_failure(failed_eid, lost=False)
+                        self._notify_failed_attempt(on_failed_attempt,
+                                                    failed_eid, e)
                         avoid.add(failed_eid)
                         with self._lock:  # retry waits for a slot again
                             self._pool_waiting[pool] += 1
@@ -509,6 +536,8 @@ class LocalCluster:
                 except (RpcUnavailableError, OSError) as e:
                     last = e
                     self._record_failure(w.executor_id, lost=True)
+                    self._notify_failed_attempt(on_failed_attempt,
+                                                w.executor_id, e)
                     self.registry.remove(w.executor_id)  # executor lost
                     avoid.add(w.executor_id)
                     w.close()

@@ -397,10 +397,20 @@ def _run_stage_store(plan_bytes: bytes, conf_overrides: dict,
         finally:
             if task_span is not None:
                 task_span.__exit__(None, None, None)
-    except BaseException:
+    except BaseException as e:
         # the task failed: stop streaming its partials NOW (the retry
-        # will register a fresh recorder under the same identity)
-        WM.finish_stage_obs(obs)
+        # will register a fresh recorder under the same identity). The
+        # packaged obs is NOT discarded with the error: stamped onto the
+        # exception, it rides the launch_task error payload back to the
+        # driver (chaos salvage — a failed attempt's wasted work shows
+        # in EXPLAIN ANALYZE findings and the query profile instead of
+        # vanishing with the traceback)
+        salvage = WM.finish_stage_obs(obs)
+        if salvage is not None:
+            try:
+                e._salvaged_obs = salvage
+            except Exception:
+                pass  # exceptions with __slots__ just lose the ride
         raise
     finally:
         if qtoken is not None:
@@ -683,7 +693,10 @@ class ClusterDAGScheduler(DAGScheduler):
             result, worker = self.cluster.run_task_traced(
                 _run_stage_store, cloudpickle.dumps(plan),
                 self.conf_overrides, sid, map_id, num_maps,
-                qid, flow_parent, task_key=(sid, map_id))
+                qid, flow_parent, task_key=(sid, map_id),
+                on_failed_attempt=lambda eid, err, salvage, _m=map_id:
+                    self._record_failed_attempt(qid, sid, _m, eid, err,
+                                                salvage))
             (tag, addr, rows, sizes, counters, obs, col_stats,
              dict_ids) = result
             assert tag == "mapstatus", tag
@@ -734,6 +747,53 @@ class ClusterDAGScheduler(DAGScheduler):
         self.ctx.metrics.add("scheduler.map_tasks", num_maps)
         self.ctx.metrics.add("shuffle.bytes_written", status.total_bytes)
         return status
+
+    def _record_failed_attempt(self, qid: str | None, sid: str,
+                               map_id: int, executor_id: str,
+                               err: Exception,
+                               salvage: dict | None) -> None:
+        """Chaos salvage (PR 11 follow-on (a)): a failed task attempt's
+        worker-side obs rode the error payload instead of dying with
+        it. Record the WASTED work — kernel deltas, span count, compile
+        ms — on the ExecContext (the query profile's `wasted` section),
+        ingest the attempt's spans into the tracer so the timeline
+        shows the abandoned attempt, and raise a warning finding so
+        chaos-path EXPLAIN ANALYZE names the waste. Deliberately NOT
+        merged into plan_metrics or worker_kernel_kinds: launch
+        reconciliation must keep counting only work that produced the
+        result."""
+        # tail of the error text: a cross-process traceback buries the
+        # actual failure (the injected-fault marker, the XLA error) at
+        # the END of the string
+        entry = {"stage": sid, "task": map_id, "executor": executor_id,
+                 "error": str(err)[-200:]}
+        launches = 0
+        if salvage:
+            kinds = salvage.get("kernel_kinds") or {}
+            launches = salvage.get("kernel_launches", 0)
+            entry.update({
+                "kernel_kinds": dict(kinds),
+                "launches": launches,
+                "compile_ms": salvage.get("kernel_compile_ms", 0.0),
+                "spans": len(salvage.get("spans") or ())})
+            tracer = getattr(self.ctx, "tracer", None)
+            if tracer is not None and salvage.get("spans"):
+                tracer.ingest(salvage["spans"],
+                              anchor=salvage.get("anchor"),
+                              track=f"worker:{executor_id}", query_id=qid)
+        with self._obs_lock:
+            if self.ctx.failed_attempt_obs is None:
+                self.ctx.failed_attempt_obs = []
+            self.ctx.failed_attempt_obs.append(entry)
+        self.ctx.metrics.add("scheduler.task_failures_salvaged")
+        if self.live is not None:
+            self.live.add_finding(qid, {
+                "severity": "warning", "kind": "obs.wasted-work",
+                "executor": executor_id,
+                "msg": f"task {sid}#m{map_id} attempt on {executor_id} "
+                       f"failed after {launches} kernel launch(es) — "
+                       "its obs rode the error payload (salvaged wasted "
+                       "work; retried elsewhere)"})
 
     def _merge_task_obs(self, obs: dict | None, executor_id: str,
                         qid: str | None) -> None:

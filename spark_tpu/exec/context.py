@@ -78,6 +78,13 @@ class ExecContext:
     # QueryExecution.execute from the tracing contextvar) — keys the
     # live store and EXPLAIN ANALYZE's straggler-finding lookup
     query_id: str | None = field(default=None, repr=False)
+    # chaos salvage (cluster mode): wasted-work records of failed task
+    # attempts whose worker-side obs rode the error payload back
+    # (ClusterDAGScheduler._record_failed_attempt) — kept SEPARATE from
+    # plan_metrics/worker_kernel_kinds so launch reconciliation still
+    # counts only work that contributed to the result; the query
+    # profile and EXPLAIN ANALYZE findings surface it as waste
+    failed_attempt_obs: list | None = field(default=None, repr=False)
 
     @property
     def memory(self):

@@ -48,6 +48,7 @@ def _esc(v) -> str:
 
 class _Handler(BaseHTTPRequestHandler):
     reader: HistoryReader = None  # injected by HistoryServer
+    profiles = None               # obs.history.ProfileStore | None
 
     def log_message(self, *a):  # silence per-request stderr noise
         pass
@@ -69,10 +70,18 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(self._app(q["id"][0]))
             elif url.path == "/query":
                 self._send(self._query(q["id"][0], int(q["n"][0])))
+            elif url.path == "/profiles" and self.profiles is not None:
+                self._send(self._profiles())
+            elif url.path == "/profile" and self.profiles is not None:
+                self._send(self._profile(q["fp"][0]))
             elif url.path == "/api/applications":
                 apps = [{"id": a, **self.reader.summary(a)}
                         for a in self.reader.applications()]
                 self._send(json.dumps(apps).encode(), "application/json")
+            elif url.path == "/api/profiles" and self.profiles is not None:
+                self._send(json.dumps(
+                    self.profiles.fingerprints()).encode(),
+                    "application/json")
             else:
                 self.send_error(404)
         except (KeyError, FileNotFoundError, IndexError, ValueError):
@@ -89,7 +98,70 @@ class _Handler(BaseHTTPRequestHandler):
         body = ("<table><tr><th>Application</th><th>Queries</th>"
                 "<th>Failed</th><th>Total ms</th></tr>"
                 + "".join(rows) + "</table>")
+        if self.profiles is not None:
+            body += ("<p><a href='/profiles'>Query flight recorder: "
+                     "fingerprint-keyed run profiles &rarr;</a></p>")
         return _page("Spark-TPU History Server", body)
+
+    def _profiles(self) -> bytes:
+        """Flight-recorder fingerprint list (obs/history.ProfileStore):
+        one row per plan fingerprint with its stored-run count — the
+        durable 'same query across restarts' view the in-memory SQL tab
+        cannot give."""
+        import time as _time
+
+        rows = []
+        fps = self.profiles.fingerprints()
+        for fp, ent in sorted(fps.items(),
+                              key=lambda kv: -kv[1]["last_ts"]):
+            age = _time.time() - ent["last_ts"] if ent["last_ts"] else 0
+            rows.append(
+                f"<tr><td><a href='/profile?fp={fp}'>{_esc(fp)}</a></td>"
+                f"<td>{_esc(ent['detail'])[:100]}</td>"
+                f"<td>{ent['profiles']}</td>"
+                f"<td>{age:.0f}s ago</td></tr>")
+        body = ("<p><a href='/'>&larr; applications</a></p>"
+                "<table><tr><th>Plan fingerprint</th><th>Query</th>"
+                "<th>Stored runs</th><th>Last run</th></tr>"
+                + "".join(rows) + "</table>")
+        return _page("Query flight recorder", body)
+
+    def _profile(self, fp: str) -> bytes:
+        """One fingerprint's stored runs: wall/launches/compiles/retries
+        per profile plus the recorded tier decision and findings — the
+        regression gate's evidence trail, rendered."""
+        profs = self.profiles.profiles_for_fingerprint(fp)
+        if not profs:
+            raise KeyError(fp)
+        parts = [f"<p><a href='/profiles'>&larr; fingerprints</a></p>"
+                 f"<p>Query: <b>{_esc(profs[-1].get('detail'))}</b><br>"
+                 f"query key: {_esc(profs[-1].get('query_key'))}</p>",
+                 "<table><tr><th>ts</th><th>wall ms</th>"
+                 "<th>launches (by kind)</th><th>compiles</th>"
+                 "<th>tier</th><th>retry/fault counters</th>"
+                 "<th>HBM peak</th><th>findings</th></tr>"]
+        for p in profs:
+            kinds = ", ".join(f"{k}:{v}" for k, v in
+                              (p.get("launches_by_kind") or {}).items())
+            tier = (p.get("tier") or {}).get("tier", "")
+            if (p.get("tier") or {}).get("degraded"):
+                tier += " (degraded)"
+            ctrs = ", ".join(f"{k.split('.')[-1]}:{v}" for k, v in
+                             (p.get("counters") or {}).items())
+            finds = "; ".join(f"[{f.get('severity')}] {f.get('kind')}"
+                              for f in (p.get("findings") or []))
+            if p.get("wasted"):
+                finds = (finds + "; " if finds else "") + \
+                    f"{len(p['wasted'])} wasted attempt(s)"
+            parts.append(
+                f"<tr><td>{p.get('ts')}</td>"
+                f"<td>{p.get('wall_ms')}</td><td>{_esc(kinds)}</td>"
+                f"<td>{p.get('compiles')}</td><td>{_esc(tier)}</td>"
+                f"<td>{_esc(ctrs)}</td>"
+                f"<td>{(p.get('hbm') or {}).get('peak') or ''}</td>"
+                f"<td>{_esc(finds)}</td></tr>")
+        parts.append("</table>")
+        return _page(f"Profiles — {fp}", "".join(parts))
 
     def _app(self, app: str) -> bytes:
         events = self.reader.load(app)
@@ -211,9 +283,16 @@ class _Handler(BaseHTTPRequestHandler):
 
 class HistoryServer:
     def __init__(self, log_dir: str, port: int = 18080,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1",
+                 profile_dir: str | None = None):
         self.reader = HistoryReader(log_dir)
-        handler = type("Handler", (_Handler,), {"reader": self.reader})
+        profiles = None
+        if profile_dir:
+            from ..obs.history import ProfileStore
+
+            profiles = ProfileStore(profile_dir)
+        handler = type("Handler", (_Handler,),
+                       {"reader": self.reader, "profiles": profiles})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
@@ -236,8 +315,12 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(description="Spark-TPU history server")
     p.add_argument("log_dir")
     p.add_argument("--port", type=int, default=18080)
+    p.add_argument("--profile-dir", default=None,
+                   help="query flight recorder store "
+                        "(spark.tpu.obs.profileDir) to serve at /profiles")
     args = p.parse_args(argv)
-    hs = HistoryServer(args.log_dir, port=args.port)
+    hs = HistoryServer(args.log_dir, port=args.port,
+                       profile_dir=args.profile_dir)
     print(f"history server on http://127.0.0.1:{hs.port}/")
     hs._httpd.serve_forever()
 

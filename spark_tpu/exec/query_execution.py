@@ -51,6 +51,13 @@ class QueryPlanningTracker:
 
 
 class QueryExecution:
+    # flight-recorder close results (obs/history.py): populated by
+    # execute() when spark.tpu.obs.profileDir is set; class defaults so
+    # probes on a recorder-off (or failed-close) query read None/empty
+    # instead of AttributeError
+    _last_profile: dict | None = None
+    _last_regressions: tuple = ()
+
     def __init__(self, session, logical: LogicalPlan):
         self.session = session
         self.logical = logical
@@ -187,8 +194,14 @@ class QueryExecution:
             # stable metric keys BEFORE execution: the stage builder
             # copies exchanges and their ancestors (with_new_children),
             # and copies share __dict__, so a pre-assigned id survives
-            # into the executed objects where id() would not
-            for i, n in enumerate(self.physical.iter_nodes()):
+            # into the executed objects where id() would not. The walk
+            # descends through a whole-query wrapper into its inner
+            # plan: a runtime tier degrade re-executes the inner
+            # operators directly, and their records must land under
+            # keys the plan graph can render (PR 11 follow-on (d))
+            from ..obs.metrics import iter_metric_nodes
+
+            for i, n in enumerate(iter_metric_nodes(self.physical)):
                 n._metric_id = i
             # AQE annotations are per-QUERY: baseline the session-level
             # adaptive counters so plan_graph reports the delta
@@ -196,6 +209,29 @@ class QueryExecution:
                 k: v for k, v in ctx.metrics.snapshot()["counters"].items()
                 if k.startswith("adaptive.")}
         self._last_ctx = ctx
+        # query flight recorder (obs/history.py): with a profile dir
+        # configured, snapshot the process counters the close-time
+        # profile deltas against. One conf read when off; the snapshot
+        # itself is a few dict copies — pure host bookkeeping
+        from ..config import OBS_PROFILE_DIR
+
+        recorder = None
+        if str(self.session.conf.get(  # tpulint: ignore[host-sync]
+                OBS_PROFILE_DIR) or ""):
+            from ..obs.history import recorder_open
+            from ..physical.compile import GLOBAL_KERNEL_CACHE as _KC
+
+            recorder = {
+                "kinds": dict(_KC.launches_by_kind),
+                "misses": _KC.misses,
+                "compile_ms": _KC.compile_ms,
+                "counters": dict(
+                    self.session._metrics.snapshot()["counters"]),
+                "t0": time.perf_counter(),
+                # overlap guard: concurrent queries contaminate each
+                # other's process-counter deltas — such profiles are
+                # marked and kept out of regression baselines
+                "guard": recorder_open()}
         bus = getattr(self.session, "listener_bus", None)
         cluster = getattr(self.session, "_sql_cluster", None)
         if cluster is not None:
@@ -228,6 +264,13 @@ class QueryExecution:
             out = self._timed("execution", lambda: sched.run(plan))
         except Exception:
             discard_pending(ctx.plan_metrics)
+            if recorder is not None:
+                # failed query: no profile, but the overlap-guard
+                # window must still close or every later query would
+                # read as overlapped
+                from ..obs.history import recorder_abort
+
+                recorder_abort(recorder["guard"])
             raise
         finally:
             if stop_flusher is not None:
@@ -240,7 +283,33 @@ class QueryExecution:
         # (one memoized host read per distinct mask identity — the only
         # device read the metrics layer performs, after the last dispatch)
         finalize_plan_metrics(ctx.plan_metrics)
+        if recorder is not None:
+            # flight recorder close: assemble the QueryProfile, persist
+            # it fingerprint-keyed, and regression-check against the
+            # stored baseline. Runs AFTER the query's last device
+            # interaction; a recorder failure must never fail the query
+            from ..obs.history import close_query_profile
+
+            try:
+                self._last_profile, self._last_regressions = \
+                    close_query_profile(self, ctx, recorder)
+            except Exception:
+                ctx.metrics.add("obs.profile_errors")
         return out
+
+    def plan_fingerprint(self) -> dict:
+        """Canonical structural fingerprint of the executed physical
+        plan (obs/history.py): the full hash + per-stage
+        sub-fingerprints the persistent compile/result caches key by.
+        Pure host work; memoized per QueryExecution (the physical plan
+        is cached, so the fingerprint cannot drift under it)."""
+        fp = getattr(self, "_plan_fingerprint", None)
+        if fp is None:
+            from ..obs.history import plan_fingerprint
+
+            fp = self._plan_fingerprint = plan_fingerprint(
+                self.physical, self.session.conf)
+        return fp
 
     def to_arrow(self) -> pa.Table:
         import uuid
@@ -342,7 +411,7 @@ class QueryExecution:
         plan text)."""
         from ..obs.metrics import (
             finalize_plan_metrics, fused_members, iter_plan_metrics,
-            metric_key,
+            metric_children, metric_key,
         )
 
         ctx = getattr(self, "_last_ctx", None)
@@ -360,7 +429,7 @@ class QueryExecution:
                 if hasattr(node, "simple_string") else "",
                 **fields,
                 "fused": fused_members(node) or None,
-                "children": [metric_key(c) for c in node.children],
+                "children": [metric_key(c) for c in metric_children(node)],
             })
         # AQE re-plan annotations: THIS query's delta over the session
         # counters (they are cumulative across queries)

@@ -347,8 +347,12 @@ def _handle_free_shuffle(payload: bytes) -> bytes:
 
 def _handle_launch_task(payload: bytes) -> bytes:
     """Runs one cloudpickled (fn, args) task. Task failures are data
-    (('err', traceback)), not transport errors — a deterministic task
-    error must not look like an executor loss to the driver."""
+    (('err', traceback, salvaged_obs)), not transport errors — a
+    deterministic task error must not look like an executor loss to the
+    driver. The third element carries the failed attempt's packaged
+    observability when the task body stamped one onto the exception
+    (cluster_sql._run_stage_store) — the wasted-work record the driver
+    surfaces in chaos-path EXPLAIN ANALYZE and the query profile."""
     import cloudpickle
 
     try:
@@ -357,8 +361,14 @@ def _handle_launch_task(payload: bytes) -> bytes:
         return pickle.dumps(("ok", result))
     except SystemExit:
         raise
-    except BaseException:
-        return pickle.dumps(("err", traceback.format_exc()))
+    except BaseException as e:
+        salvage = getattr(e, "_salvaged_obs", None)
+        try:
+            return pickle.dumps(("err", traceback.format_exc(), salvage))
+        except Exception:
+            # unpicklable salvage (should not happen — it is plain
+            # dicts) must not mask the task error
+            return pickle.dumps(("err", traceback.format_exc(), None))
 
 
 def serve_worker(driver_addr: str, token: str, host_label: str = "localhost",

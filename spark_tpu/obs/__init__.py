@@ -25,3 +25,6 @@ from .metrics import (  # noqa: F401
     pop_op, push_op, record_kernel_compile, record_kernel_launch,
     scoped_submit,
 )
+from .history import (  # noqa: F401
+    ProfileStore, detect_regressions, plan_fingerprint, query_key,
+)

@@ -38,7 +38,8 @@ import numpy as np
 __all__ = ["AnalyzedReport", "batch_cost_scope", "current_op_name",
            "export_op_records", "export_op_records_partial",
            "finalize_plan_metrics", "fused_members",
-           "get_or_create_op_record", "merge_op_records", "new_op_record",
+           "get_or_create_op_record", "iter_metric_nodes",
+           "merge_op_records", "metric_children", "new_op_record",
            "pop_op", "push_op", "record_kernel_launch",
            "record_kernel_compile", "scoped_submit"]
 
@@ -241,16 +242,49 @@ def metric_key(node) -> int:
     return id(node) if k is None else k
 
 
+def iter_metric_nodes(physical):
+    """Every node that can own a metric record, INCLUDING a whole-query
+    wrapper's inner plan (its child_fields=() hides the inner tree from
+    the schedulable walk, but a runtime tier degrade executes those
+    operators directly — they need pre-assigned metric ids so the
+    records land under keys the renderers know)."""
+    def walk(node):
+        yield node
+        for c in metric_children(node, degraded_only=False):
+            yield from walk(c)
+
+    yield from walk(physical)
+
+
+def metric_children(node, degraded_only: bool = True) -> list:
+    """A node's children for metric/graph rendering. A whole-query
+    wrapper that DEGRADED to the stage tier at runtime contributes its
+    inner plan as a rendered child (per-member attribution through the
+    wrapper — degraded profiles read like stage-tier profiles); a
+    healthy wrapper keeps its single-dispatch fused_members view.
+    `degraded_only=False` (metric-id assignment) always descends: the
+    ids must exist BEFORE execution decides whether to degrade."""
+    kids = list(node.children)
+    inner = getattr(node, "degraded_inner", None)
+    if inner is not None:
+        inner_plan = inner() if degraded_only else inner(always=True)
+        if inner_plan is not None:
+            kids = [inner_plan] + kids
+    return kids
+
+
 def iter_plan_metrics(physical, rec: dict):
     """Depth-first (node, depth, key, metric-fields) over the executed
     plan — the single walker both plan_graph and EXPLAIN ANALYZE consume,
-    so a new metric field reaches every renderer at once."""
+    so a new metric field reaches every renderer at once. Descends into
+    a runtime-degraded whole-query wrapper's inner plan (see
+    metric_children)."""
     out = []
 
     def walk(node, depth):
         key = metric_key(node)
         out.append((node, depth, key, op_metric_fields(rec.get(key))))
-        for c in node.children:
+        for c in metric_children(node):
             walk(c, depth + 1)
 
     walk(physical, 0)
@@ -533,6 +567,10 @@ class AnalyzedReport:
                            + (f"  measured peak="
                               f"{_fmt_bytes(st['measured'])}"
                               if st.get("measured") is not None else ""))
+            if mem.get("xla_temp_peak"):
+                out.append("  xla temp scratch (peak per dispatch): "
+                           + _fmt_bytes(mem["xla_temp_peak"])
+                           + " — outside the engine-tile ledger")
         if self.findings:
             out.append("-- findings --")
             for f in self.findings:
@@ -615,6 +653,42 @@ def _memory_section(physical, prediction, resources: dict | None,
     return mem
 
 
+def _xla_temp_section(measured: dict, mem: dict,
+                      findings: list) -> None:
+    """Fold captured XLA temp (scratch) bytes into the memory
+    reconciliation (PR 7 follow-on): the device ledger tracks
+    engine-held tiles only, so a fused kernel's scratch is invisible to
+    both the predicted and the measured watermark — with
+    spark.tpu.metrics.kernelMemory on, the cost table's
+    memory_analysis() capture names that headroom explicitly instead of
+    leaving it as unexplained drift (and as surprise OOM room under
+    spark.tpu.memory.budget). Scratch lives only inside one kernel, so
+    the concurrent peak is the max over the kinds this query launched."""
+    from ..physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+    per_kind = {}
+    for kind in measured:
+        tb = (KC.cost_by_kind.get(kind) or {}).get("temp_bytes")
+        if tb:
+            per_kind[kind] = int(tb)
+    if not per_kind:
+        return
+    peak = max(per_kind.values())
+    mem["xla_temp_by_kind"] = per_kind
+    mem["xla_temp_peak"] = peak
+    pred = mem.get("predicted_peak")
+    meas = mem.get("measured_peak")
+    if pred and meas is not None and meas <= pred and meas + peak > pred:
+        findings.append({
+            "severity": "info", "kind": "xla-temp",
+            "msg": f"XLA kernel scratch (up to {_fmt_bytes(peak)} of "
+                   "temp per dispatch, memory_analysis capture) pushes "
+                   "true peak HBM past the engine-tile model's "
+                   f"{_fmt_bytes(pred)} — the ledger only sees "
+                   "engine-held tiles, so this headroom is real but "
+                   "invisible to the measured watermark"})
+
+
 def build_analyzed_report(physical, plan_metrics: dict | None,
                           prediction, measured: dict,
                           counter_deltas: dict,
@@ -682,6 +756,7 @@ def build_analyzed_report(physical, plan_metrics: dict | None,
                    "launches)"})
     memory = _memory_section(physical, prediction, resources, peak_gbps,
                              nodes, findings)
+    _xla_temp_section(measured, memory, findings)
     return AnalyzedReport(nodes=nodes, predicted=predicted,
                           measured=dict(measured),
                           prediction_exact=prediction.exact,

@@ -54,7 +54,8 @@ from collections import OrderedDict
 
 __all__ = ["DeviceLedger", "GLOBAL_LEDGER", "MemoryBudgetExceeded",
            "check_memory_budget", "configure", "device_peak_gbps",
-           "kernel_cost_enabled", "ledger_enabled"]
+           "kernel_cost_enabled", "kernel_memory_enabled",
+           "ledger_enabled"]
 
 _MAX_QUERIES = 64   # retained per-query records (ring, matches LiveObs)
 
@@ -68,21 +69,24 @@ _MAX_QUERIES = 64   # retained per-query records (ring, matches LiveObs)
 # first-invocation path — both too hot for a conf dict lookup + parse
 _LEDGER_ON = True
 _KERNEL_COST_ON = True
+_KERNEL_MEMORY_ON = False
 
 
 def configure(conf) -> None:
     """Apply a session/worker conf to the process-global switches
-    (spark.tpu.memory.ledger, spark.tpu.metrics.kernelCost). Called by
-    TpuSession.__init__ and the worker-side begin_stage_obs — the ledger
-    itself stays process-global like the KernelCache."""
-    global _LEDGER_ON, _KERNEL_COST_ON
+    (spark.tpu.memory.ledger, spark.tpu.metrics.kernelCost/kernelMemory).
+    Called by TpuSession.__init__ and the worker-side begin_stage_obs —
+    the ledger itself stays process-global like the KernelCache."""
+    global _LEDGER_ON, _KERNEL_COST_ON, _KERNEL_MEMORY_ON
 
-    from ..config import KERNEL_COST, MEMORY_LEDGER
+    from ..config import KERNEL_COST, KERNEL_MEMORY, MEMORY_LEDGER
 
     # conf values are host data — bool() here never touches device
     _LEDGER_ON = bool(conf.get(MEMORY_LEDGER))  # tpulint: ignore[host-sync]
     _KERNEL_COST_ON = bool(conf.get(  # tpulint: ignore[host-sync]
         KERNEL_COST))
+    _KERNEL_MEMORY_ON = bool(conf.get(  # tpulint: ignore[host-sync]
+        KERNEL_MEMORY))
 
 
 def ledger_enabled() -> bool:
@@ -91,6 +95,13 @@ def ledger_enabled() -> bool:
 
 def kernel_cost_enabled() -> bool:
     return _KERNEL_COST_ON
+
+
+def kernel_memory_enabled() -> bool:
+    """XLA memory_analysis() temp-bytes capture (off by default: the AOT
+    lowering compile it needs is not shared with the dispatch path on
+    this jax version — one extra backend compile per distinct kernel)."""
+    return _KERNEL_MEMORY_ON
 
 
 # ---------------------------------------------------------------------------

@@ -95,8 +95,13 @@ def _capture_kernel_cost(f, args, kwargs) -> dict | None:
     invocation: XLA's HLO cost analysis via the LOWERING (tracing only —
     no second backend compile; jax.stages.Lowered.cost_analysis) with a
     metadata fallback (argument bytes) when lowering is unavailable.
-    Gated by spark.tpu.metrics.kernelCost."""
-    from ..obs.resources import kernel_cost_enabled
+    Gated by spark.tpu.metrics.kernelCost. With
+    spark.tpu.metrics.kernelMemory additionally on, the lowering is
+    also COMPILED once to read memory_analysis() temp (scratch) bytes —
+    the per-dispatch HBM the engine-tile ledger cannot see; that AOT
+    compile is not shared with the dispatch path, hence the separate
+    opt-in."""
+    from ..obs.resources import kernel_cost_enabled, kernel_memory_enabled
 
     if not kernel_cost_enabled():
         return None
@@ -106,7 +111,8 @@ def _capture_kernel_cost(f, args, kwargs) -> dict | None:
     lower = getattr(f, "lower", None)
     if lower is not None:
         try:
-            ca = lower(*args, **kwargs).cost_analysis()
+            lowered = lower(*args, **kwargs)
+            ca = lowered.cost_analysis()
             if isinstance(ca, (list, tuple)):
                 ca = ca[0] if ca else {}
             flops = float(ca.get("flops", 0.0) or 0.0)
@@ -115,6 +121,14 @@ def _capture_kernel_cost(f, args, kwargs) -> dict | None:
                 cost = {"flops": flops, "bytes": ba, "source": "xla"}
             elif flops > 0.0:
                 cost["flops"] = flops
+            if kernel_memory_enabled():
+                try:
+                    ma = lowered.compile().memory_analysis()
+                    tb = getattr(ma, "temp_size_in_bytes", None)
+                    if tb is not None:
+                        cost["temp_bytes"] = int(tb)
+                except Exception:
+                    pass  # memory capture must never fail a dispatch
         except Exception:
             pass  # cost capture must never fail a dispatch
     return cost
@@ -210,6 +224,12 @@ class KernelCache:
                         ent["flops"] += cost["flops"]
                         ent["bytes"] += cost["bytes"]
                         ent["launches"] += 1
+                        tb = cost.get("temp_bytes")
+                        if tb:
+                            # scratch is per-dispatch, not cumulative —
+                            # the kind's entry keeps the worst kernel
+                            ent["temp_bytes"] = max(
+                                ent.get("temp_bytes", 0), tb)
                         self.flops_total += cost["flops"]
                         self.bytes_total += cost["bytes"]
             # per-operator attribution (obs/metrics contextvar scope):

@@ -973,6 +973,10 @@ class WholeQueryExec(PhysicalPlan):
         self.plan = plan
         self.decision = decision
         self._members_cache: list | None = None
+        # set when a runtime fault degraded this execution to the stage
+        # tier: the obs walkers then render the INNER plan (per-member
+        # attribution through the wrapper — PR 11 follow-on (d))
+        self._degraded = False
 
     @property
     def output(self):
@@ -986,8 +990,22 @@ class WholeQueryExec(PhysicalPlan):
     def graph_name(self) -> str:
         return "WholeQueryExec"
 
+    def degraded_inner(self, always: bool = False):
+        """The inner plan for metric/graph rendering: exposed once a
+        runtime fault degraded this run to the stage tier (the inner
+        operators then executed individually and own real records), or
+        unconditionally for metric-ID pre-assignment (`always=True` —
+        ids must exist before execution decides whether to degrade).
+        obs/metrics.metric_children is the only caller."""
+        return self.plan if (always or self._degraded) else None
+
     def fused_members(self) -> list:
-        """Every lowered operator shares this node's single dispatch."""
+        """Every lowered operator shares this node's single dispatch.
+        A degraded run renders the members as REAL child nodes with
+        their own records instead (degraded_inner), so the fused view
+        empties — the two renderings must not duplicate each other."""
+        if self._degraded:
+            return []
         if self._members_cache is None:
             self._members_cache = [
                 (n.simple_string() if hasattr(n, "simple_string")
@@ -1027,6 +1045,11 @@ class WholeQueryExec(PhysicalPlan):
 
         reason = f"{type(cause).__name__}: {str(cause)[:200]}"
         self.decision.details["runtime_degraded"] = reason
+        # flip the obs walkers to per-member rendering: the inner
+        # operators are about to execute individually, and their records
+        # must be comparable to a stage-tier run's (plan graph, EXPLAIN
+        # ANALYZE, and the query profile all descend through the wrapper)
+        self._degraded = True
         ctx.metrics.add("whole_query.runtime_degraded")
         live = getattr(ctx, "live_obs", None)
         if live is not None:
