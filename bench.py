@@ -109,6 +109,25 @@ WHOLE_QUERY = "--whole-query" in sys.argv
 if WHOLE_QUERY:
     sys.argv = [a for a in sys.argv if a != "--whole-query"]
 
+# --serve-restart: measure the persistent-cache restart story
+# (spark_tpu/exec/persist_cache.py): run the smoke query set in a child
+# process with spark.tpu.cache.dir pointed at a scratch dir (cold leg),
+# re-exec a FRESH process against the same cache dir (warm leg), and
+# report cold vs warm compile counts (engine compiles, XLA disk
+# hits/misses — a warm restart must show zero disk misses) plus
+# repeated-query latency (first execution vs the zero-launch result-
+# cache hit). `python bench.py serve_restart` also selects it directly.
+SERVE_RESTART = "--serve-restart" in sys.argv
+if SERVE_RESTART:
+    sys.argv = [a for a in sys.argv if a != "--serve-restart"]
+
+# internal: one serve-restart child leg (invoked by bench_serve_restart
+# in a subprocess with SPARK_TPU_CACHE_DIR set) — runs the query set
+# against the persistent caches and prints one SERVE-LEG json line
+SERVE_LEG = "--serve-leg" in sys.argv
+if SERVE_LEG:
+    sys.argv = [a for a in sys.argv if a != "--serve-leg"]
+
 # --profile: record a QueryProfile for every query the suite executes
 # (obs/history.py flight recorder) into SPARK_TPU_PROFILE_DIR (default
 # ./bench_profiles): fingerprint-keyed JSONL with per-kind launch/compile
@@ -888,6 +907,125 @@ def bench_tpcds():
 
 
 # --------------------------------------------------------------------------
+# serve-restart: persistent-cache warm restarts (exec/persist_cache.py)
+# --------------------------------------------------------------------------
+
+def _serve_leg() -> int:
+    """One serve-restart child leg: run the query set against the
+    persistent caches rooted at SPARK_TPU_CACHE_DIR and print one
+    SERVE-LEG json line. Phase 1 runs with the result cache DISABLED so
+    queries actually execute (that is what proves the XLA disk cache:
+    engine compiles happen, backend compiles hit disk on the warm leg);
+    phase 2 enables the result cache and measures the repeated-query
+    path (zero-launch Arrow-payload answer)."""
+    import pyarrow as pa
+
+    import spark_tpu.api.functions as F
+    import spark_tpu.exec.persist_cache as pc
+    from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+    cache_dir = os.environ["SPARK_TPU_CACHE_DIR"]
+    session = _session({
+        "spark.tpu.cache.dir": cache_dir,
+        "spark.tpu.cache.result.enabled": "false",
+        "spark.sql.shuffle.partitions": 2,
+        "spark.tpu.batch.capacity": 1 << 14,
+        "spark.tpu.fusion.minRows": "0",
+    })
+    rng = np.random.default_rng(11)
+    n = max(4000, int(100_000 * SCALE))
+    table = pa.table({"k": rng.integers(0, 64, n).astype(np.int64),
+                      "v": rng.integers(0, 1000, n).astype(np.int64)})
+    df = _df_from_table(session, table, "serve_t")
+    queries = {
+        "groupby": lambda: df.groupBy("k").agg(F.sum("v").alias("s")),
+        "filter_sort": lambda: df.where(F.col("v") > 500).orderBy("k"),
+    }
+    exec_ms = {}
+    for name, q in queries.items():
+        t0 = time.perf_counter()
+        q().toArrow()
+        exec_ms[name] = round((time.perf_counter() - t0) * 1000, 2)
+    # phase 2: repeated identical query through the result cache (the
+    # cold leg populates the entry; the warm leg's first lookup already
+    # hits it CROSS-PROCESS)
+    session.conf.set("spark.tpu.cache.result.enabled", "true")
+    queries["groupby"]().toArrow()
+    l0 = KC.launches
+    t0 = time.perf_counter()
+    queries["groupby"]().toArrow()
+    repeat_ms = round((time.perf_counter() - t0) * 1000, 2)
+    counters = session._metrics.snapshot()["counters"]
+    print("SERVE-LEG " + json.dumps({
+        "compiles": KC.misses,
+        "disk_hit_compiles": KC.disk_hit_compiles,
+        "disk": pc.disk_counters(),
+        "exec_ms": exec_ms,
+        "repeat_ms": repeat_ms,
+        "repeat_launches": KC.launches - l0,
+        "result_cache_hits": int(counters.get("result_cache.hit", 0)),
+    }), flush=True)
+    return 0
+
+
+def bench_serve_restart():
+    """Cold→warm restart differential: the SAME query set in two real
+    processes sharing one cache dir. The warm process must show zero
+    XLA disk misses (every backend compile served from the cold run's
+    disk cache) and answer the repeated query from the result cache
+    with zero kernel launches."""
+    import subprocess
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="sparktpu_cache_")
+    env = dict(os.environ)
+    env["SPARK_TPU_CACHE_DIR"] = cache_dir
+    env["SPARK_TPU_BENCH_SCALE"] = str(SCALE)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    if SMOKE:
+        env["JAX_PLATFORMS"] = "cpu"
+    legs = []
+    for leg in ("cold", "warm"):
+        cmd = [sys.executable, os.path.abspath(__file__), "--serve-leg"]
+        if SMOKE:
+            cmd.append("--smoke")
+        proc = subprocess.run(
+            cmd, env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE, text=True,
+            timeout=min(_CONFIG_TIMEOUT_S, 600))
+        lines = [ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("SERVE-LEG ")]
+        if proc.returncode != 0 or not lines:
+            raise RuntimeError(
+                f"serve-restart {leg} leg failed rc={proc.returncode}: "
+                f"{proc.stdout[-400:]}")
+        legs.append(json.loads(lines[-1][len("SERVE-LEG "):]))
+    cold, warm = legs
+    return [{
+        "metric": "serve-restart warm XLA disk misses "
+                  "(0 = restart pays no cold compiles)",
+        "value": warm["disk"]["compile.disk_miss"],
+        "unit": "cold XLA compiles in a fresh process",
+        "vs_baseline": 1.0,
+        "cold_disk_misses": cold["disk"]["compile.disk_miss"],
+        "warm_disk_hits": warm["disk"]["compile.disk_hit"],
+        "cold_engine_compiles": cold["compiles"],
+        "warm_engine_compiles": warm["compiles"],
+        "warm_disk_hit_compiles": warm["disk_hit_compiles"],
+    }, {
+        "metric": "serve-restart repeated-query latency "
+                  "(cross-process result-cache hit)",
+        "value": warm["repeat_ms"],
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "first_execution_ms": warm["exec_ms"].get("groupby"),
+        "cold_repeat_ms": cold["repeat_ms"],
+        "repeat_kernel_launches": warm["repeat_launches"],
+        "result_cache_hits_warm_leg": warm["result_cache_hits"],
+    }]
+
+
+# --------------------------------------------------------------------------
 
 CONFIGS = {
     "groupby": bench_groupby,
@@ -897,6 +1035,7 @@ CONFIGS = {
     "mesh": bench_mesh,
     "encoded": bench_encoded,
     "whole_query": bench_whole_query,
+    "serve_restart": bench_serve_restart,
     "tpcds": bench_tpcds,
 }
 
@@ -932,7 +1071,8 @@ def _fallback_to_cpu_child() -> int:
                              ("--progress", PROGRESS),
                              ("--mesh", MESH),
                              ("--encoded", ENCODED),
-                             ("--whole-query", WHOLE_QUERY)) if on]
+                             ("--whole-query", WHOLE_QUERY),
+                             ("--serve-restart", SERVE_RESTART)) if on]
     try:  # stdout inherited: child lines flush straight to the driver
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__)]
@@ -950,6 +1090,8 @@ def main() -> int:
     is_child = os.environ.get("SPARK_TPU_BENCH_CHILD") == "1"
     if SMOKE:
         is_child = True  # functional gate: forced-CPU, no device probe
+    elif SERVE_LEG:
+        pass  # restart child: platform decided by the parent's env
     elif not is_child and not _device_init_alive(30):
         return _fallback_to_cpu_child()
 
@@ -958,12 +1100,17 @@ def main() -> int:
     if is_child:
         jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)
+    if SERVE_LEG:
+        # internal serve-restart child: one query-set run against the
+        # shared cache dir, one SERVE-LEG json line, exit
+        return _serve_leg()
 
     default = [c for c in CONFIGS
                if not (SMOKE and c == "tpcds")
                and (MESH or c != "mesh")       # mesh config is opt-in
                and (ENCODED or c != "encoded")  # encoded too
-               and (WHOLE_QUERY or c != "whole_query")]  # and whole-query
+               and (WHOLE_QUERY or c != "whole_query")  # and whole-query
+               and (SERVE_RESTART or c != "serve_restart")]  # and restart
     only = sys.argv[1:] or default
     records, failed = [], []
     for name in only:

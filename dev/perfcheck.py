@@ -43,6 +43,15 @@ DEFAULT_BASELINE = os.path.join(_HERE, "perf_baseline.json")
 # must not retry); mirrors obs/history.DETERMINISTIC_COUNTERS
 from spark_tpu.obs.history import DETERMINISTIC_COUNTERS, ProfileStore  # noqa: E402
 
+# persistent-cache steady-state counters (exec/persist_cache.py): gated
+# the same increase-only way — compile.disk_miss going up means the XLA
+# disk cache stopped hitting for a known plan, result_cache.miss going
+# up means a repeated query stopped answering from the result cache.
+# With the caches off (the default bench --smoke run) both stay 0 and
+# the gate is inert; a cache-enabled profile run locks them in.
+PERSIST_COUNTERS = ("compile.disk_miss", "result_cache.miss")
+GATED_COUNTERS = tuple(DETERMINISTIC_COUNTERS) + PERSIST_COUNTERS
+
 
 def collect_profiles(profile_dir: str) -> dict:
     """Collapse a profile directory into the gate's shape:
@@ -65,7 +74,7 @@ def collect_profiles(profile_dir: str) -> dict:
                 cur = launches.get(kind)
                 launches[kind] = n if cur is None else min(cur, n)
         counters = {}
-        for key in DETERMINISTIC_COUNTERS:
+        for key in GATED_COUNTERS:
             v = max((p.get("counters") or {}).get(key, 0) for p in profs)
             if v:
                 counters[key] = v
@@ -114,7 +123,7 @@ def compare(fresh: dict, baseline: dict) -> tuple[list, list]:
             regressions.append(
                 f"{tag}: steady-state compiles {fv} > baseline {bv} — a "
                 "kernel cache key stopped hitting across runs")
-        for key in DETERMINISTIC_COUNTERS:
+        for key in GATED_COUNTERS:
             bv = b.get("counters", {}).get(key, 0)
             fv = f.get("counters", {}).get(key, 0)
             if fv > bv:

@@ -48,6 +48,10 @@ JAX_PLATFORMS=cpu python dev/validate_trace.py --chaos
 echo "== profile gate (flight recorder: fingerprints, store, regression) =="
 JAX_PLATFORMS=cpu python dev/validate_trace.py --profile
 
+echo "== persist gate (cold→warm subprocess restart: disk-hit/zero-launch) =="
+JAX_PLATFORMS=cpu python dev/validate_trace.py --persist
+python bench.py --smoke --serve-restart serve_restart
+
 echo "== perfcheck (deterministic counters of bench --smoke vs baseline) =="
 python dev/perfcheck.py
 

@@ -1009,6 +1009,191 @@ def profile_gate() -> None:
         session.stop()
 
 
+# one persist-gate child leg: runs in a REAL subprocess (the warm
+# restart must be a fresh process) against the shared cache dir passed
+# as argv[1]. Prints one PERSIST json line the parent asserts on.
+_PERSIST_LEG = r'''
+import json, os, sys
+import numpy as np, pyarrow as pa
+
+cache = sys.argv[1]
+from spark_tpu import TpuSession
+from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE as KC
+import spark_tpu.exec.persist_cache as pc
+
+session = TpuSession("persist-gate", {
+    "spark.tpu.cache.dir": cache,
+    "spark.tpu.cache.result.enabled": "false",
+    "spark.sql.shuffle.partitions": 2,
+    "spark.tpu.batch.capacity": 1 << 12,
+    "spark.tpu.fusion.minRows": "0",
+    "spark.sql.adaptive.enabled": "false",
+    "spark.tpu.obs.profileDir": os.path.join(cache, "profiles"),
+})
+rng = np.random.default_rng(21)
+session.createDataFrame(pa.table({
+    "k": rng.integers(0, 9, 4000), "v": rng.integers(-50, 90, 4000),
+})).createOrReplaceTempView("pg")
+session.createDataFrame(pa.table({
+    "k": np.repeat(np.arange(9), 3), "tag": np.arange(27),
+})).createOrReplaceTempView("pg_dim")
+
+# leg 1 — compile-cache proof (result cache OFF so queries execute).
+# The FIRST run is the one that compiles (and, warm, hits disk): its
+# profile must carry the disk-hit attribution.
+q = lambda: session.sql(
+    "select k, sum(v) s, count(*) c from pg where v > 0 group by k")
+df1 = q()
+out1 = df1.toArrow()
+fp = df1.query_execution.plan_fingerprint()["fingerprint"]
+prof = df1.query_execution._last_profile or {}
+
+# leg 2 — whole-tier capacity-retry seeding: the 3x-expanding join
+# overflows its output bucket cold; a warm restart's manifest seed must
+# collapse the retry (1 dispatch, 0 capacity retries)
+session.conf.set("spark.tpu.compile.tier", "whole")
+jq = lambda: session.sql(
+    "select p.k, count(*) n from pg p join pg_dim d on p.k = d.k "
+    "group by p.k")
+jrep = jq().query_execution.analysis_report()
+c0 = dict(session._metrics.snapshot()["counters"])
+jout = jq().toArrow()
+c1 = dict(session._metrics.snapshot()["counters"])
+session.conf.unset("spark.tpu.compile.tier")
+wq = {"predicted": jrep.predicted_launches.get("whole_query"),
+      "exact": jrep.exact,
+      "dispatches": c1.get("whole_query.dispatches", 0)
+      - c0.get("whole_query.dispatches", 0),
+      "retries": c1.get("whole_query.capacity_retries", 0)
+      - c0.get("whole_query.capacity_retries", 0),
+      "rows": jout.num_rows}
+
+# leg 3 — result cache: populate, then the analyzer must predict the
+# zero-launch hit path exactly and the repeat must launch nothing
+session.conf.set("spark.tpu.cache.result.enabled", "true")
+a1 = q().toArrow()
+rep = q().query_execution.analysis_report()
+l0 = KC.launches
+a2 = q().toArrow()
+counters = session._metrics.snapshot()["counters"]
+print("PERSIST " + json.dumps({
+    "fingerprint": fp,
+    "compiles": KC.misses,
+    "disk_hit_compiles": KC.disk_hit_compiles,
+    "disk": pc.disk_counters(),
+    "profile_compiles": prof.get("compiles"),
+    "profile_disk_hit": prof.get("compiles_disk_hit"),
+    "profile_counters": prof.get("counters") or {},
+    "wq": wq,
+    "rc_predicted": rep.predicted_launches,
+    "rc_exact": rep.exact,
+    "rc_repeat_launches": KC.launches - l0,
+    "rc_hits": int(counters.get("result_cache.hit", 0)),
+    "rc_equal": a1.equals(a2),
+    "rows": out1.num_rows,
+}), flush=True)
+'''
+
+
+def persist_gate() -> None:
+    """Persistent-cache gate (--persist, self-contained): the warm-
+    restart story must hold across two REAL processes sharing one
+    spark.tpu.cache.dir. Cold leg: XLA disk misses populate the cache,
+    the whole-tier join pays its capacity retry, the result cache
+    populates and answers the repeat with zero launches (plan_lint
+    predicting the hit path exactly). Warm leg (fresh process): the
+    SAME fingerprints resolve (stability across processes), ZERO XLA
+    disk misses with every engine compile disk-served (per-query
+    profiles attribute disk-hit vs cold), the manifest seed collapses
+    the whole-tier capacity retry to one dispatch (plan_lint mirroring
+    the seeded prediction), and the result cache hits cross-process."""
+    import subprocess
+    import tempfile
+
+    cache = tempfile.mkdtemp(prefix="persist_gate_")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def leg(name: str) -> dict:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PERSIST_LEG, cache],
+            env=env, cwd=root, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, timeout=600)
+        lines = [ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("PERSIST ")]
+        if proc.returncode != 0 or not lines:
+            fail(f"--persist: {name} leg failed rc={proc.returncode}: "
+                 f"{proc.stderr[-800:]}")
+        return json.loads(lines[-1][len("PERSIST "):])
+
+    cold = leg("cold")
+    # cold-leg invariants: disk cache populated, retry paid, result
+    # cache exact on the hit path
+    if cold["disk"]["compile.disk_miss"] < 1:
+        fail("--persist: cold leg recorded no XLA disk-cache misses — "
+             "the persistent compile cache never engaged")
+    if cold["wq"]["retries"] < 1 or cold["wq"]["dispatches"] < 2:
+        fail(f"--persist: cold whole-tier join did not pay a capacity "
+             f"retry ({cold['wq']}) — the warm-start seed has nothing "
+             "to prove")
+    if cold["wq"]["predicted"] != cold["wq"]["dispatches"] \
+            or not cold["wq"]["exact"]:
+        fail(f"--persist: cold whole-query prediction "
+             f"{cold['wq']['predicted']} != measured dispatches "
+             f"{cold['wq']['dispatches']}")
+    for c in (cold,):
+        if c["rc_predicted"] != {} or not c["rc_exact"]:
+            fail(f"--persist: plan_lint did not predict the zero-launch "
+                 f"result-cache hit path ({c['rc_predicted']})")
+        if c["rc_repeat_launches"] != 0:
+            fail(f"--persist: repeated query launched "
+                 f"{c['rc_repeat_launches']} kernels through the result "
+                 "cache")
+        if not c["rc_equal"]:
+            fail("--persist: result-cache answer differs from the "
+                 "executed answer")
+    warm = leg("warm")
+    if warm["fingerprint"] != cold["fingerprint"]:
+        fail("--persist: plan fingerprint is not stable across "
+             f"processes ({cold['fingerprint']} vs "
+             f"{warm['fingerprint']}) — every persistent key is dead")
+    if warm["disk"]["compile.disk_miss"] != 0:
+        fail(f"--persist: warm restart paid "
+             f"{warm['disk']['compile.disk_miss']} TRUE cold XLA "
+             "compile(s) — the persistent compile cache missed")
+    if warm["disk"]["compile.disk_hit"] < 1:
+        fail("--persist: warm restart recorded no XLA disk-cache hits")
+    if warm["disk_hit_compiles"] < 1:
+        fail("--persist: KernelCache attributed no disk-served compiles "
+             "on the warm restart")
+    if warm["profile_disk_hit"] is None \
+            or warm["profile_disk_hit"] < 1 \
+            or not any(k == "compile.disk_hit"
+                       for k in warm["profile_counters"]):
+        fail("--persist: the warm query profile does not attribute "
+             f"disk-hit compiles ({warm['profile_disk_hit']}, "
+             f"{sorted(warm['profile_counters'])})")
+    if warm["wq"]["retries"] != 0 or warm["wq"]["dispatches"] != 1:
+        fail(f"--persist: warm whole-tier join replayed the capacity "
+             f"ladder ({warm['wq']}) — the manifest seed did not take")
+    if warm["wq"]["predicted"] != 1 or not warm["wq"]["exact"]:
+        fail(f"--persist: plan_lint did not mirror the seeded "
+             f"whole-query attempt count ({warm['wq']['predicted']})")
+    if warm["rc_hits"] < 1 or warm["rc_repeat_launches"] != 0 \
+            or not warm["rc_equal"]:
+        fail(f"--persist: cross-process result-cache hit failed "
+             f"(hits={warm['rc_hits']}, "
+             f"launches={warm['rc_repeat_launches']})")
+    print("validate_trace: persist gate OK — fingerprints stable across "
+          f"processes; warm restart: 0 true cold XLA compiles "
+          f"({warm['disk']['compile.disk_hit']} disk hits, "
+          f"{warm['disk_hit_compiles']} kernels attributed), capacity "
+          "retry collapsed 2→1 dispatches via the manifest seed, "
+          "repeated query answered with 0 launches (predicted exactly)")
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     cluster = "--cluster" in argv
@@ -1018,10 +1203,13 @@ def main(argv=None) -> int:
     whole = "--whole-query" in argv
     chaos = "--chaos" in argv
     profile = "--profile" in argv
+    persist = "--persist" in argv
     argv = [a for a in argv if a not in ("--cluster", "--live", "--mesh",
                                          "--encoded", "--whole-query",
-                                         "--chaos", "--profile")]
-    if (mesh or encoded or whole or chaos or profile) and not argv:
+                                         "--chaos", "--profile",
+                                         "--persist")]
+    if (mesh or encoded or whole or chaos or profile or persist) \
+            and not argv:
         # self-contained legs: these gates generate and validate their
         # own state (dev/run_all.sh runs them without a trace file)
         if mesh:
@@ -1034,6 +1222,8 @@ def main(argv=None) -> int:
             chaos_gate()
         if profile:
             profile_gate()
+        if persist:
+            persist_gate()
         print("validate_trace: PASS")
         return 0
     if len(argv) != 1:
@@ -1054,6 +1244,8 @@ def main(argv=None) -> int:
         chaos_gate()
     if profile:
         profile_gate()
+    if persist:
+        persist_gate()
     print("validate_trace: PASS")
     return 0
 

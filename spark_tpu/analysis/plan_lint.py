@@ -423,6 +423,10 @@ class _Analyzer:
         self._stage_by_node: dict[int, dict] = {}
         self._hbm_total = 0
         self._hbm_any = False
+        # persistent-cache mirror state (exec/persist_cache.py)
+        self._plan_root = None
+        self._persist_seed = None
+        self._persist_seed_done = False
 
     # -- bookkeeping -------------------------------------------------------
     def _approx(self, reason: str):
@@ -464,8 +468,91 @@ class _Analyzer:
         self.report.stages.append(ent)
         self._stage_by_node[id(node)] = ent
 
+    # -- persistent-cache mirrors (exec/persist_cache.py) -------------------
+    def _persist_seed_record(self):
+        """The warm-start manifest record for the analyzed plan's full
+        fingerprint (None when spark.tpu.cache.dir is unset or no prior
+        same-fingerprint run recorded outcomes) — the SAME lookup
+        QueryExecution performs, so the capacity mirrors below predict a
+        seeded first attempt exactly. Memoized per analysis."""
+        if self._persist_seed_done:
+            return self._persist_seed
+        self._persist_seed_done = True
+        try:
+            from ..exec.persist_cache import cache_root, manifest_seed
+
+            if self._plan_root is not None and cache_root(self.conf):
+                from ..obs.history import plan_fingerprint
+
+                fp = plan_fingerprint(self._plan_root, self.conf)
+                self._persist_seed = manifest_seed(self.conf,
+                                                   fp["fingerprint"])
+        except Exception:
+            self._persist_seed = None
+        return self._persist_seed
+
+    def _mesh_quota_seed(self, node, child, fused_mesh: bool,
+                         num_out: int):
+        """Mirror of the mesh exchanges' warm-start quota lookup: the
+        same mesh_quota_key the execution layer computes from the
+        staging geometry, resolved against the same manifest record."""
+        seed = self._persist_seed_record()
+        quotas = (seed or {}).get("mesh_quotas") or {}
+        if not quotas:
+            return None
+        caps = [b.cap for part in child.parts for b in part]
+        if not caps or any(c is None for c in caps):
+            return None
+        from ..exec.persist_cache import (
+            mesh_quota_key_fused, mesh_quota_key_plain,
+        )
+        from ..parallel.mesh_fusion import mesh_stage_geometry
+
+        rows_per_shard, _cap, _q = mesh_stage_geometry(sum(caps), num_out)
+        p = node.partitioning
+        pos = {a.expr_id: i for i, a in enumerate(node.output)}
+        try:
+            key_idx = tuple(pos[e.expr_id] for e in p.exprs)
+        except (AttributeError, KeyError):
+            return None
+        dtypes = [str(a.dtype) for a in node.output]
+        if fused_mesh:
+            mkey = mesh_quota_key_fused(num_out, rows_per_shard, key_idx,
+                                        len(node.output), dtypes)
+        else:
+            mkey = mesh_quota_key_plain(num_out, rows_per_shard, key_idx,
+                                        dtypes)
+        return quotas.get(mkey)
+
     # -- entry -------------------------------------------------------------
     def run(self, plan) -> AnalysisReport:
+        self._plan_root = plan
+        # persistent result cache: a plan whose collect would answer
+        # from the on-disk result cache RIGHT NOW launches NOTHING —
+        # planning is host-only work and the payload is already on disk.
+        # Same key computation as the execution path (result_probe), so
+        # the zero-launch hit prediction is exact by construction.
+        try:
+            from ..exec.persist_cache import result_probe
+
+            hit = result_probe(plan, self.conf)
+        except Exception:
+            hit = False
+        if hit:
+            dec = getattr(plan, "_tier_decision", None) \
+                or getattr(plan, "decision", None)
+            if dec is not None:
+                try:
+                    self.report.tier = dec.to_dict()
+                except Exception:
+                    pass
+            self._stage(plan, Counter(), 0, notes=(
+                "RESULT CACHE HIT: this plan's fingerprint + leaf data "
+                "versions match a stored result (spark.tpu.cache.dir) — "
+                "the collect answers from the Arrow payload with ZERO "
+                "kernel launches",))
+            self.report.predicted_launches = {}
+            return self.report
         # compile-tier decision: the planner stashes the chooser's verdict
         # (incl. the whole-query fallback reason) on the plan root; the
         # whole tier's own root node carries it directly
@@ -1832,11 +1919,23 @@ class _Analyzer:
                      "— skewed data recompiles with a doubled quota")
         key_ids = [e.expr_id for e in p.exprs
                    if isinstance(e, AttributeReference)]
+        # the stage program accumulates per-reduce-partition min/max for
+        # the exchange's stat columns IN-PROGRAM and seeds the
+        # dense-range memo at build time (parallel/mesh_exchange.
+        # _seed_mesh_stats) — mesh reduce tiles are probe-free for those
+        # columns exactly like host-shuffle rebuilt tiles, and the
+        # seeded span equals the tile's own rows' span, so the dense
+        # decision model below stays exact
+        seeded = self._exchange_seeded(node)
         sim = None
         if len(key_ids) == len(p.exprs) and child.counted:
             in_traces = self._exchange_input_traces(node, child, fused)
             if in_traces is not None:
-                sim = self._mesh_sim(child, in_traces, key_ids, num_out)
+                sim = self._mesh_sim(child, in_traces, key_ids, num_out,
+                                     seeded=seeded,
+                                     quota_seed=self._mesh_quota_seed(
+                                         node, child, fused_mesh,
+                                         num_out))
         if sim is None:
             self._approx("mesh stage quota retries are data-dependent "
                          "and the key values are untraced — assuming one "
@@ -1844,7 +1943,7 @@ class _Analyzer:
             kinds["mesh_stage"] += 1
             self._stage(node, kinds, child.total_batches if child.counted
                         else None, notes)
-            return _Flow([[_Batch(None, None, False)]
+            return _Flow([[_Batch(None, None, False, seeded=seeded)]
                           for _ in range(num_out)], None, counted=True)
         attempts, flow = sim
         kinds["mesh_stage"] += attempts
@@ -1860,14 +1959,17 @@ class _Analyzer:
         return flow
 
     def _mesh_sim(self, child: _Flow, traces: list, key_ids: list,
-                  num_out: int):
+                  num_out: int, seeded: "bool | frozenset" = False,
+                  quota_seed: "int | None" = None):
         """Host mirror of the mesh staging + quota-retry loop. Returns
         (attempts, output _Flow) or None when the layout cannot be
         reconstructed. Mirrors parallel/mesh_exchange: batches flatten
         partition-major into a [total_cap] plane, shard s owns data rows
         [s*rows_per_shard, (s+1)*rows_per_shard), pids come from the
         splitmix64 host mirror, and the quota doubles (one extra
-        dispatch) while any (src,dst) bucket overflows."""
+        dispatch) while any (src,dst) bucket overflows. `quota_seed`
+        mirrors the persistent warm-start manifest: a seeded first
+        attempt starts at the prior run's final quota."""
         # the SAME geometry helper the runtime stages with — the mirror
         # cannot drift from the execution layer
         from ..parallel.mesh_fusion import mesh_stage_geometry
@@ -1926,6 +2028,8 @@ class _Analyzer:
         live_idx = np.nonzero(live)[0]
         rows_per_shard, _shard_cap, quota = mesh_stage_geometry(
             total_cap, num_out)
+        if quota_seed and int(quota_seed) > quota:
+            quota = int(quota_seed)
         shard = live_idx // rows_per_shard
         pid_live = pids[live_idx]
         attempts = 1
@@ -1944,7 +2048,7 @@ class _Analyzer:
             sel = live_idx[pid_live == q]  # ascending == shard-major,
             # then original position: the stable per-shard pid sort
             rows_q = int(len(sel))
-            parts.append([_Batch(rows_q, out_cap, False)])
+            parts.append([_Batch(rows_q, out_cap, False, seeded=seeded)])
             cols_q = {k: (gv[sel],
                           None if gvalid is None else gvalid[sel])
                       for k, (gv, gvalid) in gcols.items()}
@@ -2342,8 +2446,14 @@ class _Analyzer:
         untraced = [False]
         # retry-loop state shared across simulation rounds: per-join
         # output capacities in lowering order, exactly as the runtime's
-        # join_caps list evolves
-        caps_state: dict[int, int] = {}
+        # join_caps list evolves. A persistent warm-start seed
+        # (exec/persist_cache.py manifest, same lookup the runtime
+        # performs) pre-populates the list — the seeded first attempt is
+        # the prior run's FINAL program, so its retry rounds collapse.
+        seed_caps = (self._persist_seed_record() or {}).get("join_caps") \
+            or ()
+        caps_state: dict[int, int] = {i: int(c)
+                                      for i, c in enumerate(seed_caps)}
         round_state = {"seq": 0, "overflow": []}
 
         def mem(n, cap, extra_planes: int = 0):

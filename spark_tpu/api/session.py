@@ -92,9 +92,16 @@ class TpuSession:
         self.catalog_ = Catalog(self.conf.case_sensitive)
         wh_dir = self.conf.get("spark.sql.warehouse.dir")
         if wh_dir:
+            from ..exec import persist_cache as _pc
             from ..plan.warehouse import Warehouse
 
-            self.catalog_.external = Warehouse(str(wh_dir))
+            # every catalog write (save/append/overwrite/drop) drops the
+            # persistent result-cache entries depending on the table —
+            # a no-op while spark.tpu.cache.dir is unset
+            self.catalog_.external = Warehouse(
+                str(wh_dir),
+                on_write=lambda p, _c=self.conf:
+                _pc.invalidate_path(_c, p))
         self._analyzer = Analyzer(self.catalog_, self.conf.case_sensitive)
         self._optimizer = Optimizer()
         self._metrics = Metrics()
@@ -123,6 +130,14 @@ class TpuSession:
         # default; chaos runs flip it per session and the rules ship to
         # workers with the rest of the conf
         _faults.configure(self.conf)
+        from ..exec import persist_cache as _persist
+
+        # persistent compile/result caches (spark.tpu.cache.*) — off by
+        # default (cache dir empty); with a dir configured this points
+        # jax's XLA persistent compilation cache at <dir>/xla and
+        # installs the disk-hit/miss event counters. Conf ships to
+        # workers, whose begin_stage_obs makes the same call.
+        _persist.configure(self.conf)
         from ..obs.live import LiveObs
 
         # live telemetry store: heartbeat-streamed worker obs partials,

@@ -479,6 +479,56 @@ OBS_PROFILE_WALL_TOLERANCE = _register(ConfigEntry(
     "obs.regression finding (wall time is noisy — never an error).",
     float))
 
+# --- persistent caches (spark_tpu/exec/persist_cache.py) -------------------
+
+CACHE_DIR = _register(ConfigEntry(
+    "spark.tpu.cache.dir", "",
+    "Root directory for the persistent caches: the XLA compile cache "
+    "(<dir>/xla — jitted programs compiled once hit disk on every later "
+    "process's first dispatch), the warm-start manifest (<dir>/"
+    "manifest.jsonl — per-fingerprint tier decisions and join/mesh "
+    "capacity outcomes, so a restarted server skips capacity-retry "
+    "recompiles), and the result cache (<dir>/result — full "
+    "plan-fingerprint + data-version keyed Arrow IPC payloads; a hit "
+    "answers with ZERO kernel launches). Empty (default) = every "
+    "persistent cache off; tier-1 exact-count tests and the plan "
+    "analyzer's default launch model assume this default.", str))
+
+CACHE_COMPILE = _register(ConfigEntry(
+    "spark.tpu.cache.compile.enabled", True,
+    "With spark.tpu.cache.dir set, point jax's XLA persistent "
+    "compilation cache at <dir>/xla so every jitted program's backend "
+    "compile is written to disk once and served from disk in later "
+    "processes (the normal jax.jit dispatch path stays intact — no AOT "
+    "lowered.compile(), whose compile is unshared with dispatch on this "
+    "jax version). The obs layer counts compile.disk_hit distinctly "
+    "from true cold compiles.", _bool))
+
+CACHE_COMPILE_MAX_BYTES = _register(ConfigEntry(
+    "spark.tpu.cache.compile.maxBytes", 0,
+    "LRU byte bound for the on-disk XLA compile cache "
+    "(jax_compilation_cache_max_size; least-recently-used entries are "
+    "evicted past it). 0 = unbounded.", int))
+
+CACHE_RESULT = _register(ConfigEntry(
+    "spark.tpu.cache.result.enabled", True,
+    "With spark.tpu.cache.dir set, cache full query RESULTS on disk "
+    "keyed by plan fingerprint + a data-version component (warehouse "
+    "parquet file identity, in-memory table content hash) — a repeated "
+    "identical query answers from the Arrow IPC payload with zero "
+    "kernel launches, shared across connect sessions, processes, and "
+    "the cluster driver. Plans with non-deterministic expressions or "
+    "unknown leaf data identity bypass the cache. Invalidated through "
+    "the catalog write path on append/overwrite (and by the file "
+    "identity in the key).", _bool))
+
+CACHE_RESULT_MAX_BYTES = _register(ConfigEntry(
+    "spark.tpu.cache.result.maxBytes", 256 << 20,
+    "Byte budget for the on-disk result cache; past it the "
+    "least-recently-hit payloads are evicted (flock-safe across "
+    "processes). One result larger than an eighth of the budget is "
+    "never cached.", int))
+
 # --- chaos hardening (PR 11): fault injection, retry/backoff, exclusion ---
 
 FAULTS_ENABLED = _register(ConfigEntry(

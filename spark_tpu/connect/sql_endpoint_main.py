@@ -12,12 +12,21 @@ def main(argv=None) -> int:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=10000)
     p.add_argument("--conf", action="append", default=[], metavar="K=V")
+    p.add_argument("--cache-dir", default=None, metavar="DIR",
+                   help="enable the persistent caches rooted here "
+                        "(spark.tpu.cache.dir): the XLA compile cache "
+                        "makes a server RESTART warm — known plans pay "
+                        "no cold compiles — and the result cache answers "
+                        "repeated identical queries with zero kernel "
+                        "launches, shared across all connections")
     args = p.parse_args(argv)
 
     from ..api.session import TpuSession
     from .sql_endpoint import SQLEndpoint
 
     conf = dict(kv.split("=", 1) for kv in args.conf if "=" in kv)
+    if args.cache_dir:
+        conf.setdefault("spark.tpu.cache.dir", args.cache_dir)
     session = TpuSession("sqlserver", conf)
     ep = SQLEndpoint(session, host=args.host, port=args.port).start()
     print(json.dumps({"host": ep.host, "port": ep.port}), flush=True)

@@ -830,6 +830,15 @@ class ClusterDAGScheduler(DAGScheduler):
                     wk = self.ctx.worker_kernel_kinds = {}
                 for k, v in kinds.items():
                     wk[k] = wk.get(k, 0) + v
+        disk = obs.get("compile_disk")
+        if disk:
+            # worker-process XLA disk-cache traffic folds into the same
+            # per-query compile.disk_* metrics the driver deltas record
+            # (exec/persist_cache.py) — a warm cluster restart's
+            # "zero true cold compiles" claim covers workers too
+            for k, v in disk.items():
+                if v:
+                    self.ctx.metrics.add(k, v)
         if obs.get("hbm"):
             # worker HBM is a DIFFERENT device's memory: it folds into
             # the query record as a per-executor remote peak (EXPLAIN

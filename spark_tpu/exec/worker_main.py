@@ -130,6 +130,12 @@ def begin_stage_obs(conf, query_id: str | None = None,
     # other process-global switches — chaos runs exercise the worker's
     # task/heartbeat/shuffle-write seams, healthy conf disables them
     faults.configure(conf)
+    from . import persist_cache as _persist
+
+    # persistent XLA compile cache: worker processes compile their own
+    # stage kernels, so a warm cluster restart needs the same disk cache
+    # wired here (spark.tpu.cache.dir ships with the conf)
+    _persist.configure(conf)
 
     # conf values are host data — bool() here never touches device
     if not bool(conf.get(  # tpulint: ignore[host-sync]
@@ -149,6 +155,7 @@ def begin_stage_obs(conf, query_id: str | None = None,
              "kinds0": dict(KC.launches_by_kind),
              "launches0": KC.launches,
              "compile_ms0": KC.compile_ms,
+             "disk0": _persist.disk_counters(),
              "query_id": query_id, "stage_id": stage_id,
              "task_id": task_id, "flush_seq": 0,
              "span_mark": tracer.mark() if trace_on else 0,
@@ -295,6 +302,11 @@ def finish_stage_obs(state: dict | None) -> dict | None:
     kinds = {k: v - state["kinds0"].get(k, 0)
              for k, v in KC.launches_by_kind.items()
              if v != state["kinds0"].get(k, 0)}
+    from . import persist_cache as _pc
+
+    disk = {k: v - state.get("disk0", {}).get(k, 0)
+            for k, v in _pc.disk_counters().items()
+            if v != state.get("disk0", {}).get(k, 0)}
     tracer = state["tracer"]
     # this process's HBM accounting for the task's query (the ledger is
     # per-process; the driver merges it as the executor's remote peak)
@@ -306,6 +318,7 @@ def finish_stage_obs(state: dict | None) -> dict | None:
         "kernel_kinds": kinds,
         "kernel_launches": KC.launches - state["launches0"],
         "kernel_compile_ms": round(KC.compile_ms - state["compile_ms0"], 3),
+        "compile_disk": disk or None,
         "hbm": {"bytes": hbm["bytes"], "peak": hbm["peak"],
                 "ops": {k: v["peak"] for k, v in hbm["ops"].items()}}
         if hbm is not None else None,

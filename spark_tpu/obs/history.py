@@ -213,10 +213,19 @@ def plan_fingerprint(physical, conf) -> dict:
     """Canonical structural hash of an executed physical plan, with
     per-stage sub-fingerprints cut at exchange boundaries (the stage =
     the compile unit, so the sub-fingerprint is the per-stage compile
-    cache key). Pure host work over plan metadata."""
+    cache key). Pure host work over plan metadata — memoized on the
+    plan root keyed by the tier-relevant conf (the persistent-cache
+    paths fingerprint the same plan several times per query: the
+    result-cache probe, the manifest seed lookup, plan_lint's mirrors,
+    and the close-time profile)."""
     from ..physical.exchange import (
         BroadcastExchangeExec, ShuffleExchangeExec,
     )
+
+    memo_key = json.dumps(_tier_conf(conf), sort_keys=True)
+    memo = getattr(physical, "_fp_memo", None)
+    if memo is not None and memo[0] == memo_key:
+        return memo[1]
 
     stages: list[dict] = []
     leaves: list[tuple] = []
@@ -248,10 +257,15 @@ def plan_fingerprint(physical, conf) -> dict:
     full = _hash(json.dumps(
         {"root": root, "stages": [s["fingerprint"] for s in stages],
          "conf": _tier_conf(conf)}, sort_keys=True))
-    return {"fingerprint": full, "root_stage": root,
-            "stages": list(reversed(stages)),  # produce->consume order
-            "leaves": [{"op": op, "schema": list(map(list, sch)),
-                        "rows": rows} for op, sch, rows in leaves]}
+    out = {"fingerprint": full, "root_stage": root,
+           "stages": list(reversed(stages)),  # produce->consume order
+           "leaves": [{"op": op, "schema": list(map(list, sch)),
+                       "rows": rows} for op, sch, rows in leaves]}
+    try:
+        physical._fp_memo = (memo_key, out)
+    except Exception:
+        pass  # slotted/frozen plan node: skip the memo
+    return out
 
 
 def query_key(optimized_logical, conf) -> str:
@@ -286,9 +300,12 @@ DETERMINISTIC_COUNTERS = (
 )
 
 # counter-delta prefixes worth persisting beyond the deterministic set
-# (profile forensics: what did this run actually do)
+# (profile forensics: what did this run actually do). "compile." and
+# "result_cache." carry the persistent-cache attribution (PR 14):
+# disk-served vs true cold XLA compiles, result-cache hit/miss/store.
 _COUNTER_PREFIXES = ("scheduler.", "shuffle.", "join.", "whole_query.",
-                     "adaptive.", "cache.", "mesh.")
+                     "adaptive.", "cache.", "mesh.", "compile.",
+                     "result_cache.")
 
 _MAX_PROFILE_NODES = 64
 _MAX_PROFILE_FINDINGS = 16
@@ -389,7 +406,7 @@ def _xla_temp_peak(kinds: dict) -> int | None:
 
 def build_profile(qe, ctx, fingerprint: dict, qkey: str, wall_s: float,
                   kinds: dict, counter_deltas: dict, compiles: int,
-                  compile_ms: float) -> dict:
+                  compile_ms: float, compiles_disk_hit: int = 0) -> dict:
     """One QueryProfile record from the close-time state. Everything
     here is host metadata; caps keep a line small enough that the ring
     file stays cheap to compact."""
@@ -454,6 +471,11 @@ def build_profile(qe, ctx, fingerprint: dict, qkey: str, wall_s: float,
         "launches_by_kind": {k: int(v) for k, v in sorted(kinds.items())},
         "launch_total": int(sum(kinds.values())),
         "compiles": int(compiles),
+        # engine compiles whose XLA backend compile was served from the
+        # persistent disk cache (exec/persist_cache.py): a warm restart
+        # shows compiles == compiles_disk_hit (zero TRUE cold compiles);
+        # the per-query compile.disk_hit/miss deltas ride `counters`
+        "compiles_disk_hit": int(compiles_disk_hit),
         "compile_ms": round(compile_ms, 3),
         "counters": counters,
         "ops": ops,
@@ -474,13 +496,13 @@ def build_profile(qe, ctx, fingerprint: dict, qkey: str, wall_s: float,
 class ProfileStore:
     """Append-only JSONL store, one bounded ring file per query key.
 
-    Writes are driver-only and process-safe: each append takes an
-    exclusive flock on the key's file, writes one line, and compacts to
-    the newest `ring` profiles once the file doubles the bound — so the
-    store stays O(ring) per fingerprint no matter how long a server
-    runs. Readers (HistoryReader-style APIs below, the history-server
-    profiles page, dev/perfcheck.py) take no lock: JSONL lines are
-    self-delimiting and a torn tail line is skipped."""
+    Writes are driver-only and process-safe; the flock-sidecar +
+    ring-compaction mechanics live in the shared utils/diskstore.
+    JsonlRing (one locking implementation for every on-disk metadata
+    store — the persistent-cache manifest reuses it). Readers
+    (HistoryReader-style APIs below, the history-server profiles page,
+    dev/perfcheck.py) take no lock: JSONL lines are self-delimiting and
+    a torn tail line is skipped."""
 
     def __init__(self, root: str, ring: int = 32):
         self.root = root
@@ -491,51 +513,17 @@ class ProfileStore:
         safe = re.sub(r"[^0-9a-zA-Z_-]", "_", qkey)
         return os.path.join(self.root, f"{safe}.jsonl")
 
-    @staticmethod
-    def _lock(f):
-        try:
-            import fcntl
+    def _ring(self, path: str):
+        from ..utils.diskstore import JsonlRing
 
-            fcntl.flock(f.fileno(), fcntl.LOCK_EX)
-        except Exception:
-            pass  # non-posix: best-effort append (still one write call)
+        return JsonlRing(path, ring=self.ring)
 
     def append(self, profile: dict) -> None:
-        path = self._path(profile["query_key"])
-        line = json.dumps(profile, default=str) + "\n"
-        # the flock lives on a SIDECAR file that is never os.replace'd:
-        # locking the data file itself would race compaction (a writer
-        # blocked on the pre-compaction inode would append to the
-        # orphaned file after the replace and silently lose its profile)
-        with open(path + ".lock", "a") as lockf:
-            self._lock(lockf)
-            with open(path, "a", encoding="utf-8") as f:
-                f.write(line)
-            with open(path, encoding="utf-8") as f:
-                lines = f.readlines()
-            if len(lines) > 2 * self.ring:
-                tmp = path + ".tmp"
-                with open(tmp, "w", encoding="utf-8") as out:
-                    out.writelines(lines[-self.ring:])
-                os.replace(tmp, path)
+        self._ring(self._path(profile["query_key"])).append(profile)
 
     # -- reads (no lock: lines are self-delimiting) ------------------------
-    @staticmethod
-    def _load(path: str) -> list[dict]:
-        out = []
-        try:
-            with open(path, encoding="utf-8") as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        out.append(json.loads(line))
-                    except json.JSONDecodeError:
-                        continue  # torn tail of a concurrent append
-        except FileNotFoundError:
-            pass
-        return out
+    def _load(self, path: str) -> list[dict]:
+        return self._ring(path).load()
 
     def query_keys(self) -> list[str]:
         keys = []
@@ -695,7 +683,9 @@ def close_query_profile(qe, ctx, baseline: dict) -> tuple:
     profile = build_profile(
         qe, ctx, fingerprint, qkey, wall_s, kinds, counter_deltas,
         compiles=KC.misses - baseline["misses"],
-        compile_ms=KC.compile_ms - baseline["compile_ms"])
+        compile_ms=KC.compile_ms - baseline["compile_ms"],
+        compiles_disk_hit=KC.disk_hit_compiles
+        - baseline.get("disk_hit_compiles", 0))
     if overlapped:
         profile["overlapped"] = True
         ctx.metrics.add("obs.profiles_overlapped")

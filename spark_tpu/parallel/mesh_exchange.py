@@ -183,6 +183,48 @@ def _empty_result(num_out: int, schema: StructType, stats: dict) -> list:
     return out
 
 
+def _stat_candidates(schema: StructType, stat_cols) -> list:
+    """Column positions whose per-reduce min/max the stage program
+    accumulates in-program: integral non-dictionary columns (the only
+    ones dense_range_stats reads), intersected with the exchange's
+    plan-reachable stat_cols annotation when present — the same
+    restriction exec/shuffle._OutBuffer applies on the host path."""
+    integral = [i for i, f in enumerate(schema.fields)
+                if np.dtype(f.dataType.device_dtype).kind == "i"
+                and not dict_encoded(f.dataType)]
+    if stat_cols is None:
+        return integral
+    allow = set(stat_cols)
+    return [i for i in integral if i in allow]
+
+
+def _seed_mesh_stats(result: list, stat_idx: list, stats_np, num_out: int,
+                     col_stats) -> None:
+    """Seed each reduce partition's dense-range memo from the program's
+    in-program column stats ([P, n_stat, 3] — min/max/live-count per
+    shard) — the mesh analog of _OutBuffer.seed_stats: post-shuffle
+    dense agg/join decisions never launch the krange3 probe. Per-shard
+    stats equal exactly what the probe would have measured (same rows),
+    so the plan analyzer's dense-decision spans stay exact. The union
+    also lands in the exchange's col_stats for the obs layer's
+    key-span stage stats."""
+    from ..utils.device_memo import seed_dense_range_memo
+
+    union: dict = {}
+    for p in range(num_out):
+        batch = result[p][0]
+        for j, ci in enumerate(stat_idx):
+            lo, hi, cnt = (int(x) for x in stats_np[p, j])
+            st = (lo, hi, True) if cnt > 0 else (0, 0, False)
+            seed_dense_range_memo(batch.columns[ci], batch.row_mask, st)
+            if cnt > 0:
+                cur = union.get(ci)
+                union[ci] = ((min(cur[0], lo), max(cur[1], hi), True)
+                             if cur else (lo, hi, True))
+    if col_stats is not None and union:
+        col_stats["mesh"] = union
+
+
 def _build_result(schema: StructType, col_arrays: list, valid_arrays: list,
                   new_mask, counts_np, dicts: list, num_out: int,
                   out_cap: int, stats: dict) -> list:
@@ -280,6 +322,31 @@ def _mesh_shuffle_plain(partitions, key_positions, num_out, schema, ctx,
     key_sig = tuple(v is not None for v in key_valids)
     pay_sig = tuple(str(d.dtype) for d in payload_datas) \
         + ("bool",) * len(vmap_idx)
+    # in-program column stats: payload index + its validity plane's
+    # position in the combined payloads list (-1 = no validity plane)
+    stat_idx = _stat_candidates(schema, stat_cols)
+    stat_spec = tuple(
+        (i, len(payload_datas) + vmap_idx.index(i)
+         if i in vmap_idx else -1)
+        for i in stat_idx)
+    # persistent warm start (exec/persist_cache.py): a prior same-
+    # fingerprint run's FINAL quota for this exchange seeds the first
+    # attempt, so a restarted process compiles the final program
+    # directly (served by the XLA disk cache) instead of replaying the
+    # quota-doubling ladder. shard_cap scales with it (the P*quota
+    # staging invariant). plan_lint mirrors the same lookup.
+    from ..exec.persist_cache import mesh_quota_key_plain
+
+    quota0 = quota
+    mkey = mesh_quota_key_plain(
+        P, rows_per_shard, key_positions,
+        [str(f.dataType) for f in schema.fields])
+    seed_q = ((getattr(ctx, "persist_seed", None) or {})
+              .get("mesh_quotas") or {}).get(mkey)
+    if seed_q and int(seed_q) > quota:
+        quota = int(seed_q)
+        shard_cap = P * quota
+        ctx.metrics.add("cache.mesh_quota_seeded")
     base = None        # device-resident base planes (set at 1st overflow)
     base_ledger = None
     gang_failures = 0
@@ -304,28 +371,36 @@ def _mesh_shuffle_plain(partitions, key_positions, num_out, schema, ctx,
                     sent + d_keys + [v for v in d_kvalids
                                      if v is not None])
                 kkey = ("mesh_stage", "p", id(mesh), axis, P, quota,
-                        len(key_eqs), key_sig, pay_sig, donate)
+                        len(key_eqs), key_sig, pay_sig, stat_spec,
+                        donate)
                 prog = GLOBAL_KERNEL_CACHE.get_or_build(
                     kkey, lambda: build_plain_stage(
                         mesh, axis, quota, P, len(key_eqs), key_sig,
-                        len(d_payloads) + len(d_vplanes), donate))
+                        len(d_payloads) + len(d_vplanes), donate,
+                        stat_spec=stat_spec))
             else:
                 # retry: the persisted base planes feed a program that
                 # re-lays them out in-program — zero host->device restage
                 d_keys, d_kvalids, d_payloads, d_vplanes, d_mask = base
                 ledger = None
                 kkey = ("mesh_stage", "p", id(mesh), axis, P, quota,
-                        len(key_eqs), key_sig, pay_sig, donate,
-                        "base", rows_per_shard)
+                        len(key_eqs), key_sig, pay_sig, stat_spec,
+                        donate, "base", rows_per_shard)
                 prog = GLOBAL_KERNEL_CACHE.get_or_build(
                     kkey, lambda: build_plain_stage(
                         mesh, axis, quota, P, len(key_eqs), key_sig,
                         len(d_payloads) + len(d_vplanes), donate,
-                        base_rows=rows_per_shard))
+                        base_rows=rows_per_shard, stat_spec=stat_spec))
             try:
                 with MF.expected_donation_residue():
-                    out_payloads, new_mask, counts, overflow = prog(
-                        d_keys, d_kvalids, d_payloads + d_vplanes, d_mask)
+                    res = prog(d_keys, d_kvalids,
+                               d_payloads + d_vplanes, d_mask)
+                if stat_spec:
+                    (out_payloads, new_mask, counts, overflow,
+                     stats_arr) = res
+                else:
+                    out_payloads, new_mask, counts, overflow = res
+                    stats_arr = None
                 # the shuffle's ONE intended sync point per attempt: the
                 # overflow verdict gates the retry loop
                 flow = int(overflow)  # tpulint: ignore[host-sync]
@@ -354,6 +429,11 @@ def _mesh_shuffle_plain(partitions, key_positions, num_out, schema, ctx,
                 ledger.release_consumed()  # donated buffers died at call
             if flow == 0:
                 ctx.metrics.add("exchange.mesh")
+                if quota != quota0:
+                    # final quota outcome for the warm-start manifest
+                    pmq = getattr(ctx, "persist_mesh_quotas", None) or {}
+                    pmq[mkey] = quota
+                    ctx.persist_mesh_quotas = pmq
                 counts_np = np.asarray(counts)  # tpulint: ignore[host-sync]
                 valid_arrays: list = [None] * len(payload_datas)
                 for j, i in enumerate(vmap_idx):
@@ -362,6 +442,13 @@ def _mesh_shuffle_plain(partitions, key_positions, num_out, schema, ctx,
                     schema, out_payloads[: len(payload_datas)],
                     valid_arrays, new_mask, counts_np, merged_dicts, P,
                     out_cap, stats)
+                if stats_arr is not None:
+                    # in-program column stats → dense-range memo seeds
+                    # (one tiny [P, n_stat, 3] pull beside the counts)
+                    stats_np = np.asarray(stats_arr).reshape(  # tpulint: ignore[host-sync]
+                        P, len(stat_idx), 3)
+                    _seed_mesh_stats(result, stat_idx, stats_np, P,
+                                     col_stats)
                 if ledger is not None:
                     ledger.release_all()
                 return result
@@ -456,6 +543,26 @@ def _mesh_shuffle_fused(partitions, fusion, num_out, schema, ctx, stats,
     d_aux = [jax.device_put(a, rep_sharding) for a in aux]
     rows_per_shard, shard_cap, quota = mesh_stage_geometry(total_cap, P)
     donate = MF.DONATE_DEFAULT  # module switch: tests A/B the HBM win
+    # in-program column stats over the pipeline OUTPUT columns (planes =
+    # out_datas + out_valids inside the program)
+    stat_idx = _stat_candidates(schema, stat_cols)
+    stat_spec = tuple(
+        (i, len(out_fields) + i if out_valid_sig[i] else -1)
+        for i in stat_idx)
+    # persistent warm start: the fused exchange's final quota from a
+    # prior same-fingerprint run (see the plain path for the contract)
+    from ..exec.persist_cache import mesh_quota_key_fused
+
+    quota0 = quota
+    mkey = mesh_quota_key_fused(
+        P, rows_per_shard, key_idx, len(out_fields),
+        [str(f.dataType) for f in out_fields])
+    seed_q = ((getattr(ctx, "persist_seed", None) or {})
+              .get("mesh_quotas") or {}).get(mkey)
+    if seed_q and int(seed_q) > quota:
+        quota = int(seed_q)
+        shard_cap = P * quota
+        ctx.metrics.add("cache.mesh_quota_seeded")
     base = None        # device-resident base planes (set at 1st overflow)
     base_ledger = None
     gang_failures = 0
@@ -478,12 +585,12 @@ def _mesh_shuffle_fused(partitions, fusion, num_out, schema, ctx, stats,
                 kkey = ("mesh_stage", "f", id(mesh), axis, P, quota, seed,
                         fusion._struct_key, key_idx, key_bool,
                         out_valid_sig, pipeline_signature(staged_view),
-                        hctx.signature(), donate)
+                        hctx.signature(), stat_spec, donate)
                 prog = GLOBAL_KERNEL_CACHE.get_or_build(
                     kkey, lambda: build_fused_stage(
                         mesh, axis, shard_cap, quota, P, seed,
                         input_attrs, filters, outputs, key_idx, key_bool,
-                        out_valid_sig, donate))
+                        out_valid_sig, donate, stat_spec=stat_spec))
             else:
                 # retry: persisted base planes, in-program re-layout —
                 # the retry pays the recompile only, never the restage
@@ -492,16 +599,23 @@ def _mesh_shuffle_fused(partitions, fusion, num_out, schema, ctx, stats,
                 kkey = ("mesh_stage", "f", id(mesh), axis, P, quota, seed,
                         fusion._struct_key, key_idx, key_bool,
                         out_valid_sig, pipeline_signature(staged_view),
-                        hctx.signature(), donate, "base", rows_per_shard)
+                        hctx.signature(), stat_spec, donate,
+                        "base", rows_per_shard)
                 prog = GLOBAL_KERNEL_CACHE.get_or_build(
                     kkey, lambda: build_fused_stage(
                         mesh, axis, shard_cap, quota, P, seed,
                         input_attrs, filters, outputs, key_idx, key_bool,
-                        out_valid_sig, donate, base_rows=rows_per_shard))
+                        out_valid_sig, donate, base_rows=rows_per_shard,
+                        stat_spec=stat_spec))
             try:
                 with MF.expected_donation_residue():
-                    g_datas, g_valids, new_mask, counts, overflow = prog(
-                        d_datas, d_valids, d_mask, d_aux)
+                    res = prog(d_datas, d_valids, d_mask, d_aux)
+                if stat_spec:
+                    (g_datas, g_valids, new_mask, counts, overflow,
+                     stats_arr) = res
+                else:
+                    g_datas, g_valids, new_mask, counts, overflow = res
+                    stats_arr = None
                 # the shuffle's ONE intended sync point per attempt
                 flow = int(overflow)  # tpulint: ignore[host-sync]
             except Exception as e:
@@ -528,10 +642,19 @@ def _mesh_shuffle_fused(partitions, fusion, num_out, schema, ctx, stats,
             if flow == 0:
                 ctx.metrics.add("exchange.mesh")
                 ctx.metrics.add("exchange.mesh_fused")
+                if quota != quota0:
+                    pmq = getattr(ctx, "persist_mesh_quotas", None) or {}
+                    pmq[mkey] = quota
+                    ctx.persist_mesh_quotas = pmq
                 counts_np = np.asarray(counts)  # tpulint: ignore[host-sync]
                 result = _build_result(schema, g_datas, list(g_valids),
                                        new_mask, counts_np, out_dicts, P,
                                        out_cap, stats)
+                if stats_arr is not None:
+                    stats_np = np.asarray(stats_arr).reshape(  # tpulint: ignore[host-sync]
+                        P, len(stat_idx), 3)
+                    _seed_mesh_stats(result, stat_idx, stats_np, P,
+                                     col_stats)
                 if ledger is not None:
                     ledger.release_all()
                 return result

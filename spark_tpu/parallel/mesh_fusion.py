@@ -185,12 +185,23 @@ class StagedBuffers:
 # ---------------------------------------------------------------------------
 
 def _exchange_tail(arrays, pids, row_mask, num_out: int, quota: int,
-                   axis: str):
+                   axis: str, stat_spec: tuple = ()):
     """Shared post-pid leg of a stage program, per shard: bucket live
     rows by destination into [P, quota] blocks, all-to-all every plane,
     and report (received arrays, received mask, per-shard live count,
-    global overflow). `arrays` entries may be None (absent validity
-    planes) and pass through as None."""
+    global overflow, per-shard column stats). `arrays` entries may be
+    None (absent validity planes) and pass through as None.
+
+    `stat_spec` = ((data_idx, valid_idx | -1), ...) into `arrays`: for
+    each listed integral column the program reduces the RECEIVED rows to
+    (min, max, live count) per shard — one [n_stat, 3] int64 block per
+    reduce partition, riding the dispatch's outputs. Post-exchange
+    per-shard is exactly the union of the map-side per-(src,dst) stats
+    MapStatus ships on the host path (same rows, same extrema), so the
+    seeded dense-range span equals what the krange3 probe would have
+    learned — the plan analyzer's dense-decision model stays exact. The
+    empty case returns min/max sentinels with count 0; the host maps
+    count 0 to the (0, 0, False) no-live-rows seed."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -210,7 +221,19 @@ def _exchange_tail(arrays, pids, row_mask, num_out: int, quota: int,
     new_mask = xchg(slot_valid)
     count = jnp.sum(new_mask.astype(jnp.int64)).reshape(1)
     total_overflow = lax.psum(overflow, axis)
-    return outs, new_mask, count, total_overflow
+    stats = None
+    if stat_spec:
+        big = jnp.int64(1) << 62
+        rows = []
+        for di, vi in stat_spec:
+            d = outs[di].astype(jnp.int64)
+            live = new_mask if vi < 0 else (new_mask & outs[vi])
+            rows.append(jnp.stack([
+                jnp.min(jnp.where(live, d, big)),
+                jnp.max(jnp.where(live, d, -big)),
+                jnp.sum(live.astype(jnp.int64))]))
+        stats = jnp.stack(rows)  # [n_stat, 3] per shard
+    return outs, new_mask, count, total_overflow, stats
 
 
 def _embed_block(x, shard_cap: int):
@@ -229,17 +252,24 @@ def _embed_block(x, shard_cap: int):
 def build_plain_stage(mesh, axis: str, quota: int, num_out: int,
                       n_keys: int, key_valid_sig: tuple,
                       n_payloads: int, donate: bool,
-                      base_rows: "int | None" = None):
+                      base_rows: "int | None" = None,
+                      stat_spec: tuple = ()):
     """Jitted mesh stage for PRE-MATERIALIZED batches: pids from staged
     key arrays + all-to-all, payload/mask send buffers donated. Signature:
     f(key_eqs, key_valids, payloads, row_mask) ->
-    (out_payloads, new_mask, counts[P], overflow).
+    (out_payloads, new_mask, counts[P], overflow[, stats]).
 
     With `base_rows`, inputs are PERSISTED base planes ([P*base_rows]
     row-sharded, geometry-independent): each shard embeds its block into
     the [shard_cap] send layout in-program, nothing is donated (the base
     planes survive for the next quota retry), and a retry pays only the
-    recompile — not the host->device restage."""
+    recompile — not the host->device restage.
+
+    With `stat_spec` (indices into the payloads list), the program also
+    reduces each listed integral column's received rows to per-reduce-
+    partition (min, max, live count) — the in-program column stats that
+    seed the dense-range memo so reduce tiles stop krange3-probing
+    (the MapStatus col-stats role on the ICI path)."""
     import jax
 
     from ..ops.hashing import hash_columns, partition_ids
@@ -257,8 +287,11 @@ def build_plain_stage(mesh, axis: str, quota: int, num_out: int,
             row_mask = _embed_block(row_mask, shard_cap)
         h = hash_columns(key_eqs, list(key_valids))
         pids = partition_ids(h, num_out)
-        return _exchange_tail(payloads, pids, row_mask, num_out, quota,
-                              axis)
+        outs, new_mask, count, overflow, stats = _exchange_tail(
+            payloads, pids, row_mask, num_out, quota, axis, stat_spec)
+        if stat_spec:
+            return outs, new_mask, count, overflow, stats
+        return outs, new_mask, count, overflow
 
     def sharded(key_eqs, key_valids, payloads, row_mask):
         in_specs = (
@@ -269,6 +302,10 @@ def build_plain_stage(mesh, axis: str, quota: int, num_out: int,
         )
         out_specs = ([rows] * n_payloads, rows, rows,
                      layout.replicated())
+        if stat_spec:
+            # stats are per-shard [n_stat, 3] blocks sharded over the
+            # leading axis: the host pull reshapes to [P, n_stat, 3]
+            out_specs = out_specs + (rows,)
         f = shard_map(local_fn, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_vma=False)
         return f(key_eqs, key_valids, payloads, row_mask)
@@ -284,14 +321,18 @@ def build_fused_stage(mesh, axis: str, shard_cap: int, quota: int,
                       num_out: int, seed: int, input_attrs,
                       filters, outputs, key_idx: tuple, key_bool: tuple,
                       out_valid_sig: tuple, donate: bool,
-                      base_rows: "int | None" = None):
+                      base_rows: "int | None" = None,
+                      stat_spec: tuple = ()):
     """Jitted mesh stage for a FUSED shuffle stage: the filter/project
     pipeline traces per shard, partition ids derive from the traced key
     outputs, and the all-to-all ships the pipeline OUTPUT columns — the
     whole stage is one SPMD dispatch. Signature:
     f(datas, valids, row_mask, aux) ->
-    (out_datas, out_valids, new_mask, counts[P], overflow), where the
-    input planes (datas/valids/row_mask) are the donated send buffers."""
+    (out_datas, out_valids, new_mask, counts[P], overflow[, stats]),
+    where the input planes (datas/valids/row_mask) are the donated send
+    buffers. `stat_spec` indexes the pipeline OUTPUT columns whose
+    per-reduce-partition (min, max, live count) the program reduces
+    in-program (see build_plain_stage)."""
     import jax
     import jax.numpy as jnp
 
@@ -324,9 +365,11 @@ def build_fused_stage(mesh, axis: str, shard_cap: int, quota: int,
         kvs = [out_valids[i] for i in key_idx]
         pids = partition_ids(hash_columns(eqs, kvs, seed=seed), num_out)
         planes = list(out_datas) + list(out_valids)
-        outs, new_mask, count, overflow = _exchange_tail(
-            planes, pids, mask, num_out, quota, axis)
+        outs, new_mask, count, overflow, stats = _exchange_tail(
+            planes, pids, mask, num_out, quota, axis, stat_spec)
         n = len(out_datas)
+        if stat_spec:
+            return outs[:n], outs[n:], new_mask, count, overflow, stats
         return outs[:n], outs[n:], new_mask, count, overflow
 
     def sharded(datas, valids, row_mask, aux):
@@ -339,6 +382,10 @@ def build_fused_stage(mesh, axis: str, shard_cap: int, quota: int,
         out_specs = ([rows] * len(outputs),
                      [rows if has else None for has in out_valid_sig],
                      rows, rows, rep)
+        if stat_spec:
+            # per-shard [n_stat, 3] stat blocks, sharded on the leading
+            # axis (host reshape → [P, n_stat, 3])
+            out_specs = out_specs + (rows,)
         f = shard_map(local_fn, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_vma=False)
         return f(datas, valids, row_mask, aux)

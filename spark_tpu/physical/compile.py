@@ -161,6 +161,12 @@ class KernelCache:
         self.launches = 0
         self.compile_ms = 0.0
         self.launches_by_kind: "collections.Counter" = collections.Counter()
+        # engine compiles (misses) whose XLA backend compile was served
+        # from the persistent disk cache (exec/persist_cache.py): a warm
+        # restart re-traces and re-jits every kernel (misses count them)
+        # but the expensive XLA compile hits disk — distinct counters so
+        # the obs layer tells disk-served compiles from true cold ones
+        self.disk_hit_compiles = 0
         self.flops_total = 0.0      # cumulative captured flops dispatched
         self.bytes_total = 0.0      # cumulative captured bytes accessed
         # kind -> {"flops","bytes","kernels","launches"} aggregate of the
@@ -238,11 +244,24 @@ class KernelCache:
             if first:
                 import time as _time
 
+                # persistent compile cache (exec/persist_cache.py): the
+                # disk-traffic counter delta across the first invocation
+                # classifies THIS kernel's XLA compile as disk-served vs
+                # true cold. Module-int reads — no overhead when the
+                # cache is off (both counters stay 0). Concurrent first
+                # invocations on other threads can in principle blur one
+                # delta; the counters are process telemetry, not a gate
+                # on correctness.
+                from ..exec import persist_cache as _pc
+
+                d0 = _pc.DISK_HITS
                 t0 = _time.perf_counter()
                 out = f(*args, **kwargs)
                 dt = (_time.perf_counter() - t0) * 1000
                 with self._lock:
                     self.compile_ms += dt
+                    if _pc.DISK_HITS > d0:
+                        self.disk_hit_compiles += 1
                 _obs_compile(kind, dt)
                 return out
             return f(*args, **kwargs)
@@ -280,13 +299,21 @@ class KernelCache:
         return f
 
     def counters(self) -> dict:
-        """Snapshot for metrics/listener plumbing."""
+        """Snapshot for metrics/listener plumbing. Deliberately does NOT
+        splat persist_cache.disk_counters() in: the compile.disk_* keys
+        already ride the session metrics as per-query deltas (worker
+        traffic folded in by the cluster scheduler), and process-absolute
+        values under the same names would clobber them in the
+        querySucceeded payload — one fact, one metric family. Callers
+        that want the raw process-global XLA disk traffic read
+        persist_cache.disk_counters() directly (bench, gates)."""
         with self._lock:
             return {
                 "kernel_cache.hits": self.hits,
                 "kernel_cache.misses": self.misses,
                 "kernel_cache.launches": self.launches,
                 "kernel_cache.compile_ms": round(self.compile_ms, 3),
+                "kernel_cache.disk_hit_compiles": self.disk_hit_compiles,
                 "kernel_cache.flops": round(self.flops_total, 1),
                 "kernel_cache.bytes_accessed": round(self.bytes_total, 1),
             }

@@ -1081,7 +1081,18 @@ class WholeQueryExec(PhysicalPlan):
                                     self.decision.details.items()
                                     if isinstance(v, (int, float, str))}}) \
             if tracer is not None else nullcontext()
-        join_caps: list[int] = []
+        # warm-start seeding (exec/persist_cache.py): a prior same-
+        # fingerprint run's FINAL join output capacities ride the
+        # persistent manifest back onto this process's first attempt, so
+        # a restarted server compiles the final program directly (one
+        # engine compile, served by the XLA disk cache) instead of
+        # replaying the capacity-retry ladder. Absent/short seeds fall
+        # back to the normal per-join defaults; an under-sized seed just
+        # re-enters the ordinary retry loop.
+        seed = (getattr(ctx, "persist_seed", None) or {}).get("join_caps")
+        join_caps: list[int] = [int(c) for c in (seed or ())]
+        if join_caps:
+            ctx.metrics.add("cache.capacity_seeded")
         with span:
             for attempt in range(_MAX_PROGRAM_RETRIES):
                 b = _ProgramBuilder(ctx, join_caps)
@@ -1112,6 +1123,10 @@ class WholeQueryExec(PhysicalPlan):
                         ctx.metrics.add("whole_query.capacity_retries",
                                         attempt)
                     ctx.metrics.add("whole_query.dispatches", attempt + 1)
+                    if join_caps:
+                        # capacity outcomes for the warm-start manifest
+                        # (QueryExecution writes it at query close)
+                        ctx.persist_join_caps = list(join_caps)
                     schema = attrs_schema(self.output)
                     cols = [Column(f.dataType, d, v,
                                    m.sdict if dict_encoded(f.dataType)

@@ -14,10 +14,23 @@ import threading
 
 
 class Warehouse:
-    def __init__(self, path: str):
+    def __init__(self, path: str, on_write=None):
         self.path = path
         self._lock = threading.Lock()
+        # catalog write-path hook, called with the table directory after
+        # every save/append/overwrite/drop: the session wires it to the
+        # persistent result cache's dependency invalidation
+        # (exec/persist_cache.invalidate_path) so cached query results
+        # over a table die the moment the table changes
+        self.on_write = on_write
         os.makedirs(path, exist_ok=True)
+
+    def _notify_write(self, p: str) -> None:
+        if self.on_write is not None:
+            try:
+                self.on_write(p)
+            except Exception:
+                pass  # cache invalidation must never fail a write
 
     @property
     def _catalog_file(self) -> str:
@@ -63,7 +76,8 @@ class Warehouse:
                 pq.write_table(table, os.path.join(p, "part-00000.parquet"))
             cat["tables"][name] = {"format": "parquet", "path": p}
             self._save(cat)
-            return p
+        self._notify_write(p)
+        return p
 
     def drop_table(self, name: str) -> bool:
         import shutil
@@ -75,6 +89,7 @@ class Warehouse:
             p = cat["tables"].pop(name)["path"]
             self._save(cat)
         shutil.rmtree(p, ignore_errors=True)
+        self._notify_write(p)
         return True
 
     def list_tables(self) -> list[str]:

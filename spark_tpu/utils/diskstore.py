@@ -1,0 +1,120 @@
+"""Flock-safe bounded-ring JSONL stores shared by every on-disk metadata
+surface of the engine.
+
+Factored out of obs/history.ProfileStore (PR 12) the moment a second
+consumer appeared (the persistent-cache manifest, exec/persist_cache.py):
+one locking implementation, not two. The contract:
+
+  * one JSONL file, each line one JSON object;
+  * appends are process-safe: an exclusive flock is taken on a SIDECAR
+    lockfile (never on the data file itself — locking the data file
+    would race compaction: a writer blocked on the pre-compaction inode
+    would append to the orphaned file after the os.replace and silently
+    lose its record);
+  * the file is a bounded ring: once it doubles `ring` lines it compacts
+    to the newest `ring` — a long-lived server's store stays O(ring);
+  * reads take NO lock: JSONL lines are self-delimiting, and a torn tail
+    line from a concurrent append is skipped.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+
+__all__ = ["JsonlRing"]
+
+
+def _flock(f) -> None:
+    try:
+        import fcntl
+
+        fcntl.flock(f.fileno(), fcntl.LOCK_EX)
+    except Exception:
+        pass  # non-posix: best-effort append (still one write call)
+
+
+# flock associates with the OPEN FILE DESCRIPTION: a second open() of the
+# same lockfile in the same process blocks against the first, so a
+# compound operation holding `locked()` that then calls append() would
+# self-deadlock. Re-entrancy is tracked per (thread, path) host-side.
+_HELD = threading.local()
+
+
+class JsonlRing:
+    """One bounded-ring JSONL file with flock-sidecar writes."""
+
+    def __init__(self, path: str, ring: int = 32):
+        self.path = path
+        self.ring = max(int(ring), 1)
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+
+    @contextlib.contextmanager
+    def locked(self):
+        """Exclusive sidecar lock for compound read-modify-write
+        operations (e.g. the result cache's evict-then-append).
+        Re-entrant per thread: appends inside a locked() block ride the
+        already-held flock instead of deadlocking against it."""
+        held = getattr(_HELD, "paths", None)
+        if held is None:
+            held = _HELD.paths = set()
+        if self.path in held:
+            yield
+            return
+        with open(self.path + ".lock", "a") as lockf:
+            _flock(lockf)
+            held.add(self.path)
+            try:
+                yield
+            finally:
+                held.discard(self.path)
+
+    def append(self, obj: dict) -> None:
+        line = json.dumps(obj, default=str) + "\n"
+        with self.locked():
+            with open(self.path, "ab") as f:
+                # a writer that died mid-line leaves a torn tail with no
+                # newline; appending straight after it would concatenate
+                # and poison THIS record too — terminate the torn line
+                # first (readers skip it as unparseable either way)
+                if f.tell() > 0:
+                    with open(self.path, "rb") as r:
+                        r.seek(-1, os.SEEK_END)
+                        if r.read(1) != b"\n":
+                            f.write(b"\n")
+                f.write(line.encode("utf-8"))
+            self._compact_locked()
+
+    def _compact_locked(self) -> None:
+        """Ring compaction; caller holds the sidecar lock."""
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                lines = f.readlines()
+        except FileNotFoundError:
+            return
+        if len(lines) > 2 * self.ring:
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as out:
+                out.writelines(lines[-self.ring:])
+            os.replace(tmp, self.path)
+
+    def load(self) -> list[dict]:
+        """All records, oldest first. Lockless (see module docstring)."""
+        out: list[dict] = []
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue  # torn tail of a concurrent append
+        except FileNotFoundError:
+            pass
+        return out
