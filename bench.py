@@ -128,6 +128,26 @@ SERVE_LEG = "--serve-leg" in sys.argv
 if SERVE_LEG:
     sys.argv = [a for a in sys.argv if a != "--serve-leg"]
 
+# --serve: the multi-tenant serving load test (spark_tpu/serve/): 8
+# concurrent per-connection sessions replay a mixed dashboard query set
+# through 2 fair-scheduler pools (weights 2:1) in a COLD process, then a
+# warm-restarted process replays the identical load against the same
+# persistent caches. Reports p50/p99 latency per pool, peak queue depth,
+# the contended-grant fairness ratio, per-query attributed launches vs
+# the global counter delta (must match — scope-exact ledger), overlapped
+# profile count (must be 0), and the warm leg's XLA disk misses /
+# result-cache zero-launch hits. `python bench.py serve` also selects it.
+SERVE = "--serve" in sys.argv
+if SERVE:
+    sys.argv = [a for a in sys.argv if a != "--serve"]
+
+# internal: one serve-load child leg (invoked by bench_serve in a
+# subprocess; SPARK_TPU_CACHE_DIR + SPARK_TPU_SERVE_PROFILES set) —
+# prints one SERVE-LOAD json line
+SERVE_LOAD_LEG = "--serve-load-leg" in sys.argv
+if SERVE_LOAD_LEG:
+    sys.argv = [a for a in sys.argv if a != "--serve-load-leg"]
+
 # --profile: record a QueryProfile for every query the suite executes
 # (obs/history.py flight recorder) into SPARK_TPU_PROFILE_DIR (default
 # ./bench_profiles): fingerprint-keyed JSONL with per-kind launch/compile
@@ -1026,6 +1046,187 @@ def bench_serve_restart():
 
 
 # --------------------------------------------------------------------------
+# serve: multi-tenant serving load (spark_tpu/serve/)
+# --------------------------------------------------------------------------
+
+_SERVE_QUERIES = [
+    "select k, sum(v) as s from serve_load_t group by k",
+    "select k, v from serve_load_t where v > 500 order by v limit 32",
+    "select count(*) c from serve_load_t where k < 32",
+]
+
+
+def _serve_load_leg() -> int:
+    """One serve-load child leg: start a serving session with 2 pools
+    (dash:2, batch:1), drive 8 concurrent cloned sessions through the
+    mixed query set (phase 1: result cache DISABLED so queries really
+    execute and contend), then replay through the result cache
+    (phase 2), and print one SERVE-LOAD json line with fairness,
+    latency, attribution, and cache evidence."""
+    import pyarrow as pa
+
+    import spark_tpu.exec.persist_cache as pc
+    from spark_tpu.obs.history import ProfileStore
+    from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE as KC
+    from spark_tpu.serve import QueryService
+    from spark_tpu.serve.loadgen import run_serve_load
+
+    cache_dir = os.environ["SPARK_TPU_CACHE_DIR"]
+    profile_dir = os.environ["SPARK_TPU_SERVE_PROFILES"]
+    session = _session({
+        "spark.tpu.cache.dir": cache_dir,
+        "spark.tpu.cache.result.enabled": "false",
+        "spark.tpu.obs.profileDir": profile_dir,
+        "spark.sql.shuffle.partitions": 2,
+        "spark.tpu.batch.capacity": 1 << 14,
+        "spark.tpu.fusion.minRows": "0",
+        "spark.tpu.scheduler.pools": "dash:2,batch:1",
+        "spark.tpu.serve.maxConcurrent": "2",
+    })
+    rng = np.random.default_rng(7)
+    n = max(4000, int(100_000 * SCALE))
+    session.createDataFrame(pa.table({
+        "k": rng.integers(0, 64, n).astype(np.int64),
+        "v": rng.integers(0, 1000, n).astype(np.int64),
+    })).createOrReplaceTempView("serve_load_t")
+    service = QueryService(session)
+    # serial warmup: compile every kernel once BEFORE the concurrent
+    # phase — concurrent FIRST invocations race the XLA disk-cache
+    # write (two threads compile, one persists), which made the warm
+    # leg's disk_miss flap 0/1. Warm kernels take the cache-hit path,
+    # so the contended phase measures admission, not compile races.
+    warmup = service.open_session()
+    for q in _SERVE_QUERIES:
+        service.execute_sql(warmup, q)
+    # phase 1: real execution under contention (8 sessions, 2 pools)
+    load = run_serve_load(service, _SERVE_QUERIES, sessions=8, reps=2,
+                          pools=("dash", "batch"))
+    # phase 2: repeated dashboard queries through the result cache
+    session.conf.set("spark.tpu.cache.result.enabled", "true")
+    l0 = KC.launches
+    t0 = time.perf_counter()
+    repeat = run_serve_load(service, _SERVE_QUERIES, sessions=4, reps=1,
+                            pools=("dash", "batch"))
+    repeat_ms = round((time.perf_counter() - t0) * 1000, 2)
+    repeat_launches = KC.launches - l0
+    rc_hits = int(repeat["counters"].get("result_cache.hit", 0))
+    service.drain()
+    # attribution: per-query scope-exact launch totals (stored profiles)
+    # must sum to the process-global KernelCache delta
+    store = ProfileStore(profile_dir)
+    attributed = 0
+    overlapped = 0
+    profiles = 0
+    for qk in store.query_keys():
+        for p in store.profiles(qk):
+            profiles += 1
+            attributed += int(p.get("launch_total", 0))
+            if p.get("overlapped"):
+                overlapped += 1
+    print("SERVE-LOAD " + json.dumps({
+        "load": load,
+        "repeat": {"wall_ms": repeat_ms, "launches": repeat_launches,
+                   "errors": repeat["errors"],
+                   "result_cache_hits": rc_hits},
+        "profiles": profiles,
+        "attributed_launches": attributed,
+        "global_launches": KC.launches,
+        "overlapped_profiles": overlapped,
+        "disk": pc.disk_counters(),
+        "compiles": KC.misses,
+        "disk_hit_compiles": KC.disk_hit_compiles,
+    }), flush=True)
+    return 0
+
+
+def bench_serve():
+    """Serving load test, cold process then warm restart: 8 concurrent
+    sessions on 2 weighted pools; the warm leg must pay zero XLA disk
+    misses and answer the repeated query set from the result cache
+    with zero launches."""
+    import subprocess
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="sparktpu_serve_cache_")
+    env = dict(os.environ)
+    env["SPARK_TPU_CACHE_DIR"] = cache_dir
+    env["SPARK_TPU_BENCH_SCALE"] = str(SCALE)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    if SMOKE:
+        env["JAX_PLATFORMS"] = "cpu"
+    legs = []
+    for leg in ("cold", "warm"):
+        env["SPARK_TPU_SERVE_PROFILES"] = tempfile.mkdtemp(
+            prefix=f"sparktpu_serve_prof_{leg}_")
+        cmd = [sys.executable, os.path.abspath(__file__),
+               "--serve-load-leg"]
+        if SMOKE:
+            cmd.append("--smoke")
+        proc = subprocess.run(
+            cmd, env=env, cwd=os.path.dirname(os.path.abspath(__file__)),
+            stdout=subprocess.PIPE, text=True,
+            timeout=min(_CONFIG_TIMEOUT_S, 600))
+        lines = [ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("SERVE-LOAD ")]
+        if proc.returncode != 0 or not lines:
+            raise RuntimeError(
+                f"serve {leg} leg failed rc={proc.returncode}: "
+                f"{proc.stdout[-400:]}")
+        legs.append(json.loads(lines[-1][len("SERVE-LOAD "):]))
+    cold, warm = legs
+    pools = cold["load"]["pools"]
+    out = [{
+        "metric": "serve p99 latency (8 sessions, pools dash:2/batch:1, "
+                  "maxConcurrent=2)",
+        "value": max(p["p99_ms"] or 0 for p in pools.values()),
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "per_pool": {name: {"p50_ms": p["p50_ms"], "p99_ms": p["p99_ms"],
+                            "completed": p["completed"]}
+                     for name, p in pools.items()},
+        "queue_depth_peak": cold["load"]["queue_depth_peak"],
+        "errors": (cold["load"]["errors"] + warm["load"]["errors"])[:4],
+    }, {
+        "metric": "serve weighted fairness (contended-grant ratio "
+                  "normalized by 2:1 weights; 1.0 = proportional)",
+        "value": cold["load"]["fairness_ratio"] or 0.0,
+        "unit": "x proportional share",
+        "vs_baseline": 1.0,
+        "contended_grants": cold["load"]["contended_grants"],
+    }, {
+        "metric": "serve attribution drift (sum of per-query attributed "
+                  "launches - global counter delta; 0 = scope-exact)",
+        "value": abs(cold["attributed_launches"]
+                     - cold["global_launches"]),
+        "unit": "launches",
+        "vs_baseline": 1.0,
+        "attributed": cold["attributed_launches"],
+        "global": cold["global_launches"],
+        "profiles": cold["profiles"],
+        "overlapped_profiles": cold["overlapped_profiles"]
+        + warm["overlapped_profiles"],
+    }, {
+        "metric": "serve warm-restart XLA disk misses (0 = replayed "
+                  "load pays no cold compiles)",
+        "value": warm["disk"]["compile.disk_miss"],
+        "unit": "cold XLA compiles",
+        "vs_baseline": 1.0,
+        "cold_disk_misses": cold["disk"]["compile.disk_miss"],
+        "warm_disk_hits": warm["disk"]["compile.disk_hit"],
+        "warm_disk_hit_compiles": warm["disk_hit_compiles"],
+    }, {
+        "metric": "serve warm repeated-load kernel launches (0 = every "
+                  "dashboard query answered by the result cache)",
+        "value": warm["repeat"]["launches"],
+        "unit": "launches",
+        "vs_baseline": 1.0,
+        "repeat_wall_ms": warm["repeat"]["wall_ms"],
+        "result_cache_hits_warm": warm["repeat"]["result_cache_hits"],
+    }]
+    return out
+
+
+# --------------------------------------------------------------------------
 
 CONFIGS = {
     "groupby": bench_groupby,
@@ -1036,6 +1237,7 @@ CONFIGS = {
     "encoded": bench_encoded,
     "whole_query": bench_whole_query,
     "serve_restart": bench_serve_restart,
+    "serve": bench_serve,
     "tpcds": bench_tpcds,
 }
 
@@ -1072,7 +1274,8 @@ def _fallback_to_cpu_child() -> int:
                              ("--mesh", MESH),
                              ("--encoded", ENCODED),
                              ("--whole-query", WHOLE_QUERY),
-                             ("--serve-restart", SERVE_RESTART)) if on]
+                             ("--serve-restart", SERVE_RESTART),
+                             ("--serve", SERVE)) if on]
     try:  # stdout inherited: child lines flush straight to the driver
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__)]
@@ -1090,7 +1293,7 @@ def main() -> int:
     is_child = os.environ.get("SPARK_TPU_BENCH_CHILD") == "1"
     if SMOKE:
         is_child = True  # functional gate: forced-CPU, no device probe
-    elif SERVE_LEG:
+    elif SERVE_LEG or SERVE_LOAD_LEG:
         pass  # restart child: platform decided by the parent's env
     elif not is_child and not _device_init_alive(30):
         return _fallback_to_cpu_child()
@@ -1104,13 +1307,18 @@ def main() -> int:
         # internal serve-restart child: one query-set run against the
         # shared cache dir, one SERVE-LEG json line, exit
         return _serve_leg()
+    if SERVE_LOAD_LEG:
+        # internal serve-load child: one concurrent serving run against
+        # the shared cache dir, one SERVE-LOAD json line, exit
+        return _serve_load_leg()
 
     default = [c for c in CONFIGS
                if not (SMOKE and c == "tpcds")
                and (MESH or c != "mesh")       # mesh config is opt-in
                and (ENCODED or c != "encoded")  # encoded too
                and (WHOLE_QUERY or c != "whole_query")  # and whole-query
-               and (SERVE_RESTART or c != "serve_restart")]  # and restart
+               and (SERVE_RESTART or c != "serve_restart")  # and restart
+               and (SERVE or c != "serve")]  # and the serving load test
     only = sys.argv[1:] or default
     records, failed = [], []
     for name in only:

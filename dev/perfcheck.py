@@ -62,10 +62,10 @@ def collect_profiles(profile_dir: str) -> dict:
     store = ProfileStore(profile_dir)
     out: dict = {}
     for qk in store.query_keys():
-        # overlapped profiles carry contaminated process-counter deltas
-        # (concurrent queries) — never gate on them
-        profs = [p for p in store.profiles(qk)
-                 if not p.get("overlapped")]
+        # deltas are scope-exact (per-query kernel ledger, PR 15) —
+        # every stored profile gates, including ones recorded under
+        # concurrent load
+        profs = store.profiles(qk)
         if not profs:
             continue
         launches: dict = {}

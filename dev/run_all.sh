@@ -52,6 +52,10 @@ echo "== persist gate (cold→warm subprocess restart: disk-hit/zero-launch) =="
 JAX_PLATFORMS=cpu python dev/validate_trace.py --persist
 python bench.py --smoke --serve-restart serve_restart
 
+echo "== serve gate (fair pools, admission, scope-exact attribution, drain) =="
+JAX_PLATFORMS=cpu python dev/validate_trace.py --serve
+python bench.py --smoke --serve serve
+
 echo "== perfcheck (deterministic counters of bench --smoke vs baseline) =="
 python dev/perfcheck.py
 

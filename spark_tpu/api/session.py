@@ -201,6 +201,56 @@ class TpuSession:
         if hasattr(cluster, "obs_sink"):
             cluster.obs_sink = self.live_obs.on_heartbeat
 
+    def newSession(self) -> "TpuSession":
+        """Per-connection session clone (reference: SparkSession
+        .newSession + the thriftserver's session-per-connection model).
+
+        The clone gets its OWN conf (seeded from this session's current
+        overrides — SET stays connection-local), its own temp-view
+        catalog and SQL variables (reading THROUGH to this session's:
+        views registered on the server session stay visible, views the
+        clone registers stay local), and its own metrics/tracer/
+        listener bus. It SHARES everything expensive and process-wide:
+        the KernelCache (module-global), the warehouse catalog with its
+        result-cache invalidation hook, the persistent caches under
+        spark.tpu.cache.dir, the live-obs store, the block manager, and
+        any attached cluster. stop() on a clone never tears the shared
+        services down."""
+        import collections
+
+        from ..exec.listener import ListenerBus
+        from ..obs.tracing import Tracer
+
+        clone = object.__new__(TpuSession)
+        clone.name = self.name
+        clone.conf = SQLConf(self.conf.overrides())
+        clone.catalog_ = Catalog(clone.conf.case_sensitive)
+        clone.catalog_.external = self.catalog_.external
+        # read-through temp views/variables: clone registrations land in
+        # the first map (connection-local), parent registrations stay
+        # visible; dropping a parent view from a clone is a no-op
+        clone.catalog_._tables = collections.ChainMap(
+            {}, self.catalog_._tables)
+        clone.catalog_.variables = collections.ChainMap(
+            {}, self.catalog_.variables)
+        clone._analyzer = Analyzer(clone.catalog_,
+                                   clone.conf.case_sensitive)
+        clone._optimizer = Optimizer()
+        clone._metrics = Metrics()
+        clone._table_stats = self._table_stats      # shared ANALYZE stats
+        clone._cached = self._cached                # shared cached plans
+        clone._streams = []
+        clone.tracer = Tracer(conf=clone.conf)
+        clone.live_obs = self.live_obs              # one live store
+        clone._progress_reporter = None
+        clone.listener_bus = ListenerBus()
+        cl = getattr(self, "_sql_cluster", None)
+        if cl is not None:
+            clone._sql_cluster = cl
+        clone._block_manager = self.block_manager   # shared pin budgets
+        clone._shared_services = True
+        return clone
+
     @property
     def listenerManager(self):
         return self.listener_bus
@@ -386,6 +436,9 @@ class TpuSession:
         return self
 
     def stop(self) -> None:
+        # a newSession() clone shares the cluster/block manager with its
+        # parent — stopping the clone must not tear those down
+        shared = getattr(self, "_shared_services", False)
         pr = getattr(self, "_progress_reporter", None)
         if pr is not None:
             try:
@@ -411,17 +464,19 @@ class TpuSession:
             self._ui = None
         cl = getattr(self, "_sql_cluster", None)
         if cl is not None:
-            try:
-                cl.stop()
-            except Exception:
-                pass
+            if not shared:
+                try:
+                    cl.stop()
+                except Exception:
+                    pass
             self._sql_cluster = None
         bm = getattr(self, "_block_manager", None)
         if bm is not None:
-            try:
-                bm.clear()
-            except Exception:
-                pass
+            if not shared:
+                try:
+                    bm.clear()
+                except Exception:
+                    pass
             self._block_manager = None
         if TpuSession._active is self:
             TpuSession._active = None

@@ -632,6 +632,59 @@ HEARTBEAT_FLUSH_BUDGET = _register(ConfigEntry(
     "carries the complete set). 0 = uncapped.", int))
 
 
+# --- multi-tenant serving (spark_tpu/serve/) -------------------------------
+
+SERVE_POOLS = _register(ConfigEntry(
+    "spark.tpu.scheduler.pools", "",
+    "Comma-separated fair-scheduler pool declarations 'name[:weight]' "
+    "(e.g. 'dash:2,batch:1'). The 'default' pool (weight 1) always "
+    "exists. Per-pool overrides ride "
+    "spark.tpu.scheduler.pool.<name>.{weight,maxConcurrent,queueSize,"
+    "queueTimeout,hbmBudget}. Role of the reference's "
+    "FairSchedulableBuilder + fairscheduler.xml pools.", str))
+
+SERVE_POOL = _register(ConfigEntry(
+    "spark.tpu.scheduler.pool", "default",
+    "Fair-scheduler pool this session's queries are admitted under "
+    "(SET spark.tpu.scheduler.pool=... — the reference's thread-local "
+    "spark.scheduler.pool selection). Undeclared pools are created on "
+    "demand with default settings.", str))
+
+SERVE_MAX_CONCURRENT = _register(ConfigEntry(
+    "spark.tpu.serve.maxConcurrent", 4,
+    "Global cap on concurrently EXECUTING queries across all pools "
+    "(fair-share slots; queued queries wait their pool's weighted "
+    "turn). 0 = unlimited.", int))
+
+SERVE_QUEUE_SIZE = _register(ConfigEntry(
+    "spark.tpu.serve.queueSize", 64,
+    "Default per-pool admission-queue bound; a query arriving at a "
+    "full queue is rejected immediately with POOL_QUEUE_FULL (load "
+    "shedding) instead of queueing unboundedly.", int))
+
+SERVE_QUEUE_TIMEOUT = _register(ConfigEntry(
+    "spark.tpu.serve.queueTimeout", 30.0,
+    "Default per-pool queue timeout in seconds: a query that has not "
+    "won a slot within it is rejected with ADMISSION_TIMEOUT.", float))
+
+SERVE_SESSION_MODE = _register(ConfigEntry(
+    "spark.tpu.serve.sessionMode", "isolated",
+    "SQL-endpoint session model: 'isolated' (default) clones one "
+    "session per connection (connection-local SET/temp views, shared "
+    "KernelCache/warehouse/persistent caches — the reference's "
+    "ThriftServer session-per-connection model); 'shared' keeps the "
+    "legacy all-connections-share-one-session behavior (a connection "
+    "can also opt in per-request with {\"session\": \"shared\"}).",
+    str))
+
+SERVE_DRAIN_TIMEOUT = _register(ConfigEntry(
+    "spark.tpu.serve.drainTimeout", 30.0,
+    "Graceful-drain budget in seconds for SQLEndpoint.stop()/SIGTERM: "
+    "new queries are rejected with SERVER_DRAINING immediately; "
+    "in-flight (and already-queued) queries get this long to finish "
+    "and flush their query profiles before the socket closes.", float))
+
+
 class SQLConf:
     """Session-local config with string overrides over typed defaults.
 

@@ -15,10 +15,25 @@ DB-API) can query the engine like any database:
     cur.execute("select k, sum(v) from t group by k")
     cur.fetchall()
 
-All connections share the ONE server session — SET commands and temp
-views are visible across clients, the same shared-SparkContext model
-the reference's thriftserver uses by default (per-connection config
-isolation would need session cloning; not implemented)."""
+Session model (spark_tpu/serve/): each connection gets its OWN cloned
+session (TpuSession.newSession) — SET and temp views are
+connection-local while the KernelCache, warehouse catalog and
+persistent caches stay shared, the reference ThriftServer's
+session-per-connection model. Temp views registered on the server
+session read through to every connection. The legacy
+all-connections-share-one-session behavior is an opt-in: start the
+server with spark.tpu.serve.sessionMode=shared, or send
+{"session": "shared"} on a connection before its first statement.
+
+Queries are admitted through weighted fair-scheduler pools
+(spark.tpu.scheduler.pools; a connection picks its pool with
+`SET spark.tpu.scheduler.pool=<name>`), with bounded queues,
+queue-timeout rejection, and plan-time HBM admission. A
+{"status": true} request returns the per-pool live serving status
+(queued/running/rejected, latency percentiles, SLO findings).
+stop() drains gracefully: new statements are rejected with a typed
+SERVER_DRAINING error while in-flight queries finish and flush their
+query profiles."""
 
 from __future__ import annotations
 
@@ -43,23 +58,34 @@ def _json_cell(v) -> Any:
 
 
 class SQLEndpoint:
-    """JSON-lines SQL server over one engine session."""
+    """JSON-lines SQL server over a serving session pool (see module
+    docstring: session-per-connection, fair-scheduler pool admission,
+    graceful drain)."""
 
-    def __init__(self, session, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
+                 service=None):
+        from ..serve.service import QueryService
+
         self.session = session
+        self.service = service if service is not None \
+            else QueryService(session)
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
+                # per-connection session, cloned lazily on the first
+                # statement so a {"session": "shared"} opt-in sent
+                # first binds the connection to the server session
+                state = {"session": None}
                 for line in self.rfile:
                     line = line.strip()
                     if not line:
                         continue
                     try:
                         req = json.loads(line)
-                        resp = outer._run(req)
+                        resp = outer._run(req, state)
                     except Exception as e:  # protocol-level failure
-                        resp = {"error": f"{type(e).__name__}: {e}"}
+                        resp = _error_resp(e)
                     self.wfile.write(
                         (json.dumps(resp) + "\n").encode())
                     self.wfile.flush()
@@ -72,15 +98,32 @@ class SQLEndpoint:
         self.host, self.port = self._server.server_address
         self._thread: threading.Thread | None = None
 
-    def _run(self, req: dict) -> dict:
+    def _conn_session(self, state: dict, req: dict):
+        if req.get("session") == "shared":
+            # explicit opt-in rebinds the connection (legacy behavior)
+            state["session"] = self.service.open_session("shared")
+        if state["session"] is None:
+            state["session"] = self.service.open_session()
+        return state["session"]
+
+    def _run(self, req: dict, state: dict) -> dict:
+        if req.get("status"):
+            return {"status": self.service.status()}
         sql = req.get("sql")
         if not sql:
+            if req.get("session"):
+                # session-mode-only request: bind and acknowledge
+                try:
+                    self._conn_session(state, req)
+                    return {"ok": True, "session": req.get("session")}
+                except Exception as e:
+                    return _error_resp(e)
             return {"error": "request must carry a 'sql' field"}
         try:
-            out = self.session.sql(sql)
-            if out is None or not hasattr(out, "toArrow"):
+            sess = self._conn_session(state, req)
+            t = self.service.execute_sql(sess, sql)
+            if t is None or not hasattr(t, "column_names"):
                 return {"columns": [], "types": [], "rows": []}
-            t = out.toArrow()
             cols = t.column_names
             types = [str(c.type) for c in t.columns]
             pylists = [c.to_pylist() for c in t.columns]
@@ -88,7 +131,7 @@ class SQLEndpoint:
                     for row in zip(*pylists)] if cols else []
             return {"columns": cols, "types": types, "rows": rows}
         except Exception as e:
-            return {"error": f"{type(e).__name__}: {e}"}
+            return _error_resp(e)
 
     def start(self) -> "SQLEndpoint":
         self._thread = threading.Thread(
@@ -97,9 +140,28 @@ class SQLEndpoint:
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, drain_timeout: float | None = None) -> bool:
+        """Graceful drain then socket close: new statements are
+        rejected with SERVER_DRAINING the moment this is called;
+        in-flight and already-queued queries get the drain budget
+        (spark.tpu.serve.drainTimeout) to finish — and flush their
+        query profiles — before the listener closes. Returns True when
+        everything quiesced inside the budget."""
+        try:
+            drained = self.service.drain(drain_timeout)
+        except Exception:
+            drained = False
         self._server.shutdown()
         self._server.server_close()
+        return drained
+
+
+def _error_resp(e: Exception) -> dict:
+    resp = {"error": f"{type(e).__name__}: {e}"}
+    ec = getattr(e, "error_class", None)
+    if ec:
+        resp["error_class"] = ec
+    return resp
 
 
 # -- DB-API 2.0 client ------------------------------------------------------
@@ -110,7 +172,13 @@ paramstyle = "format"
 
 
 class Error(Exception):
-    pass
+    """DB-API error; `error_class` carries the server's stable error
+    condition (e.g. SERVER_DRAINING, ADMISSION_TIMEOUT) when one rode
+    the wire."""
+
+    def __init__(self, message: str, error_class: str | None = None):
+        super().__init__(message)
+        self.error_class = error_class
 
 
 class Cursor:
@@ -138,7 +206,7 @@ class Cursor:
             sql = "".join(out)
         resp = self._conn._request({"sql": sql})
         if resp.get("error"):
-            raise Error(resp["error"])
+            raise Error(resp["error"], resp.get("error_class"))
         cols = resp.get("columns", [])
         types = resp.get("types", [])
         self.description = [(c, t, None, None, None, None, None)
@@ -203,6 +271,21 @@ class Connection:
 
     def cursor(self) -> Cursor:
         return Cursor(self)
+
+    def use_shared_session(self) -> None:
+        """Opt this connection into the legacy shared server session
+        (SET / temp views visible across connections)."""
+        resp = self._request({"session": "shared"})
+        if resp.get("error"):
+            raise Error(resp["error"], resp.get("error_class"))
+
+    def server_status(self) -> dict:
+        """Per-pool live serving status (queued/running/rejected,
+        latency percentiles, SLO findings)."""
+        resp = self._request({"status": True})
+        if resp.get("error"):
+            raise Error(resp["error"], resp.get("error_class"))
+        return resp.get("status", {})
 
     def commit(self) -> None:
         pass        # autocommit semantics

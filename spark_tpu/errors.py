@@ -91,5 +91,58 @@ class StageRegenerationLimitError(ExecutionError):
         self.cap = cap
 
 
+class ServingError(SparkTpuError):
+    """Raised by the multi-tenant serving layer (spark_tpu/serve/)."""
+
+    error_class = "SERVING_ERROR"
+
+
+class ServerDraining(ServingError):
+    """The server is shutting down gracefully: in-flight queries are
+    completing, new queries are rejected (role of the reference's
+    HiveThriftServer2 deregistration + session-manager stop — clients
+    should reconnect elsewhere or retry after the restart)."""
+
+    error_class = "SERVER_DRAINING"
+
+    def __init__(self, message: str | None = None):
+        super().__init__(
+            message or "server is draining: in-flight queries are "
+                       "completing, new queries are rejected")
+
+
+class AdmissionTimeout(ServingError):
+    """A query waited in its fair-scheduler pool's queue past the pool's
+    queue timeout without winning a slot (pool saturated or its
+    in-flight HBM reservation never freed enough budget)."""
+
+    error_class = "ADMISSION_TIMEOUT"
+
+    def __init__(self, pool: str, timeout_s: float):
+        super().__init__(
+            f"query admission timed out after {timeout_s:g}s in pool "
+            f"'{pool}' (pool saturated; raise "
+            "spark.tpu.serve.queueTimeout, the pool's weight, or "
+            "spark.tpu.serve.maxConcurrent)")
+        self.pool = pool
+        self.timeout_s = timeout_s
+
+
+class PoolQueueFull(ServingError):
+    """A fair-scheduler pool's bounded admission queue is full — the
+    query is rejected immediately instead of waiting (load shedding;
+    role of the reference's spark.scheduler.* pool backlog limits)."""
+
+    error_class = "POOL_QUEUE_FULL"
+
+    def __init__(self, pool: str, size: int):
+        super().__init__(
+            f"admission queue of pool '{pool}' is full ({size} queued "
+            "queries); rejecting instead of queueing unboundedly — "
+            "raise spark.tpu.serve.queueSize or add capacity")
+        self.pool = pool
+        self.size = size
+
+
 class UnsupportedOperationError(SparkTpuError):
     error_class = "UNSUPPORTED_OPERATION"

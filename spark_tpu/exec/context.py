@@ -34,6 +34,38 @@ class Metrics:
                     "timers": dict(self.timers)}
 
 
+class ScopedMetrics(Metrics):
+    """Per-query view over the session Metrics.
+
+    Every add() lands on BOTH the session-global counters (unchanged
+    behavior: listeners, bench and the gates keep reading cumulative
+    session totals) and a query-local copy, so close-time consumers
+    (query profiles, EXPLAIN ANALYZE counter deltas) read scope-exact
+    per-query deltas instead of process-snapshot differences that
+    concurrent queries on one session would contaminate.
+    snapshot() deliberately stays the SESSION view — existing callers
+    (plan_graph's adaptive baseline) diff session-cumulative counters."""
+
+    def __init__(self, base: Metrics):
+        super().__init__()
+        self.base = base
+
+    def add(self, name: str, v: int = 1) -> None:
+        self.base.add(name, v)
+        super().add(name, v)
+
+    def time(self, name: str):
+        return self.base.time(name)
+
+    def snapshot(self) -> dict:
+        return self.base.snapshot()
+
+    def local_counters(self) -> dict:
+        """This query's own counter increments (scope-exact)."""
+        with self._lock:
+            return dict(self.counters)
+
+
 class _Timer:
     def __init__(self, m: Metrics, name: str):
         self.m = m
@@ -87,6 +119,12 @@ class ExecContext:
     persist_seed: dict | None = field(default=None, repr=False)
     persist_join_caps: list | None = field(default=None, repr=False)
     persist_mesh_quotas: dict | None = field(default=None, repr=False)
+    # per-query kernel ledger (obs/metrics.QueryKernelLedger) installed
+    # by QueryExecution.execute for the execution window: scope-exact
+    # launch/compile deltas under concurrent collects (the contextvar
+    # copy rides into par_map lanes and scoped_submit pools); profiles
+    # and EXPLAIN ANALYZE read this instead of process-snapshot deltas
+    kernel_ledger: object = field(default=None, repr=False)
     # chaos salvage (cluster mode): wasted-work records of failed task
     # attempts whose worker-side obs rode the error payload back
     # (ClusterDAGScheduler._record_failed_attempt) — kept SEPARATE from

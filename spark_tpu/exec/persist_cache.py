@@ -114,6 +114,17 @@ def _on_monitor_event(event: str, **_kw) -> None:
     elif event == "/jax/compilation_cache/cache_misses":
         with _COUNTER_LOCK:
             DISK_MISSES += 1
+    else:
+        return
+    # per-query attribution: the XLA compile runs on the dispatching
+    # thread, so the query-scope contextvar is live here — land the
+    # event on the current query's kernel ledger too (scope-exact
+    # compile.disk_* deltas under concurrent collects; the process
+    # counters above stay the global ground truth)
+    from ..obs.metrics import record_compile_disk_event
+
+    record_compile_disk_event(
+        hit=event == "/jax/compilation_cache/cache_hits")
 
 
 def disk_counters() -> dict:

@@ -163,8 +163,12 @@ class QueryExecution:
         # instead of as an opaque XLA OOM mid-query (obs/resources.py)
         from ..obs.resources import check_memory_budget
 
+        # the serving layer's admission pre-flight (serve/service.py)
+        # already analyzed this plan — reuse its report instead of
+        # paying a second whole-plan analysis on the serving hot path
         check_memory_budget(
             plan, self.session.conf,
+            report=getattr(self, "_preflight_report", None),
             cluster=getattr(self.session, "_sql_cluster", None) is not None)
         # execution always runs under a query scope: collects push one in
         # to_arrow, but direct execute() callers (bench._run_blocked,
@@ -177,8 +181,14 @@ class QueryExecution:
 
             qid = uuid.uuid4().hex[:12]
             eph_token = push_query(qid)
+        from .context import ScopedMetrics
+
+        # ScopedMetrics: every counter this query adds lands on the
+        # session totals (unchanged) AND a query-local copy — profiles
+        # and EXPLAIN ANALYZE then read scope-exact per-query deltas
+        # that concurrent collects cannot contaminate
         ctx = ExecContext(conf=self.session.conf,
-                          metrics=self.session._metrics,
+                          metrics=ScopedMetrics(self.session._metrics),
                           block_manager=getattr(
                               self.session, "block_manager", None),
                           tracer=self._tracer,
@@ -218,7 +228,10 @@ class QueryExecution:
         recorder = None
         if str(self.session.conf.get(  # tpulint: ignore[host-sync]
                 OBS_PROFILE_DIR) or ""):
-            from ..obs.history import recorder_open
+            # close-time deltas come from the per-query kernel ledger
+            # and ScopedMetrics (scope-exact under concurrency); the
+            # snapshots here remain only as the fallback for contexts
+            # without a ledger, plus the wall-clock anchor
             from ..physical.compile import GLOBAL_KERNEL_CACHE as _KC
 
             recorder = {
@@ -228,11 +241,7 @@ class QueryExecution:
                 "disk_hit_compiles": _KC.disk_hit_compiles,
                 "counters": dict(
                     self.session._metrics.snapshot()["counters"]),
-                "t0": time.perf_counter(),
-                # overlap guard: concurrent queries contaminate each
-                # other's process-counter deltas — such profiles are
-                # marked and kept out of regression baselines
-                "guard": recorder_open()}
+                "t0": time.perf_counter()}
         # persistent-cache warm start (exec/persist_cache.py): with a
         # cache dir configured, seed this query's capacity-retry state
         # from the newest same-fingerprint manifest record, and snapshot
@@ -287,19 +296,23 @@ class QueryExecution:
                     live, ctx,
                     interval=float(  # tpulint: ignore[host-sync]
                         self.session.conf.get(PROGRESS_UPDATE_INTERVAL)))
+        # per-query kernel ledger: KernelCache launch/compile events of
+        # this execution window accumulate here through the query-scope
+        # contextvar (copied into par_map lanes / scoped_submit pools),
+        # so concurrent collects on one process read disjoint deltas
+        from ..obs.metrics import (
+            QueryKernelLedger, pop_query_ledger, push_query_ledger,
+        )
+
+        ctx.kernel_ledger = QueryKernelLedger()
+        led_token = push_query_ledger(ctx.kernel_ledger)
         try:
             out = self._timed("execution", lambda: sched.run(plan))
         except Exception:
             discard_pending(ctx.plan_metrics)
-            if recorder is not None:
-                # failed query: no profile, but the overlap-guard
-                # window must still close or every later query would
-                # read as overlapped
-                from ..obs.history import recorder_abort
-
-                recorder_abort(recorder["guard"])
             raise
         finally:
+            pop_query_ledger(led_token)
             if stop_flusher is not None:
                 stop_flusher()
             if live is not None:
@@ -313,11 +326,23 @@ class QueryExecution:
         if persist_on:
             # per-query XLA disk-cache traffic + the warm-start manifest
             # write (capacity outcomes of this run, keyed by the full
-            # plan fingerprint). Never fails the query.
+            # plan fingerprint). Never fails the query. The traffic
+            # deltas come from THIS query's kernel ledger (the monitor
+            # listener fires on the compiling thread, inside the query
+            # scope) — scope-exact under concurrent collects; the
+            # process-snapshot diff remains only as the fallback.
             try:
-                disk_after = _persist.disk_counters()
-                for key in ("compile.disk_hit", "compile.disk_miss"):
-                    d = disk_after[key] - disk_before[key]
+                snap = ctx.kernel_ledger.snapshot() \
+                    if ctx.kernel_ledger is not None else None
+                if snap is not None:
+                    deltas = {"compile.disk_hit": snap["disk_hits"],
+                              "compile.disk_miss": snap["disk_misses"]}
+                else:
+                    disk_after = _persist.disk_counters()
+                    deltas = {key: disk_after[key] - disk_before[key]
+                              for key in ("compile.disk_hit",
+                                          "compile.disk_miss")}
+                for key, d in deltas.items():
                     if d:
                         ctx.metrics.add(key, d)
                 _persist.record_manifest(
@@ -615,6 +640,7 @@ class QueryExecution:
                  for e in forced if e.key in conf.overrides()}
         for e in forced:
             conf.set(e, True)
+        prev_ctx = getattr(self, "_last_ctx", None)
         try:
             if warm:
                 QueryExecution(self.session, self.logical).to_arrow()
@@ -637,24 +663,41 @@ class QueryExecution:
                     conf.set(e, saved[e.key])
                 else:
                     conf.unset(e)
-        after_kinds = dict(KC.launches_by_kind)
-        after_counters = dict(self.session._metrics.snapshot()["counters"])
-        measured = {k: v - before_kinds.get(k, 0)
-                    for k, v in after_kinds.items()
-                    if v != before_kinds.get(k, 0)}
+        ctx = getattr(self, "_last_ctx", None)
+        # a result-cache hit answers without executing: _last_ctx is then
+        # stale (the warm run's, or absent) and the measured deltas fall
+        # back to the zero-launch process snapshot
+        fresh = ctx is not None and ctx is not prev_ctx
+        ledger = getattr(ctx, "kernel_ledger", None) if fresh else None
+        if ledger is not None:
+            # scope-exact per-query deltas: concurrent collects on this
+            # process (a serving workload) cannot contaminate them
+            measured = {k: v for k, v in ledger.snapshot()["kinds"].items()
+                        if v}
+        else:
+            after_kinds = dict(KC.launches_by_kind)
+            measured = {k: v - before_kinds.get(k, 0)
+                        for k, v in after_kinds.items()
+                        if v != before_kinds.get(k, 0)}
         # cluster mode: the measured run's worker processes shipped their
         # own KernelCache deltas back with the stage results — measured
         # launches are DRIVER + WORKER totals, same ground truth the
         # per-operator attribution merge uses
-        wkinds = getattr(getattr(self, "_last_ctx", None),
-                         "worker_kernel_kinds", None)
+        wkinds = getattr(ctx, "worker_kernel_kinds", None) if fresh \
+            else None
         if wkinds:
             for k, v in wkinds.items():
                 measured[k] = measured.get(k, 0) + v
-        counter_deltas = {k: v - before_counters.get(k, 0)
-                          for k, v in after_counters.items()
-                          if v != before_counters.get(k, 0)}
-        ctx = getattr(self, "_last_ctx", None)
+        scoped = getattr(getattr(ctx, "metrics", None), "local_counters",
+                         None) if fresh else None
+        if scoped is not None:
+            counter_deltas = {k: v for k, v in scoped().items() if v}
+        else:
+            after_counters = dict(
+                self.session._metrics.snapshot()["counters"])
+            counter_deltas = {k: v - before_counters.get(k, 0)
+                              for k, v in after_counters.items()
+                              if v != before_counters.get(k, 0)}
         # device-resource view of the measured run: the ledger's
         # per-query record (driver watermarks + worker peaks merged from
         # the shipped task obs) reconciles against the analyzer's

@@ -68,56 +68,22 @@ import json
 import os
 import re
 import statistics
-import threading
 import time
 
 __all__ = ["DETERMINISTIC_COUNTERS", "ProfileStore", "build_profile",
            "close_query_profile", "detect_regressions", "plan_fingerprint",
-           "query_key", "recorder_abort", "recorder_open"]
+           "query_key"]
 
 
-# ---------------------------------------------------------------------------
-# overlap guard
-# ---------------------------------------------------------------------------
-
-# The recorder deltas PROCESS-GLOBAL KernelCache/session counters between
-# query start and close, so two queries recording concurrently on one
-# process read each other's launches into their deltas. Rather than
-# silently storing contaminated counters (which would raise false
-# severity-error regressions and poison the fingerprint's baseline), the
-# guard detects any overlap and marks both profiles `overlapped` — they
-# are stored for forensics but excluded from regression baselines and
-# never regression-checked themselves. Per-query counter isolation is a
-# direction-1 (serving) concern; until then, honesty beats false alarms.
-_ACTIVE_LOCK = threading.Lock()
-_ACTIVE = 0
-_OVERLAP_EPOCH = 0
-
-
-def recorder_open() -> tuple:
-    """Begin one query's recording window. Returns the opaque token for
-    `_recorder_close` (epoch, overlapped-at-open)."""
-    global _ACTIVE, _OVERLAP_EPOCH
-    with _ACTIVE_LOCK:
-        _ACTIVE += 1
-        if _ACTIVE > 1:
-            _OVERLAP_EPOCH += 1
-        return (_OVERLAP_EPOCH, _ACTIVE > 1)
-
-
-def _recorder_close(token) -> bool:
-    """End a recording window; True when another recording query
-    overlapped it at any point."""
-    global _ACTIVE
-    epoch0, overlapped = token
-    with _ACTIVE_LOCK:
-        _ACTIVE = max(_ACTIVE - 1, 0)
-        return overlapped or _OVERLAP_EPOCH != epoch0
-
-
-def recorder_abort(token) -> None:
-    """Failure-path close (the query raised before profiling)."""
-    _recorder_close(token)
+# Concurrency note (PR 15, supersedes the PR 12 overlap guard): profile
+# deltas are no longer process-snapshot differences. Kernel events come
+# from the per-query QueryKernelLedger (obs/metrics.py, carried by a
+# contextvar through par_map lanes and scoped_submit pools) and counter
+# deltas from ExecContext's ScopedMetrics, so two queries collecting
+# concurrently on one process read DISJOINT, exact deltas. Profiles
+# recorded under load are therefore baseline-eligible and
+# regression-checked like any other — the `overlapped` mark and its
+# guard are gone.
 
 
 # ---------------------------------------------------------------------------
@@ -579,11 +545,11 @@ def detect_regressions(fresh: dict, history: list[dict],
     `baseline_n` stored profiles for the same query key. Deterministic
     counters fire severity-`error` findings only on INCREASE (a warm
     run re-using compiles/memos legitimately measures below a cold
-    baseline); wall/HBM drift is advisory `info`. Profiles whose
-    recording window overlapped another query's (contaminated
-    process-counter deltas) never enter the baseline. Returns findings
-    in the EXPLAIN ANALYZE shape ({severity, kind, msg, ...})."""
-    history = [p for p in history if not p.get("overlapped")]
+    baseline); wall/HBM drift is advisory `info`. Profiles recorded
+    under concurrent load are baseline-eligible: their deltas are
+    scope-exact (per-query kernel ledger + ScopedMetrics), not
+    process-snapshot differences. Returns findings in the EXPLAIN
+    ANALYZE shape ({severity, kind, msg, ...})."""
     base = history[-baseline_n:] if baseline_n else list(history)
     if not base:
         return []
@@ -658,43 +624,54 @@ def close_query_profile(qe, ctx, baseline: dict) -> tuple:
     from ..physical.compile import GLOBAL_KERNEL_CACHE as KC
 
     conf = qe.session.conf
-    # close the overlap-guard window FIRST (leaks would mark every
-    # later query overlapped); overlapped deltas are contaminated by
-    # the concurrent query's launches — stored for forensics, excluded
-    # from baselines, never regression-checked
-    overlapped = _recorder_close(baseline["guard"])
     root = str(conf.get(OBS_PROFILE_DIR) or "")  # tpulint: ignore[host-sync]
     if not root:
         return None, []
     wall_s = time.perf_counter() - baseline["t0"]
-    kinds = {k: v - baseline["kinds"].get(k, 0)
-             for k, v in KC.launches_by_kind.items()
-             if v != baseline["kinds"].get(k, 0)}
+    ledger = getattr(ctx, "kernel_ledger", None)
+    if ledger is not None:
+        # scope-exact per-query deltas (obs/metrics.QueryKernelLedger):
+        # concurrent collects on one process cannot contaminate them,
+        # so profiles recorded under load stay baseline-eligible
+        snap = ledger.snapshot()
+        kinds = {k: v for k, v in snap["kinds"].items() if v}
+        compiles = snap["compiles"]
+        compile_ms = snap["compile_ms"]
+        compiles_disk_hit = snap["disk_hit_compiles"]
+    else:
+        # no ledger on the context (direct build callers): fall back to
+        # the recorder's process snapshots — exact only when serial
+        kinds = {k: v - baseline["kinds"].get(k, 0)
+                 for k, v in KC.launches_by_kind.items()
+                 if v != baseline["kinds"].get(k, 0)}
+        compiles = KC.misses - baseline["misses"]
+        compile_ms = KC.compile_ms - baseline["compile_ms"]
+        compiles_disk_hit = KC.disk_hit_compiles \
+            - baseline.get("disk_hit_compiles", 0)
     # cluster mode: worker-process deltas shipped with the task results
     # fold into the same per-kind ledger (driver + worker totals)
     for k, v in (getattr(ctx, "worker_kernel_kinds", None) or {}).items():
         kinds[k] = kinds.get(k, 0) + v
-    counters = qe.session._metrics.snapshot()["counters"]
-    counter_deltas = {k: v - baseline["counters"].get(k, 0)
-                      for k, v in counters.items()
-                      if v != baseline["counters"].get(k, 0)}
+    scoped = getattr(ctx.metrics, "local_counters", None)
+    if scoped is not None:
+        counter_deltas = {k: v for k, v in scoped().items() if v}
+    else:
+        counters = qe.session._metrics.snapshot()["counters"]
+        counter_deltas = {k: v - baseline["counters"].get(k, 0)
+                          for k, v in counters.items()
+                          if v != baseline["counters"].get(k, 0)}
     fingerprint = qe.plan_fingerprint()
     qkey = query_key(qe.optimized, conf)
     profile = build_profile(
         qe, ctx, fingerprint, qkey, wall_s, kinds, counter_deltas,
-        compiles=KC.misses - baseline["misses"],
-        compile_ms=KC.compile_ms - baseline["compile_ms"],
-        compiles_disk_hit=KC.disk_hit_compiles
-        - baseline.get("disk_hit_compiles", 0))
-    if overlapped:
-        profile["overlapped"] = True
-        ctx.metrics.add("obs.profiles_overlapped")
+        compiles=compiles, compile_ms=compile_ms,
+        compiles_disk_hit=compiles_disk_hit)
     store = ProfileStore(root, ring=int(  # tpulint: ignore[host-sync]
         conf.get(OBS_PROFILE_RING)))
     history = store.profiles(qkey)
     store.append(profile)
     findings: list[dict] = []
-    if not overlapped and bool(conf.get(  # tpulint: ignore[host-sync]
+    if bool(conf.get(  # tpulint: ignore[host-sync]
             OBS_PROFILE_REGRESSION)):
         findings = detect_regressions(
             profile, history,

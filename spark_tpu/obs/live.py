@@ -471,6 +471,20 @@ class LiveObs:
             q = self._queries.get(qid)
             return list(q["findings"]) if q is not None else []
 
+    def recent_findings(self, qids, limit: int = 8) -> list[dict]:
+        """Newest findings across a set of query ids — the per-pool SLO
+        view the serving status renders (stragglers, regressions,
+        exclusions raised for the queries a fair-scheduler pool
+        admitted). Pure host bookkeeping."""
+        self.check_stragglers()
+        out: list[dict] = []
+        with self._lock:
+            for qid in qids:
+                q = self._queries.get(qid)
+                if q is not None:
+                    out.extend(q["findings"])
+        return out[-max(int(limit), 0):]
+
     # -- reads ------------------------------------------------------------
     def query_progress(self, qid: str) -> dict | None:
         """In-flight progress of one query: per stage, tasks done/total,

@@ -35,13 +35,16 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-__all__ = ["AnalyzedReport", "batch_cost_scope", "current_op_name",
+__all__ = ["AnalyzedReport", "QueryKernelLedger", "batch_cost_scope",
+           "current_op_name", "current_query_ledger",
            "export_op_records", "export_op_records_partial",
            "finalize_plan_metrics", "fused_members",
            "get_or_create_op_record", "iter_metric_nodes",
            "merge_op_records", "metric_children", "new_op_record",
-           "pop_op", "push_op", "record_kernel_launch",
-           "record_kernel_compile", "scoped_submit"]
+           "pop_op", "pop_query_ledger", "push_op", "push_query_ledger",
+           "record_compile_disk_event", "record_kernel_launch",
+           "record_kernel_compile", "record_kernel_disk_hit",
+           "record_kernel_miss", "scoped_submit"]
 
 
 # ---------------------------------------------------------------------------
@@ -69,6 +72,124 @@ _ATTR_LOCK = threading.Lock()
 # counters stay unscaled: they mirror the cost model's per-launch bytes.
 _BATCH_FRACTION: "contextvars.ContextVar" = contextvars.ContextVar(
     "spark_tpu_batch_fraction", default=None)
+
+
+# ---------------------------------------------------------------------------
+# Per-query kernel ledger: scope-exact launch/compile deltas
+# ---------------------------------------------------------------------------
+
+# The KernelCache counters are PROCESS-global: two queries collecting
+# concurrently on one process read each other's launches into any
+# snapshot-delta they take (the PR 12 `overlapped` limitation). The
+# ledger fixes that at the source: QueryExecution installs one
+# QueryKernelLedger in this contextvar for the execution window, the
+# contextvar follows the work into par_map lanes (copied contexts) and
+# scoped_submit pools, and every KernelCache launch/compile event also
+# lands on the CURRENT query's ledger — so racing queries get disjoint,
+# exact deltas and profiles/EXPLAIN ANALYZE stop needing an overlap
+# guard. Cluster-worker launches are NOT in the ledger (separate
+# processes); they keep shipping per-task deltas that the driver folds
+# per query (ctx.worker_kernel_kinds).
+_QUERY_LEDGER: "contextvars.ContextVar" = contextvars.ContextVar(
+    "spark_tpu_query_ledger", default=None)
+
+
+class QueryKernelLedger:
+    """Per-query accumulator of kernel events (launches by kind, engine
+    compiles, compile wall-ms, disk-served compiles). Pure host
+    bookkeeping; thread-safe because one query's launches arrive from
+    several par_map lanes."""
+
+    __slots__ = ("_lock", "kinds", "launches", "compiles", "compile_ms",
+                 "disk_hit_compiles", "disk_hits", "disk_misses")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.kinds: dict = {}
+        self.launches = 0
+        self.compiles = 0
+        self.compile_ms = 0.0
+        self.disk_hit_compiles = 0
+        # raw XLA persistent-cache traffic of THIS query's compiles
+        # (exec/persist_cache._on_monitor_event) — distinct from
+        # disk_hit_compiles, which counts KERNELS whose first
+        # invocation was disk-served
+        self.disk_hits = 0
+        self.disk_misses = 0
+
+    def _launch(self, kind) -> None:
+        with self._lock:
+            self.kinds[kind] = self.kinds.get(kind, 0) + 1
+            self.launches += 1
+
+    def _compile(self, ms: float) -> None:
+        with self._lock:
+            self.compile_ms += ms
+
+    def _miss(self) -> None:
+        with self._lock:
+            self.compiles += 1
+
+    def _disk_hit(self) -> None:
+        with self._lock:
+            self.disk_hit_compiles += 1
+
+    def _disk_event(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.disk_hits += 1
+            else:
+                self.disk_misses += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"kinds": dict(self.kinds),
+                    "launches": self.launches,
+                    "compiles": self.compiles,
+                    "compile_ms": self.compile_ms,
+                    "disk_hit_compiles": self.disk_hit_compiles,
+                    "disk_hits": self.disk_hits,
+                    "disk_misses": self.disk_misses}
+
+
+def push_query_ledger(ledger: "QueryKernelLedger"):
+    """Enter a query's kernel-ledger scope; returns the reset token."""
+    return _QUERY_LEDGER.set(ledger)
+
+
+def pop_query_ledger(token) -> None:
+    _QUERY_LEDGER.reset(token)
+
+
+def current_query_ledger() -> "QueryKernelLedger | None":
+    return _QUERY_LEDGER.get()
+
+
+def record_kernel_miss(kind) -> None:
+    """Called by KernelCache on every cache miss (= one engine compile:
+    trace + jit). The ledger's `compiles` mirrors what a process-level
+    KC.misses delta would read on a serial run."""
+    led = _QUERY_LEDGER.get()
+    if led is not None:
+        led._miss()
+
+
+def record_kernel_disk_hit(kind) -> None:
+    """Called by KernelCache when a kernel's first invocation was served
+    by the persistent XLA disk cache (exec/persist_cache.py)."""
+    led = _QUERY_LEDGER.get()
+    if led is not None:
+        led._disk_hit()
+
+
+def record_compile_disk_event(hit: bool) -> None:
+    """Called by persist_cache's jax monitoring listener per raw XLA
+    disk-cache hit/miss — the compile runs on the dispatching thread,
+    so the event lands on the compiling query's ledger (scope-exact
+    per-query compile.disk_* deltas under concurrency)."""
+    led = _QUERY_LEDGER.get()
+    if led is not None:
+        led._disk_event(hit)
 
 
 @contextlib.contextmanager
@@ -131,7 +252,11 @@ def record_kernel_launch(kind, cost: dict | None = None) -> None:
     captured per-launch cost (flops / bytes accessed — physical/compile.
     _capture_kernel_cost), multiplied out onto the executing operator's
     record so EXPLAIN ANALYZE can render per-operator FLOPs, bytes and
-    achieved GB/s."""
+    achieved GB/s. Also lands the launch on the current query's kernel
+    ledger (scope-exact per-query deltas under concurrent collects)."""
+    led = _QUERY_LEDGER.get()
+    if led is not None:
+        led._launch(kind)
     scope = _SCOPE.get()
     if scope is None or scope[0] is None:
         return
@@ -155,6 +280,9 @@ def record_kernel_launch(kind, cost: dict | None = None) -> None:
 def record_kernel_compile(kind, ms: float) -> None:
     """Called by KernelCache for builder time and first-invocation (XLA
     lazy compile) time."""
+    led = _QUERY_LEDGER.get()
+    if led is not None:
+        led._compile(ms)
     scope = _SCOPE.get()
     if scope is None or scope[0] is None:
         return

@@ -23,7 +23,9 @@ from ..expr.eval import HostCtx, TraceCtx, Val
 from ..obs.metrics import (
     batch_cost_scope,
     record_kernel_compile as _obs_compile,
+    record_kernel_disk_hit as _obs_disk_hit,
     record_kernel_launch as _obs_launch,
+    record_kernel_miss as _obs_miss,
 )
 from ..expr.expressions import (
     Alias, AttributeReference, Expression, Literal, SortOrder,
@@ -258,10 +260,13 @@ class KernelCache:
                 t0 = _time.perf_counter()
                 out = f(*args, **kwargs)
                 dt = (_time.perf_counter() - t0) * 1000
+                disk_hit = _pc.DISK_HITS > d0
                 with self._lock:
                     self.compile_ms += dt
-                    if _pc.DISK_HITS > d0:
+                    if disk_hit:
                         self.disk_hit_compiles += 1
+                if disk_hit:
+                    _obs_disk_hit(kind)
                 _obs_compile(kind, dt)
                 return out
             return f(*args, **kwargs)
@@ -277,6 +282,9 @@ class KernelCache:
                 self._cache.move_to_end(key)
                 return f
             self.misses += 1
+        # per-query ledger: one engine compile attributed to the query
+        # whose dispatch built this kernel (obs/metrics.py)
+        _obs_miss(key[0] if isinstance(key, tuple) and key else "?")
         if _faults.ENABLED:
             # chaos seam: a compile-time failure (trace/lower bug, XLA
             # compiler fault) — fired on the MISS path only, cached

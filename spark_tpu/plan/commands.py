@@ -345,7 +345,16 @@ def run_command(session, cmd: Command):
         if isinstance(cmd, DropVariableCommand):
             if key not in varstore and not cmd.if_exists:
                 raise AnalysisException(f"variable {cmd.name} not found")
-            varstore.pop(key, None)
+            removed = varstore.pop(key, None) is not None
+            if not removed and key in varstore:
+                # session-clone scope (ChainMap): pop only touches the
+                # connection-local layer, so a variable still visible
+                # after it lives on the SERVER session — reporting
+                # success for a drop that removed nothing would lie
+                raise AnalysisException(
+                    f"variable {cmd.name} is declared on the server "
+                    "session and cannot be dropped from a connection "
+                    "session")
             return df_of(pa.table({"variable": pa.array([cmd.name])}))
         if isinstance(cmd, SetVariableCommand) and key not in varstore:
             raise AnalysisException(

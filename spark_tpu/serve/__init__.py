@@ -1,0 +1,17 @@
+"""Multi-tenant query serving: fair-scheduler pools, admission queues,
+session isolation (ROADMAP direction 1 — "from engine to service").
+
+Layers over the existing session/scheduler/obs/persist-cache stack:
+per-connection cloned sessions (api/session.TpuSession.newSession)
+share the process KernelCache, warehouse catalog and persistent caches
+while keeping SET/temp views connection-local; weighted fair-scheduler
+pools (pools.FairScheduler) queue and admit queries with plan-time HBM
+reservations; QueryService (service.py) ties both to SQL execution and
+graceful drain; loadgen.run_serve_load drives the measurable proof.
+The SQL endpoint (connect/sql_endpoint.py) is the wire surface.
+"""
+
+from .pools import FairScheduler, PoolConfig, pool_configs
+from .service import QueryService
+
+__all__ = ["FairScheduler", "PoolConfig", "QueryService", "pool_configs"]

@@ -1,0 +1,148 @@
+"""Multi-tenant query service: session routing + fair-pool admission.
+
+The serving brain shared by the SQL endpoint (connect/sql_endpoint.py),
+`bench.py --serve`, and the `--serve` CI gate
+(dev/validate_trace.py). Role of the reference's
+SparkSQLSessionManager + SparkSQLOperationManager over a shared
+SparkContext (sql/hive-thriftserver): many logical sessions, one
+engine process — here with weighted fair-scheduler pools and plan-time
+HBM admission layered in front of execution.
+
+One QueryService wraps one long-lived "server" TpuSession:
+
+  * `open_session()` clones a per-connection session
+    (TpuSession.newSession — connection-local SET/temp views, shared
+    KernelCache/warehouse/persistent caches/cluster) or hands back the
+    shared server session when `spark.tpu.serve.sessionMode=shared`
+    (or the caller asks for "shared").
+
+  * `execute_sql()` / `collect()` run a statement: parsing, analysis,
+    planning and the admission decision happen on the calling thread
+    (pure host work, zero launches), then the collect executes inside
+    the session's fair-scheduler pool slot. With an HBM budget
+    configured the plan analyzer's predicted peak is pre-flighted
+    through the existing `check_memory_budget` path AND reserved
+    against the aggregate in-flight budget — an over-budget query
+    fails plan-time, a momentarily-unfittable one queues. Admitted
+    queries execute exactly as they would without the serving layer.
+
+  * `drain()` starts graceful shutdown: new statements raise
+    ServerDraining, in-flight (and already-queued) queries finish and
+    flush their query profiles, then the call returns.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..config import (
+    MEMORY_BUDGET, SERVE_DRAIN_TIMEOUT, SERVE_POOL, SERVE_SESSION_MODE,
+)
+from ..errors import ServerDraining
+from .pools import FairScheduler, pool_configs
+
+__all__ = ["QueryService"]
+
+
+class QueryService:
+    def __init__(self, session):
+        self.session = session
+        self.scheduler = FairScheduler(session.conf)
+        self._lock = threading.Lock()
+        self.sessions_opened = 0
+
+    # -- sessions ---------------------------------------------------------
+    def open_session(self, mode: str | None = None):
+        """A session for one connection/tenant: a clone by default, the
+        shared server session when the server (or this caller) opts
+        into 'shared'."""
+        if self.scheduler.draining:
+            raise ServerDraining()
+        mode = mode or str(self.session.conf.get(SERVE_SESSION_MODE))
+        with self._lock:
+            self.sessions_opened += 1
+        if mode == "shared":
+            return self.session
+        return self.session.newSession()
+
+    # -- execution --------------------------------------------------------
+    def _predicted_hbm(self, qe, conf) -> int:
+        """Plan-time HBM reservation for admission (zero launches). Only
+        computed when some budget is configured — otherwise the analyzer
+        is skipped entirely and admission is slot-only."""
+        budget = int(conf.get(MEMORY_BUDGET))
+        if budget <= 0 and not any(p.hbm_budget
+                                   for p in pool_configs(conf).values()):
+            return 0
+        report = qe.analysis_report()
+        # same pre-flight execute() would run — but HERE, before the
+        # query ever queues, so an over-budget plan rejects immediately
+        # with the named stage instead of waiting out a queue slot
+        from ..obs.resources import check_memory_budget
+
+        check_memory_budget(
+            qe.physical, conf, report=report,
+            cluster=getattr(qe.session, "_sql_cluster", None) is not None)
+        # hand the report to execute()'s own pre-flight so the serving
+        # hot path analyzes each plan ONCE, not twice
+        qe._preflight_report = report
+        return int(report.predicted_peak_hbm or 0)
+
+    def collect(self, session, df, pool: str | None = None,
+                timeout: float | None = None):
+        """Admit one DataFrame collect through the session's pool."""
+        if self.scheduler.draining:
+            raise ServerDraining()
+        qe = df.query_execution
+        conf = session.conf
+        hbm = self._predicted_hbm(qe, conf)
+        if pool is None:
+            pool = str(conf.get(SERVE_POOL) or "default")
+        ticket = self.scheduler.submit(pool, hbm=hbm)
+        self.scheduler.wait(ticket, timeout=timeout)
+        try:
+            table = df.toArrow()
+            ctx = getattr(qe, "_last_ctx", None)
+            if ctx is not None:
+                self.scheduler.note_query(
+                    ticket, getattr(ctx, "query_id", None))
+            return table
+        finally:
+            self.scheduler.release(ticket)
+
+    def execute_sql(self, session, sql: str):
+        """One SQL statement for one session. Commands and other
+        host-only statements (their result is a bare local relation —
+        SET, DDL, SHOW) return without admission; real queries collect
+        inside the session's pool slot."""
+        if self.scheduler.draining:
+            raise ServerDraining()
+        out = session.sql(sql)
+        if out is None or not hasattr(out, "toArrow"):
+            return out
+        from ..plan.logical import LocalRelation
+
+        if isinstance(getattr(out, "plan", None), LocalRelation):
+            # command result: already materialized host metadata
+            return out.toArrow()
+        return self.collect(session, out)
+
+    # -- lifecycle / status -----------------------------------------------
+    def drain(self, timeout: float | None = None) -> bool:
+        """Graceful shutdown: reject new queries (ServerDraining), let
+        in-flight and already-queued queries finish — their close-time
+        query profiles flush as part of normal query close — and
+        return True when everything quiesced inside the timeout."""
+        if timeout is None:
+            timeout = float(self.session.conf.get(SERVE_DRAIN_TIMEOUT))
+        self.scheduler.drain()
+        return self.scheduler.quiesce(timeout)
+
+    def status(self) -> dict:
+        """Per-pool live serving status incl. SLO findings from the
+        live store (stragglers/regressions of each pool's recent
+        queries)."""
+        st = self.scheduler.status(
+            live_obs=getattr(self.session, "live_obs", None))
+        st["sessions_opened"] = self.sessions_opened
+        return st
