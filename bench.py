@@ -109,6 +109,19 @@ WHOLE_QUERY = "--whole-query" in sys.argv
 if WHOLE_QUERY:
     sys.argv = [a for a in sys.argv if a != "--whole-query"]
 
+# --mesh-whole: add the mesh whole-query compilation config
+# (physical/mesh_whole.py): the ENTIRE sharded star-join+agg plan —
+# leaves, in-program all-to-alls, join build+probe, partial and final
+# aggregate — as ONE shard_map dispatch per execution step
+# (spark.tpu.compile.tier=mesh-whole) vs the single-device whole tier
+# and the per-stage tier. Reports dispatches-per-query for all three
+# tiers, the tier speedups, and the donated vs undonated leaf-plane HBM
+# watermark. Needs >=4 jax devices; `python bench.py mesh_whole` also
+# selects it directly.
+MESH_WHOLE = "--mesh-whole" in sys.argv
+if MESH_WHOLE:
+    sys.argv = [a for a in sys.argv if a != "--mesh-whole"]
+
 # --serve-restart: measure the persistent-cache restart story
 # (spark_tpu/exec/persist_cache.py): run the smoke query set in a child
 # process with spark.tpu.cache.dir pointed at a scratch dir (cold leg),
@@ -831,6 +844,125 @@ def bench_whole_query():
     }
 
 
+def bench_mesh_whole():
+    """Mesh whole-query compilation scoreboard: the q3-shaped star join
+    (fact scan -> filter -> two dim joins -> hash repartition -> group-by
+    sum) executed as ONE shard_map program over the device mesh per step
+    (spark.tpu.compile.tier=mesh-whole: leaves staged sharded, exchanges
+    lowered to in-program all-to-alls, join and aggregate folded in
+    behind the collectives) vs the single-device whole tier and the
+    per-stage tier. vs_baseline is the speedup over the stage tier; the
+    record carries measured dispatches-per-query for all three tiers and
+    the donated vs undonated leaf-plane HBM watermark."""
+    import gc
+
+    import jax
+    import pyarrow as pa
+
+    import spark_tpu.api.functions as F
+    from spark_tpu.obs.resources import GLOBAL_LEDGER
+    from spark_tpu.parallel import mesh_fusion as MF
+    from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+    ndev = len(jax.devices())
+    if ndev < 4:
+        return {"metric": "mesh whole-query SKIPPED (needs >=4 devices)",
+                "value": 0, "unit": "status", "vs_baseline": 1.0}
+    P = 8 if ndev >= 8 else 4
+    n_rows = int(10_000_000 * SCALE)
+    session = _session({"spark.tpu.batch.capacity": 1 << 22,
+                        "spark.tpu.fusion.minRows": "0",
+                        "spark.sql.shuffle.partitions": P})
+    rng = np.random.default_rng(23)
+    n_dim = 2048
+    fact = pa.table({
+        "date_sk": rng.integers(0, n_dim, n_rows).astype(np.int64),
+        "item_sk": rng.integers(0, n_dim, n_rows).astype(np.int64),
+        "price": rng.integers(0, 10_000, n_rows).astype(np.int64),
+    })
+    dates = pa.table({
+        "d_date_sk": np.arange(n_dim, dtype=np.int64),
+        "d_year": (1998 + (np.arange(n_dim) // 366)).astype(np.int64),
+        "d_moy": (1 + np.arange(n_dim) % 12).astype(np.int64),
+    })
+    items = pa.table({
+        "i_item_sk": np.arange(n_dim, dtype=np.int64),
+        "i_brand_id": (np.arange(n_dim) % 37).astype(np.int64),
+        "i_manufact_id": (np.arange(n_dim) % 100).astype(np.int64),
+    })
+    _df_from_table(session, fact, "mwq_fact") \
+        .createOrReplaceTempView("mwq_fact")
+    _df_from_table(session, dates, "mwq_dates") \
+        .createOrReplaceTempView("mwq_dates")
+    _df_from_table(session, items, "mwq_items") \
+        .createOrReplaceTempView("mwq_items")
+    sql = ("select d_year, i_brand_id, price from mwq_fact "
+           "join mwq_dates on date_sk = d_date_sk "
+           "join mwq_items on item_sk = i_item_sk "
+           "where d_moy = 11 and i_manufact_id = 28")
+
+    def q():
+        return (session.sql(sql).repartition(P, "i_brand_id")
+                .groupBy("d_year", "i_brand_id")
+                .agg(F.sum("price").alias("s")))
+
+    session.conf.set("spark.tpu.compile.tier", "mesh-whole")
+    _maybe_analyze(q, "mesh_whole")  # the mesh launch + retry model
+    results = {}
+    dispatches = {}
+    for tier in ("mesh-whole", "whole", "stage"):
+        session.conf.set("spark.tpu.compile.tier", tier)
+        q().toArrow()  # warm: compile the tier's programs
+        before = KC.launches
+        q().toArrow()
+        dispatches[tier] = KC.launches - before
+        results[tier] = _best_of(lambda: _run_blocked(q()))
+
+    session.conf.set("spark.tpu.compile.tier", "mesh-whole")
+
+    def hbm_window():
+        gc.collect()
+        GLOBAL_LEDGER.begin_window()
+        _run_blocked(q())
+        return GLOBAL_LEDGER.window_peak()
+
+    donate_was = MF.DONATE_DEFAULT
+    try:
+        MF.DONATE_DEFAULT = False
+        _run_blocked(q())  # compile the undonated oracle program
+        peak_undonated = hbm_window()
+        MF.DONATE_DEFAULT = True
+        _run_blocked(q())
+        peak_donated = hbm_window()
+    finally:
+        MF.DONATE_DEFAULT = donate_was
+    session.conf.unset("spark.tpu.compile.tier")
+
+    best_m = results["mesh-whole"]
+    rate = n_rows / best_m
+    return {
+        "metric": "mesh whole-query compilation: q3-shaped star join+agg "
+                  f"{n_rows:.0e} fact rows as ONE shard_map dispatch per "
+                  f"step over {P} devices (spark.tpu.compile.tier="
+                  "mesh-whole; vs_baseline = speedup over the per-stage "
+                  "tier)",
+        "value": round(rate / 1e6, 2),
+        "unit": "M rows/s",
+        "vs_baseline": round(results["stage"] / best_m, 3),
+        **_hbm_fields("mesh_whole", best_m, n_rows * 24),
+        "dispatches_per_query_mesh_whole": int(dispatches["mesh-whole"]),
+        "dispatches_per_query_whole": int(dispatches["whole"]),
+        "dispatches_per_query_stage": int(dispatches["stage"]),
+        "speedup_vs_whole": round(results["whole"] / best_m, 3),
+        "hbm_peak_donated": peak_donated,
+        "hbm_peak_undonated": peak_undonated,
+        "donated_hbm_saving": peak_undonated - peak_donated,
+        "wall_ms_mesh_whole": round(best_m * 1e3, 1),
+        "wall_ms_whole": round(results["whole"] * 1e3, 1),
+        "wall_ms_stage": round(results["stage"] * 1e3, 1),
+    }
+
+
 # --------------------------------------------------------------------------
 # #4/#5 TPC-DS q3 / q7 / q19 wall-clock at SF1-equivalent volume
 # --------------------------------------------------------------------------
@@ -1236,6 +1368,7 @@ CONFIGS = {
     "mesh": bench_mesh,
     "encoded": bench_encoded,
     "whole_query": bench_whole_query,
+    "mesh_whole": bench_mesh_whole,
     "serve_restart": bench_serve_restart,
     "serve": bench_serve,
     "tpcds": bench_tpcds,
@@ -1274,6 +1407,7 @@ def _fallback_to_cpu_child() -> int:
                              ("--mesh", MESH),
                              ("--encoded", ENCODED),
                              ("--whole-query", WHOLE_QUERY),
+                             ("--mesh-whole", MESH_WHOLE),
                              ("--serve-restart", SERVE_RESTART),
                              ("--serve", SERVE)) if on]
     try:  # stdout inherited: child lines flush straight to the driver
@@ -1317,6 +1451,7 @@ def main() -> int:
                and (MESH or c != "mesh")       # mesh config is opt-in
                and (ENCODED or c != "encoded")  # encoded too
                and (WHOLE_QUERY or c != "whole_query")  # and whole-query
+               and (MESH_WHOLE or c != "mesh_whole")   # and mesh-whole
                and (SERVE_RESTART or c != "serve_restart")  # and restart
                and (SERVE or c != "serve")]  # and the serving load test
     only = sys.argv[1:] or default

@@ -42,6 +42,12 @@ echo "== whole-query gate (one jitted program per step, 3-tier differential) =="
 JAX_PLATFORMS=cpu python dev/validate_trace.py --whole-query
 python bench.py --smoke --whole-query whole_query
 
+echo "== mesh whole-query gate (entire sharded plan as ONE shard_map program) =="
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python dev/validate_trace.py --mesh-whole
+JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    python bench.py --smoke --mesh-whole mesh_whole
+
 echo "== chaos gate (fault injection: retry/exclusion/degrade, fixed seed) =="
 JAX_PLATFORMS=cpu python dev/validate_trace.py --chaos
 

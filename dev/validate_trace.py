@@ -101,9 +101,20 @@ Four checks, all hard failures:
     verifying clean. Self-contained: `validate_trace.py --serve`
     with no trace path runs only this gate.
 
+11. Mesh whole-query gate (--mesh-whole) — on a virtual 8-device CPU
+    mesh, a repartitioned join+agg under spark.tpu.compile.tier=
+    mesh-whole must execute the ENTIRE sharded plan as ONE shard_map
+    dispatch per step (exchanges as in-program all-to-alls, join and
+    aggregate folded behind the collectives), agree with the whole and
+    stage tiers, have its mesh_whole launch count — including a skew-
+    driven quota-retry round — predicted EXACTLY by plan_lint, surface
+    the tier decision on report and span, and leave the device ledger
+    balanced. Self-contained: `validate_trace.py --mesh-whole` with no
+    trace path runs only this gate.
+
 Usage: python dev/validate_trace.py [--cluster] [--live] [--mesh]
-       [--encoded] [--whole-query] [--chaos] [--profile] [--serve]
-       [<trace.json>]
+       [--encoded] [--whole-query] [--mesh-whole] [--chaos]
+       [--profile] [--serve] [<trace.json>]
 """
 
 import json
@@ -708,6 +719,132 @@ def whole_query_gate() -> None:
         print("validate_trace: whole-query gate OK — 3 tiers agree, "
               f"{sum(expected.values())} dispatch(es) per step predicted "
               "exactly, tier decision surfaced, zero drift")
+    finally:
+        session.stop()
+
+
+def mesh_whole_gate() -> None:
+    """Mesh whole-query gate (--mesh-whole, virtual 8-device CPU mesh):
+    the ENTIRE sharded join+agg plan — leaves, in-program all-to-alls,
+    join build+probe, partial and final aggregate — must execute as ONE
+    shard_map dispatch per step under spark.tpu.compile.tier=mesh-whole,
+    with (1) results identical to the whole and stage tiers, (2) the
+    mesh_whole launch count predicted EXACTLY by plan_lint including a
+    quota-doubling retry round on a skewed key, (3) the tier decision
+    surfaced on the report and the execution span, and (4) the device
+    ledger balanced. Self-contained: no trace path required."""
+    import jax
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu import TpuSession
+    from spark_tpu.obs.resources import GLOBAL_LEDGER
+    from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE as KC
+
+    if len(jax.devices()) < 4:
+        fail("--mesh-whole: needs >=4 virtual devices (run with "
+             "JAX_PLATFORMS=cpu "
+             "XLA_FLAGS=--xla_force_host_platform_device_count=8)")
+    session = TpuSession("mesh-whole-gate", {
+        "spark.tpu.batch.capacity": 1 << 12,
+        "spark.sql.shuffle.partitions": 4,
+        "spark.tpu.fusion.minRows": "0",
+    })
+    try:
+        rng = np.random.default_rng(41)
+        n, nd = 9000, 700
+        session.createDataFrame(pa.table({
+            "item_sk": rng.integers(0, nd, n),
+            "price": rng.integers(0, 1000, n),
+        })).createOrReplaceTempView("mwg_fact")
+        session.createDataFrame(pa.table({
+            "i_item_sk": np.arange(nd, dtype=np.int64),
+            "i_brand_id": (np.arange(nd) % 37),
+        })).createOrReplaceTempView("mwg_items")
+
+        def q():
+            return (session.sql(
+                "select item_sk, price, i_brand_id from mwg_fact "
+                "join mwg_items on item_sk = i_item_sk "
+                "where price > 100")
+                .repartition(4, "i_brand_id")
+                .groupBy("i_brand_id").count())
+
+        outs = {}
+        for tier in ("mesh-whole", "whole", "stage"):
+            session.conf.set("spark.tpu.compile.tier", tier)
+            outs[tier] = (q().toPandas().sort_values("i_brand_id")
+                          .reset_index(drop=True))
+        for tier in ("whole", "stage"):
+            if not outs["mesh-whole"].equals(outs[tier]):
+                fail(f"--mesh-whole: mesh-tier results differ from the "
+                     f"{tier} tier (sharded lowering changed answers)")
+
+        session.conf.set("spark.tpu.compile.tier", "mesh-whole")
+        report = q().query_execution.analysis_report()
+        if not report.exact:
+            fail("--mesh-whole: mesh tier not exactly predicted: "
+                 f"{report.inexact_reasons}")
+        if (report.tier or {}).get("tier") != "mesh-whole":
+            fail("--mesh-whole: tier decision missing from the analysis "
+                 f"report: {report.tier}")
+        expected = report.predicted_launches
+        if set(expected) != {"mesh_whole"}:
+            fail(f"--mesh-whole: predicted kinds {expected} — per-stage "
+                 "kernels leaked out of the single sharded program")
+        q().toArrow()  # warm
+        before = dict(KC.launches_by_kind)
+        q().toArrow()
+        measured = {k: v - before.get(k, 0)
+                    for k, v in KC.launches_by_kind.items()
+                    if v != before.get(k, 0)}
+        if measured != expected:
+            fail(f"--mesh-whole: measured {measured} != predicted "
+                 f"{expected} — the one-dispatch-per-step guarantee "
+                 "regressed")
+
+        # skewed key: one destination shard overflows its exchange quota
+        # — the in-program overflow scalar doubles it and the WHOLE
+        # program re-dispatches, and the analyzer mirrors the round
+        skew = np.zeros(4000, dtype=np.int64)
+        skew[:32] = np.arange(32)
+        session.createDataFrame(pa.table({
+            "sk": skew, "sv": np.arange(4000),
+        })).createOrReplaceTempView("mwg_skew")
+
+        def qs():
+            return (session.sql("select * from mwg_skew")
+                    .repartition(4, "sk").groupBy("sk").count())
+
+        rep_s = qs().query_execution.analysis_report()
+        if rep_s.predicted_launches.get("mesh_whole", 0) < 2:
+            fail("--mesh-whole: the analyzer never predicted the skew "
+                 f"quota-retry round: {rep_s.predicted_launches}")
+        qs().toArrow()  # warm (retry rounds recur per fresh execution)
+        before = dict(KC.launches_by_kind)
+        qs().toArrow()
+        measured = {k: v - before.get(k, 0)
+                    for k, v in KC.launches_by_kind.items()
+                    if v != before.get(k, 0)}
+        if measured != rep_s.predicted_launches:
+            fail(f"--mesh-whole: skew retry measured {measured} != "
+                 f"predicted {rep_s.predicted_launches}")
+
+        tier_spans = [s for s in session.tracer.spans()
+                      if s and s[0] == "whole_query.program"
+                      and (s[6] or {}).get("tier") == "mesh-whole"]
+        if not tier_spans:
+            fail("--mesh-whole: tier decision not visible in spans (no "
+                 "whole_query.program span with args.tier=mesh-whole)")
+        bad = GLOBAL_LEDGER.verify()
+        if bad:
+            fail("--mesh-whole: device ledger failed verification after "
+                 f"the mesh whole-query runs: {bad[:3]}")
+        session.conf.unset("spark.tpu.compile.tier")
+        print("validate_trace: mesh-whole gate OK — 3 tiers agree, "
+              f"{sum(expected.values())} sharded dispatch(es) per step "
+              "and the skew retry round predicted exactly, ledger "
+              "balanced")
     finally:
         session.stop()
 
@@ -1391,16 +1528,18 @@ def main(argv=None) -> int:
     mesh = "--mesh" in argv
     encoded = "--encoded" in argv
     whole = "--whole-query" in argv
+    mesh_whole = "--mesh-whole" in argv
     chaos = "--chaos" in argv
     profile = "--profile" in argv
     persist = "--persist" in argv
     serve = "--serve" in argv
     argv = [a for a in argv if a not in ("--cluster", "--live", "--mesh",
                                          "--encoded", "--whole-query",
+                                         "--mesh-whole",
                                          "--chaos", "--profile",
                                          "--persist", "--serve")]
-    if (mesh or encoded or whole or chaos or profile or persist
-            or serve) and not argv:
+    if (mesh or encoded or whole or mesh_whole or chaos or profile
+            or persist or serve) and not argv:
         # self-contained legs: these gates generate and validate their
         # own state (dev/run_all.sh runs them without a trace file)
         if mesh:
@@ -1409,6 +1548,8 @@ def main(argv=None) -> int:
             encoded_gate()
         if whole:
             whole_query_gate()
+        if mesh_whole:
+            mesh_whole_gate()
         if chaos:
             chaos_gate()
         if profile:
@@ -1433,6 +1574,8 @@ def main(argv=None) -> int:
         encoded_gate()
     if whole:
         whole_query_gate()
+    if mesh_whole:
+        mesh_whole_gate()
     if chaos:
         chaos_gate()
     if profile:

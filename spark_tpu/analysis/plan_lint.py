@@ -626,9 +626,13 @@ class _Analyzer:
             BroadcastExchangeExec, ShuffleExchangeExec,
         )
         from ..physical.fusion import FusedAggregateExec, FusedLimitExec
+        from ..physical.mesh_whole import MeshWholeQueryExec
         from ..physical.python_eval import PythonEvalExec
         from ..physical.whole_query import WholeQueryExec
 
+        # MeshWholeQueryExec subclasses WholeQueryExec: route it first
+        if isinstance(node, MeshWholeQueryExec):
+            return self._mesh_whole(node)
         if isinstance(node, WholeQueryExec):
             return self._whole_query(node)
         if isinstance(node, PythonEvalExec):
@@ -1886,32 +1890,24 @@ class _Analyzer:
                         else None, notes)
             return _Flow([[_Batch(None, None, False, seeded=True)]
                           for _ in range(num_out)], None, counted=False)
-        dict_keys = any(isinstance(getattr(e, "dtype", None), StringType)
-                        for e in p.exprs)
-        fused_mesh = fused and self._fusion_mesh and not (fused
-                                                          and dict_keys)
+        fused_mesh = fused and self._fusion_mesh
         if fused and not fused_mesh:
             if child.counted:
                 kinds["pipeline"] += child.total_batches
             else:
                 self._approx("mesh pipeline materialization count depends "
                              "on an unknown upstream batch count")
-            if dict_keys and self._fusion_mesh:
-                notes.append("dictionary-encoded partition keys on the "
-                             "mesh path: pipeline materializes per batch, "
-                             "the plain stage hashes staged eq-key planes "
-                             "(dict-hash lut aux planes in the shard_map "
-                             "program are a recorded follow-on)")
-            else:
-                notes.append("mesh fallback (spark.tpu.fusion.mesh=false): "
-                             "the fused map side materializes the pipeline "
-                             "per batch before the all-to-all")
+            notes.append("mesh fallback (spark.tpu.fusion.mesh=false): "
+                         "the fused map side materializes the pipeline "
+                         "per batch before the all-to-all")
         if fused_mesh:
             notes.append("FUSED mesh stage: pipeline + partition ids + "
                          "all-to-all compiled as ONE shard_map program — "
                          "1 sharded dispatch per step, send buffers "
                          "donated (spark.tpu.fusion.minRows does not "
-                         "apply: one program per step, not per batch)")
+                         "apply: one program per step, not per batch; "
+                         "dictionary-encoded keys hash through replicated "
+                         "codes→value-hash lut aux planes)")
         else:
             notes.append("mesh SPMD stage: ONE sharded dispatch "
                          "redistributes the staged batches")
@@ -2450,11 +2446,18 @@ class _Analyzer:
         # (exec/persist_cache.py manifest, same lookup the runtime
         # performs) pre-populates the list — the seeded first attempt is
         # the prior run's FINAL program, so its retry rounds collapse.
-        seed_caps = (self._persist_seed_record() or {}).get("join_caps") \
-            or ()
+        seed_rec = self._persist_seed_record() or {}
+        seed_caps = seed_rec.get("join_caps") or ()
         caps_state: dict[int, int] = {i: int(c)
                                       for i, c in enumerate(seed_caps)}
-        round_state = {"seq": 0, "overflow": []}
+        # dense direct-address probe state (warm-start span seed): which
+        # joins compile the dense 1:1 variant up front, and which turned
+        # it off after the in-program guard fired — one retry round each,
+        # exactly the runtime's dense_off escalation
+        spans_seed = seed_rec.get("join_spans") or None
+        dense_off: set = set()
+        dense_used = [False]
+        round_state = {"seq": 0, "overflow": [], "guards": []}
 
         def mem(n, cap, extra_planes: int = 0):
             try:
@@ -2547,6 +2550,23 @@ class _Analyzer:
                 jid = round_state["seq"]
                 round_state["seq"] += 1
                 out_cap = caps_state.setdefault(jid, max(pcap, 1 << 10))
+                dense = self._whole_dense_span(jid, bcap, spans_seed,
+                                               dense_off) \
+                    if self._whole_dense_eligible(n) else None
+                if dense is not None:
+                    # dense direct-address probe (runtime _join_dense):
+                    # 1:1 with the probe plane, no expansion buffer —
+                    # the join cap never binds, but the in-program span/
+                    # dup guard may disable it for the next round
+                    dense_used[0] = True
+                    guard, out_tr = self._whole_dense_mirror(
+                        n, ptr, btr, *dense)
+                    if guard is None:
+                        untraced[0] = True
+                    elif guard:
+                        round_state["guards"].append(jid)
+                    mem(n, pcap)
+                    return pcap, out_tr
                 needed = self._whole_join_needed(n, ptr, btr)
                 if needed is None:
                     untraced[0] = True
@@ -2586,12 +2606,16 @@ class _Analyzer:
             attempts += 1
             round_state["seq"] = 0
             round_state["overflow"] = []
+            round_state["guards"] = []
             hbm[0] = 0
             out_cap, out_tr = walk(node.plan)
-            if untraced[0] or not round_state["overflow"]:
+            if untraced[0] or not (round_state["overflow"]
+                                   or round_state["guards"]):
                 break
             for jid, newcap in round_state["overflow"]:
                 caps_state[jid] = newcap
+            for jid in round_state["guards"]:
+                dense_off.add(jid)
         if untraced[0]:
             self._approx("whole-query join output capacity untraced (key "
                          "values outside the traced language): retry "
@@ -2600,8 +2624,14 @@ class _Analyzer:
             notes.append(
                 f"{attempts - 1} capacity "
                 f"retr{'y' if attempts == 2 else 'ies'}: a join "
-                "overflowed its output bucket and the whole program "
-                "re-dispatched with the bumped capacity")
+                "overflowed its output bucket (or a dense-probe guard "
+                "fired) and the whole program re-dispatched with the "
+                "bumped capacity")
+        if dense_used[0]:
+            notes.append("dense direct-address probe compiled up front "
+                         "from the warm-start key-span seed (1:1 with "
+                         "the probe plane, no expansion buffer), "
+                         "guarded in-program")
         kinds["whole_query"] = attempts
         notes.insert(0, "WHOLE-QUERY program: all stages in ONE jitted "
                         "dispatch per step — exchanges lowered to "
@@ -2734,6 +2764,629 @@ class _Analyzer:
                                 None if bvv is None else bvv[pick]))
         return _Trace(cols, np.ones(total, bool), True,
                       dict(ptr.dict_domains), False)
+
+    def _whole_dense_eligible(self, node) -> bool:
+        """Mirror of whole_query._dense_eligible: single plain
+        integral/date equi-key on both sides with the dense fast path
+        enabled — the shape that CAN compile the direct-address probe."""
+        if len(node.left_keys) != 1 or len(node.right_keys) != 1:
+            return False
+        if not self._dense_keys:
+            return False
+        return all(isinstance(k.dtype, (IntegralType, DateType))
+                   for k in (node.left_keys[0], node.right_keys[0]))
+
+    @staticmethod
+    def _whole_dense_span(join_id, build_cap, spans_seed, dense_off):
+        """Mirror of whole_query._dense_span: the seeded [lo, hi] span
+        when the manifest proves last run's build keys were unique and
+        dense enough (and an in-program guard hasn't disabled it)."""
+        if spans_seed is None or join_id in dense_off:
+            return None
+        if join_id >= len(spans_seed):
+            return None
+        sp = spans_seed[join_id]
+        if not sp or len(sp) < 3 or not int(sp[2]):
+            return None
+        lo, hi = int(sp[0]), int(sp[1])
+        span = hi - lo + 1
+        if span <= 0 or span > min(8 * build_cap, 1 << 23):
+            return None
+        return lo, hi
+
+    def _whole_dense_mirror(self, node, ptr, btr, lo, hi):
+        """(guard fired, output trace) of whole_query._join_dense — the
+        faithful value mirror INCLUDING the drift modes: when the guard
+        fires, the round's runtime output is the drifted dense result
+        (out-of-span matches missing, duplicate keys last-writer), and
+        downstream verdicts of that failed round observe exactly it.
+        (None, None) when the keys are outside the traced language."""
+        if ptr is None or btr is None:
+            return None, None
+        pent = ptr.cols.get(node.left_keys[0].expr_id)
+        bent = btr.cols.get(node.right_keys[0].expr_id)
+        if pent is None or bent is None:
+            return None, None
+        tcap = bucket_capacity(hi - lo + 1)
+        bv_, bvv_ = bent
+        blive = btr.live if bvv_ is None else (btr.live & bvv_)
+        bk = bv_.astype(np.int64)
+        bsel = np.nonzero(blive)[0]
+        guard = False
+        present = np.zeros(tcap, np.int64)
+        rowidx = np.zeros(tcap, np.int64)
+        if len(bsel):
+            ks = bk[bsel]
+            if int(ks.min()) < lo or int(ks.max()) > hi:
+                guard = True
+            slot = ks - lo
+            ok = (slot >= 0) & (slot < tcap)
+            np.add.at(present, slot[ok], 1)
+            if int(present.max()) > 1:
+                guard = True
+            # scatter-set semantics: among colliding writes the mirror
+            # keeps the last in row order (collisions imply guard anyway)
+            rowidx[slot[ok]] = bsel[ok]
+        pv_, pvv_ = pent
+        live = ptr.live
+        pk = pv_.astype(np.int64) - lo
+        in_range = (pk >= 0) & (pk < tcap)
+        pslot = np.clip(pk, 0, tcap - 1)
+        usable = live & in_range
+        if pvv_ is not None:
+            usable = usable & pvv_
+        matched = usable & (present[pslot] > 0)
+        bidx = rowidx[pslot]
+        jt = node.join_type
+        if jt in ("inner", "left_semi"):
+            out_live = matched
+        elif jt == "left_outer":
+            out_live = live.copy()
+        else:  # left_anti
+            out_live = live & ~matched
+        cols = dict(ptr.cols)
+        if jt not in ("left_semi", "left_anti"):
+            for k, (bvx, bvvx) in btr.cols.items():
+                base = np.ones(len(bidx), bool) if bvvx is None \
+                    else bvvx[bidx]
+                cols.setdefault(k, (bvx[bidx], base & matched))
+        return guard, _Trace(cols, out_live, True,
+                             dict(ptr.dict_domains), False)
+
+    # -- mesh whole-query tier ----------------------------------------------
+    def _mesh_whole(self, node) -> _Flow:
+        """Launch model of the mesh whole-query tier
+        (physical/mesh_whole.py): the ENTIRE sharded plan is ONE
+        shard_map program — leaf planes stage row-sharded over the mesh,
+        hash exchanges lower to in-program all_to_alls with the per-stage
+        mesh path's quota/overflow contract, reduce-side consumers fold
+        in behind the collective on the sharded layouts, and the only
+        dispatches are the program itself plus one re-dispatch per retry
+        round (join capacity bumps, DOUBLED exchange quotas and
+        dense-guard fallbacks — all of a round's verdicts applied
+        together, mirroring the runtime's single post-dispatch check).
+        The mirror walks the inner plan per shard with the staged-shard
+        value model, so {mesh_whole: attempts} is EXACT when the key
+        values trace."""
+        from ..exec.memory import schema_row_bytes
+        from ..exec.persist_cache import mesh_quota_key
+        from ..parallel.mesh_fusion import mesh_stage_geometry
+        from ..physical import operators as O
+        from ..physical.exchange import (
+            BroadcastExchangeExec, ShuffleExchangeExec,
+        )
+        from ..physical.fusion import FusedAggregateExec, FusedLimitExec
+        from ..physical.operators import attrs_schema
+        from ..physical.partitioning import HashPartitioning
+        from ..physical.whole_query import _scan_table
+
+        kinds = Counter()
+        notes = []
+        dec = getattr(node, "decision", None)
+        if dec is not None:
+            self.report.tier = dec.to_dict()
+            notes.append(f"tier decision: {dec.reason}")
+        P = int((dec.details or {}).get("mesh_devices") or 0) \
+            if dec is not None else 0
+        if P < 2:
+            self._approx("mesh-whole mirror: mesh axis unknown on the "
+                         "tier decision")
+            kinds["mesh_whole"] = 1
+            self._stage(node, kinds, 1, notes)
+            return _Flow([[_Batch(None, None, False)]], None,
+                         counted=True)
+        seed_rec = self._persist_seed_record() or {}
+        seed_caps = seed_rec.get("join_caps") or ()
+        caps_state: dict = {i: int(c) for i, c in enumerate(seed_caps)}
+        spans_seed = seed_rec.get("join_spans") or None
+        mesh_seed = seed_rec.get("mesh_quotas") or {}
+        # persistent across rounds, exactly like the builder's state:
+        # per-exchange live quotas (init once from geometry + manifest
+        # seed at the FIRST round's staging caps, doubled on overflow)
+        # and per-join dense disablement after a guard fired
+        quota_state: dict = {}
+        dense_off: set = set()
+        hbm = [0]
+        untraced = [False]
+        dense_used = [False]
+        partial_merged: set = set()
+        rs = {"jseq": 0, "xseq": 0, "cap_over": [], "quota_over": [],
+              "guards": []}
+
+        def mem(n, cap, extra_planes: int = 0):
+            # per-shard tile x row bytes x P shards (replicated flows
+            # hold the full gathered tile on EVERY shard — same scale)
+            try:
+                rb = schema_row_bytes(attrs_schema(n.output))
+            except Exception:
+                rb = 16
+                self._mem_approx(f"{type(n).__name__}: output schema "
+                                 "unavailable — 16 B/row assumed")
+            hbm[0] += (cap + extra_planes) * rb * P
+
+        # flow states mirror the builder's forms:
+        #   ("shard", per-shard cap, [P traces] | None, part_ids)
+        #   ("rep",   gathered cap,  trace | None)
+        def to_rep(st):
+            if st[0] == "rep":
+                return st
+            _f, cap, trs, _p = st
+            out_cap = cap * P
+            if trs is None or any(t is None for t in trs):
+                return ("rep", out_cap, None)
+            ids = set(trs[0].cols)
+            for t in trs[1:]:
+                ids &= set(t.cols)
+            cols = {}
+            for k in ids:
+                has_valid = any(t.cols[k][1] is not None for t in trs)
+                vals = np.concatenate([t.cols[k][0] for t in trs])
+                valid = None
+                if has_valid:
+                    valid = np.concatenate(
+                        [np.ones(len(t.live), bool)
+                         if t.cols[k][1] is None else t.cols[k][1]
+                         for t in trs])
+                cols[k] = (vals, valid)
+            live = np.concatenate([t.live for t in trs])
+            return ("rep", out_cap,
+                    _Trace(cols, live, True,
+                           dict(trs[0].dict_domains), False))
+
+        def pipe(st, filters, outputs):
+            if st[0] == "rep":
+                tr = None if st[2] is None \
+                    else self._project_trace(st[2], filters, outputs)
+                return ("rep", st[1], tr)
+            _f, cap, trs, pids_t = st
+            out = None if trs is None else [
+                None if t is None
+                else self._project_trace(t, filters, outputs)
+                for t in trs]
+            return ("shard", cap, out, pids_t)
+
+        def leaf_layout(n):
+            """([(rows, cap)] tiles, execution order; global trace)."""
+            if isinstance(n, O.LocalTableScanExec):
+                rows, trace = self._table_trace(n)
+                return [(b.rows, b.cap)
+                        for b in self._batches_for_rows(rows)], trace
+            if isinstance(n, O.ScanExec):
+                t = _scan_table(n)
+                if t is None:
+                    return None, None
+                _r, trace = self._arrow_trace(t, n.attrs)
+                tiles = [rc for part in self._part_tiles(
+                    t.num_rows, n.source.num_partitions())
+                    for rc in part]
+                return tiles, trace
+            if isinstance(n, O.RangeExec):
+                step = n.step
+                total = max(0, -(-(n.end - n.start) // step)) \
+                    if step > 0 \
+                    else max(0, -(-(n.start - n.end) // -step))
+                tiles = [rc for part in self._part_tiles(
+                    total, n.num_partitions) for rc in part]
+                trace = None
+                if 0 < total <= _TRACE_MAX_ROWS:
+                    vals = n.start + np.arange(total,
+                                               dtype=np.int64) * step
+                    trace = _Trace({n.attr.expr_id: (vals, None)},
+                                   np.ones(total, bool))
+                return tiles, trace
+            return None, None
+
+        def leaf_walk(n):
+            """Mirror of _stage_leaf_host + _lower_mesh_leaf: flatten
+            the leaf's batches to [total_cap] planes (rows-first per
+            batch capacity slot), pad to P*rps, slice per shard."""
+            tiles, trace = leaf_layout(n)
+            if tiles is None:
+                self._approx("mesh-whole leaf layout unknown "
+                             f"({type(n).__name__})")
+                untraced[0] = True
+                return ("shard", self._tile, None, ())
+            total_cap = max(sum(c for _r, c in tiles), 1)
+            rps = max(-(-total_cap // P), 1)
+            mem(n, rps, extra_planes=rps)
+            if trace is None and any(r for r, _c in tiles):
+                return ("shard", rps, None, ())
+            plane = P * rps
+            glive = np.zeros(plane, bool)
+            cols = {} if trace is None else trace.cols
+            gcols = {}
+            for k, (v, vv) in cols.items():
+                base = np.full(plane, "", dtype=object) \
+                    if v.dtype == object else np.zeros(plane, v.dtype)
+                gcols[k] = [base,
+                            np.zeros(plane, bool)
+                            if vv is not None else None]
+            off = r0 = 0
+            for rows_b, cap_b in tiles:
+                if rows_b:
+                    glive[off:off + rows_b] = True
+                    for k, (v, vv) in cols.items():
+                        gcols[k][0][off:off + rows_b] = v[r0:r0 + rows_b]
+                        if gcols[k][1] is not None:
+                            gcols[k][1][off:off + rows_b] = \
+                                vv[r0:r0 + rows_b]
+                off += cap_b
+                r0 += rows_b
+            doms = {}
+            for k, (v, _vv) in cols.items():
+                if v.dtype == object:
+                    d = self._trace_domain(trace, k)
+                    if d is not None:
+                        doms[k] = d
+            strs = []
+            for s in range(P):
+                sl = slice(s * rps, (s + 1) * rps)
+                strs.append(_Trace(
+                    {k: (gv[sl], None if gvv is None else gvv[sl])
+                     for k, (gv, gvv) in gcols.items()},
+                    glive[sl], True, dict(doms), False))
+            return ("shard", rps, strs, ())
+
+        def exchange_a2a(n, st, key_ids):
+            """Mirror of _exchange_all_to_all / _exchange_tail: per
+            (src, dst) keep the FIRST `quota` live rows in row order —
+            truncation happens EVERY dispatch, the psum'd overflow
+            scalar only reports it for the host's doubling verdict."""
+            _f, cap, trs, _p = st
+            xid = rs["xseq"]
+            rs["xseq"] += 1
+            q = quota_state.get(xid)
+            if q is None:
+                pos = {a.expr_id: i for i, a in enumerate(n.output)}
+                kidx = tuple(pos[e.expr_id]
+                             for e in n.partitioning.exprs)
+                sig = "|".join(str(a.dtype) for a in n.output)
+                mkey = mesh_quota_key("w", P, cap,
+                                      f"x{xid}:k{kidx}:s{sig}")
+                q = mesh_stage_geometry(P * cap, P)[2]
+                seed = mesh_seed.get(mkey)
+                if seed and int(seed) > q:
+                    q = int(seed)
+                quota_state[xid] = q
+            out_cap = P * q
+            mem(n, out_cap)
+            if trs is None or any(t is None for t in trs):
+                untraced[0] = True
+                return ("shard", out_cap, None, key_ids)
+            ids = set(trs[0].cols)
+            for t in trs[1:]:
+                ids &= set(t.cols)
+            sent = [[] for _ in range(P)]   # per dst: (trace, sel) rows
+            overflow = False
+            for t in trs:
+                live_idx = np.nonzero(t.live)[0]
+                if not len(live_idx):
+                    for qd in range(P):
+                        sent[qd].append((t, live_idx))
+                    continue
+                if any(k not in t.cols for k in key_ids):
+                    untraced[0] = True
+                    return ("shard", out_cap, None, key_ids)
+                pids = _np_hash_pids([t.cols[k] for k in key_ids], P)
+                pl = pids[live_idx]
+                for qd in range(P):
+                    sel = live_idx[pl == qd]
+                    if len(sel) > q:
+                        overflow = True
+                        sel = sel[:q]
+                    sent[qd].append((t, sel))
+            if overflow:
+                rs["quota_over"].append(xid)
+            out_trs = []
+            for qd in range(P):
+                cols_q = {}
+                for k in ids:
+                    has_valid = any(t.cols[k][1] is not None
+                                    for t, _s in sent[qd])
+                    vals = np.concatenate(
+                        [t.cols[k][0][sel] for t, sel in sent[qd]])
+                    valid = None
+                    if has_valid:
+                        valid = np.concatenate(
+                            [np.ones(len(sel), bool)
+                             if t.cols[k][1] is None
+                             else t.cols[k][1][sel]
+                             for t, sel in sent[qd]])
+                    cols_q[k] = (vals, valid)
+                nrows = sum(len(sel) for _t, sel in sent[qd])
+                out_trs.append(_Trace(cols_q, np.ones(nrows, bool),
+                                      True, dict(trs[0].dict_domains),
+                                      False))
+            return ("shard", out_cap, out_trs, key_ids)
+
+        def exchange_local(n, st, key_ids):
+            """Mirror of _exchange_local_filter: a hash exchange on a
+            replicated flow keeps each shard's own pid rows — no
+            collective, no quota, no overflow."""
+            cap, tr = st[1], st[2]
+            mem(n, cap)
+            if tr is None:
+                untraced[0] = True
+                return ("shard", cap, None, key_ids)
+            if any(k not in tr.cols for k in key_ids):
+                if tr.live.any():
+                    untraced[0] = True
+                    return ("shard", cap, None, key_ids)
+                pids = np.zeros(len(tr.live), np.int32)
+            else:
+                pids = _np_hash_pids([tr.cols[k] for k in key_ids], P)
+            out_trs = [_Trace(dict(tr.cols), tr.live & (pids == s),
+                              True, dict(tr.dict_domains), False)
+                       for s in range(P)]
+            return ("shard", cap, out_trs, key_ids)
+
+        def register_merge(n):
+            if getattr(n, "mode", "") != "final":
+                return
+            c = n.child
+            while isinstance(c, (ShuffleExchangeExec,
+                                 O.CoalescePartitionsExec)):
+                c = c.child
+            if isinstance(c, O.HashAggregateExec) \
+                    and getattr(c, "mode", "") == "partial":
+                partial_merged.add(id(c))
+
+        def agg_out_trace(n, t):
+            """Output key trace of an in-program aggregate: live groups
+            in the per-stage layout model's order (valid keys ascending,
+            the null group last). Single-key groupings only — this is
+            what downstream a2a exchanges partition by."""
+            if t is None or len(n.grouping) != 1:
+                return None
+            info = self._key_group_info(t, n.grouping[0].expr_id)
+            if info is None:
+                return None
+            return self._agg_out_trace(n.grouping[0].expr_id, *info)
+
+        def agg_walk(n, st):
+            out_part = None
+            if st[0] == "shard":
+                part_ids = st[3]
+                gids = set(g.expr_id for g in n.grouping)
+                co = bool(part_ids) and set(part_ids) <= gids
+                if getattr(n, "mode", "") == "partial" \
+                        and id(n) in partial_merged:
+                    out_part = part_ids if (n.grouping and co) else ()
+                elif n.grouping and co:
+                    out_part = part_ids
+                else:
+                    st = to_rep(st)
+            if st[0] == "shard":
+                cap, trs = st[1], st[2]
+                out_cap = cap if n.grouping else 8
+                mem(n, out_cap)
+                out_trs = None if trs is None \
+                    else [agg_out_trace(n, t) for t in trs]
+                return ("shard", out_cap, out_trs, out_part)
+            cap, tr = st[1], st[2]
+            out_cap = cap if n.grouping else 8
+            mem(n, out_cap)
+            return ("rep", out_cap, agg_out_trace(n, tr))
+
+        def join_walk(n):
+            pst = walk(n.left)
+            if n.probe_fusion is not None:
+                f_, o_ = n.probe_fusion
+                pst = pipe(pst, f_, o_)
+            bst = walk(n.right)
+            lkeys = tuple(k.expr_id for k in n.left_keys)
+            rkeys = tuple(k.expr_id for k in n.right_keys)
+            sharded = pst[0] == "shard"
+            if sharded:
+                co = (bst[0] == "shard" and len(lkeys) > 0
+                      and pst[3] == lkeys and bst[3] == rkeys)
+                if bst[0] == "shard" and not co:
+                    bst = to_rep(bst)
+            elif bst[0] == "shard":
+                bst = to_rep(bst)
+            pcap, bcap = pst[1], bst[1]
+            if sharded:
+                pts = pst[2] if pst[2] is not None else [None] * P
+                bts = (bst[2] if bst[2] is not None else [None] * P) \
+                    if bst[0] == "shard" else [bst[2]] * P
+            else:
+                pts = [pst[2]]
+                bts = [bst[2]]
+            jid = rs["jseq"]
+            rs["jseq"] += 1
+            out_cap = caps_state.setdefault(jid, max(pcap, 1 << 10))
+            dense = self._whole_dense_span(jid, bcap, spans_seed,
+                                           dense_off) \
+                if self._whole_dense_eligible(n) else None
+            if dense is not None:
+                # dense direct-address probe per shard: 1:1 with the
+                # probe plane, the join cap never binds; the pmax'd
+                # guard disables it for the next round on drift
+                dense_used[0] = True
+                out_cap = pcap
+                mem(n, out_cap)
+                guard_any = False
+                out_trs = []
+                for pt, bt in zip(pts, bts):
+                    g, tr = self._whole_dense_mirror(n, pt, bt, *dense)
+                    if g is None:
+                        untraced[0] = True
+                    else:
+                        guard_any = guard_any or g
+                    out_trs.append(tr)
+                if guard_any:
+                    rs["guards"].append(jid)
+            else:
+                mem(n, out_cap)
+                needs = [self._whole_join_needed(n, pt, bt)
+                         for pt, bt in zip(pts, bts)]
+                out_trs = []
+                if any(nd is None for nd in needs):
+                    untraced[0] = True
+                    out_trs = [None] * len(pts)
+                else:
+                    # the host reads the pmax'd `needed` — ONE bump
+                    # covers every shard's worst case
+                    nd_max = max(needs) if needs else 0
+                    if nd_max > out_cap:
+                        rs["cap_over"].append(
+                            (jid, bucket_capacity(nd_max)))
+                    for pt, bt, nd in zip(pts, bts, needs):
+                        tr = self._whole_join_trace(n, pt, bt)
+                        if tr is not None and nd > out_cap:
+                            # this shard's failed attempt truncates at
+                            # the bucket (probe-major fill order)
+                            if n.join_type == "inner" \
+                                    and len(tr.live) >= out_cap:
+                                tr = tr.select(np.arange(out_cap), True)
+                            else:
+                                untraced[0] = True
+                                tr = None
+                        out_trs.append(tr)
+            if sharded:
+                return ("shard", out_cap, out_trs, pst[3])
+            return ("rep", out_cap, out_trs[0])
+
+        def walk(n):
+            if isinstance(n, (O.LocalTableScanExec, O.RangeExec,
+                              O.ScanExec)):
+                return leaf_walk(n)
+            if isinstance(n, FusedAggregateExec):
+                register_merge(n)
+                st = pipe(walk(n.child), n.filters, n.pipe_outputs)
+                return agg_walk(n, st)
+            if isinstance(n, O.HashAggregateExec):
+                register_merge(n)
+                return agg_walk(n, walk(n.child))
+            if isinstance(n, FusedLimitExec):
+                st = to_rep(walk(n.child))
+                mem(n, st[1])
+                return ("rep", st[1], None)
+            if isinstance(n, (O.LimitExec, O.SortExec)):
+                st = to_rep(walk(n.child))
+                mem(n, st[1])
+                return ("rep", st[1], None)
+            if isinstance(n, O.HashJoinExec):
+                return join_walk(n)
+            if isinstance(n, O.ComputeExec):
+                st = walk(n.child)
+                mem(n, st[1])
+                return pipe(st, n.filters, n.outputs)
+            if isinstance(n, ShuffleExchangeExec):
+                st = walk(n.child)
+                if n.pipe_fusion is not None:
+                    f_, o_ = n.pipe_fusion
+                    st = pipe(st, f_, o_)
+                    mem(n, st[1])
+                p = n.partitioning
+                if isinstance(p, HashPartitioning):
+                    key_ids = tuple(e.expr_id for e in p.exprs)
+                    if st[0] == "shard":
+                        return exchange_a2a(n, st, key_ids)
+                    return exchange_local(n, st, key_ids)
+                return to_rep(st)
+            if isinstance(n, BroadcastExchangeExec):
+                return to_rep(walk(n.child))
+            if isinstance(n, O.CoalescePartitionsExec):
+                return walk(n.child)
+            if isinstance(n, O.UnionExec):
+                sts = [to_rep(walk(c)) for c in n.children_plans]
+                cap = bucket_capacity(max(sum(s[1] for s in sts), 1))
+                traces = [s[2] for s in sts]
+                tr = self._merge_group_traces(traces) \
+                    if all(t is not None for t in traces) else None
+                mem(n, cap)
+                return ("rep", cap, tr)
+            # admission should prevent this; degrade honestly
+            self._approx(f"mesh-whole mirror missing for "
+                         f"{type(n).__name__}")
+            untraced[0] = True
+            return ("rep", self._tile, None)
+
+        # mirror of MeshWholeQueryExec's retry loop: all of a round's
+        # verdicts (pmax'd join `needed`s, psum'd exchange overflows,
+        # pmax'd dense guards) are read together after the ONE dispatch
+        # and applied together before the re-dispatch. The memory model
+        # keeps the LAST round's accumulation
+        attempts = 0
+        final = ("rep", self._tile, None)
+        while attempts < 8:
+            attempts += 1
+            rs["jseq"] = 0
+            rs["xseq"] = 0
+            rs["cap_over"] = []
+            rs["quota_over"] = []
+            rs["guards"] = []
+            hbm[0] = 0
+            partial_merged.clear()
+            final = to_rep(walk(node.plan))
+            if untraced[0]:
+                break
+            if not (rs["cap_over"] or rs["quota_over"] or rs["guards"]):
+                break
+            for jid, newcap in rs["cap_over"]:
+                caps_state[jid] = newcap
+            for xid in rs["quota_over"]:
+                quota_state[xid] = quota_state[xid] * 2
+            for jid in rs["guards"]:
+                dense_off.add(jid)
+        if untraced[0]:
+            self._approx("mesh-whole verdicts untraced (key values "
+                         "outside the traced language): retry "
+                         "dispatches unpredictable")
+        if attempts > 1:
+            notes.append(
+                f"{attempts - 1} retry round"
+                f"{'' if attempts == 2 else 's'}: join capacity bumps, "
+                "doubled exchange quotas and dense-guard fallbacks "
+                "re-dispatch the whole program (all of a round's "
+                "verdicts applied together; retries restage from the "
+                "undonated base planes, never from host)")
+        if dense_used[0]:
+            notes.append("dense direct-address probe compiled up front "
+                         "from the warm-start key-span seed (1:1 with "
+                         "the probe plane, no expansion buffer), "
+                         "guarded in-program")
+        kinds["mesh_whole"] = attempts
+        notes.insert(0, f"MESH WHOLE-QUERY program: the entire sharded "
+                        f"plan as ONE shard_map dispatch per step over "
+                        f"{P} devices — hash exchanges are in-program "
+                        "all_to_alls, reduce consumers fold in behind "
+                        "the collective, intermediates never leave HBM")
+        self._sync("mesh-whole verdict scalars (pmax'd join `needed`s, "
+                   "psum'd exchange overflows, dense guards) sync ONCE "
+                   "after the single sharded dispatch")
+        self._hazard("mesh-whole join output capacities and exchange "
+                     "quotas are value-dependent program-key components "
+                     "— growth recompiles the whole sharded program")
+        self._stage(node, kinds, 1, notes)
+        ent = self._stage_by_node.get(id(node))
+        if ent is not None and "hbm_bytes" not in ent:
+            ent["hbm_bytes"] = hbm[0]
+            self._hbm_total += hbm[0]
+            self._hbm_any = True
+        return _Flow([[_Batch(None, final[1], False)]], final[2],
+                     counted=True)
 
     def _unknown(self, node) -> _Flow:
         flows = [self.visit(c) for c in node.children]

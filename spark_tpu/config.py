@@ -209,18 +209,27 @@ FUSION_EXCHANGE = _register(ConfigEntry(
 
 COMPILE_TIER = _register(ConfigEntry(
     "spark.tpu.compile.tier", "auto",
-    "Compilation tier: 'whole' compiles the ENTIRE query — all stages, "
-    "exchanges lowered to in-program gathers — into ONE jitted program "
-    "per step (zero host shuffle round-trips; physical/whole_query.py); "
-    "'stage' compiles one program per stage per batch (PR 1/5/8 fusion, "
-    "with the per-partition minRows runtime gate as the stage->operator "
+    "Compilation tier: 'mesh-whole' compiles the ENTIRE sharded query "
+    "into ONE shard_map program per step — leaf planes row-sharded over "
+    "the device mesh, hash exchanges as in-program lax.all_to_all, "
+    "reduce-side consumers folded in behind the collective "
+    "(physical/mesh_whole.py; needs spark.tpu.mesh.enabled, plain hash "
+    "keys, one power-of-two partition count and enough devices — else "
+    "falls back tier-by-tier with the reason on the decision); 'whole' "
+    "compiles the query — exchanges lowered to in-program gathers — "
+    "into ONE single-device jitted program per step (zero host shuffle "
+    "round-trips; physical/whole_query.py); 'stage' compiles one "
+    "program per stage per batch (PR 1/5/8 fusion, with the "
+    "per-partition minRows runtime gate as the stage->operator "
     "fallback); 'operator' forces the shared operator-at-a-time kernels "
     "(the differential oracle). 'auto' (default) chooses from predicted "
     "compile cost, predicted fully-resident HBM (spark.tpu.memory.budget "
     "admission), and batch volume (spark.tpu.compile.whole.minRows), "
     "falling back tier-by-tier when statistics are unknown or budgets "
     "are exceeded — the generalization of the spark.tpu.fusion.minRows "
-    "gate to whole programs.", str))
+    "gate to whole programs; mesh-whole admits in auto ONLY when the "
+    "single-device program exceeds the budget but a per-shard slice "
+    "fits.", str))
 
 WHOLE_MIN_ROWS = _register(ConfigEntry(
     "spark.tpu.compile.whole.minRows", 1 << 17,

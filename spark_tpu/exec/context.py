@@ -119,6 +119,11 @@ class ExecContext:
     persist_seed: dict | None = field(default=None, repr=False)
     persist_join_caps: list | None = field(default=None, repr=False)
     persist_mesh_quotas: dict | None = field(default=None, repr=False)
+    # per-join build-side key spans ([lo, hi, unique] or None, aligned
+    # with persist_join_caps) observed by the whole-program tiers — the
+    # manifest carries them so a warm restart compiles the dense
+    # direct-address probe variant directly
+    persist_join_spans: list | None = field(default=None, repr=False)
     # per-query kernel ledger (obs/metrics.QueryKernelLedger) installed
     # by QueryExecution.execute for the execution window: scope-exact
     # launch/compile deltas under concurrent collects (the contextvar

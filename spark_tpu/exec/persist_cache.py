@@ -858,15 +858,20 @@ def mesh_quota_key_fused(num_out: int, rows_per_shard: int,
 def record_manifest(conf, fingerprint: dict, tier: dict | None,
                     join_caps: list | None,
                     mesh_quotas: dict | None,
-                    prior: dict | None = None) -> None:
+                    prior: dict | None = None,
+                    join_spans: list | None = None) -> None:
     """Persist one query's capacity outcomes keyed by its full plan
     fingerprint (driver-only, at query close). Only written when there
     is something a warm restart could seed — the empty steady state is
     the default and needs no record. `prior` is the seed record this
     run started from (ctx.persist_seed): a seeded steady-state run
     whose outcomes match it appends nothing — the manifest records
-    capacity CHANGES, not every repetition."""
-    if not join_caps and not mesh_quotas:
+    capacity CHANGES, not every repetition. `join_spans` carries the
+    observed build-side key span per whole-program join
+    ([lo, hi, unique] or None, aligned with join_caps): a warm restart
+    compiles the dense direct-address probe variant directly instead of
+    re-learning the span through the sorted probe."""
+    if not join_caps and not mesh_quotas and not join_spans:
         return
     m = _manifest(conf)
     if m is None:
@@ -879,10 +884,16 @@ def record_manifest(conf, fingerprint: dict, tier: dict | None,
             "tier": (tier or {}).get("tier"),
             "join_caps": [int(c) for c in (join_caps or ())],
             "mesh_quotas": {k: int(v)
-                            for k, v in (mesh_quotas or {}).items()}}
+                            for k, v in (mesh_quotas or {}).items()},
+            "join_spans": [None if s is None else [int(x) for x in s]
+                           for s in (join_spans or ())]}
         if prior is not None and all(
-                prior.get(k) == rec[k]
-                for k in ("fp", "tier", "join_caps", "mesh_quotas")):
+                # records predating join_spans normalize to the empty
+                # list, so a seeded steady-state rerun stays append-free
+                (prior.get(k) or rec[k].__class__()) == rec[k]
+                if k == "join_spans" else prior.get(k) == rec[k]
+                for k in ("fp", "tier", "join_caps", "mesh_quotas",
+                          "join_spans")):
             return
         m.append({**rec, "ts": round(time.time(), 3)})
     except Exception:

@@ -351,7 +351,8 @@ class QueryExecution:
                     and self.physical.decision.to_dict(),
                     join_caps=getattr(ctx, "persist_join_caps", None),
                     mesh_quotas=getattr(ctx, "persist_mesh_quotas", None),
-                    prior=getattr(ctx, "persist_seed", None))
+                    prior=getattr(ctx, "persist_seed", None),
+                    join_spans=getattr(ctx, "persist_join_spans", None))
             except Exception:
                 ctx.metrics.add("cache.manifest_errors")
         if recorder is not None:

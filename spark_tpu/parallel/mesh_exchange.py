@@ -167,6 +167,18 @@ def _pad_base(arr, num_out: int, rows_per_shard: int):
     return out
 
 
+def _planes_alive(arrays) -> bool:
+    """True when every device plane in `arrays` is still resident (not
+    deleted/donated) — the liveness proof a gang retry needs before it
+    reuses quota-retry base planes instead of defensively restaging from
+    host. Base planes are UNDONATED by contract (build_*_stage passes
+    donate_argnums=() when base_rows is set), so a runtime gang fault
+    cannot have consumed them; this check is the assertion of that
+    contract, not a heuristic."""
+    return all(not getattr(a, "is_deleted", lambda: False)()
+               for a in arrays if a is not None)
+
+
 def _shards_by_partition(arr, out_cap: int, num_out: int) -> list:
     """Per-device shard views of a program output, ordered by reduce
     partition id."""
@@ -262,21 +274,8 @@ def mesh_shuffle_hash(partitions, key_positions: Sequence[int],
     otherwise the pipeline (if any) materializes per batch and the
     pre-materialized batches take the plain stage program."""
     from ..config import DEVICE_MESH_AXIS, FUSION_MESH
-    from ..types import StringType
 
     axis = ctx.conf.get(DEVICE_MESH_AXIS)
-    if fusion is not None and any(
-            isinstance(fusion.pipe_attrs[i].dtype, StringType)
-            for i in fusion._key_idx):
-        # dictionary-encoded partition keys on the mesh path take the
-        # materialize-then-collective composition: the plain stage hashes
-        # staged eq-key planes (value hashes), which are dictionary-
-        # independent across shards. Folding the padded dict-hash luts
-        # into the fused shard_map program as replicated aux planes is a
-        # recorded follow-on (ROADMAP direction 3).
-        partitions = [[fusion.run_pipeline(b) for b in part]
-                      for part in partitions]
-        fusion = None
     if fusion is not None and not ctx.conf.get(FUSION_MESH):
         # legacy composition: materialize the pipeline per batch, then
         # redistribute the materialized batches
@@ -411,14 +410,23 @@ def _mesh_shuffle_plain(partitions, key_positions, num_out, schema, ctx,
                     raise
                 # GANG failure (barrier semantics): one shard dying at
                 # runtime fails the whole sharded dispatch. Retry the
-                # gang once with fresh staging (donated send buffers may
-                # already be consumed), then degrade to the host shuffle.
+                # gang once, then degrade to the host shuffle. The
+                # donated send buffers may already be consumed and are
+                # restaged; the UNDONATED quota-retry base planes are
+                # provably still resident (liveness-checked) and are
+                # reused — a gang retry never re-crosses the host for
+                # data a prior attempt already staged.
                 if ledger is not None:
                     ledger.release_all()
-                if base_ledger is not None:
-                    base_ledger.release_all()
-                    base_ledger = None
-                base = None
+                if base is not None:
+                    if _planes_alive(base[0] + base[1] + base[2]
+                                     + base[3] + [base[4]]):
+                        ctx.metrics.add("exchange.mesh_gang_base_reused")
+                    else:
+                        if base_ledger is not None:
+                            base_ledger.release_all()
+                            base_ledger = None
+                        base = None
                 gang_failures += 1
                 ctx.metrics.add("exchange.mesh_gang_failures")
                 if gang_failures > _MAX_GANG_RETRIES:
@@ -520,7 +528,8 @@ def _mesh_shuffle_fused(partitions, fusion, num_out, schema, ctx, stats,
         return _empty_result(num_out, schema, stats)
     (in_datas, in_valids, row_mask, in_dicts, total_cap) = staged
 
-    from ..types import BooleanType
+    from ..columnar.batch import EMPTY_DICT as _ED
+    from ..types import BooleanType, StringType
 
     filters, outputs = fusion.filters, fusion.pipe_outputs
     key_idx = fusion._key_idx
@@ -535,12 +544,23 @@ def _mesh_shuffle_fused(partitions, fusion, num_out, schema, ctx, stats,
     out_fields = schema.fields
     out_dicts = [host_outs[i].sdict if dict_encoded(f.dataType) else None
                  for i, f in enumerate(out_fields)]
+    # string partition keys fuse too: padded codes→value-hash luts ride
+    # the dispatch as replicated aux planes, so the in-program key hash
+    # is dictionary-independent across shards (PR 9 compressed-execution
+    # carry-over — the pipeline no longer materializes before the
+    # collective for dict-encoded keys)
+    dict_pos = tuple(i for i in key_idx
+                     if isinstance(fusion.pipe_attrs[i].dtype, StringType))
+    kluts = [(host_outs[i].sdict or _ED).device_hash_lut()
+             for i in dict_pos]
 
     P = num_out
     layout = MeshSpecLayout(axis)
     sharding = layout.row_sharding(mesh)
     rep_sharding = layout.replicated_sharding(mesh)
     d_aux = [jax.device_put(a, rep_sharding) for a in aux]
+    d_kluts = [jax.device_put(l, rep_sharding) for l in kluts]
+    lut_lens = tuple(int(l.shape[0]) for l in kluts)
     rows_per_shard, shard_cap, quota = mesh_stage_geometry(total_cap, P)
     donate = MF.DONATE_DEFAULT  # module switch: tests A/B the HBM win
     # in-program column stats over the pipeline OUTPUT columns (planes =
@@ -585,12 +605,14 @@ def _mesh_shuffle_fused(partitions, fusion, num_out, schema, ctx, stats,
                 kkey = ("mesh_stage", "f", id(mesh), axis, P, quota, seed,
                         fusion._struct_key, key_idx, key_bool,
                         out_valid_sig, pipeline_signature(staged_view),
-                        hctx.signature(), stat_spec, donate)
+                        hctx.signature(), stat_spec, dict_pos,
+                        lut_lens, donate)
                 prog = GLOBAL_KERNEL_CACHE.get_or_build(
                     kkey, lambda: build_fused_stage(
                         mesh, axis, shard_cap, quota, P, seed,
                         input_attrs, filters, outputs, key_idx, key_bool,
-                        out_valid_sig, donate, stat_spec=stat_spec))
+                        out_valid_sig, donate, stat_spec=stat_spec,
+                        dict_pos=dict_pos))
             else:
                 # retry: persisted base planes, in-program re-layout —
                 # the retry pays the recompile only, never the restage
@@ -599,17 +621,17 @@ def _mesh_shuffle_fused(partitions, fusion, num_out, schema, ctx, stats,
                 kkey = ("mesh_stage", "f", id(mesh), axis, P, quota, seed,
                         fusion._struct_key, key_idx, key_bool,
                         out_valid_sig, pipeline_signature(staged_view),
-                        hctx.signature(), stat_spec, donate,
-                        "base", rows_per_shard)
+                        hctx.signature(), stat_spec, dict_pos,
+                        lut_lens, donate, "base", rows_per_shard)
                 prog = GLOBAL_KERNEL_CACHE.get_or_build(
                     kkey, lambda: build_fused_stage(
                         mesh, axis, shard_cap, quota, P, seed,
                         input_attrs, filters, outputs, key_idx, key_bool,
                         out_valid_sig, donate, base_rows=rows_per_shard,
-                        stat_spec=stat_spec))
+                        stat_spec=stat_spec, dict_pos=dict_pos))
             try:
                 with MF.expected_donation_residue():
-                    res = prog(d_datas, d_valids, d_mask, d_aux)
+                    res = prog(d_datas, d_valids, d_mask, d_aux, d_kluts)
                 if stat_spec:
                     (g_datas, g_valids, new_mask, counts, overflow,
                      stats_arr) = res
@@ -623,14 +645,20 @@ def _mesh_shuffle_fused(partitions, fusion, num_out, schema, ctx, stats,
 
                 if not is_runtime_fault(e):
                     raise
-                # gang failure: retry the whole sharded dispatch once
-                # with fresh staging, then degrade to the host shuffle
+                # gang failure: retry the whole sharded dispatch once,
+                # then degrade to the host shuffle. Undonated base
+                # planes are liveness-checked and reused (see the plain
+                # path) — only the donated attempt buffers restage.
                 if ledger is not None:
                     ledger.release_all()
-                if base_ledger is not None:
-                    base_ledger.release_all()
-                    base_ledger = None
-                base = None
+                if base is not None:
+                    if _planes_alive(base[0] + base[1] + [base[2]]):
+                        ctx.metrics.add("exchange.mesh_gang_base_reused")
+                    else:
+                        if base_ledger is not None:
+                            base_ledger.release_all()
+                            base_ledger = None
+                        base = None
                 gang_failures += 1
                 ctx.metrics.add("exchange.mesh_gang_failures")
                 if gang_failures > _MAX_GANG_RETRIES:

@@ -322,17 +322,23 @@ def build_fused_stage(mesh, axis: str, shard_cap: int, quota: int,
                       filters, outputs, key_idx: tuple, key_bool: tuple,
                       out_valid_sig: tuple, donate: bool,
                       base_rows: "int | None" = None,
-                      stat_spec: tuple = ()):
+                      stat_spec: tuple = (), dict_pos: tuple = ()):
     """Jitted mesh stage for a FUSED shuffle stage: the filter/project
     pipeline traces per shard, partition ids derive from the traced key
     outputs, and the all-to-all ships the pipeline OUTPUT columns — the
     whole stage is one SPMD dispatch. Signature:
-    f(datas, valids, row_mask, aux) ->
+    f(datas, valids, row_mask, aux, kluts) ->
     (out_datas, out_valids, new_mask, counts[P], overflow[, stats]),
     where the input planes (datas/valids/row_mask) are the donated send
     buffers. `stat_spec` indexes the pipeline OUTPUT columns whose
     per-reduce-partition (min, max, live count) the program reduces
-    in-program (see build_plain_stage)."""
+    in-program (see build_plain_stage). `dict_pos` lists the
+    dictionary-encoded partition-key positions (pipe-output indices, in
+    key_idx order) whose eq domain is a padded codes→value-hash lut
+    shipped in `kluts` as a REPLICATED aux plane: the key hash computes
+    over dictionary-independent value hashes inside the shard_map, so
+    string-key exchanges fuse instead of materializing the pipeline
+    before the collective."""
     import jax
     import jax.numpy as jnp
 
@@ -344,8 +350,9 @@ def build_fused_stage(mesh, axis: str, shard_cap: int, quota: int,
     rows = layout.rows()
     rep = layout.replicated()
     n_in = len(input_attrs)
+    lut_of = {i: j for j, i in enumerate(dict_pos)}
 
-    def local_fn(datas, valids, row_mask, aux):
+    def local_fn(datas, valids, row_mask, aux, kluts):
         if base_rows is not None:
             # quota-retry restaging: geometry-independent base planes
             # re-lay out to the attempt's [shard_cap] send layout
@@ -361,6 +368,10 @@ def build_fused_stage(mesh, axis: str, shard_cap: int, quota: int,
             kd = out_datas[i]
             if is_bool:
                 kd = kd.astype(jnp.int32)
+            if i in lut_of:
+                lut = kluts[lut_of[i]]
+                kd = jnp.take(lut, jnp.clip(kd.astype(jnp.int32), 0,
+                                            lut.shape[0] - 1))
             eqs.append(kd)
         kvs = [out_valids[i] for i in key_idx]
         pids = partition_ids(hash_columns(eqs, kvs, seed=seed), num_out)
@@ -372,12 +383,13 @@ def build_fused_stage(mesh, axis: str, shard_cap: int, quota: int,
             return outs[:n], outs[n:], new_mask, count, overflow, stats
         return outs[:n], outs[n:], new_mask, count, overflow
 
-    def sharded(datas, valids, row_mask, aux):
+    def sharded(datas, valids, row_mask, aux, kluts):
         in_specs = (
             [rows] * n_in,
             [None if v is None else rows for v in valids],
             rows,
             [rep] * len(aux),
+            [rep] * len(kluts),
         )
         out_specs = ([rows] * len(outputs),
                      [rows if has else None for has in out_valid_sig],
@@ -388,7 +400,7 @@ def build_fused_stage(mesh, axis: str, shard_cap: int, quota: int,
             out_specs = out_specs + (rows,)
         f = shard_map(local_fn, mesh=mesh, in_specs=in_specs,
                       out_specs=out_specs, check_vma=False)
-        return f(datas, valids, row_mask, aux)
+        return f(datas, valids, row_mask, aux, kluts)
 
     # built exclusively through GLOBAL_KERNEL_CACHE.get_or_build
     # (mesh_exchange) — launches ride the dispatch counters

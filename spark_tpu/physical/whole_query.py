@@ -75,7 +75,7 @@ from .operators import PhysicalPlan, attrs_schema
 
 __all__ = ["WholeQueryExec", "TierDecision", "choose_tier",
            "apply_compile_tier", "supported_whole_query",
-           "is_runtime_fault"]
+           "supported_mesh_whole", "is_runtime_fault"]
 
 _MAX_PROGRAM_RETRIES = 8
 
@@ -99,7 +99,7 @@ class TierDecision:
     """Outcome of the compile-tier cost model, stashed on the plan so
     explain("analysis") and the execution span can surface it."""
 
-    tier: str                 # "whole" | "stage" | "operator"
+    tier: str                 # "mesh-whole" | "whole" | "stage" | "operator"
     reason: str               # human-readable why (incl. fallback cause)
     details: dict = field(default_factory=dict)
 
@@ -191,6 +191,55 @@ def _iter_inner(plan):
     return inner.iter_nodes()
 
 
+def supported_mesh_whole(plan, conf) -> tuple[bool, str, dict]:
+    """Mesh admission on top of supported_whole_query: every hash
+    exchange must lower to an in-program `lax.all_to_all` on ONE
+    power-of-two mesh axis known at plan time (plain attribute keys, a
+    consistent partition count, enough devices), and at least one such
+    exchange must exist — without one the single-device whole program
+    already eliminates every round-trip and sharding buys nothing.
+    Returns (ok, why-not, details)."""
+    from ..config import MESH_ENABLED
+    from .exchange import ShuffleExchangeExec
+    from .partitioning import HashPartitioning
+
+    if not conf.get(MESH_ENABLED):
+        return False, "spark.tpu.mesh.enabled=false", {}
+    counts: set[int] = set()
+    for node in _iter_inner(plan):
+        if not isinstance(node, ShuffleExchangeExec):
+            continue
+        p = node.partitioning
+        if not isinstance(p, HashPartitioning):
+            continue
+        if not all(isinstance(e, AttributeReference) for e in p.exprs):
+            return False, ("hash exchange keys are computed expressions "
+                           "(no in-program partition-id lowering)"), {}
+        for e in p.exprs:
+            if dict_encoded(e.dtype) and not isinstance(e.dtype,
+                                                        StringType):
+                return False, (f"exchange key {e.name} is a nested "
+                               "dictionary type"), {}
+        counts.add(int(p.num_partitions))  # tpulint: ignore[host-sync]
+    if not counts:
+        return False, ("no hash exchange to run as an in-program "
+                       "collective (the single-device whole tier "
+                       "already eliminates the round-trips)"), {}
+    if len(counts) > 1:
+        return False, (f"mixed hash partition counts {sorted(counts)} "
+                       "(one mesh axis per program)"), {}
+    P = counts.pop()
+    if P < 2 or (P & (P - 1)) != 0:
+        return False, (f"partition count {P} is not a power-of-two "
+                       "mesh axis"), {}
+    import jax
+
+    n_dev = len(jax.devices())
+    if n_dev < P:
+        return False, f"mesh needs {P} devices, {n_dev} visible", {}
+    return True, "", {"mesh_devices": P}
+
+
 def _estimate_resident_bytes(plan, conf) -> Optional[int]:
     """Cheap upper-bound of the fully-resident program's engine bytes:
     every lowered operator's output tile (capacity x row bytes) plus the
@@ -274,7 +323,8 @@ def choose_tier(plan, conf, cluster: bool = False) -> TierDecision:
         return TierDecision("operator", "forced by spark.tpu.compile.tier")
     if pref == "stage":
         return TierDecision("stage", "forced by spark.tpu.compile.tier")
-    forced = pref == "whole"
+    forced_mesh = pref == "mesh-whole"
+    forced = pref == "whole" or forced_mesh
     base = "forced by spark.tpu.compile.tier" if forced \
         else "cost model (spark.tpu.compile.tier=auto)"
     if not conf.get(FUSION_ENABLED):
@@ -327,7 +377,36 @@ def choose_tier(plan, conf, cluster: bool = False) -> TierDecision:
     if est is not None:
         details["est_resident_bytes"] = est
     budget = int(conf.get(MEMORY_BUDGET))  # tpulint: ignore[host-sync]
-    if budget > 0 and est is not None and est > budget:
+    over_budget = budget > 0 and est is not None and est > budget
+    if forced_mesh or (pref == "auto" and over_budget):
+        # mesh admission: the whole-program win at 1/P the per-device
+        # residency. Forced mesh-whole always tries it; auto reaches for
+        # it ONLY in the budget gap (the single-device whole program
+        # does not fit, but a per-shard slice does) — under budget the
+        # single-device program keeps its value-dependent fast paths
+        mok, mwhy, mdet = supported_mesh_whole(plan, conf)
+        per_shard = None
+        if mok:
+            P = mdet["mesh_devices"]
+            per_shard = None if est is None else -(-est // P)
+            if budget > 0 and per_shard is not None \
+                    and per_shard > budget:
+                mok = False
+                mwhy = ("per-shard resident estimate "
+                        f"~{per_shard / (1 << 20):.1f} MiB still "
+                        "exceeds spark.tpu.memory.budget")
+        if mok:
+            details.update(mdet)
+            if per_shard is not None:
+                details["est_resident_bytes_per_shard"] = per_shard
+            reason = base if forced_mesh else (
+                base + " — fully-resident set exceeds the single-device "
+                "budget but fits per-shard across the mesh")
+            return TierDecision("mesh-whole", reason, details)
+        # tier-by-tier fallback: the reason rides the decision so
+        # explain("analysis") shows why the mesh program was refused
+        details["mesh_whole_fallback"] = mwhy
+    if over_budget:
         return TierDecision(
             "stage", "whole-query fallback: predicted fully-resident "
             f"working set ~{est / (1 << 20):.1f} MiB exceeds "
@@ -343,6 +422,13 @@ def choose_tier(plan, conf, cluster: bool = False) -> TierDecision:
                 f"{volume} rows under the compile-amortization floor "
                 f"({floor}; spark.tpu.compile.whole.minRows scaled by "
                 "program depth)", details)
+    if forced_mesh:
+        # mesh admission failed but the plan fits one device: fall back
+        # ONE tier (mesh-whole -> whole), not all the way to stage
+        return TierDecision(
+            "whole", "mesh-whole fallback: "
+            f"{details.get('mesh_whole_fallback', 'mesh inadmissible')}",
+            details)
     return TierDecision("whole", base, details)
 
 
@@ -350,6 +436,10 @@ def apply_compile_tier(plan, conf, cluster: bool = False):
     """Planner hook: wrap the plan for the whole tier, or stash the
     decision (with its fallback reason) for explain("analysis")."""
     decision = choose_tier(plan, conf, cluster=cluster)
+    if decision.tier == "mesh-whole":
+        from .mesh_whole import MeshWholeQueryExec
+
+        return MeshWholeQueryExec(plan, decision)
     if decision.tier == "whole":
         return WholeQueryExec(plan, decision)
     try:
@@ -398,6 +488,23 @@ class _Lowered(NamedTuple):
     emit: Callable         # emit(args, needed) -> (datas, valids, mask)
 
 
+class _Collect(list):
+    """Emit-time scalar collector. The list body carries per-join
+    `needed` capacities (the capacity-retry contract); the side channels
+    carry the dense-probe guard verdicts, the observed build-key spans
+    (warm-start manifest food), and per-exchange overflow counts (mesh
+    tier) that ride the SAME single dispatch — all checked once, on the
+    host, after the program returns."""
+
+    __slots__ = ("spans", "guards", "overflows")
+
+    def __init__(self):
+        super().__init__()
+        self.spans: list = []      # (lo, hi, dup) per span-observed join
+        self.guards: list = []     # violation scalar per dense join
+        self.overflows: list = []  # psum'd overflow per mesh exchange
+
+
 class _ProgramBuilder:
     """Lowers an admitted physical plan into one traced program.
 
@@ -408,7 +515,8 @@ class _ProgramBuilder:
     uses — trace_pipeline, ops.grouping, ops.joining, ops.sorting — into
     a single function; XLA fuses across what used to be stage boundaries."""
 
-    def __init__(self, ctx, join_caps: list):
+    def __init__(self, ctx, join_caps: list, spans_seed=None,
+                 dense_off=None):
         self.ctx = ctx
         self.args: list = []           # program inputs, in arg-index order
         self.key: list = []            # cache-key fragments
@@ -416,6 +524,16 @@ class _ProgramBuilder:
         # across the retry loop: a bumped bucket re-enters here)
         self._join_seq = 0
         self.members: list[str] = []   # lowered ops, produce->consume order
+        # warm-start build-side key spans ([lo, hi, unique] per join id,
+        # from the persistent manifest) and the joins whose seeded span
+        # the data contradicted this run (guard-verdict retry state)
+        self._spans_seed = spans_seed
+        self._dense_off = dense_off if dense_off is not None else set()
+        self.span_jids: list[int] = []   # joins observing their span —
+        # append order matches emit-time needed.spans appends (probe
+        # subtree lowers AND emits before build subtree before self)
+        self.guard_jids: list[int] = []  # dense joins, = guards order
+        self.dense_joins: list[int] = [] # joins on the dense fast path
 
     # -- plumbing ----------------------------------------------------------
     def arg(self, arr) -> int:
@@ -812,13 +930,53 @@ class _ProgramBuilder:
         return None, None
 
     def _lower_join(self, node) -> _Lowered:
-        jnp = _jnp()
         probe = self.lower(node.left)
         if node.probe_fusion is not None:
             filters, outputs = node.probe_fusion
             probe = self._lower_pipe(filters, outputs, node.left.output,
                                      node.probe_attrs, probe)
         build = self.lower(node.right)
+        return self._join_tail(node, probe, build)
+
+    def _dense_eligible(self, node) -> bool:
+        """Single plain-integral-key equi-join: the shape whose build
+        side CAN have a dense direct-address table (operators.py's
+        value-dependent fast path) — whether it DOES is decided by the
+        warm-start span seed (_dense_span)."""
+        from ..config import FUSION_DENSE_KEYS
+        from ..types import DateType, IntegralType
+
+        if len(node.left_keys) != 1 or len(node.right_keys) != 1:
+            return False
+        if not bool(self.ctx.conf.get(  # tpulint: ignore[host-sync]
+                FUSION_DENSE_KEYS)):
+            return False
+        return all(isinstance(k.dtype, (IntegralType, DateType))
+                   for k in (node.left_keys[0], node.right_keys[0]))
+
+    def _dense_span(self, join_id: int, build_cap: int):
+        """The seeded [lo, hi] span when the manifest proves the build
+        keys of this join were unique and dense enough last run — the
+        whole program then compiles the direct-address probe variant
+        up front, guarded in-program against data drift."""
+        if self._spans_seed is None or join_id in self._dense_off:
+            return None
+        if join_id >= len(self._spans_seed):
+            return None
+        sp = self._spans_seed[join_id]
+        if not sp or len(sp) < 3 or not int(sp[2]):  # tpulint: ignore[host-sync]
+            return None
+        lo, hi = int(sp[0]), int(sp[1])  # tpulint: ignore[host-sync]
+        span = hi - lo + 1
+        # same density bound as the per-stage fast path: the table must
+        # stay proportional to the build tile (8x) and bounded absolutely
+        if span <= 0 or span > min(8 * build_cap, 1 << 23):
+            return None
+        return lo, hi
+
+    def _join_tail(self, node, probe: _Lowered,
+                   build: _Lowered) -> _Lowered:
+        jnp = _jnp()
         jt = node.join_type
         lattrs = node._left_attrs
         rattrs = node.right.output
@@ -837,15 +995,30 @@ class _ProgramBuilder:
         if join_id >= len(self.join_caps):
             self.join_caps.append(max(probe.cap, 1 << 10))
         out_cap = self.join_caps[join_id]
+        eligible = self._dense_eligible(node)
+        dense = self._dense_span(join_id, build.cap) if eligible else None
+        if dense is not None:
+            # dense 1:1 probe: one output row per probe row, no
+            # expansion buffer — the join cap never binds
+            out_cap = probe.cap
+            self.dense_joins.append(join_id)
+            self.ctx.metrics.add("cache.join_span_seeded")
+        if eligible:
+            self.span_jids.append(join_id)
         self.key.append(("join", jt, lk, rk, out_cap, lk_bool, rk_bool,
                          tuple(x[1] for x in lk_luts),
-                         tuple(x[1] for x in rk_luts)))
+                         tuple(x[1] for x in rk_luts),
+                         ("dense",) + dense if dense is not None
+                         else None, eligible))
         semi_anti = jt in ("left_semi", "left_anti")
         if semi_anti:
             metas = list(probe.metas)
         else:
             metas = list(probe.metas) + [
                 _MCol(m.dtype, True, m.sdict) for m in build.metas]
+        if dense is not None:
+            return self._join_dense(node, probe, build, metas, lk, rk,
+                                    dense, semi_anti)
 
         def eqs_of(d, v, idx, luts, bools, args):
             eqs, valids = [], []
@@ -872,6 +1045,19 @@ class _ProgramBuilder:
             r = J.probe_join(bi_, beqs, bvalids, peqs, pvalids, pm, _oc,
                              jt)
             needed.append(r.needed)
+            if eligible:
+                # observe the build-key span + uniqueness so the NEXT
+                # same-fingerprint run (via the warm-start manifest)
+                # compiles the dense direct-address variant directly
+                bk = beqs[0].astype(jnp.int64)
+                blive = bm if bvalids[0] is None else (bm & bvalids[0])
+                big = jnp.int64(1) << 62
+                lo_o = jnp.min(jnp.where(blive, bk, big))
+                hi_o = jnp.max(jnp.where(blive, bk, -big))
+                sk = jnp.sort(jnp.where(blive, bk, big))
+                dup = jnp.any((sk[1:] == sk[:-1]) & (sk[:-1] != big)) \
+                    if sk.shape[0] > 1 else jnp.asarray(False)
+                needed.spans.append((lo_o, hi_o, dup.astype(jnp.int32)))
             if semi_anti:
                 datas = [jnp.take(x, r.probe_idx) for x in pd]
                 valids = [None if x is None else jnp.take(x, r.probe_idx)
@@ -889,6 +1075,74 @@ class _ProgramBuilder:
             return datas, valids, r.out_mask
 
         return _Lowered(metas, out_cap, emit)
+
+    def _join_dense(self, node, probe: _Lowered, build: _Lowered, metas,
+                    lk, rk, dense, semi_anti) -> _Lowered:
+        """Dense direct-address probe inside the whole program: the same
+        scatter/take body as the per-stage fast path (operators.py), but
+        compiled up front from the warm-start manifest's build-key span
+        instead of a host-synced value inspection. A guard scalar rides
+        the dispatch: if the data drifted off the seeded span (or grew a
+        duplicate) the host disables dense for this join and re-lowers —
+        one extra round, never a wrong result."""
+        jnp = _jnp()
+        lo, hi = dense
+        tcap = bucket_capacity(hi - lo + 1)
+        jt = node.join_type
+        self.guard_jids.append(self._join_seq - 1)
+        pcap, bcap = probe.cap, build.cap
+        self.ctx.metrics.add("join.dense_fast_path")
+
+        def emit(args, needed, _probe=probe, _build=build):
+            from jax import lax
+
+            pd, pv, pm = _probe.emit(args, needed)
+            bd, bv, bm = _build.emit(args, needed)
+            bk = bd[rk[0]].astype(jnp.int64)
+            bvd = bv[rk[0]]
+            blive = bm if bvd is None else (bm & bvd)
+            big = jnp.int64(1) << 62
+            lo_o = jnp.min(jnp.where(blive, bk, big))
+            hi_o = jnp.max(jnp.where(blive, bk, -big))
+            # dead/out-of-span rows dump past the table: mode="drop"
+            # discards out-of-bounds scatters (same idiom as per-stage)
+            slot = jnp.where(blive, bk - lo, tcap)
+            rowidx = jnp.full((tcap,), 0, jnp.int32).at[slot].set(
+                lax.iota(jnp.int32, bcap), mode="drop")
+            present = jnp.zeros((tcap,), jnp.int32).at[slot].add(
+                1, mode="drop")
+            dup = jnp.max(present) > 1
+            guard = (lo_o < lo) | (hi_o > hi) | dup
+            needed.guards.append(guard.astype(jnp.int32))
+            needed.spans.append((lo_o, hi_o, dup.astype(jnp.int32)))
+            needed.append(jnp.zeros((), jnp.int64))  # cap-slot alignment
+            pk = pd[lk[0]].astype(jnp.int64) - lo
+            in_range = (pk >= 0) & (pk < tcap)
+            pslot = jnp.clip(pk, 0, tcap - 1)
+            usable = pm & in_range
+            pvd = pv[lk[0]]
+            if pvd is not None:
+                usable = usable & pvd
+            matched = usable & (jnp.take(present, pslot) > 0)
+            bidx = jnp.take(rowidx, pslot)
+            if jt in ("inner", "left_semi"):
+                out_mask = matched
+            elif jt == "left_outer":
+                out_mask = pm
+            else:  # left_anti (full_outer never admits to this tier)
+                out_mask = pm & ~matched
+            if semi_anti:
+                return list(pd), list(pv), out_mask
+            datas = list(pd)
+            valids = list(pv)
+            for x, xv in zip(bd, bv):
+                datas.append(jnp.take(x, bidx))
+                base = jnp.take(xv, bidx) if xv is not None \
+                    else jnp.ones(pcap, dtype=bool)
+                valids.append(base & matched)
+            return datas, valids, out_mask
+
+        return _Lowered(metas, pcap, emit)
 
     # -- union -------------------------------------------------------------
     def _lower_union(self, node, lows: list) -> _Lowered:
@@ -949,6 +1203,25 @@ class _ProgramBuilder:
             return datas, valids, mask
 
         return _Lowered(metas, cap, emit)
+
+
+def _record_spans(ctx, b: _ProgramBuilder, spans, n_joins: int) -> None:
+    """Stash the observed build-side key spans on the context (aligned
+    by join id with persist_join_caps) so the close-time manifest write
+    carries them — the NEXT same-fingerprint run seeds the dense
+    direct-address probe variant from them (sp[2]=1 means unique)."""
+    if not b.span_jids:
+        return
+    out: list = [None] * n_joins
+    for jid, (lo, hi, dup) in zip(b.span_jids, spans):
+        lo_i = int(lo)  # tpulint: ignore[host-sync]
+        hi_i = int(hi)  # tpulint: ignore[host-sync]
+        if hi_i < lo_i:
+            continue  # empty build side: nothing worth seeding
+        uniq = 0 if int(dup) else 1  # tpulint: ignore[host-sync]
+        out[jid] = [lo_i, hi_i, uniq]
+    if any(s is not None for s in out):
+        ctx.persist_join_spans = out
 
 
 # ---------------------------------------------------------------------------
@@ -1089,26 +1362,34 @@ class WholeQueryExec(PhysicalPlan):
         # replaying the capacity-retry ladder. Absent/short seeds fall
         # back to the normal per-join defaults; an under-sized seed just
         # re-enters the ordinary retry loop.
-        seed = (getattr(ctx, "persist_seed", None) or {}).get("join_caps")
+        seed_rec = getattr(ctx, "persist_seed", None) or {}
+        seed = seed_rec.get("join_caps")
         join_caps: list[int] = [int(c) for c in (seed or ())]
         if join_caps:
             ctx.metrics.add("cache.capacity_seeded")
+        spans_seed = seed_rec.get("join_spans") or None
+        dense_off: set[int] = set()
         with span:
             for attempt in range(_MAX_PROGRAM_RETRIES):
-                b = _ProgramBuilder(ctx, join_caps)
+                b = _ProgramBuilder(ctx, join_caps,
+                                    spans_seed=spans_seed,
+                                    dense_off=dense_off)
                 root = b.lower(self.plan)
                 key = ("whole_query", tuple(b.key))
 
                 def build(_root=root, _nargs=len(b.args)):
                     def program(args):
-                        needed: list = []
+                        needed = _Collect()
                         datas, valids, mask = _root.emit(args, needed)
-                        return datas, valids, mask, tuple(needed)
+                        return (datas, valids, mask, tuple(needed),
+                                tuple(needed.spans),
+                                tuple(needed.guards))
 
                     return jax.jit(program)
 
                 kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build)
-                datas, valids, mask, needed = kernel(b.args)
+                datas, valids, mask, needed, spans, guards = \
+                    kernel(b.args)
                 # the program's ONE capacity verdict: join `needed`
                 # scalars sync after the single dispatch (the query's
                 # last device interaction before collect)
@@ -1117,6 +1398,14 @@ class WholeQueryExec(PhysicalPlan):
                     n_i = int(nd)  # tpulint: ignore[host-sync]
                     if n_i > join_caps[i]:
                         join_caps[i] = bucket_capacity(n_i)
+                        bumped = True
+                # dense-probe guards: the seeded span no longer covers
+                # the build rows (data drifted under the fingerprint) —
+                # drop the dense variant for that join and re-lower
+                for jid, g in zip(b.guard_jids, guards):
+                    if int(g):  # tpulint: ignore[host-sync]
+                        dense_off.add(jid)
+                        ctx.metrics.add("whole_query.dense_guard_retries")
                         bumped = True
                 if not bumped:
                     if attempt:
@@ -1127,6 +1416,10 @@ class WholeQueryExec(PhysicalPlan):
                         # capacity outcomes for the warm-start manifest
                         # (QueryExecution writes it at query close)
                         ctx.persist_join_caps = list(join_caps)
+                    if b.dense_joins:
+                        ctx.metrics.add("whole_query.dense_probe",
+                                        len(b.dense_joins))
+                    _record_spans(ctx, b, spans, len(join_caps))
                     schema = attrs_schema(self.output)
                     cols = [Column(f.dataType, d, v,
                                    m.sdict if dict_encoded(f.dataType)
