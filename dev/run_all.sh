@@ -7,6 +7,9 @@ cd "$(dirname "$0")/.."
 echo "== tpulint (static analysis vs baseline) =="
 python dev/tpulint.py spark_tpu --baseline dev/tpulint_baseline.json
 
+echo "== racecheck (static race & lock-discipline model vs baseline) =="
+python dev/racecheck.py spark_tpu --baseline dev/race_baseline.json
+
 echo "== native build =="
 make -C native
 
@@ -61,6 +64,9 @@ python bench.py --smoke --serve-restart serve_restart
 echo "== serve gate (fair pools, admission, scope-exact attribution, drain) =="
 JAX_PLATFORMS=cpu python dev/validate_trace.py --serve
 python bench.py --smoke --serve serve
+
+echo "== race gate (lockwatch: guard checks + acquisition orders vs static model) =="
+JAX_PLATFORMS=cpu python dev/validate_trace.py --race
 
 echo "== perfcheck (deterministic counters of bench --smoke vs baseline) =="
 python dev/perfcheck.py

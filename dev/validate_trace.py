@@ -112,9 +112,24 @@ Four checks, all hard failures:
     balanced. Self-contained: `validate_trace.py --mesh-whole` with no
     trace path runs only this gate.
 
+12. Race gate (--race) — runtime lock-discipline validation: the
+    8-session serve load and a 2-worker cluster chaos leg (transient
+    block-fetch flap plus a deterministic transport-retry exercise) run
+    under utils/lockwatch.py with every registered lock watched. Every
+    instrumented guard must be HELD where the static race_lint model
+    claims (zero guard violations, the RETRY_STATS counter actually
+    exercised), the union of the statically inferred lock-nesting graph
+    and the runtime-observed acquisition-order edges must stay acyclic
+    (an observed order the static model missed that closes a cycle is a
+    latent deadlock), the registered watch slots must all exist in the
+    static lock inventory, attribution must stay scope-exact under
+    watching, and disable() must restore raw locks (the structural
+    zero-overhead-when-idle claim). Self-contained:
+    `validate_trace.py --race` with no trace path runs only this gate.
+
 Usage: python dev/validate_trace.py [--cluster] [--live] [--mesh]
        [--encoded] [--whole-query] [--mesh-whole] [--chaos]
-       [--profile] [--serve] [<trace.json>]
+       [--profile] [--serve] [--race] [<trace.json>]
 """
 
 import json
@@ -1521,6 +1536,218 @@ def serve_gate() -> None:
           "drain quiesced with a balanced ledger")
 
 
+def race_gate() -> None:
+    """Race gate (--race, self-contained): runtime validation of the
+    static race_lint concurrency model (see module docstring #12). Runs
+    the two real concurrent loads CI already trusts — the 8-session
+    serve load and a 2-worker cluster leg with a transient block-fetch
+    flap plus a deterministic transport-retry exercise — with
+    utils/lockwatch.py watching every registered lock, then cross-checks
+    the observations against the static model built by
+    analysis/race_lint.py."""
+    import tempfile
+    import threading
+
+    # Watch BEFORE any session exists so module-level registered locks
+    # swap to proxies, and export the env var so spawned cluster workers
+    # inherit watching through their environment.
+    os.environ["SPARK_TPU_LOCKWATCH"] = "1"
+    from spark_tpu.utils import faults, lockwatch
+    lockwatch.enable()
+    lockwatch.reset_observations()
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu import TpuSession
+    from spark_tpu.config import SQLConf
+    from spark_tpu.net.transport import (
+        RETRY_STATS, RetryPolicy, RpcClient, RpcServer,
+    )
+    from spark_tpu.obs.history import ProfileStore
+    from spark_tpu.obs.resources import GLOBAL_LEDGER
+    from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE as KC
+    from spark_tpu.serve import QueryService
+    from spark_tpu.serve.loadgen import run_serve_load
+
+    def watchdog(name, fn, timeout_s=120.0):
+        out: dict = {}
+
+        def run():
+            try:
+                out["result"] = fn()
+            except BaseException as e:   # re-raised on the gate thread
+                out["error"] = e
+
+        t = threading.Thread(target=run, daemon=True, name=f"race-{name}")
+        t.start()
+        t.join(timeout_s)
+        if t.is_alive():
+            fail(f"--race: leg {name!r} HUNG past {timeout_s}s under "
+                 "lockwatch — watching must never introduce a deadlock")
+        if "error" in out:
+            raise out["error"]
+        return out.get("result")
+
+    def leg_serve():
+        """The serve-gate concurrent load (8 cloned sessions, 2 pools)
+        run under watching; attribution must stay scope-exact, proving
+        the proxies perturb nothing the obs layer measures."""
+        profile_dir = tempfile.mkdtemp(prefix="race_gate_prof_")
+        session = TpuSession("race-gate-serve", {
+            "spark.sql.shuffle.partitions": 2,
+            "spark.tpu.batch.capacity": 1 << 12,
+            "spark.tpu.fusion.minRows": "0",
+            "spark.tpu.obs.profileDir": profile_dir,
+            "spark.tpu.scheduler.pools": "dash:2,batch:1",
+            "spark.tpu.serve.maxConcurrent": 2,
+        })
+        try:
+            rng = np.random.default_rng(11)
+            session.createDataFrame(pa.table({
+                "k": rng.integers(0, 16, 4000).astype(np.int64),
+                "v": rng.integers(-50, 150, 4000).astype(np.int64),
+            })).createOrReplaceTempView("race_gate_t")
+            service = QueryService(session)
+            before = KC.launches
+            report = run_serve_load(
+                service,
+                ["select k, sum(v) s from race_gate_t group by k",
+                 "select k, v from race_gate_t where v > 0 "
+                 "order by v limit 16"],
+                sessions=8, reps=2, pools=("dash", "batch"))
+            if report["errors"]:
+                fail(f"--race: serve load failed under lockwatch: "
+                     f"{report['errors']}")
+            kc_delta = KC.launches - before
+            store = ProfileStore(profile_dir)
+            attributed = sum(int(p.get("launch_total", 0))
+                             for qk in store.query_keys()
+                             for p in store.profiles(qk))
+            if attributed != kc_delta:
+                fail(f"--race: watched serve load attribution "
+                     f"({attributed}) != KernelCache delta ({kc_delta}) "
+                     "— lockwatch perturbed the obs scope machinery")
+        finally:
+            session.stop()
+
+    def leg_cluster():
+        """2-worker cluster chaos leg: a transient block-fetch flap must
+        still return correct rows with watching live in driver AND
+        workers (inherited env), then a deterministic rpc.call flap
+        drives the RETRY_STATS locked-counter bump so its guard check
+        fires on record."""
+        session = TpuSession("race-gate-cluster", {
+            "spark.sql.shuffle.partitions": "2",
+            "spark.tpu.batch.capacity": 1 << 12,
+            "spark.sql.adaptive.enabled": "false",
+            "spark.tpu.cluster.enabled": "true",
+            "spark.tpu.cluster.workers": "2",
+        })
+        try:
+            rng = np.random.default_rng(13)
+            keys = rng.integers(0, 24, 4000)
+            vals = rng.integers(-40, 90, 4000)
+            session.createDataFrame(pa.table({"k": keys, "v": vals})) \
+                .createOrReplaceTempView("rg_t")
+            rows = sorted(zip(keys.tolist(), vals.tolist()))
+            session.conf.set("spark.tpu.faults.enabled", "true")
+            session.conf.set("spark.tpu.faults.seed", "13")
+            session.conf.set("spark.tpu.faults.points",
+                             "block.fetch=first:2")
+            faults.configure(session.conf)
+            got = sorted(
+                (r["k"], r["v"]) for r in
+                session.table("rg_t").repartition(2).collect())
+            if got != rows:
+                fail("--race: cluster flap query returned WRONG rows "
+                     "under lockwatch")
+        finally:
+            faults.reset()
+            session.stop()
+
+        server = RpcServer("rg")
+        server.register("echo", lambda p: p)
+        addr = server.start()
+        try:
+            c = RpcClient(addr, "rg")
+            faults.configure(SQLConf({
+                "spark.tpu.faults.enabled": "true",
+                "spark.tpu.faults.points": "rpc.call=first:1"}))
+            before = RETRY_STATS["absorbed"]
+            out = c.call("echo", b"y",
+                         retry=RetryPolicy(attempts=3, base_ms=1.0,
+                                           deadline_s=5.0))
+            if out != b"y" or RETRY_STATS["absorbed"] <= before:
+                fail("--race: transport retry exercise did not absorb "
+                     "the injected flap")
+            c.close()
+        finally:
+            faults.reset()
+            server.stop()
+
+    try:
+        watchdog("serve-load", leg_serve)
+        watchdog("cluster-chaos", leg_cluster)
+
+        # -- cross-check 1: every claimed guard was HELD where claimed --
+        viol = lockwatch.violations()
+        if viol:
+            fail(f"--race: {len(viol)} guard check(s) found the claimed "
+                 f"lock NOT held at a flagged mutation site, e.g. "
+                 f"{viol[0]}")
+        checks = lockwatch.guard_checks()
+        if not any(site.startswith("net.transport.RETRY_STATS")
+                   for site, _lock in checks):
+            fail("--race: the RETRY_STATS guard was never exercised — "
+                 "the retry leg did not drive the instrumented counter")
+        acq = lockwatch.acquire_counts()
+        if not acq:
+            fail("--race: no watched-lock acquisitions recorded — "
+                 "lockwatch was not live during the load")
+
+        # -- cross-check 2: the static and runtime halves share one
+        # lock namespace, and their union stays acyclic ----------------
+        from spark_tpu.analysis import race_lint
+        model = race_lint.build_model(
+            [os.path.join(_ROOT, "spark_tpu")], repo_root=_ROOT)
+        static_locks = set(model.locks)
+        unknown = [n for n in lockwatch.registered_names()
+                   if not n.startswith("counter.")
+                   and n not in static_locks]
+        if unknown:
+            fail(f"--race: registered watch slots unknown to the static "
+                 f"model: {unknown} — the two halves drifted apart")
+        observed = set(lockwatch.order_edges())
+        static_edges = {tuple(e) for e in model.lock_edges}
+        cyc = lockwatch.find_cycle(observed | static_edges)
+        if cyc:
+            fail("--race: observed acquisition orders close a lock-order "
+                 f"cycle the static model missed: {' -> '.join(cyc)}")
+
+        problems = GLOBAL_LEDGER.verify()
+        if problems:
+            fail(f"--race: device ledger inconsistent after watched "
+                 f"run: {problems[:3]}")
+    finally:
+        lockwatch.disable()
+        os.environ.pop("SPARK_TPU_LOCKWATCH", None)
+
+    # disable() must restore RAW locks in every registered slot — the
+    # zero-overhead-when-idle claim is structural, so verify structure
+    import threading as _threading
+    raw_lock_type = type(_threading.Lock())
+    if not isinstance(RETRY_STATS._lock, raw_lock_type):
+        fail("--race: disable() left a WatchedLock proxy installed — "
+             "idle runs would pay the watching overhead")
+    print("validate_trace: race gate OK — serve load (8 sessions) and "
+          "2-worker chaos leg ran watched with exact attribution, "
+          f"{len(checks)} guard site(s) held where claimed, 0 guard "
+          f"violations, {len(observed)} observed acquisition edge(s) "
+          "union the static nesting graph acyclic, raw locks restored "
+          "on disable")
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     cluster = "--cluster" in argv
@@ -1533,13 +1760,15 @@ def main(argv=None) -> int:
     profile = "--profile" in argv
     persist = "--persist" in argv
     serve = "--serve" in argv
+    race = "--race" in argv
     argv = [a for a in argv if a not in ("--cluster", "--live", "--mesh",
                                          "--encoded", "--whole-query",
                                          "--mesh-whole",
                                          "--chaos", "--profile",
-                                         "--persist", "--serve")]
+                                         "--persist", "--serve",
+                                         "--race")]
     if (mesh or encoded or whole or mesh_whole or chaos or profile
-            or persist or serve) and not argv:
+            or persist or serve or race) and not argv:
         # self-contained legs: these gates generate and validate their
         # own state (dev/run_all.sh runs them without a trace file)
         if mesh:
@@ -1558,6 +1787,8 @@ def main(argv=None) -> int:
             persist_gate()
         if serve:
             serve_gate()
+        if race:
+            race_gate()
         print("validate_trace: PASS")
         return 0
     if len(argv) != 1:
@@ -1584,6 +1815,8 @@ def main(argv=None) -> int:
         persist_gate()
     if serve:
         serve_gate()
+    if race:
+        race_gate()
     print("validate_trace: PASS")
     return 0
 

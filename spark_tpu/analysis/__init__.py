@@ -2,7 +2,7 @@
 EXPLAIN CODEGEN / debugCodegen surface plus the lint layer the reference
 spreads across Catalyst checks and scalastyle rules).
 
-Two cooperating passes:
+Three cooperating passes:
 
   * analysis.lint — AST-level source lint over spark_tpu/ for host-sync,
     recompile, and fusion-break hazards in operator/kernel hot paths
@@ -12,6 +12,11 @@ Two cooperating passes:
     why stage boundaries did or did not fuse, and flags recompile and
     dtype-overflow hazards (surfaced via df.explain("analysis"),
     QueryExecution.analysis_report(), and bench.py --analyze).
+  * analysis.race_lint — whole-repo concurrency model: shared-mutation
+    races, lock-order cycles, contextvar-losing thread spawns, and
+    worker re-init gaps (CLI: dev/racecheck.py, baseline:
+    dev/race_baseline.json; runtime cross-check: utils/lockwatch.py +
+    dev/validate_trace.py --race).
 """
 
 from .lint import (  # noqa: F401
@@ -19,3 +24,6 @@ from .lint import (  # noqa: F401
     write_baseline,
 )
 from .plan_lint import AnalysisReport, analyze_plan  # noqa: F401
+from .race_lint import (  # noqa: F401
+    RepoModel, build_model, build_model_from_sources,
+)

@@ -130,6 +130,12 @@ class TpuSession:
         # default; chaos runs flip it per session and the rules ship to
         # workers with the rest of the conf
         _faults.configure(self.conf)
+        from ..utils import lockwatch as _lockwatch
+
+        # runtime lock-discipline watching (spark.tpu.lockwatch.enabled)
+        # — off by default: raw unwrapped locks, zero overhead; the
+        # --race gate enables it per session / via SPARK_TPU_LOCKWATCH=1
+        _lockwatch.configure(self.conf)
         from ..exec import persist_cache as _persist
 
         # persistent compile/result caches (spark.tpu.cache.*) — off by

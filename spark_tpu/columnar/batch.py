@@ -90,9 +90,15 @@ class StringDict:
     def __len__(self) -> int:
         return len(self.values)
 
+    # The lazy caches below (_index/_device_hashes/_device_ranks/
+    # _hash_luts/_token) are pure functions of `values`, which is frozen
+    # at construction: par_map lanes racing on first touch recompute the
+    # SAME value and last-writer-wins is safe (wasted work, never a
+    # wrong answer). A lock here would serialize jnp.asarray uploads.
     @property
     def index(self) -> dict[str, int]:
         if self._index is None:
+            # race-lint: ignore[shared-mutation] — idempotent lazy memo
             self._index = {v: i for i, v in enumerate(self.values)}
         return self._index
 
@@ -124,6 +130,7 @@ class StringDict:
             import jax.numpy as jnp
 
             h = self.hashes if len(self.values) else np.zeros(1, np.int64)
+            # race-lint: ignore[shared-mutation] — idempotent lazy memo
             self._device_hashes = jnp.asarray(h)
         return self._device_hashes
 
@@ -132,6 +139,7 @@ class StringDict:
             import jax.numpy as jnp
 
             r = self.ranks if len(self.values) else np.zeros(1, np.int32)
+            # race-lint: ignore[shared-mutation] — idempotent lazy memo
             self._device_ranks = jnp.asarray(r)
         return self._device_ranks
 
@@ -149,12 +157,14 @@ class StringDict:
         n = max(len(self.values), 1)
         bucket = bucket_capacity(n, minimum=minimum)
         if self._hash_luts is None:
+            # race-lint: ignore[shared-mutation] — idempotent lazy memo
             self._hash_luts = {}
         lut = self._hash_luts.get(bucket)
         if lut is None:
             h = np.zeros(bucket, dtype=np.int64)
             if len(self.values):
                 h[: len(self.values)] = self.hashes
+            # race-lint: ignore[shared-mutation] — idempotent lazy memo
             lut = self._hash_luts[bucket] = jnp.asarray(h)
         return lut
 
@@ -171,6 +181,7 @@ class StringDict:
                 s = v if isinstance(v, str) else repr(canon_value(v))
                 h.update(s.encode("utf-8", "surrogatepass"))
                 h.update(b"\x00")
+            # race-lint: ignore[shared-mutation] — idempotent lazy memo
             self._token = h.hexdigest()
         return self._token
 

@@ -550,6 +550,17 @@ FAULTS_ENABLED = _register(ConfigEntry(
     "zero overhead on healthy runs. Ships to workers like all conf.",
     _bool))
 
+LOCKWATCH_ENABLED = _register(ConfigEntry(
+    "spark.tpu.lockwatch.enabled", False,
+    "Runtime lock-discipline validation (utils/lockwatch.py): swap "
+    "registered process-global locks for watching proxies that record "
+    "acquisition orders and held-lock sets at instrumented mutation "
+    "sites; dev/validate_trace.py --race cross-checks the records "
+    "against the static race_lint model. Off (default) runs raw "
+    "unwrapped locks — zero overhead. SPARK_TPU_LOCKWATCH=1 enables at "
+    "import time and ships to cluster workers via their environment.",
+    _bool))
+
 FAULTS_SEED = _register(ConfigEntry(
     "spark.tpu.faults.seed", 0,
     "Seed for probabilistic fault rules; identical seed + call order "

@@ -134,6 +134,8 @@ class SQLEndpoint:
             return _error_resp(e)
 
     def start(self) -> "SQLEndpoint":
+        # race-lint: ignore[bare-submit] — HTTP accept loop for the whole
+        # endpoint; per-request queries enter their own scope downstream
         self._thread = threading.Thread(
             target=self._server.serve_forever, daemon=True,
             name="sql-endpoint")

@@ -76,6 +76,8 @@ class Master:
 
     def start(self) -> str:
         self.address = self._server.start()
+        # race-lint: ignore[bare-submit] — master liveness monitor:
+        # process-lifetime, never runs query-scoped work
         threading.Thread(target=self._monitor_loop, daemon=True).start()
         return self.address
 

@@ -54,6 +54,8 @@ class WorkerDaemon:
         self._master = RpcClient(self.master_addr, self.token)
         self._master.wait_ready(30)
         self.worker_id = self._register()
+        # race-lint: ignore[bare-submit] — deploy-plane heartbeat:
+        # process-lifetime, never runs query-scoped work
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
         return self.address
 

@@ -21,6 +21,7 @@ another executor (TaskSetManager.maxFailures role).
 
 from __future__ import annotations
 
+import contextvars
 import os
 import pickle
 import secrets
@@ -234,6 +235,8 @@ class LocalCluster:
         self._active_tasks = 0
         self._stopping = False
         if dynamic_allocation:
+            # race-lint: ignore[bare-submit] — executor-fleet sizing
+            # loop: session-lifetime, aggregates across queries
             threading.Thread(target=self._allocation_loop,
                              daemon=True).start()
 
@@ -658,7 +661,14 @@ class LocalCluster:
 
         def launch(w: _Worker):
             in_flight[0] += 1
-            threading.Thread(target=attempt, args=(w,), daemon=True).start()
+            # the attempt dispatches THIS query's task: copy the
+            # caller's contextvar scope onto the runner thread so any
+            # obs recorded around the RPC keeps its query attribution
+            ctx = contextvars.copy_context()
+            # race-lint: ignore[bare-submit] — scope propagated
+            # explicitly via ctx.run on the line above
+            threading.Thread(target=ctx.run, args=(attempt, w),
+                             daemon=True).start()
 
         launch(primary)
         threshold = self._speculation_threshold()

@@ -298,6 +298,8 @@ class HistoryServer:
         self._thread: threading.Thread | None = None
 
     def start(self) -> "HistoryServer":
+        # race-lint: ignore[bare-submit] — HTTP accept loop serving
+        # COMPLETED queries' history; no live query scope exists here
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name="history-server")

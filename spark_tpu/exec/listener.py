@@ -57,6 +57,9 @@ class ListenerBus:
         with self._lock:
             self._listeners.append(listener)
             if self._thread is None:
+                # race-lint: ignore[bare-submit] — listener-bus drain:
+                # events carry their query ids IN the payload; the
+                # drain thread itself must stay scope-neutral
                 self._thread = threading.Thread(
                     target=self._drain, daemon=True, name="listener-bus")
                 self._thread.start()

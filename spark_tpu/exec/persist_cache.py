@@ -52,11 +52,15 @@ import hashlib
 import json
 import os
 import re
+import sys
 import threading
 import time
 
+from ..utils import lockwatch
+
 __all__ = ["configure", "cache_root", "compile_cache_active",
-           "result_cache_active", "disk_counters", "ResultCache",
+           "result_cache_active", "disk_counters", "reset_disk_counters",
+           "ResultCache",
            "result_cache_for", "result_key", "result_probe",
            "invalidate_path", "record_manifest", "manifest_seed",
            "mesh_quota_key", "mesh_quota_key_plain", "mesh_quota_key_fused"]
@@ -99,6 +103,8 @@ def result_cache_active(conf) -> bool:
 # layer deltas them per query and the KernelCache classifies each
 # kernel's first invocation (disk-served vs true cold compile).
 _COUNTER_LOCK = threading.Lock()
+lockwatch.register("exec.persist_cache._COUNTER_LOCK",
+                   sys.modules[__name__], "_COUNTER_LOCK")
 DISK_HITS = 0
 DISK_MISSES = 0
 
@@ -133,6 +139,15 @@ def disk_counters() -> dict:
     with _COUNTER_LOCK:
         return {"compile.disk_hit": DISK_HITS,
                 "compile.disk_miss": DISK_MISSES}
+
+
+def reset_disk_counters() -> None:
+    """Per-process re-init (a fresh cluster worker starts its disk
+    tallies at zero regardless of what the driver has accumulated)."""
+    global DISK_HITS, DISK_MISSES
+    with _COUNTER_LOCK:
+        DISK_HITS = 0
+        DISK_MISSES = 0
 
 
 def configure(conf) -> None:

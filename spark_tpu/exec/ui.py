@@ -89,6 +89,8 @@ class SparkUI:
         self._thread: threading.Thread | None = None
 
     def start(self) -> "SparkUI":
+        # race-lint: ignore[bare-submit] — UI HTTP accept loop:
+        # session-lifetime, reads finished snapshots only
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True, name="spark-ui")
         self._thread.start()

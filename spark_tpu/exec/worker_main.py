@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import sys
 import threading
 import time
 import traceback
@@ -28,12 +29,15 @@ import traceback
 from ..net.transport import (
     BEST_EFFORT_RETRY, CHUNK_BYTES, RpcClient, RpcServer,
 )
-from ..utils import faults
+from ..utils import faults, lockwatch
+from ..utils.counters import LockedCounter
 
 # (shuffle_id, reduce_id) → Arrow IPC bytes; lives for the worker process
 BLOCK_STORE: dict = {}
 BLOCK_ADDR: str = ""
 _STORE_LOCK = threading.Lock()
+lockwatch.register("exec.worker_main._STORE_LOCK",
+                   sys.modules[__name__], "_STORE_LOCK")
 
 
 _PUSH_CLIENT = None
@@ -130,6 +134,9 @@ def begin_stage_obs(conf, query_id: str | None = None,
     # other process-global switches — chaos runs exercise the worker's
     # task/heartbeat/shuffle-write seams, healthy conf disables them
     faults.configure(conf)
+    # lock-discipline watching follows the shipped conf as well (the
+    # env-var path SPARK_TPU_LOCKWATCH=1 already covered import time)
+    lockwatch.configure(conf)
     from . import persist_cache as _persist
 
     # persistent XLA compile cache: worker processes compile their own
@@ -170,9 +177,12 @@ def begin_stage_obs(conf, query_id: str | None = None,
 
 # heartbeat flush-budget bookkeeping: tasks trimmed to a minimal delta
 # because a beat hit spark.tpu.heartbeat.flushBudget (cumulative — the
-# driver surfaces it in live status), and a rotation cursor so the trim
-# never starves the same tasks every beat
-FLUSH_OVERFLOWS = 0
+# driver surfaces it in live status, and stage tasks / tests read it
+# concurrently with the heartbeat thread's bumps), and a rotation
+# cursor so the trim never starves the same tasks every beat
+FLUSH_OVERFLOWS = LockedCounter("exec.worker_main.FLUSH_OVERFLOWS")
+# race-lint: ignore[worker-reinit] — rotation cursor, not a metric: a
+# fresh worker starting at 0 is exactly the intended semantics
 _FLUSH_RR = 0
 
 # rough per-element payload estimates (pickled size order-of-magnitude):
@@ -206,15 +216,16 @@ def collect_live_obs() -> list:
     Host counters only: parked row-masks stay parked
     (export_op_records_partial), no kernel is launched, no device array
     is read."""
-    global FLUSH_OVERFLOWS, _FLUSH_RR
+    global _FLUSH_RR
 
     from ..obs.metrics import export_op_records_partial
     from ..physical.compile import GLOBAL_KERNEL_CACHE as KC
 
     with _STORE_LOCK:
         states = list(_LIVE_TASKS.values())
+        if states:
+            _FLUSH_RR = (_FLUSH_RR + 1) % len(states)
     if states:
-        _FLUSH_RR = (_FLUSH_RR + 1) % len(states)
         states = states[_FLUSH_RR:] + states[:_FLUSH_RR]
     budget = next((s["flush_budget"] for s in states
                    if s.get("flush_budget")), 0)
@@ -244,7 +255,7 @@ def collect_live_obs() -> list:
                 open_spans = tracer.open_spans()
         state["sent_spans"] = len(spans_closed)
         if trimmed:
-            FLUSH_OVERFLOWS += 1
+            FLUSH_OVERFLOWS.bump()
         kinds = {k: v - state["kinds0"].get(k, 0)
                  for k, v in KC.launches_by_kind.items()
                  if v != state["kinds0"].get(k, 0)}
@@ -449,7 +460,7 @@ def serve_worker(driver_addr: str, token: str, host_label: str = "localhost",
                 payload = pickle.dumps({
                     "eid": eid, "obs": obs,
                     "hbm": GLOBAL_LEDGER.snapshot(),
-                    "obs_overflows": FLUSH_OVERFLOWS})
+                    "obs_overflows": FLUSH_OVERFLOWS.value})
                 reply = driver.call("heartbeat", payload, timeout=5,
                                     compress=bool(obs))
                 if reply != b"unknown":
@@ -472,6 +483,9 @@ def serve_worker(driver_addr: str, token: str, host_label: str = "localhost",
                 if misses >= 5:  # driver gone — shut down
                     os._exit(0)
 
+    # race-lint: ignore[bare-submit] — process-lifetime service thread:
+    # heartbeats aggregate across every query on this worker and must
+    # NOT inherit any single query's contextvar scope
     threading.Thread(target=heartbeat_loop, daemon=True).start()
     if block:
         threading.Event().wait()

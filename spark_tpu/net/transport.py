@@ -27,6 +27,7 @@ from typing import Callable, Iterator
 import grpc
 
 from ..utils import faults
+from ..utils.counters import LockedCounterMap
 
 SERVICE = "sparktpu.Transport"
 CHUNK_BYTES = 4 << 20
@@ -34,8 +35,13 @@ _AUTH_KEY = "sparktpu-auth"
 
 # process-wide retry bookkeeping (tests and the chaos gate read these):
 # absorbed = transient UNAVAILABLE errors a retry recovered from;
-# gave_up = logical calls that exhausted their retry budget
-RETRY_STATS = {"absorbed": 0, "gave_up": 0}
+# gave_up = logical calls that exhausted their retry budget.
+# RPC clients retry concurrently from heartbeat, fetch, and serve
+# threads — a bare dict += here is a read-modify-write race (lost
+# updates), so the tallies live behind the locked-counter helper;
+# reads (stats["absorbed"]) still return plain ints.
+RETRY_STATS = LockedCounterMap("net.transport.RETRY_STATS",
+                               ("absorbed", "gave_up"))
 
 
 class RetryPolicy:
@@ -266,18 +272,18 @@ class RpcClient:
                 except grpc.RpcError as e:
                     raise self._classify(method, e) from None
                 if attempt:
-                    RETRY_STATS["absorbed"] += 1
+                    RETRY_STATS.bump("absorbed")
                 break
             except RpcUnavailableError:
                 attempt += 1
                 if retry is None or attempt > retry.attempts:
                     if retry is not None:
-                        RETRY_STATS["gave_up"] += 1
+                        RETRY_STATS.bump("gave_up")
                     raise
                 wait = retry.backoff_s(attempt)
                 if deadline is not None and \
                         time.monotonic() + wait >= deadline:
-                    RETRY_STATS["gave_up"] += 1
+                    RETRY_STATS.bump("gave_up")
                     raise
                 time.sleep(wait)
         if raw.startswith(_ERR_PREFIX):

@@ -675,6 +675,8 @@ class ConsoleProgressReporter:
         self._last_len = 0
 
     def start(self) -> "ConsoleProgressReporter":
+        # race-lint: ignore[bare-submit] — console repaint loop: renders
+        # EVERY live query from the registry, must not pin one scope
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="spark-tpu-progress")
         self._thread.start()

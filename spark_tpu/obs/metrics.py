@@ -30,10 +30,13 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import sys
 import threading
 from dataclasses import dataclass, field
 
 import numpy as np
+
+from ..utils import lockwatch
 
 __all__ = ["AnalyzedReport", "QueryKernelLedger", "batch_cost_scope",
            "current_op_name", "current_query_ledger",
@@ -60,6 +63,8 @@ _SCOPE: "contextvars.ContextVar" = contextvars.ContextVar(
 # per-record Counter updates are read-modify-write; lanes of one operator
 # share its record, so serialize the tiny increments
 _ATTR_LOCK = threading.Lock()
+lockwatch.register("obs.metrics._ATTR_LOCK",
+                   sys.modules[__name__], "_ATTR_LOCK")
 
 # live-row fraction of the batch currently dispatching. Captured kernel
 # costs are per-kernel-identity CONSTANTS (first-invocation lowering), so

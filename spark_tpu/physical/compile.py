@@ -329,6 +329,13 @@ class KernelCache:
 
 GLOBAL_KERNEL_CACHE = KernelCache()
 
+# the singleton's counter lock is process-global state worth watching:
+# every par_map lane and serve session bumps launch tallies through it
+from ..utils import lockwatch as _lockwatch  # noqa: E402
+
+_lockwatch.register("physical.compile.KernelCache._lock",
+                    GLOBAL_KERNEL_CACHE, "_lock")
+
 
 # ---------------------------------------------------------------------------
 # Input binding

@@ -760,7 +760,12 @@ class RDDContext:
             finally:
                 self._in_task.flag = False
 
-        futures = [self._pool.submit(wrapped, s) for s in splits]
+        # scoped_submit (NOT pool.submit): each split task re-enters the
+        # caller's contextvar scope, so RDD jobs running inside a traced
+        # query keep their kernel-ledger/span attribution on pool threads
+        from ..obs.metrics import scoped_submit
+
+        futures = [scoped_submit(self._pool, wrapped, s) for s in splits]
         return [f.result() for f in futures]
 
     def _run(self, rdd: RDD) -> list[list]:

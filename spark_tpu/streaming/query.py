@@ -171,6 +171,9 @@ class StreamingQuery:
             os.makedirs(os.path.join(checkpoint_dir, "commits"), exist_ok=True)
             self._recover()
 
+        # race-lint: ignore[bare-submit] — micro-batch driver loop: each
+        # batch ENTERS a fresh query scope itself (a stream outlives any
+        # one query id; inheriting the starter's scope would be wrong)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name=f"stream-{self.id[:8]}")
         self._thread.start()

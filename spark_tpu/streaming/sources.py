@@ -168,6 +168,8 @@ class SocketSource(StreamSource):
         self._lock = threading.Lock()
         self._sock = _socket.create_connection((host, port), timeout=10)
         self._closed = threading.Event()
+        # race-lint: ignore[bare-submit] — socket ingest loop: source-
+        # lifetime I/O pump, produces rows consumed by MANY batches
         threading.Thread(target=self._reader, daemon=True,
                          name="socket-source").start()
 

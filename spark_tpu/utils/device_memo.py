@@ -18,13 +18,18 @@ host-sync rule sanctions reads wrapped in this helper.
 from __future__ import annotations
 
 import collections
+import sys
 import threading
+
+from . import lockwatch
 
 __all__ = ["memo_device_scalars", "seed_dense_range_memo",
            "DENSE_RANGE_KIND"]
 
 _MEMO: "collections.OrderedDict" = collections.OrderedDict()
 _LOCK = threading.Lock()
+lockwatch.register("utils.device_memo._LOCK",
+                   sys.modules[__name__], "_LOCK")
 _MAX = 4096
 
 # cache-key kind shared by dense_range_stats and the arrow-ingest seeding

@@ -46,9 +46,12 @@ switches.
 from __future__ import annotations
 
 import os
+import sys
 import threading
 import time
 import zlib
+
+from . import lockwatch
 
 __all__ = ["ENABLED", "InjectedFault", "configure", "maybe_fail",
            "fire_counts", "reset", "is_transient_marker",
@@ -63,6 +66,7 @@ ENABLED = False
 HOST_LABEL = "driver"
 
 _LOCK = threading.Lock()
+lockwatch.register("utils.faults._LOCK", sys.modules[__name__], "_LOCK")
 _RULES: dict[str, "_Rule"] = {}
 _FIRED: dict[str, int] = {}
 _SEED = 0

@@ -5,6 +5,9 @@ from __future__ import annotations
 import logging
 import os
 
+# race-lint: ignore[worker-reinit] — once-per-process latch: every
+# process (driver or worker) configures its OWN logging on first use,
+# so starting fresh at False in a worker is the intended semantics
 _CONFIGURED = False
 
 

@@ -355,7 +355,7 @@ def test_flush_budget_trims_carries_and_counts_overflow():
             ent["batches"] += 1
             with s["tracer"].span(f"work{s['task_id']}"):
                 pass
-        base = wm.FLUSH_OVERFLOWS
+        base = wm.FLUSH_OVERFLOWS.value
         out = wm.collect_live_obs()
         mine = [d for d in out if d["query"] == "fbq"]
         assert len(mine) == 2
@@ -365,7 +365,7 @@ def test_flush_budget_trims_carries_and_counts_overflow():
         # counter totals survive the trim
         assert all(d["rows"] == 100 and d["batches"] == 1 for d in mine)
         assert all(not d["spans_closed"] for d in trimmed)
-        assert wm.FLUSH_OVERFLOWS > base
+        assert wm.FLUSH_OVERFLOWS.value > base
         wm.ack_live_obs()
         # the trimmed task's spans were carried, not dropped: lift the
         # budget and they ship on the next beat
