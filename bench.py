@@ -1214,6 +1214,10 @@ def _serve_load_leg() -> int:
         "spark.tpu.fusion.minRows": "0",
         "spark.tpu.scheduler.pools": "dash:2,batch:1",
         "spark.tpu.serve.maxConcurrent": "2",
+        # metrics plane on for the whole leg: the scrape at end-of-load
+        # and the drain-time series snapshot are part of the report
+        "spark.tpu.metrics.export": "true",
+        "spark.tpu.metrics.tickInterval": "0.25",
     })
     rng = np.random.default_rng(7)
     n = max(4000, int(100_000 * SCALE))
@@ -1242,7 +1246,18 @@ def _serve_load_leg() -> int:
     repeat_ms = round((time.perf_counter() - t0) * 1000, 2)
     repeat_launches = KC.launches - l0
     rc_hits = int(repeat["counters"].get("result_cache.hit", 0))
+    # end-of-load Prometheus scrape: parse it back and reconcile the
+    # per-pool e2e histogram counts against the queries the load
+    # actually completed (the metrics-plane acceptance identity)
+    from spark_tpu.obs import export as mx
+
+    scrape = mx.render_prometheus()
+    parsed = mx.parse_prometheus(scrape)
+    e2e_count = sum(
+        v for (name, _labels), v in parsed["samples"].items()
+        if name == "spark_tpu_serve_pool_e2e_ms_count")
     service.drain()
+    drain_ts = service.drain_snapshot or {}
     # attribution: per-query scope-exact launch totals (stored profiles)
     # must sum to the process-global KernelCache delta
     store = ProfileStore(profile_dir)
@@ -1267,6 +1282,12 @@ def _serve_load_leg() -> int:
         "disk": pc.disk_counters(),
         "compiles": KC.misses,
         "disk_hit_compiles": KC.disk_hit_compiles,
+        "metrics": {
+            "scrape_bytes": len(scrape),
+            "scrape_samples": len(parsed["samples"]),
+            "e2e_hist_count": int(e2e_count),
+            "drain_series": len(drain_ts.get("series", {})),
+        },
     }), flush=True)
     return 0
 
@@ -1313,11 +1334,13 @@ def bench_serve():
         "value": max(p["p99_ms"] or 0 for p in pools.values()),
         "unit": "ms",
         "vs_baseline": 1.0,
-        "per_pool": {name: {"p50_ms": p["p50_ms"], "p99_ms": p["p99_ms"],
+        "per_pool": {name: {"p50_ms": p["p50_ms"], "p95_ms": p["p95_ms"],
+                            "p99_ms": p["p99_ms"],
                             "completed": p["completed"]}
                      for name, p in pools.items()},
         "queue_depth_peak": cold["load"]["queue_depth_peak"],
         "errors": (cold["load"]["errors"] + warm["load"]["errors"])[:4],
+        "metrics_scrape": cold["metrics"],
     }, {
         "metric": "serve weighted fairness (contended-grant ratio "
                   "normalized by 2:1 weights; 1.0 = proportional)",

@@ -65,6 +65,9 @@ echo "== serve gate (fair pools, admission, scope-exact attribution, drain) =="
 JAX_PLATFORMS=cpu python dev/validate_trace.py --serve
 python bench.py --smoke --serve serve
 
+echo "== metrics gate (export plane: scrape identity, zero overhead, drain ring) =="
+JAX_PLATFORMS=cpu python dev/validate_trace.py --metrics
+
 echo "== race gate (lockwatch: guard checks + acquisition orders vs static model) =="
 JAX_PLATFORMS=cpu python dev/validate_trace.py --race
 

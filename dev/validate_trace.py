@@ -1636,7 +1636,9 @@ def race_gate() -> None:
         still return correct rows with watching live in driver AND
         workers (inherited env), then a deterministic rpc.call flap
         drives the RETRY_STATS locked-counter bump so its guard check
-        fires on record."""
+        fires on record. Returns each worker's own lockwatch
+        observations (the lockwatch_edges RPC) so the cross-checks
+        below cover executor processes, not just the driver."""
         session = TpuSession("race-gate-cluster", {
             "spark.sql.shuffle.partitions": "2",
             "spark.tpu.batch.capacity": 1 << 12,
@@ -1644,6 +1646,7 @@ def race_gate() -> None:
             "spark.tpu.cluster.enabled": "true",
             "spark.tpu.cluster.workers": "2",
         })
+        worker_lw: dict = {}
         try:
             rng = np.random.default_rng(13)
             keys = rng.integers(0, 24, 4000)
@@ -1662,6 +1665,11 @@ def race_gate() -> None:
             if got != rows:
                 fail("--race: cluster flap query returned WRONG rows "
                      "under lockwatch")
+            # pull each worker's lock observations BEFORE teardown —
+            # the executor half of cross-check 2
+            cluster = getattr(session, "_sql_cluster", None)
+            if cluster is not None:
+                worker_lw = cluster.lockwatch_edges()
         finally:
             faults.reset()
             session.stop()
@@ -1685,10 +1693,11 @@ def race_gate() -> None:
         finally:
             faults.reset()
             server.stop()
+        return worker_lw
 
     try:
         watchdog("serve-load", leg_serve)
-        watchdog("cluster-chaos", leg_cluster)
+        worker_lw = watchdog("cluster-chaos", leg_cluster) or {}
 
         # -- cross-check 1: every claimed guard was HELD where claimed --
         viol = lockwatch.violations()
@@ -1719,6 +1728,30 @@ def race_gate() -> None:
             fail(f"--race: registered watch slots unknown to the static "
                  f"model: {unknown} — the two halves drifted apart")
         observed = set(lockwatch.order_edges())
+
+        # -- cross-check 2b: the EXECUTOR processes, via the
+        # lockwatch_edges RPC the cluster leg collected — workers must
+        # have watched (inherited env), reported no guard violations,
+        # registered only slots the static model knows, and their
+        # acquisition edges fold into the same cycle check -------------
+        if not worker_lw:
+            fail("--race: no worker answered the lockwatch_edges RPC — "
+                 "executor-side lock discipline went unchecked")
+        for eid, wp in sorted(worker_lw.items()):
+            if not wp.get("enabled"):
+                fail(f"--race: worker {eid} ran with lockwatch OFF — "
+                     "the env inheritance into executors broke")
+            if wp.get("violations"):
+                fail(f"--race: worker {eid} recorded guard violations: "
+                     f"{wp['violations'][:2]}")
+            unknown_w = [n for n in wp.get("names", ())
+                         if not n.startswith("counter.")
+                         and n not in static_locks]
+            if unknown_w:
+                fail(f"--race: worker {eid} registered watch slots "
+                     f"unknown to the static model: {unknown_w}")
+            observed |= {(a, b) for a, b, _n in wp.get("edges", ())}
+
         static_edges = {tuple(e) for e in model.lock_edges}
         cyc = lockwatch.find_cycle(observed | static_edges)
         if cyc:
@@ -1743,9 +1776,170 @@ def race_gate() -> None:
     print("validate_trace: race gate OK — serve load (8 sessions) and "
           "2-worker chaos leg ran watched with exact attribution, "
           f"{len(checks)} guard site(s) held where claimed, 0 guard "
-          f"violations, {len(observed)} observed acquisition edge(s) "
-          "union the static nesting graph acyclic, raw locks restored "
-          "on disable")
+          f"violations (driver + {len(worker_lw)} workers via the "
+          f"lockwatch_edges RPC), {len(observed)} observed acquisition "
+          "edge(s) union the static nesting graph acyclic, raw locks "
+          "restored on disable")
+
+
+def metrics_gate() -> None:
+    """Metrics gate (--metrics, self-contained): the service metrics
+    plane's acceptance identities under a real serve load —
+
+      1. the new lockwatch slots are registered;
+      2. structural zero overhead: the kernel-launch delta of the same
+         query is IDENTICAL with export on and off;
+      3. under a concurrent load with export on: the Prometheus scrape
+         parses, the per-pool e2e histogram counts sum EXACTLY to the
+         queries the service admitted, per-query attribution stays
+         scope-exact, and the drain snapshot froze a non-empty ring;
+      4. the static race model still matches its baseline (the new
+         locks/threads are modeled, not baselined away).
+    """
+    import subprocess
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu import TpuSession
+    from spark_tpu.obs import export as mx
+    from spark_tpu.obs.history import ProfileStore
+    from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE as KC
+    from spark_tpu.serve import QueryService
+    from spark_tpu.serve.loadgen import run_serve_load
+    from spark_tpu.utils import lockwatch
+
+    # -- 1: the metrics plane's locks are lockwatch-registered -----------
+    names = set(lockwatch.registered_names())
+    for slot in ("obs.export.MetricsRegistry._lock",
+                 "obs.export._TS_LOCK"):
+        if slot not in names:
+            fail(f"--metrics: lock slot {slot!r} is not "
+                 "lockwatch-registered — the metrics plane left the "
+                 "runtime discipline net")
+
+    # hermetic registry: earlier gates in the same process may have
+    # bound sources over their (now-stopped) sessions
+    mx.REGISTRY.reset()
+
+    base = {
+        "spark.sql.shuffle.partitions": 2,
+        "spark.tpu.batch.capacity": 1 << 12,
+        "spark.tpu.fusion.minRows": "0",
+        "spark.tpu.cache.result.enabled": "false",
+    }
+
+    # -- 2: zero overhead — launch delta export on == export off ---------
+    session = TpuSession("metrics-gate-overhead", dict(base))
+    try:
+        rng = np.random.default_rng(17)
+        session.createDataFrame(pa.table({
+            "k": rng.integers(0, 16, 4000).astype(np.int64),
+            "v": rng.integers(-50, 150, 4000).astype(np.int64),
+        })).createOrReplaceTempView("mg_t")
+        probe = "select k, sum(v) s from mg_t group by k"
+        session.sql(probe).collect()            # compile warmup
+        l0 = KC.launches
+        session.sql(probe).collect()
+        delta_off = KC.launches - l0
+        session.conf.set("spark.tpu.metrics.export", "true")
+        mx.configure(session.conf)
+        mx.register_default_sources(session=session)
+        l0 = KC.launches
+        session.sql(probe).collect()
+        delta_on = KC.launches - l0
+        if delta_off <= 0:
+            fail("--metrics: overhead probe launched nothing — the "
+                 "comparison is vacuous")
+        if delta_on != delta_off:
+            fail(f"--metrics: export flipped the kernel-launch count "
+                 f"({delta_off} off -> {delta_on} on) — the metrics "
+                 "plane touched the device path")
+    finally:
+        session.stop()
+
+    # -- 3: serve load with export on --------------------------------
+    profile_dir = tempfile.mkdtemp(prefix="metrics_gate_prof_")
+    session = TpuSession("metrics-gate-serve", {
+        **base,
+        "spark.tpu.obs.profileDir": profile_dir,
+        "spark.tpu.scheduler.pools": "dash:2,batch:1",
+        "spark.tpu.serve.maxConcurrent": 2,
+        "spark.tpu.metrics.export": "true",
+        "spark.tpu.metrics.tickInterval": "0.1",
+    })
+    try:
+        rng = np.random.default_rng(19)
+        session.createDataFrame(pa.table({
+            "k": rng.integers(0, 16, 4000).astype(np.int64),
+            "v": rng.integers(-50, 150, 4000).astype(np.int64),
+        })).createOrReplaceTempView("mg_serve_t")
+        queries = ["select k, sum(v) s from mg_serve_t group by k",
+                   "select k, v from mg_serve_t where v > 0 "
+                   "order by v limit 16"]
+        service = QueryService(session)
+        launches_before = KC.launches
+        warmup = service.open_session()
+        for q in queries:
+            service.execute_sql(warmup, q)
+        sessions_n, reps = 6, 2
+        report = run_serve_load(service, queries, sessions=sessions_n,
+                                reps=reps, pools=("dash", "batch"))
+        if report["errors"]:
+            fail(f"--metrics: load queries failed: {report['errors']}")
+        # the acceptance identity: every admitted collect — warmup plus
+        # the whole load — released through exactly one pool histogram
+        expected = len(queries) * (1 + sessions_n * reps)
+        try:
+            parsed = mx.parse_prometheus(mx.render_prometheus())
+        except ValueError as e:
+            fail(f"--metrics: /metrics scrape does not parse: {e}")
+        e2e_total = sum(
+            v for (name, _lbl), v in parsed["samples"].items()
+            if name == "spark_tpu_serve_pool_e2e_ms_count")
+        if int(e2e_total) != expected:
+            fail(f"--metrics: per-pool e2e histogram counts sum to "
+                 f"{int(e2e_total)}, expected {expected} admitted "
+                 "queries — the admission path leaks or double-counts "
+                 "observations")
+        if "spark_tpu_kernel_launches" not in parsed["types"]:
+            fail("--metrics: scrape is missing the kernel.launches "
+                 "series — default sources not wired")
+        # attribution must stay scope-exact with the plane live
+        kc_delta = KC.launches - launches_before
+        store = ProfileStore(profile_dir)
+        attributed = sum(int(p.get("launch_total", 0))
+                         for qk in store.query_keys()
+                         for p in store.profiles(qk))
+        if attributed != kc_delta:
+            fail(f"--metrics: attributed launches ({attributed}) != "
+                 f"KernelCache delta ({kc_delta}) under the metrics "
+                 "plane — export perturbed scope attribution")
+        service.drain()
+        snap = service.drain_snapshot or {}
+        if not snap.get("series"):
+            fail("--metrics: drain froze an EMPTY time-series ring — "
+                 "the ticker never sampled")
+    finally:
+        session.stop()
+
+    # -- 4: the static race model still matches its baseline ----------
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "dev", "racecheck.py"),
+         "spark_tpu", "--baseline",
+         os.path.join(_ROOT, "dev", "race_baseline.json")],
+        cwd=_ROOT, capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        fail("--metrics: racecheck regressed against its baseline — "
+             "the metrics plane introduced unmodeled concurrency:\n"
+             + proc.stdout[-800:] + proc.stderr[-400:])
+
+    print("validate_trace: metrics gate OK — scrape parses, per-pool "
+          f"e2e histogram counts == {expected} admitted queries, "
+          f"attribution exact ({attributed} launches), launch delta "
+          f"identical export on/off ({delta_on}), drain snapshot "
+          f"{len(snap['series'])} series, racecheck baseline clean")
 
 
 def main(argv=None) -> int:
@@ -1761,14 +1955,15 @@ def main(argv=None) -> int:
     persist = "--persist" in argv
     serve = "--serve" in argv
     race = "--race" in argv
+    metrics = "--metrics" in argv
     argv = [a for a in argv if a not in ("--cluster", "--live", "--mesh",
                                          "--encoded", "--whole-query",
                                          "--mesh-whole",
                                          "--chaos", "--profile",
                                          "--persist", "--serve",
-                                         "--race")]
+                                         "--race", "--metrics")]
     if (mesh or encoded or whole or mesh_whole or chaos or profile
-            or persist or serve or race) and not argv:
+            or persist or serve or race or metrics) and not argv:
         # self-contained legs: these gates generate and validate their
         # own state (dev/run_all.sh runs them without a trace file)
         if mesh:
@@ -1787,6 +1982,8 @@ def main(argv=None) -> int:
             persist_gate()
         if serve:
             serve_gate()
+        if metrics:
+            metrics_gate()
         if race:
             race_gate()
         print("validate_trace: PASS")
@@ -1815,6 +2012,8 @@ def main(argv=None) -> int:
         persist_gate()
     if serve:
         serve_gate()
+    if metrics:
+        metrics_gate()
     if race:
         race_gate()
     print("validate_trace: PASS")

@@ -144,6 +144,13 @@ class TpuSession:
         # installs the disk-hit/miss event counters. Conf ships to
         # workers, whose begin_stage_obs makes the same call.
         _persist.configure(self.conf)
+        from ..obs import export as _export
+
+        # service metrics plane (spark.tpu.metrics.export) — off by
+        # default: no registry sampling, no ticker thread, Prometheus
+        # endpoints report disabled. QueryService wires the scrape
+        # sources; here the switch itself is applied session-wide.
+        _export.configure(self.conf)
         from ..obs.live import LiveObs
 
         # live telemetry store: heartbeat-streamed worker obs partials,

@@ -402,6 +402,15 @@ STRAGGLER_HEARTBEAT_DEADLINE = _register(ConfigEntry(
     "seconds is flagged as a straggler regardless of rate (executor "
     "frozen or partitioned).", float))
 
+STRAGGLER_RATE_WEIGHTS = _register(ConfigEntry(
+    "spark.tpu.straggler.rateWeights", "1,1,1",
+    "Comma-separated rows,batches,launches weights of the straggler "
+    "progress-rate unit (weighted sum per second vs the stage median). "
+    "The default 1,1,1 preserves the original equal weighting; skew "
+    "the weights for workloads where one dimension dominates cost "
+    "(e.g. '1,0,0' for row-bound scans) so cost-skewed stages stop "
+    "false-flagging.", str))
+
 # --- resource observability (spark_tpu/obs/resources.py) -------------------
 
 MEMORY_LEDGER = _register(ConfigEntry(
@@ -703,6 +712,48 @@ SERVE_DRAIN_TIMEOUT = _register(ConfigEntry(
     "new queries are rejected with SERVER_DRAINING immediately; "
     "in-flight (and already-queued) queries get this long to finish "
     "and flush their query profiles before the socket closes.", float))
+
+SERVE_SLO_MS = _register(ConfigEntry(
+    "spark.tpu.serve.sloMs", 0.0,
+    "Default per-query end-to-end latency SLO target in ms (submit to "
+    "release, queue wait included) for every fair-scheduler pool; 0 "
+    "disables SLO accounting. Per-pool overrides ride "
+    "spark.tpu.serve.pool.<name>.sloMs. Queries over target bump the "
+    "pool's burn counter and raise obs.slo findings in live status and "
+    "EXPLAIN ANALYZE.", float))
+
+SERVE_POOL_SLO = _register(ConfigEntry(
+    "spark.tpu.serve.pool.<name>.sloMs", 0.0,
+    "Per-pool end-to-end latency SLO target in ms, overriding "
+    "spark.tpu.serve.sloMs for pool <name> (documentation template — "
+    "substitute the pool name; read via the per-pool override path "
+    "like the spark.tpu.scheduler.pool.<name>.* family).", float))
+
+# --- service metrics plane (spark_tpu/obs/export.py) -----------------------
+
+METRICS_EXPORT = _register(ConfigEntry(
+    "spark.tpu.metrics.export", False,
+    "Service metrics plane master switch: the process-wide "
+    "MetricsRegistry scrape surface (Prometheus text /metrics on the "
+    "history server, {\"metrics\": true} on the SQL endpoint), the "
+    "time-series ticker thread, and per-executor registry deltas on "
+    "the heartbeat. Structurally zero overhead when off (module-bool "
+    "fast path; no ticker thread, no heartbeat field, no scrape "
+    "collection). Role of the reference's spark.metrics.conf + "
+    "PrometheusServlet.", _bool))
+
+METRICS_TICK_INTERVAL = _register(ConfigEntry(
+    "spark.tpu.metrics.tickInterval", 5.0,
+    "Seconds between time-series ticker samples of the metric surface "
+    "into the bounded in-memory ring (sparklines in serve status, the "
+    "drain-time snapshot). Host-counter reads only — a tick launches "
+    "no kernels and never syncs the device.", float))
+
+METRICS_RING_SIZE = _register(ConfigEntry(
+    "spark.tpu.metrics.ringSize", 120,
+    "Points retained in the in-memory metrics time-series ring (at the "
+    "default 5s tick interval, 120 points = 10 minutes of sparkline "
+    "history; memory stays bounded regardless of uptime).", int))
 
 
 class SQLConf:

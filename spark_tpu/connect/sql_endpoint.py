@@ -109,6 +109,15 @@ class SQLEndpoint:
     def _run(self, req: dict, state: dict) -> dict:
         if req.get("status"):
             return {"status": self.service.status()}
+        if req.get("metrics"):
+            # Prometheus text scrape over the SQL wire — same payload
+            # the history server's /metrics serves; "" while the export
+            # switch is off so tools can distinguish disabled from empty
+            from ..obs import export as _export
+
+            return {"metrics": _export.render_prometheus()
+                    if _export.ENABLED else "",
+                    "enabled": _export.ENABLED}
         sql = req.get("sql")
         if not sql:
             if req.get("session"):
@@ -288,6 +297,14 @@ class Connection:
         if resp.get("error"):
             raise Error(resp["error"], resp.get("error_class"))
         return resp.get("status", {})
+
+    def server_metrics(self) -> str:
+        """Prometheus text scrape of the server's metrics registry
+        ("" when spark.tpu.metrics.export is off server-side)."""
+        resp = self._request({"metrics": True})
+        if resp.get("error"):
+            raise Error(resp["error"], resp.get("error_class"))
+        return resp.get("metrics", "")
 
     def commit(self) -> None:
         pass        # autocommit semantics

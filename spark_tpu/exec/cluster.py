@@ -275,14 +275,18 @@ class LocalCluster:
         ok = self.registry.heartbeat(eid)
         sink = self.obs_sink
         if ok and sink is not None and (
-                msg.get("obs") or msg.get("hbm") is not None):
+                msg.get("obs") or msg.get("hbm") is not None
+                or msg.get("metrics") is not None):
             try:
                 # the sink is LiveObs.on_heartbeat, which takes the
                 # executor-level resource fields too (per-executor HBM
-                # occupancy + the flush-budget overflow counter)
+                # occupancy, the flush-budget overflow counter, and —
+                # with the metrics plane on — the worker's registry
+                # counter snapshot for worker-labeled scrape series)
                 sink(eid, msg.get("obs") or [],
                      hbm=msg.get("hbm"),
-                     overflows=msg.get("obs_overflows"))
+                     overflows=msg.get("obs_overflows"),
+                     metrics=msg.get("metrics"))
             except Exception:
                 # telemetry must never fail a liveness heartbeat — but a
                 # sink bug must not vanish either: count every swallowed
@@ -775,6 +779,23 @@ class LocalCluster:
             return [self._workers[e.executor_id]
                     for e in self.registry.registered()
                     if e.executor_id in self._workers]
+
+    def lockwatch_edges(self) -> dict:
+        """Collect each worker's lockwatch observations (order edges,
+        registered slot names, guard violations) over RPC so the --race
+        gate can fold executor-process lock behaviour into the same
+        cross-check it runs on the driver. Unreachable workers are
+        skipped — the gate asserts on who DID answer."""
+        with self._lock:
+            workers = list(self._workers.items())
+        out: dict = {}
+        for eid, w in workers:
+            try:
+                raw = w.client.call("lockwatch_edges", b"", timeout=15)
+                out[eid] = pickle.loads(raw)
+            except Exception:
+                continue
+        return out
 
     def run_task_on(self, worker, fn: Callable, *args) -> Any:
         """Run on a SPECIFIC executor (barrier gangs need distinct

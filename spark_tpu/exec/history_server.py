@@ -53,12 +53,28 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):  # silence per-request stderr noise
         pass
 
-    def _send(self, body: bytes, ctype="text/html"):
-        self.send_response(200)
+    def _send(self, body: bytes, ctype="text/html", code=200):
+        self.send_response(code)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _metrics(self) -> None:
+        """Prometheus text scrape of the in-process metrics registry
+        (obs/export.py) — the reference's PrometheusServlet role, served
+        off the same port as the history UI. 503 while the export
+        switch (spark.tpu.metrics.export) is off: a scraper should see
+        'target down', not an empty-but-healthy page."""
+        from ..obs import export as _export
+
+        if not _export.ENABLED:
+            self._send(b"# metrics export disabled "
+                       b"(spark.tpu.metrics.export=false)\n",
+                       "text/plain; version=0.0.4", code=503)
+            return
+        self._send(_export.render_prometheus().encode(),
+                   "text/plain; version=0.0.4")
 
     def do_GET(self):  # noqa: N802  (http.server API)
         url = urlparse(self.path)
@@ -66,6 +82,8 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if url.path == "/":
                 self._send(self._index())
+            elif url.path == "/metrics":
+                self._metrics()
             elif url.path == "/app":
                 self._send(self._app(q["id"][0]))
             elif url.path == "/query":

@@ -143,6 +143,12 @@ def begin_stage_obs(conf, query_id: str | None = None,
     # stage kernels, so a warm cluster restart needs the same disk cache
     # wired here (spark.tpu.cache.dir ships with the conf)
     _persist.configure(conf)
+    from ..obs import export as _export
+
+    # service metrics plane: with spark.tpu.metrics.export on, this
+    # worker's heartbeats attach its registry counter snapshot so the
+    # driver scrape shows worker-labeled series
+    _export.configure(conf)
 
     # conf values are host data — bool() here never touches device
     if not bool(conf.get(  # tpulint: ignore[host-sync]
@@ -369,6 +375,22 @@ def _handle_free_shuffle(payload: bytes) -> bytes:
     return b"ok"
 
 
+def _handle_lockwatch_edges(_payload: bytes) -> bytes:
+    """Worker-side lock-discipline observations for the --race gate's
+    direct executor cross-check (PR 17 follow-on): the acquisition-
+    order edges, registered slot names, and guard violations THIS
+    worker process recorded under SPARK_TPU_LOCKWATCH=1. Pure host
+    reads of the lockwatch observation tables."""
+    return pickle.dumps({
+        "enabled": lockwatch.ENABLED,
+        "edges": [[a, b, n]
+                  for (a, b), n in lockwatch.order_edges().items()],
+        "names": lockwatch.registered_names(),
+        "violations": lockwatch.violations(),
+        "acquires": sum(lockwatch.acquire_counts().values()),
+    })
+
+
 def _handle_launch_task(payload: bytes) -> bytes:
     """Runs one cloudpickled (fn, args) task. Task failures are data
     (('err', traceback, salvaged_obs)), not transport errors — a
@@ -408,6 +430,7 @@ def serve_worker(driver_addr: str, token: str, host_label: str = "localhost",
     server.register("launch_task", _handle_launch_task)
     server.register("free_shuffle", _handle_free_shuffle)
     server.register("block_stats", _handle_block_stats)
+    server.register("lockwatch_edges", _handle_lockwatch_edges)
     server.register("ping", lambda _p: b"pong")
     server.register_stream("get_block", _handle_get_block)
     addr = server.start()
@@ -457,10 +480,18 @@ def serve_worker(driver_addr: str, token: str, host_label: str = "localhost",
                 # live status shows per-executor HBM even between tasks
                 from ..obs.resources import GLOBAL_LEDGER
 
-                payload = pickle.dumps({
+                body = {
                     "eid": eid, "obs": obs,
                     "hbm": GLOBAL_LEDGER.snapshot(),
-                    "obs_overflows": FLUSH_OVERFLOWS.value})
+                    "obs_overflows": FLUSH_OVERFLOWS.value}
+                # per-executor metrics deltas (cumulative snapshots —
+                # a lost beat loses nothing) ride the same payload;
+                # structurally absent when the metrics plane is off
+                from ..obs import export as _export
+
+                if _export.ENABLED:
+                    body["metrics"] = _export.executor_payload()
+                payload = pickle.dumps(body)
                 reply = driver.call("heartbeat", payload, timeout=5,
                                     compress=bool(obs))
                 if reply != b"unknown":

@@ -51,7 +51,7 @@ from collections import OrderedDict
 from ..config import (
     PROGRESS_UPDATE_INTERVAL, STRAGGLER_ENABLED,
     STRAGGLER_HEARTBEAT_DEADLINE, STRAGGLER_MIN_SECONDS,
-    STRAGGLER_RATE_FRACTION,
+    STRAGGLER_RATE_FRACTION, STRAGGLER_RATE_WEIGHTS,
 )
 
 __all__ = ["ConsoleProgressReporter", "LiveObs", "start_query_flusher"]
@@ -111,6 +111,7 @@ class LiveObs:
         # clock matters even without writes: silence detection)
         self._version = 0
         self._scan_cache: tuple = (-1, 0.0, [])  # (version, at, active)
+        self._rate_weights_memo = None  # (raw conf string, parsed)
 
     # -- config -----------------------------------------------------------
     def _cfg(self, entry, default):
@@ -145,7 +146,8 @@ class LiveObs:
 
     def on_heartbeat(self, executor_id: str, deltas: list,
                      hbm: dict | None = None,
-                     overflows: int | None = None) -> None:
+                     overflows: int | None = None,
+                     metrics: dict | None = None) -> None:
         """Fold one executor heartbeat's live obs deltas into the store.
         Each delta is a cumulative snapshot of one running stage task
         (see exec/worker_main.collect_live_obs): snapshots replace, so
@@ -157,9 +159,13 @@ class LiveObs:
         `hbm` is the executor's device-ledger snapshot (live HBM bytes +
         process watermark) and `overflows` its cumulative flush-budget
         trim count — executor-level facts that ride every beat, task
-        deltas or not."""
+        deltas or not. `metrics` is the worker's metrics-registry
+        counter snapshot (obs/export.executor_payload, only attached
+        with spark.tpu.metrics.export on): cumulative totals that
+        REPLACE the stored row, so the driver scrape's worker-labeled
+        series converge regardless of lost beats."""
         now = time.time()
-        if hbm is not None or overflows is not None:
+        if hbm is not None or overflows is not None or metrics is not None:
             with self._lock:
                 ent = self.executors.setdefault(executor_id, {})
                 if hbm is not None:
@@ -167,6 +173,8 @@ class LiveObs:
                     ent["hbm_peak"] = hbm.get("peak", 0)
                 if overflows is not None:
                     ent["overflows"] = overflows
+                if metrics is not None:
+                    ent["metrics"] = dict(metrics)
                 ent["at"] = now
                 for eid in [eid for eid, e in self.executors.items()
                             if now - e.get("at", now) > _EXECUTOR_TTL]:
@@ -202,8 +210,10 @@ class LiveObs:
                 # (snapshots are cumulative per copy, so replacing from
                 # the laggard would make progress appear to move
                 # backwards); with a single executor this is always true
-                units = (d.get("rows", 0) + d.get("batches", 0)
-                         + d.get("launches", 0))
+                wr, wb, wl = self._rate_weights()
+                units = (wr * d.get("rows", 0)
+                         + wb * d.get("batches", 0)
+                         + wl * d.get("launches", 0))
                 if t["executor"] not in (None, executor_id) \
                         and units < self._units(t):
                     continue
@@ -377,9 +387,30 @@ class LiveObs:
             q["flagged"] = {k for k in q["flagged"] if k[0] != stage}
 
     # -- straggler detection ----------------------------------------------
-    @staticmethod
-    def _units(t: dict) -> float:
-        return t["rows"] + t["batches"] + t["launches"]
+    def _rate_weights(self) -> tuple:
+        """(rows, batches, launches) weights of the progress-rate unit
+        (spark.tpu.straggler.rateWeights — PR 6's equal weighting stays
+        the default; cost-skewed stages tune it instead of false-
+        flagging). Parsed once per distinct conf string (the scan loop
+        is too hot for a parse per task)."""
+        raw = str(self._cfg(STRAGGLER_RATE_WEIGHTS, "1,1,1"))
+        memo = getattr(self, "_rate_weights_memo", None)
+        if memo is not None and memo[0] == raw:
+            return memo[1]
+        try:
+            parts = [float(p) for p in raw.split(",")]
+            weights = tuple((parts + [0.0, 0.0, 0.0])[:3])
+            if all(w == 0 for w in weights):
+                weights = (1.0, 1.0, 1.0)
+        except Exception:
+            weights = (1.0, 1.0, 1.0)
+        self._rate_weights_memo = (raw, weights)
+        return weights
+
+    def _units(self, t: dict) -> float:
+        wr, wb, wl = self._rate_weights()
+        return (wr * t["rows"] + wb * t["batches"]
+                + wl * t["launches"])
 
     def check_stragglers(self, now: float | None = None) -> list[dict]:
         """Scan running stages for straggling tasks; newly-flagged

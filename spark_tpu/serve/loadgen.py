@@ -21,8 +21,8 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 
 from ..config import SERVE_POOL
+from ..obs.export import Histogram
 from ..obs.metrics import scoped_submit
-from .pools import _pct
 
 __all__ = ["run_serve_load"]
 
@@ -92,10 +92,10 @@ def run_serve_load(service, queries, sessions: int = 8, reps: int = 2,
     errors = []
     for pool, ms, err in results:
         ent = per_pool.setdefault(pool, {"completed": 0, "errors": 0,
-                                         "lat": []})
+                                         "hist": Histogram()})
         if err is None:
             ent["completed"] += 1
-            ent["lat"].append(ms)
+            ent["hist"].observe(ms)
         else:
             ent["errors"] += 1
             errors.append(err)
@@ -114,12 +114,16 @@ def run_serve_load(service, queries, sessions: int = 8, reps: int = 2,
     for pool, ent in sorted(per_pool.items()):
         st = status["pools"].get(pool, {})
         weight = st.get("weight", 1.0) or 1.0
+        # histogram-derived percentiles (mergeable fixed log buckets —
+        # the same numbers a cross-process scrape merge would report)
+        hist = ent["hist"]
         report["pools"][pool] = {
             "weight": weight,
             "completed": ent["completed"],
             "errors": ent["errors"],
-            "p50_ms": _pct(ent["lat"], 0.50),
-            "p99_ms": _pct(ent["lat"], 0.99),
+            "p50_ms": hist.percentile_ms(0.50),
+            "p95_ms": hist.percentile_ms(0.95),
+            "p99_ms": hist.percentile_ms(0.99),
             "wait_p99_ms": st.get("wait_p99_ms"),
             "throughput_qps": round(ent["completed"] / max(wall_s, 1e-9),
                                     3),

@@ -44,15 +44,16 @@ from collections import deque
 from dataclasses import dataclass
 
 from ..config import (
-    MEMORY_BUDGET, SERVE_MAX_CONCURRENT, SERVE_POOL, SERVE_POOLS,
-    SERVE_QUEUE_SIZE, SERVE_QUEUE_TIMEOUT,
+    MEMORY_BUDGET, SERVE_MAX_CONCURRENT, SERVE_POOL, SERVE_POOL_SLO,
+    SERVE_POOLS, SERVE_QUEUE_SIZE, SERVE_QUEUE_TIMEOUT, SERVE_SLO_MS,
 )
 from ..errors import AdmissionTimeout, PoolQueueFull, ServerDraining
+from ..obs.export import Histogram
 
 __all__ = ["FairScheduler", "PoolConfig", "pool_configs"]
 
-_RING = 512     # latency/wait samples retained per pool for p50/p99
 _QIDS = 32      # recent query ids retained per pool (SLO finding join)
+_SLO_WINDOW = 64    # recent SLO verdicts per pool (rolling burn rate)
 
 
 @dataclass
@@ -63,6 +64,7 @@ class PoolConfig:
     queue_size: int = 64
     queue_timeout_s: float = 30.0
     hbm_budget: int = 0          # 0 = inherit spark.tpu.memory.budget
+    slo_ms: float = 0.0          # end-to-end latency SLO; 0 = off
 
 
 def _one_pool(conf, name: str, weight: float | None = None) -> PoolConfig:
@@ -72,6 +74,9 @@ def _one_pool(conf, name: str, weight: float | None = None) -> PoolConfig:
         v = conf.get(f"{base}.{name}.{suffix}", None)
         return cast(v) if v is not None else default
 
+    # SLO targets live under the serve.* family (registered template
+    # spark.tpu.serve.pool.<name>.sloMs; default spark.tpu.serve.sloMs)
+    slo = conf.get(SERVE_POOL_SLO.key.replace("<name>", name), None)
     return PoolConfig(
         name=name,
         weight=max(get("weight", weight if weight is not None else 1.0,
@@ -80,7 +85,9 @@ def _one_pool(conf, name: str, weight: float | None = None) -> PoolConfig:
         queue_size=get("queueSize", int(conf.get(SERVE_QUEUE_SIZE)), int),
         queue_timeout_s=get("queueTimeout",
                             float(conf.get(SERVE_QUEUE_TIMEOUT)), float),
-        hbm_budget=get("hbmBudget", 0, int))
+        hbm_budget=get("hbmBudget", 0, int),
+        slo_ms=float(slo) if slo is not None
+        else float(conf.get(SERVE_SLO_MS)))
 
 
 def pool_configs(conf) -> dict[str, PoolConfig]:
@@ -100,7 +107,7 @@ def pool_configs(conf) -> dict[str, PoolConfig]:
 
 class _Ticket:
     __slots__ = ("pool", "hbm", "seq", "granted", "released", "enq_t",
-                 "grant_t")
+                 "grant_t", "query_id")
 
     def __init__(self, pool: str, hbm: int, seq: int):
         self.pool = pool
@@ -110,13 +117,15 @@ class _Ticket:
         self.released = False
         self.enq_t = time.perf_counter()
         self.grant_t = 0.0
+        self.query_id = None
 
 
 class _PoolState:
     __slots__ = ("cfg", "queue", "running", "hbm_inflight", "served",
                  "granted", "completed", "rejected_timeout",
-                 "rejected_full", "queue_peak", "wait_ms", "lat_ms",
-                 "busy_ms", "recent_qids")
+                 "rejected_full", "queue_peak", "hist_wait", "hist_exec",
+                 "hist_e2e", "busy_ms", "recent_qids", "slo_breaches",
+                 "slo_ok", "slo_window")
 
     def __init__(self, cfg: PoolConfig):
         self.cfg = cfg
@@ -130,19 +139,27 @@ class _PoolState:
         self.rejected_timeout = 0
         self.rejected_full = 0
         self.queue_peak = 0
-        self.wait_ms: deque = deque(maxlen=_RING)
-        self.lat_ms: deque = deque(maxlen=_RING)
+        # mergeable fixed log-bucket latency distributions (replacing
+        # the PR 15 sample rings): admission wait (enqueue→grant),
+        # execution (grant→release), end-to-end (enqueue→release) —
+        # cross-process merge reproduces single-registry quantiles
+        self.hist_wait = Histogram()
+        self.hist_exec = Histogram()
+        self.hist_e2e = Histogram()
         self.busy_ms = 0.0
         self.recent_qids: deque = deque(maxlen=_QIDS)
+        # SLO burn accounting (cfg.slo_ms > 0): lifetime ok/breach
+        # counters plus a rolling verdict window for the burn rate
+        self.slo_breaches = 0
+        self.slo_ok = 0
+        self.slo_window: deque = deque(maxlen=_SLO_WINDOW)
 
-
-def _pct(vals, q: float):
-    """Percentile over an unsorted sample (shared with loadgen)."""
-    vals = sorted(vals)
-    if not vals:
-        return None
-    i = min(len(vals) - 1, max(0, int(q * len(vals))))
-    return round(vals[i], 3)
+    def burn_rate(self):
+        """Fraction of recent completions over the SLO target (rolling
+        window); None before any SLO-tracked completion."""
+        if not self.slo_window:
+            return None
+        return round(sum(self.slo_window) / len(self.slo_window), 4)
 
 
 class FairScheduler:
@@ -220,22 +237,51 @@ class FairScheduler:
                 self._dispatch()
                 raise AdmissionTimeout(ticket.pool, float(timeout))
 
-    def release(self, ticket: _Ticket) -> None:
+    def release(self, ticket: _Ticket) -> dict | None:
+        """Free the slot and dispatch the next winner. Records the
+        ticket's execution and end-to-end latency into the pool's
+        mergeable histograms; with an SLO target configured, returns
+        the obs.slo finding when this completion breached it (the
+        caller — QueryService.collect — forwards it to the live store,
+        which feeds EXPLAIN ANALYZE and pool status), else None."""
         with self._cond:
             if ticket.released or not ticket.granted:
-                return
+                return None
             ticket.released = True
+            now = time.perf_counter()
             st = self._pool_state(ticket.pool)
             st.running -= 1
             st.hbm_inflight -= ticket.hbm
             st.completed += 1
-            lat = (time.perf_counter() - ticket.grant_t) * 1000
-            st.lat_ms.append(lat)
+            lat = (now - ticket.grant_t) * 1000
+            e2e = (now - ticket.enq_t) * 1000
+            st.hist_exec.observe(lat)
+            st.hist_e2e.observe(e2e)
             st.busy_ms += lat
             self._running_total -= 1
             self._hbm_total -= ticket.hbm
+            finding = None
+            slo = st.cfg.slo_ms
+            if slo > 0:
+                breached = e2e > slo
+                st.slo_window.append(1 if breached else 0)
+                if breached:
+                    st.slo_breaches += 1
+                    finding = {
+                        "severity": "warning", "kind": "obs.slo",
+                        "query": ticket.query_id, "pool": ticket.pool,
+                        "slo_ms": slo, "e2e_ms": round(e2e, 3),
+                        "burn_rate": st.burn_rate(),
+                        "msg": f"SLO burn: pool {ticket.pool!r} query "
+                               f"took {e2e:.1f}ms end-to-end against a "
+                               f"{slo:.0f}ms target (burn rate "
+                               f"{st.burn_rate():.0%} of recent "
+                               "completions)"}
+                else:
+                    st.slo_ok += 1
             self._dispatch()
             self._cond.notify_all()
+        return finding
 
     def note_query(self, ticket: _Ticket, query_id: str | None) -> None:
         """Associate an executed query id with the ticket's pool so
@@ -243,6 +289,7 @@ class FairScheduler:
         signals."""
         if not query_id:
             return
+        ticket.query_id = query_id
         with self._cond:
             self._pool_state(ticket.pool).recent_qids.append(query_id)
 
@@ -294,7 +341,7 @@ class FairScheduler:
             st.served += 1
             st.granted += 1
             st.hbm_inflight += t.hbm
-            st.wait_ms.append((t.grant_t - t.enq_t) * 1000)
+            st.hist_wait.observe((t.grant_t - t.enq_t) * 1000)
             self._running_total += 1
             self._hbm_total += t.hbm
             self._vclock = max(self._vclock, st.served / st.cfg.weight)
@@ -342,8 +389,6 @@ class FairScheduler:
             pools = {}
             qids = {}
             for name, st in self._pools.items():
-                lat = list(st.lat_ms)
-                wait = list(st.wait_ms)
                 pools[name] = {
                     "weight": st.cfg.weight,
                     "running": st.running,
@@ -355,11 +400,23 @@ class FairScheduler:
                     "rejected_full": st.rejected_full,
                     "busy_ms": round(st.busy_ms, 3),
                     "hbm_inflight": st.hbm_inflight,
-                    "p50_ms": _pct(lat, 0.50),
-                    "p99_ms": _pct(lat, 0.99),
-                    "wait_p50_ms": _pct(wait, 0.50),
-                    "wait_p99_ms": _pct(wait, 0.99),
+                    # histogram-derived percentiles (bucket upper edges
+                    # — identical across any process merge)
+                    "p50_ms": st.hist_exec.percentile_ms(0.50),
+                    "p95_ms": st.hist_exec.percentile_ms(0.95),
+                    "p99_ms": st.hist_exec.percentile_ms(0.99),
+                    "wait_p50_ms": st.hist_wait.percentile_ms(0.50),
+                    "wait_p99_ms": st.hist_wait.percentile_ms(0.99),
+                    "e2e_p50_ms": st.hist_e2e.percentile_ms(0.50),
+                    "e2e_p99_ms": st.hist_e2e.percentile_ms(0.99),
                 }
+                if st.cfg.slo_ms > 0:
+                    pools[name]["slo"] = {
+                        "slo_ms": st.cfg.slo_ms,
+                        "ok": st.slo_ok,
+                        "breaches": st.slo_breaches,
+                        "burn_rate": st.burn_rate(),
+                    }
                 qids[name] = list(st.recent_qids)
             out = {"draining": self._draining,
                    "running": self._running_total,
@@ -374,6 +431,45 @@ class FairScheduler:
                     f = []
                 if f:
                     out["pools"][name]["slo_findings"] = f
+        return out
+
+    def metrics_samples(self) -> list:
+        """Scrape-time pull for the metrics registry (obs/export.py):
+        per-pool counters, depth gauges, SLO burn counters, and the
+        three latency histograms under a {pool} label. Pure host reads
+        under the scheduler lock."""
+        out = []
+        with self._cond:
+            out.append(("gauge", "serve.running", (),
+                        float(self._running_total)))
+            out.append(("gauge", "serve.hbm_inflight", (),
+                        float(self._hbm_total)))
+            for name, st in self._pools.items():
+                lbl = (("pool", name),)
+                out.extend([
+                    ("gauge", "serve.pool.running", lbl,
+                     float(st.running)),
+                    ("gauge", "serve.pool.queued", lbl,
+                     float(len(st.queue))),
+                    ("counter", "serve.pool.admitted", lbl, st.granted),
+                    ("counter", "serve.pool.completed", lbl,
+                     st.completed),
+                    ("counter", "serve.pool.rejected_timeout", lbl,
+                     st.rejected_timeout),
+                    ("counter", "serve.pool.rejected_full", lbl,
+                     st.rejected_full),
+                    ("histogram", "serve.pool.wait_ms", lbl,
+                     st.hist_wait.snapshot()),
+                    ("histogram", "serve.pool.exec_ms", lbl,
+                     st.hist_exec.snapshot()),
+                    ("histogram", "serve.pool.e2e_ms", lbl,
+                     st.hist_e2e.snapshot()),
+                ])
+                if st.cfg.slo_ms > 0:
+                    out.append(("counter", "serve.pool.slo_breaches",
+                                lbl, st.slo_breaches))
+                    out.append(("counter", "serve.pool.slo_ok", lbl,
+                                st.slo_ok))
         return out
 
     def contended_grants(self) -> dict:

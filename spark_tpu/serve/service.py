@@ -50,6 +50,17 @@ class QueryService:
         self.scheduler = FairScheduler(session.conf)
         self._lock = threading.Lock()
         self.sessions_opened = 0
+        self.drain_snapshot = None
+        # service metrics plane (spark.tpu.metrics.export): wire the
+        # scrape sources over this service's pools/session and start
+        # the time-series ticker — structurally nothing when off
+        from ..obs import export as _export
+
+        _export.configure(session.conf)
+        if _export.ENABLED:
+            _export.register_default_sources(session=session,
+                                             scheduler=self.scheduler)
+            _export.start_ticker()
 
     # -- sessions ---------------------------------------------------------
     def open_session(self, mode: str | None = None):
@@ -108,7 +119,14 @@ class QueryService:
                     ticket, getattr(ctx, "query_id", None))
             return table
         finally:
-            self.scheduler.release(ticket)
+            # an SLO breach at release becomes an obs.slo finding on
+            # the query's live record — the list EXPLAIN ANALYZE and
+            # pool status already surface
+            finding = self.scheduler.release(ticket)
+            if finding is not None:
+                live = getattr(self.session, "live_obs", None)
+                if live is not None:
+                    live.add_finding(ticket.query_id, finding)
 
     def execute_sql(self, session, sql: str):
         """One SQL statement for one session. Commands and other
@@ -136,13 +154,29 @@ class QueryService:
         if timeout is None:
             timeout = float(self.session.conf.get(SERVE_DRAIN_TIMEOUT))
         self.scheduler.drain()
-        return self.scheduler.quiesce(timeout)
+        ok = self.scheduler.quiesce(timeout)
+        from ..obs import export as _export
+
+        if _export.ENABLED:
+            # drain-time snapshot: one last tick so the ring's tail is
+            # the quiesced state, then freeze the time series
+            _export.tick_once()
+            self.drain_snapshot = _export.timeseries_snapshot()
+            _export.stop_ticker()
+        return ok
 
     def status(self) -> dict:
         """Per-pool live serving status incl. SLO findings from the
         live store (stragglers/regressions of each pool's recent
-        queries)."""
+        queries) and — with the metrics plane on — sparkline series
+        from the time-series ring."""
         st = self.scheduler.status(
             live_obs=getattr(self.session, "live_obs", None))
         st["sessions_opened"] = self.sessions_opened
+        from ..obs import export as _export
+
+        if _export.ENABLED:
+            st["sparklines"] = _export.sparklines()
+            if self.drain_snapshot is not None:
+                st["drain_timeseries"] = self.drain_snapshot
         return st
