@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Postmortem CLI over diagnostic bundles (obs/blackbox.py).
+
+Usage:
+    python dev/diagnose.py <bundle_dir>              # list bundles
+    python dev/diagnose.py <bundle_dir> <bundle_id>  # render postmortem
+    python dev/diagnose.py <bundle_dir> --latest     # newest bundle
+
+Renders entirely from the bundle directory — no live process, no
+profile store, no cluster: the bundle is the self-contained black box.
+The report covers the trigger timeline (what fired and the full finding
+chain), counter drift against the embedded same-key baseline history,
+and the per-executor straggler/HBM map (driver live rows + the worker
+diagnostic rings pulled at capture time).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Render a postmortem report from a diagnostic "
+                    "bundle directory (spark.tpu.obs.bundleDir)")
+    p.add_argument("bundle_dir")
+    p.add_argument("bundle_id", nargs="?", default=None,
+                   help="bundle to render (omit to list the ring)")
+    p.add_argument("--latest", action="store_true",
+                   help="render the newest bundle")
+    args = p.parse_args(argv)
+
+    from spark_tpu.obs.blackbox import list_bundles
+    from spark_tpu.obs.diagnose import render_index, render_postmortem
+
+    bid = args.bundle_id
+    if args.latest and bid is None:
+        entries = list_bundles(args.bundle_dir)
+        if not entries:
+            print(f"no bundles under {args.bundle_dir}", file=sys.stderr)
+            return 1
+        bid = entries[0]["id"]
+    if bid is None:
+        sys.stdout.write(render_index(args.bundle_dir))
+        return 0
+    try:
+        sys.stdout.write(render_postmortem(args.bundle_dir, bid))
+    except KeyError:
+        print(f"unknown bundle id {bid} (pruned from the retention "
+              "ring?)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,6 +68,9 @@ python bench.py --smoke --serve serve
 echo "== metrics gate (export plane: scrape identity, zero overhead, drain ring) =="
 JAX_PLATFORMS=cpu python dev/validate_trace.py --metrics
 
+echo "== bundles gate (black box: chaos-seeded SLO capture, zero overhead, retention) =="
+JAX_PLATFORMS=cpu python dev/validate_trace.py --bundles
+
 echo "== race gate (lockwatch: guard checks + acquisition orders vs static model) =="
 JAX_PLATFORMS=cpu python dev/validate_trace.py --race
 

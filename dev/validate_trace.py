@@ -1942,6 +1942,209 @@ def metrics_gate() -> None:
           f"{len(snap['series'])} series, racecheck baseline clean")
 
 
+def bundles_gate() -> None:
+    """Black-box gate (--bundles, self-contained): the diagnostic
+    bundle layer's acceptance identities —
+
+      1. structural hygiene: the capture layer's lock is
+         lockwatch-registered, and with spark.tpu.obs.bundles off the
+         module bool stays False (no registry, no scans);
+      2. zero-overhead identity: the kernel-launch delta of the same
+         query is IDENTICAL armed-but-untriggered vs off, and a healthy
+         armed run captures ZERO bundles;
+      3. chaos-seeded SLO breach on a 2-worker cluster ⇒ exactly one
+         complete self-contained bundle: manifest + trace + plan
+         reports + metrics scrape on disk, pulled worker diagnostic
+         state (executor-labeled spans, fault-registry counts) inside,
+         profile with embedded same-key history, and dev/diagnose.py
+         renders the postmortem from the bundle directory alone;
+      4. the retention ring prunes to its bound.
+    """
+    import json as _json
+    import subprocess
+    import tempfile
+
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_tpu import TpuSession
+    from spark_tpu.obs import blackbox
+    from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE as KC
+    from spark_tpu.serve import QueryService
+    from spark_tpu.utils import lockwatch
+
+    # -- 1: structural hygiene -------------------------------------------
+    if "obs.blackbox._LOCK" not in set(lockwatch.registered_names()):
+        fail("--bundles: obs.blackbox._LOCK is not lockwatch-registered "
+             "— the capture layer left the runtime discipline net")
+
+    base = {
+        "spark.sql.shuffle.partitions": 2,
+        "spark.tpu.batch.capacity": 1 << 12,
+        "spark.tpu.fusion.minRows": "0",
+        "spark.tpu.cache.result.enabled": "false",
+    }
+    blackbox.reset()
+    bundle_dir = tempfile.mkdtemp(prefix="bundles_gate_")
+
+    # -- 2: zero overhead — launch delta armed == off, healthy ⇒ 0 -------
+    session = TpuSession("bundles-gate-overhead", dict(base))
+    try:
+        if blackbox.ENABLED:
+            fail("--bundles: capture layer armed with "
+                 "spark.tpu.obs.bundles at its default (off)")
+        rng = np.random.default_rng(23)
+        session.createDataFrame(pa.table({
+            "k": rng.integers(0, 16, 4000).astype(np.int64),
+            "v": rng.integers(-50, 150, 4000).astype(np.int64),
+        })).createOrReplaceTempView("bg_t")
+        probe = "select k, sum(v) s from bg_t group by k"
+        session.sql(probe).collect()            # compile warmup
+        l0 = KC.launches
+        session.sql(probe).collect()
+        delta_off = KC.launches - l0
+        session.conf.set("spark.tpu.obs.bundles", "true")
+        session.conf.set("spark.tpu.obs.bundleDir", bundle_dir)
+        blackbox.configure(session.conf)
+        if not blackbox.ENABLED:
+            fail("--bundles: configure() left the layer unarmed with "
+                 "bundles on and a bundle dir set")
+        l0 = KC.launches
+        session.sql(probe).collect()
+        delta_on = KC.launches - l0
+        if delta_off <= 0:
+            fail("--bundles: overhead probe launched nothing — the "
+                 "comparison is vacuous")
+        if delta_on != delta_off:
+            fail(f"--bundles: arming flipped the kernel-launch count "
+                 f"({delta_off} off -> {delta_on} armed) — capture is "
+                 "not pull-on-anomaly")
+        if blackbox.list_bundles(bundle_dir):
+            fail("--bundles: a HEALTHY armed run captured a bundle — "
+                 "the trigger predicate fires on non-anomalies")
+    finally:
+        session.stop()
+        blackbox.reset()
+
+    # -- 3: chaos-seeded SLO breach on a 2-worker cluster ----------------
+    profile_dir = tempfile.mkdtemp(prefix="bundles_gate_prof_")
+    session = TpuSession("bundles-gate-cluster", {
+        **base,
+        "spark.sql.adaptive.enabled": "false",
+        "spark.tpu.cluster.enabled": "true",
+        "spark.tpu.cluster.workers": "2",
+        "spark.tpu.obs.bundles": "true",
+        "spark.tpu.obs.bundleDir": bundle_dir,
+        "spark.tpu.obs.profileDir": profile_dir,
+        "spark.tpu.metrics.export": "true",
+        "spark.tpu.serve.sloMs": "50",
+        # deterministic breach: every worker stage task sleeps well past
+        # the pool SLO (host-side sleep — results stay exact)
+        "spark.tpu.faults.enabled": "true",
+        "spark.tpu.faults.seed": "7",
+        "spark.tpu.faults.points": "worker.task=always:sleep:0.2",
+    })
+    try:
+        rng = np.random.default_rng(29)
+        keys = rng.integers(0, 24, 5000).astype(np.int64)
+        vals = rng.integers(-40, 90, 5000).astype(np.int64)
+        session.createDataFrame(pa.table({"k": keys, "v": vals})) \
+            .createOrReplaceTempView("bg_c")
+        service = QueryService(session)
+        # explicit repartition: the query MUST run worker map tasks (the
+        # chaos gate's worker.task seam) for the pull leg to mean anything
+        df = session.table("bg_c").repartition(2)
+        table = service.collect(session, df)
+        got = sorted(zip(table.column("k").to_pylist(),
+                         table.column("v").to_pylist()))
+        if got != sorted(zip(keys.tolist(), vals.tolist())):
+            fail("--bundles: chaos-seeded query returned wrong rows — "
+                 "the breach scenario corrupted results")
+        entries = blackbox.list_bundles(bundle_dir)
+        if len(entries) != 1:
+            fail(f"--bundles: SLO breach captured {len(entries)} "
+                 "bundle(s), expected exactly one")
+        ent = entries[0]
+        if ent.get("trigger_kind") != "obs.slo":
+            fail(f"--bundles: bundle trigger is {ent.get('trigger_kind')!r},"
+                 " expected obs.slo")
+        bid = ent["id"]
+        bdir = os.path.join(bundle_dir, f"bundle-{bid}")
+        for fname in ("bundle.json", "trace.json", "explain_simple.txt",
+                      "explain_analysis.txt", "explain_analyze.txt",
+                      "metrics.prom"):
+            if not os.path.isfile(os.path.join(bdir, fname)):
+                fail(f"--bundles: bundle is missing {fname} — not "
+                     "self-contained")
+        with open(os.path.join(bdir, "bundle.json")) as f:
+            manifest = _json.load(f)
+        workers = manifest.get("workers") or {}
+        if not workers:
+            fail("--bundles: diagnostic_state pull landed NO worker "
+                 "state in the bundle")
+        ring_tasks = [t for w in workers.values()
+                      for t in (w.get("tasks") or [])]
+        if not ring_tasks:
+            fail("--bundles: pulled worker rings are empty — "
+                 "finish_stage_obs did not retain post-task state")
+        if not any(t.get("spans") for t in ring_tasks):
+            fail("--bundles: pulled worker rings carry no spans")
+        if not any((w.get("faults") or {}).get("fired")
+                   for w in workers.values()):
+            fail("--bundles: no worker fault-registry state in the "
+                 "bundle (the injected worker.task rule fired)")
+        with open(os.path.join(bdir, "trace.json")) as f:
+            trace = _json.load(f)
+        procs = {e.get("args", {}).get("name")
+                 for e in trace.get("traceEvents", [])
+                 if e.get("name") == "process_name"}
+        if not any(str(p).startswith("executor ") for p in procs):
+            fail(f"--bundles: trace.json has no executor-labeled "
+                 f"process track (got {sorted(map(str, procs))})")
+        if manifest.get("profile") is None:
+            fail("--bundles: bundle carries no query profile — the "
+                 "flight recorder section is missing")
+        # postmortem renders from the bundle dir alone, out of process
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_ROOT, "dev", "diagnose.py"),
+             bundle_dir, bid],
+            cwd=_ROOT, capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        if proc.returncode != 0:
+            fail("--bundles: dev/diagnose.py failed on the bundle:\n"
+                 + proc.stdout[-400:] + proc.stderr[-400:])
+        for marker in ("Trigger timeline", "obs.slo",
+                       "Per-executor straggler / HBM map"):
+            if marker not in proc.stdout:
+                fail(f"--bundles: postmortem report is missing "
+                     f"{marker!r}")
+
+        # -- 4: retention ring prunes to its bound -----------------------
+        session.conf.set("spark.tpu.obs.bundle.ring", "2")
+        blackbox.configure(session.conf)
+        for _ in range(4):
+            if session.capture_diagnostics(df) is None:
+                fail("--bundles: explicit capture_diagnostics returned "
+                     "no bundle id")
+        left = blackbox.list_bundles(bundle_dir)
+        dirs = [d for d in os.listdir(bundle_dir)
+                if d.startswith("bundle-")]
+        if len(left) > 2 or len(dirs) > 2:
+            fail(f"--bundles: retention ring bound 2 violated "
+                 f"({len(left)} index entries, {len(dirs)} dirs)")
+    finally:
+        session.stop()
+        blackbox.reset()
+
+    print("validate_trace: bundles gate OK — launch delta identical "
+          f"armed/off ({delta_on}), healthy run zero bundles, SLO "
+          "breach on the 2-worker cluster captured exactly one "
+          f"self-contained bundle ({len(ring_tasks)} pulled worker "
+          "task(s), executor trace tracks, fault-registry state), "
+          "diagnose.py rendered it offline, retention ring pruned to "
+          "bound")
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     cluster = "--cluster" in argv
@@ -1956,14 +2159,16 @@ def main(argv=None) -> int:
     serve = "--serve" in argv
     race = "--race" in argv
     metrics = "--metrics" in argv
+    bundles = "--bundles" in argv
     argv = [a for a in argv if a not in ("--cluster", "--live", "--mesh",
                                          "--encoded", "--whole-query",
                                          "--mesh-whole",
                                          "--chaos", "--profile",
                                          "--persist", "--serve",
-                                         "--race", "--metrics")]
+                                         "--race", "--metrics",
+                                         "--bundles")]
     if (mesh or encoded or whole or mesh_whole or chaos or profile
-            or persist or serve or race or metrics) and not argv:
+            or persist or serve or race or metrics or bundles) and not argv:
         # self-contained legs: these gates generate and validate their
         # own state (dev/run_all.sh runs them without a trace file)
         if mesh:
@@ -1984,6 +2189,8 @@ def main(argv=None) -> int:
             serve_gate()
         if metrics:
             metrics_gate()
+        if bundles:
+            bundles_gate()
         if race:
             race_gate()
         print("validate_trace: PASS")
@@ -2014,6 +2221,8 @@ def main(argv=None) -> int:
         serve_gate()
     if metrics:
         metrics_gate()
+    if bundles:
+        bundles_gate()
     if race:
         race_gate()
     print("validate_trace: PASS")

@@ -9,6 +9,7 @@ catalog, analyzer, optimizer, planner, and the JAX device runtime.
 from __future__ import annotations
 
 import os
+import re
 import threading
 from typing import Any, Iterable, Sequence
 
@@ -26,6 +27,12 @@ from ..types import StructType, from_arrow_type, int64
 
 _jax_initialized = False
 _init_lock = threading.Lock()
+
+# per-statement fair-scheduler pool hint: /*+ POOL(x) */ anywhere in the
+# statement text (the reference's ResolveHints COALESCE/REPARTITION hint
+# comment syntax, applied to serving admission)
+_POOL_HINT_RE = re.compile(
+    r"/\*\+\s*POOL\s*\(\s*([A-Za-z0-9_.\-]+)\s*\)\s*\*/", re.IGNORECASE)
 
 
 def _init_jax():
@@ -158,6 +165,17 @@ class TpuSession:
         # created BEFORE the conf-driven cluster attach so the cluster's
         # heartbeat handler has a sink from its first beat
         self.live_obs = LiveObs(conf=self.conf)
+        from ..obs import blackbox as _blackbox
+
+        # query black box (spark.tpu.obs.bundles): anomaly-triggered
+        # diagnostic bundle capture. Off by default — configure() leaves
+        # the module bool False and every call site stays one attribute
+        # read. The live store's finding sink routes POST-CLOSE trigger
+        # findings (the SLO verdict lands on ticket release) into the
+        # capture layer; the sink itself no-ops unless armed.
+        _blackbox.configure(self.conf)
+        self.live_obs.finding_sink = (
+            lambda qid, f, _s=self: _blackbox.on_finding(_s, qid, f))
         self._progress_reporter = None
         self.listener_bus = ListenerBus()
         if str(self.conf.get("spark.eventLog.enabled", "false")).lower() \
@@ -299,6 +317,23 @@ class TpuSession:
 
         if is_script(query):
             return execute_script(self, query)
+        # per-statement pool hint: /*+ POOL(x) */ routes THIS statement
+        # to the named fair-scheduler pool (serve/pools.py). Validated
+        # here — an unknown pool is a typed error naming the declared
+        # pools, not a silent fallback to 'default'. The hint is
+        # stripped before parse and stamped on the DataFrame for the
+        # serving layer's admission call.
+        pool_hint = None
+        m = _POOL_HINT_RE.search(query)
+        if m is not None:
+            pool_hint = m.group(1)
+            query = query[:m.start()] + query[m.end():]
+            from ..errors import UnknownPoolError
+            from ..serve.pools import pool_configs
+
+            valid = list(pool_configs(self.conf))
+            if pool_hint not in valid:
+                raise UnknownPoolError(pool_hint, valid)
         import uuid as _uuid
 
         from ..obs.tracing import pop_query, push_query
@@ -326,7 +361,10 @@ class TpuSession:
                 plan._parse_spans = parse_spans
             except Exception:
                 pass
-        return DataFrame(self, plan)
+        df = DataFrame(self, plan)
+        if pool_hint is not None:
+            df._pool_hint = pool_hint
+        return df
 
     def _materialize_ctes(self, wplan):
         """Execute each multiply-referenced CTE once and splice the
@@ -447,6 +485,28 @@ class TpuSession:
     def detachSqlCluster(self) -> "TpuSession":
         self._sql_cluster = None
         return self
+
+    def capture_diagnostics(self, df=None) -> str | None:
+        """Explicitly capture a diagnostic bundle (obs/blackbox.py) —
+        the operator's on-demand black-box pull. With a DataFrame, the
+        bundle covers its last execution (plan reports, recorded
+        metrics, profile + history); without one, the most recently
+        closed query if the capture layer is armed, else a
+        session-level bundle (serving/metrics/fleet state only).
+        Requires spark.tpu.obs.bundleDir; works with the anomaly
+        trigger (spark.tpu.obs.bundles) off. Returns the bundle id, or
+        None when no bundle dir is configured."""
+        from ..obs import blackbox
+
+        qe = ctx = None
+        if df is not None:
+            qe = df.query_execution
+            ctx = getattr(qe, "_last_ctx", None)
+        else:
+            recent = blackbox.most_recent()
+            if recent is not None:
+                qe, ctx = recent
+        return blackbox.capture(self, qe=qe, ctx=ctx, reason="manual")
 
     def stop(self) -> None:
         # a newSession() clone shares the cluster/block manager with its

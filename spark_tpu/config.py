@@ -755,6 +755,44 @@ METRICS_RING_SIZE = _register(ConfigEntry(
     "default 5s tick interval, 120 points = 10 minutes of sparkline "
     "history; memory stays bounded regardless of uptime).", int))
 
+# --- query black box (spark_tpu/obs/blackbox.py) ---------------------------
+
+OBS_BUNDLES = _register(ConfigEntry(
+    "spark.tpu.obs.bundles", False,
+    "Anomaly-triggered diagnostic bundle capture: on any severity-error "
+    "finding (obs.slo breach, obs.regression, obs.straggler, "
+    "tier.degraded, exec.excluded, admission rejection, query failure) "
+    "the driver assembles a self-contained postmortem bundle (Chrome "
+    "trace, EXPLAIN reports, metrics snapshot + time-series window, "
+    "QueryProfile with same-key baseline history, executor/HBM state, "
+    "non-default config, the finding chain, pulled worker diagnostic "
+    "rings) under spark.tpu.obs.bundleDir. Structurally zero overhead "
+    "when off (module-bool fast path); armed-but-untriggered runs "
+    "launch zero extra kernels — capture is pull-on-anomaly, never "
+    "ship-always.", _bool))
+
+OBS_BUNDLE_DIR = _register(ConfigEntry(
+    "spark.tpu.obs.bundleDir", "",
+    "Directory holding diagnostic bundles (one subdirectory per bundle "
+    "plus a flock-safe index.jsonl retention ring). Empty (default) "
+    "disables capture even when spark.tpu.obs.bundles is on; "
+    "session.capture_diagnostics() requires it. dev/diagnose.py and "
+    "the history server's /bundles pages read it offline.", str))
+
+OBS_BUNDLE_RING = _register(ConfigEntry(
+    "spark.tpu.obs.bundle.ring", 16,
+    "Retention bound on stored bundles: once more than this many exist "
+    "the oldest bundle directories are deleted at capture time (under "
+    "the index flock), so disk stays bounded no matter how unhealthy "
+    "the fleet gets.", int))
+
+OBS_BUNDLE_SAMPLE_HEALTHY = _register(ConfigEntry(
+    "spark.tpu.obs.bundle.sampleHealthy", 0,
+    "Deterministic 1-in-N tail-sampling of HEALTHY queries into "
+    "bundles (reason 'sampled') for comparison baselines: every Nth "
+    "trigger-free query close captures. 0 (default) samples none — "
+    "healthy runs write nothing.", int))
+
 
 class SQLConf:
     """Session-local config with string overrides over typed defaults.

@@ -118,6 +118,18 @@ class SQLEndpoint:
             return {"metrics": _export.render_prometheus()
                     if _export.ENABLED else "",
                     "enabled": _export.ENABLED}
+        if req.get("bundles"):
+            # black-box bundle index over the SQL wire (obs/blackbox):
+            # recent anomaly-captured bundles, newest first — empty
+            # list with the capture layer unarmed
+            from ..config import OBS_BUNDLE_DIR
+            from ..obs import blackbox
+
+            bdir = str(self.service.session.conf.get(
+                OBS_BUNDLE_DIR) or "")
+            return {"bundles": blackbox.list_bundles(bdir)[:16]
+                    if bdir else [],
+                    "enabled": blackbox.ENABLED}
         sql = req.get("sql")
         if not sql:
             if req.get("session"):

@@ -144,5 +144,23 @@ class PoolQueueFull(ServingError):
         self.size = size
 
 
+class UnknownPoolError(ServingError):
+    """A statement named a fair-scheduler pool that is not declared —
+    via a `/*+ POOL(x) */` hint or an explicit collect(pool=...). Typed
+    (not a silent fallback to 'default'): a routing typo that quietly
+    lands a batch query in the interactive pool defeats the isolation
+    the pools exist for."""
+
+    error_class = "UNKNOWN_POOL"
+
+    def __init__(self, pool: str, valid: list[str]):
+        super().__init__(
+            f"unknown fair-scheduler pool '{pool}'; declared pools: "
+            f"{', '.join(sorted(valid)) or '(none)'} — declare it in "
+            f"spark.tpu.scheduler.pools or use an existing pool")
+        self.pool = pool
+        self.valid = sorted(valid)
+
+
 class UnsupportedOperationError(SparkTpuError):
     error_class = "UNSUPPORTED_OPERATION"

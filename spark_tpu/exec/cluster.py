@@ -797,6 +797,24 @@ class LocalCluster:
                 continue
         return out
 
+    def diagnostic_state(self) -> dict:
+        """Black-box fleet state pull (obs/blackbox): each worker's
+        bounded post-task diagnostic ring plus its fault-registry,
+        lockwatch, and metrics state, fetched over RPC ONLY while a
+        diagnostic bundle is being assembled — the healthy path never
+        calls this, so heartbeat payloads stay unchanged. Unreachable
+        workers are skipped (the bundle records who answered)."""
+        with self._lock:
+            workers = list(self._workers.items())
+        out: dict = {}
+        for eid, w in workers:
+            try:
+                raw = w.client.call("diagnostic_state", b"", timeout=15)
+                out[eid] = pickle.loads(raw)
+            except Exception:
+                continue
+        return out
+
     def run_task_on(self, worker, fn: Callable, *args) -> Any:
         """Run on a SPECIFIC executor (barrier gangs need distinct
         executors — two gang members queued on one worker's slot would

@@ -49,6 +49,7 @@ def _esc(v) -> str:
 class _Handler(BaseHTTPRequestHandler):
     reader: HistoryReader = None  # injected by HistoryServer
     profiles = None               # obs.history.ProfileStore | None
+    bundles = None                # diagnostic bundle dir (str) | None
 
     def log_message(self, *a):  # silence per-request stderr noise
         pass
@@ -92,6 +93,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(self._profiles())
             elif url.path == "/profile" and self.profiles is not None:
                 self._send(self._profile(q["fp"][0]))
+            elif url.path == "/bundles" and self.bundles is not None:
+                self._send(self._bundles())
+            elif url.path == "/bundle" and self.bundles is not None:
+                self._send(self._bundle(q["id"][0]))
             elif url.path == "/api/applications":
                 apps = [{"id": a, **self.reader.summary(a)}
                         for a in self.reader.applications()]
@@ -119,7 +124,51 @@ class _Handler(BaseHTTPRequestHandler):
         if self.profiles is not None:
             body += ("<p><a href='/profiles'>Query flight recorder: "
                      "fingerprint-keyed run profiles &rarr;</a></p>")
+        if self.bundles is not None:
+            body += ("<p><a href='/bundles'>Black box: anomaly-captured "
+                     "diagnostic bundles &rarr;</a></p>")
         return _page("Spark-TPU History Server", body)
+
+    def _bundles(self) -> bytes:
+        """Diagnostic bundle index (obs/blackbox): one row per captured
+        bundle in the retention ring, newest first — the
+        capture-on-anomaly postmortem entry point."""
+        import time as _time
+
+        from ..obs.blackbox import list_bundles
+
+        rows = []
+        for e in list_bundles(self.bundles):
+            age = _time.time() - (e.get("ts") or 0)
+            rows.append(
+                f"<tr><td><a href='/bundle?id={_esc(e.get('id'))}'>"
+                f"{_esc(e.get('id'))}</a></td>"
+                f"<td>{_esc(e.get('reason'))}</td>"
+                f"<td>{_esc(e.get('trigger_kind') or '')}</td>"
+                f"<td>{_esc(e.get('query_id') or '')}</td>"
+                f"<td>{e.get('findings') or 0}</td>"
+                f"<td>{age:.0f}s ago</td></tr>")
+        body = ("<p><a href='/'>&larr; applications</a></p>"
+                "<table><tr><th>Bundle</th><th>Reason</th>"
+                "<th>Trigger</th><th>Query</th><th>Findings</th>"
+                "<th>Captured</th></tr>" + "".join(rows) + "</table>")
+        return _page("Diagnostic bundles", body)
+
+    def _bundle(self, bid: str) -> bytes:
+        """One bundle's postmortem: the diagnose.py report rendered from
+        the bundle directory alone — trigger timeline, counter drift vs
+        the embedded same-key baseline, per-executor map."""
+        from ..obs.blackbox import load_bundle
+
+        manifest = load_bundle(self.bundles, bid)
+        if manifest is None:
+            raise KeyError(bid)
+        from ..obs.diagnose import render_postmortem
+
+        report = render_postmortem(self.bundles, bid)
+        body = (f"<p><a href='/bundles'>&larr; bundles</a></p>"
+                f"<pre>{_esc(report)}</pre>")
+        return _page(f"Bundle {bid}", body)
 
     def _profiles(self) -> bytes:
         """Flight-recorder fingerprint list (obs/history.ProfileStore):
@@ -302,7 +351,8 @@ class _Handler(BaseHTTPRequestHandler):
 class HistoryServer:
     def __init__(self, log_dir: str, port: int = 18080,
                  host: str = "127.0.0.1",
-                 profile_dir: str | None = None):
+                 profile_dir: str | None = None,
+                 bundle_dir: str | None = None):
         self.reader = HistoryReader(log_dir)
         profiles = None
         if profile_dir:
@@ -310,7 +360,8 @@ class HistoryServer:
 
             profiles = ProfileStore(profile_dir)
         handler = type("Handler", (_Handler,),
-                       {"reader": self.reader, "profiles": profiles})
+                       {"reader": self.reader, "profiles": profiles,
+                        "bundles": bundle_dir or None})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None
@@ -338,9 +389,13 @@ def main(argv=None) -> None:
     p.add_argument("--profile-dir", default=None,
                    help="query flight recorder store "
                         "(spark.tpu.obs.profileDir) to serve at /profiles")
+    p.add_argument("--bundle-dir", default=None,
+                   help="diagnostic bundle ring "
+                        "(spark.tpu.obs.bundleDir) to serve at /bundles")
     args = p.parse_args(argv)
     hs = HistoryServer(args.log_dir, port=args.port,
-                       profile_dir=args.profile_dir)
+                       profile_dir=args.profile_dir,
+                       bundle_dir=args.bundle_dir)
     print(f"history server on http://127.0.0.1:{hs.port}/")
     hs._httpd.serve_forever()
 

@@ -57,6 +57,9 @@ class QueryExecution:
     # instead of AttributeError
     _last_profile: dict | None = None
     _last_regressions: tuple = ()
+    # black-box close result (obs/blackbox.py): bundle id captured for
+    # this execution, None when nothing triggered / bundles off
+    _last_bundle: str | None = None
 
     def __init__(self, session, logical: LogicalPlan):
         self.session = session
@@ -308,8 +311,21 @@ class QueryExecution:
         led_token = push_query_ledger(ctx.kernel_ledger)
         try:
             out = self._timed("execution", lambda: sched.run(plan))
-        except Exception:
+        except Exception as exec_err:
             discard_pending(ctx.plan_metrics)
+            # black box: a fatal execution error (chaos retry
+            # exhaustion, stage-regeneration limit, ...) bundles the
+            # partial evidence before the error propagates. One module
+            # bool read when off; a capture failure never masks the
+            # query error.
+            from ..obs import blackbox
+
+            if blackbox.ENABLED:
+                try:
+                    self._last_bundle = blackbox.capture_failure(
+                        self, ctx, exec_err)
+                except Exception:
+                    ctx.metrics.add("obs.bundle_errors")
             raise
         finally:
             pop_query_ledger(led_token)
@@ -367,6 +383,19 @@ class QueryExecution:
                     close_query_profile(self, ctx, recorder)
             except Exception:
                 ctx.metrics.add("obs.profile_errors")
+        # black-box close sweep (obs/blackbox.py): register this
+        # execution for post-close triggers (the SLO verdict lands on
+        # ticket release) and capture a diagnostic bundle if any trigger
+        # finding was raised during the run. Runs AFTER the flight
+        # recorder so the bundle embeds the fresh profile; one module
+        # bool read when off, zero kernel launches always.
+        from ..obs import blackbox
+
+        if blackbox.ENABLED:
+            try:
+                self._last_bundle = blackbox.maybe_capture(self, ctx)
+            except Exception:
+                ctx.metrics.add("obs.bundle_errors")
         return out
 
     def plan_fingerprint(self) -> dict:

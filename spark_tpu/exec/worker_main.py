@@ -100,6 +100,18 @@ def put_block(shuffle_id: str, reduce_id: int, data: bytes) -> None:
 # shipping accumulator updates mid-task
 _LIVE_TASKS: dict[int, dict] = {}
 
+# black-box post-task ring (obs/blackbox pull-on-anomaly capture): with
+# spark.tpu.obs.bundles armed, every finished stage task leaves a
+# bounded summary here (spans capped, host counters only) that the
+# driver pulls over the `diagnostic_state` RPC ONLY at bundle time —
+# healthy-path heartbeat payloads carry none of it
+_DIAG_RING: list[dict] = []
+_DIAG_RING_MAX = 32
+_DIAG_SPAN_CAP = 200
+_DIAG_LOCK = threading.Lock()
+lockwatch.register("exec.worker_main._DIAG_LOCK",
+                   sys.modules[__name__], "_DIAG_LOCK")
+
 
 def begin_stage_obs(conf, query_id: str | None = None,
                     stage_id: str | None = None,
@@ -149,6 +161,13 @@ def begin_stage_obs(conf, query_id: str | None = None,
     # worker's heartbeats attach its registry counter snapshot so the
     # driver scrape shows worker-labeled series
     _export.configure(conf)
+    from ..obs import blackbox as _blackbox
+
+    # black-box arming ships with the conf too: armed workers retain
+    # bounded post-task diagnostic summaries for the driver's
+    # pull-on-anomaly `diagnostic_state` RPC (nothing extra ships on
+    # the healthy path — the heartbeat payload is unchanged)
+    _blackbox.configure(conf)
 
     # conf values are host data — bool() here never touches device
     if not bool(conf.get(  # tpulint: ignore[host-sync]
@@ -328,7 +347,7 @@ def finish_stage_obs(state: dict | None) -> dict | None:
     # this process's HBM accounting for the task's query (the ledger is
     # per-process; the driver merges it as the executor's remote peak)
     hbm = GLOBAL_LEDGER.query_record(state["query_id"])
-    return {
+    out = {
         "op_records": export_op_records(state["rec"]),
         "spans": tracer.spans() if tracer is not None else [],
         "anchor": tracer.anchor if tracer is not None else None,
@@ -341,6 +360,25 @@ def finish_stage_obs(state: dict | None) -> dict | None:
         if hbm is not None else None,
         "pid": os.getpid(),
     }
+    from ..obs import blackbox as _blackbox
+
+    if _blackbox.ENABLED:
+        # armed black box: retain a bounded post-task summary for the
+        # driver's pull-on-anomaly diagnostic_state RPC. Host dict
+        # copies only — no kernel launch, no device read, and nothing
+        # added to the heartbeat payload.
+        entry = {"ts": time.time(), "query_id": state["query_id"],
+                 "stage_id": state["stage_id"],
+                 "task_id": state["task_id"],
+                 "spans": out["spans"][-_DIAG_SPAN_CAP:],
+                 "anchor": out["anchor"],
+                 "kernel_kinds": out["kernel_kinds"],
+                 "kernel_launches": out["kernel_launches"],
+                 "hbm": out["hbm"], "pid": out["pid"]}
+        with _DIAG_LOCK:
+            _DIAG_RING.append(entry)
+            del _DIAG_RING[:-_DIAG_RING_MAX]
+    return out
 
 
 def _handle_get_block(payload: bytes):
@@ -391,6 +429,34 @@ def _handle_lockwatch_edges(_payload: bytes) -> bytes:
     })
 
 
+def _handle_diagnostic_state(_payload: bytes) -> bytes:
+    """Black-box fleet state pull (obs/blackbox): the driver calls this
+    ONLY while assembling a diagnostic bundle — never on the healthy
+    path — and gets this worker's bounded post-task ring plus its
+    fault-registry, lockwatch, and metrics-registry state. Pure host
+    reads; zero kernel launches."""
+    from ..obs import blackbox as _blackbox
+    from ..obs import export as _export
+    from ..obs.resources import GLOBAL_LEDGER
+
+    with _DIAG_LOCK:
+        tasks = [dict(e) for e in _DIAG_RING]
+    return pickle.dumps({
+        "enabled": _blackbox.ENABLED,
+        "pid": os.getpid(),
+        "tasks": tasks,
+        "hbm": GLOBAL_LEDGER.snapshot(),
+        "faults": {"enabled": faults.ENABLED,
+                   "fired": faults.fire_counts()},
+        "lockwatch": {
+            "enabled": lockwatch.ENABLED,
+            "violations": lockwatch.violations(),
+            "acquires": sum(lockwatch.acquire_counts().values()),
+        },
+        "metrics": _export.executor_payload() if _export.ENABLED else None,
+    })
+
+
 def _handle_launch_task(payload: bytes) -> bytes:
     """Runs one cloudpickled (fn, args) task. Task failures are data
     (('err', traceback, salvaged_obs)), not transport errors — a
@@ -431,6 +497,7 @@ def serve_worker(driver_addr: str, token: str, host_label: str = "localhost",
     server.register("free_shuffle", _handle_free_shuffle)
     server.register("block_stats", _handle_block_stats)
     server.register("lockwatch_edges", _handle_lockwatch_edges)
+    server.register("diagnostic_state", _handle_diagnostic_state)
     server.register("ping", lambda _p: b"pong")
     server.register_stream("get_block", _handle_get_block)
     addr = server.start()

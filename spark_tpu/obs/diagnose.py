@@ -1,0 +1,214 @@
+"""Postmortem rendering over diagnostic bundles (obs/blackbox.py).
+
+Renders a captured bundle into the operator-facing report `dev/
+diagnose.py` prints and the history server's `/bundle?id=` page embeds:
+the trigger timeline (what fired, in what order, with the full finding
+chain), counter drift against the EMBEDDED same-key baseline history
+(the bundle is self-contained — no profile store, no live process), and
+the per-executor straggler/HBM map merged from the live-store snapshot
+and the pulled worker diagnostic rings.
+
+Everything here reads the bundle directory alone: a bundle copied off a
+dead host renders identically. Pure host work, obviously — this module
+never imports jax.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .blackbox import list_bundles, load_bundle
+
+__all__ = ["render_index", "render_postmortem"]
+
+
+def _fmt_bytes(n) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024
+    return f"{n:.1f} GiB"
+
+
+def render_index(bundle_dir: str) -> str:
+    """The retention ring as a table, newest first."""
+    entries = list_bundles(bundle_dir)
+    if not entries:
+        return f"no bundles under {bundle_dir}\n"
+    now = time.time()
+    lines = [f"{'bundle id':<28} {'reason':<10} {'trigger':<16} "
+             f"{'query':<14} {'age':>8}"]
+    for e in entries:
+        age = now - (e.get("ts") or now)
+        lines.append(
+            f"{e.get('id') or '?':<28} {e.get('reason') or '?':<10} "
+            f"{e.get('trigger_kind') or '-':<16} "
+            f"{(e.get('query_id') or '-'):<14} {age:>7.0f}s")
+    return "\n".join(lines) + "\n"
+
+
+def _drift_section(profile: dict | None, history: list) -> list[str]:
+    """Counter / launch / wall drift of the captured run against the
+    mean of its embedded same-key baselines."""
+    lines = ["== Counter drift vs same-key baseline =="]
+    if not profile:
+        lines.append("(no query profile in bundle — flight recorder "
+                     "was off or no query ran)")
+        return lines
+    if not history:
+        lines.append("(no baseline history embedded — first run of "
+                     "this query key, or recorder store empty)")
+    key_rows: list[tuple] = []
+
+    def mean(vals):
+        vals = [v for v in vals if isinstance(v, (int, float))]
+        return sum(vals) / len(vals) if vals else None
+
+    wall = profile.get("wall_ms")
+    base_wall = mean([p.get("wall_ms") for p in history])
+    if wall is not None:
+        key_rows.append(("wall_ms", wall, base_wall))
+    launches = sum((profile.get("launches_by_kind") or {}).values())
+    base_launches = mean(
+        [sum((p.get("launches_by_kind") or {}).values())
+         for p in history])
+    key_rows.append(("kernel launches", launches, base_launches))
+    counters = profile.get("counters") or {}
+    base_counters: dict = {}
+    for p in history:
+        for k, v in (p.get("counters") or {}).items():
+            base_counters.setdefault(k, []).append(v)
+    for k in sorted(set(counters) | set(base_counters)):
+        key_rows.append((k, counters.get(k, 0),
+                         mean(base_counters.get(k, []))))
+    lines.append(f"{'metric':<32} {'this run':>12} {'baseline':>12} "
+                 f"{'drift':>10}")
+    for name, cur, base in key_rows:
+        if base is None:
+            drift = "(new)"
+            base_s = "-"
+        else:
+            base_s = f"{base:.1f}"
+            drift = f"{cur - base:+.1f}" if isinstance(
+                cur, (int, float)) else "?"
+        lines.append(f"{name:<32} {cur!s:>12} {base_s:>12} {drift:>10}")
+    lines.append(f"(baselines: {len(history)} embedded same-key "
+                 f"run{'s' if len(history) != 1 else ''})")
+    return lines
+
+
+def _executor_section(manifest: dict) -> list[str]:
+    """Per-executor map: live-store utilization/HBM rows merged with the
+    pulled worker diagnostic rings and straggler findings."""
+    lines = ["== Per-executor straggler / HBM map =="]
+    live = manifest.get("live") or {}
+    executors = dict(live.get("executors") or {})
+    workers = manifest.get("workers") or {}
+    straggled: dict[str, int] = {}
+    for f in manifest.get("findings") or []:
+        if f.get("kind") == "obs.straggler" and f.get("executor"):
+            eid = str(f["executor"])
+            straggled[eid] = straggled.get(eid, 0) + 1
+    eids = sorted(set(executors) | set(workers) | set(straggled))
+    if not eids:
+        lines.append("(no executor state captured — local-mode query "
+                     "with no live rows)")
+        return lines
+    for eid in eids:
+        e = executors.get(eid) or {}
+        w = workers.get(eid) or {}
+        bits = [f"executor {eid}:"]
+        if e:
+            bits.append(f"hbm={_fmt_bytes(e.get('hbm_bytes'))}"
+                        f" peak={_fmt_bytes(e.get('hbm_peak'))}")
+            if e.get("excluded"):
+                bits.append(f"EXCLUDED({e.get('failures', 0)} fails)")
+            if e.get("overflows"):
+                bits.append(f"obs-trims={e['overflows']}")
+        if straggled.get(eid):
+            bits.append(f"stragglers={straggled[eid]}")
+        tasks = w.get("tasks") or []
+        if tasks:
+            spans = sum(len(t.get("spans") or []) for t in tasks)
+            bits.append(f"pulled ring: {len(tasks)} task(s), "
+                        f"{spans} span(s)")
+        faults = (w.get("faults") or {})
+        fired = faults.get("fired") or {}
+        if fired:
+            bits.append("faults fired: " + ", ".join(
+                f"{k}:{v}" for k, v in sorted(fired.items())))
+        lw = w.get("lockwatch") or {}
+        if lw.get("violations"):
+            bits.append(f"lockwatch violations={len(lw['violations'])}")
+        lines.append("  " + " ".join(bits))
+    return lines
+
+
+def render_postmortem(bundle_dir: str, bundle_id: str) -> str:
+    """The full postmortem report for one bundle, from its directory
+    alone. Raises KeyError for an unknown/pruned bundle id."""
+    manifest = load_bundle(bundle_dir, bundle_id)
+    if manifest is None:
+        raise KeyError(bundle_id)
+    lines: list[str] = []
+    ts = manifest.get("ts")
+    when = time.strftime("%Y-%m-%d %H:%M:%S",
+                         time.localtime(ts)) if ts else "?"
+    lines.append(f"DIAGNOSTIC BUNDLE {manifest.get('id')}")
+    lines.append(f"captured {when}  reason={manifest.get('reason')}  "
+                 f"query={manifest.get('query_id') or '(none)'}")
+    lines.append("")
+
+    # trigger timeline: the triggering finding first, then the full
+    # chain in raise order (the live store appends chronologically)
+    lines.append("== Trigger timeline ==")
+    trigger = manifest.get("trigger")
+    if trigger:
+        lines.append(f"TRIGGER  [{trigger.get('severity')}] "
+                     f"{trigger.get('kind')}: {trigger.get('msg')}")
+    else:
+        lines.append("(no trigger — sampled or manual capture)")
+    chain = manifest.get("findings") or []
+    for i, f in enumerate(chain):
+        mark = "->" if f == trigger else f"{i:2d}"
+        lines.append(f"  {mark} [{f.get('severity')}] {f.get('kind')}: "
+                     f"{f.get('msg')}")
+    if not chain:
+        lines.append("  (finding chain empty)")
+    lines.append("")
+
+    plan = manifest.get("plan") or {}
+    if plan:
+        lines.append("== Query ==")
+        if plan.get("detail"):
+            lines.append(f"plan: {plan['detail']}")
+        if plan.get("query_key"):
+            lines.append(f"query key: {plan['query_key']}  "
+                         f"fingerprint: {plan.get('fingerprint')}")
+        phases = plan.get("phases") or {}
+        if phases:
+            lines.append("phases: " + "  ".join(
+                f"{k}={v:.1f}ms" for k, v in phases.items()))
+        lines.append("")
+
+    lines.extend(_drift_section(manifest.get("profile"),
+                                manifest.get("profile_history") or []))
+    lines.append("")
+    lines.extend(_executor_section(manifest))
+    lines.append("")
+
+    conf = manifest.get("conf_overrides") or {}
+    if conf:
+        lines.append("== Non-default config ==")
+        for k, v in sorted(conf.items()):
+            lines.append(f"  {k} = {v}")
+        lines.append("")
+
+    lines.append("== Bundle files ==")
+    for name in manifest.get("files") or []:
+        lines.append(f"  {name}")
+    return "\n".join(lines) + "\n"

@@ -543,12 +543,17 @@ def _live_source(live) -> list:
                     by_kind[k] = by_kind.get(k, 0) + 1
             late = live.late_dropped
             errs = live.telemetry_errors
+            ev = getattr(live, "evictions", 0)
     except Exception:
         return []
     out = [("counter", "obs.findings", (("kind", k),), int(n))
            for k, n in sorted(by_kind.items())]
     out.append(("counter", "obs.heartbeat.late_dropped", (), int(late)))
     out.append(("counter", "obs.telemetry_errors", (), int(errs)))
+    # finished-query ring evictions: a non-zero series is the signal
+    # that serving load outran the 64-query live window and telemetry
+    # (findings, progress) for the evicted queries is gone
+    out.append(("counter", "obs.live.evictions", (), int(ev)))
     return out
 
 

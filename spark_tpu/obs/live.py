@@ -97,6 +97,17 @@ class LiveObs:
         self._queries: "OrderedDict[str, dict]" = OrderedDict()
         self.late_dropped = 0     # heartbeats discarded after task end
         self.partials_seen = 0    # mid-stage deltas accepted
+        # finished-query ring evictions: under serving load the 64-query
+        # ring silently drops the oldest query's findings/progress — an
+        # invisible telemetry gap until counted here (exported as
+        # obs.live.evictions through the metrics registry)
+        self.evictions = 0
+        # post-store finding hook (obs/blackbox.on_finding): called
+        # OUTSIDE self._lock with (qid, finding) after every
+        # add_finding, so post-close findings (the SLO verdict lands on
+        # ticket release, after execute() returned) can still trigger a
+        # diagnostic-bundle capture. Never raises into the caller.
+        self.finding_sink = None
         # heartbeat-sink exceptions the cluster swallowed to protect
         # liveness (exec/cluster._on_heartbeat counts them here so a
         # sink bug is visible in live status instead of silently eaten)
@@ -132,6 +143,7 @@ class LiveObs:
                 "started": time.time()}
             while len(self._queries) > _MAX_QUERIES:
                 self._queries.popitem(last=False)
+                self.evictions += 1
         return q
 
     def _task(self, qid: str, stage: str, task) -> dict:
@@ -365,6 +377,12 @@ class LiveObs:
         with self._lock:
             self._version += 1
             self._query(qid)["findings"].append(finding)
+        sink = self.finding_sink
+        if sink is not None:
+            try:
+                sink(qid, finding)     # outside _lock: the sink may read
+            except Exception:          # back through this store
+                self.telemetry_errors += 1
 
     def stage_abandoned(self, qid: str | None, stage: str) -> None:
         """A failed stage attempt retries under a NEW shuffle id (the
@@ -618,6 +636,7 @@ class LiveObs:
         out = {"running": {}, "finished_queries": finished,
                "partials_seen": self.partials_seen,
                "late_dropped": self.late_dropped,
+               "evictions": self.evictions,
                "telemetry_errors": self.telemetry_errors,
                "stragglers": self.check_stragglers(),
                "executors": self.executor_utilization(),

@@ -109,8 +109,22 @@ class QueryService:
         hbm = self._predicted_hbm(qe, conf)
         if pool is None:
             pool = str(conf.get(SERVE_POOL) or "default")
-        ticket = self.scheduler.submit(pool, hbm=hbm)
-        self.scheduler.wait(ticket, timeout=timeout)
+        try:
+            ticket = self.scheduler.submit(pool, hbm=hbm)
+            self.scheduler.wait(ticket, timeout=timeout)
+        except Exception as admission_err:
+            # black box: an admission rejection (queue full / timeout)
+            # bundles the serving/metrics state that explains it
+            # (rate-limited; never masks the rejection itself)
+            from ..obs import blackbox
+
+            if blackbox.ENABLED:
+                try:
+                    blackbox.record_rejection(self.session, admission_err,
+                                              pool=pool)
+                except Exception:
+                    pass
+            raise
         try:
             table = df.toArrow()
             ctx = getattr(qe, "_last_ctx", None)
@@ -143,7 +157,10 @@ class QueryService:
         if isinstance(getattr(out, "plan", None), LocalRelation):
             # command result: already materialized host metadata
             return out.toArrow()
-        return self.collect(session, out)
+        # per-statement /*+ POOL(x) */ hint (session.sql validated it
+        # against the declared pools and stamped the DataFrame)
+        return self.collect(session, out,
+                            pool=getattr(out, "_pool_hint", None))
 
     # -- lifecycle / status -----------------------------------------------
     def drain(self, timeout: float | None = None) -> bool:
@@ -179,4 +196,12 @@ class QueryService:
             st["sparklines"] = _export.sparklines()
             if self.drain_snapshot is not None:
                 st["drain_timeseries"] = self.drain_snapshot
+        from ..obs import blackbox
+
+        if blackbox.ENABLED:
+            from ..config import OBS_BUNDLE_DIR
+
+            bdir = str(self.session.conf.get(OBS_BUNDLE_DIR) or "")
+            if bdir:
+                st["bundles"] = blackbox.list_bundles(bdir)[:8]
         return st
