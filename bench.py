@@ -100,6 +100,17 @@ ENCODED = "--encoded" in sys.argv
 if ENCODED:
     sys.argv = [a for a in sys.argv if a != "--encoded"]
 
+# --adaptive: add the runtime-adaptive execution config
+# (physical/adaptive.py): a selective shuffled hash join measured with
+# the runtime join filter off (oracle) and on. The build side's key
+# domain is harvested host-side at the stage boundary and pushed into
+# the not-yet-run probe shuffle, pruning probe rows before they ship.
+# Reports probe rows shuffled + kernel launches per run both ways and
+# the on/off speedup. `python bench.py adaptive` also selects it.
+ADAPTIVE = "--adaptive" in sys.argv
+if ADAPTIVE:
+    sys.argv = [a for a in sys.argv if a != "--adaptive"]
+
 # --whole-query: add the whole-query compilation config
 # (physical/whole_query.py): a TPC-DS-mini-shaped join+agg plan compiled
 # as ONE jitted program per step (spark.tpu.compile.tier=whole) vs the
@@ -573,6 +584,86 @@ def bench_shuffle():
         **hbm,
         "map_launches_per_batch_fused": round(map_fused / n_batches, 2),
         "map_launches_per_batch_unfused": round(map_unfused / n_batches, 2),
+    }
+
+
+# --------------------------------------------------------------------------
+# #3b2 runtime-adaptive join filter: build-side domain pushed into the
+# not-yet-run probe shuffle (physical/adaptive.install_runtime_filters)
+# --------------------------------------------------------------------------
+
+def bench_adaptive():
+    """Selective shuffled hash join (2e7-row probe ⋈ 300-key contiguous
+    dim) run twice: spark.tpu.adaptive.runtimeFilter off (oracle) and on.
+    With the filter on, the materialized build side's dense key range is
+    harvested host-side at the stage boundary and pushed into the probe
+    shuffle, which prunes ~98.5% of probe rows BEFORE they are shuffled.
+    Reports probe rows shuffled and kernel launches per run both ways;
+    vs_baseline is the speedup over our own filter-off oracle. Partition
+    count 5 (non-power-of-two) keeps the exchanges on the host shuffle
+    path so byte/row accounting is exact."""
+    import pyarrow as pa
+
+    import spark_tpu.api.functions as F
+    from spark_tpu.physical.compile import GLOBAL_KERNEL_CACHE
+
+    n_fact = int(20_000_000 * SCALE)
+    n_keys = 100_000
+    session = _session({"spark.tpu.batch.capacity": 1 << 22,
+                        "spark.sql.shuffle.partitions": 5,
+                        "spark.sql.autoBroadcastJoinThreshold": -1})
+    rng = np.random.default_rng(41)
+    fact = pa.table({
+        "k": rng.integers(0, n_keys, n_fact).astype(np.int64),
+        "v": rng.integers(0, 1000, n_fact).astype(np.int64),
+    })
+    dim = pa.table({"k": np.arange(40_000, 40_300, dtype=np.int64),
+                    "w": np.arange(300, dtype=np.int64)})
+    # multi-partition inputs keep real hash exchanges in the join plan
+    # (single-partition sources co-locate and the probe never shuffles)
+    f = _df_from_table(session, fact, "rf_fact").repartition(5)
+    d = _df_from_table(session, dim, "rf_dim").repartition(2)
+
+    def q():
+        return (f.join(d, on="k").groupBy("k")
+                .agg(F.sum("v").alias("sv")))
+
+    _maybe_analyze(q, "adaptive")
+    results, hbm = {}, {}
+    for mode, flag in (("on", "true"), ("off", "false")):
+        session.conf.set("spark.tpu.adaptive.runtimeFilter", flag)
+        best = _best_of(lambda: _run_blocked(q()))
+        if mode == "on":
+            hbm = _hbm_fields("adaptive", best, n_fact * 16)
+        c0 = session._metrics.snapshot()["counters"]
+        l0 = GLOBAL_KERNEL_CACHE.counters()["kernel_cache.launches"]
+        _run_blocked(q())
+        c1 = session._metrics.snapshot()["counters"]
+        launches = GLOBAL_KERNEL_CACHE.counters()["kernel_cache.launches"] \
+            - l0
+        pruned = c1.get("adaptive.filter_rows_pruned", 0) \
+            - c0.get("adaptive.filter_rows_pruned", 0)
+        installed = c1.get("adaptive.runtime_filters_installed", 0) \
+            - c0.get("adaptive.runtime_filters_installed", 0)
+        results[mode] = (best, launches, pruned, installed)
+    session.conf.unset("spark.tpu.adaptive.runtimeFilter")
+    best_on, launches_on, pruned_on, installed_on = results["on"]
+    best_off, launches_off, pruned_off, _ = results["off"]
+    rate = n_fact / best_on
+    return {
+        "metric": "adaptive runtime join filter 2e7 probe ⋈ 300-key dim "
+                  "+ agg (vs_baseline = speedup over the filter-off "
+                  "oracle)",
+        "value": round(rate / 1e6, 2),
+        "unit": "M rows/s",
+        "vs_baseline": round(best_off / best_on, 3),
+        **hbm,
+        "filters_installed": installed_on,
+        "probe_rows_shuffled_off": n_fact,
+        "probe_rows_shuffled_on": n_fact - pruned_on,
+        "probe_rows_pruned": pruned_on,
+        "launches_per_run_on": launches_on,
+        "launches_per_run_off": launches_off,
     }
 
 
@@ -1388,6 +1479,7 @@ CONFIGS = {
     "sort": bench_sort,
     "join": bench_join,
     "shuffle": bench_shuffle,
+    "adaptive": bench_adaptive,
     "mesh": bench_mesh,
     "encoded": bench_encoded,
     "whole_query": bench_whole_query,
@@ -1429,6 +1521,7 @@ def _fallback_to_cpu_child() -> int:
                              ("--progress", PROGRESS),
                              ("--mesh", MESH),
                              ("--encoded", ENCODED),
+                             ("--adaptive", ADAPTIVE),
                              ("--whole-query", WHOLE_QUERY),
                              ("--mesh-whole", MESH_WHOLE),
                              ("--serve-restart", SERVE_RESTART),
@@ -1473,6 +1566,7 @@ def main() -> int:
                if not (SMOKE and c == "tpcds")
                and (MESH or c != "mesh")       # mesh config is opt-in
                and (ENCODED or c != "encoded")  # encoded too
+               and (ADAPTIVE or c != "adaptive")  # and adaptive
                and (WHOLE_QUERY or c != "whole_query")  # and whole-query
                and (MESH_WHOLE or c != "mesh_whole")   # and mesh-whole
                and (SERVE_RESTART or c != "serve_restart")  # and restart

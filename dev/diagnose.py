@@ -5,6 +5,7 @@ Usage:
     python dev/diagnose.py <bundle_dir>              # list bundles
     python dev/diagnose.py <bundle_dir> <bundle_id>  # render postmortem
     python dev/diagnose.py <bundle_dir> --latest     # newest bundle
+    python dev/diagnose.py <bundle_dir> <id> --tar   # pack to .tar.gz
 
 Renders entirely from the bundle directory — no live process, no
 profile store, no cluster: the bundle is the self-contained black box.
@@ -32,9 +33,15 @@ def main(argv=None) -> int:
                    help="bundle to render (omit to list the ring)")
     p.add_argument("--latest", action="store_true",
                    help="render the newest bundle")
+    p.add_argument("--tar", action="store_true",
+                   help="pack the bundle directory into one .tar.gz "
+                        "archive instead of rendering")
+    p.add_argument("-o", "--out", default=None,
+                   help="archive path for --tar (default: "
+                        "<bundle_dir>/bundle-<id>.tar.gz)")
     args = p.parse_args(argv)
 
-    from spark_tpu.obs.blackbox import list_bundles
+    from spark_tpu.obs.blackbox import list_bundles, pack_bundle
     from spark_tpu.obs.diagnose import render_index, render_postmortem
 
     bid = args.bundle_id
@@ -46,6 +53,14 @@ def main(argv=None) -> int:
         bid = entries[0]["id"]
     if bid is None:
         sys.stdout.write(render_index(args.bundle_dir))
+        return 0
+    if args.tar:
+        try:
+            path = pack_bundle(args.bundle_dir, bid, out=args.out)
+        except FileNotFoundError as e:
+            print(str(e), file=sys.stderr)
+            return 1
+        print(path)
         return 0
     try:
         sys.stdout.write(render_postmortem(args.bundle_dir, bid))

@@ -41,6 +41,10 @@ echo "== encoded gate (compressed execution: dict-native kernels, code shuffle) 
 JAX_PLATFORMS=cpu python dev/validate_trace.py --encoded
 python bench.py --smoke --encoded encoded
 
+echo "== adaptive gate (runtime join filters: on/off identity, honest drift) =="
+JAX_PLATFORMS=cpu python dev/validate_trace.py --adaptive
+python bench.py --smoke --adaptive adaptive
+
 echo "== whole-query gate (one jitted program per step, 3-tier differential) =="
 JAX_PLATFORMS=cpu python dev/validate_trace.py --whole-query
 python bench.py --smoke --whole-query whole_query

@@ -127,9 +127,19 @@ Four checks, all hard failures:
     zero-overhead-when-idle claim). Self-contained:
     `validate_trace.py --race` with no trace path runs only this gate.
 
+13. Adaptive gate (--adaptive) — runtime-adaptive execution: a
+    selective shuffled hash join must produce identical results with
+    spark.tpu.adaptive.runtimeFilter on vs off, install at least one
+    runtime join filter that prunes probe rows before the shuffle (the
+    install event visible as an adaptive.runtime_filter span), degrade
+    the launch model honestly (exact=False with a named runtimeFilter
+    reason, zero unexplained EXPLAIN ANALYZE drift), and leave the
+    device ledger balanced. Self-contained: `validate_trace.py
+    --adaptive` with no trace path runs only this gate.
+
 Usage: python dev/validate_trace.py [--cluster] [--live] [--mesh]
-       [--encoded] [--whole-query] [--mesh-whole] [--chaos]
-       [--profile] [--serve] [--race] [<trace.json>]
+       [--encoded] [--adaptive] [--whole-query] [--mesh-whole]
+       [--chaos] [--profile] [--serve] [--race] [<trace.json>]
 """
 
 import json
@@ -629,6 +639,91 @@ def encoded_gate() -> None:
               f"{sum(report.measured.values())} launches predicted "
               "exactly fusion on/off, 0 krange3 probes on the "
               "dictionary key")
+    finally:
+        session.stop()
+
+
+def adaptive_gate() -> None:
+    """Runtime-adaptive execution gate (--adaptive): a selective shuffled
+    hash join (2000-key probe ⋈ [5,6,7] build) must (1) produce results
+    identical with spark.tpu.adaptive.runtimeFilter on vs off — the
+    differential identity, (2) install at least one runtime join filter
+    that prunes probe rows before the shuffle, with the install event
+    visible in the trace (adaptive.runtime_filter span), (3) degrade the
+    launch model HONESTLY (exact=False with a named runtimeFilter
+    reason) and show zero unexplained EXPLAIN ANALYZE drift with the
+    adaptive layer armed, and (4) leave the device ledger balanced.
+    Self-contained: no trace path required."""
+    import pyarrow as pa
+
+    import spark_tpu.api.functions as F
+    from spark_tpu import TpuSession
+    from spark_tpu.obs.resources import GLOBAL_LEDGER
+
+    session = TpuSession("adaptive-gate", {
+        "spark.tpu.batch.capacity": 1 << 12,
+        "spark.sql.shuffle.partitions": 4,
+        "spark.sql.autoBroadcastJoinThreshold": -1,
+        "spark.tpu.ui.operatorMetrics": "true",
+    })
+    try:
+        def q():
+            a = session.createDataFrame(pa.table({
+                "k": list(range(2000)),
+                "v": list(range(2000))})).repartition(4)
+            b = session.createDataFrame(pa.table({
+                "k": [5, 6, 7], "w": [50, 60, 70]})).repartition(2)
+            return (a.join(b, on="k").groupBy("k")
+                    .agg(F.sum("v").alias("sv")).orderBy("k"))
+
+        outs = {}
+        for flag in ("false", "true"):
+            session.conf.set("spark.tpu.adaptive.runtimeFilter", flag)
+            outs[flag] = q().toArrow().to_pydict()
+        if outs["true"] != outs["false"]:
+            fail("--adaptive: results differ with the runtime filter on "
+                 "vs off (probe pruning changed answers)")
+
+        c = session._metrics.snapshot()["counters"]
+        if not c.get("adaptive.runtime_filters_installed"):
+            fail("--adaptive: no runtime filter installed on the "
+                 "selective join (harvest/install path regressed)")
+        if not c.get("adaptive.filter_rows_pruned"):
+            fail("--adaptive: filter installed but zero probe rows "
+                 "pruned (the exchange never applied it)")
+        rf_spans = [s for s in session.tracer.spans()
+                    if s and s[0] == "adaptive.runtime_filter"]
+        if not rf_spans:
+            fail("--adaptive: filter install not visible in the trace "
+                 "(no adaptive.runtime_filter span)")
+
+        # the launch model must degrade honestly, not silently: armed
+        # adaptive execution is a named inexactness, and EXPLAIN ANALYZE
+        # reconciliation must classify the drift rather than error
+        report = q().query_execution.analysis_report()
+        if report.exact:
+            fail("--adaptive: plan_lint claims exact launch counts with "
+                 "the runtime filter armed — the model is lying")
+        if not any("runtimeFilter" in r for r in report.inexact_reasons):
+            fail("--adaptive: inexactness lacks a named runtimeFilter "
+                 f"reason: {report.inexact_reasons}")
+        report = q().query_execution.analyzed_report()
+        errors = [f for f in report.findings if f["severity"] == "error"]
+        if errors:
+            print(report.render())
+            fail("--adaptive: EXPLAIN ANALYZE reported unexplained drift "
+                 "with the adaptive layer armed: "
+                 + "; ".join(f["msg"] for f in errors))
+        session.conf.unset("spark.tpu.adaptive.runtimeFilter")
+
+        issues = GLOBAL_LEDGER.verify()
+        if issues:
+            fail("--adaptive: device ledger failed verification after "
+                 "the adaptive run — " + "; ".join(issues))
+        print("validate_trace: adaptive gate OK — on == off, "
+              f"{c.get('adaptive.filter_rows_pruned')} probe rows pruned "
+              f"by {c.get('adaptive.runtime_filters_installed')} "
+              "filter(s), drift classified, ledger balanced")
     finally:
         session.stop()
 
@@ -2151,6 +2246,7 @@ def main(argv=None) -> int:
     live = "--live" in argv
     mesh = "--mesh" in argv
     encoded = "--encoded" in argv
+    adaptive = "--adaptive" in argv
     whole = "--whole-query" in argv
     mesh_whole = "--mesh-whole" in argv
     chaos = "--chaos" in argv
@@ -2161,20 +2257,24 @@ def main(argv=None) -> int:
     metrics = "--metrics" in argv
     bundles = "--bundles" in argv
     argv = [a for a in argv if a not in ("--cluster", "--live", "--mesh",
-                                         "--encoded", "--whole-query",
+                                         "--encoded", "--adaptive",
+                                         "--whole-query",
                                          "--mesh-whole",
                                          "--chaos", "--profile",
                                          "--persist", "--serve",
                                          "--race", "--metrics",
                                          "--bundles")]
-    if (mesh or encoded or whole or mesh_whole or chaos or profile
-            or persist or serve or race or metrics or bundles) and not argv:
+    if (mesh or encoded or adaptive or whole or mesh_whole or chaos
+            or profile or persist or serve or race or metrics
+            or bundles) and not argv:
         # self-contained legs: these gates generate and validate their
         # own state (dev/run_all.sh runs them without a trace file)
         if mesh:
             mesh_gate()
         if encoded:
             encoded_gate()
+        if adaptive:
+            adaptive_gate()
         if whole:
             whole_query_gate()
         if mesh_whole:
@@ -2207,6 +2307,8 @@ def main(argv=None) -> int:
         mesh_gate()
     if encoded:
         encoded_gate()
+    if adaptive:
+        adaptive_gate()
     if whole:
         whole_query_gate()
     if mesh_whole:

@@ -48,10 +48,11 @@ import numpy as np
 
 from ..columnar.batch import bucket_capacity
 from ..config import (
-    ADAPTIVE_ENABLED, ADVISORY_PARTITION_BYTES, AGG_BLOCK_ROWS,
-    BATCH_CAPACITY, BLOOM_JOIN_FILTER, COALESCE_PARTITIONS_ENABLED,
-    ENCODING_ENABLED, FUSION_DENSE_KEYS, FUSION_ENABLED, FUSION_EXCHANGE,
-    FUSION_MESH, FUSION_MIN_ROWS, MESH_ENABLED, MINMAX_JOIN_FILTER, SQLConf,
+    ADAPTIVE_ENABLED, ADAPTIVE_READMISSION, ADAPTIVE_RUNTIME_FILTER,
+    ADVISORY_PARTITION_BYTES, AGG_BLOCK_ROWS, BATCH_CAPACITY,
+    BLOOM_JOIN_FILTER, COALESCE_PARTITIONS_ENABLED, ENCODING_ENABLED,
+    FUSION_DENSE_KEYS, FUSION_ENABLED, FUSION_EXCHANGE, FUSION_MESH,
+    FUSION_MIN_ROWS, MESH_ENABLED, MINMAX_JOIN_FILTER, SQLConf,
 )
 from ..expr.expressions import (
     Alias, AttributeReference, EqualTo, GreaterThan, GreaterThanOrEqual, In,
@@ -563,6 +564,26 @@ class _Analyzer:
             except Exception:
                 pass
         self.visit(plan)
+        # adaptive stage-boundary re-admission (physical/adaptive.py):
+        # with the layer on, a multi-stage plan may collapse its
+        # remaining stages into one whole-tier program after any shuffle
+        # materializes — and a recurring query may re-plan from its
+        # warm-start history before the first batch. Both re-decisions
+        # depend on observed runtime sizes the static model cannot know,
+        # so a re-admittable plan is honestly inexact; single-stage and
+        # already-whole plans stay exact (nothing left to re-admit).
+        if bool(self.conf.get(ADAPTIVE_READMISSION)):
+            from ..physical.exchange import ShuffleExchangeExec
+            from ..physical.whole_query import WholeQueryExec
+
+            if not isinstance(plan, WholeQueryExec) and any(
+                    isinstance(n, ShuffleExchangeExec)
+                    for n in plan.iter_nodes()):
+                self._approx(
+                    "adaptive re-admission: remaining stages may collapse "
+                    "into a whole-tier program at a stage boundary "
+                    "(spark.tpu.adaptive.readmission — tier re-decision "
+                    "uses observed runtime sizes)")
         # zero-count kinds (a probe that never fires on this plan) are
         # bookkeeping, not predictions — the measured delta never lists
         # them either
@@ -1583,6 +1604,37 @@ class _Analyzer:
         build_traces = [right.part_trace(0 if node.is_broadcast else i)
                         for i in range(len(pairs))]
 
+        # adaptive runtime join filters (physical/adaptive.py): once the
+        # build side materializes, its key domain prunes rows — and whole
+        # batches — inside the not-yet-run probe shuffle. Stage run
+        # order, dense-range memo hits, and the fusion size gate are all
+        # run-dependent, so an ELIGIBLE pattern degrades the launch model
+        # honestly; ineligible shapes (broadcast, outer joins, composite
+        # or non-integral/string keys, no shuffled probe) are evaluated
+        # host-side and stay exact.
+        adaptive_rf = (bool(self.conf.get(ADAPTIVE_RUNTIME_FILTER))
+                       and not node.is_broadcast
+                       and node.join_type in ("inner", "left_semi")
+                       and len(node.left_keys) == 1
+                       and isinstance(node.left, ShuffleExchangeExec)
+                       and isinstance(node.left_keys[0].dtype,
+                                      (IntegralType, DateType, StringType)))
+        if adaptive_rf:
+            self._approx(
+                "adaptive runtime join filter: the materialized build "
+                "side's key domain prunes probe-shuffle rows/batches at "
+                "runtime (spark.tpu.adaptive.runtimeFilter)")
+            bt = build_traces[0] if build_traces else None
+            bvals = bt.stats(node.right_keys[0].expr_id) \
+                if bt is not None else None
+            if bvals is not None and bvals.size and isinstance(
+                    node.right_keys[0].dtype, (IntegralType, DateType)):
+                # the value model CAN evaluate the build domain host-side
+                # — surface the evaluated filter in the report
+                notes.append(
+                    "runtime-filter build domain evaluated host-side: "
+                    f"[{int(bvals.min())}, {int(bvals.max())}]")
+
         out_parts = []
         out_traces = []
         for pi, (lp, rp) in enumerate(pairs):
@@ -2477,10 +2529,28 @@ class _Analyzer:
                 mem(n, cap, extra_planes=sum(caps))
                 return cap, trace
             if isinstance(n, O.ScanExec):
-                from ..physical.whole_query import _scan_table
+                from ..physical.whole_query import (
+                    _external_scan_rows, _scan_table,
+                )
 
                 t = _scan_table(n)
                 if t is None:
+                    # parquet-stats admission (spark.tpu.adaptive.
+                    # parquetStats): footer row-group counts give the
+                    # exact layout without reading data — only the
+                    # VALUES stay untraced
+                    rows = _external_scan_rows(n)
+                    if rows is not None:
+                        self._approx(
+                            f"whole-query external scan [{n.name}]: "
+                            "footer statistics model the layout, values "
+                            "untraced")
+                        caps = [c for tiles in self._part_tiles(
+                            rows, n.source.num_partitions())
+                            for _r, c in tiles]
+                        cap = bucket_capacity(max(sum(caps), 1))
+                        mem(n, cap, extra_planes=sum(caps))
+                        return cap, None
                     self._approx("whole-query leaf layout unknown "
                                  f"(external scan [{n.name}])")
                     return self._tile, None

@@ -76,6 +76,39 @@ SKEW_JOIN_ENABLED = _register(ConfigEntry(
     "Split skewed shuffle partitions (reference: OptimizeSkewedJoin.scala:57).",
     _bool))
 
+ADAPTIVE_RUNTIME_FILTER = _register(ConfigEntry(
+    "spark.tpu.adaptive.runtimeFilter", False,
+    "Sideways information passing: when a hash-join build side "
+    "materializes, harvest its key domain host-side from stats the "
+    "engine already accumulates (dense-range memo min/max, StringDict "
+    "code domains — ZERO extra syncs or launches) and push a filter "
+    "into the not-yet-executed probe-side exchange. Probe map batches "
+    "prune rows inside the existing fused shuffle kernel (aux "
+    "operands, no new dispatch) or skip whole batches whose seeded "
+    "range misses the domain. Distinct from the per-batch kernels of "
+    "spark.tpu.join.runtimeFilter.", _bool))
+
+ADAPTIVE_READMISSION = _register(ConfigEntry(
+    "spark.tpu.adaptive.readmission", False,
+    "Stage-boundary tier re-admission: after shuffle stages "
+    "materialize, feed measured output stats back through the compile-"
+    "tier chooser so the remaining plan can collapse into one whole-"
+    "tier program; recurring queries re-plan from their warm-start "
+    "manifest's observed volume before the first batch moves.", _bool))
+
+ADAPTIVE_PARQUET_STATS = _register(ConfigEntry(
+    "spark.tpu.adaptive.parquetStats", True,
+    "Admit external parquet scans to the whole compile tier from "
+    "footer statistics (row-group row counts + min/max) instead of "
+    "excluding them categorically for lack of plan-time stats.", _bool))
+
+ADAPTIVE_SKEW_REPARTITION = _register(ConfigEntry(
+    "spark.tpu.adaptive.skewRepartition", True,
+    "When mesh-exchange quota retries exhaust on pathological skew, "
+    "split the remaining batches and re-plan the exchange as smaller "
+    "mesh programs instead of falling straight back to the host "
+    "shuffle (which stays as the terminal fallback).", _bool))
+
 CASE_SENSITIVE = _register(ConfigEntry(
     "spark.sql.caseSensitive", False,
     "Case sensitivity of identifier resolution.", _bool))

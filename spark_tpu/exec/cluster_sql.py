@@ -471,6 +471,7 @@ class ClusterDAGScheduler(DAGScheduler):
                 exclude_s=float(ctx.conf.get(  # tpulint: ignore[host-sync]
                     EXCLUDE_TIMEOUT_SECS)))
             health.on_exclude = self._on_executor_excluded
+            health.on_exclude_host = self._on_host_excluded
         if self.live is not None:
             if getattr(cluster, "obs_sink", None) is None:
                 cluster.obs_sink = self.live.on_heartbeat
@@ -503,6 +504,28 @@ class ClusterDAGScheduler(DAGScheduler):
             "executor": eid,
             "msg": f"executor {eid} excluded after {failures} task "
                    "failure(s) in the excludeOnFailure window"
+                   + ("" if horizon is None else
+                      " (timed re-inclusion pending)")})
+
+    def _on_host_excluded(self, host: str, until: float,
+                          eids: list) -> None:
+        """Host-granular escalation hook: every executor on one host
+        tripped the failure window, so the HealthTracker excluded the
+        box as a unit — surfaced exactly like executor exclusion (live
+        status host row + a finding on the current query)."""
+        if self.live is None:
+            return
+        import math
+
+        from ..obs.tracing import current_query
+
+        horizon = None if math.isinf(until) else until
+        self.live.host_excluded(host, horizon, eids)
+        self.live.add_finding(current_query(), {
+            "severity": "warning", "kind": "host.excluded",
+            "host": host, "executors": list(eids),
+            "msg": f"host {host} excluded: all {len(eids)} of its "
+                   "executors tripped the excludeOnFailure window"
                    + ("" if horizon is None else
                       " (timed re-inclusion pending)")})
 

@@ -874,7 +874,8 @@ def record_manifest(conf, fingerprint: dict, tier: dict | None,
                     join_caps: list | None,
                     mesh_quotas: dict | None,
                     prior: dict | None = None,
-                    join_spans: list | None = None) -> None:
+                    join_spans: list | None = None,
+                    observed_rows: int | None = None) -> None:
     """Persist one query's capacity outcomes keyed by its full plan
     fingerprint (driver-only, at query close). Only written when there
     is something a warm restart could seed — the empty steady state is
@@ -885,8 +886,16 @@ def record_manifest(conf, fingerprint: dict, tier: dict | None,
     observed build-side key span per whole-program join
     ([lo, hi, unique] or None, aligned with join_caps): a warm restart
     compiles the dense direct-address probe variant directly instead of
-    re-learning the span through the sorted probe."""
-    if not join_caps and not mesh_quotas and not join_spans:
+    re-learning the span through the sorted probe. `observed_rows` is
+    the run's measured shuffle volume (adaptive history re-planning:
+    a recurring query over statistics-less external sources re-enters
+    the tier chooser with it before the first batch moves); a whole-tier
+    run shuffles nothing, so a missing value carries the prior's
+    forward."""
+    if observed_rows is None and prior is not None:
+        observed_rows = prior.get("observed_rows")
+    if not join_caps and not mesh_quotas and not join_spans \
+            and not observed_rows:
         return
     m = _manifest(conf)
     if m is None:
@@ -901,14 +910,16 @@ def record_manifest(conf, fingerprint: dict, tier: dict | None,
             "mesh_quotas": {k: int(v)
                             for k, v in (mesh_quotas or {}).items()},
             "join_spans": [None if s is None else [int(x) for x in s]
-                           for s in (join_spans or ())]}
+                           for s in (join_spans or ())],
+            "observed_rows": None if observed_rows is None
+            else int(observed_rows)}
         if prior is not None and all(
                 # records predating join_spans normalize to the empty
                 # list, so a seeded steady-state rerun stays append-free
                 (prior.get(k) or rec[k].__class__()) == rec[k]
                 if k == "join_spans" else prior.get(k) == rec[k]
                 for k in ("fp", "tier", "join_caps", "mesh_quotas",
-                          "join_spans")):
+                          "join_spans", "observed_rows")):
             return
         m.append({**rec, "ts": round(time.time(), 3)})
     except Exception:

@@ -263,7 +263,10 @@ class DAGScheduler:
                     self._post("stageFailed", stage, error=str(e))
             raise last_err  # noqa: B904
 
-        from ..physical.adaptive import aqe_replanning_enabled, replan_stages
+        from ..physical.adaptive import (
+            aqe_replanning_enabled, install_runtime_filters, maybe_readmit,
+            replan_stages,
+        )
 
         adaptive = aqe_replanning_enabled(self.ctx)
 
@@ -287,8 +290,15 @@ class DAGScheduler:
                                           s.stage_id))
             st = ready[0]
             run_stage(st)
-            if adaptive and st is not result_stage:
-                replan_stages(needed, done, self.ctx)
+            if st is not result_stage:
+                if adaptive:
+                    replan_stages(needed, done, self.ctx)
+                # spark.tpu.adaptive.* family (each self-gating): push
+                # materialized build-side key domains into unrun probe
+                # shuffles, then try to collapse the remaining plan into
+                # one whole-tier program with the observed sizes
+                install_runtime_filters(needed, done, self.ctx)
+                maybe_readmit(result_stage, done, self.ctx)
         return result_stage.result
 
     def _post(self, kind: str, stage: Stage, dur=None, error=None):
@@ -400,9 +410,16 @@ class HealthTracker:
         self._failures: dict[str, list[float]] = {}
         self._totals: dict[str, int] = {}
         self._excluded_until: dict[str, float] = {}
+        # host-granular exclusion: when EVERY executor on one host has
+        # tripped the failure window, the box itself is suspect (NIC,
+        # PCIe link, thermal) — the host is excluded as a unit with the
+        # same timed re-inclusion horizon as its members
+        self._host_excluded_until: dict[str, float] = {}
         # on_exclude(eid, until, failures) — the cluster scheduler hooks
         # this to surface exclusion in live status / EXPLAIN ANALYZE
         self.on_exclude = None
+        # on_exclude_host(host, until, eids) — fired once per host trip
+        self.on_exclude_host = None
 
     def configure(self, enabled: bool | None = None,
                   max_failures: int | None = None,
@@ -438,6 +455,7 @@ class HealthTracker:
                 # the window restarts after an exclusion: re-inclusion
                 # gives the executor a clean slate to prove itself
                 times.clear()
+        host_trip = None
         with self.registry._lock:
             e = self.registry._executors.get(executor_id)
             if e is None:
@@ -452,12 +470,41 @@ class HealthTracker:
                     else:
                         e.excluded = True
                 excluded = e.is_excluded()
+                if trip:
+                    # host-granular escalation: every executor on this
+                    # host now excluded → exclude the host as a unit
+                    peers = [p for p in self.registry._executors.values()
+                             if p.host == e.host]
+                    if peers and all(p.is_excluded(now) for p in peers):
+                        horizon = until
+                        for p in peers:
+                            if not p.excluded:
+                                # synchronized re-inclusion: the whole
+                                # host rejoins at once, or not at all
+                                p.excluded_until = max(
+                                    p.excluded_until, horizon)
+                        host_trip = (e.host, horizon,
+                                     [p.executor_id for p in peers])
+        if host_trip is not None:
+            host, horizon, eids = host_trip
+            with self._lock:
+                # one event per trip: an already-excluded host extending
+                # its horizon re-fires only past the prior horizon
+                if self._host_excluded_until.get(host, 0.0) >= horizon:
+                    host_trip = None
+                else:
+                    self._host_excluded_until[host] = horizon
         if trip and self.on_exclude is not None:
             try:
                 self.on_exclude(executor_id,
                                 self._excluded_until[executor_id], total)
             except Exception:
                 pass    # surfacing must never fail the scheduling path
+        if host_trip is not None and self.on_exclude_host is not None:
+            try:
+                self.on_exclude_host(*host_trip)
+            except Exception:
+                pass
         return excluded
 
     def failure_count(self, executor_id: str) -> int:
@@ -471,6 +518,7 @@ class HealthTracker:
             self._failures.clear()
             self._totals.clear()
             self._excluded_until.clear()
+            self._host_excluded_until.clear()
         with self.registry._lock:
             for e in self.registry._executors.values():
                 e.excluded = False
@@ -483,6 +531,14 @@ class HealthTracker:
         with self._lock:
             return {eid: until
                     for eid, until in self._excluded_until.items()
+                    if until > now}
+
+    def excluded_hosts(self) -> dict[str, float]:
+        """Currently-excluded hosts → re-inclusion time."""
+        now = time.time()
+        with self._lock:
+            return {host: until
+                    for host, until in self._host_excluded_until.items()
                     if until > now}
 
 

@@ -173,6 +173,50 @@ class ParquetSource(DataSource):
     def num_partitions(self) -> int:
         return len(self._splits)
 
+    # --- plan-time statistics ----------------------------------------------
+    def _footer(self, fpath: str):
+        cache = self.__dict__.setdefault("_md_cache", {})
+        md = cache.get(fpath)
+        if md is None:
+            md = cache[fpath] = self._pq.ParquetFile(fpath).metadata
+        return md
+
+    def plan_time_rows(self) -> Optional[int]:
+        """Exact row count of the CURRENT split set from footer metadata
+        (row-group counts; no data read). Prune-aware — a `pruned()`
+        clone reports only its kept splits. Ends the whole-tier's
+        categorical exclusion of external scans
+        (physical/whole_query._external_scan_rows)."""
+        total = 0
+        for (fpath, lo, hi) in self._splits:
+            md = self._footer(fpath)
+            for rg in range(lo, hi):
+                total += md.row_group(rg).num_rows
+        return total
+
+    def plan_time_column_range(self, name: str) -> Optional[tuple]:
+        """Footer (min, max) of a data column across the CURRENT splits,
+        coerced to the engine's device domain (dates → epoch days).
+        None when the column is a hive-partition column or any row
+        group lacks statistics — never guess."""
+        lo = hi = None
+        for (fpath, a, b) in self._splits:
+            if b <= a:
+                continue
+            md = self._footer(fpath)
+            ci = next((i for i in range(md.num_columns)
+                       if md.schema.column(i).name == name), None)
+            if ci is None:
+                return None
+            for rg in range(a, b):
+                st = md.row_group(rg).column(ci).statistics
+                if st is None or not st.has_min_max:
+                    return None
+                mn, mx = _stat_coerce(st.min), _stat_coerce(st.max)
+                lo = mn if lo is None else min(lo, mn)
+                hi = mx if hi is None else max(hi, mx)
+        return None if lo is None else (lo, hi)
+
     # --- predicate pruning -------------------------------------------------
     def pruned(self, predicates) -> "ParquetSource":
         """A clone reading only splits that can satisfy `predicates`

@@ -73,7 +73,7 @@ from ..utils import lockwatch
 
 __all__ = ["ENABLED", "TRIGGER_KINDS", "capture", "capture_failure",
            "configure", "is_trigger", "list_bundles", "load_bundle",
-           "maybe_capture", "most_recent", "on_finding",
+           "maybe_capture", "most_recent", "on_finding", "pack_bundle",
            "record_rejection", "reset"]
 
 # fast-path flag (utils/faults.py discipline): instrumented call sites
@@ -234,13 +234,43 @@ def capture_failure(qe, ctx, error: BaseException) -> str | None:
                    trigger=finding, extra_findings=[finding])
 
 
+def _rejection_analysis(qe) -> dict | None:
+    """Predicted-HBM summary of a REJECTED plan from the serving
+    pre-flight's AnalysisReport (stashed on the QueryExecution as
+    `_preflight_report`): the bundle shows what the admission gate
+    believed — predicted peak and the largest stage — without paying a
+    second whole-plan analysis at capture time."""
+    rep = getattr(qe, "_preflight_report", None) if qe is not None else None
+    if rep is None:
+        return None
+    try:
+        stages = list(getattr(rep, "stages", None) or [])
+        largest = None
+        for s in stages:
+            hb = s.get("hbm_bytes")
+            if hb and (largest is None
+                       or hb > largest.get("hbm_bytes", 0)):
+                detail = " ".join(str(s.get("detail") or "").split())
+                largest = {"detail": detail[:160], "hbm_bytes": hb}
+        return {
+            "predicted_peak_hbm": getattr(rep, "predicted_peak_hbm", None),
+            "memory_exact": getattr(rep, "memory_exact", None),
+            "memory_notes": list(getattr(rep, "memory_notes", None) or []),
+            "largest_stage": largest,
+        }
+    except Exception:
+        return None
+
+
 def record_rejection(session, error: BaseException,
-                     pool: str | None = None) -> str | None:
+                     pool: str | None = None, qe=None) -> str | None:
     """Admission-rejection capture (PoolQueueFull / AdmissionTimeout /
     memory-budget pre-flight): no query ran, so the bundle carries the
-    serving/metrics state that explains the rejection. Rate-limited —
-    a saturated pool rejecting hundreds of queries must not turn the
-    capture layer into its own overload."""
+    serving/metrics state that explains the rejection — plus, when the
+    rejected QueryExecution is handed over, the pre-flight analysis
+    report that drove the verdict. Rate-limited — a saturated pool
+    rejecting hundreds of queries must not turn the capture layer into
+    its own overload."""
     global _LAST_REJECT_T
     if not ENABLED:
         return None
@@ -249,6 +279,7 @@ def record_rejection(session, error: BaseException,
         if now - _LAST_REJECT_T < _REJECT_MIN_GAP_S and _LAST_REJECT_T:
             return None
         _LAST_REJECT_T = now
+    analysis = _rejection_analysis(qe)
     finding = {
         "severity": "error", "kind": "serve.rejected",
         "pool": pool,
@@ -256,8 +287,19 @@ def record_rejection(session, error: BaseException,
         or type(error).__name__,
         "msg": f"admission rejected: {type(error).__name__}: "
                f"{str(error)[:300]}"}
-    return capture(session, reason="rejection", trigger=finding,
-                   extra_findings=[finding])
+    if analysis is not None:
+        finding["rejection_analysis"] = analysis
+        peak = analysis.get("predicted_peak_hbm")
+        big = analysis.get("largest_stage") or {}
+        if peak:
+            finding["msg"] += (
+                f" | predicted peak HBM {peak} B"
+                + (f", largest stage: {big.get('detail')} "
+                   f"({big.get('hbm_bytes')} B)" if big else ""))
+    return capture(session, qe=qe, reason="rejection", trigger=finding,
+                   extra_findings=[finding],
+                   extra_manifest=None if analysis is None
+                   else {"rejection_analysis": analysis})
 
 
 # ---------------------------------------------------------------------------
@@ -371,7 +413,8 @@ def _pull_workers(session) -> dict:
 def capture(session, qe=None, ctx=None, reason: str = "manual",
             trigger: dict | None = None,
             extra_findings: list | None = None,
-            bundle_dir: str | None = None) -> str | None:
+            bundle_dir: str | None = None,
+            extra_manifest: dict | None = None) -> str | None:
     """Assemble one self-contained diagnostic bundle. Pure host work at
     capture time: plan/trace/metrics/profile state already recorded,
     worker rings pulled over RPC, everything serialized under the
@@ -507,6 +550,8 @@ def capture(session, qe=None, ctx=None, reason: str = "manual",
         "explain": explains,
         "files": files,
     }
+    if extra_manifest:
+        manifest.update(extra_manifest)
     with open(os.path.join(bdir, "bundle.json"), "w") as f:
         json.dump(manifest, f, default=_json_default)
 
@@ -578,3 +623,24 @@ def load_bundle(bundle_dir: str, bundle_id: str) -> dict | None:
         return None
     with open(path) as f:
         return json.load(f)
+
+
+def pack_bundle(bundle_dir: str, bundle_id: str,
+                out: str | None = None) -> str:
+    """Pack one bundle directory into a single .tar.gz for attaching to
+    a ticket / shipping off-host (dev/diagnose.py --tar). The archive
+    root is the bundle directory name, so unpacking next to a bundle dir
+    round-trips into something list_bundles/load_bundle/diagnose can
+    read directly. Returns the archive path."""
+    import tarfile
+
+    bdir = os.path.join(bundle_dir, f"bundle-{bundle_id}")
+    if not os.path.isdir(bdir):
+        raise FileNotFoundError(f"no such bundle: {bundle_id}")
+    if out is None:
+        out = os.path.join(bundle_dir, f"bundle-{bundle_id}.tar.gz")
+    tmp = out + ".tmp"
+    with tarfile.open(tmp, "w:gz") as tf:
+        tf.add(bdir, arcname=f"bundle-{bundle_id}")
+    os.replace(tmp, out)   # readers never see a torn archive
+    return out

@@ -115,6 +115,9 @@ class LiveObs:
         # executor-level resource telemetry (rides every heartbeat, even
         # idle ones): eid -> {"hbm_bytes", "hbm_peak", "overflows", "at"}
         self.executors: dict[str, dict] = {}
+        # host-granular exclusion state (HealthTracker escalation):
+        # host -> {"excluded_until", "executors", "at"}
+        self.hosts: dict[str, dict] = {}
         # straggler-scan memo: every heartbeat, UI snapshot, and
         # speculative wait polls check_stragglers — rescanning the whole
         # store each time is wasted work AND lock contention. A scan is
@@ -367,6 +370,19 @@ class LiveObs:
                 else float("inf")
             ent["failures"] = failures
             ent.setdefault("at", time.time())
+
+    def host_excluded(self, host: str, until: float | None,
+                      eids: list) -> None:
+        """Stamp a host-granular exclusion (every executor on the host
+        tripped the failure window — HealthTracker escalated to the box):
+        live status shows the host row beside the member executors' own
+        EXCLUDED rows until the synchronized re-inclusion horizon."""
+        with self._lock:
+            self.hosts[host] = {
+                "excluded_until": until if until is not None
+                else float("inf"),
+                "executors": list(eids),
+                "at": time.time()}
 
     def add_finding(self, qid: str | None, finding: dict) -> None:
         """Append a non-straggler finding (executor exclusion, tier
@@ -633,6 +649,13 @@ class LiveObs:
             qids = [qid for qid, q in self._queries.items()
                     if not q["done"]]
             finished = len(self._queries) - len(qids)
+        now = time.time()
+        with self._lock:
+            excluded_hosts = {
+                h: {"until": e["excluded_until"],
+                    "executors": list(e.get("executors", []))}
+                for h, e in self.hosts.items()
+                if e.get("excluded_until", 0) > now}
         out = {"running": {}, "finished_queries": finished,
                "partials_seen": self.partials_seen,
                "late_dropped": self.late_dropped,
@@ -640,6 +663,7 @@ class LiveObs:
                "telemetry_errors": self.telemetry_errors,
                "stragglers": self.check_stragglers(),
                "executors": self.executor_utilization(),
+               "excluded_hosts": excluded_hosts,
                "flush_overflows": self.flush_overflow_total()}
         for qid in qids:
             p = self.query_progress(qid)

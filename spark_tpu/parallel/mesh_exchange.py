@@ -262,6 +262,42 @@ def _build_result(schema: StructType, col_arrays: list, valid_arrays: list,
     return out
 
 
+def _skew_split_merge(batches, num_out, ctx, stats, col_stats, recurse):
+    """Pathological skew past every quota retry: split the batch list in
+    half and re-plan each half as its own (smaller) mesh exchange instead
+    of degrading straight to the host shuffle. Each half stages with
+    roughly half the volume, so its quota geometry restarts small; the
+    per-reducer outputs concatenate — hash partitioning is
+    batch-decomposable. Returns None (caller degrades to host) when the
+    split is off or there is nothing left to split."""
+    from ..config import ADAPTIVE_SKEW_REPARTITION
+
+    if len(batches) < 2 or not ctx.conf.get(ADAPTIVE_SKEW_REPARTITION):
+        return None
+    ctx.metrics.add("adaptive.skew_repartitions")
+    mid = len(batches) // 2
+    halves = []
+    for chunk in (batches[:mid], batches[mid:]):
+        st: dict = {}
+        cs: dict | None = {} if col_stats is not None else None
+        halves.append((recurse(chunk, st, cs), st, cs))
+    merged = [[b for (res, _, _) in halves for b in res[i]]
+              for i in range(num_out)]
+    for i in range(num_out):
+        stats[i] = sum(st.get(i, 0) for (_, st, _) in halves)
+    if col_stats is not None:
+        union: dict = {}
+        for (_, _, cs) in halves:
+            for ci, (lo, hi, _ok) in ((cs or {}).get("mesh")
+                                      or {}).items():
+                cur = union.get(ci)
+                union[ci] = ((min(cur[0], lo), max(cur[1], hi), True)
+                             if cur else (lo, hi, True))
+        if union:
+            col_stats["mesh"] = union
+    return merged
+
+
 def mesh_shuffle_hash(partitions, key_positions: Sequence[int],
                       num_out: int, schema: StructType, ctx, stats,
                       mesh, fusion=None, col_stats=None,
@@ -486,6 +522,16 @@ def _mesh_shuffle_plain(partitions, key_positions, num_out, schema, ctx,
     # and no gang to fail — degrade instead of failing the query
     from ..exec import shuffle as S
 
+    if gang_failures <= _MAX_GANG_RETRIES:
+        # quota exhaustion (data skew), not a dying gang: split the
+        # oversized batch set and re-plan each half on the mesh
+        split = _skew_split_merge(
+            batches, num_out, ctx, stats, col_stats,
+            lambda chunk, st, cs: _mesh_shuffle_plain(
+                [chunk], key_positions, num_out, schema, ctx, st, mesh,
+                axis, cs, stat_cols))
+        if split is not None:
+            return split
     ctx.metrics.add("exchange.mesh_fallback")
     if gang_failures > _MAX_GANG_RETRIES:
         ctx.metrics.add("exchange.mesh_runtime_fallback")
@@ -705,6 +751,16 @@ def _mesh_shuffle_fused(partitions, fusion, num_out, schema, ctx, stats,
             base_ledger.release_all()
     from ..exec import shuffle as S
 
+    if gang_failures <= _MAX_GANG_RETRIES:
+        # quota exhaustion (data skew), not a dying gang: split the
+        # oversized batch set and re-plan each half on the mesh
+        split = _skew_split_merge(
+            batches, num_out, ctx, stats, col_stats,
+            lambda chunk, st, cs: _mesh_shuffle_fused(
+                [chunk], fusion, num_out, schema, ctx, st, mesh, axis,
+                cs, stat_cols))
+        if split is not None:
+            return split
     ctx.metrics.add("exchange.mesh_fallback")
     if gang_failures > _MAX_GANG_RETRIES:
         ctx.metrics.add("exchange.mesh_runtime_fallback")

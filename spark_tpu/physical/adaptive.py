@@ -146,6 +146,204 @@ def replan_stages(stages, done: set, ctx: ExecContext) -> None:
             st.root = new_root
 
 
+def install_runtime_filters(stages, done: set, ctx: ExecContext) -> None:
+    """Sideways information passing (role of the reference's
+    DynamicPruning / Presto dynamic filtering): when a hash-join build
+    side has materialized, harvest its key domain HOST-SIDE from state
+    the engine already synced — per-reducer map-side column stats
+    (exec/shuffle._OutBuffer), the seeded dense-range memo, or the
+    StringDict code domains of the materialized batches — and stash it
+    on the not-yet-run probe-side shuffle exchange. The exchange prunes
+    probe rows before they are shuffled: whole batches drop when their
+    seeded range misses the domain, and under ExchangeFusion the
+    row-level filter rides the existing fused map kernel as aux operands
+    (zero extra dispatches, zero extra syncs — the obs gate proves the
+    launch-delta identity)."""
+    from ..config import ADAPTIVE_RUNTIME_FILTER
+
+    if not ctx.conf.get(ADAPTIVE_RUNTIME_FILTER):
+        return
+    from ..exec.scheduler import _StageOutput
+    from .exchange import ShuffleExchangeExec
+    from .operators import HashJoinExec
+    from .partitioning import HashPartitioning
+
+    for st in stages:
+        if st.stage_id in done:
+            continue
+        for node in st.root.iter_nodes():
+            if not isinstance(node, HashJoinExec):
+                continue
+            # pruned probe rows must be provably output-irrelevant: only
+            # join types whose output is a subset of MATCHING probe rows
+            if node.join_type not in ("inner", "left_semi"):
+                continue
+            if len(node.left_keys) != 1 or len(node.right_keys) != 1:
+                continue
+            left = node.left
+            if not (isinstance(left, _StageOutput)
+                    and left.stage.stage_id not in done
+                    and isinstance(left.stage.root, ShuffleExchangeExec)):
+                continue
+            probe = left.stage.root
+            if getattr(probe, "runtime_filter", None) is not None:
+                continue
+            if not isinstance(probe.partitioning, HashPartitioning):
+                continue
+            filt = _harvest_build_domain(node, done)
+            if filt is None:
+                continue
+            kid = node.left_keys[0].expr_id
+            out_pos = next((i for i, a in enumerate(probe.output)
+                            if a.expr_id == kid), None)
+            if out_pos is None:
+                continue
+            filt["out_pos"] = out_pos
+            # pre-pipeline position enables whole-batch skip via the
+            # seeded memo; a computed key (None) still row-prunes fused
+            filt["child_pos"] = next(
+                (i for i, a in enumerate(probe.child.output)
+                 if a.expr_id == kid), None)
+            probe.runtime_filter = filt
+            ctx.metrics.add("adaptive.runtime_filters_installed")
+            tracer = getattr(ctx, "tracer", None)
+            if tracer is not None:
+                with tracer.span("adaptive.runtime_filter",
+                                 cat="adaptive",
+                                 args={"kind": filt["kind"],
+                                       "stage": st.stage_id}):
+                    pass
+
+
+def _harvest_build_domain(join, done: set):
+    """The materialized build side's key domain, from already-synced
+    state only (NO kernel launches, NO device reads): returns
+    {"kind": "range", "lo", "hi"} for integral/date keys,
+    {"kind": "dict", "domain": frozenset} for dict-encoded string keys,
+    or None when no free domain is available (never guess)."""
+    from ..exec.scheduler import _StageOutput
+    from ..types import DateType, IntegralType, StringType
+    from ..utils.device_memo import peek_dense_range
+    from .exchange import BroadcastExchangeExec, ShuffleExchangeExec
+
+    key = join.right_keys[0]
+    integral = isinstance(key.dtype, (IntegralType, DateType))
+    stringy = isinstance(key.dtype, StringType)
+    if not (integral or stringy):
+        return None
+    r = join.right
+    # see through an AQE-demoted broadcast over a done shuffle stage:
+    # the shuffle's map-side stats survive the demotion
+    if isinstance(r, BroadcastExchangeExec):
+        r = r.child
+    if not (isinstance(r, _StageOutput) and r.stage.stage_id in done
+            and r.stage.result is not None):
+        return None
+    result = r.stage.result
+    rows = sum(b.num_rows() for p in result for b in p)
+    if rows == 0:
+        # empty build: inner/semi output is empty — prune everything
+        return {"kind": "range", "lo": 1, "hi": 0} if integral \
+            else {"kind": "dict", "domain": frozenset()}
+    kpos = next((i for i, a in enumerate(r.output)
+                 if a.expr_id == key.expr_id), None)
+    if kpos is None:
+        return None
+    if stringy:
+        # dict-code domain: the union of the batches' StringDict values
+        # is a sound superset of the live key set, readable host-side
+        domain: set = set()
+        for part in result:
+            for b in part:
+                d = b.columns[kpos].dictionary
+                if d is None:
+                    return None
+                domain.update(d.values)
+        return {"kind": "dict", "domain": frozenset(domain)}
+    root = r.stage.root
+    if isinstance(root, ShuffleExchangeExec) and root.last_col_stats:
+        # map-side accumulated per-reducer (or mesh-union) stats
+        lo = hi = None
+        for per_col in root.last_col_stats.values():
+            st = per_col.get(kpos)
+            if st is None:
+                return None
+            cmin, cmax, any_valid = st
+            if not any_valid:
+                continue
+            lo = cmin if lo is None else min(lo, cmin)
+            hi = cmax if hi is None else max(hi, cmax)
+        if lo is None:
+            return {"kind": "range", "lo": 1, "hi": 0}
+        return {"kind": "range", "lo": int(lo), "hi": int(hi)}
+    # broadcast/gather builds: the dense-range memo, if (and only if)
+    # ingest already seeded it for these exact arrays
+    lo = hi = None
+    for part in result:
+        for b in part:
+            hit = peek_dense_range(b.columns[kpos], b.row_mask)
+            if hit is None:
+                return None
+            kmin, kmax, any_live = hit
+            if not any_live:
+                continue
+            lo = kmin if lo is None else min(lo, kmin)
+            hi = kmax if hi is None else max(hi, kmax)
+    if lo is None:
+        return {"kind": "range", "lo": 1, "hi": 0}
+    return {"kind": "range", "lo": int(lo), "hi": int(hi)}
+
+
+def _inline_remaining(root, done: set):
+    """Re-inline the NOT-yet-run parent stages into one plan tree: their
+    _StageOutput leaves become the stage roots themselves (the unrun
+    exchanges return to the tree, where the whole-tier lowering turns
+    them into in-program gathers), while DONE stages stay as
+    materialized leaves the program builder ingests directly."""
+    from ..exec.scheduler import _StageOutput
+
+    def rw(node):
+        if isinstance(node, _StageOutput) \
+                and node.stage.stage_id not in done:
+            return _inline_remaining(node.stage.root, done)
+        return node
+
+    return root.transform_up(rw)
+
+
+def maybe_readmit(result_stage, done: set, ctx: ExecContext) -> None:
+    """Stage-boundary re-admission: after a stage materializes, feed the
+    now-known output sizes back through the compile-tier chooser for the
+    REMAINING plan. A remainder the chooser admits to the whole tier
+    collapses into ONE program (materialized stages become ingested
+    leaves; unrun exchanges become in-program gathers) instead of
+    continuing stage-at-a-time — the runtime counterpart of
+    apply_compile_tier's plan-time decision."""
+    from ..config import ADAPTIVE_READMISSION
+
+    if not ctx.conf.get(ADAPTIVE_READMISSION):
+        return
+    from .whole_query import WholeQueryExec, choose_tier
+
+    if result_stage.stage_id in done:
+        return
+    if isinstance(result_stage.root, WholeQueryExec):
+        return
+    inlined = _inline_remaining(result_stage.root, done)
+    dec = choose_tier(inlined, ctx.conf)
+    if dec.tier != "whole":
+        return
+    dec.details["readmitted"] = True
+    result_stage.root = WholeQueryExec(inlined, dec)
+    ctx.readmission_decision = dec
+    ctx.metrics.add("adaptive.readmissions")
+    tracer = getattr(ctx, "tracer", None)
+    if tracer is not None:
+        with tracer.span("adaptive.readmission", cat="adaptive",
+                         args={"tier": dec.tier, "reason": dec.reason}):
+            pass
+
+
 def _effective_child(plan_child):
     """See through scheduler stage boundaries (exec/scheduler.py
     _StageOutput) to the exchange that produced the partitions."""

@@ -45,6 +45,11 @@ class ShuffleExchangeExec(PhysicalPlan):
         # accumulates (annotate_exchange_stat_cols: only plan-reachable
         # dense candidates); None = every integral column (bare plans)
         self.stat_cols: list | None = None
+        # runtime join filter (physical/adaptive.install_runtime_filters):
+        # a materialized build side's key domain, applied to map batches
+        # before they are shuffled — whole-batch skip via the seeded
+        # dense-range memo, row-level pruning inside the fused map kernel
+        self.runtime_filter: dict | None = None
 
     @property
     def output(self):
@@ -78,6 +83,8 @@ class ShuffleExchangeExec(PhysicalPlan):
 
     def execute(self, ctx: ExecContext) -> list:
         parts = self.child.execute(ctx)
+        if self.runtime_filter is not None:
+            parts = self._runtime_filter_skip(parts, ctx)
         schema = attrs_schema(self.output)
         p = self.partitioning
         # cleared IN PLACE: stage-builder/AQE copies share this node's
@@ -108,6 +115,13 @@ class ShuffleExchangeExec(PhysicalPlan):
                     # map side is fused (spark.tpu.fusion.mesh); the
                     # legacy materialize-then-collective composition sits
                     # behind that flag
+                    if self.runtime_filter is not None:
+                        # the mesh program stages whole host arrays, so
+                        # the filter cannot ride it as aux operands —
+                        # prune rows per batch BEFORE staging (one tiny
+                        # mask dispatch each; fewer live rows also eases
+                        # the quota ladder)
+                        parts = self._runtime_filter_rows(parts, ctx)
                     with self._span(ctx, "exchange.mesh_all_to_all", p):
                         return ME.mesh_shuffle_hash(
                             parts, key_positions, p.num_partitions, schema,
@@ -119,12 +133,20 @@ class ShuffleExchangeExec(PhysicalPlan):
                             stat_cols=self.stat_cols)
                 with self._span(ctx, "exchange.hash", p):
                     if fusion is not None:
-                        return S.shuffle_fused(
-                            parts,
-                            fusion.bind_hash(key_positions,
-                                             p.num_partitions),
+                        bound = fusion.bind_hash(key_positions,
+                                                 p.num_partitions)
+                        if self.runtime_filter is not None:
+                            # row-level pruning rides the SAME fused map
+                            # kernel as aux operands — no extra dispatch
+                            bound.bind_runtime_filter(self.runtime_filter)
+                        out = S.shuffle_fused(
+                            parts, bound,
                             p.num_partitions, schema, ctx, self.last_stats,
                             self.last_col_stats, self.stat_cols)
+                        if fusion.rf_pruned:
+                            ctx.metrics.add("adaptive.filter_rows_pruned",
+                                            fusion.rf_pruned)
+                        return out
                     return S.shuffle_hash(parts, key_positions,
                                           p.num_partitions, schema, ctx,
                                           self.last_stats,
@@ -146,6 +168,71 @@ class ShuffleExchangeExec(PhysicalPlan):
                         self.last_stats, col_stats=self.last_col_stats,
                         stat_cols=self.stat_cols)
         raise UnsupportedOperationError(f"exchange for {p}")
+
+    def _runtime_filter_skip(self, parts: list, ctx: ExecContext) -> list:
+        """Whole-batch pruning against the build-side key domain using
+        ONLY already-synced state: the seeded dense-range memo for
+        integral keys (peek — a miss never computes) and the host-side
+        StringDict code domain for encoded string keys. A batch whose
+        key range/domain misses the build domain cannot produce a join
+        match and never enters the shuffle. Zero kernels, zero syncs."""
+        rf = self.runtime_filter
+        cp = rf.get("child_pos")
+        if cp is None:
+            return parts    # computed key: no pre-pipeline column
+        from ..utils.device_memo import peek_dense_range
+
+        kind = rf["kind"]
+        kept, skipped = [], 0
+        for part in parts:
+            keep_part = []
+            for b in part:
+                drop = False
+                col = b.columns[cp]
+                if kind == "range":
+                    hit = peek_dense_range(col, b.row_mask)
+                    if hit is not None:
+                        kmin, kmax, any_live = hit
+                        drop = (not any_live) or kmax < rf["lo"] \
+                            or kmin > rf["hi"]
+                else:
+                    d = col.dictionary
+                    if d is not None:
+                        dom = rf["domain"]
+                        drop = not any(v in dom for v in d.values)
+                if drop:
+                    skipped += 1
+                else:
+                    keep_part.append(b)
+            kept.append(keep_part)
+        if skipped:
+            ctx.metrics.add("adaptive.filter_batches_skipped", skipped)
+        return kept
+
+    def _runtime_filter_rows(self, parts: list, ctx: ExecContext) -> list:
+        """Row-level pruning ahead of the mesh path: batches are the
+        CHILD's output here (any map pipeline runs inside the mesh
+        program), so the filter applies at the pre-pipeline key position.
+        One shared mask-update kernel per batch (physical/fusion.
+        runtime_filter_batch)."""
+        from .fusion import runtime_filter_batch
+
+        rf = self.runtime_filter
+        cp = rf.get("child_pos")
+        if cp is None:
+            return parts    # computed key: no pre-pipeline column
+        pruned = 0
+        out = []
+        for part in parts:
+            new_part = []
+            for b in part:
+                nb, drop = runtime_filter_batch(rf, None, b, cp)
+                pruned += drop
+                new_part.append(nb)
+            out.append(new_part)
+        if pruned:
+            ctx.metrics.add("adaptive.filter_rows_pruned", pruned)
+        return out
 
     @staticmethod
     def _span(ctx, name: str, p):
@@ -206,6 +293,8 @@ class ShuffleExchangeExec(PhysicalPlan):
     def simple_string(self):
         s = f"Exchange[{type(self.partitioning).__name__}" \
             f"({self.partitioning.num_partitions})]"
+        if self.runtime_filter is not None:
+            s += f" RUNTIME-FILTER[{self.runtime_filter['kind']}]"
         if self.pipe_fusion is not None:
             filters, outputs = self.pipe_fusion
             o = ", ".join(x.simple_string() for x in outputs)

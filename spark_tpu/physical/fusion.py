@@ -630,6 +630,14 @@ class ExchangeFusion:
         self._bounds_host = None
         self._bounds_dev = None
         self._range_pos = None
+        # runtime join filter (physical/adaptive): build-side key domain
+        # pruning probe rows inside the SAME fused kernel — the domain is
+        # an aux operand (range bounds / per-batch dict-code LUT), never
+        # a separate dispatch. rf_pruned accumulates the pruned-row count
+        # that rides the counts transfer (no extra sync).
+        self._rf = None
+        self._rf_dev = None
+        self.rf_pruned = 0
 
     # -- partitioning binding (one ExchangeFusion serves one execute) ------
     def bind_hash(self, key_positions, num_out: int, seed: int = 42):
@@ -639,6 +647,21 @@ class ExchangeFusion:
 
     def bind_rr(self, num_out: int):
         self._mode, self._num_out = "rr", num_out
+        return self
+
+    def bind_runtime_filter(self, rf: dict):
+        """Arm the runtime join filter. The cache key grows an element
+        ONLY when armed, so filter-off runs keep byte-identical kernel
+        keys (the launch-delta identity the obs gate proves); the range
+        bounds stay kernel operands, so different domains reuse one
+        compiled kernel."""
+        import jax.numpy as jnp
+
+        self._rf = dict(rf)
+        if rf["kind"] == "range":
+            self._rf_dev = jnp.asarray(  # tpulint: ignore[host-sync]
+                np.asarray(  # tpulint: ignore[host-sync] host bounds
+                    [rf["lo"], rf["hi"]], dtype=np.int64))
         return self
 
     def bind_range(self, key_position: int, bounds, descending: bool,
@@ -673,6 +696,8 @@ class ExchangeFusion:
         from ..exec import shuffle as S
 
         b = self.run_pipeline(batch)
+        if self._rf is not None:
+            b = self._apply_rf_unfused(b)
         if self._mode == "h":
             return S.hash_partition_batch(b, self._key_idx, self._num_out,
                                           self._seed)
@@ -681,6 +706,17 @@ class ExchangeFusion:
         return S.range_partition_batch(b, self._range_pos,
                                        self._bounds_host, self._descending,
                                        self._num_out, string_key=False)
+
+    def _apply_rf_unfused(self, b: ColumnarBatch) -> ColumnarBatch:
+        """Runtime join filter on the size-gated unfused path: one tiny
+        mask-update dispatch (the fused path folds it into the map kernel
+        instead). The pruned count rides the partition-kernel counts this
+        path already materializes, except here we pull the scalar beside
+        them — the path syncs per batch regardless."""
+        b, drop = runtime_filter_batch(self._rf, self._rf_dev, b,
+                                       self._rf["out_pos"])
+        self.rf_pruned += drop
+        return b
 
     # -- the fused kernel --------------------------------------------------
     def partition_batch(self, batch: ColumnarBatch, start: int):
@@ -709,6 +745,25 @@ class ExchangeFusion:
                  for i in dict_pos]
         mode, seed, descending = self._mode, self._seed, self._descending
         rpos = self._range_pos
+        # runtime join filter operands (bind_runtime_filter): range
+        # bounds ride as a device scalar pair; dict domains become a
+        # per-batch bool LUT over the batch's OWN code space (host set
+        # membership over StringDict values — no decode, no sync)
+        rf = self._rf
+        rf_kind = None if rf is None else rf["kind"]
+        rf_pos = None if rf is None else rf["out_pos"]
+        rf_arg = self._rf_dev
+        if rf_kind == "dict":
+            sd = host_outs[rf_pos].sdict
+            if sd is None:
+                rf_kind = rf_pos = rf_arg = None  # undecodable: unfiltered
+            else:
+                dom = rf["domain"]
+                lut = np.fromiter((v in dom for v in sd.values),
+                                  dtype=bool, count=len(sd.values))
+                if lut.size == 0:
+                    lut = np.zeros(1, dtype=bool)
+                rf_arg = jnp.asarray(lut)
         key = ("fused_shuffle", mode, self._struct_key, cap, num_out,
                key_idx, seed, descending, rpos,
                None if self._bounds_dev is None
@@ -717,16 +772,41 @@ class ExchangeFusion:
                tuple(sorted(dict_pos)),
                tuple(int(l.shape[0])  # tpulint: ignore[host-sync]
                      for l in kluts))
+        if rf_kind is not None:
+            # appended ONLY when armed: filter-off cache keys stay
+            # byte-identical (zero launch-delta with the layer enabled
+            # on a filter-free plan)
+            key = key + (("rf", rf_kind, rf_pos,
+                          None if rf_kind != "dict"
+                          # static shape, not a device scalar
+                          else int(rf_arg.shape[0])),)  # tpulint: ignore[host-sync]
 
         def build():
             from ..ops.hashing import hash_columns, partition_ids
             from ..ops.partition import _group_by_pid
 
             def kernel(datas, valids, row_mask, aux, start_s, bounds,
-                       kluts):
+                       kluts, rf_op):
                 out_datas, out_valids, mask = trace_pipeline(
                     input_attrs, filters, outputs, datas, valids, row_mask,
                     aux, cap)
+                rf_drop = None
+                if rf_kind is not None:
+                    kd = out_datas[rf_pos]
+                    kv = out_valids[rf_pos]
+                    if rf_kind == "range":
+                        k64 = kd.astype(jnp.int64)
+                        ok = (k64 >= rf_op[0]) & (k64 <= rf_op[1])
+                    else:
+                        codes = jnp.clip(kd.astype(jnp.int32), 0,
+                                         rf_op.shape[0] - 1)
+                        ok = jnp.take(rf_op, codes)
+                    if kv is not None:
+                        # null keys never match but never mis-route:
+                        # keep them (conservative) — the join drops them
+                        ok = ok | ~kv
+                    rf_drop = jnp.sum(mask & ~ok)
+                    mask = mask & ok
                 if mode == "h":
                     eqs = []
                     for i, is_bool in zip(key_idx, key_bool):
@@ -756,7 +836,13 @@ class ExchangeFusion:
                 g_datas = [jnp.take(d, pr.perm) for d in out_datas]
                 g_valids = [None if v is None else jnp.take(v, pr.perm)
                             for v in out_valids]
-                return g_datas, g_valids, pr.counts
+                counts = pr.counts
+                if rf_drop is not None:
+                    # the pruned-row count rides the counts transfer —
+                    # one appended lane, not a second sync
+                    counts = jnp.concatenate(
+                        [counts, rf_drop.astype(counts.dtype)[None]])
+                return g_datas, g_valids, counts
 
             return jax.jit(kernel)
 
@@ -765,7 +851,8 @@ class ExchangeFusion:
             g_datas, g_valids, counts = kernel(
                 [c.data for c in batch.columns],
                 [c.validity for c in batch.columns], batch.row_mask, aux,
-                np.int32(start % num_out), self._bounds_dev, kluts)
+                np.int32(start % num_out), self._bounds_dev, kluts,
+                rf_arg if rf_kind is not None else None)
         fields = attrs_schema(self.pipe_attrs).fields
         gathered = []
         for i, f in enumerate(fields):
@@ -777,12 +864,72 @@ class ExchangeFusion:
                 None if g_valids[i] is None
                 else np.asarray(g_valids[i]),  # tpulint: ignore[host-sync]
                 sdict))
-        return gathered, np.asarray(counts)  # tpulint: ignore[host-sync]
+        counts = np.asarray(counts)  # tpulint: ignore[host-sync]
+        if rf_kind is not None:
+            # counts is already host-side numpy here — no extra sync
+            self.rf_pruned += int(counts[-1])  # tpulint: ignore[host-sync]
+            counts = counts[:-1]
+        return gathered, counts
 
 
 # ---------------------------------------------------------------------------
 # FuseStages planner rule
 # ---------------------------------------------------------------------------
+
+def runtime_filter_batch(rf: dict, rf_dev, b: ColumnarBatch,
+                         pos: int) -> tuple:
+    """One mask-update dispatch applying a runtime join filter to a
+    batch's key column `pos` (the shared kernel behind the size-gated
+    unfused path AND the mesh pre-pass, where the filter cannot ride a
+    fused map kernel). Null keys are kept conservatively — the join
+    drops them. Returns (filtered batch, pruned-row count)."""
+    import jax
+
+    jnp = _jnp()
+    col = b.columns[pos]
+    if rf["kind"] == "dict":
+        sd = col.dictionary
+        if sd is None:
+            return b, 0    # undecodable codes: pass through unfiltered
+        dom = rf["domain"]
+        lut = np.fromiter((v in dom for v in sd.values), dtype=bool,
+                          count=len(sd.values))
+        if lut.size == 0:
+            lut = np.zeros(1, dtype=bool)
+        op = jnp.asarray(lut)
+    elif rf_dev is not None:
+        op = rf_dev
+    else:
+        op = jnp.asarray(  # tpulint: ignore[host-sync]
+            np.asarray(  # tpulint: ignore[host-sync] host bounds
+                [rf["lo"], rf["hi"]], dtype=np.int64))
+    kind = rf["kind"]
+    key = ("rf_mask", kind, str(col.data.dtype),
+           col.validity is not None, b.capacity,
+           # static shape, not a device scalar
+           None if kind != "dict" else int(op.shape[0]))  # tpulint: ignore[host-sync]
+
+    def build():
+        def kernel(kd, kv, mask, opnd):
+            if kind == "range":
+                k64 = kd.astype(jnp.int64)
+                ok = (k64 >= opnd[0]) & (k64 <= opnd[1])
+            else:
+                codes = jnp.clip(kd.astype(jnp.int32), 0,
+                                 opnd.shape[0] - 1)
+                ok = jnp.take(opnd, codes)
+            if kv is not None:
+                ok = ok | ~kv
+            new_mask = mask & ok
+            return new_mask, jnp.sum(mask & ~ok)
+
+        return jax.jit(kernel)
+
+    kernel = GLOBAL_KERNEL_CACHE.get_or_build(key, build)
+    new_mask, drop = kernel(col.data, col.validity, b.row_mask, op)
+    return (ColumnarBatch(b.schema, b.columns, new_mask),
+            int(drop))  # tpulint: ignore[host-sync]
+
 
 def _aggregate_fusable(agg: HashAggregateExec, compute: ComputeExec) -> bool:
     if not _compute_nontrivial(compute):

@@ -90,6 +90,25 @@ def _jnp():
     return jnp
 
 
+def _home_batch(b):
+    """Re-home one batch's planes onto the default device (no-op for
+    arrays already there). Readmitted plans ingest mesh-materialized
+    stages whose partitions live one-per-device; a single jitted
+    program cannot take args spread across devices."""
+    import jax
+
+    from dataclasses import replace
+
+    dev = jax.devices()[0]
+
+    def put(a):
+        return None if a is None else jax.device_put(a, dev)
+
+    cols = [replace(c, data=put(c.data), validity=put(c.validity))
+            for c in b.columns]
+    return ColumnarBatch(b.schema, cols, put(b.row_mask), b._num_rows)
+
+
 # ---------------------------------------------------------------------------
 # tier decision
 # ---------------------------------------------------------------------------
@@ -118,7 +137,23 @@ def _scan_table(node):
     return t if isinstance(t, pa.Table) else None
 
 
+def _external_scan_rows(node) -> Optional[int]:
+    """Plan-time row count of an external scan from file-format
+    statistics: io/sources.ParquetSource exposes `plan_time_rows()`
+    (exact footer row-group counts, no data read). None for formats
+    without trustworthy plan-time statistics."""
+    fn = getattr(getattr(node, "source", None), "plan_time_rows", None)
+    if fn is None:
+        return None
+    try:
+        r = fn()
+    except Exception:
+        return None
+    return None if r is None else int(r)
+
+
 def _leaf_rows(node) -> Optional[int]:
+    from ..exec.scheduler import _StageOutput
     from . import operators as O
 
     if isinstance(node, O.LocalTableScanExec):
@@ -126,19 +161,29 @@ def _leaf_rows(node) -> Optional[int]:
     if isinstance(node, O.ScanExec):
         t = _scan_table(node)
         if t is None:
-            return None
+            return _external_scan_rows(node)
         return int(t.num_rows)  # tpulint: ignore[host-sync]
     if isinstance(node, O.RangeExec):
         step = node.step
         if step > 0:
             return max(0, -(-(node.end - node.start) // step))
         return max(0, -(-(node.start - node.end) // -step))
+    if isinstance(node, _StageOutput) and node.stage.result is not None:
+        # materialized parent stage (adaptive re-admission): sizes are
+        # OBSERVED, not estimated — host-known batch row counts
+        return sum(b.num_rows() for p in node.stage.result for b in p)
     return None
 
 
-def supported_whole_query(plan, conf) -> tuple[bool, str]:
+def supported_whole_query(plan, conf,
+                          history_ok: bool = False) -> tuple[bool, str]:
     """Structural admission: every operator of the plan must have a
-    whole-query lowering. Returns (ok, reason-if-not)."""
+    whole-query lowering. Returns (ok, reason-if-not). `history_ok`
+    relaxes the external-scan statistics requirement when a recorded
+    QueryProfile run supplies observed volumes instead (adaptive
+    history re-planning)."""
+    from ..config import ADAPTIVE_PARQUET_STATS
+    from ..exec.scheduler import _StageOutput
     from . import operators as O
     from .exchange import BroadcastExchangeExec, ShuffleExchangeExec
     from .fusion import FusedAggregateExec, FusedLimitExec  # noqa: F401
@@ -146,10 +191,19 @@ def supported_whole_query(plan, conf) -> tuple[bool, str]:
     for node in _iter_inner(plan):
         if isinstance(node, (O.LocalTableScanExec, O.RangeExec)):
             continue
+        if isinstance(node, _StageOutput):
+            if node.stage.result is not None:
+                continue   # materialized stage: an ingestable leaf
+            return False, (f"stage {node.stage.stage_id} output is not "
+                           "materialized")
         if isinstance(node, O.ScanExec):
             if _scan_table(node) is None:
-                return False, (f"scan [{node.name}] reads an external "
-                               "source (no plan-time statistics)")
+                stats_ok = (bool(  # tpulint: ignore[host-sync] conf flag
+                    conf.get(ADAPTIVE_PARQUET_STATS))
+                    and _external_scan_rows(node) is not None)
+                if not (stats_ok or history_ok):
+                    return False, (f"scan [{node.name}] reads an external "
+                                   "source (no plan-time statistics)")
             continue
         if isinstance(node, (O.ComputeExec, O.LimitExec, O.SortExec,
                              O.UnionExec, O.CoalescePartitionsExec,
@@ -312,8 +366,13 @@ def _avg_compile_ms() -> float:
     return max(avg, 50.0)
 
 
-def choose_tier(plan, conf, cluster: bool = False) -> TierDecision:
-    """The three-tier cost model. See module docstring for the rules."""
+def choose_tier(plan, conf, cluster: bool = False,
+                observed_rows: Optional[int] = None) -> TierDecision:
+    """The three-tier cost model. See module docstring for the rules.
+    `observed_rows` substitutes a RECORDED run's total shuffled volume
+    (QueryProfile / warm-start manifest) for leaves whose plan-time row
+    count is unknown — adaptive history re-planning for recurring
+    queries over external sources."""
     from ..config import (
         COMPILE_TIER, FUSION_ENABLED, MEMORY_BUDGET, WHOLE_MIN_ROWS,
     )
@@ -356,23 +415,32 @@ def choose_tier(plan, conf, cluster: bool = False) -> TierDecision:
                 "stage", "whole-query fallback: no exchange round-trips "
                 "to eliminate (single-stage plan — stage fusion already "
                 "dispatches once per batch)", {"exchanges": 0})
-    ok, why = supported_whole_query(plan, conf)
+    ok, why = supported_whole_query(plan, conf,
+                                    history_ok=observed_rows is not None)
     if not ok:
         return TierDecision("stage", f"whole-query fallback: {why}")
     rows = []
     n_ops = 0
+    unknown_leaves = False
     for node in _iter_inner(plan):
         n_ops += 1
         r = _leaf_rows(node)
         if r is not None:
             rows.append(r)
         elif not node.children:
-            return TierDecision(
-                "stage", "whole-query fallback: leaf statistics unknown "
-                f"({type(node).__name__} row count untraced)")
+            if observed_rows is None:
+                return TierDecision(
+                    "stage", "whole-query fallback: leaf statistics "
+                    f"unknown ({type(node).__name__} row count untraced)")
+            unknown_leaves = True
     volume = sum(rows)
+    if unknown_leaves:
+        # recorded volume stands in for the untraced leaves
+        volume = max(volume, int(observed_rows))
     details = {"volume_rows": volume, "lowered_ops": n_ops,
                "est_compile_ms": round(_avg_compile_ms() * n_ops, 1)}
+    if observed_rows is not None:
+        details["observed_rows"] = int(observed_rows)
     est = _estimate_resident_bytes(plan, conf)
     if est is not None:
         details["est_resident_bytes"] = est
@@ -547,12 +615,15 @@ class _ProgramBuilder:
 
     # -- dispatch ----------------------------------------------------------
     def lower(self, node) -> _Lowered:
+        from ..exec.scheduler import _StageOutput
         from . import operators as O
         from .exchange import BroadcastExchangeExec, ShuffleExchangeExec
         from .fusion import FusedAggregateExec, FusedLimitExec
 
         if isinstance(node, (O.LocalTableScanExec, O.RangeExec,
-                             O.ScanExec)):
+                             O.ScanExec, _StageOutput)):
+            # _StageOutput: a materialized parent stage ingests exactly
+            # like a scan (adaptive re-admission mid-query)
             return self._lower_leaf(node)
         if isinstance(node, FusedAggregateExec):
             low = self.lower(node.child)
@@ -613,9 +684,21 @@ class _ProgramBuilder:
 
     # -- leaves ------------------------------------------------------------
     def _lower_leaf(self, node) -> _Lowered:
+        from ..exec.scheduler import _StageOutput
+
         jnp = _jnp()
         parts = node.execute(self.ctx)
         batches = [b for p in parts for b in p]
+        if batches and isinstance(node, _StageOutput):
+            # a mesh-materialized stage leaves partition i resident on
+            # device i; a jitted program's args must share one device —
+            # re-home everything (device_put is a no-op for arrays that
+            # already live there, so host-shuffled stages pay nothing)
+            batches = [_home_batch(b) for b in batches]
+        if not batches:
+            # all-empty partitions (e.g. an empty materialized stage):
+            # one empty batch keeps the concat/pad lowering uniform
+            batches = [ColumnarBatch.empty(attrs_schema(node.output))]
         fields = attrs_schema(node.output).fields
         self._member(node)
         caps = [b.capacity for b in batches]

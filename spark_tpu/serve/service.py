@@ -121,7 +121,7 @@ class QueryService:
             if blackbox.ENABLED:
                 try:
                     blackbox.record_rejection(self.session, admission_err,
-                                              pool=pool)
+                                              pool=pool, qe=qe)
                 except Exception:
                     pass
             raise

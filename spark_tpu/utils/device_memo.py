@@ -24,7 +24,7 @@ import threading
 from . import lockwatch
 
 __all__ = ["memo_device_scalars", "seed_dense_range_memo",
-           "DENSE_RANGE_KIND"]
+           "peek_dense_range", "DENSE_RANGE_KIND"]
 
 _MEMO: "collections.OrderedDict" = collections.OrderedDict()
 _LOCK = threading.Lock()
@@ -62,6 +62,28 @@ def memo_device_scalars(kind: tuple, arrays: tuple, compute):
         while len(_MEMO) > _MAX:
             _MEMO.popitem(last=False)
     return value
+
+
+def peek_dense_range(col, row_mask):
+    """Memo lookup WITHOUT compute: the seeded (kmin, kmax, any_live)
+    for this column under this row mask, or None on a miss. Never
+    launches a kernel and never syncs — callers that only want to act
+    when the answer is already free (runtime-filter batch skip) use
+    this instead of memo_device_scalars."""
+    arrays = (col.data, col.validity, row_mask)
+    live = tuple(a for a in arrays if a is not None)
+    key = (DENSE_RANGE_KIND,
+           tuple(id(a) if a is not None else None for a in arrays))
+    with _LOCK:
+        ent = _MEMO.get(key)
+        if ent is None:
+            return None
+        refs, value = ent
+        if all(r() is a for r, a in zip(refs, live)):
+            _MEMO.move_to_end(key)
+            return value
+        del _MEMO[key]
+        return None
 
 
 def seed_dense_range_memo(col, row_mask, value: tuple) -> None:
